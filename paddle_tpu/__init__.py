@@ -1,0 +1,92 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capabilities (reference: lisong2019/Paddle), built on JAX/XLA/Pallas.
+
+Public surface mirrors paddle 2.0 (python/paddle/__init__.py in the
+reference): tensor functions at top level, `nn`, `optimizer`, `static`,
+`vision`, `distributed`, `metric`, `hapi`-style `Model`, plus the 1.x
+`fluid` namespace for static-graph programs.
+"""
+
+__version__ = "0.1.0"
+
+# paddle semantics: int64 labels / float64 tensors are first-class
+# (framework.proto VarType has INT64/FP64); jax needs x64 opted in.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+# dtypes
+from .core.dtypes import (bfloat16, bool_, complex64, complex128,  # noqa
+                          float16, float32, float64, get_default_dtype, int8,
+                          int16, int32, int64, set_default_dtype, uint8)
+# places
+from .core.place import (CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace,  # noqa
+                         XPUPlace, get_device, is_compiled_with_cuda,
+                         is_compiled_with_tpu, is_compiled_with_xpu,
+                         set_device)
+# tensor + autograd
+from .core.tensor import Tensor, to_tensor  # noqa: F401
+from .core.autograd import enable_grad, is_grad_enabled, no_grad  # noqa
+from .core.random import seed  # noqa: F401
+
+# functional surface (paddle.add, paddle.matmul, ...)
+from .tensor import *  # noqa: F401,F403
+from .tensor import ops as _tensor_ops
+
+# subpackages (imported lazily-ish; these are light)
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import metric  # noqa: F401
+from . import io  # noqa: F401
+from . import static  # noqa: F401
+from . import vision  # noqa: F401
+from . import text  # noqa: F401
+from . import distributed  # noqa: F401
+from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import jit  # noqa: F401
+from . import device  # noqa: F401
+from . import utils  # noqa: F401
+from . import distribution  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import profiler  # noqa: F401
+from . import sparse  # noqa: F401
+from . import incubate  # noqa: F401
+
+from .io.serialization import load, save  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .nn.layer.layers import ParamAttr  # noqa: F401
+from .utils.flags import get_flags, set_flags  # noqa: F401
+from .framework import disable_static, enable_static, in_dynamic_mode  # noqa
+from .tensor.ops import rand, randn, randint, randperm, uniform, normal  # noqa
+
+# fluid 1.x namespace
+from . import fluid  # noqa: F401
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """paddle.grad parity (imperative/partial_grad_engine.cc:29)."""
+    from .core import autograd as _ag
+
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    saved = [(t, t._grad) for t in ins]
+    for t in ins:
+        t._grad = None
+    for o in outs:
+        go = None
+        if grad_outputs is not None:
+            idx = outs.index(o)
+            gos = grad_outputs if isinstance(grad_outputs, (list, tuple)) \
+                else [grad_outputs]
+            go = gos[idx] if idx < len(gos) else None
+        _ag.backward(o, go, retain_graph=bool(retain_graph))
+    result = []
+    for t, old in saved:
+        g = t._grad
+        if g is None and not allow_unused:
+            g = None
+        result.append(g)
+        t._grad = old
+    return result

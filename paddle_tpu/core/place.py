@@ -1,0 +1,139 @@
+"""Device places.
+
+Reference parity: paddle/fluid/platform/place.h:103 (Place variant over
+CPUPlace/CUDAPlace/XPUPlace/CUDAPinnedPlace). TPU-native design: `TPUPlace`
+is the first-class accelerator place; the whole DeviceContext/stream layer of
+the reference collapses into jax.Device + XLA (SURVEY.md L0). CUDAPlace is
+accepted for API compatibility and maps onto the accelerator if one exists.
+"""
+from __future__ import annotations
+
+import functools
+
+
+class Place:
+    _idx: int
+
+    def __init__(self, idx: int = 0):
+        self._idx = int(idx)
+
+    def get_device_id(self) -> int:
+        return self._idx
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self._idx == other._idx
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._idx))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._idx})"
+
+
+class CPUPlace(Place):
+    def __init__(self):
+        super().__init__(0)
+
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class TPUPlace(Place):
+    """First-class TPU device place (the north-star `paddle.TPUPlace(i)`)."""
+
+
+class CUDAPlace(Place):
+    """Compatibility alias: programs written against CUDAPlace run on the
+    accelerator jax exposes (TPU here). Mirrors reference place.h semantics
+    of 'the accelerator device i'."""
+
+
+class CUDAPinnedPlace(Place):
+    def __repr__(self):
+        return "CUDAPinnedPlace"
+
+
+class XPUPlace(Place):
+    pass
+
+
+@functools.lru_cache(maxsize=None)
+def _jax_devices(backend=None):
+    import jax
+
+    return tuple(jax.devices(backend) if backend else jax.devices())
+
+
+def get_jax_device(place):
+    """Map a Place to a concrete jax.Device."""
+    import jax
+
+    if place is None:
+        return None
+    if isinstance(place, CPUPlace):
+        return jax.devices("cpu")[0]
+    devs = _jax_devices()
+    idx = place.get_device_id()
+    if idx >= len(devs):
+        raise ValueError(f"{place!r}: only {len(devs)} devices visible")
+    return devs[idx]
+
+
+def is_compiled_with_cuda() -> bool:  # API parity
+    return False
+
+
+def is_compiled_with_xpu() -> bool:  # API parity
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def accelerator_count() -> int:
+    import jax
+
+    devs = _jax_devices()
+    return sum(1 for d in devs if d.platform != "cpu") or len(devs)
+
+
+def default_place():
+    """The place new tensors land on: the first accelerator, else CPU."""
+    devs = _jax_devices()
+    if devs and devs[0].platform != "cpu":
+        return TPUPlace(0)
+    return CPUPlace()
+
+
+def set_device(device: str):
+    """paddle.set_device parity ('cpu', 'tpu', 'tpu:0', 'gpu:0'...)."""
+    global _current_place
+    device = device.lower()
+    if device == "cpu":
+        _current_place = CPUPlace()
+    else:
+        kind, _, idx = device.partition(":")
+        idx = int(idx) if idx else 0
+        if kind in ("tpu", "gpu", "xpu", "cuda"):
+            _current_place = TPUPlace(idx)
+        else:
+            raise ValueError(f"unknown device {device!r}")
+    return _current_place
+
+
+def get_device() -> str:
+    p = _get_current_place()
+    if isinstance(p, CPUPlace):
+        return "cpu"
+    return f"tpu:{p.get_device_id()}"
+
+
+_current_place = None
+
+
+def _get_current_place():
+    global _current_place
+    if _current_place is None:
+        _current_place = default_place()
+    return _current_place

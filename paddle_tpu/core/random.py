@@ -1,0 +1,48 @@
+"""Global RNG state.
+
+Reference parity: paddle/fluid/framework/generator.h (global/per-device
+Generator) and paddle.seed. TPU-native design: a single jax PRNG key chain;
+`split()` hands out fresh keys to eager random ops, while the static executor
+threads an explicit key through the jitted program (functional randomness, as
+XLA requires).
+"""
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_key = None
+_seed_value = 0
+
+
+def seed(s: int):
+    """paddle.seed parity."""
+    global _key, _seed_value
+    import jax
+
+    with _lock:
+        _seed_value = int(s)
+        _key = jax.random.PRNGKey(_seed_value)
+    return _seed_value
+
+
+def get_seed() -> int:
+    return _seed_value
+
+
+def next_key():
+    """Hand out a fresh PRNG key (eager random ops)."""
+    global _key
+    import jax
+
+    with _lock:
+        if _key is None:
+            _key = jax.random.PRNGKey(0)
+        _key, sub = jax.random.split(_key)
+        return sub
+
+
+def fold_in(data: int):
+    import jax
+
+    return jax.random.fold_in(next_key(), data)

@@ -1,0 +1,432 @@
+"""Eager Tensor: a paddle-shaped handle over a jax.Array.
+
+Reference parity: paddle/fluid/imperative (VarBase bound at
+pybind/imperative.cc:522; Tracer::TraceOp tracer.cc:48 dispatches each python
+op call to a kernel and records a grad node). TPU-native design: the "kernel"
+is a jax function (XLA-compiled, device-resident); tracing records a jax.vjp
+closure per op (core/autograd.py). Tensors are immutable on device — in-place
+paddle APIs rebind the underlying buffer, which is exactly how XLA wants it.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import autograd
+from .dtypes import convert_dtype, dtype_name, get_default_dtype
+from .place import (CPUPlace, TPUPlace, _get_current_place, default_place,
+                    get_jax_device)
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class Tensor:
+    __slots__ = ("_data", "_stop_gradient", "_grad", "_node", "_out_idx",
+                 "name", "persistable", "_place", "__weakref__")
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True,
+                 name=None):
+        import jax
+        import jax.numpy as jnp
+
+        if isinstance(data, Tensor):
+            data = data._data
+        if dtype is not None:
+            dtype = convert_dtype(dtype)
+        if isinstance(data, jax.Array):
+            arr = data if dtype is None else data.astype(dtype)
+        else:
+            npv = np.asarray(data)
+            if dtype is None and npv.dtype == np.float64:
+                # paddle default: python floats land as float32 unless the
+                # user asked for float64 explicitly
+                if not (isinstance(data, (np.ndarray, np.generic))
+                        and data.dtype == np.float64):
+                    dtype = get_default_dtype()
+            dev = get_jax_device(place) if place is not None else None
+            arr = jnp.asarray(npv, dtype=dtype)
+            if dev is not None:
+                arr = jax.device_put(arr, dev)
+        self._data = arr
+        self._stop_gradient = bool(stop_gradient)
+        self._grad = None
+        self._node = None
+        self._out_idx = 0
+        self.name = name or ""
+        self.persistable = False
+        self._place = place
+
+    # ---------------- construction helpers ----------------
+    @staticmethod
+    def _wrap(raw, stop_gradient=True):
+        t = Tensor.__new__(Tensor)
+        t._data = raw
+        t._stop_gradient = stop_gradient
+        t._grad = None
+        t._node = None
+        t._out_idx = 0
+        t.name = ""
+        t.persistable = False
+        t._place = None
+        return t
+
+    # ---------------- basic properties ----------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def dim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def place(self):
+        d = list(self._data.devices())[0]
+        if d.platform == "cpu":
+            return CPUPlace()
+        return TPUPlace(d.id)
+
+    @property
+    def stop_gradient(self):
+        return self._stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._stop_gradient = bool(v)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = g
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        return self._data.item(*args)
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __len__(self):
+        if self._data.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        return (f"Tensor(shape={self.shape}, dtype={dtype_name(self.dtype)}, "
+                f"place={self.place}, stop_gradient={self._stop_gradient},\n"
+                f"       {np.asarray(self._data)!r})")
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __format__(self, spec):
+        if self._data.ndim == 0:
+            return format(self._data.item(), spec)
+        return repr(self)
+
+    # ---------------- autograd ----------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self):  # fluid-era alias
+        self._grad = None
+
+    def _accumulate_grad(self, raw_value):
+        if self._stop_gradient:
+            return
+        if raw_value.dtype != self._data.dtype:
+            raw_value = raw_value.astype(self._data.dtype)
+        if self._grad is None:
+            self._grad = Tensor._wrap(raw_value)
+        else:
+            self._grad = Tensor._wrap(self._grad._data + raw_value)
+
+    def detach(self):
+        t = Tensor._wrap(self._data, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def clone(self):
+        return _apply("clone", lambda x: x + 0, self)
+
+    # ---------------- conversions / movement ----------------
+    def astype(self, dtype):
+        dt = convert_dtype(dtype)
+        return _apply("cast", lambda x: x.astype(dt), self)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def cpu(self):
+        import jax
+
+        t = Tensor._wrap(jax.device_put(self._data, jax.devices("cpu")[0]),
+                         self._stop_gradient)
+        return t
+
+    def to(self, *args, **kwargs):
+        # accepts dtype or device strings
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a in ("cpu", "tpu", "gpu"):
+                out = out.cpu() if a == "cpu" else out
+            else:
+                out = out.astype(a)
+        return out
+
+    def pin_memory(self):
+        return self
+
+    # ---------------- in-place-style APIs (rebind buffer) ----------------
+    def set_value(self, value):
+        import jax.numpy as jnp
+
+        if isinstance(value, Tensor):
+            raw = value._data
+        else:
+            raw = jnp.asarray(np.asarray(value), dtype=self._data.dtype)
+        if tuple(raw.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch {raw.shape} vs {self._data.shape}")
+        self._data = raw.astype(self._data.dtype)
+
+    def copy_(self, other, *a):
+        self.set_value(other)
+        return self
+
+    def fill_(self, value):
+        self._data = _jnp().full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        self._data = _jnp().zeros_like(self._data)
+        return self
+
+    def scale_(self, scale):
+        self._data = self._data * scale
+        return self
+
+    def add_(self, other):
+        o = other._data if isinstance(other, Tensor) else other
+        self._data = self._data + o
+        return self
+
+    def subtract_(self, other):
+        o = other._data if isinstance(other, Tensor) else other
+        self._data = self._data - o
+        return self
+
+    def multiply_(self, other):
+        o = other._data if isinstance(other, Tensor) else other
+        self._data = self._data * o
+        return self
+
+    def clip_(self, min=None, max=None):
+        self._data = _jnp().clip(self._data, min, max)
+        return self
+
+    # ---------------- indexing ----------------
+    def __getitem__(self, idx):
+        idx = _unwrap_index(idx)
+        return _apply("slice", lambda x: x[idx], self)
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        v = value._data if isinstance(value, Tensor) else value
+        self._data = self._data.at[idx].set(v)
+
+    # ---------------- iteration ----------------
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # ---------------- arithmetic (delegates to the functional lib) ---------
+    def _binop(self, other, fn, name, reverse=False):
+        if not isinstance(other, Tensor):
+            other = Tensor._wrap(_jnp().asarray(other, dtype=_promote(
+                self._data.dtype, other)))
+        a, b = (other, self) if reverse else (self, other)
+        return _apply(name, fn, a, b)
+
+    def __add__(self, o):
+        return self._binop(o, lambda x, y: x + y, "add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, lambda x, y: x - y, "sub")
+
+    def __rsub__(self, o):
+        return self._binop(o, lambda x, y: x - y, "sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, lambda x, y: x * y, "mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, lambda x, y: x / y, "div")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, lambda x, y: x / y, "div", reverse=True)
+
+    def __floordiv__(self, o):
+        return self._binop(o, lambda x, y: x // y, "floordiv")
+
+    def __mod__(self, o):
+        return self._binop(o, lambda x, y: x % y, "mod")
+
+    def __pow__(self, o):
+        return self._binop(o, lambda x, y: x ** y, "pow")
+
+    def __rpow__(self, o):
+        return self._binop(o, lambda x, y: x ** y, "pow", reverse=True)
+
+    def __neg__(self):
+        return _apply("neg", lambda x: -x, self)
+
+    def __abs__(self):
+        return _apply("abs", lambda x: abs(x), self)
+
+    def __matmul__(self, o):
+        return self._binop(o, lambda x, y: _jnp().matmul(x, y), "matmul")
+
+    # comparisons (not differentiable)
+    def _cmp(self, other, fn):
+        o = other._data if isinstance(other, Tensor) else other
+        return Tensor._wrap(fn(self._data, o))
+
+    def __eq__(self, o):
+        return self._cmp(o, lambda x, y: x == y)
+
+    def __ne__(self, o):
+        return self._cmp(o, lambda x, y: x != y)
+
+    def __lt__(self, o):
+        return self._cmp(o, lambda x, y: x < y)
+
+    def __le__(self, o):
+        return self._cmp(o, lambda x, y: x <= y)
+
+    def __gt__(self, o):
+        return self._cmp(o, lambda x, y: x > y)
+
+    def __ge__(self, o):
+        return self._cmp(o, lambda x, y: x >= y)
+
+    def __hash__(self):
+        return id(self)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+
+def _promote(dtype, pyval):
+    import jax.numpy as jnp
+
+    if isinstance(pyval, bool):
+        return jnp.bool_
+    if isinstance(pyval, int) and np.issubdtype(dtype, np.floating):
+        return dtype
+    if isinstance(pyval, float):
+        if np.issubdtype(dtype, np.floating) or dtype == jnp.bfloat16:
+            return dtype
+        return get_default_dtype()
+    if isinstance(pyval, (np.ndarray, list, tuple)):
+        return None
+    return dtype
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(i._data if isinstance(i, Tensor) else i for i in idx)
+    return idx
+
+
+# --------------------------------------------------------------------------
+# The eager dispatch: every differentiable op in the framework funnels here.
+# Mirrors Tracer::TraceOp (imperative/tracer.cc:48): run the kernel; if grad
+# is required, record a node (here: a jax.vjp closure).
+# --------------------------------------------------------------------------
+
+def _apply(op_name, fn, *tensors, n_outputs=1):
+    import jax
+
+    raws = [t._data for t in tensors]
+    from .. import amp as _amp
+
+    raws = _amp.cast_inputs_if_amp(op_name, raws)
+    needs = [not t._stop_gradient for t in tensors]
+    trace = autograd.is_grad_enabled() and any(needs)
+
+    if not trace:
+        out = fn(*raws)
+        if n_outputs == 1:
+            return Tensor._wrap(out)
+        return tuple(Tensor._wrap(o) for o in out)
+
+    out, vjp_fn = jax.vjp(fn, *raws)
+    outs = (out,) if n_outputs == 1 else tuple(out)
+    out_avals = [(o.shape, o.dtype) for o in outs]
+    node = autograd.Node(
+        vjp_fn=lambda cts: vjp_fn(cts[0] if n_outputs == 1 else cts),
+        inputs=list(zip(tensors, needs)),
+        n_outputs=n_outputs,
+        op_name=op_name,
+        out_avals=out_avals,
+    )
+    wrapped = []
+    for i, o in enumerate(outs):
+        t = Tensor._wrap(o, stop_gradient=False)
+        t._node = node
+        t._out_idx = i
+        wrapped.append(t)
+    return wrapped[0] if n_outputs == 1 else tuple(wrapped)
+
+
+def apply_op(op_name, fn, tensors, n_outputs=1):
+    """Public entry used by the functional library (paddle_tpu.ops)."""
+    return _apply(op_name, fn, *tensors, n_outputs=n_outputs)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity."""
+    if isinstance(data, Tensor) and dtype is None and place is None:
+        t = Tensor._wrap(data._data, stop_gradient)
+        return t
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)

@@ -1,0 +1,27 @@
+"""paddle.device namespace."""
+from ..core.place import (CPUPlace, TPUPlace, accelerator_count,  # noqa
+                          get_device, set_device)
+
+
+def get_available_device():
+    return [get_device()]
+
+
+def device_count():
+    return accelerator_count()
+
+
+class cuda:  # namespace shim: paddle.device.cuda.*
+    @staticmethod
+    def device_count():
+        return accelerator_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        import jax
+
+        (jax.device_put(0) + 0).block_until_ready()
+
+
+def synchronize(device=None):
+    cuda.synchronize(device)

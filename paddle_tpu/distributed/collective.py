@@ -1,0 +1,78 @@
+"""Traceable collective primitives for use INSIDE jitted/shard_map'd code.
+
+Reference parity: operators/collective/ (c_allreduce_sum, c_broadcast,
+c_allgather, c_reducescatter, c_scatter, barrier). TPU-native: these are the
+XLA collectives (psum/all_gather/ppermute) keyed by mesh axis name — the
+ICI-native form. The `c_*` op names are kept for static programs; the
+stream-sync ops (c_sync_calc_stream/c_sync_comm_stream) are no-ops because
+XLA schedules communication (SURVEY.md §2.4).
+"""
+from __future__ import annotations
+
+
+def c_allreduce_sum(x, axis_name="dp"):
+    import jax
+
+    return jax.lax.psum(x, axis_name)
+
+
+def c_allreduce_max(x, axis_name="dp"):
+    import jax
+
+    return jax.lax.pmax(x, axis_name)
+
+
+def c_allreduce_min(x, axis_name="dp"):
+    import jax
+
+    return jax.lax.pmin(x, axis_name)
+
+
+def c_allreduce_prod(x, axis_name="dp"):
+    import jax
+    import jax.numpy as jnp
+
+    return jnp.exp(jax.lax.psum(jnp.log(x), axis_name))
+
+
+def c_allgather(x, axis_name="dp", tiled=True):
+    import jax
+
+    return jax.lax.all_gather(x, axis_name, tiled=tiled)
+
+
+def c_reducescatter(x, axis_name="dp"):
+    import jax
+
+    return jax.lax.psum_scatter(x, axis_name, tiled=True)
+
+
+def c_broadcast(x, root=0, axis_name="dp"):
+    import jax
+    import jax.numpy as jnp
+
+    idx = jax.lax.axis_index(axis_name)
+    src = jax.lax.psum(
+        jnp.where(idx == root, x, jnp.zeros_like(x)), axis_name)
+    return src
+
+
+def c_ppermute(x, perm, axis_name="dp"):
+    import jax
+
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def c_sync_calc_stream(x):
+    return x
+
+
+def c_sync_comm_stream(x):
+    return x
+
+
+def barrier_op(axis_name="dp"):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.lax.psum(jnp.zeros((), jnp.float32), axis_name)

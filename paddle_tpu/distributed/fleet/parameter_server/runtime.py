@@ -1,0 +1,33 @@
+"""Parameter-server runtime glue (reference:
+fleet/runtime/parameter_server_runtime.py). The gRPC KV server itself lives
+in paddle_tpu.distributed.ps; this module wires fleet init_worker/init_server
+onto it."""
+from __future__ import annotations
+
+
+def init_worker(fleet_obj):
+    from ...ps.worker import get_communicator
+
+    comm = get_communicator()
+    if comm is not None:
+        comm.start()
+
+
+def init_server(fleet_obj, *args):
+    from ...ps.server import get_server
+
+    get_server().init()
+
+
+def run_server(fleet_obj):
+    from ...ps.server import get_server
+
+    get_server().run()
+
+
+def stop_worker(fleet_obj):
+    from ...ps.worker import get_communicator
+
+    comm = get_communicator()
+    if comm is not None:
+        comm.stop()

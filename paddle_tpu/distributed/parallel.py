@@ -1,0 +1,76 @@
+"""Dygraph DataParallel.
+
+Reference parity: fluid/dygraph/parallel.py:236 (DataParallel, scale_loss
+:337, apply_collective_grads :449 — coalesced bucket allreduce). TPU-native
+design: under a 1-process mesh the SPMD train step (paddle_tpu.parallel)
+handles gradient sync inside XLA; this eager wrapper reproduces the
+bucketed-allreduce semantics for the multi-process eager path.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from . import _psum_all_devices, get_world_size
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size_mb=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False):
+        super().__init__()
+        self._layers = layers
+        self._comm_buffer_bytes = comm_buffer_size_mb * 1024 * 1024
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        # reference scales by 1/nranks before allreduce-sum
+        world = get_world_size()
+        if world == 1:
+            return loss
+        return loss / world
+
+    def apply_collective_grads(self):
+        """Coalesce grads into fixed-size buckets, one allreduce per bucket
+        (_coalesce_tensors parallel.py:409 / split back :434)."""
+        import jax.numpy as jnp
+
+        world = get_world_size()
+        if world == 1:
+            return
+        grads = [(p, p.grad) for p in self._layers.parameters()
+                 if p.grad is not None]
+        bucket, bucket_bytes = [], 0
+        buckets = [bucket]
+        for p, g in grads:
+            nbytes = g._data.size * g._data.dtype.itemsize
+            if bucket_bytes + nbytes > self._comm_buffer_bytes and bucket:
+                bucket = []
+                buckets.append(bucket)
+                bucket_bytes = 0
+            bucket.append((p, g))
+            bucket_bytes += nbytes
+        for bucket in buckets:
+            if not bucket:
+                continue
+            flat = jnp.concatenate(
+                [g._data.reshape(-1).astype(jnp.float32)
+                 for _, g in bucket])
+            flat = _psum_all_devices(flat)
+            ofs = 0
+            for p, g in bucket:
+                n = g._data.size
+                g._data = flat[ofs:ofs + n].reshape(
+                    g._data.shape).astype(g._data.dtype)
+                ofs += n
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)

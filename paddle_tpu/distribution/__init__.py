@@ -1,0 +1,155 @@
+"""paddle.distribution parity (fluid/distribution.py: Uniform, Normal,
+Categorical, MultivariateNormalDiag)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..core import random as _random
+from ..core.tensor import Tensor
+from ..tensor.ops import _t
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        import jax.numpy as jnp
+
+        return Tensor._wrap(jnp.exp(self.log_prob(value)._data))
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+
+    def sample(self, shape=(), seed=0):
+        import jax
+
+        jnp = _jnp()
+        shape = tuple(shape) + tuple(jnp.broadcast_shapes(
+            self.low._data.shape, self.high._data.shape))
+        u = jax.random.uniform(_random.next_key(), shape)
+        return Tensor._wrap(self.low._data + u * (self.high._data -
+                                                  self.low._data))
+
+    def log_prob(self, value):
+        jnp = _jnp()
+        v = _t(value)._data
+        inside = (v >= self.low._data) & (v < self.high._data)
+        lp = jnp.where(inside,
+                       -jnp.log(self.high._data - self.low._data), -np.inf)
+        return Tensor._wrap(lp)
+
+    def entropy(self):
+        jnp = _jnp()
+        return Tensor._wrap(jnp.log(self.high._data - self.low._data))
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def sample(self, shape=(), seed=0):
+        import jax
+
+        jnp = _jnp()
+        shape = tuple(shape) + tuple(jnp.broadcast_shapes(
+            self.loc._data.shape, self.scale._data.shape))
+        z = jax.random.normal(_random.next_key(), shape)
+        return Tensor._wrap(self.loc._data + z * self.scale._data)
+
+    def log_prob(self, value):
+        jnp = _jnp()
+        v = _t(value)._data
+        var = self.scale._data ** 2
+        return Tensor._wrap(-((v - self.loc._data) ** 2) / (2 * var)
+                            - jnp.log(self.scale._data)
+                            - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        jnp = _jnp()
+        return Tensor._wrap(0.5 + 0.5 * math.log(2 * math.pi) +
+                            jnp.log(self.scale._data))
+
+    def kl_divergence(self, other):
+        jnp = _jnp()
+        var_ratio = (self.scale._data / other.scale._data) ** 2
+        t1 = ((self.loc._data - other.loc._data) / other.scale._data) ** 2
+        return Tensor._wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+
+    def sample(self, shape=()):
+        import jax
+
+        out = jax.random.categorical(_random.next_key(), self.logits._data,
+                                     shape=tuple(shape) +
+                                     self.logits._data.shape[:-1])
+        return Tensor._wrap(out)
+
+    def log_prob(self, value):
+        import jax
+
+        jnp = _jnp()
+        logp = jax.nn.log_softmax(self.logits._data)
+        idx = _t(value)._data.astype(jnp.int32)
+        return Tensor._wrap(jnp.take_along_axis(
+            logp, idx[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        import jax
+
+        jnp = _jnp()
+        logp = jax.nn.log_softmax(self.logits._data)
+        p = jnp.exp(logp)
+        return Tensor._wrap(-(p * logp).sum(axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs):
+        self.p = _t(probs)
+
+    def sample(self, shape=()):
+        import jax
+
+        out = jax.random.bernoulli(_random.next_key(), self.p._data,
+                                   tuple(shape) + self.p._data.shape)
+        return Tensor._wrap(out.astype(self.p._data.dtype))
+
+    def log_prob(self, value):
+        jnp = _jnp()
+        v = _t(value)._data
+        p = jnp.clip(self.p._data, 1e-7, 1 - 1e-7)
+        return Tensor._wrap(v * jnp.log(p) + (1 - v) * jnp.log(1 - p))
+
+    def entropy(self):
+        jnp = _jnp()
+        p = jnp.clip(self.p._data, 1e-7, 1 - 1e-7)
+        return Tensor._wrap(-(p * jnp.log(p) + (1 - p) * jnp.log(1 - p)))
+
+
+def kl_divergence(p, q):
+    return p.kl_divergence(q)

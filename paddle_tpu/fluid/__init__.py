@@ -1,0 +1,73 @@
+"""fluid 1.x namespace (reference: python/paddle/fluid/__init__.py)."""
+from ..core.place import (CPUPlace, CUDAPinnedPlace, CUDAPlace,  # noqa
+                          TPUPlace, XPUPlace)
+from ..core.tensor import Tensor
+from . import initializer, io, layers, optimizer  # noqa: F401
+from .backward import append_backward, calc_gradient, gradients  # noqa
+from .executor import Executor, Scope, global_scope, scope_guard  # noqa
+from .framework import (Program, Variable, default_main_program,  # noqa
+                        default_startup_program, device_guard, name_scope,
+                        program_guard, unique_name)
+from .layer_helper import LayerHelper, ParamAttr  # noqa: F401
+from .layers.tensor import data  # noqa: F401
+from ..regularizer import L1Decay, L2Decay  # noqa: F401
+from ..utils.flags import get_flags, set_flags  # noqa: F401
+
+
+class CompiledProgram:
+    """fluid/compiler.py:87 parity. Under SPMD lowering, with_data_parallel
+    marks the program for mesh execution (the ParallelExecutor's SSA engine
+    collapses into pjit sharding — SURVEY.md §3.2 TPU design)."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._data_parallel = False
+        self._loss_name = None
+        self.build_strategy = build_strategy
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._data_parallel = True
+        self._loss_name = loss_name
+        return self
+
+    # Executor.run accepts CompiledProgram transparently
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class BuildStrategy:
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = False
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+# dygraph sub-namespace shim (fluid.dygraph.*)
+from . import dygraph  # noqa: F401,E402

@@ -1,0 +1,75 @@
+"""append_backward: static-graph autodiff.
+
+Reference parity: fluid/backward.py:1215 append_backward (Python-side grad-op
+construction over OpDesc via the C++ GradOpMaker registry). TPU-native
+design: instead of materializing ~600 hand-written grad ops, backward is ONE
+`jax_autodiff` op marking (loss, params, forward-op range); at lowering time
+the Executor runs the forward segment under jax.value_and_grad — XLA's
+autodiff IS the grad-op expansion, fused and reverse-optimized. Grad
+variables (`param@GRAD`) still appear in the program, so optimizer ops,
+grad clipping and user introspection keep their reference semantics.
+"""
+from __future__ import annotations
+
+from .framework import Parameter, Variable, default_main_program, \
+    grad_var_name
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Returns [(param, grad_var)] like the reference."""
+    program = loss.block.program
+    block = program.global_block()
+
+    if parameter_list:
+        params = []
+        for p in parameter_list:
+            if isinstance(p, str):
+                params.append(block.var(p))
+            else:
+                params.append(p)
+    else:
+        params = [v for v in block.vars.values()
+                  if isinstance(v, Parameter) and v.trainable]
+    if no_grad_set:
+        ng = {n if isinstance(n, str) else n.name for n in no_grad_set}
+        params = [p for p in params if p.name not in ng]
+
+    fwd_op_count = len(block.ops)
+    param_names = [p.name for p in params]
+
+    grads = []
+    for p in params:
+        g = block.create_var(name=grad_var_name(p.name), shape=p.shape,
+                             dtype=p.dtype, stop_gradient=True)
+        grads.append(g)
+    loss_grad = block.create_var(name=grad_var_name(loss.name),
+                                 shape=loss.shape, dtype=loss.dtype,
+                                 stop_gradient=True)
+
+    block.append_op(
+        type="jax_autodiff",
+        inputs={"Loss": [loss], "Params": param_names},
+        outputs={"Grads": [g.name for g in grads],
+                 "LossGrad": [loss_grad]},
+        attrs={
+            "loss_name": loss.name,
+            "param_names": param_names,
+            "fwd_op_count": fwd_op_count,
+            "checkpoints": [c.name if isinstance(c, Variable) else c
+                            for c in (checkpoints or [])],
+        })
+    return list(zip(params, grads))
+
+
+def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
+    """fluid/backward.py:1665 parity: grads of targets w.r.t. arbitrary
+    inputs (not just Parameters)."""
+    ts = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    pg = append_backward(ts[0], parameter_list=[v.name for v in ins])
+    return [g for _, g in pg]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    return calc_gradient(targets, inputs, target_gradients, no_grad_set)

@@ -1,0 +1,21 @@
+"""train_from_dataset glue (reference: executor.py:1407 _run_from_dataset +
+MultiTrainer/HogwildWorker). The file-driven Dataset lives in
+fluid/dataset.py; this runs its batches through the jitted program step."""
+from __future__ import annotations
+
+
+def run_from_dataset(executor, program, dataset, fetch_list=None,
+                     fetch_info=None, print_period=100):
+    if dataset is None:
+        raise ValueError("dataset is required")
+    fetch_names = [f.name if hasattr(f, "name") else f
+                   for f in (fetch_list or [])]
+    step = 0
+    for batch in dataset._iter_batches():
+        feed = batch
+        out = executor.run(program, feed=feed, fetch_list=fetch_list)
+        if fetch_names and print_period and step % print_period == 0:
+            info = fetch_info or fetch_names
+            print(" ".join(f"{n}={v}" for n, v in zip(info, out)))
+        step += 1
+    return None

@@ -1,0 +1,121 @@
+"""fluid.dygraph namespace: maps 1.x dygraph API onto the eager engine.
+
+Reference parity: fluid/dygraph/ (guard base.py, to_variable, Layer
+nn.py Conv2D/Linear/BatchNorm/Pool2D/Embedding aliases).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ...core.autograd import no_grad  # noqa: F401
+from ...core.tensor import Tensor, to_tensor
+from ...nn import (BatchNorm, Embedding, LayerList, LayerNorm,  # noqa
+                   Linear, ParameterList, Sequential)
+from ...nn.layer.layers import Layer  # noqa: F401
+from ...jit import TracedFunction, declarative, to_static  # noqa: F401
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """fluid.dygraph.guard: the eager engine is always on — kept for parity."""
+    yield
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    return to_tensor(np.asarray(value), dtype=dtype)
+
+
+def enabled():
+    return True
+
+
+class Conv2D(Layer):
+    """fluid.dygraph.Conv2D (NCHW, act fusion) — maps to nn.Conv2D."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__()
+        from ...nn import Conv2D as _Conv2D
+
+        self._conv = _Conv2D(num_channels, num_filters, filter_size, stride,
+                             padding, dilation, groups or 1,
+                             weight_attr=param_attr, bias_attr=bias_attr)
+        self._act = act
+
+    @property
+    def weight(self):
+        return self._conv.weight
+
+    @property
+    def bias(self):
+        return self._conv.bias
+
+    def forward(self, x):
+        out = self._conv(x)
+        if self._act:
+            from ...nn import functional as F
+
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class Pool2D(Layer):
+    """fluid.dygraph.Pool2D parity."""
+
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True):
+        super().__init__()
+        self._args = (pool_size, pool_stride, pool_padding, ceil_mode)
+        self._type = pool_type
+        self._global = global_pooling
+        self._exclusive = exclusive
+
+    def forward(self, x):
+        from ...nn import functional as F
+
+        if self._global:
+            return x.mean(axis=[2, 3], keepdim=True) if \
+                self._type == "avg" else x.max(axis=[2, 3], keepdim=True)
+        k, s, p, cm = self._args
+        if self._type == "max":
+            return F.max_pool2d(x, k, s, p, cm)
+        return F.avg_pool2d(x, k, s, p, cm, self._exclusive)
+
+
+class DataParallel(Layer):
+    """fluid/dygraph/parallel.py:236 parity — see distributed package for
+    the SPMD implementation."""
+
+    def __new__(cls, layer, strategy=None, **kw):
+        from ...distributed.parallel import DataParallel as DP
+
+        return DP(layer, strategy, **kw)
+
+
+def prepare_context(strategy=None):
+    from ...distributed import init_parallel_env
+
+    init_parallel_env()
+    return strategy
+
+
+class ParallelEnv:
+    @property
+    def nranks(self):
+        from ...distributed import get_world_size
+
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        from ...distributed import get_rank
+
+        return get_rank()
+
+    @property
+    def dev_id(self):
+        return self.local_rank

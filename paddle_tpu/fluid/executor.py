@@ -1,0 +1,238 @@
+"""Static-graph Executor: whole-block lowering to one XLA computation.
+
+Reference parity: fluid/executor.py:474 (Executor, run :915) and the C++
+interpreter executor.cc:180/428. TPU-native design (SURVEY.md §3.1): instead
+of the per-op hot loop, `run()` traces every op lowering (fluid/lowering.py)
+under jax.jit into ONE fused XLA computation, cached per (program version,
+feed signature). Persistable vars (parameters, optimizer state) live in a
+Scope as device-resident jax arrays and are donated to the jitted call so
+optimizer updates alias buffers across steps (donate_argnums — the
+TPU-native equivalent of in-place ParamOut).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtypes import convert_dtype
+from ..core.place import CPUPlace
+from ..core.tensor import Tensor
+from . import lowering
+from .framework import Parameter, Program, default_main_program
+
+
+class _ScopeVar:
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    def get_tensor(self):
+        return self
+
+    # tensor-protocol shims (pybind tensor parity)
+    def set(self, value, place=None):
+        import jax.numpy as jnp
+
+        self._scope._values[self._name] = jnp.asarray(np.asarray(value))
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._scope._values[self._name])
+        return arr.astype(dtype) if dtype else arr
+
+    def shape(self):
+        return list(self._scope._values[self._name].shape)
+
+
+class Scope:
+    """framework/scope.h:46 parity: name → value map (flat; hierarchical
+    scopes collapse under whole-block lowering)."""
+
+    def __init__(self):
+        self._values = {}
+
+    def var(self, name):
+        self._values.setdefault(name, None)
+        return _ScopeVar(self, name)
+
+    def find_var(self, name):
+        if name in self._values:
+            return _ScopeVar(self, name)
+        return None
+
+    def set_value(self, name, value):
+        import jax.numpy as jnp
+
+        self._values[name] = value if not isinstance(value, np.ndarray) \
+            else jnp.asarray(value)
+
+    def get_value(self, name):
+        return self._values.get(name)
+
+    def drop_kids(self):
+        pass
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        global _global_scope
+        old = _global_scope
+        _global_scope = scope
+        try:
+            yield
+        finally:
+            _global_scope = old
+
+    return guard()
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place if place is not None else CPUPlace()
+        self._cache = {}
+
+    # ------------------------------------------------------------------
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            feed_var_name="feed", fetch_var_name="fetch",
+            return_numpy=True, use_program_cache=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope or _global_scope
+
+        fetch_names = [f.name if hasattr(f, "name") else f
+                       for f in fetch_list]
+
+        blk = program.global_block()
+        persist_names = [v.name for v in blk.vars.values()
+                         if v.persistable]
+
+        # materialize feeds as jnp arrays
+        import jax
+        import jax.numpy as jnp
+
+        feed_vals = {}
+        for k, v in feed.items():
+            if isinstance(v, Tensor):
+                feed_vals[k] = v._data
+            else:
+                arr = np.asarray(v)
+                want = blk.vars.get(k)
+                if want is not None and want.dtype is not None:
+                    arr = arr.astype(want.dtype)
+                feed_vals[k] = jnp.asarray(arr)
+
+        # ensure persistables exist (startup program must have run)
+        persist_vals = {}
+        for n in persist_names:
+            val = scope._values.get(n)
+            if val is not None:
+                persist_vals[n] = val
+
+        sig = (id(program), program._version,
+               tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                            for k, v in feed_vals.items())),
+               tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                            for k, v in persist_vals.items())),
+               tuple(fetch_names))
+        compiled = self._cache.get(sig) if use_program_cache else None
+        if compiled is None:
+            compiled = self._compile(program, list(feed_vals),
+                                     list(persist_vals), fetch_names)
+            if use_program_cache:
+                self._cache[sig] = compiled
+
+        program._seed_counter += 1
+        key = jax.random.PRNGKey(
+            (program.random_seed or 0) * 100003 + program._seed_counter)
+        fetches, new_persist = compiled(persist_vals, feed_vals, key)
+
+        scope._values.update(new_persist)
+
+        out = []
+        for name, v in zip(fetch_names, fetches):
+            if return_numpy:
+                out.append(np.asarray(v))
+            else:
+                out.append(Tensor._wrap(v))
+        return out
+
+    # ------------------------------------------------------------------
+    def _compile(self, program, feed_names, persist_names, fetch_names):
+        import jax
+
+        blk = program.global_block()
+        ops = list(blk.ops)
+
+        ad_idx = next((j for j, o in enumerate(ops)
+                       if o.type == "jax_autodiff"), None)
+
+        def execute(persist, feed, rng_key):
+            env = dict(persist)
+            env.update(feed)
+            ctx = lowering.LowerCtx(env, rng_key, training=True)
+            # with an autodiff op, the forward segment runs once INSIDE
+            # value_and_grad (residual-sharing); skip re-running it here
+            start = 0
+            if ad_idx is not None:
+                _run_autodiff(ctx, ops[ad_idx], ops, persist, feed, rng_key)
+                start = ad_idx + 1
+            for op in ops[start:]:
+                if op.type in ("feed", "fetch", "jax_autodiff"):
+                    continue
+                lowering.get_lowering(op.type)(ctx, op)
+            fetches = tuple(env[n] for n in fetch_names)
+            new_persist = {n: env[n] for n in persist_names if n in env}
+            return fetches, new_persist
+
+        def _run_autodiff(ctx, op, all_ops, persist, feed, rng_key):
+            param_names = op.attrs["param_names"]
+            loss_name = op.attrs["loss_name"]
+            n_fwd = op.attrs["fwd_op_count"]
+            fwd_ops = all_ops[:n_fwd]
+
+            def loss_fn(param_vals):
+                env2 = dict(persist)
+                env2.update(feed)
+                env2.update(zip(param_names, param_vals))
+                ctx2 = lowering.LowerCtx(env2, rng_key,
+                                         training=ctx.training)
+                for fop in fwd_ops:
+                    if fop.type in ("feed", "fetch"):
+                        continue
+                    lowering.get_lowering(fop.type)(ctx2, fop)
+                loss = env2[loss_name]
+                return loss.sum(), env2
+
+            params = [ctx.env[n] for n in param_names]
+            (loss_val, env_after), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            ctx.env.update(env_after)
+            for name, g in zip(param_names, grads):
+                ctx.env[name + "@GRAD"] = g
+
+        # donate the persistable dict: optimizer state updates alias buffers
+        return jax.jit(execute, donate_argnums=(0,))
+
+    # legacy parity helpers ------------------------------------------------
+    def close(self):
+        pass
+
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        from .dataset_runner import run_from_dataset
+
+        return run_from_dataset(self, program, dataset, fetch_list,
+                                fetch_info, print_period)
+
+    def infer_from_dataset(self, *args, **kwargs):
+        return self.train_from_dataset(*args, **kwargs)

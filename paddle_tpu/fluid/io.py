@@ -1,0 +1,113 @@
+"""Static-graph save/load.
+
+Reference parity: fluid/io.py — save_persistables :598, load_persistables
+:966, save_inference_model :1164 (prunes to feed/fetch subgraph, writes
+`__model__` + params), load_inference_model :1374. Format: our pickle-based
+program desc + one combined params file (save_combine-style).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .executor import global_scope
+from .framework import Parameter, Program, default_main_program
+
+
+def _collect_persistables(program, scope, predicate=None):
+    out = {}
+    for v in program.global_block().vars.values():
+        if not v.persistable:
+            continue
+        if predicate is not None and not predicate(v):
+            continue
+        val = scope._values.get(v.name)
+        if val is not None:
+            arr = np.asarray(val)
+            if arr.dtype.name == "bfloat16":
+                out[v.name] = ("bfloat16", arr.astype(np.float32))
+            else:
+                out[v.name] = (arr.dtype.name, arr)
+    return out
+
+
+def _restore(values, scope):
+    import jax.numpy as jnp
+
+    from ..core.dtypes import bfloat16
+
+    for name, (dt, arr) in values.items():
+        if dt == "bfloat16":
+            scope._values[name] = jnp.asarray(arr, dtype=bfloat16)
+        else:
+            scope._values[name] = jnp.asarray(arr)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    main_program = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    vals = _collect_persistables(main_program, global_scope())
+    path = os.path.join(dirname, filename or "__params__")
+    with open(path, "wb") as f:
+        pickle.dump(vals, f)
+
+
+save_params = save_persistables
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    path = os.path.join(dirname, filename or "__params__")
+    with open(path, "rb") as f:
+        vals = pickle.load(f)
+    _restore(vals, global_scope())
+
+
+load_params = load_persistables
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False):
+    main_program = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    pruned = main_program._prune(target_vars)
+    pruned = pruned.clone(for_test=True)
+    meta = {
+        "program": pruned.desc_bytes(),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [t.name if hasattr(t, "name") else t
+                        for t in target_vars],
+    }
+    with open(os.path.join(dirname, model_filename or "__model__"),
+              "wb") as f:
+        pickle.dump(meta, f)
+    if not program_only:
+        vals = _collect_persistables(main_program, global_scope())
+        # keep only vars the pruned program still references
+        needed = {v.name for v in pruned.global_block().vars.values()
+                  if v.persistable}
+        vals = {k: v for k, v in vals.items() if k in needed}
+        with open(os.path.join(dirname, params_filename or "__params__"),
+                  "wb") as f:
+            pickle.dump(vals, f)
+    return meta["fetch_names"]
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    with open(os.path.join(dirname, model_filename or "__model__"),
+              "rb") as f:
+        meta = pickle.load(f)
+    program = Program.parse_from_string(meta["program"])
+    params_path = os.path.join(dirname, params_filename or "__params__")
+    if os.path.exists(params_path):
+        with open(params_path, "rb") as f:
+            vals = pickle.load(f)
+        _restore(vals, global_scope())
+    feed_names = meta["feed_names"]
+    fetch_vars = [program.global_block().var(n)
+                  for n in meta["fetch_names"]]
+    return program, feed_names, fetch_vars

@@ -1,0 +1,280 @@
+"""Static NN layers: fc, conv2d, pool2d, batch_norm, embedding...
+
+Reference parity: python/paddle/fluid/layers/nn.py (15.2k LoC of op sugar).
+Each function appends ops to the current main program and init ops to the
+startup program via LayerHelper, with build-time shape propagation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.dtypes import convert_dtype, dtype_name
+from .. import initializer as init
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+
+def _pair(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+
+def _conv_out(hw, k, s, p, d=1):
+    if hw in (-1, None):
+        return -1
+    return (hw + 2 * p - (d * (k - 1) + 1)) // s + 1
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    helper = LayerHelper("fc", name=name)
+    in_shape = input.shape
+    in_features = int(np.prod(in_shape[num_flatten_dims:]))
+    w = helper.create_parameter(param_attr, [in_features, size], input.dtype)
+    out_shape = list(in_shape[:num_flatten_dims]) + [size]
+    out = helper.create_variable_for_type_inference(input.dtype, out_shape)
+    helper.append_op(type="mul", inputs={"X": [input], "Y": [w]},
+                     outputs={"Out": [out]},
+                     attrs={"x_num_col_dims": num_flatten_dims,
+                            "y_num_col_dims": 1})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [size], input.dtype,
+                                    is_bias=True)
+        out = helper.append_bias_op(out, b, num_flatten_dims)
+    return helper.append_activation(out, act)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    xs = list(x.shape)
+    ys = list(y.shape)
+    if transpose_x and len(xs) >= 2:
+        xs[-1], xs[-2] = xs[-2], xs[-1]
+    if transpose_y and len(ys) >= 2:
+        ys[-1], ys[-2] = ys[-2], ys[-1]
+    out_shape = xs[:-1] + ys[-1:]
+    out = helper.create_variable_for_type_inference(x.dtype, out_shape)
+    helper.append_op(type="matmul", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"transpose_X": transpose_x,
+                            "transpose_Y": transpose_y, "alpha": alpha})
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    helper = LayerHelper("conv2d", name=name)
+    k = _pair(filter_size)
+    s = _pair(stride)
+    p = _pair(padding)
+    d = _pair(dilation)
+    c_in = input.shape[1]
+    filter_shape = [num_filters, c_in // groups, k[0], k[1]]
+    fan_in = (c_in // groups) * k[0] * k[1]
+    std = (2.0 / fan_in) ** 0.5
+    w = helper.create_parameter(param_attr, filter_shape, input.dtype,
+                                default_initializer=init.Normal(0.0, std))
+    n, _, h, wd = input.shape
+    out_shape = [n, num_filters, _conv_out(h, k[0], s[0], p[0], d[0]),
+                 _conv_out(wd, k[1], s[1], p[1], d[1])]
+    out = helper.create_variable_for_type_inference(input.dtype, out_shape)
+    helper.append_op(type="conv2d",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": s, "paddings": p, "dilations": d,
+                            "groups": groups})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        out = helper.append_bias_op(out, b, 1)
+    return helper.append_activation(out, act)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None, data_format="NCHW"):
+    helper = LayerHelper("pool2d", name=name)
+    k = _pair(pool_size)
+    s = _pair(pool_stride)
+    p = _pair(pool_padding)
+    n, c, h, w = input.shape
+    if global_pooling:
+        out_shape = [n, c, 1, 1]
+    else:
+        out_shape = [n, c, _conv_out(h, k[0], s[0], p[0]),
+                     _conv_out(w, k[1], s[1], p[1])]
+    out = helper.create_variable_for_type_inference(input.dtype, out_shape)
+    helper.append_op(type="pool2d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pooling_type": pool_type, "ksize": k,
+                            "strides": s, "paddings": p,
+                            "global_pooling": global_pooling,
+                            "ceil_mode": ceil_mode, "exclusive": exclusive})
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", name=None):
+    helper = LayerHelper("pool2d", name=name)
+    k = _pair(pool_size)
+    n, c = input.shape[0], input.shape[1]
+    out = helper.create_variable_for_type_inference(input.dtype,
+                                                    [n, c, k[0], k[1]])
+    helper.append_op(type="pool2d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pooling_type": pool_type, "ksize": k,
+                            "adaptive": True})
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=
+               True, use_global_stats=False):
+    helper = LayerHelper("batch_norm", name=name)
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = helper.create_parameter(param_attr, [c], input.dtype,
+                                    default_initializer=init.Constant(1.0))
+    bias = helper.create_parameter(bias_attr, [c], input.dtype, is_bias=True)
+    # moving stats: persistable, init in startup
+    sblock = helper.startup_program.global_block()
+    mean = helper.create_global_variable([c], input.dtype,
+                                         name=moving_mean_name)
+    var = helper.create_global_variable([c], input.dtype,
+                                        name=moving_variance_name)
+    for v, value in ((mean, 0.0), (var, 1.0)):
+        sv = sblock.create_var(name=v.name, shape=[c], dtype=input.dtype,
+                               persistable=True)
+        init.Constant(value)(sv, sblock)
+    saved_mean = helper.create_variable_for_type_inference(input.dtype, [c])
+    saved_var = helper.create_variable_for_type_inference(input.dtype, [c])
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op(
+        type="batch_norm",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias],
+                "Mean": [mean], "Variance": [var]},
+        outputs={"Y": [out], "MeanOut": [mean], "VarianceOut": [var],
+                 "SavedMean": [saved_mean], "SavedVariance": [saved_var]},
+        attrs={"momentum": momentum, "epsilon": epsilon,
+               "data_layout": data_layout, "is_test": is_test,
+               "use_global_stats": use_global_stats})
+    return helper.append_activation(out, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    helper = LayerHelper("layer_norm", name=name)
+    norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": [input]}
+    if scale:
+        s = helper.create_parameter(param_attr, norm_shape, input.dtype,
+                                    default_initializer=init.Constant(1.0))
+        inputs["Scale"] = [s]
+    if shift:
+        b = helper.create_parameter(bias_attr, norm_shape, input.dtype,
+                                    is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op(type="layer_norm", inputs=inputs,
+                     outputs={"Y": [out]},
+                     attrs={"begin_norm_axis": begin_norm_axis,
+                            "epsilon": epsilon})
+    return helper.append_activation(out, act)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout")
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="dropout", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"dropout_prob": dropout_prob, "is_test": is_test,
+                            "dropout_implementation":
+                            dropout_implementation})
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    helper = LayerHelper("embedding")
+    w = helper.create_parameter(param_attr, size, dtype,
+                                default_initializer=init.Xavier())
+    out_shape = list(input.shape)
+    if out_shape and out_shape[-1] == 1:
+        out_shape = out_shape[:-1]
+    out_shape = out_shape + [size[1]]
+    out = helper.create_variable_for_type_inference(convert_dtype(dtype),
+                                                    out_shape)
+    helper.append_op(type="lookup_table",
+                     inputs={"Ids": [input], "W": [w]},
+                     outputs={"Out": [out]},
+                     attrs={"is_sparse": is_sparse,
+                            "padding_idx": padding_idx if padding_idx
+                            is not None else -1})
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    loss_shape = list(logits.shape)
+    loss_shape[axis] = 1
+    loss = helper.create_variable_for_type_inference(logits.dtype,
+                                                     loss_shape)
+    softmax_out = helper.create_variable_for_type_inference(logits.dtype,
+                                                            logits.shape)
+    helper.append_op(type="softmax_with_cross_entropy",
+                     inputs={"Logits": [logits], "Label": [label]},
+                     outputs={"Loss": [loss], "Softmax": [softmax_out]},
+                     attrs={"soft_label": soft_label,
+                            "ignore_index": ignore_index, "axis": axis})
+    if return_softmax:
+        return loss, softmax_out
+    return loss
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper("cross_entropy")
+    shape = list(input.shape[:-1]) + [1]
+    out = helper.create_variable_for_type_inference(input.dtype, shape)
+    helper.append_op(type="cross_entropy",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]},
+                     attrs={"soft_label": soft_label,
+                            "ignore_index": ignore_index})
+    return out
+
+
+def square_error_cost(input, label):
+    helper = LayerHelper("square_error_cost")
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op(type="square_error_cost",
+                     inputs={"X": [input], "Y": [label]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    helper = LayerHelper("accuracy")
+    acc = helper.create_variable_for_type_inference(np.float32, [1])
+    correct = helper.create_variable_for_type_inference(np.int32, [1])
+    total = helper.create_variable_for_type_inference(np.int32, [1])
+    helper.append_op(type="accuracy",
+                     inputs={"Out": [input], "Label": [label]},
+                     outputs={"Accuracy": [acc], "Correct": [correct],
+                              "Total": [total]},
+                     attrs={"k": k})
+    return acc
+
+
+def topk(input, k=1, name=None):
+    helper = LayerHelper("top_k")
+    shape = list(input.shape[:-1]) + [k]
+    values = helper.create_variable_for_type_inference(input.dtype, shape)
+    indices = helper.create_variable_for_type_inference(np.int64, shape)
+    helper.append_op(type="top_k", inputs={"X": [input]},
+                     outputs={"Out": [values], "Indices": [indices]},
+                     attrs={"k": k})
+    return values, indices

@@ -1,0 +1,730 @@
+"""Static op lowerings: op type → jnp function over an execution env.
+
+Reference parity: the kernel side of the operator library — each fluid op
+type (REGISTER_OPERATOR in paddle/fluid/operators/) has a lowering here
+that reads input vars from the env, computes with ops/kernels.py, and
+writes outputs. The Executor traces the whole block through these under
+jax.jit, producing ONE fused XLA computation per program signature — the
+TPU-native replacement for the op-by-op interpreter (executor.cc:474).
+
+A lowering gets (ctx, op) where ctx gives: env lookups, attrs, and a
+deterministic PRNG stream (functional randomness for XLA).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtypes import convert_dtype
+from ..ops import kernels as K
+
+_REGISTRY = {}
+
+
+def register(op_type):
+    def deco(fn):
+        _REGISTRY[op_type] = fn
+        return fn
+    return deco
+
+
+def get_lowering(op_type):
+    fn = _REGISTRY.get(op_type)
+    if fn is None:
+        raise NotImplementedError(
+            f"static op {op_type!r} has no TPU lowering yet")
+    return fn
+
+
+def has_lowering(op_type):
+    return op_type in _REGISTRY
+
+
+class LowerCtx:
+    """Execution environment handed to lowerings during block tracing."""
+
+    def __init__(self, env, rng_base, training=True):
+        self.env = env          # name -> jnp array
+        self._rng_base = rng_base
+        self._rng_count = 0
+        self.training = training
+
+    def inp(self, op, slot, idx=0, default=None):
+        names = op.input(slot)
+        if not names:
+            return default
+        return self.env[names[idx]]
+
+    def inps(self, op, slot):
+        return [self.env[n] for n in op.input(slot)]
+
+    def out(self, op, slot, value, idx=0):
+        names = op.output(slot)
+        if names:
+            self.env[names[idx]] = value
+
+    def outs(self, op, slot, values):
+        for n, v in zip(op.output(slot), values):
+            self.env[n] = v
+
+    def next_key(self):
+        import jax
+
+        self._rng_count += 1
+        return jax.random.fold_in(self._rng_base, self._rng_count)
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ============ elementwise (operators/elementwise/) ============
+
+def _ew(fn):
+    def lower(ctx, op):
+        x = ctx.inp(op, "X")
+        y = ctx.inp(op, "Y")
+        axis = op.attrs.get("axis", -1)
+        if axis != -1 and y.ndim < x.ndim:
+            # paddle broadcast: align y's dims starting at `axis`
+            shape = [1] * x.ndim
+            for i, s in enumerate(y.shape):
+                shape[axis + i] = s
+            y = y.reshape(shape)
+        ctx.out(op, "Out", fn(x, y))
+    return lower
+
+
+register("elementwise_add")(_ew(lambda x, y: x + y))
+register("elementwise_sub")(_ew(lambda x, y: x - y))
+register("elementwise_mul")(_ew(lambda x, y: x * y))
+register("elementwise_div")(_ew(lambda x, y: x / y))
+register("elementwise_max")(_ew(lambda x, y: _jnp().maximum(x, y)))
+register("elementwise_min")(_ew(lambda x, y: _jnp().minimum(x, y)))
+register("elementwise_pow")(_ew(lambda x, y: x ** y))
+register("elementwise_mod")(_ew(lambda x, y: x % y))
+register("elementwise_floordiv")(_ew(lambda x, y: x // y))
+
+
+# ============ activations (operators/activation_op.cc) ============
+
+def _unary(name, fn):
+    @register(name)
+    def lower(ctx, op, _fn=fn):
+        ctx.out(op, "Out", _fn(ctx.inp(op, "X")))
+
+
+for _n, _f in {
+    "relu": K.relu, "relu6": K.relu6, "sigmoid": K.sigmoid,
+    "tanh": K.tanh, "sqrt": lambda x: _jnp().sqrt(x),
+    "rsqrt": lambda x: 1.0 / _jnp().sqrt(x),
+    "exp": lambda x: _jnp().exp(x), "log": lambda x: _jnp().log(x),
+    "square": lambda x: x * x, "abs": lambda x: _jnp().abs(x),
+    "floor": lambda x: _jnp().floor(x), "ceil": lambda x: _jnp().ceil(x),
+    "round": lambda x: _jnp().round(x), "sin": lambda x: _jnp().sin(x),
+    "cos": lambda x: _jnp().cos(x), "sign": lambda x: _jnp().sign(x),
+    "reciprocal": lambda x: 1.0 / x, "softsign": K.softsign,
+    "softplus": K.softplus, "mish": K.mish, "silu": K.silu,
+    "swish": K.swish, "hard_swish": K.hardswish,
+    "tanh_shrink": lambda x: x - _jnp().tanh(x),
+    "erf": lambda x: __import__("jax").scipy.special.erf(x),
+    "logsigmoid": lambda x: __import__("jax").nn.log_sigmoid(x),
+}.items():
+    _unary(_n, _f)
+
+
+@register("leaky_relu")
+def _leaky(ctx, op):
+    ctx.out(op, "Out", K.leaky_relu(ctx.inp(op, "X"),
+                                    op.attrs.get("alpha", 0.02)))
+
+
+@register("elu")
+def _elu(ctx, op):
+    ctx.out(op, "Out", K.elu(ctx.inp(op, "X"), op.attrs.get("alpha", 1.0)))
+
+
+@register("gelu")
+def _gelu(ctx, op):
+    ctx.out(op, "Out", K.gelu(ctx.inp(op, "X"),
+                              op.attrs.get("approximate", False)))
+
+
+@register("hard_sigmoid")
+def _hard_sigmoid(ctx, op):
+    ctx.out(op, "Out", K.hardsigmoid(ctx.inp(op, "X"),
+                                     op.attrs.get("slope", 0.2),
+                                     op.attrs.get("offset", 0.5)))
+
+
+@register("softmax")
+def _softmax(ctx, op):
+    ctx.out(op, "Out", K.softmax(ctx.inp(op, "X"),
+                                 op.attrs.get("axis", -1)))
+
+
+@register("log_softmax")
+def _log_softmax(ctx, op):
+    ctx.out(op, "Out", K.log_softmax(ctx.inp(op, "X"),
+                                     op.attrs.get("axis", -1)))
+
+
+@register("scale")
+def _scale(ctx, op):
+    ctx.out(op, "Out", K.scale(ctx.inp(op, "X"),
+                               op.attrs.get("scale", 1.0),
+                               op.attrs.get("bias", 0.0),
+                               op.attrs.get("bias_after_scale", True)))
+
+
+@register("clip")
+def _clip(ctx, op):
+    ctx.out(op, "Out", K.clip(ctx.inp(op, "X"), op.attrs.get("min"),
+                              op.attrs.get("max")))
+
+
+@register("pow")
+def _pow(ctx, op):
+    ctx.out(op, "Out", ctx.inp(op, "X") ** op.attrs.get("factor", 1.0))
+
+
+@register("cast")
+def _cast(ctx, op):
+    dt = convert_dtype(op.attrs["out_dtype"])
+    ctx.out(op, "Out", ctx.inp(op, "X").astype(dt))
+
+
+# ============ matmul / fc (operators/matmul_op.cc, mul_op.cc) ============
+
+@register("matmul")
+@register("matmul_v2")
+def _matmul(ctx, op):
+    x = ctx.inp(op, "X")
+    y = ctx.inp(op, "Y")
+    tx = op.attrs.get("transpose_X", op.attrs.get("trans_x", False))
+    ty = op.attrs.get("transpose_Y", op.attrs.get("trans_y", False))
+    out = K.matmul(x, y, tx, ty)
+    alpha = op.attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    ctx.out(op, "Out", out)
+
+
+@register("mul")
+def _mul(ctx, op):
+    ctx.out(op, "Out", K.mul_op(ctx.inp(op, "X"), ctx.inp(op, "Y"),
+                                op.attrs.get("x_num_col_dims", 1),
+                                op.attrs.get("y_num_col_dims", 1)))
+
+
+# ============ conv / pool ============
+
+@register("conv2d")
+@register("depthwise_conv2d")
+def _conv2d(ctx, op):
+    out = K.conv2d(ctx.inp(op, "Input"), ctx.inp(op, "Filter"),
+                   op.attrs.get("strides", [1, 1]),
+                   op.attrs.get("paddings", [0, 0]),
+                   op.attrs.get("dilations", [1, 1]),
+                   op.attrs.get("groups", 1))
+    ctx.out(op, "Output", out)
+
+
+@register("conv2d_transpose")
+def _conv2d_t(ctx, op):
+    out = K.conv2d_transpose(ctx.inp(op, "Input"), ctx.inp(op, "Filter"),
+                             op.attrs.get("strides", [1, 1]),
+                             op.attrs.get("paddings", [0, 0]),
+                             op.attrs.get("output_padding", [0, 0]),
+                             op.attrs.get("dilations", [1, 1]),
+                             op.attrs.get("groups", 1))
+    ctx.out(op, "Output", out)
+
+
+@register("pool2d")
+def _pool2d(ctx, op):
+    x = ctx.inp(op, "X")
+    ptype = op.attrs.get("pooling_type", "max")
+    if op.attrs.get("global_pooling", False):
+        out = x.max(axis=(2, 3), keepdims=True) if ptype == "max" else \
+            x.mean(axis=(2, 3), keepdims=True)
+    elif op.attrs.get("adaptive", False):
+        out = K.adaptive_avg_pool2d(x, op.attrs["ksize"]) \
+            if ptype == "avg" else K.adaptive_max_pool2d(x, op.attrs["ksize"])
+    else:
+        fn = K.max_pool2d if ptype == "max" else K.avg_pool2d
+        kw = {}
+        if ptype == "avg":
+            kw["exclusive"] = op.attrs.get("exclusive", True)
+        out = fn(x, op.attrs["ksize"], op.attrs.get("strides", [1, 1]),
+                 op.attrs.get("paddings", [0, 0]),
+                 op.attrs.get("ceil_mode", False), **kw)
+    ctx.out(op, "Out", out)
+
+
+# ============ norm ============
+
+@register("batch_norm")
+def _batch_norm(ctx, op):
+    x = ctx.inp(op, "X")
+    scale = ctx.inp(op, "Scale")
+    bias = ctx.inp(op, "Bias")
+    mean = ctx.inp(op, "Mean")
+    var = ctx.inp(op, "Variance")
+    eps = op.attrs.get("epsilon", 1e-5)
+    momentum = op.attrs.get("momentum", 0.9)
+    layout = op.attrs.get("data_layout", "NCHW")
+    if op.attrs.get("is_test", False) or not ctx.training or \
+            op.attrs.get("use_global_stats", False):
+        y = K.batch_norm_infer(x, scale, bias, mean, var, eps, layout)
+        ctx.out(op, "Y", y)
+    else:
+        y, nm, nv, bm, bv = K.batch_norm_train(x, scale, bias, mean, var,
+                                               momentum, eps, layout)
+        ctx.out(op, "Y", y)
+        ctx.out(op, "MeanOut", nm)
+        ctx.out(op, "VarianceOut", nv)
+        ctx.out(op, "SavedMean", bm)
+        ctx.out(op, "SavedVariance", bv)
+
+
+@register("layer_norm")
+def _layer_norm(ctx, op):
+    x = ctx.inp(op, "X")
+    scale = ctx.inp(op, "Scale")
+    bias = ctx.inp(op, "Bias")
+    begin = op.attrs.get("begin_norm_axis", 1)
+    eps = op.attrs.get("epsilon", 1e-5)
+    # paddle layer_norm flattens [begin:] and normalizes; scale is flat
+    orig_shape = x.shape
+    if scale is not None:
+        scale = scale.reshape(orig_shape[begin:])
+    if bias is not None:
+        bias = bias.reshape(orig_shape[begin:])
+    ctx.out(op, "Y", K.layer_norm(x, scale, bias, eps, begin))
+
+
+# ============ dropout / random ============
+
+@register("dropout")
+def _dropout(ctx, op):
+    x = ctx.inp(op, "X")
+    p = op.attrs.get("dropout_prob", 0.5)
+    is_test = op.attrs.get("is_test", False) or not ctx.training
+    impl = op.attrs.get("dropout_implementation", "downgrade_in_infer")
+    mode = "upscale_in_train" if impl == "upscale_in_train" else \
+        "downscale_in_infer"
+    out = K.dropout(x, ctx.next_key(), p, not is_test, mode)
+    ctx.out(op, "Out", out)
+
+
+@register("uniform_random")
+def _uniform_random(ctx, op):
+    shape = op.attrs["shape"]
+    dt = convert_dtype(op.attrs.get("dtype", "float32"))
+    ctx.out(op, "Out", K.uniform(ctx.next_key(), tuple(shape), dt,
+                                 op.attrs.get("min", -1.0),
+                                 op.attrs.get("max", 1.0)))
+
+
+@register("gaussian_random")
+def _gaussian_random(ctx, op):
+    shape = op.attrs["shape"]
+    dt = convert_dtype(op.attrs.get("dtype", "float32"))
+    ctx.out(op, "Out", K.gaussian(ctx.next_key(), tuple(shape), dt,
+                                  op.attrs.get("mean", 0.0),
+                                  op.attrs.get("std", 1.0)))
+
+
+@register("truncated_gaussian_random")
+def _trunc_gaussian(ctx, op):
+    import jax
+
+    shape = op.attrs["shape"]
+    dt = convert_dtype(op.attrs.get("dtype", "float32"))
+    v = jax.random.truncated_normal(ctx.next_key(), -2.0, 2.0, tuple(shape),
+                                    dt)
+    ctx.out(op, "Out", v * op.attrs.get("std", 1.0) +
+            op.attrs.get("mean", 0.0))
+
+
+# ============ fill / assign ============
+
+@register("fill_constant")
+def _fill_constant(ctx, op):
+    shape = op.attrs["shape"]
+    dt = convert_dtype(op.attrs.get("dtype", "float32"))
+    ctx.out(op, "Out", _jnp().full(tuple(int(s) for s in shape),
+                                   op.attrs.get("value", 0.0), dt))
+
+
+@register("fill_constant_batch_size_like")
+def _fill_cbsl(ctx, op):
+    x = ctx.inp(op, "Input")
+    shape = list(op.attrs["shape"])
+    shape[op.attrs.get("output_dim_idx", 0)] = \
+        x.shape[op.attrs.get("input_dim_idx", 0)]
+    dt = convert_dtype(op.attrs.get("dtype", "float32"))
+    ctx.out(op, "Out", _jnp().full(tuple(shape), op.attrs.get("value", 0.0),
+                                   dt))
+
+
+@register("assign")
+def _assign(ctx, op):
+    ctx.out(op, "Out", ctx.inp(op, "X"))
+
+
+@register("assign_value")
+def _assign_value(ctx, op):
+    shape = op.attrs["shape"]
+    dt = convert_dtype(op.attrs.get("dtype", "float32"))
+    vals = np.asarray(op.attrs["values"], dtype=dt).reshape(shape)
+    ctx.out(op, "Out", _jnp().asarray(vals))
+
+
+@register("shape")
+def _shape(ctx, op):
+    ctx.out(op, "Out", _jnp().asarray(ctx.inp(op, "Input").shape,
+                                      dtype=_jnp().int32))
+
+
+# ============ reshape / transpose / concat ... ============
+
+@register("reshape")
+@register("reshape2")
+def _reshape(ctx, op):
+    x = ctx.inp(op, "X")
+    shape = list(op.attrs["shape"])
+    # paddle: 0 means copy input dim
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    ctx.out(op, "Out", x.reshape(shape))
+
+
+@register("transpose")
+@register("transpose2")
+def _transpose(ctx, op):
+    ctx.out(op, "Out", K.transpose(ctx.inp(op, "X"), op.attrs["axis"]))
+
+
+@register("concat")
+def _concat(ctx, op):
+    ctx.out(op, "Out", K.concat(ctx.inps(op, "X"),
+                                op.attrs.get("axis", 0)))
+
+
+@register("split")
+def _split(ctx, op):
+    x = ctx.inp(op, "X")
+    sections = op.attrs.get("sections") or op.attrs.get("num", 2)
+    outs = K.split(x, sections, op.attrs.get("axis", 0))
+    ctx.outs(op, "Out", outs)
+
+
+@register("stack")
+def _stack(ctx, op):
+    ctx.out(op, "Y", K.stack(ctx.inps(op, "X"), op.attrs.get("axis", 0)))
+
+
+@register("squeeze")
+@register("squeeze2")
+def _squeeze(ctx, op):
+    axes = op.attrs.get("axes") or None
+    ctx.out(op, "Out", K.squeeze(ctx.inp(op, "X"), axes))
+
+
+@register("unsqueeze")
+@register("unsqueeze2")
+def _unsqueeze(ctx, op):
+    ctx.out(op, "Out", K.unsqueeze(ctx.inp(op, "X"), op.attrs["axes"]))
+
+
+@register("flatten")
+@register("flatten2")
+def _flatten(ctx, op):
+    x = ctx.inp(op, "X")
+    axis = op.attrs.get("axis", 1)
+    n = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    ctx.out(op, "Out", x.reshape((n, -1)))
+
+
+@register("flatten_contiguous_range")
+def _flatten_range(ctx, op):
+    ctx.out(op, "Out", K.flatten(ctx.inp(op, "X"),
+                                 op.attrs.get("start_axis", 0),
+                                 op.attrs.get("stop_axis", -1)))
+
+
+@register("expand")
+def _expand(ctx, op):
+    ctx.out(op, "Out", K.tile(ctx.inp(op, "X"), op.attrs["expand_times"]))
+
+
+@register("expand_v2")
+def _expand_v2(ctx, op):
+    ctx.out(op, "Out", K.expand(ctx.inp(op, "X"), op.attrs["shape"]))
+
+
+@register("slice")
+def _slice(ctx, op):
+    ctx.out(op, "Out", K.slice_op(ctx.inp(op, "Input"), op.attrs["axes"],
+                                  op.attrs["starts"], op.attrs["ends"]))
+
+
+@register("gather")
+def _gather(ctx, op):
+    ctx.out(op, "Out", K.gather(ctx.inp(op, "X"), ctx.inp(op, "Index"),
+                                op.attrs.get("axis", 0)))
+
+
+@register("pad")
+@register("pad2d")
+def _pad(ctx, op):
+    ctx.out(op, "Out", K.pad(ctx.inp(op, "X"), op.attrs["paddings"],
+                             op.attrs.get("mode", "constant"),
+                             op.attrs.get("pad_value",
+                                          op.attrs.get("value", 0.0))))
+
+
+# ============ reductions ============
+
+@register("reduce_sum")
+def _reduce_sum(ctx, op):
+    ctx.out(op, "Out", _reduce(ctx, op, K.reduce_sum))
+
+
+@register("reduce_mean")
+def _reduce_mean(ctx, op):
+    ctx.out(op, "Out", _reduce(ctx, op, K.reduce_mean))
+
+
+@register("reduce_max")
+def _reduce_max(ctx, op):
+    ctx.out(op, "Out", _reduce(ctx, op, K.reduce_max))
+
+
+@register("reduce_min")
+def _reduce_min(ctx, op):
+    ctx.out(op, "Out", _reduce(ctx, op, K.reduce_min))
+
+
+@register("reduce_prod")
+def _reduce_prod(ctx, op):
+    ctx.out(op, "Out", _reduce(ctx, op, K.reduce_prod))
+
+
+def _reduce(ctx, op, fn):
+    x = ctx.inp(op, "X")
+    if op.attrs.get("reduce_all", False):
+        return fn(x, None, op.attrs.get("keep_dim", False))
+    return fn(x, op.attrs.get("dim", [0]), op.attrs.get("keep_dim", False))
+
+
+@register("mean")
+def _mean(ctx, op):
+    ctx.out(op, "Out", ctx.inp(op, "X").mean())
+
+
+@register("sum")
+def _sum(ctx, op):
+    xs = ctx.inps(op, "X")
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    ctx.out(op, "Out", out)
+
+
+# ============ losses / metrics ============
+
+@register("softmax_with_cross_entropy")
+def _swce(ctx, op):
+    logits = ctx.inp(op, "Logits")
+    label = ctx.inp(op, "Label")
+    loss = K.softmax_with_cross_entropy(
+        logits, label, op.attrs.get("soft_label", False),
+        op.attrs.get("axis", -1), op.attrs.get("ignore_index", -100))
+    ctx.out(op, "Loss", loss)
+    ctx.out(op, "Softmax", K.softmax(logits, op.attrs.get("axis", -1)))
+
+
+@register("cross_entropy")
+@register("cross_entropy2")
+def _ce(ctx, op):
+    x = ctx.inp(op, "X")
+    label = ctx.inp(op, "Label")
+    jnp = _jnp()
+    if op.attrs.get("soft_label", False):
+        loss = -(label * jnp.log(jnp.clip(x, 1e-12, None))).sum(
+            axis=-1, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == x.ndim and lbl.shape[-1] == 1:
+            lbl = lbl[..., 0]
+        picked = jnp.take_along_axis(
+            x, lbl[..., None].astype(jnp.int32), axis=-1)
+        loss = -jnp.log(jnp.clip(picked, 1e-12, None))
+    ctx.out(op, "Y", loss)
+
+
+@register("square_error_cost")
+def _sec(ctx, op):
+    ctx.out(op, "Out", K.mse_loss(ctx.inp(op, "X"), ctx.inp(op, "Y")))
+
+
+@register("accuracy")
+def _accuracy(ctx, op):
+    import jax
+
+    jnp = _jnp()
+    out = ctx.inp(op, "Out")
+    label = ctx.inp(op, "Label")
+    if label.ndim == out.ndim and label.shape[-1] == 1:
+        label = label[..., 0]
+    pred = out.argmax(axis=-1)
+    acc = (pred == label).mean(dtype=jnp.float32)
+    ctx.out(op, "Accuracy", acc)
+    ctx.out(op, "Correct", (pred == label).sum().astype(jnp.int32))
+    ctx.out(op, "Total", jnp.asarray(label.shape[0], jnp.int32))
+
+
+@register("top_k")
+@register("top_k_v2")
+def _top_k(ctx, op):
+    v, i = K.topk(ctx.inp(op, "X"), op.attrs.get("k", 1),
+                  op.attrs.get("axis", -1),
+                  op.attrs.get("largest", True))
+    ctx.out(op, "Out", v)
+    ctx.out(op, "Indices", i)
+
+
+@register("arg_max")
+def _arg_max(ctx, op):
+    ctx.out(op, "Out", K.argmax(ctx.inp(op, "X"), op.attrs.get("axis"),
+                                op.attrs.get("keepdims", False)))
+
+
+# ============ embedding / one-hot ============
+
+@register("lookup_table")
+@register("lookup_table_v2")
+def _lookup(ctx, op):
+    ids = ctx.inp(op, "Ids")
+    w = ctx.inp(op, "W")
+    if ids.ndim >= 2 and ids.shape[-1] == 1 and op.type == "lookup_table":
+        ids = ids[..., 0]
+    ctx.out(op, "Out", K.embedding(ids, w,
+                                   op.attrs.get("padding_idx", -1)))
+
+
+@register("one_hot")
+@register("one_hot_v2")
+def _one_hot(ctx, op):
+    ids = ctx.inp(op, "X")
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    ctx.out(op, "Out", K.one_hot(ids, op.attrs["depth"]))
+
+
+# ============ optimizer ops (operators/optimizers/) ============
+
+@register("sgd")
+def _sgd(ctx, op):
+    p = ctx.inp(op, "Param")
+    g = ctx.inp(op, "Grad")
+    lr = ctx.inp(op, "LearningRate")
+    ctx.out(op, "ParamOut", p - lr * g.astype(p.dtype))
+
+
+@register("momentum")
+def _momentum(ctx, op):
+    p = ctx.inp(op, "Param")
+    g = ctx.inp(op, "Grad").astype(p.dtype)
+    v = ctx.inp(op, "Velocity")
+    lr = ctx.inp(op, "LearningRate")
+    mu = op.attrs.get("mu", 0.9)
+    v_new = mu * v + g
+    if op.attrs.get("use_nesterov", False):
+        p_new = p - lr * (g + mu * v_new)
+    else:
+        p_new = p - lr * v_new
+    ctx.out(op, "ParamOut", p_new)
+    ctx.out(op, "VelocityOut", v_new)
+
+
+@register("adam")
+def _adam(ctx, op):
+    jnp = _jnp()
+    p = ctx.inp(op, "Param")
+    g = ctx.inp(op, "Grad").astype(p.dtype)
+    m = ctx.inp(op, "Moment1")
+    v = ctx.inp(op, "Moment2")
+    lr = ctx.inp(op, "LearningRate")
+    b1p = ctx.inp(op, "Beta1Pow")
+    b2p = ctx.inp(op, "Beta2Pow")
+    b1 = op.attrs.get("beta1", 0.9)
+    b2 = op.attrs.get("beta2", 0.999)
+    eps = op.attrs.get("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    ctx.out(op, "ParamOut", p_new)
+    ctx.out(op, "Moment1Out", m_new)
+    ctx.out(op, "Moment2Out", v_new)
+    ctx.out(op, "Beta1PowOut", b1p * b1)
+    ctx.out(op, "Beta2PowOut", b2p * b2)
+
+
+@register("lamb")
+def _lamb(ctx, op):
+    jnp = _jnp()
+    p = ctx.inp(op, "Param")
+    g = ctx.inp(op, "Grad").astype(p.dtype)
+    m = ctx.inp(op, "Moment1")
+    v = ctx.inp(op, "Moment2")
+    lr = ctx.inp(op, "LearningRate")
+    b1p = ctx.inp(op, "Beta1Pow")
+    b2p = ctx.inp(op, "Beta2Pow")
+    b1 = op.attrs.get("beta1", 0.9)
+    b2 = op.attrs.get("beta2", 0.999)
+    eps = op.attrs.get("epsilon", 1e-6)
+    wd = op.attrs.get("weight_decay", 0.01)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * g * g
+    mhat = m_new / (1 - b1p)
+    vhat = v_new / (1 - b2p)
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+    w_norm = jnp.sqrt((p * p).sum())
+    r_norm = jnp.sqrt((r * r).sum())
+    trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    ctx.out(op, "ParamOut", p - lr * trust * r)
+    ctx.out(op, "Moment1Out", m_new)
+    ctx.out(op, "Moment2Out", v_new)
+    ctx.out(op, "Beta1PowOut", b1p * b1)
+    ctx.out(op, "Beta2PowOut", b2p * b2)
+
+
+# ============ grad clipping helpers ============
+
+@register("clip_by_norm")
+def _clip_by_norm(ctx, op):
+    ctx.out(op, "Out", K.clip_by_norm(ctx.inp(op, "X"),
+                                      op.attrs["max_norm"]))
+
+
+@register("squared_l2_norm")
+def _sq_l2(ctx, op):
+    x = ctx.inp(op, "X")
+    ctx.out(op, "Out", (x.astype(_jnp().float32) ** 2).sum())
+
+
+# ============ misc ============
+
+@register("increment")
+def _increment(ctx, op):
+    ctx.out(op, "Out", ctx.inp(op, "X") + op.attrs.get("step", 1.0))
+
+
+@register("seq_pool_placeholder")
+def _noop(ctx, op):
+    pass

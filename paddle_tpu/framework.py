@@ -1,0 +1,22 @@
+"""Execution-mode switch: paddle.enable_static/disable_static parity
+(fluid/framework.py dygraph guards — reference runs dygraph OFF by default in
+1.x; 2.0 runs dygraph ON by default, which we follow)."""
+from __future__ import annotations
+
+_static_mode = [False]
+
+
+def enable_static():
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
+
+def in_dynamic_mode() -> bool:
+    return not _static_mode[0]
+
+
+def in_dygraph_mode() -> bool:
+    return not _static_mode[0]

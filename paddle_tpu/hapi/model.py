@@ -1,0 +1,228 @@
+"""High-level Model API.
+
+Reference parity: python/paddle/hapi/model.py:788 (Model, fit :1243,
+evaluate, predict, save/load; Static/DynamicGraphAdapter). TPU-native
+design: one adapter — the eager engine with jit-compiled train steps; data
+parallelism comes from fleet/SPMD rather than a separate static adapter.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..io import DataLoader, Dataset
+from ..io.serialization import load as _load
+from ..io.serialization import save as _save
+from . import callbacks as cbks
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._loss = None
+        self._optimizer = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, (list, tuple)):
+            self._metrics = list(metrics)
+        else:
+            self._metrics = [metrics]
+
+    # ------------------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = _as_list(inputs)
+        labels = _as_list(labels)
+        outputs = self.network(*[to_tensor(x) for x in inputs])
+        losses = self._loss(*_as_list(outputs),
+                            *[to_tensor(y) for y in labels])
+        loss = losses if isinstance(losses, Tensor) else sum(losses)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return ([float(loss.numpy())], metrics) if metrics else \
+            [float(loss.numpy())]
+
+    def eval_batch(self, inputs, labels=None):
+        from ..core.autograd import no_grad
+
+        self.network.eval()
+        inputs = _as_list(inputs)
+        labels = _as_list(labels)
+        with no_grad():
+            outputs = self.network(*[to_tensor(x) for x in inputs])
+            losses = self._loss(*_as_list(outputs),
+                                *[to_tensor(y) for y in labels]) \
+                if self._loss else None
+        metrics = self._update_metrics(outputs, labels)
+        loss_val = [float(losses.numpy())] if losses is not None else []
+        return (loss_val, metrics) if metrics else loss_val
+
+    def predict_batch(self, inputs):
+        from ..core.autograd import no_grad
+
+        self.network.eval()
+        with no_grad():
+            out = self.network(*[to_tensor(x) for x in _as_list(inputs)])
+        return [o.numpy() for o in _as_list(out)]
+
+    def _update_metrics(self, outputs, labels):
+        vals = []
+        for m in self._metrics:
+            state = m.compute(*_as_list(outputs),
+                              *[to_tensor(y) for y in labels])
+            vals.append(m.update(*_as_list(state)))
+        return vals
+
+    # ------------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        train_loader = _as_loader(train_data, batch_size, shuffle, drop_last,
+                                  num_workers)
+        eval_loader = _as_loader(eval_data, batch_size, False, False,
+                                 num_workers) if eval_data is not None \
+            else None
+        cbk_list = cbks.config_callbacks(callbacks, self, epochs, verbose,
+                                         log_freq)
+        cbk_list.on_train_begin()
+        history = []
+        for epoch in range(epochs):
+            cbk_list.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                ins, lbs = _split_batch(batch, self._n_inputs())
+                res = self.train_batch(ins, lbs)
+                logs = _logs_from(res, self._metrics)
+                cbk_list.on_batch_end("train", step, logs)
+                if num_iters is not None and step + 1 >= num_iters:
+                    break
+            history.append(logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, verbose=0)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch_{epoch}")
+            cbk_list.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        cbk_list.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = _as_loader(eval_data, batch_size, False, False, num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            ins, lbs = _split_batch(batch, self._n_inputs())
+            res = self.eval_batch(ins, lbs)
+            if isinstance(res, tuple):
+                losses.extend(res[0])
+            else:
+                losses.extend(res)
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        out = {"loss": [float(np.mean(losses))] if losses else []}
+        for m in self._metrics:
+            out[_name_of(m)] = m.accumulate()
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = _as_loader(test_data, batch_size, False, False, num_workers)
+        outs = []
+        for batch in loader:
+            ins = batch[0] if isinstance(batch, tuple) else batch
+            outs.append(self.predict_batch(ins))
+        if stack_outputs:
+            n = len(outs[0])
+            return [np.concatenate([o[i] for o in outs]) for i in range(n)]
+        return outs
+
+    # ------------------------------------------------------------------
+    def save(self, path, training=True):
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = _load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        import os
+
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        lines = [f"{type(self.network).__name__}:"]
+        total = 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape))
+            total += n
+            lines.append(f"  {name:<40} {str(p.shape):<20} {n}")
+        lines.append(f"Total params: {total}")
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": total}
+
+    def _n_inputs(self):
+        if self._inputs is None:
+            return 1
+        return len(_as_list(self._inputs))
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _as_loader(data, batch_size, shuffle, drop_last, num_workers):
+    if data is None or isinstance(data, DataLoader):
+        return data
+    if isinstance(data, Dataset):
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+    return data
+
+
+def _split_batch(batch, n_inputs):
+    if isinstance(batch, (list, tuple)):
+        return list(batch[:n_inputs]), list(batch[n_inputs:])
+    return [batch], []
+
+
+def _logs_from(res, metrics):
+    logs = {}
+    if isinstance(res, tuple):
+        losses, mvals = res
+        logs["loss"] = losses
+        for m, v in zip(metrics, mvals):
+            logs[_name_of(m)] = v
+    else:
+        logs["loss"] = res
+    return logs
+
+
+def _name_of(m):
+    n = m.name()
+    return n if isinstance(n, str) else n[0]

@@ -1,0 +1,2 @@
+"""paddle.incubate: experimental surface (reference: fluid/incubate/)."""
+from . import checkpoint  # noqa: F401

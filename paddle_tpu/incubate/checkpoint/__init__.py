@@ -1,0 +1,67 @@
+"""Auto-checkpoint for elastic restart.
+
+Reference parity: fluid/incubate/checkpoint/auto_checkpoint.py
+(AutoCheckpointChecker :71, TrainEpochRange :265 — wraps the epoch loop,
+snapshots state, resumes after reschedule). TPU-native: orbax-style local /
+GCS checkpoint dir, env-driven like the reference (PADDLE_JOB_ID,
+PADDLE_CHECKPOINT_DIR).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from ...io.serialization import load, save
+
+
+class AutoCheckpointChecker:
+    def __init__(self):
+        self.job_id = os.environ.get("PADDLE_JOB_ID", "")
+        self.ckpt_dir = os.environ.get("PADDLE_CHECKPOINT_DIR", "")
+
+    def valid(self):
+        return bool(self.job_id and self.ckpt_dir)
+
+
+class TrainEpochRange:
+    """for epoch in TrainEpochRange(n, name).get(): ... — resumes from the
+    last completed epoch after a restart."""
+
+    def __init__(self, max_epoch_num, name, checkpoint_inter=None,
+                 save_checkpoint_fn=None, load_checkpoint_fn=None):
+        self._max = max_epoch_num
+        self._name = name
+        self._checker = AutoCheckpointChecker()
+        self._save_fn = save_checkpoint_fn
+        self._load_fn = load_checkpoint_fn
+        self._start = 0
+        if self._checker.valid():
+            meta = self._meta_path()
+            if os.path.exists(meta):
+                with open(meta) as f:
+                    state = json.load(f)
+                self._start = state.get("epoch", -1) + 1
+                if self._load_fn and state.get("payload"):
+                    self._load_fn(state["payload"])
+
+    def _meta_path(self):
+        return os.path.join(self._checker.ckpt_dir,
+                            f"{self._checker.job_id}_{self._name}.json")
+
+    def get(self):
+        for epoch in range(self._start, self._max):
+            yield epoch
+            self.save_checkpoint(epoch)
+
+    def save_checkpoint(self, epoch):
+        if not self._checker.valid():
+            return
+        os.makedirs(self._checker.ckpt_dir, exist_ok=True)
+        payload = None
+        if self._save_fn:
+            payload = os.path.join(
+                self._checker.ckpt_dir,
+                f"{self._checker.job_id}_{self._name}_e{epoch}.pdparams")
+            self._save_fn(payload)
+        with open(self._meta_path(), "w") as f:
+            json.dump({"epoch": epoch, "payload": payload}, f)

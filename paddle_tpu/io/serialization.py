@@ -1,0 +1,63 @@
+"""paddle.save / paddle.load.
+
+Reference parity: fluid/dygraph/checkpoint.py (save_dygraph/load_dygraph) and
+python/paddle/framework/io.py. Format: a pickle of nested containers where
+tensors are stored as numpy arrays + dtype tag (bfloat16-safe)."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.dtypes import bfloat16
+from ..core.tensor import Tensor
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        arr = obj.numpy()
+        if arr.dtype.name == "bfloat16":
+            return {"__tensor__": arr.astype(np.float32),
+                    "__dtype__": "bfloat16", "__name__": obj.name}
+        return {"__tensor__": arr, "__dtype__": arr.dtype.name,
+                "__name__": obj.name}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if "__tensor__" in obj:
+            arr = obj["__tensor__"]
+            if obj.get("__dtype__") == "bfloat16":
+                import jax.numpy as jnp
+
+                arr = jnp.asarray(arr, dtype=bfloat16)
+            if return_numpy:
+                return np.asarray(arr)
+            t = Tensor(arr)
+            t.name = obj.get("__name__", "")
+            return t
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        data = pickle.load(f)
+    return _unpack(data, return_numpy)

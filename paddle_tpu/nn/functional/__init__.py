@@ -1,0 +1,800 @@
+"""paddle.nn.functional parity (python/paddle/nn/functional/ in the
+reference). All math routes through ops/kernels.py jnp kernels under the
+eager autograd tape; the same kernels serve the static-graph executor."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core import random as _random
+from ...core.dtypes import convert_dtype
+from ...core.tensor import Tensor, apply_op
+from ...ops import kernels as K
+from ...tensor.ops import _op, _t
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ----------------------------- activations -----------------------------
+
+def relu(x, name=None):
+    return _op("relu", K.relu, x)
+
+
+def relu6(x, name=None):
+    return _op("relu6", K.relu6, x)
+
+
+def relu_(x):
+    out = relu(x)
+    x._data = out._data
+    return out
+
+
+def sigmoid(x, name=None):
+    return _op("sigmoid", K.sigmoid, x)
+
+
+def tanh(x, name=None):
+    return _op("tanh", K.tanh, x)
+
+
+def gelu(x, approximate=False, name=None):
+    return _op("gelu", lambda a: K.gelu(a, approximate), x)
+
+
+def silu(x, name=None):
+    return _op("silu", K.silu, x)
+
+
+def swish(x, name=None):
+    return _op("swish", K.swish, x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _op("leaky_relu", lambda a: K.leaky_relu(a, negative_slope), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return _op("elu", lambda a: K.elu(a, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _op("selu", lambda a: K.selu(a, scale, alpha), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(a, w):
+        jnp = _jnp()
+        if w.size == 1:
+            return jnp.where(a >= 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        axis = 1 if data_format == "NCHW" else a.ndim - 1
+        shape[axis] = -1
+        return jnp.where(a >= 0, a, w.reshape(shape) * a)
+
+    return _op("prelu", fn, x, weight)
+
+
+def hardswish(x, name=None):
+    return _op("hardswish", K.hardswish, x)
+
+
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5, name=None):
+    return _op("hardsigmoid", lambda a: K.hardsigmoid(a, slope, offset), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _op("hardtanh", lambda a: K.hardtanh(a, min, max), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _op("hardshrink",
+               lambda a: _jnp().where(_jnp().abs(a) > threshold, a, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    def fn(a):
+        jnp = _jnp()
+        return jnp.where(a > threshold, a - threshold,
+                         jnp.where(a < -threshold, a + threshold, 0.0))
+    return _op("softshrink", fn, x)
+
+
+def tanhshrink(x, name=None):
+    return _op("tanhshrink", lambda a: a - _jnp().tanh(a), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _op("softplus", lambda a: K.softplus(a, beta, threshold), x)
+
+
+def softsign(x, name=None):
+    return _op("softsign", K.softsign, x)
+
+
+def mish(x, name=None):
+    return _op("mish", K.mish, x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(a):
+        jnp = _jnp()
+        shape = list(a.shape)
+        c = shape[axis]
+        new = shape[:axis] + [c // groups, groups] + shape[axis + 1:]
+        return a.reshape(new).max(axis=axis + 1)
+    return _op("maxout", fn, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    dt = convert_dtype(dtype)
+
+    def fn(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return K.softmax(a, axis)
+
+    return _op("softmax", fn, x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return _op("log_softmax", lambda a: K.log_softmax(a, axis), x)
+
+
+def log_sigmoid(x, name=None):
+    import jax
+
+    return _op("log_sigmoid", lambda a: jax.nn.log_sigmoid(a), x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    import jax
+
+    key = _random.next_key()
+
+    def fn(a):
+        jnp = _jnp()
+        g = -jnp.log(-jnp.log(
+            jax.random.uniform(key, a.shape, minval=1e-20, maxval=1.0)))
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = y.argmax(axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y).at[
+                tuple(jnp.meshgrid(*[jnp.arange(s) for i, s in
+                                     enumerate(y.shape) if i != axis % y.ndim],
+                                   indexing="ij"))
+            ].set(1.0) if False else jax.nn.one_hot(
+                y.argmax(axis=axis), y.shape[axis], axis=axis, dtype=y.dtype)
+            y = onehot + y - jax.lax.stop_gradient(y)
+        return y
+
+    return _op("gumbel_softmax", fn, x)
+
+
+# ----------------------------- linear / conv -----------------------------
+
+def linear(x, weight, bias=None, name=None):
+    if bias is None:
+        return _op("linear", lambda a, w: K.linear(a, w), x, weight)
+    return _op("linear", K.linear, x, weight, bias)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(data_format)
+    nhwc = data_format == "NHWC"
+
+    def fn(a, w, *b):
+        jnp = _jnp()
+        if nhwc:
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        out = K.conv2d(a, w, stride, padding, dilation, groups)
+        if b:
+            out = out + b[0].reshape(1, -1, 1, 1)
+        if nhwc:
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return _op("conv2d", fn, *args)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW", output_size=None, name=None):
+    def fn(a, w, *b):
+        out = K.conv2d_transpose(a, w, stride, padding, output_padding,
+                                 dilation, groups)
+        if b:
+            out = out + b[0].reshape(1, -1, 1, 1)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return _op("conv2d_transpose", fn, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    def fn(a, w, *b):
+        jnp = _jnp()
+        a4 = a[:, :, None, :]
+        w4 = w[:, :, None, :]
+        s = stride if isinstance(stride, int) else stride[0]
+        d = dilation if isinstance(dilation, int) else dilation[0]
+        p = padding if isinstance(padding, (int, str)) else padding[0]
+        if isinstance(p, int):
+            pad = [(0, 0), (p, p)]
+        else:
+            pad = p
+        out = K.conv2d(a4, w4, (1, s), pad if isinstance(pad, list) else pad,
+                       (1, d), groups)
+        out = out[:, :, 0, :]
+        if b:
+            out = out + b[0].reshape(1, -1, 1)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return _op("conv1d", fn, *args)
+
+
+# ----------------------------- pooling -----------------------------
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    return _op("max_pool2d",
+               lambda a: K.max_pool2d(a, kernel_size, stride, padding,
+                                      ceil_mode), x)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _op("avg_pool2d",
+               lambda a: K.avg_pool2d(a, kernel_size, stride, padding,
+                                      ceil_mode, exclusive), x)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _op("adaptive_avg_pool2d",
+               lambda a: K.adaptive_avg_pool2d(a, output_size), x)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _op("adaptive_max_pool2d",
+               lambda a: K.adaptive_max_pool2d(a, output_size), x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               name=None):
+    def fn(a):
+        a4 = a[:, :, None, :]
+        k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        s = stride if stride is None or isinstance(stride, int) else stride[0]
+        p = padding if isinstance(padding, int) else padding[0]
+        out = K.max_pool2d(a4, (1, k), (1, s if s else k), (0, p), ceil_mode)
+        return out[:, :, 0, :]
+    return _op("max_pool1d", fn, x)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, name=None):
+    def fn(a):
+        a4 = a[:, :, None, :]
+        k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        s = stride if stride is None or isinstance(stride, int) else stride[0]
+        p = padding if isinstance(padding, int) else padding[0]
+        out = K.avg_pool2d(a4, (1, k), (1, s if s else k), (0, p), ceil_mode,
+                           exclusive)
+        return out[:, :, 0, :]
+    return _op("avg_pool1d", fn, x)
+
+
+# ----------------------------- normalization -----------------------------
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Functional BN. In training mode also updates running stats in-place
+    (reference: operators/batch_norm_op.cc semantics)."""
+    jnp = _jnp()
+    c = x.shape[1] if data_format.startswith("NC") else x.shape[-1]
+    w = weight if weight is not None else Tensor._wrap(jnp.ones((c,),
+                                                               x._data.dtype))
+    b = bias if bias is not None else Tensor._wrap(jnp.zeros((c,),
+                                                             x._data.dtype))
+    if training and not use_global_stats:
+        def fn(a, g, bb, rm, rv):
+            y, _, _, _, _ = K.batch_norm_train(a, g, bb, rm, rv, momentum,
+                                               epsilon, data_format)
+            return y
+
+        out = _op("batch_norm", fn, x, w, b, running_mean.detach(),
+                  running_var.detach())
+        # update running stats outside the tape
+        _, nm, nv, _, _ = K.batch_norm_train(
+            x._data, w._data, b._data, running_mean._data, running_var._data,
+            momentum, epsilon, data_format)
+        running_mean._data = nm
+        running_var._data = nv
+        return out
+    return _op("batch_norm_infer",
+               lambda a, g, bb, rm, rv: K.batch_norm_infer(
+                   a, g, bb, rm, rv, epsilon, data_format),
+               x, w, b, running_mean.detach(), running_var.detach())
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    begin = -len(normalized_shape)
+
+    if weight is None and bias is None:
+        return _op("layer_norm",
+                   lambda a: K.layer_norm(a, None, None, epsilon, begin), x)
+    if bias is None:
+        return _op("layer_norm",
+                   lambda a, w: K.layer_norm(a, w, None, epsilon, begin),
+                   x, weight)
+    if weight is None:
+        return _op("layer_norm",
+                   lambda a, b: K.layer_norm(a, None, b, epsilon, begin),
+                   x, bias)
+    return _op("layer_norm",
+               lambda a, w, b: K.layer_norm(a, w, b, epsilon, begin),
+               x, weight, bias)
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW", name=None):
+    args = [x] + [a for a in (weight, bias) if a is not None]
+    has_w, has_b = weight is not None, bias is not None
+
+    def fn(a, *wb):
+        w = wb[0] if has_w else None
+        b = wb[1] if (has_w and has_b) else (wb[0] if has_b else None)
+        return K.group_norm(a, num_groups, w, b, epsilon)
+
+    return _op("group_norm", fn, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    args = [x] + [a for a in (weight, bias) if a is not None]
+    has_w, has_b = weight is not None, bias is not None
+
+    def fn(a, *wb):
+        w = wb[0] if has_w else None
+        b = wb[1] if (has_w and has_b) else (wb[0] if has_b else None)
+        return K.instance_norm(a, w, b, eps)
+
+    return _op("instance_norm", fn, *args)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(a):
+        jnp = _jnp()
+        n = K.norm(a, p, axis, True)
+        return a / jnp.maximum(n, epsilon)
+    return _op("normalize", fn, x)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def fn(a):
+        jnp = _jnp()
+        sq = a * a
+        half = size // 2
+        pads = [(0, 0), (half, size - 1 - half), (0, 0), (0, 0)]
+        sq = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            acc = acc + sq[:, i:i + a.shape[1]]
+        return a / (k + alpha * acc) ** beta
+    return _op("lrn", fn, x)
+
+
+# ----------------------------- dropout / embedding -----------------------
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return _op("dropout", lambda a: a * (1.0 - p), x)
+        return _t(x)
+    key = _random.next_key()
+    if axis is not None:
+        import jax
+
+        def fn(a):
+            jnp = _jnp()
+            keep = 1.0 - p
+            shape = [a.shape[i] if i in (
+                axis if isinstance(axis, (list, tuple)) else [axis])
+                else 1 for i in range(a.ndim)]
+            mask = jax.random.bernoulli(key, keep, tuple(shape))
+            scale_v = (1.0 / keep) if mode == "upscale_in_train" else 1.0
+            return jnp.where(mask, a * scale_v, 0.0).astype(a.dtype)
+        return _op("dropout", fn, x)
+    return _op("dropout", lambda a: K.dropout(a, key, p, training, mode), x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    return dropout(x, p, axis=[0, 1], training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return _t(x)
+    import jax
+
+    key = _random.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(a):
+        jnp = _jnp()
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(key, keep, a.shape)
+        a_coef = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+        b_coef = -a_coef * alpha_p * (1 - keep)
+        return (a_coef * jnp.where(mask, a, alpha_p) + b_coef).astype(a.dtype)
+
+    return _op("alpha_dropout", fn, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return _op("embedding",
+               lambda ids, w: K.embedding(ids, w, padding_idx), x, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    return _op("one_hot", lambda a: K.one_hot(a, num_classes), x)
+
+
+# ----------------------------- losses -----------------------------
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, name=None):
+    args = (input, label) if weight is None else (input, label, weight)
+
+    def fn(logits, lbl, *w):
+        return K.cross_entropy_loss(
+            logits, lbl, soft_label, reduction, ignore_index,
+            w[0] if w else None, axis, use_softmax)
+
+    lt = _t(label)
+    if not soft_label:
+        lt = lt.detach()
+    return apply_op("cross_entropy", fn,
+                    [_t(input), lt] + ([_t(weight)] if weight is not None
+                                       else []))
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    out = apply_op(
+        "softmax_with_cross_entropy",
+        lambda lg, lb: K.softmax_with_cross_entropy(lg, lb, soft_label, axis,
+                                                    ignore_index),
+        [_t(logits), _t(label).detach() if not soft_label else _t(label)])
+    if return_softmax:
+        return out, softmax(logits, axis)
+    return out
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return _reduce_loss(_op("mse_loss", K.mse_loss, input, label), reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _reduce_loss(_op("l1_loss", K.l1_loss, input, label), reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    return _reduce_loss(
+        _op("smooth_l1", lambda a, b: K.smooth_l1(a, b, delta), input, label),
+        reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    args = (input, _t(label).detach()) if weight is None else (
+        input, _t(label).detach(), weight)
+    out = _op("nll_loss",
+              lambda lp, lb, *w: K.nll_loss(lp, lb, w[0] if w else None,
+                                            ignore_index), *args)
+    return _reduce_loss(out, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    out = _op("bce_loss", K.bce_loss, input, label)
+    if weight is not None:
+        out = out * weight
+    return _reduce_loss(out, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    if pos_weight is not None:
+        out = _op("bce_logits",
+                  lambda a, b, pw: K.bce_with_logits(a, b, pw), logit, label,
+                  pos_weight)
+    else:
+        out = _op("bce_logits", K.bce_with_logits, logit, label)
+    if weight is not None:
+        out = out * weight
+    return _reduce_loss(out, reduction)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    out = _op("kl_div", K.kl_div, input, label)
+    if reduction == "batchmean":
+        return out.sum() / out.shape[0]
+    return _reduce_loss(out, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    out = _op("margin_ranking",
+              lambda a, b, lbl: _jnp().maximum(
+                  0.0, -lbl * (a - b) + margin), input, other, label)
+    return _reduce_loss(out, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    out = _op("hinge_embedding",
+              lambda a, lbl: _jnp().where(
+                  lbl == 1.0, a, _jnp().maximum(0.0, margin - a)),
+              input, label)
+    return _reduce_loss(out, reduction)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def fn(a, b):
+        jnp = _jnp()
+        num = (a * b).sum(axis=axis)
+        den = jnp.sqrt((a * a).sum(axis=axis)) * jnp.sqrt(
+            (b * b).sum(axis=axis))
+        return num / jnp.maximum(den, eps)
+    return _op("cosine_similarity", fn, x1, x2)
+
+
+def square_error_cost(input, label):
+    return _op("square_error_cost", K.mse_loss, input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def fn(a, lbl):
+        jnp = _jnp()
+        return -lbl * jnp.log(a + epsilon) - (1.0 - lbl) * jnp.log(
+            1.0 - a + epsilon)
+    return _op("log_loss", fn, input, label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean"):
+    raise NotImplementedError("ctc_loss lands with the audio op set")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    def fn(lg, lb):
+        import jax
+
+        jnp = _jnp()
+        p = jax.nn.sigmoid(lg)
+        ce = K.bce_with_logits(lg, lb)
+        p_t = p * lb + (1 - p) * (1 - lb)
+        a_t = alpha * lb + (1 - alpha) * (1 - lb)
+        return a_t * ((1 - p_t) ** gamma) * ce
+    out = _op("sigmoid_focal_loss", fn, logit, label)
+    if normalizer is not None:
+        out = out / normalizer
+    return _reduce_loss(out, reduction)
+
+
+def _reduce_loss(out, reduction):
+    if reduction == "mean":
+        return out.mean()
+    if reduction == "sum":
+        return out.sum()
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    if prior_dist is not None:
+        return _op("label_smooth",
+                   lambda lbl, p: K.label_smooth(lbl, epsilon, p), label,
+                   prior_dist)
+    return _op("label_smooth", lambda lbl: K.label_smooth(lbl, epsilon),
+               label)
+
+
+# ----------------------------- vision -----------------------------
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    t_ = _t(x)
+    h, w = t_.shape[2], t_.shape[3]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(s) for s in size.numpy()]
+        oh, ow = int(size[0]), int(size[1])
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+            (scale_factor, scale_factor)
+        oh, ow = int(h * sf[0]), int(w * sf[1])
+    if mode == "nearest":
+        return _op("interp_nearest",
+                   lambda a: K.interpolate_nearest(a, (oh, ow)), t_)
+    if mode in ("bilinear", "linear"):
+        return _op("interp_bilinear",
+                   lambda a: K.interpolate_bilinear(a, (oh, ow),
+                                                    align_corners), t_)
+    raise NotImplementedError(f"interpolate mode {mode}")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    def fn(a):
+        jnp = _jnp()
+        n, c, h, w = a.shape
+        r = upscale_factor
+        a = a.reshape(n, c // (r * r), r, r, h, w)
+        a = jnp.transpose(a, (0, 1, 4, 2, 5, 3))
+        return a.reshape(n, c // (r * r), h * r, w * r)
+    return _op("pixel_shuffle", fn, x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    import jax
+
+    k = K._pair(kernel_sizes)
+    s = K._pair(strides)
+    p = K._pair(paddings)
+    d = K._pair(dilations)
+
+    def fn(a):
+        jnp = _jnp()
+        n, c, h, w = a.shape
+        a_p = jnp.pad(a, [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+        oh = (h + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (w + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        cols = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                patch = a_p[:, :, i * d[0]:i * d[0] + oh * s[0]:s[0],
+                            j * d[1]:j * d[1] + ow * s[1]:s[1]]
+                cols.append(patch)
+        out = jnp.stack(cols, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * k[0] * k[1], oh * ow)
+    return _op("unfold", fn, x)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    def fn(a, g):
+        jnp = _jnp()
+        n, c, h, w = a.shape
+        gx = (g[..., 0] + 1.0) * (w - 1) / 2.0 if align_corners else \
+            ((g[..., 0] + 1.0) * w - 1.0) / 2.0
+        gy = (g[..., 1] + 1.0) * (h - 1) / 2.0 if align_corners else \
+            ((g[..., 1] + 1.0) * h - 1.0) / 2.0
+        x0 = jnp.floor(gx).astype(jnp.int32)
+        y0 = jnp.floor(gy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = gx - x0
+        wy = gy - y0
+
+        def sample(yy, xx):
+            valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            yy = jnp.clip(yy, 0, h - 1)
+            xx = jnp.clip(xx, 0, w - 1)
+            # a: n c h w; index per-batch
+            bidx = jnp.arange(n).reshape(n, 1, 1)
+            out = a[bidx, :, yy, xx]  # n, gh, gw, c
+            return jnp.where(valid[..., None], out, 0.0)
+
+        v00 = sample(y0, x0)
+        v01 = sample(y0, x1)
+        v10 = sample(y1, x0)
+        v11 = sample(y1, x1)
+        out = (v00 * ((1 - wx) * (1 - wy))[..., None]
+               + v01 * (wx * (1 - wy))[..., None]
+               + v10 * ((1 - wx) * wy)[..., None]
+               + v11 * (wx * wy)[..., None])
+        return jnp.transpose(out, (0, 3, 1, 2))
+    return _op("grid_sample", fn, x, grid)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    def fn(th):
+        jnp = _jnp()
+        n, c, h, w = [int(v) for v in out_shape]
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # h w 3
+        return jnp.einsum("nij,hwj->nhwi", th, base)
+    return _op("affine_grid", fn, theta)
+
+
+# ----------------------------- padding / misc -----------------------------
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    pads = [int(p._data) if isinstance(p, Tensor) else int(p) for p in pad] \
+        if isinstance(pad, (list, tuple)) else pad
+    return _op("pad", lambda a: K.pad(a, pads, mode, value), x)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    def fn(a):
+        jnp = _jnp()
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        a = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate(
+            [a[:, 1:, :fold], jnp.zeros_like(a[:, :1, :fold])], axis=1)
+        right = jnp.concatenate(
+            [jnp.zeros_like(a[:, :1, fold:2 * fold]),
+             a[:, :-1, fold:2 * fold]], axis=1)
+        rest = a[:, :, 2 * fold:]
+        out = jnp.concatenate([left, right, rest], axis=2)
+        return out.reshape(nt, c, h, w)
+    return _op("temporal_shift", fn, x)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def fn(a, p, lbl):
+        jnp = _jnp()
+        sim = jnp.matmul(a, p.T)
+        lbl = lbl.reshape(-1, 1)
+        tgt = (lbl == lbl.T).astype(a.dtype)
+        tgt = tgt / tgt.sum(axis=1, keepdims=True)
+        logp = jax_log_softmax(sim)
+        ce = -(tgt * logp).sum(axis=1).mean()
+        reg = (a * a).sum(axis=1).mean() + (p * p).sum(axis=1).mean()
+        return ce + l2_reg * reg * 0.25
+    import jax
+    jax_log_softmax = jax.nn.log_softmax
+    return _op("npair_loss", fn, anchor, positive, _t(labels).detach())
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """TPU-first attention entry. Uses the pallas flash kernel on TPU when
+    shapes allow; falls back to the XLA softmax composition elsewhere."""
+    from ...ops import attention as A
+
+    args = [query, key, value]
+    if attn_mask is not None:
+        args.append(attn_mask)
+
+    def fn(q, k, v, *m):
+        return A.sdpa(q, k, v, m[0] if m else None, is_causal)
+
+    return _op("sdpa", fn, *args)

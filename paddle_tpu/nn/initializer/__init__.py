@@ -1,0 +1,224 @@
+"""Weight initializers.
+
+Reference parity: python/paddle/fluid/initializer.py (ConstantInitializer,
+UniformInitializer, NormalInitializer, TruncatedNormal, Xavier, MSRA/Kaiming,
+NumpyArrayInitializer) and paddle.nn.initializer. Each initializer is a
+callable (shape, dtype) -> jax array; the same objects drive both eager
+parameter creation and static-graph startup programs.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ...core import random as _random
+from ...core.dtypes import convert_dtype
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+    def _fan(self, shape):
+        shape = list(shape)
+        if len(shape) == 0:
+            return 1, 1
+        if len(shape) == 1:
+            return shape[0], shape[0]
+        if len(shape) == 2:
+            return shape[0], shape[1]
+        # conv kernels: paddle weight layout OIHW → fan_in = I*k, fan_out = O*k
+        rf = int(np.prod(shape[2:]))
+        return shape[1] * rf, shape[0] * rf
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return _jnp().full(tuple(shape), self.value, convert_dtype(dtype))
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        import jax
+
+        return jax.random.uniform(_random.next_key(), tuple(shape),
+                                  convert_dtype(dtype), self.low, self.high)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, seed=0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        import jax
+
+        return (jax.random.normal(_random.next_key(), tuple(shape),
+                                  convert_dtype(dtype)) * self.std
+                + self.mean)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, seed=0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        import jax
+
+        return (jax.random.truncated_normal(
+            _random.next_key(), -2.0, 2.0, tuple(shape),
+            convert_dtype(dtype)) * self.std + self.mean)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        import jax
+
+        fi, fo = self._fan(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(_random.next_key(), tuple(shape),
+                                  convert_dtype(dtype), -limit, limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        import jax
+
+        fi, fo = self._fan(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return jax.random.normal(_random.next_key(), tuple(shape),
+                                 convert_dtype(dtype)) * std
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype):
+        import jax
+
+        fi, _ = self._fan(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        limit = math.sqrt(6.0 / fi)
+        return jax.random.uniform(_random.next_key(), tuple(shape),
+                                  convert_dtype(dtype), -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+
+    def __call__(self, shape, dtype):
+        import jax
+
+        fi, _ = self._fan(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        std = math.sqrt(2.0 / fi)
+        return jax.random.normal(_random.next_key(), tuple(shape),
+                                 convert_dtype(dtype)) * std
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        from ...core.tensor import Tensor
+
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v.numpy()
+        arr = _jnp().asarray(np.asarray(v), dtype=convert_dtype(dtype))
+        return arr.reshape(tuple(shape)) if list(arr.shape) != list(shape) \
+            else arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        import jax
+
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        a = jax.random.normal(_random.next_key(), (max(rows, cols),
+                                                   min(rows, cols)))
+        q, r = _jnp().linalg.qr(a)
+        q = q * _jnp().sign(_jnp().diag(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(
+            convert_dtype(dtype))
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        arr = np.zeros(shape, dtype=np.float32)
+        o, i = shape[0], shape[1]
+        mid = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for k in range(min(o // self.groups, i)):
+                idx = (g * (o // self.groups) + k, k) + tuple(mid)
+                arr[idx] = 1.0
+        return _jnp().asarray(arr, dtype=convert_dtype(dtype))
+
+
+# fluid-era aliases (fluid/initializer.py)
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+XavierInitializer = XavierUniform
+MSRAInitializer = KaimingNormal
+NumpyArrayInitializer = Assign
+
+
+def _to_initializer(attr, default):
+    """Resolve a ParamAttr-ish spec into an Initializer instance."""
+    if attr is None:
+        return default
+    if isinstance(attr, Initializer):
+        return attr
+    if callable(attr):
+        return attr
+    return default
+
+
+def calculate_gain(nonlinearity, param=None):
+    if nonlinearity == "tanh":
+        return 5.0 / 3.0
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity == "selu":
+        return 3.0 / 4.0
+    return 1.0

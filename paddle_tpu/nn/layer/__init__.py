@@ -1,0 +1,1 @@
+from . import common, layers, rnn, transformer  # noqa: F401

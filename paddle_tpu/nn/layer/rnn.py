@@ -1,0 +1,285 @@
+"""Recurrent layers.
+
+Reference parity: python/paddle/nn/layer/rnn.py (LSTM/GRU/SimpleRNN + cells)
+and the C++ recurrent machinery (operators/math/lstm_compute, gru_compute,
+operators/controlflow/recurrent_op.cc). TPU-native design: the time loop is a
+single `lax.scan` — one compiled XLA While with fused per-step matmuls —
+instead of an interpreted static RNN (compiler-friendly control flow).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ...core.tensor import Tensor, apply_op
+from .. import functional as F  # noqa: F401
+from .. import initializer as I
+from .layers import Layer
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        jnp = _jnp()
+        b = batch_ref.shape[batch_dim_idx]
+        return Tensor._wrap(jnp.full((b, self.hidden_size), init_value,
+                                     batch_ref._data.dtype))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fn(x, h, wi, wh, bi, bh):
+            jnp = _jnp()
+            z = x @ wi.T + bi + h @ wh.T + bh
+            return jnp.tanh(z) if self.activation == "tanh" else \
+                jnp.maximum(z, 0)
+
+        out = apply_op("rnn_cell", fn,
+                       [inputs, states, self.weight_ih, self.weight_hh,
+                        self.bias_ih, self.bias_hh])
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        def fn(x, h_, c_, wi, wh, bi, bh):
+            import jax
+
+            jnp = _jnp()
+            gates = x @ wi.T + bi + h_ @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c_ + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h_new, c_new = apply_op(
+            "lstm_cell", fn,
+            [inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih,
+             self.bias_hh], n_outputs=2)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fn(x, h_, wi, wh, bi, bh):
+            import jax
+
+            jnp = _jnp()
+            xg = x @ wi.T + bi
+            hg = h_ @ wh.T + bh
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            return (1.0 - z) * n + z * h_
+
+        out = apply_op("gru_cell", fn,
+                       [inputs, states, self.weight_ih, self.weight_hh,
+                        self.bias_ih, self.bias_hh])
+        return out, out
+
+
+class RNN(Layer):
+    """Wraps a cell into a scanned sequence layer (nn.RNN parity)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # eager loop (autograd-taped); the static path uses lax.scan
+        axis = 0 if self.time_major else 1
+        steps = inputs.shape[axis]
+        states = initial_states
+        outs = []
+        rng = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for tstep in rng:
+            xt = inputs[(slice(None),) * axis + (tstep,)]
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        from ...tensor import ops as T
+
+        return T.stack(outs, axis=axis), states
+
+
+class _MultiLayerRNN(Layer):
+    MODE = "RNN"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirectional else 1
+        self.num_directions = num_dir
+        cells = []
+        for layer in range(num_layers):
+            for d in range(num_dir):
+                in_sz = input_size if layer == 0 else hidden_size * num_dir
+                cells.append(self._make_cell(in_sz, hidden_size, activation,
+                                             weight_ih_attr, weight_hh_attr,
+                                             bias_ih_attr, bias_hh_attr))
+        from .common import LayerList
+
+        self.cells = LayerList(cells)
+
+    def _make_cell(self, in_sz, hid, act, *attrs):
+        if self.MODE == "LSTM":
+            return LSTMCell(in_sz, hid, *attrs)
+        if self.MODE == "GRU":
+            return GRUCell(in_sz, hid, *attrs)
+        return SimpleRNNCell(in_sz, hid, act, *attrs)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor import ops as T
+
+        x = inputs
+        final_states = []
+        for layer in range(self.num_layers):
+            outs_dir = []
+            states_dir = []
+            for d in range(self.num_directions):
+                cell = self.cells[layer * self.num_directions + d]
+                init = None
+                if initial_states is not None:
+                    init = self._slice_init(initial_states, layer, d)
+                rnn = RNN(cell, is_reverse=(d == 1),
+                          time_major=self.time_major)
+                out, st = rnn(x, init)
+                outs_dir.append(out)
+                states_dir.append(st)
+            x = outs_dir[0] if len(outs_dir) == 1 else T.concat(
+                outs_dir, axis=-1)
+            final_states.extend(states_dir)
+            if self.dropout and layer < self.num_layers - 1 and self.training:
+                x = F.dropout(x, self.dropout, training=True)
+        if self.MODE == "LSTM":
+            h = T.stack([s[0] for s in final_states], axis=0)
+            c = T.stack([s[1] for s in final_states], axis=0)
+            return x, (h, c)
+        h = T.stack(final_states, axis=0)
+        return x, h
+
+    def _slice_init(self, initial_states, layer, d):
+        idx = layer * self.num_directions + d
+        if self.MODE == "LSTM":
+            h, c = initial_states
+            return h[idx], c[idx]
+        return initial_states[idx]
+
+
+class SimpleRNN(_MultiLayerRNN):
+    MODE = "RNN"
+
+
+class LSTM(_MultiLayerRNN):
+    MODE = "LSTM"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class GRU(_MultiLayerRNN):
+    MODE = "GRU"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)

@@ -1,0 +1,1031 @@
+"""Pure-jnp kernel library: the TPU-native equivalent of the reference
+operator library (paddle/fluid/operators/, 630 REGISTER_OPERATOR sites).
+
+Every kernel is a pure function over jax arrays — usable eagerly (dygraph
+dispatch, core/tensor.py), under the whole-program static lowering
+(fluid/executor.py), and inside pjit/shard_map. Layouts follow paddle
+defaults (NCHW for conv/pool). CUDA/cuDNN/mkldnn kernel *variants* of the
+reference collapse into single XLA lowerings (SURVEY.md §2.2 TPU note).
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import numpy as np
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# =====================================================================
+# elementwise / activation (reference: operators/activation_op.cc,
+# operators/elementwise/)
+# =====================================================================
+
+def relu(x):
+    return _jnp().maximum(x, 0)
+
+
+def relu6(x):
+    return _jnp().clip(x, 0, 6)
+
+
+def sigmoid(x):
+    import jax
+
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x):
+    return _jnp().tanh(x)
+
+
+def gelu(x, approximate=False):
+    import jax
+
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def silu(x):
+    import jax
+
+    return jax.nn.silu(x)
+
+
+swish = silu
+
+
+def leaky_relu(x, negative_slope=0.01):
+    jnp = _jnp()
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+def elu(x, alpha=1.0):
+    import jax
+
+    return jax.nn.elu(x, alpha)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    jnp = _jnp()
+    return scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))
+
+
+def hardswish(x):
+    jnp = _jnp()
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5):
+    jnp = _jnp()
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hardtanh(x, min=-1.0, max=1.0):
+    return _jnp().clip(x, min, max)
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    jnp = _jnp()
+    bx = beta * x
+    return jnp.where(bx > threshold, x, jnp.log1p(jnp.exp(bx)) / beta)
+
+
+def softsign(x):
+    jnp = _jnp()
+    return x / (1.0 + jnp.abs(x))
+
+
+def mish(x):
+    jnp = _jnp()
+    return x * jnp.tanh(softplus(x))
+
+
+def softmax(x, axis=-1):
+    import jax
+
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1):
+    import jax
+
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def maximum(x, y):
+    return _jnp().maximum(x, y)
+
+
+def minimum(x, y):
+    return _jnp().minimum(x, y)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def clip(x, min=None, max=None):
+    return _jnp().clip(x, min, max)
+
+
+def pow_(x, y):
+    return x ** y
+
+
+# =====================================================================
+# matmul / linear (reference: operators/matmul_op.cc, mul_op.cc, fc)
+# =====================================================================
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    jnp = _jnp()
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+def linear(x, w, b=None):
+    jnp = _jnp()
+    out = jnp.matmul(x, w)
+    if b is not None:
+        out = out + b
+    return out
+
+
+def mul_op(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    """fluid 'mul' op: flatten then 2-D matmul (operators/mul_op.cc)."""
+    jnp = _jnp()
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(np.prod(xs[:x_num_col_dims])), -1))
+    y2 = y.reshape((int(np.prod(ys[:y_num_col_dims])), -1))
+    out = jnp.matmul(x2, y2)
+    return out.reshape(tuple(xs[:x_num_col_dims]) + tuple(ys[y_num_col_dims:]))
+
+
+def bmm(x, y):
+    return _jnp().matmul(x, y)
+
+
+def dot(x, y):
+    return ( x * y ).sum(axis=-1)
+
+
+# =====================================================================
+# conv / pool (reference: operators/conv_op.cc, pool_op.cc; NCHW)
+# =====================================================================
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v), int(v))
+
+
+def _conv_padding(padding, k, stride, dilation, size=2):
+    """Normalize paddle padding spec to lax-compatible form."""
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, int):
+        return [(padding, padding)] * size
+    padding = list(padding)
+    if len(padding) == size:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * size:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                for i in range(size)]
+    raise ValueError(f"bad padding {padding!r}")
+
+
+def conv2d(x, w, stride=1, padding=0, dilation=1, groups=1):
+    """NCHW conv. The MXU eats this: lax.conv_general_dilated → XLA conv."""
+    import jax.lax as lax
+
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    pad = _conv_padding(padding, None, stride, dilation)
+    return lax.conv_general_dilated(
+        x, w,
+        window_strides=stride,
+        padding=pad,
+        rhs_dilation=dilation,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+        preferred_element_type=None,
+    )
+
+
+def conv2d_transpose(x, w, stride=1, padding=0, output_padding=0, dilation=1,
+                     groups=1):
+    import jax.lax as lax
+
+    jnp = _jnp()
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    opad = _pair(output_padding)
+    if isinstance(padding, str):
+        pad = padding.upper()
+        raise NotImplementedError("string padding for conv2d_transpose")
+    padq = _conv_padding(padding, None, stride, dilation)
+    kh = (w.shape[2] - 1) * dilation[0] + 1
+    kw = (w.shape[3] - 1) * dilation[1] + 1
+    # lax transposed conv == conv with lhs dilation
+    pad_t = [(kh - 1 - padq[0][0], kh - 1 - padq[0][1] + opad[0]),
+             (kw - 1 - padq[1][0], kw - 1 - padq[1][1] + opad[1])]
+    # weight is (in, out/groups, kh, kw) in paddle; flip spatial, swap io
+    w_flip = w[:, :, ::-1, ::-1]
+    if groups != 1:
+        ci, co_g = w.shape[0], w.shape[1]
+        w_flip = w_flip.reshape(groups, ci // groups, co_g, *w.shape[2:])
+        w_flip = jnp.swapaxes(w_flip, 1, 2)
+        w_flip = w_flip.reshape(groups * co_g, ci // groups, *w.shape[2:])
+    else:
+        w_flip = jnp.swapaxes(w_flip, 0, 1)
+    return lax.conv_general_dilated(
+        x, w_flip,
+        window_strides=(1, 1),
+        padding=pad_t,
+        lhs_dilation=stride,
+        rhs_dilation=dilation,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
+    import jax.lax as lax
+
+    k = _pair(kernel_size)
+    s = _pair(stride if stride is not None else kernel_size)
+    pad = _conv_padding(padding, k, s, (1, 1))
+    if isinstance(pad, str):
+        padding_cfg = pad
+    else:
+        padding_cfg = [(0, 0), (0, 0)] + list(pad)
+    neg = -_jnp().inf if np.issubdtype(np.dtype(x.dtype), np.floating) else \
+        np.iinfo(np.dtype(x.dtype)).min
+    return lax.reduce_window(
+        x, neg, lax.max,
+        window_dimensions=(1, 1) + k,
+        window_strides=(1, 1) + s,
+        padding=padding_cfg if isinstance(padding_cfg, str) else padding_cfg,
+    )
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True):
+    import jax.lax as lax
+
+    jnp = _jnp()
+    k = _pair(kernel_size)
+    s = _pair(stride if stride is not None else kernel_size)
+    pad = _conv_padding(padding, k, s, (1, 1))
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    if isinstance(pad, str):
+        padding_cfg = pad
+    else:
+        padding_cfg = [(0, 0), (0, 0)] + list(pad)
+    summed = lax.reduce_window(x, 0.0, lax.add, window, strides, padding_cfg)
+    if exclusive and (isinstance(pad, str) or any(p != (0, 0) for p in pad)):
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides,
+                                   padding_cfg)
+        return summed / counts
+    return summed / float(k[0] * k[1])
+
+
+def adaptive_avg_pool2d(x, output_size):
+    jnp = _jnp()
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        x = x.reshape(n, c, oh, h // oh, ow, w // ow)
+        return x.mean(axis=(3, 5))
+    # general case: integral-image approach
+    out = jnp.zeros((n, c, oh, ow), x.dtype)
+    hs = [(int(math.floor(i * h / oh)), int(math.ceil((i + 1) * h / oh)))
+          for i in range(oh)]
+    ws = [(int(math.floor(j * w / ow)), int(math.ceil((j + 1) * w / ow)))
+          for j in range(ow)]
+    rows = []
+    for (h0, h1) in hs:
+        cols = [x[:, :, h0:h1, w0:w1].mean(axis=(2, 3)) for (w0, w1) in ws]
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
+
+
+def adaptive_max_pool2d(x, output_size):
+    jnp = _jnp()
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        x = x.reshape(n, c, oh, h // oh, ow, w // ow)
+        return x.max(axis=(3, 5))
+    raise NotImplementedError("non-divisible adaptive_max_pool2d")
+
+
+# =====================================================================
+# normalization (reference: operators/batch_norm_op.cc, layer_norm_op.cc,
+# group_norm_op.cc, instance_norm_op.cc)
+# =====================================================================
+
+def batch_norm_train(x, gamma, beta, running_mean, running_var, momentum,
+                     epsilon, data_format="NCHW"):
+    """Returns (y, new_mean, new_var, batch_mean, batch_var)."""
+    jnp = _jnp()
+    axes = tuple(i for i in range(x.ndim)
+                 if i != (1 if data_format == "NCHW" else x.ndim - 1))
+    c_axis = 1 if data_format == "NCHW" else x.ndim - 1
+    mean = x.mean(axis=axes)
+    var = ((x - _bshape(mean, x.ndim, c_axis)) ** 2).mean(axis=axes)
+    inv = 1.0 / jnp.sqrt(var + epsilon)
+    y = (x - _bshape(mean, x.ndim, c_axis)) * _bshape(inv * gamma, x.ndim,
+                                                      c_axis)
+    y = y + _bshape(beta, x.ndim, c_axis)
+    new_mean = momentum * running_mean + (1.0 - momentum) * mean
+    new_var = momentum * running_var + (1.0 - momentum) * var
+    return y, new_mean, new_var, mean, var
+
+
+def batch_norm_infer(x, gamma, beta, running_mean, running_var, epsilon,
+                     data_format="NCHW"):
+    jnp = _jnp()
+    c_axis = 1 if data_format == "NCHW" else x.ndim - 1
+    inv = 1.0 / jnp.sqrt(running_var + epsilon)
+    y = (x - _bshape(running_mean, x.ndim, c_axis)) * _bshape(
+        inv * gamma, x.ndim, c_axis) + _bshape(beta, x.ndim, c_axis)
+    return y
+
+
+def _bshape(v, ndim, axis):
+    shape = [1] * ndim
+    shape[axis] = -1
+    return v.reshape(shape)
+
+
+def layer_norm(x, gamma=None, beta=None, epsilon=1e-5, begin_norm_axis=-1):
+    jnp = _jnp()
+    if begin_norm_axis < 0:
+        axes = tuple(range(x.ndim + begin_norm_axis, x.ndim))
+    else:
+        axes = tuple(range(begin_norm_axis, x.ndim))
+    mean = x.mean(axis=axes, keepdims=True)
+    var = ((x - mean) ** 2).mean(axis=axes, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + epsilon)
+    if gamma is not None:
+        y = y * gamma
+    if beta is not None:
+        y = y + beta
+    return y
+
+
+def group_norm(x, num_groups, gamma=None, beta=None, epsilon=1e-5):
+    jnp = _jnp()
+    n, c = x.shape[0], x.shape[1]
+    g = num_groups
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = xg.mean(axis=axes, keepdims=True)
+    var = ((xg - mean) ** 2).mean(axis=axes, keepdims=True)
+    y = ((xg - mean) / jnp.sqrt(var + epsilon)).reshape(x.shape)
+    if gamma is not None:
+        y = y * _bshape(gamma, x.ndim, 1)
+    if beta is not None:
+        y = y + _bshape(beta, x.ndim, 1)
+    return y
+
+
+def instance_norm(x, gamma=None, beta=None, epsilon=1e-5):
+    jnp = _jnp()
+    axes = tuple(range(2, x.ndim))
+    mean = x.mean(axis=axes, keepdims=True)
+    var = ((x - mean) ** 2).mean(axis=axes, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + epsilon)
+    if gamma is not None:
+        y = y * _bshape(gamma, x.ndim, 1)
+    if beta is not None:
+        y = y + _bshape(beta, x.ndim, 1)
+    return y
+
+
+def rms_norm(x, gamma=None, epsilon=1e-6):
+    jnp = _jnp()
+    ms = (x.astype(jnp.float32) ** 2).mean(axis=-1, keepdims=True)
+    y = x * (1.0 / jnp.sqrt(ms + epsilon)).astype(x.dtype)
+    if gamma is not None:
+        y = y * gamma
+    return y
+
+
+# =====================================================================
+# dropout / random (reference: operators/dropout_op.cc)
+# =====================================================================
+
+def dropout(x, key, p=0.5, training=True, mode="upscale_in_train"):
+    import jax
+
+    jnp = _jnp()
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1.0 - p)
+        return x
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+def uniform(key, shape, dtype, min=-1.0, max=1.0):
+    import jax
+
+    return jax.random.uniform(key, shape, dtype=dtype, minval=min, maxval=max)
+
+
+def gaussian(key, shape, dtype, mean=0.0, std=1.0):
+    import jax
+
+    return jax.random.normal(key, shape, dtype=dtype) * std + mean
+
+
+def randint(key, low, high, shape, dtype):
+    import jax
+
+    return jax.random.randint(key, shape, low, high, dtype=dtype)
+
+
+def randperm(key, n, dtype):
+    import jax
+
+    return jax.random.permutation(key, n).astype(dtype)
+
+
+def bernoulli(key, p):
+    import jax
+
+    return jax.random.bernoulli(key, p, None if not hasattr(p, "shape") else
+                                p.shape)
+
+
+# =====================================================================
+# embedding / sparse (reference: operators/lookup_table_op.cc; SelectedRows
+# grads become dense segment-sums on TPU — SURVEY.md §7 hard part 3)
+# =====================================================================
+
+def embedding(ids, table, padding_idx=None):
+    jnp = _jnp()
+    out = jnp.take(table, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+def one_hot(ids, num_classes, dtype=None):
+    import jax
+
+    return jax.nn.one_hot(ids, num_classes, dtype=dtype or _jnp().float32)
+
+
+# =====================================================================
+# reductions (reference: operators/reduce_ops/)
+# =====================================================================
+
+def reduce_sum(x, axis=None, keepdim=False):
+    return x.sum(axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def reduce_mean(x, axis=None, keepdim=False):
+    return x.mean(axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def reduce_max(x, axis=None, keepdim=False):
+    return x.max(axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def reduce_min(x, axis=None, keepdim=False):
+    return x.min(axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def reduce_prod(x, axis=None, keepdim=False):
+    return x.prod(axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    import jax
+
+    return jax.scipy.special.logsumexp(x, axis=_norm_axis(axis),
+                                       keepdims=keepdim)
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis) if len(axis) else None
+    return int(axis)
+
+
+# =====================================================================
+# losses (reference: operators/softmax_with_cross_entropy_op.*,
+# cross_entropy_op.cc, bce_loss_op.cc, ...)
+# =====================================================================
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1,
+                               ignore_index=-100):
+    import jax
+
+    jnp = _jnp()
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -(label * logp).sum(axis=axis, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        nll = -jnp.take_along_axis(
+            logp, jnp.expand_dims(lbl, axis).astype(jnp.int32), axis=axis)
+        if ignore_index is not None and ignore_index >= 0:
+            mask = (jnp.expand_dims(lbl, axis) != ignore_index)
+            nll = jnp.where(mask, nll, 0.0)
+        loss = nll
+    return loss
+
+
+def cross_entropy_loss(logits, label, soft_label=False, reduction="mean",
+                       ignore_index=-100, weight=None, axis=-1,
+                       use_softmax=True):
+    jnp = _jnp()
+    if use_softmax:
+        loss = softmax_with_cross_entropy(logits, label, soft_label, axis,
+                                          ignore_index)
+    else:
+        logp = jnp.log(jnp.clip(logits, 1e-12, None))
+        if soft_label:
+            loss = -(label * logp).sum(axis=axis, keepdims=True)
+        else:
+            lbl = label
+            if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+                lbl = jnp.squeeze(lbl, axis=axis)
+            loss = -jnp.take_along_axis(
+                logp, jnp.expand_dims(lbl, axis).astype(jnp.int32), axis=axis)
+    loss = jnp.squeeze(loss, axis=axis)
+    if weight is not None and not soft_label:
+        lbl = label
+        if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        w = jnp.take(weight, lbl.astype(jnp.int32))
+        loss = loss * w
+        if reduction == "mean":
+            return loss.sum() / jnp.maximum(w.sum(), 1e-12)
+    if reduction == "mean":
+        if ignore_index is not None and ignore_index >= 0 and not soft_label:
+            lbl = label
+            if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+                lbl = jnp.squeeze(lbl, axis=axis)
+            cnt = (lbl != ignore_index).sum()
+            return loss.sum() / jnp.maximum(cnt, 1)
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def bce_loss(x, label):
+    jnp = _jnp()
+    x = jnp.clip(x, 1e-12, 1.0 - 1e-12)
+    return -(label * jnp.log(x) + (1.0 - label) * jnp.log(1.0 - x))
+
+
+def bce_with_logits(logits, label, pos_weight=None):
+    import jax
+
+    jnp = _jnp()
+    logp = jax.nn.log_sigmoid(logits)
+    lognp = jax.nn.log_sigmoid(-logits)
+    if pos_weight is not None:
+        return -(pos_weight * label * logp + (1.0 - label) * lognp)
+    return -(label * logp + (1.0 - label) * lognp)
+
+
+def mse_loss(x, y):
+    return (x - y) ** 2
+
+
+def l1_loss(x, y):
+    return abs(x - y)
+
+
+def smooth_l1(x, y, delta=1.0):
+    jnp = _jnp()
+    d = abs(x - y)
+    return jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+
+
+def nll_loss(logp, label, weight=None, ignore_index=-100):
+    jnp = _jnp()
+    nll = -jnp.take_along_axis(
+        logp, label[:, None].astype(jnp.int32), axis=1)[:, 0]
+    if weight is not None:
+        nll = nll * jnp.take(weight, label.astype(jnp.int32))
+    return nll
+
+
+def kl_div(logp, target):
+    jnp = _jnp()
+    return target * (jnp.log(jnp.clip(target, 1e-12, None)) - logp)
+
+
+def label_smooth(label, epsilon=0.1, prior=None):
+    k = label.shape[-1]
+    if prior is None:
+        return (1.0 - epsilon) * label + epsilon / k
+    return (1.0 - epsilon) * label + epsilon * prior
+
+
+# =====================================================================
+# shape manipulation (reference: reshape_op, transpose_op, concat_op,
+# split_op, stack_op, squeeze/unsqueeze, flatten, expand, tile, pad, ...)
+# =====================================================================
+
+def reshape(x, shape):
+    shape = [int(s) for s in shape]
+    return x.reshape(shape)
+
+
+def transpose(x, perm):
+    return _jnp().transpose(x, perm)
+
+
+def concat(xs, axis=0):
+    return _jnp().concatenate(xs, axis=int(axis))
+
+
+def split(x, num_or_sections, axis=0):
+    jnp = _jnp()
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if any(s in (-1, None) for s in sections):
+        known = sum(s for s in sections if s not in (-1, None))
+        sections = [total - known if s in (-1, None) else s for s in sections]
+    idx = np.cumsum(sections)[:-1].tolist()
+    return jnp.split(x, idx, axis=axis)
+
+
+def stack(xs, axis=0):
+    return _jnp().stack(xs, axis=int(axis))
+
+
+def unstack(x, axis=0):
+    jnp = _jnp()
+    return [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)]
+
+
+def squeeze(x, axis=None):
+    jnp = _jnp()
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axes = tuple(a for a in axis if x.shape[a] == 1)
+        return jnp.squeeze(x, axes) if axes else x
+    return jnp.squeeze(x, axis) if x.shape[axis] == 1 else x
+
+
+def unsqueeze(x, axis):
+    jnp = _jnp()
+    if isinstance(axis, (list, tuple)):
+        for a in sorted(axis):
+            x = jnp.expand_dims(x, a)
+        return x
+    return jnp.expand_dims(x, int(axis))
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    shape = list(x.shape)
+    n = len(shape)
+    if start_axis < 0:
+        start_axis += n
+    if stop_axis < 0:
+        stop_axis += n
+    new = shape[:start_axis] + [int(np.prod(shape[start_axis:stop_axis + 1]) or 1)] + shape[stop_axis + 1:]
+    return x.reshape(new)
+
+
+def expand(x, shape):
+    jnp = _jnp()
+    shape = list(shape)
+    # paddle: -1 means keep dim
+    xshape = [1] * (len(shape) - x.ndim) + list(x.shape)
+    tgt = [xs if s in (-1, None) else int(s) for s, xs in zip(shape, xshape)]
+    return jnp.broadcast_to(x.reshape(xshape), tgt)
+
+
+def expand_as(x, y):
+    return _jnp().broadcast_to(x, y.shape)
+
+
+def tile(x, repeat_times):
+    return _jnp().tile(x, tuple(int(r) for r in repeat_times))
+
+
+def slice_op(x, axes, starts, ends):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        dim = x.shape[ax]
+        st = max(st + dim, 0) if st < 0 else min(st, dim)
+        en = max(en + dim, 0) if en < 0 else min(en, dim)
+        idx[ax] = slice(int(st), int(en))
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(int(st), int(en), int(sd))
+    return x[tuple(idx)]
+
+
+def gather(x, index, axis=0):
+    return _jnp().take(x, index.astype(_jnp().int32), axis=int(axis))
+
+
+def gather_nd(x, index):
+    jnp = _jnp()
+    idx = tuple(jnp.moveaxis(index, -1, 0).astype(jnp.int32))
+    return x[idx]
+
+
+def scatter(x, index, updates, overwrite=True):
+    idx = index.astype(_jnp().int32)
+    if overwrite:
+        return x.at[idx].set(updates)
+    return x.at[idx].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    jnp = _jnp()
+    idx = tuple(jnp.moveaxis(index, -1, 0).astype(jnp.int32))
+    return x.at[idx].add(updates)
+
+
+def index_select(x, index, axis=0):
+    return _jnp().take(x, index.astype(_jnp().int32), axis=int(axis))
+
+
+def index_sample(x, index):
+    return _jnp().take_along_axis(x, index.astype(_jnp().int32), axis=1)
+
+
+def masked_select(x, mask):
+    # dynamic output shape: eager-only (not jittable) — documented limitation
+    return x[mask]
+
+
+def where(cond, x, y):
+    return _jnp().where(cond, x, y)
+
+
+def pad(x, paddings, mode="constant", value=0.0):
+    jnp = _jnp()
+    if len(paddings) == 2 * x.ndim:
+        pads = [(int(paddings[2 * i]), int(paddings[2 * i + 1]))
+                for i in range(x.ndim)]
+    else:
+        # paddle nn.functional.pad NCHW convention: pad last dims
+        k = len(paddings) // 2
+        pads = [(0, 0)] * (x.ndim - k) + [
+            (int(paddings[2 * i]), int(paddings[2 * i + 1]))
+            for i in range(k)]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pads, mode="constant", constant_values=value)
+    return jnp.pad(x, pads, mode=jmode)
+
+
+def roll(x, shifts, axis=None):
+    return _jnp().roll(x, shifts, axis)
+
+
+def flip(x, axis):
+    return _jnp().flip(x, axis)
+
+
+def broadcast_to(x, shape):
+    return _jnp().broadcast_to(x, tuple(int(s) for s in shape))
+
+
+def cumsum(x, axis=None):
+    jnp = _jnp()
+    if axis is None:
+        return jnp.cumsum(x.reshape(-1))
+    return jnp.cumsum(x, axis=int(axis))
+
+
+def cumprod(x, dim=None):
+    return _jnp().cumprod(x, axis=dim)
+
+
+def diag(x, offset=0, padding_value=0.0):
+    jnp = _jnp()
+    if x.ndim == 1 and padding_value != 0.0:
+        n = x.shape[0] + abs(offset)
+        base = jnp.full((n, n), padding_value, x.dtype)
+        return base + jnp.diag(x, offset) - jnp.diag(
+            jnp.full((x.shape[0],), padding_value, x.dtype), offset)
+    return jnp.diag(x, offset)
+
+
+def meshgrid(*xs):
+    return _jnp().meshgrid(*xs, indexing="ij")
+
+
+# =====================================================================
+# search / sort (reference: operators/arg_max_op, top_k, argsort)
+# =====================================================================
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    jnp = _jnp()
+    out = jnp.argmax(x, axis=axis)
+    if keepdim and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(dtype)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    jnp = _jnp()
+    out = jnp.argmin(x, axis=axis)
+    if keepdim and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(dtype)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    import jax
+
+    jnp = _jnp()
+    if axis != -1 and axis != x.ndim - 1:
+        xs = jnp.moveaxis(x, axis, -1)
+        v, i = topk(xs, k, -1, largest, sorted)
+        return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis)
+    if largest:
+        v, i = jax.lax.top_k(x, k)
+    else:
+        v, i = jax.lax.top_k(-x, k)
+        v = -v
+    return v, i.astype(jnp.int64)
+
+
+def argsort(x, axis=-1, descending=False):
+    jnp = _jnp()
+    idx = jnp.argsort(-x if descending else x, axis=axis)
+    return idx.astype(jnp.int64)
+
+
+def sort(x, axis=-1, descending=False):
+    jnp = _jnp()
+    s = jnp.sort(x, axis=axis)
+    return -jnp.sort(-x, axis=axis) if descending else s
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False):
+    jnp = _jnp()
+    return jnp.unique(x, return_index=return_index,
+                      return_inverse=return_inverse,
+                      return_counts=return_counts)
+
+
+def nonzero(x):
+    return _jnp().stack(_jnp().nonzero(x), axis=-1)
+
+
+def searchsorted(sorted_seq, values, right=False):
+    return _jnp().searchsorted(sorted_seq, values,
+                               side="right" if right else "left")
+
+
+# =====================================================================
+# linalg / misc math
+# =====================================================================
+
+def norm(x, p=2, axis=None, keepdim=False):
+    jnp = _jnp()
+    if p == 2 and axis is None:
+        return jnp.sqrt((x.astype(jnp.float32) ** 2).sum()).astype(x.dtype)
+    if p == "fro" or p == 2:
+        return jnp.sqrt((x ** 2).sum(axis=axis, keepdims=keepdim))
+    if p == 1:
+        return abs(x).sum(axis=axis, keepdims=keepdim)
+    if p == np.inf or p == float("inf"):
+        return abs(x).max(axis=axis, keepdims=keepdim)
+    return (abs(x) ** p).sum(axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+def clip_by_norm(x, max_norm):
+    jnp = _jnp()
+    n = jnp.sqrt((x ** 2).sum())
+    return jnp.where(n > max_norm, x * (max_norm / jnp.maximum(n, 1e-12)), x)
+
+
+def t(x):
+    jnp = _jnp()
+    if x.ndim < 2:
+        return x
+    return jnp.swapaxes(x, -1, -2)
+
+
+def tril(x, diagonal=0):
+    return _jnp().tril(x, diagonal)
+
+
+def triu(x, diagonal=0):
+    return _jnp().triu(x, diagonal)
+
+
+def einsum(eq, *xs):
+    return _jnp().einsum(eq, *xs)
+
+
+def multiplex(inputs, index):
+    jnp = _jnp()
+    stacked = jnp.stack(inputs, axis=0)  # (K, N, ...)
+    idx = index.reshape(-1).astype(jnp.int32)
+    n = stacked.shape[1]
+    return stacked[idx, jnp.arange(n)]
+
+
+# =====================================================================
+# vision-ish ops (reference: operators/interpolate_op.cc, grid_sampler...)
+# =====================================================================
+
+def interpolate_nearest(x, out_hw):
+    jnp = _jnp()
+    n, c, h, w = x.shape
+    oh, ow = out_hw
+    ih = (jnp.arange(oh) * (h / oh)).astype(jnp.int32)
+    iw = (jnp.arange(ow) * (w / ow)).astype(jnp.int32)
+    return x[:, :, ih][:, :, :, iw]
+
+
+def interpolate_bilinear(x, out_hw, align_corners=False):
+    import jax
+
+    jnp = _jnp()
+    n, c, h, w = x.shape
+    oh, ow = out_hw
+    if align_corners and oh > 1 and ow > 1:
+        ys = jnp.linspace(0.0, h - 1.0, oh)
+        xs = jnp.linspace(0.0, w - 1.0, ow)
+    else:
+        ys = (jnp.arange(oh) + 0.5) * (h / oh) - 0.5
+        xs = (jnp.arange(ow) + 0.5) * (w / ow) - 0.5
+    y0 = jnp.clip(jnp.floor(ys), 0, h - 1).astype(jnp.int32)
+    x0 = jnp.clip(jnp.floor(xs), 0, w - 1).astype(jnp.int32)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    wy = jnp.clip(ys - y0, 0.0, 1.0)
+    wx = jnp.clip(xs - x0, 0.0, 1.0)
+    top = x[:, :, y0][:, :, :, x0] * (1 - wx) + x[:, :, y0][:, :, :, x1] * wx
+    bot = x[:, :, y1][:, :, :, x0] * (1 - wx) + x[:, :, y1][:, :, :, x1] * wx
+    return top * (1 - wy[:, None]) + bot * wy[:, None]
+
+
+# =====================================================================
+# sequence ops — LoD semantics as segment ops over a packed axis
+# (reference: operators/sequence_ops/; SURVEY.md §7 hard part 1: LoD → host
+# metadata + segment reductions, XLA-friendly)
+# =====================================================================
+
+def segment_sum(data, segment_ids, num_segments):
+    import jax
+
+    return jax.ops.segment_sum(data, segment_ids, num_segments)
+
+
+def sequence_pool(data, segment_ids, num_segments, pool_type="SUM"):
+    import jax
+
+    jnp = _jnp()
+    pool_type = pool_type.upper()
+    if pool_type == "SUM":
+        return jax.ops.segment_sum(data, segment_ids, num_segments)
+    if pool_type == "AVERAGE":
+        s = jax.ops.segment_sum(data, segment_ids, num_segments)
+        cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
+                                  segment_ids, num_segments)
+        return s / jnp.maximum(cnt, 1.0)[:, None]
+    if pool_type == "MAX":
+        return jax.ops.segment_max(data, segment_ids, num_segments)
+    if pool_type == "MIN":
+        return jax.ops.segment_min(data, segment_ids, num_segments)
+    raise ValueError(pool_type)

@@ -1,0 +1,403 @@
+"""Optimizers: paddle.optimizer parity.
+
+Reference parity: python/paddle/optimizer/ (new-style Adam/AdamW/...) and
+operators/optimizers/*.cc kernels (sgd_op, momentum_op, adam_op, lamb_op,
+lars_momentum_op). TPU-native design: each update rule is a pure jitted jnp
+function over (param, grad, slots); `step()` walks parameters and rebinds
+buffers — XLA compiles one fused update per (shape, dtype) signature. The
+same rules are reused by the static-graph optimizer ops (fluid/optimizer.py).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Parameter
+from . import lr as lr_sched
+from .lr import LRScheduler
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+    "Adadelta", "RMSProp", "Lamb", "lr",
+]
+
+lr = lr_sched
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted(fn):
+    import jax
+
+    return jax.jit(fn)
+
+
+def _instance_jit(obj, name, make_fn):
+    """Cache a jitted update rule on the optimizer instance so repeated
+    steps hit the XLA compile cache instead of retracing."""
+    cached = obj.__dict__.get(name)
+    if cached is None:
+        import jax
+
+        cached = jax.jit(make_fn())
+        obj.__dict__[name] = cached
+    return cached
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameters = list(parameters) if parameters is not None else []
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._accumulators = {}  # id(param) -> {slot: jax array}
+        self._step_count = 0
+
+    # -------------- lr --------------
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        self._lr = float(value)
+
+    def _lr_for(self, p):
+        base = self.get_lr()
+        mult = getattr(p, "optimize_attr", None)
+        if mult:
+            base = base * mult.get("learning_rate", 1.0)
+        return base
+
+    # -------------- state --------------
+    def _slots(self, p, names_and_inits):
+        key = id(p)
+        if key not in self._accumulators:
+            jnp = _jnp()
+            self._accumulators[key] = {
+                name: (jnp.zeros_like(p._data) if init == "zeros_like"
+                       else jnp.zeros(init[0], init[1]))
+                for name, init in names_and_inits.items()}
+        return self._accumulators[key]
+
+    def state_dict(self):
+        out = {"_step_count": self._step_count}
+        for i, p in enumerate(self._parameters):
+            slots = self._accumulators.get(id(p))
+            if slots:
+                for k, v in slots.items():
+                    out[f"{p.name or i}__{k}"] = Tensor._wrap(v)
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = state.get("_step_count", 0)
+        for i, p in enumerate(self._parameters):
+            prefix = f"{p.name or i}__"
+            for k in list(state.keys()):
+                if isinstance(k, str) and k.startswith(prefix):
+                    slot = k[len(prefix):]
+                    v = state[k]
+                    arr = v._data if isinstance(v, Tensor) else _jnp().asarray(
+                        np.asarray(v))
+                    self._accumulators.setdefault(id(p), {})[slot] = arr
+        if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+
+    set_dict = set_state_dict
+
+    # -------------- step --------------
+    def _collect(self):
+        pg = []
+        for p in self._parameters:
+            if p.stop_gradient or not getattr(p, "trainable", True):
+                continue
+            g = p.grad
+            if g is None:
+                continue
+            pg.append((p, g))
+        if self._grad_clip is not None:
+            pg = self._grad_clip(pg)
+        return pg
+
+    def _decay_value(self, p):
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        if hasattr(wd, "_coeff"):  # fluid regularizer object
+            return float(wd._coeff)
+        return float(wd)
+
+    def step(self):
+        self._step_count += 1
+        for p, g in self._collect():
+            self._update_param(p, g)
+
+    def _update_param(self, p, g):
+        raise NotImplementedError
+
+    @property
+    def _parameter_list(self):
+        return self._parameters
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameters:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """dygraph convenience: backward already done by the user or here."""
+        if loss._node is not None and all(
+                p.grad is None for p in self._parameters
+                if not p.stop_gradient):
+            loss.backward()
+        self.step()
+        return None, None
+
+
+# -------------------- concrete rules --------------------
+
+def _sgd_rule(p, g, lrv, wd):
+    return p - lrv * (g + wd * p)
+
+
+def _momentum_rule(p, g, vel, lrv, mu, wd, use_nesterov):
+    g = g + wd * p
+    v_new = mu * vel + g
+    if use_nesterov:
+        p_new = p - lrv * (g + mu * v_new)
+    else:
+        p_new = p - lrv * v_new
+    return p_new, v_new
+
+
+def _adam_rule(p, g, m, v, lrv, b1, b2, eps, t, wd, decoupled):
+    jnp = _jnp()
+    if not decoupled and wd:
+        g = g + wd * p
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * (g * g)
+    mhat = m_new / (1 - b1 ** t)
+    vhat = v_new / (1 - b2 ** t)
+    upd = mhat / (jnp.sqrt(vhat) + eps)
+    if decoupled and wd:
+        upd = upd + wd * p
+    return p - lrv * upd, m_new, v_new
+
+
+def _lamb_rule(p, g, m, v, lrv, b1, b2, eps, t, wd):
+    jnp = _jnp()
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * (g * g)
+    mhat = m_new / (1 - b1 ** t)
+    vhat = v_new / (1 - b2 ** t)
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+    w_norm = jnp.sqrt((p * p).sum())
+    r_norm = jnp.sqrt((r * r).sum())
+    trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    return p - lrv * trust * r, m_new, v_new
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _update_param(self, p, g):
+        fn = _jitted(_sgd_rule)
+        p._data = fn(p._data, g._data.astype(p._data.dtype),
+                     self._lr_for(p), self._decay_value(p))
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _update_param(self, p, g):
+        slots = self._slots(p, {"velocity": "zeros_like"})
+        fn = _instance_jit(self, "_jit_rule", lambda: functools.partial(
+            _momentum_rule, use_nesterov=self._use_nesterov))
+        p._data, slots["velocity"] = fn(
+            p._data, g._data.astype(p._data.dtype), slots["velocity"],
+            self._lr_for(p), self._momentum, self._decay_value(p))
+
+
+class Adam(Optimizer):
+    _decoupled_wd = False
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _update_param(self, p, g):
+        slots = self._slots(p, {"moment1": "zeros_like",
+                                "moment2": "zeros_like"})
+        fn = _instance_jit(self, "_jit_rule", lambda: functools.partial(
+            _adam_rule, decoupled=self._decoupled_wd))
+        p._data, slots["moment1"], slots["moment2"] = fn(
+            p._data, g._data.astype(p._data.dtype), slots["moment1"],
+            slots["moment2"], self._lr_for(p), self._beta1, self._beta2,
+            self._eps, float(self._step_count), self._decay_value(p))
+
+
+class AdamW(Adam):
+    _decoupled_wd = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 apply_decay_param_fun=None, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decay_value(self, p):
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            return 0.0
+        return super()._decay_value(p)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _update_param(self, p, g):
+        jnp = _jnp()
+        slots = self._slots(p, {"moment": "zeros_like",
+                                "inf_norm": "zeros_like"})
+
+        def rule(pp, gg, m, u, lrv, t):
+            m_new = self._beta1 * m + (1 - self._beta1) * gg
+            u_new = jnp.maximum(self._beta2 * u, jnp.abs(gg))
+            p_new = pp - lrv / (1 - self._beta1 ** t) * m_new / (
+                u_new + self._eps)
+            return p_new, m_new, u_new
+
+        fn = _instance_jit(self, "_jit_rule", lambda: rule)
+        p._data, slots["moment"], slots["inf_norm"] = fn(
+            p._data, g._data.astype(p._data.dtype), slots["moment"],
+            slots["inf_norm"], self._lr_for(p), float(self._step_count))
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_param(self, p, g):
+        jnp = _jnp()
+        slots = self._slots(p, {"moment": "zeros_like"})
+
+        def rule(pp, gg, acc, lrv):
+            acc_new = acc + gg * gg
+            return pp - lrv * gg / (jnp.sqrt(acc_new) + self._eps), acc_new
+
+        fn = _instance_jit(self, "_jit_rule", lambda: rule)
+        p._data, slots["moment"] = fn(
+            p._data, g._data.astype(p._data.dtype), slots["moment"],
+            self._lr_for(p))
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps, self._rho = epsilon, rho
+
+    def _update_param(self, p, g):
+        jnp = _jnp()
+        slots = self._slots(p, {"avg_sq_grad": "zeros_like",
+                                "avg_sq_upd": "zeros_like"})
+
+        def rule(pp, gg, eg, eu, lrv):
+            eg_new = self._rho * eg + (1 - self._rho) * gg * gg
+            upd = jnp.sqrt(eu + self._eps) / jnp.sqrt(
+                eg_new + self._eps) * gg
+            eu_new = self._rho * eu + (1 - self._rho) * upd * upd
+            return pp - lrv * upd, eg_new, eu_new
+
+        fn = _instance_jit(self, "_jit_rule", lambda: rule)
+        p._data, slots["avg_sq_grad"], slots["avg_sq_upd"] = fn(
+            p._data, g._data.astype(p._data.dtype), slots["avg_sq_grad"],
+            slots["avg_sq_upd"], self._lr_for(p))
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _update_param(self, p, g):
+        jnp = _jnp()
+        slots = self._slots(p, {"mean_square": "zeros_like",
+                                "mean_grad": "zeros_like",
+                                "momentum": "zeros_like"})
+
+        def rule(pp, gg, ms, mg, mom, lrv):
+            ms_new = self._rho * ms + (1 - self._rho) * gg * gg
+            if self._centered:
+                mg_new = self._rho * mg + (1 - self._rho) * gg
+                denom = jnp.sqrt(ms_new - mg_new * mg_new + self._eps)
+            else:
+                mg_new = mg
+                denom = jnp.sqrt(ms_new + self._eps)
+            mom_new = self._momentum * mom + lrv * gg / denom
+            return pp - mom_new, ms_new, mg_new, mom_new
+
+        fn = _instance_jit(self, "_jit_rule", lambda: rule)
+        (p._data, slots["mean_square"], slots["mean_grad"],
+         slots["momentum"]) = fn(
+            p._data, g._data.astype(p._data.dtype), slots["mean_square"],
+            slots["mean_grad"], slots["momentum"], self._lr_for(p))
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_param(self, p, g):
+        slots = self._slots(p, {"moment1": "zeros_like",
+                                "moment2": "zeros_like"})
+        wd = self._decay_value(p)
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        fn = _jitted(_lamb_rule)
+        p._data, slots["moment1"], slots["moment2"] = fn(
+            p._data, g._data.astype(p._data.dtype), slots["moment1"],
+            slots["moment2"], self._lr_for(p), self._beta1, self._beta2,
+            self._eps, float(self._step_count), wd)

@@ -1,0 +1,87 @@
+"""Profiler.
+
+Reference parity: platform/profiler.h:126 RecordEvent RAII +
+fluid/profiler.py (start_profiler/stop_profiler/profiler context). TPU-native
+design: host annotations forward to jax.profiler.TraceAnnotation; device
+timelines come from the XLA/XPlane trace (`start_profiler` starts a
+jax.profiler trace whose output loads in TensorBoard / Perfetto — the
+chrome://tracing equivalent of platform/device_tracer.cc).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+_events = []
+_trace_dir = None
+_active = False
+
+
+class RecordEvent:
+    """platform/profiler.h:126 parity; also usable as a decorator."""
+
+    def __init__(self, name, event_type="op"):
+        self.name = name
+        self._ann = None
+        self._t0 = None
+
+    def __enter__(self):
+        import jax
+
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        _events.append((self.name, dt))
+        self._ann.__exit__(*exc)
+        return False
+
+
+def start_profiler(state="All", tracer_option="Default",
+                   trace_dir="/tmp/paddle_tpu_trace"):
+    global _trace_dir, _active
+    import jax
+
+    _trace_dir = trace_dir
+    os.makedirs(trace_dir, exist_ok=True)
+    jax.profiler.start_trace(trace_dir)
+    _active = True
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    global _active
+    import jax
+
+    if _active:
+        jax.profiler.stop_trace()
+        _active = False
+    return summary()
+
+
+def reset_profiler():
+    _events.clear()
+
+
+def summary():
+    agg = {}
+    for name, dt in _events:
+        tot, cnt = agg.get(name, (0.0, 0))
+        agg[name] = (tot + dt, cnt + 1)
+    lines = ["Event                          Calls    Total(ms)   Avg(ms)"]
+    for name, (tot, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
+        lines.append(f"{name:<30} {cnt:>6} {tot * 1e3:>11.3f} "
+                     f"{tot / cnt * 1e3:>9.3f}")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

@@ -1,0 +1,27 @@
+"""fluid/regularizer.py parity: L1Decay/L2Decay applied by optimizers."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+
+class L2Decay(WeightDecayRegularizer):
+    def grad_term(self, p_raw):
+        return self._coeff * p_raw
+
+
+class L1Decay(WeightDecayRegularizer):
+    def grad_term(self, p_raw):
+        import jax.numpy as jnp
+
+        return self._coeff * jnp.sign(p_raw)
+
+
+L2DecayRegularizer = L2Decay
+L1DecayRegularizer = L1Decay

@@ -1,0 +1,49 @@
+"""Sparse support.
+
+Reference parity: framework/selected_rows.h:32 — SelectedRows {rows, value}
+used for embedding gradients. TPU-native design (SURVEY.md §7 hard part 3):
+XLA has no sparse tensors; SelectedRows is a host-side (indices, values)
+pair whose reduction lowers to segment-sum. Provided for API parity and for
+the parameter-server sparse path.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class SelectedRows:
+    def __init__(self, rows, values, height):
+        import jax.numpy as jnp
+
+        self.rows = jnp.asarray(rows, dtype=jnp.int32)
+        self.values = values._data if isinstance(values, Tensor) else values
+        self.height = int(height)
+
+    def to_dense(self):
+        import jax
+
+        import jax.numpy as jnp
+
+        dense = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                          self.values.dtype)
+        return Tensor._wrap(dense.at[self.rows].add(self.values))
+
+    def merge(self):
+        """Merge duplicate rows (selected_rows_functor MergeAdd parity)."""
+        import jax
+
+        import jax.numpy as jnp
+
+        uniq, inv = jnp.unique(self.rows, return_inverse=True,
+                               size=self.rows.shape[0],
+                               fill_value=self.height)
+        merged = jax.ops.segment_sum(self.values, inv, uniq.shape[0])
+        keep = uniq < self.height
+        return SelectedRows(np.asarray(uniq)[np.asarray(keep)],
+                            merged[np.asarray(keep)], self.height)
+
+
+def sparse_coo_tensor(indices, values, shape, dtype=None):
+    raise NotImplementedError("COO tensors land with the sparse op set")

@@ -1,0 +1,4 @@
+"""paddle.static.nn: static layer sugar (reference python/paddle/static/nn)."""
+from ...fluid.layers.nn import (batch_norm, conv2d, embedding, fc,  # noqa
+                                layer_norm)
+from ...fluid.layers.nn import pool2d  # noqa: F401

@@ -1,0 +1,37 @@
+from .ops import *  # noqa: F401,F403
+from . import ops as _ops
+
+
+def _patch_tensor_methods():
+    """Attach functional ops as Tensor methods, mirroring the reference's
+    monkey_patch_varbase (python/paddle/fluid/dygraph/varbase_patch_methods.py)."""
+    from ..core.tensor import Tensor
+
+    method_names = [
+        "exp", "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "square",
+        "abs", "floor", "ceil", "round", "sin", "cos", "tan", "tanh", "erf",
+        "sign", "reciprocal", "expm1", "isnan", "isinf", "isfinite",
+        "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+        "mod", "pow", "maximum", "minimum", "scale", "clip",
+        "sum", "mean", "max", "min", "prod", "logsumexp", "all", "any",
+        "std", "var", "median", "cumsum", "cumprod",
+        "matmul", "mm", "bmm", "dot", "t", "transpose", "norm", "dist",
+        "tril", "triu", "trace",
+        "reshape", "concat", "split", "chunk", "squeeze", "unsqueeze",
+        "flatten", "expand", "expand_as", "broadcast_to", "tile",
+        "gather", "gather_nd", "scatter", "index_select", "masked_select",
+        "roll", "flip", "unbind", "repeat_interleave", "moveaxis",
+        "swapaxes", "take_along_axis",
+        "argmax", "argmin", "topk", "argsort", "sort", "unique", "nonzero",
+        "equal", "not_equal", "less_than", "less_equal", "greater_than",
+        "greater_equal", "equal_all", "allclose", "isclose",
+        "logical_and", "logical_or", "logical_xor", "logical_not",
+        "numel", "rank", "one_hot", "where", "kthvalue",
+    ]
+    for name in method_names:
+        fn = getattr(_ops, name, None)
+        if fn is not None and not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+
+
+_patch_tensor_methods()

@@ -1,0 +1,925 @@
+"""Eager functional API: paddle.* tensor functions.
+
+Reference parity: python/paddle/tensor/ (7.7k LoC op wrappers) and the
+generated core.ops.* entry points (pybind/op_function_generator.cc:204).
+TPU-native design: each function unwraps Tensors, runs the pure-jnp kernel
+through the autograd tape (core/tensor.py apply_op), and wraps results.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import random as _random
+from ..core.dtypes import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor, apply_op, to_tensor
+from ..ops import kernels as K
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _t(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(x, dtype=dtype)
+
+
+def _op(name, fn, *tensors, n_outputs=1):
+    return apply_op(name, fn, [_t(x) for x in tensors], n_outputs=n_outputs)
+
+
+# ----------------------------- creation -----------------------------
+
+def zeros(shape, dtype=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor._wrap(_jnp().zeros(_shape(shape), dt))
+
+
+def ones(shape, dtype=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor._wrap(_jnp().ones(_shape(shape), dt))
+
+
+def full(shape, fill_value, dtype=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor._wrap(_jnp().full(_shape(shape), fill_value, dt))
+
+
+def zeros_like(x, dtype=None):
+    return Tensor._wrap(_jnp().zeros_like(_t(x)._data,
+                                          dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None):
+    return Tensor._wrap(_jnp().ones_like(_t(x)._data,
+                                         dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None):
+    return Tensor._wrap(_jnp().full_like(_t(x)._data, fill_value,
+                                         dtype=convert_dtype(dtype)))
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    dt = convert_dtype(dtype)
+    if end is None:
+        start, end = 0, start
+    if dt is None:
+        dt = np.int64 if all(
+            isinstance(v, (int, np.integer)) for v in (start, end, step)) \
+            else get_default_dtype()
+    return Tensor._wrap(_jnp().arange(start, end, step, dtype=dt))
+
+
+def linspace(start, stop, num, dtype=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor._wrap(_jnp().linspace(start, stop, int(num), dtype=dt))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor._wrap(_jnp().eye(num_rows, num_columns, dtype=dt))
+
+
+def empty(shape, dtype=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def diag(x, offset=0, padding_value=0.0):
+    return _op("diag", lambda a: K.diag(a, offset, padding_value), x)
+
+
+def clone(x):
+    return _t(x).clone()
+
+
+def assign(x, output=None):
+    src = _t(x)
+    if output is not None:
+        output.set_value(src)
+        return output
+    return src.clone()
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data if isinstance(s, Tensor) else s) for s in shape)
+
+
+# ----------------------------- random -----------------------------
+
+def rand(shape, dtype=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor._wrap(K.uniform(_random.next_key(), _shape(shape), dt, 0.0,
+                                  1.0))
+
+
+def randn(shape, dtype=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor._wrap(K.gaussian(_random.next_key(), _shape(shape), dt))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor._wrap(K.uniform(_random.next_key(), _shape(shape), dt, min,
+                                  max))
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    dt = get_default_dtype()
+    return Tensor._wrap(K.gaussian(_random.next_key(), _shape(shape), dt,
+                                   mean, std))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return Tensor._wrap(K.randint(_random.next_key(), low, high,
+                                  _shape(shape), convert_dtype(dtype)))
+
+
+def randperm(n, dtype="int64"):
+    return Tensor._wrap(K.randperm(_random.next_key(), n,
+                                   convert_dtype(dtype)))
+
+
+def bernoulli(x):
+    import jax
+
+    t = _t(x)
+    return Tensor._wrap(jax.random.bernoulli(
+        _random.next_key(), t._data, t._data.shape).astype(t._data.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    import jax
+
+    t = _t(x)
+    p = t._data / t._data.sum(axis=-1, keepdims=True)
+    key = _random.next_key()
+    logits = _jnp().log(_jnp().clip(p, 1e-30, None))
+    if replacement or num_samples == 1:
+        out = jax.random.categorical(key, logits,
+                                     shape=(num_samples,) + t._data.shape[:-1])
+        out = _jnp().moveaxis(out, 0, -1)
+    else:
+        g = -_jnp().log(-_jnp().log(
+            jax.random.uniform(key, t._data.shape)))
+        _, out = K.topk(logits + g, num_samples)
+    return Tensor._wrap(out.astype(_jnp().int64))
+
+
+# ----------------------------- math -----------------------------
+
+def _unary(name, fn):
+    def f(x, name_=None, **kw):
+        return _op(name, fn, x)
+
+    f.__name__ = name
+    return f
+
+
+def _unary_attr(name, fn):
+    def f(x, *args, **kw):
+        return _op(name, lambda a: fn(a, *args, **kw), x)
+
+    f.__name__ = name
+    return f
+
+
+exp = _unary("exp", lambda x: _jnp().exp(x))
+log = _unary("log", lambda x: _jnp().log(x))
+log2 = _unary("log2", lambda x: _jnp().log2(x))
+log10 = _unary("log10", lambda x: _jnp().log10(x))
+log1p = _unary("log1p", lambda x: _jnp().log1p(x))
+sqrt = _unary("sqrt", lambda x: _jnp().sqrt(x))
+rsqrt = _unary("rsqrt", lambda x: 1.0 / _jnp().sqrt(x))
+square = _unary("square", lambda x: x * x)
+abs = _unary("abs", lambda x: _jnp().abs(x))  # noqa: A001
+floor = _unary("floor", lambda x: _jnp().floor(x))
+ceil = _unary("ceil", lambda x: _jnp().ceil(x))
+round = _unary("round", lambda x: _jnp().round(x))  # noqa: A001
+sin = _unary("sin", lambda x: _jnp().sin(x))
+cos = _unary("cos", lambda x: _jnp().cos(x))
+tan = _unary("tan", lambda x: _jnp().tan(x))
+asin = _unary("asin", lambda x: _jnp().arcsin(x))
+acos = _unary("acos", lambda x: _jnp().arccos(x))
+atan = _unary("atan", lambda x: _jnp().arctan(x))
+sinh = _unary("sinh", lambda x: _jnp().sinh(x))
+cosh = _unary("cosh", lambda x: _jnp().cosh(x))
+tanh = _unary("tanh", lambda x: _jnp().tanh(x))
+erf = _unary("erf", lambda x: __import__("jax").scipy.special.erf(x))
+sign = _unary("sign", lambda x: _jnp().sign(x))
+reciprocal = _unary("reciprocal", lambda x: 1.0 / x)
+neg = _unary("neg", lambda x: -x)
+logit = _unary("logit", lambda x: _jnp().log(x / (1.0 - x)))
+expm1 = _unary("expm1", lambda x: _jnp().expm1(x))
+digamma = _unary("digamma", lambda x: __import__("jax").scipy.special.digamma(x))
+lgamma = _unary("lgamma", lambda x: __import__("jax").scipy.special.gammaln(x))
+trunc = _unary("trunc", lambda x: _jnp().trunc(x))
+frac = _unary("frac", lambda x: x - _jnp().trunc(x))
+isnan = _unary("isnan", lambda x: _jnp().isnan(x))
+isinf = _unary("isinf", lambda x: _jnp().isinf(x))
+isfinite = _unary("isfinite", lambda x: _jnp().isfinite(x))
+
+
+def add(x, y, name=None):
+    return _t(x) + y
+
+
+def subtract(x, y, name=None):
+    return _t(x) - y
+
+
+def multiply(x, y, name=None):
+    return _t(x) * y
+
+
+def divide(x, y, name=None):
+    return _t(x) / y
+
+
+def floor_divide(x, y, name=None):
+    return _t(x) // y
+
+
+def remainder(x, y, name=None):
+    return _t(x) % y
+
+
+mod = remainder
+
+
+def pow(x, y, name=None):  # noqa: A001
+    return _t(x) ** (y if not isinstance(y, Tensor) else y)
+
+
+def maximum(x, y, name=None):
+    return _op("maximum", K.maximum, x, y)
+
+
+def minimum(x, y, name=None):
+    return _op("minimum", K.minimum, x, y)
+
+
+def fmax(x, y, name=None):
+    return _op("fmax", lambda a, b: _jnp().fmax(a, b), x, y)
+
+
+def fmin(x, y, name=None):
+    return _op("fmin", lambda a, b: _jnp().fmin(a, b), x, y)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = _op("scale", lambda a: K.scale(a, scale, bias, bias_after_scale), x)
+    if act:
+        out = globals()[act](out)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = float(min) if isinstance(min, (int, float)) else (
+        min._data if isinstance(min, Tensor) else min)
+    mx = float(max) if isinstance(max, (int, float)) else (
+        max._data if isinstance(max, Tensor) else max)
+    return _op("clip", lambda a: K.clip(a, mn, mx), x)
+
+
+def add_n(inputs):
+    if isinstance(inputs, Tensor):
+        return inputs
+
+    def _sum_all(*xs):
+        out = xs[0]
+        for v in xs[1:]:
+            out = out + v
+        return out
+
+    return _op("add_n", _sum_all, *inputs)
+
+
+def multiply_list(xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out * x
+    return out
+
+
+def atan2(x, y):
+    return _op("atan2", lambda a, b: _jnp().arctan2(a, b), x, y)
+
+
+def hypot(x, y):
+    return _op("hypot", lambda a, b: _jnp().hypot(a, b), x, y)
+
+
+def lerp(x, y, weight):
+    if isinstance(weight, Tensor):
+        return _op("lerp", lambda a, b, w: a + w * (b - a), x, y, weight)
+    return _op("lerp", lambda a, b: a + weight * (b - a), x, y)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return _op("stanh", lambda a: scale_b * _jnp().tanh(scale_a * a), x)
+
+
+# ----------------------------- reductions -----------------------------
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    dt = convert_dtype(dtype)
+    def fn(a):
+        out = K.reduce_sum(a, axis, keepdim)
+        return out.astype(dt) if dt is not None else out
+    return _op("reduce_sum", fn, x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _op("reduce_mean", lambda a: K.reduce_mean(a, axis, keepdim), x)
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _op("reduce_max", lambda a: K.reduce_max(a, axis, keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _op("reduce_min", lambda a: K.reduce_min(a, axis, keepdim), x)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return _op("reduce_prod", lambda a: K.reduce_prod(a, axis, keepdim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return _op("logsumexp", lambda a: K.logsumexp(a, axis, keepdim), x)
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    t = _t(x)
+    return Tensor._wrap(t._data.all(axis=K._norm_axis(axis), keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    t = _t(x)
+    return Tensor._wrap(t._data.any(axis=K._norm_axis(axis), keepdims=keepdim))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return _op("std", lambda a: _jnp().std(
+        a, axis=K._norm_axis(axis), ddof=1 if unbiased else 0,
+        keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return _op("var", lambda a: _jnp().var(
+        a, axis=K._norm_axis(axis), ddof=1 if unbiased else 0,
+        keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False):
+    return _op("median", lambda a: _jnp().median(
+        a, axis=K._norm_axis(axis), keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return _op("quantile", lambda a: _jnp().quantile(
+        a, q, axis=K._norm_axis(axis), keepdims=keepdim), x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    return _op("cumsum", lambda a: K.cumsum(a, axis), x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return _op("cumprod", lambda a: K.cumprod(a, dim), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    t = _t(x)
+    return Tensor._wrap(_jnp().count_nonzero(
+        t._data, axis=K._norm_axis(axis), keepdims=keepdim))
+
+
+def nansum(x, axis=None, keepdim=False):
+    return _op("nansum", lambda a: _jnp().nansum(
+        a, axis=K._norm_axis(axis), keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return _op("nanmean", lambda a: _jnp().nanmean(
+        a, axis=K._norm_axis(axis), keepdims=keepdim), x)
+
+
+def amax(x, axis=None, keepdim=False):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return min(x, axis, keepdim)
+
+
+# ----------------------------- linalg -----------------------------
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _op("matmul",
+               lambda a, b: K.matmul(a, b, transpose_x, transpose_y), x, y)
+
+
+def mm(x, y):
+    return matmul(x, y)
+
+
+def bmm(x, y):
+    return _op("bmm", K.bmm, x, y)
+
+
+def dot(x, y):
+    return _op("dot", K.dot, x, y)
+
+
+def t(x):
+    return _op("t", K.t, x)
+
+
+def transpose(x, perm, name=None):
+    return _op("transpose", lambda a: K.transpose(a, perm), x)
+
+
+def norm(x, p=2, axis=None, keepdim=False, name=None):
+    return _op("norm", lambda a: K.norm(a, p, K._norm_axis(axis), keepdim), x)
+
+
+def dist(x, y, p=2):
+    return _op("dist", lambda a, b: K.norm(a - b, p), x, y)
+
+
+def cross(x, y, axis=None):
+    return _op("cross",
+               lambda a, b: _jnp().cross(a, b, axis=axis if axis is not None
+                                         else -1), x, y)
+
+
+def matrix_power(x, n):
+    return _op("matrix_power",
+               lambda a: _jnp().linalg.matrix_power(a, n), x)
+
+
+def einsum(eq, *xs):
+    return _op("einsum", lambda *a: K.einsum(eq, *a), *xs)
+
+
+def tril(x, diagonal=0):
+    return _op("tril", lambda a: K.tril(a, diagonal), x)
+
+
+def triu(x, diagonal=0):
+    return _op("triu", lambda a: K.triu(a, diagonal), x)
+
+
+def kron(x, y):
+    return _op("kron", lambda a, b: _jnp().kron(a, b), x, y)
+
+
+def outer(x, y):
+    return _op("outer", lambda a, b: _jnp().outer(a, b), x, y)
+
+
+def inner(x, y):
+    return _op("inner", lambda a, b: _jnp().inner(a, b), x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return _op("trace", lambda a: _jnp().trace(a, offset, axis1, axis2), x)
+
+
+# ----------------------------- manipulation -----------------------------
+
+def reshape(x, shape, name=None):
+    return _op("reshape", lambda a: K.reshape(a, _shape_dyn(shape)), x)
+
+
+def _shape_dyn(shape):
+    out = []
+    for s in (shape if isinstance(shape, (list, tuple)) else [shape]):
+        if isinstance(s, Tensor):
+            out.append(int(s._data))
+        else:
+            out.append(int(s))
+    return out
+
+
+def concat(x, axis=0, name=None):
+    axis = int(axis._data) if isinstance(axis, Tensor) else int(axis)
+    return _op("concat", lambda *xs: K.concat(list(xs), axis), *x)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    t_ = _t(x)
+    axis = int(axis._data) if isinstance(axis, Tensor) else int(axis)
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+    else:
+        n = len(num_or_sections)
+    return _op("split", lambda a: tuple(K.split(a, num_or_sections, axis)),
+               t_, n_outputs=n)
+
+
+def chunk(x, chunks, axis=0):
+    return split(x, chunks, axis)
+
+
+def stack(x, axis=0, name=None):
+    return _op("stack", lambda *xs: K.stack(list(xs), axis), *x)
+
+
+def unstack(x, axis=0, num=None):
+    t_ = _t(x)
+    n = num if num is not None else t_.shape[axis]
+    return _op("unstack", lambda a: tuple(K.unstack(a, axis)), t_,
+               n_outputs=n)
+
+
+def unbind(x, axis=0):
+    return unstack(x, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    return _op("squeeze", lambda a: K.squeeze(a, axis), x)
+
+
+def unsqueeze(x, axis, name=None):
+    return _op("unsqueeze", lambda a: K.unsqueeze(a, axis), x)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return _op("flatten", lambda a: K.flatten(a, start_axis, stop_axis), x)
+
+
+def expand(x, shape, name=None):
+    return _op("expand", lambda a: K.expand(a, _shape_dyn(shape)), x)
+
+
+def expand_as(x, y, name=None):
+    return _op("expand_as", K.expand_as, x, y)
+
+
+def broadcast_to(x, shape, name=None):
+    return _op("broadcast_to", lambda a: K.broadcast_to(a, _shape_dyn(shape)),
+               x)
+
+
+def tile(x, repeat_times, name=None):
+    return _op("tile", lambda a: K.tile(a, _shape_dyn(repeat_times)), x)
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    starts = [int(s._data) if isinstance(s, Tensor) else int(s)
+              for s in starts]
+    ends = [int(e._data) if isinstance(e, Tensor) else int(e) for e in ends]
+    return _op("slice", lambda a: K.slice_op(a, axes, starts, ends), x)
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    return _op("strided_slice",
+               lambda a: K.strided_slice(a, axes, starts, ends, strides), x)
+
+
+def gather(x, index, axis=0, name=None):
+    return _op("gather", lambda a, i: K.gather(a, i, axis), x, index)
+
+
+def gather_nd(x, index, name=None):
+    return _op("gather_nd", K.gather_nd, x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return _op("scatter",
+               lambda a, i, u: K.scatter(a, i, u, overwrite), x, index,
+               updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return _op("scatter_nd_add", K.scatter_nd_add, x, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return _op("index_select", lambda a, i: K.index_select(a, i, axis), x,
+               index)
+
+
+def index_sample(x, index):
+    return _op("index_sample", K.index_sample, x, index)
+
+
+def masked_select(x, mask, name=None):
+    return _op("masked_select", K.masked_select, x, mask)
+
+
+def masked_fill(x, mask, value):
+    return _op("masked_fill",
+               lambda a, m: _jnp().where(m, value, a), x, mask)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return _op("where", lambda c, a, b: K.where(c, a, b), condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    t_ = _t(x)
+    out = K.nonzero(t_._data)
+    if as_tuple:
+        return tuple(Tensor._wrap(out[:, i]) for i in range(out.shape[1]))
+    return Tensor._wrap(out)
+
+
+def pad(x, paddings, mode="constant", value=0.0, data_format="NCHW",
+        name=None):
+    pads = [int(p._data) if isinstance(p, Tensor) else int(p)
+            for p in paddings]
+    return _op("pad", lambda a: K.pad(a, pads, mode, value), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return _op("roll", lambda a: K.roll(a, shifts, axis), x)
+
+
+def flip(x, axis, name=None):
+    return _op("flip", lambda a: K.flip(a, axis), x)
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return _op("rot90", lambda a: _jnp().rot90(a, k, axes), x)
+
+
+def cast(x, dtype):
+    return _t(x).astype(dtype)
+
+
+def crop(x, shape=None, offsets=None):
+    import builtins
+
+    t_ = _t(x)
+    offsets = offsets or [0] * t_.ndim
+    idx = tuple(builtins.slice(int(o), int(o) + int(s))
+                for o, s in zip(offsets, _shape_dyn(shape)))
+    return _op("crop", lambda a: a[idx], t_)
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return _op("repeat_interleave",
+               lambda a: _jnp().repeat(a, repeats, axis=axis), x)
+
+
+def moveaxis(x, source, destination):
+    return _op("moveaxis",
+               lambda a: _jnp().moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1):
+    return _op("swapaxes", lambda a: _jnp().swapaxes(a, axis0, axis1), x)
+
+
+def as_real(x):
+    return _op("as_real", lambda a: _jnp().stack(
+        [a.real, a.imag], axis=-1), x)
+
+
+def as_complex(x):
+    return _op("as_complex", lambda a: a[..., 0] + 1j * a[..., 1], x)
+
+
+def meshgrid(*xs):
+    ts = [_t(x) for x in xs]
+    return _op("meshgrid", lambda *a: tuple(K.meshgrid(*a)), *ts,
+               n_outputs=len(ts))
+
+
+def atleast_1d(*xs):
+    outs = [_op("atleast_1d", lambda a: _jnp().atleast_1d(a), x) for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def diff(x, n=1, axis=-1):
+    return _op("diff", lambda a: _jnp().diff(a, n=n, axis=axis), x)
+
+
+def take_along_axis(x, indices, axis):
+    return _op("take_along_axis",
+               lambda a, i: _jnp().take_along_axis(
+                   a, i.astype(_jnp().int32), axis=axis), x, indices)
+
+
+def put_along_axis(x, indices, values, axis):
+    def fn(a, i, v):
+        jnp = _jnp()
+        return _jnp_put_along_axis(a, i.astype(jnp.int32), v, axis)
+    return _op("put_along_axis", fn, x, indices, values)
+
+
+def _jnp_put_along_axis(a, idx, v, axis):
+    jnp = _jnp()
+    idxs = list(jnp.meshgrid(*[jnp.arange(s) for s in idx.shape],
+                             indexing="ij"))
+    idxs[axis] = idx
+    return a.at[tuple(idxs)].set(v)
+
+
+# ----------------------------- search/sort -----------------------------
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    t_ = _t(x)
+    return Tensor._wrap(K.argmax(t_._data, axis, keepdim, convert_dtype(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    t_ = _t(x)
+    return Tensor._wrap(K.argmin(t_._data, axis, keepdim, convert_dtype(dtype)))
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    kk = int(k._data) if isinstance(k, Tensor) else int(k)
+    return _op("topk", lambda a: K.topk(a, kk, axis, largest, sorted), x,
+               n_outputs=2)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    t_ = _t(x)
+    return Tensor._wrap(K.argsort(t_._data, axis, descending))
+
+
+def sort(x, axis=-1, descending=False, name=None):  # noqa: A001
+    return _op("sort", lambda a: K.sort(a, axis, descending), x)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64"):
+    t_ = _t(x)
+    out = K.unique(t_._data, return_index, return_inverse, return_counts)
+    if isinstance(out, tuple):
+        return tuple(Tensor._wrap(o) for o in out)
+    return Tensor._wrap(out)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    t_ = _t(sorted_sequence)
+    v = _t(values)
+    out = K.searchsorted(t_._data, v._data, right)
+    return Tensor._wrap(out.astype(_jnp().int32 if out_int32 else
+                                   _jnp().int64))
+
+
+def histogram(x, bins=100, min=0, max=0):
+    t_ = _t(x)
+    rng = None if (min == 0 and max == 0) else (min, max)
+    h, _ = _jnp().histogram(t_._data, bins=bins, range=rng)
+    return Tensor._wrap(h)
+
+
+def bincount(x, weights=None, minlength=0):
+    t_ = _t(x)
+    w = _t(weights)._data if weights is not None else None
+    return Tensor._wrap(_jnp().bincount(t_._data, w, minlength=minlength))
+
+
+def mode(x, axis=-1, keepdim=False):
+    t_ = _t(x)
+    import scipy.stats  # noqa - fallback via numpy
+
+    arr = np.asarray(t_._data)
+    vals, counts = scipy.stats.mode(arr, axis=axis, keepdims=keepdim)
+    return Tensor._wrap(_jnp().asarray(vals)), Tensor._wrap(
+        _jnp().asarray(counts))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    t_ = _t(x)
+    jnp = _jnp()
+    s = jnp.sort(t_._data, axis=axis)
+    i = jnp.argsort(t_._data, axis=axis)
+    v = jnp.take(s, k - 1, axis=axis)
+    ix = jnp.take(i, k - 1, axis=axis)
+    if keepdim:
+        v = jnp.expand_dims(v, axis)
+        ix = jnp.expand_dims(ix, axis)
+    return Tensor._wrap(v), Tensor._wrap(ix.astype(jnp.int64))
+
+
+# ----------------------------- logic -----------------------------
+
+def equal(x, y):
+    return _t(x) == y
+
+
+def not_equal(x, y):
+    return _t(x) != y
+
+
+def less_than(x, y):
+    return _t(x) < y
+
+
+def less_equal(x, y):
+    return _t(x) <= y
+
+
+def greater_than(x, y):
+    return _t(x) > y
+
+
+def greater_equal(x, y):
+    return _t(x) >= y
+
+
+def equal_all(x, y):
+    return Tensor._wrap((_t(x)._data == _t(y)._data).all())
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return Tensor._wrap(_jnp().allclose(_t(x)._data, _t(y)._data, rtol, atol,
+                                        equal_nan))
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return Tensor._wrap(_jnp().isclose(_t(x)._data, _t(y)._data, rtol, atol,
+                                       equal_nan))
+
+
+def logical_and(x, y, out=None):
+    return Tensor._wrap(_jnp().logical_and(_t(x)._data, _t(y)._data))
+
+
+def logical_or(x, y, out=None):
+    return Tensor._wrap(_jnp().logical_or(_t(x)._data, _t(y)._data))
+
+
+def logical_xor(x, y, out=None):
+    return Tensor._wrap(_jnp().logical_xor(_t(x)._data, _t(y)._data))
+
+
+def logical_not(x, out=None):
+    return Tensor._wrap(_jnp().logical_not(_t(x)._data))
+
+
+def bitwise_and(x, y):
+    return Tensor._wrap(_jnp().bitwise_and(_t(x)._data, _t(y)._data))
+
+
+def bitwise_or(x, y):
+    return Tensor._wrap(_jnp().bitwise_or(_t(x)._data, _t(y)._data))
+
+
+def bitwise_xor(x, y):
+    return Tensor._wrap(_jnp().bitwise_xor(_t(x)._data, _t(y)._data))
+
+
+def bitwise_not(x):
+    return Tensor._wrap(_jnp().bitwise_not(_t(x)._data))
+
+
+def is_empty(x):
+    return Tensor._wrap(_jnp().asarray(_t(x)._data.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+# ----------------------------- misc -----------------------------
+
+def numel(x):
+    return Tensor._wrap(_jnp().asarray(_t(x)._data.size, dtype=_jnp().int64))
+
+
+def shape(x):
+    return Tensor._wrap(_jnp().asarray(_t(x)._data.shape, dtype=_jnp().int32))
+
+
+def rank(x):
+    return Tensor._wrap(_jnp().asarray(_t(x)._data.ndim, dtype=_jnp().int32))
+
+
+def increment(x, value=1.0):
+    x.set_value(x._data + value)
+    return x
+
+
+def one_hot(x, num_classes, name=None):
+    return _op("one_hot", lambda a: K.one_hot(a, num_classes), x)
+
+
+def multiplex(inputs, index, name=None):
+    ts = [_t(i) for i in inputs]
+    return _op("multiplex",
+               lambda *args: K.multiplex(list(args[:-1]), args[-1]),
+               *(ts + [_t(index)]))

@@ -1,0 +1,55 @@
+"""paddle.text parity (reference python/paddle/text/datasets: Imdb, Imikolov,
+Movielens, Conll05st, UCIHousing, WMT14/16). No network egress: constructors
+take local files; FakeTextDataset gives synthetic sequences for tests."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+from . import viterbi  # noqa: F401
+
+
+class FakeTextDataset(Dataset):
+    """Synthetic token-sequence dataset (cls-style: ids, label)."""
+
+    def __init__(self, size=1000, seq_len=128, vocab_size=30000,
+                 num_classes=2, seed=0):
+        self.size = size
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self.num_classes = num_classes
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx % 65536)
+        ids = rng.randint(1, self.vocab_size,
+                          size=(self.seq_len,)).astype(np.int64)
+        label = np.asarray(idx % self.num_classes, dtype=np.int64)
+        return ids, label
+
+    def __len__(self):
+        return self.size
+
+
+class UCIHousing(Dataset):
+    def __init__(self, data_file=None, mode="train"):
+        if data_file is None:
+            raise RuntimeError("no network egress: pass data_file")
+        data = np.loadtxt(data_file)
+        data = (data - data.mean(0)) / (data.std(0) + 1e-8)
+        n = len(data)
+        split = int(n * 0.8)
+        self.data = data[:split] if mode == "train" else data[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1].astype(np.float32), row[-1:].astype(np.float32)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        raise RuntimeError(
+            "no network egress: use FakeTextDataset or provide a local "
+            "aclImdb tar via data_file (loader lands with the text op set)")

@@ -1,0 +1,35 @@
+"""Viterbi decode (reference: operators/crf_decoding_op.h) — lax.scan based."""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+
+def viterbi_decode(potentials, transition, lengths=None,
+                   include_bos_eos_tag=True):
+    import jax
+    import jax.numpy as jnp
+
+    pot = potentials._data if isinstance(potentials, Tensor) else potentials
+    trans = transition._data if isinstance(transition, Tensor) else transition
+
+    def decode_one(emissions):
+        def step(carry, emit):
+            score = carry
+            broadcast = score[:, None] + trans
+            best = broadcast.max(axis=0)
+            idx = broadcast.argmax(axis=0)
+            return best + emit, idx
+
+        init = emissions[0]
+        final, idxs = jax.lax.scan(step, init, emissions[1:])
+        last = final.argmax()
+
+        def back(carry, idx_row):
+            tag = idx_row[carry]
+            return tag, tag
+
+        _, path_rev = jax.lax.scan(back, last, idxs[::-1])
+        return jnp.concatenate([path_rev[::-1], last[None]]), final.max()
+
+    paths, scores = jax.vmap(decode_one)(pot)
+    return Tensor._wrap(scores), Tensor._wrap(paths)

@@ -1,0 +1,17 @@
+from . import flags  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+
+    try:
+        return importlib.import_module(name)
+    except ImportError as e:
+        raise ImportError(f"optional dependency {name} not available: {e}")
+
+
+def unique_name(prefix="tmp"):
+    from ..nn.layer.layers import _unique_name
+
+    return _unique_name(prefix)

@@ -1,0 +1,72 @@
+"""Runtime flag registry.
+
+Reference parity: platform/flags.cc (29 gflags) + fluid.set_flags/get_flags
+(framework.py:5576/5599) + pybind/global_value_getter_setter.cc. TPU-native:
+most allocator/cudnn flags are meaningless under XLA; we keep the registry,
+honour the semantically relevant ones, and accept-and-ignore the rest so
+reference programs run unmodified. FLAGS_* env vars are read at import.
+"""
+from __future__ import annotations
+
+import os
+
+_FLAGS = {
+    # kept + honoured
+    "FLAGS_check_nan_inf": False,            # debug_nans equivalent
+    "FLAGS_cudnn_deterministic": False,      # maps to XLA deterministic ops
+    "FLAGS_benchmark": False,
+    "FLAGS_eager_delete_tensor_gb": 0.0,     # XLA owns buffers; accepted
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_paddle_num_threads": 1,
+    "FLAGS_use_pinned_memory": True,
+    "FLAGS_seed": 0,
+    "FLAGS_max_inplace_grad_add": 0,
+    "FLAGS_sync_nccl_allreduce": True,
+    "FLAGS_communicator_max_merge_var_num": 20,
+    "FLAGS_communicator_send_queue_size": 20,
+    "FLAGS_communicator_independent_recv_thread": True,
+    "FLAGS_rpc_deadline": 180000,
+    "FLAGS_rpc_retry_times": 3,
+}
+
+
+def _coerce(cur, val):
+    if isinstance(cur, bool):
+        return str(val).lower() in ("1", "true", "yes", "on")
+    if isinstance(cur, int):
+        return int(val)
+    if isinstance(cur, float):
+        return float(val)
+    return val
+
+
+for _k in list(_FLAGS):
+    if _k in os.environ:
+        _FLAGS[_k] = _coerce(_FLAGS[_k], os.environ[_k])
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        if k in _FLAGS:
+            _FLAGS[k] = _coerce(_FLAGS[k], v)
+        else:
+            _FLAGS[k] = v
+        if k == "FLAGS_check_nan_inf":
+            _apply_nan_check()
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _FLAGS.get(k) for k in flags}
+
+
+def flag(name, default=None):
+    return _FLAGS.get(name, default)
+
+
+def _apply_nan_check():
+    import jax
+
+    jax.config.update("jax_debug_nans", bool(_FLAGS["FLAGS_check_nan_inf"]))

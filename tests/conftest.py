@@ -1,0 +1,38 @@
+"""Test harness config: force CPU jax with 8 virtual devices so sharding /
+collective tests run without TPU hardware (SURVEY.md §4 TPU note — the
+reference fakes clusters with subprocesses+ports; we fake a pod with
+xla_force_host_platform_device_count, which is simpler and faster)."""
+import os
+
+# must happen before jax backends initialize
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# A site hook may have force-registered an accelerator PJRT plugin and
+# overridden jax_platforms; pin tests to the virtual CPU mesh regardless.
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+
+    for _extra in list(_xb._backend_factories):
+        if _extra not in ("cpu",):
+            _xb._backend_factories.pop(_extra, None)
+except Exception:
+    pass
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+
+    np.random.seed(0)
+    paddle.seed(0)
+    yield

@@ -1,0 +1,299 @@
+"""OpTest-style numeric parity vs numpy + gradient checks.
+
+Reference analogue: unittests/op_test.py:170 (check_output vs numpy oracle,
+check_grad via central differences op_test.py:57). Here the analytic grads
+come from the tape (jax.vjp) and are compared against central differences.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    """Central-difference dL/dx for scalar-valued fn (op_test.py:57 spirit)."""
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        old = flat[i]
+        flat[i] = old + eps
+        hi = fn(x)
+        flat[i] = old - eps
+        lo = fn(x)
+        flat[i] = old
+        gf[i] = (hi - lo) / (2 * eps)
+    return g
+
+
+def check_grad(paddle_fn, x_np, rtol=1e-2, atol=1e-3):
+    x = paddle.to_tensor(x_np.astype(np.float32), stop_gradient=False)
+    y = paddle_fn(x).sum()
+    y.backward()
+    analytic = x.grad.numpy()
+
+    def scalar_fn(v):
+        t = paddle.to_tensor(v.astype(np.float32))
+        return float(paddle_fn(t).sum().numpy())
+
+    numeric = numeric_grad(scalar_fn, x_np.astype(np.float64).copy())
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)
+
+
+class TestActivations:
+    x = np.random.RandomState(1).uniform(-2, 2, (4, 5)).astype(np.float32)
+
+    @pytest.mark.parametrize("name,ref", [
+        ("relu", lambda x: np.maximum(x, 0)),
+        ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+        ("tanh", np.tanh),
+        ("softplus", lambda x: np.log1p(np.exp(x))),
+        ("hardswish", lambda x: x * np.clip(x + 3, 0, 6) / 6),
+        ("relu6", lambda x: np.clip(x, 0, 6)),
+        ("silu", lambda x: x / (1 + np.exp(-x))),
+    ])
+    def test_forward(self, name, ref):
+        out = getattr(F, name)(paddle.to_tensor(self.x))
+        np.testing.assert_allclose(out.numpy(), ref(self.x), rtol=1e-5,
+                                   atol=1e-6)
+
+    @pytest.mark.parametrize("name", ["sigmoid", "tanh", "gelu", "softplus"])
+    def test_grad(self, name):
+        check_grad(getattr(F, name), self.x)
+
+
+def test_softmax_parity():
+    x = np.random.RandomState(2).randn(3, 7).astype(np.float32)
+    out = F.softmax(paddle.to_tensor(x)).numpy()
+    e = np.exp(x - x.max(-1, keepdims=True))
+    np.testing.assert_allclose(out, e / e.sum(-1, keepdims=True), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(out.sum(-1), np.ones(3), rtol=1e-5)
+
+
+def test_matmul_parity_and_grad():
+    rng = np.random.RandomState(3)
+    a = rng.randn(4, 5).astype(np.float32)
+    b = rng.randn(5, 6).astype(np.float32)
+    out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5, atol=1e-5)
+    # grad: d(sum(AB))/dA = 1 @ B^T
+    ta = paddle.to_tensor(a, stop_gradient=False)
+    tb = paddle.to_tensor(b, stop_gradient=False)
+    paddle.matmul(ta, tb).sum().backward()
+    np.testing.assert_allclose(ta.grad.numpy(),
+                               np.ones((4, 6)) @ b.T, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(tb.grad.numpy(),
+                               a.T @ np.ones((4, 6)), rtol=1e-5, atol=1e-5)
+
+
+def test_matmul_transpose_flags():
+    rng = np.random.RandomState(4)
+    a = rng.randn(5, 4).astype(np.float32)
+    b = rng.randn(6, 5).astype(np.float32)
+    out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                        transpose_x=True, transpose_y=True)
+    np.testing.assert_allclose(out.numpy(), a.T @ b.T, rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_parity_with_torch_free_reference():
+    # compare against explicit im2col numpy conv
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), stride=1,
+                   padding=1).numpy()
+    ref = np.zeros((2, 4, 8, 8), np.float32)
+    xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+    for i in range(8):
+        for j in range(8):
+            patch = xp[:, :, i:i + 3, j:j + 3]
+            ref[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_grad():
+    rng = np.random.RandomState(6)
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    w_np = rng.randn(3, 2, 3, 3).astype(np.float32)
+    w = paddle.to_tensor(w_np)
+
+    check_grad(lambda t: F.conv2d(t, w, padding=1), x)
+
+
+def test_pool_parity():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = F.max_pool2d(paddle.to_tensor(x), 2, 2).numpy()
+    np.testing.assert_allclose(out, [[[[5, 7], [13, 15]]]])
+    out = F.avg_pool2d(paddle.to_tensor(x), 2, 2).numpy()
+    np.testing.assert_allclose(out, [[[[2.5, 4.5], [10.5, 12.5]]]])
+
+
+def test_adaptive_avg_pool():
+    x = np.random.RandomState(7).randn(2, 3, 8, 8).astype(np.float32)
+    out = F.adaptive_avg_pool2d(paddle.to_tensor(x), 1).numpy()
+    np.testing.assert_allclose(out[:, :, 0, 0], x.mean((2, 3)), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_batch_norm_train_stats():
+    x = np.random.RandomState(8).randn(4, 3, 5, 5).astype(np.float32) * 2 + 1
+    rm = paddle.zeros([3])
+    rv = paddle.ones([3])
+    out = F.batch_norm(paddle.to_tensor(x), rm, rv, training=True,
+                       momentum=0.9)
+    # normalized output has ~zero mean / unit var per channel
+    o = out.numpy()
+    np.testing.assert_allclose(o.mean((0, 2, 3)), np.zeros(3), atol=1e-5)
+    np.testing.assert_allclose(o.var((0, 2, 3)), np.ones(3), atol=1e-3)
+    # running stats moved toward batch stats
+    np.testing.assert_allclose(rm.numpy(), 0.1 * x.mean((0, 2, 3)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_layer_norm_parity():
+    x = np.random.RandomState(9).randn(4, 6).astype(np.float32)
+    out = F.layer_norm(paddle.to_tensor(x), 6).numpy()
+    ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+        x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_cross_entropy_parity():
+    rng = np.random.RandomState(10)
+    logits = rng.randn(8, 5).astype(np.float32)
+    labels = rng.randint(0, 5, (8,)).astype(np.int64)
+    loss = F.cross_entropy(paddle.to_tensor(logits),
+                           paddle.to_tensor(labels)).numpy()
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(8), labels]).mean()
+    np.testing.assert_allclose(loss, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_cross_entropy_soft_label():
+    rng = np.random.RandomState(11)
+    logits = rng.randn(4, 5).astype(np.float32)
+    soft = rng.dirichlet(np.ones(5), 4).astype(np.float32)
+    loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(soft),
+                           soft_label=True).numpy()
+    logp = logits - logits.max(-1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+    ref = -(soft * logp).sum(-1).mean()
+    np.testing.assert_allclose(loss, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_embedding_and_grad():
+    table = paddle.to_tensor(
+        np.arange(12, dtype=np.float32).reshape(4, 3), stop_gradient=False)
+    ids = paddle.to_tensor(np.array([0, 2, 2], np.int64))
+    out = F.embedding(ids, table)
+    np.testing.assert_allclose(out.numpy(),
+                               [[0, 1, 2], [6, 7, 8], [6, 7, 8]])
+    out.sum().backward()
+    np.testing.assert_allclose(table.grad.numpy(),
+                               [[1, 1, 1], [0, 0, 0], [2, 2, 2], [0, 0, 0]])
+
+
+def test_reductions():
+    x = np.random.RandomState(12).randn(3, 4, 5).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.sum(t, axis=1).numpy(), x.sum(1),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(paddle.mean(t, axis=[0, 2]).numpy(),
+                               x.mean((0, 2)), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(paddle.max(t, axis=-1, keepdim=True).numpy(),
+                               x.max(-1, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(paddle.logsumexp(t, axis=1).numpy(),
+                               np.log(np.exp(x).sum(1)), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_manipulation():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    t = paddle.to_tensor(x)
+    assert paddle.reshape(t, [4, 6]).shape == [4, 6]
+    assert paddle.transpose(t, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.flatten(t, 1).shape == [2, 12]
+    parts = paddle.split(t, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    st = paddle.stack([t, t], axis=0)
+    assert st.shape == [2, 2, 3, 4]
+    cc = paddle.concat([t, t], axis=2)
+    assert cc.shape == [2, 3, 8]
+    assert paddle.squeeze(paddle.unsqueeze(t, 0), 0).shape == [2, 3, 4]
+    assert paddle.tile(t, [1, 2, 1]).shape == [2, 6, 4]
+    assert paddle.expand(paddle.to_tensor(np.ones((1, 4), np.float32)),
+                         [3, 4]).shape == [3, 4]
+
+
+def test_split_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32), stop_gradient=False)
+    a, b = paddle.split(x, 2)
+    (a.sum() * 2 + b.sum()).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2, 2, 1, 1, 1])
+
+
+def test_gather_where_topk():
+    x = paddle.to_tensor(np.array([[1.0, 5.0, 3.0], [9.0, 2.0, 4.0]]))
+    g = paddle.gather(x, paddle.to_tensor(np.array([1, 0])), axis=0)
+    np.testing.assert_allclose(g.numpy(), [[9, 2, 4], [1, 5, 3]])
+    w = paddle.where(x > 3, x, paddle.zeros_like(x))
+    np.testing.assert_allclose(w.numpy(), [[0, 5, 0], [9, 0, 4]])
+    v, i = paddle.topk(x, 2, axis=1)
+    np.testing.assert_allclose(v.numpy(), [[5, 3], [9, 4]])
+    np.testing.assert_allclose(i.numpy(), [[1, 2], [0, 2]])
+
+
+def test_one_hot_label_smooth():
+    ids = paddle.to_tensor(np.array([0, 2], np.int64))
+    oh = paddle.one_hot(ids, 3)
+    np.testing.assert_allclose(oh.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_dropout_train_eval():
+    paddle.seed(42)
+    x = paddle.ones([1000])
+    y = F.dropout(x, 0.5, training=True)
+    arr = y.numpy()
+    kept = arr[arr != 0]
+    np.testing.assert_allclose(kept, np.full_like(kept, 2.0))
+    assert 300 < (arr != 0).sum() < 700
+    y2 = F.dropout(x, 0.5, training=False)
+    np.testing.assert_allclose(y2.numpy(), x.numpy())
+
+
+def test_interpolate():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = F.interpolate(paddle.to_tensor(x), size=[2, 2], mode="nearest")
+    assert out.shape == [1, 1, 2, 2]
+    out = F.interpolate(paddle.to_tensor(x), scale_factor=2,
+                        mode="bilinear")
+    assert out.shape == [1, 1, 8, 8]
+
+
+def test_sdpa_reference():
+    rng = np.random.RandomState(13)
+    q = rng.randn(2, 2, 4, 8).astype(np.float32)
+    k = rng.randn(2, 2, 4, 8).astype(np.float32)
+    v = rng.randn(2, 2, 4, 8).astype(np.float32)
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v))
+    s = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(8)
+    e = np.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out.numpy(), p @ v, rtol=1e-4, atol=1e-5)
+
+
+def test_causal_attention_masks_future():
+    rng = np.random.RandomState(14)
+    q = rng.randn(1, 1, 4, 8).astype(np.float32)
+    k = rng.randn(1, 1, 4, 8).astype(np.float32)
+    v = rng.randn(1, 1, 4, 8).astype(np.float32)
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        is_causal=True).numpy()
+    # position 0 attends only to position 0
+    np.testing.assert_allclose(out[0, 0, 0], v[0, 0, 0], rtol=1e-5,
+                               atol=1e-5)

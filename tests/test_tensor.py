@@ -1,0 +1,140 @@
+"""Eager Tensor + autograd engine tests (reference analogue:
+test_var_base.py, test_imperative_basic.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basic():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == np.float32
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtype_following():
+    assert paddle.to_tensor([1, 2]).dtype == np.int64
+    assert paddle.to_tensor(1.5).dtype == np.float32
+    assert paddle.to_tensor(np.float64(1.5)).dtype == np.float64
+    assert paddle.to_tensor([1.0], dtype="float64").dtype == np.float64
+
+
+def test_arithmetic_and_broadcast():
+    a = paddle.to_tensor([[1.0, 2.0]])
+    b = paddle.to_tensor([[3.0], [4.0]])
+    c = a + b
+    assert c.shape == [2, 2]
+    np.testing.assert_allclose(c.numpy(), [[4, 5], [5, 6]])
+    np.testing.assert_allclose((a * 2 - 1).numpy(), [[1, 3]])
+    np.testing.assert_allclose((2 / a).numpy(), [[2, 1]])
+
+
+def test_backward_chain():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x + 2 * x).sum()          # dy/dx = 2x + 2
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 8.0])
+
+
+def test_backward_multi_use():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x + x * 3                  # grad = 2x + 3
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_backward_broadcast_grad():
+    x = paddle.to_tensor(np.ones((2, 3), np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.ones((3,), np.float32), stop_gradient=False)
+    y = (x + b).sum()
+    y.backward()
+    assert x.grad.shape == [2, 3]
+    assert b.grad.shape == [3]
+    np.testing.assert_allclose(b.grad.numpy(), [2.0, 2.0, 2.0])
+
+
+def test_stop_gradient():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0])  # stop_gradient default True
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y._node is None
+
+
+def test_grad_accumulation_and_clear():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    d = y.detach()
+    assert d.stop_gradient
+    z = (d * x).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_indexing_and_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+    y = x[0].sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               [[1, 1, 1], [0, 0, 0]])
+
+
+def test_setitem():
+    x = paddle.to_tensor(np.zeros((3,), np.float32))
+    x[1] = 5.0
+    np.testing.assert_allclose(x.numpy(), [0, 5, 0])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = (x ** 2).sum()
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [6.0])
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_tensor_methods():
+    x = paddle.to_tensor([[4.0, 1.0], [2.0, 3.0]])
+    np.testing.assert_allclose(x.max().numpy(), 4.0)
+    np.testing.assert_allclose(x.mean().numpy(), 2.5)
+    np.testing.assert_allclose(x.t().numpy(), [[4, 2], [1, 3]])
+    v, i = x.topk(1)
+    np.testing.assert_allclose(v.numpy(), [[4.0], [3.0]])
+    assert x.argmax().item() == 0
+
+
+def test_cast_astype():
+    x = paddle.to_tensor([1.5, 2.5])
+    y = x.astype("int32")
+    assert y.dtype == np.int32
+    z = x.astype(paddle.float64)
+    assert z.dtype == np.float64
+
+
+def test_inplace_apis():
+    x = paddle.to_tensor([1.0, -2.0])
+    x.clip_(-1.0, 1.0)
+    np.testing.assert_allclose(x.numpy(), [1.0, -1.0])
+    x.zero_()
+    np.testing.assert_allclose(x.numpy(), [0.0, 0.0])
+    x.fill_(7.0)
+    np.testing.assert_allclose(x.numpy(), [7.0, 7.0])
