@@ -1,0 +1,21 @@
+"""Ad-hoc debug helper: import FIRST to pin jax to a virtual CPU mesh
+(same workaround as tests/conftest.py). Not part of the package."""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+
+    for _extra in list(_xb._backend_factories):
+        if _extra != "cpu":
+            _xb._backend_factories.pop(_extra, None)
+except Exception:
+    pass

@@ -146,7 +146,7 @@ class Executor:
         compiled = self._cache.get(sig) if use_program_cache else None
         if compiled is None:
             compiled = self._compile(program, list(feed_vals),
-                                     list(persist_vals), fetch_names)
+                                     persist_names, fetch_names)
             if use_program_cache:
                 self._cache[sig] = compiled
 

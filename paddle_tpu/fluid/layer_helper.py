@@ -95,7 +95,8 @@ class LayerHelper:
         return self.block.append_op(**kwargs)
 
     def append_bias_op(self, out_var, bias, dim_start=1):
-        tmp = self.create_variable_for_type_inference(out_var.dtype)
+        tmp = self.create_variable_for_type_inference(out_var.dtype,
+                                                      out_var.shape)
         self.append_op(type="elementwise_add",
                        inputs={"X": [out_var], "Y": [bias]},
                        outputs={"Out": [tmp]},
@@ -105,7 +106,8 @@ class LayerHelper:
     def append_activation(self, out_var, act):
         if act is None:
             return out_var
-        tmp = self.create_variable_for_type_inference(out_var.dtype)
+        tmp = self.create_variable_for_type_inference(out_var.dtype,
+                                                      out_var.shape)
         self.append_op(type=act, inputs={"X": [out_var]},
                        outputs={"Out": [tmp]}, attrs={})
         return tmp

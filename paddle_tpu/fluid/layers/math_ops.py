@@ -9,15 +9,16 @@ from ..layer_helper import LayerHelper
 
 
 def _broadcast_shape(s1, s2):
+    n = max(len(s1), len(s2))
+    a = [1] * (n - len(s1)) + list(s1)
+    b = [1] * (n - len(s2)) + list(s2)
     out = []
-    for a, b in zip(reversed(s1), reversed(s2)):
-        if a in (-1, None) or b in (-1, None):
+    for x, y in zip(a, b):
+        if x in (-1, None) or y in (-1, None):
             out.append(-1)
         else:
-            out.append(max(a, b))
-    longer = s1 if len(s1) > len(s2) else s2
-    out.extend(reversed(longer[:abs(len(s1) - len(s2))]))
-    return list(reversed(out))
+            out.append(max(x, y))
+    return out
 
 
 def _elementwise(op_type, x, y, reverse=False, axis=-1, act=None, name=None):

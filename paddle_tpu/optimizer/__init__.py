@@ -183,16 +183,17 @@ def _momentum_rule(p, g, vel, lrv, mu, wd, use_nesterov):
 
 
 def _adam_rule(p, g, m, v, lrv, b1, b2, eps, t, wd, decoupled):
+    """Branch-free so `wd` can be a traced scalar under jit: coupled decay
+    adds wd*p to the grad, decoupled (AdamW) adds it to the update."""
     jnp = _jnp()
-    if not decoupled and wd:
-        g = g + wd * p
+    wd_c = 0.0 if decoupled else wd
+    wd_d = wd if decoupled else 0.0
+    g = g + wd_c * p
     m_new = b1 * m + (1 - b1) * g
     v_new = b2 * v + (1 - b2) * (g * g)
     mhat = m_new / (1 - b1 ** t)
     vhat = v_new / (1 - b2 ** t)
-    upd = mhat / (jnp.sqrt(vhat) + eps)
-    if decoupled and wd:
-        upd = upd + wd * p
+    upd = mhat / (jnp.sqrt(vhat) + eps) + wd_d * p
     return p - lrv * upd, m_new, v_new
 
 

@@ -1,0 +1,204 @@
+"""Static-graph (fluid) tests.
+
+Reference analogue: tests/book/test_recognize_digits.py (end-to-end static
+training, loss decrease, save/load + inference) and unittests program tests.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def make_programs():
+    main = fluid.Program()
+    startup = fluid.Program()
+    return main, startup
+
+
+def test_program_build():
+    main, startup = make_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.fc(x, 3, act="relu")
+    assert x.shape == [-1, 4]
+    assert y.shape == [-1, 3]
+    ops = [op.type for op in main.global_block().ops]
+    assert "mul" in ops and "relu" in ops
+    # parameters got startup init ops
+    assert len(startup.global_block().ops) == 2  # W init + b init
+
+
+def test_executor_forward():
+    main, startup = make_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.fc(x, 3, bias_attr=False,
+                            param_attr=fluid.initializer.Constant(0.5))
+    exe = fluid.Executor()
+    exe.run(startup)
+    out, = exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                   fetch_list=[y])
+    np.testing.assert_allclose(out, np.full((2, 3), 2.0), rtol=1e-6)
+
+
+def test_append_backward_and_sgd():
+    main, startup = make_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [2], dtype="float32")
+        label = fluid.layers.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, label))
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    w_true = np.array([[2.0], [-1.0]], np.float32)
+    losses = []
+    for _ in range(60):
+        xb = rng.randn(16, 2).astype(np.float32)
+        yb = xb @ w_true
+        lv, = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.05, losses[::10]
+
+
+def test_static_mnist_lenet_convergence():
+    """BASELINE config 1: fluid static-graph MNIST-style training."""
+    main, startup = make_programs()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [1, 28, 28], dtype="float32")
+        label = fluid.layers.data("label", [1], dtype="int64")
+        conv1 = fluid.layers.conv2d(img, 6, 5, act="relu")
+        pool1 = fluid.layers.pool2d(conv1, 2, "max", 2)
+        conv2 = fluid.layers.conv2d(pool1, 16, 5, act="relu")
+        pool2 = fluid.layers.pool2d(conv2, 2, "max", 2)
+        fc1 = fluid.layers.fc(pool2, 64, act="relu")
+        logits = fluid.layers.fc(fc1, 10)
+        loss_per = fluid.layers.softmax_with_cross_entropy(logits, label)
+        loss = fluid.layers.mean(loss_per)
+        acc = fluid.layers.accuracy(logits, label)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+
+    # synthetic separable "digits": class-dependent blobs
+    rng = np.random.RandomState(1)
+    protos = rng.randn(10, 1, 28, 28).astype(np.float32)
+
+    def batch(n=32):
+        lbl = rng.randint(0, 10, n)
+        imgs = protos[lbl] + 0.3 * rng.randn(n, 1, 28, 28).astype(
+            np.float32)
+        return imgs.astype(np.float32), lbl.reshape(n, 1).astype(np.int64)
+
+    first_loss = last_loss = None
+    for i in range(40):
+        xb, yb = batch()
+        lv, av = exe.run(main, feed={"img": xb, "label": yb},
+                         fetch_list=[loss, acc])
+        if first_loss is None:
+            first_loss = float(lv)
+        last_loss = float(lv)
+    assert last_loss < first_loss * 0.5, (first_loss, last_loss)
+    assert float(av) > 0.5
+
+
+def test_clone_for_test_freezes_dropout():
+    main, startup = make_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        h = fluid.layers.dropout(x, 0.5)
+        out = fluid.layers.reduce_sum(h)
+    test_prog = main.clone(for_test=True)
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.ones((4, 8), np.float32)
+    o1, = exe.run(test_prog, feed={"x": xv}, fetch_list=[out])
+    # downgrade_in_infer: output = input * (1 - p) at test time — the
+    # reference dropout op's default dropout_implementation
+    np.testing.assert_allclose(o1, 16.0, rtol=1e-6)
+
+
+def test_save_load_inference_model(tmp_path):
+    main, startup = make_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.fc(x, 2, bias_attr=False)
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.ones((3, 4), np.float32)
+    ref, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [y], exe, main)
+
+    # fresh scope: load and run
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+        out, = exe.run(prog, feed={feeds[0]: xv}, fetch_list=fetches)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_save_load_persistables(tmp_path):
+    main, startup = make_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.fc(x, 2)
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.ones((1, 4), np.float32)
+    ref, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    fluid.io.save_persistables(exe, str(tmp_path), main)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.io.load_persistables(exe, str(tmp_path), main)
+        out, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_batch_norm_static_train_updates_stats():
+    main, startup = make_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [3, 4, 4], dtype="float32")
+        y = fluid.layers.batch_norm(x)
+        out = fluid.layers.reduce_sum(y)
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(0).randn(8, 3, 4, 4).astype(np.float32) + 5
+    exe.run(main, feed={"x": xv}, fetch_list=[out])
+    # moving mean must have moved toward 5
+    bn_mean_name = [v for v in main.global_block().vars
+                    if "global" in v or "batch_norm" in v]
+    scope = fluid.global_scope()
+    moved = [np.asarray(v) for k, v in scope._values.items()
+             if k.endswith(".global_0") or "global" in k]
+    assert any(np.abs(m).max() > 0.1 for m in moved if m.ndim == 1)
+
+
+def test_program_serialization_roundtrip():
+    main, startup = make_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.fc(x, 2, bias_attr=False)
+    data = main.desc_bytes()
+    prog2 = fluid.Program.parse_from_string(data)
+    assert [op.type for op in prog2.global_block().ops] == \
+        [op.type for op in main.global_block().ops]
+
+
+def test_variable_operator_sugar():
+    main, startup = make_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = x * 2.0 + 1.0
+        out = fluid.layers.reduce_sum(y)
+    exe = fluid.Executor()
+    exe.run(startup)
+    o, = exe.run(main, feed={"x": np.ones((1, 4), np.float32)},
+                 fetch_list=[out])
+    np.testing.assert_allclose(o, 12.0, rtol=1e-6)
