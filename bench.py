@@ -1,0 +1,75 @@
+"""Headline benchmark: ERNIE-base fine-tune train-step throughput, one chip
+(BASELINE.md config 3). Prints ONE JSON line.
+
+vs_baseline is measured against a provisional 300 seq/s target — the
+paddlepaddle-gpu BERT/ERNIE-base fp16 fine-tune (seq_len 128) per-V100-chip
+class the north star asks us to match (BASELINE.json: no published numbers
+exist in the reference repo, so the target is recorded here and refined as
+real reference runs land).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+TARGET_SEQ_PER_SEC = 300.0
+
+BATCH = 32
+SEQ_LEN = 128
+STEPS = 50
+
+
+def main():
+    import jax
+
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu.optimizer import functional as fopt
+    from paddle_tpu.parallel import SpmdTrainer, init_mesh
+    from paddle_tpu.text import ErnieConfig, ErnieForSequenceClassification
+
+    dev = jax.devices()[0]
+    mesh = init_mesh(dp=1, devices=[dev])
+
+    cfg = ErnieConfig(vocab_size=30522, hidden_size=768, num_layers=12,
+                      num_heads=12, intermediate_size=3072,
+                      max_position=SEQ_LEN + 2, hidden_dropout=0.1,
+                      num_classes=2)
+    net = ErnieForSequenceClassification(cfg)
+
+    def ce(logits, labels):
+        import jax.numpy as jnp
+
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        return -jnp.take_along_axis(lp, labels[:, None], -1).mean()
+
+    tr = SpmdTrainer(net, ce, fopt.adamw(5e-5), mesh=mesh,
+                     compute_dtype="bfloat16")
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(1, cfg.vocab_size, (BATCH, SEQ_LEN)).astype(np.int64)
+    labels = rs.randint(0, 2, (BATCH,)).astype(np.int64)
+    key = jax.random.PRNGKey(0)
+
+    # one jitted multi-step loop (lax.scan): a single dispatch covers all
+    # STEPS, and the final float() host readback bounds completion — robust
+    # against async-dispatch runtimes under-reporting time.
+    float(tr.run_steps((ids,), labels, STEPS, rng=key))  # compile + warm
+
+    t0 = time.perf_counter()
+    lf = float(tr.run_steps((ids,), labels, STEPS, rng=key))
+    dt = time.perf_counter() - t0
+    assert lf == lf, "training produced NaN loss"
+
+    seq_per_sec = BATCH * STEPS / dt
+    print(json.dumps({
+        "metric": "ernie_base_finetune_seq_per_sec_per_chip",
+        "value": round(seq_per_sec, 2),
+        "unit": "seq/s",
+        "vs_baseline": round(seq_per_sec / TARGET_SEQ_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,7 @@ from . import jit  # noqa: F401
 from . import device  # noqa: F401
 from . import utils  # noqa: F401
 from . import distribution  # noqa: F401
+from . import parallel  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import profiler  # noqa: F401
 from . import sparse  # noqa: F401

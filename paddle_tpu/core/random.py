@@ -31,10 +31,15 @@ def get_seed() -> int:
 
 
 def next_key():
-    """Hand out a fresh PRNG key (eager random ops)."""
+    """Hand out a fresh PRNG key (eager random ops). Inside a
+    `scoped_key` region (jitted functional steps) keys derive from the
+    scoped — possibly traced — key instead of the global eager chain."""
     global _key
     import jax
 
+    sub = _scoped_next()
+    if sub is not None:
+        return sub
     with _lock:
         if _key is None:
             _key = jax.random.PRNGKey(0)
@@ -46,3 +51,35 @@ def fold_in(data: int):
     import jax
 
     return jax.random.fold_in(next_key(), data)
+
+
+# --------------------------------------------------------------------------
+# Traced-key scope: inside a jitted functional step (paddle_tpu.parallel) the
+# RNG must be *functional* — the step takes a key argument and every random op
+# derives from it. `scoped_key(key)` installs a (possibly traced) key that
+# `next_key` then splits, so eager-style layers (Dropout etc.) stay traceable
+# under jax.jit without baking a constant mask into the executable.
+# --------------------------------------------------------------------------
+import contextlib
+
+_scoped = threading.local()
+
+
+@contextlib.contextmanager
+def scoped_key(key):
+    prev = getattr(_scoped, "key", None)
+    _scoped.key = key
+    try:
+        yield
+    finally:
+        _scoped.key = prev
+
+
+def _scoped_next():
+    import jax
+
+    cur = getattr(_scoped, "key", None)
+    if cur is None:
+        return None
+    _scoped.key, sub = jax.random.split(cur)
+    return sub

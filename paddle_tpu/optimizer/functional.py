@@ -1,0 +1,304 @@
+"""Pure (pytree) optimizer rules for jitted SPMD train steps.
+
+Reference parity: operators/optimizers/*.cc kernels (sgd_op.cc, momentum_op.cc,
+adam_op.cc, lamb_op.cc) — the same update math as paddle_tpu.optimizer's eager
+classes, but expressed as init/update over whole parameter pytrees so a
+`pjit`ed train step can fuse every parameter update into one XLA program and
+shard optimizer state alongside the parameters (ZeRO-style when the rules'
+state inherits the param sharding).
+
+API (optax-shaped, by design — the TPU-idiomatic form):
+    tx = adam(lr=1e-3)
+    state = tx.init(params)
+    new_params, new_state = tx.update(params, grads, state)
+"""
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, NamedTuple
+
+
+class Transform(NamedTuple):
+    init: Callable[[Any], Any]
+    update: Callable[..., Any]  # (params, grads, state, **extra)
+
+
+def _map(fn, *trees):
+    import jax
+
+    return jax.tree_util.tree_map(fn, *trees)
+
+
+def _resolve_lr(lr, count):
+    if callable(lr):
+        return lr(count)
+    return lr
+
+
+def _cast_lr(lrv, p):
+    """Keep traced (array) learning rates from promoting low-precision
+    params; python-float lrs stay weakly typed."""
+    if hasattr(lrv, "astype"):
+        return lrv.astype(p.dtype)
+    return lrv
+
+
+class ScaleState(NamedTuple):
+    count: Any
+
+
+def sgd(learning_rate=0.01, weight_decay=0.0):
+    """sgd_op.cc parity: p -= lr * (g + wd*p)."""
+
+    def init(params):
+        import jax.numpy as jnp
+
+        return ScaleState(count=jnp.zeros((), jnp.int32))
+
+    def update(params, grads, state):
+        lrv = _resolve_lr(learning_rate, state.count)
+
+        new_params = _map(
+            lambda p, g: p - _cast_lr(lrv, p) * (
+                g.astype(p.dtype) + weight_decay * p),
+            params, grads)
+        return new_params, ScaleState(count=state.count + 1)
+
+    return Transform(init, update)
+
+
+class MomentumState(NamedTuple):
+    count: Any
+    velocity: Any
+
+
+def momentum(learning_rate=0.01, mu=0.9, weight_decay=0.0,
+             use_nesterov=False):
+    """momentum_op.cc parity."""
+
+    def init(params):
+        import jax.numpy as jnp
+
+        return MomentumState(
+            count=jnp.zeros((), jnp.int32),
+            velocity=_map(lambda p: jnp.zeros_like(p), params))
+
+    def update(params, grads, state):
+        lrv = _resolve_lr(learning_rate, state.count)
+
+        def one(p, g, v):
+            lr_p = _cast_lr(lrv, p)
+            g = g.astype(p.dtype) + weight_decay * p
+            v_new = mu * v + g
+            p_new = p - lr_p * (g + mu * v_new) if use_nesterov \
+                else p - lr_p * v_new
+            return p_new, v_new
+
+        import jax
+
+        out = _map(one, params, grads, state.velocity)
+        new_params = jax.tree_util.tree_map(
+            lambda t: t[0], out, is_leaf=lambda t: isinstance(t, tuple))
+        new_vel = jax.tree_util.tree_map(
+            lambda t: t[1], out, is_leaf=lambda t: isinstance(t, tuple))
+        return new_params, MomentumState(state.count + 1, new_vel)
+
+    return Transform(init, update)
+
+
+class AdamState(NamedTuple):
+    count: Any
+    m: Any
+    v: Any
+
+
+def adam(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+         weight_decay=0.0, decoupled=False, decay_mask=None):
+    """adam_op.cc / AdamW parity. `decay_mask(name_or_path)->bool` limits
+    decoupled decay (AdamW's apply_decay_param_fun)."""
+
+    def init(params):
+        import jax.numpy as jnp
+
+        return AdamState(
+            count=jnp.zeros((), jnp.int32),
+            m=_map(lambda p: jnp.zeros_like(p), params),
+            v=_map(lambda p: jnp.zeros_like(p), params))
+
+    def update(params, grads, state):
+        import jax
+        import jax.numpy as jnp
+
+        t = state.count + 1
+        lrv = _resolve_lr(learning_rate, state.count)
+        tf = t.astype(jnp.float32)
+        c1 = 1.0 - beta1 ** tf
+        c2 = 1.0 - beta2 ** tf
+
+        masks = None
+        if decay_mask is not None:
+            flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+            masks = jax.tree_util.tree_unflatten(
+                treedef,
+                [1.0 if decay_mask(jax.tree_util.keystr(kp)) else 0.0
+                 for kp, _ in flat])
+
+        def one(p, g, m, v, dm=1.0):
+            g = g.astype(p.dtype)
+            wd_c = 0.0 if decoupled else weight_decay * dm
+            wd_d = weight_decay * dm if decoupled else 0.0
+            g = g + wd_c * p
+            m_new = beta1 * m + (1 - beta1) * g
+            v_new = beta2 * v + (1 - beta2) * (g * g)
+            mhat = m_new / c1.astype(p.dtype)
+            vhat = v_new / c2.astype(p.dtype)
+            upd = mhat / (jnp.sqrt(vhat) + epsilon) + wd_d * p
+            return p - _cast_lr(lrv, p) * upd, m_new, v_new
+
+        if masks is None:
+            out = _map(one, params, grads, state.m, state.v)
+        else:
+            out = _map(one, params, grads, state.m, state.v, masks)
+        is_tup = lambda t_: isinstance(t_, tuple)  # noqa: E731
+        pick = lambda i: jax.tree_util.tree_map(  # noqa: E731
+            lambda t_: t_[i], out, is_leaf=is_tup)
+        return pick(0), AdamState(t, pick(1), pick(2))
+
+    return Transform(init, update)
+
+
+def adamw(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+          weight_decay=0.01, decay_mask=None):
+    return adam(learning_rate, beta1, beta2, epsilon, weight_decay,
+                decoupled=True, decay_mask=decay_mask)
+
+
+def lamb(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-6,
+         weight_decay=0.01):
+    """lamb_op.cc parity: adam moments + layerwise trust ratio."""
+
+    base = adam(1.0, beta1, beta2, epsilon, 0.0)
+
+    def init(params):
+        return base.init(params)
+
+    def update(params, grads, state):
+        import jax.numpy as jnp
+
+        t = state.count + 1
+        lrv = _resolve_lr(learning_rate, state.count)
+        tf = t.astype(jnp.float32)
+        c1 = 1.0 - beta1 ** tf
+        c2 = 1.0 - beta2 ** tf
+
+        def one(p, g, m, v):
+            g = g.astype(p.dtype)
+            m_new = beta1 * m + (1 - beta1) * g
+            v_new = beta2 * v + (1 - beta2) * (g * g)
+            mhat = m_new / c1.astype(p.dtype)
+            vhat = v_new / c2.astype(p.dtype)
+            r = mhat / (jnp.sqrt(vhat) + epsilon) + weight_decay * p
+            w_norm = jnp.sqrt((p.astype(jnp.float32) ** 2).sum())
+            r_norm = jnp.sqrt((r.astype(jnp.float32) ** 2).sum())
+            trust = jnp.where((w_norm > 0) & (r_norm > 0),
+                              w_norm / r_norm, 1.0).astype(p.dtype)
+            return p - _cast_lr(lrv, p) * trust * r, m_new, v_new
+
+        import jax
+
+        out = _map(one, params, grads, state.m, state.v)
+        is_tup = lambda t_: isinstance(t_, tuple)  # noqa: E731
+        pick = lambda i: jax.tree_util.tree_map(  # noqa: E731
+            lambda t_: t_[i], out, is_leaf=is_tup)
+        return pick(0), AdamState(t, pick(1), pick(2))
+
+    return Transform(init, update)
+
+
+def clip_by_global_norm(tx: Transform, max_norm: float) -> Transform:
+    """ClipGradByGlobalNorm composed into a pure rule (clip_op parity)."""
+
+    def init(params):
+        return tx.init(params)
+
+    def update(params, grads, state):
+        import jax
+        import jax.numpy as jnp
+
+        leaves = jax.tree_util.tree_leaves(grads)
+        gnorm = jnp.sqrt(sum((g.astype(jnp.float32) ** 2).sum()
+                             for g in leaves))
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(gnorm, 1e-12))
+        grads = _map(lambda g: (g * scale).astype(g.dtype), grads)
+        return tx.update(params, grads, state)
+
+    return Transform(init, update)
+
+
+def from_eager(opt) -> Transform:
+    """Build the pure Transform matching an eager paddle_tpu.optimizer
+    instance (so hapi/fleet can accept paddle-style optimizer objects and
+    still run a fully jitted SPMD step). Carries over: the LR schedule
+    (on-device via get_lr_traced, frozen with a warning when the schedule is
+    host-driven e.g. ReduceOnPlateau), global-norm grad clipping, weight
+    decay, and AdamW's apply_decay_param_fun exclusion mask."""
+    import warnings
+
+    from . import (SGD, Adam, AdamW, Lamb, Momentum)
+    from .lr import LRScheduler
+
+    lr = opt._lr
+
+    if isinstance(lr, LRScheduler):
+        sched = lr
+        if type(sched).traceable():
+            lrv = sched.get_lr_traced
+        else:
+            warnings.warn(
+                f"{type(sched).__name__} has no traced form; the SPMD step "
+                f"freezes its current lr={float(sched())}")
+            lrv = float(sched())
+    else:
+        lrv = float(lr)
+
+    def _wd_of(v):
+        if v is None:
+            return 0.0
+        if hasattr(v, "_coeff"):  # fluid regularizer (L2Decay)
+            return float(v._coeff)
+        return float(v)
+
+    wd = _wd_of(getattr(opt, "_weight_decay", None))
+
+    # AdamW's per-parameter decay exclusion: the mask fn receives the
+    # flattened param-tree key string (contains the state_dict name).
+    decay_mask = None
+    fn = getattr(opt, "_apply_decay_param_fun", None)
+    if fn is not None:
+        decay_mask = lambda keypath: bool(fn(keypath))  # noqa: E731
+
+    if isinstance(opt, AdamW):
+        tx = adamw(lrv, opt._beta1, opt._beta2, opt._eps, wd,
+                   decay_mask=decay_mask)
+    elif isinstance(opt, Adam):
+        tx = adam(lrv, opt._beta1, opt._beta2, opt._eps, wd)
+    elif isinstance(opt, Momentum):
+        tx = momentum(lrv, opt._momentum, wd, opt._use_nesterov)
+    elif isinstance(opt, Lamb):
+        tx = lamb(lrv, opt._beta1, opt._beta2, opt._eps, wd)
+    elif isinstance(opt, SGD):
+        tx = sgd(lrv, wd)
+    else:
+        tx = sgd(lrv, wd)
+
+    clip = getattr(opt, "_grad_clip", None)
+    if clip is not None:
+        from ..nn import ClipGradByGlobalNorm
+
+        if isinstance(clip, ClipGradByGlobalNorm):
+            tx = clip_by_global_norm(tx, float(clip.clip_norm))
+        else:
+            warnings.warn(
+                f"grad_clip {type(clip).__name__} not representable in the "
+                f"SPMD step; only ClipGradByGlobalNorm is carried over")
+    return tx

@@ -26,6 +26,18 @@ class LRScheduler:
     def get_lr(self):
         raise NotImplementedError
 
+    # ---- traced form (TPU-native extra) ----
+    # Pure function of the step counter, evaluable on a traced jnp scalar so
+    # a jitted SPMD train step (optimizer/functional.py from_eager) can run
+    # the schedule on-device instead of freezing the trace-time value.
+    # Classes whose schedule is stateful/host-driven don't override this.
+    def get_lr_traced(self, count):
+        return None
+
+    @classmethod
+    def traceable(cls):
+        return cls.get_lr_traced is not LRScheduler.get_lr_traced
+
     def state_dict(self):
         return {"last_epoch": self.last_epoch, "last_lr": self.last_lr}
 
@@ -48,6 +60,13 @@ class NoamDecay(LRScheduler):
         return self.base_lr * (self.d_model ** -0.5) * min(
             step ** -0.5, step * (self.warmup_steps ** -1.5))
 
+    def get_lr_traced(self, count):
+        import jax.numpy as jnp
+
+        step = jnp.maximum(count, 1).astype(jnp.float32)
+        return self.base_lr * (self.d_model ** -0.5) * jnp.minimum(
+            step ** -0.5, step * (self.warmup_steps ** -1.5))
+
 
 class PiecewiseDecay(LRScheduler):
     def __init__(self, boundaries, values, last_epoch=-1, verbose=False):
@@ -61,6 +80,13 @@ class PiecewiseDecay(LRScheduler):
                 return self.values[i]
         return self.values[len(self.boundaries)]
 
+    def get_lr_traced(self, count):
+        import jax.numpy as jnp
+
+        idx = sum(jnp.asarray(count >= b, jnp.int32)
+                  for b in self.boundaries)
+        return jnp.asarray(self.values, jnp.float32)[idx]
+
 
 class NaturalExpDecay(LRScheduler):
     def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
@@ -70,6 +96,12 @@ class NaturalExpDecay(LRScheduler):
     def get_lr(self):
         return self.base_lr * math.exp(-self.gamma * self.last_epoch)
 
+    def get_lr_traced(self, count):
+        import jax.numpy as jnp
+
+        return self.base_lr * jnp.exp(
+            -self.gamma * count.astype(jnp.float32))
+
 
 class InverseTimeDecay(LRScheduler):
     def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
@@ -78,6 +110,11 @@ class InverseTimeDecay(LRScheduler):
 
     def get_lr(self):
         return self.base_lr / (1 + self.gamma * self.last_epoch)
+
+    def get_lr_traced(self, count):
+        import jax.numpy as jnp
+
+        return self.base_lr / (1 + self.gamma * count.astype(jnp.float32))
 
 
 class PolynomialDecay(LRScheduler):
@@ -97,6 +134,19 @@ class PolynomialDecay(LRScheduler):
             decay_steps = decay_steps * div
         else:
             step = min(step, decay_steps)
+        return (self.base_lr - self.end_lr) * (
+            (1 - step / decay_steps) ** self.power) + self.end_lr
+
+    def get_lr_traced(self, count):
+        import jax.numpy as jnp
+
+        step = count.astype(jnp.float32)
+        if self.cycle:
+            div = jnp.maximum(jnp.ceil(step / self.decay_steps), 1.0)
+            decay_steps = self.decay_steps * div
+        else:
+            decay_steps = float(self.decay_steps)
+            step = jnp.minimum(step, decay_steps)
         return (self.base_lr - self.end_lr) * (
             (1 - step / decay_steps) ** self.power) + self.end_lr
 
@@ -121,6 +171,21 @@ class LinearWarmup(LRScheduler):
             return self.lr()
         return float(self.lr)
 
+    def get_lr_traced(self, count):
+        import jax.numpy as jnp
+
+        cf = count.astype(jnp.float32)
+        warm = (self.end_lr - self.start_lr) * (
+            cf / self.warmup_steps) + self.start_lr
+        if isinstance(self.lr, LRScheduler):
+            if not type(self.lr).traceable():
+                return None
+            after = self.lr.get_lr_traced(
+                jnp.maximum(count - self.warmup_steps, 0))
+        else:
+            after = float(self.lr)
+        return jnp.where(count < self.warmup_steps, warm, after)
+
 
 class ExponentialDecay(LRScheduler):
     def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
@@ -129,6 +194,11 @@ class ExponentialDecay(LRScheduler):
 
     def get_lr(self):
         return self.base_lr * (self.gamma ** self.last_epoch)
+
+    def get_lr_traced(self, count):
+        import jax.numpy as jnp
+
+        return self.base_lr * (self.gamma ** count.astype(jnp.float32))
 
 
 class MultiStepDecay(LRScheduler):
@@ -142,6 +212,13 @@ class MultiStepDecay(LRScheduler):
         n = sum(1 for m in self.milestones if self.last_epoch >= m)
         return self.base_lr * (self.gamma ** n)
 
+    def get_lr_traced(self, count):
+        import jax.numpy as jnp
+
+        n = sum(jnp.asarray(count >= m, jnp.float32)
+                for m in self.milestones)
+        return self.base_lr * (self.gamma ** n)
+
 
 class StepDecay(LRScheduler):
     def __init__(self, learning_rate, step_size, gamma=0.1, last_epoch=-1,
@@ -153,6 +230,12 @@ class StepDecay(LRScheduler):
     def get_lr(self):
         return self.base_lr * (self.gamma ** (self.last_epoch //
                                               self.step_size))
+
+    def get_lr_traced(self, count):
+        import jax.numpy as jnp
+
+        return self.base_lr * (self.gamma ** (
+            count // self.step_size).astype(jnp.float32))
 
 
 class LambdaDecay(LRScheduler):
@@ -221,6 +304,13 @@ class CosineAnnealingDecay(LRScheduler):
     def get_lr(self):
         return self.eta_min + (self.base_lr - self.eta_min) * (
             1 + math.cos(math.pi * self.last_epoch / self.T_max)) / 2
+
+    def get_lr_traced(self, count):
+        import jax.numpy as jnp
+
+        return self.eta_min + (self.base_lr - self.eta_min) * (
+            1 + jnp.cos(jnp.pi * count.astype(jnp.float32)
+                        / self.T_max)) / 2
 
 
 class OneCycleLR(LRScheduler):

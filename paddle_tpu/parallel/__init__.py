@@ -1,0 +1,24 @@
+"""paddle_tpu.parallel — the SPMD engine.
+
+This package replaces the reference's entire multi-device execution stack —
+ParallelExecutor + SSA graph builders (framework/details/,
+ir/multi_devices_graph_pass/), the NCCL comm registry
+(platform/collective_helper.h:62), and the transpiler program rewriters
+(fluid/transpiler/collective.py) — with the TPU-native form: a named
+`jax.sharding.Mesh` over the chip topology, parameter/activation sharding
+rules (PartitionSpec), and one jitted whole-program train step in which XLA
+inserts and schedules all collectives over ICI.
+
+Axes (canonical order): dp (data), pp (pipeline stage), tp (tensor /
+op-level model parallel; the sequence-parallel axis rides tp the Megatron-SP
+way), ep (expert, rides dp for MoE layers), sp (dedicated context-parallel
+axis for ring attention when requested).
+"""
+from .mesh import (DeviceMesh, auto_mesh, get_mesh, init_mesh,  # noqa: F401
+                   mesh_axis_size)
+from .functional import functionalize, FunctionalModule  # noqa: F401
+from .sharding import (ShardingRules, batch_sharding,  # noqa: F401
+                       infer_param_specs, named_sharding, COMMON_TP_RULES)
+from .spmd import SpmdTrainer, spmd_data_parallel  # noqa: F401
+from .ring import ring_attention  # noqa: F401
+from .pipeline import pipeline_spmd_fn  # noqa: F401

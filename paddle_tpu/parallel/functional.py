@@ -1,0 +1,100 @@
+"""Layer → pure-function bridge.
+
+Reference parity: the dygraph-to-static ProgramTranslator
+(fluid/dygraph/dygraph_to_static/program_translator.py:680) — the reference
+captures an imperative model into a static Program so executors can run it
+whole. TPU-native design: capture the imperative Layer into a *pure jax
+function* `apply(params, buffers, rng, *inputs) -> (outputs, new_buffers)`
+that jax.jit/pjit traces once, by temporarily binding traced arrays into the
+module tree (torch.func.functional_call-style), with mutated buffers
+(BatchNorm running stats) read back as explicit outputs — exactly the
+functionalization XLA requires.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict
+
+from ..core import random as _random
+from ..core.tensor import Tensor
+from ..core import autograd as _autograd
+
+
+class FunctionalModule:
+    def __init__(self, layer):
+        self.layer = layer
+        sd = layer.state_dict()
+        pnames = {n for n, _ in layer.named_parameters()}
+        self._tensors: Dict[str, Tensor] = dict(sd)
+        self.param_names = [n for n in sd if n in pnames]
+        self.buffer_names = [n for n in sd if n not in pnames]
+        # non-persistable buffers still need functional treatment
+        for n, b in layer.named_buffers():
+            if n not in sd and b is not None:
+                self._tensors[n] = b
+                self.buffer_names.append(n)
+
+    # ----- state extraction -----
+    def params(self) -> Dict[str, Any]:
+        return {n: self._tensors[n]._data for n in self.param_names}
+
+    def buffers(self) -> Dict[str, Any]:
+        return {n: self._tensors[n]._data for n in self.buffer_names}
+
+    def load(self, params=None, buffers=None):
+        for tree in (params, buffers):
+            if tree:
+                for n, v in tree.items():
+                    self._tensors[n]._data = v
+
+    # ----- the pure apply -----
+    def apply(self, params, buffers, rng, *inputs, training=True,
+              unwrap=True, **kwargs):
+        """Pure forward. `inputs` are raw jax arrays (or pytrees thereof);
+        returns (outputs, new_buffers) with outputs unwrapped to raw arrays
+        when `unwrap`."""
+        layer = self.layer
+        saved = {n: t._data for n, t in self._tensors.items()}
+        was_training = layer.training
+        layer.train() if training else layer.eval()
+        try:
+            for n, v in params.items():
+                self._tensors[n]._data = v
+            for n, v in buffers.items():
+                self._tensors[n]._data = v
+            wrapped = [x if isinstance(x, Tensor) else Tensor._wrap(x)
+                       for x in inputs]
+            with _autograd.no_grad():
+                if rng is not None:
+                    with _random.scoped_key(rng):
+                        out = layer(*wrapped, **kwargs)
+                else:
+                    out = layer(*wrapped, **kwargs)
+            new_buffers = {n: self._tensors[n]._data
+                           for n in self.buffer_names}
+            if unwrap:
+                out = _unwrap_tree(out)
+            return out, new_buffers
+        finally:
+            for n, t in self._tensors.items():
+                t._data = saved[n]
+            layer.train() if was_training else layer.eval()
+
+    def __call__(self, params, buffers, rng, *inputs, **kw):
+        return self.apply(params, buffers, rng, *inputs, **kw)
+
+
+def _unwrap_tree(out):
+    if isinstance(out, Tensor):
+        return out._data
+    if isinstance(out, (list, tuple)):
+        return type(out)(_unwrap_tree(o) for o in out)
+    if isinstance(out, dict):
+        return {k: _unwrap_tree(v) for k, v in out.items()}
+    return out
+
+
+def functionalize(layer) -> FunctionalModule:
+    """paddle_tpu-native: fm = functionalize(net);
+    out, new_bufs = fm.apply(fm.params(), fm.buffers(), key, x)."""
+    return FunctionalModule(layer)

@@ -1,0 +1,99 @@
+"""Pipeline parallelism: GPipe fill-drain over the `pp` mesh axis.
+
+Reference parity: PipelineOptimizer/_split_program + PipelineTrainer/
+SectionWorker (optimizer.py:3666, framework/pipeline_trainer.cc:24,
+section_worker.cc:82, trainer_desc.proto:66) — the reference cuts the program
+into per-device sections and streams microbatches through scope queues with
+condition variables. TPU-native design: stages are SPMD shards on the `pp`
+axis; one shard_map program runs the whole schedule, activations hop stages
+via ppermute over ICI, and the backward pass falls out of jax.grad (ppermute
+transposes to the reverse ring) — no worker threads, no queues.
+
+The stage function runs on EVERY device each tick (idle ticks compute on
+garbage and are masked out) — that is the pipeline bubble, identical in cost
+to the reference's fill/drain phases.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+
+def pipeline_spmd_fn(stage_apply, mesh=None, axis_name="pp"):
+    """Build fn(stacked_params, microbatches) -> (M, ...) outputs.
+
+    stage_apply(stage_params, x) -> y applies ONE stage; activations must
+    keep one shape across stages. `stacked_params` is a pytree whose leaves
+    have a leading n_stages axis (shard it over `pp`); `microbatches` is
+    (M, mb, ...), replicated.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from .mesh import get_mesh, shard_map
+
+    m = mesh or get_mesh()
+    n_stages = m.axis_size(axis_name)
+
+    if n_stages == 1:
+        def single(params, microbatches):
+            sq = jax.tree_util.tree_map(lambda a: a[0], params)
+            return jax.vmap(lambda mb: stage_apply(sq, mb))(microbatches)
+
+        return single
+
+    def per_device(params, microbatches):
+        import jax.numpy as jnp
+
+        stage_params = jax.tree_util.tree_map(lambda a: a[0], params)
+        s = jax.lax.axis_index(axis_name)
+        M = microbatches.shape[0]
+        mb_shape = microbatches.shape[1:]
+        fwd_perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 ingests microbatch t (clamped; masked later)
+            idx = jnp.clip(t, 0, M - 1)
+            mb_in = jax.lax.dynamic_index_in_dim(
+                microbatches, idx, 0, keepdims=False)
+            x = jnp.where(s == 0, mb_in, state)
+            y = stage_apply(stage_params, x)
+            # last stage emits microbatch t-(S-1) when valid
+            out_t = t - (n_stages - 1)
+            valid = (out_t >= 0) & (out_t < M) & (s == n_stages - 1)
+            outputs = jax.lax.cond(
+                valid,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, y, jnp.clip(out_t, 0, M - 1), 0),
+                lambda o: o, outputs)
+            state = jax.lax.ppermute(y, axis_name, fwd_perm)
+            return (state, outputs), None
+
+        state0 = jnp.zeros(mb_shape, microbatches.dtype)
+        outputs0 = jnp.zeros((M,) + mb_shape, microbatches.dtype)
+        (_, outputs), _ = jax.lax.scan(
+            tick, (state0, outputs0), jnp.arange(M + n_stages - 1))
+        # all stages agree on outputs: only the last wrote; share it
+        outputs = jax.lax.psum(outputs, axis_name)
+        return outputs
+
+    def build(params, microbatches):
+        in_specs = (
+            jax.tree_util.tree_map(lambda _: P(axis_name), params),
+            P(),
+        )
+        fn = shard_map(per_device, mesh=m.mesh, in_specs=in_specs,
+                       out_specs=P())
+        return fn(params, microbatches)
+
+    return build
+
+
+def stack_stage_params(per_stage_params):
+    """[{name: arr}, ...] per stage → {name: (S, ...) stacked} pytree for
+    pipeline_spmd_fn. All stages must share one parameter structure."""
+    import jax.numpy as jnp
+
+    keys = per_stage_params[0].keys()
+    return {k: jnp.stack([sp[k] for sp in per_stage_params])
+            for k in keys}

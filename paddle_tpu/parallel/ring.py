@@ -1,0 +1,87 @@
+"""Ring attention: context/sequence parallelism over a mesh axis.
+
+Reference parity: none — the reference handles long sequences only
+representationally via LoD tensors (SURVEY.md §5.7); this is the
+beyond-parity long-context capability the TPU build adds. Design follows the
+ring-attention pattern (blockwise online-softmax attention while K/V shards
+rotate around the ICI ring via ppermute), so sequence length scales linearly
+with the number of chips on the `sp` axis and compute overlaps the ring
+transfers (XLA pipelines ppermute with the per-block matmuls).
+
+Use inside shard_map with q/k/v sharded on the sequence axis, or call
+`ring_attention` which wraps the shard_map given a mesh axis name.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+
+def _ring_attn_local(q, k, v, axis_name, is_causal, scale):
+    """Per-shard body. q,k,v: (b, h, s_local, d). The global sequence is the
+    concatenation of shards in axis-index order."""
+    import jax
+    import jax.numpy as jnp
+
+    ax = jax.lax.axis_index(axis_name)
+    n = jax.lax.psum(1, axis_name)
+    b, h, s, d = q.shape
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32) * sc
+
+    def block(qf, kb, vb, q_off, k_off):
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32),
+                            preferred_element_type=jnp.float32)
+        if is_causal:
+            rows = q_off + jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+            cols = k_off + jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+            logits = jnp.where(rows[None, None] >= cols[None, None],
+                               logits, -1e30)
+        m_b = logits.max(axis=-1, keepdims=True)
+        p = jnp.exp(logits - m_b)
+        l_b = p.sum(axis=-1, keepdims=True)
+        o_b = jnp.einsum("bhqk,bhkd->bhqd", p, vb.astype(jnp.float32))
+        return m_b, l_b, o_b
+
+    def body(i, carry):
+        acc, m_prev, l_prev, kr, vr = carry
+        src = (ax - i) % n  # which shard of K/V we hold this round
+        m_b, l_b, o_b = block(qf, kr, vr, ax * s, src * s)
+        m_new = jnp.maximum(m_prev, m_b)
+        alpha = jnp.exp(m_prev - m_new)
+        beta = jnp.exp(m_b - m_new)
+        l_new = l_prev * alpha + l_b * beta
+        acc = acc * alpha + o_b * beta
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        kr = jax.lax.ppermute(kr, axis_name, perm)
+        vr = jax.lax.ppermute(vr, axis_name, perm)
+        return acc, m_new, l_new, kr, vr
+
+    acc0 = jnp.zeros((b, h, s, d), jnp.float32)
+    m0 = jnp.full((b, h, s, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((b, h, s, 1), jnp.float32)
+    acc, m_f, l_f, _, _ = jax.lax.fori_loop(
+        0, n, body, (acc0, m0, l0, k, v))
+    return (acc / jnp.maximum(l_f, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis_name="sp", mesh=None, is_causal=False,
+                   scale=None):
+    """Global-view entry: q/k/v are full (b, h, S, d) arrays (possibly
+    sharded); runs the ring over `axis_name` of the current mesh. Falls back
+    to plain attention when the axis has size 1."""
+    from jax.sharding import PartitionSpec as P
+
+    from .mesh import get_mesh, shard_map
+    from ..ops.attention import sdpa_reference
+
+    m = (mesh or get_mesh())
+    if m.axis_size(axis_name) == 1:
+        return sdpa_reference(q, k, v, None, is_causal, scale)
+
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        partial(_ring_attn_local, axis_name=axis_name, is_causal=is_causal,
+                scale=scale),
+        mesh=m.mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

@@ -1,0 +1,107 @@
+"""Sharding rules: parameter-name patterns → PartitionSpec.
+
+Reference parity: the reference has *no* tensor parallelism (SURVEY.md §2.3 —
+TP/SP absent); its sharding story is the DistributeTranspiler splitting
+parameters into blocks across pservers (transpiler/distribute_transpiler.py).
+TPU-native design: declarative regex rules map each parameter to a
+PartitionSpec on the global mesh; XLA's SPMD partitioner propagates the rest.
+This is the Megatron/scaling-book recipe: attention qkv and mlp-in shard the
+output feature axis on tp, attn-out and mlp-out shard the input axis, vocab
+embeddings shard the vocab axis, everything else replicates over tp and (when
+not ZeRO-sharded) over dp.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .mesh import DeviceMesh, get_mesh
+
+
+class ShardingRules:
+    """Ordered (regex, spec-tuple) table; first match wins. A spec entry is
+    a tuple over the tensor's dims, each element an axis name, a tuple of
+    axis names, or None (replicated)."""
+
+    def __init__(self, rules: Sequence[Tuple[str, Tuple]] = (),
+                 default: Tuple = ()):
+        self.rules: List[Tuple[re.Pattern, Tuple]] = [
+            (re.compile(pat), spec) for pat, spec in rules]
+        self.default = default
+
+    def add(self, pattern: str, spec: Tuple):
+        self.rules.append((re.compile(pattern), spec))
+        return self
+
+    def spec_for(self, name: str, ndim: int):
+        from jax.sharding import PartitionSpec as P
+
+        for pat, spec in self.rules:
+            if pat.search(name):
+                spec = tuple(spec)[:ndim]
+                spec = spec + (None,) * (ndim - len(spec))
+                return P(*spec)
+        return P()
+
+
+# Megatron-style TP rules for the in-tree transformer layers
+# (nn/layer/transformer.py naming: q_proj/k_proj/v_proj/out_proj, linear1/
+# linear2 in the FFN; nn.Embedding weight).  Linear weights here are stored
+# (in_features, out_features).
+COMMON_TP_RULES = ShardingRules([
+    (r"(q|k|v)_proj\.weight$", (None, "tp")),
+    (r"(q|k|v)_proj\.bias$", ("tp",)),
+    (r"out_proj\.weight$", ("tp", None)),
+    (r"linear1\.weight$", (None, "tp")),
+    (r"linear1\.bias$", ("tp",)),
+    (r"linear2\.weight$", ("tp", None)),
+    (r"word_embeddings\.weight$", ("tp", None)),
+    (r"experts\..*weight_in$", ("ep", None, "tp")),
+    (r"experts\..*weight_out$", ("ep", "tp", None)),
+])
+
+
+def infer_param_specs(params: Dict[str, object],
+                      rules: Optional[ShardingRules]) -> Dict[str, object]:
+    """name→PartitionSpec for a flat {name: array} param tree."""
+    from jax.sharding import PartitionSpec as P
+
+    out = {}
+    for name, arr in params.items():
+        if rules is None:
+            out[name] = P()
+        else:
+            out[name] = rules.spec_for(name, getattr(arr, "ndim", 0))
+    return out
+
+
+def named_sharding(spec, mesh: Optional[DeviceMesh] = None):
+    import jax
+
+    m = (mesh or get_mesh()).mesh
+    # drop axis names the mesh doesn't know (lets the same rules run on a
+    # dp-only mesh)
+    from jax.sharding import PartitionSpec as P
+
+    def clean(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(e for e in entry if e in m.axis_names)
+            return kept if kept else None
+        return entry if entry in m.axis_names else None
+
+    spec = P(*[clean(e) for e in spec])
+    return jax.sharding.NamedSharding(m, spec)
+
+
+def batch_sharding(mesh: Optional[DeviceMesh] = None, axes=("dp",)):
+    """Sharding for a batch input: leading dim over dp (and ep when the mesh
+    carries one, since ep rides the data axis between MoE layers)."""
+    from jax.sharding import PartitionSpec as P
+
+    m = mesh or get_mesh()
+    first = tuple(a for a in axes if m.axis_size(a) > 1) or None
+    if first and len(first) == 1:
+        first = first[0]
+    return named_sharding(P(first), m)

@@ -1,0 +1,281 @@
+"""SPMD train step: the TPU-native ParallelExecutor.
+
+Reference parity: framework/parallel_executor.cc + details/ (SSA graph over
+devices, AllReduceOpHandle per grad, grad bucketing via
+fuse_all_reduce_op_pass, overlap of compute and comm by the threaded
+executors) and the meta-optimizer rewrites (recompute → jax.remat, gradient
+merge → lax.scan microbatch loop, AMP → bf16 compute dtype). TPU-native
+design: ONE jitted function owns forward+backward+update for the whole step;
+parameters, optimizer state, and batch are laid out by NamedShardings and XLA
+inserts/fuses/overlaps every collective (ICI) — grad bucketing and comm
+scheduling come from the compiler's latency-hiding scheduler, not from
+hand-built op handles.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..optimizer import functional as fopt
+from .functional import functionalize
+from .mesh import DeviceMesh, get_mesh
+from .sharding import (ShardingRules, batch_sharding, infer_param_specs,
+                       named_sharding)
+
+
+class SpmdTrainer:
+    """Owns sharded (params, opt_state, buffers) and a compiled train step.
+
+    loss_fn(outputs, labels) -> scalar, over raw jax arrays.
+    Batches are (inputs_tuple, labels) of raw arrays / np arrays.
+    """
+
+    def __init__(self, layer, loss_fn: Callable, optimizer,
+                 mesh: Optional[DeviceMesh] = None,
+                 rules: Optional[ShardingRules] = None,
+                 remat: bool = False, grad_accum: int = 1,
+                 compute_dtype=None, donate: bool = True,
+                 batch_axes=("dp",)):
+        import jax
+
+        self.mesh = mesh or get_mesh()
+        self.fm = functionalize(layer)
+        self.loss_fn = loss_fn
+        self.tx = optimizer if isinstance(optimizer, fopt.Transform) \
+            else fopt.from_eager(optimizer)
+        self.remat = remat
+        self.grad_accum = int(grad_accum)
+        self.compute_dtype = compute_dtype
+        self.batch_axes = batch_axes
+        self._step_fn = None
+        self._eval_fn = None
+
+        params = self.fm.params()
+        buffers = self.fm.buffers()
+        self.param_specs = infer_param_specs(params, rules)
+        self.param_shardings = {
+            n: named_sharding(s, self.mesh)
+            for n, s in self.param_specs.items()}
+        self._repl = named_sharding((), self.mesh)
+
+        # place initial state onto the mesh
+        self.params = {
+            n: jax.device_put(v, self.param_shardings[n])
+            for n, v in params.items()}
+        self.buffers = {
+            n: jax.device_put(v, self._repl) for n, v in buffers.items()}
+        self._opt_shardings = None
+        with self.mesh.mesh:
+            self.opt_state = jax.jit(
+                self.tx.init,
+                out_shardings=self._opt_state_shardings())(self.params)
+        self._rng = None
+        self._donate = donate
+
+    def _opt_state_shardings(self):
+        """Optimizer slots inherit their parameter's sharding (the free
+        ZeRO-lite: a tp/ep-sharded param gets tp/ep-sharded moments).
+        Computed once and cached."""
+        import jax
+
+        if self._opt_shardings is not None:
+            return self._opt_shardings
+
+        def shard_like(tree):
+            if isinstance(tree, dict):
+                return {n: self.param_shardings.get(n, self._repl)
+                        for n in tree}
+            return jax.tree_util.tree_map(lambda _: self._repl, tree)
+
+        probe = jax.eval_shape(self.tx.init, self.params)
+        if hasattr(probe, "_fields"):  # NamedTuple of slots
+            out = type(probe)(*[
+                shard_like(getattr(probe, f)) if isinstance(
+                    getattr(probe, f), dict) else self._repl
+                for f in probe._fields])
+        else:
+            out = jax.tree_util.tree_map(lambda _: self._repl, probe)
+        self._opt_shardings = out
+        return out
+
+    # ------------------------------------------------------------------
+    def _forward_loss(self, params, buffers, rng, inputs, labels):
+        import jax
+
+        if self.compute_dtype is not None:
+            cast = lambda t: t.astype(self.compute_dtype) if hasattr(  # noqa
+                t, "dtype") and "float" in str(t.dtype) else t
+            params = {n: cast(v) for n, v in params.items()}
+
+        apply = self.fm.apply
+        if self.remat:
+            raw = lambda p, b, r, *xs: apply(p, b, r, *xs, training=True)  # noqa
+            out, new_buf = jax.checkpoint(raw)(params, buffers, rng, *inputs)
+        else:
+            out, new_buf = apply(params, buffers, rng, *inputs,
+                                 training=True)
+        loss = self.loss_fn(out, labels)
+        if hasattr(loss, "_data"):  # paddle Tensor from a paddle loss fn
+            loss = loss._data
+        return loss.astype("float32").mean(), new_buf
+
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        accum = self.grad_accum
+
+        def step(params, opt_state, buffers, rng, inputs, labels):
+            grad_fn = jax.value_and_grad(self._forward_loss, has_aux=True)
+
+            if accum > 1:
+                # gradient merge (optimizer.py:4994 GradientMergeOptimizer):
+                # microbatch scan, grads averaged in fp32
+                def micro(carry, mb):
+                    g_acc, l_acc, bufs, key = carry
+                    key, sub = jax.random.split(key)
+                    (loss, bufs), grads = grad_fn(
+                        params, bufs, sub, mb[:-1], mb[-1])
+                    g_acc = jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(jnp.float32) / accum,
+                        g_acc, grads)
+                    return (g_acc, l_acc + loss / accum, bufs, key), None
+
+                g0 = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                mb_stack = tuple(
+                    x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
+                    for x in tuple(inputs) + (labels,))
+                (grads, loss, buffers, _), _ = jax.lax.scan(
+                    micro, (g0, jnp.zeros((), jnp.float32), buffers, rng),
+                    mb_stack)
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: g.astype(p.dtype), grads, params)
+            else:
+                (loss, buffers), grads = grad_fn(
+                    params, buffers, rng, tuple(inputs), labels)
+
+            new_params, new_opt = self.tx.update(params, grads, opt_state)
+            return new_params, new_opt, buffers, loss
+
+        self._raw_step = step
+
+        in_shardings = (
+            self.param_shardings,
+            self._opt_state_shardings(),
+            {n: self._repl for n in self.buffers},
+            self._repl,
+            None, None,  # data: let jit take what step() receives
+        )
+        out_shardings = (
+            self.param_shardings,
+            self._opt_state_shardings(),
+            {n: self._repl for n in self.buffers},
+            self._repl,
+        )
+        donate = (0, 1, 2) if self._donate else ()
+        with self.mesh.mesh:
+            self._step_fn = jax.jit(
+                step, in_shardings=in_shardings,
+                out_shardings=out_shardings, donate_argnums=donate)
+        return self._step_fn
+
+    # ------------------------------------------------------------------
+    def shard_batch(self, *arrays):
+        """Place host batch arrays onto the mesh, leading dim over dp."""
+        import jax
+        import jax.numpy as jnp
+
+        out = []
+        for a in arrays:
+            arr = jnp.asarray(a)
+            out.append(jax.device_put(
+                arr, batch_sharding(self.mesh, self.batch_axes)))
+        return tuple(out)
+
+    def step(self, inputs, labels, rng=None):
+        import jax
+
+        if self._step_fn is None:
+            self._build_step()
+        if rng is None:
+            from ..core import random as _random
+
+            rng = _random.next_key()
+        inputs = tuple(inputs) if isinstance(inputs, (list, tuple)) \
+            else (inputs,)
+        data = self.shard_batch(*inputs, labels)
+        inputs, labels = data[:-1], data[-1]
+        self.params, self.opt_state, self.buffers, loss = self._step_fn(
+            self.params, self.opt_state, self.buffers, rng, inputs, labels)
+        return loss
+
+    def run_steps(self, inputs, labels, n_steps, rng=None):
+        """Run n_steps updates on one batch inside a single jitted lax.scan
+        (the TPU-native inner training loop: one dispatch, zero host
+        round-trips between steps). Returns the final loss."""
+        import jax
+
+        if rng is None:
+            from ..core import random as _random
+
+            rng = _random.next_key()
+        inputs = tuple(inputs) if isinstance(inputs, (list, tuple)) \
+            else (inputs,)
+        data = self.shard_batch(*inputs, labels)
+        inputs, labels = data[:-1], data[-1]
+
+        key = f"_loop_{n_steps}"
+        loop = self.__dict__.get(key)
+        if loop is None:
+            if self._step_fn is None:
+                self._build_step()
+            raw_step = self._raw_step
+
+            def run(params, opt_state, buffers, rng, inp, lab):
+                def body(carry, key_t):
+                    params, opt_state, buffers = carry
+                    params, opt_state, buffers, loss = raw_step(
+                        params, opt_state, buffers, key_t, inp, lab)
+                    return (params, opt_state, buffers), loss
+
+                keys = jax.random.split(rng, n_steps)
+                (params, opt_state, buffers), losses = jax.lax.scan(
+                    body, (params, opt_state, buffers), keys)
+                return params, opt_state, buffers, losses[-1]
+
+            with self.mesh.mesh:
+                loop = jax.jit(run, donate_argnums=(0, 1, 2))
+            self.__dict__[key] = loop
+        self.params, self.opt_state, self.buffers, loss = loop(
+            self.params, self.opt_state, self.buffers, rng, inputs, labels)
+        return loss
+
+    def eval_step(self, inputs):
+        import jax
+
+        if self._eval_fn is None:
+            def ev(params, buffers, inputs):
+                if self.compute_dtype is not None:
+                    cast = lambda t: t.astype(self.compute_dtype) if hasattr(  # noqa
+                        t, "dtype") and "float" in str(t.dtype) else t
+                    params = {n: cast(v) for n, v in params.items()}
+                out, _ = self.fm.apply(params, buffers, None, *inputs,
+                                       training=False)
+                return out
+
+            with self.mesh.mesh:
+                self._eval_fn = jax.jit(ev)
+        inputs = tuple(inputs) if isinstance(inputs, (list, tuple)) \
+            else (inputs,)
+        return self._eval_fn(self.params, self.buffers,
+                             self.shard_batch(*inputs))
+
+    def sync_to_layer(self):
+        """Write the trained state back into the eager Layer."""
+        self.fm.load(self.params, self.buffers)
+
+
+def spmd_data_parallel(layer, loss_fn, optimizer, **kw):
+    """Convenience: pure-DP trainer over every visible device — the direct
+    replacement for CompiledProgram.with_data_parallel."""
+    return SpmdTrainer(layer, loss_fn, optimizer, **kw)

@@ -53,3 +53,9 @@ class Imdb(Dataset):
         raise RuntimeError(
             "no network egress: use FakeTextDataset or provide a local "
             "aclImdb tar via data_file (loader lands with the text op set)")
+
+
+from . import models  # noqa: F401,E402
+from .models import (ErnieConfig, ErnieForPretraining,  # noqa: F401,E402
+                     ErnieForSequenceClassification, ErnieModel, ernie_base,
+                     ernie_tiny)

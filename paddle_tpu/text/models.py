@@ -1,0 +1,143 @@
+"""Flagship transformer models (ERNIE/BERT-family encoders).
+
+Reference parity: the reference framework itself ships no ERNIE model code
+(it lives in PaddleNLP), but ERNIE-base is the reference's headline NLP
+benchmark workload (BASELINE.md config 3) and the fused attention kernels
+(operators/fused/multihead_matmul_op.cc, math/bert_encoder_functor.cu) exist
+to serve it. Here the model is a first-class citizen built on paddle_tpu.nn,
+bf16-friendly, with parameter names matching parallel.sharding.COMMON_TP_RULES
+so tp/sp sharding is declarative.
+"""
+from __future__ import annotations
+
+from .. import nn
+
+
+class ErnieConfig:
+    def __init__(self, vocab_size=18000, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=3072, max_position=513,
+                 type_vocab_size=2, hidden_dropout=0.1, attn_dropout=0.1,
+                 num_classes=2):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position = max_position
+        self.type_vocab_size = type_vocab_size
+        self.hidden_dropout = hidden_dropout
+        self.attn_dropout = attn_dropout
+        self.num_classes = num_classes
+
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        d = dict(vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
+                 intermediate_size=128, max_position=128)
+        d.update(kw)
+        return cls(**d)
+
+
+class ErnieEmbeddings(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position,
+                                                cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        from ..tensor import ops as T
+
+        seq_len = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = T.arange(0, seq_len, dtype="int64")
+            position_ids = T.unsqueeze(position_ids, 0)
+        if token_type_ids is None:
+            token_type_ids = T.zeros_like(input_ids)
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class ErnieModel(nn.Layer):
+    """BERT/ERNIE encoder. attention_mask: (B, S) 1/0 valid-token mask."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = ErnieEmbeddings(cfg)
+        enc_layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_heads, cfg.intermediate_size,
+            dropout=cfg.hidden_dropout, activation="gelu",
+            attn_dropout=cfg.attn_dropout)
+        self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.pooler_act = nn.Tanh()
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        from ..tensor import ops as T
+
+        if attention_mask is not None:
+            # (B, S) -> additive (B, 1, 1, S) broadcast over heads/queries
+            m = T.unsqueeze(attention_mask, [1, 2])
+            mask = (1.0 - m.astype("float32")) * -1e4
+        else:
+            mask = None
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        seq_out = self.encoder(x, mask)
+        pooled = self.pooler_act(self.pooler(seq_out[:, 0]))
+        return seq_out, pooled
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+        self.classifier = nn.Linear(cfg.hidden_size, cfg.num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.ernie(input_ids, token_type_ids,
+                               attention_mask=attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class ErnieForPretraining(nn.Layer):
+    """MLM head (tied to word embeddings) + NSP head."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.mlm_norm = nn.LayerNorm(cfg.hidden_size)
+        self.nsp = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        from .. import nn as _nn
+        from ..tensor import ops as T
+
+        seq_out, pooled = self.ernie(input_ids, token_type_ids,
+                                     attention_mask=attention_mask)
+        h = self.mlm_norm(_nn.functional.gelu(self.mlm_transform(seq_out)))
+        # tied decoder: logits = h @ word_emb.T
+        w = self.ernie.embeddings.word_embeddings.weight
+        mlm_logits = T.matmul(h, w, transpose_y=True)
+        nsp_logits = self.nsp(pooled)
+        return mlm_logits, nsp_logits
+
+
+def ernie_base(**kw):
+    return ErnieModel(ErnieConfig.base(**kw))
+
+
+def ernie_tiny(**kw):
+    return ErnieModel(ErnieConfig.tiny(**kw))

@@ -1,0 +1,313 @@
+"""SPMD engine tests on the 8-device virtual CPU mesh (conftest.py).
+
+Mirrors the reference's distributed test strategy (SURVEY.md §4.3:
+TestDistBase fakes a cluster with subprocesses; we fake a pod with
+xla_force_host_platform_device_count) — but checks the TPU-native path:
+mesh/sharding/pjit train steps, ring attention, pipeline schedule.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.parallel import (SpmdTrainer, auto_mesh, functionalize,
+                                 init_mesh, ring_attention)
+from paddle_tpu.optimizer import functional as fopt
+
+
+def make_mlp():
+    return nn.Sequential(
+        nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def ce_loss(logits, labels):
+    import jax
+    import jax.numpy as jnp
+
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    return -jnp.take_along_axis(logp, labels[:, None], -1).mean()
+
+
+class TestMesh:
+    def test_init_mesh_shapes(self):
+        m = init_mesh(dp=2, tp=2, pp=2)
+        assert m.shape == {"dp": 2, "pp": 2, "tp": 2, "sp": 1, "ep": 1}
+
+    def test_auto_mesh(self):
+        m = auto_mesh(8, want_tp=True)
+        assert np.prod(list(m.shape.values())) == 8
+        assert m.axis_size("tp") >= 2
+
+    def test_bad_mesh(self):
+        with pytest.raises(ValueError):
+            init_mesh(dp=3, tp=5)
+
+
+class TestFunctionalize:
+    def test_pure_apply_matches_eager(self):
+        net = make_mlp()
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        eager = net(x).numpy()
+        fm = functionalize(net)
+        out, _ = fm.apply(fm.params(), fm.buffers(), None, x._data,
+                          training=False)
+        np.testing.assert_allclose(eager, np.asarray(out), rtol=1e-6)
+
+    def test_layer_state_untouched(self):
+        net = make_mlp()
+        fm = functionalize(net)
+        before = {k: v.copy() for k, v in fm.params().items()}
+        params = {k: v * 0 for k, v in fm.params().items()}
+        fm.apply(params, fm.buffers(), None,
+                 np.zeros((2, 8), "float32"), training=False)
+        for k, v in fm.params().items():
+            np.testing.assert_array_equal(np.asarray(v),
+                                          np.asarray(before[k]))
+
+    def test_batchnorm_buffers_updated(self):
+        net = nn.Sequential(nn.Linear(8, 8), nn.BatchNorm1D(8))
+        fm = functionalize(net)
+        x = np.random.randn(16, 8).astype("float32")
+        _, new_buf = fm.apply(fm.params(), fm.buffers(), None, x,
+                              training=True)
+        changed = any(
+            not np.allclose(np.asarray(new_buf[k]),
+                            np.asarray(fm.buffers()[k]))
+            for k in new_buf)
+        assert changed
+
+    def test_dropout_traced_rng(self):
+        import jax
+
+        net = nn.Dropout(0.5)
+        fm = functionalize(net)
+        x = np.ones((64,), "float32")
+
+        @jax.jit
+        def f(key):
+            out, _ = fm.apply({}, {}, key, x, training=True)
+            return out
+
+        a = np.asarray(f(jax.random.PRNGKey(0)))
+        b = np.asarray(f(jax.random.PRNGKey(1)))
+        assert not np.array_equal(a, b)  # key actually threads through
+        assert ((a == 0) | (a == 2.0)).all()
+
+
+class TestSpmdTrainer:
+    def test_dp_training_reduces_loss(self):
+        init_mesh(dp=8)
+        net = make_mlp()
+        tr = SpmdTrainer(net, ce_loss, fopt.adam(1e-2))
+        x = np.random.randn(32, 8).astype("float32")
+        y = (x.sum(1) > 0).astype("int64")
+        first = float(tr.step((x,), y))
+        for _ in range(30):
+            last = float(tr.step((x,), y))
+        assert last < first * 0.5
+
+    def test_dp_matches_single_device(self):
+        # same data, same init => same loss trajectory on dp=1 vs dp=8
+        x = np.random.randn(16, 8).astype("float32")
+        y = (x.sum(1) > 0).astype("int64")
+        losses = []
+        for dp in (1, 8):
+            paddle.seed(0)
+            if dp == 1:
+                import jax
+
+                init_mesh(dp=1, devices=jax.devices()[:1])
+            else:
+                init_mesh(dp=8)
+            net = make_mlp()
+            tr = SpmdTrainer(net, ce_loss, fopt.sgd(0.1))
+            ls = [float(tr.step((x,), y,
+                                rng=__import__("jax").random.PRNGKey(7)))
+                  for _ in range(5)]
+            losses.append(ls)
+        np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+
+    def test_tp_sharded_params(self):
+        from paddle_tpu.parallel import COMMON_TP_RULES
+        from paddle_tpu.text import ErnieConfig, \
+            ErnieForSequenceClassification
+
+        init_mesh(dp=2, tp=4)
+        net = ErnieForSequenceClassification(ErnieConfig.tiny())
+        tr = SpmdTrainer(net, ce_loss, fopt.adamw(1e-3),
+                         rules=COMMON_TP_RULES)
+        # qkv weights must actually be sharded over tp
+        name = next(n for n in tr.params if n.endswith("q_proj.weight"))
+        shard_shape = tr.params[name].sharding.shard_shape(
+            tr.params[name].shape)
+        assert shard_shape[1] == tr.params[name].shape[1] // 4
+        ids = np.random.randint(1, 1000, (8, 16)).astype("int64")
+        y = np.random.randint(0, 2, (8,)).astype("int64")
+        l0 = float(tr.step((ids,), y))
+        l5 = l0
+        for _ in range(5):
+            l5 = float(tr.step((ids,), y))
+        assert np.isfinite(l5) and l5 < l0
+
+    def test_grad_accum_equals_big_batch(self):
+        x = np.random.randn(16, 8).astype("float32")
+        y = (x.sum(1) > 0).astype("int64")
+        import jax
+
+        outs = []
+        for accum in (1, 4):
+            paddle.seed(0)
+            init_mesh(dp=1, devices=jax.devices()[:1])
+            net = make_mlp()
+            tr = SpmdTrainer(net, ce_loss, fopt.sgd(0.1),
+                             grad_accum=accum)
+            for _ in range(3):
+                tr.step((x,), y, rng=jax.random.PRNGKey(3))
+            outs.append({k: np.asarray(v) for k, v in tr.params.items()})
+        for k in outs[0]:
+            np.testing.assert_allclose(outs[0][k], outs[1][k], rtol=2e-4,
+                                       atol=1e-5)
+
+    def test_remat(self):
+        init_mesh(dp=8)
+        net = make_mlp()
+        tr = SpmdTrainer(net, ce_loss, fopt.sgd(0.1), remat=True)
+        x = np.random.randn(8, 8).astype("float32")
+        y = np.zeros((8,), "int64")
+        assert np.isfinite(float(tr.step((x,), y)))
+
+    def test_sync_to_layer(self):
+        import jax
+
+        init_mesh(dp=1, devices=jax.devices()[:1])
+        net = make_mlp()
+        w_before = net[0].weight.numpy().copy()
+        tr = SpmdTrainer(net, ce_loss, fopt.sgd(1.0))
+        x = np.random.randn(8, 8).astype("float32")
+        tr.step((x,), np.zeros((8,), "int64"))
+        tr.sync_to_layer()
+        assert not np.allclose(net[0].weight.numpy(), w_before)
+
+
+class TestRingAttention:
+    def test_matches_reference(self):
+        from paddle_tpu.ops.attention import sdpa_reference
+
+        init_mesh(sp=8)
+        b, h, s, d = 2, 4, 64, 16
+        rng = np.random.RandomState(0)
+        q = rng.randn(b, h, s, d).astype("float32")
+        k = rng.randn(b, h, s, d).astype("float32")
+        v = rng.randn(b, h, s, d).astype("float32")
+        ref = np.asarray(sdpa_reference(q, k, v))
+        out = np.asarray(ring_attention(q, k, v, axis_name="sp"))
+        np.testing.assert_allclose(ref, out, rtol=2e-4, atol=2e-5)
+
+    def test_causal_matches_reference(self):
+        from paddle_tpu.ops.attention import sdpa_reference
+
+        init_mesh(sp=4, dp=2)
+        b, h, s, d = 1, 2, 32, 8
+        rng = np.random.RandomState(1)
+        q = rng.randn(b, h, s, d).astype("float32")
+        k = rng.randn(b, h, s, d).astype("float32")
+        v = rng.randn(b, h, s, d).astype("float32")
+        ref = np.asarray(sdpa_reference(q, k, v, is_causal=True))
+        out = np.asarray(ring_attention(q, k, v, axis_name="sp",
+                                        is_causal=True))
+        np.testing.assert_allclose(ref, out, rtol=2e-4, atol=2e-5)
+
+
+class TestPipeline:
+    def test_gpipe_matches_sequential(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.parallel import pipeline_spmd_fn
+        from paddle_tpu.parallel.pipeline import stack_stage_params
+
+        m = init_mesh(pp=8)
+        rng = np.random.RandomState(0)
+        stages = [{"w": rng.randn(8, 8).astype("float32") * 0.3}
+                  for _ in range(8)]
+
+        def stage_apply(p, x):
+            return jnp.tanh(x @ p["w"])
+
+        mb = rng.randn(4, 2, 8).astype("float32")  # 4 microbatches
+        # sequential reference
+        ref = mb.reshape(8, 8)
+        for p in stages:
+            ref = np.tanh(ref @ p["w"])
+        ref = ref.reshape(4, 2, 8)
+
+        fn = pipeline_spmd_fn(stage_apply, mesh=m)
+        stacked = stack_stage_params(stages)
+        with m.mesh:
+            out = np.asarray(jax.jit(fn)(stacked, mb))
+        np.testing.assert_allclose(ref, out, rtol=1e-5, atol=1e-6)
+
+    def test_gpipe_differentiable(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.parallel import pipeline_spmd_fn
+        from paddle_tpu.parallel.pipeline import stack_stage_params
+
+        m = init_mesh(pp=4, dp=2)
+        rng = np.random.RandomState(0)
+        stages = [{"w": rng.randn(4, 4).astype("float32") * 0.3}
+                  for _ in range(4)]
+        stacked = stack_stage_params(stages)
+        mb = rng.randn(2, 2, 4).astype("float32")
+        fn = pipeline_spmd_fn(stage_apply=lambda p, x: jnp.tanh(x @ p["w"]),
+                              mesh=m)
+
+        def loss(params):
+            return (fn(params, mb) ** 2).sum()
+
+        with m.mesh:
+            g = jax.jit(jax.grad(loss))(stacked)
+        assert np.isfinite(np.asarray(g["w"])).all()
+        assert np.abs(np.asarray(g["w"])).sum() > 0
+
+
+class TestFromEager:
+    def test_lr_schedule_runs_on_device(self):
+        import jax
+
+        from paddle_tpu.optimizer.lr import StepDecay
+
+        init_mesh(dp=1, devices=__import__("jax").devices()[:1])
+        net = make_mlp()
+        sched = StepDecay(learning_rate=0.5, step_size=2, gamma=0.1)
+        opt = paddle.optimizer.SGD(sched, parameters=net.parameters())
+        tr = SpmdTrainer(net, ce_loss, opt)
+        x = np.random.randn(8, 8).astype("float32")
+        y = np.zeros((8,), "int64")
+        # steps 0,1 use lr=0.5; steps 2,3 use lr=0.05: param deltas shrink
+        w0 = np.asarray(tr.params[list(tr.params)[0]]).copy()
+        tr.step((x,), y, rng=jax.random.PRNGKey(0))
+        tr.step((x,), y, rng=jax.random.PRNGKey(0))
+        w2 = np.asarray(tr.params[list(tr.params)[0]]).copy()
+        tr.step((x,), y, rng=jax.random.PRNGKey(0))
+        w3 = np.asarray(tr.params[list(tr.params)[0]]).copy()
+        big = np.abs(w2 - w0).max() / 2
+        small = np.abs(w3 - w2).max()
+        assert small < big * 0.5  # decayed lr shows up on-device
+
+    def test_grad_clip_carried_over(self):
+        from paddle_tpu import nn as pnn
+
+        init_mesh(dp=1, devices=__import__("jax").devices()[:1])
+        net = make_mlp()
+        opt = paddle.optimizer.SGD(
+            10.0, parameters=net.parameters(),
+            grad_clip=pnn.ClipGradByGlobalNorm(1e-6))
+        tr = SpmdTrainer(net, ce_loss, opt)
+        w0 = {k: np.asarray(v).copy() for k, v in tr.params.items()}
+        x = np.random.randn(8, 8).astype("float32") * 100
+        tr.step((x,), np.zeros((8,), "int64"))
+        # with clip_norm 1e-6 and lr 10, the update is ~1e-5-scale, not huge
+        for k in w0:
+            assert np.abs(np.asarray(tr.params[k]) - w0[k]).max() < 1e-3
