@@ -9,13 +9,13 @@ from __future__ import annotations
 
 import itertools
 import math
-import queue
-import threading
 
 import numpy as np
 
 from ..core import random as _random
 from ..core.tensor import Tensor
+from .dataloader_iter import (MultiprocessIter, ThreadPrefetcher,  # noqa: F401
+                              WorkerInfo)
 from .serialization import load, save  # noqa: F401
 
 
@@ -32,10 +32,10 @@ class IterableDataset(Dataset):
         raise NotImplementedError
 
     def __getitem__(self, idx):
-        raise RuntimeError("IterableDataset has no __getitem__")
+        raise TypeError("IterableDataset has no __getitem__")
 
     def __len__(self):
-        raise RuntimeError("IterableDataset has no __len__")
+        raise TypeError("IterableDataset has no __len__")
 
 
 class TensorDataset(Dataset):
@@ -250,11 +250,14 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, use_multiprocess=True):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch = max(2, prefetch_factor)
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self._use_mp = use_multiprocess and use_shared_memory
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -287,28 +290,29 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in idxs])
 
     def __iter__(self):
-        gen = self._batches()
         if self.num_workers <= 0:
-            for b in gen:
+            for b in self._batches():
                 yield _to_tensors(b)
             return
-        # threaded prefetch pipeline (the buffered_reader.cc equivalent)
-        q = queue.Queue(maxsize=self.prefetch * max(1, self.num_workers))
-        stop = object()
-
-        def producer():
+        if self._use_mp:
+            it = MultiprocessIter(
+                self.dataset,
+                None if self._iterable_mode else self.batch_sampler,
+                self.collate_fn, self.num_workers,
+                prefetch_factor=self.prefetch,
+                worker_init_fn=self.worker_init_fn, timeout=self.timeout,
+                iterable=self._iterable_mode,
+                batch_size=self.batch_size if self._iterable_mode else 1)
             try:
-                for b in gen:
-                    q.put(b)
+                for b in it:
+                    yield _to_tensors(b)
             finally:
-                q.put(stop)
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        while True:
-            b = q.get()
-            if b is stop:
-                break
+                it.shutdown()
+            return
+        # threaded prefetch pipeline (the buffered_reader.cc equivalent)
+        for b in ThreadPrefetcher(
+                self._batches(),
+                depth=self.prefetch * max(1, self.num_workers)):
             yield _to_tensors(b)
 
 
@@ -323,4 +327,6 @@ def _to_tensors(batch):
 
 
 def get_worker_info():
-    return None
+    from .dataloader_iter import get_worker_info as _gwi
+
+    return _gwi()
