@@ -1,0 +1,77 @@
+// Host staging-buffer arena: best-fit free-list allocator with chunked
+// growth. Capability parity with the reference's
+// memory/allocation/auto_growth_best_fit_allocator.h — on TPU, XLA owns
+// device HBM, so the native allocator's surviving job is host-side staging
+// buffers (feed batches, checkpoint IO) with low fragmentation and stats.
+#pragma once
+
+#include <cstdint>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace ptcore {
+
+class Arena {
+ public:
+  explicit Arena(size_t chunk_bytes = 64 << 20, size_t alignment = 64)
+      : chunk_(chunk_bytes), align_(alignment) {}
+  ~Arena() {
+    for (void* c : chunks_) std::free(c);
+  }
+
+  void* Alloc(size_t n) {
+    std::lock_guard<std::mutex> lk(mu_);
+    n = RoundUp(n);
+    auto it = free_.lower_bound(n);  // best fit: smallest block >= n
+    if (it == free_.end()) {
+      Grow(n);
+      it = free_.lower_bound(n);
+    }
+    size_t bsz = it->first;
+    char* p = it->second;
+    free_.erase(it);
+    if (bsz > n + align_) {  // split remainder back to free list
+      free_.emplace(bsz - n, p + n);
+      bsz = n;
+    }
+    live_[p] = bsz;
+    in_use_ += bsz;
+    peak_ = in_use_ > peak_ ? in_use_ : peak_;
+    return p;
+  }
+
+  void Free(void* p) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = live_.find((char*)p);
+    if (it == live_.end()) return;
+    in_use_ -= it->second;
+    free_.emplace(it->second, it->first);
+    live_.erase(it);
+  }
+
+  size_t InUse() const { return in_use_; }
+  size_t Peak() const { return peak_; }
+  size_t Reserved() const { return reserved_; }
+
+ private:
+  size_t RoundUp(size_t n) const { return (n + align_ - 1) / align_ * align_; }
+  void Grow(size_t need) {
+    size_t sz = need > chunk_ ? RoundUp(need) : chunk_;
+    void* c = std::aligned_alloc(align_, sz);
+    chunks_.push_back(c);
+    reserved_ += sz;
+    free_.emplace(sz, (char*)c);
+  }
+
+  std::mutex mu_;
+  size_t chunk_, align_;
+  std::multimap<size_t, char*> free_;
+  std::unordered_map<char*, size_t> live_;
+  std::vector<void*> chunks_;
+  size_t in_use_ = 0, peak_ = 0, reserved_ = 0;
+};
+
+}  // namespace ptcore

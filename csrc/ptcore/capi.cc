@@ -1,0 +1,173 @@
+// extern "C" surface for ctypes (paddle_tpu/core/native.py).
+//
+// The reference exposes its native runtime through pybind11
+// (paddle/fluid/pybind/pybind.cc); here the binding layer is a flat C ABI
+// so no build-time Python dependency exists — the Python side wraps these
+// with ctypes and numpy zero-copy views.
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "arena.h"
+#include "datafeed.h"
+#include "saveload.h"
+
+namespace ptcore {
+// fs.cc
+std::vector<std::string> FsGlob(const std::string&);
+bool FsExists(const std::string&);
+bool FsMkdirP(const std::string&);
+int64_t FsFileSize(const std::string&);
+int ShellExec(const std::string&, std::string*);
+}  // namespace ptcore
+
+using namespace ptcore;
+
+extern "C" {
+
+// ---------- version ----------
+const char* pt_version() { return "ptcore-0.1"; }
+
+// ---------- arena ----------
+void* pt_arena_create(uint64_t chunk_bytes) {
+  return new Arena(chunk_bytes ? chunk_bytes : (64u << 20));
+}
+void pt_arena_destroy(void* a) { delete (Arena*)a; }
+void* pt_arena_alloc(void* a, uint64_t n) { return ((Arena*)a)->Alloc(n); }
+void pt_arena_free(void* a, void* p) { ((Arena*)a)->Free(p); }
+uint64_t pt_arena_in_use(void* a) { return ((Arena*)a)->InUse(); }
+uint64_t pt_arena_peak(void* a) { return ((Arena*)a)->Peak(); }
+uint64_t pt_arena_reserved(void* a) { return ((Arena*)a)->Reserved(); }
+
+// ---------- datafeed ----------
+// slot spec strings: name, is_float (0/1), dense_dim
+void* pt_feed_create(int nslots, const char** names, const int* is_float,
+                     const int* dense_dim, int num_threads) {
+  std::vector<SlotConf> slots;
+  for (int i = 0; i < nslots; ++i)
+    slots.push_back(SlotConf{names[i], is_float[i] != 0, dense_dim[i]});
+  return new DataFeed(std::move(slots), num_threads, 4096);
+}
+void pt_feed_destroy(void* h) { delete (DataFeed*)h; }
+void pt_feed_add_file(void* h, const char* path) {
+  ((DataFeed*)h)->AddFile(path);
+}
+void pt_feed_start(void* h, int batch_size, int64_t shuffle_buf,
+                   uint64_t seed) {
+  ((DataFeed*)h)->Start(batch_size, shuffle_buf, seed);
+}
+void pt_feed_stop(void* h) { ((DataFeed*)h)->Stop(); }
+int64_t pt_feed_samples_seen(void* h) {
+  return ((DataFeed*)h)->samples_seen();
+}
+const char* pt_feed_error(void* h) { return ((DataFeed*)h)->error().c_str(); }
+
+// Pops the next batch; returns an opaque Batch* or NULL at epoch end.
+void* pt_feed_next(void* h) { return ((DataFeed*)h)->Next().release(); }
+void pt_batch_destroy(void* b) { delete (Batch*)b; }
+int64_t pt_batch_size(void* b) { return ((Batch*)b)->batch_size; }
+// Per-slot accessors. slot_idx follows the feed's declared slot order;
+// fslot/islot index within float/int slots respectively.
+int64_t pt_batch_values_len(void* bp, int is_float, int sub_idx) {
+  Batch* b = (Batch*)bp;
+  return is_float ? (int64_t)b->fvals[sub_idx].size()
+                  : (int64_t)b->ivals[sub_idx].size();
+}
+void pt_batch_copy_fvalues(void* bp, int sub_idx, float* out) {
+  Batch* b = (Batch*)bp;
+  memcpy(out, b->fvals[sub_idx].data(), b->fvals[sub_idx].size() * 4);
+}
+void pt_batch_copy_ivalues(void* bp, int sub_idx, int64_t* out) {
+  Batch* b = (Batch*)bp;
+  memcpy(out, b->ivals[sub_idx].data(), b->ivals[sub_idx].size() * 8);
+}
+void pt_batch_copy_offsets(void* bp, int slot_idx, int64_t* out) {
+  Batch* b = (Batch*)bp;
+  memcpy(out, b->offsets[slot_idx].data(), b->offsets[slot_idx].size() * 8);
+}
+
+// ---------- save/load ----------
+int pt_save_tensor(const char* path, uint8_t dtype, const int64_t* dims,
+                   int ndim, const void* data, uint64_t nbytes) {
+  return SaveTensorFile(path, dtype, dims, ndim, data, nbytes) ? 0 : -1;
+}
+void* pt_load_tensor(const char* path) {
+  auto* t = new HostTensor;
+  if (!LoadTensorFile(path, t)) {
+    delete t;
+    return nullptr;
+  }
+  return t;
+}
+uint8_t pt_tensor_dtype(void* t) { return ((HostTensor*)t)->dtype; }
+int pt_tensor_ndim(void* t) { return (int)((HostTensor*)t)->dims.size(); }
+void pt_tensor_dims(void* t, int64_t* out) {
+  auto* ht = (HostTensor*)t;
+  memcpy(out, ht->dims.data(), ht->dims.size() * 8);
+}
+uint64_t pt_tensor_nbytes(void* t) {
+  return (uint64_t)((HostTensor*)t)->data.size();
+}
+void pt_tensor_copy_data(void* t, void* out) {
+  auto* ht = (HostTensor*)t;
+  memcpy(out, ht->data.data(), ht->data.size());
+}
+void pt_tensor_destroy(void* t) { delete (HostTensor*)t; }
+
+void* pt_combine_open(const char* path) { return CombineOpen(path); }
+int pt_combine_add(void* w, const char* name, uint8_t dtype,
+                   const int64_t* dims, int ndim, const void* data,
+                   uint64_t nbytes) {
+  return CombineAdd((CombineWriter*)w, name, dtype, dims, ndim, data, nbytes)
+             ? 0
+             : -1;
+}
+int pt_combine_close(void* w) {
+  return CombineClose((CombineWriter*)w) ? 0 : -1;
+}
+void* pt_combine_load(const char* path) { return CombineLoad(path); }
+int pt_combine_complete(void* r) {
+  return ((CombineReader*)r)->complete ? 1 : 0;
+}
+int pt_combine_count(void* r) {
+  return (int)((CombineReader*)r)->entries.size();
+}
+const char* pt_combine_name(void* r, int i) {
+  return ((CombineReader*)r)->entries[i].first.c_str();
+}
+void* pt_combine_tensor(void* r, int i) {
+  return &((CombineReader*)r)->entries[i].second;
+}
+void pt_combine_destroy(void* r) { delete (CombineReader*)r; }
+
+// ---------- fs / shell ----------
+// Glob: returns count; results retrieved one by one via a thread-local
+// scratch (simple, adequate for a binding layer).
+static thread_local std::vector<std::string> g_glob;
+int pt_fs_glob(const char* pattern) {
+  g_glob = FsGlob(pattern);
+  return (int)g_glob.size();
+}
+const char* pt_fs_glob_get(int i) { return g_glob[(size_t)i].c_str(); }
+int pt_fs_exists(const char* p) { return FsExists(p) ? 1 : 0; }
+int pt_fs_mkdir_p(const char* p) { return FsMkdirP(p) ? 0 : -1; }
+int64_t pt_fs_file_size(const char* p) { return FsFileSize(p); }
+static thread_local std::string g_shell_out;
+int pt_shell_exec(const char* cmd) {
+  g_shell_out.clear();
+  return ShellExec(cmd, &g_shell_out);
+}
+const char* pt_shell_output() { return g_shell_out.c_str(); }
+
+// ---------- profiler ----------
+void pt_prof_enable();
+void pt_prof_disable();
+int pt_prof_enabled();
+uint64_t pt_prof_now_ns();
+void pt_prof_record(const char* name, uint64_t start_ns, uint64_t end_ns);
+int pt_prof_dump(const char* path);
+void pt_prof_clear();
+uint64_t pt_prof_count();
+
+}  // extern "C"

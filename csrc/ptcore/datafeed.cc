@@ -1,0 +1,199 @@
+#include "datafeed.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace ptcore {
+
+DataFeed::DataFeed(std::vector<SlotConf> slots, int num_threads,
+                   size_t queue_cap)
+    : slots_(std::move(slots)),
+      num_threads_(num_threads > 0 ? num_threads : 1),
+      file_q_(1 << 20),
+      record_q_(queue_cap),
+      batch_q_(8) {
+  for (const auto& s : slots_) (s.is_float ? nf_ : ni_)++;
+}
+
+DataFeed::~DataFeed() { Stop(); }
+
+void DataFeed::AddFile(const std::string& path) { files_.push_back(path); }
+
+void DataFeed::Start(int batch_size, int64_t shuffle_buf, uint64_t seed) {
+  Stop();
+  file_q_.Reopen();
+  record_q_.Reopen();
+  batch_q_.Reopen();
+  samples_seen_ = 0;
+  error_.clear();
+  for (const auto& f : files_) {
+    std::string copy = f;
+    file_q_.Push(std::move(copy));
+  }
+  file_q_.Close();  // parsers drain then exit
+  live_parsers_ = num_threads_;
+  parsers_.clear();
+  for (int i = 0; i < num_threads_; ++i)
+    parsers_.emplace_back([this] { ParseWorker(); });
+  assembler_ = std::thread([this, batch_size, shuffle_buf, seed] {
+    AssembleWorker(batch_size, shuffle_buf, seed);
+  });
+  started_ = true;
+}
+
+void DataFeed::Stop() {
+  if (!started_) return;
+  file_q_.Close();
+  record_q_.Close();
+  batch_q_.Close();
+  for (auto& t : parsers_)
+    if (t.joinable()) t.join();
+  if (assembler_.joinable()) assembler_.join();
+  parsers_.clear();
+  started_ = false;
+}
+
+std::unique_ptr<Batch> DataFeed::Next() {
+  std::unique_ptr<Batch> b;
+  if (!batch_q_.Pop(&b)) return nullptr;
+  return b;
+}
+
+bool DataFeed::ParseLine(const char* p, size_t len, Record* rec) {
+  const char* end = p + len;
+  rec->fvals.assign(nf_, {});
+  rec->ivals.assign(ni_, {});
+  int fi = 0, ii = 0;
+  for (const auto& slot : slots_) {
+    char* next = nullptr;
+    long n = strtol(p, &next, 10);
+    if (next == p || n < 0) return false;
+    p = next;
+    if (slot.dense_dim > 0 && n != slot.dense_dim) return false;
+    if (slot.is_float) {
+      auto& v = rec->fvals[fi++];
+      v.reserve(n);
+      for (long k = 0; k < n; ++k) {
+        float x = strtof(p, &next);
+        if (next == p) return false;
+        v.push_back(x);
+        p = next;
+      }
+    } else {
+      auto& v = rec->ivals[ii++];
+      v.reserve(n);
+      for (long k = 0; k < n; ++k) {
+        long long x = strtoll(p, &next, 10);
+        if (next == p) return false;
+        v.push_back((int64_t)x);
+        p = next;
+      }
+    }
+    if (p > end) return false;
+  }
+  return true;
+}
+
+void DataFeed::ParseWorker() {
+  std::string path;
+  while (file_q_.Pop(&path)) {
+    FILE* f = nullptr;
+    // "cmd |" prefix runs a shell producer (the reference reads HDFS via
+    // forked pipes — framework/io/shell.cc); plain paths are fopen'd.
+    bool pipe = path.size() > 1 && path.back() == '|';
+    if (pipe) {
+      std::string cmd = path.substr(0, path.size() - 1);
+      f = popen(cmd.c_str(), "r");
+    } else {
+      f = fopen(path.c_str(), "r");
+    }
+    if (!f) {
+      error_ = "open failed: " + path;
+      continue;
+    }
+    char* line = nullptr;
+    size_t cap = 0;
+    ssize_t got;
+    while ((got = getline(&line, &cap, f)) > 0) {
+      Record rec;
+      if (ParseLine(line, (size_t)got, &rec)) {
+        if (!record_q_.Push(std::move(rec))) break;  // stopped
+        samples_seen_++;
+      }
+    }
+    free(line);
+    if (pipe)
+      pclose(f);
+    else
+      fclose(f);
+  }
+  if (--live_parsers_ == 0) record_q_.Close();
+}
+
+void DataFeed::AssembleWorker(int batch_size, int64_t shuffle_buf,
+                              uint64_t seed) {
+  std::mt19937_64 rng(seed);
+  std::vector<Record> buf;  // shuffle reservoir
+  std::vector<Record> pending;
+  auto emit = [&](std::vector<Record>& rs) -> bool {
+    if (rs.empty()) return true;
+    auto b = std::make_unique<Batch>();
+    b->batch_size = (int64_t)rs.size();
+    b->fvals.assign(nf_, {});
+    b->ivals.assign(ni_, {});
+    b->offsets.assign(slots_.size(), std::vector<int64_t>{0});
+    for (auto& r : rs) {
+      int fi = 0, ii = 0, si = 0;
+      for (const auto& slot : slots_) {
+        if (slot.is_float) {
+          auto& src = r.fvals[fi];
+          auto& dst = b->fvals[fi];
+          dst.insert(dst.end(), src.begin(), src.end());
+          b->offsets[si].push_back((int64_t)dst.size());
+          fi++;
+        } else {
+          auto& src = r.ivals[ii];
+          auto& dst = b->ivals[ii];
+          dst.insert(dst.end(), src.begin(), src.end());
+          b->offsets[si].push_back((int64_t)dst.size());
+          ii++;
+        }
+        si++;
+      }
+    }
+    rs.clear();
+    return batch_q_.Push(std::move(b));
+  };
+
+  Record rec;
+  while (record_q_.Pop(&rec)) {
+    if (shuffle_buf > 0) {
+      if ((int64_t)buf.size() < shuffle_buf) {
+        buf.push_back(std::move(rec));
+        continue;
+      }
+      // swap a random reservoir slot out into the pending batch
+      size_t j = rng() % buf.size();
+      pending.push_back(std::move(buf[j]));
+      buf[j] = std::move(rec);
+    } else {
+      pending.push_back(std::move(rec));
+    }
+    if ((int)pending.size() == batch_size) {
+      if (!emit(pending)) return;
+    }
+  }
+  // drain reservoir (shuffled)
+  for (size_t i = buf.size(); i > 1; --i)
+    std::swap(buf[i - 1], buf[rng() % i]);
+  for (auto& r : buf) {
+    pending.push_back(std::move(r));
+    if ((int)pending.size() == batch_size)
+      if (!emit(pending)) return;
+  }
+  emit(pending);
+  batch_q_.Close();
+}
+
+}  // namespace ptcore

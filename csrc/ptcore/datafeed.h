@@ -1,0 +1,85 @@
+// Out-of-core MultiSlot DataFeed.
+//
+// Capability parity with the reference's framework/data_feed.h
+// (MultiSlotDataFeed / MultiSlotInMemoryDataFeed) + data_set.h
+// (InMemoryDataset shuffle) — re-designed: N parser threads stream text
+// files through a bounded record queue; an assembler thread builds
+// ragged batches (values + LoD offsets per slot) that the host hands to
+// XLA as padded/segment inputs.
+//
+// Text format (one sample per line, slots in declared order):
+//   <n> v1 ... vn  <m> u1 ... um  ...
+// i.e. each slot is a count followed by that many values (float or int64),
+// the same MultiSlot wire format the reference ingests.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "blocking_queue.h"
+
+namespace ptcore {
+
+struct SlotConf {
+  std::string name;
+  bool is_float = true;  // else int64
+  int dense_dim = -1;    // >0: fixed-size slot (validated); -1: ragged
+};
+
+// One sample: per-slot ragged values.
+struct Record {
+  std::vector<std::vector<float>> fvals;    // parallel to float slots order
+  std::vector<std::vector<int64_t>> ivals;  // parallel to int slots order
+};
+
+// One assembled batch, ready for zero-copy export through the C API.
+struct Batch {
+  // per slot: flattened values + offsets (batch_size+1 entries).
+  std::vector<std::vector<float>> fvals;
+  std::vector<std::vector<int64_t>> ivals;
+  std::vector<std::vector<int64_t>> offsets;  // per slot
+  int64_t batch_size = 0;
+};
+
+class DataFeed {
+ public:
+  DataFeed(std::vector<SlotConf> slots, int num_threads, size_t queue_cap);
+  ~DataFeed();
+
+  void AddFile(const std::string& path);
+  // shuffle_buf > 0 enables reservoir-style streaming shuffle.
+  void Start(int batch_size, int64_t shuffle_buf, uint64_t seed);
+  // Blocks; returns nullptr at end of epoch.
+  std::unique_ptr<Batch> Next();
+  void Stop();
+
+  const std::vector<SlotConf>& slots() const { return slots_; }
+  int64_t samples_seen() const { return samples_seen_.load(); }
+  const std::string& error() const { return error_; }
+
+ private:
+  void ParseWorker();
+  void AssembleWorker(int batch_size, int64_t shuffle_buf, uint64_t seed);
+  bool ParseLine(const char* p, size_t len, Record* rec);
+
+  std::vector<SlotConf> slots_;
+  int nf_ = 0, ni_ = 0;  // float/int slot counts
+  int num_threads_;
+  std::vector<std::string> files_;
+  BlockingQueue<std::string> file_q_;
+  BlockingQueue<Record> record_q_;
+  BlockingQueue<std::unique_ptr<Batch>> batch_q_;
+  std::vector<std::thread> parsers_;
+  std::thread assembler_;
+  std::atomic<int> live_parsers_{0};
+  std::atomic<int64_t> samples_seen_{0};
+  std::string error_;
+  bool started_ = false;
+};
+
+}  // namespace ptcore

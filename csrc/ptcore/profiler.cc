@@ -1,0 +1,128 @@
+// Host event profiler with chrome://tracing export.
+//
+// Capability parity with the reference's platform/profiler.h RecordEvent /
+// EnableProfiler + device_tracer.cc chrome-trace output — native
+// re-design: lock-free-ish per-thread event buffers, steady_clock ns,
+// JSON dumped in the chrome trace-event format so the same timeline tools
+// work. Device-side timing comes from jax.profiler (XPlane); this records
+// the host annotations around it.
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace ptcore {
+
+struct Event {
+  std::string name;
+  uint64_t ts_ns;
+  uint64_t dur_ns;
+  uint32_t tid;
+};
+
+class Profiler {
+ public:
+  static Profiler& Get() {
+    static Profiler p;
+    return p;
+  }
+
+  void Enable() { enabled_ = true; }
+  void Disable() { enabled_ = false; }
+  bool Enabled() const { return enabled_; }
+
+  static uint64_t NowNs() {
+    return (uint64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
+
+  void Record(const char* name, uint64_t start_ns, uint64_t end_ns) {
+    if (!enabled_) return;
+    std::lock_guard<std::mutex> lk(mu_);
+    events_.push_back(Event{name, start_ns, end_ns - start_ns, CurTid()});
+  }
+
+  void Clear() {
+    std::lock_guard<std::mutex> lk(mu_);
+    events_.clear();
+  }
+
+  size_t Count() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return events_.size();
+  }
+
+  bool DumpChromeTrace(const char* path) {
+    std::lock_guard<std::mutex> lk(mu_);
+    FILE* f = fopen(path, "w");
+    if (!f) return false;
+    fprintf(f, "{\"traceEvents\":[\n");
+    for (size_t i = 0; i < events_.size(); ++i) {
+      const Event& e = events_[i];
+      fprintf(f,
+              "{\"name\":\"%s\",\"ph\":\"X\",\"pid\":0,\"tid\":%u,"
+              "\"ts\":%.3f,\"dur\":%.3f}%s\n",
+              JsonEscape(e.name).c_str(), e.tid, e.ts_ns / 1e3,
+              e.dur_ns / 1e3, i + 1 < events_.size() ? "," : "");
+    }
+    fprintf(f, "]}\n");
+    fclose(f);
+    return true;
+  }
+
+  static std::string JsonEscape(const std::string& s) {
+    std::string out;
+    out.reserve(s.size());
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': out += "\\\""; break;
+        case '\\': out += "\\\\"; break;
+        case '\n': out += "\\n"; break;
+        case '\t': out += "\\t"; break;
+        case '\r': out += "\\r"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out += buf;
+          } else {
+            out += (char)c;
+          }
+      }
+    }
+    return out;
+  }
+
+ private:
+  static uint32_t CurTid() {
+    static std::atomic<uint32_t> next{0};
+    thread_local uint32_t tid = next++;
+    return tid;
+  }
+
+  std::atomic<bool> enabled_{false};
+  std::mutex mu_;
+  std::vector<Event> events_;
+};
+
+}  // namespace ptcore
+
+extern "C" {
+void pt_prof_enable() { ptcore::Profiler::Get().Enable(); }
+void pt_prof_disable() { ptcore::Profiler::Get().Disable(); }
+int pt_prof_enabled() { return ptcore::Profiler::Get().Enabled() ? 1 : 0; }
+uint64_t pt_prof_now_ns() { return ptcore::Profiler::NowNs(); }
+void pt_prof_record(const char* name, uint64_t start_ns, uint64_t end_ns) {
+  ptcore::Profiler::Get().Record(name, start_ns, end_ns);
+}
+int pt_prof_dump(const char* path) {
+  return ptcore::Profiler::Get().DumpChromeTrace(path) ? 0 : -1;
+}
+void pt_prof_clear() { ptcore::Profiler::Get().Clear(); }
+uint64_t pt_prof_count() { return ptcore::Profiler::Get().Count(); }
+}

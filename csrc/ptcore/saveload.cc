@@ -1,0 +1,136 @@
+// Native tensor (de)serialization.
+//
+// Capability parity with the reference's framework/save_load_util.cc and
+// the save/save_combine/load/load_combine ops — own format ("PTT1"):
+//   [magic u32][dtype u8][ndim u8][dims i64 * ndim][nbytes u64][raw data]
+// Combine files ("PTC1") hold an entry count then (name_len u16, name,
+// tensor record) sequences, so a whole state dict round-trips in one file.
+#include "saveload.h"
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace ptcore {
+
+static const uint32_t kTensorMagic = 0x50545431;  // "PTT1"
+static const uint32_t kCombineMagic = 0x50544331;  // "PTC1"
+
+static bool WriteTensorRecord(FILE* f, uint8_t dtype, const int64_t* dims,
+                              int ndim, const void* data, uint64_t nbytes) {
+  uint32_t magic = kTensorMagic;
+  uint8_t nd = (uint8_t)ndim;
+  if (fwrite(&magic, 4, 1, f) != 1) return false;
+  if (fwrite(&dtype, 1, 1, f) != 1) return false;
+  if (fwrite(&nd, 1, 1, f) != 1) return false;
+  if (ndim && fwrite(dims, 8, ndim, f) != (size_t)ndim) return false;
+  if (fwrite(&nbytes, 8, 1, f) != 1) return false;
+  if (nbytes && fwrite(data, 1, nbytes, f) != nbytes) return false;
+  return true;
+}
+
+static bool ReadTensorRecord(FILE* f, HostTensor* t) {
+  uint32_t magic = 0;
+  if (fread(&magic, 4, 1, f) != 1 || magic != kTensorMagic) return false;
+  uint8_t nd = 0;
+  if (fread(&t->dtype, 1, 1, f) != 1) return false;
+  if (fread(&nd, 1, 1, f) != 1) return false;
+  t->dims.resize(nd);
+  if (nd && fread(t->dims.data(), 8, nd, f) != nd) return false;
+  uint64_t nbytes = 0;
+  if (fread(&nbytes, 8, 1, f) != 1) return false;
+  t->data.resize(nbytes);
+  if (nbytes && fread(t->data.data(), 1, nbytes, f) != nbytes) return false;
+  return true;
+}
+
+bool SaveTensorFile(const char* path, uint8_t dtype, const int64_t* dims,
+                    int ndim, const void* data, uint64_t nbytes) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return false;
+  bool ok = WriteTensorRecord(f, dtype, dims, ndim, data, nbytes);
+  fclose(f);
+  return ok;
+}
+
+bool LoadTensorFile(const char* path, HostTensor* t) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  bool ok = ReadTensorRecord(f, t);
+  fclose(f);
+  return ok;
+}
+
+struct CombineWriter {
+  FILE* f = nullptr;
+  uint64_t count = 0;
+};
+
+CombineWriter* CombineOpen(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  uint32_t magic = kCombineMagic;
+  uint64_t zero = 0;
+  fwrite(&magic, 4, 1, f);
+  fwrite(&zero, 8, 1, f);  // patched at close
+  auto* w = new CombineWriter;
+  w->f = f;
+  return w;
+}
+
+bool CombineAdd(CombineWriter* w, const char* name, uint8_t dtype,
+                const int64_t* dims, int ndim, const void* data,
+                uint64_t nbytes) {
+  uint16_t nl = (uint16_t)strlen(name);
+  if (fwrite(&nl, 2, 1, w->f) != 1) return false;
+  if (fwrite(name, 1, nl, w->f) != nl) return false;
+  if (!WriteTensorRecord(w->f, dtype, dims, ndim, data, nbytes)) return false;
+  w->count++;
+  return true;
+}
+
+bool CombineClose(CombineWriter* w) {
+  fseek(w->f, 4, SEEK_SET);
+  bool ok = fwrite(&w->count, 8, 1, w->f) == 1;
+  fclose(w->f);
+  delete w;
+  return ok;
+}
+
+CombineReader* CombineLoad(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  uint32_t magic = 0;
+  uint64_t count = 0;
+  if (fread(&magic, 4, 1, f) != 1 || magic != kCombineMagic ||
+      fread(&count, 8, 1, f) != 1) {
+    fclose(f);
+    return nullptr;
+  }
+  auto* r = new CombineReader;
+  r->complete = true;
+  for (uint64_t i = 0; i < count; ++i) {
+    uint16_t nl = 0;
+    if (fread(&nl, 2, 1, f) != 1) {
+      r->complete = false;
+      break;
+    }
+    std::string name(nl, 0);
+    if (nl && fread(&name[0], 1, nl, f) != nl) {
+      r->complete = false;
+      break;
+    }
+    HostTensor t;
+    if (!ReadTensorRecord(f, &t)) {
+      r->complete = false;
+      break;
+    }
+    r->entries.emplace_back(std::move(name), std::move(t));
+  }
+  fclose(f);
+  return r;
+}
+
+}  // namespace ptcore

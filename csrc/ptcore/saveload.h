@@ -1,0 +1,33 @@
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace ptcore {
+
+struct HostTensor {
+  uint8_t dtype = 0;
+  std::vector<int64_t> dims;
+  std::vector<char> data;
+};
+
+bool SaveTensorFile(const char* path, uint8_t dtype, const int64_t* dims,
+                    int ndim, const void* data, uint64_t nbytes);
+bool LoadTensorFile(const char* path, HostTensor* t);
+
+struct CombineWriter;
+CombineWriter* CombineOpen(const char* path);
+bool CombineAdd(CombineWriter* w, const char* name, uint8_t dtype,
+                const int64_t* dims, int ndim, const void* data,
+                uint64_t nbytes);
+bool CombineClose(CombineWriter* w);
+
+struct CombineReader {
+  std::vector<std::pair<std::string, HostTensor>> entries;
+  bool complete = false;  // all declared entries read back intact
+};
+CombineReader* CombineLoad(const char* path);
+
+}  // namespace ptcore

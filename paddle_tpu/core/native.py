@@ -1,0 +1,356 @@
+"""ctypes bindings for the native runtime core (csrc/ptcore → libptcore.so).
+
+The reference binds its C++ runtime via pybind11 (paddle/fluid/pybind/);
+we use a flat C ABI + ctypes so the native library has no Python build
+dependency. The library is auto-built on first use (cmake+ninja if
+available, direct g++ otherwise) and cached.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_CSRC = os.path.join(_REPO, "csrc")
+_BUILD = os.path.join(_CSRC, "build")
+_LIB_PATHS = [
+    os.path.join(_BUILD, "lib", "libptcore.so"),
+    os.path.join(_BUILD, "libptcore.so"),
+]
+
+_lock = threading.Lock()
+_lib = None
+_build_error = None
+
+# dtype codes shared with csrc (PTT1 format)
+_DTYPES = {
+    np.dtype("float32"): 1, np.dtype("float64"): 2, np.dtype("int32"): 3,
+    np.dtype("int64"): 4, np.dtype("bool"): 5, np.dtype("uint16"): 6,
+    np.dtype("float16"): 7, np.dtype("uint8"): 8, np.dtype("int8"): 9,
+    np.dtype("int16"): 10,
+}
+_DTYPES_INV = {v: k for k, v in _DTYPES.items()}
+
+
+def _build():
+    os.makedirs(_BUILD, exist_ok=True)
+    try:
+        subprocess.run(
+            ["cmake", "-G", "Ninja", "-DCMAKE_BUILD_TYPE=Release", ".."],
+            cwd=_BUILD, check=True, capture_output=True)
+        subprocess.run(["ninja"], cwd=_BUILD, check=True,
+                       capture_output=True)
+        return
+    except Exception:
+        pass
+    # fallback: single g++ invocation
+    srcs = [os.path.join(_CSRC, "ptcore", f)
+            for f in ("datafeed.cc", "saveload.cc", "profiler.cc",
+                      "fs.cc", "capi.cc")]
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", *srcs,
+         "-o", os.path.join(_BUILD, "libptcore.so"), "-pthread"],
+        check=True, capture_output=True)
+
+
+def _declare(lib):
+    c = ctypes
+    sigs = {
+        "pt_version": (c.c_char_p, []),
+        "pt_arena_create": (c.c_void_p, [c.c_uint64]),
+        "pt_arena_destroy": (None, [c.c_void_p]),
+        "pt_arena_alloc": (c.c_void_p, [c.c_void_p, c.c_uint64]),
+        "pt_arena_free": (None, [c.c_void_p, c.c_void_p]),
+        "pt_arena_in_use": (c.c_uint64, [c.c_void_p]),
+        "pt_arena_peak": (c.c_uint64, [c.c_void_p]),
+        "pt_arena_reserved": (c.c_uint64, [c.c_void_p]),
+        "pt_feed_create": (c.c_void_p, [c.c_int, c.POINTER(c.c_char_p),
+                                        c.POINTER(c.c_int),
+                                        c.POINTER(c.c_int), c.c_int]),
+        "pt_feed_destroy": (None, [c.c_void_p]),
+        "pt_feed_add_file": (None, [c.c_void_p, c.c_char_p]),
+        "pt_feed_start": (None, [c.c_void_p, c.c_int, c.c_int64,
+                                 c.c_uint64]),
+        "pt_feed_stop": (None, [c.c_void_p]),
+        "pt_feed_samples_seen": (c.c_int64, [c.c_void_p]),
+        "pt_feed_error": (c.c_char_p, [c.c_void_p]),
+        "pt_combine_complete": (c.c_int, [c.c_void_p]),
+        "pt_feed_next": (c.c_void_p, [c.c_void_p]),
+        "pt_batch_destroy": (None, [c.c_void_p]),
+        "pt_batch_size": (c.c_int64, [c.c_void_p]),
+        "pt_batch_values_len": (c.c_int64, [c.c_void_p, c.c_int, c.c_int]),
+        "pt_batch_copy_fvalues": (None, [c.c_void_p, c.c_int,
+                                         c.POINTER(c.c_float)]),
+        "pt_batch_copy_ivalues": (None, [c.c_void_p, c.c_int,
+                                         c.POINTER(c.c_int64)]),
+        "pt_batch_copy_offsets": (None, [c.c_void_p, c.c_int,
+                                         c.POINTER(c.c_int64)]),
+        "pt_save_tensor": (c.c_int, [c.c_char_p, c.c_uint8,
+                                     c.POINTER(c.c_int64), c.c_int,
+                                     c.c_void_p, c.c_uint64]),
+        "pt_load_tensor": (c.c_void_p, [c.c_char_p]),
+        "pt_tensor_dtype": (c.c_uint8, [c.c_void_p]),
+        "pt_tensor_ndim": (c.c_int, [c.c_void_p]),
+        "pt_tensor_dims": (None, [c.c_void_p, c.POINTER(c.c_int64)]),
+        "pt_tensor_nbytes": (c.c_uint64, [c.c_void_p]),
+        "pt_tensor_copy_data": (None, [c.c_void_p, c.c_void_p]),
+        "pt_tensor_destroy": (None, [c.c_void_p]),
+        "pt_combine_open": (c.c_void_p, [c.c_char_p]),
+        "pt_combine_add": (c.c_int, [c.c_void_p, c.c_char_p, c.c_uint8,
+                                     c.POINTER(c.c_int64), c.c_int,
+                                     c.c_void_p, c.c_uint64]),
+        "pt_combine_close": (c.c_int, [c.c_void_p]),
+        "pt_combine_load": (c.c_void_p, [c.c_char_p]),
+        "pt_combine_count": (c.c_int, [c.c_void_p]),
+        "pt_combine_name": (c.c_char_p, [c.c_void_p, c.c_int]),
+        "pt_combine_tensor": (c.c_void_p, [c.c_void_p, c.c_int]),
+        "pt_combine_destroy": (None, [c.c_void_p]),
+        "pt_fs_glob": (c.c_int, [c.c_char_p]),
+        "pt_fs_glob_get": (c.c_char_p, [c.c_int]),
+        "pt_fs_exists": (c.c_int, [c.c_char_p]),
+        "pt_fs_mkdir_p": (c.c_int, [c.c_char_p]),
+        "pt_fs_file_size": (c.c_int64, [c.c_char_p]),
+        "pt_shell_exec": (c.c_int, [c.c_char_p]),
+        "pt_shell_output": (c.c_char_p, []),
+        "pt_prof_enable": (None, []),
+        "pt_prof_disable": (None, []),
+        "pt_prof_enabled": (c.c_int, []),
+        "pt_prof_now_ns": (c.c_uint64, []),
+        "pt_prof_record": (None, [c.c_char_p, c.c_uint64, c.c_uint64]),
+        "pt_prof_dump": (c.c_int, [c.c_char_p]),
+        "pt_prof_clear": (None, []),
+        "pt_prof_count": (c.c_uint64, []),
+    }
+    for name, (res, args) in sigs.items():
+        fn = getattr(lib, name)
+        fn.restype = res
+        fn.argtypes = args
+
+
+def load_library(required=False):
+    """Returns the ctypes lib, building it on first use; None if the
+    toolchain is unavailable (callers fall back to Python paths)."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None and not required:
+            return None
+        try:
+            path = next((p for p in _LIB_PATHS if os.path.exists(p)), None)
+            if path is None:
+                _build()
+                path = next(p for p in _LIB_PATHS if os.path.exists(p))
+            lib = ctypes.CDLL(path)
+            _declare(lib)
+            _lib = lib
+            return _lib
+        except Exception as e:  # toolchain missing / build failed
+            _build_error = e
+            if required:
+                raise
+            return None
+
+
+def available():
+    return load_library() is not None
+
+
+# ---------------------------------------------------------------- wrappers
+
+class NativeArena:
+    """Host staging-buffer arena (memory/allocation parity — see
+    csrc/ptcore/arena.h)."""
+
+    def __init__(self, chunk_bytes=64 << 20):
+        self._lib = load_library(required=True)
+        self._h = self._lib.pt_arena_create(chunk_bytes)
+
+    def alloc(self, nbytes):
+        return self._lib.pt_arena_alloc(self._h, nbytes)
+
+    def free(self, ptr):
+        self._lib.pt_arena_free(self._h, ptr)
+
+    @property
+    def stats(self):
+        return {"in_use": self._lib.pt_arena_in_use(self._h),
+                "peak": self._lib.pt_arena_peak(self._h),
+                "reserved": self._lib.pt_arena_reserved(self._h)}
+
+    def __del__(self):
+        try:
+            self._lib.pt_arena_destroy(self._h)
+        except Exception:
+            pass
+
+
+class NativeDataFeed:
+    """MultiSlot text datafeed (framework/data_feed.h capability).
+
+    slots: list of (name, dtype-str 'float32'|'int64', dense_dim or -1).
+    Yields dicts name -> (values ndarray, offsets ndarray[int64]).
+    """
+
+    def __init__(self, slots, num_threads=2):
+        self._lib = load_library(required=True)
+        self.slots = [(n, str(t), int(d)) for n, t, d in slots]
+        names = (ctypes.c_char_p * len(slots))(
+            *[s[0].encode() for s in self.slots])
+        isf = (ctypes.c_int * len(slots))(
+            *[1 if "float" in s[1] else 0 for s in self.slots])
+        dd = (ctypes.c_int * len(slots))(*[s[2] for s in self.slots])
+        self._h = self._lib.pt_feed_create(len(slots), names, isf, dd,
+                                           num_threads)
+        # sub-index within float/int groups, per slot
+        self._sub = []
+        fi = ii = 0
+        for s in self.slots:
+            if "float" in s[1]:
+                self._sub.append((True, fi))
+                fi += 1
+            else:
+                self._sub.append((False, ii))
+                ii += 1
+
+    def add_file(self, path):
+        self._lib.pt_feed_add_file(self._h, path.encode())
+
+    def start(self, batch_size, shuffle_buffer=0, seed=0):
+        self._lib.pt_feed_start(self._h, batch_size, shuffle_buffer, seed)
+
+    def stop(self):
+        self._lib.pt_feed_stop(self._h)
+
+    @property
+    def samples_seen(self):
+        return self._lib.pt_feed_samples_seen(self._h)
+
+    def __iter__(self):
+        while True:
+            b = self._lib.pt_feed_next(self._h)
+            if not b:
+                err = self._lib.pt_feed_error(self._h)
+                if err:
+                    raise IOError(f"datafeed: {err.decode()}")
+                return
+            try:
+                bs = self._lib.pt_batch_size(b)
+                out = {}
+                for si, (name, _, _) in enumerate(self.slots):
+                    is_float, sub = self._sub[si]
+                    n = self._lib.pt_batch_values_len(
+                        b, 1 if is_float else 0, sub)
+                    offsets = np.empty(bs + 1, np.int64)
+                    self._lib.pt_batch_copy_offsets(
+                        b, si, offsets.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_int64)))
+                    if is_float:
+                        vals = np.empty(n, np.float32)
+                        self._lib.pt_batch_copy_fvalues(
+                            b, sub, vals.ctypes.data_as(
+                                ctypes.POINTER(ctypes.c_float)))
+                    else:
+                        vals = np.empty(n, np.int64)
+                        self._lib.pt_batch_copy_ivalues(
+                            b, sub, vals.ctypes.data_as(
+                                ctypes.POINTER(ctypes.c_int64)))
+                    out[name] = (vals, offsets)
+                yield out
+            finally:
+                self._lib.pt_batch_destroy(b)
+
+    def __del__(self):
+        try:
+            self._lib.pt_feed_destroy(self._h)
+        except Exception:
+            pass
+
+
+def save_tensor(path, arr):
+    lib = load_library(required=True)
+    arr = np.ascontiguousarray(arr)
+    code = _DTYPES[arr.dtype]
+    dims = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+    rc = lib.pt_save_tensor(path.encode(), code, dims, arr.ndim,
+                            arr.ctypes.data_as(ctypes.c_void_p),
+                            arr.nbytes)
+    if rc != 0:
+        raise IOError(f"save_tensor failed: {path}")
+
+
+def _tensor_from_handle(lib, h):
+    ndim = lib.pt_tensor_ndim(h)
+    dims = (ctypes.c_int64 * max(1, ndim))()
+    if ndim:
+        lib.pt_tensor_dims(h, dims)
+    dtype = _DTYPES_INV[lib.pt_tensor_dtype(h)]
+    arr = np.empty(tuple(dims[:ndim]), dtype)
+    if arr.nbytes:
+        lib.pt_tensor_copy_data(h, arr.ctypes.data_as(ctypes.c_void_p))
+    return arr
+
+
+def load_tensor(path):
+    lib = load_library(required=True)
+    h = lib.pt_load_tensor(path.encode())
+    if not h:
+        raise IOError(f"load_tensor failed: {path}")
+    try:
+        return _tensor_from_handle(lib, h)
+    finally:
+        lib.pt_tensor_destroy(h)
+
+
+def save_combine(path, named_arrays):
+    """Write {name: ndarray} into one PTC1 file (save_combine op parity)."""
+    lib = load_library(required=True)
+    w = lib.pt_combine_open(path.encode())
+    if not w:
+        raise IOError(f"save_combine open failed: {path}")
+    for name, arr in named_arrays.items():
+        arr = np.ascontiguousarray(arr)
+        dims = (ctypes.c_int64 * max(1, arr.ndim))(*arr.shape)
+        rc = lib.pt_combine_add(w, name.encode(), _DTYPES[arr.dtype], dims,
+                                arr.ndim,
+                                arr.ctypes.data_as(ctypes.c_void_p),
+                                arr.nbytes)
+        if rc != 0:
+            raise IOError(f"save_combine add failed: {name}")
+    if lib.pt_combine_close(w) != 0:
+        raise IOError("save_combine close failed")
+
+
+def load_combine(path):
+    lib = load_library(required=True)
+    r = lib.pt_combine_load(path.encode())
+    if not r:
+        raise IOError(f"load_combine failed: {path}")
+    try:
+        if not lib.pt_combine_complete(r):
+            raise IOError(
+                f"load_combine: truncated/corrupt file: {path}")
+        out = {}
+        for i in range(lib.pt_combine_count(r)):
+            name = lib.pt_combine_name(r, i).decode()
+            out[name] = _tensor_from_handle(lib, lib.pt_combine_tensor(r, i))
+        return out
+    finally:
+        lib.pt_combine_destroy(r)
+
+
+def fs_glob(pattern):
+    lib = load_library(required=True)
+    n = lib.pt_fs_glob(pattern.encode())
+    return [lib.pt_fs_glob_get(i).decode() for i in range(n)]
+
+
+def shell_exec(cmd):
+    lib = load_library(required=True)
+    rc = lib.pt_shell_exec(cmd.encode())
+    return rc, lib.pt_shell_output().decode(errors="replace")

@@ -1,0 +1,163 @@
+"""File-driven Dataset (industrial ingestion path).
+
+Reference parity: python/paddle/fluid/dataset.py (DatasetFactory :21,
+InMemoryDataset :328 with load_into_memory :611 / local_shuffle /
+global_shuffle, QueueDataset) over framework/data_set.cc + data_feed.cc.
+TPU-native design: the out-of-core MultiSlot reader, shuffle and batching
+run in native threads (csrc/ptcore/datafeed.cc); batches surface as numpy
+feed dicts — dense slots as (batch, dim) arrays, ragged slots as
+(values, lod offsets) pairs ready for segment ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class DatasetFactory:
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class}")
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._thread = 1
+        self._filelist = []
+        self._use_vars = []
+        self._pipe_command = None
+        self._shuffle_buffer = 0
+        self._seed = 0
+        self._feed = None
+
+    # --- reference configuration surface ---
+    def set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self._thread = thread_num
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_pipe_command(self, pipe_command):
+        # files are streamed through `pipe_command < file |` like the
+        # reference's pipe reader (data_feed.cc PipeReader)
+        self._pipe_command = pipe_command
+
+    def set_use_var(self, var_list):
+        self._use_vars = list(var_list)
+
+    # --- slot derivation from vars ---
+    def _slots(self):
+        slots = []
+        for v in self._use_vars:
+            dtype = str(getattr(v, "dtype", "float32"))
+            is_float = "float" in dtype
+            shape = list(getattr(v, "shape", []) or [])
+            dims = [d for d in shape[1:] if d and d > 0]
+            dense = int(np.prod(dims)) if dims and getattr(
+                v, "lod_level", 0) == 0 else -1
+            slots.append((v.name, "float32" if is_float else "int64",
+                          dense))
+        return slots
+
+    def _make_feed(self):
+        from ..core.native import NativeDataFeed, available
+
+        if not available():
+            raise RuntimeError(
+                "native datafeed unavailable (csrc build failed)")
+        feed = NativeDataFeed(self._slots(), num_threads=self._thread)
+        for f in self._filelist:
+            if self._pipe_command:
+                feed.add_file(f"{self._pipe_command} < {f} |")
+            else:
+                feed.add_file(f)
+        return feed
+
+    def _iter_batches(self):
+        feed = self._make_feed()
+        feed.start(self._batch_size, shuffle_buffer=self._shuffle_buffer,
+                   seed=self._seed)
+        slots = self._slots()
+        try:
+            for raw in feed:
+                out = {}
+                for name, _, dense in slots:
+                    vals, offsets = raw[name]
+                    bs = len(offsets) - 1
+                    if dense > 0:
+                        out[name] = vals.reshape(bs, dense)
+                    else:
+                        out[name] = (vals, offsets)
+                yield out
+        finally:
+            feed.stop()
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: files → native reader threads → batches."""
+
+
+class InMemoryDataset(DatasetBase):
+    """Loads all samples to host RAM, supports shuffles, then batches.
+
+    TPU note: "memory" is host RAM (data_set.h MemoryDataFeed); the chip
+    never holds the dataset.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._records = None  # list of per-slot raw tuples
+
+    def load_into_memory(self):
+        feed = self._make_feed()
+        # batch_size=1 → records; no shuffle at load (parity: shuffle is a
+        # separate explicit call)
+        feed.start(1, shuffle_buffer=0, seed=0)
+        slots = self._slots()
+        recs = []
+        for raw in feed:
+            recs.append({name: raw[name] for name, _, _ in slots})
+        feed.stop()
+        self._records = recs
+
+    def local_shuffle(self, seed=None):
+        if self._records is None:
+            raise RuntimeError("call load_into_memory first")
+        rng = np.random.RandomState(self._seed if seed is None else seed)
+        rng.shuffle(self._records)
+
+    def global_shuffle(self, fleet=None, thread_num=None):
+        # single-host: identical to local_shuffle; multi-host exchange is
+        # the PS runtime's job (fleet utils barrier + reshard)
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._records or [])
+
+    def release_memory(self):
+        self._records = None
+
+    def _iter_batches(self):
+        if self._records is None:
+            yield from super()._iter_batches()
+            return
+        slots = self._slots()
+        n = len(self._records)
+        for start in range(0, n, self._batch_size):
+            chunk = self._records[start:start + self._batch_size]
+            out = {}
+            for name, _, dense in slots:
+                vals = np.concatenate([c[name][0] for c in chunk])
+                lens = [len(c[name][0]) for c in chunk]
+                offsets = np.concatenate([[0], np.cumsum(lens)])
+                if dense > 0:
+                    out[name] = vals.reshape(len(chunk), dense)
+                else:
+                    out[name] = (vals, offsets.astype(np.int64))
+            yield out

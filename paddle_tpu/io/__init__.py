@@ -302,7 +302,8 @@ class DataLoader:
                 prefetch_factor=self.prefetch,
                 worker_init_fn=self.worker_init_fn, timeout=self.timeout,
                 iterable=self._iterable_mode,
-                batch_size=self.batch_size if self._iterable_mode else 1)
+                batch_size=self.batch_size if self._iterable_mode else 1,
+                drop_last=self.drop_last if self._iterable_mode else False)
             try:
                 for b in it:
                     yield _to_tensors(b)

@@ -15,6 +15,22 @@ import itertools
 import multiprocessing as mp
 import queue
 import threading
+import weakref
+
+# one process-level hook; iterators register into a weak set so per-epoch
+# iterators are collectable (atexit must not pin them)
+_live_iters = weakref.WeakSet()
+
+
+def _shutdown_all():
+    for it in list(_live_iters):
+        try:
+            it.shutdown()
+        except Exception:
+            pass
+
+
+atexit.register(_shutdown_all)
 
 
 class WorkerInfo:
@@ -40,21 +56,8 @@ def get_worker_info():
     return _worker_info
 
 
-class _IterableShard:
-    """Round-robin shard of an IterableDataset stream for worker `wid`."""
-
-    def __init__(self, dataset, wid, nworkers):
-        self.dataset = dataset
-        self.wid = wid
-        self.nworkers = nworkers
-
-    def __iter__(self):
-        return itertools.islice(iter(self.dataset), self.wid, None,
-                                self.nworkers)
-
-
 def _worker_loop(dataset, index_queue, result_queue, collate_fn, wid,
-                 num_workers, seed, worker_init_fn, iterable):
+                 num_workers, seed, worker_init_fn, iterable, drop_last):
     global _worker_info
     import numpy as np
 
@@ -63,10 +66,13 @@ def _worker_loop(dataset, index_queue, result_queue, collate_fn, wid,
     if worker_init_fn is not None:
         try:
             worker_init_fn(wid)
-        except Exception:
-            pass
-    stream = iter(_IterableShard(dataset, wid, num_workers)) \
-        if iterable else None
+        except Exception as e:
+            result_queue.put(("init_error", None, e))
+            return
+    # Reference semantics (fluid/dataloader/worker.py): each worker sees the
+    # FULL IterableDataset stream; the dataset shards itself via
+    # get_worker_info() if it wants disjoint data.
+    stream = iter(dataset) if iterable else None
     while True:
         try:
             task = index_queue.get()
@@ -78,7 +84,8 @@ def _worker_loop(dataset, index_queue, result_queue, collate_fn, wid,
         try:
             if iterable:
                 samples = list(itertools.islice(stream, len(indices)))
-                if not samples:
+                if not samples or (drop_last and
+                                   len(samples) < len(indices)):
                     result_queue.put((task_id, None, StopIteration()))
                     continue
                 batch = collate_fn(samples)
@@ -94,7 +101,7 @@ class MultiprocessIter:
 
     def __init__(self, dataset, batches, collate_fn, num_workers,
                  prefetch_factor=2, worker_init_fn=None, timeout=0,
-                 iterable=False, batch_size=1, seed=0):
+                 iterable=False, batch_size=1, seed=0, drop_last=False):
         self._ctx = mp.get_context("fork" if hasattr(mp, "get_context")
                                    else None)
         self._result_queue = self._ctx.Queue()
@@ -119,13 +126,14 @@ class MultiprocessIter:
             w = self._ctx.Process(
                 target=_worker_loop,
                 args=(dataset, iq, self._result_queue, collate_fn, wid,
-                      num_workers, seed, worker_init_fn, iterable),
+                      num_workers, seed, worker_init_fn, iterable,
+                      drop_last),
                 daemon=True)
             w.start()
             self._workers.append(w)
             self._index_queues.append(iq)
         self._closed = False
-        atexit.register(self.shutdown)
+        _live_iters.add(self)
         for _ in range(self._outstanding_target):
             if not self._dispatch_one():
                 break
@@ -172,6 +180,10 @@ class MultiprocessIter:
                 raise RuntimeError(
                     f"DataLoader timed out after {self._timeout}s waiting "
                     "for worker batch")
+            if task_id == "init_error":
+                self.shutdown()
+                raise RuntimeError(
+                    "DataLoader worker_init_fn failed") from err
             self._cache[task_id] = (batch, err)
 
     def shutdown(self):

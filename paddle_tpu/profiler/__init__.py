@@ -37,8 +37,54 @@ class RecordEvent:
     def __exit__(self, *exc):
         dt = time.perf_counter() - self._t0
         _events.append((self.name, dt))
+        # _host_lib is only non-None after enable_host_trace(): the native
+        # build/load never happens (nor does any lock) on the hot path
+        # unless host tracing was explicitly turned on.
+        if _host_lib is not None and _host_lib.pt_prof_enabled():
+            now = _host_lib.pt_prof_now_ns()
+            _host_lib.pt_prof_record(self.name.encode(),
+                                     now - int(dt * 1e9), now)
         self._ann.__exit__(*exc)
         return False
+
+
+_host_lib = None
+
+
+def _native():
+    """Native host-event recorder (csrc/ptcore/profiler.cc) when built."""
+    global _host_lib
+    if _host_lib is None:
+        try:
+            from ..core.native import load_library
+
+            _host_lib = load_library()
+        except Exception:
+            return None
+    return _host_lib
+
+
+def export_chrome_tracing(path):
+    """Dump host RecordEvents as a chrome://tracing JSON file
+    (platform/device_tracer.cc GenProfile capability)."""
+    lib = _native()
+    if lib is None:
+        raise RuntimeError("native profiler unavailable")
+    if lib.pt_prof_dump(path.encode()) != 0:
+        raise IOError(f"trace dump failed: {path}")
+    return path
+
+
+def enable_host_trace():
+    lib = _native()
+    if lib is not None:
+        lib.pt_prof_enable()
+
+
+def disable_host_trace():
+    lib = _native()
+    if lib is not None:
+        lib.pt_prof_disable()
 
 
 def start_profiler(state="All", tracer_option="Default",

@@ -55,10 +55,47 @@ def test_multiprocess_order_preserved():
     assert ys == list(range(23))
 
 
-def test_multiprocess_iterable_sharded_no_dup():
-    dl = DataLoader(_IDS(), batch_size=3, num_workers=2)
+def test_multiprocess_iterable_replicates_unsharded_stream():
+    # reference semantics: every worker runs the full stream unless the
+    # dataset shards itself with get_worker_info()
+    dl = DataLoader(_IDS(), batch_size=5, num_workers=2)
+    vals = sorted(float(v) for b in dl for v in np.asarray(b._data).ravel())
+    assert vals == sorted([float(i) for i in range(10)] * 2)
+
+
+class _ShardedIDS(IterableDataset):
+    def __iter__(self):
+        wi = get_worker_info()
+        wid = wi.id if wi else 0
+        nw = wi.num_workers if wi else 1
+        for i in range(wid, 10, nw):
+            yield np.float32(i)
+
+
+def test_multiprocess_iterable_self_sharding():
+    dl = DataLoader(_ShardedIDS(), batch_size=3, num_workers=2)
     vals = sorted(float(v) for b in dl for v in np.asarray(b._data).ravel())
     assert vals == [float(i) for i in range(10)]
+
+
+def test_multiprocess_iterable_drop_last():
+    dl = DataLoader(_IDS(), batch_size=3, num_workers=2, drop_last=True)
+    # each worker yields 10 samples → 3 full batches each, partial dropped
+    n = sum(np.asarray(b._data).size for b in dl)
+    assert n == 18
+
+
+def test_worker_init_fn_error_raises():
+    def bad_init(wid):
+        raise ValueError("init fail")
+
+    dl = DataLoader(_DS(8), batch_size=2, num_workers=2,
+                    worker_init_fn=bad_init)
+    try:
+        list(dl)
+        raise AssertionError("expected RuntimeError")
+    except RuntimeError as e:
+        assert "worker_init_fn" in str(e)
 
 
 def test_worker_info_in_workers():

@@ -1,0 +1,167 @@
+"""Native runtime core (csrc/ptcore) tests — the C++ layer the reference
+implements in paddle/fluid/{memory,framework/data_feed,io,platform/profiler}.
+Auto-builds libptcore.so on first run (g++/cmake are required toolchain)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def test_arena_alloc_free_stats():
+    a = native.NativeArena(1 << 20)
+    p1, p2 = a.alloc(1000), a.alloc(5000)
+    assert p1 and p2 and p1 != p2
+    assert a.stats["in_use"] >= 6000
+    a.free(p1)
+    a.free(p2)
+    assert a.stats["in_use"] == 0
+    assert a.stats["peak"] >= 6000
+    # reuse: freed block satisfies next alloc without growth
+    reserved = a.stats["reserved"]
+    a.alloc(4096)
+    assert a.stats["reserved"] == reserved
+
+
+def test_save_load_tensor(tmp_path):
+    x = np.random.rand(3, 4).astype(np.float32)
+    p = str(tmp_path / "t.pt")
+    native.save_tensor(p, x)
+    np.testing.assert_array_equal(native.load_tensor(p), x)
+    # scalar + int dtypes
+    for arr in (np.int64(7).reshape(()), np.arange(5, dtype=np.int32),
+                np.array([True, False])):
+        native.save_tensor(p, arr)
+        back = native.load_tensor(p)
+        assert back.dtype == arr.dtype
+        np.testing.assert_array_equal(back, arr)
+
+
+def test_save_load_combine(tmp_path):
+    sd = {"w": np.random.rand(4, 2).astype(np.float32),
+          "b": np.arange(6, dtype=np.int64)}
+    p = str(tmp_path / "all.pt")
+    native.save_combine(p, sd)
+    back = native.load_combine(p)
+    assert list(back) == list(sd)  # order preserved
+    for k in sd:
+        np.testing.assert_array_equal(back[k], sd[k])
+
+
+def _write_multislot(path, n=10):
+    with open(path, "w") as f:
+        for i in range(n):
+            vals = " ".join(str(float(i + j)) for j in range(3))
+            ids = " ".join(str(i * 10 + k) for k in range(i % 3 + 1))
+            f.write(f"3 {vals} {i % 3 + 1} {ids}\n")
+
+
+def test_datafeed_dense_and_ragged(tmp_path):
+    fn = str(tmp_path / "part-0.txt")
+    _write_multislot(fn)
+    feed = native.NativeDataFeed(
+        [("x", "float32", 3), ("ids", "int64", -1)], num_threads=2)
+    feed.add_file(fn)
+    feed.start(batch_size=4)
+    total = 0
+    for batch in feed:
+        vx, ox = batch["x"]
+        vi, oi = batch["ids"]
+        bs = len(ox) - 1
+        total += bs
+        assert vx.shape[0] == 3 * bs
+        assert oi[-1] == vi.shape[0]
+        assert (np.diff(oi) >= 1).all()
+    assert total == 10
+    assert feed.samples_seen == 10
+
+
+def test_datafeed_shuffle_covers_epoch(tmp_path):
+    fn = str(tmp_path / "part-0.txt")
+    _write_multislot(fn)
+    feed = native.NativeDataFeed([("x", "float32", 3)], num_threads=1)
+    feed.add_file(fn)
+    feed.start(batch_size=3, shuffle_buffer=8, seed=7)
+    firsts = [row[0] for b in feed
+              for row in b["x"][0].reshape(-1, 3)]
+    assert sorted(firsts) == [float(i) for i in range(10)]
+
+
+def test_fluid_dataset_in_memory(tmp_path):
+    from paddle_tpu.fluid.dataset import DatasetFactory
+
+    fn = str(tmp_path / "part-0.txt")
+    _write_multislot(fn)
+
+    class V:
+        def __init__(self, name, dtype, shape, lod_level=0):
+            self.name, self.dtype = name, dtype
+            self.shape, self.lod_level = shape, lod_level
+
+    ds = DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(4)
+    ds.set_thread(2)
+    ds.set_filelist([fn])
+    ds.set_use_var([V("x", "float32", [-1, 3]),
+                    V("ids", "int64", [-1, 1], lod_level=1)])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 10
+    ds.local_shuffle(seed=3)
+    batches = list(ds._iter_batches())
+    assert sum(b["x"].shape[0] for b in batches) == 10
+    assert batches[0]["x"].shape[1] == 3
+    vals, offs = batches[0]["ids"]
+    assert offs[-1] == len(vals)
+
+
+def test_fs_and_shell(tmp_path):
+    p = tmp_path / "a.txt"
+    p.write_text("hi")
+    assert str(p) in native.fs_glob(str(tmp_path / "*.txt"))
+    rc, out = native.shell_exec(f"wc -c < {p}")
+    assert rc == 0 and out.strip() == "2"
+
+
+def test_profiler_chrome_trace(tmp_path):
+    import paddle_tpu.profiler as prof
+
+    lib = native.load_library()
+    lib.pt_prof_clear()
+    prof.enable_host_trace()
+    with prof.RecordEvent("unit_step"):
+        np.dot(np.eye(8), np.eye(8))
+    prof.disable_host_trace()
+    out = str(tmp_path / "trace.json")
+    prof.export_chrome_tracing(out)
+    tr = json.load(open(out))
+    names = [e["name"] for e in tr["traceEvents"]]
+    assert "unit_step" in names
+
+
+def test_load_combine_truncated_raises(tmp_path):
+    p = str(tmp_path / "all.pt")
+    native.save_combine(p, {"w": np.random.rand(64).astype(np.float32)})
+    data = open(p, "rb").read()
+    with open(p, "wb") as f:
+        f.write(data[:len(data) - 32])  # cut mid-tensor
+    with pytest.raises(IOError):
+        native.load_combine(p)
+
+
+def test_profiler_escapes_json_names(tmp_path):
+    lib = native.load_library()
+    lib.pt_prof_clear()
+    lib.pt_prof_enable()
+    t0 = lib.pt_prof_now_ns()
+    lib.pt_prof_record('step "q"\\x'.encode(), t0, t0 + 10)
+    lib.pt_prof_disable()
+    out = str(tmp_path / "t.json")
+    assert lib.pt_prof_dump(out.encode()) == 0
+    tr = json.load(open(out))  # must parse
+    assert 'step "q"' in tr["traceEvents"][0]["name"]
+    lib.pt_prof_clear()
