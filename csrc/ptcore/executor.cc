@@ -1,0 +1,682 @@
+// Native inference executor.
+//
+// Capability parity with the reference's NaiveExecutor
+// (framework/naive_executor.h) + AnalysisPredictor C core
+// (inference/api/analysis_predictor.cc:288 Run): loads a ProgramDesc proto
+// (`__model__`, csrc/proto/ptframework.proto) and a combined params file
+// (`__params__`, PTC1), then interprets the op list with a small CPU
+// kernel registry — the no-Python deployment path (the XLA path is the
+// fast one; this is the standalone C ABI predictor, serving the role of
+// paddle/fluid/train's pure-C++ entry and the inference C API).
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <fstream>
+#include <functional>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "ptframework.pb.h"
+#include "saveload.h"
+
+namespace ptcore {
+
+struct NTensor {
+  std::vector<int64_t> dims;
+  std::vector<float> f;    // float32 storage
+  std::vector<int64_t> i;  // int64 storage
+  bool is_int = false;
+
+  int64_t numel() const {
+    int64_t n = 1;
+    for (auto d : dims) n *= d;
+    return n;
+  }
+};
+
+struct ExecCtx {
+  std::unordered_map<std::string, NTensor> vars;  // activations (per run)
+  const std::unordered_map<std::string, NTensor>* params = nullptr;
+  const ptframework::OpDesc* op = nullptr;
+  std::string error;
+
+  // inputs resolve activations first, then read-only params — avoids
+  // copying the whole weight map every Run (kernels never write params)
+  NTensor* In(const std::string& slot, int idx = 0) {
+    for (const auto& s : op->inputs())
+      if (s.name() == slot && idx < s.args_size()) {
+        const std::string& n = s.args(idx);
+        auto it = vars.find(n);
+        if (it != vars.end()) return &it->second;
+        if (params) {
+          auto pit = params->find(n);
+          if (pit != params->end())
+            return const_cast<NTensor*>(&pit->second);
+        }
+        error = "input var not set: " + n;
+        return nullptr;
+      }
+    return nullptr;
+  }
+  NTensor* Out(const std::string& slot, int idx = 0) {
+    for (const auto& s : op->outputs())
+      if (s.name() == slot && idx < s.args_size())
+        return &vars[s.args(idx)];
+    return nullptr;
+  }
+  const ptframework::Attr* FindAttr(const std::string& name) {
+    for (const auto& a : op->attrs())
+      if (a.name() == name) return &a;
+    return nullptr;
+  }
+  int64_t AttrI(const std::string& n, int64_t dflt) {
+    auto* a = FindAttr(n);
+    return a && a->value_case() == ptframework::Attr::kI ? a->i() : dflt;
+  }
+  double AttrF(const std::string& n, double dflt) {
+    auto* a = FindAttr(n);
+    return a && a->value_case() == ptframework::Attr::kF ? a->f() : dflt;
+  }
+  bool AttrB(const std::string& n, bool dflt) {
+    auto* a = FindAttr(n);
+    return a && a->value_case() == ptframework::Attr::kB ? a->b() : dflt;
+  }
+  std::string AttrS(const std::string& n, const std::string& dflt) {
+    auto* a = FindAttr(n);
+    return a && a->value_case() == ptframework::Attr::kS ? a->s() : dflt;
+  }
+  std::vector<int64_t> AttrInts(const std::string& n) {
+    auto* a = FindAttr(n);
+    std::vector<int64_t> out;
+    if (a && a->value_case() == ptframework::Attr::kInts)
+      for (auto v : a->ints().val()) out.push_back(v);
+    return out;
+  }
+};
+
+using Kernel = std::function<bool(ExecCtx&)>;
+
+static std::map<std::string, Kernel>& Registry() {
+  static std::map<std::string, Kernel> r;
+  return r;
+}
+
+struct RegK {
+  RegK(const char* name, Kernel k) { Registry()[name] = std::move(k); }
+};
+
+// ---------------- kernels ----------------
+
+static bool EwiseUnary(ExecCtx& c, float (*fn)(float)) {
+  NTensor* x = c.In("X");
+  NTensor* o = c.Out("Out");
+  o->dims = x->dims;
+  o->f.resize(x->f.size());
+  for (size_t k = 0; k < x->f.size(); ++k) o->f[k] = fn(x->f[k]);
+  return true;
+}
+
+static RegK r_relu("relu", [](ExecCtx& c) {
+  return EwiseUnary(c, [](float v) { return v > 0 ? v : 0.0f; });
+});
+static RegK r_sigmoid("sigmoid", [](ExecCtx& c) {
+  return EwiseUnary(c, [](float v) { return 1.0f / (1.0f + expf(-v)); });
+});
+static RegK r_tanh("tanh", [](ExecCtx& c) {
+  return EwiseUnary(c, [](float v) { return tanhf(v); });
+});
+
+static RegK r_scale("scale", [](ExecCtx& c) {
+  float s = (float)c.AttrF("scale", 1.0);
+  float b = (float)c.AttrF("bias", 0.0);
+  NTensor* x = c.In("X");
+  NTensor* o = c.Out("Out");
+  o->dims = x->dims;
+  o->f.resize(x->f.size());
+  for (size_t k = 0; k < x->f.size(); ++k) o->f[k] = x->f[k] * s + b;
+  return true;
+});
+
+static RegK r_dropout("dropout", [](ExecCtx& c) {  // inference: identity
+  NTensor* x = c.In("X");
+  NTensor* o = c.Out("Out");
+  *o = *x;
+  return true;
+});
+
+// reshape/flatten/squeeze/unsqueeze: raw data carryover, dims recomputed.
+// shape entry 0 = copy input dim at that index (fluid semantics, matching
+// the Python lowering); -1 = infer.
+static bool Reshape(ExecCtx& c, std::vector<int64_t> shape) {
+  NTensor* x = c.In("X");
+  NTensor* o = c.Out("Out");
+  int64_t known = 1, infer = -1;
+  for (size_t k = 0; k < shape.size(); ++k) {
+    if (shape[k] == 0) {
+      if (k >= x->dims.size()) {
+        c.error = "reshape: 0-dim index out of range";
+        return false;
+      }
+      shape[k] = x->dims[k];
+    }
+    if (shape[k] == -1) {
+      infer = (int64_t)k;
+    } else {
+      known *= shape[k];
+    }
+  }
+  if (infer >= 0) shape[infer] = x->numel() / (known ? known : 1);
+  o->f = x->f;
+  o->i = x->i;
+  o->is_int = x->is_int;
+  o->dims = shape;
+  return true;
+}
+
+static RegK r_reshape("reshape", [](ExecCtx& c) {
+  return Reshape(c, c.AttrInts("shape"));
+});
+static RegK r_flatten("flatten", [](ExecCtx& c) {
+  NTensor* x = c.In("X");
+  int64_t ax = c.AttrI("axis", 1);
+  int64_t d0 = 1, d1 = 1;
+  for (int64_t k = 0; k < (int64_t)x->dims.size(); ++k)
+    (k < ax ? d0 : d1) *= x->dims[k];
+  return Reshape(c, {d0, d1});
+});
+
+static RegK r_mul("mul", [](ExecCtx& c) {
+  NTensor* x = c.In("X");
+  NTensor* y = c.In("Y");
+  NTensor* o = c.Out("Out");
+  int64_t xcols = c.AttrI("x_num_col_dims", 1);
+  int64_t M = 1, K = 1;
+  for (int64_t k = 0; k < (int64_t)x->dims.size(); ++k)
+    (k < xcols ? M : K) *= x->dims[k];
+  int64_t K2 = y->dims[0], N = y->numel() / y->dims[0];
+  if (K != K2) {
+    c.error = "mul: K mismatch";
+    return false;
+  }
+  o->dims.assign(x->dims.begin(), x->dims.begin() + xcols);
+  o->dims.push_back(N);
+  o->f.assign(M * N, 0.0f);
+  for (int64_t m = 0; m < M; ++m)
+    for (int64_t k = 0; k < K; ++k) {
+      float xv = x->f[m * K + k];
+      const float* yr = &y->f[k * N];
+      float* orow = &o->f[m * N];
+      for (int64_t n = 0; n < N; ++n) orow[n] += xv * yr[n];
+    }
+  return true;
+});
+
+static RegK r_matmul("matmul", [](ExecCtx& c) {
+  NTensor* x = c.In("X");
+  NTensor* y = c.In("Y");
+  NTensor* o = c.Out("Out");
+  bool tx = c.AttrB("transpose_X", false), ty = c.AttrB("transpose_Y", false);
+  float alpha = (float)c.AttrF("alpha", 1.0);
+  if (x->dims.size() != 2 || y->dims.size() != 2) {
+    c.error = "matmul: only 2D supported in native predictor";
+    return false;
+  }
+  int64_t M = tx ? x->dims[1] : x->dims[0];
+  int64_t K = tx ? x->dims[0] : x->dims[1];
+  int64_t N = ty ? y->dims[0] : y->dims[1];
+  o->dims = {M, N};
+  o->f.assign(M * N, 0.0f);
+  for (int64_t m = 0; m < M; ++m)
+    for (int64_t k = 0; k < K; ++k) {
+      float xv = tx ? x->f[k * M + m] : x->f[m * K + k];
+      for (int64_t n = 0; n < N; ++n) {
+        float yv = ty ? y->f[n * K + k] : y->f[k * N + n];
+        o->f[m * N + n] += alpha * xv * yv;
+      }
+    }
+  return true;
+});
+
+static RegK r_eadd("elementwise_add", [](ExecCtx& c) {
+  NTensor* x = c.In("X");
+  NTensor* y = c.In("Y");
+  NTensor* o = c.Out("Out");
+  o->dims = x->dims;
+  o->f.resize(x->f.size());
+  if (x->dims == y->dims) {
+    for (size_t k = 0; k < x->f.size(); ++k) o->f[k] = x->f[k] + y->f[k];
+    return true;
+  }
+  // broadcast Y along `axis` (bias pattern): Y dims match
+  // x.dims[axis:axis+y.ndim]
+  int64_t axis = c.AttrI("axis", -1);
+  if (axis < 0) axis = (int64_t)x->dims.size() - (int64_t)y->dims.size();
+  int64_t pre = 1, mid = y->numel(), post = 1;
+  for (int64_t k = 0; k < axis; ++k) pre *= x->dims[k];
+  for (int64_t k = axis + (int64_t)y->dims.size();
+       k < (int64_t)x->dims.size(); ++k)
+    post *= x->dims[k];
+  if (pre * mid * post != x->numel()) {
+    c.error = "elementwise_add: bad broadcast";
+    return false;
+  }
+  for (int64_t p = 0; p < pre; ++p)
+    for (int64_t m = 0; m < mid; ++m)
+      for (int64_t q = 0; q < post; ++q) {
+        int64_t idx = (p * mid + m) * post + q;
+        o->f[idx] = x->f[idx] + y->f[m];
+      }
+  return true;
+});
+
+static RegK r_softmax("softmax", [](ExecCtx& c) {
+  NTensor* x = c.In("X");
+  NTensor* o = c.Out("Out");
+  o->dims = x->dims;
+  o->f.resize(x->f.size());
+  int64_t last = x->dims.back();
+  int64_t rows = x->numel() / last;
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* xr = &x->f[r * last];
+    float* orow = &o->f[r * last];
+    float mx = xr[0];
+    for (int64_t k = 1; k < last; ++k) mx = std::max(mx, xr[k]);
+    float sum = 0;
+    for (int64_t k = 0; k < last; ++k) {
+      orow[k] = expf(xr[k] - mx);
+      sum += orow[k];
+    }
+    for (int64_t k = 0; k < last; ++k) orow[k] /= sum;
+  }
+  return true;
+});
+
+static RegK r_conv2d("conv2d", [](ExecCtx& c) {
+  NTensor* x = c.In("Input");
+  NTensor* w = c.In("Filter");
+  NTensor* o = c.Out("Output");
+  auto strides = c.AttrInts("strides");
+  auto pads = c.AttrInts("paddings");
+  auto dil = c.AttrInts("dilations");
+  int64_t g = c.AttrI("groups", 1);
+  if (strides.empty()) strides = {1, 1};
+  if (pads.empty()) pads = {0, 0};
+  if (dil.empty()) dil = {1, 1};
+  int64_t N = x->dims[0], C = x->dims[1], H = x->dims[2], W = x->dims[3];
+  int64_t OC = w->dims[0], KC = w->dims[1], KH = w->dims[2], KW = w->dims[3];
+  int64_t OH = (H + 2 * pads[0] - dil[0] * (KH - 1) - 1) / strides[0] + 1;
+  int64_t OW = (W + 2 * pads[1] - dil[1] * (KW - 1) - 1) / strides[1] + 1;
+  o->dims = {N, OC, OH, OW};
+  o->f.assign(N * OC * OH * OW, 0.0f);
+  int64_t cpg = C / g, opg = OC / g;
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t oc = 0; oc < OC; ++oc) {
+      int64_t grp = oc / opg;
+      for (int64_t oh = 0; oh < OH; ++oh)
+        for (int64_t ow = 0; ow < OW; ++ow) {
+          float acc = 0;
+          for (int64_t ic = 0; ic < cpg; ++ic) {
+            int64_t cin = grp * cpg + ic;
+            for (int64_t kh = 0; kh < KH; ++kh) {
+              int64_t ih = oh * strides[0] - pads[0] + kh * dil[0];
+              if (ih < 0 || ih >= H) continue;
+              for (int64_t kw = 0; kw < KW; ++kw) {
+                int64_t iw = ow * strides[1] - pads[1] + kw * dil[1];
+                if (iw < 0 || iw >= W) continue;
+                acc += x->f[((n * C + cin) * H + ih) * W + iw] *
+                       w->f[((oc * KC + ic) * KH + kh) * KW + kw];
+              }
+            }
+          }
+          o->f[((n * OC + oc) * OH + oh) * OW + ow] = acc;
+        }
+    }
+  return true;
+});
+
+static RegK r_pool2d("pool2d", [](ExecCtx& c) {
+  NTensor* x = c.In("X");
+  NTensor* o = c.Out("Out");
+  auto ksize = c.AttrInts("ksize");
+  auto strides = c.AttrInts("strides");
+  auto pads = c.AttrInts("paddings");
+  bool global = c.AttrB("global_pooling", false);
+  bool exclusive = c.AttrB("exclusive", true);
+  std::string type = c.AttrS("pooling_type", "max");
+  bool adaptive = c.AttrB("adaptive", false);
+  int64_t N = x->dims[0], C = x->dims[1], H = x->dims[2], W = x->dims[3];
+  if (strides.empty()) strides = {1, 1};
+  if (pads.empty()) pads = {0, 0};
+  if (global) {
+    ksize = {H, W};
+    strides = {H, W};
+    pads = {0, 0};
+  }
+  int64_t OH, OW;
+  if (adaptive) {
+    OH = ksize[0];
+    OW = ksize[1];
+  } else {
+    OH = (H + 2 * pads[0] - ksize[0]) / strides[0] + 1;
+    OW = (W + 2 * pads[1] - ksize[1]) / strides[1] + 1;
+  }
+  o->dims = {N, C, OH, OW};
+  o->f.assign(N * C * OH * OW, 0.0f);
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t ch = 0; ch < C; ++ch)
+      for (int64_t oh = 0; oh < OH; ++oh)
+        for (int64_t ow = 0; ow < OW; ++ow) {
+          int64_t h0, h1, w0, w1;
+          if (adaptive) {
+            h0 = oh * H / OH;
+            h1 = (oh + 1) * H / OH;
+            w0 = ow * W / OW;
+            w1 = (ow + 1) * W / OW;
+          } else {
+            h0 = oh * strides[0] - pads[0];
+            h1 = std::min(h0 + ksize[0], H);
+            w0 = ow * strides[1] - pads[1];
+            w1 = std::min(w0 + ksize[1], W);
+            h0 = std::max<int64_t>(h0, 0);
+            w0 = std::max<int64_t>(w0, 0);
+          }
+          float acc = type == "max" ? -3.4e38f : 0.0f;
+          int64_t cnt = 0;
+          for (int64_t ih = h0; ih < h1; ++ih)
+            for (int64_t iw = w0; iw < w1; ++iw) {
+              float v = x->f[((n * C + ch) * H + ih) * W + iw];
+              if (type == "max")
+                acc = std::max(acc, v);
+              else
+                acc += v;
+              cnt++;
+            }
+          if (type != "max")
+            acc /= exclusive ? (float)cnt
+                             : (float)(ksize[0] * ksize[1]);
+          o->f[((n * C + ch) * OH + oh) * OW + ow] = acc;
+        }
+  return true;
+});
+
+static RegK r_bn("batch_norm", [](ExecCtx& c) {
+  NTensor* x = c.In("X");
+  NTensor* scale = c.In("Scale");
+  NTensor* bias = c.In("Bias");
+  NTensor* mean = c.In("Mean");
+  NTensor* var = c.In("Variance");
+  NTensor* o = c.Out("Y");
+  if (!o) o = c.Out("Out");
+  float eps = (float)c.AttrF("epsilon", 1e-5);
+  int64_t N = x->dims[0], C = x->dims[1];
+  int64_t HW = x->numel() / (N * C);
+  o->dims = x->dims;
+  o->f.resize(x->f.size());
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t ch = 0; ch < C; ++ch) {
+      float inv = 1.0f / sqrtf(var->f[ch] + eps);
+      float a = scale->f[ch] * inv;
+      float b = bias->f[ch] - mean->f[ch] * a;
+      const float* xr = &x->f[(n * C + ch) * HW];
+      float* orow = &o->f[(n * C + ch) * HW];
+      for (int64_t k = 0; k < HW; ++k) orow[k] = a * xr[k] + b;
+    }
+  return true;
+});
+
+static RegK r_transpose("transpose", [](ExecCtx& c) {
+  NTensor* x = c.In("X");
+  NTensor* o = c.Out("Out");
+  auto perm = c.AttrInts("perm");
+  if (perm.empty()) perm = c.AttrInts("axis");
+  int nd = (int)x->dims.size();
+  o->dims.resize(nd);
+  for (int k = 0; k < nd; ++k) o->dims[k] = x->dims[perm[k]];
+  std::vector<int64_t> xstr(nd, 1), ostr(nd, 1);
+  for (int k = nd - 2; k >= 0; --k)
+    xstr[k] = xstr[k + 1] * x->dims[k + 1];
+  for (int k = nd - 2; k >= 0; --k)
+    ostr[k] = ostr[k + 1] * o->dims[k + 1];
+  o->f.resize(x->f.size());
+  std::vector<int64_t> idx(nd, 0);
+  for (int64_t flat = 0; flat < x->numel(); ++flat) {
+    int64_t rem = flat, src = 0;
+    for (int k = 0; k < nd; ++k) {
+      idx[k] = rem / ostr[k];
+      rem %= ostr[k];
+      src += idx[k] * xstr[perm[k]];
+    }
+    o->f[flat] = x->f[src];
+  }
+  return true;
+});
+
+static RegK r_mean("mean", [](ExecCtx& c) {
+  NTensor* x = c.In("X");
+  NTensor* o = c.Out("Out");
+  double s = 0;
+  for (float v : x->f) s += v;
+  o->dims = {};
+  o->f = {(float)(s / std::max<int64_t>(1, x->numel()))};
+  return true;
+});
+
+static RegK r_argmax("arg_max", [](ExecCtx& c) {
+  NTensor* x = c.In("X");
+  NTensor* o = c.Out("Out");
+  int64_t last = x->dims.back();
+  int64_t rows = x->numel() / last;
+  o->dims.assign(x->dims.begin(), x->dims.end() - 1);
+  o->is_int = true;
+  o->i.resize(rows);
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* xr = &x->f[r * last];
+    o->i[r] = (int64_t)(std::max_element(xr, xr + last) - xr);
+  }
+  return true;
+});
+
+// ---------------- predictor ----------------
+
+class NativePredictor {
+ public:
+  std::string error;
+
+  bool Load(const std::string& dir) {
+    std::ifstream f(dir + "/__model__", std::ios::binary);
+    if (!f) {
+      error = "missing __model__ in " + dir;
+      return false;
+    }
+    std::stringstream ss;
+    ss << f.rdbuf();
+    if (!model_.ParseFromString(ss.str())) {
+      error = "bad __model__ proto";
+      return false;
+    }
+    // params: PTC1 combined file
+    std::string ppath = dir + "/__params__";
+    CombineReader* r = CombineLoad(ppath.c_str());
+    if (r) {
+      if (!r->complete) {
+        error = "truncated __params__";
+        delete r;
+        return false;
+      }
+      for (auto& [name, t] : r->entries) {
+        NTensor nt;
+        nt.dims = t.dims;
+        const char* src = t.data.data();
+        size_t nb = t.data.size();
+        switch (t.dtype) {  // PTT1 codes → f32/i64 working storage
+          case 1:  // float32
+            nt.f.resize(nb / 4);
+            memcpy(nt.f.data(), src, nb);
+            break;
+          case 2: {  // float64 → f32
+            nt.f.resize(nb / 8);
+            const double* d = (const double*)src;
+            for (size_t k = 0; k < nt.f.size(); ++k) nt.f[k] = (float)d[k];
+            break;
+          }
+          case 3: {  // int32 → i64
+            nt.is_int = true;
+            nt.i.resize(nb / 4);
+            const int32_t* d = (const int32_t*)src;
+            for (size_t k = 0; k < nt.i.size(); ++k) nt.i[k] = d[k];
+            break;
+          }
+          case 4:  // int64
+            nt.is_int = true;
+            nt.i.resize(nb / 8);
+            memcpy(nt.i.data(), src, nb);
+            break;
+          case 5: case 8: case 9: {  // bool/uint8/int8 → i64
+            nt.is_int = true;
+            nt.i.resize(nb);
+            for (size_t k = 0; k < nb; ++k) nt.i[k] = (int64_t)(int8_t)src[k];
+            break;
+          }
+          default:
+            error = "unsupported param dtype code " +
+                    std::to_string((int)t.dtype) + " for " + name;
+            delete r;
+            return false;
+        }
+        params_[name] = std::move(nt);
+      }
+      delete r;
+    }
+    return true;
+  }
+
+  void SetInput(const std::string& name, const int64_t* dims, int ndim,
+                const float* data) {
+    NTensor t;
+    t.dims.assign(dims, dims + ndim);
+    t.f.assign(data, data + t.numel());
+    feeds_[name] = std::move(t);
+  }
+
+  bool Run(const std::vector<std::string>& fetch_names) {
+    for (const auto& n : model_.feed_names()) {
+      if (!feeds_.count(n)) {
+        error = "input not set: " + n;
+        return false;
+      }
+    }
+    ExecCtx ctx;
+    ctx.params = &params_;
+    for (auto& [k, v] : feeds_) ctx.vars[k] = v;
+    const auto& block = model_.program().blocks(0);
+    for (const auto& op : block.ops()) {
+      if (op.type() == "feed" || op.type() == "fetch") continue;
+      auto it = Registry().find(op.type());
+      if (it == Registry().end()) {
+        error = "no native kernel for op: " + op.type();
+        return false;
+      }
+      // all declared inputs must exist before the kernel dereferences them
+      for (const auto& s : op.inputs())
+        for (const auto& arg : s.args())
+          if (!ctx.vars.count(arg) && !params_.count(arg)) {
+            error = "op " + op.type() + ": input var not set: " + arg;
+            return false;
+          }
+      ctx.op = &op;
+      if (!it->second(ctx)) {
+        error = "op " + op.type() + " failed: " + ctx.error;
+        return false;
+      }
+    }
+    fetches_.clear();
+    for (const auto& n : fetch_names) {
+      auto it = ctx.vars.find(n);
+      if (it != ctx.vars.end()) {
+        fetches_.push_back({n, it->second});
+        continue;
+      }
+      auto pit = params_.find(n);
+      if (pit == params_.end()) {
+        error = "fetch var missing: " + n;
+        return false;
+      }
+      fetches_.push_back({n, pit->second});
+    }
+    return true;
+  }
+
+  const ptframework::InferenceModel& model() const { return model_; }
+  std::vector<std::pair<std::string, NTensor>> fetches_;
+
+ private:
+  ptframework::InferenceModel model_;
+  std::unordered_map<std::string, NTensor> params_;
+  std::unordered_map<std::string, NTensor> feeds_;
+};
+
+}  // namespace ptcore
+
+// ---------------- C API ----------------
+
+using ptcore::NativePredictor;
+
+extern "C" {
+
+void* pt_pred_create(const char* model_dir) {
+  auto* p = new NativePredictor;
+  if (!p->Load(model_dir)) {
+    // keep object alive so caller can read the error, flag via negative
+    // handle convention is awkward in ctypes: expose error through object
+  }
+  return p;
+}
+const char* pt_pred_error(void* h) {
+  return ((NativePredictor*)h)->error.c_str();
+}
+int pt_pred_feed_count(void* h) {
+  return ((NativePredictor*)h)->model().feed_names_size();
+}
+const char* pt_pred_feed_name(void* h, int i) {
+  return ((NativePredictor*)h)->model().feed_names(i).c_str();
+}
+int pt_pred_fetch_count(void* h) {
+  return ((NativePredictor*)h)->model().fetch_names_size();
+}
+const char* pt_pred_fetch_name(void* h, int i) {
+  return ((NativePredictor*)h)->model().fetch_names(i).c_str();
+}
+void pt_pred_set_input(void* h, const char* name, const int64_t* dims,
+                       int ndim, const float* data) {
+  ((NativePredictor*)h)->SetInput(name, dims, ndim, data);
+}
+int pt_pred_run(void* h) {
+  auto* p = (NativePredictor*)h;
+  std::vector<std::string> fetches;
+  for (const auto& n : p->model().fetch_names()) fetches.push_back(n);
+  return p->Run(fetches) ? 0 : -1;
+}
+int pt_pred_out_ndim(void* h, int i) {
+  return (int)((NativePredictor*)h)->fetches_[i].second.dims.size();
+}
+void pt_pred_out_dims(void* h, int i, int64_t* out) {
+  auto& d = ((NativePredictor*)h)->fetches_[i].second.dims;
+  memcpy(out, d.data(), d.size() * 8);
+}
+int pt_pred_out_is_int(void* h, int i) {
+  return ((NativePredictor*)h)->fetches_[i].second.is_int ? 1 : 0;
+}
+void pt_pred_out_copy(void* h, int i, void* out) {
+  auto& t = ((NativePredictor*)h)->fetches_[i].second;
+  if (t.is_int)
+    memcpy(out, t.i.data(), t.i.size() * 8);
+  else
+    memcpy(out, t.f.data(), t.f.size() * 4);
+}
+void pt_pred_destroy(void* h) { delete (NativePredictor*)h; }
+
+}  // extern "C"

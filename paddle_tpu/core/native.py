@@ -48,13 +48,24 @@ def _build():
         return
     except Exception:
         pass
-    # fallback: single g++ invocation
+    # fallback: protoc + single g++ invocation
+    gen = os.path.join(_BUILD, "gen")
+    os.makedirs(gen, exist_ok=True)
+    proto_dir = os.path.join(_CSRC, "proto")
+    subprocess.run(
+        ["protoc", f"--cpp_out={gen}",
+         f"--descriptor_set_out={os.path.join(_BUILD, 'ptframework.desc')}",
+         f"--proto_path={proto_dir}", "ptframework.proto"],
+        check=True, capture_output=True)
     srcs = [os.path.join(_CSRC, "ptcore", f)
             for f in ("datafeed.cc", "saveload.cc", "profiler.cc",
-                      "fs.cc", "capi.cc")]
+                      "fs.cc", "executor.cc", "capi.cc")]
+    srcs.append(os.path.join(gen, "ptframework.pb.cc"))
     subprocess.run(
         ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", *srcs,
-         "-o", os.path.join(_BUILD, "libptcore.so"), "-pthread"],
+         f"-I{gen}", f"-I{os.path.join(_CSRC, 'ptcore')}",
+         "-o", os.path.join(_BUILD, "libptcore.so"), "-pthread",
+         "-lprotobuf"],
         check=True, capture_output=True)
 
 
@@ -125,6 +136,22 @@ def _declare(lib):
         "pt_prof_dump": (c.c_int, [c.c_char_p]),
         "pt_prof_clear": (None, []),
         "pt_prof_count": (c.c_uint64, []),
+        "pt_pred_create": (c.c_void_p, [c.c_char_p]),
+        "pt_pred_error": (c.c_char_p, [c.c_void_p]),
+        "pt_pred_feed_count": (c.c_int, [c.c_void_p]),
+        "pt_pred_feed_name": (c.c_char_p, [c.c_void_p, c.c_int]),
+        "pt_pred_fetch_count": (c.c_int, [c.c_void_p]),
+        "pt_pred_fetch_name": (c.c_char_p, [c.c_void_p, c.c_int]),
+        "pt_pred_set_input": (None, [c.c_void_p, c.c_char_p,
+                                     c.POINTER(c.c_int64), c.c_int,
+                                     c.POINTER(c.c_float)]),
+        "pt_pred_run": (c.c_int, [c.c_void_p]),
+        "pt_pred_out_ndim": (c.c_int, [c.c_void_p, c.c_int]),
+        "pt_pred_out_dims": (None, [c.c_void_p, c.c_int,
+                                    c.POINTER(c.c_int64)]),
+        "pt_pred_out_is_int": (c.c_int, [c.c_void_p, c.c_int]),
+        "pt_pred_out_copy": (None, [c.c_void_p, c.c_int, c.c_void_p]),
+        "pt_pred_destroy": (None, [c.c_void_p]),
     }
     for name, (res, args) in sigs.items():
         fn = getattr(lib, name)
@@ -147,7 +174,18 @@ def load_library(required=False):
                 _build()
                 path = next(p for p in _LIB_PATHS if os.path.exists(p))
             lib = ctypes.CDLL(path)
-            _declare(lib)
+            try:
+                _declare(lib)
+            except AttributeError:
+                # stale cached .so from an older source tree (missing newly
+                # added symbols): remove and rebuild once
+                for p in _LIB_PATHS:
+                    if os.path.exists(p):
+                        os.remove(p)
+                _build()
+                path = next(p for p in _LIB_PATHS if os.path.exists(p))
+                lib = ctypes.CDLL(path)
+                _declare(lib)
             _lib = lib
             return _lib
         except Exception as e:  # toolchain missing / build failed
@@ -273,7 +311,11 @@ class NativeDataFeed:
 
 
 def save_tensor(path, arr):
-    lib = load_library(required=True)
+    lib = load_library()
+    if lib is None:  # no toolchain: byte-compatible Python codec
+        from . import ptc_format
+
+        return ptc_format.save_tensor(path, np.ascontiguousarray(arr))
     arr = np.ascontiguousarray(arr)
     code = _DTYPES[arr.dtype]
     dims = (ctypes.c_int64 * arr.ndim)(*arr.shape)
@@ -297,7 +339,11 @@ def _tensor_from_handle(lib, h):
 
 
 def load_tensor(path):
-    lib = load_library(required=True)
+    lib = load_library()
+    if lib is None:
+        from . import ptc_format
+
+        return ptc_format.load_tensor(path)
     h = lib.pt_load_tensor(path.encode())
     if not h:
         raise IOError(f"load_tensor failed: {path}")
@@ -309,7 +355,11 @@ def load_tensor(path):
 
 def save_combine(path, named_arrays):
     """Write {name: ndarray} into one PTC1 file (save_combine op parity)."""
-    lib = load_library(required=True)
+    lib = load_library()
+    if lib is None:
+        from . import ptc_format
+
+        return ptc_format.save_combine(path, named_arrays)
     w = lib.pt_combine_open(path.encode())
     if not w:
         raise IOError(f"save_combine open failed: {path}")
@@ -327,7 +377,11 @@ def save_combine(path, named_arrays):
 
 
 def load_combine(path):
-    lib = load_library(required=True)
+    lib = load_library()
+    if lib is None:
+        from . import ptc_format
+
+        return ptc_format.load_combine(path)
     r = lib.pt_combine_load(path.encode())
     if not r:
         raise IOError(f"load_combine failed: {path}")
@@ -354,3 +408,63 @@ def shell_exec(cmd):
     lib = load_library(required=True)
     rc = lib.pt_shell_exec(cmd.encode())
     return rc, lib.pt_shell_output().decode(errors="replace")
+
+
+class NativePredictorHandle:
+    """ctypes wrapper over the C++ NaiveExecutor predictor
+    (csrc/ptcore/executor.cc — AnalysisPredictor C-core capability)."""
+
+    def __init__(self, model_dir):
+        self._lib = load_library(required=True)
+        self._h = self._lib.pt_pred_create(model_dir.encode())
+        err = self._lib.pt_pred_error(self._h)
+        if err:
+            msg = err.decode()
+            self._lib.pt_pred_destroy(self._h)
+            self._h = None
+            raise IOError(f"native predictor load failed: {msg}")
+
+    @property
+    def input_names(self):
+        n = self._lib.pt_pred_feed_count(self._h)
+        return [self._lib.pt_pred_feed_name(self._h, i).decode()
+                for i in range(n)]
+
+    @property
+    def output_names(self):
+        n = self._lib.pt_pred_fetch_count(self._h)
+        return [self._lib.pt_pred_fetch_name(self._h, i).decode()
+                for i in range(n)]
+
+    def run(self, feeds):
+        """feeds: {name: float32 ndarray} → list of output ndarrays."""
+        for name, arr in feeds.items():
+            arr = np.ascontiguousarray(arr, dtype=np.float32)
+            dims = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+            self._lib.pt_pred_set_input(
+                self._h, name.encode(), dims, arr.ndim,
+                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if self._lib.pt_pred_run(self._h) != 0:
+            raise RuntimeError(
+                "native predictor run failed: "
+                + self._lib.pt_pred_error(self._h).decode())
+        outs = []
+        for i in range(self._lib.pt_pred_fetch_count(self._h)):
+            ndim = self._lib.pt_pred_out_ndim(self._h, i)
+            dims = (ctypes.c_int64 * max(1, ndim))()
+            if ndim:
+                self._lib.pt_pred_out_dims(self._h, i, dims)
+            is_int = self._lib.pt_pred_out_is_int(self._h, i)
+            arr = np.empty(tuple(dims[:ndim]),
+                           np.int64 if is_int else np.float32)
+            self._lib.pt_pred_out_copy(
+                self._h, i, arr.ctypes.data_as(ctypes.c_void_p))
+            outs.append(arr)
+        return outs
+
+    def __del__(self):
+        try:
+            if self._h:
+                self._lib.pt_pred_destroy(self._h)
+        except Exception:
+            pass

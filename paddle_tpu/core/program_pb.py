@@ -1,0 +1,202 @@
+"""Dynamic protobuf bindings for the program IR (csrc/proto/ptframework.proto).
+
+The C++ side links protoc-generated code; the Python side must work with a
+newer protobuf runtime than the system protoc, so messages are created
+dynamically from a FileDescriptorSet (`ptframework.desc`, produced by protoc
+at native-build time and checked for staleness against the .proto mtime).
+Reference parity: the framework.proto ↔ framework.py desc plumbing.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_PROTO = os.path.join(_REPO, "csrc", "proto", "ptframework.proto")
+_DESC = os.path.join(_REPO, "csrc", "build", "ptframework.desc")
+
+_lock = threading.Lock()
+_msgs = None
+
+
+def _gen_desc():
+    os.makedirs(os.path.dirname(_DESC), exist_ok=True)
+    subprocess.run(
+        ["protoc", f"--descriptor_set_out={_DESC}",
+         f"--proto_path={os.path.dirname(_PROTO)}",
+         os.path.basename(_PROTO)],
+        check=True, capture_output=True)
+
+
+def messages():
+    """Returns a namespace of message classes: ProgramDesc, BlockDesc,
+    OpDesc, VarDesc, Attr, OpSlot, InferenceModel + DataType enum."""
+    global _msgs
+    with _lock:
+        if _msgs is not None:
+            return _msgs
+        if (not os.path.exists(_DESC)
+                or os.path.getmtime(_DESC) < os.path.getmtime(_PROTO)):
+            _gen_desc()
+        from google.protobuf import descriptor_pb2, descriptor_pool
+        from google.protobuf import message_factory
+
+        fds = descriptor_pb2.FileDescriptorSet()
+        with open(_DESC, "rb") as f:
+            fds.ParseFromString(f.read())
+        pool = descriptor_pool.DescriptorPool()
+        for fd in fds.file:
+            pool.Add(fd)
+
+        class NS:
+            pass
+
+        ns = NS()
+        fdesc = pool.FindFileByName("ptframework.proto")
+        for name, mdesc in fdesc.message_types_by_name.items():
+            setattr(ns, name, message_factory.GetMessageClass(mdesc))
+        ns.DataType = fdesc.enum_types_by_name["DataType"]
+        _msgs = ns
+        return ns
+
+
+# dtype-name <-> proto enum (shared with csrc PTT1 codes)
+_DT_TO_PB = {
+    "float32": 1, "float64": 2, "int32": 3, "int64": 4, "bool": 5,
+    "bfloat16": 6, "float16": 7, "uint8": 8, "int8": 9, "int16": 10,
+}
+_PB_TO_DT = {v: k for k, v in _DT_TO_PB.items()}
+
+
+def dtype_to_pb(name):
+    return _DT_TO_PB.get(str(name), 0)
+
+
+def pb_to_dtype(code):
+    return _PB_TO_DT.get(int(code))
+
+
+def _set_attr(pb_attr, name, val):
+    import numpy as np
+
+    pb_attr.name = name
+    if type(val).__name__ == "Block":  # control-flow sub-block reference
+        pb_attr.block_idx = val.idx
+    elif isinstance(val, bool):
+        pb_attr.b = val
+    elif isinstance(val, (int, np.integer)):
+        pb_attr.i = int(val)
+    elif isinstance(val, (float, np.floating)):
+        pb_attr.f = float(val)
+    elif isinstance(val, str):
+        pb_attr.s = val
+    elif isinstance(val, (list, tuple)):
+        if all(isinstance(v, bool) for v in val):
+            pb_attr.bools.val.extend(val)
+        elif all(isinstance(v, (int, np.integer)) for v in val):
+            pb_attr.ints.val.extend(int(v) for v in val)
+        elif all(isinstance(v, (int, float, np.floating, np.integer))
+                 for v in val):
+            pb_attr.floats.val.extend(float(v) for v in val)
+        elif all(isinstance(v, str) for v in val):
+            pb_attr.strs.val.extend(val)
+        else:
+            raise TypeError(f"attr {name}: unsupported list {val!r}")
+    else:
+        raise TypeError(f"attr {name}: unsupported value {val!r}")
+
+
+def _get_attr(pb_attr):
+    which = pb_attr.WhichOneof("value")
+    if which is None:
+        return None
+    v = getattr(pb_attr, which)
+    if which in ("ints", "floats", "strs", "bools"):
+        return list(v.val)
+    if which == "block_idx":
+        return ("__block__", v)
+    return v
+
+
+def program_to_proto(program):
+    """fluid Program -> ProgramDesc proto message."""
+    m = messages()
+    pb = m.ProgramDesc()
+    pb.version = 1
+    for block in program.blocks:
+        bpb = pb.blocks.add()
+        bpb.idx = block.idx
+        bpb.parent_idx = getattr(block, "parent_idx", -1) \
+            if getattr(block, "parent_idx", None) is not None else -1
+        for var in block.vars.values():
+            vpb = bpb.vars.add()
+            vpb.name = var.name
+            vpb.dtype = dtype_to_pb(
+                var.dtype.name if hasattr(var.dtype, "name") else var.dtype
+            ) if var.dtype is not None else 0
+            vpb.shape.extend(int(d) if d is not None else -1
+                             for d in (var.shape or []))
+            vpb.persistable = bool(var.persistable)
+            vpb.is_data = bool(getattr(var, "is_data", False))
+            vpb.lod_level = int(getattr(var, "lod_level", 0) or 0)
+            vpb.trainable = bool(getattr(var, "trainable", False))
+            vpb.stop_gradient = bool(getattr(var, "stop_gradient", True))
+        for op in block.ops:
+            opb = bpb.ops.add()
+            opb.type = op.type
+            for slot, args in op.inputs.items():
+                s = opb.inputs.add()
+                s.name = slot
+                s.args.extend(args)
+            for slot, args in op.outputs.items():
+                s = opb.outputs.add()
+                s.name = slot
+                s.args.extend(args)
+            for aname, aval in op.attrs.items():
+                if aval is None:
+                    continue
+                try:
+                    _set_attr(opb.attrs.add(), aname, aval)
+                except TypeError:
+                    opb.attrs.pop()  # non-serializable attr: drop
+    return pb
+
+
+def proto_to_program(pb, program_cls=None):
+    """ProgramDesc proto -> fluid Program."""
+    from ..fluid.framework import Program
+
+    program_cls = program_cls or Program
+    prog = program_cls()
+    # ensure enough blocks exist, with recorded parents
+    for bpb in pb.blocks:
+        if bpb.idx >= len(prog.blocks):
+            prog._create_block(max(bpb.parent_idx, 0))
+    for bpb in pb.blocks:
+        block = prog.blocks[bpb.idx]
+        block.parent_idx = bpb.parent_idx
+        for vpb in bpb.vars:
+            block.create_var(
+                name=vpb.name,
+                shape=[int(d) for d in vpb.shape],
+                dtype=pb_to_dtype(vpb.dtype),
+                persistable=vpb.persistable,
+                is_data=vpb.is_data,
+                lod_level=vpb.lod_level,
+                trainable=vpb.trainable,
+                stop_gradient=vpb.stop_gradient,
+            )
+        for opb in bpb.ops:
+            inputs = {s.name: list(s.args) for s in opb.inputs}
+            outputs = {s.name: list(s.args) for s in opb.outputs}
+            attrs = {}
+            for apb in opb.attrs:
+                val = _get_attr(apb)
+                if isinstance(val, tuple) and val[:1] == ("__block__",):
+                    val = prog.blocks[val[1]]  # resolve sub-block ref
+                attrs[apb.name] = val
+            block.append_op(type=opb.type, inputs=inputs, outputs=outputs,
+                            attrs=attrs)
+    return prog

@@ -236,3 +236,29 @@ class Executor:
 
     def infer_from_dataset(self, *args, **kwargs):
         return self.train_from_dataset(*args, **kwargs)
+
+
+def _lower_block_callable(program, feed_names, fetch_names, scope=None):
+    """(fn, ordered_feed_names): fn(*feed_arrays) -> tuple(fetch_arrays),
+    persistables captured as constants. Inference-mode lowering used for
+    StableHLO export (paddle.inference Predictor.export_stablehlo)."""
+    scope = scope or _global_scope
+    blk = program.global_block()
+    persist_vals = {v.name: scope._values[v.name]
+                    for v in blk.vars.values()
+                    if v.persistable and v.name in scope._values}
+    ops = list(blk.ops)
+
+    def fn(*feed_arrays):
+        import jax
+
+        env = dict(persist_vals)
+        env.update(zip(feed_names, feed_arrays))
+        ctx = lowering.LowerCtx(env, jax.random.PRNGKey(0), training=False)
+        for op in ops:
+            if op.type in ("feed", "fetch"):
+                continue
+            lowering.get_lowering(op.type)(ctx, op)
+        return tuple(env[n] for n in fetch_names)
+
+    return fn, list(feed_names)

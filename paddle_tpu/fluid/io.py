@@ -71,43 +71,71 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, export_for_deployment=True,
                          program_only=False):
+    """Writes the deployable artifact: `__model__` = InferenceModel proto
+    (csrc/proto/ptframework.proto — durable, read by both the Python
+    predictor and the native C++ NaiveExecutor) and `__params__` = PTC1
+    combined tensor file (native save_combine format)."""
+    from ..core import program_pb
+    from ..core.native import save_combine
+
     main_program = main_program or default_main_program()
     os.makedirs(dirname, exist_ok=True)
     pruned = main_program._prune(target_vars)
     pruned = pruned.clone(for_test=True)
-    meta = {
-        "program": pruned.desc_bytes(),
-        "feed_names": list(feeded_var_names),
-        "fetch_names": [t.name if hasattr(t, "name") else t
-                        for t in target_vars],
-    }
+    fetch_names = [t.name if hasattr(t, "name") else t
+                   for t in target_vars]
+    m = program_pb.messages()
+    model = m.InferenceModel()
+    model.program.CopyFrom(program_pb.program_to_proto(pruned))
+    model.feed_names.extend(list(feeded_var_names))
+    model.fetch_names.extend(fetch_names)
     with open(os.path.join(dirname, model_filename or "__model__"),
               "wb") as f:
-        pickle.dump(meta, f)
+        f.write(model.SerializeToString())
     if not program_only:
         vals = _collect_persistables(main_program, global_scope())
-        # keep only vars the pruned program still references
         needed = {v.name for v in pruned.global_block().vars.values()
                   if v.persistable}
-        vals = {k: v for k, v in vals.items() if k in needed}
-        with open(os.path.join(dirname, params_filename or "__params__"),
-                  "wb") as f:
-            pickle.dump(vals, f)
-    return meta["fetch_names"]
+        arrays = {}
+        for k, (dt, arr) in vals.items():
+            if k not in needed:
+                continue
+            # PTC1 stores bf16 payloads as f32 (dt tag preserved on load
+            # via var dtype in the program)
+            arrays[k] = arr
+        save_combine(os.path.join(dirname, params_filename or "__params__"),
+                     arrays)
+    return fetch_names
 
 
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None):
+    from ..core import program_pb
+    from ..core.native import load_combine
+
     with open(os.path.join(dirname, model_filename or "__model__"),
               "rb") as f:
-        meta = pickle.load(f)
-    program = Program.parse_from_string(meta["program"])
+        data = f.read()
+    m = program_pb.messages()
+    model = m.InferenceModel()
+    model.ParseFromString(data)
+    program = program_pb.proto_to_program(model.program)
     params_path = os.path.join(dirname, params_filename or "__params__")
     if os.path.exists(params_path):
-        with open(params_path, "rb") as f:
-            vals = pickle.load(f)
+        arrays = load_combine(params_path)
+        blk = program.global_block()
+        vals = {}
+        from ..core.dtypes import dtype_name
+
+        for name, arr in arrays.items():
+            dt = arr.dtype.name
+            if blk.has_var(name):
+                vdt = getattr(blk.var(name), "dtype", None)
+                if vdt is not None and dtype_name(vdt) == "bfloat16":
+                    dt = "bfloat16"
+            vals[name] = (dt, arr)
         _restore(vals, global_scope())
-    feed_names = meta["feed_names"]
+    feed_names = list(model.feed_names)
     fetch_vars = [program.global_block().var(n)
-                  for n in meta["fetch_names"]]
+                  for n in model.fetch_names]
     return program, feed_names, fetch_vars

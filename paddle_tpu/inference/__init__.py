@@ -1,0 +1,172 @@
+"""Inference deployment API.
+
+Reference parity: paddle.inference — AnalysisConfig
+(inference/api/paddle_analysis_config.h), AnalysisPredictor
+(api/analysis_predictor.cc:288 Run / :715 ZeroCopyRun), create_predictor,
+ZeroCopyTensor. TPU-native design: two engines behind one API —
+  * "xla": the artifact's ProgramDesc is lowered whole-block and jitted
+    (the fast path; compiled once per input signature), plus an optional
+    StableHLO export for serving systems;
+  * "native": the C++ NaiveExecutor (csrc/ptcore/executor.cc) runs the
+    same artifact with zero Python/JAX dependency — the standalone
+    C ABI deployment path (C API parity: inference/capi/).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor"]
+
+
+class Config:
+    """AnalysisConfig equivalent."""
+
+    def __init__(self, model_dir=None, prog_file=None, params_file=None):
+        if model_dir and prog_file is None:
+            self.model_dir = model_dir
+            self.prog_file = os.path.join(model_dir, "__model__")
+            self.params_file = os.path.join(model_dir, "__params__")
+        else:
+            self.model_dir = model_dir or os.path.dirname(prog_file or "")
+            self.prog_file = prog_file
+            self.params_file = params_file
+        self._engine = "xla"
+        self._device = None
+
+    # engine/device toggles (enable_use_gpu equivalents)
+    def enable_use_tpu(self, device_id=0):
+        self._engine = "xla"
+        self._device = device_id
+
+    def disable_gpu(self):
+        self._engine = "native"
+
+    def enable_native_engine(self):
+        """Use the C++ NaiveExecutor (no Python/JAX at run time)."""
+        self._engine = "native"
+
+    def enable_xla_engine(self):
+        self._engine = "xla"
+
+    def switch_use_feed_fetch_ops(self, flag):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def enable_memory_optim(self):
+        pass
+
+
+class PredictorTensor:
+    """ZeroCopyTensor equivalent: named handle for input/output."""
+
+    def __init__(self, owner, name, is_input):
+        self._owner = owner
+        self.name = name
+        self._is_input = is_input
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._owner._feeds[self.name] = np.asarray(arr)
+
+    def reshape(self, shape):
+        pass  # shapes come from the array itself
+
+    def copy_to_cpu(self):
+        return self._owner._fetch_value(self.name)
+
+
+class Predictor:
+    def __init__(self, config):
+        self.config = config
+        self._feeds = {}
+        self._outputs = None
+        if config._engine == "native":
+            from ..core.native import NativePredictorHandle
+
+            self._native = NativePredictorHandle(config.model_dir)
+            self._feed_names = self._native.input_names
+            self._fetch_names = self._native.output_names
+        else:
+            self._native = None
+            self._load_xla()
+
+    def _load_xla(self):
+        from ..fluid import Executor
+        from ..fluid.io import load_inference_model
+
+        self._exe = Executor()
+        prog, feed_names, fetch_vars = load_inference_model(
+            self.config.model_dir,
+            self._exe,
+            model_filename=os.path.basename(self.config.prog_file)
+            if self.config.prog_file else None,
+            params_filename=os.path.basename(self.config.params_file)
+            if self.config.params_file else None)
+        self._program = prog
+        self._feed_names = list(feed_names)
+        self._fetch_vars = fetch_vars
+        self._fetch_names = [v.name for v in fetch_vars]
+
+    # --- paddle.inference 2.x surface ---
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._fetch_names)
+
+    def get_input_handle(self, name):
+        return PredictorTensor(self, name, True)
+
+    def get_output_handle(self, name):
+        return PredictorTensor(self, name, False)
+
+    def run(self, inputs=None):
+        """Either positional list of arrays (ordered by input names) or use
+        handles + run() like the reference's ZeroCopyRun."""
+        if inputs is not None:
+            self._feeds = dict(zip(self._feed_names,
+                                   [np.asarray(a) for a in inputs]))
+        if self._native is not None:
+            outs = self._native.run(self._feeds)
+        else:
+            outs = self._exe.run(self._program, feed=self._feeds,
+                                 fetch_list=self._fetch_vars)
+            outs = [np.asarray(o) for o in outs]
+        self._outputs = dict(zip(self._fetch_names, outs))
+        return outs
+
+    def _fetch_value(self, name):
+        if self._outputs is None:
+            self.run()
+        return self._outputs[name]
+
+    # StableHLO export of the whole inference computation (serving systems
+    # / compiler toolchains; reference's save_optimized_model analog)
+    def export_stablehlo(self, example_feeds):
+        if self._native is not None:
+            raise RuntimeError("export requires the xla engine")
+        import jax
+
+        from ..fluid.executor import _lower_block_callable
+
+        fn, names = _lower_block_callable(self._program, self._feed_names,
+                                          self._fetch_names)
+        args = [np.asarray(example_feeds[n]) for n in names]
+        lowered = jax.jit(fn).lower(*args)
+        return lowered.as_text(dialect="stablehlo")
+
+
+def create_predictor(config):
+    return Predictor(config)
+
+
+# legacy 1.x-style entry points
+AnalysisConfig = Config
+
+
+def create_paddle_predictor(config):
+    return Predictor(config)

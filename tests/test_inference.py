@@ -1,0 +1,111 @@
+"""Inference stack tests — save_inference_model / load_inference_model
+(fluid/io.py:1164/:1374 parity), paddle.inference Config/Predictor
+(analysis_predictor.cc capability), native C++ NaiveExecutor engine, and
+StableHLO export. Mirrors the reference's inference/tests/api pattern:
+train a small model, save, reload through each engine, compare numerics."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core import native, program_pb
+from paddle_tpu.inference import Config, create_predictor
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("infer_model"))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [1, 12, 12], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="int64")
+        c = fluid.layers.conv2d(img, 4, 3, act="relu")
+        p = fluid.layers.pool2d(c, 2, pool_stride=2)
+        f = fluid.layers.fc(p, 10)
+        prob = fluid.layers.softmax(f)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(prob, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    xb = rs.rand(8, 1, 12, 12).astype(np.float32)
+    yb = rs.randint(0, 10, (8, 1)).astype(np.int64)
+    for _ in range(3):
+        exe.run(main, feed={"img": xb, "y": yb}, fetch_list=[loss])
+    fluid.io.save_inference_model(d, ["img"], [prob], exe,
+                                  main_program=main)
+    ref, = exe.run(main._prune([prob]).clone(for_test=True),
+                   feed={"img": xb}, fetch_list=[prob])
+    return d, xb, ref
+
+
+def test_program_proto_roundtrip():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        fluid.layers.softmax(h)
+    pb = program_pb.program_to_proto(main)
+    data = pb.SerializeToString()
+    m = program_pb.messages()
+    pb2 = m.ProgramDesc()
+    pb2.ParseFromString(data)
+    prog2 = program_pb.proto_to_program(pb2)
+    assert [o.type for o in prog2.global_block().ops] == \
+        [o.type for o in main.global_block().ops]
+    assert set(prog2.global_block().vars) == set(main.global_block().vars)
+    for name, v in main.global_block().vars.items():
+        v2 = prog2.global_block().var(name)
+        assert list(v.shape) == list(v2.shape)
+        assert v.persistable == v2.persistable
+
+
+def test_load_inference_model_and_run(saved_model):
+    d, xb, ref = saved_model
+    exe = fluid.Executor()
+    prog, feed_names, fetch_vars = fluid.io.load_inference_model(d, exe)
+    assert feed_names == ["img"]
+    out, = exe.run(prog, feed={"img": xb}, fetch_list=fetch_vars)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_xla_predictor(saved_model):
+    d, xb, ref = saved_model
+    pred = create_predictor(Config(d))
+    assert pred.get_input_names() == ["img"]
+    out, = pred.run([xb])
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    # zero-copy-style handle API
+    h = pred.get_input_handle("img")
+    h.copy_from_cpu(xb)
+    pred.run()
+    oh = pred.get_output_handle(pred.get_output_names()[0])
+    np.testing.assert_allclose(oh.copy_to_cpu(), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native toolchain unavailable")
+def test_native_cpp_predictor(saved_model):
+    d, xb, ref = saved_model
+    cfg = Config(d)
+    cfg.enable_native_engine()
+    pred = create_predictor(cfg)
+    assert pred.get_input_names() == ["img"]
+    out, = pred.run([xb])
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_stablehlo_export(saved_model):
+    d, xb, _ = saved_model
+    pred = create_predictor(Config(d))
+    txt = pred.export_stablehlo({"img": xb})
+    assert "func.func" in txt
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native toolchain unavailable")
+def test_native_predictor_missing_model_errors(tmp_path):
+    cfg = Config(str(tmp_path))
+    cfg.enable_native_engine()
+    with pytest.raises(IOError):
+        create_predictor(cfg)
