@@ -1,0 +1,723 @@
+// Native parameter server.
+//
+// Capability parity with the reference's PS family
+// (operators/distributed/: rpc_server + request handlers, Communicator
+// server side; operators/distributed_ops/listen_and_serv_op.h:56 —
+// server-side optimize blocks; large_scale_kv.h:762 sparse tables;
+// heart_beat_monitor.h:54) — re-designed as a compact TCP RPC server:
+// length-prefixed binary frames, thread-per-connection, mutex-guarded
+// tables, server-side SGD/momentum/Adam/adagrad rules, counting barriers,
+// per-trainer heartbeats. The TPU workers run XLA compute and talk to this
+// CPU-host server over DCN (SURVEY.md §2.3 PS row).
+//
+// Frame: u32 payload_len | payload. Payload: u8 cmd | cmd-specific bytes.
+// Strings: u16 len | bytes. Arrays: u64 count | raw little-endian data.
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <set>
+
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <condition_variable>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace ptcore {
+namespace ps {
+
+enum Cmd : uint8_t {
+  kPushDense = 1,   // name, apply_mode u8 (0=add-delta, 1=optimize), f32[]
+  kPullDense = 2,   // name
+  kInitDense = 3,   // name, f32[]
+  kPushSparse = 4,  // table, dim u32, keys i64[], grads f32[n*dim]
+  kPullSparse = 5,  // table, dim u32, keys i64[]
+  kBarrier = 6,     // barrier_id u32
+  kShutdown = 7,
+  kHeartbeat = 8,   // trainer_id u32
+  kNumTrainers = 9,
+};
+
+enum Status : uint8_t { kOk = 0, kErr = 1 };
+
+struct Reader {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  template <typename T>
+  T Get() {
+    if (p + sizeof(T) > end) {
+      ok = false;
+      return T{};
+    }
+    T v;
+    memcpy(&v, p, sizeof(T));
+    p += sizeof(T);
+    return v;
+  }
+  std::string Str() {
+    uint16_t n = Get<uint16_t>();
+    if (!ok || p + n > end) {
+      ok = false;
+      return {};
+    }
+    std::string s(p, n);
+    p += n;
+    return s;
+  }
+  const char* Raw(size_t n) {
+    if (p + n > end) {
+      ok = false;
+      return nullptr;
+    }
+    const char* q = p;
+    p += n;
+    return q;
+  }
+};
+
+struct Writer {
+  std::vector<char> buf;
+
+  template <typename T>
+  void Put(T v) {
+    size_t o = buf.size();
+    buf.resize(o + sizeof(T));
+    memcpy(&buf[o], &v, sizeof(T));
+  }
+  void Str(const std::string& s) {
+    Put<uint16_t>((uint16_t)s.size());
+    size_t o = buf.size();
+    buf.resize(o + s.size());
+    memcpy(&buf[o], s.data(), s.size());
+  }
+  void Raw(const void* d, size_t n) {
+    size_t o = buf.size();
+    buf.resize(o + n);
+    memcpy(&buf[o], d, n);
+  }
+};
+
+// server-side optimizer rules (listen_and_serv optimize-block capability)
+struct DenseTable {
+  std::vector<float> value;
+  std::vector<float> m, v;  // momentum / adam state
+  int64_t step = 0;
+  std::mutex mu;
+};
+
+struct SparseTable {
+  // key -> [dim floats] + per-key adagrad accumulator
+  std::unordered_map<int64_t, std::vector<float>> rows;
+  std::unordered_map<int64_t, std::vector<float>> accum;
+  uint32_t dim = 0;
+  uint64_t seed = 1;
+  std::mutex mu;
+
+  std::vector<float>& Row(int64_t key) {
+    auto it = rows.find(key);
+    if (it != rows.end()) return it->second;
+    // lazy init: small deterministic uniform(-0.05, 0.05) per key
+    std::vector<float> init(dim);
+    uint64_t s = seed ^ (uint64_t)key * 0x9E3779B97F4A7C15ull;
+    for (uint32_t k = 0; k < dim; ++k) {
+      s = s * 6364136223846793005ull + 1442695040888963407ull;
+      init[k] = ((s >> 33) % 10000) / 10000.0f * 0.1f - 0.05f;
+    }
+    return rows.emplace(key, std::move(init)).first->second;
+  }
+};
+
+class Server {
+ public:
+  Server(int expected_trainers, const std::string& opt, double lr)
+      : ntrainers_(expected_trainers), opt_(opt), lr_((float)lr) {}
+
+  bool Start(int port) {
+    fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons((uint16_t)port);
+    if (bind(fd_, (sockaddr*)&addr, sizeof(addr)) != 0) return false;
+    socklen_t len = sizeof(addr);
+    getsockname(fd_, (sockaddr*)&addr, &len);
+    port_ = ntohs(addr.sin_port);
+    if (listen(fd_, 64) != 0) return false;
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return true;
+  }
+
+  int Port() const { return port_; }
+
+  void Stop() {
+    if (stopping_.exchange(true)) return;
+    {
+      // wake any Serve thread parked in a barrier wait (lost-wakeup safe:
+      // notify under the same mutex the waiters hold)
+      std::lock_guard<std::mutex> lk(barrier_mu_);
+      barrier_cv_.notify_all();
+    }
+    shutdown(fd_, SHUT_RDWR);
+    close(fd_);
+    {
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      for (int c : conns_) shutdown(c, SHUT_RDWR);
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    // Serve threads are detached; wait for them to drain
+    std::unique_lock<std::mutex> lk(conn_mu_);
+    done_cv_.wait_for(lk, std::chrono::seconds(5),
+                      [&] { return active_serves_ == 0; });
+  }
+
+  ~Server() { Stop(); }
+
+  // heartbeat monitor capability: trainers last-seen, in ms-since-start
+  int StaleTrainers(int64_t timeout_ms) {
+    std::lock_guard<std::mutex> lk(hb_mu_);
+    int64_t now = NowMs();
+    int stale = 0;
+    for (auto& [tid, t] : last_seen_)
+      if (now - t > timeout_ms) stale++;
+    return stale;
+  }
+
+ private:
+  static int64_t NowMs() {
+    return std::chrono::duration_cast<std::chrono::milliseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
+
+  void AcceptLoop() {
+    while (!stopping_) {
+      int c = accept(fd_, nullptr, nullptr);
+      if (c < 0) break;
+      int one = 1;
+      setsockopt(c, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      {
+        std::lock_guard<std::mutex> lk(conn_mu_);
+        conns_.insert(c);
+        active_serves_++;
+      }
+      std::thread([this, c] {
+        Serve(c);
+        std::lock_guard<std::mutex> lk(conn_mu_);
+        conns_.erase(c);
+        active_serves_--;
+        done_cv_.notify_all();
+      }).detach();
+    }
+  }
+
+  static bool ReadN(int fd, char* buf, size_t n) {
+    size_t got = 0;
+    while (got < n) {
+      ssize_t r = recv(fd, buf + got, n - got, 0);
+      if (r <= 0) return false;
+      got += (size_t)r;
+    }
+    return true;
+  }
+
+  static bool WriteN(int fd, const char* buf, size_t n) {
+    size_t sent = 0;
+    while (sent < n) {
+      ssize_t r = send(fd, buf + sent, n - sent, MSG_NOSIGNAL);
+      if (r <= 0) return false;
+      sent += (size_t)r;
+    }
+    return true;
+  }
+
+  void Serve(int c) {
+    std::vector<char> payload;
+    while (!stopping_) {
+      uint32_t len = 0;
+      if (!ReadN(c, (char*)&len, 4)) break;
+      if (len > (256u << 20)) break;  // 256MB frame cap
+      payload.resize(len);
+      if (!ReadN(c, payload.data(), len)) break;
+      Writer resp;
+      try {
+        Handle(payload, &resp);
+      } catch (const std::exception& e) {  // bad_alloc etc: fail the call,
+        resp.buf.clear();                  // not the whole server
+        Err(&resp, std::string("server exception: ") + e.what());
+      }
+      uint32_t rlen = (uint32_t)resp.buf.size();
+      if (!WriteN(c, (const char*)&rlen, 4)) break;
+      if (!WriteN(c, resp.buf.data(), rlen)) break;
+    }
+    close(c);
+  }
+
+  // wire counts must fit inside the remaining payload (overflow-safe)
+  static bool FitsRaw(const Reader& r, uint64_t n, uint64_t elem) {
+    uint64_t avail = (uint64_t)(r.end - r.p);
+    return elem == 0 || n <= avail / elem;
+  }
+
+  void Handle(const std::vector<char>& payload, Writer* resp) {
+    Reader r{payload.data(), payload.data() + payload.size()};
+    uint8_t cmd = r.Get<uint8_t>();
+    switch (cmd) {
+      case kInitDense: {
+        std::string name = r.Str();
+        uint64_t n = r.Get<uint64_t>();
+        if (!r.ok || !FitsRaw(r, n, 4)) return Err(resp, "bad init_dense");
+        const char* data = r.Raw(n * 4);
+        if (!r.ok) return Err(resp, "bad init_dense");
+        auto& t = Dense(name);
+        std::lock_guard<std::mutex> lk(t.mu);
+        t.value.resize(n);
+        memcpy(t.value.data(), data, n * 4);
+        t.m.assign(n, 0.0f);
+        t.v.assign(n, 0.0f);
+        t.step = 0;
+        resp->Put<uint8_t>(kOk);
+        return;
+      }
+      case kPushDense: {
+        std::string name = r.Str();
+        uint8_t mode = r.Get<uint8_t>();
+        uint64_t n = r.Get<uint64_t>();
+        if (!r.ok || !FitsRaw(r, n, 4)) return Err(resp, "bad push_dense");
+        const char* data = r.Raw(n * 4);
+        if (!r.ok) return Err(resp, "bad push_dense");
+        auto& t = Dense(name);
+        std::lock_guard<std::mutex> lk(t.mu);
+        if (t.value.size() != n)
+          return Err(resp, "push_dense: size mismatch for " + name);
+        const float* g = (const float*)data;
+        if (mode == 0) {  // add delta (GEO-SGD)
+          for (uint64_t k = 0; k < n; ++k) t.value[k] += g[k];
+        } else {
+          ApplyDense(t, g, n);
+        }
+        resp->Put<uint8_t>(kOk);
+        return;
+      }
+      case kPullDense: {
+        std::string name = r.Str();
+        if (!r.ok) return Err(resp, "bad pull_dense");
+        auto& t = Dense(name);
+        std::lock_guard<std::mutex> lk(t.mu);
+        resp->Put<uint8_t>(kOk);
+        resp->Put<uint64_t>((uint64_t)t.value.size());
+        resp->Raw(t.value.data(), t.value.size() * 4);
+        return;
+      }
+      case kPushSparse: {
+        std::string name = r.Str();
+        uint32_t dim = r.Get<uint32_t>();
+        uint64_t n = r.Get<uint64_t>();
+        if (!r.ok || dim == 0 || !FitsRaw(r, n, 8))
+          return Err(resp, "bad push_sparse");
+        const char* keys = r.Raw(n * 8);
+        if (!r.ok || !FitsRaw(r, n, (uint64_t)dim * 4))
+          return Err(resp, "bad push_sparse");
+        const char* grads = r.Raw((uint64_t)n * dim * 4);
+        if (!r.ok) return Err(resp, "bad push_sparse");
+        auto& t = Sparse(name, dim);
+        std::lock_guard<std::mutex> lk(t.mu);
+        if (t.dim != dim)
+          return Err(resp, "push_sparse: dim mismatch for " + name +
+                               " (table=" + std::to_string(t.dim) +
+                               " req=" + std::to_string(dim) + ")");
+        const int64_t* kk = (const int64_t*)keys;
+        const float* gg = (const float*)grads;
+        for (uint64_t i = 0; i < n; ++i)
+          ApplySparse(t, kk[i], gg + i * dim);
+        resp->Put<uint8_t>(kOk);
+        return;
+      }
+      case kPullSparse: {
+        std::string name = r.Str();
+        uint32_t dim = r.Get<uint32_t>();
+        uint64_t n = r.Get<uint64_t>();
+        if (!r.ok || dim == 0 || !FitsRaw(r, n, 8))
+          return Err(resp, "bad pull_sparse");
+        const char* keys = r.Raw(n * 8);
+        if (!r.ok) return Err(resp, "bad pull_sparse");
+        auto& t = Sparse(name, dim);
+        std::lock_guard<std::mutex> lk(t.mu);
+        if (t.dim != dim)
+          return Err(resp, "pull_sparse: dim mismatch for " + name +
+                               " (table=" + std::to_string(t.dim) +
+                               " req=" + std::to_string(dim) + ")");
+        resp->Put<uint8_t>(kOk);
+        resp->Put<uint64_t>(n);
+        const int64_t* kk = (const int64_t*)keys;
+        for (uint64_t i = 0; i < n; ++i)
+          resp->Raw(t.Row(kk[i]).data(), dim * 4);
+        return;
+      }
+      case kBarrier: {
+        uint32_t bid = r.Get<uint32_t>();
+        std::unique_lock<std::mutex> lk(barrier_mu_);
+        int gen = barrier_gen_[bid];
+        if (++barrier_count_[bid] >= ntrainers_) {
+          barrier_count_[bid] = 0;
+          barrier_gen_[bid]++;
+          barrier_cv_.notify_all();
+        } else {
+          barrier_cv_.wait(lk, [&] {
+            return barrier_gen_[bid] != gen || stopping_;
+          });
+        }
+        resp->Put<uint8_t>(kOk);
+        return;
+      }
+      case kHeartbeat: {
+        uint32_t tid = r.Get<uint32_t>();
+        std::lock_guard<std::mutex> lk(hb_mu_);
+        last_seen_[tid] = NowMs();
+        resp->Put<uint8_t>(kOk);
+        return;
+      }
+      case kNumTrainers: {
+        resp->Put<uint8_t>(kOk);
+        resp->Put<uint32_t>((uint32_t)ntrainers_);
+        return;
+      }
+      case kShutdown: {
+        resp->Put<uint8_t>(kOk);
+        stopping_ = true;
+        {
+          std::lock_guard<std::mutex> lk(barrier_mu_);
+          barrier_cv_.notify_all();
+        }
+        // close the listening socket so AcceptLoop exits
+        shutdown(fd_, SHUT_RDWR);
+        return;
+      }
+      default:
+        return Err(resp, "unknown cmd");
+    }
+  }
+
+  void Err(Writer* resp, const std::string& msg) {
+    resp->Put<uint8_t>(kErr);
+    resp->Str(msg);
+  }
+
+  DenseTable& Dense(const std::string& name) {
+    std::lock_guard<std::mutex> lk(tables_mu_);
+    return dense_[name];
+  }
+
+  SparseTable& Sparse(const std::string& name, uint32_t dim) {
+    std::lock_guard<std::mutex> lk(tables_mu_);
+    auto& t = sparse_[name];
+    if (t.dim == 0) t.dim = dim;
+    return t;
+  }
+
+  void ApplyDense(DenseTable& t, const float* g, uint64_t n) {
+    t.step++;
+    if (opt_ == "sgd") {
+      for (uint64_t k = 0; k < n; ++k) t.value[k] -= lr_ * g[k];
+    } else if (opt_ == "momentum") {
+      const float mu = 0.9f;
+      for (uint64_t k = 0; k < n; ++k) {
+        t.m[k] = mu * t.m[k] + g[k];
+        t.value[k] -= lr_ * t.m[k];
+      }
+    } else {  // adam
+      const float b1 = 0.9f, b2 = 0.999f, eps = 1e-8f;
+      float bc1 = 1.0f - powf(b1, (float)t.step);
+      float bc2 = 1.0f - powf(b2, (float)t.step);
+      for (uint64_t k = 0; k < n; ++k) {
+        t.m[k] = b1 * t.m[k] + (1 - b1) * g[k];
+        t.v[k] = b2 * t.v[k] + (1 - b2) * g[k] * g[k];
+        t.value[k] -=
+            lr_ * (t.m[k] / bc1) / (sqrtf(t.v[k] / bc2) + eps);
+      }
+    }
+  }
+
+  void ApplySparse(SparseTable& t, int64_t key, const float* g) {
+    auto& row = t.Row(key);
+    auto& acc = t.accum[key];
+    if (acc.empty()) acc.assign(t.dim, 0.0f);
+    // adagrad (large-scale sparse default; stable for embeddings)
+    for (uint32_t k = 0; k < t.dim; ++k) {
+      acc[k] += g[k] * g[k];
+      row[k] -= lr_ * g[k] / (sqrtf(acc[k]) + 1e-8f);
+    }
+  }
+
+  int fd_ = -1;
+  int port_ = 0;
+  int ntrainers_;
+  std::string opt_;
+  float lr_;
+  std::atomic<bool> stopping_{false};
+  std::thread accept_thread_;
+  std::mutex conn_mu_;
+  std::condition_variable done_cv_;
+  std::set<int> conns_;
+  int active_serves_ = 0;
+
+  std::mutex tables_mu_;
+  std::map<std::string, DenseTable> dense_;
+  std::map<std::string, SparseTable> sparse_;
+
+  std::mutex barrier_mu_;
+  std::condition_variable barrier_cv_;
+  std::map<uint32_t, int> barrier_count_, barrier_gen_;
+
+  std::mutex hb_mu_;
+  std::map<uint32_t, int64_t> last_seen_;
+};
+
+// ------------------------- client -------------------------
+
+class Client {
+ public:
+  bool Connect(const std::string& host, int port) {
+    // resolve hostnames too (real PS deployments address servers by name)
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    int rc = getaddrinfo(host.c_str(), std::to_string(port).c_str(),
+                         &hints, &res);
+    if (rc != 0 || !res) {
+      error = "cannot resolve host '" + host + "': " + gai_strerror(rc);
+      return false;
+    }
+    fd_ = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (connect(fd_, res->ai_addr, res->ai_addrlen) != 0) {
+      error = "connect to " + host + ":" + std::to_string(port) +
+              " failed";
+      freeaddrinfo(res);
+      return false;
+    }
+    freeaddrinfo(res);
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    return true;
+  }
+
+  ~Client() {
+    if (fd_ >= 0) close(fd_);
+  }
+
+  bool Call(const Writer& req, std::vector<char>* resp) {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint32_t len = (uint32_t)req.buf.size();
+    if (!WriteAll((const char*)&len, 4) ||
+        !WriteAll(req.buf.data(), len)) {
+      error = "send failed";
+      return false;
+    }
+    uint32_t rlen = 0;
+    if (!ReadAll((char*)&rlen, 4)) {
+      error = "recv failed";
+      return false;
+    }
+    resp->resize(rlen);
+    if (!ReadAll(resp->data(), rlen)) {
+      error = "recv failed";
+      return false;
+    }
+    return true;
+  }
+
+  std::string error;
+
+ private:
+  bool WriteAll(const char* b, size_t n) {
+    size_t s = 0;
+    while (s < n) {
+      ssize_t r = send(fd_, b + s, n - s, MSG_NOSIGNAL);
+      if (r <= 0) return false;
+      s += (size_t)r;
+    }
+    return true;
+  }
+  bool ReadAll(char* b, size_t n) {
+    size_t s = 0;
+    while (s < n) {
+      ssize_t r = recv(fd_, b + s, n - s, 0);
+      if (r <= 0) return false;
+      s += (size_t)r;
+    }
+    return true;
+  }
+
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+}  // namespace ps
+}  // namespace ptcore
+
+// ------------------------- C API -------------------------
+
+using ptcore::ps::Client;
+using ptcore::ps::Server;
+using ptcore::ps::Writer;
+
+extern "C" {
+
+void* pt_ps_server_start(int port, int expected_trainers, const char* opt,
+                         double lr) {
+  auto* s = new Server(expected_trainers, opt, lr);
+  if (!s->Start(port)) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+int pt_ps_server_port(void* h) { return ((Server*)h)->Port(); }
+void pt_ps_server_stop(void* h) { ((Server*)h)->Stop(); }
+void pt_ps_server_destroy(void* h) { delete (Server*)h; }
+int pt_ps_server_stale(void* h, int64_t timeout_ms) {
+  return ((Server*)h)->StaleTrainers(timeout_ms);
+}
+
+void* pt_ps_connect(const char* host, int port) {
+  auto* c = new Client;
+  if (!c->Connect(host, port)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+void pt_ps_disconnect(void* h) { delete (Client*)h; }
+const char* pt_ps_client_error(void* h) {
+  return ((Client*)h)->error.c_str();
+}
+
+static thread_local std::vector<char> g_resp;
+
+// surface the server's Err string (payload after kErr status) to callers
+static void CaptureServerError(Client* c) {
+  if (g_resp.size() >= 3) {
+    uint16_t nl = 0;
+    memcpy(&nl, g_resp.data() + 1, 2);
+    if (3 + (size_t)nl <= g_resp.size()) {
+      c->error.assign(g_resp.data() + 3, nl);
+      return;
+    }
+  }
+  c->error = "server returned error (no detail)";
+}
+
+static int SimpleCall(Client* c, Writer& w) {
+  if (!c->Call(w, &g_resp)) return -1;
+  if (!g_resp.empty() && g_resp[0] == 0) return 0;
+  CaptureServerError(c);
+  return -2;
+}
+
+int pt_ps_init_dense(void* h, const char* name, const float* data,
+                     uint64_t n) {
+  Writer w;
+  w.Put<uint8_t>(ptcore::ps::kInitDense);
+  w.Str(name);
+  w.Put<uint64_t>(n);
+  w.Raw(data, n * 4);
+  return SimpleCall((Client*)h, w);
+}
+
+int pt_ps_push_dense(void* h, const char* name, const float* grad,
+                     uint64_t n, int optimize) {
+  Writer w;
+  w.Put<uint8_t>(ptcore::ps::kPushDense);
+  w.Str(name);
+  w.Put<uint8_t>((uint8_t)(optimize ? 1 : 0));
+  w.Put<uint64_t>(n);
+  w.Raw(grad, n * 4);
+  return SimpleCall((Client*)h, w);
+}
+
+int pt_ps_pull_dense(void* h, const char* name, float* out, uint64_t n) {
+  Writer w;
+  w.Put<uint8_t>(ptcore::ps::kPullDense);
+  w.Str(name);
+  Client* c = (Client*)h;
+  if (!c->Call(w, &g_resp)) return -1;
+  if (g_resp.empty() || g_resp[0] != 0) {
+    CaptureServerError(c);
+    return -2;
+  }
+  uint64_t count = 0;
+  memcpy(&count, g_resp.data() + 1, 8);
+  if (count != n) {
+    c->error = "pull_dense size mismatch: server has " +
+               std::to_string(count) + ", caller expects " +
+               std::to_string(n);
+    return -3;
+  }
+  memcpy(out, g_resp.data() + 9, n * 4);
+  return 0;
+}
+
+int pt_ps_push_sparse(void* h, const char* table, uint32_t dim,
+                      const int64_t* keys, uint64_t n, const float* grads) {
+  Writer w;
+  w.Put<uint8_t>(ptcore::ps::kPushSparse);
+  w.Str(table);
+  w.Put<uint32_t>(dim);
+  w.Put<uint64_t>(n);
+  w.Raw(keys, n * 8);
+  w.Raw(grads, (uint64_t)n * dim * 4);
+  return SimpleCall((Client*)h, w);
+}
+
+int pt_ps_pull_sparse(void* h, const char* table, uint32_t dim,
+                      const int64_t* keys, uint64_t n, float* out) {
+  Writer w;
+  w.Put<uint8_t>(ptcore::ps::kPullSparse);
+  w.Str(table);
+  w.Put<uint32_t>(dim);
+  w.Put<uint64_t>(n);
+  w.Raw(keys, n * 8);
+  Client* c = (Client*)h;
+  if (!c->Call(w, &g_resp)) return -1;
+  if (g_resp.empty() || g_resp[0] != 0) {
+    CaptureServerError(c);
+    return -2;
+  }
+  memcpy(out, g_resp.data() + 9, (uint64_t)n * dim * 4);
+  return 0;
+}
+
+int pt_ps_barrier(void* h, uint32_t barrier_id) {
+  Writer w;
+  w.Put<uint8_t>(ptcore::ps::kBarrier);
+  w.Put<uint32_t>(barrier_id);
+  return SimpleCall((Client*)h, w);
+}
+
+int pt_ps_heartbeat(void* h, uint32_t trainer_id) {
+  Writer w;
+  w.Put<uint8_t>(ptcore::ps::kHeartbeat);
+  w.Put<uint32_t>(trainer_id);
+  return SimpleCall((Client*)h, w);
+}
+
+int pt_ps_shutdown(void* h) {
+  Writer w;
+  w.Put<uint8_t>(ptcore::ps::kShutdown);
+  return SimpleCall((Client*)h, w);
+}
+
+}  // extern "C"

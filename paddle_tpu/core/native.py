@@ -59,7 +59,7 @@ def _build():
         check=True, capture_output=True)
     srcs = [os.path.join(_CSRC, "ptcore", f)
             for f in ("datafeed.cc", "saveload.cc", "profiler.cc",
-                      "fs.cc", "executor.cc", "capi.cc")]
+                      "fs.cc", "executor.cc", "ps_server.cc", "capi.cc")]
     srcs.append(os.path.join(gen, "ptframework.pb.cc"))
     subprocess.run(
         ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", *srcs,
@@ -152,6 +152,31 @@ def _declare(lib):
         "pt_pred_out_is_int": (c.c_int, [c.c_void_p, c.c_int]),
         "pt_pred_out_copy": (None, [c.c_void_p, c.c_int, c.c_void_p]),
         "pt_pred_destroy": (None, [c.c_void_p]),
+        "pt_ps_server_start": (c.c_void_p, [c.c_int, c.c_int, c.c_char_p,
+                                            c.c_double]),
+        "pt_ps_server_port": (c.c_int, [c.c_void_p]),
+        "pt_ps_server_stop": (None, [c.c_void_p]),
+        "pt_ps_server_destroy": (None, [c.c_void_p]),
+        "pt_ps_server_stale": (c.c_int, [c.c_void_p, c.c_int64]),
+        "pt_ps_connect": (c.c_void_p, [c.c_char_p, c.c_int]),
+        "pt_ps_disconnect": (None, [c.c_void_p]),
+        "pt_ps_client_error": (c.c_char_p, [c.c_void_p]),
+        "pt_ps_init_dense": (c.c_int, [c.c_void_p, c.c_char_p,
+                                       c.POINTER(c.c_float), c.c_uint64]),
+        "pt_ps_push_dense": (c.c_int, [c.c_void_p, c.c_char_p,
+                                       c.POINTER(c.c_float), c.c_uint64,
+                                       c.c_int]),
+        "pt_ps_pull_dense": (c.c_int, [c.c_void_p, c.c_char_p,
+                                       c.POINTER(c.c_float), c.c_uint64]),
+        "pt_ps_push_sparse": (c.c_int, [c.c_void_p, c.c_char_p, c.c_uint32,
+                                        c.POINTER(c.c_int64), c.c_uint64,
+                                        c.POINTER(c.c_float)]),
+        "pt_ps_pull_sparse": (c.c_int, [c.c_void_p, c.c_char_p, c.c_uint32,
+                                        c.POINTER(c.c_int64), c.c_uint64,
+                                        c.POINTER(c.c_float)]),
+        "pt_ps_barrier": (c.c_int, [c.c_void_p, c.c_uint32]),
+        "pt_ps_heartbeat": (c.c_int, [c.c_void_p, c.c_uint32]),
+        "pt_ps_shutdown": (c.c_int, [c.c_void_p]),
     }
     for name, (res, args) in sigs.items():
         fn = getattr(lib, name)

@@ -1,33 +1,82 @@
-"""Parameter-server runtime glue (reference:
-fleet/runtime/parameter_server_runtime.py). The gRPC KV server itself lives
-in paddle_tpu.distributed.ps; this module wires fleet init_worker/init_server
-onto it."""
+"""Parameter-server runtime glue.
+
+Reference parity: fleet/runtime/parameter_server_runtime.py — wires
+fleet.init_server/run_server/init_worker/stop_worker onto the native PS
+stack (paddle_tpu.distributed.ps: csrc TCP RPC server + Communicator).
+Role/endpoints come from the same env contract the reference uses
+(PADDLE_PSERVER_ENDPOINTS, PADDLE_PORT, PADDLE_TRAINERS_NUM,
+TRAINING_ROLE, PADDLE_TRAINER_ID).
+"""
 from __future__ import annotations
 
+import os
+import time
 
-def init_worker(fleet_obj):
-    from ...ps.worker import get_communicator
+_server = None
+_communicator = None
 
-    comm = get_communicator()
-    if comm is not None:
-        comm.start()
+
+def _env(name, default=""):
+    return os.environ.get(name, default)
 
 
 def init_server(fleet_obj, *args):
-    from ...ps.server import get_server
+    global _server
+    from ...ps import PsServer
 
-    get_server().init()
+    port = int(_env("PADDLE_PORT", "0") or 0)
+    trainers = int(_env("PADDLE_TRAINERS_NUM", "1") or 1)
+    strategy = getattr(fleet_obj, "_strategy", None)
+    lr = 0.01
+    opt = "sgd"
+    if strategy is not None:
+        cfg = getattr(strategy, "a_sync_configs", {}) or {}
+        opt = cfg.get("server_optimizer", opt)
+        lr = float(cfg.get("server_lr", lr))
+    _server = PsServer(port=port, trainers=trainers, optimizer=opt, lr=lr)
+    return _server
 
 
 def run_server(fleet_obj):
-    from ...ps.server import get_server
+    if _server is None:
+        init_server(fleet_obj)
+    while True:  # listen_and_serv main loop
+        time.sleep(0.2)
 
-    get_server().run()
+
+def get_server():
+    return _server
+
+
+def init_worker(fleet_obj):
+    global _communicator
+    from ...ps import Communicator
+
+    endpoints = [e for e in _env("PADDLE_PSERVER_ENDPOINTS").split(",")
+                 if e]
+    if not endpoints:
+        return None
+    trainer_id = int(_env("PADDLE_TRAINER_ID", "0") or 0)
+    strategy = getattr(fleet_obj, "_strategy", None)
+    mode = "sync"
+    geo_k = 4
+    if strategy is not None and getattr(strategy, "a_sync", False):
+        cfg = getattr(strategy, "a_sync_configs", {}) or {}
+        k_steps = int(cfg.get("k_steps", 0) or 0)
+        mode = "geo" if k_steps > 0 else "async"
+        geo_k = k_steps or geo_k
+    _communicator = Communicator(endpoints, mode=mode,
+                                 trainer_id=trainer_id, geo_k=geo_k)
+    _communicator.start()
+    return _communicator
+
+
+def get_communicator():
+    return _communicator
 
 
 def stop_worker(fleet_obj):
-    from ...ps.worker import get_communicator
-
-    comm = get_communicator()
-    if comm is not None:
-        comm.stop()
+    global _communicator
+    if _communicator is not None:
+        _communicator.close()
+        _communicator = None

@@ -1,0 +1,345 @@
+"""Parameter-server training mode.
+
+Reference parity: the PS family of operators/distributed/ — RPC
+client/server (grpc/brpc), `Communicator` (communicator.h:180 sync /
+:253 async / geo via env), parameter_send/recv, large-scale sparse KV
+(large_scale_kv.h:762), listen_and_serv server-side optimize blocks
+(listen_and_serv_op.h:56), heartbeat monitor (heart_beat_monitor.h:54),
+plus the Python-side fleet PS runtime
+(distributed/fleet/runtime/parameter_server_runtime.py).
+
+TPU-native design (SURVEY.md §2.3): pservers are CPU-host processes running
+the native TCP RPC server (csrc/ptcore/ps_server.cc) with server-side
+optimizer rules; TPU workers run jitted XLA compute and exchange
+dense/sparse tensors with the server between steps (host callbacks —
+never inside the XLA computation). Sharding across multiple pservers is
+by hash over parameter names.
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+import time
+
+import numpy as np
+
+from ...core.native import load_library
+
+__all__ = ["PsServer", "PsClient", "Communicator", "DistributedLookupTable",
+           "run_pserver"]
+
+
+class PsServer:
+    """In-process native PS server (one per pserver host).
+
+    optimizer: 'sgd' | 'momentum' | 'adam' — the server-side optimize
+    rule applied to pushed dense grads (listen_and_serv capability).
+    """
+
+    def __init__(self, port=0, trainers=1, optimizer="sgd", lr=0.01):
+        self._lib = load_library(required=True)
+        self._h = self._lib.pt_ps_server_start(
+            port, trainers, optimizer.encode(), float(lr))
+        if not self._h:
+            raise RuntimeError(f"PS server failed to bind port {port}")
+
+    @property
+    def port(self):
+        return self._lib.pt_ps_server_port(self._h)
+
+    def stale_trainers(self, timeout_ms=10000):
+        """Heartbeat monitor: trainers not seen within timeout."""
+        return self._lib.pt_ps_server_stale(self._h, timeout_ms)
+
+    def stop(self):
+        if self._h:
+            self._lib.pt_ps_server_stop(self._h)
+            self._lib.pt_ps_server_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class PsClient:
+    """Native RPC client for one pserver endpoint."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._lib = load_library(required=True)
+        self._h = self._lib.pt_ps_connect(host.encode(), port)
+        if not self._h:
+            raise ConnectionError(f"cannot connect to pserver {host}:{port}")
+
+    def _ck(self, rc, what):
+        if rc != 0:
+            raise RuntimeError(
+                f"ps {what} failed (rc={rc}): "
+                + self._lib.pt_ps_client_error(self._h).decode())
+
+    def init_dense(self, name, value):
+        v = np.ascontiguousarray(value, np.float32).ravel()
+        self._ck(self._lib.pt_ps_init_dense(
+            self._h, name.encode(),
+            v.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), v.size),
+            "init_dense")
+
+    def push_dense(self, name, grad, optimize=True):
+        g = np.ascontiguousarray(grad, np.float32).ravel()
+        self._ck(self._lib.pt_ps_push_dense(
+            self._h, name.encode(),
+            g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), g.size,
+            1 if optimize else 0), "push_dense")
+
+    def pull_dense(self, name, shape):
+        out = np.empty(int(np.prod(shape)), np.float32)
+        self._ck(self._lib.pt_ps_pull_dense(
+            self._h, name.encode(),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), out.size),
+            "pull_dense")
+        return out.reshape(shape)
+
+    def push_sparse(self, table, keys, grads):
+        keys = np.ascontiguousarray(keys, np.int64).ravel()
+        grads = np.ascontiguousarray(grads, np.float32)
+        dim = grads.shape[-1]
+        grads = grads.reshape(keys.size, dim)
+        self._ck(self._lib.pt_ps_push_sparse(
+            self._h, table.encode(), dim,
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), keys.size,
+            grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float))),
+            "push_sparse")
+
+    def pull_sparse(self, table, keys, dim):
+        keys = np.ascontiguousarray(keys, np.int64).ravel()
+        out = np.empty((keys.size, dim), np.float32)
+        self._ck(self._lib.pt_ps_pull_sparse(
+            self._h, table.encode(), dim,
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), keys.size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))),
+            "pull_sparse")
+        return out
+
+    def barrier(self, barrier_id=0):
+        self._ck(self._lib.pt_ps_barrier(self._h, barrier_id), "barrier")
+
+    def heartbeat(self, trainer_id):
+        self._ck(self._lib.pt_ps_heartbeat(self._h, trainer_id),
+                 "heartbeat")
+
+    def shutdown_server(self):
+        self._lib.pt_ps_shutdown(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.pt_ps_disconnect(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def _shard(name, nshards):
+    # stable across processes (unlike Python's salted hash())
+    h = 0
+    for ch in name.encode():
+        h = (h * 131 + ch) & 0x7FFFFFFF
+    return h % nshards
+
+
+class Communicator:
+    """Trainer-side grad/param exchange (communicator.h hierarchy parity).
+
+    modes:
+      'sync'  — push grads + pull params inline every step;
+      'async' — background send thread merges queued grads and sends;
+                background recv thread refreshes params every
+                `recv_interval` s (AsyncCommunicator + PullDenseWorker);
+      'geo'   — trainer keeps local params; every `geo_k` steps pushes the
+                param DELTA since last sync and pulls the global value
+                (GeoCommunicator / GEO-SGD).
+    """
+
+    def __init__(self, endpoints, mode="sync", trainer_id=0,
+                 recv_interval=0.05, geo_k=4):
+        self.mode = mode
+        self.trainer_id = trainer_id
+        self.clients = [PsClient(h, int(p)) for h, p in
+                        (e.split(":") for e in endpoints)]
+        self.geo_k = geo_k
+        self._geo_base = {}   # name -> param at last sync
+        self._geo_step = 0
+        self._dense_shapes = {}
+        self._running = False
+        self._send_q = []
+        self._send_mu = threading.Lock()
+        self._recv_interval = recv_interval
+        self._latest = {}     # name -> freshly pulled param (async)
+        self._recv_error = None
+        self._stop_evt = threading.Event()
+
+    def _client_for(self, name):
+        return self.clients[_shard(name, len(self.clients))]
+
+    # ---------------- setup ----------------
+    def init_params(self, named_params):
+        """Trainer 0 pushes initial values; all trainers then barrier."""
+        for name, val in named_params.items():
+            self._dense_shapes[name] = tuple(np.shape(val))
+            if self.trainer_id == 0:
+                self._client_for(name).init_dense(name, val)
+            if self.mode == "geo":
+                self._geo_base[name] = np.array(val, np.float32)
+        self.clients[0].barrier(0)
+
+    # ---------------- sync/async dense path ----------------
+    def push(self, named_grads):
+        if self.mode == "async":
+            with self._send_mu:
+                self._send_q.append(dict(named_grads))
+            return
+        for name, g in named_grads.items():
+            self._client_for(name).push_dense(name, g)
+
+    def pull(self):
+        if self._recv_error is not None:
+            raise RuntimeError(
+                "PS async recv thread died") from self._recv_error
+        if self.mode == "async" and self._latest:
+            return {n: self._latest[n].reshape(s)
+                    for n, s in self._dense_shapes.items()
+                    if n in self._latest}
+        return {n: self._client_for(n).pull_dense(n, s)
+                for n, s in self._dense_shapes.items()}
+
+    # ---------------- geo path ----------------
+    def geo_step(self, named_params):
+        """Called every local step with current local params; returns
+        possibly-updated params (after delta exchange every geo_k)."""
+        self._geo_step += 1
+        if self._geo_step % self.geo_k != 0:
+            return named_params
+        out = dict(named_params)
+        for name, val in named_params.items():
+            val = np.asarray(val, np.float32)
+            delta = val - self._geo_base[name]
+            c = self._client_for(name)
+            c.push_dense(name, delta, optimize=False)  # server adds delta
+            new = c.pull_dense(name, val.shape)
+            self._geo_base[name] = new.copy()
+            out[name] = new
+        return out
+
+    # ---------------- async workers ----------------
+    def start(self):
+        if self.mode != "async" or self._running:
+            return
+        self._running = True
+
+        def send_loop():
+            while not self._stop_evt.is_set():
+                with self._send_mu:
+                    batch, self._send_q = self._send_q, []
+                if batch:
+                    # merge grads for the same var (communicator merge_add)
+                    merged = {}
+                    for d in batch:
+                        for n, g in d.items():
+                            g = np.asarray(g, np.float32)
+                            merged[n] = merged.get(n, 0) + g
+                    for n, g in merged.items():
+                        self._client_for(n).push_dense(n, g)
+                else:
+                    time.sleep(0.002)
+
+        def recv_loop():
+            consecutive_errs = 0
+            while not self._stop_evt.is_set():
+                try:
+                    for n, s in list(self._dense_shapes.items()):
+                        self._latest[n] = self._client_for(n).pull_dense(
+                            n, s)
+                    consecutive_errs = 0
+                except Exception as e:  # transient: retry, then surface
+                    consecutive_errs += 1
+                    if consecutive_errs >= 5:
+                        self._recv_error = e
+                        return
+                time.sleep(self._recv_interval)
+
+        self._threads = [threading.Thread(target=send_loop, daemon=True),
+                         threading.Thread(target=recv_loop, daemon=True)]
+        for t in self._threads:
+            t.start()
+
+    def stop(self):
+        if not self._running:
+            return
+        self._stop_evt.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._running = False
+        # flush pending async grads
+        with self._send_mu:
+            batch, self._send_q = self._send_q, []
+        for d in batch:
+            for n, g in d.items():
+                self._client_for(n).push_dense(n, g)
+
+    def barrier(self, bid=1):
+        self.clients[0].barrier(bid)
+
+    def close(self):
+        self.stop()
+        for c in self.clients:
+            c.close()
+
+
+class DistributedLookupTable:
+    """Sparse embedding on pserver hosts (distributed_lookup_table_op +
+    large_scale_kv capability): pull rows for ids, push grads back.
+    Rows init lazily server-side; host RAM holds the table, the TPU only
+    sees the dense gathered minibatch."""
+
+    def __init__(self, comm: Communicator, table_name, dim):
+        self.comm = comm
+        self.table = table_name
+        self.dim = dim
+
+    def lookup(self, ids):
+        ids = np.asarray(ids, np.int64)
+        flat = ids.ravel()
+        rows = self.comm._client_for(self.table).pull_sparse(
+            self.table, flat, self.dim)
+        return rows.reshape(ids.shape + (self.dim,))
+
+    def push_grad(self, ids, grads):
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32).reshape(ids.size, self.dim)
+        self.comm._client_for(self.table).push_sparse(self.table, ids,
+                                                      grads)
+
+
+def run_pserver(port=0, trainers=1, optimizer="sgd", lr=0.01,
+                ready_file=None, block=True):
+    """Pserver main loop (listen_and_serv_op capability;
+    `python -m paddle_tpu.distributed.ps` entry)."""
+    server = PsServer(port=port, trainers=trainers, optimizer=optimizer,
+                      lr=lr)
+    if ready_file:
+        with open(ready_file, "w") as f:
+            f.write(str(server.port))
+    if not block:
+        return server
+    try:
+        while True:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
