@@ -157,3 +157,14 @@ def _spawn_entry(func, args, env):
 from . import fleet  # noqa: F401,E402
 from .parallel import DataParallel  # noqa: F401,E402
 from . import collective  # noqa: F401,E402
+
+
+def all_reduce_mean_tree(named_arrays):
+    """Average a dict of raw arrays across data-parallel replicas
+    (LocalSGD periodic sync; transpiler/collective.py:270 capability).
+    Single-replica worlds return the input unchanged."""
+    world = get_world_size()
+    if world <= 1:
+        return named_arrays
+    return {n: _psum_all_devices(v) / world
+            for n, v in named_arrays.items()}

@@ -1,0 +1,221 @@
+"""Multi-process launcher.
+
+Reference parity: python/paddle/distributed/launch.py and
+fleet/launch.py (:188 launch_collective, :227 launch_ps) + the watchdog in
+distributed/utils.py:411 (watch_local_trainers / terminate_local_procs —
+if any local proc dies, kill the pod and exit nonzero).
+
+TPU-native notes: in collective mode each rank gets the reference env
+contract (PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS,
+PADDLE_CURRENT_ENDPOINT) plus the jax multi-host coordinates
+(JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES, JAX_PROCESS_ID) so
+`jax.distributed.initialize()` picks them up over DCN. In PS mode pserver
+processes run `paddle_tpu.distributed.ps` servers and trainers get
+PADDLE_PSERVER_ENDPOINTS / TRAINING_ROLE.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+__all__ = ["launch_collective", "launch_ps", "main"]
+
+
+def _free_ports(n, host="127.0.0.1"):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind((host, 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def terminate_procs(procs):
+    """terminate_local_procs (distributed/utils.py:252) parity."""
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.time() + 5
+    for p in procs:
+        while p.poll() is None and time.time() < deadline:
+            time.sleep(0.05)
+        if p.poll() is None:
+            p.kill()
+
+
+def watch_procs(procs, tags):
+    """watch_local_trainers parity: block until all exit; if any dies
+    nonzero, kill the rest and return its code."""
+    try:
+        while True:
+            alive = False
+            for p, tag in zip(procs, tags):
+                rc = p.poll()
+                if rc is None:
+                    alive = True
+                elif rc != 0:
+                    sys.stderr.write(
+                        f"[launch] {tag} exited with code {rc}; "
+                        "terminating remaining processes\n")
+                    terminate_procs(procs)
+                    return rc
+            if not alive:
+                return 0
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        terminate_procs(procs)
+        return 1
+
+
+def launch_collective(script_args, nproc=2, host="127.0.0.1",
+                      started_port=None, log_dir=None, extra_env=None):
+    """Spawn nproc ranks of `python script args...` with the collective
+    env contract. Returns the watchdog's exit code."""
+    ports = _free_ports(nproc) if started_port is None else \
+        list(range(started_port, started_port + nproc))
+    endpoints = ",".join(f"{host}:{p}" for p in ports)
+    procs, tags = [], []
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    for rank in range(nproc):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nproc),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT": f"{host}:{ports[rank]}",
+            "TRAINING_ROLE": "TRAINER",
+            # jax.distributed.initialize() coordinates
+            "JAX_COORDINATOR_ADDRESS": f"{host}:{ports[0]}",
+            "JAX_NUM_PROCESSES": str(nproc),
+            "JAX_PROCESS_ID": str(rank),
+        })
+        env.update(extra_env or {})
+        out = None
+        if log_dir:
+            out = open(os.path.join(log_dir, f"workerlog.{rank}"), "w")
+        procs.append(subprocess.Popen(
+            [sys.executable, *script_args], env=env, stdout=out,
+            stderr=subprocess.STDOUT if out else None))
+        tags.append(f"trainer {rank}")
+    return watch_procs(procs, tags)
+
+
+def launch_ps(script_args, num_servers=1, num_trainers=1,
+              host="127.0.0.1", server_optimizer="sgd", server_lr=0.01,
+              log_dir=None, extra_env=None):
+    """Spawn pserver processes (native PS servers) + trainer processes
+    (fleet/launch.py:227 launch_ps parity)."""
+    ports = _free_ports(num_servers)
+    endpoints = ",".join(f"{host}:{p}" for p in ports)
+    procs, tags = [], []
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    for sid, port in enumerate(ports):
+        env = dict(os.environ)
+        env.update({
+            "TRAINING_ROLE": "PSERVER",
+            "PADDLE_PORT": str(port),
+            "PADDLE_TRAINERS_NUM": str(num_trainers),
+            "POD_IP": host,
+        })
+        env.update(extra_env or {})
+        out = None
+        if log_dir:
+            out = open(os.path.join(log_dir, f"serverlog.{sid}"), "w")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.ps",
+             "--port", str(port), "--trainers", str(num_trainers),
+             "--optimizer", server_optimizer, "--lr", str(server_lr)],
+            env=env, stdout=out,
+            stderr=subprocess.STDOUT if out else None))
+        tags.append(f"pserver {sid}")
+    trainer_procs = []
+    for rank in range(num_trainers):
+        env = dict(os.environ)
+        env.update({
+            "TRAINING_ROLE": "TRAINER",
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(num_trainers),
+            "PADDLE_PSERVER_ENDPOINTS": endpoints,
+        })
+        env.update(extra_env or {})
+        out = None
+        if log_dir:
+            out = open(os.path.join(log_dir, f"workerlog.{rank}"), "w")
+        trainer_procs.append(subprocess.Popen(
+            [sys.executable, *script_args], env=env, stdout=out,
+            stderr=subprocess.STDOUT if out else None))
+        tags.append(f"trainer {rank}")
+    all_procs = procs + trainer_procs
+    # trainers finishing cleanly ends the job; then stop servers
+    rc = 0
+    try:
+        while True:
+            t_alive = False
+            for i, p in enumerate(trainer_procs):
+                prc = p.poll()
+                if prc is None:
+                    t_alive = True
+                elif prc != 0:
+                    sys.stderr.write(
+                        f"[launch] trainer {i} exited {prc}; "
+                        "terminating job\n")
+                    terminate_procs(all_procs)
+                    return prc
+            for i, p in enumerate(procs):
+                prc = p.poll()
+                if prc is not None and t_alive:
+                    sys.stderr.write(
+                        f"[launch] pserver {i} died ({prc}); "
+                        "terminating job\n")
+                    terminate_procs(all_procs)
+                    return prc or 1
+            if not t_alive:
+                break
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        rc = 1
+    terminate_procs(procs)
+    return rc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="paddle_tpu multi-process launcher (fleetrun parity)")
+    ap.add_argument("--nproc_per_node", type=int, default=None)
+    ap.add_argument("--server_num", type=int, default=0)
+    ap.add_argument("--worker_num", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--started_port", type=int, default=None)
+    ap.add_argument("--log_dir", default=None)
+    ap.add_argument("--server_optimizer", default="sgd")
+    ap.add_argument("--server_lr", type=float, default=0.01)
+    ap.add_argument("script", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    script = [a for a in args.script if a != "--"]
+    if not script:
+        ap.error("no training script given")
+    if args.server_num > 0:
+        return launch_ps(script, num_servers=args.server_num,
+                         num_trainers=args.worker_num or 1,
+                         host=args.host, log_dir=args.log_dir,
+                         server_optimizer=args.server_optimizer,
+                         server_lr=args.server_lr)
+    nproc = args.nproc_per_node or 1
+    return launch_collective(script, nproc=nproc, host=args.host,
+                             started_port=args.started_port,
+                             log_dir=args.log_dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

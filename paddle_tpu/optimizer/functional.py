@@ -302,3 +302,91 @@ def from_eager(opt) -> Transform:
                 f"grad_clip {type(clip).__name__} not representable in the "
                 f"SPMD step; only ClipGradByGlobalNorm is carried over")
     return tx
+
+
+class LarsState(NamedTuple):
+    count: Any
+    velocity: Any
+
+
+def lars_momentum(learning_rate=0.01, mu=0.9, lars_coeff=0.001,
+                  lars_weight_decay=5e-4, epsilon=1e-9):
+    """lars_momentum_op.cc parity: layer-wise adaptive rate scaling.
+    local_lr = lr * coeff * ||p|| / (||g|| + wd*||p|| + eps),
+    v = mu*v + local_lr*(g + wd*p); p -= v."""
+
+    def init(params):
+        import jax.numpy as jnp
+
+        return LarsState(
+            count=jnp.zeros((), jnp.int32),
+            velocity=_map(jnp.zeros_like, params))
+
+    def update(params, grads, state):
+        import jax.numpy as jnp
+
+        lrv = _resolve_lr(learning_rate, state.count)
+
+        def one(p, g, v):
+            pn = jnp.linalg.norm(p.astype(jnp.float32))
+            gn = jnp.linalg.norm(g.astype(jnp.float32))
+            local = lrv * lars_coeff * pn / (
+                gn + lars_weight_decay * pn + epsilon)
+            local = jnp.where(pn > 0, local, lrv)
+            nv = mu * v + local.astype(p.dtype) * (
+                g + lars_weight_decay * p)
+            return p - nv, nv
+
+        import jax
+
+        out = jax.tree_util.tree_map(one, params, grads, state.velocity)
+        leaf = lambda x: isinstance(x, tuple)  # noqa: E731
+        new_p = jax.tree_util.tree_map(lambda o: o[0], out, is_leaf=leaf)
+        new_v = jax.tree_util.tree_map(lambda o: o[1], out, is_leaf=leaf)
+        return new_p, LarsState(state.count + 1, new_v)
+
+    return Transform(init, update)
+
+
+class DgcState(NamedTuple):
+    inner: Any
+    residual: Any  # error-feedback accumulator (momentum correction)
+
+
+def dgc(tx: Transform, sparsity=0.99, rampup_begin_step=0):
+    """Deep Gradient Compression (details/sparse_all_reduce_op_handle.cc +
+    DGCMomentumOptimizer capability): keep only the top-(1-sparsity)
+    magnitude entries of each grad, accumulate the rest locally
+    (error feedback), then run the inner rule on the sparsified grad.
+    On TPU the sparsified grad still rides the dense XLA all-reduce (ICI
+    bandwidth is the non-issue; the capability kept is the accuracy
+    behavior of DGC's momentum correction)."""
+
+    def init(params):
+        import jax.numpy as jnp
+
+        return DgcState(inner=tx.init(params),
+                        residual=_map(jnp.zeros_like, params))
+
+    def update(params, grads, state, **kw):
+        import jax.numpy as jnp
+
+        def compress(g, r):
+            acc = g + r
+            flat = jnp.abs(acc).reshape(-1)
+            k = max(1, int(flat.size * (1.0 - sparsity)))
+            thresh = jax.lax.top_k(flat, k)[0][-1]
+            mask = jnp.abs(acc) >= thresh
+            sent = jnp.where(mask, acc, 0)
+            return sent, acc - sent
+
+        import jax
+
+        out = jax.tree_util.tree_map(compress, grads, state.residual)
+        leaf = lambda x: isinstance(x, tuple)  # noqa: E731
+        sent = jax.tree_util.tree_map(lambda o: o[0], out, is_leaf=leaf)
+        resid = jax.tree_util.tree_map(lambda o: o[1], out, is_leaf=leaf)
+        new_p, new_inner = tx.update(params, sent, state.inner, **kw)
+        return new_p, DgcState(new_inner, resid)
+
+    return Transform(init, update)
