@@ -17,18 +17,23 @@ namespace ptcore {
 class Arena {
  public:
   explicit Arena(size_t chunk_bytes = 64 << 20, size_t alignment = 64)
-      : chunk_(chunk_bytes), align_(alignment) {}
+      : chunk_(0), align_(alignment ? alignment : 64) {
+    // aligned_alloc requires size to be a multiple of alignment
+    chunk_ = RoundUp(chunk_bytes ? chunk_bytes : align_);
+  }
   ~Arena() {
     for (void* c : chunks_) std::free(c);
   }
 
   void* Alloc(size_t n) {
     std::lock_guard<std::mutex> lk(mu_);
-    n = RoundUp(n);
+    n = RoundUp(n ? n : 1);  // size-0 allocs get a real block: a zero-size
+                             // best-fit would re-free the block it returns
     auto it = free_.lower_bound(n);  // best fit: smallest block >= n
     if (it == free_.end()) {
       Grow(n);
       it = free_.lower_bound(n);
+      if (it == free_.end()) return nullptr;  // OOM: Grow failed
     }
     size_t bsz = it->first;
     char* p = it->second;
@@ -61,6 +66,7 @@ class Arena {
   void Grow(size_t need) {
     size_t sz = need > chunk_ ? RoundUp(need) : chunk_;
     void* c = std::aligned_alloc(align_, sz);
+    if (!c) return;  // OOM surfaces as Alloc() -> nullptr
     chunks_.push_back(c);
     reserved_ += sz;
     free_.emplace(sz, (char*)c);

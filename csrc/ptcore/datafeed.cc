@@ -26,7 +26,11 @@ void DataFeed::Start(int batch_size, int64_t shuffle_buf, uint64_t seed) {
   record_q_.Reopen();
   batch_q_.Reopen();
   samples_seen_ = 0;
-  error_.clear();
+  {
+    std::lock_guard<std::mutex> lk(err_mu_);
+    has_error_.store(false, std::memory_order_release);
+    error_.clear();
+  }
   for (const auto& f : files_) {
     std::string copy = f;
     file_q_.Push(std::move(copy));
@@ -95,6 +99,13 @@ bool DataFeed::ParseLine(const char* p, size_t len, Record* rec) {
   return true;
 }
 
+void DataFeed::SetError(std::string msg) {
+  std::lock_guard<std::mutex> lk(err_mu_);
+  if (has_error_.load(std::memory_order_relaxed)) return;  // first error wins
+  error_ = std::move(msg);
+  has_error_.store(true, std::memory_order_release);
+}
+
 void DataFeed::ParseWorker() {
   std::string path;
   while (file_q_.Pop(&path)) {
@@ -109,7 +120,7 @@ void DataFeed::ParseWorker() {
       f = fopen(path.c_str(), "r");
     }
     if (!f) {
-      error_ = "open failed: " + path;
+      SetError("open failed: " + path);
       continue;
     }
     char* line = nullptr;

@@ -60,7 +60,14 @@ class DataFeed {
 
   const std::vector<SlotConf>& slots() const { return slots_; }
   int64_t samples_seen() const { return samples_seen_.load(); }
-  const std::string& error() const { return error_; }
+  // first-error-wins, written once under err_mu_; the acquire load pairs
+  // with SetError's release store so readers never observe a half-written
+  // string (parser threads race to report; pt_feed_error reads concurrently)
+  const std::string& error() const {
+    static const std::string kEmpty;
+    return has_error_.load(std::memory_order_acquire) ? error_ : kEmpty;
+  }
+  void SetError(std::string msg);
 
  private:
   void ParseWorker();
@@ -78,6 +85,8 @@ class DataFeed {
   std::thread assembler_;
   std::atomic<int> live_parsers_{0};
   std::atomic<int64_t> samples_seen_{0};
+  std::mutex err_mu_;
+  std::atomic<bool> has_error_{false};
   std::string error_;
   bool started_ = false;
 };

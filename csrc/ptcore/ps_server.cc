@@ -186,6 +186,9 @@ class Server {
 
   ~Server() { Stop(); }
 
+  // true once a client sent kShutdown; standalone pserver loops poll this
+  bool ShutdownRequested() const { return shutdown_req_ || stopping_; }
+
   // heartbeat monitor capability: trainers last-seen, in ms-since-start
   int StaleTrainers(int64_t timeout_ms) {
     std::lock_guard<std::mutex> lk(hb_mu_);
@@ -377,8 +380,14 @@ class Server {
           barrier_cv_.notify_all();
         } else {
           barrier_cv_.wait(lk, [&] {
-            return barrier_gen_[bid] != gen || stopping_;
+            return barrier_gen_[bid] != gen || stopping_ || shutdown_req_;
           });
+          if (barrier_gen_[bid] == gen) {
+            // released by shutdown, not by the barrier completing: undo our
+            // arrival and fail loudly so stragglers don't proceed as synced
+            if (barrier_count_[bid] > 0) barrier_count_[bid]--;
+            return Err(resp, "server shutting down");
+          }
         }
         resp->Put<uint8_t>(kOk);
         return;
@@ -397,12 +406,16 @@ class Server {
       }
       case kShutdown: {
         resp->Put<uint8_t>(kOk);
-        stopping_ = true;
+        // only REQUEST shutdown here; stopping_ must stay false so a later
+        // Stop() (pt_ps_server_stop / ~Server) still runs its full teardown
+        // — joining accept_thread_ — instead of early-returning and leaving
+        // a joinable std::thread to std::terminate the process.
+        shutdown_req_ = true;
         {
           std::lock_guard<std::mutex> lk(barrier_mu_);
           barrier_cv_.notify_all();
         }
-        // close the listening socket so AcceptLoop exits
+        // wake the listener so AcceptLoop exits
         shutdown(fd_, SHUT_RDWR);
         return;
       }
@@ -468,6 +481,7 @@ class Server {
   std::string opt_;
   float lr_;
   std::atomic<bool> stopping_{false};
+  std::atomic<bool> shutdown_req_{false};
   std::thread accept_thread_;
   std::mutex conn_mu_;
   std::condition_variable done_cv_;
@@ -591,6 +605,9 @@ void pt_ps_server_destroy(void* h) { delete (Server*)h; }
 int pt_ps_server_stale(void* h, int64_t timeout_ms) {
   return ((Server*)h)->StaleTrainers(timeout_ms);
 }
+int pt_ps_server_shutdown_requested(void* h) {
+  return ((Server*)h)->ShutdownRequested() ? 1 : 0;
+}
 
 void* pt_ps_connect(const char* host, int port) {
   auto* c = new Client;
@@ -658,6 +675,10 @@ int pt_ps_pull_dense(void* h, const char* name, float* out, uint64_t n) {
     CaptureServerError(c);
     return -2;
   }
+  if (g_resp.size() < 9) {
+    c->error = "pull_dense: truncated response header";
+    return -4;
+  }
   uint64_t count = 0;
   memcpy(&count, g_resp.data() + 1, 8);
   if (count != n) {
@@ -665,6 +686,10 @@ int pt_ps_pull_dense(void* h, const char* name, float* out, uint64_t n) {
                std::to_string(count) + ", caller expects " +
                std::to_string(n);
     return -3;
+  }
+  if (g_resp.size() < 9 + (uint64_t)n * 4) {
+    c->error = "pull_dense: truncated response payload";
+    return -4;
   }
   memcpy(out, g_resp.data() + 9, n * 4);
   return 0;
@@ -695,6 +720,10 @@ int pt_ps_pull_sparse(void* h, const char* table, uint32_t dim,
   if (g_resp.empty() || g_resp[0] != 0) {
     CaptureServerError(c);
     return -2;
+  }
+  if (g_resp.size() < 9 + (uint64_t)n * dim * 4) {
+    c->error = "pull_sparse: truncated response payload";
+    return -4;
   }
   memcpy(out, g_resp.data() + 9, (uint64_t)n * dim * 4);
   return 0;

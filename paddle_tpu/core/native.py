@@ -158,6 +158,7 @@ def _declare(lib):
         "pt_ps_server_stop": (None, [c.c_void_p]),
         "pt_ps_server_destroy": (None, [c.c_void_p]),
         "pt_ps_server_stale": (c.c_int, [c.c_void_p, c.c_int64]),
+        "pt_ps_server_shutdown_requested": (c.c_int, [c.c_void_p]),
         "pt_ps_connect": (c.c_void_p, [c.c_char_p, c.c_int]),
         "pt_ps_disconnect": (None, [c.c_void_p]),
         "pt_ps_client_error": (c.c_char_p, [c.c_void_p]),

@@ -51,6 +51,10 @@ class PsServer:
         """Heartbeat monitor: trainers not seen within timeout."""
         return self._lib.pt_ps_server_stale(self._h, timeout_ms)
 
+    def shutdown_requested(self):
+        """True once a client issued the shutdown RPC."""
+        return bool(self._lib.pt_ps_server_shutdown_requested(self._h))
+
     def stop(self):
         if self._h:
             self._lib.pt_ps_server_stop(self._h)
@@ -337,7 +341,9 @@ def run_pserver(port=0, trainers=1, optimizer="sgd", lr=0.01,
     if not block:
         return server
     try:
-        while True:
+        # exit when a trainer sends shutdown_server (listen_and_serv
+        # semantics: server loop ends on the RPC shutdown notify)
+        while not server.shutdown_requested():
             time.sleep(0.2)
     except KeyboardInterrupt:
         pass

@@ -118,7 +118,11 @@ class MultiprocessIter:
         self._next_task = 0        # next task id to hand out
         self._next_yield = 0       # next task id to yield (ordering)
         self._cache = {}
-        self._workers_done = 0
+        # iterable mode: workers that answered StopIteration once; they are
+        # skipped by the dispatcher and counted at most once toward epoch end
+        self._exhausted = set()
+        self._task_worker = {}     # task id -> wid it was dispatched to
+        self._rr = 0               # round-robin cursor over live workers
         self._sent = 0
         self._outstanding_target = num_workers * max(2, prefetch_factor)
         for wid in range(num_workers):
@@ -139,11 +143,18 @@ class MultiprocessIter:
                 break
 
     def _dispatch_one(self):
+        if len(self._exhausted) >= self._num_workers:
+            return False
         try:
             indices = next(self._batches)
         except StopIteration:
             return False
-        wid = self._next_task % self._num_workers
+        for _ in range(self._num_workers):
+            wid = self._rr % self._num_workers
+            self._rr += 1
+            if wid not in self._exhausted:
+                break
+        self._task_worker[self._next_task] = wid
         self._index_queues[wid].put((self._next_task, indices))
         self._next_task += 1
         self._sent += 1
@@ -155,14 +166,19 @@ class MultiprocessIter:
     def __next__(self):
         while True:
             if self._next_yield in self._cache:
-                batch, err = self._cache.pop(self._next_yield)
+                tid = self._next_yield
+                batch, err = self._cache.pop(tid)
                 self._next_yield += 1
+                wid = self._task_worker.pop(tid, tid % self._num_workers)
                 if isinstance(err, StopIteration):
-                    # one iterable worker ran dry; others may still produce
-                    self._workers_done += 1
-                    if self._workers_done >= self._num_workers:
+                    # this iterable worker ran dry; count each worker once
+                    # (in-flight tasks to an already-dry worker answer
+                    # StopIteration too) and stop dispatching to it
+                    self._exhausted.add(wid)
+                    if len(self._exhausted) >= self._num_workers:
                         self.shutdown()
                         raise StopIteration
+                    self._dispatch_one()  # keep remaining workers busy
                     continue
                 if err is not None:
                     self.shutdown()

@@ -165,3 +165,25 @@ def test_collate_nested():
     out = default_collate_fn(batch)
     assert out["x"].shape == (2, 2)
     assert out["y"].tolist() == [1, 2]
+
+
+class _UnbalancedIDS(IterableDataset):
+    """Self-sharding stream where worker 0 holds 2 samples and worker 1
+    holds 20 — the ADVICE round-1 silent-data-loss scenario (an exhausted
+    worker kept answering StopIteration until the done-count hit
+    num_workers while the other worker still had data)."""
+
+    def __iter__(self):
+        wi = get_worker_info()
+        wid = wi.id if wi else 0
+        n = 2 if wid == 0 else 20
+        for i in range(n):
+            yield np.float32(wid * 1000 + i)
+
+
+def test_multiprocess_iterable_unbalanced_workers_no_data_loss():
+    dl = DataLoader(_UnbalancedIDS(), batch_size=2, num_workers=2)
+    vals = sorted(float(v) for b in dl for v in np.asarray(b._data).ravel())
+    want = sorted([float(i) for i in range(2)] +
+                  [float(1000 + i) for i in range(20)])
+    assert vals == want

@@ -237,3 +237,20 @@ def test_ctr_sparse_dense_convergence():
         comm.close()
     finally:
         srv.stop()
+
+
+def test_shutdown_rpc_then_stop_joins_cleanly():
+    """ADVICE round-1: a client kShutdown used to set the server's stopping
+    flag directly, so a later Stop() early-returned without joining the
+    accept thread → std::terminate in ~Server. Now shutdown is a request
+    flag; Stop() must still run its full teardown."""
+    from paddle_tpu.distributed.ps import PsClient, PsServer
+
+    srv = PsServer(port=0, trainers=1)
+    cli = PsClient("127.0.0.1", srv.port)
+    cli.init_dense("w", np.zeros(4, np.float32))
+    assert not srv.shutdown_requested()
+    cli.shutdown_server()
+    assert srv.shutdown_requested()
+    cli.close()
+    srv.stop()  # must not abort the process
