@@ -178,7 +178,8 @@ class Executor:
         def execute(persist, feed, rng_key):
             env = dict(persist)
             env.update(feed)
-            ctx = lowering.LowerCtx(env, rng_key, training=True)
+            ctx = lowering.LowerCtx(env, rng_key, training=True,
+                                    program=program)
             # with an autodiff op, the forward segment runs once INSIDE
             # value_and_grad (residual-sharing); skip re-running it here
             start = 0
@@ -204,7 +205,8 @@ class Executor:
                 env2.update(feed)
                 env2.update(zip(param_names, param_vals))
                 ctx2 = lowering.LowerCtx(env2, rng_key,
-                                         training=ctx.training)
+                                         training=ctx.training,
+                                         program=program)
                 for fop in fwd_ops:
                     if fop.type in ("feed", "fetch"):
                         continue
@@ -254,7 +256,8 @@ def _lower_block_callable(program, feed_names, fetch_names, scope=None):
 
         env = dict(persist_vals)
         env.update(zip(feed_names, feed_arrays))
-        ctx = lowering.LowerCtx(env, jax.random.PRNGKey(0), training=False)
+        ctx = lowering.LowerCtx(env, jax.random.PRNGKey(0), training=False,
+                                program=program)
         for op in ops:
             if op.type in ("feed", "fetch"):
                 continue

@@ -97,6 +97,35 @@ class Variable:
     def __truediv__(self, o):
         return self._binop(o, "elementwise_div")
 
+    def __mod__(self, o):
+        return self._binop(o, "elementwise_mod")
+
+    def __floordiv__(self, o):
+        return self._binop(o, "elementwise_floordiv")
+
+    def __neg__(self):
+        return self._binop(-1.0, "elementwise_mul")
+
+    def __lt__(self, o):
+        from .layers.control_flow import less_than
+
+        return less_than(self, o)
+
+    def __le__(self, o):
+        from .layers.control_flow import less_equal
+
+        return less_equal(self, o)
+
+    def __gt__(self, o):
+        from .layers.control_flow import greater_than
+
+        return greater_than(self, o)
+
+    def __ge__(self, o):
+        from .layers.control_flow import greater_equal
+
+        return greater_equal(self, o)
+
     def __matmul__(self, o):
         from .layers.nn import matmul
 
@@ -254,10 +283,26 @@ class Program:
     def num_blocks(self):
         return len(self.blocks)
 
-    def _create_block(self, parent_idx=0):
+    def _create_block(self, parent_idx=None):
+        if parent_idx is None:
+            parent_idx = self.current_block().idx
         b = Block(self, len(self.blocks), parent_idx)
         self.blocks.append(b)
         return b
+
+    @contextlib.contextmanager
+    def _block_guard(self):
+        """Build ops into a fresh sub-block (control-flow bodies). The
+        reference switches BlockDesc on a stack (framework.py:3934
+        Program._create_block/_rollback); here the guard sets
+        current_block so LayerHelper appends land in the sub-block."""
+        prev = self.current_block().idx
+        b = self._create_block(prev)
+        self._current_block_idx = b.idx
+        try:
+            yield b
+        finally:
+            self._current_block_idx = prev
 
     def list_vars(self):
         for b in self.blocks:

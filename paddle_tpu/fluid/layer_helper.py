@@ -65,8 +65,10 @@ class LayerHelper:
         initializer = attr.initializer or default_initializer
         name = attr.name or unique_name.generate(
             f"{self.name}.w" if not is_bias else f"{self.name}.b")
-        # main-program view of the parameter
-        p = self.block.create_parameter(
+        # main-program view of the parameter — ALWAYS in the global block,
+        # even when the op using it sits in a control-flow sub-block
+        # (reference: Parameters live in block 0, framework.py:5053)
+        p = self.main_program.global_block().create_parameter(
             name=name, shape=list(shape), dtype=dtype,
             optimize_attr={"learning_rate": attr.learning_rate},
             regularizer=attr.regularizer)

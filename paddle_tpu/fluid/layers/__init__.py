@@ -1,3 +1,4 @@
+from .control_flow import *  # noqa: F401,F403
 from .math_ops import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403

@@ -1,0 +1,470 @@
+"""Static-graph control flow: While / cond / case / StaticRNN + array ops.
+
+Reference parity: python/paddle/fluid/layers/control_flow.py (While, cond,
+case, switch_case, StaticRNN, increment, array_write/read/length, the
+compare/logical sugar) over operators/controlflow/while_op.cc,
+conditional_block_op.cc and operators/recurrent_op.cc.
+
+TPU-native design (SURVEY.md §7 hard part 2): sub-blocks are real Blocks in
+the Program IR — serialization/clone keep working — but execution does NOT
+scope-switch an interpreter. At lowering time (fluid/lowering.py) the
+sub-blocks trace into XLA structured control flow:
+
+    while            -> lax.while_loop   (forward; inference loops)
+    conditional_block-> lax.cond         (differentiable)
+    recurrent        -> lax.scan         (differentiable; RNN training)
+
+Loop-carried state is computed at BUILD time: every name a sub-block writes
+that belongs to an ancestor block is part of the carry (the functional
+analogue of the reference's write-to-parent-scope semantics).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ...core.dtypes import dtype_name
+from ..framework import Variable, unique_name
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "While", "cond", "case", "switch_case", "StaticRNN", "increment",
+    "less_than", "less_equal", "greater_than", "greater_equal", "equal",
+    "not_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "create_array", "array_write", "array_read", "array_length",
+]
+
+
+def _parent_visible_writes(sub_block):
+    """Names written by sub_block ops that live in an ancestor block —
+    the loop-carried / branch-merged state."""
+    parent = sub_block.program.block(sub_block.parent_idx)
+    written, seen = [], set()
+    for op in sub_block.ops:
+        for n in op.output_arg_names:
+            if n in seen:
+                continue
+            seen.add(n)
+            if n not in sub_block.vars and parent.has_var(n):
+                written.append(n)
+    return written
+
+
+# ---------------- compare / logical sugar ----------------
+
+def _cmp_layer(op_type, x, y, out=None):
+    helper = LayerHelper(op_type)
+    if not isinstance(y, Variable):
+        from .math_ops import fill_constant_scalar
+
+        y = fill_constant_scalar(helper, x, y)
+    if out is None:
+        out = helper.create_variable_for_type_inference("bool", x.shape)
+    helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def less_than(x, y, force_cpu=None, cond=None, name=None):
+    return _cmp_layer("less_than", x, y, cond)
+
+
+def less_equal(x, y, cond=None, name=None):
+    return _cmp_layer("less_equal", x, y, cond)
+
+
+def greater_than(x, y, cond=None, name=None):
+    return _cmp_layer("greater_than", x, y, cond)
+
+
+def greater_equal(x, y, cond=None, name=None):
+    return _cmp_layer("greater_equal", x, y, cond)
+
+
+def equal(x, y, cond=None, name=None):
+    return _cmp_layer("equal", x, y, cond)
+
+
+def not_equal(x, y, cond=None, name=None):
+    return _cmp_layer("not_equal", x, y, cond)
+
+
+def _logical_layer(op_type, x, y, out=None):
+    helper = LayerHelper(op_type)
+    if out is None:
+        out = helper.create_variable_for_type_inference("bool", x.shape)
+    inputs = {"X": [x]} if y is None else {"X": [x], "Y": [y]}
+    helper.append_op(type=op_type, inputs=inputs,
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def logical_and(x, y, out=None, name=None):
+    return _logical_layer("logical_and", x, y, out)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _logical_layer("logical_or", x, y, out)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _logical_layer("logical_xor", x, y, out)
+
+
+def logical_not(x, out=None, name=None):
+    return _logical_layer("logical_not", x, None, out)
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="increment", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"step": float(value)})
+    return out
+
+
+# ---------------- While ----------------
+
+class While:
+    """fluid.layers.While parity (control_flow.py While). Lowered to
+    lax.while_loop; the condition var must be updated inside the body.
+
+    Usage::
+
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 10)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            ... body ops mutating parent vars ...
+            layers.increment(i)
+            layers.less_than(i, n, cond=cond)
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.helper = LayerHelper("while", name=name)
+        if list(getattr(cond, "shape", [])) not in ([], [1]):
+            raise TypeError(
+                f"While condition must be a scalar/[1] bool var, got "
+                f"shape {cond.shape}")
+        self.cond_var = cond
+        self.is_test = is_test
+
+    @contextlib.contextmanager
+    def block(self):
+        prog = self.helper.main_program
+        with prog._block_guard() as blk:
+            yield
+        carry = _parent_visible_writes(blk)
+        if self.cond_var.name not in carry:
+            carry.append(self.cond_var.name)
+        parent = prog.block(blk.parent_idx)
+        parent.append_op(
+            type="while",
+            inputs={"Condition": [self.cond_var], "X": list(carry)},
+            outputs={"Out": list(carry)},
+            attrs={"sub_block": blk.idx, "carry_names": list(carry),
+                   "is_test": self.is_test})
+
+
+# ---------------- cond / case / switch_case ----------------
+
+def _flatten_rets(rets):
+    if rets is None:
+        return []
+    if isinstance(rets, Variable):
+        return [rets]
+    out = []
+    for r in rets:
+        out.extend(_flatten_rets(r))
+    return out
+
+
+def _pack_like(template, flat):
+    """Rebuild template's nesting with vars from flat (consumed in order)."""
+    it = iter(flat)
+
+    def pack(t):
+        if t is None:
+            return None
+        if isinstance(t, Variable):
+            return next(it)
+        return type(t)(pack(x) for x in t)
+
+    return pack(template)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """paddle.static.nn.cond parity — both branches trace into sub-blocks
+    and lower to lax.cond (differentiable; both branches must return the
+    same structure/shapes, reference control_flow.py cond semantics)."""
+    helper = LayerHelper("cond", name=name)
+    prog = helper.main_program
+    with prog._block_guard() as tb:
+        true_out = true_fn() if true_fn is not None else None
+    with prog._block_guard() as fb:
+        false_out = false_fn() if false_fn is not None else None
+    t_flat = _flatten_rets(true_out)
+    f_flat = _flatten_rets(false_out)
+    if len(t_flat) != len(f_flat):
+        raise ValueError(
+            f"cond branches must return the same structure: true_fn "
+            f"returned {len(t_flat)} vars, false_fn {len(f_flat)}")
+    parent = prog.block(tb.parent_idx)
+    outs = [parent.create_var(name=unique_name.generate("cond_out"),
+                              shape=v.shape, dtype=v.dtype)
+            for v in t_flat]
+    carry = sorted(set(_parent_visible_writes(tb)) |
+                   set(_parent_visible_writes(fb)))
+    parent.append_op(
+        type="conditional_block",
+        inputs={"Cond": [pred]},
+        outputs={"Out": [o.name for o in outs] + carry},
+        attrs={"sub_block_t": tb.idx, "sub_block_f": fb.idx,
+               "true_rets": [v.name for v in t_flat],
+               "false_rets": [v.name for v in f_flat],
+               "out_names": [o.name for o in outs],
+               "carry_names": carry})
+    if true_out is None:
+        return None
+    return _pack_like(true_out, outs)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """fluid.layers.case parity: chained cond."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    (pred, fn), rest = pred_fn_pairs[0], pred_fn_pairs[1:]
+    if not rest:
+        if default is None:
+            return cond(pred, fn, fn, name=name)
+        return cond(pred, fn, default, name=name)
+    return cond(pred, fn, lambda: case(rest, default), name=name)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """fluid.layers.switch_case parity over an int index var."""
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    else:
+        pairs = list(enumerate(branch_fns))
+    from .tensor import fill_constant
+
+    pred_pairs = []
+    for idx, fn in pairs:
+        c = fill_constant([1], branch_index.dtype or "int64", int(idx))
+        pred_pairs.append((equal(branch_index, c), fn))
+    if default is None:
+        default = pred_pairs[-1][1]
+    return case(pred_pairs, default, name=name)
+
+
+# ---------------- StaticRNN ----------------
+
+class StaticRNN:
+    """fluid.layers.StaticRNN parity (control_flow.py StaticRNN over
+    operators/recurrent_op.cc). Lowered to lax.scan over the leading
+    (time) axis — fully differentiable, so seq2seq trains through
+    jax_autodiff.
+
+    Usage::
+
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            w = rnn.step_input(x_tbd)        # x: [T, B, D]
+            h_prev = rnn.memory(init=h0)     # h0: [B, H]
+            h = layers.tanh(layers.fc(w, H) + layers.fc(h_prev, H))
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        hs = rnn()                           # [T, B, H]
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self.seq_len = None
+        self._step_inputs = []   # (placeholder_name, source Variable)
+        self._memories = []      # {boot: Variable, pre: name, new: name}
+        self._step_outputs = []  # step-level Variables
+        self._block = None
+        self._parent_outs = None
+
+    @contextlib.contextmanager
+    def step(self):
+        prog = self.helper.main_program
+        with prog._block_guard() as blk:
+            self._block = blk
+            yield
+        self._complete(blk)
+
+    def _require_block(self):
+        if self._block is None:
+            raise RuntimeError("StaticRNN ops must be used inside "
+                               "`with rnn.step():`")
+        return self._block
+
+    def step_input(self, x):
+        blk = self._require_block()
+        if self.seq_len is None:
+            self.seq_len = x.shape[0] if x.shape else None
+        ipt = blk.create_var(name=unique_name.generate(f"{x.name}@step"),
+                             shape=list(x.shape[1:]), dtype=x.dtype)
+        self._step_inputs.append((ipt.name, x))
+        return ipt
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        blk = self._require_block()
+        prog = blk.program
+        parent = prog.block(blk.parent_idx)
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("StaticRNN.memory needs init= or "
+                                 "(shape=, batch_ref=)")
+            # batch_ref is usually the step-input placeholder, which only
+            # exists inside the scan body — the boot fill op runs in the
+            # PARENT block, so point it at the placeholder's source
+            # sequence (its dim k is the source's dim k+1)
+            ref, ref_idx = batch_ref, ref_batch_dim_idx
+            for ph_name, src in self._step_inputs:
+                if ph_name == batch_ref.name:
+                    ref, ref_idx = src, ref_batch_dim_idx + 1
+                    break
+            init = parent.create_var(
+                name=unique_name.generate("rnn_boot"),
+                shape=list(shape), dtype=batch_ref.dtype)
+            parent.append_op(
+                type="fill_constant_batch_size_like",
+                inputs={"Input": [ref]},
+                outputs={"Out": [init]},
+                attrs={"shape": list(shape), "value": float(init_value),
+                       "dtype": dtype_name(batch_ref.dtype)
+                       if batch_ref.dtype is not None else "float32",
+                       "input_dim_idx": ref_idx,
+                       "output_dim_idx": init_batch_dim_idx})
+        pre = blk.create_var(name=unique_name.generate(f"{init.name}@pre"),
+                             shape=list(init.shape), dtype=init.dtype)
+        self._memories.append({"boot": init, "pre": pre.name, "new": None})
+        return pre
+
+    def update_memory(self, pre_mem, new_mem):
+        self._require_block()
+        for m in self._memories:
+            if m["pre"] == pre_mem.name:
+                m["new"] = new_mem.name
+                return
+        raise ValueError(f"{pre_mem.name} is not a StaticRNN memory")
+
+    def step_output(self, o):
+        self._require_block()
+        self._step_outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _complete(self, blk):
+        for m in self._memories:
+            if m["new"] is None:
+                raise ValueError(
+                    f"memory {m['pre']} was never update_memory'd")
+        prog = blk.program
+        parent = prog.block(blk.parent_idx)
+        outs = []
+        for o in self._step_outputs:
+            shape = ([self.seq_len] if self.seq_len is not None else [-1]) \
+                + list(o.shape or [])
+            outs.append(parent.create_var(
+                name=unique_name.generate("rnn_out"),
+                shape=shape, dtype=o.dtype))
+        parent.append_op(
+            type="recurrent",
+            inputs={"StepInputs": [v.name for _, v in self._step_inputs],
+                    "BootMemories": [m["boot"].name
+                                     for m in self._memories]},
+            outputs={"Out": [o.name for o in outs]},
+            attrs={"sub_block": blk.idx,
+                   "step_in_names": [n for n, _ in self._step_inputs],
+                   "src_names": [v.name for _, v in self._step_inputs],
+                   "boot_names": [m["boot"].name for m in self._memories],
+                   "pre_names": [m["pre"] for m in self._memories],
+                   "new_names": [m["new"] for m in self._memories],
+                   "step_out_names": [o.name for o in self._step_outputs],
+                   "out_names": [o.name for o in outs]})
+        self._parent_outs = outs
+        self._block = None
+
+    def __call__(self):
+        if self._parent_outs is None:
+            raise RuntimeError("StaticRNN() called before its step block "
+                               "completed")
+        if len(self._parent_outs) == 1:
+            return self._parent_outs[0]
+        return list(self._parent_outs)
+
+
+# ---------------- LoDTensorArray ops (unrolled trace mode) ----------------
+
+def create_array(dtype, initialized_list=None):
+    """fluid.layers.create_array parity — the var holds a Python list of
+    traced arrays during lowering (write_to_array appends / replaces)."""
+    helper = LayerHelper("create_array")
+    arr = helper.block.create_var(
+        name=unique_name.generate("tensor_array"), dtype=dtype, shape=None)
+    arr.is_tensor_array = True
+    if initialized_list:
+        for i, v in enumerate(initialized_list):
+            idx = fill_i64([1], i)
+            array_write(v, idx, array=arr)
+    return arr
+
+
+def fill_i64(shape, value):
+    from .tensor import fill_constant
+
+    return fill_constant(shape, "int64", value)
+
+
+def _static_index_of(i):
+    """Build-time concrete index when `i` comes from fill_constant —
+    under jit every env value is a tracer, so the lowering can never
+    concretize; recover the index from the producing op instead."""
+    op = getattr(i, "op", None)
+    if op is not None and op.type == "fill_constant":
+        return int(op.attrs.get("value", 0))
+    return -1
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = helper.block.create_var(
+            name=unique_name.generate("tensor_array"), dtype=x.dtype,
+            shape=None)
+        array.is_tensor_array = True
+    helper.append_op(type="write_to_array",
+                     inputs={"X": [x], "I": [i], "ArrayIn": [array]},
+                     outputs={"Out": [array]},
+                     attrs={"static_index": _static_index_of(i)})
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op(type="read_from_array",
+                     inputs={"X": [array], "I": [i]},
+                     outputs={"Out": [out]},
+                     attrs={"static_index": _static_index_of(i)})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference("int64", [1])
+    helper.append_op(type="lod_array_length", inputs={"X": [array]},
+                     outputs={"Out": [out]}, attrs={})
+    return out

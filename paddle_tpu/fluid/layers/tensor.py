@@ -220,3 +220,67 @@ def shape(input):
     helper.append_op(type="shape", inputs={"Input": [input]},
                      outputs={"Out": [out]}, attrs={})
     return out
+
+
+def scatter(input, index, updates, overwrite=True, name=None):
+    """fluid.layers.scatter parity (operators/scatter_op.cc): rows of
+    `input` at `index` replaced (or accumulated) with `updates`."""
+    helper = LayerHelper("scatter")
+    out = helper.create_variable_for_type_inference(input.dtype,
+                                                    input.shape)
+    helper.append_op(type="scatter",
+                     inputs={"X": [input], "Ids": [index],
+                             "Updates": [updates]},
+                     outputs={"Out": [out]},
+                     attrs={"overwrite": overwrite})
+    return out
+
+
+def gather_nd(input, index, name=None):
+    helper = LayerHelper("gather_nd")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="gather_nd",
+                     inputs={"X": [input], "Index": [index]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    helper = LayerHelper("scatter_nd_add")
+    out = helper.create_variable_for_type_inference(ref.dtype, ref.shape)
+    helper.append_op(type="scatter_nd_add",
+                     inputs={"X": [ref], "Index": [index],
+                             "Updates": [updates]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """fluid.layers.create_parameter parity."""
+    from ..layer_helper import ParamAttr
+
+    helper = LayerHelper("create_parameter")
+    attr = ParamAttr._to_attr(attr)
+    if name is not None and attr.name is None:
+        attr.name = name
+    return helper.create_parameter(attr, shape=shape, dtype=dtype,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    helper = LayerHelper("create_global_var")
+    var = helper.create_global_variable(shape, dtype,
+                                        persistable=persistable, name=name)
+    # startup-program twin (like create_parameter): the var must be
+    # registered in the startup block or Executor.run(startup) won't
+    # persist the filled value into the scope
+    sblock = helper.startup_program.global_block()
+    svar = sblock.create_var(name=var.name, shape=list(shape),
+                             dtype=var.dtype, persistable=True)
+    from ..initializer import Constant
+
+    Constant(value)(svar, sblock)
+    return var

@@ -42,11 +42,12 @@ def has_lowering(op_type):
 class LowerCtx:
     """Execution environment handed to lowerings during block tracing."""
 
-    def __init__(self, env, rng_base, training=True):
+    def __init__(self, env, rng_base, training=True, program=None):
         self.env = env          # name -> jnp array
         self._rng_base = rng_base
         self._rng_count = 0
         self.training = training
+        self.program = program  # needed by control-flow ops (sub-blocks)
 
     def inp(self, op, slot, idx=0, default=None):
         names = op.input(slot)
@@ -77,6 +78,26 @@ def _jnp():
     import jax.numpy as jnp
 
     return jnp
+
+
+def trace_block(program, block_idx, env, rng_key, training):
+    """Run every op lowering of a sub-block over env (in place). The
+    control-flow lowerings call this from inside lax.while_loop / cond /
+    scan bodies — sub-blocks become nested XLA regions, not interpreter
+    scope switches (reference: executor.cc:428 RunPartialPreparedContext
+    re-entered per sub-block)."""
+    ctx = LowerCtx(env, rng_key, training=training, program=program)
+    for op in program.block(block_idx).ops:
+        get_lowering(op.type)(ctx, op)
+    return env
+
+
+def _require_program(ctx, op):
+    if ctx.program is None:
+        raise RuntimeError(
+            f"op {op.type!r} needs sub-block access but this LowerCtx has "
+            f"no program attached")
+    return ctx.program
 
 
 # ============ elementwise (operators/elementwise/) ============
@@ -718,11 +739,256 @@ def _sq_l2(ctx, op):
     ctx.out(op, "Out", (x.astype(_jnp().float32) ** 2).sum())
 
 
+# ============ compare / logical (operators/controlflow/) ============
+
+def _cmp(fn):
+    def lower(ctx, op):
+        ctx.out(op, "Out", fn(ctx.inp(op, "X"), ctx.inp(op, "Y")))
+    return lower
+
+
+register("less_than")(_cmp(lambda x, y: x < y))
+register("less_equal")(_cmp(lambda x, y: x <= y))
+register("greater_than")(_cmp(lambda x, y: x > y))
+register("greater_equal")(_cmp(lambda x, y: x >= y))
+register("equal")(_cmp(lambda x, y: x == y))
+register("not_equal")(_cmp(lambda x, y: x != y))
+register("logical_and")(_cmp(lambda x, y: x & y))
+register("logical_or")(_cmp(lambda x, y: x | y))
+register("logical_xor")(_cmp(lambda x, y: x ^ y))
+
+
+@register("logical_not")
+def _logical_not(ctx, op):
+    ctx.out(op, "Out", ~ctx.inp(op, "X"))
+
+
+# ============ scatter / gather_nd ============
+
+@register("scatter")
+def _scatter(ctx, op):
+    x = ctx.inp(op, "X")
+    ids = ctx.inp(op, "Ids")
+    upd = ctx.inp(op, "Updates")
+    if ids.ndim == 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    if op.attrs.get("overwrite", True):
+        ctx.out(op, "Out", x.at[ids].set(upd))
+    else:
+        ctx.out(op, "Out", x.at[ids].add(upd))
+
+
+@register("scatter_nd_add")
+def _scatter_nd_add(ctx, op):
+    x = ctx.inp(op, "X")
+    idx = ctx.inp(op, "Index")
+    upd = ctx.inp(op, "Updates")
+    ctx.out(op, "Out", x.at[tuple(idx[..., d] for d in
+                                  range(idx.shape[-1]))].add(upd))
+
+
+@register("gather_nd")
+def _gather_nd(ctx, op):
+    x = ctx.inp(op, "X")
+    idx = ctx.inp(op, "Index")
+    ctx.out(op, "Out", x[tuple(idx[..., d] for d in range(idx.shape[-1]))])
+
+
+# ============ control flow (operators/controlflow/, recurrent_op.cc) =====
+# SURVEY.md §7 hard part 2: while -> lax.while_loop (forward),
+# conditional_block -> lax.cond, recurrent -> lax.scan (differentiable).
+
+def _as_pred(jnp, v):
+    return jnp.reshape(v.astype(jnp.bool_), ())
+
+
+@register("while")
+def _while(ctx, op):
+    import jax
+
+    jnp = _jnp()
+    prog = _require_program(ctx, op)
+    blk_idx = op.attrs["sub_block"]
+    carry_names = list(op.attrs["carry_names"])
+    cond_name = op.input("Condition")[0]
+    for n in carry_names:
+        if isinstance(ctx.env.get(n), list):
+            raise NotImplementedError(
+                f"while: tensor-array {n!r} in loop carry is not "
+                f"supported; carry a fixed-size buffer updated with "
+                f"scatter instead (static shapes are required by XLA)")
+    base_env = dict(ctx.env)
+    body_key = ctx.next_key()
+    init = tuple(ctx.env[n] for n in carry_names) + \
+        (jnp.zeros((), jnp.int32),)
+
+    def cond_fn(carry):
+        env = dict(zip(carry_names, carry[:-1]))
+        return _as_pred(jnp, env[cond_name])
+
+    def body_fn(carry):
+        env = dict(base_env)
+        env.update(zip(carry_names, carry[:-1]))
+        i = carry[-1]
+        key = jax.random.fold_in(body_key, i)
+        trace_block(prog, blk_idx, env, key, ctx.training)
+        return tuple(env[n] for n in carry_names) + (i + 1,)
+
+    out = jax.lax.while_loop(cond_fn, body_fn, init)
+    for n, v in zip(carry_names, out[:-1]):
+        ctx.env[n] = v
+
+
+@register("conditional_block")
+def _conditional_block(ctx, op):
+    import jax
+
+    jnp = _jnp()
+    prog = _require_program(ctx, op)
+    pred = _as_pred(jnp, ctx.env[op.input("Cond")[0]])
+    carry = list(op.attrs["carry_names"])
+    out_names = list(op.attrs["out_names"])
+    base_env = dict(ctx.env)
+    key_t, key_f = ctx.next_key(), ctx.next_key()
+
+    def make_branch(blk_idx, ret_names, key):
+        def branch(_):
+            env = dict(base_env)
+            trace_block(prog, blk_idx, env, key, ctx.training)
+            missing = [n for n in carry if n not in env]
+            if missing:
+                raise ValueError(
+                    f"cond: carried vars {missing} neither pre-exist nor "
+                    f"are written by both branches")
+            return (tuple(env[n] for n in ret_names) +
+                    tuple(env[n] for n in carry))
+        return branch
+
+    res = jax.lax.cond(
+        pred,
+        make_branch(op.attrs["sub_block_t"], op.attrs["true_rets"], key_t),
+        make_branch(op.attrs["sub_block_f"], op.attrs["false_rets"], key_f),
+        operand=None)
+    n_out = len(out_names)
+    for n, v in zip(out_names, res[:n_out]):
+        ctx.env[n] = v
+    for n, v in zip(carry, res[n_out:]):
+        ctx.env[n] = v
+
+
+@register("recurrent")
+def _recurrent(ctx, op):
+    import jax
+
+    jnp = _jnp()
+    prog = _require_program(ctx, op)
+    a = op.attrs
+    srcs = [ctx.env[n] for n in a["src_names"]]
+    boots = [ctx.env[n] for n in a["boot_names"]]
+    base_env = dict(ctx.env)
+    body_key = ctx.next_key()
+    T = srcs[0].shape[0] if srcs else 0
+
+    def scan_fn(carry, xs):
+        t = xs[0]
+        env = dict(base_env)
+        env.update(zip(a["pre_names"], carry))
+        env.update(zip(a["step_in_names"], xs[1:]))
+        key = jax.random.fold_in(body_key, t)
+        trace_block(prog, a["sub_block"], env, key, ctx.training)
+        new_carry = tuple(env[n] for n in a["new_names"])
+        ys = tuple(env[n] for n in a["step_out_names"])
+        return new_carry, ys
+
+    xs = (jnp.arange(T),) + tuple(srcs)
+    _, ys = jax.lax.scan(scan_fn, tuple(boots), xs)
+    for n, y in zip(a["out_names"], ys):
+        ctx.env[n] = y
+
+
+# ====== LoDTensorArray ops (unrolled trace mode; python list in env) ======
+
+def _concrete_int(op, i):
+    """Concrete array index: build-time static_index attr first (jit makes
+    every env value a tracer), concrete value second."""
+    idx = op.attrs.get("static_index", -1)
+    if idx is not None and idx >= 0:
+        return idx
+    try:
+        return int(i)
+    except Exception:
+        return None
+
+
+@register("write_to_array")
+def _write_to_array(ctx, op):
+    x = ctx.inp(op, "X")
+    i = ctx.inp(op, "I")
+    name = op.output("Out")[0]
+    arr = ctx.env.get(name)
+    arr = list(arr) if isinstance(arr, list) else []
+    idx = _concrete_int(op, i)
+    if idx is not None:
+        if idx < len(arr):
+            arr[idx] = x
+        elif idx == len(arr):
+            arr.append(x)
+        else:
+            raise IndexError(
+                f"write_to_array: index {idx} beyond array length "
+                f"{len(arr)} (sparse writes are not supported)")
+    else:
+        # dynamic index: canonical sequential-write pattern appends
+        # (paddle programs write i = current length)
+        arr.append(x)
+    ctx.env[name] = arr
+
+
+@register("read_from_array")
+def _read_from_array(ctx, op):
+    jnp = _jnp()
+    arr = ctx.inp(op, "X")
+    i = ctx.inp(op, "I")
+    if not isinstance(arr, list) or not arr:
+        raise ValueError(
+            f"read_from_array: {op.input('X')[0]!r} is empty or not a "
+            f"tensor array")
+    idx = _concrete_int(op, i)
+    if idx is not None:
+        ctx.out(op, "Out", arr[idx])
+    else:
+        stacked = jnp.stack(arr)
+        ctx.out(op, "Out", stacked[jnp.reshape(i, ()).astype(jnp.int32)])
+
+
+@register("lod_array_length")
+def _lod_array_length(ctx, op):
+    jnp = _jnp()
+    arr = ctx.inp(op, "X")
+    n = len(arr) if isinstance(arr, list) else 0
+    ctx.out(op, "Out", jnp.asarray([n], jnp.int64))
+
+
+@register("tensor_array_to_tensor")
+def _tensor_array_to_tensor(ctx, op):
+    jnp = _jnp()
+    arr = ctx.inp(op, "X")
+    axis = op.attrs.get("axis", 0)
+    if op.attrs.get("use_stack", False):
+        ctx.out(op, "Out", jnp.stack(arr, axis=axis))
+    else:
+        ctx.out(op, "Out", jnp.concatenate(arr, axis=axis))
+    ctx.out(op, "OutIndex",
+            jnp.asarray([a.shape[axis] for a in arr], jnp.int32))
+
+
 # ============ misc ============
 
 @register("increment")
 def _increment(ctx, op):
-    ctx.out(op, "Out", ctx.inp(op, "X") + op.attrs.get("step", 1.0))
+    x = ctx.inp(op, "X")
+    step = _jnp().asarray(op.attrs.get("step", 1.0), x.dtype)
+    ctx.out(op, "Out", x + step)
 
 
 @register("seq_pool_placeholder")
