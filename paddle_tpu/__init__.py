@@ -68,27 +68,23 @@ from . import fluid  # noqa: F401
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False):
-    """paddle.grad parity (imperative/partial_grad_engine.cc:29)."""
+    """paddle.grad parity (imperative/partial_grad_engine.cc:29): grads of
+    outputs w.r.t. arbitrary inputs (leaf or intermediate) in one reverse
+    pass, leaving every tensor's `.grad` untouched."""
     from .core import autograd as _ag
 
-    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double grad) is not supported yet; for "
+            "higher-order derivatives use paddle_tpu.incubate.autograd / "
+            "jax.grad composition on a functional model")
+    outs = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
     ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    saved = [(t, t._grad) for t in ins]
-    for t in ins:
-        t._grad = None
-    for o in outs:
-        go = None
-        if grad_outputs is not None:
-            idx = outs.index(o)
-            gos = grad_outputs if isinstance(grad_outputs, (list, tuple)) \
-                else [grad_outputs]
-            go = gos[idx] if idx < len(gos) else None
-        _ag.backward(o, go, retain_graph=bool(retain_graph))
-    result = []
-    for t, old in saved:
-        g = t._grad
-        if g is None and not allow_unused:
-            g = None
-        result.append(g)
-        t._grad = old
-    return result
+    gos = None
+    if grad_outputs is not None:
+        gos = list(grad_outputs) if isinstance(
+            grad_outputs, (list, tuple)) else [grad_outputs]
+        gos += [None] * (len(outs) - len(gos))
+    retain = bool(retain_graph) if retain_graph is not None else False
+    return _ag.partial_grad(outs, list(ins), gos, retain_graph=retain,
+                            allow_unused=allow_unused)

@@ -65,56 +65,49 @@ class Node:
         return jnp.zeros(shape, dtype)
 
 
-def backward(root, grad=None, retain_graph=False):
-    """Run reverse-mode accumulation from `root` (a Tensor).
-
-    Mirrors BasicEngine::Execute's dependency-counted queue walk
-    (imperative/basic_engine.cc:219), with GradientAccumulator semantics
-    (sum over multiple consumers) via jnp addition.
-    """
-    import jax.numpy as jnp
-    from .tensor import Tensor
-
-    if root._node is None and root.stop_gradient:
-        raise RuntimeError(
-            "backward() called on a tensor with stop_gradient=True and no "
-            "recorded graph")
-
-    if grad is None:
-        grad_val = jnp.ones_like(root._data)
-    else:
-        grad_val = grad._data if isinstance(grad, Tensor) else jnp.asarray(grad)
-
-    if root._node is None:
-        _accum_leaf(root, grad_val)
-        return
-
-    # --- phase 1: discover reachable nodes + count consumer edges ---
-    nodes = []
-    visited = set()
-    stack = [root._node]
+def _reverse_walk(seeds, take, retain_graph=False, restrict=None):
+    """Shared dependency-counted reverse walk (BasicEngine::Execute parity,
+    imperative/basic_engine.cc:219). `seeds` = [(tensor, cotangent)];
+    `take(tensor, ct)` observes every cotangent delivered to a tensor;
+    `restrict`, when given, is a predicate(node)->bool limiting which nodes
+    run their vjp (partial-grad pruning). Returns the list of ALL discovered
+    nodes (walked or not) so callers can free them."""
+    # --- discover reachable nodes from all seed roots ---
+    all_nodes, visited = [], set()
+    stack = [t._node for t, _ in seeds if t._node is not None]
     while stack:
         node = stack.pop()
         if id(node) in visited:
             continue
         visited.add(id(node))
-        nodes.append(node)
-        node.out_grads = [None] * node.n_outputs
+        all_nodes.append(node)
+        node.out_grads = None
         for t, _needs in node.inputs:
             if t._node is not None:
                 stack.append(t._node)
+
+    nodes = [n for n in all_nodes if restrict is None or restrict(n)]
+    in_graph = {id(n) for n in nodes}
     dep = {id(n): 0 for n in nodes}
     for node in nodes:
         for t, _needs in node.inputs:
-            if t._node is not None:
+            if t._node is not None and id(t._node) in in_graph:
                 dep[id(t._node)] += 1
 
-    # --- phase 2: dependency-counted queue walk from the root ---
-    _accum_output_grad(root._node, root._out_idx, grad_val)
-    queue = [root._node]
+    # --- seed root cotangents ---
+    import collections
+
+    queue = collections.deque()
+    for t, ct in seeds:
+        take(t, ct)
+        if t._node is not None and id(t._node) in in_graph:
+            _accum_output_grad(t._node, t._out_idx, ct)
+            if dep.get(id(t._node), 0) == 0:
+                queue.append(t._node)
+
     processed = set()
     while queue:
-        node = queue.pop(0)
+        node = queue.popleft()
         if id(node) in processed:
             continue
         processed.add(id(node))
@@ -131,16 +124,13 @@ def backward(root, grad=None, retain_graph=False):
             in_cts = None
 
         if in_cts is not None:
-            k = 0
-            for t, needs in node.inputs:
+            for k, (t, needs) in enumerate(node.inputs):
                 ct = in_cts[k]
-                k += 1
                 if not needs or ct is None:
                     continue
-                if t._node is not None:
+                take(t, ct)
+                if t._node is not None and id(t._node) in in_graph:
                     _accum_output_grad(t._node, t._out_idx, ct)
-                else:
-                    _accum_leaf(t, ct)
         if not retain_graph:
             node.vjp_fn = None
 
@@ -151,10 +141,104 @@ def backward(root, grad=None, retain_graph=False):
                 if dep[id(up)] == 0 and id(up) not in processed:
                     queue.append(up)
 
-    for node in nodes:  # free anything unreached
+    for node in all_nodes:  # free anything unreached too
         node.out_grads = None
         if not retain_graph:
             node.vjp_fn = None
+    return all_nodes
+
+
+def backward(root, grad=None, retain_graph=False):
+    """Run reverse-mode accumulation from `root` (a Tensor) into every
+    reachable leaf's `.grad` (GradientAccumulator semantics: sum over
+    multiple consumers)."""
+    import jax.numpy as jnp
+    from .tensor import Tensor
+
+    if root._node is None and root.stop_gradient:
+        raise RuntimeError(
+            "backward() called on a tensor with stop_gradient=True and no "
+            "recorded graph")
+
+    if grad is None:
+        grad_val = jnp.ones_like(root._data)
+    else:
+        grad_val = grad._data if isinstance(grad, Tensor) else jnp.asarray(grad)
+
+    def take(t, ct):
+        if t._node is None:
+            _accum_leaf(t, ct)
+
+    _reverse_walk([(root, grad_val)], take, retain_graph=retain_graph)
+
+
+def partial_grad(outputs, inputs, grad_outputs=None, retain_graph=False,
+                 allow_unused=False):
+    """paddle.grad engine: grads of `outputs` w.r.t. `inputs` in ONE reverse
+    pass over the union graph of all outputs, without touching any leaf's
+    `.grad` (imperative/partial_grad_engine.cc:29 parity). `grad_outputs[i]`
+    is the cotangent seeded at `outputs[i]` (None -> ones). Only the
+    subgraph that can reach a requested input runs its vjps."""
+    import jax.numpy as jnp
+    from .tensor import Tensor
+
+    outs = list(outputs)
+    ins = list(inputs)
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outs)
+    want = {}
+    for i, t in enumerate(ins):
+        want.setdefault(id(t), []).append(i)
+    result = [None] * len(ins)
+
+    def take(t, ct):
+        for i in want.get(id(t), ()):
+            result[i] = ct if result[i] is None else result[i] + ct
+
+    # prune to the subgraph that can reach a requested input: post-order
+    # DFS computing needed(n) = any input tensor requested, or any
+    # upstream producer needed
+    needed = {}
+
+    def _mark(root_node):
+        order = [(root_node, False)]
+        while order:
+            node, expanded = order.pop()
+            if id(node) in needed and not expanded:
+                continue
+            if expanded:
+                needed[id(node)] = any(
+                    id(t) in want
+                    or (t._node is not None and needed.get(id(t._node), False))
+                    for t, _needs in node.inputs)
+            else:
+                needed.setdefault(id(node), False)
+                order.append((node, True))
+                for t, _needs in node.inputs:
+                    if t._node is not None and id(t._node) not in needed:
+                        order.append((t._node, False))
+
+    seeds = []
+    for o, go in zip(outs, grad_outputs):
+        if go is None:
+            ct = jnp.ones_like(o._data)
+        else:
+            ct = go._data if isinstance(go, Tensor) else jnp.asarray(go)
+        seeds.append((o, ct))
+        if o._node is not None and id(o._node) not in needed:
+            _mark(o._node)
+
+    _reverse_walk(seeds, take, retain_graph=retain_graph,
+                  restrict=lambda n: needed.get(id(n), False))
+
+    if not allow_unused:
+        for i, g in enumerate(result):
+            if g is None:
+                raise RuntimeError(
+                    f"input {i} is unreachable from the given outputs; pass "
+                    f"allow_unused=True to get None for it")
+    return [Tensor._wrap(g) if g is not None and not isinstance(g, Tensor)
+            else g for g in result]
 
 
 def _accum_output_grad(node, idx, value):

@@ -12,7 +12,7 @@ grad clipping and user introspection keep their reference semantics.
 from __future__ import annotations
 
 from .framework import Parameter, Variable, default_main_program, \
-    grad_var_name
+    grad_var_name, unique_name
 
 
 def append_backward(loss, parameter_list=None, no_grad_set=None,
@@ -64,11 +64,53 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
 
 def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
     """fluid/backward.py:1665 parity: grads of targets w.r.t. arbitrary
-    inputs (not just Parameters)."""
-    ts = targets if isinstance(targets, (list, tuple)) else [targets]
-    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    pg = append_backward(ts[0], parameter_list=[v.name for v in ins])
-    return [g for _, g in pg]
+    inputs (Parameters or data/feed vars), with optional seeded cotangents
+    `target_gradients[i]` for each target (None → ones)."""
+    ts = list(targets) if isinstance(targets, (list, tuple)) else [targets]
+    ins = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    program = ts[0].block.program
+    block = program.global_block()
+
+    # resolve strings, keep one result slot per ORIGINAL input; blocked
+    # (no_grad_set) inputs yield None in place
+    ng = {n if isinstance(n, str) else n.name for n in (no_grad_set or ())}
+    resolved = [block.var(v) if isinstance(v, str) else v for v in ins]
+    active = [v for v in resolved if v.name not in ng]
+
+    tgs = None
+    if target_gradients is not None:
+        tgs = list(target_gradients) if isinstance(
+            target_gradients, (list, tuple)) else [target_gradients]
+        tgs += [None] * (len(ts) - len(tgs))
+
+    fwd_op_count = len(block.ops)
+    in_names = [v.name for v in active]
+    grad_by_name = {}
+    for v in active:
+        gname = grad_var_name(v.name)
+        if gname in block.vars:
+            # a previous append_backward/calc_gradient already claimed this
+            # name; each autodiff op must write distinct grad vars
+            gname = unique_name.generate(gname)
+        g = block.create_var(name=gname, shape=v.shape,
+                             dtype=v.dtype, stop_gradient=True)
+        grad_by_name[v.name] = g
+
+    block.append_op(
+        type="jax_autodiff",
+        inputs={"Loss": [ts[0]], "Params": in_names},
+        outputs={"Grads": [grad_by_name[n].name for n in in_names]},
+        attrs={
+            "loss_name": ts[0].name,
+            "loss_names": [t.name for t in ts],
+            "target_grad_names": [
+                (g.name if isinstance(g, Variable) else g) if g is not None
+                else None for g in tgs] if tgs else None,
+            "param_names": in_names,
+            "fwd_op_count": fwd_op_count,
+            "checkpoints": [],
+        })
+    return [grad_by_name.get(v.name) for v in resolved]
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):

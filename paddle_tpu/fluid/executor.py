@@ -137,7 +137,7 @@ class Executor:
             if val is not None:
                 persist_vals[n] = val
 
-        sig = (id(program), program._version,
+        sig = (program._uid, program._version,
                tuple(sorted((k, tuple(v.shape), str(v.dtype))
                             for k, v in feed_vals.items())),
                tuple(sorted((k, tuple(v.shape), str(v.dtype))
@@ -172,54 +172,23 @@ class Executor:
         blk = program.global_block()
         ops = list(blk.ops)
 
-        ad_idx = next((j for j, o in enumerate(ops)
-                       if o.type == "jax_autodiff"), None)
-
         def execute(persist, feed, rng_key):
+            # every op runs eagerly in program order; jax_autodiff lowerings
+            # re-trace their (pruned) forward slice inside value_and_grad
+            # and publish the in-trace values back — XLA CSE/DCE dedupes
+            # the overlap, so the double tracing costs compile time only
             env = dict(persist)
             env.update(feed)
             ctx = lowering.LowerCtx(env, rng_key, training=True,
-                                    program=program)
-            # with an autodiff op, the forward segment runs once INSIDE
-            # value_and_grad (residual-sharing); skip re-running it here
-            start = 0
-            if ad_idx is not None:
-                _run_autodiff(ctx, ops[ad_idx], ops, persist, feed, rng_key)
-                start = ad_idx + 1
-            for op in ops[start:]:
-                if op.type in ("feed", "fetch", "jax_autodiff"):
+                                    program=program,
+                                    base_env={**persist, **feed})
+            for op in ops:
+                if op.type in ("feed", "fetch"):
                     continue
-                lowering.get_lowering(op.type)(ctx, op)
+                lowering.lower_op(ctx, op)
             fetches = tuple(env[n] for n in fetch_names)
             new_persist = {n: env[n] for n in persist_names if n in env}
             return fetches, new_persist
-
-        def _run_autodiff(ctx, op, all_ops, persist, feed, rng_key):
-            param_names = op.attrs["param_names"]
-            loss_name = op.attrs["loss_name"]
-            n_fwd = op.attrs["fwd_op_count"]
-            fwd_ops = all_ops[:n_fwd]
-
-            def loss_fn(param_vals):
-                env2 = dict(persist)
-                env2.update(feed)
-                env2.update(zip(param_names, param_vals))
-                ctx2 = lowering.LowerCtx(env2, rng_key,
-                                         training=ctx.training,
-                                         program=program)
-                for fop in fwd_ops:
-                    if fop.type in ("feed", "fetch"):
-                        continue
-                    lowering.get_lowering(fop.type)(ctx2, fop)
-                loss = env2[loss_name]
-                return loss.sum(), env2
-
-            params = [ctx.env[n] for n in param_names]
-            (loss_val, env_after), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
-            ctx.env.update(env_after)
-            for name, g in zip(param_names, grads):
-                ctx.env[name + "@GRAD"] = g
 
         # donate the persistable dict: optimizer state updates alias buffers
         return jax.jit(execute, donate_argnums=(0,))
@@ -261,7 +230,7 @@ def _lower_block_callable(program, feed_names, fetch_names, scope=None):
         for op in ops:
             if op.type in ("feed", "fetch"):
                 continue
-            lowering.get_lowering(op.type)(ctx, op)
+            lowering.lower_op(ctx, op)
         return tuple(env[n] for n in fetch_names)
 
     return fn, list(feed_names)

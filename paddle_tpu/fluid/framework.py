@@ -12,6 +12,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import copy
+import itertools
 import pickle
 
 import numpy as np
@@ -149,6 +150,30 @@ class Parameter(Variable):
         self.initializer = kw.get("initializer")
 
 
+_PKG_DIR = None
+
+
+def _user_callstack(limit=6):
+    """Trimmed creation traceback for an op, excluding frames inside the
+    framework itself — the user-code attribution the reference records per
+    OpDesc (framework/op_call_stack.cc). Returns FrameSummary objects;
+    formatting (source-line loading) is deferred to the error path."""
+    global _PKG_DIR
+    if _PKG_DIR is None:
+        import os
+
+        _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    import sys
+    import traceback
+
+    f = sys._getframe(2)
+    frames = traceback.StackSummary.extract(
+        traceback.walk_stack(f), limit=32, lookup_lines=False)
+    frames.reverse()  # walk_stack yields innermost-first
+    user = [fr for fr in frames if not fr.filename.startswith(_PKG_DIR)]
+    return list(user[-limit:])
+
+
 class Operator:
     def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
         self.block = block
@@ -165,6 +190,10 @@ class Operator:
             self.outputs[k] = [x.name if isinstance(x, Variable) else x
                                for x in vs]
         self.attrs = dict(attrs or {})
+        if "op_callstack" not in self.attrs:
+            stack = _user_callstack()
+            if stack:
+                self.attrs["op_callstack"] = stack
 
     def input(self, slot):
         return self.inputs.get(slot, [])
@@ -256,15 +285,28 @@ class Block:
 
 
 class Program:
+    _uid_counter = itertools.count()
+
     def __init__(self):
         self.blocks = [Block(self, 0)]
         self.random_seed = 0
+        # monotonic identity for executor caches: id(program) can alias a
+        # GC'd-and-reallocated Program, a uid cannot
+        self._uid = next(Program._uid_counter)
         self._version = 0
         self._seed_counter = 0
         # parity attrs
         self._is_distributed = False
         self._is_startup = False
         self.lr_scheduler = None
+
+    def __deepcopy__(self, memo):
+        p = self.__class__.__new__(self.__class__)
+        memo[id(self)] = p
+        for k, v in self.__dict__.items():
+            setattr(p, k, copy.deepcopy(v, memo))
+        p._uid = next(Program._uid_counter)
+        return p
 
     def _bump(self):
         self._version += 1

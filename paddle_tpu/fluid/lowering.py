@@ -39,15 +39,40 @@ def has_lowering(op_type):
     return op_type in _REGISTRY
 
 
+def lower_op(ctx, op):
+    """Run one op's lowering; on failure, attach the Python creation stack
+    recorded on the OpDesc so errors point at user code, not the tracer
+    (reference: framework/op_call_stack.cc)."""
+    try:
+        fn = get_lowering(op.type)
+        return fn(ctx, op)
+    except Exception as e:
+        stack = op.attrs.get("op_callstack")
+        if stack and hasattr(e, "add_note"):
+            import traceback
+
+            try:
+                note = "".join(traceback.format_list(stack))
+            except Exception:
+                note = "\n".join(str(f) for f in stack)
+            e.add_note("op %r was created at (most recent call last):\n%s"
+                       % (op.type, note))
+        raise
+
+
 class LowerCtx:
     """Execution environment handed to lowerings during block tracing."""
 
-    def __init__(self, env, rng_base, training=True, program=None):
+    def __init__(self, env, rng_base, training=True, program=None,
+                 base_env=None):
         self.env = env          # name -> jnp array
         self._rng_base = rng_base
         self._rng_count = 0
         self.training = training
         self.program = program  # needed by control-flow ops (sub-blocks)
+        # snapshot of env at global-block op 0 (persistables + feeds):
+        # jax_autodiff re-runs its forward segment from here
+        self.base_env = base_env
 
     def inp(self, op, slot, idx=0, default=None):
         names = op.input(slot)
@@ -88,8 +113,93 @@ def trace_block(program, block_idx, env, rng_key, training):
     re-entered per sub-block)."""
     ctx = LowerCtx(env, rng_key, training=training, program=program)
     for op in program.block(block_idx).ops:
-        get_lowering(op.type)(ctx, op)
+        lower_op(ctx, op)
     return env
+
+
+@register("jax_autodiff")
+def _lower_jax_autodiff(ctx, op):
+    """Static autodiff as ONE op (fluid/backward.py design note): re-run the
+    slice of the forward segment (global-block ops[:fwd_op_count]) that the
+    targets actually depend on under jax.value_and_grad, write the op's
+    declared Grads vars. Registered like any lowering so several autodiff
+    ops (minimize + calc_gradient) compose in one program.
+
+    The segment is pruned by a backward slice from the targets that STOPS
+    at the requested params: ops upstream of a param only matter through
+    the param value, which is injected. This (a) supports grads w.r.t.
+    intermediate vars (their producers are excluded; the eagerly computed
+    value from ctx.env is the diff point), and (b) keeps earlier autodiff
+    / optimizer ops out of the trace, avoiding nested re-differentiation."""
+    import jax
+
+    program = _require_program(ctx, op)
+    blk = program.global_block()
+    param_names = op.attrs["param_names"]
+    loss_names = op.attrs.get("loss_names") or [op.attrs["loss_name"]]
+    tg_names = op.attrs.get("target_grad_names") or [None] * len(loss_names)
+    n_fwd = op.attrs["fwd_op_count"]
+    fwd_ops = blk.ops[:n_fwd]
+    base = ctx.base_env if ctx.base_env is not None else ctx.env
+
+    # backward slice from targets, stopping at params
+    pset = set(param_names)
+    need = set(loss_names) | {g for g in tg_names if g is not None}
+    keep = [False] * len(fwd_ops)
+    for i in range(len(fwd_ops) - 1, -1, -1):
+        fop = fwd_ops[i]
+        if fop.type in ("feed", "fetch"):
+            continue
+        if need & set(fop.output_arg_names):
+            keep[i] = True
+            need |= set(fop.input_arg_names) - pset
+    traced = [fop for i, fop in enumerate(fwd_ops) if keep[i]]
+    # values produced by excluded ops that traced ops read come in as
+    # stop-gradient constants from the eager env
+    excluded_out = set()
+    for i, fop in enumerate(fwd_ops):
+        if not keep[i]:
+            excluded_out.update(fop.output_arg_names)
+
+    def loss_fn(param_vals):
+        env2 = dict(base)
+        env2.update({n: jax.lax.stop_gradient(ctx.env[n])
+                     for n in excluded_out if n in ctx.env})
+        env2.update(zip(param_names, param_vals))
+        ctx2 = LowerCtx(env2, ctx._rng_base, training=ctx.training,
+                        program=program, base_env=dict(base))
+        for fop in traced:
+            lower_op(ctx2, fop)
+        # seeded cotangents: sum_t <t, stop_grad(tg_t)> makes value_and_grad
+        # produce the vjp with those seeds
+        total = None
+        for tname, gname in zip(loss_names, tg_names):
+            tv = env2[tname]
+            if gname is not None:
+                term = (tv * jax.lax.stop_gradient(env2[gname])).sum()
+            else:
+                term = tv.sum()
+            total = term if total is None else total + term
+        return total, env2
+
+    params = [ctx.env[n] for n in param_names]
+    (_, env_after), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(params)
+    # adopt the in-grad-trace forward values so downstream ops (optimizer,
+    # fetches) see activations consistent with the grads (e.g. dropout
+    # masks) — but ONLY names the traced slice writes: clobbering
+    # un-written names (feeds, outer-trace params) would disconnect them
+    # from an enclosing autodiff trace
+    written = set()
+    for fop in traced:
+        written.update(fop.output_arg_names)
+    ctx.env.update({k: v for k, v in env_after.items()
+                    if k in written and k not in pset})
+    grad_outs = op.output("Grads")
+    if not grad_outs:
+        grad_outs = [n + "@GRAD" for n in param_names]
+    for name, g in zip(grad_outs, grads):
+        ctx.env[name] = g
 
 
 def _require_program(ctx, op):

@@ -1,0 +1,176 @@
+"""paddle.grad / calc_gradient semantics + error attribution.
+
+Reference parity: imperative/partial_grad_engine.cc:29 (paddle.grad),
+fluid/backward.py:1665 (calc_gradient target_gradients), and
+framework/op_call_stack.cc (op creation traceback in errors).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+
+def test_grad_intermediate_input():
+    x = paddle.to_tensor(np.array([1., 2., 3.], np.float32),
+                         stop_gradient=False)
+    y = x * x
+    z = (y * 3.0).sum()
+    gy, gx = paddle.grad(z, [y, x])
+    assert np.allclose(np.asarray(gy._data), 3.0)
+    assert np.allclose(np.asarray(gx._data), 6.0 * np.array([1., 2., 3.]))
+    # .grad of every tensor stays untouched
+    assert x._grad is None and y._grad is None
+
+
+def test_grad_outputs_seeding():
+    w = paddle.to_tensor(np.array([1., 2.], np.float32), stop_gradient=False)
+    out = w * 2.0
+    (g,) = paddle.grad([out], [w],
+                       grad_outputs=[np.array([10., 20.], np.float32)])
+    assert np.allclose(np.asarray(g._data), [20., 40.])
+
+
+def test_grad_multiple_outputs_single_pass():
+    x = paddle.to_tensor(np.array([2.], np.float32), stop_gradient=False)
+    a = x * 3.0
+    b = x * x
+    (g,) = paddle.grad([a, b], [x])
+    assert np.allclose(np.asarray(g._data), 3.0 + 2.0 * 2.0)
+
+
+def test_grad_allow_unused():
+    x = paddle.to_tensor(np.array([1.], np.float32), stop_gradient=False)
+    y = paddle.to_tensor(np.array([1.], np.float32), stop_gradient=False)
+    out = x * 2.0
+    with pytest.raises(RuntimeError):
+        paddle.grad(out, [y])
+    gx, gy = paddle.grad(x * 2.0, [x, y], allow_unused=True)
+    assert gy is None and np.allclose(np.asarray(gx._data), 2.0)
+
+
+def test_calc_gradient_target_gradients():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.data(name="a", shape=[3], dtype="float32")
+        b = a * a
+        gs = fluid.backward.calc_gradient(b, [a], target_gradients=[a])
+    exe = fluid.Executor()
+    av = np.array([1., 2., 3.], np.float32)
+    (ga,) = exe.run(main, feed={"a": av}, fetch_list=[gs[0]])
+    # d/da sum(a^2 * stop_grad(a)) = 2 a * a
+    assert np.allclose(ga, 2 * av * av)
+
+
+def test_calc_gradient_wrt_data_var():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.data(name="a", shape=[2], dtype="float32")
+        b = (a * 3.0) + 1.0
+        gs = fluid.backward.calc_gradient(b, [a])
+    exe = fluid.Executor()
+    (ga,) = exe.run(main, feed={"a": np.ones(2, np.float32)},
+                    fetch_list=[gs[0]])
+    assert np.allclose(ga, 3.0)
+
+
+def test_grad_duplicate_inputs():
+    x = paddle.to_tensor(np.array([2.], np.float32), stop_gradient=False)
+    z = (x * x).sum()
+    g1, g2 = paddle.grad(z, [x, x])
+    assert np.allclose(np.asarray(g1._data), 4.0)
+    assert np.allclose(np.asarray(g2._data), 4.0)
+
+
+def test_two_autodiff_ops_in_one_program():
+    # minimize() + a later calc_gradient must BOTH execute
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.data(name="a", shape=[2], dtype="float32")
+        w = fluid.layers.create_parameter([2], "float32", name="w2x")
+        loss = fluid.layers.mean(a * w)
+        fluid.optimizer.SGD(0.0).minimize(loss)
+        b = a * a
+        (gb,) = fluid.backward.calc_gradient(b, [a])
+    exe = fluid.Executor()
+    exe.run(startup)
+    av = np.array([1., 3.], np.float32)
+    ga, gw = exe.run(main, feed={"a": av},
+                     fetch_list=[gb, "w2x@GRAD"])
+    assert np.allclose(ga, 2 * av)
+    assert gw.shape == (2,)
+
+
+def test_calc_gradient_no_grad_set_alignment():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.data(name="a", shape=[2], dtype="float32")
+        b = fluid.data(name="b", shape=[2], dtype="float32")
+        out = a * 2.0 + b * 3.0
+        gs = fluid.backward.calc_gradient(out, [a, b], no_grad_set={"a"})
+    assert len(gs) == 2 and gs[0] is None
+    exe = fluid.Executor()
+    one = np.ones(2, np.float32)
+    (gbv,) = exe.run(main, feed={"a": one, "b": one}, fetch_list=[gs[1]])
+    assert np.allclose(gbv, 3.0)
+
+
+def test_calc_gradient_string_inputs():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.data(name="astr", shape=[2], dtype="float32")
+        out = a * 5.0
+        gs = fluid.backward.calc_gradient(out, ["astr"])
+    exe = fluid.Executor()
+    (ga,) = exe.run(main, feed={"astr": np.ones(2, np.float32)},
+                    fetch_list=[gs[0]])
+    assert np.allclose(ga, 5.0)
+
+
+def test_calc_gradient_no_grad_var_collision():
+    # two calc_gradient calls w.r.t. the same input must not share grad vars
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.data(name="a", shape=[2], dtype="float32")
+        out1 = a * a
+        out2 = a * a * a
+        (g1,) = fluid.backward.calc_gradient(out1, [a])
+        (g2,) = fluid.backward.calc_gradient(out2, [a])
+    assert g1.name != g2.name
+    exe = fluid.Executor()
+    av = np.array([1., 2.], np.float32)
+    v1, v2 = exe.run(main, feed={"a": av}, fetch_list=[g1, g2])
+    assert np.allclose(v1, 2 * av)
+    assert np.allclose(v2, 3 * av * av)
+
+
+def test_calc_gradient_wrt_intermediate():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.data(name="a", shape=[2], dtype="float32")
+        b = a * 3.0
+        out = b * b
+        (gb,) = fluid.backward.calc_gradient(out, [b])
+    exe = fluid.Executor()
+    av = np.array([1., 2.], np.float32)
+    (gbv,) = exe.run(main, feed={"a": av}, fetch_list=[gb])
+    assert np.allclose(gbv, 2 * 3.0 * av)  # d(b^2)/db = 2b = 6a
+
+
+def test_program_uid_distinct_after_clone():
+    p = fluid.Program()
+    q = p.clone()
+    assert p._uid != q._uid
+
+
+def test_lowering_error_carries_op_callstack():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        v = blk.create_var(name="zz", shape=[1], dtype="float32")
+        blk.append_op(type="totally_bogus_op", inputs={}, outputs={"Out": [v]})
+    exe = fluid.Executor()
+    with pytest.raises(NotImplementedError) as ei:
+        exe.run(main, feed={}, fetch_list=["zz"])
+    notes = "".join(getattr(ei.value, "__notes__", []))
+    assert "test_grad_api.py" in notes
