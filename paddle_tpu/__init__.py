@@ -84,7 +84,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     if grad_outputs is not None:
         gos = list(grad_outputs) if isinstance(
             grad_outputs, (list, tuple)) else [grad_outputs]
-        gos += [None] * (len(outs) - len(gos))
+        if len(gos) != len(outs):
+            raise ValueError(
+                f"the length of grad_outputs ({len(gos)}) must equal the "
+                f"length of outputs ({len(outs)})")
     retain = bool(retain_graph) if retain_graph is not None else False
     return _ag.partial_grad(outs, list(ins), gos, retain_graph=retain,
                             allow_unused=allow_unused)

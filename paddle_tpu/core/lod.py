@@ -1,0 +1,152 @@
+"""LoDTensor: host-side ragged-sequence metadata over dense storage.
+
+Reference parity: paddle/fluid/framework/lod_tensor.h:104 — a tensor whose
+rows are partitioned into variable-length sequences by level-of-detail
+offset tables. TPU-native design (SURVEY.md §7 hard part 1): LoD lives at
+the EDGES only. Device compute always sees a dense padded [batch, max_len,
+...] array plus an int32 lengths vector [batch]; the packed [total, ...] +
+offsets form exists host-side for feeding/fetching and API parity. The
+canonicalization (pack <-> pad) happens in the Executor feed/fetch path,
+never inside jitted code — XLA requires static shapes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _offsets_from_lengths(lengths):
+    out = [0]
+    for n in lengths:
+        out.append(out[-1] + int(n))
+    return out
+
+
+def _lengths_from_offsets(offsets):
+    return [int(offsets[i + 1] - offsets[i]) for i in range(len(offsets) - 1)]
+
+
+class LoDTensor:
+    """Packed rows + lod offset tables (host side only).
+
+    `data` is [total_rows, ...]; `lod` is a list of offset tables, each a
+    monotone list starting at 0 (lod_tensor.h LoD = vector<vector<size_t>>).
+    Level -1 (the last) partitions rows of `data`; earlier levels partition
+    the level below them.
+    """
+
+    def __init__(self, data=None, lod=None):
+        self._data = np.asarray(data) if data is not None else None
+        self._lod = [list(map(int, l)) for l in (lod or [])]
+
+    # ---- tensor protocol ----
+    def __array__(self, dtype=None):
+        arr = self._data
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def set(self, value, place=None):
+        self._data = np.asarray(value)
+
+    def shape(self):
+        return list(self._data.shape)
+
+    # ---- lod protocol (pybind tensor parity) ----
+    def lod(self):
+        return [list(l) for l in self._lod]
+
+    def set_lod(self, lod):
+        self._lod = [list(map(int, l)) for l in lod]
+
+    def recursive_sequence_lengths(self):
+        return [_lengths_from_offsets(l) for l in self._lod]
+
+    def set_recursive_sequence_lengths(self, seq_lens):
+        self._lod = [_offsets_from_lengths(l) for l in seq_lens]
+
+    def has_valid_recursive_sequence_lengths(self):
+        if not self._lod:
+            return True
+        for level, nxt in zip(self._lod, self._lod[1:]):
+            if level[-1] != len(nxt) - 1:
+                return False
+        return self._lod[-1][-1] == len(self._data)
+
+    @property
+    def lod_level(self):
+        return len(self._lod)
+
+    def __repr__(self):
+        return (f"LoDTensor(shape={list(self._data.shape)}, "
+                f"lod={self._lod})")
+
+    # ---- canonicalization: pack <-> pad ----
+    def sequence_lengths(self):
+        """Row lengths at the LAST lod level (the one partitioning data)."""
+        if not self._lod:
+            return [len(self._data)]
+        return _lengths_from_offsets(self._lod[-1])
+
+    def to_padded(self, max_len=None, pad_value=0):
+        """(padded [B, T, ...], lengths [B] int32). Flattens nested lod to
+        the last level — device compute sees one ragged axis; outer nesting
+        is re-attached at fetch from host metadata."""
+        lens = self.sequence_lengths()
+        T = int(max_len or (max(lens) if lens else 0)) or 1
+        B = len(lens)
+        tail = self._data.shape[1:]
+        out = np.full((B, T) + tail, pad_value, dtype=self._data.dtype)
+        offs = self._lod[-1] if self._lod else [0, len(self._data)]
+        for b, n in enumerate(lens):
+            out[b, :n] = self._data[offs[b]:offs[b] + n]
+        return out, np.asarray(lens, dtype=np.int32)
+
+    @staticmethod
+    def from_padded(padded, lengths, outer_lod=None):
+        """Inverse of to_padded: re-pack valid prefixes into [total, ...]."""
+        padded = np.asarray(padded)
+        lengths = [int(x) for x in np.asarray(lengths).reshape(-1)]
+        rows = [padded[b, :n] for b, n in enumerate(lengths)]
+        data = (np.concatenate(rows, axis=0) if rows else
+                np.zeros((0,) + padded.shape[2:], dtype=padded.dtype))
+        lod = list(outer_lod or []) + [_offsets_from_lengths(lengths)]
+        return LoDTensor(data, lod)
+
+    @staticmethod
+    def from_sequences(seqs, dtype=None):
+        """Build from a list of per-example arrays (level-1 lod)."""
+        arrs = [np.asarray(s, dtype=dtype) for s in seqs]
+        lens = [len(a) for a in arrs]
+        data = (np.concatenate(arrs, axis=0) if arrs else
+                np.zeros((0,), dtype=dtype or np.float32))
+        return LoDTensor(data, [_offsets_from_lengths(lens)])
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """fluid.create_lod_tensor parity (fluid/lod_tensor.py): data is a numpy
+    array / list-of-lists / LoDTensor, recursive_seq_lens a list of
+    length-lists per level."""
+    if isinstance(data, LoDTensor):
+        t = LoDTensor(np.asarray(data), None)
+    elif isinstance(data, list):
+        flat = [np.asarray(row).reshape(-1, 1) for row in data]
+        exp = [len(r) for r in flat]
+        if recursive_seq_lens and exp != list(recursive_seq_lens[-1]):
+            raise ValueError("data row lengths do not match seq_lens")
+        t = LoDTensor(np.concatenate(flat, axis=0) if flat else
+                      np.zeros((0, 1)), None)
+    else:
+        t = LoDTensor(np.asarray(data), None)
+    t.set_recursive_sequence_lengths(recursive_seq_lens)
+    if not t.has_valid_recursive_sequence_lengths():
+        raise ValueError(
+            f"invalid recursive_seq_lens {recursive_seq_lens} for data with "
+            f"{len(np.asarray(t))} rows")
+    return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place, low,
+                                high):
+    """fluid.create_random_int_lodtensor parity — used by book tests."""
+    total = sum(recursive_seq_lens[-1])
+    data = np.random.randint(low, high + 1,
+                             size=[total] + list(base_shape)).astype("int64")
+    return create_lod_tensor(data, recursive_seq_lens, place)

@@ -1,6 +1,8 @@
 """fluid 1.x namespace (reference: python/paddle/fluid/__init__.py)."""
 from ..core.place import (CPUPlace, CUDAPinnedPlace, CUDAPlace,  # noqa
                           TPUPlace, XPUPlace)
+from ..core.lod import (LoDTensor, create_lod_tensor,  # noqa: F401
+                        create_random_int_lodtensor)
 from ..core.tensor import Tensor
 from . import initializer, io, layers, optimizer  # noqa: F401
 from .backward import append_backward, calc_gradient, gradients  # noqa

@@ -96,16 +96,24 @@ def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
                              dtype=v.dtype, stop_gradient=True)
         grad_by_name[v.name] = g
 
+    # "" is the no-seed sentinel ("" is serializable where None in a str
+    # list is not); the lowering treats falsy names as ones-seeding
+    tg_names = [""] * len(ts)
+    if tgs:
+        tg_names = [(g.name if isinstance(g, Variable) else g)
+                    if g is not None else "" for g in tgs]
+    # ALL targets and seed vars must appear as op inputs so Program._prune
+    # and save_inference_model keep their producers alive
     block.append_op(
         type="jax_autodiff",
-        inputs={"Loss": [ts[0]], "Params": in_names},
+        inputs={"Loss": [ts[0]], "Targets": [t.name for t in ts],
+                "TargetGrads": [n for n in tg_names if n],
+                "Params": in_names},
         outputs={"Grads": [grad_by_name[n].name for n in in_names]},
         attrs={
             "loss_name": ts[0].name,
             "loss_names": [t.name for t in ts],
-            "target_grad_names": [
-                (g.name if isinstance(g, Variable) else g) if g is not None
-                else None for g in tgs] if tgs else None,
+            "target_grad_names": tg_names,
             "param_names": in_names,
             "fwd_op_count": fwd_op_count,
             "checkpoints": [],

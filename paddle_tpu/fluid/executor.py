@@ -119,10 +119,21 @@ class Executor:
         import jax
         import jax.numpy as jnp
 
+        from ..core.lod import LoDTensor
+
         feed_vals = {}
         for k, v in feed.items():
             if isinstance(v, Tensor):
                 feed_vals[k] = v._data
+            elif isinstance(v, LoDTensor) and v.lod_level > 0:
+                # pad+mask canonicalization at the edge (SURVEY §7.1):
+                # device sees [B, T, ...] + int32 lengths companion
+                padded, lens = v.to_padded()
+                want = blk.vars.get(k)
+                if want is not None and want.dtype is not None:
+                    padded = padded.astype(want.dtype)
+                feed_vals[k] = jnp.asarray(padded)
+                feed_vals[k + "@@LOD"] = jnp.asarray(lens)
             else:
                 arr = np.asarray(v)
                 want = blk.vars.get(k)
@@ -153,13 +164,26 @@ class Executor:
         program._seed_counter += 1
         key = jax.random.PRNGKey(
             (program.random_seed or 0) * 100003 + program._seed_counter)
-        fetches, new_persist = compiled(persist_vals, feed_vals, key)
+        fetches, fetch_lods, new_persist = compiled(persist_vals, feed_vals,
+                                                    key)
 
         scope._values.update(new_persist)
 
         out = []
         for name, v in zip(fetch_names, fetches):
-            if return_numpy:
+            lens = fetch_lods.get(name + "@@LOD")
+            if lens is not None:
+                if return_numpy:
+                    # reference parity (executor.py as_numpy): padded rows
+                    # past each sequence's length are garbage — force the
+                    # caller to take the LoDTensor instead of wrong data
+                    raise RuntimeError(
+                        f"fetch var {name!r} is a sequence (LoD) tensor; "
+                        f"pass return_numpy=False and use the returned "
+                        f"LoDTensor's recursive_sequence_lengths()")
+                out.append(LoDTensor.from_padded(np.asarray(v),
+                                                 np.asarray(lens)))
+            elif return_numpy:
                 out.append(np.asarray(v))
             else:
                 out.append(Tensor._wrap(v))
@@ -187,8 +211,12 @@ class Executor:
                     continue
                 lowering.lower_op(ctx, op)
             fetches = tuple(env[n] for n in fetch_names)
+            # sequence-typed fetches carry their lengths companion out so
+            # the host can re-pack a LoDTensor (core/lod.py)
+            fetch_lods = {n + "@@LOD": env[n + "@@LOD"]
+                          for n in fetch_names if n + "@@LOD" in env}
             new_persist = {n: env[n] for n in persist_names if n in env}
-            return fetches, new_persist
+            return fetches, fetch_lods, new_persist
 
         # donate the persistable dict: optimizer state updates alias buffers
         return jax.jit(execute, donate_argnums=(0,))

@@ -175,9 +175,15 @@ def _user_callstack(limit=6):
 
 
 class Operator:
+    _uid_counter = itertools.count(1)
+
     def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
         self.block = block
         self.type = type
+        # stable identity for PRNG key derivation: lowering folds this (not
+        # a trace-order counter) into the rng stream, so a pruned re-trace
+        # (jax_autodiff) reproduces the exact masks of the eager pass
+        self._uid = next(Operator._uid_counter)
         # canonical form: {slot: [var names]}
         self.inputs = {}
         for k, v in (inputs or {}).items():
@@ -381,6 +387,11 @@ class Program:
         p = copy.deepcopy(self)
         nb = p.global_block()
         nb.ops = [op for i, op in enumerate(nb.ops) if keep[i]]
+        # jax_autodiff's forward segment is "every op before me": its
+        # fwd_op_count was its own append-time index, stale after pruning
+        for i, op in enumerate(nb.ops):
+            if op.type == "jax_autodiff":
+                op.attrs["fwd_op_count"] = min(op.attrs["fwd_op_count"], i)
         return p
 
     # --------- serialization (pickle-based; stable across processes) ------

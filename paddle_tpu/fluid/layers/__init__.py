@@ -1,5 +1,6 @@
 from .control_flow import *  # noqa: F401,F403
 from .math_ops import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
+from .sequence_lod import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .tensor import data  # noqa: F401

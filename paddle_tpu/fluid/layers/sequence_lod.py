@@ -1,0 +1,227 @@
+"""Sequence-op sugar for the fluid static API.
+
+Reference parity: python/paddle/fluid/layers/sequence_lod.py. Each function
+appends the corresponding sequence op; the executor's pad+mask canonical
+form means a lod_level>0 Variable is fed as a host LoDTensor and travels
+through XLA as (padded, lengths) — see fluid/lowering_seq.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.dtypes import convert_dtype
+from ..layer_helper import LayerHelper
+
+
+def _seq_out(helper, x, shape=None, lod_level=None):
+    out = helper.create_variable_for_type_inference(
+        x.dtype, shape or x.shape)
+    out.lod_level = x.lod_level if lod_level is None else lod_level
+    return out
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):
+    helper = LayerHelper("sequence_pool")
+    out = _seq_out(helper, input, lod_level=0)
+    helper.append_op(type="sequence_pool", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pooltype": pool_type.upper(),
+                            "pad_value": pad_value})
+    return out
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    out = _seq_out(helper, input)
+    helper.append_op(type="sequence_softmax", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    out = _seq_out(helper, x, lod_level=max(x.lod_level, 1))
+    helper.append_op(type="sequence_expand", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"ref_level": ref_level})
+    return out
+
+
+def sequence_expand_as(x, y, name=None):
+    helper = LayerHelper("sequence_expand_as", name=name)
+    out = _seq_out(helper, x, lod_level=max(y.lod_level, 1))
+    helper.append_op(type="sequence_expand_as", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    helper = LayerHelper("sequence_conv", name=name)
+    d = input.shape[-1]
+    filter_shape = [filter_size * d, num_filters]
+    w = helper.create_parameter(param_attr, filter_shape, input.dtype)
+    out = _seq_out(helper, input,
+                   shape=list(input.shape[:-1]) + [num_filters])
+    helper.append_op(
+        type="sequence_conv",
+        inputs={"X": [input], "Filter": [w]},
+        outputs={"Out": [out]},
+        attrs={"contextLength": filter_size, "contextStride": filter_stride,
+               "contextStart": padding_start})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        # padded runtime rank is IR rank + 1 (time axis): align bias at the
+        # LAST axis, which is correct in both views
+        out = helper.append_bias_op(out, b, -1)
+    return helper.append_activation(out, act)
+
+
+def sequence_reverse(x, name=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    out = _seq_out(helper, x)
+    helper.append_op(type="sequence_reverse", inputs={"X": [x]},
+                     outputs={"Y": [out]}, attrs={})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", name=name)
+    out = _seq_out(helper, input)
+    helper.append_op(type="sequence_slice",
+                     inputs={"X": [input], "Offset": [offset],
+                             "Length": [length]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat", name=name)
+    out = _seq_out(helper, input[0])
+    helper.append_op(type="sequence_concat", inputs={"X": list(input)},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    helper = LayerHelper("sequence_reshape")
+    out = _seq_out(helper, input,
+                   shape=list(input.shape[:-1]) + [new_dim])
+    helper.append_op(type="sequence_reshape", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"new_dim": new_dim})
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    helper = LayerHelper("sequence_enumerate", name=name)
+    out = _seq_out(helper, input,
+                   shape=list(input.shape) + [win_size])
+    helper.append_op(type="sequence_enumerate", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"win_size": win_size, "pad_value": pad_value})
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    helper = LayerHelper("sequence_pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    length = helper.create_variable_for_type_inference(np.int32,
+                                                       [x.shape[0] or -1])
+    helper.append_op(type="sequence_pad",
+                     inputs={"X": [x], "PadValue": [pad_value]},
+                     outputs={"Out": [out], "Length": [length]},
+                     attrs={"padded_length": maxlen if maxlen else -1})
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    helper = LayerHelper("sequence_unpad", name=name)
+    out = _seq_out(helper, x, lod_level=1)
+    helper.append_op(type="sequence_unpad",
+                     inputs={"X": [x], "Length": [length]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def sequence_scatter(input, index, updates, name=None):
+    helper = LayerHelper("sequence_scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op(type="sequence_scatter",
+                     inputs={"X": [input], "Ids": [index],
+                             "Updates": [updates]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(
+        convert_dtype(dtype), list(x.shape) + [maxlen or -1])
+    helper.append_op(type="sequence_mask", inputs={"X": [x]},
+                     outputs={"Y": [out]},
+                     attrs={"maxlen": maxlen if maxlen else -1,
+                            "out_dtype": dtype})
+    return out
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """fluid.layers.dynamic_lstm parity (rnn.py): input is the fc-projected
+    [.., 4*hidden] sequence; size = 4*hidden."""
+    helper = LayerHelper("dynamic_lstm", name=name)
+    hidden = size // 4
+    w = helper.create_parameter(param_attr, [hidden, 4 * hidden], dtype)
+    bias_size = [1, 7 * hidden if use_peepholes else 4 * hidden]
+    b = helper.create_parameter(bias_attr, bias_size, dtype, is_bias=True)
+    hid = _seq_out(helper, input,
+                   shape=list(input.shape[:-1]) + [hidden])
+    cell = _seq_out(helper, input,
+                    shape=list(input.shape[:-1]) + [hidden])
+    inputs = {"Input": [input], "Weight": [w], "Bias": [b]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
+    helper.append_op(
+        type="dynamic_lstm", inputs=inputs,
+        outputs={"Hidden": [hid], "Cell": [cell]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation})
+    return hid, cell
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, origin_mode=False,
+                name=None):
+    """fluid.layers.dynamic_gru parity: input is fc-projected [.., 3*size]."""
+    helper = LayerHelper("dynamic_gru", name=name)
+    dtype = input.dtype
+    w = helper.create_parameter(param_attr, [size, 3 * size], dtype)
+    b = helper.create_parameter(bias_attr, [1, 3 * size], dtype,
+                                is_bias=True)
+    hid = _seq_out(helper, input, shape=list(input.shape[:-1]) + [size])
+    inputs = {"Input": [input], "Weight": [w], "Bias": [b]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    helper.append_op(
+        type="dynamic_gru", inputs=inputs, outputs={"Hidden": [hid]},
+        attrs={"is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "candidate_activation": candidate_activation,
+               "origin_mode": origin_mode})
+    return hid

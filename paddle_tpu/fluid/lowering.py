@@ -39,13 +39,44 @@ def has_lowering(op_type):
     return op_type in _REGISTRY
 
 
+_LOD_SUFFIX = "@@LOD"
+
+
+def _propagate_lod(ctx, op):
+    """Row-wise ops keep their input's ragged structure: if an input var has
+    a lengths companion in the env and an output of matching [B, T] leading
+    shape has none, inherit it. This is the pad+mask analogue of the
+    reference's InferVarType lod propagation (ShareLoD in op InferShape)."""
+    src = None
+    for n in op.input_arg_names:
+        ln = ctx.env.get(n + _LOD_SUFFIX)
+        if ln is not None:
+            x = ctx.env.get(n)
+            if hasattr(x, "shape") and len(getattr(x, "shape", ())) >= 2:
+                src = (x.shape[:2], ln)
+                break
+    if src is None:
+        return
+    lead, ln = src
+    for n in op.output_arg_names:
+        if n + _LOD_SUFFIX in ctx.env:
+            continue
+        y = ctx.env.get(n)
+        if hasattr(y, "shape") and len(getattr(y, "shape", ())) >= 2 \
+                and tuple(y.shape[:2]) == tuple(lead):
+            ctx.env[n + _LOD_SUFFIX] = ln
+
+
 def lower_op(ctx, op):
     """Run one op's lowering; on failure, attach the Python creation stack
     recorded on the OpDesc so errors point at user code, not the tracer
     (reference: framework/op_call_stack.cc)."""
     try:
         fn = get_lowering(op.type)
-        return fn(ctx, op)
+        ctx.begin_op(op)
+        out = fn(ctx, op)
+        _propagate_lod(ctx, op)
+        return out
     except Exception as e:
         stack = op.attrs.get("op_callstack")
         if stack and hasattr(e, "add_note"):
@@ -68,6 +99,7 @@ class LowerCtx:
         self.env = env          # name -> jnp array
         self._rng_base = rng_base
         self._rng_count = 0
+        self._cur_op_uid = 0
         self.training = training
         self.program = program  # needed by control-flow ops (sub-blocks)
         # snapshot of env at global-block op 0 (persistables + feeds):
@@ -92,11 +124,20 @@ class LowerCtx:
         for n, v in zip(op.output(slot), values):
             self.env[n] = v
 
+    def begin_op(self, op):
+        self._cur_op_uid = getattr(op, "_uid", 0)
+        self._rng_count = 0
+
     def next_key(self):
         import jax
 
         self._rng_count += 1
-        return jax.random.fold_in(self._rng_base, self._rng_count)
+        # keyed by the op's stable uid, not trace order: a pruned re-trace
+        # (jax_autodiff backward slice) must reproduce the eager pass's
+        # dropout/random draws exactly even when earlier rng ops are pruned
+        return jax.random.fold_in(
+            jax.random.fold_in(self._rng_base, self._cur_op_uid),
+            self._rng_count)
 
 
 def _jnp():
@@ -138,6 +179,7 @@ def _lower_jax_autodiff(ctx, op):
     param_names = op.attrs["param_names"]
     loss_names = op.attrs.get("loss_names") or [op.attrs["loss_name"]]
     tg_names = op.attrs.get("target_grad_names") or [None] * len(loss_names)
+    tg_names = [g or None for g in tg_names]  # "" sentinel -> no seed
     n_fwd = op.attrs["fwd_op_count"]
     fwd_ops = blk.ops[:n_fwd]
     base = ctx.base_env if ctx.base_env is not None else ctx.env
@@ -218,6 +260,12 @@ def _ew(fn):
         y = ctx.inp(op, "Y")
         axis = op.attrs.get("axis", -1)
         if axis != -1 and y.ndim < x.ndim:
+            # sequence X: IR axis counts packed dims; runtime is padded
+            # [B, T, ...] with one extra axis, so shift alignment right
+            if axis >= 1 and op.input("X") and \
+                    op.input("X")[0] + _LOD_SUFFIX in ctx.env and \
+                    axis + y.ndim < x.ndim:
+                axis += 1
             # paddle broadcast: align y's dims starting at `axis`
             shape = [1] * x.ndim
             for i, s in enumerate(y.shape):
@@ -344,8 +392,13 @@ def _matmul(ctx, op):
 
 @register("mul")
 def _mul(ctx, op):
-    ctx.out(op, "Out", K.mul_op(ctx.inp(op, "X"), ctx.inp(op, "Y"),
-                                op.attrs.get("x_num_col_dims", 1),
+    x = ctx.inp(op, "X")
+    xcols = op.attrs.get("x_num_col_dims", 1)
+    # sequence input: IR num_col_dims counts packed dims [total, d...]; the
+    # runtime array is padded [B, T, d...] (one extra axis), so shift by 1
+    if op.input("X") and op.input("X")[0] + _LOD_SUFFIX in ctx.env:
+        xcols += 1
+    ctx.out(op, "Out", K.mul_op(x, ctx.inp(op, "Y"), xcols,
                                 op.attrs.get("y_num_col_dims", 1)))
 
 
@@ -1104,3 +1157,7 @@ def _increment(ctx, op):
 @register("seq_pool_placeholder")
 def _noop(ctx, op):
     pass
+
+
+# sequence-op lowerings register themselves into this registry on import
+from . import lowering_seq  # noqa: E402,F401

@@ -1,0 +1,238 @@
+"""Static lowerings for LoD sequence ops (operators/sequence_ops/).
+
+Canonical form inside the XLA program: a sequence-typed var X is TWO env
+entries — `X` (padded [B, T, ...]) and `X@@LOD` (int32 lengths [B]). The
+Executor's feed path writes both from a host LoDTensor; the fetch path
+re-packs them (core/lod.py). Ops that produce sequences write both names;
+ops that consume them read the companion via `ctx.env.get(name + LOD_SUFFIX)`.
+A missing companion means "dense": full-length rows.
+"""
+from __future__ import annotations
+
+from ..ops import sequence as S
+from .lowering import register
+
+LOD_SUFFIX = "@@LOD"
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _lens(ctx, op, slot, idx=0):
+    names = op.input(slot)
+    if not names:
+        return None
+    return ctx.env.get(names[idx] + LOD_SUFFIX)
+
+
+def _lens_or_full(ctx, op, slot, x):
+    ln = _lens(ctx, op, slot)
+    if ln is None:
+        jnp = _jnp()
+        ln = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    return ln
+
+
+def _out_seq(ctx, op, slot, value, lengths, idx=0):
+    names = op.output(slot)
+    if names:
+        ctx.env[names[idx]] = value
+        ctx.env[names[idx] + LOD_SUFFIX] = lengths
+
+
+@register("sequence_pool")
+def _seq_pool(ctx, op):
+    x = ctx.inp(op, "X")
+    lens = _lens_or_full(ctx, op, "X", x)
+    ctx.out(op, "Out", S.sequence_pool(x, lens,
+                                       op.attrs.get("pooltype", "SUM"),
+                                       op.attrs.get("pad_value", 0.0)))
+
+
+@register("sequence_softmax")
+def _seq_softmax(ctx, op):
+    x = ctx.inp(op, "X")
+    lens = _lens_or_full(ctx, op, "X", x)
+    _out_seq(ctx, op, "Out", S.sequence_softmax(x, lens), lens)
+
+
+@register("sequence_expand")
+def _seq_expand(ctx, op):
+    x = ctx.inp(op, "X")
+    y = ctx.inp(op, "Y")
+    y_lens = _lens_or_full(ctx, op, "Y", y)
+    _out_seq(ctx, op, "Out", S.sequence_expand_as(x, y, y_lens), y_lens)
+
+
+@register("sequence_expand_as")
+def _seq_expand_as(ctx, op):
+    x = ctx.inp(op, "X")
+    y = ctx.inp(op, "Y")
+    y_lens = _lens_or_full(ctx, op, "Y", y)
+    _out_seq(ctx, op, "Out", S.sequence_expand_as(x, y, y_lens), y_lens)
+
+
+@register("sequence_conv")
+def _seq_conv(ctx, op):
+    x = ctx.inp(op, "X")
+    filt = ctx.inp(op, "Filter")
+    lens = _lens_or_full(ctx, op, "X", x)
+    out = S.sequence_conv(x, lens, filt,
+                          op.attrs.get("contextLength", 3),
+                          op.attrs.get("contextStart", None))
+    _out_seq(ctx, op, "Out", out, lens)
+
+
+@register("sequence_reverse")
+def _seq_reverse(ctx, op):
+    x = ctx.inp(op, "X")
+    lens = _lens_or_full(ctx, op, "X", x)
+    _out_seq(ctx, op, "Y", S.sequence_reverse(x, lens), lens)
+
+
+@register("sequence_slice")
+def _seq_slice(ctx, op):
+    x = ctx.inp(op, "X")
+    lens = _lens_or_full(ctx, op, "X", x)
+    out, new_lens = S.sequence_slice(x, lens, ctx.inp(op, "Offset"),
+                                     ctx.inp(op, "Length"))
+    _out_seq(ctx, op, "Out", out, new_lens)
+
+
+@register("sequence_concat")
+def _seq_concat(ctx, op):
+    xs = ctx.inps(op, "X")
+    lens = [ctx.env.get(n + LOD_SUFFIX) for n in op.input("X")]
+    jnp = _jnp()
+    lens = [l if l is not None else
+            jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+            for x, l in zip(xs, lens)]
+    out, out_lens = S.sequence_concat(xs, lens)
+    _out_seq(ctx, op, "Out", out, out_lens)
+
+
+@register("sequence_reshape")
+def _seq_reshape(ctx, op):
+    x = ctx.inp(op, "X")
+    lens = _lens_or_full(ctx, op, "X", x)
+    out, new_lens = S.sequence_reshape(x, lens, op.attrs["new_dim"])
+    _out_seq(ctx, op, "Out", out, new_lens)
+
+
+@register("sequence_enumerate")
+def _seq_enumerate(ctx, op):
+    x = ctx.inp(op, "X")
+    if x.ndim == 3 and x.shape[-1] == 1:
+        x = x[..., 0]
+    lens = _lens_or_full(ctx, op, "X", x)
+    out = S.sequence_enumerate(x, lens, op.attrs["win_size"],
+                               op.attrs.get("pad_value", 0))
+    _out_seq(ctx, op, "Out", out, lens)
+
+
+@register("sequence_pad")
+def _seq_pad(ctx, op):
+    x = ctx.inp(op, "X")
+    pad_value = ctx.inp(op, "PadValue")
+    lens = _lens_or_full(ctx, op, "X", x)
+    out = S.sequence_pad(x, lens,
+                         pad_value if pad_value is not None else 0.0,
+                         op.attrs.get("padded_length")
+                         if op.attrs.get("padded_length", -1) != -1 else None)
+    ctx.out(op, "Out", out)
+    ctx.out(op, "Length", lens)
+
+
+@register("sequence_unpad")
+def _seq_unpad(ctx, op):
+    x = ctx.inp(op, "X")
+    length = ctx.inp(op, "Length")
+    out, lens = S.sequence_unpad(x, length)
+    _out_seq(ctx, op, "Out", out, lens)
+
+
+@register("sequence_scatter")
+def _seq_scatter(ctx, op):
+    x = ctx.inp(op, "X")
+    ids = ctx.inp(op, "Ids")
+    upd = ctx.inp(op, "Updates")
+    upd_lens = _lens_or_full(ctx, op, "Updates", upd)
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    ctx.out(op, "Out", S.sequence_scatter(x, ids, upd, upd_lens))
+
+
+@register("sequence_mask")
+def _seq_mask(ctx, op):
+    from ..core.dtypes import convert_dtype
+
+    x = ctx.inp(op, "X")
+    maxlen = op.attrs.get("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        import numpy as np
+
+        try:
+            maxlen = int(np.asarray(x).max())
+        except Exception as e:
+            raise ValueError(
+                "sequence_mask with maxlen=-1 needs concrete lengths; pass "
+                "an explicit maxlen inside jitted programs") from e
+    dt = convert_dtype(op.attrs.get("out_dtype", "int64"))
+    ctx.out(op, "Y", S.seq_mask(x, maxlen, dt))
+
+
+@register("sequence_first_step")
+def _seq_first(ctx, op):
+    x = ctx.inp(op, "X")
+    lens = _lens_or_full(ctx, op, "X", x)
+    ctx.out(op, "Out", S.sequence_pool(x, lens, "first"))
+
+
+@register("sequence_last_step")
+def _seq_last(ctx, op):
+    x = ctx.inp(op, "X")
+    lens = _lens_or_full(ctx, op, "X", x)
+    ctx.out(op, "Out", S.sequence_pool(x, lens, "last"))
+
+
+@register("dynamic_lstm")
+def _dynamic_lstm(ctx, op):
+    x = ctx.inp(op, "Input")
+    w = ctx.inp(op, "Weight")
+    b = ctx.inp(op, "Bias")
+    lens = _lens_or_full(ctx, op, "Input", x)
+    h0 = ctx.inp(op, "H0")
+    c0 = ctx.inp(op, "C0")
+    hs, cs = S.dynamic_lstm(
+        x, lens, w, b, h0, c0,
+        use_peepholes=op.attrs.get("use_peepholes", True),
+        is_reverse=op.attrs.get("is_reverse", False),
+        gate_activation=op.attrs.get("gate_activation", "sigmoid"),
+        cell_activation=op.attrs.get("cell_activation", "tanh"),
+        candidate_activation=op.attrs.get("candidate_activation", "tanh"))
+    _out_seq(ctx, op, "Hidden", hs, lens)
+    _out_seq(ctx, op, "Cell", cs, lens)
+
+
+@register("dynamic_gru")
+def _dynamic_gru(ctx, op):
+    x = ctx.inp(op, "Input")
+    w = ctx.inp(op, "Weight")
+    b = ctx.inp(op, "Bias")
+    lens = _lens_or_full(ctx, op, "Input", x)
+    h0 = ctx.inp(op, "H0")
+    hs = S.dynamic_gru(
+        x, lens, w, b, h0,
+        is_reverse=op.attrs.get("is_reverse", False),
+        gate_activation=op.attrs.get("gate_activation", "sigmoid"),
+        candidate_activation=op.attrs.get("candidate_activation", "tanh"),
+        origin_mode=op.attrs.get("origin_mode", False))
+    _out_seq(ctx, op, "Hidden", hs, lens)
+
+
+# Elementwise/shape-preserving ops propagate lod through the env by name
+# convention at the layer level (sequence sugar passes lod_level through
+# Variable metadata); the executor only needs feed/fetch awareness.
