@@ -13,6 +13,12 @@ from __future__ import annotations
 
 import numpy as np
 
+# env-key suffix for the lengths companion of a sequence-typed var inside
+# lowered programs — single source of truth for executor/lowering/layers
+LOD_SUFFIX = "@@LOD"
+# outer nesting levels ride as additional int32 offset-array companions
+LOD_OUTER_SUFFIX = "@@LODO"
+
 
 def _offsets_from_lengths(lengths):
     out = [0]

@@ -119,7 +119,7 @@ class Executor:
         import jax
         import jax.numpy as jnp
 
-        from ..core.lod import LoDTensor
+        from ..core.lod import LOD_OUTER_SUFFIX, LOD_SUFFIX, LoDTensor
 
         feed_vals = {}
         for k, v in feed.items():
@@ -127,13 +127,17 @@ class Executor:
                 feed_vals[k] = v._data
             elif isinstance(v, LoDTensor) and v.lod_level > 0:
                 # pad+mask canonicalization at the edge (SURVEY §7.1):
-                # device sees [B, T, ...] + int32 lengths companion
+                # device sees [B, T, ...] + int32 lengths companion;
+                # outer nesting levels ride as offset-array companions
                 padded, lens = v.to_padded()
                 want = blk.vars.get(k)
                 if want is not None and want.dtype is not None:
                     padded = padded.astype(want.dtype)
                 feed_vals[k] = jnp.asarray(padded)
-                feed_vals[k + "@@LOD"] = jnp.asarray(lens)
+                feed_vals[k + LOD_SUFFIX] = jnp.asarray(lens)
+                for j, level in enumerate(v.lod()[:-1]):
+                    feed_vals[f"{k}{LOD_OUTER_SUFFIX}{j}"] = \
+                        jnp.asarray(np.asarray(level, np.int32))
             else:
                 arr = np.asarray(v)
                 want = blk.vars.get(k)
@@ -171,7 +175,7 @@ class Executor:
 
         out = []
         for name, v in zip(fetch_names, fetches):
-            lens = fetch_lods.get(name + "@@LOD")
+            lens = fetch_lods.get(name + LOD_SUFFIX)
             if lens is not None:
                 if return_numpy:
                     # reference parity (executor.py as_numpy): padded rows
@@ -181,8 +185,14 @@ class Executor:
                         f"fetch var {name!r} is a sequence (LoD) tensor; "
                         f"pass return_numpy=False and use the returned "
                         f"LoDTensor's recursive_sequence_lengths()")
+                outer = []
+                j = 0
+                while f"{name}{LOD_OUTER_SUFFIX}{j}" in fetch_lods:
+                    outer.append(np.asarray(
+                        fetch_lods[f"{name}{LOD_OUTER_SUFFIX}{j}"]).tolist())
+                    j += 1
                 out.append(LoDTensor.from_padded(np.asarray(v),
-                                                 np.asarray(lens)))
+                                                 np.asarray(lens), outer))
             elif return_numpy:
                 out.append(np.asarray(v))
             else:
@@ -211,10 +221,17 @@ class Executor:
                     continue
                 lowering.lower_op(ctx, op)
             fetches = tuple(env[n] for n in fetch_names)
-            # sequence-typed fetches carry their lengths companion out so
-            # the host can re-pack a LoDTensor (core/lod.py)
-            fetch_lods = {n + "@@LOD": env[n + "@@LOD"]
-                          for n in fetch_names if n + "@@LOD" in env}
+            # sequence-typed fetches carry their lengths (and outer-lod)
+            # companions out so the host can re-pack a LoDTensor
+            from ..core.lod import LOD_SUFFIX
+
+            fetch_lods = {}
+            for n in fetch_names:
+                for k in env:
+                    # covers both the lengths companion (@@LOD) and the
+                    # outer-nesting companions (@@LODO<j>)
+                    if k.startswith(n + LOD_SUFFIX):
+                        fetch_lods[k] = env[k]
             new_persist = {n: env[n] for n in persist_names if n in env}
             return fetches, fetch_lods, new_persist
 

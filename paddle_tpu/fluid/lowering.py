@@ -39,25 +39,37 @@ def has_lowering(op_type):
     return op_type in _REGISTRY
 
 
-_LOD_SUFFIX = "@@LOD"
+from ..core.lod import LOD_OUTER_SUFFIX as _LOD_OUTER_SUFFIX
+from ..core.lod import LOD_SUFFIX as _LOD_SUFFIX
+
+# op types that manage lod companions explicitly in their lowerings
+# (fluid/lowering_seq.py registers itself here); the generic propagation
+# below must not second-guess them — e.g. sequence_pad's whole purpose is
+# a DENSE output, which shape-matching would falsely re-tag as a sequence
+LOD_AWARE_OPS = set()
 
 
 def _propagate_lod(ctx, op):
     """Row-wise ops keep their input's ragged structure: if an input var has
     a lengths companion in the env and an output of matching [B, T] leading
-    shape has none, inherit it. This is the pad+mask analogue of the
-    reference's InferVarType lod propagation (ShareLoD in op InferShape)."""
+    shape has none, inherit it (plus any outer-nesting companions). This is
+    the pad+mask analogue of the reference's lod propagation (ShareLoD in
+    op InferShape)."""
+    if op.type in LOD_AWARE_OPS:
+        return
     src = None
     for n in op.input_arg_names:
         ln = ctx.env.get(n + _LOD_SUFFIX)
         if ln is not None:
             x = ctx.env.get(n)
             if hasattr(x, "shape") and len(getattr(x, "shape", ())) >= 2:
-                src = (x.shape[:2], ln)
+                src = (n, x.shape[:2], ln)
                 break
     if src is None:
         return
-    lead, ln = src
+    src_name, lead, ln = src
+    outer = {k: v for k, v in ctx.env.items()
+             if k.startswith(src_name + _LOD_OUTER_SUFFIX)}
     for n in op.output_arg_names:
         if n + _LOD_SUFFIX in ctx.env:
             continue
@@ -65,6 +77,8 @@ def _propagate_lod(ctx, op):
         if hasattr(y, "shape") and len(getattr(y, "shape", ())) >= 2 \
                 and tuple(y.shape[:2]) == tuple(lead):
             ctx.env[n + _LOD_SUFFIX] = ln
+            for k, v in outer.items():
+                ctx.env[n + k[len(src_name):]] = v
 
 
 def lower_op(ctx, op):

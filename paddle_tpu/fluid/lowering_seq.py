@@ -9,10 +9,17 @@ A missing companion means "dense": full-length rows.
 """
 from __future__ import annotations
 
+from ..core.lod import LOD_SUFFIX
 from ..ops import sequence as S
-from .lowering import register
+from .lowering import LOD_AWARE_OPS, register as _base_register
 
-LOD_SUFFIX = "@@LOD"
+
+def register(op_type):
+    """Like lowering.register, but also opts the op out of the generic
+    shape-based lod propagation — sequence ops set companions themselves
+    (and some, like sequence_pad, intentionally produce DENSE outputs)."""
+    LOD_AWARE_OPS.add(op_type)
+    return _base_register(op_type)
 
 
 def _jnp():
@@ -63,6 +70,17 @@ def _seq_softmax(ctx, op):
 def _seq_expand(ctx, op):
     x = ctx.inp(op, "X")
     y = ctx.inp(op, "Y")
+    # supported static-shape case: x is one step per sequence ([B, D] or
+    # [B, 1, D]) broadcast over y's steps. The general ragged repeat
+    # (x rows longer than 1 step) has data-dependent output shape —
+    # reject at trace time rather than produce wrong-rank output
+    # (reference sequence_expand_op.h repeats whole x segments per y lod).
+    if x.ndim >= 3 and x.shape[1] != 1 and \
+            op.input("X")[0] + LOD_SUFFIX in ctx.env:
+        raise NotImplementedError(
+            "sequence_expand with multi-step x sequences has a "
+            "data-dependent output shape (not XLA-lowerable); restructure "
+            "with sequence_expand_as / explicit masks")
     y_lens = _lens_or_full(ctx, op, "Y", y)
     _out_seq(ctx, op, "Out", S.sequence_expand_as(x, y, y_lens), y_lens)
 

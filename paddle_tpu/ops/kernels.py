@@ -417,7 +417,9 @@ def instance_norm(x, gamma=None, beta=None, epsilon=1e-5):
 
 def rms_norm(x, gamma=None, epsilon=1e-6):
     jnp = _jnp()
-    ms = (x.astype(jnp.float32) ** 2).mean(axis=-1, keepdims=True)
+    # accumulate in at-least-f32 (bf16 inputs) without downcasting f64
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    ms = (x.astype(acc) ** 2).mean(axis=-1, keepdims=True)
     y = x * (1.0 / jnp.sqrt(ms + epsilon)).astype(x.dtype)
     if gamma is not None:
         y = y * gamma

@@ -38,20 +38,23 @@ def sequence_pool(x, lengths, pool_type="sum", pad_value=0.0):
     mask = seq_mask(lengths, T)
     fmask = _expand_mask(mask, x).astype(x.dtype)
     pt = pool_type.lower()
+    is_float = jnp.issubdtype(x.dtype, jnp.floating)
+    div_dtype = x.dtype if is_float else jnp.float32
     lens = jnp.maximum(jnp.reshape(lengths, (-1,)), 1)
-    lens = lens.reshape((-1,) + (1,) * (x.ndim - 2)).astype(x.dtype)
+    lens = lens.reshape((-1,) + (1,) * (x.ndim - 2)).astype(div_dtype)
     if pt == "sum":
         out = (x * fmask).sum(axis=1)
     elif pt == "average":
-        out = (x * fmask).sum(axis=1) / lens
+        out = ((x * fmask).sum(axis=1).astype(div_dtype) /
+               lens).astype(x.dtype)
     elif pt == "sqrt":
-        out = (x * fmask).sum(axis=1) / jnp.sqrt(lens)
-    elif pt == "max":
-        neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
-        out = jnp.where(_expand_mask(mask, x), x, neg).max(axis=1)
-    elif pt == "min":
-        pos = jnp.asarray(jnp.finfo(x.dtype).max, x.dtype)
-        out = jnp.where(_expand_mask(mask, x), x, pos).min(axis=1)
+        out = ((x * fmask).sum(axis=1).astype(div_dtype) /
+               jnp.sqrt(lens)).astype(x.dtype)
+    elif pt in ("max", "min"):
+        info = jnp.finfo(x.dtype) if is_float else jnp.iinfo(x.dtype)
+        fill = jnp.asarray(info.min if pt == "max" else info.max, x.dtype)
+        masked = jnp.where(_expand_mask(mask, x), x, fill)
+        out = masked.max(axis=1) if pt == "max" else masked.min(axis=1)
     elif pt == "last":
         idx = jnp.maximum(jnp.reshape(lengths, (-1,)) - 1, 0)
         out = jnp.take_along_axis(
@@ -79,36 +82,11 @@ def sequence_softmax(x, lengths):
     return e / jnp.maximum(e.sum(axis=1, keepdims=True), 1e-12)
 
 
-def sequence_expand(x, x_lengths, y_lengths):
-    """sequence_expand_op.h: repeat x's row-b sequence per y's row-b length.
-    Supported (static-shape) case: every x row has length 1 — i.e. x is a
-    per-sequence vector [B, 1, D] or [B, D] — broadcast across y's steps.
-    The general ragged repeat (x_len>1) has data-dependent output shape and
-    is rejected (XLA static shapes)."""
-    jnp = _jnp()
-    if x.ndim >= 3 and x.shape[1] == 1:
-        x = x[:, 0]
-    maxlen = int(_static_max(y_lengths))
-    out = jnp.broadcast_to(x[:, None], (x.shape[0], maxlen) + x.shape[1:])
-    m = _expand_mask(seq_mask(y_lengths, maxlen), out).astype(out.dtype)
-    return out * m
-
-
-def _static_max(lengths):
-    # lengths may be a traced array: the padded T must be static; callers
-    # pass the padded buffer's T via lengths' companion array when traced.
-    import numpy as np
-
-    try:
-        return int(max(np.asarray(lengths).max(), 1))
-    except Exception as e:  # traced — caller must supply maxlen explicitly
-        raise ValueError(
-            "sequence_expand inside jit needs a static target length; use "
-            "sequence_expand_as with a padded reference tensor") from e
-
-
 def sequence_expand_as(x, y, y_lengths):
-    """x [B, D] (or [B,1,D]) broadcast to y's padded time axis, masked."""
+    """sequence_expand(_as)_op.h, static-shape case: x [B, D] (or
+    [B, 1, D] — one step per sequence) broadcast to y's padded time axis,
+    masked by y's lengths. The general ragged repeat (multi-step x rows)
+    is rejected at the lowering (fluid/lowering_seq.py sequence_expand)."""
     jnp = _jnp()
     if x.ndim >= 3 and x.shape[1] == 1:
         x = x[:, 0]
@@ -158,11 +136,16 @@ def sequence_reverse(x, lengths):
 
 
 def sequence_slice(x, lengths, offset, length):
-    """sequence_slice_op.h: per-row subsequence [offset, offset+length)."""
+    """sequence_slice_op.h: per-row subsequence [offset, offset+length).
+    The reference enforce-fails when offset+length exceeds the row length;
+    inside jit that is not expressible, so the request is clamped to the
+    valid range instead (never reads padding as data)."""
     jnp = _jnp()
     T = x.shape[1]
-    off = jnp.reshape(offset, (-1, 1)).astype(jnp.int32)
-    ln = jnp.reshape(length, (-1, 1)).astype(jnp.int32)
+    rows = jnp.reshape(lengths, (-1, 1)).astype(jnp.int32)
+    off = jnp.clip(jnp.reshape(offset, (-1, 1)).astype(jnp.int32), 0, rows)
+    ln = jnp.clip(jnp.reshape(length, (-1, 1)).astype(jnp.int32),
+                  0, rows - off)
     t = jnp.arange(T, dtype=jnp.int32)[None, :]
     src = jnp.clip(off + t, 0, T - 1)
     out = jnp.take_along_axis(

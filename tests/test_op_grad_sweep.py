@@ -1,0 +1,525 @@
+"""Numeric-gradient sweep over the op library (OpTest parity).
+
+Reference analogue: unittests/op_test.py — every op checked against a
+numpy forward oracle AND central-difference gradients
+(get_numeric_gradient, op_test.py:57). Two layers here:
+
+- Part A sweeps the eager kernel library (ops/kernels.py, ops/sequence.py)
+  under float64 (jax.experimental.enable_x64) so central differences are
+  accurate to ~1e-7 and the analytic jax.grad must match tightly. This is
+  where kernel-composition bugs (bn train mode, conv_transpose, norm
+  reshaping, rnn cells) would show.
+- Part B sweeps STATIC lowerings (fluid/lowering.py) through the whole
+  pipeline: build a one-op Program, differentiate with fluid.gradients
+  (the jax_autodiff op), and compare against central differences of the
+  executed program in float32 — validating lowering attrs, autodiff
+  slicing, and executor plumbing together.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.ops import kernels as K
+from paddle_tpu.ops import sequence as S
+
+
+def _cotangent(shape, seed=7):
+    return np.random.RandomState(seed).uniform(0.5, 1.5, shape)
+
+
+def check_kernel_grad(fn, args, wrt=(0,), eps=1e-5, rtol=2e-4, atol=1e-6,
+                      seed=7):
+    """jax.grad of <fn(args), random cotangent> vs central differences,
+    in float64 for numeric headroom."""
+    import jax
+
+    with jax.enable_x64():
+        args64 = [np.asarray(a, np.float64)
+                  if np.asarray(a).dtype.kind == "f" else np.asarray(a)
+                  for a in args]
+
+        cots = {}
+
+        def loss(*a):
+            import jax.numpy as jnp
+
+            a = [jnp.asarray(v) for v in a]
+            out = fn(*a)
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            total = 0.0
+            for j, o in enumerate(outs):
+                if o is None or o.dtype.kind not in "f":
+                    continue
+                if j not in cots:
+                    cots[j] = _cotangent(o.shape, seed + j)
+                total = total + (o * cots[j]).sum()
+            return total
+
+        analytic = jax.grad(loss, argnums=tuple(wrt))(*args64)
+        for k, i in enumerate(wrt):
+            x = args64[i].copy()
+            num = np.zeros_like(x)
+            flat, nflat = x.reshape(-1), num.reshape(-1)
+            for e in range(flat.size):
+                old = flat[e]
+                flat[e] = old + eps
+                hi = float(loss(*[x if j == i else args64[j]
+                                  for j in range(len(args64))]))
+                flat[e] = old - eps
+                lo = float(loss(*[x if j == i else args64[j]
+                                  for j in range(len(args64))]))
+                flat[e] = old
+                nflat[e] = (hi - lo) / (2 * eps)
+            np.testing.assert_allclose(
+                np.asarray(analytic[k]), num, rtol=rtol, atol=atol,
+                err_msg=f"grad wrt arg {i}")
+
+
+_R = np.random.RandomState
+
+
+# ---------------------------------------------------------------------------
+# Part A: eager kernel sweep (float64, tight tolerances)
+# ---------------------------------------------------------------------------
+# (name, fn, args, wrt) — inputs chosen away from kinks (|x| > 0.1 for
+# relu-family) the same way the reference's OpTest dodges non-smooth points.
+
+def _smooth(shape, seed, lo=0.2, hi=2.0):
+    r = _R(seed)
+    return r.uniform(lo, hi, shape) * np.where(r.rand(*shape) < 0.5, -1, 1)
+
+
+A = []
+
+
+def case(name, fn, args, wrt=(0,), **kw):
+    A.append(pytest.param(fn, args, wrt, kw, id=name))
+
+
+x34 = _smooth((3, 4), 0)
+x2344 = _smooth((2, 3, 4, 4), 1)
+
+for nm in ["relu", "relu6", "sigmoid", "tanh", "softsign", "mish", "silu",
+           "softplus", "hardswish", "selu", "elu"]:
+    case(nm, getattr(K, nm), [x34])
+case("gelu", K.gelu, [x34])
+case("gelu_tanh", lambda x: K.gelu(x, True), [x34])
+case("leaky_relu", lambda x: K.leaky_relu(x, 0.05), [x34])
+case("hardsigmoid", K.hardsigmoid, [x34 * 0.1])
+case("hardtanh", K.hardtanh, [x34 * 0.3])
+case("softmax", K.softmax, [x34])
+case("log_softmax", K.log_softmax, [x34])
+case("logsumexp", K.logsumexp, [x34])
+case("scale", lambda x: K.scale(x, 2.5, 0.5), [x34])
+case("clip", lambda x: K.clip(x, -1.0, 1.0), [x34 * 0.4])
+
+case("matmul", K.matmul, [_smooth((3, 4), 2), _smooth((4, 5), 3)], (0, 1))
+case("matmul_tt",
+     lambda a, b: K.matmul(a, b, True, True),
+     [_smooth((4, 3), 4), _smooth((5, 4), 5)], (0, 1))
+case("bmm", K.bmm, [_smooth((2, 3, 4), 6), _smooth((2, 4, 2), 7)], (0, 1))
+case("mul_op", lambda a, b: K.mul_op(a, b, 1, 1),
+     [_smooth((3, 2, 2), 8), _smooth((4, 5), 9)], (0, 1))
+case("linear", K.linear,
+     [_smooth((3, 4), 10), _smooth((4, 2), 11), _smooth((2,), 12)],
+     (0, 1, 2))
+case("dot", K.dot, [_smooth((5,), 13), _smooth((5,), 14)], (0, 1))
+
+case("conv2d", lambda x, w: K.conv2d(x, w, 1, 1),
+     [_smooth((1, 2, 5, 5), 15), _smooth((3, 2, 3, 3), 16)], (0, 1))
+case("conv2d_stride2_dil2",
+     lambda x, w: K.conv2d(x, w, 2, 2, 2),
+     [_smooth((1, 2, 7, 7), 17), _smooth((2, 2, 3, 3), 18)], (0, 1))
+case("conv2d_groups", lambda x, w: K.conv2d(x, w, 1, 0, 1, 2),
+     [_smooth((1, 4, 5, 5), 19), _smooth((4, 2, 3, 3), 20)], (0, 1))
+case("conv2d_transpose", lambda x, w: K.conv2d_transpose(x, w, 2, 1, 1),
+     [_smooth((1, 3, 4, 4), 21), _smooth((3, 2, 3, 3), 22)], (0, 1))
+case("conv2d_transpose_groups",
+     lambda x, w: K.conv2d_transpose(x, w, 2, 0, 0, 1, 2),
+     [_smooth((1, 4, 3, 3), 23), _smooth((4, 1, 2, 2), 24)], (0, 1))
+
+case("max_pool2d", lambda x: K.max_pool2d(x, 2, 2), [x2344])
+case("max_pool2d_ceil", lambda x: K.max_pool2d(x, 2, 2, 0, True),
+     [_smooth((1, 2, 5, 5), 25)])
+case("avg_pool2d", lambda x: K.avg_pool2d(x, 2, 2), [x2344])
+case("avg_pool2d_pad_incl",
+     lambda x: K.avg_pool2d(x, 3, 2, 1, False, False),
+     [_smooth((1, 2, 5, 5), 26)])
+case("adaptive_avg_pool2d", lambda x: K.adaptive_avg_pool2d(x, (2, 2)),
+     [_smooth((1, 2, 6, 6), 27)])
+case("adaptive_max_pool2d", lambda x: K.adaptive_max_pool2d(x, (2, 2)),
+     [_smooth((1, 2, 6, 6), 28)])
+
+case("batch_norm_train",
+     lambda x, g, b: K.batch_norm_train(
+         x, g, b, np.zeros(3), np.ones(3), 0.9, 1e-5)[0],
+     [_smooth((4, 3, 2, 2), 29), _smooth((3,), 30), _smooth((3,), 31)],
+     (0, 1, 2), rtol=5e-4, atol=1e-5)
+case("batch_norm_infer",
+     lambda x, g, b: K.batch_norm_infer(
+         x, g, b, np.zeros(3) + 0.1, np.ones(3) * 0.8, 1e-5),
+     [_smooth((4, 3, 2, 2), 32), _smooth((3,), 33), _smooth((3,), 34)],
+     (0, 1, 2))
+case("batch_norm_nhwc",
+     lambda x, g, b: K.batch_norm_train(
+         x, g, b, np.zeros(3), np.ones(3), 0.9, 1e-5, "NHWC")[0],
+     [_smooth((4, 2, 2, 3), 35), _smooth((3,), 36), _smooth((3,), 37)],
+     (0, 1, 2), rtol=5e-4, atol=1e-5)
+case("layer_norm",
+     lambda x, g, b: K.layer_norm(x, g, b, 1e-5, 1),
+     [_smooth((3, 4, 2), 38), _smooth((4, 2), 39), _smooth((4, 2), 40)],
+     (0, 1, 2), rtol=5e-4, atol=1e-5)
+case("group_norm",
+     lambda x, g, b: K.group_norm(x, 2, g, b),
+     [_smooth((2, 4, 3, 3), 41), _smooth((4,), 42), _smooth((4,), 43)],
+     (0, 1, 2), rtol=5e-4, atol=1e-5)
+case("instance_norm",
+     lambda x, g, b: K.instance_norm(x, g, b),
+     [_smooth((2, 3, 3, 3), 44), _smooth((3,), 45), _smooth((3,), 46)],
+     (0, 1, 2), rtol=5e-4, atol=1e-5)
+case("rms_norm", lambda x, g: K.rms_norm(x, g),
+     [_smooth((3, 4), 47), _smooth((4,), 48)], (0, 1))
+
+case("embedding",
+     lambda w: K.embedding(np.array([[0, 2], [1, 1]]), w),
+     [_smooth((4, 3), 49)])
+case("embedding_padding_idx",
+     lambda w: K.embedding(np.array([[0, 2], [1, 1]]), w, 1),
+     [_smooth((4, 3), 50)])
+
+for red in ["reduce_sum", "reduce_mean", "reduce_max", "reduce_min"]:
+    case(red, lambda x, _f=getattr(K, red): _f(x, [1]), [x34])
+case("reduce_prod", lambda x: K.reduce_prod(x, [0]),
+     [_smooth((3, 4), 51, 0.5, 1.5)])
+
+case("softmax_with_ce",
+     lambda lg: K.softmax_with_cross_entropy(lg, np.array([[1], [0], [3]])),
+     [_smooth((3, 4), 52)])
+case("softmax_with_ce_soft",
+     lambda lg: K.softmax_with_cross_entropy(
+         lg, np.full((3, 4), 0.25), soft_label=True),
+     [_smooth((3, 4), 53)])
+case("cross_entropy_loss",
+     lambda lg: K.cross_entropy_loss(lg, np.array([1, 0, 3])),
+     [_smooth((3, 4), 54)])
+case("bce_loss",
+     lambda p: K.bce_loss(p, (np.arange(6).reshape(3, 2) % 2).astype("f")),
+     [_R(55).uniform(0.2, 0.8, (3, 2))])
+case("bce_with_logits",
+     lambda lg: K.bce_with_logits(
+         lg, (np.arange(6).reshape(3, 2) % 2).astype("f")),
+     [_smooth((3, 2), 56)])
+case("mse_loss", K.mse_loss, [_smooth((3, 4), 57), _smooth((3, 4), 58)],
+     (0, 1))
+case("l1_loss", K.l1_loss, [_smooth((3, 4), 59), _smooth((3, 4), 60) * 2],
+     (0,))
+case("smooth_l1", K.smooth_l1,
+     [_smooth((3, 4), 61), _smooth((3, 4), 62) * 3], (0,))
+case("nll_loss",
+     lambda lp: K.nll_loss(lp, np.array([1, 0, 2])),
+     [np.log(_R(63).dirichlet(np.ones(4), 3))])
+case("kl_div",
+     lambda lp: K.kl_div(lp, _R(64).dirichlet(np.ones(4), 3)),
+     [np.log(_R(65).dirichlet(np.ones(4), 3))])
+
+case("reshape", lambda x: K.reshape(x, (4, 3)), [x34])
+case("transpose", lambda x: K.transpose(x, [1, 0]), [x34])
+case("concat", lambda a, b: K.concat([a, b], 1),
+     [_smooth((3, 2), 66), _smooth((3, 3), 67)], (0, 1))
+case("split", lambda x: K.split(x, 2, 1), [x34])
+case("split_sections", lambda x: K.split(x, [1, 3], 1), [x34])
+case("stack", lambda a, b: K.stack([a, b], 1),
+     [_smooth((3, 2), 68), _smooth((3, 2), 69)], (0, 1))
+case("squeeze", lambda x: K.squeeze(x, None), [_smooth((3, 1, 4), 70)])
+case("unsqueeze", lambda x: K.unsqueeze(x, [1]), [x34])
+case("flatten", lambda x: K.flatten(x, 1, 2), [_smooth((2, 3, 4), 71)])
+case("expand", lambda x: K.expand(x, (3, 2, 4)), [_smooth((2, 4), 72)])
+case("tile", lambda x: K.tile(x, (2, 3)), [x34])
+case("slice", lambda x: K.slice_op(x, [0, 1], [1, 0], [3, 2]), [x34])
+case("strided_slice",
+     lambda x: K.strided_slice(x, [1], [0], [4], [2]), [x34])
+case("gather", lambda x: K.gather(x, np.array([2, 0, 1]), 0), [x34])
+case("gather_nd",
+     lambda x: K.gather_nd(x, np.array([[0, 1], [2, 3]])), [x34])
+case("scatter",
+     lambda x, u: K.scatter(x, np.array([1, 0]), u, True),
+     [x34, _smooth((2, 4), 73)], (0, 1))
+case("scatter_add",
+     lambda x, u: K.scatter(x, np.array([1, 1]), u, False),
+     [x34, _smooth((2, 4), 74)], (0, 1))
+case("scatter_nd_add",
+     lambda x, u: K.scatter_nd_add(x, np.array([[1], [1]]), u),
+     [x34, _smooth((2, 4), 75)], (0, 1))
+case("index_select",
+     lambda x: K.index_select(x, np.array([1, 1, 3]), 1), [x34])
+case("index_sample",
+     lambda x: K.index_sample(x, np.array([[0, 1], [2, 0], [1, 1]])),
+     [x34])
+case("where",
+     lambda a, b: K.where(np.array([[True, False]] * 3), a, b),
+     [_smooth((3, 2), 76), _smooth((3, 2), 77)], (0, 1))
+case("pad_constant",
+     lambda x: K.pad(x, [1, 1, 0, 2], "constant", 0.5), [x34])
+case("pad_reflect", lambda x: K.pad(x, [1, 1, 1, 1], "reflect"), [x34])
+case("pad_edge", lambda x: K.pad(x, [0, 1, 2, 0], "replicate"), [x34])
+case("roll", lambda x: K.roll(x, 2, 1), [x34])
+case("flip", lambda x: K.flip(x, 0), [x34])
+case("broadcast_to", lambda x: K.broadcast_to(x, (3, 4)),
+     [_smooth((1, 4), 78)])
+case("cumsum", lambda x: K.cumsum(x, 1), [x34])
+case("cumprod", lambda x: K.cumprod(x, 1),
+     [_smooth((3, 4), 79, 0.5, 1.5)])
+case("tril", K.tril, [x34])
+case("triu", K.triu, [x34])
+case("norm_l2", lambda x: K.norm(x, 2, 1), [x34])
+case("clip_by_norm", lambda x: K.clip_by_norm(x, 1.0), [x34])
+case("multiplex",
+     lambda a, b: K.multiplex([a, b], np.array([1, 0, 1])),
+     [x34, _smooth((3, 4), 80)], (0, 1))
+case("interp_bilinear",
+     lambda x: K.interpolate_bilinear(x, (4, 4)),
+     [_smooth((1, 2, 3, 3), 81)])
+case("segment_sum",
+     lambda x: K.segment_sum(x, np.array([0, 0, 1, 2]), 3),
+     [_smooth((4, 3), 82)])
+
+_lens = np.array([3, 1, 4])
+for pt in ["sum", "average", "sqrt", "max", "last", "first"]:
+    case(f"sequence_pool_{pt}",
+         lambda x, _p=pt: S.sequence_pool(x, _lens, _p),
+         [_smooth((3, 4, 2), 83)])
+case("sequence_softmax", lambda x: S.sequence_softmax(x, _lens),
+     [_smooth((3, 4), 84)])
+case("sequence_conv",
+     lambda x, w: S.sequence_conv(x, _lens, w, 3, -1),
+     [_smooth((3, 4, 2), 85), _smooth((6, 3), 86)], (0, 1))
+case("sequence_reverse", lambda x: S.sequence_reverse(x, _lens),
+     [_smooth((3, 4, 2), 87)])
+case("sequence_expand_as",
+     lambda x, y: S.sequence_expand_as(x, y, _lens),
+     [_smooth((3, 2), 88), _smooth((3, 4, 2), 89)], (0,))
+case("dynamic_gru",
+     lambda x, w, b: S.dynamic_gru(x, _lens, w, b),
+     [_smooth((3, 4, 6), 90) * 0.3, _smooth((2, 6), 91) * 0.3,
+      _smooth((1, 6), 92) * 0.1], (0, 1, 2),
+     rtol=5e-4, atol=1e-5)
+case("dynamic_lstm",
+     lambda x, w, b: S.dynamic_lstm(x, _lens, w, b, use_peepholes=True),
+     [_smooth((3, 4, 8), 93) * 0.3, _smooth((2, 8), 94) * 0.3,
+      _smooth((1, 14), 95) * 0.1], (0, 1, 2),
+     rtol=5e-4, atol=1e-5)
+case("dynamic_lstm_reverse",
+     lambda x, w, b: S.dynamic_lstm(x, _lens, w, b, use_peepholes=False,
+                                    is_reverse=True),
+     [_smooth((3, 4, 8), 96) * 0.3, _smooth((2, 8), 97) * 0.3,
+      _smooth((1, 8), 98) * 0.1], (0, 1, 2),
+     rtol=5e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("fn,args,wrt,kw", A)
+def test_kernel_grad(fn, args, wrt, kw):
+    check_kernel_grad(fn, args, wrt, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Part B: static lowering sweep through Program + jax_autodiff + Executor
+# ---------------------------------------------------------------------------
+
+def check_static_grad(op_type, inputs, outputs, attrs, wrt, extra_vars=(),
+                      eps=2e-3, rtol=2e-2, atol=2e-3, out_slot=None,
+                      seed=11):
+    """Build a one-op Program; compare fluid.gradients (jax_autodiff) wrt
+    feed vars against central differences of the executed forward."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        in_vars = {}
+        op_inputs = {}
+        for slot, arrs in inputs.items():
+            vs = []
+            for i, a in enumerate(arrs):
+                v = blk.create_var(name=f"in_{slot}_{i}", shape=list(a.shape),
+                                   dtype=str(a.dtype), is_data=True,
+                                   stop_gradient=False)
+                vs.append(v)
+                in_vars[v.name] = a
+            op_inputs[slot] = vs
+        out_vars = {}
+        for slot, n in outputs.items():
+            out_vars[slot] = [blk.create_var(name=f"out_{slot}_{i}")
+                              for i in range(n)]
+        blk.append_op(type=op_type, inputs=op_inputs,
+                      outputs={k: [v.name for v in vs]
+                               for k, vs in out_vars.items()},
+                      attrs=dict(attrs))
+        slot = out_slot or next(iter(outputs))
+        target = out_vars[slot][0]
+        cot_name = "cot"
+        # scalar loss = <out, fixed random cotangent>; appended as ops so
+        # the whole thing (incl. the op under test) sits in ONE program
+        cotv = blk.create_var(name=cot_name, is_data=True)
+        prod = fluid.layers.elementwise_mul(target, cotv)
+        loss = fluid.layers.reduce_sum(prod)  # reduce_all -> scalar
+        wrt_vars = [blk.var(f"in_{s}_{i}") for (s, i) in wrt]
+        grads = fluid.gradients([loss], wrt_vars)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    from paddle_tpu.core.lod import LoDTensor
+
+    def run(feed, fetches):
+        # return_numpy=False: sequence-typed fetches come back as
+        # LoDTensors; re-pad those so shapes match the in-program view
+        outs = exe.run(main, feed, fetches, return_numpy=False)
+        return [o.to_padded()[0] if isinstance(o, LoDTensor)
+                else np.asarray(o) for o in outs]
+
+    # forward once to learn output shape, then fix the cotangent
+    probe = dict(in_vars)
+    probe[cot_name] = np.ones((1,), "float32")  # placeholder may broadcast
+    out0 = run({**in_vars, cot_name: np.zeros((1,), "float32")}, [target])[0]
+    cot = _cotangent(out0.shape, seed).astype("float32")
+    feed = {**in_vars, cot_name: cot}
+
+    analytic = run(feed, grads)
+    for (s, i), g in zip(wrt, analytic):
+        x = in_vars[f"in_{s}_{i}"]
+        num = np.zeros(x.shape, "float64")
+        flat, nflat = x.reshape(-1), num.reshape(-1)
+        for e in range(flat.size):
+            old = flat[e]
+            flat[e] = old + eps
+            hi = float(run(feed, [loss])[0])
+            flat[e] = old - eps
+            lo = float(run(feed, [loss])[0])
+            flat[e] = old
+            nflat[e] = (hi - lo) / (2 * eps)
+        np.testing.assert_allclose(
+            np.asarray(g, "float64"), num, rtol=rtol, atol=atol,
+            err_msg=f"static grad of {op_type} wrt in_{s}_{i}")
+
+
+def _f32(a):
+    return np.asarray(a, "float32")
+
+
+B = []
+
+
+def scase(name, op_type, inputs, outputs, attrs, wrt, **kw):
+    B.append(pytest.param(op_type, inputs, outputs, attrs, wrt, kw, id=name))
+
+
+sx = _f32(_smooth((3, 4), 100))
+sy = _f32(_smooth((3, 4), 101))
+
+for ew in ["elementwise_add", "elementwise_sub", "elementwise_mul",
+           "elementwise_div", "elementwise_max", "elementwise_min"]:
+    scase(ew, ew, {"X": [sx], "Y": [sy]}, {"Out": 1}, {},
+          [("X", 0), ("Y", 0)])
+scase("elementwise_add_axis", "elementwise_add",
+      {"X": [_f32(_smooth((3, 4, 2), 102))], "Y": [_f32(_smooth((4,), 103))]},
+      {"Out": 1}, {"axis": 1}, [("X", 0), ("Y", 0)])
+for act in ["tanh", "sigmoid", "gelu", "softplus", "silu", "mish"]:
+    scase(f"act_{act}", act, {"X": [sx]}, {"Out": 1}, {}, [("X", 0)])
+scase("softmax", "softmax", {"X": [sx]}, {"Out": 1}, {"axis": -1},
+      [("X", 0)])
+scase("scale", "scale", {"X": [sx]}, {"Out": 1},
+      {"scale": 1.7, "bias": 0.3}, [("X", 0)])
+scase("matmul", "matmul",
+      {"X": [_f32(_smooth((3, 4), 104))], "Y": [_f32(_smooth((4, 2), 105))]},
+      {"Out": 1}, {}, [("X", 0), ("Y", 0)])
+scase("matmul_ty", "matmul",
+      {"X": [_f32(_smooth((3, 4), 106))], "Y": [_f32(_smooth((2, 4), 107))]},
+      {"Out": 1}, {"transpose_Y": True}, [("X", 0), ("Y", 0)])
+scase("mul", "mul",
+      {"X": [_f32(_smooth((3, 4), 108))], "Y": [_f32(_smooth((4, 2), 109))]},
+      {"Out": 1}, {}, [("X", 0), ("Y", 0)])
+scase("conv2d", "conv2d",
+      {"Input": [_f32(_smooth((1, 2, 5, 5), 110))],
+       "Filter": [_f32(_smooth((3, 2, 3, 3), 111))]},
+      {"Output": 1}, {"strides": [1, 1], "paddings": [1, 1]},
+      [("Input", 0), ("Filter", 0)])
+scase("conv2d_transpose", "conv2d_transpose",
+      {"Input": [_f32(_smooth((1, 3, 4, 4), 112))],
+       "Filter": [_f32(_smooth((3, 2, 3, 3), 113))]},
+      {"Output": 1},
+      {"strides": [2, 2], "paddings": [1, 1], "output_padding": [1, 1]},
+      [("Input", 0), ("Filter", 0)])
+scase("pool2d_avg", "pool2d",
+      {"X": [_f32(_smooth((1, 2, 4, 4), 114))]}, {"Out": 1},
+      {"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2]},
+      [("X", 0)])
+scase("pool2d_max_global", "pool2d",
+      {"X": [_f32(_smooth((1, 2, 4, 4), 115))]}, {"Out": 1},
+      {"pooling_type": "max", "ksize": [1, 1], "global_pooling": True},
+      [("X", 0)])
+scase("layer_norm", "layer_norm",
+      {"X": [_f32(_smooth((3, 4), 116))],
+       "Scale": [_f32(_smooth((4,), 117))],
+       "Bias": [_f32(_smooth((4,), 118))]},
+      {"Y": 1}, {"begin_norm_axis": 1},
+      [("X", 0), ("Scale", 0), ("Bias", 0)], rtol=4e-2)
+scase("batch_norm_train", "batch_norm",
+      {"X": [_f32(_smooth((4, 3, 2, 2), 119))],
+       "Scale": [_f32(_smooth((3,), 120))],
+       "Bias": [_f32(_smooth((3,), 121))],
+       "Mean": [np.zeros(3, "float32")],
+       "Variance": [np.ones(3, "float32")]},
+      {"Y": 1, "MeanOut": 1, "VarianceOut": 1, "SavedMean": 1,
+       "SavedVariance": 1},
+      {"momentum": 0.9, "epsilon": 1e-5},
+      [("X", 0), ("Scale", 0), ("Bias", 0)], out_slot="Y", rtol=4e-2)
+scase("reduce_mean", "reduce_mean", {"X": [sx]}, {"Out": 1},
+      {"dim": [1], "keep_dim": True}, [("X", 0)])
+scase("reduce_max", "reduce_max", {"X": [sx]}, {"Out": 1},
+      {"dim": [1], "keep_dim": True}, [("X", 0)])
+scase("swce", "softmax_with_cross_entropy",
+      {"Logits": [_f32(_smooth((3, 5), 122))],
+       "Label": [np.array([[1], [0], [4]], "int64")]},
+      {"Loss": 1, "Softmax": 1}, {}, [("Logits", 0)], out_slot="Loss")
+scase("cross_entropy", "cross_entropy",
+      {"X": [_f32(_R(123).dirichlet(np.ones(4), 3))],
+       "Label": [np.array([[1], [0], [3]], "int64")]},
+      {"Y": 1}, {}, [("X", 0)])
+scase("lookup_table", "lookup_table_v2",
+      {"Ids": [np.array([[0, 2], [1, 1]], "int64")],
+       "W": [_f32(_smooth((4, 3), 124))]},
+      {"Out": 1}, {}, [("W", 0)])
+scase("reshape", "reshape2", {"X": [sx]}, {"Out": 1}, {"shape": [2, 6]},
+      [("X", 0)])
+scase("transpose", "transpose2", {"X": [sx]}, {"Out": 1}, {"axis": [1, 0]},
+      [("X", 0)])
+scase("concat", "concat",
+      {"X": [_f32(_smooth((3, 2), 125)), _f32(_smooth((3, 3), 126))]},
+      {"Out": 1}, {"axis": 1}, [("X", 0), ("X", 1)])
+scase("stack", "stack",
+      {"X": [_f32(_smooth((3, 2), 127)), _f32(_smooth((3, 2), 128))]},
+      {"Y": 1}, {"axis": 0}, [("X", 0), ("X", 1)])
+scase("slice", "slice", {"Input": [sx]}, {"Out": 1},
+      {"axes": [1], "starts": [1], "ends": [3]}, [("Input", 0)])
+scase("gather", "gather",
+      {"X": [sx], "Index": [np.array([2, 0], "int64")]},
+      {"Out": 1}, {}, [("X", 0)])
+scase("squeeze", "squeeze2",
+      {"X": [_f32(_smooth((3, 1, 4), 129))]}, {"Out": 1}, {"axes": [1]},
+      [("X", 0)])
+scase("expand_v2", "expand_v2",
+      {"X": [_f32(_smooth((1, 4), 130))]}, {"Out": 1}, {"shape": [3, 4]},
+      [("X", 0)])
+scase("pad2d", "pad",
+      {"X": [sx]}, {"Out": 1}, {"paddings": [1, 0, 0, 1], "value": 0.0},
+      [("X", 0)])
+scase("clip_op", "clip", {"X": [_f32(sx * 0.4)]}, {"Out": 1},
+      {"min": -0.5, "max": 0.5}, [("X", 0)])
+scase("sequence_pool_static", "sequence_pool",
+      {"X": [_f32(_smooth((3, 4, 2), 131))]}, {"Out": 1},
+      {"pooltype": "SUM"}, [("X", 0)])
+scase("sequence_softmax_static", "sequence_softmax",
+      {"X": [_f32(_smooth((3, 4), 132))]}, {"Out": 1}, {}, [("X", 0)])
+
+
+@pytest.mark.parametrize("op_type,inputs,outputs,attrs,wrt,kw", B)
+def test_static_lowering_grad(op_type, inputs, outputs, attrs, wrt, kw):
+    check_static_grad(op_type, inputs, outputs, attrs, wrt, **kw)

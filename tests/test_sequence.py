@@ -38,7 +38,9 @@ def _pad(rows, lens, T=None):
 @pytest.mark.parametrize("pool", ["sum", "average", "sqrt", "max", "min",
                                   "last", "first"])
 def test_sequence_pool_parity(pool):
-    rows, lens = _rand_lod(seed=hash(pool) % 1000)
+    import zlib
+
+    rows, lens = _rand_lod(seed=zlib.crc32(pool.encode()) % 1000)
     got = np.asarray(S.sequence_pool(_pad(rows, lens), lens, pool))
     for b, r in enumerate(rows):
         want = {"sum": r.sum(0), "average": r.mean(0),
@@ -335,6 +337,61 @@ def test_static_lstm_mt_style_trains():
 
     losses = [float(exe.run(main, batch(), [avg])[0]) for _ in range(40)]
     assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.6, losses
+
+
+def test_sequence_slice_clamps_overrun():
+    rows, lens = _rand_lod(seed=20)
+    # request past the row end: clamped, never reads padding as data
+    offset = np.minimum(lens - 1, 2)
+    length = np.full_like(lens, 100)
+    out, new_lens = S.sequence_slice(_pad(rows, lens), lens, offset, length)
+    out = np.asarray(out)
+    for b, r in enumerate(rows):
+        want = r[offset[b]:]
+        assert int(new_lens[b]) == len(want)
+        np.testing.assert_allclose(out[b, :len(want)], want, rtol=1e-6)
+        assert np.all(out[b, len(want):] == 0)
+
+
+def test_sequence_pool_int_dtypes():
+    lens = np.array([2, 3])
+    ids = np.array([[5, 9, 0], [1, 2, 7]], dtype="int64")
+    got = np.asarray(S.sequence_pool(ids, lens, "max"))
+    np.testing.assert_array_equal(got, [9, 7])
+    got = np.asarray(S.sequence_pool(ids, lens, "min"))
+    np.testing.assert_array_equal(got, [5, 1])
+
+
+def test_sequence_pad_output_is_dense():
+    """sequence_pad's Out must NOT be re-tagged as a sequence by generic
+    lod propagation — it is the op's purpose to produce a dense tensor."""
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], dtype="float32", lod_level=1)
+        pv = fluid.layers.fill_constant([1], "float32", 0.0)
+        out, length = fluid.layers.sequence_pad(x, pv)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rows = [np.ones((2, 2), "float32"), np.ones((3, 2), "float32")]
+    # dense fetch with return_numpy=True must work (no LoD error)
+    padded = exe.run(main, {"x": LoDTensor.from_sequences(rows)}, [out])[0]
+    assert padded.shape == (2, 3, 2)
+    assert np.all(padded[0, 2] == 0)
+
+
+def test_nested_lod_feed_fetch_roundtrip():
+    """Outer lod levels survive feed -> shape-preserving op -> fetch."""
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1], dtype="float32", lod_level=2)
+        y = fluid.layers.scale(x, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    data = np.arange(7, dtype="float32").reshape(7, 1)
+    t = create_lod_tensor(data, [[2, 1], [2, 3, 2]], None)
+    out = exe.run(main, {"x": t}, [y], return_numpy=False)[0]
+    assert out.recursive_sequence_lengths() == [[2, 1], [2, 3, 2]]
+    np.testing.assert_allclose(np.asarray(out), data * 2)
 
 
 def test_lod_fetch_returns_lodtensor():
