@@ -200,6 +200,12 @@ class Operator:
             stack = _user_callstack()
             if stack:
                 self.attrs["op_callstack"] = stack
+        # device_guard annotation (framework.py:5516 op_device attr) — the
+        # hook PipelineOptimizer's program splitter cuts stages on
+        if "op_device" not in self.attrs:
+            dev = current_device_annotation()
+            if dev is not None:
+                self.attrs["op_device"] = dev
 
     def input(self, slot):
         return self.inputs.get(slot, [])

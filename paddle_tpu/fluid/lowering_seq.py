@@ -72,15 +72,14 @@ def _seq_expand(ctx, op):
     y = ctx.inp(op, "Y")
     # supported static-shape case: x is one step per sequence ([B, D] or
     # [B, 1, D]) broadcast over y's steps. The general ragged repeat
-    # (x rows longer than 1 step) has data-dependent output shape —
-    # reject at trace time rather than produce wrong-rank output
-    # (reference sequence_expand_op.h repeats whole x segments per y lod).
-    if x.ndim >= 3 and x.shape[1] != 1 and \
-            op.input("X")[0] + LOD_SUFFIX in ctx.env:
+    # (x rows longer than 1 step — sequence OR dense) has data-dependent
+    # output shape — reject at trace time rather than produce wrong-rank
+    # output (reference sequence_expand_op.h repeats x segments per y lod).
+    if x.ndim >= 3 and x.shape[1] != 1:
         raise NotImplementedError(
-            "sequence_expand with multi-step x sequences has a "
-            "data-dependent output shape (not XLA-lowerable); restructure "
-            "with sequence_expand_as / explicit masks")
+            "sequence_expand with multi-step x has a data-dependent "
+            "output shape (not XLA-lowerable); restructure with "
+            "sequence_expand_as / explicit masks")
     y_lens = _lens_or_full(ctx, op, "Y", y)
     _out_seq(ctx, op, "Out", S.sequence_expand_as(x, y, y_lens), y_lens)
 

@@ -219,3 +219,6 @@ class GradientMergeOptimizer(Optimizer):
         # with the fleet meta-optimizer pass
         return self._inner.minimize(loss, startup_program, parameter_list,
                                     no_grad_set)
+
+
+from .pipeline import PipelineOptimizer  # noqa: E402,F401

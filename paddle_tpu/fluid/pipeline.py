@@ -1,0 +1,401 @@
+"""Pipeline parallelism for static programs: device_guard splitting + 1F1B.
+
+Reference parity: PipelineOptimizer._split_program (fluid/optimizer.py:3666
+-3923) cuts the program into per-device "sections" by each op's `op_device`
+attr (set via fluid.device_guard); PipelineTrainer/SectionWorker
+(framework/pipeline_trainer.cc:24, section_worker.cc:82) run one worker per
+section, streaming microbatch scopes through queues.
+
+TPU-native design: each section's op list is traced through the standard
+lowerings into ONE jitted function pinned to its own device; activations
+hop devices with explicit jax.device_put (the ICI transfer the reference
+does with scope queues), and the 1F1B schedule is driven from the host —
+correct because XLA dispatch is async: issuing F(s+1, mb) then B(s, mb')
+lets both devices compute concurrently, which is exactly what the
+reference's section worker threads achieve. Backward is jax.vjp of each
+section function (no hand-built grad sections), grads accumulate over
+microbatches, and the inner optimizer applies per-section as a functional
+transform (optimizer/functional.py) on the section's device.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+from ..optimizer import functional as fopt
+from . import lowering
+
+
+class ProgramSection:
+    """One contiguous device-annotated slice of the forward program."""
+
+    def __init__(self, index, device, ops):
+        self.index = index
+        self.device = device
+        self.ops = ops
+        self.param_names = []   # persistables read
+        self.in_names = []      # activations from earlier sections / feeds
+        self.out_names = []     # activations later sections (or loss) read
+
+    def __repr__(self):
+        return (f"Section({self.index}, dev={self.device!r}, "
+                f"ops={[o.type for o in self.ops]}, in={self.in_names}, "
+                f"out={self.out_names})")
+
+
+def split_program(program, loss_name, feed_names):
+    """Cut the global block's forward ops into ProgramSections by their
+    op_device annotation (optimizer.py:3686 _op_device_key parity). Ops
+    without an annotation inherit the previous op's device (reference
+    fills with the last seen device). Every device must form one
+    contiguous run — interleaving is a user error, as in the reference."""
+    blk = program.global_block()
+    fwd_ops = [op for op in blk.ops
+               if op.type not in ("feed", "fetch", "jax_autodiff")]
+
+    runs = []
+    cur_dev, cur_ops = None, []
+    for op in fwd_ops:
+        dev = op.attrs.get("op_device") or cur_dev
+        if dev != cur_dev and cur_ops:
+            runs.append((cur_dev, cur_ops))
+            cur_ops = []
+        cur_dev = dev
+        cur_ops.append(op)
+    if cur_ops:
+        runs.append((cur_dev, cur_ops))
+    seen = set()
+    for dev, _ in runs:
+        if dev in seen:
+            raise ValueError(
+                f"device_guard({dev!r}) ops are not contiguous; pipeline "
+                f"sections must be a single run per device")
+        seen.add(dev)
+
+    sections = [ProgramSection(i, dev, ops)
+                for i, (dev, ops) in enumerate(runs)]
+
+    persistable = {v.name for v in blk.vars.values() if v.persistable}
+    produced_by = {}
+    for s in sections:
+        for op in s.ops:
+            for n in op.output_arg_names:
+                produced_by.setdefault(n, s.index)
+
+    needed_later = collections.defaultdict(set)  # section -> names
+    for s in sections:
+        for op in s.ops:
+            for n in op.input_arg_names:
+                src = produced_by.get(n)
+                if src is not None and src < s.index:
+                    needed_later[src].add(n)
+
+    feed_set = set(feed_names)
+    for s in sections:
+        produced_here = set()
+        ins, params = [], []
+        for op in s.ops:
+            for n in op.input_arg_names:
+                if n in produced_here:
+                    continue
+                if n in persistable:
+                    if n not in params:
+                        params.append(n)
+                elif (n in feed_set or produced_by.get(n, s.index)
+                        < s.index):
+                    if n not in ins:
+                        ins.append(n)
+            produced_here.update(op.output_arg_names)
+        s.in_names = ins
+        s.param_names = params
+        s.out_names = sorted(needed_later[s.index])
+    if loss_name not in sections[-1].out_names:
+        if produced_by.get(loss_name) != sections[-1].index:
+            raise ValueError(
+                f"loss {loss_name!r} must be produced by the LAST pipeline "
+                f"section (produced by section "
+                f"{produced_by.get(loss_name)})")
+        sections[-1].out_names = sections[-1].out_names + [loss_name]
+    return sections
+
+
+def _section_fn(program, section, training=True):
+    """(params_dict, inputs_dict, key) -> outputs_dict, traced through the
+    standard op lowerings — one XLA computation per section."""
+
+    def fn(params, inputs, key):
+        env = dict(params)
+        env.update(inputs)
+        ctx = lowering.LowerCtx(env, key, training=training,
+                                program=program)
+        for op in section.ops:
+            lowering.lower_op(ctx, op)
+        return {n: env[n] for n in section.out_names}
+
+    return fn
+
+
+def _opt_transform(inner):
+    """Map a fluid optimizer instance to its functional rule
+    (operators/optimizers kernels as pytree transforms)."""
+    from . import optimizer as fo
+
+    lr = inner._learning_rate
+    if isinstance(inner, fo.LambOptimizer):
+        return fopt.lamb(lr, inner._beta1, inner._beta2, inner._eps,
+                         weight_decay=inner._wd)
+    if isinstance(inner, fo.AdamOptimizer):
+        return fopt.adam(lr, inner._beta1, inner._beta2, inner._eps)
+    if isinstance(inner, fo.MomentumOptimizer):
+        return fopt.momentum(lr, inner._momentum,
+                             use_nesterov=inner._use_nesterov)
+    if isinstance(inner, fo.SGDOptimizer):
+        return fopt.sgd(lr)
+    raise TypeError(
+        f"PipelineOptimizer: no functional rule for {type(inner).__name__}")
+
+
+class PipelineTrainer:
+    """Runs the section schedule (PipelineTrainer/SectionWorker parity).
+
+    1F1B: after a warmup of S in-flight microbatches, every new forward is
+    paired with the backward of the oldest in-flight microbatch, bounding
+    live activation memory to S microbatches per stage.
+    """
+
+    def __init__(self, program, sections, inner_optimizer, scope,
+                 num_microbatches, devices=None, seed=0, loss_name=None):
+        import jax
+
+        self.program = program
+        self.sections = sections
+        self.M = int(num_microbatches)
+        self.scope = scope
+        self.inner = inner_optimizer
+        self.tx = _opt_transform(inner_optimizer)
+        if devices is None:
+            avail = jax.devices()
+            devices = [avail[i % len(avail)]
+                       for i in range(len(sections))]
+        self.devices = devices
+        self.seed = seed
+        self.loss_name = loss_name
+        self._step = 0
+        # jitted per-section forward and backward. The backward RECOMPUTES
+        # its section's forward inside the jit (activation recompute, the
+        # standard 1F1B-with-remat trade) so both directions compile ONCE
+        # and the per-op Python lowering loop stays off the hot path
+        # (the reference compiles each section's program once per
+        # SectionWorker, section_worker.cc).
+        self._fwd, self._bwd = [], []
+        for s in sections:
+            fn = _section_fn(program, s)
+
+            def bwd(p, ins, key, cot, _fn=fn):
+                _, vjp_fn = jax.vjp(lambda pp, xx: _fn(pp, xx, key), p, ins)
+                return vjp_fn(cot)
+
+            self._fwd.append(jax.jit(fn))
+            self._bwd.append(jax.jit(bwd))
+        self._params = None     # list of {name: array} per section
+        self._opt_state = None
+
+    # -- parameter placement ------------------------------------------------
+    def _materialize(self):
+        import jax
+
+        if self._params is not None:
+            return
+        self._params = []
+        for s, dev in zip(self.sections, self.devices):
+            vals = {}
+            for n in s.param_names:
+                v = self.scope.get_value(n)
+                if v is None:
+                    raise RuntimeError(
+                        f"persistable {n!r} missing from scope; run the "
+                        f"startup program first")
+                vals[n] = jax.device_put(v, dev)
+            self._params.append(vals)
+        self._opt_state = [self.tx.init(p) for p in self._params]
+
+    def _writeback(self):
+        for p in self._params or []:
+            for n, v in p.items():
+                self.scope.set_value(n, v)
+
+    # -- one optimizer step over a full batch -------------------------------
+    def train_batch(self, feed, loss_name=None):
+        """feed: {name: full_batch_array}; returns mean loss (host float).
+        Splits the batch into M microbatches along axis 0 and runs 1F1B."""
+        import jax
+        import jax.numpy as jnp
+
+        self._materialize()
+        S = len(self.sections)
+        M = self.M
+        loss_name = loss_name or self.loss_name
+        if loss_name is None:
+            raise ValueError("no loss_name: pass one or use "
+                             "PipelineOptimizer.create_trainer")
+
+        micro = {}
+        for k, v in feed.items():
+            arr = np.asarray(v)
+            if arr.shape[0] % M:
+                raise ValueError(
+                    f"batch dim {arr.shape[0]} of feed {k!r} is not "
+                    f"divisible by num_microbatches={M}")
+            micro[k] = arr.reshape((M, arr.shape[0] // M) + arr.shape[1:])
+
+        self._step += 1
+        base_key = jax.random.PRNGKey(self.seed * 9973 + self._step)
+
+        # which names are produced by a section (vs. raw feeds): only these
+        # carry cotangents backward (feeds — often integer ids/labels —
+        # get float0 cotangents from jax that must not be accumulated)
+        produced = {}
+        for s in self.sections:
+            for n in s.out_names:
+                produced.setdefault(n, s.index)
+
+        grads = [jax.tree_util.tree_map(jnp.zeros_like, p)
+                 for p in self._params]
+        losses = [None] * M
+        in_flight = collections.deque()  # (mb, ins/keys/outs per section)
+
+        def forward(mb):
+            ins_all, keys, outs_all = [], [], []
+            acts = {k: jnp.asarray(micro[k][mb]) for k in micro}
+            for i, (sec, dev) in enumerate(
+                    zip(self.sections, self.devices)):
+                ins = {n: jax.device_put(acts[n], dev)
+                       for n in sec.in_names}
+                key = jax.random.fold_in(base_key, mb * 131 + i)
+                outs = self._fwd[i](self._params[i], ins, key)
+                ins_all.append(ins)
+                keys.append(key)
+                outs_all.append(outs)
+                acts.update(outs)
+            losses[mb] = acts[loss_name]
+            return ins_all, keys, outs_all
+
+        def backward(mb, ins_all, keys, outs_all):
+            # pending cotangents by name, summed over all consumers (skip
+            # connections across sections contribute additively)
+            pending = {loss_name: jnp.full_like(losses[mb], 1.0 / M)}
+            for i in range(S - 1, -1, -1):
+                sec = self.sections[i]
+                out_cot = {
+                    n: pending.get(n) if pending.get(n) is not None
+                    else jnp.zeros_like(outs_all[i][n])
+                    for n in sec.out_names}
+                pg, in_cot = self._bwd[i](self._params[i], ins_all[i],
+                                          keys[i], out_cot)
+                grads[i] = jax.tree_util.tree_map(
+                    lambda a, b: a + b, grads[i], pg)
+                for n, v in in_cot.items():
+                    if n not in produced or produced[n] >= i:
+                        continue  # feed or not an upstream activation
+                    tgt = self.devices[produced[n]]
+                    v = jax.device_put(v, tgt)
+                    pending[n] = v if pending.get(n) is None else \
+                        pending[n] + v
+
+        # 1F1B: warmup fills S in-flight microbatches, then steady-state
+        # pairs each forward with the oldest backward (section_worker.cc's
+        # fill/steady phases); live activations bounded to S microbatches
+        for mb in range(M):
+            in_flight.append((mb, *forward(mb)))
+            if len(in_flight) >= S:
+                backward(*in_flight.popleft())
+        while in_flight:
+            backward(*in_flight.popleft())
+
+        grads = self._clip_and_regularize(grads)
+        for i in range(S):
+            self._params[i], self._opt_state[i] = self.tx.update(
+                self._params[i], grads[i], self._opt_state[i])
+        self._writeback()
+        return float(np.mean([np.asarray(l) for l in losses]))
+
+    def _clip_and_regularize(self, grads):
+        """Honor the inner optimizer's regularization and grad_clip — the
+        same semantics Optimizer._apply_gradients gives the non-pipeline
+        path (regularizer grad terms, then clipping; global-norm clipping
+        uses the norm across ALL sections, not per-section)."""
+        import jax
+        import jax.numpy as jnp
+
+        from .. import nn as _nn
+
+        reg = getattr(self.inner, "_regularization", None)
+        if reg is not None:
+            grads = [
+                {n: g + jnp.asarray(reg.grad_term(p[n]), g.dtype)
+                 for n, g in gsec.items()}
+                for gsec, p in zip(grads, self._params)]
+        clip = getattr(self.inner, "_grad_clip", None)
+        if clip is None:
+            return grads
+        if isinstance(clip, _nn.ClipGradByGlobalNorm):
+            total = sum(
+                float((np.asarray(g, np.float64) ** 2).sum())
+                for gsec in grads
+                for g in jax.tree_util.tree_leaves(gsec))
+            gn = np.sqrt(total)
+            scale = min(1.0, clip.clip_norm / max(gn, 1e-12))
+            return [jax.tree_util.tree_map(
+                lambda g: (g * scale).astype(g.dtype), gsec)
+                for gsec in grads]
+        if isinstance(clip, _nn.ClipGradByNorm):
+            from ..ops import kernels as K
+
+            return [jax.tree_util.tree_map(
+                lambda g: K.clip_by_norm(g, clip.clip_norm), gsec)
+                for gsec in grads]
+        if isinstance(clip, _nn.ClipGradByValue):
+            return [jax.tree_util.tree_map(
+                lambda g: jnp.clip(g, clip.min, clip.max), gsec)
+                for gsec in grads]
+        raise NotImplementedError(
+            f"PipelineOptimizer: unsupported grad_clip "
+            f"{type(clip).__name__}")
+
+
+class PipelineOptimizer:
+    """fluid.optimizer.PipelineOptimizer parity (optimizer.py:3666).
+
+    usage:
+        opt = fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.Adam(1e-3), num_microbatches=4)
+        opt.minimize(loss)
+        trainer = opt.create_trainer(exe)   # after exe.run(startup)
+        loss_val = trainer.train_batch({"x": X, "y": Y})
+    """
+
+    def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0):
+        self._inner = optimizer
+        self.num_microbatches = int(num_microbatches)
+        self._minimized = None
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        program = loss.block.program
+        blk = program.global_block()
+        feed_names = [v.name for v in blk.vars.values() if v.is_data]
+        sections = split_program(program, loss.name, feed_names)
+        self._minimized = (program, sections, loss.name)
+        return None, []
+
+    def create_trainer(self, exe=None, scope=None, devices=None, seed=0):
+        from .executor import global_scope
+
+        if self._minimized is None:
+            raise RuntimeError("call minimize(loss) first")
+        program, sections, loss_name = self._minimized
+        return PipelineTrainer(program, sections, self._inner,
+                               scope or global_scope(),
+                               self.num_microbatches, devices=devices,
+                               seed=seed, loss_name=loss_name)

@@ -9,22 +9,49 @@ axis; one shard_map program runs the whole schedule, activations hop stages
 via ppermute over ICI, and the backward pass falls out of jax.grad (ppermute
 transposes to the reverse ring) — no worker threads, no queues.
 
+Heterogeneous first/last stages: real models are not a uniform stack —
+stage 0 ingests raw microbatches (token ids -> embeddings) and the last
+stage runs the head/loss. `first_fn`/`last_fn` express that inside the same
+SPMD program as axis_index-selected branches; the repeated transformer body
+stays a homogeneous stacked-params stage_apply, which is where the FLOPs
+are. (The fully general per-device heterogeneous program split lives in
+fluid/pipeline.py PipelineOptimizer — the device_guard path.)
+
 The stage function runs on EVERY device each tick (idle ticks compute on
 garbage and are masked out) — that is the pipeline bubble, identical in cost
 to the reference's fill/drain phases.
+
+NOTE for the fluid/static counterpart: the device_guard program splitter +
+1F1B section schedule over explicit devices is fluid/pipeline.py.
 """
 from __future__ import annotations
 
-from functools import partial
+
+def _tree_index(tree, idx):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.dynamic_index_in_dim(a, idx, 0, keepdims=False),
+        tree)
 
 
-def pipeline_spmd_fn(stage_apply, mesh=None, axis_name="pp"):
-    """Build fn(stacked_params, microbatches) -> (M, ...) outputs.
+def pipeline_spmd_fn(stage_apply, mesh=None, axis_name="pp",
+                     first_fn=None, last_fn=None):
+    """Build fn(params, microbatches) -> (M, ...) per-microbatch outputs.
 
-    stage_apply(stage_params, x) -> y applies ONE stage; activations must
-    keep one shape across stages. `stacked_params` is a pytree whose leaves
-    have a leading n_stages axis (shard it over `pp`); `microbatches` is
-    (M, mb, ...), replicated.
+    stage_apply(stage_params, x) -> y applies ONE body stage; the carried
+    activation keeps one shape across stages. Params:
+      - without first/last: params is a pytree whose leaves have a leading
+        n_stages axis (sharded over `pp`); microbatches (M, mb, ...) float
+        activations, replicated.
+      - with first_fn/last_fn: params = (stacked_stage_params, first_params,
+        last_params); microbatches may be ANY pytree with leading axis M
+        (e.g. (ids, labels)). first_fn(first_params, mb) -> x0 runs
+        (masked) on stage 0 to ingest a raw microbatch; last_fn(last_params,
+        y, mb) -> out runs (masked) on the last stage. Differentiable end to
+        end: jax.grad through the returned fn accumulates gradients over all
+        microbatches (the GPipe schedule's backward falls out of the scan +
+        ppermute transpose).
     """
     import jax
     from jax.sharding import PartitionSpec as P
@@ -33,44 +60,70 @@ def pipeline_spmd_fn(stage_apply, mesh=None, axis_name="pp"):
 
     m = mesh or get_mesh()
     n_stages = m.axis_size(axis_name)
+    has_ends = first_fn is not None or last_fn is not None
+    ffn = first_fn or (lambda fp, mb: mb)
+    lfn = last_fn or (lambda lp, y, mb: y)
+
+    def _normalize(params):
+        if has_ends:
+            stages_p, first_p, last_p = params
+        else:
+            stages_p, first_p, last_p = params, (), ()
+        return stages_p, first_p, last_p
 
     if n_stages == 1:
         def single(params, microbatches):
-            sq = jax.tree_util.tree_map(lambda a: a[0], params)
-            return jax.vmap(lambda mb: stage_apply(sq, mb))(microbatches)
+            stages_p, first_p, last_p = _normalize(params)
+            sq = jax.tree_util.tree_map(lambda a: a[0], stages_p)
+
+            def one(mb):
+                y = stage_apply(sq, ffn(first_p, mb))
+                return lfn(last_p, y, mb)
+
+            return jax.vmap(one)(microbatches)
 
         return single
 
-    def per_device(params, microbatches):
+    def per_device(stages_p, first_p, last_p, microbatches):
         import jax.numpy as jnp
 
-        stage_params = jax.tree_util.tree_map(lambda a: a[0], params)
+        stage_params = jax.tree_util.tree_map(lambda a: a[0], stages_p)
         s = jax.lax.axis_index(axis_name)
-        M = microbatches.shape[0]
-        mb_shape = microbatches.shape[1:]
+        leaves = jax.tree_util.tree_leaves(microbatches)
+        M = leaves[0].shape[0]
         fwd_perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+        mb0 = _tree_index(microbatches, 0)
+        x_shape = jax.eval_shape(ffn, first_p, mb0)
+        out_shape = jax.eval_shape(
+            lambda fp, lp, mb: lfn(
+                lp, stage_apply(stage_params, ffn(fp, mb)), mb),
+            first_p, last_p, mb0)
 
         def tick(carry, t):
             state, outputs = carry
             # stage 0 ingests microbatch t (clamped; masked later)
             idx = jnp.clip(t, 0, M - 1)
-            mb_in = jax.lax.dynamic_index_in_dim(
-                microbatches, idx, 0, keepdims=False)
-            x = jnp.where(s == 0, mb_in, state)
+            mb_in = _tree_index(microbatches, idx)
+            x0 = ffn(first_p, mb_in)
+            x = jnp.where(s == 0, x0, state)
             y = stage_apply(stage_params, x)
             # last stage emits microbatch t-(S-1) when valid
             out_t = t - (n_stages - 1)
+            ci = jnp.clip(out_t, 0, M - 1)
+            mb_out = _tree_index(microbatches, ci)
+            o = lfn(last_p, y, mb_out)
             valid = (out_t >= 0) & (out_t < M) & (s == n_stages - 1)
-            outputs = jax.lax.cond(
-                valid,
-                lambda o: jax.lax.dynamic_update_index_in_dim(
-                    o, y, jnp.clip(out_t, 0, M - 1), 0),
-                lambda o: o, outputs)
+            prev = jax.lax.dynamic_index_in_dim(outputs, ci, 0,
+                                                keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(valid, o, prev), ci, 0)
             state = jax.lax.ppermute(y, axis_name, fwd_perm)
             return (state, outputs), None
 
-        state0 = jnp.zeros(mb_shape, microbatches.dtype)
-        outputs0 = jnp.zeros((M,) + mb_shape, microbatches.dtype)
+        state0 = jnp.zeros(x_shape.shape, x_shape.dtype)
+        outputs0 = jnp.zeros((M,) + tuple(out_shape.shape),
+                             out_shape.dtype)
         (_, outputs), _ = jax.lax.scan(
             tick, (state0, outputs0), jnp.arange(M + n_stages - 1))
         # all stages agree on outputs: only the last wrote; share it
@@ -78,13 +131,16 @@ def pipeline_spmd_fn(stage_apply, mesh=None, axis_name="pp"):
         return outputs
 
     def build(params, microbatches):
+        stages_p, first_p, last_p = _normalize(params)
         in_specs = (
-            jax.tree_util.tree_map(lambda _: P(axis_name), params),
-            P(),
+            jax.tree_util.tree_map(lambda _: P(axis_name), stages_p),
+            jax.tree_util.tree_map(lambda _: P(), first_p),
+            jax.tree_util.tree_map(lambda _: P(), last_p),
+            jax.tree_util.tree_map(lambda _: P(), microbatches),
         )
         fn = shard_map(per_device, mesh=m.mesh, in_specs=in_specs,
                        out_specs=P())
-        return fn(params, microbatches)
+        return fn(stages_p, first_p, last_p, microbatches)
 
     return build
 
