@@ -237,8 +237,12 @@ def partial_grad(outputs, inputs, grad_outputs=None, retain_graph=False,
                 raise RuntimeError(
                     f"input {i} is unreachable from the given outputs; pass "
                     f"allow_unused=True to get None for it")
-    return [Tensor._wrap(g) if g is not None and not isinstance(g, Tensor)
-            else g for g in result]
+    from ..sparse import SelectedRows
+
+    # SelectedRows grads pass through AS-IS (sparse embedding weights);
+    # wrapping one in a Tensor would produce an object-dtype shell
+    return [g if g is None or isinstance(g, (Tensor, SelectedRows))
+            else Tensor._wrap(g) for g in result]
 
 
 def _accum_output_grad(node, idx, value):

@@ -166,10 +166,28 @@ class Tensor:
     def _accumulate_grad(self, raw_value):
         if self._stop_gradient:
             return
+        # sparse (SelectedRows) gradients accumulate WITHOUT densifying —
+        # GradientAccumulator's SelectedRows branch
+        # (imperative/gradient_accumulator.cc); mixed sparse+dense falls
+        # back to dense
+        from ..sparse import SelectedRows
+
+        if isinstance(raw_value, SelectedRows):
+            if raw_value.dtype != self._data.dtype:
+                raw_value = raw_value.astype(self._data.dtype)
+            if self._grad is None:
+                self._grad = raw_value
+            elif isinstance(self._grad, SelectedRows):
+                self._grad = self._grad + raw_value
+            else:
+                self._grad = Tensor._wrap(raw_value + self._grad._data)
+            return
         if raw_value.dtype != self._data.dtype:
             raw_value = raw_value.astype(self._data.dtype)
         if self._grad is None:
             self._grad = Tensor._wrap(raw_value)
+        elif isinstance(self._grad, SelectedRows):
+            self._grad = Tensor._wrap(self._grad + raw_value)
         else:
             self._grad = Tensor._wrap(self._grad._data + raw_value)
 
@@ -422,6 +440,27 @@ def _apply(op_name, fn, *tensors, n_outputs=1):
 def apply_op(op_name, fn, tensors, n_outputs=1):
     """Public entry used by the functional library (paddle_tpu.ops)."""
     return _apply(op_name, fn, *tensors, n_outputs=n_outputs)
+
+
+def apply_custom_vjp(op_name, out_raw, inputs_with_needs, vjp_fn):
+    """Record a tape node with a HAND-WRITTEN vjp (reference: ops with
+    custom GradOpMaker). `vjp_fn(ct) -> tuple of input cotangents`, which
+    may include SelectedRows for row-sparse gradients — the mechanism
+    behind F.embedding(..., sparse=True)."""
+    if not (autograd.is_grad_enabled()
+            and any(n for _, n in inputs_with_needs)):
+        return Tensor._wrap(out_raw)
+    node = autograd.Node(
+        vjp_fn=lambda cts: vjp_fn(cts[0]),
+        inputs=list(inputs_with_needs),
+        n_outputs=1,
+        op_name=op_name,
+        out_avals=[(out_raw.shape, out_raw.dtype)],
+    )
+    t = Tensor._wrap(out_raw, stop_gradient=False)
+    t._node = node
+    t._out_idx = 0
+    return t
 
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):

@@ -203,11 +203,25 @@ class Communicator:
 
     # ---------------- sync/async dense path ----------------
     def push(self, named_grads):
+        """Dense grads go to push_dense; SelectedRows grads (sparse
+        embedding backward) go straight to push_sparse with their (rows,
+        values) — never densified (parameter_send sparse path parity)."""
+        from ...sparse import SelectedRows
+
+        sparse = {n: g for n, g in named_grads.items()
+                  if isinstance(g, SelectedRows)}
+        dense = {n: g for n, g in named_grads.items() if n not in sparse}
+        for name, g in sparse.items():
+            g = g.merge()  # dedup + drop out-of-range fill rows
+            self._client_for(name).push_sparse(
+                name, np.asarray(g.rows), np.asarray(g.values))
+        if not dense:
+            return
         if self.mode == "async":
             with self._send_mu:
-                self._send_q.append(dict(named_grads))
+                self._send_q.append(dict(dense))
             return
-        for name, g in named_grads.items():
+        for name, g in dense.items():
             self._client_for(name).push_dense(name, g)
 
     def pull(self):

@@ -47,6 +47,18 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                                  shape=loss.shape, dtype=loss.dtype,
                                  stop_gradient=True)
 
+    # params fed to lookup_table ops marked is_sparse get SelectedRows-
+    # style (rows, values) gradients: the autodiff lowering diffs w.r.t.
+    # the GATHERED rows only, never materializing a [vocab, dim] gradient
+    # (lookup_table_op.cc grad with is_sparse=True; SURVEY §7 hard part 3)
+    sparse_names = []
+    for fop in block.ops[:fwd_op_count]:
+        if fop.type in ("lookup_table", "lookup_table_v2") and \
+                fop.attrs.get("is_sparse"):
+            for w in fop.input("W"):
+                if w in param_names and w not in sparse_names:
+                    sparse_names.append(w)
+
     block.append_op(
         type="jax_autodiff",
         inputs={"Loss": [loss], "Params": param_names},
@@ -55,6 +67,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         attrs={
             "loss_name": loss.name,
             "param_names": param_names,
+            "sparse_param_names": sparse_names,
             "fwd_op_count": fwd_op_count,
             "checkpoints": [c.name if isinstance(c, Variable) else c
                             for c in (checkpoints or [])],

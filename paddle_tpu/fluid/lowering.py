@@ -217,11 +217,48 @@ def _lower_jax_autodiff(ctx, op):
         if not keep[i]:
             excluded_out.update(fop.output_arg_names)
 
+    # ---- sparse (SelectedRows) params: diff w.r.t. GATHERED rows only ----
+    # For each lookup_table param marked is_sparse, collect every ids
+    # input feeding it in the traced slice, take the (static-size) unique
+    # id set, and substitute the diff variable with table[uids]. The
+    # lookup lowering reads the gathered rows via the @@SPARSE@ env entry
+    # (searchsorted on the sorted uids), so the [vocab, dim] table only
+    # ever appears under stop_gradient — its cotangent is never built.
+    jnp = _jnp()
+    sparse_names = [n for n in (op.attrs.get("sparse_param_names") or ())
+                    if n in param_names]
+    sparse_info = {}
+    for w in sparse_names:
+        ids_vals = []
+        dense_consumer = False
+        for fop in traced:
+            if fop.type in ("lookup_table", "lookup_table_v2") and \
+                    w in fop.input("W"):
+                ids_vals.append(ctx.env[fop.input("Ids")[0]].reshape(-1))
+            elif w in fop.input_arg_names:
+                # the table feeds a NON-lookup op (tied embeddings, weight
+                # sharing): the sparse substitution would zero that path's
+                # gradient — fall back to a dense grad for correctness
+                dense_consumer = True
+        if not ids_vals or dense_consumer:
+            continue
+        table = ctx.env[w]
+        V = table.shape[0]
+        ids_all = jnp.concatenate(ids_vals).astype(jnp.int32)
+        uids = jnp.unique(ids_all, size=ids_all.shape[0], fill_value=V)
+        uids = jax.lax.stop_gradient(uids)
+        sparse_info[w] = (uids, V)
+
     def loss_fn(param_vals):
         env2 = dict(base)
         env2.update({n: jax.lax.stop_gradient(ctx.env[n])
                      for n in excluded_out if n in ctx.env})
         env2.update(zip(param_names, param_vals))
+        for w in sparse_info:
+            uids, _V = sparse_info[w]
+            gathered_tr = env2[w]  # the diff value IS the gathered rows
+            env2[w] = jax.lax.stop_gradient(ctx.env[w])
+            env2["@@SPARSE@" + w] = (uids, gathered_tr)
         ctx2 = LowerCtx(env2, ctx._rng_base, training=ctx.training,
                         program=program, base_env=dict(base))
         for fop in traced:
@@ -238,9 +275,20 @@ def _lower_jax_autodiff(ctx, op):
             total = term if total is None else total + term
         return total, env2
 
-    params = [ctx.env[n] for n in param_names]
+    params = []
+    for n in param_names:
+        if n in sparse_info:
+            uids, V = sparse_info[n]
+            params.append(ctx.env[n][jnp.clip(uids, 0, V - 1)])
+        else:
+            params.append(ctx.env[n])
     (_, env_after), grads = jax.value_and_grad(
         loss_fn, has_aux=True)(params)
+    # sparse grads publish as (rows, values) pairs — the SelectedRows form
+    # optimizer-op lowerings apply row-wise (never a dense [V, D] array)
+    grads = [
+        (sparse_info[n][0], g) if n in sparse_info else g
+        for n, g in zip(param_names, grads)]
     # adopt the in-grad-trace forward values so downstream ops (optimizer,
     # fetches) see activations consistent with the grads (e.g. dropout
     # masks) — but ONLY names the traced slice writes: clobbering
@@ -806,12 +854,27 @@ def _arg_max(ctx, op):
 @register("lookup_table")
 @register("lookup_table_v2")
 def _lookup(ctx, op):
+    jnp = _jnp()
     ids = ctx.inp(op, "Ids")
-    w = ctx.inp(op, "W")
+    w_name = op.input("W")[0]
+    w = ctx.env[w_name]
     if ids.ndim >= 2 and ids.shape[-1] == 1 and op.type == "lookup_table":
         ids = ids[..., 0]
-    ctx.out(op, "Out", K.embedding(ids, w,
-                                   op.attrs.get("padding_idx", -1)))
+    pad = op.attrs.get("padding_idx", -1)
+    sub = ctx.env.get("@@SPARSE@" + w_name)
+    if sub is not None:
+        # sparse-diff substitution (jax_autodiff): rows come from the
+        # gathered differentiable slice, found by searchsorted over the
+        # sorted unique-id table — gradient flows into rows only
+        uids, gathered = sub
+        pos = jnp.searchsorted(uids, ids.astype(uids.dtype))
+        pos = jnp.clip(pos, 0, gathered.shape[0] - 1)
+        out = gathered[pos]
+        if pad is not None and pad >= 0:
+            out = out * (ids != pad)[..., None].astype(out.dtype)
+        ctx.out(op, "Out", out)
+        return
+    ctx.out(op, "Out", K.embedding(ids, w, pad))
 
 
 @register("one_hot")
@@ -830,16 +893,34 @@ def _sgd(ctx, op):
     p = ctx.inp(op, "Param")
     g = ctx.inp(op, "Grad")
     lr = ctx.inp(op, "LearningRate")
+    if isinstance(g, tuple):  # SelectedRows (rows, values): row update only
+        rows, vals = g
+        ctx.out(op, "ParamOut",
+                p.at[rows].add(-(lr * vals).astype(p.dtype), mode="drop"))
+        return
     ctx.out(op, "ParamOut", p - lr * g.astype(p.dtype))
 
 
 @register("momentum")
 def _momentum(ctx, op):
     p = ctx.inp(op, "Param")
-    g = ctx.inp(op, "Grad").astype(p.dtype)
+    g = ctx.inp(op, "Grad")
     v = ctx.inp(op, "Velocity")
     lr = ctx.inp(op, "LearningRate")
     mu = op.attrs.get("mu", 0.9)
+    if isinstance(g, tuple):  # SelectedRows: moments decay densely,
+        rows, vals = g        # grad contributes its rows (momentum_op.h)
+        vals = vals.astype(p.dtype)
+        v_new = (mu * v).at[rows].add(vals, mode="drop")
+        if op.attrs.get("use_nesterov", False):
+            p_new = (p - lr * mu * v_new).at[rows].add(
+                -(lr * vals).astype(p.dtype), mode="drop")
+        else:
+            p_new = p - lr * v_new
+        ctx.out(op, "ParamOut", p_new)
+        ctx.out(op, "VelocityOut", v_new)
+        return
+    g = g.astype(p.dtype)
     v_new = mu * v + g
     if op.attrs.get("use_nesterov", False):
         p_new = p - lr * (g + mu * v_new)
@@ -853,7 +934,7 @@ def _momentum(ctx, op):
 def _adam(ctx, op):
     jnp = _jnp()
     p = ctx.inp(op, "Param")
-    g = ctx.inp(op, "Grad").astype(p.dtype)
+    g = ctx.inp(op, "Grad")
     m = ctx.inp(op, "Moment1")
     v = ctx.inp(op, "Moment2")
     lr = ctx.inp(op, "LearningRate")
@@ -862,8 +943,17 @@ def _adam(ctx, op):
     b1 = op.attrs.get("beta1", 0.9)
     b2 = op.attrs.get("beta2", 0.999)
     eps = op.attrs.get("epsilon", 1e-8)
-    m_new = b1 * m + (1 - b1) * g
-    v_new = b2 * v + (1 - b2) * g * g
+    if isinstance(g, tuple):
+        # SelectedRows sparse adam (adam_op.h SparseAdamFunctor): moments
+        # decay everywhere, grad adds on its rows
+        rows, vals = g
+        vals = vals.astype(p.dtype)
+        m_new = (b1 * m).at[rows].add((1 - b1) * vals, mode="drop")
+        v_new = (b2 * v).at[rows].add((1 - b2) * vals * vals, mode="drop")
+    else:
+        g = g.astype(p.dtype)
+        m_new = b1 * m + (1 - b1) * g
+        v_new = b2 * v + (1 - b2) * g * g
     lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
     p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
     ctx.out(op, "ParamOut", p_new)

@@ -451,8 +451,43 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    # the SelectedRows cotangent can only terminate at a LEAF weight; a
+    # derived weight (amp cast, scale, slice) needs a dense cotangent to
+    # flow upstream, so fall back to the dense path (the reference raises
+    # for non-parameter sparse lookups; densifying is strictly safer)
+    if sparse and weight.is_leaf:
+        return _sparse_embedding(x, weight, padding_idx)
     return _op("embedding",
                lambda ids, w: K.embedding(ids, w, padding_idx), x, weight)
+
+
+def _sparse_embedding(x, weight, padding_idx):
+    """is_sparse=True lookup (lookup_table_op.cc grad with SelectedRows):
+    the tape records a custom vjp whose weight cotangent is
+    SelectedRows(rows=ids, values=output grads) — no [vocab, dim] dense
+    gradient is ever built."""
+    import jax.numpy as jnp
+
+    from ...core.tensor import apply_custom_vjp
+    from ...sparse import SelectedRows
+
+    ids_raw = x._data
+    w_raw = weight._data
+    out = K.embedding(ids_raw, w_raw, padding_idx)
+    V = int(w_raw.shape[0])
+
+    def vjp(ct):
+        flat_ids = ids_raw.reshape(-1)
+        vals = ct.reshape((-1,) + tuple(w_raw.shape[1:]))
+        if padding_idx is not None and padding_idx >= 0:
+            # padding rows receive no gradient: route them out of range so
+            # merge()/to_dense() (mode='drop') discard them
+            flat_ids = jnp.where(flat_ids == padding_idx, V, flat_ids)
+        return (None, SelectedRows(flat_ids, vals, V))
+
+    return apply_custom_vjp(
+        "embedding_sparse_grad", out,
+        [(x, False), (weight, not weight.stop_gradient)], vjp)
 
 
 def one_hot(x, num_classes, name=None):

@@ -15,6 +15,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Parameter
+from ..sparse import SelectedRows
 from . import lr as lr_sched
 from .lr import LRScheduler
 
@@ -126,6 +127,10 @@ class Optimizer:
                 continue
             pg.append((p, g))
         if self._grad_clip is not None:
+            if any(isinstance(g, SelectedRows) for _, g in pg):
+                raise NotImplementedError(
+                    "grad_clip over sparse (SelectedRows) gradients is not "
+                    "supported; clip densely or drop the clip")
             pg = self._grad_clip(pg)
         return pg
 
@@ -140,10 +145,24 @@ class Optimizer:
     def step(self):
         self._step_count += 1
         for p, g in self._collect():
-            self._update_param(p, g)
+            if isinstance(g, SelectedRows):
+                if self._decay_value(p):
+                    raise ValueError(
+                        "weight_decay/regularization is not supported with "
+                        "sparse (SelectedRows) gradients — reference "
+                        "lookup_table is_sparse=True has the same "
+                        "restriction")
+                self._update_param_sparse(p, g)
+            else:
+                self._update_param(p, g)
 
     def _update_param(self, p, g):
         raise NotImplementedError
+
+    def _update_param_sparse(self, p, g):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no sparse (SelectedRows) update "
+            f"rule; use SGD/Momentum/Adam for sparse embedding training")
 
     @property
     def _parameter_list(self):
@@ -210,6 +229,52 @@ def _lamb_rule(p, g, m, v, lrv, b1, b2, eps, t, wd):
     return p - lrv * trust * r, m_new, v_new
 
 
+# -------------------- sparse (SelectedRows) rules --------------------
+# Reference: the SelectedRows kernels of sgd_op.h / momentum_op.h /
+# adam_op.h — moments decay over all rows, the gradient contributes only
+# its rows, and lazy_mode restricts the whole update to touched rows.
+
+def _sgd_sparse_rule(p, rows, vals, lrv):
+    return p.at[rows].add(-(lrv * vals).astype(p.dtype), mode="drop")
+
+
+def _momentum_sparse_rule(p, rows, vals, vel, lrv, mu, use_nesterov):
+    v_new = (mu * vel).at[rows].add(vals, mode="drop")
+    if use_nesterov:
+        # p -= lr * (g + mu*v_new): dense mu*v_new part + sparse g part
+        p_new = (p - lrv * mu * v_new).at[rows].add(
+            -(lrv * vals).astype(p.dtype), mode="drop")
+    else:
+        p_new = p - lrv * v_new
+    return p_new.astype(p.dtype), v_new
+
+
+def _adam_sparse_rule(p, rows, vals, m, v, lrv, b1, b2, eps, t):
+    """Non-lazy sparse adam (adam_op.h SparseAdamFunctor, mode='global'):
+    moments decay everywhere, grad adds on its rows, every row's param
+    moves by the bias-corrected moment."""
+    jnp = _jnp()
+    m_new = (b1 * m).at[rows].add((1 - b1) * vals, mode="drop")
+    v_new = (b2 * v).at[rows].add((1 - b2) * vals * vals, mode="drop")
+    mhat = m_new / (1 - b1 ** t)
+    vhat = v_new / (1 - b2 ** t)
+    return (p - lrv * mhat / (jnp.sqrt(vhat) + eps)).astype(p.dtype), \
+        m_new, v_new
+
+
+def _adam_sparse_lazy_rule(p, rows, vals, m, v, lrv, b1, b2, eps, t):
+    """lazy_mode=True: only touched rows update (rows pre-merged)."""
+    jnp = _jnp()
+    m_r = b1 * m[rows] + (1 - b1) * vals
+    v_r = b2 * v[rows] + (1 - b2) * vals * vals
+    mhat = m_r / (1 - b1 ** t)
+    vhat = v_r / (1 - b2 ** t)
+    upd = -(lrv * mhat / (jnp.sqrt(vhat) + eps)).astype(p.dtype)
+    return (p.at[rows].add(upd, mode="drop"),
+            m.at[rows].set(m_r, mode="drop"),
+            v.at[rows].set(v_r, mode="drop"))
+
+
 class SGD(Optimizer):
     def __init__(self, learning_rate=0.001, parameters=None,
                  weight_decay=None, grad_clip=None, name=None):
@@ -219,6 +284,11 @@ class SGD(Optimizer):
         fn = _jitted(_sgd_rule)
         p._data = fn(p._data, g._data.astype(p._data.dtype),
                      self._lr_for(p), self._decay_value(p))
+
+    def _update_param_sparse(self, p, g):
+        fn = _jitted(_sgd_sparse_rule)
+        p._data = fn(p._data, g.rows, g.values.astype(p._data.dtype),
+                     self._lr_for(p))
 
 
 class Momentum(Optimizer):
@@ -237,6 +307,14 @@ class Momentum(Optimizer):
             p._data, g._data.astype(p._data.dtype), slots["velocity"],
             self._lr_for(p), self._momentum, self._decay_value(p))
 
+    def _update_param_sparse(self, p, g):
+        slots = self._slots(p, {"velocity": "zeros_like"})
+        fn = _instance_jit(self, "_jit_sparse", lambda: functools.partial(
+            _momentum_sparse_rule, use_nesterov=self._use_nesterov))
+        p._data, slots["velocity"] = fn(
+            p._data, g.rows, g.values.astype(p._data.dtype),
+            slots["velocity"], self._lr_for(p), self._momentum)
+
 
 class Adam(Optimizer):
     _decoupled_wd = False
@@ -247,6 +325,7 @@ class Adam(Optimizer):
                  name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lazy_mode = bool(lazy_mode)
 
     def _update_param(self, p, g):
         slots = self._slots(p, {"moment1": "zeros_like",
@@ -257,6 +336,22 @@ class Adam(Optimizer):
             p._data, g._data.astype(p._data.dtype), slots["moment1"],
             slots["moment2"], self._lr_for(p), self._beta1, self._beta2,
             self._eps, float(self._step_count), self._decay_value(p))
+
+    def _update_param_sparse(self, p, g):
+        slots = self._slots(p, {"moment1": "zeros_like",
+                                "moment2": "zeros_like"})
+        # adam is non-linear in g (g*g): duplicate rows MUST merge first
+        # (adam_op.h runs scatter::MergeAdd before the sparse functor);
+        # shape_stable keeps the row count static so the jitted rule
+        # compiles once, not once per distinct nnz
+        g = g.merge(shape_stable=True)
+        rule = _adam_sparse_lazy_rule if self._lazy_mode else \
+            _adam_sparse_rule
+        fn = _jitted(rule)
+        p._data, slots["moment1"], slots["moment2"] = fn(
+            p._data, g.rows, g.values.astype(p._data.dtype),
+            slots["moment1"], slots["moment2"], self._lr_for(p),
+            self._beta1, self._beta2, self._eps, float(self._step_count))
 
 
 class AdamW(Adam):

@@ -1,10 +1,14 @@
-"""Sparse support.
+"""Sparse support: SelectedRows gradients + COO/CSR tensors.
 
 Reference parity: framework/selected_rows.h:32 — SelectedRows {rows, value}
-used for embedding gradients. TPU-native design (SURVEY.md §7 hard part 3):
-XLA has no sparse tensors; SelectedRows is a host-side (indices, values)
-pair whose reduction lowers to segment-sum. Provided for API parity and for
-the parameter-server sparse path.
+used for embedding gradients (lookup_table_op.cc grad with is_sparse=True,
+operators/math/selected_rows_functor.h MergeAdd). TPU-native design
+(SURVEY.md §7 hard part 3): XLA has no sparse tensors; SelectedRows is an
+(indices, values) pair whose reduction lowers to segment-sum/scatter-add,
+so a 30M-row vocab never materializes a dense gradient. The eager tape
+emits SelectedRows from `F.embedding(..., sparse=True)`; optimizers apply
+row-wise updates; the PS client pushes (rows, values) directly
+(large_scale_kv.h:762 capability).
 """
 from __future__ import annotations
 
@@ -13,25 +17,55 @@ import numpy as np
 from ..core.tensor import Tensor
 
 
+def _raw(v):
+    return v._data if isinstance(v, Tensor) else v
+
+
 class SelectedRows:
+    """{rows: int32[n], values: [n, ...], height: V} — row-sparse tensor.
+
+    Rows may repeat; `merge()` sums duplicates (MergeAdd parity). Supports
+    `+` with another SelectedRows (concat — GradientAccumulator semantics
+    for sparse grads) or with a dense array (densifies).
+    """
+
     def __init__(self, rows, values, height):
         import jax.numpy as jnp
 
-        self.rows = jnp.asarray(rows, dtype=jnp.int32)
-        self.values = values._data if isinstance(values, Tensor) else values
+        self.rows = jnp.asarray(_raw(rows)).astype(jnp.int32).reshape(-1)
+        self.values = _raw(values)
         self.height = int(height)
 
-    def to_dense(self):
-        import jax
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
 
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.values.astype(dtype),
+                            self.height)
+
+    def to_dense(self):
         import jax.numpy as jnp
 
         dense = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
                           self.values.dtype)
-        return Tensor._wrap(dense.at[self.rows].add(self.values))
+        # mode='drop': out-of-range rows (e.g. unique() fill values) vanish
+        return Tensor._wrap(dense.at[self.rows].add(self.values,
+                                                    mode="drop"))
 
-    def merge(self):
-        """Merge duplicate rows (selected_rows_functor MergeAdd parity)."""
+    def merge(self, shape_stable=False):
+        """Merge duplicate rows (selected_rows_functor MergeAdd parity).
+
+        shape_stable=True keeps the fixed-size unique output (padded with
+        out-of-range fill rows = height, zero values) — jit-friendly: no
+        host sync, no recompile per distinct nnz; consumers must use
+        mode='drop' scatters, which all sparse optimizer rules do.
+        shape_stable=False filters the fill rows on the host (exact nnz,
+        for host-side consumers like the PS push)."""
         import jax
 
         import jax.numpy as jnp
@@ -40,10 +74,122 @@ class SelectedRows:
                                size=self.rows.shape[0],
                                fill_value=self.height)
         merged = jax.ops.segment_sum(self.values, inv, uniq.shape[0])
-        keep = uniq < self.height
-        return SelectedRows(np.asarray(uniq)[np.asarray(keep)],
+        if shape_stable:
+            return SelectedRows(uniq, merged, self.height)
+        keep = np.asarray(uniq) < self.height
+        return SelectedRows(np.asarray(uniq)[keep],
                             merged[np.asarray(keep)], self.height)
+
+    def __add__(self, other):
+        import jax.numpy as jnp
+
+        if isinstance(other, SelectedRows):
+            if other.height != self.height:
+                raise ValueError("SelectedRows height mismatch")
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]), self.height)
+        # dense + sparse -> dense
+        dense = _raw(other)
+        return dense.at[self.rows].add(self.values.astype(dense.dtype),
+                                       mode="drop")
+
+    __radd__ = __add__
+
+    def numpy(self):
+        return np.asarray(self.to_dense())
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nnz_rows={self.rows.shape[0]}, "
+                f"value_shape={tuple(self.values.shape)})")
+
+
+class SparseCooTensor:
+    """paddle.sparse COO tensor (paddle 2.x incubate.sparse parity):
+    indices [ndim, nnz] int64, values [nnz, ...dense_dims], shape."""
+
+    def __init__(self, indices, values, shape):
+        import jax.numpy as jnp
+
+        self.indices = jnp.asarray(_raw(indices)).astype(jnp.int64)
+        self.values = jnp.asarray(_raw(values))
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def nnz(self):
+        return int(self.values.shape[0])
+
+    def to_dense(self):
+        import jax.numpy as jnp
+
+        sd = self.indices.shape[0]
+        dense = jnp.zeros(self._shape[:sd] + tuple(self.values.shape[1:]),
+                          self.values.dtype)
+        idx = tuple(self.indices[d] for d in range(sd))
+        return Tensor._wrap(dense.at[idx].add(self.values))
+
+    def coalesce(self):
+        """Sum duplicate coordinates."""
+        import jax
+
+        import jax.numpy as jnp
+
+        sd = self.indices.shape[0]
+        strides = [int(np.prod(self._shape[d + 1:sd], dtype=np.int64))
+                   for d in range(sd)]
+        flat = sum(self.indices[d] * int(strides[d]) for d in range(sd))
+        uniq, inv = jnp.unique(flat, return_inverse=True,
+                               size=flat.shape[0], fill_value=-1)
+        vals = jax.ops.segment_sum(self.values, inv, uniq.shape[0])
+        keep = np.asarray(uniq) >= 0
+        uniq_k = np.asarray(uniq)[keep]
+        coords = []
+        rem = uniq_k
+        for d in range(sd):
+            coords.append(rem // int(strides[d]))
+            rem = rem % int(strides[d])
+        return SparseCooTensor(np.stack(coords), vals[np.asarray(keep)],
+                               self._shape)
+
+    def __repr__(self):
+        return f"SparseCooTensor(shape={self._shape}, nnz={self.nnz()})"
 
 
 def sparse_coo_tensor(indices, values, shape, dtype=None):
-    raise NotImplementedError("COO tensors land with the sparse op set")
+    """paddle.sparse.sparse_coo_tensor parity."""
+    v = np.asarray(_raw(values))
+    if dtype is not None:
+        from ..core.dtypes import convert_dtype
+
+        v = v.astype(convert_dtype(dtype))
+    return SparseCooTensor(np.asarray(_raw(indices)), v, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
+    """CSR expressed over the COO core (2-D only)."""
+    crows = np.asarray(_raw(crows)).astype(np.int64)
+    cols = np.asarray(_raw(cols)).astype(np.int64)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    return sparse_coo_tensor(np.stack([rows, cols]), values, shape, dtype)
+
+
+def matmul(sp, dense):
+    """COO (2-D) @ dense via segment-sum — the XLA-native SpMM."""
+    import jax
+
+    d = _raw(dense)
+    if isinstance(sp, SparseCooTensor):
+        rows, cols = sp.indices[0], sp.indices[1]
+        contrib = sp.values[:, None] * d[cols]
+        out = jax.ops.segment_sum(contrib, rows.astype(np.int32),
+                                  sp._shape[0])
+        return Tensor._wrap(out)
+    raise TypeError(f"matmul expects SparseCooTensor, got {type(sp)}")

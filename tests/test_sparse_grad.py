@@ -1,0 +1,279 @@
+"""Sparse (SelectedRows) embedding-gradient path, end to end.
+
+Reference analogue: lookup_table_op grad with is_sparse=True
+(selected_rows.h:32, selected_rows_functor.h MergeAdd, adam_op.h
+SparseAdamFunctor, test_lookup_table_op / test_adam_op sparse cases).
+Covers: eager tape emits SelectedRows; accumulation; optimizer sparse
+rules match their dense counterparts; static jax_autodiff produces
+(rows, values) grads with NO dense [V, D] gradient in the program; the
+PS client pushes SelectedRows directly; COO tensors.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+from paddle_tpu.sparse import (SelectedRows, SparseCooTensor, matmul,
+                               sparse_coo_tensor, sparse_csr_tensor)
+
+
+def test_eager_sparse_embedding_grad_is_selected_rows():
+    V, D = 50, 8
+    w = paddle.to_tensor(
+        np.random.RandomState(0).randn(V, D).astype("float32"),
+        stop_gradient=False)
+    ids = paddle.to_tensor(np.array([[1, 3], [3, 7]], dtype="int64"))
+    out = F.embedding(ids, w, sparse=True)
+    out.backward()
+    g = w.grad
+    assert isinstance(g, SelectedRows)
+    assert g.height == V
+    assert g.rows.shape[0] == 4  # one row per looked-up id
+    # dense equivalence: same grads as the dense path
+    w2 = paddle.to_tensor(np.asarray(w._data), stop_gradient=False)
+    out2 = F.embedding(ids, w2, sparse=False)
+    out2.backward()
+    np.testing.assert_allclose(np.asarray(g.to_dense()),
+                               np.asarray(w2.grad._data), rtol=1e-6)
+
+
+def test_eager_sparse_accumulation_and_padding_idx():
+    V, D = 20, 4
+    w = paddle.to_tensor(np.ones((V, D), "float32"), stop_gradient=False)
+    ids = paddle.to_tensor(np.array([0, 2, 2, 5], dtype="int64"))
+    out = F.embedding(ids, w, padding_idx=0, sparse=True)
+    out.sum().backward()
+    # second backward pass accumulates (concat) without densifying
+    out2 = F.embedding(ids, w, padding_idx=0, sparse=True)
+    out2.sum().backward()
+    g = w.grad
+    assert isinstance(g, SelectedRows)
+    dense = np.asarray(g.to_dense())
+    assert np.all(dense[0] == 0)        # padding_idx row gets no grad
+    np.testing.assert_allclose(dense[2], 4.0)  # 2 lookups x 2 passes
+    np.testing.assert_allclose(dense[5], 2.0)
+
+
+@pytest.mark.parametrize("opt_cls,kw", [
+    (paddle.optimizer.SGD, {}),
+    (paddle.optimizer.Momentum, {"momentum": 0.9}),
+    (paddle.optimizer.Adam, {}),
+    (paddle.optimizer.Adam, {"lazy_mode": True}),
+])
+def test_sparse_optimizer_matches_dense(opt_cls, kw):
+    """Sparse update == dense update with the equivalent dense grad
+    (for lazy adam: equality on touched rows, untouched rows frozen)."""
+    V, D = 30, 6
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(V, D).astype("float32")
+    ids = np.array([[2, 9, 2], [17, 9, 4]], dtype="int64")
+
+    def train(sparse, lazy_skip=False):
+        emb = nn.Embedding(V, D, sparse=sparse)
+        with paddle.no_grad():
+            emb.weight.set_value(paddle.to_tensor(w0))
+        opt = opt_cls(learning_rate=0.1, parameters=emb.parameters(), **kw)
+        for _ in range(3):
+            y = emb(paddle.to_tensor(ids))
+            (y * y).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        return np.asarray(emb.weight._data)
+
+    w_sparse = train(True)
+    w_dense = train(False)
+    touched = np.unique(ids)
+    if kw.get("lazy_mode"):
+        untouched = np.setdiff1d(np.arange(V), touched)
+        # lazy: untouched rows NEVER move
+        np.testing.assert_allclose(w_sparse[untouched], w0[untouched])
+        # dense adam moves untouched rows via bias correction -> only
+        # compare touched rows loosely
+        np.testing.assert_allclose(w_sparse[touched], w_dense[touched],
+                                   rtol=1e-3, atol=1e-4)
+    else:
+        np.testing.assert_allclose(w_sparse, w_dense, rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_eager_big_vocab_trains_without_dense_grad():
+    """A vocab too big to take a dense grad per step comfortably: grads
+    stay (rows, values) and only touched rows change."""
+    V, D = 200_000, 16
+    emb = nn.Embedding(V, D, sparse=True)
+    opt = paddle.optimizer.Adam(0.05, parameters=emb.parameters(),
+                                lazy_mode=True)
+    before = np.asarray(emb.weight._data[:100]).copy()
+    ids = paddle.to_tensor(np.array([5, 77, 123456], dtype="int64"))
+    loss = (emb(ids) ** 2).sum()
+    loss.backward()
+    assert isinstance(emb.weight.grad, SelectedRows)
+    assert emb.weight.grad.values.shape == (3, D)
+    opt.step()
+    after = np.asarray(emb.weight._data[:100])
+    moved = np.abs(after - before).sum(axis=1) > 0
+    assert moved[5] and moved[77]
+    assert not moved[6] and not moved[0]
+
+
+def test_static_sparse_grad_is_rows_values():
+    """is_sparse=True static program: W@GRAD is a (rows, values) pair, the
+    optimizer applies it row-wise, and training matches the dense-grad
+    version of the same program."""
+    V, D = 40, 8
+    rng = np.random.RandomState(2)
+    ids_batch = rng.randint(0, V, size=(6, 4, 3, 1)).astype("int64")
+    # learnable target: a fixed per-id value
+    table = (rng.randn(V) * 0.5).astype("float32")
+    y_batch = table[ids_batch[..., 0]][..., None]
+
+    def build(is_sparse):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", shape=[3, 1], dtype="int64")
+            y = fluid.layers.data("y", shape=[3, 1], dtype="float32")
+            emb = fluid.layers.embedding(ids, size=[V, D],
+                                         is_sparse=is_sparse)
+            pred = fluid.layers.fc(emb, size=1, num_flatten_dims=2)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        return main, startup, loss
+
+    losses = {}
+    snapshot = None
+    for is_sparse in (False, True):
+        main, startup, loss = build(is_sparse)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            if snapshot is None:  # identical init for both runs (names
+                # match thanks to unique_name.guard around each build)
+                snapshot = {k: np.asarray(v)
+                            for k, v in scope._values.items()
+                            if v is not None}
+            else:
+                for k, v in snapshot.items():
+                    scope.set_value(k, v)
+            ls = []
+            for step in range(24):
+                i = step % 6
+                ls.append(float(exe.run(
+                    main, {"ids": ids_batch[i], "y": y_batch[i]},
+                    [loss])[0]))
+            losses[is_sparse] = ls
+    # same program semantics regardless of grad representation
+    np.testing.assert_allclose(losses[True], losses[False], rtol=2e-3,
+                               atol=1e-4)
+    assert np.mean(losses[True][-6:]) < np.mean(losses[True][:6]) * 0.8
+
+
+def test_communicator_pushes_selected_rows(tmp_path):
+    """PS push path: a SelectedRows grad goes out via push_sparse and the
+    server applies the row update (sgd)."""
+    from paddle_tpu.distributed.ps import Communicator, PsServer
+
+    srv = PsServer(port=0, trainers=1, optimizer="sgd", lr=1.0)
+    try:
+        comm = Communicator([f"127.0.0.1:{srv.port}"], mode="sync",
+                            trainer_id=0)
+        client = comm.clients[0]
+        D = 4
+        rows0 = client.pull_sparse("emb", np.array([3, 8], np.int64), D)
+        g = SelectedRows(np.array([3, 3, 8]),
+                         np.ones((3, D), np.float32), 100)
+        comm.push({"emb": g})
+        rows1 = client.pull_sparse("emb", np.array([3, 8], np.int64), D)
+        # server sparse rule is adagrad: delta = lr * g / sqrt(sum g^2).
+        # Duplicate rows MERGED before push -> row 3 sees ONE grad of 2
+        # (delta 2/sqrt(4) = 1), not two grads of 1 (delta 1.707)
+        np.testing.assert_allclose(rows0[0] - rows1[0], 1.0, atol=1e-5)
+        np.testing.assert_allclose(rows0[1] - rows1[1], 1.0, atol=1e-5)
+    finally:
+        srv.stop()
+
+
+def test_sparse_coo_tensor_ops():
+    idx = np.array([[0, 1, 1], [2, 0, 2]])
+    vals = np.array([1.0, 2.0, 3.0], "float32")
+    t = sparse_coo_tensor(idx, vals, [2, 3])
+    dense = np.asarray(t.to_dense())
+    want = np.array([[0, 0, 1], [2, 0, 3]], "float32")
+    np.testing.assert_allclose(dense, want)
+    # duplicate coords sum on coalesce
+    t2 = sparse_coo_tensor(np.array([[0, 0], [1, 1]]),
+                           np.array([1.0, 5.0], "float32"), [2, 2])
+    c = t2.coalesce()
+    assert c.nnz() == 1
+    np.testing.assert_allclose(np.asarray(c.to_dense())[0, 1], 6.0)
+    # CSR roundtrip
+    csr = sparse_csr_tensor([0, 1, 3], [2, 0, 2], vals, [2, 3])
+    np.testing.assert_allclose(np.asarray(csr.to_dense()), want)
+    # SpMM
+    d = np.random.RandomState(3).randn(3, 5).astype("float32")
+    out = np.asarray(matmul(t, d))
+    np.testing.assert_allclose(out, want @ d, rtol=1e-5)
+
+
+def test_paddle_grad_returns_selected_rows():
+    V, D = 12, 4
+    w = paddle.to_tensor(np.ones((V, D), "float32"), stop_gradient=False)
+    ids = paddle.to_tensor(np.array([1, 1, 7], dtype="int64"))
+    out = F.embedding(ids, w, sparse=True)
+    (g,) = paddle.grad([out.sum()], [w])
+    assert isinstance(g, SelectedRows)
+    dense = np.asarray(g.to_dense())
+    np.testing.assert_allclose(dense[1], 2.0)
+    np.testing.assert_allclose(dense[7], 1.0)
+
+
+def test_sparse_embedding_nonleaf_weight_falls_back_dense():
+    """A derived (non-leaf) weight cannot take a SelectedRows cotangent;
+    the sparse flag silently downgrades to the dense path instead of
+    crashing backward."""
+    V, D = 10, 3
+    w = paddle.to_tensor(np.ones((V, D), "float32"), stop_gradient=False)
+    scaled = w * 2.0
+    ids = paddle.to_tensor(np.array([0, 4], dtype="int64"))
+    out = F.embedding(ids, scaled, sparse=True)
+    out.sum().backward()
+    g = w.grad
+    assert not isinstance(g, SelectedRows)
+    dense = np.asarray(g._data)
+    np.testing.assert_allclose(dense[0], 2.0)
+    np.testing.assert_allclose(dense[4], 2.0)
+    np.testing.assert_allclose(dense[1], 0.0)
+
+
+def test_static_sparse_tied_table_falls_back_dense():
+    """is_sparse=True table that ALSO feeds a non-lookup op (tied
+    weights): the autodiff must keep the dense grad so the second path
+    contributes (sparse substitution would silently zero it)."""
+    V, D = 15, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[2, 1], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[V, D], is_sparse=True)
+        blk = main.global_block()
+        # tied consumer: mean over the whole table enters the loss
+        w_name = [op.input("W")[0] for op in blk.ops
+                  if op.type == "lookup_table"][0]
+        w_var = blk.var(w_name)
+        table_term = fluid.layers.reduce_mean(w_var)
+        loss = fluid.layers.reduce_mean(emb) + table_term
+        fluid.optimizer.SGD(1.0).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w0 = np.asarray(scope.get_value(w_name)).copy()
+        exe.run(main, {"ids": np.array([[[1], [2]]], dtype="int64")},
+                [loss])
+        w1 = np.asarray(scope.get_value(w_name))
+    # the tied mean term moves EVERY row (by lr * 1/(V*D)); untouched
+    # rows must move too — proof the dense fallback kicked in
+    untouched_moved = np.abs(w1[9] - w0[9]).max()
+    assert untouched_moved > 1e-5, untouched_moved
