@@ -1,27 +1,37 @@
-"""Headline benchmark: ERNIE-base fine-tune train-step throughput, one chip
-(BASELINE.md config 3). Prints ONE JSON line.
+"""Benchmarks for the BASELINE.md configs, single chip.
 
-vs_baseline is measured against a provisional 300 seq/s target — the
-paddlepaddle-gpu BERT/ERNIE-base fp16 fine-tune (seq_len 128) per-V100-chip
-class the north star asks us to match (BASELINE.json: no published numbers
-exist in the reference repo, so the target is recorded here and refined as
-real reference runs land).
+Prints ONE JSON line (the headline ERNIE-base fine-tune throughput,
+config 3) to stdout; every config's result is also written to
+BENCH_DETAILS.json and echoed to stderr:
+
+  1. fluid static-graph MNIST (LeNet, whole-block XLA Executor)  imgs/s
+  2. paddle.vision ResNet-50 (dygraph functionalized, bf16)      imgs/s
+  3. ERNIE-base fine-tune (static + flash attention, bf16)       seq/s
+  5. CTR-DNN with async native PS + SelectedRows sparse push     ex/s
+
+Config 4 (multi-chip allreduce scaling) needs >1 real chip and records
+as skipped here; the 8-device CPU dryrun (__graft_entry__) validates its
+code path.
+
+vs_baseline for the headline is measured against a provisional 300 seq/s
+target — the paddlepaddle-gpu BERT-base fp16 fine-tune per-V100-chip
+class the north star asks us to match (BASELINE.json has no published
+numbers; see BASELINE.md).
 """
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
 
 TARGET_SEQ_PER_SEC = 300.0
 
-BATCH = 32
-SEQ_LEN = 128
 STEPS = 50
 
 
-def main():
+def _ernie(batch=32, seq_len=128, steps=STEPS, layers=12, hidden=768, heads=12, inter=3072):
     import jax
 
     import paddle_tpu  # noqa: F401
@@ -29,11 +39,12 @@ def main():
     from paddle_tpu.parallel import SpmdTrainer, init_mesh
     from paddle_tpu.text import ErnieConfig, ErnieForSequenceClassification
 
+    BATCH, SEQ_LEN = batch, seq_len
     dev = jax.devices()[0]
     mesh = init_mesh(dp=1, devices=[dev])
-
-    cfg = ErnieConfig(vocab_size=30522, hidden_size=768, num_layers=12,
-                      num_heads=12, intermediate_size=3072,
+    cfg = ErnieConfig(vocab_size=30522, hidden_size=hidden,
+                      num_layers=layers, num_heads=heads,
+                      intermediate_size=inter,
                       max_position=SEQ_LEN + 2, hidden_dropout=0.1,
                       num_classes=2)
     net = ErnieForSequenceClassification(cfg)
@@ -46,29 +57,181 @@ def main():
 
     tr = SpmdTrainer(net, ce, fopt.adamw(5e-5), mesh=mesh,
                      compute_dtype="bfloat16")
-
     rs = np.random.RandomState(0)
     ids = rs.randint(1, cfg.vocab_size, (BATCH, SEQ_LEN)).astype(np.int64)
     labels = rs.randint(0, 2, (BATCH,)).astype(np.int64)
     key = jax.random.PRNGKey(0)
-
-    # one jitted multi-step loop (lax.scan): a single dispatch covers all
-    # STEPS, and the final float() host readback bounds completion — robust
-    # against async-dispatch runtimes under-reporting time.
-    float(tr.run_steps((ids,), labels, STEPS, rng=key))  # compile + warm
-
+    # one jitted multi-step lax.scan; the float() readback bounds
+    # completion (async-dispatch runtimes under-report otherwise)
+    float(tr.run_steps((ids,), labels, steps, rng=key))  # compile + warm
     t0 = time.perf_counter()
-    lf = float(tr.run_steps((ids,), labels, STEPS, rng=key))
+    lf = float(tr.run_steps((ids,), labels, steps, rng=key))
     dt = time.perf_counter() - t0
-    assert lf == lf, "training produced NaN loss"
+    assert lf == lf, "ERNIE produced NaN loss"
+    v = BATCH * steps / dt
+    return {"metric": "ernie_base_finetune_seq_per_sec_per_chip",
+            "value": round(v, 2), "unit": "seq/s",
+            "vs_baseline": round(v / TARGET_SEQ_PER_SEC, 3)}
 
-    seq_per_sec = BATCH * STEPS / dt
-    print(json.dumps({
-        "metric": "ernie_base_finetune_seq_per_sec_per_chip",
-        "value": round(seq_per_sec, 2),
-        "unit": "seq/s",
-        "vs_baseline": round(seq_per_sec / TARGET_SEQ_PER_SEC, 3),
-    }))
+
+def _resnet50(batch=32, img=224, steps=20):
+    import jax
+
+    from paddle_tpu.optimizer import functional as fopt
+    from paddle_tpu.parallel import SpmdTrainer, init_mesh
+    from paddle_tpu.vision.models import resnet50
+
+    BATCH, IMG = batch, img
+    mesh = init_mesh(dp=1, devices=[jax.devices()[0]])
+    net = resnet50(num_classes=1000)
+
+    def ce(logits, labels):
+        import jax.numpy as jnp
+
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        return -jnp.take_along_axis(lp, labels[:, None], -1).mean()
+
+    tr = SpmdTrainer(net, ce, fopt.momentum(0.1, 0.9), mesh=mesh,
+                     compute_dtype="bfloat16")
+    rs = np.random.RandomState(0)
+    imgs = rs.randn(BATCH, 3, IMG, IMG).astype(np.float32)
+    labels = rs.randint(0, 1000, (BATCH,)).astype(np.int64)
+    key = jax.random.PRNGKey(0)
+    float(tr.run_steps((imgs,), labels, steps, rng=key))
+    t0 = time.perf_counter()
+    lf = float(tr.run_steps((imgs,), labels, steps, rng=key))
+    dt = time.perf_counter() - t0
+    assert lf == lf, "ResNet produced NaN loss"
+    v = BATCH * steps / dt
+    # reference class: paddlepaddle-gpu ResNet-50 fp16 ~780 imgs/s/V100
+    return {"metric": "resnet50_train_imgs_per_sec_per_chip",
+            "value": round(v, 2), "unit": "imgs/s",
+            "vs_baseline": round(v / 780.0, 3)}
+
+
+def _mnist_static(batch=256, steps=100):
+    import paddle_tpu.fluid as fluid
+
+    BATCH = batch
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[1, 28, 28], dtype="float32")
+        lbl = fluid.layers.data("lbl", shape=[1], dtype="int64")
+        c1 = fluid.layers.conv2d(img, 6, 5, padding=2, act="relu")
+        p1 = fluid.layers.pool2d(c1, 2, "max", 2)
+        c2 = fluid.layers.conv2d(p1, 16, 5, act="relu")
+        p2 = fluid.layers.pool2d(c2, 2, "max", 2)
+        f1 = fluid.layers.fc(p2, 120, act="relu")
+        f2 = fluid.layers.fc(f1, 84, act="relu")
+        logits = fluid.layers.fc(f2, 10)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, lbl))
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    img_b = rs.randn(BATCH, 1, 28, 28).astype(np.float32)
+    lbl_b = rs.randint(0, 10, (BATCH, 1)).astype(np.int64)
+    feed = {"img": img_b, "lbl": lbl_b}
+    exe.run(main, feed, [loss])  # compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        lv = exe.run(main, feed, [loss])[0]
+    dt = time.perf_counter() - t0
+    assert np.isfinite(lv).all()
+    v = BATCH * steps / dt
+    return {"metric": "mnist_lenet_static_imgs_per_sec",
+            "value": round(v, 2), "unit": "imgs/s",
+            "vs_baseline": None}
+
+
+def _ctr_dnn_ps(batch=512, steps=30):
+    """Config 5: CTR-DNN, async native PS, sparse embedding rows pulled
+    from / pushed to the CPU pserver while the dense tower trains on
+    device (the DLRM-on-TPU shape SURVEY prescribes)."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.ps import Communicator, PsServer
+    from paddle_tpu.sparse import SelectedRows
+
+    BATCH, SLOTS, DIM, VOCAB = batch, 8, 16, 1_000_000
+    srv = PsServer(port=0, trainers=1, optimizer="sgd", lr=0.01)
+    try:
+        comm = Communicator([f"127.0.0.1:{srv.port}"], mode="async",
+                            trainer_id=0)
+        comm.start()
+        client = comm.clients[0]
+        tower = nn.Sequential(nn.Linear(SLOTS * DIM, 64), nn.ReLU(),
+                              nn.Linear(64, 1))
+        opt = paddle.optimizer.Adam(
+            1e-3, parameters=tower.parameters())
+        rs = np.random.RandomState(0)
+
+        def one_step():
+            ids = rs.randint(0, VOCAB, (BATCH, SLOTS)).astype(np.int64)
+            y = (ids.sum(1, keepdims=True) % 2).astype(np.float32)
+            rows = client.pull_sparse("ctr_emb", ids.ravel(), DIM)
+            emb = paddle.to_tensor(
+                rows.reshape(BATCH, SLOTS * DIM), stop_gradient=False)
+            pred = tower(emb)
+            loss = ((pred - paddle.to_tensor(y)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            g_rows = np.asarray(emb.grad._data).reshape(
+                BATCH * SLOTS, DIM)
+            comm.push({"ctr_emb": SelectedRows(ids.ravel(), g_rows,
+                                               VOCAB)})
+
+        one_step()  # compile + table warm
+        t0 = time.perf_counter()
+        for step in range(steps):
+            one_step()
+        dt = time.perf_counter() - t0
+        comm.stop()
+        v = BATCH * steps / dt
+        return {"metric": "ctr_dnn_async_ps_examples_per_sec",
+                "value": round(v, 2), "unit": "ex/s",
+                "vs_baseline": None}
+    finally:
+        srv.stop()
+
+
+def main():
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    configs = [("mnist", _mnist_static), ("resnet50", _resnet50),
+               ("ernie", _ernie), ("ctr_ps", _ctr_dnn_ps)]
+    results = {}
+    headline = None
+    for name, fn in configs:
+        if only and name != only:
+            continue
+        try:
+            r = fn()
+        except Exception as e:  # record, keep the headline alive
+            r = {"metric": name, "error": f"{type(e).__name__}: {e}"}
+        results[name] = r
+        print(f"# {name}: {json.dumps(r)}", file=sys.stderr)
+        if (name == "ernie" or only) and "value" in r:
+            headline = r  # single-config runs headline themselves
+    results["multichip_scaling"] = {
+        "metric": "fleet_allreduce_scaling",
+        "status": "skipped: single real chip; code path validated by "
+                  "__graft_entry__.dryrun_multichip(8)"}
+    try:
+        with open("BENCH_DETAILS.json", "w") as f:
+            json.dump(results, f, indent=1)
+    except Exception:
+        pass
+    if headline is None:
+        # a config errored (or an unknown name was asked for): report the
+        # failure honestly, never a fabricated 0.0 measurement
+        headline = results.get("ernie") or {
+            "metric": only or "ernie_base_finetune_seq_per_sec_per_chip",
+            "error": "config did not produce a measurement"}
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

@@ -894,9 +894,11 @@ def _sgd(ctx, op):
     g = ctx.inp(op, "Grad")
     lr = ctx.inp(op, "LearningRate")
     if isinstance(g, tuple):  # SelectedRows (rows, values): row update only
+        from ..optimizer import _sgd_sparse_rule
+
         rows, vals = g
         ctx.out(op, "ParamOut",
-                p.at[rows].add(-(lr * vals).astype(p.dtype), mode="drop"))
+                _sgd_sparse_rule(p, rows, vals.astype(p.dtype), lr))
         return
     ctx.out(op, "ParamOut", p - lr * g.astype(p.dtype))
 
@@ -908,15 +910,13 @@ def _momentum(ctx, op):
     v = ctx.inp(op, "Velocity")
     lr = ctx.inp(op, "LearningRate")
     mu = op.attrs.get("mu", 0.9)
-    if isinstance(g, tuple):  # SelectedRows: moments decay densely,
-        rows, vals = g        # grad contributes its rows (momentum_op.h)
-        vals = vals.astype(p.dtype)
-        v_new = (mu * v).at[rows].add(vals, mode="drop")
-        if op.attrs.get("use_nesterov", False):
-            p_new = (p - lr * mu * v_new).at[rows].add(
-                -(lr * vals).astype(p.dtype), mode="drop")
-        else:
-            p_new = p - lr * v_new
+    if isinstance(g, tuple):  # SelectedRows: shared rule (momentum_op.h)
+        from ..optimizer import _momentum_sparse_rule
+
+        rows, vals = g
+        p_new, v_new = _momentum_sparse_rule(
+            p, rows, vals.astype(p.dtype), v, lr, mu,
+            op.attrs.get("use_nesterov", False))
         ctx.out(op, "ParamOut", p_new)
         ctx.out(op, "VelocityOut", v_new)
         return
@@ -967,7 +967,13 @@ def _adam(ctx, op):
 def _lamb(ctx, op):
     jnp = _jnp()
     p = ctx.inp(op, "Param")
-    g = ctx.inp(op, "Grad").astype(p.dtype)
+    g = ctx.inp(op, "Grad")
+    if isinstance(g, tuple):
+        raise NotImplementedError(
+            "lamb has no sparse (SelectedRows) update rule — the "
+            "reference lamb_op is dense-only; train sparse embeddings "
+            "with sgd/momentum/adam")
+    g = g.astype(p.dtype)
     m = ctx.inp(op, "Moment1")
     v = ctx.inp(op, "Moment2")
     lr = ctx.inp(op, "LearningRate")
@@ -994,15 +1000,25 @@ def _lamb(ctx, op):
 
 # ============ grad clipping helpers ============
 
+def _require_dense(x, op):
+    if isinstance(x, tuple):
+        raise NotImplementedError(
+            f"op {op.type!r} cannot take a sparse (SelectedRows) gradient "
+            f"— grad clipping/regularization over is_sparse embedding "
+            f"grads is unsupported (reference restriction); drop the clip "
+            f"or use a dense embedding")
+    return x
+
+
 @register("clip_by_norm")
 def _clip_by_norm(ctx, op):
-    ctx.out(op, "Out", K.clip_by_norm(ctx.inp(op, "X"),
+    ctx.out(op, "Out", K.clip_by_norm(_require_dense(ctx.inp(op, "X"), op),
                                       op.attrs["max_norm"]))
 
 
 @register("squared_l2_norm")
 def _sq_l2(ctx, op):
-    x = ctx.inp(op, "X")
+    x = _require_dense(ctx.inp(op, "X"), op)
     ctx.out(op, "Out", (x.astype(_jnp().float32) ** 2).sum())
 
 

@@ -1,15 +1,23 @@
-"""Attention kernels: XLA composition + (on TPU) a Pallas flash-attention
-kernel. Reference parity: the fused multihead attention of
-operators/fused/multihead_matmul_op.* and math/bert_encoder_functor.cu —
-re-designed TPU-first as a blockwise online-softmax kernel (flash attention)
-instead of a translated CUDA kernel.
+"""Attention kernels: XLA composition + (on TPU) Pallas flash-attention
+kernels, forward AND backward. Reference parity: the fused multihead
+attention of operators/fused/multihead_matmul_op.* and
+math/bert_encoder_functor.cu — re-designed TPU-first as blockwise
+online-softmax kernels (flash attention) instead of translated CUDA.
 
 Layout: (batch, heads, seq, head_dim) throughout.
+
+Backward is a real flash backward (no S×S probability matrix is ever
+materialized): the forward saves only the output and the per-row
+logsumexp; dQ/dK/dV recompute probabilities blockwise in VMEM. Padded
+batches stay on the flash path via a key-position bias (the (B, 1, 1, S)
+additive mask every NLP batch uses); full (B, H, Sq, Sk) masks fall back
+to the XLA reference.
 """
 from __future__ import annotations
 
 import functools
 import math
+import os
 
 
 def _jnp():
@@ -48,43 +56,81 @@ def _on_tpu() -> bool:
         return False
 
 
-def flash_attention_tpu(q, k, v, is_causal=False, scale=None,
-                        block_q=256, block_k=256):
-    """Pallas blockwise flash attention (forward) for TPU.
+def _import_pallas():
+    """Import pallas, tolerating environments where the 'tpu' platform
+    name is unregistered (CPU-pinned test processes pop plugin backend
+    factories; vendor PJRT plugins may register under another name).
+    checkify (imported by pallas.helpers) registers a lowering rule for
+    platform 'tpu' and refuses unknown platform names."""
+    try:
+        from jax._src import xla_bridge as xb
 
-    Grid over (batch*heads, q blocks); the k loop runs inside the kernel with
-    online softmax in fp32 accumulators (VMEM-resident blocks, MXU matmuls).
-    """
-    import jax
-    import jax.numpy as jnp
+        if "tpu" not in xb.known_platforms():
+            xb._platform_aliases.setdefault("tpu", "tpu")
+    except Exception:
+        pass
     from jax.experimental import pallas as pl
 
-    b, h, sq, d = q.shape
-    sk = k.shape[2]
-    s = scale if scale is not None else 1.0 / math.sqrt(d)
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k:
-        return sdpa_reference(q, k, v, None, is_causal, scale)
+    return pl
 
-    qr = q.reshape(b * h, sq, d)
-    kr = k.reshape(b * h, sk, d)
-    vr = v.reshape(b * h, sk, d)
+
+def _kv_bias(mask, b, h, sk):
+    """Normalize a mask to a key-position additive bias [b, sk] if it only
+    varies over (batch, key) — the padded-batch case. Returns None if the
+    mask is richer (per-head or per-query) and needs the reference path."""
+    import jax.numpy as jnp
+
+    if mask is None:
+        return None
+    m = mask
+    if m.dtype == jnp.bool_:
+        m = jnp.where(m, 0.0, -1e30).astype(jnp.float32)
+    # accepted shapes: (b, sk), (b, 1, sk), (b, 1, 1, sk), (1/b, 1, 1, sk)
+    shp = m.shape
+    if shp[-1] != sk:
+        return None
+    lead = shp[:-1]
+    if any(d != 1 for d in lead[1:]):
+        return None
+    if len(lead) >= 1 and lead[0] not in (1, b):
+        return None
+    m = m.reshape((lead[0] if lead else 1, sk)).astype(jnp.float32)
+    if m.shape[0] == 1:
+        m = jnp.broadcast_to(m, (b, sk))
+    return m
+
+
+# --------------------------------------------------------------------------
+# forward kernel: out + logsumexp (residual for the flash backward)
+# --------------------------------------------------------------------------
+
+def _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal, has_bias, block_q,
+                       block_k, dtype, interpret=False):
+    import jax
+    import jax.numpy as jnp
+
+    pl = _import_pallas()
+
     nq = sq // block_q
     nk = sk // block_k
 
-    def kernel(q_ref, k_ref, v_ref, o_ref):
+    def kernel(*refs):
+        if has_bias:
+            q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref = refs
+        else:
+            q_ref, k_ref, v_ref, o_ref, lse_ref = refs
         qi = pl.program_id(1)
         qb = q_ref[...].astype(jnp.float32) * s
 
         def body(ki, carry):
             acc, m_prev, l_prev = carry
-            kb = pl.load(k_ref, (pl.ds(ki * block_k, block_k),
-                                 slice(None))).astype(jnp.float32)
-            vb = pl.load(v_ref, (pl.ds(ki * block_k, block_k),
-                                 slice(None))).astype(jnp.float32)
+            kb = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+            vb = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
             logits = jnp.dot(qb, kb.T,
                              preferred_element_type=jnp.float32)
+            if has_bias:
+                bias = bias_ref[pl.ds(ki * block_k, block_k)]
+                logits = logits + bias[None, :]
             if is_causal:
                 rows = qi * block_q + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 0)
@@ -103,56 +149,339 @@ def flash_attention_tpu(q, k, v, is_causal=False, scale=None,
         m0 = jnp.full((block_q, 1), -1e30, jnp.float32)
         l0 = jnp.zeros((block_q, 1), jnp.float32)
         if is_causal:
-            # only blocks up to and including the diagonal contribute
             k_hi = (qi + 1) * block_q
             nk_eff = (k_hi + block_k - 1) // block_k
         else:
             nk_eff = nk
         acc, m_f, l_f = jax.lax.fori_loop(0, nk_eff, body, (acc0, m0, l0))
-        o_ref[...] = (acc / jnp.maximum(l_f, 1e-30)).astype(o_ref.dtype)
+        l_safe = jnp.maximum(l_f, 1e-30)
+        o_ref[...] = (acc / l_safe).astype(o_ref.dtype)
+        lse_ref[...] = (m_f + jnp.log(l_safe))[:, 0]
 
-    out = pl.pallas_call(
+    in_specs = [
+        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
+        pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
+    ]
+    if has_bias:
+        in_specs.append(
+            pl.BlockSpec((None, sk), lambda bh, qi: (bh, 0)))
+    return pl.pallas_call(
         kernel,
         grid=(b * h, nq),
-        in_specs=[
+        in_specs=in_specs,
+        out_specs=[
             pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )
+
+
+def flash_attention_fwd(q, k, v, bias=None, is_causal=False, scale=None,
+                        block_q=256, block_k=256, interpret=False):
+    """Returns (out [b,h,sq,d], lse [b*h, sq]). bias: [b, sk] additive."""
+    import jax.numpy as jnp
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"flash kernels need block-tileable lengths; got sq={sq}, "
+            f"sk={sk} with blocks ({block_q}, {block_k}) — use "
+            f"flash_attention() which falls back to the XLA reference")
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+    call = _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal,
+                              bias is not None, block_q, block_k, q.dtype,
+                              interpret)
+    if bias is not None:
+        bias_bh = jnp.repeat(bias, h, axis=0)  # [b*h, sk]
+        out, lse = call(qr, kr, vr, bias_bh)
+    else:
+        out, lse = call(qr, kr, vr)
+    return out.reshape(b, h, sq, d), lse
+
+
+def flash_attention_tpu(q, k, v, is_causal=False, scale=None,
+                        block_q=256, block_k=256):
+    """Forward-only entry (kept for callers that don't differentiate)."""
+    sq, sk = q.shape[2], k.shape[2]
+    if sq % min(block_q, sq) or sk % min(block_k, sk):
+        return sdpa_reference(q, k, v, None, is_causal, scale)
+    out, _ = flash_attention_fwd(q, k, v, None, is_causal, scale,
+                                 block_q, block_k)
+    return out
+
+
+# --------------------------------------------------------------------------
+# backward kernels: dQ (grid over q blocks) and dK/dV (grid over k blocks)
+# --------------------------------------------------------------------------
+
+def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
+                        block_q=256, block_k=256, interpret=False):
+    import jax
+    import jax.numpy as jnp
+
+    pl = _import_pallas()
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    nq = sq // block_q
+    nk = sk // block_k
+    has_bias = bias is not None
+
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+    orr = out.reshape(b * h, sq, d)
+    gr = g.reshape(b * h, sq, d)
+    # D_i = rowsum(dO_i * O_i) — the softmax-correction term
+    delta = (gr.astype(jnp.float32) * orr.astype(jnp.float32)).sum(-1)
+    bias_bh = jnp.repeat(bias, h, axis=0) if has_bias else None
+
+    def dq_kernel(*refs):
+        if has_bias:
+            (q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, dl_ref,
+             dq_ref) = refs
+        else:
+            q_ref, k_ref, v_ref, g_ref, lse_ref, dl_ref, dq_ref = refs
+        qi = pl.program_id(1)
+        qb = q_ref[...].astype(jnp.float32)
+        gb = g_ref[...].astype(jnp.float32)
+        lse_b = lse_ref[...][:, None]
+        dl_b = dl_ref[...][:, None]
+
+        def body(ki, acc):
+            kb = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+            vb = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+            logits = jnp.dot(qb, kb.T,
+                             preferred_element_type=jnp.float32) * s
+            if has_bias:
+                bb = b_ref[pl.ds(ki * block_k, block_k)]
+                logits = logits + bb[None, :]
+            if is_causal:
+                rows = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                cols = ki * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                logits = jnp.where(rows >= cols, logits, -1e30)
+            p = jnp.exp(logits - lse_b)
+            dp = jnp.dot(gb, vb.T, preferred_element_type=jnp.float32)
+            ds = p * (dp - dl_b) * s
+            return acc + jnp.dot(ds, kb,
+                                 preferred_element_type=jnp.float32)
+
+        if is_causal:
+            nk_eff = ((qi + 1) * block_q + block_k - 1) // block_k
+        else:
+            nk_eff = nk
+        acc = jax.lax.fori_loop(
+            0, nk_eff, body, jnp.zeros((block_q, d), jnp.float32))
+        dq_ref[...] = acc.astype(dq_ref.dtype)
+
+    dq_in = [
+        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
+        pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
+    ]
+    if has_bias:
+        dq_in.append(pl.BlockSpec((None, sk), lambda bh, qi: (bh, 0)))
+    dq_in += [
+        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),
+        pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),
+    ]
+    dq_args = [qr, kr, vr] + ([bias_bh] if has_bias else []) + \
+        [gr, lse, delta]
+    dq = pl.pallas_call(
+        dq_kernel, grid=(b * h, nq), in_specs=dq_in,
+        out_specs=pl.BlockSpec((None, block_q, d),
+                               lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-    )(qr, kr, vr)
-    return out.reshape(b, h, sq, d)
+        interpret=interpret,
+    )(*dq_args)
+
+    def dkv_kernel(*refs):
+        if has_bias:
+            (q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, dl_ref,
+             dk_ref, dv_ref) = refs
+        else:
+            (q_ref, k_ref, v_ref, g_ref, lse_ref, dl_ref, dk_ref,
+             dv_ref) = refs
+        ki = pl.program_id(1)
+        kb = k_ref[...].astype(jnp.float32)
+        vb = v_ref[...].astype(jnp.float32)
+        if has_bias:
+            bb = b_ref[...]
+
+        def body(qi, carry):
+            dk_acc, dv_acc = carry
+            qb = q_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+            gb = g_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+            lse_b = lse_ref[pl.ds(qi * block_q, block_q)][:, None]
+            dl_b = dl_ref[pl.ds(qi * block_q, block_q)][:, None]
+            logits = jnp.dot(qb, kb.T,
+                             preferred_element_type=jnp.float32) * s
+            if has_bias:
+                logits = logits + bb[None, :]
+            if is_causal:
+                rows = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                cols = ki * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                logits = jnp.where(rows >= cols, logits, -1e30)
+            p = jnp.exp(logits - lse_b)
+            dv_acc = dv_acc + jnp.dot(p.T, gb,
+                                      preferred_element_type=jnp.float32)
+            dp = jnp.dot(gb, vb.T, preferred_element_type=jnp.float32)
+            ds = p * (dp - dl_b) * s
+            dk_acc = dk_acc + jnp.dot(ds.T, qb,
+                                      preferred_element_type=jnp.float32)
+            return dk_acc, dv_acc
+
+        if is_causal:
+            q_lo = (ki * block_k) // block_q
+        else:
+            q_lo = 0
+        z = jnp.zeros((block_k, d), jnp.float32)
+        dk_acc, dv_acc = jax.lax.fori_loop(q_lo, nq, body, (z, z))
+        dk_ref[...] = dk_acc.astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc.astype(dv_ref.dtype)
+
+    dkv_in = [
+        pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, 0, 0)),
+        pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
+    ]
+    if has_bias:
+        dkv_in.append(
+            pl.BlockSpec((None, block_k), lambda bh, ki: (bh, ki)))
+    dkv_in += [
+        pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, 0, 0)),
+        pl.BlockSpec((None, sq), lambda bh, ki: (bh, 0)),
+        pl.BlockSpec((None, sq), lambda bh, ki: (bh, 0)),
+    ]
+    dkv_args = [qr, kr, vr] + ([bias_bh] if has_bias else []) + \
+        [gr, lse, delta]
+    dk, dv = pl.pallas_call(
+        dkv_kernel, grid=(b * h, nk), in_specs=dkv_in,
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(*dkv_args)
+
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
 
 
-def sdpa(q, k, v, mask=None, is_causal=False, scale=None):
-    """Dispatch: pallas flash kernel on TPU for mask-free/causal attention,
-    XLA reference otherwise. Differentiable (flash path uses custom VJP via
-    recompute through the reference — cheap under remat)."""
-    if mask is None and _on_tpu() and q.ndim == 4 and q.shape[-1] <= 256:
-        try:
-            return _flash_diff(q, k, v, is_causal, scale)
-        except Exception:
-            pass
-    return sdpa_reference(q, k, v, mask, is_causal, scale)
+# --------------------------------------------------------------------------
+# differentiable flash attention + dispatch
+# --------------------------------------------------------------------------
 
-
-def _flash_diff(q, k, v, is_causal, scale):
+@functools.lru_cache(maxsize=None)
+def _flash_diff_fn(is_causal, scale, has_bias, interpret):
     import jax
 
     @jax.custom_vjp
-    def f(q, k, v):
-        return flash_attention_tpu(q, k, v, is_causal, scale)
+    def f(q, k, v, bias):
+        out, _ = flash_attention_fwd(q, k, v, bias, is_causal, scale,
+                                     interpret=interpret)
+        return out
 
-    def fwd(q, k, v):
-        return f(q, k, v), (q, k, v)
+    def fwd(q, k, v, bias):
+        out, lse = flash_attention_fwd(q, k, v, bias, is_causal, scale,
+                                       interpret=interpret)
+        return out, (q, k, v, bias, out, lse)
 
     def bwd(res, g):
-        q, k, v = res
-        _, vjp = jax.vjp(
-            lambda a, b, c: sdpa_reference(a, b, c, None, is_causal, scale),
-            q, k, v)
-        return vjp(g)
+        q, k, v, bias, out, lse = res
+        dq, dk, dv = flash_attention_bwd(q, k, v, bias, out, lse, g,
+                                         is_causal, scale,
+                                         interpret=interpret)
+        return dq, dk, dv, None
 
     f.defvjp(fwd, bwd)
-    return f(q, k, v)
+    return f
+
+
+def flash_attention(q, k, v, bias=None, is_causal=False, scale=None,
+                    interpret=False, block_q=256, block_k=256):
+    """Differentiable flash attention (fwd+bwd pallas). bias: optional
+    [b, sk] additive key bias (padding masks). Sequence lengths that do
+    not tile into blocks fall back to the XLA reference (the blockwise
+    grid would silently truncate the tail otherwise)."""
+    sq, sk = q.shape[2], k.shape[2]
+    if sq % min(block_q, sq) or sk % min(block_k, sk):
+        mask4 = None if bias is None else bias[:, None, None, :]
+        return sdpa_reference(q, k, v, mask4, is_causal, scale)
+    f = _flash_diff_fn(is_causal, scale, bias is not None, interpret)
+    return f(q, k, v, bias)
+
+
+_FLASH_PROBED = {}
+
+
+def _flash_usable():
+    """One-time probe: compile+run a tiny fwd+bwd on the real backend; if
+    anything in the pallas path breaks on this chip/runtime, fall back to
+    the XLA reference permanently (never crash a training run)."""
+    flag = os.environ.get("PT_FLASH_ATTENTION", "auto")
+    if flag == "0":
+        return False
+    key = "probe"
+    if key in _FLASH_PROBED:
+        return _FLASH_PROBED[key]
+    ok = False
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        q = jnp.asarray(np.random.RandomState(0).randn(1, 1, 256, 64),
+                        jnp.float32)
+
+        def loss(q, k, v):
+            return flash_attention(q, k, v, None, True, None).sum()
+
+        val, grads = jax.jit(jax.value_and_grad(loss, (0, 1, 2)))(q, q, q)
+        ok = bool(np.isfinite(float(val)))
+        for gg in grads:
+            ok = ok and bool(np.isfinite(np.asarray(gg)).all())
+    except Exception:
+        ok = False
+    _FLASH_PROBED[key] = ok
+    return ok
+
+
+def sdpa(q, k, v, mask=None, is_causal=False, scale=None):
+    """Dispatch: pallas flash fwd+bwd on TPU whenever the mask reduces to
+    a key-position bias (incl. every padded batch); XLA reference
+    otherwise."""
+    if _on_tpu() and q.ndim == 4 and q.shape[-1] <= 256 \
+            and q.shape[2] % min(256, q.shape[2]) == 0 \
+            and k.shape[2] % min(256, k.shape[2]) == 0:
+        bias = _kv_bias(mask, q.shape[0], q.shape[1], k.shape[2])
+        if (mask is None or bias is not None) and _flash_usable():
+            try:
+                return flash_attention(q, k, v, bias, is_causal, scale)
+            except Exception:
+                pass
+    return sdpa_reference(q, k, v, mask, is_causal, scale)

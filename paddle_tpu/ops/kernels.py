@@ -268,8 +268,10 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
         padding_cfg = pad
     else:
         padding_cfg = [(0, 0), (0, 0)] + list(pad)
-    neg = -_jnp().inf if np.issubdtype(np.dtype(x.dtype), np.floating) else \
-        np.iinfo(np.dtype(x.dtype)).min
+    jnp = _jnp()
+    # jnp.issubdtype understands bfloat16 (numpy sees it as void)
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+        jnp.iinfo(x.dtype).min
     return lax.reduce_window(
         x, neg, lax.max,
         window_dimensions=(1, 1) + k,
@@ -343,12 +345,16 @@ def batch_norm_train(x, gamma, beta, running_mean, running_var, momentum,
     axes = tuple(i for i in range(x.ndim)
                  if i != (1 if data_format == "NCHW" else x.ndim - 1))
     c_axis = 1 if data_format == "NCHW" else x.ndim - 1
-    mean = x.mean(axis=axes)
-    var = ((x - _bshape(mean, x.ndim, c_axis)) ** 2).mean(axis=axes)
+    # statistics in at-least-f32 regardless of a bf16 compute dtype (the
+    # reference AMP keeps batch_norm in fp32); y returns in x's dtype
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    xf = x.astype(acc)
+    mean = xf.mean(axis=axes)
+    var = ((xf - _bshape(mean, x.ndim, c_axis)) ** 2).mean(axis=axes)
     inv = 1.0 / jnp.sqrt(var + epsilon)
-    y = (x - _bshape(mean, x.ndim, c_axis)) * _bshape(inv * gamma, x.ndim,
-                                                      c_axis)
-    y = y + _bshape(beta, x.ndim, c_axis)
+    y = (xf - _bshape(mean, x.ndim, c_axis)) * _bshape(
+        inv * gamma.astype(acc), x.ndim, c_axis)
+    y = (y + _bshape(beta.astype(acc), x.ndim, c_axis)).astype(x.dtype)
     new_mean = momentum * running_mean + (1.0 - momentum) * mean
     new_var = momentum * running_var + (1.0 - momentum) * var
     return y, new_mean, new_var, mean, var

@@ -362,7 +362,7 @@ class AdamW(Adam):
                  grad_clip=None, lazy_mode=False, multi_precision=False,
                  apply_decay_param_fun=None, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         weight_decay, grad_clip)
+                         weight_decay, grad_clip, lazy_mode=lazy_mode)
         self._apply_decay_param_fun = apply_decay_param_fun
 
     def _decay_value(self, p):

@@ -105,6 +105,9 @@ class SpmdTrainer:
             cast = lambda t: t.astype(self.compute_dtype) if hasattr(  # noqa
                 t, "dtype") and "float" in str(t.dtype) else t
             params = {n: cast(v) for n, v in params.items()}
+            # float INPUTS too (conv images etc.): mixed f32xbf16 operands
+            # are an error for lax.conv and silently promote elsewhere
+            inputs = tuple(cast(x) for x in inputs)
 
         apply = self.fm.apply
         if self.remat:

@@ -1,0 +1,84 @@
+"""Worker script for the multi-process collective convergence test.
+
+Reference analogue: the model side of test_dist_base.py (dist_mnist.py):
+each rank trains the same net on its shard of a deterministic dataset
+with DataParallel allreduce; losses are pickled for the parent test to
+compare against a single-process run.
+
+Launched by paddle_tpu.distributed.launch.launch_collective, which sets
+the PADDLE_* + JAX_* env contract.
+"""
+import json
+import os
+import sys
+
+# one local CPU device per rank, regardless of the parent's XLA_FLAGS
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+
+    for _k in list(_xb._backend_factories):
+        if _k != "cpu":
+            _xb._backend_factories.pop(_k, None)
+except Exception:
+    pass
+
+# init_parallel_env reads PADDLE_MASTER for the coordinator address
+os.environ.setdefault("PADDLE_MASTER",
+                      os.environ.get("JAX_COORDINATOR_ADDRESS", ""))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+
+
+def build_model():
+    paddle.seed(42)  # identical init on every rank
+    return nn.Sequential(
+        nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+
+
+def main():
+    out_path = sys.argv[1]
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 12
+
+    dist.init_parallel_env()
+    rank, world = dist.get_rank(), dist.get_world_size()
+
+    model = build_model()
+    dp = dist.DataParallel(model)
+    opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+
+    rng = np.random.RandomState(123)
+    w_true = rng.randn(4, 1).astype("float32")
+    losses = []
+    for step in range(steps):
+        X = rng.randn(16, 4).astype("float32")
+        Y = (X @ w_true).astype("float32")
+        xs, ys = X[rank::world], Y[rank::world]
+        pred = dp(paddle.to_tensor(xs))
+        local = ((pred - paddle.to_tensor(ys)) ** 2).mean()
+        # reference protocol: scale 1/world, backward, allreduce-sum grads
+        scaled = dp.scale_loss(local)
+        scaled.backward()
+        dp.apply_collective_grads()
+        opt.step()
+        opt.clear_grad()
+        # report the GLOBAL loss (mean over ranks) like check_with_place
+        g = paddle.to_tensor(np.asarray(float(local.numpy()), "float32"))
+        dist.all_reduce(g)
+        losses.append(float(np.asarray(g.numpy())) / world)
+
+    with open(f"{out_path}.rank{rank}", "w") as f:
+        json.dump(losses, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Multi-process collective data-parallel convergence test.
+
+Reference analogue: TestDistBase (unittests/test_dist_base.py:594) — spawn
+REAL trainer subprocesses on localhost, train the same model, and compare
+convergence against a local single-process run (check_with_place :1023).
+Here ranks coordinate through jax.distributed (the NCCL2-mode equivalent
+over the jax coordination service) and allreduce grads via DataParallel.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+
+def _single_process_losses(steps):
+    """Full-batch single-process baseline of the worker's exact model."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(42)
+    model = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+    rng = np.random.RandomState(123)
+    w_true = rng.randn(4, 1).astype("float32")
+    losses = []
+    for _ in range(steps):
+        X = rng.randn(16, 4).astype("float32")
+        Y = (X @ w_true).astype("float32")
+        loss = ((model(paddle.to_tensor(X)) -
+                 paddle.to_tensor(Y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+def test_two_process_collective_matches_local(tmp_path):
+    from paddle_tpu.distributed.launch import launch_collective
+
+    steps = 12
+    out = str(tmp_path / "losses")
+    script = os.path.join(os.path.dirname(__file__),
+                          "dist_collective_worker.py")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rc = launch_collective(
+        [script, out, str(steps)], nproc=2,
+        log_dir=str(tmp_path / "logs"),
+        extra_env={"PYTHONPATH": repo_root + os.pathsep +
+                   os.environ.get("PYTHONPATH", "")})
+    if rc != 0:
+        logs = ""
+        logdir = tmp_path / "logs"
+        for f in sorted(os.listdir(logdir)):
+            logs += f"----- {f} -----\n"
+            logs += (logdir / f).read_text()[-3000:]
+        pytest.fail(f"collective launch failed rc={rc}\n{logs}")
+
+    with open(out + ".rank0") as f:
+        r0 = json.load(f)
+    with open(out + ".rank1") as f:
+        r1 = json.load(f)
+    # both ranks computed the same global (allreduced) loss
+    np.testing.assert_allclose(r0, r1, rtol=1e-5, atol=1e-6)
+
+    ref = _single_process_losses(steps)
+    # 2-rank DP with 1/world loss scaling + allreduce-sum == full batch:
+    # losses must track the single-process run step for step
+    np.testing.assert_allclose(r0, ref, rtol=5e-3, atol=5e-4)
+    assert r0[-1] < r0[0] * 0.5  # and it actually converges
