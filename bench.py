@@ -185,12 +185,14 @@ def _ctr_dnn_ps(batch=512, steps=30):
             comm.push({"ctr_emb": SelectedRows(ids.ravel(), g_rows,
                                                VOCAB)})
 
-        one_step()  # compile + table warm
-        t0 = time.perf_counter()
-        for step in range(steps):
-            one_step()
-        dt = time.perf_counter() - t0
-        comm.stop()
+        try:
+            one_step()  # compile + table warm
+            t0 = time.perf_counter()
+            for step in range(steps):
+                one_step()
+            dt = time.perf_counter() - t0
+        finally:
+            comm.stop()  # always reap the async send/recv threads
         v = BATCH * steps / dt
         return {"metric": "ctr_dnn_async_ps_examples_per_sec",
                 "value": round(v, 2), "unit": "ex/s",

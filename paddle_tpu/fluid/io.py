@@ -120,6 +120,9 @@ def load_inference_model(dirname, executor, model_filename=None,
     model = m.InferenceModel()
     model.ParseFromString(data)
     program = program_pb.proto_to_program(model.program)
+    # ops with on-disk companion artifacts (jax_exported) resolve relative
+    # to the model directory
+    program._model_dir = os.path.abspath(dirname)
     params_path = os.path.join(dirname, params_filename or "__params__")
     if os.path.exists(params_path):
         arrays = load_combine(params_path)

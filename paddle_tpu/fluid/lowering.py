@@ -1279,5 +1279,36 @@ def _noop(ctx, op):
     pass
 
 
+_EXPORTED_CACHE = {}
+
+
+@register("jax_exported")
+def _jax_exported(ctx, op):
+    """A whole exported computation (jax.export artifact written by
+    paddle.jit.save) as ONE op: the TranslatedLayer/'subgraph op' analogue
+    of the reference's save_inference_model programs. Parameters live as
+    baked constants inside the artifact; data-dependent control flow came
+    through the dy2static lax rewrites."""
+    import os
+
+    program = _require_program(ctx, op)
+    model_dir = getattr(program, "_model_dir", None)
+    if model_dir is None:
+        raise RuntimeError(
+            "jax_exported op needs program._model_dir (load the program "
+            "via fluid.io.load_inference_model / paddle.inference)")
+    path = os.path.join(model_dir, op.attrs["artifact"])
+    exported = _EXPORTED_CACHE.get(path)
+    if exported is None:
+        from jax import export as jexport
+
+        with open(path, "rb") as f:
+            exported = jexport.deserialize(bytearray(f.read()))
+        _EXPORTED_CACHE[path] = exported
+    ins = ctx.inps(op, "X")
+    outs = exported.call(*ins)
+    ctx.outs(op, "Out", tuple(outs))
+
+
 # sequence-op lowerings register themselves into this registry on import
 from . import lowering_seq  # noqa: E402,F401

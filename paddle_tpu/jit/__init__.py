@@ -1,28 +1,51 @@
 """paddle.jit: dygraph-to-static.
 
-Reference parity: fluid/dygraph/jit.py:156 @declarative (to_static) and
-dygraph_to_static/program_translator.py. TPU-native design: to_static is
-trace-based — the layer's forward runs once under jax tracing and becomes a
-cached XLA computation per input signature; this is *stronger* than the
-reference's AST translation for straight-line code (whole-program XLA
-fusion) and falls back to eager for data-dependent Python control flow.
+Reference parity: fluid/dygraph/jit.py:156 @declarative (to_static),
+dygraph_to_static/program_translator.py:680 and TranslatedLayer
+(dygraph/io.py). TPU-native design, two layers:
+
+- AST translation (jit/dy2static.py): `if`/`while` over Tensors rewrite
+  to runtime-dispatched lax.cond/lax.while_loop, so ONE converted
+  function runs eagerly and under jit/export with data-dependent
+  control flow — the reference's 24-file transformer suite collapses
+  into two transforms because jax supplies the structured control flow.
+- Trace capture: the converted forward traces into a cached XLA
+  computation per input signature (stronger than op-by-op capture:
+  whole-program fusion).
+
+jit.save exports the traced computation portably with jax.export
+(parameters baked as constants) and writes the durable `__model__`
+program the Predictor loads — the program wraps the artifact as one
+`jax_exported` op, the TPU-native analogue of the reference's
+save_inference_model subgraph. jit.load returns a TranslatedLayer.
 """
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 
 from ..core.tensor import Tensor
 from ..core import autograd as _ag
+from .dy2static import convert_to_static
 
 
 class TracedFunction:
     def __init__(self, fn, layer=None):
-        self._fn = fn
+        self._orig = fn
         self._layer = layer
         self._cache = {}
         functools.update_wrapper(self, fn)
+
+    @property
+    def _fn(self):
+        """AST-converted body, resolved PER CALL so
+        enable_to_static(False/True) takes effect after decoration (the
+        reference's ProgramTranslator is a dynamic toggle)."""
+        if ProgramTranslator.get_instance().enabled:
+            return convert_to_static(self._orig)
+        return self._orig
 
     def _signature(self, args):
         sig = []
@@ -41,7 +64,14 @@ class TracedFunction:
             layer = args[0]
             args = args[1:]
 
-        # grad-tracking callers fall back to eager tape execution
+        # translator off = plain dygraph: no conversion, no jit (the
+        # reference's enable_to_static(False) debugging contract)
+        if not ProgramTranslator.get_instance().enabled:
+            if layer is not None:
+                return self._orig(layer, *args, **kwargs)
+            return self._orig(*args, **kwargs)
+
+        # grad-tracking callers run the (converted) fn eagerly on the tape
         if _ag.is_grad_enabled() and (
                 (layer is not None and any(
                     not p.stop_gradient for p in layer.parameters()))
@@ -57,18 +87,28 @@ class TracedFunction:
                 return self._fn(layer, *args, **kwargs)
             return self._fn(*args, **kwargs)
 
-        key = self._signature(args)
+        key = (self._signature(args),
+               ProgramTranslator.get_instance().enabled)
         compiled = self._cache.get(key)
         if compiled is None:
             fn = self._fn
 
             if layer is not None:
                 def run(state, *raw):
+                    # bind traced state for the trace, then RESTORE the
+                    # concrete arrays — otherwise the live layer keeps
+                    # leaked tracers after compilation
+                    saved = layer.raw_state()
                     layer.load_raw_state(state)
-                    with _ag.no_grad():
-                        out = fn(layer, *[Tensor._wrap(r) if isinstance(
-                            r, (jax.Array,)) else r for r in raw])
-                    return _unwrap_tree(out)
+                    try:
+                        with _ag.no_grad():
+                            out = fn(layer, *[
+                                Tensor._wrap(r) if isinstance(
+                                    r, (jax.Array,)) else r for r in raw])
+                        out = _unwrap_tree(out)
+                    finally:
+                        layer.load_raw_state(saved)
+                    return out
             else:
                 def run(*raw):
                     with _ag.no_grad():
@@ -125,28 +165,159 @@ def to_static(function=None, input_spec=None, build_strategy=None, **kwargs):
 declarative = to_static
 
 
+# --------------------------------------------------------------------------
+# save / load: portable exported artifact + durable __model__ program
+# --------------------------------------------------------------------------
+
+def _example_arrays(input_spec):
+    from ..static import InputSpec
+
+    arrs = []
+    for spec in input_spec:
+        if isinstance(spec, Tensor):
+            arrs.append(np.asarray(spec._data))
+        elif isinstance(spec, InputSpec):
+            from ..core.dtypes import convert_dtype
+
+            shape = [1 if (d is None or d < 0) else int(d)
+                     for d in spec.shape]
+            dt = np.dtype(convert_dtype(spec.dtype)) \
+                if spec.dtype is not None else np.dtype(np.float32)
+            arrs.append(np.zeros(shape, dt))
+        else:
+            arrs.append(np.asarray(spec))
+    return arrs
+
+
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save: exports params + (if available) StableHLO artifact
-    (reference: dygraph/jit.py SaveLoadConfig + save_inference_model)."""
+    """paddle.jit.save parity: writes into directory `path`:
+      - state.pdparams   (state_dict, for fine-tune reload)
+      - __export__.bin   (jax.export artifact: params baked as constants,
+                          data-dependent lax control flow included)
+      - __model__        (program IR wrapping the artifact as one
+                          `jax_exported` op — loadable by
+                          paddle.inference.Predictor's XLA engine)
+    The exported computation is shape-specialized to the input_spec
+    shapes (None -> 1); re-export per deployed shape set.
+    """
+    import jax
+    from jax import export as jexport
+
+    from ..core import program_pb
+    from ..fluid.framework import Program
     from ..io.serialization import save as _save
 
+    os.makedirs(path, exist_ok=True)
     state = layer.state_dict() if hasattr(layer, "state_dict") else {}
-    _save(state, path + ".pdparams")
-    if input_spec:
-        try:
-            import jax
+    _save(state, os.path.join(path, "state.pdparams"))
 
-            from ..static.export import export_stablehlo
+    if input_spec is None:
+        raise ValueError("paddle.jit.save needs input_spec (shapes/dtypes "
+                         "or example tensors) to export the computation")
+    arrs = _example_arrays(input_spec)
 
-            export_stablehlo(layer, input_spec, path + ".stablehlo")
-        except Exception:
-            pass
+    fwd = layer.forward
+    if isinstance(fwd, TracedFunction):
+        fn = fwd._fn
+        layer_arg = fwd._layer or layer
+    else:
+        fn = convert_to_static(
+            fwd.__func__ if hasattr(fwd, "__func__") else fwd)
+        layer_arg = layer
+
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    try:
+        def run(*raw):
+            with _ag.no_grad():
+                out = fn(layer_arg, *[Tensor._wrap(r) for r in raw])
+            out = _unwrap_tree(out)
+            return out if isinstance(out, (tuple, list)) else (out,)
+
+        exported = jexport.export(jax.jit(run))(
+            *[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrs])
+        blob = exported.serialize()
+    finally:
+        if hasattr(layer, "train") and was_training:
+            layer.train()
+    with open(os.path.join(path, "__export__.bin"), "wb") as f:
+        f.write(bytes(blob))
+
+    # output shapes come from the export metadata — no execution needed
+    out_avals = exported.out_avals
+    prog = Program()
+    blk = prog.global_block()
+    in_names, out_names = [], []
+    for i, a in enumerate(arrs):
+        n = f"x_{i}"
+        blk.create_var(name=n, shape=list(a.shape), dtype=a.dtype.name,
+                       is_data=True)
+        in_names.append(n)
+    for i, av in enumerate(out_avals):
+        n = f"out_{i}"
+        blk.create_var(name=n, shape=list(av.shape),
+                       dtype=np.dtype(av.dtype).name)
+        out_names.append(n)
+    blk.append_op(type="jax_exported",
+                  inputs={"X": in_names},
+                  outputs={"Out": out_names},
+                  attrs={"artifact": "__export__.bin"})
+    m = program_pb.messages()
+    model = m.InferenceModel()
+    model.program.CopyFrom(program_pb.program_to_proto(prog))
+    model.feed_names.extend(in_names)
+    model.fetch_names.extend(out_names)
+    with open(os.path.join(path, "__model__"), "wb") as f:
+        f.write(model.SerializeToString())
+
+
+class TranslatedLayer:
+    """dygraph/io.py TranslatedLayer parity: a loaded, immutable inference
+    layer backed by the exported computation."""
+
+    def __init__(self, path):
+        from jax import export as jexport
+
+        with open(os.path.join(path, "__export__.bin"), "rb") as f:
+            self._exported = jexport.deserialize(bytearray(f.read()))
+        self._path = path
+        self.training = False
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        raise RuntimeError(
+            "TranslatedLayer is an inference artifact (parameters are "
+            "baked constants); reload the original model for training")
+
+    def forward(self, *args):
+        raws = [a._data if isinstance(a, Tensor) else np.asarray(a)
+                for a in args]
+        outs = self._exported.call(*raws)
+        outs = tuple(Tensor._wrap(o) for o in outs)
+        return outs[0] if len(outs) == 1 else outs
+
+    __call__ = forward
+
+    def state_dict(self):
+        from ..io.serialization import load as _load
+
+        p = os.path.join(self._path, "state.pdparams")
+        return _load(p) if os.path.exists(p) else {}
 
 
 def load(path, **configs):
+    """paddle.jit.load: a directory saved by jit.save -> TranslatedLayer;
+    a bare .pdparams path (legacy) -> the state dict."""
+    if os.path.isdir(path):
+        return TranslatedLayer(path)
     from ..io.serialization import load as _load
 
-    return _load(path + ".pdparams")
+    return _load(path if path.endswith(".pdparams")
+                 else path + ".pdparams")
 
 
 class ProgramTranslator:
@@ -163,6 +334,10 @@ class ProgramTranslator:
 
     def enable(self, enable_to_static):
         self.enabled = enable_to_static
+
+
+def enable_to_static(flag=True):
+    ProgramTranslator.get_instance().enable(flag)
 
 
 def not_to_static(fn):

@@ -1,0 +1,358 @@
+"""AST-based dygraph-to-static translation.
+
+Reference parity: fluid/dygraph/dygraph_to_static/ (24 files —
+IfElseTransformer, LoopTransformer, program_translator.py:680). TPU-native
+design: instead of rewriting to fluid control-flow OPS, the transforms
+rewrite Python `if`/`while` statements over Tensors into `_jst.cond` /
+`_jst.while_loop` calls that dispatch at RUNTIME — plain Python control
+flow when the predicate is concrete, `lax.cond`/`lax.while_loop` when it
+is a traced value — so one converted function works eagerly AND under
+jax.jit/jax.export with data-dependent branching.
+
+Supported: `if`/`elif`/`else` and `while` whose bodies have no
+`break`/`continue`/`return` (those keep Python semantics and therefore
+need concrete predicates, as in the reference's unsupported cases);
+`for` over concrete iterables needs no transform (tracing unrolls it).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+
+class _Undefined:
+    """Placeholder for names assigned only inside a branch/loop body
+    (dygraph_to_static's UndefinedVar)."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEF = _Undefined()
+
+
+def _opt(fn):
+    """Evaluate a name lazily; unbound -> UNDEF."""
+    try:
+        return fn()
+    except (NameError, UnboundLocalError):
+        return UNDEF
+
+
+def _is_traced_bool(x):
+    import jax.core
+
+    from ..core.tensor import Tensor
+
+    raw = x._data if isinstance(x, Tensor) else x
+    if isinstance(raw, jax.core.Tracer):
+        return True, raw
+    return False, raw
+
+
+def _unwrap(v):
+    from ..core.tensor import Tensor
+
+    return v._data if isinstance(v, Tensor) else v
+
+
+def _rewrap(raw, like):
+    from ..core.tensor import Tensor
+
+    return Tensor._wrap(raw) if isinstance(like, Tensor) else raw
+
+
+def _wrap_outputs(outs):
+    """Branch outputs normalize to Tensors for array leaves so both
+    branches produce one type scheme."""
+    import jax
+
+    from ..core.tensor import Tensor
+
+    return tuple(Tensor._wrap(o) if isinstance(o, jax.Array) else o
+                 for o in outs)
+
+
+def cond(pred, true_fn, false_fn, carry):
+    """Runtime dispatch for a transformed `if`."""
+    traced, raw = _is_traced_bool(pred)
+    if not traced:
+        return _wrap_outputs(true_fn(carry) if bool(raw) else
+                             false_fn(carry))
+    import jax
+    import jax.numpy as jnp
+
+    # traced predicate: lax.cond over the defined leaves; UNDEF slots pass
+    # through statically (both branches must then produce real values)
+    defined_idx = [i for i, v in enumerate(carry) if v is not UNDEF]
+
+    def make(branch):
+        def run(defined_raw):
+            full = list(carry)
+            for j, i in enumerate(defined_idx):
+                full[i] = _rewrap(defined_raw[j], carry[i])
+            outs = branch(tuple(full))
+            out_raw = tuple(_unwrap(o) for o in outs)
+            for o in out_raw:
+                if o is UNDEF:
+                    raise ValueError(
+                        "dy2static: a variable assigned in only one "
+                        "branch of a traced `if` must be defined in both "
+                        "branches (or before the if)")
+            return out_raw
+
+        return run
+
+    operand = tuple(_unwrap(carry[i]) for i in defined_idx)
+    out_raw = jax.lax.cond(jnp.reshape(raw, ()).astype(bool),
+                           make(true_fn), make(false_fn), operand)
+    return _wrap_outputs(out_raw)
+
+
+def while_loop(cond_fn, body_fn, carry):
+    """Runtime dispatch for a transformed `while`."""
+    pred = cond_fn(carry)
+    traced, raw = _is_traced_bool(pred)
+    if not traced:
+        while bool(_unwrap(pred)):
+            carry = _wrap_outputs(body_fn(carry))
+            pred = cond_fn(carry)
+        return carry
+    import jax
+    import jax.numpy as jnp
+
+    for v in carry:
+        if v is UNDEF:
+            raise ValueError(
+                "dy2static: every variable used in a traced `while` must "
+                "be initialized before the loop (XLA needs a fixed carry)")
+
+    def lax_cond(c_raw):
+        full = tuple(_rewrap(r, o) for r, o in zip(c_raw, carry))
+        return jnp.reshape(_unwrap(cond_fn(full)), ()).astype(bool)
+
+    def lax_body(c_raw):
+        full = tuple(_rewrap(r, o) for r, o in zip(c_raw, carry))
+        outs = body_fn(full)
+        return tuple(_unwrap(o) for o in outs)
+
+    out_raw = jax.lax.while_loop(lax_cond, lax_body,
+                                 tuple(_unwrap(v) for v in carry))
+    return _wrap_outputs(out_raw)
+
+
+_JST = {"cond": cond, "while_loop": while_loop, "opt": _opt,
+        "UNDEF": UNDEF}
+
+
+class _NameCollector(ast.NodeVisitor):
+    def __init__(self):
+        self.names = []
+
+    def _add(self, n):
+        if n not in self.names and not n.startswith("__jst"):
+            self.names.append(n)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store,)):
+            self._add(node.id)
+
+    def visit_AugAssign(self, node):
+        if isinstance(node.target, ast.Name):
+            self._add(node.target.id)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        for t in ast.walk(node.target):
+            if isinstance(t, ast.Name):
+                self._add(t.id)
+        self.generic_visit(node)
+
+    # don't descend into nested function/class scopes
+    def visit_FunctionDef(self, node):
+        self._add(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self._add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _assigned_names(stmts):
+    c = _NameCollector()
+    for s in stmts:
+        c.visit(s)
+    return c.names
+
+
+def _has_flow_escape(node_or_stmts):
+    """Conservatively: any break/continue/return in these statements,
+    recursing into compound statements but NOT into nested function/class
+    scopes (their control flow cannot escape into ours)."""
+    stmts = node_or_stmts if isinstance(node_or_stmts, list) \
+        else [node_or_stmts]
+    for s in stmts:
+        if isinstance(s, (ast.Return, ast.Break, ast.Continue)):
+            return True
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        for child in ast.iter_child_nodes(s):
+            if _has_flow_escape(child):
+                return True
+    return False
+
+
+def _names_in_expr(expr):
+    return [n.id for n in ast.walk(expr) if isinstance(n, ast.Name)
+            and isinstance(n.ctx, ast.Load)]
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+
+    def _tuple(self, names, ctx):
+        return ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ctx()) for n in names], ctx=ctx())
+
+    def _branch_fn(self, fname, names, body):
+        """def fname(__jst_c): (names) = __jst_c; body; return (names)"""
+        stmts = []
+        if names:
+            stmts.append(ast.Assign(
+                targets=[self._tuple(names, ast.Store)],
+                value=ast.Name(id="__jst_c", ctx=ast.Load())))
+        stmts.extend(body)
+        stmts.append(ast.Return(value=self._tuple(names, ast.Load)))
+        return ast.FunctionDef(
+            name=fname,
+            args=ast.arguments(posonlyargs=[], args=[
+                ast.arg(arg="__jst_c")], kwonlyargs=[], kw_defaults=[],
+                defaults=[]),
+            body=stmts, decorator_list=[])
+
+    def _opt_tuple(self, names):
+        """(_jst_opt(lambda: a), _jst_opt(lambda: b), ...)"""
+        elts = []
+        for n in names:
+            elts.append(ast.Call(
+                func=ast.Name(id="__jst_opt", ctx=ast.Load()),
+                args=[ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[],
+                                       kwonlyargs=[], kw_defaults=[],
+                                       defaults=[]),
+                    body=ast.Name(id=n, ctx=ast.Load()))],
+                keywords=[]))
+        return ast.Tuple(elts=elts, ctx=ast.Load())
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_flow_escape(node.body) or _has_flow_escape(node.orelse):
+            return node  # python semantics preserved; needs concrete pred
+        names = _assigned_names(node.body + node.orelse)
+        if not names:
+            return node
+        k = self.counter
+        self.counter += 1
+        tfn = self._branch_fn(f"__jst_true_{k}", names, node.body)
+        ffn = self._branch_fn(
+            f"__jst_false_{k}", names,
+            node.orelse or [ast.Pass()])
+        call = ast.Assign(
+            targets=[self._tuple(names, ast.Store)],
+            value=ast.Call(
+                func=ast.Name(id="__jst_cond", ctx=ast.Load()),
+                args=[node.test,
+                      ast.Name(id=f"__jst_true_{k}", ctx=ast.Load()),
+                      ast.Name(id=f"__jst_false_{k}", ctx=ast.Load()),
+                      self._opt_tuple(names)],
+                keywords=[]))
+        return [tfn, ffn, call]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_flow_escape(node.body):
+            return node
+        names = _assigned_names(node.body)
+        # (loop-invariant reads in the test close over the outer scope)
+        if not names:
+            return node
+        k = self.counter
+        self.counter += 1
+        cond_stmts = []
+        if names:
+            cond_stmts.append(ast.Assign(
+                targets=[self._tuple(names, ast.Store)],
+                value=ast.Name(id="__jst_c", ctx=ast.Load())))
+        cond_stmts.append(ast.Return(value=node.test))
+        cfn = ast.FunctionDef(
+            name=f"__jst_wcond_{k}",
+            args=ast.arguments(posonlyargs=[], args=[
+                ast.arg(arg="__jst_c")], kwonlyargs=[], kw_defaults=[],
+                defaults=[]),
+            body=cond_stmts, decorator_list=[])
+        bfn = self._branch_fn(f"__jst_wbody_{k}", names, node.body)
+        call = ast.Assign(
+            targets=[self._tuple(names, ast.Store)],
+            value=ast.Call(
+                func=ast.Name(id="__jst_while", ctx=ast.Load()),
+                args=[ast.Name(id=f"__jst_wcond_{k}", ctx=ast.Load()),
+                      ast.Name(id=f"__jst_wbody_{k}", ctx=ast.Load()),
+                      self._opt_tuple(names)],
+                keywords=[]))
+        return [cfn, bfn, call]
+
+
+_CONVERTED = {}
+
+
+def convert_to_static(fn):
+    """Return a control-flow-converted version of `fn` (cached). Falls
+    back to the original on any source/AST failure (builtins, C
+    functions, exotic syntax)."""
+    cached = _CONVERTED.get(fn)
+    if cached is not None:
+        return cached
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        fdef.decorator_list = []
+        new = _ControlFlowTransformer().visit(fdef)
+        mod = ast.Module(body=[new], type_ignores=[])
+        ast.fix_missing_locations(mod)
+        glb = dict(fn.__globals__)
+        glb["__jst_cond"] = cond
+        glb["__jst_while"] = while_loop
+        glb["__jst_opt"] = _opt
+        # closures: bind current cell values by name (static snapshot)
+        if fn.__closure__:
+            for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+                try:
+                    glb[name] = cell.cell_contents
+                except ValueError:
+                    pass
+        code = compile(mod, filename=f"<dy2static {fn.__qualname__}>",
+                       mode="exec")
+        ns = {}
+        exec(code, glb, ns)
+        out = ns[fdef.name]
+        out = functools.wraps(fn)(out)
+        out.__wrapped_original__ = fn
+    except (OSError, TypeError, SyntaxError):
+        out = fn
+    _CONVERTED[fn] = out
+    return out

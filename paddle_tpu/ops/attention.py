@@ -316,7 +316,7 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
     def dkv_kernel(*refs):
         if has_bias:
             (q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, dl_ref,
-             dk_ref, dv_ref) = refs
+             dk_ref, dv_ref, db_ref) = refs
         else:
             (q_ref, k_ref, v_ref, g_ref, lse_ref, dl_ref, dk_ref,
              dv_ref) = refs
@@ -327,7 +327,7 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
             bb = b_ref[...]
 
         def body(qi, carry):
-            dk_acc, dv_acc = carry
+            dk_acc, dv_acc, db_acc = carry
             qb = q_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
             gb = g_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
             lse_b = lse_ref[pl.ds(qi * block_q, block_q)][:, None]
@@ -346,19 +346,25 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
             dv_acc = dv_acc + jnp.dot(p.T, gb,
                                       preferred_element_type=jnp.float32)
             dp = jnp.dot(gb, vb.T, preferred_element_type=jnp.float32)
-            ds = p * (dp - dl_b) * s
+            dlogits = p * (dp - dl_b)   # d loss / d (q.k*s + bias)
+            db_acc = db_acc + dlogits.sum(axis=0)
+            ds = dlogits * s
             dk_acc = dk_acc + jnp.dot(ds.T, qb,
                                       preferred_element_type=jnp.float32)
-            return dk_acc, dv_acc
+            return dk_acc, dv_acc, db_acc
 
         if is_causal:
             q_lo = (ki * block_k) // block_q
         else:
             q_lo = 0
         z = jnp.zeros((block_k, d), jnp.float32)
-        dk_acc, dv_acc = jax.lax.fori_loop(q_lo, nq, body, (z, z))
+        zb = jnp.zeros((block_k,), jnp.float32)
+        dk_acc, dv_acc, db_acc = jax.lax.fori_loop(
+            q_lo, nq, body, (z, z, zb))
         dk_ref[...] = dk_acc.astype(dk_ref.dtype)
         dv_ref[...] = dv_acc.astype(dv_ref.dtype)
+        if has_bias:
+            db_ref[...] = db_acc
 
     dkv_in = [
         pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, 0, 0)),
@@ -375,21 +381,33 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
     ]
     dkv_args = [qr, kr, vr] + ([bias_bh] if has_bias else []) + \
         [gr, lse, delta]
-    dk, dv = pl.pallas_call(
+    out_specs = [
+        pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+        jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+    ]
+    if has_bias:
+        out_specs.append(pl.BlockSpec((None, block_k),
+                                      lambda bh, ki: (bh, ki)))
+        out_shape.append(jax.ShapeDtypeStruct((b * h, sk), jnp.float32))
+    outs = pl.pallas_call(
         dkv_kernel, grid=(b * h, nk), in_specs=dkv_in,
-        out_specs=[
-            pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
-        ],
+        out_specs=out_specs, out_shape=out_shape,
         interpret=interpret,
     )(*dkv_args)
+    if has_bias:
+        dk, dv, db_bh = outs
+        # bias is per (batch, key): sum the head axis
+        dbias = db_bh.reshape(b, h, sk).sum(axis=1).astype(bias.dtype)
+    else:
+        dk, dv = outs
+        dbias = None
 
     return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
-            dv.reshape(b, h, sk, d))
+            dv.reshape(b, h, sk, d), dbias)
 
 
 # --------------------------------------------------------------------------
@@ -413,10 +431,10 @@ def _flash_diff_fn(is_causal, scale, has_bias, interpret):
 
     def bwd(res, g):
         q, k, v, bias, out, lse = res
-        dq, dk, dv = flash_attention_bwd(q, k, v, bias, out, lse, g,
-                                         is_causal, scale,
-                                         interpret=interpret)
-        return dq, dk, dv, None
+        dq, dk, dv, dbias = flash_attention_bwd(q, k, v, bias, out, lse,
+                                                g, is_causal, scale,
+                                                interpret=interpret)
+        return dq, dk, dv, dbias
 
     f.defvjp(fwd, bwd)
     return f

@@ -1,0 +1,219 @@
+"""dygraph-to-static: AST control-flow translation + jit.save/load.
+
+Reference analogue: unittests/dygraph_to_static/ (IfElse/Loop transformer
+tests, test_save_inference_model): a model with DATA-DEPENDENT branching
+must compile to one static computation, export, and serve through the
+inference Predictor.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit.dy2static import convert_to_static
+
+
+def branchy(x):
+    y = x * 2
+    if y.sum() > 0:
+        z = y + 10
+    else:
+        z = y - 10
+    return z
+
+
+def loopy(x):
+    s = paddle.to_tensor(np.float32(0.0))
+    i = paddle.to_tensor(np.float32(0.0))
+    while i < x.shape[0]:
+        s = s + x[0] * 0 + i  # touch x so it participates
+        i = i + 1
+    return s
+
+
+def nested(x):
+    total = paddle.to_tensor(np.float32(0.0))
+    i = paddle.to_tensor(np.float32(0.0))
+    while i < 4:
+        if (i % 2) == 0:
+            total = total + x.sum()
+        else:
+            total = total - 1.0
+        i = i + 1
+    return total
+
+
+class BranchNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.fc(x)
+        if h.mean() > 0:
+            out = paddle.tanh(h)
+        else:
+            out = paddle.exp(h) * 0.5
+        n = paddle.to_tensor(np.float32(0.0))
+        k = paddle.to_tensor(np.float32(0.0))
+        while k < 2:
+            n = n + out.mean()
+            k = k + 1
+        return out * paddle.tanh(n)  # loop result feeds the output
+
+
+def test_convert_if_parity_both_branches():
+    cf = convert_to_static(branchy)
+    for sign in (1.0, -1.0):
+        x = paddle.to_tensor(np.full((3,), sign, "float32"))
+        np.testing.assert_allclose(np.asarray(cf(x).numpy()),
+                                   np.asarray(branchy(x).numpy()))
+
+
+def test_convert_if_under_jit_one_trace():
+    import jax
+
+    cf = convert_to_static(branchy)
+    traces = []
+
+    def run(xr):
+        traces.append(1)
+        return cf(paddle.to_tensor(xr))._data
+
+    jf = jax.jit(run)
+    pos = jf(np.ones((3,), "float32"))
+    neg = jf(-np.ones((3,), "float32"))
+    assert len(traces) == 1  # ONE compilation serves both branches
+    np.testing.assert_allclose(np.asarray(pos), [12, 12, 12])
+    np.testing.assert_allclose(np.asarray(neg), [-12, -12, -12])
+
+
+def test_convert_while_and_nested():
+    import jax
+
+    cf = convert_to_static(loopy)
+    x = paddle.to_tensor(np.zeros((5,), "float32"))
+    assert float(cf(x).numpy()) == 0 + 1 + 2 + 3 + 4
+
+    cn = convert_to_static(nested)
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    want = float(nested(x).numpy())
+    got = float(jax.jit(lambda xr: cn(paddle.to_tensor(xr))._data)(
+        np.ones((2,), "float32")))
+    assert got == want == 2 + 2 - 2  # i=0,2 add 2; i=1,3 subtract 1
+
+
+def test_to_static_layer_branches():
+    net = BranchNet()
+    net.eval()
+    s = paddle.jit.to_static(net)
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    with paddle.no_grad():
+        out = s(x)
+    eager = net.forward._fn(net, x)  # converted fn, eager path
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(eager.numpy()), rtol=1e-6)
+    assert len(net.forward._cache) == 1  # compiled, not eager fallback
+
+
+def test_jit_save_load_translated_layer(tmp_path):
+    from paddle_tpu.static import InputSpec
+
+    net = BranchNet()
+    net.eval()
+    path = str(tmp_path / "branch_model")
+    paddle.jit.save(net, path,
+                    input_spec=[InputSpec([2, 4], "float32")])
+    assert os.path.exists(os.path.join(path, "__model__"))
+    assert os.path.exists(os.path.join(path, "__export__.bin"))
+
+    loaded = paddle.jit.load(path)
+    for sign in (1.0, -1.0):
+        x = np.full((2, 4), sign, "float32")
+        want = net.forward._fn(net, paddle.to_tensor(x)) if hasattr(
+            net.forward, "_fn") else net(paddle.to_tensor(x))
+        with paddle.no_grad():
+            want = net(paddle.to_tensor(x))
+        got = loaded(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(got.numpy()),
+                                   np.asarray(want.numpy()), rtol=1e-5,
+                                   atol=1e-6)
+    with pytest.raises(RuntimeError):
+        loaded.train()
+    assert "fc.weight" in loaded.state_dict()
+
+
+def test_jit_saved_model_serves_via_predictor(tmp_path):
+    """The __model__ written by jit.save loads in the inference Predictor
+    (XLA engine) — branching preserved inside the artifact."""
+    from paddle_tpu import inference
+    from paddle_tpu.static import InputSpec
+
+    net = BranchNet()
+    net.eval()
+    path = str(tmp_path / "served_model")
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 4], "float32")])
+
+    cfg = inference.Config(path)
+    cfg.enable_xla_engine()
+    pred = inference.Predictor(cfg)
+    assert pred.get_input_names() == ["x_0"]
+    for sign in (1.0, -1.0):
+        x = np.full((2, 4), sign, "float32")
+        (out,) = pred.run([x])
+        with paddle.no_grad():
+            want = net(paddle.to_tensor(x))
+        np.testing.assert_allclose(out, np.asarray(want.numpy()),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_program_translator_disable():
+    from paddle_tpu.jit import ProgramTranslator, TracedFunction
+
+    ProgramTranslator.get_instance().enable(False)
+    try:
+        tf = TracedFunction(branchy)
+        assert tf._fn is branchy  # no conversion when disabled
+    finally:
+        ProgramTranslator.get_instance().enable(True)
+
+
+def early_return(x):
+    if x.sum() > 0:
+        g = lambda: 1  # noqa: E731 — lambda BEFORE the return in the walk
+        if x.mean() > 100:
+            return x + 100
+    y = x - 1
+    return y
+
+
+def test_flow_escape_detected_past_lambda():
+    """A return nested after a lambda in the branch must still block the
+    transform (python semantics preserved)."""
+    cf = convert_to_static(early_return)
+    x = paddle.to_tensor(np.full((2,), 200.0, "float32"))
+    np.testing.assert_allclose(np.asarray(cf(x).numpy()),
+                               np.asarray(early_return(x).numpy()))
+    x2 = paddle.to_tensor(np.full((2,), -5.0, "float32"))
+    np.testing.assert_allclose(np.asarray(cf(x2).numpy()),
+                               np.asarray(early_return(x2).numpy()))
+
+
+def test_enable_to_static_dynamic_toggle():
+    net = BranchNet()
+    net.eval()
+    s = paddle.jit.to_static(net)
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    with paddle.no_grad():
+        out_on = s(x)
+    paddle.jit.enable_to_static(False)
+    try:
+        assert s.forward._fn is s.forward._orig  # toggle took effect
+        with paddle.no_grad():
+            out_off = s(x)
+    finally:
+        paddle.jit.enable_to_static(True)
+    np.testing.assert_allclose(np.asarray(out_on.numpy()),
+                               np.asarray(out_off.numpy()), rtol=1e-6)

@@ -100,3 +100,30 @@ def test_kv_bias_normalization():
     out2 = A._kv_bias(jnp.asarray(mb), b, h, sk)
     assert float(np.asarray(out2)[1, 0]) < -1e20
     assert float(np.asarray(out2)[0, 0]) == 0.0
+
+
+def test_flash_bias_gradient():
+    """d(loss)/d(bias) must be real (ALiBi-style learned biases), not a
+    silent zero."""
+    import jax
+
+    b, h, s, d = 2, 2, 64, 16
+    q, k, v = _rand((b, h, s, d), 7), _rand((b, h, s, d), 8), \
+        _rand((b, h, s, d), 9)
+    bias0 = (_rand((b, s), 10) * 0.1).astype("float32")
+    cot = _rand((b, h, s, d), 11)
+
+    def flash_loss(bias):
+        out = A.flash_attention(q, k, v, bias, False, None,
+                                interpret=True)
+        return (out * cot).sum()
+
+    def ref_loss(bias):
+        out = A.sdpa_reference(q, k, v, bias[:, None, None, :], False)
+        return (out * cot).sum()
+
+    g_fl = jax.grad(flash_loss)(bias0)
+    g_ref = jax.grad(ref_loss)(bias0)
+    assert float(np.abs(np.asarray(g_ref)).max()) > 1e-4
+    np.testing.assert_allclose(np.asarray(g_fl), np.asarray(g_ref),
+                               rtol=2e-3, atol=2e-4)
