@@ -54,6 +54,7 @@ from . import profiler  # noqa: F401
 from . import sparse  # noqa: F401
 from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
+from . import slim  # noqa: F401
 
 from .io.serialization import load, save  # noqa: F401
 from .hapi.model import Model  # noqa: F401

@@ -1279,6 +1279,109 @@ def _noop(ctx, op):
     pass
 
 
+# ====== int8 quantized kernels (contrib/slim PTQ output ops) ======
+# The MXU multiplies int8 natively: activations quantize on the fly
+# (scale calibrated offline), weights are stored int8, accumulation in
+# int32, dequant folds into one multiply. Reference capability:
+# api/mkldnn_quantizer.cc / quantization_pass.py outputs.
+
+def _quant_act_int8(x, s_in):
+    jnp = _jnp()
+    return jnp.clip(jnp.round(x / s_in), -127, 127).astype(jnp.int8)
+
+
+def _dequant_scales(op, w):
+    import numpy as np
+
+    scales = np.asarray(op.attrs["weight_scales"], np.float32)
+    axis = op.attrs.get("weight_channel_axis", -1)
+    return scales, axis
+
+
+@register("quantized_mul")
+@register("quantized_matmul")
+@register("quantized_matmul_v2")
+def _quantized_mul(ctx, op):
+    import jax
+
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    w = ctx.inp(op, "Y")
+    s_in = op.attrs["in_scale"]
+    scales, axis = _dequant_scales(op, w)
+    if op.type == "quantized_mul":
+        ncol = op.attrs.get("x_num_col_dims", 1)
+        if op.input("X") and op.input("X")[0] + _LOD_SUFFIX in ctx.env:
+            ncol += 1
+        lead = x.shape[:ncol]
+        xm = x.reshape((int(np.prod(lead)) if lead else 1, -1))
+    else:
+        if op.attrs.get("transpose_X", op.attrs.get("trans_x", False)):
+            x = jnp.swapaxes(x, -1, -2)
+        lead = x.shape[:-1]
+        xm = x.reshape((-1, x.shape[-1]))
+        if op.attrs.get("transpose_Y", op.attrs.get("trans_y", False)):
+            # PTQ quantized transposed weights along axis 0 (the OUTPUT
+            # channels of w.T) — after this transpose the scales align
+            # with acc's columns
+            w = w.T
+    xq = _quant_act_int8(xm, s_in)
+    acc = jax.lax.dot_general(
+        xq, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    s_w = np.asarray(scales, np.float32)
+    if s_w.size == acc.shape[1]:
+        out = acc.astype(jnp.float32) * (s_in * jnp.asarray(s_w))[None, :]
+    else:
+        out = acc.astype(jnp.float32) * (s_in * float(s_w.reshape(-1)[0]))
+    alpha = op.attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    ctx.out(op, "Out", out.reshape(tuple(lead) + (out.shape[-1],)))
+
+
+@register("quantized_conv2d")
+@register("quantized_depthwise_conv2d")
+def _quantized_conv2d(ctx, op):
+    import jax
+
+    jnp = _jnp()
+    from ..ops.kernels import _conv_padding, _pair
+
+    x = ctx.inp(op, "Input")
+    w = ctx.inp(op, "Filter")
+    s_in = op.attrs["in_scale"]
+    scales, _ = _dequant_scales(op, w)
+    stride = _pair(op.attrs.get("strides", [1, 1]))
+    dil = _pair(op.attrs.get("dilations", [1, 1]))
+    # same padding normalization as the fp32 conv2d kernel (int, pair,
+    # 4-element, SAME/VALID)
+    pad = _conv_padding(op.attrs.get("paddings", [0, 0]),
+                        (w.shape[2], w.shape[3]), stride, dil)
+    groups = op.attrs.get("groups", 1)
+    xq = _quant_act_int8(x, s_in)
+    try:
+        acc = jax.lax.conv_general_dilated(
+            xq.astype(jnp.int8), w.astype(jnp.int8),
+            window_strides=stride, padding=pad, rhs_dilation=dil,
+            feature_group_count=groups,
+            preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32)
+    except Exception:
+        # backend without integer conv: same numerics via float math over
+        # the int8-valued operands
+        out = jax.lax.conv_general_dilated(
+            xq.astype(jnp.float32), w.astype(jnp.float32),
+            window_strides=stride, padding=pad, rhs_dilation=dil,
+            feature_group_count=groups)
+    s_w = jnp.asarray(scales, jnp.float32)
+    if s_w.ndim and s_w.shape[0] == out.shape[1]:
+        out = out * (s_in * s_w)[None, :, None, None]
+    else:
+        out = out * (s_in * float(np.asarray(scales).reshape(-1)[0]))
+    ctx.out(op, "Output", out)
+
+
 _EXPORTED_CACHE = {}
 
 
@@ -1298,13 +1401,17 @@ def _jax_exported(ctx, op):
             "jax_exported op needs program._model_dir (load the program "
             "via fluid.io.load_inference_model / paddle.inference)")
     path = os.path.join(model_dir, op.attrs["artifact"])
-    exported = _EXPORTED_CACHE.get(path)
+    # key on mtime too: re-saving a model into the same directory must
+    # not serve the stale artifact
+    key = (path, os.path.getmtime(path))
+    exported = _EXPORTED_CACHE.get(key)
     if exported is None:
         from jax import export as jexport
 
         with open(path, "rb") as f:
             exported = jexport.deserialize(bytearray(f.read()))
-        _EXPORTED_CACHE[path] = exported
+        _EXPORTED_CACHE.clear()
+        _EXPORTED_CACHE[key] = exported
     ins = ctx.inps(op, "X")
     outs = exported.call(*ins)
     ctx.outs(op, "Out", tuple(outs))

@@ -87,8 +87,7 @@ class TracedFunction:
                 return self._fn(layer, *args, **kwargs)
             return self._fn(*args, **kwargs)
 
-        key = (self._signature(args),
-               ProgramTranslator.get_instance().enabled)
+        key = self._signature(args)  # translator-off calls return above
         compiled = self._cache.get(key)
         if compiled is None:
             fn = self._fn

@@ -319,6 +319,25 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 _CONVERTED = {}
 
 
+class _SuperRewriter(ast.NodeTransformer):
+    """Zero-arg super() relies on the implicit __class__ closure cell,
+    which an exec-recompiled function lacks; rewrite to the explicit
+    two-arg form bound to the original class."""
+
+    def __init__(self, first_arg):
+        self.first_arg = first_arg
+        self.used = False
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if isinstance(node.func, ast.Name) and node.func.id == "super" \
+                and not node.args and self.first_arg:
+            self.used = True
+            node.args = [ast.Name(id="__jst_class__", ctx=ast.Load()),
+                         ast.Name(id=self.first_arg, ctx=ast.Load())]
+        return node
+
+
 def convert_to_static(fn):
     """Return a control-flow-converted version of `fn` (cached). Falls
     back to the original on any source/AST failure (builtins, C
@@ -331,10 +350,25 @@ def convert_to_static(fn):
         tree = ast.parse(src)
         fdef = tree.body[0]
         fdef.decorator_list = []
+        first_arg = fdef.args.args[0].arg if fdef.args.args else None
+        sup = _SuperRewriter(first_arg)
+        sup.visit(fdef)
         new = _ControlFlowTransformer().visit(fdef)
         mod = ast.Module(body=[new], type_ignores=[])
         ast.fix_missing_locations(mod)
         glb = dict(fn.__globals__)
+        if sup.used:
+            cls = None
+            if fn.__closure__ and "__class__" in fn.__code__.co_freevars:
+                cell = fn.__closure__[
+                    fn.__code__.co_freevars.index("__class__")]
+                try:
+                    cls = cell.cell_contents
+                except ValueError:
+                    pass
+            if cls is None:
+                raise TypeError("zero-arg super() without __class__ cell")
+            glb["__jst_class__"] = cls
         glb["__jst_cond"] = cond
         glb["__jst_while"] = while_loop
         glb["__jst_opt"] = _opt

@@ -1,0 +1,16 @@
+"""paddle.slim: quantization (PTQ + QAT).
+
+Reference parity: fluid/contrib/slim/quantization —
+post_training_quantization.py (PostTrainingQuantization),
+quantization_pass.py:211 (QuantizationTransformPass) and imperative QAT
+(imperative/qat.py ImperativeQuantAware). TPU-native design: PTQ is a
+program-IR pass whose output runs REAL int8 matmuls on the MXU
+(lax.dot_general with int8 operands accumulating in int32), not a
+simulated pass; QAT wraps layers with straight-through fake-quant so the
+trained model exports to the same artifact family.
+"""
+from .quant import (ImperativeQuantAware, PostTrainingQuantization,
+                    quant_post_static)
+
+__all__ = ["PostTrainingQuantization", "quant_post_static",
+           "ImperativeQuantAware"]

@@ -1,0 +1,359 @@
+"""Quantization: post-training int8 + quantization-aware training.
+
+Reference parity:
+- PostTrainingQuantization (contrib/slim/quantization/
+  post_training_quantization.py): load an inference model, run
+  calibration batches collecting activation abs-max, quantize weights
+  per-channel, rewrite the program, save the deployable artifact.
+- QuantizationTransformPass (quantization_pass.py:211) — here the
+  rewrite swaps quantizable ops for `quantized_*` op types whose
+  lowerings do int8 MXU math (fluid/lowering.py).
+- ImperativeQuantAware (imperative/qat.py): wrap Linear/Conv2D with
+  straight-through fake-quant for QAT; export via paddle.jit.save.
+"""
+from __future__ import annotations
+
+import copy
+import os
+
+import numpy as np
+
+QUANTIZABLE_OP_TYPES = ("conv2d", "depthwise_conv2d", "mul", "matmul",
+                        "matmul_v2")
+
+# op type -> (activation input slot, weight input slot, weight out-channel
+# axis for per-channel scales)
+_OP_SLOTS = {
+    "conv2d": ("Input", "Filter", 0),
+    "depthwise_conv2d": ("Input", "Filter", 0),
+    "mul": ("X", "Y", 1),
+    "matmul": ("X", "Y", 1),
+    "matmul_v2": ("X", "Y", 1),
+}
+
+
+class PostTrainingQuantization:
+    """Calibrate + quantize a saved inference model.
+
+    usage:
+        ptq = PostTrainingQuantization(
+            executor=exe, model_dir=fp32_dir,
+            sample_generator=gen,       # yields feed dicts
+            batch_nums=8)
+        program = ptq.quantize()
+        ptq.save_quantized_model(int8_dir)
+    """
+
+    def __init__(self, executor, model_dir, sample_generator=None,
+                 data_loader=None, batch_nums=8, algo="abs_max",
+                 quantizable_op_type=QUANTIZABLE_OP_TYPES,
+                 weight_quantize_type="channel_wise_abs_max",
+                 model_filename=None, params_filename=None, scope=None):
+        from ..fluid.executor import Scope
+        from ..fluid.io import load_inference_model
+
+        self.exe = executor
+        self.model_dir = model_dir
+        self.samples = sample_generator or data_loader
+        self.batch_nums = batch_nums
+        self.algo = algo
+        self.op_types = tuple(quantizable_op_type)
+        self.weight_qtype = weight_quantize_type
+        self.scope = scope or Scope()
+        from ..fluid.executor import scope_guard
+
+        with scope_guard(self.scope):
+            prog, feeds, fetches = load_inference_model(
+                model_dir, executor, model_filename, params_filename)
+        self.program = prog
+        self.feed_names = feeds
+        self.fetch_vars = fetches
+        self._quant_program = None
+
+    # ------------------------------------------------------------------
+    def _calibrate(self):
+        """Per-quantizable-op activation abs-max over calibration batches
+        (algo='abs_max'; the reference's KL/hist algos reduce to scale
+        selection over the same collected maxima)."""
+        from ..fluid.executor import scope_guard
+
+        act_names = []
+        for op in self.program.global_block().ops:
+            if op.type in self.op_types and op.type in _OP_SLOTS:
+                a_slot, _, _ = _OP_SLOTS[op.type]
+                n = op.input(a_slot)
+                if n:
+                    act_names.append(n[0])
+        act_names = sorted(set(act_names))
+        maxima = {n: 0.0 for n in act_names}
+        if not self.samples:
+            raise ValueError("PostTrainingQuantization needs a "
+                             "sample_generator/data_loader to calibrate")
+        with scope_guard(self.scope):
+            for i, feed in enumerate(self.samples()):
+                if i >= self.batch_nums:
+                    break
+                outs = self.exe.run(self.program, feed=feed,
+                                    fetch_list=act_names,
+                                    scope=self.scope)
+                for n, v in zip(act_names, outs):
+                    maxima[n] = max(maxima[n],
+                                    float(np.abs(np.asarray(v)).max()))
+        return maxima
+
+    # ------------------------------------------------------------------
+    def quantize(self):
+        if self._quant_program is not None:
+            return self._quant_program  # idempotent
+        act_max = self._calibrate()
+        prog = copy.deepcopy(self.program)
+        blk = prog.global_block()
+        # snapshot FLOAT weights first: the scope mutates to int8 below,
+        # and a weight shared by several ops must quantize from the float
+        # original with ONE (w_q, scales) shared by all consumers
+        float_w = {}
+        for op in blk.ops:
+            if op.type in self.op_types and op.type in _OP_SLOTS:
+                for w_name in op.input(_OP_SLOTS[op.type][1]):
+                    v = self.scope.get_value(w_name)
+                    if v is not None and w_name not in float_w:
+                        float_w[w_name] = np.asarray(v, np.float32)
+        quantized = {}  # w_name -> (ch_axis, scales)
+        for op in blk.ops:
+            if op.type not in self.op_types or op.type not in _OP_SLOTS:
+                continue
+            a_slot, w_slot, ch_axis = _OP_SLOTS[op.type]
+            if not op.input(a_slot) or not op.input(w_slot):
+                continue
+            a_name = op.input(a_slot)[0]
+            w_name = op.input(w_slot)[0]
+            if w_name not in float_w or a_name not in act_max:
+                continue
+            # channel axis follows the OUTPUT channels; transposed matmul
+            # weights carry them on axis 0
+            if op.type in ("matmul", "matmul_v2") and op.attrs.get(
+                    "transpose_Y", op.attrs.get("trans_y", False)):
+                ch_axis = 0
+            if w_name in quantized:
+                prev_axis, scales = quantized[w_name]
+                if prev_axis != ch_axis:
+                    # consumers disagree on channel axis: redo per-tensor
+                    w = float_w[w_name]
+                    s_w = np.abs(w).max() / 127.0
+                    s_w = max(float(s_w), 1e-8)
+                    self.scope.set_value(w_name, np.clip(
+                        np.round(w / s_w), -127, 127).astype(np.int8))
+                    scales = [s_w]
+                    quantized[w_name] = (-2, scales)
+            else:
+                w = float_w[w_name]
+                if self.weight_qtype == "channel_wise_abs_max":
+                    red = tuple(i for i in range(w.ndim) if i != ch_axis)
+                    s_w = np.maximum(np.abs(w).max(axis=red),
+                                     1e-8) / 127.0
+                    shape = [1] * w.ndim
+                    shape[ch_axis] = -1
+                    w_q = np.clip(np.round(w / s_w.reshape(shape)),
+                                  -127, 127).astype(np.int8)
+                    scales = [float(x) for x in np.atleast_1d(s_w)]
+                else:
+                    s_w = max(float(np.abs(w).max()), 1e-8) / 127.0
+                    w_q = np.clip(np.round(w / s_w),
+                                  -127, 127).astype(np.int8)
+                    scales = [s_w]
+                self.scope.set_value(w_name, w_q)
+                quantized[w_name] = (ch_axis, scales)
+                if blk.has_var(w_name):
+                    blk.var(w_name).dtype = np.dtype(np.int8)
+            s_in = max(act_max[a_name], 1e-8) / 127.0
+            op.type = "quantized_" + op.type
+            op.attrs["in_scale"] = float(s_in)
+            op.attrs["weight_scales"] = quantized[w_name][1]
+            op.attrs["weight_channel_axis"] = quantized[w_name][0]
+        self._quant_program = prog
+        return prog
+
+    # ------------------------------------------------------------------
+    def save_quantized_model(self, save_model_path, model_filename=None,
+                             params_filename=None):
+        from ..fluid.executor import scope_guard
+        from ..fluid.io import save_inference_model
+
+        if self._quant_program is None:
+            self.quantize()
+        with scope_guard(self.scope):
+            save_inference_model(
+                save_model_path, self.feed_names,
+                [self._quant_program.global_block().var(v.name)
+                 for v in self.fetch_vars],
+                self.exe, main_program=self._quant_program,
+                model_filename=model_filename,
+                params_filename=params_filename)
+
+
+def quant_post_static(executor, model_dir, quantize_model_path,
+                      sample_generator=None, data_loader=None,
+                      batch_nums=8, algo="abs_max",
+                      quantizable_op_type=QUANTIZABLE_OP_TYPES, **kw):
+    """paddleslim.quant.quant_post_static-shaped convenience wrapper."""
+    ptq = PostTrainingQuantization(
+        executor, model_dir, sample_generator=sample_generator,
+        data_loader=data_loader, batch_nums=batch_nums, algo=algo,
+        quantizable_op_type=quantizable_op_type, **kw)
+    ptq.quantize()
+    ptq.save_quantized_model(quantize_model_path)
+    return ptq
+
+
+# ==========================================================================
+# QAT: straight-through fake quantization for eager layers
+# ==========================================================================
+
+def fake_quant(x, scale, bits=8):
+    """Quantize-dequantize with a straight-through gradient
+    (fake_quantize_dequantize ops + the STE the reference's QAT uses)."""
+    import jax
+
+    bound = 2.0 ** (bits - 1) - 1
+
+    @jax.custom_vjp
+    def f(x, s):
+        q = jax.numpy.clip(jax.numpy.round(x / s), -bound, bound)
+        return q * s
+
+    def fwd(x, s):
+        return f(x, s), (x, s)
+
+    def bwd(res, g):
+        x, s = res
+        mask = (jax.numpy.abs(x) <= bound * s).astype(g.dtype)
+        return g * mask, None
+
+    f.defvjp(fwd, bwd)
+    return f(x, scale)
+
+
+class _QuantWrapper:
+    """Mixin: weight abs-max fake quant + activation moving-max quant."""
+
+    def _init_qat(self, inner, momentum=0.9):
+        self._inner = inner
+        self._act_max = 1.0
+        self._mom = momentum
+
+    def _quant_act(self, x, training=True):
+        from ..core.tensor import apply_op
+
+        raw = x._data
+        if training and not _is_tracer(raw):
+            # numpy on the host: under an active jit trace every jnp op
+            # is staged (omnistaging), but concrete arrays convert fine
+            cur = float(np.abs(np.asarray(raw)).max())
+            self._act_max = self._mom * self._act_max + \
+                (1 - self._mom) * max(cur, 1e-8)
+        s = max(self._act_max, 1e-8) / 127.0
+        # through the tape so the STE gradient reaches upstream layers
+        return apply_op("fake_quant_act",
+                        lambda r: fake_quant(r, s), [x]), s
+
+    def _quant_w(self, w):
+        from ..core.tensor import apply_op
+
+        if not _is_tracer(w._data):
+            absmax = float(np.abs(np.asarray(w._data)).max())
+            self._w_scale = max(absmax, 1e-8) / 127.0
+        s = getattr(self, "_w_scale", 1.0 / 127.0)
+        return apply_op("fake_quant_weight",
+                        lambda r: fake_quant(r, s), [w])
+
+
+def _is_tracer(v):
+    import jax.core
+
+    return isinstance(v, jax.core.Tracer)
+
+
+class QuantedLinear(_QuantWrapper):
+    """Declared as an nn.Layer holding the ORIGINAL parameters under the
+    original names ('weight'/'bias'), so state_dict keys are unchanged
+    after quantization (the reference ImperativeQuantAware contract)."""
+
+    def __new__(cls, inner):
+        from .. import nn
+
+        class _Q(nn.Layer, _QuantWrapper):
+            def __init__(self, inner):
+                super().__init__()
+                self._init_qat(inner)
+                self.weight = inner.weight
+                self.bias = inner.bias
+
+            def forward(self, x):
+                import paddle_tpu.nn.functional as F
+
+                xq, _ = self._quant_act(x, self.training)
+                wq = self._quant_w(self.weight)
+                return F.linear(xq, wq, self.bias)
+
+        return _Q(inner)
+
+
+class QuantedConv2D(_QuantWrapper):
+    def __new__(cls, inner):
+        from .. import nn
+
+        class _Q(nn.Layer, _QuantWrapper):
+            def __init__(self, inner):
+                super().__init__()
+                self._init_qat(inner)
+                self.weight = inner.weight
+                self.bias = inner.bias
+                self._cfg = (inner._stride, inner._padding,
+                             inner._dilation, inner._groups)
+
+            def forward(self, x):
+                import paddle_tpu.nn.functional as F
+
+                st, pad, dil, grp = self._cfg
+                xq, _ = self._quant_act(x, self.training)
+                wq = self._quant_w(self.weight)
+                return F.conv2d(xq, wq, self.bias, stride=st, padding=pad,
+                                dilation=dil, groups=grp)
+
+        return _Q(inner)
+
+
+class ImperativeQuantAware:
+    """imperative/qat.py parity: wrap quantizable sublayers in place,
+    preserving parameter names."""
+
+    def __init__(self, quantizable_layer_type=("Linear", "Conv2D"),
+                 weight_bits=8, activation_bits=8, **kw):
+        self.types = tuple(quantizable_layer_type)
+
+    def quantize(self, model):
+        from .. import nn
+
+        type_map = {"Linear": (nn.Linear, QuantedLinear),
+                    "Conv2D": (nn.Conv2D, QuantedConv2D)}
+        wanted = [type_map[t] for t in self.types if t in type_map]
+
+        def walk(layer):
+            for name, sub in list(getattr(layer, "_sub_layers",
+                                          {}).items()):
+                replaced = False
+                for cls, qcls in wanted:
+                    if isinstance(sub, cls):
+                        layer._sub_layers[name] = qcls(sub)
+                        replaced = True
+                        break
+                if not replaced:
+                    walk(sub)
+
+        walk(model)
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None, **kw):
+        from .. import jit
+
+        jit.save(model, path, input_spec=input_spec)

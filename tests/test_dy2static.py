@@ -217,3 +217,38 @@ def test_enable_to_static_dynamic_toggle():
         paddle.jit.enable_to_static(True)
     np.testing.assert_allclose(np.asarray(out_on.numpy()),
                                np.asarray(out_off.numpy()), rtol=1e-6)
+
+
+class BaseNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+class SuperNet(BaseNet):
+    def forward(self, x):
+        h = super().forward(x)  # zero-arg super() in a converted method
+        if h.sum() > 0:
+            out = h * 2
+        else:
+            out = h * -1
+        return out
+
+
+def test_super_call_in_converted_method():
+    net = SuperNet()
+    net.eval()
+    s = paddle.jit.to_static(net)
+    for sign in (3.0, -3.0):
+        x = paddle.to_tensor(np.full((2, 4), sign, "float32"))
+        with paddle.no_grad():
+            got = s(x)
+            want = SuperNet.forward.__wrapped__(net, x) if hasattr(
+                SuperNet.forward, "__wrapped__") else None
+        base = np.asarray(net.fc(x).numpy())
+        expect = base * 2 if base.sum() > 0 else base * -1
+        np.testing.assert_allclose(np.asarray(got.numpy()), expect,
+                                   rtol=1e-6)
