@@ -55,6 +55,7 @@ from . import sparse  # noqa: F401
 from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
 from . import slim  # noqa: F401
+from . import dataset  # noqa: F401
 
 from .io.serialization import load, save  # noqa: F401
 from .hapi.model import Model  # noqa: F401

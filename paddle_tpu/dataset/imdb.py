@@ -1,0 +1,23 @@
+"""Legacy paddle.dataset.imdb (dataset/imdb.py parity)."""
+from __future__ import annotations
+
+from ._reader import dataset_reader
+
+
+def _make(mode, data_file=None, cutoff=150):
+    from ..text.datasets import Imdb
+
+    return Imdb(data_file=data_file, mode=mode, cutoff=cutoff,
+                download=data_file is None)
+
+
+def word_dict(data_file=None, cutoff=150):
+    return _make("train", data_file, cutoff).word_idx
+
+
+def train(word_idx=None, data_file=None):
+    return dataset_reader(lambda: _make("train", data_file))
+
+
+def test(word_idx=None, data_file=None):
+    return dataset_reader(lambda: _make("test", data_file))

@@ -1290,12 +1290,8 @@ def _quant_act_int8(x, s_in):
     return jnp.clip(jnp.round(x / s_in), -127, 127).astype(jnp.int8)
 
 
-def _dequant_scales(op, w):
-    import numpy as np
-
-    scales = np.asarray(op.attrs["weight_scales"], np.float32)
-    axis = op.attrs.get("weight_channel_axis", -1)
-    return scales, axis
+def _dequant_scales(op):
+    return np.asarray(op.attrs["weight_scales"], np.float32)
 
 
 @register("quantized_mul")
@@ -1308,7 +1304,7 @@ def _quantized_mul(ctx, op):
     x = ctx.inp(op, "X")
     w = ctx.inp(op, "Y")
     s_in = op.attrs["in_scale"]
-    scales, axis = _dequant_scales(op, w)
+    scales = _dequant_scales(op)
     if op.type == "quantized_mul":
         ncol = op.attrs.get("x_num_col_dims", 1)
         if op.input("X") and op.input("X")[0] + _LOD_SUFFIX in ctx.env:
@@ -1351,7 +1347,7 @@ def _quantized_conv2d(ctx, op):
     x = ctx.inp(op, "Input")
     w = ctx.inp(op, "Filter")
     s_in = op.attrs["in_scale"]
-    scales, _ = _dequant_scales(op, w)
+    scales = _dequant_scales(op)
     stride = _pair(op.attrs.get("strides", [1, 1]))
     dil = _pair(op.attrs.get("dilations", [1, 1]))
     # same padding normalization as the fp32 conv2d kernel (int, pair,
@@ -1367,9 +1363,15 @@ def _quantized_conv2d(ctx, op):
             feature_group_count=groups,
             preferred_element_type=jnp.int32)
         out = acc.astype(jnp.float32)
-    except Exception:
-        # backend without integer conv: same numerics via float math over
-        # the int8-valued operands
+    except Exception as e:
+        # ONLY dtype-support failures fall back (a backend without
+        # integer conv); genuine shape/attr errors must surface
+        msg = str(e).lower()
+        if not any(t in msg for t in ("dtype", "integer", "int8",
+                                      "preferred_element_type",
+                                      "unsupported")):
+            raise
+        # same numerics via float math over the int8-valued operands
         out = jax.lax.conv_general_dilated(
             xq.astype(jnp.float32), w.astype(jnp.float32),
             window_strides=stride, padding=pad, rhs_dilation=dil,
@@ -1410,7 +1412,8 @@ def _jax_exported(ctx, op):
 
         with open(path, "rb") as f:
             exported = jexport.deserialize(bytearray(f.read()))
-        _EXPORTED_CACHE.clear()
+        for k in [k for k in _EXPORTED_CACHE if k[0] == path]:
+            del _EXPORTED_CACHE[k]  # evict stale versions of THIS path
         _EXPORTED_CACHE[key] = exported
     ins = ctx.inps(op, "X")
     outs = exported.call(*ins)

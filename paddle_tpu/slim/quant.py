@@ -90,9 +90,17 @@ class PostTrainingQuantization:
             raise ValueError("PostTrainingQuantization needs a "
                              "sample_generator/data_loader to calibrate")
         with scope_guard(self.scope):
-            for i, feed in enumerate(self.samples()):
+            batches = self.samples() if callable(self.samples) \
+                else iter(self.samples)
+            for i, feed in enumerate(batches):
                 if i >= self.batch_nums:
                     break
+                if not isinstance(feed, dict):
+                    # DataLoader batches: positional, in feed-name order
+                    vals = feed if isinstance(feed, (list, tuple)) \
+                        else [feed]
+                    feed = {n: np.asarray(v)
+                            for n, v in zip(self.feed_names, vals)}
                 outs = self.exe.run(self.program, feed=feed,
                                     fetch_list=act_names,
                                     scope=self.scope)
@@ -108,68 +116,67 @@ class PostTrainingQuantization:
         act_max = self._calibrate()
         prog = copy.deepcopy(self.program)
         blk = prog.global_block()
-        # snapshot FLOAT weights first: the scope mutates to int8 below,
-        # and a weight shared by several ops must quantize from the float
-        # original with ONE (w_q, scales) shared by all consumers
-        float_w = {}
-        for op in blk.ops:
-            if op.type in self.op_types and op.type in _OP_SLOTS:
-                for w_name in op.input(_OP_SLOTS[op.type][1]):
-                    v = self.scope.get_value(w_name)
-                    if v is not None and w_name not in float_w:
-                        float_w[w_name] = np.asarray(v, np.float32)
-        quantized = {}  # w_name -> (ch_axis, scales)
+
+        def op_ch_axis(op):
+            ch = _OP_SLOTS[op.type][2]
+            if op.type in ("matmul", "matmul_v2") and op.attrs.get(
+                    "transpose_Y", op.attrs.get("trans_y", False)):
+                ch = 0
+            return ch
+
+        # pass 1: every consumer votes on its weight's channel axis; a
+        # disagreement (e.g. a weight used both plain and transposed)
+        # falls back to one per-tensor scale — decided BEFORE any attr or
+        # scope write so all consumers see consistent scales
+        consumers = {}
         for op in blk.ops:
             if op.type not in self.op_types or op.type not in _OP_SLOTS:
                 continue
-            a_slot, w_slot, ch_axis = _OP_SLOTS[op.type]
+            a_slot, w_slot, _ = _OP_SLOTS[op.type]
             if not op.input(a_slot) or not op.input(w_slot):
                 continue
-            a_name = op.input(a_slot)[0]
-            w_name = op.input(w_slot)[0]
-            if w_name not in float_w or a_name not in act_max:
+            a_name, w_name = op.input(a_slot)[0], op.input(w_slot)[0]
+            if a_name not in act_max:
                 continue
-            # channel axis follows the OUTPUT channels; transposed matmul
-            # weights carry them on axis 0
-            if op.type in ("matmul", "matmul_v2") and op.attrs.get(
-                    "transpose_Y", op.attrs.get("trans_y", False)):
-                ch_axis = 0
-            if w_name in quantized:
-                prev_axis, scales = quantized[w_name]
-                if prev_axis != ch_axis:
-                    # consumers disagree on channel axis: redo per-tensor
-                    w = float_w[w_name]
-                    s_w = np.abs(w).max() / 127.0
-                    s_w = max(float(s_w), 1e-8)
-                    self.scope.set_value(w_name, np.clip(
-                        np.round(w / s_w), -127, 127).astype(np.int8))
-                    scales = [s_w]
-                    quantized[w_name] = (-2, scales)
-            else:
-                w = float_w[w_name]
-                if self.weight_qtype == "channel_wise_abs_max":
-                    red = tuple(i for i in range(w.ndim) if i != ch_axis)
-                    s_w = np.maximum(np.abs(w).max(axis=red),
-                                     1e-8) / 127.0
-                    shape = [1] * w.ndim
-                    shape[ch_axis] = -1
-                    w_q = np.clip(np.round(w / s_w.reshape(shape)),
-                                  -127, 127).astype(np.int8)
-                    scales = [float(x) for x in np.atleast_1d(s_w)]
-                else:
-                    s_w = max(float(np.abs(w).max()), 1e-8) / 127.0
-                    w_q = np.clip(np.round(w / s_w),
-                                  -127, 127).astype(np.int8)
-                    scales = [s_w]
-                self.scope.set_value(w_name, w_q)
+            if self.scope.get_value(w_name) is None:
+                continue
+            consumers.setdefault(w_name, []).append((op, a_name,
+                                                     op_ch_axis(op)))
+
+        quantized = {}  # w_name -> (ch_axis or -1/-2, scales)
+        for w_name, uses in consumers.items():
+            w = np.asarray(self.scope.get_value(w_name), np.float32)
+            axes = {ax for _, _, ax in uses}
+            per_channel = (self.weight_qtype == "channel_wise_abs_max"
+                           and len(axes) == 1)
+            if per_channel:
+                ch_axis = axes.pop()
+                red = tuple(i for i in range(w.ndim) if i != ch_axis)
+                s_w = np.maximum(np.abs(w).max(axis=red), 1e-8) / 127.0
+                shape = [1] * w.ndim
+                shape[ch_axis] = -1
+                w_q = np.clip(np.round(w / s_w.reshape(shape)),
+                              -127, 127).astype(np.int8)
+                scales = [float(x) for x in np.atleast_1d(s_w)]
                 quantized[w_name] = (ch_axis, scales)
-                if blk.has_var(w_name):
-                    blk.var(w_name).dtype = np.dtype(np.int8)
-            s_in = max(act_max[a_name], 1e-8) / 127.0
-            op.type = "quantized_" + op.type
-            op.attrs["in_scale"] = float(s_in)
-            op.attrs["weight_scales"] = quantized[w_name][1]
-            op.attrs["weight_channel_axis"] = quantized[w_name][0]
+            else:
+                s_w = max(float(np.abs(w).max()), 1e-8) / 127.0
+                w_q = np.clip(np.round(w / s_w),
+                              -127, 127).astype(np.int8)
+                quantized[w_name] = (-1, [s_w])
+            self.scope.set_value(w_name, w_q)
+            if blk.has_var(w_name):
+                blk.var(w_name).dtype = np.dtype(np.int8)
+
+        # pass 2: rewrite consumer ops with the final shared scales
+        for w_name, uses in consumers.items():
+            ch_axis, scales = quantized[w_name]
+            for op, a_name, _ in uses:
+                s_in = max(act_max[a_name], 1e-8) / 127.0
+                op.type = "quantized_" + op.type
+                op.attrs["in_scale"] = float(s_in)
+                op.attrs["weight_scales"] = scales
+                op.attrs["weight_channel_axis"] = ch_axis
         self._quant_program = prog
         return prog
 
@@ -236,10 +243,13 @@ def fake_quant(x, scale, bits=8):
 class _QuantWrapper:
     """Mixin: weight abs-max fake quant + activation moving-max quant."""
 
-    def _init_qat(self, inner, momentum=0.9):
+    def _init_qat(self, inner, momentum=0.9, weight_bits=8,
+                  activation_bits=8):
         self._inner = inner
         self._act_max = 1.0
         self._mom = momentum
+        self._w_bits = weight_bits
+        self._a_bits = activation_bits
 
     def _quant_act(self, x, training=True):
         from ..core.tensor import apply_op
@@ -251,20 +261,24 @@ class _QuantWrapper:
             cur = float(np.abs(np.asarray(raw)).max())
             self._act_max = self._mom * self._act_max + \
                 (1 - self._mom) * max(cur, 1e-8)
-        s = max(self._act_max, 1e-8) / 127.0
+        bound = 2.0 ** (self._a_bits - 1) - 1
+        s = max(self._act_max, 1e-8) / bound
+        bits = self._a_bits
         # through the tape so the STE gradient reaches upstream layers
         return apply_op("fake_quant_act",
-                        lambda r: fake_quant(r, s), [x]), s
+                        lambda r: fake_quant(r, s, bits), [x]), s
 
     def _quant_w(self, w):
         from ..core.tensor import apply_op
 
+        bound = 2.0 ** (self._w_bits - 1) - 1
         if not _is_tracer(w._data):
             absmax = float(np.abs(np.asarray(w._data)).max())
-            self._w_scale = max(absmax, 1e-8) / 127.0
-        s = getattr(self, "_w_scale", 1.0 / 127.0)
+            self._w_scale = max(absmax, 1e-8) / bound
+        s = getattr(self, "_w_scale", 1.0 / bound)
+        bits = self._w_bits
         return apply_op("fake_quant_weight",
-                        lambda r: fake_quant(r, s), [w])
+                        lambda r: fake_quant(r, s, bits), [w])
 
 
 def _is_tracer(v):
@@ -278,13 +292,14 @@ class QuantedLinear(_QuantWrapper):
     original names ('weight'/'bias'), so state_dict keys are unchanged
     after quantization (the reference ImperativeQuantAware contract)."""
 
-    def __new__(cls, inner):
+    def __new__(cls, inner, weight_bits=8, activation_bits=8):
         from .. import nn
 
         class _Q(nn.Layer, _QuantWrapper):
             def __init__(self, inner):
                 super().__init__()
-                self._init_qat(inner)
+                self._init_qat(inner, weight_bits=weight_bits,
+                               activation_bits=activation_bits)
                 self.weight = inner.weight
                 self.bias = inner.bias
 
@@ -299,13 +314,14 @@ class QuantedLinear(_QuantWrapper):
 
 
 class QuantedConv2D(_QuantWrapper):
-    def __new__(cls, inner):
+    def __new__(cls, inner, weight_bits=8, activation_bits=8):
         from .. import nn
 
         class _Q(nn.Layer, _QuantWrapper):
             def __init__(self, inner):
                 super().__init__()
-                self._init_qat(inner)
+                self._init_qat(inner, weight_bits=weight_bits,
+                               activation_bits=activation_bits)
                 self.weight = inner.weight
                 self.bias = inner.bias
                 self._cfg = (inner._stride, inner._padding,
@@ -330,6 +346,8 @@ class ImperativeQuantAware:
     def __init__(self, quantizable_layer_type=("Linear", "Conv2D"),
                  weight_bits=8, activation_bits=8, **kw):
         self.types = tuple(quantizable_layer_type)
+        self.weight_bits = int(weight_bits)
+        self.activation_bits = int(activation_bits)
 
     def quantize(self, model):
         from .. import nn
@@ -344,7 +362,8 @@ class ImperativeQuantAware:
                 replaced = False
                 for cls, qcls in wanted:
                     if isinstance(sub, cls):
-                        layer._sub_layers[name] = qcls(sub)
+                        layer._sub_layers[name] = qcls(
+                            sub, self.weight_bits, self.activation_bits)
                         replaced = True
                         break
                 if not replaced:

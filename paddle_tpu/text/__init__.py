@@ -6,6 +6,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..io import Dataset
+from . import datasets  # noqa: F401
 from . import viterbi  # noqa: F401
 
 
@@ -30,31 +31,8 @@ class FakeTextDataset(Dataset):
         return self.size
 
 
-class UCIHousing(Dataset):
-    def __init__(self, data_file=None, mode="train"):
-        if data_file is None:
-            raise RuntimeError("no network egress: pass data_file")
-        data = np.loadtxt(data_file)
-        data = (data - data.mean(0)) / (data.std(0) + 1e-8)
-        n = len(data)
-        split = int(n * 0.8)
-        self.data = data[:split] if mode == "train" else data[split:]
-
-    def __getitem__(self, idx):
-        row = self.data[idx]
-        return row[:-1].astype(np.float32), row[-1:].astype(np.float32)
-
-    def __len__(self):
-        return len(self.data)
-
-
-class Imdb(Dataset):
-    def __init__(self, data_file=None, mode="train", cutoff=150):
-        raise RuntimeError(
-            "no network egress: use FakeTextDataset or provide a local "
-            "aclImdb tar via data_file (loader lands with the text op set)")
-
-
+from .datasets import (Conll05st, Imdb, Imikolov,  # noqa: F401,E402
+                       Movielens, MovieReviews, UCIHousing, WMT14, WMT16)
 from . import models  # noqa: F401,E402
 from .models import (ErnieConfig, ErnieForPretraining,  # noqa: F401,E402
                      ErnieForSequenceClassification, ErnieModel, ernie_base,

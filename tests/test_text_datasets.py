@@ -1,0 +1,256 @@
+"""Text/NLP datasets + legacy paddle.dataset namespace.
+
+Reference analogue: dataset/tests/ — each dataset parses a fixture
+archive built here in the EXACT on-disk format the reference downloads
+(aclImdb tar, PTB simple-examples tgz, ml-1m zip, conll05st tar, wmt
+tars), so the parsing logic is verified without network access.
+"""
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text.datasets import (Conll05st, Imdb, Imikolov,
+                                      Movielens, UCIHousing, WMT14, WMT16)
+
+
+def _add_bytes(tf, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture
+def imdb_tar(tmp_path):
+    path = str(tmp_path / "aclImdb_v1.tar.gz")
+    docs = {
+        "train/pos/0_9.txt": b"a great movie , truly great fun",
+        "train/pos/1_8.txt": b"great acting and a great plot",
+        "train/neg/0_2.txt": b"a bad movie ; bad bad bad",
+        "test/pos/0_10.txt": b"great great great",
+        "test/neg/0_1.txt": b"bad and boring",
+    }
+    with tarfile.open(path, "w:gz") as tf:
+        for name, data in docs.items():
+            _add_bytes(tf, f"aclImdb/{name}", data)
+    return path
+
+
+def test_imdb_parsing(imdb_tar):
+    ds = Imdb(data_file=imdb_tar, mode="train", cutoff=1)
+    # words with freq > 1 in train: 'a'(2), 'great'(5), 'bad'(4)
+    assert set(ds.word_idx) >= {"great", "bad", "<unk>"}
+    assert len(ds) == 3
+    doc0, label0 = ds[0]
+    assert label0[0] == 0  # pos first
+    assert doc0.dtype.kind == "i"
+    labels = [int(ds[i][1][0]) for i in range(len(ds))]
+    assert labels == [0, 0, 1]
+    # test split
+    ds_t = Imdb(data_file=imdb_tar, mode="test", cutoff=1)
+    assert len(ds_t) == 2
+    # legacy reader parity
+    r = paddle.dataset.imdb.train(data_file=imdb_tar)
+    assert len(list(r())) == 3
+
+
+@pytest.fixture
+def ptb_tar(tmp_path):
+    path = str(tmp_path / "simple-examples.tgz")
+    train = b"the cat sat\nthe dog sat\nthe cat ran\n" * 5
+    valid = b"the cat sat\n" * 3
+    with tarfile.open(path, "w:gz") as tf:
+        _add_bytes(tf, "./simple-examples/data/ptb.train.txt", train)
+        _add_bytes(tf, "./simple-examples/data/ptb.valid.txt", valid)
+    return path
+
+
+def test_imikolov_ngram_and_seq(ptb_tar):
+    ds = Imikolov(data_file=ptb_tar, data_type="NGRAM", window_size=2,
+                  mode="train", min_word_freq=2)
+    assert "<s>" in ds.word_idx and "<e>" in ds.word_idx
+    grams = ds[0]
+    assert len(grams) == 2
+    seq = Imikolov(data_file=ptb_tar, data_type="SEQ", window_size=-1,
+                   mode="train", min_word_freq=2)
+    src, trg = seq[0]
+    assert len(src) == len(trg)
+    np.testing.assert_array_equal(src[1:], trg[:-1])
+
+
+def test_uci_housing(tmp_path):
+    rng = np.random.RandomState(0)
+    data = rng.rand(50, 14) * 10
+    path = str(tmp_path / "housing.data")
+    with open(path, "w") as f:
+        for row in data:
+            f.write(" ".join(f"{v:.4f}" for v in row) + "\n")
+    tr = UCIHousing(data_file=path, mode="train")
+    te = UCIHousing(data_file=path, mode="test")
+    assert len(tr) == 40 and len(te) == 10
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    # features normalized
+    allx = np.stack([tr[i][0] for i in range(len(tr))])
+    assert np.abs(allx).max() <= 1.0 + 1e-6
+
+
+@pytest.fixture
+def ml1m_zip(tmp_path):
+    path = str(tmp_path / "ml-1m.zip")
+    movies = ("1::Toy Story (1995)::Animation|Comedy\n"
+              "2::Heat (1995)::Action|Crime\n")
+    users = ("1::M::25::10::90210\n"
+             "2::F::35::3::10021\n")
+    ratings = "".join(f"{u}::{m}::{r}::97830{i}\n"
+                      for i, (u, m, r) in enumerate(
+                          [(1, 1, 5), (1, 2, 3), (2, 1, 4), (2, 2, 1)] * 5))
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("ml-1m/movies.dat", movies)
+        z.writestr("ml-1m/users.dat", users)
+        z.writestr("ml-1m/ratings.dat", ratings)
+    return path
+
+
+def test_movielens(ml1m_zip):
+    tr = Movielens(data_file=ml1m_zip, mode="train", test_ratio=0.2,
+                   rand_seed=0)
+    te = Movielens(data_file=ml1m_zip, mode="test", test_ratio=0.2,
+                   rand_seed=0)
+    assert len(tr) + len(te) == 20
+    sample = tr[0]
+    assert len(sample) == 8  # 4 user + 3 movie + rating
+    rating = float(sample[-1][0])
+    assert -5.0 <= rating <= 5.0
+    assert paddle.dataset.movielens.max_movie_id(
+        data_file=ml1m_zip) == 2
+
+
+@pytest.fixture
+def wmt14_tar(tmp_path):
+    path = str(tmp_path / "wmt14.tgz")
+    src_dict = b"<s>\n<e>\n<unk>\nhello\nworld\n"
+    trg_dict = b"<s>\n<e>\n<unk>\nbonjour\nmonde\n"
+    train = b"hello world\tbonjour monde\nhello\tbonjour\n"
+    test = b"world\tmonde\n"
+    with tarfile.open(path, "w:gz") as tf:
+        _add_bytes(tf, "wmt14/train/src.dict", src_dict)
+        _add_bytes(tf, "wmt14/train/trg.dict", trg_dict)
+        _add_bytes(tf, "wmt14/train/train", train)
+        _add_bytes(tf, "wmt14/test/test", test)
+    return path
+
+
+def test_wmt14(wmt14_tar):
+    ds = WMT14(data_file=wmt14_tar, mode="train", dict_size=5)
+    assert len(ds) == 2
+    src, trg, trg_next = ds[0]
+    # <s> hello world <e>
+    np.testing.assert_array_equal(src, [0, 3, 4, 1])
+    np.testing.assert_array_equal(trg, [0, 3, 4])
+    np.testing.assert_array_equal(trg_next, [3, 4, 1])
+    te = WMT14(data_file=wmt14_tar, mode="test", dict_size=5)
+    assert len(te) == 1
+
+
+def test_wmt16(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path / "home"))
+    import importlib
+
+    import paddle_tpu.dataset.common as common
+    importlib.reload(common)
+    path = str(tmp_path / "wmt16.tar.gz")
+    train = (b"the cat\tdie katze\nthe dog\tder hund\n"
+             b"the cat\tdie katze\n")
+    val = b"the cat\tdie katze\n"
+    with tarfile.open(path, "w:gz") as tf:
+        _add_bytes(tf, "wmt16/train", train)
+        _add_bytes(tf, "wmt16/val", val)
+        _add_bytes(tf, "wmt16/test", val)
+    ds = WMT16(data_file=path, mode="train", src_dict_size=10,
+               trg_dict_size=10, lang="en")
+    assert ds.src_dict["<s>"] == 0 and ds.src_dict["<e>"] == 1
+    assert "the" in ds.src_dict and "katze" in ds.trg_dict
+    src, trg, trg_next = ds[0]
+    assert src[0] == 0 and src[-1] == 1
+    assert len(ds) == 3
+
+
+@pytest.fixture
+def conll_fixture(tmp_path):
+    words = b"The\ncat\nsat\n\nDogs\nbark\n\n"
+    props = (b"-\t(A0*\n"
+             b"-\t*)\n"
+             b"sit\t(V*)\n"
+             b"\n"
+             b"-\t(A0*)\n"
+             b"bark\t(V*)\n"
+             b"\n")
+    wbuf, pbuf = io.BytesIO(), io.BytesIO()
+    with gzip.GzipFile(fileobj=wbuf, mode="w") as g:
+        g.write(words)
+    with gzip.GzipFile(fileobj=pbuf, mode="w") as g:
+        g.write(props)
+    tar_path = str(tmp_path / "conll05st-tests.tar.gz")
+    with tarfile.open(tar_path, "w:gz") as tf:
+        _add_bytes(tf, "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                   wbuf.getvalue())
+        _add_bytes(tf, "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                   pbuf.getvalue())
+    wd = str(tmp_path / "wordDict.txt")
+    with open(wd, "w") as f:
+        f.write("The\ncat\nsat\nDogs\nbark\n")
+    vd = str(tmp_path / "verbDict.txt")
+    with open(vd, "w") as f:
+        f.write("sit\nbark\n")
+    td = str(tmp_path / "targetDict.txt")
+    with open(td, "w") as f:
+        f.write("B-A0\nI-A0\nB-V\nI-V\nO\n")
+    return tar_path, wd, vd, td
+
+
+def test_conll05(conll_fixture):
+    tar_path, wd, vd, td = conll_fixture
+    ds = Conll05st(data_file=tar_path, word_dict_file=wd,
+                   verb_dict_file=vd, target_dict_file=td)
+    assert len(ds) == 2
+    sample = ds[0]
+    assert len(sample) == 9
+    word_ids, *ctx, mark, pred, labels = sample
+    assert len(word_ids) == 3  # "The cat sat"
+    assert list(mark) == [0, 0, 1]  # the predicate position
+    assert len(labels) == 3
+    word_dict, verb_dict, label_dict = ds.get_dict()
+    assert "B-A0" in label_dict and "O" in label_dict
+
+
+def test_download_raises_zero_egress(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    import importlib
+
+    import paddle_tpu.dataset.common as common
+    importlib.reload(common)
+    with pytest.raises(RuntimeError, match="no\\s+network egress"):
+        common.download("http://example.com/x.tar", "x", "0")
+
+
+def test_cluster_files_reader(tmp_path):
+    from paddle_tpu.dataset import common
+
+    def reader():
+        for i in range(10):
+            yield i
+
+    os.chdir(tmp_path)
+    common.split(reader, 3, suffix=str(tmp_path / "chunk-%05d.pickle"))
+    r0 = common.cluster_files_reader(
+        str(tmp_path / "chunk-*.pickle"), 2, 0)
+    r1 = common.cluster_files_reader(
+        str(tmp_path / "chunk-*.pickle"), 2, 1)
+    got = sorted(list(r0()) + list(r1()))
+    assert got == list(range(10))
