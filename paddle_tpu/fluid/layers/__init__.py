@@ -1,4 +1,5 @@
 from .control_flow import *  # noqa: F401,F403
+from . import detection  # noqa: F401
 from .math_ops import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .sequence_lod import *  # noqa: F401,F403

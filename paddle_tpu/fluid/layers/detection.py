@@ -1,0 +1,180 @@
+"""Static-graph detection layer sugar (fluid/layers/detection.py parity).
+
+Each function appends a detection op; lowerings live in
+fluid/lowering_detection.py over the ops/detection.py kernels (static
+-1-padded NMS outputs instead of variable-length LoD)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_variable_for_type_inference(
+        x.dtype, [x.shape[0] or -1, y.shape[0] or -1])
+    helper.append_op(type="iou_similarity",
+                     inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]},
+                     attrs={"box_normalized": box_normalized})
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None, axis=0):
+    helper = LayerHelper("box_coder", name=name)
+    out = helper.create_variable_for_type_inference(target_box.dtype,
+                                                    target_box.shape)
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis}
+    if isinstance(prior_box_var, (list, tuple)):
+        attrs["variance"] = [float(v) for v in prior_box_var]
+    elif prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(type="box_coder", inputs=inputs,
+                     outputs={"OutputBox": [out]}, attrs=attrs)
+    return out
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype,
+                                                    input.shape)
+    helper.append_op(type="box_clip",
+                     inputs={"Input": [input], "ImInfo": [im_info]},
+                     outputs={"Output": [out]}, attrs={})
+    return out
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=64,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, name=None):
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = helper.create_variable_for_type_inference(
+        bboxes.dtype, [keep_top_k, 6])
+    num = helper.create_variable_for_type_inference(np.int32, [1])
+    helper.append_op(
+        type="multiclass_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out], "NmsRoisNum": [num]},
+        attrs={"score_threshold": score_threshold,
+               "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+               "nms_threshold": nms_threshold, "normalized": normalized,
+               "background_label": background_label})
+    return out
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None,
+             scale_x_y=1.0):
+    helper = LayerHelper("yolo_box", name=name)
+    A = len(anchors) // 2
+    hw = (x.shape[2] or 1) * (x.shape[3] or 1)
+    boxes = helper.create_variable_for_type_inference(
+        x.dtype, [x.shape[0] or -1, hw * A, 4])
+    scores = helper.create_variable_for_type_inference(
+        x.dtype, [x.shape[0] or -1, hw * A, class_num])
+    helper.append_op(
+        type="yolo_box",
+        inputs={"X": [x], "ImgSize": [img_size]},
+        outputs={"Boxes": [boxes], "Scores": [scores]},
+        attrs={"anchors": [int(a) for a in anchors],
+               "class_num": class_num, "conf_thresh": conf_thresh,
+               "downsample_ratio": downsample_ratio,
+               "clip_bbox": clip_bbox, "scale_x_y": scale_x_y})
+    return boxes, scores
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              name=None, min_max_aspect_ratios_order=False):
+    helper = LayerHelper("prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference(input.dtype, None)
+    var = helper.create_variable_for_type_inference(input.dtype, None)
+    attrs = {"min_sizes": [float(m) for m in min_sizes],
+             "aspect_ratios": [float(a) for a in aspect_ratios],
+             "variances": [float(v) for v in variance],
+             "flip": flip, "clip": clip,
+             # reference order: steps = [step_w, step_h]
+             "step_w": float(steps[0]),
+             "step_h": float(steps[1] if len(steps) > 1 else steps[0]),
+             "offset": offset,
+             "min_max_aspect_ratios_order": min_max_aspect_ratios_order}
+    if max_sizes:
+        attrs["max_sizes"] = [float(m) for m in max_sizes]
+    helper.append_op(type="prior_box",
+                     inputs={"Input": [input], "Image": [image]},
+                     outputs={"Boxes": [boxes], "Variances": [var]},
+                     attrs=attrs)
+    return boxes, var
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, stride,
+                     variance=(0.1, 0.1, 0.2, 0.2), offset=0.5,
+                     name=None):
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors = helper.create_variable_for_type_inference(input.dtype, None)
+    var = helper.create_variable_for_type_inference(input.dtype, None)
+    helper.append_op(
+        type="anchor_generator", inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [var]},
+        attrs={"anchor_sizes": [float(a) for a in anchor_sizes],
+               "aspect_ratios": [float(a) for a in aspect_ratios],
+               "stride": [float(s) for s in stride],
+               "variances": [float(v) for v in variance],
+               "offset": offset})
+    return anchors, var
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None,
+              name=None):
+    helper = LayerHelper("roi_align", name=name)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, [rois.shape[0] or -1, input.shape[1],
+                      pooled_height, pooled_width])
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        inputs["RoisNum"] = [rois_num]
+    helper.append_op(
+        type="roi_align", inputs=inputs, outputs={"Out": [out]},
+        attrs={"pooled_height": pooled_height,
+               "pooled_width": pooled_width,
+               "spatial_scale": spatial_scale,
+               "sampling_ratio": sampling_ratio})
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None, name=None):
+    helper = LayerHelper("roi_pool", name=name)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, [rois.shape[0] or -1, input.shape[1],
+                      pooled_height, pooled_width])
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        inputs["RoisNum"] = [rois_num]
+    helper.append_op(
+        type="roi_pool", inputs=inputs, outputs={"Out": [out]},
+        attrs={"pooled_height": pooled_height,
+               "pooled_width": pooled_width,
+               "spatial_scale": spatial_scale})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    helper = LayerHelper("bipartite_match", name=name)
+    idx = helper.create_variable_for_type_inference(
+        np.int32, [dist_matrix.shape[1] or -1])
+    d = helper.create_variable_for_type_inference(
+        dist_matrix.dtype, [dist_matrix.shape[1] or -1])
+    helper.append_op(type="bipartite_match",
+                     inputs={"DistMat": [dist_matrix]},
+                     outputs={"ColToRowMatchIndices": [idx],
+                              "ColToRowMatchDist": [d]},
+                     attrs={})
+    return idx, d

@@ -1363,19 +1363,17 @@ def _quantized_conv2d(ctx, op):
             feature_group_count=groups,
             preferred_element_type=jnp.int32)
         out = acc.astype(jnp.float32)
-    except Exception as e:
-        # ONLY dtype-support failures fall back (a backend without
-        # integer conv); genuine shape/attr errors must surface
-        msg = str(e).lower()
-        if not any(t in msg for t in ("dtype", "integer", "int8",
-                                      "preferred_element_type",
-                                      "unsupported")):
-            raise
-        # same numerics via float math over the int8-valued operands
-        out = jax.lax.conv_general_dilated(
-            xq.astype(jnp.float32), w.astype(jnp.float32),
-            window_strides=stride, padding=pad, rhs_dilation=dil,
-            feature_group_count=groups)
+    except Exception as int8_err:
+        # fall back to float math over the int8-valued operands (same
+        # numerics); if the float path fails TOO, the op itself is bad —
+        # surface the original error rather than masking it
+        try:
+            out = jax.lax.conv_general_dilated(
+                xq.astype(jnp.float32), w.astype(jnp.float32),
+                window_strides=stride, padding=pad, rhs_dilation=dil,
+                feature_group_count=groups)
+        except Exception:
+            raise int8_err
     s_w = jnp.asarray(scales, jnp.float32)
     if s_w.ndim and s_w.shape[0] == out.shape[1]:
         out = out * (s_in * s_w)[None, :, None, None]
@@ -1422,3 +1420,6 @@ def _jax_exported(ctx, op):
 
 # sequence-op lowerings register themselves into this registry on import
 from . import lowering_seq  # noqa: E402,F401
+
+# detection-op lowerings register themselves on import
+from . import lowering_detection  # noqa: E402,F401

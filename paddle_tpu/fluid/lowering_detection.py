@@ -1,0 +1,152 @@
+"""Static lowerings for detection ops over ops/detection.py kernels."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import detection as D
+from .lowering import register
+
+
+@register("iou_similarity")
+def _iou(ctx, op):
+    ctx.out(op, "Out", D.iou_matrix(ctx.inp(op, "X"), ctx.inp(op, "Y"),
+                                    op.attrs.get("box_normalized", True)))
+
+
+@register("box_coder")
+def _box_coder(ctx, op):
+    pv = ctx.inp(op, "PriorBoxVar")
+    if pv is None and op.attrs.get("variance"):
+        pv = np.asarray(op.attrs["variance"], np.float32)
+    out = D.box_coder(ctx.inp(op, "PriorBox"), pv,
+                      ctx.inp(op, "TargetBox"),
+                      op.attrs.get("code_type", "encode_center_size"),
+                      op.attrs.get("box_normalized", True))
+    ctx.out(op, "OutputBox", out)
+
+
+@register("box_clip")
+def _box_clip(ctx, op):
+    im = ctx.inp(op, "ImInfo")
+    ctx.out(op, "Output", D.box_clip(ctx.inp(op, "Input"),
+                                     im.reshape(-1)))
+
+
+@register("multiclass_nms")
+@register("multiclass_nms2")
+def _mc_nms(ctx, op):
+    bboxes = ctx.inp(op, "BBoxes")
+    scores = ctx.inp(op, "Scores")
+    if bboxes.ndim == 3:  # [B, N, 4]: lower per batch element
+        outs, nums = [], []
+        for b in range(bboxes.shape[0]):
+            o, n = D.multiclass_nms(
+                bboxes[b], scores[b],
+                op.attrs.get("score_threshold", 0.05),
+                op.attrs.get("nms_top_k", 64),
+                op.attrs.get("keep_top_k", 100),
+                op.attrs.get("nms_threshold", 0.3),
+                op.attrs.get("normalized", True),
+                op.attrs.get("background_label", 0))
+            outs.append(o)
+            nums.append(n)
+        import jax.numpy as jnp
+
+        ctx.out(op, "Out", jnp.concatenate(outs, axis=0))
+        ctx.out(op, "NmsRoisNum", jnp.stack(nums))
+        return
+    out, num = D.multiclass_nms(
+        bboxes, scores, op.attrs.get("score_threshold", 0.05),
+        op.attrs.get("nms_top_k", 64), op.attrs.get("keep_top_k", 100),
+        op.attrs.get("nms_threshold", 0.3),
+        op.attrs.get("normalized", True),
+        op.attrs.get("background_label", 0))
+    ctx.out(op, "Out", out)
+    ctx.out(op, "NmsRoisNum", num)
+
+
+@register("yolo_box")
+def _yolo_box(ctx, op):
+    boxes, scores = D.yolo_box(
+        ctx.inp(op, "X"), ctx.inp(op, "ImgSize"),
+        op.attrs["anchors"], op.attrs["class_num"],
+        op.attrs.get("conf_thresh", 0.01),
+        op.attrs.get("downsample_ratio", 32),
+        op.attrs.get("clip_bbox", True),
+        op.attrs.get("scale_x_y", 1.0))
+    ctx.out(op, "Boxes", boxes)
+    ctx.out(op, "Scores", scores)
+
+
+@register("prior_box")
+def _prior_box(ctx, op):
+    x = ctx.inp(op, "Input")
+    im = ctx.inp(op, "Image")
+    boxes, var = D.prior_box(
+        (x.shape[2], x.shape[3]), (im.shape[2], im.shape[3]),
+        list(op.attrs["min_sizes"]),
+        list(op.attrs.get("max_sizes") or []) or None,
+        tuple(op.attrs.get("aspect_ratios", (1.0,))),
+        tuple(op.attrs.get("variances", (0.1, 0.1, 0.2, 0.2))),
+        op.attrs.get("flip", False), op.attrs.get("clip", False),
+        (op.attrs.get("step_h", 0.0), op.attrs.get("step_w", 0.0)),
+        op.attrs.get("offset", 0.5),
+        op.attrs.get("min_max_aspect_ratios_order", False))
+    ctx.out(op, "Boxes", boxes)
+    ctx.out(op, "Variances", var)
+
+
+@register("anchor_generator")
+def _anchor_gen(ctx, op):
+    x = ctx.inp(op, "Input")
+    anchors, var = D.anchor_generator(
+        (x.shape[2], x.shape[3]), list(op.attrs["anchor_sizes"]),
+        list(op.attrs["aspect_ratios"]), list(op.attrs["stride"]),
+        tuple(op.attrs.get("variances", (0.1, 0.1, 0.2, 0.2))),
+        op.attrs.get("offset", 0.5))
+    ctx.out(op, "Anchors", anchors)
+    ctx.out(op, "Variances", var)
+
+
+def _roi_batch_ids(ctx, op, rois):
+    import jax.numpy as jnp
+
+    num = ctx.inp(op, "RoisNum")
+    if num is None:
+        return jnp.zeros((rois.shape[0],), jnp.int32)
+    # traced-friendly: roi r belongs to the batch element whose cumulative
+    # count it falls under (static total R, data-dependent boundaries ok)
+    num = jnp.reshape(num, (-1,)).astype(jnp.int32)
+    bounds = jnp.cumsum(num)
+    r = jnp.arange(rois.shape[0], dtype=jnp.int32)
+    return (r[:, None] >= bounds[None, :]).sum(axis=1).astype(jnp.int32)
+
+
+@register("roi_align")
+def _roi_align(ctx, op):
+    rois = ctx.inp(op, "ROIs")
+    out = D.roi_align(
+        ctx.inp(op, "X"), rois, _roi_batch_ids(ctx, op, rois),
+        (op.attrs.get("pooled_height", 1),
+         op.attrs.get("pooled_width", 1)),
+        op.attrs.get("spatial_scale", 1.0),
+        op.attrs.get("sampling_ratio", -1))
+    ctx.out(op, "Out", out)
+
+
+@register("roi_pool")
+def _roi_pool(ctx, op):
+    rois = ctx.inp(op, "ROIs")
+    out = D.roi_pool(
+        ctx.inp(op, "X"), rois, _roi_batch_ids(ctx, op, rois),
+        (op.attrs.get("pooled_height", 1),
+         op.attrs.get("pooled_width", 1)),
+        op.attrs.get("spatial_scale", 1.0))
+    ctx.out(op, "Out", out)
+
+
+@register("bipartite_match")
+def _bipartite(ctx, op):
+    idx, d = D.bipartite_match(ctx.inp(op, "DistMat"))
+    ctx.out(op, "ColToRowMatchIndices", idx)
+    ctx.out(op, "ColToRowMatchDist", d)

@@ -1,0 +1,464 @@
+"""Detection op library (operators/detection/ parity, 17.1k LoC of CUDA
+re-designed TPU-first).
+
+Every kernel keeps STATIC shapes — XLA's contract: NMS returns a
+fixed-size index buffer padded with -1 plus a valid count (the reference
+returns a variable-length LoDTensor; the -1-padded form is the
+TPU-native equivalent, like TF's combined_non_max_suppression). Greedy
+loops (nms, bipartite match) are lax.fori_loop over masks, not data-
+dependent Python.
+
+Key reference files: multiclass_nms_op.cc, roi_align_op.cu, yolo_box_op.h,
+prior_box_op.h, box_coder_op.h, iou_similarity_op.h, bipartite_match_op.cc.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def box_area(boxes):
+    return ((boxes[..., 2] - boxes[..., 0]) *
+            (boxes[..., 3] - boxes[..., 1]))
+
+
+def iou_matrix(a, b, normalized=True):
+    """Pairwise IoU: a [N,4], b [M,4] -> [N,M] (iou_similarity_op.h)."""
+    jnp = _jnp()
+    off = 0.0 if normalized else 1.0
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt + off, 0.0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+    area_b = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def nms(boxes, scores, iou_threshold=0.3, score_threshold=None,
+        max_out=None, normalized=True):
+    """Greedy hard NMS. Returns (keep_idx [max_out] int32 padded -1,
+    num_valid). boxes [N,4], scores [N]."""
+    import jax
+
+    jnp = _jnp()
+    boxes = jnp.asarray(boxes)
+    scores = jnp.asarray(scores)
+    n = boxes.shape[0]
+    max_out = int(max_out or n)
+    if score_threshold is not None:
+        valid = scores > score_threshold
+    else:
+        valid = jnp.ones((n,), bool)
+    order = jnp.argsort(-scores)
+    ious = iou_matrix(boxes, boxes, normalized)
+
+    def body(i, carry):
+        keep_mask, out, count = carry
+        cand = order[i]
+        ok = keep_mask[cand] & valid[cand] & (count < max_out)
+        out = out.at[jnp.clip(count, 0, max_out - 1)].set(
+            jnp.where(ok, cand.astype(jnp.int32),
+                      out[jnp.clip(count, 0, max_out - 1)]))
+        count = count + ok.astype(jnp.int32)
+        # suppress every box with IoU > thr against the kept candidate
+        sup = ious[cand] > iou_threshold
+        keep_mask = jnp.where(ok, keep_mask & ~sup, keep_mask)
+        return keep_mask, out, count
+
+    out0 = jnp.full((max_out,), -1, jnp.int32)
+    _, out, count = jax.lax.fori_loop(
+        0, n, body, (jnp.ones((n,), bool), out0, jnp.zeros((), jnp.int32)))
+    return out, count
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=64,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   background_label=0):
+    """multiclass_nms_op.cc capability with static output:
+    bboxes [N, 4], scores [C, N] -> (out [keep_top_k, 6] rows
+    [label, score, x1, y1, x2, y2] padded with -1 labels, num_valid)."""
+    import jax
+
+    jnp = _jnp()
+    C, N = scores.shape
+    per_class = []
+    for c in range(C):
+        if c == background_label:
+            continue
+        keep, cnt = nms(bboxes, scores[c], nms_threshold, score_threshold,
+                        min(nms_top_k, N), normalized)
+        k = keep.shape[0]
+        sel = jnp.clip(keep, 0, N - 1)
+        valid = (jnp.arange(k) < cnt) & (keep >= 0)
+        rows = jnp.concatenate([
+            jnp.full((k, 1), c, jnp.float32),
+            scores[c][sel][:, None].astype(jnp.float32),
+            bboxes[sel].astype(jnp.float32),
+        ], axis=1)
+        rows = jnp.where(valid[:, None], rows, -1.0)
+        per_class.append(rows)
+    if not per_class:  # every class was the background label
+        return (jnp.full((keep_top_k, 6), -1.0, jnp.float32),
+                jnp.zeros((), jnp.int32))
+    allrows = jnp.concatenate(per_class, axis=0)
+    # keep_top_k by score over all classes
+    key = jnp.where(allrows[:, 0] >= 0, allrows[:, 1], -jnp.inf)
+    top = jnp.argsort(-key)[:keep_top_k]
+    out = allrows[top]
+    pad = keep_top_k - out.shape[0]
+    if pad > 0:
+        out = jnp.concatenate(
+            [out, jnp.full((pad, 6), -1.0, jnp.float32)], axis=0)
+    num = (out[:, 0] >= 0).sum().astype(jnp.int32)
+    return out, num
+
+
+def box_clip(boxes, im_shape):
+    """box_clip_op.h: clip to [0, w-1] x [0, h-1]."""
+    jnp = _jnp()
+    h, w = im_shape[0], im_shape[1]
+    x1 = jnp.clip(boxes[..., 0], 0, w - 1)
+    y1 = jnp.clip(boxes[..., 1], 0, h - 1)
+    x2 = jnp.clip(boxes[..., 2], 0, w - 1)
+    y2 = jnp.clip(boxes[..., 3], 0, h - 1)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True):
+    """box_coder_op.h: encode targets against priors, or decode deltas."""
+    jnp = _jnp()
+    off = 0.0 if box_normalized else 1.0
+    pw = prior_box[:, 2] - prior_box[:, 0] + off
+    ph = prior_box[:, 3] - prior_box[:, 1] + off
+    pcx = prior_box[:, 0] + pw * 0.5
+    pcy = prior_box[:, 1] + ph * 0.5
+    if prior_box_var is None:
+        var = jnp.ones((1, 4), jnp.float32)
+    else:
+        var = jnp.asarray(prior_box_var, jnp.float32).reshape(-1, 4) \
+            if np.ndim(prior_box_var) == 1 else prior_box_var
+    if code_type.lower() in ("encode_center_size", "encode"):
+        tw = target_box[:, 2] - target_box[:, 0] + off
+        th = target_box[:, 3] - target_box[:, 1] + off
+        tcx = target_box[:, 0] + tw * 0.5
+        tcy = target_box[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.clip(tw[:, None] / pw[None, :], 1e-10, None))
+        dh = jnp.log(jnp.clip(th[:, None] / ph[None, :], 1e-10, None))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        return out / var[None, :, :]
+    # decode: target_box [N, 4] deltas against priors [N, 4]
+    d = target_box * var if var.shape[0] != 1 else target_box * var[0]
+    cx = d[:, 0] * pw + pcx
+    cy = d[:, 1] * ph + pcy
+    w = jnp.exp(d[:, 2]) * pw
+    h = jnp.exp(d[:, 3]) * ph
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - off, cy + h * 0.5 - off], axis=-1)
+
+
+def prior_box(input_hw, image_hw, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variances=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False):
+    """prior_box_op.h: SSD prior boxes for one feature map. Returns
+    (boxes [H, W, P, 4], variances [H, W, P, 4])."""
+    jnp = _jnp()
+    H, W = input_hw
+    img_h, img_w = image_hw
+    step_h = steps[0] or img_h / H
+    step_w = steps[1] or img_w / W
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    whs = []
+    for ms in min_sizes:
+        whs.append((ms, ms))
+        if min_max_aspect_ratios_order and max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes and not min_max_aspect_ratios_order:
+            mx = max_sizes[min_sizes.index(ms)]
+            whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    whs = np.asarray(whs, np.float32)  # [P, 2]
+    P = len(whs)
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+    cxg = cxg[..., None]
+    cyg = cyg[..., None]
+    w_half = jnp.asarray(whs[:, 0])[None, None, :] * 0.5
+    h_half = jnp.asarray(whs[:, 1])[None, None, :] * 0.5
+    boxes = jnp.stack([(cxg - w_half) / img_w, (cyg - h_half) / img_h,
+                       (cxg + w_half) / img_w, (cyg + h_half) / img_h],
+                      axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (H, W, P, 4))
+    return boxes, var
+
+
+def anchor_generator(input_hw, anchor_sizes, aspect_ratios, stride,
+                     variances=(0.1, 0.1, 0.2, 0.2), offset=0.5):
+    """anchor_generator_op.h: RPN anchors. Returns (anchors [H,W,A,4],
+    variances [H,W,A,4]); coordinates in input-image pixels."""
+    jnp = _jnp()
+    H, W = input_hw
+    whs = []
+    for ar in aspect_ratios:
+        for sz in anchor_sizes:
+            area = sz * sz
+            w = np.sqrt(area / ar)
+            whs.append((w, w * ar))
+    whs = np.asarray(whs, np.float32)
+    A = len(whs)
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * stride[1]
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    cxg, cyg = cxg[..., None], cyg[..., None]
+    wh = jnp.asarray(whs) * 0.5
+    anchors = jnp.stack([cxg - wh[None, None, :, 0],
+                         cyg - wh[None, None, :, 1],
+                         cxg + wh[None, None, :, 0],
+                         cyg + wh[None, None, :, 1]], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (H, W, A, 4))
+    return anchors, var
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+    """yolo_box_op.h: decode one YOLOv3 head. x [B, A*(5+C), H, W],
+    img_size [B, 2] (h, w). Returns (boxes [B, H*W*A, 4],
+    scores [B, H*W*A, C])."""
+    import jax
+
+    jnp = _jnp()
+    B, ch, H, W = x.shape
+    A = len(anchors) // 2
+    C = class_num
+    x = x.reshape(B, A, 5 + C, H, W)
+    grid_x = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    an_w = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    an_h = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+    input_h = downsample_ratio * H
+    input_w = downsample_ratio * W
+    sig = jax.nn.sigmoid
+    bx = (grid_x + sig(x[:, :, 0]) * scale_x_y -
+          (scale_x_y - 1) * 0.5) / W
+    by = (grid_y + sig(x[:, :, 1]) * scale_x_y -
+          (scale_x_y - 1) * 0.5) / H
+    bw = jnp.exp(x[:, :, 2]) * an_w / input_w
+    bh = jnp.exp(x[:, :, 3]) * an_h / input_h
+    conf = sig(x[:, :, 4])
+    probs = sig(x[:, :, 5:]) * conf[:, :, None]
+    img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw * 0.5) * img_w
+    y1 = (by - bh * 0.5) * img_h
+    x2 = (bx + bw * 0.5) * img_w
+    y2 = (by + bh * 0.5) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0.0, img_w - 1)
+        y1 = jnp.clip(y1, 0.0, img_h - 1)
+        x2 = jnp.clip(x2, 0.0, img_w - 1)
+        y2 = jnp.clip(y2, 0.0, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # [B, A, H, W, 4]
+    mask = (conf > conf_thresh)[..., None]
+    boxes = jnp.where(mask, boxes, 0.0)
+    probs = jnp.where(mask, jnp.moveaxis(probs, 2, -1), 0.0)
+    boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(B, H * W * A, 4)
+    scores = probs.transpose(0, 2, 3, 1, 4).reshape(B, H * W * A, C)
+    return boxes, scores
+
+
+def roi_align(x, rois, roi_batch_id, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=False):
+    """roi_align_op: bilinear-sampled average pooling per RoI.
+    x [B, C, H, W], rois [R, 4] (x1, y1, x2, y2 in input coords),
+    roi_batch_id [R] int -> [R, C, ph, pw]."""
+    import jax
+
+    jnp = _jnp()
+    x = jnp.asarray(x)
+    B, C, H, W = x.shape
+    ph, pw = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+    offset = 0.5 if aligned else 0.0
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+
+    def one_roi(roi, bidx):
+        x1 = roi[0] * spatial_scale - offset
+        y1 = roi[1] * spatial_scale - offset
+        x2 = roi[2] * spatial_scale - offset
+        y2 = roi[3] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample grid: [ph, sr] x [pw, sr]
+        iy = (jnp.arange(ph)[:, None] +
+              (jnp.arange(sr)[None, :] + 0.5) / sr)  # [ph, sr]
+        ix = (jnp.arange(pw)[:, None] +
+              (jnp.arange(sr)[None, :] + 0.5) / sr)
+        sy = y1 + iy * bin_h   # [ph, sr]
+        sx = x1 + ix * bin_w   # [pw, sr]
+        img = x[bidx]  # [C, H, W]
+
+        def bilinear(yy, xx):
+            y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+            y1_ = jnp.clip(y0 + 1, 0, H - 1)
+            x1_ = jnp.clip(x0 + 1, 0, W - 1)
+            ly = jnp.clip(yy - y0, 0.0, 1.0)
+            lx = jnp.clip(xx - x0, 0.0, 1.0)
+            y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+            y1i, x1i = y1_.astype(jnp.int32), x1_.astype(jnp.int32)
+            v00 = img[:, y0i, x0i]
+            v01 = img[:, y0i, x1i]
+            v10 = img[:, y1i, x0i]
+            v11 = img[:, y1i, x1i]
+            return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+                    v10 * ly * (1 - lx) + v11 * ly * lx)
+
+        yy = sy[:, None, :, None]          # [ph, 1, sr, 1]
+        xx = sx[None, :, None, :]          # [1, pw, 1, sr]
+        yy = jnp.broadcast_to(yy, (ph, pw, sr, sr))
+        xx = jnp.broadcast_to(xx, (ph, pw, sr, sr))
+        vals = bilinear(yy.reshape(-1), xx.reshape(-1))  # [C, ph*pw*sr*sr]
+        vals = vals.reshape(C, ph, pw, sr, sr)
+        return vals.mean(axis=(3, 4))
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32),
+                             roi_batch_id.astype(jnp.int32))
+
+
+def roi_pool(x, rois, roi_batch_id, output_size, spatial_scale=1.0):
+    """roi_pool_op: max pooling per RoI bin (quantized boundaries)."""
+    import jax
+
+    jnp = _jnp()
+    x = jnp.asarray(x)
+    B, C, H, W = x.shape
+    ph, pw = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+
+    def one_roi(roi, bidx):
+        x1 = jnp.round(roi[0] * spatial_scale)
+        y1 = jnp.round(roi[1] * spatial_scale)
+        x2 = jnp.round(roi[2] * spatial_scale)
+        y2 = jnp.round(roi[3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        img = x[bidx]
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+        # bin index of each pixel relative to the roi, or -1 outside
+        by = jnp.floor((ys - y1) / (rh / ph))
+        bx = jnp.floor((xs - x1) / (rw / pw))
+        by = jnp.where((ys >= y1) & (ys <= y2), jnp.clip(by, 0, ph - 1),
+                       -1)
+        bx = jnp.where((xs >= x1) & (xs <= x2), jnp.clip(bx, 0, pw - 1),
+                       -1)
+        out = jnp.full((C, ph, pw), -jnp.inf, x.dtype)
+        onehot_y = (by[None, :] ==
+                    jnp.arange(ph, dtype=jnp.float32)[:, None])
+        onehot_x = (bx[None, :] ==
+                    jnp.arange(pw, dtype=jnp.float32)[:, None])
+        # [ph, H] x [pw, W]: max over the masked pixels per bin
+        masked = jnp.where(
+            onehot_y[None, :, None, :, None] &
+            onehot_x[None, None, :, None, :],
+            img[:, None, None, :, :], -jnp.inf)
+        out = masked.max(axis=(3, 4))
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32),
+                             roi_batch_id.astype(jnp.int32))
+
+
+def bipartite_match(dist):
+    """bipartite_match_op.cc greedy max matching: dist [N, M] ->
+    (match_indices [M] int32 with -1 for unmatched, match_dist [M])."""
+    import jax
+
+    jnp = _jnp()
+    N, M = dist.shape
+
+    def body(_, carry):
+        d, match_idx, match_d = carry
+        flat = jnp.argmax(d)
+        i, j = flat // M, flat % M
+        v = d[i, j]
+        ok = v > 0
+        match_idx = match_idx.at[j].set(
+            jnp.where(ok, i.astype(jnp.int32), match_idx[j]))
+        match_d = match_d.at[j].set(jnp.where(ok, v, match_d[j]))
+        d = jnp.where(ok, d.at[i, :].set(-1.0).at[:, j].set(-1.0), d)
+        return d, match_idx, match_d
+
+    init = (dist.astype(jnp.float32),
+            jnp.full((M,), -1, jnp.int32), jnp.zeros((M,), jnp.float32))
+    _, match_idx, match_d = jax.lax.fori_loop(
+        0, min(N, M), body, init)
+    return match_idx, match_d
+
+
+def density_prior_box(input_hw, image_hw, fixed_sizes, fixed_ratios,
+                      densities, variances=(0.1, 0.1, 0.2, 0.2),
+                      steps=(0.0, 0.0), offset=0.5, clip=False):
+    """density_prior_box_op.h: dense-grid SSD priors."""
+    jnp = _jnp()
+    H, W = input_hw
+    img_h, img_w = image_hw
+    step_h = steps[0] or img_h / H
+    step_w = steps[1] or img_w / W
+    whs = []
+    shifts = []
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            w = size * np.sqrt(ratio)
+            h = size / np.sqrt(ratio)
+            step = 1.0 / density
+            for di in range(density):
+                for dj in range(density):
+                    whs.append((w, h))
+                    shifts.append((
+                        (dj + 0.5) * step - 0.5,
+                        (di + 0.5) * step - 0.5))
+    whs = np.asarray(whs, np.float32)
+    shifts = np.asarray(shifts, np.float32)
+    P = len(whs)
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    cxg = cxg[..., None] + jnp.asarray(shifts[:, 0]) * step_w
+    cyg = cyg[..., None] + jnp.asarray(shifts[:, 1]) * step_h
+    wh = jnp.asarray(whs) * 0.5
+    boxes = jnp.stack([(cxg - wh[None, None, :, 0]) / img_w,
+                       (cyg - wh[None, None, :, 1]) / img_h,
+                       (cxg + wh[None, None, :, 0]) / img_w,
+                       (cyg + wh[None, None, :, 1]) / img_h], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (H, W, P, 4))
+    return boxes, var

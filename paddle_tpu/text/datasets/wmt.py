@@ -112,11 +112,19 @@ class WMT16(Dataset):
         return n if n > 0 else 2 ** 31 - 1
 
     def _dict_path(self, lang, size):
+        import hashlib
+
         base = os.path.join(
             os.path.expanduser(os.environ.get(
                 "PADDLE_TPU_DATA_HOME", common.DATA_HOME)), "wmt16")
         os.makedirs(base, exist_ok=True)
-        return os.path.join(base, f"{lang}_dict_{size}.txt")
+        # key the cache on the CORPUS identity too: two different tars
+        # must never share a vocabulary file
+        st = os.stat(self.data_file)
+        tag = hashlib.md5(
+            f"{os.path.abspath(self.data_file)}:{st.st_size}:"
+            f"{int(st.st_mtime)}".encode()).hexdigest()[:10]
+        return os.path.join(base, f"{lang}_dict_{size}_{tag}.txt")
 
     def _load_dict(self, lang, size):
         path = self._dict_path(lang, size)

@@ -1,0 +1,121 @@
+"""paddle.vision.ops parity: detection operators over eager Tensors.
+
+Reference: python/paddle/vision/ops.py (yolo_box, roi_align, roi_pool,
+nms, prior_box, box_coder...). Thin Tensor wrappers over
+paddle_tpu.ops.detection kernels (static-shape TPU design: NMS results
+are -1-padded fixed buffers + counts).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops import detection as D
+
+
+def _raw(v):
+    return v._data if isinstance(v, Tensor) else np.asarray(v)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, score_threshold=None,
+        category_idxs=None, categories=None, top_k=None):
+    import jax.numpy as jnp
+
+    b = _raw(boxes)
+    s = _raw(scores) if scores is not None else jnp.ones((b.shape[0],))
+    if category_idxs is not None:
+        # per-category NMS: offset each category onto a disjoint canvas so
+        # cross-category boxes never overlap (batched-NMS trick)
+        cats = jnp.asarray(_raw(category_idxs)).astype(jnp.float32)
+        span = jnp.abs(jnp.asarray(b)).max() + 1.0
+        b = jnp.asarray(b) + (cats * 2.0 * span)[:, None]
+    keep, cnt = D.nms(b, s, iou_threshold, score_threshold,
+                      top_k or b.shape[0])
+    n = int(cnt)
+    return Tensor._wrap(keep[:n])
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=64,
+                   keep_top_k=100, nms_threshold=0.3,
+                   background_label=0):
+    out, num = D.multiclass_nms(_raw(bboxes), _raw(scores),
+                                score_threshold, nms_top_k, keep_top_k,
+                                nms_threshold,
+                                background_label=background_label)
+    return Tensor._wrap(out), Tensor._wrap(num)
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    import jax.numpy as jnp
+
+    xr, br = _raw(x), _raw(boxes)
+    if boxes_num is None:
+        batch_ids = jnp.zeros((br.shape[0],), jnp.int32)
+    else:
+        bn = np.asarray(_raw(boxes_num)).reshape(-1)
+        batch_ids = jnp.asarray(np.repeat(np.arange(len(bn)), bn)
+                                .astype(np.int32))
+    out = D.roi_align(xr, br, batch_ids, output_size, spatial_scale,
+                      sampling_ratio, aligned)
+    return Tensor._wrap(out)
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0):
+    import jax.numpy as jnp
+
+    xr, br = _raw(x), _raw(boxes)
+    if boxes_num is None:
+        batch_ids = jnp.zeros((br.shape[0],), jnp.int32)
+    else:
+        bn = np.asarray(_raw(boxes_num)).reshape(-1)
+        batch_ids = jnp.asarray(np.repeat(np.arange(len(bn)), bn)
+                                .astype(np.int32))
+    return Tensor._wrap(D.roi_pool(xr, br, batch_ids, output_size,
+                                   spatial_scale))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None,
+             scale_x_y=1.0):
+    boxes, scores = D.yolo_box(_raw(x), _raw(img_size), anchors,
+                               class_num, conf_thresh, downsample_ratio,
+                               clip_bbox, scale_x_y)
+    return Tensor._wrap(boxes), Tensor._wrap(scores)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    xr, im = _raw(input), _raw(image)
+    # reference steps order is [step_w, step_h]; the kernel takes (h, w)
+    boxes, var = D.prior_box(
+        (xr.shape[2], xr.shape[3]), (im.shape[2], im.shape[3]),
+        list(min_sizes), list(max_sizes) if max_sizes else None,
+        tuple(aspect_ratios), tuple(variance), flip, clip,
+        (steps[1] if len(steps) > 1 else steps[0], steps[0]),
+        offset, min_max_aspect_ratios_order)
+    return Tensor._wrap(boxes), Tensor._wrap(var)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None, axis=0):
+    pv = None if prior_box_var is None else _raw(prior_box_var)
+    return Tensor._wrap(D.box_coder(_raw(prior_box), pv,
+                                    _raw(target_box), code_type,
+                                    box_normalized))
+
+
+def iou_similarity(x, y, box_normalized=True):
+    return Tensor._wrap(D.iou_matrix(_raw(x), _raw(y), box_normalized))
+
+
+def bipartite_match(dist_matrix):
+    idx, d = D.bipartite_match(_raw(dist_matrix))
+    return Tensor._wrap(idx), Tensor._wrap(d)
+
+
+def box_clip(input, im_info, name=None):
+    return Tensor._wrap(D.box_clip(_raw(input), _raw(im_info)))

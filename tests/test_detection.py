@@ -1,0 +1,232 @@
+"""Detection op library vs numpy oracles.
+
+Reference analogue: unittests/test_multiclass_nms_op.py,
+test_roi_align_op.py, test_yolo_box_op.py, test_prior_box_op.py,
+test_box_coder_op.py, test_bipartite_match_op.py — each kernel checked
+against a direct numpy implementation; plus the static lowering path
+and the paddle.vision.ops eager surface.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.ops import detection as D
+
+
+def _boxes(n, seed=0, size=100.0):
+    rng = np.random.RandomState(seed)
+    xy = rng.rand(n, 2) * size
+    wh = rng.rand(n, 2) * size * 0.4 + 1
+    return np.concatenate([xy, xy + wh], axis=1).astype("float32")
+
+
+def _iou_np(a, b):
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    aa = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    ab = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    union = aa[:, None] + ab[None, :] - inter
+    return np.where(union > 0, inter / union, 0)
+
+
+def test_iou_matrix():
+    a, b = _boxes(5, 0), _boxes(7, 1)
+    np.testing.assert_allclose(np.asarray(D.iou_matrix(a, b)),
+                               _iou_np(a, b), rtol=1e-5, atol=1e-6)
+
+
+def test_nms_matches_greedy_numpy():
+    boxes = _boxes(30, 2)
+    scores = np.random.RandomState(3).rand(30).astype("float32")
+    keep, cnt = D.nms(boxes, scores, iou_threshold=0.4)
+    keep = np.asarray(keep)[:int(cnt)]
+
+    # numpy greedy reference
+    order = np.argsort(-scores)
+    ious = _iou_np(boxes, boxes)
+    alive = np.ones(30, bool)
+    want = []
+    for i in order:
+        if alive[i]:
+            want.append(i)
+            alive &= ious[i] <= 0.4
+            alive[i] = False
+    np.testing.assert_array_equal(keep, want)
+
+
+def test_nms_score_threshold_and_max_out():
+    boxes = _boxes(20, 4)
+    scores = np.linspace(0, 1, 20).astype("float32")
+    keep, cnt = D.nms(boxes, scores, iou_threshold=0.99,
+                      score_threshold=0.5, max_out=5)
+    assert int(cnt) <= 5
+    kept = np.asarray(keep)[:int(cnt)]
+    assert np.all(scores[kept] > 0.5)
+
+
+def test_multiclass_nms_static_shape():
+    boxes = _boxes(16, 5)
+    scores = np.random.RandomState(6).rand(3, 16).astype("float32")
+    out, num = D.multiclass_nms(boxes, scores, score_threshold=0.2,
+                                keep_top_k=10, background_label=0)
+    out = np.asarray(out)
+    assert out.shape == (10, 6)
+    n = int(num)
+    assert np.all(out[:n, 0] >= 1)  # class 0 = background excluded
+    assert np.all(out[n:, 0] == -1)
+    # scores sorted descending over valid rows
+    s = out[:n, 1]
+    assert np.all(np.diff(s) <= 1e-6)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    priors = _boxes(8, 7)
+    targets = _boxes(8, 8)
+    var = np.array([0.1, 0.1, 0.2, 0.2], "float32")
+    enc = np.asarray(D.box_coder(priors, var, targets, "encode_center_size"))
+    # decode the diagonal (target i against prior i)
+    deltas = enc[np.arange(8), np.arange(8)]
+    dec = np.asarray(D.box_coder(priors, var, deltas,
+                                 "decode_center_size"))
+    np.testing.assert_allclose(dec, targets, rtol=1e-4, atol=1e-3)
+
+
+def test_box_clip():
+    boxes = np.array([[-5, -5, 50, 50], [10, 10, 200, 300]], "float32")
+    out = np.asarray(D.box_clip(boxes, np.array([100, 120], "float32")))
+    np.testing.assert_allclose(out, [[0, 0, 50, 50], [10, 10, 119, 99]])
+
+
+def test_prior_box_properties():
+    boxes, var = D.prior_box((4, 4), (64, 64), min_sizes=[16.0],
+                             max_sizes=[32.0], aspect_ratios=(2.0,),
+                             flip=True, clip=True)
+    boxes = np.asarray(boxes)
+    # P = 1 (min) + 2 (ar 2, 1/2) + 1 (sqrt(min*max)) = 4
+    assert boxes.shape == (4, 4, 4, 4)
+    assert boxes.min() >= 0 and boxes.max() <= 1
+    # first prior at cell (0,0): square of size 16 centered at (8, 8)
+    np.testing.assert_allclose(
+        boxes[0, 0, 0], [0, 0, 16 / 64, 16 / 64], atol=1e-6)
+    assert np.asarray(var).shape == (4, 4, 4, 4)
+
+
+def test_anchor_generator_first_cell():
+    anchors, _ = D.anchor_generator((2, 2), [32.0], [1.0], [16.0, 16.0])
+    anchors = np.asarray(anchors)
+    assert anchors.shape == (2, 2, 1, 4)
+    # center of cell (0,0) = (8, 8); size-32 square
+    np.testing.assert_allclose(anchors[0, 0, 0], [-8, -8, 24, 24],
+                               atol=1e-5)
+
+
+def test_yolo_box_decode():
+    rng = np.random.RandomState(9)
+    B, A, C, H, W = 1, 2, 3, 2, 2
+    x = rng.randn(B, A * (5 + C), H, W).astype("float32")
+    img = np.array([[64, 64]], "int32")
+    anchors = [10, 14, 23, 27]
+    boxes, scores = D.yolo_box(x, img, anchors, C, conf_thresh=-1.0,
+                               downsample_ratio=32, clip_bbox=False)
+    boxes, scores = np.asarray(boxes), np.asarray(scores)
+    assert boxes.shape == (B, H * W * A, 4)
+    assert scores.shape == (B, H * W * A, C)
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    # check cell (0, 0), anchor 0 against the formula
+    xr = x.reshape(B, A, 5 + C, H, W)
+    bx = (0 + sig(xr[0, 0, 0, 0, 0])) / W * 64
+    by = (0 + sig(xr[0, 0, 1, 0, 0])) / H * 64
+    bw = np.exp(xr[0, 0, 2, 0, 0]) * 10 / (32 * W) * 64
+    bh = np.exp(xr[0, 0, 3, 0, 0]) * 14 / (32 * H) * 64
+    np.testing.assert_allclose(
+        boxes[0, 0], [bx - bw / 2, by - bh / 2, bx + bw / 2,
+                      by + bh / 2], rtol=1e-4)
+    conf = sig(xr[0, 0, 4, 0, 0])
+    np.testing.assert_allclose(scores[0, 0],
+                               sig(xr[0, 0, 5:, 0, 0]) * conf, rtol=1e-4)
+
+
+def test_roi_align_constant_map():
+    """On a constant feature map every aligned average is the constant."""
+    x = np.full((1, 3, 8, 8), 2.5, "float32")
+    rois = np.array([[0, 0, 4, 4], [2, 2, 7, 7]], "float32")
+    out = np.asarray(D.roi_align(x, rois, np.zeros(2, np.int32), (2, 2)))
+    assert out.shape == (2, 3, 2, 2)
+    np.testing.assert_allclose(out, 2.5, rtol=1e-6)
+
+
+def test_roi_align_linear_map_center():
+    """On f(y, x) = x the bilinear average equals the bin center x."""
+    W = 16
+    x = np.tile(np.arange(W, dtype="float32"), (1, 1, W, 1))
+    rois = np.array([[2.0, 2.0, 10.0, 10.0]], "float32")
+    out = np.asarray(D.roi_align(x, rois, np.zeros(1, np.int32), (2, 2),
+                                 sampling_ratio=2))
+    # bins span x in [2, 6] and [6, 10]: centers 4 and 8
+    np.testing.assert_allclose(out[0, 0, 0], [4.0, 8.0], atol=1e-4)
+
+
+def test_roi_pool_max():
+    x = np.zeros((1, 1, 8, 8), "float32")
+    x[0, 0, 1, 1] = 5.0
+    x[0, 0, 6, 6] = 7.0
+    rois = np.array([[0, 0, 7, 7]], "float32")
+    out = np.asarray(D.roi_pool(x, rois, np.zeros(1, np.int32), (2, 2)))
+    assert out.shape == (1, 1, 2, 2)
+    assert out[0, 0, 0, 0] == 5.0
+    assert out[0, 0, 1, 1] == 7.0
+
+
+def test_bipartite_match_greedy():
+    dist = np.array([[0.9, 0.1, 0.3],
+                     [0.8, 0.7, 0.2]], "float32")
+    idx, d = D.bipartite_match(dist)
+    idx, d = np.asarray(idx), np.asarray(d)
+    # greedy: (0,0)=0.9 first, then (1,1)=0.7; col 2 unmatched
+    np.testing.assert_array_equal(idx, [0, 1, -1])
+    np.testing.assert_allclose(d, [0.9, 0.7, 0.0], rtol=1e-6)
+
+
+def test_vision_ops_surface():
+    boxes = _boxes(10, 11)
+    scores = np.random.RandomState(12).rand(10).astype("float32")
+    kept = paddle.vision.ops.nms(paddle.to_tensor(boxes),
+                                 iou_threshold=0.5,
+                                 scores=paddle.to_tensor(scores))
+    assert kept.numpy().ndim == 1
+    x = paddle.to_tensor(np.random.RandomState(13).randn(
+        1, 2, 8, 8).astype("float32"))
+    rois = paddle.to_tensor(np.array([[0, 0, 4, 4]], "float32"))
+    out = paddle.vision.ops.roi_align(x, rois, output_size=2)
+    assert tuple(out.numpy().shape) == (1, 2, 2, 2)
+
+
+def test_static_detection_program():
+    """multiclass_nms + box_coder + iou through the static executor."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        bx = fluid.layers.data("bx", shape=[16, 4], dtype="float32")
+        sc = fluid.layers.data("sc", shape=[3, 16], dtype="float32")
+        out = fluid.layers.detection.multiclass_nms(
+            bx, sc, score_threshold=0.2, keep_top_k=8)
+        a = fluid.layers.data("a", shape=[5, 4], dtype="float32")
+        b = fluid.layers.data("b", shape=[6, 4], dtype="float32")
+        sim = fluid.layers.detection.iou_similarity(a, b)
+    exe = fluid.Executor()
+    exe.run(startup)
+    boxes = _boxes(16, 14)
+    scores = np.random.RandomState(15).rand(3, 16).astype("float32")
+    av, bv = _boxes(5, 16), _boxes(6, 17)
+    o, s = exe.run(main, {"bx": boxes, "sc": scores, "a": av, "b": bv},
+                   [out, sim])
+    assert o.shape == (8, 6)
+    np.testing.assert_allclose(s, _iou_np(av, bv), rtol=1e-5, atol=1e-6)
+    want, _ = D.multiclass_nms(boxes, scores, score_threshold=0.2,
+                               keep_top_k=8)
+    np.testing.assert_allclose(o, np.asarray(want), rtol=1e-5)
