@@ -59,7 +59,8 @@ def _build():
         check=True, capture_output=True)
     srcs = [os.path.join(_CSRC, "ptcore", f)
             for f in ("datafeed.cc", "saveload.cc", "profiler.cc",
-                      "fs.cc", "executor.cc", "ps_server.cc", "capi.cc")]
+                      "fs.cc", "executor.cc", "ps_server.cc",
+                      "crypto.cc", "capi.cc")]
     srcs.append(os.path.join(gen, "ptframework.pb.cc"))
     subprocess.run(
         ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", *srcs,
@@ -135,6 +136,11 @@ def _declare(lib):
         "pt_prof_record": (None, [c.c_char_p, c.c_uint64, c.c_uint64]),
         "pt_prof_dump": (c.c_int, [c.c_char_p]),
         "pt_prof_clear": (None, []),
+        "pt_cipher_encrypt_file": (c.c_int, [c.c_char_p, c.c_char_p,
+                                             c.c_char_p]),
+        "pt_cipher_decrypt_file": (c.c_int, [c.c_char_p, c.c_char_p,
+                                             c.c_char_p]),
+        "pt_cipher_is_encrypted": (c.c_int, [c.c_char_p]),
         "pt_prof_count": (c.c_uint64, []),
         "pt_pred_create": (c.c_void_p, [c.c_char_p]),
         "pt_pred_error": (c.c_char_p, [c.c_void_p]),

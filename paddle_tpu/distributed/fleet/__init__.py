@@ -77,11 +77,18 @@ class Fleet:
 
     # ----------------- optimizer path ----------------
     def distributed_optimizer(self, optimizer, strategy=None):
+        from .strategy_compiler import StrategyCompiler
+
         if strategy is not None:
             self._strategy = strategy
         self._user_defined_optimizer = optimizer
-        return MetaOptimizer(optimizer, self._strategy or
-                             DistributedStrategy(), self)
+        st = self._strategy or DistributedStrategy()
+        # validate + order the strategy set (raises on conflicts — the
+        # reference StrategyCompiler's black-list behavior)
+        compiler = StrategyCompiler()
+        compiler.generate_optimizer(st, optimizer)
+        self._strategy_compiler = compiler
+        return MetaOptimizer(optimizer, st, self)
 
     def distributed_model(self, model):
         from ..parallel import DataParallel

@@ -1,0 +1,3 @@
+from .fs import HDFSClient, LocalFS  # noqa: F401
+
+__all__ = ["LocalFS", "HDFSClient"]

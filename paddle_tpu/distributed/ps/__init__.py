@@ -363,3 +363,52 @@ def run_pserver(port=0, trainers=1, optimizer="sgd", lr=0.01,
         pass
     finally:
         server.stop()
+
+
+class SparsePrefetcher:
+    """Overlap sparse pulls with device compute (parameter_prefetch.cc
+    capability): while the chip runs step t, a background thread pulls
+    the embedding rows for step t+1's ids.
+
+    usage:
+        pf = SparsePrefetcher(comm, "emb", dim)
+        pf.prime(first_ids)
+        for batch in data:
+            rows = pf.get()            # rows for current ids
+            pf.prefetch(next_ids)      # overlap next pull with compute
+            ... train on rows ...
+    """
+
+    def __init__(self, comm, table, dim):
+        self.comm = comm
+        self.table = table
+        self.dim = dim
+        self._pending = None
+
+    def _pull(self, ids):
+        flat = np.asarray(ids, np.int64).ravel()
+        rows = self.comm._client_for(self.table).pull_sparse(
+            self.table, flat, self.dim)
+        return rows.reshape(np.asarray(ids).shape + (self.dim,))
+
+    def prime(self, ids):
+        self.prefetch(ids)
+
+    def prefetch(self, ids):
+        import concurrent.futures
+
+        if not hasattr(self, "_pool"):
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="pt-sparse-prefetch")
+        self._pending = self._pool.submit(self._pull, ids)
+
+    def get(self, timeout=60.0):
+        if self._pending is None:
+            raise RuntimeError("prefetch()/prime() before get()")
+        out = self._pending.result(timeout=timeout)
+        self._pending = None
+        return out
+
+    def close(self):
+        if hasattr(self, "_pool"):
+            self._pool.shutdown(wait=False)

@@ -1,21 +1,78 @@
-"""train_from_dataset glue (reference: executor.py:1407 _run_from_dataset +
-MultiTrainer/HogwildWorker). The file-driven Dataset lives in
-fluid/dataset.py; this runs its batches through the jitted program step."""
+"""train_from_dataset engine (reference: executor.py:1407
+_run_from_dataset + MultiTrainer::Run multi_trainer.cc:120 +
+HogwildWorker::TrainFiles hogwild_worker.cc:191).
+
+TPU-native design: the reference runs one DeviceWorker THREAD per CPU
+core because each op executes on the worker's core; with a single XLA
+device the compute parallelism lives inside the chip, so the engine's
+job is keeping the DEVICE fed — a reader thread drains the native
+datafeed into a bounded prefetch queue (the double-buffering
+BufferedReader capability, operators/reader/buffered_reader.cc) while
+the main thread dispatches jitted steps; XLA's async dispatch overlaps
+host feeding with device compute.
+"""
 from __future__ import annotations
+
+import queue
+import threading
 
 
 def run_from_dataset(executor, program, dataset, fetch_list=None,
-                     fetch_info=None, print_period=100):
+                     fetch_info=None, print_period=100,
+                     prefetch_depth=4):
     if dataset is None:
         raise ValueError("dataset is required")
     fetch_names = [f.name if hasattr(f, "name") else f
                    for f in (fetch_list or [])]
+
+    q = queue.Queue(maxsize=prefetch_depth)
+    _END = object()
+    err = []
+    stop = threading.Event()
+
+    def feeder():
+        try:
+            for batch in dataset._iter_batches():
+                while not stop.is_set():  # never block forever on a
+                    try:                  # dead consumer (step raised)
+                        q.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # surfaced on the consumer side
+            err.append(e)
+        finally:
+            while True:
+                try:
+                    q.put(_END, timeout=0.1)
+                    break
+                except queue.Full:
+                    if stop.is_set():
+                        break
+
+    t = threading.Thread(target=feeder, daemon=True,
+                         name="pt-datafeed-prefetch")
+    t.start()
+
     step = 0
-    for batch in dataset._iter_batches():
-        feed = batch
-        out = executor.run(program, feed=feed, fetch_list=fetch_list)
-        if fetch_names and print_period and step % print_period == 0:
-            info = fetch_info or fetch_names
-            print(" ".join(f"{n}={v}" for n, v in zip(info, out)))
-        step += 1
+    try:
+        while True:
+            batch = q.get()
+            if batch is _END:
+                break
+            out = executor.run(program, feed=batch,
+                               fetch_list=fetch_list)
+            if fetch_names and print_period and \
+                    step % print_period == 0:
+                info = fetch_info or fetch_names
+                print(" ".join(f"{n}={v}"
+                               for n, v in zip(info, out)))
+            step += 1
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+    if err:
+        raise err[0]
     return None

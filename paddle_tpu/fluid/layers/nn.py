@@ -278,3 +278,227 @@ def topk(input, k=1, name=None):
                      outputs={"Out": [values], "Indices": [indices]},
                      attrs={"k": k})
     return values, indices
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    helper = LayerHelper("conv2d_transpose", name=name)
+    k = _pair(filter_size if filter_size is not None else 4)
+    s = _pair(stride)
+    p = _pair(padding)
+    d = _pair(dilation)
+    c_in = input.shape[1]
+    w = helper.create_parameter(
+        param_attr, [c_in, num_filters // groups, k[0], k[1]], input.dtype)
+    n, _, h, wd = input.shape
+
+    def _base(sz, i):
+        return (sz - 1) * s[i] - 2 * p[i] + d[i] * (k[i] - 1) + 1
+
+    opad = [0, 0]
+    if output_size is not None:
+        osz = _pair(output_size)
+        for i, sz in enumerate((h, wd)):
+            if sz in (-1, None):
+                continue
+            opad[i] = int(osz[i]) - _base(sz, i)
+            if not 0 <= opad[i] < s[i]:
+                raise ValueError(
+                    f"output_size[{i}]={osz[i]} unreachable from input "
+                    f"{sz} with stride {s[i]} (valid range "
+                    f"[{_base(sz, i)}, {_base(sz, i) + s[i] - 1}])")
+    oh = _base(h, 0) + opad[0] if h not in (-1, None) else -1
+    ow = _base(wd, 1) + opad[1] if wd not in (-1, None) else -1
+    out = helper.create_variable_for_type_inference(
+        input.dtype, [n, num_filters, oh, ow])
+    helper.append_op(type="conv2d_transpose",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": s, "paddings": p,
+                            "output_padding": opad,
+                            "dilations": d,
+                            "groups": groups})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        out = helper.append_bias_op(out, b, 1)
+    return helper.append_activation(out, act)
+
+
+def expand(x, expand_times, name=None):
+    helper = LayerHelper("expand", name=name)
+    shape = [(-1 if d in (-1, None) else d * t)
+             for d, t in zip(x.shape, expand_times)]
+    out = helper.create_variable_for_type_inference(x.dtype, shape)
+    helper.append_op(type="expand", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"expand_times": list(expand_times)})
+    return out
+
+
+def slice(input, axes, starts, ends, name=None):  # noqa: A001
+    helper = LayerHelper("slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, None)
+    helper.append_op(type="slice", inputs={"Input": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"axes": list(axes), "starts": list(starts),
+                            "ends": list(ends)})
+    return out
+
+
+def gather(input, index, overwrite=True, name=None):
+    helper = LayerHelper("gather", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, None)
+    helper.append_op(type="gather",
+                     inputs={"X": [input], "Index": [index]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper("pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, None)
+    helper.append_op(type="pad", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"paddings": list(paddings),
+                            "pad_value": float(pad_value)})
+    return out
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      name=None, normalize=False):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="sigmoid_cross_entropy_with_logits",
+                     inputs={"X": [x], "Label": [label]},
+                     outputs={"Out": [out]},
+                     attrs={"ignore_index": ignore_index,
+                            "normalize": normalize})
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=1.0):
+    helper = LayerHelper("smooth_l1_loss")
+    diff = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    out = helper.create_variable_for_type_inference(
+        x.dtype, [x.shape[0], 1])
+    inputs = {"X": [x], "Y": [y]}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = [inside_weight]
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = [outside_weight]
+    helper.append_op(type="smooth_l1_loss", inputs=inputs,
+                     outputs={"Out": [out], "Diff": [diff]},
+                     attrs={"sigma": sigma})
+    return out
+
+
+def l2_normalize(x, axis=-1, epsilon=1e-12, name=None):
+    """Composed from existing ops — no new lowering needed."""
+    from .math_ops import elementwise_div, reduce_sum, square
+
+    sq = square(x)
+    ssum = reduce_sum(sq, dim=[axis], keep_dim=True)
+    helper = LayerHelper("l2_normalize")
+    norm = helper.create_variable_for_type_inference(x.dtype, ssum.shape)
+    helper.append_op(type="clip", inputs={"X": [ssum]},
+                     outputs={"Out": [norm]},
+                     attrs={"min": epsilon, "max": 3.4e38})
+    rt = helper.create_variable_for_type_inference(x.dtype, norm.shape)
+    helper.append_op(type="sqrt", inputs={"X": [norm]},
+                     outputs={"Out": [rt]}, attrs={})
+    return elementwise_div(x, rt)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    out = helper.create_variable_for_type_inference(label.dtype,
+                                                    label.shape)
+    helper.append_op(type="label_smooth", inputs={"X": [label]},
+                     outputs={"Out": [out]},
+                     attrs={"epsilon": float(epsilon)})
+    return out
+
+
+def cumsum(x, axis=None, exclusive=False, reverse=False, name=None):
+    helper = LayerHelper("cumsum", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="cumsum", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"axis": -1 if axis is None else axis,
+                            "exclusive": exclusive, "reverse": reverse})
+    return out
+
+
+def reverse(x, axis, name=None):
+    helper = LayerHelper("reverse", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="reverse", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"axis": axis if isinstance(axis, (list, tuple))
+                            else [axis]})
+    return out
+
+
+def sign(x, name=None):
+    helper = LayerHelper("sign", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="sign", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def argmin(x, axis=0, name=None):
+    helper = LayerHelper("arg_min", name=name)
+    out = helper.create_variable_for_type_inference(np.int64, None)
+    helper.append_op(type="arg_min", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"axis": axis, "keepdims": False})
+    return out
+
+
+def unstack(x, axis=0, num=None, name=None):
+    """Composed: split along axis then squeeze it."""
+    from .tensor import cast  # noqa: F401 (import keeps style parity)
+
+    helper = LayerHelper("unstack", name=name)
+    n = num if num is not None else x.shape[axis]
+    outs = [helper.create_variable_for_type_inference(x.dtype, None)
+            for _ in range(n)]
+    helper.append_op(type="split", inputs={"X": [x]},
+                     outputs={"Out": outs},
+                     attrs={"num": n, "axis": axis, "sections": None})
+    squeezed = []
+    for o in outs:
+        so = helper.create_variable_for_type_inference(x.dtype, None)
+        helper.append_op(type="squeeze", inputs={"X": [o]},
+                        outputs={"Out": [so]}, attrs={"axes": [axis]})
+        squeezed.append(so)
+    return squeezed
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op(type="clip_by_norm", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"max_norm": float(max_norm)})
+    return out
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """sequence lod override (lod_reset_op.h): re-attaches the lengths
+    companion from y (another sequence var) or a literal lod."""
+    helper = LayerHelper("lod_reset")
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    out.lod_level = 1
+    inputs = {"X": [x]}
+    if y is not None:
+        inputs["Y"] = [y]
+    helper.append_op(type="lod_reset", inputs=inputs,
+                     outputs={"Out": [out]},
+                     attrs={"target_lod": list(target_lod or [])})
+    return out

@@ -1423,3 +1423,107 @@ from . import lowering_seq  # noqa: E402,F401
 
 # detection-op lowerings register themselves on import
 from . import lowering_detection  # noqa: E402,F401
+
+
+# ====== book-era op additions (fluid/layers/nn.py 15.2k surface) ======
+
+@register("sigmoid_cross_entropy_with_logits")
+def _sce_logits(ctx, op):
+    import jax
+
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    label = ctx.inp(op, "Label").astype(x.dtype)
+    ignore = op.attrs.get("ignore_index", -100)
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    mask = (label != ignore).astype(x.dtype)
+    loss = loss * mask
+    if op.attrs.get("normalize", False):
+        loss = loss / jnp.maximum(mask.sum(), 1.0)
+    ctx.out(op, "Out", loss)
+
+
+@register("smooth_l1_loss")
+def _smooth_l1(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    y = ctx.inp(op, "Y")
+    iw = ctx.inp(op, "InsideWeight")
+    ow = ctx.inp(op, "OutsideWeight")
+    sigma2 = float(op.attrs.get("sigma", 1.0)) ** 2
+    d = x - y
+    if iw is not None:
+        d = d * iw
+    ad = jnp.abs(d)
+    per = jnp.where(ad < 1.0 / sigma2, 0.5 * d * d * sigma2,
+                    ad - 0.5 / sigma2)
+    if ow is not None:
+        per = per * ow
+    ctx.out(op, "Diff", d)
+    ctx.out(op, "Out", per.reshape(per.shape[0], -1).sum(
+        axis=1, keepdims=True))
+
+
+@register("label_smooth")
+def _label_smooth(ctx, op):
+    x = ctx.inp(op, "X")
+    eps = op.attrs.get("epsilon", 0.1)
+    prior = ctx.inp(op, "PriorDist")
+    if prior is not None:
+        ctx.out(op, "Out", x * (1.0 - eps) + eps * prior)
+    else:
+        ctx.out(op, "Out", x * (1.0 - eps) + eps / x.shape[-1])
+
+
+@register("cumsum")
+def _cumsum(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    axis = op.attrs.get("axis", -1)
+    if op.attrs.get("reverse", False):
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if op.attrs.get("exclusive", False):
+        out = out - (jnp.flip(ctx.inp(op, "X"), axis)
+                     if op.attrs.get("reverse", False)
+                     else ctx.inp(op, "X"))
+    if op.attrs.get("reverse", False):
+        out = jnp.flip(out, axis)
+    ctx.out(op, "Out", out)
+
+
+@register("reverse")
+def _reverse(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    for ax in op.attrs.get("axis", [0]):
+        x = jnp.flip(x, ax)
+    ctx.out(op, "Out", x)
+
+
+@register("arg_min")
+def _arg_min(ctx, op):
+    ctx.out(op, "Out", K.argmin(ctx.inp(op, "X"), op.attrs.get("axis"),
+                                op.attrs.get("keepdims", False)))
+
+
+@register("lod_reset")
+def _lod_reset(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    out_names = op.output("Out")
+    ctx.out(op, "Out", x)
+    if not out_names:
+        return
+    if op.input("Y"):
+        src = op.input("Y")[0] + _LOD_SUFFIX
+        if src in ctx.env:
+            ctx.env[out_names[0] + _LOD_SUFFIX] = ctx.env[src]
+            return
+    tl = op.attrs.get("target_lod") or []
+    if tl:
+        import numpy as _np
+
+        lens = _np.diff(_np.asarray(tl))
+        ctx.env[out_names[0] + _LOD_SUFFIX] = jnp.asarray(
+            lens.astype(_np.int32))

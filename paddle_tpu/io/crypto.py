@@ -1,0 +1,78 @@
+"""Model encryption (framework/io/crypto + pybind/crypto.cc parity).
+
+Native AES-128-CTR with an integrity tag (csrc/ptcore/crypto.cc);
+encrypt/decrypt inference artifacts at rest:
+
+    from paddle_tpu.io import crypto
+    c = crypto.CipherFactory.create_cipher()
+    c.encrypt_to_file(key, model_path, enc_path)
+    c.decrypt_from_file(key, enc_path, model_path)
+"""
+from __future__ import annotations
+
+import os
+
+from ..core.native import load_library
+
+
+def encrypt_file(src, dst, key):
+    lib = load_library(required=True)
+    rc = lib.pt_cipher_encrypt_file(
+        os.fspath(src).encode(), os.fspath(dst).encode(),
+        key.encode() if isinstance(key, str) else key)
+    if rc != 0:
+        raise IOError(f"encrypt_file({src!r}) failed rc={rc}")
+
+
+def decrypt_file(src, dst, key):
+    lib = load_library(required=True)
+    rc = lib.pt_cipher_decrypt_file(
+        os.fspath(src).encode(), os.fspath(dst).encode(),
+        key.encode() if isinstance(key, str) else key)
+    if rc == -5:
+        raise ValueError(
+            f"decrypt_file({src!r}): wrong key or corrupted file "
+            f"(integrity tag mismatch)")
+    if rc != 0:
+        raise IOError(f"decrypt_file({src!r}) failed rc={rc}")
+
+
+def is_encrypted(path):
+    lib = load_library(required=True)
+    return bool(lib.pt_cipher_is_encrypted(os.fspath(path).encode()))
+
+
+class Cipher:
+    """pybind crypto.cc Cipher parity (file-level AES-CTR)."""
+
+    def encrypt_to_file(self, key, src, dst):
+        encrypt_file(src, dst, key)
+
+    def decrypt_from_file(self, key, src, dst):
+        decrypt_file(src, dst, key)
+
+
+class CipherFactory:
+    @staticmethod
+    def create_cipher(config_file=None):
+        return Cipher()
+
+
+def encrypt_inference_model(model_dir, out_dir, key,
+                            files=("__model__", "__params__")):
+    """Encrypt a saved inference model directory (the reference's
+    encrypted-model deployment flow)."""
+    os.makedirs(out_dir, exist_ok=True)
+    for f in files:
+        src = os.path.join(model_dir, f)
+        if os.path.exists(src):
+            encrypt_file(src, os.path.join(out_dir, f), key)
+
+
+def decrypt_inference_model(enc_dir, out_dir, key,
+                            files=("__model__", "__params__")):
+    os.makedirs(out_dir, exist_ok=True)
+    for f in files:
+        src = os.path.join(enc_dir, f)
+        if os.path.exists(src):
+            decrypt_file(src, os.path.join(out_dir, f), key)

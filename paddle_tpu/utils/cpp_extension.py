@@ -1,0 +1,110 @@
+"""Custom C++ op loading (tests/custom_op + utils/cpp_extension parity).
+
+The reference JIT-compiles user .cc files against its op registry and
+dlopens them. TPU-native design: the user writes a plain C kernel
+(float arrays in/out), `load()` compiles it with g++ into a shared
+library, and `register_custom_op` exposes it BOTH as an eager Tensor op
+and as a static-graph lowering — the host kernel runs inside XLA
+programs through jax.pure_callback (the supported escape hatch for
+host-side custom code; device-side custom kernels are written in pallas
+instead, see ops/attention.py).
+
+Expected C symbol:  void <name>(const float* x, float* out, long long n)
+(elementwise contract; richer signatures can be bound manually from the
+returned ctypes library).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+_LOADED = {}
+
+
+def load(name, sources, extra_cxx_cflags=None, build_directory=None,
+         verbose=False):
+    """Compile `sources` (.cc/.cpp) into <build>/<name>.so and dlopen it.
+    Returns the ctypes CDLL."""
+    flags = tuple(extra_cxx_cflags or [])
+    key = (name, tuple(sources), flags, build_directory)
+    if key in _LOADED:
+        return _LOADED[key]
+    build = build_directory or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    os.makedirs(build, exist_ok=True)
+    tag = hashlib.md5(("".join(
+        open(s).read() for s in sources) +
+        "|".join(flags)).encode()).hexdigest()[:10]
+    so = os.path.join(build, f"{name}_{tag}.so")
+    if not os.path.exists(so):
+        cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+               *list(sources), *(extra_cxx_cflags or []), "-o", so]
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"custom op build failed:\n{proc.stderr[-2000:]}")
+    lib = ctypes.CDLL(so)
+    _LOADED[key] = lib
+    return lib
+
+
+def register_custom_op(op_name, lib, symbol=None):
+    """Bind lib.<symbol> (elementwise float contract) as:
+      - an eager callable paddle-style: fn(tensor) -> tensor
+      - a static op lowering of type `op_name` (inputs {X}, outputs {Out})
+    The kernel runs on HOST via jax.pure_callback, so it composes with
+    jit/grad-free graphs (reference custom ops are likewise opaque to
+    autodiff unless a grad kernel is registered)."""
+    fn_c = getattr(lib, symbol or op_name)
+    fn_c.restype = None
+    fn_c.argtypes = [ctypes.POINTER(ctypes.c_float),
+                     ctypes.POINTER(ctypes.c_float), ctypes.c_longlong]
+
+    def host_kernel(x):
+        x = np.ascontiguousarray(x, np.float32)
+        out = np.empty_like(x)
+        fn_c(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+             x.size)
+        return out
+
+    def jax_op(x):
+        import jax
+
+        return jax.pure_callback(
+            host_kernel, jax.ShapeDtypeStruct(x.shape, np.float32),
+            x.astype(np.float32))
+
+    # eager surface
+    def eager(x):
+        from ..core.tensor import Tensor
+
+        raw = x._data if isinstance(x, Tensor) else x
+        return Tensor._wrap(jax_op(raw))
+
+    # static lowering
+    from ..fluid import lowering
+
+    @lowering.register(op_name)
+    def _lower(ctx, op):  # noqa: F811
+        ctx.out(op, "Out", jax_op(ctx.inp(op, "X")))
+
+    # fluid layer sugar
+    def layer(x, name=None):
+        from ..fluid.layer_helper import LayerHelper
+
+        helper = LayerHelper(op_name, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+        helper.append_op(type=op_name, inputs={"X": [x]},
+                         outputs={"Out": [out]}, attrs={})
+        return out
+
+    eager.static_layer = layer
+    return eager
