@@ -137,13 +137,20 @@ static uint64_t Fnv(const uint8_t* p, size_t n) {
   return h;
 }
 
-// KEYED tag: hashes key material first so the header never carries a
-// plaintext fingerprint an attacker could match offline.
-static uint64_t KeyedTag(const uint8_t key[16], const uint8_t* p,
-                         size_t n) {
+// KEYED tag (NOT a cryptographic MAC — tamper-evidence for operational
+// integrity, parity with the reference's checksum role): absorbs
+// key || iv || data || key so (a) the random IV decorrelates equal
+// plaintexts and (b) the trailing key absorption blocks running the
+// absorption backwards from a known plaintext.
+static uint64_t KeyedTag(const uint8_t key[16], const uint8_t iv[16],
+                         const uint8_t* p, size_t n) {
   uint64_t h = Fnv(key, 16);
+  for (size_t i = 0; i < 16; ++i) h = (h ^ iv[i]) * 1099511628211ULL;
   for (size_t i = 0; i < n; ++i) h = (h ^ p[i]) * 1099511628211ULL;
-  return h ^ Fnv(key, 16) << 1;
+  for (size_t i = 0; i < 16; ++i) h = (h ^ key[i]) * 1099511628211ULL;
+  h ^= h >> 30; h *= 0xbf58476d1ce4e5b9ULL;
+  h ^= h >> 27; h *= 0x94d049bb133111ebULL;
+  return h ^ (h >> 31);
 }
 
 }  // namespace ptcrypto
@@ -179,17 +186,18 @@ int pt_cipher_encrypt_file(const char* src, const char* dst,
       std::memcpy(iv + i, &r, 4);
     }
   }
-  uint64_t tag = ptcrypto::KeyedTag(key, buf.data(), buf.size());
+  uint64_t tag = ptcrypto::KeyedTag(key, iv, buf.data(), buf.size());
 
   ptcrypto::CtrTransform(aes, iv, buf.data(), buf.size());
 
   FILE* fo = std::fopen(dst, "wb");
   if (!fo) return -3;
-  std::fwrite(kMagic, 1, 8, fo);
-  std::fwrite(iv, 1, 16, fo);
-  std::fwrite(&tag, 1, 8, fo);
-  if (!buf.empty()) std::fwrite(buf.data(), 1, buf.size(), fo);
-  std::fclose(fo);
+  size_t wrote = std::fwrite(kMagic, 1, 8, fo);
+  wrote += std::fwrite(iv, 1, 16, fo);
+  wrote += std::fwrite(&tag, 1, 8, fo);
+  if (!buf.empty()) wrote += std::fwrite(buf.data(), 1, buf.size(), fo);
+  int rc = std::fclose(fo);
+  if (wrote != 32 + buf.size() || rc != 0) return -6;  // short write
   return 0;
 }
 
@@ -224,12 +232,15 @@ int pt_cipher_decrypt_file(const char* src, const char* dst,
   ptcrypto::DeriveKey(passphrase, key);
   ptcrypto::Aes128 aes(key);
   ptcrypto::CtrTransform(aes, iv, buf.data(), buf.size());
-  if (ptcrypto::KeyedTag(key, buf.data(), buf.size()) != tag) return -5;
+  if (ptcrypto::KeyedTag(key, iv, buf.data(), buf.size()) != tag)
+    return -5;
 
   FILE* fo = std::fopen(dst, "wb");
   if (!fo) return -3;
-  if (!buf.empty()) std::fwrite(buf.data(), 1, buf.size(), fo);
-  std::fclose(fo);
+  size_t wrote = buf.empty() ? 0
+      : std::fwrite(buf.data(), 1, buf.size(), fo);
+  int rc = std::fclose(fo);
+  if (wrote != buf.size() || rc != 0) return -6;
   return 0;
 }
 

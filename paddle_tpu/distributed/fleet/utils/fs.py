@@ -50,12 +50,21 @@ class LocalFS:
     def rename(self, src, dst):
         os.rename(src, dst)
 
-    mv = rename
+    def mv(self, src, dst, overwrite=False):
+        if overwrite:
+            self.delete(dst)
+        os.rename(src, dst)
 
-    def upload(self, local_path, path):
+    def upload(self, local_path, path, multi_processes=1,
+               overwrite=False):
+        if overwrite:
+            self.delete(path)
         shutil.copy(local_path, path)
 
-    def download(self, path, local_path):
+    def download(self, path, local_path, multi_processes=1,
+                 overwrite=False):
+        if overwrite and os.path.exists(local_path):
+            os.remove(local_path)
         shutil.copy(path, local_path)
 
     def touch(self, path, exist_ok=True):
@@ -155,4 +164,6 @@ class HDFSClient:
         self._run("-touchz", path)
 
     def cat(self, path):
-        return self._run("-cat", path).stdout
+        # bytes, matching LocalFS.cat
+        out = self._run("-cat", path).stdout
+        return out.encode() if isinstance(out, str) else out

@@ -212,9 +212,17 @@ class Communicator:
                   if isinstance(g, SelectedRows)}
         dense = {n: g for n, g in named_grads.items() if n not in sparse}
         for name, g in sparse.items():
-            g = g.merge()  # dedup + drop out-of-range fill rows
-            self._client_for(name).push_sparse(
-                name, np.asarray(g.rows), np.asarray(g.values))
+            # merge on the HOST: the rows are leaving for the pserver
+            # anyway, and a device-side merge costs one accelerator
+            # round-trip per eager op (prohibitive over remote links)
+            rows = np.asarray(g.rows).ravel()
+            vals = np.asarray(g.values).reshape(rows.size, -1)
+            keep = rows < g.height  # drop shape-stable fill rows
+            rows, vals = rows[keep], vals[keep]
+            uniq, inv = np.unique(rows, return_inverse=True)
+            merged = np.zeros((uniq.size, vals.shape[1]), vals.dtype)
+            np.add.at(merged, inv, vals)
+            self._client_for(name).push_sparse(name, uniq, merged)
         if not dense:
             return
         if self.mode == "async":
@@ -380,16 +388,11 @@ class SparsePrefetcher:
     """
 
     def __init__(self, comm, table, dim):
-        self.comm = comm
-        self.table = table
-        self.dim = dim
+        self._table = DistributedLookupTable(comm, table, dim)
         self._pending = None
 
     def _pull(self, ids):
-        flat = np.asarray(ids, np.int64).ravel()
-        rows = self.comm._client_for(self.table).pull_sparse(
-            self.table, flat, self.dim)
-        return rows.reshape(np.asarray(ids).shape + (self.dim,))
+        return self._table.lookup(ids)
 
     def prime(self, ids):
         self.prefetch(ids)
@@ -410,5 +413,13 @@ class SparsePrefetcher:
         return out
 
     def close(self):
+        # drain any in-flight pull BEFORE the caller tears the
+        # communicator/native client down under the worker thread
+        if self._pending is not None:
+            try:
+                self._pending.result(timeout=10.0)
+            except Exception:
+                pass
+            self._pending = None
         if hasattr(self, "_pool"):
-            self._pool.shutdown(wait=False)
+            self._pool.shutdown(wait=True)

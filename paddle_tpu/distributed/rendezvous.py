@@ -83,8 +83,16 @@ class _KVHandler(socketserver.StreamRequestHandler):
                 self.wfile.write(
                     json.dumps({"ok": True, "value": cur}).encode() +
                     b"\n")
-        except Exception:
-            pass
+            else:
+                self.wfile.write(json.dumps(
+                    {"ok": False,
+                     "error": f"unknown op {op!r}"}).encode() + b"\n")
+        except Exception as e:  # report, never hang the client parser
+            try:
+                self.wfile.write(json.dumps(
+                    {"ok": False, "error": str(e)}).encode() + b"\n")
+            except Exception:
+                pass
 
 
 class TCPStore:
@@ -135,15 +143,12 @@ class TCPStore:
                           "value": value})["value"]
 
     def barrier(self, name="barrier", timeout=None):
-        # epoch-aware: the n-th barrier with a name waits for
-        # world_size * n arrivals, so reusing a barrier name stays a
-        # real synchronization point
-        if not hasattr(self, "_barrier_epochs"):
-            self._barrier_epochs = {}
-        epoch = self._barrier_epochs.get(name, 0) + 1
-        self._barrier_epochs[name] = epoch
-        self.add(f"__barrier_{name}", 1)
-        target = self.world_size * epoch
+        # cohort-based: my arrival number k (SERVER-side counter, so a
+        # reconnected client cannot skip a round) puts me in cohort
+        # ceil(k / world); I wait until my whole cohort arrived
+        k = self.add(f"__barrier_{name}", 1)
+        target = ((k + self.world_size - 1) //
+                  self.world_size) * self.world_size
         deadline = time.time() + (timeout or self.timeout)
         while time.time() < deadline:
             r = self._rpc({"op": "get", "key": f"__barrier_{name}"})

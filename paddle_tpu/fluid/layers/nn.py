@@ -396,20 +396,13 @@ def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=1.0):
 
 
 def l2_normalize(x, axis=-1, epsilon=1e-12, name=None):
-    """Composed from existing ops — no new lowering needed."""
-    from .math_ops import elementwise_div, reduce_sum, square
-
-    sq = square(x)
-    ssum = reduce_sum(sq, dim=[axis], keep_dim=True)
-    helper = LayerHelper("l2_normalize")
-    norm = helper.create_variable_for_type_inference(x.dtype, ssum.shape)
-    helper.append_op(type="clip", inputs={"X": [ssum]},
-                     outputs={"Out": [norm]},
-                     attrs={"min": epsilon, "max": 3.4e38})
-    rt = helper.create_variable_for_type_inference(x.dtype, norm.shape)
-    helper.append_op(type="sqrt", inputs={"X": [norm]},
-                     outputs={"Out": [rt]}, attrs={})
-    return elementwise_div(x, rt)
+    helper = LayerHelper("l2_normalize", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    n = helper.create_variable_for_type_inference(x.dtype, None)
+    helper.append_op(type="norm", inputs={"X": [x]},
+                     outputs={"Out": [out], "Norm": [n]},
+                     attrs={"axis": axis, "epsilon": float(epsilon)})
+    return out
 
 
 def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",

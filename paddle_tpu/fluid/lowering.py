@@ -1527,3 +1527,380 @@ def _lod_reset(ctx, op):
         lens = _np.diff(_np.asarray(tl))
         ctx.env[out_names[0] + _LOD_SUFFIX] = jnp.asarray(
             lens.astype(_np.int32))
+
+
+# ====== op-surface widening batch 2 (operators/*.cc parity) ======
+
+for _n2, _f2 in {
+    "tan": lambda x: _jnp().tan(x),
+    "asin": lambda x: _jnp().arcsin(x),
+    "acos": lambda x: _jnp().arccos(x),
+    "atan": lambda x: _jnp().arctan(x),
+    "sinh": lambda x: _jnp().sinh(x),
+    "cosh": lambda x: _jnp().cosh(x),
+    "asinh": lambda x: _jnp().arcsinh(x),
+    "acosh": lambda x: _jnp().arccosh(x),
+    "atanh": lambda x: _jnp().arctanh(x),
+    "log2": lambda x: _jnp().log2(x),
+    "log10": lambda x: _jnp().log10(x),
+    "log1p": lambda x: _jnp().log1p(x),
+    "expm1": lambda x: _jnp().expm1(x),
+    "selu": K.selu,
+    "isnan_v2": lambda x: _jnp().isnan(x),
+    "isinf_v2": lambda x: _jnp().isinf(x),
+    "isfinite_v2": lambda x: _jnp().isfinite(x),
+    "fill_zeros_like": lambda x: _jnp().zeros_like(x),
+}.items():
+    _unary(_n2, _f2)
+
+
+@register("prelu")
+def _prelu(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    alpha = ctx.inp(op, "Alpha")
+    mode = op.attrs.get("mode", "all")
+    if mode == "channel" and alpha.ndim == 1 and x.ndim >= 2:
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    ctx.out(op, "Out", jnp.where(x > 0, x, alpha * x))
+
+
+@register("group_norm")
+def _group_norm(ctx, op):
+    ctx.out(op, "Y", K.group_norm(
+        ctx.inp(op, "X"), op.attrs["groups"], ctx.inp(op, "Scale"),
+        ctx.inp(op, "Bias"), op.attrs.get("epsilon", 1e-5)))
+
+
+@register("instance_norm")
+def _instance_norm(ctx, op):
+    ctx.out(op, "Y", K.instance_norm(
+        ctx.inp(op, "X"), ctx.inp(op, "Scale"), ctx.inp(op, "Bias"),
+        op.attrs.get("epsilon", 1e-5)))
+
+
+@register("rms_norm")
+def _rms_norm(ctx, op):
+    ctx.out(op, "Y", K.rms_norm(ctx.inp(op, "X"), ctx.inp(op, "Scale"),
+                                op.attrs.get("epsilon", 1e-6)))
+
+
+@register("norm")
+def _norm_op(ctx, op):
+    """l2_normalize's backing op (norm_op.cc): x / ||x||_2 along axis."""
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    axis = op.attrs.get("axis", -1)
+    eps = op.attrs.get("epsilon", 1e-10)
+    n = jnp.sqrt((x * x).sum(axis=axis, keepdims=True) + eps)
+    ctx.out(op, "Out", x / n)
+    ctx.out(op, "Norm", n)
+
+
+@register("p_norm")
+def _p_norm(ctx, op):
+    ctx.out(op, "Out", K.norm(ctx.inp(op, "X"),
+                              op.attrs.get("porder", 2.0),
+                              op.attrs.get("axis", None),
+                              op.attrs.get("keepdim", False)))
+
+
+@register("frobenius_norm")
+def _fro_norm(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    dims = tuple(op.attrs.get("dim", [-2, -1]))
+    ctx.out(op, "Out", jnp.sqrt((x * x).sum(axis=dims,
+                                keepdims=op.attrs.get("keep_dim", False))))
+
+
+@register("roll")
+def _roll(ctx, op):
+    axis = op.attrs.get("axis", None)
+    ctx.out(op, "Out", K.roll(ctx.inp(op, "X"), op.attrs["shifts"],
+                              axis if axis else None))
+
+
+@register("flip")
+def _flip(ctx, op):
+    ctx.out(op, "Out", K.flip(ctx.inp(op, "X"), op.attrs["axis"]))
+
+
+@register("cumprod")
+def _cumprod(ctx, op):
+    ctx.out(op, "Out", K.cumprod(ctx.inp(op, "X"), op.attrs.get("dim")))
+
+
+@register("diag_v2")
+def _diag_v2(ctx, op):
+    ctx.out(op, "Out", K.diag(ctx.inp(op, "X"),
+                              op.attrs.get("offset", 0),
+                              op.attrs.get("padding_value", 0.0)))
+
+
+@register("meshgrid")
+def _meshgrid(ctx, op):
+    ctx.outs(op, "Out", K.meshgrid(*ctx.inps(op, "X")))
+
+
+@register("argsort")
+def _argsort(ctx, op):
+    ids = K.argsort(ctx.inp(op, "X"), op.attrs.get("axis", -1),
+                    op.attrs.get("descending", False))
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    ctx.out(op, "Indices", ids)
+    ctx.out(op, "Out", jnp.take_along_axis(x, ids,
+                                           op.attrs.get("axis", -1)))
+
+
+@register("tril_triu")
+def _tril_triu(ctx, op):
+    fn = K.tril if op.attrs.get("lower", True) else K.triu
+    ctx.out(op, "Out", fn(ctx.inp(op, "X"),
+                          op.attrs.get("diagonal", 0)))
+
+
+@register("multiplex")
+def _multiplex(ctx, op):
+    ids = ctx.inp(op, "Ids")
+    if ids.ndim == 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    ctx.out(op, "Out", K.multiplex(ctx.inps(op, "X"), ids))
+
+
+@register("strided_slice")
+def _strided_slice(ctx, op):
+    ctx.out(op, "Out", K.strided_slice(
+        ctx.inp(op, "Input"), op.attrs["axes"], op.attrs["starts"],
+        op.attrs["ends"], op.attrs["strides"]))
+
+
+@register("expand_as_v2")
+def _expand_as(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    shape = op.attrs.get("target_shape")
+    if shape is None:
+        shape = ctx.inp(op, "Y").shape
+    ctx.out(op, "Out", jnp.broadcast_to(x, tuple(shape)))
+
+
+@register("index_select")
+def _index_select(ctx, op):
+    ctx.out(op, "Out", K.index_select(
+        ctx.inp(op, "X"), ctx.inp(op, "Index"),
+        op.attrs.get("dim", 0)))
+
+
+@register("index_sample")
+def _index_sample(ctx, op):
+    ctx.out(op, "Out", K.index_sample(ctx.inp(op, "X"),
+                                      ctx.inp(op, "Index")))
+
+
+@register("where")
+def _where(ctx, op):
+    ctx.out(op, "Out", K.where(ctx.inp(op, "Condition"),
+                               ctx.inp(op, "X"), ctx.inp(op, "Y")))
+
+
+@register("reduce_all")
+def _reduce_all(ctx, op):
+    x = ctx.inp(op, "X")
+    dims = None if op.attrs.get("reduce_all", False) else \
+        tuple(op.attrs.get("dim", [0]))
+    ctx.out(op, "Out", x.all(axis=dims,
+                             keepdims=op.attrs.get("keep_dim", False)))
+
+
+@register("reduce_any")
+def _reduce_any(ctx, op):
+    x = ctx.inp(op, "X")
+    dims = None if op.attrs.get("reduce_all", False) else \
+        tuple(op.attrs.get("dim", [0]))
+    ctx.out(op, "Out", x.any(axis=dims,
+                             keepdims=op.attrs.get("keep_dim", False)))
+
+
+@register("logsumexp")
+def _logsumexp(ctx, op):
+    ctx.out(op, "Out", K.logsumexp(
+        ctx.inp(op, "X"),
+        None if op.attrs.get("reduce_all", False)
+        else tuple(op.attrs.get("axis", [0])),
+        op.attrs.get("keepdim", False)))
+
+
+@register("size")
+def _size(ctx, op):
+    jnp = _jnp()
+    ctx.out(op, "Out", jnp.asarray(ctx.inp(op, "Input").size, jnp.int64))
+
+
+@register("fill_any_like")
+def _fill_any_like(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    dt = op.attrs.get("dtype", -1)
+    out_dt = convert_dtype(dt) if isinstance(dt, str) or dt not in (-1,) \
+        else x.dtype
+    ctx.out(op, "Out", jnp.full_like(x, op.attrs.get("value", 0.0),
+                                     dtype=out_dt))
+
+
+@register("range")
+def _range(ctx, op):
+    jnp = _jnp()
+    start = ctx.inp(op, "Start").reshape(())
+    end = ctx.inp(op, "End").reshape(())
+    step = ctx.inp(op, "Step").reshape(())
+    # static shapes: bounds must be concrete (build-time attrs preferred)
+    import numpy as _np
+
+    ctx.out(op, "Out", jnp.arange(float(_np.asarray(start)),
+                                  float(_np.asarray(end)),
+                                  float(_np.asarray(step))))
+
+
+@register("linspace")
+def _linspace(ctx, op):
+    jnp = _jnp()
+    import numpy as _np
+
+    s = float(_np.asarray(ctx.inp(op, "Start")).reshape(()))
+    e = float(_np.asarray(ctx.inp(op, "Stop")).reshape(()))
+    n = int(_np.asarray(ctx.inp(op, "Num")).reshape(()))
+    ctx.out(op, "Out", jnp.linspace(s, e, n))
+
+
+@register("eye")
+def _eye(ctx, op):
+    jnp = _jnp()
+    ctx.out(op, "Out", jnp.eye(
+        int(op.attrs["num_rows"]),
+        int(op.attrs.get("num_columns") or op.attrs["num_rows"]),
+        dtype=convert_dtype(op.attrs.get("dtype", "float32"))))
+
+
+@register("cos_sim")
+def _cos_sim(ctx, op):
+    """cos_sim_op.h (the word2vec book net's similarity head)."""
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    y = ctx.inp(op, "Y")
+    xn = jnp.sqrt((x * x).sum(axis=-1, keepdims=True))
+    yn = jnp.sqrt((y * y).sum(axis=-1, keepdims=True))
+    ctx.out(op, "Out", (x * y).sum(axis=-1, keepdims=True) /
+            jnp.maximum(xn * yn, 1e-12))
+    ctx.out(op, "XNorm", xn)
+    ctx.out(op, "YNorm", yn)
+
+
+@register("huber_loss")
+def _huber(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    y = ctx.inp(op, "Y")
+    delta = op.attrs.get("delta", 1.0)
+    d = y - x
+    ad = jnp.abs(d)
+    loss = jnp.where(ad <= delta, 0.5 * d * d,
+                     delta * (ad - 0.5 * delta))
+    ctx.out(op, "Out", loss)
+    ctx.out(op, "Residual", d)
+
+
+@register("log_loss")
+def _log_loss(ctx, op):
+    jnp = _jnp()
+    p = ctx.inp(op, "Predicted")
+    y = ctx.inp(op, "Labels")
+    eps = op.attrs.get("epsilon", 1e-4)
+    ctx.out(op, "Loss", -y * jnp.log(p + eps) -
+            (1 - y) * jnp.log(1 - p + eps))
+
+
+@register("affine_channel")
+def _affine_channel(ctx, op):
+    x = ctx.inp(op, "X")
+    scale = ctx.inp(op, "Scale")
+    bias = ctx.inp(op, "Bias")
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    ctx.out(op, "Out", x * scale.reshape(shape) + bias.reshape(shape))
+
+
+@register("pixel_shuffle")
+def _pixel_shuffle(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    r = op.attrs.get("upscale_factor", 1)
+    b, c, h, w = x.shape
+    x = x.reshape(b, c // (r * r), r, r, h, w)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    ctx.out(op, "Out", x.reshape(b, c // (r * r), h * r, w * r))
+
+
+@register("nearest_interp")
+@register("nearest_interp_v2")
+def _nearest_interp(ctx, op):
+    x = ctx.inp(op, "X")
+    oh, ow = _interp_out_hw(ctx, op, x)
+    ctx.out(op, "Out", K.interpolate_nearest(x, (oh, ow)))
+
+
+@register("bilinear_interp")
+@register("bilinear_interp_v2")
+def _bilinear_interp(ctx, op):
+    x = ctx.inp(op, "X")
+    oh, ow = _interp_out_hw(ctx, op, x)
+    ctx.out(op, "Out", K.interpolate_bilinear(
+        x, (oh, ow), op.attrs.get("align_corners", False)))
+
+
+def _interp_out_hw(ctx, op, x):
+    oh = op.attrs.get("out_h", -1)
+    ow = op.attrs.get("out_w", -1)
+    scale = op.attrs.get("scale", 0.0)
+    if (oh is None or oh <= 0) and scale:
+        if isinstance(scale, (list, tuple)):
+            sh, sw = (scale[0], scale[1]) if len(scale) > 1 else \
+                (scale[0], scale[0])
+        else:
+            sh = sw = scale
+        oh = int(x.shape[2] * sh)
+        ow = int(x.shape[3] * sw)
+    return oh, ow
+
+
+@register("grid_sampler")
+def _grid_sampler(ctx, op):
+    """grid_sampler_op: bilinear sampling at normalized grid coords
+    [-1, 1] (align_corners=True semantics)."""
+    import jax
+
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    grid = ctx.inp(op, "Grid")  # [B, H', W', 2] (gx, gy)
+    B, C, H, W = x.shape
+    gx = (grid[..., 0] + 1.0) * 0.5 * (W - 1)
+    gy = (grid[..., 1] + 1.0) * 0.5 * (H - 1)
+    x0 = jnp.clip(jnp.floor(gx), 0, W - 1)
+    y0 = jnp.clip(jnp.floor(gy), 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    lx = jnp.clip(gx - x0, 0.0, 1.0)[:, None]
+    ly = jnp.clip(gy - y0, 0.0, 1.0)[:, None]
+
+    def gather2(img, yy, xx):
+        return jax.vmap(lambda im, y_, x_: im[:, y_.astype(jnp.int32),
+                                              x_.astype(jnp.int32)])(
+            img, yy, xx)
+
+    v00 = gather2(x, y0, x0)
+    v01 = gather2(x, y0, x1)
+    v10 = gather2(x, y1, x0)
+    v11 = gather2(x, y1, x1)
+    out = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+           v10 * ly * (1 - lx) + v11 * ly * lx)
+    ctx.out(op, "Output", out)

@@ -492,8 +492,13 @@ def _flash_usable():
 def sdpa(q, k, v, mask=None, is_causal=False, scale=None):
     """Dispatch: pallas flash fwd+bwd on TPU whenever the mask reduces to
     a key-position bias (incl. every padded batch); XLA reference
-    otherwise."""
+    otherwise. Short sequences (< 512) stay on the XLA path — its fused
+    attention beats the blockwise kernel there and the S x S buffer is
+    tiny; flash pays off where it matters, long context (measured:
+    ERNIE seq 128 is ~2% faster on the reference path)."""
+    min_flash_len = int(os.environ.get("PT_FLASH_MIN_SEQ", "512"))
     if _on_tpu() and q.ndim == 4 and q.shape[-1] <= 256 \
+            and q.shape[2] >= min_flash_len \
             and q.shape[2] % min(256, q.shape[2]) == 0 \
             and k.shape[2] % min(256, k.shape[2]) == 0:
         bias = _kv_bias(mask, q.shape[0], q.shape[1], k.shape[2])

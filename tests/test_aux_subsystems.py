@@ -107,7 +107,7 @@ def test_hdfs_client_shell_pipe(tmp_path):
     dirs, files = client.ls_dir("/data")
     assert dirs == ["sub"] and files == ["f.txt"]
     assert client.is_exist("/data/f.txt")
-    assert client.cat("/data/f.txt").strip() == "content"
+    assert client.cat("/data/f.txt").strip() == b"content"
     client.mkdirs("/data/new")
     client.upload(str(shim), "/data/up")
     calls = log.read_text()
@@ -299,3 +299,80 @@ def test_dataset_runner_prefetch_thread(tmp_path):
     with pytest.raises(RuntimeError, match="reader exploded"):
         run_from_dataset(exe, main, FakeDataset(6, fail_at=3),
                          fetch_list=[s], print_period=0)
+
+
+# ---------------- async/sharded checkpoint (orbax) ----------------
+
+def test_async_checkpointer_roundtrip(tmp_path):
+    from paddle_tpu import nn
+    from paddle_tpu.io.checkpoint import AsyncCheckpointer
+
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    ck = AsyncCheckpointer(str(tmp_path / "ckpts"), max_to_keep=2)
+    for step in (1, 2, 3):
+        state = {"model": net.state_dict(), "step": step}
+        ck.save(step, state)
+    ck.wait()
+    assert ck.all_steps() == [2, 3]  # max_to_keep pruned step 1
+    restored = ck.restore()
+    assert restored["step"] == 3
+    for k, v in net.state_dict().items():
+        np.testing.assert_allclose(restored["model"][k],
+                                   np.asarray(v._data), rtol=1e-6)
+    # load into a fresh model
+    net2 = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    net2.set_state_dict({k: paddle.to_tensor(np.asarray(v))
+                         for k, v in restored["model"].items()})
+    x = np.random.RandomState(0).randn(2, 4).astype("float32")
+    with paddle.no_grad():
+        np.testing.assert_allclose(
+            np.asarray(net(paddle.to_tensor(x)).numpy()),
+            np.asarray(net2(paddle.to_tensor(x)).numpy()), rtol=1e-6)
+    ck.close()
+
+
+def test_sharded_checkpoint_preserves_sharding(tmp_path):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.io.checkpoint import load_sharded, save_sharded
+
+    devs = np.array(jax.devices("cpu")[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("a", "b"))
+    arr = jax.device_put(np.arange(16, dtype="float32").reshape(4, 4),
+                         NamedSharding(mesh, P("a", "b")))
+    save_sharded({"w": arr}, str(tmp_path / "sharded"))
+    back = load_sharded(str(tmp_path / "sharded"))
+    np.testing.assert_allclose(np.asarray(back["w"]),
+                               np.arange(16).reshape(4, 4))
+
+
+# ---------------- stat registry ----------------
+
+def test_stat_registry():
+    from paddle_tpu.utils.monitor import (StatRegistry, Timer, get_stats,
+                                          stat_set, stat_update)
+
+    StatRegistry.instance().reset()
+    stat_update("reader.bytes", 100)
+    stat_update("reader.bytes", 50)
+    stat_set("mem.peak", 4096)
+    with Timer("step"):
+        pass
+    s = get_stats()
+    assert s["reader.bytes"] == 150
+    assert s["mem.peak"] == 4096
+    assert s["step.count"] == 1 and s["step.total_us"] >= 0
+
+    # thread safety: concurrent increments all land
+    def w():
+        for _ in range(1000):
+            stat_update("concurrent")
+
+    ts = [threading.Thread(target=w) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert get_stats()["concurrent"] == 4000

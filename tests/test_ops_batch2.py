@@ -1,0 +1,184 @@
+"""Op-surface widening batch 2: spot numerics through the Executor.
+
+Covers the newly lowered ops (trig/log family, prelu, norms, roll/flip,
+argsort, tril_triu, where, reduce_all/any, cos_sim, huber/log_loss,
+affine_channel, pixel_shuffle, interps, grid_sampler, eye/linspace).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _run_one(op_type, inputs, outputs, attrs, feeds=None, n_out=1):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        blk = main.global_block()
+        in_map = {}
+        for slot, arrs in inputs.items():
+            vs = []
+            for i, a in enumerate(arrs):
+                v = blk.create_var(name=f"i_{slot}_{i}",
+                                   shape=list(np.shape(a)),
+                                   dtype=str(np.asarray(a).dtype),
+                                   is_data=True)
+                vs.append(v)
+            in_map[slot] = vs
+        out_map = {}
+        for slot, n in outputs.items():
+            out_map[slot] = [blk.create_var(name=f"o_{slot}_{i}")
+                             for i in range(n)]
+        blk.append_op(type=op_type, inputs=in_map,
+                      outputs={k: [v.name for v in vs]
+                               for k, vs in out_map.items()},
+                      attrs=attrs)
+    exe = fluid.Executor()
+    exe.run(startup)
+    feed = {}
+    for slot, arrs in inputs.items():
+        for i, a in enumerate(arrs):
+            feed[f"i_{slot}_{i}"] = np.asarray(a)
+    fetch = [v for vs in out_map.values() for v in vs]
+    return exe.run(main, feed, fetch)
+
+
+R = np.random.RandomState(0)
+X = R.uniform(0.2, 0.9, (3, 4)).astype("float32")
+
+
+@pytest.mark.parametrize("op,ref", [
+    ("tan", np.tan), ("asin", np.arcsin), ("acos", np.arccos),
+    ("atan", np.arctan), ("sinh", np.sinh), ("cosh", np.cosh),
+    ("log1p", np.log1p), ("expm1", np.expm1), ("log2", np.log2),
+    ("log10", np.log10),
+])
+def test_unary_batch2(op, ref):
+    (out,) = _run_one(op, {"X": [X]}, {"Out": 1}, {})
+    np.testing.assert_allclose(out, ref(X), rtol=1e-5, atol=1e-6)
+
+
+def test_prelu_channel():
+    x = R.randn(2, 3, 4).astype("float32")
+    alpha = np.array([0.1, 0.2, 0.3], "float32")
+    (out,) = _run_one("prelu", {"X": [x], "Alpha": [alpha]},
+                      {"Out": 1}, {"mode": "channel"})
+    want = np.where(x > 0, x, alpha[None, :, None] * x)
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_norm_and_p_norm():
+    x = R.randn(3, 5).astype("float32")
+    out, n = _run_one("norm", {"X": [x]}, {"Out": 1, "Norm": 1},
+                      {"axis": 1})
+    np.testing.assert_allclose(
+        out, x / np.linalg.norm(x, axis=1, keepdims=True), rtol=1e-4)
+    (p,) = _run_one("p_norm", {"X": [x]}, {"Out": 1},
+                    {"porder": 2.0, "axis": 1})
+    np.testing.assert_allclose(p, np.linalg.norm(x, axis=1), rtol=1e-5)
+
+
+def test_roll_flip_trilu():
+    x = R.randn(3, 4).astype("float32")
+    (out,) = _run_one("roll", {"X": [x]}, {"Out": 1},
+                      {"shifts": [1], "axis": [1]})
+    np.testing.assert_allclose(out, np.roll(x, 1, 1))
+    (out,) = _run_one("flip", {"X": [x]}, {"Out": 1}, {"axis": [0]})
+    np.testing.assert_allclose(out, x[::-1])
+    (out,) = _run_one("tril_triu", {"X": [x]}, {"Out": 1},
+                      {"lower": True, "diagonal": 0})
+    np.testing.assert_allclose(out, np.tril(x))
+
+
+def test_argsort_and_where():
+    x = R.randn(3, 4).astype("float32")
+    srt, idx = _run_one("argsort", {"X": [x]},
+                        {"Out": 1, "Indices": 1}, {"axis": -1})
+    np.testing.assert_allclose(srt, np.sort(x, -1), rtol=1e-6)
+    cond = x > 0
+    y = np.zeros_like(x)
+    (out,) = _run_one("where", {"Condition": [cond], "X": [x], "Y": [y]},
+                      {"Out": 1}, {})
+    np.testing.assert_allclose(out, np.where(cond, x, y))
+
+
+def test_reduce_all_any_logsumexp():
+    b = R.rand(3, 4) > 0.4
+    (out,) = _run_one("reduce_all", {"X": [b]}, {"Out": 1}, {"dim": [1]})
+    np.testing.assert_array_equal(out, b.all(1))
+    (out,) = _run_one("reduce_any", {"X": [b]}, {"Out": 1}, {"dim": [1]})
+    np.testing.assert_array_equal(out, b.any(1))
+    x = R.randn(3, 4).astype("float32")
+    (out,) = _run_one("logsumexp", {"X": [x]}, {"Out": 1}, {"axis": [1]})
+    np.testing.assert_allclose(
+        out, np.log(np.exp(x).sum(1)), rtol=1e-5)
+
+
+def test_cos_sim_huber_logloss():
+    x = R.randn(4, 8).astype("float32")
+    y = R.randn(4, 8).astype("float32")
+    out, xn, yn = _run_one("cos_sim", {"X": [x], "Y": [y]},
+                           {"Out": 1, "XNorm": 1, "YNorm": 1}, {})
+    want = (x * y).sum(1) / (np.linalg.norm(x, axis=1) *
+                             np.linalg.norm(y, axis=1))
+    np.testing.assert_allclose(out[:, 0], want, rtol=1e-4)
+
+    lo, res = _run_one("huber_loss", {"X": [x], "Y": [y]},
+                       {"Out": 1, "Residual": 1}, {"delta": 1.0})
+    d = y - x
+    want = np.where(np.abs(d) <= 1, 0.5 * d * d, np.abs(d) - 0.5)
+    np.testing.assert_allclose(lo, want, rtol=1e-5)
+
+    p = R.uniform(0.1, 0.9, (4, 1)).astype("float32")
+    lbl = (R.rand(4, 1) > 0.5).astype("float32")
+    (ll,) = _run_one("log_loss", {"Predicted": [p], "Labels": [lbl]},
+                     {"Loss": 1}, {"epsilon": 1e-4})
+    want = -lbl * np.log(p + 1e-4) - (1 - lbl) * np.log(1 - p + 1e-4)
+    np.testing.assert_allclose(ll, want, rtol=1e-5)
+
+
+def test_affine_channel_pixel_shuffle():
+    x = R.randn(2, 4, 3, 3).astype("float32")
+    s = R.randn(4).astype("float32")
+    b = R.randn(4).astype("float32")
+    (out,) = _run_one("affine_channel",
+                      {"X": [x], "Scale": [s], "Bias": [b]},
+                      {"Out": 1}, {})
+    np.testing.assert_allclose(
+        out, x * s[None, :, None, None] + b[None, :, None, None],
+        rtol=1e-6)
+    (ps,) = _run_one("pixel_shuffle", {"X": [x]}, {"Out": 1},
+                     {"upscale_factor": 2})
+    assert ps.shape == (2, 1, 6, 6)
+    # spot: output pixel (0,0) block comes from the 4 channels at (0,0)
+    np.testing.assert_allclose(
+        ps[0, 0, :2, :2].ravel(),
+        [x[0, 0, 0, 0], x[0, 1, 0, 0], x[0, 2, 0, 0], x[0, 3, 0, 0]])
+
+
+def test_interps_and_grid_sampler():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    (nn_,) = _run_one("nearest_interp_v2", {"X": [x]}, {"Out": 1},
+                      {"out_h": 2, "out_w": 2})
+    assert nn_.shape == (1, 1, 2, 2)
+    (bl,) = _run_one("bilinear_interp_v2", {"X": [x]}, {"Out": 1},
+                     {"out_h": 8, "out_w": 8})
+    assert bl.shape == (1, 1, 8, 8)
+    # identity grid reproduces the input (align_corners semantics)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                         indexing="ij")
+    grid = np.stack([xs, ys], -1)[None].astype("float32")
+    (gs,) = _run_one("grid_sampler", {"X": [x], "Grid": [grid]},
+                     {"Output": 1}, {})
+    np.testing.assert_allclose(gs, x, atol=1e-4)
+
+
+def test_eye_linspace_size_fill():
+    (e,) = _run_one("eye", {}, {"Out": 1},
+                    {"num_rows": 3, "num_columns": 4, "dtype": "float32"})
+    np.testing.assert_allclose(e, np.eye(3, 4))
+    x = R.randn(2, 5).astype("float32")
+    (sz,) = _run_one("size", {"Input": [x]}, {"Out": 1}, {})
+    assert int(sz) == 10
+    (f,) = _run_one("fill_any_like", {"X": [x]}, {"Out": 1},
+                    {"value": 7.0, "dtype": -1})
+    np.testing.assert_allclose(f, np.full_like(x, 7.0))
