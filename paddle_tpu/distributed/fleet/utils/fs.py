@@ -53,6 +53,9 @@ class LocalFS:
     def mv(self, src, dst, overwrite=False):
         if overwrite:
             self.delete(dst)
+        elif os.path.exists(dst):
+            raise ExecuteError(
+                f"mv: {dst!r} exists (pass overwrite=True to replace)")
         os.rename(src, dst)
 
     def upload(self, local_path, path, multi_processes=1,
@@ -100,7 +103,8 @@ class HDFSClient:
     def _run(self, *args, check=True):
         cmd = self._base + list(args)
         try:
-            p = subprocess.run(cmd, capture_output=True, text=True,
+            # binary pipes: cat must pass bytes through untouched
+            p = subprocess.run(cmd, capture_output=True,
                                timeout=self._timeout)
         except FileNotFoundError as e:
             raise ExecuteError(
@@ -109,15 +113,15 @@ class HDFSClient:
         except subprocess.TimeoutExpired as e:
             raise ExecuteError(f"{' '.join(cmd)} timed out") from e
         if check and p.returncode != 0:
+            err = p.stderr.decode("utf-8", "replace").strip()[:500]
             raise ExecuteError(
-                f"{' '.join(cmd)} failed rc={p.returncode}: "
-                f"{p.stderr.strip()[:500]}")
+                f"{' '.join(cmd)} failed rc={p.returncode}: {err}")
         return p
 
     def ls_dir(self, path):
         p = self._run("-ls", path, check=False)
         dirs, files = [], []
-        for line in p.stdout.splitlines():
+        for line in p.stdout.decode("utf-8", "replace").splitlines():
             parts = line.split()
             if len(parts) < 8:
                 continue
@@ -164,6 +168,4 @@ class HDFSClient:
         self._run("-touchz", path)
 
     def cat(self, path):
-        # bytes, matching LocalFS.cat
-        out = self._run("-cat", path).stdout
-        return out.encode() if isinstance(out, str) else out
+        return self._run("-cat", path).stdout  # bytes, like LocalFS.cat

@@ -422,4 +422,6 @@ class SparsePrefetcher:
                 pass
             self._pending = None
         if hasattr(self, "_pool"):
-            self._pool.shutdown(wait=True)
+            # best effort: a pull stuck on a dead pserver must not hang
+            # the caller's teardown forever
+            self._pool.shutdown(wait=False)

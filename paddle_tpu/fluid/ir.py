@@ -1,0 +1,227 @@
+"""Graph IR + pass framework (framework/ir/ parity).
+
+Reference: ir/graph.h, ir/pass.h + ~100 passes (fc_fuse_pass.cc,
+conv_bn_fuse_pass.cc, memory_optimize_pass, quantization passes).
+TPU-native design: XLA already performs op fusion, buffer reuse and
+scheduling INSIDE a lowered computation, so the pass framework here
+targets what XLA cannot see — PROGRAM-level rewrites: folding
+conv+batch_norm weights before lowering, collapsing mul+add into fc,
+deleting inference-mode dropout, and the quantization rewrite
+(slim/quant.py registers through the same registry).
+
+API:
+    graph = IrGraph(program)
+    apply_pass(program, "conv_bn_fuse_pass", scope=scope)
+    apply_pass(program, ["delete_dropout_pass", "fc_fuse_pass"])
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_PASS_REGISTRY = {}
+
+
+def register_pass(name):
+    def deco(fn):
+        _PASS_REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def pass_names():
+    return sorted(_PASS_REGISTRY)
+
+
+def apply_pass(program, names, scope=None):
+    """Run passes IN PLACE over the program (BuildStrategy::Apply /
+    PassBuilder order semantics). Returns the program."""
+    if isinstance(names, str):
+        names = [names]
+    for n in names:
+        p = _PASS_REGISTRY.get(n)
+        if p is None:
+            raise KeyError(
+                f"unknown pass {n!r}; registered: {pass_names()}")
+        p(program, scope)
+    return program
+
+
+class IrGraph:
+    """ir::Graph-lite: op/var node views + pattern helpers over a
+    Program's global block (the quantization passes' substrate)."""
+
+    def __init__(self, program, for_test=False):
+        self.program = program
+        self.for_test = for_test
+
+    @property
+    def ops(self):
+        return list(self.program.global_block().ops)
+
+    def all_op_nodes(self):
+        return self.ops
+
+    def var_consumers(self, name):
+        return [op for op in self.ops if name in op.input_arg_names]
+
+    def var_producer(self, name):
+        for op in self.ops:
+            if name in op.output_arg_names:
+                return op
+        return None
+
+    def find_chains(self, type_a, type_b):
+        """(a, b) pairs where b consumes a's first output and is its ONLY
+        consumer (GraphPatternDetector two-op chain)."""
+        out = []
+        for a in self.ops:
+            a_outs = a.output_arg_names
+            if a.type != type_a or not a_outs:
+                continue
+            consumers = self.var_consumers(a_outs[0])
+            if len(consumers) == 1 and consumers[0].type == type_b:
+                out.append((a, consumers[0]))
+        return out
+
+    def remove_ops(self, dead):
+        blk = self.program.global_block()
+        dead_ids = {id(o) for o in dead}
+        blk.ops = [o for o in blk.ops if id(o) not in dead_ids]
+        self.program._bump()
+
+
+def _rewire(program, old_name, new_name):
+    """Point every consumer of old_name at new_name."""
+    for blk in program.blocks:
+        for op in blk.ops:
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [new_name if n == old_name else n
+                                   for n in names]
+
+
+@register_pass("delete_dropout_pass")
+def delete_dropout_pass(program, scope=None):
+    """Inference cleanup (delete_dropout_op_pass): upscale_in_train
+    dropout is identity at inference and is removed outright; the v1
+    default downgrade_in_infer SCALES by (1-p) at inference, so it
+    rewrites to a scale op instead."""
+    g = IrGraph(program)
+    dead = []
+    for op in g.ops:
+        if op.type != "dropout":
+            continue
+        impl = op.attrs.get("dropout_implementation",
+                            "downgrade_in_infer")
+        if impl == "upscale_in_train":
+            _rewire(program, op.output("Out")[0], op.input("X")[0])
+            dead.append(op)
+        else:
+            op.type = "scale"
+            op.attrs = {"scale": 1.0 - op.attrs.get("dropout_prob", 0.5),
+                        "bias": 0.0,
+                        "op_callstack": op.attrs.get("op_callstack")}
+    g.remove_ops(dead)
+    program._bump()
+    return program
+
+
+@register_pass("fc_fuse_pass")
+def fc_fuse_pass(program, scope=None):
+    """mul + elementwise_add(bias) -> one fc op (fc_fuse_pass.cc).
+    XLA would fuse the arithmetic anyway; the win is a smaller program
+    (fewer ops to trace) and native-executor parity."""
+    g = IrGraph(program)
+    blk = program.global_block()
+    dead = []
+    for mul_op, add_op in g.find_chains("mul", "elementwise_add"):
+        mul_out = mul_op.output("Out")[0]
+        # preconditions: the mul result must be the add's X (Y is the
+        # bias), the bias must be a 1-D var, and the broadcast axis must
+        # be the trailing-alignment the fc lowering implements
+        if add_op.input("X") != [mul_out]:
+            continue
+        bias = add_op.input("Y")
+        if not bias or bias[0] == mul_out:
+            continue
+        if add_op.attrs.get("axis", -1) not in (-1, 1):
+            continue
+        if blk.has_var(bias[0]):
+            bshape = blk.var(bias[0]).shape or []
+            if len(bshape) > 1:
+                continue
+        mul_op.type = "fc"
+        mul_op.inputs["Bias"] = [bias[0]]
+        mul_op.attrs["in_num_col_dims"] = mul_op.attrs.get(
+            "x_num_col_dims", 1)
+        mul_op.outputs["Out"] = [add_op.output("Out")[0]]
+        dead.append(add_op)
+    g.remove_ops(dead)
+    return program
+
+
+@register_pass("conv_bn_fuse_pass")
+def conv_bn_fuse_pass(program, scope=None):
+    """conv2d + batch_norm(is_test) -> conv2d with FOLDED weights
+    (conv_bn_fuse_pass.cc): w' = w * gamma/std, b' = beta - mean*gamma/
+    std. Mutates the scope weights, so it needs one."""
+    if scope is None:
+        raise ValueError("conv_bn_fuse_pass needs the scope holding the "
+                         "conv/bn weights")
+    g = IrGraph(program)
+    # plan first, mutate second: a half-applied fold after a mid-pass
+    # failure would corrupt both the program and the scope weights
+    plan = []
+    for conv, bn in g.find_chains("conv2d", "batch_norm"):
+        if not bn.attrs.get("is_test", False):
+            continue  # training-mode bn cannot fold
+        w_name = conv.input("Filter")[0]
+        vals = [scope.get_value(w_name)] + [
+            scope.get_value(bn.input(s_)[0])
+            for s_ in ("Scale", "Bias", "Mean", "Variance")]
+        if any(v is None for v in vals):
+            continue  # pruned stats: leave this chain unfused
+        plan.append((conv, bn, w_name, vals))
+    dead = []
+    for conv, bn, w_name, vals in plan:
+        w, gamma, beta, mean, var = (
+            np.asarray(v, np.float32) for v in vals)
+        eps = bn.attrs.get("epsilon", 1e-5)
+        std = np.sqrt(var + eps)
+        scale = gamma / std
+        scope.set_value(w_name, w * scale[:, None, None, None])
+        bias_name = w_name + "@bn_folded_bias"
+        scope.set_value(bias_name, beta - mean * scale)
+        blk = program.global_block()
+        blk.create_var(name=bias_name, shape=[int(w.shape[0])],
+                       dtype=np.float32, persistable=True)
+        # conv output feeds an elementwise_add against the folded bias,
+        # writing bn's old output so consumers are untouched
+        conv_out = conv.output("Output")[0]
+        tmp = conv_out + "@prefold"
+        blk.create_var(name=tmp)
+        conv.outputs["Output"] = [tmp]
+        idx = blk.ops.index(bn)
+        blk._insert_op(idx, "elementwise_add",
+                       inputs={"X": [tmp], "Y": [bias_name]},
+                       outputs={"Out": [bn.output("Y")[0]]},
+                       attrs={"axis": 1})
+        dead.append(bn)
+    g.remove_ops(dead)
+    return program
+
+
+@register_pass("memory_optimize_pass")
+def memory_optimize_pass(program, scope=None):
+    """No-op by design: XLA owns buffer liveness/reuse inside the lowered
+    computation (SURVEY §7 hard part 6 — the reference's memory passes
+    are subsumed). Registered for PassBuilder API parity."""
+    return program
+
+
+@register_pass("quantization_rewrite_pass")
+def quantization_rewrite_pass(program, scope=None):
+    """Alias of the slim PTQ program rewrite for pass-pipeline users;
+    calibration requires PostTrainingQuantization directly."""
+    raise RuntimeError(
+        "quantization needs calibration data: use "
+        "paddle_tpu.slim.PostTrainingQuantization / quant_post_static")

@@ -1875,32 +1875,69 @@ def _interp_out_hw(ctx, op, x):
 
 @register("grid_sampler")
 def _grid_sampler(ctx, op):
-    """grid_sampler_op: bilinear sampling at normalized grid coords
-    [-1, 1] (align_corners=True semantics)."""
+    """grid_sampler_op: sampling at normalized grid coords [-1, 1] with
+    the op's align_corners / mode / padding_mode attrs honored
+    (bilinear|nearest, zeros|border padding)."""
     import jax
 
     jnp = _jnp()
     x = ctx.inp(op, "X")
     grid = ctx.inp(op, "Grid")  # [B, H', W', 2] (gx, gy)
     B, C, H, W = x.shape
-    gx = (grid[..., 0] + 1.0) * 0.5 * (W - 1)
-    gy = (grid[..., 1] + 1.0) * 0.5 * (H - 1)
-    x0 = jnp.clip(jnp.floor(gx), 0, W - 1)
-    y0 = jnp.clip(jnp.floor(gy), 0, H - 1)
-    x1 = jnp.clip(x0 + 1, 0, W - 1)
-    y1 = jnp.clip(y0 + 1, 0, H - 1)
-    lx = jnp.clip(gx - x0, 0.0, 1.0)[:, None]
-    ly = jnp.clip(gy - y0, 0.0, 1.0)[:, None]
+    align = op.attrs.get("align_corners", True)
+    mode = op.attrs.get("mode", "bilinear")
+    padding = op.attrs.get("padding_mode", "zeros")
+    if mode not in ("bilinear", "nearest") or \
+            padding not in ("zeros", "border"):
+        raise NotImplementedError(
+            f"grid_sampler mode={mode!r} padding_mode={padding!r} "
+            f"unsupported (bilinear/nearest x zeros/border)")
+
+    def denorm(g, size):
+        if align:
+            return (g + 1.0) * 0.5 * (size - 1)
+        return ((g + 1.0) * size - 1.0) * 0.5
+
+    gx = denorm(grid[..., 0], W)
+    gy = denorm(grid[..., 1], H)
+    in_x = (gx >= 0) & (gx <= W - 1)
+    in_y = (gy >= 0) & (gy <= H - 1)
 
     def gather2(img, yy, xx):
-        return jax.vmap(lambda im, y_, x_: im[:, y_.astype(jnp.int32),
-                                              x_.astype(jnp.int32)])(
-            img, yy, xx)
+        yy = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xx = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        return jax.vmap(lambda im, y_, x_: im[:, y_, x_])(img, yy, xx)
 
-    v00 = gather2(x, y0, x0)
-    v01 = gather2(x, y0, x1)
-    v10 = gather2(x, y1, x0)
-    v11 = gather2(x, y1, x1)
-    out = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
-           v10 * ly * (1 - lx) + v11 * ly * lx)
+    if mode == "nearest":
+        out = gather2(x, jnp.round(gy), jnp.round(gx))
+        mask = (in_x & in_y)[:, None]
+    else:
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        lx = jnp.clip(gx - x0, 0.0, 1.0)[:, None]
+        ly = jnp.clip(gy - y0, 0.0, 1.0)[:, None]
+        v00 = gather2(x, y0, x0)
+        v01 = gather2(x, y0, x0 + 1)
+        v10 = gather2(x, y0 + 1, x0)
+        v11 = gather2(x, y0 + 1, x0 + 1)
+        out = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+               v10 * ly * (1 - lx) + v11 * ly * lx)
+        mask = (in_x & in_y)[:, None]
+    if padding == "zeros":
+        out = out * mask.astype(out.dtype)
     ctx.out(op, "Output", out)
+
+
+@register("fc")
+def _fc_fused(ctx, op):
+    """fc_fuse_pass output: mul + bias in one op (fc_op.cc parity)."""
+    x = ctx.inp(op, "Input") if op.input("Input") else ctx.inp(op, "X")
+    w = ctx.inp(op, "W") if op.input("W") else ctx.inp(op, "Y")
+    ncol = op.attrs.get("in_num_col_dims", 1)
+    if op.input("X") and op.input("X")[0] + _LOD_SUFFIX in ctx.env:
+        ncol += 1
+    out = K.mul_op(x, w, ncol, 1)
+    b = ctx.inp(op, "Bias")
+    if b is not None:
+        out = out + b
+    ctx.out(op, "Out", out)

@@ -34,6 +34,7 @@ class Config:
             self.params_file = params_file
         self._engine = "xla"
         self._device = None
+        self._ir_optim = True
 
     # engine/device toggles (enable_use_gpu equivalents)
     def enable_use_tpu(self, device_id=0):
@@ -54,7 +55,7 @@ class Config:
         pass
 
     def switch_ir_optim(self, flag=True):
-        pass
+        self._ir_optim = bool(flag)
 
     def enable_memory_optim(self):
         pass
@@ -106,6 +107,18 @@ class Predictor:
             if self.config.prog_file else None,
             params_filename=os.path.basename(self.config.params_file)
             if self.config.params_file else None)
+        if getattr(self.config, "_ir_optim", True):
+            # program-level rewrite passes (ir/pass framework): XLA fuses
+            # arithmetic, these shrink the traced program + fold bn
+            from ..fluid import executor as _fx
+            from ..fluid.ir import apply_pass
+
+            apply_pass(prog, ["delete_dropout_pass", "fc_fuse_pass"])
+            try:
+                apply_pass(prog, "conv_bn_fuse_pass",
+                           scope=_fx.global_scope())
+            except Exception:
+                pass  # missing weights (program_only artifacts)
         self._program = prog
         self._feed_names = list(feed_names)
         self._fetch_vars = fetch_vars

@@ -1,0 +1,108 @@
+"""Graph IR pass framework: fusion/cleanup passes preserve semantics.
+
+Reference analogue: unittests/ir/ pass tests (test_fc_fuse_pass,
+test_conv_bn_fuse_pass...) — each pass must leave program outputs
+bit-compatible (or numerically equal for weight folding).
+"""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.ir import IrGraph, apply_pass, pass_names
+
+
+def _build_mlp_with_dropout():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[6], dtype="float32")
+        h = fluid.layers.fc(x, size=8, act="tanh")
+        h = fluid.layers.dropout(
+            h, dropout_prob=0.4, is_test=True,
+            dropout_implementation="upscale_in_train")
+        h = fluid.layers.dropout(h, dropout_prob=0.25, is_test=True)
+        y = fluid.layers.fc(h, size=3)
+    return main, startup, y
+
+
+def test_delete_dropout_and_fc_fuse_preserve_outputs():
+    main, startup, y = _build_mlp_with_dropout()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    xv = np.random.RandomState(0).randn(4, 6).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        before = exe.run(main, {"x": xv}, [y])[0]
+        n_ops_before = len(main.global_block().ops)
+        apply_pass(main, ["delete_dropout_pass", "fc_fuse_pass"])
+        types = [op.type for op in main.global_block().ops]
+        assert "dropout" not in types
+        # downgrade_in_infer dropout rewrites to a (1-p) scale op
+        assert "scale" in types
+        assert "mul" not in types and types.count("fc") == 2
+        assert len(main.global_block().ops) < n_ops_before
+        after = exe.run(main, {"x": xv}, [y])[0]
+    np.testing.assert_allclose(after, before, rtol=1e-6)
+
+
+def test_conv_bn_fuse_numerics():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[2, 6, 6], dtype="float32")
+        c = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                padding=1, bias_attr=False)
+        out = fluid.layers.batch_norm(c, is_test=True)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(1)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # non-trivial bn stats so folding actually matters
+        for v in startup.global_block().vars.values():
+            cur = scope.get_value(v.name)
+            if cur is not None and np.asarray(cur).shape == (4,):
+                scope.set_value(v.name,
+                                rng.uniform(0.5, 1.5, 4).astype("f4"))
+        xv = rng.randn(2, 2, 6, 6).astype("float32")
+        before = exe.run(main, {"img": xv}, [out])[0]
+        apply_pass(main, "conv_bn_fuse_pass", scope=scope)
+        types = [op.type for op in main.global_block().ops]
+        assert "batch_norm" not in types
+        after = exe.run(main, {"img": xv}, [out])[0]
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)
+
+
+def test_predictor_applies_ir_passes(tmp_path):
+    main, startup, y = _build_mlp_with_dropout()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    xv = np.random.RandomState(2).randn(3, 6).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        want = exe.run(main, {"x": xv}, [y])[0]
+        d = str(tmp_path / "m")
+        fluid.io.save_inference_model(d, ["x"], [y], exe,
+                                      main_program=main)
+    from paddle_tpu import inference
+
+    cfg = inference.Config(d)
+    cfg.switch_ir_optim(True)
+    pred = inference.Predictor(cfg)
+    types = [op.type for op in pred._program.global_block().ops]
+    assert "dropout" not in types and "fc" in types
+    (got,) = pred.run([xv])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    cfg2 = inference.Config(d)
+    cfg2.switch_ir_optim(False)
+    (got2,) = inference.Predictor(cfg2).run([xv])
+    np.testing.assert_allclose(got2, want, rtol=1e-5, atol=1e-6)
+
+
+def test_ir_graph_pattern_helpers():
+    main, startup, y = _build_mlp_with_dropout()
+    g = IrGraph(main)
+    assert any(op.type == "mul" for op in g.all_op_nodes())
+    chains = g.find_chains("mul", "elementwise_add")
+    assert len(chains) == 2
+    prod = g.var_producer(y.name)
+    assert prod is not None
+    assert "fc_fuse_pass" in pass_names()
