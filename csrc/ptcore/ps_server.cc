@@ -46,6 +46,7 @@ enum Cmd : uint8_t {
   kShutdown = 7,
   kHeartbeat = 8,   // trainer_id u32
   kNumTrainers = 9,
+  kPullDenseIfNewer = 10,  // name, client_version u64 -> version-gated
 };
 
 enum Status : uint8_t { kOk = 0, kErr = 1 };
@@ -114,6 +115,7 @@ struct DenseTable {
   std::vector<float> value;
   std::vector<float> m, v;  // momentum / adam state
   int64_t step = 0;
+  uint64_t version = 0;  // bumps on every mutation (delta-pull gate)
   std::mutex mu;
 };
 
@@ -292,6 +294,7 @@ class Server {
         t.m.assign(n, 0.0f);
         t.v.assign(n, 0.0f);
         t.step = 0;
+        ++t.version;
         resp->Put<uint8_t>(kOk);
         return;
       }
@@ -312,6 +315,7 @@ class Server {
         } else {
           ApplyDense(t, g, n);
         }
+        ++t.version;
         resp->Put<uint8_t>(kOk);
         return;
       }
@@ -323,6 +327,28 @@ class Server {
         resp->Put<uint8_t>(kOk);
         resp->Put<uint64_t>((uint64_t)t.value.size());
         resp->Raw(t.value.data(), t.value.size() * 4);
+        return;
+      }
+      case kPullDenseIfNewer: {
+        // the async PullDenseWorker's delta gate: data travels only
+        // when the server-side table advanced past the client's copy
+        std::string name = r.Str();
+        uint64_t cver = r.Get<uint64_t>();
+        if (!r.ok) return Err(resp, "bad pull_dense_if_newer");
+        auto& t = Dense(name);
+        std::lock_guard<std::mutex> lk(t.mu);
+        if (t.version == 0 && t.value.empty())
+          return Err(resp, "pull_dense_if_newer: " + name +
+                           " was never initialized");
+        resp->Put<uint8_t>(kOk);
+        resp->Put<uint64_t>(t.version);
+        if (t.version > cver) {
+          resp->Put<uint8_t>(1);
+          resp->Put<uint64_t>((uint64_t)t.value.size());
+          resp->Raw(t.value.data(), t.value.size() * 4);
+        } else {
+          resp->Put<uint8_t>(0);
+        }
         return;
       }
       case kPushSparse: {
@@ -692,6 +718,45 @@ int pt_ps_pull_dense(void* h, const char* name, float* out, uint64_t n) {
     return -4;
   }
   memcpy(out, g_resp.data() + 9, n * 4);
+  return 0;
+}
+
+int pt_ps_pull_dense_if_newer(void* h, const char* name, float* out,
+                              uint64_t n, uint64_t* version_io) {
+  Writer w;
+  w.Put<uint8_t>(ptcore::ps::kPullDenseIfNewer);
+  w.Str(name);
+  w.Put<uint64_t>(*version_io);
+  Client* c = (Client*)h;
+  if (!c->Call(w, &g_resp)) return -1;
+  if (g_resp.empty() || g_resp[0] != 0) {
+    CaptureServerError(c);
+    return -2;
+  }
+  if (g_resp.size() < 10) {
+    c->error = "pull_dense_if_newer: truncated header";
+    return -4;
+  }
+  uint64_t ver = 0;
+  memcpy(&ver, g_resp.data() + 1, 8);
+  uint8_t has = (uint8_t)g_resp[9];
+  *version_io = ver;
+  if (!has) return 1;  // unchanged: no payload transferred
+  if (g_resp.size() < 18) {
+    c->error = "pull_dense_if_newer: truncated count";
+    return -4;
+  }
+  uint64_t count = 0;
+  memcpy(&count, g_resp.data() + 10, 8);
+  if (count != n) {
+    c->error = "pull_dense_if_newer size mismatch";
+    return -3;
+  }
+  if (g_resp.size() < 18 + (uint64_t)n * 4) {
+    c->error = "pull_dense_if_newer: truncated payload";
+    return -4;
+  }
+  memcpy(out, g_resp.data() + 18, n * 4);
   return 0;
 }
 

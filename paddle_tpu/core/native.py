@@ -141,6 +141,9 @@ def _declare(lib):
         "pt_cipher_decrypt_file": (c.c_int, [c.c_char_p, c.c_char_p,
                                              c.c_char_p]),
         "pt_cipher_is_encrypted": (c.c_int, [c.c_char_p]),
+        "pt_ps_pull_dense_if_newer": (c.c_int, [
+            c.c_void_p, c.c_char_p, c.POINTER(c.c_float), c.c_uint64,
+            c.POINTER(c.c_uint64)]),
         "pt_prof_count": (c.c_uint64, []),
         "pt_pred_create": (c.c_void_p, [c.c_char_p]),
         "pt_pred_error": (c.c_char_p, [c.c_void_p]),

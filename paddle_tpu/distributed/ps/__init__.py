@@ -116,6 +116,23 @@ class PsClient:
             grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float))),
             "push_sparse")
 
+    def pull_dense_if_newer(self, name, shape, version, out=None):
+        """Version-gated pull (the async PullDenseWorker delta path):
+        returns (array_or_None, new_version) — None means the server's
+        table has not advanced past `version`, so no payload moved.
+        Pass a reusable `out` buffer to avoid per-poll allocation."""
+        if out is None:
+            out = np.empty(int(np.prod(shape)), np.float32)
+        ver = ctypes.c_uint64(int(version))
+        rc = self._lib.pt_ps_pull_dense_if_newer(
+            self._h, name.encode(),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), out.size,
+            ctypes.byref(ver))
+        if rc == 1:
+            return None, ver.value
+        self._ck(rc, "pull_dense_if_newer")
+        return out.reshape(shape), ver.value
+
     def pull_sparse(self, table, keys, dim):
         keys = np.ascontiguousarray(keys, np.int64).ravel()
         out = np.empty((keys.size, dim), np.float32)
@@ -285,11 +302,23 @@ class Communicator:
 
         def recv_loop():
             consecutive_errs = 0
+            versions = {}
+            scratch = {}  # reusable per-name buffers (no per-poll alloc)
             while not self._stop_evt.is_set():
                 try:
                     for n, s in list(self._dense_shapes.items()):
-                        self._latest[n] = self._client_for(n).pull_dense(
-                            n, s)
+                        # delta gate: payload moves only when the server
+                        # table advanced (PullDenseWorker without the
+                        # full-param re-pull every interval)
+                        if n not in scratch:
+                            scratch[n] = np.empty(
+                                int(np.prod(s)), np.float32)
+                        arr, versions[n] = self._client_for(
+                            n).pull_dense_if_newer(
+                                n, s, versions.get(n, 0),
+                                out=scratch[n])
+                        if arr is not None:
+                            self._latest[n] = arr.copy()
                     consecutive_errs = 0
                 except Exception as e:  # transient: retry, then surface
                     consecutive_errs += 1

@@ -254,3 +254,44 @@ def test_shutdown_rpc_then_stop_joins_cleanly():
     assert srv.shutdown_requested()
     cli.close()
     srv.stop()  # must not abort the process
+
+
+def test_delta_gated_dense_pull():
+    """kPullDenseIfNewer: the async recv path transfers a parameter only
+    when the server-side table advanced (PullDenseWorker without the
+    full re-pull every interval)."""
+    import numpy as np
+
+    from paddle_tpu.distributed.ps import Communicator, PsServer
+
+    srv = PsServer(port=0, trainers=1, optimizer="sgd", lr=0.1)
+    try:
+        comm = Communicator([f"127.0.0.1:{srv.port}"], mode="sync")
+        c = comm.clients[0]
+        c.init_dense("w", np.ones(6, np.float32))
+        arr, v1 = c.pull_dense_if_newer("w", (6,), 0)
+        assert arr is not None and v1 >= 1
+        # no server-side change -> no payload
+        arr2, v2 = c.pull_dense_if_newer("w", (6,), v1)
+        assert arr2 is None and v2 == v1
+        # push advances the version and the next gated pull transfers
+        c.push_dense("w", np.full(6, 0.5, np.float32))
+        arr3, v3 = c.pull_dense_if_newer("w", (6,), v2)
+        assert arr3 is not None and v3 > v2
+        np.testing.assert_allclose(arr3, 1.0 - 0.1 * 0.5, rtol=1e-6)
+
+        # async mode end-to-end: recv loop picks up pushed updates
+        comm2 = Communicator([f"127.0.0.1:{srv.port}"], mode="async",
+                             recv_interval=0.01)
+        comm2._dense_shapes["w"] = (6,)
+        comm2.start()
+        import time
+
+        c.push_dense("w", np.full(6, 0.5, np.float32))
+        deadline = time.time() + 5
+        while time.time() < deadline and "w" not in comm2._latest:
+            time.sleep(0.02)
+        assert "w" in comm2._latest
+        comm2.stop()
+    finally:
+        srv.stop()
