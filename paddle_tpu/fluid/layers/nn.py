@@ -11,7 +11,7 @@ import numpy as np
 from ...core.dtypes import convert_dtype, dtype_name
 from .. import initializer as init
 from ..framework import Variable
-from ..layer_helper import LayerHelper
+from ..layer_helper import LayerHelper, ParamAttr
 
 
 def _pair(v):
@@ -494,4 +494,116 @@ def lod_reset(x, y=None, target_lod=None):
     helper.append_op(type="lod_reset", inputs=inputs,
                      outputs={"Out": [out]},
                      attrs={"target_lod": list(target_lod or [])})
+    return out
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference(
+        np.float32, [input.shape[0] or -1, 1])
+    inputs = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        inputs["LogitsLength"] = [input_length]
+    if label_length is not None:
+        inputs["LabelLength"] = [label_length]
+    helper.append_op(type="warpctc", inputs=inputs,
+                     outputs={"Loss": [loss]},
+                     attrs={"blank": blank,
+                            "norm_by_times": norm_by_times})
+    return loss
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """linear_chain_crf layer: creates the [num_tags+2, num_tags]
+    transition parameter (fluid layout) and returns per-example
+    log-likelihood (negated by callers as the loss)."""
+    helper = LayerHelper("linear_chain_crf")
+    num_tags = input.shape[-1]
+    transition = helper.create_parameter(
+        param_attr, [num_tags + 2, num_tags], input.dtype,
+        default_initializer=init.Normal(0.0, 0.1))
+    ll = helper.create_variable_for_type_inference(
+        input.dtype, [input.shape[0] or -1, 1])
+    inputs = {"Emission": [input], "Transition": [transition],
+              "Label": [label]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(type="linear_chain_crf", inputs=inputs,
+                     outputs={"LogLikelihood": [ll]}, attrs={})
+    return ll
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None,
+                 transition=None):
+    helper = LayerHelper("crf_decoding")
+    if transition is None:
+        attr = ParamAttr._to_attr(param_attr)
+        transition = helper.main_program.global_block().var(attr.name)
+    path = helper.create_variable_for_type_inference(
+        np.int64, list(input.shape[:-1]))
+    path.lod_level = getattr(input, "lod_level", 0)
+    inputs = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        # fluid contract: with Label, the output is a 0/1 correctness
+        # mask per position, not the path
+        inputs["Label"] = [label]
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [path]}, attrs={})
+    return path
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0,
+                input_image_size=None, out_stride=1, name=None):
+    if input_image_size is not None:
+        raise NotImplementedError(
+            "im2sequence with per-image input_image_size produces "
+            "data-dependent sequence lengths (not XLA-lowerable); pad "
+            "images uniformly or mask downstream")
+    helper = LayerHelper("im2sequence", name=name)
+    k = _pair(filter_size)
+    s = _pair(stride)
+    p = padding if isinstance(padding, (list, tuple)) else [padding] * 4
+    out = helper.create_variable_for_type_inference(input.dtype, None)
+    helper.append_op(type="im2sequence", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"kernels": list(k), "strides": list(s),
+                            "paddings": list(p)})
+    return out
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper("margin_rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(left.dtype,
+                                                    left.shape)
+    act = helper.create_variable_for_type_inference(left.dtype,
+                                                    left.shape)
+    helper.append_op(type="margin_rank_loss",
+                     inputs={"Label": [label], "X1": [left],
+                             "X2": [right]},
+                     outputs={"Out": [out], "Activated": [act]},
+                     attrs={"margin": margin})
+    return out
+
+
+def rank_loss(label, left, right, name=None):
+    helper = LayerHelper("rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(left.dtype,
+                                                    left.shape)
+    helper.append_op(type="rank_loss",
+                     inputs={"Label": [label], "Left": [left],
+                             "Right": [right]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def hinge_loss(input, label, name=None):
+    helper = LayerHelper("hinge_loss", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype,
+                                                    input.shape)
+    helper.append_op(type="hinge_loss",
+                     inputs={"Logits": [input], "Labels": [label]},
+                     outputs={"Loss": [out]}, attrs={})
     return out

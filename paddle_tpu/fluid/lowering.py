@@ -1941,3 +1941,268 @@ def _fc_fused(ctx, op):
     if b is not None:
         out = out + b
     ctx.out(op, "Out", out)
+
+
+# ====== structured sequence losses + rnn units + ranking losses ======
+
+@register("warpctc")
+def _warpctc(ctx, op):
+    """warpctc_op parity over the padded canonical form: Logits
+    [B, T, C] (+ @@LOD) or with explicit LogitsLength/LabelLength."""
+    import jax
+
+    from ..ops import sequence_losses as SL
+
+    jnp = _jnp()
+    logits = ctx.inp(op, "Logits")
+    label = ctx.inp(op, "Label")
+    lg_len = ctx.inp(op, "LogitsLength")
+    lb_len = ctx.inp(op, "LabelLength")
+    if lg_len is None:
+        lg_len = ctx.env.get(op.input("Logits")[0] + _LOD_SUFFIX)
+    if lb_len is None:
+        lb_len = ctx.env.get(op.input("Label")[0] + _LOD_SUFFIX)
+    if lg_len is None:
+        lg_len = jnp.full((logits.shape[0],), logits.shape[1], jnp.int32)
+    if lb_len is None:
+        lb_len = jnp.full((label.shape[0],), label.shape[1], jnp.int32)
+    if label.ndim == 3 and label.shape[-1] == 1:
+        label = label[..., 0]
+    lp = jax.nn.log_softmax(
+        logits.astype(jnp.float32), axis=-1)
+    loss = SL.ctc_loss(jnp.moveaxis(lp, 1, 0), label,
+                       lg_len, lb_len,
+                       blank=op.attrs.get("blank", 0))
+    if op.attrs.get("norm_by_times", False):
+        loss = loss / jnp.maximum(
+            jnp.reshape(lg_len, (-1,)).astype(loss.dtype), 1.0)
+    ctx.out(op, "Loss", loss[:, None])
+
+
+@register("linear_chain_crf")
+def _linear_chain_crf(ctx, op):
+    from ..ops import sequence_losses as SL
+
+    jnp = _jnp()
+    em = ctx.inp(op, "Emission")
+    trans = ctx.inp(op, "Transition")
+    label = ctx.inp(op, "Label")
+    lens = ctx.inp(op, "Length")
+    if lens is None:
+        lens = ctx.env.get(op.input("Emission")[0] + _LOD_SUFFIX)
+    if lens is None:
+        lens = jnp.full((em.shape[0],), em.shape[1], jnp.int32)
+    if label.ndim == 3 and label.shape[-1] == 1:
+        label = label[..., 0]
+    ll = SL.crf_log_likelihood(em, trans, label, lens)
+    ctx.out(op, "LogLikelihood", ll[:, None])
+
+
+@register("crf_decoding")
+def _crf_decoding(ctx, op):
+    from ..ops import sequence_losses as SL
+
+    jnp = _jnp()
+    em = ctx.inp(op, "Emission")
+    trans = ctx.inp(op, "Transition")
+    lens = ctx.inp(op, "Length")
+    if lens is None:
+        lens = ctx.env.get(op.input("Emission")[0] + _LOD_SUFFIX)
+    if lens is None:
+        lens = jnp.full((em.shape[0],), em.shape[1], jnp.int32)
+    path, _ = SL.crf_decode(em, trans, lens)
+    label = ctx.inp(op, "Label")
+    if label is not None:
+        # fluid contract: with Label given, output a 0/1 per-position
+        # CORRECTNESS mask (crf_decoding_op.h), not the path itself
+        if label.ndim == 3 and label.shape[-1] == 1:
+            label = label[..., 0]
+        path = (path == label.astype(path.dtype)).astype(jnp.int64)
+    out_name = op.output("ViterbiPath")
+    if out_name:
+        ctx.env[out_name[0]] = path
+        ln = op.input("Emission")[0] + _LOD_SUFFIX
+        if ln in ctx.env:
+            ctx.env[out_name[0] + _LOD_SUFFIX] = ctx.env[ln]
+
+
+@register("im2sequence")
+def _im2sequence(ctx, op):
+    """im2sequence_op (OCR pipelines): image patches -> row-major token
+    sequence [B, out_h*out_w, C*kh*kw]."""
+    import jax
+
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    kh, kw = op.attrs["kernels"]
+    sh, sw = op.attrs.get("strides", [1, 1])
+    pu, pl_, pd, pr = op.attrs.get("paddings", [0, 0, 0, 0])
+    x = jnp.pad(x, ((0, 0), (0, 0), (pu, pd), (pl_, pr)))
+    B, C, H, W = x.shape
+    oh = (H - kh) // sh + 1
+    ow = (W - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), padding="VALID")  # [B, C*kh*kw, oh, ow]
+    seq = patches.reshape(B, -1, oh * ow).transpose(0, 2, 1)
+    ctx.out(op, "Out", seq)
+
+
+@register("gru_unit")
+def _gru_unit(ctx, op):
+    from ..ops import sequence as S
+
+    hs = S.dynamic_gru(
+        ctx.inp(op, "Input")[:, None, :],
+        _jnp().ones((ctx.inp(op, "Input").shape[0],), _jnp().int32),
+        ctx.inp(op, "Weight"), ctx.inp(op, "Bias"),
+        ctx.inp(op, "HiddenPrev"),
+        gate_activation=op.attrs.get("gate_activation", "sigmoid"),
+        candidate_activation=op.attrs.get("activation", "tanh"),
+        origin_mode=op.attrs.get("origin_mode", False))
+    ctx.out(op, "Hidden", hs[:, 0])
+
+
+@register("lstm_unit")
+def _lstm_unit(ctx, op):
+    """lstm_unit_op.h: X already carries the 4 gate pre-activations in
+    order (i, f, o, g); no recurrent weight inside the op."""
+    import jax
+
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    c_prev = ctx.inp(op, "C_prev")
+    fb = op.attrs.get("forget_bias", 0.0)
+    D = x.shape[-1] // 4
+    i = jax.nn.sigmoid(x[:, :D])
+    f = jax.nn.sigmoid(x[:, D:2 * D] + fb)
+    o = jax.nn.sigmoid(x[:, 2 * D:3 * D])
+    g = jnp.tanh(x[:, 3 * D:])
+    c = f * c_prev + i * g
+    ctx.out(op, "C", c)
+    ctx.out(op, "H", o * jnp.tanh(c))
+
+
+@register("margin_rank_loss")
+def _margin_rank(ctx, op):
+    jnp = _jnp()
+    label = ctx.inp(op, "Label")
+    left = ctx.inp(op, "X1")
+    right = ctx.inp(op, "X2")
+    margin = op.attrs.get("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (left - right) + margin)
+    ctx.out(op, "Out", out)
+    ctx.out(op, "Activated", (out > 0).astype(left.dtype))
+
+
+@register("rank_loss")
+def _rank_loss(ctx, op):
+    jnp = _jnp()
+    label = ctx.inp(op, "Label")
+    left = ctx.inp(op, "Left")
+    right = ctx.inp(op, "Right")
+    d = left - right
+    # logaddexp(0, d) = log(1 + e^d), overflow-safe for large gaps
+    ctx.out(op, "Out", jnp.logaddexp(0.0, d) - label * d)
+
+
+@register("hinge_loss")
+def _hinge_loss(ctx, op):
+    jnp = _jnp()
+    logits = ctx.inp(op, "Logits")
+    labels = ctx.inp(op, "Labels").astype(logits.dtype)
+    ctx.out(op, "Loss",
+            jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits))
+
+
+# ====== remaining optimizer op lowerings ======
+
+@register("adagrad")
+def _adagrad(ctx, op):
+    jnp = _jnp()
+    p = ctx.inp(op, "Param")
+    g = ctx.inp(op, "Grad").astype(p.dtype)
+    m = ctx.inp(op, "Moment")
+    lr = ctx.inp(op, "LearningRate")
+    eps = op.attrs.get("epsilon", 1e-6)
+    m_new = m + g * g
+    ctx.out(op, "ParamOut", p - lr * g / (jnp.sqrt(m_new) + eps))
+    ctx.out(op, "MomentOut", m_new)
+
+
+@register("rmsprop")
+def _rmsprop(ctx, op):
+    jnp = _jnp()
+    p = ctx.inp(op, "Param")
+    g = ctx.inp(op, "Grad").astype(p.dtype)
+    ms = ctx.inp(op, "MeanSquare")
+    mom = ctx.inp(op, "Moment")
+    lr = ctx.inp(op, "LearningRate")
+    rho = op.attrs.get("decay", 0.95)
+    eps = op.attrs.get("epsilon", 1e-6)
+    mu = op.attrs.get("momentum", 0.0)
+    ms_new = rho * ms + (1 - rho) * g * g
+    mom_new = mu * mom + lr * g / jnp.sqrt(ms_new + eps)
+    ctx.out(op, "ParamOut", p - mom_new)
+    ctx.out(op, "MeanSquareOut", ms_new)
+    ctx.out(op, "MomentOut", mom_new)
+
+
+@register("adadelta")
+def _adadelta(ctx, op):
+    jnp = _jnp()
+    p = ctx.inp(op, "Param")
+    g = ctx.inp(op, "Grad").astype(p.dtype)
+    avg_sq = ctx.inp(op, "AvgSquaredGrad")
+    avg_upd = ctx.inp(op, "AvgSquaredUpdate")
+    rho = op.attrs.get("rho", 0.95)
+    eps = op.attrs.get("epsilon", 1e-6)
+    sq_new = rho * avg_sq + (1 - rho) * g * g
+    upd = jnp.sqrt(avg_upd + eps) / jnp.sqrt(sq_new + eps) * g
+    upd_new = rho * avg_upd + (1 - rho) * upd * upd
+    ctx.out(op, "ParamOut", p - upd)
+    ctx.out(op, "AvgSquaredGradOut", sq_new)
+    ctx.out(op, "AvgSquaredUpdateOut", upd_new)
+
+
+@register("adamax")
+def _adamax(ctx, op):
+    jnp = _jnp()
+    p = ctx.inp(op, "Param")
+    g = ctx.inp(op, "Grad").astype(p.dtype)
+    m = ctx.inp(op, "Moment")
+    inf_norm = ctx.inp(op, "InfNorm")
+    b1p = ctx.inp(op, "Beta1Pow")
+    lr = ctx.inp(op, "LearningRate")
+    b1 = op.attrs.get("beta1", 0.9)
+    b2 = op.attrs.get("beta2", 0.999)
+    eps = op.attrs.get("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    inf_new = jnp.maximum(b2 * inf_norm, jnp.abs(g))
+    lr_t = lr / (1 - b1p)
+    ctx.out(op, "ParamOut", p - lr_t * m_new / (inf_new + eps))
+    ctx.out(op, "MomentOut", m_new)
+    ctx.out(op, "InfNormOut", inf_new)
+    ctx.out(op, "Beta1PowOut", b1p * b1)
+
+
+@register("ftrl")
+def _ftrl(ctx, op):
+    jnp = _jnp()
+    p = ctx.inp(op, "Param")
+    g = ctx.inp(op, "Grad").astype(p.dtype)
+    sq = ctx.inp(op, "SquaredAccumulator")
+    lin = ctx.inp(op, "LinearAccumulator")
+    lr = ctx.inp(op, "LearningRate")
+    l1 = op.attrs.get("l1", 0.0)
+    l2 = op.attrs.get("l2", 0.0)
+    power = op.attrs.get("lr_power", -0.5)
+    sq_new = sq + g * g
+    sigma = (sq_new ** (-power) - sq ** (-power)) / lr
+    lin_new = lin + g - sigma * p
+    quad = sq_new ** (-power) / lr + 2 * l2
+    pre = jnp.clip(lin_new, -l1, l1) - lin_new
+    p_new = jnp.where(jnp.abs(lin_new) > l1, pre / quad,
+                      jnp.zeros_like(p))
+    ctx.out(op, "ParamOut", p_new)
+    ctx.out(op, "SquaredAccumOut", sq_new)
+    ctx.out(op, "LinearAccumOut", lin_new)

@@ -222,3 +222,103 @@ class GradientMergeOptimizer(Optimizer):
 
 
 from .pipeline import PipelineOptimizer  # noqa: E402,F401
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._eps = epsilon
+
+    def _append_op(self, block, param, grad, lr):
+        m = self._make_acc(block, param, "moment")
+        block.append_op(type="adagrad",
+                        inputs={"Param": [param], "Grad": [grad],
+                                "Moment": [m], "LearningRate": [lr]},
+                        outputs={"ParamOut": [param], "MomentOut": [m]},
+                        attrs={"epsilon": self._eps})
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._eps, self._momentum = rho, epsilon, momentum
+
+    def _append_op(self, block, param, grad, lr):
+        ms = self._make_acc(block, param, "mean_square")
+        mom = self._make_acc(block, param, "moment")
+        block.append_op(
+            type="rmsprop",
+            inputs={"Param": [param], "Grad": [grad],
+                    "MeanSquare": [ms], "Moment": [mom],
+                    "LearningRate": [lr]},
+            outputs={"ParamOut": [param], "MeanSquareOut": [ms],
+                     "MomentOut": [mom]},
+            attrs={"decay": self._rho, "epsilon": self._eps,
+                   "momentum": self._momentum})
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._eps = rho, epsilon
+
+    def _append_op(self, block, param, grad, lr):
+        sq = self._make_acc(block, param, "avg_squared_grad")
+        upd = self._make_acc(block, param, "avg_squared_update")
+        block.append_op(
+            type="adadelta",
+            inputs={"Param": [param], "Grad": [grad],
+                    "AvgSquaredGrad": [sq], "AvgSquaredUpdate": [upd]},
+            outputs={"ParamOut": [param], "AvgSquaredGradOut": [sq],
+                     "AvgSquaredUpdateOut": [upd]},
+            attrs={"rho": self._rho, "epsilon": self._eps})
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _append_op(self, block, param, grad, lr):
+        m = self._make_acc(block, param, "moment")
+        inf = self._make_acc(block, param, "inf_norm")
+        b1p = self._make_acc(block, param, "beta1_pow", self._beta1,
+                             shape=[1])
+        block.append_op(
+            type="adamax",
+            inputs={"Param": [param], "Grad": [grad], "Moment": [m],
+                    "InfNorm": [inf], "Beta1Pow": [b1p],
+                    "LearningRate": [lr]},
+            outputs={"ParamOut": [param], "MomentOut": [m],
+                     "InfNormOut": [inf], "Beta1PowOut": [b1p]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._eps})
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _append_op(self, block, param, grad, lr):
+        sq = self._make_acc(block, param, "squared_accum", 0.1)
+        lin = self._make_acc(block, param, "linear_accum")
+        block.append_op(
+            type="ftrl",
+            inputs={"Param": [param], "Grad": [grad],
+                    "SquaredAccumulator": [sq],
+                    "LinearAccumulator": [lin], "LearningRate": [lr]},
+            outputs={"ParamOut": [param], "SquaredAccumOut": [sq],
+                     "LinearAccumOut": [lin]},
+            attrs={"l1": self._l1, "l2": self._l2,
+                   "lr_power": self._lr_power})
+
+
+Adagrad = AdagradOptimizer
+RMSProp = RMSPropOptimizer
+Adadelta = AdadeltaOptimizer
+Adamax = AdamaxOptimizer
+Ftrl = FtrlOptimizer

@@ -618,9 +618,33 @@ def log_loss(input, label, epsilon=1e-4, name=None):
     return _op("log_loss", fn, input, label)
 
 
-def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
-             reduction="mean"):
-    raise NotImplementedError("ctc_loss lands with the audio op set")
+def ctc_loss(log_probs, labels, input_lengths, label_lengths,
+             blank=0, reduction="mean", norm_by_times=False):
+    """paddle.nn.functional.ctc_loss parity (warpctc capability):
+    log_probs [T, B, C] raw logits (log_softmax applied internally, as
+    warpctc does its own normalization), labels [B, L] padded."""
+    import jax
+
+    from ...ops import sequence_losses as SL
+
+    def fn(lp_raw):
+        lp = jax.nn.log_softmax(lp_raw.astype("float32"), axis=-1)
+        loss = SL.ctc_loss(lp, _t(labels)._data, _t(input_lengths)._data,
+                           _t(label_lengths)._data, blank=blank)
+        if norm_by_times:
+            import jax.numpy as jnp
+
+            loss = loss / jnp.maximum(
+                jnp.reshape(_t(input_lengths)._data, (-1,)).astype(
+                    loss.dtype), 1.0)
+        return loss
+
+    out = _op("ctc_loss", fn, log_probs)
+    if reduction == "mean":
+        return out.mean()
+    if reduction == "sum":
+        return out.sum()
+    return out
 
 
 def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,

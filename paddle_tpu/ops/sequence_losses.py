@@ -1,0 +1,196 @@
+"""Structured sequence losses: CTC and linear-chain CRF.
+
+Reference parity:
+- warpctc op (operators/warpctc_op.*, external warp-ctc library) — here
+  a from-scratch log-domain CTC forward algorithm under lax.scan, fully
+  differentiable through jax autodiff (no hand-written grad kernel
+  needed; the scan transposes).
+- linear_chain_crf / crf_decoding ops (operators/linear_chain_crf_op.h,
+  crf_decoding_op.h): transition matrix layout [num_tags + 2, num_tags]
+  with row 0 = start weights, row 1 = stop weights, rows 2.. = pairwise
+  transitions — the fluid layout, kept for checkpoint compatibility.
+
+All kernels take PADDED batches + lengths (the framework's LoD
+canonical form) and mask internally; shapes stay static for XLA.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+NEG = -1e30
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0):
+    """Negative log-likelihood per example.
+
+    log_probs: [T, B, C] log-softmax outputs; labels: [B, L] int padded;
+    input_lengths [B], label_lengths [B]. Standard alpha recursion over
+    the extended label sequence (blank-interleaved, length 2L+1).
+    """
+    import jax
+
+    jnp = _jnp()
+    log_probs = jnp.asarray(log_probs)
+    labels = jnp.asarray(labels)
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+
+    # extended labels: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels.astype(jnp.int32))
+    lab_len = jnp.reshape(label_lengths, (-1,)).astype(jnp.int32)
+    inp_len = jnp.reshape(input_lengths, (-1,)).astype(jnp.int32)
+    ext_len = 2 * lab_len + 1
+
+    # can we skip from s-2 to s? (only onto a label position whose label
+    # differs from the one two back)
+    prev2 = jnp.concatenate(
+        [jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != prev2)
+
+    def emit(t):
+        # log_probs[t] gathered at each extended symbol: [B, S]
+        return jnp.take_along_axis(log_probs[t], ext, axis=1)
+
+    alpha0 = jnp.full((B, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(emit(0)[:, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(lab_len > 0, emit(0)[:, 1], NEG))
+
+    def step(alpha, t):
+        a_shift1 = jnp.concatenate(
+            [jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+        a_shift2 = jnp.concatenate(
+            [jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+        a_shift2 = jnp.where(can_skip, a_shift2, NEG)
+        merged = jnp.logaddexp(alpha, jnp.logaddexp(a_shift1, a_shift2))
+        new = merged + emit(t)
+        # frozen past each example's input length
+        alive = (t < inp_len)[:, None]
+        return jnp.where(alive, new, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+
+    # total log prob: last blank + last label position
+    idx_last = jnp.clip(ext_len - 1, 0, S - 1)
+    idx_prev = jnp.clip(ext_len - 2, 0, S - 1)
+    a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(
+        ext_len >= 2,
+        jnp.take_along_axis(alpha, idx_prev[:, None], axis=1)[:, 0], NEG)
+    ll = jnp.logaddexp(a_last, a_prev)
+    return -ll
+
+
+# ---------------------------------------------------------------------------
+# linear-chain CRF
+# ---------------------------------------------------------------------------
+
+def _split_transition(transition):
+    start = transition[0]     # [C]
+    stop = transition[1]      # [C]
+    trans = transition[2:]    # [C, C] (from, to)
+    return start, stop, trans
+
+
+def crf_log_likelihood(emission, transition, label, lengths):
+    """Per-example log p(label | emission): score - logZ.
+
+    emission [B, T, C], transition [C+2, C] (fluid layout), label
+    [B, T] int, lengths [B]."""
+    import jax
+
+    jnp = _jnp()
+    emission = jnp.asarray(emission)
+    transition = jnp.asarray(transition)
+    B, T, C = emission.shape
+    start, stop, trans = _split_transition(transition)
+    lens = jnp.reshape(lengths, (-1,)).astype(jnp.int32)
+    label = jnp.asarray(label).reshape(B, T).astype(jnp.int32)
+    t_idx = jnp.arange(T)
+    mask = (t_idx[None, :] < lens[:, None])
+
+    # ----- gold path score -----
+    em_score = jnp.take_along_axis(emission, label[..., None],
+                                   axis=2)[..., 0]
+    em_score = (em_score * mask).sum(axis=1)
+    start_score = start[label[:, 0]]
+    last_idx = jnp.clip(lens - 1, 0, T - 1)
+    last_lab = jnp.take_along_axis(label, last_idx[:, None],
+                                   axis=1)[:, 0]
+    stop_score = stop[last_lab]
+    pair = trans[label[:, :-1], label[:, 1:]]          # [B, T-1]
+    pair_mask = mask[:, 1:]
+    pair_score = (pair * pair_mask).sum(axis=1)
+    score = em_score + start_score + stop_score + pair_score
+
+    # ----- partition function (forward algorithm) -----
+    alpha0 = start[None, :] + emission[:, 0]
+
+    def step(alpha, t):
+        nxt = jax.nn.logsumexp(
+            alpha[:, :, None] + trans[None, :, :], axis=1) + emission[:, t]
+        alive = (t < lens)[:, None]
+        return jnp.where(alive, nxt, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    logz = jax.nn.logsumexp(alpha + stop[None, :], axis=1)
+    return score - logz
+
+
+def crf_decode(emission, transition, lengths):
+    """Viterbi path under the fluid transition layout. Returns
+    (path [B, T] int32 with zeros past each length, scores [B])."""
+    import jax
+
+    jnp = _jnp()
+    emission = jnp.asarray(emission)
+    transition = jnp.asarray(transition)
+    B, T, C = emission.shape
+    start, stop, trans = _split_transition(transition)
+    lens = jnp.reshape(lengths, (-1,)).astype(jnp.int32)
+
+    alpha0 = start[None, :] + emission[:, 0]
+
+    def fwd(alpha, t):
+        cand = alpha[:, :, None] + trans[None, :, :]   # [B, from, to]
+        best = cand.max(axis=1) + emission[:, t]
+        back = cand.argmax(axis=1).astype(jnp.int32)
+        alive = (t < lens)[:, None]
+        return jnp.where(alive, best, alpha), \
+            jnp.where(alive, back,
+                      jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32),
+                                       (B, C)))
+
+    alpha, backs = jax.lax.scan(fwd, alpha0, jnp.arange(1, T))
+    final = alpha + stop[None, :]
+    last = final.argmax(axis=1).astype(jnp.int32)
+    scores = final.max(axis=1)
+
+    # backtrace from each example's last valid step
+    def bwd(carry, t):
+        path_t = carry
+        bp = backs[t]                                   # [B, C]
+        prev = jnp.take_along_axis(bp, path_t[:, None], axis=1)[:, 0]
+        # positions at-or-after the example's end keep the end label
+        use = (t < lens - 1)
+        return jnp.where(use, prev, path_t), path_t
+
+    first, rev = jax.lax.scan(bwd, last, jnp.arange(T - 2, -1, -1))
+    # rev[k] = label at position T-1-k (the carry BEFORE each update);
+    # the final carry is the label at position 0
+    path = jnp.concatenate([first[:, None], jnp.flip(rev, 0).T], axis=1)
+    t_idx = jnp.arange(T)
+    path = jnp.where(t_idx[None, :] < lens[:, None], path, 0)
+    return path.astype(jnp.int32), scores

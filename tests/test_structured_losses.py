@@ -1,0 +1,375 @@
+"""CTC + linear-chain CRF vs brute-force oracles.
+
+Reference analogue: test_warpctc_op.py and test_linear_chain_crf_op.py
+— both ops checked against exhaustive-enumeration references on tiny
+sizes (every alignment / every path)."""
+import itertools
+
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import sequence_losses as SL
+
+
+def _ctc_brute(logp, label, T_len, blank=0):
+    """Sum probability over ALL alignments via DP in plain numpy."""
+    lab = [blank] + [v for x in label for v in (x, blank)]
+    S = len(lab)
+    alpha = np.full((T_len, S), -np.inf)
+    alpha[0, 0] = logp[0, blank]
+    if S > 1:
+        alpha[0, 1] = logp[0, lab[1]]
+    for t in range(1, T_len):
+        for s in range(S):
+            cands = [alpha[t - 1, s]]
+            if s >= 1:
+                cands.append(alpha[t - 1, s - 1])
+            if s >= 2 and lab[s] != blank and lab[s] != lab[s - 2]:
+                cands.append(alpha[t - 1, s - 2])
+            alpha[t, s] = np.logaddexp.reduce(cands) + logp[t, lab[s]]
+    ends = [alpha[T_len - 1, S - 1]]
+    if S >= 2:
+        ends.append(alpha[T_len - 1, S - 2])
+    return -np.logaddexp.reduce(ends)
+
+
+def test_ctc_loss_matches_bruteforce():
+    import jax
+
+    rng = np.random.RandomState(0)
+    T, B, C, L = 6, 3, 5, 2
+    logits = rng.randn(T, B, C).astype("float32")
+    logp = np.asarray(jax.nn.log_softmax(logits, axis=-1))
+    labels = np.array([[1, 2], [3, 3], [4, 0]], "int64")
+    in_len = np.array([6, 5, 4])
+    lab_len = np.array([2, 2, 1])
+    got = np.asarray(SL.ctc_loss(logp, labels, in_len, lab_len))
+    for b in range(B):
+        want = _ctc_brute(logp[:, b], list(labels[b][:lab_len[b]]),
+                          in_len[b])
+        np.testing.assert_allclose(got[b], want, rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_loss_differentiable():
+    import jax
+
+    rng = np.random.RandomState(1)
+    T, B, C = 5, 2, 4
+    logits = rng.randn(T, B, C).astype("float32")
+    labels = np.array([[1, 2], [3, 0]], "int64")
+
+    def loss(lg):
+        lp = jax.nn.log_softmax(lg, axis=-1)
+        return SL.ctc_loss(lp, labels, np.array([5, 4]),
+                           np.array([2, 1])).sum()
+
+    g = np.asarray(jax.grad(loss)(logits))
+    assert np.isfinite(g).all() and np.abs(g).max() > 0
+    # rows sum to ~0 for softmax-composed CTC grads (probability mass)
+    np.testing.assert_allclose(g.sum(-1), 0.0, atol=1e-5)
+
+
+def _crf_paths_brute(em, start, stop, trans, n):
+    C = em.shape[1]
+    scores = {}
+    for path in itertools.product(range(C), repeat=n):
+        s = start[path[0]] + stop[path[-1]]
+        s += sum(em[t, path[t]] for t in range(n))
+        s += sum(trans[path[t], path[t + 1]] for t in range(n - 1))
+        scores[path] = s
+    return scores
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_crf_log_likelihood_and_decode(seed):
+    rng = np.random.RandomState(seed)
+    B, T, C = 3, 4, 3
+    em = rng.randn(B, T, C).astype("float32")
+    transition = rng.randn(C + 2, C).astype("float32") * 0.5
+    lengths = np.array([4, 3, 2])
+    labels = rng.randint(0, C, (B, T)).astype("int64")
+
+    ll = np.asarray(SL.crf_log_likelihood(em, transition, labels,
+                                          lengths))
+    path, pscore = SL.crf_decode(em, transition, lengths)
+    path, pscore = np.asarray(path), np.asarray(pscore)
+
+    start, stop, trans = (transition[0], transition[1], transition[2:])
+    for b in range(B):
+        n = lengths[b]
+        scores = _crf_paths_brute(em[b, :n], start, stop, trans, n)
+        logz = np.logaddexp.reduce(list(scores.values()))
+        gold = scores[tuple(labels[b][:n])]
+        np.testing.assert_allclose(ll[b], gold - logz, rtol=1e-4,
+                                   atol=1e-4)
+        best = max(scores, key=scores.get)
+        np.testing.assert_array_equal(path[b][:n], best)
+        np.testing.assert_allclose(pscore[b], scores[best], rtol=1e-4,
+                                   atol=1e-4)
+        assert np.all(path[b][n:] == 0)
+
+
+def test_crf_trains():
+    """Gradient ascent on the CRF log-likelihood learns a toy tagging
+    rule (emissions + transition jointly)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(2)
+    B, T, C = 8, 5, 3
+    # rule: label = feature argmax, with a bias toward staying
+    feats = rng.randn(B, T, C).astype("float32")
+    labels = feats.argmax(-1).astype("int64")
+    lengths = np.full((B,), T)
+
+    w = np.eye(C, dtype="float32") * 0.1
+    transition = np.zeros((C + 2, C), "float32")
+    params = {"w": w, "tr": transition}
+
+    def nll(p):
+        em = feats @ p["w"]
+        return -SL.crf_log_likelihood(em, p["tr"], labels,
+                                      lengths).mean()
+
+    g0 = float(nll(params))
+    grad_fn = jax.jit(jax.grad(nll))
+    for _ in range(60):
+        g = grad_fn(params)
+        params = jax.tree_util.tree_map(
+            lambda a, b: a - 0.5 * b, params, g)
+    g1 = float(nll(params))
+    assert g1 < g0 * 0.5, (g0, g1)
+    # decoding with the learned params recovers the rule
+    em = feats @ params["w"]
+    path, _ = SL.crf_decode(jnp.asarray(em), params["tr"], lengths)
+    acc = (np.asarray(path) == labels).mean()
+    assert acc > 0.9, acc
+
+
+# ---------------- static-graph end-to-end (book capability) ----------------
+
+def test_static_crf_tagger_trains():
+    """label_semantic_roles book capability: embedding -> GRU ->
+    linear_chain_crf loss; crf_decoding recovers a learnable tag rule."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.lod import LoDTensor
+
+    V, C, EMB, H = 20, 3, 12, 12
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        words = fluid.layers.data("words", shape=[1], dtype="int64",
+                                  lod_level=1)
+        tags = fluid.layers.data("tags", shape=[1], dtype="int64",
+                                 lod_level=1)
+        emb = fluid.layers.embedding(words, size=[V, EMB])
+        proj = fluid.layers.fc(emb, size=3 * H, bias_attr=False)
+        hidden = fluid.layers.dynamic_gru(proj, size=H)
+        emission = fluid.layers.fc(hidden, size=C)
+        ll = fluid.layers.linear_chain_crf(
+            emission, tags, param_attr="crf_trans")
+        loss = fluid.layers.reduce_mean(-1.0 * ll, dim=[0, 1])
+        fluid.optimizer.Adam(0.02).minimize(loss)
+        path = fluid.layers.crf_decoding(emission,
+                                         param_attr="crf_trans")
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(3)
+
+    def batch():
+        lens = rng.randint(2, 6, size=6)
+        rows = [rng.randint(0, V, (n, 1)).astype("int64") for n in lens]
+        # learnable rule: tag = word id mod C
+        tag_rows = [(r % C).astype("int64") for r in rows]
+        return (LoDTensor.from_sequences(rows),
+                LoDTensor.from_sequences(tag_rows), rows, tag_rows)
+
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(80):
+            w, t, _, _ = batch()
+            losses.append(float(exe.run(
+                main, {"words": w, "tags": t}, [loss])[0]))
+        assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.5, (
+            losses[:3], losses[-3:])
+        # decode accuracy on a fresh batch
+        w, t, rows, tag_rows = batch()
+        decoded = exe.run(main, {"words": w, "tags": t}, [path],
+                          return_numpy=False)[0]
+        correct = total = 0
+        offs = 0
+        dec = np.asarray(decoded).reshape(-1)
+        for r, tr in zip(rows, tag_rows):
+            n = len(r)
+            correct += (dec[offs:offs + n] == tr[:, 0]).sum()
+            total += n
+            offs += n
+        assert correct / total > 0.8, correct / total
+
+
+def test_static_ctc_trains():
+    """OCR-style: conv features -> im2sequence is exercised separately;
+    here a dense feature sequence trains against CTC."""
+    import paddle_tpu.fluid as fluid
+
+    B, T, C, L = 4, 8, 6, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        feats = fluid.layers.data("feats", shape=[T, 10],
+                                  dtype="float32")
+        label = fluid.layers.data("label", shape=[L], dtype="int64")
+        llen = fluid.layers.data("llen", shape=[1], dtype="int32")
+        ilen = fluid.layers.data("ilen", shape=[1], dtype="int32")
+        logits = fluid.layers.fc(feats, size=C, num_flatten_dims=2)
+        loss = fluid.layers.reduce_mean(fluid.layers.warpctc(
+            logits, label, blank=0, input_length=ilen,
+            label_length=llen), dim=[0, 1])
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(4)
+    # learnable: feature pattern k -> emit token k+1
+    toks = rng.randint(1, C, (B, L)).astype("int64")
+    feats_np = np.zeros((B, T, 10), "float32")
+    for b in range(B):
+        for i, tk in enumerate(toks[b]):
+            feats_np[b, 2 * i + 1, tk % 10] = 2.0
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(60):
+            losses.append(float(exe.run(main, {
+                "feats": feats_np, "label": toks,
+                "llen": np.full((B, 1), L, "int32"),
+                "ilen": np.full((B, 1), T, "int32")}, [loss])[0]))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_im2sequence_shapes():
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 8, 8], dtype="float32")
+        seq = fluid.layers.im2sequence(img, filter_size=4, stride=4)
+    exe = fluid.Executor()
+    exe.run(startup)
+    x = np.random.RandomState(5).randn(2, 3, 8, 8).astype("float32")
+    (out,) = exe.run(main, {"img": x}, [seq])
+    assert out.shape == (2, 4, 3 * 16)
+    # first patch = top-left 4x4 block, channel-major
+    np.testing.assert_allclose(
+        out[0, 0].reshape(3, 4, 4), x[0, :, :4, :4], rtol=1e-6)
+
+
+@pytest.mark.parametrize("opt_name,steps,factor", [
+    ("Adagrad", 60, 0.6), ("RMSProp", 60, 0.6),
+    ("Adadelta", 250, 0.8),  # no lr: updates bootstrap from avg state
+    ("Adamax", 60, 0.6), ("Ftrl", 60, 0.6)])
+def test_static_optimizers_converge(opt_name, steps, factor):
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(
+            fluid.layers.fc(x, 1), y))
+        getattr(fluid.optimizer, opt_name)(0.05).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(6)
+    w = rng.randn(4, 1).astype("float32")
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            xb = rng.randn(16, 4).astype("float32")
+            losses.append(float(exe.run(
+                main, {"x": xb, "y": xb @ w}, [loss])[0]))
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) * factor, (
+        opt_name, losses[0], losses[-1])
+
+
+def test_functional_ctc_loss_and_lstm_unit():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.fluid as fluid
+    import jax
+
+    rng = np.random.RandomState(7)
+    T, B, C = 5, 2, 4
+    logits = rng.randn(T, B, C).astype("float32")
+    labels = np.array([[1, 2], [3, 0]], "int64")
+    lt = paddle.to_tensor(logits, stop_gradient=False)
+    loss = F.ctc_loss(lt, paddle.to_tensor(labels),
+                      paddle.to_tensor(np.array([5, 4], "int32")),
+                      paddle.to_tensor(np.array([2, 1], "int32")),
+                      reduction="sum")
+    # matches the kernel applied to log-softmaxed logits
+    lp = np.asarray(jax.nn.log_softmax(logits, -1))
+    want = float(np.asarray(SL.ctc_loss(
+        lp, labels, np.array([5, 4]), np.array([2, 1]))).sum())
+    np.testing.assert_allclose(float(loss.numpy()), want, rtol=1e-5)
+    loss.backward()
+    assert np.isfinite(np.asarray(lt.grad._data)).all()
+
+    # lstm_unit static op vs the reference formula (i, f, o, g order)
+    D = 3
+    x = rng.randn(2, 4 * D).astype("float32")
+    c_prev = rng.randn(2, D).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", shape=[4 * D], dtype="float32")
+        cv = fluid.layers.data("c", shape=[D], dtype="float32")
+        blk = main.global_block()
+        h = blk.create_var(name="h_out")
+        c = blk.create_var(name="c_out")
+        blk.append_op(type="lstm_unit",
+                      inputs={"X": [xv], "C_prev": [cv]},
+                      outputs={"H": [h.name], "C": [c.name]},
+                      attrs={"forget_bias": 1.0})
+    exe = fluid.Executor()
+    exe.run(startup)
+    hv, cvv = exe.run(main, {"x": x, "c": c_prev}, [h, c])
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    i = sig(x[:, :D])
+    f = sig(x[:, D:2 * D] + 1.0)
+    o = sig(x[:, 2 * D:3 * D])
+    g = np.tanh(x[:, 3 * D:])
+    c_want = f * c_prev + i * g
+    np.testing.assert_allclose(cvv, c_want, rtol=1e-5)
+    np.testing.assert_allclose(hv, o * np.tanh(c_want), rtol=1e-5)
+
+
+def test_crf_decoding_with_label_gives_mask():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.lod import LoDTensor
+
+    C = 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        em = fluid.layers.data("em", shape=[C], dtype="float32",
+                               lod_level=1)
+        lbl = fluid.layers.data("lbl", shape=[1], dtype="int64",
+                                lod_level=1)
+        ll = fluid.layers.linear_chain_crf(em, lbl, param_attr="trans2")
+        mask = fluid.layers.crf_decoding(em, param_attr="trans2",
+                                         label=lbl)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(8)
+    rows = [rng.randn(4, C).astype("float32"),
+            rng.randn(2, C).astype("float32")]
+    labels = [r.argmax(-1)[:, None].astype("int64") for r in rows]
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        out = exe.run(main, {"em": LoDTensor.from_sequences(rows),
+                             "lbl": LoDTensor.from_sequences(labels)},
+                      [mask], return_numpy=False)[0]
+    vals = np.asarray(out).reshape(-1)
+    assert set(np.unique(vals)).issubset({0, 1})
