@@ -166,3 +166,98 @@ def accuracy(input, label, k=1):
     _, idx = jax.lax.top_k(p, k)
     correct = (idx == l[..., None]).any(axis=-1)
     return Tensor._wrap(correct.mean(dtype=jnp.float32))
+
+
+class ChunkEvaluator(Metric):
+    """Chunking F1 over BIO tag sequences (fluid/metrics.py
+    ChunkEvaluator + chunk_eval_op capability): update() takes
+    (num_infer_chunks, num_label_chunks, num_correct_chunks) or computes
+    them from (pred_tags, label_tags, lengths) with the IOB scheme."""
+
+    def __init__(self, num_chunk_types=None, name=None):
+        super().__init__(name or "chunk")
+        self.num_chunk_types = num_chunk_types
+        self.reset()
+
+    def reset(self):
+        self.num_infer = 0
+        self.num_label = 0
+        self.num_correct = 0
+
+    @staticmethod
+    def extract_chunks(tags, num_chunk_types):
+        """IOB tags (0..2T-1 with even=B-x, odd=I-x; O = any id >= 2T)
+        -> set of (start, end, type). conlleval semantics: an I tag with
+        no live chunk of its type BEGINS one (stray-I tolerant, like the
+        reference chunk_eval)."""
+        if num_chunk_types is None:
+            raise ValueError(
+                "extract_chunks needs num_chunk_types to tell O tags "
+                "apart from chunk tags")
+        chunks = []
+        start = ctype = None
+        tags = list(tags)
+        for i, t in enumerate(tags):
+            t = int(t)
+            typ = t // 2
+            is_o = typ >= num_chunk_types
+            is_b = (not is_o) and t % 2 == 0
+            ends = start is not None and (is_o or is_b or typ != ctype)
+            if ends:
+                chunks.append((start, i - 1, ctype))
+                start = ctype = None
+            if not is_o and start is None:  # B, or stray/other-type I
+                start, ctype = i, typ
+        if start is not None:
+            chunks.append((start, len(tags) - 1, ctype))
+        return set(chunks)
+
+    def update(self, *args):
+        if len(args) == 3 and np.ndim(args[0]) == 0:
+            infer, label, correct = args
+            self.num_infer += int(infer)
+            self.num_label += int(label)
+            self.num_correct += int(correct)
+            return
+        pred, gold, lengths = args
+        if self.num_chunk_types is None:
+            raise ValueError(
+                "ChunkEvaluator(num_chunk_types=...) is required for "
+                "tag-sequence updates (count-tuple updates work without)")
+        pred, gold = _np(pred), _np(gold)
+        lengths = _np(lengths).reshape(-1).astype(int)
+        for b, n in enumerate(lengths):
+            pc = self.extract_chunks(pred[b][:n], self.num_chunk_types)
+            gc = self.extract_chunks(gold[b][:n], self.num_chunk_types)
+            self.num_infer += len(pc)
+            self.num_label += len(gc)
+            self.num_correct += len(pc & gc)
+
+    def accumulate(self):
+        p = self.num_correct / self.num_infer if self.num_infer else 0.0
+        r = self.num_correct / self.num_label if self.num_label else 0.0
+        f1 = 2 * p * r / (p + r) if p + r else 0.0
+        return p, r, f1
+
+
+class CompositeMetric(Metric):
+    """fluid/metrics.py CompositeMetric parity: fan one update out to
+    several sub-metrics."""
+
+    def __init__(self, *metrics, name=None):
+        super().__init__(name or "composite")
+        self._metrics = list(metrics)
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, *args):
+        for m in self._metrics:
+            m.update(*args)
+
+    def accumulate(self):
+        return [m.accumulate() for m in self._metrics]

@@ -1,0 +1,165 @@
+"""Greedy + beam search decoders vs brute-force enumeration.
+
+Reference analogue: test_beam_search_op.py / test_beam_search_decode_op
+— beam contents checked against exhaustive scoring on a tiny Markov
+language model.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text.decode import beam_search, greedy_search
+
+
+def _markov_step(trans):
+    """step_fn over a fixed Markov transition table [V, V] of logits."""
+    import jax.numpy as jnp
+
+    tbl = jnp.asarray(trans)
+
+    def step_fn(tokens, state):
+        return tbl[tokens], state
+
+    return step_fn
+
+
+def _brute_best(trans, bos, eos, max_len, k):
+    """Exhaustively score every sequence of length <= max_len."""
+    import jax
+
+    V = trans.shape[0]
+    lp = np.asarray(jax.nn.log_softmax(trans, -1))
+    scored = {}
+    for L in range(1, max_len + 1):
+        for seq in itertools.product(range(V), repeat=L):
+            # must not contain EOS except (optionally) at the very end
+            if any(s == eos for s in seq[:-1]):
+                continue
+            s = 0.0
+            prev = bos
+            for t in seq:
+                s += lp[prev, t]
+                prev = t
+            if seq[-1] == eos:
+                scored[seq] = s
+            elif L == max_len:
+                scored[seq] = s  # ran to the horizon unfinished
+    return sorted(scored.items(), key=lambda kv: -kv[1])[:k]
+
+
+def test_greedy_matches_argmax_chain():
+    rng = np.random.RandomState(0)
+    V, eos, bos = 6, 0, 1
+    trans = rng.randn(V, V).astype("float32") * 2
+    toks, lens = greedy_search(_markov_step(trans), (), 3, bos, eos, 5)
+    toks = np.asarray(toks)
+    # replay the argmax chain manually
+    for b in range(3):
+        prev, done = bos, False
+        for t in range(5):
+            want = trans[prev].argmax() if not done else eos
+            assert toks[b, t] == want
+            done = done or want == eos
+            prev = want
+
+
+def test_beam_finds_higher_probability_than_greedy():
+    """Craft a distribution where the greedy first step is a trap."""
+    import jax
+
+    V, bos, eos = 4, 1, 0
+    trans = np.full((V, V), -5.0, "float32")
+    trans[1, 2] = 1.0    # greedy takes 2 ...
+    trans[1, 3] = 0.9    # ... slightly better long-run goes through 3
+    trans[2, 0] = -2.0   # then has to pay to finish
+    trans[3, 0] = 3.0    # 3 finishes cheaply
+    step = _markov_step(trans)
+    g_toks, _ = greedy_search(step, (), 1, bos, eos, 3)
+    seqs, scores, lens = beam_search(step, (), 1, bos, eos,
+                                     beam_size=3, max_len=3)
+    seqs, scores = np.asarray(seqs), np.asarray(scores)
+    lp = np.asarray(jax.nn.log_softmax(trans, -1))
+
+    def score(seq):
+        s, prev = 0.0, bos
+        for t in seq:
+            s += lp[prev, t]
+            prev = t
+            if t == eos:
+                break
+        return s
+
+    greedy_score = score(list(np.asarray(g_toks)[0]))
+    assert scores[0, 0] > greedy_score + 1e-4
+    np.testing.assert_array_equal(seqs[0, 0][:2], [3, 0])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_beam_matches_bruteforce_topk(seed):
+    rng = np.random.RandomState(seed)
+    V, bos, eos, L, K = 5, 1, 0, 4, 3
+    trans = (rng.randn(V, V) * 1.5).astype("float32")
+    seqs, scores, lens = beam_search(_markov_step(trans), (), 1, bos,
+                                     eos, beam_size=K, max_len=L)
+    seqs, scores, lens = (np.asarray(seqs), np.asarray(scores),
+                          np.asarray(lens))
+    want = _brute_best(trans, bos, eos, L, K)
+    # the TOP beam must be the global best sequence
+    best_seq, best_score = want[0]
+    got = tuple(seqs[0, 0][:len(best_seq)])
+    assert got == best_seq, (got, best_seq, want[:3])
+    np.testing.assert_allclose(scores[0, 0], best_score, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_beam_batch_and_state_gather():
+    """Per-beam state must follow beam reshuffling: use a counter state
+    that each step increments by the token value; at the end the state
+    must equal the token-sum of ITS OWN beam's history."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(3)
+    V, bos, eos, B, K, L = 5, 1, 0, 2, 3, 4
+    trans = (rng.randn(V, V) * 1.5).astype("float32")
+    tbl = jnp.asarray(trans)
+
+    def step_fn(tokens, state):
+        return tbl[tokens], state + tokens
+
+    seqs, scores, lens, state = beam_search(
+        step_fn, jnp.zeros((B,), jnp.int32), B, bos, eos, K, L,
+        return_state=True)
+    seqs, lens = np.asarray(seqs), np.asarray(lens)
+    assert seqs.shape == (B, K, L)
+    # scores strictly ordered best-first
+    s = np.asarray(scores)
+    assert np.all(np.diff(s, axis=1) <= 1e-6)
+    # the regathered per-beam state equals the token-sum of ITS OWN
+    # history (counter state: prev-token added each step, incl. bos)
+    state = np.asarray(state).reshape(B, K)
+    for b in range(B):
+        for k in range(K):
+            want = bos  # first step adds the bos input token
+            prev = [bos] + list(seqs[b, k][:-1])
+            want = sum(prev)
+            np.testing.assert_equal(state[b, k], want)
+
+
+def test_beam_jits():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(4)
+    trans = (rng.randn(6, 6)).astype("float32")
+    tbl = jnp.asarray(trans)
+
+    @jax.jit
+    def decode(t):
+        return beam_search(lambda tok, st: (t[tok], st), (), 2, 1, 0,
+                           beam_size=4, max_len=6)
+
+    seqs, scores, lens = decode(tbl)
+    assert np.asarray(seqs).shape == (2, 4, 6)
+    assert np.isfinite(np.asarray(scores)).all()

@@ -373,3 +373,22 @@ def test_crf_decoding_with_label_gives_mask():
                       [mask], return_numpy=False)[0]
     vals = np.asarray(out).reshape(-1)
     assert set(np.unique(vals)).issubset({0, 1})
+
+
+def test_chunk_evaluator():
+    from paddle_tpu.metric import ChunkEvaluator
+
+    ce = ChunkEvaluator(num_chunk_types=2)
+    # tags: B-0=0, I-0=1, B-1=2, I-1=3, O=4 (num_chunk_types=2)
+    gold = np.array([[0, 1, 4, 2, 3, 4]])
+    pred = np.array([[0, 1, 4, 2, 4, 4]])  # second chunk truncated
+    assert ChunkEvaluator.extract_chunks(gold[0], 2) == {
+        (0, 1, 0), (3, 4, 1)}
+    ce.update(pred, gold, np.array([6]))
+    p, r, f1 = ce.accumulate()
+    assert p == 0.5 and r == 0.5 and abs(f1 - 0.5) < 1e-9
+    # counting form
+    ce.reset()
+    ce.update(4, 5, 3)
+    p, r, f1 = ce.accumulate()
+    assert abs(p - 3 / 4) < 1e-9 and abs(r - 3 / 5) < 1e-9
