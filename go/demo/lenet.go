@@ -1,0 +1,47 @@
+// Demo: run a saved LeNet/MNIST inference model through the Go client
+// (reference parity: go/demo/mobilenet.go).
+//
+// Usage:
+//
+//	CGO_LDFLAGS="-L../../csrc/build/lib -lptcore" go run lenet.go <model_dir>
+package main
+
+import (
+	"fmt"
+	"os"
+
+	"paddle_tpu/go/paddle"
+)
+
+func main() {
+	if len(os.Args) < 2 {
+		fmt.Println("usage: lenet <model_dir>")
+		os.Exit(1)
+	}
+	cfg := paddle.NewConfig()
+	cfg.SetModel(os.Args[1])
+	pred, err := paddle.NewPredictor(cfg)
+	if err != nil {
+		panic(err)
+	}
+	defer pred.Destroy()
+
+	in := paddle.NewTensor([]int64{1, 1, 28, 28},
+		make([]float32, 28*28))
+	pred.SetInput(pred.InputNames()[0], in)
+	outs, err := pred.Run()
+	if err != nil {
+		panic(err)
+	}
+	for i, t := range outs {
+		fmt.Printf("output %d shape=%v first=%v\n", i, t.Shape,
+			t.Data[:min(4, len(t.Data))])
+	}
+}
+
+func min(a, b int) int {
+	if a < b {
+		return a
+	}
+	return b
+}

@@ -1,0 +1,44 @@
+// Package paddle is the Go inference client for paddle_tpu's native
+// predictor (csrc/ptcore NaiveExecutor engine).
+//
+// Reference parity: go/paddle/{config,predictor,tensor}.go — a cgo wrapper
+// over the C ABI. Here the ABI is ptcore's pt_pred_* surface
+// (csrc/ptcore/executor.cc:628); build libptcore.so first (cmake+ninja in
+// csrc/, or the auto-build in paddle_tpu.core.native), then:
+//
+//	CGO_CFLAGS="-I${REPO}/go/paddle" \
+//	CGO_LDFLAGS="-L${REPO}/csrc/build/lib -lptcore" \
+//	go build ./...
+package paddle
+
+// #cgo LDFLAGS: -lptcore
+// #include <stdint.h>
+// #include <stdlib.h>
+// void* pt_pred_create(const char* model_dir);
+// const char* pt_pred_error(void* h);
+// int pt_pred_feed_count(void* h);
+// const char* pt_pred_feed_name(void* h, int i);
+// int pt_pred_fetch_count(void* h);
+// const char* pt_pred_fetch_name(void* h, int i);
+import "C"
+
+import "unsafe"
+
+// Config selects a saved-inference-model directory (the durable
+// `__model__` + params artifact written by save_inference_model /
+// paddle.jit.save).
+type Config struct {
+	modelDir string
+}
+
+func NewConfig() *Config { return &Config{} }
+
+// SetModel points the config at a model directory.
+func (c *Config) SetModel(modelDir string) { c.modelDir = modelDir }
+
+// ModelDir returns the configured model directory.
+func (c *Config) ModelDir() string { return c.modelDir }
+
+func cString(s string) *C.char { return C.CString(s) }
+
+func freeCString(p *C.char) { C.free(unsafe.Pointer(p)) }

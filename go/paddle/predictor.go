@@ -1,0 +1,124 @@
+package paddle
+
+// #cgo LDFLAGS: -lptcore
+// #include <stdint.h>
+// #include <stdlib.h>
+// void* pt_pred_create(const char* model_dir);
+// const char* pt_pred_error(void* h);
+// int pt_pred_feed_count(void* h);
+// const char* pt_pred_feed_name(void* h, int i);
+// int pt_pred_fetch_count(void* h);
+// const char* pt_pred_fetch_name(void* h, int i);
+// void pt_pred_set_input(void* h, const char* name, const int64_t* dims,
+//                        int ndim, const float* data);
+// int pt_pred_run(void* h);
+// int pt_pred_out_ndim(void* h, int i);
+// void pt_pred_out_dims(void* h, int i, int64_t* out);
+// int pt_pred_out_is_int(void* h, int i);
+// void pt_pred_out_copy(void* h, int i, void* out);
+// void pt_pred_destroy(void* h);
+import "C"
+
+import (
+	"errors"
+	"runtime"
+	"unsafe"
+)
+
+// Predictor runs a saved inference model through the native C++ engine.
+type Predictor struct {
+	h unsafe.Pointer
+}
+
+// NewPredictor loads the model named by cfg and prepares the executor.
+func NewPredictor(cfg *Config) (*Predictor, error) {
+	cdir := cString(cfg.ModelDir())
+	defer freeCString(cdir)
+	h := C.pt_pred_create(cdir)
+	p := &Predictor{h: h}
+	if msg := C.GoString(C.pt_pred_error(h)); msg != "" {
+		C.pt_pred_destroy(h)
+		return nil, errors.New("paddle: " + msg)
+	}
+	runtime.SetFinalizer(p, func(p *Predictor) { p.Destroy() })
+	return p, nil
+}
+
+// Destroy releases the native predictor.
+func (p *Predictor) Destroy() {
+	if p.h != nil {
+		C.pt_pred_destroy(p.h)
+		p.h = nil
+	}
+}
+
+// InputNames lists the model's feed variable names, in feed order.
+func (p *Predictor) InputNames() []string {
+	n := int(C.pt_pred_feed_count(p.h))
+	out := make([]string, n)
+	for i := 0; i < n; i++ {
+		out[i] = C.GoString(C.pt_pred_feed_name(p.h, C.int(i)))
+	}
+	runtime.KeepAlive(p)
+	return out
+}
+
+// OutputNames lists the model's fetch variable names, in fetch order.
+func (p *Predictor) OutputNames() []string {
+	n := int(C.pt_pred_fetch_count(p.h))
+	out := make([]string, n)
+	for i := 0; i < n; i++ {
+		out[i] = C.GoString(C.pt_pred_fetch_name(p.h, C.int(i)))
+	}
+	runtime.KeepAlive(p)
+	return out
+}
+
+// SetInput binds a float32 tensor to the named feed variable.
+func (p *Predictor) SetInput(name string, t *Tensor) {
+	cname := cString(name)
+	defer freeCString(cname)
+	C.pt_pred_set_input(p.h, cname,
+		(*C.int64_t)(unsafe.Pointer(&t.Shape[0])), C.int(len(t.Shape)),
+		(*C.float)(unsafe.Pointer(&t.Data[0])))
+	runtime.KeepAlive(p)
+	runtime.KeepAlive(t)
+}
+
+// Run executes the model and returns every fetch output.
+func (p *Predictor) Run() ([]*Tensor, error) {
+	if C.pt_pred_run(p.h) != 0 {
+		return nil, errors.New("paddle: " + C.GoString(C.pt_pred_error(p.h)))
+	}
+	n := int(C.pt_pred_fetch_count(p.h))
+	outs := make([]*Tensor, n)
+	for i := 0; i < n; i++ {
+		nd := int(C.pt_pred_out_ndim(p.h, C.int(i)))
+		shape := make([]int64, nd)
+		if nd > 0 {
+			C.pt_pred_out_dims(p.h, C.int(i),
+				(*C.int64_t)(unsafe.Pointer(&shape[0])))
+		}
+		numel := int64(1)
+		for _, d := range shape {
+			numel *= d
+		}
+		t := &Tensor{Shape: shape}
+		if C.pt_pred_out_is_int(p.h, C.int(i)) != 0 {
+			t.Ints = make([]int64, numel)
+			if numel > 0 {
+				C.pt_pred_out_copy(p.h, C.int(i),
+					unsafe.Pointer(&t.Ints[0]))
+			}
+		} else {
+			t.Data = make([]float32, numel)
+			if numel > 0 {
+				C.pt_pred_out_copy(p.h, C.int(i),
+					unsafe.Pointer(&t.Data[0]))
+			}
+		}
+		outs[i] = t
+	}
+	runtime.KeepAlive(p)
+	return outs, nil
+}

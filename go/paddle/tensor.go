@@ -1,0 +1,27 @@
+package paddle
+
+// Tensor is a host-side dense tensor exchanged with the predictor.
+// Float32 inputs only (the native engine's feed dtype; int64 feeds are
+// cast server-side), float32 or int64 outputs.
+type Tensor struct {
+	Shape []int64
+	Data  []float32 // set for float outputs/inputs
+	Ints  []int64   // set for int64 outputs
+}
+
+// NewTensor builds a float32 input tensor.
+func NewTensor(shape []int64, data []float32) *Tensor {
+	return &Tensor{Shape: shape, Data: data}
+}
+
+// Numel returns the element count implied by Shape.
+func (t *Tensor) Numel() int64 {
+	n := int64(1)
+	for _, d := range t.Shape {
+		n *= d
+	}
+	return n
+}
+
+// IsInt reports whether the tensor holds int64 data.
+func (t *Tensor) IsInt() bool { return t.Ints != nil }

@@ -125,6 +125,11 @@ def program_to_proto(program):
     m = messages()
     pb = m.ProgramDesc()
     pb.version = 1
+    from ..fluid.op_version import program_op_versions
+
+    for name, ver in sorted(program_op_versions(program).items()):
+        pair = pb.op_version_map.add()
+        pair.op_name, pair.version = name, ver
     for block in program.blocks:
         bpb = pb.blocks.add()
         bpb.idx = block.idx
@@ -169,6 +174,9 @@ def proto_to_program(pb, program_cls=None):
     from ..fluid.framework import Program
 
     program_cls = program_cls or Program
+    from ..fluid.op_version import check_compatible
+
+    check_compatible({p.op_name: p.version for p in pb.op_version_map})
     prog = program_cls()
     # ensure enough blocks exist, with recorded parents
     for bpb in pb.blocks:

@@ -15,6 +15,7 @@ from __future__ import annotations
 import os
 
 from ...core.tensor import Tensor
+from . import metrics  # noqa: F401  (fleet.metrics.sum/max/auc/...)
 from .strategy import DistributedStrategy  # noqa: F401
 from .role_maker import (PaddleCloudRoleMaker, Role,  # noqa: F401
                          UserDefinedRoleMaker)
@@ -206,9 +207,9 @@ server_endpoints = fleet.server_endpoints
 
 class UtilBase:
     def all_reduce(self, input, mode="sum"):
-        import numpy as np
+        from . import metrics as _m
 
-        return input
+        return _m._all_reduce(input, mode)
 
     def barrier(self):
         fleet.barrier_worker()

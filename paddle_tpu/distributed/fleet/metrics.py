@@ -1,0 +1,171 @@
+"""Fleet distributed metrics: sum/max/min/auc/mae/rmse/mse/acc aggregated
+over all trainers.
+
+Reference parity: python/paddle/distributed/fleet/metrics/metric.py (gloo /
+pslib allreduce of numpy stats) — each trainer holds local metric buckets
+(e.g. the stat_pos/stat_neg outputs of the auc op) and the fleet metric
+reduces them across workers before the final formula.
+
+TPU-native design: the reduction is HOST-side (these are CPU numpy stats,
+not device tensors) over the KV rendezvous store
+(paddle_tpu.distributed.rendezvous.TCPStore — the gloo-store equivalent),
+so it works in PS mode, collective mode, and single-process mode alike.
+Call `init_metric_context(store, rank, world)` once per trainer, or set
+`PT_METRIC_STORE=<host:port>` (+ the standard PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM env the launcher already exports) and the context
+auto-connects on first use. With no context configured, world=1 semantics
+apply (a no-op reduce).
+"""
+from __future__ import annotations
+
+import base64
+import builtins
+import os
+
+import numpy as np
+
+_CTX = {"store": None, "rank": 0, "world": 1, "round": 0, "env_tried": False}
+
+
+def init_metric_context(store, rank, world):
+    """Install the cross-trainer reduce context (a rendezvous store)."""
+    _CTX.update(store=store, rank=int(rank), world=int(world), round=0,
+                env_tried=True)
+
+
+def _maybe_init_from_env():
+    if _CTX["store"] is not None or _CTX["env_tried"]:
+        return
+    _CTX["env_tried"] = True
+    ep = os.environ.get("PT_METRIC_STORE")
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if not ep or world <= 1:
+        return
+    from ..rendezvous import TCPStore
+
+    host, port = ep.rsplit(":", 1)
+    _CTX.update(
+        store=TCPStore(host, int(port), is_master=False, world_size=world),
+        rank=int(os.environ.get("PADDLE_TRAINER_ID", "0")), world=world)
+
+
+def _resolve(x, scope):
+    """numpy array | paddle Tensor | fluid Variable | var-name string."""
+    if isinstance(x, str):
+        name = x
+    elif hasattr(x, "name") and not hasattr(x, "__array__") and not hasattr(
+            x, "_data"):
+        name = x.name  # fluid Variable
+    else:
+        if hasattr(x, "_data"):
+            return np.asarray(x._data)
+        return np.asarray(x)
+    if scope is None:
+        from ...fluid.executor import global_scope
+
+        scope = global_scope()
+    var = scope.find_var(name)
+    if var is None:
+        raise KeyError(f"fleet.metrics: variable {name!r} not in scope")
+    return np.asarray(var.get_tensor())
+
+
+def _all_reduce(arr, mode="sum"):
+    """Host-side allreduce of a numpy array across trainers via the KV
+    store: every rank publishes its buffer, every rank reduces all of
+    them (symmetric, no root)."""
+    _maybe_init_from_env()
+    store, rank, world = _CTX["store"], _CTX["rank"], _CTX["world"]
+    arr = np.asarray(arr, np.float64)
+    if store is None or world <= 1:
+        return arr
+    rnd = _CTX["round"]
+    _CTX["round"] = rnd + 1
+    # PT_METRIC_NS namespaces key rounds per job incarnation so an elastic
+    # restart against a long-lived store cannot read a crashed run's
+    # leftover buffers (launcher exports one value to every rank)
+    ns = os.environ.get("PT_METRIC_NS", "")
+    key = f"__fleet_metric_{ns}_{rnd}"
+    store.set(f"{key}_{rank}",
+              base64.b64encode(arr.astype("<f8").tobytes()).decode())
+    parts = []
+    for r in range(world):
+        raw = base64.b64decode(store.get(f"{key}_{r}"))
+        parts.append(np.frombuffer(raw, "<f8").reshape(arr.shape))
+    op = {"sum": np.add, "max": np.maximum, "min": np.minimum}[mode]
+    out = parts[0]
+    for p in parts[1:]:
+        out = op(out, p)
+    # bounded store: last reader deletes the round's keys (every rank
+    # bumps a done-counter once it has read all parts)
+    if hasattr(store, "add") and hasattr(store, "delete"):
+        done = store.add(f"{key}__done", 1)
+        if done >= world:
+            for r in range(world):
+                store.delete(f"{key}_{r}")
+            store.delete(f"{key}__done")
+    return out
+
+
+def sum(input, scope=None):  # noqa: A001 — reference API name
+    """Distributed sum of a local stat array."""
+    return _all_reduce(_resolve(input, scope), "sum")
+
+
+def max(input, scope=None):  # noqa: A001
+    """Distributed elementwise max of a local stat array."""
+    return _all_reduce(_resolve(input, scope), "max")
+
+
+def min(input, scope=None):  # noqa: A001
+    """Distributed elementwise min of a local stat array."""
+    return _all_reduce(_resolve(input, scope), "min")
+
+
+def auc(stat_pos, stat_neg, scope=None):
+    """Distributed AUC from the bucketed stat_pos/stat_neg outputs of the
+    auc op: allreduce both histograms, then integrate the ROC area
+    bucket-by-bucket from the highest threshold down."""
+    pos = _all_reduce(_resolve(stat_pos, scope).reshape(-1), "sum")
+    neg = _all_reduce(_resolve(stat_neg, scope).reshape(-1), "sum")
+    area = tp = fp = 0.0
+    total = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_tp = tp + pos[i]
+        new_fp = fp + neg[i]
+        total += pos[i] + neg[i]
+        area += (new_fp - fp) * (tp + new_tp) / 2.0
+        tp, fp = new_tp, new_fp
+    if tp * fp == 0 or total == 0:
+        return 0.5
+    return float(area / (tp * fp))
+
+
+def _reduced_scalar(x, scope):
+    return float(_all_reduce(_resolve(x, scope).reshape(-1), "sum").sum())
+
+
+def mae(abserr, total_ins_num, scope=None):
+    """Distributed mean absolute error from (sum |err|, instance count)."""
+    err = _reduced_scalar(abserr, scope)
+    n = _reduced_scalar(total_ins_num, scope)
+    return err / builtins.max(n, 1.0)
+
+
+def rmse(sqrerr, total_ins_num, scope=None):
+    """Distributed root mean squared error from (sum err^2, count)."""
+    return float(np.sqrt(mse(sqrerr, total_ins_num, scope)))
+
+
+def mse(sqrerr, total_ins_num, scope=None):
+    """Distributed mean squared error from (sum err^2, count)."""
+    err = _reduced_scalar(sqrerr, scope)
+    n = _reduced_scalar(total_ins_num, scope)
+    return err / builtins.max(n, 1.0)
+
+
+def acc(correct, total, scope=None):
+    """Distributed accuracy from (correct count, total count)."""
+    c = _reduced_scalar(correct, scope)
+    t = _reduced_scalar(total, scope)
+    return c / builtins.max(t, 1.0)

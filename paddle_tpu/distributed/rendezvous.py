@@ -83,6 +83,10 @@ class _KVHandler(socketserver.StreamRequestHandler):
                 self.wfile.write(
                     json.dumps({"ok": True, "value": cur}).encode() +
                     b"\n")
+            elif op == "delete":
+                with self.server.mu:  # type: ignore[attr-defined]
+                    store.pop(req["key"], None)
+                self.wfile.write(b'{"ok": true}\n')
             else:
                 self.wfile.write(json.dumps(
                     {"ok": False,
@@ -141,6 +145,9 @@ class TCPStore:
     def add(self, key, value=1):
         return self._rpc({"op": "add", "key": key,
                           "value": value})["value"]
+
+    def delete(self, key):
+        self._rpc({"op": "delete", "key": key})
 
     def barrier(self, name="barrier", timeout=None):
         # cohort-based: my arrival number k (SERVER-side counter, so a

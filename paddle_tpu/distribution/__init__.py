@@ -47,7 +47,11 @@ class Uniform(Distribution):
         jnp = _jnp()
         shape = tuple(shape) + tuple(jnp.broadcast_shapes(
             self.low._data.shape, self.high._data.shape))
-        u = jax.random.uniform(_random.next_key(), shape)
+        u = jax.random.uniform(_random.next_key(), shape,
+                               dtype=(self.low._data.dtype
+                                      if jnp.issubdtype(self.low._data.dtype,
+                                                        jnp.floating)
+                                      else jnp.float32))
         return Tensor._wrap(self.low._data + u * (self.high._data -
                                                   self.low._data))
 
@@ -75,7 +79,11 @@ class Normal(Distribution):
         jnp = _jnp()
         shape = tuple(shape) + tuple(jnp.broadcast_shapes(
             self.loc._data.shape, self.scale._data.shape))
-        z = jax.random.normal(_random.next_key(), shape)
+        z = jax.random.normal(_random.next_key(), shape,
+                              dtype=(self.loc._data.dtype
+                                     if jnp.issubdtype(self.loc._data.dtype,
+                                                       jnp.floating)
+                                     else jnp.float32))
         return Tensor._wrap(self.loc._data + z * self.scale._data)
 
     def log_prob(self, value):

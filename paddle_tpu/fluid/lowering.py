@@ -1424,6 +1424,9 @@ from . import lowering_seq  # noqa: E402,F401
 # detection-op lowerings register themselves on import
 from . import lowering_detection  # noqa: E402,F401
 
+# batch-3 general-purpose op surface registers itself on import
+from . import lowering_batch3  # noqa: E402,F401
+
 
 # ====== book-era op additions (fluid/layers/nn.py 15.2k surface) ======
 

@@ -171,8 +171,11 @@ def accuracy(input, label, k=1):
 class ChunkEvaluator(Metric):
     """Chunking F1 over BIO tag sequences (fluid/metrics.py
     ChunkEvaluator + chunk_eval_op capability): update() takes
-    (num_infer_chunks, num_label_chunks, num_correct_chunks) or computes
-    them from (pred_tags, label_tags, lengths) with the IOB scheme."""
+    (num_infer_chunks, num_label_chunks, num_correct_chunks) — scalars or
+    size-1 arrays, as the chunk_eval op emits — or computes them from
+    (pred_tags [B, T], label_tags [B, T], lengths [B]) with the IOB
+    scheme. Tag sequences must be 2-D (batched); that is what makes the
+    two forms unambiguous."""
 
     def __init__(self, num_chunk_types=None, name=None):
         super().__init__(name or "chunk")
@@ -213,11 +216,17 @@ class ChunkEvaluator(Metric):
         return set(chunks)
 
     def update(self, *args):
-        if len(args) == 3 and np.ndim(args[0]) == 0:
+        # count-tuple form: three scalar chunk counts, as emitted by the
+        # chunk_eval op — 0-d scalars or size-1 arrays (fluid fetch results
+        # arrive shaped (1,))
+        if len(args) == 3 and np.ndim(args[0]) <= 1 and all(
+                np.size(a) == 1 and np.ndim(a) <= 1 for a in args):
+            # tag-sequence updates are always 2-D [B, T]; three size-1
+            # low-rank values can only be the count-tuple form
             infer, label, correct = args
-            self.num_infer += int(infer)
-            self.num_label += int(label)
-            self.num_correct += int(correct)
+            self.num_infer += int(np.asarray(infer).ravel()[0])
+            self.num_label += int(np.asarray(label).ravel()[0])
+            self.num_correct += int(np.asarray(correct).ravel()[0])
             return
         pred, gold, lengths = args
         if self.num_chunk_types is None:
@@ -225,6 +234,11 @@ class ChunkEvaluator(Metric):
                 "ChunkEvaluator(num_chunk_types=...) is required for "
                 "tag-sequence updates (count-tuple updates work without)")
         pred, gold = _np(pred), _np(gold)
+        if pred.ndim != 2:
+            raise ValueError(
+                "ChunkEvaluator tag-sequence updates take 2-D [B, T] "
+                f"pred/label tags (got ndim={pred.ndim}); pass counts as "
+                "three scalars/size-1 arrays instead")
         lengths = _np(lengths).reshape(-1).astype(int)
         for b, n in enumerate(lengths):
             pc = self.extract_chunks(pred[b][:n], self.num_chunk_types)

@@ -159,7 +159,8 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     def fn(a):
         jnp = _jnp()
         g = -jnp.log(-jnp.log(
-            jax.random.uniform(key, a.shape, minval=1e-20, maxval=1.0)))
+            jax.random.uniform(key, a.shape, dtype=jnp.float32,
+                               minval=1e-20, maxval=1.0)))
         y = jax.nn.softmax((a + g) / temperature, axis=axis)
         if hard:
             idx = y.argmax(axis=axis, keepdims=True)
@@ -418,7 +419,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             shape = [a.shape[i] if i in (
                 axis if isinstance(axis, (list, tuple)) else [axis])
                 else 1 for i in range(a.ndim)]
-            mask = jax.random.bernoulli(key, keep, tuple(shape))
+            mask = jax.random.bernoulli(key, jnp.float32(keep), tuple(shape))
             scale_v = (1.0 / keep) if mode == "upscale_in_train" else 1.0
             return jnp.where(mask, a * scale_v, 0.0).astype(a.dtype)
         return _op("dropout", fn, x)
@@ -442,7 +443,7 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     def fn(a):
         jnp = _jnp()
         keep = 1.0 - p
-        mask = jax.random.bernoulli(key, keep, a.shape)
+        mask = jax.random.bernoulli(key, jnp.float32(keep), a.shape)
         a_coef = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
         b_coef = -a_coef * alpha_p * (1 - keep)
         return (a_coef * jnp.where(mask, a, alpha_p) + b_coef).astype(a.dtype)
@@ -687,22 +688,36 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW",
                 name=None):
     t_ = _t(x)
-    h, w = t_.shape[2], t_.shape[3]
+    spatial = t_.ndim - 2
     if size is not None:
         if isinstance(size, Tensor):
             size = [int(s) for s in size.numpy()]
-        oh, ow = int(size[0]), int(size[1])
+        out = [int(s) for s in (size if isinstance(size, (list, tuple))
+                                else [size] * spatial)]
     else:
         sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
-            (scale_factor, scale_factor)
-        oh, ow = int(h * sf[0]), int(w * sf[1])
+            (scale_factor,) * spatial
+        out = [int(d * s) for d, s in zip(t_.shape[2:], sf)]
     if mode == "nearest":
+        if spatial != 2:
+            raise NotImplementedError("nearest interpolate is 2-D")
         return _op("interp_nearest",
-                   lambda a: K.interpolate_nearest(a, (oh, ow)), t_)
-    if mode in ("bilinear", "linear"):
+                   lambda a: K.interpolate_nearest(a, tuple(out)), t_)
+    if mode == "bilinear" or (mode == "linear" and spatial == 2):
         return _op("interp_bilinear",
-                   lambda a: K.interpolate_bilinear(a, (oh, ow),
+                   lambda a: K.interpolate_bilinear(a, tuple(out),
                                                     align_corners), t_)
+    if mode in ("linear", "trilinear"):
+        from ...fluid.lowering_batch3 import _linear_nd
+
+        return _op("interp_linear",
+                   lambda a: _linear_nd(a, out, align_corners), t_)
+    if mode == "bicubic":
+        from ...fluid.lowering_batch3 import _cubic_nd
+
+        return _op("interp_bicubic",
+                   lambda a: _cubic_nd(a, out, align_corners).astype(
+                       a.dtype), t_)
     raise NotImplementedError(f"interpolate mode {mode}")
 
 

@@ -570,10 +570,45 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, weight_shape, axis=0, power_iters=1, epsilon=1e-12,
-                 name=None):
+    """Spectral weight normalization (spectral_norm_op.cc): divides the
+    weight by its largest singular value, estimated by `power_iters`
+    rounds of power iteration on persistent u/v vectors."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None, dtype="float32"):
         super().__init__()
-        raise NotImplementedError("SpectralNorm lands with the GAN op set")
+        import numpy as np
+
+        from ...core.tensor import to_tensor
+
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        rs = np.random.RandomState(0)
+        self.register_buffer("weight_u", to_tensor(
+            _np_l2norm(rs.randn(h).astype(dtype))))
+        self.register_buffer("weight_v", to_tensor(
+            _np_l2norm(rs.randn(w).astype(dtype))))
+
+    def forward(self, weight):
+        from ...ops import kernels as K
+        from ...tensor import ops as T
+
+        w = weight._data if hasattr(weight, "_data") else weight
+        return T.Tensor._wrap(K.spectral_normalize(
+            w, self.weight_u._data, self.weight_v._data, self._dim,
+            self._power_iters, self._eps))
+
+
+def _np_l2norm(a):
+    import numpy as np
+
+    return a / (np.linalg.norm(a) + 1e-12)
 
 
 class RMSNorm(Layer):

@@ -445,7 +445,10 @@ def dropout(x, key, p=0.5, training=True, mode="upscale_in_train"):
             return x * (1.0 - p)
         return x
     keep = 1.0 - p
-    mask = jax.random.bernoulli(key, keep, x.shape)
+    # f32 probability: a Python-float p under jax_enable_x64 would draw
+    # float64 uniforms — emulated (not native) on TPU and measured at ~30%
+    # of a dropout-heavy train step
+    mask = jax.random.bernoulli(key, jnp.float32(keep), x.shape)
     if mode == "upscale_in_train":
         return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
     return jnp.where(mask, x, 0.0).astype(x.dtype)
@@ -478,8 +481,12 @@ def randperm(key, n, dtype):
 def bernoulli(key, p):
     import jax
 
-    return jax.random.bernoulli(key, p, None if not hasattr(p, "shape") else
-                                p.shape)
+    jnp = _jnp()
+    if not hasattr(p, "shape"):
+        return jax.random.bernoulli(key, jnp.float32(p))
+    if p.dtype == jnp.float64:
+        p = p.astype(jnp.float32)
+    return jax.random.bernoulli(key, p, p.shape)
 
 
 # =====================================================================
@@ -1037,3 +1044,22 @@ def sequence_pool(data, segment_ids, num_segments, pool_type="SUM"):
     if pool_type == "MIN":
         return jax.ops.segment_min(data, segment_ids, num_segments)
     raise ValueError(pool_type)
+
+
+def spectral_normalize(w, u, v, dim=0, power_iters=1, eps=1e-12):
+    """Weight / sigma_max, sigma estimated by power iteration on (u, v)
+    (spectral_norm_op.cc). Shared by the static lowering and the
+    nn.SpectralNorm layer."""
+    jnp = _jnp()
+    wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    u = u.reshape(-1)
+    v = v.reshape(-1)
+
+    def _norm(a):
+        return a / (jnp.linalg.norm(a) + eps)
+
+    for _ in range(max(power_iters, 0)):
+        v = _norm(wm.T @ u)
+        u = _norm(wm @ v)
+    sigma = u @ wm @ v
+    return w / sigma

@@ -174,7 +174,7 @@ def multinomial(x, num_samples=1, replacement=False):
         out = _jnp().moveaxis(out, 0, -1)
     else:
         g = -_jnp().log(-_jnp().log(
-            jax.random.uniform(key, t._data.shape)))
+            jax.random.uniform(key, t._data.shape, dtype=_jnp().float32)))
         _, out = K.topk(logits + g, num_samples)
     return Tensor._wrap(out.astype(_jnp().int64))
 

@@ -65,7 +65,10 @@ def beam_search(step_fn, init_state, batch_size, bos_id, eos_id,
 
     state0 = jax.tree_util.tree_map(tile, init_state)
     # beam 0 starts live, others dead so the first expansion is unique
-    logp0 = jnp.tile(jnp.asarray([0.0] + [NEG] * (K - 1)), (B, 1))
+    # f32 explicitly: under jax_enable_x64 a bare float list is f64, which
+    # would promote the whole scoring scan to emulated f64 on TPU
+    logp0 = jnp.tile(jnp.asarray([0.0] + [NEG] * (K - 1), jnp.float32),
+                     (B, 1))
     tok0 = jnp.full((B, K), bos_id, jnp.int32)
     fin0 = jnp.zeros((B, K), bool)
     len0 = jnp.zeros((B, K), jnp.int32)
@@ -77,7 +80,7 @@ def beam_search(step_fn, init_state, batch_size, bos_id, eos_id,
         lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
         lp = lp.reshape(B, K, V)
         # finished beams: only EOS continues, at no additional cost
-        fin_mask = jnp.full((V,), NEG).at[eos_id].set(0.0)
+        fin_mask = jnp.full((V,), NEG, jnp.float32).at[eos_id].set(0.0)
         lp = jnp.where(fin[:, :, None], fin_mask[None, None, :], lp)
         total = logp[:, :, None] + lp                  # [B, K, V]
         flat = total.reshape(B, K * V)

@@ -1,0 +1,133 @@
+"""Fleet distributed metrics + op version registry tests.
+
+Reference parity: distributed/fleet/metrics/metric.py (stats allreduced
+over trainers before the final formula) and framework/op_version_registry.h
+(saved programs embed op versions; loaders detect newer-than-supported
+ops)."""
+import multiprocessing as mp
+import warnings
+
+import numpy as np
+import pytest
+
+
+# --------------------------------------------------------------------------
+# fleet.metrics over a real multi-process KV store
+# --------------------------------------------------------------------------
+
+def _metric_worker(rank, world, port, q):
+    from paddle_tpu.distributed.fleet import metrics
+    from paddle_tpu.distributed.rendezvous import TCPStore
+
+    store = TCPStore("127.0.0.1", port, is_master=False, world_size=world)
+    metrics.init_metric_context(store, rank, world)
+    local = np.array([1.0 + rank, 10.0 * (rank + 1)])
+    s = metrics.sum(local)
+    mx = metrics.max(local)
+    # bucketed auc stats: rank 0 sees only positives high, rank 1 mixes
+    pos = np.zeros(4)
+    neg = np.zeros(4)
+    if rank == 0:
+        pos[3] = 5
+        neg[0] = 5
+    else:
+        pos[2] = 3
+        neg[1] = 4
+    a = metrics.auc(pos, neg)
+    acc = metrics.acc(np.array([8.0 + rank]), np.array([10.0]))
+    q.put((rank, s.tolist(), mx.tolist(), a, acc))
+
+
+def test_fleet_metrics_two_trainers():
+    from paddle_tpu.distributed.rendezvous import TCPStore
+
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2)
+    try:
+        q = mp.Queue()
+        procs = [mp.Process(target=_metric_worker,
+                            args=(r, 2, master.port, q)) for r in range(2)]
+        for p in procs:
+            p.start()
+        results = {}
+        for _ in range(2):
+            rank, s, mx, a, acc = q.get(timeout=60)
+            results[rank] = (s, mx, a, acc)
+        for p in procs:
+            p.join(30)
+        assert set(results) == {0, 1}
+        for rank in (0, 1):
+            s, mx, a, acc = results[rank]
+            assert s == [3.0, 30.0]           # (1+2, 10+20)
+            assert mx == [2.0, 20.0]
+            # every trainer computes the SAME global auc/acc
+            assert a == results[0][2]
+            assert acc == pytest.approx((8 + 9) / 20.0)
+        # auc sanity: most positives at high buckets -> auc well above 0.5
+        assert 0.8 < results[0][2] <= 1.0
+    finally:
+        master.shutdown()
+
+
+def test_fleet_metrics_single_process_identity():
+    from paddle_tpu.distributed.fleet import metrics
+
+    metrics.init_metric_context(None, 0, 1)
+    x = np.array([2.0, 4.0])
+    np.testing.assert_array_equal(metrics.sum(x), x)
+    assert metrics.mae(np.array([5.0]), np.array([10.0])) == 0.5
+    assert metrics.mse(np.array([16.0]), np.array([4.0])) == 4.0
+    assert metrics.rmse(np.array([16.0]), np.array([4.0])) == 2.0
+
+
+def test_fleet_util_all_reduce_identity():
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.distributed.fleet import metrics
+
+    metrics.init_metric_context(None, 0, 1)
+    out = fleet.util.all_reduce(np.array([1.0, 2.0]))
+    np.testing.assert_array_equal(out, [1.0, 2.0])
+
+
+# --------------------------------------------------------------------------
+# op version registry
+# --------------------------------------------------------------------------
+
+def test_op_version_registry_defaults_and_bumps():
+    from paddle_tpu.fluid import op_version as ov
+
+    assert ov.get_op_version("matmul") == 1
+    assert ov.get_op_version("dropout") >= 2
+    with pytest.raises(ValueError):
+        ov.register_op_version("dropout", 1)  # can't move backward
+
+
+def test_program_embeds_and_checks_op_versions():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core import program_pb
+    from paddle_tpu.fluid import op_version as ov
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        h = fluid.layers.fc(x, 3)
+        fluid.layers.dropout(h, 0.5)
+    pb = program_pb.program_to_proto(main)
+    vmap = {p.op_name: p.version for p in pb.op_version_map}
+    assert vmap.get("dropout", 0) >= 2
+    assert "mul" in vmap or "fc" in vmap or "matmul" in vmap
+
+    # round-trip load is compatible (no warning)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        prog2 = program_pb.proto_to_program(pb)
+    assert [op.type for op in prog2.global_block().ops]
+
+    # a program from "the future" warns (and raises in strict mode)
+    future = program_pb.program_to_proto(main)
+    for pair in future.op_version_map:
+        if pair.op_name == "dropout":
+            pair.version = ov.get_op_version("dropout") + 7
+    with pytest.warns(RuntimeWarning):
+        program_pb.proto_to_program(future)
+    with pytest.raises(RuntimeError):
+        ov.check_compatible({"dropout": 99}, strict=True)
