@@ -4,7 +4,9 @@ from ..core.place import (CPUPlace, CUDAPinnedPlace, CUDAPlace,  # noqa
 from ..core.lod import (LoDTensor, create_lod_tensor,  # noqa: F401
                         create_random_int_lodtensor)
 from ..core.tensor import Tensor
-from . import initializer, io, layers, optimizer  # noqa: F401
+from . import initializer, io, layers, optimizer, transpiler  # noqa: F401
+from .transpiler import (DistributeTranspiler,  # noqa: F401
+                         DistributeTranspilerConfig)
 from .backward import append_backward, calc_gradient, gradients  # noqa
 from .executor import Executor, Scope, global_scope, scope_guard  # noqa
 from .framework import (Program, Variable, default_main_program,  # noqa

@@ -103,6 +103,12 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             feed_var_name="feed", fetch_var_name="fetch",
             return_numpy=True, use_program_cache=True):
+        from .transpiler import PServerProgram
+
+        if isinstance(program, PServerProgram):
+            # listen_and_serv parity: exe.run(pserver_program) blocks
+            # serving the native PS until interrupted
+            return program.serve(blocking=True)
         program = program or default_main_program()
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -172,6 +178,12 @@ class Executor:
                                                     key)
 
         scope._values.update(new_persist)
+
+        # transpiler-installed hooks (PS grad push/param pull, LocalSGD
+        # averaging) run at the jit boundary — SURVEY §7.4: RPC never
+        # lives inside the XLA program
+        for hook in getattr(program, "_run_hooks", ()):  # noqa: B007
+            hook(self, program, scope)
 
         out = []
         for name, v in zip(fetch_names, fetches):

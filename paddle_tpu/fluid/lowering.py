@@ -1427,6 +1427,9 @@ from . import lowering_detection  # noqa: E402,F401
 # batch-3 general-purpose op surface registers itself on import
 from . import lowering_batch3  # noqa: E402,F401
 
+# batch-4: sampled losses, CV sampling, fusion_* family, SelectedRows utils
+from . import lowering_batch4  # noqa: E402,F401
+
 
 # ====== book-era op additions (fluid/layers/nn.py 15.2k surface) ======
 

@@ -838,3 +838,133 @@ def _py_func(ctx, op):
                 var.dtype, "name") else var.dtype)))
     outs = jax.pure_callback(fn, tuple(specs), *xs)
     ctx.outs(op, "Out", list(outs))
+
+
+# ======================================================================
+# collective ops (operators/collective/) — XLA collectives over ICI
+# ======================================================================
+
+def _try_axis_reduce(x, reduce_fn, axis_names=("dp",)):
+    """Inside an SPMD trace (shard_map/pmap with a bound mesh axis) the
+    c_* ops ARE the XLA collectives; in a single-replica trace they are
+    identity (world=1). NCCL streams/comm-init have no equivalent — XLA
+    schedules collectives itself. Returns (out, reduced) so callers can
+    tell the identity fallback apart from a real reduction. Only the
+    unbound-axis error triggers the fallback — real collective failures
+    (bad scatter dims etc.) surface to the user."""
+    for ax in axis_names:
+        try:
+            return reduce_fn(x, ax), True
+        except NameError:
+            continue
+        except (KeyError, ValueError, TypeError) as e:
+            if "unbound" in str(e) or "axis name" in str(e):
+                continue
+            raise
+    return x, False
+
+
+def _c_allreduce(lax_name):
+    def lower(ctx, op):
+        import jax
+
+        x = ctx.inp(op, "X")
+        ax = op.attrs.get("axis_name", "dp")
+        fn = getattr(jax.lax, lax_name)
+        out, reduced = _try_axis_reduce(x, lambda v, a: fn(v, a),
+                                        (ax, "dp"))
+        scale = op.attrs.get("scale")
+        if scale and reduced:
+            # 1/nranks averaging belongs to the reduction; the world=1
+            # identity fallback must not shrink the tensor
+            out = out * scale
+        ctx.out(op, "Out", out)
+    return lower
+
+
+register("c_allreduce_sum")(_c_allreduce("psum"))
+register("c_allreduce_max")(_c_allreduce("pmax"))
+register("c_allreduce_min")(_c_allreduce("pmin"))
+
+
+@register("c_allreduce_prod")
+def _c_allreduce_prod(ctx, op):
+    import jax
+
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    ax = op.attrs.get("axis_name", "dp")
+    # product via exp(psum(log)) breaks on zeros/negatives; use
+    # all_gather+prod when the axis is bound
+    out, _ = _try_axis_reduce(
+        x, lambda v, a: jnp.prod(jax.lax.all_gather(v, a), axis=0),
+        (ax, "dp"))
+    ctx.out(op, "Out", out)
+
+
+@register("c_broadcast")
+def _c_broadcast(ctx, op):
+    # single-program SPMD: every replica already holds root's value after
+    # the XLA partitioner runs; identity preserves semantics
+    ctx.out(op, "Out", ctx.inp(op, "X"))
+
+
+@register("c_allgather")
+def _c_allgather(ctx, op):
+    import jax
+
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    ax = op.attrs.get("axis_name", "dp")
+    nranks = op.attrs.get("nranks", 1)
+
+    def gather(v, a):
+        g = jax.lax.all_gather(v, a)         # [world, ...]
+        return g.reshape((-1,) + v.shape[1:])
+
+    out, reduced = _try_axis_reduce(x, gather, (ax, "dp"))
+    if not reduced and nranks > 1:
+        out = jnp.concatenate([x] * nranks, axis=0)  # replicated world
+    ctx.out(op, "Out", out)
+
+
+@register("c_reducescatter")
+def _c_reducescatter(ctx, op):
+    import jax
+
+    x = ctx.inp(op, "X")
+    ax = op.attrs.get("axis_name", "dp")
+
+    def rs(v, a):
+        return jax.lax.psum_scatter(v, a, scatter_dimension=0, tiled=True)
+
+    out, _ = _try_axis_reduce(x, rs, (ax, "dp"))
+    ctx.out(op, "Out", out)
+
+
+def _c_noop_passthrough(slot_in="X", slot_out="Out"):
+    def lower(ctx, op, _si=slot_in, _so=slot_out):
+        x = ctx.inp(op, _si)
+        if x is not None:
+            ctx.out(op, _so, x)
+    return lower
+
+
+# stream ordering / comm bootstrap: XLA's scheduler owns collective
+# ordering; jax.distributed owns rendezvous (SURVEY §2.4 NCCL row)
+for _n in ("c_sync_calc_stream", "c_sync_comm_stream"):
+    register(_n)(_c_noop_passthrough())
+for _n in ("c_comm_init", "c_comm_init_all", "c_gen_nccl_id",
+           "gen_nccl_id", "c_wait_comm", "c_wait_compute"):
+    @register(_n)
+    def _c_init_noop(ctx, op):
+        pass
+
+
+@register("barrier")
+def _barrier(ctx, op):
+    # host-side barrier is a launch/runtime concern (distributed.barrier);
+    # inside one XLA program there is nothing to order
+    x = ctx.inp(op, "X")
+    if x is not None:
+        ctx.out(op, "Out", x)

@@ -1,0 +1,469 @@
+"""Static lowerings, batch 4: sampled-class losses, CV sampling ops, the
+fusion_* inference op family, and SelectedRows utilities.
+
+Reference parity: nce_op.cc, sample_logits_op.cc, center_loss_op.cc,
+affine_grid_op.cc, deformable_conv_op.cu (+v1), psroi_pool_op.cc,
+fused/fusion_gru_op.cc, fused/fusion_lstm_op.cc,
+fused/fusion_repeated_fc_relu_op.cc, fused/fusion_squared_mat_sub_op.cc,
+fused/fusion_seqpool_concat_op.cc, fused/fusion_seqconv_eltadd_relu_op.cc,
+operators/math/selected_rows_functor (merge_selected_rows,
+get_tensor_from_selected_rows).
+
+TPU-native notes: the fusion_* ops exist in the reference because its CPU
+executor can't fuse — here each is ONE lowering composed from the same
+kernels XLA fuses anyway, so op-name parity costs nothing at runtime.
+Deformable conv is expressed as bilinear gathers + a dense matmul (MXU)
+rather than a translated CUDA scatter kernel. Sampled-class losses draw
+their negatives with the ctx op-uid key chain, so re-traces reproduce the
+same samples (autodiff prune safety).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.lod import LOD_SUFFIX
+from ..ops import sequence as S
+from .lowering import LOD_AWARE_OPS, _jnp, register
+
+
+def _lax():
+    import jax.lax as lax
+
+    return lax
+
+
+# ======================================================================
+# sampled-class losses
+# ======================================================================
+
+@register("nce")
+def _nce(ctx, op):
+    """Noise-contrastive estimation (nce_op.h): binary logistic loss on
+    the true class vs num_neg_samples uniform noise classes."""
+    import jax
+
+    jnp = _jnp()
+    x = ctx.inp(op, "Input")                     # [N, D]
+    lbl = ctx.inp(op, "Label").reshape(x.shape[0], -1)  # [N, num_true]
+    w = ctx.inp(op, "Weight")                    # [C, D]
+    b = ctx.inp(op, "Bias")                      # [C]
+    total = op.attrs.get("num_total_classes", w.shape[0])
+    k = op.attrs.get("num_neg_samples", 10)
+    n, num_true = lbl.shape
+    neg = jax.random.randint(ctx.next_key(), (n, k), 0, total)
+    samples = jnp.concatenate([lbl.astype(jnp.int32),
+                               neg.astype(jnp.int32)], axis=1)
+    logits = jnp.einsum("nd,nsd->ns", x, w[samples])
+    if b is not None:
+        logits = logits + b.reshape(-1)[samples]
+    # NCE posterior P(real | y) = o / (o + k*q) with uniform noise
+    # q = 1/total (nce_op.h): as a logistic over the ADJUSTED logit
+    # logit - log(k*q)
+    adj = logits - jnp.log(jnp.asarray(k / total, jnp.float32))
+    labels = jnp.concatenate(
+        [jnp.ones((n, num_true), x.dtype) / num_true,
+         jnp.zeros((n, k), x.dtype)], axis=1)
+    per = labels * (-jax.nn.log_sigmoid(adj)) + \
+        (1 - labels) * (-jax.nn.log_sigmoid(-adj))
+    ctx.out(op, "Cost", per.sum(1, keepdims=True))
+    ctx.out(op, "SampleLogits", logits)
+    ctx.out(op, "SampleLabels", samples.astype(jnp.int64))
+
+
+@register("sample_logits")
+def _sample_logits(ctx, op):
+    """Sampled softmax helper (sample_logits_op.cc): gather the true
+    class logit plus uniformly sampled negatives, correcting each by
+    -log(expected_count) so full-softmax training is unbiased."""
+    import jax
+
+    jnp = _jnp()
+    logits = ctx.inp(op, "Logits")               # [N, C]
+    lbl = ctx.inp(op, "Labels").reshape(logits.shape[0], -1)
+    k = op.attrs.get("num_samples", 10)
+    n, c = logits.shape
+    num_true = lbl.shape[1]
+    neg = jax.random.randint(ctx.next_key(), (n, k), 0, c)
+    samples = jnp.concatenate([lbl.astype(jnp.int32),
+                               neg.astype(jnp.int32)], axis=1)
+    picked = jnp.take_along_axis(logits, samples, axis=1)
+    if op.attrs.get("remove_accidental_hits", True):
+        # negatives that equal the true class get pushed to -inf
+        acc = (samples[:, num_true:, None] ==
+               lbl[:, None, :].astype(jnp.int32)).any(-1)
+        picked = picked.at[:, num_true:].add(
+            jnp.where(acc, -1e20, 0.0).astype(picked.dtype))
+    # uniform expected-count correction: q = k / C per class
+    q = jnp.asarray(k / c, picked.dtype)
+    picked = picked - jnp.log(q)
+    ctx.out(op, "SampledLogits", picked)
+    ctx.out(op, "SampledLabels",
+            jnp.tile(jnp.arange(num_true, dtype=jnp.int64), (n, 1)))
+    ctx.out(op, "Samples", samples.astype(jnp.int64))
+    ctx.out(op, "Probabilities",
+            jnp.full(samples.shape, 1.0 / c, jnp.float32))
+
+
+@register("center_loss")
+def _center_loss(ctx, op):
+    """center_loss_op.cc: pull each feature toward its class center;
+    centers are running state updated with CenterUpdateRate."""
+    jnp = _jnp()
+    x = ctx.inp(op, "X")                         # [N, D]
+    lbl = ctx.inp(op, "Label").reshape(-1).astype(jnp.int32)
+    centers = ctx.inp(op, "Centers")             # [C, D]
+    rate = ctx.inp(op, "CenterUpdateRate")
+    rate = rate.reshape(()) if rate is not None else jnp.asarray(
+        op.attrs.get("alpha", 0.5), x.dtype)
+    diff = x - centers[lbl]
+    ctx.out(op, "SampleCenterDiff", diff)
+    ctx.out(op, "Loss", 0.5 * (diff * diff).sum(1, keepdims=True))
+    if op.attrs.get("need_update", True):
+        cnt = jnp.zeros((centers.shape[0],), x.dtype).at[lbl].add(1.0)
+        upd = jnp.zeros_like(centers).at[lbl].add(diff.astype(
+            centers.dtype))
+        centers_new = centers + rate * upd / (cnt[:, None] + 1.0)
+        ctx.out(op, "CentersOut", centers_new)
+    else:
+        ctx.out(op, "CentersOut", centers)
+
+
+# ======================================================================
+# CV sampling ops
+# ======================================================================
+
+@register("affine_grid")
+def _affine_grid(ctx, op):
+    """affine_grid_op.cc: [N, 2, 3] theta -> [N, H, W, 2] sampling grid
+    over the [-1, 1] normalized output lattice."""
+    jnp = _jnp()
+    theta = ctx.inp(op, "Theta")
+    shape = op.attrs.get("output_shape")
+    if not shape:
+        shape = [int(s) for s in np.asarray(ctx.inp(op, "OutputShape"))]
+    n, _, h, w = shape
+    align = op.attrs.get("align_corners", True)
+    if align:
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+    else:
+        ys = (jnp.arange(h) + 0.5) * 2.0 / h - 1.0
+        xs = (jnp.arange(w) + 0.5) * 2.0 / w - 1.0
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+    out = jnp.einsum("hwk,nck->nhwc", base.astype(theta.dtype), theta)
+    ctx.out(op, "Output", out)
+
+
+def _bilinear_sample_nchw(img, ys, xs):
+    """img [N, C, H, W]; ys/xs [N, P] absolute coords -> [N, C, P];
+    out-of-range samples are zero (deformable-conv border rule)."""
+    jnp = _jnp()
+    n, c, h, w = img.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+    out = 0.0
+    for dy, sy in ((0, 1 - wy), (1, wy)):
+        for dx, sx in ((0, 1 - wx), (1, wx)):
+            yy = y0 + dy
+            xx = x0 + dx
+            valid = ((yy >= 0) & (yy <= h - 1) & (xx >= 0) &
+                     (xx <= w - 1))
+            yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            v = img[jnp.arange(n)[:, None], :, yc, xc]  # [N, P, C]
+            out = out + v * (sy * sx * valid)[:, :, None]
+    return jnp.moveaxis(out, 1, 2)  # [N, C, P]
+
+
+def _deformable_conv(ctx, op, modulated):
+    jnp = _jnp()
+    x = ctx.inp(op, "Input")                     # [N, C, H, W]
+    offset = ctx.inp(op, "Offset")               # [N, 2*dg*kh*kw, OH, OW]
+    mask = ctx.inp(op, "Mask") if modulated else None
+    w = ctx.inp(op, "Filter")                    # [O, C/g, kh, kw]
+    st = op.attrs.get("strides", [1, 1])
+    pd = op.attrs.get("paddings", [0, 0])
+    dl = op.attrs.get("dilations", [1, 1])
+    groups = op.attrs.get("groups", 1)
+    dg = op.attrs.get("deformable_groups", 1)
+    n, c, h, ww = x.shape
+    o, cg, kh, kw = w.shape
+    oh = (h + 2 * pd[0] - (dl[0] * (kh - 1) + 1)) // st[0] + 1
+    ow = (ww + 2 * pd[1] - (dl[1] * (kw - 1) + 1)) // st[1] + 1
+    # base sampling positions: y depends on (tap kh_i, out row); x on
+    # (tap kw_i, out col)
+    by = ((jnp.arange(kh) * dl[0])[:, None] +
+          (jnp.arange(oh) * st[0] - pd[0])[None, :])     # [kh, OH]
+    bx = ((jnp.arange(kw) * dl[1])[:, None] +
+          (jnp.arange(ow) * st[1] - pd[1])[None, :])     # [kw, OW]
+    base_y = jnp.broadcast_to(by[:, None, :, None], (kh, kw, oh, ow))
+    base_x = jnp.broadcast_to(bx[None, :, None, :], (kh, kw, oh, ow))
+    off = offset.reshape(n, dg, kh * kw, 2, oh, ow)
+    cols = []
+    cpg = c // dg                                 # channels per dg
+    for g in range(dg):
+        oy = off[:, g, :, 0]                      # [N, kh*kw, OH, OW]
+        ox = off[:, g, :, 1]
+        ys = base_y.reshape(1, kh * kw, oh, ow) + oy
+        xs = base_x.reshape(1, kh * kw, oh, ow) + ox
+        flat_y = ys.reshape(n, -1)
+        flat_x = xs.reshape(n, -1)
+        sub = x[:, g * cpg:(g + 1) * cpg]
+        sampled = _bilinear_sample_nchw(sub, flat_y, flat_x)
+        sampled = sampled.reshape(n, cpg, kh * kw, oh, ow)
+        if mask is not None:
+            m = mask.reshape(n, dg, kh * kw, oh, ow)[:, g]
+            sampled = sampled * m[:, None]
+        cols.append(sampled)
+    col = jnp.concatenate(cols, axis=1)          # [N, C, kh*kw, OH, OW]
+    col = col.reshape(n, c * kh * kw, oh * ow)
+    wg = w.reshape(groups, o // groups, cg * kh * kw)
+    colg = col.reshape(n, groups, (c // groups) * kh * kw, oh * ow)
+    out = jnp.einsum("gok,ngkp->ngop", wg, colg)
+    ctx.out(op, "Output", out.reshape(n, o, oh, ow))
+
+
+@register("deformable_conv")
+def _deformable_conv_v2(ctx, op):
+    _deformable_conv(ctx, op, modulated=True)
+
+
+@register("deformable_conv_v1")
+def _deformable_conv_v1(ctx, op):
+    _deformable_conv(ctx, op, modulated=False)
+
+
+@register("psroi_pool")
+def _psroi_pool(ctx, op):
+    """Position-sensitive RoI average pooling (psroi_pool_op.cc): output
+    channel (c, ph, pw) reads input channel c*P*P + ph*P + pw within the
+    (ph, pw) bin of the RoI."""
+    jnp = _jnp()
+    x = ctx.inp(op, "X")                         # [N, C*P*P, H, W]
+    rois = ctx.inp(op, "ROIs")
+    lod = ctx.env.get(op.input("ROIs")[0] + LOD_SUFFIX)
+    out_c = op.attrs["output_channels"]
+    p = op.attrs["pooled_height"]
+    scale = op.attrs.get("spatial_scale", 1.0)
+    n, cpp, h, w = x.shape
+    if lod is not None:
+        # canonical padded sequence form: rois [n_img, R_max, 4] + lens;
+        # flatten, keep the per-image index, and emit the same lens so
+        # the fetch path repacks only the valid rows
+        n_img, r_max = rois.shape[0], rois.shape[1]
+        batch_ix = jnp.repeat(jnp.arange(n_img), r_max)
+        rois = rois.reshape(n_img * r_max, rois.shape[-1])
+    else:
+        batch_ix = jnp.zeros((rois.shape[0],), jnp.int32)
+    r = rois.shape[0]
+    x1 = jnp.round(rois[:, 0]) * scale
+    y1 = jnp.round(rois[:, 1]) * scale
+    x2 = (jnp.round(rois[:, 2]) + 1.0) * scale
+    y2 = (jnp.round(rois[:, 3]) + 1.0) * scale
+    rh = jnp.maximum(y2 - y1, 0.1) / p
+    rw = jnp.maximum(x2 - x1, 0.1) / p
+    # dense: sample a fixed SxS lattice per bin and average
+    s = 4
+    bins = jnp.arange(p)
+    lat = (jnp.arange(s) + 0.5) / s
+    # yi[r, ph, a] / xi[r, pw, b]: sample coords inside each bin
+    py = y1[:, None, None] + (bins[None, :, None] +
+                              lat[None, None, :]) * rh[:, None, None]
+    px = x1[:, None, None] + (bins[None, :, None] +
+                              lat[None, None, :]) * rw[:, None, None]
+    yi = jnp.clip(jnp.floor(py), 0, h - 1).astype(jnp.int32)  # [R, P, S]
+    xi = jnp.clip(jnp.floor(px), 0, w - 1).astype(jnp.int32)
+    xg = x.reshape(n, out_c, p, p, h, w)
+    # out[r, c, ph, pw] = mean_{a,b} xg[b_ix[r], c, ph, pw, yi[r,ph,a],
+    #                                   xi[r,pw,b]]
+    B = batch_ix[:, None, None, None, None, None]
+    C = jnp.arange(out_c)[None, :, None, None, None, None]
+    PH = bins[None, None, :, None, None, None]
+    PW = bins[None, None, None, :, None, None]
+    Y = yi[:, None, :, None, :, None]
+    X = xi[:, None, None, :, None, :]
+    g = xg[B, C, PH, PW, Y, X]                    # [R, out_c, P, P, S, S]
+    out = g.mean(axis=(4, 5))
+    ctx.out(op, "Out", out)
+    if lod is not None:
+        # [n_img, R_max, out_c, P, P] padded rows + lengths companion
+        n_img = lod.shape[0]
+        ctx.out(op, "Out", out.reshape((n_img, -1) + out.shape[1:]))
+        ctx.env[op.output("Out")[0] + LOD_SUFFIX] = lod
+
+
+LOD_AWARE_OPS.add("psroi_pool")
+
+
+# ======================================================================
+# fusion_* op family — compositions of existing kernels (XLA fuses)
+# ======================================================================
+
+def _seq_lens(ctx, op, slot):
+    names = op.input(slot)
+    if not names:
+        return None
+    return ctx.env.get(names[0] + LOD_SUFFIX)
+
+
+def _full_lens(x):
+    jnp = _jnp()
+    return jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+
+
+@register("fusion_gru")
+def _fusion_gru(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")                          # [B, T, M]
+    wx = ctx.inp(op, "WeightX")                   # [M, 3D]
+    wh = ctx.inp(op, "WeightH")                   # [D, 3D]
+    b = ctx.inp(op, "Bias")
+    h0 = ctx.inp(op, "H0")
+    in_lens = _seq_lens(ctx, op, "X")
+    lens = in_lens if in_lens is not None else _full_lens(x)
+    xw = jnp.einsum("btm,md->btd", x, wx)
+    hs = S.dynamic_gru(
+        xw, lens, wh, b, h0,
+        is_reverse=op.attrs.get("is_reverse", False),
+        gate_activation=op.attrs.get("gate_activation", "sigmoid"),
+        candidate_activation=op.attrs.get("activation", "tanh"),
+        origin_mode=op.attrs.get("origin_mode", False))
+    ctx.out(op, "Hidden", hs)
+    if in_lens is not None:  # sequence in -> sequence out; dense stays dense
+        ctx.env[op.output("Hidden")[0] + LOD_SUFFIX] = lens
+
+
+@register("fusion_lstm")
+def _fusion_lstm(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    wx = ctx.inp(op, "WeightX")                   # [M, 4D]
+    wh = ctx.inp(op, "WeightH")                   # [D, 4D]
+    b = ctx.inp(op, "Bias")
+    h0 = ctx.inp(op, "H0")
+    c0 = ctx.inp(op, "C0")
+    in_lens = _seq_lens(ctx, op, "X")
+    lens = in_lens if in_lens is not None else _full_lens(x)
+    xw = jnp.einsum("btm,md->btd", x, wx)
+    # fusion_lstm bias is [1, 4D] (no peepholes)
+    hs, cs = S.dynamic_lstm(
+        xw, lens, wh, b, h0, c0, use_peepholes=False,
+        is_reverse=op.attrs.get("is_reverse", False),
+        gate_activation=op.attrs.get("gate_activation", "sigmoid"),
+        cell_activation=op.attrs.get("cell_activation", "tanh"),
+        candidate_activation=op.attrs.get("candidate_activation", "tanh"))
+    ctx.out(op, "Hidden", hs)
+    ctx.out(op, "Cell", cs)
+    if in_lens is not None:
+        for slot in ("Hidden", "Cell"):
+            names = op.output(slot)
+            if names:
+                ctx.env[names[0] + LOD_SUFFIX] = lens
+
+
+for _n in ("fusion_gru", "fusion_lstm"):
+    LOD_AWARE_OPS.add(_n)
+
+
+@register("fusion_repeated_fc_relu")
+def _fusion_repeated_fc_relu(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    ws = ctx.inps(op, "W")
+    bs = ctx.inps(op, "Bias")
+    for w, b in zip(ws, bs):
+        x = jnp.maximum(x @ w + b.reshape(-1), 0.0)
+    ctx.out(op, "Out", x)
+
+
+@register("fusion_squared_mat_sub")
+def _fusion_squared_mat_sub(ctx, op):
+    # (x @ y)^2 - x^2 @ y^2, scaled (fusion_squared_mat_sub_op.cc)
+    x, y = ctx.inp(op, "X"), ctx.inp(op, "Y")
+    scalar = op.attrs.get("scalar", 1.0)
+    xy = x @ y
+    ctx.out(op, "Out", scalar * (xy * xy - (x * x) @ (y * y)))
+    ctx.out(op, "SquaredX", x * x)
+    ctx.out(op, "SquaredY", y * y)
+    ctx.out(op, "SquaredXY", xy * xy)
+
+
+@register("fusion_seqpool_concat")
+def _fusion_seqpool_concat(ctx, op):
+    jnp = _jnp()
+    xs = ctx.inps(op, "X")
+    ptype = op.attrs.get("pooltype", "SUM")
+    pooled = []
+    for name, x in zip(op.input("X"), xs):
+        lens = ctx.env.get(name + LOD_SUFFIX)
+        if lens is None:
+            lens = _full_lens(x)
+        pooled.append(S.sequence_pool(x, lens, ptype.lower()))
+    ctx.out(op, "Out", jnp.concatenate(pooled, axis=-1))
+
+
+LOD_AWARE_OPS.add("fusion_seqpool_concat")
+
+
+@register("fusion_seqconv_eltadd_relu")
+def _fusion_seqconv_eltadd_relu(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    w = ctx.inp(op, "Filter")
+    b = ctx.inp(op, "Bias")
+    lens = _seq_lens(ctx, op, "X")
+    if lens is None:
+        lens = _full_lens(x)
+    out = S.sequence_conv(
+        x, lens, w,
+        context_length=op.attrs.get("contextLength",
+                                    op.attrs.get("context_length", 3)),
+        context_start=op.attrs.get("contextStart",
+                                   op.attrs.get("context_start", None)))
+    out = jnp.maximum(out + b.reshape(-1), 0.0)
+    ctx.out(op, "Out", out)
+    names = op.output("Out")
+    if names:
+        ctx.env[names[0] + LOD_SUFFIX] = lens
+
+
+LOD_AWARE_OPS.add("fusion_seqconv_eltadd_relu")
+
+
+# ======================================================================
+# SelectedRows utilities (sparse grads surface as (rows, values) tuples)
+# ======================================================================
+
+@register("merge_selected_rows")
+def _merge_selected_rows(ctx, op):
+    """Sum duplicate rows (selected_rows_functor MergeAdd). Static-shape
+    form: scatter-add into the full-height dense table and re-emit as
+    (arange(height), dense) — a complete, duplicate-free SelectedRows."""
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    if not isinstance(x, tuple):
+        ctx.out(op, "Out", x)
+        return
+    rows, vals = x
+    name = op.input("X")[0]
+    var = ctx.program.global_block().vars.get(name)
+    height = var.shape[0] if var is not None and var.shape else None
+    if height is None or height < 0:
+        raise ValueError(
+            f"merge_selected_rows needs a static height on var {name!r}")
+    dense = jnp.zeros((height,) + tuple(vals.shape[1:]), vals.dtype)
+    dense = dense.at[rows].add(vals)
+    ctx.out(op, "Out", (jnp.arange(height, dtype=rows.dtype), dense))
+
+
+@register("get_tensor_from_selected_rows")
+def _get_tensor_from_selected_rows(ctx, op):
+    x = ctx.inp(op, "X")
+    if isinstance(x, tuple):
+        rows, vals = x
+        ctx.out(op, "Out", vals)
+    else:
+        ctx.out(op, "Out", x)

@@ -1,0 +1,309 @@
+"""fluid.transpiler: the v1 distributed program rewriters.
+
+Reference parity: python/paddle/fluid/transpiler/distribute_transpiler.py
+(:256 DistributeTranspiler, :545 transpile, :1153 get_pserver_program) and
+transpiler/collective.py (:178 GradAllReduce, :270 LocalSGD).
+
+TPU-native design (SURVEY §7 hard part 4): the reference rewrites the
+program with send/recv *ops* interleaved with compute; XLA can't host RPC
+inside a jitted block, so the transpiled trainer program keeps
+forward+backward as ONE jitted computation, marks every `param@GRAD`
+persistable (so it surfaces at the executor boundary), and attaches a
+run-hook that exchanges grads/params with the native CPU pserver
+(paddle_tpu.distributed.ps) AROUND each `exe.run` — same wire traffic and
+server-side optimize semantics as listen_and_serv, at the jit boundary
+instead of mid-graph. The pserver side reuses the native PS server; its
+optimizer rule/lr are lifted from the optimizer ops the transpile removed.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# optimizer op types the v1 PS splits out to the server
+OPTIMIZER_OP_TYPES = ("sgd", "momentum", "adam", "adamax", "adagrad",
+                      "rmsprop", "ftrl", "lamb")
+
+# server-side rules the native PS implements (ps_server.cc); others fall
+# back to plain sgd on the server with a warning
+_SERVER_RULES = {"sgd", "momentum", "adam", "adagrad"}
+
+GRAD_SUFFIX = "@GRAD"
+
+
+class DistributeTranspilerConfig:
+    """Accepted for API parity; block-slicing knobs are advisory — the
+    native PS shards whole tensors by name hash across servers."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = None
+        self.min_block_size = 8192
+        self.enable_dc_asgd = False
+        self.sync_mode = True
+        self.runtime_split_send_recv = False
+        self.geo_sgd_mode = False
+        self.geo_sgd_need_push_nums = 100
+
+
+class PServerProgram:
+    """What get_pserver_program returns: enough to run the native PS
+    server for this endpoint. `Executor.run` serves it (blocking), the
+    reference's listen_and_serv behavior."""
+
+    def __init__(self, endpoint, trainers, optimizer, lr, param_names):
+        self.endpoint = endpoint
+        self.trainers = trainers
+        self.optimizer = optimizer
+        self.lr = lr
+        self.param_names = param_names
+
+    def serve(self, blocking=True):
+        import time
+
+        from ..distributed.ps import PsServer
+
+        port = int(self.endpoint.rsplit(":", 1)[1])
+        self._server = PsServer(port=port, trainers=self.trainers,
+                                optimizer=self.optimizer, lr=self.lr)
+        if blocking:
+            try:
+                while True:
+                    time.sleep(0.2)
+            except KeyboardInterrupt:
+                self._server.stop()
+        return self._server
+
+
+class _PsTrainerHook:
+    """Post-run hook installed on the trainer program: push grads, then
+    refresh params, through the Communicator (sync/async/geo modes)."""
+
+    def __init__(self, endpoints, trainer_id, param_names, grad_map,
+                 sync_mode, geo_k=0):
+        self.endpoints = endpoints
+        self.trainer_id = trainer_id
+        self.param_names = param_names
+        self.grad_map = grad_map            # param -> grad var name
+        self.sync_mode = sync_mode
+        self.geo_k = geo_k
+        self.comm = None
+
+    def _ensure_comm(self, scope):
+        if self.comm is not None:
+            return
+        from ..distributed.ps import Communicator
+
+        mode = "geo" if self.geo_k else ("sync" if self.sync_mode
+                                         else "async")
+        self.comm = Communicator(self.endpoints, mode=mode,
+                                 trainer_id=self.trainer_id,
+                                 geo_k=self.geo_k or 4)
+        init = {}
+        for p in self.param_names:
+            v = scope._values.get(p)
+            if v is not None:
+                init[p] = np.asarray(v)
+        self.comm.init_params(init)
+        if mode == "async":
+            self.comm.start()
+
+    def __call__(self, exe, program, scope):
+        self._ensure_comm(scope)
+        import jax.numpy as jnp
+
+        if self.geo_k:
+            params = {p: np.asarray(scope._values[p])
+                      for p in self.param_names}
+            fresh = self.comm.geo_step(params)
+            for p, v in (fresh or {}).items():
+                scope._values[p] = jnp.asarray(v)
+            return
+        grads = {}
+        for p in self.param_names:
+            g = scope._values.get(self.grad_map[p])
+            if g is not None:
+                grads[p] = np.asarray(g)
+        self.comm.push(grads)
+        # sync: round-trip pull; async: pull() returns the recv-thread's
+        # freshest snapshot without blocking on the server
+        for p, v in self.comm.pull().items():
+            scope._values[p] = jnp.asarray(v)
+
+    def stop(self):
+        if self.comm is not None:
+            self.comm.close()
+            self.comm = None
+
+
+class DistributeTranspiler:
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._trainer_program = None
+        self._pserver_info = None
+
+    def transpile(self, trainer_id, program=None, pservers="", trainers=1,
+                  sync_mode=True, startup_program=None,
+                  current_endpoint=""):
+        from .framework import default_main_program
+
+        program = program or default_main_program()
+        endpoints = [e for e in pservers.split(",") if e]
+        blk = program.global_block()
+
+        opt_ops = [op for op in blk.ops if op.type in OPTIMIZER_OP_TYPES]
+        if not opt_ops:
+            raise ValueError(
+                "DistributeTranspiler.transpile: program has no optimizer "
+                "ops; call minimize() before transpiling")
+        opt_type = opt_ops[0].type
+        lr = 0.01
+        lr_name = (opt_ops[0].input("LearningRate") or [None])[0]
+        if lr_name:
+            # the lr var is a persistable constant: read it from the scope
+            # (startup already ran) or from the startup program's
+            # initializer op
+            from .executor import global_scope
+
+            v = global_scope()._values.get(lr_name)
+            if v is not None:
+                lr = float(np.asarray(v).ravel()[0])
+            elif startup_program is not None:
+                for op in startup_program.global_block().ops:
+                    if op.output("Out") == [lr_name] and \
+                            "value" in op.attrs:
+                        lr = float(op.attrs["value"])
+        server_opt = opt_type if opt_type in _SERVER_RULES else "sgd"
+
+        param_names, grad_map = [], {}
+        for op in opt_ops:
+            p = op.input("Param")[0]
+            g = op.input("Grad")[0]
+            param_names.append(p)
+            grad_map[p] = g
+
+        # trainer program: drop the optimizer ops, surface the grads
+        self._trainer_program = program
+        keep = [op for op in blk.ops if op.type not in OPTIMIZER_OP_TYPES]
+        removed = len(blk.ops) - len(keep)
+        blk.ops[:] = keep
+        program._bump()
+        for g in grad_map.values():
+            if g in blk.vars:
+                blk.vars[g].persistable = True
+        hook = _PsTrainerHook(
+            endpoints, trainer_id, param_names, grad_map, sync_mode,
+            geo_k=(self.config.geo_sgd_need_push_nums
+                   if self.config.geo_sgd_mode else 0))
+        hooks = getattr(program, "_run_hooks", None)
+        if hooks is None:
+            hooks = program._run_hooks = []
+        hooks.append(hook)
+        self._hook = hook
+        self._pserver_info = (endpoints, trainers, server_opt, lr,
+                              param_names, removed)
+        return self
+
+    def get_trainer_program(self, wait_port=True):
+        return self._trainer_program
+
+    def get_pserver_program(self, endpoint):
+        endpoints, trainers, opt, lr, params, _ = self._pserver_info
+        return PServerProgram(endpoint, trainers, opt, lr, params)
+
+    def get_pserver_programs(self, endpoint):
+        return self.get_pserver_program(endpoint), None
+
+    def get_startup_program(self, endpoint=None, pserver_program=None):
+        # server-side state is created lazily on first push (the native
+        # PS initializes tables from trainer 0's init_params)
+        from .framework import default_startup_program
+
+        return default_startup_program()
+
+    def release(self):
+        if getattr(self, "_hook", None) is not None:
+            self._hook.stop()
+
+
+# ==========================================================================
+# collective transpilers (transpiler/collective.py)
+# ==========================================================================
+
+class Collective:
+    """Base: rewrite a program for multi-replica data parallelism. The c_*
+    ops lower to XLA collectives when the executor traces under an SPMD
+    axis; single-replica traces make them identity (world=1)."""
+
+    def __init__(self, nrings=1):
+        self.nrings = nrings
+
+    def transpile(self, startup_program, main_program, rank, endpoints,
+                  current_endpoint, wait_port=True):
+        self.nranks = len(endpoints) if isinstance(endpoints, (list, tuple)) \
+            else len([e for e in endpoints.split(",") if e])
+        self.rank = rank
+        self._transpile_main(main_program)
+        return self
+
+
+class GradAllReduce(Collective):
+    """Insert c_allreduce_sum on every param gradient (collective.py:178):
+    grads are averaged across replicas before the optimizer ops run."""
+
+    def _transpile_main(self, program):
+        from .framework import Operator
+
+        blk = program.global_block()
+        new_ops = []
+        for op in blk.ops:
+            if op.type in OPTIMIZER_OP_TYPES:
+                g = (op.input("Grad") or [None])[0]
+                if g:
+                    ar = Operator(
+                        blk, "c_allreduce_sum",
+                        {"X": [g]}, {"Out": [g]},
+                        {"ring_id": 0, "use_calc_stream": True,
+                         "scale": 1.0 / max(self.nranks, 1)})
+                    new_ops.append(ar)
+            new_ops.append(op)
+        blk.ops[:] = new_ops
+        program._bump()
+
+
+class LocalSGD(Collective):
+    """Periodic parameter averaging (collective.py:270): every k steps the
+    params are psum-averaged across replicas (the hook counts steps)."""
+
+    def __init__(self, nrings=1, k_steps=1):
+        super().__init__(nrings)
+        self.k_steps = k_steps
+
+    def _transpile_main(self, program):
+        blk = program.global_block()
+        params = [op.input("Param")[0] for op in blk.ops
+                  if op.type in OPTIMIZER_OP_TYPES]
+        nranks = max(self.nranks, 1)
+        k = max(self.k_steps, 1)
+        state = {"step": 0}
+
+        def hook(exe, prog, scope):
+            state["step"] += 1
+            if state["step"] % k:
+                return
+            from ..distributed import all_reduce_mean_tree, get_world_size
+
+            # average over the ACTUAL jax world: a single-process run is
+            # a no-op regardless of how many endpoints were declared —
+            # dividing by nranks without a matching sum would corrupt
+            # every parameter
+            if get_world_size() <= 1:
+                return
+            named = {p: scope._values[p] for p in params
+                     if p in scope._values}
+            for p, v in all_reduce_mean_tree(named).items():
+                scope._values[p] = v
+
+        hooks = getattr(program, "_run_hooks", None)
+        if hooks is None:
+            hooks = program._run_hooks = []
+        hooks.append(hook)

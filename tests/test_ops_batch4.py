@@ -1,0 +1,236 @@
+"""Op-surface batch 4: sampled-class losses, CV sampling ops, fusion_*
+family, SelectedRows utilities."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.lod import LoDTensor
+
+
+def _run_one(op_type, inputs, outputs, attrs, lod_feeds=None):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        blk = main.global_block()
+        in_map = {}
+        for slot, arrs in inputs.items():
+            vs = []
+            for i, a in enumerate(arrs):
+                lod_level = 0
+                if lod_feeds and (slot, i) in lod_feeds:
+                    lod_level = 1
+                    a = lod_feeds[(slot, i)][0]
+                v = blk.create_var(name=f"i_{slot}_{i}",
+                                   shape=list(np.shape(a)),
+                                   dtype=str(np.asarray(a).dtype),
+                                   is_data=True, lod_level=lod_level)
+                vs.append(v)
+            in_map[slot] = vs
+        out_map = {}
+        for slot, n in outputs.items():
+            out_map[slot] = [blk.create_var(name=f"o_{slot}_{i}")
+                             for i in range(n)]
+        blk.append_op(type=op_type, inputs=in_map,
+                      outputs={k: [v.name for v in vs]
+                               for k, vs in out_map.items()},
+                      attrs=attrs)
+    exe = fluid.Executor()
+    exe.run(startup)
+    feed = {}
+    for slot, arrs in inputs.items():
+        for i, a in enumerate(arrs):
+            if lod_feeds and (slot, i) in lod_feeds:
+                flat, lens = lod_feeds[(slot, i)]
+                feed[f"i_{slot}_{i}"] = LoDTensor(
+                    flat, [list(np.cumsum([0] + list(lens)))])
+            else:
+                feed[f"i_{slot}_{i}"] = np.asarray(a)
+    fetch = [v for vs in out_map.values() for v in vs]
+    return exe.run(main, feed, fetch, return_numpy=False)
+
+
+def _np_out(x):
+    return np.asarray(x._data if hasattr(x, "_data") else x)
+
+
+R = np.random.RandomState(11)
+
+
+def test_nce_runs_and_separates():
+    x = R.randn(6, 8).astype("float32")
+    lbl = R.randint(0, 20, (6, 1)).astype("int64")
+    w = R.randn(20, 8).astype("float32")
+    b = np.zeros(20, "float32")
+    cost, slog, slbl = _run_one(
+        "nce", {"Input": [x], "Label": [lbl], "Weight": [w], "Bias": [b]},
+        {"Cost": 1, "SampleLogits": 1, "SampleLabels": 1},
+        {"num_total_classes": 20, "num_neg_samples": 5})
+    cost = _np_out(cost)
+    assert cost.shape == (6, 1) and np.isfinite(cost).all()
+    assert _np_out(slbl).shape == (6, 6)  # 1 true + 5 sampled
+
+
+def test_sample_logits_correction():
+    logits = R.randn(4, 50).astype("float32")
+    lbl = R.randint(0, 50, (4, 1)).astype("int64")
+    outs = _run_one(
+        "sample_logits", {"Logits": [logits], "Labels": [lbl]},
+        {"SampledLogits": 1, "SampledLabels": 1, "Samples": 1,
+         "Probabilities": 1},
+        {"num_samples": 8, "remove_accidental_hits": True})
+    slog, slbl, samples, probs = map(_np_out, outs)
+    assert slog.shape == (4, 9)
+    # true-class logit (col 0) carries the -log(k/C) correction
+    expected = logits[np.arange(4), lbl[:, 0]] - np.log(8 / 50)
+    np.testing.assert_allclose(slog[:, 0], expected, rtol=1e-5)
+    assert (slbl == 0).all()  # true class sits at sampled position 0
+
+
+def test_center_loss():
+    x = R.randn(5, 4).astype("float32")
+    lbl = np.array([0, 1, 0, 2, 1], "int64")
+    centers = R.randn(3, 4).astype("float32")
+    rate = np.array([0.5], "float32")
+    loss, diff, cout = _run_one(
+        "center_loss",
+        {"X": [x], "Label": [lbl], "Centers": [centers],
+         "CenterUpdateRate": [rate]},
+        {"Loss": 1, "SampleCenterDiff": 1, "CentersOut": 1},
+        {"need_update": True})
+    loss, diff, cout = map(_np_out, (loss, diff, cout))
+    ref_diff = x - centers[lbl]
+    np.testing.assert_allclose(diff, ref_diff, rtol=1e-5)
+    np.testing.assert_allclose(
+        loss[:, 0], 0.5 * (ref_diff ** 2).sum(1), rtol=1e-5)
+    # class 2 center moved toward x[3] by rate * diff / (count+1)
+    np.testing.assert_allclose(
+        cout[2], centers[2] + 0.5 * ref_diff[3] / 2.0, rtol=1e-5)
+
+
+def test_affine_grid_identity():
+    theta = np.tile(np.array([[[1, 0, 0], [0, 1, 0]]], "float32"),
+                    (2, 1, 1))
+    (grid,) = _run_one("affine_grid", {"Theta": [theta]}, {"Output": 1},
+                       {"output_shape": [2, 3, 4, 5],
+                        "align_corners": True})
+    grid = _np_out(grid)
+    assert grid.shape == (2, 4, 5, 2)
+    np.testing.assert_allclose(grid[0, 0, 0], [-1, -1], atol=1e-6)
+    np.testing.assert_allclose(grid[0, -1, -1], [1, 1], atol=1e-6)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    x = R.randn(1, 4, 6, 6).astype("float32")
+    w = R.randn(3, 4, 3, 3).astype("float32")
+    offset = np.zeros((1, 2 * 9, 6, 6), "float32")
+    mask = np.ones((1, 9, 6, 6), "float32")
+    (out,) = _run_one(
+        "deformable_conv",
+        {"Input": [x], "Offset": [offset], "Mask": [mask], "Filter": [w]},
+        {"Output": 1},
+        {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+         "groups": 1, "deformable_groups": 1})
+    ref = np.asarray(lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW")))
+    np.testing.assert_allclose(_np_out(out), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_psroi_pool_constant_map():
+    # constant feature map: every pooled bin equals the channel constant
+    P, OC = 2, 3
+    x = np.zeros((1, OC * P * P, 8, 8), "float32")
+    for c in range(OC * P * P):
+        x[0, c] = c
+    rois_flat = np.array([[0, 0, 3, 3], [2, 2, 7, 7]], "float32")
+    outs = _run_one(
+        "psroi_pool", {"X": [x], "ROIs": [rois_flat]}, {"Out": 1},
+        {"output_channels": OC, "pooled_height": P, "pooled_width": P,
+         "spatial_scale": 1.0},
+        lod_feeds={("ROIs", 0): (rois_flat, [2])})
+    out = _np_out(outs[0])
+    assert out.shape == (2, OC, P, P)
+    for c in range(OC):
+        for ph in range(P):
+            for pw in range(P):
+                np.testing.assert_allclose(
+                    out[:, c, ph, pw], c * P * P + ph * P + pw)
+
+
+def test_fusion_gru_matches_dynamic_gru():
+    B, T, M, D = 2, 4, 3, 5
+    x = R.randn(B, T, M).astype("float32")
+    wx = R.randn(M, 3 * D).astype("float32")
+    wh = R.randn(D, 3 * D).astype("float32")
+    b = R.randn(1, 3 * D).astype("float32")
+    (hs,) = _run_one(
+        "fusion_gru",
+        {"X": [x], "WeightX": [wx], "WeightH": [wh], "Bias": [b]},
+        {"Hidden": 1}, {"is_reverse": False, "activation": "tanh",
+                        "gate_activation": "sigmoid"})
+    from paddle_tpu.ops import sequence as S
+    import jax.numpy as jnp
+
+    ref = np.asarray(S.dynamic_gru(
+        jnp.asarray(x) @ jnp.asarray(wx),
+        jnp.full((B,), T, jnp.int32), jnp.asarray(wh), jnp.asarray(b)))
+    np.testing.assert_allclose(_np_out(hs), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fusion_lstm_shapes_and_finiteness():
+    B, T, M, D = 2, 3, 4, 6
+    x = R.randn(B, T, M).astype("float32")
+    wx = R.randn(M, 4 * D).astype("float32")
+    wh = R.randn(D, 4 * D).astype("float32")
+    b = R.randn(1, 4 * D).astype("float32")
+    hs, cs = _run_one(
+        "fusion_lstm",
+        {"X": [x], "WeightX": [wx], "WeightH": [wh], "Bias": [b]},
+        {"Hidden": 1, "Cell": 1}, {})
+    hs, cs = _np_out(hs), _np_out(cs)
+    assert hs.shape == (B, T, D) and cs.shape == (B, T, D)
+    assert np.isfinite(hs).all() and np.isfinite(cs).all()
+
+
+def test_fusion_repeated_fc_relu_and_squared_mat_sub():
+    x = R.randn(3, 4).astype("float32")
+    w1 = R.randn(4, 5).astype("float32")
+    b1 = R.randn(5).astype("float32")
+    w2 = R.randn(5, 2).astype("float32")
+    b2 = R.randn(2).astype("float32")
+    (out,) = _run_one("fusion_repeated_fc_relu",
+                      {"X": [x], "W": [w1, w2], "Bias": [b1, b2]},
+                      {"Out": 1}, {})
+    ref = np.maximum(np.maximum(x @ w1 + b1, 0) @ w2 + b2, 0)
+    np.testing.assert_allclose(_np_out(out), ref, rtol=1e-4)
+
+    a = R.randn(3, 4).astype("float32")
+    b = R.randn(4, 5).astype("float32")
+    outs = _run_one("fusion_squared_mat_sub", {"X": [a], "Y": [b]},
+                    {"Out": 1, "SquaredX": 1, "SquaredY": 1,
+                     "SquaredXY": 1}, {"scalar": 0.5})
+    ref = 0.5 * ((a @ b) ** 2 - (a * a) @ (b * b))
+    np.testing.assert_allclose(_np_out(outs[0]), ref, rtol=1e-4)
+
+
+def test_fusion_seqpool_concat():
+    flat1 = R.randn(5, 3).astype("float32")   # rows: [2, 3]
+    flat2 = R.randn(5, 2).astype("float32")
+    outs = _run_one(
+        "fusion_seqpool_concat", {"X": [flat1, flat2]}, {"Out": 1},
+        {"pooltype": "SUM"},
+        lod_feeds={("X", 0): (flat1, [2, 3]), ("X", 1): (flat2, [2, 3])})
+    out = _np_out(outs[0])
+    ref = np.concatenate([
+        np.stack([flat1[:2].sum(0), flat1[2:].sum(0)]),
+        np.stack([flat2[:2].sum(0), flat2[2:].sum(0)])], axis=1)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_get_tensor_from_selected_rows_dense_passthrough():
+    x = R.randn(3, 4).astype("float32")
+    (out,) = _run_one("get_tensor_from_selected_rows", {"X": [x]},
+                      {"Out": 1}, {})
+    np.testing.assert_allclose(_np_out(out), x)

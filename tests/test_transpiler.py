@@ -1,0 +1,122 @@
+"""fluid.transpiler tests: v1 DistributeTranspiler over the native PS
+(reference distribute_transpiler.py:545 + listen_and_serv) and the
+collective rewriters (transpiler/collective.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def _build_net(lr=0.1):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(lr).minimize(loss)
+    return main, startup, loss
+
+
+def test_distribute_transpiler_ps_training():
+    from paddle_tpu.distributed.ps import PsServer
+
+    srv = PsServer(port=0, trainers=1, optimizer="sgd", lr=0.1)
+    try:
+        main, startup, loss = _build_net(lr=0.1)
+        exe = fluid.Executor()
+        exe.run(startup)
+
+        t = fluid.DistributeTranspiler()
+        t.transpile(trainer_id=0, program=main,
+                    pservers=f"127.0.0.1:{srv.port}", trainers=1,
+                    sync_mode=True, startup_program=startup)
+        trainer_prog = t.get_trainer_program()
+        # optimizer ops are gone from the trainer program
+        assert not [op for op in trainer_prog.global_block().ops
+                    if op.type == "sgd"]
+
+        rs = np.random.RandomState(0)
+        xb = rs.randn(16, 4).astype(np.float32)
+        yb = (xb @ np.array([[1.0], [-1.0], [0.5], [2.0]],
+                            np.float32))
+        losses = []
+        try:
+            for _ in range(25):
+                lv, = exe.run(trainer_prog, {"x": xb, "y": yb}, [loss])
+                losses.append(float(lv))
+        finally:
+            t.release()
+        assert losses[-1] < losses[0] / 10, losses
+    finally:
+        srv.stop()
+
+
+def test_pserver_program_object():
+    main, startup, _ = _build_net()
+    exe = fluid.Executor()
+    exe.run(startup)
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main,
+                pservers="127.0.0.1:0,127.0.0.1:0", trainers=2,
+                sync_mode=True, startup_program=startup)
+    ps_prog = t.get_pserver_program("127.0.0.1:0")
+    assert ps_prog.trainers == 2
+    assert ps_prog.optimizer == "sgd"
+    assert ps_prog.param_names  # the fc weight + bias shards
+    t.release()
+
+
+def test_pserver_lr_extraction():
+    main, startup, _ = _build_net(lr=0.05)
+    exe = fluid.Executor()
+    exe.run(startup)
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers="127.0.0.1:0",
+                trainers=1, startup_program=startup)
+    ps = t.get_pserver_program("127.0.0.1:0")
+    assert abs(ps.lr - 0.05) < 1e-9
+    t.release()
+
+
+def test_grad_allreduce_transpile_single_rank():
+    from paddle_tpu.fluid.transpiler import GradAllReduce
+
+    main, startup, loss = _build_net(lr=0.1)
+    GradAllReduce().transpile(startup, main, rank=0,
+                              endpoints=["127.0.0.1:1"],
+                              current_endpoint="127.0.0.1:1")
+    ops = [op.type for op in main.global_block().ops]
+    assert "c_allreduce_sum" in ops
+    # allreduce precedes its optimizer op
+    assert ops.index("c_allreduce_sum") < ops.index("sgd")
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    xb = rs.randn(8, 4).astype(np.float32)
+    yb = xb.sum(1, keepdims=True).astype(np.float32)
+    l0 = float(exe.run(main, {"x": xb, "y": yb}, [loss])[0])
+    for _ in range(20):
+        lf = float(exe.run(main, {"x": xb, "y": yb}, [loss])[0])
+    assert lf < l0 / 10  # identity allreduce at world=1, training intact
+
+
+def test_local_sgd_transpile_hook_runs():
+    from paddle_tpu.fluid.transpiler import LocalSGD
+
+    main, startup, loss = _build_net()
+    LocalSGD(k_steps=2).transpile(startup, main, rank=0,
+                                  endpoints=["127.0.0.1:1"],
+                                  current_endpoint="127.0.0.1:1")
+    exe = fluid.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    xb = rs.randn(8, 4).astype(np.float32)
+    yb = xb.sum(1, keepdims=True).astype(np.float32)
+    for _ in range(4):  # world=1: averaging is a no-op but must not crash
+        exe.run(main, {"x": xb, "y": yb}, [loss])
