@@ -1430,6 +1430,9 @@ from . import lowering_batch3  # noqa: E402,F401
 # batch-4: sampled losses, CV sampling, fusion_* family, SelectedRows utils
 from . import lowering_batch4  # noqa: E402,F401
 
+# batch-5: metric ops, quant-sim, DGC, io ops, yolov3_loss, aliases
+from . import lowering_batch5  # noqa: E402,F401
+
 
 # ====== book-era op additions (fluid/layers/nn.py 15.2k surface) ======
 

@@ -246,7 +246,8 @@ def _psroi_pool(ctx, op):
     rois = ctx.inp(op, "ROIs")
     lod = ctx.env.get(op.input("ROIs")[0] + LOD_SUFFIX)
     out_c = op.attrs["output_channels"]
-    p = op.attrs["pooled_height"]
+    ph_n = op.attrs["pooled_height"]
+    pw_n = op.attrs.get("pooled_width", ph_n)
     scale = op.attrs.get("spatial_scale", 1.0)
     n, cpp, h, w = x.shape
     if lod is not None:
@@ -263,26 +264,25 @@ def _psroi_pool(ctx, op):
     y1 = jnp.round(rois[:, 1]) * scale
     x2 = (jnp.round(rois[:, 2]) + 1.0) * scale
     y2 = (jnp.round(rois[:, 3]) + 1.0) * scale
-    rh = jnp.maximum(y2 - y1, 0.1) / p
-    rw = jnp.maximum(x2 - x1, 0.1) / p
+    rh = jnp.maximum(y2 - y1, 0.1) / ph_n
+    rw = jnp.maximum(x2 - x1, 0.1) / pw_n
     # dense: sample a fixed SxS lattice per bin and average
     s = 4
-    bins = jnp.arange(p)
     lat = (jnp.arange(s) + 0.5) / s
     # yi[r, ph, a] / xi[r, pw, b]: sample coords inside each bin
-    py = y1[:, None, None] + (bins[None, :, None] +
+    py = y1[:, None, None] + (jnp.arange(ph_n)[None, :, None] +
                               lat[None, None, :]) * rh[:, None, None]
-    px = x1[:, None, None] + (bins[None, :, None] +
+    px = x1[:, None, None] + (jnp.arange(pw_n)[None, :, None] +
                               lat[None, None, :]) * rw[:, None, None]
-    yi = jnp.clip(jnp.floor(py), 0, h - 1).astype(jnp.int32)  # [R, P, S]
-    xi = jnp.clip(jnp.floor(px), 0, w - 1).astype(jnp.int32)
-    xg = x.reshape(n, out_c, p, p, h, w)
+    yi = jnp.clip(jnp.floor(py), 0, h - 1).astype(jnp.int32)  # [R, PH, S]
+    xi = jnp.clip(jnp.floor(px), 0, w - 1).astype(jnp.int32)  # [R, PW, S]
+    xg = x.reshape(n, out_c, ph_n, pw_n, h, w)
     # out[r, c, ph, pw] = mean_{a,b} xg[b_ix[r], c, ph, pw, yi[r,ph,a],
     #                                   xi[r,pw,b]]
     B = batch_ix[:, None, None, None, None, None]
     C = jnp.arange(out_c)[None, :, None, None, None, None]
-    PH = bins[None, None, :, None, None, None]
-    PW = bins[None, None, None, :, None, None]
+    PH = jnp.arange(ph_n)[None, None, :, None, None, None]
+    PW = jnp.arange(pw_n)[None, None, None, :, None, None]
     Y = yi[:, None, :, None, :, None]
     X = xi[:, None, None, :, None, :]
     g = xg[B, C, PH, PW, Y, X]                    # [R, out_c, P, P, S, S]
@@ -303,10 +303,9 @@ LOD_AWARE_OPS.add("psroi_pool")
 # ======================================================================
 
 def _seq_lens(ctx, op, slot):
-    names = op.input(slot)
-    if not names:
-        return None
-    return ctx.env.get(names[0] + LOD_SUFFIX)
+    from .lowering_seq import _lens
+
+    return _lens(ctx, op, slot)
 
 
 def _full_lens(x):

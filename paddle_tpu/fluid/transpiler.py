@@ -30,6 +30,16 @@ _SERVER_RULES = {"sgd", "momentum", "adam", "adagrad"}
 GRAD_SUFFIX = "@GRAD"
 
 
+def install_run_hook(program, hook):
+    """Attach a post-run hook to a Program (Executor.run calls each hook
+    with (exe, program, scope) after persistables are written back)."""
+    hooks = getattr(program, "_run_hooks", None)
+    if hooks is None:
+        hooks = program._run_hooks = []
+    hooks.append(hook)
+    return hook
+
+
 class DistributeTranspilerConfig:
     """Accepted for API parity; block-slicing knobs are advisory — the
     native PS shards whole tensors by name hash across servers."""
@@ -190,15 +200,10 @@ class DistributeTranspiler:
         for g in grad_map.values():
             if g in blk.vars:
                 blk.vars[g].persistable = True
-        hook = _PsTrainerHook(
+        self._hook = install_run_hook(program, _PsTrainerHook(
             endpoints, trainer_id, param_names, grad_map, sync_mode,
             geo_k=(self.config.geo_sgd_need_push_nums
-                   if self.config.geo_sgd_mode else 0))
-        hooks = getattr(program, "_run_hooks", None)
-        if hooks is None:
-            hooks = program._run_hooks = []
-        hooks.append(hook)
-        self._hook = hook
+                   if self.config.geo_sgd_mode else 0)))
         self._pserver_info = (endpoints, trainers, server_opt, lr,
                               param_names, removed)
         return self
@@ -211,7 +216,8 @@ class DistributeTranspiler:
         return PServerProgram(endpoint, trainers, opt, lr, params)
 
     def get_pserver_programs(self, endpoint):
-        return self.get_pserver_program(endpoint), None
+        return (self.get_pserver_program(endpoint),
+                self.get_startup_program(endpoint))
 
     def get_startup_program(self, endpoint=None, pserver_program=None):
         # server-side state is created lazily on first push (the native
@@ -303,7 +309,4 @@ class LocalSGD(Collective):
             for p, v in all_reduce_mean_tree(named).items():
                 scope._values[p] = v
 
-        hooks = getattr(program, "_run_hooks", None)
-        if hooks is None:
-            hooks = program._run_hooks = []
-        hooks.append(hook)
+        install_run_hook(program, hook)

@@ -1,0 +1,359 @@
+"""Op-surface batch 5: metric ops, optimizers, quant-sim, fusions, DGC,
+io ops, yolov3_loss."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.lod import LoDTensor
+
+
+def _run_one(op_type, inputs, outputs, attrs, lod_feeds=None,
+             return_numpy=True):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        blk = main.global_block()
+        in_map = {}
+        for slot, arrs in inputs.items():
+            vs = []
+            for i, a in enumerate(arrs):
+                lod_level = 1 if lod_feeds and (slot, i) in lod_feeds else 0
+                v = blk.create_var(name=f"i_{slot}_{i}",
+                                   shape=list(np.shape(a)),
+                                   dtype=str(np.asarray(a).dtype),
+                                   is_data=True, lod_level=lod_level)
+                vs.append(v)
+            in_map[slot] = vs
+        out_map = {}
+        for slot, n in outputs.items():
+            out_map[slot] = [blk.create_var(name=f"o_{slot}_{i}")
+                             for i in range(n)]
+        blk.append_op(type=op_type, inputs=in_map,
+                      outputs={k: [v.name for v in vs]
+                               for k, vs in out_map.items()},
+                      attrs=attrs)
+    exe = fluid.Executor()
+    exe.run(startup)
+    feed = {}
+    for slot, arrs in inputs.items():
+        for i, a in enumerate(arrs):
+            if lod_feeds and (slot, i) in lod_feeds:
+                flat, lens = lod_feeds[(slot, i)]
+                feed[f"i_{slot}_{i}"] = LoDTensor(
+                    flat, [list(np.cumsum([0] + list(lens)))])
+            else:
+                feed[f"i_{slot}_{i}"] = np.asarray(a)
+    fetch = [v for vs in out_map.values() for v in vs]
+    return exe.run(main, feed, fetch, return_numpy=return_numpy)
+
+
+R = np.random.RandomState(3)
+
+
+def test_hard_shrink_and_proximal_gd():
+    x = np.array([[-1.0, -0.3, 0.2, 0.8]], "float32")
+    (out,) = _run_one("hard_shrink", {"X": [x]}, {"Out": 1},
+                      {"threshold": 0.5})
+    np.testing.assert_allclose(out, [[-1.0, 0.0, 0.0, 0.8]])
+
+    p = np.array([1.0, -2.0], "float32")
+    g = np.array([0.5, 0.5], "float32")
+    lr = np.array([0.1], "float32")
+    (out,) = _run_one("proximal_gd",
+                      {"Param": [p], "Grad": [g], "LearningRate": [lr]},
+                      {"ParamOut": 1}, {"l1": 0.0, "l2": 0.0})
+    np.testing.assert_allclose(out, p - 0.1 * g, rtol=1e-6)
+
+
+def test_decayed_adagrad():
+    p = np.ones(3, "float32")
+    g = np.full(3, 0.5, "float32")
+    m = np.zeros(3, "float32")
+    lr = np.array([0.1], "float32")
+    pout, mout = _run_one(
+        "decayed_adagrad",
+        {"Param": [p], "Grad": [g], "Moment": [m], "LearningRate": [lr]},
+        {"ParamOut": 1, "MomentOut": 1}, {"decay": 0.9, "epsilon": 1e-6})
+    m2 = 0.1 * 0.25
+    np.testing.assert_allclose(mout, m2, rtol=1e-5)
+    np.testing.assert_allclose(pout, 1 - 0.1 * 0.5 / (np.sqrt(m2) + 1e-6),
+                               rtol=1e-5)
+
+
+def test_auc_op():
+    pred = np.stack([1 - np.array([0.9, 0.8, 0.3, 0.1]),
+                     np.array([0.9, 0.8, 0.3, 0.1])], 1).astype("float32")
+    label = np.array([[1], [1], [0], [0]], "int64")
+    pos = np.zeros(4096, "int64")
+    neg = np.zeros(4096, "int64")
+    auc, pout, nout = _run_one(
+        "auc", {"Predict": [pred], "Label": [label], "StatPos": [pos],
+                "StatNeg": [neg]},
+        {"AUC": 1, "StatPosOut": 1, "StatNegOut": 1},
+        {"num_thresholds": 4095})
+    assert float(auc) == pytest.approx(1.0, abs=1e-3)  # perfect ranking
+    assert pout.sum() == 2 and nout.sum() == 2
+
+
+def test_chunk_eval_op():
+    # tags: B-0=0, I-0=1, B-1=2, I-1=3, O=4
+    inf = np.array([[0, 1, 4, 2]], "int64")
+    lab = np.array([[0, 1, 4, 0]], "int64")
+    outs = _run_one("chunk_eval", {"Inference": [inf], "Label": [lab]},
+                    {"Precision": 1, "Recall": 1, "F1-Score": 1,
+                     "NumInferChunks": 1, "NumLabelChunks": 1,
+                     "NumCorrectChunks": 1},
+                    {"num_chunk_types": 2, "chunk_scheme": "IOB"})
+    p, r, f1, ni, nl, nc = [np.asarray(o) for o in outs]
+    assert int(ni) == 2 and int(nl) == 2 and int(nc) == 1
+    assert float(p) == pytest.approx(0.5)
+
+
+def test_positive_negative_pair():
+    score = np.array([[0.9], [0.2], [0.5], [0.4]], "float32")
+    label = np.array([[1], [0], [1], [0]], "float32")
+    qid = np.array([[1], [1], [2], [2]], "int64")
+    pos, neg, neu = _run_one(
+        "positive_negative_pair",
+        {"Score": [score], "Label": [label], "QueryID": [qid]},
+        {"PositivePair": 1, "NegativePair": 1, "NeutralPair": 1}, {})
+    assert pos.ravel()[0] == 2.0 and neg.ravel()[0] == 0.0 and neu.ravel()[0] == 0.0
+
+
+def test_fake_quant_ops():
+    x = R.randn(3, 4).astype("float32")
+    out, scale = _run_one("fake_quantize_dequantize_abs_max", {"X": [x]},
+                          {"Out": 1, "OutScale": 1}, {"bit_length": 8})
+    s = np.abs(x).max()
+    ref = np.clip(np.round(x / s * 127), -127, 127) / 127 * s
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(scale, [s], rtol=1e-6)
+
+    q = _run_one("quantize", {"Input": [x]}, {"Output": 1},
+                 {"Scale": 64.0})[0]
+    assert q.dtype == np.int8
+    d = _run_one("dequantize", {"Input": [q]}, {"Output": 1},
+                 {"Scale": 64.0})[0]
+    np.testing.assert_allclose(d, x, atol=1.5 / 64)
+
+
+def test_multihead_matmul_matches_sdpa():
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.attention import sdpa_reference
+
+    B, S, H, heads = 2, 5, 8, 2
+    x = R.randn(B, S, H).astype("float32")
+    w = R.randn(H, 3, H).astype("float32")       # [H, 3, heads*dh]
+    b = np.zeros((3, H), "float32")
+    (out,) = _run_one(
+        "multihead_matmul",
+        {"Input": [x], "W": [w.reshape(H, 3, H)], "Bias": [b]},
+        {"Out": 1}, {"head_number": heads})
+    qkv = np.einsum("bsh,htd->bstd", x, w.reshape(H, 3, H))
+    dh = H // heads
+
+    def split(i):
+        t = qkv[:, :, i].reshape(B, S, heads, dh)
+        return np.swapaxes(t, 1, 2)
+
+    ref = np.asarray(sdpa_reference(
+        jnp.asarray(split(0)), jnp.asarray(split(1)),
+        jnp.asarray(split(2)), scale=1.0 / np.sqrt(dh)))
+    ref = np.swapaxes(ref, 1, 2).reshape(B, S, H)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fsp_batch_fc_coalesce():
+    x = R.randn(2, 3, 4, 4).astype("float32")
+    y = R.randn(2, 5, 4, 4).astype("float32")
+    (out,) = _run_one("fsp", {"X": [x], "Y": [y]}, {"Out": 1}, {})
+    ref = np.einsum("nchw,ndhw->ncd", x, y) / 16
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+    xi = R.randn(3, 2, 4).astype("float32")
+    w = R.randn(3, 4, 5).astype("float32")
+    b = R.randn(3, 1, 5).astype("float32")
+    (out,) = _run_one("batch_fc", {"Input": [xi], "W": [w], "Bias": [b]},
+                      {"Out": 1}, {})
+    np.testing.assert_allclose(out, np.einsum("sbi,sio->sbo", xi, w) + b,
+                               rtol=1e-4)
+
+    a = R.randn(4).astype("float32")
+    c = R.randn(6).astype("float32")
+    o1, o2, fused = _run_one("coalesce_tensor", {"Input": [a, c]},
+                             {"Output": 2, "FusedOutput": 1}, {})
+    np.testing.assert_allclose(fused, np.concatenate([a, c]))
+
+
+def test_dgc_sparsify():
+    g = np.array([0.1, -5.0, 0.2, 3.0, 0.0, -0.1, 0.05, 1.0],
+                 "float32")
+    u = np.zeros(8, "float32")
+    v = np.zeros(8, "float32")
+    uo, vo, enc, go = _run_one(
+        "dgc", {"U": [u], "V": [v], "Grad": [g]},
+        {"U_out": 1, "V_out": 1, "EncodeGrad": 1, "Grad_out": 1},
+        {"m": 0.9, "ratio": 0.25})  # k = 2
+    nz = np.nonzero(enc)[0]
+    assert set(nz) == {1, 3}                     # two largest |g|
+    np.testing.assert_allclose(enc[nz], g[nz], rtol=1e-6)
+    np.testing.assert_allclose(vo[nz], 0.0)      # residual cleared there
+    np.testing.assert_allclose(vo[0], g[0], rtol=1e-6)  # kept elsewhere
+
+
+def test_save_load_ops_roundtrip():
+    d = tempfile.mkdtemp()
+    x = R.randn(3, 4).astype("float32")
+    path = os.path.join(d, "var.pd")
+    _run_one("save", {"X": [x]}, {}, {"file_path": path})
+    assert os.path.exists(path)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        blk = main.global_block()
+        out = blk.create_var(name="loaded", shape=[3, 4], dtype="float32")
+        blk.append_op(type="load", inputs={},
+                      outputs={"Out": [out.name]},
+                      attrs={"file_path": path})
+    exe = fluid.Executor()
+    exe.run(startup)
+    (got,) = exe.run(main, {}, [out])
+    np.testing.assert_allclose(got, x)
+
+
+def test_save_combine_load_combine():
+    d = tempfile.mkdtemp()
+    a = R.randn(2, 2).astype("float32")
+    b = R.randn(3).astype("float32")
+    path = os.path.join(d, "combined.pd")
+    _run_one("save_combine", {"X": [a, b]}, {}, {"file_path": path})
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        blk = main.global_block()
+        va = blk.create_var(name="i_X_0", shape=[2, 2], dtype="float32")
+        vb = blk.create_var(name="i_X_1", shape=[3], dtype="float32")
+        blk.append_op(type="load_combine", inputs={},
+                      outputs={"Out": [va.name, vb.name]},
+                      attrs={"file_path": path})
+    exe = fluid.Executor()
+    exe.run(startup)
+    ga, gb = exe.run(main, {}, [va, vb])
+    np.testing.assert_allclose(ga, a)
+    np.testing.assert_allclose(gb, b)
+
+
+def test_shard_index_and_hash():
+    x = np.array([[1], [7], [14]], "int64")
+    (out,) = _run_one("shard_index", {"X": [x]}, {"Out": 1},
+                      {"index_num": 20, "nshards": 2, "shard_id": 1,
+                       "ignore_value": -1})
+    np.testing.assert_array_equal(out, [[-1], [-1], [4]])
+
+    ids = np.array([[3], [3], [9]], "int64")
+    (h,) = _run_one("hash", {"X": [ids]}, {"Out": 1},
+                    {"num_hash": 2, "mod_by": 1000})
+    assert h.shape == (3, 2, 1)
+    assert (h >= 0).all() and (h < 1000).all()
+    np.testing.assert_array_equal(h[0], h[1])    # deterministic
+    assert (h[0] != h[2]).any()
+
+
+def test_sequence_erase():
+    flat = np.array([1, 2, 3, 2, 9], "int64")    # rows [3, 2]
+    outs = _run_one("sequence_erase", {"X": [flat.reshape(-1, 1)[:, 0]]},
+                    {"Out": 1}, {"tokens": [2]},
+                    lod_feeds={("X", 0): (flat, [3, 2])},
+                    return_numpy=False)
+    lt = outs[0]
+    assert lt.recursive_sequence_lengths() == [[2, 1]]
+    np.testing.assert_array_equal(np.asarray(lt), [1, 3, 9])
+
+
+def test_lstmp_shapes():
+    B, T, D, P = 2, 4, 6, 3
+    x = R.randn(B, T, 4 * D).astype("float32")
+    wh = R.randn(P, 4 * D).astype("float32")
+    wp = R.randn(D, P).astype("float32")
+    proj, cell = _run_one(
+        "lstmp", {"Input": [x], "Weight": [wh], "ProjWeight": [wp]},
+        {"Projection": 1, "Cell": 1}, {})
+    assert proj.shape == (B, T, P) and cell.shape == (B, T, D)
+    assert np.isfinite(proj).all()
+
+
+def test_select_output():
+    x = np.full((2, 2), 5.0, "float32")
+    mask = np.array([1], "int32")
+    o0, o1 = _run_one("select_output", {"X": [x], "Mask": [mask]},
+                      {"Out": 2}, {})
+    np.testing.assert_allclose(o0, 0.0)
+    np.testing.assert_allclose(o1, x)
+
+
+def test_yolov3_loss_sanity():
+    N, C, H, W = 1, 3, 4, 4
+    A = 2
+    x = (R.randn(N, A * (5 + C), H, W) * 0.1).astype("float32")
+    gtbox = np.zeros((N, 2, 4), "float32")
+    gtbox[0, 0] = [0.4, 0.4, 0.25, 0.25]         # one valid box
+    gtlabel = np.zeros((N, 2), "int64")
+    loss, objmask, match = _run_one(
+        "yolov3_loss",
+        {"X": [x], "GTBox": [gtbox], "GTLabel": [gtlabel]},
+        {"Loss": 1, "ObjectnessMask": 1, "GTMatchMask": 1},
+        {"anchors": [10, 13, 16, 30, 33, 23],
+         "anchor_mask": [1, 2], "class_num": C,
+         "ignore_thresh": 0.7, "downsample_ratio": 32})
+    assert loss.shape == (N,)
+    assert np.isfinite(loss).all() and loss[0] > 0
+    assert objmask.sum() == 1.0                  # exactly one positive
+    # the positive sits at the gt center cell
+    assert objmask[0, :, 1, 1].sum() == 1.0
+
+
+def test_collective_aliases_identity():
+    x = R.randn(2, 3).astype("float32")
+    (out,) = _run_one("allreduce", {"X": [x]}, {"Out": 1}, {})
+    np.testing.assert_allclose(out, x)
+    (out,) = _run_one("c_reduce_sum", {"X": [x]}, {"Out": 1}, {})
+    np.testing.assert_allclose(out, x)
+
+
+def test_lstmp_initial_state_and_peepholes():
+    B, T, D, P = 1, 2, 2, 2
+    x = np.zeros((B, T, 4 * D), "float32")
+    wh = np.zeros((P, 4 * D), "float32")
+    wp = np.eye(D, P).astype("float32")
+    h0 = np.full((B, P), 0.3, "float32")
+    c0 = np.full((B, D), 0.7, "float32")
+    b = np.zeros((1, 7 * D), "float32")
+    b[0, 5 * D:6 * D] = 100.0  # checkF huge -> forget gate saturates to 1
+    proj, cell = _run_one(
+        "lstmp",
+        {"Input": [x], "Weight": [wh], "ProjWeight": [wp],
+         "H0": [h0], "C0": [c0], "Bias": [b]},
+        {"Projection": 1, "Cell": 1}, {"use_peepholes": True})
+    # cell carried over: c2 ~= c0 * 1 (peephole forced forget open)
+    np.testing.assert_allclose(cell[0, 0], 0.7, atol=0.02)
+
+
+def test_psroi_pool_rectangular_bins():
+    PH, PW, OC = 2, 4, 1
+    x = np.zeros((1, OC * PH * PW, 8, 8), "float32")
+    for c in range(OC * PH * PW):
+        x[0, c] = c
+    rois = np.array([[0, 0, 7, 7]], "float32")
+    outs = _run_one(
+        "psroi_pool", {"X": [x], "ROIs": [rois]}, {"Out": 1},
+        {"output_channels": OC, "pooled_height": PH, "pooled_width": PW,
+         "spatial_scale": 1.0},
+        lod_feeds={("ROIs", 0): (rois, [1])}, return_numpy=False)
+    out = np.asarray(outs[0])
+    assert out.shape == (1, OC, PH, PW)
+    for ph in range(PH):
+        for pw in range(PW):
+            np.testing.assert_allclose(out[0, 0, ph, pw], ph * PW + pw)
