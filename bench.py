@@ -305,8 +305,18 @@ def main():
         "status": "skipped: single real chip; code path validated by "
                   "__graft_entry__.dryrun_multichip(8)"}
     try:
+        # single-config runs MERGE into the record instead of clobbering
+        # the other configs' results
+        merged = {}
+        if only:
+            try:
+                with open("BENCH_DETAILS.json") as f:
+                    merged = json.load(f)
+            except Exception:
+                merged = {}
+        merged.update(results)
         with open("BENCH_DETAILS.json", "w") as f:
-            json.dump(results, f, indent=1)
+            json.dump(merged, f, indent=1)
     except Exception:
         pass
     if headline is None:

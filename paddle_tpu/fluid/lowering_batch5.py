@@ -120,9 +120,13 @@ def _auc(ctx, op):
     buckets = pos_in.reshape(-1).shape[0]
     p1 = pred[:, -1].astype(jnp.float32)
     ix = jnp.clip((p1 * k).astype(jnp.int32), 0, buckets - 1)
-    lab = label.astype(jnp.float32)
-    pos = pos_in.reshape(-1).astype(jnp.float32).at[ix].add(lab)
-    neg = neg_in.reshape(-1).astype(jnp.float32).at[ix].add(1.0 - lab)
+    # accumulate the persistent counters in int64: f32 would freeze a
+    # bucket at ~2^24 increments (x + 1 == x) on long streaming runs
+    lab_i = label.astype(jnp.int64)
+    pos_i = pos_in.reshape(-1).astype(jnp.int64).at[ix].add(lab_i)
+    neg_i = neg_in.reshape(-1).astype(jnp.int64).at[ix].add(1 - lab_i)
+    pos = pos_i.astype(jnp.float64)
+    neg = neg_i.astype(jnp.float64)
 
     # trapezoid area from the highest threshold down (metrics/auc_op.h)
     rpos = jnp.cumsum(pos[::-1])
@@ -137,9 +141,9 @@ def _auc(ctx, op):
     auc = jnp.where(tot_pos * tot_neg > 0, area / (tot_pos * tot_neg),
                     0.5)
     ctx.out(op, "AUC", auc)
-    ctx.out(op, "StatPosOut", pos.astype(pos_in.dtype).reshape(
+    ctx.out(op, "StatPosOut", pos_i.astype(pos_in.dtype).reshape(
         pos_in.shape))
-    ctx.out(op, "StatNegOut", neg.astype(neg_in.dtype).reshape(
+    ctx.out(op, "StatNegOut", neg_i.astype(neg_in.dtype).reshape(
         neg_in.shape))
 
 
@@ -159,10 +163,9 @@ def _chunk_eval(ctx, op):
         raise NotImplementedError(
             f"chunk_eval scheme {scheme!r}: only IOB tagging is lowered")
     excluded = set(op.attrs.get("excluded_chunk_types", []) or [])
-    lens_name = op.input("Inference")[0] + LOD_SUFFIX
-    lens = ctx.env.get(lens_name)
-    if lens is None:
-        lens = jnp.full((inf.shape[0],), inf.shape[1], jnp.int32)
+    from .lowering_seq import _lens_or_full
+
+    lens = _lens_or_full(ctx, op, "Inference", inf)
 
     def host(inf_np, lab_np, lens_np):
         from ..metric import ChunkEvaluator
@@ -611,10 +614,9 @@ def _sequence_erase(ctx, op):
 
     jnp = _jnp()
     x = ctx.inp(op, "X")                         # [B, T] ids
-    name = op.input("X")[0]
-    lens = ctx.env.get(name + LOD_SUFFIX)
-    if lens is None:
-        lens = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    from .lowering_seq import _lens_or_full
+
+    lens = _lens_or_full(ctx, op, "X", x)
     tokens = jnp.asarray(op.attrs.get("tokens", []), x.dtype)
     T = x.shape[1]
     valid = jnp.arange(T)[None, :] < lens[:, None]
@@ -648,10 +650,11 @@ def _lstmp(ctx, op):
     b = ctx.inp(op, "Bias")
     h0_in = ctx.inp(op, "H0")
     c0_in = ctx.inp(op, "C0")
-    lens_name = op.input("Input")[0] + LOD_SUFFIX
-    lens = ctx.env.get(lens_name)
-    if lens is None:
-        lens = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    from .lowering_seq import _lens
+    from .lowering_seq import _lens_or_full
+
+    lens_in = _lens(ctx, op, "Input")
+    lens = _lens_or_full(ctx, op, "Input", x)
     B, T, D4 = x.shape
     D = D4 // 4
     P = wproj.shape[1]
@@ -696,7 +699,7 @@ def _lstmp(ctx, op):
     ctx.out(op, "Cell", cs)
     for slot in ("Projection", "Cell"):
         names = op.output(slot)
-        if names and ctx.env.get(lens_name) is not None:
+        if names and lens_in is not None:
             ctx.env[names[0] + LOD_SUFFIX] = lens
 
 

@@ -859,16 +859,20 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
-                                 training=True, name=None):
+                                 training=True, name=None,
+                                 layout="BHSD"):
     """TPU-first attention entry. Uses the pallas flash kernel on TPU when
-    shapes allow; falls back to the XLA softmax composition elsewhere."""
+    shapes allow; falls back to the XLA softmax composition elsewhere.
+    layout="BSHD" takes [batch, seq, heads, dim] operands and skips the
+    head transposes entirely on the short-sequence XLA path."""
     from ...ops import attention as A
 
     args = [query, key, value]
     if attn_mask is not None:
         args.append(attn_mask)
+    sdpa_fn = A.sdpa_bshd if layout == "BSHD" else A.sdpa
 
     def fn(q, k, v, *m):
-        return A.sdpa(q, k, v, m[0] if m else None, is_causal)
+        return sdpa_fn(q, k, v, m[0] if m else None, is_causal)
 
     return _op("sdpa", fn, *args)

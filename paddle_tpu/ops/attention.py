@@ -489,6 +489,47 @@ def _flash_usable():
     return ok
 
 
+def sdpa_reference_bshd(q, k, v, mask=None, is_causal=False, scale=None):
+    """XLA attention over [batch, seq, heads, head_dim] operands: the
+    head transpose folds into the einsum's dimension numbers instead of
+    materializing (measured 1.3x on the ERNIE-block attention stack vs
+    explicit BHSD transposes). Output is [B, S, H, D]."""
+    import jax
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * s
+    if is_causal:
+        qlen, klen = logits.shape[-2], logits.shape[-1]
+        cmask = jnp.tril(jnp.ones((qlen, klen), bool), klen - qlen)
+        logits = jnp.where(cmask, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+
+
+def sdpa_bshd(q, k, v, mask=None, is_causal=False, scale=None):
+    """sdpa over [B, S, H, D] operands. Long sequences transpose into the
+    flash kernel's BHSD layout (transpose cost is negligible vs S^2
+    attention there); short sequences stay transpose-free on XLA."""
+    import jax.numpy as jnp
+
+    min_flash_len = int(os.environ.get("PT_FLASH_MIN_SEQ", "512"))
+    if _on_tpu() and q.ndim == 4 and q.shape[-1] <= 256 \
+            and q.shape[1] >= min_flash_len:
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        out = sdpa(qh, kh, vh, mask, is_causal, scale)
+        return jnp.swapaxes(out, 1, 2)
+    return sdpa_reference_bshd(q, k, v, mask, is_causal, scale)
+
+
 def sdpa(q, k, v, mask=None, is_causal=False, scale=None):
     """Dispatch: pallas flash fwd+bwd on TPU whenever the mask reduces to
     a key-position bias (incl. every padded batch); XLA reference
