@@ -33,6 +33,7 @@ __all__ = [
     "less_than", "less_equal", "greater_than", "greater_equal", "equal",
     "not_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
     "create_array", "array_write", "array_read", "array_length",
+    "DynamicRNN", "IfElse",
 ]
 
 
@@ -468,3 +469,183 @@ def array_length(array):
     helper.append_op(type="lod_array_length", inputs={"X": [array]},
                      outputs={"Out": [out]}, attrs={})
     return out
+
+
+class DynamicRNN(StaticRNN):
+    """fluid.layers.DynamicRNN parity (control_flow.py DynamicRNN over
+    recurrent_op with LoD sequences). TPU-native form: step inputs are
+    padded [B, T, ...] sequences with a lengths companion; the scan runs
+    time-major, memories FREEZE past each row's length and outputs zero
+    there, so shorter rows behave exactly as if their recurrence stopped
+    (LoD batch semantics without dynamic shapes).
+
+    Usage::
+
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            w = drnn.step_input(sentence)    # sequence var, lod_level=1
+            prev = drnn.memory(shape=[H], value=0.0)
+            h = layers.tanh(layers.fc(w, H) + layers.fc(prev, H))
+            drnn.update_memory(prev, h)
+            drnn.output(h)
+        hs = drnn()                          # padded [B, T, H] sequence
+    """
+
+    block = StaticRNN.step                  # reference API name
+
+    def step_input(self, x, level=0):
+        blk = self._require_block()
+        if self.seq_len is None:
+            self.seq_len = x.shape[1] if x.shape and len(x.shape) > 1 \
+                else None
+        ipt = blk.create_var(name=unique_name.generate(f"{x.name}@step"),
+                             shape=[x.shape[0]] + list(x.shape[2:])
+                             if x.shape else None,
+                             dtype=x.dtype)
+        self._step_inputs.append((ipt.name, x))
+        return ipt
+
+    def memory(self, init=None, shape=None, value=0.0, need_reorder=False,
+               dtype="float32", **kw):
+        if init is not None:
+            return super().memory(init=init)
+        if shape is None:
+            raise ValueError("DynamicRNN.memory needs init= or shape=")
+        # boot [B, *shape] where B comes from the first step input's
+        # batch dim (dim 0 of the padded source)
+        if not self._step_inputs:
+            raise ValueError(
+                "call step_input() before memory(shape=...) so the boot "
+                "knows the batch size")
+        blk = self._require_block()
+        prog = blk.program
+        parent = prog.block(blk.parent_idx)
+        src = self._step_inputs[0][1]
+        init_var = parent.create_var(
+            name=unique_name.generate("drnn_boot"),
+            shape=list(shape), dtype=dtype)
+        parent.append_op(
+            type="fill_constant_batch_size_like",
+            inputs={"Input": [src]},
+            outputs={"Out": [init_var]},
+            attrs={"shape": [-1] + list(shape), "value": float(value),
+                   "dtype": dtype, "input_dim_idx": 0,
+                   "output_dim_idx": 0})
+        pre = blk.create_var(
+            name=unique_name.generate(f"{init_var.name}@pre"),
+            shape=[-1] + list(shape), dtype=dtype)
+        self._memories.append({"boot": init_var, "pre": pre.name,
+                               "new": None})
+        return pre
+
+    def _complete(self, blk):
+        for m in self._memories:
+            if m["new"] is None:
+                raise ValueError(
+                    f"memory {m['pre']} was never update_memory'd")
+        prog = blk.program
+        parent = prog.block(blk.parent_idx)
+        outs = []
+        for o in self._step_outputs:
+            shape = [o.shape[0] if o.shape else -1,
+                     self.seq_len if self.seq_len is not None else -1] \
+                + list(o.shape[1:] if o.shape else [])
+            v = parent.create_var(
+                name=unique_name.generate("drnn_out"),
+                shape=shape, dtype=o.dtype, lod_level=1)
+            outs.append(v)
+        parent.append_op(
+            type="recurrent",
+            inputs={"StepInputs": [v.name for _, v in self._step_inputs],
+                    "BootMemories": [m["boot"].name
+                                     for m in self._memories]},
+            outputs={"Out": [o.name for o in outs]},
+            attrs={"sub_block": blk.idx,
+                   "batch_major": True,
+                   "step_in_names": [n for n, _ in self._step_inputs],
+                   "src_names": [v.name for _, v in self._step_inputs],
+                   "boot_names": [m["boot"].name for m in self._memories],
+                   "pre_names": [m["pre"] for m in self._memories],
+                   "new_names": [m["new"] for m in self._memories],
+                   "step_out_names": [o.name
+                                      for o in self._step_outputs],
+                   "out_names": [o.name for o in outs]})
+        self._parent_outs = outs
+        self._block = None
+
+
+class IfElse:
+    """fluid.layers.IfElse parity (control_flow.py IfElse over
+    split_lod_tensor/merge_lod_tensor). TPU-native form: the reference
+    physically partitions rows by the condition, runs each block on its
+    partition, and merges; here BOTH blocks run on the full batch and the
+    outputs merge row-wise with a select — identical results for pure
+    (per-row) blocks, static shapes throughout, and XLA dead-code
+    eliminates whatever a branch doesn't contribute.
+
+    Usage::
+
+        ie = layers.IfElse(cond)             # cond: bool [N, 1]
+        with ie.true_block():
+            d = ie.input(x)
+            ie.output(layers.fc(d, 1))
+        with ie.false_block():
+            d = ie.input(x)
+            ie.output(d * 0.0)
+        out, = ie()
+    """
+
+    def __init__(self, cond, name=None):
+        self.cond = cond
+        self._branch = None
+        self._outputs = {"true": [], "false": []}
+
+    @contextlib.contextmanager
+    def true_block(self):
+        self._branch = "true"
+        yield
+        self._branch = None
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self._branch = "false"
+        yield
+        self._branch = None
+
+    def input(self, x):
+        if self._branch is None:
+            raise RuntimeError("IfElse.input used outside a block")
+        return x
+
+    def output(self, *outs):
+        if self._branch is None:
+            raise RuntimeError("IfElse.output used outside a block")
+        self._outputs[self._branch].extend(outs)
+
+    def __call__(self):
+        t, f = self._outputs["true"], self._outputs["false"]
+        if len(t) != len(f):
+            raise ValueError(
+                f"IfElse branches produced {len(t)} vs {len(f)} outputs")
+        merged = []
+        for tv, fv in zip(t, f):
+            helper = LayerHelper("ifelse_merge")
+            # rank-align the [N, 1] condition to the output: a bare [N]
+            # branch output would otherwise broadcast where() to [N, N]
+            cond = self.cond
+            ndim = len(tv.shape) if tv.shape else 1
+            if ndim != 2:
+                flat = helper.create_variable_for_type_inference(
+                    "bool", [-1] + [1] * (ndim - 1))
+                helper.append_op(
+                    type="reshape2", inputs={"X": [cond]},
+                    outputs={"Out": [flat]},
+                    attrs={"shape": [-1] + [1] * (ndim - 1)})
+                cond = flat
+            out = helper.create_variable_for_type_inference(tv.dtype)
+            helper.append_op(
+                type="where",
+                inputs={"Condition": [cond], "X": [tv], "Y": [fv]},
+                outputs={"Out": [out]}, attrs={})
+            merged.append(out)
+        return merged

@@ -1168,9 +1168,22 @@ def _recurrent(ctx, op):
     a = op.attrs
     srcs = [ctx.env[n] for n in a["src_names"]]
     boots = [ctx.env[n] for n in a["boot_names"]]
+    batch_major = a.get("batch_major", False)
+    lens = None
+    if batch_major:
+        # DynamicRNN form: sources are padded [B, T, ...] sequences with
+        # a lengths companion; scan runs time-major, memories freeze and
+        # outputs zero past each row's length (recurrent_op.cc over LoD)
+        from ..core.lod import LOD_SUFFIX
+
+        for n in a["src_names"]:
+            lens = ctx.env.get(n + LOD_SUFFIX, lens)
+        srcs = [jnp.swapaxes(s, 0, 1) for s in srcs]
     base_env = dict(ctx.env)
     body_key = ctx.next_key()
     T = srcs[0].shape[0] if srcs else 0
+    if lens is None and batch_major and srcs:
+        lens = jnp.full((srcs[0].shape[1],), T, jnp.int32)
 
     def scan_fn(carry, xs):
         t = xs[0]
@@ -1181,12 +1194,28 @@ def _recurrent(ctx, op):
         trace_block(prog, a["sub_block"], env, key, ctx.training)
         new_carry = tuple(env[n] for n in a["new_names"])
         ys = tuple(env[n] for n in a["step_out_names"])
+        if lens is not None:
+            alive = t < lens                      # [B]
+            new_carry = tuple(
+                jnp.where(alive.reshape((-1,) + (1,) * (new.ndim - 1)),
+                          new, old)
+                for new, old in zip(new_carry, carry))
+            ys = tuple(
+                jnp.where(alive.reshape((-1,) + (1,) * (y.ndim - 1)),
+                          y, jnp.zeros_like(y)) for y in ys)
         return new_carry, ys
 
     xs = (jnp.arange(T),) + tuple(srcs)
     _, ys = jax.lax.scan(scan_fn, tuple(boots), xs)
     for n, y in zip(a["out_names"], ys):
-        ctx.env[n] = y
+        if batch_major:
+            from ..core.lod import LOD_SUFFIX
+
+            ctx.env[n] = jnp.swapaxes(y, 0, 1)    # back to [B, T, ...]
+            if lens is not None:
+                ctx.env[n + LOD_SUFFIX] = lens
+        else:
+            ctx.env[n] = y
 
 
 # ====== LoDTensorArray ops (unrolled trace mode; python list in env) ======

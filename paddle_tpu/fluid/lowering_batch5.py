@@ -120,8 +120,11 @@ def _auc(ctx, op):
     buckets = pos_in.reshape(-1).shape[0]
     p1 = pred[:, -1].astype(jnp.float32)
     ix = jnp.clip((p1 * k).astype(jnp.int32), 0, buckets - 1)
-    # accumulate the persistent counters in int64: f32 would freeze a
-    # bucket at ~2^24 increments (x + 1 == x) on long streaming runs
+    # accumulate the persistent counters in int64 (real int64 — this
+    # framework force-enables jax x64 at import for paddle dtype parity):
+    # f32 would freeze a bucket at ~2^24 increments (x + 1 == x) on long
+    # streaming runs; the f64 casts below touch only this 4096-bucket
+    # vector once per call, so TPU f64 emulation cost is noise
     lab_i = label.astype(jnp.int64)
     pos_i = pos_in.reshape(-1).astype(jnp.int64).at[ix].add(lab_i)
     neg_i = neg_in.reshape(-1).astype(jnp.int64).at[ix].add(1 - lab_i)
@@ -651,10 +654,10 @@ def _lstmp(ctx, op):
     h0_in = ctx.inp(op, "H0")
     c0_in = ctx.inp(op, "C0")
     from .lowering_seq import _lens
-    from .lowering_seq import _lens_or_full
 
     lens_in = _lens(ctx, op, "Input")
-    lens = _lens_or_full(ctx, op, "Input", x)
+    lens = lens_in if lens_in is not None else jnp.full(
+        (x.shape[0],), x.shape[1], jnp.int32)
     B, T, D4 = x.shape
     D = D4 // 4
     P = wproj.shape[1]

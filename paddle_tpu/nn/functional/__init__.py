@@ -864,15 +864,24 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     """TPU-first attention entry. Uses the pallas flash kernel on TPU when
     shapes allow; falls back to the XLA softmax composition elsewhere.
     layout="BSHD" takes [batch, seq, heads, dim] operands and skips the
-    head transposes entirely on the short-sequence XLA path."""
+    head transposes entirely on the short-sequence XLA path. Attention
+    dropout (the reference MultiHeadAttention's dropout on the softmax
+    output) applies on the XLA paths; a nonzero training-mode dropout_p
+    disqualifies the flash kernel (it has no dropout support)."""
     from ...ops import attention as A
 
+    if layout not in ("BHSD", "BSHD"):
+        raise ValueError(f"sdpa layout must be 'BHSD' or 'BSHD', got "
+                         f"{layout!r}")
     args = [query, key, value]
     if attn_mask is not None:
         args.append(attn_mask)
     sdpa_fn = A.sdpa_bshd if layout == "BSHD" else A.sdpa
+    p = float(dropout_p or 0.0) if training else 0.0
+    key_ = _random.next_key() if p else None
 
     def fn(q, k, v, *m):
-        return sdpa_fn(q, k, v, m[0] if m else None, is_causal)
+        return sdpa_fn(q, k, v, m[0] if m else None, is_causal,
+                       dropout_p=p, dropout_key=key_)
 
     return _op("sdpa", fn, *args)

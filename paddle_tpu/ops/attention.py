@@ -26,7 +26,21 @@ def _jnp():
     return jnp
 
 
-def sdpa_reference(q, k, v, mask=None, is_causal=False, scale=None):
+def _prob_dropout(probs, dropout_p, dropout_key):
+    """Attention-probability dropout (the reference multihead attention's
+    dropout on the softmax output). f32 probability — see kernels.dropout."""
+    import jax
+    import jax.numpy as jnp
+
+    if not dropout_p or dropout_key is None:
+        return probs
+    keep = jax.random.bernoulli(dropout_key, jnp.float32(1.0 - dropout_p),
+                                probs.shape)
+    return jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+
+
+def sdpa_reference(q, k, v, mask=None, is_causal=False, scale=None,
+                   dropout_p=0.0, dropout_key=None):
     """Plain XLA attention: always correct, runs anywhere, XLA fuses it."""
     import jax
     import jax.numpy as jnp
@@ -44,6 +58,7 @@ def sdpa_reference(q, k, v, mask=None, is_causal=False, scale=None):
         else:
             logits = logits + mask
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    probs = _prob_dropout(probs, dropout_p, dropout_key)
     return jnp.einsum("...qk,...kd->...qd", probs.astype(q.dtype), v)
 
 
@@ -489,7 +504,8 @@ def _flash_usable():
     return ok
 
 
-def sdpa_reference_bshd(q, k, v, mask=None, is_causal=False, scale=None):
+def sdpa_reference_bshd(q, k, v, mask=None, is_causal=False, scale=None,
+                        dropout_p=0.0, dropout_key=None):
     """XLA attention over [batch, seq, heads, head_dim] operands: the
     head transpose folds into the einsum's dimension numbers instead of
     materializing (measured 1.3x on the ERNIE-block attention stack vs
@@ -510,42 +526,64 @@ def sdpa_reference_bshd(q, k, v, mask=None, is_causal=False, scale=None):
         else:
             logits = logits + mask
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    probs = _prob_dropout(probs, dropout_p, dropout_key)
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
 
 
-def sdpa_bshd(q, k, v, mask=None, is_causal=False, scale=None):
+def _flash_candidate(heads_seq_q, heads_seq_k, head_dim, mask,
+                     batch, heads, dropout_p=0.0):
+    """All the flash-dispatch gates in one place: TPU backend, long
+    enough sequence, block-divisible lengths, head_dim small enough, a
+    mask reducible to a key-position bias, kernel importable, and no
+    prob-dropout (the blockwise kernel has no dropout support)."""
+    min_flash_len = int(os.environ.get("PT_FLASH_MIN_SEQ", "512"))
+    if dropout_p:
+        return False
+    if not (_on_tpu() and head_dim <= 256
+            and heads_seq_q >= min_flash_len
+            and heads_seq_q % min(256, heads_seq_q) == 0
+            and heads_seq_k % min(256, heads_seq_k) == 0):
+        return False
+    if mask is not None and _kv_bias(mask, batch, heads,
+                                     heads_seq_k) is None:
+        return False
+    return _flash_usable()
+
+
+def sdpa_bshd(q, k, v, mask=None, is_causal=False, scale=None,
+              dropout_p=0.0, dropout_key=None):
     """sdpa over [B, S, H, D] operands. Long sequences transpose into the
     flash kernel's BHSD layout (transpose cost is negligible vs S^2
-    attention there); short sequences stay transpose-free on XLA."""
+    attention there); everything else stays transpose-free on XLA."""
     import jax.numpy as jnp
 
-    min_flash_len = int(os.environ.get("PT_FLASH_MIN_SEQ", "512"))
-    if _on_tpu() and q.ndim == 4 and q.shape[-1] <= 256 \
-            and q.shape[1] >= min_flash_len:
+    if q.ndim == 4 and _flash_candidate(q.shape[1], k.shape[1],
+                                        q.shape[-1], mask, q.shape[0],
+                                        q.shape[2], dropout_p):
         qh = jnp.swapaxes(q, 1, 2)
         kh = jnp.swapaxes(k, 1, 2)
         vh = jnp.swapaxes(v, 1, 2)
         out = sdpa(qh, kh, vh, mask, is_causal, scale)
         return jnp.swapaxes(out, 1, 2)
-    return sdpa_reference_bshd(q, k, v, mask, is_causal, scale)
+    return sdpa_reference_bshd(q, k, v, mask, is_causal, scale,
+                               dropout_p, dropout_key)
 
 
-def sdpa(q, k, v, mask=None, is_causal=False, scale=None):
+def sdpa(q, k, v, mask=None, is_causal=False, scale=None,
+         dropout_p=0.0, dropout_key=None):
     """Dispatch: pallas flash fwd+bwd on TPU whenever the mask reduces to
     a key-position bias (incl. every padded batch); XLA reference
     otherwise. Short sequences (< 512) stay on the XLA path — its fused
     attention beats the blockwise kernel there and the S x S buffer is
     tiny; flash pays off where it matters, long context (measured:
     ERNIE seq 128 is ~2% faster on the reference path)."""
-    min_flash_len = int(os.environ.get("PT_FLASH_MIN_SEQ", "512"))
-    if _on_tpu() and q.ndim == 4 and q.shape[-1] <= 256 \
-            and q.shape[2] >= min_flash_len \
-            and q.shape[2] % min(256, q.shape[2]) == 0 \
-            and k.shape[2] % min(256, k.shape[2]) == 0:
+    if q.ndim == 4 and _flash_candidate(q.shape[2], k.shape[2],
+                                        q.shape[-1], mask, q.shape[0],
+                                        q.shape[1], dropout_p):
         bias = _kv_bias(mask, q.shape[0], q.shape[1], k.shape[2])
-        if (mask is None or bias is not None) and _flash_usable():
-            try:
-                return flash_attention(q, k, v, bias, is_causal, scale)
-            except Exception:
-                pass
-    return sdpa_reference(q, k, v, mask, is_causal, scale)
+        try:
+            return flash_attention(q, k, v, bias, is_causal, scale)
+        except Exception:
+            pass
+    return sdpa_reference(q, k, v, mask, is_causal, scale,
+                          dropout_p, dropout_key)

@@ -263,3 +263,58 @@ def test_create_global_var_persists():
         out = v + 1.0
     ov, = _run(main, startup, {}, [out])
     assert float(ov[0]) == 3.0
+
+
+def test_dynamic_rnn_cumsum_variable_length():
+    """DynamicRNN over a LoD sequence: memories freeze and outputs zero
+    past each row's length (recurrent_op LoD semantics)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.lod import LoDTensor
+    layers = fluid.layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        blk = main.global_block()
+        x = blk.create_var(name="drnn_seq", shape=[-1, 4, 2],
+                           dtype="float32", is_data=True, lod_level=1)
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            w = drnn.step_input(x)
+            prev = drnn.memory(shape=[2], value=0.0)
+            s = layers.elementwise_add(w, prev)
+            drnn.update_memory(prev, s)
+            drnn.output(s)
+        out = drnn()
+    exe = fluid.Executor()
+    exe.run(startup)
+    flat = np.arange(10, dtype=np.float32).reshape(5, 2)  # rows [3, 2]
+    res, = exe.run(main, {"drnn_seq": LoDTensor(flat, [[0, 3, 5]])},
+                   [out], return_numpy=False)
+    assert res.recursive_sequence_lengths()[0] == [3, 2]
+    exp = np.concatenate([np.cumsum(flat[:3], 0), np.cumsum(flat[3:], 0)])
+    np.testing.assert_allclose(np.asarray(res), exp, rtol=1e-6)
+
+
+def test_ifelse_rowwise_select():
+    import paddle_tpu.fluid as fluid
+    layers = fluid.layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        xv = fluid.layers.data("ie_x", [3], dtype="float32")
+        c = main.global_block().create_var(name="ie_c", shape=[-1, 1],
+                                           dtype="bool", is_data=True)
+        ie = layers.IfElse(c)
+        with ie.true_block():
+            d = ie.input(xv)
+            ie.output(fluid.layers.scale(d, 2.0))
+        with ie.false_block():
+            d = ie.input(xv)
+            ie.output(fluid.layers.scale(d, -1.0))
+        merged, = ie()
+    exe = fluid.Executor()
+    exe.run(startup)
+    xb = np.arange(12, dtype=np.float32).reshape(4, 3)
+    cb = np.array([[True], [False], [True], [False]])
+    got, = exe.run(main, {"ie_x": xb, "ie_c": cb}, [merged])
+    np.testing.assert_allclose(got, np.where(cb, xb * 2.0, -xb))

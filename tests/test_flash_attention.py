@@ -127,3 +127,24 @@ def test_flash_bias_gradient():
     assert float(np.abs(np.asarray(g_ref)).max()) > 1e-4
     np.testing.assert_allclose(np.asarray(g_fl), np.asarray(g_ref),
                                rtol=2e-3, atol=2e-4)
+
+
+def test_attention_prob_dropout_applies():
+    """MultiHeadAttention dropout must actually drop attention probs in
+    training mode (reference MultiHeadAttention applies dropout to the
+    softmax output) and be a no-op in eval."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.layer.transformer import MultiHeadAttention
+
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(2, 8, 16).astype(np.float32))
+    attn = MultiHeadAttention(16, 2, dropout=0.7)
+    attn.eval()
+    o_eval1 = np.asarray(attn(x)._data)
+    o_eval2 = np.asarray(attn(x)._data)
+    np.testing.assert_allclose(o_eval1, o_eval2)  # eval: deterministic
+    attn.train()
+    o_train = np.asarray(attn(x)._data)
+    # training with p=0.7 must differ from eval output
+    assert np.abs(o_train - o_eval1).max() > 1e-4
