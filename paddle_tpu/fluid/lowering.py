@@ -27,9 +27,62 @@ def register(op_type):
     return deco
 
 
+# ops deliberately NOT lowered, with the design reason (the judge of
+# "missing" vs "excluded"): engine-delegation and vendor-runtime ops have
+# no TPU analogue (XLA IS the engine), pslib ops merged into the single
+# native PS, dynamic-output-shape ops exist eagerly (paddle.unique/
+# masked_select/nonzero) but cannot have static XLA shapes, queue/section
+# ops are subsumed by the SPMD pipeline schedule, and RPC ops live at the
+# executor boundary (run-hooks / PServerProgram), never inside a jit.
+EXCLUDED_OPS = {
+    "tensorrt_engine": "subgraph delegation: XLA is the engine here",
+    "lite_engine": "subgraph delegation: XLA is the engine here",
+    "fusion_group": "runtime codegen: XLA fusion subsumes it",
+    "nccl": "XLA ICI collectives replace NCCL (SURVEY §2.4)",
+    "cudnn_lstm": "cudnn-packed-weight RNN; use lstm/dynamic_lstm",
+    "listen_and_serv": "serving loop lives in PServerProgram, not an op",
+    "fl_listen_and_serv": "see listen_and_serv",
+    "send_and_recv": "PS RPC happens at the executor boundary "
+                     "(transpiler run-hooks), never inside XLA",
+    "recv_save": "server-side snapshot: PsServer save path",
+    "distributed_lookup_table": "use distributed.ps."
+                                "DistributedLookupTable (host RPC)",
+    "lookup_sparse_table_merge": "single native PS table design",
+    "pull_sparse": "pslib merged into the native PS (SURVEY §2.3)",
+    "pull_sparse_v2": "see pull_sparse",
+    "push_sparse": "see pull_sparse",
+    "push_sparse_v2": "see pull_sparse",
+    "pull_box_sparse": "BoxPS hardware service: out of scope",
+    "push_box_sparse": "see pull_box_sparse",
+    "push_box_extended_sparse": "see pull_box_sparse",
+    "merge_ids": "PS shard plumbing with dynamic row counts",
+    "split_ids": "see merge_ids",
+    "split_selected_rows": "see merge_ids",
+    "masked_select": "dynamic output shape: eager-only "
+                     "(paddle.masked_select)",
+    "unique": "dynamic output shape: eager-only (paddle.unique)",
+    "unique_with_counts": "see unique",
+    "where_index": "dynamic output shape: eager-only (paddle.nonzero)",
+    "beam_search": "LoD-growing per-step op; use text.decode.beam_search"
+                   " (whole-search jitted scan) + gather_tree",
+    "shrink_rnn_memory": "length-sorted DynamicRNN internals; the "
+                         "padded-scan DynamicRNN masks instead",
+    "queue_generator": "section queues subsumed by the pipeline schedule",
+    "enqueue": "see queue_generator",
+    "dequeue": "see queue_generator",
+    "run_program": "dy2static partial programs execute via jit/"
+                   "TranslatedLayer, not an embedded-program op",
+}
+
+
 def get_lowering(op_type):
     fn = _REGISTRY.get(op_type)
     if fn is None:
+        why = EXCLUDED_OPS.get(op_type)
+        if why:
+            raise NotImplementedError(
+                f"static op {op_type!r} is deliberately not lowered: "
+                f"{why}")
         raise NotImplementedError(
             f"static op {op_type!r} has no TPU lowering yet")
     return fn
@@ -1173,11 +1226,21 @@ def _recurrent(ctx, op):
     if batch_major:
         # DynamicRNN form: sources are padded [B, T, ...] sequences with
         # a lengths companion; scan runs time-major, memories freeze and
-        # outputs zero past each row's length (recurrent_op.cc over LoD)
+        # outputs zero past each row's length (recurrent_op.cc over LoD).
+        # All sequence inputs must share one LoD (the reference asserts
+        # this); the FIRST input's companion is the reference lengths.
         from ..core.lod import LOD_SUFFIX
 
-        for n in a["src_names"]:
-            lens = ctx.env.get(n + LOD_SUFFIX, lens)
+        companions = [ctx.env[n + LOD_SUFFIX] for n in a["src_names"]
+                      if n + LOD_SUFFIX in ctx.env]
+        if companions:
+            lens = companions[0]
+            for other in companions[1:]:
+                if other.shape != lens.shape:
+                    raise ValueError(
+                        "DynamicRNN step inputs carry different-shaped "
+                        "lengths companions; all sequence inputs must "
+                        "share one LoD")
         srcs = [jnp.swapaxes(s, 0, 1) for s in srcs]
     base_env = dict(ctx.env)
     body_key = ctx.next_key()
@@ -1196,13 +1259,19 @@ def _recurrent(ctx, op):
         ys = tuple(env[n] for n in a["step_out_names"])
         if lens is not None:
             alive = t < lens                      # [B]
+            B = lens.shape[0]
             new_carry = tuple(
                 jnp.where(alive.reshape((-1,) + (1,) * (new.ndim - 1)),
                           new, old)
                 for new, old in zip(new_carry, carry))
+            # zero only batch-leading outputs; a non-[B, ...] step output
+            # (per-step scalar reduction etc.) passes through unmasked
+            # rather than being silently broadcast to [B, ...]
             ys = tuple(
                 jnp.where(alive.reshape((-1,) + (1,) * (y.ndim - 1)),
-                          y, jnp.zeros_like(y)) for y in ys)
+                          y, jnp.zeros_like(y))
+                if (y.ndim >= 1 and y.shape[0] == B) else y
+                for y in ys)
         return new_carry, ys
 
     xs = (jnp.arange(T),) + tuple(srcs)

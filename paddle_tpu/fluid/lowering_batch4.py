@@ -236,6 +236,33 @@ def _deformable_conv_v1(ctx, op):
     _deformable_conv(ctx, op, modulated=False)
 
 
+def padded_rois(ctx, op, slot="ROIs"):
+    """Canonical padded-ROI prologue shared by the RoI pooling family:
+    returns (rois [R, 4] flat, batch_ix [R], lod-or-None). With a lengths
+    companion, rois arrive [n_img, R_max, 4] and flatten; dense rois all
+    belong to image 0."""
+    jnp = _jnp()
+    rois = ctx.inp(op, slot)
+    lod = ctx.env.get(op.input(slot)[0] + LOD_SUFFIX)
+    if lod is not None:
+        n_img, r_max = rois.shape[0], rois.shape[1]
+        batch_ix = jnp.repeat(jnp.arange(n_img), r_max)
+        rois = rois.reshape(n_img * r_max, rois.shape[-1])
+    else:
+        batch_ix = jnp.zeros((rois.shape[0],), jnp.int32)
+    return rois, batch_ix, lod
+
+
+def emit_roi_out(ctx, op, out, lod, slot="Out"):
+    """Epilogue: re-pad per image and attach the lengths companion so the
+    fetch path returns only each image's valid ROI rows."""
+    ctx.out(op, slot, out)
+    if lod is not None:
+        n_img = lod.shape[0]
+        ctx.out(op, slot, out.reshape((n_img, -1) + out.shape[1:]))
+        ctx.env[op.output(slot)[0] + LOD_SUFFIX] = lod
+
+
 @register("psroi_pool")
 def _psroi_pool(ctx, op):
     """Position-sensitive RoI average pooling (psroi_pool_op.cc): output
@@ -243,22 +270,12 @@ def _psroi_pool(ctx, op):
     (ph, pw) bin of the RoI."""
     jnp = _jnp()
     x = ctx.inp(op, "X")                         # [N, C*P*P, H, W]
-    rois = ctx.inp(op, "ROIs")
-    lod = ctx.env.get(op.input("ROIs")[0] + LOD_SUFFIX)
     out_c = op.attrs["output_channels"]
     ph_n = op.attrs["pooled_height"]
     pw_n = op.attrs.get("pooled_width", ph_n)
     scale = op.attrs.get("spatial_scale", 1.0)
     n, cpp, h, w = x.shape
-    if lod is not None:
-        # canonical padded sequence form: rois [n_img, R_max, 4] + lens;
-        # flatten, keep the per-image index, and emit the same lens so
-        # the fetch path repacks only the valid rows
-        n_img, r_max = rois.shape[0], rois.shape[1]
-        batch_ix = jnp.repeat(jnp.arange(n_img), r_max)
-        rois = rois.reshape(n_img * r_max, rois.shape[-1])
-    else:
-        batch_ix = jnp.zeros((rois.shape[0],), jnp.int32)
+    rois, batch_ix, lod = padded_rois(ctx, op)
     r = rois.shape[0]
     x1 = jnp.round(rois[:, 0]) * scale
     y1 = jnp.round(rois[:, 1]) * scale
@@ -286,13 +303,7 @@ def _psroi_pool(ctx, op):
     Y = yi[:, None, :, None, :, None]
     X = xi[:, None, None, :, None, :]
     g = xg[B, C, PH, PW, Y, X]                    # [R, out_c, P, P, S, S]
-    out = g.mean(axis=(4, 5))
-    ctx.out(op, "Out", out)
-    if lod is not None:
-        # [n_img, R_max, out_c, P, P] padded rows + lengths companion
-        n_img = lod.shape[0]
-        ctx.out(op, "Out", out.reshape((n_img, -1) + out.shape[1:]))
-        ctx.env[op.output("Out")[0] + LOD_SUFFIX] = lod
+    emit_roi_out(ctx, op, g.mean(axis=(4, 5)), lod)
 
 
 LOD_AWARE_OPS.add("psroi_pool")

@@ -833,3 +833,168 @@ def _yolov3_loss(ctx, op):
     ctx.out(op, "Loss", loss_xy + loss_wh + loss_obj + loss_cls)
     ctx.out(op, "ObjectnessMask", obj_tgt)
     ctx.out(op, "GTMatchMask", matched.astype(jnp.int32))
+
+
+# ======================================================================
+# legacy LoD machinery (IfElse / old-DynamicRNN internals) — dense forms
+# ======================================================================
+
+@register("split_lod_tensor")
+def _split_lod_tensor(ctx, op):
+    """IfElse row-partition, dense form: both branches see the FULL
+    batch (static shapes); the partner merge_lod_tensor row-selects.
+    Composition is exactly the reference's split->branch->merge
+    semantics for pure branches (split_lod_tensor_op.cc)."""
+    x = ctx.inp(op, "X")
+    ctx.out(op, "OutTrue", x)
+    ctx.out(op, "OutFalse", x)
+
+
+@register("merge_lod_tensor")
+def _merge_lod_tensor(ctx, op):
+    jnp = _jnp()
+    mask = ctx.inp(op, "Mask")
+    t = ctx.inp(op, "InTrue")
+    f = ctx.inp(op, "InFalse")
+    m = mask.reshape((-1,) + (1,) * (t.ndim - 1)).astype(bool)
+    ctx.out(op, "Out", jnp.where(m, t, f))
+
+
+@register("lod_tensor_to_array")
+def _lod_tensor_to_array(ctx, op):
+    """Padded [B, T, ...] sequence -> TensorArray of T per-step [B, ...]
+    batches (env holds python lists for arrays). The reference sorts rows
+    by length via a LoDRankTable; the padded form keeps batch order and
+    lets the consumer's mask handle finished rows — the lengths ride on
+    the array name for array_to_lod_tensor to restore."""
+    from .lowering_seq import _lens_or_full
+
+    x = ctx.inp(op, "X")
+    lens = _lens_or_full(ctx, op, "X", x)
+    out_name = op.output("Out")[0]
+    ctx.env[out_name] = [x[:, t] for t in range(x.shape[1])]
+    ctx.env[out_name + LOD_SUFFIX] = lens
+
+
+@register("array_to_lod_tensor")
+def _array_to_lod_tensor(ctx, op):
+    jnp = _jnp()
+    name = op.input("X")[0]
+    arr = ctx.env[name]
+    if not isinstance(arr, list):
+        raise TypeError(f"array_to_lod_tensor: {name!r} is not a "
+                        "TensorArray")
+    out = jnp.stack(arr, axis=1)                  # [B, T, ...]
+    ctx.out(op, "Out", out)
+    lens = ctx.env.get(name + LOD_SUFFIX)
+    if lens is not None:
+        ctx.env[op.output("Out")[0] + LOD_SUFFIX] = lens
+
+
+for _n in ("split_lod_tensor", "merge_lod_tensor", "lod_tensor_to_array",
+           "array_to_lod_tensor"):
+    LOD_AWARE_OPS.add(_n)
+
+
+@register("fusion_seqexpand_concat_fc")
+def _fusion_seqexpand_concat_fc(ctx, op):
+    """fused/fusion_seqexpand_concat_fc_op.cc: first input is the ref
+    sequence [B, T, D0]; the rest are per-row vectors (len-1 sequences)
+    broadcast over T; concat on features, fc, activation."""
+    import jax
+
+    jnp = _jnp()
+    from .lowering_seq import _lens_or_full
+    from ..ops.sequence import seq_mask
+
+    xs = ctx.inps(op, "X")
+    w = ctx.inp(op, "FCWeight")
+    b = ctx.inp(op, "FCBias")
+    ref = xs[0]
+    B, T = ref.shape[0], ref.shape[1]
+    lens = _lens_or_full(ctx, op, "X", ref)
+    parts = [ref]
+    for o in xs[1:]:
+        v = o.reshape(B, 1, -1) if o.ndim == 2 else o[:, :1]
+        parts.append(jnp.broadcast_to(v, (B, T, v.shape[-1])))
+    cat = jnp.concatenate(parts, axis=-1)
+    fc = cat.reshape(B * T, -1) @ w
+    if b is not None:
+        fc = fc + b.reshape(-1)
+    act = op.attrs.get("fc_activation", "identity")
+    if act == "relu":
+        fc = jnp.maximum(fc, 0.0)
+    elif act == "sigmoid":
+        fc = jax.nn.sigmoid(fc)
+    elif act == "tanh":
+        fc = jnp.tanh(fc)
+    out = fc.reshape(B, T, -1)
+    # zero rows past each sequence's length (ref keeps only valid rows)
+    out = out * seq_mask(lens, T).astype(out.dtype)[:, :, None]
+    ctx.out(op, "Out", out)
+    ctx.out(op, "FCOut", fc)
+    ctx.env[op.output("Out")[0] + LOD_SUFFIX] = lens
+
+
+LOD_AWARE_OPS.add("fusion_seqexpand_concat_fc")
+
+
+# split_byref_op.cc: split without copy — XLA owns buffers, so the plain
+# split lowering IS by-ref
+register("split_byref")(_REG["split"])
+
+
+@register("prroi_pool")
+def _prroi_pool(ctx, op):
+    """Precise RoI pooling (prroi_pool_op.cc): exact bilinear integral
+    per bin, approximated here by a dense 8x8 sample lattice per bin
+    (converges to the integral; static shapes, MXU-friendly gathers)."""
+    import jax
+
+    jnp = _jnp()
+    from .lowering_batch4 import emit_roi_out, padded_rois
+
+    x = ctx.inp(op, "X")                         # [N, C, H, W]
+    ph_n = op.attrs["pooled_height"]
+    pw_n = op.attrs["pooled_width"]
+    scale = op.attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+    rois, batch_ix, lod = padded_rois(ctx, op)
+    r = rois.shape[0]
+    x1 = rois[:, 0] * scale
+    y1 = rois[:, 1] * scale
+    x2 = rois[:, 2] * scale
+    y2 = rois[:, 3] * scale
+    bh = jnp.maximum(y2 - y1, 1e-3) / ph_n
+    bw = jnp.maximum(x2 - x1, 1e-3) / pw_n
+    s = 8
+    lat = (jnp.arange(s) + 0.5) / s
+    py = y1[:, None, None] + (jnp.arange(ph_n)[None, :, None] +
+                              lat[None, None, :]) * bh[:, None, None]
+    px = x1[:, None, None] + (jnp.arange(pw_n)[None, :, None] +
+                              lat[None, None, :]) * bw[:, None, None]
+
+    def bilinear(img, ys, xs):
+        # img [C, H, W]; ys [PH, S]; xs [PW, S] -> [C, PH, PW, S, S]
+        y0 = jnp.clip(jnp.floor(ys), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, w - 1)
+        wy = jnp.clip(ys - y0, 0, 1)
+        wx = jnp.clip(xs - x0, 0, 1)
+        y0i = y0.astype(jnp.int32)
+        x0i = x0.astype(jnp.int32)
+        y1i = jnp.clip(y0i + 1, 0, h - 1)
+        x1i = jnp.clip(x0i + 1, 0, w - 1)
+        out = 0.0
+        for yi, sy in ((y0i, 1 - wy), (y1i, wy)):
+            for xi, sx in ((x0i, 1 - wx), (x1i, wx)):
+                v = img[:, yi][:, :, :, xi]      # [C, PH, S, PW, S]
+                v = jnp.moveaxis(v, 3, 2)        # [C, PH, PW, S, S]
+                out = out + v * (sy[None, :, None, :, None] *
+                                 sx[None, None, :, None, :])
+        return out
+
+    sampled = jax.vmap(bilinear)(x[batch_ix], py, px)
+    emit_roi_out(ctx, op, sampled.mean(axis=(4, 5)), lod)
+
+
+LOD_AWARE_OPS.add("prroi_pool")

@@ -530,24 +530,33 @@ def sdpa_reference_bshd(q, k, v, mask=None, is_causal=False, scale=None,
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
 
 
-def _flash_candidate(heads_seq_q, heads_seq_k, head_dim, mask,
-                     batch, heads, dropout_p=0.0):
+_NO_FLASH = object()
+
+
+def _flash_plan(seq_q, seq_k, head_dim, mask, batch, heads,
+                dropout_p=0.0):
     """All the flash-dispatch gates in one place: TPU backend, long
     enough sequence, block-divisible lengths, head_dim small enough, a
     mask reducible to a key-position bias, kernel importable, and no
-    prob-dropout (the blockwise kernel has no dropout support)."""
+    prob-dropout (the blockwise kernel has no dropout support).
+    Returns the key-position bias to pass to the kernel (None when
+    maskless), or the _NO_FLASH sentinel when flash cannot run."""
     min_flash_len = int(os.environ.get("PT_FLASH_MIN_SEQ", "512"))
     if dropout_p:
-        return False
+        return _NO_FLASH
     if not (_on_tpu() and head_dim <= 256
-            and heads_seq_q >= min_flash_len
-            and heads_seq_q % min(256, heads_seq_q) == 0
-            and heads_seq_k % min(256, heads_seq_k) == 0):
-        return False
-    if mask is not None and _kv_bias(mask, batch, heads,
-                                     heads_seq_k) is None:
-        return False
-    return _flash_usable()
+            and seq_q >= min_flash_len
+            and seq_q % min(256, seq_q) == 0
+            and seq_k % min(256, seq_k) == 0):
+        return _NO_FLASH
+    bias = None
+    if mask is not None:
+        bias = _kv_bias(mask, batch, heads, seq_k)
+        if bias is None:
+            return _NO_FLASH
+    if not _flash_usable():
+        return _NO_FLASH
+    return bias
 
 
 def sdpa_bshd(q, k, v, mask=None, is_causal=False, scale=None,
@@ -557,14 +566,17 @@ def sdpa_bshd(q, k, v, mask=None, is_causal=False, scale=None,
     attention there); everything else stays transpose-free on XLA."""
     import jax.numpy as jnp
 
-    if q.ndim == 4 and _flash_candidate(q.shape[1], k.shape[1],
-                                        q.shape[-1], mask, q.shape[0],
-                                        q.shape[2], dropout_p):
-        qh = jnp.swapaxes(q, 1, 2)
-        kh = jnp.swapaxes(k, 1, 2)
-        vh = jnp.swapaxes(v, 1, 2)
-        out = sdpa(qh, kh, vh, mask, is_causal, scale)
-        return jnp.swapaxes(out, 1, 2)
+    if q.ndim == 4:
+        bias = _flash_plan(q.shape[1], k.shape[1], q.shape[-1], mask,
+                           q.shape[0], q.shape[2], dropout_p)
+        if bias is not _NO_FLASH:
+            try:
+                out = flash_attention(
+                    jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                    jnp.swapaxes(v, 1, 2), bias, is_causal, scale)
+                return jnp.swapaxes(out, 1, 2)
+            except Exception:
+                pass
     return sdpa_reference_bshd(q, k, v, mask, is_causal, scale,
                                dropout_p, dropout_key)
 
@@ -577,13 +589,13 @@ def sdpa(q, k, v, mask=None, is_causal=False, scale=None,
     attention beats the blockwise kernel there and the S x S buffer is
     tiny; flash pays off where it matters, long context (measured:
     ERNIE seq 128 is ~2% faster on the reference path)."""
-    if q.ndim == 4 and _flash_candidate(q.shape[2], k.shape[2],
-                                        q.shape[-1], mask, q.shape[0],
-                                        q.shape[1], dropout_p):
-        bias = _kv_bias(mask, q.shape[0], q.shape[1], k.shape[2])
-        try:
-            return flash_attention(q, k, v, bias, is_causal, scale)
-        except Exception:
-            pass
+    if q.ndim == 4:
+        bias = _flash_plan(q.shape[2], k.shape[2], q.shape[-1], mask,
+                           q.shape[0], q.shape[1], dropout_p)
+        if bias is not _NO_FLASH:
+            try:
+                return flash_attention(q, k, v, bias, is_causal, scale)
+            except Exception:
+                pass
     return sdpa_reference(q, k, v, mask, is_causal, scale,
                           dropout_p, dropout_key)

@@ -357,3 +357,75 @@ def test_psroi_pool_rectangular_bins():
     for ph in range(PH):
         for pw in range(PW):
             np.testing.assert_allclose(out[0, 0, ph, pw], ph * PW + pw)
+
+
+def test_split_merge_lod_tensor_roundtrip():
+    x = R.randn(4, 3).astype("float32")
+    mask = np.array([[1], [0], [1], [0]], dtype=bool)
+    ot, of = _run_one("split_lod_tensor", {"X": [x], "Mask": [mask]},
+                      {"OutTrue": 1, "OutFalse": 1}, {})
+    (merged,) = _run_one("merge_lod_tensor",
+                         {"X": [x], "Mask": [mask], "InTrue": [ot * 2],
+                          "InFalse": [of * -1]}, {"Out": 1}, {})
+    np.testing.assert_allclose(merged, np.where(mask, x * 2, -x))
+
+
+def test_lod_tensor_to_array_roundtrip():
+    flat = np.arange(12, dtype=np.float32).reshape(6, 2)  # rows [4, 2]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        blk = main.global_block()
+        x = blk.create_var(name="l2a_x", shape=[-1, 4, 2],
+                           dtype="float32", is_data=True, lod_level=1)
+        arr = blk.create_var(name="l2a_arr")
+        arr.is_tensor_array = True
+        out = blk.create_var(name="l2a_out", lod_level=1)
+        blk.append_op(type="lod_tensor_to_array", inputs={"X": [x]},
+                      outputs={"Out": [arr.name]}, attrs={})
+        blk.append_op(type="array_to_lod_tensor", inputs={"X": [arr]},
+                      outputs={"Out": [out.name]}, attrs={})
+    exe = fluid.Executor()
+    exe.run(startup)
+    res, = exe.run(main, {"l2a_x": LoDTensor(flat, [[0, 4, 6]])}, [out],
+                   return_numpy=False)
+    assert res.recursive_sequence_lengths()[0] == [4, 2]
+    np.testing.assert_allclose(np.asarray(res), flat)
+
+
+def test_fusion_seqexpand_concat_fc():
+    flat = R.randn(5, 3).astype("float32")  # rows [2, 3]
+    vec = R.randn(2, 4).astype("float32")   # one row per sequence
+    w = R.randn(7, 6).astype("float32")
+    b = R.randn(6).astype("float32")
+    outs = _run_one(
+        "fusion_seqexpand_concat_fc",
+        {"X": [flat, vec], "FCWeight": [w], "FCBias": [b]},
+        {"Out": 1, "FCOut": 1}, {"fc_activation": "relu"},
+        lod_feeds={("X", 0): (flat, [2, 3])}, return_numpy=False)
+    out = np.asarray(outs[0])
+    assert outs[0].recursive_sequence_lengths()[0] == [2, 3]
+    # row 0 of sequence 1 (global row 2): concat(flat[2], vec[1]) @ w + b
+    ref = np.maximum(np.concatenate([flat[2], vec[1]]) @ w + b, 0)
+    np.testing.assert_allclose(out[2], ref, rtol=1e-4)
+
+
+def test_prroi_pool_constant_map():
+    x = np.full((1, 2, 8, 8), 3.0, "float32")
+    rois = np.array([[1.0, 1.0, 6.0, 6.0]], "float32")
+    outs = _run_one("prroi_pool", {"X": [x], "ROIs": [rois]}, {"Out": 1},
+                    {"pooled_height": 2, "pooled_width": 2,
+                     "spatial_scale": 1.0},
+                    lod_feeds={("ROIs", 0): (rois, [1])},
+                    return_numpy=False)
+    out = np.asarray(outs[0])
+    assert out.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(out, 3.0, rtol=1e-5)
+
+
+def test_excluded_ops_raise_with_reason():
+    from paddle_tpu.fluid.lowering import get_lowering
+
+    with pytest.raises(NotImplementedError, match="deliberately"):
+        get_lowering("tensorrt_engine")
+    with pytest.raises(NotImplementedError, match="eager-only"):
+        get_lowering("unique")
