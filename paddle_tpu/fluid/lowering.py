@@ -1227,8 +1227,11 @@ def _recurrent(ctx, op):
         # DynamicRNN form: sources are padded [B, T, ...] sequences with
         # a lengths companion; scan runs time-major, memories freeze and
         # outputs zero past each row's length (recurrent_op.cc over LoD).
-        # All sequence inputs must share one LoD (the reference asserts
-        # this); the FIRST input's companion is the reference lengths.
+        # The FIRST input's companion is the authoritative lengths (the
+        # reference requires identical LoD across inputs; lengths are
+        # traced values here, so only shape mismatches are detectable —
+        # feeding inputs with different VALUES reads the shorter ones'
+        # padding, which is the same user error the reference rejects).
         from ..core.lod import LOD_SUFFIX
 
         companions = [ctx.env[n + LOD_SUFFIX] for n in a["src_names"]

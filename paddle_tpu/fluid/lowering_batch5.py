@@ -975,22 +975,28 @@ def _prroi_pool(ctx, op):
                               lat[None, None, :]) * bw[:, None, None]
 
     def bilinear(img, ys, xs):
-        # img [C, H, W]; ys [PH, S]; xs [PW, S] -> [C, PH, PW, S, S]
-        y0 = jnp.clip(jnp.floor(ys), 0, h - 1)
-        x0 = jnp.clip(jnp.floor(xs), 0, w - 1)
-        wy = jnp.clip(ys - y0, 0, 1)
-        wx = jnp.clip(xs - x0, 0, 1)
-        y0i = y0.astype(jnp.int32)
-        x0i = x0.astype(jnp.int32)
-        y1i = jnp.clip(y0i + 1, 0, h - 1)
-        x1i = jnp.clip(x0i + 1, 0, w - 1)
+        # img [C, H, W]; ys [PH, S]; xs [PW, S] -> [C, PH, PW, S, S].
+        # Outside-image area contributes ZERO (the PrRoI integral treats
+        # the region beyond the feature map as empty), so border-crossing
+        # ROIs pool proportionally smaller values, not clamped edges.
+        y0 = jnp.floor(ys)
+        x0 = jnp.floor(xs)
+        wy = ys - y0
+        wx = xs - x0
         out = 0.0
-        for yi, sy in ((y0i, 1 - wy), (y1i, wy)):
-            for xi, sx in ((x0i, 1 - wx), (x1i, wx)):
+        for dy, sy in ((0.0, 1 - wy), (1.0, wy)):
+            for dx, sx in ((0.0, 1 - wx), (1.0, wx)):
+                yy = y0 + dy
+                xx = x0 + dx
+                vy = (yy >= 0) & (yy <= h - 1)
+                vx = (xx >= 0) & (xx <= w - 1)
+                yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+                xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
                 v = img[:, yi][:, :, :, xi]      # [C, PH, S, PW, S]
                 v = jnp.moveaxis(v, 3, 2)        # [C, PH, PW, S, S]
-                out = out + v * (sy[None, :, None, :, None] *
-                                 sx[None, None, :, None, :])
+                wgt = ((sy * vy)[None, :, None, :, None] *
+                       (sx * vx)[None, None, :, None, :])
+                out = out + v * wgt
         return out
 
     sampled = jax.vmap(bilinear)(x[batch_ix], py, px)

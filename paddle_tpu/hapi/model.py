@@ -173,16 +173,7 @@ class Model:
         return self.network.parameters(*args, **kwargs)
 
     def summary(self, input_size=None, dtype=None):
-        lines = [f"{type(self.network).__name__}:"]
-        total = 0
-        for name, p in self.network.named_parameters():
-            n = int(np.prod(p.shape))
-            total += n
-            lines.append(f"  {name:<40} {str(p.shape):<20} {n}")
-        lines.append(f"Total params: {total}")
-        s = "\n".join(lines)
-        print(s)
-        return {"total_params": total}
+        return summary(self.network, input_size, dtype)
 
     def _n_inputs(self):
         if self._inputs is None:
@@ -226,3 +217,45 @@ def _logs_from(res, metrics):
 def _name_of(m):
     n = m.name()
     return n if isinstance(n, str) else n[0]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """paddle.summary parity (hapi/model_summary.py): parameter table +
+    totals; with input_size (or a concrete input), runs a forward pass in
+    eval mode and reports the output shape too."""
+    lines = [f"{type(net).__name__}:"]
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape))
+        total += n
+        if getattr(p, "trainable", True):
+            trainable += n
+        lines.append(f"  {name:<40} {str(p.shape):<20} {n}")
+    lines.append(f"Total params: {total}")
+    lines.append(f"Trainable params: {trainable}")
+    lines.append(f"Non-trainable params: {total - trainable}")
+    out_shape = None
+    try:
+        if input is None and input_size is not None:
+            from ..core.tensor import to_tensor
+
+            shape = list(input_size)
+            input = to_tensor(np.zeros(
+                shape, dtypes if isinstance(dtypes, str) else "float32"))
+        if input is not None:
+            was_training = getattr(net, "training", False)
+            net.eval()
+            out = net(input)
+            if was_training:
+                net.train()
+            first = out[0] if isinstance(out, (list, tuple)) else out
+            out_shape = list(first.shape)
+            lines.append(f"Output shape: {out_shape}")
+    except Exception as e:  # shape probe is best-effort
+        lines.append(f"(forward probe skipped: {e})")
+    print("\n".join(lines))
+    res = {"total_params": total, "trainable_params": trainable}
+    if out_shape is not None:
+        res["output_shape"] = out_shape
+    return res

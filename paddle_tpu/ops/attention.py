@@ -144,7 +144,7 @@ def _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal, has_bias, block_q,
             logits = jnp.dot(qb, kb.T,
                              preferred_element_type=jnp.float32)
             if has_bias:
-                bias = bias_ref[pl.ds(ki * block_k, block_k)]
+                bias = bias_ref[pl.ds(ki * block_k, block_k), 0]
                 logits = logits + bias[None, :]
             if is_causal:
                 rows = qi * block_q + jax.lax.broadcasted_iota(
@@ -168,10 +168,11 @@ def _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal, has_bias, block_q,
             nk_eff = (k_hi + block_k - 1) // block_k
         else:
             nk_eff = nk
-        acc, m_f, l_f = jax.lax.fori_loop(0, nk_eff, body, (acc0, m0, l0))
+        acc, m_f, l_f = jax.lax.fori_loop(
+            jnp.int32(0), jnp.int32(nk_eff), body, (acc0, m0, l0))
         l_safe = jnp.maximum(l_f, 1e-30)
         o_ref[...] = (acc / l_safe).astype(o_ref.dtype)
-        lse_ref[...] = (m_f + jnp.log(l_safe))[:, 0]
+        lse_ref[...] = m_f + jnp.log(l_safe)   # (block_q, 1)
 
     in_specs = [
         pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
@@ -179,19 +180,23 @@ def _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal, has_bias, block_q,
         pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
     ]
     if has_bias:
+        # per-row tensors carry a trailing unit dim: the TPU lowering
+        # requires the last two block dims be (8k, 128k) or equal the
+        # array dims — (rows, 1) satisfies that where a 1-D row block
+        # cannot
         in_specs.append(
-            pl.BlockSpec((None, sk), lambda bh, qi: (bh, 0)))
+            pl.BlockSpec((None, sk, 1), lambda bh, qi: (bh, 0, 0)))
     return pl.pallas_call(
         kernel,
         grid=(b * h, nq),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((None, block_q, 1), lambda bh, qi: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
         ],
         interpret=interpret,
     )
@@ -199,7 +204,7 @@ def _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal, has_bias, block_q,
 
 def flash_attention_fwd(q, k, v, bias=None, is_causal=False, scale=None,
                         block_q=256, block_k=256, interpret=False):
-    """Returns (out [b,h,sq,d], lse [b*h, sq]). bias: [b, sk] additive."""
+    """Returns (out [b,h,sq,d], lse [b*h, sq, 1]). bias: [b, sk] additive."""
     import jax.numpy as jnp
 
     b, h, sq, d = q.shape
@@ -219,11 +224,11 @@ def flash_attention_fwd(q, k, v, bias=None, is_causal=False, scale=None,
                               bias is not None, block_q, block_k, q.dtype,
                               interpret)
     if bias is not None:
-        bias_bh = jnp.repeat(bias, h, axis=0)  # [b*h, sk]
+        bias_bh = jnp.repeat(bias, h, axis=0)[:, :, None]  # [b*h, sk, 1]
         out, lse = call(qr, kr, vr, bias_bh)
     else:
         out, lse = call(qr, kr, vr)
-    return out.reshape(b, h, sq, d), lse
+    return out.reshape(b, h, sq, d), lse          # lse: [b*h, sq, 1]
 
 
 def flash_attention_tpu(q, k, v, is_causal=False, scale=None,
@@ -263,8 +268,11 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
     orr = out.reshape(b * h, sq, d)
     gr = g.reshape(b * h, sq, d)
     # D_i = rowsum(dO_i * O_i) — the softmax-correction term
-    delta = (gr.astype(jnp.float32) * orr.astype(jnp.float32)).sum(-1)
-    bias_bh = jnp.repeat(bias, h, axis=0) if has_bias else None
+    # (kept (b*h, sq, 1): see the fwd block-constraint note)
+    delta = (gr.astype(jnp.float32) * orr.astype(jnp.float32)).sum(
+        -1, keepdims=True)
+    bias_bh = jnp.repeat(bias, h, axis=0)[:, :, None] if has_bias \
+        else None
 
     def dq_kernel(*refs):
         if has_bias:
@@ -275,8 +283,8 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
         qi = pl.program_id(1)
         qb = q_ref[...].astype(jnp.float32)
         gb = g_ref[...].astype(jnp.float32)
-        lse_b = lse_ref[...][:, None]
-        dl_b = dl_ref[...][:, None]
+        lse_b = lse_ref[...]                      # (block_q, 1)
+        dl_b = dl_ref[...]
 
         def body(ki, acc):
             kb = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
@@ -284,7 +292,7 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
             logits = jnp.dot(qb, kb.T,
                              preferred_element_type=jnp.float32) * s
             if has_bias:
-                bb = b_ref[pl.ds(ki * block_k, block_k)]
+                bb = b_ref[pl.ds(ki * block_k, block_k), 0]
                 logits = logits + bb[None, :]
             if is_causal:
                 rows = qi * block_q + jax.lax.broadcasted_iota(
@@ -303,7 +311,8 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
         else:
             nk_eff = nk
         acc = jax.lax.fori_loop(
-            0, nk_eff, body, jnp.zeros((block_q, d), jnp.float32))
+            jnp.int32(0), jnp.int32(nk_eff), body,
+            jnp.zeros((block_q, d), jnp.float32))
         dq_ref[...] = acc.astype(dq_ref.dtype)
 
     dq_in = [
@@ -312,11 +321,11 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
         pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
     ]
     if has_bias:
-        dq_in.append(pl.BlockSpec((None, sk), lambda bh, qi: (bh, 0)))
+        dq_in.append(pl.BlockSpec((None, sk, 1), lambda bh, qi: (bh, 0, 0)))
     dq_in += [
         pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-        pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),
-        pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),
+        pl.BlockSpec((None, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((None, block_q, 1), lambda bh, qi: (bh, qi, 0)),
     ]
     dq_args = [qr, kr, vr] + ([bias_bh] if has_bias else []) + \
         [gr, lse, delta]
@@ -339,14 +348,14 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
         kb = k_ref[...].astype(jnp.float32)
         vb = v_ref[...].astype(jnp.float32)
         if has_bias:
-            bb = b_ref[...]
+            bb = b_ref[...][:, 0]
 
         def body(qi, carry):
             dk_acc, dv_acc, db_acc = carry
             qb = q_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
             gb = g_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-            lse_b = lse_ref[pl.ds(qi * block_q, block_q)][:, None]
-            dl_b = dl_ref[pl.ds(qi * block_q, block_q)][:, None]
+            lse_b = lse_ref[pl.ds(qi * block_q, block_q), :]
+            dl_b = dl_ref[pl.ds(qi * block_q, block_q), :]
             logits = jnp.dot(qb, kb.T,
                              preferred_element_type=jnp.float32) * s
             if has_bias:
@@ -375,11 +384,11 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
         z = jnp.zeros((block_k, d), jnp.float32)
         zb = jnp.zeros((block_k,), jnp.float32)
         dk_acc, dv_acc, db_acc = jax.lax.fori_loop(
-            q_lo, nq, body, (z, z, zb))
+            jnp.int32(q_lo), jnp.int32(nq), body, (z, z, zb))
         dk_ref[...] = dk_acc.astype(dk_ref.dtype)
         dv_ref[...] = dv_acc.astype(dv_ref.dtype)
         if has_bias:
-            db_ref[...] = db_acc
+            db_ref[...] = db_acc[:, None]
 
     dkv_in = [
         pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, 0, 0)),
@@ -388,11 +397,11 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
     ]
     if has_bias:
         dkv_in.append(
-            pl.BlockSpec((None, block_k), lambda bh, ki: (bh, ki)))
+            pl.BlockSpec((None, block_k, 1), lambda bh, ki: (bh, ki, 0)))
     dkv_in += [
         pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, 0, 0)),
-        pl.BlockSpec((None, sq), lambda bh, ki: (bh, 0)),
-        pl.BlockSpec((None, sq), lambda bh, ki: (bh, 0)),
+        pl.BlockSpec((None, sq, 1), lambda bh, ki: (bh, 0, 0)),
+        pl.BlockSpec((None, sq, 1), lambda bh, ki: (bh, 0, 0)),
     ]
     dkv_args = [qr, kr, vr] + ([bias_bh] if has_bias else []) + \
         [gr, lse, delta]
@@ -405,9 +414,10 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
         jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
     ]
     if has_bias:
-        out_specs.append(pl.BlockSpec((None, block_k),
-                                      lambda bh, ki: (bh, ki)))
-        out_shape.append(jax.ShapeDtypeStruct((b * h, sk), jnp.float32))
+        out_specs.append(pl.BlockSpec((None, block_k, 1),
+                                      lambda bh, ki: (bh, ki, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((b * h, sk, 1),
+                                              jnp.float32))
     outs = pl.pallas_call(
         dkv_kernel, grid=(b * h, nk), in_specs=dkv_in,
         out_specs=out_specs, out_shape=out_shape,
@@ -416,7 +426,8 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
     if has_bias:
         dk, dv, db_bh = outs
         # bias is per (batch, key): sum the head axis
-        dbias = db_bh.reshape(b, h, sk).sum(axis=1).astype(bias.dtype)
+        dbias = db_bh[:, :, 0].reshape(b, h, sk).sum(axis=1).astype(
+            bias.dtype)
     else:
         dk, dv = outs
         dbias = None

@@ -429,3 +429,19 @@ def test_excluded_ops_raise_with_reason():
         get_lowering("tensorrt_engine")
     with pytest.raises(NotImplementedError, match="eager-only"):
         get_lowering("unique")
+
+
+def test_prroi_pool_border_roi_zero_outside():
+    # ROI half outside the image: the outside area contributes zero, so
+    # an all-ones map pools < 1 in bins crossing the border
+    x = np.ones((1, 1, 8, 8), "float32")
+    rois = np.array([[-4.0, -4.0, 3.99, 3.99]], "float32")
+    outs = _run_one("prroi_pool", {"X": [x], "ROIs": [rois]}, {"Out": 1},
+                    {"pooled_height": 2, "pooled_width": 2,
+                     "spatial_scale": 1.0},
+                    lod_feeds={("ROIs", 0): (rois, [1])},
+                    return_numpy=False)
+    out = np.asarray(outs[0])
+    # top-left bin fully outside -> ~0; bottom-right bin inside -> ~1
+    assert out[0, 0, 0, 0] < 0.1
+    assert out[0, 0, 1, 1] > 0.9
