@@ -41,20 +41,28 @@ def _marginal_step_time(run_n, steps, lo_frac=5):
     seconds-noisy and not model throughput. Falls back to plain t/steps
     (conservative) when noise wins or the two points coincide.
     """
-    def best_of(n, reps=3):
-        best = None
-        run_n(n)  # compile + warm this n
-        for _ in range(reps):
-            dt = run_n(n)
-            best = dt if best is None else min(best, dt)
-        return best
-
     lo = max(2, steps // lo_frac)
-    t_hi = best_of(steps)
-    if lo >= steps:
-        return t_hi / steps, t_hi / steps
-    t_lo = best_of(lo)
-    if t_hi <= t_lo:
+    if lo >= steps:  # degenerate: single point, single measurement
+        run_n(steps)
+        dt = run_n(steps) / steps
+        return dt, dt
+    best = {lo: None, steps: None}
+    for n in (steps, lo):
+        run_n(n)  # compile + warm this n
+    # alternate min-sampling both points; the min is the right estimator
+    # under the tunnel's additive positive jitter, and alternating keeps
+    # slow phases from landing entirely on one point. Extend up to 3
+    # rounds while noise keeps the slope non-positive.
+    for round_ in range(3):
+        for _ in range(3):
+            for n in (lo, steps):
+                dt = run_n(n)
+                if best[n] is None or dt < best[n]:
+                    best[n] = dt
+        if lo < steps and best[steps] > best[lo]:
+            break
+    t_hi, t_lo = best[steps], best[lo]
+    if lo >= steps or t_hi <= t_lo:
         return t_hi / steps, t_hi / steps
     return (t_hi - t_lo) / (steps - lo), t_hi / steps
 

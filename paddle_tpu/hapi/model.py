@@ -246,9 +246,11 @@ def summary(net, input_size=None, dtypes=None, input=None):
         if input is not None:
             was_training = getattr(net, "training", False)
             net.eval()
-            out = net(input)
-            if was_training:
-                net.train()
+            try:
+                out = net(input)
+            finally:
+                if was_training:
+                    net.train()
             first = out[0] if isinstance(out, (list, tuple)) else out
             out_shape = list(first.shape)
             lines.append(f"Output shape: {out_shape}")
