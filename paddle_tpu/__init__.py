@@ -83,14 +83,13 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False):
     """paddle.grad parity (imperative/partial_grad_engine.cc:29): grads of
     outputs w.r.t. arbitrary inputs (leaf or intermediate) in one reverse
-    pass, leaving every tensor's `.grad` untouched."""
+    pass, leaving every tensor's `.grad` untouched. With
+    create_graph=True the backward pass itself is recorded on the tape
+    (each vjp re-expressed as jax.vjp over the node's primals), so the
+    returned grads are differentiable — double grad, the GAN
+    gradient-penalty pattern (imperative double-grad parity)."""
     from .core import autograd as _ag
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (double grad) is not supported yet; for "
-            "higher-order derivatives use paddle_tpu.incubate.autograd / "
-            "jax.grad composition on a functional model")
     outs = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
     ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     gos = None
@@ -101,6 +100,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             raise ValueError(
                 f"the length of grad_outputs ({len(gos)}) must equal the "
                 f"length of outputs ({len(outs)})")
-    retain = bool(retain_graph) if retain_graph is not None else False
+    # paddle semantics: retain_graph defaults to create_graph
+    retain = bool(retain_graph) if retain_graph is not None \
+        else bool(create_graph)
     return _ag.partial_grad(outs, list(ins), gos, retain_graph=retain,
-                            allow_unused=allow_unused)
+                            allow_unused=allow_unused,
+                            create_graph=create_graph)

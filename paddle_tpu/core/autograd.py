@@ -48,15 +48,18 @@ class Node:
     """One recorded differentiable op on the tape."""
 
     __slots__ = ("vjp_fn", "inputs", "n_outputs", "out_grads", "out_avals",
-                 "op_name", "__weakref__")
+                 "op_name", "fwd_fn", "fwd_in_dtypes", "__weakref__")
 
-    def __init__(self, vjp_fn, inputs, n_outputs, op_name="", out_avals=None):
+    def __init__(self, vjp_fn, inputs, n_outputs, op_name="", out_avals=None,
+                 fwd_fn=None, fwd_in_dtypes=None):
         self.vjp_fn = vjp_fn          # cotangents(tuple) -> input cotangents
         self.inputs = inputs          # list[(Tensor, in_needs_grad)]
         self.n_outputs = n_outputs
         self.out_grads = None         # filled during backward
         self.out_avals = out_avals    # [(shape, dtype)] per output
         self.op_name = op_name
+        self.fwd_fn = fwd_fn          # original kernel (double-grad rebuild)
+        self.fwd_in_dtypes = fwd_in_dtypes  # AMP-cast dtypes at forward
 
     def zero_ct(self, i):
         import jax.numpy as jnp
@@ -65,12 +68,18 @@ class Node:
         return jnp.zeros(shape, dtype)
 
 
-def _reverse_walk(seeds, take, retain_graph=False, restrict=None):
+def _reverse_walk(seeds, take, retain_graph=False, restrict=None,
+                  create_graph=False):
     """Shared dependency-counted reverse walk (BasicEngine::Execute parity,
     imperative/basic_engine.cc:219). `seeds` = [(tensor, cotangent)];
     `take(tensor, ct)` observes every cotangent delivered to a tensor;
     `restrict`, when given, is a predicate(node)->bool limiting which nodes
-    run their vjp (partial-grad pruning). Returns the list of ALL discovered
+    run their vjp (partial-grad pruning). With `create_graph`, cotangents
+    flow as TENSORS and every vjp call is re-recorded through the tape
+    (jax.vjp closures are themselves differentiable), so the returned
+    grads carry a graph — double grad, the reference's
+    imperative/basic_engine double-grad capability (GAN gradient
+    penalty). Returns the list of ALL discovered
     nodes (walked or not) so callers can free them."""
     # --- discover reachable nodes from all seed roots ---
     all_nodes, visited = [], set()
@@ -115,11 +124,37 @@ def _reverse_walk(seeds, take, retain_graph=False, restrict=None):
         cotangents = node.out_grads
         node.out_grads = None
         if cotangents is not None and any(c is not None for c in cotangents):
+            def _zero(i):
+                z = node.zero_ct(i)
+                if create_graph:
+                    from .tensor import Tensor
+
+                    return Tensor._wrap(z)
+                return z
+
+            def _match_dtype(c, i):
+                # AMP mixes dtypes across op boundaries (a white-listed
+                # bf16 op feeding a black-listed f32 op): jax's vjp
+                # demands the cotangent match the op's OUTPUT dtype, so
+                # cast at delivery (loss-scaling safe — dtype only)
+                if node.out_avals is None or isinstance(c, tuple):
+                    return c  # tuple = SelectedRows sparse ct: pass through
+                want = node.out_avals[i][1]
+                if hasattr(c, "_data"):   # Tensor cotangent (create_graph)
+                    return c.astype(want) if c._data.dtype != want else c
+                return c.astype(want) if c.dtype != want else c
+
             cts = tuple(
-                c if c is not None else node.zero_ct(i)
+                _match_dtype(c, i) if c is not None else _zero(i)
                 for i, c in enumerate(cotangents)
-            ) if node.n_outputs > 1 else (cotangents[0],)
-            in_cts = node.vjp_fn(cts) if node.vjp_fn else None
+            ) if node.n_outputs > 1 else (
+                _match_dtype(cotangents[0], 0),)
+            if node.vjp_fn is None:
+                in_cts = None
+            elif create_graph:
+                in_cts = _tape_vjp(node, cts)
+            else:
+                in_cts = node.vjp_fn(cts)
         else:
             in_cts = None
 
@@ -148,6 +183,62 @@ def _reverse_walk(seeds, take, retain_graph=False, restrict=None):
     return all_nodes
 
 
+def _tape_vjp(node, cts):
+    """Run a node's vjp THROUGH the tape (create_graph): the second-order
+    dependency on the PRIMALS lives inside the vjp closure, invisible to
+    the tape, so the backward step is re-expressed as a fresh tape op
+    h(primals, cotangents) = jax.vjp(fwd_fn, primals)[1](cotangents) over
+    the node's original input tensors + the cotangent tensors."""
+    import jax
+
+    from .tensor import _apply
+
+    if node.fwd_fn is None:
+        raise RuntimeError(
+            f"create_graph=True cannot differentiate through the backward "
+            f"of op {node.op_name!r}: it records a custom/sparse vjp "
+            f"(e.g. SelectedRows embedding grads) with no dense "
+            f"second-order form")
+    n_in = len(node.inputs)
+    fwd_fn = node.fwd_fn
+    n_out = node.n_outputs
+
+    needs = [n for _, n in node.inputs]
+
+    fwd_dtypes = node.fwd_in_dtypes
+
+    def h(*args):
+        import jax.numpy as jnp
+
+        prims = args[:n_in]
+        cts_raw = args[n_in:]
+        if fwd_dtypes is not None:
+            # replay the forward's AMP cast decision: the node inputs
+            # hold the UNCAST tensors, but the cotangents carry the cast
+            # dtype the forward actually ran in
+            prims = tuple(p.astype(d) if p.dtype != d else p
+                          for p, d in zip(prims, fwd_dtypes))
+        _, vjp_fn = jax.vjp(fwd_fn, *prims)
+        in_cts = vjp_fn(cts_raw[0] if n_out == 1 else tuple(cts_raw))
+        # not-needed cotangents are replaced by FRESH zeros (no data
+        # dependence): partial-domain vjp rules (e.g. d/dy x**y needs
+        # log x) would otherwise inject NaNs into the second-order graph
+        # through branches the walk never consumes
+        in_cts = tuple(
+            c if needs[i] else jnp.zeros(prims[i].shape, prims[i].dtype)
+            for i, c in enumerate(in_cts))
+        # _apply's single-output convention wants the bare array
+        return in_cts[0] if n_in == 1 else in_cts
+
+    from .tensor import Tensor
+
+    ct_tensors = [c if isinstance(c, Tensor) else Tensor._wrap(c)
+                  for c in cts]
+    args = [t for t, _ in node.inputs] + ct_tensors
+    outs = _apply(f"grad_{node.op_name}", h, *args, n_outputs=n_in)
+    return outs if isinstance(outs, tuple) else (outs,)
+
+
 def backward(root, grad=None, retain_graph=False):
     """Run reverse-mode accumulation from `root` (a Tensor) into every
     reachable leaf's `.grad` (GradientAccumulator semantics: sum over
@@ -173,7 +264,7 @@ def backward(root, grad=None, retain_graph=False):
 
 
 def partial_grad(outputs, inputs, grad_outputs=None, retain_graph=False,
-                 allow_unused=False):
+                 allow_unused=False, create_graph=False):
     """paddle.grad engine: grads of `outputs` w.r.t. `inputs` in ONE reverse
     pass over the union graph of all outputs, without touching any leaf's
     `.grad` (imperative/partial_grad_engine.cc:29 parity). `grad_outputs[i]`
@@ -224,12 +315,18 @@ def partial_grad(outputs, inputs, grad_outputs=None, retain_graph=False,
             ct = jnp.ones_like(o._data)
         else:
             ct = go._data if isinstance(go, Tensor) else jnp.asarray(go)
+        if create_graph:
+            ct = go if isinstance(go, Tensor) else Tensor._wrap(ct)
         seeds.append((o, ct))
         if o._node is not None and id(o._node) not in needed:
             _mark(o._node)
 
-    _reverse_walk(seeds, take, retain_graph=retain_graph,
-                  restrict=lambda n: needed.get(id(n), False))
+    # create_graph FORCES graph retention regardless of retain_graph: the
+    # re-recorded backward ops reference forward residuals, and the usual
+    # follow-up (penalty.backward()) re-traverses the forward nodes
+    _reverse_walk(seeds, take, retain_graph=retain_graph or create_graph,
+                  restrict=lambda n: needed.get(id(n), False),
+                  create_graph=create_graph)
 
     if not allow_unused:
         for i, g in enumerate(result):

@@ -327,6 +327,10 @@ class Tensor:
         return self._binop(o, lambda x, y: x % y, "mod")
 
     def __pow__(self, o):
+        if not isinstance(o, Tensor):
+            # scalar exponent stays a closure constant: no d/dy cotangent
+            # (whose x**y * log x rule NaNs for x < 0) ever exists
+            return _apply("pow", lambda x: x ** o, self)
         return self._binop(o, lambda x, y: x ** y, "pow")
 
     def __rpow__(self, o):
@@ -427,6 +431,8 @@ def _apply(op_name, fn, *tensors, n_outputs=1):
         n_outputs=n_outputs,
         op_name=op_name,
         out_avals=out_avals,
+        fwd_fn=fn,  # kept so create_graph can rebuild the vjp on-tape
+        fwd_in_dtypes=tuple(r.dtype for r in raws),  # AMP-cast dtypes
     )
     wrapped = []
     for i, o in enumerate(outs):

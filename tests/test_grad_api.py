@@ -174,3 +174,94 @@ def test_lowering_error_carries_op_callstack():
         exe.run(main, feed={}, fetch_list=["zz"])
     notes = "".join(getattr(ei.value, "__notes__", []))
     assert "test_grad_api.py" in notes
+
+
+def test_create_graph_double_grad():
+    """paddle.grad(create_graph=True) re-records the backward on the tape
+    (reference: imperative double-grad / GAN gradient penalty)."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, -1.5], np.float32),
+                         stop_gradient=False)
+    y = (x ** 3).sum()
+    (g,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(np.asarray(g._data),
+                               3 * np.array([1, 4, 2.25]), rtol=1e-5)
+    penalty = (g ** 2).sum()
+    penalty.backward()
+    np.testing.assert_allclose(np.asarray(x.grad._data),
+                               36 * np.array([1.0, 8.0, -3.375]),
+                               rtol=1e-5)
+
+
+def test_gradient_penalty_through_layer():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    net = nn.Linear(4, 1)
+    xi = paddle.to_tensor(
+        np.random.RandomState(0).randn(8, 4).astype("float32"),
+        stop_gradient=False)
+    out = net(xi).sum()
+    (gx,) = paddle.grad(out, xi, create_graph=True)
+    loss = (((gx ** 2).sum(axis=-1) ** 0.5 - 1.0) ** 2).mean()
+    loss.backward()
+    wg = np.asarray(net.weight.grad._data)
+    assert np.isfinite(wg).all() and np.abs(wg).sum() > 0
+
+
+def test_grad_of_grad_composition():
+    import numpy as np
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = x ** 4
+    (g1,) = paddle.grad(y, x, create_graph=True)    # 4x^3
+    (g2,) = paddle.grad(g1, x)                      # 12x^2
+    assert abs(float(np.asarray(g1._data)[0]) - 32.0) < 1e-4
+    assert abs(float(np.asarray(g2._data)[0]) - 48.0) < 1e-4
+
+
+def test_create_graph_under_amp_autocast():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import amp
+
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 8).astype("float32"),
+        stop_gradient=False)
+    w = paddle.to_tensor(
+        np.random.RandomState(1).randn(8, 2).astype("float32"),
+        stop_gradient=False)
+    with amp.auto_cast(level="O1"):
+        y = (x @ w).sum()
+        (gx,) = paddle.grad(y, x, create_graph=True)
+    penalty = (gx.astype("float32") ** 2).sum()
+    penalty.backward()
+    assert w.grad is not None
+    assert np.isfinite(np.asarray(w.grad._data)).all()
+
+
+def test_amp_backward_across_white_black_boundary():
+    """First-order: a white-listed bf16 op feeding a black-listed f32 op
+    must backprop (the cotangent is cast to each op's output dtype at
+    delivery)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import amp
+
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 8).astype("float32"),
+        stop_gradient=False)
+    w = paddle.to_tensor(
+        np.random.RandomState(1).randn(8, 2).astype("float32"),
+        stop_gradient=False)
+    with amp.auto_cast(level="O1"):
+        y = (x @ w).sum()
+    y.backward()
+    gx = np.asarray(x.grad._data)
+    np.testing.assert_allclose(
+        gx, np.broadcast_to(np.asarray(w._data).sum(1), (4, 8)),
+        rtol=5e-2, atol=2e-2)  # grads ran in bf16
