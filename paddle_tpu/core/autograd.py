@@ -48,10 +48,10 @@ class Node:
     """One recorded differentiable op on the tape."""
 
     __slots__ = ("vjp_fn", "inputs", "n_outputs", "out_grads", "out_avals",
-                 "op_name", "fwd_fn", "fwd_in_dtypes", "__weakref__")
+                 "op_name", "fwd_fn", "fwd_raws", "__weakref__")
 
     def __init__(self, vjp_fn, inputs, n_outputs, op_name="", out_avals=None,
-                 fwd_fn=None, fwd_in_dtypes=None):
+                 fwd_fn=None, fwd_raws=None):
         self.vjp_fn = vjp_fn          # cotangents(tuple) -> input cotangents
         self.inputs = inputs          # list[(Tensor, in_needs_grad)]
         self.n_outputs = n_outputs
@@ -59,7 +59,7 @@ class Node:
         self.out_avals = out_avals    # [(shape, dtype)] per output
         self.op_name = op_name
         self.fwd_fn = fwd_fn          # original kernel (double-grad rebuild)
-        self.fwd_in_dtypes = fwd_in_dtypes  # AMP-cast dtypes at forward
+        self.fwd_raws = fwd_raws      # AMP-cast input arrays at forward
 
     def zero_ct(self, i):
         import jax.numpy as jnp
@@ -168,6 +168,8 @@ def _reverse_walk(seeds, take, retain_graph=False, restrict=None,
                     _accum_output_grad(t._node, t._out_idx, ct)
         if not retain_graph:
             node.vjp_fn = None
+            node.fwd_fn = None
+            node.fwd_raws = None
 
         for t, _needs in node.inputs:
             up = t._node
@@ -180,6 +182,8 @@ def _reverse_walk(seeds, take, retain_graph=False, restrict=None,
         node.out_grads = None
         if not retain_graph:
             node.vjp_fn = None
+            node.fwd_fn = None
+            node.fwd_raws = None
     return all_nodes
 
 
@@ -205,19 +209,11 @@ def _tape_vjp(node, cts):
 
     needs = [n for _, n in node.inputs]
 
-    fwd_dtypes = node.fwd_in_dtypes
-
     def h(*args):
         import jax.numpy as jnp
 
         prims = args[:n_in]
         cts_raw = args[n_in:]
-        if fwd_dtypes is not None:
-            # replay the forward's AMP cast decision: the node inputs
-            # hold the UNCAST tensors, but the cotangents carry the cast
-            # dtype the forward actually ran in
-            prims = tuple(p.astype(d) if p.dtype != d else p
-                          for p, d in zip(prims, fwd_dtypes))
         _, vjp_fn = jax.vjp(fwd_fn, *prims)
         in_cts = vjp_fn(cts_raw[0] if n_out == 1 else tuple(cts_raw))
         # not-needed cotangents are replaced by FRESH zeros (no data
@@ -234,9 +230,34 @@ def _tape_vjp(node, cts):
 
     ct_tensors = [c if isinstance(c, Tensor) else Tensor._wrap(c)
                   for c in cts]
-    args = [t for t, _ in node.inputs] + ct_tensors
-    outs = _apply(f"grad_{node.op_name}", h, *args, n_outputs=n_in)
-    return outs if isinstance(outs, tuple) else (outs,)
+    # record the grad op MANUALLY (not via _apply): its tape inputs must
+    # be the ORIGINAL tensors (leaf identity / upstream edges), but the
+    # vjp primals must be the SNAPSHOTTED forward raws (already AMP-cast;
+    # live tensors may have been mutated in place since forward)
+    raws = list(node.fwd_raws) + [c._data for c in ct_tensors]
+    out, vjp_fn = jax.vjp(h, *raws)
+    outs = (out,) if n_in == 1 else tuple(out)
+    in_list = [(t, n) for t, n in node.inputs] + \
+        [(c, not c._stop_gradient) for c in ct_tensors]
+    grad_node = None
+    if any(n for _, n in in_list):
+        grad_node = Node(
+            vjp_fn=lambda c2: vjp_fn(c2[0] if n_in == 1 else c2),
+            inputs=in_list,
+            n_outputs=n_in,
+            op_name=f"grad_{node.op_name}",
+            out_avals=[(o.shape, o.dtype) for o in outs],
+            fwd_fn=h,
+            fwd_raws=tuple(raws),
+        )
+    wrapped = []
+    for i, o in enumerate(outs):
+        t = Tensor._wrap(o, stop_gradient=grad_node is None)
+        if grad_node is not None:
+            t._node = grad_node
+            t._out_idx = i
+        wrapped.append(t)
+    return tuple(wrapped)
 
 
 def backward(root, grad=None, retain_graph=False):
@@ -321,12 +342,19 @@ def partial_grad(outputs, inputs, grad_outputs=None, retain_graph=False,
         if o._node is not None and id(o._node) not in needed:
             _mark(o._node)
 
-    # create_graph FORCES graph retention regardless of retain_graph: the
+    # create_graph FORCES graph retention regardless of retain_graph (the
     # re-recorded backward ops reference forward residuals, and the usual
-    # follow-up (penalty.backward()) re-traverses the forward nodes
-    _reverse_walk(seeds, take, retain_graph=retain_graph or create_graph,
-                  restrict=lambda n: needed.get(id(n), False),
-                  create_graph=create_graph)
+    # follow-up — penalty.backward() — re-traverses the forward nodes)
+    # and FORCES grad mode so a surrounding no_grad() can't silently
+    # detach the re-recorded ops
+    import contextlib
+
+    ctxmgr = enable_grad() if create_graph else contextlib.nullcontext()
+    with ctxmgr:
+        _reverse_walk(seeds, take,
+                      retain_graph=retain_graph or create_graph,
+                      restrict=lambda n: needed.get(id(n), False),
+                      create_graph=create_graph)
 
     if not allow_unused:
         for i, g in enumerate(result):

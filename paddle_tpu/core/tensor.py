@@ -432,7 +432,7 @@ def _apply(op_name, fn, *tensors, n_outputs=1):
         op_name=op_name,
         out_avals=out_avals,
         fwd_fn=fn,  # kept so create_graph can rebuild the vjp on-tape
-        fwd_in_dtypes=tuple(r.dtype for r in raws),  # AMP-cast dtypes
+        fwd_raws=tuple(raws),  # forward-time (AMP-cast) input snapshot
     )
     wrapped = []
     for i, o in enumerate(outs):

@@ -72,6 +72,15 @@ EXCLUDED_OPS = {
     "dequeue": "see queue_generator",
     "run_program": "dy2static partial programs execute via jit/"
                    "TranslatedLayer, not an embedded-program op",
+    "var_conv_2d": "per-image variable H/W (ROW/COLUMN LoD) is a dynamic"
+                   " shape; pad to the max and use conv2d",
+    "tree_conv": "tree-topology TBCNN patch op; gather + segment ops "
+                 "express it when a model needs it",
+    "bilateral_slice": "HDRnet grid-slice op; niche CV family",
+    "pyramid_hash": "pslib search-ranking hash embedding stack",
+    "rank_attention": "pslib ads rank-feature op",
+    "filter_by_instag": "dynamic row filtering by tag match; eager "
+                        "boolean indexing covers the capability",
 }
 
 
@@ -1533,6 +1542,9 @@ from . import lowering_batch4  # noqa: E402,F401
 
 # batch-5: metric ops, quant-sim, DGC, io ops, yolov3_loss, aliases
 from . import lowering_batch5  # noqa: E402,F401
+
+# batch-6: attention_lstm + fused_embedding_fc_lstm
+from . import lowering_batch6  # noqa: E402,F401
 
 
 # ====== book-era op additions (fluid/layers/nn.py 15.2k surface) ======

@@ -1,0 +1,136 @@
+"""Static lowerings, batch 6: the last inference-fusion RNNs.
+
+Reference parity: attention_lstm_op.cc (per-step attention over the whole
+sequence conditioned on the previous cell, feeding a 1-step LSTM) and
+fused/fused_embedding_fc_lstm_op.cc (embedding table pre-multiplied by the
+LSTM input weight — lookup IS the input projection).
+
+TPU-native notes: both are batch-vectorized lax.scans over the padded
+canonical form; the attention softmax masks invalid key positions with
+-inf instead of the reference's per-sequence pointer loops.
+"""
+from __future__ import annotations
+
+from ..core.lod import LOD_SUFFIX
+from ..ops import sequence as S
+from .lowering import LOD_AWARE_OPS, _jnp, register
+
+
+@register("attention_lstm")
+def _attention_lstm(ctx, op):
+    """attention_lstm_op.cc: at every step, attention scores over ALL of
+    the sequence's tokens from (token fc + prev-cell fc) -> relu ->
+    optional scalar fc -> softmax; the attended sum feeds one LSTM step.
+    LSTMWeight layout: rows [0:D] recur (h), rows [D:D+M] input (x);
+    gate order [forget, input, output, candidate]."""
+    import jax
+
+    jnp = _jnp()
+    from ..ops.sequence import _act, seq_mask
+    from .lowering_seq import _lens, _lens_or_full, _out_seq
+
+    x = ctx.inp(op, "X")                          # [B, T, M] padded
+    in_lens_x = _lens(ctx, op, "X")
+    h0 = ctx.inp(op, "H0")
+    c0 = ctx.inp(op, "C0")                        # [B, D]
+    aw = ctx.inp(op, "AttentionWeight")           # [M+D, 1]
+    ab = ctx.inp(op, "AttentionBias")
+    asc = ctx.inp(op, "AttentionScalar")
+    ascb = ctx.inp(op, "AttentionScalarBias")
+    lw = ctx.inp(op, "LSTMWeight")                # [D+M, 4D]
+    lb = ctx.inp(op, "LSTMBias")                  # [1, 4D]
+    lens = _lens_or_full(ctx, op, "X", x)
+    B, T, M = x.shape
+    D = lw.shape[1] // 4
+    act_gate = _act(op.attrs.get("gate_activation", "sigmoid"))
+    act_cell = _act(op.attrs.get("cell_activation", "tanh"))
+    act_cand = _act(op.attrs.get("candidate_activation", "tanh"))
+
+    aw_m = aw.reshape(-1)[:M]
+    aw_d = aw.reshape(-1)[M:]
+    atted = jnp.einsum("btm,m->bt", x, aw_m)
+    if ab is not None:
+        atted = atted + ab.reshape(())
+    w_h = lw[:D]                                  # [D, 4D]
+    w_x = lw[D:]                                  # [M, 4D]
+    bias = lb.reshape(-1)
+    valid = seq_mask(lens, T).astype(bool)        # [B, T]
+    alive_t = valid                               # step-alive mask
+
+    h_init = h0 if h0 is not None else jnp.zeros((B, D), x.dtype)
+    c_init = c0 if c0 is not None else jnp.zeros((B, D), x.dtype)
+
+    def step(carry, t):
+        h, c = carry
+        e = jax.nn.relu(atted + (c @ aw_d)[:, None])          # [B, T]
+        if asc is not None:
+            e = e * asc.reshape(())
+            if ascb is not None:
+                e = e + ascb.reshape(())
+            e = jax.nn.relu(e)
+        e = jnp.where(valid, e, -1e30)
+        a = jax.nn.softmax(e.astype(jnp.float32), -1).astype(x.dtype)
+        lstm_x = jnp.einsum("bt,btm->bm", a, x)               # [B, M]
+        gates = lstm_x @ w_x + h @ w_h + bias                 # [B, 4D]
+        f = act_gate(gates[:, :D])
+        i = act_gate(gates[:, D:2 * D])
+        o = act_gate(gates[:, 2 * D:3 * D])
+        cand = act_cand(gates[:, 3 * D:])
+        c2 = f * c + i * cand
+        h2 = act_cell(c2) * o
+        m = alive_t[:, t][:, None]
+        c2 = jnp.where(m, c2, c)
+        h2 = jnp.where(m, h2, h)
+        return (h2, c2), (h2, c2)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h_init, c_init),
+                                    jnp.arange(T))
+    hs = jnp.swapaxes(hs, 0, 1)
+    cs = jnp.swapaxes(cs, 0, 1)
+    ctx.out(op, "AttentionedX", atted.reshape(B * T, 1))
+    if in_lens_x is not None:  # sequence in -> sequence out
+        _out_seq(ctx, op, "Hidden", hs, lens)
+        _out_seq(ctx, op, "Cell", cs, lens)
+    else:
+        ctx.out(op, "Hidden", hs)
+        ctx.out(op, "Cell", cs)
+
+
+LOD_AWARE_OPS.add("attention_lstm")
+
+
+@register("fused_embedding_fc_lstm")
+def _fused_embedding_fc_lstm(ctx, op):
+    """fused/fused_embedding_fc_lstm_op.cc: Embeddings is the word table
+    already multiplied by the LSTM input weight ([vocab, 4D]), so the
+    lookup IS the input projection; the rest is a standard LSTM scan."""
+    jnp = _jnp()
+    from .lowering_seq import _lens, _lens_or_full, _out_seq
+
+    ids = ctx.inp(op, "Ids")                      # [B, T] or [B, T, 1]
+    emb = ctx.inp(op, "Embeddings")               # [V, 4D]
+    wh = ctx.inp(op, "WeightH")                   # [D, 4D]
+    b = ctx.inp(op, "Bias")
+    h0 = ctx.inp(op, "H0")
+    c0 = ctx.inp(op, "C0")
+    if ids.ndim == 3:
+        ids = ids[:, :, 0]
+    in_lens = _lens(ctx, op, "Ids")
+    lens = _lens_or_full(ctx, op, "Ids", ids)
+    xw = emb[ids.astype(jnp.int32)]               # [B, T, 4D]
+    hs, cs = S.dynamic_lstm(
+        xw, lens, wh, b, h0, c0,
+        use_peepholes=op.attrs.get("use_peepholes", True),
+        is_reverse=op.attrs.get("is_reverse", False),
+        gate_activation=op.attrs.get("gate_activation", "sigmoid"),
+        cell_activation=op.attrs.get("cell_activation", "tanh"),
+        candidate_activation=op.attrs.get("candidate_activation", "tanh"))
+    if in_lens is not None:
+        _out_seq(ctx, op, "Hidden", hs, lens)
+        _out_seq(ctx, op, "Cell", cs, lens)
+    else:
+        ctx.out(op, "Hidden", hs)
+        ctx.out(op, "Cell", cs)
+
+
+LOD_AWARE_OPS.add("fused_embedding_fc_lstm")

@@ -265,3 +265,28 @@ def test_amp_backward_across_white_black_boundary():
     np.testing.assert_allclose(
         gx, np.broadcast_to(np.asarray(w._data).sum(1), (4, 8)),
         rtol=5e-2, atol=2e-2)  # grads ran in bf16
+
+
+def test_create_graph_snapshot_survives_inplace_mutation():
+    import numpy as np
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = (x ** 3).sum()
+    x[0] = 100.0  # in-place rebind AFTER forward
+    (g,) = paddle.grad(y, x, create_graph=True)
+    # grad must use the FORWARD-time value: 3 * 2^2 = 12, not 3 * 100^2
+    assert abs(float(np.asarray(g._data)[0]) - 12.0) < 1e-4
+
+
+def test_create_graph_inside_no_grad():
+    import numpy as np
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = (x ** 3).sum()
+    with paddle.no_grad():
+        (g,) = paddle.grad(y, x, create_graph=True)
+    assert not g.stop_gradient  # grads carry a graph despite no_grad
+    (g2,) = paddle.grad(g, x)
+    assert abs(float(np.asarray(g2._data)[0]) - 12.0) < 1e-4  # 6x

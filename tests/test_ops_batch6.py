@@ -1,0 +1,115 @@
+"""Batch-6 fusion RNN lowerings: attention_lstm, fused_embedding_fc_lstm."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.lod import LoDTensor
+
+
+def _run_one(op_type, inputs, outputs, attrs, lod_feeds=None):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        blk = main.global_block()
+        in_map = {}
+        for slot, arrs in inputs.items():
+            vs = []
+            for i, a in enumerate(arrs):
+                lod_level = 1 if lod_feeds and (slot, i) in lod_feeds else 0
+                v = blk.create_var(name=f"i_{slot}_{i}",
+                                   shape=list(np.shape(a)),
+                                   dtype=str(np.asarray(a).dtype),
+                                   is_data=True, lod_level=lod_level)
+                vs.append(v)
+            in_map[slot] = vs
+        out_map = {}
+        for slot, n in outputs.items():
+            out_map[slot] = [blk.create_var(name=f"o_{slot}_{i}")
+                             for i in range(n)]
+        blk.append_op(type=op_type, inputs=in_map,
+                      outputs={k: [v.name for v in vs]
+                               for k, vs in out_map.items()},
+                      attrs=attrs)
+    exe = fluid.Executor()
+    exe.run(startup)
+    feed = {}
+    for slot, arrs in inputs.items():
+        for i, a in enumerate(arrs):
+            if lod_feeds and (slot, i) in lod_feeds:
+                flat, lens = lod_feeds[(slot, i)]
+                feed[f"i_{slot}_{i}"] = LoDTensor(
+                    flat, [list(np.cumsum([0] + list(lens)))])
+            else:
+                feed[f"i_{slot}_{i}"] = np.asarray(a)
+    fetch = [v for vs in out_map.values() for v in vs]
+    return exe.run(main, feed, fetch, return_numpy=False)
+
+
+R = np.random.RandomState(9)
+
+
+def _sigmoid(v):
+    return 1 / (1 + np.exp(-v))
+
+
+def test_attention_lstm_single_step_math():
+    # one sequence of length 1: attention trivially weights the only token
+    M, D = 3, 2
+    x = R.randn(1, 1, M).astype("float32")
+    c0 = R.randn(1, D).astype("float32")
+    aw = R.randn(M + D, 1).astype("float32")
+    lw = R.randn(D + M, 4 * D).astype("float32")
+    lb = (R.randn(1, 4 * D) * 0.1).astype("float32")
+    hs, cs = _run_one(
+        "attention_lstm",
+        {"X": [x], "C0": [c0], "AttentionWeight": [aw],
+         "LSTMWeight": [lw], "LSTMBias": [lb]},
+        {"Hidden": 1, "Cell": 1}, {})
+    hs, cs = np.asarray(hs), np.asarray(cs)
+    # softmax over a single token = 1 -> lstm_x = x[0,0]
+    gates = x[0, 0] @ lw[D:] + lb[0]
+    f = _sigmoid(gates[:D])
+    i = _sigmoid(gates[D:2 * D])
+    o = _sigmoid(gates[2 * D:3 * D])
+    cand = np.tanh(gates[3 * D:])
+    c_ref = f * c0[0] + i * cand
+    h_ref = np.tanh(c_ref) * o
+    np.testing.assert_allclose(cs.reshape(-1), c_ref, rtol=1e-4)
+    np.testing.assert_allclose(hs.reshape(-1), h_ref, rtol=1e-4)
+
+
+def test_attention_lstm_varlen_sequences():
+    M, D = 4, 3
+    flat = R.randn(5, M).astype("float32")        # rows [3, 2]
+    c0 = np.zeros((2, D), "float32")
+    aw = R.randn(M + D, 1).astype("float32")
+    lw = (R.randn(D + M, 4 * D) * 0.3).astype("float32")
+    lb = np.zeros((1, 4 * D), "float32")
+    hs, cs = _run_one(
+        "attention_lstm",
+        {"X": [flat], "C0": [c0], "AttentionWeight": [aw],
+         "LSTMWeight": [lw], "LSTMBias": [lb]},
+        {"Hidden": 1, "Cell": 1},
+        {}, lod_feeds={("X", 0): (flat, [3, 2])})
+    assert hs.recursive_sequence_lengths()[0] == [3, 2]
+    h = np.asarray(hs)
+    assert h.shape == (5, D) and np.isfinite(h).all()
+    assert np.abs(h).sum() > 0
+
+
+def test_fused_embedding_fc_lstm():
+    V, D, B, T = 10, 3, 2, 4
+    ids = R.randint(0, V, (B, T)).astype("int64")
+    emb = (R.randn(V, 4 * D) * 0.3).astype("float32")
+    wh = (R.randn(D, 4 * D) * 0.3).astype("float32")
+    b = np.zeros((1, 4 * D), "float32")
+    hs, cs = _run_one(
+        "fused_embedding_fc_lstm",
+        {"Ids": [ids], "Embeddings": [emb], "WeightH": [wh], "Bias": [b]},
+        {"Hidden": 1, "Cell": 1}, {"use_peepholes": False})
+    from paddle_tpu.ops import sequence as S
+    import jax.numpy as jnp
+
+    ref = np.asarray(S.dynamic_lstm(
+        jnp.asarray(emb[ids]), jnp.full((B,), T, jnp.int32),
+        jnp.asarray(wh), jnp.asarray(b), use_peepholes=False)[0])
+    np.testing.assert_allclose(np.asarray(hs), ref, rtol=1e-4, atol=1e-5)
