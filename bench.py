@@ -46,25 +46,27 @@ def _marginal_step_time(run_n, steps, lo_frac=5):
         run_n(steps)
         dt = run_n(steps) / steps
         return dt, dt
-    best = {lo: None, steps: None}
     for n in (steps, lo):
         run_n(n)  # compile + warm this n
-    # alternate min-sampling both points; the min is the right estimator
-    # under the tunnel's additive positive jitter, and alternating keeps
-    # slow phases from landing entirely on one point. Extend up to 3
-    # rounds while noise keeps the slope non-positive.
-    for round_ in range(3):
-        for _ in range(3):
-            for n in (lo, steps):
-                dt = run_n(n)
-                if best[n] is None or dt < best[n]:
-                    best[n] = dt
-        if lo < steps and best[steps] > best[lo]:
-            break
-    t_hi, t_lo = best[steps], best[lo]
-    if lo >= steps or t_hi <= t_lo:
-        return t_hi / steps, t_hi / steps
-    return (t_hi - t_lo) / (steps - lo), t_hi / steps
+    # measure ADJACENT (lo, hi) pairs and take the MEDIAN of per-pair
+    # slopes: pairing cancels the tunnel's slow drift (each pair sees
+    # nearly the same fixed overhead), and the median resists the
+    # multi-second outliers that bias a min-of-points estimator in
+    # EITHER direction (min-based slopes measured 1.7x above the
+    # device-profile truth under asymmetric noise)
+    slopes = []
+    t_hi_best = None
+    for _ in range(7):
+        t_lo = run_n(lo)
+        t_hi = run_n(steps)
+        t_hi_best = t_hi if t_hi_best is None else min(t_hi_best, t_hi)
+        if t_hi > t_lo:
+            slopes.append((t_hi - t_lo) / (steps - lo))
+    if not slopes:
+        return t_hi_best / steps, t_hi_best / steps
+    slopes.sort()
+    dt = slopes[len(slopes) // 2]
+    return dt, t_hi_best / steps
 
 
 def _ernie(batch=32, seq_len=128, steps=STEPS, layers=12, hidden=768, heads=12, inter=3072):

@@ -347,8 +347,6 @@ def partial_grad(outputs, inputs, grad_outputs=None, retain_graph=False,
     # follow-up — penalty.backward() — re-traverses the forward nodes)
     # and FORCES grad mode so a surrounding no_grad() can't silently
     # detach the re-recorded ops
-    import contextlib
-
     ctxmgr = enable_grad() if create_graph else contextlib.nullcontext()
     with ctxmgr:
         _reverse_walk(seeds, take,

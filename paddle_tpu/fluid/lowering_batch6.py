@@ -11,7 +11,6 @@ canonical form; the attention softmax masks invalid key positions with
 """
 from __future__ import annotations
 
-from ..core.lod import LOD_SUFFIX
 from ..ops import sequence as S
 from .lowering import LOD_AWARE_OPS, _jnp, register
 
@@ -54,8 +53,7 @@ def _attention_lstm(ctx, op):
     w_h = lw[:D]                                  # [D, 4D]
     w_x = lw[D:]                                  # [M, 4D]
     bias = lb.reshape(-1)
-    valid = seq_mask(lens, T).astype(bool)        # [B, T]
-    alive_t = valid                               # step-alive mask
+    valid = seq_mask(lens, T).astype(bool)        # [B, T] key/step mask
 
     h_init = h0 if h0 is not None else jnp.zeros((B, D), x.dtype)
     c_init = c0 if c0 is not None else jnp.zeros((B, D), x.dtype)
@@ -78,7 +76,7 @@ def _attention_lstm(ctx, op):
         cand = act_cand(gates[:, 3 * D:])
         c2 = f * c + i * cand
         h2 = act_cell(c2) * o
-        m = alive_t[:, t][:, None]
+        m = valid[:, t][:, None]
         c2 = jnp.where(m, c2, c)
         h2 = jnp.where(m, h2, h)
         return (h2, c2), (h2, c2)
@@ -87,13 +85,16 @@ def _attention_lstm(ctx, op):
                                     jnp.arange(T))
     hs = jnp.swapaxes(hs, 0, 1)
     cs = jnp.swapaxes(cs, 0, 1)
-    ctx.out(op, "AttentionedX", atted.reshape(B * T, 1))
     if in_lens_x is not None:  # sequence in -> sequence out
         _out_seq(ctx, op, "Hidden", hs, lens)
         _out_seq(ctx, op, "Cell", cs, lens)
+        # AttentionedX is per-token too: padded [B, T, 1] + lengths so
+        # the fetch path packs exactly x_rows rows (reference InferShape)
+        _out_seq(ctx, op, "AttentionedX", atted[:, :, None], lens)
     else:
         ctx.out(op, "Hidden", hs)
         ctx.out(op, "Cell", cs)
+        ctx.out(op, "AttentionedX", atted.reshape(B * T, 1))
 
 
 LOD_AWARE_OPS.add("attention_lstm")

@@ -113,3 +113,47 @@ def test_fused_embedding_fc_lstm():
         jnp.asarray(emb[ids]), jnp.full((B,), T, jnp.int32),
         jnp.asarray(wh), jnp.asarray(b), use_peepholes=False)[0])
     np.testing.assert_allclose(np.asarray(hs), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_attention_lstm_varlen_numpy_reference():
+    """Full per-step numpy oracle over a [3, 2] variable-length batch:
+    pins the attention mask (no attending to padding) and the finished-
+    sequence freeze."""
+    M, D = 2, 2
+    flat = R.randn(5, M).astype("float32")        # rows [3, 2]
+    c0 = (R.randn(2, D) * 0.3).astype("float32")
+    aw = (R.randn(M + D, 1) * 0.5).astype("float32")
+    lw = (R.randn(D + M, 4 * D) * 0.3).astype("float32")
+    lb = (R.randn(1, 4 * D) * 0.1).astype("float32")
+    hs, cs = _run_one(
+        "attention_lstm",
+        {"X": [flat], "C0": [c0], "AttentionWeight": [aw],
+         "LSTMWeight": [lw], "LSTMBias": [lb]},
+        {"Hidden": 1, "Cell": 1}, {},
+        lod_feeds={("X", 0): (flat, [3, 2])})
+    got_h = np.asarray(hs)
+
+    # numpy oracle, sequence by sequence (reference per-step loops)
+    aw_m, aw_d = aw.reshape(-1)[:M], aw.reshape(-1)[M:]
+    w_h, w_x = lw[:D], lw[D:]
+    rows = [flat[:3], flat[3:]]
+    ref_rows = []
+    for si, xseq in enumerate(rows):
+        c = c0[si].copy()
+        h = np.zeros(D, "float32")
+        for _t in range(len(xseq)):
+            e = np.maximum(xseq @ aw_m + c @ aw_d, 0.0)
+            a = np.exp(e - e.max())
+            a = a / a.sum()
+            lstm_x = a @ xseq
+            gates = lstm_x @ w_x + h @ w_h + lb[0]
+            f = _sigmoid(gates[:D])
+            i = _sigmoid(gates[D:2 * D])
+            o = _sigmoid(gates[2 * D:3 * D])
+            cand = np.tanh(gates[3 * D:])
+            c = f * c + i * cand
+            h = np.tanh(c) * o
+            ref_rows.append(h.copy())
+    # packed order: seq0 rows then seq1 rows
+    np.testing.assert_allclose(got_h, np.stack(ref_rows), rtol=1e-4,
+                               atol=1e-5)
