@@ -416,12 +416,22 @@ class SparsePrefetcher:
             ... train on rows ...
     """
 
-    def __init__(self, comm, table, dim):
+    def __init__(self, comm, table, dim, to_device=False):
+        """to_device: issue the host→device transfer on the prefetch
+        thread too, so by get() time the rows are already (or becoming)
+        device-resident and the jitted step never blocks on H2D — the
+        buffered_reader.cc overlap applied to PS pulls."""
         self._table = DistributedLookupTable(comm, table, dim)
         self._pending = None
+        self._to_device = to_device
 
     def _pull(self, ids):
-        return self._table.lookup(ids)
+        rows = self._table.lookup(ids)
+        if self._to_device:
+            import jax
+
+            rows = jax.device_put(rows)
+        return rows
 
     def prime(self, ids):
         self.prefetch(ids)

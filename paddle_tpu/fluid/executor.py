@@ -144,6 +144,14 @@ class Executor:
                 for j, level in enumerate(v.lod()[:-1]):
                     feed_vals[f"{k}{LOD_OUTER_SUFFIX}{j}"] = \
                         jnp.asarray(np.asarray(level, np.int32))
+            elif isinstance(v, jax.Array):
+                # device-resident feed: reuse without a host round-trip
+                # (buffered_reader.cc role — callers pre-place hot batches)
+                want = blk.vars.get(k)
+                if want is not None and want.dtype is not None and \
+                        str(v.dtype) != str(jnp.dtype(want.dtype)):
+                    v = v.astype(want.dtype)
+                feed_vals[k] = v
             else:
                 arr = np.asarray(v)
                 want = blk.vars.get(k)

@@ -165,6 +165,346 @@ def roi_pool(input, rois, pooled_height=1, pooled_width=1,
     return out
 
 
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None,
+                       return_rois_num=False):
+    """RPN proposal generation (generate_proposals_op.cc:81). Static
+    contract: rois come back [B, post_nms_top_n, 4] zero-padded with a
+    per-image valid count instead of a variable-length LoD."""
+    helper = LayerHelper("generate_proposals", name=name)
+    B = scores.shape[0] or -1
+    rois = helper.create_variable_for_type_inference(
+        scores.dtype, [B, post_nms_top_n, 4])
+    probs = helper.create_variable_for_type_inference(
+        scores.dtype, [B, post_nms_top_n])
+    num = helper.create_variable_for_type_inference(np.int32, [B])
+    inputs = {"Scores": [scores], "BboxDeltas": [bbox_deltas],
+              "ImInfo": [im_info], "Anchors": [anchors]}
+    if variances is not None:
+        inputs["Variances"] = [variances]
+    helper.append_op(
+        type="generate_proposals",
+        inputs=inputs,
+        outputs={"RpnRois": [rois], "RpnRoiProbs": [probs],
+                 "RpnRoisNum": [num]},
+        attrs={"pre_nms_topN": pre_nms_top_n,
+               "post_nms_topN": post_nms_top_n,
+               "nms_thresh": nms_thresh, "min_size": min_size,
+               "eta": eta})
+    if return_rois_num:
+        return rois, probs, num
+    return rois, probs
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd, im_info,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True,
+                      name=None):
+    """rpn_target_assign_op.cc:36. Static contract: instead of gathered
+    index lists, returns full-anchor-set tensors
+    (score_pred [B,A], loc_pred [B,A,4], labels [B,A] with -1=ignore,
+    bbox_targets [B,A,4], bbox_inside_weight [B,A,4]); mask the loss with
+    labels>=0 / labels==1."""
+    helper = LayerHelper("rpn_target_assign", name=name)
+    B = gt_boxes.shape[0] or -1
+    A = anchor_box.shape[0] or -1
+    labels = helper.create_variable_for_type_inference(np.int32, [B, A])
+    tgt = helper.create_variable_for_type_inference(
+        anchor_box.dtype, [B, A, 4])
+    inw = helper.create_variable_for_type_inference(
+        anchor_box.dtype, [B, A, 4])
+    helper.append_op(
+        type="rpn_target_assign",
+        inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+                "IsCrowd": [is_crowd], "ImInfo": [im_info]},
+        outputs={"TargetLabel": [labels], "TargetBBox": [tgt],
+                 "BBoxInsideWeight": [inw]},
+        attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+               "rpn_straddle_thresh": rpn_straddle_thresh,
+               "rpn_fg_fraction": rpn_fg_fraction,
+               "rpn_positive_overlap": rpn_positive_overlap,
+               "rpn_negative_overlap": rpn_negative_overlap,
+               "use_random": use_random})
+    return cls_logits, bbox_pred, labels, tgt, inw
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd, im_info,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4, name=None):
+    """rpn_target_assign_op.cc:612 variant: class labels + fg count for
+    focal-loss normalization, no sampling."""
+    helper = LayerHelper("retinanet_target_assign", name=name)
+    B = gt_boxes.shape[0] or -1
+    A = anchor_box.shape[0] or -1
+    labels = helper.create_variable_for_type_inference(np.int32, [B, A])
+    tgt = helper.create_variable_for_type_inference(
+        anchor_box.dtype, [B, A, 4])
+    inw = helper.create_variable_for_type_inference(
+        anchor_box.dtype, [B, A, 4])
+    fg = helper.create_variable_for_type_inference(np.int32, [B])
+    helper.append_op(
+        type="retinanet_target_assign",
+        inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+                "GtLabels": [gt_labels], "IsCrowd": [is_crowd],
+                "ImInfo": [im_info]},
+        outputs={"TargetLabel": [labels], "TargetBBox": [tgt],
+                 "BBoxInsideWeight": [inw], "ForegroundNumber": [fg]},
+        attrs={"positive_overlap": positive_overlap,
+               "negative_overlap": negative_overlap})
+    return cls_logits, bbox_pred, labels, tgt, inw, fg
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, rpn_rois_num=None,
+                             batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.25, bg_thresh_hi=0.5,
+                             bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, name=None,
+                             return_gt_index=False):
+    """generate_proposal_labels_op.cc:43. rpn_rois [B,R,4] zero-padded +
+    rpn_rois_num [B]; gt_* [B,G,...] zero-padded. Returns static
+    (rois [B,S,4], labels_int32 [B,S] (-1 pad), bbox_targets
+    [B,S,4*class_nums], bbox_inside_weights, bbox_outside_weights,
+    rois_num [B]) with S = batch_size_per_im."""
+    helper = LayerHelper("generate_proposal_labels", name=name)
+    B = rpn_rois.shape[0] or -1
+    S = batch_size_per_im
+    C = int(class_nums or 81)
+    rois = helper.create_variable_for_type_inference(
+        rpn_rois.dtype, [B, S, 4])
+    labels = helper.create_variable_for_type_inference(np.int32, [B, S])
+    bt = helper.create_variable_for_type_inference(
+        rpn_rois.dtype, [B, S, 4 * C])
+    biw = helper.create_variable_for_type_inference(
+        rpn_rois.dtype, [B, S, 4 * C])
+    bow = helper.create_variable_for_type_inference(
+        rpn_rois.dtype, [B, S, 4 * C])
+    num = helper.create_variable_for_type_inference(np.int32, [B])
+    inputs = {"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+              "IsCrowd": [is_crowd], "GtBoxes": [gt_boxes],
+              "ImInfo": [im_info]}
+    if rpn_rois_num is not None:
+        inputs["RpnRoisNum"] = [rpn_rois_num]
+    gt_index = helper.create_variable_for_type_inference(np.int32, [B, S])
+    helper.append_op(
+        type="generate_proposal_labels", inputs=inputs,
+        outputs={"Rois": [rois], "LabelsInt32": [labels],
+                 "BboxTargets": [bt], "BboxInsideWeights": [biw],
+                 "BboxOutsideWeights": [bow], "RoisNum": [num],
+                 "GtIndex": [gt_index]},
+        attrs={"batch_size_per_im": batch_size_per_im,
+               "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+               "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+               "bbox_reg_weights": [float(w) for w in bbox_reg_weights],
+               "class_nums": C, "use_random": use_random,
+               "is_cls_agnostic": is_cls_agnostic})
+    # GtIndex is a real graph output; the attribute is only a convenience
+    # handle for the common rois→generate_mask_labels wiring. Pass
+    # return_gt_index=True (or gt_index=... explicitly) when rois go
+    # through intermediate ops, which drop Python attributes.
+    rois.gt_index = gt_index
+    if return_gt_index:
+        return rois, labels, bt, biw, bow, num, gt_index
+    return rois, labels, bt, biw, bow, num
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """distribute_fpn_proposals_op.cc:24. fpn_rois [R,4] (+ rois_num
+    scalar); returns (list of per-level [R,4] zero-padded rois,
+    restore_index [R], list of per-level counts)."""
+    helper = LayerHelper("distribute_fpn_proposals", name=name)
+    n_lvl = max_level - min_level + 1
+    R = fpn_rois.shape[0] or -1
+    multi = [helper.create_variable_for_type_inference(
+        fpn_rois.dtype, [R, 4]) for _ in range(n_lvl)]
+    restore = helper.create_variable_for_type_inference(np.int32, [R])
+    nums = [helper.create_variable_for_type_inference(np.int32, [1])
+            for _ in range(n_lvl)]
+    inputs = {"FpnRois": [fpn_rois]}
+    if rois_num is not None:
+        inputs["RoisNum"] = [rois_num]
+    helper.append_op(
+        type="distribute_fpn_proposals", inputs=inputs,
+        outputs={"MultiFpnRois": multi, "RestoreIndex": [restore],
+                 "MultiLevelRoIsNum": nums},
+        attrs={"min_level": min_level, "max_level": max_level,
+               "refer_level": refer_level, "refer_scale": refer_scale})
+    return multi, restore, nums
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """collect_fpn_proposals_op.cc:29 → (rois [K,4], scores [K],
+    num_valid) with K = post_nms_top_n."""
+    helper = LayerHelper("collect_fpn_proposals", name=name)
+    rois = helper.create_variable_for_type_inference(
+        multi_rois[0].dtype, [post_nms_top_n, 4])
+    scores = helper.create_variable_for_type_inference(
+        multi_scores[0].dtype, [post_nms_top_n])
+    num = helper.create_variable_for_type_inference(np.int32, [1])
+    inputs = {"MultiLevelRois": list(multi_rois),
+              "MultiLevelScores": list(multi_scores)}
+    if rois_num_per_level is not None:
+        inputs["MultiLevelRoIsNum"] = list(rois_num_per_level)
+    helper.append_op(
+        type="collect_fpn_proposals", inputs=inputs,
+        outputs={"FpnRois": [rois], "FpnRoiProbs": [scores],
+                 "RoisNum": [num]},
+        attrs={"post_nms_topN": post_nms_top_n})
+    return rois, scores, num
+
+
+def generate_mask_labels(gt_segms, rois, labels_int32, gt_index=None,
+                         resolution=14, num_classes=81, name=None):
+    """Mask-head targets (generate_mask_labels_op.cc capability).
+    Static/bitmask form: gt_segms [B,G,H,W] {0,1} bitmasks (polygon
+    rasterisation belongs to the data pipeline); rois [B,S,4] /
+    labels_int32 [B,S] from generate_proposal_labels, whose returned
+    rois Variable carries the matched-gt index as `rois.gt_index`
+    (used automatically when gt_index is omitted). Returns (mask_rois,
+    mask_int32 [B, S, resolution, resolution], -1 on non-fg rows)."""
+    helper = LayerHelper("generate_mask_labels", name=name)
+    if gt_index is None:
+        gt_index = getattr(rois, "gt_index", None)
+    if gt_index is None:
+        raise ValueError(
+            "generate_mask_labels needs gt_index (pass explicitly or use "
+            "the rois returned by generate_proposal_labels)")
+    B = rois.shape[0] or -1
+    S = rois.shape[1] or -1
+    mrois = helper.create_variable_for_type_inference(rois.dtype,
+                                                      rois.shape)
+    mint = helper.create_variable_for_type_inference(
+        np.float32, [B, S, resolution, resolution])
+    helper.append_op(
+        type="generate_mask_labels",
+        inputs={"GtSegms": [gt_segms], "Rois": [rois],
+                "LabelsInt32": [labels_int32], "GtIndex": [gt_index]},
+        outputs={"MaskRois": [mrois], "MaskInt32": [mint]},
+        attrs={"resolution": int(resolution),
+               "num_classes": int(num_classes)})
+    return mrois, mint
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0.0, name=None):
+    """target_assign_op.cc:24 (batched static form: input [B,M,K],
+    matched_indices [B,P]) -> (out [B,P,K], out_weight [B,P,1])."""
+    helper = LayerHelper("target_assign", name=name)
+    B, P = matched_indices.shape[0] or -1, matched_indices.shape[1] or -1
+    K = input.shape[-1]
+    out = helper.create_variable_for_type_inference(
+        input.dtype, [B, P, K])
+    wt = helper.create_variable_for_type_inference(
+        np.float32, [B, P, 1])
+    inputs = {"X": [input], "MatchIndices": [matched_indices]}
+    if negative_indices is not None:
+        inputs["NegIndices"] = [negative_indices]
+    helper.append_op(
+        type="target_assign", inputs=inputs,
+        outputs={"Out": [out], "OutWeight": [wt]},
+        attrs={"mismatch_value": float(mismatch_value)})
+    return out, wt
+
+
+def mine_hard_examples(cls_loss, match_indices, match_dist, loc_loss=None,
+                       neg_pos_ratio=3.0, neg_dist_threshold=0.5,
+                       sample_size=0, mining_type="max_negative",
+                       name=None):
+    """mine_hard_examples_op.cc:268 → (neg_mask [B,P] int32,
+    updated_match_indices [B,P])."""
+    helper = LayerHelper("mine_hard_examples", name=name)
+    shape = [match_indices.shape[0] or -1, match_indices.shape[1] or -1]
+    neg = helper.create_variable_for_type_inference(np.int32, shape)
+    upd = helper.create_variable_for_type_inference(np.int32, shape)
+    inputs = {"ClsLoss": [cls_loss], "MatchIndices": [match_indices],
+              "MatchDist": [match_dist]}
+    if loc_loss is not None:
+        inputs["LocLoss"] = [loc_loss]
+    helper.append_op(
+        type="mine_hard_examples", inputs=inputs,
+        outputs={"NegIndices": [neg],
+                 "UpdatedMatchIndices": [upd]},
+        attrs={"neg_pos_ratio": float(neg_pos_ratio),
+               "neg_dist_threshold": float(neg_dist_threshold),
+               "sample_size": int(sample_size),
+               "mining_type": mining_type})
+    return neg, upd
+
+
+def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """matrix_nms_op.cc:87 (batched): bboxes [B,N,4], scores [B,C,N] →
+    out [B*keep_top_k, 6] (-1 padded per image)."""
+    helper = LayerHelper("matrix_nms", name=name)
+    B = bboxes.shape[0] or -1
+    out = helper.create_variable_for_type_inference(
+        bboxes.dtype, [B * keep_top_k if B != -1 else -1, 6])
+    index = helper.create_variable_for_type_inference(
+        np.int32, [B * keep_top_k if B != -1 else -1, 1])
+    num = helper.create_variable_for_type_inference(np.int32, [B])
+    helper.append_op(
+        type="matrix_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out], "Index": [index], "RoisNum": [num]},
+        attrs={"score_threshold": float(score_threshold),
+               "post_threshold": float(post_threshold),
+               "nms_top_k": int(nms_top_k),
+               "keep_top_k": int(keep_top_k),
+               "use_gaussian": use_gaussian,
+               "gaussian_sigma": float(gaussian_sigma),
+               "background_label": int(background_label),
+               "normalized": normalized})
+    outs = [out]
+    if return_index:
+        outs.append(index)
+    if return_rois_num:
+        outs.append(num)
+    return tuple(outs) if len(outs) > 1 else out
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction", name=None):
+    """SSD multibox loss (reference fluid/layers/detection.py ssd_loss):
+    bipartite/per-prediction matching + center-size target encoding +
+    hard-negative mining + smooth-l1/softmax, fused into ONE graph op
+    (the repo's one-jittable-op design — the reference composes ~7 ops
+    via LoD plumbing that static shapes don't need). Batched static
+    form: location [B,P,4], confidence [B,P,C], gt_box [B,G,4]
+    zero-padded, gt_label [B,G] int. Returns the scalar loss."""
+    helper = LayerHelper("ssd_loss", name=name)
+    out = helper.create_variable_for_type_inference(location.dtype, [1])
+    inputs = {"Location": [location], "Confidence": [confidence],
+              "GtBox": [gt_box], "GtLabel": [gt_label],
+              "PriorBox": [prior_box]}
+    attrs = {"background_label": int(background_label),
+             "overlap_threshold": float(overlap_threshold),
+             "neg_pos_ratio": float(neg_pos_ratio),
+             "neg_overlap": float(neg_overlap),
+             "loc_loss_weight": float(loc_loss_weight),
+             "conf_loss_weight": float(conf_loss_weight),
+             "match_type": match_type}
+    if isinstance(prior_box_var, (list, tuple)):
+        attrs["variance"] = [float(v) for v in prior_box_var]
+    elif prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(type="ssd_loss", inputs=inputs,
+                     outputs={"Loss": [out]}, attrs=attrs)
+    return out
+
+
 def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
                     name=None):
     helper = LayerHelper("bipartite_match", name=name)

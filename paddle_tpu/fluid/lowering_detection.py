@@ -150,3 +150,275 @@ def _bipartite(ctx, op):
     idx, d = D.bipartite_match(ctx.inp(op, "DistMat"))
     ctx.out(op, "ColToRowMatchIndices", idx)
     ctx.out(op, "ColToRowMatchDist", d)
+
+
+# ---------------------------------------------------------------------------
+# training-side family (ops/detection_train.py kernels)
+
+from ..ops import detection_train as DT  # noqa: E402
+
+
+def _flatten_rpn_maps(scores, deltas):
+    """[B,A,H,W] objectness + [B,4A,H,W] deltas -> per-image flat
+    [A*H*W] / [A*H*W,4] in the reference's (H,W,A) anchor order
+    (generate_proposals_op.cc transposes NCHW->NHWC before decoding)."""
+    import jax.numpy as jnp
+
+    B, A, H, W = scores.shape
+    s = jnp.transpose(scores, (0, 2, 3, 1)).reshape(B, H * W * A)
+    d = jnp.transpose(deltas.reshape(B, A, 4, H, W),
+                      (0, 3, 4, 1, 2)).reshape(B, H * W * A, 4)
+    return s, d
+
+
+@register("generate_proposals")
+def _generate_proposals(ctx, op):
+    import jax.numpy as jnp
+
+    scores = ctx.inp(op, "Scores")
+    deltas = ctx.inp(op, "BboxDeltas")
+    im_info = ctx.inp(op, "ImInfo")
+    anchors = ctx.inp(op, "Anchors").reshape(-1, 4)
+    variances = ctx.inp(op, "Variances")
+    if variances is not None:
+        variances = variances.reshape(-1, 4)
+    s, d = _flatten_rpn_maps(scores, deltas)
+    rois, probs, nums = [], [], []
+    for b in range(s.shape[0]):
+        r, p, n = DT.generate_proposals(
+            s[b], d[b], im_info[b], anchors, variances,
+            op.attrs.get("pre_nms_topN", 6000),
+            op.attrs.get("post_nms_topN", 1000),
+            op.attrs.get("nms_thresh", 0.5),
+            op.attrs.get("min_size", 0.1),
+            op.attrs.get("eta", 1.0))
+        rois.append(r)
+        probs.append(p)
+        nums.append(n)
+    ctx.out(op, "RpnRois", jnp.stack(rois))
+    ctx.out(op, "RpnRoiProbs", jnp.stack(probs))
+    ctx.out(op, "RpnRoisNum", jnp.stack(nums))
+
+
+@register("rpn_target_assign")
+def _rpn_target_assign(ctx, op):
+    import jax.numpy as jnp
+
+    anchors = ctx.inp(op, "Anchor").reshape(-1, 4)
+    gt = ctx.inp(op, "GtBoxes")
+    crowd = ctx.inp(op, "IsCrowd")
+    im_info = ctx.inp(op, "ImInfo")
+    labels, tgts, inws = [], [], []
+    for b in range(gt.shape[0]):
+        key = ctx.next_key() if op.attrs.get("use_random", True) else None
+        out = DT.rpn_target_assign(
+            anchors, gt[b],
+            crowd[b] if crowd is not None else jnp.zeros(
+                (gt.shape[1],), jnp.int32),
+            im_info[b], None,
+            op.attrs.get("rpn_batch_size_per_im", 256),
+            op.attrs.get("rpn_straddle_thresh", 0.0),
+            op.attrs.get("rpn_fg_fraction", 0.5),
+            op.attrs.get("rpn_positive_overlap", 0.7),
+            op.attrs.get("rpn_negative_overlap", 0.3), key=key)
+        labels.append(out["labels"])
+        tgts.append(out["bbox_targets"])
+        inws.append(out["bbox_inside_weight"])
+    ctx.out(op, "TargetLabel", jnp.stack(labels))
+    ctx.out(op, "TargetBBox", jnp.stack(tgts))
+    ctx.out(op, "BBoxInsideWeight", jnp.stack(inws))
+
+
+@register("retinanet_target_assign")
+def _retina_target_assign(ctx, op):
+    import jax.numpy as jnp
+
+    anchors = ctx.inp(op, "Anchor").reshape(-1, 4)
+    gt = ctx.inp(op, "GtBoxes")
+    gtl = ctx.inp(op, "GtLabels")
+    crowd = ctx.inp(op, "IsCrowd")
+    im_info = ctx.inp(op, "ImInfo")
+    labels, tgts, inws, fgs = [], [], [], []
+    for b in range(gt.shape[0]):
+        out = DT.retinanet_target_assign(
+            anchors, gt[b], gtl[b],
+            crowd[b] if crowd is not None else jnp.zeros(
+                (gt.shape[1],), jnp.int32),
+            im_info[b], None,
+            op.attrs.get("positive_overlap", 0.5),
+            op.attrs.get("negative_overlap", 0.4))
+        labels.append(out["labels"])
+        tgts.append(out["bbox_targets"])
+        inws.append(out["bbox_inside_weight"])
+        fgs.append(out["fg_num"])
+    ctx.out(op, "TargetLabel", jnp.stack(labels))
+    ctx.out(op, "TargetBBox", jnp.stack(tgts))
+    ctx.out(op, "BBoxInsideWeight", jnp.stack(inws))
+    ctx.out(op, "ForegroundNumber", jnp.stack(fgs))
+
+
+@register("generate_proposal_labels")
+def _generate_proposal_labels(ctx, op):
+    import jax.numpy as jnp
+
+    rois = ctx.inp(op, "RpnRois")
+    gtc = ctx.inp(op, "GtClasses")
+    crowd = ctx.inp(op, "IsCrowd")
+    gtb = ctx.inp(op, "GtBoxes")
+    im_info = ctx.inp(op, "ImInfo")
+    rnum = ctx.inp(op, "RpnRoisNum")
+    outs = {k: [] for k in ("rois", "labels_int32", "bbox_targets",
+                            "bbox_inside_weights", "bbox_outside_weights",
+                            "valid_num", "gt_index")}
+    for b in range(rois.shape[0]):
+        key = ctx.next_key() if op.attrs.get("use_random", True) else None
+        o = DT.generate_proposal_labels(
+            rois[b],
+            rnum[b] if rnum is not None else rois.shape[1],
+            gtc[b],
+            crowd[b] if crowd is not None else jnp.zeros(
+                (gtb.shape[1],), jnp.int32),
+            gtb[b], im_info[b][2], None,
+            op.attrs.get("batch_size_per_im", 512),
+            op.attrs.get("fg_fraction", 0.25),
+            op.attrs.get("fg_thresh", 0.5),
+            op.attrs.get("bg_thresh_hi", 0.5),
+            op.attrs.get("bg_thresh_lo", 0.0),
+            tuple(op.attrs.get("bbox_reg_weights", (0.1, 0.1, 0.2, 0.2))),
+            op.attrs.get("class_nums", 81), True, key,
+            op.attrs.get("is_cls_agnostic", False))
+        for k in outs:
+            outs[k].append(o[k])
+    ctx.out(op, "Rois", jnp.stack(outs["rois"]))
+    ctx.out(op, "LabelsInt32", jnp.stack(outs["labels_int32"]))
+    ctx.out(op, "BboxTargets", jnp.stack(outs["bbox_targets"]))
+    ctx.out(op, "BboxInsideWeights", jnp.stack(outs["bbox_inside_weights"]))
+    ctx.out(op, "BboxOutsideWeights",
+            jnp.stack(outs["bbox_outside_weights"]))
+    ctx.out(op, "RoisNum", jnp.stack(outs["valid_num"]))
+    ctx.out(op, "GtIndex", jnp.stack(outs["gt_index"]))
+
+
+@register("distribute_fpn_proposals")
+def _distribute_fpn(ctx, op):
+    import jax.numpy as jnp
+
+    rois = ctx.inp(op, "FpnRois")
+    rnum = ctx.inp(op, "RoisNum")
+    if rnum is not None:
+        rnum = rnum.reshape(())
+    else:
+        rnum = jnp.asarray(rois.shape[0])
+    outs, restore = DT.distribute_fpn_proposals(
+        rois, rnum,
+        op.attrs.get("min_level", 2), op.attrs.get("max_level", 5),
+        op.attrs.get("refer_level", 4), op.attrs.get("refer_scale", 224))
+    ctx.outs(op, "MultiFpnRois", [o for o, _, _ in outs])
+    ctx.outs(op, "MultiLevelRoIsNum",
+             [c.reshape((1,)) for _, _, c in outs])
+    ctx.out(op, "RestoreIndex", restore)
+
+
+@register("collect_fpn_proposals")
+def _collect_fpn(ctx, op):
+    import jax.numpy as jnp
+
+    multi_rois = ctx.inps(op, "MultiLevelRois")
+    multi_scores = ctx.inps(op, "MultiLevelScores")
+    nums = op.input("MultiLevelRoIsNum") and \
+        [n.reshape(()) for n in ctx.inps(op, "MultiLevelRoIsNum")]
+    if not nums:
+        nums = [jnp.asarray(r.shape[0]) for r in multi_rois]
+    rois, scores, n = DT.collect_fpn_proposals(
+        multi_rois, multi_scores, nums,
+        op.attrs.get("post_nms_topN", 1000))
+    ctx.out(op, "FpnRois", rois)
+    ctx.out(op, "FpnRoiProbs", scores)
+    ctx.out(op, "RoisNum", n.reshape((1,)))
+
+
+@register("target_assign")
+def _target_assign(ctx, op):
+    out, wt = DT.target_assign(
+        ctx.inp(op, "X"), ctx.inp(op, "MatchIndices"),
+        op.attrs.get("mismatch_value", 0.0))
+    ctx.out(op, "Out", out)
+    ctx.out(op, "OutWeight", wt[..., None])
+
+
+@register("mine_hard_examples")
+def _mine_hard(ctx, op):
+    import jax.numpy as jnp
+
+    neg, upd = DT.mine_hard_examples(
+        ctx.inp(op, "ClsLoss"), ctx.inp(op, "MatchIndices"),
+        ctx.inp(op, "MatchDist"), ctx.inp(op, "LocLoss"),
+        op.attrs.get("neg_pos_ratio", 3.0),
+        op.attrs.get("neg_dist_threshold", 0.5),
+        op.attrs.get("sample_size", 0),
+        op.attrs.get("mining_type", "max_negative"))
+    ctx.out(op, "NegIndices", neg.astype(jnp.int32))
+    ctx.out(op, "UpdatedMatchIndices", upd)
+
+
+@register("matrix_nms")
+def _matrix_nms(ctx, op):
+    import jax.numpy as jnp
+
+    bboxes = ctx.inp(op, "BBoxes")
+    scores = ctx.inp(op, "Scores")
+    outs, idxs, nums = [], [], []
+    for b in range(bboxes.shape[0]):
+        o, i, n = DT.matrix_nms(
+            bboxes[b], scores[b],
+            op.attrs.get("score_threshold", 0.05),
+            op.attrs.get("post_threshold", 0.0),
+            op.attrs.get("nms_top_k", 400),
+            op.attrs.get("keep_top_k", 100),
+            op.attrs.get("use_gaussian", False),
+            op.attrs.get("gaussian_sigma", 2.0),
+            op.attrs.get("background_label", 0),
+            op.attrs.get("normalized", True))
+        outs.append(o)
+        idxs.append(i)
+        nums.append(n)
+    ctx.out(op, "Out", jnp.concatenate(outs, axis=0))
+    ctx.out(op, "Index", jnp.concatenate(idxs)[:, None])
+    ctx.out(op, "RoisNum", jnp.stack(nums))
+
+
+@register("ssd_loss")
+def _ssd_loss(ctx, op):
+    pv = ctx.inp(op, "PriorBoxVar")
+    if pv is None and op.attrs.get("variance"):
+        pv = np.asarray(op.attrs["variance"], np.float32)
+    out = DT.ssd_loss(
+        ctx.inp(op, "Location"), ctx.inp(op, "Confidence"),
+        ctx.inp(op, "GtBox"), ctx.inp(op, "GtLabel"),
+        ctx.inp(op, "PriorBox"), pv,
+        op.attrs.get("background_label", 0),
+        op.attrs.get("overlap_threshold", 0.5),
+        op.attrs.get("neg_pos_ratio", 3.0),
+        op.attrs.get("neg_overlap", 0.5),
+        op.attrs.get("loc_loss_weight", 1.0),
+        op.attrs.get("conf_loss_weight", 1.0),
+        op.attrs.get("match_type", "per_prediction"))
+    ctx.out(op, "Loss", out)
+
+
+@register("generate_mask_labels")
+def _generate_mask_labels(ctx, op):
+    import jax.numpy as jnp
+
+    segms = ctx.inp(op, "GtSegms")
+    rois = ctx.inp(op, "Rois")
+    labels = ctx.inp(op, "LabelsInt32")
+    gt_index = ctx.inp(op, "GtIndex")
+    outs = []
+    for b in range(rois.shape[0]):
+        outs.append(DT.generate_mask_labels(
+            segms[b], rois[b], labels[b], gt_index[b],
+            op.attrs.get("resolution", 14),
+            op.attrs.get("num_classes", 81)))
+    ctx.out(op, "MaskRois", rois)
+    ctx.out(op, "MaskInt32", jnp.stack(outs))

@@ -331,3 +331,82 @@ def get_worker_info():
     from .dataloader_iter import get_worker_info as _gwi
 
     return _gwi()
+
+
+class DevicePrefetcher:
+    """Host→device double-buffered prefetch (reference:
+    operators/reader/buffered_reader.cc:1 — the buffered reader that
+    overlaps H2D copies with compute).
+
+    Wraps any iterator of numpy/jax pytrees. A background thread pulls
+    host batches and issues async `jax.device_put`s `depth` ahead, so by
+    the time the training step asks for batch k its transfer has been in
+    flight while step k-1 computed. Yields device-committed pytrees.
+    """
+
+    _END = object()
+
+    def __init__(self, it, sharding=None, depth=2):
+        import queue
+        import threading
+
+        self._q = queue.Queue(maxsize=max(1, depth))
+        self._err = None
+        self._closed = False
+
+        def _put(item):
+            # blocking put that aborts promptly once close() is called;
+            # the END sentinel MUST go through here too — dropping it on
+            # a full queue would strand the consumer in get() forever
+            while not self._closed:
+                try:
+                    self._q.put(item, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+
+        def _pump():
+            import jax
+
+            try:
+                for batch in it:
+                    if self._closed:
+                        return
+                    put = (lambda a: jax.device_put(a, sharding)) \
+                        if sharding is not None else jax.device_put
+                    dev = jax.tree_util.tree_map(
+                        lambda a: put(np.asarray(a))
+                        if isinstance(a, np.ndarray) or np.isscalar(a)
+                        or hasattr(a, "__array__") else a, batch)
+                    _put(dev)
+            except BaseException as e:  # surfaced on the consumer side
+                self._err = e
+            finally:
+                _put(self._END)
+
+        self._thread = threading.Thread(target=_pump, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        """Stop the pump and release queued device buffers. Call when
+        abandoning iteration early; iterating to exhaustion cleans up on
+        its own."""
+        self._closed = True
+        try:
+            while True:
+                self._q.get_nowait()
+        except Exception:
+            pass
+        self._thread.join(timeout=5)
+
+    def __iter__(self):
+        try:
+            while True:
+                item = self._q.get()
+                if item is self._END:
+                    if self._err is not None:
+                        raise self._err
+                    return
+                yield item
+        finally:
+            self.close()

@@ -1,1 +1,3 @@
 from . import kernels  # noqa: F401
+from . import detection  # noqa: F401
+from . import detection_train  # noqa: F401

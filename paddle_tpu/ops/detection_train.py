@@ -1,0 +1,619 @@
+"""Training-side detection ops: proposal generation, target assignment,
+FPN routing, hard-example mining (operators/detection/ training family,
+re-designed TPU-first).
+
+Reference parity targets:
+  generate_proposals_op.cc:81, rpn_target_assign_op.cc:36 (+ the
+  retinanet variant at :612), distribute_fpn_proposals_op.cc:24,
+  collect_fpn_proposals_op.cc:29, generate_proposal_labels_op.cc:43,
+  generate_mask_labels_op.cc, target_assign_op.cc:24,
+  mine_hard_examples_op.cc:268, matrix_nms_op.cc:87.
+
+TPU-native contract (same as ops/detection.py): every output is STATIC
+shape. Variable-length results come back as fixed buffers padded with -1
+(indices) or 0 (values) plus a valid count; "sampling" is a top-k over
+masked random keys inside jit instead of reservoir sampling over
+std::vector. Batch = vmap or a Python loop over a handful of images at
+trace time, never data-dependent shapes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .detection import iou_matrix, nms
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+_BBOX_CLIP = float(np.log(1000.0 / 16.0))
+
+
+def _encode_rowwise(targets, priors, box_normalized=False, weights=None):
+    """Row-wise center-size encode: target[i] against prior[i] -> [N,4]
+    (the Faster-RCNN delta form of box_coder_op.h with axis-aligned
+    rows; the library box_coder's encode path is the pairwise [N,M,4]
+    SSD form)."""
+    jnp = _jnp()
+    off = 0.0 if box_normalized else 1.0
+    pw = priors[:, 2] - priors[:, 0] + off
+    ph = priors[:, 3] - priors[:, 1] + off
+    pcx = priors[:, 0] + pw * 0.5
+    pcy = priors[:, 1] + ph * 0.5
+    tw = targets[:, 2] - targets[:, 0] + off
+    th = targets[:, 3] - targets[:, 1] + off
+    tcx = targets[:, 0] + tw * 0.5
+    tcy = targets[:, 1] + th * 0.5
+    out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                     jnp.log(jnp.clip(tw / pw, 1e-10, None)),
+                     jnp.log(jnp.clip(th / ph, 1e-10, None))], axis=1)
+    if weights is not None:
+        w = jnp.asarray(weights, out.dtype)
+        out = out / (w if w.ndim == 2 else w[None, :])
+    return out
+
+
+def _rand_keys(key, shape):
+    """Uniform tie-break keys for sampling; deterministic arange when no
+    PRNG key is supplied (use_random=False parity)."""
+    import jax
+
+    jnp = _jnp()
+    if key is None:
+        return -jnp.arange(np.prod(shape), dtype=jnp.float32).reshape(shape)
+    return jax.random.uniform(key, shape)
+
+
+def _sample_mask(cand_mask, quota, key):
+    """Pick up to `quota` True positions from cand_mask (random when key
+    given, lowest-index otherwise). Returns (mask, count)."""
+    jnp = _jnp()
+    n = cand_mask.shape[0]
+    quota = jnp.minimum(jnp.asarray(quota, jnp.int32),
+                        cand_mask.sum().astype(jnp.int32))
+    score = jnp.where(cand_mask, _rand_keys(key, (n,)), -jnp.inf)
+    order = jnp.argsort(-score)
+    rank = jnp.zeros((n,), jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32))
+    mask = cand_mask & (rank < quota)
+    return mask, quota
+
+
+def decode_proposals(anchors, deltas, variances=None):
+    """generate_proposals_op.cc BoxCoder: decode RPN deltas against
+    anchors ((x1,y1,x2,y2), +1 pixel widths, exp clipped at
+    log(1000/16)). anchors/deltas [N,4] -> proposals [N,4]."""
+    jnp = _jnp()
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    if variances is not None:
+        dx, dy = variances[:, 0] * deltas[:, 0], variances[:, 1] * deltas[:, 1]
+        dw, dh = variances[:, 2] * deltas[:, 2], variances[:, 3] * deltas[:, 3]
+    else:
+        dx, dy, dw, dh = (deltas[:, i] for i in range(4))
+    cx = dx * aw + acx
+    cy = dy * ah + acy
+    w = jnp.exp(jnp.minimum(dw, _BBOX_CLIP)) * aw
+    h = jnp.exp(jnp.minimum(dh, _BBOX_CLIP)) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2,
+                      cx + w / 2 - 1, cy + h / 2 - 1], axis=1)
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0):
+    """Single image. scores [A*H*W] (objectness), bbox_deltas [A*H*W,4]
+    laid out to match `anchors` [A*H*W,4], im_info [3] = (h, w, scale).
+    Returns (rois [post_nms_top_n,4] zero-padded, roi_probs
+    [post_nms_top_n], num_valid). generate_proposals_op.cc:81."""
+    jnp = _jnp()
+    n = scores.shape[0]
+    k = min(int(pre_nms_top_n), n) if pre_nms_top_n > 0 else n
+    top = jnp.argsort(-scores)[:k]
+    props = decode_proposals(anchors[top], bbox_deltas[top],
+                             None if variances is None else variances[top])
+    h, w, scale = im_info[0], im_info[1], im_info[2]
+    props = jnp.stack([
+        jnp.clip(props[:, 0], 0.0, w - 1),
+        jnp.clip(props[:, 1], 0.0, h - 1),
+        jnp.clip(props[:, 2], 0.0, w - 1),
+        jnp.clip(props[:, 3], 0.0, h - 1)], axis=1)
+    # FilterBoxes: min_size at the ORIGINAL image scale, center inside
+    ms = jnp.maximum(min_size, 1.0)
+    ws = props[:, 2] - props[:, 0] + 1
+    hs = props[:, 3] - props[:, 1] + 1
+    keep = ((ws - 1) / scale + 1 >= ms) & ((hs - 1) / scale + 1 >= ms) \
+        & (props[:, 0] + ws / 2 <= w) & (props[:, 1] + hs / 2 <= h)
+    sc = jnp.where(keep, scores[top], -jnp.inf)
+    keep_idx, cnt = nms(props, sc, nms_thresh,
+                        max_out=int(post_nms_top_n), normalized=False)
+    valid = (jnp.arange(int(post_nms_top_n)) < cnt) & (keep_idx >= 0)
+    sel = jnp.clip(keep_idx, 0, k - 1)
+    # nms emits by score desc, so min-size-filtered (-inf) candidates can
+    # only appear AFTER every real one — `real` is a prefix mask; rows
+    # past it are zeroed so the padding contract holds
+    real = valid & jnp.isfinite(jnp.where(valid, sc[sel], 0.0))
+    rois = jnp.where(real[:, None], props[sel], 0.0)
+    probs = jnp.where(real, scores[top][sel], 0.0)
+    return rois, probs, real.sum().astype(jnp.int32)
+
+
+def rpn_target_assign(anchors, gt_boxes, is_crowd, im_info, gt_count=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, key=None):
+    """Single image anchor→gt assignment (rpn_target_assign_op.cc:36).
+
+    anchors [A,4], gt_boxes [G,4] (zero-padded), is_crowd [G] int,
+    im_info [3], gt_count = #valid gt rows (defaults to all G).
+    Returns dict with STATIC shapes:
+      labels       [A] int32: 1 fg / 0 bg / -1 ignore
+      bbox_targets [A,4] encode_center_size targets (zero off-fg)
+      bbox_inside_weight [A,4] 1.0 on fg rows
+      fg_num, bg_num scalars
+    (The reference emits compacted index lists; masks over the full
+    anchor set are the static equivalent — gather loc/score indices with
+    jnp.nonzero OUTSIDE jit, or consume the masks directly in the loss.)
+    """
+    jnp = _jnp()
+    A = anchors.shape[0]
+    G = gt_boxes.shape[0]
+    gvalid = jnp.arange(G) < (G if gt_count is None else gt_count)
+    gvalid = gvalid & (jnp.asarray(is_crowd) == 0)
+    h, w = im_info[0], im_info[1]
+    t = rpn_straddle_thresh
+    if t >= 0:
+        inside = ((anchors[:, 0] >= -t) & (anchors[:, 1] >= -t)
+                  & (anchors[:, 2] < w + t) & (anchors[:, 3] < h + t))
+    else:
+        inside = jnp.ones((A,), bool)
+    iou = iou_matrix(anchors, gt_boxes, normalized=True)
+    iou = jnp.where(gvalid[None, :], iou, -1.0)
+    iou = jnp.where(inside[:, None], iou, -1.0)
+    a2g_max = iou.max(axis=1)
+    a2g_arg = iou.argmax(axis=1)
+    g2a_max = iou.max(axis=0)
+    # Detectron rule: anchors hitting a gt's best overlap, or above thresh
+    is_best = ((jnp.abs(iou - g2a_max[None, :]) < 1e-5)
+               & gvalid[None, :] & (iou > 0)).any(axis=1)
+    fg_cand = inside & (is_best | (a2g_max >= rpn_positive_overlap))
+    fg_quota = int(rpn_fg_fraction * rpn_batch_size_per_im) \
+        if rpn_fg_fraction > 0 and rpn_batch_size_per_im > 0 else A
+    import jax
+
+    k1 = k2 = None
+    if key is not None:
+        k1, k2 = jax.random.split(key)
+    fg_mask, fg_num = _sample_mask(fg_cand, fg_quota, k1)
+    bg_cand = inside & (a2g_max < rpn_negative_overlap) & ~fg_mask
+    bg_quota = rpn_batch_size_per_im - fg_num \
+        if rpn_batch_size_per_im > 0 else A
+    bg_mask, bg_num = _sample_mask(bg_cand, bg_quota, k2)
+    labels = jnp.full((A,), -1, jnp.int32)
+    labels = jnp.where(bg_mask, 0, labels)
+    labels = jnp.where(fg_mask, 1, labels)
+    tgt = _encode_rowwise(gt_boxes[a2g_arg], anchors)
+    bbox_targets = jnp.where(fg_mask[:, None], tgt, 0.0)
+    inw = jnp.where(fg_mask[:, None],
+                    jnp.ones((A, 4), anchors.dtype), 0.0)
+    return {"labels": labels, "bbox_targets": bbox_targets,
+            "bbox_inside_weight": inw, "fg_num": fg_num, "bg_num": bg_num}
+
+
+def retinanet_target_assign(anchors, gt_boxes, gt_labels, is_crowd, im_info,
+                            gt_count=None, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    """rpn_target_assign_op.cc:612 variant: every non-ignored anchor is
+    used (no sampling), fg labels carry the gt CLASS (1-based), and the
+    fg count is returned for focal-loss normalization."""
+    jnp = _jnp()
+    A = anchors.shape[0]
+    G = gt_boxes.shape[0]
+    gvalid = jnp.arange(G) < (G if gt_count is None else gt_count)
+    gvalid = gvalid & (jnp.asarray(is_crowd) == 0)
+    iou = iou_matrix(anchors, gt_boxes, normalized=True)
+    iou = jnp.where(gvalid[None, :], iou, -1.0)
+    a2g_max = iou.max(axis=1)
+    a2g_arg = iou.argmax(axis=1)
+    g2a_max = iou.max(axis=0)
+    is_best = ((jnp.abs(iou - g2a_max[None, :]) < 1e-5)
+               & gvalid[None, :] & (iou > 0)).any(axis=1)
+    fg = is_best | (a2g_max >= positive_overlap)
+    bg = ~fg & (a2g_max < negative_overlap) & (a2g_max >= 0)
+    labels = jnp.full((A,), -1, jnp.int32)
+    labels = jnp.where(bg, 0, labels)
+    labels = jnp.where(fg, jnp.asarray(gt_labels, jnp.int32)[a2g_arg],
+                       labels)
+    tgt = _encode_rowwise(gt_boxes[a2g_arg], anchors)
+    bbox_targets = jnp.where(fg[:, None], tgt, 0.0)
+    inw = jnp.where(fg[:, None], jnp.ones((A, 4), anchors.dtype), 0.0)
+    return {"labels": labels, "bbox_targets": bbox_targets,
+            "bbox_inside_weight": inw,
+            "fg_num": fg.sum().astype(jnp.int32)}
+
+
+def generate_proposal_labels(rois, roi_count, gt_classes, is_crowd, gt_boxes,
+                             im_scale, gt_count=None,
+                             batch_size_per_im=512, fg_fraction=0.25,
+                             fg_thresh=0.5, bg_thresh_hi=0.5,
+                             bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=81, use_gt_as_rois=True, key=None,
+                             is_cls_agnostic=False):
+    """Single image RoI-head sampling (generate_proposal_labels_op.cc:43).
+
+    rois [R,4] zero-padded with roi_count valid; gt_boxes [G,4] at the
+    ORIGINAL scale (scaled by im_scale internally, reference parity);
+    gt_classes [G] int (1..class_nums-1). Returns dict of STATIC shapes:
+      rois            [B,4]   sampled boxes (B = batch_size_per_im)
+      labels_int32    [B]     class id, 0 = background, -1 = pad
+      bbox_targets    [B, 4*class_nums] encoded targets in the label's slot
+      bbox_inside_weights / bbox_outside_weights same shape
+      fg_num, valid_num scalars
+    """
+    import jax
+
+    jnp = _jnp()
+    R, G = rois.shape[0], gt_boxes.shape[0]
+    B = int(batch_size_per_im)
+    gvalid = jnp.arange(G) < (G if gt_count is None else gt_count)
+    # zero-padded gt rows must never match anything: a [0,0,0,0] box has
+    # area 1 under the +1-pixel convention and would self-match its own
+    # appended roi with IoU 1.0, fabricating foreground samples
+    nonzero = ((gt_boxes[:, 2] > gt_boxes[:, 0])
+               & (gt_boxes[:, 3] > gt_boxes[:, 1]))
+    not_crowd = gvalid & nonzero & (jnp.asarray(is_crowd) == 0)
+    gt_scaled = gt_boxes * im_scale
+    # candidate set: proposals (+ gt boxes themselves, reference appends)
+    if use_gt_as_rois:
+        allb = jnp.concatenate([rois, gt_scaled], axis=0)
+        bvalid = jnp.concatenate(
+            [jnp.arange(R) < roi_count, not_crowd], axis=0)
+    else:
+        allb = rois
+        bvalid = jnp.arange(R) < roi_count
+    N = allb.shape[0]
+    if N < B:  # fewer candidates than the sampling budget: pad invalid
+        pad = B - N
+        allb = jnp.concatenate(
+            [allb, jnp.zeros((pad, 4), allb.dtype)], axis=0)
+        bvalid = jnp.concatenate(
+            [bvalid, jnp.zeros((pad,), bool)], axis=0)
+        N = B
+    iou = iou_matrix(allb, gt_scaled, normalized=False)
+    iou = jnp.where(not_crowd[None, :], iou, -1.0)
+    iou = jnp.where(bvalid[:, None], iou, -1.0)
+    b2g_max = iou.max(axis=1)
+    b2g_arg = iou.argmax(axis=1)
+    fg_cand = bvalid & (b2g_max >= fg_thresh)
+    bg_cand = bvalid & (b2g_max < bg_thresh_hi) & (b2g_max >= bg_thresh_lo)
+    k1 = k2 = None
+    if key is not None:
+        k1, k2 = jax.random.split(key)
+    fg_quota = int(np.round(fg_fraction * B))
+    fg_mask, fg_num = _sample_mask(fg_cand, fg_quota, k1)
+    bg_mask, bg_num = _sample_mask(bg_cand, B - fg_num, k2)
+    # order: all fg rows first, then bg (reference concatenates) — build
+    # a static gather: rank fg rows 0..fg_num-1, bg rows fg_num..
+    skey = jnp.where(fg_mask, 2.0, jnp.where(bg_mask, 1.0, 0.0))
+    tie = _rand_keys(None, (N,)) * 1e-9  # stable by index
+    order = jnp.argsort(-(skey + tie))
+    sel = order[:B]
+    sel_fg = fg_mask[sel]
+    sel_valid = (fg_mask | bg_mask)[sel]
+    out_rois = jnp.where(sel_valid[:, None], allb[sel], 0.0)
+    glab = jnp.asarray(gt_classes, jnp.int32)[b2g_arg[sel]]
+    labels = jnp.where(sel_fg, glab, jnp.where(sel_valid, 0, -1))
+    w = jnp.asarray(bbox_reg_weights, allb.dtype)
+    tgt = _encode_rowwise(gt_scaled[b2g_arg[sel]], allb[sel],
+                          weights=w)
+    # scatter each fg target into its class slot of [B, 4*class_nums]
+    C = 1 if is_cls_agnostic else int(class_nums)
+    fg_cls = jnp.ones_like(glab) if is_cls_agnostic else glab
+    cls = jnp.where(sel_fg, fg_cls, 0)
+    bt = jnp.zeros((B, C, 4), allb.dtype)
+    rowi = jnp.arange(B)
+    bt = bt.at[rowi, jnp.clip(cls, 0, C - 1)].set(
+        jnp.where(sel_fg[:, None], tgt, 0.0))
+    bt = bt * (cls > 0)[:, None, None]
+    inw = jnp.zeros((B, C, 4), allb.dtype).at[
+        rowi, jnp.clip(cls, 0, C - 1)].set(
+        jnp.where(sel_fg[:, None], 1.0, 0.0)) * (cls > 0)[:, None, None]
+    return {"rois": out_rois,
+            "labels_int32": labels,
+            "bbox_targets": bt.reshape(B, C * 4),
+            "bbox_inside_weights": inw.reshape(B, C * 4),
+            "bbox_outside_weights": inw.reshape(B, C * 4),
+            "fg_num": fg_num, "valid_num": fg_num + bg_num,
+            "gt_index": b2g_arg[sel]}
+
+
+def generate_mask_labels(gt_masks, sampled_rois, sampled_labels, gt_index,
+                         resolution=14, num_classes=81):
+    """Mask-head targets (generate_mask_labels_op.cc capability, bitmask
+    form). gt_masks [G,H,W] {0,1} at the roi coordinate scale;
+    sampled_rois [B,4] + sampled_labels [B] + gt_index [B] from
+    generate_proposal_labels. Returns mask_targets
+    [B, resolution, resolution] in {0,1} (-1 on non-fg rows) — crop each
+    roi from its matched gt bitmask with nearest-neighbor sampling at
+    bin centers (binary targets make interpolation moot; COCO polygon
+    decoding belongs to the data pipeline, not the graph)."""
+    jnp = _jnp()
+    B = sampled_rois.shape[0]
+    res = int(resolution)
+    x1, y1, x2, y2 = (sampled_rois[:, i] for i in range(4))
+    # sample grid over each roi
+    t = (jnp.arange(res) + 0.5) / res
+    gx = x1[:, None] + t[None, :] * (x2 - x1 + 1)[:, None]  # [B,res]
+    gy = y1[:, None] + t[None, :] * (y2 - y1 + 1)[:, None]
+    masks = jnp.asarray(gt_masks)[jnp.asarray(gt_index)]  # [B,H,W]
+    H, W = masks.shape[1], masks.shape[2]
+    xi = jnp.clip(gx.astype(jnp.int32), 0, W - 1)
+    yi = jnp.clip(gy.astype(jnp.int32), 0, H - 1)
+    out = masks[jnp.arange(B)[:, None, None], yi[:, :, None],
+                xi[:, None, :]]
+    fg = jnp.asarray(sampled_labels) > 0
+    return jnp.where(fg[:, None, None], out.astype(jnp.float32), -1.0)
+
+
+def distribute_fpn_proposals(rois, roi_count, min_level=2, max_level=5,
+                             refer_level=4, refer_scale=224):
+    """distribute_fpn_proposals_op.cc:24: route each roi to an FPN level
+    by sqrt(area). rois [R,4] + count. Returns (per-level list of
+    ([R,4] zero-padded rois, mask [R]), restore_index [R] int32): level
+    buffers keep the ORIGINAL row order compacted to the front, and
+    restore_index maps concat(level outputs) rows back to input order."""
+    jnp = _jnp()
+    R = rois.shape[0]
+    valid = jnp.arange(R) < roi_count
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = jnp.sqrt(jnp.clip(w, 0, None) * jnp.clip(h, 0, None))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    lvl = jnp.where(valid, lvl, max_level + 1)  # pads route nowhere
+    outs = []
+    offsets = jnp.zeros((), jnp.int32)
+    # sentinel R = "routed nowhere": out-of-bounds scatters get dropped,
+    # so padded rows can never clobber concat position 0
+    pos_in_out = jnp.full((R,), R, jnp.int32)
+    for level in range(min_level, max_level + 1):
+        m = lvl == level
+        # compact this level's rois to the buffer front, original order
+        rank = jnp.cumsum(m.astype(jnp.int32)) - 1
+        cnt = m.sum().astype(jnp.int32)
+        buf = jnp.zeros((R, 4), rois.dtype)
+        buf = buf.at[jnp.where(m, rank, R)].set(
+            jnp.where(m[:, None], rois, 0.0), mode="drop")
+        # rows that routed here sit at concat offset + rank
+        pos_in_out = jnp.where(m, offsets + rank, pos_in_out)
+        offsets = offsets + cnt
+        outs.append((buf, m, cnt))
+    restore = jnp.zeros((R,), jnp.int32).at[pos_in_out].set(
+        jnp.arange(R, dtype=jnp.int32), mode="drop")
+    # restore_index[j] = original row of concat-row j (reference contract)
+    nvalid = jnp.asarray(roi_count, jnp.int32)
+    return outs, jnp.where(jnp.arange(R) < nvalid, restore, -1)
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, counts,
+                          post_nms_top_n=1000):
+    """collect_fpn_proposals_op.cc:29: concat per-level (rois, scores),
+    keep global top post_nms_top_n by score. Each entry [Ri,4]/[Ri] with
+    counts[i] valid. Returns (rois [K,4], scores [K], num_valid)."""
+    jnp = _jnp()
+    rois = jnp.concatenate(multi_rois, axis=0)
+    scores = jnp.concatenate(multi_scores, axis=0)
+    valids = jnp.concatenate([
+        jnp.arange(r.shape[0]) < c
+        for r, c in zip(multi_rois, counts)], axis=0)
+    K = int(post_nms_top_n)
+    sc = jnp.where(valids, scores, -jnp.inf)
+    top = jnp.argsort(-sc)[:K]
+    ok = sc[top] > -jnp.inf
+    return (jnp.where(ok[:, None], rois[top], 0.0),
+            jnp.where(ok, scores[top], 0.0),
+            ok.sum().astype(jnp.int32))
+
+
+def target_assign(x, match_indices, mismatch_value=0.0, x_count=None):
+    """target_assign_op.cc:24 (batched, static): x [B, M, K] candidate
+    rows (gt boxes / labels), match_indices [B, P] int (-1 = no match).
+    out[b,p] = x[b, match[b,p]] when matched else mismatch_value;
+    weight 1/0 alike. x_count [B] masks padded gt rows to mismatch."""
+    jnp = _jnp()
+    x = jnp.asarray(x)
+    mi = jnp.asarray(match_indices)
+    B, M = x.shape[0], x.shape[1]
+    matched = mi >= 0
+    if x_count is not None:
+        matched = matched & (mi < jnp.asarray(x_count)[:, None])
+    sel = jnp.clip(mi, 0, M - 1)
+    out = x[jnp.arange(B)[:, None], sel]
+    out = jnp.where(matched[..., None] if out.ndim == 3 else matched,
+                    out, mismatch_value)
+    wt = matched.astype(jnp.float32)
+    return out, wt
+
+
+def mine_hard_examples(cls_loss, match_indices, match_dist, loc_loss=None,
+                       neg_pos_ratio=3.0, neg_dist_threshold=0.5,
+                       sample_size=0, mining_type="max_negative"):
+    """mine_hard_examples_op.cc:268 (max_negative mining, static masks).
+
+    cls_loss [B,P], match_indices [B,P] (-1 = unmatched), match_dist
+    [B,P]. Negative candidates are unmatched priors with dist <
+    neg_dist_threshold; keep top (neg_pos_ratio * num_pos) (or
+    sample_size for hard_example mining) by loss. Returns
+    (neg_mask [B,P] bool, updated_match_indices [B,P]) where non-selected
+    negatives stay -1 and positives keep their match."""
+    jnp = _jnp()
+    cl = jnp.asarray(cls_loss)
+    if loc_loss is not None and mining_type == "hard_example":
+        cl = cl + jnp.asarray(loc_loss)
+    mi = jnp.asarray(match_indices)
+    md = jnp.asarray(match_dist)
+    B, P = cl.shape
+    pos = mi >= 0
+    neg_cand = (~pos) & (md < neg_dist_threshold)
+    if mining_type == "hard_example" and sample_size > 0:
+        quota = jnp.full((B,), int(sample_size), jnp.int32)
+    else:
+        quota = jnp.ceil(
+            pos.sum(axis=1).astype(jnp.float32) * neg_pos_ratio
+        ).astype(jnp.int32)
+    quota = jnp.minimum(quota, neg_cand.sum(axis=1).astype(jnp.int32))
+    loss_k = jnp.where(neg_cand, cl, -jnp.inf)
+    order = jnp.argsort(-loss_k, axis=1)
+    rank = jnp.zeros((B, P), jnp.int32)
+    rank = rank.at[jnp.arange(B)[:, None], order].set(
+        jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P)))
+    neg_mask = neg_cand & (rank < quota[:, None])
+    return neg_mask, jnp.where(pos, mi, -1)
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction"):
+    """SSD multibox loss, fully fused (reference
+    python/paddle/fluid/layers/detection.py ssd_loss composition over
+    target_assign/mine_hard_examples; one jittable op here).
+
+    location [B,P,4] predicted deltas, confidence [B,P,C] logits,
+    gt_box [B,G,4] zero-padded, gt_label [B,G] int (rows beyond the real
+    gt count must be zero-area boxes), prior_box [P,4]. Differentiable
+    wrt location/confidence; matching is stop-gradient.
+    """
+    import jax
+
+    from .detection import bipartite_match
+
+    jnp = _jnp()
+    B, P, C = confidence.shape
+    loc = location
+    conf = confidence
+    gt_box = jax.lax.stop_gradient(jnp.asarray(gt_box))
+    prior = jnp.asarray(prior_box)
+    gvalid = ((gt_box[..., 2] > gt_box[..., 0])
+              & (gt_box[..., 3] > gt_box[..., 1]))  # [B,G]
+
+    if prior_box_var is None:
+        var = jnp.ones((4,), jnp.float32)
+    else:
+        # a 4-vector broadcasts to all priors; a [P,4] tensor stays
+        # per-prior (the reference PriorBoxVar input form)
+        var = jnp.asarray(prior_box_var, jnp.float32).reshape(-1, 4)
+        if var.shape[0] == 1:
+            var = var[0]
+
+    def one(loc_b, conf_b, gtb, gtl, gv):
+        iou = iou_matrix(gtb, prior, normalized=True)       # [G,P]
+        iou = jnp.where(gv[:, None], iou, -1.0)
+        match, mdist = bipartite_match(iou)
+        if match_type == "per_prediction":
+            best = iou.max(axis=0)
+            arg = iou.argmax(axis=0)
+            extra = (match < 0) & (best >= overlap_threshold)
+            match = jnp.where(extra, arg.astype(jnp.int32), match)
+            mdist = jnp.where(extra, best, mdist)
+        pos = match >= 0
+        sel = jnp.clip(match, 0, gtb.shape[0] - 1)
+        tgt = _encode_rowwise(gtb[sel], prior, weights=var)
+        lbl = jnp.where(pos, jnp.asarray(gtl, jnp.int32)[sel],
+                        background_label)
+        lp = jax.nn.log_softmax(conf_b.astype(jnp.float32), -1)
+        conf_loss = -jnp.take_along_axis(lp, lbl[:, None], 1)[:, 0]
+        # hard negative mining on the conf loss
+        neg_cand = (~pos) & (iou.max(axis=0) < neg_overlap)
+        quota = jnp.minimum(
+            jnp.ceil(pos.sum() * neg_pos_ratio).astype(jnp.int32),
+            neg_cand.sum().astype(jnp.int32))
+        lk = jnp.where(neg_cand, jax.lax.stop_gradient(conf_loss),
+                       -jnp.inf)
+        order = jnp.argsort(-lk)
+        rank = jnp.zeros((P,), jnp.int32).at[order].set(
+            jnp.arange(P, dtype=jnp.int32))
+        neg = neg_cand & (rank < quota)
+        diff = (loc_b - tgt).astype(jnp.float32)
+        ad = jnp.abs(diff)
+        sl1 = jnp.where(ad < 1.0, 0.5 * diff * diff, ad - 0.5).sum(-1)
+        denom = jnp.maximum(pos.sum().astype(jnp.float32), 1.0)
+        return (loc_loss_weight * (sl1 * pos).sum()
+                + conf_loss_weight * (conf_loss * (pos | neg)).sum()
+                ) / denom
+
+    losses = jax.vmap(one)(loc, conf, gt_box,
+                           jnp.asarray(gt_label), gvalid)
+    return losses.mean().reshape((1,)).astype(location.dtype)
+
+
+def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True):
+    """matrix_nms_op.cc:87: parallel soft suppression — no sequential
+    greedy loop, the decay of box j is min_i f(iou_ij)/f(iou_max_i) over
+    higher-scored same-class boxes i. O(k^2) matrix math, MXU/VPU
+    friendly, zero lax.fori_loop. bboxes [N,4], scores [C,N].
+    Returns (out [keep_top_k,6] rows [label,score,x1,y1,x2,y2] padded
+    -1, index [keep_top_k] into N, num_valid)."""
+    jnp = _jnp()
+    C, N = scores.shape
+    k = min(int(nms_top_k), N)
+    rows = []
+    idxs = []
+    for c in range(C):
+        if c == background_label:
+            continue
+        sc = scores[c]
+        ok = sc > score_threshold
+        sck = jnp.where(ok, sc, -jnp.inf)
+        top = jnp.argsort(-sck)[:k]
+        svalid = jnp.isfinite(sck[top])
+        b = bboxes[top]
+        iou = iou_matrix(b, b, normalized)
+        upper = jnp.tril(jnp.ones((k, k), bool), -1).T  # i < j pairs
+        iou_u = jnp.where(upper & svalid[:, None] & svalid[None, :],
+                          iou, 0.0)
+        # compensate_iou[i]: how much suppressor i is itself suppressed
+        # by anything scored above it (SOLOv2 matrix-NMS: decay of j is
+        # min_i f(iou_ij)/f(compensate_i) over higher-scored i)
+        comp = iou_u.max(axis=0)[:, None]
+        if use_gaussian:
+            decay = jnp.exp(-(iou_u ** 2 - comp ** 2) / gaussian_sigma)
+        else:
+            decay = (1.0 - iou_u) / jnp.maximum(1.0 - comp, 1e-10)
+        decay = jnp.where(upper, decay, jnp.inf).min(axis=0)
+        decay = jnp.where(jnp.isinf(decay), 1.0, decay)
+        newsc = jnp.where(svalid, sc[top] * decay, -1.0)
+        if post_threshold > 0:
+            newsc = jnp.where(newsc >= post_threshold, newsc, -1.0)
+        rows.append(jnp.concatenate([
+            jnp.full((k, 1), c, jnp.float32),
+            newsc[:, None].astype(jnp.float32),
+            b.astype(jnp.float32)], axis=1))
+        idxs.append(top.astype(jnp.int32))
+    if not rows:
+        return (jnp.full((keep_top_k, 6), -1.0, jnp.float32),
+                jnp.full((keep_top_k,), -1, jnp.int32),
+                jnp.zeros((), jnp.int32))
+    allrows = jnp.concatenate(rows, axis=0)
+    allidx = jnp.concatenate(idxs, axis=0)
+    keyv = jnp.where(allrows[:, 1] > 0, allrows[:, 1], -jnp.inf)
+    top = jnp.argsort(-keyv)[:int(keep_top_k)]
+    ok = jnp.isfinite(keyv[top])
+    out = jnp.where(ok[:, None], allrows[top], -1.0)
+    pad = int(keep_top_k) - out.shape[0]
+    if pad > 0:
+        out = jnp.concatenate(
+            [out, jnp.full((pad, 6), -1.0, jnp.float32)], axis=0)
+        ok = jnp.concatenate([ok, jnp.zeros((pad,), bool)], axis=0)
+    idx = jnp.where(ok, allidx[jnp.clip(top, 0, allidx.shape[0] - 1)], -1)
+    if pad > 0:
+        idx = idx[:int(keep_top_k)]
+    return out, idx, ok.sum().astype(jnp.int32)

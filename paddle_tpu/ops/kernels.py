@@ -203,13 +203,61 @@ def _conv_padding(padding, k, stride, dilation, size=2):
     raise ValueError(f"bad padding {padding!r}")
 
 
+def _conv2d_im2col(x, w, stride, pad, dilation):
+    """Stem-conv path: k*k static slices -> one einsum on the MXU.
+
+    For tiny input-channel convs (MNIST/CIFAR/ImageNet stems, C_in<=4)
+    XLA's conv weight-gradient lowering is pathologically slow to compile
+    on some TPU toolchains (minutes for a 1x28x28 5x5 conv); an im2col
+    matmul is equivalent math, compiles instantly, and — being built from
+    pad/slice/einsum — differentiates cleanly at every order (double grad
+    included, which a custom_vjp workaround would forfeit)."""
+    import jax.lax as lax
+
+    jnp = _jnp()
+    B, C, H, W = x.shape
+    O, _, KH, KW = w.shape
+    sh, sw = stride
+    dh, dw = dilation
+    xp = jnp.pad(x, ((0, 0), (0, 0), tuple(pad[0]), tuple(pad[1])))
+    OH = (xp.shape[2] - ((KH - 1) * dh + 1)) // sh + 1
+    OW = (xp.shape[3] - ((KW - 1) * dw + 1)) // sw + 1
+    cols = []
+    for i in range(KH):
+        for j in range(KW):
+            cols.append(lax.slice(
+                xp, (0, 0, i * dh, j * dw),
+                (B, C, i * dh + (OH - 1) * sh + 1,
+                 j * dw + (OW - 1) * sw + 1),
+                (1, 1, sh, sw)))
+    cols = jnp.stack(cols, axis=2)  # [B, C, KH*KW, OH, OW]
+    return jnp.einsum("bcthw,oct->bohw", cols,
+                      w.reshape(O, C, KH * KW))
+
+
 def conv2d(x, w, stride=1, padding=0, dilation=1, groups=1):
-    """NCHW conv. The MXU eats this: lax.conv_general_dilated → XLA conv."""
+    """NCHW conv. The MXU eats this: lax.conv_general_dilated → XLA conv.
+    Tiny-C_in stems take the im2col route (see _conv2d_im2col)."""
     import jax.lax as lax
 
     stride = _pair(stride)
     dilation = _pair(dilation)
     pad = _conv_padding(padding, None, stride, dilation)
+    if (groups == 1 and x.ndim == 4 and x.shape[1] <= 4
+            and w.shape[2] * w.shape[3] > 1
+            and x.shape[2] * x.shape[3] <= 128 * 128):
+        if isinstance(pad, str):
+            kh = (w.shape[2] - 1) * dilation[0] + 1
+            kw = (w.shape[3] - 1) * dilation[1] + 1
+            if pad == "VALID":
+                pad = [(0, 0), (0, 0)]
+            else:  # SAME: out = ceil(in/stride)
+                ph = max(0, (-(-x.shape[2] // stride[0]) - 1) * stride[0]
+                         + kh - x.shape[2])
+                pw = max(0, (-(-x.shape[3] // stride[1]) - 1) * stride[1]
+                         + kw - x.shape[3])
+                pad = [(ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2)]
+        return _conv2d_im2col(x, w, stride, pad, dilation)
     return lax.conv_general_dilated(
         x, w,
         window_strides=stride,
@@ -338,23 +386,115 @@ def adaptive_max_pool2d(x, output_size):
 # group_norm_op.cc, instance_norm_op.cc)
 # =====================================================================
 
+def _bn_moments(x, axes, acc):
+    """Per-channel mean/var in fp32. Half-width inputs (the AMP hot
+    path) use the fused single pass E[x^2]-E[x]^2 — one HBM read, and
+    bf16's ~8-bit mantissa already bounds the expressible spread so the
+    cancellation risk is moot. Full-precision inputs keep the two-pass
+    (x-mean)^2 form: E[x^2]-E[x]^2 in fp32 catastrophically cancels for
+    distributions like mean~1e2, std~1e-1."""
+    jnp = _jnp()
+    n = 1
+    for i in axes:
+        n *= x.shape[i]
+    xf = x.astype(acc)
+    mean = jnp.sum(xf, axis=axes) / n
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        var = jnp.maximum(
+            jnp.sum(xf * xf, axis=axes) / n - mean * mean, 0.0)
+    else:
+        shape = [1] * x.ndim
+        for i in range(x.ndim):
+            if i not in axes:
+                shape[i] = -1
+        d = xf - mean.reshape(shape)
+        var = jnp.sum(d * d, axis=axes) / n
+    return mean, var, n
+
+
+def _bn_norm_fwd_impl(x, gamma, beta, epsilon, c_axis):
+    jnp = _jnp()
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    mean, var, _ = _bn_moments(x, axes, acc)
+    inv = 1.0 / jnp.sqrt(var + epsilon)
+    scale = gamma.astype(acc) * inv
+    shift = beta.astype(acc) - mean * scale
+    # elementwise normalize stays in x's dtype (bf16 under AMP): per-channel
+    # scale/shift are precomputed fp32 then cast, so the big tensor is read
+    # and written exactly once in its native width
+    y = x * _bshape(scale.astype(x.dtype), x.ndim, c_axis) + _bshape(
+        shift.astype(x.dtype), x.ndim, c_axis)
+    return y, (mean, var, inv)
+
+
+def _make_bn_norm(epsilon, c_axis):
+    import jax
+
+    @jax.custom_vjp
+    def bn_norm(x, gamma, beta):
+        return _bn_norm_fwd_impl(x, gamma, beta, epsilon, c_axis)[0]
+
+    def fwd(x, gamma, beta):
+        y, (mean, var, inv) = _bn_norm_fwd_impl(x, gamma, beta, epsilon,
+                                                c_axis)
+        return y, (x, gamma, mean, inv)
+
+    def bwd(res, dy):
+        # analytic BN backward (reference operators/batch_norm_op.h
+        # BatchNormGradKernel): the big-tensor arithmetic runs in dy's own
+        # dtype; only the two per-channel reductions accumulate in fp32
+        jnp = _jnp()
+        x, gamma, mean, inv = res
+        axes = tuple(i for i in range(x.ndim) if i != c_axis)
+        acc = jnp.promote_types(x.dtype, jnp.float32)
+        n = 1
+        for i in axes:
+            n *= x.shape[i]
+        mean_b = _bshape(mean.astype(x.dtype), x.ndim, c_axis)
+        inv_b = _bshape(inv.astype(x.dtype), x.ndim, c_axis)
+        xhat = (x - mean_b) * inv_b
+        sum_dy = jnp.sum(dy.astype(acc), axis=axes)
+        sum_dy_xhat = jnp.sum((dy * xhat).astype(acc), axis=axes)
+        dgamma = sum_dy_xhat.astype(gamma.dtype)
+        dbeta = sum_dy.astype(gamma.dtype)
+        coef = (gamma.astype(acc) * inv)
+        dx = _bshape(coef.astype(dy.dtype), x.ndim, c_axis) * (
+            dy - _bshape((sum_dy / n).astype(dy.dtype), x.ndim, c_axis)
+            - xhat * _bshape((sum_dy_xhat / n).astype(dy.dtype),
+                             x.ndim, c_axis))
+        return dx.astype(x.dtype), dgamma, dbeta
+
+    bn_norm.defvjp(fwd, bwd)
+    return bn_norm
+
+
+_BN_NORM_CACHE = {}
+
+
 def batch_norm_train(x, gamma, beta, running_mean, running_var, momentum,
                      epsilon, data_format="NCHW"):
-    """Returns (y, new_mean, new_var, batch_mean, batch_var)."""
+    """Returns (y, new_mean, new_var, batch_mean, batch_var).
+
+    Stats accumulate in fp32 (the reference AMP keeps batch_norm fp32,
+    operators/batch_norm_op.cc); the activation math — forward normalize
+    and the custom analytic backward — runs in x's dtype so bf16 training
+    never pays fp32 HBM traffic on the feature map. batch_mean/batch_var
+    feed the running-stat buffers only and carry no gradient.
+    """
+    import jax
+
     jnp = _jnp()
-    axes = tuple(i for i in range(x.ndim)
-                 if i != (1 if data_format == "NCHW" else x.ndim - 1))
     c_axis = 1 if data_format == "NCHW" else x.ndim - 1
-    # statistics in at-least-f32 regardless of a bf16 compute dtype (the
-    # reference AMP keeps batch_norm in fp32); y returns in x's dtype
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
     acc = jnp.promote_types(x.dtype, jnp.float32)
-    xf = x.astype(acc)
-    mean = xf.mean(axis=axes)
-    var = ((xf - _bshape(mean, x.ndim, c_axis)) ** 2).mean(axis=axes)
-    inv = 1.0 / jnp.sqrt(var + epsilon)
-    y = (xf - _bshape(mean, x.ndim, c_axis)) * _bshape(
-        inv * gamma.astype(acc), x.ndim, c_axis)
-    y = (y + _bshape(beta.astype(acc), x.ndim, c_axis)).astype(x.dtype)
+    key = (float(epsilon), c_axis)
+    bn = _BN_NORM_CACHE.get(key)
+    if bn is None:
+        bn = _BN_NORM_CACHE[key] = _make_bn_norm(float(epsilon), c_axis)
+    y = bn(x, gamma, beta)
+    # same reductions as inside bn's forward — XLA CSE merges them
+    mean, var, _ = _bn_moments(jax.lax.stop_gradient(x), axes, acc)
     new_mean = momentum * running_mean + (1.0 - momentum) * mean
     new_var = momentum * running_var + (1.0 - momentum) * var
     return y, new_mean, new_var, mean, var
@@ -364,9 +504,15 @@ def batch_norm_infer(x, gamma, beta, running_mean, running_var, epsilon,
                      data_format="NCHW"):
     jnp = _jnp()
     c_axis = 1 if data_format == "NCHW" else x.ndim - 1
-    inv = 1.0 / jnp.sqrt(running_var + epsilon)
-    y = (x - _bshape(running_mean, x.ndim, c_axis)) * _bshape(
-        inv * gamma, x.ndim, c_axis) + _bshape(beta, x.ndim, c_axis)
+    # precompute per-channel fp32 scale/shift; broadcast in x's dtype so a
+    # bf16 feature map is never promoted (fp32 running stats would otherwise
+    # upcast the whole tensor)
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    inv = 1.0 / jnp.sqrt(running_var.astype(acc) + epsilon)
+    scale = gamma.astype(acc) * inv
+    shift = beta.astype(acc) - running_mean.astype(acc) * scale
+    y = x * _bshape(scale.astype(x.dtype), x.ndim, c_axis) + _bshape(
+        shift.astype(x.dtype), x.ndim, c_axis)
     return y
 
 

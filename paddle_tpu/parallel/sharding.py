@@ -95,13 +95,16 @@ def named_sharding(spec, mesh: Optional[DeviceMesh] = None):
     return jax.sharding.NamedSharding(m, spec)
 
 
-def batch_sharding(mesh: Optional[DeviceMesh] = None, axes=("dp",)):
+def batch_sharding(mesh: Optional[DeviceMesh] = None, axes=("dp",),
+                   leading=0):
     """Sharding for a batch input: leading dim over dp (and ep when the mesh
-    carries one, since ep rides the data axis between MoE layers)."""
+    carries one, since ep rides the data axis between MoE layers).
+    `leading` extra unsharded dims prefix the spec (e.g. a stacked chunk of
+    batches scanned on-device)."""
     from jax.sharding import PartitionSpec as P
 
     m = mesh or get_mesh()
     first = tuple(a for a in axes if m.axis_size(a) > 1) or None
     if first and len(first) == 1:
         first = first[0]
-    return named_sharding(P(first), m)
+    return named_sharding(P(*([None] * leading + [first])), m)

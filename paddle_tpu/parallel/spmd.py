@@ -253,6 +253,79 @@ class SpmdTrainer:
             self.params, self.opt_state, self.buffers, rng, inputs, labels)
         return loss
 
+    def run_epoch(self, batches, rng=None, chunk=8):
+        """Drive many (inputs_tuple, labels) batches through the compiled
+        step with device-resident double-buffered input: batches are
+        stacked `chunk` at a time, each stack's H2D transfer is issued
+        asynchronously while the previous stack's jitted lax.scan runs
+        (reference operators/reader/buffered_reader.cc role). Returns the
+        last loss. TPU-native shape: one dispatch per chunk, transfers
+        overlapped by XLA's async device_put."""
+        import jax
+        import numpy as np
+
+        if rng is None:
+            from ..core import random as _random
+
+            rng = _random.next_key()
+
+        key = f"_epoch_{chunk}"
+        loop = self.__dict__.get(key)
+        if loop is None:
+            if self._step_fn is None:
+                self._build_step()
+            raw_step = self._raw_step
+
+            def run(params, opt_state, buffers, rng, stack):
+                def body(carry, xs):
+                    params, opt_state, buffers, rng = carry
+                    rng, sub = jax.random.split(rng)
+                    params, opt_state, buffers, loss = raw_step(
+                        params, opt_state, buffers, sub, xs[:-1], xs[-1])
+                    return (params, opt_state, buffers, rng), loss
+
+                (params, opt_state, buffers, rng), losses = jax.lax.scan(
+                    body, (params, opt_state, buffers, rng), stack)
+                return params, opt_state, buffers, rng, losses[-1]
+
+            with self.mesh.mesh:
+                loop = jax.jit(run, donate_argnums=(0, 1, 2))
+            self.__dict__[key] = loop
+
+        tail = []
+
+        def stacks():
+            buf = []
+            for inputs, labels in batches:
+                inputs = tuple(inputs) if isinstance(inputs, (list, tuple)) \
+                    else (inputs,)
+                buf.append(tuple(np.asarray(x) for x in inputs)
+                           + (np.asarray(labels),))
+                if len(buf) == chunk:
+                    yield tuple(np.stack([b[i] for b in buf])
+                                for i in range(len(buf[0])))
+                    buf = []
+            tail.extend(buf)  # leftover < chunk: run via single steps
+
+        from ..io import DevicePrefetcher
+        from .sharding import batch_sharding
+
+        sh = batch_sharding(self.mesh, self.batch_axes, leading=1)
+        loss = None
+        pf = DevicePrefetcher(stacks(), sharding=sh, depth=2)
+        try:
+            for stack in pf:
+                self.params, self.opt_state, self.buffers, rng, loss = \
+                    loop(self.params, self.opt_state, self.buffers, rng,
+                         stack)
+        finally:
+            pf.close()
+        # tail batches below `chunk` go through the already-compiled
+        # single-step path (a per-tail-size scan would compile anew)
+        for b in tail:
+            loss = self.step(b[:-1], b[-1])
+        return loss
+
     def eval_step(self, inputs):
         import jax
 

@@ -106,6 +106,42 @@ class TestSpmdTrainer:
             last = float(tr.step((x,), y))
         assert last < first * 0.5
 
+    def test_run_epoch_device_prefetch(self):
+        # run_epoch: stacked-chunk scan + DevicePrefetcher double buffer
+        # must train the same way plain step() does
+        init_mesh(dp=8)
+        net = make_mlp()
+        tr = SpmdTrainer(net, ce_loss, fopt.adam(1e-2))
+        rs = np.random.RandomState(0)
+        x = rs.randn(32, 8).astype("float32")
+        y = (x.sum(1) > 0).astype("int64")
+        first = float(tr.step((x,), y))
+
+        def batches():
+            for _ in range(16):
+                yield (x,), y
+
+        last = float(tr.run_epoch(batches(), chunk=4))
+        assert last < first * 0.7
+
+    def test_device_prefetcher_plain_iter(self):
+        from paddle_tpu.io import DevicePrefetcher
+
+        src = [{"a": np.ones((2, 2)) * i} for i in range(5)]
+        out = list(DevicePrefetcher(iter(src), depth=2))
+        assert len(out) == 5
+        np.testing.assert_allclose(np.asarray(out[3]["a"]), 3.0)
+
+    def test_device_prefetcher_propagates_error(self):
+        from paddle_tpu.io import DevicePrefetcher
+
+        def bad():
+            yield np.ones(3)
+            raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError, match="boom"):
+            list(DevicePrefetcher(bad()))
+
     def test_dp_matches_single_device(self):
         # same data, same init => same loss trajectory on dp=1 vs dp=8
         x = np.random.randn(16, 8).astype("float32")
