@@ -29,7 +29,9 @@ struct NTensor {
   std::vector<int64_t> dims;
   std::vector<float> f;    // float32 storage
   std::vector<int64_t> i;  // int64 storage
+  std::vector<int8_t> q;   // int8 storage (slim PTQ/QAT weights)
   bool is_int = false;
+  bool is_q = false;
 
   int64_t numel() const {
     int64_t n = 1;
@@ -94,6 +96,13 @@ struct ExecCtx {
     std::vector<int64_t> out;
     if (a && a->value_case() == ptframework::Attr::kInts)
       for (auto v : a->ints().val()) out.push_back(v);
+    return out;
+  }
+  std::vector<double> AttrFloats(const std::string& n) {
+    auto* a = FindAttr(n);
+    std::vector<double> out;
+    if (a && a->value_case() == ptframework::Attr::kFloats)
+      for (auto v : a->floats().val()) out.push_back(v);
     return out;
   }
 };
@@ -403,6 +412,128 @@ static RegK r_pool2d("pool2d", [](ExecCtx& c) {
   return true;
 });
 
+// ---- int8 quantized kernels (slim PTQ/QAT artifacts; the reference
+// serves these via mkldnn INT8, api/mkldnn_quantizer.cc role). Weights
+// arrive int8 (NTensor.q); activations quantize on the fly with the
+// calibrated in_scale; accumulation is int32; dequant = in_scale *
+// per-channel weight_scale. Matches fluid/lowering.py _quantized_mul.
+
+static inline int8_t QuantAct(float v, float s_in) {
+  float r = v / s_in;
+  r = r > 127.f ? 127.f : (r < -127.f ? -127.f : r);
+  return (int8_t)lrintf(r);
+}
+
+static bool QuantizedGemm(ExecCtx& c, bool is_mul) {
+  NTensor* x = c.In("X");
+  NTensor* y = c.In("Y");
+  NTensor* o = c.Out("Out");
+  if (!x || !y || !o) return false;
+  if (!y->is_q) { c.error = "quantized op: weight is not int8"; return false; }
+  float s_in = (float)c.AttrF("in_scale", 1.0f / 127.0f);
+  auto scales = c.AttrFloats("weight_scales");
+  int64_t M = 1, K = 1, N;
+  bool ty = false;
+  if (is_mul) {
+    int64_t xcols = c.AttrI("x_num_col_dims", 1);
+    for (int64_t k = 0; k < (int64_t)x->dims.size(); ++k)
+      (k < xcols ? M : K) *= x->dims[k];
+    N = y->numel() / y->dims[0];
+    o->dims.assign(x->dims.begin(), x->dims.begin() + xcols);
+    o->dims.push_back(N);
+  } else {
+    ty = c.AttrB("transpose_Y", false);
+    if (x->dims.size() != 2 || y->dims.size() != 2) {
+      c.error = "quantized_matmul: only 2D in native predictor";
+      return false;
+    }
+    M = x->dims[0];
+    K = x->dims[1];
+    N = ty ? y->dims[0] : y->dims[1];
+    o->dims = {M, N};
+  }
+  std::vector<int8_t> xq(M * K);
+  for (int64_t idx = 0; idx < M * K; ++idx)
+    xq[idx] = QuantAct(x->f[idx], s_in);
+  o->f.assign(M * N, 0.0f);
+  o->is_int = false; o->is_q = false;
+  for (int64_t m = 0; m < M; ++m)
+    for (int64_t n = 0; n < N; ++n) {
+      int32_t acc = 0;
+      for (int64_t k = 0; k < K; ++k) {
+        int8_t wv = ty ? y->q[n * K + k] : y->q[k * N + n];
+        acc += (int32_t)xq[m * K + k] * (int32_t)wv;
+      }
+      float sw = scales.size() == (size_t)N ? (float)scales[n]
+                 : (scales.empty() ? 1.f : (float)scales[0]);
+      o->f[m * N + n] = (float)acc * s_in * sw;
+    }
+  return true;
+}
+
+static RegK r_qmul("quantized_mul", [](ExecCtx& c) {
+  return QuantizedGemm(c, true);
+});
+static RegK r_qmatmul("quantized_matmul", [](ExecCtx& c) {
+  return QuantizedGemm(c, false);
+});
+static RegK r_qmatmul2("quantized_matmul_v2", [](ExecCtx& c) {
+  return QuantizedGemm(c, false);
+});
+
+static RegK r_qconv2d("quantized_conv2d", [](ExecCtx& c) {
+  NTensor* x = c.In("Input");
+  NTensor* w = c.In("Filter");
+  NTensor* o = c.Out("Output");
+  if (!x || !w || !o) return false;
+  if (!w->is_q) { c.error = "quantized_conv2d: weight not int8"; return false; }
+  float s_in = (float)c.AttrF("in_scale", 1.0f / 127.0f);
+  auto scales = c.AttrFloats("weight_scales");
+  auto strides = c.AttrInts("strides");
+  auto pads = c.AttrInts("paddings");
+  auto dil = c.AttrInts("dilations");
+  int64_t g = c.AttrI("groups", 1);
+  if (strides.empty()) strides = {1, 1};
+  if (pads.empty()) pads = {0, 0};
+  if (dil.empty()) dil = {1, 1};
+  int64_t N = x->dims[0], C = x->dims[1], H = x->dims[2], W = x->dims[3];
+  int64_t OC = w->dims[0], KC = w->dims[1], KH = w->dims[2], KW = w->dims[3];
+  int64_t OH = (H + 2 * pads[0] - dil[0] * (KH - 1) - 1) / strides[0] + 1;
+  int64_t OW = (W + 2 * pads[1] - dil[1] * (KW - 1) - 1) / strides[1] + 1;
+  o->dims = {N, OC, OH, OW};
+  o->f.assign(N * OC * OH * OW, 0.0f);
+  std::vector<int8_t> xq(x->numel());
+  for (int64_t idx = 0; idx < x->numel(); ++idx)
+    xq[idx] = QuantAct(x->f[idx], s_in);
+  int64_t cpg = C / g, opg = OC / g;
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t oc = 0; oc < OC; ++oc) {
+      int64_t grp = oc / opg;
+      float sw = scales.size() == (size_t)OC ? (float)scales[oc]
+                 : (scales.empty() ? 1.f : (float)scales[0]);
+      for (int64_t oh = 0; oh < OH; ++oh)
+        for (int64_t ow = 0; ow < OW; ++ow) {
+          int32_t acc = 0;
+          for (int64_t ic = 0; ic < cpg; ++ic) {
+            int64_t cin = grp * cpg + ic;
+            for (int64_t kh = 0; kh < KH; ++kh) {
+              int64_t ih = oh * strides[0] - pads[0] + kh * dil[0];
+              if (ih < 0 || ih >= H) continue;
+              for (int64_t kw = 0; kw < KW; ++kw) {
+                int64_t iw = ow * strides[1] - pads[1] + kw * dil[1];
+                if (iw < 0 || iw >= W) continue;
+                acc += (int32_t)xq[((n * C + cin) * H + ih) * W + iw] *
+                       (int32_t)w->q[((oc * KC + ic) * KH + kh) * KW + kw];
+              }
+            }
+          }
+          o->f[((n * OC + oc) * OH + oh) * OW + ow] =
+              (float)acc * s_in * sw;
+        }
+    }
+  return true;
+});
+
 static RegK r_bn("batch_norm", [](ExecCtx& c) {
   NTensor* x = c.In("X");
   NTensor* scale = c.In("Scale");
@@ -535,10 +666,52 @@ class NativePredictor {
             nt.i.resize(nb / 8);
             memcpy(nt.i.data(), src, nb);
             break;
-          case 5: case 8: case 9: {  // bool/uint8/int8 → i64
+          case 5: case 8: {  // bool/uint8 → i64
             nt.is_int = true;
             nt.i.resize(nb);
             for (size_t k = 0; k < nb; ++k) nt.i[k] = (int64_t)(int8_t)src[k];
+            break;
+          }
+          case 9: {  // int8: kept quantized for the quantized_* kernels
+            nt.is_q = true;
+            nt.q.resize(nb);
+            memcpy(nt.q.data(), src, nb);
+            break;
+          }
+          case 6: {  // uint16 carries bf16 bit patterns → f32
+            nt.f.resize(nb / 2);
+            const uint16_t* d = (const uint16_t*)src;
+            for (size_t k = 0; k < nt.f.size(); ++k) {
+              uint32_t bits = ((uint32_t)d[k]) << 16;
+              memcpy(&nt.f[k], &bits, 4);
+            }
+            break;
+          }
+          case 7: {  // float16 → f32
+            nt.f.resize(nb / 2);
+            const uint16_t* d = (const uint16_t*)src;
+            for (size_t k = 0; k < nt.f.size(); ++k) {
+              uint16_t h = d[k];
+              uint32_t sign = (uint32_t)(h & 0x8000) << 16;
+              uint32_t expo = (h >> 10) & 0x1f;
+              uint32_t mant = h & 0x3ff;
+              uint32_t bits;
+              if (expo == 0) {
+                if (mant == 0) {
+                  bits = sign;
+                } else {  // subnormal: normalize
+                  int e = -1;
+                  do { mant <<= 1; ++e; } while (!(mant & 0x400));
+                  bits = sign | ((uint32_t)(127 - 15 - e) << 23)
+                       | ((mant & 0x3ff) << 13);
+                }
+              } else if (expo == 31) {
+                bits = sign | 0x7f800000u | (mant << 13);
+              } else {
+                bits = sign | ((expo - 15 + 127) << 23) | (mant << 13);
+              }
+              memcpy(&nt.f[k], &bits, 4);
+            }
             break;
           }
           default:

@@ -48,10 +48,11 @@ class Node:
     """One recorded differentiable op on the tape."""
 
     __slots__ = ("vjp_fn", "inputs", "n_outputs", "out_grads", "out_avals",
-                 "op_name", "fwd_fn", "fwd_raws", "__weakref__")
+                 "op_name", "fwd_fn", "fwd_raws", "fwd_cast",
+                 "__weakref__")
 
     def __init__(self, vjp_fn, inputs, n_outputs, op_name="", out_avals=None,
-                 fwd_fn=None, fwd_raws=None):
+                 fwd_fn=None, fwd_raws=None, fwd_cast=None):
         self.vjp_fn = vjp_fn          # cotangents(tuple) -> input cotangents
         self.inputs = inputs          # list[(Tensor, in_needs_grad)]
         self.n_outputs = n_outputs
@@ -59,7 +60,11 @@ class Node:
         self.out_avals = out_avals    # [(shape, dtype)] per output
         self.op_name = op_name
         self.fwd_fn = fwd_fn          # original kernel (double-grad rebuild)
-        self.fwd_raws = fwd_raws      # AMP-cast input arrays at forward
+        # PRE-cast forward input arrays (refs to live params/acts — no
+        # extra memory) + per-input AMP cast dtype (None = used as-is);
+        # the cast copy is re-materialised only if double grad runs
+        self.fwd_raws = fwd_raws
+        self.fwd_cast = fwd_cast
 
     def zero_ct(self, i):
         import jax.numpy as jnp
@@ -234,7 +239,10 @@ def _tape_vjp(node, cts):
     # be the ORIGINAL tensors (leaf identity / upstream edges), but the
     # vjp primals must be the SNAPSHOTTED forward raws (already AMP-cast;
     # live tensors may have been mutated in place since forward)
-    raws = list(node.fwd_raws) + [c._data for c in ct_tensors]
+    cast = node.fwd_cast or (None,) * len(node.fwd_raws)
+    raws = [r if d is None else r.astype(d)
+            for r, d in zip(node.fwd_raws, cast)] + \
+        [c._data for c in ct_tensors]
     out, vjp_fn = jax.vjp(h, *raws)
     outs = (out,) if n_in == 1 else tuple(out)
     in_list = [(t, n) for t, n in node.inputs] + \

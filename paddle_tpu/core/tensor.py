@@ -409,10 +409,10 @@ def _unwrap_index(idx):
 def _apply(op_name, fn, *tensors, n_outputs=1):
     import jax
 
-    raws = [t._data for t in tensors]
+    pre_raws = [t._data for t in tensors]
     from .. import amp as _amp
 
-    raws = _amp.cast_inputs_if_amp(op_name, raws)
+    raws = _amp.cast_inputs_if_amp(op_name, pre_raws)
     needs = [not t._stop_gradient for t in tensors]
     trace = autograd.is_grad_enabled() and any(needs)
 
@@ -432,7 +432,13 @@ def _apply(op_name, fn, *tensors, n_outputs=1):
         op_name=op_name,
         out_avals=out_avals,
         fwd_fn=fn,  # kept so create_graph can rebuild the vjp on-tape
-        fwd_raws=tuple(raws),  # forward-time (AMP-cast) input snapshot
+        # snapshot the PRE-cast arrays (refs, no copy) + the cast dtype;
+        # double grad re-casts on demand instead of pinning bf16 copies
+        # of every AMP input for the whole tape lifetime
+        fwd_raws=tuple(pre_raws),
+        fwd_cast=tuple(
+            (r.dtype if r is not p else None)
+            for r, p in zip(raws, pre_raws)),
     )
     wrapped = []
     for i, o in enumerate(outs):

@@ -69,13 +69,56 @@ class PsServer:
 
 
 class PsClient:
-    """Native RPC client for one pserver endpoint."""
+    """Native RPC client for one pserver endpoint.
+
+    Thread-safe: one framed-RPC socket underlies the handle, so every
+    RPC runs under a lock — the async Communicator's send and recv
+    threads (and the dataset engine's Downpour plane) share one client,
+    and interleaved frames corrupt the protocol ("send failed" rc=-1).
+    """
+
+    _RPC_METHODS = ("init_dense", "push_dense", "pull_dense",
+                    "push_sparse", "pull_dense_if_newer", "pull_sparse",
+                    "barrier", "heartbeat", "shutdown_server")
 
     def __init__(self, host="127.0.0.1", port=0):
+        import functools
+        import threading
+
         self._lib = load_library(required=True)
+        self._host, self._port = host, port
         self._h = self._lib.pt_ps_connect(host.encode(), port)
         if not self._h:
             raise ConnectionError(f"cannot connect to pserver {host}:{port}")
+        self._mu = threading.Lock()
+        for name in self._RPC_METHODS:
+            fn = getattr(self, name)
+
+            def locked(*a, _fn=fn, **k):
+                with self._mu:
+                    try:
+                        return _fn(*a, **k)
+                    except RuntimeError as e:
+                        # transient transport failure: reconnect once and
+                        # retry (AsyncCommunicator resilience — a dead
+                        # socket must not silently kill the send thread)
+                        if "send" not in str(e) and "recv" not in str(e):
+                            raise
+                        self._reconnect()
+                        return _fn(*a, **k)
+
+            setattr(self, name, functools.wraps(fn)(locked))
+
+    def _reconnect(self):
+        if self._h:
+            try:
+                self._lib.pt_ps_disconnect(self._h)
+            except Exception:
+                pass
+        self._h = self._lib.pt_ps_connect(self._host.encode(), self._port)
+        if not self._h:
+            raise ConnectionError(
+                f"cannot reconnect to pserver {self._host}:{self._port}")
 
     def _ck(self, rc, what):
         if rc != 0:
@@ -253,12 +296,13 @@ class Communicator:
         if self._recv_error is not None:
             raise RuntimeError(
                 "PS async recv thread died") from self._recv_error
+        shapes = list(self._dense_shapes.items())  # init_params may
+        # grow the dict concurrently (engine pull thread vs first hook)
         if self.mode == "async" and self._latest:
             return {n: self._latest[n].reshape(s)
-                    for n, s in self._dense_shapes.items()
-                    if n in self._latest}
+                    for n, s in shapes if n in self._latest}
         return {n: self._client_for(n).pull_dense(n, s)
-                for n, s in self._dense_shapes.items()}
+                for n, s in shapes}
 
     # ---------------- geo path ----------------
     def geo_step(self, named_params):

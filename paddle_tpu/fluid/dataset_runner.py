@@ -1,6 +1,7 @@
 """train_from_dataset engine (reference: executor.py:1407
 _run_from_dataset + MultiTrainer::Run multi_trainer.cc:120 +
-HogwildWorker::TrainFiles hogwild_worker.cc:191).
+HogwildWorker::TrainFiles hogwild_worker.cc:191 + the Downpour dense
+plane: framework/pull_dense_worker.cc:1, device_worker.h:246).
 
 TPU-native design: the reference runs one DeviceWorker THREAD per CPU
 core because each op executes on the worker's core; with a single XLA
@@ -10,11 +11,103 @@ datafeed into a bounded prefetch queue (the double-buffering
 BufferedReader capability, operators/reader/buffered_reader.cc) while
 the main thread dispatches jitted steps; XLA's async dispatch overlaps
 host feeding with device compute.
+
+PS mode (async): the engine additionally owns the Downpour worker
+plane —
+  * a PULL-DENSE thread refreshes local params from the pserver on an
+    interval and writes them into the scope (PullDenseWorker::Run);
+  * a PUSH thread drains a queue of per-step grad handles, performing
+    the device→host readback AND the RPC off the training loop
+    (DownpourWorker's async push), so the step dispatch never blocks on
+    either.
+The per-step hook then only enqueues grad references.
 """
 from __future__ import annotations
 
 import queue
 import threading
+
+
+class _PsWorkerPlane:
+    """Engine-owned async-PS plane: pull-dense thread + push thread
+    around a _PsTrainerHook's Communicator."""
+
+    def __init__(self, hook, scope, pull_interval=0.002, push_depth=8):
+        import numpy as np
+
+        self._np = np
+        self.hook = hook
+        self.scope = scope
+        self.interval = pull_interval
+        self._stop = threading.Event()
+        self._q = queue.Queue(maxsize=push_depth)
+        self._err = []
+        self._fresh = {}
+        self._fresh_mu = threading.Lock()
+        hook._engine_q = self._q
+        hook._engine_plane = self
+        self._push_t = threading.Thread(
+            target=self._pusher, daemon=True, name="pt-ps-push")
+        self._pull_t = threading.Thread(
+            target=self._pull_dense, daemon=True, name="pt-ps-pull-dense")
+        self._push_t.start()
+        self._pull_t.start()
+
+    def _pusher(self):
+        np = self._np
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            try:
+                grads = {p: np.asarray(g) for p, g in item.items()}
+                self.hook.comm.push(grads)
+            except Exception as e:  # pragma: no cover
+                self._err.append(e)
+
+    def _pull_dense(self):
+        # STAGE fresh params; the hook applies them at the step boundary
+        # (after the executor's persistable writeback — writing scope
+        # directly here would race it and get clobbered), mirroring
+        # PullDenseWorker::Run + DeviceWorker's per-step apply. The
+        # 2ms default interval matches PullDenseWorker's sleep_time_ms:
+        # steps on a cached program run in single-digit ms, so a coarse
+        # interval would miss every refresh window.
+        while not self._stop.wait(self.interval):
+            comm = self.hook.comm
+            if comm is None:
+                continue
+            try:
+                fresh = comm.pull()
+                with self._fresh_mu:
+                    self._fresh = fresh
+            except Exception as e:  # pragma: no cover
+                self._err.append(e)
+
+    def take_fresh(self):
+        with self._fresh_mu:
+            fresh, self._fresh = self._fresh, {}
+        return fresh
+
+    def close(self):
+        """Stops the threads; returns (not raises) any worker error so a
+        finally-block caller cannot mask the primary exception or skip
+        sibling planes' cleanup."""
+        self._stop.set()
+        self._q.put(None)
+        self._push_t.join(timeout=10)
+        self._pull_t.join(timeout=10)
+        self.hook._engine_q = None
+        self.hook._engine_plane = None
+        return self._err[0] if self._err else None
+
+
+def _ps_hooks(program):
+    from .transpiler import _PsTrainerHook
+
+    return [h for h in getattr(program, "_run_hooks", ())
+            if isinstance(h, _PsTrainerHook)
+            and not h.sync_mode and not h.geo_k]
 
 
 def run_from_dataset(executor, program, dataset, fetch_list=None,
@@ -56,6 +149,13 @@ def run_from_dataset(executor, program, dataset, fetch_list=None,
                          name="pt-datafeed-prefetch")
     t.start()
 
+    # async-PS: engine owns the Downpour dense-pull/push plane while the
+    # loop runs; hooks degrade to enqueue-only (see _PsTrainerHook)
+    from .executor import _global_scope
+
+    scope = getattr(executor, "scope", None) or _global_scope
+    planes = [_PsWorkerPlane(h, scope) for h in _ps_hooks(program)]
+
     step = 0
     try:
         while True:
@@ -73,6 +173,10 @@ def run_from_dataset(executor, program, dataset, fetch_list=None,
     finally:
         stop.set()
         t.join(timeout=5.0)
+        plane_errs = [e for e in (p.close() for p in planes)
+                      if e is not None]
     if err:
         raise err[0]
+    if plane_errs:
+        raise plane_errs[0]
     return None

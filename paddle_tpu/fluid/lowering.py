@@ -81,6 +81,69 @@ EXCLUDED_OPS = {
     "rank_attention": "pslib ads rank-feature op",
     "filter_by_instag": "dynamic row filtering by tag match; eager "
                         "boolean indexing covers the capability",
+    # --- r03 accounting closure (VERDICT #3) ---
+    "feed": "executor boundary: the Executor binds feeds directly "
+            "(fluid/executor.py), never lowers the op",
+    "fetch": "executor boundary: see feed",
+    "assert": "host-side debug check; FLAGS_check_nan_inf + Python "
+              "asserts at the jit boundary cover it",
+    "delete_var": "GC op: XLA buffer liveness + donation own memory",
+    "get_places": "legacy ParallelDo device enumeration; the mesh "
+                  "(parallel/mesh.py) owns placement",
+    "read": "reader op: DataLoader/DataFeed feed at the executor "
+            "boundary (io/, fluid/dataset.py)",
+    "create_custom_reader": "see read",
+    "conditional_block_infer": "inference twin of conditional_block; "
+                               "the lax.cond lowering serves both",
+    "merge_lod_tensor_infer": "inference twin of merge_lod_tensor; the "
+                              "select lowering serves both",
+    "lod_rank_table": "length-sorted DynamicRNN plumbing; the padded-"
+                      "scan DynamicRNN (layers/control_flow.py) masks "
+                      "instead of sorting (SURVEY §7.1)",
+    "max_sequence_len": "reads a lod_rank_table: same design note",
+    "reorder_lod_tensor_by_rank": "see lod_rank_table",
+    "rnn_memory_helper": "see lod_rank_table (scan carries memory)",
+    "beam_search_decode": "LoD-walking decode twin of beam_search; "
+                          "text.decode.beam_search returns the decoded "
+                          "ids from one jitted scan + gather_tree",
+    "checkpoint_notify": "PS RPC at the executor boundary "
+                         "(distributed/ps Communicator / PsServer save)",
+    "fetch_barrier": "PS RPC barrier: executor run-hooks synchronise",
+    "send_barrier": "see fetch_barrier",
+    "send": "PS RPC at the executor boundary (transpiler run-hooks)",
+    "recv": "see send",
+    "prefetch": "sparse-table RPC prefetch: ps.SparsePrefetcher",
+    "push_dense": "pslib dense push: the native PS Communicator pushes "
+                  "at the executor boundary",
+    "fake_init": "PS-side placeholder init for transpiled programs; "
+                 "PsServer initialises tables itself",
+    "lookup_sparse_table_init": "single native PS table design "
+                                "(ps_server.cc): server owns init",
+    "lookup_sparse_table_read": "see lookup_sparse_table_init",
+    "lookup_sparse_table_write": "see lookup_sparse_table_init",
+    "lookup_table_dequant": "int8-packed embedding rows; the slim int8 "
+                            "path + dequantize_abs_max cover quantized "
+                            "embeddings",
+    "pull_box_extended_sparse": "BoxPS hardware service: out of scope "
+                                "(see pull_box_sparse)",
+    "detection_map": "streaming mAP over LoD state tensors; "
+                     "metric.DetectionMAP computes mAP host-side from "
+                     "the static multiclass_nms outputs",
+    "sequence_topk_avg_pooling": "ROW/COLUMN two-level LoD image "
+                                 "sequences (var_conv_2d family); pad "
+                                 "to max and compose topk+mean",
+    "deformable_psroi_pooling": "deformable-offset RoI sampling; "
+                                "deformable CV family kept to "
+                                "deformable_conv scope",
+    "roi_perspective_transform": "OCR perspective warp of RoIs; "
+                                 "niche — roi_align covers pooling, "
+                                 "compose affine_grid+grid_sampler for "
+                                 "warps",
+    "conv2d_inception_fusion": "pass-generated fusion artifact; the "
+                               "decomposed graph re-fuses under XLA",
+    "fused_fc_elementwise_layernorm": "see conv2d_inception_fusion",
+    "fusion_seqpool_cvm_concat": "see conv2d_inception_fusion",
+    "fusion_transpose_flatten_concat": "see conv2d_inception_fusion",
 }
 
 
@@ -1977,7 +2040,8 @@ def _bilinear_interp(ctx, op):
     x = ctx.inp(op, "X")
     oh, ow = _interp_out_hw(ctx, op, x)
     ctx.out(op, "Out", K.interpolate_bilinear(
-        x, (oh, ow), op.attrs.get("align_corners", False)))
+        x, (oh, ow), op.attrs.get("align_corners", False),
+        int(op.attrs.get("align_mode", 1))))
 
 
 def _interp_out_hw(ctx, op, x):
@@ -2328,3 +2392,6 @@ def _ftrl(ctx, op):
     ctx.out(op, "ParamOut", p_new)
     ctx.out(op, "SquaredAccumOut", sq_new)
     ctx.out(op, "LinearAccumOut", lin_new)
+
+# batch-7: op-accounting closure + fake-quant QAT family (r03)
+from . import lowering_batch7  # noqa: E402,F401

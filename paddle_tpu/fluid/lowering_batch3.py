@@ -399,9 +399,11 @@ def _interp_out_size(op, x, spatial):
     return out
 
 
-def _linear_nd(x, out_sizes, align_corners):
+def _linear_nd(x, out_sizes, align_corners, align_mode=0):
     """Separable linear resize over the trailing len(out_sizes) axes of a
-    channel-leading tensor (N, C, *spatial)."""
+    channel-leading tensor (N, C, *spatial). align_mode (reference
+    interpolate_op.h): 0 = half-pixel src = (dst+0.5)*scale-0.5,
+    1 = legacy src = dst*scale; ignored when align_corners."""
     jnp = _jnp()
     spatial = len(out_sizes)
     for i, o in enumerate(out_sizes):
@@ -409,6 +411,8 @@ def _linear_nd(x, out_sizes, align_corners):
         d = x.shape[ax]
         if align_corners and o > 1:
             coords = jnp.linspace(0.0, d - 1.0, o)
+        elif align_mode == 1:
+            coords = jnp.arange(o) * (d / o)
         else:
             coords = (jnp.arange(o) + 0.5) * (d / o) - 0.5
         lo = jnp.clip(jnp.floor(coords), 0, d - 1).astype(jnp.int32)
@@ -466,7 +470,8 @@ def _make_interp(spatial, method):
         out = _interp_out_size(op, x, spatial)
         align = op.attrs.get("align_corners", False)
         if method == "linear":
-            y = _linear_nd(x, out, align)
+            y = _linear_nd(x, out, align,
+                           int(op.attrs.get("align_mode", 1)))
         else:
             y = _cubic_nd(x, out, align)
         ctx.out(op, "Out", y.astype(x.dtype))
@@ -751,10 +756,17 @@ def _gather_tree(ctx, op):
 
 @register("spectral_norm")
 def _spectral_norm(ctx, op):
-    ctx.out(op, "Out", K.spectral_normalize(
+    out, u_new, v_new = K.spectral_normalize(
         ctx.inp(op, "Weight"), ctx.inp(op, "U"), ctx.inp(op, "V"),
         op.attrs.get("dim", 0), op.attrs.get("power_iters", 1),
-        op.attrs.get("eps", 1e-12)))
+        op.attrs.get("eps", 1e-12))
+    ctx.out(op, "Out", out)
+    # in-place U/V update (reference kernel semantics): write back into
+    # the input vars so persistable buffers stream across steps
+    ctx.env[op.input("U")[0]] = u_new.reshape(
+        ctx.inp(op, "U").shape)
+    ctx.env[op.input("V")[0]] = v_new.reshape(
+        ctx.inp(op, "V").shape)
 
 
 @register("inplace_abn")

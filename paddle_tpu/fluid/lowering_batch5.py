@@ -579,12 +579,28 @@ def _ref_by_trainer_id(ctx, op):
         [lambda i=i: xs[i] for i in range(len(xs))]))
 
 
+_HASH_WARNED = [False]
+
+
 @register("hash")
 def _hash(ctx, op):
     """hash_op.cc: num_hash deterministic hashes of each id row into
     [0, mod_by). xxhash is replaced by a Fibonacci multiplicative mix —
-    the contract is determinism + spread, not a specific digest."""
+    the contract is determinism + spread, not a specific digest.
+
+    LOUD caveat: bucket assignments differ from the reference's xxhash64,
+    so REFERENCE-trained pyramid-hash-style embeddings will look up
+    different rows here. Fresh training is unaffected."""
     jnp = _jnp()
+    if not _HASH_WARNED[0]:
+        import warnings
+
+        warnings.warn(
+            "hash op uses a deterministic mix, not xxhash64: embeddings "
+            "trained by the reference framework against hash buckets "
+            "will NOT align — retrain, or re-bucket offline",
+            RuntimeWarning, stacklevel=2)
+        _HASH_WARNED[0] = True
     x = ctx.inp(op, "X")
     num_hash = op.attrs.get("num_hash", 1)
     mod_by = op.attrs.get("mod_by", 1)

@@ -1,0 +1,1091 @@
+"""Static lowerings, batch 7: closing the op accounting (VERDICT r02 #3)
+plus the fake-quant program-IR family (VERDICT r02 #4).
+
+Groups:
+- tensor/random utilities: allclose, bernoulli, diag, diag_embed, fill,
+  fill_zeros_like2, histogram, is_empty, maxout, randint, randperm, seed,
+  sampling_id, add_position_encoding, *_batch_size_like randoms,
+  random_crop (operators/{allclose,diag,diag_embed,fill,...}_op.cc)
+- losses/metrics: squared_l2_distance, modified_huber_loss,
+  teacher_student_sigmoid_loss, mean_iou, precision_recall, edit_distance
+- optimizer/amp helpers: lars_momentum, average_accumulates,
+  amp_check_finite_and_scale
+- pooling: pool3d, spp (operators/pool_op.cc:451, spp_op.cc)
+- sequence: ctc_align (operators/ctc_align_op.cc:69), match_matrix_tensor
+- sparse-recall trees: tdm_child, tdm_sampler (operators/tdm_*_op.cc)
+- hierarchical_sigmoid (operators/hierarchical_sigmoid_op.cc:61,
+  math/matrix_bit_code.h SimpleCode)
+- fused-op program compat: fused_batch_norm_act, fused_elemwise_activation,
+  conv2d_fusion, fused_embedding_seq_pool (reference fusion passes emit
+  these into saved programs; here they decompose and XLA re-fuses)
+- fake-quant QAT family (operators/fake_quantize_op.cc:182): all forward
+  quantizers carry the straight-through estimator via
+  x + stop_gradient(q(x) - x), so append_backward trains through them.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import kernels as K
+from .lowering import _jnp, register
+from .lowering_seq import _lens_or_full, _out_seq
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+# ---------------------------------------------------------------------------
+# tensor / random utilities
+
+@register("allclose")
+def _allclose(ctx, op):
+    jnp = _jnp()
+    rtol = float(op.attrs.get("rtol", 1e-5))
+    atol = float(op.attrs.get("atol", 1e-8))
+    x, y = ctx.inp(op, "Input"), ctx.inp(op, "Other")
+    eq = jnp.isclose(x, y, rtol=rtol, atol=atol,
+                     equal_nan=op.attrs.get("equal_nan", False))
+    ctx.out(op, "Out", eq.all())
+
+
+@register("bernoulli")
+def _bernoulli(ctx, op):
+    jax = _jax()
+    x = ctx.inp(op, "X")
+    u = jax.random.uniform(ctx.next_key(), x.shape, dtype="float32")
+    ctx.out(op, "Out", (u < x.astype("float32")).astype(x.dtype))
+
+
+@register("diag")
+def _diag(ctx, op):
+    jnp = _jnp()
+    ctx.out(op, "Out", jnp.diag(ctx.inp(op, "Diagonal").reshape(-1)))
+
+
+@register("diag_embed")
+def _diag_embed(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "Input")
+    off = int(op.attrs.get("offset", 0))
+    d1 = int(op.attrs.get("dim1", -2))
+    d2 = int(op.attrs.get("dim2", -1))
+    n = x.shape[-1] + abs(off)
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    i = jnp.arange(x.shape[-1])
+    r = i + max(-off, 0)
+    c = i + max(off, 0)
+    out = base.at[..., r, c].set(x)
+    nd = out.ndim
+    d1 = d1 % nd
+    d2 = d2 % nd
+    perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+    # move the two diag axes to (dim1, dim2)
+    order = []
+    src = {d1: nd - 2, d2: nd - 1}
+    it = iter(perm)
+    for i in range(nd):
+        order.append(src[i] if i in src else next(it))
+    ctx.out(op, "Out", jnp.transpose(out, order))
+
+
+@register("fill")
+def _fill(ctx, op):
+    jnp = _jnp()
+    from ..core.dtypes import convert_dtype
+
+    val = np.asarray(op.attrs["value"], np.float32)
+    shape = [int(s) for s in op.attrs["shape"]]
+    dt = convert_dtype(op.attrs.get("dtype", "float32"))
+    ctx.out(op, "Out", jnp.asarray(val.reshape(shape)).astype(dt))
+
+
+@register("fill_zeros_like2")
+def _fill_zeros_like2(ctx, op):
+    jnp = _jnp()
+    ctx.out(op, "Out", jnp.zeros_like(ctx.inp(op, "X")))
+
+
+@register("histogram")
+def _histogram(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X").reshape(-1).astype("float32")
+    bins = int(op.attrs.get("bins", 100))
+    lo = float(op.attrs.get("min", 0))
+    hi = float(op.attrs.get("max", 0))
+    lo_t = jnp.where(lo == 0 and hi == 0, x.min(), lo)
+    hi_t = jnp.where(lo == 0 and hi == 0, x.max(), hi)
+    hi_t = jnp.where(hi_t == lo_t, lo_t + 1.0, hi_t)
+    idx = jnp.floor((x - lo_t) / (hi_t - lo_t) * bins).astype("int32")
+    idx = jnp.clip(idx, 0, bins - 1)
+    inside = (x >= lo_t) & (x <= hi_t)
+    ctx.out(op, "Out", jnp.zeros((bins,), "int64").at[idx].add(
+        inside.astype("int64")))
+
+
+@register("is_empty")
+def _is_empty(ctx, op):
+    jnp = _jnp()
+    ctx.out(op, "Out", jnp.asarray(ctx.inp(op, "X").size == 0))
+
+
+@register("maxout")
+def _maxout(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    groups = int(op.attrs["groups"])
+    axis = int(op.attrs.get("axis", 1)) % x.ndim
+    c = x.shape[axis]
+    shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    ctx.out(op, "Out", x.reshape(shape).max(axis=axis + 1))
+
+
+@register("randint")
+def _randint(ctx, op):
+    jax = _jax()
+    shape = [int(s) for s in op.attrs["shape"]]
+    out = jax.random.randint(ctx.next_key(), shape,
+                             int(op.attrs.get("low", 0)),
+                             int(op.attrs.get("high", 100)))
+    ctx.out(op, "Out", out.astype("int64"))
+
+
+@register("randperm")
+def _randperm(ctx, op):
+    jax = _jax()
+    n = int(op.attrs["n"])
+    ctx.out(op, "Out", jax.random.permutation(
+        ctx.next_key(), n).astype("int64"))
+
+
+@register("seed")
+def _seed(ctx, op):
+    jax = _jax()
+    jnp = _jnp()
+    s = int(op.attrs.get("seed", 0))
+    if s == 0:
+        out = jax.random.randint(ctx.next_key(), (1,), 1, 2 ** 30)
+    else:
+        out = jnp.asarray([s])
+    ctx.out(op, "Out", out.astype("int32"))
+
+
+@register("sampling_id")
+def _sampling_id(ctx, op):
+    jax = _jax()
+    jnp = _jnp()
+    x = ctx.inp(op, "X")  # [B, C] probabilities
+    ids = jax.random.categorical(
+        ctx.next_key(), jnp.log(jnp.clip(x.astype("float32"), 1e-20,
+                                         None)))
+    ctx.out(op, "Out", ids.astype("int64"))
+
+
+@register("add_position_encoding")
+def _add_position_encoding(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")  # [B, T, D]
+    alpha = float(op.attrs.get("alpha", 1.0))
+    beta = float(op.attrs.get("beta", 1.0))
+    B, T, D = x.shape
+    half = D // 2
+    pos = jnp.arange(T, dtype="float32")[:, None]
+    div = jnp.power(10000.0, jnp.arange(half, dtype="float32") / half)
+    pe = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)],
+                         axis=1).astype(x.dtype)
+    ctx.out(op, "Out", alpha * x + beta * pe[None, :, :])
+
+
+def _batch_size_like_shape(op, ref):
+    shape = [int(s) for s in op.attrs["shape"]]
+    in_idx = int(op.attrs.get("input_dim_idx", 0))
+    out_idx = int(op.attrs.get("output_dim_idx", 0))
+    shape[out_idx] = ref.shape[in_idx]
+    return shape
+
+
+@register("gaussian_random_batch_size_like")
+def _gauss_bsl(ctx, op):
+    jax = _jax()
+    shape = _batch_size_like_shape(op, ctx.inp(op, "Input"))
+    out = jax.random.normal(ctx.next_key(), shape) \
+        * float(op.attrs.get("std", 1.0)) + float(op.attrs.get("mean", 0.0))
+    ctx.out(op, "Out", out.astype("float32"))
+
+
+@register("uniform_random_batch_size_like")
+def _unif_bsl(ctx, op):
+    jax = _jax()
+    shape = _batch_size_like_shape(op, ctx.inp(op, "Input"))
+    out = jax.random.uniform(
+        ctx.next_key(), shape, minval=float(op.attrs.get("min", -1.0)),
+        maxval=float(op.attrs.get("max", 1.0)))
+    ctx.out(op, "Out", out.astype("float32"))
+
+
+@register("random_crop")
+def _random_crop(ctx, op):
+    jax = _jax()
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    shape = [int(s) for s in op.attrs["shape"]]
+    k = len(shape)
+    lead = x.shape[:x.ndim - k]
+    key = ctx.next_key()
+    # one random offset per cropped dim, shared across leading dims (the
+    # reference draws per instance; per-batch offsets would need a vmap —
+    # shared offsets keep the op jit-cheap and preserve randomness)
+    starts = []
+    for i, s in enumerate(shape):
+        dim = x.shape[x.ndim - k + i]
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, max(dim - s, 0) + 1))
+    idx = tuple([slice(None)] * len(lead))
+    out = jax.lax.dynamic_slice(
+        x, [jnp.zeros((), "int32")] * len(lead)
+        + [s.astype("int32") for s in starts], list(lead) + shape)
+    del idx
+    ctx.out(op, "Out", out)
+    ctx.out(op, "SeedOut", jnp.zeros((1,), "int64"))
+
+
+# ---------------------------------------------------------------------------
+# losses / metrics
+
+@register("squared_l2_distance")
+def _squared_l2_distance(ctx, op):
+    x, y = ctx.inp(op, "X"), ctx.inp(op, "Y")
+    sub = x - y
+    ctx.out(op, "sub_result", sub)
+    ctx.out(op, "Out", (sub * sub).sum(axis=tuple(range(1, sub.ndim)),
+                                       keepdims=sub.ndim > 1))
+
+
+@register("modified_huber_loss")
+def _modified_huber_loss(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    y = ctx.inp(op, "Y")
+    yy = 2.0 * y.astype("float32") - 1.0
+    z = x.astype("float32") * yy
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.square(jnp.maximum(1.0 - z, 0.0)))
+    ctx.out(op, "IntermediateVal", z.astype(x.dtype))
+    ctx.out(op, "Out", loss.astype(x.dtype))
+
+
+@register("teacher_student_sigmoid_loss")
+def _ts_sigmoid_loss(ctx, op):
+    # teacher_student_sigmoid_loss_op.h: label < -1 -> ce(clk=1);
+    # -1<=label<0 -> ce(clk=0); 0<=label<1 -> ce(1) + teacher term;
+    # label>=1 -> ce(0) + teacher term with z'=label-1
+    jnp = _jnp()
+    x = ctx.inp(op, "X").reshape(-1).astype("float32")
+    lab = ctx.inp(op, "Label").reshape(-1).astype("float32")
+    sp = jnp.maximum(x, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ce1 = sp            # -log sigmoid(x) + x*0 form for clk=1: sp
+    ce0 = sp - x        # wait: see mapping below
+    # reference: clk=1 term = max(x,0)+log1p(e^-|x|)  (== sp)
+    #            clk=0 term = max(x,0)-x+log1p(e^-|x|) (== sp - x)
+    t1 = sp
+    t0 = sp - x
+    teacher = lambda zp: t0 + (t1 - x * zp - t1 + sp) * 0 + (sp - x * zp)  # noqa
+    loss = jnp.where(
+        lab < -1.0, t1,
+        jnp.where(lab < 0.0, t0,
+                  jnp.where(lab < 1.0, t1 + sp - x * lab,
+                            t0 + sp - x * (lab - 1.0))))
+    del ce1, ce0, teacher
+    ctx.out(op, "Y", loss.reshape(-1, 1).astype(ctx.inp(op, "X").dtype))
+
+
+@register("mean_iou")
+def _mean_iou(ctx, op):
+    jnp = _jnp()
+    pred = ctx.inp(op, "Predictions").reshape(-1).astype("int32")
+    lab = ctx.inp(op, "Labels").reshape(-1).astype("int32")
+    n = int(op.attrs["num_classes"])
+    inter = jnp.zeros((n,), "int64").at[
+        jnp.where(pred == lab, pred, n)].add(1, mode="drop")
+    pa = jnp.zeros((n,), "int64").at[pred].add(1, mode="drop")
+    la = jnp.zeros((n,), "int64").at[lab].add(1, mode="drop")
+    union = pa + la - inter
+    present = union > 0
+    iou = jnp.where(present, inter / jnp.maximum(union, 1), 0.0)
+    miou = iou.sum() / jnp.maximum(present.sum(), 1)
+    ctx.out(op, "OutMeanIou", miou.astype("float32"))
+    ctx.out(op, "OutWrong", (la - inter).astype("int32"))
+    ctx.out(op, "OutCorrect", inter.astype("int32"))
+
+
+@register("precision_recall")
+def _precision_recall(ctx, op):
+    # metrics/precision_recall_op.cc: per-class TP/FP/TN/FN from the
+    # predicted class (Indices) vs Labels, macro+micro P/R/F1, with
+    # streaming accumulation through StatesInfo
+    jnp = _jnp()
+    idx = ctx.inp(op, "Indices").reshape(-1).astype("int32")
+    lab = ctx.inp(op, "Labels").reshape(-1).astype("int32")
+    w = ctx.inp(op, "Weights")
+    C = int(op.attrs["class_number"])
+    wv = w.reshape(-1).astype("float32") if w is not None else \
+        jnp.ones(idx.shape, "float32")
+    correct = idx == lab
+    tp = jnp.zeros((C,), "float32").at[
+        jnp.where(correct, lab, C)].add(wv, mode="drop")
+    fp = jnp.zeros((C,), "float32").at[
+        jnp.where(correct, C, idx)].add(wv, mode="drop")
+    fn = jnp.zeros((C,), "float32").at[
+        jnp.where(correct, C, lab)].add(wv, mode="drop")
+    total = wv.sum()
+    tn = total - tp - fp - fn
+
+    def metrics(tp, fp, tn, fn):
+        prec = jnp.where(tp + fp > 0, tp / jnp.maximum(tp + fp, 1e-12),
+                         0.0)
+        rec = jnp.where(tp + fn > 0, tp / jnp.maximum(tp + fn, 1e-12),
+                        0.0)
+        f1 = jnp.where(prec + rec > 0,
+                       2 * prec * rec / jnp.maximum(prec + rec, 1e-12),
+                       0.0)
+        return prec.mean(), rec.mean(), f1.mean()
+
+    bp, br, bf = metrics(tp, fp, tn, fn)
+    states = ctx.inp(op, "StatesInfo")
+    if states is not None:
+        acc = states.astype("float32") + jnp.stack([tp, fp, tn, fn], 1)
+    else:
+        acc = jnp.stack([tp, fp, tn, fn], 1)
+    ap, ar, af = metrics(acc[:, 0], acc[:, 1], acc[:, 2], acc[:, 3])
+    ctx.out(op, "BatchMetrics", jnp.stack([bp, br, bf]).astype("float32"))
+    ctx.out(op, "AccumMetrics", jnp.stack([ap, ar, af]).astype("float32"))
+    ctx.out(op, "AccumStatesInfo", acc)
+
+
+@register("edit_distance")
+def _edit_distance(ctx, op):
+    # operators/edit_distance_op.cc:103 — batched Levenshtein DP as a
+    # lax.scan over hypothesis positions carrying one DP row per batch
+    jax = _jax()
+    jnp = _jnp()
+    hyp = ctx.inp(op, "Hyps")
+    ref = ctx.inp(op, "Refs")
+    hlens = _lens_or_full(ctx, op, "Hyps", hyp).astype("int32")
+    rlens = _lens_or_full(ctx, op, "Refs", ref).astype("int32")
+    if hyp.ndim > 2:
+        hyp = hyp.reshape(hyp.shape[0], -1)
+        ref = ref.reshape(ref.shape[0], -1)
+    B, Th = hyp.shape
+    Tr = ref.shape[1]
+    cols = jnp.arange(Tr + 1, dtype="float32")
+    row0 = jnp.broadcast_to(cols, (B, Tr + 1))
+
+    def body(row, i):
+        # row = dp[i]; compute dp[i+1]
+        new0 = jnp.full((B,), float(0), "float32") + (i + 1)
+        sub = row[:, :-1] + (hyp[:, i][:, None] != ref).astype("float32")
+        dele = row[:, 1:] + 1.0
+
+        def inner(carry, j):
+            prev = carry  # dp[i+1][j]
+            cur = jnp.minimum(jnp.minimum(sub[:, j], dele[:, j]),
+                              prev + 1.0)
+            return cur, cur
+
+        _, rest = jax.lax.scan(inner, new0, jnp.arange(Tr))
+        new = jnp.concatenate([new0[:, None], rest.T], axis=1)
+        # rows beyond this hyp's length keep the previous value
+        new = jnp.where((i < hlens)[:, None], new, row)
+        return new, None
+
+    final, _ = jax.lax.scan(body, row0, jnp.arange(Th))
+    d = final[jnp.arange(B), rlens]
+    # hyps shorter than Th: dp stops at hlens; refs shorter: index rlens
+    if op.attrs.get("normalized", True):
+        d = d / jnp.maximum(rlens.astype("float32"), 1.0)
+    ctx.out(op, "Out", d.reshape(B, 1).astype("float32"))
+    ctx.out(op, "SequenceNum", jnp.asarray(B, "int64"))
+
+
+# ---------------------------------------------------------------------------
+# optimizer / amp helpers
+
+@register("lars_momentum")
+def _lars_momentum(ctx, op):
+    jnp = _jnp()
+    p = ctx.inp(op, "Param")
+    g = ctx.inp(op, "Grad")
+    v = ctx.inp(op, "Velocity")
+    lr = ctx.inp(op, "LearningRate").reshape(())
+    mu = float(op.attrs.get("mu", 0.9))
+    coeff = float(op.attrs.get("lars_coeff", 1e-3))
+    wd = float(op.attrs.get("lars_weight_decay", 5e-4))
+    eps = float(op.attrs.get("epsilon", 0.0))
+    pn = jnp.sqrt((p.astype("float32") ** 2).sum())
+    gn = jnp.sqrt((g.astype("float32") ** 2).sum())
+    local_lr = jnp.where(
+        (pn > 0) & (gn > 0),
+        lr * coeff * pn / (gn + wd * pn + eps), lr)
+    v2 = mu * v + local_lr * (g + wd * p)
+    ctx.out(op, "ParamOut", p - v2)
+    ctx.out(op, "VelocityOut", v2)
+
+
+@register("average_accumulates")
+def _average_accumulates(ctx, op):
+    # average_accumulates_op.h: ModelAverage's streaming parameter sums
+    jnp = _jnp()
+    param = ctx.inp(op, "param")
+    s1 = ctx.inp(op, "in_sum_1")
+    s2 = ctx.inp(op, "in_sum_2")
+    s3 = ctx.inp(op, "in_sum_3")
+    nu = ctx.inp(op, "in_num_updates").reshape(()).astype("int64")
+    na = ctx.inp(op, "in_num_accumulates").reshape(()).astype("int64")
+    ona = ctx.inp(op, "in_old_num_accumulates").reshape(()) \
+        .astype("int64")
+    avg_win = float(op.attrs.get("average_window", 0))
+    max_w = int(op.attrs.get("max_average_window", 10000))
+    min_w = int(op.attrs.get("min_average_window", 10000))
+    kmax = 16384
+    nu = nu + 1
+    na = na + 1
+    o1 = s1 + param
+    o2 = s2
+    o3 = s3
+    spill = nu % kmax == 0
+    o2 = jnp.where(spill, o2 + o1, o2)
+    o1 = jnp.where(spill, jnp.zeros_like(o1), o1)
+    win = jnp.minimum(jnp.asarray(max_w, "float32"),
+                      nu.astype("float32") * avg_win)
+    retire = (na >= min_w) & (na.astype("float32") >= win)
+    o3 = jnp.where(retire, o1 + o2, o3)
+    o1 = jnp.where(retire, jnp.zeros_like(o1), o1)
+    o2 = jnp.where(retire, jnp.zeros_like(o2), o2)
+    ona = jnp.where(retire, na, ona)
+    na = jnp.where(retire, jnp.zeros_like(na), na)
+    ctx.out(op, "out_sum_1", o1)
+    ctx.out(op, "out_sum_2", o2)
+    ctx.out(op, "out_sum_3", o3)
+    ctx.out(op, "out_num_updates", nu.reshape(1))
+    ctx.out(op, "out_num_accumulates", na.reshape(1))
+    ctx.out(op, "out_old_num_accumulates", ona.reshape(1))
+
+
+@register("amp_check_finite_and_scale")
+@register("check_finite_and_unscale")
+def _amp_check_finite_and_scale(ctx, op):
+    jnp = _jnp()
+    xs = ctx.inps(op, "X")
+    scale = ctx.inp(op, "Scale").reshape(())
+    found = jnp.zeros((), bool)
+    outs = []
+    for x in xs:
+        found = found | ~jnp.isfinite(x.astype("float32")).all()
+        outs.append(x / scale)
+    ctx.outs(op, "Out", outs)
+    ctx.out(op, "FoundInfinite", found.reshape(1))
+
+
+# ---------------------------------------------------------------------------
+# pooling
+
+def _pool_nd(x, ksize, strides, pads, ptype, exclusive, adaptive, nd):
+    import jax.lax as lax
+
+    jnp = _jnp()
+    if adaptive:
+        # adaptive: split each spatial dim into ksize[i] roughly-even bins
+        out = x
+        for d in range(nd):
+            axis = 2 + d
+            bins = ksize[d]
+            size = out.shape[axis]
+            idx = [(size * i) // bins for i in range(bins + 1)]
+            pieces = []
+            for i in range(bins):
+                sl = [slice(None)] * out.ndim
+                sl[axis] = slice(idx[i], max(idx[i + 1], idx[i] + 1))
+                seg = out[tuple(sl)]
+                red = seg.max(axis=axis, keepdims=True) if ptype == "max" \
+                    else seg.mean(axis=axis, keepdims=True)
+                pieces.append(red)
+            out = jnp.concatenate(pieces, axis=axis)
+        return out
+    window = (1, 1) + tuple(ksize)
+    stride = (1, 1) + tuple(strides)
+    pad = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    if ptype == "max":
+        init = -jnp.inf
+        return lax.reduce_window(x, init, lax.max, window, stride, pad)
+    s = lax.reduce_window(x.astype("float32"), 0.0, lax.add, window,
+                          stride, pad)
+    if exclusive:
+        ones = jnp.ones(x.shape[2:], "float32")[None, None]
+        cnt = lax.reduce_window(
+            jnp.broadcast_to(ones, x.shape).astype("float32"), 0.0,
+            lax.add, window, stride, pad)
+    else:
+        cnt = float(np.prod(ksize))
+    return (s / cnt).astype(x.dtype)
+
+
+@register("pool3d")
+def _pool3d(ctx, op):
+    x = ctx.inp(op, "X")  # NCDHW
+    ksize = [int(k) for k in op.attrs["ksize"]]
+    ptype = op.attrs.get("pooling_type", "max")
+    strides = [int(s) for s in op.attrs.get("strides", [1, 1, 1])]
+    pads = [int(p) for p in op.attrs.get("paddings", [0, 0, 0])]
+    if op.attrs.get("global_pooling", False):
+        ksize = list(x.shape[2:])
+        pads = [0, 0, 0]
+    ctx.out(op, "Out", _pool_nd(
+        x, ksize, strides, pads, ptype,
+        op.attrs.get("exclusive", True),
+        op.attrs.get("adaptive", False), 3))
+
+
+@register("spp")
+def _spp(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")  # [N, C, H, W]
+    h = int(op.attrs["pyramid_height"])
+    ptype = op.attrs.get("pooling_type", "max")
+    N, C = x.shape[0], x.shape[1]
+    feats = []
+    for level in range(h):
+        bins = 2 ** level
+        pooled = _pool_nd(x, [bins, bins], [1, 1], [0, 0], ptype,
+                          True, True, 2)
+        feats.append(pooled.reshape(N, C * bins * bins))
+    ctx.out(op, "Out", jnp.concatenate(feats, axis=1))
+
+
+# ---------------------------------------------------------------------------
+# sequence extras
+
+@register("ctc_align")
+def _ctc_align(ctx, op):
+    # ctc_align_op.cc:69 — merge repeated tokens then drop blanks;
+    # static form compacts to the front and emits a lengths companion
+    jnp = _jnp()
+    x = ctx.inp(op, "Input")
+    lens = _lens_or_full(ctx, op, "Input", x).astype("int32")
+    blank = int(op.attrs.get("blank", 0))
+    merge = op.attrs.get("merge_repeated", True)
+    B, T = x.shape[0], x.shape[1]
+    xi = x.reshape(B, T).astype("int32")
+    pos = jnp.arange(T)[None, :]
+    valid = pos < lens[:, None]
+    first = pos == 0
+    if merge:
+        prev = jnp.concatenate(
+            [jnp.full((B, 1), -1, "int32"), xi[:, :-1]], axis=1)
+        keep = (xi != prev) | first
+    else:
+        keep = jnp.ones_like(valid)
+    keep = keep & (xi != blank) & valid
+    rank = jnp.cumsum(keep.astype("int32"), axis=1) - 1
+    out = jnp.zeros((B, T), xi.dtype).at[
+        jnp.arange(B)[:, None], jnp.where(keep, rank, T)].set(
+        jnp.where(keep, xi, 0), mode="drop")
+    new_lens = keep.sum(axis=1).astype("int32")
+    _out_seq(ctx, op, "Output", out.astype(x.dtype), new_lens)
+
+
+@register("match_matrix_tensor")
+def _match_matrix_tensor(ctx, op):
+    # match_matrix_tensor_op.cc (MatchPyramid): out[b,t,i,j] =
+    # x_i^T W_t y_j over padded sequences; invalid positions zeroed
+    jnp = _jnp()
+    x = ctx.inp(op, "X")  # [B, Tx, D]
+    y = ctx.inp(op, "Y")  # [B, Ty, D]
+    w = ctx.inp(op, "W")  # [D, dim_t, D]
+    xl = _lens_or_full(ctx, op, "X", x).astype("int32")
+    yl = _lens_or_full(ctx, op, "Y", y).astype("int32")
+    tmp = jnp.einsum("bxd,dte->bxte", x, w)
+    out = jnp.einsum("bxte,bye->btxy", tmp, y)
+    mx = (jnp.arange(x.shape[1])[None, :] < xl[:, None])
+    my = (jnp.arange(y.shape[1])[None, :] < yl[:, None])
+    out = out * mx[:, None, :, None] * my[:, None, None, :]
+    ctx.out(op, "Out", out)
+    ctx.out(op, "Tmp", tmp)
+
+
+@register("similarity_focus")
+def _similarity_focus(ctx, op):
+    # similarity_focus_op.h: for the selected channels, greedily pick
+    # per-(row,col) maxima — each selected element claims its row and
+    # column; every claimed row/col position gets focus value 1
+    jax = _jax()
+    jnp = _jnp()
+    x = ctx.inp(op, "X")  # [B, C, A, B2]
+    axis = int(op.attrs.get("axis", 1))
+    indexes = [int(i) for i in op.attrs.get("indexes", [0])]
+    if axis != 1:
+        perm = list(range(x.ndim))
+        perm[1], perm[axis] = perm[axis], perm[1]
+        x = jnp.transpose(x, perm)
+    B, C, M, N = x.shape
+    out = jnp.zeros_like(x)
+    for ci in indexes:
+        plane = x[:, ci]  # [B, M, N]
+        steps = min(M, N)
+
+        def body(i, carry):
+            rmask, cmask, focus = carry
+            masked = jnp.where(rmask[:, :, None] | cmask[:, None, :],
+                               -jnp.inf, plane)
+            flat = masked.reshape(B, -1)
+            amax = flat.argmax(axis=1)
+            r, c = amax // N, amax % N
+            rmask = rmask.at[jnp.arange(B), r].set(True)
+            cmask = cmask.at[jnp.arange(B), c].set(True)
+            return rmask, cmask, focus
+
+        rmask, cmask, _ = jax.lax.fori_loop(
+            0, steps, body,
+            (jnp.zeros((B, M), bool), jnp.zeros((B, N), bool),
+             jnp.zeros((B, M, N), x.dtype)))
+        focus = (rmask[:, :, None] | cmask[:, None, :]).astype(x.dtype)
+        out = out.at[:, ci].set(focus)
+    # all channels share the focus mask of their channel (non-selected
+    # channels stay zero, reference behavior)
+    if axis != 1:
+        out = jnp.transpose(out, perm)
+    ctx.out(op, "Out", out)
+
+
+# ---------------------------------------------------------------------------
+# TDM tree ops
+
+@register("tdm_child")
+def _tdm_child(ctx, op):
+    # tdm_child_op.h TreeInfo rows: [item_id, layer_id, ancestor,
+    # child_0..child_{n-1}]; leaf mask = child node exists AND its
+    # item_id != 0
+    jnp = _jnp()
+    x = ctx.inp(op, "X").astype("int32")
+    info = ctx.inp(op, "TreeInfo").astype("int32")
+    n = int(op.attrs["child_nums"])
+    flat = x.reshape(-1)
+    rows = info[flat]  # [K, 3+child_nums]
+    children = rows[:, 3:3 + n]
+    item_ids = info[jnp.clip(children, 0, info.shape[0] - 1), 0]
+    mask = ((children != 0) & (item_ids != 0)).astype("int32")
+    child = jnp.where(mask > 0, children, 0)
+    shape = x.shape + (n,)
+    ctx.out(op, "Child", child.reshape(shape).astype("int64"))
+    ctx.out(op, "LeafMask", mask.reshape(shape).astype("int64"))
+
+
+@register("tdm_sampler")
+def _tdm_sampler(ctx, op):
+    # tdm_sampler_op.h: for each item, walk its Travel path (one positive
+    # node per layer) and draw neg_num negatives per layer from that
+    # layer's node list (excluding the positive by redraw-shift)
+    jax = _jax()
+    jnp = _jnp()
+    x = ctx.inp(op, "X").astype("int32").reshape(-1)   # [B] item ids
+    travel = ctx.inp(op, "Travel").astype("int32")     # [items, L]
+    layer = ctx.inp(op, "Layer").astype("int32")       # [total_nodes]
+    neg_nums = [int(v) for v in op.attrs["neg_samples_num_list"]]
+    layer_offsets = [int(v) for v in op.attrs["layer_offset_lod"]]
+    output_positive = bool(op.attrs.get("output_positive", True))
+    B = x.shape[0]
+    paths = travel[x]  # [B, L]
+    outs, labs, masks = [], [], []
+    key = ctx.next_key()
+    for li, neg in enumerate(neg_nums):
+        lo, hi = layer_offsets[li], layer_offsets[li + 1]
+        pos = paths[:, li]  # [B]
+        pvalid = pos != 0
+        if output_positive:
+            outs.append(pos[:, None])
+            labs.append(jnp.ones((B, 1), "int32") * pvalid[:, None])
+            masks.append(pvalid[:, None].astype("int32"))
+        key, sub = jax.random.split(key)
+        ridx = jax.random.randint(sub, (B, neg), lo, max(hi - 1, lo + 1))
+        cand = layer.reshape(-1)[jnp.clip(ridx, 0, layer.size - 1)]
+        # avoid sampling the positive: shift colliding draws by one slot
+        coll = cand == pos[:, None]
+        alt = layer.reshape(-1)[jnp.clip(ridx + 1, 0, layer.size - 1)]
+        cand = jnp.where(coll, alt, cand)
+        outs.append(cand * pvalid[:, None])
+        labs.append(jnp.zeros((B, neg), "int32"))
+        masks.append(jnp.broadcast_to(pvalid[:, None].astype("int32"),
+                                      (B, neg)))
+    ctx.out(op, "Out", jnp.concatenate(outs, 1).astype("int64")
+            .reshape(B, -1, 1))
+    ctx.out(op, "Labels", jnp.concatenate(labs, 1).astype("int64")
+            .reshape(B, -1, 1))
+    ctx.out(op, "Mask", jnp.concatenate(masks, 1).astype("int64")
+            .reshape(B, -1, 1))
+
+
+# ---------------------------------------------------------------------------
+# hierarchical sigmoid
+
+@register("hierarchical_sigmoid")
+def _hierarchical_sigmoid(ctx, op):
+    """hierarchical_sigmoid_op.cc:61 + matrix_bit_code.h SimpleCode:
+    default complete-binary-tree coding (code = label + num_classes;
+    weight index = prefixes, bit path = suffixes) or custom
+    PathTable/PathCode."""
+    jnp = _jnp()
+    x = ctx.inp(op, "X")          # [B, D]
+    w = ctx.inp(op, "W")          # [num_nodes, D]
+    label = ctx.inp(op, "Label").reshape(-1).astype("int32")
+    bias = ctx.inp(op, "Bias")
+    path_table = ctx.inp(op, "PathTable")
+    path_code = ctx.inp(op, "PathCode")
+    C = int(op.attrs.get("num_classes", 2))
+    B = x.shape[0]
+    if path_table is not None:
+        idx = path_table.astype("int32")        # [B, L]
+        bits = path_code.astype("float32")      # [B, L]
+        valid = idx >= 0
+        idx = jnp.clip(idx, 0, w.shape[0] - 1)
+    else:
+        L = max(int(np.ceil(np.log2(max(C, 2)))), 1)
+        code = label + C                        # [B]
+        length = jnp.floor(
+            jnp.log2(code.astype("float32"))).astype("int32")
+        j = jnp.arange(L)[None, :]
+        valid = j < length[:, None]
+        idx = (code[:, None] >> (j + 1)) - 1
+        idx = jnp.clip(idx, 0, w.shape[0] - 1)
+        bits = ((code[:, None] >> j) & 1).astype("float32")
+    wg = w[idx]                                  # [B, L, D]
+    logits = jnp.einsum("bld,bd->bl", wg.astype("float32"),
+                        x.astype("float32"))
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[idx]
+    # sigmoid CE with target bit, summed over the (masked) path
+    sp = jnp.maximum(logits, 0.0) - logits * bits + \
+        jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    loss = jnp.where(valid, sp, 0.0).sum(axis=1, keepdims=True)
+    pre = jnp.where(valid, logits, 0.0)
+    ctx.out(op, "Out", loss.astype(x.dtype))
+    ctx.out(op, "PreOut", pre.astype(x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# fused-op program compatibility (decompose; XLA re-fuses)
+
+@register("fused_batch_norm_act")
+def _fused_batch_norm_act(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    scale = ctx.inp(op, "Scale")
+    b = ctx.inp(op, "Bias")
+    mean = ctx.inp(op, "Mean")
+    var = ctx.inp(op, "Variance")
+    eps = float(op.attrs.get("epsilon", 1e-5))
+    mom = float(op.attrs.get("momentum", 0.9))
+    act = op.attrs.get("act_type", "relu")
+    y, nm, nv, bm, bv = K.batch_norm_train(x, scale, b, mean, var, mom,
+                                           eps)
+    y = K.activation(y, act) if hasattr(K, "activation") else \
+        getattr(jnp, act, None)(y) if hasattr(jnp, act) else \
+        jnp.maximum(y, 0)
+    ctx.out(op, "Y", y)
+    ctx.out(op, "MeanOut", nm)
+    ctx.out(op, "VarianceOut", nv)
+    ctx.out(op, "SavedMean", bm)
+    ctx.out(op, "SavedVariance", bv)
+
+
+_ELEM_FN = {
+    "elementwise_add": lambda a, b: a + b,
+    "elementwise_sub": lambda a, b: a - b,
+    "elementwise_mul": lambda a, b: a * b,
+}
+
+
+def _unary_fn(name):
+    jnp = _jnp()
+    return {
+        "relu": lambda v: jnp.maximum(v, 0),
+        "sigmoid": lambda v: 1.0 / (1.0 + jnp.exp(-v)),
+        "tanh": jnp.tanh,
+        "scale": lambda v: v,
+    }[name.split(":")[0]]
+
+
+@register("fused_elemwise_activation")
+def _fused_elemwise_activation(ctx, op):
+    # fused_elemwise_activation_op.cc: functor_list = [f_outer, f_inner];
+    # binary-first (e.g. ["elementwise_add", "relu"]: out=add(x,relu(y)))
+    # or unary-outer (["relu", "elementwise_add"]: out=relu(add(x,y)))
+    x = ctx.inp(op, "X")
+    y = ctx.inp(op, "Y")
+    f0, f1 = [f for f in op.attrs["functor_list"]]
+    if f0 in _ELEM_FN:          # binary outer, unary inner on Y
+        inter = _unary_fn(f1)(y)
+        out = _ELEM_FN[f0](x, inter)
+    else:                        # unary outer, binary inner
+        inter = _ELEM_FN[f1](x, y)
+        out = _unary_fn(f0)(inter)
+    ctx.out(op, "Out", out)
+    ctx.out(op, "IntermediateOut", inter)
+
+
+@register("conv2d_fusion")
+def _conv2d_fusion(ctx, op):
+    # fused_conv2d_op / conv2d_fusion: conv + bias + activation
+    # (+ residual add)
+    jnp = _jnp()
+    x = ctx.inp(op, "Input")
+    w = ctx.inp(op, "Filter")
+    out = K.conv2d(
+        x, w, [int(s) for s in op.attrs.get("strides", [1, 1])],
+        [int(p) for p in op.attrs.get("paddings", [0, 0])],
+        [int(d) for d in op.attrs.get("dilations", [1, 1])],
+        int(op.attrs.get("groups", 1)))
+    b = ctx.inp(op, "Bias")
+    if b is not None:
+        out = out + b.reshape(1, -1, 1, 1)
+    r = ctx.inp(op, "ResidualData")
+    if r is not None:
+        out = out + r
+    act = op.attrs.get("activation", "relu")
+    if act and act != "identity":
+        out = _unary_fn(act)(out)
+    ctx.out(op, "Output", out)
+
+
+@register("fused_embedding_seq_pool")
+def _fused_embedding_seq_pool(ctx, op):
+    jnp = _jnp()
+    w = ctx.inp(op, "W")
+    ids = ctx.inp(op, "Ids")
+    lens = _lens_or_full(ctx, op, "Ids", ids).astype("int32")
+    B, T = ids.shape[0], ids.shape[1]
+    emb = w[jnp.clip(ids.reshape(B, T).astype("int32"), 0,
+                     w.shape[0] - 1)]
+    mask = (jnp.arange(T)[None, :] < lens[:, None])[..., None]
+    ctx.out(op, "Out", (emb * mask).sum(axis=1))
+
+
+# ---------------------------------------------------------------------------
+# fake-quant QAT family (fake_quantize_op.cc:182) — STE everywhere
+
+def _ste(x, q):
+    """Straight-through estimator: forward q, gradient of identity."""
+    import jax
+
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def _quant_dequant(x, scale, bin_cnt):
+    jnp = _jnp()
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.round(jnp.clip(x / s, -1.0, 1.0) * bin_cnt) * s / bin_cnt
+    return q
+
+
+@register("fake_quantize_abs_max")
+@register("fake_quantize_dequantize_abs_max")
+def _fake_quantize_abs_max(ctx, op):
+    jax = _jax()
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    bits = int(op.attrs.get("bit_length", 8))
+    bin_cnt = 2 ** (bits - 1) - 1
+    scale = jax.lax.stop_gradient(jnp.abs(x).max())
+    ctx.out(op, "Out", _ste(x, _quant_dequant(x, scale, bin_cnt)))
+    ctx.out(op, "OutScale", scale.reshape(1))
+
+
+@register("fake_quantize_range_abs_max")
+def _fake_quantize_range_abs_max(ctx, op):
+    # FindRangeAbsMaxFunctor: ring buffer of window_size scales; the
+    # running max refreshes from the window when the evicted entry WAS
+    # the max
+    jax = _jax()
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    in_scale = ctx.inp(op, "InScale").reshape(())
+    it = ctx.inp(op, "Iter")
+    bits = int(op.attrs.get("bit_length", 8))
+    window = int(op.attrs.get("window_size", 10000))
+    bin_cnt = 2 ** (bits - 1) - 1
+    if op.attrs.get("is_test", False):
+        ctx.out(op, "Out", _ste(x, _quant_dequant(x, in_scale, bin_cnt)))
+        ctx.out(op, "OutScale", in_scale.reshape(1))
+        return
+    cur = jax.lax.stop_gradient(jnp.abs(x).max())
+    scales_arr = ctx.inp(op, "InScales")
+    itv = it.reshape(()).astype("int32") if it is not None else \
+        jnp.zeros((), "int32")
+    if scales_arr is None:
+        scales_arr = jnp.zeros((window,), "float32")
+    idx = itv % window
+    removed = scales_arr[idx]
+    scales_arr = scales_arr.at[idx].set(cur)
+    size = jnp.minimum(itv + 1, window)
+    win_mask = jnp.arange(window) < size
+    win_max = jnp.where(win_mask, scales_arr, 0.0).max()
+    out_scale = jnp.where(
+        in_scale < cur, cur,
+        jnp.where(jnp.abs(removed - in_scale) < 1e-6, win_max, in_scale))
+    ctx.out(op, "Out", _ste(x, _quant_dequant(x, out_scale, bin_cnt)))
+    ctx.out(op, "OutScale", out_scale.reshape(1))
+    ctx.out(op, "OutScales", scales_arr)
+
+
+@register("fake_quantize_moving_average_abs_max")
+@register("fake_quantize_dequantize_moving_average_abs_max")
+def _fake_quantize_moving_avg(ctx, op):
+    jax = _jax()
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    in_scale = ctx.inp(op, "InScale").reshape(())
+    bits = int(op.attrs.get("bit_length", 8))
+    rate = float(op.attrs.get("moving_rate", 0.9))
+    bin_cnt = 2 ** (bits - 1) - 1
+    if op.attrs.get("is_test", False):
+        ctx.out(op, "Out", _ste(x, _quant_dequant(x, in_scale, bin_cnt)))
+        ctx.out(op, "OutScale", in_scale.reshape(1))
+        return
+    cur = jax.lax.stop_gradient(jnp.abs(x).max())
+    accum = ctx.inp(op, "InAccum")
+    state = ctx.inp(op, "InState")
+    a = accum.reshape(()) if accum is not None else jnp.ones((), "f4")
+    s = state.reshape(()) if state is not None else jnp.ones((), "f4")
+    s2 = rate * s + 1.0
+    a2 = rate * a + cur
+    scale = a2 / s2
+    ctx.out(op, "Out", _ste(x, _quant_dequant(x, scale, bin_cnt)))
+    ctx.out(op, "OutScale", scale.reshape(1))
+    ctx.out(op, "OutState", s2.reshape(1))
+    ctx.out(op, "OutAccum", a2.reshape(1))
+
+
+@register("moving_average_abs_max_scale")
+def _moving_average_abs_max_scale(ctx, op):
+    jax = _jax()
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    rate = float(op.attrs.get("moving_rate", 0.9))
+    cur = jax.lax.stop_gradient(jnp.abs(x).max())
+    accum = ctx.inp(op, "InAccum")
+    state = ctx.inp(op, "InState")
+    a = accum.reshape(()) if accum is not None else jnp.ones((), "f4")
+    s = state.reshape(()) if state is not None else jnp.ones((), "f4")
+    if op.attrs.get("is_test", False):
+        scale = a / s
+        s2, a2 = s, a
+    else:
+        s2 = rate * s + 1.0
+        a2 = rate * a + cur
+        scale = a2 / s2
+    ctx.out(op, "Out", x)
+    ctx.out(op, "OutScale", scale.reshape(1))
+    ctx.out(op, "OutState", s2.reshape(1))
+    ctx.out(op, "OutAccum", a2.reshape(1))
+
+
+@register("fake_channel_wise_quantize_abs_max")
+def _fake_channel_wise_quantize(ctx, op):
+    jax = _jax()
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    bits = int(op.attrs.get("bit_length", 8))
+    axis = int(op.attrs.get("quant_axis", 0))
+    bin_cnt = 2 ** (bits - 1) - 1
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jax.lax.stop_gradient(jnp.abs(x).max(axis=axes))
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    ctx.out(op, "Out",
+            _ste(x, _quant_dequant(x, scale.reshape(shape), bin_cnt)))
+    ctx.out(op, "OutScale", scale)
+
+
+@register("fake_dequantize_max_abs")
+def _fake_dequantize_max_abs(ctx, op):
+    x = ctx.inp(op, "X")
+    scale = ctx.inp(op, "Scale").reshape(())
+    max_range = float(op.attrs.get("max_range", 127.0))
+    ctx.out(op, "Out", x.astype("float32") * scale / max_range)
+
+
+@register("fake_channel_wise_dequantize_max_abs")
+def _fake_channel_wise_dequantize(ctx, op):
+    jnp = _jnp()
+    x = ctx.inp(op, "X")
+    scales = ctx.inps(op, "Scales")
+    bits = [int(b) for b in op.attrs.get("quant_bits", [8])]
+    axis = int(op.attrs.get("quant_axis", 0))
+    s0 = scales[0].reshape(-1)
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    out = x.astype("float32") * s0.reshape(shape) / (2 ** (bits[0] - 1)
+                                                     - 1)
+    if len(scales) > 1 and scales[1] is not None:
+        out = out * scales[1].reshape(()) / (2 ** (bits[1] - 1) - 1)
+    ctx.out(op, "Out", out)
+
+
+@register("dequantize_abs_max")
+def _dequantize_abs_max(ctx, op):
+    x = ctx.inp(op, "X")
+    scale = ctx.inp(op, "Scale").reshape(())
+    max_range = float(op.attrs.get("max_range", 127.0))
+    ctx.out(op, "Out", x.astype("float32") * scale / max_range)
+
+
+@register("dequantize_log")
+def _dequantize_log(ctx, op):
+    # dequantize_log_op.cc: int8 codes index a 128-entry dictionary;
+    # negative codes mirror with sign (log-quantized embedding tables)
+    jnp = _jnp()
+    x = ctx.inp(op, "X").astype("int32")
+    d = ctx.inp(op, "Dict").reshape(-1)
+    neg = x < 0
+    idx = jnp.where(neg, x + 128, x)
+    vals = d[jnp.clip(idx, 0, d.shape[0] - 1)]
+    ctx.out(op, "Out", jnp.where(neg, -vals, vals))
+
+
+@register("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx, op):
+    # bilinear_tensor_product_op.cc:147: out[b,s] = x_b^T W_s y_b + b_s
+    jnp = _jnp()
+    x = ctx.inp(op, "X")          # [B, M]
+    y = ctx.inp(op, "Y")          # [B, N]
+    w = ctx.inp(op, "Weight")     # [S, M, N]
+    bias = ctx.inp(op, "Bias")    # [1, S]
+    out = jnp.einsum("bm,smn,bn->bs", x, w, y)
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    ctx.out(op, "Out", out)
+
+
+@register("fused_sdpa")
+def _fused_sdpa(ctx, op):
+    """Target of multihead_matmul_fuse_pass: scaled-dot-product attention
+    over [B, H, T, D] (or [B*H, T, D]) tensors, dispatching to the
+    pallas-flash/XLA-fused path (ops/attention.py sdpa)."""
+    from ..ops import attention as A
+
+    jnp = _jnp()
+    q = ctx.inp(op, "Q")
+    k = ctx.inp(op, "K")
+    v = ctx.inp(op, "V")
+    mask = ctx.inp(op, "Mask")
+    scale = float(op.attrs.get("scale", 1.0))
+    squeeze = False
+    if q.ndim == 3:  # [B*H, T, D]: lift to 4-D for the kernel
+        q, k, v = (t[None] for t in (q, k, v))
+        if mask is not None and mask.ndim == 3:
+            mask = mask[None]
+        squeeze = True
+    # sdpa applies scale to q @ k^T itself; the pass folded the program's
+    # scale/alpha into `scale`
+    out = A.sdpa(q, k, v, mask=mask, scale=scale)
+    ctx.out(op, "Out", out[0] if squeeze else out)

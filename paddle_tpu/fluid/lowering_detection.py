@@ -422,3 +422,171 @@ def _generate_mask_labels(ctx, op):
             op.attrs.get("num_classes", 81)))
     ctx.out(op, "MaskRois", rois)
     ctx.out(op, "MaskInt32", jnp.stack(outs))
+
+
+@register("density_prior_box")
+def _density_prior_box(ctx, op):
+    x = ctx.inp(op, "Input")
+    img = ctx.inp(op, "Image")
+    boxes, var = D.density_prior_box(
+        (x.shape[2], x.shape[3]), (img.shape[2], img.shape[3]),
+        [float(v) for v in op.attrs.get("fixed_sizes", [])],
+        [float(v) for v in op.attrs.get("fixed_ratios", [])],
+        [int(v) for v in op.attrs.get("densities", [])],
+        variances=[float(v) for v in op.attrs.get(
+            "variances", (0.1, 0.1, 0.2, 0.2))],
+        steps=(float(op.attrs.get("step_h", 0.0)),
+               float(op.attrs.get("step_w", 0.0))),
+        offset=float(op.attrs.get("offset", 0.5)),
+        clip=op.attrs.get("clip", False))
+    ctx.out(op, "Boxes", boxes)
+    ctx.out(op, "Variances", var)
+
+
+@register("polygon_box_transform")
+def _polygon_box_transform(ctx, op):
+    # detection/polygon_box_transform_op.cc: EAST geometry maps — even
+    # channels become 4*w_index - v, odd channels 4*h_index - v
+    import jax.numpy as jnp
+
+    x = ctx.inp(op, "Input")  # [N, geo_c, H, W]
+    N, C, H, W = x.shape
+    wi = jnp.arange(W, dtype=x.dtype)[None, None, None, :]
+    hi = jnp.arange(H, dtype=x.dtype)[None, None, :, None]
+    even = jnp.arange(C)[None, :, None, None] % 2 == 0
+    ctx.out(op, "Output", jnp.where(even, 4.0 * wi - x, 4.0 * hi - x))
+
+
+@register("box_decoder_and_assign")
+def _box_decoder_and_assign(ctx, op):
+    # detection/box_decoder_and_assign_op.cc: decode per-class deltas
+    # against priors, then assign each roi its best-scoring class's box
+    import jax.numpy as jnp
+
+    prior = ctx.inp(op, "PriorBox")            # [N, 4]
+    pvar = ctx.inp(op, "PriorBoxVar")          # [N, 4]
+    deltas = ctx.inp(op, "TargetBox")          # [N, C*4]
+    score = ctx.inp(op, "BoxScore")            # [N, C]
+    clip = float(op.attrs.get("box_clip", 4.135))
+    N, C = score.shape
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    pcx = prior[:, 0] + 0.5 * pw
+    pcy = prior[:, 1] + 0.5 * ph
+    d = deltas.reshape(N, C, 4) * pvar[:, None, :]
+    cx = d[..., 0] * pw[:, None] + pcx[:, None]
+    cy = d[..., 1] * ph[:, None] + pcy[:, None]
+    w = jnp.exp(jnp.minimum(d[..., 2], clip)) * pw[:, None]
+    h = jnp.exp(jnp.minimum(d[..., 3], clip)) * ph[:, None]
+    dec = jnp.stack([cx - w / 2, cy - h / 2,
+                     cx + w / 2 - 1, cy + h / 2 - 1], axis=-1)
+    ctx.out(op, "DecodeBox", dec.reshape(N, C * 4))
+    best = score.argmax(axis=1)
+    ctx.out(op, "OutputAssignBox", dec[jnp.arange(N), best])
+
+
+@register("locality_aware_nms")
+def _locality_aware_nms(ctx, op):
+    # detection/locality_aware_nms_op.cc (EAST): merge heavily-
+    # overlapping detections weighted by score, then standard
+    # multiclass NMS. Static form: each NMS survivor becomes the
+    # score-weighted centroid of every box it suppressed.
+    import jax.numpy as jnp
+
+    bboxes = ctx.inp(op, "BBoxes")   # [B, N, 4]
+    scores = ctx.inp(op, "Scores")   # [B, C, N]
+    thr = op.attrs.get("nms_threshold", 0.3)
+    keep_top_k = op.attrs.get("keep_top_k", 100)
+    outs, nums = [], []
+    for b in range(bboxes.shape[0]):
+        box = bboxes[b]
+        sc = scores[b]
+        C, N = sc.shape
+        bg = op.attrs.get("background_label", 0)
+        normalized = op.attrs.get("normalized", True)
+        iou = D.iou_matrix(box, box, normalized)
+        w = iou > thr                      # merge neighborhoods
+        # per-class score-weighted merge feeding per-class NMS: class c's
+        # geometry must only be averaged by class c's own scores
+        rows_all = []
+        for c in range(C):
+            if c == bg:
+                continue
+            sw = jnp.where(w, sc[c][None, :], 0.0)
+            tot = jnp.maximum(sw.sum(1, keepdims=True), 1e-8)
+            mb = (sw @ box) / tot
+            keep, cnt = D.nms(
+                mb, sc[c], thr,
+                op.attrs.get("score_threshold", 0.05),
+                min(op.attrs.get("nms_top_k", 64), N), normalized)
+            k = keep.shape[0]
+            sel = jnp.clip(keep, 0, N - 1)
+            valid = (jnp.arange(k) < cnt) & (keep >= 0)
+            rows = jnp.concatenate([
+                jnp.full((k, 1), c, jnp.float32),
+                sc[c][sel][:, None].astype(jnp.float32),
+                mb[sel].astype(jnp.float32)], axis=1)
+            rows_all.append(jnp.where(valid[:, None], rows, -1.0))
+        allrows = jnp.concatenate(rows_all, axis=0) if rows_all else \
+            jnp.full((1, 6), -1.0, jnp.float32)
+        key = jnp.where(allrows[:, 0] >= 0, allrows[:, 1], -jnp.inf)
+        K = int(keep_top_k)
+        top = jnp.argsort(-key)[:K]
+        ok = jnp.isfinite(key[top])
+        o = jnp.where(ok[:, None], allrows[top], -1.0)
+        pad = K - o.shape[0]
+        if pad > 0:
+            o = jnp.concatenate(
+                [o, jnp.full((pad, 6), -1.0, jnp.float32)], axis=0)
+        outs.append(o)
+        nums.append(ok.sum().astype(jnp.int32))
+    ctx.out(op, "Out", jnp.concatenate(outs, axis=0))
+    ctx.out(op, "RoisNum" if op.output("RoisNum") else "Index",
+            jnp.stack(nums))
+
+
+@register("retinanet_detection_output")
+def _retinanet_detection_output(ctx, op):
+    # detection/retinanet_detection_output_op.cc: per-FPN-level top-k of
+    # sigmoid scores above threshold, decode vs anchors, then per-class
+    # NMS across levels
+    import jax.numpy as jnp
+
+    blist = ctx.inps(op, "BBoxes")    # per level [B, A_l, 4] deltas
+    slist = ctx.inps(op, "Scores")    # per level [B, A_l, C] logits
+    alist = ctx.inps(op, "Anchors")   # per level [A_l, 4]
+    im_info = ctx.inp(op, "ImInfo")
+    thr = float(op.attrs.get("score_threshold", 0.05))
+    nms_top_k = int(op.attrs.get("nms_top_k", 1000))
+    keep_top_k = int(op.attrs.get("keep_top_k", 100))
+    nms_thr = float(op.attrs.get("nms_threshold", 0.3))
+    B = blist[0].shape[0]
+    C = slist[0].shape[-1]
+    outs, nums = [], []
+    for b in range(B):
+        boxes_lv, scores_lv = [], []
+        for deltas, logits, anchors in zip(blist, slist, alist):
+            sc = 1.0 / (1.0 + jnp.exp(-logits[b]))        # [A, C]
+            best = sc.max(axis=1)
+            k = min(nms_top_k, best.shape[0])
+            top = jnp.argsort(-best)[:k]
+            dec = DT.decode_proposals(anchors.reshape(-1, 4)[top],
+                                      deltas[b][top])
+            h, w = im_info[b][0], im_info[b][1]
+            dec = jnp.stack([jnp.clip(dec[:, 0], 0, w - 1),
+                             jnp.clip(dec[:, 1], 0, h - 1),
+                             jnp.clip(dec[:, 2], 0, w - 1),
+                             jnp.clip(dec[:, 3], 0, h - 1)], 1)
+            svalid = jnp.where(sc[top] >= thr, sc[top], 0.0)
+            boxes_lv.append(dec)
+            scores_lv.append(svalid)
+        allb = jnp.concatenate(boxes_lv, axis=0)
+        alls = jnp.concatenate(scores_lv, axis=0)     # [K, C]
+        o, n = D.multiclass_nms(
+            allb, alls.T, thr, nms_top_k, keep_top_k, nms_thr,
+            False, -1)
+        outs.append(o)
+        nums.append(n)
+    ctx.out(op, "Out", jnp.concatenate(outs, axis=0))
+    ctx.out(op, "NmsRoisNum" if op.output("NmsRoisNum") else "Index",
+            jnp.stack(nums))

@@ -97,6 +97,12 @@ class _PsTrainerHook:
         self.sync_mode = sync_mode
         self.geo_k = geo_k
         self.comm = None
+        # set by dataset_runner._PsWorkerPlane (train_from_dataset PS
+        # mode): grads are enqueued for the engine's push thread and the
+        # engine's pull-dense thread refreshes params — the hook itself
+        # never blocks on a readback or RPC
+        self._engine_q = None
+        self._engine_plane = None
 
     def _ensure_comm(self, scope):
         if self.comm is not None:
@@ -121,6 +127,26 @@ class _PsTrainerHook:
         self._ensure_comm(scope)
         import jax.numpy as jnp
 
+        if self._engine_q is not None:
+            # Downpour worker plane: hand the DEVICE grad handles to the
+            # push thread (it does np.asarray + RPC); dense pulls arrive
+            # via the engine's pull-dense thread
+            grads = {}
+            for p in self.param_names:
+                g = scope._values.get(self.grad_map[p])
+                if g is not None:
+                    # device copy (async, ~free): the NEXT exe.run
+                    # donates persistable buffers, which would invalidate
+                    # the raw handle before the push thread reads it
+                    grads[p] = jnp.copy(g) if hasattr(g, "devices") \
+                        else g
+            self._engine_q.put(grads)
+            # apply whatever the pull-dense thread staged since the last
+            # step (post-writeback, so the executor can't clobber it)
+            if self._engine_plane is not None:
+                for p, v in self._engine_plane.take_fresh().items():
+                    scope._values[p] = jnp.asarray(v)
+            return
         if self.geo_k:
             params = {p: np.asarray(scope._values[p])
                       for p in self.param_names}

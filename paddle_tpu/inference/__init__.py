@@ -113,7 +113,11 @@ class Predictor:
             from ..fluid import executor as _fx
             from ..fluid.ir import apply_pass
 
-            apply_pass(prog, ["delete_dropout_pass", "fc_fuse_pass"])
+            apply_pass(prog, ["delete_dropout_pass",
+                              "multihead_matmul_fuse_pass",
+                              "conv_elementwise_add_act_fuse_pass",
+                              "fc_gru_fuse_pass", "fc_lstm_fuse_pass",
+                              "fc_fuse_pass"])
             try:
                 apply_pass(prog, "conv_bn_fuse_pass",
                            scope=_fx.global_scope())

@@ -374,11 +374,18 @@ class DevicePrefetcher:
                         return
                     put = (lambda a: jax.device_put(a, sharding)) \
                         if sharding is not None else jax.device_put
-                    dev = jax.tree_util.tree_map(
-                        lambda a: put(np.asarray(a))
-                        if isinstance(a, np.ndarray) or np.isscalar(a)
-                        or hasattr(a, "__array__") else a, batch)
-                    _put(dev)
+
+                    def place(a):
+                        if isinstance(a, jax.Array):
+                            # already device-resident: device_put moves/
+                            # reshards WITHOUT a host round-trip
+                            return put(a)
+                        if isinstance(a, np.ndarray) or np.isscalar(a) \
+                                or hasattr(a, "__array__"):
+                            return put(np.asarray(a))
+                        return a
+
+                    _put(jax.tree_util.tree_map(place, batch))
             except BaseException as e:  # surfaced on the consumer side
                 self._err = e
             finally:

@@ -102,8 +102,18 @@ class MultiprocessIter:
     def __init__(self, dataset, batches, collate_fn, num_workers,
                  prefetch_factor=2, worker_init_fn=None, timeout=0,
                  iterable=False, batch_size=1, seed=0, drop_last=False):
-        self._ctx = mp.get_context("fork" if hasattr(mp, "get_context")
-                                   else None)
+        # spawn-family start methods only: fork would duplicate JAX's
+        # runtime threads into the worker (deadlock risk — the reference
+        # hit the same with CUDA, multiprocess_utils.py). forkserver
+        # amortises interpreter startup; PT_DATALOADER_START_METHOD
+        # overrides for debugging.
+        import os as _os
+
+        method = _os.environ.get("PT_DATALOADER_START_METHOD")
+        if method is None:
+            method = "forkserver" if "forkserver" in \
+                mp.get_all_start_methods() else "spawn"
+        self._ctx = mp.get_context(method)
         self._result_queue = self._ctx.Queue()
         self._workers = []
         self._index_queues = []

@@ -9,10 +9,21 @@ flow when the predicate is concrete, `lax.cond`/`lax.while_loop` when it
 is a traced value — so one converted function works eagerly AND under
 jax.jit/jax.export with data-dependent branching.
 
-Supported: `if`/`elif`/`else` and `while` whose bodies have no
-`break`/`continue`/`return` (those keep Python semantics and therefore
-need concrete predicates, as in the reference's unsupported cases);
-`for` over concrete iterables needs no transform (tracing unrolls it).
+Transform pipeline (each a reference transformer's TPU counterpart):
+  1. _ForToWhileTransformer — `for i in range(...)` / `for x in tensor`
+     become while loops (loop_transformer.py), increment-first so
+     continue-guards cannot skip it;
+  2. _EarlyExitTransformer — `break`/`continue` become guard flags and
+     loop `return`s a single-exit flag+value pair
+     (break_continue_transformer.py, return_transformer.py), leaving
+     loops escape-free;
+  3. _LogicalTransformer — and/or/not become runtime __jst_* calls that
+     stay correct on traced booleans (logical_transformer.py);
+  4. _ControlFlowTransformer — if/while become __jst_cond/__jst_while
+     runtime-dispatch calls (lax.cond / lax.while_loop when traced).
+Caveat: `return` inside a loop whose trip count is TRACED would need a
+pre-known return structure for the lax carry; with concrete (trace-time)
+bounds — the common dygraph pattern — it stages fine.
 """
 from __future__ import annotations
 
@@ -150,6 +161,53 @@ def while_loop(cond_fn, body_fn, carry):
     return _wrap_outputs(out_raw)
 
 
+def _rt_indexable(it):
+    """Iterables without __getitem__ (dict views, generators) materialize
+    to a list so the for->while index rewrite can subscript them."""
+    return it if hasattr(it, "__getitem__") else list(it)
+
+
+def _rt_not(x):
+    """`not` that stays correct on traced/array booleans
+    (logical_transformer.py convert_logical_not)."""
+    traced, raw = _is_traced_bool(x)
+    if traced:
+        import jax.numpy as jnp
+
+        return jnp.logical_not(raw)
+    if hasattr(raw, "dtype"):
+        import numpy as np
+
+        return np.logical_not(raw)
+    return not raw
+
+
+def _rt_bool(fn_a, fn_b, op_name):
+    """Short-circuiting and/or over lazily-evaluated operands; traced
+    operands combine via jnp.logical_* (both sides evaluated, as in the
+    reference's convert_logical_and)."""
+    a = fn_a()
+    ta, ra = _is_traced_bool(a)
+    if not ta and not hasattr(ra, "dtype"):
+        if op_name == "and" and not ra:
+            return ra
+        if op_name == "or" and ra:
+            return ra
+    b = fn_b()
+    tb, rb = _is_traced_bool(b)
+    if ta or tb:
+        import jax.numpy as jnp
+
+        return (jnp.logical_and if op_name == "and"
+                else jnp.logical_or)(ra, rb)
+    if hasattr(ra, "dtype") or hasattr(rb, "dtype"):
+        import numpy as np
+
+        return (np.logical_and if op_name == "and"
+                else np.logical_or)(ra, rb)
+    return (ra and rb) if op_name == "and" else (ra or rb)
+
+
 _JST = {"cond": cond, "while_loop": while_loop, "opt": _opt,
         "UNDEF": UNDEF}
 
@@ -158,8 +216,14 @@ class _NameCollector(ast.NodeVisitor):
     def __init__(self):
         self.names = []
 
+    _HELPERS = ("__jst_true_", "__jst_false_", "__jst_wcond_",
+                "__jst_wbody_", "__jst_carry")  # carry param name is
+    # chosen to never prefix-collide with data flags (__jst_cont_*!)
+
     def _add(self, n):
-        if n not in self.names and not n.startswith("__jst"):
+        # generated helper FUNCTIONS never join a carry; generated data
+        # names (__jst_it/brk/cont/ret/seq/stop/step) must
+        if n not in self.names and not n.startswith(self._HELPERS):
             self.names.append(n)
 
     def visit_Name(self, node):
@@ -234,13 +298,13 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         if names:
             stmts.append(ast.Assign(
                 targets=[self._tuple(names, ast.Store)],
-                value=ast.Name(id="__jst_c", ctx=ast.Load())))
+                value=ast.Name(id="__jst_carry", ctx=ast.Load())))
         stmts.extend(body)
         stmts.append(ast.Return(value=self._tuple(names, ast.Load)))
         return ast.FunctionDef(
             name=fname,
             args=ast.arguments(posonlyargs=[], args=[
-                ast.arg(arg="__jst_c")], kwonlyargs=[], kw_defaults=[],
+                ast.arg(arg="__jst_carry")], kwonlyargs=[], kw_defaults=[],
                 defaults=[]),
             body=stmts, decorator_list=[])
 
@@ -296,12 +360,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         if names:
             cond_stmts.append(ast.Assign(
                 targets=[self._tuple(names, ast.Store)],
-                value=ast.Name(id="__jst_c", ctx=ast.Load())))
+                value=ast.Name(id="__jst_carry", ctx=ast.Load())))
         cond_stmts.append(ast.Return(value=node.test))
         cfn = ast.FunctionDef(
             name=f"__jst_wcond_{k}",
             args=ast.arguments(posonlyargs=[], args=[
-                ast.arg(arg="__jst_c")], kwonlyargs=[], kw_defaults=[],
+                ast.arg(arg="__jst_carry")], kwonlyargs=[], kw_defaults=[],
                 defaults=[]),
             body=cond_stmts, decorator_list=[])
         bfn = self._branch_fn(f"__jst_wbody_{k}", names, node.body)
@@ -314,6 +378,298 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                       self._opt_tuple(names)],
                 keywords=[]))
         return [cfn, bfn, call]
+
+
+def _name(n, ctx=ast.Load):
+    return ast.Name(id=n, ctx=ctx())
+
+
+def _assign(target, value):
+    return ast.Assign(targets=[_name(target, ast.Store)], value=value)
+
+
+def _const(v):
+    return ast.Constant(value=v)
+
+
+def _not(expr):
+    return ast.UnaryOp(op=ast.Not(), operand=expr)
+
+
+def _and(*exprs):
+    exprs = [e for e in exprs if e is not None]
+    if len(exprs) == 1:
+        return exprs[0]
+    return ast.BoolOp(op=ast.And(), values=list(exprs))
+
+
+class _ForToWhileTransformer(ast.NodeTransformer):
+    """LoopTransformer's for-range half (dygraph_to_static/
+    loop_transformer.py): `for i in range(...)` and `for x in tensor`
+    become while loops so traced trip counts hit lax.while_loop. The
+    iterator increments FIRST inside the body (starting one step back),
+    so a later `continue`-guard rewrite cannot skip it."""
+
+    def __init__(self):
+        self.counter = 0
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            return node
+        k = self.counter
+        it, stop, step = f"__jst_it_{k}", f"__jst_stop_{k}", \
+            f"__jst_step_{k}"
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and 1 <= len(node.iter.args) <= 3
+                    and not node.iter.keywords)
+        prelude = []
+        if is_range:
+            a = node.iter.args
+            start = a[0] if len(a) >= 2 else _const(0)
+            stop_e = a[1] if len(a) >= 2 else a[0]
+            step_e = a[2] if len(a) == 3 else _const(1)
+            if len(a) == 3 and not (isinstance(step_e, ast.Constant)
+                                    and isinstance(step_e.value, int)
+                                    and step_e.value > 0):
+                return node  # non-positive/dynamic step: keep python for
+            assigns = [_assign(it, ast.BinOp(left=start, op=ast.Sub(),
+                                             right=_name(step)))]
+            bind = [ast.Assign(targets=[node.target],
+                               value=_name(it))]
+        elif isinstance(node.target, ast.Name):
+            # for x in seq: iterate the leading axis by index (tensor
+            # iteration unrolls statically only via len(), which is a
+            # static shape even for traced arrays)
+            seq = f"__jst_seq_{k}"
+            prelude.append(_assign(seq, ast.Call(
+                func=_name("__jst_indexable"), args=[node.iter],
+                keywords=[])))
+            start = _const(0)
+            stop_e = ast.Call(func=_name("len"), args=[_name(seq)],
+                              keywords=[])
+            step_e = _const(1)
+            assigns = [_assign(it, _const(-1))]
+            bind = [ast.Assign(
+                targets=[node.target],
+                value=ast.Subscript(value=_name(seq),
+                                    slice=_name(it), ctx=ast.Load()))]
+        else:
+            return node
+        self.counter += 1
+        prelude.extend([
+            _assign(stop, stop_e),
+            _assign(step, step_e),
+        ] + assigns)
+        body = [ast.AugAssign(target=_name(it, ast.Store),
+                              op=ast.Add(), value=_name(step))] \
+            + bind + node.body
+        test = ast.Compare(
+            left=ast.BinOp(left=_name(it), op=ast.Add(),
+                           right=_name(step)),
+            ops=[ast.Lt()], comparators=[_name(stop)])
+        return prelude + [ast.While(test=test, body=body, orelse=[])]
+
+
+def _contains(stmts, kinds, cross_loops=False):
+    """Any of `kinds` in these statements, not descending into nested
+    function/class scopes, and (unless cross_loops) not into nested
+    loops (whose break/continue bind tighter; returns DO escape)."""
+    want_return = (ast.Return in kinds) if isinstance(kinds, tuple) \
+        else kinds is ast.Return
+    for s in stmts if isinstance(stmts, list) else [stmts]:
+        if isinstance(s, kinds):
+            return True
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        if not cross_loops and isinstance(s, (ast.While, ast.For)):
+            if want_return and _contains(s.body, ast.Return,
+                                         cross_loops=True):
+                return True
+            continue
+        for child in ast.iter_child_nodes(s):
+            if _contains([child], kinds, cross_loops):
+                return True
+    return False
+
+
+class _EarlyExitTransformer(ast.NodeTransformer):
+    """break_continue_transformer.py + return_transformer.py in one
+    pass: rewrite `break`/`continue` into guard flags and loop-returns
+    into a single-exit form, so the loops become escape-free and the
+    cond/while transformer can stage them onto lax control flow."""
+
+    RET_FLAG = "__jst_ret_flag"
+    RET_VAL = "__jst_ret_val"
+
+    def __init__(self):
+        self.counter = 0
+        self.uses_return = False
+
+    # -- statement-list guarding ------------------------------------
+    def _sets_flags(self, s, flags):
+        for node in ast.walk(s):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id in flags:
+                        return True
+        return False
+
+    def _guard_rest(self, stmts, flags):
+        """After any compound statement that may set a guard flag, wrap
+        the remaining statements in `if not (f1 or f2 ...)`."""
+        out = []
+        for i, s in enumerate(stmts):
+            out.append(s)
+            rest = stmts[i + 1:]
+            if rest and not isinstance(s, (ast.Break, ast.Continue,
+                                           ast.Return)) \
+                    and self._sets_flags(s, flags):
+                cond = _not(ast.BoolOp(
+                    op=ast.Or(),
+                    values=[_name(f) for f in sorted(flags)])
+                    if len(flags) > 1 else _name(next(iter(flags))))
+                out.append(ast.If(test=cond,
+                                  body=self._guard_rest(rest, flags),
+                                  orelse=[]))
+                return out
+        return out
+
+    def _replace_escapes(self, stmts, brk, cont, in_loop):
+        """Replace break/continue/return statements with flag sets (not
+        descending into nested loops for break/continue, nor nested
+        scopes at all)."""
+        new = []
+        for s in stmts:
+            if isinstance(s, ast.Break) and brk:
+                new.append(_assign(brk, _const(True)))
+            elif isinstance(s, ast.Continue) and cont:
+                new.append(_assign(cont, _const(True)))
+            elif isinstance(s, ast.Return) and in_loop \
+                    and self.uses_return:
+                new.append(_assign(self.RET_VAL,
+                                   s.value or _const(None)))
+                new.append(_assign(self.RET_FLAG, _const(True)))
+            elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                new.append(s)
+            elif isinstance(s, (ast.While, ast.For)):
+                # nested loop: its own break/continue bind to it; only
+                # returns keep propagating (handled when it is visited)
+                new.append(s)
+            elif isinstance(s, ast.If):
+                s.body = self._replace_escapes(s.body, brk, cont,
+                                               in_loop)
+                s.orelse = self._replace_escapes(s.orelse, brk, cont,
+                                                 in_loop)
+                new.append(s)
+            else:
+                new.append(s)
+        return new
+
+    def visit_While(self, node):
+        self.generic_visit(node)  # inner loops first
+        has_brk = _contains(node.body, ast.Break)
+        has_cont = _contains(node.body, ast.Continue)
+        has_ret = self.uses_return and _contains(
+            node.body, ast.Return, cross_loops=True)
+        if not (has_brk or has_cont or has_ret):
+            return node
+        k = self.counter
+        self.counter += 1
+        brk = f"__jst_brk_{k}" if (has_brk or has_ret) else None
+        cont = f"__jst_cont_{k}" if has_cont else None
+        body = self._replace_escapes(node.body, brk, cont, True)
+        flags = set()
+        if brk:
+            flags.add(brk)
+        if cont:
+            flags.add(cont)
+        if has_ret:
+            flags.add(self.RET_FLAG)
+        body = self._guard_rest(body, flags)
+        if cont:
+            body = [_assign(cont, _const(False))] + body
+        prelude = []
+        test = node.test
+        if cont:
+            # also initialized BEFORE the loop: a traced lax.while_loop
+            # needs every carried name bound in the initial carry
+            prelude.append(_assign(cont, _const(False)))
+        if brk:
+            prelude.append(_assign(brk, _const(False)))
+            test = _and(_not(_name(brk)), test)
+        if has_ret:
+            test = _and(_not(_name(self.RET_FLAG)), test)
+        return prelude + [ast.While(test=test, body=body, orelse=[])]
+
+    def apply(self, fdef):
+        # single-exit rewrite only when a loop contains a return
+        loops = [n for n in ast.walk(fdef)
+                 if isinstance(n, (ast.While, ast.For))]
+        self.uses_return = any(
+            _contains(lp.body, ast.Return, cross_loops=True)
+            for lp in loops)
+        if self.uses_return:
+            # replace every top-level-reachable return with flag sets,
+            # then a single trailing return
+            def repl_fn_returns(stmts):
+                new = []
+                for s in stmts:
+                    if isinstance(s, ast.Return):
+                        new.append(_assign(self.RET_VAL,
+                                           s.value or _const(None)))
+                        new.append(_assign(self.RET_FLAG, _const(True)))
+                    elif isinstance(s, ast.If):
+                        s.body = repl_fn_returns(s.body)
+                        s.orelse = repl_fn_returns(s.orelse)
+                        new.append(s)
+                    else:
+                        new.append(s)
+                return new
+
+            fdef.body = repl_fn_returns(fdef.body)
+        self.visit(fdef)
+        if self.uses_return:
+            fdef.body = [
+                _assign(self.RET_FLAG, _const(False)),
+                _assign(self.RET_VAL, _const(None)),
+            ] + self._guard_rest(fdef.body, {self.RET_FLAG}) + [
+                ast.Return(value=_name(self.RET_VAL))]
+        return fdef
+
+
+class _LogicalTransformer(ast.NodeTransformer):
+    """and/or/not -> runtime __jst_and/__jst_or/__jst_not calls so
+    boolean logic works on traced values (the reference's
+    logical_transformer.py). Operands stay lazily evaluated via lambdas
+    to preserve python short-circuiting."""
+
+    def _lam(self, expr):
+        return ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=expr)
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        name = "__jst_and" if isinstance(node.op, ast.And) else "__jst_or"
+        out = node.values[0]
+        for nxt in node.values[1:]:
+            out = ast.Call(func=_name(name),
+                           args=[self._lam(out), self._lam(nxt)],
+                           keywords=[])
+        return out
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=_name("__jst_not"),
+                            args=[node.operand], keywords=[])
+        return node
 
 
 _CONVERTED = {}
@@ -353,6 +709,9 @@ def convert_to_static(fn):
         first_arg = fdef.args.args[0].arg if fdef.args.args else None
         sup = _SuperRewriter(first_arg)
         sup.visit(fdef)
+        fdef = _ForToWhileTransformer().visit(fdef)
+        fdef = _EarlyExitTransformer().apply(fdef)
+        fdef = _LogicalTransformer().visit(fdef)
         new = _ControlFlowTransformer().visit(fdef)
         mod = ast.Module(body=[new], type_ignores=[])
         ast.fix_missing_locations(mod)
@@ -372,6 +731,10 @@ def convert_to_static(fn):
         glb["__jst_cond"] = cond
         glb["__jst_while"] = while_loop
         glb["__jst_opt"] = _opt
+        glb["__jst_not"] = _rt_not
+        glb["__jst_indexable"] = _rt_indexable
+        glb["__jst_and"] = functools.partial(_rt_bool, op_name="and")
+        glb["__jst_or"] = functools.partial(_rt_bool, op_name="or")
         # closures: bind current cell values by name (static snapshot)
         if fn.__closure__:
             for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):

@@ -275,3 +275,95 @@ class CompositeMetric(Metric):
 
     def accumulate(self):
         return [m.accumulate() for m in self._metrics]
+
+
+class DetectionMAP(Metric):
+    """Streaming mean average precision for detection (reference
+    fluid/metrics.py DetectionMAP + operators/detection/detection_map_op
+    role, computed host-side from the static multiclass_nms outputs —
+    see EXCLUDED_OPS['detection_map']).
+
+    update(detections, gt_boxes, gt_labels, difficult=None) per image:
+      detections  [K, 6] rows [label, score, x1, y1, x2, y2] (padded
+                  rows with label < 0 are skipped — the static NMS form)
+      gt_boxes    [G, 4], gt_labels [G]
+    accumulate() -> mAP in [0, 1] over the stream so far.
+    """
+
+    def __init__(self, overlap_threshold=0.5, evaluate_difficult=False,
+                 ap_version="integral", name=None):
+        self._thr = float(overlap_threshold)
+        self._eval_difficult = evaluate_difficult
+        self._ap_version = ap_version
+        self._name = name or "detection_map"
+        self.reset()
+
+    def reset(self):
+        self._dets = {}     # label -> list of (score, matched)
+        self._npos = {}     # label -> #gt
+
+    def name(self):
+        return self._name
+
+    def update(self, detections, gt_boxes, gt_labels, difficult=None):
+        import numpy as np
+
+        det = _np(detections)
+        gtb = _np(gt_boxes).reshape(-1, 4)
+        gtl = _np(gt_labels).reshape(-1).astype(int)
+        diff = (_np(difficult).reshape(-1).astype(bool)
+                if difficult is not None
+                else np.zeros(len(gtl), bool))
+        for lab in np.unique(gtl):
+            n = ((gtl == lab) & (self._eval_difficult | ~diff)).sum()
+            self._npos[lab] = self._npos.get(lab, 0) + int(n)
+        det = det[det[:, 0] >= 0]
+        order = np.argsort(-det[:, 1])
+        taken = np.zeros(len(gtl), bool)
+        for row in det[order]:
+            lab = int(row[0])
+            box = row[2:6]
+            cand = np.where((gtl == lab) & ~taken)[0]
+            best, best_iou = -1, self._thr
+            for g in cand:
+                bb = gtb[g]
+                ix = max(0.0, min(box[2], bb[2]) - max(box[0], bb[0]))
+                iy = max(0.0, min(box[3], bb[3]) - max(box[1], bb[1]))
+                inter = ix * iy
+                ua = ((box[2] - box[0]) * (box[3] - box[1])
+                      + (bb[2] - bb[0]) * (bb[3] - bb[1]) - inter)
+                iou = inter / ua if ua > 0 else 0.0
+                if iou >= best_iou:
+                    best, best_iou = g, iou
+            matched = best >= 0
+            if matched:
+                if diff[best] and not self._eval_difficult:
+                    continue  # difficult matches are ignored entirely
+                taken[best] = True
+            self._dets.setdefault(lab, []).append(
+                (float(row[1]), bool(matched)))
+
+    def accumulate(self):
+        import numpy as np
+
+        aps = []
+        for lab, n_pos in self._npos.items():
+            if n_pos == 0:
+                continue
+            rows = sorted(self._dets.get(lab, []), reverse=True)
+            if not rows:
+                aps.append(0.0)
+                continue
+            tp = np.cumsum([m for _, m in rows])
+            fp = np.cumsum([not m for _, m in rows])
+            rec = tp / n_pos
+            prec = tp / np.maximum(tp + fp, 1)
+            if self._ap_version == "11point":
+                ap = float(np.mean([
+                    prec[rec >= t].max() if (rec >= t).any() else 0.0
+                    for t in np.linspace(0, 1, 11)]))
+            else:  # integral
+                ap = float(np.sum((rec[1:] - rec[:-1]) * prec[1:])
+                           + rec[0] * prec[0])
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0

@@ -705,13 +705,14 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
                    lambda a: K.interpolate_nearest(a, tuple(out)), t_)
     if mode == "bilinear" or (mode == "linear" and spatial == 2):
         return _op("interp_bilinear",
-                   lambda a: K.interpolate_bilinear(a, tuple(out),
-                                                    align_corners), t_)
+                   lambda a: K.interpolate_bilinear(
+                       a, tuple(out), align_corners, align_mode), t_)
     if mode in ("linear", "trilinear"):
         from ...fluid.lowering_batch3 import _linear_nd
 
         return _op("interp_linear",
-                   lambda a: _linear_nd(a, out, align_corners), t_)
+                   lambda a: _linear_nd(a, out, align_corners,
+                                        align_mode), t_)
     if mode == "bicubic":
         from ...fluid.lowering_batch3 import _cubic_nd
 

@@ -600,9 +600,14 @@ class SpectralNorm(Layer):
         from ...tensor import ops as T
 
         w = weight._data if hasattr(weight, "_data") else weight
-        return T.Tensor._wrap(K.spectral_normalize(
+        out, u_new, v_new = K.spectral_normalize(
             w, self.weight_u._data, self.weight_v._data, self._dim,
-            self._power_iters, self._eps))
+            self._power_iters, self._eps)
+        # persist the power-iteration state so sigma converges across
+        # steps (reference CalcMatrixSigmaAndNormWeight mutates U/V)
+        self.weight_u._data = u_new
+        self.weight_v._data = v_new
+        return T.Tensor._wrap(out)
 
 
 def _np_l2norm(a):

@@ -605,15 +605,16 @@ def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
     allrows = jnp.concatenate(rows, axis=0)
     allidx = jnp.concatenate(idxs, axis=0)
     keyv = jnp.where(allrows[:, 1] > 0, allrows[:, 1], -jnp.inf)
-    top = jnp.argsort(-keyv)[:int(keep_top_k)]
-    ok = jnp.isfinite(keyv[top])
-    out = jnp.where(ok[:, None], allrows[top], -1.0)
-    pad = int(keep_top_k) - out.shape[0]
+    K = int(keep_top_k)
+    top = jnp.argsort(-keyv)[:K]          # length T = min(total, K)
+    ok_t = jnp.isfinite(keyv[top])
+    out_t = jnp.where(ok_t[:, None], allrows[top], -1.0)
+    idx_t = jnp.where(ok_t, allidx[top], -1)
+    pad = K - out_t.shape[0]              # total rows may be < K
     if pad > 0:
-        out = jnp.concatenate(
-            [out, jnp.full((pad, 6), -1.0, jnp.float32)], axis=0)
-        ok = jnp.concatenate([ok, jnp.zeros((pad,), bool)], axis=0)
-    idx = jnp.where(ok, allidx[jnp.clip(top, 0, allidx.shape[0] - 1)], -1)
-    if pad > 0:
-        idx = idx[:int(keep_top_k)]
-    return out, idx, ok.sum().astype(jnp.int32)
+        out_t = jnp.concatenate(
+            [out_t, jnp.full((pad, 6), -1.0, jnp.float32)], axis=0)
+        idx_t = jnp.concatenate(
+            [idx_t, jnp.full((pad,), -1, idx_t.dtype)], axis=0)
+        ok_t = jnp.concatenate([ok_t, jnp.zeros((pad,), bool)], axis=0)
+    return out_t, idx_t.astype(jnp.int32), ok_t.sum().astype(jnp.int32)

@@ -376,3 +376,240 @@ class ImperativeQuantAware:
         from .. import jit
 
         jit.save(model, path, input_spec=input_spec)
+
+
+# ==========================================================================
+# Static-graph QAT: fake-quant ops in the program IR (VERDICT r02 #4)
+# ==========================================================================
+
+class QuantizationTransformPass:
+    """Insert fake-quant ops around quantizable ops in a TRAINING program
+    (reference contrib/slim/quantization/quantization_pass.py:211 +
+    operators/fake_quantize_op.cc:182).
+
+    Activations get `fake_quantize_moving_average_abs_max` (or abs_max /
+    range_abs_max) with persistable scale/state/accum vars that stream
+    across steps through the executor's persistable writeback; weights get
+    `fake_channel_wise_quantize_abs_max` (or abs_max). All quantizers are
+    straight-through estimators, so append_backward/minimize trains
+    through them unchanged — run the pass BEFORE minimize().
+
+    usage:
+        pass_ = QuantizationTransformPass(scope=scope)
+        pass_.apply(main_program)
+        opt.minimize(loss)             # backward sees the fake ops
+        ... train ...
+        QuantizationFreezePass(scope).apply(main_program)  # -> int8
+    """
+
+    def __init__(self, scope=None, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quantize_type="channel_wise_abs_max",
+                 moving_rate=0.9, window_size=10000,
+                 quantizable_op_type=QUANTIZABLE_OP_TYPES):
+        from ..fluid.executor import global_scope
+
+        self.scope = scope or global_scope()
+        self.wbits = int(weight_bits)
+        self.abits = int(activation_bits)
+        self.act_type = activation_quantize_type
+        self.weight_qtype = weight_quantize_type
+        self.moving_rate = float(moving_rate)
+        self.window_size = int(window_size)
+        self.op_types = tuple(quantizable_op_type)
+
+    # -- helpers -----------------------------------------------------------
+    def _state_var(self, blk, name, value):
+        if not blk.has_var(name):
+            v = blk.create_var(name=name, shape=[1], dtype="float32")
+            v.persistable = True
+        if self.scope.get_value(name) is None:
+            self.scope.set_value(name, np.full((1,), value, np.float32))
+        return name
+
+    def _insert_act_quant(self, blk, idx, name):
+        q = f"{name}.quantized"
+        blk.create_var(name=q)
+        scale = self._state_var(blk, f"{name}.quant_scale", 1.0)
+        if self.act_type == "moving_average_abs_max":
+            state = self._state_var(blk, f"{name}.quant_state", 1.0)
+            accum = self._state_var(blk, f"{name}.quant_accum", 1.0)
+            blk._insert_op(
+                idx, type="fake_quantize_moving_average_abs_max",
+                inputs={"X": [name], "InScale": [scale],
+                        "InState": [state], "InAccum": [accum]},
+                outputs={"Out": [q], "OutScale": [scale],
+                         "OutState": [state], "OutAccum": [accum]},
+                attrs={"bit_length": self.abits,
+                       "moving_rate": self.moving_rate})
+        elif self.act_type == "range_abs_max":
+            if not blk.has_var(f"{name}.quant_scales_arr"):
+                v = blk.create_var(name=f"{name}.quant_scales_arr",
+                                   shape=[self.window_size],
+                                   dtype="float32")
+                v.persistable = True
+            if self.scope.get_value(f"{name}.quant_scales_arr") is None:
+                self.scope.set_value(
+                    f"{name}.quant_scales_arr",
+                    np.zeros((self.window_size,), np.float32))
+            it = self._state_var(blk, f"{name}.quant_iter", 0.0)
+            blk._insert_op(
+                idx, type="fake_quantize_range_abs_max",
+                inputs={"X": [name], "InScale": [scale],
+                        "Iter": [it],
+                        "InScales": [f"{name}.quant_scales_arr"]},
+                outputs={"Out": [q], "OutScale": [scale],
+                         "OutScales": [f"{name}.quant_scales_arr"]},
+                attrs={"bit_length": self.abits,
+                       "window_size": self.window_size})
+        else:  # abs_max: stateless
+            blk._insert_op(
+                idx, type="fake_quantize_abs_max",
+                inputs={"X": [name]},
+                outputs={"Out": [q], "OutScale": [scale]},
+                attrs={"bit_length": self.abits})
+        return q
+
+    def _insert_weight_quant(self, blk, idx, name, ch_axis):
+        q = f"{name}.quantized"
+        blk.create_var(name=q)
+        scale = f"{name}.quant_scale_w"
+        if not blk.has_var(scale):
+            sv = blk.create_var(name=scale, dtype="float32")
+            sv.persistable = True
+        if self.weight_qtype == "channel_wise_abs_max":
+            blk._insert_op(
+                idx, type="fake_channel_wise_quantize_abs_max",
+                inputs={"X": [name]},
+                outputs={"Out": [q], "OutScale": [scale]},
+                attrs={"bit_length": self.wbits, "quant_axis": ch_axis})
+        else:
+            blk._insert_op(
+                idx, type="fake_quantize_abs_max",
+                inputs={"X": [name]},
+                outputs={"Out": [q], "OutScale": [scale]},
+                attrs={"bit_length": self.wbits})
+        return q
+
+    # ----------------------------------------------------------------------
+    def apply(self, program):
+        blk = program.global_block()
+        done = {}          # original name -> quantized name
+        i = 0
+        while i < len(blk.ops):
+            op = blk.ops[i]
+            if op.type in self.op_types and op.type in _OP_SLOTS:
+                a_slot, w_slot, ch = _OP_SLOTS[op.type]
+                if op.input(a_slot) and op.input(w_slot):
+                    a = op.input(a_slot)[0]
+                    w = op.input(w_slot)[0]
+                    if a.endswith(".quantized") or \
+                            w.endswith(".quantized"):
+                        i += 1
+                        continue
+                    if a not in done:
+                        done[a] = self._insert_act_quant(blk, i, a)
+                        i += 1
+                    if w not in done:
+                        done[w] = self._insert_weight_quant(blk, i, w,
+                                                            ch)
+                        i += 1
+                    op.inputs[a_slot] = [done[a]]
+                    op.inputs[w_slot] = [done[w]]
+            i += 1
+        return program
+
+
+_FAKE_QUANT_TYPES = (
+    "fake_quantize_abs_max", "fake_quantize_range_abs_max",
+    "fake_quantize_moving_average_abs_max",
+    "fake_channel_wise_quantize_abs_max", "moving_average_abs_max_scale")
+
+
+class QuantizationFreezePass:
+    """Convert a QAT-trained program into the deployable int8 form
+    (reference quantization_pass.py QuantizationFreezePass): drop the
+    fake-quant ops, bake the streamed activation scales and the final
+    per-channel weight scales into `quantized_*` op attrs, store int8
+    weights in the scope."""
+
+    def __init__(self, scope=None, weight_bits=8,
+                 weight_quantize_type="channel_wise_abs_max"):
+        from ..fluid.executor import global_scope
+
+        self.scope = scope or global_scope()
+        self.wbits = int(weight_bits)
+        self.weight_qtype = weight_quantize_type
+
+    def apply(self, program):
+        blk = program.global_block()
+        # map quantized-var name -> source name
+        strip = lambda n: n[:-len(".quantized")] \
+            if n.endswith(".quantized") else n          # noqa: E731
+        new_ops = []
+        done_w = {}   # weight name -> (scales, ch_axis): quantize ONCE
+        # (a shared weight re-read as int8 would yield abs-max ~127 and
+        # bake garbage scales into its second consumer; also makes the
+        # pass idempotent)
+        for op in blk.ops:
+            if op.type in _FAKE_QUANT_TYPES:
+                continue  # dropped; scales live in the scope
+            if op.type in self.op_types_map():
+                a_slot, w_slot, ch = _OP_SLOTS[op.type]
+                a_q = op.input(a_slot)[0] if op.input(a_slot) else ""
+                w_q = op.input(w_slot)[0] if op.input(w_slot) else ""
+                if a_q.endswith(".quantized") or \
+                        w_q.endswith(".quantized"):
+                    a, w = strip(a_q), strip(w_q)
+                    op.inputs[a_slot] = [a]
+                    op.inputs[w_slot] = [w]
+                    s_act = self.scope.get_value(f"{a}.quant_scale")
+                    s_in = max(float(np.asarray(s_act).reshape(-1)[0]),
+                               1e-8) / 127.0 if s_act is not None \
+                        else 1.0 / 127.0
+                    if w in done_w:
+                        scales, ch_axis = done_w[w]
+                        op.type = "quantized_" + op.type
+                        op.attrs["in_scale"] = float(s_in)
+                        op.attrs["weight_scales"] = scales
+                        op.attrs["weight_channel_axis"] = ch_axis
+                        new_ops.append(op)
+                        continue
+                    wv = np.asarray(self.scope.get_value(w), np.float32)
+                    if np.asarray(self.scope.get_value(w)).dtype == \
+                            np.int8:
+                        raise RuntimeError(
+                            f"QuantizationFreezePass: weight {w!r} is "
+                            "already int8 — the pass ran twice on this "
+                            "program/scope")
+                    if self.weight_qtype == "channel_wise_abs_max":
+                        red = tuple(i for i in range(wv.ndim)
+                                    if i != ch)
+                        s_w = np.maximum(np.abs(wv).max(axis=red),
+                                         1e-8) / 127.0
+                        shape = [1] * wv.ndim
+                        shape[ch] = -1
+                        w_q8 = np.clip(np.round(wv / s_w.reshape(shape)),
+                                       -127, 127).astype(np.int8)
+                        scales = [float(x) for x in np.atleast_1d(s_w)]
+                        ch_axis = ch
+                    else:
+                        s = max(float(np.abs(wv).max()), 1e-8) / 127.0
+                        w_q8 = np.clip(np.round(wv / s),
+                                       -127, 127).astype(np.int8)
+                        scales, ch_axis = [s], -1
+                    self.scope.set_value(w, w_q8)
+                    done_w[w] = (scales, ch_axis)
+                    if blk.has_var(w):
+                        blk.var(w).dtype = np.dtype(np.int8)
+                    op.type = "quantized_" + op.type
+                    op.attrs["in_scale"] = float(s_in)
+                    op.attrs["weight_scales"] = scales
+                    op.attrs["weight_channel_axis"] = ch_axis
+            new_ops.append(op)
+        blk.ops[:] = new_ops
+        return program
+
+    @staticmethod
+    def op_types_map():
+        return _OP_SLOTS

@@ -85,12 +85,13 @@ def test_multiprocess_iterable_drop_last():
     assert n == 18
 
 
-def test_worker_init_fn_error_raises():
-    def bad_init(wid):
-        raise ValueError("init fail")
+def _bad_init(wid):  # module-level: spawn workers must pickle it
+    raise ValueError("init fail")
 
+
+def test_worker_init_fn_error_raises():
     dl = DataLoader(_DS(8), batch_size=2, num_workers=2,
-                    worker_init_fn=bad_init)
+                    worker_init_fn=_bad_init)
     try:
         list(dl)
         raise AssertionError("expected RuntimeError")
@@ -105,13 +106,16 @@ def test_worker_info_in_workers():
     assert get_worker_info() is None  # parent process
 
 
-def test_worker_error_propagates():
-    class Bad(Dataset):
-        def __len__(self):
-            return 4
+class _BadDS(Dataset):  # module-level: spawn workers must pickle it
+    def __len__(self):
+        return 4
 
-        def __getitem__(self, i):
-            raise ValueError("boom")
+    def __getitem__(self, i):
+        raise ValueError("boom")
+
+
+def test_worker_error_propagates():
+    Bad = _BadDS
 
     dl = DataLoader(Bad(), batch_size=2, num_workers=1)
     try:

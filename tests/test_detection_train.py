@@ -298,6 +298,17 @@ class TestMatrixNms:
         np.testing.assert_allclose(out[2, 1], 0.6 * (1 - iou01),
                                    rtol=1e-4)
 
+    def test_keep_top_k_exceeds_candidates(self):
+        # fewer candidate rows than keep_top_k must pad, not crash
+        jnp = _jnp()
+        bb = np.array([[0, 0, 10, 10], [30, 30, 40, 40]], np.float32)
+        sc = np.array([[0.0, 0.0], [0.9, 0.8]], np.float32)
+        out, idx, n = DT.matrix_nms(jnp.asarray(bb), jnp.asarray(sc),
+                                    keep_top_k=100, background_label=0)
+        assert out.shape == (100, 6) and idx.shape == (100,)
+        assert int(n) == 2
+        assert (np.asarray(out)[2:, 0] == -1).all()
+
     def test_gaussian_mode_and_threshold(self):
         jnp = _jnp()
         bb = np.array([[0, 0, 10, 10], [0, 0, 10, 9]], np.float32)

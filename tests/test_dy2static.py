@@ -252,3 +252,156 @@ def test_super_call_in_converted_method():
         expect = base * 2 if base.sum() > 0 else base * -1
         np.testing.assert_allclose(np.asarray(got.numpy()), expect,
                                    rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# r03: for-range -> while, break/continue guard flags, return-in-loop
+# (reference unittests/dygraph_to_static/test_break_continue.py patterns)
+
+def _check_matches(fn, *args, traced=True):
+    """Converted fn must match the python original eagerly AND under
+    jax.jit (static execution)."""
+    import jax
+
+    conv = convert_to_static(fn)
+    want = fn(*args)
+    got = conv(*args)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                               rtol=1e-6)
+    if traced:
+        raw = [a._data if hasattr(a, "_data") else a for a in args]
+
+        def run(*raws):
+            outs = conv(*[paddle.to_tensor(r) for r in raws])
+            return outs._data if hasattr(outs, "_data") else outs
+
+        jitted = np.asarray(jax.jit(run)(*raw))
+        np.testing.assert_allclose(np.asarray(want), jitted, rtol=1e-6)
+
+
+def for_range_sum(x):
+    s = paddle.to_tensor(np.float32(0.0))
+    for i in range(4):
+        s = s + x.sum() + i
+    return s
+
+
+def while_break(x):
+    s = paddle.to_tensor(np.float32(0.0))
+    i = paddle.to_tensor(np.float32(0.0))
+    while i < 10:
+        if s > x.sum():
+            break
+        s = s + 2.0
+        i = i + 1
+    return s
+
+
+def for_continue(x):
+    s = paddle.to_tensor(np.float32(0.0))
+    for i in range(6):
+        if i % 2 == 0:
+            continue
+        s = s + x.sum() + i
+    return s
+
+
+def for_break_continue(x):
+    s = paddle.to_tensor(np.float32(0.0))
+    for i in range(10):
+        if i == 7:
+            break
+        if i % 3 == 0:
+            continue
+        s = s + i * x.sum()
+    return s
+
+
+def nested_loop_break(x):
+    s = paddle.to_tensor(np.float32(0.0))
+    for i in range(3):
+        j = paddle.to_tensor(np.float32(0.0))
+        while j < 5:
+            if j > i:
+                break
+            s = s + x.sum()
+            j = j + 1
+    return s
+
+
+def return_in_loop(x):
+    s = paddle.to_tensor(np.float32(0.0))
+    for i in range(8):
+        s = s + x.sum()
+        if i == 3:
+            return s * 10.0
+    return s
+
+
+def for_over_tensor(x):
+    s = paddle.to_tensor(np.float32(0.0))
+    for row in x:
+        s = s + row.sum()
+    return s
+
+
+def while_continue_break(x):
+    s = paddle.to_tensor(np.float32(0.0))
+    i = paddle.to_tensor(np.float32(0.0))
+    while i < 12:
+        i = i + 1
+        if (i % 2) == 0:
+            continue
+        if i > 8:
+            break
+        s = s + x.sum() + i
+    return s
+
+
+class TestBreakContinueReturn:
+    def setup_method(self):
+        self.x = paddle.to_tensor(
+            np.arange(6, dtype="float32").reshape(2, 3) * 0.1)
+
+    def test_for_range_sum(self):
+        _check_matches(for_range_sum, self.x)
+
+    def test_while_break(self):
+        _check_matches(while_break, self.x)
+
+    def test_for_continue(self):
+        _check_matches(for_continue, self.x)
+
+    def test_for_break_continue(self):
+        _check_matches(for_break_continue, self.x)
+
+    def test_nested_loop_break(self):
+        _check_matches(nested_loop_break, self.x)
+
+    def test_return_in_loop(self):
+        # concrete trip bounds: the single-exit rewrite executes through
+        # the python path eagerly and unrolls under trace
+        _check_matches(return_in_loop, self.x)
+
+    def test_for_over_tensor(self):
+        _check_matches(for_over_tensor, self.x)
+
+    def test_while_continue_break(self):
+        _check_matches(while_continue_break, self.x)
+
+    def test_traced_break_is_staged(self):
+        # data-dependent break must actually stage to lax.while_loop:
+        # run under jit where the threshold is a traced value
+        import jax
+
+        conv = convert_to_static(while_break)
+
+        def run(raw):
+            return conv(paddle.to_tensor(raw))._data
+
+        for mul in (0.5, 3.0):
+            xv = (np.arange(6, dtype="float32").reshape(2, 3) * mul)
+            np.testing.assert_allclose(
+                np.asarray(jax.jit(run)(xv)),
+                np.asarray(while_break(paddle.to_tensor(xv))),
+                rtol=1e-6)

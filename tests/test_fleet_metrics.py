@@ -43,8 +43,9 @@ def test_fleet_metrics_two_trainers():
 
     master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2)
     try:
-        q = mp.Queue()
-        procs = [mp.Process(target=_metric_worker,
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_metric_worker,
                             args=(r, 2, master.port, q)) for r in range(2)]
         for p in procs:
             p.start()

@@ -290,3 +290,23 @@ def test_create_graph_inside_no_grad():
     assert not g.stop_gradient  # grads carry a graph despite no_grad
     (g2,) = paddle.grad(g, x)
     assert abs(float(np.asarray(g2._data)[0]) - 12.0) < 1e-4  # 6x
+
+
+def test_double_grad_through_batch_norm_fp32():
+    """create_graph=True through BatchNorm must work at fp32 (the
+    gradient-penalty pattern); the bf16 fast path intentionally uses a
+    custom analytic bwd instead."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    rs = np.random.RandomState(0)
+    bn = nn.BatchNorm1D(4)
+    x = paddle.to_tensor(rs.randn(8, 4).astype("float32"),
+                         stop_gradient=False)
+    y = (bn(x) ** 2).sum()
+    (gx,) = paddle.grad([y], [x], create_graph=True)
+    gp = (gx ** 2).sum()
+    gp.backward()
+    g2 = x.grad
+    assert g2 is not None and np.isfinite(g2.numpy()).all()
+    assert np.abs(g2.numpy()).max() > 0

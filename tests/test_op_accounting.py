@@ -1,0 +1,71 @@
+"""Complete op accounting (VERDICT r02 #3): every forward operator the
+reference registers is either lowered (_REGISTRY) or deliberately
+excluded with a written reason (EXCLUDED_OPS). No silent gaps.
+
+The reference registry is extracted from the REGISTER_OPERATOR /
+REGISTER_OP_WITHOUT_GRADIENT / REGISTER_OP_CPU_KERNEL macro sites under
+/root/reference/paddle/fluid/operators (the 630-site registry,
+SURVEY §1 L5)."""
+import os
+import re
+
+import pytest
+
+REF_OPS_DIR = "/root/reference/paddle/fluid/operators"
+
+# names the regex extracts that are not real operators (macro parameter
+# text inside #define bodies)
+EXTRACTION_ARTIFACTS = {"op_type", "op_name", "name"}
+
+_PAT = re.compile(
+    r"REGISTER_OPERATOR\(\s*\n?\s*([a-z0-9_]+)\s*,|"
+    r"REGISTER_OP_WITHOUT_GRADIENT\(\s*\n?\s*([a-z0-9_]+)\s*,|"
+    r"REGISTER_OP_CPU_KERNEL\(\s*\n?\s*([a-z0-9_]+)\s*,")
+
+
+def _reference_forward_ops():
+    ops = set()
+    for root, _, files in os.walk(REF_OPS_DIR):
+        for fn in files:
+            if not fn.endswith((".cc", ".cu", ".h")):
+                continue
+            try:
+                text = open(os.path.join(root, fn), errors="ignore").read()
+            except OSError:
+                continue
+            for m in _PAT.finditer(text):
+                name = m.group(1) or m.group(2) or m.group(3)
+                if name:
+                    ops.add(name)
+    return sorted(
+        o for o in ops
+        if not o.endswith("_grad") and not o.endswith("_grad2")
+        and "_grad_" not in o and o not in EXTRACTION_ARTIFACTS)
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_OPS_DIR),
+                    reason="reference tree not present")
+def test_every_reference_op_is_accounted_for():
+    from paddle_tpu.fluid.lowering import EXCLUDED_OPS, _REGISTRY
+
+    ref = _reference_forward_ops()
+    assert len(ref) > 400  # the extraction itself still works
+    covered = set(_REGISTRY) | set(EXCLUDED_OPS)
+    missing = [o for o in ref if o not in covered]
+    assert not missing, (
+        f"{len(missing)} reference ops neither lowered nor excluded-"
+        f"with-reason: {missing}")
+
+
+def test_excluded_ops_all_carry_reasons():
+    from paddle_tpu.fluid.lowering import EXCLUDED_OPS
+
+    for op, why in EXCLUDED_OPS.items():
+        assert isinstance(why, str) and len(why) > 6, op
+
+
+def test_no_op_both_registered_and_excluded():
+    from paddle_tpu.fluid.lowering import EXCLUDED_OPS, _REGISTRY
+
+    both = set(_REGISTRY) & set(EXCLUDED_OPS)
+    assert not both, f"ops both lowered and excluded: {sorted(both)}"

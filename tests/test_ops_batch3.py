@@ -261,9 +261,18 @@ def test_linear_and_trilinear_interp():
     v = R.randn(1, 2, 4, 4, 4).astype("float32")
     (out,) = _run_one("trilinear_interp_v2", {"X": [v]}, {"Out": 1},
                       {"out_d": 8, "out_h": 8, "out_w": 8,
-                       "align_corners": False})
+                       "align_corners": False, "align_mode": 0})
     assert out.shape == (1, 2, 8, 8, 8)
     np.testing.assert_allclose(out.mean(), v.mean(), rtol=1e-2, atol=1e-3)
+
+    # align_mode=1 (the attr DEFAULT, legacy fluid): src = dst*scale —
+    # output position 0 copies input position 0 exactly, and upsampling
+    # 1-D by 2x places input samples at even outputs
+    x1 = R.randn(1, 1, 4).astype("float32")
+    (o1,) = _run_one("linear_interp_v2", {"X": [x1]}, {"Out": 1},
+                     {"out_w": 8, "align_corners": False,
+                      "align_mode": 1})
+    np.testing.assert_allclose(o1[0, 0, ::2], x1[0, 0], rtol=1e-5)
 
 
 def test_bicubic_interp():

@@ -1,0 +1,417 @@
+"""Op-surface batch 7: accounting-closure ops — tensor/random utils,
+losses/metrics, optimizer helpers, pool3d/spp, ctc_align, trees,
+hierarchical_sigmoid, fused-op compat, fake-quant QAT family."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.lod import LoDTensor
+
+from test_ops_batch5 import _run_one  # same harness
+
+R = np.random.RandomState(7)
+
+
+def test_allclose_and_is_empty():
+    x = np.array([1.0, 2.0], "float32")
+    y = np.array([1.0, 2.0 + 1e-7], "float32")
+    (out,) = _run_one("allclose", {"Input": [x], "Other": [y]},
+                      {"Out": 1}, {"rtol": 1e-5, "atol": 1e-8})
+    assert bool(out)
+    (out,) = _run_one("allclose", {"Input": [x], "Other": [y * 2]},
+                      {"Out": 1}, {"rtol": 1e-5, "atol": 1e-8})
+    assert not bool(out)
+    (e,) = _run_one("is_empty", {"X": [x]}, {"Out": 1}, {})
+    assert not bool(e)
+
+
+def test_bernoulli_statistics():
+    p = np.full((2000,), 0.3, "float32")
+    (out,) = _run_one("bernoulli", {"X": [p]}, {"Out": 1}, {})
+    assert set(np.unique(out)) <= {0.0, 1.0}
+    assert 0.2 < out.mean() < 0.4
+
+
+def test_diag_and_diag_embed():
+    d = np.array([1.0, 2.0, 3.0], "float32")
+    (out,) = _run_one("diag", {"Diagonal": [d]}, {"Out": 1}, {})
+    np.testing.assert_allclose(out, np.diag(d))
+    x = R.randn(2, 3).astype("float32")
+    (out,) = _run_one("diag_embed", {"Input": [x]}, {"Out": 1},
+                      {"offset": 1, "dim1": -2, "dim2": -1})
+    want = np.stack([np.diag(r, k=1) for r in x])
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_fill_and_zeros_like2():
+    (out,) = _run_one("fill", {}, {"Out": 1},
+                      {"value": [1.0, 2.0, 3.0, 4.0], "shape": [2, 2],
+                       "dtype": "float32"})
+    np.testing.assert_allclose(out, [[1, 2], [3, 4]])
+    x = R.randn(3, 2).astype("float32")
+    (out,) = _run_one("fill_zeros_like2", {"X": [x]}, {"Out": 1}, {})
+    assert (out == 0).all() and out.shape == x.shape
+
+
+def test_histogram():
+    x = np.array([0.1, 0.4, 0.6, 0.9, 1.5], "float32")
+    (out,) = _run_one("histogram", {"X": [x]}, {"Out": 1},
+                      {"bins": 2, "min": 0.0, "max": 1.0})
+    np.testing.assert_array_equal(out, [2, 2])  # 1.5 outside
+
+
+def test_maxout():
+    x = R.randn(2, 6, 3, 3).astype("float32")
+    (out,) = _run_one("maxout", {"X": [x]}, {"Out": 1},
+                      {"groups": 2, "axis": 1})
+    want = x.reshape(2, 3, 2, 3, 3).max(axis=2)
+    np.testing.assert_allclose(out, want)
+
+
+def test_randint_randperm_sampling_id():
+    (out,) = _run_one("randint", {}, {"Out": 1},
+                      {"shape": [100], "low": 3, "high": 7})
+    assert out.min() >= 3 and out.max() < 7
+    (out,) = _run_one("randperm", {}, {"Out": 1}, {"n": 16})
+    np.testing.assert_array_equal(np.sort(out), np.arange(16))
+    probs = np.zeros((50, 4), "float32")
+    probs[:, 2] = 1.0
+    (out,) = _run_one("sampling_id", {"X": [probs]}, {"Out": 1}, {})
+    assert (out == 2).all()
+
+
+def test_add_position_encoding():
+    x = np.zeros((1, 4, 6), "float32")
+    (out,) = _run_one("add_position_encoding", {"X": [x]}, {"Out": 1},
+                      {"alpha": 1.0, "beta": 1.0})
+    # position 0: sin(0)=0, cos(0)=1
+    np.testing.assert_allclose(out[0, 0, :3], 0.0, atol=1e-6)
+    np.testing.assert_allclose(out[0, 0, 3:], 1.0, atol=1e-6)
+
+
+def test_squared_l2_distance_and_huber():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], "float32")
+    y = np.array([[0.0, 0.0], [3.0, 2.0]], "float32")
+    sub, out = _run_one("squared_l2_distance",
+                        {"X": [x], "Y": [y]},
+                        {"sub_result": 1, "Out": 1}, {})
+    np.testing.assert_allclose(out.reshape(-1), [5.0, 4.0])
+    xv = np.array([[2.0], [0.5], [-2.0]], "float32")
+    yv = np.array([[1.0], [1.0], [1.0]], "float32")
+    inter, loss = _run_one("modified_huber_loss", {"X": [xv], "Y": [yv]},
+                           {"IntermediateVal": 1, "Out": 1}, {})
+    # z = x*(2y-1) = [2, .5, -2]; loss = [0, .25, 8]
+    np.testing.assert_allclose(loss.reshape(-1), [0.0, 0.25, 8.0],
+                               rtol=1e-5)
+
+
+def test_teacher_student_sigmoid_loss():
+    x = np.array([[0.5], [0.5], [0.5], [1.5]], "float32")
+    lab = np.array([[-2.0], [-0.5], [0.3], [1.4]], "float32")
+    (y,) = _run_one("teacher_student_sigmoid_loss",
+                    {"X": [x], "Label": [lab]}, {"Y": 1}, {})
+    sp = lambda v: max(v, 0) + np.log1p(np.exp(-abs(v)))  # noqa: E731
+    want = [sp(0.5),
+            sp(0.5) - 0.5,
+            sp(0.5) + sp(0.5) - 0.5 * 0.3,
+            sp(1.5) - 1.5 + sp(1.5) - 1.5 * 0.4]
+    np.testing.assert_allclose(y.reshape(-1), want, rtol=1e-5)
+
+
+def test_mean_iou():
+    pred = np.array([0, 0, 1, 1, 2], "int32")
+    lab = np.array([0, 1, 1, 1, 2], "int32")
+    miou, wrong, correct = _run_one(
+        "mean_iou", {"Predictions": [pred], "Labels": [lab]},
+        {"OutMeanIou": 1, "OutWrong": 1, "OutCorrect": 1},
+        {"num_classes": 3})
+    # class ious: 0: 1/2, 1: 2/3, 2: 1/1
+    np.testing.assert_allclose(miou, (0.5 + 2 / 3 + 1.0) / 3, rtol=1e-5)
+    np.testing.assert_array_equal(correct, [1, 2, 1])
+
+
+def test_precision_recall():
+    idx = np.array([0, 1, 1, 0], "int32")
+    lab = np.array([0, 1, 0, 1], "int32")
+    batch, accum, states = _run_one(
+        "precision_recall", {"Indices": [idx], "Labels": [lab]},
+        {"BatchMetrics": 1, "AccumMetrics": 1, "AccumStatesInfo": 1},
+        {"class_number": 2})
+    # both classes: tp=1, fp=1, fn=1 -> P=R=F1=0.5
+    np.testing.assert_allclose(batch, [0.5, 0.5, 0.5], rtol=1e-5)
+    np.testing.assert_allclose(accum, batch, rtol=1e-6)
+
+
+def _lev(a, b):
+    dp = np.arange(len(b) + 1, dtype=float)
+    for i, ca in enumerate(a):
+        prev = dp.copy()
+        dp[0] = i + 1
+        for j, cb in enumerate(b):
+            dp[j + 1] = min(prev[j] + (ca != cb), prev[j + 1] + 1,
+                            dp[j] + 1)
+    return dp[-1]
+
+
+def test_edit_distance():
+    hyps = [[1, 2, 3, 4], [5, 6]]
+    refs = [[1, 3, 3], [5, 6, 7, 8]]
+    hflat = np.asarray(hyps[0] + hyps[1], "int64").reshape(-1, 1)
+    rflat = np.asarray(refs[0] + refs[1], "int64").reshape(-1, 1)
+    out, num = _run_one(
+        "edit_distance", {"Hyps": [hflat], "Refs": [rflat]},
+        {"Out": 1, "SequenceNum": 1}, {"normalized": False},
+        lod_feeds={("Hyps", 0): (hflat, [4, 2]),
+                   ("Refs", 0): (rflat, [3, 4])})
+    want = [_lev(hyps[0], refs[0]), _lev(hyps[1], refs[1])]
+    np.testing.assert_allclose(np.asarray(out).reshape(-1), want)
+    assert int(num) == 2
+
+
+def test_lars_momentum():
+    p = np.array([3.0, 4.0], "float32")          # ||p|| = 5
+    g = np.array([0.6, 0.8], "float32")          # ||g|| = 1
+    v = np.zeros(2, "float32")
+    lr = np.array([0.1], "float32")
+    po, vo = _run_one(
+        "lars_momentum",
+        {"Param": [p], "Grad": [g], "Velocity": [v],
+         "LearningRate": [lr]},
+        {"ParamOut": 1, "VelocityOut": 1},
+        {"mu": 0.9, "lars_coeff": 0.001, "lars_weight_decay": 0.0005})
+    local_lr = 0.1 * 0.001 * 5.0 / (1.0 + 0.0005 * 5.0)
+    want_v = local_lr * (g + 0.0005 * p)
+    np.testing.assert_allclose(vo, want_v, rtol=1e-5)
+    np.testing.assert_allclose(po, p - want_v, rtol=1e-5)
+
+
+def test_amp_check_finite_and_scale():
+    x = np.array([1.0, 2.0], "float32")
+    bad = np.array([1.0, np.inf], "float32")
+    scale = np.array([2.0], "float32")
+    out, found = _run_one(
+        "amp_check_finite_and_scale", {"X": [x], "Scale": [scale]},
+        {"Out": 1, "FoundInfinite": 1}, {})
+    np.testing.assert_allclose(out, [0.5, 1.0])
+    assert not bool(found.reshape(-1)[0])
+    _, found = _run_one(
+        "amp_check_finite_and_scale", {"X": [bad], "Scale": [scale]},
+        {"Out": 1, "FoundInfinite": 1}, {})
+    assert bool(found.reshape(-1)[0])
+
+
+def test_pool3d_max_and_avg():
+    x = R.randn(1, 2, 4, 4, 4).astype("float32")
+    (out,) = _run_one("pool3d", {"X": [x]}, {"Out": 1},
+                      {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                       "paddings": [0, 0, 0], "pooling_type": "max"})
+    want = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max((3, 5, 7))
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+    (out,) = _run_one("pool3d", {"X": [x]}, {"Out": 1},
+                      {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                       "paddings": [0, 0, 0], "pooling_type": "avg"})
+    want = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean((3, 5, 7))
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_spp():
+    x = R.randn(2, 3, 8, 8).astype("float32")
+    (out,) = _run_one("spp", {"X": [x]}, {"Out": 1},
+                      {"pyramid_height": 2, "pooling_type": "max"})
+    assert out.shape == (2, 3 * (1 + 4))
+    np.testing.assert_allclose(out[:, :3], x.max((2, 3)), rtol=1e-6)
+
+
+def test_ctc_align():
+    seqs = [[1, 1, 0, 2, 2, 0, 3], [4, 0, 4, 4]]
+    flat = np.asarray(seqs[0] + seqs[1], "int32").reshape(-1, 1)
+    out = _run_one("ctc_align", {"Input": [flat]}, {"Output": 1},
+                   {"blank": 0, "merge_repeated": True},
+                   lod_feeds={("Input", 0): (flat, [7, 4])},
+                   return_numpy=False)[0]
+    lens = [len(r) for r in out.rows()] if hasattr(out, "rows") else None
+    arr = np.asarray(out.to_padded()[0]) if hasattr(out, "to_padded") \
+        else np.asarray(out)
+    np.testing.assert_array_equal(arr[0][:3], [1, 2, 3])
+    np.testing.assert_array_equal(arr[1][:2], [4, 4])
+    del lens
+
+
+def test_bilinear_tensor_product():
+    x = R.randn(3, 4).astype("float32")
+    y = R.randn(3, 5).astype("float32")
+    w = R.randn(2, 4, 5).astype("float32")
+    b = R.randn(1, 2).astype("float32")
+    (out,) = _run_one("bilinear_tensor_product",
+                      {"X": [x], "Y": [y], "Weight": [w], "Bias": [b]},
+                      {"Out": 1}, {})
+    want = np.einsum("bm,smn,bn->bs", x, w, y) + b
+    np.testing.assert_allclose(out, want, rtol=1e-4)
+
+
+def test_hierarchical_sigmoid_default_tree():
+    x = R.randn(4, 8).astype("float32")
+    w = (R.randn(7, 8) * 0.1).astype("float32")
+    lab = np.array([[0], [3], [5], [7]], "int64")
+    loss, pre = _run_one(
+        "hierarchical_sigmoid",
+        {"X": [x], "W": [w], "Label": [lab]},
+        {"Out": 1, "PreOut": 1}, {"num_classes": 8})
+    assert loss.shape == (4, 1) and (loss > 0).all()
+    # manual check for label 0, num_classes 8: code=8=0b1000, len 3,
+    # indexes (8>>1)-1=3, (8>>2)-1=1, (8>>3)-1=0; bits 0,0,0
+    logits = w[[3, 1, 0]] @ x[0]
+    want = np.sum(np.maximum(logits, 0) + np.log1p(np.exp(-np.abs(
+        logits))))
+    np.testing.assert_allclose(loss[0, 0], want, rtol=1e-4)
+
+
+def test_tdm_child():
+    # tree: node1 root(item 0), nodes 2,3 children of 1 (items 11, 12)
+    info = np.array([
+        [0, 0, 0, 0, 0],     # node 0 unused
+        [0, 0, 0, 2, 3],     # root
+        [11, 1, 1, 0, 0],    # leaf
+        [12, 1, 1, 0, 0],    # leaf
+    ], "int32")
+    x = np.array([[1], [2]], "int64")
+    child, mask = _run_one(
+        "tdm_child", {"X": [x], "TreeInfo": [info]},
+        {"Child": 1, "LeafMask": 1}, {"child_nums": 2})
+    np.testing.assert_array_equal(child.reshape(2, 1, 2),
+                                  [[[2, 3]], [[0, 0]]])
+    np.testing.assert_array_equal(mask.reshape(2, 1, 2),
+                                  [[[1, 1]], [[0, 0]]])
+
+
+def test_match_matrix_tensor():
+    x = R.randn(2, 3, 4).astype("float32")
+    y = R.randn(2, 5, 4).astype("float32")
+    w = R.randn(4, 2, 4).astype("float32")
+    out, tmp = _run_one(
+        "match_matrix_tensor", {"X": [x], "Y": [y], "W": [w]},
+        {"Out": 1, "Tmp": 1}, {"dim_t": 2})
+    want = np.einsum("bxd,dte,bye->btxy", x, w, y)
+    np.testing.assert_allclose(out, want, rtol=1e-4)
+
+
+def test_average_accumulates_retires_window():
+    p = np.ones(3, "float32")
+    z = np.zeros(3, "float32")
+    outs = _run_one(
+        "average_accumulates",
+        {"param": [p], "in_sum_1": [z], "in_sum_2": [z],
+         "in_sum_3": [z], "in_num_updates": [np.array([0], "int64")],
+         "in_num_accumulates": [np.array([0], "int64")],
+         "in_old_num_accumulates": [np.array([0], "int64")]},
+        {"out_sum_1": 1, "out_sum_2": 1, "out_sum_3": 1,
+         "out_num_updates": 1, "out_num_accumulates": 1,
+         "out_old_num_accumulates": 1},
+        {"average_window": 1.0, "max_average_window": 1,
+         "min_average_window": 1})
+    o1, o2, o3, nu, na, ona = outs
+    # window of 1: immediately retires -> sum_3 = param, counters reset
+    np.testing.assert_allclose(o3, p)
+    assert int(na[0]) == 0 and int(ona[0]) == 1 and int(nu[0]) == 1
+
+
+class TestFakeQuant:
+    def test_abs_max_roundtrip(self):
+        x = np.array([[-0.5, 0.25, 1.0]], "float32")
+        out, scale = _run_one(
+            "fake_quantize_abs_max", {"X": [x]},
+            {"Out": 1, "OutScale": 1}, {"bit_length": 8})
+        assert abs(scale[0] - 1.0) < 1e-6
+        np.testing.assert_allclose(
+            out, np.round(x * 127) / 127, rtol=1e-6)
+
+    def test_channel_wise(self):
+        x = np.stack([np.linspace(-1, 1, 6),
+                      np.linspace(-4, 4, 6)]).astype("float32")
+        out, scale = _run_one(
+            "fake_channel_wise_quantize_abs_max", {"X": [x]},
+            {"Out": 1, "OutScale": 1}, {"bit_length": 8,
+                                        "quant_axis": 0})
+        np.testing.assert_allclose(scale, [1.0, 4.0], rtol=1e-6)
+        np.testing.assert_allclose(
+            out[1], np.round(x[1] / 4 * 127) * 4 / 127, rtol=1e-5)
+
+    def test_moving_average_state(self):
+        x = np.full((4,), 2.0, "float32")
+        one = np.array([1.0], "float32")
+        out, scale, state, accum = _run_one(
+            "fake_quantize_moving_average_abs_max",
+            {"X": [x], "InScale": [one], "InState": [one],
+             "InAccum": [one]},
+            {"Out": 1, "OutScale": 1, "OutState": 1, "OutAccum": 1},
+            {"bit_length": 8, "moving_rate": 0.9})
+        # state = .9*1+1 = 1.9 ; accum = .9*1+2 = 2.9; scale = 2.9/1.9
+        np.testing.assert_allclose(state, [1.9], rtol=1e-6)
+        np.testing.assert_allclose(accum, [2.9], rtol=1e-6)
+        np.testing.assert_allclose(scale, [2.9 / 1.9], rtol=1e-6)
+
+    def test_range_abs_max_window(self):
+        x = np.array([0.5], "float32")
+        scale_in = np.array([2.0], "float32")
+        it = np.array([0], "int64")
+        scales0 = np.zeros(4, "float32")
+        out, oscale, oscales = _run_one(
+            "fake_quantize_range_abs_max",
+            {"X": [x], "InScale": [scale_in], "Iter": [it],
+             "InScales": [scales0]},
+            {"Out": 1, "OutScale": 1, "OutScales": 1},
+            {"bit_length": 8, "window_size": 4})
+        # cur (0.5) < last (2.0), removed (0) != last -> keep last
+        np.testing.assert_allclose(oscale, [2.0])
+        np.testing.assert_allclose(oscales[0], 0.5)
+
+    def test_dequantize(self):
+        q = np.array([[-127, 0, 127]], "float32")
+        s = np.array([0.5], "float32")
+        (out,) = _run_one("fake_dequantize_max_abs",
+                          {"X": [q], "Scale": [s]}, {"Out": 1},
+                          {"max_range": 127.0})
+        np.testing.assert_allclose(out, [[-0.5, 0, 0.5]], rtol=1e-6)
+
+    def test_ste_gradient_flows(self):
+        # the quantizer must behave as identity for gradients (STE):
+        # train a weight THROUGH fake_quant and see the loss fall
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [4], dtype="float32")
+            y = fluid.layers.data("y", [1], dtype="float32")
+            h = fluid.layers.fc(x, 1)
+            blk = main.global_block()
+            q = blk.create_var(name="q")
+            qs = blk.create_var(name="qs")
+            blk.append_op(type="fake_quantize_abs_max",
+                          inputs={"X": [h.name]},
+                          outputs={"Out": [q.name],
+                                   "OutScale": [qs.name]},
+                          attrs={"bit_length": 8})
+            q.desc_shape = None
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(q, y))
+            fluid.optimizer.SGD(0.05).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        xb = rs.randn(16, 4).astype("float32")
+        yb = (xb @ np.array([[1.0], [2.0], [-1.0], [0.5]],
+                            "float32")).astype("float32")
+        first = float(exe.run(main, {"x": xb, "y": yb}, [loss])[0])
+        for _ in range(30):
+            last = float(exe.run(main, {"x": xb, "y": yb}, [loss])[0])
+        assert last < first * 0.5, (first, last)
+
+
+def test_detection_map_metric():
+    from paddle_tpu.metric import DetectionMAP
+
+    m = DetectionMAP()
+    det = np.array([[1, 0.9, 0, 0, 10, 10],
+                    [1, 0.8, 100, 100, 110, 110]], "float32")
+    gt = np.array([[0, 0, 10, 10], [50, 50, 60, 60]], "float32")
+    m.update(det, gt, np.array([1, 1]))
+    # 1 TP at rank 1, 1 FP, 1 FN -> AP = 0.5 (integral)
+    assert abs(m.accumulate() - 0.5) < 1e-6

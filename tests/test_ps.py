@@ -148,7 +148,7 @@ def test_two_trainer_sync_convergence():
     """2 real trainer processes + 1 pserver: loss must drop >100x."""
     srv = _server(trainers=2, lr=0.1)
     try:
-        ctx = mp.get_context("fork")
+        ctx = mp.get_context("spawn")
         q = ctx.Queue()
         ep = f"127.0.0.1:{srv.port}"
         procs = [ctx.Process(target=_trainer_proc, args=(ep, tid, q))
@@ -293,5 +293,79 @@ def test_delta_gated_dense_pull():
             time.sleep(0.02)
         assert "w" in comm2._latest
         comm2.stop()
+    finally:
+        srv.stop()
+
+
+def test_train_from_dataset_async_ps_engine(tmp_path):
+    """VERDICT r02 #10: train_from_dataset PS mode runs the Downpour
+    worker plane INSIDE the dataset engine — hook only enqueues grads,
+    a push thread does readback+RPC, a pull-dense thread refreshes
+    params — and the model still converges."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.dataset import DatasetFactory
+    from paddle_tpu.fluid.transpiler import DistributeTranspiler
+
+    srv = _server(trainers=1, lr=0.02)
+    try:
+        # MultiSlot text file: y = 2*x0 - x1
+        rs = np.random.RandomState(0)
+        lines = []
+        for _ in range(64):
+            x = rs.rand(2)
+            y = 2 * x[0] - x[1]
+            lines.append(f"2 {x[0]:.6f} {x[1]:.6f} 1 {y:.6f}\n")
+        fn = tmp_path / "train.txt"
+        fn.write_text("".join(lines) * 4)
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), \
+                fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [2], dtype="float32")
+            y = fluid.layers.data("y", [1], dtype="float32")
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        tp = DistributeTranspiler()
+        tp.transpile(trainer_id=0, program=main,
+                     pservers=f"127.0.0.1:{srv.port}", trainers=1,
+                     sync_mode=False)
+        trainer_prog = tp.get_trainer_program()
+
+        exe = fluid.Executor()
+        exe.run(startup)
+        first = float(exe.run(trainer_prog,
+                              {"x": np.zeros((4, 2), np.float32),
+                               "y": np.zeros((4, 1), np.float32)},
+                              [loss])[0])
+        del first
+
+        ds = DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_batch_size(16)
+        ds.set_thread(1)
+        ds.set_filelist([str(fn)])
+
+        class V:
+            def __init__(self, name, dtype, shape):
+                self.name, self.dtype = name, dtype
+                self.shape, self.lod_level = shape, 0
+
+        ds.set_use_var([V("x", "float32", [-1, 2]),
+                        V("y", "float32", [-1, 1])])
+        ds.load_into_memory()
+        for _ in range(25):  # epochs
+            exe.train_from_dataset(trainer_prog, ds, fetch_list=[loss],
+                                   print_period=0)
+        lv = float(exe.run(trainer_prog,
+                           {"x": np.asarray([[0.5, 0.5]], np.float32),
+                            "y": np.asarray([[0.5]], np.float32)},
+                           [loss])[0])
+        # after training, w ~ [2, -1]: loss at (0.5,0.5)->0.5 is tiny
+        assert lv < 0.1, lv
+        # the engine plane actually engaged (hook left enqueue mode)
+        hooks = [h for h in trainer_prog._run_hooks]
+        assert hooks and hooks[0]._engine_q is None
+        hooks[0].stop()
     finally:
         srv.stop()
