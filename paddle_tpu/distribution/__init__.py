@@ -159,5 +159,74 @@ class Bernoulli(Distribution):
         return Tensor._wrap(-(p * jnp.log(p) + (1 - p) * jnp.log(1 - p)))
 
 
+class MultivariateNormalDiag(Distribution):
+    """Multivariate normal parameterized by loc [k] and a DIAGONAL
+    COVARIANCE matrix scale [k, k] (reference
+    fluid/layers/distributions.py:531 MultivariateNormalDiag — despite
+    the name, its docstring and closed forms treat `scale` as the
+    covariance). entropy()/kl_divergence() reproduce the reference's
+    documented values; sample()/log_prob() are the natural diag-MVN
+    extensions the reference lacked."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def _var(self):
+        jnp = _jnp()
+        return jnp.diagonal(self.scale._data, axis1=-2, axis2=-1)
+
+    def sample(self, shape=(), seed=0):
+        import jax
+
+        jnp = _jnp()
+        std = jnp.sqrt(self._var())
+        shape = tuple(shape) + tuple(self.loc._data.shape)
+        z = jax.random.normal(_random.next_key(), shape,
+                              dtype=(self.loc._data.dtype
+                                     if jnp.issubdtype(self.loc._data.dtype,
+                                                       jnp.floating)
+                                     else jnp.float32))
+        return Tensor._wrap(self.loc._data + z * std)
+
+    def log_prob(self, value):
+        jnp = _jnp()
+        v = _t(value)._data
+        var = self._var()
+        k = self.loc._data.shape[-1]
+        y = (v - self.loc._data) ** 2 / var
+        return Tensor._wrap(
+            -0.5 * y.sum(-1) - 0.5 * jnp.log(var).sum(-1)
+            - 0.5 * k * math.log(2 * math.pi))
+
+    def entropy(self):
+        # 0.5 * (k * (1 + log 2pi) + log det(cov))
+        jnp = _jnp()
+        var = self._var()
+        k = self.loc._data.shape[-1]
+        return Tensor._wrap(
+            (0.5 * k * (1.0 + math.log(2 * math.pi))
+             + 0.5 * jnp.log(var).sum(-1)).reshape(1))
+
+    def kl_divergence(self, other):
+        # KL(N(mu1, V1) || N(mu2, V2)), V diagonal covariances:
+        # 0.5 * (tr(V2^-1 V1) - k + (mu2-mu1)^T V2^-1 (mu2-mu1)
+        #        + log det V2 - log det V1)
+        jnp = _jnp()
+        if not isinstance(other, MultivariateNormalDiag):
+            raise TypeError(
+                "MultivariateNormalDiag.kl_divergence expects another "
+                f"MultivariateNormalDiag, got {type(other).__name__}")
+        v1 = self._var()
+        v2 = other._var()
+        mu1, mu2 = self.loc._data, _t(other.loc)._data
+        k = mu1.shape[-1]
+        return Tensor._wrap(
+            (0.5 * ((v1 / v2).sum(-1) - k
+                    + ((mu2 - mu1) ** 2 / v2).sum(-1)
+                    + jnp.log(v2).sum(-1)
+                    - jnp.log(v1).sum(-1))).reshape(1))
+
+
 def kl_divergence(p, q):
     return p.kl_divergence(q)

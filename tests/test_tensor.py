@@ -138,3 +138,26 @@ def test_inplace_apis():
     np.testing.assert_allclose(x.numpy(), [0.0, 0.0])
     x.fill_(7.0)
     np.testing.assert_allclose(x.numpy(), [7.0, 7.0])
+
+
+def test_multivariate_normal_diag_matches_reference_example():
+    # reference fluid/layers/distributions.py:588 documented values
+    from paddle_tpu.distribution import MultivariateNormalDiag
+
+    a = MultivariateNormalDiag(
+        np.array([0.3, 0.5], "float32"),
+        np.array([[0.4, 0.0], [0.0, 0.5]], "float32"))
+    b = MultivariateNormalDiag(
+        np.array([0.2, 0.4], "float32"),
+        np.array([[0.3, 0.0], [0.0, 0.4]], "float32"))
+    np.testing.assert_allclose(a.entropy().numpy(), [2.033158],
+                               rtol=1e-5)
+    np.testing.assert_allclose(b.entropy().numpy(), [1.7777451],
+                               rtol=1e-5)
+    np.testing.assert_allclose(a.kl_divergence(b).numpy(), [0.06542051],
+                               rtol=1e-4)
+    # sample/log_prob consistency: mean log_prob near entropy
+    s = a.sample((20000,))
+    lp = a.log_prob(s)
+    np.testing.assert_allclose(-lp.numpy().mean(),
+                               a.entropy().numpy()[0], rtol=0.03)
