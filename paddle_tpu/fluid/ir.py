@@ -351,6 +351,21 @@ def multihead_matmul_fuse_pass(program, scope=None):
                          "attrs": {"transpose_Y": lambda v: not v}}
             for m in SubgraphMatcher(pat).match(program):
                 qk, av, soft = m["qk"], m["av"], m["soft"]
+                # fused_sdpa always normalizes over the LAST axis; only
+                # rewrite a softmax that does too. Resolve the rank when
+                # the var shape is known; fall back to the 4-D attention
+                # layout (axis 3) when it isn't.
+                axis = soft.attrs.get("axis")
+                if axis not in (None, -1):
+                    rank = None
+                    try:
+                        shp = blk.var(soft.input("X")[0]).shape
+                        rank = len(shp) if shp else None
+                    except ValueError:
+                        pass
+                    last = (rank - 1) if rank else 3
+                    if axis != last:
+                        continue
                 scale = 1.0
                 if "scale" in m:
                     scale = float(m["scale"].attrs.get("scale", 1.0))
@@ -380,8 +395,27 @@ def multihead_matmul_fuse_pass(program, scope=None):
 @register_pass("conv_elementwise_add_act_fuse_pass")
 def conv_elementwise_add_act_fuse_pass(program, scope=None):
     """conv2d -> elementwise_add -> relu/sigmoid/tanh collapses into one
-    conv2d_fusion op (ir/conv_elementwise_add_act_fuse_pass.cc)."""
+    conv2d_fusion op (ir/conv_elementwise_add_act_fuse_pass.cc).
+
+    The add's Y must be a bias parameter — persistable or 1-D [C] — not
+    a feature map; a residual join (conv -> add(shortcut) -> relu) must
+    NOT match (graph_pattern_detector.cc ConvElementwiseadd requires
+    assert_is_persistable_var on the Y input)."""
     blk = program.global_block()
+
+    def _is_bias_add(add):
+        try:
+            v = blk.var(add.input("Y")[0])
+        except ValueError:
+            return False
+        shape = [d for d in (v.shape or [])]
+        # a conv bias is a persistable 1-D [C] param added on the
+        # channel axis; anything else (feature maps, per-width adds,
+        # multi-dim params) changes semantics under reshape(1,C,1,1)
+        return (bool(getattr(v, "persistable", False))
+                and len(shape) == 1
+                and add.attrs.get("axis", -1) == 1)
+
     for act in ("relu", "sigmoid", "tanh"):
         pat = {
             "conv": {"type": "conv2d"},
@@ -391,6 +425,8 @@ def conv_elementwise_add_act_fuse_pass(program, scope=None):
         }
         for m in SubgraphMatcher(pat).match(program):
             conv, add, actop = m["conv"], m["add"], m["act"]
+            if not _is_bias_add(add):
+                continue
             idx = blk.ops.index(actop)  # after every input's producer
             inputs = {"Input": [conv.input("Input")[0]],
                       "Filter": [conv.input("Filter")[0]],

@@ -707,11 +707,13 @@ def _tdm_sampler(ctx, op):
             labs.append(jnp.ones((B, 1), "int32") * pvalid[:, None])
             masks.append(pvalid[:, None].astype("int32"))
         key, sub = jax.random.split(key)
-        ridx = jax.random.randint(sub, (B, neg), lo, max(hi - 1, lo + 1))
+        ridx = jax.random.randint(sub, (B, neg), lo, max(hi, lo + 1))
         cand = layer.reshape(-1)[jnp.clip(ridx, 0, layer.size - 1)]
         # avoid sampling the positive: shift colliding draws by one slot
+        # (wrapping within this layer's [lo, hi) range)
         coll = cand == pos[:, None]
-        alt = layer.reshape(-1)[jnp.clip(ridx + 1, 0, layer.size - 1)]
+        nxt = jnp.where(ridx + 1 >= hi, lo, ridx + 1)
+        alt = layer.reshape(-1)[jnp.clip(nxt, 0, layer.size - 1)]
         cand = jnp.where(coll, alt, cand)
         outs.append(cand * pvalid[:, None])
         labs.append(jnp.zeros((B, neg), "int32"))
@@ -777,7 +779,6 @@ def _hierarchical_sigmoid(ctx, op):
 
 @register("fused_batch_norm_act")
 def _fused_batch_norm_act(ctx, op):
-    jnp = _jnp()
     x = ctx.inp(op, "X")
     scale = ctx.inp(op, "Scale")
     b = ctx.inp(op, "Bias")
@@ -788,9 +789,11 @@ def _fused_batch_norm_act(ctx, op):
     act = op.attrs.get("act_type", "relu")
     y, nm, nv, bm, bv = K.batch_norm_train(x, scale, b, mean, var, mom,
                                            eps)
-    y = K.activation(y, act) if hasattr(K, "activation") else \
-        getattr(jnp, act, None)(y) if hasattr(jnp, act) else \
-        jnp.maximum(y, 0)
+    try:
+        y = _unary_fn(act or "identity")(y)
+    except KeyError:
+        raise NotImplementedError(
+            f"fused_batch_norm_act: unsupported act_type {act!r}")
     ctx.out(op, "Y", y)
     ctx.out(op, "MeanOut", nm)
     ctx.out(op, "VarianceOut", nv)
@@ -809,9 +812,10 @@ def _unary_fn(name):
     jnp = _jnp()
     return {
         "relu": lambda v: jnp.maximum(v, 0),
-        "sigmoid": lambda v: 1.0 / (1.0 + jnp.exp(-v)),
+        "sigmoid": _jax().nn.sigmoid,
         "tanh": jnp.tanh,
         "scale": lambda v: v,
+        "identity": lambda v: v,
     }[name.split(":")[0]]
 
 
@@ -935,6 +939,10 @@ def _fake_quantize_range_abs_max(ctx, op):
     ctx.out(op, "Out", _ste(x, _quant_dequant(x, out_scale, bin_cnt)))
     ctx.out(op, "OutScale", out_scale.reshape(1))
     ctx.out(op, "OutScales", scales_arr)
+    # advance the global step driving the ring buffer (reference wires
+    # the executor's global step; here the op owns its counter). Kept
+    # int32 end-to-end: a float32 counter freezes at 2^24 steps.
+    ctx.out(op, "OutIter", (itv + 1).reshape(1))
 
 
 @register("fake_quantize_moving_average_abs_max")

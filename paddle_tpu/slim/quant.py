@@ -419,12 +419,12 @@ class QuantizationTransformPass:
         self.op_types = tuple(quantizable_op_type)
 
     # -- helpers -----------------------------------------------------------
-    def _state_var(self, blk, name, value):
+    def _state_var(self, blk, name, value, dtype="float32"):
         if not blk.has_var(name):
-            v = blk.create_var(name=name, shape=[1], dtype="float32")
+            v = blk.create_var(name=name, shape=[1], dtype=dtype)
             v.persistable = True
         if self.scope.get_value(name) is None:
-            self.scope.set_value(name, np.full((1,), value, np.float32))
+            self.scope.set_value(name, np.full((1,), value, dtype))
         return name
 
     def _insert_act_quant(self, blk, idx, name):
@@ -452,14 +452,16 @@ class QuantizationTransformPass:
                 self.scope.set_value(
                     f"{name}.quant_scales_arr",
                     np.zeros((self.window_size,), np.float32))
-            it = self._state_var(blk, f"{name}.quant_iter", 0.0)
+            it = self._state_var(blk, f"{name}.quant_iter", 0,
+                                 dtype="int32")
             blk._insert_op(
                 idx, type="fake_quantize_range_abs_max",
                 inputs={"X": [name], "InScale": [scale],
                         "Iter": [it],
                         "InScales": [f"{name}.quant_scales_arr"]},
                 outputs={"Out": [q], "OutScale": [scale],
-                         "OutScales": [f"{name}.quant_scales_arr"]},
+                         "OutScales": [f"{name}.quant_scales_arr"],
+                         "OutIter": [it]},
                 attrs={"bit_length": self.abits,
                        "window_size": self.window_size})
         else:  # abs_max: stateless

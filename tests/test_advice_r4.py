@@ -1,0 +1,223 @@
+"""Round-4 regression tests for the round-3 advisor findings.
+
+Each test pins down a specific mis-fuse / silent-fallback the advisor
+demonstrated: residual joins mis-fused as conv bias, fused_batch_norm_act
+ignoring act_type, the range-abs-max quant iter never advancing, the
+tdm_sampler never drawing a layer's last node, and the multihead fuse
+rewriting non-last-axis softmax.
+"""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.ir import apply_pass
+
+
+def test_conv_add_act_skips_residual_join():
+    """conv2d -> elementwise_add(shortcut FEATURE MAP) -> relu must NOT
+    match conv_elementwise_add_act_fuse_pass: the reference pattern
+    requires the add's Y to be a persistable bias
+    (graph_pattern_detector.cc ConvElementwiseadd)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        blk = main.global_block()
+        x = fluid.layers.data("x", [3, 8, 8])
+        w = fluid.layers.create_parameter([3, 3, 3, 3], "float32",
+                                          name="wconv_res")
+        conv_out = blk.create_var(name="co_res")
+        blk.append_op(type="conv2d",
+                      inputs={"Input": [x], "Filter": [w]},
+                      outputs={"Output": [conv_out]},
+                      attrs={"strides": [1, 1], "paddings": [1, 1],
+                             "dilations": [1, 1], "groups": 1})
+        add_out = blk.create_var(name="ao_res")
+        # Y is the non-persistable [N,C,H,W] shortcut, not a bias
+        blk.append_op(type="elementwise_add",
+                      inputs={"X": [conv_out], "Y": [x]},
+                      outputs={"Out": [add_out]}, attrs={})
+        act_out = blk.create_var(name="ro_res")
+        blk.append_op(type="relu", inputs={"X": [add_out]},
+                      outputs={"Out": [act_out]})
+    exe = fluid.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(3)
+    feed = {"x": rs.randn(2, 3, 8, 8).astype("float32")}
+    want = exe.run(main, feed, [act_out])[0]
+    apply_pass(main, "conv_elementwise_add_act_fuse_pass")
+    types = [o.type for o in main.global_block().ops]
+    assert "conv2d_fusion" not in types, types
+    got = exe.run(main, feed, [act_out])[0]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_conv_add_act_skips_non_bias_params():
+    """Only a persistable 1-D [C] param added on axis=1 is a conv bias;
+    a multi-dim persistable param or a trailing-axis 1-D add must not
+    fuse (both would be mis-applied as reshape(1,C,1,1))."""
+    for shape, axis in (([1, 4, 8, 8], -1), ([8], -1)):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main,
+                                                            startup):
+            blk = main.global_block()
+            x = fluid.layers.data("x", [3, 8, 8])
+            w = fluid.layers.create_parameter([4, 3, 3, 3], "float32",
+                                              name="wc")
+            p = fluid.layers.create_parameter(shape, "float32",
+                                              name="pb")
+            conv_out = blk.create_var(name="co2")
+            blk.append_op(type="conv2d",
+                          inputs={"Input": [x], "Filter": [w]},
+                          outputs={"Output": [conv_out]},
+                          attrs={"strides": [1, 1], "paddings": [1, 1],
+                                 "dilations": [1, 1], "groups": 1})
+            add_out = blk.create_var(name="ao2")
+            blk.append_op(type="elementwise_add",
+                          inputs={"X": [conv_out], "Y": [p]},
+                          outputs={"Out": [add_out]},
+                          attrs={"axis": axis})
+            act_out = blk.create_var(name="ro2")
+            blk.append_op(type="relu", inputs={"X": [add_out]},
+                          outputs={"Out": [act_out]})
+        apply_pass(main, "conv_elementwise_add_act_fuse_pass")
+        types = [o.type for o in main.global_block().ops]
+        assert "conv2d_fusion" not in types, (shape, axis, types)
+
+
+def test_fused_bn_act_sigmoid_applies_sigmoid():
+    """fused_batch_norm_act with act_type='sigmoid' must apply sigmoid,
+    not silently fall back to relu (fused_bn_activation_op.cc)."""
+    rs = np.random.RandomState(0)
+    xv = rs.randn(4, 2, 3, 3).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        blk = main.global_block()
+        x = fluid.layers.data("x", [2, 3, 3])
+        scale = fluid.layers.create_parameter([2], "float32", name="g")
+        bias = fluid.layers.create_parameter([2], "float32", name="b")
+        mean = fluid.layers.create_parameter([2], "float32", name="m")
+        var = fluid.layers.create_parameter([2], "float32", name="v")
+        outs = {k: blk.create_var(name=f"bn_{k}").name
+                for k in ("Y", "MeanOut", "VarianceOut", "SavedMean",
+                          "SavedVariance")}
+        blk.append_op(type="fused_batch_norm_act",
+                      inputs={"X": [x], "Scale": [scale], "Bias": [bias],
+                              "Mean": [mean], "Variance": [var]},
+                      outputs={k: [v] for k, v in outs.items()},
+                      attrs={"act_type": "sigmoid", "epsilon": 1e-5,
+                             "momentum": 0.9})
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        scope.set_value("g", np.ones(2, "float32"))
+        scope.set_value("b", np.zeros(2, "float32"))
+        scope.set_value("m", np.zeros(2, "float32"))
+        scope.set_value("v", np.ones(2, "float32"))
+        got = exe.run(main, {"x": xv}, [outs["Y"]])[0]
+    bm = xv.mean(axis=(0, 2, 3), keepdims=True)
+    bv = xv.var(axis=(0, 2, 3), keepdims=True)
+    want = 1.0 / (1.0 + np.exp(-(xv - bm) / np.sqrt(bv + 1e-5)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    assert got.min() > 0.0  # a relu fallback would clamp to exactly 0
+
+
+def test_range_abs_max_iter_advances():
+    """The quant_iter state must advance every step so the ring-buffer
+    window semantics (fake_quantize_op.cc FindRangeAbsMaxFunctor) hold;
+    round-3 left it frozen at 0."""
+    main, startup = fluid.Program(), fluid.Program()
+    window = 4
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        blk = main.global_block()
+        x = fluid.layers.data("x", [3], dtype="float32")
+        for nm in ("qscale", "qiter", "qarr"):
+            v = blk.create_var(name=nm, dtype="float32")
+            v.persistable = True
+        q = blk.create_var(name="q")
+        blk.append_op(type="fake_quantize_range_abs_max",
+                      inputs={"X": [x], "InScale": ["qscale"],
+                              "Iter": ["qiter"], "InScales": ["qarr"]},
+                      outputs={"Out": [q.name], "OutScale": ["qscale"],
+                               "OutScales": ["qarr"],
+                               "OutIter": ["qiter"]},
+                      attrs={"bit_length": 8, "window_size": window})
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        scope.set_value("qscale", np.array([1.0], "float32"))
+        scope.set_value("qiter", np.array([0.0], "float32"))
+        scope.set_value("qarr", np.zeros(window, "float32"))
+        for step in range(3):
+            xv = np.full((1, 3), 0.5 + 0.25 * step, "float32")
+            exe.run(main, {"x": xv}, [q])
+        it = float(np.asarray(scope.get_value("qiter")).reshape(-1)[0])
+        arr = np.asarray(scope.get_value("qarr"))
+    assert it == 3.0, it
+    # each step landed in its own ring-buffer slot
+    np.testing.assert_allclose(arr[:3], [0.5, 0.75, 1.0], rtol=1e-6)
+
+
+def test_tdm_sampler_reaches_last_layer_node():
+    """Negative draws must span the whole layer [lo, hi); round-3's
+    exclusive hi-1 bound could never emit the layer's last node
+    (tdm_sampler_op.cc uniform sampling)."""
+    travel = np.array([[0, 0], [1, 5]], "int64")  # item 1 path: 1 -> 5
+    layer = np.array([1, 2, 3, 4, 5, 6, 7, 8], "int64")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        blk = main.global_block()
+        x = blk.create_var(name="ids", shape=[8, 1], dtype="int64",
+                           is_data=True)
+        tv = blk.create_var(name="travel", shape=[2, 2], dtype="int64",
+                            is_data=True)
+        lv = blk.create_var(name="layer", shape=[8], dtype="int64",
+                            is_data=True)
+        outs = [blk.create_var(name=n) for n in ("tdm_o", "tdm_l",
+                                                 "tdm_m")]
+        blk.append_op(type="tdm_sampler",
+                      inputs={"X": [x], "Travel": [tv], "Layer": [lv]},
+                      outputs={"Out": [outs[0].name],
+                               "Labels": [outs[1].name],
+                               "Mask": [outs[2].name]},
+                      attrs={"neg_samples_num_list": [2, 64],
+                             "layer_offset_lod": [0, 4, 8],
+                             "output_positive": True})
+    exe = fluid.Executor()
+    exe.run(startup)
+    ids = np.ones((8, 1), "int64")
+    out, labels, _ = exe.run(
+        main, {"ids": ids, "travel": travel, "layer": layer},
+        [o.name for o in outs])
+    out = np.asarray(out).reshape(8, -1)
+    labels = np.asarray(labels).reshape(8, -1)
+    neg = out[labels == 0]
+    layer2 = neg[np.isin(neg, layer[4:])]
+    # positive (node 5) is excluded; the LAST node (8) is reachable
+    assert 5 not in layer2
+    assert 8 in layer2, sorted(set(layer2.tolist()))
+
+
+def test_multihead_fuse_skips_nonlast_softmax_axis():
+    """A softmax over a non-last axis between the two matmuls must not be
+    rewritten into fused_sdpa (which always normalizes the last axis)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        blk = main.global_block()
+        q = fluid.layers.data("q", [2, 4, 8])
+        k = fluid.layers.data("k", [2, 4, 8])
+        v = fluid.layers.data("v", [2, 4, 8])
+        qk = blk.create_var(name="qk")
+        blk.append_op(type="matmul", inputs={"X": [q], "Y": [k]},
+                      outputs={"Out": [qk.name]},
+                      attrs={"transpose_Y": True})
+        sm = blk.create_var(name="sm")
+        blk.append_op(type="softmax", inputs={"X": [qk]},
+                      outputs={"Out": [sm.name]}, attrs={"axis": 1})
+        av = blk.create_var(name="av")
+        blk.append_op(type="matmul", inputs={"X": [sm], "Y": [v]},
+                      outputs={"Out": [av.name]},
+                      attrs={"transpose_Y": False})
+    apply_pass(main, "multihead_matmul_fuse_pass")
+    types = [o.type for o in main.global_block().ops]
+    assert "fused_sdpa" not in types, types
+    assert "softmax" in types
