@@ -224,126 +224,193 @@ def _mnist_static(batch=256, steps=100):
             "vs_baseline": round(v / 8992.6, 3)}
 
 
-def _ctr_dnn_ps(batch=4096, steps=30):
-    """Config 5: CTR-DNN, async native PS, sparse embedding rows pulled
-    from / pushed to the CPU pserver while the dense tower trains on
-    device (the DLRM-on-TPU shape SURVEY prescribes). The whole tower
-    step (fwd+bwd+adam) is ONE jitted computation — eager op-by-op
-    dispatch would drown in per-call latency on a remote chip. Pulls are
-    prefetched one batch ahead; grad pushes drain on a background thread
-    so the training loop never blocks on the device→host readback (the
-    async-worker shape of the reference's HogwildWorker + Communicator)."""
-    import queue
-    import threading
+def _tunnel_profile(sample_bytes=4 << 20):
+    """Measure the device link live: fixed per-call latency, H2D and D2H
+    bandwidth. Marginal (big - small) cancels the fixed cost out of the
+    bandwidth estimates; each point is best-of-3. Returns a dict that
+    also feeds the published ceiling math."""
+    import jax
 
+    # payloads must be INCOMPRESSIBLE: the link compresses zero-filled
+    # buffers and reports 4-5x the bandwidth real embedding/grad data
+    # gets (measured live: 67 MB/s on zeros vs ~13 MB/s on random bf16)
+    rng = np.random.RandomState(0)
+
+    def h2d_time(nbytes):
+        a = rng.randn(max(nbytes // 4, 1)).astype(np.float32)
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            d = jax.device_put(a)
+            float(d.ravel()[0])  # only a readback bounds completion here
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    def d2h_time(nbytes):
+        # the array must be a fresh on-device computation result each
+        # trial: np.asarray of a host-originated device_put (or of an
+        # already-read array) returns the cached host copy and measures
+        # nothing (seen live: a "4.2 TB/s D2H" artifact)
+        base = jax.device_put(
+            rng.randn(max(nbytes // 4, 1)).astype(np.float32))
+        f = jax.jit(lambda x, c: x + c)
+        best = None
+        for i in range(3):
+            d = f(base, float(i + 1))
+            float(d.ravel()[0])  # computation done; only transfer left
+            t0 = time.perf_counter()
+            np.asarray(d)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    t_small = h2d_time(4)
+    t_big = h2d_time(sample_bytes)
+    h2d_bw = sample_bytes / max(t_big - t_small, 1e-6)
+    t_small_d = d2h_time(4)
+    t_big_d = d2h_time(sample_bytes)
+    d2h_bw = sample_bytes / max(t_big_d - t_small_d, 1e-6)
+    return {"fixed_call_latency_s": round(t_small, 4),
+            "h2d_bw_bytes_per_s": round(h2d_bw),
+            "d2h_bw_bytes_per_s": round(d2h_bw)}
+
+
+def _ctr_dnn_ps(batch=4096, chunks=12, merge_k=16):
+    """Config 5: CTR-DNN, async native PS, K-step merged transfers.
+
+    The r03 loop paid THREE fixed-latency tunnel calls per step (row H2D,
+    step dispatch, grad D2H) — ~0.3s/step of pure latency at 4096 ex per
+    step. r04 batches K=16 training steps per transfer (the merge_k
+    default; 8 and 24 measured within ~10%) via
+    MergedSparseStream (reference AsyncCommunicator max_merge_var_num,
+    communicator.h:253): embeddings for K batches ship H2D as one bf16
+    transfer, one jitted lax.scan runs the K fwd+bwd+adam steps, and the
+    K grads come back as one bf16 readback, merged by row id before the
+    pserver push. bf16 on the wire halves the link bytes; the pserver
+    table stays fp32. Ceiling math from the live-measured link profile is
+    published alongside the measurement."""
     import jax
     import jax.numpy as jnp
 
-    from paddle_tpu.distributed.ps import (Communicator, PsServer,
-                                           SparsePrefetcher)
+    from paddle_tpu.distributed.ps import (Communicator, MergedSparseStream,
+                                           PsServer)
     from paddle_tpu.optimizer import functional as fopt
-    from paddle_tpu.sparse import SelectedRows
 
-    BATCH, SLOTS, DIM, VOCAB = batch, 8, 16, 1_000_000
+    BATCH, SLOTS, DIM, VOCAB, K = batch, 8, 16, 1_000_000, merge_k
     srv = PsServer(port=0, trainers=1, optimizer="sgd", lr=0.01)
     try:
         comm = Communicator([f"127.0.0.1:{srv.port}"], mode="async",
                             trainer_id=0)
         comm.start()
+        # to_device=True: the prefetch thread issues the bf16 device_put
+        # for chunk i+1 while the main loop dispatches chunk i, so the
+        # H2D never sits on the critical path (host-arg dispatch measured
+        # WORSE — 22.5k vs 25.8k ex/s at K=8 — because the arg transfer
+        # blocks the dispatching thread)
+        ms = MergedSparseStream(comm, "ctr_emb", DIM, height=VOCAB,
+                                wire_dtype="bfloat16", to_device=True)
         rs = np.random.RandomState(0)
-        w1 = (rs.randn(SLOTS * DIM, 64) * 0.05).astype(np.float32)
-        b1 = np.zeros(64, np.float32)
-        w2 = (rs.randn(64, 1) * 0.05).astype(np.float32)
-        b2 = np.zeros(1, np.float32)
-        params = {"w1": w1, "b1": b1, "w2": w2, "b2": b2}
+        params = {"w1": (rs.randn(SLOTS * DIM, 64) * 0.05).astype("f4"),
+                  "b1": np.zeros(64, np.float32),
+                  "w2": (rs.randn(64, 1) * 0.05).astype("f4"),
+                  "b2": np.zeros(1, np.float32)}
         tx = fopt.adam(1e-3)
         opt_state = tx.init(params)
 
         def loss_fn(p, emb, y):
-            h = jnp.maximum(emb.reshape(BATCH, -1) @ p["w1"] + p["b1"],
-                            0.0)
+            h = jnp.maximum(
+                emb.astype(jnp.float32).reshape(BATCH, -1) @ p["w1"]
+                + p["b1"], 0.0)
             pred = h @ p["w2"] + p["b2"]
             return ((pred - y) ** 2).mean()
 
         @jax.jit
-        def step(p, opt_state, emb, y):
-            lv, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
-                p, emb, y)
-            gp, gemb = grads
-            p2, s2 = tx.update(p, gp, opt_state)
-            return p2, s2, gemb, lv
+        def run_chunk(p, s, embs, ys):
+            def body(carry, inp):
+                p, s = carry
+                emb, y = inp
+                lv, (gp, gemb) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1))(p, emb, y)
+                p2, s2 = tx.update(p, gp, s)
+                return (p2, s2), (gemb.astype(embs.dtype), lv)
+            (p, s), (gembs, lvs) = jax.lax.scan(body, (p, s),
+                                                (embs, ys))
+            return p, s, gembs, lvs[-1]
 
-        pf = SparsePrefetcher(comm, "ctr_emb", DIM, to_device=True)
+        def make_chunk():
+            ids = rs.randint(0, VOCAB, (K, BATCH, SLOTS)).astype(np.int64)
+            ys = (ids.sum(-1, keepdims=True) % 2).astype(np.float32)
+            return ids, ys
 
-        def make_ids():
-            return rs.randint(0, VOCAB, (BATCH, SLOTS)).astype(np.int64)
+        ids, ys = make_chunk()
+        ms.prime(ids)
 
-        push_q = queue.Queue(maxsize=4)
-        push_err = []
-
-        def pusher():
-            while True:
-                item = push_q.get()
-                if item is None:
-                    push_q.task_done()
-                    return
-                p_ids, p_gemb = item
-                try:
-                    # np.asarray = device→host readback, off the hot loop
-                    comm.push({"ctr_emb": SelectedRows(
-                        p_ids.ravel(),
-                        np.asarray(p_gemb).reshape(BATCH * SLOTS, DIM),
-                        VOCAB)})
-                except Exception as e:  # pragma: no cover - surfaced below
-                    push_err.append(e)
-                finally:
-                    push_q.task_done()
-
-        push_thread = threading.Thread(target=pusher, daemon=True)
-        push_thread.start()
-
-        ids = make_ids()
-        pf.prime(ids)
-
-        def one_step():
-            nonlocal params, opt_state, ids
-            rows = pf.get()                     # [B, SLOTS, DIM]
-            nxt = make_ids()
-            pf.prefetch(nxt)                    # overlap next pull
-            y = (ids.sum(1, keepdims=True) % 2).astype(np.float32)
-            params, opt_state, gemb, lv = step(params, opt_state,
-                                               rows, y)
-            push_q.put((ids, gemb))             # async d2h + RPC push
-            ids = nxt
+        def one_chunk():
+            nonlocal params, opt_state, ids, ys
+            rows = ms.get()                 # [K, B, S, D] bf16 on device
+            nxt = make_chunk()
+            ms.prefetch(nxt[0])             # overlap next pull + H2D
+            params, opt_state, gembs, lv = run_chunk(params, opt_state,
+                                                     rows, ys)
+            ms.push_async(ids, gembs)       # one D2H + merged RPC push
+            ids, ys = nxt
             return lv
 
         try:
-            lv = one_step()              # compile + warm
-            float(lv)
+            float(one_chunk())              # compile + warm
             dt = None
-            for _ in range(2):           # best-of-2: host-RPC jitter
+            for _ in range(2):              # best-of-2: host-RPC jitter
                 t0 = time.perf_counter()
-                for _ in range(steps):
-                    lv = one_step()
-                push_q.join()            # all grads actually at the PS
-                float(lv)                # bound the dispatch queue
+                for _ in range(chunks):
+                    lv = one_chunk()
+                ms.drain()                  # grads actually at the PS
+                float(lv)                   # bound the dispatch queue
                 d = time.perf_counter() - t0
                 dt = d if dt is None else min(dt, d)
-            if push_err:
-                raise push_err[0]
+            host_plane = {
+                "ps_pull_s_per_chunk": round(
+                    ms.pull_seconds / max(ms.chunks, 1), 3),
+                "push_plane_s_per_chunk": round(
+                    ms.push_seconds / max(ms.chunks, 1), 3),
+                "note": "worker-thread seconds. push_plane includes the"
+                        " grad readback, which BLOCKS until the scan"
+                        " compute finishes (it bounds the dispatch"
+                        " queue), plus widen+merge+RPC (~0.3s measured"
+                        " host-side); one CPU core serializes all of it"
+                        " against the tunnel client — together this"
+                        " accounts for measured vs link-only ceiling"}
         finally:
-            push_q.put(None)
-            push_thread.join(timeout=30)
-            pf.close()
+            ms.close()
             comm.stop()  # always reap the async send/recv threads
-        v = BATCH * steps / dt
+        v = BATCH * K * chunks / dt
+        # ---- published ceiling math (VERDICT r03 weak #1) ----
+        # per chunk the tunnel serializes: 3 fixed-latency calls (row
+        # device_put, scan dispatch, grad readback) + K*B*S*D*2 bytes
+        # bf16 each way. ceiling = K*B / that time; compute is ~free.
+        link = _tunnel_profile()
+        bytes_each_way = K * BATCH * SLOTS * DIM * 2
+        t_ceiling = (3 * link["fixed_call_latency_s"]
+                     + bytes_each_way / link["h2d_bw_bytes_per_s"]
+                     + bytes_each_way / link["d2h_bw_bytes_per_s"])
+        ceiling = BATCH * K / t_ceiling
         # anchor: torch-CPU in-process CTR-DNN (same tower/vocab, b512,
         # SparseAdam) on this host: 125337 ex/s — see BASELINE.md. The PS
-        # path pays RPC + tunnel H2D/D2H (~30MB/s here, GB/s on production
-        # TPU hosts); the anchor keeps the gap honest rather than hidden.
+        # path pays RPC + tunnel H2D/D2H (GB/s on production TPU hosts);
+        # the anchor keeps the gap honest rather than hidden.
         return {"metric": "ctr_dnn_async_ps_examples_per_sec",
                 "value": round(v, 2), "unit": "ex/s",
-                "vs_baseline": round(v / 125337.0, 4)}
+                "vs_baseline": round(v / 125337.0, 4),
+                "merge_k": K, "wire_dtype": "bfloat16",
+                "link_profile": link, "host_plane": host_plane,
+                "ceiling_ex_per_sec": round(ceiling, 1),
+                "frac_of_ceiling": round(v / ceiling, 3),
+                "ceiling_math": (
+                    f"chunk = 3 fixed calls x {link['fixed_call_latency_s']}s"
+                    f" + {bytes_each_way}B bf16 H2D @"
+                    f" {link['h2d_bw_bytes_per_s']}B/s + same D2H @"
+                    f" {link['d2h_bw_bytes_per_s']}B/s =>"
+                    f" {round(t_ceiling, 3)}s per {BATCH * K} examples")}
     finally:
         srv.stop()
 
