@@ -121,13 +121,89 @@ def _ernie(batch=32, seq_len=128, steps=STEPS, layers=12, hidden=768, heads=12, 
                       "keeps it included)"}
 
 
+def _hbm_profile():
+    """Measure usable HBM bandwidth: a chained elementwise loop over a
+    205MB bf16 tensor (reads+writes once per iteration), timed via the
+    two-point marginal. Elementwise fusions are pure HBM streams, so
+    bytes/time is the achievable roofline."""
+    import jax
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    x = jnp.asarray(np.random.RandomState(0)
+                    .randn(128, 256, 56, 56) * 0.1, jnp.bfloat16)
+
+    @jax.jit
+    def run(x, n):
+        return lax.fori_loop(
+            0, n, lambda i, x: x * jnp.bfloat16(1.0000001)
+            + jnp.bfloat16(1e-7), x)
+
+    def run_n(n):
+        t0 = time.perf_counter()
+        float(run(x, n).ravel()[0])
+        return time.perf_counter() - t0
+
+    # median-of-pairs marginal (the min-of-2 estimator is biased under
+    # this tunnel's asymmetric noise — see _marginal_step_time)
+    dt, _ = _marginal_step_time(run_n, 60, lo_frac=6)
+    return x.nbytes * 2 / max(dt, 1e-6)  # bytes/s
+
+
+def _resnet50_min_traffic(batch):
+    """Analytic lower bound on HBM bytes per training step, bf16
+    activations: per conv, fwd reads the input activation and writes the
+    output twice-read (once by the fused BN-stats reduce, once by the
+    next layer via the normalize folded into its prologue); bwd reads
+    dy + saved input for the weight grad, dy + weights for the data
+    grad, writes dx, and re-reads the output for the relu mask.
+    ~= 3*in + 5*out bytes per conv at 2B/elem. Stem/pool/fc + fp32
+    param/momentum update traffic added explicitly."""
+    # (in_c, in_hw, out_c, out_hw) with input sizes tracked explicitly —
+    # channel counts collide across resolutions, so no c->hw lookup
+    convs = [(3, 224, 64, 112)]                  # stem
+    cfg = [(3, 64, 256, 56), (4, 128, 512, 28),
+           (6, 256, 1024, 14), (3, 512, 2048, 7)]
+    cin, hw_cur = 64, 56                         # after stem maxpool
+    for n, cmid, cout, hw in cfg:
+        for b in range(n):
+            convs.append((cin, hw_cur, cmid, hw_cur))      # 1x1 reduce
+            convs.append((cmid, hw_cur, cmid, hw))         # 3x3 (strides)
+            convs.append((cmid, hw, cout, hw))             # 1x1 expand
+            if b == 0:
+                convs.append((cin, hw_cur, cout, hw))      # projection
+            cin, hw_cur = cout, hw
+    total = 0
+    for ci, hi, co, ho in convs:
+        in_b = batch * ci * hi * hi * 2
+        out_b = batch * co * ho * ho * 2
+        total += 3 * in_b + 5 * out_b
+    total += 25.6e6 * 4 * 4                      # fp32 params+momentum r/w
+    return total
+
+
 def _resnet50(batch=128, img=224, steps=40):
     """Batch 128 won the r03 sweep (64:2546, 128:2716, 192:2474, 256:2594,
     512:2453 imgs/s — BENCH_DETAILS resnet50_batch_sweep). The batch lives
     on device across timing calls: re-feeding host arrays per call costs
     ~5s over the tunnel's ~30MB/s H2D and is a harness artifact, not model
     throughput; streamed-input training is the run_epoch + DevicePrefetcher
-    path (tests/test_parallel.py::test_run_epoch_device_prefetch)."""
+    path (tests/test_parallel.py::test_run_epoch_device_prefetch).
+
+    r04 roofline finding: the step is HBM-BOUND, not MXU-bound — the
+    device profile shows every hot fusion running at 630-660 GiB/s
+    against a measured ~650 GB/s elementwise roof, with conv FLOP
+    utilization ~0.1-0.2% on those fusions. MFU is the wrong lens for
+    this model; roofline efficiency is reported instead. The step moves
+    ~1.4x the ideal-folding traffic floor (BN's two-pass nature and
+    saved-activation re-reads account for most of the excess).
+    Experiments that did NOT move the needle (all measured on-chip):
+    NHWC-internal convs (2787 vs 2708), full channels-last pure-jax
+    model (2750), breaking the conv+BN-stats fusion (2606),
+    1x1-conv-as-einsum (2036). Pallas block fusion (keeping bottleneck
+    intermediates in VMEM through BN's reduce barrier) is the
+    structural lever for the remaining gap and Mosaic cannot compile
+    through the axon tunnel."""
     import jax
 
     from paddle_tpu.optimizer import functional as fopt
@@ -161,11 +237,27 @@ def _resnet50(batch=128, img=224, steps=40):
 
     dt, dt_e2e = _marginal_step_time(run_n, steps, lo_frac=4)
     v = BATCH / dt
+    hbm_bw = _hbm_profile()
+    min_bytes = _resnet50_min_traffic(BATCH)
+    floor_s = min_bytes / hbm_bw
     # reference class: paddlepaddle-gpu ResNet-50 fp16 ~780 imgs/s/V100
     return {"metric": "resnet50_train_imgs_per_sec_per_chip",
             "value": round(v, 2), "unit": "imgs/s",
             "vs_baseline": round(v / 780.0, 3),
             "e2e_value": round(BATCH / dt_e2e, 2),
+            "roofline": {
+                "hbm_bw_bytes_per_s": round(hbm_bw),
+                "min_traffic_bytes_per_step": round(min_bytes),
+                "hbm_floor_imgs_per_sec": round(BATCH / floor_s, 1),
+                "frac_of_hbm_floor": round(v / (BATCH / floor_s), 3),
+                "note": "step is HBM-bound (device profile: hot fusions "
+                        "at 630-660 GiB/s, conv FLOP util ~0.1-0.2%); "
+                        "floor = ideal-folding activation+grad bytes / "
+                        "measured elementwise HBM bandwidth. The gap to "
+                        "1.0 is real traffic above the ideal (BN's "
+                        "2-pass normalize, saved-activation re-reads); "
+                        "closing it needs VMEM-persistent block fusion "
+                        "(Pallas), unavailable over this tunnel"},
             "method": "two-point marginal over jitted multi-step scans on a "
                       "device-resident batch (fixed remote-dispatch latency "
                       "excluded; e2e_value keeps it included)"}
