@@ -21,10 +21,12 @@
 
 #include <set>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <cmath>
 #include <condition_variable>
+#include <cstdio>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -47,6 +49,8 @@ enum Cmd : uint8_t {
   kHeartbeat = 8,   // trainer_id u32
   kNumTrainers = 9,
   kPullDenseIfNewer = 10,  // name, client_version u64 -> version-gated
+  kSave = 11,  // path -> snapshot ALL tables (dense + sparse + opt state)
+  kLoad = 12,  // path -> restore tables from a kSave snapshot
 };
 
 enum Status : uint8_t { kOk = 0, kErr = 1 };
@@ -418,6 +422,26 @@ class Server {
         resp->Put<uint8_t>(kOk);
         return;
       }
+      case kSave: {
+        // server-side table snapshot (checkpoint_notify_op.cc:66 +
+        // recv_save_op.cc + large_scale_kv.h:762 save capability): the
+        // trainer notifies, the SERVER owns the IO — dense values with
+        // optimizer slots, sparse rows with adagrad accumulators.
+        std::string path = r.Str();
+        if (!r.ok) return Err(resp, "bad save");
+        std::string err;
+        if (!Snapshot(path, &err)) return Err(resp, err);
+        resp->Put<uint8_t>(kOk);
+        return;
+      }
+      case kLoad: {
+        std::string path = r.Str();
+        if (!r.ok) return Err(resp, "bad load");
+        std::string err;
+        if (!Restore(path, &err)) return Err(resp, err);
+        resp->Put<uint8_t>(kOk);
+        return;
+      }
       case kHeartbeat: {
         uint32_t tid = r.Get<uint32_t>();
         std::lock_guard<std::mutex> lk(hb_mu_);
@@ -453,6 +477,208 @@ class Server {
   void Err(Writer* resp, const std::string& msg) {
     resp->Put<uint8_t>(kErr);
     resp->Str(msg);
+  }
+
+  // ---- snapshot/restore (binary, atomic-rename; format PTPS1) ----
+  // u32 magic 'PTPS' | u8 version | u32 ndense | per dense:
+  //   str name | i64 step | u64 version | u64 n | f32 value[n] m[n] v[n]
+  // u32 nsparse | per sparse: str name | u32 dim | u64 seed | u64 nrows |
+  //   per row: i64 key | f32 row[dim] | u8 has_accum | f32 accum[dim]?
+  static void WStr(FILE* f, const std::string& s) {
+    uint16_t n = (uint16_t)s.size();
+    fwrite(&n, 2, 1, f);
+    fwrite(s.data(), 1, n, f);
+  }
+  static bool RStr(FILE* f, std::string* s) {
+    uint16_t n = 0;
+    if (fread(&n, 2, 1, f) != 1) return false;
+    s->resize(n);
+    return n == 0 || fread(&(*s)[0], 1, n, f) == n;
+  }
+
+  bool Snapshot(const std::string& path, std::string* err) {
+    std::string tmp = path + ".tmp";
+    FILE* f = fopen(tmp.c_str(), "wb");
+    if (!f) {
+      *err = "save: cannot open " + tmp;
+      return false;
+    }
+    uint32_t magic = 0x53505450;  // 'PTPS'
+    uint8_t ver = 1;
+    fwrite(&magic, 4, 1, f);
+    fwrite(&ver, 1, 1, f);
+    // copy the name lists under tables_mu_, then lock tables one by one
+    std::vector<std::string> dnames, snames;
+    {
+      std::lock_guard<std::mutex> lk(tables_mu_);
+      for (auto& [n, t] : dense_) dnames.push_back(n);
+      for (auto& [n, t] : sparse_) snames.push_back(n);
+    }
+    uint32_t nd = (uint32_t)dnames.size();
+    fwrite(&nd, 4, 1, f);
+    for (auto& name : dnames) {
+      auto& t = Dense(name);
+      std::lock_guard<std::mutex> lk(t.mu);
+      WStr(f, name);
+      fwrite(&t.step, 8, 1, f);
+      fwrite(&t.version, 8, 1, f);
+      uint64_t n = t.value.size();
+      fwrite(&n, 8, 1, f);
+      fwrite(t.value.data(), 4, n, f);
+      // m/v may be unsized for never-optimized tables; pad to n
+      std::vector<float> m(t.m), v(t.v);
+      m.resize(n, 0.0f);
+      v.resize(n, 0.0f);
+      fwrite(m.data(), 4, n, f);
+      fwrite(v.data(), 4, n, f);
+    }
+    uint32_t ns = (uint32_t)snames.size();
+    fwrite(&ns, 4, 1, f);
+    for (auto& name : snames) {
+      auto& t = Sparse(name, 0);
+      std::lock_guard<std::mutex> lk(t.mu);
+      WStr(f, name);
+      fwrite(&t.dim, 4, 1, f);
+      fwrite(&t.seed, 8, 1, f);
+      uint64_t nrows = t.rows.size();
+      fwrite(&nrows, 8, 1, f);
+      for (auto& [key, row] : t.rows) {
+        fwrite(&key, 8, 1, f);
+        fwrite(row.data(), 4, t.dim, f);
+        auto it = t.accum.find(key);
+        uint8_t has = it != t.accum.end() ? 1 : 0;
+        fwrite(&has, 1, 1, f);
+        if (has) fwrite(it->second.data(), 4, t.dim, f);
+      }
+    }
+    bool okio = ferror(f) == 0;
+    okio = (fclose(f) == 0) && okio;
+    if (!okio || rename(tmp.c_str(), path.c_str()) != 0) {
+      *err = "save: write/rename failed for " + path;
+      remove(tmp.c_str());
+      return false;
+    }
+    return true;
+  }
+
+  bool Restore(const std::string& path, std::string* err) {
+    // Parse the WHOLE snapshot into staging structures first (using the
+    // bounds-checked Reader over the in-memory file, so corrupt counts
+    // fail the parse instead of allocating), then swap into the live
+    // tables — a bad file must never leave the server half-restored.
+    FILE* f = fopen(path.c_str(), "rb");
+    if (!f) {
+      *err = "load: cannot open " + path;
+      return false;
+    }
+    fseek(f, 0, SEEK_END);
+    long fsize = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    std::vector<char> buf((size_t)(fsize > 0 ? fsize : 0));
+    bool rd = fsize > 0 &&
+              fread(buf.data(), 1, buf.size(), f) == buf.size();
+    fclose(f);
+    if (!rd) {
+      *err = "load: cannot read " + path;
+      return false;
+    }
+    Reader r{buf.data(), buf.data() + buf.size()};
+    struct DStage {
+      std::string name;
+      int64_t step;
+      uint64_t version;
+      std::vector<float> value, m, v;
+    };
+    struct SStage {
+      std::string name;
+      uint32_t dim;
+      uint64_t seed;
+      std::unordered_map<int64_t, std::vector<float>> rows, accum;
+    };
+    std::vector<DStage> dstage;
+    std::vector<SStage> sstage;
+    uint32_t magic = r.Get<uint32_t>();
+    uint8_t ver = r.Get<uint8_t>();
+    if (!r.ok || magic != 0x53505450 || ver != 1) {
+      *err = "load: not a PTPS1 snapshot: " + path;
+      return false;
+    }
+    uint32_t nd = r.Get<uint32_t>();
+    for (uint32_t i = 0; r.ok && i < nd; ++i) {
+      DStage d;
+      d.name = r.Str();
+      d.step = r.Get<int64_t>();
+      d.version = r.Get<uint64_t>();
+      uint64_t n = r.Get<uint64_t>();
+      if (!r.ok || !FitsRaw(r, n, 12)) {
+        r.ok = false;
+        break;
+      }
+      const char* pv = r.Raw(n * 4);
+      const char* pm = r.Raw(n * 4);
+      const char* pvv = r.Raw(n * 4);
+      if (!r.ok) break;
+      d.value.assign((const float*)pv, (const float*)pv + n);
+      d.m.assign((const float*)pm, (const float*)pm + n);
+      d.v.assign((const float*)pvv, (const float*)pvv + n);
+      dstage.push_back(std::move(d));
+    }
+    uint32_t nsp = r.ok ? r.Get<uint32_t>() : 0;
+    for (uint32_t i = 0; r.ok && i < nsp; ++i) {
+      SStage s;
+      s.name = r.Str();
+      s.dim = r.Get<uint32_t>();
+      s.seed = r.Get<uint64_t>();
+      uint64_t nrows = r.Get<uint64_t>();
+      if (!r.ok || s.dim == 0 ||
+          !FitsRaw(r, nrows, 9 + (uint64_t)s.dim * 4)) {
+        r.ok = false;
+        break;
+      }
+      s.rows.reserve(nrows);
+      for (uint64_t rix = 0; r.ok && rix < nrows; ++rix) {
+        int64_t key = r.Get<int64_t>();
+        const char* prow = r.Raw((uint64_t)s.dim * 4);
+        uint8_t has = r.Get<uint8_t>();
+        if (!r.ok) break;
+        s.rows.emplace(key, std::vector<float>(
+                                (const float*)prow,
+                                (const float*)prow + s.dim));
+        if (has) {
+          const char* pacc = r.Raw((uint64_t)s.dim * 4);
+          if (!r.ok) break;
+          s.accum.emplace(key, std::vector<float>(
+                                   (const float*)pacc,
+                                   (const float*)pacc + s.dim));
+        }
+      }
+      if (r.ok) sstage.push_back(std::move(s));
+    }
+    if (!r.ok) {
+      *err = "load: corrupt or truncated snapshot " + path;
+      return false;
+    }
+    // whole file validated — swap into the live tables
+    for (auto& d : dstage) {
+      auto& t = Dense(d.name);
+      std::lock_guard<std::mutex> lk(t.mu);
+      t.value = std::move(d.value);
+      t.m = std::move(d.m);
+      t.v = std::move(d.v);
+      t.step = d.step;
+      // never move the version backwards: a delta-pull client holding a
+      // higher version would otherwise never refresh after a rollback
+      t.version = std::max(t.version, d.version) + 1;
+    }
+    for (auto& s : sstage) {
+      auto& t = Sparse(s.name, s.dim);
+      std::lock_guard<std::mutex> lk(t.mu);
+      t.dim = s.dim;
+      t.seed = s.seed;
+      t.rows = std::move(s.rows);
+      t.accum = std::move(s.accum);
+    }
+    return true;
   }
 
   DenseTable& Dense(const std::string& name) {
@@ -811,6 +1037,20 @@ int pt_ps_heartbeat(void* h, uint32_t trainer_id) {
 int pt_ps_shutdown(void* h) {
   Writer w;
   w.Put<uint8_t>(ptcore::ps::kShutdown);
+  return SimpleCall((Client*)h, w);
+}
+
+int pt_ps_save(void* h, const char* path) {
+  Writer w;
+  w.Put<uint8_t>(ptcore::ps::kSave);
+  w.Str(path);
+  return SimpleCall((Client*)h, w);
+}
+
+int pt_ps_load(void* h, const char* path) {
+  Writer w;
+  w.Put<uint8_t>(ptcore::ps::kLoad);
+  w.Str(path);
   return SimpleCall((Client*)h, w);
 }
 

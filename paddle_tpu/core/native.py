@@ -187,6 +187,8 @@ def _declare(lib):
         "pt_ps_barrier": (c.c_int, [c.c_void_p, c.c_uint32]),
         "pt_ps_heartbeat": (c.c_int, [c.c_void_p, c.c_uint32]),
         "pt_ps_shutdown": (c.c_int, [c.c_void_p]),
+        "pt_ps_save": (c.c_int, [c.c_void_p, c.c_char_p]),
+        "pt_ps_load": (c.c_int, [c.c_void_p, c.c_char_p]),
     }
     for name, (res, args) in sigs.items():
         fn = getattr(lib, name)

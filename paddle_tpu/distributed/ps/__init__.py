@@ -79,7 +79,8 @@ class PsClient:
 
     _RPC_METHODS = ("init_dense", "push_dense", "pull_dense",
                     "push_sparse", "pull_dense_if_newer", "pull_sparse",
-                    "barrier", "heartbeat", "shutdown_server")
+                    "barrier", "heartbeat", "shutdown_server",
+                    "save", "load")
 
     def __init__(self, host="127.0.0.1", port=0):
         import functools
@@ -193,6 +194,18 @@ class PsClient:
         self._ck(self._lib.pt_ps_heartbeat(self._h, trainer_id),
                  "heartbeat")
 
+    def save(self, path):
+        """Server-side table snapshot to `path` (the server owns the IO;
+        checkpoint_notify_op.cc:66 / recv_save_op.cc capability)."""
+        self._ck(self._lib.pt_ps_save(self._h, str(path).encode()),
+                 "save")
+
+    def load(self, path):
+        """Restore a kSave snapshot into the server's tables
+        (large_scale_kv.h:762 load capability)."""
+        self._ck(self._lib.pt_ps_load(self._h, str(path).encode()),
+                 "load")
+
     def shutdown_server(self):
         self._lib.pt_ps_shutdown(self._h)
 
@@ -303,6 +316,34 @@ class Communicator:
                     for n, s in shapes if n in self._latest}
         return {n: self._client_for(n).pull_dense(n, s)
                 for n, s in shapes}
+
+    # ---------------- checkpoint ----------------
+    def checkpoint_notify(self, dirname, load=False):
+        """Notify every pserver to snapshot (or restore) its tables —
+        the trainer-side checkpoint_notify_op role
+        (operators/distributed_ops/checkpoint_notify_op.cc:66). Each
+        shard writes `dirname/pserver_<i>.ptps`; the server process owns
+        the file IO (recv_save_op semantics), so the path must be
+        reachable from the pserver host. Returns the per-shard paths.
+
+        In async mode the local send queue is flushed first so queued
+        grads land in the snapshot. Multi-trainer jobs must quiesce the
+        OTHER trainers themselves (e.g. `barrier()`) — trainer 0 then
+        issues the notify, matching the reference's fleet save flow."""
+        import os
+
+        if not load and self.mode == "async":
+            with self._send_mu:
+                batch, self._send_q = self._send_q, []
+            for d in batch:
+                for n, g in d.items():
+                    self._client_for(n).push_dense(n, g)
+        paths = []
+        for i, cl in enumerate(self.clients):
+            p = os.path.join(str(dirname), f"pserver_{i}.ptps")
+            (cl.load if load else cl.save)(p)
+            paths.append(p)
+        return paths
 
     # ---------------- geo path ----------------
     def geo_step(self, named_params):

@@ -106,8 +106,11 @@ EXCLUDED_OPS = {
     "beam_search_decode": "LoD-walking decode twin of beam_search; "
                           "text.decode.beam_search returns the decoded "
                           "ids from one jitted scan + gather_tree",
-    "checkpoint_notify": "PS RPC at the executor boundary "
-                         "(distributed/ps Communicator / PsServer save)",
+    "checkpoint_notify": "PS RPC at the executor boundary: "
+                         "Communicator.checkpoint_notify drives the "
+                         "server-side kSave/kLoad snapshot RPCs "
+                         "(ps_server.cc Snapshot/Restore; wired into "
+                         "incubate.checkpoint.TrainEpochRange)",
     "fetch_barrier": "PS RPC barrier: executor run-hooks synchronise",
     "send_barrier": "see fetch_barrier",
     "send": "PS RPC at the executor boundary (transpiler run-hooks)",

@@ -28,12 +28,19 @@ class TrainEpochRange:
     last completed epoch after a restart."""
 
     def __init__(self, max_epoch_num, name, checkpoint_inter=None,
-                 save_checkpoint_fn=None, load_checkpoint_fn=None):
+                 save_checkpoint_fn=None, load_checkpoint_fn=None,
+                 ps_communicator=None):
+        """ps_communicator: a distributed.ps.Communicator — when given,
+        every checkpoint also snapshots the PSERVER tables (dense +
+        sparse embedding shards, checkpoint_notify_op.cc:66 role) and a
+        restart restores them, so a CTR job resumes with its embedding
+        table instead of a re-initialized one."""
         self._max = max_epoch_num
         self._name = name
         self._checker = AutoCheckpointChecker()
         self._save_fn = save_checkpoint_fn
         self._load_fn = load_checkpoint_fn
+        self._ps_comm = ps_communicator
         self._start = 0
         if self._checker.valid():
             meta = self._meta_path()
@@ -43,6 +50,9 @@ class TrainEpochRange:
                 self._start = state.get("epoch", -1) + 1
                 if self._load_fn and state.get("payload"):
                     self._load_fn(state["payload"])
+                if self._ps_comm is not None and state.get("ps_dir"):
+                    self._ps_comm.checkpoint_notify(state["ps_dir"],
+                                                    load=True)
 
     def _meta_path(self):
         return os.path.join(self._checker.ckpt_dir,
@@ -63,5 +73,27 @@ class TrainEpochRange:
                 self._checker.ckpt_dir,
                 f"{self._checker.job_id}_{self._name}_e{epoch}.pdparams")
             self._save_fn(payload)
+        ps_dir = None
+        if self._ps_comm is not None:
+            # per-epoch dir: a crash between the snapshot and the meta
+            # write must leave the PREVIOUS epoch's snapshot intact (an
+            # in-place overwrite would double-apply an epoch on resume)
+            ps_dir = os.path.join(
+                self._checker.ckpt_dir,
+                f"{self._checker.job_id}_{self._name}_ps_e{epoch}")
+            os.makedirs(ps_dir, exist_ok=True)
+            self._ps_comm.checkpoint_notify(ps_dir)
         with open(self._meta_path(), "w") as f:
-            json.dump({"epoch": epoch, "payload": payload}, f)
+            json.dump({"epoch": epoch, "payload": payload,
+                       "ps_dir": ps_dir}, f)
+        if ps_dir is not None:
+            # GC snapshots older than the one the meta now points at
+            import glob as _glob
+            import shutil
+
+            pat = os.path.join(
+                self._checker.ckpt_dir,
+                f"{self._checker.job_id}_{self._name}_ps_e*")
+            for d in _glob.glob(pat):
+                if d != ps_dir:
+                    shutil.rmtree(d, ignore_errors=True)
