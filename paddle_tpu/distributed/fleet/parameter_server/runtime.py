@@ -60,13 +60,24 @@ def init_worker(fleet_obj):
     strategy = getattr(fleet_obj, "_strategy", None)
     mode = "sync"
     geo_k = 4
+    # async-SGD staleness knobs (DistributedStrategy.a_sync_configs):
+    # bounded send queue + a short recv interval keep lr*(1+tau)*L < 2
+    # on a contended host (the 8/50ms defaults diverged at lr=0.1)
+    send_queue_size = 2
+    recv_interval = 0.005
     if strategy is not None and getattr(strategy, "a_sync", False):
         cfg = getattr(strategy, "a_sync_configs", {}) or {}
         k_steps = int(cfg.get("k_steps", 0) or 0)
         mode = "geo" if k_steps > 0 else "async"
         geo_k = k_steps or geo_k
+        send_queue_size = int(cfg.get("send_queue_size",
+                                      send_queue_size) or send_queue_size)
+        recv_interval = float(cfg.get("recv_interval", recv_interval)
+                              or recv_interval)
     _communicator = Communicator(endpoints, mode=mode,
-                                 trainer_id=trainer_id, geo_k=geo_k)
+                                 trainer_id=trainer_id, geo_k=geo_k,
+                                 send_queue_size=send_queue_size,
+                                 recv_interval=recv_interval)
     _communicator.start()
     return _communicator
 

@@ -97,6 +97,11 @@ class PsClient:
 
             def locked(*a, _fn=fn, **k):
                 with self._mu:
+                    # close() nulls the handle under this same lock; a
+                    # late RPC from a lingering worker thread must fail
+                    # cleanly, not hand a freed pointer to native code
+                    if self._h is None:
+                        raise ConnectionError("ps client is closed")
                     try:
                         return _fn(*a, **k)
                     except RuntimeError as e:
@@ -210,9 +215,14 @@ class PsClient:
         self._lib.pt_ps_shutdown(self._h)
 
     def close(self):
-        if self._h:
-            self._lib.pt_ps_disconnect(self._h)
-            self._h = None
+        # free the native handle under the RPC lock: an in-flight RPC on
+        # another thread finishes first, and any later one sees None
+        # (use-after-free here segfaulted the whole process when an
+        # async recv thread outlived Communicator.stop()'s join timeout)
+        with self._mu:
+            if self._h:
+                self._lib.pt_ps_disconnect(self._h)
+                self._h = None
 
     def __del__(self):
         try:
@@ -243,7 +253,7 @@ class Communicator:
     """
 
     def __init__(self, endpoints, mode="sync", trainer_id=0,
-                 recv_interval=0.05, geo_k=4):
+                 recv_interval=0.05, geo_k=4, send_queue_size=8):
         self.mode = mode
         self.trainer_id = trainer_id
         self.clients = [PsClient(h, int(p)) for h, p in
@@ -253,10 +263,18 @@ class Communicator:
         self._geo_step = 0
         self._dense_shapes = {}
         self._running = False
+        # bounded like the reference's send channel (communicator.h
+        # send_queue_size): an unbounded queue lets a contended host
+        # batch up dozens of STALE grads and apply them in one burst —
+        # async SGD diverges. push() blocks once the bound is hit.
+        self.send_queue_size = max(int(send_queue_size), 1)
         self._send_q = []
         self._send_mu = threading.Lock()
+        self._send_cv = threading.Condition(self._send_mu)
+        self._send_error = None
         self._recv_interval = recv_interval
         self._latest = {}     # name -> freshly pulled param (async)
+        self._latest_gen = 0  # bumps when recv_loop lands fresh data
         self._recv_error = None
         self._stop_evt = threading.Event()
 
@@ -299,23 +317,42 @@ class Communicator:
         if not dense:
             return
         if self.mode == "async":
-            with self._send_mu:
-                self._send_q.append(dict(dense))
-            return
+            with self._send_cv:
+                while (self._running and self._send_error is None
+                       and len(self._send_q) >= self.send_queue_size):
+                    self._send_cv.wait(timeout=1.0)
+                if self._send_error is not None:
+                    raise RuntimeError(
+                        "PS async send thread died") from self._send_error
+                if self._running:
+                    self._send_q.append(dict(dense))
+                    return
+            # communicator stopped (or never started): push inline so
+            # the grad is neither lost nor parked on a dead queue
         for name, g in dense.items():
             self._client_for(name).push_dense(name, g)
 
-    def pull(self):
+    def pull(self, force=False):
+        """force=True bypasses the async recv-thread cache and does a
+        blocking dense pull from the servers (bounded-staleness
+        fallback; sync mode always pulls)."""
         if self._recv_error is not None:
             raise RuntimeError(
                 "PS async recv thread died") from self._recv_error
         shapes = list(self._dense_shapes.items())  # init_params may
         # grow the dict concurrently (engine pull thread vs first hook)
-        if self.mode == "async" and self._latest:
+        if not force and self.mode == "async" and self._latest:
             return {n: self._latest[n].reshape(s)
                     for n, s in shapes if n in self._latest}
         return {n: self._client_for(n).pull_dense(n, s)
                 for n, s in shapes}
+
+    @property
+    def latest_generation(self):
+        """Bumps whenever the async recv thread lands genuinely fresh
+        params; consumers can gate on it to tell a starved recv thread
+        from a quiet server."""
+        return self._latest_gen
 
     # ---------------- checkpoint ----------------
     def checkpoint_notify(self, dirname, load=False):
@@ -371,17 +408,28 @@ class Communicator:
 
         def send_loop():
             while not self._stop_evt.is_set():
-                with self._send_mu:
+                with self._send_cv:
                     batch, self._send_q = self._send_q, []
+                    if batch:
+                        self._send_cv.notify_all()
                 if batch:
                     # merge grads for the same var (communicator merge_add)
-                    merged = {}
-                    for d in batch:
-                        for n, g in d.items():
-                            g = np.asarray(g, np.float32)
-                            merged[n] = merged.get(n, 0) + g
-                    for n, g in merged.items():
-                        self._client_for(n).push_dense(n, g)
+                    try:
+                        merged = {}
+                        for d in batch:
+                            for n, g in d.items():
+                                g = np.asarray(g, np.float32)
+                                merged[n] = merged.get(n, 0) + g
+                        for n, g in merged.items():
+                            self._client_for(n).push_dense(n, g)
+                    except Exception as e:
+                        # surface on the NEXT push(): with a bounded
+                        # queue a silently-dead send thread would block
+                        # the trainer forever
+                        with self._send_cv:
+                            self._send_error = e
+                            self._send_cv.notify_all()
+                        return
                 else:
                     time.sleep(0.002)
 
@@ -404,6 +452,7 @@ class Communicator:
                                 out=scratch[n])
                         if arr is not None:
                             self._latest[n] = arr.copy()
+                            self._latest_gen += 1
                     consecutive_errs = 0
                 except Exception as e:  # transient: retry, then surface
                     consecutive_errs += 1
@@ -423,10 +472,14 @@ class Communicator:
         self._stop_evt.set()
         for t in self._threads:
             t.join(timeout=2.0)
-        self._running = False
-        # flush pending async grads
-        with self._send_mu:
+        # flip state, release blocked pushers, and drain in ONE critical
+        # section: a waiter waking after a separate flush would append to
+        # a never-drained queue and lose its grad (late push() calls now
+        # go inline — see push())
+        with self._send_cv:
+            self._running = False
             batch, self._send_q = self._send_q, []
+            self._send_cv.notify_all()
         for d in batch:
             for n, g in d.items():
                 self._client_for(n).push_dense(n, g)

@@ -32,7 +32,7 @@ class _PsWorkerPlane:
     """Engine-owned async-PS plane: pull-dense thread + push thread
     around a _PsTrainerHook's Communicator."""
 
-    def __init__(self, hook, scope, pull_interval=0.002, push_depth=8):
+    def __init__(self, hook, scope, pull_interval=0.002, push_depth=2):
         import numpy as np
 
         self._np = np
@@ -73,12 +73,22 @@ class _PsWorkerPlane:
         # 2ms default interval matches PullDenseWorker's sleep_time_ms:
         # steps on a cached program run in single-digit ms, so a coarse
         # interval would miss every refresh window.
+        last_gen = -1
         while not self._stop.wait(self.interval):
             comm = self.hook.comm
             if comm is None:
                 continue
             try:
+                # stage only GENUINELY fresh params: republishing the
+                # recv thread's frozen cache would defeat the hook's
+                # staleness counter (a starved recv thread must look
+                # like "no fresh data", not like a steady stream)
+                gen = getattr(comm, "latest_generation", None)
+                if gen is not None and gen == last_gen:
+                    continue
                 fresh = comm.pull()
+                if gen is not None:
+                    last_gen = gen
                 with self._fresh_mu:
                     self._fresh = fresh
             except Exception as e:  # pragma: no cover
@@ -88,6 +98,18 @@ class _PsWorkerPlane:
         with self._fresh_mu:
             fresh, self._fresh = self._fresh, {}
         return fresh
+
+    def force_refresh(self):
+        """Blocking dense pull — the hook's bounded-staleness fallback
+        when no fresh params arrived for several steps."""
+        comm = self.hook.comm
+        if comm is None:
+            return {}
+        try:
+            return comm.pull(force=True)
+        except Exception as e:  # pragma: no cover
+            self._err.append(e)
+            return {}
 
     def close(self):
         """Stops the threads; returns (not raises) any worker error so a

@@ -111,9 +111,17 @@ class _PsTrainerHook:
 
         mode = "geo" if self.geo_k else ("sync" if self.sync_mode
                                          else "async")
+        # async-SGD stability needs lr*(1+tau)*L < 2: tau (grad
+        # staleness) is bounded by BOTH the send queue depth and how
+        # often fresh params come back. send_queue_size=2 bounds the
+        # push side; recv_interval=5ms bounds the pull side (the 50ms
+        # default left params ~10 steps stale on a cached program and
+        # diverged at the program's own lr — seen live at lr=0.1)
         self.comm = Communicator(self.endpoints, mode=mode,
                                  trainer_id=self.trainer_id,
-                                 geo_k=self.geo_k or 4)
+                                 geo_k=self.geo_k or 4,
+                                 send_queue_size=2,
+                                 recv_interval=0.005)
         init = {}
         for p in self.param_names:
             v = scope._values.get(p)
@@ -135,16 +143,39 @@ class _PsTrainerHook:
             for p in self.param_names:
                 g = scope._values.get(self.grad_map[p])
                 if g is not None:
-                    # device copy (async, ~free): the NEXT exe.run
-                    # donates persistable buffers, which would invalidate
-                    # the raw handle before the push thread reads it
+                    # device copy: the NEXT exe.run donates persistable
+                    # buffers, which would invalidate the raw handle
+                    # before the push thread reads it
                     grads[p] = jnp.copy(g) if hasattr(g, "devices") \
                         else g
+            # the copies must MATERIALIZE before this step returns:
+            # donation does not respect a merely-enqueued read, and a
+            # late copy picks up the next step's reused buffer — garbage
+            # grads diverged training ~1-in-5 suite runs before this
+            import jax
+
+            jax.block_until_ready(grads)
             self._engine_q.put(grads)
             # apply whatever the pull-dense thread staged since the last
             # step (post-writeback, so the executor can't clobber it)
             if self._engine_plane is not None:
-                for p, v in self._engine_plane.take_fresh().items():
+                fresh = self._engine_plane.take_fresh()
+                if fresh:
+                    self._stale_steps = 0
+                else:
+                    # bounded staleness: when the poll thread starves
+                    # (contended host), async SGD on frozen params
+                    # diverges — force a synchronous refresh instead of
+                    # running open-loop (PullDenseWorker's wait-times
+                    # bound)
+                    self._stale_steps = getattr(self, "_stale_steps",
+                                                0) + 1
+                    if self._stale_steps >= 4:
+                        fresh = self._engine_plane.force_refresh()
+                        if fresh:  # a FAILED refresh keeps the counter
+                            self._stale_steps = 0  # armed (retry next
+                            # step), not open-loop for 4 more
+                for p, v in fresh.items():
                     scope._values[p] = jnp.asarray(v)
             return
         if self.geo_k:

@@ -306,7 +306,11 @@ def test_train_from_dataset_async_ps_engine(tmp_path):
     from paddle_tpu.fluid.dataset import DatasetFactory
     from paddle_tpu.fluid.transpiler import DistributeTranspiler
 
-    srv = _server(trainers=1, lr=0.02)
+    # the pserver must run the PROGRAM's optimizer rule (the reference
+    # pserver executes the transpiled optimize block): SGD(0.1) below.
+    # A mismatched slower server lr left convergence init-dependent
+    # (in-suite uid counters shift the fc init; 0.02 was marginal).
+    srv = _server(trainers=1, lr=0.1)
     try:
         # MultiSlot text file: y = 2*x0 - x1
         rs = np.random.RandomState(0)
@@ -354,7 +358,8 @@ def test_train_from_dataset_async_ps_engine(tmp_path):
         ds.set_use_var([V("x", "float32", [-1, 2]),
                         V("y", "float32", [-1, 1])])
         ds.load_into_memory()
-        for _ in range(25):  # epochs
+        for _ in range(40):  # epochs (40: async convergence under a
+            # contended single-core host is noisy; 25 landed at ~0.1x)
             exe.train_from_dataset(trainer_prog, ds, fetch_list=[loss],
                                    print_period=0)
         lv = float(exe.run(trainer_prog,
