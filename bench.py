@@ -45,7 +45,7 @@ def _marginal_step_time(run_n, steps, lo_frac=5):
     if lo >= steps:  # degenerate: single point, single measurement
         run_n(steps)
         dt = run_n(steps) / steps
-        return dt, dt
+        return dt, dt, [dt]
     for n in (steps, lo):
         run_n(n)  # compile + warm this n
     # measure ADJACENT (lo, hi) pairs and take the MEDIAN of per-pair
@@ -63,10 +63,27 @@ def _marginal_step_time(run_n, steps, lo_frac=5):
         if t_hi > t_lo:
             slopes.append((t_hi - t_lo) / (steps - lo))
     if not slopes:
-        return t_hi_best / steps, t_hi_best / steps
+        return t_hi_best / steps, t_hi_best / steps, [t_hi_best / steps]
     slopes.sort()
     dt = slopes[len(slopes) // 2]
-    return dt, t_hi_best / steps
+    return dt, t_hi_best / steps, slopes
+
+
+def _spread(per_sample_values, kind="pair_slopes"):
+    """Dispersion record for per-sample throughput estimates: the
+    headline is the MEDIAN (driver-reproducible), and the spread states
+    how far one observed sample can land from it (VERDICT r03 weak #2:
+    single-trial numbers drifted 28% run-to-run unflagged). `kind`
+    keeps the record honest about sample independence: 'pair_slopes'
+    are adjacent-pair marginal slopes (noise-negative pairs dropped,
+    so the sample is censored and correlated); 'trials' are fully
+    independent end-to-end repetitions."""
+    vs = sorted(float(v) for v in per_sample_values)
+    med = vs[len(vs) // 2]
+    lo, hi = vs[0], vs[-1]
+    return {"samples": len(vs), "kind": kind,
+            "min": round(lo, 2), "max": round(hi, 2),
+            "spread_pct": round(100.0 * (hi - lo) / med, 1) if med else 0.0}
 
 
 def _ernie(batch=32, seq_len=128, steps=STEPS, layers=12, hidden=768, heads=12, inter=3072):
@@ -110,12 +127,13 @@ def _ernie(batch=32, seq_len=128, steps=STEPS, layers=12, hidden=768, heads=12, 
         assert lf == lf, "ERNIE produced NaN loss"
         return dt
 
-    dt, dt_e2e = _marginal_step_time(run_n, steps)
+    dt, dt_e2e, slopes = _marginal_step_time(run_n, steps)
     v = BATCH / dt
     return {"metric": "ernie_base_finetune_seq_per_sec_per_chip",
             "value": round(v, 2), "unit": "seq/s",
             "vs_baseline": round(v / TARGET_SEQ_PER_SEC, 3),
             "e2e_value": round(BATCH / dt_e2e, 2),
+            "spread": _spread([BATCH / s for s in slopes]),
             "method": "two-point marginal over jitted multi-step scans "
                       "(fixed remote-dispatch latency excluded; e2e_value "
                       "keeps it included)"}
@@ -146,7 +164,7 @@ def _hbm_profile():
 
     # median-of-pairs marginal (the min-of-2 estimator is biased under
     # this tunnel's asymmetric noise — see _marginal_step_time)
-    dt, _ = _marginal_step_time(run_n, 60, lo_frac=6)
+    dt, _, _ = _marginal_step_time(run_n, 60, lo_frac=6)
     return x.nbytes * 2 / max(dt, 1e-6)  # bytes/s
 
 
@@ -235,7 +253,7 @@ def _resnet50(batch=128, img=224, steps=40):
         assert lf == lf, "ResNet produced NaN loss"
         return dt
 
-    dt, dt_e2e = _marginal_step_time(run_n, steps, lo_frac=4)
+    dt, dt_e2e, slopes = _marginal_step_time(run_n, steps, lo_frac=4)
     v = BATCH / dt
     hbm_bw = _hbm_profile()
     min_bytes = _resnet50_min_traffic(BATCH)
@@ -245,6 +263,7 @@ def _resnet50(batch=128, img=224, steps=40):
             "value": round(v, 2), "unit": "imgs/s",
             "vs_baseline": round(v / 780.0, 3),
             "e2e_value": round(BATCH / dt_e2e, 2),
+            "spread": _spread([BATCH / s for s in slopes]),
             "roofline": {
                 "hbm_bw_bytes_per_s": round(hbm_bw),
                 "min_traffic_bytes_per_step": round(min_bytes),
@@ -263,7 +282,10 @@ def _resnet50(batch=128, img=224, steps=40):
                       "excluded; e2e_value keeps it included)"}
 
 
-def _mnist_static(batch=256, steps=100):
+def _mnist_static(batch=256, steps=2000):
+    # steps=2000: LeNet steps are ~0.25ms on-device through the scan
+    # path, so shorter scans leave the marginal noise-dominated (100
+    # steps measured 106% spread; 2000 steps ~10%)
     import paddle_tpu.fluid as fluid
 
     BATCH = batch
@@ -291,29 +313,28 @@ def _mnist_static(batch=256, steps=100):
     import jax
 
     feed = {"img": jax.device_put(img_b), "lbl": jax.device_put(lbl_b)}
-    exe.run(main, feed, [loss])  # compile
+    exe.run(main, feed, [loss])  # compile 1-step; materialize opt slots
 
-    def timed(n):
-        # pipelined dispatch — the real Executor usage pattern fetches the
-        # loss every N steps, not every step; the final fetch bounds
-        # completion of the whole dispatch queue
+    def run_n(n):
+        # Executor.run_n: the whole n-step loop is ONE jitted lax.scan
+        # dispatch (r03's pipelined per-step dispatch measured the
+        # tunnel's ~8-12ms call latency, not the model — 21.7k imgs/s
+        # at 46.6% spread; the scan path measures the Executor itself)
         t0 = time.perf_counter()
-        for _ in range(n - 1):
-            exe.run(main, feed, [])
-        lv = exe.run(main, feed, [loss])[0]
+        lv = exe.run_n(main, feed, [loss], n=n)[0]
         dt = time.perf_counter() - t0
         assert np.isfinite(lv).all()
         return dt
 
-    timed(10)  # warm the no-fetch path
-    dt = min(timed(steps) for _ in range(3)) / steps
+    dt, _, slopes = _marginal_step_time(run_n, steps)
     v = BATCH / dt
     # anchor: torch-CPU LeNet b256 Adam on this host, 8992.6 imgs/s
     # (single-thread; measured 2026-07-30, see BASELINE.md "Measured
     # anchors") — the CPUPlace-reference class for config 1
     return {"metric": "mnist_lenet_static_imgs_per_sec",
             "value": round(v, 2), "unit": "imgs/s",
-            "vs_baseline": round(v / 8992.6, 3)}
+            "vs_baseline": round(v / 8992.6, 3),
+            "spread": _spread([BATCH / s for s in slopes])}
 
 
 def _tunnel_profile(sample_bytes=4 << 20):
@@ -451,15 +472,15 @@ def _ctr_dnn_ps(batch=4096, chunks=12, merge_k=16):
 
         try:
             float(one_chunk())              # compile + warm
-            dt = None
-            for _ in range(2):              # best-of-2: host-RPC jitter
+            trials = []
+            for _ in range(3):              # median-of-3: host-RPC jitter
                 t0 = time.perf_counter()
                 for _ in range(chunks):
                     lv = one_chunk()
                 ms.drain()                  # grads actually at the PS
                 float(lv)                   # bound the dispatch queue
-                d = time.perf_counter() - t0
-                dt = d if dt is None else min(dt, d)
+                trials.append(BATCH * K * chunks
+                              / (time.perf_counter() - t0))
             host_plane = {
                 "ps_pull_s_per_chunk": round(
                     ms.pull_seconds / max(ms.chunks, 1), 3),
@@ -475,7 +496,7 @@ def _ctr_dnn_ps(batch=4096, chunks=12, merge_k=16):
         finally:
             ms.close()
             comm.stop()  # always reap the async send/recv threads
-        v = BATCH * K * chunks / dt
+        v = sorted(trials)[len(trials) // 2]
         # ---- published ceiling math (VERDICT r03 weak #1) ----
         # per chunk the tunnel serializes: 3 fixed-latency calls (row
         # device_put, scan dispatch, grad readback) + K*B*S*D*2 bytes
@@ -494,6 +515,7 @@ def _ctr_dnn_ps(batch=4096, chunks=12, merge_k=16):
                 "value": round(v, 2), "unit": "ex/s",
                 "vs_baseline": round(v / 125337.0, 4),
                 "merge_k": K, "wire_dtype": "bfloat16",
+                "spread": _spread(trials, kind="trials"),
                 "link_profile": link, "host_plane": host_plane,
                 "ceiling_ex_per_sec": round(ceiling, 1),
                 "frac_of_ceiling": round(v / ceiling, 3),

@@ -127,37 +127,7 @@ class Executor:
 
         from ..core.lod import LOD_OUTER_SUFFIX, LOD_SUFFIX, LoDTensor
 
-        feed_vals = {}
-        for k, v in feed.items():
-            if isinstance(v, Tensor):
-                feed_vals[k] = v._data
-            elif isinstance(v, LoDTensor) and v.lod_level > 0:
-                # pad+mask canonicalization at the edge (SURVEY §7.1):
-                # device sees [B, T, ...] + int32 lengths companion;
-                # outer nesting levels ride as offset-array companions
-                padded, lens = v.to_padded()
-                want = blk.vars.get(k)
-                if want is not None and want.dtype is not None:
-                    padded = padded.astype(want.dtype)
-                feed_vals[k] = jnp.asarray(padded)
-                feed_vals[k + LOD_SUFFIX] = jnp.asarray(lens)
-                for j, level in enumerate(v.lod()[:-1]):
-                    feed_vals[f"{k}{LOD_OUTER_SUFFIX}{j}"] = \
-                        jnp.asarray(np.asarray(level, np.int32))
-            elif isinstance(v, jax.Array):
-                # device-resident feed: reuse without a host round-trip
-                # (buffered_reader.cc role — callers pre-place hot batches)
-                want = blk.vars.get(k)
-                if want is not None and want.dtype is not None and \
-                        str(v.dtype) != str(jnp.dtype(want.dtype)):
-                    v = v.astype(want.dtype)
-                feed_vals[k] = v
-            else:
-                arr = np.asarray(v)
-                want = blk.vars.get(k)
-                if want is not None and want.dtype is not None:
-                    arr = arr.astype(want.dtype)
-                feed_vals[k] = jnp.asarray(arr)
+        feed_vals = self._materialize_feeds(blk, feed)
 
         # ensure persistables exist (startup program must have run)
         persist_vals = {}
@@ -218,6 +188,164 @@ class Executor:
             else:
                 out.append(Tensor._wrap(v))
         return out
+
+    # ------------------------------------------------------------------
+    def _materialize_feeds(self, blk, feed):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.lod import LOD_OUTER_SUFFIX, LOD_SUFFIX, LoDTensor
+
+        feed_vals = {}
+        for k, v in feed.items():
+            if isinstance(v, Tensor):
+                feed_vals[k] = v._data
+            elif isinstance(v, LoDTensor) and v.lod_level > 0:
+                # pad+mask canonicalization at the edge (SURVEY §7.1):
+                # device sees [B, T, ...] + int32 lengths companion;
+                # outer nesting levels ride as offset-array companions
+                padded, lens = v.to_padded()
+                want = blk.vars.get(k)
+                if want is not None and want.dtype is not None:
+                    padded = padded.astype(want.dtype)
+                feed_vals[k] = jnp.asarray(padded)
+                feed_vals[k + LOD_SUFFIX] = jnp.asarray(lens)
+                for j, level in enumerate(v.lod()[:-1]):
+                    feed_vals[f"{k}{LOD_OUTER_SUFFIX}{j}"] = \
+                        jnp.asarray(np.asarray(level, np.int32))
+            elif isinstance(v, jax.Array):
+                # device-resident feed: reuse without a host round-trip
+                # (buffered_reader.cc role — callers pre-place hot batches)
+                want = blk.vars.get(k)
+                if want is not None and want.dtype is not None and \
+                        str(v.dtype) != str(jnp.dtype(want.dtype)):
+                    v = v.astype(want.dtype)
+                feed_vals[k] = v
+            else:
+                arr = np.asarray(v)
+                want = blk.vars.get(k)
+                if want is not None and want.dtype is not None:
+                    arr = arr.astype(want.dtype)
+                feed_vals[k] = jnp.asarray(arr)
+        return feed_vals
+
+    def run_n(self, program=None, feed=None, fetch_list=None, n=1,
+              scope=None, return_numpy=True):
+        """Run the program n times as ONE jitted lax.scan over the
+        persistable state (params + optimizer slots) — a single device
+        dispatch instead of n, so per-call dispatch latency amortizes
+        n-fold (the ParallelExecutor run-loop role, TPU-native; on a
+        remote-tunneled chip this is the difference between measuring
+        the link and measuring the model). The same feed is applied
+        every step; fetches come from the LAST step.
+
+        Falls back to n sequential run() calls when the program carries
+        run-hooks (PS push/pull RPC must happen at every step boundary,
+        host-side)."""
+        from ..core.lod import LoDTensor
+
+        program = program or default_main_program()
+        scope = scope or _global_scope
+        feed = feed or {}
+        has_lod_feed = any(isinstance(v, LoDTensor) and v.lod_level > 0
+                           for v in feed.values())
+        if n <= 1 or has_lod_feed or getattr(program, "_run_hooks", ()):
+            # sequence feeds and per-step host hooks (PS RPC) keep the
+            # step-by-step path; run() handles their canonicalization
+            out = None
+            for _ in range(max(int(n), 1)):
+                out = self.run(program, feed, fetch_list, scope=scope,
+                               return_numpy=return_numpy)
+            return out
+        import jax
+
+        fetch_list = fetch_list or []
+        fetch_names = [f.name if hasattr(f, "name") else f
+                       for f in fetch_list]
+        blk = program.global_block()
+        persist_names = [v.name for v in blk.vars.values()
+                         if v.persistable]
+        feed_vals = self._materialize_feeds(blk, feed)
+        persist_vals = {nm: scope._values[nm] for nm in persist_names
+                        if scope._values.get(nm) is not None}
+        if len(persist_vals) != len(persist_names):
+            # optimizer slots (moments, lr counters) materialize on the
+            # first run; they must be IN the scan carry or every step
+            # would re-zero them. One regular run populates the scope.
+            out = self.run(program, feed, fetch_list, scope=scope,
+                           return_numpy=return_numpy)
+            n -= 1
+            if n < 1:
+                return out
+            persist_vals = {nm: scope._values[nm]
+                            for nm in persist_names
+                            if scope._values.get(nm) is not None}
+        sig = ("scan", n, program._uid, program._version,
+               tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                            for k, v in feed_vals.items())),
+               tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                            for k, v in persist_vals.items())),
+               tuple(fetch_names))
+        compiled = self._cache.get(sig)
+        if compiled is None:
+            compiled = self._compile_scan(program, list(feed_vals),
+                                          sorted(persist_vals),
+                                          fetch_names, n)
+            self._cache[sig] = compiled
+        program._seed_counter += 1
+        key = jax.random.PRNGKey(
+            (program.random_seed or 0) * 100003 + program._seed_counter)
+        fetches, new_persist = compiled(persist_vals, feed_vals, key)
+        scope._values.update(new_persist)
+        out = []
+        for name, v in zip(fetch_names, fetches):
+            out.append(np.asarray(v) if return_numpy
+                       else Tensor._wrap(v))
+        return out
+
+    def _compile_scan(self, program, feed_names, persist_names,
+                      fetch_names, n):
+        import jax
+        import jax.lax as lax
+
+        blk = program.global_block()
+        ops = list(blk.ops)
+
+        def step(persist, feed, rng_key):
+            from ..core.lod import LOD_SUFFIX
+
+            env = dict(persist)
+            env.update(feed)
+            ctx = lowering.LowerCtx(env, rng_key, training=True,
+                                    program=program,
+                                    base_env={**persist, **feed})
+            for op in ops:
+                if op.type in ("feed", "fetch"):
+                    continue
+                lowering.lower_op(ctx, op)
+            for m in fetch_names:  # trace-time check, zero runtime cost
+                if any(k.startswith(m + LOD_SUFFIX) for k in env):
+                    raise NotImplementedError(
+                        f"run_n: fetch var {m!r} is a sequence (LoD) "
+                        f"tensor; use run() per step for LoD fetches")
+            new_persist = {m: env[m] for m in persist_names}
+            return new_persist, tuple(env[m] for m in fetch_names)
+
+        def execute_n(persist, feed, rng_key):
+            keys = jax.random.split(rng_key, n)
+
+            def body(carry, k):
+                new_p, _ = step(carry, feed, k)  # fetches unused: DCE'd
+                return new_p, ()
+
+            # scan n-1 steps, then one unrolled final step for the
+            # fetches — stacking per-step fetch values as scan ys would
+            # allocate O(n) device memory only to keep the last slice
+            persist, _ = lax.scan(body, persist, keys[:-1])
+            persist, fetches = step(persist, feed, keys[-1])
+            return fetches, persist
+
+        return jax.jit(execute_n, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
     def _compile(self, program, feed_names, persist_names, fetch_names):

@@ -202,3 +202,54 @@ def test_variable_operator_sugar():
     o, = exe.run(main, feed={"x": np.ones((1, 4), np.float32)},
                  fetch_list=[out])
     np.testing.assert_allclose(o, 12.0, rtol=1e-6)
+
+
+def test_run_n_scan_matches_sequential_runs():
+    """Executor.run_n (one jitted lax.scan over the persistable state)
+    must produce the same params/loss as n sequential run() calls — the
+    ParallelExecutor run-loop role, TPU-native."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        out = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(out, y))
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+
+    rs = np.random.RandomState(0)
+    xb = rs.randn(16, 4).astype("float32")
+    yb = (xb.sum(1, keepdims=True) * 0.3).astype("float32")
+    feed = {"x": xb, "y": yb}
+
+    exe = fluid.Executor()
+    sc = fluid.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(startup)
+        # snapshot init: weight init is op-uid-keyed, so the comparison
+        # must run BOTH paths from the same program + same init values
+        init = {k: np.asarray(v).copy() for k, v in sc._values.items()
+                if v is not None}
+        first = None
+        for _ in range(9):
+            seq = exe.run(main, feed, [loss])[0]
+            first = first if first is not None else seq
+        w_seq = {k: np.asarray(v).copy() for k, v in sc._values.items()
+                 if v is not None and k.startswith("fc")}
+        for k, v in init.items():
+            sc.set_value(k, v.copy())
+        scan = exe.run_n(main, feed, [loss], n=9)[0]
+        w_scan = {k: np.asarray(v) for k, v in sc._values.items()
+                  if v is not None and k.startswith("fc")}
+
+    np.testing.assert_allclose(float(scan), float(seq), rtol=1e-5)
+    assert w_seq.keys() == w_scan.keys() and len(w_seq) >= 2
+    for k in w_seq:
+        np.testing.assert_allclose(w_scan[k], w_seq[k], rtol=1e-4,
+                                   atol=1e-6)
+    # training progressed across the scanned steps
+    assert float(scan) < float(first)
