@@ -251,9 +251,27 @@ class SubgraphMatcher:
 
     def __init__(self, pattern):
         self.pattern = pattern
-        # topological-ish order: nodes with no intra-pattern inputs first
-        self.order = sorted(
-            pattern, key=lambda n: len(pattern[n].get("inputs", {})))
+        # TRUE topological order over intra-pattern dependencies: a node
+        # binds only after every node it consumes from. (Sorting by
+        # input-count alone put a 1-input consumer of a 2-input node
+        # first, where its producer check could never succeed.)
+        deps = {}
+        for n, tpl in pattern.items():
+            srcs = set()
+            for src in tpl.get("inputs", {}).values():
+                srcs.add(src[0] if isinstance(src, tuple) else src)
+            deps[n] = srcs
+        order = []
+        remaining = dict(deps)
+        while remaining:
+            ready = sorted(n for n, d in remaining.items()
+                           if d <= set(order))
+            if not ready:
+                raise ValueError(
+                    f"cyclic pattern dependencies: {sorted(remaining)}")
+            order.append(ready[0])
+            remaining.pop(ready[0])
+        self.order = order
 
     def _attr_ok(self, op, tpl):
         for k, want in tpl.get("attrs", {}).items():
@@ -338,7 +356,8 @@ def multihead_matmul_fuse_pass(program, scope=None):
             prev = "qk"
             if with_scale:
                 pat["scale"] = {"type": "scale",
-                                "inputs": {"X": (prev, True)}}
+                                "inputs": {"X": (prev, True)},
+                                "attrs": {"bias": lambda v: not v}}
                 prev = "scale"
             if with_mask:
                 pat["mask"] = {"type": "elementwise_add",
@@ -443,40 +462,545 @@ def conv_elementwise_add_act_fuse_pass(program, scope=None):
     return program
 
 
-def _fc_rnn_fuse(program, scope, rnn_type, fused_type, gate_mult):
+def _fc_rnn_emit(blk, program, mul, rnn, fused_type, bias_name=None):
+    idx = blk.ops.index(rnn)    # after every input's producer
+    inputs = {"X": [mul.input("X")[0]],
+              "WeightX": [mul.input("Y")[0]],
+              "WeightH": [rnn.input("Weight")[0]]}
+    for slot in ("Bias", "H0", "C0"):
+        if rnn.input(slot):
+            inputs[slot] = [rnn.input(slot)[0]]
+    if bias_name is not None:
+        inputs["Bias"] = [bias_name]
+    outputs = {"Hidden": [rnn.output("Hidden")[0]]}
+    if fused_type == "fusion_lstm" and rnn.output("Cell"):
+        outputs["Cell"] = [rnn.output("Cell")[0]]
+    blk._insert_op(idx, fused_type, inputs=inputs, outputs=outputs,
+                   attrs=dict(rnn.attrs))
+
+
+def _fc_rnn_fuse(program, scope, rnn_type, fused_type, gate_mult,
+                 include_bias_form=False):
     blk = program.global_block()
+    if include_bias_form and scope is not None:
+        # fc form: mul + elementwise_add(projection bias) + rnn. The fc
+        # bias merges into the fusion op's gate bias by addition (the
+        # reference fc_gru/fc_lstm passes build the same combined bias),
+        # which needs the weights — scope-gated.
+        pat = {
+            "mul": {"type": "mul"},
+            "badd": {"type": "elementwise_add",
+                     "inputs": {"X": ("mul", True)}},
+            "rnn": {"type": rnn_type,
+                    "inputs": {"Input": ("badd", True)}},
+        }
+        for m in SubgraphMatcher(pat).match(program):
+            mul, badd, rnn = m["mul"], m["badd"], m["rnn"]
+            bname = badd.input("Y")[0]
+            fcb = scope.get_value(bname)
+            if fcb is None or np.asarray(fcb).ndim > 1 or \
+                    badd.attrs.get("axis", -1) not in (-1, 1):
+                continue
+            fcb = np.asarray(fcb, np.float32).ravel()
+            comb = fcb.reshape(1, -1)  # rnn bias convention: [1, k*D]
+            if rnn.input("Bias"):
+                rb = scope.get_value(rnn.input("Bias")[0])
+                if rb is None:
+                    continue
+                comb = np.asarray(rb, np.float32).copy()
+                if comb.size < fcb.size:
+                    continue  # gate widths disagree: leave unfused
+                comb.reshape(-1)[:fcb.size] += fcb
+            cname = f"{bname}@{fused_type}_combined"
+            scope.set_value(cname, comb)
+            blk.create_var(name=cname, shape=list(comb.shape),
+                           dtype=np.float32, persistable=True)
+            _fc_rnn_emit(blk, program, mul, rnn, fused_type,
+                         bias_name=cname)
+            IrGraph(program).remove_ops([mul, badd, rnn])
+    # bare mul form (mul_gru/mul_lstm role)
     pat = {
         "mul": {"type": "mul"},
         "rnn": {"type": rnn_type, "inputs": {"Input": ("mul", True)}},
     }
     for m in SubgraphMatcher(pat).match(program):
-        mul, rnn = m["mul"], m["rnn"]
-        idx = blk.ops.index(rnn)    # after every input's producer
-        inputs = {"X": [mul.input("X")[0]],
-                  "WeightX": [mul.input("Y")[0]],
-                  "WeightH": [rnn.input("Weight")[0]]}
-        for slot in ("Bias", "H0", "C0"):
-            if rnn.input(slot):
-                inputs[slot] = [rnn.input(slot)[0]]
-        outputs = {"Hidden": [rnn.output("Hidden")[0]]}
-        if fused_type == "fusion_lstm" and rnn.output("Cell"):
-            outputs["Cell"] = [rnn.output("Cell")[0]]
-        blk._insert_op(
-            idx, fused_type, inputs=inputs, outputs=outputs,
-            attrs=dict(rnn.attrs))
-        IrGraph(program).remove_ops([mul, rnn])
+        _fc_rnn_emit(blk, program, m["mul"], m["rnn"], fused_type)
+        IrGraph(program).remove_ops([m["mul"], m["rnn"]])
     program._bump()
     return program
 
 
 @register_pass("fc_gru_fuse_pass")
 def fc_gru_fuse_pass(program, scope=None):
-    """mul (input projection) + gru -> fusion_gru
-    (ir/fc_gru_fuse_pass.cc)."""
-    return _fc_rnn_fuse(program, scope, "gru", "fusion_gru", 3)
+    """mul [+ projection-bias add] + gru -> fusion_gru
+    (ir/fc_gru_fuse_pass.cc); the biased form merges the fc bias into
+    the gate bias and needs the scope."""
+    return _fc_rnn_fuse(program, scope, "gru", "fusion_gru", 3,
+                        include_bias_form=True)
 
 
 @register_pass("fc_lstm_fuse_pass")
 def fc_lstm_fuse_pass(program, scope=None):
-    """mul + lstm -> fusion_lstm (ir/fc_lstm_fuse_pass.cc)."""
+    """mul [+ bias add] + lstm -> fusion_lstm
+    (ir/fc_lstm_fuse_pass.cc)."""
+    return _fc_rnn_fuse(program, scope, "lstm", "fusion_lstm", 4,
+                        include_bias_form=True)
+
+
+@register_pass("mul_gru_fuse_pass")
+def mul_gru_fuse_pass(program, scope=None):
+    """bare mul + gru -> fusion_gru (ir/mul_gru_fuse_pass.cc — the
+    projection-without-bias variant of fc_gru)."""
+    return _fc_rnn_fuse(program, scope, "gru", "fusion_gru", 3)
+
+
+@register_pass("mul_lstm_fuse_pass")
+def mul_lstm_fuse_pass(program, scope=None):
+    """bare mul + lstm -> fusion_lstm (ir/mul_lstm_fuse_pass.cc)."""
     return _fc_rnn_fuse(program, scope, "lstm", "fusion_lstm", 4)
+
+
+# ---------------------------------------------------------------------------
+# r04: layernorm fuse family (paddle_pass_builder.cc GPU/CPU lists)
+
+@register_pass("embedding_eltwise_layernorm_fuse_pass")
+def embedding_eltwise_layernorm_fuse_pass(program, scope=None):
+    """N lookup_tables summed then layer_norm'd (the transformer
+    word+pos[+sent] embedding stem) -> one fused_embedding_eltwise_
+    layernorm op (ir/embedding_eltwise_layernorm_fuse_pass.cc)."""
+    blk = program.global_block()
+    for lt in ("lookup_table_v2", "lookup_table"):
+        for n_emb in (3, 2):
+            pat = {f"lk{i}": {"type": lt} for i in range(n_emb)}
+            pat["add0"] = {"type": "elementwise_add",
+                           "inputs": {"X": ("lk0", True),
+                                      "Y": ("lk1", True)}}
+            prev = "add0"
+            for i in range(2, n_emb):
+                pat[f"add{i - 1}"] = {
+                    "type": "elementwise_add",
+                    "inputs": {"X": (prev, True),
+                               "Y": (f"lk{i}", True)}}
+                prev = f"add{i - 1}"
+            # the fused lowering normalizes over the LAST axis of the
+            # [B, T, D] embedding sum, i.e. begin_norm_axis == 2
+            pat["ln"] = {"type": "layer_norm",
+                         "inputs": {"X": (prev, True)},
+                         "attrs": {"begin_norm_axis":
+                                   lambda v: v in (2, -1)}}
+            for m in SubgraphMatcher(pat).match(program):
+                ln = m["ln"]
+                ids = [m[f"lk{i}"].input("Ids")[0]
+                       for i in range(n_emb)]
+                embs = [m[f"lk{i}"].input("W")[0]
+                        for i in range(n_emb)]
+                idx = blk.ops.index(ln)
+                blk._insert_op(
+                    idx, "fused_embedding_eltwise_layernorm",
+                    inputs={"Ids": ids, "Embs": embs,
+                            "Scale": [ln.input("Scale")[0]],
+                            "Bias": [ln.input("Bias")[0]]},
+                    outputs={"Out": [ln.output("Y")[0]]},
+                    attrs={"epsilon": ln.attrs.get("epsilon", 1e-5)})
+                IrGraph(program).remove_ops(
+                    [m[k] for k in pat])
+    program._bump()
+    return program
+
+
+@register_pass("fc_elementwise_layernorm_fuse_pass")
+def fc_elementwise_layernorm_fuse_pass(program, scope=None):
+    """fc -> elementwise_add(residual) -> layer_norm collapses into one
+    fused_fc_elementwise_layernorm op
+    (ir/fc_elementwise_layernorm_fuse_pass.cc). Run AFTER fc_fuse."""
+    blk = program.global_block()
+
+    def _is_residual(name):
+        try:
+            v = blk.var(name)
+        except ValueError:
+            return True  # intermediate: fine
+        shape = v.shape or []
+        return not (getattr(v, "persistable", False) and len(shape) == 1)
+
+    for fc_slot in ("X", "Y"):  # residual add can put fc on either side
+        other = "Y" if fc_slot == "X" else "X"
+        pat = {
+            "fc": {"type": "fc"},
+            "add": {"type": "elementwise_add",
+                    "inputs": {fc_slot: ("fc", True)}},
+            "ln": {"type": "layer_norm", "inputs": {"X": ("add", True)}},
+        }
+        for m in SubgraphMatcher(pat).match(program):
+            fc, add, ln = m["fc"], m["add"], m["ln"]
+            if not _is_residual(add.input(other)[0]):
+                continue  # a plain bias add is fc's own business
+            xin = (fc.input("Input") or fc.input("X"))[0]
+            w = (fc.input("W") or fc.input("Y"))[0]
+            inputs = {"X": [xin], "W": [w],
+                      "Y": [add.input(other)[0]],
+                      "Scale": [ln.input("Scale")[0]],
+                      "Bias1": [ln.input("Bias")[0]]}
+            if fc.input("Bias"):
+                inputs["Bias0"] = [fc.input("Bias")[0]]
+            idx = blk.ops.index(ln)
+            blk._insert_op(
+                idx, "fused_fc_elementwise_layernorm",
+                inputs=inputs,
+                outputs={"Out": [ln.output("Y")[0]]},
+                attrs={"epsilon": ln.attrs.get("epsilon", 1e-5),
+                       "begin_norm_axis": ln.attrs.get(
+                           "begin_norm_axis", 1),
+                       "in_num_col_dims": fc.attrs.get(
+                           "in_num_col_dims", 1)})
+            IrGraph(program).remove_ops([fc, add, ln])
+    program._bump()
+    return program
+
+
+@register_pass("skip_layernorm_fuse_pass")
+def skip_layernorm_fuse_pass(program, scope=None):
+    """elementwise_add(residual join) -> layer_norm becomes one
+    skip_layernorm op (ir/skip_layernorm_fuse_pass.cc). Run AFTER the
+    more specific embedding/fc layernorm fuses."""
+    blk = program.global_block()
+
+    def _is_feature(name):
+        try:
+            v = blk.var(name)
+        except ValueError:
+            return True
+        shape = v.shape or []
+        return not (getattr(v, "persistable", False) and len(shape) <= 1)
+
+    pat = {
+        # the skip_layernorm lowering does a plain trailing-broadcast
+        # x + y: a mid-axis add (axis attr set) must not match
+        "add": {"type": "elementwise_add",
+                "attrs": {"axis": lambda v: v in (None, -1)}},
+        "ln": {"type": "layer_norm", "inputs": {"X": ("add", True)}},
+    }
+    for m in SubgraphMatcher(pat).match(program):
+        add, ln = m["add"], m["ln"]
+        if not (_is_feature(add.input("X")[0])
+                and _is_feature(add.input("Y")[0])):
+            continue
+        idx = blk.ops.index(ln)
+        blk._insert_op(
+            idx, "skip_layernorm",
+            inputs={"X": [add.input("X")[0]],
+                    "Y": [add.input("Y")[0]],
+                    "Scale": [ln.input("Scale")[0]],
+                    "Bias": [ln.input("Bias")[0]]},
+            outputs={"Out": [ln.output("Y")[0]]},
+            attrs={"epsilon": ln.attrs.get("epsilon", 1e-5),
+                   "begin_norm_axis": ln.attrs.get("begin_norm_axis",
+                                                   1)})
+        IrGraph(program).remove_ops([add, ln])
+    program._bump()
+    return program
+
+
+# ---------------------------------------------------------------------------
+# r04: CTR / sequence fuse family (paddle_pass_builder.cc CPU list)
+
+@register_pass("seqconv_eltadd_relu_fuse_pass")
+def seqconv_eltadd_relu_fuse_pass(program, scope=None):
+    """sequence_conv + bias add + relu -> fusion_seqconv_eltadd_relu
+    (ir/seqconv_eltadd_relu_fuse_pass.cc)."""
+    blk = program.global_block()
+
+    def _is_bias(name):
+        try:
+            v = blk.var(name)
+        except ValueError:
+            return False
+        return bool(getattr(v, "persistable", False)) and \
+            len(v.shape or []) == 1
+
+    pat = {
+        "sc": {"type": "sequence_conv"},
+        "add": {"type": "elementwise_add",
+                "inputs": {"X": ("sc", True)},
+                "attrs": {"axis": lambda v: v in (None, -1, 1)}},
+        "act": {"type": "relu", "inputs": {"X": ("add", True)}},
+    }
+    for m in SubgraphMatcher(pat).match(program):
+        sc, add, act = m["sc"], m["add"], m["act"]
+        if not _is_bias(add.input("Y")[0]):
+            continue  # residual join, not a bias: leave unfused
+        idx = blk.ops.index(act)
+        blk._insert_op(
+            idx, "fusion_seqconv_eltadd_relu",
+            inputs={"X": [sc.input("X")[0]],
+                    "Filter": [sc.input("Filter")[0]],
+                    "Bias": [add.input("Y")[0]]},
+            outputs={"Out": [act.output("Out")[0]]},
+            attrs={k: sc.attrs[k]
+                   for k in ("contextLength", "contextStart")
+                   if k in sc.attrs})
+        IrGraph(program).remove_ops([sc, add, act])
+    program._bump()
+    return program
+
+
+@register_pass("repeated_fc_relu_fuse_pass")
+def repeated_fc_relu_fuse_pass(program, scope=None):
+    """>=2 consecutive (fc -> relu) pairs -> one fusion_repeated_fc_relu
+    (ir/repeated_fc_relu_fuse_pass.cc). Run AFTER fc_fuse."""
+    g = IrGraph(program)
+    blk = program.global_block()
+    used = set()
+    chains = []
+    def _is_2d_fc(fc):
+        # the fused lowering contracts the LAST dim only: the chain
+        # must be plain 2-D matmuls (ncol==1 over a rank-2 input)
+        if fc.attrs.get("in_num_col_dims", 1) != 1:
+            return False
+        xname = (fc.input("Input") or fc.input("X"))[0]
+        try:
+            shape = blk.var(xname).shape or []
+        except ValueError:
+            return False  # unknown rank: leave unfused
+        return len(shape) == 2
+
+    for op in blk.ops:
+        if op.type != "fc" or id(op) in used or not op.input("Bias") \
+                or not _is_2d_fc(op):
+            continue
+        chain = []
+        cur = op
+        while (cur is not None and cur.type == "fc"
+               and cur.input("Bias") and id(cur) not in used
+               and cur.attrs.get("in_num_col_dims", 1) == 1):
+            cons = g.var_consumers(cur.output("Out")[0])
+            if len(cons) != 1 or cons[0].type != "relu":
+                break
+            relu = cons[0]
+            chain.append((cur, relu))
+            nxt = g.var_consumers(relu.output("Out")[0])
+            cur = nxt[0] if len(nxt) == 1 else None
+        if len(chain) >= 2:
+            for fc, relu in chain:
+                used.add(id(fc))
+                used.add(id(relu))
+            chains.append(chain)
+    dead = []
+    for chain in chains:
+        first_fc = chain[0][0]
+        last_relu = chain[-1][1]
+        idx = blk.ops.index(last_relu)
+        blk._insert_op(
+            idx, "fusion_repeated_fc_relu",
+            inputs={"X": [(first_fc.input("Input")
+                           or first_fc.input("X"))[0]],
+                    "W": [(fc.input("W") or fc.input("Y"))[0]
+                          for fc, _ in chain],
+                    "Bias": [fc.input("Bias")[0] for fc, _ in chain]},
+            outputs={"Out": [last_relu.output("Out")[0]]})
+        for fc, relu in chain:
+            dead += [fc, relu]
+    IrGraph(program).remove_ops(dead)
+    program._bump()
+    return program
+
+
+@register_pass("squared_mat_sub_fuse_pass")
+def squared_mat_sub_fuse_pass(program, scope=None):
+    """scalar * ((x@y)^2 - x^2 @ y^2) -> fusion_squared_mat_sub
+    (ir/squared_mat_sub_fuse_pass.cc)."""
+    blk = program.global_block()
+    for with_scale in (True, False):
+        pat = {
+            "mm1": {"type": "matmul",
+                    "attrs": {"transpose_X": lambda v: not v,
+                              "transpose_Y": lambda v: not v}},
+            "sqxy": {"type": "square", "inputs": {"X": ("mm1", True)}},
+            "sqx": {"type": "square"},
+            "sqy": {"type": "square"},
+            "mm2": {"type": "matmul",
+                    "inputs": {"X": ("sqx", True), "Y": ("sqy", True)}},
+            "sub": {"type": "elementwise_sub",
+                    "inputs": {"X": ("sqxy", True), "Y": ("mm2", True)}},
+        }
+        last = "sub"
+        if with_scale:
+            # only a pure multiplier folds into `scalar`; a scale with
+            # a bias term must stay a separate op
+            pat["scale"] = {"type": "scale",
+                            "inputs": {"X": ("sub", True)},
+                            "attrs": {"bias": lambda v: not v}}
+            last = "scale"
+        for m in SubgraphMatcher(pat).match(program):
+            # the squared operands must be THE matmul operands
+            if m["sqx"].input("X") != [m["mm1"].input("X")[0]] or \
+                    m["sqy"].input("X") != [m["mm1"].input("Y")[0]]:
+                continue
+            scalar = float(m["scale"].attrs.get("scale", 1.0)) \
+                if with_scale else 1.0
+            out = m[last].output("Out")[0]
+            idx = blk.ops.index(m[last])
+            blk._insert_op(
+                idx, "fusion_squared_mat_sub",
+                inputs={"X": [m["mm1"].input("X")[0]],
+                        "Y": [m["mm1"].input("Y")[0]]},
+                outputs={"Out": [out]},
+                attrs={"scalar": scalar})
+            IrGraph(program).remove_ops([m[k] for k in pat])
+    program._bump()
+    return program
+
+
+@register_pass("transpose_flatten_concat_fuse_pass")
+def transpose_flatten_concat_fuse_pass(program, scope=None):
+    """N x (transpose2 -> flatten2) -> concat becomes one
+    fusion_transpose_flatten_concat op
+    (ir/transpose_flatten_concat_fuse_pass.cc)."""
+    g = IrGraph(program)
+    blk = program.global_block()
+    rewrites = []
+    for concat in [o for o in blk.ops if o.type == "concat"]:
+        branches = []
+        for name in concat.input("X"):
+            fl = g.var_producer(name)
+            if fl is None or fl.type != "flatten2" or \
+                    len(g.var_consumers(name)) != 1:
+                break
+            tr = g.var_producer(fl.input("X")[0])
+            if tr is None or tr.type != "transpose2" or \
+                    len(g.var_consumers(fl.input("X")[0])) != 1:
+                break
+            branches.append((tr, fl))
+        else:
+            if (len(branches) >= 2
+                    and len({tuple(tr.attrs.get("axis", ()))
+                             for tr, _ in branches}) == 1
+                    and len({fl.attrs.get("axis", 1)
+                             for _, fl in branches}) == 1):
+                rewrites.append((concat, branches))
+    dead = []
+    for concat, branches in rewrites:
+        idx = blk.ops.index(concat)
+        blk._insert_op(
+            idx, "fusion_transpose_flatten_concat",
+            inputs={"X": [tr.input("X")[0] for tr, _ in branches]},
+            outputs={"Out": [concat.output("Out")[0]]},
+            attrs={"trans_axis": list(branches[0][0].attrs["axis"]),
+                   "flatten_axis": branches[0][1].attrs.get("axis", 1),
+                   "concat_axis": concat.attrs.get("axis", 1)})
+        for tr, fl in branches:
+            dead += [tr, fl]
+        dead.append(concat)
+    IrGraph(program).remove_ops(dead)
+    program._bump()
+    return program
+
+
+# ---------------------------------------------------------------------------
+# r04: conv+bn folding variants (weights mutate, so a scope is needed)
+
+def _plan_bn_fold(scope, conv, bn, bias_add=None):
+    """Validate + compute one fold WITHOUT mutating anything. Returns
+    (w_name, new_w, new_bias) or None when weights are missing or
+    shapes disagree (grouped convs) — a failed plan must never leave a
+    half-folded program/scope behind."""
+    w_name = conv.input("Filter")[0]
+    vals = [scope.get_value(w_name)] + [
+        scope.get_value(bn.input(s_)[0])
+        for s_ in ("Scale", "Bias", "Mean", "Variance")]
+    b0 = None
+    if bias_add is not None:
+        b0 = scope.get_value(bias_add.input("Y")[0])
+        vals.append(b0)
+    if any(v is None for v in vals):
+        return None
+    w, gamma, beta, mean, var = (np.asarray(v, np.float32)
+                                 for v in vals[:5])
+    eps = bn.attrs.get("epsilon", 1e-5)
+    scale = gamma / np.sqrt(var + eps)
+    c_axis = 1 if conv.type == "conv2d_transpose" else 0
+    if w.ndim < 2 or w.shape[c_axis] != scale.size:
+        return None  # grouped/unexpected layout: leave unfused
+    shape = [1] * w.ndim
+    shape[c_axis] = -1
+    base = np.asarray(b0, np.float32).reshape(-1) if b0 is not None \
+        else 0.0
+    if b0 is not None and np.asarray(b0).size != scale.size:
+        return None
+    return (w_name, w * scale.reshape(shape),
+            (base - mean) * scale + beta)
+
+
+def _apply_bn_fold(program, conv, bn, plan):
+    w_name, new_w, new_bias = plan
+    blk = program.global_block()
+    bias_name = w_name + "@bn_folded_bias"
+    blk.create_var(name=bias_name, shape=[int(new_bias.size)],
+                   dtype=np.float32, persistable=True)
+    conv_out = conv.output("Output")[0]
+    tmp = conv_out + "@prefold"
+    blk.create_var(name=tmp)
+    conv.outputs["Output"] = [tmp]
+    idx = blk.ops.index(bn)
+    blk._insert_op(idx, "elementwise_add",
+                   inputs={"X": [tmp], "Y": [bias_name]},
+                   outputs={"Out": [bn.output("Y")[0]]},
+                   attrs={"axis": 1})
+    return bias_name
+
+
+@register_pass("conv_eltwiseadd_bn_fuse_pass")
+def conv_eltwiseadd_bn_fuse_pass(program, scope=None):
+    """conv2d + bias add + batch_norm(is_test) -> folded conv + one add
+    (ir/conv_eltwiseadd_bn_fuse_pass.cc). Plans every fold first, then
+    mutates (conv_bn_fuse_pass discipline)."""
+    if scope is None:
+        raise ValueError("conv_eltwiseadd_bn_fuse_pass needs the scope "
+                         "holding the conv/bn weights")
+    plans = []
+    for m in SubgraphMatcher({
+            "conv": {"type": "conv2d"},
+            "add": {"type": "elementwise_add",
+                    "inputs": {"X": ("conv", True)}},
+            "bn": {"type": "batch_norm",
+                   "attrs": {"is_test": lambda v: bool(v)},
+                   "inputs": {"X": ("add", True)}}}).match(program):
+        plan = _plan_bn_fold(scope, m["conv"], m["bn"],
+                             bias_add=m["add"])
+        if plan is not None:
+            plans.append((m, plan))
+    dead = []
+    for m, plan in plans:
+        scope.set_value(plan[0], plan[1])
+        bias_name = _apply_bn_fold(program, m["conv"], m["bn"], plan)
+        scope.set_value(bias_name, plan[2])
+        dead += [m["add"], m["bn"]]
+    IrGraph(program).remove_ops(dead)
+    program._bump()
+    return program
+
+
+@register_pass("conv_transpose_bn_fuse_pass")
+def conv_transpose_bn_fuse_pass(program, scope=None):
+    """conv2d_transpose + batch_norm(is_test) -> folded weights
+    (ir/conv_transpose_bn_fuse_pass.cc)."""
+    if scope is None:
+        raise ValueError("conv_transpose_bn_fuse_pass needs the scope "
+                         "holding the conv/bn weights")
+    g = IrGraph(program)
+    plans = []
+    for conv, bn in g.find_chains("conv2d_transpose", "batch_norm"):
+        if not bn.attrs.get("is_test", False):
+            continue
+        plan = _plan_bn_fold(scope, conv, bn)
+        if plan is not None:
+            plans.append((conv, bn, plan))
+    dead = []
+    for conv, bn, plan in plans:
+        scope.set_value(plan[0], plan[1])
+        bias_name = _apply_bn_fold(program, conv, bn, plan)
+        scope.set_value(bias_name, plan[2])
+        dead.append(bn)
+    g.remove_ops(dead)
+    program._bump()
+    return program

@@ -1097,3 +1097,65 @@ def _fused_sdpa(ctx, op):
     # scale/alpha into `scale`
     out = A.sdpa(q, k, v, mask=mask, scale=scale)
     ctx.out(op, "Out", out[0] if squeeze else out)
+
+
+# ---------------------------------------------------------------------------
+# r04 inference-fuse targets (ir.py layernorm/sequence fuse families)
+
+def _flat_ln(x, scale, bias, eps, begin):
+    """layer_norm over [begin:] with flat scale/bias (layer_norm_op.cc
+    flattened-parameter convention, shared by the fused LN ops)."""
+    if scale is not None:
+        scale = scale.reshape(x.shape[begin:])
+    if bias is not None:
+        bias = bias.reshape(x.shape[begin:])
+    return K.layer_norm(x, scale, bias, eps, begin)
+
+
+@register("skip_layernorm")
+def _skip_layernorm(ctx, op):
+    """skip_layernorm_op: layer_norm(X + Y) — the residual+LN pair the
+    skip_layernorm_fuse_pass forms (ir/skip_layernorm_fuse_pass.cc)."""
+    x = ctx.inp(op, "X")
+    y = ctx.inp(op, "Y")
+    ctx.out(op, "Out", _flat_ln(
+        x + y, ctx.inp(op, "Scale"), ctx.inp(op, "Bias"),
+        op.attrs.get("epsilon", 1e-5),
+        int(op.attrs.get("begin_norm_axis", x.ndim - 1))))
+
+
+@register("fused_fc_elementwise_layernorm")
+def _fused_fc_eltwise_ln(ctx, op):
+    """fused_fc_elementwise_layernorm_op.cc: layer_norm(fc(X) + Y)."""
+    x = ctx.inp(op, "X")
+    w = ctx.inp(op, "W")
+    y = ctx.inp(op, "Y")
+    ncol = int(op.attrs.get("in_num_col_dims", 1))
+    out = K.mul_op(x, w, ncol, 1)
+    b0 = ctx.inp(op, "Bias0")
+    if b0 is not None:
+        out = out + b0
+    out = out.reshape(y.shape) + y
+    ctx.out(op, "Out", _flat_ln(
+        out, ctx.inp(op, "Scale"), ctx.inp(op, "Bias1"),
+        op.attrs.get("epsilon", 1e-5),
+        int(op.attrs.get("begin_norm_axis", out.ndim - 1))))
+
+
+@register("fusion_transpose_flatten_concat")
+def _fusion_transpose_flatten_concat(ctx, op):
+    """fusion_transpose_flatten_concat_op.cc: per input transpose(axis)
+    then flatten(flatten_axis) then concat(concat_axis)."""
+    jnp = _jnp()
+    xs = ctx.inps(op, "X")
+    trans = [int(a) for a in op.attrs["trans_axis"]]
+    flat = int(op.attrs.get("flatten_axis", 1))
+    cat = int(op.attrs.get("concat_axis", 1))
+    outs = []
+    for x in xs:
+        t = jnp.transpose(x, trans)
+        lead = 1
+        for d in t.shape[:flat]:
+            lead *= d
+        outs.append(t.reshape(lead, -1))
+    ctx.out(op, "Out", jnp.concatenate(outs, axis=cat))

@@ -117,9 +117,20 @@ class Predictor:
                               "multihead_matmul_fuse_pass",
                               "conv_elementwise_add_act_fuse_pass",
                               "fc_gru_fuse_pass", "fc_lstm_fuse_pass",
-                              "fc_fuse_pass"])
+                              "embedding_eltwise_layernorm_fuse_pass",
+                              "fc_fuse_pass",
+                              # after fc_fuse: these match formed fc ops
+                              "fc_elementwise_layernorm_fuse_pass",
+                              "skip_layernorm_fuse_pass",
+                              "seqconv_eltadd_relu_fuse_pass",
+                              "repeated_fc_relu_fuse_pass",
+                              "squared_mat_sub_fuse_pass",
+                              "transpose_flatten_concat_fuse_pass"])
             try:
-                apply_pass(prog, "conv_bn_fuse_pass",
+                # weight-mutating folds (need the loaded params)
+                apply_pass(prog, ["conv_eltwiseadd_bn_fuse_pass",
+                                  "conv_bn_fuse_pass",
+                                  "conv_transpose_bn_fuse_pass"],
                            scope=_fx.global_scope())
             except Exception:
                 pass  # missing weights (program_only artifacts)
