@@ -150,8 +150,9 @@ class Executor:
                 self._cache[sig] = compiled
 
         program._seed_counter += 1
-        key = jax.random.PRNGKey(
-            (program.random_seed or 0) * 100003 + program._seed_counter)
+        key = jax.random.fold_in(jax.random.PRNGKey(
+            (program.random_seed or 0) * 100003 + program._seed_counter),
+            program._rng_tag())
         fetches, fetch_lods, new_persist = compiled(persist_vals, feed_vals,
                                                     key)
 
@@ -293,8 +294,9 @@ class Executor:
                                           fetch_names, n)
             self._cache[sig] = compiled
         program._seed_counter += 1
-        key = jax.random.PRNGKey(
-            (program.random_seed or 0) * 100003 + program._seed_counter)
+        key = jax.random.fold_in(jax.random.PRNGKey(
+            (program.random_seed or 0) * 100003 + program._seed_counter),
+            program._rng_tag())
         fetches, new_persist = compiled(persist_vals, feed_vals, key)
         scope._values.update(new_persist)
         out = []

@@ -182,8 +182,18 @@ class Operator:
         self.type = type
         # stable identity for PRNG key derivation: lowering folds this (not
         # a trace-order counter) into the rng stream, so a pruned re-trace
-        # (jax_autodiff) reproduces the exact masks of the eager pass
-        self._uid = next(Operator._uid_counter)
+        # (jax_autodiff) reproduces the exact masks of the eager pass.
+        # PROGRAM-local (not process-global): a program's random draws —
+        # weight init above all — must not depend on how many ops other
+        # programs created earlier in the process (reference random_seed
+        # reproducibility; a process-global counter made convergence
+        # tests order-sensitive).
+        prog = getattr(block, "program", None)
+        if prog is not None and hasattr(prog, "_next_op_uid"):
+            self._uid = prog._next_op_uid
+            prog._next_op_uid += 1
+        else:
+            self._uid = next(Operator._uid_counter)
         # canonical form: {slot: [var names]}
         self.inputs = {}
         for k, v in (inputs or {}).items():
@@ -305,6 +315,10 @@ class Program:
         # monotonic identity for executor caches: id(program) can alias a
         # GC'd-and-reallocated Program, a uid cannot
         self._uid = next(Program._uid_counter)
+        # per-program op identity stream (rng key derivation): fresh per
+        # program so draws don't depend on process history (plain int:
+        # deepcopy-able, unlike itertools.count)
+        self._next_op_uid = 1
         self._version = 0
         self._seed_counter = 0
         # parity attrs
@@ -322,6 +336,25 @@ class Program:
 
     def _bump(self):
         self._version += 1
+
+    def _rng_tag(self):
+        """Stable content fingerprint folded into the executor's rng
+        base key: per-program op uids restart at 1, so WITHOUT this two
+        different programs (startup vs main) would derive identical
+        per-op keys on their first runs — init draws correlating with
+        dropout masks. The fingerprint depends only on the program's
+        own content, never on process history."""
+        cached = getattr(self, "_rng_tag_cache", None)
+        if cached is not None and cached[0] == self._version:
+            return cached[1]
+        import zlib
+
+        sig = "|".join(
+            f"{op.type}:{','.join(sorted(op.output_arg_names))}"
+            for blk in self.blocks for op in blk.ops)
+        tag = zlib.crc32(sig.encode())
+        self._rng_tag_cache = (self._version, tag)
+        return tag
 
     def global_block(self):
         return self.blocks[0]

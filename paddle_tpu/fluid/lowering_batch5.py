@@ -579,38 +579,94 @@ def _ref_by_trainer_id(ctx, op):
         [lambda i=i: xs[i] for i in range(len(xs))]))
 
 
-_HASH_WARNED = [False]
+
+
+_XXP = tuple(np.uint64(p) for p in (
+    11400714785074694791, 14029467366897019727, 1609587929392839161,
+    9650029242287828579, 2870177450012600261))
+
+
+def _xxh64_lanes(lanes, seed):
+    """XXH64 over a row of little-endian u32 lanes (4*len(lanes) bytes),
+    vectorized across rows: every lane is a [N] uint64 array holding
+    u32 values. Returns [N] uint64 digests. Bit-exact with the
+    reference hash_op's XXH64(row_bytes, 4*last_dim, seed)
+    (operators/hash_op.h)."""
+    jnp = _jnp()
+    P1, P2, P3, P4, P5 = _XXP
+    length = np.uint64(4 * len(lanes))
+    c64 = jnp.uint64
+
+    def rotl(x, r):
+        r = np.uint64(r)
+        return (x << r) | (x >> (np.uint64(64) - r))
+
+    def rnd(acc, w):
+        acc = acc + w * P2
+        acc = rotl(acc, 31)
+        return acc * P1
+
+    words = [lanes[2 * j] | (lanes[2 * j + 1] << np.uint64(32))
+             for j in range(len(lanes) // 2)]
+    seed = c64(seed)
+    i = 0
+    if int(length) >= 32:
+        v1 = seed + P1 + P2
+        v2 = seed + P2
+        v3 = seed + np.uint64(0)
+        v4 = seed - P1
+        vs = [v1, v2, v3, v4]
+        nstripes = int(length) // 32
+        for s_ in range(nstripes):
+            for k in range(4):
+                vs[k] = rnd(vs[k], words[4 * s_ + k])
+        h = (rotl(vs[0], 1) + rotl(vs[1], 7) + rotl(vs[2], 12)
+             + rotl(vs[3], 18))
+        for k in range(4):
+            h = (h ^ rnd(jnp.zeros_like(vs[k]), vs[k])) * P1 + P4
+        i = nstripes * 4
+    else:
+        h = seed + P5
+    h = h + length
+    while i < len(words):
+        h = (h ^ rnd(jnp.zeros_like(words[i]), words[i]))
+        h = rotl(h, 27) * P1 + P4
+        i += 1
+    if len(lanes) % 2:                       # trailing 4-byte lane
+        h = h ^ (lanes[-1] * P1)
+        h = rotl(h, 23) * P2 + P3
+    h = h ^ (h >> np.uint64(33))
+    h = h * P2
+    h = h ^ (h >> np.uint64(29))
+    h = h * P3
+    h = h ^ (h >> np.uint64(32))
+    return h
 
 
 @register("hash")
 def _hash(ctx, op):
-    """hash_op.cc: num_hash deterministic hashes of each id row into
-    [0, mod_by). xxhash is replaced by a Fibonacci multiplicative mix —
-    the contract is determinism + spread, not a specific digest.
-
-    LOUD caveat: bucket assignments differ from the reference's xxhash64,
-    so REFERENCE-trained pyramid-hash-style embeddings will look up
-    different rows here. Fresh training is unaffected."""
+    """hash_op.h: num_hash XXH64 digests of each id row into
+    [0, mod_by), seeded by the hash index. Matches the reference
+    byte-for-byte, including its quirk of hashing sizeof(int) *
+    last_dim = 4*L bytes of the int64 row buffer (the first L
+    little-endian u32 lanes), so bucket ids align with artifacts
+    trained by the reference."""
     jnp = _jnp()
-    if not _HASH_WARNED[0]:
-        import warnings
-
-        warnings.warn(
-            "hash op uses a deterministic mix, not xxhash64: embeddings "
-            "trained by the reference framework against hash buckets "
-            "will NOT align — retrain, or re-bucket offline",
-            RuntimeWarning, stacklevel=2)
-        _HASH_WARNED[0] = True
     x = ctx.inp(op, "X")
-    num_hash = op.attrs.get("num_hash", 1)
-    mod_by = op.attrs.get("mod_by", 1)
-    seeds = jnp.arange(1, num_hash + 1, dtype=jnp.int64) * np.int64(
-        -7046029254386353131)  # 0x9E3779B97F4A7C15 as signed i64
+    num_hash = int(op.attrs.get("num_hash", 1))
+    mod_by = int(op.attrs.get("mod_by", 1))
+    import jax.lax as lax
+
     flat = x.reshape(x.shape[0], -1).astype(jnp.int64)
-    mixed = flat[:, None, :] * seeds[None, :, None]
-    mixed = jnp.bitwise_xor(mixed, mixed >> 29)
-    h = jnp.abs(mixed.sum(-1)) % mod_by          # [N, num_hash]
-    ctx.out(op, "Out", h[:, :, None])
+    L = flat.shape[1]
+    u = lax.bitcast_convert_type(flat, jnp.uint64)
+    mask32 = np.uint64(0xFFFFFFFF)
+    pairs = [(u[:, k] & mask32, (u[:, k] >> np.uint64(32)) & mask32)
+             for k in range((L + 1) // 2)]
+    lanes = [p for pair in pairs for p in pair][:L]
+    hs = [(_xxh64_lanes(lanes, s) % np.uint64(mod_by)).astype(jnp.int64)
+          for s in range(num_hash)]
+    ctx.out(op, "Out", jnp.stack(hs, axis=1)[:, :, None])
 
 
 @register("select_output")

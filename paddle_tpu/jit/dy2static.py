@@ -82,15 +82,40 @@ def _rewrap(raw, like):
     return Tensor._wrap(raw) if isinstance(like, Tensor) else raw
 
 
+def _is_slot_leaf(v):
+    from ..core.tensor import Tensor
+
+    return isinstance(v, Tensor) or v is UNDEF
+
+
+def _unwrap_tree(v):
+    """Unwrap a carry slot that may be a CONTAINER of Tensors (list
+    accumulation patterns — list_transformer.py territory)."""
+    import jax
+
+    return jax.tree.map(_unwrap, v, is_leaf=_is_slot_leaf)
+
+
+def _rewrap_tree(raw, like):
+    import jax
+
+    return jax.tree.map(_rewrap, raw, like, is_leaf=_is_slot_leaf)
+
+
 def _wrap_outputs(outs):
-    """Branch outputs normalize to Tensors for array leaves so both
-    branches produce one type scheme."""
+    """Branch outputs normalize to Tensors for array leaves (including
+    leaves inside list/tuple slots) so both branches produce one type
+    scheme."""
     import jax
 
     from ..core.tensor import Tensor
 
-    return tuple(Tensor._wrap(o) if isinstance(o, jax.Array) else o
-                 for o in outs)
+    def w(o):
+        return Tensor._wrap(o) if isinstance(o, jax.Array) else o
+
+    return tuple(
+        o if o is UNDEF else jax.tree.map(w, o, is_leaf=_is_slot_leaf)
+        for o in outs)
 
 
 def cond(pred, true_fn, false_fn, carry):
@@ -110,9 +135,9 @@ def cond(pred, true_fn, false_fn, carry):
         def run(defined_raw):
             full = list(carry)
             for j, i in enumerate(defined_idx):
-                full[i] = _rewrap(defined_raw[j], carry[i])
+                full[i] = _rewrap_tree(defined_raw[j], carry[i])
             outs = branch(tuple(full))
-            out_raw = tuple(_unwrap(o) for o in outs)
+            out_raw = tuple(_unwrap_tree(o) for o in outs)
             for o in out_raw:
                 if o is UNDEF:
                     raise ValueError(
@@ -123,7 +148,7 @@ def cond(pred, true_fn, false_fn, carry):
 
         return run
 
-    operand = tuple(_unwrap(carry[i]) for i in defined_idx)
+    operand = tuple(_unwrap_tree(carry[i]) for i in defined_idx)
     out_raw = jax.lax.cond(jnp.reshape(raw, ()).astype(bool),
                            make(true_fn), make(false_fn), operand)
     return _wrap_outputs(out_raw)
@@ -148,16 +173,16 @@ def while_loop(cond_fn, body_fn, carry):
                 "be initialized before the loop (XLA needs a fixed carry)")
 
     def lax_cond(c_raw):
-        full = tuple(_rewrap(r, o) for r, o in zip(c_raw, carry))
+        full = tuple(_rewrap_tree(r, o) for r, o in zip(c_raw, carry))
         return jnp.reshape(_unwrap(cond_fn(full)), ()).astype(bool)
 
     def lax_body(c_raw):
-        full = tuple(_rewrap(r, o) for r, o in zip(c_raw, carry))
+        full = tuple(_rewrap_tree(r, o) for r, o in zip(c_raw, carry))
         outs = body_fn(full)
-        return tuple(_unwrap(o) for o in outs)
+        return tuple(_unwrap_tree(o) for o in outs)
 
     out_raw = jax.lax.while_loop(lax_cond, lax_body,
-                                 tuple(_unwrap(v) for v in carry))
+                                 tuple(_unwrap_tree(v) for v in carry))
     return _wrap_outputs(out_raw)
 
 
@@ -675,6 +700,96 @@ class _LogicalTransformer(ast.NodeTransformer):
 _CONVERTED = {}
 
 
+def _rt_list_append(lst, v):
+    """Staged list append (list_transformer.py role): rebinding instead
+    of mutating lets the control-flow carry analysis see the list, so
+    appends inside traced if/while branches ride the lax carry."""
+    if isinstance(lst, list):
+        return lst + [v]
+    lst.append(v)          # non-list .append (e.g. LayerList): passthru
+    return lst
+
+
+def _rt_list_pop(lst, *idx):
+    if isinstance(lst, list):
+        i = idx[0] if idx else -1
+        return lst[:i] + lst[i:][1:], lst[i]
+    return lst, lst.pop(*idx)
+
+
+class _ListTransformer(ast.NodeTransformer):
+    """`lst.append(v)` / `lst.pop(i)` statements become REBINDING calls
+    (list_transformer.py's tensor-array rewrite, runtime-staged): the
+    list variable is assigned on every mutation, which puts it into the
+    if/while carry computed by the later control-flow transforms.
+
+    ONLY lists the function owns are rewritten — names first bound to a
+    list literal in the body. Rebinding a parameter/closure/global list
+    would silently stop mutating the caller's object (or raise
+    UnboundLocalError for closures)."""
+
+    def visit_FunctionDef(self, node):
+        params = {a.arg for a in node.args.args + node.args.kwonlyargs}
+        if node.args.vararg:
+            params.add(node.args.vararg.arg)
+        if node.args.kwarg:
+            params.add(node.args.kwarg.arg)
+        own = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name) \
+                    and isinstance(sub.value, (ast.List, ast.ListComp)):
+                own.add(sub.targets[0].id)
+        self._own = own - params
+        self.generic_visit(node)
+        return node
+
+    def _target(self, call):
+        if (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id in getattr(self, "_own", ())
+                and call.func.attr in ("append", "pop")):
+            return call.func.value.id, call.func.attr
+        return None, None
+
+    def visit_Expr(self, node):
+        self.generic_visit(node)
+        name, kind = self._target(node.value)
+        if kind == "append":
+            return ast.Assign(
+                targets=[ast.Name(id=name, ctx=ast.Store())],
+                value=ast.Call(
+                    func=ast.Name(id="__jst_list_append", ctx=ast.Load()),
+                    args=[ast.Name(id=name, ctx=ast.Load())]
+                    + node.value.args, keywords=[]))
+        if kind == "pop":
+            return ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[ast.Name(id=name, ctx=ast.Store()),
+                          ast.Name(id="__jst_popped__", ctx=ast.Store())],
+                    ctx=ast.Store())],
+                value=ast.Call(
+                    func=ast.Name(id="__jst_list_pop", ctx=ast.Load()),
+                    args=[ast.Name(id=name, ctx=ast.Load())]
+                    + node.value.args, keywords=[]))
+        return node
+
+    def visit_Assign(self, node):
+        self.generic_visit(node)
+        name, kind = self._target(node.value)
+        if kind == "pop" and len(node.targets) == 1:
+            return ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[ast.Name(id=name, ctx=ast.Store()),
+                          node.targets[0]], ctx=ast.Store())],
+                value=ast.Call(
+                    func=ast.Name(id="__jst_list_pop", ctx=ast.Load()),
+                    args=[ast.Name(id=name, ctx=ast.Load())]
+                    + node.value.args, keywords=[]))
+        return node
+
+
 class _SuperRewriter(ast.NodeTransformer):
     """Zero-arg super() relies on the implicit __class__ closure cell,
     which an exec-recompiled function lacks; rewrite to the explicit
@@ -709,6 +824,7 @@ def convert_to_static(fn):
         first_arg = fdef.args.args[0].arg if fdef.args.args else None
         sup = _SuperRewriter(first_arg)
         sup.visit(fdef)
+        fdef = _ListTransformer().visit(fdef)
         fdef = _ForToWhileTransformer().visit(fdef)
         fdef = _EarlyExitTransformer().apply(fdef)
         fdef = _LogicalTransformer().visit(fdef)
@@ -735,6 +851,8 @@ def convert_to_static(fn):
         glb["__jst_indexable"] = _rt_indexable
         glb["__jst_and"] = functools.partial(_rt_bool, op_name="and")
         glb["__jst_or"] = functools.partial(_rt_bool, op_name="or")
+        glb["__jst_list_append"] = _rt_list_append
+        glb["__jst_list_pop"] = _rt_list_pop
         # closures: bind current cell values by name (static snapshot)
         if fn.__closure__:
             for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):

@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# CI smoke for paddle_tpu (paddle/scripts/paddle_build.sh role, compact):
+#   1. full test suite on the virtual-CPU mesh
+#   2. quick per-op micro-benchmarks, compared against the committed
+#      OP_BENCH.json baseline (>2x step-time regressions fail the run
+#      only with CI_STRICT_PERF=1; they always print)
+#   3. bench.py CPU dry-run of the CTR config (exercises the native PS)
+# Usage: scripts/ci.sh [pytest-args...]
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+rc=0
+
+echo "== [1/3] pytest =="
+python -m pytest tests/ -q -x "$@" || rc=1
+
+echo "== [2/3] op micro-bench (quick, vs baseline) =="
+if python tools/op_bench.py --cpu --quick --compare; then
+  echo "op-bench: no >2x regressions"
+else
+  echo "op-bench: regressions detected (see above)"
+  if [ "${CI_STRICT_PERF:-0}" = "1" ]; then rc=1; fi
+fi
+
+echo "== [3/3] bench dry-run (ctr_ps, small, cpu) =="
+JAX_PLATFORMS=cpu python - <<'PY' || rc=1
+import _cpu_debug  # noqa: F401
+import bench
+
+r = bench._ctr_dnn_ps(batch=256, chunks=2, merge_k=2)
+assert "value" in r, r
+print("ctr dry-run ok:", r["value"], r["unit"])
+PY
+
+exit $rc

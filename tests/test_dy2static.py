@@ -405,3 +405,137 @@ class TestBreakContinueReturn:
                 np.asarray(jax.jit(run)(xv)),
                 np.asarray(while_break(paddle.to_tensor(xv))),
                 rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# r04 VERDICT #7: list + tensor-shape patterns
+# (dygraph_to_static/test_list.py, test_tensor_shape.py mirrors). The
+# runtime-staged design subsumes most of the reference's AST rewrites:
+# shapes are concrete at trace time and concrete-bound loops unroll, so
+# Python lists and x.shape arithmetic stage naturally; these tests pin
+# that down.
+
+def list_append_in_for(x):
+    out = []
+    for i in range(3):
+        out.append(x + i)
+    return paddle.stack(out).sum(0)
+
+
+def list_append_in_if(x):
+    out = []
+    if x.sum() > 0:
+        out.append(x * 2)
+    else:
+        out.append(x - 2)
+    out.append(x)
+    return paddle.concat(out, axis=-1)
+
+
+def list_pop_and_index(x):
+    out = []
+    for i in range(4):
+        out.append(x * i)
+    out.pop(0)
+    last = out.pop()
+    return out[0] + last
+
+
+def list_append_in_while(x):
+    out = []
+    i = 0
+    while i < x.shape[0]:
+        out.append(x[i] * (i + 1))
+        i += 1
+    return paddle.stack(out).mean()
+
+
+def shape_in_reshape(x):
+    b = x.shape[0]
+    c = x.shape[1]
+    return x.reshape([c, b]) * 2
+
+
+def shape_arithmetic(x):
+    numel = x.shape[0] * x.shape[1]
+    flat = x.reshape([numel])
+    return flat + float(numel)
+
+
+def shape_in_loop_bound(x):
+    s = paddle.to_tensor(np.float32(0.0))
+    for i in range(x.shape[0]):
+        s = s + x[i].sum()
+    return s
+
+
+def shape_of_intermediate(x):
+    y = paddle.concat([x, x], axis=0)
+    return y.reshape([y.shape[0] * y.shape[1]]).sum()
+
+
+class TestListAndTensorShape:
+    def setup_method(self):
+        self.x = paddle.to_tensor(
+            np.arange(6, dtype="float32").reshape(2, 3) * 0.5 - 0.7)
+
+    @pytest.mark.parametrize("fn", [
+        list_append_in_for, list_append_in_if, list_pop_and_index,
+        list_append_in_while, shape_in_reshape, shape_arithmetic,
+        shape_in_loop_bound, shape_of_intermediate,
+    ])
+    def test_matches_eager(self, fn):
+        _check_matches(fn, self.x)
+
+    def test_list_stage_under_jit(self):
+        # the list pattern must also stage inside one jax.jit trace
+        import jax
+
+        conv = convert_to_static(list_append_in_for)
+
+        def run(raw):
+            return conv(paddle.Tensor._wrap(raw))._data
+
+        want = list_append_in_for(self.x)
+        got = jax.jit(run)(self.x._data)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6)
+
+    def test_shape_stage_under_jit(self):
+        import jax
+
+        conv = convert_to_static(shape_arithmetic)
+
+        def run(raw):
+            return conv(paddle.Tensor._wrap(raw))._data
+
+        want = shape_arithmetic(self.x)
+        got = jax.jit(run)(self.x._data)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6)
+
+
+def test_list_mutation_of_caller_list_untouched():
+    """Only function-OWNED lists (bound to a literal in the body) are
+    rewritten to staged rebinding; a caller-supplied accumulator must
+    still be mutated in place (and closure lists must not become
+    UnboundLocalError)."""
+    def collect(x, acc):
+        acc.append(x * 2)
+        return x
+
+    conv = convert_to_static(collect)
+    acc = []
+    conv(paddle.to_tensor(np.float32(1.5)), acc)
+    assert len(acc) == 1
+    np.testing.assert_allclose(np.asarray(acc[0]), 3.0)
+
+    hooks = []
+
+    def fwd(x):
+        hooks.append(x)
+        return x + 1
+
+    conv2 = convert_to_static(fwd)
+    conv2(paddle.to_tensor(np.float32(2.0)))
+    assert len(hooks) == 1

@@ -1,0 +1,343 @@
+"""Per-op micro-benchmark harness over the lowering registry.
+
+Reference role: operators/benchmark/op_tester.cc (config-driven per-op
+timing) — TPU-native: each config builds a ONE-OP fluid program whose
+inputs come from in-program random ops, then times it two ways:
+
+  e2e_us   one Executor.run() call — dispatch + compile-cache hit path
+  step_us  marginal per-step time inside an Executor.run_n lax.scan
+           (the random feeder consumes the per-step rng key, so XLA
+           cannot hoist the op out of the loop)
+
+Usage:
+  python tools/op_bench.py                 # full table -> OP_BENCH.json
+  python tools/op_bench.py --quick         # first 8 configs
+  python tools/op_bench.py --ops matmul,softmax
+  python tools/op_bench.py --compare       # diff vs committed baseline,
+                                           # exit 1 on >2x step_us regress
+
+Runs on whatever jax backend the environment provides (CPU pin by
+default under the test env; the real chip under the driver).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+BASELINE = os.path.join(REPO, "OP_BENCH.json")
+
+
+def _f(shape, name, blk):
+    """A float input fed by an in-program uniform_random."""
+    v = blk.create_var(name=name)
+    blk.append_op(type="uniform_random", inputs={},
+                  outputs={"Out": [v.name]},
+                  attrs={"shape": list(shape), "min": -1.0, "max": 1.0,
+                         "dtype": "float32"})
+    return v.name
+
+
+def _i(shape, name, blk, high=1000):
+    v = blk.create_var(name=name)
+    blk.append_op(type="randint", inputs={}, outputs={"Out": [v.name]},
+                  attrs={"shape": list(shape), "low": 0, "high": high})
+    return v.name
+
+
+def _p(shape, name, blk, scope):
+    """A persistable parameter input (weights: constant across steps)."""
+    import zlib
+
+    v = blk.create_var(name=name, shape=list(shape), dtype="float32")
+    v.persistable = True
+    # crc32, not hash(): str hashing is salted per process and would
+    # bench against different weight values every run
+    rs = np.random.RandomState(zlib.crc32(name.encode()) % (2 ** 31))
+    scope.set_value(name, (rs.randn(*shape) * 0.05).astype(np.float32))
+    return v.name
+
+
+# (name, builder(blk, scope) -> (op_type, inputs, outputs, attrs))
+# shapes sized for ~ms-scale device work; the 30 hottest op families
+# across the model zoo + optimizer/loss paths
+def _configs():
+    B, T, D, H = 32, 128, 768, 1024
+
+    def simple(op, ins, outs, attrs=None):
+        def build(blk, scope):
+            return op, ins(blk, scope), outs, (attrs or {})
+        return build
+
+    cfgs = []
+
+    def unary(op):
+        return simple(op, lambda b, s: {"X": [_f((B, T, D), "x", b)]},
+                      {"Out": 1})
+
+    cfgs += [
+        ("matmul", simple(
+            "matmul", lambda b, s: {"X": [_f((B, T, D), "x", b)],
+                                    "Y": [_p((D, D), "w", b, s)]},
+            {"Out": 1})),
+        ("mul", simple(
+            "mul", lambda b, s: {"X": [_f((B * T, D), "x", b)],
+                                 "Y": [_p((D, H), "w", b, s)]},
+            {"Out": 1})),
+        ("fc", simple(
+            "fc", lambda b, s: {"Input": [_f((B * T, D), "x", b)],
+                                "W": [_p((D, H), "w", b, s)],
+                                "Bias": [_p((H,), "bias", b, s)]},
+            {"Out": 1})),
+        ("conv2d", simple(
+            "conv2d", lambda b, s: {"Input": [_f((16, 64, 56, 56),
+                                                 "x", b)],
+                                    "Filter": [_p((64, 64, 3, 3),
+                                                  "w", b, s)]},
+            {"Output": 1},
+            {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+             "groups": 1})),
+        ("depthwise_conv2d", simple(
+            "depthwise_conv2d",
+            lambda b, s: {"Input": [_f((16, 64, 56, 56), "x", b)],
+                          "Filter": [_p((64, 1, 3, 3), "w", b, s)]},
+            {"Output": 1},
+            {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+             "groups": 64})),
+        ("batch_norm", simple(
+            "batch_norm",
+            lambda b, s: {"X": [_f((16, 64, 56, 56), "x", b)],
+                          "Scale": [_p((64,), "g", b, s)],
+                          "Bias": [_p((64,), "bta", b, s)],
+                          "Mean": [_p((64,), "mu", b, s)],
+                          "Variance": [_p((64,), "va", b, s)]},
+            {"Y": 1, "MeanOut": 1, "VarianceOut": 1, "SavedMean": 1,
+             "SavedVariance": 1},
+            {"is_test": False, "epsilon": 1e-5, "momentum": 0.9})),
+        ("layer_norm", simple(
+            "layer_norm",
+            lambda b, s: {"X": [_f((B, T, D), "x", b)],
+                          "Scale": [_p((D,), "g", b, s)],
+                          "Bias": [_p((D,), "bta", b, s)]},
+            {"Y": 1}, {"begin_norm_axis": 2})),
+        ("softmax", unary("softmax")),
+        ("relu", unary("relu")),
+        ("gelu", unary("gelu")),
+        ("tanh", unary("tanh")),
+        ("sigmoid", unary("sigmoid")),
+        ("exp", unary("exp")),
+        ("dropout", simple(
+            "dropout", lambda b, s: {"X": [_f((B, T, D), "x", b)]},
+            {"Out": 1, "Mask": 1},
+            {"dropout_prob": 0.1,
+             "dropout_implementation": "upscale_in_train"})),
+        ("elementwise_add", simple(
+            "elementwise_add",
+            lambda b, s: {"X": [_f((B, T, D), "x", b)],
+                          "Y": [_f((B, T, D), "y", b)]}, {"Out": 1})),
+        ("elementwise_mul", simple(
+            "elementwise_mul",
+            lambda b, s: {"X": [_f((B, T, D), "x", b)],
+                          "Y": [_f((B, T, D), "y", b)]}, {"Out": 1})),
+        ("reduce_sum", simple(
+            "reduce_sum", lambda b, s: {"X": [_f((B, T, D), "x", b)]},
+            {"Out": 1}, {"dim": [-1], "keep_dim": False})),
+        ("reduce_mean", simple(
+            "reduce_mean", lambda b, s: {"X": [_f((B, T, D), "x", b)]},
+            {"Out": 1}, {"dim": [-1], "keep_dim": False})),
+        ("transpose2", simple(
+            "transpose2", lambda b, s: {"X": [_f((B, T, D), "x", b)]},
+            {"Out": 1}, {"axis": [0, 2, 1]})),
+        ("reshape2", simple(
+            "reshape2", lambda b, s: {"X": [_f((B, T, D), "x", b)]},
+            {"Out": 1}, {"shape": [B * T, D]})),
+        ("concat", simple(
+            "concat", lambda b, s: {"X": [_f((B, T, D), "x", b),
+                                          _f((B, T, D), "y", b)]},
+            {"Out": 1}, {"axis": -1})),
+        ("split", simple(
+            "split", lambda b, s: {"X": [_f((B, T, D), "x", b)]},
+            {"Out": 2}, {"num": 2, "axis": -1})),
+        ("slice", simple(
+            "slice", lambda b, s: {"Input": [_f((B, T, D), "x", b)]},
+            {"Out": 1},
+            {"axes": [1], "starts": [0], "ends": [T // 2]})),
+        ("lookup_table_v2", simple(
+            "lookup_table_v2",
+            lambda b, s: {"Ids": [_i((B, T), "ids", b, high=30000)],
+                          "W": [_p((30000, D), "emb", b, s)]},
+            {"Out": 1})),
+        ("gather", simple(
+            "gather", lambda b, s: {"X": [_f((30000, D), "x", b)],
+                                    "Index": [_i((4096,), "ids", b,
+                                                 high=30000)]},
+            {"Out": 1})),
+        ("top_k_v2", simple(
+            "top_k_v2", lambda b, s: {"X": [_f((B, 30000), "x", b)]},
+            {"Out": 1, "Indices": 1}, {"k": 10, "axis": -1})),
+        ("pool2d", simple(
+            "pool2d", lambda b, s: {"X": [_f((16, 64, 56, 56), "x", b)]},
+            {"Out": 1},
+            {"pooling_type": "max", "ksize": [3, 3], "strides": [2, 2],
+             "paddings": [1, 1]})),
+        ("softmax_with_cross_entropy", simple(
+            "softmax_with_cross_entropy",
+            lambda b, s: {"Logits": [_f((B * T, D), "x", b)],
+                          "Label": [_i((B * T, 1), "lbl", b, high=D)]},
+            {"Softmax": 1, "Loss": 1}, {})),
+        ("fused_sdpa", simple(
+            "fused_sdpa",
+            lambda b, s: {"Q": [_f((B, 12, T, 64), "q", b)],
+                          "K": [_f((B, 12, T, 64), "k", b)],
+                          "V": [_f((B, 12, T, 64), "v", b)]},
+            {"Out": 1}, {"scale": 0.125})),
+        ("scale", simple(
+            "scale", lambda b, s: {"X": [_f((B, T, D), "x", b)]},
+            {"Out": 1}, {"scale": 1.5, "bias": 0.1})),
+        ("sqrt", unary("sqrt")),
+        ("cast", simple(
+            "cast", lambda b, s: {"X": [_f((B, T, D), "x", b)]},
+            {"Out": 1}, {"in_dtype": "float32", "out_dtype": "float16"})),
+    ]
+    return cfgs
+
+
+def bench_one(name, builder, steps=30):
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.unique_name.guard(), fluid.program_guard(main,
+                                                            startup):
+            blk = main.global_block()
+            op, ins, outs, attrs = builder(blk, scope)
+            out_map = {}
+            for slot, n_out in outs.items():
+                out_map[slot] = [
+                    blk.create_var(name=f"ob_{slot}_{i}").name
+                    for i in range(n_out)]
+            blk.append_op(type=op, inputs=ins, outputs=out_map,
+                          attrs=attrs)
+            # persistable accumulator consuming the op output: without
+            # it the scan carry ignores the op and XLA dead-code
+            # eliminates every step but the unrolled last one
+            first_out = out_map[next(iter(out_map))][0]
+            red = blk.create_var(name="ob_red")
+            blk.append_op(type="reduce_sum",
+                          inputs={"X": [first_out]},
+                          outputs={"Out": [red.name]},
+                          attrs={"dim": [], "reduce_all": True,
+                                 "keep_dim": False})
+            cst = blk.create_var(name="ob_cst")
+            blk.append_op(type="cast", inputs={"X": [red]},
+                          outputs={"Out": [cst.name]},
+                          attrs={"in_dtype": "float32",
+                                 "out_dtype": "float32"})
+            acc = blk.create_var(name="ob_acc", shape=[1],
+                                 dtype="float32")
+            acc.persistable = True
+            blk.append_op(type="elementwise_add",
+                          inputs={"X": ["ob_acc"], "Y": [cst]},
+                          outputs={"Out": ["ob_acc"]}, attrs={})
+        scope.set_value("ob_acc", np.zeros(1, np.float32))
+        exe = fluid.Executor()
+        exe.run(startup)
+        fetch = ["ob_acc"]
+
+        t0 = time.perf_counter()
+        exe.run(main, {}, fetch)          # compile
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        exe.run(main, {}, fetch)
+        e2e_s = time.perf_counter() - t0
+
+        for n in (steps, 5):                  # compile both scan lengths
+            exe.run_n(main, {}, fetch, n=n)
+        slopes = []
+        for _ in range(5):                    # median of adjacent pairs
+            t0 = time.perf_counter()
+            exe.run_n(main, {}, fetch, n=5)
+            t_lo = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            exe.run_n(main, {}, fetch, n=steps)
+            t_hi = time.perf_counter() - t0
+            if t_hi > t_lo:
+                slopes.append((t_hi - t_lo) / (steps - 5))
+        slopes.sort()
+        dt = slopes[len(slopes) // 2] if slopes else 0.0
+    return {"e2e_us": round(e2e_s * 1e6, 1),
+            "step_us": round(dt * 1e6, 2),
+            "compile_s": round(compile_s, 2)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin to the virtual-CPU jax backend (the axon "
+                         "site hook otherwise grabs the tunnel chip)")
+    ap.add_argument("--quick", action="store_true",
+                    help="first 8 configs only")
+    ap.add_argument("--ops", default="", help="comma-separated subset")
+    ap.add_argument("--out", default=BASELINE)
+    ap.add_argument("--compare", action="store_true",
+                    help="compare against the committed baseline; exit 1 "
+                         "when any op's step_us regressed >2x")
+    args = ap.parse_args()
+    if args.cpu:
+        sys.path.insert(0, REPO)
+        import _cpu_debug  # noqa: F401  (forces the cpu backend)
+
+    cfgs = _configs()
+    if args.ops:
+        want = set(args.ops.split(","))
+        cfgs = [c for c in cfgs if c[0] in want]
+    elif args.quick:
+        cfgs = cfgs[:8]
+
+    results = {}
+    for name, builder in cfgs:
+        try:
+            results[name] = bench_one(name, builder)
+        except Exception as e:  # record, keep the table alive
+            results[name] = {"error": f"{type(e).__name__}: {e}"}
+        r = results[name]
+        print(f"{name:28s} {json.dumps(r)}", file=sys.stderr)
+
+    import jax
+
+    record = {"backend": jax.default_backend(),
+              "ops": results}
+    if args.compare:
+        try:
+            with open(BASELINE) as f:
+                base = json.load(f)
+        except Exception:
+            print("no baseline to compare against", file=sys.stderr)
+            base = None
+        bad = []
+        if base and base.get("backend") == record["backend"]:
+            for op, r in results.items():
+                b = base["ops"].get(op, {})
+                if "step_us" in r and "step_us" in b and \
+                        b["step_us"] > 0 and \
+                        r["step_us"] > 2.0 * b["step_us"]:
+                    bad.append((op, b["step_us"], r["step_us"]))
+        for op, old, new in bad:
+            print(f"REGRESSION {op}: {old}us -> {new}us", file=sys.stderr)
+        print(json.dumps({"regressions": len(bad)}))
+        sys.exit(1 if bad else 0)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+    print(json.dumps({"ops_benchmarked": len(results),
+                      "out": args.out}))
+
+
+if __name__ == "__main__":
+    main()
