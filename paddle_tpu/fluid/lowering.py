@@ -452,9 +452,11 @@ def _ew(fn):
         if axis != -1 and y.ndim < x.ndim:
             # sequence X: IR axis counts packed dims; runtime is padded
             # [B, T, ...] with one extra axis, so shift alignment right
+            # — unless the program was built against the padded shapes
             if axis >= 1 and op.input("X") and \
                     op.input("X")[0] + _LOD_SUFFIX in ctx.env and \
-                    axis + y.ndim < x.ndim:
+                    axis + y.ndim < x.ndim and \
+                    not _declared_padded(ctx, op, op.input("X")[0], x):
                 axis += 1
             # paddle broadcast: align y's dims starting at `axis`
             shape = [1] * x.ndim
@@ -580,14 +582,46 @@ def _matmul(ctx, op):
     ctx.out(op, "Out", out)
 
 
+def _seq_ncol_shift(ctx, op, slot, x, ncol):
+    """Sequence-input num_col_dims adjustment: a PACKED-convention
+    program ([total, d...] LoD vars, e.g. a loaded reference artifact)
+    needs +1 because the runtime array is padded [B, T, d...] with one
+    extra axis. A program BUILT against the padded shapes (declared var
+    rank == runtime rank) already counted the time axis — bumping again
+    would flatten the feature dim into the rows (seen live: fc over an
+    attention concat collapsed to [B*T*D, 1])."""
+    names = op.input(slot)
+    if not names or names[0] + _LOD_SUFFIX not in ctx.env:
+        return ncol
+    if _declared_padded(ctx, op, names[0], x):
+        return ncol                # padded-convention program
+    return ncol + 1
+
+
+def _declared_padded(ctx, op, name, x):
+    """True when the program DECLARED this var with the padded rank
+    (time axis included), i.e. it was built against padded shapes and
+    packed-convention adjustments must not apply. Resolves through the
+    op's own block so sub-block (while/cond body) vars are seen."""
+    blk = getattr(op, "block", None)
+    declared = None
+    for b in (blk, getattr(ctx, "program", None)
+              and ctx.program.global_block()):
+        if b is None:
+            continue
+        try:
+            declared = b.var(name).shape
+            break
+        except ValueError:
+            continue
+    return bool(declared) and len(declared) == getattr(x, "ndim", 0)
+
+
 @register("mul")
 def _mul(ctx, op):
     x = ctx.inp(op, "X")
-    xcols = op.attrs.get("x_num_col_dims", 1)
-    # sequence input: IR num_col_dims counts packed dims [total, d...]; the
-    # runtime array is padded [B, T, d...] (one extra axis), so shift by 1
-    if op.input("X") and op.input("X")[0] + _LOD_SUFFIX in ctx.env:
-        xcols += 1
+    xcols = _seq_ncol_shift(ctx, op, "X", x,
+                            op.attrs.get("x_num_col_dims", 1))
     ctx.out(op, "Out", K.mul_op(x, ctx.inp(op, "Y"), xcols,
                                 op.attrs.get("y_num_col_dims", 1)))
 
@@ -1482,9 +1516,8 @@ def _quantized_mul(ctx, op):
     s_in = op.attrs["in_scale"]
     scales = _dequant_scales(op)
     if op.type == "quantized_mul":
-        ncol = op.attrs.get("x_num_col_dims", 1)
-        if op.input("X") and op.input("X")[0] + _LOD_SUFFIX in ctx.env:
-            ncol += 1
+        ncol = _seq_ncol_shift(ctx, op, "X", x,
+                               op.attrs.get("x_num_col_dims", 1))
         lead = x.shape[:ncol]
         xm = x.reshape((int(np.prod(lead)) if lead else 1, -1))
     else:
@@ -2120,11 +2153,11 @@ def _grid_sampler(ctx, op):
 @register("fc")
 def _fc_fused(ctx, op):
     """fc_fuse_pass output: mul + bias in one op (fc_op.cc parity)."""
-    x = ctx.inp(op, "Input") if op.input("Input") else ctx.inp(op, "X")
+    slot = "Input" if op.input("Input") else "X"
+    x = ctx.inp(op, slot)
     w = ctx.inp(op, "W") if op.input("W") else ctx.inp(op, "Y")
-    ncol = op.attrs.get("in_num_col_dims", 1)
-    if op.input("X") and op.input("X")[0] + _LOD_SUFFIX in ctx.env:
-        ncol += 1
+    ncol = _seq_ncol_shift(ctx, op, slot, x,
+                           op.attrs.get("in_num_col_dims", 1))
     out = K.mul_op(x, w, ncol, 1)
     b = ctx.inp(op, "Bias")
     if b is not None:

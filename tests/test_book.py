@@ -148,3 +148,109 @@ def test_auto_checkpoint_restart(tmp_path, monkeypatch):
         np.testing.assert_allclose(np.asarray(a._data),
                                    np.asarray(b._data), rtol=1e-5,
                                    atol=1e-6, err_msg=k)
+
+
+def test_machine_translation_book():
+    """book/test_machine_translation.py role: an attention seq2seq
+    (encoder GRU -> Luong attention -> decoder GRU, teacher forcing)
+    trains as ONE fluid program to a clearly falling loss, then the
+    trained weights drive text.decode.beam_search (the jitted scan
+    decoder) and the beam output solves the toy copy task."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.text.decode import beam_search
+
+    V, D, H, B, T = 18, 16, 24, 32, 6
+    BOS, EOS = 1, 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        src = fluid.layers.data("src", [T], dtype="int64")
+        tin = fluid.layers.data("tin", [T], dtype="int64")
+        tout = fluid.layers.data("tout", [T, 1], dtype="int64")
+        semb = fluid.layers.embedding(src, size=[V, D],
+                                      param_attr="src_emb")
+        enc_in = fluid.layers.fc(semb, 3 * H, num_flatten_dims=2,
+                                 bias_attr=False, param_attr="enc_proj")
+        enc = fluid.layers.dynamic_gru(enc_in, H, param_attr="enc_gru_w",
+                                       bias_attr="enc_gru_b")
+        temb = fluid.layers.embedding(tin, size=[V, D],
+                                      param_attr="tgt_emb")
+        dec_in = fluid.layers.fc(temb, 3 * H, num_flatten_dims=2,
+                                 bias_attr=False, param_attr="dec_proj")
+        dec = fluid.layers.dynamic_gru(dec_in, H, param_attr="dec_gru_w",
+                                       bias_attr="dec_gru_b")
+        # Luong attention over the encoder states (teacher-forced path
+        # computes every step at once: [B,Td,Te] scores)
+        scores = fluid.layers.matmul(dec, enc, transpose_y=True)
+        alpha = fluid.layers.softmax(scores)
+        ctx = fluid.layers.matmul(alpha, enc)
+        cat = fluid.layers.concat([dec, ctx], axis=-1)
+        logits = fluid.layers.fc(cat, V, num_flatten_dims=2,
+                                 param_attr="out_w", bias_attr="out_b")
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, tout))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+
+    rs = np.random.RandomState(0)
+    data = rs.randint(3, V, (256, T)).astype("int64")  # 0,1,2 reserved
+
+    def batch(i):
+        rows = data[(i * B) % 256:(i * B) % 256 + B]
+        tin_b = np.concatenate(
+            [np.full((B, 1), BOS, np.int64), rows[:, :-1]], 1)
+        return {"src": rows, "tin": tin_b, "tout": rows[..., None]}
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = [float(exe.run(main, batch(i), [loss])[0])
+                  for i in range(220)]
+        w = {n: np.asarray(scope.get_value(n)) for n in
+             ("src_emb", "enc_proj", "enc_gru_w", "enc_gru_b",
+              "tgt_emb", "dec_proj", "dec_gru_w", "dec_gru_b",
+              "out_w", "out_b")}
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.3, (
+        losses[:3], losses[-3:])
+
+    # ---- beam decode with the trained weights (jitted scan) ----
+    def gru_step(h, xt, wg, b):
+        Hd = h.shape[-1]
+        gates = xt[:, :2 * Hd] + b[0, :2 * Hd] + h @ wg[:, :2 * Hd]
+        u = jax.nn.sigmoid(gates[:, :Hd])
+        r = jax.nn.sigmoid(gates[:, Hd:])
+        cand = jnp.tanh(xt[:, 2 * Hd:] + b[0, 2 * Hd:]
+                        + (r * h) @ wg[:, 2 * Hd:])
+        return h - u * h + u * cand
+
+    src_b = data[:8]
+    # encode once (time scan, matches dynamic_gru semantics)
+    ex = w["src_emb"][src_b] @ w["enc_proj"]          # [8, T, 3H]
+    h = jnp.zeros((8, H), jnp.float32)
+    enc_states = []
+    for t in range(T):
+        h = gru_step(h, jnp.asarray(ex[:, t]), w["enc_gru_w"],
+                     w["enc_gru_b"])
+        enc_states.append(h)
+    enc_j = jnp.stack(enc_states, 1)                  # [8, T, H]
+
+    def step_fn(tok, state):
+        hdec, enc_s = state
+        xt = jnp.asarray(w["tgt_emb"])[tok] @ jnp.asarray(w["dec_proj"])
+        hdec = gru_step(hdec, xt, jnp.asarray(w["dec_gru_w"]),
+                        jnp.asarray(w["dec_gru_b"]))
+        att = jax.nn.softmax(
+            jnp.einsum("bh,bth->bt", hdec, enc_s), -1)
+        ctxv = jnp.einsum("bt,bth->bh", att, enc_s)
+        logit = jnp.concatenate([hdec, ctxv], -1) @ \
+            jnp.asarray(w["out_w"]) + jnp.asarray(w["out_b"])
+        return logit, (hdec, enc_s)
+
+    toks, _scores, _lens = beam_search(
+        step_fn, (jnp.zeros((8, H), jnp.float32), enc_j),
+        batch_size=8, bos_id=BOS, eos_id=EOS, beam_size=3, max_len=T)
+    best = np.asarray(toks[:, 0, :])                  # [8, T]
+    acc = float((best == src_b).mean())
+    assert acc > 0.8, (acc, best[0], src_b[0])
