@@ -412,6 +412,165 @@ static RegK r_pool2d("pool2d", [](ExecCtx& c) {
   return true;
 });
 
+// depthwise_conv2d is conv2d with groups == channels; the grouped conv
+// kernel above already handles it (filter [OC, 1, KH, KW])
+static RegK r_dwconv("depthwise_conv2d", [](ExecCtx& c) {
+  return Registry()["conv2d"](c);
+});
+
+static RegK r_relu6("relu6", [](ExecCtx& c) {
+  return EwiseUnary(c, [](float v) {
+    return v < 0 ? 0.0f : (v > 6.0f ? 6.0f : v);
+  });
+});
+
+// MobileNetV3-family activations (hard_sigmoid/hard_swish)
+static RegK r_hsig("hard_sigmoid", [](ExecCtx& c) {
+  float slope = (float)c.AttrF("slope", 0.2);
+  float offset = (float)c.AttrF("offset", 0.5);
+  NTensor* x = c.In("X");
+  NTensor* o = c.Out("Out");
+  o->dims = x->dims;
+  o->f.resize(x->f.size());
+  for (size_t k = 0; k < x->f.size(); ++k) {
+    float y = x->f[k] * slope + offset;
+    o->f[k] = y < 0 ? 0.0f : (y > 1.0f ? 1.0f : y);
+  }
+  return true;
+});
+static RegK r_hswish("hard_swish", [](ExecCtx& c) {
+  return EwiseUnary(c, [](float v) {
+    float t = v + 3.0f;
+    t = t < 0 ? 0.0f : (t > 6.0f ? 6.0f : t);
+    return v * t / 6.0f;
+  });
+});
+
+static int64_t NormAxis(int64_t axis, size_t ndim) {
+  return axis < 0 ? axis + (int64_t)ndim : axis;
+}
+
+static RegK r_concat("concat", [](ExecCtx& c) {
+  // gather the X arg list
+  std::vector<NTensor*> xs;
+  for (const auto& s : c.op->inputs())
+    if (s.name() == "X")
+      for (int k = 0; k < s.args_size(); ++k) {
+        NTensor* t = c.In("X", k);
+        if (!t) return false;
+        xs.push_back(t);
+      }
+  if (xs.empty()) {
+    c.error = "concat: no inputs";
+    return false;
+  }
+  NTensor* o = c.Out("Out");
+  int64_t axis = NormAxis(c.AttrI("axis", 0), xs[0]->dims.size());
+  if (axis < 0 || axis >= (int64_t)xs[0]->dims.size()) {
+    c.error = "concat: bad axis";
+    return false;
+  }
+  // every input must share rank and non-axis dims (and float storage:
+  // the int64 path isn't wired here)
+  for (auto* t : xs) {
+    if (t->is_int) {
+      c.error = "concat: int tensors unsupported in native engine";
+      return false;
+    }
+    if (t->dims.size() != xs[0]->dims.size()) {
+      c.error = "concat: rank mismatch";
+      return false;
+    }
+    for (size_t k = 0; k < t->dims.size(); ++k)
+      if ((int64_t)k != axis && t->dims[k] != xs[0]->dims[k]) {
+        c.error = "concat: non-axis dim mismatch";
+        return false;
+      }
+  }
+  int64_t pre = 1, post = 1, mid = 0;
+  for (int64_t k = 0; k < axis; ++k) pre *= xs[0]->dims[k];
+  for (int64_t k = axis + 1; k < (int64_t)xs[0]->dims.size(); ++k)
+    post *= xs[0]->dims[k];
+  for (auto* t : xs) mid += t->dims[axis];
+  o->dims = xs[0]->dims;
+  o->dims[axis] = mid;
+  o->f.resize(pre * mid * post);
+  int64_t off = 0;
+  for (auto* t : xs) {
+    int64_t m = t->dims[axis];
+    for (int64_t p = 0; p < pre; ++p)
+      memcpy(&o->f[(p * mid + off) * post], &t->f[p * m * post],
+             sizeof(float) * m * post);
+    off += m;
+  }
+  return true;
+});
+
+static RegK r_split("split", [](ExecCtx& c) {
+  NTensor* x = c.In("X");
+  if (!x) return false;
+  if (x->is_int) {
+    c.error = "split: int tensors unsupported in native engine";
+    return false;
+  }
+  int64_t axis = NormAxis(c.AttrI("axis", 0), x->dims.size());
+  if (axis < 0 || axis >= (int64_t)x->dims.size()) {
+    c.error = "split: bad axis";
+    return false;
+  }
+  int64_t num = c.AttrI("num", 0);
+  auto sections = c.AttrInts("sections");
+  int out_n = 0;
+  for (const auto& s : c.op->outputs())
+    if (s.name() == "Out") out_n = s.args_size();
+  if (sections.empty()) {
+    if (num <= 0) num = out_n;
+    if (num <= 0 || x->dims[axis] % num != 0) {
+      c.error = "split: bad num";
+      return false;
+    }
+    sections.assign(num, x->dims[axis] / num);
+  } else {
+    int64_t known = 0, neg = -1;
+    for (size_t k = 0; k < sections.size(); ++k)
+      if (sections[k] < 0) neg = (int64_t)k; else known += sections[k];
+    if (neg >= 0) sections[neg] = x->dims[axis] - known;
+  }
+  int64_t total = 0;
+  for (int64_t s_ : sections) {
+    if (s_ <= 0) {
+      c.error = "split: non-positive section";
+      return false;
+    }
+    total += s_;
+  }
+  if (total != x->dims[axis]) {
+    c.error = "split: sections do not sum to dims[axis]";
+    return false;
+  }
+  int64_t pre = 1, post = 1, mid = x->dims[axis];
+  for (int64_t k = 0; k < axis; ++k) pre *= x->dims[k];
+  for (int64_t k = axis + 1; k < (int64_t)x->dims.size(); ++k)
+    post *= x->dims[k];
+  int64_t off = 0;
+  for (size_t i = 0; i < sections.size(); ++i) {
+    NTensor* o = c.Out("Out", (int)i);
+    if (!o) {
+      c.error = "split: missing output";
+      return false;
+    }
+    int64_t m = sections[i];
+    o->dims = x->dims;
+    o->dims[axis] = m;
+    o->f.resize(pre * m * post);
+    for (int64_t p = 0; p < pre; ++p)
+      memcpy(&o->f[p * m * post], &x->f[(p * mid + off) * post],
+             sizeof(float) * m * post);
+    off += m;
+  }
+  return true;
+});
+
 // ---- int8 quantized kernels (slim PTQ/QAT artifacts; the reference
 // serves these via mkldnn INT8, api/mkldnn_quantizer.cc role). Weights
 // arrive int8 (NTensor.q); activations quantize on the fly with the
