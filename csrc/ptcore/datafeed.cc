@@ -106,6 +106,56 @@ void DataFeed::SetError(std::string msg) {
   has_error_.store(true, std::memory_order_release);
 }
 
+static const char kBinMagic[5] = {'P', 'T', 'M', 'B', 1};
+
+bool DataFeed::ParseBinaryFile(FILE* f, const std::string& path) {
+  // binary MultiSlot wire (data_feed.h:650 in-memory/protobin role):
+  // magic "PTMB\x01" | per record: u8 0xAB | per slot in conf order:
+  // u32 count | count x (f32 | i64). Strict: any framing error poisons
+  // the feed instead of silently skipping records.
+  while (true) {
+    uint8_t sent = 0;
+    size_t got = fread(&sent, 1, 1, f);
+    if (got != 1) return true;  // clean EOF
+    if (sent != 0xAB) {
+      SetError("protobin: bad record sentinel in " + path);
+      return false;
+    }
+    Record rec;
+    rec.fvals.assign(nf_, {});
+    rec.ivals.assign(ni_, {});
+    int fi = 0, ii = 0;
+    for (const auto& slot : slots_) {
+      uint32_t n = 0;
+      if (fread(&n, 4, 1, f) != 1 || n > (64u << 20)) {
+        SetError("protobin: truncated/oversized slot in " + path);
+        return false;
+      }
+      if (slot.dense_dim > 0 && n != (uint32_t)slot.dense_dim) {
+        SetError("protobin: dense dim mismatch in " + path);
+        return false;
+      }
+      if (slot.is_float) {
+        auto& v = rec.fvals[fi++];
+        v.resize(n);
+        if (n && fread(v.data(), 4, n, f) != n) {
+          SetError("protobin: truncated payload in " + path);
+          return false;
+        }
+      } else {
+        auto& v = rec.ivals[ii++];
+        v.resize(n);
+        if (n && fread(v.data(), 8, n, f) != n) {
+          SetError("protobin: truncated payload in " + path);
+          return false;
+        }
+      }
+    }
+    if (!record_q_.Push(std::move(rec))) return true;  // stopped
+    samples_seen_++;
+  }
+}
+
 void DataFeed::ParseWorker() {
   std::string path;
   while (file_q_.Pop(&path)) {
@@ -117,11 +167,28 @@ void DataFeed::ParseWorker() {
       std::string cmd = path.substr(0, path.size() - 1);
       f = popen(cmd.c_str(), "r");
     } else {
-      f = fopen(path.c_str(), "r");
+      f = fopen(path.c_str(), "rb");
     }
     if (!f) {
       SetError("open failed: " + path);
       continue;
+    }
+    // SEEKABLE regular files sniff the binary magic; pipes and
+    // non-seekable paths (FIFOs, /dev/fd/N) stay text — sniffing them
+    // would eat the first bytes with no way to rewind
+    if (!pipe && ftell(f) == 0) {
+      char head[5] = {0};
+      size_t got = fread(head, 1, 5, f);
+      if (got == 5 && memcmp(head, kBinMagic, 5) == 0) {
+        ParseBinaryFile(f, path);
+        fclose(f);
+        continue;
+      }
+      if (fseek(f, 0, SEEK_SET) != 0) {
+        SetError("datafeed: cannot rewind after sniff: " + path);
+        fclose(f);
+        continue;
+      }
     }
     char* line = nullptr;
     size_t cap = 0;

@@ -15,6 +15,7 @@
 
 #include <atomic>
 #include <cstdint>
+#include <cstdio>
 #include <memory>
 #include <random>
 #include <string>
@@ -73,6 +74,7 @@ class DataFeed {
   void ParseWorker();
   void AssembleWorker(int batch_size, int64_t shuffle_buf, uint64_t seed);
   bool ParseLine(const char* p, size_t len, Record* rec);
+  bool ParseBinaryFile(FILE* f, const std::string& path);
 
   std::vector<SlotConf> slots_;
   int nf_ = 0, ni_ = 0;  // float/int slot counts

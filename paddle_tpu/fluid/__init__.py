@@ -4,7 +4,7 @@ from ..core.place import (CPUPlace, CUDAPinnedPlace, CUDAPlace,  # noqa
 from ..core.lod import (LoDTensor, create_lod_tensor,  # noqa: F401
                         create_random_int_lodtensor)
 from ..core.tensor import Tensor
-from . import initializer, io, layers, optimizer, transpiler  # noqa: F401
+from . import initializer, io, layers, nets, optimizer, transpiler  # noqa: F401,E501
 from .transpiler import (DistributeTranspiler,  # noqa: F401
                          DistributeTranspilerConfig)
 from .backward import append_backward, calc_gradient, gradients  # noqa

@@ -161,3 +161,29 @@ class InMemoryDataset(DatasetBase):
                 else:
                     out[name] = (vals, offsets.astype(np.int64))
             yield out
+
+
+def write_multislot_binary(path, records, slot_types):
+    """Write records in the binary MultiSlot wire the native feed sniffs
+    by magic (data_feed.h:650 in-memory/protobin role — ~3x smaller and
+    parse-free vs the text wire for dense float slots).
+
+    records: iterable of per-slot value lists, one entry per slot in
+    feed order. slot_types: 'float32'/'int64' per slot (the DatasetBase
+    _slots() convention).
+    """
+    import struct
+
+    with open(path, "wb") as f:
+        f.write(b"PTMB\x01")
+        for rec in records:
+            if len(rec) != len(slot_types):
+                raise ValueError(
+                    f"record has {len(rec)} slots, feed declares "
+                    f"{len(slot_types)}")
+            f.write(b"\xab")
+            for vals, st in zip(rec, slot_types):
+                arr = np.asarray(
+                    vals, np.float32 if "float" in st else np.int64)
+                f.write(struct.pack("<I", arr.size))
+                f.write(arr.tobytes())

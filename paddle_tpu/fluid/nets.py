@@ -1,0 +1,123 @@
+"""fluid.nets — composite-layer sugar (python/paddle/fluid/nets.py:1).
+
+The four wrappers the reference book examples lean on: conv+pool image
+stem, sequence conv+pool text stem, gated linear unit, and scaled
+dot-product attention — all composed from the existing fluid layers so
+every path lowers through the same registry.
+"""
+from __future__ import annotations
+
+from . import layers
+
+__all__ = ["simple_img_conv_pool", "sequence_conv_pool", "glu",
+           "scaled_dot_product_attention", "img_conv_group"]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1,
+                         conv_padding=0, conv_dilation=1, conv_groups=1,
+                         param_attr=None, bias_attr=None, act=None,
+                         use_cudnn=True):
+    """conv2d + pool2d (nets.py simple_img_conv_pool)."""
+    conv_out = layers.conv2d(
+        input, num_filters=num_filters, filter_size=filter_size,
+        stride=conv_stride, padding=conv_padding, dilation=conv_dilation,
+        groups=conv_groups, param_attr=param_attr, bias_attr=bias_attr,
+        act=act)
+    return layers.pool2d(
+        conv_out, pool_size=pool_size, pool_type=pool_type,
+        pool_stride=pool_stride, pool_padding=pool_padding,
+        global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size,
+                   conv_padding=1, conv_filter_size=3, conv_act=None,
+                   param_attr=None, conv_with_batchnorm=False,
+                   conv_batchnorm_drop_rate=0.0, pool_stride=1,
+                   pool_type="max", use_cudnn=True):
+    """VGG-style conv block group + trailing pool
+    (nets.py img_conv_group)."""
+    tmp = input
+    n = len(conv_num_filter) if isinstance(conv_num_filter,
+                                           (list, tuple)) else 1
+    filters = conv_num_filter if isinstance(conv_num_filter,
+                                            (list, tuple)) \
+        else [conv_num_filter]
+
+    def _ith(v, i):
+        return v[i] if isinstance(v, (list, tuple)) else v
+
+    for i in range(n):
+        with_bn = bool(_ith(conv_with_batchnorm, i))
+        tmp = layers.conv2d(
+            tmp, num_filters=filters[i],
+            filter_size=_ith(conv_filter_size, i),
+            padding=_ith(conv_padding, i),
+            param_attr=_ith(param_attr, i),
+            act=None if with_bn else _ith(conv_act, i))
+        if with_bn:
+            tmp = layers.batch_norm(tmp, act=_ith(conv_act, i))
+            rate = _ith(conv_batchnorm_drop_rate, i)
+            if rate:
+                tmp = layers.dropout(tmp, dropout_prob=rate)
+    return layers.pool2d(tmp, pool_size=pool_size,
+                         pool_type=pool_type, pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", bias_attr=None):
+    """sequence_conv + sequence_pool (nets.py sequence_conv_pool) —
+    the classic text-CNN stem."""
+    conv_out = layers.sequence_conv(
+        input, num_filters=num_filters, filter_size=filter_size,
+        param_attr=param_attr, bias_attr=bias_attr, act=act)
+    return layers.sequence_pool(conv_out, pool_type=pool_type)
+
+
+def glu(input, dim=-1):
+    """Gated linear unit: split along `dim`, a * sigmoid(b)
+    (nets.py glu)."""
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """Multi-head scaled dot-product attention over [B, T, D] tensors
+    (nets.py scaled_dot_product_attention). Composed from matmul/
+    softmax so the multihead fuse pass can rewrite it to fused_sdpa."""
+    if queries.shape[-1] != keys.shape[-1]:
+        raise ValueError(
+            f"queries hidden size {queries.shape[-1]} must equal keys "
+            f"hidden size {keys.shape[-1]}")
+    for name, t in (("queries", queries), ("keys", keys),
+                    ("values", values)):
+        if t.shape[-1] % num_heads != 0:
+            raise ValueError(
+                f"{name} hidden size {t.shape[-1]} is not divisible by "
+                f"num_heads {num_heads}")
+    d_key = queries.shape[-1] // num_heads
+    d_val = values.shape[-1] // num_heads   # values may be wider
+
+    def _split_heads(x):
+        if num_heads == 1:
+            return x
+        # [B, T, D] -> [B, H, T, D/H], split by the tensor's OWN width
+        r = layers.reshape(x, shape=[0, 0, num_heads,
+                                     x.shape[-1] // num_heads])
+        return layers.transpose(r, perm=[0, 2, 1, 3])
+
+    q = _split_heads(queries)
+    k = _split_heads(keys)
+    v = _split_heads(values)
+    scores = layers.matmul(q, k, transpose_y=True,
+                           alpha=float(d_key) ** -0.5)
+    weights = layers.softmax(scores)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = layers.matmul(weights, v)
+    if num_heads == 1:
+        return ctx
+    ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
+    return layers.reshape(ctx, shape=[0, 0, num_heads * d_val])

@@ -1,0 +1,77 @@
+"""fluid.nets composite sugar (python/paddle/fluid/nets.py parity)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def test_simple_img_conv_pool_and_group():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [1, 12, 12])
+        a = fluid.nets.simple_img_conv_pool(
+            img, num_filters=4, filter_size=3, pool_size=2,
+            pool_stride=2, conv_padding=1, act="relu")
+        b = fluid.nets.img_conv_group(
+            img, conv_num_filter=[4, 4], pool_size=2, conv_act="relu",
+            conv_with_batchnorm=True, pool_stride=2)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    av, bv = exe.run(main, {"img": rs.randn(2, 1, 12, 12).astype("f4")},
+                     [a, b])
+    assert av.shape == (2, 4, 6, 6)
+    assert bv.shape == (2, 4, 6, 6)
+    assert av.min() >= 0.0  # relu'd then max-pooled
+
+
+def test_sequence_conv_pool():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [7, 6])
+        out = fluid.nets.sequence_conv_pool(x, num_filters=5,
+                                            filter_size=3)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(1)
+    (ov,) = exe.run(main, {"x": rs.randn(3, 7, 6).astype("f4")}, [out])
+    assert ov.shape == (3, 5)
+    assert (ov >= 0).all() and (ov <= 1).all()  # sigmoid + max-pool
+
+
+def test_glu():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8])
+        out = fluid.nets.glu(x, dim=-1)
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(2).randn(4, 8).astype("f4")
+    (ov,) = exe.run(main, {"x": xv}, [out])
+    want = xv[:, :4] * (1.0 / (1.0 + np.exp(-xv[:, 4:])))
+    np.testing.assert_allclose(ov, want, rtol=1e-5, atol=1e-6)
+
+
+def test_scaled_dot_product_attention_multihead():
+    B, T, D, H = 2, 5, 8, 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        q = fluid.layers.data("q", [T, D])
+        k = fluid.layers.data("k", [T, D])
+        v = fluid.layers.data("v", [T, D])
+        out = fluid.nets.scaled_dot_product_attention(q, k, v,
+                                                      num_heads=H)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rs = np.random.RandomState(3)
+    qv, kv, vv = (rs.randn(B, T, D).astype("f4") for _ in range(3))
+    (ov,) = exe.run(main, {"q": qv, "k": kv, "v": vv}, [out])
+    # numpy reference
+    dk = D // H
+    qh = qv.reshape(B, T, H, dk).transpose(0, 2, 1, 3)
+    kh = kv.reshape(B, T, H, dk).transpose(0, 2, 1, 3)
+    vh = vv.reshape(B, T, H, dk).transpose(0, 2, 1, 3)
+    sc = qh @ kh.transpose(0, 1, 3, 2) / np.sqrt(dk)
+    w = np.exp(sc - sc.max(-1, keepdims=True))
+    w /= w.sum(-1, keepdims=True)
+    want = (w @ vh).transpose(0, 2, 1, 3).reshape(B, T, D)
+    np.testing.assert_allclose(ov, want, rtol=1e-4, atol=1e-5)
