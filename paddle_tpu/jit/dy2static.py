@@ -700,6 +700,56 @@ class _LogicalTransformer(ast.NodeTransformer):
 _CONVERTED = {}
 
 
+def _rt_print(*args, **kw):
+    """print() that stays functional under trace (print_transformer.py
+    role): traced operands route through jax.debug.print so the values
+    appear at RUN time, not trace time."""
+    import jax
+
+    vals = [_unwrap(a) for a in args]
+    if any(isinstance(v, jax.core.Tracer) for v in vals):
+        fmt = kw.get("sep", " ").join("{}" for _ in vals)
+        jax.debug.print(fmt, *vals)
+    else:
+        print(*args, **kw)
+
+
+def _rt_assert(pred, msg=None):
+    """assert that works on tensors and under trace
+    (assert_transformer.py / assert_op.cc role): concrete values reduce
+    with .all() like the Assert op; traced predicates check at run time
+    via a host callback."""
+    traced, raw = _is_traced_bool(pred)
+    if not traced:
+        ok = raw.all() if hasattr(raw, "all") else raw
+        assert bool(ok), msg
+        return
+    import jax
+    import numpy as _np
+
+    def _check(ok):
+        if not bool(_np.asarray(ok).all()):
+            raise AssertionError(
+                msg if msg is not None else "Assert failed in traced code")
+
+    jax.debug.callback(_check, raw)
+
+
+def _rt_cast(v, py_type):
+    """int()/float()/bool() that stage instead of concretizing
+    (cast_transformer.py role): traced tensors become dtype casts."""
+    import jax
+
+    raw = _unwrap(v)
+    if isinstance(raw, jax.core.Tracer):
+        import jax.numpy as jnp
+
+        dt = {int: jnp.int64, float: jnp.float32,
+              bool: jnp.bool_}[py_type]
+        return _rewrap(raw.astype(dt), v)
+    return py_type(raw)
+
+
 def _rt_list_append(lst, v):
     """Staged list append (list_transformer.py role): rebinding instead
     of mutating lets the control-flow carry analysis see the list, so
@@ -790,6 +840,36 @@ class _ListTransformer(ast.NodeTransformer):
         return node
 
 
+class _BuiltinCallTransformer(ast.NodeTransformer):
+    """print/assert/int/float/bool rewrites (print_transformer.py,
+    assert_transformer.py, cast_transformer.py counterparts): each
+    becomes a runtime-dispatch call that behaves like the builtin on
+    concrete values and stages on traced ones."""
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if isinstance(node.func, ast.Name):
+            if node.func.id == "print":
+                return ast.Call(
+                    func=ast.Name(id="__jst_print", ctx=ast.Load()),
+                    args=node.args, keywords=node.keywords)
+            if node.func.id in ("int", "float", "bool") \
+                    and len(node.args) == 1 and not node.keywords:
+                return ast.Call(
+                    func=ast.Name(id="__jst_cast", ctx=ast.Load()),
+                    args=[node.args[0],
+                          ast.Name(id=node.func.id, ctx=ast.Load())],
+                    keywords=[])
+        return node
+
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        return ast.Expr(value=ast.Call(
+            func=ast.Name(id="__jst_assert", ctx=ast.Load()),
+            args=[node.test, node.msg or ast.Constant(value=None)],
+            keywords=[]))
+
+
 class _SuperRewriter(ast.NodeTransformer):
     """Zero-arg super() relies on the implicit __class__ closure cell,
     which an exec-recompiled function lacks; rewrite to the explicit
@@ -824,6 +904,7 @@ def convert_to_static(fn):
         first_arg = fdef.args.args[0].arg if fdef.args.args else None
         sup = _SuperRewriter(first_arg)
         sup.visit(fdef)
+        fdef = _BuiltinCallTransformer().visit(fdef)
         fdef = _ListTransformer().visit(fdef)
         fdef = _ForToWhileTransformer().visit(fdef)
         fdef = _EarlyExitTransformer().apply(fdef)
@@ -853,6 +934,9 @@ def convert_to_static(fn):
         glb["__jst_or"] = functools.partial(_rt_bool, op_name="or")
         glb["__jst_list_append"] = _rt_list_append
         glb["__jst_list_pop"] = _rt_list_pop
+        glb["__jst_print"] = _rt_print
+        glb["__jst_assert"] = _rt_assert
+        glb["__jst_cast"] = _rt_cast
         # closures: bind current cell values by name (static snapshot)
         if fn.__closure__:
             for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):

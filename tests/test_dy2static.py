@@ -539,3 +539,56 @@ def test_list_mutation_of_caller_list_untouched():
     conv2 = convert_to_static(fwd)
     conv2(paddle.to_tensor(np.float32(2.0)))
     assert len(hooks) == 1
+
+
+# r04: print/assert/cast transformers (print_transformer.py,
+# assert_transformer.py, cast_transformer.py mirrors)
+
+def printy(x):
+    y = x * 2
+    print("value is", y.sum())
+    return y
+
+
+def asserty(x):
+    assert x.sum() > -1000, "sum exploded"
+    return x + 1
+
+
+def casty(x):
+    n = float(x.sum())
+    k = int(x.shape[0])
+    return x * n + k
+
+
+class TestPrintAssertCast:
+    def setup_method(self):
+        self.x = paddle.to_tensor(
+            np.arange(4, dtype="float32").reshape(2, 2))
+
+    def test_print_eager_and_traced(self, capsys):
+        conv = convert_to_static(printy)
+        conv(self.x)                       # concrete: builtin print
+        assert "value is" in capsys.readouterr().out
+        import jax
+
+        jax.jit(lambda r: conv(paddle.Tensor._wrap(r))._data)(
+            self.x._data)                  # traced: debug.print, no crash
+
+    def test_assert_concrete_and_traced(self):
+        conv = convert_to_static(asserty)
+        out = conv(self.x)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(self.x) + 1)
+        with pytest.raises(AssertionError, match="sum exploded"):
+            conv(paddle.to_tensor(np.float32(-1e6)))
+        import jax
+
+        # traced predicate stages (true case executes cleanly)
+        got = jax.jit(lambda r: conv(paddle.Tensor._wrap(r))._data)(
+            self.x._data)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(self.x) + 1)
+
+    def test_cast_matches_eager(self):
+        _check_matches(casty, self.x)
