@@ -700,32 +700,63 @@ class _LogicalTransformer(ast.NodeTransformer):
 _CONVERTED = {}
 
 
+_CB_OK = [None]
+
+
+def _callbacks_supported():
+    """Host callbacks (jax.debug.print/callback) are unavailable on some
+    remote PJRT backends (axon raises UNIMPLEMENTED at dispatch); fall
+    back to trace-time behavior there instead of crashing the whole
+    computation."""
+    if _CB_OK[0] is None:
+        import jax
+
+        _CB_OK[0] = jax.default_backend() in ("cpu", "tpu", "gpu",
+                                              "rocm", "cuda")
+    return _CB_OK[0]
+
+
 def _rt_print(*args, **kw):
     """print() that stays functional under trace (print_transformer.py
     role): traced operands route through jax.debug.print so the values
-    appear at RUN time, not trace time."""
+    appear at RUN time, not trace time. Backends without host callbacks
+    print the tracer reprs at trace time (pre-conversion behavior)."""
     import jax
 
     vals = [_unwrap(a) for a in args]
-    if any(isinstance(v, jax.core.Tracer) for v in vals):
+    if any(isinstance(v, jax.core.Tracer) for v in vals) \
+            and _callbacks_supported():
         fmt = kw.get("sep", " ").join("{}" for _ in vals)
         jax.debug.print(fmt, *vals)
     else:
         print(*args, **kw)
 
 
-def _rt_assert(pred, msg=None):
+def _rt_assert(pred, msg_fn=None):
     """assert that works on tensors and under trace
     (assert_transformer.py / assert_op.cc role): concrete values reduce
     with .all() like the Assert op; traced predicates check at run time
-    via a host callback."""
+    via a host callback (surfacing as a backend callback error WRAPPING
+    the AssertionError — callers matching AssertionError only catch the
+    concrete path). Backends without host callbacks skip the traced
+    check (no way to inspect run-time values there).
+
+    msg_fn is a thunk so the message expression is only evaluated on
+    failure, like a real assert."""
     traced, raw = _is_traced_bool(pred)
     if not traced:
         ok = raw.all() if hasattr(raw, "all") else raw
-        assert bool(ok), msg
+        assert bool(ok), (msg_fn() if msg_fn is not None else None)
+        return
+    if not _callbacks_supported():
         return
     import jax
     import numpy as _np
+
+    try:  # evaluate the message at trace time: the callback must not
+        msg = msg_fn() if msg_fn is not None else None  # hold tracers
+    except Exception:
+        msg = None
 
     def _check(ok):
         if not bool(_np.asarray(ok).all()):
@@ -844,11 +875,30 @@ class _BuiltinCallTransformer(ast.NodeTransformer):
     """print/assert/int/float/bool rewrites (print_transformer.py,
     assert_transformer.py, cast_transformer.py counterparts): each
     becomes a runtime-dispatch call that behaves like the builtin on
-    concrete values and stages on traced ones."""
+    concrete values and stages on traced ones. Names the function
+    SHADOWS (params or local assignments) are left untouched."""
+
+    def visit_FunctionDef(self, node):
+        shadowed = {a.arg for a in node.args.args + node.args.kwonlyargs}
+        if node.args.vararg:
+            shadowed.add(node.args.vararg.arg)
+        if node.args.kwarg:
+            shadowed.add(node.args.kwarg.arg)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx,
+                                                        ast.Store):
+                shadowed.add(sub.id)
+        self._shadowed = shadowed
+        self.generic_visit(node)
+        return node
+
+    def _is_builtin(self, name):
+        return name not in getattr(self, "_shadowed", ())
 
     def visit_Call(self, node):
         self.generic_visit(node)
-        if isinstance(node.func, ast.Name):
+        if isinstance(node.func, ast.Name) and \
+                self._is_builtin(node.func.id):
             if node.func.id == "print":
                 return ast.Call(
                     func=ast.Name(id="__jst_print", ctx=ast.Load()),
@@ -864,10 +914,17 @@ class _BuiltinCallTransformer(ast.NodeTransformer):
 
     def visit_Assert(self, node):
         self.generic_visit(node)
+        # the message rides as a THUNK so it is only evaluated on
+        # failure (a real assert never touches it on the passing path)
+        msg = ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                               kwonlyargs=[], kw_defaults=[],
+                               kwarg=None, defaults=[]),
+            body=node.msg) if node.msg is not None else \
+            ast.Constant(value=None)
         return ast.Expr(value=ast.Call(
             func=ast.Name(id="__jst_assert", ctx=ast.Load()),
-            args=[node.test, node.msg or ast.Constant(value=None)],
-            keywords=[]))
+            args=[node.test, msg], keywords=[]))
 
 
 class _SuperRewriter(ast.NodeTransformer):

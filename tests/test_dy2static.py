@@ -592,3 +592,23 @@ class TestPrintAssertCast:
 
     def test_cast_matches_eager(self):
         _check_matches(casty, self.x)
+
+
+def test_builtin_rewrites_respect_shadowing_and_lazy_msg():
+    """User-shadowed print/int names are untouched, and assert message
+    expressions are only evaluated on failure (real-assert semantics)."""
+    def shadowed(x, print):           # noqa: A002 - deliberate shadow
+        return print(x)
+
+    conv = convert_to_static(shadowed)
+    out = conv(paddle.to_tensor(np.float32(2.0)), lambda v: v * 3)
+    np.testing.assert_allclose(np.asarray(out), 6.0)
+
+    def lazy_msg(x):
+        a = []
+        assert x.sum() > -1000, "boom %s" % a[0]   # msg invalid if eval'd
+        return x
+
+    conv2 = convert_to_static(lazy_msg)
+    out2 = conv2(paddle.to_tensor(np.float32(1.0)))  # passes: msg never
+    np.testing.assert_allclose(np.asarray(out2), 1.0)  # evaluated
