@@ -574,13 +574,29 @@ class SparsePrefetcher:
     def prime(self, ids):
         self.prefetch(ids)
 
-    def prefetch(self, ids):
+    def prefetch(self, ids, aux=None):
+        """aux: optional host array(s) shipped to the device on the
+        prefetch thread alongside the rows (e.g. the chunk's labels) so
+        the training dispatch never pays their H2D inline. When given,
+        get() returns the pull result with the device aux appended."""
         import concurrent.futures
 
         if not hasattr(self, "_pool"):
             self._pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="pt-sparse-prefetch")
-        self._pending = self._pool.submit(self._pull, ids)
+        if aux is None:
+            self._pending = self._pool.submit(self._pull, ids)
+        else:
+            def pull_with_aux():
+                out = self._pull(ids)
+                aux_d = aux
+                if self._to_device:
+                    import jax
+
+                    aux_d = jax.device_put(aux)
+                return (out + (aux_d,) if isinstance(out, tuple)
+                        else (out, aux_d))
+            self._pending = self._pool.submit(pull_with_aux)
 
     def get(self, timeout=60.0):
         if self._pending is None:
@@ -632,10 +648,26 @@ class MergedSparseStream(SparsePrefetcher):
             grads = train_k_steps(rows)  # one jitted lax.scan
             ms.push_async(chunk_ids, grads)  # one D2H + merged push
         ms.drain()                       # grads all applied at the PS
+
+    unique_wire=True moves the id dedup to the PULL side and the row
+    merge onto the DEVICE: the prefetch thread np.unique's the chunk's
+    ids, pulls only the unique rows from the pserver, and ships
+    (rows[Upad,D] wire-dtype, inv[K,B,S] int32) — the training chunk
+    gathers `rows[inv[k]]` per step, and the gradient w.r.t. the unique
+    rows is the XLA-transposed scatter-add, i.e. the row merge runs on
+    the chip for free. The push side then reads back one already-merged
+    [Upad,D] gradient and RPCs it straight to the pserver — no host
+    np.unique/np.add.at on the critical plane, and every byte on the
+    tunnel and the PS wire is for a *unique* row (real CTR id streams
+    are Zipfian, so dedup cuts far deeper than the uniform-draw worst
+    case). U is padded up to a multiple of `pad_rows` (sentinel id ==
+    height, zero rows) so jit sees a handful of bucket shapes instead
+    of a fresh compile per chunk.
     """
 
     def __init__(self, comm, table, dim, height, wire_dtype="bfloat16",
-                 to_device=True, max_pending=4):
+                 to_device=True, max_pending=4, unique_wire=False,
+                 pad_rows=16384):
         import concurrent.futures
 
         super().__init__(comm, table, dim, to_device=to_device)
@@ -644,6 +676,8 @@ class MergedSparseStream(SparsePrefetcher):
         self._dim = dim
         self._height = height
         self._wire_dtype = wire_dtype
+        self._unique_wire = bool(unique_wire)
+        self._pad_rows = max(int(pad_rows), 1)
         self._max_pending = max(int(max_pending), 1)
         self._push_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="pt-merged-push")
@@ -654,15 +688,23 @@ class MergedSparseStream(SparsePrefetcher):
         self.push_seconds = 0.0
         self.chunks = 0
 
+    def _wire_np_dtype(self):
+        if not self._wire_dtype or self._wire_dtype == "float32":
+            return np.dtype(np.float32)
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, self._wire_dtype,
+                                self._wire_dtype))
+
     # ---------------- pull side (SparsePrefetcher + wire narrowing) ----
     def _pull(self, ids):
+        if self._unique_wire:
+            return self._pull_unique(ids)
         t0 = time.perf_counter()
         rows = self._table.lookup(ids)      # one RPC for all K batches
-        if self._wire_dtype and self._wire_dtype != "float32":
-            import ml_dtypes
-
-            rows = rows.astype(np.dtype(getattr(
-                ml_dtypes, self._wire_dtype, self._wire_dtype)))
+        wire = self._wire_np_dtype()
+        if rows.dtype != wire:
+            rows = rows.astype(wire)
         if self._to_device:
             import jax
 
@@ -670,6 +712,26 @@ class MergedSparseStream(SparsePrefetcher):
         self.pull_seconds += time.perf_counter() - t0
         self.chunks += 1
         return rows
+
+    def _pull_unique(self, ids):
+        t0 = time.perf_counter()
+        ids = np.asarray(ids, np.int64)
+        uniq, inv = np.unique(ids.ravel(), return_inverse=True)
+        upad = -(-uniq.size // self._pad_rows) * self._pad_rows
+        rows = np.zeros((upad, self._dim), self._wire_np_dtype())
+        # one RPC for the UNIQUE rows only; astype into the padded
+        # wire buffer narrows in the same pass
+        rows[:uniq.size] = self._table.lookup(uniq)
+        uniq_pad = np.full(upad, self._height, np.int64)
+        uniq_pad[:uniq.size] = uniq
+        inv = inv.reshape(ids.shape).astype(np.int32)
+        if self._to_device:
+            import jax
+
+            rows, inv = jax.device_put((rows, inv))
+        self.pull_seconds += time.perf_counter() - t0
+        self.chunks += 1
+        return rows, inv, uniq_pad
 
     # ---------------- push side ----------------
     def _push(self, ids, grads):
@@ -681,8 +743,17 @@ class MergedSparseStream(SparsePrefetcher):
         vals = np.asarray(grads).reshape(ids.size, self._dim)
         if vals.dtype != np.float32:
             vals = vals.astype(np.float32)
-        self._comm.push({self._name: SelectedRows(ids.ravel(), vals,
-                                                  self._height)})
+        if self._unique_wire:
+            # rows arrived pre-merged from the device scatter-add —
+            # drop the pad sentinels and RPC straight to the pserver,
+            # skipping Communicator.push's host unique/add.at plane
+            flat = ids.ravel()
+            keep = flat < self._height
+            self._comm._client_for(self._name).push_sparse(
+                self._name, flat[keep], vals[keep])
+        else:
+            self._comm.push({self._name: SelectedRows(ids.ravel(), vals,
+                                                      self._height)})
         self.push_seconds += time.perf_counter() - t0
 
     def push_async(self, ids, grads):

@@ -115,6 +115,17 @@ def _kv_bias(mask, b, h, sk):
     return m
 
 
+
+def _z():
+    """Typed zero for BlockSpec index maps: the tunnel's remote Mosaic
+    compile helper fails to legalize the weak int64 a bare python ``0``
+    stages (func.return (i32, i32, i64)); an int32-typed literal lowers
+    cleanly everywhere."""
+    import jax.numpy as jnp
+
+    return jnp.int32(0)
+
+
 # --------------------------------------------------------------------------
 # forward kernel: out + logsumexp (residual for the flash backward)
 # --------------------------------------------------------------------------
@@ -151,7 +162,8 @@ def _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal, has_bias, block_q,
                     jnp.int32, (block_q, block_k), 0)
                 cols = ki * block_k + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 1)
-                logits = jnp.where(rows >= cols, logits, -1e30)
+                logits = jnp.where(rows >= cols, logits,
+                                   jnp.float32(-1e30))
             m_cur = jnp.maximum(m_prev, logits.max(axis=-1, keepdims=True))
             alpha = jnp.exp(m_prev - m_cur)
             p = jnp.exp(logits - m_cur)
@@ -165,19 +177,19 @@ def _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal, has_bias, block_q,
         l0 = jnp.zeros((block_q, 1), jnp.float32)
         if is_causal:
             k_hi = (qi + 1) * block_q
-            nk_eff = (k_hi + block_k - 1) // block_k
+            nk_eff = (k_hi + block_k - 1) // jnp.int32(block_k)
         else:
             nk_eff = nk
         acc, m_f, l_f = jax.lax.fori_loop(
             jnp.int32(0), jnp.int32(nk_eff), body, (acc0, m0, l0))
-        l_safe = jnp.maximum(l_f, 1e-30)
+        l_safe = jnp.maximum(l_f, jnp.float32(1e-30))
         o_ref[...] = (acc / l_safe).astype(o_ref.dtype)
         lse_ref[...] = m_f + jnp.log(l_safe)   # (block_q, 1)
 
     in_specs = [
-        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-        pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
-        pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
+        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, _z())),
+        pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, _z(), _z())),
+        pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, _z(), _z())),
     ]
     if has_bias:
         # per-row tensors carry a trailing unit dim: the TPU lowering
@@ -185,14 +197,14 @@ def _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal, has_bias, block_q,
         # array dims — (rows, 1) satisfies that where a 1-D row block
         # cannot
         in_specs.append(
-            pl.BlockSpec((None, sk, 1), lambda bh, qi: (bh, 0, 0)))
+            pl.BlockSpec((None, sk, 1), lambda bh, qi: (bh, _z(), _z())))
     return pl.pallas_call(
         kernel,
         grid=(b * h, nq),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, _z())),
+            pl.BlockSpec((None, block_q, 1), lambda bh, qi: (bh, qi, _z())),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), dtype),
@@ -299,7 +311,8 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
                     jnp.int32, (block_q, block_k), 0)
                 cols = ki * block_k + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 1)
-                logits = jnp.where(rows >= cols, logits, -1e30)
+                logits = jnp.where(rows >= cols, logits,
+                                   jnp.float32(-1e30))
             p = jnp.exp(logits - lse_b)
             dp = jnp.dot(gb, vb.T, preferred_element_type=jnp.float32)
             ds = p * (dp - dl_b) * s
@@ -307,7 +320,8 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
                                  preferred_element_type=jnp.float32)
 
         if is_causal:
-            nk_eff = ((qi + 1) * block_q + block_k - 1) // block_k
+            nk_eff = ((qi + 1) * block_q + block_k - 1) \
+                // jnp.int32(block_k)
         else:
             nk_eff = nk
         acc = jax.lax.fori_loop(
@@ -316,23 +330,23 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
         dq_ref[...] = acc.astype(dq_ref.dtype)
 
     dq_in = [
-        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-        pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
-        pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
+        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, _z())),
+        pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, _z(), _z())),
+        pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, _z(), _z())),
     ]
     if has_bias:
-        dq_in.append(pl.BlockSpec((None, sk, 1), lambda bh, qi: (bh, 0, 0)))
+        dq_in.append(pl.BlockSpec((None, sk, 1), lambda bh, qi: (bh, _z(), _z())))
     dq_in += [
-        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-        pl.BlockSpec((None, block_q, 1), lambda bh, qi: (bh, qi, 0)),
-        pl.BlockSpec((None, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, _z())),
+        pl.BlockSpec((None, block_q, 1), lambda bh, qi: (bh, qi, _z())),
+        pl.BlockSpec((None, block_q, 1), lambda bh, qi: (bh, qi, _z())),
     ]
     dq_args = [qr, kr, vr] + ([bias_bh] if has_bias else []) + \
         [gr, lse, delta]
     dq = pl.pallas_call(
         dq_kernel, grid=(b * h, nq), in_specs=dq_in,
         out_specs=pl.BlockSpec((None, block_q, d),
-                               lambda bh, qi: (bh, qi, 0)),
+                               lambda bh, qi: (bh, qi, _z())),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         interpret=interpret,
     )(*dq_args)
@@ -365,7 +379,8 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
                     jnp.int32, (block_q, block_k), 0)
                 cols = ki * block_k + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 1)
-                logits = jnp.where(rows >= cols, logits, -1e30)
+                logits = jnp.where(rows >= cols, logits,
+                                   jnp.float32(-1e30))
             p = jnp.exp(logits - lse_b)
             dv_acc = dv_acc + jnp.dot(p.T, gb,
                                       preferred_element_type=jnp.float32)
@@ -378,7 +393,7 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
             return dk_acc, dv_acc, db_acc
 
         if is_causal:
-            q_lo = (ki * block_k) // block_q
+            q_lo = (ki * block_k) // jnp.int32(block_q)
         else:
             q_lo = 0
         z = jnp.zeros((block_k, d), jnp.float32)
@@ -391,23 +406,23 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
             db_ref[...] = db_acc[:, None]
 
     dkv_in = [
-        pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, 0, 0)),
-        pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
-        pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, _z(), _z())),
+        pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, _z())),
+        pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, _z())),
     ]
     if has_bias:
         dkv_in.append(
-            pl.BlockSpec((None, block_k, 1), lambda bh, ki: (bh, ki, 0)))
+            pl.BlockSpec((None, block_k, 1), lambda bh, ki: (bh, ki, _z())))
     dkv_in += [
-        pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, 0, 0)),
-        pl.BlockSpec((None, sq, 1), lambda bh, ki: (bh, 0, 0)),
-        pl.BlockSpec((None, sq, 1), lambda bh, ki: (bh, 0, 0)),
+        pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, _z(), _z())),
+        pl.BlockSpec((None, sq, 1), lambda bh, ki: (bh, _z(), _z())),
+        pl.BlockSpec((None, sq, 1), lambda bh, ki: (bh, _z(), _z())),
     ]
     dkv_args = [qr, kr, vr] + ([bias_bh] if has_bias else []) + \
         [gr, lse, delta]
     out_specs = [
-        pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
-        pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, _z())),
+        pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, _z())),
     ]
     out_shape = [
         jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
@@ -415,7 +430,7 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
     ]
     if has_bias:
         out_specs.append(pl.BlockSpec((None, block_k, 1),
-                                      lambda bh, ki: (bh, ki, 0)))
+                                      lambda bh, ki: (bh, ki, _z())))
         out_shape.append(jax.ShapeDtypeStruct((b * h, sk, 1),
                                               jnp.float32))
     outs = pl.pallas_call(
