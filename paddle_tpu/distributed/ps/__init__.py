@@ -563,22 +563,26 @@ class SparsePrefetcher:
         self._pending = None
         self._to_device = to_device
 
-    def _pull(self, ids):
+    def _pull(self, ids, aux=None):
         rows = self._table.lookup(ids)
         if self._to_device:
             import jax
 
+            if aux is not None:
+                return jax.device_put((rows, aux))
             rows = jax.device_put(rows)
-        return rows
+        return rows if aux is None else (rows, aux)
 
     def prime(self, ids):
         self.prefetch(ids)
 
     def prefetch(self, ids, aux=None):
-        """aux: optional host array(s) shipped to the device on the
+        """aux: optional host array shipped to the device on the
         prefetch thread alongside the rows (e.g. the chunk's labels) so
-        the training dispatch never pays their H2D inline. When given,
-        get() returns the pull result with the device aux appended."""
+        the training dispatch never pays their H2D inline — folded into
+        the SAME device_put as the rows, so it adds bytes but no extra
+        fixed-latency tunnel call. When given, get() returns the pull
+        result with the device aux appended."""
         import concurrent.futures
 
         if not hasattr(self, "_pool"):
@@ -587,16 +591,7 @@ class SparsePrefetcher:
         if aux is None:
             self._pending = self._pool.submit(self._pull, ids)
         else:
-            def pull_with_aux():
-                out = self._pull(ids)
-                aux_d = aux
-                if self._to_device:
-                    import jax
-
-                    aux_d = jax.device_put(aux)
-                return (out + (aux_d,) if isinstance(out, tuple)
-                        else (out, aux_d))
-            self._pending = self._pool.submit(pull_with_aux)
+            self._pending = self._pool.submit(self._pull, ids, aux)
 
     def get(self, timeout=60.0):
         if self._pending is None:
@@ -697,9 +692,9 @@ class MergedSparseStream(SparsePrefetcher):
                                 self._wire_dtype))
 
     # ---------------- pull side (SparsePrefetcher + wire narrowing) ----
-    def _pull(self, ids):
+    def _pull(self, ids, aux=None):
         if self._unique_wire:
-            return self._pull_unique(ids)
+            return self._pull_unique(ids, aux)
         t0 = time.perf_counter()
         rows = self._table.lookup(ids)      # one RPC for all K batches
         wire = self._wire_np_dtype()
@@ -708,12 +703,15 @@ class MergedSparseStream(SparsePrefetcher):
         if self._to_device:
             import jax
 
-            rows = jax.device_put(rows)
+            if aux is not None:
+                rows, aux = jax.device_put((rows, aux))
+            else:
+                rows = jax.device_put(rows)
         self.pull_seconds += time.perf_counter() - t0
         self.chunks += 1
-        return rows
+        return rows if aux is None else (rows, aux)
 
-    def _pull_unique(self, ids):
+    def _pull_unique(self, ids, aux=None):
         t0 = time.perf_counter()
         ids = np.asarray(ids, np.int64)
         uniq, inv = np.unique(ids.ravel(), return_inverse=True)
@@ -728,10 +726,16 @@ class MergedSparseStream(SparsePrefetcher):
         if self._to_device:
             import jax
 
-            rows, inv = jax.device_put((rows, inv))
+            # one device_put for rows + inv + aux: the tunnel charges a
+            # fixed latency per call, so the labels ride along free
+            if aux is not None:
+                rows, inv, aux = jax.device_put((rows, inv, aux))
+            else:
+                rows, inv = jax.device_put((rows, inv))
         self.pull_seconds += time.perf_counter() - t0
         self.chunks += 1
-        return rows, inv, uniq_pad
+        out = (rows, inv, uniq_pad)
+        return out if aux is None else out + (aux,)
 
     # ---------------- push side ----------------
     def _push(self, ids, grads):

@@ -254,3 +254,204 @@ def test_machine_translation_book():
     best = np.asarray(toks[:, 0, :])                  # [8, T]
     acc = float((best == src_b).mean())
     assert acc > 0.8, (acc, best[0], src_b[0])
+
+
+def test_fit_a_line_book(tmp_path):
+    """tests/book/test_fit_a_line.py capability: linear regression on a
+    housing-style feature vector, SGD to decreasing loss, then
+    save_inference_model -> load_inference_model -> predictions match
+    the training program's."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[13], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, y), dim=[0, 1])
+        fluid.optimizer.SGD(0.03).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(7)
+    w_true = rng.randn(13, 1).astype("float32")
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(120):
+            xv = rng.randn(32, 13).astype("float32")
+            yv = xv @ w_true + 0.05 * rng.randn(32, 1).astype("float32")
+            losses.append(float(exe.run(main, {"x": xv, "y": yv},
+                                        [loss])[0]))
+        assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.2, (
+            losses[:3], losses[-3:])
+        mdir = str(tmp_path / "fit_a_line")
+        fluid.io.save_inference_model(mdir, ["x"], [pred], exe,
+                                      main_program=main)
+        xq = rng.randn(4, 13).astype("float32")
+        want = exe.run(main, {"x": xq, "y": np.zeros((4, 1), "f4")},
+                       [pred])[0]
+    infer_scope = fluid.Scope()
+    with fluid.scope_guard(infer_scope):
+        prog, feeds, fetches = fluid.io.load_inference_model(mdir, exe)
+        got = exe.run(prog, {feeds[0]: xq}, fetches)[0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_recommender_system_book():
+    """tests/book/test_recommender_system.py capability: two-tower
+    recommender — user tower (id/gender/age/job embeddings -> fc) and
+    movie tower (id/category embeddings -> fc) -> interaction readout
+    regressed onto ratings; loss must fall."""
+    USR, GEN, AGE, JOB, MOV, CAT = 40, 2, 7, 10, 60, 6
+    EMB = 8
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        def emb_feat(name, vocab):
+            d = fluid.layers.data(name, shape=[1], dtype="int64")
+            return d, fluid.layers.embedding(d, size=[vocab, EMB])
+        usr_in, usr_emb = emb_feat("usr", USR)
+        gen_in, gen_emb = emb_feat("gender", GEN)
+        age_in, age_emb = emb_feat("age", AGE)
+        job_in, job_emb = emb_feat("job", JOB)
+        mov_in, mov_emb = emb_feat("movie", MOV)
+        cat_in, cat_emb = emb_feat("category", CAT)
+        usr_feat = fluid.layers.fc(
+            fluid.layers.concat([usr_emb, gen_emb, age_emb, job_emb], 1),
+            size=16, act="tanh")
+        mov_feat = fluid.layers.fc(
+            fluid.layers.concat([mov_emb, cat_emb], 1),
+            size=16, act="tanh")
+        inter = fluid.layers.elementwise_mul(usr_feat, mov_feat)
+        rating = fluid.layers.fc(inter, size=1)
+        label = fluid.layers.data("score", shape=[1], dtype="float32")
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(rating, label), dim=[0, 1])
+        fluid.optimizer.Adam(0.01).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(11)
+    # learnable rule: rating driven by (user id + movie id) parity mix
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(150):
+            B = 32
+            feed = {"usr": rng.randint(0, USR, (B, 1)).astype("int64"),
+                    "gender": rng.randint(0, GEN, (B, 1)).astype("int64"),
+                    "age": rng.randint(0, AGE, (B, 1)).astype("int64"),
+                    "job": rng.randint(0, JOB, (B, 1)).astype("int64"),
+                    "movie": rng.randint(0, MOV, (B, 1)).astype("int64"),
+                    "category": rng.randint(0, CAT, (B, 1)).astype(
+                        "int64")}
+            score = ((feed["usr"] % 5) + (feed["movie"] % 5)
+                     ).astype("float32") / 2.0
+            feed["score"] = score
+            losses.append(float(exe.run(main, feed, [loss])[0]))
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.5, (
+        losses[:3], losses[-3:])
+
+
+def test_rnn_encoder_decoder_book():
+    """tests/book/test_rnn_encoder_decoder.py capability: plain GRU
+    encoder -> decoder conditioned on the encoder's final state
+    (no attention — the MT book test covers attention), teacher-forced
+    next-token loss falls."""
+    from paddle_tpu.core.lod import LoDTensor
+
+    V, EMB, H = 25, 12, 16
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        src = fluid.layers.data("src", shape=[1], dtype="int64",
+                                lod_level=1)
+        trg = fluid.layers.data("trg", shape=[1], dtype="int64",
+                                lod_level=1)
+        nxt = fluid.layers.data("nxt", shape=[1], dtype="int64",
+                                lod_level=1)
+        src_emb = fluid.layers.embedding(src, size=[V, EMB],
+                                         param_attr="src_emb")
+        enc_proj = fluid.layers.fc(src_emb, size=3 * H, bias_attr=False)
+        enc = fluid.layers.dynamic_gru(enc_proj, size=H)
+        enc_last = fluid.layers.sequence_last_step(enc)
+        trg_emb = fluid.layers.embedding(trg, size=[V, EMB],
+                                         param_attr="trg_emb")
+        dec_proj = fluid.layers.fc(trg_emb, size=3 * H, bias_attr=False)
+        dec = fluid.layers.dynamic_gru(dec_proj, size=H, h_0=enc_last)
+        logits = fluid.layers.fc(dec, size=V)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, nxt),
+            dim=[0, 1])
+        fluid.optimizer.Adam(0.02).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(5)
+
+    def batch():
+        lens = rng.randint(2, 6, size=8)
+        srcs = [rng.randint(1, V, (n, 1)).astype("int64") for n in lens]
+        # learnable mapping: target token = source token reversed order
+        trgs = [s[::-1].copy() for s in srcs]
+        # teacher forcing: input is <bos=0> + trg[:-1], predict trg
+        tins = [np.vstack([[0], t[:-1]]).astype("int64") for t in trgs]
+        return (LoDTensor.from_sequences(srcs),
+                LoDTensor.from_sequences(tins),
+                LoDTensor.from_sequences(trgs))
+
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(120):
+            s, t, n = batch()
+            losses.append(float(exe.run(
+                main, {"src": s, "trg": t, "nxt": n}, [loss])[0]))
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.8, (
+        losses[:3], losses[-3:])
+
+
+def test_understand_sentiment_book():
+    """tests/book/notest_understand_sentiment.py capability: stacked
+    bidirectional-ish LSTM sentiment classifier (the book's
+    stacked_lstm_net) over LoD word sequences; loss falls on a
+    learnable token rule."""
+    from paddle_tpu.core.lod import LoDTensor
+
+    V, EMB, H = 30, 12, 16
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        words = fluid.layers.data("words", shape=[1], dtype="int64",
+                                  lod_level=1)
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(words, size=[V, EMB])
+        fc1 = fluid.layers.fc(emb, size=4 * H, bias_attr=False)
+        lstm1, _ = fluid.layers.dynamic_lstm(fc1, size=4 * H)
+        fc2 = fluid.layers.fc(lstm1, size=4 * H, bias_attr=False)
+        lstm2, _ = fluid.layers.dynamic_lstm(fc2, size=4 * H,
+                                             is_reverse=True)
+        feat = fluid.layers.concat(
+            [fluid.layers.sequence_pool(lstm1, "max"),
+             fluid.layers.sequence_pool(lstm2, "max")], axis=1)
+        logits = fluid.layers.fc(feat, size=2)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label),
+            dim=[0, 1])
+        fluid.optimizer.Adam(0.01).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(9)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(100):
+            lens = rng.randint(3, 8, size=8)
+            rows = [rng.randint(0, V, (n, 1)).astype("int64")
+                    for n in lens]
+            # sentiment rule: positive iff any token < V // 3
+            y = np.array([[int((r < V // 3).any())] for r in rows],
+                         dtype="int64")
+            feed = {"words": LoDTensor.from_sequences(rows), "label": y}
+            losses.append(float(exe.run(main, feed, [loss])[0]))
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.6, (
+        losses[:3], losses[-3:])

@@ -432,3 +432,31 @@ class TestHeterogeneousSpmdPipeline:
                         jax.tree_util.tree_leaves(g_ref)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-3, atol=1e-5)
+
+
+def test_multichip_scaling_harness_cpu_mesh():
+    """The bench.py multichip harness (BASELINE.md north star: fleet
+    allreduce GB/s + >70% DP scaling) must run end-to-end on the
+    8-virtual-device CPU mesh so it is ready the moment real multi-chip
+    hardware appears. Bandwidth numbers on CPU are meaningless; the
+    assertions cover structure and sanity, not magnitude."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, devs
+    r = bench._multichip_scaling(devices=devs[:8], sizes_mb=(1,),
+                                 ar_iters=2, dp_steps=2)
+    assert r["metric"] == "fleet_allreduce_scaling"
+    assert r["n_devices"] == 8
+    band = r["allreduce"]["1MB"]
+    assert band["algbw_GBps"] > 0 and band["busbw_GBps"] > 0
+    ws = r["dp_weak_scaling"]
+    assert ws["tput_1dev_ex_per_s"] > 0 and ws["tput_8dev_ex_per_s"] > 0
+    assert 0 < ws["efficiency"]
