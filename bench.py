@@ -87,6 +87,16 @@ def _spread(per_sample_values, kind="pair_slopes"):
             "spread_pct": round(100.0 * (hi - lo) / med, 1) if med else 0.0}
 
 
+
+def _softmax_ce(logits, labels):
+    """Shared bench loss: f32 log-softmax CE over integer labels."""
+    import jax
+    import jax.numpy as jnp
+
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    return -jnp.take_along_axis(lp, labels[:, None], -1).mean()
+
+
 def _ernie(batch=32, seq_len=128, steps=STEPS, layers=12, hidden=768, heads=12, inter=3072):
     import jax
 
@@ -105,11 +115,7 @@ def _ernie(batch=32, seq_len=128, steps=STEPS, layers=12, hidden=768, heads=12, 
                       num_classes=2)
     net = ErnieForSequenceClassification(cfg)
 
-    def ce(logits, labels):
-        import jax.numpy as jnp
-
-        lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-        return -jnp.take_along_axis(lp, labels[:, None], -1).mean()
+    ce = _softmax_ce
 
     tr = SpmdTrainer(net, ce, fopt.adamw(5e-5), mesh=mesh,
                      compute_dtype="bfloat16")
@@ -138,6 +144,77 @@ def _ernie(batch=32, seq_len=128, steps=STEPS, layers=12, hidden=768, heads=12, 
             "method": "two-point marginal over jitted multi-step scans "
                       "(fixed remote-dispatch latency excluded; e2e_value "
                       "keeps it included)"}
+
+
+def _ernie_long(batch=8, seq_len=1024, steps=16):
+    """Long-context ERNIE fine-tune (seq 1024): the default dispatch
+    (XLA fused attention — measured faster in-model on this chip) vs
+    the pallas flash path forced on. This is the full-model companion
+    to the `long_context` kernel A/B, and the measurement that SET the
+    default: flash wins 1.4-1.9x standalone on BHSD operands, but
+    in-model the BSHD transposes + lost projection fusion make it
+    0.90-0.94x across seq 1024/2048/4096, so sdpa_bshd keeps XLA until
+    PT_FLASH_MIN_SEQ_BSHD says otherwise. Dropout is 0: the blockwise
+    kernel has no prob-dropout, so this isolates the attention
+    implementation on an otherwise identical (and common: many
+    fine-tune recipes disable dropout) workload."""
+    import os
+
+    def measure(force_flash):
+        import jax
+
+        if force_flash:
+            os.environ["PT_FLASH_MIN_SEQ_BSHD"] = "512"
+        else:
+            os.environ.pop("PT_FLASH_MIN_SEQ_BSHD", None)
+        from paddle_tpu.optimizer import functional as fopt
+        from paddle_tpu.parallel import SpmdTrainer, init_mesh
+        from paddle_tpu.text import (ErnieConfig,
+                                     ErnieForSequenceClassification)
+
+        mesh = init_mesh(dp=1, devices=[jax.devices()[0]])
+        cfg = ErnieConfig(vocab_size=30522, max_position=seq_len + 2,
+                          hidden_dropout=0.0, attn_dropout=0.0,
+                          num_classes=2)
+        net = ErnieForSequenceClassification(cfg)
+
+        ce = _softmax_ce
+
+        tr = SpmdTrainer(net, ce, fopt.adamw(5e-5), mesh=mesh,
+                         compute_dtype="bfloat16")
+        rs = np.random.RandomState(0)
+        ids = rs.randint(1, cfg.vocab_size,
+                         (batch, seq_len)).astype(np.int64)
+        labels = rs.randint(0, 2, (batch,)).astype(np.int64)
+        key = jax.random.PRNGKey(0)
+        dids, dlabels = tr.shard_batch(ids, labels)
+
+        def run_n(n):
+            t0 = time.perf_counter()
+            lf = float(tr.run_steps((dids,), dlabels, n, rng=key))
+            dt = time.perf_counter() - t0
+            assert lf == lf, "ernie_long produced NaN loss"
+            return dt
+
+        dt, _, slopes = _marginal_step_time(run_n, steps, lo_frac=4)
+        return batch / dt, slopes
+
+    v_default, slopes = measure(False)
+    v_flash, _ = measure(True)
+    os.environ.pop("PT_FLASH_MIN_SEQ_BSHD", None)
+    return {"metric": "ernie_long_context_seq1024_seq_per_sec_per_chip",
+            "value": round(v_default, 2), "unit": "seq/s",
+            "flash_forced_seq_per_sec": round(v_flash, 2),
+            "flash_vs_default": round(v_flash / v_default, 3),
+            "spread": _spread([batch / s for s in slopes]),
+            "config": {"batch": batch, "seq_len": seq_len,
+                       "dropout": 0.0,
+                       "note": "dropout off: flash kernel has no "
+                               "prob-dropout; common fine-tune "
+                               "configuration. Default dispatch is XLA "
+                               "fused attention in-model (see "
+                               "sdpa_bshd docstring)"},
+            "method": "two-point marginal over jitted multi-step scans"}
 
 
 def _hbm_profile():
@@ -238,11 +315,7 @@ def _resnet50(batch=128, img=224, steps=40):
     mesh = init_mesh(dp=1, devices=[jax.devices()[0]])
     net = resnet50(num_classes=1000)
 
-    def ce(logits, labels):
-        import jax.numpy as jnp
-
-        lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-        return -jnp.take_along_axis(lp, labels[:, None], -1).mean()
+    ce = _softmax_ce
 
     tr = SpmdTrainer(net, ce, fopt.momentum(0.1, 0.9), mesh=mesh,
                      compute_dtype="bfloat16")
@@ -604,7 +677,11 @@ def _long_context_attention(seqs=(1024, 2048, 4096), b=2, h=16, d=64,
             @functools.partial(jax.jit, static_argnums=3)
             def run_n(q, k, v, n):
                 def body(c, _):
-                    gq, gk, gv = g(q * (1 + c * 1e-9), k, v)
+                    # perturb in q's OWN dtype: bf16 * f32-carry would
+                    # silently promote Q to f32 and benchmark the wrong
+                    # precision
+                    qp = (q * (1 + c * 1e-9)).astype(q.dtype)
+                    gq, gk, gv = g(qp, k, v)
                     return gq.astype(jnp.float32).mean(), None
                 c, _ = jax.lax.scan(body, jnp.float32(0.0), None,
                                     length=n)
@@ -700,16 +777,16 @@ def _multichip_scaling(devices=None, sizes_mb=(4, 64), ar_iters=8,
         net = pnn.Sequential(pnn.Linear(256, 512), pnn.ReLU(),
                              pnn.Linear(512, 10))
 
-        def ce(logits, labels):
-            lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-            return -jnp.take_along_axis(lp, labels[:, None], -1).mean()
+        ce = _softmax_ce
 
         tr = SpmdTrainer(net, ce, fopt.momentum(0.1, 0.9), mesh=m)
         B = 512 * len(sub)
         xs = np.random.RandomState(1).randn(B, 256).astype("f4")
         ys = np.random.RandomState(2).randint(0, 10, (B,)).astype("i8")
         dx, dy = tr.shard_batch(xs, ys)
-        float(tr.run_steps((dx,), dy, 2))     # warm
+        # warm the SAME step count: run_steps caches jitted loops per n,
+        # so warming n=2 and timing n=dp_steps would time a compile
+        float(tr.run_steps((dx,), dy, dp_steps))
         t0 = time.perf_counter()
         float(tr.run_steps((dx,), dy, dp_steps))
         return B * dp_steps / (time.perf_counter() - t0)
@@ -759,6 +836,7 @@ def main():
     configs = [("mnist", _mnist_static), ("resnet50", _resnet50),
                ("ernie", _ernie), ("ctr_ps", _ctr_dnn_ps),
                ("long_context", _long_context_attention),
+               ("ernie_long", _ernie_long),
                ("multichip_scaling", _multichip_scaling)]
     results = {}
     headline = None
