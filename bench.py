@@ -199,9 +199,15 @@ def _ernie_long(batch=8, seq_len=1024, steps=16):
         dt, _, slopes = _marginal_step_time(run_n, steps, lo_frac=4)
         return batch / dt, slopes
 
-    v_default, slopes = measure(False)
-    v_flash, _ = measure(True)
-    os.environ.pop("PT_FLASH_MIN_SEQ_BSHD", None)
+    saved = os.environ.get("PT_FLASH_MIN_SEQ_BSHD")
+    try:
+        v_default, slopes = measure(False)
+        v_flash, _ = measure(True)
+    finally:
+        if saved is None:
+            os.environ.pop("PT_FLASH_MIN_SEQ_BSHD", None)
+        else:
+            os.environ["PT_FLASH_MIN_SEQ_BSHD"] = saved
     return {"metric": "ernie_long_context_seq1024_seq_per_sec_per_chip",
             "value": round(v_default, 2), "unit": "seq/s",
             "flash_forced_seq_per_sec": round(v_flash, 2),
@@ -581,10 +587,12 @@ def _ctr_dnn_ps(batch=4096, chunks=12, merge_k=16):
                 "note": "worker-thread seconds. push_plane includes the"
                         " grad readback, which BLOCKS until the scan"
                         " compute finishes (it bounds the dispatch"
-                        " queue), plus bf16 widen + the unique-row RPC"
-                        " push; the host merge plane (np.unique/add.at"
-                        " on 524k rows) moved onto the device in r04's"
-                        " unique_wire and no longer appears here"}
+                        " queue), plus the unique-row RPC push; the"
+                        " host merge plane (np.unique/add.at) moved"
+                        " onto the device (unique_wire) and the"
+                        " widen/narrow passes moved into the C++"
+                        " pserver (bf16 wire opcodes) — the trainer"
+                        " host never converts dtypes anymore"}
         finally:
             ms.close()
             comm.stop()  # always reap the async send/recv threads

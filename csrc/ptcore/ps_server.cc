@@ -51,7 +51,29 @@ enum Cmd : uint8_t {
   kPullDenseIfNewer = 10,  // name, client_version u64 -> version-gated
   kSave = 11,  // path -> snapshot ALL tables (dense + sparse + opt state)
   kLoad = 12,  // path -> restore tables from a kSave snapshot
+  kPushSparseBf16 = 13,  // table, dim u32, keys i64[], grads bf16[n*dim]
+  kPullSparseBf16 = 14,  // table, dim u32, keys i64[] -> rows bf16
 };
+
+// bf16 <-> f32: widen is exact (<<16); narrow is round-to-nearest-even,
+// bit-identical to ml_dtypes/numpy astype — the server-side conversion
+// replaces the trainer's host-plane widen/narrow with the SAME numerics
+// while halving the wire bytes.
+static inline float Bf16ToF32(uint16_t b) {
+  uint32_t u = ((uint32_t)b) << 16;
+  float f;
+  memcpy(&f, &u, 4);
+  return f;
+}
+
+static inline uint16_t F32ToBf16(float f) {
+  uint32_t u;
+  memcpy(&u, &f, 4);
+  if ((u & 0x7F800000u) == 0x7F800000u)  // inf/nan: truncate, keep payload
+    return (uint16_t)(u >> 16) | (uint16_t)((u & 0xFFFFu) ? 0x40 : 0);
+  u += 0x7FFFu + ((u >> 16) & 1u);
+  return (uint16_t)(u >> 16);
+}
 
 enum Status : uint8_t { kOk = 0, kErr = 1 };
 
@@ -420,6 +442,60 @@ class Server {
           }
         }
         resp->Put<uint8_t>(kOk);
+        return;
+      }
+      case kPushSparseBf16: {
+        std::string name = r.Str();
+        uint32_t dim = r.Get<uint32_t>();
+        uint64_t n = r.Get<uint64_t>();
+        if (!r.ok || dim == 0 || !FitsRaw(r, n, 8))
+          return Err(resp, "bad push_sparse_bf16");
+        const char* keys = r.Raw(n * 8);
+        if (!r.ok || !FitsRaw(r, n, (uint64_t)dim * 2))
+          return Err(resp, "bad push_sparse_bf16");
+        const char* grads = r.Raw((uint64_t)n * dim * 2);
+        if (!r.ok) return Err(resp, "bad push_sparse_bf16");
+        auto& t = Sparse(name, dim);
+        std::lock_guard<std::mutex> lk(t.mu);
+        if (t.dim != dim)
+          return Err(resp, "push_sparse_bf16: dim mismatch for " + name +
+                               " (table=" + std::to_string(t.dim) +
+                               " req=" + std::to_string(dim) + ")");
+        const int64_t* kk = (const int64_t*)keys;
+        const uint16_t* gg = (const uint16_t*)grads;
+        std::vector<float> wide(dim);
+        for (uint64_t i = 0; i < n; ++i) {
+          for (uint32_t k = 0; k < dim; ++k)
+            wide[k] = Bf16ToF32(gg[i * dim + k]);
+          ApplySparse(t, kk[i], wide.data());
+        }
+        resp->Put<uint8_t>(kOk);
+        return;
+      }
+      case kPullSparseBf16: {
+        std::string name = r.Str();
+        uint32_t dim = r.Get<uint32_t>();
+        uint64_t n = r.Get<uint64_t>();
+        if (!r.ok || dim == 0 || !FitsRaw(r, n, 8))
+          return Err(resp, "bad pull_sparse_bf16");
+        const char* keys = r.Raw(n * 8);
+        if (!r.ok) return Err(resp, "bad pull_sparse_bf16");
+        auto& t = Sparse(name, dim);
+        std::lock_guard<std::mutex> lk(t.mu);
+        if (t.dim != dim)
+          return Err(resp, "pull_sparse_bf16: dim mismatch for " + name +
+                               " (table=" + std::to_string(t.dim) +
+                               " req=" + std::to_string(dim) + ")");
+        resp->Put<uint8_t>(kOk);
+        resp->Put<uint64_t>(n);
+        const int64_t* kk = (const int64_t*)keys;
+        std::vector<uint16_t> narrow(dim);
+        for (uint64_t i = 0; i < n; ++i) {
+          auto& row = t.Row(kk[i]);
+          for (uint32_t k = 0; k < dim; ++k)
+            narrow[k] = F32ToBf16(row[k]);
+          resp->Raw(narrow.data(), (uint64_t)dim * 2);
+        }
         return;
       }
       case kSave: {
@@ -1017,6 +1093,41 @@ int pt_ps_pull_sparse(void* h, const char* table, uint32_t dim,
     return -4;
   }
   memcpy(out, g_resp.data() + 9, (uint64_t)n * dim * 4);
+  return 0;
+}
+
+int pt_ps_push_sparse_bf16(void* h, const char* table, uint32_t dim,
+                           const int64_t* keys, uint64_t n,
+                           const uint16_t* grads) {
+  Writer w;
+  w.Put<uint8_t>(ptcore::ps::kPushSparseBf16);
+  w.Str(table);
+  w.Put<uint32_t>(dim);
+  w.Put<uint64_t>(n);
+  w.Raw(keys, n * 8);
+  w.Raw(grads, (uint64_t)n * dim * 2);
+  return SimpleCall((Client*)h, w);
+}
+
+int pt_ps_pull_sparse_bf16(void* h, const char* table, uint32_t dim,
+                           const int64_t* keys, uint64_t n, uint16_t* out) {
+  Writer w;
+  w.Put<uint8_t>(ptcore::ps::kPullSparseBf16);
+  w.Str(table);
+  w.Put<uint32_t>(dim);
+  w.Put<uint64_t>(n);
+  w.Raw(keys, n * 8);
+  Client* c = (Client*)h;
+  if (!c->Call(w, &g_resp)) return -1;
+  if (g_resp.empty() || g_resp[0] != 0) {
+    CaptureServerError(c);
+    return -2;
+  }
+  if (g_resp.size() < 9 + (uint64_t)n * dim * 2) {
+    c->error = "pull_sparse_bf16: truncated response payload";
+    return -4;
+  }
+  memcpy(out, g_resp.data() + 9, (uint64_t)n * dim * 2);
   return 0;
 }
 

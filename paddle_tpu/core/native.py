@@ -184,6 +184,16 @@ def _declare(lib):
         "pt_ps_pull_sparse": (c.c_int, [c.c_void_p, c.c_char_p, c.c_uint32,
                                         c.POINTER(c.c_int64), c.c_uint64,
                                         c.POINTER(c.c_float)]),
+        "pt_ps_push_sparse_bf16": (c.c_int, [c.c_void_p, c.c_char_p,
+                                             c.c_uint32,
+                                             c.POINTER(c.c_int64),
+                                             c.c_uint64,
+                                             c.POINTER(c.c_uint16)]),
+        "pt_ps_pull_sparse_bf16": (c.c_int, [c.c_void_p, c.c_char_p,
+                                             c.c_uint32,
+                                             c.POINTER(c.c_int64),
+                                             c.c_uint64,
+                                             c.POINTER(c.c_uint16)]),
         "pt_ps_barrier": (c.c_int, [c.c_void_p, c.c_uint32]),
         "pt_ps_heartbeat": (c.c_int, [c.c_void_p, c.c_uint32]),
         "pt_ps_shutdown": (c.c_int, [c.c_void_p]),

@@ -192,6 +192,56 @@ class PsClient:
             "pull_sparse")
         return out
 
+    def push_sparse_bf16(self, table, keys, grads_bf16):
+        """bf16-wire push: grads arrive as an ml_dtypes.bfloat16 array
+        (e.g. straight off a device readback) and ship WITHOUT a host
+        widen — the server widens while applying (bit-identical to the
+        host astype it replaces) and the loopback RPC carries half the
+        bytes."""
+        import ml_dtypes
+
+        keys = np.ascontiguousarray(keys, np.int64).ravel()
+        g = np.ascontiguousarray(grads_bf16)
+        if g.dtype != np.dtype(ml_dtypes.bfloat16):
+            g = g.astype(ml_dtypes.bfloat16)
+        dim = g.shape[-1]
+        g16 = g.reshape(keys.size, dim).view(np.uint16)
+        self._ck(self._lib.pt_ps_push_sparse_bf16(
+            self._h, table.encode(), dim,
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), keys.size,
+            g16.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))),
+            "push_sparse_bf16")
+
+    def pull_sparse_bf16(self, table, keys, dim, out=None):
+        """bf16-wire pull: the server narrows fp32 rows to bf16
+        (round-to-nearest-even, matching numpy astype) before the RPC;
+        the result lands directly in `out` (or a fresh bf16 array) with
+        no host-side narrow pass. `out` may be any [n, dim] uint16 or
+        bfloat16 buffer — e.g. a slice of a padded wire buffer."""
+        import ml_dtypes
+
+        keys = np.ascontiguousarray(keys, np.int64).ravel()
+        bf16 = np.dtype(ml_dtypes.bfloat16)
+        if out is None:
+            out = np.empty((keys.size, dim), bf16)
+        view = out.view(np.uint16) if out.dtype == bf16 else out
+        if view.dtype != np.uint16:
+            raise ValueError(
+                f"pull_sparse_bf16 out must be bfloat16 or uint16, got "
+                f"{out.dtype}")
+        if not view.flags["C_CONTIGUOUS"]:
+            raise ValueError("pull_sparse_bf16 needs a contiguous out")
+        if view.size != keys.size * dim:
+            raise ValueError(
+                f"pull_sparse_bf16 out has {view.size} elements, needs "
+                f"{keys.size * dim}")
+        self._ck(self._lib.pt_ps_pull_sparse_bf16(
+            self._h, table.encode(), dim,
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), keys.size,
+            view.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))),
+            "pull_sparse_bf16")
+        return out if out.dtype == bf16 else out.view(bf16)
+
     def barrier(self, barrier_id=0):
         self._ck(self._lib.pt_ps_barrier(self._h, barrier_id), "barrier")
 
@@ -717,9 +767,15 @@ class MergedSparseStream(SparsePrefetcher):
         uniq, inv = np.unique(ids.ravel(), return_inverse=True)
         upad = -(-uniq.size // self._pad_rows) * self._pad_rows
         rows = np.zeros((upad, self._dim), self._wire_np_dtype())
-        # one RPC for the UNIQUE rows only; astype into the padded
-        # wire buffer narrows in the same pass
-        rows[:uniq.size] = self._table.lookup(uniq)
+        # one RPC for the UNIQUE rows only. bf16 wire: the pserver
+        # narrows server-side straight into the padded wire buffer —
+        # half the loopback bytes and zero host narrow pass; other
+        # dtypes narrow on assignment from the fp32 pull
+        if self._bf16_wire():
+            self._comm._client_for(self._name).pull_sparse_bf16(
+                self._name, uniq, self._dim, out=rows[:uniq.size])
+        else:
+            rows[:uniq.size] = self._table.lookup(uniq)
         uniq_pad = np.full(upad, self._height, np.int64)
         uniq_pad[:uniq.size] = uniq
         inv = inv.reshape(ids.shape).astype(np.int32)
@@ -737,25 +793,40 @@ class MergedSparseStream(SparsePrefetcher):
         out = (rows, inv, uniq_pad)
         return out if aux is None else out + (aux,)
 
+    def _bf16_wire(self):
+        """True when the bf16-on-the-wire fast path applies end to end:
+        bfloat16 wire dtype AND the native client (the pure-python test
+        fakes don't speak the bf16 opcodes)."""
+        if self._wire_dtype != "bfloat16":
+            return False
+        cli = self._comm._client_for(self._name)
+        return hasattr(cli, "push_sparse_bf16")
+
     # ---------------- push side ----------------
     def _push(self, ids, grads):
         from ...sparse import SelectedRows
 
         t0 = time.perf_counter()
-        # np.asarray = the ONE device→host readback for K batches; row
-        # merge + fp32 widen happen host-side in Communicator.push
+        # np.asarray = the ONE device→host readback for K batches
         vals = np.asarray(grads).reshape(ids.size, self._dim)
-        if vals.dtype != np.float32:
-            vals = vals.astype(np.float32)
         if self._unique_wire:
             # rows arrived pre-merged from the device scatter-add —
             # drop the pad sentinels and RPC straight to the pserver,
             # skipping Communicator.push's host unique/add.at plane
             flat = ids.ravel()
             keep = flat < self._height
-            self._comm._client_for(self._name).push_sparse(
-                self._name, flat[keep], vals[keep])
+            cli = self._comm._client_for(self._name)
+            if self._bf16_wire() and vals.dtype == self._wire_np_dtype():
+                # device readback is already bf16: ship it verbatim,
+                # the server widens (bit-identical to a host astype)
+                cli.push_sparse_bf16(self._name, flat[keep], vals[keep])
+            else:
+                if vals.dtype != np.float32:
+                    vals = vals.astype(np.float32)
+                cli.push_sparse(self._name, flat[keep], vals[keep])
         else:
+            if vals.dtype != np.float32:
+                vals = vals.astype(np.float32)
             self._comm.push({self._name: SelectedRows(ids.ravel(), vals,
                                                       self._height)})
         self.push_seconds += time.perf_counter() - t0

@@ -462,6 +462,104 @@ def conv_elementwise_add_act_fuse_pass(program, scope=None):
     return program
 
 
+@register_pass("conv_elementwise_add2_act_fuse_pass")
+def conv_elementwise_add2_act_fuse_pass(program, scope=None):
+    """conv2d -> elementwise_add(bias) -> elementwise_add(residual) ->
+    relu collapses into one conv2d_fusion with Bias + ResidualData
+    (ir/conv_elementwise_add2_act_fuse_pass.cc). The first add's Y must
+    be a persistable 1-D bias; the second add's Y is the residual
+    feature map (NOT persistable — the exact opposite guard of the
+    single-add pass)."""
+    blk = program.global_block()
+
+    def _var(name):
+        try:
+            return blk.var(name)
+        except ValueError:
+            return None
+
+    def _is_bias(v):
+        return (v is not None and bool(getattr(v, "persistable", False))
+                and len(v.shape or []) == 1)
+
+    pat = {
+        "conv": {"type": "conv2d"},
+        "add1": {"type": "elementwise_add",
+                 "inputs": {"X": ("conv", True)}},
+        "add2": {"type": "elementwise_add",
+                 "inputs": {"X": ("add1", True)}},
+        "act": {"type": "relu", "inputs": {"X": ("add2", True)}},
+    }
+    for m in SubgraphMatcher(pat).match(program):
+        conv, add1, add2, actop = (m["conv"], m["add1"], m["add2"],
+                                   m["act"])
+        bias_v = _var(add1.input("Y")[0])
+        resid_v = _var(add2.input("Y")[0])
+        if not _is_bias(bias_v) or add1.attrs.get("axis", -1) != 1:
+            continue
+        if resid_v is None or getattr(resid_v, "persistable", False):
+            continue  # residual must be a runtime feature map
+        # and a full-rank one added trailing-aligned: a broadcast add
+        # (axis=1 over a computed [C] tensor, say) is not a residual
+        # join and would mis-broadcast under conv2d_fusion's `out + r`
+        if add2.attrs.get("axis", -1) != -1:
+            continue
+        if len(resid_v.shape or []) != 4:
+            continue
+        idx = blk.ops.index(actop)
+        blk._insert_op(
+            idx, "conv2d_fusion",
+            inputs={"Input": [conv.input("Input")[0]],
+                    "Filter": [conv.input("Filter")[0]],
+                    "Bias": [add1.input("Y")[0]],
+                    "ResidualData": [add2.input("Y")[0]]},
+            outputs={"Output": [actop.output("Out")[0]]},
+            attrs={**{k: v for k, v in conv.attrs.items()
+                      if k in ("strides", "paddings", "dilations",
+                               "groups")},
+                   "activation": "relu"})
+        IrGraph(program).remove_ops([conv, add1, add2, actop])
+    program._bump()
+    return program
+
+
+@register_pass("seqpool_concat_fuse_pass")
+def seqpool_concat_fuse_pass(program, scope=None):
+    """N parallel sequence_pool(SUM) branches feeding one concat(axis=1)
+    collapse into fusion_seqpool_concat
+    (ir/seqpool_concat_fuse_pass.cc). Variable fan-in, so this walks
+    concat ops directly instead of a fixed-arity matcher pattern."""
+    blk = program.global_block()
+    g = IrGraph(program)
+    for cat in [op for op in blk.ops if op.type == "concat"]:
+        if cat.attrs.get("axis", None) not in (1,):
+            continue
+        pools = []
+        for name in cat.input("X"):
+            prods = [op for op in blk.ops
+                     if name in op.output_arg_names]
+            if (len(prods) == 1 and prods[0].type == "sequence_pool"
+                    and str(prods[0].attrs.get("pooltype",
+                                               "AVERAGE")).upper()
+                    == "SUM"
+                    and len(g.var_consumers(name)) == 1):
+                pools.append(prods[0])
+            else:
+                pools = None
+                break
+        if not pools:
+            continue
+        idx = blk.ops.index(cat)
+        blk._insert_op(
+            idx, "fusion_seqpool_concat",
+            inputs={"X": [p.input("X")[0] for p in pools]},
+            outputs={"Out": [cat.output("Out")[0]]},
+            attrs={"pooltype": "SUM", "axis": 1})
+        IrGraph(program).remove_ops(pools + [cat])
+    program._bump()
+    return program
+
+
 def _fc_rnn_emit(blk, program, mul, rnn, fused_type, bias_name=None):
     idx = blk.ops.index(rnn)    # after every input's producer
     inputs = {"X": [mul.input("X")[0]],

@@ -444,3 +444,86 @@ def test_fc_gru_biased_form_fuse():
         got = exe.run(main, feed, [hid])[0]
         want = np.asarray(want_lod).reshape(got.shape)
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+def test_conv_elementwise_add2_act_fuse():
+    """conv -> add(bias) -> add(residual feature map) -> relu fuses to
+    conv2d_fusion with ResidualData; a persistable second operand must
+    NOT match (it would be a double-bias, not a residual)."""
+    from paddle_tpu.core.lod import LoDTensor  # noqa: F401 (parity import)
+
+    main, startup, exe = _exe_prog()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        blk = main.global_block()
+        x = fluid.layers.data("x", [3, 8, 8])
+        res = fluid.layers.data("res", [5, 8, 8])
+        w = fluid.layers.create_parameter([5, 3, 3, 3], "float32",
+                                          name="c2w")
+        b = fluid.layers.create_parameter([5], "float32", name="c2b")
+        co = blk.create_var(name="c2out")
+        _append(blk, "conv2d", {"Input": [x], "Filter": [w]},
+                {"Output": [co.name]},
+                {"strides": [1, 1], "paddings": [1, 1],
+                 "dilations": [1, 1], "groups": 1})
+        a1 = blk.create_var(name="c2a1")
+        _append(blk, "elementwise_add", {"X": [co], "Y": [b]},
+                {"Out": [a1.name]}, {"axis": 1})
+        a2 = blk.create_var(name="c2a2")
+        _append(blk, "elementwise_add", {"X": [a1], "Y": [res]},
+                {"Out": [a2.name]}, {"axis": -1})
+        y = blk.create_var(name="c2y")
+        _append(blk, "relu", {"X": [a2]}, {"Out": [y.name]})
+    exe.run(startup)
+    rs = np.random.RandomState(3)
+    feed = {"x": rs.randn(2, 3, 8, 8).astype("f4"),
+            "res": rs.randn(2, 5, 8, 8).astype("f4")}
+    want = exe.run(main, feed, [y])[0]
+    apply_pass(main, "conv_elementwise_add2_act_fuse_pass")
+    types = [o.type for o in main.global_block().ops]
+    assert "conv2d_fusion" in types and "conv2d" not in types, types
+    fused = [o for o in main.global_block().ops
+             if o.type == "conv2d_fusion"][0]
+    assert fused.input("ResidualData") == ["res"]
+    got = exe.run(main, feed, [y])[0]
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+def test_seqpool_concat_fuse():
+    """N sequence_pool(SUM) branches + concat(axis=1) fuse into one
+    fusion_seqpool_concat; numerics identical on a LoD batch."""
+    from paddle_tpu.core.lod import LoDTensor
+
+    main, startup, exe = _exe_prog()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        blk = main.global_block()
+        xs = [fluid.layers.data(f"sq{i}", [4], lod_level=1)
+              for i in range(3)]
+        pooled = []
+        for i, xv in enumerate(xs):
+            p = blk.create_var(name=f"sp{i}")
+            _append(blk, "sequence_pool", {"X": [xv]},
+                    {"Out": [p.name]}, {"pooltype": "SUM"})
+            pooled.append(p)
+        cat = blk.create_var(name="spcat")
+        _append(blk, "concat", {"X": [p.name for p in pooled]},
+                {"Out": [cat.name]}, {"axis": 1})
+    exe.run(startup)
+    rs = np.random.RandomState(4)
+
+    def batch():
+        feed = {}
+        for i in range(3):
+            lens = rs.randint(1, 5, size=4)
+            feed[f"sq{i}"] = LoDTensor.from_sequences(
+                [rs.randn(n, 4).astype("f4") for n in lens])
+        return feed
+
+    feed = batch()
+    want = exe.run(main, feed, [cat])[0]
+    apply_pass(main, "seqpool_concat_fuse_pass")
+    types = [o.type for o in main.global_block().ops]
+    assert "fusion_seqpool_concat" in types
+    assert "sequence_pool" not in types and "concat" not in types, types
+    got = exe.run(main, feed, [cat])[0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=1e-6)
