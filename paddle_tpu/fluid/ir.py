@@ -530,20 +530,20 @@ def seqpool_concat_fuse_pass(program, scope=None):
     (ir/seqpool_concat_fuse_pass.cc). Variable fan-in, so this walks
     concat ops directly instead of a fixed-arity matcher pattern."""
     blk = program.global_block()
-    g = IrGraph(program)
-    for cat in [op for op in blk.ops if op.type == "concat"]:
+    for cat in [op for op in list(blk.ops) if op.type == "concat"]:
         if cat.attrs.get("axis", None) not in (1,):
             continue
+        # fresh graph per candidate: remove_ops mutates the block, so a
+        # snapshot from before an earlier rewrite would be stale
+        g = IrGraph(program)
         pools = []
         for name in cat.input("X"):
-            prods = [op for op in blk.ops
-                     if name in op.output_arg_names]
-            if (len(prods) == 1 and prods[0].type == "sequence_pool"
-                    and str(prods[0].attrs.get("pooltype",
-                                               "AVERAGE")).upper()
-                    == "SUM"
+            prod = g.var_producer(name)
+            if (prod is not None and prod.type == "sequence_pool"
+                    and str(prod.attrs.get("pooltype",
+                                           "AVERAGE")).upper() == "SUM"
                     and len(g.var_consumers(name)) == 1):
-                pools.append(prods[0])
+                pools.append(prod)
             else:
                 pools = None
                 break
@@ -555,7 +555,193 @@ def seqpool_concat_fuse_pass(program, scope=None):
             inputs={"X": [p.input("X")[0] for p in pools]},
             outputs={"Out": [cat.output("Out")[0]]},
             attrs={"pooltype": "SUM", "axis": 1})
-        IrGraph(program).remove_ops(pools + [cat])
+        g.remove_ops(pools + [cat])
+    program._bump()
+    return program
+
+
+@register_pass("attention_lstm_fuse_pass")
+def attention_lstm_fuse_pass(program, scope=None):
+    """DynamicRNN-form per-step attention LSTM (the shape
+    fluid.nets.attention_lstm builds — token-fc + prev-cell-fc -> relu
+    -> softmax -> attended sum -> one LSTM step, gates [f,i,o,cand]) ->
+    ONE fused `attention_lstm` op (ir/attention_lstm_fuse_pass.cc,
+    attention_lstm_op.cc). Needs the scope: the fused op's weight layout
+    concatenates the unfused params (AttentionWeight = [aw_m; aw_d],
+    LSTMWeight = [w_h; w_x] rows), so new combined parameters are
+    materialized. The reference pass matched one hard-coded model by
+    variable name; this one matches the op-graph fingerprint of the
+    recurrent sub-block."""
+    import collections
+
+    if scope is None:
+        raise ValueError("attention_lstm_fuse_pass needs the scope "
+                         "holding the attention/LSTM weights")
+    blk = program.global_block()
+    _FPRINT = {"mul": 3, "elementwise_add": 4, "relu": 1, "softmax": 1,
+               "reshape2": 1, "elementwise_mul": 4, "reduce_sum": 1,
+               "slice": 4, "sigmoid": 3, "tanh": 2}
+
+    def _producer(ops, name):
+        for o in ops:
+            if name in o.output_arg_names:
+                return o
+        return None
+
+    for rec in [op for op in list(blk.ops) if op.type == "recurrent"]:
+        a = rec.attrs
+        if not a.get("batch_major") or len(a.get("pre_names", [])) != 2:
+            continue
+        sub = program.block(a["sub_block"])
+        sops = list(sub.ops)
+        if collections.Counter(o.type for o in sops) != _FPRINT:
+            continue
+        pres = set(a["pre_names"])
+        # the two gate muls: one consumes a memory (h_pre @ w_h), the
+        # cell-fc mul consumes the other memory (c_pre @ [D,1])
+        muls = [o for o in sops if o.type == "mul"]
+
+        def _gshape(name):
+            return ((blk.var(name).shape or [None])
+                    if blk.has_var(name) else [None])
+
+        cfc = next((o for o in muls if o.input("X")[0] in pres
+                    and _gshape(o.input("Y")[0])[-1] == 1), None)
+        gh = next((o for o in muls if o.input("X")[0] in pres
+                   and o is not cfc), None)
+        gx = next((o for o in muls if o not in (cfc, gh)), None)
+        if cfc is None or gh is None or gx is None:
+            continue
+        c_pre = cfc.input("X")[0]
+        h_pre = gh.input("X")[0]
+        if c_pre == h_pre:
+            continue  # both gate muls must read DISTINCT memories
+        aw_d_name, w_h_name, w_x_name = (cfc.input("Y")[0],
+                                         gh.input("Y")[0],
+                                         gx.input("Y")[0])
+        # e = relu(atted + cfc): the add joining cfc with the
+        # OUTER-produced atted
+        eadd = next((o for o in sops if o.type == "elementwise_add"
+                     and cfc.output("Out")[0] in o.input_arg_names),
+                    None)
+        if eadd is None:
+            continue
+        atted_name = next(n for n in eadd.input_arg_names
+                          if n != cfc.output("Out")[0])
+        # the bias add: persistable 1-D Y (lstm bias)
+        badd = next((o for o in sops if o.type == "elementwise_add"
+                     and blk.has_var(o.input("Y")[0])
+                     and getattr(blk.var(o.input("Y")[0]), "persistable",
+                                 False)
+                     and len(blk.var(o.input("Y")[0]).shape or []) == 1
+                     and o is not eadd), None)
+        if badd is None:
+            continue
+        b_name = badd.input("Y")[0]
+        # gate order check: slices [0:D],[D:2D],[2D:3D],[3D:4D] must
+        # feed sigmoid, sigmoid, sigmoid, tanh (f, i, o, candidate)
+        if not blk.has_var(w_h_name):
+            continue
+        w_h_shape = blk.var(w_h_name).shape or []
+        if len(w_h_shape) != 2 or w_h_shape[1] % 4:
+            continue
+        D = w_h_shape[1] // 4
+        order_ok = True
+        for gi, want in enumerate(("sigmoid", "sigmoid", "sigmoid",
+                                   "tanh")):
+            sl = next((o for o in sops if o.type == "slice"
+                       and o.attrs.get("starts") == [gi * D]), None)
+            if sl is None:
+                order_ok = False
+                break
+            cons = [o for o in sops
+                    if sl.output("Out")[0] in o.input_arg_names]
+            if len(cons) != 1 or cons[0].type != want:
+                order_ok = False
+                break
+        if not order_ok:
+            continue
+        # the static sequence: the elementwise_mul of softmax weights
+        # against an outer var = x
+        smax = next(o for o in sops if o.type == "softmax")
+        rshp = next((o for o in sops if o.type == "reshape2"
+                     and smax.output("Out")[0] in o.input_arg_names),
+                    None)
+        if rshp is None:
+            continue
+        wmul = next((o for o in sops if o.type == "elementwise_mul"
+                     and rshp.output("Out")[0] in o.input_arg_names),
+                    None)
+        if wmul is None:
+            continue
+        x_name = next(n for n in wmul.input_arg_names
+                      if n != rshp.output("Out")[0])
+        # parent-side atted chain: reshape2 <- add(ab) <- mul(x, aw_m)
+        p_rshp = _producer(blk.ops, atted_name)
+        if p_rshp is None or p_rshp.type != "reshape2":
+            continue
+        p_add = _producer(blk.ops, p_rshp.input("X")[0])
+        if p_add is None or p_add.type != "elementwise_add":
+            continue
+        p_mul = _producer(blk.ops, p_add.input("X")[0])
+        if (p_mul is None or p_mul.type != "mul"
+                or p_mul.input("X")[0] != x_name):
+            continue
+        aw_m_name, ab_name = p_mul.input("Y")[0], p_add.input("Y")[0]
+        vals = {n: scope.get_value(n) for n in
+                (aw_m_name, ab_name, aw_d_name, w_x_name, w_h_name,
+                 b_name)}
+        if any(v is None for v in vals.values()):
+            continue
+        aw_m = np.asarray(vals[aw_m_name], np.float32).reshape(-1, 1)
+        aw_d = np.asarray(vals[aw_d_name], np.float32).reshape(-1, 1)
+        w_x = np.asarray(vals[w_x_name], np.float32)
+        w_h = np.asarray(vals[w_h_name], np.float32)
+        M = aw_m.shape[0]
+        fused_aw = np.concatenate([aw_m, aw_d], axis=0)      # [M+D, 1]
+        fused_lw = np.concatenate([w_h, w_x], axis=0)        # [D+M, 4D]
+        fused_lb = np.asarray(vals[b_name],
+                              np.float32).reshape(1, 4 * D)
+        # unique per matched recurrence: two attention branches over
+        # the SAME x must not share/clobber fused weight vars
+        base = f"{x_name}@{a['out_names'][0]}"
+        names = {}
+        for suffix, val in (("aw", fused_aw), ("lw", fused_lw),
+                            ("lb", fused_lb),
+                            ("ab", np.asarray(vals[ab_name],
+                                              np.float32))):
+            nm = f"{base}@attn_lstm_{suffix}"
+            blk.create_var(name=nm, shape=list(val.shape),
+                           dtype=np.float32, persistable=True)
+            scope.set_value(nm, val)
+            names[suffix] = nm
+        hid_out, cell_out = a["out_names"][0], a["out_names"][1]
+        for on in (hid_out, cell_out):
+            v = blk.var(on)
+            if getattr(v, "lod_level", 0):
+                v.lod_level = 0      # fused dense-X path emits dense outs
+        attx = f"{base}@attn_lstm_attx"  # unique via base
+        blk.create_var(name=attx, shape=[-1, 1], dtype=np.float32)
+        idx = blk.ops.index(rec)
+        blk._insert_op(
+            idx, "attention_lstm",
+            inputs={"X": [x_name],
+                    "AttentionWeight": [names["aw"]],
+                    "AttentionBias": [names["ab"]],
+                    "LSTMWeight": [names["lw"]],
+                    "LSTMBias": [names["lb"]]},
+            outputs={"Hidden": [hid_out], "Cell": [cell_out],
+                     "AttentionedX": [attx]},
+            attrs={"gate_activation": "sigmoid",
+                   "cell_activation": "tanh",
+                   "candidate_activation": "tanh"})
+        dead = [rec, p_rshp, p_add, p_mul]
+        # boot fills now feed nothing
+        for bn in a.get("boot_names", []):
+            bp = _producer(blk.ops, bn)
+            if bp is not None and bp.type == "fill_constant_batch_size_like":
+                dead.append(bp)
+        IrGraph(program).remove_ops(dead)
     program._bump()
     return program
 

@@ -316,6 +316,15 @@ class StaticRNN:
         self._step_inputs.append((ipt.name, x))
         return ipt
 
+    def static_input(self, x):
+        """Reference StaticRNN.StaticInput parity: expose a FULL outer
+        tensor inside every step (not sliced per timestep — the scan
+        body's environment carries parent-block vars through, so the
+        whole sequence is readable at each step; the per-step attention
+        over a complete source sequence is the canonical use)."""
+        self._require_block()
+        return x
+
     def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
                init_batch_dim_idx=0, ref_batch_dim_idx=1):
         blk = self._require_block()

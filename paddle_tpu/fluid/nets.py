@@ -10,7 +10,8 @@ from __future__ import annotations
 from . import layers
 
 __all__ = ["simple_img_conv_pool", "sequence_conv_pool", "glu",
-           "scaled_dot_product_attention", "img_conv_group"]
+           "scaled_dot_product_attention", "img_conv_group",
+           "attention_lstm"]
 
 
 def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
@@ -121,3 +122,108 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
         return ctx
     ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
     return layers.reshape(ctx, shape=[0, 0, num_heads * d_val])
+
+
+def attention_lstm(x, size, name="attn_lstm"):
+    """Per-step attention LSTM in its UNFUSED DynamicRNN form — the
+    program shape users of the reference wrote before
+    attention_lstm_fuse_pass.cc rewrote it into the fused
+    `attention_lstm` op (attention_lstm_op.cc semantics: at every step,
+    scores over ALL tokens from token-fc + prev-cell-fc -> relu ->
+    softmax; the attended sum feeds one LSTM step; gate order
+    [forget, input, output, candidate]).
+
+    x: dense [B, T, M] (full-length rows; ragged masking arrives with
+    the fused op's LoD lens after `attention_lstm_fuse_pass` runs).
+    Returns (hidden [B, T, size], cell [B, T, size]).
+    """
+    from .framework import unique_name
+    from .layer_helper import LayerHelper
+
+    helper = LayerHelper(name)
+    B_, T, M = x.shape[0], int(x.shape[1]), int(x.shape[2])
+    D = int(size)
+
+    def param(suffix, shape):
+        return layers.create_parameter(
+            shape, "float32",
+            name=unique_name.generate(f"{name}_{suffix}"))
+
+    aw_m = param("attn_w", [M, 1])
+    ab = param("attn_b", [1])
+    aw_d = param("cell_w", [D, 1])
+    w_x = param("lstm_wx", [M, 4 * D])
+    w_h = param("lstm_wh", [D, 4 * D])
+    b = param("lstm_b", [4 * D])
+
+    def app(t, ins, _outs=None, attrs=None, out_shape=None):
+        # helper.block at CALL time: ops inside the DynamicRNN `with`
+        # must land in the rnn sub-block, not the parent
+        blk = helper.block
+        ov = blk.create_var(
+            name=unique_name.generate(f"{name}_t"), shape=out_shape,
+            dtype="float32")
+        blk.append_op(type=t, inputs=ins, outputs={"Out": [ov]},
+                      attrs=attrs or {})
+        return ov
+
+    # precomputed token scores: atted[B, T] = x @ aw_m + ab (the fused
+    # lowering hoists exactly this out of its scan too)
+    mm = app("mul", {"X": [x], "Y": [aw_m]}, None,
+             {"x_num_col_dims": 2}, out_shape=[B_, T, 1])
+    mb = app("elementwise_add", {"X": [mm], "Y": [ab]}, None,
+             {"axis": -1}, out_shape=[B_, T, 1])
+    atted = app("reshape2", {"X": [mb]}, None, {"shape": [0, T]},
+                out_shape=[B_, T])
+
+    rnn = layers.DynamicRNN()
+    with rnn.block():
+        step = rnn.step_input(x)               # drives T; value unused
+        xs = rnn.static_input(x)               # whole sequence per step
+        h_pre = rnn.memory(shape=[D], value=0.0)
+        c_pre = rnn.memory(shape=[D], value=0.0)
+        cfc = app("mul", {"X": [c_pre], "Y": [aw_d]}, None,
+                  {"x_num_col_dims": 1}, out_shape=[-1, 1])
+        e_pre = app("elementwise_add", {"X": [atted], "Y": [cfc]}, None,
+                    {"axis": -1}, out_shape=[-1, T])
+        e = app("relu", {"X": [e_pre]}, None, out_shape=[-1, T])
+        a = app("softmax", {"X": [e]}, None, {"axis": -1},
+                out_shape=[-1, T])
+        a_r = app("reshape2", {"X": [a]}, None, {"shape": [0, T, 1]},
+                  out_shape=[-1, T, 1])
+        ax = app("elementwise_mul", {"X": [xs], "Y": [a_r]}, None,
+                 {"axis": -1}, out_shape=[-1, T, M])
+        lstm_x = app("reduce_sum", {"X": [ax]}, None,
+                     {"dim": [1], "keep_dim": False},
+                     out_shape=[-1, M])
+        g1 = app("mul", {"X": [lstm_x], "Y": [w_x]}, None,
+                 {"x_num_col_dims": 1}, out_shape=[-1, 4 * D])
+        g2 = app("mul", {"X": [h_pre], "Y": [w_h]}, None,
+                 {"x_num_col_dims": 1}, out_shape=[-1, 4 * D])
+        g12 = app("elementwise_add", {"X": [g1], "Y": [g2]}, None,
+                  {"axis": -1}, out_shape=[-1, 4 * D])
+        gates = app("elementwise_add", {"X": [g12], "Y": [b]}, None,
+                    {"axis": -1}, out_shape=[-1, 4 * D])
+        gs = []
+        for gi in range(4):                     # [f, i, o, candidate]
+            gs.append(app("slice", {"Input": [gates]}, None,
+                          {"axes": [1], "starts": [gi * D],
+                           "ends": [(gi + 1) * D]}, out_shape=[-1, D]))
+        f = app("sigmoid", {"X": [gs[0]]}, None, out_shape=[-1, D])
+        i = app("sigmoid", {"X": [gs[1]]}, None, out_shape=[-1, D])
+        o = app("sigmoid", {"X": [gs[2]]}, None, out_shape=[-1, D])
+        cand = app("tanh", {"X": [gs[3]]}, None, out_shape=[-1, D])
+        fc_ = app("elementwise_mul", {"X": [f], "Y": [c_pre]}, None,
+                  {"axis": -1}, out_shape=[-1, D])
+        ic = app("elementwise_mul", {"X": [i], "Y": [cand]}, None,
+                 {"axis": -1}, out_shape=[-1, D])
+        c2 = app("elementwise_add", {"X": [fc_], "Y": [ic]}, None,
+                 {"axis": -1}, out_shape=[-1, D])
+        ct = app("tanh", {"X": [c2]}, None, out_shape=[-1, D])
+        h2 = app("elementwise_mul", {"X": [ct], "Y": [o]}, None,
+                 {"axis": -1}, out_shape=[-1, D])
+        rnn.update_memory(h_pre, h2)
+        rnn.update_memory(c_pre, c2)
+        rnn.output(h2, c2)
+    hidden, cell = rnn()
+    return hidden, cell

@@ -527,3 +527,40 @@ def test_seqpool_concat_fuse():
     got = exe.run(main, feed, [cat])[0]
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=1e-6)
+
+
+def test_attention_lstm_fuse():
+    """fluid.nets.attention_lstm's DynamicRNN form rewrites into ONE
+    fused attention_lstm op (attention_lstm_fuse_pass.cc role) with the
+    combined AttentionWeight/[w_h; w_x] layouts; numerics match the
+    unfused recurrence."""
+    import paddle_tpu.fluid.nets as nets
+    from paddle_tpu.fluid.ir import apply_pass
+
+    B, T, M, D = 3, 5, 6, 4
+    main, startup, exe = _exe_prog()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [T, M], dtype="float32")
+        hidden, cell = nets.attention_lstm(x, size=D)
+    scope = fluid.Scope()
+    rs = np.random.RandomState(6)
+    xv = rs.randn(B, T, M).astype("f4")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # non-trivial weights: Xavier leaves them random already, but
+        # keep the bias non-zero so the gate order matters
+        bname = [n for n in scope._values if "lstm_b" in n][0]
+        scope.set_value(bname, (rs.randn(4 * D) * 0.3).astype("f4"))
+        h0, c0 = exe.run(main, {"x": xv}, [hidden, cell],
+                         return_numpy=False)
+        want_h = np.asarray(h0).reshape(B, T, D)
+        want_c = np.asarray(c0).reshape(B, T, D)
+        apply_pass(main, "attention_lstm_fuse_pass", scope=scope)
+        types = [o.type for o in main.global_block().ops]
+        assert "attention_lstm" in types and "recurrent" not in types, \
+            types
+        got_h, got_c = exe.run(main, {"x": xv}, [hidden, cell])
+    np.testing.assert_allclose(np.asarray(got_h).reshape(B, T, D),
+                               want_h, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(got_c).reshape(B, T, D),
+                               want_c, rtol=2e-5, atol=2e-6)
