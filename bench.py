@@ -6,12 +6,13 @@ BENCH_DETAILS.json and echoed to stderr:
 
   1. fluid static-graph MNIST (LeNet, whole-block XLA Executor)  imgs/s
   2. paddle.vision ResNet-50 (dygraph functionalized, bf16)      imgs/s
-  3. ERNIE-base fine-tune (static + flash attention, bf16)       seq/s
-  5. CTR-DNN with async native PS + SelectedRows sparse push     ex/s
-
-Config 4 (multi-chip allreduce scaling) needs >1 real chip and records
-as skipped here; the 8-device CPU dryrun (__graft_entry__) validates its
-code path.
+  3. ERNIE-base fine-tune (bf16)                                 seq/s
+  5. CTR-DNN, async native PS, unique-row bf16 wire              ex/s
+  +  long_context: pallas flash vs XLA attention kernel A/B      x
+  +  ernie_long:   seq-1024 fine-tune, default vs flash-forced   seq/s
+  4. multichip_scaling: allreduce busbw + DP weak scaling — runs
+     whenever >1 device is visible (records skipped on this 1-chip
+     host; validated on the 8-device CPU mesh by the test suite).
 
 vs_baseline for the headline is measured against a provisional 300 seq/s
 target — the paddlepaddle-gpu BERT-base fp16 fine-tune per-V100-chip

@@ -538,7 +538,8 @@ def seqpool_concat_fuse_pass(program, scope=None):
         g = IrGraph(program)
         pools = []
         for name in cat.input("X"):
-            prod = g.var_producer(name)
+            writers = [o for o in g.ops if name in o.output_arg_names]
+            prod = writers[0] if len(writers) == 1 else None
             if (prod is not None and prod.type == "sequence_pool"
                     and str(prod.attrs.get("pooltype",
                                            "AVERAGE")).upper() == "SUM"
@@ -581,12 +582,6 @@ def attention_lstm_fuse_pass(program, scope=None):
     _FPRINT = {"mul": 3, "elementwise_add": 4, "relu": 1, "softmax": 1,
                "reshape2": 1, "elementwise_mul": 4, "reduce_sum": 1,
                "slice": 4, "sigmoid": 3, "tanh": 2}
-
-    def _producer(ops, name):
-        for o in ops:
-            if name in o.output_arg_names:
-                return o
-        return None
 
     for rec in [op for op in list(blk.ops) if op.type == "recurrent"]:
         a = rec.attrs
@@ -676,18 +671,63 @@ def attention_lstm_fuse_pass(program, scope=None):
             continue
         x_name = next(n for n in wmul.input_arg_names
                       if n != rshp.output("Out")[0])
-        # parent-side atted chain: reshape2 <- add(ab) <- mul(x, aw_m)
-        p_rshp = _producer(blk.ops, atted_name)
-        if p_rshp is None or p_rshp.type != "reshape2":
+        # parent-side atted chain: reshape2 <- add(ab) <- mul(x, aw_m);
+        # every link must be its output's SOLE global consumer (and the
+        # vars single-writer) or removal would starve another reader
+        g = IrGraph(program)
+
+        def _sole_chain_producer(name, want_type, consumer=None):
+            writers = [o for o in g.ops if name in o.output_arg_names]
+            if len(writers) != 1 or writers[0].type != want_type:
+                return None
+            cons = g.var_consumers(name)
+            if consumer is None:
+                # atted itself: consumed only inside the sub-block, so
+                # its GLOBAL consumer list must be empty
+                if cons:
+                    return None
+            elif cons != [consumer]:
+                return None
+            return writers[0]
+
+        p_rshp = _sole_chain_producer(atted_name, "reshape2")
+        if p_rshp is None:
             continue
-        p_add = _producer(blk.ops, p_rshp.input("X")[0])
-        if p_add is None or p_add.type != "elementwise_add":
+        p_add = _sole_chain_producer(p_rshp.input("X")[0],
+                                     "elementwise_add", p_rshp)
+        if p_add is None:
             continue
-        p_mul = _producer(blk.ops, p_add.input("X")[0])
-        if (p_mul is None or p_mul.type != "mul"
-                or p_mul.input("X")[0] != x_name):
+        p_mul = _sole_chain_producer(p_add.input("X")[0], "mul", p_add)
+        if p_mul is None or p_mul.input("X")[0] != x_name:
             continue
         aw_m_name, ab_name = p_mul.input("Y")[0], p_add.input("Y")[0]
+        # the fused op wires no H0/C0: only literal ZERO boots fuse
+        # (a value=0.5 boot would silently become zeros otherwise)
+        boots_zero = True
+        for bn in a.get("boot_names", []):
+            bp = g.var_producer(bn)
+            if (bp is None
+                    or bp.type != "fill_constant_batch_size_like"
+                    or float(bp.attrs.get("value", 0.0)) != 0.0):
+                boots_zero = False
+                break
+        if not boots_zero:
+            continue
+        # map outputs by ROLE, not position: the cell memory's updated
+        # var is the cell chain, the other is hidden — robust to
+        # rnn.output(c2, h2) ordering; bail on any arity mismatch
+        # BEFORE any scope/program mutation
+        pre_list = list(a["pre_names"])
+        new_list = list(a.get("new_names", []))
+        souts = list(a.get("step_out_names", []))
+        outs = list(a["out_names"])
+        if (len(new_list) != 2 or len(souts) != 2 or len(outs) != 2
+                or set(souts) != set(new_list)):
+            continue
+        cell_new = new_list[pre_list.index(c_pre)]
+        hidden_new = new_list[pre_list.index(h_pre)]
+        hid_out = outs[souts.index(hidden_new)]
+        cell_out = outs[souts.index(cell_new)]
         vals = {n: scope.get_value(n) for n in
                 (aw_m_name, ab_name, aw_d_name, w_x_name, w_h_name,
                  b_name)}
@@ -715,7 +755,6 @@ def attention_lstm_fuse_pass(program, scope=None):
                            dtype=np.float32, persistable=True)
             scope.set_value(nm, val)
             names[suffix] = nm
-        hid_out, cell_out = a["out_names"][0], a["out_names"][1]
         for on in (hid_out, cell_out):
             v = blk.var(on)
             if getattr(v, "lod_level", 0):
@@ -736,12 +775,13 @@ def attention_lstm_fuse_pass(program, scope=None):
                    "cell_activation": "tanh",
                    "candidate_activation": "tanh"})
         dead = [rec, p_rshp, p_add, p_mul]
-        # boot fills now feed nothing
         for bn in a.get("boot_names", []):
-            bp = _producer(blk.ops, bn)
-            if bp is not None and bp.type == "fill_constant_batch_size_like":
+            bp = g.var_producer(bn)
+            if (bp is not None
+                    and bp.type == "fill_constant_batch_size_like"
+                    and [o for o in g.var_consumers(bn)] == []):
                 dead.append(bp)
-        IrGraph(program).remove_ops(dead)
+        g.remove_ops(dead)
     program._bump()
     return program
 

@@ -115,6 +115,10 @@ class Predictor:
 
             apply_pass(prog, ["delete_dropout_pass",
                               "multihead_matmul_fuse_pass",
+                              # add2 (bias+residual) BEFORE the
+                              # single-add form so the longer chain
+                              # claims its ops first
+                              "conv_elementwise_add2_act_fuse_pass",
                               "conv_elementwise_add_act_fuse_pass",
                               "fc_gru_fuse_pass", "fc_lstm_fuse_pass",
                               "embedding_eltwise_layernorm_fuse_pass",
@@ -123,6 +127,7 @@ class Predictor:
                               "fc_elementwise_layernorm_fuse_pass",
                               "skip_layernorm_fuse_pass",
                               "seqconv_eltadd_relu_fuse_pass",
+                              "seqpool_concat_fuse_pass",
                               "repeated_fc_relu_fuse_pass",
                               "squared_mat_sub_fuse_pass",
                               "transpose_flatten_concat_fuse_pass"])
@@ -130,7 +135,8 @@ class Predictor:
                 # weight-mutating folds (need the loaded params)
                 apply_pass(prog, ["conv_eltwiseadd_bn_fuse_pass",
                                   "conv_bn_fuse_pass",
-                                  "conv_transpose_bn_fuse_pass"],
+                                  "conv_transpose_bn_fuse_pass",
+                                  "attention_lstm_fuse_pass"],
                            scope=_fx.global_scope())
             except Exception:
                 pass  # missing weights (program_only artifacts)
