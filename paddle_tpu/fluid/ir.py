@@ -70,6 +70,9 @@ class IrGraph:
                 return op
         return None
 
+    def var_writers(self, name):
+        return [op for op in self.ops if name in op.output_arg_names]
+
     def find_chains(self, type_a, type_b):
         """(a, b) pairs where b consumes a's first output and is its ONLY
         consumer (GraphPatternDetector two-op chain)."""
@@ -538,7 +541,7 @@ def seqpool_concat_fuse_pass(program, scope=None):
         g = IrGraph(program)
         pools = []
         for name in cat.input("X"):
-            writers = [o for o in g.ops if name in o.output_arg_names]
+            writers = g.var_writers(name)
             prod = writers[0] if len(writers) == 1 else None
             if (prod is not None and prod.type == "sequence_pool"
                     and str(prod.attrs.get("pooltype",
@@ -677,7 +680,7 @@ def attention_lstm_fuse_pass(program, scope=None):
         g = IrGraph(program)
 
         def _sole_chain_producer(name, want_type, consumer=None):
-            writers = [o for o in g.ops if name in o.output_arg_names]
+            writers = g.var_writers(name)
             if len(writers) != 1 or writers[0].type != want_type:
                 return None
             cons = g.var_consumers(name)
@@ -777,9 +780,11 @@ def attention_lstm_fuse_pass(program, scope=None):
         dead = [rec, p_rshp, p_add, p_mul]
         for bn in a.get("boot_names", []):
             bp = g.var_producer(bn)
+            # rec still sits in the block here — a boot fill is dead
+            # when the recurrence being removed was its only consumer
             if (bp is not None
                     and bp.type == "fill_constant_batch_size_like"
-                    and [o for o in g.var_consumers(bn)] == []):
+                    and all(c is rec for c in g.var_consumers(bn))):
                 dead.append(bp)
         g.remove_ops(dead)
     program._bump()
