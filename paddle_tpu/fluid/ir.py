@@ -564,6 +564,86 @@ def seqpool_concat_fuse_pass(program, scope=None):
     return program
 
 
+@register_pass("identity_scale_op_clean_pass")
+def identity_scale_op_clean_pass(program, scope=None):
+    """Remove scale ops that are numerically the identity (scale=1,
+    bias=0) by rewiring their consumers to the input
+    (ir/identity_scale_op_clean_pass.cc)."""
+    g = IrGraph(program)
+    dead = []
+    for op in g.ops:
+        if op.type != "scale":
+            continue
+        if (float(op.attrs.get("scale", 1.0)) != 1.0
+                or float(op.attrs.get("bias", 0.0)) != 0.0):
+            continue
+        x_name, out_name = op.input("X")[0], op.output("Out")[0]
+        producers = g.var_writers(x_name)
+        if (len(producers) == 1
+                and g.var_consumers(x_name) == [op]):
+            # preserve the OUTPUT name (reference models fetch the
+            # trailing save_infer_model/scale_0 vars): the producer
+            # writes straight to it
+            prod = producers[0]
+            for slot, names in prod.outputs.items():
+                prod.outputs[slot] = [out_name if n == x_name else n
+                                      for n in names]
+            dead.append(op)
+        elif g.var_consumers(out_name):
+            # intermediate identity: rewire consumers to X. A
+            # consumer-less output is (un-detectably) a fetch target —
+            # keep the op rather than orphan the fetch
+            _rewire(program, out_name, x_name)
+            dead.append(op)
+    g.remove_ops(dead)
+    program._bump()
+    return program
+
+
+@register_pass("conv_affine_channel_fuse_pass")
+def conv_affine_channel_fuse_pass(program, scope=None):
+    """conv2d + affine_channel -> conv2d with scale FOLDED into the
+    filter + a channel bias add (ir/conv_affine_channel_fuse_pass.cc):
+    w' = w * scale[c], bias' = bias. Mutates the scope weights."""
+    if scope is None:
+        raise ValueError("conv_affine_channel_fuse_pass needs the scope "
+                         "holding the conv/affine weights")
+    g = IrGraph(program)
+    plan = []
+    for conv, ac in g.find_chains("conv2d", "affine_channel"):
+        w_name = conv.input("Filter")[0]
+        if len(g.var_consumers(w_name)) != 1:
+            continue  # shared filter: folding would corrupt the others
+        vals = [scope.get_value(w_name),
+                scope.get_value(ac.input("Scale")[0]),
+                scope.get_value(ac.input("Bias")[0])]
+        if any(v is None for v in vals):
+            continue
+        plan.append((conv, ac, w_name, vals))
+    dead = []
+    blk = program.global_block()
+    for conv, ac, w_name, vals in plan:
+        w, scale, bias = (np.asarray(v, np.float32) for v in vals)
+        scope.set_value(w_name, w * scale[:, None, None, None])
+        bias_name = w_name + "@ac_folded_bias"
+        scope.set_value(bias_name, bias)
+        blk.create_var(name=bias_name, shape=[int(w.shape[0])],
+                       dtype=np.float32, persistable=True)
+        conv_out = conv.output("Output")[0]
+        tmp = conv_out + "@prefold_ac"
+        blk.create_var(name=tmp)
+        conv.outputs["Output"] = [tmp]
+        idx = blk.ops.index(ac)
+        blk._insert_op(idx, "elementwise_add",
+                       inputs={"X": [tmp], "Y": [bias_name]},
+                       outputs={"Out": [ac.output("Out")[0]]},
+                       attrs={"axis": 1})
+        dead.append(ac)
+    g.remove_ops(dead)
+    program._bump()
+    return program
+
+
 @register_pass("attention_lstm_fuse_pass")
 def attention_lstm_fuse_pass(program, scope=None):
     """DynamicRNN-form per-step attention LSTM (the shape

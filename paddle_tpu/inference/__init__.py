@@ -114,6 +114,7 @@ class Predictor:
             from ..fluid.ir import apply_pass
 
             apply_pass(prog, ["delete_dropout_pass",
+                              "identity_scale_op_clean_pass",
                               "multihead_matmul_fuse_pass",
                               # add2 (bias+residual) BEFORE the
                               # single-add form so the longer chain
@@ -136,6 +137,7 @@ class Predictor:
                 apply_pass(prog, ["conv_eltwiseadd_bn_fuse_pass",
                                   "conv_bn_fuse_pass",
                                   "conv_transpose_bn_fuse_pass",
+                                  "conv_affine_channel_fuse_pass",
                                   "attention_lstm_fuse_pass"],
                            scope=_fx.global_scope())
             except Exception:

@@ -564,3 +564,61 @@ def test_attention_lstm_fuse():
                                want_h, rtol=2e-5, atol=2e-6)
     np.testing.assert_allclose(np.asarray(got_c).reshape(B, T, D),
                                want_c, rtol=2e-5, atol=2e-6)
+
+
+def test_identity_scale_clean():
+    """scale(scale=1, bias=0) is removed and consumers rewired; a real
+    scale survives."""
+    main, startup, exe = _exe_prog()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        blk = main.global_block()
+        x = fluid.layers.data("x", [4])
+        s1 = blk.create_var(name="ident")
+        _append(blk, "scale", {"X": [x]}, {"Out": [s1.name]},
+                {"scale": 1.0, "bias": 0.0})
+        s2 = blk.create_var(name="real")
+        _append(blk, "scale", {"X": [s1]}, {"Out": [s2.name]},
+                {"scale": 2.0, "bias": 0.5})
+    exe.run(startup)
+    xv = np.random.RandomState(1).randn(3, 4).astype("f4")
+    want = exe.run(main, {"x": xv}, [s2])[0]
+    apply_pass(main, "identity_scale_op_clean_pass")
+    types = [o.type for o in main.global_block().ops]
+    assert types.count("scale") == 1, types
+    got = exe.run(main, {"x": xv}, [s2])[0]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_conv_affine_channel_fuse():
+    """conv + affine_channel folds into the filter + a channel bias;
+    numerics identical."""
+    main, startup, exe = _exe_prog()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        blk = main.global_block()
+        x = fluid.layers.data("x", [3, 8, 8])
+        w = fluid.layers.create_parameter([5, 3, 3, 3], "float32",
+                                          name="acw")
+        sc = fluid.layers.create_parameter([5], "float32", name="acs")
+        bi = fluid.layers.create_parameter([5], "float32", name="acb")
+        co = blk.create_var(name="acout")
+        _append(blk, "conv2d", {"Input": [x], "Filter": [w]},
+                {"Output": [co.name]},
+                {"strides": [1, 1], "paddings": [1, 1],
+                 "dilations": [1, 1], "groups": 1})
+        y = blk.create_var(name="acy")
+        _append(blk, "affine_channel",
+                {"X": [co], "Scale": [sc], "Bias": [bi]},
+                {"Out": [y.name]})
+    scope = fluid.Scope()
+    rs = np.random.RandomState(8)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        scope.set_value("acs", (1.0 + 0.2 * rs.randn(5)).astype("f4"))
+        scope.set_value("acb", (0.3 * rs.randn(5)).astype("f4"))
+        xv = rs.randn(2, 3, 8, 8).astype("f4")
+        want = exe.run(main, {"x": xv}, [y])[0]
+        apply_pass(main, "conv_affine_channel_fuse_pass", scope=scope)
+        types = [o.type for o in main.global_block().ops]
+        assert "affine_channel" not in types, types
+        got = exe.run(main, {"x": xv}, [y])[0]
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
