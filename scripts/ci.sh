@@ -14,6 +14,9 @@ rc=0
 echo "== [1/3] pytest =="
 python -m pytest tests/ -q -x "$@" || rc=1
 
+echo "== [1b] README bench-claim hygiene =="
+python tools/check_readme_bench.py || rc=1
+
 echo "== [2/3] op micro-bench (quick, vs baseline) =="
 if python tools/op_bench.py --cpu --quick --compare; then
   echo "op-bench: no >2x regressions"

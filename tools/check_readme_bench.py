@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Claim-hygiene gate: README bench headlines must match BENCH_DETAILS.json.
+
+Round-3 and round-4 reviews both caught README/commit headlines quoting
+numbers above the committed artifact of record (MNIST in r03, CTR in r04).
+This check makes that impossible to repeat silently: every throughput row
+in README's bench table is parsed and compared against the corresponding
+BENCH_DETAILS.json median; any README claim more than TOLERANCE above the
+artifact fails CI.
+
+Claims may be *below* the artifact by any amount (sandbagging is honest),
+and may exceed it by at most TOLERANCE (rounding, e.g. "~2700" for 2708).
+"""
+
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOLERANCE = 0.02  # README may exceed the artifact by at most 2% (rounding)
+
+# (row-identifying regex, claim-extracting regex on the throughput cell,
+#  BENCH_DETAILS path, human name). The claim regex must yield a float in
+#  the artifact's units after the named multiplier is applied.
+CHECKS = [
+    (r"ERNIE-base fine-tune", r"~?([\d.]+)(k?)\s*seq/s", ("ernie", "value"), "ernie seq/s"),
+    (r"ResNet-50 train", r"~?([\d.]+)(k?)\s*imgs/s", ("resnet50", "value"), "resnet50 imgs/s"),
+    (r"fluid static MNIST", r"~?([\d.]+)(M?)\s*imgs/s", ("mnist", "value"), "mnist imgs/s"),
+    (r"CTR-DNN", r"~?([\d.]+)(k?)\s*ex/s", ("ctr_ps", "value"), "ctr ex/s"),
+    (r"ERNIE long-context", r"~?([\d.]+)()\s*seq/s", ("ernie_long", "value"), "ernie_long seq/s"),
+]
+
+MULT = {"": 1.0, "k": 1e3, "M": 1e6}
+
+
+def main():
+    readme = open(os.path.join(ROOT, "README.md")).read()
+    details = json.load(open(os.path.join(ROOT, "BENCH_DETAILS.json")))
+
+    failures = []
+    checked = 0
+    for row_re, claim_re, path, name in CHECKS:
+        rows = [ln for ln in readme.splitlines() if ln.startswith("|") and re.search(row_re, ln)]
+        if not rows:
+            failures.append(f"{name}: README row matching /{row_re}/ not found")
+            continue
+        cells = [c.strip() for c in rows[0].strip().strip("|").split("|")]
+        if len(cells) < 2:
+            failures.append(f"{name}: bench row has no throughput column: {rows[0][:90]}")
+            continue
+        m = re.search(claim_re, cells[1])  # column 2 = Throughput
+        if not m:
+            failures.append(f"{name}: no claim matching /{claim_re}/ in throughput cell: {cells[1][:90]}")
+            continue
+        claimed = float(m.group(1)) * MULT[m.group(2)]
+        try:
+            node = details
+            for k in path:
+                node = node[k]
+            artifact = float(node)
+        except (KeyError, TypeError, ValueError) as e:
+            failures.append(f"{name}: BENCH_DETAILS path {path} unreadable: {e!r}")
+            continue
+        checked += 1
+        if claimed > artifact * (1.0 + TOLERANCE):
+            failures.append(
+                f"{name}: README claims {claimed:g} but BENCH_DETAILS says {artifact:g} "
+                f"(over by {claimed / artifact - 1:.1%}, tolerance {TOLERANCE:.0%})"
+            )
+        else:
+            print(f"ok: {name}: README {claimed:g} <= artifact {artifact:g} (+{TOLERANCE:.0%})")
+
+    if failures:
+        print("README bench-claim check FAILED:", file=sys.stderr)
+        for f in failures:
+            print("  - " + f, file=sys.stderr)
+        return 1
+    print(f"README bench claims consistent with BENCH_DETAILS.json ({checked} rows checked)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
