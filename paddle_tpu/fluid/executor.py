@@ -20,6 +20,22 @@ from . import lowering
 from .framework import Parameter, Program, default_main_program
 
 
+def _finalize_flash_probe(program):
+    """fused_sdpa/multihead_matmul lowerings consult the flash-attention
+    probe at TRACE time, where it can only compile-check the kernel
+    (provisional verdict). Consulting here — eagerly, before the jit
+    trace — also EXECUTES the tiny probe and rejects a kernel that
+    compiles but emits non-finite values, so a broken Mosaic path can
+    never be baked into a compiled program (advisor r4; same hook as
+    SpmdTrainer.__init__)."""
+    if any(op.type in ("fused_sdpa", "multihead_matmul")
+           for blk in program.blocks for op in blk.ops):
+        from ..ops import attention as A
+
+        if A._on_tpu():
+            A._flash_usable()
+
+
 class _ScopeVar:
     def __init__(self, scope, name):
         self._scope = scope
@@ -310,6 +326,7 @@ class Executor:
         import jax
         import jax.lax as lax
 
+        _finalize_flash_probe(program)
         blk = program.global_block()
         ops = list(blk.ops)
 
@@ -353,6 +370,7 @@ class Executor:
     def _compile(self, program, feed_names, persist_names, fetch_names):
         import jax
 
+        _finalize_flash_probe(program)
         blk = program.global_block()
         ops = list(blk.ops)
 

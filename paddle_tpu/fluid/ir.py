@@ -102,6 +102,24 @@ def _rewire(program, old_name, new_name):
                                    for n in names]
 
 
+def _sub_block_readers(program, name, exclude=()):
+    """Ops in NON-global blocks that read `name`. Sub-block ops
+    (recurrent/while bodies) read parent-block vars by name without the
+    parent op declaring them as inputs, so IrGraph's global-block
+    consumer scan alone under-counts readers; passes that rename or
+    delete a var's producer must also clear this. `exclude` = block
+    indices whose reads don't count (e.g. a matched recurrence's own
+    body that is itself being removed)."""
+    readers = []
+    for idx, blk in enumerate(program.blocks):
+        if idx == 0 or idx in exclude:
+            continue
+        for op in blk.ops:
+            if name in op.input_arg_names:
+                readers.append(op)
+    return readers
+
+
 @register_pass("delete_dropout_pass")
 def delete_dropout_pass(program, scope=None):
     """Inference cleanup (delete_dropout_op_pass): upscale_in_train
@@ -580,7 +598,8 @@ def identity_scale_op_clean_pass(program, scope=None):
         x_name, out_name = op.input("X")[0], op.output("Out")[0]
         producers = g.var_writers(x_name)
         if (len(producers) == 1
-                and g.var_consumers(x_name) == [op]):
+                and g.var_consumers(x_name) == [op]
+                and not _sub_block_readers(program, x_name)):
             # preserve the OUTPUT name (reference models fetch the
             # trailing save_infer_model/scale_0 vars): the producer
             # writes straight to it
@@ -763,6 +782,12 @@ def attention_lstm_fuse_pass(program, scope=None):
             writers = g.var_writers(name)
             if len(writers) != 1 or writers[0].type != want_type:
                 return None
+            # a SECOND control-flow body reading the var would be
+            # starved by the chain removal (the matched recurrence's
+            # own sub-block is removed with it, so its reads are fine)
+            if _sub_block_readers(program, name,
+                                  exclude=(a["sub_block"],)):
+                return None
             cons = g.var_consumers(name)
             if consumer is None:
                 # atted itself: consumed only inside the sub-block, so
@@ -864,7 +889,9 @@ def attention_lstm_fuse_pass(program, scope=None):
             # when the recurrence being removed was its only consumer
             if (bp is not None
                     and bp.type == "fill_constant_batch_size_like"
-                    and all(c is rec for c in g.var_consumers(bn))):
+                    and all(c is rec for c in g.var_consumers(bn))
+                    and not _sub_block_readers(program, bn,
+                                               exclude=(a["sub_block"],))):
                 dead.append(bp)
         g.remove_ops(dead)
     program._bump()

@@ -502,22 +502,44 @@ _FLASH_PROBED = {}
 
 def _flash_usable():
     """One-time probe: AOT-lower + compile a tiny fwd+bwd on the real
-    backend; if anything in the pallas/Mosaic path breaks on this
-    chip/runtime, fall back to the XLA reference permanently (never
-    crash a training run). AOT (lower().compile()) rather than an
-    execution probe on purpose: the first consult usually happens at
-    TRACE time inside a jitted train step (SpmdTrainer), where running
-    a fresh custom_vjp eagerly leaks the ambient trace
-    (ConcretizationTypeError) and would cache a spurious False —
-    compilation is trace-state-independent and is exactly the failure
-    mode the probe guards (remote Mosaic helper rejections). Numeric
+    backend, and — whenever the consult happens OUTSIDE an ambient
+    trace — also execute it once and require finite outputs; if
+    anything in the pallas/Mosaic path breaks on this chip/runtime,
+    fall back to the XLA reference permanently (never crash or poison
+    a training run). In-trace consults (SpmdTrainer traces the first
+    step) stay compile-only: running a fresh custom_vjp eagerly there
+    leaks the ambient trace (ConcretizationTypeError) and would cache
+    a spurious False. A compile-only True is provisional — the next
+    clean-state consult upgrades it to an executed probe. Numeric
     parity is covered by tests/test_flash_attention.py."""
     flag = os.environ.get("PT_FLASH_ATTENTION", "auto")
     if flag == "0":
         return False
-    key = "probe"
-    if key in _FLASH_PROBED:
-        return _FLASH_PROBED[key]
+    cached = _FLASH_PROBED.get("probe")
+    if cached is False:
+        return False
+    if cached is True and _FLASH_PROBED.get("executed"):
+        return True  # final verdict: plain dict hit on the hot path
+    try:
+        from jax._src import core as _jax_core
+
+        clean = _jax_core.trace_state_clean()
+    except Exception:
+        clean = False
+        if not _FLASH_PROBED.get("warned_no_trace_state"):
+            _FLASH_PROBED["warned_no_trace_state"] = True
+            import warnings
+
+            warnings.warn(
+                "jax trace-state introspection unavailable "
+                "(jax._src.core.trace_state_clean); the flash-attention "
+                "probe stays compile-only — no run-time finiteness check",
+                RuntimeWarning, stacklevel=2)
+    if cached is True and not clean:
+        # an executed probe is final; a compile-only probe (taken
+        # in-trace) is re-consulted once trace state is clean so the
+        # run-time finiteness check still happens eventually
+        return True
     ok = False
     try:
         import jax
@@ -528,12 +550,21 @@ def _flash_usable():
         def loss(q, k, v):
             return flash_attention(q, k, v, None, True, None).sum()
 
-        jax.jit(jax.value_and_grad(loss, (0, 1, 2))).lower(
+        compiled = jax.jit(jax.value_and_grad(loss, (0, 1, 2))).lower(
             q, q, q).compile()
         ok = True
+        if clean:
+            # eager context: also RUN the compiled probe once and
+            # require finite outputs — a Mosaic path that compiles but
+            # mis-executes must not poison a training run
+            x = jnp.full((1, 1, 256, 64), 0.5, jnp.float32)
+            val, grads = compiled(x, x, x)
+            ok = all(bool(jnp.isfinite(t).all())
+                     for t in (val, *grads))
+            _FLASH_PROBED["executed"] = True
     except Exception:
         ok = False
-    _FLASH_PROBED[key] = ok
+    _FLASH_PROBED["probe"] = ok
     return ok
 
 

@@ -49,6 +49,17 @@ class SpmdTrainer:
         self._step_fn = None
         self._eval_fn = None
 
+        # finalize the flash-attention probe EAGERLY, before any trace:
+        # the first in-trace consult can only compile-check the kernel
+        # (provisional verdict); consulting here, in a clean trace
+        # state, also EXECUTES the tiny probe and rejects a kernel that
+        # compiles but emits non-finite values — otherwise that verdict
+        # would be baked into the compiled train step (advisor r4)
+        from paddle_tpu.ops import attention as _attn
+
+        if _attn._on_tpu():
+            _attn._flash_usable()
+
         params = self.fm.params()
         buffers = self.fm.buffers()
         self.param_specs = infer_param_specs(params, rules)

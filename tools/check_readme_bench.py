@@ -29,6 +29,7 @@ CHECKS = [
     (r"fluid static MNIST", r"~?([\d.]+)(M?)\s*imgs/s", ("mnist", "value"), "mnist imgs/s"),
     (r"CTR-DNN", r"~?([\d.]+)(k?)\s*ex/s", ("ctr_ps", "value"), "ctr ex/s"),
     (r"ERNIE long-context", r"~?([\d.]+)()\s*seq/s", ("ernie_long", "value"), "ernie_long seq/s"),
+    (r"Long-context flash attention", r"~?([\d.]+)()x XLA", ("long_context", "value"), "flash x-vs-XLA"),
 ]
 
 MULT = {"": 1.0, "k": 1e3, "M": 1e6}
