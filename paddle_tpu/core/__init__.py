@@ -1,2 +1,13 @@
 from . import autograd, dtypes, place, random  # noqa: F401
 from .tensor import Tensor, to_tensor  # noqa: F401
+
+
+def __getattr__(name):
+    # fluid.core.EOFException is the reference spelling user code
+    # catches around py_reader loops; defined in fluid.reader (lazy:
+    # core must not import fluid at package-init time)
+    if name == "EOFException":
+        from ..fluid.reader import EOFException
+
+        return EOFException
+    raise AttributeError(name)

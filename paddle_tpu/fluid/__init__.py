@@ -14,6 +14,7 @@ from .framework import (Program, Variable, default_main_program,  # noqa
                         program_guard, unique_name)
 from .layer_helper import LayerHelper, ParamAttr  # noqa: F401
 from .layers.tensor import data  # noqa: F401
+from .reader import EOFException, PyReader  # noqa: F401
 from ..regularizer import L1Decay, L2Decay  # noqa: F401
 from ..utils.flags import get_flags, set_flags  # noqa: F401
 

@@ -130,6 +130,16 @@ class Executor:
         fetch_list = fetch_list or []
         scope = scope or _global_scope
 
+        # non-iterable GeneratorLoader/PyReader pull (reader.py:1150
+        # start/reset protocol): a STARTED loader bound to this program
+        # supplies the feed vars the caller did not; exhaustion raises
+        # EOFException for the reference catch-and-reset loop
+        for loader in getattr(program, "_py_readers", ()):
+            if loader._started:
+                pulled = loader._next_feed()
+                for k, v in pulled.items():
+                    feed.setdefault(k, v)
+
         fetch_names = [f.name if hasattr(f, "name") else f
                        for f in fetch_list]
 

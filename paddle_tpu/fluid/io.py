@@ -14,6 +14,23 @@ import numpy as np
 
 from .executor import global_scope
 from .framework import Parameter, Program, default_main_program
+from .reader import EOFException, GeneratorLoader, PyReader  # noqa: F401
+
+
+class DataLoader:
+    """fluid.io.DataLoader namespace (reader.py:392): the static-graph
+    entry is the `from_generator` factory; the dygraph dataset loader
+    lives at paddle_tpu.io.DataLoader."""
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity=None,
+                       use_double_buffer=True, iterable=True,
+                       return_list=False, use_multiprocess=False,
+                       drop_last=True):
+        return GeneratorLoader(feed_list=feed_list, capacity=capacity,
+                               use_double_buffer=use_double_buffer,
+                               iterable=iterable, return_list=return_list,
+                               drop_last=drop_last)
 
 
 def _collect_persistables(program, scope, predicate=None):

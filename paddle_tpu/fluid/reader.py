@@ -1,0 +1,246 @@
+"""Static-graph data feeding front door: ``DataLoader.from_generator``
+and ``PyReader``.
+
+Reference parity: python/paddle/fluid/reader.py:409 (from_generator),
+:993 (GeneratorLoader), :1253 (PyReader), with the double-buffer
+host->device prefetch of operators/reader/buffered_reader.cc:1.
+
+TPU-native design: the reference pushes LoDTensors through a C++
+BlockingQueue into program-embedded ``read`` ops; here the loader is a
+host-side prefetch pipeline that yields ordinary feed dicts (the
+whole-block-jit Executor has no per-op reader machinery to hook — feeds
+ARE the program boundary). ``use_double_buffer`` starts the transfers
+early: batches are staged onto the device with ``jax.device_put`` from
+the prefetch thread, so the H2D copy of batch k+1 rides under the
+compute of batch k (the buffered_reader role). The non-iterable mode
+binds the loader to the feed vars' program; ``Executor.run`` pulls a
+batch per call and raises ``EOFException`` at exhaustion — the
+reference's ``fluid.core.EOFException`` catch-loop pattern works
+unchanged.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import threading
+
+import numpy as np
+
+from ..core.lod import LoDTensor
+
+
+class EOFException(Exception):
+    """Raised by Executor.run when a bound non-iterable loader is
+    exhausted (reference: fluid.core.EOFException from the read op)."""
+
+
+def _var_name(v):
+    return v if isinstance(v, str) else v.name
+
+
+class GeneratorLoader:
+    """fluid/reader.py:993 parity. Create via
+    ``fluid.io.DataLoader.from_generator(...)``."""
+
+    def __init__(self, feed_list=None, capacity=None,
+                 use_double_buffer=True, iterable=True, return_list=False,
+                 drop_last=True):
+        if not feed_list:
+            raise ValueError("from_generator needs feed_list (the "
+                             "fluid.layers.data vars to feed)")
+        self._feed_list = list(feed_list)
+        self._capacity = int(capacity or 64)
+        self._use_double_buffer = use_double_buffer
+        self._iterable = iterable
+        self._return_list = return_list
+        self._drop_last = drop_last
+        self._tensor_reader = None
+        self._places = None
+        # non-iterable state
+        self._started = False
+        self._it = None
+        if not iterable:
+            prog = getattr(self._feed_list[0], "block", None)
+            prog = prog.program if prog is not None else None
+            self._program = prog
+            if prog is not None:
+                if not hasattr(prog, "_py_readers"):
+                    prog._py_readers = []
+                prog._py_readers.append(self)
+
+    # -- data sources ---------------------------------------------------
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        """reader() yields ONE sample per next() — a tuple/list with one
+        array per feed var. Batched here; lod_level>0 vars collate into
+        LoDTensors (ragged rows)."""
+        if batch_size <= 0:
+            raise ValueError("batch_size must be larger than 0")
+
+        def batched():
+            it = iter(reader())
+            while True:
+                chunk = list(itertools.islice(it, batch_size))
+                if not chunk:
+                    return
+                if len(chunk) < batch_size and drop_last:
+                    return
+                yield chunk
+        self._set_list_source(batched)
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        """reader() yields a LIST of samples per next() (paddle.batch
+        output form)."""
+        self._set_list_source(lambda: iter(reader()))
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        """reader() yields ready feed tuples: one array/LoDTensor per
+        feed var, already batched."""
+        self._tensor_reader = reader
+        return self
+
+    def _set_list_source(self, make_iter):
+        feed_vars = self._feed_list
+
+        def tensor_reader():
+            for samples in make_iter():
+                batch = []
+                for i, var in enumerate(feed_vars):
+                    cols = [np.asarray(s[i]) for s in samples]
+                    if getattr(var, "lod_level", 0):
+                        batch.append(LoDTensor.from_sequences(cols))
+                    else:
+                        batch.append(np.stack(cols))
+                yield tuple(batch)
+        self._tensor_reader = tensor_reader
+
+    # -- iterable mode --------------------------------------------------
+    def _feed_dicts(self):
+        names = [_var_name(v) for v in self._feed_list]
+        dtypes = [getattr(v, "dtype", None) for v in self._feed_list]
+        stage = _device_stage if self._use_double_buffer else \
+            (lambda x: x)
+        for tensors in self._tensor_reader():
+            if len(tensors) != len(names):
+                raise ValueError(
+                    f"reader yielded {len(tensors)} tensors for "
+                    f"{len(names)} feed vars {names}")
+            out = {}
+            for n, dt, t in zip(names, dtypes, tensors):
+                if isinstance(t, LoDTensor):
+                    out[n] = t            # executor pads at the edge
+                else:
+                    a = np.asarray(t)
+                    if dt is not None and a.dtype != np.dtype(dt):
+                        a = a.astype(dt)
+                    out[n] = stage(a)
+            yield out
+
+    def __iter__(self):
+        if not self._iterable:
+            raise RuntimeError("DataLoader is not iterable; use "
+                               "start()/reset() with Executor.run")
+        if self._tensor_reader is None:
+            raise RuntimeError("data source not set: call "
+                               "set_batch_generator / "
+                               "set_sample_list_generator / "
+                               "set_sample_generator first")
+        from ..io.dataloader_iter import ThreadPrefetcher
+
+        src = ThreadPrefetcher(self._feed_dicts(), depth=self._capacity)
+        if self._return_list:
+            names = [_var_name(v) for v in self._feed_list]
+            return iter([d[n] for n in names] for d in src)
+        return iter(src)
+
+    def __call__(self):
+        return self.__iter__()
+
+    # -- non-iterable mode (start/reset + Executor pull) ---------------
+    def start(self):
+        if self._iterable:
+            raise RuntimeError("start() cannot be called when DataLoader"
+                               " is iterable")
+        if self._tensor_reader is None:
+            raise RuntimeError("data source not set")
+        from ..io.dataloader_iter import ThreadPrefetcher
+
+        self._it = iter(ThreadPrefetcher(self._feed_dicts(),
+                                         depth=self._capacity))
+        self._started = True
+
+    def reset(self):
+        if self._iterable:
+            raise RuntimeError("reset() cannot be called when DataLoader"
+                               " is iterable")
+        self._it = None
+        self._started = False
+
+    def _next_feed(self):
+        """Executor pull: one feed dict, or EOFException at the end (the
+        loader auto-resets so the reference catch-and-reset loop can
+        call start() again)."""
+        if not self._started or self._it is None:
+            raise RuntimeError("loader not started: call start() before "
+                               "Executor.run, and reset() after "
+                               "EOFException")
+        try:
+            return next(self._it)
+        except StopIteration:
+            self._started = False
+            self._it = None
+            raise EOFException("py_reader data source exhausted") \
+                from None
+
+
+def _device_stage(a):
+    """Async H2D: issue the transfer NOW from the prefetch thread so it
+    overlaps the current step's compute (buffered_reader.cc role).
+    Falls back to the host array when no device is reachable."""
+    try:
+        import jax
+
+        return jax.device_put(a)
+    except Exception:
+        return a
+
+
+class PyReader:
+    """fluid/reader.py:1253 parity: the decorate_* spelling of the same
+    machinery. iterable=True yields feed dicts; iterable=False drives
+    Executor.run via start()/reset() + EOFException."""
+
+    def __init__(self, feed_list=None, capacity=None,
+                 use_double_buffer=True, iterable=True, return_list=False):
+        self._loader = GeneratorLoader(
+            feed_list=feed_list, capacity=capacity,
+            use_double_buffer=use_double_buffer, iterable=iterable,
+            return_list=return_list)
+
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        self._loader.set_sample_generator(sample_generator, batch_size,
+                                          drop_last, places)
+        return self
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        self._loader.set_sample_list_generator(reader, places)
+        return self
+
+    def decorate_batch_generator(self, reader, places=None):
+        self._loader.set_batch_generator(reader, places)
+        return self
+
+    def start(self):
+        self._loader.start()
+
+    def reset(self):
+        self._loader.reset()
+
+    def __iter__(self):
+        return iter(self._loader)
+
+    def __call__(self):
+        return self.__iter__()

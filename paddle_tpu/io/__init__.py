@@ -275,6 +275,14 @@ class DataLoader:
             raise TypeError("IterableDataset has no len()")
         return len(self.batch_sampler)
 
+    @staticmethod
+    def from_generator(*args, **kwargs):
+        """Static-graph feeding front door (fluid/reader.py:409);
+        delegates to the single factory in fluid.io.DataLoader."""
+        from ..fluid.io import DataLoader as _FluidDataLoader
+
+        return _FluidDataLoader.from_generator(*args, **kwargs)
+
     def _batches(self):
         if self._iterable_mode:
             it = iter(self.dataset)

@@ -16,6 +16,7 @@ from .layer.common import (  # noqa: F401
     Sequential, Sigmoid, Silu, SmoothL1Loss, Softmax, Softplus, Softshrink,
     Softsign, Swish, SyncBatchNorm, Tanh, Tanhshrink, Unfold, Upsample,
     UpsamplingBilinear2D, UpsamplingNearest2D)
+from .layer.moe import MoELayer  # noqa: F401
 from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder,
     TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer)

@@ -1,0 +1,136 @@
+"""Mixture-of-Experts FFN with top-1 (switch) routing and
+capacity-bounded dispatch/combine over the `ep` mesh axis.
+
+Reference role: the reference framework predates MoE support (its
+distributed stack is PS/collective-only); this is a beyond-parity
+capability required by the `ep` axis the SPMD engine advertises.
+TPU-native design: dispatch/combine are dense one-hot einsums over a
+STATIC [tokens, experts, capacity] tensor (Mesh-TensorFlow / Switch
+Transformer formulation) — no dynamic shapes, no scatter; expert
+weights are stacked [E, ...] so `parallel.sharding` rules
+(`experts.weight_in/out` -> ("ep", ...)) shard the expert axis and XLA
+inserts the all-to-alls implied by the einsum contractions.
+"""
+from __future__ import annotations
+
+from .. import functional as F
+from .layers import Layer
+
+
+class _Experts(Layer):
+    """Parameter container whose PATH gives the `experts.weight_in/out`
+    names the sharding rules key on (parallel/sharding.py:59)."""
+
+    def __init__(self, num_experts, d_model, d_ff):
+        super().__init__()
+        import paddle_tpu.nn.initializer as I
+
+        self.weight_in = self.create_parameter(
+            [num_experts, d_model, d_ff],
+            default_initializer=I.XavierUniform())
+        self.weight_out = self.create_parameter(
+            [num_experts, d_ff, d_model],
+            default_initializer=I.XavierUniform())
+
+
+class MoELayer(Layer):
+    """Top-1 routed FFN: y[t] = gate[t] * W_out[e(t)] @ act(W_in[e(t)] x[t]).
+
+    Tokens beyond an expert's capacity (capacity_factor * tokens /
+    num_experts) are dropped (contribute zero — the residual connection
+    around the layer carries them), matching Switch Transformer
+    semantics. The router's load-balancing auxiliary loss is stored on
+    `self.aux_loss` each forward; trainers add `moe_aux_weight *
+    sum(aux losses)` to the objective.
+    """
+
+    def __init__(self, d_model, d_ff, num_experts=2, capacity_factor=1.25,
+                 activation="gelu", name=None):
+        super().__init__()
+        import paddle_tpu.nn.initializer as I
+
+        self.num_experts = int(num_experts)
+        self.capacity_factor = float(capacity_factor)
+        self.act = activation
+        self.router = self.create_parameter(
+            [d_model, self.num_experts],
+            default_initializer=I.XavierUniform())
+        # stacked expert weights: leading E axis is the `ep` shard axis
+        # (parallel/sharding.py rules match the experts.* path)
+        self.experts = _Experts(self.num_experts, d_model, d_ff)
+        # the load-balance aux loss rides a (non-persistable) BUFFER:
+        # FunctionalModule threads buffer mutations through apply()'s
+        # RETURN value, which survives jit and jax.checkpoint — a side
+        # list would leak tracers out of the remat trace. SpmdTrainer
+        # picks every `aux_loss_val` buffer out of new_buffers and adds
+        # moe_aux_weight * sum to the objective.
+        import numpy as np
+
+        from ...core.tensor import Tensor
+
+        self.register_buffer("aux_loss_val",
+                             Tensor(np.zeros((), np.float32)),
+                             persistable=False)
+        self._last_aux = None
+
+    @property
+    def aux_loss(self):
+        """Eager: the tape Tensor from the last forward (differentiable
+        for `total = loss + w * moe.aux_loss` training loops). In a
+        functional/jit context read the `aux_loss_val` entry of
+        apply()'s new_buffers instead."""
+        if self._last_aux is not None:
+            return self._last_aux
+        return self._buffers["aux_loss_val"]
+
+    def forward(self, x):
+        """x: [B, S, d_model] -> [B, S, d_model]."""
+        from ...tensor import ops as T
+
+        B, S, D = x.shape
+        E = self.num_experts
+        tokens = B * S
+        cap = max(1, int(self.capacity_factor * tokens / E))
+        xf = T.reshape(x, [tokens, D])
+
+        logits = T.einsum("td,de->te", xf, self.router)
+        probs = F.softmax(logits, axis=-1)                    # [T, E]
+        expert_idx = T.argmax(probs, axis=-1)                 # [T]
+        onehot = F.one_hot(expert_idx, E)                     # [T, E]
+        gate = T.sum(probs * onehot, axis=-1)                 # [T]
+
+        # position of each token within its expert's queue, in token
+        # order; tokens past capacity get mask 0
+        pos = T.cumsum(onehot, axis=0) * onehot               # [T, E]
+        pos = T.sum(pos, axis=-1) - 1.0                       # [T]
+        keep = (pos < float(cap)).astype("float32")
+        pos_oh = F.one_hot(T.clip(pos, 0.0, float(cap - 1)).astype(
+            "int64"), cap)                                    # [T, C]
+        # dispatch[t, e, c] = 1 iff token t sits in slot c of expert e
+        dispatch = T.einsum("te,tc->tec",
+                            onehot * T.unsqueeze(keep, -1), pos_oh)
+        combine = dispatch * T.unsqueeze(
+            T.unsqueeze(gate, -1), -1)                        # [T, E, C]
+
+        expert_in = T.einsum("tec,td->ecd", dispatch, xf)     # [E, C, D]
+        h = T.einsum("ecd,edf->ecf", expert_in,
+                     self.experts.weight_in)
+        h = F.gelu(h) if self.act == "gelu" else F.relu(h)
+        expert_out = T.einsum("ecf,efd->ecd", h,
+                              self.experts.weight_out)        # [E, C, D]
+        out = T.einsum("tec,ecd->td", combine, expert_out)
+
+        # Switch load-balance aux loss: E * sum_e f_e * P_e, where f_e =
+        # fraction of tokens routed to e, P_e = mean router prob of e
+        f_e = T.mean(onehot, axis=0)
+        p_e = T.mean(probs, axis=0)
+        aux = T.sum(f_e * p_e) * float(E)
+        self._buffers["aux_loss_val"]._data = aux._data  # jit channel
+        try:
+            from jax._src import core as _jc
+
+            self._last_aux = aux if _jc.trace_state_clean() else None
+        except Exception:
+            self._last_aux = None
+
+        return T.reshape(out, [B, S, D])

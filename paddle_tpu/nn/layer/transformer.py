@@ -100,16 +100,27 @@ class MultiHeadAttention(Layer):
 class TransformerEncoderLayer(Layer):
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
                  activation="relu", attn_dropout=None, act_dropout=None,
-                 normalize_before=False, weight_attr=None, bias_attr=None):
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 moe_experts=0, moe_capacity_factor=1.25):
         super().__init__()
         self.normalize_before = normalize_before
         self.self_attn = MultiHeadAttention(
             d_model, nhead, attn_dropout if attn_dropout is not None
             else dropout, weight_attr=weight_attr, bias_attr=bias_attr)
-        self.linear1 = Linear(d_model, dim_feedforward, weight_attr,
-                              bias_attr)
-        self.linear2 = Linear(dim_feedforward, d_model, weight_attr,
-                              bias_attr)
+        if moe_experts:
+            from .moe import MoELayer
+
+            self.moe = MoELayer(d_model, dim_feedforward,
+                                num_experts=moe_experts,
+                                capacity_factor=moe_capacity_factor,
+                                activation=activation)
+            self.linear1 = self.linear2 = None
+        else:
+            self.moe = None
+            self.linear1 = Linear(d_model, dim_feedforward, weight_attr,
+                                  bias_attr)
+            self.linear2 = Linear(dim_feedforward, d_model, weight_attr,
+                                  bias_attr)
         self.norm1 = LayerNorm(d_model)
         self.norm2 = LayerNorm(d_model)
         self.dropout1 = Dropout(dropout)
@@ -132,8 +143,11 @@ class TransformerEncoderLayer(Layer):
         residual = src
         if self.normalize_before:
             src = self.norm2(src)
-        src = self.linear2(self.dropout_act(self.activation(
-            self.linear1(src))))
+        if self.moe is not None:
+            src = self.moe(src)
+        else:
+            src = self.linear2(self.dropout_act(self.activation(
+                self.linear1(src))))
         src = residual + self.dropout2(src)
         if not self.normalize_before:
             src = self.norm2(src)

@@ -132,8 +132,47 @@ def _z():
 # forward kernel: out + logsumexp (residual for the flash backward)
 # --------------------------------------------------------------------------
 
+def _drop_consts(dropout_p):
+    """(uint32 keep-threshold, f32 1/keep) — numpy-typed on purpose: the
+    tunnel's remote Mosaic helper rejects weak-typed literals."""
+    import numpy as np
+
+    thresh = np.uint32(min(int(round(dropout_p * 2.0 ** 32)), 2 ** 32 - 1))
+    return thresh, np.float32(1.0 / (1.0 - dropout_p))
+
+
+def _block_bits(pltpu, seed_ref, bh, qi, ki, block_q, block_k):
+    """Counter-style dropout bits for one (qi, ki) logits block: reseed
+    the on-core PRNG with (seed, bh, qi, ki) then draw — the SAME tuple
+    (not stream order) addresses the block, so the dQ kernel (ki inner
+    loop) and the dK/dV kernel (qi inner loop) regenerate identical
+    masks. Reference role: dropout_op.cc composed after the softmax of
+    multihead attention."""
+    import jax.numpy as jnp
+
+    # Mosaic supports at most TWO seed words: fold bh into the first
+    # and (qi, ki) injectively into the second (ki < 4096 always:
+    # sk <= 2^20 at block_k >= 256)
+    pltpu.prng_seed(seed_ref[0] + bh, qi * jnp.int32(4096) + ki)
+    bits = pltpu.prng_random_bits((block_q, block_k))
+    if bits.dtype != jnp.uint32:
+        bits = pltpu.bitcast(bits, jnp.uint32)
+    return bits
+
+
+def _causal_apply(jax, jnp, dmat, qi, ki, block_q, block_k, logits):
+    """Mask logits[r, c] where (global row) < (global col). dmat =
+    row-iota - col-iota is hoisted OUT of the k loop; per block only a
+    scalar offset compare + select remains. Measured (tools/
+    tune_flash.py, seq1024): predicating the select away entirely with
+    lax.cond made every combo ~1.5x SLOWER (Mosaic serializes around
+    scf.if), so the mask applies unconditionally."""
+    off = ki * jnp.int32(block_k) - qi * jnp.int32(block_q)
+    return jnp.where(dmat >= off, logits, jnp.float32(-1e30))
+
+
 def _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal, has_bias, block_q,
-                       block_k, dtype, interpret=False):
+                       block_k, dtype, interpret=False, dropout_p=0.0):
     import jax
     import jax.numpy as jnp
 
@@ -141,57 +180,91 @@ def _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal, has_bias, block_q,
 
     nq = sq // block_q
     nk = sk // block_k
+    has_drop = dropout_p > 0.0
+    if has_drop:
+        from jax.experimental.pallas import tpu as pltpu
+
+        thresh, inv_keep = _drop_consts(dropout_p)
 
     def kernel(*refs):
+        if has_drop:
+            seed_ref, *refs = refs
         if has_bias:
             q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref = refs
         else:
             q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        bh = pl.program_id(0)
         qi = pl.program_id(1)
-        qb = q_ref[...].astype(jnp.float32) * s
+        # operands stay in their NATIVE dtype (bf16 x bf16 -> f32 MXU
+        # accumulation); the softmax scale folds into the [bq, d] query
+        # block ONCE instead of a [bq, bk] logits multiply per k block
+        sf = jnp.float32(s)
+        qb = (q_ref[...].astype(jnp.float32) * sf).astype(q_ref.dtype)
+        if is_causal:
+            dmat = (jax.lax.broadcasted_iota(
+                        jnp.int32, (block_q, block_k), 0)
+                    - jax.lax.broadcasted_iota(
+                        jnp.int32, (block_q, block_k), 1))
 
-        def body(ki, carry):
-            acc, m_prev, l_prev = carry
-            kb = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-            vb = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-            logits = jnp.dot(qb, kb.T,
-                             preferred_element_type=jnp.float32)
-            if has_bias:
-                bias = bias_ref[pl.ds(ki * block_k, block_k), 0]
-                logits = logits + bias[None, :]
-            if is_causal:
-                rows = qi * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0)
-                cols = ki * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1)
-                logits = jnp.where(rows >= cols, logits,
-                                   jnp.float32(-1e30))
-            m_cur = jnp.maximum(m_prev, logits.max(axis=-1, keepdims=True))
-            alpha = jnp.exp(m_prev - m_cur)
-            p = jnp.exp(logits - m_cur)
-            l_cur = l_prev * alpha + p.sum(axis=-1, keepdims=True)
-            acc = acc * alpha + jnp.dot(p, vb,
-                                        preferred_element_type=jnp.float32)
-            return acc, m_cur, l_cur
+        def make_body(masked):
+            def body(ki, carry):
+                acc, m_prev, l_prev = carry
+                kb = k_ref[pl.ds(ki * block_k, block_k), :]
+                vb = v_ref[pl.ds(ki * block_k, block_k), :]
+                logits = jnp.dot(qb, kb.T,
+                                 preferred_element_type=jnp.float32)
+                if has_bias:
+                    bias = bias_ref[pl.ds(ki * block_k, block_k), 0]
+                    logits = logits + bias[None, :]
+                if masked:
+                    logits = _causal_apply(jax, jnp, dmat, qi, ki,
+                                           block_q, block_k, logits)
+                m_cur = jnp.maximum(m_prev,
+                                    logits.max(axis=-1, keepdims=True))
+                alpha = jnp.exp(m_prev - m_cur)
+                p = jnp.exp(logits - m_cur)
+                # softmax normalizer accumulates the RAW probabilities;
+                # dropout applies to the normalized output, which
+                # divides by l at the end — only the acc matmul sees
+                # the mask
+                l_cur = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+                if has_drop:
+                    bits = _block_bits(pltpu, seed_ref, bh, qi, ki,
+                                       block_q, block_k)
+                    p = jnp.where(bits >= thresh, p * inv_keep,
+                                  jnp.float32(0.0))
+                acc = (acc * alpha
+                       + jnp.dot(p.astype(qb.dtype), vb,
+                                 preferred_element_type=jnp.float32))
+                return acc, m_cur, l_cur
+            return body
 
         acc0 = jnp.zeros((block_q, d), jnp.float32)
         m0 = jnp.full((block_q, 1), -1e30, jnp.float32)
         l0 = jnp.zeros((block_q, 1), jnp.float32)
-        if is_causal:
+        carry0 = (acc0, m0, l0)
+        if is_causal and block_q == block_k:
+            # diagonal split: interior blocks [0, qi) need no mask at
+            # all (measured VPU cost); only the diagonal block does
+            carry = jax.lax.fori_loop(jnp.int32(0), qi,
+                                      make_body(False), carry0)
+            acc, m_f, l_f = make_body(True)(qi, carry)
+        elif is_causal:
             k_hi = (qi + 1) * block_q
             nk_eff = (k_hi + block_k - 1) // jnp.int32(block_k)
+            acc, m_f, l_f = jax.lax.fori_loop(
+                jnp.int32(0), jnp.int32(nk_eff), make_body(True), carry0)
         else:
-            nk_eff = nk
-        acc, m_f, l_f = jax.lax.fori_loop(
-            jnp.int32(0), jnp.int32(nk_eff), body, (acc0, m0, l0))
+            acc, m_f, l_f = jax.lax.fori_loop(
+                jnp.int32(0), jnp.int32(nk), make_body(False), carry0)
         l_safe = jnp.maximum(l_f, jnp.float32(1e-30))
         o_ref[...] = (acc / l_safe).astype(o_ref.dtype)
         lse_ref[...] = m_f + jnp.log(l_safe)   # (block_q, 1)
 
     in_specs = [
-        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, _z())),
-        pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, _z(), _z())),
-        pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, _z(), _z())),
+        pl.BlockSpec((None, block_q, d), lambda bh, qi, *_: (bh, qi, _z())),
+        pl.BlockSpec((None, sk, d), lambda bh, qi, *_: (bh, _z(), _z())),
+        pl.BlockSpec((None, sk, d), lambda bh, qi, *_: (bh, _z(), _z())),
     ]
     if has_bias:
         # per-row tensors carry a trailing unit dim: the TPU lowering
@@ -199,26 +272,39 @@ def _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal, has_bias, block_q,
         # array dims — (rows, 1) satisfies that where a 1-D row block
         # cannot
         in_specs.append(
-            pl.BlockSpec((None, sk, 1), lambda bh, qi: (bh, _z(), _z())))
+            pl.BlockSpec((None, sk, 1), lambda bh, qi, *_: (bh, _z(), _z())))
+    out_specs = [
+        pl.BlockSpec((None, block_q, d), lambda bh, qi, *_: (bh, qi, _z())),
+        pl.BlockSpec((None, block_q, 1), lambda bh, qi, *_: (bh, qi, _z())),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((b * h, sq, d), dtype),
+        jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+    ]
+    if has_drop:
+        from jax.experimental.pallas import tpu as pltpu
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, grid=(b * h, nq),
+            in_specs=in_specs, out_specs=out_specs)
+        return pl.pallas_call(kernel, grid_spec=grid_spec,
+                              out_shape=out_shape, interpret=interpret)
     return pl.pallas_call(
         kernel,
         grid=(b * h, nq),
         in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, _z())),
-            pl.BlockSpec((None, block_q, 1), lambda bh, qi: (bh, qi, _z())),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, sq, d), dtype),
-            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )
 
 
 def flash_attention_fwd(q, k, v, bias=None, is_causal=False, scale=None,
-                        block_q=256, block_k=256, interpret=False):
-    """Returns (out [b,h,sq,d], lse [b*h, sq, 1]). bias: [b, sk] additive."""
+                        block_q=256, block_k=256, interpret=False,
+                        dropout_p=0.0, seed=None):
+    """Returns (out [b,h,sq,d], lse [b*h, sq, 1]). bias: [b, sk] additive.
+    dropout_p > 0 needs `seed` (int32[1]): in-kernel counter-addressed
+    probability dropout on the normalized attention weights."""
     import jax.numpy as jnp
 
     b, h, sq, d = q.shape
@@ -231,17 +317,25 @@ def flash_attention_fwd(q, k, v, bias=None, is_causal=False, scale=None,
             f"flash kernels need block-tileable lengths; got sq={sq}, "
             f"sk={sk} with blocks ({block_q}, {block_k}) — use "
             f"flash_attention() which falls back to the XLA reference")
+    if dropout_p and seed is None:
+        raise ValueError("flash dropout needs a seed (int32[1] array)")
+    if is_causal and sq != sk:
+        raise ValueError(
+            "flash kernels mask causal start-aligned (row >= col); the "
+            "reference semantics for sq != sk align the diagonal at the "
+            "END — use flash_attention(), which falls back")
     qr = q.reshape(b * h, sq, d)
     kr = k.reshape(b * h, sk, d)
     vr = v.reshape(b * h, sk, d)
     call = _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal,
                               bias is not None, block_q, block_k, q.dtype,
-                              interpret)
+                              interpret, dropout_p)
+    lead = (seed,) if dropout_p else ()
     if bias is not None:
         bias_bh = jnp.repeat(bias, h, axis=0)[:, :, None]  # [b*h, sk, 1]
-        out, lse = call(qr, kr, vr, bias_bh)
+        out, lse = call(*lead, qr, kr, vr, bias_bh)
     else:
-        out, lse = call(qr, kr, vr)
+        out, lse = call(*lead, qr, kr, vr)
     return out.reshape(b, h, sq, d), lse          # lse: [b*h, sq, 1]
 
 
@@ -249,7 +343,8 @@ def flash_attention_tpu(q, k, v, is_causal=False, scale=None,
                         block_q=256, block_k=256):
     """Forward-only entry (kept for callers that don't differentiate)."""
     sq, sk = q.shape[2], k.shape[2]
-    if sq % min(block_q, sq) or sk % min(block_k, sk):
+    if (sq % min(block_q, sq) or sk % min(block_k, sk)
+            or (is_causal and sq != sk)):
         return sdpa_reference(q, k, v, None, is_causal, scale)
     out, _ = flash_attention_fwd(q, k, v, None, is_causal, scale,
                                  block_q, block_k)
@@ -261,7 +356,8 @@ def flash_attention_tpu(q, k, v, is_causal=False, scale=None,
 # --------------------------------------------------------------------------
 
 def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
-                        block_q=256, block_k=256, interpret=False):
+                        block_q=256, block_k=256, interpret=False,
+                        dropout_p=0.0, seed=None):
     import jax
     import jax.numpy as jnp
 
@@ -275,6 +371,16 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
     nq = sq // block_q
     nk = sk // block_k
     has_bias = bias is not None
+    has_drop = dropout_p > 0.0
+    if has_drop:
+        from jax.experimental.pallas import tpu as pltpu
+
+        thresh, inv_keep = _drop_consts(dropout_p)
+        # dropout composes AFTER the softmax: O = (D∘P)V with
+        # D = mask/keep. delta = rowsum(dO∘O) still equals
+        # rowsum(P∘(D∘dP_raw)), so the correction term is unchanged;
+        # the kernels regenerate D per block from (seed, bh, qi, ki)
+        # and apply it to dP (and to P for dV).
 
     qr = q.reshape(b * h, sq, d)
     kr = k.reshape(b * h, sk, d)
@@ -289,142 +395,208 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
         else None
 
     def dq_kernel(*refs):
+        if has_drop:
+            seed_ref, *refs = refs
         if has_bias:
             (q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, dl_ref,
              dq_ref) = refs
         else:
             q_ref, k_ref, v_ref, g_ref, lse_ref, dl_ref, dq_ref = refs
+        bh = pl.program_id(0)
         qi = pl.program_id(1)
-        qb = q_ref[...].astype(jnp.float32)
-        gb = g_ref[...].astype(jnp.float32)
+        sf = jnp.float32(s)
+        # scale folded into the query block, SAME side as the forward
+        # so the recomputed logits match the saved lse bit-for-bit
+        qb = (q_ref[...].astype(jnp.float32) * sf).astype(q_ref.dtype)
+        gb = g_ref[...]
         lse_b = lse_ref[...]                      # (block_q, 1)
         dl_b = dl_ref[...]
-
-        def body(ki, acc):
-            kb = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-            vb = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-            logits = jnp.dot(qb, kb.T,
-                             preferred_element_type=jnp.float32) * s
-            if has_bias:
-                bb = b_ref[pl.ds(ki * block_k, block_k), 0]
-                logits = logits + bb[None, :]
-            if is_causal:
-                rows = qi * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0)
-                cols = ki * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1)
-                logits = jnp.where(rows >= cols, logits,
-                                   jnp.float32(-1e30))
-            p = jnp.exp(logits - lse_b)
-            dp = jnp.dot(gb, vb.T, preferred_element_type=jnp.float32)
-            ds = p * (dp - dl_b) * s
-            return acc + jnp.dot(ds, kb,
-                                 preferred_element_type=jnp.float32)
-
         if is_causal:
+            dmat = (jax.lax.broadcasted_iota(
+                        jnp.int32, (block_q, block_k), 0)
+                    - jax.lax.broadcasted_iota(
+                        jnp.int32, (block_q, block_k), 1))
+
+        def make_body(masked):
+            def body(ki, acc):
+                kb = k_ref[pl.ds(ki * block_k, block_k), :]
+                vb = v_ref[pl.ds(ki * block_k, block_k), :]
+                logits = jnp.dot(qb, kb.T,
+                                 preferred_element_type=jnp.float32)
+                if has_bias:
+                    bb = b_ref[pl.ds(ki * block_k, block_k), 0]
+                    logits = logits + bb[None, :]
+                if masked:
+                    logits = _causal_apply(jax, jnp, dmat, qi, ki,
+                                           block_q, block_k, logits)
+                p = jnp.exp(logits - lse_b)
+                dp = jnp.dot(gb, vb.T,
+                             preferred_element_type=jnp.float32)
+                if has_drop:
+                    bits = _block_bits(pltpu, seed_ref, bh, qi, ki,
+                                       block_q, block_k)
+                    dp = jnp.where(bits >= thresh, dp * inv_keep,
+                                   jnp.float32(0.0))
+                ds = p * (dp - dl_b)
+                # dq = (ds*s) @ kb = ds @ (s*kb): scale the [bk, d]
+                # operand, not the [bq, bk] ds
+                kbs = (kb.astype(jnp.float32) * sf).astype(kb.dtype)
+                return acc + jnp.dot(ds.astype(qb.dtype), kbs,
+                                     preferred_element_type=jnp.float32)
+            return body
+
+        acc0 = jnp.zeros((block_q, d), jnp.float32)
+        if is_causal and block_q == block_k:
+            acc = jax.lax.fori_loop(jnp.int32(0), qi,
+                                    make_body(False), acc0)
+            acc = make_body(True)(qi, acc)
+        elif is_causal:
             nk_eff = ((qi + 1) * block_q + block_k - 1) \
                 // jnp.int32(block_k)
+            acc = jax.lax.fori_loop(
+                jnp.int32(0), jnp.int32(nk_eff), make_body(True), acc0)
         else:
-            nk_eff = nk
-        acc = jax.lax.fori_loop(
-            jnp.int32(0), jnp.int32(nk_eff), body,
-            jnp.zeros((block_q, d), jnp.float32))
+            acc = jax.lax.fori_loop(
+                jnp.int32(0), jnp.int32(nk), make_body(False), acc0)
         dq_ref[...] = acc.astype(dq_ref.dtype)
 
     dq_in = [
-        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, _z())),
-        pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, _z(), _z())),
-        pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, _z(), _z())),
+        pl.BlockSpec((None, block_q, d), lambda bh, qi, *_: (bh, qi, _z())),
+        pl.BlockSpec((None, sk, d), lambda bh, qi, *_: (bh, _z(), _z())),
+        pl.BlockSpec((None, sk, d), lambda bh, qi, *_: (bh, _z(), _z())),
     ]
     if has_bias:
-        dq_in.append(pl.BlockSpec((None, sk, 1), lambda bh, qi: (bh, _z(), _z())))
+        dq_in.append(pl.BlockSpec((None, sk, 1),
+                                  lambda bh, qi, *_: (bh, _z(), _z())))
     dq_in += [
-        pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, _z())),
-        pl.BlockSpec((None, block_q, 1), lambda bh, qi: (bh, qi, _z())),
-        pl.BlockSpec((None, block_q, 1), lambda bh, qi: (bh, qi, _z())),
+        pl.BlockSpec((None, block_q, d), lambda bh, qi, *_: (bh, qi, _z())),
+        pl.BlockSpec((None, block_q, 1), lambda bh, qi, *_: (bh, qi, _z())),
+        pl.BlockSpec((None, block_q, 1), lambda bh, qi, *_: (bh, qi, _z())),
     ]
     dq_args = [qr, kr, vr] + ([bias_bh] if has_bias else []) + \
         [gr, lse, delta]
-    dq = pl.pallas_call(
-        dq_kernel, grid=(b * h, nq), in_specs=dq_in,
-        out_specs=pl.BlockSpec((None, block_q, d),
-                               lambda bh, qi: (bh, qi, _z())),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        interpret=interpret,
-    )(*dq_args)
+    dq_out_spec = pl.BlockSpec((None, block_q, d),
+                               lambda bh, qi, *_: (bh, qi, _z()))
+    dq_out_shape = jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)
+    if has_drop:
+        dq_grid = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, grid=(b * h, nq),
+            in_specs=dq_in, out_specs=dq_out_spec)
+        dq = pl.pallas_call(dq_kernel, grid_spec=dq_grid,
+                            out_shape=dq_out_shape,
+                            interpret=interpret)(seed, *dq_args)
+    else:
+        dq = pl.pallas_call(
+            dq_kernel, grid=(b * h, nq), in_specs=dq_in,
+            out_specs=dq_out_spec,
+            out_shape=dq_out_shape,
+            interpret=interpret,
+        )(*dq_args)
 
     def dkv_kernel(*refs):
+        if has_drop:
+            seed_ref, *refs = refs
         if has_bias:
             (q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, dl_ref,
              dk_ref, dv_ref, db_ref) = refs
         else:
             (q_ref, k_ref, v_ref, g_ref, lse_ref, dl_ref, dk_ref,
              dv_ref) = refs
+        bh = pl.program_id(0)
         ki = pl.program_id(1)
-        kb = k_ref[...].astype(jnp.float32)
-        vb = v_ref[...].astype(jnp.float32)
+        kb = k_ref[...]
+        vb = v_ref[...]
+        sf = jnp.float32(s)
         if has_bias:
             bb = b_ref[...][:, 0]
-
-        def body(qi, carry):
-            dk_acc, dv_acc, db_acc = carry
-            qb = q_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-            gb = g_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-            lse_b = lse_ref[pl.ds(qi * block_q, block_q), :]
-            dl_b = dl_ref[pl.ds(qi * block_q, block_q), :]
-            logits = jnp.dot(qb, kb.T,
-                             preferred_element_type=jnp.float32) * s
-            if has_bias:
-                logits = logits + bb[None, :]
-            if is_causal:
-                rows = qi * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0)
-                cols = ki * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1)
-                logits = jnp.where(rows >= cols, logits,
-                                   jnp.float32(-1e30))
-            p = jnp.exp(logits - lse_b)
-            dv_acc = dv_acc + jnp.dot(p.T, gb,
-                                      preferred_element_type=jnp.float32)
-            dp = jnp.dot(gb, vb.T, preferred_element_type=jnp.float32)
-            dlogits = p * (dp - dl_b)   # d loss / d (q.k*s + bias)
-            db_acc = db_acc + dlogits.sum(axis=0)
-            ds = dlogits * s
-            dk_acc = dk_acc + jnp.dot(ds.T, qb,
-                                      preferred_element_type=jnp.float32)
-            return dk_acc, dv_acc, db_acc
-
         if is_causal:
-            q_lo = (ki * block_k) // jnp.int32(block_q)
-        else:
-            q_lo = 0
+            dmat = (jax.lax.broadcasted_iota(
+                        jnp.int32, (block_q, block_k), 0)
+                    - jax.lax.broadcasted_iota(
+                        jnp.int32, (block_q, block_k), 1))
+
+        def make_body(masked):
+            def body(qi, carry):
+                dk_acc, dv_acc, db_acc = carry
+                qb = q_ref[pl.ds(qi * block_q, block_q), :]
+                gb = g_ref[pl.ds(qi * block_q, block_q), :]
+                lse_b = lse_ref[pl.ds(qi * block_q, block_q), :]
+                dl_b = dl_ref[pl.ds(qi * block_q, block_q), :]
+                # qbs matches the fwd's scale-folded query block, so
+                # the recomputed logits agree with the saved lse; it
+                # also IS s*qb, which the dk matmul needs
+                qbs = (qb.astype(jnp.float32) * sf).astype(qb.dtype)
+                logits = jnp.dot(qbs, kb.T,
+                                 preferred_element_type=jnp.float32)
+                if has_bias:
+                    logits = logits + bb[None, :]
+                if masked:
+                    logits = _causal_apply(jax, jnp, dmat, qi, ki,
+                                           block_q, block_k, logits)
+                p = jnp.exp(logits - lse_b)
+                dp = jnp.dot(gb, vb.T,
+                             preferred_element_type=jnp.float32)
+                if has_drop:
+                    bits = _block_bits(pltpu, seed_ref, bh, qi, ki,
+                                       block_q, block_k)
+                    keep = bits >= thresh
+                    pd = jnp.where(keep, p * inv_keep, jnp.float32(0.0))
+                    dp = jnp.where(keep, dp * inv_keep, jnp.float32(0.0))
+                else:
+                    pd = p
+                dv_acc = dv_acc + jnp.dot(
+                    pd.astype(kb.dtype).T, gb,
+                    preferred_element_type=jnp.float32)
+                dlogits = p * (dp - dl_b)   # d loss/d (q.k*s + bias)
+                db_acc = db_acc + dlogits.sum(axis=0)
+                # dk = (dlogits*s)^T @ qb = dlogits^T @ (s*qb) = ^T@qbs
+                dk_acc = dk_acc + jnp.dot(
+                    dlogits.astype(kb.dtype).T, qbs,
+                    preferred_element_type=jnp.float32)
+                return dk_acc, dv_acc, db_acc
+            return body
+
         z = jnp.zeros((block_k, d), jnp.float32)
         zb = jnp.zeros((block_k,), jnp.float32)
-        dk_acc, dv_acc, db_acc = jax.lax.fori_loop(
-            jnp.int32(q_lo), jnp.int32(nq), body, (z, z, zb))
+        carry0 = (z, z, zb)
+        if is_causal and block_q == block_k:
+            # diagonal block at qi == ki needs the mask; everything
+            # after it does not
+            carry = make_body(True)(ki, carry0)
+            outs = jax.lax.fori_loop(ki + jnp.int32(1), jnp.int32(nq),
+                                     make_body(False), carry)
+        elif is_causal:
+            q_lo = (ki * block_k) // jnp.int32(block_q)
+            outs = jax.lax.fori_loop(
+                jnp.int32(q_lo), jnp.int32(nq), make_body(True), carry0)
+        else:
+            outs = jax.lax.fori_loop(
+                jnp.int32(0), jnp.int32(nq), make_body(False), carry0)
+        dk_acc, dv_acc, db_acc = outs
         dk_ref[...] = dk_acc.astype(dk_ref.dtype)
         dv_ref[...] = dv_acc.astype(dv_ref.dtype)
         if has_bias:
             db_ref[...] = db_acc[:, None]
 
     dkv_in = [
-        pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, _z(), _z())),
-        pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, _z())),
-        pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, _z())),
+        pl.BlockSpec((None, sq, d), lambda bh, ki, *_: (bh, _z(), _z())),
+        pl.BlockSpec((None, block_k, d), lambda bh, ki, *_: (bh, ki, _z())),
+        pl.BlockSpec((None, block_k, d), lambda bh, ki, *_: (bh, ki, _z())),
     ]
     if has_bias:
         dkv_in.append(
-            pl.BlockSpec((None, block_k, 1), lambda bh, ki: (bh, ki, _z())))
+            pl.BlockSpec((None, block_k, 1),
+                         lambda bh, ki, *_: (bh, ki, _z())))
     dkv_in += [
-        pl.BlockSpec((None, sq, d), lambda bh, ki: (bh, _z(), _z())),
-        pl.BlockSpec((None, sq, 1), lambda bh, ki: (bh, _z(), _z())),
-        pl.BlockSpec((None, sq, 1), lambda bh, ki: (bh, _z(), _z())),
+        pl.BlockSpec((None, sq, d), lambda bh, ki, *_: (bh, _z(), _z())),
+        pl.BlockSpec((None, sq, 1), lambda bh, ki, *_: (bh, _z(), _z())),
+        pl.BlockSpec((None, sq, 1), lambda bh, ki, *_: (bh, _z(), _z())),
     ]
     dkv_args = [qr, kr, vr] + ([bias_bh] if has_bias else []) + \
         [gr, lse, delta]
     out_specs = [
-        pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, _z())),
-        pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, _z())),
+        pl.BlockSpec((None, block_k, d), lambda bh, ki, *_: (bh, ki, _z())),
+        pl.BlockSpec((None, block_k, d), lambda bh, ki, *_: (bh, ki, _z())),
     ]
     out_shape = [
         jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
@@ -432,14 +604,22 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
     ]
     if has_bias:
         out_specs.append(pl.BlockSpec((None, block_k, 1),
-                                      lambda bh, ki: (bh, ki, _z())))
+                                      lambda bh, ki, *_: (bh, ki, _z())))
         out_shape.append(jax.ShapeDtypeStruct((b * h, sk, 1),
                                               jnp.float32))
-    outs = pl.pallas_call(
-        dkv_kernel, grid=(b * h, nk), in_specs=dkv_in,
-        out_specs=out_specs, out_shape=out_shape,
-        interpret=interpret,
-    )(*dkv_args)
+    if has_drop:
+        dkv_grid = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, grid=(b * h, nk),
+            in_specs=dkv_in, out_specs=out_specs)
+        outs = pl.pallas_call(dkv_kernel, grid_spec=dkv_grid,
+                              out_shape=out_shape,
+                              interpret=interpret)(seed, *dkv_args)
+    else:
+        outs = pl.pallas_call(
+            dkv_kernel, grid=(b * h, nk), in_specs=dkv_in,
+            out_specs=out_specs, out_shape=out_shape,
+            interpret=interpret,
+        )(*dkv_args)
     if has_bias:
         dk, dv, db_bh = outs
         # bias is per (batch, key): sum the head axis
@@ -458,43 +638,92 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
 # --------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def _flash_diff_fn(is_causal, scale, has_bias, interpret):
+def _flash_diff_fn(is_causal, scale, has_bias, interpret, dropout_p,
+                   block_q, block_k):
     import jax
 
     @jax.custom_vjp
-    def f(q, k, v, bias):
+    def f(q, k, v, bias, seed):
         out, _ = flash_attention_fwd(q, k, v, bias, is_causal, scale,
-                                     interpret=interpret)
+                                     block_q, block_k, interpret,
+                                     dropout_p, seed)
         return out
 
-    def fwd(q, k, v, bias):
+    def fwd(q, k, v, bias, seed):
         out, lse = flash_attention_fwd(q, k, v, bias, is_causal, scale,
-                                       interpret=interpret)
-        return out, (q, k, v, bias, out, lse)
+                                       block_q, block_k, interpret,
+                                       dropout_p, seed)
+        return out, (q, k, v, bias, seed, out, lse)
 
     def bwd(res, g):
-        q, k, v, bias, out, lse = res
+        q, k, v, bias, seed, out, lse = res
         dq, dk, dv, dbias = flash_attention_bwd(q, k, v, bias, out, lse,
                                                 g, is_causal, scale,
-                                                interpret=interpret)
-        return dq, dk, dv, dbias
+                                                block_q, block_k,
+                                                interpret, dropout_p,
+                                                seed)
+        return dq, dk, dv, dbias, None
 
     f.defvjp(fwd, bwd)
     return f
 
 
+def _pick_blocks(sq, sk, block_q=None, block_k=None):
+    """Block sizes measured on TPU v5e (tools/tune_flash.py sweep over
+    {128,256,512,1024}^2 at seq 1024/2048/4096): 512x512 wins every
+    config — 1.06x/2.96x/3.10x vs the XLA fused reference fwd+bwd.
+    EQUAL blocks also enable the diagonal-split causal path (interior
+    blocks skip the mask select entirely), worth ~10% alone. Lengths
+    not divisible by 512 take the largest 128-multiple that divides
+    them (1280 -> 256, 768 -> 384) so flash still engages; the
+    _flash_plan divisibility gate derives from THIS function — one
+    source of truth."""
+    def _one(s, override):
+        if override is not None:
+            return min(override, s)
+        for b in (512, 384, 256, 128):
+            if s % b == 0 or b >= s:
+                return min(b, s)
+        return min(128, s)
+    return _one(sq, block_q), _one(sk, block_k)
+
+
 def flash_attention(q, k, v, bias=None, is_causal=False, scale=None,
-                    interpret=False, block_q=256, block_k=256):
+                    interpret=False, block_q=None, block_k=None,
+                    dropout_p=0.0, dropout_seed=None):
     """Differentiable flash attention (fwd+bwd pallas). bias: optional
-    [b, sk] additive key bias (padding masks). Sequence lengths that do
-    not tile into blocks fall back to the XLA reference (the blockwise
-    grid would silently truncate the tail otherwise)."""
+    [b, sk] additive key bias (padding masks). dropout_p: in-kernel
+    probability dropout on the attention weights, addressed by
+    (dropout_seed, bh, qi, ki) so fwd and both bwd kernels regenerate
+    identical masks. Sequence lengths that do not tile into blocks fall
+    back to the XLA reference (the blockwise grid would silently
+    truncate the tail otherwise)."""
     sq, sk = q.shape[2], k.shape[2]
-    if sq % min(block_q, sq) or sk % min(block_k, sk):
+    block_q, block_k = _pick_blocks(sq, sk, block_q, block_k)
+    if (sq % block_q or sk % block_k
+            or (is_causal and sq != sk)):
+        # fallbacks: non-tileable lengths, and causal with sq != sk —
+        # the kernels' causal mask is start-aligned (row >= col) while
+        # the reference aligns the diagonal at the END for cross
+        # shapes; rather than be silently wrong, use the reference
+        # (r05 review finding: both old and new kernels mis-masked
+        # cross-shape causal)
+        if dropout_p and dropout_seed is None:
+            raise ValueError(
+                "flash dropout needs dropout_seed (int32[1])")
+        import jax
+
         mask4 = None if bias is None else bias[:, None, None, :]
-        return sdpa_reference(q, k, v, mask4, is_causal, scale)
-    f = _flash_diff_fn(is_causal, scale, bias is not None, interpret)
-    return f(q, k, v, bias)
+        key = (jax.random.fold_in(jax.random.PRNGKey(0),
+                                  dropout_seed[0])
+               if dropout_p else None)
+        return sdpa_reference(q, k, v, mask4, is_causal, scale,
+                              dropout_p, key)
+    if dropout_p and dropout_seed is None:
+        raise ValueError("flash dropout needs dropout_seed (int32[1])")
+    f = _flash_diff_fn(is_causal, scale, bias is not None, interpret,
+                       float(dropout_p), block_q, block_k)
+    return f(q, k, v, bias, dropout_seed)
 
 
 _FLASH_PROBED = {}
@@ -597,21 +826,37 @@ def sdpa_reference_bshd(q, k, v, mask=None, is_causal=False, scale=None,
 _NO_FLASH = object()
 
 
+def _seed_from_key(key):
+    """int32[1] kernel seed from a jax PRNG key (typed or raw). A plain
+    bitcast of the key data (no extra RNG draw): per-step keys are
+    already folded from the step counter upstream."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        data = jax.random.key_data(key)
+    except Exception:
+        data = key
+    data = jnp.ravel(data)[:1]
+    return jax.lax.bitcast_convert_type(data, jnp.int32)
+
+
 def _flash_plan(seq_q, seq_k, head_dim, mask, batch, heads,
-                dropout_p=0.0):
+                dropout_p=0.0, dropout_key=None):
     """All the flash-dispatch gates in one place: TPU backend, long
     enough sequence, block-divisible lengths, head_dim small enough, a
-    mask reducible to a key-position bias, kernel importable, and no
-    prob-dropout (the blockwise kernel has no dropout support).
-    Returns the key-position bias to pass to the kernel (None when
-    maskless), or the _NO_FLASH sentinel when flash cannot run."""
+    mask reducible to a key-position bias, and the kernel importable.
+    Prob-dropout runs IN-KERNEL (counter-addressed bits) and needs the
+    caller's dropout_key. Returns the key-position bias to pass to the
+    kernel (None when maskless), or the _NO_FLASH sentinel when flash
+    cannot run."""
     min_flash_len = int(os.environ.get("PT_FLASH_MIN_SEQ", "512"))
-    if dropout_p:
+    if dropout_p and dropout_key is None:
         return _NO_FLASH
+    bq, bk = _pick_blocks(seq_q, seq_k)
     if not (_on_tpu() and head_dim <= 256
             and seq_q >= min_flash_len
-            and seq_q % min(256, seq_q) == 0
-            and seq_k % min(256, seq_k) == 0):
+            and seq_q % bq == 0 and seq_k % bk == 0):
         return _NO_FLASH
     bias = None
     if mask is not None:
@@ -625,27 +870,32 @@ def _flash_plan(seq_q, seq_k, head_dim, mask, batch, heads,
 
 def sdpa_bshd(q, k, v, mask=None, is_causal=False, scale=None,
               dropout_p=0.0, dropout_key=None):
-    """sdpa over [B, S, H, D] operands. The flash path here is gated by
-    PT_FLASH_MIN_SEQ_BSHD, default 8192 — i.e. OFF for every measured
-    size: inside a full compiled model XLA's fused attention beat the
-    flash kernel at seq 1024/2048/4096 on this chip (0.94x/0.92x/0.90x
-    end-to-end, bench `ernie_long`) because the BSHD<->BHSD transposes
-    and the lost fusion with the QKV/output projections outweigh the
-    kernel's standalone win (bench `long_context`: 1.4-1.9x on BHSD
-    operands). Override the env to re-engage if a future chip/runtime
-    shifts the balance."""
+    """sdpa over [B, S, H, D] operands. Flash engages at seq >=
+    PT_FLASH_MIN_SEQ_BSHD (default 1024). Measured in-model (ERNIE b8
+    seq1024, bench `ernie_long`, r05 kernel with 512x512 blocks +
+    diagonal-split causal): flash 1.22x vs the XLA fused path at
+    dropout 0, and 1.56x at dropout 0.1 — the XLA path materializes +
+    draws RNG for the full [B,H,S,S] prob tensor while the kernel's
+    counter-addressed in-kernel bits are ~free. (r04's kernel LOST
+    in-model at 1024, 0.94x, which is why the old default was 8192;
+    the r05 block-tuning flipped it.)"""
     import jax.numpy as jnp
 
     if q.ndim == 4:
-        min_bshd = int(os.environ.get("PT_FLASH_MIN_SEQ_BSHD", "8192"))
+        env = "PT_FLASH_MIN_SEQ_BSHD_DROP" if dropout_p else \
+            "PT_FLASH_MIN_SEQ_BSHD"
+        min_bshd = int(os.environ.get(env, "1024"))
         bias = (_NO_FLASH if q.shape[1] < min_bshd else
                 _flash_plan(q.shape[1], k.shape[1], q.shape[-1], mask,
-                            q.shape[0], q.shape[2], dropout_p))
+                            q.shape[0], q.shape[2], dropout_p,
+                            dropout_key))
         if bias is not _NO_FLASH:
             try:
+                seed = _seed_from_key(dropout_key) if dropout_p else None
                 out = flash_attention(
                     jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
-                    jnp.swapaxes(v, 1, 2), bias, is_causal, scale)
+                    jnp.swapaxes(v, 1, 2), bias, is_causal, scale,
+                    dropout_p=dropout_p, dropout_seed=seed)
                 return jnp.swapaxes(out, 1, 2)
             except Exception:
                 pass
@@ -663,10 +913,14 @@ def sdpa(q, k, v, mask=None, is_causal=False, scale=None,
     ERNIE seq 128 is ~2% faster on the reference path)."""
     if q.ndim == 4:
         bias = _flash_plan(q.shape[2], k.shape[2], q.shape[-1], mask,
-                           q.shape[0], q.shape[1], dropout_p)
+                           q.shape[0], q.shape[1], dropout_p,
+                           dropout_key)
         if bias is not _NO_FLASH:
             try:
-                return flash_attention(q, k, v, bias, is_causal, scale)
+                seed = _seed_from_key(dropout_key) if dropout_p else None
+                return flash_attention(q, k, v, bias, is_causal, scale,
+                                       dropout_p=dropout_p,
+                                       dropout_seed=seed)
             except Exception:
                 pass
     return sdpa_reference(q, k, v, mask, is_causal, scale,

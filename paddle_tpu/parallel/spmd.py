@@ -34,10 +34,11 @@ class SpmdTrainer:
                  rules: Optional[ShardingRules] = None,
                  remat: bool = False, grad_accum: int = 1,
                  compute_dtype=None, donate: bool = True,
-                 batch_axes=("dp",)):
+                 batch_axes=("dp",), moe_aux_weight: float = 0.01):
         import jax
 
         self.mesh = mesh or get_mesh()
+        self.moe_aux_weight = float(moe_aux_weight)
         self.fm = functionalize(layer)
         self.loss_fn = loss_fn
         self.tx = optimizer if isinstance(optimizer, fopt.Transform) \
@@ -130,7 +131,19 @@ class SpmdTrainer:
         loss = self.loss_fn(out, labels)
         if hasattr(loss, "_data"):  # paddle Tensor from a paddle loss fn
             loss = loss._data
-        return loss.astype("float32").mean(), new_buf
+        total = loss.astype("float32").mean()
+        # MoE load-balance pressure: every MoELayer publishes its aux
+        # loss through the buffer channel (nn/layer/moe.py) — remat- and
+        # jit-safe because buffers are RETURNED, not side-stored
+        if self.moe_aux_weight:
+            import jax.numpy as jnp
+
+            aux = [v for n, v in new_buf.items()
+                   if n.endswith("aux_loss_val")]
+            if aux:
+                total = total + jnp.float32(self.moe_aux_weight) * sum(
+                    a.astype("float32").reshape(()) for a in aux)
+        return total, new_buf
 
     def _build_step(self):
         import jax

@@ -17,7 +17,7 @@ class ErnieConfig:
     def __init__(self, vocab_size=18000, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=3072, max_position=513,
                  type_vocab_size=2, hidden_dropout=0.1, attn_dropout=0.1,
-                 num_classes=2):
+                 num_classes=2, moe_experts=0, moe_capacity_factor=1.25):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -28,6 +28,11 @@ class ErnieConfig:
         self.hidden_dropout = hidden_dropout
         self.attn_dropout = attn_dropout
         self.num_classes = num_classes
+        # moe_experts > 0 replaces every encoder FFN with a top-1
+        # routed MoELayer (nn/layer/moe.py) whose expert axis shards
+        # over the mesh's `ep` axis
+        self.moe_experts = moe_experts
+        self.moe_capacity_factor = moe_capacity_factor
 
     @classmethod
     def base(cls, **kw):
@@ -77,7 +82,9 @@ class ErnieModel(nn.Layer):
         enc_layer = nn.TransformerEncoderLayer(
             cfg.hidden_size, cfg.num_heads, cfg.intermediate_size,
             dropout=cfg.hidden_dropout, activation="gelu",
-            attn_dropout=cfg.attn_dropout)
+            attn_dropout=cfg.attn_dropout,
+            moe_experts=getattr(cfg, "moe_experts", 0),
+            moe_capacity_factor=getattr(cfg, "moe_capacity_factor", 1.25))
         self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_layers)
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
         self.pooler_act = nn.Tanh()

@@ -274,14 +274,25 @@ def test_fit_a_line_book(tmp_path):
     scope = fluid.Scope()
     rng = np.random.RandomState(7)
     w_true = rng.randn(13, 1).astype("float32")
-    losses = []
-    with fluid.scope_guard(scope):
-        exe.run(startup)
+
+    # the book's feeding front door: DataLoader.from_generator
+    # (fluid/reader.py:409) with the reference-style `for data in
+    # loader(): exe.run(feed=data)` loop
+    def batches():
         for _ in range(120):
             xv = rng.randn(32, 13).astype("float32")
             yv = xv @ w_true + 0.05 * rng.randn(32, 1).astype("float32")
-            losses.append(float(exe.run(main, {"x": xv, "y": yv},
-                                        [loss])[0]))
+            yield xv, yv
+
+    loader = fluid.io.DataLoader.from_generator(feed_list=[x, y],
+                                                capacity=8)
+    loader.set_batch_generator(batches)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for data in loader():
+            losses.append(float(exe.run(main, data, [loss])[0]))
+        assert len(losses) == 120
         assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.2, (
             losses[:3], losses[-3:])
         mdir = str(tmp_path / "fit_a_line")
@@ -332,23 +343,36 @@ def test_recommender_system_book():
     exe = fluid.Executor()
     scope = fluid.Scope()
     rng = np.random.RandomState(11)
-    # learnable rule: rating driven by (user id + movie id) parity mix
+
+    # learnable rule: rating driven by (user id + movie id) parity mix,
+    # fed through the NON-iterable loader protocol (fluid/reader.py
+    # :1150): start() -> run() with no feed -> EOFException -> reset()
+    feed_vars = [usr_in, gen_in, age_in, job_in, mov_in, cat_in, label]
+
+    def batches():
+        for _ in range(50):
+            B = 32
+            cols = [rng.randint(0, V, (B, 1)).astype("int64")
+                    for V in (USR, GEN, AGE, JOB, MOV, CAT)]
+            score = ((cols[0] % 5) + (cols[4] % 5)).astype("f4") / 2.0
+            yield tuple(cols) + (score,)
+
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=feed_vars, capacity=4, iterable=False)
+    loader.set_batch_generator(batches)
     losses = []
     with fluid.scope_guard(scope):
         exe.run(startup)
-        for _ in range(150):
-            B = 32
-            feed = {"usr": rng.randint(0, USR, (B, 1)).astype("int64"),
-                    "gender": rng.randint(0, GEN, (B, 1)).astype("int64"),
-                    "age": rng.randint(0, AGE, (B, 1)).astype("int64"),
-                    "job": rng.randint(0, JOB, (B, 1)).astype("int64"),
-                    "movie": rng.randint(0, MOV, (B, 1)).astype("int64"),
-                    "category": rng.randint(0, CAT, (B, 1)).astype(
-                        "int64")}
-            score = ((feed["usr"] % 5) + (feed["movie"] % 5)
-                     ).astype("float32") / 2.0
-            feed["score"] = score
-            losses.append(float(exe.run(main, feed, [loss])[0]))
+        for _epoch in range(3):
+            loader.start()
+            while True:
+                try:
+                    losses.append(float(exe.run(main,
+                                                fetch_list=[loss])[0]))
+                except fluid.EOFException:
+                    loader.reset()
+                    break
+    assert len(losses) == 150
     assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.5, (
         losses[:3], losses[-3:])
 

@@ -1,0 +1,172 @@
+"""MoE expert parallelism (nn/layer/moe.py): top-1 routing with
+capacity-bounded dispatch/combine, expert grads that actually differ
+per expert, and SPMD training over the `ep` mesh axis."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _layer(d=16, f=32, e=2, cap=8.0, seed=5):
+    paddle.seed(seed)
+    return nn.MoELayer(d, f, num_experts=e, capacity_factor=cap)
+
+
+def test_moe_forward_matches_dense_per_token_expert():
+    """With capacity large enough that nothing drops, the MoE output at
+    token t equals gate[t] * FFN_{e(t)}(x[t]) computed densely."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.tensor import ops as T
+
+    d, f, e = 8, 16, 3
+    layer = _layer(d, f, e, cap=float(10_000))
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(2, 5, d).astype("f4"))
+    out = layer(x)
+    assert tuple(out.shape) == (2, 5, d)
+
+    xn = np.asarray(x._data).reshape(-1, d)
+    router = np.asarray(layer.router._data)
+    w_in = np.asarray(layer.experts.weight_in._data)
+    w_out = np.asarray(layer.experts.weight_out._data)
+    logits = xn @ router
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    eidx = probs.argmax(-1)
+    want = np.zeros_like(xn)
+    gelu = lambda v: np.asarray(  # noqa: E731
+        F.gelu(paddle.to_tensor(v.astype("f4")))._data)
+    for t in range(xn.shape[0]):
+        ei = eidx[t]
+        h = gelu(xn[t] @ w_in[ei])
+        want[t] = probs[t, ei] * (h @ w_out[ei])
+    np.testing.assert_allclose(np.asarray(out._data).reshape(-1, d),
+                               want, rtol=2e-4, atol=2e-5)
+    # aux loss is a scalar >= 1 at balance (E * sum f_e * P_e)
+    assert float(layer.aux_loss) > 0.0
+
+
+def test_moe_capacity_drops_overflow_tokens():
+    """capacity 1 with many tokens routed to one expert: overflowed
+    tokens contribute ZERO output (the residual carries them)."""
+    d, f = 4, 8
+    layer = _layer(d, f, e=2, cap=0.0)  # cap -> max(1, 0) = 1 slot each
+    rs = np.random.RandomState(1)
+    x = paddle.to_tensor(rs.randn(1, 6, d).astype("f4"))
+    out = np.asarray(layer(x)._data).reshape(-1, d)
+    zero_rows = (np.abs(out).max(-1) < 1e-7).sum()
+    assert zero_rows >= 4, zero_rows  # 6 tokens, 2 slots total
+
+
+def test_moe_expert_grads_differ():
+    """Backward: experts receive DIFFERENT gradients (each sees only its
+    routed tokens) — the test the r04 verdict asked for."""
+    d, f, e = 8, 16, 2
+    layer = _layer(d, f, e, cap=float(10_000))
+    rs = np.random.RandomState(2)
+    x = paddle.to_tensor(rs.randn(4, 6, d).astype("f4"))
+    out = layer(x)
+    loss = (out * paddle.to_tensor(rs.randn(4, 6, d).astype("f4"))).sum()
+    loss.backward()
+    g_in = np.asarray(layer.experts.weight_in.grad._data)
+    assert g_in.shape == (e, d, f)
+    n0, n1 = np.abs(g_in[0]).sum(), np.abs(g_in[1]).sum()
+    assert n0 > 0 and n1 > 0, (n0, n1)  # both experts exercised
+    assert not np.allclose(g_in[0], g_in[1]), "experts got identical grads"
+    # router learns too
+    assert float(np.abs(np.asarray(layer.router.grad._data)).sum()) > 0
+
+
+def test_moe_spmd_ep_axis_trains():
+    """Tiny MoE-ERNIE on a dp x ep CPU mesh: one jitted train step,
+    experts sharded over ep (placement asserted), loss drops, expert
+    updates differ per expert."""
+    import jax
+
+    from paddle_tpu.optimizer import functional as fopt
+    from paddle_tpu.parallel import (COMMON_TP_RULES, SpmdTrainer,
+                                     init_mesh)
+    from paddle_tpu.text import ErnieConfig, ErnieForSequenceClassification
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    mesh = init_mesh(dp=2, ep=2, devices=jax.devices()[:4])
+    cfg = ErnieConfig.tiny(moe_experts=2, hidden_dropout=0.0,
+                           attn_dropout=0.0)
+    paddle.seed(11)
+    net = ErnieForSequenceClassification(cfg)
+
+    def ce(logits, labels):
+        import jax.numpy as jnp
+
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.take_along_axis(logp, labels[:, None], 1).mean()
+
+    tr = SpmdTrainer(net, ce, fopt.adamw(1e-3), mesh=mesh,
+                     rules=COMMON_TP_RULES)
+    # expert weights sharded over ep
+    wname = [n for n in tr.params if n.endswith("experts.weight_in")][0]
+    spec = tr.param_specs[wname]
+    assert "ep" in str(spec), (wname, spec)
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(1, cfg.vocab_size, (8, 16)).astype(np.int64)
+    labels = (ids.sum(1) % 2).astype(np.int64)
+    dids, dlabels = tr.shard_batch(ids, labels)
+    w_before = np.asarray(
+        jax.device_get(tr.params[wname]).astype(np.float32))
+    losses = [float(tr.step((dids,), dlabels)) for _ in range(8)]
+    assert all(lv == lv for lv in losses), losses
+    assert losses[-1] < losses[0], losses
+    w_after = np.asarray(
+        jax.device_get(tr.params[wname]).astype(np.float32))
+    upd = w_after - w_before
+    assert np.abs(upd[0]).sum() > 0 and np.abs(upd[1]).sum() > 0
+    assert not np.allclose(upd[0], upd[1]), "expert updates identical"
+
+
+def test_moe_aux_loss_consumed_by_trainer():
+    """r05 review: the Switch aux loss must actually apply pressure —
+    SpmdTrainer adds moe_aux_weight * sum(aux) to the objective via the
+    buffer channel (remat/jit-safe), so the reported loss shifts with
+    the weight and the router feels balance gradients."""
+    import jax
+
+    from paddle_tpu.optimizer import functional as fopt
+    from paddle_tpu.parallel import COMMON_TP_RULES, SpmdTrainer, init_mesh
+    from paddle_tpu.text import ErnieConfig, ErnieForSequenceClassification
+
+    mesh = init_mesh(dp=2, ep=2, devices=jax.devices()[:4])
+    cfg = ErnieConfig.tiny(moe_experts=2, hidden_dropout=0.0,
+                           attn_dropout=0.0)
+
+    def ce(logits, labels):
+        import jax.numpy as jnp
+
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.take_along_axis(logp, labels[:, None], 1).mean()
+
+    rs = np.random.RandomState(3)
+    ids = rs.randint(1, cfg.vocab_size, (8, 16)).astype(np.int64)
+    labels = (ids.sum(1) % 2).astype(np.int64)
+    losses = {}
+    for w in (0.0, 0.5):
+        paddle.seed(21)
+        net = ErnieForSequenceClassification(cfg)
+        tr = SpmdTrainer(net, ce, fopt.adamw(0.0), mesh=mesh,
+                         rules=COMMON_TP_RULES, moe_aux_weight=w)
+        dids, dlabels = tr.shard_batch(ids, labels)
+        losses[w] = float(tr.step((dids,), dlabels))
+    # identical nets/batch, lr=0: the difference IS the weighted aux
+    aux_contrib = losses[0.5] - losses[0.0]
+    assert aux_contrib > 0.2, losses  # 2 MoE layers x aux >= 1 x 0.5/2
+    # remat path threads it identically (buffer channel, no leaks)
+    paddle.seed(21)
+    net = ErnieForSequenceClassification(cfg)
+    tr = SpmdTrainer(net, ce, fopt.adamw(0.0), mesh=mesh,
+                     rules=COMMON_TP_RULES, moe_aux_weight=0.5,
+                     remat=True)
+    dids, dlabels = tr.shard_batch(ids, labels)
+    np.testing.assert_allclose(float(tr.step((dids,), dlabels)),
+                               losses[0.5], rtol=1e-4)

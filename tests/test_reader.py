@@ -1,0 +1,175 @@
+"""DataLoader.from_generator + PyReader (fluid/reader.py:409, :993,
+:1253; buffered_reader.cc double-buffer role): the static-graph feeding
+front door, in both iterable and start()/reset() modes."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _linreg_prog():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    return main, startup, x, y, loss
+
+
+def _gen_batches(n_batches=6, bs=16, seed=0):
+    rs = np.random.RandomState(seed)
+    w = np.arange(1.0, 5.0, dtype=np.float32).reshape(4, 1)
+    for _ in range(n_batches):
+        xb = rs.randn(bs, 4).astype("f4")
+        yield xb, (xb @ w + 0.5).astype("f4")
+
+
+def test_iterable_batch_generator_trains():
+    main, startup, x, y, loss = _linreg_prog()
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=[x, y], capacity=4)
+    loader.set_batch_generator(lambda: _gen_batches(30))
+    exe = fluid.Executor()
+    exe.run(startup)
+    losses = []
+    for data in loader():                  # reference-style loop
+        lv, = exe.run(main, feed=data, fetch_list=[loss])
+        losses.append(float(lv))
+    assert len(losses) == 30
+    assert losses[-1] < losses[0] / 5, (losses[0], losses[-1])
+
+
+def test_iterable_epochs_restart():
+    main, startup, x, y, loss = _linreg_prog()
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=[x, y], capacity=2)
+    loader.set_batch_generator(lambda: _gen_batches(4))
+    exe = fluid.Executor()
+    exe.run(startup)
+    for _epoch in range(3):                # loader restarts per epoch
+        n = sum(1 for data in loader()
+                if exe.run(main, feed=data, fetch_list=[loss]))
+        assert n == 4
+
+
+def test_sample_generator_batches_and_drops_last():
+    main, startup, x, y, loss = _linreg_prog()
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=[x, y], capacity=4)
+
+    def samples():
+        rs = np.random.RandomState(1)
+        for _ in range(25):                # 25 % 8 -> 3 batches, tail dropped
+            xv = rs.randn(4).astype("f4")
+            yield xv, np.float32([xv.sum()])
+    loader.set_sample_generator(samples, batch_size=8)
+    batches = list(loader())
+    assert len(batches) == 3
+    assert np.asarray(batches[0]["x"]).shape == (8, 4)
+    assert np.asarray(batches[0]["y"]).shape == (8, 1)
+
+
+def test_sample_list_generator_return_list():
+    main, startup, x, y, loss = _linreg_prog()
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=[x, y], capacity=4, return_list=True)
+
+    def sample_lists():
+        rs = np.random.RandomState(2)
+        for _ in range(5):
+            yield [(rs.randn(4).astype("f4"),
+                    np.float32([1.0])) for _ in range(6)]
+    loader.set_sample_list_generator(sample_lists)
+    got = list(loader())
+    assert len(got) == 5
+    xb, yb = got[0]
+    assert np.asarray(xb).shape == (6, 4)
+    assert np.asarray(yb).shape == (6, 1)
+
+
+def test_non_iterable_start_reset_eof_loop():
+    """The reference py_reader training loop: start(), run() without
+    feeds until EOFException, reset(), next epoch."""
+    main, startup, x, y, loss = _linreg_prog()
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=[x, y], capacity=4, iterable=False)
+    loader.set_batch_generator(lambda: _gen_batches(7))
+    exe = fluid.Executor()
+    exe.run(startup)
+    for _epoch in range(2):
+        loader.start()
+        n = 0
+        while True:
+            try:
+                exe.run(main, fetch_list=[loss])
+                n += 1
+            except fluid.EOFException:
+                loader.reset()
+                break
+        assert n == 7
+
+
+def test_pyreader_decorate_and_eof():
+    import paddle_tpu.core as core
+
+    main, startup, x, y, loss = _linreg_prog()
+    reader = fluid.PyReader(feed_list=[x, y], capacity=3,
+                            iterable=False)
+    reader.decorate_batch_generator(lambda: _gen_batches(5))
+    exe = fluid.Executor()
+    exe.run(startup)
+    reader.start()
+    n = 0
+    while True:
+        try:
+            exe.run(main, fetch_list=[loss])
+            n += 1
+        except core.EOFException:      # reference fluid.core spelling
+            reader.reset()
+            break
+    assert n == 5
+
+
+def test_lod_feed_via_sample_generator():
+    """lod_level>0 feed vars collate ragged samples into LoDTensors."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        seq = fluid.layers.data("seq", [1], dtype="int64", lod_level=1)
+        emb = fluid.layers.embedding(seq, size=[50, 8])
+        pooled = fluid.layers.sequence_pool(emb, "sum")
+    exe = fluid.Executor()
+    exe.run(startup)
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=[seq], capacity=2, use_double_buffer=False)
+
+    def samples():
+        rs = np.random.RandomState(3)
+        for _ in range(9):
+            L = rs.randint(1, 6)
+            yield (rs.randint(0, 50, (L, 1)).astype("i8"),)
+    loader.set_sample_generator(samples, batch_size=3)
+    n = 0
+    for data in loader():
+        out, = exe.run(main, feed=data, fetch_list=[pooled])
+        assert np.asarray(out).shape == (3, 8)
+        n += 1
+    assert n == 3
+
+
+def test_loader_errors():
+    main, startup, x, y, loss = _linreg_prog()
+    with pytest.raises(ValueError):
+        fluid.io.DataLoader.from_generator(feed_list=[])
+    loader = fluid.io.DataLoader.from_generator(feed_list=[x, y])
+    with pytest.raises(RuntimeError):
+        iter(loader)                       # source not set
+    ni = fluid.io.DataLoader.from_generator(feed_list=[x, y],
+                                            iterable=False)
+    ni.set_batch_generator(lambda: _gen_batches(1))
+    with pytest.raises(RuntimeError):
+        iter(ni)                           # non-iterable
+    with pytest.raises(RuntimeError):
+        loader.start()                     # iterable
