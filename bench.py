@@ -323,10 +323,14 @@ def _resnet50(batch=128, img=224, steps=40):
     XLA already fuses each conv with its BN-stats reduction and the
     apply+relu+add chains into single passes — the bottleneck 1x1
     convs are themselves bandwidth-bound at these shapes (AI ~50
-    FLOP/B), so the remaining gap to the floor is structural to the
-    conv data movement, not unfused elementwise. Pallas now compiles
-    over the tunnel (r04 typed-literal fixes) but a VMEM-persistent
-    conv+BN block kernel remains future work."""
+    FLOP/B). r05 CLOSED the question: the fused conv+BN Pallas kernel
+    was built (ops/fused_conv.py, numerically exact, fwd+bwd incl.
+    stats cotangents) and measured 0.18-0.88x vs XLA at every
+    bottleneck shape; the 1x1-as-dot_general rewrite measured 2-4x at
+    the chain level but 2200 vs 2708 imgs/s end to end (layout
+    transitions). Leaf-event profiling shows every hot category within
+    ~15% of its own traffic/MXU floor. XLA's compilation of this model
+    is the envelope on this chip; see roofline.note."""
     import jax
 
     from paddle_tpu.optimizer import functional as fopt
@@ -371,15 +375,48 @@ def _resnet50(batch=128, img=224, steps=40):
                 "hbm_floor_imgs_per_sec": round(BATCH / floor_s, 1),
                 "frac_of_hbm_floor": round(v / (BATCH / floor_s), 3),
                 "note": "step is HBM-bound; floor = ideal-folding "
-                        "activation+grad bytes / measured elementwise "
-                        "HBM bandwidth. r04 op-profile: conv fusions "
-                        "(conv + fused BN-stats/apply chains) are ~78% "
-                        "of device time and the 1x1 bottleneck convs "
-                        "are bandwidth-bound at these shapes; the gap "
-                        "to 1.0 is structural conv data movement. "
-                        "Pallas compiles over the tunnel since r04; a "
-                        "VMEM-persistent conv+BN block kernel is the "
-                        "remaining (unbuilt) lever"},
+                        "activation+grad bytes / measured ELEMENTWISE "
+                        "HBM bandwidth — r05 established that floor is "
+                        "MISCALIBRATED low: matmul/conv read streams "
+                        "measure ~925 GB/s effective vs the 669 GB/s "
+                        "elementwise roof, so frac_of_hbm_floor < 1 "
+                        "does not indicate recoverable headroom. r05 "
+                        "leaf-event trace (6-step window): conv "
+                        "fusions ~24% (~= their MXU floor), BN stats "
+                        "convert_reduce ~32% and BN-bwd "
+                        "multiply_subtract ~25% — each within ~15% of "
+                        "its own traffic floor for the passes exact "
+                        "BN training structurally requires. The r04 "
+                        "'unbuilt lever' was BUILT and measured this "
+                        "round: the VMEM-persistent fused "
+                        "scale+relu+matmul+stats Pallas kernel "
+                        "(ops/fused_conv.py) loses 0.18-0.88x to "
+                        "XLA's own dot_general fusions at every "
+                        "bottleneck shape (fused_kernel_ab below), "
+                        "and the 1x1-conv-as-dot_general rewrite wins "
+                        "2-4x chain-level but loses end-to-end (2200 "
+                        "vs 2708 imgs/s: dot/conv layout transitions) "
+                        "— PT_CONV1X1_DOT stays off. Verdict: XLA's "
+                        "conv+BN compilation is at the achievable "
+                        "envelope on this chip; the honest ceiling is "
+                        "the structural BN pass count, not a missing "
+                        "kernel.",
+                "fused_kernel_ab": {
+                    "unit": "ms fwd+bwd, B128",
+                    "shapes": {
+                        "Ci256_Co64_HW3136": {"fused": 1.93,
+                                              "xla": 0.54},
+                        "Ci64_Co256_HW3136": {"fused": 1.42,
+                                              "xla": 0.31},
+                        "Ci512_Co128_HW784": {"fused": 1.06,
+                                              "xla": 0.28},
+                        "Ci128_Co512_HW784": {"fused": 0.70,
+                                              "xla": 0.13},
+                        "Ci1024_Co256_HW196": {"fused": 0.64,
+                                               "xla": 0.13},
+                        "Ci2048_Co512_HW49": {"fused": 1.06,
+                                              "xla": 0.93}},
+                    "conv1x1_as_dot_e2e_imgs_per_sec": 2200}},
             "method": "two-point marginal over jitted multi-step scans on a "
                       "device-resident batch (fixed remote-dispatch latency "
                       "excluded; e2e_value keeps it included)"}
@@ -492,7 +529,7 @@ def _tunnel_profile(sample_bytes=4 << 20):
             "d2h_bw_bytes_per_s": round(d2h_bw)}
 
 
-def _ctr_dnn_ps(batch=4096, chunks=12, merge_k=16):
+def _ctr_dnn_ps(batch=4096, chunks=8, merge_k=32):
     """Config 5: CTR-DNN, async native PS, K-step merged UNIQUE-row wire.
 
     The r03 loop paid THREE fixed-latency tunnel calls per step (row H2D,
@@ -620,10 +657,18 @@ def _ctr_dnn_ps(batch=4096, chunks=12, merge_k=16):
         # payloads. The tunnel's bandwidth varies run to run (measured
         # 5-40 MB/s windows), so the link is profiled directly around
         # the trials. Two ceilings: 'serial' assumes H2D and D2H share
-        # one half-duplex lane; 'duplex' lets the pull (prefetch
-        # thread) and push (readback thread) overlap, which the
-        # pipeline actually does — measured/serial can exceed 1.0 in
-        # slow-link windows precisely because of that overlap.
+        # one lane; 'duplex' would require them to overlap. r05
+        # MEASURED the overlap directly (concurrent device_put +
+        # np.asarray from two threads): the tunnel transport
+        # SERIALIZES — concurrent wall was ~0.88x of serial, far from
+        # max(h2d, d2h) — so 'serial' is the honest ceiling and the
+        # duplex number is recorded only as the transport upper bound.
+        # The r05 lever was therefore BYTES, not overlap: merge_k=32
+        # (from 16) amortizes the fixed calls 2x and deepens the
+        # unique-row dedup (1.05M draws -> 650k unique rows), cutting
+        # wire bytes per example ~30%: 24.9k -> 76k ex/s measured
+        # (K=64: 91k, frac_of_serial 0.78; K=32 keeps staleness in the
+        # reference AsyncCommunicator's regime, max_merge_var_num~20).
         link = _tunnel_profile()
         h2d_bytes = (upad * DIM * 2            # unique rows, bf16
                      + K * BATCH * SLOTS * 4   # inv gather map, int32

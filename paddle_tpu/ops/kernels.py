@@ -10,6 +10,7 @@ reference collapse into single XLA lowerings (SURVEY.md §2.2 TPU note).
 from __future__ import annotations
 
 import math
+import os
 from functools import partial
 
 import numpy as np
@@ -243,6 +244,29 @@ def conv2d(x, w, stride=1, padding=0, dilation=1, groups=1):
     stride = _pair(stride)
     dilation = _pair(dilation)
     pad = _conv_padding(padding, None, stride, dilation)
+    if (groups == 1 and x.ndim == 4 and w.shape[2] == 1
+            and w.shape[3] == 1 and stride == (1, 1)
+            and pad in ("VALID", [(0, 0), (0, 0)])
+            and os.environ.get("PT_CONV1X1_DOT", "0") == "1"):
+        # OFF by default — measured end to end (r05, TPU v5e): 1x1
+        # stride-1 conv as dot_general wins 2-4x at the ISOLATED
+        # conv+BN-chain level (XLA fuses elementwise/reduce chains into
+        # dot_general but treats convolution HLOs as fusion barriers:
+        # einsum chain 0.31-0.54 ms vs conv-form ~1.9 ms at B128
+        # bottleneck shapes, fwd+bwd) — but LOSES in the full model:
+        # ResNet-50 2200 imgs/s vs 2708 with conv HLOs everywhere,
+        # because mixing dot-layout tensors into conv-layout chains
+        # makes XLA insert relayouts between every 1x1/3x3 pair. Same
+        # end-to-end verdict as r04's einsum experiment (2036); kept as
+        # an env-gated path because the chain-level result is real and
+        # a future all-dot or NHWC-native model formulation may flip
+        # it. See also ops/fused_conv.py (the Pallas fused kernel, same
+        # honest outcome) and BENCH_DETAILS resnet50.roofline.
+        jnp = _jnp()
+        B, C, H, W = x.shape
+        z = jnp.einsum("oc,bch->boh", w.reshape(w.shape[0], C),
+                       x.reshape(B, C, H * W))
+        return z.reshape(B, w.shape[0], H, W)
     if (groups == 1 and x.ndim == 4 and x.shape[1] <= 4
             and w.shape[2] * w.shape[3] > 1
             and x.shape[2] * x.shape[3] <= 128 * 128):

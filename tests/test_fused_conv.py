@@ -1,0 +1,114 @@
+"""Fused 1x1-conv+BN Pallas kernel (ops/fused_conv.py): numerical
+exactness vs a pure-jax reference in interpret mode — forward, stats,
+and every gradient INCLUDING the stats cotangents (the BN-chain path) —
+plus the env-gated conv2d 1x1 dot_general form's parity."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.fused_conv import bn_scale_shift, fused_scale_act_mm_stats
+
+
+def _ref(x, sc, sh, w, relu=True):
+    xn = x * sc[None, :, None] + sh[None, :, None]
+    if relu:
+        xn = jnp.maximum(xn, 0.0)
+    z = jnp.einsum("oc,bch->boh", w, xn)
+    return z, z.sum((0, 2)), (z * z).sum((0, 2))
+
+
+@pytest.mark.parametrize("hw", [128, 200])  # 200: masked padded lanes
+def test_fused_fwd_and_grads_exact(hw):
+    B, Ci, Co = 3, 16, 8
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(B, Ci, hw).astype("f4"))
+    sc = jnp.asarray(rs.rand(Ci).astype("f4") + 0.5)
+    sh = jnp.asarray(rs.randn(Ci).astype("f4") * 0.1)
+    w = jnp.asarray(rs.randn(Co, Ci).astype("f4") * 0.2)
+    z, s, ss = fused_scale_act_mm_stats(x, sc, sh, w, relu=True,
+                                        interpret=True)
+    zr, sr, ssr = _ref(x, sc, sh, w)
+    np.testing.assert_allclose(np.asarray(z), np.asarray(zr),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s), np.asarray(sr),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(ss), np.asarray(ssr),
+                               rtol=1e-4, atol=1e-4)
+
+    gd = jnp.asarray(rs.randn(B, Co, hw).astype("f4"))
+    cs = jnp.asarray(rs.randn(Co).astype("f4"))
+    css = jnp.asarray(rs.randn(Co).astype("f4") * 0.01)
+
+    def L(fn):
+        def loss(x, sc, sh, w):
+            z, s, ss = fn(x, sc, sh, w)
+            return (z * gd).sum() + (s * cs).sum() + (ss * css).sum()
+        return loss
+
+    gf = jax.grad(L(lambda *a: fused_scale_act_mm_stats(
+        *a, relu=True, interpret=True)), (0, 1, 2, 3))(x, sc, sh, w)
+    gr = jax.grad(L(_ref), (0, 1, 2, 3))(x, sc, sh, w)
+    for name, a, b in zip("x scale shift w".split(), gf, gr):
+        scale = float(jnp.abs(b).max()) + 1e-6
+        np.testing.assert_allclose(
+            np.asarray(a) / scale, np.asarray(b) / scale,
+            rtol=1e-5, atol=2e-6, err_msg=f"grad {name}")
+
+
+def test_fused_identity_no_relu():
+    B, Ci, Co, HW = 2, 8, 4, 128
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(B, Ci, HW).astype("f4"))
+    w = jnp.asarray(rs.randn(Co, Ci).astype("f4") * 0.2)
+    z, s, ss = fused_scale_act_mm_stats(x, None, None, w, relu=False,
+                                        interpret=True)
+    zr = jnp.einsum("oc,bch->boh", w, x)
+    np.testing.assert_allclose(np.asarray(z), np.asarray(zr),
+                               rtol=1e-5, atol=1e-5)
+    g = jax.grad(lambda x, w: (fused_scale_act_mm_stats(
+        x, None, None, w, relu=False, interpret=True)[0] ** 2).sum(),
+        (0, 1))(x, w)
+    gr = jax.grad(lambda x, w: (jnp.einsum("oc,bch->boh", w, x) ** 2
+                                ).sum(), (0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(g[0]), np.asarray(gr[0]),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(g[1]), np.asarray(gr[1]),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_bn_scale_shift_matches_batchnorm():
+    """bn_scale_shift(gamma, beta, stats) folded into the fused op
+    reproduces BN-train normalize exactly."""
+    B, C, HW = 4, 8, 128
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randn(B, C, HW).astype("f4"))
+    gamma = jnp.asarray(rs.rand(C).astype("f4") + 0.5)
+    beta = jnp.asarray(rs.randn(C).astype("f4"))
+    s = x.sum((0, 2)); ss = (x * x).sum((0, 2))
+    scale, shift, mean, var = bn_scale_shift(gamma, beta, s, ss,
+                                             B * HW, 1e-5)
+    y = x * scale[None, :, None] + shift[None, :, None]
+    m = x.mean((0, 2)); v = x.var((0, 2))
+    want = ((x - m[None, :, None]) / jnp.sqrt(v[None, :, None] + 1e-5)
+            * gamma[None, :, None] + beta[None, :, None])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(m),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_conv1x1_dot_path_parity(monkeypatch):
+    """The env-gated PT_CONV1X1_DOT form is numerically the same conv."""
+    from paddle_tpu.ops import kernels as K
+
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(2, 16, 14, 14).astype("f4"))
+    w = jnp.asarray(rs.randn(8, 16, 1, 1).astype("f4") * 0.2)
+    monkeypatch.delenv("PT_CONV1X1_DOT", raising=False)
+    base = K.conv2d(x, w, stride=1, padding=0)
+    monkeypatch.setenv("PT_CONV1X1_DOT", "1")
+    dot = K.conv2d(x, w, stride=1, padding=0)
+    np.testing.assert_allclose(np.asarray(dot), np.asarray(base),
+                               rtol=1e-4, atol=1e-5)

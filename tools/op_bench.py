@@ -205,7 +205,511 @@ def _configs():
             "cast", lambda b, s: {"X": [_f((B, T, D), "x", b)]},
             {"Out": 1}, {"in_dtype": "float32", "out_dtype": "float16"})),
     ]
+    cfgs += _configs_extended(simple, unary)
     return cfgs
+
+
+def _configs_extended(simple, unary):
+    """r05 widening (VERDICT r04 weak #6): cover the sequence /
+    embedding / fused-CTR / detection / RNN families the bench models
+    actually execute, so the CI regression gate watches the hot paths
+    — reference op_tester.cc configs role. Sequence ops get an
+    in-program int32 lengths companion (name + @@LOD) so the MASKED
+    kernel path is what's timed, not the dense fallback."""
+    B, T, D, H = 32, 128, 768, 1024
+    SB, ST, SD = 64, 50, 64           # sequence family shapes (CTR-ish)
+
+    def _lens(b, name, t=ST, n=SB):
+        v = b.create_var(name=name + "@@LOD")
+        b.append_op(type="randint", inputs={},
+                    outputs={"Out": [v.name]},
+                    attrs={"shape": [n], "low": 1, "high": t + 1,
+                           "dtype": "int32"})
+        return v
+
+    def seq(op, outs=None, attrs=None, extra=None):
+        def build(blk, scope):
+            x = _f((SB, ST, SD), "x", blk)
+            _lens(blk, "x")
+            ins = {"X": [x]}
+            if extra:
+                ins.update(extra(blk, scope))
+            return op, ins, (outs or {"Out": 1}), (attrs or {})
+        return build
+
+    def ew(op):
+        return simple(op, lambda b, s: {"X": [_f((B, T, D), "x", b)],
+                                        "Y": [_f((B, T, D), "y", b)]},
+                      {"Out": 1})
+
+    cfgs = [
+        # ---- sequence family (CTR/NLP hot path) ----
+        ("sequence_pool", seq("sequence_pool", {"Out": 1, "MaxIndex": 1},
+                              {"pooltype": "SUM"})),
+        ("sequence_pool_max", seq("sequence_pool",
+                                  {"Out": 1, "MaxIndex": 1},
+                                  {"pooltype": "MAX"})),
+        ("sequence_softmax", seq("sequence_softmax")),
+        ("sequence_reverse", seq("sequence_reverse", {"Y": 1})),
+        ("sequence_conv", seq(
+            "sequence_conv", {"Out": 1},
+            {"contextLength": 3, "contextStart": -1, "contextStride": 1},
+            extra=lambda b, s: {"Filter": [_p((3 * SD, SD), "scw", b,
+                                              s)]})),
+        ("im2sequence", simple(
+            "im2sequence",
+            lambda b, s: {"X": [_f((8, 16, 28, 28), "x", b)]},
+            {"Out": 1},
+            {"kernels": [3, 3], "strides": [1, 1],
+             "paddings": [0, 0, 0, 0]})),
+        # ---- fused CTR / NLP ops ----
+        ("fusion_gru", seq(
+            "fusion_gru", {"Hidden": 1, "XX": 1},
+            {"activation": "tanh", "gate_activation": "sigmoid",
+             "is_reverse": False},
+            extra=lambda b, s: {"WeightX": [_p((SD, 3 * SD), "wx", b, s)],
+                                "WeightH": [_p((SD, 3 * SD), "wh", b, s)],
+                                "Bias": [_p((3 * SD,), "bg", b, s)]})),
+        ("fusion_lstm", seq(
+            "fusion_lstm", {"Hidden": 1, "Cell": 1, "XX": 1},
+            {"candidate_activation": "tanh", "gate_activation": "sigmoid",
+             "cell_activation": "tanh", "is_reverse": False},
+            extra=lambda b, s: {"WeightX": [_p((SD, 4 * SD), "wx", b, s)],
+                                "WeightH": [_p((SD, 4 * SD), "wh", b, s)],
+                                "Bias": [_p((4 * SD,), "bg", b, s)]})),
+        ("attention_lstm", seq(
+            "attention_lstm",
+            {"Hidden": 1, "Cell": 1, "AttentionedX": 1},
+            {"gate_activation": "sigmoid", "cell_activation": "tanh",
+             "candidate_activation": "tanh"},
+            extra=lambda b, s: {
+                "AttentionWeight": [_p((SD + SD, 1), "aw", b, s)],
+                "AttentionBias": [_p((1,), "ab", b, s)],
+                "LSTMWeight": [_p((SD + SD, 4 * SD), "lw", b, s)],
+                "LSTMBias": [_p((1, 4 * SD), "lb", b, s)]})),
+        ("multihead_matmul", simple(
+            "multihead_matmul",
+            lambda b, s: {"Input": [_f((B, T, D), "x", b)],
+                          "W": [_p((D, 3 * D), "qkvw", b, s)],
+                          "Bias": [_p((3 * D,), "qkvb", b, s)]},
+            {"Out": 1}, {"head_number": 12,
+                         "alpha": 1.0 / 8.0})),
+        ("skip_layernorm", simple(
+            "skip_layernorm",
+            lambda b, s: {"X": [_f((B, T, D), "x", b)],
+                          "Y": [_f((B, T, D), "y", b)],
+                          "Scale": [_p((D,), "g", b, s)],
+                          "Bias": [_p((D,), "bt", b, s)]},
+            {"Out": 1}, {"epsilon": 1e-5})),
+        ("fused_fc_elementwise_layernorm", simple(
+            "fused_fc_elementwise_layernorm",
+            lambda b, s: {"X": [_f((B * T, D), "x", b)],
+                          "W": [_p((D, D), "w", b, s)],
+                          "Y": [_f((B * T, D), "y", b)],
+                          "Scale": [_p((D,), "g", b, s)],
+                          "Bias1": [_p((D,), "b1", b, s)]},
+            {"Out": 1}, {"epsilon": 1e-5, "begin_norm_axis": 1})),
+        # ---- RNN (unfused reference forms): the lengths companion
+        # rides on the op's ACTUAL sequence input slot (Input/"xg") so
+        # the masked recurrence is what gets timed ----
+        ("lstm", _rnn_cfg("lstm", 4, SB, ST, SD,
+                          {"Hidden": 1, "Cell": 1, "BatchGate": 1,
+                           "BatchCellPreAct": 1},
+                          {"use_peepholes": False,
+                           "gate_activation": "sigmoid",
+                           "cell_activation": "tanh",
+                           "candidate_activation": "tanh"})),
+        ("gru", _rnn_cfg("gru", 3, SB, ST, SD,
+                         {"Hidden": 1, "BatchGate": 1,
+                          "BatchResetHiddenPrev": 1},
+                         {"activation": "tanh",
+                          "gate_activation": "sigmoid",
+                          "is_reverse": False})),
+        # ---- conv / vision family ----
+        ("conv2d_1x1", simple(
+            "conv2d", lambda b, s: {"Input": [_f((16, 256, 56, 56),
+                                                 "x", b)],
+                                    "Filter": [_p((64, 256, 1, 1),
+                                                  "w", b, s)]},
+            {"Output": 1},
+            {"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+             "groups": 1})),
+        ("conv2d_s2", simple(
+            "conv2d", lambda b, s: {"Input": [_f((16, 128, 56, 56),
+                                                 "x", b)],
+                                    "Filter": [_p((128, 128, 3, 3),
+                                                  "w", b, s)]},
+            {"Output": 1},
+            {"strides": [2, 2], "paddings": [1, 1], "dilations": [1, 1],
+             "groups": 1})),
+        ("conv2d_transpose", simple(
+            "conv2d_transpose",
+            lambda b, s: {"Input": [_f((8, 128, 28, 28), "x", b)],
+                          "Filter": [_p((128, 64, 2, 2), "w", b, s)]},
+            {"Output": 1},
+            {"strides": [2, 2], "paddings": [0, 0], "dilations": [1, 1],
+             "groups": 1})),
+        ("pool2d_avg", simple(
+            "pool2d", lambda b, s: {"X": [_f((16, 64, 56, 56), "x", b)]},
+            {"Out": 1},
+            {"pooling_type": "avg", "ksize": [3, 3], "strides": [2, 2],
+             "paddings": [1, 1]})),
+        ("pool2d_global", simple(
+            "pool2d", lambda b, s: {"X": [_f((16, 2048, 7, 7), "x", b)]},
+            {"Out": 1},
+            {"pooling_type": "avg", "ksize": [1, 1],
+             "global_pooling": True})),
+        ("bilinear_interp_v2", simple(
+            "bilinear_interp_v2",
+            lambda b, s: {"X": [_f((8, 64, 28, 28), "x", b)]},
+            {"Out": 1},
+            {"out_h": 56, "out_w": 56, "interp_method": "bilinear",
+             "align_corners": False, "data_layout": "NCHW"})),
+        ("nearest_interp_v2", simple(
+            "nearest_interp_v2",
+            lambda b, s: {"X": [_f((8, 64, 28, 28), "x", b)]},
+            {"Out": 1},
+            {"out_h": 56, "out_w": 56, "interp_method": "nearest",
+             "align_corners": False, "data_layout": "NCHW"})),
+        ("grid_sampler", simple(
+            "grid_sampler",
+            lambda b, s: {"X": [_f((8, 32, 28, 28), "x", b)],
+                          "Grid": [_f((8, 28, 28, 2), "g", b)]},
+            {"Output": 1}, {"mode": "bilinear",
+                            "padding_mode": "zeros",
+                            "align_corners": True})),
+        ("affine_channel", simple(
+            "affine_channel",
+            lambda b, s: {"X": [_f((16, 64, 56, 56), "x", b)],
+                          "Scale": [_p((64,), "g", b, s)],
+                          "Bias": [_p((64,), "bt", b, s)]},
+            {"Out": 1}, {"data_layout": "NCHW"})),
+        ("pixel_shuffle", simple(
+            "pixel_shuffle",
+            lambda b, s: {"X": [_f((8, 64, 28, 28), "x", b)]},
+            {"Out": 1}, {"upscale_factor": 2})),
+        ("shuffle_channel", simple(
+            "shuffle_channel",
+            lambda b, s: {"X": [_f((8, 64, 28, 28), "x", b)]},
+            {"Out": 1}, {"group": 4})),
+        ("pad2d", simple(
+            "pad2d", lambda b, s: {"X": [_f((16, 64, 56, 56), "x", b)]},
+            {"Out": 1}, {"paddings": [1, 1, 1, 1], "mode": "constant",
+                         "pad_value": 0.0, "data_format": "NCHW"})),
+        ("instance_norm", simple(
+            "instance_norm",
+            lambda b, s: {"X": [_f((16, 64, 28, 28), "x", b)],
+                          "Scale": [_p((64,), "g", b, s)],
+                          "Bias": [_p((64,), "bt", b, s)]},
+            {"Y": 1, "SavedMean": 1, "SavedVariance": 1},
+            {"epsilon": 1e-5})),
+        ("group_norm", simple(
+            "group_norm",
+            lambda b, s: {"X": [_f((16, 64, 28, 28), "x", b)],
+                          "Scale": [_p((64,), "g", b, s)],
+                          "Bias": [_p((64,), "bt", b, s)]},
+            {"Y": 1, "Mean": 1, "Variance": 1},
+            {"epsilon": 1e-5, "groups": 8})),
+        # ---- detection family ----
+        ("prior_box", simple(
+            "prior_box",
+            lambda b, s: {"Input": [_f((8, 64, 28, 28), "x", b)],
+                          "Image": [_f((8, 3, 224, 224), "img", b)]},
+            {"Boxes": 1, "Variances": 1},
+            {"min_sizes": [32.0], "max_sizes": [64.0],
+             "aspect_ratios": [1.0, 2.0], "flip": True, "clip": True,
+             "variances": [0.1, 0.1, 0.2, 0.2], "step_w": 0.0,
+             "step_h": 0.0, "offset": 0.5})),
+        ("box_coder", simple(
+            "box_coder",
+            lambda b, s: {"PriorBox": [_f((4096, 4), "pb", b)],
+                          "TargetBox": [_f((4096, 4), "tb", b)]},
+            {"OutputBox": 1},
+            {"code_type": "decode_center_size", "box_normalized": True,
+             "variance": [0.1, 0.1, 0.2, 0.2]})),
+        ("iou_similarity", simple(
+            "iou_similarity",
+            lambda b, s: {"X": [_f((1024, 4), "x", b)],
+                          "Y": [_f((256, 4), "y", b)]},
+            {"Out": 1}, {"box_normalized": True})),
+        # ---- losses ----
+        ("sigmoid_cross_entropy_with_logits", simple(
+            "sigmoid_cross_entropy_with_logits",
+            lambda b, s: {"X": [_f((B * T, 80), "x", b)],
+                          "Label": [_f((B * T, 80), "lbl", b)]},
+            {"Out": 1}, {"normalize": False})),
+        ("smooth_l1_loss", simple(
+            "smooth_l1_loss",
+            lambda b, s: {"X": [_f((4096, 4), "x", b)],
+                          "Y": [_f((4096, 4), "y", b)]},
+            {"Out": 1, "Diff": 1}, {"sigma": 1.0})),
+        ("huber_loss", simple(
+            "huber_loss",
+            lambda b, s: {"X": [_f((4096, 1), "x", b)],
+                          "Y": [_f((4096, 1), "y", b)]},
+            {"Out": 1, "Residual": 1}, {"delta": 1.0})),
+        ("bce_loss", simple(
+            "bce_loss",
+            lambda b, s: {"X": [_sig01(b, (B * T, 1), "x")],
+                          "Label": [_sig01(b, (B * T, 1), "lbl")]},
+            {"Out": 1})),
+        ("kldiv_loss", simple(
+            "kldiv_loss",
+            lambda b, s: {"X": [_f((B, T), "x", b)],
+                          "Target": [_sig01(b, (B, T), "t")]},
+            {"Loss": 1}, {"reduction": "mean"})),
+        ("log_softmax", simple(
+            "log_softmax", lambda b, s: {"X": [_f((B, T, D), "x", b)]},
+            {"Out": 1}, {"axis": -1})),
+        ("cross_entropy", simple(
+            "cross_entropy",
+            lambda b, s: {"X": [_softmaxed(b, (B * T, 128), "x")],
+                          "Label": [_i((B * T, 1), "lbl", b, high=128)]},
+            {"Y": 1}, {"soft_label": False})),
+        ("label_smooth", simple(
+            "label_smooth",
+            lambda b, s: {"X": [_sig01(b, (B * T, 128), "x")]},
+            {"Out": 1}, {"epsilon": 0.1})),
+        ("squared_l2_norm", simple(
+            "squared_l2_norm",
+            lambda b, s: {"X": [_f((B * T, D), "x", b)]}, {"Out": 1})),
+        # ---- elementwise / math breadth ----
+        ("elementwise_sub", ew("elementwise_sub")),
+        ("elementwise_div", ew("elementwise_div")),
+        ("elementwise_max", ew("elementwise_max")),
+        ("elementwise_min", ew("elementwise_min")),
+        ("elementwise_pow", simple(
+            "elementwise_pow",
+            lambda b, s: {"X": [_sig01(b, (B, T, D), "x")],
+                          "Y": [_sig01(b, (B, T, D), "y")]}, {"Out": 1})),
+        ("clip", simple(
+            "clip", lambda b, s: {"X": [_f((B, T, D), "x", b)]},
+            {"Out": 1}, {"min": -0.5, "max": 0.5})),
+        ("abs", unary("abs")),
+        ("log", simple(
+            "log", lambda b, s: {"X": [_sig01(b, (B, T, D), "x")]},
+            {"Out": 1})),
+        ("rsqrt", simple(
+            "rsqrt", lambda b, s: {"X": [_sig01(b, (B, T, D), "x")]},
+            {"Out": 1})),
+        ("square", unary("square")),
+        ("floor", unary("floor")),
+        ("softplus", unary("softplus")),
+        ("softsign", unary("softsign")),
+        ("leaky_relu", simple(
+            "leaky_relu", lambda b, s: {"X": [_f((B, T, D), "x", b)]},
+            {"Out": 1}, {"alpha": 0.1})),
+        ("relu6", unary("relu6")),
+        ("hard_swish", unary("hard_swish")),
+        ("hard_sigmoid", unary("hard_sigmoid")),
+        ("swish", simple(
+            "swish", lambda b, s: {"X": [_f((B, T, D), "x", b)]},
+            {"Out": 1}, {"beta": 1.0})),
+        ("mish", unary("mish")),
+        ("elu", unary("elu")),
+        ("sign", unary("sign")),
+        ("mean", simple(
+            "mean", lambda b, s: {"X": [_f((B, T, D), "x", b)]},
+            {"Out": 1})),
+        ("cumsum", simple(
+            "cumsum", lambda b, s: {"X": [_f((B, T, D), "x", b)]},
+            {"Out": 1}, {"axis": -1})),
+        ("sum3", simple(
+            "sum", lambda b, s: {"X": [_f((B, T, D), "x", b),
+                                       _f((B, T, D), "y", b),
+                                       _f((B, T, D), "z", b)]},
+            {"Out": 1})),
+        # ---- shape / indexing breadth ----
+        ("matmul_v2", simple(
+            "matmul_v2", lambda b, s: {"X": [_f((B, T, D), "x", b)],
+                                       "Y": [_p((D, D), "w", b, s)]},
+            {"Out": 1}, {"trans_x": False, "trans_y": False})),
+        ("bmm", simple(
+            "bmm", lambda b, s: {"X": [_f((B * 12, T, 64), "x", b)],
+                                 "Y": [_f((B * 12, 64, T), "y", b)]},
+            {"Out": 1})),
+        ("stack", simple(
+            "stack", lambda b, s: {"X": [_f((B, T), "x", b),
+                                        _f((B, T), "y", b),
+                                        _f((B, T), "z", b)]},
+            {"Y": 1}, {"axis": 0})),
+        ("tile", simple(
+            "tile", lambda b, s: {"X": [_f((B, T), "x", b)]},
+            {"Out": 1}, {"repeat_times": [1, 4]})),
+        ("expand_v2", simple(
+            "expand_v2", lambda b, s: {"X": [_f((B, 1, D), "x", b)]},
+            {"Out": 1}, {"shape": [B, T, D]})),
+        ("flatten2", simple(
+            "flatten2", lambda b, s: {"X": [_f((B, T, D), "x", b)]},
+            {"Out": 1, "XShape": 1}, {"axis": 2})),
+        ("squeeze2", simple(
+            "squeeze2", lambda b, s: {"X": [_f((B, 1, T, D), "x", b)]},
+            {"Out": 1, "XShape": 1}, {"axes": [1]})),
+        ("unsqueeze2", simple(
+            "unsqueeze2", lambda b, s: {"X": [_f((B, T, D), "x", b)]},
+            {"Out": 1, "XShape": 1}, {"axes": [1]})),
+        ("strided_slice", simple(
+            "strided_slice",
+            lambda b, s: {"Input": [_f((B, T, D), "x", b)]},
+            {"Out": 1},
+            {"axes": [1], "starts": [0], "ends": [T], "strides": [2]})),
+        ("gather_nd", simple(
+            "gather_nd",
+            lambda b, s: {"X": [_f((512, 512), "x", b)],
+                          "Index": [_i((4096, 2), "ids", b, high=512)]},
+            {"Out": 1})),
+        ("scatter", simple(
+            "scatter",
+            lambda b, s: {"X": [_f((30000, 64), "x", b)],
+                          "Ids": [_i((4096,), "ids", b, high=30000)],
+                          "Updates": [_f((4096, 64), "u", b)]},
+            {"Out": 1}, {"overwrite": False})),
+        ("scatter_nd_add", simple(
+            "scatter_nd_add",
+            lambda b, s: {"X": [_f((512, 512), "x", b)],
+                          "Index": [_i((4096, 2), "ids", b, high=512)],
+                          "Updates": [_f((4096,), "u", b)]},
+            {"Out": 1})),
+        ("index_select", simple(
+            "index_select",
+            lambda b, s: {"X": [_f((30000, 64), "x", b)],
+                          "Index": [_i((4096,), "ids", b, high=30000)]},
+            {"Out": 1}, {"dim": 0})),
+        ("one_hot_v2", simple(
+            "one_hot_v2",
+            lambda b, s: {"X": [_i((B * T,), "ids", b, high=128)]},
+            {"Out": 1}, {"depth": 128})),
+        ("lookup_table", simple(
+            "lookup_table",
+            lambda b, s: {"Ids": [_i((B * T, 1), "ids", b, high=30000)],
+                          "W": [_p((30000, D), "emb", b, s)]},
+            {"Out": 1})),
+        ("arg_max", simple(
+            "arg_max", lambda b, s: {"X": [_f((B, 30000), "x", b)]},
+            {"Out": 1}, {"axis": -1})),
+        ("argsort", simple(
+            "argsort", lambda b, s: {"X": [_f((B, 4096), "x", b)]},
+            {"Out": 1, "Indices": 1}, {"axis": -1})),
+    ]
+    cfgs += _configs_special()
+    return cfgs
+
+
+def _rnn_cfg(op, gates, SB, ST, SD, outs, attrs):
+    def build(blk, scope):
+        xg = _f((SB, ST, gates * SD), "xg", blk)
+        lv = blk.create_var(name="xg@@LOD")
+        blk.append_op(type="randint", inputs={},
+                      outputs={"Out": [lv.name]},
+                      attrs={"shape": [SB], "low": 1, "high": ST + 1,
+                             "dtype": "int32"})
+        return op, {"Input": [xg],
+                    "Weight": [_p((SD, gates * SD), "w", blk, scope)],
+                    "Bias": [_p((1, gates * SD), "bias", blk, scope)]}, \
+            outs, attrs
+    return build
+
+
+def _sig01(blk, shape, name):
+    """uniform(0.05, 0.95) input (ops needing (0,1) or positive data)."""
+    v = blk.create_var(name=name)
+    blk.append_op(type="uniform_random", inputs={},
+                  outputs={"Out": [v.name]},
+                  attrs={"shape": list(shape), "min": 0.05, "max": 0.95,
+                         "dtype": "float32"})
+    return v.name
+
+
+def _softmaxed(blk, shape, name):
+    raw = _f(shape, name + "_raw", blk)
+    v = blk.create_var(name=name)
+    blk.append_op(type="softmax", inputs={"X": [raw]},
+                  outputs={"Out": [v.name]}, attrs={"axis": -1})
+    return v.name
+
+
+def _configs_special():
+    """Configs needing bespoke graph construction."""
+    B, T, D = 32, 128, 768
+    SB, ST, SD = 64, 50, 64
+
+    def where_build(blk, scope):
+        x = _f((B, T, D), "x", blk)
+        y = _f((B, T, D), "y", blk)
+        c = blk.create_var(name="cond")
+        blk.append_op(type="greater_than",
+                      inputs={"X": [x], "Y": [y]},
+                      outputs={"Out": [c.name]}, attrs={})
+        return "where", {"Condition": [c.name], "X": [x], "Y": [y]}, \
+            {"Out": 1}, {}
+
+    def seqpool_concat_build(blk, scope):
+        ins = []
+        for i in range(4):
+            x = _f((SB, ST, SD), f"x{i}", blk)
+            lv = blk.create_var(name=f"x{i}@@LOD")
+            blk.append_op(type="randint", inputs={},
+                          outputs={"Out": [lv.name]},
+                          attrs={"shape": [SB], "low": 1, "high": ST + 1,
+                                 "dtype": "int32"})
+            ins.append(x)
+        return "fusion_seqpool_concat", {"X": ins}, {"Out": 1}, \
+            {"pooltype": "SUM", "axis": 1}
+
+    def seq_expand_build(blk, scope):
+        x = _f((SB, 1, SD), "x", blk)
+        y = _f((SB, ST, SD), "y", blk)
+        for n, hi in (("x", 2), ("y", ST + 1)):
+            lv = blk.create_var(name=f"{n}@@LOD")
+            blk.append_op(type="randint", inputs={},
+                          outputs={"Out": [lv.name]},
+                          attrs={"shape": [SB], "low": 1, "high": hi,
+                                 "dtype": "int32"})
+        return "sequence_expand", {"X": [x], "Y": [y]}, {"Out": 1}, \
+            {"ref_level": 0}
+
+    def seq_mask_build(blk, scope):
+        ids = _i((SB,), "lens", blk, high=ST)
+        return "sequence_mask", {"X": [ids]}, {"Y": 1}, \
+            {"maxlen": ST, "out_dtype": "float32"}
+
+    def yolo_build(blk, scope):
+        x = _f((8, 255, 13, 13), "x", blk)
+        sz = blk.create_var(name="imgsz")
+        blk.append_op(type="randint", inputs={},
+                      outputs={"Out": [sz.name]},
+                      attrs={"shape": [8, 2], "low": 416, "high": 417,
+                             "dtype": "int32"})
+        return "yolo_box", {"X": [x], "ImgSize": [sz.name]}, \
+            {"Boxes": 1, "Scores": 1}, \
+            {"anchors": [10, 13, 16, 30, 33, 23], "class_num": 80,
+             "conf_thresh": 0.01, "downsample_ratio": 32,
+             "clip_bbox": True}
+
+    def box_clip_build(blk, scope):
+        boxes = _f((2048, 4), "bx", blk)
+        info = blk.create_var(name="iminfo")
+        blk.append_op(type="uniform_random", inputs={},
+                      outputs={"Out": [info.name]},
+                      attrs={"shape": [1, 3], "min": 224.0, "max": 225.0,
+                             "dtype": "float32"})
+        return "box_clip", {"Input": [boxes], "ImInfo": [info.name]}, \
+            {"Output": 1}, {}
+
+    def seq_enum_build(blk, scope):
+        ids = _i((2048, 1), "ids", blk, high=30000)
+        return "sequence_enumerate", {"X": [ids]}, {"Out": 1}, \
+            {"win_size": 2, "pad_value": 0}
+
+    return [
+        ("where", where_build),
+        ("fusion_seqpool_concat", seqpool_concat_build),
+        ("sequence_expand", seq_expand_build),
+        ("sequence_mask", seq_mask_build),
+        ("yolo_box", yolo_build),
+        ("box_clip", box_clip_build),
+        ("sequence_enumerate", seq_enum_build),
+    ]
 
 
 def bench_one(name, builder, steps=30):
