@@ -154,6 +154,12 @@ def _declare(lib):
         "pt_pred_set_input": (None, [c.c_void_p, c.c_char_p,
                                      c.POINTER(c.c_int64), c.c_int,
                                      c.POINTER(c.c_float)]),
+        "pt_pred_set_input_i64": (None, [c.c_void_p, c.c_char_p,
+                                         c.POINTER(c.c_int64), c.c_int,
+                                         c.POINTER(c.c_int64)]),
+        "pt_pred_set_input_lod": (c.c_int, [c.c_void_p, c.c_char_p,
+                                            c.POINTER(c.c_int64),
+                                            c.c_int]),
         "pt_pred_run": (c.c_int, [c.c_void_p]),
         "pt_pred_out_ndim": (c.c_int, [c.c_void_p, c.c_int]),
         "pt_pred_out_dims": (None, [c.c_void_p, c.c_int,
@@ -484,13 +490,35 @@ class NativePredictorHandle:
                 for i in range(n)]
 
     def run(self, feeds):
-        """feeds: {name: float32 ndarray} → list of output ndarrays."""
+        """feeds: {name: ndarray (f32 or int) | LoDTensor} → list of
+        output ndarrays. LoDTensor feeds ship as packed rows + level-1
+        offsets so the sequence kernels (sequence_pool, attention_lstm)
+        see real sequence structure."""
+        from .lod import LoDTensor
+
         for name, arr in feeds.items():
-            arr = np.ascontiguousarray(arr, dtype=np.float32)
+            lod = None
+            if isinstance(arr, LoDTensor):
+                lod = np.asarray(arr.lod()[-1], np.int64)
+                arr = np.asarray(arr)
+            arr = np.ascontiguousarray(arr)
             dims = (ctypes.c_int64 * arr.ndim)(*arr.shape)
-            self._lib.pt_pred_set_input(
-                self._h, name.encode(), dims, arr.ndim,
-                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            if np.issubdtype(arr.dtype, np.integer):
+                arr = np.ascontiguousarray(arr, dtype=np.int64)
+                self._lib.pt_pred_set_input_i64(
+                    self._h, name.encode(), dims, arr.ndim,
+                    arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            else:
+                arr = np.ascontiguousarray(arr, dtype=np.float32)
+                self._lib.pt_pred_set_input(
+                    self._h, name.encode(), dims, arr.ndim,
+                    arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            if lod is not None:
+                offs = np.ascontiguousarray(lod, np.int64)
+                self._lib.pt_pred_set_input_lod(
+                    self._h, name.encode(),
+                    offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    len(offs))
         if self._lib.pt_pred_run(self._h) != 0:
             raise RuntimeError(
                 "native predictor run failed: "

@@ -134,11 +134,22 @@ def _ps_hooks(program):
 
 def run_from_dataset(executor, program, dataset, fetch_list=None,
                      fetch_info=None, print_period=100,
-                     prefetch_depth=4):
+                     prefetch_depth=4, dump_fields=None,
+                     dump_fields_path=None):
     if dataset is None:
         raise ValueError("dataset is required")
     fetch_names = [f.name if hasattr(f, "name") else f
                    for f in (fetch_list or [])]
+    dump_names = [f.name if hasattr(f, "name") else f
+                  for f in (dump_fields or [])]
+    dump_file = None
+    if dump_names:
+        import os
+
+        if not dump_fields_path:
+            raise ValueError("dump_fields needs dump_fields_path")
+        os.makedirs(dump_fields_path, exist_ok=True)
+        dump_file = open(os.path.join(dump_fields_path, "part-0"), "w")
 
     q = queue.Queue(maxsize=prefetch_depth)
     _END = object()
@@ -184,8 +195,33 @@ def run_from_dataset(executor, program, dataset, fetch_list=None,
             batch = q.get()
             if batch is _END:
                 break
-            out = executor.run(program, feed=batch,
-                               fetch_list=fetch_list)
+            out = executor.run(program,
+                               feed=batch,
+                               fetch_list=(list(fetch_list or [])
+                                           + dump_names))
+            if dump_file is not None:
+                import numpy as _np
+
+                n_fetch = len(fetch_names)
+                dump_vals = [
+                    _np.asarray(v).reshape(
+                        _np.asarray(v).shape[0] if _np.asarray(v).ndim
+                        else 1, -1)
+                    for v in out[n_fetch:]]
+                rows = {v.shape[0] for v in dump_vals}
+                if len(rows) > 1:
+                    raise ValueError(
+                        "dump_fields must all be per-instance (same "
+                        "leading dim); got "
+                        + str({n: v.shape[0] for n, v in
+                               zip(dump_names, dump_vals)}))
+                for r in range(rows.pop() if rows else 0):
+                    cols = "\t".join(
+                        f"{n}:{v.shape[1]}:"
+                        + " ".join(repr(float(x)) for x in v[r])
+                        for n, v in zip(dump_names, dump_vals))
+                    dump_file.write(f"{step}_{r}\t{cols}\n")
+                out = out[:n_fetch]
             if fetch_names and print_period and \
                     step % print_period == 0:
                 info = fetch_info or fetch_names
@@ -195,6 +231,8 @@ def run_from_dataset(executor, program, dataset, fetch_list=None,
     finally:
         stop.set()
         t.join(timeout=5.0)
+        if dump_file is not None:
+            dump_file.close()
         plane_errs = [e for e in (p.close() for p in planes)
                       if e is not None]
     if err:

@@ -225,6 +225,20 @@ class Executor:
 
         feed_vals = {}
         for k, v in feed.items():
+            if (isinstance(v, tuple) and len(v) == 2
+                    and getattr(blk.vars.get(k), "lod_level", 0)):
+                # dataset-engine lod slot: (flat values, level offsets)
+                # — the native datafeed's wire form (dataset.py
+                # _iter_batches); repack as a LoDTensor at the edge.
+                # Guarded on the TARGET VAR being lod-typed so an
+                # ordinary 2-tuple feed still densifies via np.asarray
+                vals, offs = v
+                offs = np.asarray(offs)
+                if (offs.ndim == 1 and offs.size >= 1
+                        and np.issubdtype(offs.dtype, np.integer)):
+                    vals = np.asarray(vals)
+                    v = LoDTensor(vals.reshape(int(offs[-1]), -1),
+                                  lod=[offs.tolist()])
             if isinstance(v, Tensor):
                 feed_vals[k] = v._data
             elif isinstance(v, LoDTensor) and v.lod_level > 0:
@@ -422,11 +436,19 @@ class Executor:
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           dump_fields=None, dump_fields_path=None):
+        """dump_fields/dump_fields_path: per-INSTANCE feature dump for
+        ads debugging (trainer_desc.proto:39-42 dump_fields/dump_param,
+        DeviceWorker::DumpField role): every listed var's per-row
+        values are appended to <dump_fields_path>/part-0, one line per
+        instance: `<step>_<row>\\tname:n:v1 v2 ...`."""
         from .dataset_runner import run_from_dataset
 
         return run_from_dataset(self, program, dataset, fetch_list,
-                                fetch_info, print_period)
+                                fetch_info, print_period,
+                                dump_fields=dump_fields,
+                                dump_fields_path=dump_fields_path)
 
     def infer_from_dataset(self, *args, **kwargs):
         return self.train_from_dataset(*args, **kwargs)

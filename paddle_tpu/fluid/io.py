@@ -96,33 +96,63 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     from ..core.native import save_combine
 
     main_program = main_program or default_main_program()
-    os.makedirs(dirname, exist_ok=True)
     pruned = main_program._prune(target_vars)
     pruned = pruned.clone(for_test=True)
+    needed = {v.name for v in pruned.global_block().vars.values()
+              if v.persistable}
+    return _write_model_artifact(
+        dirname, pruned, feeded_var_names, target_vars,
+        params_from=main_program,
+        param_filter=(lambda k: k in needed),
+        model_filename=model_filename, params_filename=params_filename,
+        program_only=program_only)
+
+
+def _write_model_artifact(dirname, program, feed_names, fetch_vars,
+                          params_from=None, param_filter=None,
+                          model_filename=None, params_filename=None,
+                          program_only=False):
+    """Shared __model__ (InferenceModel proto) + __params__ (PTC1)
+    writer behind save_inference_model and save_train_model."""
+    from ..core import program_pb
+    from ..core.native import save_combine
+
+    os.makedirs(dirname, exist_ok=True)
     fetch_names = [t.name if hasattr(t, "name") else t
-                   for t in target_vars]
+                   for t in fetch_vars]
     m = program_pb.messages()
     model = m.InferenceModel()
-    model.program.CopyFrom(program_pb.program_to_proto(pruned))
-    model.feed_names.extend(list(feeded_var_names))
+    model.program.CopyFrom(program_pb.program_to_proto(program))
+    model.feed_names.extend(list(feed_names))
     model.fetch_names.extend(fetch_names)
     with open(os.path.join(dirname, model_filename or "__model__"),
               "wb") as f:
         f.write(model.SerializeToString())
     if not program_only:
-        vals = _collect_persistables(main_program, global_scope())
-        needed = {v.name for v in pruned.global_block().vars.values()
-                  if v.persistable}
-        arrays = {}
-        for k, (dt, arr) in vals.items():
-            if k not in needed:
-                continue
-            # PTC1 stores bf16 payloads as f32 (dt tag preserved on load
-            # via var dtype in the program)
-            arrays[k] = arr
-        save_combine(os.path.join(dirname, params_filename or "__params__"),
+        vals = _collect_persistables(params_from or program,
+                                     global_scope())
+        # PTC1 stores bf16 payloads as f32 (dt tag preserved on load
+        # via var dtype in the program)
+        arrays = {k: arr for k, (dt, arr) in vals.items()
+                  if param_filter is None or param_filter(k)}
+        save_combine(os.path.join(dirname,
+                                  params_filename or "__params__"),
                      arrays)
     return fetch_names
+
+
+def save_train_model(dirname, feeded_var_names, fetch_vars, executor,
+                     main_program=None):
+    """Writes the pure-C++ TRAINING artifact (reference: fluid/train/
+    test_train_recognize_digits.cc loads a program saved by a Python
+    authoring script and trains with no Python): same __model__ +
+    __params__ format as save_inference_model but WITHOUT pruning or
+    for_test cloning — the jax_autodiff backward op and the sgd update
+    ops stay in the block, and the native executor's grad-kernel
+    registry interprets them (csrc/ptcore/executor.cc jax_autodiff)."""
+    main_program = main_program or default_main_program()
+    return _write_model_artifact(dirname, main_program,
+                                 feeded_var_names, fetch_vars)
 
 
 def load_inference_model(dirname, executor, model_filename=None,

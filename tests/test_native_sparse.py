@@ -1,0 +1,115 @@
+"""Native-engine serving of the industrial sparse/sequence family
+(VERDICT r04 missing #4): a CTR-DNN (lookup_table + sequence_pool +
+concat + fc) and an attention_lstm artifact served by the C++
+NaiveExecutor must match the XLA engine. Reference:
+operators/lookup_table_op.cc, sequence_ops/sequence_pool_op.cc,
+attention_lstm_op.cc served through framework/naive_executor.h."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.core.lod import LoDTensor
+
+
+def _seq_ids(rs, n_seq, max_len, vocab):
+    lens = rs.randint(1, max_len + 1, n_seq)
+    rows = rs.randint(0, vocab, (int(lens.sum()), 1)).astype("i8")
+    return LoDTensor.from_sequences(
+        [rows[int(lens[:i].sum()):int(lens[:i + 1].sum())]
+         for i in range(n_seq)])
+
+
+def test_native_ctr_dnn_matches_xla(tmp_path):
+    V, D, SLOTS = 100, 8, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        pooled = []
+        seqs = []
+        for i in range(SLOTS):
+            ids = fluid.layers.data(f"slot{i}", [1], dtype="int64",
+                                    lod_level=1)
+            seqs.append(ids)
+            emb = fluid.layers.embedding(ids, size=[V, D])
+            pooled.append(fluid.layers.sequence_pool(emb, "sum"))
+        feat = fluid.layers.concat(pooled, axis=1)
+        h = fluid.layers.fc(feat, 16, act="relu")
+        pred = fluid.layers.fc(h, 1, act="sigmoid")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rs = np.random.RandomState(0)
+    feeds = {f"slot{i}": _seq_ids(rs, 4, 5, V) for i in range(SLOTS)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        want = np.asarray(exe.run(main, feeds, [pred])[0])
+        mdir = str(tmp_path / "ctr")
+        fluid.io.save_inference_model(
+            mdir, [f"slot{i}" for i in range(SLOTS)], [pred], exe,
+            main_program=main)
+    from paddle_tpu.core.native import NativePredictorHandle
+
+    h = NativePredictorHandle(mdir)
+    got = h.run(feeds)[0]
+    np.testing.assert_allclose(np.asarray(got).reshape(want.shape),
+                               want, rtol=2e-5, atol=2e-6)
+
+
+def test_native_sequence_pool_types(tmp_path):
+    V, D = 50, 6
+    for pooltype in ("sum", "average", "max", "sqrt", "first", "last"):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main,
+                                                            startup):
+            ids = fluid.layers.data("ids", [1], dtype="int64",
+                                    lod_level=1)
+            emb = fluid.layers.embedding(ids, size=[V, D])
+            out = fluid.layers.sequence_pool(emb, pooltype)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        rs = np.random.RandomState(3)
+        feed = {"ids": _seq_ids(rs, 5, 4, V)}
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            want = np.asarray(exe.run(main, feed, [out])[0])
+            mdir = str(tmp_path / f"sp_{pooltype}")
+            fluid.io.save_inference_model(mdir, ["ids"], [out], exe,
+                                          main_program=main)
+        from paddle_tpu.core.native import NativePredictorHandle
+
+        h = NativePredictorHandle(mdir)
+        got = h.run(feed)[0]
+        np.testing.assert_allclose(
+            np.asarray(got).reshape(want.shape), want,
+            rtol=2e-5, atol=2e-6, err_msg=pooltype)
+
+
+def test_native_attention_lstm_matches_xla(tmp_path):
+    import paddle_tpu.fluid.nets as nets
+    from paddle_tpu.fluid.ir import apply_pass
+
+    T, M, D = 5, 6, 4
+    main, startup = fluid.Program(), fluid.Program()
+    exe = fluid.Executor()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [1, M], dtype="float32", lod_level=1)
+        hidden, cell = nets.attention_lstm(x, size=D)
+    scope = fluid.Scope()
+    rs = np.random.RandomState(7)
+    lens = [3, 5, 2]
+    xv = LoDTensor.from_sequences(
+        [rs.randn(L, M).astype("f4") for L in lens])
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        apply_pass(main, "attention_lstm_fuse_pass", scope=scope)
+        types = [o.type for o in main.global_block().ops]
+        assert "attention_lstm" in types, types
+        want_h = np.asarray(exe.run(main, {"x": xv}, [hidden],
+                                    return_numpy=False)[0])
+        mdir = str(tmp_path / "attn")
+        fluid.io.save_inference_model(mdir, ["x"], [hidden], exe,
+                                      main_program=main)
+    from paddle_tpu.core.native import NativePredictorHandle
+
+    h = NativePredictorHandle(mdir)
+    got = h.run({"x": xv})[0]
+    np.testing.assert_allclose(np.asarray(got).reshape(want_h.shape),
+                               want_h, rtol=5e-4, atol=5e-5)

@@ -1,0 +1,85 @@
+"""Pure-C++ training entry (VERDICT r04 missing #5; reference:
+fluid/train/test_train_recognize_digits.cc): Python only AUTHORS the
+training program artifact (save_train_model keeps jax_autodiff + sgd in
+the block); the training loop itself is csrc/ptcore/train_demo.cc — a
+C program against the flat C ABI, no Python in the loop."""
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_convnet_train_prog():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [1, 28, 28], dtype="float32")
+        label = fluid.layers.data("label", [1], dtype="int64")
+        c1 = fluid.layers.conv2d(img, 8, 5, padding=2, act="relu")
+        p1 = fluid.layers.pool2d(c1, 2, pool_type="max", pool_stride=2)
+        c2 = fluid.layers.conv2d(p1, 16, 5, padding=2, act="relu")
+        p2 = fluid.layers.pool2d(c2, 2, pool_type="max", pool_stride=2)
+        flat = fluid.layers.reshape(p2, [-1, 16 * 7 * 7])
+        h = fluid.layers.fc(flat, 64, act="relu")
+        logits = fluid.layers.fc(h, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_cpp_trains_digits(tmp_path):
+    main, startup, loss = _build_convnet_train_prog()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    mdir = str(tmp_path / "train_model")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_train_model(mdir, ["img", "label"], [loss], exe,
+                                  main_program=main)
+
+    from paddle_tpu.core import native
+
+    native.load_library(required=True)  # ensure libptcore.so exists
+    lib_dir = os.path.join(REPO, "csrc", "build", "lib")
+    demo_src = os.path.join(REPO, "csrc", "ptcore", "train_demo.cc")
+    demo_bin = str(tmp_path / "train_demo")
+    subprocess.run(
+        ["g++", "-O2", "-o", demo_bin, demo_src,
+         "-L" + lib_dir, "-lptcore", "-Wl,-rpath," + lib_dir],
+        check=True)
+    r = subprocess.run([demo_bin, mdir, "40"], capture_output=True,
+                      text=True, timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "first" in r.stdout and "last" in r.stdout, r.stdout
+
+
+def test_native_train_steps_match_xla(tmp_path):
+    """Native C++ training steps == XLA Executor steps from identical
+    initial params on an identical repeated batch. Step 1 checks the
+    forward; steps 2-3 check the GRADIENTS — their losses depend on the
+    step-1/2 updates, so a wrong grad kernel (e.g. the r05 review's
+    scrambled conv-bias broadcast reduce) diverges here."""
+    main, startup, loss = _build_convnet_train_prog()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rs = np.random.RandomState(0)
+    img = rs.rand(8, 1, 28, 28).astype("f4")
+    lbl = rs.randint(0, 10, (8, 1)).astype("i8")
+    mdir = str(tmp_path / "tm")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_train_model(mdir, ["img", "label"], [loss], exe,
+                                  main_program=main)
+        want = [float(exe.run(main, {"img": img, "label": lbl},
+                              [loss])[0]) for _ in range(3)]
+    from paddle_tpu.core.native import NativePredictorHandle
+
+    h = NativePredictorHandle(mdir)
+    got = [float(np.asarray(h.run({"img": img, "label": lbl})[0]
+                            ).ravel()[0]) for _ in range(3)]
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=1e-4)
