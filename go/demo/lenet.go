@@ -8,6 +8,7 @@ package main
 
 import (
 	"fmt"
+	"log"
 	"os"
 
 	"paddle_tpu/go/paddle"
@@ -28,7 +29,9 @@ func main() {
 
 	in := paddle.NewTensor([]int64{1, 1, 28, 28},
 		make([]float32, 28*28))
-	pred.SetInput(pred.InputNames()[0], in)
+	if err := pred.SetInput(pred.InputNames()[0], in); err != nil {
+		log.Fatal(err)
+	}
 	outs, err := pred.Run()
 	if err != nil {
 		panic(err)

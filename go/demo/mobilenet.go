@@ -1,0 +1,70 @@
+//go:build ignore
+
+// MobileNet Go inference demo (reference parity:
+// go/demo/mobilenet.go + r/example/mobilenet.r role): classify a
+// 224x224 image with a saved MobileNet artifact through the native
+// C++ engine.
+//
+// Author the model with:
+//
+//	import paddle_tpu as paddle
+//	from paddle_tpu.vision.models import mobilenet_v1
+//	paddle.jit.save_inference(mobilenet_v1(), "mobilenet_model",
+//	                          input_shape=[1, 3, 224, 224])
+//
+// Then:
+//
+//	cd go && CGO_LDFLAGS="-L${REPO}/csrc/build/lib -lptcore \
+//	             -Wl,-rpath,${REPO}/csrc/build/lib" \
+//	go run ./demo/mobilenet.go -model ../mobilenet_model
+package main
+
+import (
+	"flag"
+	"fmt"
+	"log"
+	"math/rand"
+
+	"paddle_tpu/go/paddle"
+)
+
+func main() {
+	model := flag.String("model", "mobilenet_model",
+		"saved inference model dir")
+	flag.Parse()
+
+	cfg := paddle.NewConfig()
+	cfg.SetModel(*model)
+	pred, err := paddle.NewPredictor(cfg)
+	if err != nil {
+		log.Fatal(err)
+	}
+	defer pred.Destroy()
+
+	fmt.Println("inputs:", pred.InputNames())
+	fmt.Println("outputs:", pred.OutputNames())
+
+	// synthetic image; a real client decodes + normalizes a JPEG here
+	data := make([]float32, 1*3*224*224)
+	for i := range data {
+		data[i] = rand.Float32()
+	}
+	if err := pred.SetInput(pred.InputNames()[0],
+		paddle.NewTensor([]int64{1, 3, 224, 224}, data)); err != nil {
+		log.Fatal(err)
+	}
+
+	outs, err := pred.Run()
+	if err != nil {
+		log.Fatal(err)
+	}
+	logits := outs[0]
+	best, bestV := 0, float32(-1e30)
+	for i, v := range logits.Data {
+		if v > bestV {
+			best, bestV = i, v
+		}
+	}
+	fmt.Printf("top-1 class %d (logit %.4f) of %d\n",
+		best, bestV, len(logits.Data))
+}

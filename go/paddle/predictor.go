@@ -11,6 +11,11 @@ package paddle
 // const char* pt_pred_fetch_name(void* h, int i);
 // void pt_pred_set_input(void* h, const char* name, const int64_t* dims,
 //                        int ndim, const float* data);
+// void pt_pred_set_input_i64(void* h, const char* name,
+//                            const int64_t* dims, int ndim,
+//                            const int64_t* data);
+// int pt_pred_set_input_lod(void* h, const char* name,
+//                           const int64_t* offsets, int n);
 // int pt_pred_run(void* h);
 // int pt_pred_out_ndim(void* h, int i);
 // void pt_pred_out_dims(void* h, int i, int64_t* out);
@@ -74,15 +79,33 @@ func (p *Predictor) OutputNames() []string {
 	return out
 }
 
-// SetInput binds a float32 tensor to the named feed variable.
-func (p *Predictor) SetInput(name string, t *Tensor) {
+// SetInput binds a tensor (float32 or int64, optionally lod-tagged) to
+// the named feed variable. Returns an error when the data length does
+// not match the shape (the C side copies Numel elements and would read
+// past the Go slice otherwise).
+func (p *Predictor) SetInput(name string, t *Tensor) error {
+	if n := t.Numel(); (t.Ints != nil && int64(len(t.Ints)) != n) ||
+		(t.Ints == nil && int64(len(t.Data)) != n) {
+		return errors.New("paddle: SetInput " + name +
+			": data length does not match shape numel")
+	}
 	cname := cString(name)
 	defer freeCString(cname)
-	C.pt_pred_set_input(p.h, cname,
-		(*C.int64_t)(unsafe.Pointer(&t.Shape[0])), C.int(len(t.Shape)),
-		(*C.float)(unsafe.Pointer(&t.Data[0])))
+	dims := (*C.int64_t)(unsafe.Pointer(&t.Shape[0]))
+	if t.Ints != nil {
+		C.pt_pred_set_input_i64(p.h, cname, dims, C.int(len(t.Shape)),
+			(*C.int64_t)(unsafe.Pointer(&t.Ints[0])))
+	} else {
+		C.pt_pred_set_input(p.h, cname, dims, C.int(len(t.Shape)),
+			(*C.float)(unsafe.Pointer(&t.Data[0])))
+	}
+	if len(t.Lod) > 0 {
+		C.pt_pred_set_input_lod(p.h, cname,
+			(*C.int64_t)(unsafe.Pointer(&t.Lod[0])), C.int(len(t.Lod)))
+	}
 	runtime.KeepAlive(p)
 	runtime.KeepAlive(t)
+	return nil
 }
 
 // Run executes the model and returns every fetch output.

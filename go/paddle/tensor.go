@@ -1,18 +1,28 @@
 package paddle
 
 // Tensor is a host-side dense tensor exchanged with the predictor.
-// Float32 inputs only (the native engine's feed dtype; int64 feeds are
-// cast server-side), float32 or int64 outputs.
+// Float32 or int64 either way; Lod (level-1 offsets) marks packed
+// sequence rows for the lod-aware kernels (sequence_pool,
+// attention_lstm) — reference go/paddle/tensor.go ZeroCopyTensor role.
 type Tensor struct {
 	Shape []int64
-	Data  []float32 // set for float outputs/inputs
-	Ints  []int64   // set for int64 outputs
+	Data  []float32 // set for float inputs/outputs
+	Ints  []int64   // set for int64 inputs/outputs
+	Lod   []int64   // optional level-1 offsets ([0, n1, n1+n2, ...])
 }
 
 // NewTensor builds a float32 input tensor.
 func NewTensor(shape []int64, data []float32) *Tensor {
 	return &Tensor{Shape: shape, Data: data}
 }
+
+// NewIntTensor builds an int64 input tensor (sparse-id feeds).
+func NewIntTensor(shape []int64, data []int64) *Tensor {
+	return &Tensor{Shape: shape, Ints: data}
+}
+
+// SetLod attaches level-1 sequence offsets to the tensor.
+func (t *Tensor) SetLod(offsets []int64) { t.Lod = offsets }
 
 // Numel returns the element count implied by Shape.
 func (t *Tensor) Numel() int64 {

@@ -269,14 +269,14 @@ def _configs_extended(simple, unary):
              "is_reverse": False},
             extra=lambda b, s: {"WeightX": [_p((SD, 3 * SD), "wx", b, s)],
                                 "WeightH": [_p((SD, 3 * SD), "wh", b, s)],
-                                "Bias": [_p((3 * SD,), "bg", b, s)]})),
+                                "Bias": [_p((1, 3 * SD), "bg", b, s)]})),
         ("fusion_lstm", seq(
             "fusion_lstm", {"Hidden": 1, "Cell": 1, "XX": 1},
             {"candidate_activation": "tanh", "gate_activation": "sigmoid",
              "cell_activation": "tanh", "is_reverse": False},
             extra=lambda b, s: {"WeightX": [_p((SD, 4 * SD), "wx", b, s)],
                                 "WeightH": [_p((SD, 4 * SD), "wh", b, s)],
-                                "Bias": [_p((4 * SD,), "bg", b, s)]})),
+                                "Bias": [_p((1, 4 * SD), "bg", b, s)]})),
         ("attention_lstm", seq(
             "attention_lstm",
             {"Hidden": 1, "Cell": 1, "AttentionedX": 1},
