@@ -8,9 +8,10 @@
 // Author the model with:
 //
 //	import paddle_tpu as paddle
+//	from paddle_tpu.static import InputSpec
 //	from paddle_tpu.vision.models import mobilenet_v1
-//	paddle.jit.save_inference(mobilenet_v1(), "mobilenet_model",
-//	                          input_shape=[1, 3, 224, 224])
+//	paddle.jit.save(mobilenet_v1().eval(), "mobilenet_model",
+//	                input_spec=[InputSpec([1, 3, 224, 224], "float32")])
 //
 // Then:
 //

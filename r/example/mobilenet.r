@@ -1,0 +1,42 @@
+#!/usr/bin/env Rscript
+# R inference client (reference parity: r/example/mobilenet.r — the
+# reference binds R to the predictor through reticulate over the Python
+# API, and so does this one; no native R binding exists in either).
+#
+# Usage: Rscript mobilenet.r <model_dir>
+# The model_dir holds a save_inference_model artifact (__model__ +
+# __params__). Requires the reticulate R package and a Python with
+# paddle_tpu importable.
+
+library(reticulate)
+
+args <- commandArgs(trailingOnly = TRUE)
+model_dir <- if (length(args) >= 1) args[[1]] else "mobilenet_model"
+
+np <- import("numpy")
+inf <- import("paddle_tpu.inference")
+
+set_config <- function() {
+    config <- inf$Config(model_dir)
+    # config$enable_native_engine()  # uncomment for the C++ engine
+    return(config)
+}
+
+run_mobilenet <- function() {
+    config <- set_config()
+    predictor <- inf$create_predictor(config)
+
+    input_names <- predictor$get_input_names()
+    input_tensor <- predictor$get_input_handle(input_names[[1]])
+    data <- np$random$rand(1L, 3L, 224L, 224L)$astype("float32")
+    input_tensor$copy_from_cpu(data)
+
+    predictor$run()
+
+    output_names <- predictor$get_output_names()
+    output_tensor <- predictor$get_output_handle(output_names[[1]])
+    logits <- output_tensor$copy_to_cpu()
+    cat("top-1 class:", which.max(py_to_r(np$asarray(logits))) - 1, "\n")
+}
+
+run_mobilenet()
