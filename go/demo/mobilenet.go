@@ -5,13 +5,11 @@
 // 224x224 image with a saved MobileNet artifact through the native
 // C++ engine.
 //
-// Author the model with:
-//
-//	import paddle_tpu as paddle
-//	from paddle_tpu.static import InputSpec
-//	from paddle_tpu.vision.models import mobilenet_v1
-//	paddle.jit.save(mobilenet_v1().eval(), "mobilenet_model",
-//	                input_spec=[InputSpec([1, 3, 224, 224], "float32")])
+// Author the artifact with fluid.io.save_inference_model (the
+// __model__ + __params__ form the native C++ engine loads — a
+// paddle.jit.save export is XLA-engine-only); see
+// tests/test_inference.py::test_native_predictor_serves_mobilenet_lite
+// for a complete static-graph authoring example of this op family.
 //
 // Then:
 //

@@ -499,7 +499,9 @@ class NativePredictorHandle:
         for name, arr in feeds.items():
             lod = None
             if isinstance(arr, LoDTensor):
-                lod = np.asarray(arr.lod()[-1], np.int64)
+                levels = arr.lod()
+                if levels:  # lod-less LoDTensor degrades to dense rows
+                    lod = np.asarray(levels[-1], np.int64)
                 arr = np.asarray(arr)
             arr = np.ascontiguousarray(arr)
             dims = (ctypes.c_int64 * arr.ndim)(*arr.shape)

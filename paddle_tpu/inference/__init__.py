@@ -71,7 +71,25 @@ class PredictorTensor:
         self._value = None
 
     def copy_from_cpu(self, arr):
-        self._owner._feeds[self.name] = np.asarray(arr)
+        from ..core.lod import LoDTensor
+
+        if isinstance(arr, LoDTensor):
+            # keep sequence structure: both engines consume LoDTensors
+            # (XLA pads at the edge; native ships rows + offsets)
+            self._owner._feeds[self.name] = arr
+        else:
+            self._owner._feeds[self.name] = np.asarray(arr)
+
+    def set_lod(self, lod):
+        """Reference ZeroCopyTensor.SetLoD: attach level offsets to the
+        already-copied dense rows."""
+        from ..core.lod import LoDTensor
+
+        cur = self._owner._feeds.get(self.name)
+        if cur is None:
+            raise RuntimeError("set_lod before copy_from_cpu")
+        self._owner._feeds[self.name] = LoDTensor(
+            np.asarray(cur), lod=[list(map(int, lvl)) for lvl in lod])
 
     def reshape(self, shape):
         pass  # shapes come from the array itself
@@ -164,8 +182,12 @@ class Predictor:
         """Either positional list of arrays (ordered by input names) or use
         handles + run() like the reference's ZeroCopyRun."""
         if inputs is not None:
-            self._feeds = dict(zip(self._feed_names,
-                                   [np.asarray(a) for a in inputs]))
+            from ..core.lod import LoDTensor
+
+            self._feeds = dict(zip(
+                self._feed_names,
+                [a if isinstance(a, LoDTensor) else np.asarray(a)
+                 for a in inputs]))
         if self._native is not None:
             outs = self._native.run(self._feeds)
         else:

@@ -13,7 +13,7 @@ library(reticulate)
 args <- commandArgs(trailingOnly = TRUE)
 model_dir <- if (length(args) >= 1) args[[1]] else "mobilenet_model"
 
-np <- import("numpy")
+np <- import("numpy", convert = FALSE)
 inf <- import("paddle_tpu.inference")
 
 set_config <- function() {
@@ -35,8 +35,8 @@ run_mobilenet <- function() {
 
     output_names <- predictor$get_output_names()
     output_tensor <- predictor$get_output_handle(output_names[[1]])
-    logits <- output_tensor$copy_to_cpu()
-    cat("top-1 class:", which.max(py_to_r(np$asarray(logits))) - 1, "\n")
+    logits <- py_to_r(output_tensor$copy_to_cpu())
+    cat("top-1 class:", which.max(logits) - 1, "\n")
 }
 
 run_mobilenet()

@@ -113,3 +113,76 @@ def test_native_attention_lstm_matches_xla(tmp_path):
     got = h.run({"x": xv})[0]
     np.testing.assert_allclose(np.asarray(got).reshape(want_h.shape),
                                want_h, rtol=5e-4, atol=5e-5)
+
+
+def test_predictor_facade_lod_both_engines(tmp_path):
+    """The user-facing Predictor (Config/create_predictor handles) must
+    carry LoD feeds on BOTH engines — copy_from_cpu(LoDTensor) and the
+    reference-style copy_from_cpu(rows)+set_lod(offsets) spelling."""
+    from paddle_tpu.inference import Config, create_predictor
+
+    V, D = 40, 6
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", [1], dtype="int64", lod_level=1)
+        emb = fluid.layers.embedding(ids, size=[V, D])
+        out = fluid.layers.fc(fluid.layers.sequence_pool(emb, "sum"), 4)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rs = np.random.RandomState(11)
+    feed = _seq_ids(rs, 4, 5, V)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        want = np.asarray(exe.run(main, {"ids": feed}, [out])[0])
+        mdir = str(tmp_path / "m")
+        fluid.io.save_inference_model(mdir, ["ids"], [out], exe,
+                                      main_program=main)
+    for engine in ("xla", "native"):
+        cfg = Config(mdir)
+        if engine == "native":
+            cfg.enable_native_engine()
+        p = create_predictor(cfg)
+        h = p.get_input_handle(p.get_input_names()[0])
+        h.copy_from_cpu(feed)                      # LoDTensor direct
+        p.run()
+        got = np.asarray(p.get_output_handle(
+            p.get_output_names()[0]).copy_to_cpu())
+        np.testing.assert_allclose(got.reshape(want.shape), want,
+                                   rtol=2e-5, atol=2e-6,
+                                   err_msg=engine)
+        # rows + set_lod spelling
+        p2 = create_predictor(cfg)
+        h2 = p2.get_input_handle(p2.get_input_names()[0])
+        h2.copy_from_cpu(np.asarray(feed))
+        h2.set_lod(feed.lod())
+        p2.run()
+        got2 = np.asarray(p2.get_output_handle(
+            p2.get_output_names()[0]).copy_to_cpu())
+        np.testing.assert_allclose(got2.reshape(want.shape), want,
+                                   rtol=2e-5, atol=2e-6,
+                                   err_msg=engine + "+set_lod")
+
+
+def test_native_lodless_lodtensor_degrades_to_dense(tmp_path):
+    """A LoDTensor with NO lod fed to the native engine must behave as
+    dense rows, not crash (r05 review regression guard)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        out = fluid.layers.fc(x, 2)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rs = np.random.RandomState(0)
+    xv = rs.randn(3, 4).astype("f4")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        want = np.asarray(exe.run(main, {"x": xv}, [out])[0])
+        mdir = str(tmp_path / "m")
+        fluid.io.save_inference_model(mdir, ["x"], [out], exe,
+                                      main_program=main)
+    from paddle_tpu.core.native import NativePredictorHandle
+
+    h = NativePredictorHandle(mdir)
+    got = h.run({"x": LoDTensor(xv)})[0]
+    np.testing.assert_allclose(np.asarray(got).reshape(want.shape),
+                               want, rtol=2e-5, atol=2e-6)
