@@ -29,13 +29,15 @@ def _ring_attn_local(q, k, v, axis_name, is_causal, scale):
     sc = scale if scale is not None else 1.0 / math.sqrt(d)
     qf = q.astype(jnp.float32) * sc
 
-    def block(qf, kb, vb, q_off, k_off):
+    def block(qf, kb, vb, masked):
         logits = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32),
                             preferred_element_type=jnp.float32)
-        if is_causal:
-            rows = q_off + jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
-            cols = k_off + jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
-            logits = jnp.where(rows[None, None] >= cols[None, None],
+        if masked:
+            # only the DIAGONAL ring step needs the causal select:
+            # shard-local offsets coincide there (q_off == k_off)
+            rows = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+            logits = jnp.where((rows >= cols)[None, None],
                                logits, -1e30)
         m_b = logits.max(axis=-1, keepdims=True)
         p = jnp.exp(logits - m_b)
@@ -43,25 +45,51 @@ def _ring_attn_local(q, k, v, axis_name, is_causal, scale):
         o_b = jnp.einsum("bhqk,bhkd->bhqd", p, vb.astype(jnp.float32))
         return m_b, l_b, o_b
 
-    def body(i, carry):
-        acc, m_prev, l_prev, kr, vr = carry
-        src = (ax - i) % n  # which shard of K/V we hold this round
-        m_b, l_b, o_b = block(qf, kr, vr, ax * s, src * s)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def combine(carry, m_b, l_b, o_b):
+        acc, m_prev, l_prev = carry
         m_new = jnp.maximum(m_prev, m_b)
         alpha = jnp.exp(m_prev - m_new)
         beta = jnp.exp(m_b - m_new)
-        l_new = l_prev * alpha + l_b * beta
-        acc = acc * alpha + o_b * beta
-        perm = [(j, (j + 1) % n) for j in range(n)]
+        return (acc * alpha + o_b * beta, m_new,
+                l_prev * alpha + l_b * beta)
+
+    def body(i, carry):
+        acc, m_prev, l_prev, kr, vr = carry
+        src = (ax - i) % n  # which shard of K/V we hold this round
+        if is_causal:
+            # future shards (src > ax) are ENTIRELY masked under the
+            # causal order — skip their matmuls. NOTE (r05 review):
+            # with contiguous sequence sharding this saves FLOPs but
+            # not wall clock — the per-step ppermute barrier waits for
+            # the last device, which always computes; converting the
+            # saving into time needs zigzag/striped sharding (each
+            # device holds early AND late positions), future work.
+            m_b, l_b, o_b = jax.lax.cond(
+                src > ax,
+                lambda ops: (jnp.full((b, h, s, 1), -1e30, jnp.float32),
+                             jnp.zeros((b, h, s, 1), jnp.float32),
+                             jnp.zeros((b, h, s, d), jnp.float32)),
+                lambda ops: block(*ops, False),
+                (qf, kr, vr))
+        else:
+            m_b, l_b, o_b = block(qf, kr, vr, False)
+        acc, m_new, l_new = combine((acc, m_prev, l_prev), m_b, l_b, o_b)
         kr = jax.lax.ppermute(kr, axis_name, perm)
         vr = jax.lax.ppermute(vr, axis_name, perm)
         return acc, m_new, l_new, kr, vr
 
-    acc0 = jnp.zeros((b, h, s, d), jnp.float32)
-    m0 = jnp.full((b, h, s, 1), -1e30, jnp.float32)
-    l0 = jnp.zeros((b, h, s, 1), jnp.float32)
+    # step 0 peeled: src == ax exactly then — the one MASKED (diagonal)
+    # block; the loop body then only ever distinguishes skip vs clean
+    m0_, l0_, o0_ = block(qf, k, v, is_causal)
+    acc0 = o0_
+    m0 = m0_
+    l0 = l0_
+    k1 = jax.lax.ppermute(k, axis_name, perm)
+    v1 = jax.lax.ppermute(v, axis_name, perm)
     acc, m_f, l_f, _, _ = jax.lax.fori_loop(
-        0, n, body, (acc0, m0, l0, k, v))
+        1, n, body, (acc0, m0, l0, k1, v1))
     return (acc / jnp.maximum(l_f, 1e-30)).astype(q.dtype)
 
 
