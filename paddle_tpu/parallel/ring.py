@@ -10,6 +10,11 @@ transfers (XLA pipelines ppermute with the per-block matmuls).
 
 Use inside shard_map with q/k/v sharded on the sequence axis, or call
 `ring_attention` which wraps the shard_map given a mesh axis name.
+Causal layouts: "contiguous" (natural order; future shards skip their
+matmuls but the ppermute barrier still waits on the last device) and
+"zigzag" (each device holds an early AND a late chunk, balancing the
+causal work per step — the llama3-style recipe that converts the skip
+into wall clock).
 """
 from __future__ import annotations
 
@@ -17,9 +22,51 @@ import math
 from functools import partial
 
 
+def _block(qf, kb, vb, masked):
+    """One blockwise attention partial: (m, l, o) un-normalized online-
+    softmax pieces for scaled queries qf against one K/V block."""
+    import jax
+    import jax.numpy as jnp
+
+    s_q, s_k = qf.shape[2], kb.shape[2]
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+    if masked:
+        # only DIAGONAL blocks need the causal select: their global
+        # query/key offsets coincide
+        rows = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
+        logits = jnp.where((rows >= cols)[None, None], logits,
+                           jnp.float32(-1e30))
+    m_b = logits.max(axis=-1, keepdims=True)
+    p = jnp.exp(logits - m_b)
+    l_b = p.sum(axis=-1, keepdims=True)
+    o_b = jnp.einsum("bhqk,bhkd->bhqd", p, vb.astype(jnp.float32))
+    return m_b, l_b, o_b
+
+
+def _combine(carry, m_b, l_b, o_b):
+    """Merge one (m, l, o) block partial into the online-softmax carry."""
+    import jax.numpy as jnp
+
+    acc, m_prev, l_prev = carry
+    m_new = jnp.maximum(m_prev, m_b)
+    alpha = jnp.exp(m_prev - m_new)
+    beta = jnp.exp(m_b - m_new)
+    return (acc * alpha + o_b * beta, m_new,
+            l_prev * alpha + l_b * beta)
+
+
+def _skip_partial(jnp, b, h, s, d):
+    """The (m, l, o) of a fully-masked block: contributes nothing."""
+    return (jnp.full((b, h, s, 1), -1e30, jnp.float32),
+            jnp.zeros((b, h, s, 1), jnp.float32),
+            jnp.zeros((b, h, s, d), jnp.float32))
+
+
 def _ring_attn_local(q, k, v, axis_name, is_causal, scale):
-    """Per-shard body. q,k,v: (b, h, s_local, d). The global sequence is the
-    concatenation of shards in axis-index order."""
+    """Per-shard body, CONTIGUOUS layout. q,k,v: (b, h, s_local, d); the
+    global sequence is the concatenation of shards in axis-index order."""
     import jax
     import jax.numpy as jnp
 
@@ -28,32 +75,7 @@ def _ring_attn_local(q, k, v, axis_name, is_causal, scale):
     b, h, s, d = q.shape
     sc = scale if scale is not None else 1.0 / math.sqrt(d)
     qf = q.astype(jnp.float32) * sc
-
-    def block(qf, kb, vb, masked):
-        logits = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32),
-                            preferred_element_type=jnp.float32)
-        if masked:
-            # only the DIAGONAL ring step needs the causal select:
-            # shard-local offsets coincide there (q_off == k_off)
-            rows = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
-            logits = jnp.where((rows >= cols)[None, None],
-                               logits, -1e30)
-        m_b = logits.max(axis=-1, keepdims=True)
-        p = jnp.exp(logits - m_b)
-        l_b = p.sum(axis=-1, keepdims=True)
-        o_b = jnp.einsum("bhqk,bhkd->bhqd", p, vb.astype(jnp.float32))
-        return m_b, l_b, o_b
-
     perm = [(j, (j + 1) % n) for j in range(n)]
-
-    def combine(carry, m_b, l_b, o_b):
-        acc, m_prev, l_prev = carry
-        m_new = jnp.maximum(m_prev, m_b)
-        alpha = jnp.exp(m_prev - m_new)
-        beta = jnp.exp(m_b - m_new)
-        return (acc * alpha + o_b * beta, m_new,
-                l_prev * alpha + l_b * beta)
 
     def body(i, carry):
         acc, m_prev, l_prev, kr, vr = carry
@@ -61,31 +83,25 @@ def _ring_attn_local(q, k, v, axis_name, is_causal, scale):
         if is_causal:
             # future shards (src > ax) are ENTIRELY masked under the
             # causal order — skip their matmuls. NOTE (r05 review):
-            # with contiguous sequence sharding this saves FLOPs but
-            # not wall clock — the per-step ppermute barrier waits for
-            # the last device, which always computes; converting the
-            # saving into time needs zigzag/striped sharding (each
-            # device holds early AND late positions), future work.
+            # contiguous sharding saves FLOPs but not wall clock (the
+            # ppermute barrier waits on the last device, which always
+            # computes); layout="zigzag" is the balanced form.
             m_b, l_b, o_b = jax.lax.cond(
                 src > ax,
-                lambda ops: (jnp.full((b, h, s, 1), -1e30, jnp.float32),
-                             jnp.zeros((b, h, s, 1), jnp.float32),
-                             jnp.zeros((b, h, s, d), jnp.float32)),
-                lambda ops: block(*ops, False),
+                lambda ops: _skip_partial(jnp, b, h, s, d),
+                lambda ops: _block(*ops, False),
                 (qf, kr, vr))
         else:
-            m_b, l_b, o_b = block(qf, kr, vr, False)
-        acc, m_new, l_new = combine((acc, m_prev, l_prev), m_b, l_b, o_b)
+            m_b, l_b, o_b = _block(qf, kr, vr, False)
+        acc, m_new, l_new = _combine((acc, m_prev, l_prev),
+                                     m_b, l_b, o_b)
         kr = jax.lax.ppermute(kr, axis_name, perm)
         vr = jax.lax.ppermute(vr, axis_name, perm)
         return acc, m_new, l_new, kr, vr
 
     # step 0 peeled: src == ax exactly then — the one MASKED (diagonal)
     # block; the loop body then only ever distinguishes skip vs clean
-    m0_, l0_, o0_ = block(qf, k, v, is_causal)
-    acc0 = o0_
-    m0 = m0_
-    l0 = l0_
+    m0, l0, acc0 = _block(qf, k, v, is_causal)
     k1 = jax.lax.ppermute(k, axis_name, perm)
     v1 = jax.lax.ppermute(v, axis_name, perm)
     acc, m_f, l_f, _, _ = jax.lax.fori_loop(
@@ -93,21 +109,142 @@ def _ring_attn_local(q, k, v, axis_name, is_causal, scale):
     return (acc / jnp.maximum(l_f, 1e-30)).astype(q.dtype)
 
 
+def _zigzag_ring_local(q, k, v, axis_name, scale):
+    """Causal ring body for ZIGZAG-sharded operands: each shard holds
+    chunk `ax` (early) and chunk `2n-1-ax` (late) of 2n global chunks,
+    concatenated [lo | hi] along the sequence axis. Every device then
+    computes exactly 2 of its 4 (chunk_q, chunk_k) pairs per ppermute
+    step (plus the two diagonals at step 0) — balanced, no straggler —
+    so the causal skip is a wall-clock win, not just a FLOP count."""
+    import jax
+    import jax.numpy as jnp
+
+    ax = jax.lax.axis_index(axis_name)
+    n = jax.lax.psum(1, axis_name)
+    b, h, s2, d = q.shape
+    s = s2 // 2
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32) * sc
+    q_chunks = (qf[:, :, :s], qf[:, :, s:])
+    # global chunk offsets: lo chunk = ax, hi chunk = 2n-1-ax (traced)
+    q_offs = (ax, 2 * n - 1 - ax)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, kr, vr, src):
+        # per (query-chunk, key-chunk) pair: lax.cond SKIPS disallowed
+        # pairs outright (a masked select would still pay the matmuls)
+        accs, ms, ls = carry
+        k_chunks = (kr[:, :, :s], kr[:, :, s:])
+        v_chunks = (vr[:, :, :s], vr[:, :, s:])
+        k_offs = (src, 2 * n - 1 - src)
+        new = []
+        for qi in range(2):
+            acc, m_prev, l_prev = accs[qi], ms[qi], ls[qi]
+            qo = q_offs[qi]
+            for ki in range(2):
+                ko = k_offs[ki]
+                m_b, l_b, o_b = jax.lax.cond(
+                    qo < ko,
+                    lambda ops: _skip_partial(jnp, b, h, s, d),
+                    lambda ops: jax.lax.cond(
+                        qo == ko,
+                        lambda o: _block(*o, True),
+                        lambda o: _block(*o, False),
+                        ops),
+                    (q_chunks[qi], k_chunks[ki], v_chunks[ki]))
+                acc, m_prev, l_prev = _combine(
+                    (acc, m_prev, l_prev), m_b, l_b, o_b)
+            new.append((acc, m_prev, l_prev))
+        return ((new[0][0], new[1][0]), (new[0][1], new[1][1]),
+                (new[0][2], new[1][2]))
+
+    def body(i, carry):
+        accs, ms, ls, kr, vr = carry
+        src = (ax - i) % n
+        accs, ms, ls = step((accs, ms, ls), kr, vr, src)
+        kr = jax.lax.ppermute(kr, axis_name, perm)
+        vr = jax.lax.ppermute(vr, axis_name, perm)
+        return accs, ms, ls, kr, vr
+
+    z = jnp.zeros((b, h, s, d), jnp.float32)
+    mneg = jnp.full((b, h, s, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((b, h, s, 1), jnp.float32)
+    accs, ms, ls, _, _ = jax.lax.fori_loop(
+        0, n, body, ((z, z), (mneg, mneg), (l0, l0), k, v))
+    out = [accs[qi] / jnp.maximum(ls[qi], 1e-30) for qi in range(2)]
+    return jnp.concatenate(out, axis=2).astype(q.dtype)
+
+
+def zigzag_permutation(S, n):
+    """(forward, inverse) int32 gather indices between natural sequence
+    order and the zigzag shard order (device j holds chunks j and
+    2n-1-j of 2n chunks). Use in the DATA PIPELINE to stripe token
+    streams once per batch, then call ring_attention(layout="zigzag",
+    pre_striped=True) — per-call striping pays 4 cross-shard gathers
+    per attention layer, which erodes the balancing win at scale."""
+    import numpy as np
+
+    if S % (2 * n):
+        raise ValueError(f"zigzag needs seq {S} divisible by 2*{n}")
+    cs = S // (2 * n)
+    order = []
+    for j in range(n):
+        order.extend(range(j * cs, (j + 1) * cs))
+        order.extend(range((2 * n - 1 - j) * cs, (2 * n - j) * cs))
+    fwd = np.asarray(order, np.int32)
+    inv = np.empty_like(fwd)
+    inv[fwd] = np.arange(S, dtype=np.int32)
+    return fwd, inv
+
+
 def ring_attention(q, k, v, axis_name="sp", mesh=None, is_causal=False,
-                   scale=None):
+                   scale=None, layout="contiguous", pre_striped=False):
     """Global-view entry: q/k/v are full (b, h, S, d) arrays (possibly
-    sharded); runs the ring over `axis_name` of the current mesh. Falls back
-    to plain attention when the axis has size 1."""
+    sharded); runs the ring over `axis_name` of the current mesh. Falls
+    back to plain attention when the axis has size 1.
+
+    layout="zigzag" (causal only): stripes the sequence so every device
+    holds an early AND a late chunk — causal work balances across the
+    ring and the future-shard skip becomes a wall-clock win (see
+    _zigzag_ring_local). Requires S divisible by 2*axis_size. With
+    pre_striped=False the striping happens HERE (4 sequence-axis
+    gathers per call — convenient but costly per layer); production
+    pipelines should stripe tokens once via zigzag_permutation() and
+    pass pre_striped=True (inputs AND output stay in zigzag order)."""
+    import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     from .mesh import get_mesh, shard_map
     from ..ops.attention import sdpa_reference
 
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown ring layout {layout!r}: expected "
+                         f"'contiguous' or 'zigzag'")
+    if layout == "zigzag" and not is_causal:
+        raise ValueError("layout='zigzag' balances CAUSAL work; use the "
+                         "contiguous layout for bidirectional attention")
+
     m = (mesh or get_mesh())
-    if m.axis_size(axis_name) == 1:
+    n = m.axis_size(axis_name)
+    if n == 1:
         return sdpa_reference(q, k, v, None, is_causal, scale)
 
     spec = P(None, None, axis_name, None)
+    if layout == "zigzag":
+        S = q.shape[2]
+        fn = shard_map(
+            partial(_zigzag_ring_local, axis_name=axis_name, scale=scale),
+            mesh=m.mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        if pre_striped:
+            if S % (2 * n):
+                raise ValueError(
+                    f"zigzag ring needs seq {S} divisible by 2*{n}")
+            return fn(q, k, v)
+        fwd, inv = zigzag_permutation(S, n)
+        fwd = jnp.asarray(fwd)
+        inv = jnp.asarray(inv)
+        qz, kz, vz = (t[:, :, fwd] for t in (q, k, v))
+        return fn(qz, kz, vz)[:, :, inv]
     fn = shard_map(
         partial(_ring_attn_local, axis_name=axis_name, is_causal=is_causal,
                 scale=scale),

@@ -491,3 +491,73 @@ def test_ring_attention_causal_grads_match_reference():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=2e-5, atol=2e-5,
                                    err_msg=f"grad {name}")
+
+
+def test_ring_attention_zigzag_layout():
+    """Zigzag-striped causal ring (r05): every device holds an early
+    AND a late chunk, so causal skipping balances per ppermute step
+    (2 of 4 chunk pairs per device per step) and converts to wall
+    clock. Forward + grads must match the reference exactly; bad seq
+    divisibility must raise."""
+    import jax
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from paddle_tpu.ops.attention import sdpa_reference
+    from paddle_tpu.parallel import init_mesh, ring_attention
+
+    mesh = init_mesh(sp=4, dp=2, devices=jax.devices()[:8])
+    rs = np.random.RandomState(9)
+    q = jnp.asarray(rs.randn(2, 4, 64, 16).astype("f4"))
+    k = jnp.asarray(rs.randn(2, 4, 64, 16).astype("f4"))
+    v = jnp.asarray(rs.randn(2, 4, 64, 16).astype("f4"))
+    out = ring_attention(q, k, v, axis_name="sp", is_causal=True,
+                         layout="zigzag")
+    want = sdpa_reference(q, k, v, None, True, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+    g = jax.grad(lambda q, k, v: ring_attention(
+        q, k, v, axis_name="sp", is_causal=True,
+        layout="zigzag").astype(jnp.float32).sum(), (0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: sdpa_reference(
+        q, k, v, None, True, None).astype(jnp.float32).sum(),
+        (0, 1, 2))(q, k, v)
+    for name, a, b_ in zip("qkv", g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-5, atol=2e-5,
+                                   err_msg=f"zigzag grad {name}")
+    with _pytest.raises(ValueError, match="divisible"):
+        ring_attention(q[:, :, :60], k[:, :, :60], v[:, :, :60],
+                       axis_name="sp", is_causal=True, layout="zigzag")
+
+
+def test_ring_attention_zigzag_pre_striped_and_validation():
+    """pre_striped=True consumes/produces zigzag order with no gathers;
+    layout typos and non-causal zigzag raise."""
+    import jax
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from paddle_tpu.ops.attention import sdpa_reference
+    from paddle_tpu.parallel import init_mesh, ring_attention
+    from paddle_tpu.parallel.ring import zigzag_permutation
+
+    mesh = init_mesh(sp=4, dp=2, devices=jax.devices()[:8])
+    rs = np.random.RandomState(13)
+    q = jnp.asarray(rs.randn(1, 2, 64, 16).astype("f4"))
+    k = jnp.asarray(rs.randn(1, 2, 64, 16).astype("f4"))
+    v = jnp.asarray(rs.randn(1, 2, 64, 16).astype("f4"))
+    fwd, inv = zigzag_permutation(64, 4)
+    np.testing.assert_array_equal(fwd[inv], np.arange(64))
+    out_z = ring_attention(q[:, :, fwd], k[:, :, fwd], v[:, :, fwd],
+                           axis_name="sp", is_causal=True,
+                           layout="zigzag", pre_striped=True)
+    want = sdpa_reference(q, k, v, None, True, None)
+    np.testing.assert_allclose(np.asarray(out_z[:, :, inv]),
+                               np.asarray(want), rtol=2e-5, atol=2e-6)
+    with _pytest.raises(ValueError, match="unknown ring layout"):
+        ring_attention(q, k, v, axis_name="sp", is_causal=True,
+                       layout="zig-zag")
+    with _pytest.raises(ValueError, match="CAUSAL"):
+        ring_attention(q, k, v, axis_name="sp", is_causal=False,
+                       layout="zigzag")
