@@ -170,3 +170,22 @@ def test_moe_aux_loss_consumed_by_trainer():
     dids, dlabels = tr.shard_batch(ids, labels)
     np.testing.assert_allclose(float(tr.step((dids,), dlabels)),
                                losses[0.5], rtol=1e-4)
+
+
+def test_moe_state_dict_roundtrip(tmp_path):
+    """MoE layers save/load like any Layer: expert + router params
+    round-trip; the aux_loss_val buffer is non-persistable and stays
+    out of the artifact."""
+    layer = _layer(8, 16, e=2, seed=31)
+    rs = np.random.RandomState(4)
+    x = paddle.to_tensor(rs.randn(2, 5, 8).astype("f4"))
+    want = np.asarray(layer(x)._data)
+    sd = layer.state_dict()
+    assert not any("aux_loss_val" in k for k in sd), list(sd)
+    path = str(tmp_path / "moe.pdparams")
+    paddle.save(sd, path)
+    fresh = _layer(8, 16, e=2, seed=99)
+    assert not np.allclose(np.asarray(fresh(x)._data), want)
+    fresh.set_state_dict(paddle.load(path))
+    np.testing.assert_allclose(np.asarray(fresh(x)._data), want,
+                               rtol=1e-6, atol=1e-7)
