@@ -882,8 +882,16 @@ static RegK r_sgd("sgd", [](ExecCtx& c) {
     if (it != c.vars.end()) p = &it->second;
   }
   if (!p) { c.error = "sgd: param not found: " + pname; return false; }
+  if (p->f.size() != grad->f.size()) {
+    // a silent min(size) loop would update only a prefix of the
+    // parameter on a shape mismatch (ADVICE r05)
+    c.error = "sgd: Param/Grad size mismatch for " + pname + ": " +
+              std::to_string(p->f.size()) + " vs " +
+              std::to_string(grad->f.size());
+    return false;
+  }
   float lrv = lr->f.empty() ? 0.01f : lr->f[0];
-  for (size_t k = 0; k < p->f.size() && k < grad->f.size(); ++k)
+  for (size_t k = 0; k < p->f.size(); ++k)
     p->f[k] -= lrv * grad->f[k];
   return true;
 });

@@ -116,6 +116,16 @@ class LoDTensor:
         lod = list(outer_lod or []) + [_offsets_from_lengths(lengths)]
         return LoDTensor(data, lod)
 
+    def to_packed(self, row_len=None, pad_value=0):
+        """LoD -> PackedBatch (packed tokens, segment_ids, positions):
+        the feed for the segment-aware packed flash path. Flattens
+        nested lod to the last level, like to_padded."""
+        lens = self.sequence_lengths()
+        offs = self._lod[-1] if self._lod else [0, len(self._data)]
+        seqs = [self._data[offs[b]:offs[b] + n]
+                for b, n in enumerate(lens)]
+        return pack_sequences(seqs, row_len, pad_value)
+
     @staticmethod
     def from_sequences(seqs, dtype=None):
         """Build from a list of per-example arrays (level-1 lod)."""
@@ -124,6 +134,124 @@ class LoDTensor:
         data = (np.concatenate(arrs, axis=0) if arrs else
                 np.zeros((0,), dtype=dtype or np.float32))
         return LoDTensor(data, [_offsets_from_lengths(lens)])
+
+
+class PackedBatch:
+    """LoD sequences packed multiple-per-row for the segment-aware flash
+    path (ops/attention.py). Fields:
+
+    data          [rows, row_len, ...] — tokens, several sequences per
+                  row back-to-back, padded at the row tail
+    segment_ids   [rows, row_len] int32 — one id per sequence, NON-
+                  DECREASING along each row (the kernel's block-level
+                  early-out depends on this); tail padding gets the
+                  next id after the row's last sequence, so pads form
+                  their own segment and real tokens never attend them
+    positions     [rows, row_len] int32 — within-sequence positions
+                  (position-embedding feed for packed transformers)
+    spans         per-sequence (row, start, length), in input order
+    """
+
+    def __init__(self, data, segment_ids, positions, spans, lengths):
+        self.data = data
+        self.segment_ids = segment_ids
+        self.positions = positions
+        self.spans = spans
+        self.lengths = lengths
+
+    @property
+    def num_rows(self):
+        return self.data.shape[0]
+
+    @property
+    def row_len(self):
+        return self.data.shape[1]
+
+    @property
+    def fill(self):
+        """Fraction of packed slots holding real tokens."""
+        total = self.data.shape[0] * self.data.shape[1]
+        return float(sum(self.lengths)) / total if total else 0.0
+
+    def unpack(self, outputs=None):
+        """Re-slice per-sequence arrays (from `outputs` aligned with
+        `data`, default the packed tokens themselves) -> LoDTensor with
+        the original level-1 lod."""
+        src = np.asarray(outputs) if outputs is not None else self.data
+        rows = [src[r, s:s + n] for (r, s, n) in self.spans]
+        data = (np.concatenate(rows, axis=0) if rows else
+                np.zeros((0,) + src.shape[2:], dtype=src.dtype))
+        return LoDTensor(data, [_offsets_from_lengths(self.lengths)])
+
+    def cls_flat_index(self):
+        """Flat [num_seqs] int32 index of each sequence's FIRST token in
+        the row-major flattened [rows*row_len, ...] view — the packed
+        stand-in for `seq_out[:, 0]` CLS pooling."""
+        return np.asarray([r * self.row_len + s
+                           for (r, s, _) in self.spans], dtype=np.int32)
+
+
+def pack_sequences(seqs, row_len=None, pad_value=0):
+    """Greedy next-fit packing of per-sequence arrays into rows of
+    `row_len` tokens (reference gap: lod_tensor.h:104 rides varlen
+    batches through bert_encoder_functor.cu on GPU; here the packed
+    layout feeds the segment-masked pallas flash kernel). Order is
+    preserved, so segment ids are monotone within every row. Sequences
+    longer than row_len are rejected — pick row_len >= max length."""
+    arrs = [np.asarray(s) for s in seqs]
+    lens = [int(a.shape[0]) for a in arrs]
+    if row_len is None:
+        row_len = max(lens) if lens else 1
+    if lens and max(lens) > row_len:
+        raise ValueError(
+            f"sequence of length {max(lens)} does not fit row_len "
+            f"{row_len}")
+    tail = arrs[0].shape[1:] if arrs else ()
+    dtype = arrs[0].dtype if arrs else np.float32
+
+    rows, spans = [], []
+    cur, fill = None, 0
+    for i, (a, n) in enumerate(zip(arrs, lens)):
+        if cur is None or fill + n > row_len:
+            cur = {"segs": [], "fill": 0}
+            rows.append(cur)
+            fill = 0
+        cur["segs"].append((i, a, n))
+        fill += n
+        cur["fill"] = fill
+
+    R = max(len(rows), 1)
+    data = np.full((R, row_len) + tail, pad_value, dtype=dtype)
+    segment_ids = np.zeros((R, row_len), np.int32)
+    positions = np.zeros((R, row_len), np.int32)
+    spans = [None] * len(arrs)
+    for r, row in enumerate(rows):
+        off = 0
+        last = -1
+        for (i, a, n) in row["segs"]:
+            data[r, off:off + n] = a
+            segment_ids[r, off:off + n] = i
+            positions[r, off:off + n] = np.arange(n, dtype=np.int32)
+            spans[i] = (r, off, n)
+            off += n
+            last = i
+        # row tail: pads become their OWN segment (id follows the
+        # row's last real id, keeping the row monotone) — real tokens
+        # never attend them and they only attend each other
+        segment_ids[r, off:] = last + 1
+    return PackedBatch(data, segment_ids, positions, spans, lens)
+
+
+def pack_padded(padded, lengths, row_len=None, pad_value=0):
+    """(padded [B, T, ...], lengths [B]) -> PackedBatch: the LoD-native
+    feed for the packed flash path. With the default row_len (= max
+    length, i.e. T of a tightly padded batch) a ~50%-fill padded batch
+    packs into roughly half the rows — the padding FLOPs the dense
+    layout burns simply disappear."""
+    padded = np.asarray(padded)
+    lengths = [int(x) for x in np.asarray(lengths).reshape(-1)]
+    return pack_sequences([padded[b, :n] for b, n in enumerate(lengths)],
+                          row_len or padded.shape[1], pad_value)
 
 
 def create_lod_tensor(data, recursive_seq_lens, place=None):

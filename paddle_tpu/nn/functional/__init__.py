@@ -861,14 +861,18 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, name=None,
-                                 layout="BHSD"):
+                                 layout="BHSD", segment_ids=None):
     """TPU-first attention entry. Uses the pallas flash kernel on TPU when
     shapes allow; falls back to the XLA softmax composition elsewhere.
     layout="BSHD" takes [batch, seq, heads, dim] operands and skips the
     head transposes entirely on the short-sequence XLA path. Attention
     dropout (the reference MultiHeadAttention's dropout on the softmax
-    output) applies on the XLA paths; a nonzero training-mode dropout_p
-    disqualifies the flash kernel (it has no dropout support)."""
+    output) runs IN-KERNEL on the flash path and via jax.random on the
+    XLA paths. segment_ids ([B, S] int, packed monotone rows from
+    core/lod.pack_padded) restrict attention to same-segment tokens —
+    the LoD-packed varlen path; the dispatcher routes it to the
+    segment-masked flash kernel with block-level early-out on TPU and
+    to a densely-masked reference composition elsewhere."""
     from ...ops import attention as A
 
     if layout not in ("BHSD", "BSHD"):
@@ -877,12 +881,17 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     args = [query, key, value]
     if attn_mask is not None:
         args.append(attn_mask)
+    segs = None
+    if segment_ids is not None:
+        # ids are metadata, not a differentiable operand: keep them out
+        # of the tape
+        segs = _t(segment_ids).detach()._data
     sdpa_fn = A.sdpa_bshd if layout == "BSHD" else A.sdpa
     p = float(dropout_p or 0.0) if training else 0.0
     key_ = _random.next_key() if p else None
 
     def fn(q, k, v, *m):
         return sdpa_fn(q, k, v, m[0] if m else None, is_causal,
-                       dropout_p=p, dropout_key=key_)
+                       dropout_p=p, dropout_key=key_, segment_ids=segs)
 
     return _op("sdpa", fn, *args)

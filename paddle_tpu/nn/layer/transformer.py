@@ -41,14 +41,16 @@ class MultiHeadAttention(Layer):
             [0, 2, 1, 3])
 
     def forward(self, query, key=None, value=None, attn_mask=None,
-                cache=None):
+                cache=None, segment_ids=None):
         key = query if key is None else key
         value = key if value is None else value
         if cache is None:
             # transpose-free path: [B, S, h, d] operands — the head
             # transpose folds into the attention einsums (1.3x on the
             # short-seq XLA path; flash transposes internally when it
-            # engages)
+            # engages). segment_ids: packed-varlen feed (several LoD
+            # sequences per row); rides to the segment-masked flash
+            # kernel through the sdpa dispatcher.
             b, s, _ = query.shape
             q = self.q_proj(query).reshape(
                 [b, s, self.num_heads, self.head_dim])
@@ -59,7 +61,8 @@ class MultiHeadAttention(Layer):
             out = F.scaled_dot_product_attention(q, k, v, attn_mask,
                                                  self.dropout,
                                                  training=self.training,
-                                                 layout="BSHD")
+                                                 layout="BSHD",
+                                                 segment_ids=segment_ids)
             out = out.reshape([b, s, self.num_heads * self.head_dim])
             return self.out_proj(out)
         q = self._split_heads(self.q_proj(query))
@@ -129,12 +132,13 @@ class TransformerEncoderLayer(Layer):
                                    else dropout)
         self.activation = getattr(F, activation)
 
-    def forward(self, src, src_mask=None, cache=None):
+    def forward(self, src, src_mask=None, cache=None, segment_ids=None):
         residual = src
         if self.normalize_before:
             src = self.norm1(src)
         if cache is None:
-            src = self.self_attn(src, src, src, src_mask)
+            src = self.self_attn(src, src, src, src_mask,
+                                 segment_ids=segment_ids)
         else:
             src, cache = self.self_attn(src, src, src, src_mask, cache)
         src = residual + self.dropout1(src)
@@ -168,12 +172,13 @@ class TransformerEncoder(Layer):
         self.num_layers = num_layers
         self.norm = norm
 
-    def forward(self, src, src_mask=None, cache=None):
+    def forward(self, src, src_mask=None, cache=None, segment_ids=None):
         output = src
         new_caches = []
         for i, layer in enumerate(self.layers):
             if cache is None:
-                output = layer(output, src_mask)
+                output = layer(output, src_mask,
+                               segment_ids=segment_ids)
             else:
                 output, c = layer(output, src_mask, cache[i])
                 new_caches.append(c)

@@ -12,6 +12,21 @@ logsumexp; dQ/dK/dV recompute probabilities blockwise in VMEM. Padded
 batches stay on the flash path via a key-position bias (the (B, 1, 1, S)
 additive mask every NLP batch uses); full (B, H, Sq, Sk) masks fall back
 to the XLA reference.
+
+Packed/varlen batches (LoD-native): multiple ragged sequences packed
+into one row ride the flash path via per-token SEGMENT IDS
+(core/lod.py pack_padded produces them). Ids must be non-decreasing
+along the token axis of each row — the packed layout guarantees it —
+which makes the set of keys a query block may see a CONTIGUOUS token
+range; both the forward and both backward kernels turn that range into
+fori_loop bounds, so fully-cross-segment blocks are never visited at
+all (the same block-level early-out the causal path applies to future
+blocks). Visited blocks apply the token-level same-segment mask
+unconditionally: predicating it away with lax.cond measured ~1.5x
+SLOWER under Mosaic (see _causal_apply), so boundary and interior
+blocks share one body. Dropout, key-position bias and causal compose
+with segments; `sdpa`/`sdpa_bshd` route automatically whenever segment
+metadata is present.
 """
 from __future__ import annotations
 
@@ -116,6 +131,19 @@ def _kv_bias(mask, b, h, sk):
 
 
 
+def segment_bias(segment_ids, kv_segment_ids=None):
+    """Additive f32 [b, 1, sq, sk] attention bias from per-token segment
+    ids ([b, sq] / [b, sk] int): 0 within a segment, -1e30 across. The
+    XLA-composition equivalent of the in-kernel segment mask — the
+    fallback paths and the parity tests both use it."""
+    import jax.numpy as jnp
+
+    seg_q = jnp.asarray(segment_ids)
+    seg_k = seg_q if kv_segment_ids is None else jnp.asarray(kv_segment_ids)
+    eq = seg_q[:, :, None] == seg_k[:, None, :]
+    return jnp.where(eq, 0.0, -1e30).astype(jnp.float32)[:, None]
+
+
 def _z():
     """Typed zero for BlockSpec index maps: the tunnel's remote Mosaic
     compile helper fails to legalize the weak int64 a bare python ``0``
@@ -141,6 +169,18 @@ def _drop_consts(dropout_p):
     return thresh, np.float32(1.0 / (1.0 - dropout_p))
 
 
+def _check_drop_grid(sk, block_k):
+    """The second PRNG seed word packs (qi, ki) as qi*4096 + ki, which
+    is injective only while ki < 4096. ki indexes key blocks, so the
+    bound is static at kernel-build time — enforce it instead of
+    silently wrapping (ADVICE r05 low)."""
+    nk = sk // block_k
+    if nk > 4096:
+        raise ValueError(
+            f"flash dropout block addressing needs sk/block_k <= 4096 "
+            f"(got {nk}); raise block_k or disable in-kernel dropout")
+
+
 def _block_bits(pltpu, seed_ref, bh, qi, ki, block_q, block_k):
     """Counter-style dropout bits for one (qi, ki) logits block: reseed
     the on-core PRNG with (seed, bh, qi, ki) then draw — the SAME tuple
@@ -151,13 +191,76 @@ def _block_bits(pltpu, seed_ref, bh, qi, ki, block_q, block_k):
     import jax.numpy as jnp
 
     # Mosaic supports at most TWO seed words: fold bh into the first
-    # and (qi, ki) injectively into the second (ki < 4096 always:
-    # sk <= 2^20 at block_k >= 256)
-    pltpu.prng_seed(seed_ref[0] + bh, qi * jnp.int32(4096) + ki)
+    # NON-additively (odd-constant multiply — a plain seed+bh made
+    # (seed, head) and (seed+1, head-1) collide, ADVICE r05) and pack
+    # (qi, ki) injectively into the second (ki < 4096 enforced by
+    # _check_drop_grid at kernel-build time)
+    pltpu.prng_seed(seed_ref[0] + bh * jnp.int32(-1640531527),
+                    qi * jnp.int32(4096) + ki)
     bits = pltpu.prng_random_bits((block_q, block_k))
     if bits.dtype != jnp.uint32:
         bits = pltpu.bitcast(bits, jnp.uint32)
     return bits
+
+
+def _hash_bits(jnp, jax, seed, bh, qi, ki, block_q, block_k):
+    """Interpret-mode stand-in for _block_bits: a pure-jnp counter hash
+    over (seed, bh, qi, ki, row, col) — the Mosaic PRNG has no CPU
+    lowering. Same addressing contract (the tuple, not stream order,
+    identifies the block) so fwd and both bwd kernels regenerate
+    identical masks; `dropout_keep_reference` reproduces these exact
+    bits host-side, which is what lets the CPU test suite check flash
+    dropout against an XLA composition BIT-FOR-BIT."""
+    r = jax.lax.broadcasted_iota(jnp.uint32, (block_q, block_k), 0)
+    c = jax.lax.broadcasted_iota(jnp.uint32, (block_q, block_k), 1)
+    x = (seed.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+         ^ bh.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)
+         ^ qi.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35)
+         ^ ki.astype(jnp.uint32) * jnp.uint32(0x27D4EB2F))
+    x = x ^ (r * jnp.uint32(0x165667B1)) ^ (c * jnp.uint32(0x9E3779B9))
+    # murmur3 fmix32 finalizer
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> jnp.uint32(16))
+    return x
+
+
+def dropout_keep_reference(seed, b, h, sq, sk, block_q, block_k,
+                           dropout_p):
+    """Host/numpy replica of the INTERPRET-mode in-kernel dropout keep
+    mask, [b*h, sq, sk] bool — feeds the XLA reference composition in
+    tests so segment-masked flash with dropout ON can be checked for
+    exact parity on CPU. (The compiled TPU path draws from the Mosaic
+    PRNG instead; its statistics are validated on-chip by
+    tests/test_flash_dropout.py.)"""
+    import numpy as np
+
+    thresh = np.uint32(min(int(round(dropout_p * 2.0 ** 32)),
+                           2 ** 32 - 1))
+    nq, nk = sq // block_q, sk // block_k
+    keep = np.empty((b * h, sq, sk), bool)
+    r = np.arange(block_q, dtype=np.uint32)[:, None]
+    c = np.arange(block_k, dtype=np.uint32)[None, :]
+    with np.errstate(over="ignore"):
+        for bh in range(b * h):
+            for qi in range(nq):
+                for ki in range(nk):
+                    x = (np.uint32(seed) * np.uint32(0x9E3779B9)
+                         ^ np.uint32(bh) * np.uint32(0x85EBCA6B)
+                         ^ np.uint32(qi) * np.uint32(0xC2B2AE35)
+                         ^ np.uint32(ki) * np.uint32(0x27D4EB2F))
+                    x = x ^ (r * np.uint32(0x165667B1)) \
+                        ^ (c * np.uint32(0x9E3779B9))
+                    x = x ^ (x >> np.uint32(16))
+                    x = x * np.uint32(0x85EBCA6B)
+                    x = x ^ (x >> np.uint32(13))
+                    x = x * np.uint32(0xC2B2AE35)
+                    x = x ^ (x >> np.uint32(16))
+                    keep[bh, qi * block_q:(qi + 1) * block_q,
+                         ki * block_k:(ki + 1) * block_k] = x >= thresh
+    return keep
 
 
 def _causal_apply(jax, jnp, dmat, qi, ki, block_q, block_k, logits):
@@ -172,7 +275,8 @@ def _causal_apply(jax, jnp, dmat, qi, ki, block_q, block_k, logits):
 
 
 def _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal, has_bias, block_q,
-                       block_k, dtype, interpret=False, dropout_p=0.0):
+                       block_k, dtype, interpret=False, dropout_p=0.0,
+                       has_segs=False):
     import jax
     import jax.numpy as jnp
 
@@ -184,11 +288,24 @@ def _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal, has_bias, block_q,
     if has_drop:
         from jax.experimental.pallas import tpu as pltpu
 
+        _check_drop_grid(sk, block_k)
         thresh, inv_keep = _drop_consts(dropout_p)
 
+        def draw_bits(seed_ref, bh, qi, ki):
+            if interpret:  # Mosaic PRNG has no CPU lowering
+                return _hash_bits(jnp, jax, seed_ref[0], bh, qi, ki,
+                                  block_q, block_k)
+            return _block_bits(pltpu, seed_ref, bh, qi, ki,
+                               block_q, block_k)
+
     def kernel(*refs):
+        refs = list(refs)
         if has_drop:
-            seed_ref, *refs = refs
+            seed_ref = refs.pop(0)
+        if has_segs:
+            # inputs run (q, k, v, bias?, qseg, kseg), outputs (o, lse)
+            kseg_ref = refs.pop(-3)
+            qseg_ref = refs.pop(-3)
         if has_bias:
             q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref = refs
         else:
@@ -205,6 +322,19 @@ def _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal, has_bias, block_q,
                         jnp.int32, (block_q, block_k), 0)
                     - jax.lax.broadcasted_iota(
                         jnp.int32, (block_q, block_k), 1))
+        if has_segs:
+            # monotone ids make valid keys one contiguous token range:
+            # everything with an id in [min(qseg), max(qseg)] — turn it
+            # into block-loop bounds (block-level early-out; same trick
+            # as the causal future-block skip)
+            qsegc = qseg_ref[...]                 # (block_q, 1) int32
+            qmin, qmax = qsegc.min(), qsegc.max()
+            kseg_all = kseg_ref[...]              # (sk, 1) int32
+            lo_tok = jnp.sum((kseg_all < qmin).astype(jnp.int32))
+            hi_tok = jnp.sum((kseg_all <= qmax).astype(jnp.int32))
+            seg_lo = lo_tok // jnp.int32(block_k)
+            seg_hi = (hi_tok + jnp.int32(block_k - 1)) \
+                // jnp.int32(block_k)
 
         def make_body(masked):
             def body(ki, carry):
@@ -216,6 +346,10 @@ def _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal, has_bias, block_q,
                 if has_bias:
                     bias = bias_ref[pl.ds(ki * block_k, block_k), 0]
                     logits = logits + bias[None, :]
+                if has_segs:
+                    ksb = kseg_ref[pl.ds(ki * block_k, block_k), 0]
+                    logits = jnp.where(qsegc == ksb[None, :], logits,
+                                       jnp.float32(-1e30))
                 if masked:
                     logits = _causal_apply(jax, jnp, dmat, qi, ki,
                                            block_q, block_k, logits)
@@ -229,8 +363,7 @@ def _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal, has_bias, block_q,
                 # the mask
                 l_cur = l_prev * alpha + p.sum(axis=-1, keepdims=True)
                 if has_drop:
-                    bits = _block_bits(pltpu, seed_ref, bh, qi, ki,
-                                       block_q, block_k)
+                    bits = draw_bits(seed_ref, bh, qi, ki)
                     p = jnp.where(bits >= thresh, p * inv_keep,
                                   jnp.float32(0.0))
                 acc = (acc * alpha
@@ -243,7 +376,15 @@ def _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal, has_bias, block_q,
         m0 = jnp.full((block_q, 1), -1e30, jnp.float32)
         l0 = jnp.zeros((block_q, 1), jnp.float32)
         carry0 = (acc0, m0, l0)
-        if is_causal and block_q == block_k:
+        if has_segs:
+            hi = seg_hi
+            if is_causal:
+                k_hi = (qi + 1) * block_q
+                hi = jnp.minimum(
+                    hi, (k_hi + block_k - 1) // jnp.int32(block_k))
+            acc, m_f, l_f = jax.lax.fori_loop(
+                seg_lo, hi, make_body(is_causal), carry0)
+        elif is_causal and block_q == block_k:
             # diagonal split: interior blocks [0, qi) need no mask at
             # all (measured VPU cost); only the diagonal block does
             carry = jax.lax.fori_loop(jnp.int32(0), qi,
@@ -273,6 +414,14 @@ def _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal, has_bias, block_q,
         # cannot
         in_specs.append(
             pl.BlockSpec((None, sk, 1), lambda bh, qi, *_: (bh, _z(), _z())))
+    if has_segs:
+        # q segs blocked with the query; k segs whole-row (the loop
+        # bounds reduce over them before any key block is touched)
+        in_specs.append(
+            pl.BlockSpec((None, block_q, 1),
+                         lambda bh, qi, *_: (bh, qi, _z())))
+        in_specs.append(
+            pl.BlockSpec((None, sk, 1), lambda bh, qi, *_: (bh, _z(), _z())))
     out_specs = [
         pl.BlockSpec((None, block_q, d), lambda bh, qi, *_: (bh, qi, _z())),
         pl.BlockSpec((None, block_q, 1), lambda bh, qi, *_: (bh, qi, _z())),
@@ -299,12 +448,28 @@ def _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal, has_bias, block_q,
     )
 
 
+def _segs_bh(segment_ids, h, s, what):
+    """[b, s] int segment ids -> [b*h, s, 1] int32 kernel operand."""
+    import jax.numpy as jnp
+
+    seg = jnp.asarray(segment_ids).astype(jnp.int32)
+    if seg.ndim != 2 or seg.shape[1] != s:
+        raise ValueError(
+            f"{what} segment_ids must be [batch, {s}], got {seg.shape}")
+    return jnp.repeat(seg, h, axis=0)[:, :, None]
+
+
 def flash_attention_fwd(q, k, v, bias=None, is_causal=False, scale=None,
                         block_q=256, block_k=256, interpret=False,
-                        dropout_p=0.0, seed=None):
+                        dropout_p=0.0, seed=None, segment_ids=None,
+                        kv_segment_ids=None):
     """Returns (out [b,h,sq,d], lse [b*h, sq, 1]). bias: [b, sk] additive.
     dropout_p > 0 needs `seed` (int32[1]): in-kernel counter-addressed
-    probability dropout on the normalized attention weights."""
+    probability dropout on the normalized attention weights.
+    segment_ids ([b, sq] int, NON-DECREASING along tokens — the packed
+    layout from core/lod.pack_padded) restricts attention to same-segment
+    tokens with a block-level early-out; kv_segment_ids defaults to
+    segment_ids (self-attention packing)."""
     import jax.numpy as jnp
 
     b, h, sq, d = q.shape
@@ -327,15 +492,20 @@ def flash_attention_fwd(q, k, v, bias=None, is_causal=False, scale=None,
     qr = q.reshape(b * h, sq, d)
     kr = k.reshape(b * h, sk, d)
     vr = v.reshape(b * h, sk, d)
+    has_segs = segment_ids is not None
     call = _flash_fwd_kernels(b, h, sq, sk, d, s, is_causal,
                               bias is not None, block_q, block_k, q.dtype,
-                              interpret, dropout_p)
+                              interpret, dropout_p, has_segs)
     lead = (seed,) if dropout_p else ()
+    args = [qr, kr, vr]
     if bias is not None:
-        bias_bh = jnp.repeat(bias, h, axis=0)[:, :, None]  # [b*h, sk, 1]
-        out, lse = call(*lead, qr, kr, vr, bias_bh)
-    else:
-        out, lse = call(*lead, qr, kr, vr)
+        args.append(jnp.repeat(bias, h, axis=0)[:, :, None])  # [b*h,sk,1]
+    if has_segs:
+        args.append(_segs_bh(segment_ids, h, sq, "query"))
+        args.append(_segs_bh(
+            segment_ids if kv_segment_ids is None else kv_segment_ids,
+            h, sk, "key"))
+    out, lse = call(*lead, *args)
     return out.reshape(b, h, sq, d), lse          # lse: [b*h, sq, 1]
 
 
@@ -357,7 +527,8 @@ def flash_attention_tpu(q, k, v, is_causal=False, scale=None,
 
 def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
                         block_q=256, block_k=256, interpret=False,
-                        dropout_p=0.0, seed=None):
+                        dropout_p=0.0, seed=None, segment_ids=None,
+                        kv_segment_ids=None):
     import jax
     import jax.numpy as jnp
 
@@ -371,16 +542,25 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
     nq = sq // block_q
     nk = sk // block_k
     has_bias = bias is not None
+    has_segs = segment_ids is not None
     has_drop = dropout_p > 0.0
     if has_drop:
         from jax.experimental.pallas import tpu as pltpu
 
+        _check_drop_grid(sk, block_k)
         thresh, inv_keep = _drop_consts(dropout_p)
         # dropout composes AFTER the softmax: O = (D∘P)V with
         # D = mask/keep. delta = rowsum(dO∘O) still equals
         # rowsum(P∘(D∘dP_raw)), so the correction term is unchanged;
         # the kernels regenerate D per block from (seed, bh, qi, ki)
         # and apply it to dP (and to P for dV).
+
+        def draw_bits(seed_ref, bh, qi, ki):
+            if interpret:  # Mosaic PRNG has no CPU lowering
+                return _hash_bits(jnp, jax, seed_ref[0], bh, qi, ki,
+                                  block_q, block_k)
+            return _block_bits(pltpu, seed_ref, bh, qi, ki,
+                               block_q, block_k)
 
     qr = q.reshape(b * h, sq, d)
     kr = k.reshape(b * h, sk, d)
@@ -393,10 +573,20 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
         -1, keepdims=True)
     bias_bh = jnp.repeat(bias, h, axis=0)[:, :, None] if has_bias \
         else None
+    if has_segs:
+        qseg_bh = _segs_bh(segment_ids, h, sq, "query")
+        kseg_bh = _segs_bh(
+            segment_ids if kv_segment_ids is None else kv_segment_ids,
+            h, sk, "key")
 
     def dq_kernel(*refs):
+        refs = list(refs)
         if has_drop:
-            seed_ref, *refs = refs
+            seed_ref = refs.pop(0)
+        if has_segs:
+            # inputs end (..., qseg, kseg); the single output dq trails
+            kseg_ref = refs.pop(-2)
+            qseg_ref = refs.pop(-2)
         if has_bias:
             (q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, dl_ref,
              dq_ref) = refs
@@ -416,6 +606,16 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
                         jnp.int32, (block_q, block_k), 0)
                     - jax.lax.broadcasted_iota(
                         jnp.int32, (block_q, block_k), 1))
+        if has_segs:
+            # same contiguous-range early-out as the forward
+            qsegc = qseg_ref[...]
+            qmin, qmax = qsegc.min(), qsegc.max()
+            kseg_all = kseg_ref[...]
+            lo_tok = jnp.sum((kseg_all < qmin).astype(jnp.int32))
+            hi_tok = jnp.sum((kseg_all <= qmax).astype(jnp.int32))
+            seg_lo = lo_tok // jnp.int32(block_k)
+            seg_hi = (hi_tok + jnp.int32(block_k - 1)) \
+                // jnp.int32(block_k)
 
         def make_body(masked):
             def body(ki, acc):
@@ -426,6 +626,10 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
                 if has_bias:
                     bb = b_ref[pl.ds(ki * block_k, block_k), 0]
                     logits = logits + bb[None, :]
+                if has_segs:
+                    ksb = kseg_ref[pl.ds(ki * block_k, block_k), 0]
+                    logits = jnp.where(qsegc == ksb[None, :], logits,
+                                       jnp.float32(-1e30))
                 if masked:
                     logits = _causal_apply(jax, jnp, dmat, qi, ki,
                                            block_q, block_k, logits)
@@ -433,8 +637,7 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
                 dp = jnp.dot(gb, vb.T,
                              preferred_element_type=jnp.float32)
                 if has_drop:
-                    bits = _block_bits(pltpu, seed_ref, bh, qi, ki,
-                                       block_q, block_k)
+                    bits = draw_bits(seed_ref, bh, qi, ki)
                     dp = jnp.where(bits >= thresh, dp * inv_keep,
                                    jnp.float32(0.0))
                 ds = p * (dp - dl_b)
@@ -446,7 +649,15 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
             return body
 
         acc0 = jnp.zeros((block_q, d), jnp.float32)
-        if is_causal and block_q == block_k:
+        if has_segs:
+            hi = seg_hi
+            if is_causal:
+                hi = jnp.minimum(
+                    hi, ((qi + 1) * block_q + block_k - 1)
+                    // jnp.int32(block_k))
+            acc = jax.lax.fori_loop(seg_lo, hi, make_body(is_causal),
+                                    acc0)
+        elif is_causal and block_q == block_k:
             acc = jax.lax.fori_loop(jnp.int32(0), qi,
                                     make_body(False), acc0)
             acc = make_body(True)(qi, acc)
@@ -473,8 +684,13 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
         pl.BlockSpec((None, block_q, 1), lambda bh, qi, *_: (bh, qi, _z())),
         pl.BlockSpec((None, block_q, 1), lambda bh, qi, *_: (bh, qi, _z())),
     ]
+    if has_segs:
+        dq_in.append(pl.BlockSpec((None, block_q, 1),
+                                  lambda bh, qi, *_: (bh, qi, _z())))
+        dq_in.append(pl.BlockSpec((None, sk, 1),
+                                  lambda bh, qi, *_: (bh, _z(), _z())))
     dq_args = [qr, kr, vr] + ([bias_bh] if has_bias else []) + \
-        [gr, lse, delta]
+        [gr, lse, delta] + ([qseg_bh, kseg_bh] if has_segs else [])
     dq_out_spec = pl.BlockSpec((None, block_q, d),
                                lambda bh, qi, *_: (bh, qi, _z()))
     dq_out_shape = jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)
@@ -494,8 +710,14 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
         )(*dq_args)
 
     def dkv_kernel(*refs):
+        refs = list(refs)
         if has_drop:
-            seed_ref, *refs = refs
+            seed_ref = refs.pop(0)
+        if has_segs:
+            # inputs end (..., qseg, kseg); 2-3 outputs (dk, dv, db?)
+            n_out = 3 if has_bias else 2
+            kseg_ref = refs.pop(-(n_out + 1))
+            qseg_ref = refs.pop(-(n_out + 1))
         if has_bias:
             (q_ref, k_ref, v_ref, b_ref, g_ref, lse_ref, dl_ref,
              dk_ref, dv_ref, db_ref) = refs
@@ -514,6 +736,18 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
                         jnp.int32, (block_q, block_k), 0)
                     - jax.lax.broadcasted_iota(
                         jnp.int32, (block_q, block_k), 1))
+        if has_segs:
+            # mirror of the dq early-out: queries that can see THIS key
+            # block are those with ids in [min(kseg), max(kseg)]
+            ksegc = kseg_ref[...]                 # (block_k, 1)
+            ksb_row = ksegc[:, 0]
+            kmin, kmax = ksegc.min(), ksegc.max()
+            qseg_all = qseg_ref[...]              # (sq, 1)
+            lo_tok = jnp.sum((qseg_all < kmin).astype(jnp.int32))
+            hi_tok = jnp.sum((qseg_all <= kmax).astype(jnp.int32))
+            seg_qlo = lo_tok // jnp.int32(block_q)
+            seg_qhi = (hi_tok + jnp.int32(block_q - 1)) \
+                // jnp.int32(block_q)
 
         def make_body(masked):
             def body(qi, carry):
@@ -530,6 +764,10 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
                                  preferred_element_type=jnp.float32)
                 if has_bias:
                     logits = logits + bb[None, :]
+                if has_segs:
+                    qsb = qseg_ref[pl.ds(qi * block_q, block_q), :]
+                    logits = jnp.where(qsb == ksb_row[None, :], logits,
+                                       jnp.float32(-1e30))
                 if masked:
                     logits = _causal_apply(jax, jnp, dmat, qi, ki,
                                            block_q, block_k, logits)
@@ -537,8 +775,7 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
                 dp = jnp.dot(gb, vb.T,
                              preferred_element_type=jnp.float32)
                 if has_drop:
-                    bits = _block_bits(pltpu, seed_ref, bh, qi, ki,
-                                       block_q, block_k)
+                    bits = draw_bits(seed_ref, bh, qi, ki)
                     keep = bits >= thresh
                     pd = jnp.where(keep, p * inv_keep, jnp.float32(0.0))
                     dp = jnp.where(keep, dp * inv_keep, jnp.float32(0.0))
@@ -559,7 +796,14 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
         z = jnp.zeros((block_k, d), jnp.float32)
         zb = jnp.zeros((block_k,), jnp.float32)
         carry0 = (z, z, zb)
-        if is_causal and block_q == block_k:
+        if has_segs:
+            lo = seg_qlo
+            if is_causal:
+                lo = jnp.maximum(lo, (ki * block_k)
+                                 // jnp.int32(block_q))
+            outs = jax.lax.fori_loop(lo, seg_qhi, make_body(is_causal),
+                                     carry0)
+        elif is_causal and block_q == block_k:
             # diagonal block at qi == ki needs the mask; everything
             # after it does not
             carry = make_body(True)(ki, carry0)
@@ -592,8 +836,13 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
         pl.BlockSpec((None, sq, 1), lambda bh, ki, *_: (bh, _z(), _z())),
         pl.BlockSpec((None, sq, 1), lambda bh, ki, *_: (bh, _z(), _z())),
     ]
+    if has_segs:
+        dkv_in.append(pl.BlockSpec((None, sq, 1),
+                                   lambda bh, ki, *_: (bh, _z(), _z())))
+        dkv_in.append(pl.BlockSpec((None, block_k, 1),
+                                   lambda bh, ki, *_: (bh, ki, _z())))
     dkv_args = [qr, kr, vr] + ([bias_bh] if has_bias else []) + \
-        [gr, lse, delta]
+        [gr, lse, delta] + ([qseg_bh, kseg_bh] if has_segs else [])
     out_specs = [
         pl.BlockSpec((None, block_k, d), lambda bh, ki, *_: (bh, ki, _z())),
         pl.BlockSpec((None, block_k, d), lambda bh, ki, *_: (bh, ki, _z())),
@@ -639,30 +888,30 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
 
 @functools.lru_cache(maxsize=None)
 def _flash_diff_fn(is_causal, scale, has_bias, interpret, dropout_p,
-                   block_q, block_k):
+                   block_q, block_k, has_segs=False):
     import jax
 
     @jax.custom_vjp
-    def f(q, k, v, bias, seed):
+    def f(q, k, v, bias, qseg, kseg, seed):
         out, _ = flash_attention_fwd(q, k, v, bias, is_causal, scale,
                                      block_q, block_k, interpret,
-                                     dropout_p, seed)
+                                     dropout_p, seed, qseg, kseg)
         return out
 
-    def fwd(q, k, v, bias, seed):
+    def fwd(q, k, v, bias, qseg, kseg, seed):
         out, lse = flash_attention_fwd(q, k, v, bias, is_causal, scale,
                                        block_q, block_k, interpret,
-                                       dropout_p, seed)
-        return out, (q, k, v, bias, seed, out, lse)
+                                       dropout_p, seed, qseg, kseg)
+        return out, (q, k, v, bias, qseg, kseg, seed, out, lse)
 
     def bwd(res, g):
-        q, k, v, bias, seed, out, lse = res
+        q, k, v, bias, qseg, kseg, seed, out, lse = res
         dq, dk, dv, dbias = flash_attention_bwd(q, k, v, bias, out, lse,
                                                 g, is_causal, scale,
                                                 block_q, block_k,
                                                 interpret, dropout_p,
-                                                seed)
-        return dq, dk, dv, dbias, None
+                                                seed, qseg, kseg)
+        return dq, dk, dv, dbias, None, None, None
 
     f.defvjp(fwd, bwd)
     return f
@@ -690,14 +939,18 @@ def _pick_blocks(sq, sk, block_q=None, block_k=None):
 
 def flash_attention(q, k, v, bias=None, is_causal=False, scale=None,
                     interpret=False, block_q=None, block_k=None,
-                    dropout_p=0.0, dropout_seed=None):
+                    dropout_p=0.0, dropout_seed=None, segment_ids=None,
+                    kv_segment_ids=None):
     """Differentiable flash attention (fwd+bwd pallas). bias: optional
     [b, sk] additive key bias (padding masks). dropout_p: in-kernel
     probability dropout on the attention weights, addressed by
     (dropout_seed, bh, qi, ki) so fwd and both bwd kernels regenerate
-    identical masks. Sequence lengths that do not tile into blocks fall
-    back to the XLA reference (the blockwise grid would silently
-    truncate the tail otherwise)."""
+    identical masks. segment_ids: optional [b, sq] int per-token packed
+    segment ids (non-decreasing per row — core/lod.pack_padded layout);
+    attention is restricted to same-segment tokens with a block-level
+    early-out, so fully-cross-segment blocks cost nothing. Sequence
+    lengths that do not tile into blocks fall back to the XLA reference
+    (the blockwise grid would silently truncate the tail otherwise)."""
     sq, sk = q.shape[2], k.shape[2]
     block_q, block_k = _pick_blocks(sq, sk, block_q, block_k)
     if (sq % block_q or sk % block_k
@@ -714,6 +967,9 @@ def flash_attention(q, k, v, bias=None, is_causal=False, scale=None,
         import jax
 
         mask4 = None if bias is None else bias[:, None, None, :]
+        if segment_ids is not None:
+            sb = segment_bias(segment_ids, kv_segment_ids)
+            mask4 = sb if mask4 is None else mask4 + sb
         key = (jax.random.fold_in(jax.random.PRNGKey(0),
                                   dropout_seed[0])
                if dropout_p else None)
@@ -722,8 +978,9 @@ def flash_attention(q, k, v, bias=None, is_causal=False, scale=None,
     if dropout_p and dropout_seed is None:
         raise ValueError("flash dropout needs dropout_seed (int32[1])")
     f = _flash_diff_fn(is_causal, scale, bias is not None, interpret,
-                       float(dropout_p), block_q, block_k)
-    return f(q, k, v, bias, dropout_seed)
+                       float(dropout_p), block_q, block_k,
+                       segment_ids is not None)
+    return f(q, k, v, bias, segment_ids, kv_segment_ids, dropout_seed)
 
 
 _FLASH_PROBED = {}
@@ -827,9 +1084,12 @@ _NO_FLASH = object()
 
 
 def _seed_from_key(key):
-    """int32[1] kernel seed from a jax PRNG key (typed or raw). A plain
-    bitcast of the key data (no extra RNG draw): per-step keys are
-    already folded from the step counter upstream."""
+    """int32[1] kernel seed from a jax PRNG key (typed or raw), folding
+    ALL key words (odd-multiply + xor chain, no extra RNG draw). The
+    old code took only the FIRST word — the threefry HIGH word, which
+    is zero for every PRNGKey(n) with n < 2^32, so plain per-step keys
+    all mapped to seed 0 (ADVICE r05 medium). For such keys the fold
+    reduces to the low word; distinct keys give distinct seeds."""
     import jax
     import jax.numpy as jnp
 
@@ -837,8 +1097,13 @@ def _seed_from_key(key):
         data = jax.random.key_data(key)
     except Exception:
         data = key
-    data = jnp.ravel(data)[:1]
-    return jax.lax.bitcast_convert_type(data, jnp.int32)
+    data = jnp.ravel(data)
+    acc = jax.lax.bitcast_convert_type(data[:1], jnp.uint32).reshape(-1)
+    for i in range(1, int(data.shape[0])):
+        w = jax.lax.bitcast_convert_type(data[i:i + 1],
+                                         jnp.uint32).reshape(-1)
+        acc = acc * jnp.uint32(0x9E3779B9) ^ w
+    return jax.lax.bitcast_convert_type(acc[:1], jnp.int32)
 
 
 def _flash_plan(seq_q, seq_k, head_dim, mask, batch, heads,
@@ -868,8 +1133,25 @@ def _flash_plan(seq_q, seq_k, head_dim, mask, batch, heads,
     return bias
 
 
+def _with_segment_mask(mask, segment_ids, bshd=False):
+    """Fold packed segment ids into a dense additive mask for the XLA
+    reference paths (broadcasts over heads and, via [b,1,sq,sk], both
+    layouts)."""
+    import jax.numpy as jnp
+
+    if segment_ids is None:
+        return mask
+    sb = segment_bias(segment_ids)
+    if mask is None:
+        return sb
+    m = mask
+    if m.dtype == jnp.bool_:
+        m = jnp.where(m, 0.0, -1e30).astype(jnp.float32)
+    return m + sb
+
+
 def sdpa_bshd(q, k, v, mask=None, is_causal=False, scale=None,
-              dropout_p=0.0, dropout_key=None):
+              dropout_p=0.0, dropout_key=None, segment_ids=None):
     """sdpa over [B, S, H, D] operands. Flash engages at seq >=
     PT_FLASH_MIN_SEQ_BSHD (default 1024). Measured in-model (ERNIE b8
     seq1024, bench `ernie_long`, r05 kernel with 512x512 blocks +
@@ -878,14 +1160,24 @@ def sdpa_bshd(q, k, v, mask=None, is_causal=False, scale=None,
     draws RNG for the full [B,H,S,S] prob tensor while the kernel's
     counter-addressed in-kernel bits are ~free. (r04's kernel LOST
     in-model at 1024, 0.94x, which is why the old default was 8192;
-    the r05 block-tuning flipped it.)"""
+    the r05 block-tuning flipped it.)
+
+    segment_ids ([B, S] int, packed-layout monotone rows) routes the
+    PACKED flash path: same-segment masking in-kernel with block-level
+    early-out; the packed gate uses PT_FLASH_MIN_SEQ (512) rather than
+    the BSHD in-model threshold because the packed kernel also SKIPS
+    cross-segment blocks — it wins earlier."""
     import jax.numpy as jnp
 
     if q.ndim == 4:
-        env = "PT_FLASH_MIN_SEQ_BSHD_DROP" if dropout_p else \
-            "PT_FLASH_MIN_SEQ_BSHD"
-        min_bshd = int(os.environ.get(env, "1024"))
-        bias = (_NO_FLASH if q.shape[1] < min_bshd else
+        if segment_ids is None:
+            env = "PT_FLASH_MIN_SEQ_BSHD_DROP" if dropout_p else \
+                "PT_FLASH_MIN_SEQ_BSHD"
+            min_bshd = int(os.environ.get(env, "1024"))
+            too_short = q.shape[1] < min_bshd
+        else:
+            too_short = False
+        bias = (_NO_FLASH if too_short else
                 _flash_plan(q.shape[1], k.shape[1], q.shape[-1], mask,
                             q.shape[0], q.shape[2], dropout_p,
                             dropout_key))
@@ -895,22 +1187,27 @@ def sdpa_bshd(q, k, v, mask=None, is_causal=False, scale=None,
                 out = flash_attention(
                     jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
                     jnp.swapaxes(v, 1, 2), bias, is_causal, scale,
-                    dropout_p=dropout_p, dropout_seed=seed)
+                    dropout_p=dropout_p, dropout_seed=seed,
+                    segment_ids=segment_ids)
                 return jnp.swapaxes(out, 1, 2)
             except Exception:
                 pass
-    return sdpa_reference_bshd(q, k, v, mask, is_causal, scale,
-                               dropout_p, dropout_key)
+    return sdpa_reference_bshd(q, k, v,
+                               _with_segment_mask(mask, segment_ids),
+                               is_causal, scale, dropout_p, dropout_key)
 
 
 def sdpa(q, k, v, mask=None, is_causal=False, scale=None,
-         dropout_p=0.0, dropout_key=None):
+         dropout_p=0.0, dropout_key=None, segment_ids=None):
     """Dispatch: pallas flash fwd+bwd on TPU whenever the mask reduces to
     a key-position bias (incl. every padded batch); XLA reference
     otherwise. Short sequences (< 512) stay on the XLA path — its fused
     attention beats the blockwise kernel there and the S x S buffer is
     tiny; flash pays off where it matters, long context (measured:
-    ERNIE seq 128 is ~2% faster on the reference path)."""
+    ERNIE seq 128 is ~2% faster on the reference path). segment_ids
+    ([B, S] int, packed monotone rows from core/lod.pack_padded) engage
+    the segment-masked packed kernel; off-TPU or when any gate fails,
+    the reference composition applies the same segment mask densely."""
     if q.ndim == 4:
         bias = _flash_plan(q.shape[2], k.shape[2], q.shape[-1], mask,
                            q.shape[0], q.shape[1], dropout_p,
@@ -920,8 +1217,9 @@ def sdpa(q, k, v, mask=None, is_causal=False, scale=None,
                 seed = _seed_from_key(dropout_key) if dropout_p else None
                 return flash_attention(q, k, v, bias, is_causal, scale,
                                        dropout_p=dropout_p,
-                                       dropout_seed=seed)
+                                       dropout_seed=seed,
+                                       segment_ids=segment_ids)
             except Exception:
                 pass
-    return sdpa_reference(q, k, v, mask, is_causal, scale,
-                          dropout_p, dropout_key)
+    return sdpa_reference(q, k, v, _with_segment_mask(mask, segment_ids),
+                          is_causal, scale, dropout_p, dropout_key)

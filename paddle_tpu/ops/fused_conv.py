@@ -47,9 +47,11 @@ blocks where the producer is NOT a dot (e.g. gather+reduce chains).
 
 Layout: NCHW with HW flattened to the lane axis — full-HW blocks, so
 no transposes anywhere (a relayout would eat the savings). Mosaic pads
-lanes to 128; padded lanes are masked out of the stats and dW
-contractions. Stride-1 1x1 convs only (the bottleneck's conv1/conv3);
-3x3, strided, and projection convs stay on XLA.
+lanes to 128 physically, but jnp reductions inside the kernel operate
+on the LOGICAL block shape, so the stats and dW contractions never see
+padded lanes — no masking needed. Stride-1 1x1 convs only (the
+bottleneck's conv1/conv3); 3x3, strided, and projection convs stay on
+XLA.
 """
 from __future__ import annotations
 
@@ -60,12 +62,6 @@ import numpy as np
 from .attention import _import_pallas, _z
 
 
-def _lane_mask(jnp, jax, co, hw, hw_pad):
-    """[1, hw_pad] bool, True on real lanes."""
-    return (jax.lax.broadcasted_iota(jnp.int32, (1, hw_pad), 1)
-            < jnp.int32(hw))
-
-
 @functools.lru_cache(maxsize=None)
 def _fwd_call(B, Ci, Co, HW, relu, has_norm, dtype_str, interpret):
     import jax
@@ -73,7 +69,6 @@ def _fwd_call(B, Ci, Co, HW, relu, has_norm, dtype_str, interpret):
 
     pl = _import_pallas()
     dtype = jnp.dtype(dtype_str)
-    masked = HW % 128 != 0
 
     def kernel(x_ref, sc_ref, sh_ref, w_ref, z_ref, s_ref, ss_ref):
         b = pl.program_id(0)
@@ -89,9 +84,11 @@ def _fwd_call(B, Ci, Co, HW, relu, has_norm, dtype_str, interpret):
             w_ref[...], xn, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)        # [Co, HW]
         z_ref[...] = z.astype(z_ref.dtype)
-        if masked:
-            z = jnp.where(_lane_mask(jnp, jax, Co, HW, z.shape[1]),
-                          z, jnp.float32(0.0))
+        # no lane masking needed: reductions here see the LOGICAL block
+        # shape (z.shape[1] == HW at trace level) — Mosaic's physical
+        # lane padding to 128 is invisible to jnp ops, so stats over
+        # axis 1 already exclude it (an iota < HW mask was all-true
+        # dead code, ADVICE r05)
         s_part = z.sum(axis=1, keepdims=True)          # [Co, 1]
         ss_part = (z * z).sum(axis=1, keepdims=True)
         first = b == 0
@@ -129,7 +126,6 @@ def _bwd_call(B, Ci, Co, HW, relu, has_norm, dtype_str, interpret):
 
     pl = _import_pallas()
     dtype = jnp.dtype(dtype_str)
-    masked = HW % 128 != 0
 
     def kernel(x_ref, sc_ref, sh_ref, w_ref, z_ref, dz_ref, ds_ref,
                dss_ref, dx_ref, dw_ref, dsc_ref, dsh_ref):
@@ -137,11 +133,10 @@ def _bwd_call(B, Ci, Co, HW, relu, has_norm, dtype_str, interpret):
         x = x_ref[...]
         dz = dz_ref[...].astype(jnp.float32)
         z = z_ref[...].astype(jnp.float32)
+        # logical-shape ops never see Mosaic's lane padding (see the
+        # fwd kernel note), so dz_eff needs no lane mask before the dW
+        # contraction either
         dz_eff = dz + ds_ref[...] + 2.0 * z * dss_ref[...]
-        if masked:
-            dz_eff = jnp.where(
-                _lane_mask(jnp, jax, Co, HW, dz_eff.shape[1]),
-                dz_eff, jnp.float32(0.0))
         if has_norm:
             pre = x.astype(jnp.float32) * sc_ref[...] + sh_ref[...]
             mask = pre > 0 if relu else None

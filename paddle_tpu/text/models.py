@@ -73,7 +73,17 @@ class ErnieEmbeddings(nn.Layer):
 
 
 class ErnieModel(nn.Layer):
-    """BERT/ERNIE encoder. attention_mask: (B, S) 1/0 valid-token mask."""
+    """BERT/ERNIE encoder. attention_mask: (B, S) 1/0 valid-token mask.
+
+    Packed varlen feeds (LoD-native fine-tuning): pass the outputs of
+    core/lod.pack_padded instead of a padded batch — `input_ids` =
+    packed.data, `position_ids` = packed.positions, `attn_segment_ids`
+    = packed.segment_ids, and `cls_flat_index` = packed.cls_flat_index()
+    to pool each SEQUENCE's first token (several sequences share a
+    row, so `seq_out[:, 0]` would miss all but the first). No dense
+    attention_mask is needed: pads form their own segment, and the
+    attention dispatcher routes segment ids to the segment-masked
+    packed flash kernel on TPU."""
 
     def __init__(self, cfg: ErnieConfig):
         super().__init__()
@@ -90,7 +100,8 @@ class ErnieModel(nn.Layer):
         self.pooler_act = nn.Tanh()
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
-                attention_mask=None):
+                attention_mask=None, attn_segment_ids=None,
+                cls_flat_index=None):
         from ..tensor import ops as T
 
         if attention_mask is not None:
@@ -100,8 +111,14 @@ class ErnieModel(nn.Layer):
         else:
             mask = None
         x = self.embeddings(input_ids, token_type_ids, position_ids)
-        seq_out = self.encoder(x, mask)
-        pooled = self.pooler_act(self.pooler(seq_out[:, 0]))
+        seq_out = self.encoder(x, mask, segment_ids=attn_segment_ids)
+        if cls_flat_index is not None:
+            b, s, hdim = seq_out.shape
+            flat = seq_out.reshape([b * s, hdim])
+            cls_tok = T.index_select(flat, cls_flat_index, axis=0)
+        else:
+            cls_tok = seq_out[:, 0]
+        pooled = self.pooler_act(self.pooler(cls_tok))
         return seq_out, pooled
 
 
@@ -112,9 +129,14 @@ class ErnieForSequenceClassification(nn.Layer):
         self.dropout = nn.Dropout(cfg.hidden_dropout)
         self.classifier = nn.Linear(cfg.hidden_size, cfg.num_classes)
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                position_ids=None, attn_segment_ids=None,
+                cls_flat_index=None):
         _, pooled = self.ernie(input_ids, token_type_ids,
-                               attention_mask=attention_mask)
+                               position_ids=position_ids,
+                               attention_mask=attention_mask,
+                               attn_segment_ids=attn_segment_ids,
+                               cls_flat_index=cls_flat_index)
         return self.classifier(self.dropout(pooled))
 
 

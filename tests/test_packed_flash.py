@@ -1,0 +1,356 @@
+"""Segment-aware packed flash attention (the LoD-native varlen path).
+
+Reference analogue: the varlen fused encoder the CUDA reference built
+for ragged NLP batches (math/bert_encoder_functor.cu over
+lod_tensor.h:104 offsets). Covered here, all on the CPU interpreter
+path:
+
+- LoD -> (packed tokens, segment_ids, positions) round-trip
+  (core/lod.pack_padded / pack_sequences / LoDTensor.to_packed)
+- segment-masked flash forward AND backward parity vs the XLA
+  reference composition on ragged batches whose segment boundaries
+  cross block boundaries — causal and not, bias and not
+- the same parity with dropout ON: interpret-mode kernels draw
+  counter-hash bits that dropout_keep_reference reproduces host-side,
+  so the comparison is exact, not statistical
+- auto-dispatch: sdpa/sdpa_bshd select the packed flash path from
+  segment metadata alone (no user flags), and the off-TPU fallback
+  applies the same segment mask densely
+- the r05 ADVICE dropout-seed fixes (high-word-only seed, additive
+  head folding, unasserted ki bound)
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.core.lod import LoDTensor, pack_padded, pack_sequences
+from paddle_tpu.ops import attention as A
+
+
+def _rand(shape, seed):
+    return np.random.RandomState(seed).randn(*shape).astype("float32")
+
+
+def _ragged_segs(lens_rows, s):
+    """Per-row monotone segment ids from per-row segment lengths."""
+    rows = []
+    for lens in lens_rows:
+        assert sum(lens) == s
+        rows.append(np.concatenate(
+            [np.full(n, i, np.int32) for i, n in enumerate(lens)]))
+    return np.stack(rows)
+
+
+# ---------------------------------------------------------------- packing
+
+def test_pack_padded_round_trip():
+    rs = np.random.RandomState(0)
+    lens = [50, 30, 64, 20, 44, 10]
+    B, T, D = len(lens), 64, 8
+    padded = rs.randn(B, T, D).astype("float32")
+    for b, n in enumerate(lens):
+        padded[b, n:] = 0.0
+    pk = pack_padded(padded, lens, row_len=T)
+    # monotone ids per row (the kernel's early-out contract)
+    assert np.all(np.diff(pk.segment_ids, axis=1) >= 0)
+    # pads form their own trailing segment per row
+    for r in range(pk.num_rows):
+        real = [i for (i, (rr, s, n)) in enumerate(pk.spans) if rr == r]
+        if real:
+            fill = sum(pk.spans[i][2] for i in real)
+            if fill < pk.row_len:
+                assert pk.segment_ids[r, -1] == max(real) + 1
+    # positions restart at 0 per sequence
+    for i, (r, s, n) in enumerate(pk.spans):
+        np.testing.assert_array_equal(pk.positions[r, s:s + n],
+                                      np.arange(n))
+        np.testing.assert_allclose(pk.data[r, s:s + n],
+                                   padded[i, :lens[i]])
+    # unpack -> LoDTensor with the original level-1 lod
+    lt = pk.unpack()
+    assert lt.recursive_sequence_lengths() == [lens]
+    np.testing.assert_allclose(
+        np.asarray(lt), np.concatenate(
+            [padded[b, :n] for b, n in enumerate(lens)]))
+    # fill improves on padding whenever sequences share rows
+    assert pk.num_rows < B
+    assert 0.0 < pk.fill <= 1.0
+
+
+def test_lod_tensor_to_packed():
+    seqs = [np.arange(n, dtype="float32").reshape(n, 1) * (i + 1)
+            for i, n in enumerate([7, 3, 5, 2])]
+    lt = LoDTensor.from_sequences(seqs)
+    pk = lt.to_packed(row_len=8)
+    back = pk.unpack()
+    assert back.recursive_sequence_lengths() == [[7, 3, 5, 2]]
+    np.testing.assert_allclose(np.asarray(back), np.asarray(lt))
+    # cls_flat_index points at each sequence's first token
+    flat = pk.data.reshape(-1, 1)
+    for i, fi in enumerate(pk.cls_flat_index()):
+        np.testing.assert_allclose(flat[fi], seqs[i][0])
+
+
+def test_pack_rejects_oversized_sequence():
+    with pytest.raises(ValueError, match="does not fit"):
+        pack_sequences([np.zeros((9, 2))], row_len=8)
+
+
+# ------------------------------------------------- kernel parity (fwd+bwd)
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("bias", [False, True])
+def test_segment_flash_fwd_bwd_parity(causal, bias):
+    """Boundary-heavy ragged batch: segment lengths deliberately NOT
+    multiples of the 64-token blocks, so both boundary blocks (token
+    mask) and interior blocks (early-out bounds) are exercised."""
+    import jax
+    import jax.numpy as jnp
+
+    b, h, s, d = 2, 3, 256, 32
+    seg = _ragged_segs([[100, 60, 96], [200, 40, 16]], s)
+    q, k, v = _rand((b, h, s, d), 0), _rand((b, h, s, d), 1), \
+        _rand((b, h, s, d), 2)
+    cot = _rand((b, h, s, d), 3)
+    if bias:
+        # small random key bias (ALiBi-style), NOT a full -inf segment
+        # mask: fully masking a whole segment leaves its queries with
+        # zero valid keys, where the reference softmax degenerates to
+        # uniform and any two implementations legitimately differ
+        bias_arr = (_rand((b, s), 12) * 0.5).astype("float32")
+        jbias = jnp.asarray(bias_arr)
+    else:
+        bias_arr = jbias = None
+
+    def ref_loss(q, k, v):
+        m4 = A.segment_bias(jnp.asarray(seg))
+        if bias_arr is not None:
+            m4 = m4 + bias_arr[:, None, None, :]
+        return (A.sdpa_reference(q, k, v, m4, causal) * cot).sum()
+
+    def fl_loss(q, k, v):
+        out = A.flash_attention(q, k, v, jbias, causal, None,
+                                interpret=True, block_q=64, block_k=64,
+                                segment_ids=jnp.asarray(seg))
+        return (out * cot).sum()
+
+    rv, rg = jax.value_and_grad(ref_loss, (0, 1, 2))(q, k, v)
+    fv, fg = jax.value_and_grad(fl_loss, (0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(float(fv), float(rv), rtol=2e-4)
+    for name, a_, b_ in zip("qkv", fg, rg):
+        np.testing.assert_allclose(np.asarray(a_), np.asarray(b_),
+                                   rtol=2e-3, atol=2e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_segment_flash_dropout_on_exact_parity():
+    """Dropout ON, CPU interpreter path: the kernels draw counter-hash
+    bits (the Mosaic PRNG has no CPU lowering) and
+    dropout_keep_reference reproduces them host-side, so flash fwd AND
+    bwd must match an XLA composition using the SAME keep mask exactly
+    — this pins the dropout composition math (raw-p normalizer, masked
+    acc matmul, bwd mask regeneration across both kernels), not just
+    its statistics."""
+    import jax
+    import jax.numpy as jnp
+
+    b, h, s, d = 1, 2, 256, 32
+    bq = bk = 64
+    P, seed = 0.3, 17
+    seg = _ragged_segs([[100, 90, 66]], s)
+    q, k, v = _rand((b, h, s, d), 4), _rand((b, h, s, d), 5), \
+        _rand((b, h, s, d), 6)
+    cot = _rand((b, h, s, d), 7)
+    keep4 = jnp.asarray(A.dropout_keep_reference(
+        seed, b, h, s, s, bq, bk, P).reshape(b, h, s, s))
+
+    def ref_loss(q, k, v):
+        logits = jnp.einsum("...qd,...kd->...qk", q, k) / np.sqrt(d)
+        logits = logits + A.segment_bias(jnp.asarray(seg))
+        probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
+        probs = jnp.where(keep4, probs / (1.0 - P), 0.0)
+        out = jnp.einsum("...qk,...kd->...qd", probs.astype(q.dtype), v)
+        return (out * cot).sum()
+
+    def fl_loss(q, k, v):
+        out = A.flash_attention(
+            q, k, v, None, False, None, interpret=True, block_q=bq,
+            block_k=bk, dropout_p=P,
+            dropout_seed=jnp.array([seed], jnp.int32),
+            segment_ids=jnp.asarray(seg))
+        return (out * cot).sum()
+
+    rv, rg = jax.value_and_grad(ref_loss, (0, 1, 2))(q, k, v)
+    fv, fg = jax.value_and_grad(fl_loss, (0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(float(fv), float(rv), rtol=2e-4)
+    for name, a_, b_ in zip("qkv", fg, rg):
+        np.testing.assert_allclose(np.asarray(a_), np.asarray(b_),
+                                   rtol=2e-3, atol=2e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_segment_early_out_no_cross_leakage():
+    """Make the other segment's values enormous: if any early-out bound
+    or boundary mask were off by one block, the huge values would leak
+    into this segment's output."""
+    import jax.numpy as jnp
+
+    b, h, s, d = 1, 1, 256, 16
+    seg = _ragged_segs([[130, 126]], s)
+    q, k = _rand((b, h, s, d), 8), _rand((b, h, s, d), 9)
+    v = _rand((b, h, s, d), 10)
+    v2 = v.copy()
+    v2[:, :, 130:] = 1e6          # only segment 1 changes
+    out1 = np.asarray(A.flash_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), None, False,
+        None, interpret=True, block_q=64, block_k=64,
+        segment_ids=jnp.asarray(seg)))
+    out2 = np.asarray(A.flash_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v2), None, False,
+        None, interpret=True, block_q=64, block_k=64,
+        segment_ids=jnp.asarray(seg)))
+    np.testing.assert_array_equal(out1[:, :, :130], out2[:, :, :130])
+    assert np.abs(out2[:, :, 130:]).max() > 1e5
+
+
+# --------------------------------------------------------------- dispatch
+
+def test_sdpa_routes_segments_to_flash(monkeypatch):
+    """The dispatcher must hand segment metadata to the flash kernel by
+    itself — no user flags — whenever the flash gates pass."""
+    import jax.numpy as jnp
+
+    calls = {}
+
+    def fake_flash(q, k, v, bias, is_causal, scale, dropout_p=0.0,
+                   dropout_seed=None, segment_ids=None, **kw):
+        calls["segment_ids"] = segment_ids
+        return jnp.zeros_like(q)
+
+    monkeypatch.setattr(A, "_on_tpu", lambda: True)
+    monkeypatch.setattr(A, "_flash_usable", lambda: True)
+    monkeypatch.setattr(A, "flash_attention", fake_flash)
+    b, h, s, d = 2, 2, 1024, 64
+    q = jnp.zeros((b, h, s, d), jnp.float32)
+    seg = jnp.asarray(_ragged_segs([[700, 324], [500, 524]], s))
+    A.sdpa(q, q, q, segment_ids=seg)
+    assert calls["segment_ids"] is seg
+    # BSHD layout too (the in-model path)
+    calls.clear()
+    qs = jnp.zeros((b, s, h, d), jnp.float32)
+    A.sdpa_bshd(qs, qs, qs, segment_ids=seg)
+    assert calls["segment_ids"] is seg
+
+
+def test_sdpa_fallback_applies_segment_mask():
+    """Off-TPU (this suite) sdpa must still enforce the segment mask via
+    the reference composition."""
+    import jax.numpy as jnp
+
+    b, h, s, d = 1, 2, 64, 16
+    seg = _ragged_segs([[40, 24]], s)
+    q, k, v = _rand((b, h, s, d), 11), _rand((b, h, s, d), 12), \
+        _rand((b, h, s, d), 13)
+    got = np.asarray(A.sdpa(jnp.asarray(q), jnp.asarray(k),
+                            jnp.asarray(v), segment_ids=jnp.asarray(seg)))
+    want = np.asarray(A.sdpa_reference(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        A.segment_bias(jnp.asarray(seg)), False))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_packed_lod_to_model_dispatch(monkeypatch):
+    """End-to-end LoD metadata selection: pack a ragged batch, feed the
+    packed segment ids through nn.functional -> sdpa_bshd, and check
+    the flash path receives them (auto-routing from LoD metadata)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.layer.transformer import MultiHeadAttention
+
+    seen = {}
+    real_bshd = A.sdpa_bshd
+
+    def spy_bshd(q, k, v, mask=None, is_causal=False, scale=None,
+                 dropout_p=0.0, dropout_key=None, segment_ids=None):
+        seen["segment_ids"] = segment_ids
+        return real_bshd(q, k, v, mask, is_causal, scale, dropout_p,
+                         dropout_key, segment_ids)
+
+    monkeypatch.setattr(A, "sdpa_bshd", spy_bshd)
+    rs = np.random.RandomState(0)
+    lens = [30, 20, 14]
+    pk = pack_padded(rs.randn(3, 32, 16).astype("f4"), lens, row_len=64)
+    x = paddle.to_tensor(pk.data.reshape(pk.num_rows, 64, 16)
+                         .astype("float32"))
+    attn = MultiHeadAttention(16, 2)
+    attn.eval()
+    out = attn(x, segment_ids=paddle.to_tensor(pk.segment_ids))
+    assert seen["segment_ids"] is not None
+    assert out.shape == list(x.shape)
+
+
+def test_ernie_packed_matches_padded():
+    """Full-model check: the packed ERNIE feed (segment ids + packed
+    positions + per-sequence CLS gather) reproduces the padded batch's
+    logits with dropout off."""
+    import paddle_tpu as paddle
+    from paddle_tpu.text import ErnieConfig, ErnieForSequenceClassification
+
+    rs = np.random.RandomState(0)
+    cfg = ErnieConfig.tiny(hidden_dropout=0.0, attn_dropout=0.0,
+                           max_position=128)
+    net = ErnieForSequenceClassification(cfg)
+    net.eval()
+    lens = [50, 30, 64, 20, 44, 10]
+    B, T = len(lens), 64
+    ids = np.zeros((B, T), np.int64)
+    mask = np.zeros((B, T), np.float32)
+    for b, n in enumerate(lens):
+        ids[b, :n] = rs.randint(1, cfg.vocab_size, n)
+        mask[b, :n] = 1.0
+    want = np.asarray(net(paddle.to_tensor(ids),
+                          attention_mask=paddle.to_tensor(mask))._data)
+    pk = pack_padded(ids, lens, row_len=T)
+    assert pk.num_rows < B          # packing actually packed
+    got = np.asarray(net(
+        paddle.to_tensor(pk.data.astype(np.int64)),
+        position_ids=paddle.to_tensor(pk.positions.astype(np.int64)),
+        attn_segment_ids=paddle.to_tensor(pk.segment_ids),
+        cls_flat_index=paddle.to_tensor(
+            pk.cls_flat_index().astype(np.int64)))._data)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+# ------------------------------------------------ ADVICE r05 seed fixes
+
+def test_seed_from_key_distinct_for_small_keys():
+    """Regression: the old seed took the threefry HIGH word (zero for
+    every PRNGKey(n), n < 2^32) — all small keys collided at seed 0."""
+    import jax
+
+    seeds = {int(np.asarray(A._seed_from_key(jax.random.PRNGKey(n)))[0])
+             for n in range(16)}
+    assert len(seeds) == 16
+    assert seeds != {0}
+
+
+def test_drop_grid_bound_asserted():
+    with pytest.raises(ValueError, match="4096"):
+        A._check_drop_grid(sk=4096 * 128 + 128, block_k=128)
+    A._check_drop_grid(sk=4096 * 128, block_k=128)   # boundary ok
+
+
+def test_hash_bits_decorrelate_seed_and_head():
+    """Regression for the additive (seed + bh) folding: (seed, head)
+    and (seed+1, head-1) must not produce identical streams."""
+    import jax
+    import jax.numpy as jnp
+
+    def bits(seed, bh):
+        return np.asarray(A._hash_bits(
+            jnp, jax, jnp.int32(seed), jnp.int32(bh), jnp.int32(0),
+            jnp.int32(0), 8, 128))
+
+    assert not np.array_equal(bits(3, 2), bits(4, 1))
+    assert not np.array_equal(bits(3, 2), bits(2, 3))
+    assert np.array_equal(bits(3, 2), bits(3, 2))   # deterministic

@@ -9,6 +9,13 @@ inputs come from in-program random ops, then times it two ways:
            (the random feeder consumes the per-step rng key, so XLA
            cannot hoist the op out of the loop)
 
+`*_bwd` configs time the op's forward PLUS its backward: the scalar
+reduction of the op output is differentiated w.r.t. the hot input
+slots via fluid.gradients (the jax_autodiff op), and every gradient
+feeds the persistable accumulator so neither pass can be DCE'd out of
+the scan — the CI gate watches training-path regressions, not just
+inference (VERDICT weak #4).
+
 Usage:
   python tools/op_bench.py                 # full table -> OP_BENCH.json
   python tools/op_bench.py --quick         # first 8 configs
@@ -206,6 +213,7 @@ def _configs():
             {"Out": 1}, {"in_dtype": "float32", "out_dtype": "float16"})),
     ]
     cfgs += _configs_extended(simple, unary)
+    cfgs += _configs_bwd(cfgs)
     return cfgs
 
 
@@ -595,6 +603,116 @@ def _configs_extended(simple, unary):
     return cfgs
 
 
+def _bwd(builder, *slots):
+    """Wrap a forward builder into a fwd+bwd config: the 5th tuple slot
+    names the input slots to differentiate; bench_one appends a
+    fluid.gradients (jax_autodiff) op over the scalar reduction of the
+    op's first output and accumulates every gradient, so the scan times
+    the full forward + backward of the op."""
+    def build(blk, scope):
+        op, ins, outs, attrs = builder(blk, scope)
+        return op, ins, outs, attrs, list(slots)
+    return build
+
+
+# (forward config name, input slots to differentiate) — the hot
+# families first (attention / matmul / embedding / norm), then
+# activation, loss, elementwise and indexing breadth: the CI perf gate
+# (scripts/ci.sh --compare) was forward-only before (VERDICT weak #4)
+_BWD_FAMILIES = [
+    # attention + matmul family
+    ("fused_sdpa", ["Q", "K", "V"]),
+    ("multihead_matmul", ["Input"]),
+    ("matmul", ["X"]), ("matmul_v2", ["X"]), ("mul", ["X"]),
+    ("fc", ["Input"]), ("bmm", ["X", "Y"]),
+    # embedding family (grads w.r.t. the table, the trained operand)
+    ("lookup_table_v2", ["W"]), ("lookup_table", ["W"]),
+    ("gather", ["X"]), ("gather_nd", ["X"]), ("index_select", ["X"]),
+    # norms
+    ("layer_norm", ["X"]), ("batch_norm", ["X"]),
+    ("instance_norm", ["X"]), ("group_norm", ["X"]),
+    ("skip_layernorm", ["X"]),
+    ("fused_fc_elementwise_layernorm", ["X"]),
+    # activations
+    ("softmax", ["X"]), ("log_softmax", ["X"]), ("relu", ["X"]),
+    ("gelu", ["X"]), ("tanh", ["X"]), ("sigmoid", ["X"]),
+    ("leaky_relu", ["X"]), ("swish", ["X"]), ("dropout", ["X"]),
+    # losses
+    ("softmax_with_cross_entropy", ["Logits"]),
+    ("sigmoid_cross_entropy_with_logits", ["X"]),
+    ("smooth_l1_loss", ["X"]), ("huber_loss", ["X"]),
+    ("bce_loss", ["X"]), ("kldiv_loss", ["X"]),
+    ("squared_l2_norm", ["X"]),
+    # elementwise / reduction / shape breadth
+    ("elementwise_add", ["X", "Y"]), ("elementwise_mul", ["X", "Y"]),
+    ("elementwise_sub", ["X"]), ("elementwise_div", ["X"]),
+    ("reduce_sum", ["X"]), ("reduce_mean", ["X"]), ("mean", ["X"]),
+    ("cumsum", ["X"]), ("sum3", ["X"]), ("scale", ["X"]),
+    ("transpose2", ["X"]), ("reshape2", ["X"]), ("concat", ["X"]),
+    ("split", ["X"]), ("slice", ["Input"]),
+    ("pool2d", ["X"]), ("pool2d_avg", ["X"]),
+    ("tile", ["X"]), ("expand_v2", ["X"]), ("stack", ["X"]),
+]
+
+
+def _conv_bwd_cfgs(simple):
+    """Conv-family backward configs get DEDICATED, smaller shapes: the
+    forward conv configs run seconds-per-step on the CPU gate machine
+    and a backward pass multiplies that ~3x — same op lowering, same
+    regression signal, tractable wall-clock."""
+    def c(name, op, ins, outs, attrs, slots):
+        return (f"{name}_bwd", _bwd(simple(op, ins, outs, attrs),
+                                    *slots))
+    return [
+        c("conv2d", "conv2d",
+          lambda b, s: {"Input": [_f((4, 32, 28, 28), "x", b)],
+                        "Filter": [_p((32, 32, 3, 3), "w", b, s)]},
+          {"Output": 1},
+          {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+           "groups": 1}, ["Input", "Filter"]),
+        c("conv2d_1x1", "conv2d",
+          lambda b, s: {"Input": [_f((4, 128, 28, 28), "x", b)],
+                        "Filter": [_p((32, 128, 1, 1), "w", b, s)]},
+          {"Output": 1},
+          {"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+           "groups": 1}, ["Input"]),
+        c("conv2d_s2", "conv2d",
+          lambda b, s: {"Input": [_f((4, 64, 28, 28), "x", b)],
+                        "Filter": [_p((64, 64, 3, 3), "w", b, s)]},
+          {"Output": 1},
+          {"strides": [2, 2], "paddings": [1, 1], "dilations": [1, 1],
+           "groups": 1}, ["Input"]),
+        c("depthwise_conv2d", "depthwise_conv2d",
+          lambda b, s: {"Input": [_f((4, 32, 28, 28), "x", b)],
+                        "Filter": [_p((32, 1, 3, 3), "w", b, s)]},
+          {"Output": 1},
+          {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+           "groups": 32}, ["Input"]),
+        c("conv2d_transpose", "conv2d_transpose",
+          lambda b, s: {"Input": [_f((4, 64, 14, 14), "x", b)],
+                        "Filter": [_p((64, 32, 2, 2), "w", b, s)]},
+          {"Output": 1},
+          {"strides": [2, 2], "paddings": [0, 0], "dilations": [1, 1],
+           "groups": 1}, ["Input"]),
+    ]
+
+
+def _configs_bwd(fwd_cfgs):
+    def simple(op, ins, outs, attrs=None):
+        def build(blk, scope):
+            return op, ins(blk, scope), outs, (attrs or {})
+        return build
+
+    by_name = dict((n, b) for n, b, *_ in fwd_cfgs)
+    cfgs = [(f"{name}_bwd", _bwd(by_name[name], *slots))
+            for name, slots in _BWD_FAMILIES if name in by_name]
+    cfgs += _conv_bwd_cfgs(simple)
+    # fwd+bwd scans are ~3x the forward work: shorter scans keep the
+    # table generation tractable without losing the marginal-slope
+    # methodology (lo becomes 3)
+    return [(n, b, {"steps": 12}) for n, b in cfgs]
+
+
 def _rnn_cfg(op, gates, SB, ST, SD, outs, attrs):
     def build(blk, scope):
         xg = _f((SB, ST, gates * SD), "xg", blk)
@@ -721,7 +839,9 @@ def bench_one(name, builder, steps=30):
         with fluid.unique_name.guard(), fluid.program_guard(main,
                                                             startup):
             blk = main.global_block()
-            op, ins, outs, attrs = builder(blk, scope)
+            built = builder(blk, scope)
+            op, ins, outs, attrs = built[:4]
+            wrt_slots = built[4] if len(built) > 4 else None
             out_map = {}
             for slot, n_out in outs.items():
                 out_map[slot] = [
@@ -750,6 +870,25 @@ def bench_one(name, builder, steps=30):
             blk.append_op(type="elementwise_add",
                           inputs={"X": ["ob_acc"], "Y": [cst]},
                           outputs={"Out": ["ob_acc"]}, attrs={})
+            if wrt_slots:
+                # backward config: differentiate the scalar reduction
+                # w.r.t. the named input slots (jax_autodiff op) and
+                # fold every grad into the accumulator so neither pass
+                # can be dead-code eliminated out of the scan
+                wrt_vars = [blk.var(n) for slot in wrt_slots
+                            for n in ins[slot]]
+                grads = fluid.gradients([red], wrt_vars)
+                for i, g in enumerate(grads):
+                    rg = blk.create_var(name=f"ob_gred_{i}")
+                    blk.append_op(type="reduce_sum",
+                                  inputs={"X": [g.name]},
+                                  outputs={"Out": [rg.name]},
+                                  attrs={"dim": [], "reduce_all": True,
+                                         "keep_dim": False})
+                    blk.append_op(type="elementwise_add",
+                                  inputs={"X": ["ob_acc"],
+                                          "Y": [rg.name]},
+                                  outputs={"Out": ["ob_acc"]}, attrs={})
         scope.set_value("ob_acc", np.zeros(1, np.float32))
         exe = fluid.Executor()
         exe.run(startup)
@@ -793,6 +932,10 @@ def main():
     ap.add_argument("--compare", action="store_true",
                     help="compare against the committed baseline; exit 1 "
                          "when any op's step_us regressed >2x")
+    ap.add_argument("--merge", action="store_true",
+                    help="merge benched ops into the existing table "
+                         "instead of clobbering it (e.g. generate only "
+                         "the new _bwd rows: --ops ... --merge)")
     args = ap.parse_args()
     if args.cpu:
         sys.path.insert(0, REPO)
@@ -806,9 +949,10 @@ def main():
         cfgs = cfgs[:8]
 
     results = {}
-    for name, builder in cfgs:
+    for name, builder, *rest in cfgs:
+        opts = rest[0] if rest else {}
         try:
-            results[name] = bench_one(name, builder)
+            results[name] = bench_one(name, builder, **opts)
         except Exception as e:  # record, keep the table alive
             results[name] = {"error": f"{type(e).__name__}: {e}"}
         r = results[name]
@@ -818,6 +962,19 @@ def main():
 
     record = {"backend": jax.default_backend(),
               "ops": results}
+    if args.merge and not args.compare:
+        try:
+            with open(args.out) as f:
+                base = json.load(f)
+        except Exception:
+            base = {"backend": record["backend"], "ops": {}}
+        if base.get("backend") != record["backend"]:
+            print(f"refusing to merge across backends "
+                  f"({base.get('backend')} vs {record['backend']})",
+                  file=sys.stderr)
+            sys.exit(1)
+        base["ops"].update(results)
+        record = base
     if args.compare:
         try:
             with open(BASELINE) as f:
