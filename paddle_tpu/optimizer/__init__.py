@@ -2,10 +2,13 @@
 
 Reference parity: python/paddle/optimizer/ (new-style Adam/AdamW/...) and
 operators/optimizers/*.cc kernels (sgd_op, momentum_op, adam_op, lamb_op,
-lars_momentum_op). TPU-native design: each update rule is a pure jitted jnp
-function over (param, grad, slots); `step()` walks parameters and rebinds
-buffers — XLA compiles one fused update per (shape, dtype) signature. The
-same rules are reused by the static-graph optimizer ops (fluid/optimizer.py).
+lars_momentum_op). TPU-native design: `step()` runs ONE fused jitted XLA
+computation over the whole dense parameter bag (optimizer/fused.py —
+grad cast, global-norm clip, per-param lr multipliers, weight decay and
+the rule all inside a single donated dispatch); sparse (SelectedRows)
+grads and unsupported configurations fall back to the per-param jitted
+rules below. The same pure rules are reused by the static-graph
+optimizer ops (fluid/optimizer.py) via optimizer/functional.py.
 """
 from __future__ import annotations
 
@@ -16,6 +19,7 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Parameter
 from ..sparse import SelectedRows
+from . import functional
 from . import lr as lr_sched
 from .lr import LRScheduler
 
@@ -69,6 +73,10 @@ class Optimizer:
         return float(self._lr)
 
     def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError(
+                "optimizer's learning rate can't be LRScheduler when "
+                "invoke this API, because this will lead to conflict")
         self._lr = float(value)
 
     def _lr_for(self, p):
@@ -117,22 +125,64 @@ class Optimizer:
     set_dict = set_state_dict
 
     # -------------- step --------------
+    # Fused-path protocol: concrete rules declare their slot names (in
+    # accumulator order), the functional state tuple holding them, and a
+    # factory for the matching pure Transform. optimizer/fused.py drives
+    # the whole dense update through ONE donated jitted dispatch.
+    _fused_slots = ()
+    _fused_state_cls = None
+
+    def _fused_tx(self, lrv, wd):
+        raise NotImplementedError
+
+    def _fused_wd(self, p):
+        return self._decay_value(p)
+
+    def _mp_enabled(self, p):
+        """multi_precision master-weight path for low-precision params."""
+        if not getattr(self, "_multi_precision", False):
+            return False
+        jnp = _jnp()
+        return p._data.dtype in (jnp.bfloat16, jnp.float16)
+
+    def _rule_slot_spec(self, p):
+        """Accumulator spec for the rule's slots; fp32 when the param
+        trains against an fp32 master weight."""
+        if self._mp_enabled(p):
+            jnp = _jnp()
+            shape = tuple(p._data.shape)
+            return {n: (shape, jnp.float32) for n in self._fused_slots}
+        return {n: "zeros_like" for n in self._fused_slots}
+
+    def _mp_master(self, p, slots):
+        """The fp32 master weight the rule updates (None when the param
+        trains in its own dtype)."""
+        if not self._mp_enabled(p):
+            return None
+        m = slots.get("master_weight")
+        if m is None:
+            m = slots["master_weight"] = p._data.astype(_jnp().float32)
+        return m
+
+    def _writeback(self, p, slots, new_p):
+        if self._mp_enabled(p) and "master_weight" in slots:
+            slots["master_weight"] = new_p
+            p._data = new_p.astype(p._data.dtype)
+        else:
+            p._data = new_p
+
     def _collect(self):
-        pg = []
+        """Split this step's (param, grad) pairs into (dense, sparse)."""
+        dense, sparse = [], []
         for p in self._parameters:
             if p.stop_gradient or not getattr(p, "trainable", True):
                 continue
             g = p.grad
             if g is None:
                 continue
-            pg.append((p, g))
-        if self._grad_clip is not None:
-            if any(isinstance(g, SelectedRows) for _, g in pg):
-                raise NotImplementedError(
-                    "grad_clip over sparse (SelectedRows) gradients is not "
-                    "supported; clip densely or drop the clip")
-            pg = self._grad_clip(pg)
-        return pg
+            (sparse if isinstance(g, SelectedRows) else dense).append(
+                (p, g))
+        return dense, sparse
 
     def _decay_value(self, p):
         wd = self._weight_decay
@@ -144,17 +194,31 @@ class Optimizer:
 
     def step(self):
         self._step_count += 1
-        for p, g in self._collect():
-            if isinstance(g, SelectedRows):
-                if self._decay_value(p):
-                    raise ValueError(
-                        "weight_decay/regularization is not supported with "
-                        "sparse (SelectedRows) gradients — reference "
-                        "lookup_table is_sparse=True has the same "
-                        "restriction")
-                self._update_param_sparse(p, g)
+        dense, sparse = self._collect()
+        if self._grad_clip is not None and sparse:
+            raise NotImplementedError(
+                "grad_clip over sparse (SelectedRows) gradients is not "
+                "supported; clip densely or drop the clip")
+        if dense:
+            from . import fused as _fused
+
+            # duplicate param objects must not donate one buffer twice
+            if _fused.supported(self) and \
+                    len({id(p) for p, _ in dense}) == len(dense):
+                _fused.apply(self, dense)
             else:
-                self._update_param(p, g)
+                pg = self._grad_clip(dense) \
+                    if self._grad_clip is not None else dense
+                for p, g in pg:
+                    self._update_param(p, g)
+        for p, g in sparse:
+            if self._decay_value(p):
+                raise ValueError(
+                    "weight_decay/regularization is not supported with "
+                    "sparse (SelectedRows) gradients — reference "
+                    "lookup_table is_sparse=True has the same "
+                    "restriction")
+            self._update_param_sparse(p, g)
 
     def _update_param(self, p, g):
         raise NotImplementedError
@@ -276,9 +340,15 @@ def _adam_sparse_lazy_rule(p, rows, vals, m, v, lrv, b1, b2, eps, t):
 
 
 class SGD(Optimizer):
+    _fused_slots = ()
+    _fused_state_cls = functional.ScaleState
+
     def __init__(self, learning_rate=0.001, parameters=None,
                  weight_decay=None, grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _fused_tx(self, lrv, wd):
+        return functional.sgd(lrv, wd)
 
     def _update_param(self, p, g):
         fn = _jitted(_sgd_rule)
@@ -292,22 +362,37 @@ class SGD(Optimizer):
 
 
 class Momentum(Optimizer):
+    _fused_slots = ("velocity",)
+    _fused_state_cls = functional.MomentumState
+
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
                  multi_precision=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self._momentum = momentum
         self._use_nesterov = use_nesterov
+        self._multi_precision = bool(multi_precision)
+
+    def _fused_tx(self, lrv, wd):
+        return functional.momentum(lrv, self._momentum, wd,
+                                   self._use_nesterov)
 
     def _update_param(self, p, g):
-        slots = self._slots(p, {"velocity": "zeros_like"})
+        slots = self._slots(p, self._rule_slot_spec(p))
+        master = self._mp_master(p, slots)
+        base = master if master is not None else p._data
         fn = _instance_jit(self, "_jit_rule", lambda: functools.partial(
             _momentum_rule, use_nesterov=self._use_nesterov))
-        p._data, slots["velocity"] = fn(
-            p._data, g._data.astype(p._data.dtype), slots["velocity"],
+        new_p, slots["velocity"] = fn(
+            base, g._data.astype(base.dtype), slots["velocity"],
             self._lr_for(p), self._momentum, self._decay_value(p))
+        self._writeback(p, slots, new_p)
 
     def _update_param_sparse(self, p, g):
+        if self._mp_enabled(p):
+            raise NotImplementedError(
+                "multi_precision is not supported with sparse "
+                "(SelectedRows) gradients")
         slots = self._slots(p, {"velocity": "zeros_like"})
         fn = _instance_jit(self, "_jit_sparse", lambda: functools.partial(
             _momentum_sparse_rule, use_nesterov=self._use_nesterov))
@@ -318,6 +403,8 @@ class Momentum(Optimizer):
 
 class Adam(Optimizer):
     _decoupled_wd = False
+    _fused_slots = ("moment1", "moment2")
+    _fused_state_cls = functional.AdamState
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
@@ -326,18 +413,29 @@ class Adam(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         self._lazy_mode = bool(lazy_mode)
+        self._multi_precision = bool(multi_precision)
+
+    def _fused_tx(self, lrv, wd):
+        return functional.adam(lrv, self._beta1, self._beta2, self._eps,
+                               wd, decoupled=self._decoupled_wd)
 
     def _update_param(self, p, g):
-        slots = self._slots(p, {"moment1": "zeros_like",
-                                "moment2": "zeros_like"})
+        slots = self._slots(p, self._rule_slot_spec(p))
+        master = self._mp_master(p, slots)
+        base = master if master is not None else p._data
         fn = _instance_jit(self, "_jit_rule", lambda: functools.partial(
             _adam_rule, decoupled=self._decoupled_wd))
-        p._data, slots["moment1"], slots["moment2"] = fn(
-            p._data, g._data.astype(p._data.dtype), slots["moment1"],
+        new_p, slots["moment1"], slots["moment2"] = fn(
+            base, g._data.astype(base.dtype), slots["moment1"],
             slots["moment2"], self._lr_for(p), self._beta1, self._beta2,
             self._eps, float(self._step_count), self._decay_value(p))
+        self._writeback(p, slots, new_p)
 
     def _update_param_sparse(self, p, g):
+        if self._mp_enabled(p):
+            raise NotImplementedError(
+                "multi_precision is not supported with sparse "
+                "(SelectedRows) gradients")
         slots = self._slots(p, {"moment1": "zeros_like",
                                 "moment2": "zeros_like"})
         # adam is non-linear in g (g*g): duplicate rows MUST merge first
@@ -362,7 +460,8 @@ class AdamW(Adam):
                  grad_clip=None, lazy_mode=False, multi_precision=False,
                  apply_decay_param_fun=None, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         weight_decay, grad_clip, lazy_mode=lazy_mode)
+                         weight_decay, grad_clip, lazy_mode=lazy_mode,
+                         multi_precision=multi_precision)
         self._apply_decay_param_fun = apply_decay_param_fun
 
     def _decay_value(self, p):
@@ -373,11 +472,18 @@ class AdamW(Adam):
 
 
 class Adamax(Optimizer):
+    _fused_slots = ("moment", "inf_norm")
+    _fused_state_cls = functional.AdamaxState
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _fused_tx(self, lrv, wd):
+        # the per-param adamax rule applies no weight decay; keep parity
+        return functional.adamax(lrv, self._beta1, self._beta2, self._eps)
 
     def _update_param(self, p, g):
         jnp = _jnp()
@@ -398,12 +504,18 @@ class Adamax(Optimizer):
 
 
 class Adagrad(Optimizer):
+    _fused_slots = ("moment",)
+    _fused_state_cls = functional.AdagradState
+
     def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
                  weight_decay=None, grad_clip=None,
                  initial_accumulator_value=0.0, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self._eps = epsilon
         self._init_acc = initial_accumulator_value
+
+    def _fused_tx(self, lrv, wd):
+        return functional.adagrad(lrv, self._eps)
 
     def _update_param(self, p, g):
         jnp = _jnp()
@@ -420,11 +532,17 @@ class Adagrad(Optimizer):
 
 
 class Adadelta(Optimizer):
+    _fused_slots = ("avg_sq_grad", "avg_sq_upd")
+    _fused_state_cls = functional.AdadeltaState
+
     def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
                  parameters=None, weight_decay=None, grad_clip=None,
                  name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self._eps, self._rho = epsilon, rho
+
+    def _fused_tx(self, lrv, wd):
+        return functional.adadelta(lrv, self._eps, self._rho)
 
     def _update_param(self, p, g):
         jnp = _jnp()
@@ -445,12 +563,19 @@ class Adadelta(Optimizer):
 
 
 class RMSProp(Optimizer):
+    _fused_slots = ("mean_square", "mean_grad", "momentum")
+    _fused_state_cls = functional.RmspropState
+
     def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
                  momentum=0.0, centered=False, parameters=None,
                  weight_decay=None, grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self._rho, self._eps = rho, epsilon
         self._momentum, self._centered = momentum, centered
+
+    def _fused_tx(self, lrv, wd):
+        return functional.rmsprop(lrv, self._rho, self._eps,
+                                  self._momentum, self._centered)
 
     def _update_param(self, p, g):
         jnp = _jnp()
@@ -477,6 +602,9 @@ class RMSProp(Optimizer):
 
 
 class Lamb(Optimizer):
+    _fused_slots = ("moment1", "moment2")
+    _fused_state_cls = functional.AdamState
+
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
                  beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
                  grad_clip=None, exclude_from_weight_decay_fn=None,
@@ -485,6 +613,15 @@ class Lamb(Optimizer):
                          grad_clip)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _fused_tx(self, lrv, wd):
+        return functional.lamb(lrv, self._beta1, self._beta2, self._eps,
+                               wd)
+
+    def _fused_wd(self, p):
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            return 0.0
+        return self._decay_value(p)
 
     def _update_param(self, p, g):
         slots = self._slots(p, {"moment1": "zeros_like",

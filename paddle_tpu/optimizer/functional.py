@@ -29,6 +29,16 @@ def _map(fn, *trees):
     return jax.tree_util.tree_map(fn, *trees)
 
 
+def _unzip(out):
+    """out is a pytree whose leaves are tuples (rule outputs); returns
+    pick(i) -> the pytree of each tuple slot."""
+    import jax
+
+    is_tup = lambda t: isinstance(t, tuple)  # noqa: E731
+    return lambda i: jax.tree_util.tree_map(lambda t: t[i], out,
+                                            is_leaf=is_tup)
+
+
 def _resolve_lr(lr, count):
     if callable(lr):
         return lr(count)
@@ -211,6 +221,158 @@ def lamb(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-6,
         pick = lambda i: jax.tree_util.tree_map(  # noqa: E731
             lambda t_: t_[i], out, is_leaf=is_tup)
         return pick(0), AdamState(t, pick(1), pick(2))
+
+    return Transform(init, update)
+
+
+class AdamaxState(NamedTuple):
+    count: Any
+    m: Any
+    u: Any
+
+
+def adamax(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8):
+    """adamax_op.cc parity: adam moments with an infinity-norm second
+    moment (no weight decay — the eager rule has none either)."""
+
+    def init(params):
+        import jax.numpy as jnp
+
+        return AdamaxState(
+            count=jnp.zeros((), jnp.int32),
+            m=_map(lambda p: jnp.zeros_like(p), params),
+            u=_map(lambda p: jnp.zeros_like(p), params))
+
+    def update(params, grads, state):
+        import jax.numpy as jnp
+
+        t = state.count + 1
+        lrv = _resolve_lr(learning_rate, state.count)
+        c1 = 1.0 - beta1 ** t.astype(jnp.float32)
+
+        def one(p, g, m, u):
+            g = g.astype(p.dtype)
+            m_new = beta1 * m + (1 - beta1) * g
+            u_new = jnp.maximum(beta2 * u, jnp.abs(g))
+            p_new = p - _cast_lr(lrv, p) / c1.astype(p.dtype) * m_new / (
+                u_new + epsilon)
+            return p_new, m_new, u_new
+
+        pick = _unzip(_map(one, params, grads, state.m, state.u))
+        return pick(0), AdamaxState(t, pick(1), pick(2))
+
+    return Transform(init, update)
+
+
+class AdagradState(NamedTuple):
+    count: Any
+    moment: Any
+
+
+def adagrad(learning_rate=0.001, epsilon=1e-6):
+    """adagrad_op.cc parity: acc += g*g; p -= lr * g / (sqrt(acc)+eps)."""
+
+    def init(params):
+        import jax.numpy as jnp
+
+        return AdagradState(
+            count=jnp.zeros((), jnp.int32),
+            moment=_map(lambda p: jnp.zeros_like(p), params))
+
+    def update(params, grads, state):
+        import jax.numpy as jnp
+
+        lrv = _resolve_lr(learning_rate, state.count)
+
+        def one(p, g, acc):
+            g = g.astype(p.dtype)
+            acc_new = acc + g * g
+            return (p - _cast_lr(lrv, p) * g / (jnp.sqrt(acc_new) +
+                                                epsilon), acc_new)
+
+        pick = _unzip(_map(one, params, grads, state.moment))
+        return pick(0), AdagradState(state.count + 1, pick(1))
+
+    return Transform(init, update)
+
+
+class AdadeltaState(NamedTuple):
+    count: Any
+    avg_sq_grad: Any
+    avg_sq_upd: Any
+
+
+def adadelta(learning_rate=0.001, epsilon=1e-6, rho=0.95):
+    """adadelta_op.cc parity."""
+
+    def init(params):
+        import jax.numpy as jnp
+
+        return AdadeltaState(
+            count=jnp.zeros((), jnp.int32),
+            avg_sq_grad=_map(lambda p: jnp.zeros_like(p), params),
+            avg_sq_upd=_map(lambda p: jnp.zeros_like(p), params))
+
+    def update(params, grads, state):
+        import jax.numpy as jnp
+
+        lrv = _resolve_lr(learning_rate, state.count)
+
+        def one(p, g, eg, eu):
+            g = g.astype(p.dtype)
+            eg_new = rho * eg + (1 - rho) * g * g
+            upd = jnp.sqrt(eu + epsilon) / jnp.sqrt(eg_new + epsilon) * g
+            eu_new = rho * eu + (1 - rho) * upd * upd
+            return p - _cast_lr(lrv, p) * upd, eg_new, eu_new
+
+        pick = _unzip(_map(one, params, grads, state.avg_sq_grad,
+                           state.avg_sq_upd))
+        return pick(0), AdadeltaState(state.count + 1, pick(1), pick(2))
+
+    return Transform(init, update)
+
+
+class RmspropState(NamedTuple):
+    count: Any
+    mean_square: Any
+    mean_grad: Any
+    momentum: Any
+
+
+def rmsprop(learning_rate=0.001, rho=0.95, epsilon=1e-6, momentum=0.0,
+            centered=False):
+    """rmsprop_op.cc parity (lr folded into the momentum accumulator)."""
+
+    def init(params):
+        import jax.numpy as jnp
+
+        return RmspropState(
+            count=jnp.zeros((), jnp.int32),
+            mean_square=_map(lambda p: jnp.zeros_like(p), params),
+            mean_grad=_map(lambda p: jnp.zeros_like(p), params),
+            momentum=_map(lambda p: jnp.zeros_like(p), params))
+
+    def update(params, grads, state):
+        import jax.numpy as jnp
+
+        lrv = _resolve_lr(learning_rate, state.count)
+
+        def one(p, g, ms, mg, mom):
+            g = g.astype(p.dtype)
+            ms_new = rho * ms + (1 - rho) * g * g
+            if centered:
+                mg_new = rho * mg + (1 - rho) * g
+                denom = jnp.sqrt(ms_new - mg_new * mg_new + epsilon)
+            else:
+                mg_new = mg
+                denom = jnp.sqrt(ms_new + epsilon)
+            mom_new = momentum * mom + _cast_lr(lrv, p) * g / denom
+            return p - mom_new, ms_new, mg_new, mom_new
+
+        pick = _unzip(_map(one, params, grads, state.mean_square,
+                           state.mean_grad, state.momentum))
+        return pick(0), RmspropState(state.count + 1, pick(1), pick(2),
+                                     pick(3))
 
     return Transform(init, update)
 

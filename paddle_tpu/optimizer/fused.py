@@ -1,0 +1,175 @@
+"""Fused whole-model optimizer step: ONE donated XLA dispatch per step.
+
+The eager per-param path launches one jitted call per tensor (plus N+1
+eager reductions when global-norm clipping is on) — for a transformer-
+sized model that is hundreds of tiny device round-trips per `opt.step()`
+where the math itself is microseconds. This driver collects every dense
+`(param, grad, slots)` into one pytree and runs the entire update —
+grad cast, global-norm clip, per-param lr multipliers (`optimize_attr`),
+weight decay, fp32 master weights (`multi_precision`), and the rule —
+inside a single `jax.jit` call with `donate_argnums` on params+slots, so
+buffers alias across steps and XLA fuses the whole sweep.
+
+The body reuses the pure `Transform` rules of optimizer/functional.py:
+params are grouped by (lr multiplier, weight decay) — both static per
+parameter — each group runs one Transform.update over its sub-pytree,
+and `functional.clip_by_global_norm` wraps the combined transform so the
+clip norm accumulates over ALL dense grads in fp32, exactly like the
+legacy `nn.ClipGradByGlobalNorm`.
+
+The jitted step is cached per optimizer instance, keyed on the dense
+parameter-set signature (shape/dtype/grad-dtype/lr-mult/wd/mp per param
++ clip norm); lr and the step count are fed as traced scalars so LR
+schedules never retrace. Donation means the OLD param/slot buffers are
+invalidated after `step()` — `p._data` is rebound to the new arrays, but
+raw `jax.Array` references taken before the step must not be reused.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import functional as F
+
+
+def supported(opt):
+    """True when `opt`'s dense update can run on the fused path."""
+    if opt.__dict__.get("_use_fused") is False or not _enabled():
+        return False
+    if getattr(type(opt), "_fused_state_cls", None) is None:
+        return False
+    # a subclass overriding the per-param rule opts out implicitly
+    from . import (SGD, Adadelta, Adagrad, Adam, Adamax, Lamb, Momentum,
+                   RMSProp)
+
+    impl = type(opt)._update_param
+    if not any(impl is c._update_param for c in
+               (SGD, Momentum, Adam, Adamax, Adagrad, Adadelta, RMSProp,
+                Lamb)):
+        return False
+    clip = opt._grad_clip
+    if clip is not None:
+        from ..nn import ClipGradByGlobalNorm
+
+        if type(clip) is not ClipGradByGlobalNorm:
+            return False
+    return True
+
+
+def _enabled():
+    import os
+
+    return os.environ.get("PADDLE_TPU_FUSED_OPT", "1") != "0"
+
+
+def _low_precision(dtype):
+    import jax.numpy as jnp
+
+    return dtype in (jnp.bfloat16, jnp.float16)
+
+
+def apply(opt, dense_pg):
+    """Run one fused update over the dense (param, grad) list, clip
+    included. Slots live in `opt._accumulators` exactly as on the
+    per-param path, so state_dict round-trips and the two paths can be
+    switched freely between steps."""
+    import jax.numpy as jnp
+
+    slot_names = opt._fused_slots
+    specs = []
+    slot_lists = []
+    for p, g in dense_pg:
+        mult = 1.0
+        oa = getattr(p, "optimize_attr", None)
+        if oa:
+            mult = float(oa.get("learning_rate", 1.0))
+        wd = float(opt._fused_wd(p))
+        mp = opt._mp_enabled(p)
+        slots = opt._slots(p, opt._rule_slot_spec(p))
+        vals = [slots[n] for n in slot_names]
+        if mp:
+            master = slots.get("master_weight")
+            if master is None:
+                master = slots["master_weight"] = p._data.astype(
+                    jnp.float32)
+            vals.append(master)
+        slot_lists.append(tuple(vals))
+        specs.append((tuple(p._data.shape), str(p._data.dtype),
+                      str(g._data.dtype), mult, wd, mp))
+    clip = opt._grad_clip
+    clip_norm = float(clip.clip_norm) if clip is not None else None
+    key = (tuple(specs), clip_norm)
+    cache = opt.__dict__.setdefault("_fused_cache", {})
+    fn = cache.get(key)
+    if fn is None:
+        fn = cache[key] = _build(opt, specs, clip_norm)
+    new_params, new_slots = fn(
+        tuple(p._data for p, _ in dense_pg),
+        tuple(g._data for _, g in dense_pg),
+        tuple(slot_lists),
+        np.float32(opt.get_lr()),
+        np.int32(opt._step_count - 1))
+    for (p, _), spec, arr, svals in zip(dense_pg, specs, new_params,
+                                        new_slots):
+        p._data = arr
+        slots = opt._accumulators[id(p)]
+        for n, v in zip(slot_names, svals):
+            slots[n] = v
+        if spec[5]:
+            slots["master_weight"] = svals[len(slot_names)]
+
+
+def _build(opt, specs, clip_norm):
+    """Trace one pure function over the whole dense parameter bag.
+
+    specs: per-param statics (shape, dtype, grad dtype, lr mult, wd, mp).
+    Returns a jitted fn (params, grads, slots, lr, count) ->
+    (new_params, new_slots) with params+slots donated (devices that
+    support aliasing reuse the buffers in place; CPU ignores donation, so
+    it is skipped there to avoid warning spam).
+    """
+    import jax
+
+    n_state = len(opt._fused_slots)
+    groups = {}
+    for i, (_, _, _, mult, wd, _) in enumerate(specs):
+        groups.setdefault((mult, wd), []).append(i)
+    glist = sorted(groups.items())
+
+    def fused(params, grads, slots, lrv, count):
+        def update(ptree, gtree, _state):
+            new_p = {}
+            new_slots = {i: None for i in range(len(specs))}
+            for (mult, wd), idxs in glist:
+                tx = opt._fused_tx(lrv * mult, wd)
+                sub_p = {str(i): ptree[str(i)] for i in idxs}
+                sub_g = {str(i): gtree[str(i)] for i in idxs}
+                trees = tuple({str(i): slots[i][k] for i in idxs}
+                              for k in range(n_state))
+                out_p, out_st = tx.update(
+                    sub_p, sub_g, opt._fused_state_cls(count, *trees))
+                new_p.update(out_p)
+                for i in idxs:
+                    new_slots[i] = tuple(out_st[k + 1][str(i)]
+                                         for k in range(n_state))
+            return new_p, new_slots
+
+        tx_all = F.Transform(lambda _: None, update)
+        if clip_norm is not None:
+            tx_all = F.clip_by_global_norm(tx_all, clip_norm)
+        # multi_precision params feed their fp32 master into the rule
+        ptree = {str(i): (slots[i][n_state] if specs[i][5] else params[i])
+                 for i in range(len(specs))}
+        gtree = {str(i): g for i, g in enumerate(grads)}
+        new_p, new_slots = tx_all.update(ptree, gtree, None)
+        outs_p, outs_s = [], []
+        for i in range(len(specs)):
+            if specs[i][5]:
+                outs_p.append(new_p[str(i)].astype(params[i].dtype))
+                outs_s.append(new_slots[i] + (new_p[str(i)],))
+            else:
+                outs_p.append(new_p[str(i)])
+                outs_s.append(new_slots[i])
+        return tuple(outs_p), tuple(outs_s)
+
+    donate = () if jax.default_backend() == "cpu" else (0, 2)
+    return jax.jit(fused, donate_argnums=donate)
