@@ -15,6 +15,8 @@ BENCH_DETAILS.json and echoed to stderr:
                    fine-tune at ~50% fill                        seq/s
   +  fused_optimizer: fused vs per-param opt.step() A/B (Adam +
                    global-norm clip, ~200 small tensors)         x
+  +  decode_throughput: fused static-KV-cache decode scan vs
+                   eager concat-cache generation loop, tokens/s  x
   4. multichip_scaling: allreduce busbw + DP weak scaling — runs
      whenever >1 device is visible (records skipped on this 1-chip
      host; validated on the 8-device CPU mesh by the test suite).
@@ -969,6 +971,90 @@ def _fused_optimizer(n_layers=14, hidden=128, steps=30):
             "spread": _spread([1.0 / s for s in fused_slopes])}
 
 
+def _decode_throughput(points=((4, 64), (16, 64), (4, 128)),
+                       d_model=128, nhead=4, ffn=256, n_layers=2,
+                       vocab=512, mem_len=8, prompt_len=8):
+    """Fused static-cache decode vs the eager concat-cache loop,
+    tokens/s at several (batch, max_new_tokens) points. The eager side
+    is the reference's cache regime — T.concat grows K/V every token,
+    so every step reallocates and re-dispatches; the fused side runs
+    prefill once plus ONE jitted lax.scan with StaticKVCache as carry
+    (text/generation.py). Greedy outputs are asserted token-identical
+    between the two paths, so the A/B can't silently diverge."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import nn
+    from paddle_tpu.nn.layer.transformer import (TransformerDecoder,
+                                                 TransformerDecoderLayer)
+    from paddle_tpu.text.generation import (DecodeEngine, bucket_size,
+                                            generate_eager)
+
+    layer = TransformerDecoderLayer(d_model, nhead, ffn, dropout=0.0)
+    dec = TransformerDecoder(layer, n_layers)
+    dec.eval()
+    embed = nn.Embedding(vocab, d_model)
+    proj = nn.Linear(d_model, vocab)
+    eng = DecodeEngine(dec, embed, proj)
+    rs = np.random.RandomState(0)
+    by_point = {}
+    speedup_last = None
+    for batch, max_new in points:
+        memory = jnp.asarray(rs.randn(batch, mem_len, d_model)
+                             .astype("f4"))
+        prompt = np.full((batch, prompt_len), 0, np.int32)
+        prompt[:, 1:] = rs.randint(2, vocab,
+                                   (batch, prompt_len - 1))
+        prompt = jnp.asarray(prompt)
+
+        def run_fused():
+            t0 = time.perf_counter()
+            toks, lens = eng.generate(memory, prompt, bos_id=0,
+                                      eos_id=1,
+                                      max_new_tokens=max_new)
+            jax.block_until_ready(0)  # generate returns host arrays
+            return time.perf_counter() - t0, toks
+
+        run_fused()                         # compile
+        fused_samples = []
+        toks_f = None
+        for _ in range(5):
+            dt, toks_f = run_fused()
+            fused_samples.append(batch * max_new / dt)
+
+        def run_eager():
+            t0 = time.perf_counter()
+            toks, _ = generate_eager(
+                dec, embed, proj, memory, prompt, bos_id=0, eos_id=1,
+                max_new_tokens=max_new,
+                pad_prompt_to=bucket_size(prompt_len))
+            return time.perf_counter() - t0, toks
+
+        run_eager()                         # warm per-shape retraces
+        dt_e, toks_e = run_eager()
+        if not np.array_equal(np.asarray(toks_f), np.asarray(toks_e)):
+            raise AssertionError(
+                "fused static-cache greedy diverged from the eager "
+                "concat-cache reference")
+        fused_samples.sort()
+        fused_tps = fused_samples[len(fused_samples) // 2]
+        eager_tps = batch * max_new / dt_e
+        speedup_last = fused_tps / eager_tps
+        by_point[f"b{batch}_n{max_new}"] = {
+            "fused_tok_per_s": round(fused_tps, 1),
+            "eager_tok_per_s": round(eager_tps, 1),
+            "speedup": round(speedup_last, 2),
+            "spread": _spread(fused_samples, kind="trials")}
+    return {"metric": "decode_throughput",
+            "value": round(speedup_last, 2),
+            "unit": "x vs eager concat-cache loop",
+            "by_point": by_point,
+            "config": {"layers": n_layers, "d_model": d_model,
+                       "nhead": nhead, "vocab": vocab,
+                       "prompt_len": prompt_len, "greedy": True,
+                       "parity_checked": True}}
+
+
 def _multichip_scaling(devices=None, sizes_mb=(4, 64), ar_iters=8,
                        dp_steps=6):
     """Config 4 harness: fleet collective allreduce bandwidth + DP weak
@@ -1097,6 +1183,7 @@ def main():
                ("ernie_long", _ernie_long),
                ("packed_varlen", _packed_varlen),
                ("fused_optimizer", _fused_optimizer),
+               ("decode_throughput", _decode_throughput),
                ("multichip_scaling", _multichip_scaling)]
     results = {}
     headline = None

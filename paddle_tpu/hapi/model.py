@@ -69,6 +69,20 @@ class Model:
         loss_val = [float(losses.numpy())] if losses is not None else []
         return (loss_val, metrics) if metrics else loss_val
 
+    def generate(self, *args, **kwargs):
+        """Autoregressive generation through the network's static
+        KV-cache decode engine (nn.TransformerDecoder.generate /
+        text.generation.DecodeEngine): prefill once, then the whole
+        decode as one jitted scan."""
+        net = self.network
+        if not hasattr(net, "generate"):
+            raise AttributeError(
+                f"{type(net).__name__} has no generate(); attach a "
+                "text.generation.DecodeEngine or use a decoder stack "
+                "with TransformerDecoder.generate")
+        net.eval()
+        return net.generate(*args, **kwargs)
+
     def predict_batch(self, inputs):
         from ..core.autograd import no_grad
 

@@ -35,6 +35,7 @@ class Config:
         self._engine = "xla"
         self._device = None
         self._ir_optim = True
+        self._batch_bucketing = True
 
     # engine/device toggles (enable_use_gpu equivalents)
     def enable_use_tpu(self, device_id=0):
@@ -59,6 +60,15 @@ class Config:
 
     def enable_memory_optim(self):
         pass
+
+    def switch_batch_bucketing(self, flag=True):
+        """xla engine: pad the leading batch dim of every feed to the
+        next power of two (outputs sliced back), so serving traffic
+        with drifting batch sizes hits a BOUNDED compile cache —
+        O(log max_batch) programs instead of one per distinct batch.
+        On by default; turn off for programs that reduce across the
+        batch axis (padding rows would change those)."""
+        self._batch_bucketing = bool(flag)
 
 
 class PredictorTensor:
@@ -184,6 +194,14 @@ class Predictor:
         if inputs is not None:
             from ..core.lod import LoDTensor
 
+            if len(inputs) != len(self._feed_names):
+                # dict(zip(...)) would silently DROP feeds on a short
+                # list and silently ignore extras — either way the
+                # program runs on stale/garbage values
+                raise ValueError(
+                    f"Predictor.run expected {len(self._feed_names)} "
+                    f"inputs for feeds {self._feed_names}, got "
+                    f"{len(inputs)}")
             self._feeds = dict(zip(
                 self._feed_names,
                 [a if isinstance(a, LoDTensor) else np.asarray(a)
@@ -191,9 +209,16 @@ class Predictor:
         if self._native is not None:
             outs = self._native.run(self._feeds)
         else:
-            outs = self._exe.run(self._program, feed=self._feeds,
+            feeds, pad = self._feeds, None
+            if getattr(self.config, "_batch_bucketing", True):
+                feeds, pad = _pad_batch_feeds(feeds)
+            outs = self._exe.run(self._program, feed=feeds,
                                  fetch_list=self._fetch_vars)
             outs = [np.asarray(o) for o in outs]
+            if pad is not None:
+                b, nb = pad
+                outs = [o[:b] if getattr(o, "ndim", 0) >= 1
+                        and o.shape[0] == nb else o for o in outs]
         self._outputs = dict(zip(self._fetch_names, outs))
         return outs
 
@@ -201,6 +226,72 @@ class Predictor:
         if self._outputs is None:
             self.run()
         return self._outputs[name]
+
+    def generate(self, input_ids, max_new_tokens=32, eos_id=None):
+        """Greedy autoregressive serving on the xla engine with
+        shape-bucketed compilation. Contract: the artifact maps ONE int
+        token-id feed [B, S] to ONE logits fetch [B, S, V] with causal
+        semantics (position t reads ids[:, :t+1] only). Prompt length
+        and batch pad to power-of-two buckets, so the jit cache holds
+        O(log n) programs over serving traffic instead of one per
+        distinct shape. Returns (tokens [B, max_new_tokens],
+        lengths [B]).
+
+        Program artifacts cannot thread a KV cache, so each step re-runs
+        the bucketed prefix — the fully fused static-cache scan lives on
+        nn.TransformerDecoder.generate / text.generation.DecodeEngine
+        for in-process models."""
+        if self._native is not None:
+            raise RuntimeError("Predictor.generate requires the xla "
+                               "engine")
+        if len(self._feed_names) != 1 or len(self._fetch_names) != 1:
+            raise ValueError(
+                "generate needs a single-feed/single-fetch LM artifact; "
+                f"got feeds={self._feed_names} "
+                f"fetches={self._fetch_names}")
+        import jax
+
+        from ..fluid.executor import _lower_block_callable
+        from ..text.generation import bucket_size
+
+        if getattr(self, "_gen_fn", None) is None:
+            fn, _ = _lower_block_callable(
+                self._program, self._feed_names, self._fetch_names)
+            self._gen_fn = jax.jit(fn)
+            self._gen_shapes = set()  # bucketed shapes actually compiled
+        ids = np.asarray(input_ids)
+        B0, cur_len = ids.shape
+        dtype = ids.dtype if np.issubdtype(ids.dtype, np.integer) \
+            else np.int64
+        cur = ids.astype(dtype)
+        done = np.zeros((B0,), bool)
+        lens = np.zeros((B0,), np.int64)
+        toks = []
+        for _ in range(max_new_tokens):
+            Bb, Sb = bucket_size(B0), bucket_size(cur_len)
+            self._gen_shapes.add((Bb, Sb))
+            buf = np.zeros((Bb, Sb), dtype)
+            buf[:B0, :cur_len] = cur
+            if Bb > B0:
+                buf[B0:] = buf[B0 - 1:B0]  # edge rows, sliced off below
+            logits = np.asarray(self._gen_fn(buf)[0])
+            nxt = logits[:B0, cur_len - 1].argmax(-1).astype(dtype)
+            if eos_id is not None:
+                nxt = np.where(done, eos_id, nxt)
+            lens += ~done
+            if eos_id is not None:
+                done |= nxt == eos_id
+            toks.append(nxt)
+            cur = np.concatenate([cur, nxt[:, None]], axis=1)
+            cur_len += 1
+            if eos_id is not None and done.all():
+                break
+        out = np.stack(toks, axis=1)
+        if out.shape[1] < max_new_tokens and eos_id is not None:
+            pad = np.full((B0, max_new_tokens - out.shape[1]), eos_id,
+                          dtype)
+            out = np.concatenate([out, pad], axis=1)
+        return out, lens
 
     # StableHLO export of the whole inference computation (serving systems
     # / compiler toolchains; reference's save_optimized_model analog)
@@ -216,6 +307,36 @@ class Predictor:
         args = [np.asarray(example_feeds[n]) for n in names]
         lowered = jax.jit(fn).lower(*args)
         return lowered.as_text(dialect="stablehlo")
+
+
+def _pad_batch_feeds(feeds):
+    """Pad every plain-ndarray feed's leading dim to the next power of
+    two by replicating the last row (numerically safe for the row-wise
+    programs inference artifacts are; edge rows are sliced back off the
+    outputs). Skipped entirely — returns (feeds, None) — when any feed
+    is a LoDTensor (rows carry sequence structure), feeds disagree on
+    batch size, or the batch is already a power of two."""
+    from ..core.lod import LoDTensor
+
+    if not feeds or any(isinstance(v, LoDTensor) for v in feeds.values()):
+        return feeds, None
+    batches = {v.shape[0] for v in feeds.values()
+               if getattr(v, "ndim", 0) >= 1 and v.shape[0] > 0}
+    if len(batches) != 1:
+        return feeds, None
+    b = batches.pop()
+    nb = 1 << (b - 1).bit_length()
+    if nb == b:
+        return feeds, None
+    out = {}
+    for name, v in feeds.items():
+        if getattr(v, "ndim", 0) >= 1 and v.shape[0] == b:
+            out[name] = np.concatenate(
+                [v, np.broadcast_to(v[-1:], (nb - b,) + v.shape[1:])],
+                axis=0)
+        else:
+            out[name] = v
+    return out, (b, nb)
 
 
 def create_predictor(config):

@@ -17,6 +17,13 @@ from .layers import Layer
 class MultiHeadAttention(Layer):
     Cache = collections.namedtuple("Cache", ["k", "v"])
     StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+    # decode-engine cache: preallocated [B, H, max_len, D] K/V buffers
+    # plus the lockstep int32 write index ([B], every row equal — the
+    # leading dim makes it a valid lax.scan carry AND lets beam search
+    # tile/regather it like any other state leaf). Leaves are raw jax
+    # arrays, NOT Tensors: the whole point is to ride jitted scans.
+    StaticKVCache = collections.namedtuple("StaticKVCache",
+                                           ["k", "v", "index"])
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None,
                  vdim=None, need_weights=False, weight_attr=None,
@@ -68,6 +75,10 @@ class MultiHeadAttention(Layer):
         q = self._split_heads(self.q_proj(query))
         k = self._split_heads(self.k_proj(key))
         v = self._split_heads(self.v_proj(value))
+        if isinstance(cache, self.StaticKVCache):
+            out, cache = self._static_kv_attention(q, k, v, attn_mask,
+                                                   cache)
+            return self.out_proj(out), cache
         if isinstance(cache, self.StaticCache):
             k, v = cache.k, cache.v
         else:
@@ -86,7 +97,66 @@ class MultiHeadAttention(Layer):
             return out, cache
         return out
 
-    def gen_cache(self, key, value=None, type=None):
+    def _static_kv_attention(self, q, k, v, attn_mask, cache):
+        """Preallocated-cache attention (inference-only, raw jnp — the
+        static path exists to run inside jitted decode scans, outside
+        the autograd tape). The new K/V block lands at the write index
+        via lax.dynamic_update_slice; queries see written positions
+        only (position mask), composed with an optional [B, max_len]
+        (or [B, 1, 1, max_len]) additive key bias for padded-prompt
+        holes. Contract: a multi-token write (S > 1) is the PREFILL of
+        an empty cache — it attends within the prompt block itself on
+        the regular flash-capable path; S == 1 is a decode step through
+        the flash-decode kernel."""
+        import jax
+        import jax.numpy as jnp
+
+        from ...core.tensor import Tensor
+        from ...ops import attention as A
+
+        def raw(x):
+            return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+        qd, kd, vd = raw(q), raw(k), raw(v)
+        kbuf, vbuf, idx = raw(cache.k), raw(cache.v), raw(cache.index)
+        b, h, s, d = qd.shape
+        pos = (idx[0] if idx.ndim else idx).astype(jnp.int32)
+        z = jnp.int32(0)
+        kbuf = jax.lax.dynamic_update_slice(kbuf, kd.astype(kbuf.dtype),
+                                            (z, z, pos, z))
+        vbuf = jax.lax.dynamic_update_slice(vbuf, vd.astype(vbuf.dtype),
+                                            (z, z, pos, z))
+        new_cache = MultiHeadAttention.StaticKVCache(
+            kbuf, vbuf, (idx + s).astype(jnp.int32))
+        mask = None if attn_mask is None else raw(attn_mask)
+        if mask is not None and mask.ndim > 2:
+            mask = mask.reshape(mask.shape[0], mask.shape[-1])
+        if s == 1:
+            out = A.decode_attention(qd, kbuf, vbuf, pos + 1, bias=mask)
+        else:
+            bias4 = None if mask is None else \
+                mask.astype(jnp.float32)[:, None, None, :]
+            out = A.sdpa(qd, kd, vd, bias4, is_causal=True)
+        out = jnp.swapaxes(out, 1, 2).reshape(b, s, h * d)
+        return Tensor._wrap(out), new_cache
+
+    def gen_cache(self, key, value=None, type=None, max_length=None,
+                  batch_size=None, dtype=None):
+        """Cache constructors. type=StaticCache precomputes K/V from
+        `key` (cross-attention). max_length=N preallocates a
+        StaticKVCache of [B, H, N, D] zero buffers + a zero write index
+        — the decode-engine carry; B/dtype default to key's."""
+        if max_length is not None:
+            import jax.numpy as jnp
+
+            b = batch_size if batch_size is not None else key.shape[0]
+            if dtype is None:
+                dtype = self.q_proj.weight._data.dtype
+            buf = jnp.zeros(
+                (int(b), self.num_heads, int(max_length), self.head_dim),
+                dtype)
+            return self.StaticKVCache(buf, buf,
+                                      jnp.zeros((int(b),), jnp.int32))
         if type == MultiHeadAttention.StaticCache:
             k = self._split_heads(self.k_proj(key))
             v = self._split_heads(self.v_proj(value if value is not None
@@ -249,8 +319,14 @@ class TransformerDecoderLayer(Layer):
             return tgt
         return tgt, (incremental_cache, static_cache)
 
-    def gen_cache(self, memory):
-        incremental = self.self_attn.gen_cache(memory)
+    def gen_cache(self, memory, max_length=None, batch_size=None,
+                  dtype=None):
+        if max_length is not None:
+            incremental = self.self_attn.gen_cache(
+                memory, max_length=max_length, batch_size=batch_size,
+                dtype=dtype)
+        else:
+            incremental = self.self_attn.gen_cache(memory)
         static = self.cross_attn.gen_cache(
             memory, type=MultiHeadAttention.StaticCache)
         return incremental, static
@@ -282,8 +358,28 @@ class TransformerDecoder(Layer):
             output = self.norm(output)
         return output if cache is None else (output, new_caches)
 
-    def gen_cache(self, memory, do_zip=False):
-        return [layer.gen_cache(memory) for layer in self.layers]
+    def gen_cache(self, memory, do_zip=False, max_length=None,
+                  batch_size=None, dtype=None):
+        return [layer.gen_cache(memory, max_length=max_length,
+                                batch_size=batch_size, dtype=dtype)
+                for layer in self.layers]
+
+    def generate(self, memory, embed, project, **kwargs):
+        """Fused autoregressive generation on the static KV-cache path:
+        prefill through the flash-capable prompt pass, then the whole
+        decode as ONE jitted lax.scan (greedy or beam) with
+        StaticKVCache as carry. embed/project: the token-embedding and
+        logits-projection Layers around this decoder stack. See
+        paddle_tpu.text.generation.DecodeEngine for the full contract
+        (bucketing, max_new_tokens, prompts)."""
+        from ...text.generation import DecodeEngine
+
+        eng = getattr(self, "_decode_engine", None)
+        if eng is None or eng.embed_ref is not embed \
+                or eng.project_ref is not project:
+            eng = DecodeEngine(self, embed, project)
+            self._decode_engine = eng
+        return eng.generate(memory, **kwargs)
 
 
 class Transformer(Layer):

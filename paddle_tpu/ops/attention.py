@@ -27,6 +27,14 @@ SLOWER under Mosaic (see _causal_apply), so boundary and interior
 blocks share one body. Dropout, key-position bias and causal compose
 with segments; `sdpa`/`sdpa_bshd` route automatically whenever segment
 metadata is present.
+
+Decode mode (autoregressive serving): `decode_attention` takes ONE
+query token per row against a preallocated KV cache ([b, h, max_len,
+d]) with a traced written-token count — on TPU a split-K flash-decode
+kernel (`flash_decode`) spreads the cache length across the grid and
+merges per-split partial softmaxes in XLA; elsewhere the
+`decode_attention_reference` composition applies the same length mask
+densely. Interpret-mode CPU parity mirrors the training kernels.
 """
 from __future__ import annotations
 
@@ -1195,6 +1203,184 @@ def sdpa_bshd(q, k, v, mask=None, is_causal=False, scale=None,
     return sdpa_reference_bshd(q, k, v,
                                _with_segment_mask(mask, segment_ids),
                                is_causal, scale, dropout_p, dropout_key)
+
+
+# --------------------------------------------------------------------------
+# decode-mode attention: one query token against a static KV cache
+# --------------------------------------------------------------------------
+
+def decode_attention_reference(q, k, v, length, bias=None, scale=None):
+    """XLA reference for single-token decode attention against a
+    preallocated cache. q [b, h, 1, d]; k/v [b, h, L, d] where L is the
+    cache's max_length; `length` (traced int32 scalar or [b]) marks how
+    many cache slots hold real tokens — key positions >= length are
+    masked out; bias: optional [b, L] additive key bias (padded-prompt
+    holes). Always correct, runs anywhere; the flash_decode kernel is
+    checked against THIS composition in interpret mode on CPU."""
+    import jax.numpy as jnp
+
+    b, h, sq, d = q.shape
+    L = k.shape[2]
+    length = jnp.asarray(length, jnp.int32)
+    kpos = jnp.arange(L, dtype=jnp.int32)
+    valid = kpos[None, :] < (length.reshape(-1, 1) if length.ndim
+                             else length.reshape(1, 1))
+    m = jnp.where(valid, 0.0, -1e30).astype(jnp.float32)
+    if m.shape[0] == 1:
+        m = jnp.broadcast_to(m, (b, L))
+    if bias is not None:
+        m = m + jnp.asarray(bias, jnp.float32)
+    return sdpa_reference(q, k, v, m[:, None, None, :], False, scale)
+
+
+def _pick_decode_splits(L, split_k=None):
+    """Split-K factor over the cache length: each split must stay a
+    lane-friendly 128-multiple; prefer ~512-token splits (the MXU-util
+    sweet spot for a (1, d) x (split, d) decode dot)."""
+    if split_k is not None:
+        n = max(1, int(split_k))
+        while L % n or (L // n) % 128:
+            n -= 1
+        return max(1, n)
+    for n in (8, 4, 2):
+        if L % n == 0 and (L // n) % 128 == 0 and L // n >= 512:
+            return n
+    return 1
+
+
+def _flash_decode_call(b, h, L, d, s, n_splits, has_bias, interpret):
+    import jax
+    import jax.numpy as jnp
+
+    pl = _import_pallas()
+    from jax.experimental.pallas import tpu as pltpu
+
+    split = L // n_splits
+
+    def kernel(len_ref, *refs):
+        if has_bias:
+            q_ref, k_ref, v_ref, bias_ref, o_ref, m_ref, l_ref = refs
+        else:
+            q_ref, k_ref, v_ref, o_ref, m_ref, l_ref = refs
+        si = pl.program_id(1)
+        start = si * jnp.int32(split)
+        n_valid = len_ref[0]
+
+        @pl.when(start < n_valid)
+        def _compute():
+            sf = jnp.float32(s)
+            qb = (q_ref[...].astype(jnp.float32) * sf).astype(
+                q_ref.dtype)                      # (1, d)
+            kb = k_ref[...]                        # (split, d)
+            vb = v_ref[...]
+            logits = jnp.dot(qb, kb.T,
+                             preferred_element_type=jnp.float32)
+            kpos = start + jax.lax.broadcasted_iota(
+                jnp.int32, (1, split), 1)
+            logits = jnp.where(kpos < n_valid, logits,
+                               jnp.float32(-1e30))
+            if has_bias:
+                logits = logits + bias_ref[...][:, 0][None, :]
+            m = logits.max(axis=-1, keepdims=True)          # (1, 1)
+            p = jnp.exp(logits - m)
+            l = p.sum(axis=-1, keepdims=True)
+            acc = jnp.dot(p.astype(qb.dtype), vb,
+                          preferred_element_type=jnp.float32)
+            o_ref[...] = acc
+            m_ref[...] = m
+            l_ref[...] = l
+
+        @pl.when(start >= n_valid)
+        def _skip():
+            # split entirely past the written cache region: contribute
+            # an exact zero to the combine (m=-1e30 -> alpha underflows)
+            o_ref[...] = jnp.zeros((1, d), jnp.float32)
+            m_ref[...] = jnp.full((1, 1), -1e30, jnp.float32)
+            l_ref[...] = jnp.zeros((1, 1), jnp.float32)
+
+    in_specs = [
+        pl.BlockSpec((None, 1, d), lambda bh, si, *_: (bh, _z(), _z())),
+        pl.BlockSpec((None, split, d), lambda bh, si, *_: (bh, si, _z())),
+        pl.BlockSpec((None, split, d), lambda bh, si, *_: (bh, si, _z())),
+    ]
+    if has_bias:
+        in_specs.append(
+            pl.BlockSpec((None, split, 1),
+                         lambda bh, si, *_: (bh, si, _z())))
+    out_specs = [
+        pl.BlockSpec((None, None, 1, d),
+                     lambda bh, si, *_: (bh, si, _z(), _z())),
+        pl.BlockSpec((None, None, 1, 1),
+                     lambda bh, si, *_: (bh, si, _z(), _z())),
+        pl.BlockSpec((None, None, 1, 1),
+                     lambda bh, si, *_: (bh, si, _z(), _z())),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((b * h, n_splits, 1, d), jnp.float32),
+        jax.ShapeDtypeStruct((b * h, n_splits, 1, 1), jnp.float32),
+        jax.ShapeDtypeStruct((b * h, n_splits, 1, 1), jnp.float32),
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(b * h, n_splits),
+        in_specs=in_specs, out_specs=out_specs)
+    return pl.pallas_call(kernel, grid_spec=grid_spec,
+                          out_shape=out_shape, interpret=interpret)
+
+
+def flash_decode(q, k, v, length, bias=None, scale=None, split_k=None,
+                 interpret=False):
+    """Pallas flash-decode: one query token per row against the cached
+    K/V, split-K over the cache length so a long cache still spreads
+    across the grid (a single (1, L) row otherwise leaves the chip
+    idle). Per-split partial (acc, m, l) merge in XLA with the standard
+    logsumexp combine. `length` is the lockstep written-token count
+    (int32, traced); splits entirely past it are skipped in-kernel."""
+    import jax.numpy as jnp
+
+    b, h, sq, d = q.shape
+    if sq != 1:
+        raise ValueError(f"flash_decode takes a single query token, got "
+                         f"sq={sq} — prefill runs on the regular flash "
+                         f"path")
+    L = k.shape[2]
+    n_splits = _pick_decode_splits(L, split_k)
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    qr = q.reshape(b * h, 1, d)
+    kr = k.reshape(b * h, L, d)
+    vr = v.reshape(b * h, L, d)
+    len_arr = jnp.asarray(length, jnp.int32).reshape(-1)[:1]
+    call = _flash_decode_call(b, h, L, d, s, n_splits, bias is not None,
+                              interpret)
+    args = [qr, kr, vr]
+    if bias is not None:
+        args.append(jnp.repeat(jnp.asarray(bias, jnp.float32), h,
+                               axis=0)[:, :, None])
+    acc, m, l = call(len_arr, *args)               # [b*h, ns, 1, ...]
+    m_star = m.max(axis=1, keepdims=True)
+    alpha = jnp.exp(m - m_star)
+    num = (acc * alpha).sum(axis=1)                # [b*h, 1, d]
+    den = jnp.maximum((l * alpha).sum(axis=1), 1e-30)
+    return (num / den).astype(q.dtype).reshape(b, h, 1, d)
+
+
+def decode_attention(q, k, v, length, bias=None, scale=None, split_k=None,
+                     interpret=False):
+    """Decode-attention dispatch: the split-K pallas kernel on TPU (or
+    under interpret=True for CPU parity tests), the XLA reference
+    composition everywhere else. Same gate style as sdpa: any kernel
+    failure falls back rather than poisoning a decode loop."""
+    L = k.shape[2]
+    use_kernel = interpret or (
+        _on_tpu() and q.shape[-1] <= 256 and L >= 256 and L % 128 == 0
+        and _flash_usable())
+    if use_kernel:
+        try:
+            return flash_decode(q, k, v, length, bias, scale, split_k,
+                                interpret)
+        except Exception:
+            if interpret:
+                raise
+    return decode_attention_reference(q, k, v, length, bias, scale)
 
 
 def sdpa(q, k, v, mask=None, is_causal=False, scale=None,

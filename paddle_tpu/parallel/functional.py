@@ -88,7 +88,10 @@ def _unwrap_tree(out):
     if isinstance(out, Tensor):
         return out._data
     if isinstance(out, (list, tuple)):
-        return type(out)(_unwrap_tree(o) for o in out)
+        vals = [_unwrap_tree(o) for o in out]
+        if hasattr(out, "_fields"):  # namedtuple (e.g. attention caches)
+            return type(out)(*vals)
+        return type(out)(vals)
     if isinstance(out, dict):
         return {k: _unwrap_tree(v) for k, v in out.items()}
     return out

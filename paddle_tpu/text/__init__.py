@@ -8,6 +8,7 @@ import numpy as np
 from ..io import Dataset
 from . import datasets  # noqa: F401
 from . import decode  # noqa: F401
+from . import generation  # noqa: F401
 from . import viterbi  # noqa: F401
 
 

@@ -22,8 +22,14 @@ def _jnp():
 
 
 def greedy_search(step_fn, init_state, batch_size, bos_id, eos_id,
-                  max_len):
-    """Argmax decoding. Returns (tokens [B, max_len], lengths [B])."""
+                  max_len, init_logits=None):
+    """Argmax decoding. Returns (tokens [B, max_len], lengths [B]).
+
+    init_logits ([B, V], optional): next-token logits already computed
+    for the sequence prefix — the decode engine's PREFILL output. When
+    given, the FIRST of the max_len tokens is argmax(init_logits) and
+    the scan runs max_len - 1 steps; step_fn is then only ever called
+    with tokens the cache has not seen (single-token decode steps)."""
     import jax
 
     jnp = _jnp()
@@ -37,23 +43,38 @@ def greedy_search(step_fn, init_state, batch_size, bos_id, eos_id,
         length = length + (~done).astype(jnp.int32)
         return (nxt, state, done_new, length), nxt
 
-    tok0 = jnp.full((batch_size,), bos_id, jnp.int32)
-    done0 = jnp.zeros((batch_size,), bool)
-    len0 = jnp.zeros((batch_size,), jnp.int32)
+    if init_logits is None:
+        tok0 = jnp.full((batch_size,), bos_id, jnp.int32)
+        done0 = jnp.zeros((batch_size,), bool)
+        len0 = jnp.zeros((batch_size,), jnp.int32)
+        scan_len = max_len
+    else:
+        tok0 = init_logits.argmax(-1).astype(jnp.int32)
+        done0 = tok0 == eos_id
+        len0 = jnp.ones((batch_size,), jnp.int32)
+        scan_len = max_len - 1
     (_, _, _, lengths), toks = jax.lax.scan(
-        step, (tok0, init_state, done0, len0), None, length=max_len)
-    return jnp.moveaxis(toks, 0, 1), lengths
+        step, (tok0, init_state, done0, len0), None, length=scan_len)
+    toks = jnp.moveaxis(toks, 0, 1)
+    if init_logits is not None:
+        toks = jnp.concatenate([tok0[:, None], toks], axis=1)
+    return toks, lengths
 
 
 def beam_search(step_fn, init_state, batch_size, bos_id, eos_id,
                 beam_size, max_len, length_penalty=0.0,
-                return_state=False):
+                return_state=False, init_logits=None):
     """Beam search. Returns (tokens [B, K, max_len] best-first,
     scores [B, K], lengths [B, K]) — plus each beam's final state
     (best-first, leading dim B*K) when return_state=True.
 
     States must have leading dim batch_size; they are tiled to
     batch*beam internally and re-gathered as beams reshuffle.
+
+    init_logits ([B, V], optional, V >= K): prefix logits from the
+    decode engine's prefill — the first expansion is top_k over THEM
+    (equivalent to the classic first step, where only beam 0 is live)
+    and the scan runs max_len - 1 steps on cache-backed decode tokens.
     """
     import jax
 
@@ -64,14 +85,24 @@ def beam_search(step_fn, init_state, batch_size, bos_id, eos_id,
         return jnp.repeat(t, K, axis=0)  # [B*K, ...] beam-major rows
 
     state0 = jax.tree_util.tree_map(tile, init_state)
-    # beam 0 starts live, others dead so the first expansion is unique
-    # f32 explicitly: under jax_enable_x64 a bare float list is f64, which
-    # would promote the whole scoring scan to emulated f64 on TPU
-    logp0 = jnp.tile(jnp.asarray([0.0] + [NEG] * (K - 1), jnp.float32),
-                     (B, 1))
-    tok0 = jnp.full((B, K), bos_id, jnp.int32)
-    fin0 = jnp.zeros((B, K), bool)
-    len0 = jnp.zeros((B, K), jnp.int32)
+    if init_logits is None:
+        # beam 0 starts live, others dead so the first expansion is
+        # unique. f32 explicitly: under jax_enable_x64 a bare float
+        # list is f64, which would promote the whole scoring scan to
+        # emulated f64 on TPU
+        logp0 = jnp.tile(jnp.asarray([0.0] + [NEG] * (K - 1),
+                                     jnp.float32), (B, 1))
+        tok0 = jnp.full((B, K), bos_id, jnp.int32)
+        fin0 = jnp.zeros((B, K), bool)
+        len0 = jnp.zeros((B, K), jnp.int32)
+        scan_len = max_len
+    else:
+        lp_init = jax.nn.log_softmax(init_logits.astype(jnp.float32), -1)
+        logp0, top_ix = jax.lax.top_k(lp_init, K)        # [B, K]
+        tok0 = top_ix.astype(jnp.int32)
+        fin0 = tok0 == eos_id
+        len0 = jnp.ones((B, K), jnp.int32)
+        scan_len = max_len - 1
 
     def step(carry, _):
         tok, logp, fin, lens, state = carry
@@ -103,7 +134,7 @@ def beam_search(step_fn, init_state, batch_size, bos_id, eos_id,
         return (nxt_tok, top_lp, fin, lens, state), (nxt_tok, src_beam)
 
     (tokT, logpT, finT, lensT, stateT), (toks, srcs) = jax.lax.scan(
-        step, (tok0, logp0, fin0, len0, state0), None, length=max_len)
+        step, (tok0, logp0, fin0, len0, state0), None, length=scan_len)
 
     # backtrace beam ancestry so each final beam reads its OWN history
     def bwd(beam_ix, t):
@@ -112,9 +143,14 @@ def beam_search(step_fn, init_state, batch_size, bos_id, eos_id,
         return prev, tok_t
 
     init_ix = jnp.tile(jnp.arange(K, dtype=jnp.int32), (B, 1))
-    _, rev = jax.lax.scan(bwd, init_ix,
-                          jnp.arange(max_len - 1, -1, -1))
+    first_ix, rev = jax.lax.scan(bwd, init_ix,
+                                 jnp.arange(scan_len - 1, -1, -1))
     seqs = jnp.flip(jnp.moveaxis(rev, 0, 2), axis=2)  # [B, K, L]
+    if init_logits is not None:
+        # the ancestry bottoms out in the init expansion: prepend each
+        # final beam's OWN first token
+        first = jnp.take_along_axis(tok0, first_ix, axis=1)
+        seqs = jnp.concatenate([first[:, :, None], seqs], axis=2)
 
     # length-penalized scores, best-first
     denom = jnp.maximum(lensT, 1).astype(jnp.float32) ** length_penalty

@@ -1,0 +1,425 @@
+"""Static-shape KV-cache decode engine: fused autoregressive generation.
+
+Reference role: the machine-translation book's decoder loop and
+beam_search_op.cc, re-designed serving-first. The reference (and the
+eager fallback kept in `generate_eager`) grows the cache with a concat
+per token — every step changes the cache shape, so every step retraces
+and reallocates, and the cache can never be a `lax.scan` carry. Here the
+cache is a preallocated `MultiHeadAttention.StaticKVCache` ([B, H,
+max_len, D] buffers + an int32 write index, see nn/layer/transformer.py):
+
+  * PREFILL: the whole (padded) prompt runs ONCE through the regular
+    flash-capable attention path and lands in the cache in one
+    `dynamic_update_slice`;
+  * DECODE: `text.decode.greedy_search` / `beam_search` run the entire
+    generation as ONE jitted `lax.scan` with the caches as carry — beam
+    ancestry regather tree-maps over the state, so StaticKVCache rides
+    it for free; each step's attention is the split-K flash-decode
+    kernel on TPU (ops/attention.py) and the XLA reference elsewhere.
+
+Shape-bucket policy: prompt length and batch pad to the next power of
+two, so the jit cache stays O(log n) over serving traffic instead of
+O(distinct shapes). The `max_length` preallocation contract: the cache
+is built with max_length = bucket(prompt_len) + max_new_tokens; rows
+whose prompt is shorter than the bucket keep a -1e30 key bias over the
+pad hole [len_i, bucket) for the whole generation, and generated tokens
+occupy positions bucket, bucket+1, ... (absolute slot indices — the
+same convention the eager right-padded reference uses, which is what
+makes the two paths bit-comparable).
+"""
+from __future__ import annotations
+
+import collections
+import inspect
+
+from ..nn.layer.layers import Layer
+from ..nn.layer.transformer import MultiHeadAttention
+from ..core.tensor import Tensor
+from ..parallel.functional import functionalize
+from .decode import beam_search, greedy_search
+
+NEG = -1e30
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def bucket_size(n, minimum=1):
+    """Next power of two >= n — the shape-bucket policy shared by the
+    decode engine and Predictor serving (compile cache O(log n))."""
+    n = max(int(n), int(minimum))
+    return 1 << (n - 1).bit_length()
+
+
+def _raw(x):
+    import jax.numpy as jnp
+
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _takes_positions(fn):
+    """Does the embed callable accept (tokens, positions)? Layers are
+    inspected on .forward; plain callables directly."""
+    try:
+        target = fn.forward if isinstance(fn, Layer) else fn
+        params = [p for p in inspect.signature(target).parameters.values()
+                  if p.kind in (p.POSITIONAL_ONLY,
+                                p.POSITIONAL_OR_KEYWORD)]
+        return len(params) >= 2
+    except (TypeError, ValueError):
+        return False
+
+
+class _StepNet(Layer):
+    """decoder + embed + project as ONE functionalized unit, so a single
+    param/buffer pytree feeds both prefill and every scan step."""
+
+    def __init__(self, decoder, embed, project):
+        super().__init__()
+        self.decoder = decoder
+        self.embed = embed
+        self.project = project
+        self._embed_pos = _takes_positions(embed)
+
+    def _embed(self, tokens, positions):
+        if self._embed_pos:
+            return self.embed(tokens, positions)
+        return self.embed(tokens)
+
+    def forward(self, tokens, positions, memory, tgt_mask=None,
+                memory_mask=None, inc=None, static_kv=None,
+                prefill=False):
+        if prefill:
+            static_kv = [
+                tuple(_raw(t) for t in layer.cross_attn.gen_cache(
+                    memory, type=MultiHeadAttention.StaticCache))
+                for layer in self.decoder.layers]
+        x = self._embed(tokens, positions)
+        cache = [(inc[i],
+                  MultiHeadAttention.StaticCache(Tensor._wrap(sk),
+                                                 Tensor._wrap(sv)))
+                 for i, (sk, sv) in enumerate(static_kv)]
+        out, new_caches = self.decoder(x, memory, tgt_mask, memory_mask,
+                                       cache)
+        logits = self.project(out)
+        new_inc = [c[0] for c in new_caches]
+        if prefill:
+            return logits, new_inc, static_kv
+        return logits, new_inc
+
+
+def _pad_rows(x, n):
+    """Pad the leading dim to n by replicating the last row (edge rows
+    are numerically safe and get sliced off the results)."""
+    import jax.numpy as jnp
+
+    b = x.shape[0]
+    if b == n:
+        return x
+    return jnp.concatenate(
+        [x, jnp.broadcast_to(x[-1:], (n - b,) + x.shape[1:])], axis=0)
+
+
+class DecodeEngine:
+    """One engine per (decoder, embed, project) triple. `generate()`
+    buckets the call shape, then runs a jitted (prefill + scan) program
+    compiled ONCE per bucket — `trace_counts` records per-bucket trace
+    counts so serving code (and the compile-count test) can verify the
+    compile cache stays bounded."""
+
+    def __init__(self, decoder, embed, project):
+        self.embed_ref = embed
+        self.project_ref = project
+        self._net = _StepNet(decoder, embed, project)
+        self._fm = functionalize(self._net)
+        self._compiled = {}
+        self.trace_counts = collections.Counter()
+
+    # ------------------------------------------------------------------
+    def generate(self, memory, prompt=None, prompt_lengths=None, *,
+                 bos_id=0, eos_id=1, max_new_tokens=32, beam_size=1,
+                 length_penalty=0.0, memory_mask=None,
+                 bucket_batch=True):
+        """Generate max_new_tokens per row. Greedy (beam_size=1) returns
+        (tokens [B, max_new_tokens], lengths [B]); beam returns
+        (tokens [B, K, max_new_tokens] best-first, scores [B, K],
+        lengths [B, K]). `prompt` [B, P] int (must start with bos;
+        defaults to a bos column); ragged prompts pass prompt_lengths
+        [B] and right-pad."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        memory = _raw(memory)
+        B0 = memory.shape[0]
+        if prompt is None:
+            prompt = jnp.full((B0, 1), bos_id, jnp.int32)
+        prompt = _raw(prompt).astype(jnp.int32)
+        P0 = prompt.shape[1]
+        if prompt_lengths is None:
+            lengths = jnp.full((B0,), P0, jnp.int32)
+        else:
+            lengths = _raw(prompt_lengths).astype(jnp.int32)
+        Pb = bucket_size(P0)
+        Bb = bucket_size(B0) if bucket_batch else B0
+        pad_cols = jnp.full((B0, Pb - P0), eos_id, jnp.int32)
+        prompt_b = _pad_rows(jnp.concatenate([prompt, pad_cols], 1), Bb)
+        lengths_b = _pad_rows(lengths, Bb)
+        memory_b = _pad_rows(memory, Bb)
+        mm_b = None if memory_mask is None else \
+            _pad_rows(_raw(memory_mask), Bb)
+        key = (Bb, Pb, int(max_new_tokens), int(beam_size),
+               int(bos_id), int(eos_id), float(length_penalty),
+               memory_b.shape[1:], str(memory_b.dtype),
+               mm_b is not None)
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._build(key)
+            self._compiled[key] = fn
+        args = [self._fm.params(), self._fm.buffers(), memory_b,
+                prompt_b, lengths_b]
+        if mm_b is not None:
+            args.append(mm_b)
+        out = fn(*args)
+        if beam_size == 1:
+            toks, lens = out
+            return np.asarray(toks)[:B0], np.asarray(lens)[:B0]
+        toks, scores, lens = out
+        return (np.asarray(toks)[:B0], np.asarray(scores)[:B0],
+                np.asarray(lens)[:B0])
+
+    # ------------------------------------------------------------------
+    def _build(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        (Bb, Pb, max_new, K, bos_id, eos_id, lp, _mshape, _mdtype,
+         has_mm) = key
+        fm = self._fm
+        decoder = self._net.decoder
+        L = Pb + max_new  # the max_length preallocation contract
+
+        def gen_fn(params, buffers, memory, prompt, lengths,
+                   mem_mask=None):
+            self.trace_counts[key] += 1  # python side effect: one per
+            #                              trace = one per compile
+            kpos = jnp.arange(L, dtype=jnp.int32)
+            hole = (kpos[None, :] >= lengths[:, None]) & \
+                (kpos[None, :] < jnp.int32(Pb))
+            pad_bias = jnp.where(hole, jnp.float32(NEG),
+                                 jnp.float32(0.0))        # [Bb, L]
+            positions = jnp.broadcast_to(
+                jnp.arange(Pb, dtype=jnp.int32)[None], (Bb, Pb))
+            inc0 = [layer.self_attn.gen_cache(
+                None, max_length=L, batch_size=Bb, dtype=memory.dtype)
+                for layer in decoder.layers]
+            (lg, inc1, static_kv), _ = fm.apply(
+                params, buffers, None, prompt, positions, memory,
+                training=False, tgt_mask=pad_bias[:, :Pb],
+                memory_mask=mem_mask, inc=inc0, prefill=True)
+            # the next token conditions on each row's LAST REAL prompt
+            # position, not the pad tail
+            last = jnp.take_along_axis(
+                lg, (lengths - 1)[:, None, None], axis=1)[:, 0]
+            rep = 1 if K == 1 else K
+
+            def tile(t):
+                return t if rep == 1 else jnp.repeat(t, rep, axis=0)
+
+            mem_t = tile(memory)
+            bias_t = tile(pad_bias)
+            mm_t = None if mem_mask is None else tile(mem_mask)
+            static_t = [(tile(sk), tile(sv)) for sk, sv in static_kv]
+
+            def step_fn(tok, state):
+                inc = state
+                posn = inc[0].index[:, None]  # written count == the
+                #                               incoming token's slot
+                (lg2, inc2), _ = fm.apply(
+                    params, buffers, None, tok[:, None], posn, mem_t,
+                    training=False, tgt_mask=bias_t,
+                    memory_mask=mm_t, inc=inc, static_kv=static_t,
+                    prefill=False)
+                return lg2[:, 0], inc2
+
+            if K == 1:
+                return greedy_search(step_fn, inc1, Bb, bos_id, eos_id,
+                                     max_new, init_logits=last)
+            toks, scores, lens = beam_search(
+                step_fn, inc1, Bb, bos_id, eos_id, K, max_new,
+                length_penalty=lp, init_logits=last)
+            return toks, scores, lens
+
+        return jax.jit(gen_fn)
+
+
+# ----------------------------------------------------------------------
+# eager concat-cache reference: the A side of the decode_throughput
+# bench and the parity oracle for the fused path
+# ----------------------------------------------------------------------
+
+def generate_eager(decoder, embed, project, memory, prompt=None,
+                   prompt_lengths=None, *, bos_id=0, eos_id=1,
+                   max_new_tokens=32, beam_size=1, length_penalty=0.0,
+                   memory_mask=None, pad_prompt_to=None):
+    """Token-by-token generation on the reference concat-grown Cache
+    path: every step T.concat-extends the cache (one reallocation + one
+    retrace per token — the regime the static engine removes). Pads the
+    prompt to `pad_prompt_to` (default bucket_size(P)) with the same
+    masking/position conventions as the fused path, so outputs are
+    directly comparable."""
+    import jax
+    import numpy as np
+
+    jnp = _jnp()
+    takes_pos = _takes_positions(embed)
+
+    def run_embed(tokens, positions):
+        t = Tensor._wrap(jnp.asarray(tokens, jnp.int32))
+        if takes_pos:
+            return embed(t, Tensor._wrap(jnp.asarray(positions,
+                                                     jnp.int32)))
+        return embed(t)
+
+    was_training = decoder.training
+    decoder.eval()
+    try:
+        memory_t = Tensor._wrap(_raw(memory))
+        B = memory_t.shape[0]
+        if prompt is None:
+            prompt = jnp.full((B, 1), bos_id, jnp.int32)
+        prompt = _raw(prompt).astype(jnp.int32)
+        P0 = prompt.shape[1]
+        Pb = pad_prompt_to or bucket_size(P0)
+        lengths = (jnp.full((B,), P0, jnp.int32)
+                   if prompt_lengths is None
+                   else _raw(prompt_lengths).astype(jnp.int32))
+        prompt = jnp.concatenate(
+            [prompt, jnp.full((B, Pb - P0), eos_id, jnp.int32)], 1)
+        L = Pb + max_new_tokens
+        kpos = jnp.arange(L, dtype=jnp.int32)
+        hole = (kpos[None, :] >= lengths[:, None]) & \
+            (kpos[None, :] < jnp.int32(Pb))
+        pad_bias = jnp.where(hole, jnp.float32(NEG), jnp.float32(0.0))
+        mm = None if memory_mask is None else Tensor._wrap(
+            _raw(memory_mask))
+
+        def prefill(mem_t, bias):
+            caches = decoder.gen_cache(mem_t)
+            x = run_embed(prompt if bias.shape[0] == B else
+                          jnp.repeat(prompt, beam_size, axis=0),
+                          jnp.broadcast_to(
+                              jnp.arange(Pb, dtype=jnp.int32)[None],
+                              (bias.shape[0], Pb)))
+            cmask = jnp.where(
+                jnp.tril(jnp.ones((Pb, Pb), bool)), 0.0, NEG
+            ).astype(jnp.float32)
+            full = cmask[None, None] + bias[:, None, None, :Pb]
+            out, caches = decoder(x, mem_t, Tensor._wrap(full), mm2(mm,
+                                  bias.shape[0]), caches)
+            return project(out), caches
+
+        def mm2(m, n):
+            if m is None:
+                return None
+            if m.shape[0] == n:
+                return m
+            return Tensor._wrap(jnp.repeat(_raw(m), beam_size, axis=0))
+
+        def step(tok, pos, n_keys, mem_t, bias, caches):
+            x = run_embed(tok[:, None], pos[:, None])
+            out, caches = decoder(
+                x, mem_t, Tensor._wrap(bias[:, None, None, :n_keys]),
+                mm2(mm, bias.shape[0]), caches)
+            return _raw(project(out))[:, 0], caches
+
+        if beam_size == 1:
+            logits, caches = prefill(memory_t, pad_bias)
+            lg = _raw(logits)
+            last = jnp.take_along_axis(
+                lg, (lengths - 1)[:, None, None], axis=1)[:, 0]
+            tok = last.argmax(-1).astype(jnp.int32)
+            done = tok == eos_id
+            lens = jnp.ones((B,), jnp.int32)
+            toks = [tok]
+            for t in range(1, max_new_tokens):
+                lg2, caches = step(tok, jnp.full((B,), Pb + t - 1,
+                                                 jnp.int32),
+                                   Pb + t, memory_t, pad_bias, caches)
+                nxt = lg2.argmax(-1).astype(jnp.int32)
+                nxt = jnp.where(done, eos_id, nxt)
+                lens = lens + (~done).astype(jnp.int32)
+                done = done | (nxt == eos_id)
+                tok = nxt
+                toks.append(tok)
+            return (np.stack([np.asarray(t) for t in toks], 1),
+                    np.asarray(lens))
+
+        # ---- beam: the exact decode.beam_search math, python-stepped
+        # over concat caches regathered by ancestry ----
+        K = beam_size
+        mem_k = Tensor._wrap(jnp.repeat(_raw(memory_t), K, axis=0))
+        bias_k = jnp.repeat(pad_bias, K, axis=0)
+        logits, _ = prefill(memory_t, pad_bias)
+        lg = _raw(logits)
+        last = jnp.take_along_axis(
+            lg, (lengths - 1)[:, None, None], axis=1)[:, 0]
+        lp0 = jax.nn.log_softmax(last.astype(jnp.float32), -1)
+        logp, top_ix = jax.lax.top_k(lp0, K)            # [B, K]
+        tok = top_ix.astype(jnp.int32)
+        fin = tok == eos_id
+        lens = jnp.ones((B, K), jnp.int32)
+        # the prefill cache is per-row; tile to beam-major B*K rows
+        _, caches = prefill(mem_k, bias_k)
+        histories = [[[int(tok[b, k])] for k in range(K)]
+                     for b in range(B)]
+        for t in range(1, max_new_tokens):
+            lg2, caches = step(
+                tok.reshape(B * K),
+                jnp.full((B * K,), Pb + t - 1, jnp.int32),
+                Pb + t, mem_k, bias_k, caches)
+            V = lg2.shape[-1]
+            lp = jax.nn.log_softmax(lg2.astype(jnp.float32), -1)
+            lp = lp.reshape(B, K, V)
+            # scoring mask uses decode.beam_search's own NEG so the two
+            # paths rank identically even among dead-beam candidates
+            from .decode import NEG as SCORE_NEG
+            fin_mask = jnp.full((V,), SCORE_NEG,
+                                jnp.float32).at[eos_id].set(0.0)
+            lp = jnp.where(fin[:, :, None], fin_mask[None, None, :], lp)
+            total = logp[:, :, None] + lp
+            logp, top_ix = jax.lax.top_k(total.reshape(B, K * V), K)
+            src = (top_ix // V).astype(jnp.int32)
+            tok = (top_ix % V).astype(jnp.int32)
+
+            def regather(arr):
+                a = _raw(arr)
+                a = a.reshape((B, K) + a.shape[1:])
+                srcx = src.reshape((B, K) + (1,) * (a.ndim - 2))
+                a = jnp.take_along_axis(a, srcx, axis=1)
+                return Tensor._wrap(a.reshape((B * K,) + a.shape[2:]))
+
+            caches = jax.tree_util.tree_map(
+                regather, caches,
+                is_leaf=lambda x: isinstance(x, Tensor))
+            fin = jnp.take_along_axis(fin, src, axis=1)
+            lens = jnp.take_along_axis(lens, src, axis=1)
+            lens = lens + (~fin).astype(jnp.int32)
+            fin = fin | (tok == eos_id)
+            histories = [[histories[b][int(src[b, k])] +
+                          [int(tok[b, k])] for k in range(K)]
+                         for b in range(B)]
+        denom = jnp.maximum(lens, 1).astype(jnp.float32) ** \
+            length_penalty
+        scores = logp / denom
+        order = np.asarray(jnp.argsort(-scores, axis=1))
+        seqs = np.asarray([[histories[b][order[b, k]]
+                            for k in range(K)] for b in range(B)],
+                          dtype=np.int32)
+        scores = np.take_along_axis(np.asarray(scores), order, axis=1)
+        lens = np.take_along_axis(np.asarray(lens), order, axis=1)
+        return seqs, scores, lens
+    finally:
+        decoder.train() if was_training else decoder.eval()

@@ -1,0 +1,386 @@
+"""Static-shape KV-cache decode engine, end to end.
+
+Covers: flash-decode kernel fwd parity vs the XLA reference in
+interpret mode on CPU (split-K on/off, bias, partial lengths);
+StaticKVCache mechanics in MultiHeadAttention (prefill + decode steps
+vs one full causal forward); fused greedy/beam generation parity
+against the eager concat-cache reference (ragged prompts, multi-layer);
+beam-ancestry regather of StaticKVCache state through
+text.decode.beam_search; init_logits equivalence in greedy/beam; and
+the compile-count contract (one trace per shape bucket).
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.nn.layer.transformer import (MultiHeadAttention,
+                                             TransformerDecoder,
+                                             TransformerDecoderLayer)
+from paddle_tpu.ops.attention import (decode_attention,
+                                      decode_attention_reference,
+                                      flash_decode)
+from paddle_tpu.text.decode import beam_search, greedy_search
+from paddle_tpu.text.generation import (DecodeEngine, bucket_size,
+                                        generate_eager)
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ----------------------------------------------------------------------
+# flash-decode kernel parity (interpret mode on CPU)
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("split", [1, 4])
+@pytest.mark.parametrize("with_bias", [False, True])
+@pytest.mark.parametrize("length", [1, 71, 512])
+def test_flash_decode_parity(split, with_bias, length):
+    jnp = _jnp()
+    rs = np.random.RandomState(0)
+    b, h, L, d = 2, 3, 512, 32
+    q = jnp.asarray(rs.randn(b, h, 1, d).astype("f4"))
+    k = jnp.asarray(rs.randn(b, h, L, d).astype("f4"))
+    v = jnp.asarray(rs.randn(b, h, L, d).astype("f4"))
+    bias = jnp.asarray((rs.randn(b, L) * 0.5).astype("f4")) \
+        if with_bias else None
+    out = flash_decode(q, k, v, length, bias=bias, split_k=split,
+                       interpret=True)
+    ref = decode_attention_reference(q, k, v, length, bias=bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_decode_traced_length():
+    """The written-token count is a TRACED scalar (it is the scan
+    carry's index) — the kernel must accept it under jit."""
+    import jax
+
+    jnp = _jnp()
+    rs = np.random.RandomState(1)
+    b, h, L, d = 1, 2, 256, 16
+    q = jnp.asarray(rs.randn(b, h, 1, d).astype("f4"))
+    k = jnp.asarray(rs.randn(b, h, L, d).astype("f4"))
+    v = jnp.asarray(rs.randn(b, h, L, d).astype("f4"))
+
+    @jax.jit
+    def f(ln):
+        return flash_decode(q, k, v, ln, split_k=2, interpret=True)
+
+    for ln in (3, 100, 256):
+        ref = decode_attention_reference(q, k, v, ln)
+        np.testing.assert_allclose(np.asarray(f(jnp.int32(ln))),
+                                   np.asarray(ref), rtol=1e-5,
+                                   atol=1e-5)
+
+
+def test_decode_attention_dispatch_cpu():
+    """Off-TPU the dispatcher must route to the XLA reference."""
+    jnp = _jnp()
+    rs = np.random.RandomState(2)
+    q = jnp.asarray(rs.randn(1, 2, 1, 16).astype("f4"))
+    k = jnp.asarray(rs.randn(1, 2, 128, 16).astype("f4"))
+    v = jnp.asarray(rs.randn(1, 2, 128, 16).astype("f4"))
+    out = decode_attention(q, k, v, 50)
+    ref = decode_attention_reference(q, k, v, 50)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+
+
+# ----------------------------------------------------------------------
+# StaticKVCache mechanics in MultiHeadAttention
+# ----------------------------------------------------------------------
+
+def test_static_kv_cache_matches_full_causal_forward():
+    """Prefill(4 tokens) + 3 decode steps through the preallocated
+    cache == one full 7-token causal forward, position by position."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+
+    rs = np.random.RandomState(3)
+    B, S, D, H = 2, 7, 16, 2
+    mha = MultiHeadAttention(D, H)
+    mha.eval()
+    x = jnp.asarray(rs.randn(B, S, D).astype("f4"))
+    xt = Tensor._wrap(x)
+
+    # reference: full causal self-attention over all S tokens
+    cmask = jnp.where(jnp.tril(jnp.ones((S, S), bool)), 0.0, -1e30)
+    ref = mha(xt, xt, xt, Tensor._wrap(
+        jnp.broadcast_to(cmask.astype(jnp.float32)[None, None],
+                         (B, 1, S, S))))
+    ref = np.asarray(ref._data)
+
+    P = 4
+    cache = mha.gen_cache(x, max_length=S)
+    assert cache.k.shape == (B, H, S, D // H)
+    out_p, cache = mha(Tensor._wrap(x[:, :P]), None, None, None, cache)
+    got = [np.asarray(out_p._data)]
+    assert np.asarray(cache.index).tolist() == [P, P]
+    for t in range(P, S):
+        out_t, cache = mha(Tensor._wrap(x[:, t:t + 1]), None, None,
+                           None, cache)
+        got.append(np.asarray(out_t._data))
+    got = np.concatenate(got, axis=1)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    assert np.asarray(cache.index).tolist() == [S, S]
+
+
+def test_static_kv_cache_pad_bias_masks_prompt_holes():
+    """A -1e30 key bias over padded prompt positions must make the
+    decode step identical to running the short prompt unpadded."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+
+    rs = np.random.RandomState(4)
+    B, D, H, L = 1, 16, 2, 8
+    mha = MultiHeadAttention(D, H)
+    mha.eval()
+    toks3 = jnp.asarray(rs.randn(B, 3, D).astype("f4"))
+    nxt = jnp.asarray(rs.randn(B, 1, D).astype("f4"))
+
+    # path A: 3-token prefill at slots [0,3), decode at slot 3
+    cache = mha.gen_cache(toks3, max_length=L)
+    _, cache = mha(Tensor._wrap(toks3), None, None, None, cache)
+    out_a, _ = mha(Tensor._wrap(nxt), None, None, None, cache)
+
+    # path B: prompt right-padded to 4 with a garbage token + pad bias
+    # over the hole; decode lands at slot 4 instead of 3 — same
+    # VISIBLE keys, so the outputs must agree
+    pad = jnp.asarray(rs.randn(B, 1, D).astype("f4") * 100)
+    toks4 = jnp.concatenate([toks3, pad], axis=1)
+    bias = jnp.asarray([[0.0, 0.0, 0.0, -1e30] + [0.0] * (L - 4)],
+                       jnp.float32)
+    cache = mha.gen_cache(toks4, max_length=L)
+    _, cache = mha(Tensor._wrap(toks4), None, None,
+                   Tensor._wrap(bias[:, :4]), cache)
+    out_b, _ = mha(Tensor._wrap(nxt), None, None,
+                   Tensor._wrap(bias), cache)
+    np.testing.assert_allclose(np.asarray(out_a._data),
+                               np.asarray(out_b._data),
+                               rtol=1e-5, atol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# init_logits seeding of the fused scans
+# ----------------------------------------------------------------------
+
+def _markov_step(trans):
+    import jax.numpy as jnp
+
+    tbl = jnp.asarray(trans)
+
+    def step_fn(tokens, state):
+        return tbl[tokens], state
+
+    return step_fn
+
+
+def test_greedy_init_logits_equivalent():
+    """greedy(init_logits=logits(bos)) == classic greedy from bos."""
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(5)
+    V, bos, eos = 6, 1, 0
+    trans = (rs.randn(V, V) * 2).astype("f4")
+    step = _markov_step(trans)
+    t_ref, l_ref = greedy_search(step, (), 3, bos, eos, 5)
+    init = jnp.broadcast_to(jnp.asarray(trans)[bos][None], (3, V))
+    t_new, l_new = greedy_search(step, (), 3, bos, eos, 5,
+                                 init_logits=init)
+    np.testing.assert_array_equal(np.asarray(t_ref), np.asarray(t_new))
+    np.testing.assert_array_equal(np.asarray(l_ref), np.asarray(l_new))
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_beam_init_logits_equivalent(seed):
+    """beam(init_logits=logits(bos)) == classic beam from bos — the
+    classic first expansion only has beam 0 live, which is exactly
+    top_k over the bos row."""
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(seed)
+    V, bos, eos, K, L = 5, 1, 0, 3, 4
+    trans = (rs.randn(V, V) * 1.5).astype("f4")
+    step = _markov_step(trans)
+    s_ref = beam_search(step, (), 2, bos, eos, K, L)
+    init = jnp.broadcast_to(jnp.asarray(trans)[bos][None], (2, V))
+    s_new = beam_search(step, (), 2, bos, eos, K, L, init_logits=init)
+    for a, b in zip(s_ref, s_new):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_beam_regather_static_kv_cache_state():
+    """StaticKVCache rides beam reshuffling: a step_fn that WRITES each
+    consumed token into its cache slot must end with every beam's
+    buffer holding exactly ITS OWN token history."""
+    import jax
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(6)
+    V, bos, eos, B, K, L = 5, 1, 0, 2, 3, 4
+    trans = (rs.randn(V, V) * 1.5).astype("f4")
+    tbl = jnp.asarray(trans)
+
+    def step_fn(tokens, cache):
+        idx = cache.index[0]
+        k = jax.lax.dynamic_update_slice(
+            cache.k, tokens[:, None, None, None].astype(cache.k.dtype),
+            (jnp.int32(0), jnp.int32(0), idx, jnp.int32(0)))
+        cache = MultiHeadAttention.StaticKVCache(
+            k, cache.v, cache.index + 1)
+        return tbl[tokens], cache
+
+    cache0 = MultiHeadAttention.StaticKVCache(
+        jnp.full((B, 1, L, 1), -1.0, jnp.float32),
+        jnp.zeros((B, 1, L, 1), jnp.float32),
+        jnp.zeros((B,), jnp.int32))
+    seqs, scores, lens, state = beam_search(
+        step_fn, cache0, B, bos, eos, K, L, return_state=True)
+    seqs = np.asarray(seqs)
+    written = np.asarray(state.k).reshape(B, K, L)
+    assert np.asarray(state.index).tolist() == [L] * (B * K)
+    for b in range(B):
+        for k in range(K):
+            # slot t holds the token CONSUMED at step t: bos then the
+            # beam's own emissions (shifted by one)
+            want = [bos] + list(seqs[b, k][:-1])
+            np.testing.assert_array_equal(written[b, k], want)
+
+
+# ----------------------------------------------------------------------
+# fused engine vs eager concat-cache reference
+# ----------------------------------------------------------------------
+
+def _small_stack(seed=7, D=32, H=2, V=17, layers=2):
+    from paddle_tpu import nn
+
+    np.random.seed(seed)
+    layer = TransformerDecoderLayer(D, H, 64, dropout=0.0)
+    dec = TransformerDecoder(layer, layers)
+    dec.eval()
+    embed = nn.Embedding(V, D)
+    proj = nn.Linear(D, V)
+    return dec, embed, proj, D, V
+
+
+def _ragged_inputs(D, V, B=3, Pmax=5, mem_len=4, seed=8):
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(seed)
+    memory = jnp.asarray(rs.randn(B, mem_len, D).astype("f4"))
+    prompt = rs.randint(2, V, (B, Pmax)).astype("i4")
+    prompt[:, 0] = 0  # bos
+    plens = jnp.asarray([Pmax, Pmax - 2, Pmax - 1], jnp.int32)
+    return memory, jnp.asarray(prompt), plens
+
+
+def test_fused_greedy_bitmatches_eager():
+    dec, embed, proj, D, V = _small_stack()
+    memory, prompt, plens = _ragged_inputs(D, V)
+    eng = DecodeEngine(dec, embed, proj)
+    toks, lens = eng.generate(memory, prompt, plens, bos_id=0, eos_id=1,
+                              max_new_tokens=8)
+    et, el = generate_eager(dec, embed, proj, memory, prompt, plens,
+                            bos_id=0, eos_id=1, max_new_tokens=8,
+                            pad_prompt_to=bucket_size(prompt.shape[1]))
+    np.testing.assert_array_equal(toks, et)
+    np.testing.assert_array_equal(lens, el)
+
+
+def test_fused_beam_bitmatches_eager():
+    dec, embed, proj, D, V = _small_stack(seed=9)
+    memory, prompt, plens = _ragged_inputs(D, V, seed=10)
+    eng = DecodeEngine(dec, embed, proj)
+    bt, bs, bl = eng.generate(memory, prompt, plens, bos_id=0, eos_id=1,
+                              max_new_tokens=6, beam_size=3,
+                              length_penalty=0.5)
+    et, es, el = generate_eager(
+        dec, embed, proj, memory, prompt, plens, bos_id=0, eos_id=1,
+        max_new_tokens=6, beam_size=3, length_penalty=0.5,
+        pad_prompt_to=bucket_size(prompt.shape[1]))
+    np.testing.assert_array_equal(bt, et)
+    np.testing.assert_allclose(bs, es, rtol=1e-4, atol=1e-5)
+    np.testing.assert_array_equal(bl, el)
+
+
+def test_generate_compiles_once_per_bucket():
+    """The acceptance contract: one trace per (bucketed) shape —
+    repeated calls, including different in-bucket batch/prompt sizes,
+    reuse the compiled scan."""
+    import jax.numpy as jnp
+
+    dec, embed, proj, D, V = _small_stack(seed=11)
+    eng = DecodeEngine(dec, embed, proj)
+    rs = np.random.RandomState(12)
+
+    def run(B, P):
+        mem = jnp.asarray(rs.randn(B, 4, D).astype("f4"))
+        pr = rs.randint(2, V, (B, P)).astype("i4")
+        pr[:, 0] = 0
+        return eng.generate(mem, jnp.asarray(pr), bos_id=0, eos_id=1,
+                            max_new_tokens=4)
+
+    run(3, 5)
+    run(3, 5)   # exact repeat
+    run(4, 5)   # batch 3 and 4 share the 4-bucket
+    run(3, 7)   # prompts 5 and 7 share the 8-bucket
+    assert sum(eng.trace_counts.values()) == 1, dict(eng.trace_counts)
+    run(3, 9)   # prompt bucket 16: one more compile
+    assert sum(eng.trace_counts.values()) == 2, dict(eng.trace_counts)
+
+
+def test_transformer_decoder_generate_and_hapi():
+    """The layer-level and hapi entry points reach the same engine."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.hapi.model import Model
+
+    dec, embed, proj, D, V = _small_stack(seed=13)
+    memory, prompt, plens = _ragged_inputs(D, V, seed=14)
+    toks, lens = dec.generate(memory, embed, proj, prompt=prompt,
+                              prompt_lengths=plens, bos_id=0, eos_id=1,
+                              max_new_tokens=5)
+    assert toks.shape == (3, 5)
+    # same engine instance is reused (compile cache survives calls)
+    eng = dec._decode_engine
+    dec.generate(memory, embed, proj, prompt=prompt,
+                 prompt_lengths=plens, bos_id=0, eos_id=1,
+                 max_new_tokens=5)
+    assert dec._decode_engine is eng
+    assert sum(eng.trace_counts.values()) == 1
+
+    m = Model(dec)
+    t2, l2 = m.generate(memory, embed, proj, prompt=prompt,
+                        prompt_lengths=plens, bos_id=0, eos_id=1,
+                        max_new_tokens=5)
+    np.testing.assert_array_equal(toks, t2)
+    np.testing.assert_array_equal(lens, l2)
+
+
+def test_generate_eos_lengths():
+    """Rows that emit eos freeze: lengths < max_new and the tail is
+    all eos — fused and eager agree."""
+    dec, embed, proj, D, V = _small_stack(seed=15)
+    memory, prompt, plens = _ragged_inputs(D, V, seed=16)
+    eng = DecodeEngine(dec, embed, proj)
+    # eos_id chosen as the greedy argmax somewhere: probe a long run
+    toks, lens = eng.generate(memory, prompt, plens, bos_id=0,
+                              eos_id=int(np.asarray(toks_probe(
+                                  eng, memory, prompt, plens))),
+                              max_new_tokens=10)
+    lens = np.asarray(lens)
+    toks = np.asarray(toks)
+    for b in range(toks.shape[0]):
+        if lens[b] < 10:
+            assert (toks[b, lens[b]:] == toks[b, lens[b] - 1]).all()
+
+
+def toks_probe(eng, memory, prompt, plens):
+    """First greedy token of row 0 — used as a guaranteed-hit eos."""
+    t, _ = eng.generate(memory, prompt, plens, bos_id=0, eos_id=1,
+                        max_new_tokens=1)
+    return t[0, 0]

@@ -3,6 +3,8 @@
 (analysis_predictor.cc capability), native C++ NaiveExecutor engine, and
 StableHLO export. Mirrors the reference's inference/tests/api pattern:
 train a small model, save, reload through each engine, compare numerics."""
+import os
+
 import numpy as np
 import pytest
 
@@ -81,6 +83,172 @@ def test_xla_predictor(saved_model):
     oh = pred.get_output_handle(pred.get_output_names()[0])
     np.testing.assert_allclose(oh.copy_to_cpu(), ref, rtol=1e-5,
                                atol=1e-6)
+
+
+def _protoc_ok():
+    """save/load_inference_model serializes through protoc-generated
+    descriptors; skip (not error) where the toolchain is absent."""
+    import shutil
+
+    return (os.path.exists(program_pb._DESC)
+            or shutil.which("protoc") is not None)
+
+
+@pytest.mark.skipif(not _protoc_ok(), reason="protoc unavailable")
+def test_predictor_run_feed_count_mismatch(saved_model):
+    """dict(zip(...)) used to silently drop short feed lists (and
+    ignore extras) — both are now hard errors."""
+    d, xb, _ = saved_model
+    pred = create_predictor(Config(d))
+    with pytest.raises(ValueError, match="expected 1"):
+        pred.run([])
+    with pytest.raises(ValueError, match="expected 1"):
+        pred.run([xb, xb])
+
+
+@pytest.mark.skipif(not _protoc_ok(), reason="protoc unavailable")
+def test_predictor_batch_bucketing(saved_model):
+    """xla engine pads the batch dim to the next power of two (bounded
+    compile cache) and slices outputs back — numerics must match the
+    unbucketed run for every original row."""
+    d, xb, _ = saved_model
+    pred = create_predictor(Config(d))
+    cfg_off = Config(d)
+    cfg_off.switch_batch_bucketing(False)
+    pred_off = create_predictor(cfg_off)
+    for b in (1, 3, 5, 7):
+        got, = pred.run([xb[:b]])
+        want, = pred_off.run([xb[:b]])
+        assert got.shape[0] == b
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.skipif(not _protoc_ok(), reason="protoc unavailable")
+def test_predictor_generate_markov(tmp_path):
+    """Predictor.generate: greedy serving of a causal LM artifact (a
+    Markov table as an embedding lookup: logits[:, t] depends only on
+    ids[:, t]) with power-of-two shape buckets."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+    from paddle_tpu.fluid.io import save_inference_model
+
+    V = 7
+    rs = np.random.RandomState(0)
+    table = (rs.randn(V, V) * 2).astype(np.float32)
+    scope = Scope()
+    with scope_guard(scope):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), \
+                fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", [-1], dtype="int64")
+            logits = fluid.layers.embedding(
+                ids, [V, V], param_attr=fluid.ParamAttr(name="trans"))
+        exe = fluid.Executor()
+        exe.run(startup)
+        scope.set_value("trans", table)
+        d = str(tmp_path / "markov_lm")
+        save_inference_model(d, ["ids"], [logits], exe,
+                             main_program=main)
+
+    pred = create_predictor(Config(d))
+    B, P, N = 3, 3, 6
+    prompt = rs.randint(0, V, (B, P)).astype(np.int64)
+    toks, lens = pred.generate(prompt, max_new_tokens=N)
+    # reference: greedy argmax chain off the last prompt token
+    for b in range(B):
+        prev = prompt[b, -1]
+        for t in range(N):
+            want = table[prev].argmax()
+            assert toks[b, t] == want
+            prev = want
+    assert lens.tolist() == [N] * B
+    # bucketed compile cache: prompt lengths 3..9 span buckets {4, 8,
+    # 16} only
+    assert len(pred._gen_shapes) <= 3, pred._gen_shapes
+
+
+def test_pad_batch_feeds_unit():
+    """Batch-bucketing helper: pow2 padding with edge rows, skipped for
+    pow2 batches, LoD feeds, and disagreeing batch dims."""
+    from paddle_tpu.core.lod import LoDTensor
+    from paddle_tpu.inference import _pad_batch_feeds
+
+    f = {"x": np.arange(12.0).reshape(3, 4)}
+    out, pad = _pad_batch_feeds(f)
+    assert pad == (3, 4) and out["x"].shape == (4, 4)
+    np.testing.assert_array_equal(out["x"][3], out["x"][2])
+    assert _pad_batch_feeds({"x": np.zeros((4, 2))})[1] is None
+    assert _pad_batch_feeds({"x": LoDTensor(np.zeros((3, 2)),
+                                            lod=[[0, 1, 3]])})[1] is None
+    assert _pad_batch_feeds({"x": np.zeros((3, 2)),
+                             "y": np.zeros((5, 2))})[1] is None
+
+
+def _markov_predictor(scope, V=7, seed=0):
+    """In-memory Markov-LM Predictor (no artifact round trip, so the
+    logic is exercised even where protoc is unavailable): logits[:, t]
+    is an embedding lookup of ids[:, t] — trivially causal."""
+    from paddle_tpu.inference import Predictor
+
+    rs = np.random.RandomState(seed)
+    table = (rs.randn(V, V) * 2).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", [-1], dtype="int64")
+        logits = fluid.layers.embedding(
+            ids, [V, V], param_attr=fluid.ParamAttr(name="trans"))
+    exe = fluid.Executor()
+    exe.run(startup)
+    scope.set_value("trans", table)
+    p = object.__new__(Predictor)
+    p.config = Config("unused")
+    p._native = None
+    p._feeds = {}
+    p._outputs = None
+    p._exe = exe
+    p._program = main
+    p._feed_names = ["ids"]
+    p._fetch_vars = [logits]
+    p._fetch_names = [logits.name]
+    return p, table
+
+
+def test_predictor_generate_inmemory():
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+
+    scope = Scope()
+    with scope_guard(scope):
+        pred, table = _markov_predictor(scope)
+        rs = np.random.RandomState(1)
+        B, P, N = 3, 3, 6
+        prompt = rs.randint(0, table.shape[0], (B, P)).astype(np.int64)
+        toks, lens = pred.generate(prompt, max_new_tokens=N)
+        for b in range(B):
+            prev = prompt[b, -1]
+            for t in range(N):
+                want = table[prev].argmax()
+                assert toks[b, t] == want
+                prev = want
+        assert lens.tolist() == [N] * B
+        # prompt lengths 3..9 only touch the {4, 8, 16} buckets
+        assert len(pred._gen_shapes) <= 3, pred._gen_shapes
+
+
+def test_predictor_feed_count_and_bucketing_inmemory():
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+
+    scope = Scope()
+    with scope_guard(scope):
+        pred, table = _markov_predictor(scope)
+        ids = np.random.RandomState(2).randint(
+            0, table.shape[0], (3, 4)).astype(np.int64)
+        with pytest.raises(ValueError, match="expected 1"):
+            pred.run([ids, ids])
+        with pytest.raises(ValueError, match="expected 1"):
+            pred.run([])
+        got, = pred.run([ids])         # batch 3 pads to 4, slices back
+        assert got.shape == (3, 4, table.shape[0])
+        np.testing.assert_allclose(got, table[ids], rtol=1e-6)
 
 
 @pytest.mark.skipif(not native.available(),

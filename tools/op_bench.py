@@ -29,6 +29,7 @@ default under the test env; the real chip under the driver).
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import os
 import sys
@@ -215,6 +216,7 @@ def _configs():
     cfgs += _configs_extended(simple, unary)
     cfgs += _configs_bwd(cfgs)
     cfgs += _configs_optimizer()
+    cfgs += _configs_flash_decode()
     return cfgs
 
 
@@ -900,6 +902,70 @@ def _configs_optimizer():
         ("optimizer_step_adam_per_param", direct("adam", False)),
         ("optimizer_step_sgd_fused", direct("sgd", True)),
         ("optimizer_step_sgd_per_param", direct("sgd", False)),
+    ]
+
+
+def _configs_flash_decode():
+    """flash_decode rows: single-token decode attention against a
+    static KV cache (ops/attention.decode_attention), several cache
+    lengths / batch sizes, split-K on vs off. Direct benches through
+    the DISPATCHER: on the committed-baseline CPU backend both split
+    settings time the XLA reference (identical by construction — the
+    rows exist so the TPU driver's refresh shows the split-K delta);
+    on TPU the pallas kernel engages with the requested split."""
+
+    def direct(batch, heads, L, d, split, steps=30):
+        def bench():
+            import jax
+            import jax.numpy as jnp
+
+            from paddle_tpu.ops.attention import decode_attention
+
+            rs = np.random.RandomState(0)
+            q = jnp.asarray(rs.randn(batch, heads, 1, d).astype("f4"))
+            k = jnp.asarray(rs.randn(batch, heads, L, d).astype("f4"))
+            v = jnp.asarray(rs.randn(batch, heads, L, d).astype("f4"))
+            length = jnp.int32(L * 3 // 4)
+
+            fn = jax.jit(functools.partial(decode_attention,
+                                           split_k=split))
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(q, k, v, length))
+            compile_s = time.perf_counter() - t0
+
+            def run_n(n):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    out = fn(q, k, v, length)
+                jax.block_until_ready(out)
+                return time.perf_counter() - t0
+
+            e2e_s = run_n(1)
+            run_n(5)
+            run_n(steps)
+            slopes = []
+            for _ in range(5):
+                t_lo = run_n(5)
+                t_hi = run_n(steps)
+                if t_hi > t_lo:
+                    slopes.append((t_hi - t_lo) / (steps - 5))
+            slopes.sort()
+            dt = slopes[len(slopes) // 2] if slopes else e2e_s
+            return {"e2e_us": round(e2e_s * 1e6, 1),
+                    "step_us": round(dt * 1e6, 2),
+                    "compile_s": round(compile_s, 2)}
+
+        bench._direct = True
+        return bench
+
+    return [
+        ("flash_decode_b1_L2048_split", direct(1, 8, 2048, 64, 4)),
+        ("flash_decode_b1_L2048_nosplit", direct(1, 8, 2048, 64, 1)),
+        ("flash_decode_b8_L2048_split", direct(8, 8, 2048, 64, 4)),
+        ("flash_decode_b8_L2048_nosplit", direct(8, 8, 2048, 64, 1)),
+        ("flash_decode_b8_L8192_split", direct(8, 8, 8192, 64, 8)),
+        ("flash_decode_b8_L8192_nosplit", direct(8, 8, 8192, 64, 1)),
+        ("flash_decode_b32_L512_split", direct(32, 8, 512, 64, 4)),
     ]
 
 
