@@ -1055,6 +1055,143 @@ def _decode_throughput(points=((4, 64), (16, 64), (4, 128)),
                        "parity_checked": True}}
 
 
+def _serving_throughput(n_requests=48, num_slots=8, d_model=128,
+                        nhead=4, ffn=256, n_layers=2, vocab=512,
+                        mem_len=8, max_new=12, prompt_max=8):
+    """Continuous batching vs static-batch drain under Poisson
+    arrivals. A side: the serving runtime — requests join the 8-slot
+    ServingEngine the iteration a slot frees, so TTFT is one prefill
+    away and short requests never wait on long co-residents. B side:
+    the legacy regime — arrivals accumulate while DecodeEngine.generate
+    drains the current batch; everyone in a batch waits for the whole
+    batch (tokens only surface at the end), and nobody joins mid-run.
+    Same model, same arrival schedule, same per-request work; reports
+    tok/s plus p50/p99 TTFT for both."""
+    import jax.numpy as jnp
+
+    from paddle_tpu import nn
+    from paddle_tpu.nn.layer.transformer import (TransformerDecoder,
+                                                 TransformerDecoderLayer)
+    from paddle_tpu.serving import Request, Scheduler, ServingEngine
+    from paddle_tpu.text.generation import DecodeEngine
+
+    layer = TransformerDecoderLayer(d_model, nhead, ffn, dropout=0.0)
+    dec = TransformerDecoder(layer, n_layers)
+    dec.eval()
+    embed = nn.Embedding(vocab, d_model)
+    proj = nn.Linear(d_model, vocab)
+    rs = np.random.RandomState(0)
+
+    def mk_workload():
+        """(prompt [P], lengths, memory) per request; prompts ragged,
+        right-padded copies for the static side (fixed P0=prompt_max
+        so the static engine compiles one prompt bucket)."""
+        work = []
+        for _ in range(n_requests):
+            P = int(rs.randint(1, prompt_max + 1))
+            prompt = rs.randint(2, vocab, (prompt_max,)).astype("i4")
+            prompt[0] = 0
+            mem = rs.randn(mem_len, d_model).astype("f4")
+            work.append((prompt, P, mem))
+        return work
+
+    work = mk_workload()
+    max_len = bucket_sz = 1 << (prompt_max - 1).bit_length()
+    max_len = bucket_sz + max_new
+
+    # ---- A: continuous batching (synchronous drive, real clock) ----
+    eng = ServingEngine(dec, embed, proj, num_slots=num_slots,
+                        max_len=max_len)
+    sched = Scheduler(max_queue=n_requests + 8)
+    # warm every join bucket + the step before timing
+    for P in sorted({1 << (max(p, 1) - 1).bit_length()
+                     for _, p, _ in work}):
+        r = Request(work[0][0][:P].copy(), work[0][2],
+                    max_new_tokens=1, eos_id=1)
+        sched.submit(r)
+        eng.serve_until_idle(sched, max_iterations=50)
+
+    gap = 0.004   # mean Poisson inter-arrival (s): ~arrival/iteration
+    gaps = rs.exponential(gap, n_requests)
+    reqs = []
+    t0 = time.perf_counter()
+    next_arrival = t0
+    i = 0
+    while i < len(work) or sched.depth() > 0 or eng.occupancy() > 0:
+        now = time.perf_counter()
+        while i < len(work) and now >= next_arrival:
+            prompt, P, mem = work[i]
+            reqs.append(sched.submit(Request(
+                prompt[:P].copy(), mem, max_new_tokens=max_new,
+                eos_id=1)))
+            next_arrival += gaps[i]
+            i += 1
+        eng.run_iteration(sched)
+    cont_wall = time.perf_counter() - t0
+    cont_ttft = np.asarray([r.result().ttft_s for r in reqs])
+    cont_tokens = sum(len(r.result().tokens) for r in reqs)
+
+    # ---- B: static-batch drain on DecodeEngine.generate ----
+    deng = DecodeEngine(dec, embed, proj)
+    for b in (1, 2, 4, 8):   # warm the batch buckets the drain hits
+        mems = jnp.asarray(np.stack([work[0][2]] * b))
+        pr = jnp.asarray(np.stack([work[0][0]] * b))
+        ln = jnp.asarray(np.full((b,), work[0][1], "i4"))
+        deng.generate(mems, pr, ln, bos_id=0, eos_id=1,
+                      max_new_tokens=max_new)
+    t0 = time.perf_counter()
+    next_arrival = t0
+    arrived = []          # (arrival_time, index)
+    stat_ttft = []
+    stat_tokens = 0
+    i = 0
+    while i < len(work) or arrived:
+        now = time.perf_counter()
+        while i < len(work) and now >= next_arrival:
+            arrived.append((next_arrival, i))
+            next_arrival += gaps[i]
+            i += 1
+        if not arrived:
+            time.sleep(max(0.0, next_arrival - now))
+            continue
+        batch = arrived[:num_slots]   # same concurrency as the pool
+        arrived = arrived[num_slots:]
+        mems = jnp.asarray(np.stack([work[j][2] for _, j in batch]))
+        pr = jnp.asarray(np.stack([work[j][0] for _, j in batch]))
+        ln = jnp.asarray(np.asarray([work[j][1] for _, j in batch],
+                                    "i4"))
+        toks, lens = deng.generate(mems, pr, ln, bos_id=0, eos_id=1,
+                                   max_new_tokens=max_new)
+        t_done = time.perf_counter()
+        stat_tokens += int(np.asarray(lens).sum())
+        stat_ttft.extend(t_done - t_arr for t_arr, _ in batch)
+    stat_wall = time.perf_counter() - t0
+    stat_ttft = np.asarray(stat_ttft)
+
+    def pct(a, q):
+        return round(float(np.percentile(a, q)) * 1e3, 1)
+
+    cont_tps = cont_tokens / cont_wall
+    stat_tps = stat_tokens / stat_wall
+    return {"metric": "serving_throughput",
+            "value": round(float(np.percentile(stat_ttft, 50) /
+                                 np.percentile(cont_ttft, 50)), 2),
+            "unit": "x lower p50 TTFT vs static-batch drain",
+            "continuous": {"tok_per_s": round(cont_tps, 1),
+                           "ttft_p50_ms": pct(cont_ttft, 50),
+                           "ttft_p99_ms": pct(cont_ttft, 99),
+                           "wall_s": round(cont_wall, 2)},
+            "static_drain": {"tok_per_s": round(stat_tps, 1),
+                             "ttft_p50_ms": pct(stat_ttft, 50),
+                             "ttft_p99_ms": pct(stat_ttft, 99),
+                             "wall_s": round(stat_wall, 2)},
+            "config": {"n_requests": n_requests, "slots": num_slots,
+                       "layers": n_layers, "d_model": d_model,
+                       "max_new_tokens": max_new,
+                       "poisson_mean_gap_ms": 4,
+                       "prompt_len": f"1..{prompt_max} ragged"}}
+
+
 def _multichip_scaling(devices=None, sizes_mb=(4, 64), ar_iters=8,
                        dp_steps=6):
     """Config 4 harness: fleet collective allreduce bandwidth + DP weak
@@ -1184,6 +1321,7 @@ def main():
                ("packed_varlen", _packed_varlen),
                ("fused_optimizer", _fused_optimizer),
                ("decode_throughput", _decode_throughput),
+               ("serving_throughput", _serving_throughput),
                ("multichip_scaling", _multichip_scaling)]
     results = {}
     headline = None

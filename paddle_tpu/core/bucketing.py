@@ -1,0 +1,71 @@
+"""Shape-bucketing helpers shared by the decode engine, the inference
+Predictor, and the serving runtime.
+
+Serving traffic drifts over batch sizes and prompt lengths; compiling
+one XLA program per distinct shape makes the jit cache O(traffic). The
+shared policy here pads every serving-visible dimension to the next
+power of two, so the cache stays O(log n) programs:
+
+  * `bucket_size` — the bucket boundary itself;
+  * `pad_rows` — leading-dim padding with replicated edge rows (rows
+    are numerically safe for row-wise programs and get sliced back off
+    the results);
+  * `pad_batch_feeds` — the Predictor's feed-dict variant with the LoD
+    / disagreeing-batch escape hatches.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["bucket_size", "pad_rows", "pad_batch_feeds"]
+
+
+def bucket_size(n, minimum=1):
+    """Next power of two >= n — the shape-bucket policy shared by the
+    decode engine, Predictor serving, and the continuous-batching
+    runtime (compile cache O(log n))."""
+    n = max(int(n), int(minimum))
+    return 1 << (n - 1).bit_length()
+
+
+def pad_rows(x, n):
+    """Pad the leading dim of a jax array to n by replicating the last
+    row (edge rows are numerically safe and get sliced off the
+    results)."""
+    import jax.numpy as jnp
+
+    b = x.shape[0]
+    if b == n:
+        return x
+    return jnp.concatenate(
+        [x, jnp.broadcast_to(x[-1:], (n - b,) + x.shape[1:])], axis=0)
+
+
+def pad_batch_feeds(feeds):
+    """Pad every plain-ndarray feed's leading dim to the next power of
+    two by replicating the last row (numerically safe for the row-wise
+    programs inference artifacts are; edge rows are sliced back off the
+    outputs). Skipped entirely — returns (feeds, None) — when any feed
+    is a LoDTensor (rows carry sequence structure), feeds disagree on
+    batch size, or the batch is already a power of two."""
+    from .lod import LoDTensor
+
+    if not feeds or any(isinstance(v, LoDTensor) for v in feeds.values()):
+        return feeds, None
+    batches = {v.shape[0] for v in feeds.values()
+               if getattr(v, "ndim", 0) >= 1 and v.shape[0] > 0}
+    if len(batches) != 1:
+        return feeds, None
+    b = batches.pop()
+    nb = bucket_size(b)
+    if nb == b:
+        return feeds, None
+    out = {}
+    for name, v in feeds.items():
+        if getattr(v, "ndim", 0) >= 1 and v.shape[0] == b:
+            out[name] = np.concatenate(
+                [v, np.broadcast_to(v[-1:], (nb - b,) + v.shape[1:])],
+                axis=0)
+        else:
+            out[name] = v
+    return out, (b, nb)

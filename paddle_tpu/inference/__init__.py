@@ -17,6 +17,8 @@ import os
 
 import numpy as np
 
+from ..core.bucketing import bucket_size, pad_batch_feeds
+
 __all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor"]
 
 
@@ -36,6 +38,7 @@ class Config:
         self._device = None
         self._ir_optim = True
         self._batch_bucketing = True
+        self._serving = None
 
     # engine/device toggles (enable_use_gpu equivalents)
     def enable_use_tpu(self, device_id=0):
@@ -60,6 +63,19 @@ class Config:
 
     def enable_memory_optim(self):
         pass
+
+    def enable_serving_engine(self, num_slots=8, max_queue=256,
+                              max_joins_per_iter=2):
+        """Route `Predictor.generate` through the continuous-batching
+        `serving.ArtifactServingEngine`: a fixed pool of `num_slots`
+        generation slots stepped one token per iteration, so the
+        offline generate() path and any online `Predictor.serve()`
+        frontend share ONE engine instance — and therefore one compiled
+        decode step per (slots, bucketed-length) shape — instead of
+        compiling separate programs per calling convention."""
+        self._serving = {"num_slots": int(num_slots),
+                         "max_queue": int(max_queue),
+                         "max_joins_per_iter": int(max_joins_per_iter)}
 
     def switch_batch_bucketing(self, flag=True):
         """xla engine: pad the leading batch dim of every feed to the
@@ -241,24 +257,10 @@ class Predictor:
         the bucketed prefix — the fully fused static-cache scan lives on
         nn.TransformerDecoder.generate / text.generation.DecodeEngine
         for in-process models."""
-        if self._native is not None:
-            raise RuntimeError("Predictor.generate requires the xla "
-                               "engine")
-        if len(self._feed_names) != 1 or len(self._fetch_names) != 1:
-            raise ValueError(
-                "generate needs a single-feed/single-fetch LM artifact; "
-                f"got feeds={self._feed_names} "
-                f"fetches={self._fetch_names}")
-        import jax
-
-        from ..fluid.executor import _lower_block_callable
-        from ..text.generation import bucket_size
-
-        if getattr(self, "_gen_fn", None) is None:
-            fn, _ = _lower_block_callable(
-                self._program, self._feed_names, self._fetch_names)
-            self._gen_fn = jax.jit(fn)
-            self._gen_shapes = set()  # bucketed shapes actually compiled
+        self._ensure_gen_fn()
+        if self.config._serving is not None:
+            return self._generate_serving(input_ids, max_new_tokens,
+                                          eos_id)
         ids = np.asarray(input_ids)
         B0, cur_len = ids.shape
         dtype = ids.dtype if np.issubdtype(ids.dtype, np.integer) \
@@ -293,6 +295,86 @@ class Predictor:
             out = np.concatenate([out, pad], axis=1)
         return out, lens
 
+    def _ensure_gen_fn(self):
+        """The jitted whole-artifact callable behind generate() and the
+        serving engine — one compile cache for both."""
+        if self._native is not None:
+            raise RuntimeError("Predictor.generate requires the xla "
+                               "engine")
+        if len(self._feed_names) != 1 or len(self._fetch_names) != 1:
+            raise ValueError(
+                "generate needs a single-feed/single-fetch LM artifact; "
+                f"got feeds={self._feed_names} "
+                f"fetches={self._fetch_names}")
+        if getattr(self, "_gen_fn", None) is None:
+            import jax
+
+            from ..fluid.executor import _lower_block_callable
+
+            fn, _ = _lower_block_callable(
+                self._program, self._feed_names, self._fetch_names)
+            self._gen_fn = jax.jit(fn)
+            self._gen_shapes = set()  # bucketed shapes actually compiled
+        return self._gen_fn
+
+    def _serving_engine_instance(self, dtype):
+        from ..serving import ArtifactServingEngine
+
+        eng = getattr(self, "_serving_eng", None)
+        if eng is None:
+            cfg = self.config._serving
+            eng = ArtifactServingEngine(
+                self._ensure_gen_fn(), num_slots=cfg["num_slots"],
+                dtype=dtype,
+                max_joins_per_iter=cfg["max_joins_per_iter"])
+            self._serving_eng = eng
+        return eng
+
+    def _generate_serving(self, input_ids, max_new_tokens, eos_id):
+        """generate() routed through the continuous-batching slot
+        engine: each row becomes a Request, the whole batch drains
+        through the shared slot pool. Same output contract as the
+        direct path — (tokens [B, max_new_tokens], lengths [B]),
+        eos-padded — so the switch is behaviorally invisible."""
+        from ..serving import Request, Scheduler
+
+        ids = np.asarray(input_ids)
+        B0 = ids.shape[0]
+        dtype = ids.dtype if np.issubdtype(ids.dtype, np.integer) \
+            else np.int64
+        eng = self._serving_engine_instance(dtype)
+        sched = Scheduler(
+            max_queue=max(self.config._serving["max_queue"], B0))
+        reqs = [Request(row.astype(dtype),
+                        max_new_tokens=max_new_tokens, eos_id=eos_id)
+                for row in ids]
+        for r in reqs:
+            sched.submit(r)
+        eng.serve_until_idle(sched)
+        fill = 0 if eos_id is None else eos_id
+        out = np.full((B0, max_new_tokens), fill, dtype)
+        lens = np.zeros((B0,), np.int64)
+        for b, r in enumerate(reqs):
+            res = r.result()
+            out[b, :len(res.tokens)] = res.tokens.astype(dtype)
+            lens[b] = len(res.tokens)
+        return out, lens
+
+    def serve(self, *, max_queue=None, **server_kwargs):
+        """Online frontend for this artifact: an always-on
+        `serving.ServingServer` whose engine is the SAME slot engine
+        (and compile cache) `generate()` uses when
+        `Config.enable_serving_engine()` is set. Returns the started
+        server; submit(prompt_row) -> Request future."""
+        if self.config._serving is None:
+            self.config.enable_serving_engine()
+        from ..serving import ServingServer
+
+        eng = self._serving_engine_instance(np.int64)
+        if max_queue is None:
+            max_queue = self.config._serving["max_queue"]
+        return ServingServer(eng, max_queue=max_queue, **server_kwargs)
+
     # StableHLO export of the whole inference computation (serving systems
     # / compiler toolchains; reference's save_optimized_model analog)
     def export_stablehlo(self, example_feeds):
@@ -309,34 +391,9 @@ class Predictor:
         return lowered.as_text(dialect="stablehlo")
 
 
-def _pad_batch_feeds(feeds):
-    """Pad every plain-ndarray feed's leading dim to the next power of
-    two by replicating the last row (numerically safe for the row-wise
-    programs inference artifacts are; edge rows are sliced back off the
-    outputs). Skipped entirely — returns (feeds, None) — when any feed
-    is a LoDTensor (rows carry sequence structure), feeds disagree on
-    batch size, or the batch is already a power of two."""
-    from ..core.lod import LoDTensor
-
-    if not feeds or any(isinstance(v, LoDTensor) for v in feeds.values()):
-        return feeds, None
-    batches = {v.shape[0] for v in feeds.values()
-               if getattr(v, "ndim", 0) >= 1 and v.shape[0] > 0}
-    if len(batches) != 1:
-        return feeds, None
-    b = batches.pop()
-    nb = 1 << (b - 1).bit_length()
-    if nb == b:
-        return feeds, None
-    out = {}
-    for name, v in feeds.items():
-        if getattr(v, "ndim", 0) >= 1 and v.shape[0] == b:
-            out[name] = np.concatenate(
-                [v, np.broadcast_to(v[-1:], (nb - b,) + v.shape[1:])],
-                axis=0)
-        else:
-            out[name] = v
-    return out, (b, nb)
+# the shared pow2 helper; the old private name stays importable for
+# existing callers/tests
+_pad_batch_feeds = pad_batch_feeds
 
 
 def create_predictor(config):

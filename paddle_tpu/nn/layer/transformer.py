@@ -120,25 +120,75 @@ class MultiHeadAttention(Layer):
         qd, kd, vd = raw(q), raw(k), raw(v)
         kbuf, vbuf, idx = raw(cache.k), raw(cache.v), raw(cache.index)
         b, h, s, d = qd.shape
-        pos = (idx[0] if idx.ndim else idx).astype(jnp.int32)
+        idx = (idx if idx.ndim else idx[None]).astype(jnp.int32)
         z = jnp.int32(0)
-        kbuf = jax.lax.dynamic_update_slice(kbuf, kd.astype(kbuf.dtype),
-                                            (z, z, pos, z))
-        vbuf = jax.lax.dynamic_update_slice(vbuf, vd.astype(vbuf.dtype),
-                                            (z, z, pos, z))
+        if s == 1:
+            # decode step: per-ROW write positions — the serving slot
+            # pool holds requests at independent offsets; lockstep
+            # batches (DecodeEngine) are the all-equal special case
+            def _write(buf, new, i):
+                return jax.lax.dynamic_update_slice(buf, new, (z, i, z))
+
+            kbuf = jax.vmap(_write)(kbuf, kd.astype(kbuf.dtype), idx)
+            vbuf = jax.vmap(_write)(vbuf, vd.astype(vbuf.dtype), idx)
+        else:
+            # multi-token prefill of an empty cache: lockstep by
+            # contract, one dynamic_update_slice covers every row
+            pos = idx[0]
+            kbuf = jax.lax.dynamic_update_slice(
+                kbuf, kd.astype(kbuf.dtype), (z, z, pos, z))
+            vbuf = jax.lax.dynamic_update_slice(
+                vbuf, vd.astype(vbuf.dtype), (z, z, pos, z))
         new_cache = MultiHeadAttention.StaticKVCache(
             kbuf, vbuf, (idx + s).astype(jnp.int32))
         mask = None if attn_mask is None else raw(attn_mask)
         if mask is not None and mask.ndim > 2:
             mask = mask.reshape(mask.shape[0], mask.shape[-1])
         if s == 1:
-            out = A.decode_attention(qd, kbuf, vbuf, pos + 1, bias=mask)
+            out = A.decode_attention(qd, kbuf, vbuf, idx + 1, bias=mask)
         else:
             bias4 = None if mask is None else \
                 mask.astype(jnp.float32)[:, None, None, :]
             out = A.sdpa(qd, kd, vd, bias4, is_causal=True)
         out = jnp.swapaxes(out, 1, 2).reshape(b, s, h * d)
         return Tensor._wrap(out), new_cache
+
+    @staticmethod
+    def static_kv_splice(cache, slot, k_new, v_new, n_written):
+        """Slot JOIN for pooled serving caches: write a prefilled
+        [1, H, P, D] K/V block into row `slot` of a pooled [S, H, L, D]
+        StaticKVCache (P <= L) and set that row's write index to
+        `n_written`, leaving every other slot's buffers and index
+        untouched. `slot` and `n_written` are traced int32 scalars, so
+        joining ANY slot at ANY admitted prompt length reuses one
+        compiled program — slot join never retraces."""
+        import jax
+        import jax.numpy as jnp
+
+        z = jnp.int32(0)
+        slot = jnp.asarray(slot, jnp.int32)
+        k = jax.lax.dynamic_update_slice(
+            cache.k, k_new.astype(cache.k.dtype), (slot, z, z, z))
+        v = jax.lax.dynamic_update_slice(
+            cache.v, v_new.astype(cache.v.dtype), (slot, z, z, z))
+        index = jax.lax.dynamic_update_slice(
+            cache.index,
+            jnp.asarray(n_written, jnp.int32).reshape(1), (slot,))
+        return MultiHeadAttention.StaticKVCache(k, v, index)
+
+    @staticmethod
+    def splice_rows(buf, slot, rows):
+        """Row splice for any pooled per-slot buffer ([S, ...]): write
+        `rows` ([1, ...], trailing dims <= buf's) at row `slot` (traced
+        int32). Used for the serving pool's cross-attention StaticCache
+        K/V, pad-bias rows, and memory rows on slot join."""
+        import jax
+        import jax.numpy as jnp
+
+        z = jnp.int32(0)
+        start = (jnp.asarray(slot, jnp.int32),) + (z,) * (buf.ndim - 1)
+        return jax.lax.dynamic_update_slice(
+            buf, rows.astype(buf.dtype), start)
 
     def gen_cache(self, key, value=None, type=None, max_length=None,
                   batch_size=None, dtype=None):

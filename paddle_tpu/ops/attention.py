@@ -1264,7 +1264,10 @@ def _flash_decode_call(b, h, L, d, s, n_splits, has_bias, interpret):
             q_ref, k_ref, v_ref, o_ref, m_ref, l_ref = refs
         si = pl.program_id(1)
         start = si * jnp.int32(split)
-        n_valid = len_ref[0]
+        # per-ROW written count: the serving slot pool decodes rows at
+        # independent cache offsets (len_ref is [b]; lockstep batches
+        # are the all-equal special case)
+        n_valid = len_ref[pl.program_id(0) // jnp.int32(h)]
 
         @pl.when(start < n_valid)
         def _compute():
@@ -1333,8 +1336,10 @@ def flash_decode(q, k, v, length, bias=None, scale=None, split_k=None,
     K/V, split-K over the cache length so a long cache still spreads
     across the grid (a single (1, L) row otherwise leaves the chip
     idle). Per-split partial (acc, m, l) merge in XLA with the standard
-    logsumexp combine. `length` is the lockstep written-token count
-    (int32, traced); splits entirely past it are skipped in-kernel."""
+    logsumexp combine. `length` is the written-token count (int32,
+    traced; a scalar for lockstep batches or [b] for the serving slot
+    pool, where every row decodes at its own offset); splits entirely
+    past a row's count are skipped in-kernel."""
     import jax.numpy as jnp
 
     b, h, sq, d = q.shape
@@ -1348,7 +1353,8 @@ def flash_decode(q, k, v, length, bias=None, scale=None, split_k=None,
     qr = q.reshape(b * h, 1, d)
     kr = k.reshape(b * h, L, d)
     vr = v.reshape(b * h, L, d)
-    len_arr = jnp.asarray(length, jnp.int32).reshape(-1)[:1]
+    len_arr = jnp.broadcast_to(
+        jnp.asarray(length, jnp.int32).reshape(-1), (b,))
     call = _flash_decode_call(b, h, L, d, s, n_splits, bias is not None,
                               interpret)
     args = [qr, kr, vr]

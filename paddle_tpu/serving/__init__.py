@@ -1,0 +1,29 @@
+"""Continuous-batching serving runtime.
+
+An always-on generation engine with iteration-level (Orca/vLLM-style)
+batching over the static KV cache:
+
+  * `engine.ServingEngine` — fixed pool of S cache slots; ONE jitted
+    decode step of static shape [S, ...] with a per-slot active mask;
+    slot join = batch-1 bucketed prefill spliced into the live pool
+    (never retraces);
+  * `scheduler.Scheduler` / `Request` — bounded FIFO admission with
+    backpressure (`QueueFull`), deadlines, cancellation, drain;
+  * `server.ServingServer` — thread frontend: submit() -> future with
+    per-token streaming;
+  * `metrics.ServingMetrics` — TTFT / per-token latency / tokens/s /
+    queue depth / occupancy, `snapshot()` + hapi-style callbacks.
+
+See the "Serving runtime" section of the README for the slot
+lifecycle, backpressure and deadline semantics, and the metrics table.
+"""
+from .engine import ArtifactServingEngine, ServingEngine
+from .metrics import CallbackList, ServingCallback, ServingMetrics
+from .scheduler import QueueFull, Request, RequestResult, Scheduler
+from .server import ServingServer
+
+__all__ = [
+    "ServingEngine", "ArtifactServingEngine", "ServingServer",
+    "Scheduler", "Request", "RequestResult", "QueueFull",
+    "ServingMetrics", "ServingCallback", "CallbackList",
+]

@@ -1,0 +1,471 @@
+"""Continuous-batching generation engines: a fixed slot pool over the
+static KV cache.
+
+`DecodeEngine` (text/generation.py) made whole-batch generation one
+compiled program, but a batch is an all-or-nothing unit: a straggler
+request pins every finished row and new arrivals wait for a full
+drain. The serving engines here do Orca/vLLM-style *iteration-level*
+batching instead — the scheduling unit is ONE decode step:
+
+  * the pool owns S cache slots: per-layer `StaticKVCache` buffers of
+    shape [S, H, max_len, D] with PER-ROW write indices, plus pooled
+    cross-attention K/V, pad-bias rows, and memory rows;
+  * the decode step is ONE jitted call of static shape [S, ...] with a
+    per-slot active mask — compiled once per pool config, regardless of
+    which requests occupy which slots (`trace_counts` proves it);
+  * a finished/evicted slot is refilled by prefilling the new prompt
+    (batch-1, prompt bucketed to a power of two) through the regular
+    flash-capable path and SPLICING its K/V rows + write index into the
+    live pool with `dynamic_update_slice` — the slot id and prompt
+    length are traced scalars, so slot join never retraces either
+    (one compile per prompt bucket).
+
+Numerics contract: every slot reproduces `generate_eager` for its own
+prompt bit-for-bit at the token level — all per-slot ops are row-wise,
+so co-resident requests can never perturb each other's output; the
+soak test in tests/test_serving.py holds this across joins, evictions,
+and timeouts.
+
+`ArtifactServingEngine` applies the same slot lifecycle to inference
+Program artifacts (ids -> logits, no threadable cache): each iteration
+re-runs every active slot's bucketed prefix, batched across slots —
+the `Predictor.generate` serving mode behind
+`Config.enable_serving_engine()`.
+"""
+from __future__ import annotations
+
+import collections
+import time
+
+import numpy as np
+
+from ..core.bucketing import bucket_size
+from .metrics import CallbackList, ServingMetrics
+
+__all__ = ["ServingEngine", "ArtifactServingEngine"]
+
+
+class _EngineBase:
+    """Slot lifecycle + per-iteration orchestration shared by the
+    model-backed and artifact-backed engines. Subclasses implement
+    `_join(slot, request) -> first_token | None`, `_decode_step(active)
+    -> tokens [S]`, and optionally `_evict(slot)` / `admit_check`.
+
+    One `run_iteration(scheduler)` is the continuous-batching unit:
+    (1) fault harvest — cancelled / past-deadline requests leave their
+    slots with partial output; (2) admission — up to
+    `max_joins_per_iter` queued requests prefill into free slots (the
+    prefill/decode interleave policy: bounding joins per iteration
+    bounds the decode stall co-resident requests see); (3) one batched
+    decode step over the active mask. NOT thread-safe — drive it from
+    one thread (the `ServingServer` loop or a synchronous drain)."""
+
+    def __init__(self, num_slots, *, max_joins_per_iter=2, metrics=None,
+                 callbacks=(), clock=time.monotonic):
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        self.num_slots = int(num_slots)
+        self.max_joins_per_iter = int(max_joins_per_iter)
+        self.clock = clock
+        self.metrics = metrics if metrics is not None else \
+            ServingMetrics(clock=clock)
+        self._cbs = CallbackList(callbacks)
+        self.slots = [None] * self.num_slots   # Request | None
+        self.trace_counts = collections.Counter()
+
+    # ---- subclass surface ----
+    def admit_check(self, request):
+        """Raise ValueError for requests this pool can never serve."""
+
+    def _join(self, slot, request):
+        raise NotImplementedError
+
+    def _decode_step(self, active):
+        raise NotImplementedError
+
+    def _evict(self, slot):
+        """Host-side bookkeeping on slot release (device state needs
+        none: the active mask hides the slot and the next join splices
+        over it)."""
+
+    # ---- slot lifecycle ----
+    def occupancy(self):
+        return sum(r is not None for r in self.slots)
+
+    def _finish_slot(self, s, reason, now):
+        r = self.slots[s]
+        self.slots[s] = None
+        self._evict(s)
+        self.metrics.record_finish(reason)
+        r.finish(reason, now)
+        self._cbs.emit("on_finish", r)
+
+    def _deliver(self, r, tok, now):
+        if r.state == "DONE":
+            return
+        r.tokens.append(tok)
+        self.metrics.record_token()
+        if r.first_token_at is None:
+            r.first_token_at = now
+            if r.submitted_at is not None:
+                self.metrics.record_first_token(now - r.submitted_at)
+        self._cbs.emit("on_token", r, tok)
+        if r.stream_cb is not None:
+            try:
+                r.stream_cb(r, tok)
+            except Exception:
+                pass
+        if r.eos_id is not None and tok == r.eos_id:
+            self._finish_slot(r.slot, "eos", now)
+        elif len(r.tokens) >= r.max_new_tokens:
+            self._finish_slot(r.slot, "length", now)
+
+    # ---- the continuous-batching iteration ----
+    def run_iteration(self, scheduler):
+        """One iteration: harvest faults, admit new work, decode one
+        token for every active slot. Returns True when any work was
+        done (False = idle: empty queue, empty pool)."""
+        now = self.clock()
+        progress = False
+        # 1. fault harvest: cancellation + deadline eviction happen at
+        # iteration boundaries — partial tokens are delivered
+        for s, r in enumerate(self.slots):
+            if r is None:
+                continue
+            if r.cancelled:
+                self._finish_slot(s, "cancelled", now)
+                progress = True
+            elif r.expired(now):
+                self._finish_slot(s, "timeout", now)
+                progress = True
+        # 2. admission: refill free slots, bounded per iteration
+
+        def _queue_death(req):   # cancelled/expired while QUEUED
+            self.metrics.record_finish(req.finish_reason)
+            self._cbs.emit("on_finish", req)
+
+        joins = 0
+        while joins < self.max_joins_per_iter:
+            free = [i for i, r in enumerate(self.slots) if r is None]
+            if not free:
+                break
+            r = scheduler.pop_ready(now, on_dead=_queue_death)
+            if r is None:
+                break
+            try:
+                self.admit_check(r)
+            except Exception as e:
+                # unservable request that bypassed the frontend check
+                r.state = "DONE"
+                r.finish_reason = "error"
+                r.future.set_exception(e)
+                self.metrics.record_finish("error")
+                continue
+            s = free[0]
+            r.state, r.slot = "RUNNING", s
+            self.slots[s] = r
+            tok = self._join(s, r)
+            joins += 1
+            progress = True
+            self.metrics.record_join()
+            self._cbs.emit("on_join", r, s)
+            if tok is not None:   # prefill already produced token 0
+                self._deliver(r, int(tok), self.clock())
+        # 3. one batched decode step over the active mask
+        active = np.asarray([r is not None for r in self.slots], bool)
+        if active.any():
+            t0 = self.clock()
+            toks = self._decode_step(active)
+            now2 = self.clock()
+            n = 0
+            for s, r in enumerate(list(self.slots)):
+                if r is not None:
+                    self._deliver(r, int(toks[s]), now2)
+                    n += 1
+            self.metrics.record_decode(n, now2 - t0)
+            progress = True
+        self.metrics.record_iteration(
+            scheduler.depth(), self.occupancy() / self.num_slots)
+        self._cbs.emit("on_iteration", {
+            "queue_depth": scheduler.depth(),
+            "occupancy": self.occupancy(), "joins": joins})
+        return progress
+
+    def serve_until_idle(self, scheduler, max_iterations=None):
+        """Synchronous drive: iterate until queue and pool are empty.
+        The offline path (Predictor.generate, benches, tests) — online
+        serving wraps run_iteration in a ServingServer thread."""
+        it = 0
+        while scheduler.depth() > 0 or self.occupancy() > 0:
+            self.run_iteration(scheduler)
+            it += 1
+            if max_iterations is not None and it >= max_iterations:
+                raise RuntimeError(
+                    f"serve_until_idle: no convergence after {it} "
+                    f"iterations")
+
+    def abort_active(self, reason, now=None):
+        """Finalize every in-flight request (non-drain shutdown);
+        partial tokens are delivered."""
+        if now is None:
+            now = self.clock()
+        for s, r in enumerate(self.slots):
+            if r is not None:
+                self._finish_slot(
+                    s, "cancelled" if r.cancelled else reason, now)
+
+
+class ServingEngine(_EngineBase):
+    """The always-on model-backed engine: (decoder, embed, project)
+    triple — the same step net `DecodeEngine` compiles — over a pooled
+    StaticKVCache of `num_slots` rows x `max_len` positions.
+
+    Admission contract: a request needs `bucket(prompt_len) +
+    max_new_tokens <= max_len` cache positions and a cross-attention
+    `memory` of the pool's [M, D] shape (fixed by the first join).
+    Token positions follow the DecodeEngine convention — prompt at
+    [0, Pb), its pad hole key-masked forever, generated tokens at
+    absolute slots Pb, Pb+1, ... — which is what makes every slot's
+    output bit-comparable to a solo `generate_eager` run."""
+
+    def __init__(self, decoder, embed, project, *, num_slots=8,
+                 max_len=128, max_joins_per_iter=2, metrics=None,
+                 callbacks=(), clock=time.monotonic):
+        super().__init__(num_slots, max_joins_per_iter=max_joins_per_iter,
+                         metrics=metrics, callbacks=callbacks, clock=clock)
+        from ..parallel.functional import functionalize
+        from ..text.generation import _StepNet
+
+        self.max_len = int(max_len)
+        self._net = _StepNet(decoder, embed, project)
+        self._fm = functionalize(self._net)
+        self._compiled = {}
+        self._state = None          # lazily built on first join
+        self._mem_shape = None
+        self._np_dtype = None
+        self._pool_key = None
+
+    # ------------------------------------------------------------------
+    def admit_check(self, r):
+        P = max(1, int(r.prompt.shape[0]))
+        Pb = bucket_size(P)
+        if Pb + r.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"request needs bucket({P})={Pb} prompt slots + "
+                f"{r.max_new_tokens} decode slots > pool max_len "
+                f"{self.max_len}")
+        if r.memory is None or r.memory.ndim != 2:
+            raise ValueError("ServingEngine requests need a 2-D "
+                             "cross-attention memory [M, D]")
+        if self._mem_shape is not None and \
+                tuple(r.memory.shape) != self._mem_shape:
+            raise ValueError(
+                f"memory shape {tuple(r.memory.shape)} != pool's "
+                f"{self._mem_shape} (fixed by the first join)")
+
+    def _ensure_state(self, memory):
+        if self._state is not None:
+            return
+        import jax.numpy as jnp
+
+        from ..text.generation import NEG
+
+        decoder = self._net.decoder
+        M, Dm = memory.shape
+        dtype = jnp.asarray(np.asarray(memory)).dtype
+        S, L = self.num_slots, self.max_len
+        inc = [layer.self_attn.gen_cache(None, max_length=L,
+                                         batch_size=S, dtype=dtype)
+               for layer in decoder.layers]
+        static = []
+        for layer in decoder.layers:
+            z = jnp.zeros((S, layer.cross_attn.num_heads, M,
+                           layer.cross_attn.head_dim), dtype)
+            static.append((z, z))
+        self._state = {
+            "tok": jnp.zeros((S,), jnp.int32),
+            "bias": jnp.zeros((S, L), jnp.float32),
+            "mem": jnp.zeros((S, M, Dm), dtype),
+            "inc": inc,
+            "static": static,
+        }
+        self._mem_shape = (M, Dm)
+        self._np_dtype = np.dtype(str(dtype))
+        self._pool_key = (S, L, M, Dm, str(dtype))
+        self._neg = float(NEG)
+
+    # ------------------------------------------------------------------
+    def _join(self, s, r):
+        import jax.numpy as jnp
+
+        self._ensure_state(r.memory)
+        P0 = max(1, int(r.prompt.shape[0]))
+        Pb = bucket_size(P0)
+        pad_id = int(r.eos_id) if r.eos_id is not None else 0
+        prompt_b = np.full((1, Pb), pad_id, np.int32)
+        prompt_b[0, :r.prompt.shape[0]] = r.prompt
+        key = ("join", Pb)
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._build_join(Pb)
+            self._compiled[key] = fn
+        self._state, tok0 = fn(
+            self._fm.params(), self._fm.buffers(), self._state,
+            jnp.int32(s), jnp.asarray(prompt_b),
+            jnp.asarray([P0], jnp.int32),
+            jnp.asarray(np.asarray(r.memory, self._np_dtype)[None]))
+        return int(tok0)
+
+    def _build_join(self, Pb):
+        import jax
+        import jax.numpy as jnp
+
+        from ..nn.layer.transformer import MultiHeadAttention as MHA
+
+        fm = self._fm
+        decoder = self._net.decoder
+        L = self.max_len
+        key = ("join", Pb)
+        neg = self._neg
+
+        def join_fn(params, buffers, state, slot, prompt, length,
+                    memory):
+            self.trace_counts[key] += 1  # python side effect: one per
+            #                              trace = one per compile
+            kpos = jnp.arange(L, dtype=jnp.int32)
+            hole = (kpos[None, :] >= length[:, None]) & \
+                (kpos[None, :] < jnp.int32(Pb))
+            bias_row = jnp.where(hole, jnp.float32(neg),
+                                 jnp.float32(0.0))           # [1, L]
+            positions = jnp.arange(Pb, dtype=jnp.int32)[None]
+            inc0 = [layer.self_attn.gen_cache(
+                None, max_length=Pb, batch_size=1, dtype=memory.dtype)
+                for layer in decoder.layers]
+            (lg, inc1, static1), _ = fm.apply(
+                params, buffers, None, prompt, positions, memory,
+                training=False, tgt_mask=bias_row[:, :Pb],
+                memory_mask=None, inc=inc0, prefill=True)
+            # token 0 conditions on the row's LAST REAL prompt position
+            last = jnp.take_along_axis(
+                lg, (length - 1)[:, None, None], axis=1)[:, 0]
+            tok0 = last.argmax(-1).astype(jnp.int32)[0]
+            new_inc = [MHA.static_kv_splice(pool, slot, c.k, c.v,
+                                            jnp.int32(Pb))
+                       for pool, c in zip(state["inc"], inc1)]
+            new_static = [(MHA.splice_rows(pk, slot, sk),
+                           MHA.splice_rows(pv, slot, sv))
+                          for (pk, pv), (sk, sv) in zip(state["static"],
+                                                        static1)]
+            new_state = {
+                "tok": jax.lax.dynamic_update_slice(
+                    state["tok"], tok0[None], (slot,)),
+                "bias": MHA.splice_rows(state["bias"], slot, bias_row),
+                "mem": MHA.splice_rows(state["mem"], slot, memory),
+                "inc": new_inc,
+                "static": new_static,
+            }
+            return new_state, tok0
+
+        return jax.jit(join_fn)
+
+    # ------------------------------------------------------------------
+    def _decode_step(self, active):
+        import jax.numpy as jnp
+
+        key = ("step",) + self._pool_key
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._build_step(key)
+            self._compiled[key] = fn
+        self._state, toks = fn(self._fm.params(), self._fm.buffers(),
+                               self._state, jnp.asarray(active))
+        return np.asarray(toks)
+
+    def _build_step(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        from ..nn.layer.transformer import MultiHeadAttention as MHA
+
+        fm = self._fm
+
+        def step_fn(params, buffers, state, active):
+            self.trace_counts[key] += 1  # one per trace = one compile
+            inc = state["inc"]
+            posn = inc[0].index[:, None]  # per-SLOT written counts
+            (lg, inc2), _ = fm.apply(
+                params, buffers, None, state["tok"][:, None], posn,
+                state["mem"], training=False, tgt_mask=state["bias"],
+                memory_mask=None, inc=inc, static_kv=state["static"],
+                prefill=False)
+            nxt = lg[:, 0].argmax(-1).astype(jnp.int32)
+            nxt = jnp.where(active, nxt, state["tok"])
+            # inactive slots must not creep their write index: their
+            # (masked, garbage) write this step gets overwritten before
+            # it can ever become visible, but the index itself must
+            # stay put so an idle slot never marches toward max_len
+            inc2 = [MHA.StaticKVCache(
+                c.k, c.v, jnp.where(active, c.index, old.index))
+                for c, old in zip(inc2, inc)]
+            return dict(state, tok=nxt, inc=inc2), nxt
+
+        return jax.jit(step_fn)
+
+
+class ArtifactServingEngine(_EngineBase):
+    """Continuous batching over a stateless causal-LM logits callable
+    (an inference Program artifact: one int feed [B, S] -> one logits
+    fetch [B, S, V], position t reading ids[:, :t+1] only). Program
+    artifacts cannot thread a KV cache, so each iteration re-runs every
+    active slot's prefix — bucketed to a power of two and batched
+    across the S slots in ONE call, so the compile cache stays
+    O(log max_len) programs for the whole pool (`shapes` records the
+    (S, Lb) combos actually run). New arrivals join mid-flight instead
+    of waiting for a full batch drain: this is `Predictor.generate`'s
+    serving mode behind `Config.enable_serving_engine()`."""
+
+    def __init__(self, logits_fn, *, num_slots=8, max_len=None,
+                 dtype=np.int64, max_joins_per_iter=2, metrics=None,
+                 callbacks=(), clock=time.monotonic):
+        super().__init__(num_slots, max_joins_per_iter=max_joins_per_iter,
+                         metrics=metrics, callbacks=callbacks, clock=clock)
+        self._fn = logits_fn
+        self.max_len = None if max_len is None else int(max_len)
+        self._dtype = np.dtype(dtype)
+        self._rows = [None] * self.num_slots   # per-slot id prefix
+        self.shapes = set()                    # (S, Lb) combos run
+
+    def admit_check(self, r):
+        need = int(r.prompt.shape[0]) + r.max_new_tokens
+        if self.max_len is not None and need > self.max_len:
+            raise ValueError(f"request needs {need} positions > "
+                             f"engine max_len {self.max_len}")
+
+    def _join(self, s, r):
+        self._rows[s] = [int(x) for x in r.prompt]
+        return None   # token 0 falls out of the next batched pass
+
+    def _evict(self, s):
+        self._rows[s] = None
+
+    def _decode_step(self, active):
+        S = self.num_slots
+        Lb = bucket_size(max(len(self._rows[s]) for s in range(S)
+                             if active[s]))
+        buf = np.zeros((S, Lb), self._dtype)
+        for s in range(S):
+            if self._rows[s] is not None:
+                buf[s, :len(self._rows[s])] = self._rows[s]
+        shape = (S, Lb)
+        if shape not in self.shapes:
+            self.shapes.add(shape)
+            self.trace_counts[("step",) + shape] += 1
+        logits = np.asarray(self._fn(buf)[0])
+        toks = np.zeros((S,), np.int64)
+        for s in range(S):
+            if active[s]:
+                n = len(self._rows[s])
+                t = int(logits[s, n - 1].argmax(-1))
+                self._rows[s].append(t)
+                toks[s] = t
+        return toks

@@ -1,0 +1,189 @@
+"""Serving observability: counters, latency reservoirs, callbacks.
+
+The serving loop is an always-on system — the numbers that matter are
+the ones operators alarm on: time-to-first-token (admission + prefill),
+per-token decode latency, sustained tokens/s, queue depth (backpressure
+headroom), and slot occupancy (batching efficiency). `ServingMetrics`
+records all of them with O(1) bounded memory (fixed-size reservoirs)
+and serves them through `snapshot()`; `ServingCallback` is the
+hapi-`Callback`-style hook surface the engine drives, so user code can
+tap the same events (per-request logging, tracing, export to external
+metric systems) without touching the engine."""
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["ServingMetrics", "ServingCallback", "CallbackList"]
+
+
+class _Reservoir:
+    """Bounded sample buffer (ring overwrite) with percentile reads —
+    latency distributions over the most recent `cap` observations."""
+
+    def __init__(self, cap=2048):
+        self.cap = int(cap)
+        self._buf = []
+        self._next = 0
+        self.count = 0
+
+    def add(self, x):
+        x = float(x)
+        if len(self._buf) < self.cap:
+            self._buf.append(x)
+        else:
+            self._buf[self._next] = x
+            self._next = (self._next + 1) % self.cap
+        self.count += 1
+
+    def summary(self, scale=1.0, digits=3):
+        import numpy as np
+
+        if not self._buf:
+            return {"n": 0}
+        a = np.asarray(self._buf, dtype=np.float64) * scale
+        return {"n": self.count,
+                "mean": round(float(a.mean()), digits),
+                "p50": round(float(np.percentile(a, 50)), digits),
+                "p99": round(float(np.percentile(a, 99)), digits),
+                "max": round(float(a.max()), digits)}
+
+
+class ServingMetrics:
+    """Thread-safe metric sink for the serving runtime. The engine and
+    the frontend both record into it; `snapshot()` can be called from
+    any thread at any time (monitoring endpoints, tests, the bench)."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.submitted = 0
+        self.completed = 0          # finished with "eos" / "length"
+        self.rejected = 0           # backpressure (QueueFull)
+        self.cancelled = 0
+        self.timeouts = 0           # deadline evictions
+        self.aborted = 0            # non-drain shutdown
+        self.joins = 0
+        self.iterations = 0
+        self.tokens_out = 0         # every delivered token (incl. the
+        #                             prefill-produced first token)
+        self.decode_tokens = 0      # tokens out of batched decode steps
+        self.decode_time_s = 0.0
+        self.ttft_s = _Reservoir()
+        self.token_latency_s = _Reservoir()
+        self.queue_depth = _Reservoir(512)
+        self.occupancy = _Reservoir(512)
+
+    # ---- recording (engine / frontend side) ----
+    def record_submit(self):
+        with self._lock:
+            self.submitted += 1
+
+    def record_reject(self):
+        with self._lock:
+            self.rejected += 1
+
+    def record_join(self):
+        with self._lock:
+            self.joins += 1
+
+    def record_first_token(self, ttft_s):
+        with self._lock:
+            self.ttft_s.add(ttft_s)
+
+    def record_token(self):
+        with self._lock:
+            self.tokens_out += 1
+
+    def record_decode(self, n_tokens, dt_s):
+        """One engine iteration produced `n_tokens` across the active
+        slots in `dt_s` seconds of decode wall time."""
+        with self._lock:
+            self.decode_tokens += n_tokens
+            self.decode_time_s += dt_s
+            if n_tokens:
+                self.token_latency_s.add(dt_s)
+
+    def record_finish(self, reason):
+        with self._lock:
+            if reason in ("eos", "length", "drain"):
+                self.completed += 1
+            elif reason == "cancelled":
+                self.cancelled += 1
+            elif reason == "timeout":
+                self.timeouts += 1
+            else:
+                self.aborted += 1
+
+    def record_iteration(self, queue_depth, occupancy):
+        with self._lock:
+            self.iterations += 1
+            self.queue_depth.add(queue_depth)
+            self.occupancy.add(occupancy)
+
+    # ---- reading ----
+    def snapshot(self):
+        with self._lock:
+            tps = (self.decode_tokens / self.decode_time_s
+                   if self.decode_time_s > 0 else 0.0)
+            return {
+                "requests": {"submitted": self.submitted,
+                             "completed": self.completed,
+                             "rejected": self.rejected,
+                             "cancelled": self.cancelled,
+                             "timeouts": self.timeouts,
+                             "aborted": self.aborted},
+                "joins": self.joins,
+                "iterations": self.iterations,
+                "tokens_out": self.tokens_out,
+                "tokens_per_s": round(tps, 1),
+                "ttft_ms": self.ttft_s.summary(scale=1e3),
+                "per_token_ms": self.token_latency_s.summary(scale=1e3),
+                "queue_depth": self.queue_depth.summary(digits=2),
+                "slot_occupancy": self.occupancy.summary(digits=3),
+            }
+
+
+class ServingCallback:
+    """hapi-style hook surface: subclass, override what you need, pass
+    instances to the engine/server. Every hook is a no-op by default;
+    hooks run on the engine thread, so keep them cheap."""
+
+    def on_submit(self, request):
+        pass
+
+    def on_reject(self, request, reason):
+        pass
+
+    def on_join(self, request, slot):
+        pass
+
+    def on_token(self, request, token):
+        pass
+
+    def on_finish(self, request):
+        pass
+
+    def on_iteration(self, stats):
+        pass
+
+
+class CallbackList:
+    """Fan-out invoker (mirrors hapi.callbacks.CallbackList): exceptions
+    in one hook never take down the serving loop."""
+
+    def __init__(self, callbacks=()):
+        self.callbacks = list(callbacks)
+
+    def append(self, cb):
+        self.callbacks.append(cb)
+
+    def emit(self, name, *args):
+        for cb in self.callbacks:
+            fn = getattr(cb, name, None)
+            if fn is None:
+                continue
+            try:
+                fn(*args)
+            except Exception:
+                pass

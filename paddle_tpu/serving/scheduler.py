@@ -1,0 +1,198 @@
+"""Admission control for the continuous-batching serving runtime.
+
+Requests arrive at arbitrary times; slots free up one request at a
+time. The scheduler sits between them as a bounded FIFO with the
+serving-side policies the engine itself should not know about:
+
+  * **backpressure** — past the high-water mark `submit` raises
+    `QueueFull` instead of queueing unboundedly (the caller sheds load
+    or retries; an unbounded queue just converts overload into
+    timeouts);
+  * **deadline / timeout eviction** — a request carries an absolute
+    `deadline` (engine clock); expired requests are finalized with
+    their partial output instead of occupying a slot;
+  * **cancellation** — `Request.cancel()` marks the request; queued
+    requests are finalized on the next pop, in-flight ones are evicted
+    by the engine's fault harvest at the next iteration boundary;
+  * **graceful drain** — `drain()` closes admission while everything
+    already accepted runs to completion.
+
+The scheduler never touches device state: it hands `Request` objects to
+the engine's `run_iteration` and finalizes the ones that die in the
+queue. All methods are thread-safe; `Request.future` is a
+`concurrent.futures.Future` resolving to a `RequestResult` (partial
+tokens included for timeout/cancel — delivery semantics are "best
+effort by the deadline", not all-or-nothing)."""
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+
+import numpy as np
+from concurrent.futures import Future
+
+__all__ = ["QueueFull", "Request", "RequestResult", "Scheduler"]
+
+#: terminal finish reasons
+FINISH_REASONS = ("eos", "length", "cancelled", "timeout", "drain",
+                  "shutdown", "error")
+
+
+class QueueFull(RuntimeError):
+    """Backpressure: the bounded request queue is at its high-water
+    mark. Shed load or retry later."""
+
+
+class RequestResult(collections.namedtuple(
+        "RequestResult", ["tokens", "finish_reason", "ttft_s",
+                          "latency_s"])):
+    """What a request's future resolves to. `tokens` is the generated
+    int32 array (possibly partial for timeout/cancel), `finish_reason`
+    one of FINISH_REASONS, `ttft_s`/`latency_s` the request's own
+    time-to-first-token and end-to-end latency (None when it never
+    produced a token)."""
+    __slots__ = ()
+
+    @property
+    def ok(self):
+        return self.finish_reason in ("eos", "length", "drain")
+
+
+class Request:
+    """One generation request. Built by the frontend (or directly in
+    tests), consumed by the scheduler + engine. Host-side only."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt, memory=None, *, max_new_tokens=32,
+                 eos_id=1, deadline=None, stream_cb=None):
+        prompt = np.asarray(prompt)
+        if prompt.ndim != 1:
+            raise ValueError(f"prompt must be 1-D [P], got "
+                             f"{prompt.shape}")
+        self.id = next(Request._ids)
+        self.prompt = prompt
+        self.memory = None if memory is None else np.asarray(memory)
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.eos_id = eos_id
+        self.deadline = deadline      # absolute engine-clock seconds
+        self.stream_cb = stream_cb    # called (request, token) per token
+        self.tokens = []              # generated so far (ints)
+        self.state = "QUEUED"         # QUEUED -> RUNNING -> DONE
+        self.finish_reason = None
+        self.future = Future()
+        self.slot = None
+        self.submitted_at = None
+        self.first_token_at = None
+        self.finished_at = None
+        self._cancelled = threading.Event()
+
+    # ---- caller-facing ----
+    def cancel(self):
+        """Request cancellation. Queued requests die on the next
+        scheduler pop; in-flight ones are evicted at the next engine
+        iteration boundary (their partial tokens are delivered)."""
+        self._cancelled.set()
+
+    @property
+    def cancelled(self):
+        return self._cancelled.is_set()
+
+    def result(self, timeout=None):
+        """Block for the RequestResult (partial for timeout/cancel)."""
+        return self.future.result(timeout)
+
+    # ---- engine/scheduler-facing ----
+    def expired(self, now):
+        return self.deadline is not None and now >= self.deadline
+
+    def finish(self, reason, now):
+        if self.state == "DONE":      # idempotent: harvest races drain
+            return
+        self.state = "DONE"
+        self.finish_reason = reason
+        self.finished_at = now
+        ttft = (None if self.first_token_at is None or
+                self.submitted_at is None
+                else self.first_token_at - self.submitted_at)
+        lat = (None if self.submitted_at is None
+               else now - self.submitted_at)
+        self.future.set_result(RequestResult(
+            np.asarray(self.tokens, np.int32), reason, ttft, lat))
+
+
+class Scheduler:
+    """Bounded FIFO with deadline/cancel screening and drain."""
+
+    def __init__(self, max_queue=64, clock=time.monotonic):
+        self.max_queue = int(max_queue)
+        self.clock = clock
+        self._q = collections.deque()
+        self._lock = threading.Lock()
+        self._draining = False
+
+    def submit(self, request):
+        """Enqueue, or raise QueueFull past the high-water mark /
+        RuntimeError after drain started. Sets `submitted_at`."""
+        now = self.clock()
+        with self._lock:
+            if self._draining:
+                raise RuntimeError("scheduler is draining: admission "
+                                   "closed")
+            if len(self._q) >= self.max_queue:
+                raise QueueFull(
+                    f"request queue at high-water mark "
+                    f"({self.max_queue}); shed load or retry")
+            request.submitted_at = now
+            self._q.append(request)
+        return request
+
+    def pop_ready(self, now=None, on_dead=None):
+        """Next admissible request (FIFO), finalizing any queued
+        request that was cancelled or missed its deadline on the way
+        (`on_dead(request)` fires for each — the engine's metrics
+        hook). Returns None when the queue is empty."""
+        if now is None:
+            now = self.clock()
+        while True:
+            with self._lock:
+                if not self._q:
+                    return None
+                r = self._q.popleft()
+            if r.cancelled or r.expired(now):
+                r.finish("cancelled" if r.cancelled else "timeout", now)
+                if on_dead is not None:
+                    on_dead(r)
+                continue
+            return r
+
+    def depth(self):
+        with self._lock:
+            return len(self._q)
+
+    # ---- drain / teardown ----
+    def drain(self):
+        """Close admission; queued + running work keeps flowing."""
+        with self._lock:
+            self._draining = True
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def abort_queued(self, reason, now=None):
+        """Finalize everything still queued (non-drain shutdown)."""
+        if now is None:
+            now = self.clock()
+        out = []
+        while True:
+            with self._lock:
+                if not self._q:
+                    return out
+                r = self._q.popleft()
+            r.finish(reason if not r.cancelled else "cancelled", now)
+            out.append(r)

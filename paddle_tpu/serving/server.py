@@ -1,0 +1,137 @@
+"""Thread-based serving frontend: submit(prompt) -> future, streaming.
+
+The engine is single-threaded by design (all device work happens on one
+thread); the server wraps it in an always-on loop thread and exposes a
+thread-safe `submit` to any number of caller threads. Tokens stream per
+iteration through the request's `stream_cb`; the final result is a
+`concurrent.futures`-style future on the returned `Request`.
+
+Lifecycle: `shutdown(drain=True)` closes admission and lets everything
+already accepted run to completion (graceful drain); `drain=False`
+aborts in-flight work at the next iteration boundary, delivering
+partial tokens with finish_reason "shutdown". Works under
+JAX_PLATFORMS=cpu — nothing here assumes an accelerator."""
+from __future__ import annotations
+
+import threading
+import time
+
+from .scheduler import Request, Scheduler
+
+__all__ = ["ServingServer"]
+
+
+class ServingServer:
+    """Always-on generation frontend over a serving engine.
+
+        server = ServingServer(engine, max_queue=64)
+        req = server.submit(prompt, memory=mem, max_new_tokens=32,
+                            timeout=2.0, stream_cb=on_token)
+        result = req.result()          # RequestResult(tokens, ...)
+        server.shutdown(drain=True)
+
+    `submit` raises `QueueFull` past the queue's high-water mark
+    (backpressure) and ValueError for requests the pool can never
+    serve (admission pre-check)."""
+
+    def __init__(self, engine, *, max_queue=64, clock=None,
+                 idle_wait_s=0.005, start=True):
+        self.engine = engine
+        if clock is None:
+            clock = engine.clock
+        self.clock = clock
+        self.scheduler = Scheduler(max_queue=max_queue, clock=clock)
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._drained = threading.Event()
+        self._idle_wait_s = float(idle_wait_s)
+        self._thread = threading.Thread(
+            target=self._loop, name="paddle-tpu-serving", daemon=True)
+        self._started = False
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------------
+    def start(self):
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def submit(self, prompt, memory=None, *, max_new_tokens=32,
+               eos_id=1, deadline=None, timeout=None, stream_cb=None):
+        """Enqueue one generation request; returns the `Request` whose
+        `.result()` blocks for a RequestResult and whose `.cancel()`
+        withdraws it. `timeout` (seconds from now) is sugar for an
+        absolute `deadline` on the engine clock. Raises QueueFull under
+        backpressure, RuntimeError after shutdown/drain began, and
+        ValueError for unservable requests."""
+        if timeout is not None:
+            deadline = self.clock() + float(timeout)
+        r = Request(prompt, memory, max_new_tokens=max_new_tokens,
+                    eos_id=eos_id, deadline=deadline,
+                    stream_cb=stream_cb)
+        self.engine.admit_check(r)   # fail fast, before queueing
+        try:
+            self.scheduler.submit(r)
+        except Exception as e:
+            self.engine.metrics.record_reject()
+            self.engine._cbs.emit("on_reject", r, type(e).__name__)
+            raise
+        self.engine.metrics.record_submit()
+        self.engine._cbs.emit("on_submit", r)
+        self._wake.set()
+        return r
+
+    def metrics_snapshot(self):
+        return self.engine.metrics.snapshot()
+
+    # ------------------------------------------------------------------
+    def _idle(self):
+        return (self.scheduler.depth() == 0 and
+                self.engine.occupancy() == 0)
+
+    def _loop(self):
+        while True:
+            if self._stop.is_set():
+                break
+            progress = self.engine.run_iteration(self.scheduler)
+            if self.scheduler.draining and self._idle():
+                break   # graceful drain complete
+            if not progress:
+                self._wake.wait(self._idle_wait_s)
+                self._wake.clear()
+        self._drained.set()
+
+    # ------------------------------------------------------------------
+    def shutdown(self, drain=True, timeout=None):
+        """Stop serving. drain=True: close admission, run accepted work
+        to completion, then stop (graceful). drain=False: stop at the
+        next iteration boundary, finalizing queued AND in-flight
+        requests with finish_reason "shutdown" (partial tokens
+        delivered)."""
+        if not self._started:
+            return
+        if drain:
+            self.scheduler.drain()
+        else:
+            self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("serving loop did not stop in time")
+        if not drain:
+            now = self.clock()
+            self.scheduler.drain()
+            for r in self.scheduler.abort_queued("shutdown", now):
+                self.engine.metrics.record_finish(r.finish_reason)
+                self.engine._cbs.emit("on_finish", r)
+            self.engine.abort_active("shutdown", now)
+        self._started = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=exc == (None, None, None))
+        return False

@@ -34,6 +34,7 @@ import inspect
 
 from ..nn.layer.layers import Layer
 from ..nn.layer.transformer import MultiHeadAttention
+from ..core.bucketing import bucket_size, pad_rows as _pad_rows  # noqa: F401
 from ..core.tensor import Tensor
 from ..parallel.functional import functionalize
 from .decode import beam_search, greedy_search
@@ -45,13 +46,6 @@ def _jnp():
     import jax.numpy as jnp
 
     return jnp
-
-
-def bucket_size(n, minimum=1):
-    """Next power of two >= n — the shape-bucket policy shared by the
-    decode engine and Predictor serving (compile cache O(log n))."""
-    n = max(int(n), int(minimum))
-    return 1 << (n - 1).bit_length()
 
 
 def _raw(x):
@@ -109,18 +103,6 @@ class _StepNet(Layer):
         if prefill:
             return logits, new_inc, static_kv
         return logits, new_inc
-
-
-def _pad_rows(x, n):
-    """Pad the leading dim to n by replicating the last row (edge rows
-    are numerically safe and get sliced off the results)."""
-    import jax.numpy as jnp
-
-    b = x.shape[0]
-    if b == n:
-        return x
-    return jnp.concatenate(
-        [x, jnp.broadcast_to(x[-1:], (n - b,) + x.shape[1:])], axis=0)
 
 
 class DecodeEngine:

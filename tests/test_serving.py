@@ -1,0 +1,589 @@
+"""Continuous-batching serving runtime, end to end.
+
+Covers: the acceptance soak (>= 64 requests with ragged arrivals and
+mixed prompt/generation lengths through an 8-slot ServingEngine, every
+completed request bit-matching a solo generate_eager run); the
+compile-count contract (ONE decode-step trace per pool config and one
+join trace per prompt bucket across joins, evictions, and timeouts);
+fault injection — deadline expiry mid-decode, cancellation of queued
+and in-flight requests, queue overflow backpressure, graceful drain and
+abortive shutdown; metrics + callbacks; and the Predictor
+enable_serving_engine() route (engine output == plain bucketed path).
+The threaded Poisson soak and the latency-distribution check are
+marked `slow` so tier-1 stays inside its timeout.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import nn
+from paddle_tpu.nn.layer.transformer import (TransformerDecoder,
+                                             TransformerDecoderLayer)
+from paddle_tpu.serving import (ArtifactServingEngine, QueueFull,
+                                Request, Scheduler, ServingCallback,
+                                ServingEngine, ServingServer)
+from paddle_tpu.text.generation import bucket_size, generate_eager
+
+
+class FakeClock:
+    """Deterministic engine/scheduler clock for fault injection."""
+
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+def _small_stack(seed=7, D=32, H=2, V=17, layers=2):
+    np.random.seed(seed)
+    layer = TransformerDecoderLayer(D, H, 64, dropout=0.0)
+    dec = TransformerDecoder(layer, layers)
+    dec.eval()
+    embed = nn.Embedding(V, D)
+    proj = nn.Linear(D, V)
+    return dec, embed, proj, D, V
+
+
+def _mk_engine(seed=7, num_slots=4, max_len=32, clock=None, **kw):
+    dec, embed, proj, D, V = _small_stack(seed)
+    eng = ServingEngine(dec, embed, proj, num_slots=num_slots,
+                        max_len=max_len,
+                        clock=clock or time.monotonic, **kw)
+    return eng, (dec, embed, proj, D, V)
+
+
+def _mk_request(rs, D, V, pmax=6, nmax=10, **kw):
+    P = int(rs.randint(1, pmax + 1))
+    prompt = rs.randint(2, V, (P,)).astype(np.int32)
+    prompt[0] = 0
+    # memory is a deterministic function of the prompt, so requests
+    # with equal prompts are equal end to end (the soak's eager-oracle
+    # cache keys on the prompt alone)
+    mem_seed = int(prompt.sum()) * 131 + P
+    mem = np.random.RandomState(mem_seed).randn(4, D).astype("f4")
+    n = int(rs.randint(2, nmax + 1))
+    return Request(prompt, mem, max_new_tokens=n, eos_id=1, **kw)
+
+
+def _eager_reference(stack, r, max_new):
+    """Solo greedy run of one request's prompt on the eager
+    concat-cache oracle, same bucketing conventions as the engine."""
+    import jax.numpy as jnp
+
+    dec, embed, proj, D, V = stack
+    toks, lens = generate_eager(
+        dec, embed, proj, jnp.asarray(r.memory[None]),
+        jnp.asarray(r.prompt[None]),
+        jnp.asarray([r.prompt.shape[0]], jnp.int32), bos_id=0,
+        eos_id=1, max_new_tokens=max_new,
+        pad_prompt_to=bucket_size(r.prompt.shape[0]))
+    return np.asarray(toks)[0], int(np.asarray(lens)[0])
+
+
+# ----------------------------------------------------------------------
+# the acceptance soak: ragged arrivals, mixed lengths, bit-match
+# ----------------------------------------------------------------------
+
+def test_soak_64_requests_bitmatch_and_single_trace():
+    """>= 64 requests with ragged arrival times (submitted in waves
+    between iterations) and mixed prompt/generation lengths stream
+    through an 8-slot engine; every completed request's tokens
+    bit-match a solo generate_eager run, and the decode step traced
+    ONCE for the pool despite 64 joins and evictions."""
+    eng, stack = _mk_engine(seed=21, num_slots=8, max_len=32)
+    D, V = stack[3], stack[4]
+    sched = Scheduler(max_queue=128)
+    rs = np.random.RandomState(22)
+    reqs = []
+
+    def submit_wave(k):
+        for _ in range(k):
+            r = _mk_request(rs, D, V)
+            sched.submit(r)
+            reqs.append(r)
+
+    submit_wave(5)
+    it = 0
+    while len(reqs) < 64 or sched.depth() > 0 or eng.occupancy() > 0:
+        eng.run_iteration(sched)
+        it += 1
+        if len(reqs) < 64 and it % 3 == 0:
+            submit_wave(int(rs.randint(1, 7)))   # ragged arrivals
+        assert it < 2000
+    assert len(reqs) >= 64
+
+    eager_cache = {}
+    for r in reqs:
+        res = r.result(timeout=5)
+        assert res.ok, res
+        key = tuple(r.prompt.tolist())
+        if key not in eager_cache:
+            eager_cache[key] = _eager_reference(stack, r, max_new=10)
+        et, el = eager_cache[key]
+        want = et[:len(res.tokens)]
+        np.testing.assert_array_equal(res.tokens, want)
+        if res.finish_reason == "eos":
+            assert res.tokens[-1] == 1
+            assert len(res.tokens) == min(el, r.max_new_tokens)
+
+    # the compile-count contract: one step trace per pool config, one
+    # join trace per prompt bucket — never one per join/evict
+    steps = {k: v for k, v in eng.trace_counts.items()
+             if k[0] == "step"}
+    joins = {k: v for k, v in eng.trace_counts.items()
+             if k[0] == "join"}
+    assert len(steps) == 1 and set(steps.values()) == {1}, steps
+    assert set(joins.values()) == {1}, joins
+    assert set(k[1] for k in joins) <= {1, 2, 4, 8}
+
+    snap = eng.metrics.snapshot()
+    assert snap["requests"]["completed"] == len(reqs)
+    assert snap["tokens_out"] == sum(len(r.result().tokens)
+                                     for r in reqs)
+
+
+# ----------------------------------------------------------------------
+# fault injection: deadlines, cancellation, backpressure, drain
+# ----------------------------------------------------------------------
+
+def test_deadline_expiry_mid_decode():
+    """A request whose deadline passes while it HOLDS a slot is evicted
+    at the next iteration boundary with its partial tokens and
+    finish_reason 'timeout'; the slot frees up for the queue."""
+    clk = FakeClock()
+    eng, stack = _mk_engine(seed=31, num_slots=1, max_len=32, clock=clk)
+    D, V = stack[3], stack[4]
+    sched = Scheduler(max_queue=8, clock=clk)
+    rs = np.random.RandomState(32)
+    doomed = Request(np.asarray([0, 3, 4], np.int32),
+                     rs.randn(4, D).astype("f4"),
+                     max_new_tokens=20, eos_id=None, deadline=10.0)
+    waiting = _mk_request(rs, D, V)
+    sched.submit(doomed)
+    sched.submit(waiting)
+    for _ in range(3):                 # join + a couple of decode steps
+        eng.run_iteration(sched)
+    assert doomed.state == "RUNNING" and len(doomed.tokens) >= 2
+    clk.advance(11.0)                  # deadline passes mid-decode
+    eng.run_iteration(sched)
+    res = doomed.result(timeout=5)
+    assert res.finish_reason == "timeout" and not res.ok
+    assert len(res.tokens) >= 2        # partial delivery
+    # slot freed: the waiting request got admitted the same iteration
+    assert waiting.state == "RUNNING"
+    eng.serve_until_idle(sched, max_iterations=100)
+    assert waiting.result(timeout=5).ok
+    assert eng.metrics.snapshot()["requests"]["timeouts"] == 1
+
+
+def test_deadline_expiry_in_queue():
+    """A QUEUED request that misses its deadline while the pool is busy
+    is finalized with zero tokens — it never wastes a prefill."""
+    clk = FakeClock()
+    eng, stack = _mk_engine(seed=33, num_slots=1, max_len=32, clock=clk)
+    D, V = stack[3], stack[4]
+    sched = Scheduler(max_queue=8, clock=clk)
+    rs = np.random.RandomState(34)
+    hog = Request(np.asarray([0, 2], np.int32),
+                  rs.randn(4, D).astype("f4"), max_new_tokens=20,
+                  eos_id=None)
+    late = Request(np.asarray([0, 5], np.int32),
+                   rs.randn(4, D).astype("f4"), max_new_tokens=5,
+                   eos_id=None, deadline=1.0)
+    sched.submit(hog)
+    sched.submit(late)
+    eng.run_iteration(sched)           # hog takes the only slot
+    clk.advance(2.0)                   # late expires while queued
+    eng.serve_until_idle(sched, max_iterations=100)
+    res = late.result(timeout=5)
+    assert res.finish_reason == "timeout" and len(res.tokens) == 0
+    assert hog.result(timeout=5).ok
+
+
+def test_cancellation_queued_and_inflight():
+    clk = FakeClock()
+    eng, stack = _mk_engine(seed=35, num_slots=1, max_len=32, clock=clk)
+    D, V = stack[3], stack[4]
+    sched = Scheduler(max_queue=8, clock=clk)
+    rs = np.random.RandomState(36)
+    running = Request(np.asarray([0, 3], np.int32),
+                      rs.randn(4, D).astype("f4"), max_new_tokens=25,
+                      eos_id=None)
+    queued = _mk_request(rs, D, V)
+    sched.submit(running)
+    sched.submit(queued)
+    for _ in range(3):
+        eng.run_iteration(sched)
+    assert running.state == "RUNNING" and queued.state == "QUEUED"
+    queued.cancel()                    # dies in the queue, 0 tokens
+    running.cancel()                   # evicted mid-flight, partial
+    eng.serve_until_idle(sched, max_iterations=100)
+    r1 = running.result(timeout=5)
+    r2 = queued.result(timeout=5)
+    assert r1.finish_reason == "cancelled" and len(r1.tokens) >= 2
+    assert r2.finish_reason == "cancelled" and len(r2.tokens) == 0
+    assert eng.metrics.snapshot()["requests"]["cancelled"] == 2
+
+
+def test_queue_overflow_backpressure():
+    """Past the high-water mark submit raises QueueFull and the reject
+    is counted; below it, admission recovers."""
+    eng, stack = _mk_engine(seed=37, num_slots=1, max_len=32)
+    D, V = stack[3], stack[4]
+    rs = np.random.RandomState(38)
+    srv = ServingServer(eng, max_queue=2, start=False)
+    a = srv.submit(np.asarray([0, 2], np.int32),
+                   rs.randn(4, D).astype("f4"), max_new_tokens=3,
+                   eos_id=None)
+    b = srv.submit(np.asarray([0, 3], np.int32),
+                   rs.randn(4, D).astype("f4"), max_new_tokens=3,
+                   eos_id=None)
+    with pytest.raises(QueueFull):
+        srv.submit(np.asarray([0, 4], np.int32),
+                   rs.randn(4, D).astype("f4"), max_new_tokens=3,
+                   eos_id=None)
+    snap = eng.metrics.snapshot()
+    assert snap["requests"]["rejected"] == 1
+    assert snap["requests"]["submitted"] == 2
+    srv.start()
+    assert a.result(timeout=30).ok and b.result(timeout=30).ok
+    c = srv.submit(np.asarray([0, 5], np.int32),
+                   rs.randn(4, D).astype("f4"), max_new_tokens=3,
+                   eos_id=None)                  # recovered
+    assert c.result(timeout=30).ok
+    srv.shutdown(drain=True, timeout=30)
+
+
+def test_unservable_request_fails_fast():
+    """Admission pre-check: a request that can NEVER fit the pool
+    (bucket(P) + max_new > max_len, bad memory shape) raises at
+    submit time instead of poisoning the queue."""
+    eng, stack = _mk_engine(seed=39, num_slots=2, max_len=16)
+    D = stack[3]
+    rs = np.random.RandomState(40)
+    srv = ServingServer(eng, max_queue=8, start=False)
+    with pytest.raises(ValueError, match="max_len"):
+        srv.submit(np.zeros(10, np.int32), rs.randn(4, D).astype("f4"),
+                   max_new_tokens=10, eos_id=None)
+    with pytest.raises(ValueError, match="memory"):
+        srv.submit(np.zeros(2, np.int32), None, max_new_tokens=2)
+    with pytest.raises(ValueError, match="1-D"):
+        Request(np.zeros((2, 2), np.int32))
+
+
+def test_graceful_drain_on_shutdown():
+    """shutdown(drain=True): admission closes, every accepted request
+    runs to completion, the loop exits clean."""
+    eng, stack = _mk_engine(seed=41, num_slots=2, max_len=32)
+    D, V = stack[3], stack[4]
+    rs = np.random.RandomState(42)
+    srv = ServingServer(eng, max_queue=32)
+    reqs = [srv.submit(r.prompt, r.memory,
+                       max_new_tokens=r.max_new_tokens, eos_id=1)
+            for r in (_mk_request(rs, D, V) for _ in range(6))]
+    srv.shutdown(drain=True, timeout=60)
+    for r in reqs:
+        assert r.result(timeout=5).ok
+    with pytest.raises(RuntimeError, match="draining|admission"):
+        srv.scheduler.submit(_mk_request(rs, D, V))
+
+
+def test_abortive_shutdown_delivers_partials():
+    """shutdown(drain=False): in-flight and queued work is finalized
+    with finish_reason 'shutdown'; futures never hang."""
+    eng, stack = _mk_engine(seed=43, num_slots=1, max_len=64)
+    D, V = stack[3], stack[4]
+    rs = np.random.RandomState(44)
+    srv = ServingServer(eng, max_queue=8)
+    long_req = srv.submit(np.asarray([0, 2, 3], np.int32),
+                          rs.randn(4, D).astype("f4"),
+                          max_new_tokens=60, eos_id=None)
+    queued = srv.submit(np.asarray([0, 4], np.int32),
+                        rs.randn(4, D).astype("f4"),
+                        max_new_tokens=60, eos_id=None)
+    while len(long_req.tokens) < 2:    # genuinely mid-flight
+        time.sleep(0.01)
+    srv.shutdown(drain=False, timeout=60)
+    r1 = long_req.result(timeout=5)
+    r2 = queued.result(timeout=5)
+    assert r1.finish_reason == "shutdown" and len(r1.tokens) >= 2
+    assert r2.finish_reason == "shutdown"
+
+
+# ----------------------------------------------------------------------
+# compile-count: joins / evictions / timeouts never retrace
+# ----------------------------------------------------------------------
+
+def test_slot_join_evict_timeout_never_retrace():
+    clk = FakeClock()
+    eng, stack = _mk_engine(seed=45, num_slots=2, max_len=32, clock=clk)
+    D, V = stack[3], stack[4]
+    sched = Scheduler(max_queue=32, clock=clk)
+    rs = np.random.RandomState(46)
+    reqs = []
+    # same prompt bucket, landing on BOTH slots across generations
+    for i in range(6):
+        r = Request(np.asarray([0, 2 + i], np.int32),
+                    rs.randn(4, D).astype("f4"), max_new_tokens=4,
+                    eos_id=None)
+        sched.submit(r)
+        reqs.append(r)
+    # plus a cancelled one, a timed-out one, and a second bucket
+    victim = Request(np.asarray([0, 3], np.int32),
+                     rs.randn(4, D).astype("f4"), max_new_tokens=20,
+                     eos_id=None)
+    late = Request(np.asarray([0, 4], np.int32),
+                   rs.randn(4, D).astype("f4"), max_new_tokens=20,
+                   eos_id=None, deadline=5.0)
+    big = Request(np.asarray([0, 2, 3, 4, 5], np.int32),
+                  rs.randn(4, D).astype("f4"), max_new_tokens=4,
+                  eos_id=None)
+    for r in (victim, late, big):
+        sched.submit(r)
+    for i in range(4):
+        eng.run_iteration(sched)
+    victim.cancel()
+    clk.advance(6.0)                   # expires `late` wherever it is
+    eng.serve_until_idle(sched, max_iterations=200)
+    for r in reqs + [big]:
+        assert r.result(timeout=5).ok
+    steps = {k: v for k, v in eng.trace_counts.items()
+             if k[0] == "step"}
+    joins = {k: v for k, v in eng.trace_counts.items()
+             if k[0] == "join"}
+    assert len(steps) == 1 and set(steps.values()) == {1}, steps
+    # buckets touched: 2 (short prompts) and 8 (the 5-token prompt);
+    # every join reused its bucket's single trace
+    assert joins == {("join", 2): 1, ("join", 8): 1}, joins
+
+
+# ----------------------------------------------------------------------
+# metrics + callbacks
+# ----------------------------------------------------------------------
+
+class _Recorder(ServingCallback):
+    def __init__(self):
+        self.events = []
+
+    def on_submit(self, r):
+        self.events.append(("submit", r.id))
+
+    def on_join(self, r, slot):
+        self.events.append(("join", r.id, slot))
+
+    def on_token(self, r, tok):
+        self.events.append(("token", r.id, tok))
+
+    def on_finish(self, r):
+        self.events.append(("finish", r.id, r.finish_reason))
+
+
+def test_metrics_and_callbacks_and_streaming():
+    rec = _Recorder()
+    eng, stack = _mk_engine(seed=47, num_slots=2, max_len=32,
+                            callbacks=[rec])
+    D, V = stack[3], stack[4]
+    rs = np.random.RandomState(48)
+    streamed = []
+    srv = ServingServer(eng, max_queue=8)
+    r = srv.submit(np.asarray([0, 2, 3], np.int32),
+                   rs.randn(4, D).astype("f4"), max_new_tokens=5,
+                   eos_id=None,
+                   stream_cb=lambda req, t: streamed.append(t))
+    res = r.result(timeout=30)
+    srv.shutdown(drain=True, timeout=30)
+    assert res.ok and len(res.tokens) == 5
+    # streaming delivered exactly the final tokens, in order
+    np.testing.assert_array_equal(streamed, res.tokens)
+    kinds = [e[0] for e in rec.events if e[0] != "iteration"]
+    assert kinds[0] == "submit" and "join" in kinds
+    assert kinds[-1] == "finish"
+    assert kinds.count("token") == 5
+    snap = eng.metrics.snapshot()
+    assert snap["requests"] == {"submitted": 1, "completed": 1,
+                                "rejected": 0, "cancelled": 0,
+                                "timeouts": 0, "aborted": 0}
+    assert snap["tokens_out"] == 5 and snap["joins"] == 1
+    assert snap["ttft_ms"]["n"] == 1
+    assert res.ttft_s is not None and res.latency_s >= res.ttft_s
+
+
+# ----------------------------------------------------------------------
+# Predictor route: enable_serving_engine()
+# ----------------------------------------------------------------------
+
+def _markov_predictor(scope, serving, V=7, seed=0):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.inference import Config, Predictor
+
+    rs = np.random.RandomState(seed)
+    table = (rs.randn(V, V) * 2).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", [-1], dtype="int64")
+        logits = fluid.layers.embedding(
+            ids, [V, V], param_attr=fluid.ParamAttr(name="trans"))
+    exe = fluid.Executor()
+    exe.run(startup)
+    scope.set_value("trans", table)
+    p = object.__new__(Predictor)
+    p.config = Config("unused")
+    if serving:
+        p.config.enable_serving_engine(num_slots=4)
+    p._native = None
+    p._feeds = {}
+    p._outputs = None
+    p._exe = exe
+    p._program = main
+    p._feed_names = ["ids"]
+    p._fetch_vars = [logits]
+    p._fetch_names = [logits.name]
+    return p, table
+
+
+def test_predictor_serving_engine_matches_plain():
+    """The continuous-batching route behind enable_serving_engine()
+    is behaviorally invisible: same tokens, lengths, padding as the
+    direct bucketed path, with a bounded pool compile cache."""
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+
+    scope = Scope()
+    with scope_guard(scope):
+        plain, table = _markov_predictor(scope, serving=False)
+        served, _ = _markov_predictor(scope, serving=True)
+        rs = np.random.RandomState(1)
+        for B, P, N, eos in [(3, 3, 6, None), (5, 4, 7, 2),
+                             (1, 2, 5, 0)]:
+            prompt = rs.randint(0, 7, (B, P)).astype(np.int64)
+            t0, l0 = plain.generate(prompt, max_new_tokens=N,
+                                    eos_id=eos)
+            t1, l1 = served.generate(prompt, max_new_tokens=N,
+                                     eos_id=eos)
+            np.testing.assert_array_equal(t0, t1)
+            np.testing.assert_array_equal(l0, l1)
+        # pool-shaped compile cache: leading dim pinned to num_slots,
+        # pow2 length buckets only
+        assert all(s == 4 and (l & (l - 1)) == 0
+                   for s, l in served._serving_eng.shapes)
+
+
+def test_predictor_serve_shares_engine():
+    """Predictor.serve() exposes the SAME slot engine (and compile
+    cache) the offline generate() route uses."""
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+
+    scope = Scope()
+    with scope_guard(scope):
+        served, table = _markov_predictor(scope, serving=True)
+        prompt = np.asarray([[1, 2, 3]], np.int64)
+        t0, _ = served.generate(prompt, max_new_tokens=4)
+        srv = served.serve()
+        try:
+            assert srv.engine is served._serving_eng
+            r = srv.submit(prompt[0], max_new_tokens=4, eos_id=1)
+            res = r.result(timeout=30)
+        finally:
+            srv.shutdown(drain=True, timeout=30)
+        np.testing.assert_array_equal(res.tokens[:len(res.tokens)],
+                                      t0[0][:len(res.tokens)])
+
+
+def test_artifact_engine_admission_and_occupancy():
+    """ArtifactServingEngine honors max_len admission and interleaves
+    arrivals mid-flight (occupancy goes above one request at a time)."""
+    table = np.eye(5, dtype=np.float32)
+
+    def fn(ids):
+        return [table[ids]]
+
+    eng = ArtifactServingEngine(fn, num_slots=2, max_len=8,
+                                dtype=np.int64)
+    with pytest.raises(ValueError, match="max_len"):
+        eng.admit_check(Request(np.zeros(6, np.int64),
+                                max_new_tokens=6, eos_id=None))
+    sched = Scheduler(max_queue=8)
+    rs = np.random.RandomState(2)
+    reqs = [Request(rs.randint(0, 5, (2,)).astype(np.int64),
+                    max_new_tokens=3, eos_id=None) for _ in range(4)]
+    sched.submit(reqs[0])
+    sched.submit(reqs[1])
+    eng.run_iteration(sched)
+    assert eng.occupancy() == 2        # both admitted, one iteration
+    sched.submit(reqs[2])
+    sched.submit(reqs[3])
+    eng.serve_until_idle(sched, max_iterations=50)
+    for r in reqs:
+        res = r.result(timeout=5)
+        assert res.ok and len(res.tokens) == 3
+        # identity table: argmax chain repeats the last prompt token
+        assert set(res.tokens.tolist()) == {int(r.prompt[-1])}
+
+
+# ----------------------------------------------------------------------
+# slow soaks: threaded Poisson arrivals + latency distribution
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_threaded_poisson_soak_bitmatch():
+    """The full online stack under concurrency: a ServingServer thread,
+    Poisson-ish arrivals from the caller thread, mixed lengths and
+    deadlines — every ok completion still bit-matches the solo eager
+    oracle, and the metrics snapshot stays consistent."""
+    eng, stack = _mk_engine(seed=51, num_slots=8, max_len=32)
+    D, V = stack[3], stack[4]
+    rs = np.random.RandomState(52)
+    srv = ServingServer(eng, max_queue=256)
+    reqs = []
+    for i in range(96):
+        r = _mk_request(rs, D, V)
+        reqs.append(srv.submit(r.prompt, r.memory,
+                               max_new_tokens=r.max_new_tokens,
+                               eos_id=1))
+        if i % 5 == 0:
+            time.sleep(float(rs.exponential(0.002)))
+    srv.shutdown(drain=True, timeout=300)
+    eager_cache = {}
+    n_ok = 0
+    for r in reqs:
+        res = r.result(timeout=5)
+        assert res.ok
+        n_ok += 1
+        key = tuple(r.prompt.tolist())
+        if key not in eager_cache:
+            eager_cache[key] = _eager_reference(stack, r, max_new=10)
+        np.testing.assert_array_equal(
+            res.tokens, eager_cache[key][0][:len(res.tokens)])
+    snap = eng.metrics.snapshot()
+    assert snap["requests"]["completed"] == n_ok == 96
+    assert snap["ttft_ms"]["n"] == 96
+    assert snap["per_token_ms"]["p99"] >= snap["per_token_ms"]["p50"]
+    steps = {k: v for k, v in eng.trace_counts.items()
+             if k[0] == "step"}
+    assert len(steps) == 1 and set(steps.values()) == {1}
+
+
+@pytest.mark.slow
+def test_latency_distribution_under_load():
+    """Occupancy and queue-depth distributions react to overload: with
+    more concurrent work than slots, occupancy saturates and TTFT p99
+    dominates p50."""
+    eng, stack = _mk_engine(seed=53, num_slots=2, max_len=32)
+    D, V = stack[3], stack[4]
+    rs = np.random.RandomState(54)
+    srv = ServingServer(eng, max_queue=64)
+    reqs = [srv.submit(r.prompt, r.memory, max_new_tokens=8,
+                       eos_id=None)
+            for r in (_mk_request(rs, D, V) for _ in range(24))]
+    srv.shutdown(drain=True, timeout=300)
+    for r in reqs:
+        assert r.result(timeout=5).ok
+    snap = eng.metrics.snapshot()
+    assert snap["slot_occupancy"]["max"] == 1.0
+    assert snap["ttft_ms"]["p99"] >= snap["ttft_ms"]["p50"]
+    assert snap["queue_depth"]["max"] >= 1

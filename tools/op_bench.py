@@ -217,6 +217,7 @@ def _configs():
     cfgs += _configs_bwd(cfgs)
     cfgs += _configs_optimizer()
     cfgs += _configs_flash_decode()
+    cfgs += _configs_serving()
     return cfgs
 
 
@@ -967,6 +968,139 @@ def _configs_flash_decode():
         ("flash_decode_b8_L8192_nosplit", direct(8, 8, 8192, 64, 1)),
         ("flash_decode_b32_L512_split", direct(32, 8, 512, 64, 4)),
     ]
+
+
+def _configs_serving():
+    """Serving-runtime kernel rows: the decode-step-with-slot-join
+    shapes the continuous-batching engine runs every iteration.
+    `decode_rowlens` is single-token decode attention with PER-ROW
+    written counts (each serving slot at its own cache offset) vs the
+    lockstep variant; `slot_join` is the prefill splice — a bucketed
+    [1, H, P, D] K/V block lands in the pooled [S, H, L, D] cache at a
+    TRACED slot index; `step_join` is one full engine iteration at the
+    kernel level: splice one joining slot, then decode every slot at
+    its own offset. On the committed-baseline CPU backend the decode
+    rows time the XLA reference (the rows exist so the TPU driver's
+    refresh shows the pallas delta)."""
+
+    def rowlens(batch, heads, L, d, per_row, steps=30):
+        def bench():
+            import jax
+            import jax.numpy as jnp
+
+            from paddle_tpu.ops.attention import decode_attention
+
+            rs = np.random.RandomState(0)
+            q = jnp.asarray(rs.randn(batch, heads, 1, d).astype("f4"))
+            k = jnp.asarray(rs.randn(batch, heads, L, d).astype("f4"))
+            v = jnp.asarray(rs.randn(batch, heads, L, d).astype("f4"))
+            if per_row:
+                length = jnp.asarray(
+                    rs.randint(L // 4, L, (batch,)), jnp.int32)
+            else:
+                length = jnp.int32(L * 3 // 4)
+            fn = jax.jit(decode_attention)
+            return _time_direct(lambda: fn(q, k, v, length), steps)
+
+        bench._direct = True
+        return bench
+
+    def slot_join(S, heads, L, d, P, steps=30):
+        def bench():
+            import jax
+            import jax.numpy as jnp
+
+            from paddle_tpu.nn.layer.transformer import \
+                MultiHeadAttention as MHA
+
+            rs = np.random.RandomState(0)
+            pool = MHA.StaticKVCache(
+                jnp.zeros((S, heads, L, d), jnp.float32),
+                jnp.zeros((S, heads, L, d), jnp.float32),
+                jnp.zeros((S,), jnp.int32))
+            kb = jnp.asarray(rs.randn(1, heads, P, d).astype("f4"))
+            vb = jnp.asarray(rs.randn(1, heads, P, d).astype("f4"))
+            fn = jax.jit(lambda c, s: MHA.static_kv_splice(
+                c, s, kb, vb, jnp.int32(P)))
+            slot = jnp.int32(S // 2)
+            return _time_direct(lambda: fn(pool, slot), steps)
+
+        bench._direct = True
+        return bench
+
+    def step_join(S, heads, L, d, P, steps=30):
+        def bench():
+            import jax
+            import jax.numpy as jnp
+
+            from paddle_tpu.nn.layer.transformer import \
+                MultiHeadAttention as MHA
+            from paddle_tpu.ops.attention import decode_attention
+
+            rs = np.random.RandomState(0)
+            pool = MHA.StaticKVCache(
+                jnp.asarray(rs.randn(S, heads, L, d).astype("f4")),
+                jnp.asarray(rs.randn(S, heads, L, d).astype("f4")),
+                jnp.asarray(rs.randint(P, L - 1, (S,)), jnp.int32))
+            kb = jnp.asarray(rs.randn(1, heads, P, d).astype("f4"))
+            vb = jnp.asarray(rs.randn(1, heads, P, d).astype("f4"))
+            q = jnp.asarray(rs.randn(S, heads, 1, d).astype("f4"))
+
+            def one_iter(c, slot):
+                c = MHA.static_kv_splice(c, slot, kb, vb, jnp.int32(P))
+                return decode_attention(q, c.k, c.v, c.index + 1)
+
+            fn = jax.jit(one_iter)
+            slot = jnp.int32(0)
+            return _time_direct(lambda: fn(pool, slot), steps)
+
+        bench._direct = True
+        return bench
+
+    return [
+        ("serving_decode_rowlens_b8_L2048", rowlens(8, 8, 2048, 64,
+                                                    True)),
+        ("serving_decode_lockstep_b8_L2048", rowlens(8, 8, 2048, 64,
+                                                     False)),
+        ("serving_slot_join_s8_L2048_P128", slot_join(8, 8, 2048, 64,
+                                                      128)),
+        ("serving_slot_join_s8_L512_P64", slot_join(8, 8, 512, 64,
+                                                    64)),
+        ("serving_step_join_s8_L2048", step_join(8, 8, 2048, 64, 128)),
+        ("serving_step_join_s32_L512", step_join(32, 8, 512, 64, 64)),
+    ]
+
+
+def _time_direct(run, steps):
+    """Shared timing scaffold for direct (non-Program) benches:
+    compile, e2e, then median marginal step time over pair slopes."""
+    import jax
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(run())
+    compile_s = time.perf_counter() - t0
+
+    def run_n(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = run()
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    e2e_s = run_n(1)
+    run_n(5)
+    run_n(steps)
+    slopes = []
+    for _ in range(5):
+        t_lo = run_n(5)
+        t_hi = run_n(steps)
+        if t_hi > t_lo:
+            slopes.append((t_hi - t_lo) / (steps - 5))
+    slopes.sort()
+    dt = slopes[len(slopes) // 2] if slopes else e2e_s
+    return {"e2e_us": round(e2e_s * 1e6, 1),
+            "step_us": round(dt * 1e6, 2),
+            "compile_s": round(compile_s, 2)}
 
 
 def bench_one(name, builder, steps=30):
