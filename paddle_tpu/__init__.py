@@ -70,6 +70,7 @@ from . import dataset  # noqa: F401
 
 from .io.serialization import load, save  # noqa: F401
 from .hapi.model import Model, summary  # noqa: F401
+from .hapi import callbacks  # noqa: F401
 from .nn.layer.layers import ParamAttr  # noqa: F401
 from .utils.flags import get_flags, set_flags  # noqa: F401
 from .framework import disable_static, enable_static, in_dynamic_mode  # noqa

@@ -73,13 +73,60 @@ def _fmt(v):
 
 
 class ModelCheckpoint(Callback):
-    def __init__(self, save_freq=1, save_dir=None):
+    """Epoch-end checkpointing through the crash-safe
+    `io.checkpoint.CheckpointManager`: atomic tmp+rename publishes with
+    per-shard checksums (a kill mid-save can never leave a torn
+    checkpoint), `max_to_keep` retention, optional monitor-metric
+    "save best only", and async saves whose errors surface at train
+    end instead of being lost."""
+
+    def __init__(self, save_freq=1, save_dir=None, *, max_to_keep=None,
+                 monitor="loss", mode="min", save_best_only=False,
+                 async_save=False):
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.max_to_keep = max_to_keep
+        self.monitor = monitor
+        self.mode = "min" if mode in ("auto", "min") else "max"
+        self.save_best_only = save_best_only
+        self.async_save = async_save
+        self.best = None
+        self._mgr = None
+
+    def _manager(self):
+        if self._mgr is None:
+            from ..io.checkpoint import CheckpointManager
+
+            self._mgr = CheckpointManager(
+                self.save_dir, max_to_keep=self.max_to_keep,
+                async_save=self.async_save)
+        return self._mgr
+
+    def _is_better(self, v):
+        if self.best is None:
+            return True
+        return v < self.best if self.mode == "min" else v > self.best
 
     def on_epoch_end(self, epoch, logs=None):
-        if self.save_dir and epoch % self.save_freq == 0:
-            self.model.save(f"{self.save_dir}/epoch_{epoch}")
+        if not self.save_dir or epoch % self.save_freq != 0:
+            return
+        if self.save_best_only:
+            v = (logs or {}).get(self.monitor)
+            if v is not None:
+                v = float(np.ravel(v)[0])
+                if not self._is_better(v):
+                    return
+                self.best = v
+        state = {"epoch": int(epoch),
+                 "model": self.model.network.state_dict()}
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is not None:
+            state["opt"] = opt.state_dict()
+        self._manager().save(epoch, state, force=True)
+
+    def on_train_end(self, logs=None):
+        if self._mgr is not None:
+            self._mgr.wait()   # surface async-save errors, don't lose
 
 
 class EarlyStopping(Callback):

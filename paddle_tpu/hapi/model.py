@@ -103,17 +103,35 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
-            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+            callbacks=None, accumulate_grad_batches=1, num_iters=None,
+            resume=None):
+        """Train the prepared model. `resume` names a crash-safe checkpoint directory
+        (io.checkpoint.CheckpointManager): every finished epoch is
+        checkpointed atomically (model + optimizer + numpy RNG state),
+        and a rerun with the same `resume` dir restores the newest
+        VALID checkpoint — torn/corrupt steps from a mid-save kill are
+        skipped — and continues from the next epoch, bit-matching the
+        uninterrupted run."""
         train_loader = _as_loader(train_data, batch_size, shuffle, drop_last,
                                   num_workers)
         eval_loader = _as_loader(eval_data, batch_size, False, False,
                                  num_workers) if eval_data is not None \
             else None
+        ckpt_mgr = None
+        start_epoch = 0
+        if resume:
+            from ..io.checkpoint import CheckpointManager
+
+            ckpt_mgr = CheckpointManager(resume, max_to_keep=3)
+            if ckpt_mgr.latest_step() is not None:
+                snap = ckpt_mgr.restore()
+                self._load_train_state(snap)
+                start_epoch = int(snap["epoch"]) + 1
         cbk_list = cbks.config_callbacks(callbacks, self, epochs, verbose,
                                          log_freq)
         cbk_list.on_train_begin()
         history = []
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             cbk_list.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
@@ -130,11 +148,32 @@ class Model:
                 self.evaluate(eval_loader, verbose=0)
             if save_dir and (epoch + 1) % save_freq == 0:
                 self.save(f"{save_dir}/epoch_{epoch}")
+            if ckpt_mgr is not None:
+                # last op of the epoch, so the snapshot (incl. RNG
+                # state) is exactly what the next epoch starts from
+                ckpt_mgr.save(epoch, self._train_state(epoch),
+                              force=True)
             cbk_list.on_epoch_end(epoch, logs)
             if self.stop_training:
                 break
         cbk_list.on_train_end()
         return history
+
+    def _train_state(self, epoch):
+        """Everything fit(resume=...) needs to continue bit-exactly."""
+        state = {"epoch": int(epoch),
+                 "model": self.network.state_dict(),
+                 "numpy_rng": np.random.get_state()}
+        if self._optimizer is not None:
+            state["opt"] = self._optimizer.state_dict()
+        return state
+
+    def _load_train_state(self, state):
+        self.network.set_state_dict(state["model"])
+        if self._optimizer is not None and "opt" in state:
+            self._optimizer.set_state_dict(state["opt"])
+        if "numpy_rng" in state:
+            np.random.set_state(state["numpy_rng"])
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_iters=None):

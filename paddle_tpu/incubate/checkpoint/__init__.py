@@ -45,8 +45,18 @@ class TrainEpochRange:
         if self._checker.valid():
             meta = self._meta_path()
             if os.path.exists(meta):
-                with open(meta) as f:
-                    state = json.load(f)
+                try:
+                    with open(meta) as f:
+                        state = json.load(f)
+                except (OSError, ValueError):
+                    # torn/corrupt meta (killed mid-write before this
+                    # file became atomic): start fresh rather than die
+                    import warnings
+
+                    warnings.warn(
+                        f"auto-checkpoint meta {meta!r} unreadable; "
+                        f"restarting from epoch 0")
+                    state = {}
                 self._start = state.get("epoch", -1) + 1
                 if self._load_fn and state.get("payload"):
                     self._load_fn(state["payload"])
@@ -83,9 +93,16 @@ class TrainEpochRange:
                 f"{self._checker.job_id}_{self._name}_ps_e{epoch}")
             os.makedirs(ps_dir, exist_ok=True)
             self._ps_comm.checkpoint_notify(ps_dir)
-        with open(self._meta_path(), "w") as f:
+        # atomic meta publish: tmp + os.replace, so a kill mid-write
+        # leaves the previous epoch's meta intact instead of torn JSON
+        meta = self._meta_path()
+        tmp = meta + ".tmp"
+        with open(tmp, "w") as f:
             json.dump({"epoch": epoch, "payload": payload,
                        "ps_dir": ps_dir}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, meta)
         if ps_dir is not None:
             # GC snapshots older than the one the meta now points at
             import glob as _glob

@@ -14,9 +14,12 @@ import numpy as np
 
 from ..core import random as _random
 from ..core.tensor import Tensor
+from ..testing import faults
 from .dataloader_iter import (MultiprocessIter, ThreadPrefetcher,  # noqa: F401
                               WorkerInfo)
 from .serialization import load, save  # noqa: F401
+
+_PT_DL_NEXT = faults.point("dataloader.next")
 
 
 class Dataset:
@@ -300,7 +303,7 @@ class DataLoader:
     def __iter__(self):
         if self.num_workers <= 0:
             for b in self._batches():
-                yield _to_tensors(b)
+                yield _to_tensors(_PT_DL_NEXT(payload=b))
             return
         if self._use_mp:
             it = MultiprocessIter(
@@ -314,7 +317,7 @@ class DataLoader:
                 drop_last=self.drop_last if self._iterable_mode else False)
             try:
                 for b in it:
-                    yield _to_tensors(b)
+                    yield _to_tensors(_PT_DL_NEXT(payload=b))
             finally:
                 it.shutdown()
             return
@@ -322,7 +325,7 @@ class DataLoader:
         for b in ThreadPrefetcher(
                 self._batches(),
                 depth=self.prefetch * max(1, self.num_workers)):
-            yield _to_tensors(b)
+            yield _to_tensors(_PT_DL_NEXT(payload=b))
 
 
 def _to_tensors(batch):

@@ -110,8 +110,25 @@ class Optimizer:
 
     def set_state_dict(self, state):
         self._step_count = state.get("_step_count", 0)
+        # ordered distinct prefixes as saved (dict order = param order)
+        saved_prefixes = []
+        for k in state:
+            if not isinstance(k, str) or "__" not in k or \
+                    k in ("_step_count", "LR_Scheduler"):
+                continue
+            pre = k.rsplit("__", 1)[0]
+            if pre not in saved_prefixes:
+                saved_prefixes.append(pre)
         for i, p in enumerate(self._parameters):
             prefix = f"{p.name or i}__"
+            if not any(isinstance(k, str) and k.startswith(prefix)
+                       for k in state) and i < len(saved_prefixes):
+                # positional fallback: auto-generated param names are a
+                # process-global counter, so a checkpoint restored into
+                # a freshly built model (fit(resume=...) after a crash)
+                # numbers its params differently — slot i still maps to
+                # the i-th saved param
+                prefix = saved_prefixes[i] + "__"
             for k in list(state.keys()):
                 if isinstance(k, str) and k.startswith(prefix):
                     slot = k[len(prefix):]

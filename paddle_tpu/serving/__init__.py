@@ -14,16 +14,22 @@ batching over the static KV cache:
   * `metrics.ServingMetrics` — TTFT / per-token latency / tokens/s /
     queue depth / occupancy, `snapshot()` + hapi-style callbacks.
 
-See the "Serving runtime" section of the README for the slot
-lifecycle, backpressure and deadline semantics, and the metrics table.
+Failure isolation (README "Fault tolerance"): joins/decodes run under
+retry+backoff with an optional watchdog; a failed join kills one
+future (or degrades to `generate_eager`), a failed decode step evicts
+in-flight requests with partials + the cause and the pool keeps
+serving, and a wedged loop marks the server dead (`ServerCrashed`)
+with every future resolved. All of it is deterministically testable
+via the `serving.*` fault points in `paddle_tpu.testing.faults`.
 """
-from .engine import ArtifactServingEngine, ServingEngine
+from .engine import ArtifactServingEngine, ServingEngine, WatchdogTimeout
 from .metrics import CallbackList, ServingCallback, ServingMetrics
 from .scheduler import QueueFull, Request, RequestResult, Scheduler
-from .server import ServingServer
+from .server import ServerCrashed, ServingServer
 
 __all__ = [
     "ServingEngine", "ArtifactServingEngine", "ServingServer",
     "Scheduler", "Request", "RequestResult", "QueueFull",
     "ServingMetrics", "ServingCallback", "CallbackList",
+    "WatchdogTimeout", "ServerCrashed",
 ]

@@ -40,9 +40,22 @@ import time
 import numpy as np
 
 from ..core.bucketing import bucket_size
+from ..testing import faults
 from .metrics import CallbackList, ServingMetrics
 
-__all__ = ["ServingEngine", "ArtifactServingEngine"]
+__all__ = ["ServingEngine", "ArtifactServingEngine", "WatchdogTimeout"]
+
+#: fault points instrumenting the slot lifecycle (armed only in tests /
+#: chaos runs; a disarmed hit is one boolean read)
+_PT_SLOT_JOIN = faults.point("serving.slot_join")
+_PT_PREFILL = faults.point("serving.prefill")
+_PT_DECODE = faults.point("serving.decode_step")
+
+
+class WatchdogTimeout(TimeoutError):
+    """An engine operation completed but blew its `watchdog_s` wall
+    budget — treated as a failure (retried with backoff, then failed
+    cleanly) so one slow/hung compile can't wedge the pool silently."""
 
 
 class _EngineBase:
@@ -61,7 +74,9 @@ class _EngineBase:
     one thread (the `ServingServer` loop or a synchronous drain)."""
 
     def __init__(self, num_slots, *, max_joins_per_iter=2, metrics=None,
-                 callbacks=(), clock=time.monotonic):
+                 callbacks=(), clock=time.monotonic, max_attempts=3,
+                 backoff_base_s=0.01, backoff_cap_s=0.5,
+                 watchdog_s=None, sleep=time.sleep):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         self.num_slots = int(num_slots)
@@ -69,9 +84,19 @@ class _EngineBase:
         self.clock = clock
         self.metrics = metrics if metrics is not None else \
             ServingMetrics(clock=clock)
-        self._cbs = CallbackList(callbacks)
+        self._cbs = CallbackList(
+            callbacks,
+            on_error=lambda hook, e: self.metrics.record_error(
+                f"callback.{hook}", e))
         self.slots = [None] * self.num_slots   # Request | None
         self.trace_counts = collections.Counter()
+        # failure-isolation knobs: every join/decode runs under a
+        # capped-exponential retry loop and an optional wall watchdog
+        self.max_attempts = max(1, int(max_attempts))
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.watchdog_s = watchdog_s
+        self._sleep = sleep
 
     # ---- subclass surface ----
     def admit_check(self, request):
@@ -87,6 +112,71 @@ class _EngineBase:
         """Host-side bookkeeping on slot release (device state needs
         none: the active mask hides the slot and the next join splices
         over it)."""
+
+    def _join_fallback(self, request, exc):
+        """Last-resort degradation after a join failed all attempts.
+        Return True when the request was served another way (its future
+        resolved); False to fail the future with `exc`."""
+        return False
+
+    def _reset_pool(self):
+        """Rebuild device pool state after a decode-step failure (all
+        in-flight requests have been evicted)."""
+
+    # ---- watchdog + retry/backoff ----
+    def _guarded(self, opname, fn):
+        """Run one engine op with up to `max_attempts` tries, capped
+        exponential backoff between them, and a wall-clock watchdog: an
+        op that returns but took > `watchdog_s` is treated as failed
+        (a hung compile/dispatch that eventually unwedges must not be
+        trusted to have left the iteration on schedule). The final
+        failure propagates to the caller, which isolates it."""
+        last = None
+        for attempt in range(self.max_attempts):
+            if attempt:
+                self.metrics.record_retry(opname)
+                self._sleep(min(self.backoff_cap_s,
+                                self.backoff_base_s * (2 ** (attempt - 1))))
+            t0 = time.monotonic()
+            try:
+                out = fn()
+            except Exception as e:
+                last = e
+                continue
+            if self.watchdog_s is not None:
+                dt = time.monotonic() - t0
+                if dt > self.watchdog_s:
+                    last = WatchdogTimeout(
+                        f"{opname} took {dt:.3f}s > watchdog budget "
+                        f"{self.watchdog_s}s")
+                    continue
+            return out
+        raise last
+
+    def _join_attempt(self, s, r):
+        _PT_SLOT_JOIN()
+        return self._join(s, r)
+
+    def _decode_attempt(self, active):
+        _PT_DECODE()
+        return self._decode_step(active)
+
+    def _fail_active(self, exc):
+        """Decode-step failure that survived retries: every in-flight
+        request is poisoned (the batched step is all-or-nothing), so
+        evict them all with their partial tokens + the cause, rebuild
+        the pool state, and keep serving — the pool itself survives."""
+        now = self.clock()
+        for s, r in enumerate(self.slots):
+            if r is None:
+                continue
+            self.slots[s] = None
+            self._evict(s)
+            self.metrics.record_finish("error")
+            self.metrics.record_eviction_on_error()
+            r.finish("error", now, error=exc)
+            self._cbs.emit("on_finish", r)
+        self._reset_pool()
 
     # ---- slot lifecycle ----
     def occupancy(self):
@@ -113,8 +203,10 @@ class _EngineBase:
         if r.stream_cb is not None:
             try:
                 r.stream_cb(r, tok)
-            except Exception:
-                pass
+            except Exception as e:
+                # a broken streaming callback must not stall the pool,
+                # but the failure is recorded, never swallowed
+                self.metrics.record_error("stream_cb", e)
         if r.eos_id is not None and tok == r.eos_id:
             self._finish_slot(r.slot, "eos", now)
         elif len(r.tokens) >= r.max_new_tokens:
@@ -156,15 +248,31 @@ class _EngineBase:
                 self.admit_check(r)
             except Exception as e:
                 # unservable request that bypassed the frontend check
-                r.state = "DONE"
-                r.finish_reason = "error"
-                r.future.set_exception(e)
+                self.metrics.record_error("admit", e)
+                r.fail(e, now)
                 self.metrics.record_finish("error")
+                self._cbs.emit("on_finish", r)
                 continue
             s = free[0]
             r.state, r.slot = "RUNNING", s
             self.slots[s] = r
-            tok = self._join(s, r)
+            try:
+                tok = self._guarded("slot_join",
+                                    lambda: self._join_attempt(s, r))
+            except Exception as e:
+                # per-request isolation: the failed join kills THIS
+                # request's future (or degrades it to the eager path),
+                # frees the slot, and the pool keeps serving
+                self.slots[s] = None
+                self._evict(s)
+                r.slot = None
+                self.metrics.record_error("slot_join", e)
+                if not self._join_fallback(r, e):
+                    r.fail(e, self.clock())
+                    self.metrics.record_finish("error")
+                    self._cbs.emit("on_finish", r)
+                progress = True
+                continue
             joins += 1
             progress = True
             self.metrics.record_join()
@@ -175,15 +283,22 @@ class _EngineBase:
         active = np.asarray([r is not None for r in self.slots], bool)
         if active.any():
             t0 = self.clock()
-            toks = self._decode_step(active)
-            now2 = self.clock()
-            n = 0
-            for s, r in enumerate(list(self.slots)):
-                if r is not None:
-                    self._deliver(r, int(toks[s]), now2)
-                    n += 1
-            self.metrics.record_decode(n, now2 - t0)
-            progress = True
+            try:
+                toks = self._guarded(
+                    "decode_step", lambda: self._decode_attempt(active))
+            except Exception as e:
+                self.metrics.record_error("decode_step", e)
+                self._fail_active(e)
+                progress = True
+            else:
+                now2 = self.clock()
+                n = 0
+                for s, r in enumerate(list(self.slots)):
+                    if r is not None:
+                        self._deliver(r, int(toks[s]), now2)
+                        n += 1
+                self.metrics.record_decode(n, now2 - t0)
+                progress = True
         self.metrics.record_iteration(
             scheduler.depth(), self.occupancy() / self.num_slots)
         self._cbs.emit("on_iteration", {
@@ -230,12 +345,15 @@ class ServingEngine(_EngineBase):
 
     def __init__(self, decoder, embed, project, *, num_slots=8,
                  max_len=128, max_joins_per_iter=2, metrics=None,
-                 callbacks=(), clock=time.monotonic):
+                 callbacks=(), clock=time.monotonic,
+                 eager_fallback=False, **kw):
         super().__init__(num_slots, max_joins_per_iter=max_joins_per_iter,
-                         metrics=metrics, callbacks=callbacks, clock=clock)
+                         metrics=metrics, callbacks=callbacks, clock=clock,
+                         **kw)
         from ..parallel.functional import functionalize
         from ..text.generation import _StepNet
 
+        self.eager_fallback = bool(eager_fallback)
         self.max_len = int(max_len)
         self._net = _StepNet(decoder, embed, project)
         self._fm = functionalize(self._net)
@@ -298,6 +416,7 @@ class ServingEngine(_EngineBase):
     def _join(self, s, r):
         import jax.numpy as jnp
 
+        _PT_PREFILL()
         self._ensure_state(r.memory)
         P0 = max(1, int(r.prompt.shape[0]))
         Pb = bucket_size(P0)
@@ -367,6 +486,65 @@ class ServingEngine(_EngineBase):
             return new_state, tok0
 
         return jax.jit(join_fn)
+
+    def _reset_pool(self):
+        # dropped wholesale: the next join's _ensure_state rebuilds a
+        # zeroed pool (all slots are empty by now); the compiled
+        # join/step programs are pure and stay cached — no retrace
+        self._state = None
+
+    # ---- graceful degradation: solo eager serve ----
+    def _join_fallback(self, r, exc):
+        """`eager_fallback=True`: after a join fails every attempt
+        (persistent compile/dispatch failure), serve the request solo
+        on the eager concat-cache path — slower, but the caller gets
+        its exact tokens instead of an exception."""
+        if not self.eager_fallback:
+            return False
+        try:
+            toks, n = self._run_eager(r)
+        except Exception as e:
+            self.metrics.record_error("eager_fallback", e)
+            return False
+        self.metrics.record_fallback()
+        now = self.clock()
+        for t in toks[:n]:
+            r.tokens.append(int(t))
+            self.metrics.record_token()
+            if r.first_token_at is None:
+                r.first_token_at = now
+                if r.submitted_at is not None:
+                    self.metrics.record_first_token(
+                        now - r.submitted_at)
+            self._cbs.emit("on_token", r, int(t))
+            if r.stream_cb is not None:
+                try:
+                    r.stream_cb(r, int(t))
+                except Exception as e:
+                    self.metrics.record_error("stream_cb", e)
+        reason = ("eos" if r.eos_id is not None and r.tokens and
+                  r.tokens[-1] == r.eos_id else "length")
+        self.metrics.record_finish(reason)
+        r.finish(reason, now)
+        self._cbs.emit("on_finish", r)
+        return True
+
+    def _run_eager(self, r):
+        import jax.numpy as jnp
+
+        from ..text.generation import generate_eager
+
+        net = self._net
+        eos = int(r.eos_id) if r.eos_id is not None else -1
+        toks, lens = generate_eager(
+            net.decoder, net.embed, net.project,
+            jnp.asarray(np.asarray(r.memory)[None]),
+            jnp.asarray(r.prompt[None]),
+            jnp.asarray([r.prompt.shape[0]], jnp.int32),
+            bos_id=0, eos_id=eos, max_new_tokens=r.max_new_tokens,
+            pad_prompt_to=bucket_size(max(1, int(r.prompt.shape[0]))))
+        n = min(int(np.asarray(lens)[0]), r.max_new_tokens)
+        return np.asarray(toks)[0], n
 
     # ------------------------------------------------------------------
     def _decode_step(self, active):
@@ -442,6 +620,7 @@ class ArtifactServingEngine(_EngineBase):
                              f"engine max_len {self.max_len}")
 
     def _join(self, s, r):
+        _PT_PREFILL()
         self._rows[s] = [int(x) for x in r.prompt]
         return None   # token 0 falls out of the next batched pass
 

@@ -73,6 +73,14 @@ class ServingMetrics:
         self.token_latency_s = _Reservoir()
         self.queue_depth = _Reservoir(512)
         self.occupancy = _Reservoir(512)
+        # fault accounting (per-request isolation + retry layer)
+        self.failed = 0             # finished with reason "error"
+        self.errors = 0             # recorded internal errors, any kind
+        self.retries = 0            # retry attempts after a failure
+        self.evictions_on_error = 0  # in-flight requests evicted by a
+        #                              decode-step failure
+        self.fallbacks = 0          # requests degraded to the eager path
+        self.last_error = None      # {"where","type","message","at"}
 
     # ---- recording (engine / frontend side) ----
     def record_submit(self):
@@ -112,8 +120,34 @@ class ServingMetrics:
                 self.cancelled += 1
             elif reason == "timeout":
                 self.timeouts += 1
+            elif reason == "error":
+                self.failed += 1
             else:
                 self.aborted += 1
+
+    # ---- fault accounting ----
+    def record_error(self, where, exc):
+        """An internal failure was observed at `where` (slot_join,
+        decode_step, stream_cb, callback.*, server_crash, ...): bump
+        the counter and keep a last-error snapshot for operators."""
+        with self._lock:
+            self.errors += 1
+            self.last_error = {"where": where,
+                               "type": type(exc).__name__,
+                               "message": str(exc),
+                               "at": self._clock()}
+
+    def record_retry(self, where):
+        with self._lock:
+            self.retries += 1
+
+    def record_eviction_on_error(self, n=1):
+        with self._lock:
+            self.evictions_on_error += n
+
+    def record_fallback(self):
+        with self._lock:
+            self.fallbacks += 1
 
     def record_iteration(self, queue_depth, occupancy):
         with self._lock:
@@ -132,7 +166,14 @@ class ServingMetrics:
                              "rejected": self.rejected,
                              "cancelled": self.cancelled,
                              "timeouts": self.timeouts,
+                             "failed": self.failed,
                              "aborted": self.aborted},
+                "errors": {"count": self.errors,
+                           "retries": self.retries,
+                           "evictions_on_error":
+                               self.evictions_on_error,
+                           "fallbacks": self.fallbacks,
+                           "last": self.last_error},
                 "joins": self.joins,
                 "iterations": self.iterations,
                 "tokens_out": self.tokens_out,
@@ -170,10 +211,13 @@ class ServingCallback:
 
 class CallbackList:
     """Fan-out invoker (mirrors hapi.callbacks.CallbackList): exceptions
-    in one hook never take down the serving loop."""
+    in one hook never take down the serving loop — they are reported to
+    `on_error(hook_name, exc)` (the engine routes it into
+    ServingMetrics.record_error) instead of vanishing."""
 
-    def __init__(self, callbacks=()):
+    def __init__(self, callbacks=(), on_error=None):
         self.callbacks = list(callbacks)
+        self.on_error = on_error
 
     def append(self, cb):
         self.callbacks.append(cb)
@@ -185,5 +229,6 @@ class CallbackList:
                 continue
             try:
                 fn(*args)
-            except Exception:
-                pass
+            except Exception as e:
+                if self.on_error is not None:
+                    self.on_error(name, e)

@@ -31,9 +31,13 @@ import threading
 import time
 
 import numpy as np
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
+
+from ..testing import faults
 
 __all__ = ["QueueFull", "Request", "RequestResult", "Scheduler"]
+
+_PT_ADMIT = faults.point("scheduler.admit")
 
 #: terminal finish reasons
 FINISH_REASONS = ("eos", "length", "cancelled", "timeout", "drain",
@@ -47,13 +51,20 @@ class QueueFull(RuntimeError):
 
 class RequestResult(collections.namedtuple(
         "RequestResult", ["tokens", "finish_reason", "ttft_s",
-                          "latency_s"])):
+                          "latency_s", "error"])):
     """What a request's future resolves to. `tokens` is the generated
     int32 array (possibly partial for timeout/cancel), `finish_reason`
     one of FINISH_REASONS, `ttft_s`/`latency_s` the request's own
     time-to-first-token and end-to-end latency (None when it never
-    produced a token)."""
+    produced a token). `error` carries the cause when the engine
+    evicted the request on an internal failure (finish_reason
+    "error" with partial tokens) — None otherwise."""
     __slots__ = ()
+
+    def __new__(cls, tokens, finish_reason, ttft_s=None, latency_s=None,
+                error=None):
+        return super().__new__(cls, tokens, finish_reason, ttft_s,
+                               latency_s, error)
 
     @property
     def ok(self):
@@ -110,7 +121,7 @@ class Request:
     def expired(self, now):
         return self.deadline is not None and now >= self.deadline
 
-    def finish(self, reason, now):
+    def finish(self, reason, now, error=None):
         if self.state == "DONE":      # idempotent: harvest races drain
             return
         self.state = "DONE"
@@ -121,8 +132,26 @@ class Request:
                 else self.first_token_at - self.submitted_at)
         lat = (None if self.submitted_at is None
                else now - self.submitted_at)
-        self.future.set_result(RequestResult(
-            np.asarray(self.tokens, np.int32), reason, ttft, lat))
+        try:
+            self.future.set_result(RequestResult(
+                np.asarray(self.tokens, np.int32), reason, ttft, lat,
+                error))
+        except InvalidStateError:
+            pass   # already failed by a server-crash declaration
+
+    def fail(self, exc, now):
+        """Fail THIS request's future with the cause (per-request
+        isolation: a broken join/admission kills one future, never the
+        pool). Idempotent against a concurrent finish()."""
+        if self.state == "DONE":
+            return
+        self.state = "DONE"
+        self.finish_reason = "error"
+        self.finished_at = now
+        try:
+            self.future.set_exception(exc)
+        except InvalidStateError:
+            pass
 
 
 class Scheduler:
@@ -139,6 +168,7 @@ class Scheduler:
         """Enqueue, or raise QueueFull past the high-water mark /
         RuntimeError after drain started. Sets `submitted_at`."""
         now = self.clock()
+        _PT_ADMIT()   # fault point: an injected raise = admission lost
         with self._lock:
             if self._draining:
                 raise RuntimeError("scheduler is draining: admission "
@@ -183,6 +213,14 @@ class Scheduler:
     @property
     def draining(self):
         return self._draining
+
+    def pop_all(self):
+        """Drain the queue raw (server-crash path): the requests are
+        returned unfinalized for the caller to fail/finish."""
+        with self._lock:
+            out = list(self._q)
+            self._q.clear()
+        return out
 
     def abort_queued(self, reason, now=None):
         """Finalize everything still queued (non-drain shutdown)."""

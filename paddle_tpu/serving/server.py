@@ -18,7 +18,13 @@ import time
 
 from .scheduler import Request, Scheduler
 
-__all__ = ["ServingServer"]
+__all__ = ["ServingServer", "ServerCrashed"]
+
+
+class ServerCrashed(RuntimeError):
+    """The serving loop died (or refused to stop in time). Every
+    outstanding future has been failed with this as the cause; further
+    `submit()` calls raise it immediately."""
 
 
 class ServingServer:
@@ -44,6 +50,8 @@ class ServingServer:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._drained = threading.Event()
+        self._dead = False
+        self._crash_cause = None
         self._idle_wait_s = float(idle_wait_s)
         self._thread = threading.Thread(
             target=self._loop, name="paddle-tpu-serving", daemon=True)
@@ -66,6 +74,9 @@ class ServingServer:
         absolute `deadline` on the engine clock. Raises QueueFull under
         backpressure, RuntimeError after shutdown/drain began, and
         ValueError for unservable requests."""
+        if self._dead:
+            raise ServerCrashed(
+                f"server is dead ({self._crash_cause!r}); restart it")
         if timeout is not None:
             deadline = self.clock() + float(timeout)
         r = Request(prompt, memory, max_new_tokens=max_new_tokens,
@@ -92,16 +103,44 @@ class ServingServer:
                 self.engine.occupancy() == 0)
 
     def _loop(self):
-        while True:
-            if self._stop.is_set():
-                break
-            progress = self.engine.run_iteration(self.scheduler)
-            if self.scheduler.draining and self._idle():
-                break   # graceful drain complete
-            if not progress:
-                self._wake.wait(self._idle_wait_s)
-                self._wake.clear()
-        self._drained.set()
+        try:
+            while True:
+                if self._stop.is_set():
+                    break
+                progress = self.engine.run_iteration(self.scheduler)
+                if self.scheduler.draining and self._idle():
+                    break   # graceful drain complete
+                if not progress:
+                    self._wake.wait(self._idle_wait_s)
+                    self._wake.clear()
+        except BaseException as e:
+            # the engine isolates per-request failures; anything that
+            # still escapes is a loop-level crash — fail every future
+            # rather than hanging their callers
+            self._declare_dead(e)
+        finally:
+            self._drained.set()
+
+    def _declare_dead(self, cause):
+        """Mark the server dead: close admission, fail every queued and
+        in-flight future with a ServerCrashed cause, make subsequent
+        submit() raise immediately. Engine state is left untouched — a
+        hung loop thread may still own it."""
+        self._dead = True
+        self._crash_cause = cause
+        self._stop.set()
+        self.scheduler.drain()
+        self.engine.metrics.record_error("server_crash", cause)
+        exc = ServerCrashed(f"serving loop crashed: {cause!r}")
+        exc.__cause__ = cause if isinstance(cause, BaseException) \
+            else None
+        now = self.clock()
+        doomed = self.scheduler.pop_all() + \
+            [r for r in self.engine.slots if r is not None]
+        for r in doomed:
+            r.fail(exc, now)   # idempotent vs a racing finish()
+            self.engine.metrics.record_finish("error")
+            self.engine._cbs.emit("on_finish", r)
 
     # ------------------------------------------------------------------
     def shutdown(self, drain=True, timeout=None):
@@ -110,7 +149,7 @@ class ServingServer:
         next iteration boundary, finalizing queued AND in-flight
         requests with finish_reason "shutdown" (partial tokens
         delivered)."""
-        if not self._started:
+        if not self._started or self._dead:
             return
         if drain:
             self.scheduler.drain()
@@ -119,7 +158,15 @@ class ServingServer:
         self._wake.set()
         self._thread.join(timeout)
         if self._thread.is_alive():
-            raise TimeoutError("serving loop did not stop in time")
+            # the loop is wedged: declare the server dead so no future
+            # ever hangs — queued + in-flight futures fail with a
+            # ServerCrashed cause and submit() rejects from now on
+            self._declare_dead(
+                TimeoutError(f"serving loop did not stop within "
+                             f"{timeout}s"))
+            raise TimeoutError(
+                "serving loop did not stop in time; server marked "
+                "dead, outstanding futures failed with ServerCrashed")
         if not drain:
             now = self.clock()
             self.scheduler.drain()

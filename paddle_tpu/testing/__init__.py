@@ -1,0 +1,7 @@
+"""Test-support utilities that ship with the package (importable from
+production code): deterministic fault injection lives in
+`paddle_tpu.testing.faults`. Nothing here pulls in jax — the serving
+runtime, checkpoint IO, and dataloader import it at module load."""
+from . import faults  # noqa: F401
+
+__all__ = ["faults"]

@@ -29,6 +29,15 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long soaks kept out of the tier-1 run")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection soaks (tools/chaos_check.py runs the "
+        "full matrix); long ones are also marked slow")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as paddle
@@ -36,3 +45,12 @@ def _seed():
     np.random.seed(0)
     paddle.seed(0)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """Fault injections must never leak across tests."""
+    yield
+    from paddle_tpu.testing import faults
+
+    faults.reset()

@@ -1,0 +1,230 @@
+"""Crash-safe checkpointing (io.checkpoint.CheckpointManager) and
+auto-resume: atomic tmp+rename publishes, per-shard CRC32 manifests,
+torn-write / corrupt-shard recovery (via the checkpoint.write/read
+fault points), async-save error surfacing, retention, the hardened
+hapi ModelCheckpoint callback, and the acceptance path — a training
+run killed mid-checkpoint resumes via `fit(resume=...)` from the
+latest VALID step and bit-matches the uninterrupted run."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.io.checkpoint import (CheckpointCorrupt, CheckpointError,
+                                      CheckpointManager)
+from paddle_tpu.testing import faults
+
+
+# ----------------------------------------------------------------------
+# the manager itself
+# ----------------------------------------------------------------------
+
+def test_roundtrip_retention_and_tensor_payloads(tmp_path):
+    m = CheckpointManager(tmp_path, max_to_keep=2)
+    for s in range(4):
+        m.save(s, {"w": paddle.to_tensor(np.full((3,), s, "f4")),
+                   "meta": {"epoch": s, "note": "x"}})
+    assert m.all_steps() == [2, 3]           # retention pruned 0, 1
+    st = m.restore()
+    np.testing.assert_array_equal(st["w"].numpy(), np.full((3,), 3, "f4"))
+    assert st["meta"] == {"epoch": 3, "note": "x"}
+    st2 = m.restore(step=2, return_numpy=True)
+    np.testing.assert_array_equal(st2["w"], np.full((3,), 2, "f4"))
+    with pytest.raises(CheckpointError, match="already exists"):
+        m.save(3, {"w": 1})
+    m.save(3, {"w": paddle.to_tensor(np.zeros(1, "f4"))}, force=True)
+
+
+def test_torn_write_leaves_no_checkpoint(tmp_path):
+    """A crash (injected raise) mid-save must leave the directory as if
+    the save never started: no torn step, previous steps intact."""
+    m = CheckpointManager(tmp_path, max_to_keep=None)
+    m.save(0, {"a": np.arange(4)})
+    with faults.inject("checkpoint.write", on="nth", n=1):
+        with pytest.raises(faults.InjectedFault):
+            m.save(1, {"a": np.arange(8)})
+    assert m.all_steps() == [0]
+    assert not [x for x in os.listdir(tmp_path) if x.startswith("_tmp")]
+    np.testing.assert_array_equal(m.restore()["a"], np.arange(4))
+
+
+def test_corrupt_shard_skipped_with_fallback(tmp_path):
+    """Corrupt bytes on the write path: the manifest checksum catches
+    it on restore; restore() falls back to the newest valid step and
+    flags the skip, restore(step=...) raises CheckpointCorrupt."""
+    m = CheckpointManager(tmp_path, max_to_keep=None)
+    m.save(0, {"a": np.arange(3)})
+    m.save(1, {"a": np.arange(3) + 1})
+    with faults.inject("checkpoint.write", action="corrupt"):
+        m.save(2, {"a": np.arange(3) + 2})   # silently torn on disk
+    assert m.all_steps() == [0, 1, 2]
+    assert m.valid_steps() == [0, 1]
+    assert m.latest_step() == 1
+    with pytest.warns(UserWarning, match="fell back"):
+        st = m.restore()
+    np.testing.assert_array_equal(st["a"], np.arange(3) + 1)
+    assert m.last_restore_report["step"] == 1
+    assert [s for s, _ in m.last_restore_report["skipped"]] == [2]
+    with pytest.raises(CheckpointCorrupt, match="checksum"):
+        m.restore(step=2)
+
+
+def test_read_side_corruption_detected(tmp_path):
+    m = CheckpointManager(tmp_path)
+    m.save(0, {"a": np.arange(16)})
+    with faults.inject("checkpoint.read", action="corrupt"):
+        with pytest.raises(CheckpointCorrupt, match="checksum"):
+            m.restore(step=0)
+    np.testing.assert_array_equal(m.restore()["a"], np.arange(16))
+
+
+def test_async_save_error_surfaces_on_wait(tmp_path):
+    """Background-save failures are never lost: they re-raise on
+    wait() (or the next save), and a clean save still works after."""
+    m = CheckpointManager(tmp_path, async_save=True)
+    with faults.inject("checkpoint.write", on="nth", n=1):
+        m.save(0, {"a": 1})                  # returns immediately
+        with pytest.raises(faults.InjectedFault):
+            m.wait()
+    m.save(1, {"a": 2})
+    m.wait()
+    assert m.restore()["a"] == 2 and m.valid_steps() == [1]
+
+
+def test_no_valid_checkpoint_raises(tmp_path):
+    m = CheckpointManager(tmp_path)
+    with pytest.raises(FileNotFoundError):
+        m.restore()
+    with faults.inject("checkpoint.write", action="corrupt"):
+        m.save(0, {"a": 1})
+    with pytest.raises(FileNotFoundError, match="skipped corrupt"):
+        m.restore()
+
+
+# ----------------------------------------------------------------------
+# hapi: ModelCheckpoint callback + fit(resume=...) bit-match
+# ----------------------------------------------------------------------
+
+def _mk_model(seed):
+    np.random.seed(seed)
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    opt = paddle.optimizer.Adam(0.01, parameters=net.parameters())
+    m = paddle.Model(net)
+    m.prepare(optimizer=opt, loss=nn.CrossEntropyLoss())
+    return m
+
+
+def _mk_data(n=16):
+    rs = np.random.RandomState(123)
+    xs = rs.randn(n, 4).astype("f4")
+    ys = rs.randint(0, 2, (n, 1)).astype("i8")
+    from paddle_tpu.io import TensorDataset
+
+    return TensorDataset([xs, ys])
+
+
+def _weights(m):
+    return {k: np.asarray(v.numpy())
+            for k, v in m.network.state_dict().items()}
+
+
+def test_model_checkpoint_callback_atomic_with_retention(tmp_path):
+    m = _mk_model(0)
+    cb = paddle.callbacks.ModelCheckpoint(save_dir=str(tmp_path),
+                                          max_to_keep=2)
+    m.fit(_mk_data(), epochs=4, batch_size=4, shuffle=False, verbose=0,
+          callbacks=[cb])
+    mgr = CheckpointManager(tmp_path)
+    assert mgr.all_steps() == [2, 3]
+    st = mgr.restore()
+    assert st["epoch"] == 3
+    np.testing.assert_array_equal(st["model"]["2.weight"].numpy(),
+                                  _weights(m)["2.weight"])
+    assert "opt" in st
+
+
+def test_model_checkpoint_save_best_only(tmp_path):
+    cb = paddle.callbacks.ModelCheckpoint(
+        save_dir=str(tmp_path), save_best_only=True, monitor="loss")
+    cb.set_model(_mk_model(1))
+    for epoch, loss in enumerate([1.0, 0.5, 0.8, 0.3]):
+        cb.on_epoch_end(epoch, {"loss": [loss]})
+    cb.on_train_end()
+    # only improving epochs were written: 0 (first), 1, 3
+    assert CheckpointManager(tmp_path, max_to_keep=None).all_steps() \
+        == [0, 1, 3]
+    assert cb.best == 0.3
+
+
+def test_fit_resume_bitmatch_after_midcheckpoint_kill(tmp_path):
+    """Acceptance: a run killed mid-checkpoint (injected crash during
+    the epoch-2 save -> that step is torn and auto-discarded) resumes
+    via fit(resume=...) from the latest VALID step (epoch 1) and ends
+    bit-identical to the uninterrupted run — model AND optimizer
+    state, with the dataloader's shuffle RNG restored."""
+    ds = _mk_data()
+    kw = dict(epochs=4, batch_size=4, shuffle=True, verbose=0)
+
+    ref = _mk_model(0)
+    ref.fit(ds, resume=str(tmp_path / "ref"), **kw)
+    want = _weights(ref)
+
+    crashed = _mk_model(0)
+    # each save writes 4 shards (epoch, model, numpy_rng, opt): hit 9
+    # is the first shard of the THIRD save (epoch 2) -> killed mid-
+    # checkpoint, epochs 0 and 1 remain valid
+    with faults.inject("checkpoint.write", on="nth", n=9):
+        with pytest.raises(faults.InjectedFault):
+            crashed.fit(ds, resume=str(tmp_path / "b"), **kw)
+    mgr = CheckpointManager(tmp_path / "b")
+    assert mgr.latest_step() == 1
+
+    # a fresh process: differently-seeded model, everything restored
+    resumed = _mk_model(7)
+    resumed.fit(ds, resume=str(tmp_path / "b"), **kw)
+    got = _weights(resumed)
+    assert want.keys() == got.keys()
+    for k in want:
+        np.testing.assert_array_equal(want[k], got[k], err_msg=k)
+    # and the resumed run's checkpoints continued from epoch 2
+    assert CheckpointManager(tmp_path / "b").latest_step() == 3
+
+
+def test_incubate_auto_checkpoint_survives_torn_meta(tmp_path,
+                                                     monkeypatch):
+    """TrainEpochRange: the meta JSON is published atomically, and a
+    torn/garbage meta from an old-style kill is tolerated (restart
+    from epoch 0 with a warning) instead of crashing the job."""
+    from paddle_tpu.incubate.checkpoint import TrainEpochRange
+
+    monkeypatch.setenv("PADDLE_JOB_ID", "job1")
+    monkeypatch.setenv("PADDLE_CHECKPOINT_DIR", str(tmp_path))
+    tr = TrainEpochRange(3, "t")
+    done = [e for e in tr.get()]
+    assert done == [0, 1, 2]
+    meta = tmp_path / "job1_t.json"
+    assert meta.exists() and not (tmp_path / "job1_t.json.tmp").exists()
+    # resume skips completed epochs
+    assert [e for e in TrainEpochRange(4, "t").get()] == [3]
+    # torn meta: garbage JSON -> fresh start, not a crash
+    meta.write_text("{torn")
+    with pytest.warns(UserWarning, match="unreadable"):
+        tr2 = TrainEpochRange(2, "t")
+    assert [e for e in tr2.get()] == [0, 1]
+
+
+def test_fit_resume_noop_on_fresh_dir(tmp_path):
+    """resume on an empty dir trains from scratch and checkpoints as
+    it goes — same result as no resume at all."""
+    a = _mk_model(0)
+    a.fit(_mk_data(), epochs=2, batch_size=4, shuffle=False, verbose=0)
+    b = _mk_model(0)
+    b.fit(_mk_data(), epochs=2, batch_size=4, shuffle=False, verbose=0,
+          resume=str(tmp_path / "fresh"))
+    wa, wb = _weights(a), _weights(b)
+    for k in wa:
+        np.testing.assert_array_equal(wa[k], wb[k], err_msg=k)
+    assert CheckpointManager(tmp_path / "fresh").all_steps() == [0, 1]

@@ -22,8 +22,10 @@ from paddle_tpu import nn
 from paddle_tpu.nn.layer.transformer import (TransformerDecoder,
                                              TransformerDecoderLayer)
 from paddle_tpu.serving import (ArtifactServingEngine, QueueFull,
-                                Request, Scheduler, ServingCallback,
-                                ServingEngine, ServingServer)
+                                Request, Scheduler, ServerCrashed,
+                                ServingCallback, ServingEngine,
+                                ServingServer, WatchdogTimeout)
+from paddle_tpu.testing import faults
 from paddle_tpu.text.generation import bucket_size, generate_eager
 
 
@@ -408,7 +410,10 @@ def test_metrics_and_callbacks_and_streaming():
     snap = eng.metrics.snapshot()
     assert snap["requests"] == {"submitted": 1, "completed": 1,
                                 "rejected": 0, "cancelled": 0,
-                                "timeouts": 0, "aborted": 0}
+                                "timeouts": 0, "failed": 0,
+                                "aborted": 0}
+    assert snap["errors"]["count"] == 0
+    assert snap["errors"]["last"] is None
     assert snap["tokens_out"] == 5 and snap["joins"] == 1
     assert snap["ttft_ms"]["n"] == 1
     assert res.ttft_s is not None and res.latency_s >= res.ttft_s
@@ -523,6 +528,373 @@ def test_artifact_engine_admission_and_occupancy():
         assert res.ok and len(res.tokens) == 3
         # identity table: argmax chain repeats the last prompt token
         assert set(res.tokens.tolist()) == {int(r.prompt[-1])}
+
+
+# ----------------------------------------------------------------------
+# chaos: deterministic fault injection against the slot lifecycle
+# ----------------------------------------------------------------------
+
+def test_transient_join_failure_is_retried():
+    """A slot join that fails ONCE (injected at serving.prefill) is
+    retried with backoff and succeeds — the caller never notices."""
+    eng, stack = _mk_engine(seed=61, num_slots=2, max_len=32,
+                            max_attempts=3, backoff_base_s=0.0)
+    D, V = stack[3], stack[4]
+    sched = Scheduler(max_queue=8)
+    rs = np.random.RandomState(62)
+    r = _mk_request(rs, D, V)
+    sched.submit(r)
+    with faults.inject("serving.prefill", on="nth", n=1):
+        eng.serve_until_idle(sched, max_iterations=100)
+    res = r.result(timeout=5)
+    assert res.ok
+    np.testing.assert_array_equal(
+        res.tokens, _eager_reference(stack, r, 10)[0][:len(res.tokens)])
+    snap = eng.metrics.snapshot()
+    assert snap["errors"]["retries"] >= 1
+    assert snap["errors"]["count"] == 0      # absorbed, not surfaced
+    assert snap["requests"]["failed"] == 0
+
+
+def test_failed_join_isolates_one_request():
+    """A join that fails EVERY attempt fails only that request's
+    future (with the cause); the slot frees and the pool keeps serving
+    other requests, which still bit-match the eager oracle."""
+    eng, stack = _mk_engine(seed=63, num_slots=2, max_len=32,
+                            max_attempts=2, backoff_base_s=0.0)
+    D, V = stack[3], stack[4]
+    sched = Scheduler(max_queue=8)
+    rs = np.random.RandomState(64)
+    doomed = _mk_request(rs, D, V)
+    sched.submit(doomed)
+    with faults.inject("serving.prefill", on="always"):
+        eng.run_iteration(sched)             # join exhausts attempts
+    with pytest.raises(faults.InjectedFault):
+        doomed.result(timeout=5)
+    assert doomed.state == "DONE" and doomed.finish_reason == "error"
+    assert eng.occupancy() == 0              # slot freed
+    survivors = [_mk_request(rs, D, V) for _ in range(3)]
+    for r in survivors:
+        sched.submit(r)
+    eng.serve_until_idle(sched, max_iterations=200)
+    for r in survivors:
+        res = r.result(timeout=5)
+        assert res.ok
+        np.testing.assert_array_equal(
+            res.tokens,
+            _eager_reference(stack, r, 10)[0][:len(res.tokens)])
+    snap = eng.metrics.snapshot()
+    assert snap["requests"]["failed"] == 1
+    assert snap["errors"]["count"] == 1
+    assert snap["errors"]["last"]["where"] == "slot_join"
+
+
+def test_decode_failure_evicts_with_partials_and_pool_recovers():
+    """A decode step that fails all attempts evicts every in-flight
+    request with its PARTIAL tokens + the cause (finish_reason
+    "error"), rebuilds the pool state, and the pool serves fresh
+    requests afterwards without retracing."""
+    eng, stack = _mk_engine(seed=65, num_slots=2, max_len=32,
+                            max_attempts=2, backoff_base_s=0.0)
+    D, V = stack[3], stack[4]
+    sched = Scheduler(max_queue=8)
+    rs = np.random.RandomState(66)
+    a = Request(np.asarray([0, 3, 4], np.int32),
+                rs.randn(4, D).astype("f4"), max_new_tokens=20,
+                eos_id=None)
+    b = Request(np.asarray([0, 5], np.int32),
+                rs.randn(4, D).astype("f4"), max_new_tokens=20,
+                eos_id=None)
+    sched.submit(a)
+    sched.submit(b)
+    for _ in range(3):                       # both running, tokens out
+        eng.run_iteration(sched)
+    assert len(a.tokens) >= 2 and len(b.tokens) >= 1
+    with faults.inject("serving.decode_step", on="always",
+                       max_fires=2):         # both attempts of one step
+        eng.run_iteration(sched)
+    ra, rb = a.result(timeout=5), b.result(timeout=5)
+    for res in (ra, rb):
+        assert res.finish_reason == "error" and not res.ok
+        assert isinstance(res.error, faults.InjectedFault)
+        assert len(res.tokens) >= 1          # partials delivered
+    snap = eng.metrics.snapshot()
+    assert snap["errors"]["evictions_on_error"] == 2
+    assert snap["requests"]["failed"] == 2
+    # the pool survives: fresh requests complete and bit-match
+    fresh = [_mk_request(rs, D, V) for _ in range(3)]
+    for r in fresh:
+        sched.submit(r)
+    eng.serve_until_idle(sched, max_iterations=200)
+    for r in fresh:
+        res = r.result(timeout=5)
+        assert res.ok
+        np.testing.assert_array_equal(
+            res.tokens,
+            _eager_reference(stack, r, 10)[0][:len(res.tokens)])
+    steps = {k: v for k, v in eng.trace_counts.items()
+             if k[0] == "step"}
+    assert len(steps) == 1 and set(steps.values()) == {1}, steps
+
+
+def test_watchdog_flags_slow_join_then_fails_cleanly():
+    """Injected latency above the watchdog budget: the join is treated
+    as hung, retried, then failed cleanly — never a hung future."""
+    eng, stack = _mk_engine(seed=67, num_slots=1, max_len=32,
+                            max_attempts=2, backoff_base_s=0.0,
+                            watchdog_s=0.01)
+    D, V = stack[3], stack[4]
+    sched = Scheduler(max_queue=8)
+    rs = np.random.RandomState(68)
+    r = _mk_request(rs, D, V)
+    sched.submit(r)
+    with faults.inject("serving.prefill", action="delay", delay_s=0.05):
+        eng.run_iteration(sched)
+    with pytest.raises(WatchdogTimeout):
+        r.result(timeout=5)
+    snap = eng.metrics.snapshot()
+    assert snap["errors"]["retries"] == 1
+    assert snap["errors"]["last"]["type"] == "WatchdogTimeout"
+    # disarmed: the pool serves normally again
+    r2 = _mk_request(rs, D, V)
+    sched.submit(r2)
+    eng.serve_until_idle(sched, max_iterations=100)
+    assert r2.result(timeout=5).ok
+
+
+def test_eager_fallback_on_persistent_join_failure():
+    """eager_fallback=True: a request whose join fails every attempt is
+    degraded to a solo generate_eager run — the caller still gets its
+    exact tokens (bit-matching the oracle) instead of an exception."""
+    eng, stack = _mk_engine(seed=69, num_slots=2, max_len=32,
+                            max_attempts=2, backoff_base_s=0.0,
+                            eager_fallback=True)
+    D, V = stack[3], stack[4]
+    sched = Scheduler(max_queue=8)
+    rs = np.random.RandomState(70)
+    r = _mk_request(rs, D, V)
+    sched.submit(r)
+    with faults.inject("serving.prefill", on="always"):
+        eng.serve_until_idle(sched, max_iterations=50)
+    res = r.result(timeout=5)
+    assert res.ok
+    et, el = _eager_reference(stack, r, r.max_new_tokens)
+    np.testing.assert_array_equal(res.tokens, et[:len(res.tokens)])
+    assert len(res.tokens) == min(el, r.max_new_tokens)
+    snap = eng.metrics.snapshot()
+    assert snap["errors"]["fallbacks"] == 1
+    assert snap["requests"]["completed"] == 1
+
+
+def test_stream_cb_error_recorded_not_swallowed():
+    eng, stack = _mk_engine(seed=71, num_slots=1, max_len=32)
+    D, V = stack[3], stack[4]
+    sched = Scheduler(max_queue=8)
+    rs = np.random.RandomState(72)
+
+    def bad_cb(req, tok):
+        raise RuntimeError("consumer bug")
+
+    r = Request(np.asarray([0, 2], np.int32),
+                rs.randn(4, D).astype("f4"), max_new_tokens=3,
+                eos_id=None, stream_cb=bad_cb)
+    sched.submit(r)
+    eng.serve_until_idle(sched, max_iterations=50)
+    assert r.result(timeout=5).ok            # delivery survived
+    snap = eng.metrics.snapshot()
+    assert snap["errors"]["count"] == 3      # one per token
+    assert snap["errors"]["last"]["where"] == "stream_cb"
+    assert snap["errors"]["last"]["message"] == "consumer bug"
+
+
+def test_admission_fault_rejects_at_submit():
+    eng, stack = _mk_engine(seed=73, num_slots=1, max_len=32)
+    D, V = stack[3], stack[4]
+    srv = ServingServer(eng, max_queue=8, start=False)
+    rs = np.random.RandomState(74)
+    with faults.inject("scheduler.admit", on="nth", n=1):
+        with pytest.raises(faults.InjectedFault):
+            srv.submit(np.asarray([0, 2], np.int32),
+                       rs.randn(4, D).astype("f4"), max_new_tokens=3)
+    assert eng.metrics.snapshot()["requests"]["rejected"] == 1
+    # recovered: next submit is queued
+    r = srv.submit(np.asarray([0, 3], np.int32),
+                   rs.randn(4, D).astype("f4"), max_new_tokens=3,
+                   eos_id=None)
+    srv.start()
+    assert r.result(timeout=30).ok
+    srv.shutdown(drain=True, timeout=30)
+
+
+def test_wedged_loop_marks_server_dead_and_fails_futures():
+    """shutdown(timeout) on a wedged loop: the server is marked dead,
+    every outstanding future fails with a ServerCrashed cause, and
+    subsequent submit() raises immediately — nothing hangs."""
+    eng, stack = _mk_engine(seed=75, num_slots=1, max_len=128)
+    D, V = stack[3], stack[4]
+    rs = np.random.RandomState(76)
+    srv = ServingServer(eng, max_queue=8)
+    r = srv.submit(np.asarray([0, 2, 3], np.int32),
+                   rs.randn(4, D).astype("f4"), max_new_tokens=100,
+                   eos_id=None)
+    while len(r.tokens) < 2:                 # genuinely mid-decode
+        time.sleep(0.01)
+    with faults.inject("serving.decode_step", action="delay",
+                       delay_s=1.5, max_fires=2):
+        time.sleep(0.05)                     # loop enters the stall
+        with pytest.raises(TimeoutError, match="marked dead"):
+            srv.shutdown(drain=False, timeout=0.3)
+    with pytest.raises(ServerCrashed):
+        r.result(timeout=5)
+    with pytest.raises(ServerCrashed):
+        srv.submit(np.asarray([0, 4], np.int32),
+                   rs.randn(4, D).astype("f4"), max_new_tokens=3)
+    snap = eng.metrics.snapshot()
+    assert snap["errors"]["last"]["where"] == "server_crash"
+
+
+def _chaos_soak(n_requests, num_slots, plans, seed):
+    """Shared chaos-soak driver: ragged arrivals with every serving
+    fault point armed; returns (engine, stack, accepted, admit_failed,
+    injections)."""
+    eng, stack = _mk_engine(seed=seed, num_slots=num_slots, max_len=32,
+                            max_attempts=2, backoff_base_s=0.0)
+    D, V = stack[3], stack[4]
+    sched = Scheduler(max_queue=4 * n_requests)
+    rs = np.random.RandomState(seed + 1)
+    injs = [faults.inject(name, **kw) for name, kw in plans]
+    accepted, admit_failed, n_made = [], 0, 0
+
+    def submit_wave(k):
+        nonlocal admit_failed, n_made
+        for _ in range(k):
+            r = _mk_request(rs, D, V)
+            n_made += 1
+            try:
+                sched.submit(r)
+            except faults.InjectedFault:
+                admit_failed += 1        # caller saw the exception
+                continue
+            accepted.append(r)
+
+    try:
+        submit_wave(5)
+        it = 0
+        while n_made < n_requests or sched.depth() > 0 or \
+                eng.occupancy() > 0:
+            eng.run_iteration(sched)
+            it += 1
+            if n_made < n_requests and it % 3 == 0:
+                submit_wave(int(rs.randint(1, 7)))
+            assert it < 5000, "soak did not converge"
+    finally:
+        counts = faults.hit_counts()
+        faults.reset()
+    return eng, stack, accepted, admit_failed, injs, counts
+
+
+def _check_soak(eng, stack, accepted, admit_failed, injs, counts,
+                plans):
+    # 1. every fault point fired at least once, per its armed plan
+    for inj, (name, _) in zip(injs, plans):
+        assert inj.fired >= 1, f"{name} never fired: {inj}"
+    for name in ("scheduler.admit", "serving.slot_join",
+                 "serving.prefill", "serving.decode_step"):
+        assert counts.get(name, 0) >= 1, counts
+    # 2. every accepted future resolved — result or exception, no hangs
+    eager_cache = {}
+    outcome = {"ok": 0, "error_result": 0, "raised": 0}
+    for r in accepted:
+        assert r.future.done(), f"hung future: {r.id}"
+        try:
+            res = r.result(timeout=0)
+        except faults.InjectedFault:
+            outcome["raised"] += 1
+            continue
+        key = tuple(r.prompt.tolist())
+        if key not in eager_cache:
+            eager_cache[key] = _eager_reference(stack, r, max_new=10)
+        # healthy AND evicted-with-partials requests both bit-match a
+        # prefix of the solo eager run — co-residents never perturbed
+        np.testing.assert_array_equal(
+            res.tokens, eager_cache[key][0][:len(res.tokens)])
+        if res.ok:
+            outcome["ok"] += 1
+        else:
+            assert res.finish_reason == "error"
+            assert isinstance(res.error, faults.InjectedFault)
+            outcome["error_result"] += 1
+    assert outcome["ok"] >= 1
+    # 3. metrics account for exactly what the faults did
+    snap = eng.metrics.snapshot()
+    assert snap["requests"]["completed"] == outcome["ok"]
+    assert snap["requests"]["failed"] == \
+        outcome["raised"] + outcome["error_result"]
+    assert snap["requests"]["rejected"] == 0   # direct-sched soak
+    assert snap["errors"]["evictions_on_error"] == \
+        outcome["error_result"]
+    assert snap["errors"]["count"] >= 1
+    assert snap["errors"]["retries"] >= 1
+    assert admit_failed >= 1
+    # 4. the pool still serves: a fresh disarmed wave, bit-exact
+    sched = Scheduler(max_queue=32)
+    rs = np.random.RandomState(4242)
+    D, V = stack[3], stack[4]
+    fresh = [_mk_request(rs, D, V) for _ in range(6)]
+    for r in fresh:
+        sched.submit(r)
+    eng.serve_until_idle(sched, max_iterations=300)
+    for r in fresh:
+        res = r.result(timeout=5)
+        assert res.ok
+        key = tuple(r.prompt.tolist())
+        if key not in eager_cache:
+            eager_cache[key] = _eager_reference(stack, r, max_new=10)
+        np.testing.assert_array_equal(
+            res.tokens, eager_cache[key][0][:len(res.tokens)])
+
+
+_MINI_PLANS = [
+    ("scheduler.admit", dict(on="nth", n=4)),
+    ("serving.slot_join", dict(on="every", k=9)),
+    ("serving.prefill", dict(on="every", k=7)),
+    ("serving.prefill", dict(on="nth", n=15)),
+    ("serving.prefill", dict(on="nth", n=16)),   # consecutive pair ->
+    #                                              one join exhausts
+    ("serving.decode_step", dict(on="every", k=5)),
+    ("serving.decode_step", dict(on="nth", n=12)),
+    ("serving.decode_step", dict(on="nth", n=13)),  # pair -> eviction
+]
+
+
+@pytest.mark.chaos
+def test_chaos_mini_soak_every_point_fires():
+    """Tier-1 chaos: ~20 ragged requests with every serving fault point
+    armed — all futures resolve, survivors bit-match, counters match,
+    pool serves a fresh batch afterwards."""
+    out = _chaos_soak(20, 4, _MINI_PLANS, seed=81)
+    _check_soak(*out, _MINI_PLANS)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_soak_64_requests():
+    """The acceptance soak: >= 64 ragged-arrival requests under the
+    full fault matrix (admission loss, join/prefill raises incl. an
+    exhausting pair, decode raises incl. an eviction pair)."""
+    plans = [
+        ("scheduler.admit", dict(on="nth", n=10)),
+        ("scheduler.admit", dict(on="prob", p=0.02, seed=5)),
+        ("serving.slot_join", dict(on="every", k=13)),
+        ("serving.prefill", dict(on="every", k=11)),
+        ("serving.prefill", dict(on="nth", n=29)),
+        ("serving.prefill", dict(on="nth", n=30)),
+        ("serving.decode_step", dict(on="every", k=17)),
+        ("serving.decode_step", dict(on="nth", n=60)),
+        ("serving.decode_step", dict(on="nth", n=61)),
+    ]
+    out = _chaos_soak(64, 8, plans, seed=91)
+    _check_soak(*out, plans)
 
 
 # ----------------------------------------------------------------------

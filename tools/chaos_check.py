@@ -1,0 +1,266 @@
+#!/usr/bin/env python
+"""Chaos matrix CI gate: every registered fault point x every
+applicable action against a tiny model, bounded by wall timeouts.
+
+For each cell the harness arms ONE injection plan, drives the
+subsystem (serving pool / checkpoint manager / dataloader), and
+requires the fault-tolerance contract to hold:
+
+  * serving.* / scheduler.admit — every submitted future RESOLVES
+    (result or exception, never a hang) and the pool serves a clean
+    batch after disarm;
+  * checkpoint.write/read — a raise leaves no torn step, a corrupt
+    plan is detected + restore falls back, a delay just slows;
+  * dataloader.next — a raise surfaces to the caller deterministically.
+
+Each cell runs on a worker thread with a hard join timeout: a hung
+cell is reported as HANG and the run exits nonzero. Usage:
+
+    JAX_PLATFORMS=cpu python tools/chaos_check.py [--timeout-s 120]
+    python tools/chaos_check.py --list          # print the matrix
+
+The equivalent in-suite coverage is `pytest -m chaos`; this script is
+the standalone gate (no pytest, explicit exit code) for CI cron.
+"""
+import argparse
+import os
+import sys
+import threading
+import time
+import traceback
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _small_engine(seed=7, **kw):
+    from paddle_tpu import nn
+    from paddle_tpu.nn.layer.transformer import (TransformerDecoder,
+                                                 TransformerDecoderLayer)
+    from paddle_tpu.serving import ServingEngine
+
+    np.random.seed(seed)
+    layer = TransformerDecoderLayer(32, 2, 64, dropout=0.0)
+    dec = TransformerDecoder(layer, 2)
+    dec.eval()
+    embed = nn.Embedding(17, 32)
+    proj = nn.Linear(32, 17)
+    kw.setdefault("max_attempts", 2)
+    kw.setdefault("backoff_base_s", 0.0)
+    eng = ServingEngine(dec, embed, proj, num_slots=4, max_len=32, **kw)
+    return eng
+
+
+def _requests(n, seed):
+    from paddle_tpu.serving import Request
+
+    rs = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        P = int(rs.randint(1, 6))
+        prompt = rs.randint(2, 17, (P,)).astype(np.int32)
+        prompt[0] = 0
+        mem = rs.randn(4, 32).astype("f4")
+        out.append(Request(prompt, mem, max_new_tokens=int(
+            rs.randint(2, 8)), eos_id=1))
+    return out
+
+
+def _drive_serving(point, action):
+    """One serving cell: 8 requests with the plan armed, then a clean
+    batch. Raises on any unresolved future."""
+    from paddle_tpu.serving import Scheduler
+    from paddle_tpu.testing import faults
+
+    eng = _small_engine()
+    sched = Scheduler(max_queue=64)
+    plan = (dict(action="delay", delay_s=0.02, on="every", k=3)
+            if action == "delay" else dict(on="every", k=3))
+    inj = faults.inject(point, **plan)
+    accepted = []
+    try:
+        for r in _requests(8, seed=11):
+            try:
+                sched.submit(r)
+            except faults.InjectedFault:
+                continue             # admission loss: caller informed
+            accepted.append(r)
+        it = 0
+        while sched.depth() > 0 or eng.occupancy() > 0:
+            eng.run_iteration(sched)
+            it += 1
+            if it > 2000:
+                raise RuntimeError("no convergence under faults")
+        fired = inj.fired
+    finally:
+        faults.reset()
+    if not fired:
+        raise RuntimeError(f"plan on {point} never fired")
+    for r in accepted:
+        if not r.future.done():
+            raise RuntimeError(f"hung future {r.id} ({point}/{action})")
+    # pool must still serve clean work
+    sched2 = Scheduler(max_queue=16)
+    clean = _requests(3, seed=13)
+    for r in clean:
+        sched2.submit(r)
+    it = 0
+    while sched2.depth() > 0 or eng.occupancy() > 0:
+        eng.run_iteration(sched2)
+        it += 1
+        if it > 500:
+            raise RuntimeError("pool dead after disarm")
+    for r in clean:
+        if not r.result(timeout=0).ok:
+            raise RuntimeError("clean request failed after disarm")
+
+
+def _drive_checkpoint(point, action):
+    import shutil
+    import tempfile
+
+    from paddle_tpu.io.checkpoint import (CheckpointCorrupt,
+                                          CheckpointManager)
+    from paddle_tpu.testing import faults
+
+    d = tempfile.mkdtemp(prefix="chaos_ckpt_")
+    try:
+        m = CheckpointManager(d, max_to_keep=None)
+        m.save(0, {"w": np.arange(8)})
+        plan = (dict(action="delay", delay_s=0.02) if action == "delay"
+                else dict(action=action))
+        with faults.inject(point, on="always", **plan):
+            if point == "checkpoint.write":
+                if action == "raise":
+                    try:
+                        m.save(1, {"w": np.arange(8) + 1})
+                        raise RuntimeError("torn save did not raise")
+                    except faults.InjectedFault:
+                        pass
+                    if m.all_steps() != [0]:
+                        raise RuntimeError("torn step leaked")
+                else:
+                    m.save(1, {"w": np.arange(8) + 1})
+            else:   # checkpoint.read
+                if action == "raise":
+                    try:
+                        m.restore(step=0)
+                        raise RuntimeError("read fault did not raise")
+                    except faults.InjectedFault:
+                        pass
+                elif action == "corrupt":
+                    try:
+                        m.restore(step=0)
+                        raise RuntimeError("corrupt read undetected")
+                    except CheckpointCorrupt:
+                        pass
+                else:
+                    m.restore(step=0)
+        # recovery: restore always lands on a valid step after disarm
+        st = m.restore()
+        expect = 0 if (point, action) != ("checkpoint.write", "delay") \
+            else 1
+        if int(np.asarray(st["w"])[0]) != expect:
+            raise RuntimeError(f"recovered wrong step: {st['w']}")
+        if point == "checkpoint.write" and action == "corrupt":
+            if m.valid_steps() != [0]:
+                raise RuntimeError("corrupt step counted as valid")
+    finally:
+        faults.reset()
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _drive_dataloader(point, action):
+    from paddle_tpu.io import DataLoader, TensorDataset
+    from paddle_tpu.testing import faults
+
+    ds = TensorDataset([np.arange(12, dtype=np.float32).reshape(12, 1)])
+    dl = DataLoader(ds, batch_size=2, shuffle=False)
+    plan = (dict(action="delay", delay_s=0.02, on="every", k=2)
+            if action == "delay" else dict(on="nth", n=2))
+    with faults.inject(point, **plan):
+        try:
+            n = sum(1 for _ in dl)
+            if action == "raise":
+                raise RuntimeError("dataloader fault did not surface")
+            if n != 6:
+                raise RuntimeError(f"lost batches under delay: {n}")
+        except faults.InjectedFault:
+            if action != "raise":
+                raise
+    faults.reset()
+    if sum(1 for _ in dl) != 6:
+        raise RuntimeError("dataloader broken after disarm")
+
+
+MATRIX = (
+    [("scheduler.admit", a, _drive_serving) for a in ("raise", "delay")]
+    + [("serving.slot_join", a, _drive_serving)
+       for a in ("raise", "delay")]
+    + [("serving.prefill", a, _drive_serving)
+       for a in ("raise", "delay")]
+    + [("serving.decode_step", a, _drive_serving)
+       for a in ("raise", "delay")]
+    + [("checkpoint.write", a, _drive_checkpoint)
+       for a in ("raise", "delay", "corrupt")]
+    + [("checkpoint.read", a, _drive_checkpoint)
+       for a in ("raise", "delay", "corrupt")]
+    + [("dataloader.next", a, _drive_dataloader)
+       for a in ("raise", "delay")]
+)
+
+
+def run_cell(point, action, fn, timeout_s):
+    box = {}
+
+    def work():
+        try:
+            fn(point, action)
+            box["ok"] = True
+        except BaseException as e:
+            box["err"] = f"{type(e).__name__}: {e}"
+            box["tb"] = traceback.format_exc()
+
+    t = threading.Thread(target=work, daemon=True)
+    t0 = time.monotonic()
+    t.start()
+    t.join(timeout_s)
+    dt = time.monotonic() - t0
+    if t.is_alive():
+        return "HANG", dt, f"cell still running after {timeout_s}s"
+    if "err" in box:
+        return "FAIL", dt, box["err"]
+    return "ok", dt, ""
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--timeout-s", type=float, default=180.0,
+                    help="hard wall budget per matrix cell")
+    ap.add_argument("--points", default="",
+                    help="comma-separated substring filter on points")
+    ap.add_argument("--list", action="store_true",
+                    help="print the matrix and exit")
+    args = ap.parse_args(argv)
+    cells = [(p, a, f) for p, a, f in MATRIX
+             if not args.points or any(s and s in p for s in
+                                       args.points.split(","))]
+    if args.list:
+        for p, a, _ in cells:
+            print(f"{p} x {a}")
+        return 0
+    failures = 0
+    for p, a, f in cells:
+        status, dt, msg = run_cell(p, a, f, args.timeout_s)
+        print(f"{p:24s} x {a:8s} {status:5s} {dt:7.2f}s  {msg}")
+        if status != "ok":
+            failures += 1
+    print(f"\n{len(cells) - failures}/{len(cells)} cells ok")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
