@@ -11,13 +11,18 @@ power of two, so the cache stays O(log n) programs:
     are numerically safe for row-wise programs and get sliced back off
     the results);
   * `pad_batch_feeds` — the Predictor's feed-dict variant with the LoD
-    / disagreeing-batch escape hatches.
+    / disagreeing-batch escape hatches;
+  * `pad_prompt_row` / `pad_token_rows` — the serving engines' prompt
+    padding (one bucketed [1, Pb] row for a slot join; the artifact
+    engine's [S, Lb] re-run buffer), hoisted here so ServingEngine and
+    ArtifactServingEngine stop re-deriving the bucket layout locally.
 """
 from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["bucket_size", "pad_rows", "pad_batch_feeds"]
+__all__ = ["bucket_size", "pad_rows", "pad_batch_feeds",
+           "pad_prompt_row", "pad_token_rows"]
 
 
 def bucket_size(n, minimum=1):
@@ -39,6 +44,32 @@ def pad_rows(x, n):
         return x
     return jnp.concatenate(
         [x, jnp.broadcast_to(x[-1:], (n - b,) + x.shape[1:])], axis=0)
+
+
+def pad_prompt_row(prompt, pad_id, minimum=1, dtype=np.int32):
+    """One serving slot join's prompt layout: the 1-D token array padded
+    with `pad_id` to its power-of-two bucket as a [1, Pb] row. Returns
+    (row, P0, Pb) where P0 = max(len(prompt), minimum) is the real
+    token count admission/masking reasons about."""
+    prompt = np.asarray(prompt)
+    P0 = max(int(prompt.shape[0]), int(minimum))
+    Pb = bucket_size(P0)
+    row = np.full((1, Pb), pad_id, dtype)
+    row[0, :prompt.shape[0]] = prompt
+    return row, P0, Pb
+
+
+def pad_token_rows(rows, pad_id=0, dtype=np.int64):
+    """The artifact engine's re-run buffer: per-slot token prefixes
+    (lists, or None for an empty slot) right-padded into one
+    [S, bucket(max_len)] array. Returns (buf, Lb)."""
+    lens = [len(r) for r in rows if r is not None]
+    Lb = bucket_size(max(lens) if lens else 1)
+    buf = np.full((len(rows), Lb), pad_id, dtype)
+    for s, r in enumerate(rows):
+        if r is not None:
+            buf[s, :len(r)] = r
+    return buf, Lb
 
 
 def pad_batch_feeds(feeds):

@@ -75,6 +75,12 @@ class MultiHeadAttention(Layer):
         q = self._split_heads(self.q_proj(query))
         k = self._split_heads(self.k_proj(key))
         v = self._split_heads(self.v_proj(value))
+        from ...serving.paging import PagedKVCache
+
+        if isinstance(cache, PagedKVCache):
+            out, cache = self._paged_kv_attention(q, k, v, attn_mask,
+                                                  cache)
+            return self.out_proj(out), cache
         if isinstance(cache, self.StaticKVCache):
             out, cache = self._static_kv_attention(q, k, v, attn_mask,
                                                    cache)
@@ -152,6 +158,89 @@ class MultiHeadAttention(Layer):
             out = A.sdpa(qd, kd, vd, bias4, is_causal=True)
         out = jnp.swapaxes(out, 1, 2).reshape(b, s, h * d)
         return Tensor._wrap(out), new_cache
+
+    def _paged_kv_attention(self, q, k, v, attn_mask, cache):
+        """Decode attention through a paged pool (serving-only, raw
+        jnp): the single token's K/V is quantized and scattered into
+        the physical page the slot's table maps for its write position
+        (rescaling an int8 page whose scale it outranges), then the
+        query attends over the pages — through the scalar-prefetched
+        page table in the pallas kernel on TPU, or a gathered dense
+        logical view on the XLA fallback path (bit-identical to the
+        dense StaticKVCache when pages keep the compute dtype).
+        Contract: decode steps only (S == 1 query token); prompt
+        prefill runs on the regular flash path into a dense batch-1
+        cache whose pages the serving join scatters separately."""
+        import jax.numpy as jnp
+
+        from ...core.tensor import Tensor
+        from ...ops import attention as A
+        from ...serving import paging as PG
+
+        def raw(x):
+            return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+        qd, kd, vd = raw(q), raw(k), raw(v)
+        b, h, s, d = qd.shape
+        if s != 1:
+            raise ValueError(
+                "PagedKVCache attention is decode-only (one query "
+                "token per slot); prefill goes through the join path")
+        idx = raw(cache.index).astype(jnp.int32)
+        table = raw(cache.table).astype(jnp.int32)
+        kp, ks = PG.write_token(cache.k, cache.k_scale, table, idx,
+                                kd[:, :, 0, :])
+        vp, vs = PG.write_token(cache.v, cache.v_scale, table, idx,
+                                vd[:, :, 0, :])
+        new_cache = PG.PagedKVCache(kp, vp, ks, vs, table,
+                                    (idx + 1).astype(jnp.int32))
+        mask = None if attn_mask is None else raw(attn_mask)
+        if mask is not None and mask.ndim > 2:
+            mask = mask.reshape(mask.shape[0], mask.shape[-1])
+        out = A.paged_decode_attention(qd, kp, vp, ks, vs, table,
+                                       idx + 1, bias=mask)
+        out = jnp.swapaxes(out, 1, 2).reshape(b, s, h * d)
+        return Tensor._wrap(out), new_cache
+
+    def gen_paged_cache(self, num_pages, page_size, num_slots,
+                        max_pages, dtype, kv_dtype=None):
+        """Per-layer paged pool: zeroed [num_pages + 1, H, page_size,
+        D] K/V page arrays (the +1 row is the trash page inactive
+        slots' masked writes land on), per-page scales when kv_dtype
+        is int8, an unmapped (trash-clipped) table and zero write
+        indices. The serving engine owns the host-side PageAllocator /
+        page table; this just shapes the device state."""
+        import jax.numpy as jnp
+
+        from ...serving import paging as PG
+
+        storage, quantized = PG.resolve_kv_dtype(kv_dtype, dtype)
+        buf = jnp.zeros((int(num_pages) + 1, self.num_heads,
+                         int(page_size), self.head_dim), storage)
+        sc = jnp.zeros((int(num_pages) + 1, self.num_heads, 1, 1),
+                       jnp.float32) if quantized else None
+        return PG.PagedKVCache(
+            buf, buf, sc, sc,
+            jnp.full((int(num_slots), int(max_pages)), int(num_pages),
+                     jnp.int32),
+            jnp.zeros((int(num_slots),), jnp.int32))
+
+    @staticmethod
+    def paged_prompt_splice(cache, page_ids, k_new, v_new):
+        """Slot JOIN for paged pools: scatter a prefilled [1, H, P, D]
+        K/V block into the physical pages `page_ids` (traced int32
+        [ceil(P / page_size)]), quantizing per page on the way in.
+        Like `static_kv_splice`, every operand that varies per join is
+        traced, so joining any slot at any admitted prompt length
+        reuses one compiled program per prompt bucket."""
+        from ...serving import paging as PG
+
+        quantized = cache.k_scale is not None
+        kp, ks = PG.write_prompt_pages(cache.k, cache.k_scale, page_ids,
+                                       k_new, quantized)
+        vp, vs = PG.write_prompt_pages(cache.v, cache.v_scale, page_ids,
+                                       v_new, quantized)
+        return cache._replace(k=kp, v=vp, k_scale=ks, v_scale=vs)
 
     @staticmethod
     def static_kv_splice(cache, slot, k_new, v_new, n_written):

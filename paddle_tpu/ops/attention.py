@@ -1389,6 +1389,189 @@ def decode_attention(q, k, v, length, bias=None, scale=None, split_k=None,
     return decode_attention_reference(q, k, v, length, bias, scale)
 
 
+# --------------------------------------------------------------------------
+# paged decode attention: one query token against a paged KV cache
+# --------------------------------------------------------------------------
+
+def paged_gather_kv(pages, scales, table, compute_dtype):
+    """Dense [S, H, L, D] logical view of a paged cache ([N+1, H, psz,
+    D] pages indexed by a [S, max_pages] int32 table), dequantized via
+    the per-(page, head) scales when present. The XLA fallback read for
+    `paged_decode_attention`; garbage gathered through trash-clipped
+    table entries is hidden by the written-length mask downstream."""
+    import jax.numpy as jnp
+
+    S, mp = table.shape
+    _, h, psz, d = pages.shape
+    g = pages[table]                                # [S, mp, h, psz, d]
+    if scales is not None:
+        g = g.astype(jnp.float32) * scales[table]
+    return jnp.transpose(g, (0, 2, 1, 3, 4)).reshape(
+        S, h, mp * psz, d).astype(compute_dtype)
+
+
+def _paged_flash_decode_call(S, h, mp, psz, d, s, has_scale, has_bias,
+                             interpret):
+    """One grid step per (slot*head, logical page): the page table rides
+    scalar prefetch, so each K/V BlockSpec's index map dereferences
+    table[slot, page] to pick the physical page row to DMA — the same
+    static-shape int32 indirection trick as the split-K decode kernel's
+    length prefetch, one compile per pool config. Per-page partial
+    (acc, m, l) merge in XLA with the standard logsumexp combine."""
+    import jax
+    import jax.numpy as jnp
+
+    pl = _import_pallas()
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(tbl_ref, len_ref, *refs):
+        refs = list(refs)
+        q_ref, k_ref, v_ref = refs[:3]
+        refs = refs[3:]
+        if has_scale:
+            ks_ref, vs_ref = refs[:2]
+            refs = refs[2:]
+        if has_bias:
+            bias_ref = refs[0]
+            refs = refs[1:]
+        o_ref, m_ref, l_ref = refs
+        bh = pl.program_id(0)
+        pi = pl.program_id(1)
+        start = pi * jnp.int32(psz)
+        n_valid = len_ref[bh // jnp.int32(h)]
+
+        @pl.when(start < n_valid)
+        def _compute():
+            sf = jnp.float32(s)
+            qb = q_ref[...].astype(jnp.float32) * sf      # (1, d)
+            kb = k_ref[...].astype(jnp.float32)           # (psz, d)
+            vb = v_ref[...].astype(jnp.float32)
+            if has_scale:
+                kb = kb * ks_ref[0, 0]                    # dequantize
+                vb = vb * vs_ref[0, 0]                    # in-kernel
+            logits = jnp.dot(qb, kb.T,
+                             preferred_element_type=jnp.float32)
+            kpos = start + jax.lax.broadcasted_iota(
+                jnp.int32, (1, psz), 1)
+            logits = jnp.where(kpos < n_valid, logits,
+                               jnp.float32(-1e30))
+            if has_bias:
+                logits = logits + bias_ref[...][:, 0][None, :]
+            m = logits.max(axis=-1, keepdims=True)
+            p = jnp.exp(logits - m)
+            l = p.sum(axis=-1, keepdims=True)
+            o_ref[...] = jnp.dot(p, vb,
+                                 preferred_element_type=jnp.float32)
+            m_ref[...] = m
+            l_ref[...] = l
+
+        @pl.when(start >= n_valid)
+        def _skip():
+            # page entirely past the written region: exact-zero partial
+            o_ref[...] = jnp.zeros((1, d), jnp.float32)
+            m_ref[...] = jnp.full((1, 1), -1e30, jnp.float32)
+            l_ref[...] = jnp.zeros((1, 1), jnp.float32)
+
+    def page_ix(bh, pi, tbl, lens):
+        # physical page row out of the prefetched table; head from the
+        # flattened (slot, head) grid axis
+        return (tbl[bh // jnp.int32(h), pi], bh % jnp.int32(h),
+                _z(), _z())
+
+    in_specs = [
+        pl.BlockSpec((None, 1, d), lambda bh, pi, *_: (bh, _z(), _z())),
+        pl.BlockSpec((None, None, psz, d), page_ix),
+        pl.BlockSpec((None, None, psz, d), page_ix),
+    ]
+    if has_scale:
+        in_specs.append(pl.BlockSpec((None, None, 1, 1), page_ix))
+        in_specs.append(pl.BlockSpec((None, None, 1, 1), page_ix))
+    if has_bias:
+        # bias lives in LOGICAL per-slot coordinates [S, L, 1]: block
+        # by (slot, logical page), no table dereference
+        in_specs.append(pl.BlockSpec(
+            (None, psz, 1),
+            lambda bh, pi, *_: (bh // jnp.int32(h), pi, _z())))
+    out_specs = [
+        pl.BlockSpec((None, None, 1, d),
+                     lambda bh, pi, *_: (bh, pi, _z(), _z())),
+        pl.BlockSpec((None, None, 1, 1),
+                     lambda bh, pi, *_: (bh, pi, _z(), _z())),
+        pl.BlockSpec((None, None, 1, 1),
+                     lambda bh, pi, *_: (bh, pi, _z(), _z())),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((S * h, mp, 1, d), jnp.float32),
+        jax.ShapeDtypeStruct((S * h, mp, 1, 1), jnp.float32),
+        jax.ShapeDtypeStruct((S * h, mp, 1, 1), jnp.float32),
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2, grid=(S * h, mp),
+        in_specs=in_specs, out_specs=out_specs)
+    return pl.pallas_call(kernel, grid_spec=grid_spec,
+                          out_shape=out_shape, interpret=interpret)
+
+
+def paged_flash_decode(q, k_pages, v_pages, k_scale, v_scale, table,
+                       length, bias=None, scale=None, interpret=False):
+    """Pallas paged decode: one query token per slot against K/V
+    gathered THROUGH the page table — no dense materialization. q
+    [S, h, 1, d]; pages [N+1, h, psz, d] (+1 = trash row); table
+    [S, max_pages] int32 (trash-clipped); length [S] written counts;
+    k_scale/v_scale optional [N+1, h, 1, 1] per-page dequant scales;
+    bias optional [S, L] additive key bias in logical coordinates."""
+    import jax.numpy as jnp
+
+    S, h, sq, d = q.shape
+    if sq != 1:
+        raise ValueError("paged_flash_decode takes a single query "
+                         "token per slot")
+    mp = table.shape[1]
+    psz = k_pages.shape[2]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    call = _paged_flash_decode_call(S, h, mp, psz, d, s,
+                                    k_scale is not None,
+                                    bias is not None, interpret)
+    args = [q.reshape(S * h, 1, d), k_pages, v_pages]
+    if k_scale is not None:
+        args += [k_scale, v_scale]
+    if bias is not None:
+        args.append(jnp.asarray(bias, jnp.float32)[:, :, None])
+    acc, m, l = call(jnp.asarray(table, jnp.int32),
+                     jnp.asarray(length, jnp.int32), *args)
+    m_star = m.max(axis=1, keepdims=True)
+    alpha = jnp.exp(m - m_star)
+    num = (acc * alpha).sum(axis=1)                # [S*h, 1, d]
+    den = jnp.maximum((l * alpha).sum(axis=1), 1e-30)
+    return (num / den).astype(q.dtype).reshape(S, h, 1, d)
+
+
+def paged_decode_attention(q, k_pages, v_pages, k_scale, v_scale, table,
+                           length, bias=None, scale=None,
+                           interpret=False):
+    """Paged decode-attention dispatch: the page-table pallas kernel on
+    TPU (or under interpret=True for CPU parity tests); elsewhere
+    gather the pages into the dense logical view and run the exact XLA
+    reference — with same-dtype pages the gathered buffer reproduces
+    the dense StaticKVCache bit-for-bit, which is what makes paged
+    serving bit-identical to the dense pool on the fallback path."""
+    psz = k_pages.shape[2]
+    use_kernel = interpret or (
+        _on_tpu() and q.shape[-1] <= 256 and psz % 8 == 0
+        and _flash_usable())
+    if use_kernel:
+        try:
+            return paged_flash_decode(q, k_pages, v_pages, k_scale,
+                                      v_scale, table, length, bias,
+                                      scale, interpret)
+        except Exception:
+            if interpret:
+                raise
+    kd = paged_gather_kv(k_pages, k_scale, table, q.dtype)
+    vd = paged_gather_kv(v_pages, v_scale, table, q.dtype)
+    return decode_attention_reference(q, kd, vd, length, bias, scale)
+
+
 def sdpa(q, k, v, mask=None, is_causal=False, scale=None,
          dropout_p=0.0, dropout_key=None, segment_ids=None):
     """Dispatch: pallas flash fwd+bwd on TPU whenever the mask reduces to

@@ -7,6 +7,13 @@ batching over the static KV cache:
     decode step of static shape [S, ...] with a per-slot active mask;
     slot join = batch-1 bucketed prefill spliced into the live pool
     (never retraces);
+  * `paging` / `engine.PagedServingEngine` (`ServingEngine(...,
+    paged=True)`) — the slot pool over a global pool of fixed-size KV
+    pages: free-list + refcount `PageAllocator`, per-slot int32 page
+    table (traced input — page mapping never retraces), whole-prompt
+    `PrefixCache` with zero-re-prefill shared joins + copy-on-write,
+    fp32/bf16/int8 pages behind `kv_dtype=`, free-page admission with
+    `OutOfPages` backpressure (README "Paged KV cache");
   * `scheduler.Scheduler` / `Request` — bounded FIFO admission with
     backpressure (`QueueFull`), deadlines, cancellation, drain;
   * `server.ServingServer` — thread frontend: submit() -> future with
@@ -22,14 +29,17 @@ serving, and a wedged loop marks the server dead (`ServerCrashed`)
 with every future resolved. All of it is deterministically testable
 via the `serving.*` fault points in `paddle_tpu.testing.faults`.
 """
-from .engine import ArtifactServingEngine, ServingEngine, WatchdogTimeout
+from .engine import (ArtifactServingEngine, PagedServingEngine,
+                     ServingEngine, WatchdogTimeout)
 from .metrics import CallbackList, ServingCallback, ServingMetrics
+from .paging import OutOfPages, PageAllocator, PagedKVCache, PrefixCache
 from .scheduler import QueueFull, Request, RequestResult, Scheduler
 from .server import ServerCrashed, ServingServer
 
 __all__ = [
-    "ServingEngine", "ArtifactServingEngine", "ServingServer",
-    "Scheduler", "Request", "RequestResult", "QueueFull",
-    "ServingMetrics", "ServingCallback", "CallbackList",
-    "WatchdogTimeout", "ServerCrashed",
+    "ServingEngine", "PagedServingEngine", "ArtifactServingEngine",
+    "ServingServer", "Scheduler", "Request", "RequestResult",
+    "QueueFull", "ServingMetrics", "ServingCallback", "CallbackList",
+    "WatchdogTimeout", "ServerCrashed", "OutOfPages", "PageAllocator",
+    "PagedKVCache", "PrefixCache",
 ]

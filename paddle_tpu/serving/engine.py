@@ -39,11 +39,13 @@ import time
 
 import numpy as np
 
-from ..core.bucketing import bucket_size
+from ..core.bucketing import bucket_size, pad_prompt_row, pad_token_rows
 from ..testing import faults
+from .paging import OutOfPages, PageAllocator, PrefixCache, pages_for
 from .metrics import CallbackList, ServingMetrics
 
-__all__ = ["ServingEngine", "ArtifactServingEngine", "WatchdogTimeout"]
+__all__ = ["ServingEngine", "PagedServingEngine",
+           "ArtifactServingEngine", "WatchdogTimeout"]
 
 #: fault points instrumenting the slot lifecycle (armed only in tests /
 #: chaos runs; a disarmed hit is one boolean read)
@@ -118,6 +120,18 @@ class _EngineBase:
         Return True when the request was served another way (its future
         resolved); False to fail the future with `exc`."""
         return False
+
+    def _admission_gate(self, request):
+        """Resource headroom check beyond a free slot. Returning False
+        pushes the request back to the queue HEAD (it stays admitted,
+        just deferred) and ends this iteration's joins — the paged
+        engine's OutOfPages backpressure path."""
+        return True
+
+    def _iteration_gauges(self):
+        """Extra per-iteration gauges for metrics.record_iteration
+        (the paged engine reports page occupancy here)."""
+        return None
 
     def _reset_pool(self):
         """Rebuild device pool state after a decode-step failure (all
@@ -253,6 +267,12 @@ class _EngineBase:
                 self.metrics.record_finish("error")
                 self._cbs.emit("on_finish", r)
                 continue
+            if not self._admission_gate(r):
+                # resource backpressure (paged: not enough free pages):
+                # the request stays queued at the head, joins stop for
+                # this iteration, decode keeps draining the pool
+                scheduler.push_front(r)
+                break
             s = free[0]
             r.state, r.slot = "RUNNING", s
             self.slots[s] = r
@@ -300,7 +320,8 @@ class _EngineBase:
                 self.metrics.record_decode(n, now2 - t0)
                 progress = True
         self.metrics.record_iteration(
-            scheduler.depth(), self.occupancy() / self.num_slots)
+            scheduler.depth(), self.occupancy() / self.num_slots,
+            **(self._iteration_gauges() or {}))
         self._cbs.emit("on_iteration", {
             "queue_depth": scheduler.depth(),
             "occupancy": self.occupancy(), "joins": joins})
@@ -343,10 +364,17 @@ class ServingEngine(_EngineBase):
     absolute slots Pb, Pb+1, ... — which is what makes every slot's
     output bit-comparable to a solo `generate_eager` run."""
 
+    def __new__(cls, *args, **kw):
+        # `paged=True` routes construction to the paged-pool engine so
+        # callers opt into paging without a second entry point
+        if cls is ServingEngine and kw.get("paged"):
+            return object.__new__(PagedServingEngine)
+        return object.__new__(cls)
+
     def __init__(self, decoder, embed, project, *, num_slots=8,
                  max_len=128, max_joins_per_iter=2, metrics=None,
                  callbacks=(), clock=time.monotonic,
-                 eager_fallback=False, **kw):
+                 eager_fallback=False, paged=False, **kw):
         super().__init__(num_slots, max_joins_per_iter=max_joins_per_iter,
                          metrics=metrics, callbacks=callbacks, clock=clock,
                          **kw)
@@ -364,6 +392,11 @@ class ServingEngine(_EngineBase):
         self._pool_key = None
 
     # ------------------------------------------------------------------
+    def _max_len_detail(self):
+        """Suffix for the max_len overflow message (the paged engine
+        reports the page-granular limit here)."""
+        return ""
+
     def admit_check(self, r):
         P = max(1, int(r.prompt.shape[0]))
         Pb = bucket_size(P)
@@ -371,7 +404,7 @@ class ServingEngine(_EngineBase):
             raise ValueError(
                 f"request needs bucket({P})={Pb} prompt slots + "
                 f"{r.max_new_tokens} decode slots > pool max_len "
-                f"{self.max_len}")
+                f"{self.max_len}{self._max_len_detail()}")
         if r.memory is None or r.memory.ndim != 2:
             raise ValueError("ServingEngine requests need a 2-D "
                              "cross-attention memory [M, D]")
@@ -418,11 +451,8 @@ class ServingEngine(_EngineBase):
 
         _PT_PREFILL()
         self._ensure_state(r.memory)
-        P0 = max(1, int(r.prompt.shape[0]))
-        Pb = bucket_size(P0)
         pad_id = int(r.eos_id) if r.eos_id is not None else 0
-        prompt_b = np.full((1, Pb), pad_id, np.int32)
-        prompt_b[0, :r.prompt.shape[0]] = r.prompt
+        prompt_b, P0, Pb = pad_prompt_row(r.prompt, pad_id)
         key = ("join", Pb)
         fn = self._compiled.get(key)
         if fn is None:
@@ -590,6 +620,573 @@ class ServingEngine(_EngineBase):
         return jax.jit(step_fn)
 
 
+def _make_cross_kv_fm(decoder):
+    """Functionalized 'memory -> per-layer cross-attention StaticCache'
+    net: the prefix-hit attach path needs the joiner's OWN cross-attn
+    K/V (memory is per-request) but must not run any self-attention
+    prefill — this is the only model compute a shared-prefix join
+    performs."""
+    from ..nn.layer.layers import Layer
+    from ..nn.layer.transformer import MultiHeadAttention as MHA
+    from ..parallel.functional import functionalize
+
+    class _CrossKV(Layer):
+        def __init__(self, dec):
+            super().__init__()
+            self.dec = dec
+
+        def forward(self, memory):
+            return [layer.cross_attn.gen_cache(
+                memory, type=MHA.StaticCache)
+                for layer in self.dec.layers]
+
+    return functionalize(_CrossKV(decoder))
+
+
+class PagedServingEngine(ServingEngine):
+    """The serving pool over PAGED KV storage: `ServingEngine(...,
+    paged=True)`. Device K/V lives in a global pool of fixed-size pages
+    ([num_pages + 1, H, page_size, D] per layer — static shape, one
+    compile per pool config); each slot maps its logical positions
+    through a host-owned int32 page table shipped to the device as a
+    traced input every step, so page mapping, joins, and evictions
+    never retrace:
+
+      * slot join allocates only the pages the PROMPT bucket needs;
+        decode pages are mapped on demand as the write position crosses
+        page boundaries, so pool occupancy is bounded by actual tokens,
+        not worst-case max_len — `num_pages` can be far below
+        `num_slots * max_pages` (oversubscription);
+      * a prompt already in the prefix cache joins with ZERO prefill
+        FLOPs: the shared pages are mapped read-only (refcounted) and
+        only the page the joiner will decode-write into is copied
+        (copy-on-write), so co-resident requests sharing a prefix stay
+        bit-isolated;
+      * admission runs on free-page headroom (prompt pages + a decode
+        reservation) — insufficient pages DEFER the queue head
+        (OutOfPages backpressure, `metrics.page_waits`) instead of
+        failing it; if oversubscription still runs dry mid-decode, the
+        starved slot is evicted with partials + an `OutOfPages` cause
+        (`metrics.oom_evictions`) and the pool keeps serving;
+      * pages store fp32 (default: bit-identical to the dense pool's
+        decode), bf16, or int8 + per-(page, head) scales behind
+        `kv_dtype=`, dequantized at read time (in-kernel on TPU).
+
+    Numerics contract: with `kv_dtype=None` (compute dtype) every
+    request's tokens bit-match both the dense `ServingEngine` and a
+    solo `generate_eager` run, provided `max_len` is a page multiple
+    (it is rounded up to one — a non-multiple would change the masked
+    softmax width)."""
+
+    def __init__(self, decoder, embed, project, *, num_slots=8,
+                 max_len=128, page_size=16, num_pages=None,
+                 kv_dtype=None, prefix_cache=True, prefix_capacity=64,
+                 reserve_decode_frac=1.0, paged=True, **kw):
+        page_size = int(page_size)
+        max_len = pages_for(max_len, page_size) * page_size
+        super().__init__(decoder, embed, project, num_slots=num_slots,
+                         max_len=max_len, **kw)
+        self.page_size = page_size
+        self.max_pages = self.max_len // page_size
+        self.num_pages = (int(num_pages) if num_pages is not None
+                          else self.num_slots * self.max_pages)
+        self.kv_dtype = kv_dtype
+        self.reserve_decode_frac = float(reserve_decode_frac)
+        self._alloc = PageAllocator(self.num_pages, page_size)
+        self._prefix = (PrefixCache(self._alloc, prefix_capacity)
+                        if prefix_cache else None)
+        self._table = np.full((self.num_slots, self.max_pages), -1,
+                              np.int32)
+        self._index = np.zeros(self.num_slots, np.int32)
+        # total pages each occupied slot will have mapped by the time
+        # its request hits max_new_tokens — admission subtracts the
+        # not-yet-mapped remainder from the free-page headroom so
+        # reserve_decode_frac=1.0 is a no-OOM guarantee
+        self._slot_pages_total = np.zeros(self.num_slots, np.int64)
+        self._fm_cross = None
+        self._page_bytes = None
+        self._prefix_params = None   # param identity the cache is
+        #                              valid for (see _check_params)
+        self.prefill_count = 0   # real prefills run (prefix hits skip)
+
+    # ------------------------------------------------------------------
+    def _max_len_detail(self):
+        return (f" (= {self.max_pages} pages x {self.page_size} "
+                f"tokens, paged)")
+
+    def admit_check(self, r):
+        super().admit_check(r)
+        # liveness: a request the whole (empty) pool could never hold
+        # must fail fast, not defer at the backpressure gate forever
+        P = max(1, int(r.prompt.shape[0]))
+        need = pages_for(bucket_size(P) + r.max_new_tokens,
+                         self.page_size)
+        if need > self.num_pages:
+            raise ValueError(
+                f"request needs {need} pages > pool num_pages "
+                f"{self.num_pages} ({self.page_size}-token pages)")
+
+    def _ensure_state(self, memory):
+        if self._state is not None:
+            return
+        import jax.numpy as jnp
+
+        from ..text.generation import NEG
+        from .paging import resolve_kv_dtype
+
+        decoder = self._net.decoder
+        M, Dm = memory.shape
+        dtype = jnp.asarray(np.asarray(memory)).dtype
+        S, L = self.num_slots, self.max_len
+        paged = []
+        for layer in decoder.layers:
+            c = layer.self_attn.gen_paged_cache(
+                self.num_pages, self.page_size, S, self.max_pages,
+                dtype, self.kv_dtype)
+            paged.append({"k": c.k, "v": c.v, "ks": c.k_scale,
+                          "vs": c.v_scale})
+        static = []
+        for layer in decoder.layers:
+            z = jnp.zeros((S, layer.cross_attn.num_heads, M,
+                           layer.cross_attn.head_dim), dtype)
+            static.append((z, z))
+        self._state = {
+            "tok": jnp.zeros((S,), jnp.int32),
+            "bias": jnp.zeros((S, L), jnp.float32),
+            "mem": jnp.zeros((S, M, Dm), dtype),
+            "static": static,
+            "paged": paged,
+        }
+        self._mem_shape = (M, Dm)
+        self._np_dtype = np.dtype(str(dtype))
+        self._pool_key = (S, L, M, Dm, str(dtype), self.page_size,
+                          self.num_pages, str(self.kv_dtype))
+        self._neg = float(NEG)
+        storage, quantized = resolve_kv_dtype(self.kv_dtype, dtype)
+        h0 = decoder.layers[0].self_attn
+        per_buf = h0.num_heads * self.page_size * h0.head_dim \
+            * jnp.dtype(storage).itemsize
+        scale_b = h0.num_heads * 4 if quantized else 0
+        self._page_bytes = 2 * len(decoder.layers) * (per_buf + scale_b)
+
+    # ---- host page bookkeeping ----
+    def _alloc_pages(self, n):
+        """Allocate n pages, reclaiming LRU prefix-cache entries under
+        pressure first."""
+        if self._alloc.pages_free < n and self._prefix is not None:
+            self._prefix.reclaim(n)
+        return self._alloc.alloc(n)
+
+    def _release_slot(self, s):
+        mapped = [int(p) for p in self._table[s] if p >= 0]
+        if mapped:
+            self._alloc.decref(mapped)
+        self._table[s] = -1
+        self._index[s] = 0
+        self._slot_pages_total[s] = 0
+
+    def _evict(self, s):
+        self._release_slot(s)
+
+    def _device_table(self):
+        import jax.numpy as jnp
+
+        # unmapped entries point at the trash row (num_pages): inactive
+        # slots' masked decode writes can never land on live pages
+        return jnp.asarray(np.where(self._table < 0, self.num_pages,
+                                    self._table).astype(np.int32))
+
+    def flush_prefix_cache(self):
+        """Drop every prefix-cache entry (releases the cache's page
+        references; pages still mapped by live slots survive via their
+        own refs). After a full drain this returns the allocator to
+        all-free — the chaos leak check pivots on it."""
+        if self._prefix is not None:
+            self._prefix.flush()
+
+    def _reset_pool(self):
+        # a decode-step failure evicted every slot (pages returned);
+        # the device pages are rebuilt zeroed on the next join, so the
+        # prefix cache's pages would hold garbage — flush it
+        self.flush_prefix_cache()
+        self._table[:] = -1
+        self._index[:] = 0
+        self._state = None
+
+    # ---- admission: free-page headroom ----
+    def _pages_needed(self, r):
+        P0 = max(1, int(r.prompt.shape[0]))
+        Pb = bucket_size(P0)
+        n_pp = pages_for(Pb, self.page_size)
+        need_prompt = n_pp
+        if self._prefix is not None:
+            pad_id = int(r.eos_id) if r.eos_id is not None else 0
+            row, P0, Pb = pad_prompt_row(r.prompt, pad_id)
+            if self._prefix.peek(self._prefix_key(row, P0, r)) \
+                    is not None:
+                # shared pages are free; only a COW of the partial
+                # tail page (when the bucket ends mid-page) is new
+                need_prompt = 1 if Pb % self.page_size else 0
+        total = pages_for(Pb + r.max_new_tokens, self.page_size)
+        reserve = int(np.ceil(
+            self.reserve_decode_frac * (total - n_pp)))
+        return need_prompt + reserve
+
+    def _outstanding_reservations(self):
+        """Pages already-admitted slots will still map before they
+        finish (scaled by the reservation fraction): subtracted from
+        the free headroom so admission never promises the same page
+        twice."""
+        out = 0
+        for s, r in enumerate(self.slots):
+            if r is None:
+                continue
+            mapped = int((self._table[s] >= 0).sum())
+            remain = max(0, int(self._slot_pages_total[s]) - mapped)
+            out += int(np.ceil(self.reserve_decode_frac * remain))
+        return out
+
+    def _admission_gate(self, r):
+        need = self._pages_needed(r) + self._outstanding_reservations()
+        if self._alloc.pages_free < need and self._prefix is not None:
+            self._prefix.reclaim(need)
+        if self._alloc.pages_free >= need:
+            return True
+        self.metrics.record_page_wait()
+        return False
+
+    def _iteration_gauges(self):
+        gauges = {"pages_in_use": self._alloc.pages_in_use,
+                  "pages_free": self._alloc.pages_free}
+        active_toks = sum(int(self._index[s])
+                          for s, r in enumerate(self.slots)
+                          if r is not None)
+        if active_toks and self._page_bytes:
+            gauges["bytes_per_active_token"] = \
+                self._alloc.pages_in_use * self._page_bytes \
+                / active_toks
+        return gauges
+
+    # ---- join: prefill into pages, or attach shared prefix pages ----
+    def _prefix_key(self, padded_row, P0, r):
+        from .paging import PrefixCache as PC
+
+        return (int(P0),) + PC.key_of(padded_row[0], r.memory)
+
+    def _check_params(self):
+        """Prefix-cache entries hold MODEL-DERIVED state (prompt K/V
+        pages + the first greedy token), so a weight update makes them
+        stale — unlike the compiled programs, which take params as
+        arguments every call. Rebinding any `p._data` replaces the leaf
+        array object, so an identity sweep over the param pytree (a few
+        hundred `is` checks, no device work) detects the update and
+        flushes the cache; holding the previous dict's array references
+        makes the identity check sound (no id recycling)."""
+        cur = self._fm.params()
+        prev = self._prefix_params
+        if prev is not None and len(prev) == len(cur) and \
+                all(cur[k] is prev.get(k) for k in cur):
+            return
+        if prev is not None:
+            self.flush_prefix_cache()
+        self._prefix_params = cur
+
+    def _join(self, s, r):
+        self._ensure_state(r.memory)
+        if self._prefix is not None:
+            self._check_params()
+        # idempotent under the retry loop: a half-joined earlier
+        # attempt's pages are released before this one allocates
+        self._release_slot(s)
+        pad_id = int(r.eos_id) if r.eos_id is not None else 0
+        prompt_b, P0, Pb = pad_prompt_row(r.prompt, pad_id)
+        self._slot_pages_total[s] = pages_for(
+            Pb + r.max_new_tokens, self.page_size)
+        hit = None
+        if self._prefix is not None:
+            key = self._prefix_key(prompt_b, P0, r)
+            hit = self._prefix.lookup(key)
+            self.metrics.record_prefix(hit is not None)
+        if hit is not None:
+            return self._attach_shared(s, r, hit, P0, Pb)
+        return self._prefill_join(
+            s, r, prompt_b, P0, Pb,
+            key if self._prefix is not None else None)
+
+    def _prefill_join(self, s, r, prompt_b, P0, Pb, key):
+        import jax.numpy as jnp
+
+        _PT_PREFILL()
+        n_pp = pages_for(Pb, self.page_size)
+        pages = self._alloc_pages(n_pp)
+        ck = ("pjoin", Pb)
+        fn = self._compiled.get(ck)
+        if fn is None:
+            fn = self._build_paged_join(Pb)
+            self._compiled[ck] = fn
+        try:
+            self._state, tok0 = fn(
+                self._fm.params(), self._fm.buffers(), self._state,
+                jnp.int32(s), jnp.asarray(prompt_b),
+                jnp.asarray([P0], jnp.int32),
+                jnp.asarray(np.asarray(r.memory, self._np_dtype)[None]),
+                jnp.asarray(np.asarray(pages, np.int32)))
+        except Exception:
+            self._alloc.decref(pages)
+            raise
+        self._table[s, :n_pp] = pages
+        self._index[s] = Pb
+        self.prefill_count += 1
+        tok0 = int(tok0)
+        if self._prefix is not None and key is not None:
+            self._prefix.insert(key, pages, tok0, P0, Pb)
+        self._cow_tail(s, Pb)
+        return tok0
+
+    def _attach_shared(self, s, r, hit, P0, Pb):
+        """Prefix-cache hit: map the shared prompt pages read-only and
+        splice only the per-request rows (bias hole, memory, cross-attn
+        K/V, cached first token) — ZERO self-attention prefill FLOPs
+        for the shared pages. One compiled program for every bucket
+        (the bucket boundary rides in as a traced scalar)."""
+        import jax.numpy as jnp
+
+        pages = hit["pages"]
+        self._alloc.incref(pages)
+        if self._fm_cross is None:
+            self._fm_cross = _make_cross_kv_fm(self._net.decoder)
+        ck = ("attach",)
+        fn = self._compiled.get(ck)
+        if fn is None:
+            fn = self._build_attach()
+            self._compiled[ck] = fn
+        try:
+            self._state = fn(
+                self._fm_cross.params(), self._fm_cross.buffers(),
+                self._state, jnp.int32(s), jnp.int32(hit["tok0"]),
+                jnp.asarray([P0], jnp.int32), jnp.int32(Pb),
+                jnp.asarray(np.asarray(r.memory, self._np_dtype)[None]))
+        except Exception:
+            self._alloc.decref(pages)
+            raise
+        self._table[s, :len(pages)] = pages
+        self._index[s] = Pb
+        self._cow_tail(s, Pb)
+        return int(hit["tok0"])
+
+    def _cow_tail(self, s, Pb):
+        """Copy-on-write: when the bucket boundary falls mid-page, the
+        first decode write lands inside the last prompt page — if that
+        page is shared (prefix cache / co-resident holder), give this
+        slot a private copy first so the shared original stays
+        immutable."""
+        import jax.numpy as jnp
+
+        if Pb % self.page_size == 0:
+            return
+        pi = Pb // self.page_size
+        src = int(self._table[s, pi])
+        if src < 0 or self._alloc.refcount[src] <= 1:
+            return
+        dst = self._alloc_pages(1)[0]
+        ck = ("cow",)
+        fn = self._compiled.get(ck)
+        if fn is None:
+            fn = self._build_cow()
+            self._compiled[ck] = fn
+        try:
+            self._state = fn(self._state, jnp.int32(src),
+                             jnp.int32(dst))
+        except Exception:
+            self._alloc.decref([dst])
+            raise
+        self._alloc.decref([src])
+        self._table[s, pi] = dst
+
+    # ---- compiled programs ----
+    def _build_paged_join(self, Pb):
+        import jax
+        import jax.numpy as jnp
+
+        from ..nn.layer.transformer import MultiHeadAttention as MHA
+        from . import paging as PG
+
+        fm = self._fm
+        decoder = self._net.decoder
+        L = self.max_len
+        ck = ("pjoin", Pb)
+        neg = self._neg
+
+        def join_fn(params, buffers, state, slot, prompt, length,
+                    memory, page_ids):
+            self.trace_counts[ck] += 1  # one per trace = one compile
+            kpos = jnp.arange(L, dtype=jnp.int32)
+            hole = (kpos[None, :] >= length[:, None]) & \
+                (kpos[None, :] < jnp.int32(Pb))
+            bias_row = jnp.where(hole, jnp.float32(neg),
+                                 jnp.float32(0.0))           # [1, L]
+            positions = jnp.arange(Pb, dtype=jnp.int32)[None]
+            inc0 = [layer.self_attn.gen_cache(
+                None, max_length=Pb, batch_size=1, dtype=memory.dtype)
+                for layer in decoder.layers]
+            (lg, inc1, static1), _ = fm.apply(
+                params, buffers, None, prompt, positions, memory,
+                training=False, tgt_mask=bias_row[:, :Pb],
+                memory_mask=None, inc=inc0, prefill=True)
+            last = jnp.take_along_axis(
+                lg, (length - 1)[:, None, None], axis=1)[:, 0]
+            tok0 = last.argmax(-1).astype(jnp.int32)[0]
+            new_paged = []
+            for pc, c in zip(state["paged"], inc1):
+                cache = PG.PagedKVCache(pc["k"], pc["v"], pc["ks"],
+                                        pc["vs"], None, None)
+                cache = MHA.paged_prompt_splice(cache, page_ids,
+                                                c.k, c.v)
+                new_paged.append({"k": cache.k, "v": cache.v,
+                                  "ks": cache.k_scale,
+                                  "vs": cache.v_scale})
+            new_static = [(MHA.splice_rows(pk, slot, sk),
+                           MHA.splice_rows(pv, slot, sv))
+                          for (pk, pv), (sk, sv) in zip(state["static"],
+                                                        static1)]
+            new_state = {
+                "tok": jax.lax.dynamic_update_slice(
+                    state["tok"], tok0[None], (slot,)),
+                "bias": MHA.splice_rows(state["bias"], slot, bias_row),
+                "mem": MHA.splice_rows(state["mem"], slot, memory),
+                "static": new_static,
+                "paged": new_paged,
+            }
+            return new_state, tok0
+
+        return jax.jit(join_fn)
+
+    def _build_attach(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ..nn.layer.transformer import MultiHeadAttention as MHA
+
+        fm_cross = self._fm_cross
+        L = self.max_len
+        ck = ("attach",)
+        neg = self._neg
+
+        def attach_fn(cparams, cbuffers, state, slot, tok0, length,
+                      pb, memory):
+            self.trace_counts[ck] += 1
+            static1, _ = fm_cross.apply(cparams, cbuffers, None,
+                                        memory, training=False)
+            kpos = jnp.arange(L, dtype=jnp.int32)
+            hole = (kpos[None, :] >= length[:, None]) & \
+                (kpos[None, :] < pb)                 # pb traced: one
+            #                                          compile, all
+            #                                          buckets
+            bias_row = jnp.where(hole, jnp.float32(neg),
+                                 jnp.float32(0.0))
+            new_static = [(MHA.splice_rows(pk, slot, sk),
+                           MHA.splice_rows(pv, slot, sv))
+                          for (pk, pv), (sk, sv) in zip(state["static"],
+                                                        static1)]
+            return dict(
+                state,
+                tok=jax.lax.dynamic_update_slice(
+                    state["tok"], tok0[None], (slot,)),
+                bias=MHA.splice_rows(state["bias"], slot, bias_row),
+                mem=MHA.splice_rows(state["mem"], slot, memory),
+                static=new_static)
+
+        return jax.jit(attach_fn)
+
+    def _build_cow(self):
+        import jax
+
+        from . import paging as PG
+
+        ck = ("cow",)
+
+        def cow_fn(state, src, dst):
+            self.trace_counts[ck] += 1
+            new_paged = []
+            for pc in state["paged"]:
+                k, ks = PG.copy_page(pc["k"], pc["ks"], src, dst)
+                v, vs = PG.copy_page(pc["v"], pc["vs"], src, dst)
+                new_paged.append({"k": k, "v": v, "ks": ks, "vs": vs})
+            return dict(state, paged=new_paged)
+
+        return jax.jit(cow_fn)
+
+    # ---- decode: on-demand page mapping + one batched step ----
+    def _evict_oom(self, s, exc, now):
+        r = self.slots[s]
+        self.slots[s] = None
+        self._evict(s)
+        self.metrics.record_oom_eviction()
+        self.metrics.record_error("out_of_pages", exc)
+        self.metrics.record_finish("error")
+        r.finish("error", now, error=exc)
+        self._cbs.emit("on_finish", r)
+
+    def _decode_step(self, active):
+        import jax.numpy as jnp
+
+        now = self.clock()
+        # map the page each active slot's write position needs; under
+        # oversubscription a dry pool evicts the starved slot with its
+        # partial tokens (the pool itself keeps serving)
+        for s, r in enumerate(list(self.slots)):
+            if r is None:
+                continue
+            pi = int(self._index[s]) // self.page_size
+            if self._table[s, pi] < 0:
+                try:
+                    self._table[s, pi] = self._alloc_pages(1)[0]
+                except OutOfPages as e:
+                    self._evict_oom(s, e, now)
+        active = np.asarray([r is not None for r in self.slots], bool)
+        if not active.any():
+            return np.zeros((self.num_slots,), np.int64)
+        ck = ("pstep",) + self._pool_key
+        fn = self._compiled.get(ck)
+        if fn is None:
+            fn = self._build_paged_step(ck)
+            self._compiled[ck] = fn
+        self._state, toks = fn(
+            self._fm.params(), self._fm.buffers(), self._state,
+            self._device_table(),
+            jnp.asarray(self._index.astype(np.int32)),
+            jnp.asarray(active))
+        self._index[active] += 1
+        return np.asarray(toks)
+
+    def _build_paged_step(self, ck):
+        import jax
+        import jax.numpy as jnp
+
+        from . import paging as PG
+
+        fm = self._fm
+
+        def step_fn(params, buffers, state, table, index, active):
+            self.trace_counts[ck] += 1  # one per trace = one compile
+            inc = [PG.PagedKVCache(pc["k"], pc["v"], pc["ks"],
+                                   pc["vs"], table, index)
+                   for pc in state["paged"]]
+            posn = index[:, None]
+            (lg, inc2), _ = fm.apply(
+                params, buffers, None, state["tok"][:, None], posn,
+                state["mem"], training=False, tgt_mask=state["bias"],
+                memory_mask=None, inc=inc, static_kv=state["static"],
+                prefill=False)
+            nxt = lg[:, 0].argmax(-1).astype(jnp.int32)
+            nxt = jnp.where(active, nxt, state["tok"])
+            new_paged = [{"k": c.k, "v": c.v, "ks": c.k_scale,
+                          "vs": c.v_scale} for c in inc2]
+            return dict(state, tok=nxt, paged=new_paged), nxt
+
+        return jax.jit(step_fn)
+
+
 class ArtifactServingEngine(_EngineBase):
     """Continuous batching over a stateless causal-LM logits callable
     (an inference Program artifact: one int feed [B, S] -> one logits
@@ -629,12 +1226,8 @@ class ArtifactServingEngine(_EngineBase):
 
     def _decode_step(self, active):
         S = self.num_slots
-        Lb = bucket_size(max(len(self._rows[s]) for s in range(S)
-                             if active[s]))
-        buf = np.zeros((S, Lb), self._dtype)
-        for s in range(S):
-            if self._rows[s] is not None:
-                buf[s, :len(self._rows[s])] = self._rows[s]
+        buf, Lb = pad_token_rows(self._rows, pad_id=0,
+                                 dtype=self._dtype)
         shape = (S, Lb)
         if shape not in self.shapes:
             self.shapes.add(shape)

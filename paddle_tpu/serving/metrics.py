@@ -81,6 +81,15 @@ class ServingMetrics:
         #                              decode-step failure
         self.fallbacks = 0          # requests degraded to the eager path
         self.last_error = None      # {"where","type","message","at"}
+        # paging accounting (None until a paged engine records — the
+        # snapshot only grows a "paging" section for paged pools)
+        self.pages_in_use = None    # last-iteration gauge
+        self.pages_free = None
+        self.prefix_hits = 0        # joins served from the prefix cache
+        self.prefix_misses = 0      # joins that ran a real prefill
+        self.page_waits = 0         # admissions deferred on page headroom
+        self.oom_evictions = 0      # mid-decode OutOfPages victims
+        self.bytes_per_token = _Reservoir(512)  # bytes / active token
 
     # ---- recording (engine / frontend side) ----
     def record_submit(self):
@@ -149,11 +158,37 @@ class ServingMetrics:
         with self._lock:
             self.fallbacks += 1
 
-    def record_iteration(self, queue_depth, occupancy):
+    def record_prefix(self, hit):
+        """A paged join consulted the prefix cache: hit = shared pages
+        mapped with zero prefill; miss = a real prefill ran."""
+        with self._lock:
+            if hit:
+                self.prefix_hits += 1
+            else:
+                self.prefix_misses += 1
+
+    def record_page_wait(self):
+        """Admission deferred: not enough free pages for the queue head
+        (the OutOfPages backpressure path — the request stays queued)."""
+        with self._lock:
+            self.page_waits += 1
+
+    def record_oom_eviction(self, n=1):
+        with self._lock:
+            self.oom_evictions += n
+
+    def record_iteration(self, queue_depth, occupancy, pages_in_use=None,
+                         pages_free=None, bytes_per_active_token=None):
         with self._lock:
             self.iterations += 1
             self.queue_depth.add(queue_depth)
             self.occupancy.add(occupancy)
+            if pages_in_use is not None:
+                self.pages_in_use = int(pages_in_use)
+            if pages_free is not None:
+                self.pages_free = int(pages_free)
+            if bytes_per_active_token is not None:
+                self.bytes_per_token.add(bytes_per_active_token)
 
     # ---- reading ----
     def snapshot(self):
@@ -182,6 +217,20 @@ class ServingMetrics:
                 "per_token_ms": self.token_latency_s.summary(scale=1e3),
                 "queue_depth": self.queue_depth.summary(digits=2),
                 "slot_occupancy": self.occupancy.summary(digits=3),
+                **({} if self.pages_in_use is None else {"paging": {
+                    "pages_in_use": self.pages_in_use,
+                    "pages_free": self.pages_free,
+                    "prefix_hits": self.prefix_hits,
+                    "prefix_misses": self.prefix_misses,
+                    "prefix_hit_rate": round(
+                        self.prefix_hits /
+                        max(1, self.prefix_hits + self.prefix_misses),
+                        3),
+                    "page_waits": self.page_waits,
+                    "oom_evictions": self.oom_evictions,
+                    "bytes_per_active_token":
+                        self.bytes_per_token.summary(digits=1),
+                }}),
             }
 
 

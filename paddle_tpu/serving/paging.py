@@ -1,0 +1,360 @@
+"""Paged KV-cache subsystem for the serving pool.
+
+The dense serving pool (engine.py) preallocates one contiguous
+[S, H, max_len, D] K/V region per layer, so every slot pays for the
+worst-case `max_len` whether its request uses 9 tokens or 900, and
+identical system prompts are re-prefilled for every request. This
+module replaces the per-slot rows with a **global pool of fixed-size
+pages** plus an int32 indirection:
+
+  * pages live in static-shape arrays `[n_pages + 1, H, page_size, D]`
+    per layer (row `n_pages` is the TRASH page — inactive slots' masked
+    decode writes land there, never on live data);
+  * each slot owns an int32 `page_table[S, max_pages]` row mapping its
+    logical block i to a physical page (host-side `-1` = unmapped,
+    clipped to the trash row before it reaches the device);
+  * `PageAllocator` hands pages out of a free list with refcounts, so
+    several slots can map the SAME physical page read-only (shared
+    prompt prefixes) and a page returns to the free list exactly when
+    its last reference drops;
+  * `PrefixCache` keys fully-prefilled prompt pages on the prompt's
+    token hash (+ the cross-attention memory digest — the decoder's
+    self-attention K/V depend on it through the cross-attn residual
+    stream), so a request repeating a known prompt maps the cached
+    pages with ZERO prefill FLOPs; the page a joiner will decode-write
+    into is copied first (copy-on-write), so cached pages are
+    immutable;
+  * pages store K/V in fp32 / bf16 / int8 behind the engine's
+    `kv_dtype=` knob; int8 pages carry a per-(page, head) f32 scale
+    (symmetric, amax/127) that grows monotonically — a decode write
+    whose token outranges the page rescales the existing int8 payload
+    in place — and is applied at read time (in-kernel on TPU, in the
+    gather fallback elsewhere).
+
+Everything here is either pure host bookkeeping (allocator, prefix
+cache, page tables as numpy) or pure jnp array math safe inside jit
+(quantize / scatter / gather / copy). Shapes stay static for any pool
+config: the page table is a traced int32 input, so joining, evicting,
+and decode never retrace — the same trick the split-K decode kernel
+uses for its traced written-token counts.
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+
+import numpy as np
+
+__all__ = ["OutOfPages", "PageAllocator", "PrefixCache", "PagedKVCache",
+           "pages_for", "resolve_kv_dtype", "quantize_chunks",
+           "chunk_prompt", "write_prompt_pages", "write_token",
+           "copy_page", "gather_pages"]
+
+_QMAX = 127.0
+
+
+class OutOfPages(RuntimeError):
+    """The page pool cannot serve an allocation: backpressure (the
+    scheduler keeps the request queued until pages free up) or, when it
+    strikes mid-decode under oversubscription, a victim eviction."""
+
+
+#: the decode-engine paged cache: per-layer page arrays + the shared
+#: per-slot indirection. Leaves are raw jax arrays (valid jit inputs /
+#: scan carries); `k_scale`/`v_scale` are None unless the pages are
+#: int8. `table` is the [S, max_pages] int32 page table (trash-clipped)
+#: and `index` the per-slot written-token count — both shipped fresh
+#: from the host each step, so page mapping changes never retrace.
+PagedKVCache = collections.namedtuple(
+    "PagedKVCache", ["k", "v", "k_scale", "v_scale", "table", "index"])
+
+
+def pages_for(n_tokens, page_size):
+    """Pages needed to hold `n_tokens` cache positions."""
+    return -(-int(n_tokens) // int(page_size))
+
+
+def resolve_kv_dtype(kv_dtype, compute_dtype):
+    """The engine's `kv_dtype=` knob -> (storage jnp dtype, quantized?).
+    None keeps the compute dtype (bit-exact paging); "bf16" stores
+    bfloat16; "int8" stores symmetric int8 with per-(page, head)
+    scales."""
+    import jax.numpy as jnp
+
+    if kv_dtype is None:
+        return jnp.dtype(compute_dtype), False
+    name = str(kv_dtype).lower()
+    if name in ("int8", "i1"):
+        return jnp.dtype(jnp.int8), True
+    if name in ("bf16", "bfloat16"):
+        return jnp.dtype(jnp.bfloat16), False
+    if name in ("f4", "f32", "float32"):
+        return jnp.dtype(jnp.float32), False
+    return jnp.dtype(kv_dtype), False
+
+
+# --------------------------------------------------------------------------
+# host side: allocator + prefix cache
+# --------------------------------------------------------------------------
+
+class PageAllocator:
+    """Free-list + refcount bookkeeping over `n_pages` physical pages.
+    Host-side only — it never touches device arrays; the engine turns
+    its decisions into page-table entries. `alloc` raises `OutOfPages`
+    without partial effects; refcounts let shared prompt pages outlive
+    any single slot."""
+
+    def __init__(self, n_pages, page_size):
+        if n_pages < 1 or page_size < 1:
+            raise ValueError("n_pages and page_size must be >= 1")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        # pop() takes from the end: keep ids ascending for readability
+        self._free = list(range(self.n_pages - 1, -1, -1))
+        self.refcount = np.zeros(self.n_pages, np.int32)
+
+    @property
+    def pages_free(self):
+        return len(self._free)
+
+    @property
+    def pages_in_use(self):
+        return self.n_pages - len(self._free)
+
+    def alloc(self, n):
+        """Allocate `n` pages (refcount 1 each) or raise OutOfPages
+        with NO pages taken."""
+        n = int(n)
+        if n > len(self._free):
+            raise OutOfPages(
+                f"need {n} pages, {len(self._free)} free of "
+                f"{self.n_pages}")
+        pages = [self._free.pop() for _ in range(n)]
+        self.refcount[pages] = 1
+        return pages
+
+    def incref(self, pages):
+        for p in pages:
+            if self.refcount[p] <= 0:
+                raise RuntimeError(f"incref on free page {p}")
+            self.refcount[p] += 1
+
+    def decref(self, pages):
+        """Drop one reference per page; pages reaching zero return to
+        the free list (double-free raises — the invariant tests lean on
+        this)."""
+        for p in pages:
+            p = int(p)
+            if self.refcount[p] <= 0:
+                raise RuntimeError(f"decref on free page {p}")
+            self.refcount[p] -= 1
+            if self.refcount[p] == 0:
+                self._free.append(p)
+
+    def check(self):
+        """Invariants: free + referenced partitions the pool exactly;
+        raises on any violation (used by the soak test and the chaos
+        leak check)."""
+        free = set(self._free)
+        if len(free) != len(self._free):
+            raise AssertionError("duplicate pages on the free list")
+        held = {p for p in range(self.n_pages) if self.refcount[p] > 0}
+        if free & held:
+            raise AssertionError(f"pages both free and held: "
+                                 f"{sorted(free & held)}")
+        if free | held != set(range(self.n_pages)):
+            raise AssertionError("leaked pages: neither free nor held: "
+                                 f"{sorted(set(range(self.n_pages)) - free - held)}")
+        if (self.refcount < 0).any():
+            raise AssertionError("negative refcount")
+        return True
+
+
+class PrefixCache:
+    """Host-side map from (prompt tokens, memory digest) to the
+    immutable pages a previous join prefilled for that prompt, plus the
+    prefill's first greedy token. Whole-prompt granularity: a hit means
+    the ENTIRE padded prompt block [0, Pb) is served by shared pages
+    and the join runs zero prefill FLOPs. LRU-bounded: inserting past
+    `capacity` (or an explicit `reclaim`) drops the oldest entries,
+    releasing the cache's page references."""
+
+    def __init__(self, allocator, capacity=64):
+        self.allocator = allocator
+        self.capacity = int(capacity)
+        self._entries = collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def key_of(prompt, memory):
+        prompt = np.asarray(prompt)
+        mem = b"" if memory is None else np.ascontiguousarray(memory)
+        digest = hashlib.sha1()
+        digest.update(np.ascontiguousarray(prompt.astype(np.int64)))
+        if memory is not None:
+            digest.update(str(mem.dtype).encode())
+            digest.update(str(mem.shape).encode())
+            digest.update(mem)
+        # the digest alone would admit hash collisions across prompts;
+        # carrying the token tuple keeps lookups exact
+        return (tuple(int(t) for t in prompt.ravel()),
+                digest.hexdigest())
+
+    def __len__(self):
+        return len(self._entries)
+
+    def peek(self, key):
+        """Like lookup, but no hit/miss accounting and no MRU move —
+        the admission gate's headroom estimate uses it."""
+        return self._entries.get(key)
+
+    def lookup(self, key):
+        """Entry dict {pages, tok0, n_prompt, Pb} or None. A hit moves
+        the entry to MRU; the CALLER increfs the pages it maps."""
+        e = self._entries.get(key)
+        if e is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        return e
+
+    def insert(self, key, pages, tok0, n_prompt, Pb):
+        """Adopt `pages` (already refcounted by their owner): the cache
+        takes its own reference so they survive the owner's eviction."""
+        if key in self._entries:
+            return
+        self.allocator.incref(pages)
+        self._entries[key] = {"pages": list(pages), "tok0": int(tok0),
+                              "n_prompt": int(n_prompt), "Pb": int(Pb)}
+        while len(self._entries) > self.capacity:
+            self._drop_lru()
+
+    def _drop_lru(self):
+        _, e = self._entries.popitem(last=False)
+        self.allocator.decref(e["pages"])
+
+    def reclaim(self, n_needed):
+        """Drop LRU entries until the allocator has `n_needed` free
+        pages or the cache is empty. Returns True on success. (Entries
+        whose pages are still mapped by live slots free nothing yet —
+        the refcount keeps them alive — so keep dropping.)"""
+        while self.allocator.pages_free < n_needed and self._entries:
+            self._drop_lru()
+        return self.allocator.pages_free >= n_needed
+
+    def flush(self):
+        while self._entries:
+            self._drop_lru()
+
+    @property
+    def hit_rate(self):
+        n = self.hits + self.misses
+        return (self.hits / n) if n else 0.0
+
+
+# --------------------------------------------------------------------------
+# device side: pure jnp page math (safe under jit; shapes static)
+# --------------------------------------------------------------------------
+
+def quantize_chunks(chunks, storage_dtype, quantized):
+    """[N, H, page_size, D] compute-dtype chunks -> (stored, scale).
+    int8: symmetric per-(page, head) amax/127 scale (1.0 for all-zero
+    pages so dequant never divides by zero); other dtypes: plain cast,
+    scale None."""
+    import jax.numpy as jnp
+
+    if not quantized:
+        return chunks.astype(storage_dtype), None
+    amax = jnp.max(jnp.abs(chunks.astype(jnp.float32)), axis=(2, 3),
+                   keepdims=True)                     # [N, H, 1, 1]
+    scale = jnp.where(amax > 0, amax / _QMAX, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(chunks.astype(jnp.float32) / scale),
+                 -_QMAX, _QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def chunk_prompt(kv, page_size):
+    """A prefilled [1, H, P, D] K or V block -> [n_pages, H, page_size,
+    D] page chunks (tail zero-padded to the page boundary)."""
+    import jax.numpy as jnp
+
+    _, H, P, D = kv.shape
+    n_pp = pages_for(P, page_size)
+    pad = n_pp * page_size - P
+    x = kv[0]
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((H, pad, D), x.dtype)], axis=1)
+    return jnp.transpose(
+        x.reshape(H, n_pp, page_size, D), (1, 0, 2, 3))
+
+
+def write_prompt_pages(pages, scales, page_ids, kv, quantized):
+    """Scatter a prefilled [1, H, P, D] block into `pages` at the
+    (traced int32 [n_pages]) `page_ids`. Returns (pages, scales)."""
+    page_size = pages.shape[2]
+    chunks = chunk_prompt(kv, page_size)
+    stored, sc = quantize_chunks(chunks, pages.dtype, quantized)
+    pages = pages.at[page_ids].set(stored)
+    if quantized:
+        scales = scales.at[page_ids].set(sc)
+    return pages, scales
+
+
+def write_token(pages, scales, table, index, tok):
+    """The decode write: slot s's token K or V ([S, H, D]) lands at
+    logical position index[s] — physical page table[s, index[s] //
+    page_size], offset index[s] % page_size. Slots whose table entry
+    points at the trash row write garbage there harmlessly (the engine
+    maps every ACTIVE slot's write page before the step). int8 pages
+    whose scale the new token outranges are rescaled in place (the
+    per-page scale only ever grows)."""
+    import jax.numpy as jnp
+
+    page_size = pages.shape[2]
+    S = tok.shape[0]
+    pid = jnp.take_along_axis(
+        table, (index // page_size)[:, None], axis=1)[:, 0]   # [S]
+    off = index % page_size
+    if scales is None:
+        return pages.at[pid, :, off, :].set(tok.astype(pages.dtype)), \
+            None
+    # gather the S target pages, grow their scales to cover the new
+    # token, rescale the existing int8 payload, write, scatter back
+    pg = pages[pid].astype(jnp.float32)              # [S, H, psz, D]
+    s_old = scales[pid]                              # [S, H, 1, 1]
+    t32 = tok.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(t32), axis=-1,
+                   keepdims=True)[..., None]         # [S, H, 1, 1]
+    s_new = jnp.maximum(s_old, amax / _QMAX)
+    s_new = jnp.where(s_new > 0, s_new, 1.0)
+    factor = s_old / s_new                           # <= 1; exact 1.0
+    #                                                  when no growth
+    pg = jnp.clip(jnp.round(pg * factor), -_QMAX, _QMAX)
+    qt = jnp.clip(jnp.round(t32 / s_new[..., 0]), -_QMAX, _QMAX)
+    pg = pg.at[jnp.arange(S), :, off, :].set(qt)
+    return (pages.at[pid].set(pg.astype(jnp.int8)),
+            scales.at[pid].set(s_new))
+
+
+def copy_page(pages, scales, src, dst):
+    """Copy-on-write: duplicate physical page `src` into `dst` (traced
+    int32 scalars) so a joiner can decode-write without touching the
+    shared original."""
+    pages = pages.at[dst].set(pages[src])
+    if scales is not None:
+        scales = scales.at[dst].set(scales[src])
+    return pages, scales
+
+
+def gather_pages(pages, scales, table, compute_dtype):
+    """Dense [S, H, max_pages * page_size, D] logical view of each
+    slot's cache, dequantized — the XLA fallback read path (the pallas
+    kernel reads pages in place through the scalar-prefetched table
+    instead). Unmapped (trash-clipped) table entries gather garbage
+    that the written-length mask hides."""
+    from ..ops.attention import paged_gather_kv
+
+    return paged_gather_kv(pages, scales, table, compute_dtype)

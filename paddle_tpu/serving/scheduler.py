@@ -200,6 +200,16 @@ class Scheduler:
                 continue
             return r
 
+    def push_front(self, request):
+        """Return an already-admitted request to the HEAD of the queue
+        (FIFO order preserved): the paged engine's page-headroom gate
+        defers the queue head when free pages can't cover its prompt +
+        reservation — OutOfPages backpressure keeps it queued instead
+        of failing it. Bypasses the high-water mark and drain checks on
+        purpose: the request was admitted once already."""
+        with self._lock:
+            self._q.appendleft(request)
+
     def depth(self):
         with self._lock:
             return len(self._q)

@@ -30,6 +30,7 @@ CHECKS = [
     (r"CTR-DNN", r"~?([\d.]+)(k?)\s*ex/s", ("ctr_ps", "value"), "ctr ex/s"),
     (r"ERNIE long-context", r"~?([\d.]+)()\s*seq/s", ("ernie_long", "value"), "ernie_long seq/s"),
     (r"Long-context flash attention", r"~?([\d.]+)()x XLA", ("long_context", "value"), "flash x-vs-XLA"),
+    (r"Paged KV pool", r"~?([\d.]+)()x peak concurrent", ("serving_paged", "value"), "serving_paged x-concurrency"),
 ]
 
 MULT = {"": 1.0, "k": 1e3, "M": 1e6}

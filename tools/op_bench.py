@@ -218,6 +218,7 @@ def _configs():
     cfgs += _configs_optimizer()
     cfgs += _configs_flash_decode()
     cfgs += _configs_serving()
+    cfgs += _configs_paged_decode()
     return cfgs
 
 
@@ -1068,6 +1069,65 @@ def _configs_serving():
                                                     64)),
         ("serving_step_join_s8_L2048", step_join(8, 8, 2048, 64, 128)),
         ("serving_step_join_s32_L512", step_join(32, 8, 512, 64, 64)),
+    ]
+
+
+def _configs_paged_decode():
+    """Paged decode-attention rows: one query token per slot against
+    K/V reached THROUGH a [S, max_pages] int32 page table (the paged
+    serving pool's per-step kernel call), across page sizes, logical
+    cache lengths, and fp32 vs int8 pages (per-page scales dequantized
+    at read time). Times the dispatcher: on the committed-baseline CPU
+    backend that is the gather + XLA reference (the rows exist so the
+    TPU driver's refresh shows the scalar-prefetch kernel delta vs the
+    dense flash_decode rows above)."""
+
+    def direct(batch, heads, L, d, psz, kv_dtype, steps=30):
+        def bench():
+            import jax
+            import jax.numpy as jnp
+
+            from paddle_tpu.ops.attention import paged_decode_attention
+            from paddle_tpu.serving.paging import quantize_chunks
+
+            rs = np.random.RandomState(0)
+            mp = L // psz
+            n_pages = batch * mp
+            raw = jnp.asarray(
+                rs.randn(n_pages + 1, heads, psz, d).astype("f4"))
+            if kv_dtype == "int8":
+                pages, scales = quantize_chunks(raw, jnp.int8, True)
+            else:
+                pages, scales = raw, None
+            table = jnp.asarray(
+                rs.permutation(n_pages).astype("i4").reshape(batch, mp))
+            q = jnp.asarray(rs.randn(batch, heads, 1, d).astype("f4"))
+            length = jnp.asarray(
+                rs.randint(L // 4, L, (batch,)), jnp.int32)
+
+            fn = jax.jit(lambda q, kp, vp, t, n: paged_decode_attention(
+                q, kp, vp, scales, scales, t, n))
+            return _time_direct(
+                lambda: fn(q, pages, pages, table, length), steps)
+
+        bench._direct = True
+        return bench
+
+    return [
+        ("paged_decode_b8_L512_p16_f32", direct(8, 8, 512, 64, 16,
+                                                "f32")),
+        ("paged_decode_b8_L512_p16_int8", direct(8, 8, 512, 64, 16,
+                                                 "int8")),
+        ("paged_decode_b8_L2048_p16_f32", direct(8, 8, 2048, 64, 16,
+                                                 "f32")),
+        ("paged_decode_b8_L2048_p64_f32", direct(8, 8, 2048, 64, 64,
+                                                 "f32")),
+        ("paged_decode_b8_L2048_p64_int8", direct(8, 8, 2048, 64, 64,
+                                                  "int8")),
+        ("paged_decode_b8_L8192_p64_f32", direct(8, 8, 8192, 64, 64,
+                                                 "f32")),
+        ("paged_decode_b8_L8192_p64_int8", direct(8, 8, 8192, 64, 64,
+                                                  "int8")),
     ]
 
 
