@@ -1327,6 +1327,193 @@ def _serving_paged(n_requests=40, d_model=64, nhead=2, ffn=128,
                        "max_new_tokens": "4..24 ragged (mean ~14)"}}
 
 
+def _serving_sharded(n_requests=24, d_model=64, nhead=2, ffn=128,
+                     n_layers=2, vocab=128, mem_len=4, max_new=10,
+                     prompt_max=8, dense_slots=4, long_prompt=40,
+                     resident_new=48):
+    """Mesh-sharded serving A/B on the 8-virtual-device CPU mesh.
+
+    Part 1 — pool scaling at EQUAL per-device cache memory: the
+    single-chip engine gets `dense_slots` rows on one CPU device; the
+    sharded engine (dp=2 x fsdp=2 x tp=2) gets `2 * dense_slots` rows
+    sharded over dp — the same rows-per-device budget, with weights
+    laid out fsdp x tp in the bit-exact "gathered" layout. The bench
+    ASSERTS every request's tokens bit-match between the two pools.
+    CPU caveat: one host core executes all 8 virtual devices, so
+    tokens/s measures structure and overhead, not the memory-capacity
+    scaling a real pod sees (the pool and the weights it can hold DO
+    scale with the mesh; wall clock here cannot).
+
+    Part 2 — prefill/decode disaggregation under concurrent long-prompt
+    joins: 4 resident requests decode while a long-prompt (bucket-64)
+    request joins EVERY iteration. Inline prefill blocks each iteration
+    on the full prompt prefill; the disaggregated engine dispatches it
+    to the prefill dp slice and splices asynchronously. The metric is
+    the decode-step inter-arrival p50 (`step_gap_ms`) the residents
+    see between their tokens; the bench asserts the disaggregated
+    path's p50 is LOWER."""
+    import os
+
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    import jax
+
+    try:
+        cpus = jax.devices("cpu")
+    except Exception:
+        cpus = [d for d in jax.devices() if d.platform == "cpu"]
+    if len(cpus) < 8:
+        return {"metric": "serving_sharded",
+                "status": "skipped: needs 8 virtual cpu devices (run "
+                          "with XLA_FLAGS=--xla_force_host_platform_"
+                          "device_count=8 before jax initializes)"}
+
+    from paddle_tpu import nn
+    from paddle_tpu.nn.layer.transformer import (TransformerDecoder,
+                                                 TransformerDecoderLayer)
+    from paddle_tpu.parallel import init_mesh
+    from paddle_tpu.serving import (Request, Scheduler, ServingEngine,
+                                    ShardedServingEngine)
+
+    layer = TransformerDecoderLayer(d_model, nhead, ffn, dropout=0.0)
+    dec = TransformerDecoder(layer, n_layers)
+    dec.eval()
+    embed = nn.Embedding(vocab, d_model)
+    proj = nn.Linear(d_model, vocab)
+    rs = np.random.RandomState(0)
+    mesh = init_mesh(dp=2, fsdp=2, tp=2, devices=cpus[:8])
+
+    max_len = (1 << (prompt_max - 1).bit_length()) + max_new
+    work = []
+    for _ in range(n_requests):
+        P = int(rs.randint(1, prompt_max + 1))
+        p = rs.randint(2, vocab, (P,)).astype("i4")
+        p[0] = 0
+        work.append((p, rs.randn(mem_len, d_model).astype("f4")))
+
+    def drive(eng):
+        sched = Scheduler(max_queue=n_requests + 8)
+        reqs = []
+        t0 = time.perf_counter()
+        for p, m in work:
+            reqs.append(sched.submit(Request(
+                p.copy(), m, max_new_tokens=max_new, eos_id=1)))
+        eng.serve_until_idle(sched, max_iterations=20000)
+        wall = time.perf_counter() - t0
+        res = [r.result() for r in reqs]
+        assert all(r.ok for r in res)
+        ttft = np.asarray([r.ttft_s for r in res])
+        toks = sum(len(r.tokens) for r in res)
+        return res, ttft, toks, wall
+
+    with jax.default_device(cpus[0]):   # pin the 1-chip side to ONE
+        #                                 cpu device for a fair A/B
+        dense = ServingEngine(dec, embed, proj, num_slots=dense_slots,
+                              max_len=max_len, max_joins_per_iter=4)
+        d_res, d_ttft, d_toks, d_wall = drive(dense)
+
+    shard = ShardedServingEngine(dec, embed, proj, mesh=mesh,
+                                 num_slots=2 * dense_slots,
+                                 max_len=max_len, max_joins_per_iter=4)
+    s_res, s_ttft, s_toks, s_wall = drive(shard)
+
+    # the acceptance bit-match: fp32 gathered layout, per request
+    for a, b in zip(d_res, s_res):
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+
+    # ---- part 2: disaggregated vs inline prefill ----
+    LONG_MAXLEN = (1 << (long_prompt - 1).bit_length()) + 16
+    lp = rs.randint(2, vocab, (long_prompt,)).astype("i4")
+    lp[0] = 0
+    lmem = rs.randn(mem_len, d_model).astype("f4")
+    residents = []
+    for _ in range(4):
+        p = rs.randint(2, vocab, (2,)).astype("i4")
+        p[0] = 0
+        residents.append((p, rs.randn(mem_len, d_model).astype("f4")))
+
+    def measure(policy):
+        eng = ShardedServingEngine(dec, embed, proj, mesh=mesh,
+                                   num_slots=6, max_len=LONG_MAXLEN,
+                                   prefill=policy,
+                                   max_joins_per_iter=1)
+        sched = Scheduler(max_queue=512)
+        warm = []
+        for p, m in [(lp, lmem), residents[0]]:
+            r = Request(p.copy(), m, max_new_tokens=1, eos_id=None)
+            sched.submit(r)
+            warm.append(r)
+        eng.serve_until_idle(sched, max_iterations=200)
+        res = [Request(p.copy(), m, max_new_tokens=resident_new,
+                       eos_id=None) for p, m in residents]
+        for r in res:
+            sched.submit(r)
+        for _ in range(6):              # join the residents
+            eng.run_iteration(sched)
+        n0 = len(eng.metrics.step_gap_s._buf)
+        n_long = 0
+        it = 0
+        while any(r.state != "DONE" for r in res):
+            sched.submit(Request(lp.copy(), lmem, max_new_tokens=2,
+                                 eos_id=None))
+            n_long += 1
+            eng.run_iteration(sched)
+            it += 1
+            assert it < 1000
+        gaps = np.asarray(eng.metrics.step_gap_s._buf[n0:]) * 1e3
+        eng.abort_active("shutdown")
+        sched.abort_queued("shutdown")
+        sh = eng.metrics.snapshot()["sharding"]
+        return gaps, n_long, sh
+
+    inline_gaps, inline_longs, _ = measure("inline")
+    dis_gaps, dis_longs, dis_sh = measure("disaggregated")
+    inline_p50 = float(np.percentile(inline_gaps, 50))
+    dis_p50 = float(np.percentile(dis_gaps, 50))
+    # the acceptance: disaggregated prefill stops stealing decode-step
+    # latency from co-resident requests
+    assert dis_p50 < inline_p50, (dis_p50, inline_p50)
+
+    def pct(a, q):
+        return round(float(np.percentile(a, q)) * 1e3, 1)
+
+    return {"metric": "serving_sharded",
+            "value": round(inline_p50 / dis_p50, 2),
+            "unit": "x lower decode-step p50 with disaggregated "
+                    "prefill under concurrent long-prompt joins",
+            "bitmatch_single_chip": True,
+            "pool_scaling": {
+                "dense_1dev": {"slots": dense_slots,
+                               "tok_per_s": round(d_toks / d_wall, 1),
+                               "ttft_p50_ms": pct(d_ttft, 50),
+                               "wall_s": round(d_wall, 2)},
+                "sharded_8dev": {"slots": 2 * dense_slots,
+                                 "mesh": "dp2 x fsdp2 x tp2",
+                                 "tok_per_s": round(s_toks / s_wall,
+                                                    1),
+                                 "ttft_p50_ms": pct(s_ttft, 50),
+                                 "wall_s": round(s_wall, 2)},
+                "note": "equal rows-per-device; CPU mesh measures "
+                        "structure, not bandwidth"},
+            "disaggregation": {
+                "inline_step_gap_p50_ms": round(inline_p50, 2),
+                "disagg_step_gap_p50_ms": round(dis_p50, 2),
+                "inline_long_joins": inline_longs,
+                "disagg_long_joins": dis_longs,
+                "prefill_step_p50_ms":
+                    dis_sh["prefill_step_ms"].get("p50"),
+                "collective_time_share":
+                    dis_sh["collective_time_share"]},
+            "config": {"n_requests": n_requests, "d_model": d_model,
+                       "layers": n_layers, "max_new_tokens": max_new,
+                       "long_prompt_len": long_prompt,
+                       "layout": "gathered (bit-exact)"}}
+
+
 def _multichip_scaling(devices=None, sizes_mb=(4, 64), ar_iters=8,
                        dp_steps=6):
     """Config 4 harness: fleet collective allreduce bandwidth + DP weak
@@ -1458,6 +1645,7 @@ def main():
                ("decode_throughput", _decode_throughput),
                ("serving_throughput", _serving_throughput),
                ("serving_paged", _serving_paged),
+               ("serving_sharded", _serving_sharded),
                ("multichip_scaling", _multichip_scaling)]
     results = {}
     headline = None

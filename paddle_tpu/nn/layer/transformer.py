@@ -203,13 +203,18 @@ class MultiHeadAttention(Layer):
         return Tensor._wrap(out), new_cache
 
     def gen_paged_cache(self, num_pages, page_size, num_slots,
-                        max_pages, dtype, kv_dtype=None):
+                        max_pages, dtype, kv_dtype=None,
+                        page_sharding=None):
         """Per-layer paged pool: zeroed [num_pages + 1, H, page_size,
         D] K/V page arrays (the +1 row is the trash page inactive
         slots' masked writes land on), per-page scales when kv_dtype
         is int8, an unmapped (trash-clipped) table and zero write
         indices. The serving engine owns the host-side PageAllocator /
-        page table; this just shapes the device state."""
+        page table; this just shapes the device state.
+        `page_sharding`: optional NamedSharding laying the page axis
+        out across the mesh (the sharded engine's data-parallel page
+        pool); page reads/writes stay pure selection, so placement
+        never changes the math."""
         import jax.numpy as jnp
 
         from ...serving import paging as PG
@@ -219,6 +224,12 @@ class MultiHeadAttention(Layer):
                          int(page_size), self.head_dim), storage)
         sc = jnp.zeros((int(num_pages) + 1, self.num_heads, 1, 1),
                        jnp.float32) if quantized else None
+        if page_sharding is not None:
+            import jax
+
+            buf = jax.device_put(buf, page_sharding)
+            if sc is not None:
+                sc = jax.device_put(sc, page_sharding)
         return PG.PagedKVCache(
             buf, buf, sc, sc,
             jnp.full((int(num_slots), int(max_pages)), int(num_pages),
@@ -243,14 +254,18 @@ class MultiHeadAttention(Layer):
         return cache._replace(k=kp, v=vp, k_scale=ks, v_scale=vs)
 
     @staticmethod
-    def static_kv_splice(cache, slot, k_new, v_new, n_written):
+    def static_kv_splice(cache, slot, k_new, v_new, n_written,
+                         constraint=None):
         """Slot JOIN for pooled serving caches: write a prefilled
         [1, H, P, D] K/V block into row `slot` of a pooled [S, H, L, D]
         StaticKVCache (P <= L) and set that row's write index to
         `n_written`, leaving every other slot's buffers and index
         untouched. `slot` and `n_written` are traced int32 scalars, so
         joining ANY slot at ANY admitted prompt length reuses one
-        compiled program — slot join never retraces."""
+        compiled program — slot join never retraces. `constraint`:
+        optional (kv_NamedSharding, index_NamedSharding) pinning the
+        spliced pool back onto its mesh layout (the sharded engine's
+        slot-on-data carry contract)."""
         import jax
         import jax.numpy as jnp
 
@@ -263,28 +278,42 @@ class MultiHeadAttention(Layer):
         index = jax.lax.dynamic_update_slice(
             cache.index,
             jnp.asarray(n_written, jnp.int32).reshape(1), (slot,))
+        if constraint is not None:
+            kv_ns, idx_ns = constraint
+            k = jax.lax.with_sharding_constraint(k, kv_ns)
+            v = jax.lax.with_sharding_constraint(v, kv_ns)
+            index = jax.lax.with_sharding_constraint(index, idx_ns)
         return MultiHeadAttention.StaticKVCache(k, v, index)
 
     @staticmethod
-    def splice_rows(buf, slot, rows):
+    def splice_rows(buf, slot, rows, constraint=None):
         """Row splice for any pooled per-slot buffer ([S, ...]): write
         `rows` ([1, ...], trailing dims <= buf's) at row `slot` (traced
         int32). Used for the serving pool's cross-attention StaticCache
-        K/V, pad-bias rows, and memory rows on slot join."""
+        K/V, pad-bias rows, and memory rows on slot join. `constraint`:
+        optional NamedSharding pinned on the result."""
         import jax
         import jax.numpy as jnp
 
         z = jnp.int32(0)
         start = (jnp.asarray(slot, jnp.int32),) + (z,) * (buf.ndim - 1)
-        return jax.lax.dynamic_update_slice(
+        out = jax.lax.dynamic_update_slice(
             buf, rows.astype(buf.dtype), start)
+        if constraint is not None:
+            out = jax.lax.with_sharding_constraint(out, constraint)
+        return out
 
     def gen_cache(self, key, value=None, type=None, max_length=None,
-                  batch_size=None, dtype=None):
+                  batch_size=None, dtype=None, kv_sharding=None,
+                  index_sharding=None):
         """Cache constructors. type=StaticCache precomputes K/V from
         `key` (cross-attention). max_length=N preallocates a
         StaticKVCache of [B, H, N, D] zero buffers + a zero write index
-        — the decode-engine carry; B/dtype default to key's."""
+        — the decode-engine carry; B/dtype default to key's.
+        `kv_sharding`/`index_sharding`: optional NamedShardings placing
+        the pooled buffers straight onto a mesh (slot axis
+        data-parallel in the sharded serving engine) instead of a
+        single device."""
         if max_length is not None:
             import jax.numpy as jnp
 
@@ -294,8 +323,14 @@ class MultiHeadAttention(Layer):
             buf = jnp.zeros(
                 (int(b), self.num_heads, int(max_length), self.head_dim),
                 dtype)
-            return self.StaticKVCache(buf, buf,
-                                      jnp.zeros((int(b),), jnp.int32))
+            idx = jnp.zeros((int(b),), jnp.int32)
+            if kv_sharding is not None:
+                import jax
+
+                buf = jax.device_put(buf, kv_sharding)
+                if index_sharding is not None:
+                    idx = jax.device_put(idx, index_sharding)
+            return self.StaticKVCache(buf, buf, idx)
         if type == MultiHeadAttention.StaticCache:
             k = self._split_heads(self.k_proj(key))
             v = self._split_heads(self.v_proj(value if value is not None

@@ -38,6 +38,7 @@ densely. Interpret-mode CPU parity mirrors the training kernels.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
 import os
@@ -1209,6 +1210,41 @@ def sdpa_bshd(q, k, v, mask=None, is_causal=False, scale=None,
 # decode-mode attention: one query token against a static KV cache
 # --------------------------------------------------------------------------
 
+#: active decode-sharding annotation (trace-scoped): {"q"/"kv"/"out":
+#: jax.sharding.NamedSharding}. The sharded serving engine wraps its
+#: step/join traces in `decode_shardings(...)` so the UNCHANGED decode
+#: kernels get `with_sharding_constraint` pinned on their operands —
+#: the TPP/TVM shape of the win: the hot kernel stays put while the
+#: layout/distribution layer moves around it.
+_DECODE_SPECS = [None]
+
+
+@contextlib.contextmanager
+def decode_shardings(specs):
+    """Scope a {'q': NamedSharding, 'kv': ..., 'out': ...} annotation
+    over a jit trace; every `decode_attention` /
+    `paged_decode_attention` call traced inside constrains its operands
+    and output accordingly. No-op (and zero-cost) when unset."""
+    prev = _DECODE_SPECS[0]
+    _DECODE_SPECS[0] = dict(specs) if specs else None
+    try:
+        yield
+    finally:
+        _DECODE_SPECS[0] = prev
+
+
+def _constrain_decode(x, what):
+    specs = _DECODE_SPECS[0]
+    if specs is None or x is None:
+        return x
+    ns = specs.get(what)
+    if ns is None:
+        return x
+    import jax
+
+    return jax.lax.with_sharding_constraint(x, ns)
+
+
 def decode_attention_reference(q, k, v, length, bias=None, scale=None):
     """XLA reference for single-token decode attention against a
     preallocated cache. q [b, h, 1, d]; k/v [b, h, L, d] where L is the
@@ -1376,17 +1412,22 @@ def decode_attention(q, k, v, length, bias=None, scale=None, split_k=None,
     composition everywhere else. Same gate style as sdpa: any kernel
     failure falls back rather than poisoning a decode loop."""
     L = k.shape[2]
+    q = _constrain_decode(q, "q")
+    k = _constrain_decode(k, "kv")
+    v = _constrain_decode(v, "kv")
     use_kernel = interpret or (
         _on_tpu() and q.shape[-1] <= 256 and L >= 256 and L % 128 == 0
         and _flash_usable())
     if use_kernel:
         try:
-            return flash_decode(q, k, v, length, bias, scale, split_k,
-                                interpret)
+            return _constrain_decode(
+                flash_decode(q, k, v, length, bias, scale, split_k,
+                             interpret), "out")
         except Exception:
             if interpret:
                 raise
-    return decode_attention_reference(q, k, v, length, bias, scale)
+    return _constrain_decode(
+        decode_attention_reference(q, k, v, length, bias, scale), "out")
 
 
 # --------------------------------------------------------------------------
@@ -1556,20 +1597,26 @@ def paged_decode_attention(q, k_pages, v_pages, k_scale, v_scale, table,
     the dense StaticKVCache bit-for-bit, which is what makes paged
     serving bit-identical to the dense pool on the fallback path."""
     psz = k_pages.shape[2]
+    q = _constrain_decode(q, "q")
+    k_pages = _constrain_decode(k_pages, "pages")
+    v_pages = _constrain_decode(v_pages, "pages")
     use_kernel = interpret or (
         _on_tpu() and q.shape[-1] <= 256 and psz % 8 == 0
         and _flash_usable())
     if use_kernel:
         try:
-            return paged_flash_decode(q, k_pages, v_pages, k_scale,
-                                      v_scale, table, length, bias,
-                                      scale, interpret)
+            return _constrain_decode(
+                paged_flash_decode(q, k_pages, v_pages, k_scale,
+                                   v_scale, table, length, bias,
+                                   scale, interpret), "out")
         except Exception:
             if interpret:
                 raise
     kd = paged_gather_kv(k_pages, k_scale, table, q.dtype)
     vd = paged_gather_kv(v_pages, v_scale, table, q.dtype)
-    return decode_attention_reference(q, kd, vd, length, bias, scale)
+    return _constrain_decode(
+        decode_attention_reference(q, kd, vd, length, bias, scale),
+        "out")
 
 
 def sdpa(q, k, v, mask=None, is_causal=False, scale=None,

@@ -18,7 +18,8 @@ from .mesh import (DeviceMesh, auto_mesh, get_mesh, init_mesh,  # noqa: F401
                    mesh_axis_size)
 from .functional import functionalize, FunctionalModule  # noqa: F401
 from .sharding import (ShardingRules, batch_sharding,  # noqa: F401
-                       infer_param_specs, named_sharding, COMMON_TP_RULES)
+                       infer_param_specs, named_sharding, COMMON_TP_RULES,
+                       serving_param_rules)
 from .spmd import SpmdTrainer, spmd_data_parallel  # noqa: F401
 from .ring import ring_attention  # noqa: F401
 from .pipeline import pipeline_spmd_fn  # noqa: F401

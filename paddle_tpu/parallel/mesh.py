@@ -14,7 +14,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-_CANONICAL = ("dp", "pp", "tp", "sp", "ep")
+_CANONICAL = ("dp", "fsdp", "pp", "tp", "sp", "ep")
 
 _current: list = [None]
 
@@ -30,9 +30,38 @@ class DeviceMesh:
     def shape(self):
         return dict(self.mesh.shape)
 
+    @property
+    def devices(self):
+        """The mesh's device ndarray (axis order = axis_names)."""
+        return self.mesh.devices
+
     def axis_size(self, name: str) -> int:
         return self.mesh.shape.get(name, 1) if name in self.mesh.axis_names \
             else 1
+
+    def slice_axis(self, name: str, start, stop) -> "DeviceMesh":
+        """Sub-mesh over a contiguous [start, stop) slab of one axis —
+        the prefill/decode disaggregation split: the serving engine
+        carves the dp axis into a decode slice and a prefill slice, so
+        prompt prefill executes on devices the decode step never
+        touches. The returned mesh keeps every axis name (the sliced
+        axis shrinks to stop - start) so one ShardingRules table serves
+        both slices."""
+        from jax.sharding import Mesh
+
+        if name not in self.mesh.axis_names:
+            raise ValueError(f"mesh has no axis {name!r}: "
+                             f"{self.mesh.axis_names}")
+        ax = self.mesh.axis_names.index(name)
+        idx = [slice(None)] * len(self.mesh.axis_names)
+        idx[ax] = slice(int(start), int(stop))
+        sub = self.mesh.devices[tuple(idx)]
+        if sub.size == 0:
+            raise ValueError(
+                f"empty {name!r} slice [{start}, {stop}) of axis size "
+                f"{self.mesh.shape[name]}")
+        return DeviceMesh(Mesh(sub, self.mesh.axis_names),
+                          self.axis_names)
 
     def __enter__(self):
         self.mesh.__enter__()
@@ -46,16 +75,24 @@ class DeviceMesh:
 
 
 def init_mesh(dp: int = 1, pp: int = 1, tp: int = 1, sp: int = 1,
-              ep: int = 1, devices=None) -> DeviceMesh:
+              ep: int = 1, fsdp: Optional[int] = None,
+              devices=None) -> DeviceMesh:
     """Build and install the global mesh. Axis sizes must multiply to the
     device count. Axes of size 1 are kept (named collectives over them are
-    no-op-cheap and keep user programs shape-stable across topologies)."""
+    no-op-cheap and keep user programs shape-stable across topologies).
+    The `fsdp` axis (weight-storage sharding between dp and pp — the
+    serving engines' data x fsdp x tp layout) joins the mesh only when
+    explicitly requested, so dp/pp/tp-only programs keep their shape."""
     import jax
     from jax.sharding import Mesh
 
     devices = list(jax.devices()) if devices is None else list(devices)
     sizes = collections.OrderedDict(
         [("dp", dp), ("pp", pp), ("tp", tp), ("sp", sp), ("ep", ep)])
+    if fsdp is not None:
+        sizes = collections.OrderedDict(
+            [("dp", dp), ("fsdp", fsdp), ("pp", pp), ("tp", tp),
+             ("sp", sp), ("ep", ep)])
     total = int(np.prod(list(sizes.values())))
     if total != len(devices):
         raise ValueError(

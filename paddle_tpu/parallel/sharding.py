@@ -61,6 +61,54 @@ COMMON_TP_RULES = ShardingRules([
 ])
 
 
+def serving_param_rules(layout: str = "gathered") -> ShardingRules:
+    """Weight-layout rules for the sharded serving engines' step net
+    (`decoder.layers.N.{self_attn,cross_attn}.*_proj` / `linear1/2`,
+    `embed.weight`, `project.weight` — text/generation._StepNet names).
+
+    Two layouts over the data x fsdp x tp mesh:
+
+    * ``"gathered"`` (default) — every large weight shards its
+      OUTPUT-feature dim (vocab dim for embeddings) jointly over
+      (fsdp, tp); no weight is split along a contraction dim, so the
+      SPMD partitioner materializes results by concatenation
+      (all-gather), never by partial-sum psum — float reduction order
+      is untouched and the sharded decode step stays BIT-IDENTICAL to
+      the single-chip engine. This is FSDP semantics: storage scales
+      with fsdp*tp, compute gathers per layer.
+    * ``"megatron"`` — the canonical TP layout (SNIPPETS [1] /
+      scaling-book): qkv + ffn-in shard (fsdp-rows, tp-cols), attn-out
+      + ffn-out shard (tp-rows, fsdp-cols). Contraction dims are split,
+      so matmuls finish with a psum over tp/fsdp — numerically
+      equivalent but NOT bit-identical (reduction order moves); use it
+      where tp bandwidth wins beat the bit-exactness contract.
+    """
+    if layout == "gathered":
+        joint = (None, ("fsdp", "tp"))
+        return ShardingRules([
+            (r"(q|k|v)_proj\.weight$", joint),
+            (r"out_proj\.weight$", joint),
+            (r"linear[12]\.weight$", joint),
+            (r"(^|\.)embed\.weight$", (("fsdp", "tp"), None)),
+            (r"word_embeddings\.weight$", (("fsdp", "tp"), None)),
+            (r"(^|\.)project\.weight$", joint),
+        ])
+    if layout == "megatron":
+        return ShardingRules([
+            (r"(q|k|v)_proj\.weight$", ("fsdp", "tp")),
+            (r"(q|k|v)_proj\.bias$", ("tp",)),
+            (r"out_proj\.weight$", ("tp", "fsdp")),
+            (r"linear1\.weight$", ("fsdp", "tp")),
+            (r"linear1\.bias$", ("tp",)),
+            (r"linear2\.weight$", ("tp", "fsdp")),
+            (r"(^|\.)embed\.weight$", (("fsdp", "tp"), None)),
+            (r"word_embeddings\.weight$", (("fsdp", "tp"), None)),
+            (r"(^|\.)project\.weight$", ("fsdp", "tp")),
+        ])
+    raise ValueError(f"unknown serving weight layout {layout!r} "
+                     f"(want 'gathered' or 'megatron')")
+
+
 def infer_param_specs(params: Dict[str, object],
                       rules: Optional[ShardingRules]) -> Dict[str, object]:
     """name→PartitionSpec for a flat {name: array} param tree."""
@@ -93,6 +141,38 @@ def named_sharding(spec, mesh: Optional[DeviceMesh] = None):
 
     spec = P(*[clean(e) for e in spec])
     return jax.sharding.NamedSharding(m, spec)
+
+
+def fitted_sharding(shape, spec, mesh: Optional[DeviceMesh] = None):
+    """`named_sharding`, but pruned against a concrete array shape:
+    any spec axis whose mesh extent does not divide the dimension is
+    dropped (largest dividing prefix of a joint (a, b) entry wins), so
+    "shard where divisible, replicate otherwise" — jax.device_put
+    rejects uneven layouts, and a 17-row toy vocab must not force the
+    whole table onto one chip policy-wise, just fall back for that
+    dim."""
+    m = mesh or get_mesh()
+
+    def fit(entry, dim):
+        if entry is None:
+            return None
+        names = list(entry) if isinstance(entry, (tuple, list)) \
+            else [entry]
+        names = [n for n in names if m.axis_size(n) > 0]
+        while names:
+            total = 1
+            for n in names:
+                total *= m.axis_size(n)
+            if total and dim % total == 0:
+                break
+            names.pop()          # drop the innermost axis, retry
+        if not names:
+            return None
+        return names[0] if len(names) == 1 else tuple(names)
+
+    spec = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
+    return named_sharding(
+        tuple(fit(e, d) for e, d in zip(spec, shape)), m)
 
 
 def batch_sharding(mesh: Optional[DeviceMesh] = None, axes=("dp",),

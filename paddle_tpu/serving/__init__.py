@@ -35,9 +35,11 @@ from .metrics import CallbackList, ServingCallback, ServingMetrics
 from .paging import OutOfPages, PageAllocator, PagedKVCache, PrefixCache
 from .scheduler import QueueFull, Request, RequestResult, Scheduler
 from .server import ServerCrashed, ServingServer
+from .sharded import ShardedPagedServingEngine, ShardedServingEngine
 
 __all__ = [
     "ServingEngine", "PagedServingEngine", "ArtifactServingEngine",
+    "ShardedServingEngine", "ShardedPagedServingEngine",
     "ServingServer", "Scheduler", "Request", "RequestResult",
     "QueueFull", "ServingMetrics", "ServingCallback", "CallbackList",
     "WatchdogTimeout", "ServerCrashed", "OutOfPages", "PageAllocator",

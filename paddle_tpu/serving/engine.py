@@ -54,6 +54,33 @@ _PT_PREFILL = faults.point("serving.prefill")
 _PT_DECODE = faults.point("serving.decode_step")
 
 
+def _reject_sharded_params(params, engine_name):
+    """Fail FAST (and loudly) when a single-chip engine is handed
+    mesh-sharded weights. A weight committed across several devices
+    would make the jitted join/step programs run SPMD over the whole
+    mesh with a single-device pool layout — at best slow, at worst a
+    silently different reduction order than the engine's bit-match
+    contract. The sharded pool engine exists for exactly this."""
+    for name, v in params.items():
+        sh = getattr(v, "sharding", None)
+        if sh is None:
+            continue
+        try:
+            multi = len(sh.device_set) > 1
+            replicated = bool(getattr(sh, "is_fully_replicated", False))
+        except Exception:
+            continue
+        if multi and not replicated:
+            raise ValueError(
+                f"{engine_name} was handed mesh-sharded weights "
+                f"(param {name!r} is laid out across "
+                f"{len(sh.device_set)} devices: {sh}); the single-chip "
+                f"slot pool cannot serve them. Use "
+                f"paddle_tpu.serving.sharded.ShardedServingEngine, "
+                f"which lays weights out tp/fsdp and shards the slot "
+                f"pool data-parallel over the same mesh.")
+
+
 class WatchdogTimeout(TimeoutError):
     """An engine operation completed but blew its `watchdog_s` wall
     budget — treated as a failure (retried with backoff, then failed
@@ -91,6 +118,12 @@ class _EngineBase:
             on_error=lambda hook, e: self.metrics.record_error(
                 f"callback.{hook}", e))
         self.slots = [None] * self.num_slots   # Request | None
+        # slots whose request holds the slot but whose pool state is
+        # not spliced yet (a disaggregated prefill still in flight on
+        # the prefill mesh slice): occupied for admission, EXCLUDED
+        # from the decode-step active mask until _poll_pending splices
+        self._pending = set()
+        self._last_step_done = None   # decode-step inter-arrival clock
         self.trace_counts = collections.Counter()
         # failure-isolation knobs: every join/decode runs under a
         # capped-exponential retry loop and an optional wall watchdog
@@ -136,6 +169,20 @@ class _EngineBase:
     def _reset_pool(self):
         """Rebuild device pool state after a decode-step failure (all
         in-flight requests have been evicted)."""
+
+    def _poll_pending(self, now):
+        """Advance asynchronous joins (the sharded engine's
+        disaggregated prefill): splice any prefill whose arrays are
+        ready into the pool and activate the slot. Returns True when
+        any slot was activated. Default engines join synchronously —
+        no-op."""
+        return False
+
+    def _choose_slot(self, free):
+        """Pick the slot a new request joins into. The sharded engine
+        overrides this to balance occupancy across the dp shards of
+        the slot axis."""
+        return free[0]
 
     # ---- watchdog + retry/backoff ----
     def _guarded(self, opname, fn):
@@ -244,6 +291,10 @@ class _EngineBase:
             elif r.expired(now):
                 self._finish_slot(s, "timeout", now)
                 progress = True
+        # 1b. asynchronous joins: splice finished disaggregated
+        # prefills into the pool (no-op for synchronous engines)
+        if self._poll_pending(now):
+            progress = True
         # 2. admission: refill free slots, bounded per iteration
 
         def _queue_death(req):   # cancelled/expired while QUEUED
@@ -273,7 +324,7 @@ class _EngineBase:
                 # this iteration, decode keeps draining the pool
                 scheduler.push_front(r)
                 break
-            s = free[0]
+            s = self._choose_slot(free)
             r.state, r.slot = "RUNNING", s
             self.slots[s] = r
             try:
@@ -299,8 +350,11 @@ class _EngineBase:
             self._cbs.emit("on_join", r, s)
             if tok is not None:   # prefill already produced token 0
                 self._deliver(r, int(tok), self.clock())
-        # 3. one batched decode step over the active mask
-        active = np.asarray([r is not None for r in self.slots], bool)
+        # 3. one batched decode step over the active mask (slots with a
+        # disaggregated prefill still in flight stay masked out)
+        active = np.asarray(
+            [r is not None and s not in self._pending
+             for s, r in enumerate(self.slots)], bool)
         if active.any():
             t0 = self.clock()
             try:
@@ -314,11 +368,20 @@ class _EngineBase:
                 now2 = self.clock()
                 n = 0
                 for s, r in enumerate(list(self.slots)):
-                    if r is not None:
+                    if r is not None and active[s]:
                         self._deliver(r, int(toks[s]), now2)
                         n += 1
                 self.metrics.record_decode(n, now2 - t0)
+                # decode-step inter-arrival: the latency co-resident
+                # requests actually SEE between their tokens — inline
+                # prefill inflates it, disaggregated prefill doesn't
+                if self._last_step_done is not None:
+                    self.metrics.record_step_gap(
+                        now2 - self._last_step_done)
+                self._last_step_done = now2
                 progress = True
+        else:
+            self._last_step_done = None
         self.metrics.record_iteration(
             scheduler.depth(), self.occupancy() / self.num_slots,
             **(self._iteration_gauges() or {}))
@@ -385,6 +448,11 @@ class ServingEngine(_EngineBase):
         self.max_len = int(max_len)
         self._net = _StepNet(decoder, embed, project)
         self._fm = functionalize(self._net)
+        if not getattr(self, "_accepts_sharded_params", False):
+            _reject_sharded_params(
+                self._fm.params(),
+                f"{type(self).__name__}"
+                f"{'(paged=True)' if paged else ''}")
         self._compiled = {}
         self._state = None          # lazily built on first join
         self._mem_shape = None
@@ -392,6 +460,14 @@ class ServingEngine(_EngineBase):
         self._pool_key = None
 
     # ------------------------------------------------------------------
+    def _params(self):
+        """Param pytree the compiled programs run over. The sharded
+        engine overrides this with its mesh-placed copy."""
+        return self._fm.params()
+
+    def _buffers(self):
+        return self._fm.buffers()
+
     def _max_len_detail(self):
         """Suffix for the max_len overflow message (the paged engine
         reports the page-granular limit here)."""
@@ -459,13 +535,22 @@ class ServingEngine(_EngineBase):
             fn = self._build_join(Pb)
             self._compiled[key] = fn
         self._state, tok0 = fn(
-            self._fm.params(), self._fm.buffers(), self._state,
+            self._params(), self._buffers(), self._state,
             jnp.int32(s), jnp.asarray(prompt_b),
             jnp.asarray([P0], jnp.int32),
             jnp.asarray(np.asarray(r.memory, self._np_dtype)[None]))
         return int(tok0)
 
     def _build_join(self, Pb):
+        import jax
+
+        return jax.jit(self._join_body(Pb))
+
+    def _join_body(self, Pb):
+        """The traceable join program (prefill + splice), separated
+        from its jit wrapper so the sharded engine can wrap the same
+        body in sharding annotations before jitting — one source of
+        truth for the math, one trace_counts key either way."""
         import jax
         import jax.numpy as jnp
 
@@ -515,7 +600,7 @@ class ServingEngine(_EngineBase):
             }
             return new_state, tok0
 
-        return jax.jit(join_fn)
+        return join_fn
 
     def _reset_pool(self):
         # dropped wholesale: the next join's _ensure_state rebuilds a
@@ -585,12 +670,16 @@ class ServingEngine(_EngineBase):
         if fn is None:
             fn = self._build_step(key)
             self._compiled[key] = fn
-        self._state, toks = fn(self._fm.params(), self._fm.buffers(),
+        self._state, toks = fn(self._params(), self._buffers(),
                                self._state, jnp.asarray(active))
         return np.asarray(toks)
 
     def _build_step(self, key):
         import jax
+
+        return jax.jit(self._step_body(key))
+
+    def _step_body(self, key):
         import jax.numpy as jnp
 
         from ..nn.layer.transformer import MultiHeadAttention as MHA
@@ -617,7 +706,7 @@ class ServingEngine(_EngineBase):
                 for c, old in zip(inc2, inc)]
             return dict(state, tok=nxt, inc=inc2), nxt
 
-        return jax.jit(step_fn)
+        return step_fn
 
 
 def _make_cross_kv_fm(decoder):
@@ -710,6 +799,11 @@ class PagedServingEngine(ServingEngine):
         self.prefill_count = 0   # real prefills run (prefix hits skip)
 
     # ------------------------------------------------------------------
+    def _cross_params(self):
+        """Cross-attention K/V net params for the prefix-attach path
+        (the sharded engine overrides with its mesh-placed copy)."""
+        return self._fm_cross.params()
+
     def _max_len_detail(self):
         return (f" (= {self.max_pages} pages x {self.page_size} "
                 f"tokens, paged)")
@@ -926,7 +1020,7 @@ class PagedServingEngine(ServingEngine):
             self._compiled[ck] = fn
         try:
             self._state, tok0 = fn(
-                self._fm.params(), self._fm.buffers(), self._state,
+                self._params(), self._buffers(), self._state,
                 jnp.int32(s), jnp.asarray(prompt_b),
                 jnp.asarray([P0], jnp.int32),
                 jnp.asarray(np.asarray(r.memory, self._np_dtype)[None]),
@@ -962,7 +1056,7 @@ class PagedServingEngine(ServingEngine):
             self._compiled[ck] = fn
         try:
             self._state = fn(
-                self._fm_cross.params(), self._fm_cross.buffers(),
+                self._cross_params(), self._fm_cross.buffers(),
                 self._state, jnp.int32(s), jnp.int32(hit["tok0"]),
                 jnp.asarray([P0], jnp.int32), jnp.int32(Pb),
                 jnp.asarray(np.asarray(r.memory, self._np_dtype)[None]))
@@ -1005,6 +1099,11 @@ class PagedServingEngine(ServingEngine):
 
     # ---- compiled programs ----
     def _build_paged_join(self, Pb):
+        import jax
+
+        return jax.jit(self._paged_join_body(Pb))
+
+    def _paged_join_body(self, Pb):
         import jax
         import jax.numpy as jnp
 
@@ -1059,9 +1158,14 @@ class PagedServingEngine(ServingEngine):
             }
             return new_state, tok0
 
-        return jax.jit(join_fn)
+        return join_fn
 
     def _build_attach(self):
+        import jax
+
+        return jax.jit(self._attach_body())
+
+    def _attach_body(self):
         import jax
         import jax.numpy as jnp
 
@@ -1096,11 +1200,14 @@ class PagedServingEngine(ServingEngine):
                 mem=MHA.splice_rows(state["mem"], slot, memory),
                 static=new_static)
 
-        return jax.jit(attach_fn)
+        return attach_fn
 
     def _build_cow(self):
         import jax
 
+        return jax.jit(self._cow_body())
+
+    def _cow_body(self):
         from . import paging as PG
 
         ck = ("cow",)
@@ -1114,7 +1221,7 @@ class PagedServingEngine(ServingEngine):
                 new_paged.append({"k": k, "v": v, "ks": ks, "vs": vs})
             return dict(state, paged=new_paged)
 
-        return jax.jit(cow_fn)
+        return cow_fn
 
     # ---- decode: on-demand page mapping + one batched step ----
     def _evict_oom(self, s, exc, now):
@@ -1152,7 +1259,7 @@ class PagedServingEngine(ServingEngine):
             fn = self._build_paged_step(ck)
             self._compiled[ck] = fn
         self._state, toks = fn(
-            self._fm.params(), self._fm.buffers(), self._state,
+            self._params(), self._buffers(), self._state,
             self._device_table(),
             jnp.asarray(self._index.astype(np.int32)),
             jnp.asarray(active))
@@ -1161,6 +1268,10 @@ class PagedServingEngine(ServingEngine):
 
     def _build_paged_step(self, ck):
         import jax
+
+        return jax.jit(self._paged_step_body(ck))
+
+    def _paged_step_body(self, ck):
         import jax.numpy as jnp
 
         from . import paging as PG
@@ -1184,7 +1295,7 @@ class PagedServingEngine(ServingEngine):
                           "vs": c.v_scale} for c in inc2]
             return dict(state, tok=nxt, paged=new_paged), nxt
 
-        return jax.jit(step_fn)
+        return step_fn
 
 
 class ArtifactServingEngine(_EngineBase):

@@ -90,6 +90,22 @@ class ServingMetrics:
         self.page_waits = 0         # admissions deferred on page headroom
         self.oom_evictions = 0      # mid-decode OutOfPages victims
         self.bytes_per_token = _Reservoir(512)  # bytes / active token
+        # sharded-serving accounting (the snapshot grows a "sharding"
+        # section once any of these record — single-chip pools don't
+        # pay for keys they never touch). Phases follow the
+        # prefill/decode disaggregation split: "prefill" latencies are
+        # the prefill-slice step (dispatch -> arrays ready), "decode"
+        # rides the existing decode reservoirs; step_gap_s is the
+        # decode-step INTER-ARRIVAL co-resident requests see between
+        # tokens — the number inline prefill inflates and a
+        # disaggregated prefill slice does not.
+        self._sharded = False
+        self.prefill_step_s = _Reservoir()
+        self.step_gap_s = _Reservoir()
+        self.collective_s = 0.0     # cross-slice transfers (prefill ->
+        #                             decode splices, param placement)
+        self.collective_events = 0
+        self.shard_occupancy = None  # last-iteration per-dp-shard list
 
     # ---- recording (engine / frontend side) ----
     def record_submit(self):
@@ -177,8 +193,35 @@ class ServingMetrics:
         with self._lock:
             self.oom_evictions += n
 
+    # ---- sharded-serving accounting ----
+    def record_step_gap(self, dt_s):
+        """Wall time between two consecutive decode-step completions
+        while the pool stayed active: per-token latency as co-resident
+        requests experience it, join/prefill stalls included."""
+        with self._lock:
+            self.step_gap_s.add(dt_s)
+
+    def record_prefill_step(self, dt_s):
+        """One prefill-slice step completed (disaggregated: dispatch ->
+        arrays ready, polled at iteration granularity; inline: the
+        blocking join call)."""
+        with self._lock:
+            self._sharded = True
+            self.prefill_step_s.add(dt_s)
+
+    def record_collective(self, dt_s):
+        """Host-timed cross-slice communication: a prefill-slice ->
+        decode-slice K/V transfer (or a param re-placement). In-program
+        collectives are XLA's to schedule and are not visible here;
+        this tracks the traffic the ENGINE moves between mesh slices."""
+        with self._lock:
+            self._sharded = True
+            self.collective_s += float(dt_s)
+            self.collective_events += 1
+
     def record_iteration(self, queue_depth, occupancy, pages_in_use=None,
-                         pages_free=None, bytes_per_active_token=None):
+                         pages_free=None, bytes_per_active_token=None,
+                         shard_occupancy=None):
         with self._lock:
             self.iterations += 1
             self.queue_depth.add(queue_depth)
@@ -189,6 +232,10 @@ class ServingMetrics:
                 self.pages_free = int(pages_free)
             if bytes_per_active_token is not None:
                 self.bytes_per_token.add(bytes_per_active_token)
+            if shard_occupancy is not None:
+                self._sharded = True
+                self.shard_occupancy = [round(float(x), 3)
+                                        for x in shard_occupancy]
 
     # ---- reading ----
     def snapshot(self):
@@ -217,6 +264,22 @@ class ServingMetrics:
                 "per_token_ms": self.token_latency_s.summary(scale=1e3),
                 "queue_depth": self.queue_depth.summary(digits=2),
                 "slot_occupancy": self.occupancy.summary(digits=3),
+                **({} if not self._sharded else {"sharding": {
+                    # prefill-slice vs decode-slice step latency: the
+                    # disaggregation split's two phases side by side
+                    "prefill_step_ms":
+                        self.prefill_step_s.summary(scale=1e3),
+                    "decode_step_ms":
+                        self.token_latency_s.summary(scale=1e3),
+                    "step_gap_ms": self.step_gap_s.summary(scale=1e3),
+                    "per_shard_occupancy": self.shard_occupancy,
+                    "collective_ms": round(self.collective_s * 1e3, 3),
+                    "collective_events": self.collective_events,
+                    "collective_time_share": round(
+                        self.collective_s /
+                        max(1e-9, self.collective_s + self.decode_time_s
+                            + sum(self.prefill_step_s._buf)), 4),
+                }}),
                 **({} if self.pages_in_use is None else {"paging": {
                     "pages_in_use": self.pages_in_use,
                     "pages_free": self.pages_free,

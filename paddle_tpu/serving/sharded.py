@@ -1,0 +1,547 @@
+"""Mesh-sharded serving: tp/fsdp-parallel decode over the slot pool.
+
+The single-chip engines (engine.py) bound the servable model by one
+device's memory and pin the pool size to one chip. This module runs the
+SAME slot lifecycle over a `parallel.mesh` Mesh:
+
+  * **weights** lay out tp/fsdp via `parallel.sharding`'s
+    `serving_param_rules` — the default ``"gathered"`` layout shards
+    every large weight's output-feature dim (vocab dim for embeddings)
+    jointly over (fsdp, tp), so the SPMD partitioner materializes
+    activations by all-gather (concatenation), never by partial-sum
+    psum: float reduction order is untouched and every request's tokens
+    stay BIT-IDENTICAL to the single-chip engine. ``layout="megatron"``
+    flips to the canonical TP layout (contraction dims split, psum per
+    matmul) where interconnect bandwidth beats the bit-exact contract;
+  * **the slot pool** shards its slot axis data-parallel over ``dp``:
+    pooled `StaticKVCache` rows / `PagedKVCache` pages + scales,
+    per-row write indices, bias rows, memory rows, cross-attn K/V, and
+    the paged engine's table/index all carry `PartitionSpec("dp")`
+    leading dims, pinned with `with_sharding_constraint` on EVERY carry
+    of the decode step — the pool scales with the mesh;
+  * **the decode step stays ONE jitted per-pool-config call**: the
+    engine bodies are the single-chip ones (engine.py `_*_body`),
+    re-wrapped in sharding annotations (`ops.attention.decode_shardings`
+    spec-annotates the unchanged decode kernels) — joins, evictions and
+    page maps never retrace, proven by the same `trace_counts` keys;
+  * **prefill/decode disaggregation** (``prefill="disaggregated"``):
+    the dp axis is carved into a decode slice and a prefill slice
+    (`DeviceMesh.slice_axis`), prompt prefill runs asynchronously on
+    the prefill slice's own weight copy, and the finished K/V is
+    spliced into the live pool (`static_kv_splice`/`splice_rows` with
+    the pool constraints) once its arrays are ready — a long-prompt
+    join no longer blocks the decode step, which shows up directly in
+    the `step_gap_ms` (decode-step inter-arrival) metric the
+    `serving_sharded` bench A/Bs.
+
+Numerics contract (fp32, ``layout="gathered"``): every request's token
+stream bit-matches both the single-chip `ServingEngine` and a solo
+`generate_eager` run — tests/test_serving_sharded.py soaks it on the
+8-device CPU mesh with ragged arrivals, chaos cells, and the
+single-trace-per-bucket proof.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..core.bucketing import pad_prompt_row
+from ..testing import faults
+from .engine import PagedServingEngine, ServingEngine, _PT_PREFILL
+
+__all__ = ["ShardedServingEngine", "ShardedPagedServingEngine"]
+
+#: fault point for the disaggregated splice (prefill-slice K/V landing
+#: in the live pool) — chaos tests pin per-request isolation on it
+_PT_SPLICE = faults.point("serving.prefill_splice")
+
+
+class ShardedServingEngine(ServingEngine):
+    """`ServingEngine` over a device mesh. Use exactly like the
+    single-chip engine; extra knobs:
+
+      mesh       parallel.DeviceMesh (default: the installed global
+                 mesh). Needs a ``dp`` axis whose size divides
+                 `num_slots`; ``fsdp``/``tp`` axes engage weight
+                 sharding when present (absent axes are dropped from
+                 the rules, so the same engine runs on a dp-only mesh).
+      rules      parallel.ShardingRules for the step-net weights
+                 (default: `serving_param_rules(layout)`).
+      layout     "gathered" (bit-exact, default) | "megatron".
+      prefill    "inline" (joins block, single-chip semantics) |
+                 "disaggregated" (prompt prefill runs on a dedicated
+                 dp slice with its own weight copy; joins splice in
+                 asynchronously).
+      prefill_dp how many dp rows the prefill slice takes (default 1).
+
+    `paged=True` routes to `ShardedPagedServingEngine` the same way
+    `ServingEngine(paged=True)` routes to the paged pool.
+
+    Weights are PLACED at construction: after updating the underlying
+    layers call `refresh_params()` to re-place them on the mesh.
+    """
+
+    _accepts_sharded_params = True
+
+    def __new__(cls, *args, **kw):
+        if cls is ShardedServingEngine and kw.get("paged"):
+            return object.__new__(ShardedPagedServingEngine)
+        return object.__new__(cls)
+
+    def __init__(self, decoder, embed, project, *, mesh=None, rules=None,
+                 layout="gathered", prefill="inline", prefill_dp=1,
+                 num_slots=8, max_len=128, **kw):
+        from ..parallel.mesh import get_mesh
+        from ..parallel.sharding import serving_param_rules
+
+        self._mesh = mesh if mesh is not None else get_mesh()
+        self._rules = rules if rules is not None \
+            else serving_param_rules(layout)
+        self.layout = layout
+        if prefill not in ("inline", "disaggregated"):
+            raise ValueError(
+                f"prefill policy must be 'inline' or 'disaggregated', "
+                f"got {prefill!r}")
+        self._prefill_policy = prefill
+        dp = self._mesh.axis_size("dp")
+        if prefill == "disaggregated":
+            prefill_dp = int(prefill_dp)
+            if dp < prefill_dp + 1:
+                raise ValueError(
+                    f"disaggregated prefill needs dp >= {prefill_dp + 1} "
+                    f"(a decode slice plus {prefill_dp} prefill row(s)); "
+                    f"mesh has dp={dp}")
+            self._decode_dm = self._mesh.slice_axis(
+                "dp", 0, dp - prefill_dp)
+            self._prefill_dm = self._mesh.slice_axis(
+                "dp", dp - prefill_dp, dp)
+        else:
+            self._decode_dm = self._mesh
+            self._prefill_dm = None
+        self._pool_dp = max(1, self._decode_dm.axis_size("dp"))
+        if int(num_slots) % self._pool_dp:
+            raise ValueError(
+                f"num_slots ({num_slots}) must be divisible by the "
+                f"decode slice's dp axis ({self._pool_dp}) — the slot "
+                f"pool shards over it")
+        self._pending_info = {}
+        super().__init__(decoder, embed, project, num_slots=num_slots,
+                         max_len=max_len, **kw)
+        self._build_shardings()
+        self._place_params()
+
+    # ------------------------------------------------------------------
+    # placement
+    # ------------------------------------------------------------------
+    def _build_shardings(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self._decode_dm.mesh
+        self._ns_pool = jax.sharding.NamedSharding(mesh, P("dp"))
+        self._ns_repl = jax.sharding.NamedSharding(mesh, P())
+
+    def _place_params(self):
+        """device_put the step-net weights onto the mesh per the layout
+        rules (and, when disaggregated, a second copy onto the prefill
+        slice). Timed into the collective budget — placement is the
+        engine-driven cross-device traffic operators should see."""
+        import jax
+
+        from ..parallel.sharding import fitted_sharding, infer_param_specs
+
+        t0 = time.monotonic()
+        params = self._fm.params()
+        specs = infer_param_specs(params, self._rules)
+        self._sparams = {
+            n: jax.device_put(v, fitted_sharding(v.shape, specs[n],
+                                                 self._decode_dm))
+            for n, v in params.items()}
+        self._sbuffers = {
+            n: jax.device_put(v, self._ns_repl)
+            for n, v in self._fm.buffers().items()}
+        if self._prefill_dm is not None:
+            import jax.sharding as jsh
+            from jax.sharding import PartitionSpec as P
+
+            self._pparams = {
+                n: jax.device_put(v, fitted_sharding(
+                    v.shape, specs[n], self._prefill_dm))
+                for n, v in params.items()}
+            self._pbuffers = {
+                n: jax.device_put(v, jsh.NamedSharding(
+                    self._prefill_dm.mesh, P()))
+                for n, v in self._fm.buffers().items()}
+        self.metrics.record_collective(time.monotonic() - t0)
+
+    def refresh_params(self):
+        """Re-place the (possibly updated) layer weights onto the mesh;
+        compiled programs are pure and stay cached."""
+        self._place_params()
+
+    def _params(self):
+        return self._sparams
+
+    def _buffers(self):
+        return self._sbuffers
+
+    # ------------------------------------------------------------------
+    # sharded compilation: same bodies, annotated
+    # ------------------------------------------------------------------
+    def _decode_specs(self):
+        return {"q": self._ns_pool, "kv": self._ns_pool,
+                "pages": self._ns_pool, "out": self._ns_pool}
+
+    def _constrain_state(self, state):
+        """Pin PartitionSpec('dp') on every pool carry (slot-leading
+        leaves; the paged page/scale arrays shard their page axis the
+        same way), replicating nothing implicitly — the ISSUE's
+        every-carry contract."""
+        import jax
+
+        from ..nn.layer.transformer import MultiHeadAttention as MHA
+
+        c = lambda x: jax.lax.with_sharding_constraint(  # noqa: E731
+            x, self._ns_pool)
+        out = dict(state)
+        for k in ("tok", "bias", "mem"):
+            if k in out:
+                out[k] = c(out[k])
+        if "inc" in out:
+            out["inc"] = [MHA.StaticKVCache(c(cc.k), c(cc.v),
+                                            c(cc.index))
+                          for cc in out["inc"]]
+        if "static" in out:
+            out["static"] = [(c(sk), c(sv)) for sk, sv in out["static"]]
+        if "paged" in out:
+            out["paged"] = [
+                {"k": c(pc["k"]), "v": c(pc["v"]),
+                 "ks": None if pc["ks"] is None else c(pc["ks"]),
+                 "vs": None if pc["vs"] is None else c(pc["vs"])}
+                for pc in out["paged"]]
+        return out
+
+    def _wrap_state_out(self, body, has_aux):
+        """jit a single-chip engine body with the sharded annotations:
+        decode kernels constrained via `decode_shardings`, every
+        returned carry pinned to the pool layout."""
+        import jax
+
+        from ..ops import attention as A
+
+        specs = self._decode_specs()
+
+        def fn(*args):
+            with A.decode_shardings(specs):
+                out = body(*args)
+            if has_aux:
+                st, aux = out
+                return self._constrain_state(st), aux
+            return self._constrain_state(out)
+
+        return jax.jit(fn)
+
+    def _build_join(self, Pb):
+        return self._wrap_state_out(self._join_body(Pb), True)
+
+    def _build_step(self, key):
+        return self._wrap_state_out(self._step_body(key), True)
+
+    # ------------------------------------------------------------------
+    # pool state placement
+    # ------------------------------------------------------------------
+    def _ensure_state(self, memory):
+        if self._state is not None:
+            return
+        super()._ensure_state(memory)
+        self._state = self._place_state(self._state)
+
+    def _place_state(self, state):
+        """Lay the freshly-built pool state out on the decode mesh:
+        slot-leading leaves shard over dp (the KV pool is REBUILT with
+        `gen_cache`'s sharded constructors so the zeros never
+        materialize on one device)."""
+        import jax
+
+        L, S = self.max_len, self.num_slots
+        dtype = state["mem"].dtype
+        decoder = self._net.decoder
+        out = dict(state)
+        for k in ("tok", "bias", "mem"):
+            out[k] = jax.device_put(state[k], self._ns_pool)
+        out["static"] = [
+            (jax.device_put(sk, self._ns_pool),
+             jax.device_put(sv, self._ns_pool))
+            for sk, sv in state["static"]]
+        if "inc" in state:
+            out["inc"] = [layer.self_attn.gen_cache(
+                None, max_length=L, batch_size=S, dtype=dtype,
+                kv_sharding=self._ns_pool,
+                index_sharding=self._ns_pool)
+                for layer in decoder.layers]
+        if "paged" in state:
+            # pad the page-row count to a dp multiple so the page axis
+            # lays out evenly; rows past the trash row (num_pages) are
+            # never referenced by any table entry — pure padding
+            rows = self.num_pages + 1
+            padded = -(-rows // self._pool_dp) * self._pool_dp
+            paged = []
+            for layer in decoder.layers:
+                cc = layer.self_attn.gen_paged_cache(
+                    padded - 1, self.page_size, S, self.max_pages,
+                    dtype, self.kv_dtype, page_sharding=self._ns_pool)
+                paged.append({"k": cc.k, "v": cc.v, "ks": cc.k_scale,
+                              "vs": cc.v_scale})
+            out["paged"] = paged
+        return out
+
+    # ------------------------------------------------------------------
+    # shard-aware slot policy + gauges
+    # ------------------------------------------------------------------
+    def _shard_of(self, s):
+        return s // (self.num_slots // self._pool_dp)
+
+    def _shard_occupancies(self):
+        per = self.num_slots // self._pool_dp
+        return [sum(self.slots[g * per + i] is not None
+                    for i in range(per)) / per
+                for g in range(self._pool_dp)]
+
+    def _choose_slot(self, free):
+        """Balance occupancy across the dp shards of the slot axis so
+        one mesh row never saturates while another idles."""
+        occ = self._shard_occupancies()
+        return min(free, key=lambda s: (occ[self._shard_of(s)], s))
+
+    def _iteration_gauges(self):
+        gauges = dict(super()._iteration_gauges() or {})
+        gauges["shard_occupancy"] = self._shard_occupancies()
+        return gauges
+
+    # ------------------------------------------------------------------
+    # disaggregated prefill: dispatch on the prefill slice, splice
+    # asynchronously into the live pool
+    # ------------------------------------------------------------------
+    def _join(self, s, r):
+        if self._prefill_dm is None:
+            return super()._join(s, r)
+        return self._dispatch_prefill(s, r)
+
+    def _dispatch_prefill(self, s, r):
+        import jax.numpy as jnp
+
+        _PT_PREFILL()
+        self._ensure_state(r.memory)
+        pad_id = int(r.eos_id) if r.eos_id is not None else 0
+        prompt_b, P0, Pb = pad_prompt_row(r.prompt, pad_id)
+        key = ("prefill", Pb)
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._build_prefill(Pb)
+            self._compiled[key] = fn
+        mem = np.asarray(r.memory, self._np_dtype)[None]
+        outs = fn(self._pparams, self._pbuffers,
+                  jnp.asarray(prompt_b), jnp.asarray([P0], jnp.int32),
+                  jnp.asarray(mem))
+        self._pending.add(s)
+        self._pending_info[s] = {
+            "req": r, "outs": outs, "mem": mem, "Pb": Pb,
+            "t0": time.monotonic()}
+        return None   # token 0 is delivered by the splice
+
+    def _build_prefill(self, Pb):
+        """The prefill-slice program: the single-chip join's prefill
+        half (prompt -> batch-1 K/V + first token), no pool splice —
+        it runs on the prefill mesh's own weight copy and its outputs
+        travel to the decode slice when ready."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..text.generation import NEG
+
+        fm = self._fm
+        decoder = self._net.decoder
+        L = self.max_len
+        key = ("prefill", Pb)
+        neg = float(NEG)
+
+        def prefill_fn(params, buffers, prompt, length, memory):
+            self.trace_counts[key] += 1  # one per trace = one compile
+            kpos = jnp.arange(L, dtype=jnp.int32)
+            hole = (kpos[None, :] >= length[:, None]) & \
+                (kpos[None, :] < jnp.int32(Pb))
+            bias_row = jnp.where(hole, jnp.float32(neg),
+                                 jnp.float32(0.0))           # [1, L]
+            positions = jnp.arange(Pb, dtype=jnp.int32)[None]
+            inc0 = [layer.self_attn.gen_cache(
+                None, max_length=Pb, batch_size=1, dtype=memory.dtype)
+                for layer in decoder.layers]
+            (lg, inc1, static1), _ = fm.apply(
+                params, buffers, None, prompt, positions, memory,
+                training=False, tgt_mask=bias_row[:, :Pb],
+                memory_mask=None, inc=inc0, prefill=True)
+            last = jnp.take_along_axis(
+                lg, (length - 1)[:, None, None], axis=1)[:, 0]
+            tok0 = last.argmax(-1).astype(jnp.int32)[0]
+            kvs = [(c.k, c.v) for c in inc1]
+            return tok0, kvs, static1, bias_row
+
+        return jax.jit(prefill_fn)
+
+    def _build_splice(self, Pb):
+        """The decode-slice half of a disaggregated join: land the
+        travelled K/V + bias + memory + first token in the pool at the
+        traced slot — `static_kv_splice`/`splice_rows` with the pool
+        constraints, one compile per prompt bucket."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..nn.layer.transformer import MultiHeadAttention as MHA
+
+        key = ("splice", Pb)
+        ns, ns1 = self._ns_pool, self._ns_pool
+
+        def splice_fn(state, slot, tok0, bias_row, kvs, statics,
+                      memory):
+            self.trace_counts[key] += 1
+            new_inc = [MHA.static_kv_splice(pool, slot, k, v,
+                                            jnp.int32(Pb),
+                                            constraint=(ns, ns1))
+                       for pool, (k, v) in zip(state["inc"], kvs)]
+            new_static = [
+                (MHA.splice_rows(pk, slot, sk, constraint=ns),
+                 MHA.splice_rows(pv, slot, sv, constraint=ns))
+                for (pk, pv), (sk, sv) in zip(state["static"], statics)]
+            return dict(
+                state,
+                tok=jax.lax.with_sharding_constraint(
+                    jax.lax.dynamic_update_slice(
+                        state["tok"], tok0[None], (slot,)), ns),
+                bias=MHA.splice_rows(state["bias"], slot, bias_row,
+                                     constraint=ns),
+                mem=MHA.splice_rows(state["mem"], slot, memory,
+                                    constraint=ns),
+                inc=new_inc, static=new_static)
+
+        return jax.jit(splice_fn)
+
+    def _poll_pending(self, now):
+        """Splice every finished prefill into the pool. Runs once per
+        iteration; a prefill whose arrays are not ready yet just stays
+        pending (the decode step keeps running without it)."""
+        if not self._pending:
+            return False
+        import jax
+        import jax.numpy as jnp
+
+        activated = False
+        for s in sorted(self._pending):
+            info = self._pending_info.get(s)
+            r = self.slots[s]
+            if info is None or r is None:   # evicted while pending
+                self._pending.discard(s)
+                self._pending_info.pop(s, None)
+                continue
+            leaves = jax.tree_util.tree_leaves(info["outs"])
+            if not all(getattr(x, "is_ready", lambda: True)()
+                       for x in leaves):
+                continue
+            self.metrics.record_prefill_step(
+                time.monotonic() - info["t0"])
+            Pb = info["Pb"]
+            try:
+                _PT_SPLICE()
+                t1 = time.monotonic()
+                moved = jax.device_put(info["outs"], self._ns_repl)
+                jax.block_until_ready(moved)
+                self.metrics.record_collective(time.monotonic() - t1)
+                key = ("splice", Pb)
+                fn = self._compiled.get(key)
+                if fn is None:
+                    fn = self._build_splice(Pb)
+                    self._compiled[key] = fn
+                tok0, kvs, statics, bias_row = moved
+                self._state = fn(self._state, jnp.int32(s), tok0,
+                                 bias_row, kvs, statics,
+                                 jnp.asarray(info["mem"]))
+                tok0 = int(tok0)
+            except Exception as e:
+                # per-request isolation: the failed splice kills THIS
+                # request's future, frees the slot, pool keeps serving
+                self.slots[s] = None
+                self._evict(s)
+                r.slot = None
+                self.metrics.record_error("prefill_splice", e)
+                r.fail(e, self.clock())
+                self.metrics.record_finish("error")
+                self._cbs.emit("on_finish", r)
+                continue
+            self._pending.discard(s)
+            self._pending_info.pop(s, None)
+            self._deliver(r, tok0, self.clock())
+            activated = True
+        return activated
+
+    def _evict(self, s):
+        self._pending.discard(s)
+        self._pending_info.pop(s, None)
+        super()._evict(s)
+
+    def _inflight_prefills(self):
+        return len(self._pending)
+
+
+class ShardedPagedServingEngine(ShardedServingEngine, PagedServingEngine):
+    """`ShardedServingEngine(..., paged=True)`: the paged pool's host
+    bookkeeping (allocator, prefix cache, COW, page tables) is
+    unchanged; the DEVICE side shards the page/scale arrays over dp
+    alongside the slot-leading state, so cache memory scales with the
+    mesh while page mapping stays a traced input that never retraces.
+    Page reads/writes are pure selection (gather/scatter), so dp-laid
+    pages keep the bit-exactness contract of `kv_dtype=None`.
+
+    Disaggregated prefill is not wired through the paged join yet
+    (prefix-attach and COW interleave with allocation host-side);
+    constructing with ``prefill="disaggregated"`` raises."""
+
+    def __init__(self, decoder, embed, project, *, prefill="inline",
+                 **kw):
+        if prefill != "inline":
+            raise NotImplementedError(
+                "ShardedPagedServingEngine supports prefill='inline' "
+                "only (disaggregation of the paged join — prefix "
+                "attach + COW — is a follow-up); use the dense "
+                "ShardedServingEngine for disaggregated prefill")
+        kw.pop("paged", None)
+        super().__init__(decoder, embed, project, prefill="inline",
+                         **kw)
+
+    def _cross_params(self):
+        if getattr(self, "_scross", None) is None:
+            import jax
+
+            self._scross = {
+                n: jax.device_put(v, self._ns_repl)
+                for n, v in self._fm_cross.params().items()}
+        return self._scross
+
+    def _check_params(self):
+        prev = self._prefix_params
+        super()._check_params()
+        if prev is not None and self._prefix_params is not prev:
+            # weights changed: re-place the mesh copies too
+            self._scross = None
+            self._place_params()
+
+    def _build_paged_join(self, Pb):
+        return self._wrap_state_out(self._paged_join_body(Pb), True)
+
+    def _build_paged_step(self, ck):
+        return self._wrap_state_out(self._paged_step_body(ck), True)
+
+    def _build_attach(self):
+        return self._wrap_state_out(self._attach_body(), False)
+
+    def _build_cow(self):
+        return self._wrap_state_out(self._cow_body(), False)

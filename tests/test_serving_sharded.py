@@ -1,0 +1,533 @@
+"""Mesh-sharded serving on the 8-device virtual CPU mesh.
+
+Covers: the ragged-arrival soak through `ShardedServingEngine`
+(dp x fsdp x tp mesh, slot pool sharded over dp, weights laid out in
+the bit-exact "gathered" layout) with every completed request
+bit-matching a solo `generate_eager` run and the
+single-trace-per-bucket proof; a direct A/B against the single-chip
+`ServingEngine` (bit-identical tokens per request); the disaggregated
+prefill path (prefill slice + asynchronous splice) bit-matching inline;
+the sharded PAGED pool (dp-laid pages, prefix-cache hits, leak-free
+allocator); the early mesh-sharded-weights guard on the single-chip
+engines; chaos cells (slot_join / decode_step / prefill_splice faults)
+staying leak-free under sharding; and the mesh/sharding helpers
+(fsdp axis, slice_axis, fitted_sharding, serving_param_rules).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn.layer.transformer import (TransformerDecoder,
+                                             TransformerDecoderLayer)
+from paddle_tpu.parallel import init_mesh, serving_param_rules
+from paddle_tpu.serving import (Request, Scheduler, ServingEngine,
+                                ShardedPagedServingEngine,
+                                ShardedServingEngine)
+from paddle_tpu.testing import faults
+from paddle_tpu.text.generation import bucket_size, generate_eager
+
+
+def _small_stack(seed=7, D=32, H=2, V=17, layers=2):
+    np.random.seed(seed)
+    layer = TransformerDecoderLayer(D, H, 64, dropout=0.0)
+    dec = TransformerDecoder(layer, layers)
+    dec.eval()
+    embed = nn.Embedding(V, D)
+    proj = nn.Linear(D, V)
+    return dec, embed, proj, D, V
+
+
+def _mesh222():
+    return init_mesh(dp=2, fsdp=2, tp=2)
+
+
+def _mk_request(rs, D, V, pmax=6, nmax=10, **kw):
+    P = int(rs.randint(1, pmax + 1))
+    prompt = rs.randint(2, V, (P,)).astype(np.int32)
+    prompt[0] = 0
+    mem_seed = int(prompt.sum()) * 131 + P
+    mem = np.random.RandomState(mem_seed).randn(4, D).astype("f4")
+    n = int(rs.randint(2, nmax + 1))
+    return Request(prompt, mem, max_new_tokens=n, eos_id=1, **kw)
+
+
+def _eager_reference(stack, r, max_new):
+    import jax.numpy as jnp
+
+    dec, embed, proj, D, V = stack
+    toks, lens = generate_eager(
+        dec, embed, proj, jnp.asarray(r.memory[None]),
+        jnp.asarray(r.prompt[None]),
+        jnp.asarray([r.prompt.shape[0]], jnp.int32), bos_id=0,
+        eos_id=1, max_new_tokens=max_new,
+        pad_prompt_to=bucket_size(r.prompt.shape[0]))
+    return np.asarray(toks)[0], int(np.asarray(lens)[0])
+
+
+def _drive(eng, sched, reqs_done, max_iterations=3000):
+    it = 0
+    while sched.depth() > 0 or eng.occupancy() > 0:
+        eng.run_iteration(sched)
+        it += 1
+        assert it < max_iterations
+    return it
+
+
+# ----------------------------------------------------------------------
+# mesh / sharding helpers
+# ----------------------------------------------------------------------
+
+class TestMeshHelpers:
+    def test_fsdp_axis_opt_in(self):
+        m = init_mesh(dp=2, fsdp=2, tp=2)
+        assert m.shape == {"dp": 2, "fsdp": 2, "pp": 1, "tp": 2,
+                           "sp": 1, "ep": 1}
+        # without the kwarg the axis stays out (shape-stable programs)
+        m2 = init_mesh(dp=8)
+        assert "fsdp" not in m2.shape
+
+    def test_slice_axis(self):
+        m = init_mesh(dp=2, fsdp=2, tp=2)
+        dec = m.slice_axis("dp", 0, 1)
+        pre = m.slice_axis("dp", 1, 2)
+        assert dec.axis_size("dp") == 1 and pre.axis_size("dp") == 1
+        assert dec.axis_size("tp") == 2 and dec.axis_size("fsdp") == 2
+        decd = {d.id for d in dec.devices.ravel()}
+        pred = {d.id for d in pre.devices.ravel()}
+        assert not (decd & pred)       # disjoint device sets
+        with pytest.raises(ValueError, match="no axis"):
+            m.slice_axis("zz", 0, 1)
+        with pytest.raises(ValueError, match="empty"):
+            m.slice_axis("dp", 1, 1)
+
+    def test_fitted_sharding_prunes_nondividing(self):
+        from paddle_tpu.parallel.sharding import fitted_sharding
+
+        m = init_mesh(dp=2, fsdp=2, tp=2)
+        # 32 divides fsdp*tp=4: keeps the joint spec
+        ns = fitted_sharding((17, 32), (None, ("fsdp", "tp")), m)
+        assert ns.spec[1] == ("fsdp", "tp")
+        # 17 divides neither 4 nor 2: replicated
+        ns = fitted_sharding((17, 32), (("fsdp", "tp"), None), m)
+        assert ns.spec[0] is None
+        # 18 divides 2 but not 4: largest dividing prefix wins
+        ns = fitted_sharding((18, 32), (("fsdp", "tp"), None), m)
+        assert ns.spec[0] == "fsdp"
+
+    def test_serving_param_rules_layouts(self):
+        g = serving_param_rules("gathered")
+        p = g.spec_for("decoder.layers.0.self_attn.q_proj.weight", 2)
+        assert tuple(p) == (None, ("fsdp", "tp"))
+        p = g.spec_for("embed.weight", 2)
+        assert tuple(p)[0] == ("fsdp", "tp")
+        mgt = serving_param_rules("megatron")
+        p = mgt.spec_for("decoder.layers.0.self_attn.out_proj.weight", 2)
+        assert tuple(p) == ("tp", "fsdp")
+        with pytest.raises(ValueError, match="layout"):
+            serving_param_rules("zebra")
+
+
+# ----------------------------------------------------------------------
+# the acceptance soak: ragged arrivals on the sharded pool
+# ----------------------------------------------------------------------
+
+def test_sharded_soak_bitmatch_and_single_trace():
+    """Ragged-arrival requests stream through an 8-slot
+    ShardedServingEngine on the dp=2 x fsdp=2 x tp=2 mesh; every
+    completed request's tokens bit-match a solo generate_eager run
+    (fp32, gathered layout), and joins/evictions never retrace the
+    sharded decode step: ONE step trace for the pool, one join trace
+    per prompt bucket."""
+    mesh = _mesh222()
+    stack = _small_stack(seed=21)
+    dec, embed, proj, D, V = stack
+    eng = ShardedServingEngine(dec, embed, proj, mesh=mesh,
+                               num_slots=8, max_len=32)
+    sched = Scheduler(max_queue=128)
+    rs = np.random.RandomState(22)
+    reqs = []
+
+    def submit_wave(k):
+        for _ in range(k):
+            r = _mk_request(rs, D, V)
+            sched.submit(r)
+            reqs.append(r)
+
+    submit_wave(5)
+    it = 0
+    while len(reqs) < 40 or sched.depth() > 0 or eng.occupancy() > 0:
+        eng.run_iteration(sched)
+        it += 1
+        if len(reqs) < 40 and it % 3 == 0:
+            submit_wave(int(rs.randint(1, 7)))   # ragged arrivals
+        assert it < 2000
+    assert len(reqs) >= 40
+
+    eager_cache = {}
+    for r in reqs:
+        res = r.result(timeout=5)
+        assert res.ok, res
+        key = tuple(r.prompt.tolist())
+        if key not in eager_cache:
+            eager_cache[key] = _eager_reference(stack, r, max_new=10)
+        et, el = eager_cache[key]
+        np.testing.assert_array_equal(res.tokens,
+                                      et[:len(res.tokens)])
+        if res.finish_reason == "eos":
+            assert res.tokens[-1] == 1
+
+    steps = {k: v for k, v in eng.trace_counts.items()
+             if k[0] == "step"}
+    joins = {k: v for k, v in eng.trace_counts.items()
+             if k[0] == "join"}
+    assert len(steps) == 1 and set(steps.values()) == {1}, steps
+    assert set(joins.values()) == {1}, joins
+
+    snap = eng.metrics.snapshot()
+    assert snap["requests"]["completed"] == len(reqs)
+    sh = snap["sharding"]
+    assert sh["per_shard_occupancy"] is not None
+    assert len(sh["per_shard_occupancy"]) == 2      # dp shards
+    assert sh["step_gap_ms"]["n"] > 0
+    assert sh["collective_events"] >= 1             # param placement
+
+
+def test_sharded_matches_single_chip_engine():
+    """The acceptance A/B: the same request sequence through the
+    single-chip ServingEngine and the sharded pool produces
+    bit-identical tokens per request (fp32, gathered layout)."""
+    stack = _small_stack(seed=33)
+    dec, embed, proj, D, V = stack
+    rs = np.random.RandomState(34)
+    protos = [_mk_request(rs, D, V) for _ in range(10)]
+
+    def run(eng):
+        sched = Scheduler(max_queue=32)
+        rr = []
+        for p in protos:
+            r = Request(p.prompt.copy(), p.memory,
+                        max_new_tokens=p.max_new_tokens, eos_id=1)
+            sched.submit(r)
+            rr.append(r)
+        _drive(eng, sched, rr)
+        return [r.result(timeout=5) for r in rr]
+
+    solo = run(ServingEngine(dec, embed, proj, num_slots=4,
+                             max_len=32))
+    mesh = _mesh222()
+    shard = run(ShardedServingEngine(dec, embed, proj, mesh=mesh,
+                                     num_slots=4, max_len=32))
+    for a, b in zip(solo, shard):
+        assert a.ok and b.ok
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+        assert a.finish_reason == b.finish_reason
+
+
+def test_slot_choice_balances_dp_shards():
+    """Joins spread across the dp shards of the slot axis instead of
+    filling shard 0 first."""
+    mesh = _mesh222()
+    dec, embed, proj, D, V = _small_stack(seed=41)
+    eng = ShardedServingEngine(dec, embed, proj, mesh=mesh,
+                               num_slots=4, max_len=32,
+                               max_joins_per_iter=1)
+    sched = Scheduler(max_queue=8)
+    rs = np.random.RandomState(42)
+    joined = []
+
+    from paddle_tpu.serving import ServingCallback
+
+    class Rec(ServingCallback):
+        def on_join(self, request, slot):
+            joined.append(slot)
+
+    eng._cbs.append(Rec())
+    for _ in range(4):
+        prompt = rs.randint(2, V, (3,)).astype(np.int32)
+        prompt[0] = 0
+        mem = rs.randn(4, D).astype("f4")
+        # eos_id=None + long budget: all four stay resident
+        sched.submit(Request(prompt, mem, max_new_tokens=20,
+                             eos_id=None))
+    for _ in range(5):
+        eng.run_iteration(sched)
+    # slots 0,1 are shard 0; slots 2,3 shard 1: joins must alternate
+    shards = [s // 2 for s in joined]
+    assert shards == [0, 1, 0, 1], (joined, shards)
+    eng.abort_active("shutdown")
+
+
+# ----------------------------------------------------------------------
+# disaggregated prefill
+# ----------------------------------------------------------------------
+
+def test_disaggregated_prefill_bitmatch_and_phase_metrics():
+    """prefill='disaggregated': prompts prefill on the dedicated dp
+    slice and splice in asynchronously — tokens stay bit-identical to
+    the eager oracle, the pending set drains, and the snapshot carries
+    both phases' latencies."""
+    mesh = _mesh222()
+    stack = _small_stack(seed=51)
+    dec, embed, proj, D, V = stack
+    eng = ShardedServingEngine(dec, embed, proj, mesh=mesh,
+                               num_slots=3, max_len=32,
+                               prefill="disaggregated")
+    assert eng._pool_dp == 1           # dp=2 -> 1 decode + 1 prefill
+    sched = Scheduler(max_queue=32)
+    rs = np.random.RandomState(52)
+    reqs = []
+    for _ in range(8):
+        r = _mk_request(rs, D, V)
+        sched.submit(r)
+        reqs.append(r)
+    _drive(eng, sched, reqs)
+    eager_cache = {}
+    for r in reqs:
+        res = r.result(timeout=5)
+        assert res.ok, res
+        key = tuple(r.prompt.tolist())
+        if key not in eager_cache:
+            eager_cache[key] = _eager_reference(stack, r, max_new=10)
+        np.testing.assert_array_equal(
+            res.tokens, eager_cache[key][0][:len(res.tokens)])
+    assert not eng._pending and not eng._pending_info
+    # one prefill + one splice trace per prompt bucket, never more
+    pre = {k: v for k, v in eng.trace_counts.items()
+           if k[0] == "prefill"}
+    spl = {k: v for k, v in eng.trace_counts.items()
+           if k[0] == "splice"}
+    assert pre and set(pre.values()) == {1}, pre
+    assert spl and set(spl.values()) == {1}, spl
+    assert set(k[1] for k in pre) == set(k[1] for k in spl)
+    sh = eng.metrics.snapshot()["sharding"]
+    assert sh["prefill_step_ms"]["n"] == len(reqs)
+    assert sh["decode_step_ms"]["n"] > 0
+    assert sh["collective_events"] >= len(reqs)   # K/V transfers
+    assert 0.0 <= sh["collective_time_share"] <= 1.0
+
+
+def test_disaggregated_validation():
+    dec, embed, proj, D, V = _small_stack(seed=55)
+    mesh = init_mesh(dp=1, fsdp=2, tp=2,
+                     devices=__import__("jax").devices()[:4])
+    with pytest.raises(ValueError, match="dp >= 2"):
+        ShardedServingEngine(dec, embed, proj, mesh=mesh,
+                             prefill="disaggregated")
+    with pytest.raises(ValueError, match="prefill policy"):
+        ShardedServingEngine(dec, embed, proj, mesh=mesh,
+                             prefill="offline")
+    m8 = init_mesh(dp=8)
+    with pytest.raises(ValueError, match="divisible"):
+        ShardedServingEngine(dec, embed, proj, mesh=m8, num_slots=6)
+    with pytest.raises(NotImplementedError, match="inline"):
+        ShardedServingEngine(dec, embed, proj, mesh=_mesh222(),
+                             num_slots=2, paged=True,
+                             prefill="disaggregated")
+
+
+# ----------------------------------------------------------------------
+# sharded paged pool
+# ----------------------------------------------------------------------
+
+def test_sharded_paged_bitmatch_prefix_and_leakfree():
+    """ShardedServingEngine(paged=True): dp-laid pages + dp-sharded
+    slot state keep the paged pool's whole contract — bit-match vs the
+    eager oracle, zero-re-prefill prefix hits for repeated prompts,
+    and an allocator that returns to all-free after the drain."""
+    mesh = _mesh222()
+    stack = _small_stack(seed=61)
+    dec, embed, proj, D, V = stack
+    eng = ShardedServingEngine(dec, embed, proj, mesh=mesh,
+                               num_slots=4, max_len=32, paged=True,
+                               page_size=8)
+    assert isinstance(eng, ShardedPagedServingEngine)
+    sched = Scheduler(max_queue=64)
+    rs = np.random.RandomState(62)
+    protos = [_mk_request(rs, D, V) for _ in range(5)]
+    reqs = []
+    for i in range(12):                 # repeats ride the prefix cache
+        p = protos[i % len(protos)]
+        r = Request(p.prompt.copy(), p.memory,
+                    max_new_tokens=p.max_new_tokens, eos_id=1)
+        sched.submit(r)
+        reqs.append(r)
+    _drive(eng, sched, reqs)
+    eager_cache = {}
+    for r in reqs:
+        res = r.result(timeout=5)
+        assert res.ok, res
+        key = tuple(r.prompt.tolist())
+        if key not in eager_cache:
+            eager_cache[key] = _eager_reference(stack, r, max_new=10)
+        np.testing.assert_array_equal(
+            res.tokens, eager_cache[key][0][:len(res.tokens)])
+    assert eng.metrics.prefix_hits >= 5         # repeats shared pages
+    assert eng.prefill_count <= len(protos) + 1
+    # paged-step single-trace proof under sharding
+    steps = {k: v for k, v in eng.trace_counts.items()
+             if k[0] == "pstep"}
+    assert len(steps) == 1 and set(steps.values()) == {1}, steps
+    eng.flush_prefix_cache()
+    eng._alloc.check()
+    assert eng._alloc.pages_free == eng.num_pages
+
+
+# ----------------------------------------------------------------------
+# the early guard on single-chip engines
+# ----------------------------------------------------------------------
+
+def test_mesh_sharded_weights_guard():
+    """A single-chip engine handed mesh-sharded weights fails FAST
+    with a message pointing at ShardedServingEngine — not a silent
+    wrong answer; the sharded engine itself accepts them."""
+    import jax
+
+    from paddle_tpu.parallel.functional import functionalize
+    from paddle_tpu.parallel.sharding import (fitted_sharding,
+                                              infer_param_specs)
+
+    mesh = _mesh222()
+    dec, embed, proj, D, V = _small_stack(seed=71)
+    fm = functionalize(dec)
+    specs = infer_param_specs(fm.params(), serving_param_rules())
+    for n, t in fm._tensors.items():
+        if n in fm.params():
+            t._data = jax.device_put(
+                t._data, fitted_sharding(t._data.shape, specs[n],
+                                         mesh))
+    with pytest.raises(ValueError, match="ShardedServingEngine"):
+        ServingEngine(dec, embed, proj, num_slots=2, max_len=32)
+    with pytest.raises(ValueError, match="ShardedServingEngine"):
+        ServingEngine(dec, embed, proj, num_slots=2, max_len=32,
+                      paged=True)
+    # the engine built for the job takes the same weights happily
+    eng = ShardedServingEngine(dec, embed, proj, mesh=mesh,
+                               num_slots=2, max_len=32)
+    assert eng is not None
+
+
+# ----------------------------------------------------------------------
+# chaos cells under sharding
+# ----------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_chaos_sharded_join_and_step_faults_leak_free():
+    """Fault cells on the SHARDED pool: a transient slot_join fault is
+    retried through; a persistent decode_step fault evicts the
+    in-flight requests with partials + cause and the pool revives
+    WITHOUT retracing (the step program stays cached); afterwards the
+    pool serves bit-exact again and nothing leaks (pending empty,
+    occupancy zero)."""
+    mesh = _mesh222()
+    stack = _small_stack(seed=81)
+    dec, embed, proj, D, V = stack
+    eng = ShardedServingEngine(dec, embed, proj, mesh=mesh,
+                               num_slots=4, max_len=32,
+                               backoff_base_s=0.0)
+    sched = Scheduler(max_queue=64)
+    rs = np.random.RandomState(82)
+
+    # warm: one request end to end
+    r0 = _mk_request(rs, D, V)
+    sched.submit(r0)
+    _drive(eng, sched, [r0])
+    assert r0.result(timeout=5).ok
+    steps_before = dict(eng.trace_counts)
+
+    # cell 1: transient join fault — retried, request still bit-exact
+    with faults.inject("serving.slot_join", on="nth", n=1):
+        r1 = _mk_request(rs, D, V)
+        sched.submit(r1)
+        _drive(eng, sched, [r1])
+    res1 = r1.result(timeout=5)
+    assert res1.ok
+    et, _ = _eager_reference(stack, r1, max_new=10)
+    np.testing.assert_array_equal(res1.tokens, et[:len(res1.tokens)])
+    assert eng.metrics.retries >= 1
+
+    # cell 2: persistent decode fault — all in-flight evicted with the
+    # cause, pool revives, step program NOT retraced
+    victims = [_mk_request(rs, D, V) for _ in range(3)]
+    for v in victims:
+        sched.submit(v)
+    with faults.inject("serving.decode_step", action="raise",
+                       max_fires=eng.max_attempts):
+        for _ in range(4):
+            eng.run_iteration(sched)
+    _drive(eng, sched, victims)        # drain the survivors
+    for v in victims:
+        res = v.result(timeout=5)
+        if res.finish_reason == "error":
+            assert res.error is not None
+    assert eng.metrics.evictions_on_error >= 1
+    assert eng.occupancy() == 0 and not eng._pending
+
+    # revival: new request served bit-exact, zero new step traces
+    r2 = _mk_request(rs, D, V)
+    sched.submit(r2)
+    _drive(eng, sched, [r2])
+    res2 = r2.result(timeout=5)
+    assert res2.ok
+    et2, _ = _eager_reference(stack, r2, max_new=10)
+    np.testing.assert_array_equal(res2.tokens, et2[:len(res2.tokens)])
+    steps_after = {k: v for k, v in eng.trace_counts.items()
+                   if k[0] == "step"}
+    assert steps_after == {k: v for k, v in steps_before.items()
+                           if k[0] == "step"}
+
+
+@pytest.mark.chaos
+def test_chaos_disaggregated_splice_fault_isolated():
+    """A splice that fails (prefill-slice K/V landing) kills only that
+    request's future; the pool keeps serving and the pending set stays
+    clean."""
+    mesh = _mesh222()
+    stack = _small_stack(seed=91)
+    dec, embed, proj, D, V = stack
+    eng = ShardedServingEngine(dec, embed, proj, mesh=mesh,
+                               num_slots=2, max_len=32,
+                               prefill="disaggregated")
+    sched = Scheduler(max_queue=16)
+    rs = np.random.RandomState(92)
+    doomed = _mk_request(rs, D, V)
+    healthy = _mk_request(rs, D, V)
+    with faults.inject("serving.prefill_splice", on="nth", n=1):
+        sched.submit(doomed)
+        sched.submit(healthy)
+        _drive(eng, sched, [doomed, healthy])
+    with pytest.raises(faults.InjectedFault):
+        doomed.result(timeout=5)
+    res = healthy.result(timeout=5)
+    assert res.ok
+    et, _ = _eager_reference(stack, healthy, max_new=10)
+    np.testing.assert_array_equal(res.tokens, et[:len(res.tokens)])
+    assert not eng._pending and not eng._pending_info
+    assert eng.occupancy() == 0
+
+
+@pytest.mark.chaos
+def test_chaos_sharded_paged_leak_free():
+    """slot_join faults on the sharded paged pool never leak pages:
+    after the storm + drain the free list is back to its initial
+    state."""
+    mesh = _mesh222()
+    dec, embed, proj, D, V = _small_stack(seed=95)
+    eng = ShardedServingEngine(dec, embed, proj, mesh=mesh,
+                               num_slots=4, max_len=32, paged=True,
+                               page_size=8, backoff_base_s=0.0)
+    sched = Scheduler(max_queue=64)
+    rs = np.random.RandomState(96)
+    reqs = []
+    with faults.inject("serving.slot_join", on="every", k=3):
+        for _ in range(8):
+            r = _mk_request(rs, D, V)
+            sched.submit(r)
+            reqs.append(r)
+        _drive(eng, sched, reqs)
+    for r in reqs:
+        r.result(timeout=5)            # resolved one way or the other
+    eng.flush_prefix_cache()
+    eng._alloc.check()
+    assert eng._alloc.pages_free == eng.num_pages
+    assert eng.occupancy() == 0

@@ -219,6 +219,7 @@ def _configs():
     cfgs += _configs_flash_decode()
     cfgs += _configs_serving()
     cfgs += _configs_paged_decode()
+    cfgs += _configs_sharded_decode()
     return cfgs
 
 
@@ -1069,6 +1070,67 @@ def _configs_serving():
                                                     64)),
         ("serving_step_join_s8_L2048", step_join(8, 8, 2048, 64, 128)),
         ("serving_step_join_s32_L512", step_join(32, 8, 512, 64, 64)),
+    ]
+
+
+def _configs_sharded_decode():
+    """Sharded decode-step rows: the pooled decode-attention of the
+    serving engines with the slot axis laid out data-parallel over a
+    dp mesh and the kernel spec-annotated via
+    `ops.attention.decode_shardings` (the ShardedServingEngine path),
+    against the same shapes on a 1-device mesh. On this CPU harness the
+    numbers measure structure/overhead, not bandwidth; the TPU driver
+    refreshes them on real chips. Rows skip (not fail) when the host
+    lacks the virtual 8-device mesh."""
+    def sharded_step(S, heads, L, d, dp, steps=30):
+        def bench():
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import Mesh, NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from paddle_tpu.ops.attention import (decode_attention,
+                                                  decode_shardings)
+
+            devs = [dev for dev in jax.devices()
+                    if dev.platform == "cpu"] or jax.devices()
+            if len(devs) < dp:
+                return {"skipped": f"needs {dp} devices (run with "
+                        f"XLA_FLAGS=--xla_force_host_platform_"
+                        f"device_count=8)"}
+            mesh = Mesh(np.array(devs[:dp]), ("dp",))
+            ns = NamedSharding(mesh, P("dp"))
+            rs = np.random.RandomState(0)
+            q = jax.device_put(
+                jnp.asarray(rs.randn(S, heads, 1, d).astype("f4")), ns)
+            k = jax.device_put(
+                jnp.asarray(rs.randn(S, heads, L, d).astype("f4")), ns)
+            v = jax.device_put(
+                jnp.asarray(rs.randn(S, heads, L, d).astype("f4")), ns)
+            length = jax.device_put(
+                jnp.asarray(rs.randint(L // 4, L, (S,)), jnp.int32),
+                ns)
+            specs = {"q": ns, "kv": ns, "out": ns}
+
+            def step(q, k, v, length):
+                with decode_shardings(specs):
+                    return decode_attention(q, k, v, length)
+
+            fn = jax.jit(step)
+            return _time_direct(lambda: fn(q, k, v, length), steps)
+
+        bench._direct = True
+        return bench
+
+    return [
+        ("sharded_decode_s8_L2048_dp1", sharded_step(8, 8, 2048, 64,
+                                                     1)),
+        ("sharded_decode_s8_L2048_dp8", sharded_step(8, 8, 2048, 64,
+                                                     8)),
+        ("sharded_decode_s32_L512_dp1", sharded_step(32, 8, 512, 64,
+                                                     1)),
+        ("sharded_decode_s32_L512_dp8", sharded_step(32, 8, 512, 64,
+                                                     8)),
     ]
 
 
