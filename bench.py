@@ -28,6 +28,7 @@ numbers; see BASELINE.md).
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import json
 import sys
@@ -36,6 +37,31 @@ import time
 import numpy as np
 
 TARGET_SEQ_PER_SEC = 300.0
+
+#: --trace: serving benches run under a tracer session and write a
+#: chrome-trace artifact per run (tools/trace_report.py / Perfetto)
+_TRACE = False
+
+
+@contextlib.contextmanager
+def _maybe_trace(tag):
+    """Wrap a serving-bench drive in a tracer session when --trace is
+    set; exports /tmp/paddle_tpu_trace_<tag>.json. Yields the artifact
+    path holder (path at [0] after exit) so results can record it."""
+    holder = [None]
+    if not _TRACE:
+        yield holder
+        return
+    from paddle_tpu.profiler import trace as T
+
+    tr = T.start_session(capacity=1 << 18)
+    try:
+        yield holder
+    finally:
+        T.end_session()
+        holder[0] = tr.export_chrome_trace(
+            f"/tmp/paddle_tpu_trace_{tag}.json")
+        print(f"# trace artifact: {holder[0]}", file=sys.stderr)
 
 STEPS = 50
 
@@ -1114,20 +1140,21 @@ def _serving_throughput(n_requests=48, num_slots=8, d_model=128,
     gap = 0.004   # mean Poisson inter-arrival (s): ~arrival/iteration
     gaps = rs.exponential(gap, n_requests)
     reqs = []
-    t0 = time.perf_counter()
-    next_arrival = t0
-    i = 0
-    while i < len(work) or sched.depth() > 0 or eng.occupancy() > 0:
-        now = time.perf_counter()
-        while i < len(work) and now >= next_arrival:
-            prompt, P, mem = work[i]
-            reqs.append(sched.submit(Request(
-                prompt[:P].copy(), mem, max_new_tokens=max_new,
-                eos_id=1)))
-            next_arrival += gaps[i]
-            i += 1
-        eng.run_iteration(sched)
-    cont_wall = time.perf_counter() - t0
+    with _maybe_trace("serving_throughput") as trace_art:
+        t0 = time.perf_counter()
+        next_arrival = t0
+        i = 0
+        while i < len(work) or sched.depth() > 0 or eng.occupancy() > 0:
+            now = time.perf_counter()
+            while i < len(work) and now >= next_arrival:
+                prompt, P, mem = work[i]
+                reqs.append(sched.submit(Request(
+                    prompt[:P].copy(), mem, max_new_tokens=max_new,
+                    eos_id=1)))
+                next_arrival += gaps[i]
+                i += 1
+            eng.run_iteration(sched)
+        cont_wall = time.perf_counter() - t0
     cont_ttft = np.asarray([r.result().ttft_s for r in reqs])
     cont_tokens = sum(len(r.result().tokens) for r in reqs)
 
@@ -1168,6 +1195,55 @@ def _serving_throughput(n_requests=48, num_slots=8, d_model=128,
     stat_wall = time.perf_counter() - t0
     stat_ttft = np.asarray(stat_ttft)
 
+    # ---- traced-overhead A/B on the decode step ----
+    # A steady pool (4 resident requests, no joins, no finishes) runs
+    # pure decode iterations in alternating groups with the tracer OFF
+    # and ON; identical compiled work either way, so the medians
+    # isolate the tracer's own cost. Asserted: tracing ON stays within
+    # 2% of OFF — the observability layer must be deployable always-on.
+    from paddle_tpu.profiler import trace as T
+
+    ov_eng = ServingEngine(dec, embed, proj, num_slots=4, max_len=516)
+    ov_sched = Scheduler(max_queue=8)
+    for k in range(4):
+        ov_sched.submit(Request(work[k][0][:2].copy(), work[k][2],
+                                max_new_tokens=512, eos_id=None))
+    for _ in range(8):                 # join all four + warm the step
+        ov_eng.run_iteration(ov_sched)
+
+    def _one(tracer):
+        if tracer is not None:
+            T.start_session(tracer=tracer)
+        s0 = time.perf_counter()
+        ov_eng.run_iteration(ov_sched)
+        dt = time.perf_counter() - s0
+        if tracer is not None:
+            T.end_session()
+        return dt
+
+    # PAIRED per-step measurement: each (off, on) pair runs back to
+    # back — the median of per-pair differences cancels the 1-core
+    # box's drift (cpu freq, gc, scheduler) that group medians cannot
+    tr = T.Tracer(capacity=1 << 15)
+    off_s, diff_s = [], []
+    for k in range(200):
+        if k % 2 == 0:                 # alternate order inside pairs
+            off = _one(None)
+            on = _one(tr)
+        else:
+            on = _one(tr)
+            off = _one(None)
+        off_s.append(off)
+        diff_s.append(on - off)
+    off_ms = float(np.median(off_s)) * 1e3
+    diff_ms = float(np.median(diff_s)) * 1e3
+    on_ms = off_ms + diff_ms
+    overhead_pct = diff_ms / off_ms * 100.0
+    assert overhead_pct < 2.0, \
+        f"tracing overhead {overhead_pct:.2f}% >= 2% " \
+        f"(on {on_ms:.3f}ms vs off {off_ms:.3f}ms per decode step)"
+    ov_eng.abort_active("shutdown")
+
     def pct(a, q):
         return round(float(np.percentile(a, q)) * 1e3, 1)
 
@@ -1185,6 +1261,14 @@ def _serving_throughput(n_requests=48, num_slots=8, d_model=128,
                              "ttft_p50_ms": pct(stat_ttft, 50),
                              "ttft_p99_ms": pct(stat_ttft, 99),
                              "wall_s": round(stat_wall, 2)},
+            "trace_overhead": {
+                "off_step_ms": round(off_ms, 3),
+                "on_step_ms": round(on_ms, 3),
+                "overhead_pct": round(overhead_pct, 2),
+                "asserted_lt_pct": 2.0,
+                "steps_per_side": len(off_s)},
+            **({} if trace_art[0] is None
+               else {"trace_artifact": trace_art[0]}),
             "config": {"n_requests": n_requests, "slots": num_slots,
                        "layers": n_layers, "d_model": d_model,
                        "max_new_tokens": max_new,
@@ -1280,7 +1364,8 @@ def _serving_paged(n_requests=40, d_model=64, nhead=2, ffn=128,
                           max_len=max_len, paged=True,
                           page_size=page_size, num_pages=num_pages,
                           max_joins_per_iter=4)
-    p_res, p_ttft, p_toks, p_wall, p_peak = drive(paged)
+    with _maybe_trace("serving_paged") as trace_art:
+        p_res, p_ttft, p_toks, p_wall, p_peak = drive(paged)
 
     # fp32 pages: bit-identical tokens to the dense pool, per request
     for a, b in zip(d_res, p_res):
@@ -1306,6 +1391,8 @@ def _serving_paged(n_requests=40, d_model=64, nhead=2, ffn=128,
             "unit": "x peak concurrent requests vs dense pool at "
                     "equal cache memory",
             "bitmatch_dense": True,
+            **({} if trace_art[0] is None
+               else {"trace_artifact": trace_art[0]}),
             "paged": {"peak_concurrency": p_peak,
                       "ttft_p50_ms": pct(p_ttft, 50),
                       "ttft_p99_ms": pct(p_ttft, 99),
@@ -1419,7 +1506,8 @@ def _serving_sharded(n_requests=24, d_model=64, nhead=2, ffn=128,
     shard = ShardedServingEngine(dec, embed, proj, mesh=mesh,
                                  num_slots=2 * dense_slots,
                                  max_len=max_len, max_joins_per_iter=4)
-    s_res, s_ttft, s_toks, s_wall = drive(shard)
+    with _maybe_trace("serving_sharded") as trace_art:
+        s_res, s_ttft, s_toks, s_wall = drive(shard)
 
     # the acceptance bit-match: fp32 gathered layout, per request
     for a, b in zip(d_res, s_res):
@@ -1486,6 +1574,8 @@ def _serving_sharded(n_requests=24, d_model=64, nhead=2, ffn=128,
             "unit": "x lower decode-step p50 with disaggregated "
                     "prefill under concurrent long-prompt joins",
             "bitmatch_single_chip": True,
+            **({} if trace_art[0] is None
+               else {"trace_artifact": trace_art[0]}),
             "pool_scaling": {
                 "dense_1dev": {"slots": dense_slots,
                                "tok_per_s": round(d_toks / d_wall, 1),
@@ -1635,7 +1725,11 @@ def _read_details():
 
 
 def main():
-    only = sys.argv[1] if len(sys.argv) > 1 else None
+    global _TRACE
+    argv = list(sys.argv[1:])
+    _TRACE = "--trace" in argv
+    argv = [a for a in argv if a != "--trace"]
+    only = argv[0] if argv else None
     configs = [("mnist", _mnist_static), ("resnet50", _resnet50),
                ("ernie", _ernie), ("ctr_ps", _ctr_dnn_ps),
                ("long_context", _long_context_attention),
@@ -1666,7 +1760,8 @@ def main():
                     json.dump(stale, f, indent=1)
             try:
                 proc = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__), name],
+                    [sys.executable, os.path.abspath(__file__), name]
+                    + (["--trace"] if _TRACE else []),
                     timeout=CONFIG_TIMEOUT_S,
                     stdout=subprocess.DEVNULL,
                     cwd=os.path.dirname(os.path.abspath(__file__)))

@@ -6,23 +6,49 @@ design: host annotations forward to jax.profiler.TraceAnnotation; device
 timelines come from the XLA/XPlane trace (`start_profiler` starts a
 jax.profiler trace whose output loads in TensorBoard / Perfetto — the
 chrome://tracing equivalent of platform/device_tracer.cc).
+
+`profiler.trace` adds the span-based host tracer the serving stack
+reports into (per-request timelines, compile observer, retrace
+sentinel); `start_profiler`/`stop_profiler` start and stop a tracer
+session in lockstep with the XPlane trace, so the host span dump
+(`<trace_dir>/host_trace.json`) loads in Perfetto next to the device
+timeline.
 """
 from __future__ import annotations
 
+import collections
 import contextlib
 import os
 import time
 
-_events = []
+from . import trace  # noqa: F401  (paddle_tpu.profiler.trace)
+
+#: host RecordEvent ring: bounded so an always-on process can leave
+#: profiling annotations in place without unbounded growth
+_EVENTS_CAP = 65536
+_events = collections.deque(maxlen=_EVENTS_CAP)
 _trace_dir = None
 _active = False
+_own_tracer = False
+last_host_trace = None
+
+
+def set_events_capacity(cap):
+    """Resize the RecordEvent ring buffer (keeps the newest events)."""
+    global _events, _EVENTS_CAP
+    _EVENTS_CAP = int(cap)
+    _events = collections.deque(_events, maxlen=_EVENTS_CAP)
 
 
 class RecordEvent:
-    """platform/profiler.h:126 parity; also usable as a decorator."""
+    """platform/profiler.h:126 parity; also usable as a decorator.
+    Records (name, event_type, duration) host-side, forwards the name
+    to jax.profiler.TraceAnnotation, and — when a `profiler.trace`
+    session is active — surfaces the event as a span in the tracer."""
 
     def __init__(self, name, event_type="op"):
         self.name = name
+        self.event_type = event_type
         self._ann = None
         self._t0 = None
 
@@ -35,8 +61,13 @@ class RecordEvent:
         return self
 
     def __exit__(self, *exc):
-        dt = time.perf_counter() - self._t0
-        _events.append((self.name, dt))
+        t1 = time.perf_counter()
+        dt = t1 - self._t0
+        _events.append((self.name, self.event_type, dt))
+        tr = trace._SESSION
+        if tr is not None:
+            tr.add_complete(self.name, self._t0, t1, cat="record_event",
+                            attrs={"event_type": self.event_type})
         # _host_lib is only non-None after enable_host_trace(): the native
         # build/load never happens (nor does any lock) on the hot path
         # unless host tracing was explicitly turned on.
@@ -89,22 +120,37 @@ def disable_host_trace():
 
 def start_profiler(state="All", tracer_option="Default",
                    trace_dir="/tmp/paddle_tpu_trace"):
-    global _trace_dir, _active
+    """Start the XPlane device trace AND a `profiler.trace` span
+    session in lockstep (unless one is already active, which is then
+    left under its owner's control)."""
+    global _trace_dir, _active, _own_tracer
     import jax
 
     _trace_dir = trace_dir
     os.makedirs(trace_dir, exist_ok=True)
     jax.profiler.start_trace(trace_dir)
+    if trace._SESSION is None:
+        trace.start_session()
+        _own_tracer = True
     _active = True
 
 
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
-    global _active
+    """Stop the XPlane trace; the lockstep tracer session (if this
+    module started it) is ended and exported to
+    `<trace_dir>/host_trace.json` (`profiler.last_host_trace`)."""
+    global _active, _own_tracer, last_host_trace
     import jax
 
     if _active:
         jax.profiler.stop_trace()
         _active = False
+        if _own_tracer:
+            _own_tracer = False
+            tr = trace.end_session()
+            if tr is not None and _trace_dir is not None:
+                last_host_trace = tr.export_chrome_trace(
+                    os.path.join(_trace_dir, "host_trace.json"))
     return summary()
 
 
@@ -112,15 +158,28 @@ def reset_profiler():
     _events.clear()
 
 
+def reset():
+    """Clear the host RecordEvent buffer (alias of reset_profiler)."""
+    reset_profiler()
+
+
+def events():
+    """The recorded (name, event_type, duration_s) host events, newest
+    `set_events_capacity()` of them."""
+    return list(_events)
+
+
 def summary():
     agg = {}
-    for name, dt in _events:
-        tot, cnt = agg.get(name, (0.0, 0))
-        agg[name] = (tot + dt, cnt + 1)
-    lines = ["Event                          Calls    Total(ms)   Avg(ms)"]
-    for name, (tot, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
-        lines.append(f"{name:<30} {cnt:>6} {tot * 1e3:>11.3f} "
-                     f"{tot / cnt * 1e3:>9.3f}")
+    for name, etype, dt in _events:
+        tot, cnt = agg.get((name, etype), (0.0, 0))
+        agg[(name, etype)] = (tot + dt, cnt + 1)
+    lines = ["Event                          Type     Calls    "
+             "Total(ms)   Avg(ms)"]
+    for (name, etype), (tot, cnt) in sorted(agg.items(),
+                                            key=lambda kv: -kv[1][0]):
+        lines.append(f"{name:<30} {etype:<8} {cnt:>6} "
+                     f"{tot * 1e3:>11.3f} {tot / cnt * 1e3:>9.3f}")
     return "\n".join(lines)
 
 

@@ -19,7 +19,13 @@ batching over the static KV cache:
   * `server.ServingServer` — thread frontend: submit() -> future with
     per-token streaming;
   * `metrics.ServingMetrics` — TTFT / per-token latency / tokens/s /
-    queue depth / occupancy, `snapshot()` + hapi-style callbacks.
+    queue depth / occupancy, `snapshot()` (schema of record:
+    `SNAPSHOT_DOCS`; Prometheus text dump via `to_prometheus` /
+    tools/metrics_dump.py) + hapi-style callbacks;
+  * `tracing` — per-request span timelines over `profiler.trace`
+    (queue -> join/prefill -> decode -> finish waterfalls, compile
+    observer, chrome-trace export) and the `retrace_sentinel` standing
+    "never retraces" assertion (README "Observability").
 
 Failure isolation (README "Fault tolerance"): joins/decodes run under
 retry+backoff with an optional watchdog; a failed join kills one
@@ -31,11 +37,14 @@ via the `serving.*` fault points in `paddle_tpu.testing.faults`.
 """
 from .engine import (ArtifactServingEngine, PagedServingEngine,
                      ServingEngine, WatchdogTimeout)
-from .metrics import CallbackList, ServingCallback, ServingMetrics
+from .metrics import (CallbackList, ServingCallback, ServingMetrics,
+                      to_prometheus)
 from .paging import OutOfPages, PageAllocator, PagedKVCache, PrefixCache
 from .scheduler import QueueFull, Request, RequestResult, Scheduler
 from .server import ServerCrashed, ServingServer
 from .sharded import ShardedPagedServingEngine, ShardedServingEngine
+from .tracing import (RetraceError, RetraceSentinel, retrace_sentinel,
+                      session_scope)
 
 __all__ = [
     "ServingEngine", "PagedServingEngine", "ArtifactServingEngine",
@@ -43,5 +52,6 @@ __all__ = [
     "ServingServer", "Scheduler", "Request", "RequestResult",
     "QueueFull", "ServingMetrics", "ServingCallback", "CallbackList",
     "WatchdogTimeout", "ServerCrashed", "OutOfPages", "PageAllocator",
-    "PagedKVCache", "PrefixCache",
+    "PagedKVCache", "PrefixCache", "RetraceError", "RetraceSentinel",
+    "retrace_sentinel", "session_scope", "to_prometheus",
 ]

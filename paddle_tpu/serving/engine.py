@@ -34,13 +34,14 @@ the `Predictor.generate` serving mode behind
 """
 from __future__ import annotations
 
-import collections
 import time
 
 import numpy as np
 
 from ..core.bucketing import bucket_size, pad_prompt_row, pad_token_rows
+from ..profiler import trace as _trace
 from ..testing import faults
+from . import tracing as _rt
 from .paging import OutOfPages, PageAllocator, PrefixCache, pages_for
 from .metrics import CallbackList, ServingMetrics
 
@@ -124,7 +125,10 @@ class _EngineBase:
         # from the decode-step active mask until _poll_pending splices
         self._pending = set()
         self._last_step_done = None   # decode-step inter-arrival clock
-        self.trace_counts = collections.Counter()
+        # trace_counts is observable: the retrace sentinel / tracer see
+        # every increment (= one jax trace = one compile) as it happens
+        self.trace_counts = _trace.ObservedCounter(
+            owner=type(self).__name__)
         # failure-isolation knobs: every join/decode runs under a
         # capped-exponential retry loop and an optional wall watchdog
         self.max_attempts = max(1, int(max_attempts))
@@ -258,6 +262,8 @@ class _EngineBase:
         self.metrics.record_token()
         if r.first_token_at is None:
             r.first_token_at = now
+            if r._trace is not None:
+                _rt.on_first_token(r)
             if r.submitted_at is not None:
                 self.metrics.record_first_token(now - r.submitted_at)
         self._cbs.emit("on_token", r, tok)
@@ -327,6 +333,8 @@ class _EngineBase:
             s = self._choose_slot(free)
             r.state, r.slot = "RUNNING", s
             self.slots[s] = r
+            if _trace._SESSION is not None:
+                _rt.on_join_begin(r, s)
             try:
                 tok = self._guarded("slot_join",
                                     lambda: self._join_attempt(s, r))
@@ -337,6 +345,8 @@ class _EngineBase:
                 self.slots[s] = None
                 self._evict(s)
                 r.slot = None
+                if r._trace is not None:
+                    _rt.on_join_end(r, ok=False, error=e)
                 self.metrics.record_error("slot_join", e)
                 if not self._join_fallback(r, e):
                     r.fail(e, self.clock())
@@ -346,6 +356,8 @@ class _EngineBase:
                 continue
             joins += 1
             progress = True
+            if r._trace is not None:
+                _rt.on_join_end(r, pending=s in self._pending)
             self.metrics.record_join()
             self._cbs.emit("on_join", r, s)
             if tok is not None:   # prefill already produced token 0
@@ -357,6 +369,8 @@ class _EngineBase:
              for s, r in enumerate(self.slots)], bool)
         if active.any():
             t0 = self.clock()
+            _ts0 = (time.perf_counter()
+                    if _trace._SESSION is not None else 0.0)
             try:
                 toks = self._guarded(
                     "decode_step", lambda: self._decode_attempt(active))
@@ -366,6 +380,9 @@ class _EngineBase:
                 progress = True
             else:
                 now2 = self.clock()
+                if _trace._SESSION is not None:
+                    _rt.on_decode_step(self, _ts0, time.perf_counter(),
+                                       active, scheduler)
                 n = 0
                 for s, r in enumerate(list(self.slots)):
                     if r is not None and active[s]:
@@ -453,7 +470,9 @@ class ServingEngine(_EngineBase):
                 self._fm.params(),
                 f"{type(self).__name__}"
                 f"{'(paged=True)' if paged else ''}")
-        self._compiled = {}
+        # jit cache whose entries the compile observer wraps: each
+        # trace+compile surfaces as a "compile" span with its duration
+        self._compiled = _trace.JitCache(self)
         self._state = None          # lazily built on first join
         self._mem_shape = None
         self._np_dtype = None
@@ -529,11 +548,14 @@ class ServingEngine(_EngineBase):
         self._ensure_state(r.memory)
         pad_id = int(r.eos_id) if r.eos_id is not None else 0
         prompt_b, P0, Pb = pad_prompt_row(r.prompt, pad_id)
+        if r._trace is not None:
+            _rt.on_join_attr(r, prompt_bucket=Pb)
         key = ("join", Pb)
         fn = self._compiled.get(key)
         if fn is None:
             fn = self._build_join(Pb)
             self._compiled[key] = fn
+            fn = self._compiled[key]   # the observed wrapper
         self._state, tok0 = fn(
             self._params(), self._buffers(), self._state,
             jnp.int32(s), jnp.asarray(prompt_b),
@@ -670,6 +692,7 @@ class ServingEngine(_EngineBase):
         if fn is None:
             fn = self._build_step(key)
             self._compiled[key] = fn
+            fn = self._compiled[key]   # the observed wrapper
         self._state, toks = fn(self._params(), self._buffers(),
                                self._state, jnp.asarray(active))
         return np.asarray(toks)
@@ -1001,6 +1024,9 @@ class PagedServingEngine(ServingEngine):
             key = self._prefix_key(prompt_b, P0, r)
             hit = self._prefix.lookup(key)
             self.metrics.record_prefix(hit is not None)
+        if r._trace is not None:
+            _rt.on_join_attr(r, prompt_bucket=Pb,
+                             prefix_hit=hit is not None)
         if hit is not None:
             return self._attach_shared(s, r, hit, P0, Pb)
         return self._prefill_join(
@@ -1018,6 +1044,7 @@ class PagedServingEngine(ServingEngine):
         if fn is None:
             fn = self._build_paged_join(Pb)
             self._compiled[ck] = fn
+            fn = self._compiled[ck]   # the observed wrapper
         try:
             self._state, tok0 = fn(
                 self._params(), self._buffers(), self._state,
@@ -1054,6 +1081,7 @@ class PagedServingEngine(ServingEngine):
         if fn is None:
             fn = self._build_attach()
             self._compiled[ck] = fn
+            fn = self._compiled[ck]   # the observed wrapper
         try:
             self._state = fn(
                 self._cross_params(), self._fm_cross.buffers(),
@@ -1088,6 +1116,7 @@ class PagedServingEngine(ServingEngine):
         if fn is None:
             fn = self._build_cow()
             self._compiled[ck] = fn
+            fn = self._compiled[ck]   # the observed wrapper
         try:
             self._state = fn(self._state, jnp.int32(src),
                              jnp.int32(dst))
@@ -1258,6 +1287,7 @@ class PagedServingEngine(ServingEngine):
         if fn is None:
             fn = self._build_paged_step(ck)
             self._compiled[ck] = fn
+            fn = self._compiled[ck]   # the observed wrapper
         self._state, toks = fn(
             self._params(), self._buffers(), self._state,
             self._device_table(),

@@ -14,7 +14,147 @@ from __future__ import annotations
 import threading
 import time
 
-__all__ = ["ServingMetrics", "ServingCallback", "CallbackList"]
+__all__ = ["ServingMetrics", "ServingCallback", "CallbackList",
+           "SNAPSHOT_DOCS", "flatten_snapshot", "to_prometheus"]
+
+#: Every key `ServingMetrics.snapshot()` can emit, flattened with "."
+#: (reservoir summaries are ONE documented key whose value is the
+#: {n, mean, p50, p99, max} dict). This is the schema of record: the
+#: README "Observability" table renders from it and
+#: tests/test_tracing.py asserts a fully-populated snapshot flattens
+#: to EXACTLY these keys — the snapshot cannot drift silently.
+SNAPSHOT_DOCS = {
+    "requests.submitted": ("counter", "requests accepted by submit()"),
+    "requests.completed": ("counter",
+                           "finished with eos / length / drain"),
+    "requests.rejected": ("counter",
+                          "QueueFull backpressure + admission rejects"),
+    "requests.cancelled": ("counter", "caller-cancelled requests"),
+    "requests.timeouts": ("counter",
+                          "deadline evictions (queued or mid-decode)"),
+    "requests.failed": ("counter", "finished with reason 'error'"),
+    "requests.aborted": ("counter",
+                         "finalized by a non-drain shutdown"),
+    "errors.count": ("counter", "internal failures recorded anywhere"),
+    "errors.retries": ("counter", "retry attempts after a failed op"),
+    "errors.evictions_on_error": (
+        "counter", "in-flight victims of a failed decode step"),
+    "errors.fallbacks": ("counter",
+                         "requests degraded to the solo eager path"),
+    "errors.last": ("info",
+                    "last recorded error {where, type, message, at}"),
+    "joins": ("counter", "successful slot joins"),
+    "iterations": ("counter", "engine iterations run"),
+    "tokens_out": ("counter",
+                   "delivered tokens incl. the prefill first token"),
+    "tokens_per_s": ("gauge", "decode tokens / decode wall seconds"),
+    "ttft_ms": ("summary", "time to first token (submit -> token 0)"),
+    "per_token_ms": ("summary", "batched decode-step wall latency"),
+    "queue_depth": ("summary", "scheduler depth sampled per iteration"),
+    "slot_occupancy": ("summary",
+                       "occupied-slot fraction sampled per iteration"),
+    # sharded pools (PR 7) — the section appears once any of these
+    # record
+    "sharding.prefill_step_ms": (
+        "summary", "prefill-slice step: dispatch -> arrays ready"),
+    "sharding.decode_step_ms": (
+        "summary", "decode-step latency (the per_token_ms reservoir)"),
+    "sharding.step_gap_ms": (
+        "summary",
+        "decode-step inter-arrival co-resident requests see"),
+    "sharding.per_shard_occupancy": (
+        "gauge", "last-iteration occupancy per dp shard of the pool"),
+    "sharding.collective_ms": (
+        "counter", "host-timed cross-slice transfer milliseconds"),
+    "sharding.collective_events": (
+        "counter", "cross-slice transfers (splices, param placement)"),
+    "sharding.collective_time_share": (
+        "gauge", "collective / (collective + prefill + decode) time"),
+    # paged pools (PR 6) — the section appears once a paged engine
+    # records
+    "paging.pages_in_use": ("gauge", "pages mapped at last iteration"),
+    "paging.pages_free": ("gauge", "allocator free pages"),
+    "paging.prefix_hits": ("counter",
+                           "joins served from the prefix cache"),
+    "paging.prefix_misses": ("counter", "joins that ran a real prefill"),
+    "paging.prefix_hit_rate": ("gauge", "hits / (hits + misses)"),
+    "paging.page_waits": ("counter",
+                          "admissions deferred on page headroom"),
+    "paging.oom_evictions": ("counter", "mid-decode OutOfPages victims"),
+    "paging.bytes_per_active_token": (
+        "summary", "cache bytes per live token (oversubscription)"),
+}
+
+_SUMMARY_KEYS = {"n", "mean", "p50", "p99", "max"}
+_LEAF_DICTS = {"errors.last"}
+
+
+def flatten_snapshot(snap, _prefix=""):
+    """Flatten a snapshot() dict to {dotted_key: leaf}. Reservoir
+    summaries ({n, mean, p50, p99, max}) and the last-error record stay
+    leaves — the flattened key set must equal SNAPSHOT_DOCS for a
+    fully-populated snapshot."""
+    out = {}
+    for k, v in snap.items():
+        key = f"{_prefix}{k}"
+        if isinstance(v, dict) and key not in _LEAF_DICTS and \
+                not set(v) <= _SUMMARY_KEYS:
+            out.update(flatten_snapshot(v, key + "."))
+        else:
+            out[key] = v
+    return out
+
+
+def _prom_escape(s):
+    return (str(s).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def to_prometheus(snapshot, tracer=None, prefix="paddle_tpu_serving"):
+    """Render a snapshot() (plus, optionally, a `profiler.trace.Tracer`
+    session's counters) in the Prometheus text exposition format —
+    `tools/metrics_dump.py` is the CLI over this."""
+    lines = []
+
+    def head(name, kind, doc):
+        lines.append(f"# HELP {name} {doc}")
+        lines.append(f"# TYPE {name} "
+                     f"{'counter' if kind == 'counter' else 'gauge'}")
+
+    flat = flatten_snapshot(snapshot)
+    for key in sorted(flat):
+        kind, doc = SNAPSHOT_DOCS.get(key, ("gauge", ""))
+        v = flat[key]
+        name = prefix + "_" + key.replace(".", "_")
+        if v is None:
+            continue
+        if isinstance(v, dict) and set(v) <= _SUMMARY_KEYS:
+            head(name, "gauge", doc)
+            for stat in sorted(v):
+                lines.append(f'{name}{{stat="{stat}"}} {float(v[stat])}')
+        elif kind == "info" and isinstance(v, dict):
+            head(name, "gauge", doc)
+            labels = ",".join(f'{lk}="{_prom_escape(lv)}"'
+                              for lk, lv in sorted(v.items()))
+            lines.append(f"{name}{{{labels}}} 1")
+        elif isinstance(v, (list, tuple)):
+            head(name, kind, doc)
+            for i, sv in enumerate(v):
+                lines.append(f'{name}{{index="{i}"}} {float(sv)}')
+        elif isinstance(v, (int, float)):
+            head(name, kind, doc)
+            lines.append(f"{name} {float(v)}")
+    if tracer is not None:
+        name = prefix + "_tracer_events"
+        head(name, "counter", "tracer session counters")
+        for cname in sorted(tracer.counters):
+            lines.append(f'{name}{{counter="{_prom_escape(cname)}"}} '
+                         f'{float(tracer.counters[cname])}')
+        head(prefix + "_tracer_spans_dropped", "counter",
+             "spans overwritten past the ring-buffer capacity")
+        lines.append(f"{prefix}_tracer_spans_dropped "
+                     f"{float(tracer.dropped)}")
+    return "\n".join(lines) + "\n"
 
 
 class _Reservoir:

@@ -48,6 +48,7 @@ import numpy as np
 
 from ..core.bucketing import pad_prompt_row
 from ..testing import faults
+from . import tracing as _rt
 from .engine import PagedServingEngine, ServingEngine, _PT_PREFILL
 
 __all__ = ["ShardedServingEngine", "ShardedPagedServingEngine"]
@@ -340,6 +341,7 @@ class ShardedServingEngine(ServingEngine):
         if fn is None:
             fn = self._build_prefill(Pb)
             self._compiled[key] = fn
+            fn = self._compiled[key]   # the observed wrapper
         mem = np.asarray(r.memory, self._np_dtype)[None]
         outs = fn(self._pparams, self._pbuffers,
                   jnp.asarray(prompt_b), jnp.asarray([P0], jnp.int32),
@@ -461,6 +463,7 @@ class ShardedServingEngine(ServingEngine):
                 if fn is None:
                     fn = self._build_splice(Pb)
                     self._compiled[key] = fn
+                    fn = self._compiled[key]   # observed wrapper
                 tok0, kvs, statics, bias_row = moved
                 self._state = fn(self._state, jnp.int32(s), tok0,
                                  bias_row, kvs, statics,
@@ -472,6 +475,8 @@ class ShardedServingEngine(ServingEngine):
                 self.slots[s] = None
                 self._evict(s)
                 r.slot = None
+                if r._trace is not None:
+                    _rt.on_splice_end(r, ok=False, error=e)
                 self.metrics.record_error("prefill_splice", e)
                 r.fail(e, self.clock())
                 self.metrics.record_finish("error")
@@ -479,6 +484,8 @@ class ShardedServingEngine(ServingEngine):
                 continue
             self._pending.discard(s)
             self._pending_info.pop(s, None)
+            if r._trace is not None:
+                _rt.on_splice_end(r, ok=True)
             self._deliver(r, tok0, self.clock())
             activated = True
         return activated

@@ -29,7 +29,6 @@ makes the two paths bit-comparable).
 """
 from __future__ import annotations
 
-import collections
 import inspect
 
 from ..nn.layer.layers import Layer
@@ -37,6 +36,7 @@ from ..nn.layer.transformer import MultiHeadAttention
 from ..core.bucketing import bucket_size, pad_rows as _pad_rows  # noqa: F401
 from ..core.tensor import Tensor
 from ..parallel.functional import functionalize
+from ..profiler import trace as _trace
 from .decode import beam_search, greedy_search
 
 NEG = -1e30
@@ -117,8 +117,10 @@ class DecodeEngine:
         self.project_ref = project
         self._net = _StepNet(decoder, embed, project)
         self._fm = functionalize(self._net)
-        self._compiled = {}
-        self.trace_counts = collections.Counter()
+        # observable jit cache + trace counter: the compile observer /
+        # retrace sentinel (profiler.trace) see every compile
+        self._compiled = _trace.JitCache(self)
+        self.trace_counts = _trace.ObservedCounter(owner="DecodeEngine")
 
     # ------------------------------------------------------------------
     def generate(self, memory, prompt=None, prompt_lengths=None, *,
@@ -160,6 +162,7 @@ class DecodeEngine:
         if fn is None:
             fn = self._build(key)
             self._compiled[key] = fn
+            fn = self._compiled[key]   # the observed wrapper
         args = [self._fm.params(), self._fm.buffers(), memory_b,
                 prompt_b, lengths_b]
         if mm_b is not None:

@@ -54,3 +54,13 @@ def _disarm_faults():
     from paddle_tpu.testing import faults
 
     faults.reset()
+
+
+@pytest.fixture(autouse=True)
+def _disarm_tracing():
+    """Tracer sessions / retrace sentinels must never leak across
+    tests (a test may arm a standing sentinel without a with-block)."""
+    yield
+    from paddle_tpu.profiler import trace
+
+    trace.reset()

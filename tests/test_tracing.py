@@ -1,0 +1,512 @@
+"""End-to-end request tracing, compile observer, retrace sentinel.
+
+Covers: raw tracer span nesting/ordering and the chrome-trace JSON
+schema round-trip; the per-request waterfall completeness contract
+under a ragged-arrival soak on the dense, paged and sharded engines
+(every admitted request exports a complete queue -> join -> decode ->
+finish/error waterfall, loadable in Perfetto); compile-observer spans
+(one per jit trace, with duration and cache key); the retrace sentinel
+(raise and log modes, budget overrides); disabled-mode cost (nothing
+recorded, zero allocations attributable to the tracing modules on the
+decode hot path); the chaos cell (an evicted request's trace ends with
+an error span); the profiler.RecordEvent fix (event_type recorded,
+bounded buffer, surfaces into an active tracer session); and the
+ServingMetrics snapshot schema (flattened keys == SNAPSHOT_DOCS ==
+the README tables, Prometheus rendering).
+"""
+import json
+
+import numpy as np
+import pytest
+
+from paddle_tpu import nn
+from paddle_tpu.nn.layer.transformer import (TransformerDecoder,
+                                             TransformerDecoderLayer)
+from paddle_tpu.profiler import trace as T
+from paddle_tpu.serving import (Request, Scheduler, ServingEngine,
+                                retrace_sentinel)
+from paddle_tpu.serving import tracing as rt
+from paddle_tpu.serving.metrics import (SNAPSHOT_DOCS, ServingMetrics,
+                                        flatten_snapshot, to_prometheus)
+from paddle_tpu.testing import faults
+
+
+def _small_stack(seed=7, D=32, H=2, V=17, layers=2):
+    np.random.seed(seed)
+    layer = TransformerDecoderLayer(D, H, 64, dropout=0.0)
+    dec = TransformerDecoder(layer, layers)
+    dec.eval()
+    embed = nn.Embedding(V, D)
+    proj = nn.Linear(D, V)
+    return dec, embed, proj, D, V
+
+
+def _mk_request(rs, D, V, pmax=6, nmax=10, **kw):
+    P = int(rs.randint(1, pmax + 1))
+    prompt = rs.randint(2, V, (P,)).astype(np.int32)
+    prompt[0] = 0
+    mem_seed = int(prompt.sum()) * 131 + P
+    mem = np.random.RandomState(mem_seed).randn(4, D).astype("f4")
+    n = int(rs.randint(2, nmax + 1))
+    return Request(prompt, mem, max_new_tokens=n, eos_id=1, **kw)
+
+
+def _ragged_soak(eng, stack, n_requests, seed, sched=None):
+    """Submit `n_requests` in ragged waves between iterations; drive to
+    idle; every future must resolve ok. Returns the requests."""
+    D, V = stack[3], stack[4]
+    sched = sched or Scheduler(max_queue=4 * n_requests)
+    rs = np.random.RandomState(seed)
+    reqs = []
+
+    def wave(k):
+        for _ in range(k):
+            r = _mk_request(rs, D, V)
+            sched.submit(r)
+            reqs.append(r)
+
+    wave(4)
+    it = 0
+    while len(reqs) < n_requests or sched.depth() > 0 or \
+            eng.occupancy() > 0:
+        eng.run_iteration(sched)
+        it += 1
+        if len(reqs) < n_requests and it % 3 == 0:
+            wave(int(rs.randint(1, 5)))
+        assert it < 3000
+    for r in reqs:
+        assert r.result(timeout=5).ok
+    return reqs
+
+
+def _check_export(tr, reqs, tmp_path, tag):
+    """Export -> reload -> schema + waterfall-completeness assertions
+    shared by the dense/paged/sharded soaks."""
+    path = str(tmp_path / f"{tag}.json")
+    tr.export_chrome_trace(path)
+    payload = json.load(open(path))
+    assert set(payload) == {"traceEvents", "displayTimeUnit"}
+    events = payload["traceEvents"]
+    # chrome-trace schema: every event has the required fields and
+    # non-negative relative timestamps/durations
+    for ev in events:
+        assert ev["ph"] in ("X", "M", "C"), ev
+        assert "name" in ev and "pid" in ev
+        if ev["ph"] == "X":
+            assert ev["ts"] >= 0 and ev["dur"] >= 0
+    # waterfall completeness: every admitted request has queue + join
+    # spans and a terminal finish event, grouped by its trace id
+    wf = rt.waterfalls(events)
+    ids = {r.id for r in reqs}
+    assert ids <= set(wf), (sorted(ids), sorted(wf))
+    for r in reqs:
+        w = wf[r.id]
+        assert w["complete"], (r.id, sorted(
+            e["name"] for e in w["spans"]))
+        assert w["terminal"] == "finish"
+        assert w["tokens"] == len(r.result().tokens)
+        assert w["total_ms"] >= w["phases"]["queue"] >= 0
+    # the report renders
+    rep = rt.waterfall_report(events, top=3)
+    assert "phase" in rep and "p50(ms)" in rep and "req " in rep
+    return events
+
+
+# ----------------------------------------------------------------------
+# raw tracer: nesting, ordering, schema round-trip
+# ----------------------------------------------------------------------
+
+def test_span_nesting_ordering_and_roundtrip(tmp_path):
+    tr = T.Tracer(capacity=16)
+    root = tr.begin("request", cat="request", trace_id=9)
+    child = tr.begin("queue", cat="request", trace_id=9, parent=root)
+    with tr.span("inner", cat="span", trace_id=9, parent=child):
+        pass
+    tr.end(child)
+    tr.instant("finish", cat="request", trace_id=9, parent=root)
+    tr.end(root, reason="eos")
+    spans = tr.spans()
+    by_name = {s.name: s for s in spans}
+    assert by_name["queue"].parent_id == root.span_id
+    assert by_name["inner"].parent_id == child.span_id
+    # nesting: child intervals inside the parent's
+    assert root.t0 <= child.t0 <= child.t1 <= root.t1
+    assert child.t0 <= by_name["inner"].t0 <= by_name["inner"].t1 \
+        <= child.t1
+    # completion order in the ring: inner ended before queue, queue
+    # before request
+    names = [s.name for s in spans]
+    assert names.index("inner") < names.index("queue") < \
+        names.index("request")
+    # round-trip
+    path = tr.export_chrome_trace(str(tmp_path / "t.json"))
+    evs = rt.load_chrome_trace(path)
+    req_evs = [e for e in evs if e["ph"] == "X"]
+    assert {e["name"] for e in req_evs} == {"request", "queue",
+                                           "inner", "finish"}
+    for e in req_evs:
+        assert e["args"]["trace_id"] == 9
+    # parent ids survive export
+    q = next(e for e in req_evs if e["name"] == "queue")
+    assert q["args"]["parent_id"] == root.span_id
+
+
+def test_ring_buffer_caps_and_counts_drops():
+    tr = T.Tracer(capacity=8)
+    for i in range(20):
+        tr.instant(f"e{i}")
+    assert len(tr.spans()) == 8
+    assert tr.dropped == 12
+    assert [s.name for s in tr.spans()] == [f"e{i}"
+                                            for i in range(12, 20)]
+
+
+def test_session_management():
+    assert T.session() is None
+    tr = T.start_session()
+    try:
+        with pytest.raises(RuntimeError, match="already active"):
+            T.start_session()
+        assert T.session() is tr
+    finally:
+        assert T.end_session() is tr
+    assert T.session() is None and T.end_session() is None
+
+
+# ----------------------------------------------------------------------
+# the acceptance soaks: dense / paged / sharded waterfalls
+# ----------------------------------------------------------------------
+
+def test_waterfall_soak_dense_engine(tmp_path):
+    """Ragged-arrival soak on the dense pool under a tracer session +
+    retrace sentinel: complete per-request waterfalls, compile spans
+    with durations, decode.step spans carrying the co-residents."""
+    dec, embed, proj, D, V = _small_stack(seed=121)
+    eng = ServingEngine(dec, embed, proj, num_slots=4, max_len=32)
+    with T.session_scope() as tr, retrace_sentinel(eng):
+        reqs = _ragged_soak(eng, (dec, embed, proj, D, V), 16,
+                            seed=122)
+    events = _check_export(tr, reqs, tmp_path, "dense")
+    # compile observer: one span per jit trace, duration > 0, count 1
+    compiles = [e for e in events if e["name"] == "compile"]
+    assert compiles, "no compile spans recorded"
+    keys = {e["args"]["key"] for e in compiles}
+    assert any("'step'" in k for k in keys), keys
+    assert any("'join'" in k for k in keys), keys
+    for e in compiles:
+        assert e["dur"] > 0 and e["args"]["count"] == 1
+    # every request co-resided in at least one recorded decode step
+    steps = [e for e in events if e["name"] == "decode.step"]
+    assert steps
+    seen = set()
+    for e in steps:
+        assert e["args"]["n_active"] == len(e["args"]["slots"])
+        seen.update(e["args"]["slots"])
+    decoded = {r.id for r in reqs if len(r.result().tokens) > 1}
+    assert decoded <= seen
+
+
+def test_waterfall_soak_paged_engine(tmp_path):
+    """Same soak through the paged pool: pjoin/pstep compile keys,
+    prefix_hit attribute on join spans, page gauges on decode.step."""
+    dec, embed, proj, D, V = _small_stack(seed=131)
+    eng = ServingEngine(dec, embed, proj, num_slots=4, max_len=32,
+                        paged=True, page_size=8)
+    sched = Scheduler(max_queue=64)
+    rs = np.random.RandomState(132)
+    protos = [_mk_request(rs, D, V) for _ in range(4)]
+    with T.session_scope() as tr, retrace_sentinel(eng):
+        reqs = []
+        for i in range(10):            # repeats ride the prefix cache
+            p = protos[i % len(protos)]
+            r = Request(p.prompt.copy(), p.memory,
+                        max_new_tokens=p.max_new_tokens, eos_id=1)
+            sched.submit(r)
+            reqs.append(r)
+            eng.run_iteration(sched)
+        it = 0
+        while sched.depth() > 0 or eng.occupancy() > 0:
+            eng.run_iteration(sched)
+            it += 1
+            assert it < 2000
+        for r in reqs:
+            assert r.result(timeout=5).ok
+    events = _check_export(tr, reqs, tmp_path, "paged")
+    joins = [e for e in events if e["name"] == "join"]
+    hits = [e for e in joins if e["args"].get("prefix_hit")]
+    assert hits, "no prefix-hit join spans despite repeated prompts"
+    misses = [e for e in joins if e["args"].get("prefix_hit") is False]
+    assert misses
+    steps = [e for e in events if e["name"] == "decode.step"]
+    assert all("pages_in_use" in e["args"] and "pages_free" in
+               e["args"] for e in steps), steps[0]["args"]
+    keys = {e["args"]["key"] for e in events if e["name"] == "compile"}
+    assert any("'pstep'" in k for k in keys), keys
+
+
+def test_waterfall_soak_sharded_engine(tmp_path):
+    """Same soak through the mesh-sharded pool (dp2 x fsdp2 x tp2):
+    complete waterfalls plus shard-occupancy gauges on decode.step."""
+    from paddle_tpu.parallel import init_mesh
+    from paddle_tpu.serving import ShardedServingEngine
+
+    mesh = init_mesh(dp=2, fsdp=2, tp=2)
+    dec, embed, proj, D, V = _small_stack(seed=141)
+    eng = ShardedServingEngine(dec, embed, proj, mesh=mesh,
+                               num_slots=4, max_len=32)
+    with T.session_scope() as tr, retrace_sentinel(eng):
+        reqs = _ragged_soak(eng, (dec, embed, proj, D, V), 8, seed=142)
+    events = _check_export(tr, reqs, tmp_path, "sharded")
+    steps = [e for e in events if e["name"] == "decode.step"]
+    assert steps and all(len(e["args"]["shard_occupancy"]) == 2
+                         for e in steps)
+
+
+# ----------------------------------------------------------------------
+# chaos: an evicted request's trace ends with an error span
+# ----------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_evicted_request_trace_ends_with_error_span(tmp_path):
+    dec, embed, proj, D, V = _small_stack(seed=151)
+    eng = ServingEngine(dec, embed, proj, num_slots=2, max_len=32,
+                        max_attempts=2, backoff_base_s=0.0)
+    sched = Scheduler(max_queue=8)
+    rs = np.random.RandomState(152)
+    with T.session_scope() as tr:
+        a = Request(np.asarray([0, 3, 4], np.int32),
+                    rs.randn(4, D).astype("f4"), max_new_tokens=20,
+                    eos_id=None)
+        sched.submit(a)
+        for _ in range(3):
+            eng.run_iteration(sched)
+        assert len(a.tokens) >= 2
+        with faults.inject("serving.decode_step", on="always",
+                           max_fires=2):
+            eng.run_iteration(sched)
+        assert a.result(timeout=5).finish_reason == "error"
+        # a failed JOIN also traces as an error terminal
+        b = _mk_request(rs, D, V)
+        sched.submit(b)
+        with faults.inject("serving.prefill", on="always"):
+            eng.run_iteration(sched)
+        with pytest.raises(faults.InjectedFault):
+            b.result(timeout=5)
+    events = tr.chrome_trace_events()
+    wf = rt.waterfalls(events)
+    for r in (a, b):
+        w = wf[r.id]
+        assert w["terminal"] == "error", w
+        err = [e for e in w["spans"] if e["name"] == "error"]
+        assert err and err[0]["args"]["error"] == "InjectedFault"
+        # the error event is the LAST event of the request's trace
+        assert w["spans"][-1]["name"] in ("error", "request")
+    # failed join span is closed with ok=False
+    joins = [e for e in wf[b.id]["spans"] if e["name"] == "join"]
+    assert joins and joins[-1]["args"]["ok"] is False
+
+
+# ----------------------------------------------------------------------
+# retrace sentinel
+# ----------------------------------------------------------------------
+
+def test_retrace_sentinel_raise_log_and_budgets():
+    dec, embed, proj, D, V = _small_stack(seed=161)
+    eng = ServingEngine(dec, embed, proj, num_slots=2, max_len=32)
+    sched = Scheduler(max_queue=8)
+    rs = np.random.RandomState(162)
+    with retrace_sentinel(eng) as s:
+        r = _mk_request(rs, D, V)
+        sched.submit(r)
+        eng.serve_until_idle(sched, max_iterations=200)
+        assert r.result(timeout=5).ok
+        assert not s.violations          # first compiles are in budget
+    step_key = ("step",) + eng._pool_key
+    # a retrace (count -> 2) fires the sentinel at the offending trace
+    with retrace_sentinel(eng):
+        with pytest.raises(T.RetraceError, match="traced 2 times"):
+            eng.trace_counts[step_key] += 1
+    eng.trace_counts[step_key] -= 1      # undo the simulated retrace
+    # log mode records instead of raising; assert_ok surfaces it
+    with retrace_sentinel(eng, mode="log") as s:
+        eng.trace_counts[step_key] += 1
+        assert len(s.violations) == 1
+        assert s.violations[0]["key"] == step_key
+        with pytest.raises(T.RetraceError):
+            s.assert_ok()
+    eng.trace_counts[step_key] -= 1
+    # budget overrides by key kind
+    with retrace_sentinel(eng, budgets={"step": 3}) as s:
+        eng.trace_counts[step_key] += 1  # count 2 <= budget 3
+        eng.trace_counts[("join", 2)] = 1
+        assert not s.violations
+    eng.trace_counts[step_key] -= 1
+    # outside any sentinel scope increments are free again
+    eng.trace_counts[step_key] += 5
+    eng.trace_counts[step_key] -= 5
+
+
+def test_sentinel_violation_fails_request_loudly():
+    """A retrace mid-serve surfaces as a failed request (the sentinel
+    raises inside the traced body), never a silent slowdown."""
+    dec, embed, proj, D, V = _small_stack(seed=171)
+    eng = ServingEngine(dec, embed, proj, num_slots=2, max_len=32,
+                        max_attempts=1)
+    sched = Scheduler(max_queue=8)
+    rs = np.random.RandomState(172)
+    r0 = _mk_request(rs, D, V)
+    sched.submit(r0)
+    eng.serve_until_idle(sched, max_iterations=200)
+    assert r0.result(timeout=5).ok
+    # simulate a retrace regression: drop a compiled join program so
+    # the next join of that bucket traces AGAIN under the sentinel
+    jkey = next(k for k in eng.trace_counts if k[0] == "join")
+    raw = dict.__getitem__(eng._compiled, jkey)   # keep cache type
+    del eng._compiled[jkey]
+    try:
+        with retrace_sentinel(eng):
+            r1 = Request(r0.prompt.copy(), r0.memory,
+                         max_new_tokens=4, eos_id=1)
+            sched.submit(r1)
+            for _ in range(3):
+                eng.run_iteration(sched)
+        with pytest.raises(T.RetraceError):
+            r1.result(timeout=5)
+    finally:
+        dict.__setitem__(eng._compiled, jkey, raw)
+
+
+# ----------------------------------------------------------------------
+# disabled mode: nothing recorded, nothing allocated
+# ----------------------------------------------------------------------
+
+def test_disabled_mode_records_and_allocates_nothing():
+    import tracemalloc
+
+    dec, embed, proj, D, V = _small_stack(seed=181)
+    eng = ServingEngine(dec, embed, proj, num_slots=2, max_len=128)
+    sched = Scheduler(max_queue=8)
+    rs = np.random.RandomState(182)
+    r = Request(np.asarray([0, 3], np.int32),
+                rs.randn(4, D).astype("f4"), max_new_tokens=100,
+                eos_id=None)
+    sched.submit(r)
+    for _ in range(5):                   # join + warm the decode step
+        eng.run_iteration(sched)
+    assert r._trace is None              # no session at submit
+    tracemalloc.start()
+    snap1 = tracemalloc.take_snapshot()
+    for _ in range(20):
+        eng.run_iteration(sched)
+    snap2 = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    grew = [d for d in snap2.compare_to(snap1, "filename")
+            if d.size_diff > 0 and any(
+                m in (d.traceback[0].filename or "")
+                for m in ("profiler/trace", "serving/tracing"))]
+    assert not grew, [str(g) for g in grew]
+    assert T.session() is None
+    r.cancel()
+    eng.serve_until_idle(sched, max_iterations=50)
+
+
+# ----------------------------------------------------------------------
+# profiler.RecordEvent satellite
+# ----------------------------------------------------------------------
+
+def test_record_event_type_capacity_and_tracer_surface():
+    import paddle_tpu.profiler as prof
+
+    prof.reset()
+    with prof.RecordEvent("unit_x", event_type="kernel"):
+        pass
+    evs = prof.events()
+    assert evs and evs[-1][0] == "unit_x" and evs[-1][1] == "kernel"
+    assert "kernel" in prof.summary()
+    # bounded buffer: capacity cap keeps the NEWEST events
+    old_cap = prof._EVENTS_CAP
+    try:
+        prof.set_events_capacity(4)
+        for i in range(7):
+            with prof.RecordEvent(f"e{i}"):
+                pass
+        names = [e[0] for e in prof.events()]
+        assert names == ["e3", "e4", "e5", "e6"]
+    finally:
+        prof.set_events_capacity(old_cap)
+    prof.reset()
+    assert prof.events() == []
+    # surfaces into an active tracer session
+    with T.session_scope() as tr:
+        with prof.RecordEvent("in_session", event_type="step"):
+            pass
+    spans = [s for s in tr.spans() if s.name == "in_session"]
+    assert len(spans) == 1 and spans[0].cat == "record_event"
+    assert spans[0].attrs["event_type"] == "step"
+
+
+# ----------------------------------------------------------------------
+# snapshot schema + Prometheus + README sync
+# ----------------------------------------------------------------------
+
+def _full_metrics():
+    """A ServingMetrics with every section populated (paging +
+    sharding gauges recorded) — no engine needed."""
+    m = ServingMetrics()
+    m.record_submit()
+    m.record_join()
+    m.record_first_token(0.01)
+    m.record_token()
+    m.record_decode(1, 0.002)
+    m.record_finish("eos")
+    m.record_error("stream_cb", RuntimeError("x"))
+    m.record_retry("slot_join")
+    m.record_prefix(True)
+    m.record_page_wait()
+    m.record_oom_eviction()
+    m.record_step_gap(0.001)
+    m.record_prefill_step(0.003)
+    m.record_collective(0.001)
+    m.record_iteration(1, 0.5, pages_in_use=3, pages_free=5,
+                       bytes_per_active_token=128.0,
+                       shard_occupancy=[0.5, 0.25])
+    return m
+
+
+def test_snapshot_schema_matches_docs_exactly():
+    flat = flatten_snapshot(_full_metrics().snapshot())
+    assert set(flat) == set(SNAPSHOT_DOCS), (
+        sorted(set(flat) ^ set(SNAPSHOT_DOCS)))
+    # base sections only: still a strict subset of the documented keys
+    flat_base = flatten_snapshot(ServingMetrics().snapshot())
+    assert set(flat_base) < set(SNAPSHOT_DOCS)
+
+
+def test_prometheus_rendering():
+    m = _full_metrics()
+    tr = T.Tracer()
+    tr.count("compiles", 3)
+    text = to_prometheus(m.snapshot(), tracer=tr)
+    assert "# TYPE paddle_tpu_serving_requests_submitted counter" \
+        in text
+    assert "paddle_tpu_serving_requests_submitted 1.0" in text
+    assert 'paddle_tpu_serving_ttft_ms{stat="p50"}' in text
+    assert 'paddle_tpu_serving_sharding_per_shard_occupancy' \
+           '{index="1"} 0.25' in text
+    assert 'where="stream_cb"' in text          # errors.last info
+    assert 'counter="compiles"} 3.0' in text
+    # a snapshot without optional sections renders too
+    assert "paging" not in to_prometheus(ServingMetrics().snapshot())
+
+
+def test_readme_documents_snapshot_keys_and_span_taxonomy():
+    import os
+
+    readme = open(os.path.join(os.path.dirname(__file__), "..",
+                               "README.md")).read()
+    for key in SNAPSHOT_DOCS:
+        assert f"`{key}`" in readme, \
+            f"README metrics table is missing `{key}`"
+    for name, _ in rt.SPAN_TAXONOMY:
+        assert f"`{name}`" in readme, \
+            f"README span-taxonomy table is missing `{name}`"

@@ -18,6 +18,8 @@ cell is reported as HANG and the run exits nonzero. Usage:
 
     JAX_PLATFORMS=cpu python tools/chaos_check.py [--timeout-s 120]
     python tools/chaos_check.py --list          # print the matrix
+    python tools/chaos_check.py --trace         # + chrome-trace
+                                                #   artifact per cell
 
 The equivalent in-suite coverage is `pytest -m chaos`; this script is
 the standalone gate (no pytest, explicit exit code) for CI cron.
@@ -213,16 +215,32 @@ MATRIX = (
 )
 
 
-def run_cell(point, action, fn, timeout_s):
+def run_cell(point, action, fn, timeout_s, trace_dir=None):
     box = {}
 
     def work():
+        tr = None
+        if trace_dir:
+            from paddle_tpu.profiler import trace as T
+
+            T.end_session()   # clear a session a hung cell leaked
+            tr = T.start_session()
         try:
             fn(point, action)
             box["ok"] = True
         except BaseException as e:
             box["err"] = f"{type(e).__name__}: {e}"
             box["tb"] = traceback.format_exc()
+        finally:
+            if tr is not None:
+                from paddle_tpu.profiler import trace as T
+
+                T.end_session()
+                path = os.path.join(
+                    trace_dir,
+                    f"chaos_{point.replace('.', '_')}_{action}.json")
+                tr.export_chrome_trace(path)
+                box["trace"] = path
 
     t = threading.Thread(target=work, daemon=True)
     t0 = time.monotonic()
@@ -233,7 +251,7 @@ def run_cell(point, action, fn, timeout_s):
         return "HANG", dt, f"cell still running after {timeout_s}s"
     if "err" in box:
         return "FAIL", dt, box["err"]
-    return "ok", dt, ""
+    return "ok", dt, box.get("trace", "")
 
 
 def main(argv=None):
@@ -244,7 +262,18 @@ def main(argv=None):
                     help="comma-separated substring filter on points")
     ap.add_argument("--list", action="store_true",
                     help="print the matrix and exit")
+    ap.add_argument("--trace", action="store_true",
+                    help="write a chrome-trace artifact per cell "
+                         "(inspect with tools/trace_report.py or "
+                         "Perfetto)")
+    ap.add_argument("--trace-dir",
+                    default="/tmp/paddle_tpu_chaos_traces",
+                    help="directory for --trace artifacts")
     args = ap.parse_args(argv)
+    trace_dir = None
+    if args.trace:
+        trace_dir = args.trace_dir
+        os.makedirs(trace_dir, exist_ok=True)
     cells = [(p, a, f) for p, a, f in MATRIX
              if not args.points or any(s and s in p for s in
                                        args.points.split(","))]
@@ -254,7 +283,8 @@ def main(argv=None):
         return 0
     failures = 0
     for p, a, f in cells:
-        status, dt, msg = run_cell(p, a, f, args.timeout_s)
+        status, dt, msg = run_cell(p, a, f, args.timeout_s,
+                                   trace_dir=trace_dir)
         print(f"{p:24s} x {a:8s} {status:5s} {dt:7.2f}s  {msg}")
         if status != "ok":
             failures += 1

@@ -74,6 +74,38 @@ def main():
         else:
             print(f"ok: {name}: README {claimed:g} <= artifact {artifact:g} (+{TOLERANCE:.0%})")
 
+    # traced-overhead hygiene (PR 8): the serving bench row must carry
+    # the tracing-ON-vs-OFF claim, and the artifact must back it — the
+    # bench ASSERTS <2% in-run, so a missing/over-budget record means
+    # the observability layer regressed or the row went stale.
+    serving_rows = [ln for ln in readme.splitlines()
+                    if ln.startswith("|") and re.search(
+                        r"Continuous-batching serving", ln)]
+    if not serving_rows:
+        failures.append("traced overhead: README 'Continuous-batching "
+                        "serving' bench row not found")
+    elif not re.search(r"[Tt]raced overhead.*<\s*2\s*%",
+                       serving_rows[0]):
+        failures.append("traced overhead: serving bench row does not "
+                        "mention the asserted '<2%' traced overhead")
+    else:
+        try:
+            ov = details["serving_throughput"]["trace_overhead"]
+            pct = float(ov["overhead_pct"])
+        except (KeyError, TypeError, ValueError) as e:
+            failures.append(f"traced overhead: BENCH_DETAILS "
+                            f"serving_throughput.trace_overhead "
+                            f"unreadable: {e!r}")
+        else:
+            checked += 1
+            if pct >= 2.0:
+                failures.append(
+                    f"traced overhead: artifact records {pct}% >= the "
+                    f"2% budget the bench asserts")
+            else:
+                print(f"ok: traced overhead: README '<2%' backed by "
+                      f"artifact {pct}%")
+
     if failures:
         print("README bench-claim check FAILED:", file=sys.stderr)
         for f in failures:

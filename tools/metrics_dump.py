@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Prometheus text-format dump of ServingMetrics (+ tracer counters).
+
+The serving runtime's `ServingMetrics.snapshot()` is a nested dict;
+operators scrape flat Prometheus metrics. This CLI renders the one into
+the other via `paddle_tpu.serving.metrics.to_prometheus` (the schema of
+record is `SNAPSHOT_DOCS` — every snapshot key is documented there and
+the doc test pins the two in sync). Usage:
+
+    # render a saved snapshot (json.dump(engine.metrics.snapshot()))
+    python tools/metrics_dump.py --snapshot snap.json [-o out.prom]
+
+    # drive a tiny in-process pool and dump ITS metrics (self-test /
+    # schema preview; runs on the CPU pin, no hardware needed)
+    JAX_PLATFORMS=cpu python tools/metrics_dump.py --demo
+
+In-process, prefer the library route:
+
+    from paddle_tpu.serving import to_prometheus
+    text = to_prometheus(engine.metrics.snapshot(), tracer=tracer)
+"""
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _demo_snapshot():
+    """Serve a few requests through a tiny pool under a tracer session
+    and return (snapshot, tracer)."""
+    import numpy as np
+
+    from paddle_tpu import nn
+    from paddle_tpu.nn.layer.transformer import (TransformerDecoder,
+                                                 TransformerDecoderLayer)
+    from paddle_tpu.serving import (Request, Scheduler, ServingEngine,
+                                    session_scope)
+
+    np.random.seed(0)
+    layer = TransformerDecoderLayer(32, 2, 64, dropout=0.0)
+    dec = TransformerDecoder(layer, 2)
+    dec.eval()
+    eng = ServingEngine(dec, nn.Embedding(17, 32), nn.Linear(32, 17),
+                        num_slots=4, max_len=32)
+    sched = Scheduler(max_queue=16)
+    rs = np.random.RandomState(1)
+    with session_scope() as tr:
+        reqs = []
+        for _ in range(6):
+            P = int(rs.randint(1, 6))
+            prompt = rs.randint(2, 17, (P,)).astype(np.int32)
+            prompt[0] = 0
+            r = Request(prompt, rs.randn(4, 32).astype("f4"),
+                        max_new_tokens=int(rs.randint(2, 8)), eos_id=1)
+            sched.submit(r)
+            reqs.append(r)
+        eng.serve_until_idle(sched, max_iterations=500)
+        for r in reqs:
+            assert r.result(timeout=5).ok
+    return eng.metrics.snapshot(), tr
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--snapshot",
+                    help="path to a json.dump'd metrics snapshot")
+    ap.add_argument("--demo", action="store_true",
+                    help="drive a tiny in-process pool and dump it")
+    ap.add_argument("--prefix", default="paddle_tpu_serving")
+    ap.add_argument("-o", "--out", help="write here instead of stdout")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.serving.metrics import to_prometheus
+
+    tracer = None
+    if args.demo:
+        snap, tracer = _demo_snapshot()
+    elif args.snapshot:
+        with open(args.snapshot) as f:
+            snap = json.load(f)
+    else:
+        ap.error("one of --snapshot or --demo is required")
+    text = to_prometheus(snap, tracer=tracer, prefix=args.prefix)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out} ({len(text.splitlines())} lines)")
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
