@@ -1081,6 +1081,58 @@ def _decode_throughput(points=((4, 64), (16, 64), (4, 128)),
                        "parity_checked": True}}
 
 
+def _model_param_bytes(*nets):
+    """Analytic weight bytes: every parameter's size x itemsize,
+    straight off the Layer API (independent of the engines' ledger)."""
+    total = 0
+    for net in nets:
+        for p in net.parameters():
+            total += int(np.prod(p.shape)) * 4
+    return total
+
+
+def _expected_dense_pool_bytes(dec, *, num_slots, max_len, mem_len,
+                               d_model, itemsize=4):
+    """Closed-form dense slot-pool footprint: per layer the [S, H, L,
+    D] K+V incremental caches + int32 write index and the [S, Hc, M,
+    Dc] cross-attention K+V, plus the pooled tok/bias/memory rows."""
+    S, L, M = num_slots, max_len, mem_len
+    total = 4 * S + 4 * S * L + itemsize * S * M * d_model
+    for layer in dec.layers:
+        h, dh = layer.self_attn.num_heads, layer.self_attn.head_dim
+        total += 2 * S * h * L * dh * itemsize + 4 * S
+        hc, dc = layer.cross_attn.num_heads, layer.cross_attn.head_dim
+        total += 2 * S * hc * M * dc * itemsize
+    return total
+
+
+def _expected_paged_pool_bytes(dec, *, num_slots, max_len, mem_len,
+                               d_model, page_size, num_pages,
+                               kv_dtype=None, itemsize=4):
+    """Closed-form paged pool footprint: per layer the [P+1, H, page,
+    D] K+V page arrays in the storage dtype (+ per-(page, head) f32
+    scales when quantized) and the [S, Hc, M, Dc] cross K+V, plus
+    tok/bias/memory rows and the int32 page table."""
+    from paddle_tpu.serving.paging import resolve_kv_dtype
+
+    import jax.numpy as jnp
+
+    S, L, M = num_slots, max_len, mem_len
+    max_pages = L // page_size
+    total = 4 * S + 4 * S * L + itemsize * S * M * d_model
+    total += S * max_pages * 4                    # device page table
+    storage, quantized = resolve_kv_dtype(kv_dtype, jnp.float32)
+    st_item = jnp.dtype(storage).itemsize
+    for layer in dec.layers:
+        h, dh = layer.self_attn.num_heads, layer.self_attn.head_dim
+        total += 2 * (num_pages + 1) * h * page_size * dh * st_item
+        if quantized:
+            total += 2 * (num_pages + 1) * h * 4  # [P+1, H, 1, 1] f32
+        hc, dc = layer.cross_attn.num_heads, layer.cross_attn.head_dim
+        total += 2 * S * hc * M * dc * itemsize
+    return total
+
+
 def _serving_throughput(n_requests=48, num_slots=8, d_model=128,
                         nhead=4, ffn=256, n_layers=2, vocab=512,
                         mem_len=8, max_new=12, prompt_max=8):
@@ -1195,29 +1247,36 @@ def _serving_throughput(n_requests=48, num_slots=8, d_model=128,
     stat_wall = time.perf_counter() - t0
     stat_ttft = np.asarray(stat_ttft)
 
-    # ---- traced-overhead A/B on the decode step ----
+    # ---- armed-overhead A/B on the decode step ----
     # A steady pool (4 resident requests, no joins, no finishes) runs
-    # pure decode iterations in alternating groups with the tracer OFF
-    # and ON; identical compiled work either way, so the medians
-    # isolate the tracer's own cost. Asserted: tracing ON stays within
-    # 2% of OFF — the observability layer must be deployable always-on.
+    # pure decode iterations in alternating groups with the FULL
+    # observability stack OFF and ON — tracer session + cost-accounting
+    # session (MFU/goodput gauges) + HBM-ledger budget; identical
+    # compiled work either way, so the medians isolate the
+    # instrumentation's own cost. Asserted: armed stays within 2% of
+    # disarmed — the accounting layer must be deployable always-on.
+    from paddle_tpu.profiler import costs as C
     from paddle_tpu.profiler import trace as T
 
-    ov_eng = ServingEngine(dec, embed, proj, num_slots=4, max_len=516)
+    ov_eng = ServingEngine(dec, embed, proj, num_slots=4, max_len=516,
+                           hbm_budget_bytes=1 << 30)
     ov_sched = Scheduler(max_queue=8)
     for k in range(4):
         ov_sched.submit(Request(work[k][0][:2].copy(), work[k][2],
                                 max_new_tokens=512, eos_id=None))
     for _ in range(8):                 # join all four + warm the step
         ov_eng.run_iteration(ov_sched)
+    ov_book = C.CostBook()  # reused across armed steps: steady state
 
     def _one(tracer):
         if tracer is not None:
             T.start_session(tracer=tracer)
+            C.start_accounting(book=ov_book)
         s0 = time.perf_counter()
         ov_eng.run_iteration(ov_sched)
         dt = time.perf_counter() - s0
         if tracer is not None:
+            C.end_accounting()
             T.end_session()
         return dt
 
@@ -1240,9 +1299,21 @@ def _serving_throughput(n_requests=48, num_slots=8, d_model=128,
     on_ms = off_ms + diff_ms
     overhead_pct = diff_ms / off_ms * 100.0
     assert overhead_pct < 2.0, \
-        f"tracing overhead {overhead_pct:.2f}% >= 2% " \
-        f"(on {on_ms:.3f}ms vs off {off_ms:.3f}ms per decode step)"
+        f"armed accounting+tracing overhead {overhead_pct:.2f}% >= " \
+        f"2% (on {on_ms:.3f}ms vs off {off_ms:.3f}ms per decode step)"
     ov_eng.abort_active("shutdown")
+
+    # ---- HBM-ledger exactness (dense pool) ----
+    # the snapshot's memory section must equal the ANALYTIC pool+weight
+    # footprint, computed here from the model/pool config alone
+    snap_mem = eng.metrics.snapshot()["memory"]
+    exp = _expected_dense_pool_bytes(
+        dec, num_slots=num_slots, max_len=max_len, mem_len=mem_len,
+        d_model=d_model, itemsize=4)
+    exp_w = _model_param_bytes(dec, embed, proj)
+    assert snap_mem["total_bytes"] == exp + exp_w, \
+        f"ledger {snap_mem['total_bytes']} != analytic " \
+        f"{exp + exp_w} (pool {exp} + weights {exp_w})"
 
     def pct(a, q):
         return round(float(np.percentile(a, q)) * 1e3, 1)
@@ -1262,11 +1333,16 @@ def _serving_throughput(n_requests=48, num_slots=8, d_model=128,
                              "ttft_p99_ms": pct(stat_ttft, 99),
                              "wall_s": round(stat_wall, 2)},
             "trace_overhead": {
+                "armed": "tracer+costs+ledger",
                 "off_step_ms": round(off_ms, 3),
                 "on_step_ms": round(on_ms, 3),
                 "overhead_pct": round(overhead_pct, 2),
                 "asserted_lt_pct": 2.0,
                 "steps_per_side": len(off_s)},
+            "memory_ledger": {
+                "total_bytes": snap_mem["total_bytes"],
+                "analytic_bytes": exp + exp_w,
+                "exact_match": True},
             **({} if trace_art[0] is None
                else {"trace_artifact": trace_art[0]}),
             "config": {"n_requests": n_requests, "slots": num_slots,
@@ -1381,7 +1457,17 @@ def _serving_paged(n_requests=40, d_model=64, nhead=2, ffn=128,
     paged.flush_prefix_cache()
     paged._alloc.check()
     assert paged._alloc.pages_free == paged.num_pages
-    snap = paged.metrics.snapshot()["paging"]
+    full = paged.metrics.snapshot()
+    snap = full["paging"]
+    # HBM-ledger exactness (paged pool): snapshot vs the closed-form
+    # page/scale/table footprint + the Layer-API weight bytes
+    exp_pool = _expected_paged_pool_bytes(
+        dec, num_slots=paged_slots, max_len=paged.max_len,
+        mem_len=mem_len, d_model=d_model, page_size=page_size,
+        num_pages=num_pages)
+    exp_w = _model_param_bytes(dec, embed, proj)
+    assert full["memory"]["total_bytes"] == exp_pool + exp_w, \
+        (full["memory"], exp_pool, exp_w)
 
     def pct(a, q):
         return round(float(np.percentile(a, q)) * 1e3, 1)
@@ -1391,6 +1477,10 @@ def _serving_paged(n_requests=40, d_model=64, nhead=2, ffn=128,
             "unit": "x peak concurrent requests vs dense pool at "
                     "equal cache memory",
             "bitmatch_dense": True,
+            "memory_ledger": {
+                "total_bytes": full["memory"]["total_bytes"],
+                "analytic_bytes": exp_pool + exp_w,
+                "exact_match": True},
             **({} if trace_art[0] is None
                else {"trace_artifact": trace_art[0]}),
             "paged": {"peak_concurrency": p_peak,

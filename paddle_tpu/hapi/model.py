@@ -25,6 +25,9 @@ class Model:
         self._optimizer = None
         self._metrics = []
         self.stop_training = False
+        # per-fit step-timing telemetry (see fit_report()); refreshed
+        # by every fit() call
+        self.fit_stats = None
 
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None):
@@ -131,6 +134,16 @@ class Model:
                                          log_freq)
         cbk_list.on_train_begin()
         history = []
+        # step-timing telemetry: two clock reads per step feed the
+        # training-goodput gauge (useful step wall / total fit wall —
+        # the loader/eval/checkpoint overhead is the difference) and
+        # the per-step latency profiler.costs' training-MFU math uses
+        import time as _time
+
+        t_fit0 = _time.perf_counter()
+        n_steps = 0
+        train_s = 0.0
+        step_times = []            # bounded: last 2048 step walls
         for epoch in range(start_epoch, epochs):
             cbk_list.on_epoch_begin(epoch)
             for m in self._metrics:
@@ -138,7 +151,15 @@ class Model:
             logs = {}
             for step, batch in enumerate(train_loader):
                 ins, lbs = _split_batch(batch, self._n_inputs())
+                t0 = _time.perf_counter()
                 res = self.train_batch(ins, lbs)
+                dt = _time.perf_counter() - t0
+                n_steps += 1
+                train_s += dt
+                if len(step_times) < 2048:
+                    step_times.append(dt)
+                else:
+                    step_times[n_steps % 2048] = dt
                 logs = _logs_from(res, self._metrics)
                 cbk_list.on_batch_end("train", step, logs)
                 if num_iters is not None and step + 1 >= num_iters:
@@ -157,7 +178,39 @@ class Model:
             if self.stop_training:
                 break
         cbk_list.on_train_end()
+        wall_s = _time.perf_counter() - t_fit0
+        self.fit_stats = {
+            "steps": n_steps,
+            "train_s": round(train_s, 6),
+            "wall_s": round(wall_s, 6),
+            "step_ms_p50": round(
+                float(np.median(step_times)) * 1e3, 3)
+            if step_times else 0.0,
+            # training goodput: the fraction of fit wall spent in the
+            # optimizer step proper (loader, eval, checkpointing and
+            # callback overheads are the 1 - goodput remainder)
+            "goodput": round(train_s / wall_s, 4) if wall_s > 0
+            else 0.0,
+        }
         return history
+
+    def fit_report(self, flops_per_step=None, spec=None):
+        """The last fit()'s step-timing telemetry, optionally extended
+        with training MFU when the caller knows the per-step flops
+        (e.g. from a `profiler.costs` book entry): mean achieved flop
+        rate over the steps vs the DeviceSpec peak."""
+        if self.fit_stats is None:
+            raise RuntimeError("fit() has not run yet")
+        out = dict(self.fit_stats)
+        if flops_per_step is not None and out["steps"]:
+            from ..profiler import costs as _costs
+
+            spec = spec if spec is not None else _costs.detect_spec()
+            mean_dt = out["train_s"] / out["steps"]
+            out["device"] = spec.as_dict()
+            out["mfu"] = round(
+                _costs.mfu(float(flops_per_step), mean_dt, spec), 6)
+        return out
 
     def _train_state(self, epoch):
         """Everything fit(resume=...) needs to continue bit-exactly."""

@@ -44,18 +44,42 @@ class RecordEvent:
     """platform/profiler.h:126 parity; also usable as a decorator.
     Records (name, event_type, duration) host-side, forwards the name
     to jax.profiler.TraceAnnotation, and — when a `profiler.trace`
-    session is active — surfaces the event as a span in the tracer."""
+    session is active — surfaces the event as a span in the tracer.
 
-    def __init__(self, name, event_type="op"):
+    Span links: when the XPlane device trace is running in lockstep
+    with a tracer session (`start_profiler`), the span is opened at
+    __enter__ so its (trace_id, span_id) identity EXISTS before the
+    device work runs, and both ids are stamped into the
+    TraceAnnotation metadata — Perfetto shows them on the XPlane
+    event's args, so a host span and its device timeline region
+    correlate by id. Pass `trace_id=` to link the event to a request's
+    trace (serving code passes the request id)."""
+
+    def __init__(self, name, event_type="op", trace_id=0):
         self.name = name
         self.event_type = event_type
+        self.trace_id = int(trace_id)
         self._ann = None
         self._t0 = None
+        self._span = None
 
     def __enter__(self):
         import jax
 
-        self._ann = jax.profiler.TraceAnnotation(self.name)
+        tr = trace._SESSION
+        if tr is not None:
+            # open the span FIRST so its id can ride into the XPlane
+            self._span = tr.begin(self.name, cat="record_event",
+                                  trace_id=self.trace_id,
+                                  attrs={"event_type": self.event_type})
+            if _active:
+                # lockstep XPlane trace: stamp the span identity into
+                # the device-timeline event metadata (span links)
+                self._ann = jax.profiler.TraceAnnotation(
+                    self.name, trace_id=self.trace_id,
+                    span_id=self._span.span_id)
+        if self._ann is None:
+            self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
         self._t0 = time.perf_counter()
         return self
@@ -65,7 +89,11 @@ class RecordEvent:
         dt = t1 - self._t0
         _events.append((self.name, self.event_type, dt))
         tr = trace._SESSION
-        if tr is not None:
+        if self._span is not None:
+            if tr is not None:
+                tr.end(self._span)
+            self._span = None
+        elif tr is not None:
             tr.add_complete(self.name, self._t0, t1, cat="record_event",
                             attrs={"event_type": self.event_type})
         # _host_lib is only non-None after enable_host_trace(): the native

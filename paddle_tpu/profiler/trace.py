@@ -44,7 +44,8 @@ import time
 __all__ = [
     "Span", "Tracer", "start_session", "end_session", "session",
     "session_scope", "ObservedCounter", "JitCache", "RetraceError",
-    "RetraceSentinel", "retrace_sentinel",
+    "RetraceSentinel", "retrace_sentinel", "add_compile_hook",
+    "remove_compile_hook", "suppress_observation",
 ]
 
 _LOG = logging.getLogger("paddle_tpu.trace")
@@ -52,16 +53,58 @@ _LOG = logging.getLogger("paddle_tpu.trace")
 _LOCK = threading.RLock()
 #: the ONE global every instrumented hot path reads; None = disabled
 _SESSION = None
-#: True while a session OR a sentinel is armed — gates the compile
+#: True while a session OR a sentinel OR a compile hook (the cost
+#: accounting layer, profiler.costs) is armed — gates the compile
 #: observer and counter notifications (trace-time only, never hot)
 _WATCH = False
 _GLOBAL_SENTINELS = []
 _SENTINEL_COUNT = 0
+#: observers of every detected trace+compile: fn(owner, key, raw_fn,
+#: args, kw, t0, t1). profiler.costs registers one while an accounting
+#: session is armed — this is how program cost/memory analysis attaches
+#: to the SAME cache keys the retrace sentinel and compile spans use.
+_COMPILE_HOOKS = []
+#: armed while the cost layer re-lowers a program to extract XLA
+#: analyses: the re-trace's counter bump must not look like a retrace
+_SUPPRESS = False
 
 
 def _recompute_watch():
     global _WATCH
-    _WATCH = _SESSION is not None or _SENTINEL_COUNT > 0
+    _WATCH = (_SESSION is not None or _SENTINEL_COUNT > 0
+              or len(_COMPILE_HOOKS) > 0)
+
+
+def add_compile_hook(hook):
+    """Register a compile observer: called as fn(owner, key, raw_fn,
+    args, kw, t0, t1) after every detected trace+compile while armed.
+    Arms the jit-cache observation (same switch as sessions/sentinels)."""
+    with _LOCK:
+        _COMPILE_HOOKS.append(hook)
+        _recompute_watch()
+
+
+def remove_compile_hook(hook):
+    with _LOCK:
+        if hook in _COMPILE_HOOKS:
+            _COMPILE_HOOKS.remove(hook)
+        _recompute_watch()
+
+
+@contextlib.contextmanager
+def suppress_observation():
+    """Silence ObservedCounter notifications (sentinels, session trace
+    counts) for the duration: the cost layer's `fn.lower()` re-traces a
+    program that already compiled, and that deliberate second trace
+    must not fire the retrace sentinel or skew session counters."""
+    global _SUPPRESS
+    with _LOCK:
+        prev, _SUPPRESS = _SUPPRESS, True
+    try:
+        yield
+    finally:
+        with _LOCK:
+            _SUPPRESS = prev
 
 
 def _key_str(key):
@@ -101,7 +144,8 @@ class Tracer:
     finished spans are overwritten past `capacity` — `dropped` counts
     them) plus a plain counter surface for scalar telemetry."""
 
-    def __init__(self, capacity=65536, clock=time.perf_counter):
+    def __init__(self, capacity=65536, clock=time.perf_counter,
+                 sample=None):
         self.capacity = int(capacity)
         self._clock = clock
         self._lock = threading.Lock()
@@ -111,6 +155,26 @@ class Tracer:
         self.counters = collections.Counter()
         self.dropped = 0
         self.t_origin = clock()
+        # request sampling: None = trace everything; a float in (0, 1]
+        # traces ~that fraction of requests (deterministic in the
+        # request id), bounding a multi-hour always-on session by
+        # sampling rather than just ring capacity. An unsampled request
+        # costs one branch at submit and nothing afterwards.
+        if sample is not None:
+            sample = float(sample)
+            if not 0.0 < sample <= 1.0:
+                raise ValueError(
+                    f"sample must be in (0, 1], got {sample}")
+        self.sample = sample
+
+    def should_sample(self, trace_id):
+        """Deterministic per-request sampling decision (Knuth
+        multiplicative hash of the trace id vs the sample fraction), so
+        a given request id samples identically across runs/processes."""
+        if self.sample is None:
+            return True
+        h = (int(trace_id) * 2654435761) & 0xFFFFFFFF
+        return h < self.sample * 4294967296.0
 
     # ---- recording ----
     def now(self):
@@ -234,15 +298,18 @@ class Tracer:
 # session management
 # ----------------------------------------------------------------------
 
-def start_session(capacity=65536, tracer=None):
+def start_session(capacity=65536, tracer=None, sample=None):
     """Install the module-wide tracer session every instrumented call
-    site reports into. Raises if a session is already active."""
+    site reports into. Raises if a session is already active.
+    `sample` (float in (0, 1], e.g. 1/16) traces only that fraction of
+    requests — the always-on mode for multi-hour sessions."""
     global _SESSION
     with _LOCK:
         if _SESSION is not None:
             raise RuntimeError("a tracer session is already active; "
                                "end_session() it first")
-        _SESSION = tracer if tracer is not None else Tracer(capacity)
+        _SESSION = tracer if tracer is not None else \
+            Tracer(capacity, sample=sample)
         _recompute_watch()
         return _SESSION
 
@@ -265,8 +332,8 @@ def session():
 
 
 @contextlib.contextmanager
-def session_scope(capacity=65536):
-    tr = start_session(capacity)
+def session_scope(capacity=65536, sample=None):
+    tr = start_session(capacity, sample=sample)
     try:
         yield tr
     finally:
@@ -291,7 +358,7 @@ class ObservedCounter(collections.Counter):
 
     def __setitem__(self, key, value):
         super().__setitem__(key, value)
-        if _WATCH:
+        if _WATCH and not _SUPPRESS:
             _on_trace(self, key, value)
 
 
@@ -347,13 +414,19 @@ def _observed_compiled(owner, key, fn):
         out = fn(*args, **kw)
         n1 = tc[key]
         if n1 != n0:
+            t1 = time.perf_counter()
             tr = _SESSION
             if tr is not None:
                 tr.add_complete(
-                    "compile", t0, time.perf_counter(), cat="compile",
+                    "compile", t0, t1, cat="compile",
                     attrs={"engine": type(owner).__name__,
                            "key": _key_str(key), "count": n1})
                 tr.count("compiles")
+            for h in tuple(_COMPILE_HOOKS):
+                try:
+                    h(owner, key, fn, args, kw, t0, t1)
+                except Exception:
+                    _LOG.exception("compile hook %r failed", h)
         return out
     return call
 
@@ -464,12 +537,14 @@ def retrace_sentinel(*engines, budget=1, budgets=None, mode="raise"):
 
 
 def reset():
-    """Drop the active session and every armed sentinel, disarm the
-    watch flag. Test teardowns call this (conftest autouse) so a
-    failing test never leaks an armed tracer into the next."""
-    global _SESSION, _SENTINEL_COUNT
+    """Drop the active session, every armed sentinel and compile hook,
+    disarm the watch flag. Test teardowns call this (conftest autouse)
+    so a failing test never leaks an armed tracer into the next."""
+    global _SESSION, _SENTINEL_COUNT, _SUPPRESS
     with _LOCK:
         _SESSION = None
         _GLOBAL_SENTINELS.clear()
+        _COMPILE_HOOKS.clear()
         _SENTINEL_COUNT = 0
+        _SUPPRESS = False
         _recompute_watch()

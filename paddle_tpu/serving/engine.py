@@ -39,6 +39,7 @@ import time
 import numpy as np
 
 from ..core.bucketing import bucket_size, pad_prompt_row, pad_token_rows
+from ..profiler import costs as _costs
 from ..profiler import trace as _trace
 from ..testing import faults
 from . import tracing as _rt
@@ -82,6 +83,28 @@ def _reject_sharded_params(params, engine_name):
                 f"pool data-parallel over the same mesh.")
 
 
+def _tree_bytes(x):
+    """Logical byte footprint of a pytree of arrays: every leaf counts
+    at size x itemsize (aliased leaves count each — the ledger reports
+    committed CAPACITY, which is what the two pool k/v views occupy
+    once they diverge). Pure metadata walk: never syncs the device."""
+    if x is None:
+        return 0
+    if isinstance(x, dict):
+        return sum(_tree_bytes(v) for v in x.values())
+    if isinstance(x, (list, tuple)):   # incl. NamedTuple caches
+        return sum(_tree_bytes(v) for v in x)
+    x = getattr(x, "_data", x)         # Tensor wrapper -> array
+    size = getattr(x, "size", None)
+    dt = getattr(x, "dtype", None)
+    if size is None or dt is None:
+        return 0
+    itemsize = getattr(dt, "itemsize", None)
+    if itemsize is None:
+        itemsize = np.dtype(str(dt)).itemsize
+    return int(size) * int(itemsize)
+
+
 class WatchdogTimeout(TimeoutError):
     """An engine operation completed but blew its `watchdog_s` wall
     budget — treated as a failure (retried with backoff, then failed
@@ -106,7 +129,8 @@ class _EngineBase:
     def __init__(self, num_slots, *, max_joins_per_iter=2, metrics=None,
                  callbacks=(), clock=time.monotonic, max_attempts=3,
                  backoff_base_s=0.01, backoff_cap_s=0.5,
-                 watchdog_s=None, sleep=time.sleep):
+                 watchdog_s=None, sleep=time.sleep,
+                 hbm_budget_bytes=None, hbm_watermark=0.9):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         self.num_slots = int(num_slots)
@@ -136,6 +160,15 @@ class _EngineBase:
         self.backoff_cap_s = float(backoff_cap_s)
         self.watchdog_s = watchdog_s
         self._sleep = sleep
+        # HBM budget for the live memory ledger's watermark (warn
+        # BEFORE OutOfPages/OOM); model-backed subclasses register
+        # their ledger provider with the metrics sink
+        self.hbm_budget_bytes = hbm_budget_bytes
+        if hbm_budget_bytes is not None:
+            self.metrics.budget_bytes = int(hbm_budget_bytes)
+            self.metrics.watermark_frac = float(hbm_watermark)
+        self._weights_bytes = None   # cached by memory_ledger()
+        self._step_cost_cache = None  # (book, key, ProgramCost)
 
     # ---- subclass surface ----
     def admit_check(self, request):
@@ -188,8 +221,44 @@ class _EngineBase:
         the slot axis."""
         return free[0]
 
+    # ---- cost/memory accounting (profiler.costs) ----
+    def _step_cost_key(self):
+        """The jit-cache key of this engine's batched decode step (the
+        identity the MFU gauges, the compile observer, and the retrace
+        sentinel all share). None = no compiled step (Artifact pool)."""
+        return None
+
+    def cost_hint(self, key):
+        """Analytic {flops, bytes_accessed, ...} for `key` — the
+        CPU-safe fallback when XLA's cost analysis returns nothing (or
+        the program compiled before accounting armed). None = no
+        estimate for this key."""
+        return None
+
+    def _record_step_cost(self, dt_s):
+        """Armed-only (caller guards on the costs session): one decode
+        step's roofline position into the MFU/bandwidth gauges. The
+        ProgramCost lookup is cached per (book, key) so a steady pool
+        pays one dict hit + two reservoir adds per armed step."""
+        key = self._step_cost_key()
+        if key is None:
+            return
+        bk = _costs._BOOK
+        if bk is None:
+            return
+        cached = self._step_cost_cache
+        if cached is not None and cached[0] is bk and cached[1] == key:
+            c = cached[2]
+        else:
+            c = _costs.cost_for(self, key)
+            if c is None:
+                return
+            self._step_cost_cache = (bk, key, c)
+        self.metrics.record_step_utilization(
+            c.flops, c.bytes_accessed, dt_s, bk.spec, c.source)
+
     # ---- watchdog + retry/backoff ----
-    def _guarded(self, opname, fn):
+    def _guarded(self, opname, fn, retry_tokens=0):
         """Run one engine op with up to `max_attempts` tries, capped
         exponential backoff between them, and a wall-clock watchdog: an
         op that returns but took > `watchdog_s` is treated as failed
@@ -199,7 +268,7 @@ class _EngineBase:
         last = None
         for attempt in range(self.max_attempts):
             if attempt:
-                self.metrics.record_retry(opname)
+                self.metrics.record_retry(opname, retry_tokens)
                 self._sleep(min(self.backoff_cap_s,
                                 self.backoff_base_s * (2 ** (attempt - 1))))
             t0 = time.monotonic()
@@ -237,7 +306,7 @@ class _EngineBase:
                 continue
             self.slots[s] = None
             self._evict(s)
-            self.metrics.record_finish("error")
+            self.metrics.record_finish("error", len(r.tokens))
             self.metrics.record_eviction_on_error()
             r.finish("error", now, error=exc)
             self._cbs.emit("on_finish", r)
@@ -251,7 +320,7 @@ class _EngineBase:
         r = self.slots[s]
         self.slots[s] = None
         self._evict(s)
-        self.metrics.record_finish(reason)
+        self.metrics.record_finish(reason, len(r.tokens))
         r.finish(reason, now)
         self._cbs.emit("on_finish", r)
 
@@ -304,7 +373,8 @@ class _EngineBase:
         # 2. admission: refill free slots, bounded per iteration
 
         def _queue_death(req):   # cancelled/expired while QUEUED
-            self.metrics.record_finish(req.finish_reason)
+            self.metrics.record_finish(req.finish_reason,
+                                       len(req.tokens))
             self._cbs.emit("on_finish", req)
 
         joins = 0
@@ -321,7 +391,7 @@ class _EngineBase:
                 # unservable request that bypassed the frontend check
                 self.metrics.record_error("admit", e)
                 r.fail(e, now)
-                self.metrics.record_finish("error")
+                self.metrics.record_finish("error", len(r.tokens))
                 self._cbs.emit("on_finish", r)
                 continue
             if not self._admission_gate(r):
@@ -350,7 +420,7 @@ class _EngineBase:
                 self.metrics.record_error("slot_join", e)
                 if not self._join_fallback(r, e):
                     r.fail(e, self.clock())
-                    self.metrics.record_finish("error")
+                    self.metrics.record_finish("error", len(r.tokens))
                     self._cbs.emit("on_finish", r)
                 progress = True
                 continue
@@ -373,7 +443,8 @@ class _EngineBase:
                     if _trace._SESSION is not None else 0.0)
             try:
                 toks = self._guarded(
-                    "decode_step", lambda: self._decode_attempt(active))
+                    "decode_step", lambda: self._decode_attempt(active),
+                    retry_tokens=int(active.sum()))
             except Exception as e:
                 self.metrics.record_error("decode_step", e)
                 self._fail_active(e)
@@ -389,6 +460,11 @@ class _EngineBase:
                         self._deliver(r, int(toks[s]), now2)
                         n += 1
                 self.metrics.record_decode(n, now2 - t0)
+                # roofline gauges: one global read disarmed; when a
+                # costs session is armed, the step's flops/bytes (XLA
+                # or analytic) land in the MFU/bandwidth reservoirs
+                if _costs._BOOK is not None:
+                    self._record_step_cost(now2 - t0)
                 # decode-step inter-arrival: the latency co-resident
                 # requests actually SEE between their tokens — inline
                 # prefill inflates it, disaggregated prefill doesn't
@@ -477,6 +553,11 @@ class ServingEngine(_EngineBase):
         self._mem_shape = None
         self._np_dtype = None
         self._pool_key = None
+        self._n_params = None       # cached dense param count (hints)
+        # the live HBM ledger: snapshot()["memory"] reports this
+        # engine's weights + pool footprint (and the budget watermark
+        # warns before the pool runs dry)
+        self.metrics.set_memory_provider(self.memory_ledger)
 
     # ------------------------------------------------------------------
     def _params(self):
@@ -491,6 +572,82 @@ class ServingEngine(_EngineBase):
         """Suffix for the max_len overflow message (the paged engine
         reports the page-granular limit here)."""
         return ""
+
+    # ---- the live HBM ledger ----
+    def weights_bytes(self):
+        """Byte footprint of the params + buffers the compiled
+        programs run over (the placed copy for sharded engines)."""
+        if self._weights_bytes is None:
+            self._weights_bytes = _tree_bytes(self._params()) + \
+                _tree_bytes(self._buffers())
+        return self._weights_bytes
+
+    def pool_bytes(self):
+        """Byte footprint of the slot-pool device state (0 before the
+        first join builds it)."""
+        return _tree_bytes(self._state)
+
+    def pool_in_use_bytes(self):
+        """The LIVE portion of the pool. The dense pool preallocates
+        every row, so committed == live; the paged engine subtracts
+        unmapped pages."""
+        return self.pool_bytes()
+
+    def memory_ledger(self):
+        """The `memory` section's raw components — weights, pool, live
+        bytes, and the compile temp high-water from the armed cost
+        book (0 when accounting is off)."""
+        w = self.weights_bytes()
+        p = self.pool_bytes()
+        return {"weights_bytes": w, "pool_bytes": p,
+                "in_use_bytes": w + self.pool_in_use_bytes(),
+                "compile_temp_peak_bytes": _costs.temp_high_water()}
+
+    # ---- analytic cost hints (profiler.costs fallback) ----
+    def _model_dims(self):
+        """(n_params, n_layers, heads, head_dim, mem_len) for the
+        analytic flop formulas; None before the pool shape is known."""
+        if self._n_params is None:
+            self._n_params = sum(
+                int(getattr(v, "size", 0))
+                for v in self._params().values()) + sum(
+                int(getattr(v, "size", 0))
+                for v in self._buffers().values())
+        decoder = self._net.decoder
+        h0 = decoder.layers[0].self_attn
+        M = self._mem_shape[0] if self._mem_shape else 0
+        return (self._n_params, len(decoder.layers), h0.num_heads,
+                h0.head_dim, M)
+
+    def _step_cost_key(self):
+        if self._pool_key is None:
+            return None
+        return ("step",) + self._pool_key
+
+    def cost_hint(self, key):
+        kind = key[0] if isinstance(key, tuple) and key else key
+        n_params, n_layers, heads, hd, M = self._model_dims()
+        pool = self.pool_bytes()
+        w = self.weights_bytes()
+        if kind in ("step", "pstep"):
+            # the compiled step computes ALL S rows over the full
+            # (masked) max_len window, active or not
+            flops = _costs.transformer_decode_flops(
+                n_params, self.num_slots, self.max_len, n_layers,
+                heads, hd, mem_len=M)
+            return {"flops": flops, "bytes_accessed": w + pool,
+                    "argument_bytes": w + pool}
+        if kind in ("join", "pjoin", "prefill") and len(key) > 1:
+            Pb = int(key[1])
+            flops = _costs.transformer_prefill_flops(
+                n_params, 1, Pb, n_layers, heads, hd, mem_len=M)
+            return {"flops": flops, "bytes_accessed": w + pool,
+                    "argument_bytes": w + pool}
+        if kind in ("attach", "cow", "splice"):
+            # row splices / page copies: byte traffic, ~no matmul flops
+            return {"flops": 0.0, "bytes_accessed": pool,
+                    "argument_bytes": pool}
+        return None
 
     def admit_check(self, r):
         P = max(1, int(r.prompt.shape[0]))
@@ -539,6 +696,11 @@ class ServingEngine(_EngineBase):
         self._np_dtype = np.dtype(str(dtype))
         self._pool_key = (S, L, M, Dm, str(dtype))
         self._neg = float(NEG)
+        if self.metrics.budget_bytes > 0:
+            # the dense pool commits its whole footprint up front:
+            # check the watermark the moment it exists
+            self.metrics.check_memory_watermark(
+                self.weights_bytes() + self.pool_bytes())
 
     # ------------------------------------------------------------------
     def _join(self, s, r):
@@ -661,7 +823,7 @@ class ServingEngine(_EngineBase):
                     self.metrics.record_error("stream_cb", e)
         reason = ("eos" if r.eos_id is not None and r.tokens and
                   r.tokens[-1] == r.eos_id else "length")
-        self.metrics.record_finish(reason)
+        self.metrics.record_finish(reason, len(r.tokens))
         r.finish(reason, now)
         self._cbs.emit("on_finish", r)
         return True
@@ -817,6 +979,7 @@ class PagedServingEngine(ServingEngine):
         self._slot_pages_total = np.zeros(self.num_slots, np.int64)
         self._fm_cross = None
         self._page_bytes = None
+        self._pool_total_bytes = None  # ledger cache (watermark path)
         self._prefix_params = None   # param identity the cache is
         #                              valid for (see _check_params)
         self.prefill_count = 0   # real prefills run (prefix hits skip)
@@ -830,6 +993,30 @@ class PagedServingEngine(ServingEngine):
     def _max_len_detail(self):
         return (f" (= {self.max_pages} pages x {self.page_size} "
                 f"tokens, paged)")
+
+    # ---- the live HBM ledger (paged) ----
+    def table_bytes(self):
+        """The int32 page table shipped to the device every step."""
+        return self.num_slots * self.max_pages * 4
+
+    def pool_bytes(self):
+        b = _tree_bytes(self._state)
+        return b + self.table_bytes() if b else 0
+
+    def pool_in_use_bytes(self):
+        """Committed pool minus the UNMAPPED pages: the paged pool's
+        whole point is that live bytes track actual tokens, not
+        worst-case max_len — this is the number the budget watermark
+        and oversubscription monitoring care about."""
+        total = self.pool_bytes()
+        if not total or not self._page_bytes:
+            return total
+        return total - self._alloc.pages_free * self._page_bytes
+
+    def _step_cost_key(self):
+        if self._pool_key is None:
+            return None
+        return ("pstep",) + self._pool_key
 
     def admit_check(self, r):
         super().admit_check(r)
@@ -885,6 +1072,10 @@ class PagedServingEngine(ServingEngine):
             * jnp.dtype(storage).itemsize
         scale_b = h0.num_heads * 4 if quantized else 0
         self._page_bytes = 2 * len(decoder.layers) * (per_buf + scale_b)
+        self._pool_total_bytes = self.pool_bytes()
+        if self.metrics.budget_bytes > 0:
+            self.metrics.check_memory_watermark(
+                self.weights_bytes() + self.pool_in_use_bytes())
 
     # ---- host page bookkeeping ----
     def _alloc_pages(self, n):
@@ -929,6 +1120,7 @@ class PagedServingEngine(ServingEngine):
         self._table[:] = -1
         self._index[:] = 0
         self._state = None
+        self._pool_total_bytes = None
 
     # ---- admission: free-page headroom ----
     def _pages_needed(self, r):
@@ -982,6 +1174,15 @@ class PagedServingEngine(ServingEngine):
             gauges["bytes_per_active_token"] = \
                 self._alloc.pages_in_use * self._page_bytes \
                 / active_toks
+        # budget watermark: live bytes grow page by page, so the
+        # crossing fires while free headroom still exists — BEFORE the
+        # OutOfPages backpressure/eviction paths. Costs two int ops per
+        # iteration, and only when a budget was configured.
+        if self.metrics.budget_bytes > 0 and \
+                self._pool_total_bytes is not None:
+            in_use = self.weights_bytes() + self._pool_total_bytes \
+                - self._alloc.pages_free * (self._page_bytes or 0)
+            self.metrics.check_memory_watermark(in_use)
         return gauges
 
     # ---- join: prefill into pages, or attach shared prefix pages ----
@@ -1259,7 +1460,7 @@ class PagedServingEngine(ServingEngine):
         self._evict(s)
         self.metrics.record_oom_eviction()
         self.metrics.record_error("out_of_pages", exc)
-        self.metrics.record_finish("error")
+        self.metrics.record_finish("error", len(r.tokens))
         r.finish("error", now, error=exc)
         self._cbs.emit("on_finish", r)
 

@@ -83,10 +83,51 @@ SNAPSHOT_DOCS = {
     "paging.oom_evictions": ("counter", "mid-decode OutOfPages victims"),
     "paging.bytes_per_active_token": (
         "summary", "cache bytes per live token (oversubscription)"),
+    # live HBM ledger (PR 9) — the section appears once the engine
+    # registers its memory provider (model-backed engines always do)
+    "memory.weights_bytes": (
+        "gauge", "param + buffer bytes the pool serves"),
+    "memory.pool_bytes": (
+        "gauge",
+        "KV pool + per-slot row arrays (paged: pages/scales/table)"),
+    "memory.total_bytes": (
+        "gauge", "weights + pool: the committed device footprint"),
+    "memory.in_use_bytes": (
+        "gauge", "weights + rows/pages actually live right now"),
+    "memory.budget_bytes": ("gauge", "configured HBM budget (0=unset)"),
+    "memory.budget_used_frac": ("gauge", "in_use / budget"),
+    "memory.compile_temp_peak_bytes": (
+        "gauge", "XLA temp-buffer high-water across compiled programs"),
+    "memory.watermark_warnings": (
+        "counter", "budget-watermark crossings (warns BEFORE OOM)"),
+    # MFU / bandwidth gauges (PR 9) — the section appears while a
+    # profiler.costs accounting session records per-step utilization
+    "mfu.device": ("info",
+                   "roofline spec {name, peak_tflops, peak_gbps, ...}"),
+    "mfu.cost_source": ("info",
+                        "{source}: xla cost_analysis or analytic hint"),
+    "mfu.flops_per_step": ("gauge", "compiled decode-step flops"),
+    "mfu.bytes_per_step": ("gauge", "decode-step bytes accessed"),
+    "mfu.model_flops_util": (
+        "summary", "per-step achieved flops / DeviceSpec peak"),
+    "mfu.bandwidth_util": (
+        "summary", "per-step bytes accessed / DeviceSpec peak BW"),
+    # goodput (PR 9): how much of the produced work reached callers
+    "goodput.useful_tokens": (
+        "counter", "tokens of requests that completed (eos/length/drain)"),
+    "goodput.wasted_tokens": (
+        "counter",
+        "partial tokens of evicted/failed/timed-out/cancelled requests"),
+    "goodput.warmup_tokens": (
+        "counter", "tokens produced inside begin_warmup()/end_warmup()"),
+    "goodput.retry_tokens": (
+        "counter", "token-slots burned by retried decode attempts"),
+    "goodput.ratio": (
+        "gauge", "useful / (useful + wasted + warmup + retried)"),
 }
 
 _SUMMARY_KEYS = {"n", "mean", "p50", "p99", "max"}
-_LEAF_DICTS = {"errors.last"}
+_LEAF_DICTS = {"errors.last", "mfu.device"}
 
 
 def flatten_snapshot(snap, _prefix=""):
@@ -246,6 +287,33 @@ class ServingMetrics:
         #                             decode splices, param placement)
         self.collective_events = 0
         self.shard_occupancy = None  # last-iteration per-dp-shard list
+        # live HBM ledger (PR 9): the engine registers a provider that
+        # returns {weights_bytes, pool_bytes, in_use_bytes,
+        # compile_temp_peak_bytes}; snapshot() formats it into the
+        # "memory" section. budget_bytes arms the watermark: crossing
+        # watermark_frac * budget bumps watermark_warnings ONCE per
+        # excursion (warn before OutOfPages/OOM, not after).
+        self._memory_provider = None
+        self.budget_bytes = 0
+        self.watermark_frac = 0.9
+        self.watermark_warnings = 0
+        self._above_watermark = False
+        # goodput accounting: token-denominated usefulness, classified
+        # at finish time (the engines pass each request's token count)
+        self.useful_tokens = 0
+        self.wasted_tokens = 0
+        self.warmup_tokens = 0
+        self.retry_tokens = 0
+        self._warmup = False
+        # MFU / bandwidth gauges: recorded per decode step only while
+        # a profiler.costs accounting session is armed
+        self._mfu = False
+        self._spec = None             # DeviceSpec (dict at snapshot)
+        self.cost_source = None       # "xla" | "analytic"
+        self.flops_per_step = 0.0
+        self.bytes_per_step = 0.0
+        self.mfu_util = _Reservoir(512)
+        self.bw_util = _Reservoir(512)
 
     # ---- recording (engine / frontend side) ----
     def record_submit(self):
@@ -277,18 +345,40 @@ class ServingMetrics:
             if n_tokens:
                 self.token_latency_s.add(dt_s)
 
-    def record_finish(self, reason):
+    def record_finish(self, reason, n_tokens=0):
+        """Request finished with `reason`; `n_tokens` (the tokens it
+        was delivered) feeds the goodput split: completions count as
+        useful, evictions/failures/timeouts as wasted, and anything
+        produced inside a warmup window as warmup."""
         with self._lock:
             if reason in ("eos", "length", "drain"):
                 self.completed += 1
-            elif reason == "cancelled":
-                self.cancelled += 1
-            elif reason == "timeout":
-                self.timeouts += 1
-            elif reason == "error":
-                self.failed += 1
+                if self._warmup:
+                    self.warmup_tokens += int(n_tokens)
+                else:
+                    self.useful_tokens += int(n_tokens)
             else:
-                self.aborted += 1
+                self.wasted_tokens += int(n_tokens)
+                if reason == "cancelled":
+                    self.cancelled += 1
+                elif reason == "timeout":
+                    self.timeouts += 1
+                elif reason == "error":
+                    self.failed += 1
+                else:
+                    self.aborted += 1
+
+    # ---- goodput / warmup ----
+    def begin_warmup(self):
+        """Tokens finished until end_warmup() classify as warmup, not
+        useful — benches/servers call this around bucket warm loops so
+        goodput reflects steady-state serving only."""
+        with self._lock:
+            self._warmup = True
+
+    def end_warmup(self):
+        with self._lock:
+            self._warmup = False
 
     # ---- fault accounting ----
     def record_error(self, where, exc):
@@ -302,9 +392,13 @@ class ServingMetrics:
                                "message": str(exc),
                                "at": self._clock()}
 
-    def record_retry(self, where):
+    def record_retry(self, where, n_tokens=0):
+        """A failed op is being retried; for decode steps `n_tokens` is
+        the active-slot count — the token-slots of work the failed
+        attempt burned (goodput's retry term)."""
         with self._lock:
             self.retries += 1
+            self.retry_tokens += int(n_tokens)
 
     def record_eviction_on_error(self, n=1):
         with self._lock:
@@ -332,6 +426,51 @@ class ServingMetrics:
     def record_oom_eviction(self, n=1):
         with self._lock:
             self.oom_evictions += n
+
+    # ---- HBM ledger / MFU accounting (PR 9) ----
+    def set_memory_provider(self, provider, budget_bytes=None,
+                            watermark_frac=None):
+        """Register the engine's ledger closure: `provider()` returns
+        {weights_bytes, pool_bytes, in_use_bytes,
+        compile_temp_peak_bytes} (or None before the pool exists).
+        snapshot() calls it OUTSIDE the metrics lock."""
+        self._memory_provider = provider
+        if budget_bytes is not None:
+            self.budget_bytes = int(budget_bytes)
+        if watermark_frac is not None:
+            self.watermark_frac = float(watermark_frac)
+
+    def check_memory_watermark(self, in_use_bytes):
+        """Engine-side liveness check against the configured budget:
+        the first crossing of watermark_frac * budget bumps the warning
+        counter (and arms hysteresis so a pool hovering at the line
+        warns once per excursion, not per iteration). Returns True
+        while above the watermark."""
+        if self.budget_bytes <= 0:
+            return False
+        above = in_use_bytes >= self.watermark_frac * self.budget_bytes
+        with self._lock:
+            if above and not self._above_watermark:
+                self.watermark_warnings += 1
+            self._above_watermark = above
+        return above
+
+    def record_step_utilization(self, flops, bytes_accessed, dt_s,
+                                spec, source):
+        """One decode step's roofline position: the compiled program's
+        flops / bytes against the DeviceSpec peaks. Armed-only (the
+        engine guards on the costs session), so the disarmed hot path
+        never reaches here."""
+        with self._lock:
+            self._mfu = True
+            self._spec = spec
+            self.cost_source = source
+            self.flops_per_step = float(flops)
+            self.bytes_per_step = float(bytes_accessed)
+            if dt_s > 0:
+                self.mfu_util.add(flops / dt_s / spec.peak_flops)
+                self.bw_util.add(
+                    bytes_accessed / dt_s / spec.peak_bytes_per_s)
 
     # ---- sharded-serving accounting ----
     def record_step_gap(self, dt_s):
@@ -379,9 +518,37 @@ class ServingMetrics:
 
     # ---- reading ----
     def snapshot(self):
+        # the ledger provider walks engine state — call it OUTSIDE the
+        # metrics lock (it must stay free to call metrics methods)
+        ledger = None
+        if self._memory_provider is not None:
+            try:
+                ledger = self._memory_provider()
+            except Exception:
+                ledger = None
         with self._lock:
             tps = (self.decode_tokens / self.decode_time_s
                    if self.decode_time_s > 0 else 0.0)
+            mem = None
+            if ledger is not None:
+                w = int(ledger.get("weights_bytes", 0))
+                p = int(ledger.get("pool_bytes", 0))
+                used = int(ledger.get("in_use_bytes", w + p))
+                b = self.budget_bytes
+                mem = {
+                    "weights_bytes": w,
+                    "pool_bytes": p,
+                    "total_bytes": w + p,
+                    "in_use_bytes": used,
+                    "budget_bytes": b,
+                    "budget_used_frac":
+                        round(used / b, 4) if b > 0 else 0.0,
+                    "compile_temp_peak_bytes":
+                        int(ledger.get("compile_temp_peak_bytes", 0)),
+                    "watermark_warnings": self.watermark_warnings,
+                }
+            good_denom = (self.useful_tokens + self.wasted_tokens +
+                          self.warmup_tokens + self.retry_tokens)
             return {
                 "requests": {"submitted": self.submitted,
                              "completed": self.completed,
@@ -404,6 +571,23 @@ class ServingMetrics:
                 "per_token_ms": self.token_latency_s.summary(scale=1e3),
                 "queue_depth": self.queue_depth.summary(digits=2),
                 "slot_occupancy": self.occupancy.summary(digits=3),
+                "goodput": {
+                    "useful_tokens": self.useful_tokens,
+                    "wasted_tokens": self.wasted_tokens,
+                    "warmup_tokens": self.warmup_tokens,
+                    "retry_tokens": self.retry_tokens,
+                    "ratio": round(self.useful_tokens / good_denom, 4)
+                    if good_denom else 1.0,
+                },
+                **({} if mem is None else {"memory": mem}),
+                **({} if not self._mfu else {"mfu": {
+                    "device": self._spec.as_dict(),
+                    "cost_source": self.cost_source,
+                    "flops_per_step": self.flops_per_step,
+                    "bytes_per_step": self.bytes_per_step,
+                    "model_flops_util": self.mfu_util.summary(digits=5),
+                    "bandwidth_util": self.bw_util.summary(digits=5),
+                }}),
                 **({} if not self._sharded else {"sharding": {
                     # prefill-slice vs decode-slice step latency: the
                     # disaggregation split's two phases side by side

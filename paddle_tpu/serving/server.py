@@ -144,7 +144,7 @@ class ServingServer:
             [r for r in self.engine.slots if r is not None]
         for r in doomed:
             r.fail(exc, now)   # idempotent vs a racing finish()
-            self.engine.metrics.record_finish("error")
+            self.engine.metrics.record_finish("error", len(r.tokens))
             self.engine._cbs.emit("on_finish", r)
 
     # ------------------------------------------------------------------
@@ -176,7 +176,8 @@ class ServingServer:
             now = self.clock()
             self.scheduler.drain()
             for r in self.scheduler.abort_queued("shutdown", now):
-                self.engine.metrics.record_finish(r.finish_reason)
+                self.engine.metrics.record_finish(r.finish_reason,
+                                                  len(r.tokens))
                 self.engine._cbs.emit("on_finish", r)
             self.engine.abort_active("shutdown", now)
         self._started = False

@@ -49,7 +49,8 @@ import numpy as np
 from ..core.bucketing import pad_prompt_row
 from ..testing import faults
 from . import tracing as _rt
-from .engine import PagedServingEngine, ServingEngine, _PT_PREFILL
+from .engine import (PagedServingEngine, ServingEngine, _PT_PREFILL,
+                     _tree_bytes)
 
 __all__ = ["ShardedServingEngine", "ShardedPagedServingEngine"]
 
@@ -180,12 +181,28 @@ class ShardedServingEngine(ServingEngine):
         """Re-place the (possibly updated) layer weights onto the mesh;
         compiled programs are pure and stay cached."""
         self._place_params()
+        self._weights_bytes = None   # ledger cache: shapes may change
 
     def _params(self):
         return self._sparams
 
     def _buffers(self):
         return self._sbuffers
+
+    def weights_bytes(self):
+        """GLOBAL logical weight bytes across the mesh: the placed
+        decode-slice copy plus, under disaggregation, the prefill
+        slice's second copy (each addressable shard holds 1/n of a
+        sharded leaf; replicated leaves cost the full size per device
+        — the ledger reports the logical total, the number capacity
+        planning sums against per-chip HBM)."""
+        if self._weights_bytes is None:
+            b = _tree_bytes(self._sparams) + _tree_bytes(self._sbuffers)
+            if self._prefill_dm is not None:
+                b += _tree_bytes(self._pparams) + \
+                    _tree_bytes(self._pbuffers)
+            self._weights_bytes = b
+        return self._weights_bytes
 
     # ------------------------------------------------------------------
     # sharded compilation: same bodies, annotated
@@ -479,7 +496,7 @@ class ShardedServingEngine(ServingEngine):
                     _rt.on_splice_end(r, ok=False, error=e)
                 self.metrics.record_error("prefill_splice", e)
                 r.fail(e, self.clock())
-                self.metrics.record_finish("error")
+                self.metrics.record_finish("error", len(r.tokens))
                 self._cbs.emit("on_finish", r)
                 continue
             self._pending.discard(s)

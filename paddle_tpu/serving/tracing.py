@@ -96,6 +96,14 @@ def on_submit(r):
     tr = _trace._SESSION
     if tr is None:
         return
+    # sampling mode (start_session(sample=...)): an unsampled request
+    # costs exactly this one branch — r._trace stays None, so every
+    # downstream hook short-circuits on the attribute it already reads
+    if tr.sample is not None and not tr.should_sample(r.id):
+        tr.count("requests_unsampled")
+        return
+    if tr.sample is not None:
+        tr.count("requests_sampled")
     root = tr.begin("request", cat="request", trace_id=r.id,
                     attrs={"prompt_len": int(r.prompt.shape[0]),
                            "max_new_tokens": r.max_new_tokens})

@@ -121,6 +121,42 @@ class DecodeEngine:
         # retrace sentinel (profiler.trace) see every compile
         self._compiled = _trace.JitCache(self)
         self.trace_counts = _trace.ObservedCounter(owner="DecodeEngine")
+        self._n_params = None      # cached for cost_hint
+
+    def cost_hint(self, key):
+        """Analytic cost for one compiled (prefill + scan) program —
+        profiler.costs' CPU-safe fallback when XLA's analysis is
+        unavailable (or the program compiled before accounting armed).
+        Key layout matches generate()'s cache key."""
+        from ..profiler import costs as _costs
+
+        if not (isinstance(key, tuple) and len(key) >= 8):
+            return None
+        Bb, Pb, max_new, K = (int(key[0]), int(key[1]), int(key[2]),
+                              int(key[3]))
+        mshape = key[7]
+        M = int(mshape[0]) if mshape else 0
+        if self._n_params is None:
+            self._n_params = sum(
+                int(getattr(v, "size", 0))
+                for v in self._fm.params().values()) + sum(
+                int(getattr(v, "size", 0))
+                for v in self._fm.buffers().values())
+        decoder = self._net.decoder
+        h0 = decoder.layers[0].self_attn
+        n_layers, heads, hd = len(decoder.layers), h0.num_heads, \
+            h0.head_dim
+        flops = _costs.transformer_prefill_flops(
+            self._n_params, Bb, Pb, n_layers, heads, hd, mem_len=M)
+        flops += max_new * _costs.transformer_decode_flops(
+            self._n_params, Bb * K, Pb + max_new, n_layers, heads, hd,
+            mem_len=M)
+        pbytes = sum(
+            int(getattr(v, "size", 0)) *
+            int(getattr(getattr(v, "dtype", None), "itemsize", 4))
+            for v in self._fm.params().values())
+        return {"flops": flops,
+                "bytes_accessed": float(pbytes) * (max_new + 1)}
 
     # ------------------------------------------------------------------
     def generate(self, memory, prompt=None, prompt_lengths=None, *,

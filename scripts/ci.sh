@@ -25,6 +25,14 @@ else
   if [ "${CI_STRICT_PERF:-0}" = "1" ]; then rc=1; fi
 fi
 
+echo "== [2b] perf gate (quick 2-row smoke vs committed baselines) =="
+if python tools/perf_gate.py --cpu --quick --out /tmp/PERF_GATE.json; then
+  echo "perf-gate: pass (see /tmp/PERF_GATE.json)"
+else
+  echo "perf-gate: regressions/missing rows detected (see above)"
+  rc=1
+fi
+
 echo "== [3/3] bench dry-run (ctr_ps, small, cpu) =="
 JAX_PLATFORMS=cpu python - <<'PY' || rc=1
 import _cpu_debug  # noqa: F401

@@ -58,9 +58,11 @@ def _disarm_faults():
 
 @pytest.fixture(autouse=True)
 def _disarm_tracing():
-    """Tracer sessions / retrace sentinels must never leak across
-    tests (a test may arm a standing sentinel without a with-block)."""
+    """Tracer sessions / retrace sentinels / cost-accounting sessions
+    must never leak across tests (a test may arm a standing sentinel
+    without a with-block)."""
     yield
-    from paddle_tpu.profiler import trace
+    from paddle_tpu.profiler import costs, trace
 
+    costs.reset()
     trace.reset()

@@ -451,14 +451,17 @@ def test_record_event_type_capacity_and_tracer_surface():
 
 def _full_metrics():
     """A ServingMetrics with every section populated (paging +
-    sharding gauges recorded) — no engine needed."""
+    sharding + memory ledger + MFU/goodput gauges recorded) — no
+    engine needed."""
+    from paddle_tpu.profiler.costs import CPU_SPEC
+
     m = ServingMetrics()
     m.record_submit()
     m.record_join()
     m.record_first_token(0.01)
     m.record_token()
     m.record_decode(1, 0.002)
-    m.record_finish("eos")
+    m.record_finish("eos", 1)
     m.record_error("stream_cb", RuntimeError("x"))
     m.record_retry("slot_join")
     m.record_prefix(True)
@@ -470,6 +473,11 @@ def _full_metrics():
     m.record_iteration(1, 0.5, pages_in_use=3, pages_free=5,
                        bytes_per_active_token=128.0,
                        shard_occupancy=[0.5, 0.25])
+    m.set_memory_provider(
+        lambda: {"weights_bytes": 1000, "pool_bytes": 500,
+                 "in_use_bytes": 1200, "compile_temp_peak_bytes": 64},
+        budget_bytes=2000)
+    m.record_step_utilization(1e6, 2e6, 0.001, CPU_SPEC, "xla")
     return m
 
 
@@ -510,3 +518,94 @@ def test_readme_documents_snapshot_keys_and_span_taxonomy():
     for name, _ in rt.SPAN_TAXONOMY:
         assert f"`{name}`" in readme, \
             f"README span-taxonomy table is missing `{name}`"
+
+
+# ----------------------------------------------------------------------
+# sampling mode (PR 9): bounded always-on sessions
+# ----------------------------------------------------------------------
+
+def test_sampling_deterministic_and_bounded():
+    tr = T.Tracer(sample=0.5)
+    picks = [tr.should_sample(i) for i in range(200)]
+    # deterministic: same ids -> same decisions
+    assert picks == [tr.should_sample(i) for i in range(200)]
+    # roughly the requested fraction (hash-uniform over ids)
+    assert 60 <= sum(picks) <= 140
+    # sample=1 keeps everything; invalid fractions refuse loudly
+    assert all(T.Tracer(sample=1.0).should_sample(i)
+               for i in range(50))
+    with pytest.raises(ValueError):
+        T.Tracer(sample=0.0)
+    with pytest.raises(ValueError):
+        T.Tracer(sample=1.5)
+
+
+def test_sampled_session_traces_only_sampled_requests():
+    dec, embed, proj, D, V = _small_stack()
+    eng = ServingEngine(dec, embed, proj, num_slots=4, max_len=32)
+    sched = Scheduler(max_queue=64)
+    rs = np.random.RandomState(3)
+    with T.session_scope(sample=0.5) as tr:
+        reqs = []
+        for _ in range(12):
+            r = _mk_request(rs, D, V)
+            sched.submit(r)
+            reqs.append(r)
+        eng.serve_until_idle(sched, max_iterations=2000)
+        for r in reqs:
+            assert r.result(timeout=5).ok
+    sampled = {r.id for r in reqs if tr.should_sample(r.id)}
+    unsampled = {r.id for r in reqs} - sampled
+    assert sampled and unsampled, "seed produced a degenerate split"
+    wf = rt.waterfalls(tr.chrome_trace_events())
+    assert sampled <= set(wf)
+    assert not (unsampled & set(wf))
+    for rid in sampled:
+        assert wf[rid]["complete"]
+    # the split is visible as session counters
+    assert tr.counters["requests_sampled"] == len(sampled)
+    assert tr.counters["requests_unsampled"] == len(unsampled)
+    # an unsampled request never got a _ReqTrace attached
+    assert all(r._trace is None for r in reqs)
+
+
+# ----------------------------------------------------------------------
+# XPlane span links (PR 9): host spans carry ids into the device trace
+# ----------------------------------------------------------------------
+
+def test_record_event_span_links_in_lockstep_profile(tmp_path):
+    from paddle_tpu import profiler as prof
+
+    trace_dir = str(tmp_path / "xplane")
+    prof.start_profiler(trace_dir=trace_dir)
+    try:
+        assert T._SESSION is not None   # lockstep tracer session
+        with prof.RecordEvent("linked_op", event_type="step",
+                              trace_id=42):
+            np.ones(4).sum()
+    finally:
+        prof.stop_profiler()
+    # the lockstep session exported host_trace.json with the span's
+    # identity (trace_id + span_id) — the same ids RecordEvent stamped
+    # into the TraceAnnotation metadata on the device timeline
+    assert prof.last_host_trace is not None
+    events = rt.load_chrome_trace(prof.last_host_trace)
+    linked = [e for e in events
+              if e.get("name") == "linked_op" and e["ph"] == "X"]
+    assert linked, [e.get("name") for e in events]
+    args = linked[0]["args"]
+    assert args["trace_id"] == 42
+    assert args["span_id"] > 0
+    assert args["event_type"] == "step"
+
+
+def test_record_event_without_profiler_still_spans():
+    from paddle_tpu import profiler as prof
+
+    with T.session_scope() as tr:
+        with prof.RecordEvent("plain", event_type="op"):
+            pass
+    spans = [s for s in tr.spans() if s.name == "plain"]
+    assert len(spans) == 1
+    assert spans[0].cat == "record_event"
+    assert spans[0].t1 is not None

@@ -106,6 +106,28 @@ def main():
                 print(f"ok: traced overhead: README '<2%' backed by "
                       f"artifact {pct}%")
 
+    # HBM-ledger hygiene (PR 9): the serving benches assert the
+    # snapshot memory section equals the analytic pool+weight footprint
+    # exactly — the committed artifact must carry that record, true.
+    for metric in ("serving_throughput", "serving_paged"):
+        try:
+            ml = details[metric]["memory_ledger"]
+            ok = bool(ml["exact_match"]) and \
+                int(ml["total_bytes"]) == int(ml["analytic_bytes"])
+        except (KeyError, TypeError, ValueError) as e:
+            failures.append(f"memory ledger: BENCH_DETAILS "
+                            f"{metric}.memory_ledger unreadable: {e!r}")
+        else:
+            checked += 1
+            if not ok:
+                failures.append(
+                    f"memory ledger: {metric} records "
+                    f"total {ml.get('total_bytes')} != analytic "
+                    f"{ml.get('analytic_bytes')}")
+            else:
+                print(f"ok: memory ledger: {metric} exact "
+                      f"({ml['total_bytes']} bytes)")
+
     if failures:
         print("README bench-claim check FAILED:", file=sys.stderr)
         for f in failures:

@@ -31,12 +31,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 def _demo_snapshot():
     """Serve a few requests through a tiny pool under a tracer session
+    AND an armed cost-accounting session, so the dump previews every
+    snapshot section — memory ledger, MFU/goodput gauges included —
     and return (snapshot, tracer)."""
     import numpy as np
 
     from paddle_tpu import nn
     from paddle_tpu.nn.layer.transformer import (TransformerDecoder,
                                                  TransformerDecoderLayer)
+    from paddle_tpu.profiler import costs
     from paddle_tpu.serving import (Request, Scheduler, ServingEngine,
                                     session_scope)
 
@@ -45,10 +48,11 @@ def _demo_snapshot():
     dec = TransformerDecoder(layer, 2)
     dec.eval()
     eng = ServingEngine(dec, nn.Embedding(17, 32), nn.Linear(32, 17),
-                        num_slots=4, max_len=32)
+                        num_slots=4, max_len=32,
+                        hbm_budget_bytes=1 << 20)
     sched = Scheduler(max_queue=16)
     rs = np.random.RandomState(1)
-    with session_scope() as tr:
+    with costs.accounting_scope(), session_scope() as tr:
         reqs = []
         for _ in range(6):
             P = int(rs.randint(1, 6))
@@ -61,7 +65,10 @@ def _demo_snapshot():
         eng.serve_until_idle(sched, max_iterations=500)
         for r in reqs:
             assert r.result(timeout=5).ok
-    return eng.metrics.snapshot(), tr
+        # snapshot INSIDE the scope so the compile-temp high-water of
+        # the armed cost book lands in the memory section
+        snap = eng.metrics.snapshot()
+    return snap, tr
 
 
 def main(argv=None):
