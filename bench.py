@@ -1071,14 +1071,114 @@ def _decode_throughput(points=((4, 64), (16, 64), (4, 128)),
             "eager_tok_per_s": round(eager_tps, 1),
             "speedup": round(speedup_last, 2),
             "spread": _spread(fused_samples, kind="trials")}
+    spec = _spec_decode_ab(dec, embed, proj, d_model=d_model,
+                           vocab=vocab)
     return {"metric": "decode_throughput",
             "value": round(speedup_last, 2),
             "unit": "x vs eager concat-cache loop",
             "by_point": by_point,
+            "speculative": spec,
             "config": {"layers": n_layers, "d_model": d_model,
                        "nhead": nhead, "vocab": vocab,
                        "prompt_len": prompt_len, "greedy": True,
                        "parity_checked": True}}
+
+
+def _spec_decode_ab(dec, embed, proj, *, d_model, vocab, spec_k=8,
+                    ngram=2, max_new=96, pairs=5):
+    """Speculative-decoding A/B over the serving engine's per-step
+    dispatch path — the regime the feature targets: at batch 1-8 each
+    decode step is one host dispatch whose overhead dominates this
+    box's tiny-model compute, and draft-verify turns one-dispatch-per-
+    token into two dispatches per accepted run. Workload: a
+    repetitive-suffix prompt (the self-speculation sweet spot —
+    templated text / copy-through); tokens asserted BIT-IDENTICAL to
+    the non-spec engine per request. PAIRED per-pair ratio, alternating
+    order inside pairs, median-of-pairs (the repo's 1-core noise
+    discipline). The fused whole-scan DecodeEngine spec path is
+    measured by tools/op_bench.py spec_decode_* rows instead (on this
+    compute-bound CPU the k-wide verify pays ~k, so the fused-scan win
+    only appears on bandwidth-bound hardware)."""
+    import jax  # noqa: F401  (engine imports lazily)
+
+    from paddle_tpu.serving.engine import ServingEngine
+    from paddle_tpu.serving.scheduler import Request, Scheduler
+
+    def mk_engine(with_spec, slots):
+        kw = dict(spec_k=spec_k, spec_ngram=ngram) if with_spec else {}
+        return ServingEngine(dec, embed, proj, num_slots=slots,
+                             max_len=160, **kw)
+
+    def serve(eng, prompt, n_req):
+        mem = np.random.RandomState(9).randn(8, d_model).astype("f4")
+        sched = Scheduler(max_queue=32)
+        reqs = [Request(prompt.copy(), mem, max_new_tokens=max_new,
+                        eos_id=1) for _ in range(n_req)]
+        for r in reqs:
+            sched.submit(r)
+        t0 = time.perf_counter()
+        eng.serve_until_idle(sched)
+        dt = time.perf_counter() - t0
+        toks = [list(r.result(timeout=5).tokens) for r in reqs]
+        return sum(len(t) for t in toks) / dt, toks
+
+    # copy-through prompt: seed the model with a repeated pattern, then
+    # use its OWN greedy continuation as the served prompt — the
+    # continuation keeps following the attractor it is already on, the
+    # canonical self-speculation-friendly (templated/copy-through)
+    # regime
+    rs = np.random.RandomState(3)
+    seed_prompt = np.zeros((8,), np.int32)
+    seed_prompt[1:] = np.tile(rs.randint(2, vocab, (4,)), 2)[:7]
+    seeder = mk_engine(False, 1)
+    _, seed_toks = serve(seeder, seed_prompt, 1)
+    prompt0 = np.zeros((33,), np.int32)
+    prompt0[1:] = seed_toks[0][:32]
+
+    out = {}
+    for batch in (1, 8):
+        base = mk_engine(False, batch)
+        spec = mk_engine(True, batch)
+        serve(base, prompt0, batch)           # compile both paths
+        serve(spec, prompt0, batch)
+        ratios, spec_tps_s, base_tps_s = [], [], []
+        toks_b = toks_s = None
+        for i in range(pairs):
+            order = (base, spec) if i % 2 == 0 else (spec, base)
+            a_tps, a_toks = serve(order[0], prompt0, batch)
+            b_tps, b_toks = serve(order[1], prompt0, batch)
+            if order[0] is base:
+                bt, st_, btk, stk = a_tps, b_tps, a_toks, b_toks
+            else:
+                bt, st_, btk, stk = b_tps, a_tps, b_toks, a_toks
+            ratios.append(st_ / bt)
+            spec_tps_s.append(st_)
+            base_tps_s.append(bt)
+            toks_b, toks_s = btk, stk
+        if toks_b != toks_s:
+            raise AssertionError(
+                "speculative serving decode diverged from the "
+                "non-spec engine (greedy acceptance must be "
+                "bit-exact)")
+        ratios.sort()
+        med = ratios[len(ratios) // 2]
+        snap = spec.metrics.snapshot()["speculation"]
+        out[f"b{batch}"] = {
+            "spec_tok_per_s": round(sorted(spec_tps_s)[pairs // 2], 1),
+            "base_tok_per_s": round(sorted(base_tps_s)[pairs // 2], 1),
+            "speedup": round(med, 2),
+            "acceptance_rate": snap["acceptance_rate"],
+            "draft_step_ms_p50": snap["draft_step_ms"].get("p50"),
+            "verify_step_ms_p50": snap["verify_step_ms"].get("p50"),
+            "spread": _spread(ratios, kind="pairs")}
+    if out["b1"]["speedup"] < 1.5:
+        raise AssertionError(
+            f"speculative decode A/B below the 1.5x floor at batch 1: "
+            f"{out['b1']}")
+    return dict(out, spec_k=spec_k, ngram=ngram, max_new=max_new,
+                bit_match_asserted=True,
+                workload="copy-through prompt (the model's own "
+                         "continuation), serving slot pool")
 
 
 def _model_param_bytes(*nets):

@@ -113,7 +113,12 @@ class MultiHeadAttention(Layer):
         holes. Contract: a multi-token write (S > 1) is the PREFILL of
         an empty cache — it attends within the prompt block itself on
         the regular flash-capable path; S == 1 is a decode step through
-        the flash-decode kernel."""
+        the flash-decode kernel. Inside `ops.attention.kv_verify_scope`
+        a multi-token write is instead a speculative-decoding VERIFY
+        block: the S tokens land at each row's OWN write offset (per-row
+        vmapped writes, the decode-step layout) and attend causally
+        within the block via `verify_attention` — rolling the write
+        index back afterwards is the caller's acceptance logic."""
         import jax
         import jax.numpy as jnp
 
@@ -128,10 +133,13 @@ class MultiHeadAttention(Layer):
         b, h, s, d = qd.shape
         idx = (idx if idx.ndim else idx[None]).astype(jnp.int32)
         z = jnp.int32(0)
-        if s == 1:
-            # decode step: per-ROW write positions — the serving slot
-            # pool holds requests at independent offsets; lockstep
-            # batches (DecodeEngine) are the all-equal special case
+        verify = s > 1 and A.in_kv_verify_scope()
+        if s == 1 or verify:
+            # decode step (or a verify block): per-ROW write positions —
+            # the serving slot pool holds requests at independent
+            # offsets; lockstep batches (DecodeEngine) are the all-equal
+            # special case. The same vmapped dynamic_update_slice covers
+            # one token or a k-token verify block.
             def _write(buf, new, i):
                 return jax.lax.dynamic_update_slice(buf, new, (z, i, z))
 
@@ -152,6 +160,8 @@ class MultiHeadAttention(Layer):
             mask = mask.reshape(mask.shape[0], mask.shape[-1])
         if s == 1:
             out = A.decode_attention(qd, kbuf, vbuf, idx + 1, bias=mask)
+        elif verify:
+            out = A.verify_attention(qd, kbuf, vbuf, idx + s, bias=mask)
         else:
             bias4 = None if mask is None else \
                 mask.astype(jnp.float32)[:, None, None, :]

@@ -1431,6 +1431,210 @@ def decode_attention(q, k, v, length, bias=None, scale=None, split_k=None,
 
 
 # --------------------------------------------------------------------------
+# verify-mode attention: a k-token draft-verify block against the cache
+# --------------------------------------------------------------------------
+
+#: trace-scoped flag: speculative decoding's verify step feeds S > 1
+#: query tokens through `MultiHeadAttention._static_kv_attention`, which
+#: otherwise reserves multi-token calls for the PREFILL of an empty
+#: cache. Arming the scope switches the multi-token branch to the
+#: per-row verify write + `verify_attention` (causal-within-the-block
+#: against each row's own cache offset). Trace-time only, like
+#: `decode_shardings` — zero cost when unset.
+_KV_VERIFY = [False]
+
+
+@contextlib.contextmanager
+def kv_verify_scope():
+    """Scope a jit trace so multi-token StaticKVCache attention means
+    DRAFT-VERIFY (per-row offsets, causal block) instead of prefill."""
+    prev = _KV_VERIFY[0]
+    _KV_VERIFY[0] = True
+    try:
+        yield
+    finally:
+        _KV_VERIFY[0] = prev
+
+
+def in_kv_verify_scope():
+    return _KV_VERIFY[0]
+
+
+def verify_attention_reference(q, k, v, length, bias=None, scale=None):
+    """XLA reference for the speculative-decoding VERIFY step: T query
+    tokens per row (the pending token + T-1 draft tokens), just written
+    into the cache at each row's own offset. q [b, h, T, d]; k/v
+    [b, h, L, d]; `length` ([b] or scalar int32, traced) is the written
+    count AFTER the T-token write, so query i sits at absolute position
+    length - T + i and may see key positions <= its own (causal within
+    the block, everything before it in the cache). bias: optional
+    [b, L] additive key bias (padded-prompt holes). With T == 1 this is
+    exactly `decode_attention_reference`; the flash_verify kernel is
+    checked against THIS composition in interpret mode on CPU."""
+    import jax.numpy as jnp
+
+    b, h, T, d = q.shape
+    L = k.shape[2]
+    length = jnp.asarray(length, jnp.int32)
+    length = jnp.broadcast_to(length.reshape(-1), (b,))
+    kpos = jnp.arange(L, dtype=jnp.int32)
+    qpos = (length[:, None] - jnp.int32(T)) + \
+        jnp.arange(T, dtype=jnp.int32)[None, :]          # [b, T]
+    valid = kpos[None, None, :] <= qpos[:, :, None]      # [b, T, L]
+    m = jnp.where(valid, 0.0, -1e30).astype(jnp.float32)
+    if bias is not None:
+        m = m + jnp.asarray(bias, jnp.float32)[:, None, :]
+    return sdpa_reference(q, k, v, m[:, None], False, scale)
+
+
+def _flash_verify_call(b, h, L, d, T, s, n_splits, has_bias, interpret):
+    """Split-K verify kernel: the flash_decode grid with a (T, d) query
+    block instead of (1, d); in-kernel masking keeps key position j
+    visible to query row i only while j <= row i's absolute position
+    (n_valid - T + i)."""
+    import jax
+    import jax.numpy as jnp
+
+    pl = _import_pallas()
+    from jax.experimental.pallas import tpu as pltpu
+
+    split = L // n_splits
+
+    def kernel(len_ref, *refs):
+        if has_bias:
+            q_ref, k_ref, v_ref, bias_ref, o_ref, m_ref, l_ref = refs
+        else:
+            q_ref, k_ref, v_ref, o_ref, m_ref, l_ref = refs
+        si = pl.program_id(1)
+        start = si * jnp.int32(split)
+        n_valid = len_ref[pl.program_id(0) // jnp.int32(h)]
+
+        # every query sees keys < n_valid only, so splits entirely past
+        # the written region contribute an exact zero to the combine
+        @pl.when(start < n_valid)
+        def _compute():
+            sf = jnp.float32(s)
+            qb = (q_ref[...].astype(jnp.float32) * sf).astype(
+                q_ref.dtype)                      # (T, d)
+            kb = k_ref[...]                        # (split, d)
+            vb = v_ref[...]
+            logits = jnp.dot(qb, kb.T,
+                             preferred_element_type=jnp.float32)
+            kpos = start + jax.lax.broadcasted_iota(
+                jnp.int32, (T, split), 1)
+            qpos = (n_valid - jnp.int32(T)) + jax.lax.broadcasted_iota(
+                jnp.int32, (T, split), 0)
+            logits = jnp.where(kpos <= qpos, logits,
+                               jnp.float32(-1e30))
+            if has_bias:
+                logits = logits + bias_ref[...][:, 0][None, :]
+            m = logits.max(axis=-1, keepdims=True)          # (T, 1)
+            p = jnp.exp(logits - m)
+            # a query row fully masked WITHIN an active split (its
+            # position precedes the split) leaves m = -1e30 and p = 1s;
+            # the XLA combine's alpha = exp(m - m_star) flushes that
+            # split's contribution to an exact zero — every row's own
+            # position guarantees some split holds a finite m
+            l = p.sum(axis=-1, keepdims=True)
+            o_ref[...] = jnp.dot(p.astype(qb.dtype), vb,
+                                 preferred_element_type=jnp.float32)
+            m_ref[...] = m
+            l_ref[...] = l
+
+        @pl.when(start >= n_valid)
+        def _skip():
+            o_ref[...] = jnp.zeros((T, d), jnp.float32)
+            m_ref[...] = jnp.full((T, 1), -1e30, jnp.float32)
+            l_ref[...] = jnp.zeros((T, 1), jnp.float32)
+
+    in_specs = [
+        pl.BlockSpec((None, T, d), lambda bh, si, *_: (bh, _z(), _z())),
+        pl.BlockSpec((None, split, d), lambda bh, si, *_: (bh, si, _z())),
+        pl.BlockSpec((None, split, d), lambda bh, si, *_: (bh, si, _z())),
+    ]
+    if has_bias:
+        in_specs.append(
+            pl.BlockSpec((None, split, 1),
+                         lambda bh, si, *_: (bh, si, _z())))
+    out_specs = [
+        pl.BlockSpec((None, None, T, d),
+                     lambda bh, si, *_: (bh, si, _z(), _z())),
+        pl.BlockSpec((None, None, T, 1),
+                     lambda bh, si, *_: (bh, si, _z(), _z())),
+        pl.BlockSpec((None, None, T, 1),
+                     lambda bh, si, *_: (bh, si, _z(), _z())),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((b * h, n_splits, T, d), jnp.float32),
+        jax.ShapeDtypeStruct((b * h, n_splits, T, 1), jnp.float32),
+        jax.ShapeDtypeStruct((b * h, n_splits, T, 1), jnp.float32),
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(b * h, n_splits),
+        in_specs=in_specs, out_specs=out_specs)
+    return pl.pallas_call(kernel, grid_spec=grid_spec,
+                          out_shape=out_shape, interpret=interpret)
+
+
+def flash_verify(q, k, v, length, bias=None, scale=None, split_k=None,
+                 interpret=False):
+    """Pallas verify kernel: T query tokens per row against the cached
+    K/V, split-K over the cache length exactly like `flash_decode`; the
+    per-split partial (acc, m, l) merge in XLA with the standard
+    logsumexp combine. `length` [b] (or scalar, traced) is the written
+    count AFTER the block write — per-row, the serving slot pool's
+    layout."""
+    import jax.numpy as jnp
+
+    b, h, T, d = q.shape
+    L = k.shape[2]
+    n_splits = _pick_decode_splits(L, split_k)
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    qr = q.reshape(b * h, T, d)
+    kr = k.reshape(b * h, L, d)
+    vr = v.reshape(b * h, L, d)
+    len_arr = jnp.broadcast_to(
+        jnp.asarray(length, jnp.int32).reshape(-1), (b,))
+    call = _flash_verify_call(b, h, L, d, T, s, n_splits,
+                              bias is not None, interpret)
+    args = [qr, kr, vr]
+    if bias is not None:
+        args.append(jnp.repeat(jnp.asarray(bias, jnp.float32), h,
+                               axis=0)[:, :, None])
+    acc, m, l = call(len_arr, *args)               # [b*h, ns, T, ...]
+    m_star = m.max(axis=1, keepdims=True)
+    alpha = jnp.exp(m - m_star)
+    num = (acc * alpha).sum(axis=1)                # [b*h, T, d]
+    den = jnp.maximum((l * alpha).sum(axis=1), 1e-30)
+    return (num / den).astype(q.dtype).reshape(b, h, T, d)
+
+
+def verify_attention(q, k, v, length, bias=None, scale=None,
+                     split_k=None, interpret=False):
+    """Verify-attention dispatch: the split-K pallas kernel on TPU (or
+    under interpret=True for CPU parity tests), the XLA reference
+    composition everywhere else — same gate style as
+    `decode_attention`, any kernel failure falls back."""
+    L = k.shape[2]
+    q = _constrain_decode(q, "q")
+    k = _constrain_decode(k, "kv")
+    v = _constrain_decode(v, "kv")
+    use_kernel = interpret or (
+        _on_tpu() and q.shape[-1] <= 256 and L >= 256 and L % 128 == 0
+        and _flash_usable())
+    if use_kernel:
+        try:
+            return _constrain_decode(
+                flash_verify(q, k, v, length, bias, scale, split_k,
+                             interpret), "out")
+        except Exception:
+            if interpret:
+                raise
+    return _constrain_decode(
+        verify_attention_reference(q, k, v, length, bias, scale), "out")
+
+
+# --------------------------------------------------------------------------
 # paged decode attention: one query token against a paged KV cache
 # --------------------------------------------------------------------------
 

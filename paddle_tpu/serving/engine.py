@@ -455,10 +455,27 @@ class _EngineBase:
                     _rt.on_decode_step(self, _ts0, time.perf_counter(),
                                        active, scheduler)
                 n = 0
-                for s, r in enumerate(list(self.slots)):
-                    if r is not None and active[s]:
-                        self._deliver(r, int(toks[s]), now2)
-                        n += 1
+                if isinstance(toks, tuple):
+                    # speculative step: (emit [S, k], n_emit [S]) —
+                    # up to k tokens per slot per iteration; delivery
+                    # stops the moment the slot finishes (eos /
+                    # max_new_tokens), dropping the over-speculated
+                    # tail exactly like the eager oracle would
+                    emit, n_emit = toks
+                    for s, r in enumerate(list(self.slots)):
+                        if r is None or not active[s]:
+                            continue
+                        for j in range(int(n_emit[s])):
+                            if self.slots[s] is not r or \
+                                    r.state == "DONE":
+                                break
+                            self._deliver(r, int(emit[s, j]), now2)
+                            n += 1
+                else:
+                    for s, r in enumerate(list(self.slots)):
+                        if r is not None and active[s]:
+                            self._deliver(r, int(toks[s]), now2)
+                            n += 1
                 self.metrics.record_decode(n, now2 - t0)
                 # roofline gauges: one global read disarmed; when a
                 # costs session is armed, the step's flops/bytes (XLA
@@ -530,7 +547,8 @@ class ServingEngine(_EngineBase):
     def __init__(self, decoder, embed, project, *, num_slots=8,
                  max_len=128, max_joins_per_iter=2, metrics=None,
                  callbacks=(), clock=time.monotonic,
-                 eager_fallback=False, paged=False, **kw):
+                 eager_fallback=False, paged=False, spec_k=None,
+                 spec_ngram=2, **kw):
         super().__init__(num_slots, max_joins_per_iter=max_joins_per_iter,
                          metrics=metrics, callbacks=callbacks, clock=clock,
                          **kw)
@@ -539,6 +557,26 @@ class ServingEngine(_EngineBase):
 
         self.eager_fallback = bool(eager_fallback)
         self.max_len = int(max_len)
+        # speculative decoding (text/speculative.py): spec_k >= 2 turns
+        # the batched decode step into a draft + k-token-verify pair
+        # delivering up to spec_k tokens per slot per iteration —
+        # bit-identical tokens, fewer dispatches. The pool carries
+        # spec_k extra cache positions so a round's fixed-k verify
+        # write never clips (admission keeps the max_len contract).
+        if spec_k is not None:
+            spec_k = int(spec_k)
+            if spec_k < 2:
+                raise ValueError("spec_k must be >= 2 (the pending "
+                                 "token plus at least one draft)")
+            if isinstance(self, PagedServingEngine):
+                raise NotImplementedError(
+                    "speculative decoding is not wired through the "
+                    "paged pool yet (multi-token page writes + paged "
+                    "verify attention are a follow-up); use the dense "
+                    "ServingEngine for spec_k")
+        self.spec_k = spec_k
+        self.spec_ngram = int(spec_ngram)
+        self._pool_len = self.max_len + (spec_k or 0)
         self._net = _StepNet(decoder, embed, project)
         self._fm = functionalize(self._net)
         if not getattr(self, "_accepts_sharded_params", False):
@@ -676,7 +714,7 @@ class ServingEngine(_EngineBase):
         decoder = self._net.decoder
         M, Dm = memory.shape
         dtype = jnp.asarray(np.asarray(memory)).dtype
-        S, L = self.num_slots, self.max_len
+        S, L = self.num_slots, self._pool_len
         inc = [layer.self_attn.gen_cache(None, max_length=L,
                                          batch_size=S, dtype=dtype)
                for layer in decoder.layers]
@@ -692,9 +730,18 @@ class ServingEngine(_EngineBase):
             "inc": inc,
             "static": static,
         }
+        if self.spec_k:
+            # the n-gram draft source's token mirror of the cache, plus
+            # each slot's true prompt length / bucket for the logical
+            # (hole-skipping) history view
+            self._state["hist"] = jnp.zeros((S, L), jnp.int32)
+            self._state["plen"] = jnp.zeros((S,), jnp.int32)
+            self._state["pbk"] = jnp.zeros((S,), jnp.int32)
         self._mem_shape = (M, Dm)
         self._np_dtype = np.dtype(str(dtype))
-        self._pool_key = (S, L, M, Dm, str(dtype))
+        self._pool_key = (S, L, M, Dm, str(dtype)) + \
+            ((("spec", self.spec_k, self.spec_ngram),)
+             if self.spec_k else ())
         self._neg = float(NEG)
         if self.metrics.budget_bytes > 0:
             # the dense pool commits its whole footprint up front:
@@ -742,7 +789,8 @@ class ServingEngine(_EngineBase):
 
         fm = self._fm
         decoder = self._net.decoder
-        L = self.max_len
+        L = self._pool_len
+        spec = bool(self.spec_k)
         key = ("join", Pb)
         neg = self._neg
 
@@ -782,6 +830,16 @@ class ServingEngine(_EngineBase):
                 "inc": new_inc,
                 "static": new_static,
             }
+            if spec:
+                hist_row = jnp.concatenate(
+                    [prompt, jnp.zeros((1, L - Pb), jnp.int32)], 1)
+                new_state["hist"] = MHA.splice_rows(
+                    state["hist"], slot, hist_row)
+                new_state["plen"] = jax.lax.dynamic_update_slice(
+                    state["plen"], length.astype(jnp.int32), (slot,))
+                new_state["pbk"] = jax.lax.dynamic_update_slice(
+                    state["pbk"], jnp.full((1,), Pb, jnp.int32),
+                    (slot,))
             return new_state, tok0
 
         return join_fn
@@ -849,6 +907,8 @@ class ServingEngine(_EngineBase):
     def _decode_step(self, active):
         import jax.numpy as jnp
 
+        if self.spec_k:
+            return self._spec_decode_step(active)
         key = ("step",) + self._pool_key
         fn = self._compiled.get(key)
         if fn is None:
@@ -890,6 +950,126 @@ class ServingEngine(_EngineBase):
                 c.k, c.v, jnp.where(active, c.index, old.index))
                 for c, old in zip(inc2, inc)]
             return dict(state, tok=nxt, inc=inc2), nxt
+
+        return step_fn
+
+    # ---- speculative decode: draft program + k-token verify program ----
+    def _spec_decode_step(self, active):
+        """One speculative iteration over the pool: (1) the DRAFT
+        program proposes spec_k - 1 tokens per slot by n-gram
+        self-speculation over each slot's own history (pure jnp, no
+        model weights); (2) the VERIFY program runs one spec_k-token
+        step through the model at each row's own cache offset, accepts
+        the matching draft prefix, rolls the per-row write indices back
+        and returns (emit [S, k], n_emit [S]) — run_iteration delivers
+        up to spec_k bit-exact tokens per slot. Two host dispatches
+        instead of one-per-token; compiled once per pool config."""
+        import jax
+        import jax.numpy as jnp
+
+        spec_on = np.asarray(
+            [r is not None and getattr(r, "spec", True)
+             for r in self.slots], bool)
+        dkey = ("draft",) + self._pool_key
+        fn = self._compiled.get(dkey)
+        if fn is None:
+            fn = self._build_draft(dkey)
+            self._compiled[dkey] = fn
+            fn = self._compiled[dkey]   # the observed wrapper
+        t0 = time.perf_counter()
+        st = self._state
+        drafts = fn(st["hist"], st["tok"], st["plen"], st["pbk"],
+                    st["inc"][0].index)
+        jax.block_until_ready(drafts)
+        t1 = time.perf_counter()
+        vkey = ("sstep",) + self._pool_key
+        fn = self._compiled.get(vkey)
+        if fn is None:
+            fn = self._build_spec_step(vkey)
+            self._compiled[vkey] = fn
+            fn = self._compiled[vkey]   # the observed wrapper
+        self._state, (emit, n_emit) = fn(
+            self._params(), self._buffers(), self._state, drafts,
+            jnp.asarray(active), jnp.asarray(spec_on))
+        emit = np.asarray(emit)
+        n_emit = np.asarray(n_emit)
+        t2 = time.perf_counter()
+        on = active & spec_on
+        proposed = int(on.sum()) * (self.spec_k - 1)
+        accepted = int(np.maximum(n_emit[on] - 1, 0).sum()) \
+            if on.any() else 0
+        self.metrics.record_spec_step(
+            int(active.sum()), proposed, accepted, t1 - t0, t2 - t1)
+        if _trace._SESSION is not None:
+            _rt.on_spec_step(t0, t1, t2, int(active.sum()), proposed,
+                             accepted)
+        return emit, n_emit
+
+    def _build_draft(self, dkey):
+        import jax
+
+        return jax.jit(self._draft_body(dkey))
+
+    def _draft_body(self, dkey):
+        from ..text import speculative as SP
+
+        k, ngram = self.spec_k, self.spec_ngram
+
+        def draft_fn(hist, tok, plen, pbk, index):
+            self.trace_counts[dkey] += 1  # one per trace = one compile
+            return SP.ngram_propose(hist, tok, plen, pbk, k - 1,
+                                    index - pbk, ngram)
+
+        return draft_fn
+
+    def _build_spec_step(self, vkey):
+        import jax
+
+        return jax.jit(self._spec_step_body(vkey))
+
+    def _spec_step_body(self, vkey):
+        import jax.numpy as jnp
+
+        from ..nn.layer.transformer import MultiHeadAttention as MHA
+        from ..ops import attention as A
+        from ..text import speculative as SP
+        from ..text.decode import greedy_accept
+
+        fm = self._fm
+        k = self.spec_k
+
+        def step_fn(params, buffers, state, drafts, active, spec_on):
+            self.trace_counts[vkey] += 1  # one per trace = one compile
+            inc = state["inc"]
+            idx0 = inc[0].index
+            # a spec=False slot's drafts are forced unmatched (-1 never
+            # equals a vocab token), so it accepts exactly one oracle
+            # token per step — the plain decode semantics on the same
+            # compiled program
+            drafts = jnp.where(spec_on[:, None], drafts, -1)
+            fed = jnp.concatenate([state["tok"][:, None], drafts], 1)
+            posn = idx0[:, None] + jnp.arange(k, dtype=jnp.int32)[None]
+            with A.kv_verify_scope():
+                (lg, inc2), _ = fm.apply(
+                    params, buffers, None, fed, posn, state["mem"],
+                    training=False, tgt_mask=state["bias"],
+                    memory_mask=None, inc=inc,
+                    static_kv=state["static"], prefill=False)
+            preds = lg.argmax(-1).astype(jnp.int32)
+            n_match, emit = greedy_accept(drafts, preds)
+            n_emit = jnp.where(active, n_match + 1, 0).astype(jnp.int32)
+            # acceptance rollback on active rows, index pin on the rest
+            # (the same inactive-slot contract as the plain step)
+            new_idx = SP.rollback_index(inc2[0].index, k, n_match,
+                                        active)
+            inc3 = [MHA.StaticKVCache(c.k, c.v, new_idx) for c in inc2]
+            corr = jnp.take_along_axis(preds, n_match[:, None],
+                                       axis=1)[:, 0]
+            nxt = jnp.where(active, corr, state["tok"])
+            new_state = dict(
+                state, tok=nxt, inc=inc3,
+                hist=SP.write_hist(state["hist"], fed, idx0))
+            return new_state, (emit, n_emit)
 
         return step_fn
 

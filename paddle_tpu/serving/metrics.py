@@ -123,7 +123,27 @@ SNAPSHOT_DOCS = {
     "goodput.retry_tokens": (
         "counter", "token-slots burned by retried decode attempts"),
     "goodput.ratio": (
-        "gauge", "useful / (useful + wasted + warmup + retried)"),
+        "gauge", "useful / (useful + wasted + warmup + retried + "
+                 "rejected-draft)"),
+    # speculative decoding (PR 10) — the section appears once a
+    # spec-enabled engine records a draft/verify step pair
+    "speculation.rounds": ("counter", "draft + verify step pairs run"),
+    "speculation.drafts_proposed": (
+        "counter", "draft tokens proposed across all spec steps"),
+    "speculation.drafts_accepted": (
+        "counter", "draft tokens that matched the verify oracle"),
+    "speculation.acceptance_rate": (
+        "gauge", "drafts_accepted / drafts_proposed"),
+    "speculation.accepted_per_step": (
+        "summary", "accepted draft tokens per verify step"),
+    "speculation.draft_step_ms": (
+        "summary", "draft-proposal dispatch wall latency"),
+    "speculation.verify_step_ms": (
+        "summary", "k-token verify dispatch wall latency"),
+    "speculation.wasted_draft_tokens": (
+        "counter",
+        "rejected drafts — verify lanes burned; in the goodput "
+        "denominator"),
 }
 
 _SUMMARY_KEYS = {"n", "mean", "p50", "p99", "max"}
@@ -305,6 +325,17 @@ class ServingMetrics:
         self.warmup_tokens = 0
         self.retry_tokens = 0
         self._warmup = False
+        # speculative decoding (the snapshot grows a "speculation"
+        # section once a spec-enabled engine records): device-side
+        # acceptance accounting plus the two dispatch latencies of the
+        # draft/verify pair; wasted drafts feed the goodput denominator
+        self._spec_recorded = False
+        self.spec_rounds = 0
+        self.drafts_proposed = 0
+        self.drafts_accepted = 0
+        self.accepted_per_step = _Reservoir(512)
+        self.draft_step_s = _Reservoir(512)
+        self.verify_step_s = _Reservoir(512)
         # MFU / bandwidth gauges: recorded per decode step only while
         # a profiler.costs accounting session is armed
         self._mfu = False
@@ -472,6 +503,24 @@ class ServingMetrics:
                 self.bw_util.add(
                     bytes_accessed / dt_s / spec.peak_bytes_per_s)
 
+    # ---- speculative-decoding accounting ----
+    def record_spec_step(self, n_active, proposed, accepted, draft_s,
+                         verify_s):
+        """One speculative iteration: `proposed` draft tokens went into
+        the verify step for the spec-enabled active slots, `accepted`
+        of them matched the oracle; `draft_s`/`verify_s` are the two
+        dispatch wall times. Rejected drafts are wasted verify lanes —
+        they join the goodput denominator."""
+        with self._lock:
+            self._spec_recorded = True
+            self.spec_rounds += 1
+            self.drafts_proposed += int(proposed)
+            self.drafts_accepted += int(accepted)
+            if n_active:
+                self.accepted_per_step.add(accepted / n_active)
+            self.draft_step_s.add(draft_s)
+            self.verify_step_s.add(verify_s)
+
     # ---- sharded-serving accounting ----
     def record_step_gap(self, dt_s):
         """Wall time between two consecutive decode-step completions
@@ -547,8 +596,10 @@ class ServingMetrics:
                         int(ledger.get("compile_temp_peak_bytes", 0)),
                     "watermark_warnings": self.watermark_warnings,
                 }
+            wasted_drafts = self.drafts_proposed - self.drafts_accepted
             good_denom = (self.useful_tokens + self.wasted_tokens +
-                          self.warmup_tokens + self.retry_tokens)
+                          self.warmup_tokens + self.retry_tokens +
+                          wasted_drafts)
             return {
                 "requests": {"submitted": self.submitted,
                              "completed": self.completed,
@@ -579,6 +630,21 @@ class ServingMetrics:
                     "ratio": round(self.useful_tokens / good_denom, 4)
                     if good_denom else 1.0,
                 },
+                **({} if not self._spec_recorded else {"speculation": {
+                    "rounds": self.spec_rounds,
+                    "drafts_proposed": self.drafts_proposed,
+                    "drafts_accepted": self.drafts_accepted,
+                    "acceptance_rate": round(
+                        self.drafts_accepted /
+                        max(1, self.drafts_proposed), 4),
+                    "accepted_per_step":
+                        self.accepted_per_step.summary(digits=3),
+                    "draft_step_ms":
+                        self.draft_step_s.summary(scale=1e3),
+                    "verify_step_ms":
+                        self.verify_step_s.summary(scale=1e3),
+                    "wasted_draft_tokens": wasted_drafts,
+                }}),
                 **({} if mem is None else {"memory": mem}),
                 **({} if not self._mfu else {"mfu": {
                     "device": self._spec.as_dict(),

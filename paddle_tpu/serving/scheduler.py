@@ -80,7 +80,7 @@ class Request:
     _ids = itertools.count()
 
     def __init__(self, prompt, memory=None, *, max_new_tokens=32,
-                 eos_id=1, deadline=None, stream_cb=None):
+                 eos_id=1, deadline=None, stream_cb=None, spec=True):
         prompt = np.asarray(prompt)
         if prompt.ndim != 1:
             raise ValueError(f"prompt must be 1-D [P], got "
@@ -94,6 +94,11 @@ class Request:
         self.eos_id = eos_id
         self.deadline = deadline      # absolute engine-clock seconds
         self.stream_cb = stream_cb    # called (request, token) per token
+        # speculative decoding opt-out: on a spec-enabled engine a
+        # spec=False request decodes one oracle token per step (its
+        # draft lanes ride along unmatched) — output is identical
+        # either way, this only trades verify width for latency
+        self.spec = bool(spec)
         self.tokens = []              # generated so far (ints)
         self.state = "QUEUED"         # QUEUED -> RUNNING -> DONE
         self.finish_reason = None

@@ -68,7 +68,8 @@ class ServingServer:
         return self
 
     def submit(self, prompt, memory=None, *, max_new_tokens=32,
-               eos_id=1, deadline=None, timeout=None, stream_cb=None):
+               eos_id=1, deadline=None, timeout=None, stream_cb=None,
+               spec=True):
         """Enqueue one generation request; returns the `Request` whose
         `.result()` blocks for a RequestResult and whose `.cancel()`
         withdraws it. `timeout` (seconds from now) is sugar for an
@@ -82,7 +83,7 @@ class ServingServer:
             deadline = self.clock() + float(timeout)
         r = Request(prompt, memory, max_new_tokens=max_new_tokens,
                     eos_id=eos_id, deadline=deadline,
-                    stream_cb=stream_cb)
+                    stream_cb=stream_cb, spec=spec)
         self.engine.admit_check(r)   # fail fast, before queueing
         try:
             self.scheduler.submit(r)

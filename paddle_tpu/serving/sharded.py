@@ -223,7 +223,7 @@ class ShardedServingEngine(ServingEngine):
         c = lambda x: jax.lax.with_sharding_constraint(  # noqa: E731
             x, self._ns_pool)
         out = dict(state)
-        for k in ("tok", "bias", "mem"):
+        for k in ("tok", "bias", "mem", "hist", "plen", "pbk"):
             if k in out:
                 out[k] = c(out[k])
         if "inc" in out:
@@ -266,6 +266,18 @@ class ShardedServingEngine(ServingEngine):
     def _build_step(self, key):
         return self._wrap_state_out(self._step_body(key), True)
 
+    def _build_spec_step(self, vkey):
+        # the spec verify body returns (state, (emit, n_emit)) — the
+        # same state-out contract, annotated identically
+        return self._wrap_state_out(self._spec_step_body(vkey), True)
+
+    def _build_draft(self, dkey):
+        # pure gathers over dp-sharded per-slot rows; the SPMD
+        # partitioner follows the operand layouts, no pinning needed
+        import jax
+
+        return jax.jit(self._draft_body(dkey))
+
     # ------------------------------------------------------------------
     # pool state placement
     # ------------------------------------------------------------------
@@ -282,12 +294,13 @@ class ShardedServingEngine(ServingEngine):
         materialize on one device)."""
         import jax
 
-        L, S = self.max_len, self.num_slots
+        L, S = self._pool_len, self.num_slots
         dtype = state["mem"].dtype
         decoder = self._net.decoder
         out = dict(state)
-        for k in ("tok", "bias", "mem"):
-            out[k] = jax.device_put(state[k], self._ns_pool)
+        for k in ("tok", "bias", "mem", "hist", "plen", "pbk"):
+            if k in state:
+                out[k] = jax.device_put(state[k], self._ns_pool)
         out["static"] = [
             (jax.device_put(sk, self._ns_pool),
              jax.device_put(sv, self._ns_pool))
@@ -366,6 +379,7 @@ class ShardedServingEngine(ServingEngine):
         self._pending.add(s)
         self._pending_info[s] = {
             "req": r, "outs": outs, "mem": mem, "Pb": Pb,
+            "prompt": np.asarray(prompt_b, np.int32), "P0": P0,
             "t0": time.monotonic()}
         return None   # token 0 is delivered by the splice
 
@@ -381,7 +395,7 @@ class ShardedServingEngine(ServingEngine):
 
         fm = self._fm
         decoder = self._net.decoder
-        L = self.max_len
+        L = self._pool_len
         key = ("prefill", Pb)
         neg = float(NEG)
 
@@ -420,9 +434,11 @@ class ShardedServingEngine(ServingEngine):
 
         key = ("splice", Pb)
         ns, ns1 = self._ns_pool, self._ns_pool
+        L = self._pool_len
+        spec = bool(self.spec_k)
 
         def splice_fn(state, slot, tok0, bias_row, kvs, statics,
-                      memory):
+                      memory, prompt, length):
             self.trace_counts[key] += 1
             new_inc = [MHA.static_kv_splice(pool, slot, k, v,
                                             jnp.int32(Pb),
@@ -432,7 +448,7 @@ class ShardedServingEngine(ServingEngine):
                 (MHA.splice_rows(pk, slot, sk, constraint=ns),
                  MHA.splice_rows(pv, slot, sv, constraint=ns))
                 for (pk, pv), (sk, sv) in zip(state["static"], statics)]
-            return dict(
+            out = dict(
                 state,
                 tok=jax.lax.with_sharding_constraint(
                     jax.lax.dynamic_update_slice(
@@ -442,6 +458,20 @@ class ShardedServingEngine(ServingEngine):
                 mem=MHA.splice_rows(state["mem"], slot, memory,
                                     constraint=ns),
                 inc=new_inc, static=new_static)
+            if spec:
+                hist_row = jnp.concatenate(
+                    [prompt, jnp.zeros((1, L - Pb), jnp.int32)], 1)
+                out["hist"] = MHA.splice_rows(state["hist"], slot,
+                                              hist_row, constraint=ns)
+                out["plen"] = jax.lax.with_sharding_constraint(
+                    jax.lax.dynamic_update_slice(
+                        state["plen"], length.astype(jnp.int32),
+                        (slot,)), ns)
+                out["pbk"] = jax.lax.with_sharding_constraint(
+                    jax.lax.dynamic_update_slice(
+                        state["pbk"], jnp.full((1,), Pb, jnp.int32),
+                        (slot,)), ns)
+            return out
 
         return jax.jit(splice_fn)
 
@@ -484,7 +514,9 @@ class ShardedServingEngine(ServingEngine):
                 tok0, kvs, statics, bias_row = moved
                 self._state = fn(self._state, jnp.int32(s), tok0,
                                  bias_row, kvs, statics,
-                                 jnp.asarray(info["mem"]))
+                                 jnp.asarray(info["mem"]),
+                                 jnp.asarray(info["prompt"]),
+                                 jnp.asarray([info["P0"]], jnp.int32))
                 tok0 = int(tok0)
             except Exception as e:
                 # per-request isolation: the failed splice kills THIS

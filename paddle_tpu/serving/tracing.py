@@ -30,6 +30,10 @@ Span taxonomy (exported Chrome-trace names):
   decode.step     engine track: one batched decode step (attrs:
                   n_active, slots, occupancy, queue depth, page-pool
                   and shard gauges)
+  decode.draft    engine track: a speculative draft proposal dispatch
+                  (attrs: n_active, proposed)
+  decode.verify   engine track: the k-token verify dispatch (attrs:
+                  n_active, proposed, accepted)
   compile         engine track: one jit trace+compile (attrs: cache
                   key, duration, count)
   retrace         engine track instant: a retrace-sentinel violation
@@ -66,6 +70,8 @@ SPAN_TAXONOMY = (
     ("finish", "terminal instant: finish_reason"),
     ("error", "terminal instant: failure cause"),
     ("decode.step", "engine track: one batched decode step"),
+    ("decode.draft", "engine track: speculative draft proposal"),
+    ("decode.verify", "engine track: k-token speculative verify"),
     ("compile", "engine track: one jit trace+compile"),
     ("retrace", "engine track: retrace-sentinel violation"),
 )
@@ -231,6 +237,21 @@ def on_decode_step(engine, t0, t1, active, scheduler=None):
                     else list(v) if isinstance(v, (list, tuple))
                     else v)
     tr.add_complete("decode.step", t0, t1, cat="engine", attrs=attrs)
+
+
+def on_spec_step(t0, t1, t2, n_active, proposed, accepted):
+    """Engine-track spans for one speculative iteration's two
+    dispatches: the draft proposal ([t0, t1]) and the k-token verify
+    ([t1, t2]) with the device-side acceptance counts — the waterfall
+    report's speculation-phase breakdown reads these."""
+    tr = _trace._SESSION
+    if tr is None:
+        return
+    tr.add_complete("decode.draft", t0, t1, cat="engine",
+                    attrs={"n_active": n_active, "proposed": proposed})
+    tr.add_complete("decode.verify", t1, t2, cat="engine",
+                    attrs={"n_active": n_active, "proposed": proposed,
+                           "accepted": accepted})
 
 
 # ----------------------------------------------------------------------

@@ -9,6 +9,7 @@ from ..io import Dataset
 from . import datasets  # noqa: F401
 from . import decode  # noqa: F401
 from . import generation  # noqa: F401
+from . import speculative  # noqa: F401
 from . import viterbi  # noqa: F401
 
 

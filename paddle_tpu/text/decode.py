@@ -61,6 +61,126 @@ def greedy_search(step_fn, init_state, batch_size, bos_id, eos_id,
     return toks, lengths
 
 
+def greedy_accept(drafts, preds):
+    """Greedy draft-verify acceptance. drafts [B, K-1] are the proposed
+    continuation tokens; preds [B, K] = argmax of the verify logits for
+    the fed block [pending, d_1, ..., d_{K-1}] — preds[:, i] is the
+    oracle token FOLLOWING fed position i. Draft i is accepted only
+    while every earlier draft matched its oracle (the classic greedy
+    speculative-decoding rule, which keeps the output bit-identical to
+    plain greedy for any draft source).
+
+    Returns (n_match [B] in [0, K-1], emit [B, K]): emit[:, i] is the
+    i-th newly emitted token — the accepted drafts, then the correction
+    token preds[:, n_match] at position n_match (positions past n_match
+    repeat the correction token; callers emit only n_match + 1)."""
+    jnp = _jnp()
+    K = preds.shape[1]
+    match = (drafts == preds[:, :-1]).astype(jnp.int32)
+    # explicit int32: under jax_enable_x64 integer reductions promote
+    # to int64, which would poison the while-loop carry dtypes
+    n_match = jnp.cumprod(match, axis=1).sum(
+        axis=1).astype(jnp.int32)                             # [B]
+    corr = jnp.take_along_axis(preds, n_match[:, None], axis=1)
+    ii = jnp.arange(K, dtype=jnp.int32)[None, :]
+    dpad = jnp.concatenate([drafts, corr], axis=1)            # [B, K]
+    emit = jnp.where(ii < n_match[:, None], dpad, corr)
+    return n_match, emit.astype(jnp.int32)
+
+
+def spec_greedy_search(verify_fn, draft_fn, rollback_fn, init_state,
+                       batch_size, eos_id, max_len, k, init_logits,
+                       return_stats=False):
+    """Speculative greedy decoding: the draft-verify counterpart of
+    `greedy_search`. Each round proposes k - 1 draft tokens, runs ONE
+    k-token verify step (the pending token plus the drafts, written
+    into the cache at each row's current offset), accepts the longest
+    matching prefix with `greedy_accept`, and rolls the cache back to
+    the accepted length. Greedy acceptance keeps the output
+    BIT-IDENTICAL to plain greedy decoding for ANY draft source; the
+    whole generation is one fixed-k `lax.while_loop` whose carry holds
+    the accepted-count arithmetic, so variable accept-lengths never
+    change a shape and never retrace.
+
+    verify_fn(tokens [B, k], state) -> (logits [B, k, V], state) — one
+        k-token model step; must write the fed tokens at each row's
+        current cache offset (`ops.attention.kv_verify_scope`).
+    draft_fn(pending [B], emitted [B], state) -> (drafts [B, k-1],
+        state) — any deterministic proposal source: n-gram
+        self-speculation, a small draft model stepping its own cache.
+    rollback_fn(state, n_match [B], active [B]) -> state — set every
+        cache write index back to (pre-verify index + 1 + n_match) on
+        active rows, and pin inactive rows' indices (verify advanced
+        them all by k).
+
+    init_logits [B, V]: prefill logits; the first emitted token is
+    their argmax, exactly as in `greedy_search(init_logits=...)`.
+
+    Returns (tokens [B, max_len], lengths [B]); with return_stats=True
+    also a {"rounds", "proposed", "accepted"} dict of traced scalars —
+    accepted counts draft tokens that were emitted, so the wasted-draft
+    telemetry is exact."""
+    import jax
+
+    jnp = _jnp()
+    B, K = batch_size, k
+    tok0 = init_logits.argmax(-1).astype(jnp.int32)
+    done0 = tok0 == eos_id
+    # the buffer is k wider than max_len so a round's fixed-k block
+    # write never clips; initialized to eos so capped tails match the
+    # plain greedy convention (tokens after eos are eos)
+    buf0 = jnp.full((B, max_len + K), eos_id, jnp.int32)
+    buf0 = buf0.at[:, 0].set(tok0)
+    cnt0 = jnp.ones((B,), jnp.int32)
+    z = jnp.int32(0)
+    carry0 = (tok0, init_state, done0, cnt0, buf0, z, z, z)
+
+    def cond(carry):
+        return ~jnp.all(carry[2])
+
+    def body(carry):
+        pending, state, done, cnt, buf, rounds, prop, acc = carry
+        active = ~done
+        drafts, state = draft_fn(pending, cnt, state)
+        fed = jnp.concatenate([pending[:, None], drafts], axis=1)
+        logits, state = verify_fn(fed, state)
+        preds = logits.argmax(-1).astype(jnp.int32)
+        n_match, emit = greedy_accept(drafts, preds)
+        # emission caps: stop at the first emitted eos (inclusive) and
+        # never past the max_len budget; done rows emit nothing
+        ii = jnp.arange(K, dtype=jnp.int32)[None, :]
+        eos_pos = jnp.min(jnp.where(emit == eos_id, ii, K), axis=1)
+        n_emit = jnp.minimum(n_match + 1, eos_pos + 1)
+        n_emit = jnp.minimum(n_emit, jnp.int32(max_len) - cnt)
+        n_emit = jnp.where(active, n_emit, 0)
+        blk = jnp.where(ii < n_emit[:, None], emit, eos_id)
+
+        def wr(row, blk_row, at):
+            return jax.lax.dynamic_update_slice(row, blk_row, (at,))
+
+        buf = jax.vmap(wr)(buf, blk, cnt)
+        state = rollback_fn(state, n_match, active)
+        cnt = (cnt + n_emit).astype(jnp.int32)
+        done = done | (eos_pos < n_emit) | (cnt >= jnp.int32(max_len))
+        corr = jnp.take_along_axis(preds, n_match[:, None],
+                                   axis=1)[:, 0]
+        pending = jnp.where(active, corr, pending)
+        n_act = active.astype(jnp.int32).sum().astype(jnp.int32)
+        rounds = (rounds + jnp.minimum(n_act, 1)).astype(jnp.int32)
+        prop = (prop + n_act * jnp.int32(K - 1)).astype(jnp.int32)
+        acc = (acc + jnp.where(active, jnp.minimum(n_match, n_emit),
+                               0).sum()).astype(jnp.int32)
+        return pending, state, done, cnt, buf, rounds, prop, acc
+
+    (_, _, _, cnt, buf, rounds, prop, acc) = jax.lax.while_loop(
+        cond, body, carry0)
+    toks = buf[:, :max_len]
+    if return_stats:
+        return toks, cnt, {"rounds": rounds, "proposed": prop,
+                           "accepted": acc}
+    return toks, cnt
+
+
 def beam_search(step_fn, init_state, batch_size, bos_id, eos_id,
                 beam_size, max_len, length_penalty=0.0,
                 return_state=False, init_logits=None):

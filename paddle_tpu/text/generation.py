@@ -122,6 +122,7 @@ class DecodeEngine:
         self._compiled = _trace.JitCache(self)
         self.trace_counts = _trace.ObservedCounter(owner="DecodeEngine")
         self._n_params = None      # cached for cost_hint
+        self._draft = None         # DraftModel of the last spec call
 
     def cost_hint(self, key):
         """Analytic cost for one compiled (prefill + scan) program —
@@ -162,16 +163,37 @@ class DecodeEngine:
     def generate(self, memory, prompt=None, prompt_lengths=None, *,
                  bos_id=0, eos_id=1, max_new_tokens=32, beam_size=1,
                  length_penalty=0.0, memory_mask=None,
-                 bucket_batch=True):
+                 bucket_batch=True, spec_k=None, spec_ngram=2,
+                 draft_model=None, return_spec_stats=False):
         """Generate max_new_tokens per row. Greedy (beam_size=1) returns
         (tokens [B, max_new_tokens], lengths [B]); beam returns
         (tokens [B, K, max_new_tokens] best-first, scores [B, K],
         lengths [B, K]). `prompt` [B, P] int (must start with bos;
         defaults to a bos column); ragged prompts pass prompt_lengths
-        [B] and right-pad."""
+        [B] and right-pad.
+
+        spec_k >= 2 switches greedy generation to SPECULATIVE
+        draft-verify (text/speculative.py): each round drafts spec_k -
+        1 tokens — suffix n-gram self-speculation over the row's own
+        history by default (`spec_ngram`), or a `DraftModel` with its
+        own StaticKVCache — and one spec_k-token verify step accepts
+        the matching prefix. Output is BIT-IDENTICAL to spec_k=None;
+        only the dispatch count changes. `return_spec_stats=True`
+        appends a {rounds, proposed, accepted} acceptance-telemetry
+        dict. One compile per (bucket, spec_k): `spec_k` should come
+        from a small fixed set (pow2: 2/4/8) so the jit cache stays
+        bounded."""
         import jax.numpy as jnp
         import numpy as np
 
+        if spec_k is not None:
+            spec_k = int(spec_k)
+            if spec_k < 2:
+                raise ValueError("spec_k must be >= 2 (the pending "
+                                 "token plus at least one draft)")
+            if beam_size != 1:
+                raise ValueError("speculative decoding is greedy-only "
+                                 "(beam_size must be 1)")
         memory = _raw(memory)
         B0 = memory.shape[0]
         if prompt is None:
@@ -190,10 +212,12 @@ class DecodeEngine:
         memory_b = _pad_rows(memory, Bb)
         mm_b = None if memory_mask is None else \
             _pad_rows(_raw(memory_mask), Bb)
+        self._draft = draft_model
         key = (Bb, Pb, int(max_new_tokens), int(beam_size),
                int(bos_id), int(eos_id), float(length_penalty),
                memory_b.shape[1:], str(memory_b.dtype),
-               mm_b is not None)
+               mm_b is not None, spec_k or 0, int(spec_ngram),
+               0 if draft_model is None else id(draft_model))
         fn = self._compiled.get(key)
         if fn is None:
             fn = self._build(key)
@@ -203,7 +227,17 @@ class DecodeEngine:
                 prompt_b, lengths_b]
         if mm_b is not None:
             args.append(mm_b)
+        if spec_k is not None and draft_model is not None:
+            args += [draft_model.params(), draft_model.buffers()]
         out = fn(*args)
+        if spec_k is not None:
+            toks, lens, stats = out
+            toks = np.asarray(toks)[:B0]
+            lens = np.asarray(lens)[:B0]
+            if return_spec_stats:
+                return toks, lens, {k2: int(v)
+                                    for k2, v in stats.items()}
+            return toks, lens
         if beam_size == 1:
             toks, lens = out
             return np.asarray(toks)[:B0], np.asarray(lens)[:B0]
@@ -217,15 +251,28 @@ class DecodeEngine:
         import jax.numpy as jnp
 
         (Bb, Pb, max_new, K, bos_id, eos_id, lp, _mshape, _mdtype,
-         has_mm) = key
+         has_mm) = key[:10]
+        spec_k = int(key[10]) if len(key) > 10 else 0
+        ngram = int(key[11]) if len(key) > 11 else 2
+        has_draft = bool(key[12]) if len(key) > 12 else False
+        draft = self._draft if has_draft else None
         fm = self._fm
         decoder = self._net.decoder
-        L = Pb + max_new  # the max_length preallocation contract
+        # the max_length preallocation contract; speculative decoding
+        # pads the cache by spec_k so a round's fixed-k verify write
+        # never clips (the extra tail stays masked — bit-neutral)
+        L = Pb + max_new + spec_k
 
         def gen_fn(params, buffers, memory, prompt, lengths,
-                   mem_mask=None):
+                   *extra):
             self.trace_counts[key] += 1  # python side effect: one per
             #                              trace = one per compile
+            i = 0
+            mem_mask = None
+            if has_mm:
+                mem_mask, i = extra[0], 1
+            if has_draft:
+                dparams, dbuffers = extra[i], extra[i + 1]
             kpos = jnp.arange(L, dtype=jnp.int32)
             hole = (kpos[None, :] >= lengths[:, None]) & \
                 (kpos[None, :] < jnp.int32(Pb))
@@ -244,6 +291,89 @@ class DecodeEngine:
             # position, not the pad tail
             last = jnp.take_along_axis(
                 lg, (lengths - 1)[:, None, None], axis=1)[:, 0]
+            if spec_k:
+                from ..ops import attention as A
+                from . import speculative as SP
+                from .decode import spec_greedy_search
+
+                iota_k = jnp.arange(spec_k, dtype=jnp.int32)
+                hist0 = jnp.zeros((Bb, L), jnp.int32)
+                hist0 = jax.lax.dynamic_update_slice(
+                    hist0, prompt, (jnp.int32(0), jnp.int32(0)))
+                state0 = {"inc": inc1, "hist": hist0}
+                if has_draft:
+                    dfm = draft._fm
+                    ddec = draft._net.decoder
+                    dinc0 = [ly.self_attn.gen_cache(
+                        None, max_length=L, batch_size=Bb,
+                        dtype=memory.dtype) for ly in ddec.layers]
+                    (_, dinc1, dstatic), _ = dfm.apply(
+                        dparams, dbuffers, None, prompt, positions,
+                        memory, training=False,
+                        tgt_mask=pad_bias[:, :Pb],
+                        memory_mask=mem_mask, inc=dinc0, prefill=True)
+                    state0["dinc"] = dinc1
+
+                def verify_fn(fed, st):
+                    posn = st["inc"][0].index[:, None] + iota_k[None, :]
+                    with A.kv_verify_scope():
+                        (lg2, inc2), _ = fm.apply(
+                            params, buffers, None, fed, posn, memory,
+                            training=False, tgt_mask=pad_bias,
+                            memory_mask=mem_mask, inc=st["inc"],
+                            static_kv=static_kv, prefill=False)
+                    return lg2, dict(
+                        st, inc=inc2,
+                        hist=SP.write_hist(st["hist"], fed,
+                                           st["inc"][0].index))
+
+                if has_draft:
+                    def draft_fn(pending, cnt, st):
+                        dinc = st["dinc"]
+                        t = pending
+                        toks_d = []
+                        # k-1 draft proposals, then one write-only step
+                        # so the draft cache covers the verify's k slots
+                        for _ in range(spec_k - 1):
+                            posn = dinc[0].index[:, None]
+                            (lgd, dinc), _ = dfm.apply(
+                                dparams, dbuffers, None, t[:, None],
+                                posn, memory, training=False,
+                                tgt_mask=pad_bias, memory_mask=mem_mask,
+                                inc=dinc, static_kv=dstatic,
+                                prefill=False)
+                            t = lgd[:, 0].argmax(-1).astype(jnp.int32)
+                            toks_d.append(t)
+                        posn = dinc[0].index[:, None]
+                        (_, dinc), _ = dfm.apply(
+                            dparams, dbuffers, None, t[:, None], posn,
+                            memory, training=False, tgt_mask=pad_bias,
+                            memory_mask=mem_mask, inc=dinc,
+                            static_kv=dstatic, prefill=False)
+                        return (jnp.stack(toks_d, axis=1),
+                                dict(st, dinc=dinc))
+                else:
+                    def draft_fn(pending, cnt, st):
+                        drafts = SP.ngram_propose(
+                            st["hist"], pending, lengths, Pb,
+                            spec_k - 1, cnt - 1, ngram)
+                        return drafts, st
+
+                def rollback_fn(st, n_match, active):
+                    out = dict(st, inc=[
+                        c._replace(index=SP.rollback_index(
+                            c.index, spec_k, n_match, active))
+                        for c in st["inc"]])
+                    if has_draft:
+                        out["dinc"] = [
+                            c._replace(index=SP.rollback_index(
+                                c.index, spec_k, n_match, active))
+                            for c in st["dinc"]]
+                    return out
+
+                return spec_greedy_search(
+                    verify_fn, draft_fn, rollback_fn, state0, Bb,
+                    eos_id, max_new, spec_k, last, return_stats=True)
             rep = 1 if K == 1 else K
 
             def tile(t):

@@ -1,0 +1,143 @@
+"""Speculative decoding: pluggable draft sources for the fused scan.
+
+Draft-verify generation (the classic speculative-sampling /
+Medusa-style self-drafting recipe, greedy-acceptance variant): instead
+of one bandwidth-bound decode dispatch per token, a cheap DRAFT source
+proposes k - 1 continuation tokens, ONE k-token VERIFY step runs them
+through the target model against the live KV cache (the pending token
+plus the drafts, written at each row's own offset —
+`ops.attention.verify_attention`), and the longest draft prefix whose
+tokens match the verify argmaxes is accepted together with one free
+correction token (`text.decode.greedy_accept`). Greedy acceptance
+makes the output BIT-IDENTICAL to plain greedy decoding for ANY draft
+source, so the repo's fused-vs-eager identity tests extend directly.
+
+Draft sources here:
+
+  * `ngram_propose` — zero-cost self-speculation: suffix n-gram
+    matching over the row's OWN prompt + generated history (a token
+    mirror of the KV cache, same absolute-slot layout and the same
+    index arithmetic for rollback). Pure jnp, fixed shapes, traced
+    into the same program as the verify step. Strong on repetitive
+    suffixes (code, templated text, copy-through), harmless elsewhere
+    (unaccepted drafts cost only the verify lane they rode in).
+  * `DraftModel` — a small draft model with its OWN StaticKVCache,
+    prefilled alongside the target and stepped k times per round so
+    both caches stay in lockstep; acceptance rolls both back with the
+    same per-row write-index arithmetic.
+
+Cache rollback needs NO copy: the verify step writes all k fed tokens,
+acceptance just sets the per-row write index back to (pre-verify +
+1 + n_match); rejected positions hold garbage that the next round's
+fixed-k write covers before any query can see it (key positions >= the
+write index are masked everywhere).
+"""
+from __future__ import annotations
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def ngram_propose(hist, pending, lengths, Pb, n_new, gen_len, ngram=2):
+    """Suffix n-gram self-speculation: propose `n_new` tokens for each
+    row by finding the most recent position in the row's own history
+    whose trailing `ngram`-gram matches the current context, and
+    reading the tokens that followed it.
+
+    hist [B, L]: token mirror of the KV cache — prompt at [0, Pb) with
+    its pad hole, generated tokens from Pb in absolute-slot layout.
+    pending [B]: the last emitted token (not yet written — the cache's
+    pending-token convention). lengths [B]: true prompt lengths (the
+    hole [lengths, Pb) is skipped by matching in LOGICAL coordinates).
+    gen_len [B]: count of valid generated tokens in hist (= emitted -
+    1). Rows with no match repeat the pending token — any proposal is
+    output-safe under greedy acceptance, a wrong one just wastes its
+    verify lane. Pure jnp, fixed shapes, fully traced."""
+    jnp = _jnp()
+    B, L = hist.shape
+    lens = jnp.asarray(lengths, jnp.int32).reshape(-1, 1)     # [B, 1]
+    # Pb may be a python int (DecodeEngine: one bucket per program) or
+    # a per-row [B] array (the serving pool: slots joined at different
+    # prompt buckets co-reside)
+    Pbv = jnp.asarray(Pb, jnp.int32).reshape(-1, 1)
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    # logical view: real prompt tokens then generated tokens, the pad
+    # hole [len, Pb) spliced out
+    phys = jnp.where(pos < lens, pos, pos + (Pbv - lens))
+    hl = jnp.take_along_axis(hist, jnp.clip(phys, 0, L - 1), axis=1)
+    ell = lens[:, 0] + jnp.asarray(gen_len, jnp.int32)        # [B]
+    # match at q: hl[q] == pending and hl[q - j] == (j-th token back
+    # from the current end) for j = 1..ngram-1
+    ok = hl == pending[:, None]
+    for j in range(1, int(ngram)):
+        cj = jnp.take_along_axis(
+            hl, jnp.clip(ell - j, 0, L - 1)[:, None], axis=1)
+        hl_back = jnp.take_along_axis(hl, jnp.clip(pos - j, 0, L - 1),
+                                      axis=1)
+        ok = ok & (hl_back == cj) & (pos >= j)
+    ok = ok & (pos < ell[:, None])
+    q = jnp.max(jnp.where(ok, pos, -1), axis=1)               # [B]
+    # the match distance IS the detected period: position ell + 1 + j
+    # (the j-th proposal) reads q + 1 + (j mod p), wrapping so periodic
+    # continuations of ANY period are proposed in full; the wrap
+    # position that lands on ell itself is the pending token
+    p = jnp.maximum(ell - q, 1)[:, None]                      # [B, 1]
+    jj = jnp.arange(n_new, dtype=jnp.int32)[None, :]
+    off = q[:, None] + 1 + jj % p
+    gath = jnp.take_along_axis(hl, jnp.clip(off, 0, L - 1), axis=1)
+    oob = (q[:, None] < 0) | (off >= ell[:, None])
+    return jnp.where(oob, pending[:, None], gath).astype(jnp.int32)
+
+
+def write_hist(hist, fed, index):
+    """Mirror a verify round's fed block [B, k] into the history buffer
+    at each row's cache write offset (the SAME per-row vmapped
+    dynamic_update_slice the cache write uses — one source of truth for
+    the slot layout, and rollback is implicit: validity is derived from
+    the rolled-back write index)."""
+    import jax
+    import jax.numpy as jnp
+
+    def wr(row, blk, at):
+        return jax.lax.dynamic_update_slice(row, blk.astype(row.dtype),
+                                            (at,))
+
+    return jax.vmap(wr)(hist, fed, jnp.asarray(index, jnp.int32))
+
+
+def rollback_index(index, k, n_match, active):
+    """The acceptance-time write-index arithmetic shared by every
+    cache: verify advanced `index` by k; keep the pending token plus
+    the accepted drafts on active rows, pin inactive rows."""
+    jnp = _jnp()
+    keep = jnp.where(active, 1 + jnp.asarray(n_match, jnp.int32), 0)
+    return (jnp.asarray(index, jnp.int32) - jnp.int32(k) +
+            keep).astype(jnp.int32)
+
+
+class DraftModel:
+    """A small draft model with its OWN StaticKVCache. Wraps a
+    (decoder, embed, project) triple that shares the target's
+    vocabulary and cross-attention memory; the spec engine prefills it
+    alongside the target, steps it k times per round (the pending
+    token, then each draft — the last step is write-only so the draft
+    cache covers the same k positions the verify writes), and rolls
+    its write indices back with the target's own acceptance
+    arithmetic."""
+
+    def __init__(self, decoder, embed, project):
+        from ..parallel.functional import functionalize
+        from .generation import _StepNet
+
+        self.decoder = decoder
+        self._net = _StepNet(decoder, embed, project)
+        self._fm = functionalize(self._net)
+
+    def params(self):
+        return self._fm.params()
+
+    def buffers(self):
+        return self._fm.buffers()

@@ -13,8 +13,22 @@ from paddle_tpu.core import native, program_pb
 from paddle_tpu.inference import Config, create_predictor
 
 
+def _protoc_ok():
+    """save/load_inference_model serializes through protoc-generated
+    descriptors; skip (not error) where the toolchain is absent."""
+    import shutil
+
+    return (os.path.exists(program_pb._DESC)
+            or shutil.which("protoc") is not None)
+
+
 @pytest.fixture(scope="module")
 def saved_model(tmp_path_factory):
+    if not _protoc_ok():
+        # a missing protoc used to surface as FileNotFoundError fixture
+        # ERRORs in every dependent test — a clean environment skip, not
+        # a failure class
+        pytest.skip("protoc unavailable (csrc/build descriptors absent)")
     d = str(tmp_path_factory.mktemp("infer_model"))
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
@@ -40,6 +54,7 @@ def saved_model(tmp_path_factory):
     return d, xb, ref
 
 
+@pytest.mark.skipif(not _protoc_ok(), reason="protoc unavailable")
 def test_program_proto_roundtrip():
     main = fluid.Program()
     with fluid.program_guard(main, fluid.Program()):
@@ -83,15 +98,6 @@ def test_xla_predictor(saved_model):
     oh = pred.get_output_handle(pred.get_output_names()[0])
     np.testing.assert_allclose(oh.copy_to_cpu(), ref, rtol=1e-5,
                                atol=1e-6)
-
-
-def _protoc_ok():
-    """save/load_inference_model serializes through protoc-generated
-    descriptors; skip (not error) where the toolchain is absent."""
-    import shutil
-
-    return (os.path.exists(program_pb._DESC)
-            or shutil.which("protoc") is not None)
 
 
 @pytest.mark.skipif(not _protoc_ok(), reason="protoc unavailable")

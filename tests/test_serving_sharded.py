@@ -368,6 +368,40 @@ def test_sharded_paged_bitmatch_prefix_and_leakfree():
     assert eng._alloc.pages_free == eng.num_pages
 
 
+def test_sharded_spec_decode_bitmatch_single_trace():
+    """Speculative decoding over the sharded pool: the draft + verify
+    programs compile ONCE each under the pool annotations (retrace
+    sentinel armed), every request bit-matches its solo eager run, and
+    acceptance telemetry records."""
+    from paddle_tpu.serving import retrace_sentinel
+
+    stack = _small_stack(seed=91)
+    dec, embed, proj, D, V = stack
+    eng = ShardedServingEngine(dec, embed, proj, mesh=_mesh222(),
+                               num_slots=2, max_len=16, spec_k=4)
+    retrace_sentinel(eng).__enter__()   # disarmed by conftest teardown
+    sched = Scheduler(max_queue=8)
+    rs = np.random.RandomState(92)
+    reqs = [_mk_request(rs, D, V, pmax=4, nmax=6) for _ in range(4)]
+    for r in reqs:
+        sched.submit(r)
+    _drive(eng, sched, reqs)
+    eager_cache = {}
+    for r in reqs:
+        res = r.result(timeout=5)
+        assert res.ok, res
+        key = tuple(r.prompt.tolist())
+        if key not in eager_cache:
+            eager_cache[key] = _eager_reference(stack, r, max_new=6)
+        np.testing.assert_array_equal(
+            res.tokens, eager_cache[key][0][:len(res.tokens)])
+    spec = eng.metrics.snapshot()["speculation"]
+    assert spec["rounds"] >= 1
+    assert 0 <= spec["drafts_accepted"] <= spec["drafts_proposed"]
+    assert len([k for k in eng.trace_counts if k[0] == "draft"]) == 1
+    assert len([k for k in eng.trace_counts if k[0] == "sstep"]) == 1
+
+
 # ----------------------------------------------------------------------
 # the early guard on single-chip engines
 # ----------------------------------------------------------------------

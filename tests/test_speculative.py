@@ -1,0 +1,445 @@
+"""Speculative decoding: draft-verify generation on the fused scan.
+
+Covers: k-token verify attention parity (reference == stepwise decode;
+flash_verify interpret-mode kernel == reference, per-row lengths);
+greedy acceptance math; n-gram proposal behavior; DecodeEngine
+spec-vs-eager bit-match across ragged prompts and k buckets (n-gram
+AND draft-model sources); rollback correctness of the per-row write
+indices after partial acceptance (the accepted cache prefix is
+bit-identical to sequential decode writes); the one-trace-per-
+(bucket, k) compile contract; a serving soak with speculation enabled
+(survivors bit-match eager, acceptance counters consistent, retrace
+sentinel armed, per-request opt-out mixed in); a chaos cell (verify-
+step fault -> eviction with partials, pool revives); and the
+spec-config guard rails.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu import nn
+from paddle_tpu.nn.layer.transformer import (MultiHeadAttention,
+                                             TransformerDecoder,
+                                             TransformerDecoderLayer)
+from paddle_tpu.ops.attention import (decode_attention_reference,
+                                      flash_verify, kv_verify_scope,
+                                      verify_attention_reference)
+from paddle_tpu.serving import (Request, Scheduler, ServingEngine,
+                                retrace_sentinel)
+from paddle_tpu.testing import faults
+from paddle_tpu.text.decode import greedy_accept
+from paddle_tpu.text.generation import (DecodeEngine, bucket_size,
+                                        generate_eager)
+from paddle_tpu.text.speculative import (DraftModel, ngram_propose,
+                                         rollback_index)
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ----------------------------------------------------------------------
+# verify attention: reference semantics + kernel parity
+# ----------------------------------------------------------------------
+
+def test_verify_reference_matches_stepwise_decode():
+    """A T-token verify block equals T sequential single-token decode
+    steps: query i sees the cache prefix plus the fed tokens before
+    and including itself. Activations agree to final-ulp (XLA's T-row
+    matmul kernel accumulates in a different register order than the
+    1-row kernel); TOKEN-level bit-identity — the contract that
+    matters — is asserted by the end-to-end tests below, where every
+    emitted token is the verify oracle's own argmax."""
+    jnp = _jnp()
+    rs = np.random.RandomState(0)
+    b, h, L, d, T, n0 = 2, 2, 16, 8, 4, 5
+    kbuf = jnp.asarray(rs.randn(b, h, L, d).astype("f4"))
+    vbuf = jnp.asarray(rs.randn(b, h, L, d).astype("f4"))
+    newk = rs.randn(b, h, T, d).astype("f4")
+    newv = rs.randn(b, h, T, d).astype("f4")
+    q = jnp.asarray(rs.randn(b, h, T, d).astype("f4"))
+    # block write at n0, then one verify call
+    kb = kbuf.at[:, :, n0:n0 + T].set(newk)
+    vb = vbuf.at[:, :, n0:n0 + T].set(newv)
+    got = verify_attention_reference(q, kb, vb, n0 + T)
+    # stepwise: write token i, attend with length n0 + i + 1
+    kk, vv = kbuf, vbuf
+    for i in range(T):
+        kk = kk.at[:, :, n0 + i].set(newk[:, :, i])
+        vv = vv.at[:, :, n0 + i].set(newv[:, :, i])
+        ref = decode_attention_reference(q[:, :, i:i + 1], kk, vv,
+                                         n0 + i + 1)
+        np.testing.assert_allclose(np.asarray(got[:, :, i:i + 1]),
+                                   np.asarray(ref), rtol=1e-6,
+                                   atol=1e-6)
+
+
+@pytest.mark.parametrize("split", [1, 4])
+@pytest.mark.parametrize("with_bias", [False, True])
+@pytest.mark.parametrize("T", [2, 4, 8])
+def test_flash_verify_interpret_parity(split, with_bias, T):
+    """The split-K verify kernel against the XLA reference, per-row
+    written counts (each row at its own offset, splits straddling and
+    past the valid region)."""
+    jnp = _jnp()
+    rs = np.random.RandomState(1)
+    b, h, L, d = 3, 2, 512, 32
+    q = jnp.asarray(rs.randn(b, h, T, d).astype("f4"))
+    k = jnp.asarray(rs.randn(b, h, L, d).astype("f4"))
+    v = jnp.asarray(rs.randn(b, h, L, d).astype("f4"))
+    length = jnp.asarray([T, 130, 512], jnp.int32)
+    bias = jnp.asarray((rs.randn(b, L) * 0.5).astype("f4")) \
+        if with_bias else None
+    out = flash_verify(q, k, v, length, bias=bias, split_k=split,
+                       interpret=True)
+    ref = verify_attention_reference(q, k, v, length, bias=bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_verify_scope_routes_multi_token_static_kv():
+    """Inside kv_verify_scope a multi-token StaticKVCache call writes
+    per-row and attends at per-row offsets; outside it stays the
+    prefill contract."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+
+    rs = np.random.RandomState(2)
+    B, D, H, L, T = 2, 16, 2, 12, 3
+    mha = MultiHeadAttention(D, H)
+    mha.eval()
+    x0 = jnp.asarray(rs.randn(B, 4, D).astype("f4"))
+    cache = mha.gen_cache(x0, max_length=L)
+    _, cache = mha(Tensor._wrap(x0), None, None, None, cache)
+    xT = jnp.asarray(rs.randn(B, T, D).astype("f4"))
+    with kv_verify_scope():
+        out_blk, cache_blk = mha(Tensor._wrap(xT), None, None, None,
+                                 cache)
+    assert np.asarray(cache_blk.index).tolist() == [4 + T] * B
+    # stepwise oracle (final-ulp float agreement; see the note on
+    # test_verify_reference_matches_stepwise_decode)
+    outs, c = [], cache
+    for i in range(T):
+        o, c = mha(Tensor._wrap(xT[:, i:i + 1]), None, None, None, c)
+        outs.append(np.asarray(o._data))
+    np.testing.assert_allclose(np.asarray(out_blk._data),
+                               np.concatenate(outs, axis=1),
+                               rtol=1e-5, atol=1e-6)
+
+
+# ----------------------------------------------------------------------
+# acceptance math + n-gram proposals + rollback
+# ----------------------------------------------------------------------
+
+def test_greedy_accept_cells():
+    jnp = _jnp()
+    drafts = jnp.asarray([[5, 6, 7],    # all match
+                          [5, 9, 7],    # 1 match, then miss
+                          [9, 6, 7]],   # immediate miss
+                         jnp.int32)
+    preds = jnp.asarray([[5, 6, 7, 8],
+                         [5, 6, 7, 8],
+                         [5, 6, 7, 8]], jnp.int32)
+    n_match, emit = greedy_accept(drafts, preds)
+    assert np.asarray(n_match).tolist() == [3, 1, 0]
+    emit = np.asarray(emit)
+    # row 0: 3 drafts + correction preds[3]
+    assert emit[0].tolist() == [5, 6, 7, 8]
+    # row 1: draft 5 accepted, correction preds[1] = 6 at position 1
+    assert emit[1][:2].tolist() == [5, 6]
+    # row 2: correction preds[0] = 5 at position 0
+    assert emit[2][0] == 5
+
+
+def test_rollback_index_arithmetic():
+    jnp = _jnp()
+    idx = jnp.asarray([10, 10, 10], jnp.int32)   # post-verify (k=4)
+    out = rollback_index(idx, 4, jnp.asarray([3, 1, 0], jnp.int32),
+                         jnp.asarray([True, True, False]))
+    assert np.asarray(out).tolist() == [10, 8, 6]
+
+
+def test_ngram_propose_repetitive_and_fallback():
+    jnp = _jnp()
+    # row 0: history ... 3 4 5 3 4 | pending 5 -> bigram (4, 5) matched
+    # at position 2 -> propose continuation 3, 4
+    # row 1: nothing matches -> repeat pending
+    hist = jnp.asarray([[3, 4, 5, 3, 4, 0, 0, 0],
+                        [1, 2, 3, 4, 5, 0, 0, 0]], jnp.int32)
+    pending = jnp.asarray([5, 9], jnp.int32)
+    lens = jnp.asarray([5, 5], jnp.int32)
+    drafts = ngram_propose(hist, pending, lens, 5, 2, 0, ngram=2)
+    got = np.asarray(drafts)
+    assert got[0].tolist() == [3, 4]
+    assert got[1].tolist() == [9, 9]
+
+
+def test_partial_acceptance_cache_prefix_bitmatch():
+    """After a verify write + rollback, the cache's visible region must
+    be bit-identical to sequential single-token decode writes of the
+    ACCEPTED tokens — the rollback makes rejected lanes invisible and
+    the next round's write covers them before any query can see them."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+
+    rs = np.random.RandomState(4)
+    B, D, H, L, T = 2, 16, 2, 12, 4
+    mha = MultiHeadAttention(D, H)
+    mha.eval()
+    x0 = jnp.asarray(rs.randn(B, 4, D).astype("f4"))
+    cache0 = mha.gen_cache(x0, max_length=L)
+    _, cache0 = mha(Tensor._wrap(x0), None, None, None, cache0)
+    xT = jnp.asarray(rs.randn(B, T, D).astype("f4"))
+    with kv_verify_scope():
+        _, cache_v = mha(Tensor._wrap(xT), None, None, None, cache0)
+    n_match = jnp.asarray([2, 0], jnp.int32)      # per-row acceptance
+    new_idx = rollback_index(cache_v.index, T, n_match,
+                             jnp.asarray([True, True]))
+    assert np.asarray(new_idx).tolist() == [7, 5]
+    # oracle: step the accepted prefix token by token
+    c = cache0
+    for i in range(3):        # row 0 keeps 3 fed tokens, row 1 keeps 1
+        _, c = mha(Tensor._wrap(xT[:, i:i + 1]), None, None, None, c)
+    kv, ko = np.asarray(cache_v.k), np.asarray(c.k)
+    for b, keep in enumerate(np.asarray(new_idx)):
+        np.testing.assert_array_equal(kv[b, :, :keep], ko[b, :, :keep])
+
+
+# ----------------------------------------------------------------------
+# DecodeEngine: spec output == eager oracle == non-spec fused
+# ----------------------------------------------------------------------
+
+def _small_stack(seed=7, D=32, H=2, V=17, layers=2):
+    np.random.seed(seed)
+    layer = TransformerDecoderLayer(D, H, 64, dropout=0.0)
+    dec = TransformerDecoder(layer, layers)
+    dec.eval()
+    embed = nn.Embedding(V, D)
+    proj = nn.Linear(D, V)
+    return dec, embed, proj, D, V
+
+
+def _ragged_inputs(D, V, B=3, Pmax=5, mem_len=4, seed=8):
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(seed)
+    memory = jnp.asarray(rs.randn(B, mem_len, D).astype("f4"))
+    prompt = rs.randint(2, V, (B, Pmax)).astype("i4")
+    prompt[:, 0] = 0
+    plens = jnp.asarray([Pmax, Pmax - 2, Pmax - 1], jnp.int32)
+    return memory, jnp.asarray(prompt), plens
+
+
+def test_spec_greedy_bitmatches_eager_across_k():
+    dec, embed, proj, D, V = _small_stack()
+    memory, prompt, plens = _ragged_inputs(D, V)
+    eng = DecodeEngine(dec, embed, proj)
+    base_t, base_l = eng.generate(memory, prompt, plens, bos_id=0,
+                                  eos_id=1, max_new_tokens=8)
+    et, el = generate_eager(dec, embed, proj, memory, prompt, plens,
+                            bos_id=0, eos_id=1, max_new_tokens=8,
+                            pad_prompt_to=bucket_size(prompt.shape[1]))
+    np.testing.assert_array_equal(base_t, et)
+    for k in (2, 4, 8):
+        ts, ls, stats = eng.generate(
+            memory, prompt, plens, bos_id=0, eos_id=1,
+            max_new_tokens=8, spec_k=k, return_spec_stats=True)
+        np.testing.assert_array_equal(ts, et)
+        np.testing.assert_array_equal(ls, el)
+        assert 0 <= stats["accepted"] <= stats["proposed"]
+        assert stats["rounds"] >= 1
+
+
+def test_spec_draft_model_bitmatches_eager():
+    """ANY draft source preserves the output — a differently-seeded
+    small draft model included (its proposals mostly miss; acceptance
+    only changes round count)."""
+    dec, embed, proj, D, V = _small_stack(seed=9)
+    memory, prompt, plens = _ragged_inputs(D, V, seed=10)
+    eng = DecodeEngine(dec, embed, proj)
+    base_t, base_l = eng.generate(memory, prompt, plens, bos_id=0,
+                                  eos_id=1, max_new_tokens=6)
+    np.random.seed(33)
+    dlayer = TransformerDecoderLayer(D, 2, 32, dropout=0.0)
+    ddec = TransformerDecoder(dlayer, 1)
+    ddec.eval()
+    dm = DraftModel(ddec, nn.Embedding(V, D), nn.Linear(D, V))
+    ts, ls = eng.generate(memory, prompt, plens, bos_id=0, eos_id=1,
+                          max_new_tokens=6, spec_k=4, draft_model=dm)
+    np.testing.assert_array_equal(ts, base_t)
+    np.testing.assert_array_equal(ls, base_l)
+
+
+def test_spec_one_trace_per_bucket_and_k():
+    """The compile contract: one trace per (shape bucket, spec_k) —
+    in-bucket batch/prompt variation and repeated calls reuse the
+    compiled program; a new k is a new program."""
+    import jax.numpy as jnp
+
+    dec, embed, proj, D, V = _small_stack(seed=11)
+    eng = DecodeEngine(dec, embed, proj)
+    rs = np.random.RandomState(12)
+
+    def run(B, P, k):
+        mem = jnp.asarray(rs.randn(B, 4, D).astype("f4"))
+        pr = rs.randint(2, V, (B, P)).astype("i4")
+        pr[:, 0] = 0
+        return eng.generate(mem, jnp.asarray(pr), bos_id=0, eos_id=1,
+                            max_new_tokens=4, spec_k=k)
+
+    run(3, 5, 4)
+    run(3, 5, 4)   # exact repeat
+    run(4, 5, 4)   # batch 3 and 4 share the 4-bucket
+    run(3, 7, 4)   # prompts 5 and 7 share the 8-bucket
+    assert sum(eng.trace_counts.values()) == 1, dict(eng.trace_counts)
+    run(3, 5, 8)   # new k: one more compile
+    assert sum(eng.trace_counts.values()) == 2, dict(eng.trace_counts)
+
+
+def test_spec_validation():
+    dec, embed, proj, D, V = _small_stack(seed=13)
+    memory, prompt, plens = _ragged_inputs(D, V, seed=14)
+    eng = DecodeEngine(dec, embed, proj)
+    with pytest.raises(ValueError, match="spec_k"):
+        eng.generate(memory, prompt, plens, spec_k=1)
+    with pytest.raises(ValueError, match="greedy"):
+        eng.generate(memory, prompt, plens, spec_k=4, beam_size=2)
+
+
+# ----------------------------------------------------------------------
+# serving: spec soak, opt-out, chaos
+# ----------------------------------------------------------------------
+
+def _mk_request(rs, D, V, pmax=6, nmax=10, **kw):
+    P = int(rs.randint(1, pmax + 1))
+    prompt = rs.randint(2, V, (P,)).astype(np.int32)
+    prompt[0] = 0
+    mem_seed = int(prompt.sum()) * 131 + P
+    mem = np.random.RandomState(mem_seed).randn(4, D).astype("f4")
+    n = int(rs.randint(2, nmax + 1))
+    return Request(prompt, mem, max_new_tokens=n, eos_id=1, **kw)
+
+
+def _eager_reference(stack, r, max_new):
+    import jax.numpy as jnp
+
+    dec, embed, proj, D, V = stack
+    toks, lens = generate_eager(
+        dec, embed, proj, jnp.asarray(r.memory[None]),
+        jnp.asarray(r.prompt[None]),
+        jnp.asarray([r.prompt.shape[0]], jnp.int32), bos_id=0,
+        eos_id=1, max_new_tokens=max_new,
+        pad_prompt_to=bucket_size(r.prompt.shape[0]))
+    return np.asarray(toks)[0], int(np.asarray(lens)[0])
+
+
+def test_serving_spec_soak_bitmatch_and_counters():
+    """Ragged requests (spec opt-out mixed in) through a spec-enabled
+    pool: every survivor bit-matches its solo eager run, draft/verify
+    compiled once each (retrace sentinel armed over the whole soak),
+    and the acceptance counters are consistent."""
+    stack = _small_stack(seed=21)
+    dec, embed, proj, D, V = stack
+    eng = ServingEngine(dec, embed, proj, num_slots=4, max_len=32,
+                        spec_k=4)
+    retrace_sentinel(eng).__enter__()   # disarmed by conftest teardown
+    sched = Scheduler(max_queue=64)
+    rs = np.random.RandomState(22)
+    reqs = []
+    for i in range(20):
+        reqs.append(_mk_request(rs, D, V, spec=(i % 4 != 0)))
+    for r in reqs[:8]:
+        sched.submit(r)
+    it = 0
+    submitted = 8
+    while submitted < len(reqs) or sched.depth() > 0 or \
+            eng.occupancy() > 0:
+        eng.run_iteration(sched)
+        it += 1
+        if submitted < len(reqs) and it % 2 == 0:
+            sched.submit(reqs[submitted])
+            submitted += 1
+        assert it < 1000
+    eager_cache = {}
+    for r in reqs:
+        res = r.result(timeout=5)
+        assert res.ok, res
+        key = tuple(r.prompt.tolist())
+        if key not in eager_cache:
+            eager_cache[key] = _eager_reference(stack, r, max_new=10)
+        et, el = eager_cache[key]
+        np.testing.assert_array_equal(res.tokens,
+                                      et[:len(res.tokens)])
+        if res.finish_reason == "eos":
+            assert res.tokens[-1] == 1
+    snap = eng.metrics.snapshot()
+    spec = snap["speculation"]
+    assert spec["rounds"] >= 1
+    assert 0 <= spec["drafts_accepted"] <= spec["drafts_proposed"]
+    assert spec["wasted_draft_tokens"] == \
+        spec["drafts_proposed"] - spec["drafts_accepted"]
+    assert spec["acceptance_rate"] == pytest.approx(
+        spec["drafts_accepted"] / max(1, spec["drafts_proposed"]),
+        abs=1e-3)
+    # wasted drafts entered the goodput denominator
+    g = snap["goodput"]
+    denom = (g["useful_tokens"] + g["wasted_tokens"] +
+             g["warmup_tokens"] + g["retry_tokens"] +
+             spec["wasted_draft_tokens"])
+    assert g["ratio"] == pytest.approx(g["useful_tokens"] / denom,
+                                       abs=1e-3)
+    # compile-count contract: ONE draft + ONE verify program
+    assert len([k for k in eng.trace_counts if k[0] == "draft"]) == 1
+    assert len([k for k in eng.trace_counts if k[0] == "sstep"]) == 1
+
+
+def test_serving_spec_chaos_verify_fault_pool_revives():
+    """A persistent verify-step fault evicts the in-flight requests
+    with partials + cause (batched step semantics) and the pool keeps
+    serving spec traffic that bit-matches eager — without retracing."""
+    stack = _small_stack(seed=65)
+    dec, embed, proj, D, V = stack
+    eng = ServingEngine(dec, embed, proj, num_slots=2, max_len=32,
+                        spec_k=4, max_attempts=2, backoff_base_s=0.0)
+    retrace_sentinel(eng).__enter__()   # disarmed by conftest teardown
+    sched = Scheduler(max_queue=8)
+    rs = np.random.RandomState(66)
+    a = Request(np.asarray([0, 3, 4], np.int32),
+                rs.randn(4, D).astype("f4"), max_new_tokens=20,
+                eos_id=None)
+    sched.submit(a)
+    for _ in range(2):
+        eng.run_iteration(sched)
+    assert len(a.tokens) >= 1
+    with faults.inject("serving.decode_step", on="always",
+                       max_fires=2):    # both attempts of one step
+        eng.run_iteration(sched)
+    res = a.result(timeout=5)
+    assert res.finish_reason == "error" and not res.ok
+    assert isinstance(res.error, faults.InjectedFault)
+    assert len(res.tokens) >= 1          # partials delivered
+    # pool revives; fresh spec requests complete and bit-match
+    fresh = [_mk_request(rs, D, V) for _ in range(3)]
+    for r in fresh:
+        sched.submit(r)
+    eng.serve_until_idle(sched, max_iterations=200)
+    for r in fresh:
+        res = r.result(timeout=5)
+        assert res.ok
+        np.testing.assert_array_equal(
+            res.tokens,
+            _eager_reference(stack, r, 10)[0][:len(res.tokens)])
+    assert len([k for k in eng.trace_counts if k[0] == "sstep"]) == 1
+
+
+def test_serving_spec_guard_rails():
+    dec, embed, proj, D, V = _small_stack(seed=70)
+    with pytest.raises(ValueError, match="spec_k"):
+        ServingEngine(dec, embed, proj, num_slots=2, max_len=32,
+                      spec_k=1)
+    with pytest.raises(NotImplementedError, match="paged"):
+        ServingEngine(dec, embed, proj, num_slots=2, max_len=32,
+                      paged=True, spec_k=4)

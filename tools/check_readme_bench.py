@@ -31,6 +31,7 @@ CHECKS = [
     (r"ERNIE long-context", r"~?([\d.]+)()\s*seq/s", ("ernie_long", "value"), "ernie_long seq/s"),
     (r"Long-context flash attention", r"~?([\d.]+)()x XLA", ("long_context", "value"), "flash x-vs-XLA"),
     (r"Paged KV pool", r"~?([\d.]+)()x peak concurrent", ("serving_paged", "value"), "serving_paged x-concurrency"),
+    (r"Speculative decoding", r"~?([\d.]+)()x tokens/s", ("decode_throughput", "speculative", "b1", "speedup"), "speculative x-tokens/s"),
     (r"Sharded serving", r"~?([\d.]+)()x lower decode-step p50", ("serving_sharded", "value"), "serving_sharded x-step-p50"),
 ]
 

@@ -30,10 +30,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def _demo_snapshot():
-    """Serve a few requests through a tiny pool under a tracer session
-    AND an armed cost-accounting session, so the dump previews every
-    snapshot section — memory ledger, MFU/goodput gauges included —
-    and return (snapshot, tracer)."""
+    """Serve a few requests through a tiny pool (speculation enabled)
+    under a tracer session AND an armed cost-accounting session, so
+    the dump previews every snapshot section — memory ledger,
+    MFU/goodput gauges, speculation counters included — and return
+    (snapshot, tracer)."""
     import numpy as np
 
     from paddle_tpu import nn
@@ -48,7 +49,7 @@ def _demo_snapshot():
     dec = TransformerDecoder(layer, 2)
     dec.eval()
     eng = ServingEngine(dec, nn.Embedding(17, 32), nn.Linear(32, 17),
-                        num_slots=4, max_len=32,
+                        num_slots=4, max_len=32, spec_k=4,
                         hbm_budget_bytes=1 << 20)
     sched = Scheduler(max_queue=16)
     rs = np.random.RandomState(1)

@@ -218,6 +218,7 @@ def _configs():
     cfgs += _configs_optimizer()
     cfgs += _configs_flash_decode()
     cfgs += _configs_serving()
+    cfgs += _configs_spec_decode()
     cfgs += _configs_paged_decode()
     cfgs += _configs_sharded_decode()
     return cfgs
@@ -1071,6 +1072,47 @@ def _configs_serving():
         ("serving_step_join_s8_L2048", step_join(8, 8, 2048, 64, 128)),
         ("serving_step_join_s32_L512", step_join(32, 8, 512, 64, 64)),
     ]
+
+
+def _configs_spec_decode():
+    """Speculative-decoding kernel rows: the k-token VERIFY attention
+    (ops/attention.verify_attention — the pending token + k-1 drafts
+    against the cache at per-row offsets, causal within the block) vs
+    the PLAIN single-token decode step over the same cache, k in
+    {2, 4, 8} at batch 1 and 8. The verify-to-plain step ratio is the
+    cost of widening one decode dispatch to k tokens — speculative
+    decoding wins when (accepted run length) / (that ratio) > 1. On
+    the committed-baseline CPU backend both route to the XLA reference
+    (the rows exist so the TPU driver's refresh shows the pallas
+    split-K verify delta)."""
+
+    def step(batch, heads, L, d, T, steps=30):
+        def bench():
+            import jax
+            import jax.numpy as jnp
+
+            from paddle_tpu.ops.attention import (decode_attention,
+                                                  verify_attention)
+
+            rs = np.random.RandomState(0)
+            q = jnp.asarray(rs.randn(batch, heads, T, d).astype("f4"))
+            k = jnp.asarray(rs.randn(batch, heads, L, d).astype("f4"))
+            v = jnp.asarray(rs.randn(batch, heads, L, d).astype("f4"))
+            length = jnp.asarray(rs.randint(L // 4, L, (batch,)),
+                                 jnp.int32)
+            fn = jax.jit(decode_attention if T == 1
+                         else verify_attention)
+            return _time_direct(lambda: fn(q, k, v, length), steps)
+
+        bench._direct = True
+        return bench
+
+    rows = [(f"spec_decode_plain_b{b}_L2048", step(b, 8, 2048, 64, 1))
+            for b in (1, 8)]
+    rows += [(f"spec_decode_verify_k{T}_b{b}_L2048",
+              step(b, 8, 2048, 64, T))
+             for b in (1, 8) for T in (2, 4, 8)]
+    return rows
 
 
 def _configs_sharded_decode():

@@ -60,6 +60,12 @@ QUICK_OPS = ("sequence_mask", "tile")
 #: wall-clock-shaped and re-anchored per PR instead)
 DEFAULT_BENCH = ("fused_optimizer",)
 
+#: speculative-decoding rows folded into the full-run default (PR 10):
+#: one verify row and its plain-step pair, so a regression in the
+#: k-token verify path (the spec hot kernel) fails the gate
+SPEC_OPS = ("spec_decode_plain_b1_L2048",
+            "spec_decode_verify_k4_b1_L2048")
+
 
 # ----------------------------------------------------------------------
 # pure comparison core (unit-tested directly; no measurement involved)
@@ -257,8 +263,8 @@ def main(argv=None):
             # keeps the tight default
             args.tol_op = 4.0
     else:
-        op_names = [c[0] for c in _quick8()] if args.ops is None \
-            else []
+        op_names = ([c[0] for c in _quick8()] + list(SPEC_OPS)) \
+            if args.ops is None else []
         bench_names = list(DEFAULT_BENCH) if args.bench is None else []
     if args.ops is not None:
         op_names = [s for s in args.ops.split(",") if s]

@@ -66,6 +66,16 @@ def main(argv=None):
                   f"count={e['args'].get('count')}")
     if steps:
         print(f"decode steps: {len(steps)}")
+    drafts = [e for e in events if e.get("name") == "decode.draft"]
+    verifies = [e for e in events if e.get("name") == "decode.verify"]
+    if verifies:
+        acc = sum(e["args"].get("accepted", 0) for e in verifies)
+        prop = sum(e["args"].get("proposed", 0) for e in verifies)
+        d_ms = sum(e.get("dur", 0) for e in drafts) / 1e3
+        v_ms = sum(e.get("dur", 0) for e in verifies) / 1e3
+        print(f"speculation: {len(verifies)} draft/verify pairs, "
+              f"acceptance {acc}/{prop} ({acc / max(1, prop):.0%}), "
+              f"draft {d_ms:.1f}ms + verify {v_ms:.1f}ms wall")
     if retraces:
         print(f"RETRACE VIOLATIONS: {len(retraces)}")
         for e in retraces:
