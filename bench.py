@@ -997,6 +997,95 @@ def _fused_optimizer(n_layers=14, hidden=128, steps=30):
             "spread": _spread([1.0 / s for s in fused_slopes])}
 
 
+def _cold_start(d_model=32, nhead=2, layers=2, vocab=17, num_slots=4,
+                max_len=32, buckets=(2, 4, 8)):
+    """Cold-vs-warm engine start A/B: time-to-ready of a ServingEngine
+    precompile with an EMPTY persistent AOT cache (every serving
+    program traces + compiles) against a restarted engine precompiling
+    from the POPULATED cache (every program deserializes — zero
+    compiles). The warm side's first request is served under an armed
+    retrace sentinel + tracer session: the bench ASSERTS zero compile
+    spans before the first token (the PR 11 warm-start guarantee) and
+    that warm ready time is strictly faster than cold. Host-side
+    compile/deserialize work — backend-independent shape of the win."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.nn.layer.transformer import (TransformerDecoder,
+                                                 TransformerDecoderLayer)
+    from paddle_tpu.profiler import trace as T
+    from paddle_tpu.serving import Request, Scheduler, ServingEngine
+
+    def mk_engine():
+        paddle.seed(0)
+        layer = TransformerDecoderLayer(d_model, nhead, 2 * d_model,
+                                        dropout=0.0)
+        dec = TransformerDecoder(layer, layers)
+        dec.eval()
+        return ServingEngine(dec, nn.Embedding(vocab, d_model),
+                             nn.Linear(d_model, vocab),
+                             num_slots=num_slots, max_len=max_len)
+
+    def serve_one(eng):
+        sched = Scheduler(max_queue=8)
+        rs = np.random.RandomState(1)
+        prompt = rs.randint(2, vocab, (3,)).astype(np.int32)
+        prompt[0] = 0
+        r = Request(prompt, rs.randn(4, d_model).astype("f4"),
+                    max_new_tokens=6, eos_id=1)
+        sched.submit(r)
+        eng.serve_until_idle(sched, max_iterations=200)
+        assert r.result(timeout=10).ok
+        return list(r.tokens)
+
+    cache_dir = tempfile.mkdtemp(prefix="pt_aot_bench_")
+    try:
+        # ---- cold start: empty cache, every program compiles ----
+        eng_cold = mk_engine()
+        rep_cold = eng_cold.precompile(
+            (4, d_model), dtype="float32", prompt_buckets=buckets,
+            cache=cache_dir)
+        toks_cold = serve_one(eng_cold)
+        ttft_cold = eng_cold.metrics.first_ttft_s
+        assert rep_cold["compiled"] == rep_cold["programs"], rep_cold
+
+        # ---- warm restart: same pool config, populated cache ----
+        eng_warm = mk_engine()
+        tr = T.start_session()
+        try:
+            with T.retrace_sentinel(eng_warm):
+                rep_warm = eng_warm.precompile(
+                    (4, d_model), dtype="float32",
+                    prompt_buckets=buckets, cache=cache_dir)
+                toks_warm = serve_one(eng_warm)
+        finally:
+            T.end_session()
+        ttft_warm = eng_warm.metrics.first_ttft_s
+        # the PR 11 guarantees, asserted in-bench
+        assert rep_warm["warm"] == 1 and rep_warm["compiled"] == 0, \
+            rep_warm
+        assert tr.counters.get("compiles", 0) == 0, dict(tr.counters)
+        assert sum(eng_warm.trace_counts.values()) == 0, \
+            dict(eng_warm.trace_counts)
+        assert toks_warm == toks_cold, (toks_warm, toks_cold)
+        cold_s = rep_cold["time_to_ready_s"]
+        warm_s = rep_warm["time_to_ready_s"]
+        assert warm_s < cold_s, (warm_s, cold_s)
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    return {"metric": "cold_start_time_to_ready",
+            "programs": rep_cold["programs"],
+            "cold_ready_s": round(cold_s, 3),
+            "warm_ready_s": round(warm_s, 3),
+            "cold_first_ttft_ms": round(ttft_cold * 1e3, 2),
+            "warm_first_ttft_ms": round(ttft_warm * 1e3, 2),
+            "warm_zero_compiles": True,
+            "value": round(cold_s / warm_s, 2),
+            "unit": "x_faster_ready_warm_vs_cold"}
+
+
 def _decode_throughput(points=((4, 64), (16, 64), (4, 128)),
                        d_model=128, nhead=4, ffn=256, n_layers=2,
                        vocab=512, mem_len=8, prompt_len=8):
@@ -1927,6 +2016,7 @@ def main():
                ("packed_varlen", _packed_varlen),
                ("fused_optimizer", _fused_optimizer),
                ("decode_throughput", _decode_throughput),
+               ("cold_start", _cold_start),
                ("serving_throughput", _serving_throughput),
                ("serving_paged", _serving_paged),
                ("serving_sharded", _serving_sharded),

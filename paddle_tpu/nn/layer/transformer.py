@@ -354,6 +354,16 @@ class MultiHeadAttention(Layer):
         return self.Cache(k, v)
 
 
+#: module-level aliases for the class-scoped cache namedtuples: their
+#: __qualname__ is the bare typename, so pickle resolves them as
+#: attributes of THIS module — the persistent AOT compile cache
+#: (paddle_tpu.tuning.aot_cache) pickles PyTreeDefs that reference
+#: them when serializing the engines' compiled programs
+Cache = MultiHeadAttention.Cache
+StaticCache = MultiHeadAttention.StaticCache
+StaticKVCache = MultiHeadAttention.StaticKVCache
+
+
 class TransformerEncoderLayer(Layer):
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
                  activation="relu", attn_dropout=None, act_dropout=None,

@@ -897,8 +897,18 @@ def flash_attention_bwd(q, k, v, bias, out, lse, g, is_causal, scale,
 
 @functools.lru_cache(maxsize=None)
 def _flash_diff_fn(is_causal, scale, has_bias, interpret, dropout_p,
-                   block_q, block_k, has_segs=False):
+                   block_q, block_k, has_segs=False, block_q_bwd=None,
+                   block_k_bwd=None):
     import jax
+
+    # the backward kernels may tile differently from the forward (the
+    # tuning table keys them separately: dQ/dKV have their own VMEM
+    # pressure) — EXCEPT under in-kernel dropout, where the counter
+    # addressing is (seed, bh, qi, ki) BLOCK indices: fwd and bwd must
+    # regenerate identical masks, so flash_attention pins bwd == fwd
+    # blocks whenever dropout_p > 0
+    bq_b = block_q if block_q_bwd is None else block_q_bwd
+    bk_b = block_k if block_k_bwd is None else block_k_bwd
 
     @jax.custom_vjp
     def f(q, k, v, bias, qseg, kseg, seed):
@@ -917,7 +927,7 @@ def _flash_diff_fn(is_causal, scale, has_bias, interpret, dropout_p,
         q, k, v, bias, qseg, kseg, seed, out, lse = res
         dq, dk, dv, dbias = flash_attention_bwd(q, k, v, bias, out, lse,
                                                 g, is_causal, scale,
-                                                block_q, block_k,
+                                                bq_b, bk_b,
                                                 interpret, dropout_p,
                                                 seed, qseg, kseg)
         return dq, dk, dv, dbias, None, None, None
@@ -926,16 +936,34 @@ def _flash_diff_fn(is_causal, scale, has_bias, interpret, dropout_p,
     return f
 
 
-def _pick_blocks(sq, sk, block_q=None, block_k=None):
-    """Block sizes measured on TPU v5e (tools/tune_flash.py sweep over
-    {128,256,512,1024}^2 at seq 1024/2048/4096): 512x512 wins every
-    config — 1.06x/2.96x/3.10x vs the XLA fused reference fwd+bwd.
-    EQUAL blocks also enable the diagonal-split causal path (interior
-    blocks skip the mask select entirely), worth ~10% alone. Lengths
-    not divisible by 512 take the largest 128-multiple that divides
-    them (1280 -> 256, 768 -> 384) so flash still engages; the
-    _flash_plan divisibility gate derives from THIS function — one
-    source of truth."""
+def _tuned(kernel, key):
+    """Consult the autotuned kernel-config table (paddle_tpu.tuning).
+    Returns the config dict or None; ANY tuning-layer failure reads as
+    a miss — a broken table must never take down attention."""
+    try:
+        from ..tuning import table as _tt
+
+        return _tt.lookup(kernel, key)
+    except Exception:
+        return None
+
+
+def _seq_bucket(n):
+    n = max(int(n), 1)
+    return 1 << (n - 1).bit_length()
+
+
+def _pick_blocks_heuristic(sq, sk, block_q=None, block_k=None):
+    """The hand-picked block ladder, measured on TPU v5e (tools/
+    tune_flash.py sweep over {128,256,512,1024}^2 at seq
+    1024/2048/4096): 512x512 wins every config — 1.06x/2.96x/3.10x vs
+    the XLA fused reference fwd+bwd. EQUAL blocks also enable the
+    diagonal-split causal path (interior blocks skip the mask select
+    entirely), worth ~10% alone. Lengths not divisible by 512 take the
+    largest 128-multiple that divides them (1280 -> 256, 768 -> 384)
+    so flash still engages. This is the committed-fallback source of
+    truth: the default tuning table's entries are GENERATED from it
+    (tuning.autotune.fallback_config), so untuned == pre-tuning."""
     def _one(s, override):
         if override is not None:
             return min(override, s)
@@ -944,6 +972,28 @@ def _pick_blocks(sq, sk, block_q=None, block_k=None):
                 return min(b, s)
         return min(128, s)
     return _one(sq, block_q), _one(sk, block_k)
+
+
+def _pick_blocks(sq, sk, block_q=None, block_k=None, head_dim=None,
+                 dtype=None, kernel="flash_fwd"):
+    """Block sizes for the flash fwd/bwd kernels: explicit overrides
+    win; otherwise the autotuned table (keyed (head_dim, sq bucket,
+    sk bucket, dtype), device-tiered) is consulted, and a miss — or a
+    tuned entry that does not tile THESE lengths — falls back to the
+    hand-picked heuristic. The _flash_plan divisibility gate derives
+    from this function — one source of truth either way."""
+    if block_q is None and block_k is None and head_dim is not None:
+        cfg = _tuned(kernel, (int(head_dim), _seq_bucket(sq),
+                              _seq_bucket(sk), str(dtype)))
+        if cfg is not None:
+            try:
+                bq = min(int(cfg["block_q"]), sq)
+                bk = min(int(cfg["block_k"]), sk)
+            except (KeyError, TypeError, ValueError):
+                bq = bk = 0
+            if bq > 0 and bk > 0 and sq % bq == 0 and sk % bk == 0:
+                return bq, bk
+    return _pick_blocks_heuristic(sq, sk, block_q, block_k)
 
 
 def flash_attention(q, k, v, bias=None, is_causal=False, scale=None,
@@ -961,7 +1011,17 @@ def flash_attention(q, k, v, bias=None, is_causal=False, scale=None,
     lengths that do not tile into blocks fall back to the XLA reference
     (the blockwise grid would silently truncate the tail otherwise)."""
     sq, sk = q.shape[2], k.shape[2]
-    block_q, block_k = _pick_blocks(sq, sk, block_q, block_k)
+    d, dt = q.shape[-1], str(q.dtype)
+    explicit = block_q is not None or block_k is not None
+    block_q, block_k = _pick_blocks(sq, sk, block_q, block_k,
+                                    head_dim=d, dtype=dt)
+    if explicit or dropout_p:
+        # explicit overrides apply to both passes; dropout pins bwd ==
+        # fwd (the counter-addressed bits are block-indexed)
+        bq_bwd, bk_bwd = block_q, block_k
+    else:
+        bq_bwd, bk_bwd = _pick_blocks(sq, sk, None, None, head_dim=d,
+                                      dtype=dt, kernel="flash_bwd")
     if (sq % block_q or sk % block_k
             or (is_causal and sq != sk)):
         # fallbacks: non-tileable lengths, and causal with sq != sk —
@@ -988,7 +1048,7 @@ def flash_attention(q, k, v, bias=None, is_causal=False, scale=None,
         raise ValueError("flash dropout needs dropout_seed (int32[1])")
     f = _flash_diff_fn(is_causal, scale, bias is not None, interpret,
                        float(dropout_p), block_q, block_k,
-                       segment_ids is not None)
+                       segment_ids is not None, bq_bwd, bk_bwd)
     return f(q, k, v, bias, segment_ids, kv_segment_ids, dropout_seed)
 
 
@@ -1116,18 +1176,19 @@ def _seed_from_key(key):
 
 
 def _flash_plan(seq_q, seq_k, head_dim, mask, batch, heads,
-                dropout_p=0.0, dropout_key=None):
+                dropout_p=0.0, dropout_key=None, dtype=None):
     """All the flash-dispatch gates in one place: TPU backend, long
     enough sequence, block-divisible lengths, head_dim small enough, a
     mask reducible to a key-position bias, and the kernel importable.
     Prob-dropout runs IN-KERNEL (counter-addressed bits) and needs the
     caller's dropout_key. Returns the key-position bias to pass to the
     kernel (None when maskless), or the _NO_FLASH sentinel when flash
-    cannot run."""
+    cannot run. `dtype` keeps the divisibility gate consulting the
+    SAME tuning-table entry flash_attention will pick blocks from."""
     min_flash_len = int(os.environ.get("PT_FLASH_MIN_SEQ", "512"))
     if dropout_p and dropout_key is None:
         return _NO_FLASH
-    bq, bk = _pick_blocks(seq_q, seq_k)
+    bq, bk = _pick_blocks(seq_q, seq_k, head_dim=head_dim, dtype=dtype)
     if not (_on_tpu() and head_dim <= 256
             and seq_q >= min_flash_len
             and seq_q % bq == 0 and seq_k % bk == 0):
@@ -1189,7 +1250,7 @@ def sdpa_bshd(q, k, v, mask=None, is_causal=False, scale=None,
         bias = (_NO_FLASH if too_short else
                 _flash_plan(q.shape[1], k.shape[1], q.shape[-1], mask,
                             q.shape[0], q.shape[2], dropout_p,
-                            dropout_key))
+                            dropout_key, dtype=str(q.dtype)))
         if bias is not _NO_FLASH:
             try:
                 seed = _seed_from_key(dropout_key) if dropout_p else None
@@ -1269,19 +1330,47 @@ def decode_attention_reference(q, k, v, length, bias=None, scale=None):
     return sdpa_reference(q, k, v, m[:, None, None, :], False, scale)
 
 
-def _pick_decode_splits(L, split_k=None):
-    """Split-K factor over the cache length: each split must stay a
-    lane-friendly 128-multiple; prefer ~512-token splits (the MXU-util
-    sweet spot for a (1, d) x (split, d) decode dot)."""
+def _pick_decode_splits_heuristic(L):
+    """Hand-picked split-K ladder: prefer ~512-token splits (the
+    MXU-util sweet spot for a (1, d) x (split, d) decode dot). The
+    committed-fallback source of truth for the flash_decode /
+    flash_verify tuning-table entries."""
+    for n in (8, 4, 2):
+        if L % n == 0 and (L // n) % 128 == 0 and L // n >= 512:
+            return n
+    return 1
+
+
+def _split_legal(L, n):
+    """Each split must stay a lane-friendly 128-multiple."""
+    return n >= 1 and L % n == 0 and (L // n) % 128 == 0
+
+
+def _pick_decode_splits(L, split_k=None, head_dim=None, dtype=None,
+                        kernel="flash_decode", T=None):
+    """Split-K factor over the cache length: an explicit `split_k`
+    wins (sanitized down to the nearest legal factor); otherwise the
+    autotuned table (keyed (head_dim, L bucket, dtype[, T]),
+    device-tiered) is consulted, and a miss — or an entry illegal for
+    THIS L — falls back to the hand-picked ~512-token ladder."""
     if split_k is not None:
         n = max(1, int(split_k))
         while L % n or (L // n) % 128:
             n -= 1
         return max(1, n)
-    for n in (8, 4, 2):
-        if L % n == 0 and (L // n) % 128 == 0 and L // n >= 512:
-            return n
-    return 1
+    if head_dim is not None:
+        key = (int(head_dim), _seq_bucket(L), str(dtype))
+        if kernel == "flash_verify":
+            key = key + (int(T if T is not None else 1),)
+        cfg = _tuned(kernel, key)
+        if cfg is not None:
+            try:
+                n = int(cfg["split_k"])
+            except (KeyError, TypeError, ValueError):
+                n = 0
+            if _split_legal(L, n):
+                return n
+    return _pick_decode_splits_heuristic(L)
 
 
 def _flash_decode_call(b, h, L, d, s, n_splits, has_bias, interpret):
@@ -1384,7 +1473,8 @@ def flash_decode(q, k, v, length, bias=None, scale=None, split_k=None,
                          f"sq={sq} — prefill runs on the regular flash "
                          f"path")
     L = k.shape[2]
-    n_splits = _pick_decode_splits(L, split_k)
+    n_splits = _pick_decode_splits(L, split_k, head_dim=d,
+                                   dtype=str(q.dtype))
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     qr = q.reshape(b * h, 1, d)
     kr = k.reshape(b * h, L, d)
@@ -1588,7 +1678,9 @@ def flash_verify(q, k, v, length, bias=None, scale=None, split_k=None,
 
     b, h, T, d = q.shape
     L = k.shape[2]
-    n_splits = _pick_decode_splits(L, split_k)
+    n_splits = _pick_decode_splits(L, split_k, head_dim=d,
+                                   dtype=str(q.dtype),
+                                   kernel="flash_verify", T=T)
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     qr = q.reshape(b * h, T, d)
     kr = k.reshape(b * h, L, d)
@@ -1807,6 +1899,14 @@ def paged_decode_attention(q, k_pages, v_pages, k_scale, v_scale, table,
     use_kernel = interpret or (
         _on_tpu() and q.shape[-1] <= 256 and psz % 8 == 0
         and _flash_usable())
+    if use_kernel and not interpret:
+        # dispatch-level tuning knob: the paged grid is (slot*head,
+        # page) — no block-shape freedom — but a device tier can force
+        # the XLA gather path where the scalar-prefetch kernel loses
+        cfg = _tuned("paged_flash_decode",
+                     (q.shape[-1], psz, str(k_pages.dtype)))
+        if cfg is not None and not cfg.get("kernel", True):
+            use_kernel = False
     if use_kernel:
         try:
             return _constrain_decode(
@@ -1837,7 +1937,7 @@ def sdpa(q, k, v, mask=None, is_causal=False, scale=None,
     if q.ndim == 4:
         bias = _flash_plan(q.shape[2], k.shape[2], q.shape[-1], mask,
                            q.shape[0], q.shape[1], dropout_p,
-                           dropout_key)
+                           dropout_key, dtype=str(q.dtype))
         if bias is not _NO_FLASH:
             try:
                 seed = _seed_from_key(dropout_key) if dropout_p else None

@@ -44,7 +44,7 @@ __all__ = [
     "DEVICE_SPECS", "detect_spec", "start_accounting",
     "end_accounting", "accounting_scope", "book", "mfu", "bw_util",
     "temp_high_water", "transformer_decode_flops",
-    "transformer_prefill_flops",
+    "transformer_prefill_flops", "capture_compiled",
 ]
 
 
@@ -247,6 +247,39 @@ def book():
     return _BOOK
 
 
+def _cost_from_compiled(owner_name, key, compiled, compile_s):
+    """Pull XLA's cost/memory analyses off an ALREADY-compiled
+    executable (no lowering, no trace). Shared by the warm-path
+    re-lower capture and the startup precompile capture — AOT-loaded
+    programs never compile through the observer, so precompile hands
+    them here directly. Returns None when the backend can't answer."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        ca = None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict) or "flops" not in ca:
+        return None
+    cost = ProgramCost(
+        owner_name, key,
+        flops=ca.get("flops", 0.0),
+        bytes_accessed=ca.get("bytes accessed", 0.0),
+        compile_s=compile_s, source="xla")
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        ma = None
+    if ma is not None:
+        cost.argument_bytes = int(
+            getattr(ma, "argument_size_in_bytes", 0))
+        cost.output_bytes = int(getattr(ma, "output_size_in_bytes", 0))
+        cost.temp_bytes = int(getattr(ma, "temp_size_in_bytes", 0))
+        cost.generated_code_bytes = int(
+            getattr(ma, "generated_code_size_in_bytes", 0))
+    return cost
+
+
 def _extract_xla(owner, key, fn, args, kw, compile_s):
     """AOT re-lower+compile the jitted `fn` at the observed call's
     arguments and pull XLA's cost/memory analyses. The deliberate
@@ -263,30 +296,31 @@ def _extract_xla(owner, key, fn, args, kw, compile_s):
         finally:
             if counter is not None:
                 counter[key] = before
-    try:
-        ca = compiled.cost_analysis()
-    except Exception:
-        ca = None
-    if isinstance(ca, (list, tuple)):
-        ca = ca[0] if ca else None
-    if not isinstance(ca, dict) or "flops" not in ca:
+    return _cost_from_compiled(type(owner).__name__, key, compiled,
+                               compile_s)
+
+
+def capture_compiled(owner, key, compiled, compile_s=0.0):
+    """Record one startup-precompiled program into the armed book
+    (no-op when accounting is disarmed). The engines' precompile()
+    path calls this for every readied program — including
+    cache-DESERIALIZED executables, which never pass through the
+    compile observer because they never compile — so a warm start
+    still arrives with a fully populated cost book. Falls back to the
+    owner's analytic hint exactly like the warm-path capture."""
+    bk = _BOOK
+    if bk is None:
         return None
-    cost = ProgramCost(
-        type(owner).__name__, key,
-        flops=ca.get("flops", 0.0),
-        bytes_accessed=ca.get("bytes accessed", 0.0),
-        compile_s=compile_s, source="xla")
-    try:
-        ma = compiled.memory_analysis()
-    except Exception:
-        ma = None
-    if ma is not None:
-        cost.argument_bytes = int(
-            getattr(ma, "argument_size_in_bytes", 0))
-        cost.output_bytes = int(getattr(ma, "output_size_in_bytes", 0))
-        cost.temp_bytes = int(getattr(ma, "temp_size_in_bytes", 0))
-        cost.generated_code_bytes = int(
-            getattr(ma, "generated_code_size_in_bytes", 0))
+    name = type(owner).__name__
+    if bk.get(name, key) is not None:
+        return bk.get(name, key)
+    cost = None
+    if bk.capture_xla:
+        cost = _cost_from_compiled(name, key, compiled, compile_s)
+    if cost is None:
+        cost = analytic_cost(owner, key, compile_s=compile_s)
+    if cost is not None:
+        bk.put(cost)
     return cost
 
 

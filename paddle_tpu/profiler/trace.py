@@ -45,7 +45,7 @@ __all__ = [
     "Span", "Tracer", "start_session", "end_session", "session",
     "session_scope", "ObservedCounter", "JitCache", "RetraceError",
     "RetraceSentinel", "retrace_sentinel", "add_compile_hook",
-    "remove_compile_hook", "suppress_observation",
+    "remove_compile_hook", "suppress_observation", "record_precompile",
 ]
 
 _LOG = logging.getLogger("paddle_tpu.trace")
@@ -404,6 +404,26 @@ class JitCache(dict):
         if e is None:
             return default
         return e.observed if _WATCH else e.raw
+
+
+def record_precompile(owner, key, t0, t1, source):
+    """Startup-precompile observability: one ``precompile`` span per
+    program the engine readied before serving (cat "compile", so it
+    lands on the same Perfetto track as warm-path compiles), with
+    `source` = "cache" (deserialized, no compile paid) or "compile"
+    (AOT lower+compile at startup). The warm-start proof pivots on
+    the session's counters: a warm start shows only
+    ``precompile_cache_hits``, and the ``compiles`` counter stays 0
+    through the first token."""
+    tr = _SESSION
+    if tr is None:
+        return
+    tr.add_complete("precompile", t0, t1, cat="compile",
+                    attrs={"engine": type(owner).__name__,
+                           "key": _key_str(key), "source": source})
+    tr.count("precompiles")
+    if source == "cache":
+        tr.count("precompile_cache_hits")
 
 
 def _observed_compiled(owner, key, fn):

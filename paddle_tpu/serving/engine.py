@@ -111,6 +111,34 @@ class WatchdogTimeout(TimeoutError):
     cleanly) so one slow/hung compile can't wedge the pool silently."""
 
 
+class _CachedProgram:
+    """A program deserialized from the persistent AOT cache, with a
+    rebuild escape hatch: a stale-but-CRC-valid entry whose argument
+    layout no longer matches the live pool raises TypeError at the
+    AOT arg check — rebuild the jitted program in place (one compile,
+    recorded as an `aot_cache` error) instead of crashing the serve.
+    The happy path is one try frame around the raw executable call."""
+
+    __slots__ = ("_engine", "_key", "_build", "compiled", "_fell_back")
+
+    def __init__(self, engine, key, build, compiled):
+        self._engine = engine
+        self._key = key
+        self._build = build
+        self.compiled = compiled
+        self._fell_back = False
+
+    def __call__(self, *args):
+        if not self._fell_back:
+            try:
+                return self.compiled(*args)
+            except TypeError as e:
+                self._fell_back = True
+                self._engine.metrics.record_error("aot_cache", e)
+                self.compiled = self._build()
+        return self.compiled(*args)
+
+
 class _EngineBase:
     """Slot lifecycle + per-iteration orchestration shared by the
     model-backed and artifact-backed engines. Subclasses implement
@@ -256,6 +284,89 @@ class _EngineBase:
             self._step_cost_cache = (bk, key, c)
         self.metrics.record_step_utilization(
             c.flops, c.bytes_accessed, dt_s, bk.spec, c.source)
+
+    # ---- zero-warmup startup: AOT precompile + persistent cache ----
+    def _startup_programs(self, prompt_buckets):
+        """[(key, build, example_args)] for every compiled program
+        this pool config serves with: the jit-cache key, a zero-arg
+        builder returning the jitted program, and arguments shaped
+        EXACTLY like the runtime calls (so an AOT lower().compile()
+        yields the executable the hot path will invoke). Default: none
+        (the Artifact engine's programs live in its Predictor)."""
+        return []
+
+    def _program_fingerprint(self):
+        """Identity folded into every persistent-cache key so two
+        engines with different models/pool configs can never collide
+        in one cache directory."""
+        return type(self).__name__
+
+    def _program_cache_key(self, key):
+        return f"{self._program_fingerprint()}|{key!r}"
+
+    def _precompile_run(self, progs, cache, persist):
+        """Ready every (key, build, args) program: deserialize from
+        the persistent cache when possible, AOT lower+compile
+        otherwise (and persist the result), and install the finished
+        executable in the jit cache — the serving hot path then never
+        traces. Returns the cold_start report."""
+        from ..tuning.aot_cache import AotCompileCache
+
+        t_start = time.perf_counter()
+        if cache is not None and not isinstance(cache, AotCompileCache):
+            cache = AotCompileCache(cache)
+        err0 = (cache.stats["corrupt"] + cache.stats["stale"]) \
+            if cache is not None else 0
+        n_loaded = n_compiled = n_ready = n_failed = 0
+        for key, build, args in progs:
+            if key in self._compiled:
+                n_ready += 1
+                continue
+            t0 = time.perf_counter()
+            fn = None
+            source = "cache"
+            if cache is not None:
+                loaded = cache.load(self._program_cache_key(key))
+                if loaded is not None:
+                    fn = _CachedProgram(self, key, build, loaded)
+                    n_loaded += 1
+            if fn is None:
+                source = "compile"
+                try:
+                    fn = build().lower(*args).compile()
+                except Exception as e:
+                    # a program that cannot AOT-compile here still
+                    # compiles lazily at first use — precompile must
+                    # never take the pool down
+                    self.metrics.record_error("precompile", e)
+                    n_failed += 1
+                    continue
+                n_compiled += 1
+                if cache is not None and persist:
+                    cache.store(self._program_cache_key(key), fn)
+            t1 = time.perf_counter()
+            self._compiled[key] = fn
+            n_ready += 1
+            if _trace._SESSION is not None:
+                _trace.record_precompile(self, key, t0, t1, source)
+            if _costs._BOOK is not None:
+                compiled = fn.compiled if isinstance(
+                    fn, _CachedProgram) else fn
+                _costs.capture_compiled(self, key, compiled,
+                                        compile_s=t1 - t0)
+        errs = ((cache.stats["corrupt"] + cache.stats["stale"])
+                if cache is not None else 0) - err0
+        report = {
+            "time_to_ready_s": round(time.perf_counter() - t_start, 4),
+            "programs": n_ready,
+            "loaded_from_cache": n_loaded,
+            "compiled": n_compiled,
+            "cache_errors": errs,
+            "build_failures": n_failed,
+            "warm": int(n_compiled == 0 and n_failed == 0),
+        }
+        self.metrics.record_cold_start(report)
+        return report
 
     # ---- watchdog + retry/backoff ----
     def _guarded(self, opname, fn, retry_tokens=0):
@@ -1022,6 +1133,75 @@ class ServingEngine(_EngineBase):
 
         return draft_fn
 
+    # ------------------------------------------------------------------
+    # zero-warmup startup: AOT precompile + persistent cache
+    # ------------------------------------------------------------------
+    def precompile(self, memory, *, dtype="float32",
+                   prompt_buckets=(8, 16, 32, 64), cache=None,
+                   persist=True):
+        """Ready EVERY serving program of this pool config before the
+        first request: one join program per prompt bucket plus the
+        batched decode step (or the spec draft/verify pair; the paged
+        pool adds attach/cow). Programs come out of the persistent
+        `cache` (an `AotCompileCache` or a directory path) when a
+        valid entry exists — zero compiles, the warm start — and are
+        AOT lower().compile()d otherwise, with the result persisted
+        for the NEXT start. `memory` is the cross-attention memory: an
+        example [M, D] array or its shape tuple (+ `dtype`); it pins
+        the pool config exactly like the first join would, so
+        admission semantics are unchanged. Returns the cold_start
+        report (also recorded in `ServingMetrics.snapshot()`)."""
+        if hasattr(memory, "ndim") or isinstance(memory, np.ndarray):
+            mem = np.asarray(memory)
+        else:
+            M, Dm = memory
+            mem = np.zeros((int(M), int(Dm)), np.dtype(dtype))
+        self._ensure_state(mem)
+        progs = self._startup_programs(prompt_buckets)
+        return self._precompile_run(progs, cache, persist)
+
+    def _program_fingerprint(self):
+        from ..tuning.aot_cache import model_fingerprint
+
+        return (f"{type(self).__name__}|"
+                f"{model_fingerprint(self._fm.params(), self._fm.buffers())}|"
+                f"{self._pool_key}")
+
+    def _startup_programs(self, prompt_buckets):
+        import jax.numpy as jnp
+
+        S = self.num_slots
+        params, buffers, state = self._params(), self._buffers(), \
+            self._state
+        M, Dm = self._mem_shape
+        mem1 = jnp.zeros((1, M, Dm), jnp.dtype(self._np_dtype))
+        one = jnp.asarray([1], jnp.int32)
+        active = jnp.zeros((S,), bool)
+        progs = []
+        for Pb in sorted({bucket_size(int(p)) for p in prompt_buckets}):
+            progs.append((
+                ("join", Pb), lambda Pb=Pb: self._build_join(Pb),
+                (params, buffers, state, jnp.int32(0),
+                 jnp.zeros((1, Pb), jnp.int32), one, mem1)))
+        if self.spec_k:
+            dkey = ("draft",) + self._pool_key
+            progs.append((
+                dkey, lambda dkey=dkey: self._build_draft(dkey),
+                (state["hist"], state["tok"], state["plen"],
+                 state["pbk"], state["inc"][0].index)))
+            vkey = ("sstep",) + self._pool_key
+            progs.append((
+                vkey, lambda vkey=vkey: self._build_spec_step(vkey),
+                (params, buffers, state,
+                 jnp.zeros((S, self.spec_k - 1), jnp.int32), active,
+                 active)))
+        else:
+            skey = ("step",) + self._pool_key
+            progs.append((
+                skey, lambda skey=skey: self._build_step(skey),
+                (params, buffers, state, active)))
+        return progs
+
     def _build_spec_step(self, vkey):
         import jax
 
@@ -1681,6 +1861,43 @@ class PagedServingEngine(ServingEngine):
         import jax
 
         return jax.jit(self._paged_step_body(ck))
+
+    # ---- zero-warmup startup (paged program set) ----
+    def _startup_programs(self, prompt_buckets):
+        import jax.numpy as jnp
+
+        S = self.num_slots
+        params, buffers, state = self._params(), self._buffers(), \
+            self._state
+        M, Dm = self._mem_shape
+        mem1 = jnp.zeros((1, M, Dm), jnp.dtype(self._np_dtype))
+        one = jnp.asarray([1], jnp.int32)
+        progs = []
+        for Pb in sorted({bucket_size(int(p)) for p in prompt_buckets}):
+            n_pp = pages_for(Pb, self.page_size)
+            progs.append((
+                ("pjoin", Pb),
+                lambda Pb=Pb: self._build_paged_join(Pb),
+                (params, buffers, state, jnp.int32(0),
+                 jnp.zeros((1, Pb), jnp.int32), one, mem1,
+                 jnp.zeros((n_pp,), jnp.int32))))
+        if self._prefix is not None:
+            if self._fm_cross is None:
+                self._fm_cross = _make_cross_kv_fm(self._net.decoder)
+            progs.append((
+                ("attach",), self._build_attach,
+                (self._cross_params(), self._fm_cross.buffers(), state,
+                 jnp.int32(0), jnp.int32(0), one, jnp.int32(1), mem1)))
+            progs.append((
+                ("cow",), self._build_cow,
+                (state, jnp.int32(0), jnp.int32(0))))
+        ck = ("pstep",) + self._pool_key
+        progs.append((
+            ck, lambda ck=ck: self._build_paged_step(ck),
+            (params, buffers, state,
+             jnp.zeros((S, self.max_pages), jnp.int32),
+             jnp.zeros((S,), jnp.int32), jnp.zeros((S,), bool))))
+        return progs
 
     def _paged_step_body(self, ck):
         import jax.numpy as jnp

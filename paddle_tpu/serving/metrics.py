@@ -144,6 +144,31 @@ SNAPSHOT_DOCS = {
         "counter",
         "rejected drafts — verify lanes burned; in the goodput "
         "denominator"),
+    # cold start (PR 11) — the section appears once the engine runs
+    # precompile(): startup AOT compile / persistent-cache accounting.
+    # Cold-start latency is a production metric: these are the numbers
+    # a restart dashboard alarms on.
+    "cold_start.time_to_ready_s": (
+        "gauge", "precompile() wall seconds until every serving "
+                 "program was ready"),
+    "cold_start.programs": (
+        "gauge", "serving programs readied at startup (join buckets + "
+                 "steps + paged attach/cow)"),
+    "cold_start.loaded_from_cache": (
+        "gauge", "programs deserialized from the persistent AOT "
+                 "cache — no compile paid"),
+    "cold_start.compiled": (
+        "gauge", "programs AOT-compiled fresh at startup (cache "
+                 "miss/cold)"),
+    "cold_start.cache_errors": (
+        "counter", "corrupt/stale cache entries that fell back to a "
+                   "fresh compile (never a crash)"),
+    "cold_start.warm": (
+        "gauge", "1 when every program loaded from cache — the "
+                 "zero-compile warm start"),
+    "cold_start.first_ttft_ms": (
+        "gauge", "TTFT of the very first request after start (the "
+                 "number warm vs cold starts A/B)"),
 }
 
 _SUMMARY_KEYS = {"n", "mean", "p50", "p99", "max"}
@@ -336,6 +361,11 @@ class ServingMetrics:
         self.accepted_per_step = _Reservoir(512)
         self.draft_step_s = _Reservoir(512)
         self.verify_step_s = _Reservoir(512)
+        # cold start (PR 11): the engine's precompile() report — how
+        # the pool reached readiness (cache-warm vs compiled) and the
+        # first request's TTFT (what a restart actually costs callers)
+        self._cold_start = None
+        self.first_ttft_s = None
         # MFU / bandwidth gauges: recorded per decode step only while
         # a profiler.costs accounting session is armed
         self._mfu = False
@@ -362,6 +392,9 @@ class ServingMetrics:
     def record_first_token(self, ttft_s):
         with self._lock:
             self.ttft_s.add(ttft_s)
+            if self.first_ttft_s is None:
+                # the first request ever: the cold-start A/B's number
+                self.first_ttft_s = float(ttft_s)
 
     def record_token(self):
         with self._lock:
@@ -503,6 +536,23 @@ class ServingMetrics:
                 self.bw_util.add(
                     bytes_accessed / dt_s / spec.peak_bytes_per_s)
 
+    # ---- cold-start accounting (PR 11) ----
+    def record_cold_start(self, report):
+        """The engine's precompile() report: {time_to_ready_s,
+        programs, loaded_from_cache, compiled, cache_errors, warm}.
+        A second call (another precompile pass on the same engine)
+        accumulates program counts and keeps the first ready time."""
+        with self._lock:
+            if self._cold_start is None:
+                self._cold_start = dict(report)
+            else:
+                c = self._cold_start
+                for k in ("programs", "loaded_from_cache", "compiled",
+                          "cache_errors"):
+                    c[k] = c.get(k, 0) + int(report.get(k, 0))
+                c["warm"] = int(bool(c.get("warm"))
+                                and bool(report.get("warm")))
+
     # ---- speculative-decoding accounting ----
     def record_spec_step(self, n_active, proposed, accepted, draft_s,
                          verify_s):
@@ -630,6 +680,20 @@ class ServingMetrics:
                     "ratio": round(self.useful_tokens / good_denom, 4)
                     if good_denom else 1.0,
                 },
+                **({} if self._cold_start is None else {"cold_start": {
+                    "time_to_ready_s":
+                        self._cold_start.get("time_to_ready_s", 0.0),
+                    "programs": self._cold_start.get("programs", 0),
+                    "loaded_from_cache":
+                        self._cold_start.get("loaded_from_cache", 0),
+                    "compiled": self._cold_start.get("compiled", 0),
+                    "cache_errors":
+                        self._cold_start.get("cache_errors", 0),
+                    "warm": int(bool(self._cold_start.get("warm"))),
+                    "first_ttft_ms":
+                        None if self.first_ttft_s is None else
+                        round(self.first_ttft_s * 1e3, 3),
+                }}),
                 **({} if not self._spec_recorded else {"speculation": {
                     "rounds": self.spec_rounds,
                     "drafts_proposed": self.drafts_proposed,

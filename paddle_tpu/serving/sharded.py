@@ -46,7 +46,7 @@ import time
 
 import numpy as np
 
-from ..core.bucketing import pad_prompt_row
+from ..core.bucketing import bucket_size, pad_prompt_row
 from ..testing import faults
 from . import tracing as _rt
 from .engine import (PagedServingEngine, ServingEngine, _PT_PREFILL,
@@ -128,6 +128,9 @@ class ShardedServingEngine(ServingEngine):
                 f"decode slice's dp axis ({self._pool_dp}) — the slot "
                 f"pool shards over it")
         self._pending_info = {}
+        #: seconds a dispatched prefill may stay not-ready before
+        #: _poll_pending stops polling and blocks for it (see there)
+        self.poll_block_s = 0.5
         super().__init__(decoder, embed, project, num_slots=num_slots,
                          max_len=max_len, **kw)
         self._build_shardings()
@@ -495,7 +498,15 @@ class ShardedServingEngine(ServingEngine):
             leaves = jax.tree_util.tree_leaves(info["outs"])
             if not all(getattr(x, "is_ready", lambda: True)()
                        for x in leaves):
-                continue
+                # bounded-wait escape valve: an AOT-precompiled
+                # prefill dispatches asynchronously, and on a
+                # starved host (1-core box, idle pool spinning this
+                # poll) its arrays may never flip ready on their
+                # own — past the deadline, block for them. The
+                # overlap win is gone by then anyway; liveness wins.
+                if time.monotonic() - info["t0"] < self.poll_block_s:
+                    continue
+                jax.block_until_ready(info["outs"])
             self.metrics.record_prefill_step(
                 time.monotonic() - info["t0"])
             Pb = info["Pb"]
@@ -543,6 +554,59 @@ class ShardedServingEngine(ServingEngine):
         self._pending.discard(s)
         self._pending_info.pop(s, None)
         super()._evict(s)
+
+    # ------------------------------------------------------------------
+    # zero-warmup startup: the sharded program set
+    # ------------------------------------------------------------------
+    def _program_fingerprint(self):
+        # mesh geometry + prefill policy change the compiled programs'
+        # layouts: fold them into the persistent-cache identity
+        return (f"{super()._program_fingerprint()}|"
+                f"dp{self._pool_dp}|{self._prefill_policy}|"
+                f"{self.layout}")
+
+    def _startup_programs(self, prompt_buckets):
+        progs = super()._startup_programs(prompt_buckets)
+        if self._prefill_dm is None:
+            return progs
+        import jax
+        import jax.numpy as jnp
+
+        decoder = self._net.decoder
+        M, Dm = self._mem_shape
+        dt = jnp.dtype(self._np_dtype)
+        mem1 = jnp.zeros((1, M, Dm), dt)
+        one = jnp.asarray([1], jnp.int32)
+        L = self._pool_len
+        state = self._state
+        repl = self._ns_repl
+        for Pb in sorted({bucket_size(int(p)) for p in prompt_buckets}):
+            progs.append((
+                ("prefill", Pb),
+                lambda Pb=Pb: self._build_prefill(Pb),
+                (self._pparams, self._pbuffers,
+                 jnp.zeros((1, Pb), jnp.int32), one, mem1)))
+            # the splice half sees the travelled prefill outputs
+            # REPLICATED on the decode slice (_poll_pending device_puts
+            # them to _ns_repl before the call) — mirror that placement
+            # so the AOT executable's input layouts match the hot path
+            kvs = [jax.device_put(
+                (jnp.zeros((1, ly.self_attn.num_heads, Pb,
+                            ly.self_attn.head_dim), dt),) * 2, repl)
+                for ly in decoder.layers]
+            statics = [jax.device_put(
+                (jnp.zeros((1, ly.cross_attn.num_heads, M,
+                            ly.cross_attn.head_dim), dt),) * 2, repl)
+                for ly in decoder.layers]
+            progs.append((
+                ("splice", Pb),
+                lambda Pb=Pb: self._build_splice(Pb),
+                (state, jnp.int32(0),
+                 jax.device_put(jnp.int32(0), repl),
+                 jax.device_put(jnp.zeros((1, L), jnp.float32), repl),
+                 kvs, statics, mem1, jnp.zeros((1, Pb), jnp.int32),
+                 one)))
+        return progs
 
     def _inflight_prefills(self):
         return len(self._pending)

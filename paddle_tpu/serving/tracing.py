@@ -73,6 +73,8 @@ SPAN_TAXONOMY = (
     ("decode.draft", "engine track: speculative draft proposal"),
     ("decode.verify", "engine track: k-token speculative verify"),
     ("compile", "engine track: one jit trace+compile"),
+    ("precompile", "engine track: one startup program readied "
+                   "(source: cache deserialize | AOT compile)"),
     ("retrace", "engine track: retrace-sentinel violation"),
 )
 

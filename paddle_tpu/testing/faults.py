@@ -1,7 +1,11 @@
 """Deterministic fault injection for the serving / IO / training paths.
 
 The production code is instrumented with *named fault points* — module
-level markers created once at import:
+level markers created once at import (serving.slot_join / prefill /
+decode_step / prefill_splice, scheduler.admit, checkpoint.write/read,
+dataloader.next, and tuning.cache_load — the persistent AOT compile
+cache's entry reads, so chaos runs can hand the startup path torn
+blobs):
 
     _PT_DECODE = faults.point("serving.decode_step")
     ...

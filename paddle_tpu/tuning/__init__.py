@@ -1,0 +1,33 @@
+"""Kernel autotuning + persistent AOT compilation cache.
+
+Two coupled halves (TVM / Tensor Processing Primitives both argue the
+same split — see PAPERS.md):
+
+  * **Autotuner** (`table.py` / `autotune.py`) — pallas block-shape
+    configs per (kernel, head_dim, seq bucket, dtype) are *searched*,
+    not hand-picked: a sweep times each candidate with the
+    `tools/op_bench.py` measurement harness, prunes candidates whose
+    analytic roofline lower bound (profiler.costs.DeviceSpec) already
+    exceeds the incumbent, and persists winners to a versioned on-disk
+    `TuningTable` keyed by `device_kind`. `ops/attention.py` consults
+    the table instead of its hard-coded block constants; the committed
+    fallback entries equal the hand-picked constants, so CPU/untuned
+    devices are bit-identical to the pre-tuning kernels.
+  * **Persistent AOT compile cache** (`aot_cache.py`) — at engine
+    startup `ServingEngine.precompile()` AOT-lowers-and-compiles every
+    serving/prompt-bucket program into `AotCompileCache`, a persisted
+    directory with a CRC-manifested index (the CheckpointManager
+    staged-rename pattern), so a restarted engine reaches full speed
+    with ZERO warmup jit stalls — the retrace sentinel sees no compile
+    spans before the first token on a warm start.
+"""
+from .table import (TuningTable, TableError, get_table, set_table,
+                    lookup, reset, current_device_kind,
+                    committed_table_path, seq_bucket)
+from .aot_cache import AotCompileCache, CacheCorrupt, env_fingerprint
+
+__all__ = [
+    "TuningTable", "TableError", "get_table", "set_table", "lookup",
+    "reset", "current_device_kind", "committed_table_path",
+    "seq_bucket", "AotCompileCache", "CacheCorrupt", "env_fingerprint",
+]

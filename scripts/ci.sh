@@ -33,6 +33,14 @@ else
   rc=1
 fi
 
+echo "== [2c] kernel autotune smoke sweep (dry-run, mechanics only) =="
+if python tools/autotune.py --cpu --smoke --dry-run > /tmp/autotune_smoke.json; then
+  echo "autotune: smoke sweep ok (see /tmp/autotune_smoke.json)"
+else
+  echo "autotune: smoke sweep FAILED"
+  rc=1
+fi
+
 echo "== [3/3] bench dry-run (ctr_ps, small, cpu) =="
 JAX_PLATFORMS=cpu python - <<'PY' || rc=1
 import _cpu_debug  # noqa: F401

@@ -479,6 +479,9 @@ def _full_metrics():
                  "in_use_bytes": 1200, "compile_temp_peak_bytes": 64},
         budget_bytes=2000)
     m.record_step_utilization(1e6, 2e6, 0.001, CPU_SPEC, "xla")
+    m.record_cold_start({"time_to_ready_s": 1.5, "programs": 4,
+                         "loaded_from_cache": 3, "compiled": 1,
+                         "cache_errors": 0, "warm": 0})
     return m
 
 

@@ -1,0 +1,534 @@
+"""Kernel autotuner + persistent AOT compile cache (PR 11).
+
+Covers the tuning-table contract (device keying, persistence,
+committed-fallback == heuristic bit-identity), the sweep driver's
+determinism + roofline prune, the AOT cache's corruption robustness
+(chaos cell on tuning.cache_load), and the warm-start guarantee:
+a restarted engine precompiling from a populated cache serves its
+first token with ZERO compiles (retrace sentinel + tracer proof),
+bit-matching the cold engine.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "tools"))
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.ops import attention as A  # noqa: E402
+from paddle_tpu.profiler import costs  # noqa: E402
+from paddle_tpu.profiler import trace as T  # noqa: E402
+from paddle_tpu.testing import faults  # noqa: E402
+from paddle_tpu.tuning import aot_cache as AC  # noqa: E402
+from paddle_tpu.tuning import autotune as AT  # noqa: E402
+from paddle_tpu.tuning import table as TBL  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_tuning():
+    yield
+    TBL.reset()
+    os.environ.pop("PT_TUNING", None)
+
+
+def _tiny_engine(num_slots=4, max_len=32, **kw):
+    from paddle_tpu import nn
+    from paddle_tpu.nn.layer.transformer import (
+        TransformerDecoder, TransformerDecoderLayer)
+    from paddle_tpu.serving import ServingEngine
+
+    paddle.seed(0)
+    layer = TransformerDecoderLayer(32, 2, 64, dropout=0.0)
+    dec = TransformerDecoder(layer, 2)
+    dec.eval()
+    return ServingEngine(dec, nn.Embedding(17, 32),
+                         nn.Linear(32, 17), num_slots=num_slots,
+                         max_len=max_len, **kw)
+
+
+def _serve_one(eng, max_new=5):
+    from paddle_tpu.serving import Request, Scheduler
+
+    sched = Scheduler(max_queue=8)
+    rs = np.random.RandomState(1)
+    prompt = rs.randint(2, 17, (3,)).astype(np.int32)
+    prompt[0] = 0
+    r = Request(prompt, rs.randn(4, 32).astype("f4"),
+                max_new_tokens=max_new, eos_id=1)
+    sched.submit(r)
+    eng.serve_until_idle(sched, max_iterations=300)
+    assert r.result(timeout=10).ok
+    return list(r.tokens)
+
+
+# ----------------------------------------------------------------------
+# tuning table
+# ----------------------------------------------------------------------
+
+def test_table_put_lookup_device_tiers_and_roundtrip(tmp_path):
+    t = TBL.TuningTable()
+    key = (64, 1024, 1024, "float32")
+    t.put("flash_fwd", key, {"block_q": 512, "block_k": 512},
+          device_kind="any")
+    t.put("flash_fwd", key, {"block_q": 256, "block_k": 128},
+          device_kind="TPU v5e")
+    # exact device tier wins; unknown devices fall to "any"; misses
+    # return None
+    assert t.lookup("flash_fwd", key, "TPU v5e")["block_q"] == 256
+    assert t.lookup("flash_fwd", key, "cpu")["block_q"] == 512
+    assert t.lookup("flash_fwd", (64, 2048, 2048, "float32"),
+                    "cpu") is None
+    assert t.lookup("flash_decode", key, "cpu") is None
+    # persistence round-trip (atomic save, versioned load)
+    p = tmp_path / "t.json"
+    t.save(str(p))
+    t2 = TBL.TuningTable.load(str(p))
+    assert t2.lookup("flash_fwd", key, "TPU v5e")["block_k"] == 128
+    assert len(t2) == len(t) == 2
+    # version mismatch / malformed files raise TableError (get_table
+    # converts that to a warning + heuristics, never a crash)
+    bad = json.loads(p.read_text())
+    bad["version"] = 999
+    p.write_text(json.dumps(bad))
+    with pytest.raises(TBL.TableError):
+        TBL.TuningTable.load(str(p))
+    p.write_text("{not json")
+    with pytest.raises(TBL.TableError):
+        TBL.TuningTable.load(str(p))
+    # configs naming none of the kernel's knobs are rejected
+    with pytest.raises(TBL.TableError):
+        t.put("flash_decode", (64, 512, "float32"), {"bogus": 1})
+
+
+def test_committed_table_equals_heuristics_exactly():
+    """The bit-identity guarantee's root: every committed fallback
+    entry equals the hand-picked heuristic for its key, so consulting
+    the table changes NOTHING on an untuned device."""
+    t = TBL.TuningTable.load(TBL.committed_table_path())
+    rows = t.entries(device_kind="any")
+    assert len(rows) >= 50
+    for _, kernel, key_s, cfg in rows:
+        parts = key_s.split("/")
+        key = tuple(int(p) if p.isdigit() else p for p in parts)
+        fb = AT.fallback_config(kernel, key)
+        assert all(cfg[k] == v for k, v in fb.items()), \
+            (kernel, key_s, cfg, fb)
+        assert cfg.get("source") == "fallback"
+
+
+def test_pick_blocks_and_splits_consult_table():
+    t = TBL.TuningTable()
+    t.put("flash_fwd", (64, 512, 512, "float32"),
+          {"block_q": 128, "block_k": 128}, device_kind="any")
+    t.put("flash_bwd", (64, 512, 512, "float32"),
+          {"block_q": 256, "block_k": 128}, device_kind="any")
+    t.put("flash_decode", (64, 2048, "float32"), {"split_k": 8},
+          device_kind="any")
+    # an entry that does not tile the length falls back to heuristic
+    t.put("flash_decode", (64, 512, "float32"), {"split_k": 7},
+          device_kind="any")
+    TBL.set_table(t)
+    assert A._pick_blocks(512, 512, head_dim=64,
+                          dtype="float32") == (128, 128)
+    assert A._pick_blocks(512, 512, head_dim=64, dtype="float32",
+                          kernel="flash_bwd") == (256, 128)
+    # explicit overrides always win over the table
+    assert A._pick_blocks(512, 512, 384, 384, head_dim=64,
+                          dtype="float32") == (384, 384)
+    assert A._pick_decode_splits(2048, head_dim=64,
+                                 dtype="float32") == 8
+    assert A._pick_decode_splits(512, head_dim=64, dtype="float32") \
+        == A._pick_decode_splits_heuristic(512)
+    # no entry for this dtype -> heuristic
+    assert A._pick_blocks(512, 512, head_dim=64, dtype="bfloat16") \
+        == A._pick_blocks_heuristic(512, 512)
+    # PT_TUNING=0 disables every lookup
+    os.environ["PT_TUNING"] = "0"
+    assert A._pick_blocks(512, 512, head_dim=64, dtype="float32") \
+        == A._pick_blocks_heuristic(512, 512)
+
+
+def test_tuned_off_vs_tuned_on_bit_identical_on_cpu():
+    """Flash fwd under the COMMITTED table vs PT_TUNING=0: identical
+    arrays, bit for bit (the fallback entries ARE the heuristics)."""
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(1, 2, 512, 64), jnp.float32)
+    k = jnp.asarray(rs.randn(1, 2, 512, 64), jnp.float32)
+    v = jnp.asarray(rs.randn(1, 2, 512, 64), jnp.float32)
+    TBL.reset()   # committed default table
+    out_on = A.flash_attention(q, k, v, None, True, None,
+                               interpret=True)
+    os.environ["PT_TUNING"] = "0"
+    out_off = A.flash_attention(q, k, v, None, True, None,
+                                interpret=True)
+    assert np.array_equal(np.asarray(out_on), np.asarray(out_off))
+    # and a genuinely different tuned entry still computes the same
+    # math (block shape changes scheduling, not semantics)
+    os.environ.pop("PT_TUNING")
+    t = TBL.TuningTable()
+    t.put("flash_fwd", (64, 512, 512, "float32"),
+          {"block_q": 128, "block_k": 128}, device_kind="any")
+    t.put("flash_bwd", (64, 512, 512, "float32"),
+          {"block_q": 128, "block_k": 128}, device_kind="any")
+    TBL.set_table(t)
+    out_128 = A.flash_attention(q, k, v, None, True, None,
+                                interpret=True)
+    np.testing.assert_allclose(np.asarray(out_128),
+                               np.asarray(out_off), rtol=2e-6,
+                               atol=2e-6)
+
+
+# ----------------------------------------------------------------------
+# sweep driver
+# ----------------------------------------------------------------------
+
+def test_two_candidate_mini_sweep_picks_faster_and_persists(tmp_path):
+    """Deterministic sweep over injected timings: the faster split
+    wins, the report records both sides, and apply_report installs it
+    under the device tier."""
+    times = {1: 10e-6, 2: 5e-6, 4: 20e-6}
+
+    def measurer(kernel, key, config):
+        return times[config["split_k"]]
+
+    key = (64, 512, "float32")
+    rep = AT.sweep_key("flash_decode", key, measurer=measurer,
+                       spec=costs.CPU_SPEC, batch=1, heads=1)
+    assert rep["winner"] == {"split_k": 2}
+    assert rep["fallback"] == {"split_k": 1}   # the heuristic for 512
+    assert rep["step_us"] == 5.0 and rep["fallback_us"] == 10.0
+    t = TBL.TuningTable()
+    AT.apply_report(t, rep, device_kind="testdev")
+    cfg = t.lookup("flash_decode", key, "testdev")
+    assert cfg["split_k"] == 2 and cfg["source"] == "sweep"
+    p = tmp_path / "swept.json"
+    t.save(str(p))
+    assert TBL.TuningTable.load(str(p)).lookup(
+        "flash_decode", key, "testdev")["split_k"] == 2
+
+
+def test_roofline_prune_and_stop():
+    key = (64, 1024, 1024, "float32")
+    cands = AT.candidates("flash_fwd", key)
+    assert {"block_q": 512, "block_k": 512} in cands
+    # a device so slow every candidate's floor exceeds the incumbent:
+    # everything is pruned, nothing would be timed
+    slow = costs.DeviceSpec("snail", 1e3, 1e3, 1 << 30)
+    keep, cut = AT.prune("flash_fwd", key, cands, 1e-6, slow)
+    assert not keep and len(cut) == len(cands)
+    # a fast device prunes nothing at a generous incumbent
+    keep2, cut2 = AT.prune("flash_fwd", key, cands, 10.0,
+                           costs.CPU_SPEC)
+    assert len(keep2) == len(cands) and not cut2
+    # no incumbent -> nothing can be pruned
+    keep3, _ = AT.prune("flash_fwd", key, cands, None, slow)
+    assert len(keep3) == len(cands)
+    # incumbent measured AT its own floor: every other candidate's
+    # floor exceeds it, so the whole ladder is pruned unmeasured
+    floor = AT.roofline_seconds(
+        AT.analytic_cost("flash_decode", (64, 512, "float32"),
+                         {"split_k": 1}), costs.CPU_SPEC)
+    calls = []
+
+    def measurer(kernel, k2, config):
+        calls.append(config)
+        return floor
+
+    rep = AT.sweep_key("flash_decode", (64, 512, "float32"),
+                       measurer=measurer, spec=costs.CPU_SPEC)
+    assert len(calls) == 1 and rep["timed"] == 1
+    assert rep["pruned"] == 2   # splits 2 and 4 never timed
+    # stop condition: an incumbent slightly ABOVE its floor (so close
+    # candidates survive the prune) but within stop_factor of the
+    # roofline ends the sweep before timing them
+    calls2 = []
+
+    def measurer2(kernel, k2, config):
+        calls2.append(config)
+        return 1.05 * floor
+
+    rep2 = AT.sweep_key("flash_decode", (64, 512, "float32"),
+                        measurer=measurer2, spec=costs.CPU_SPEC)
+    assert rep2["stopped_at_roofline"] and len(calls2) == 1
+
+
+def test_candidates_respect_tiling_legality():
+    for c in AT.candidates("flash_decode", (64, 2048, "float32")):
+        n = c["split_k"]
+        assert 2048 % n == 0 and (2048 // n) % 128 == 0
+    # L=640: 640/128=5 lanes -> only split 5... ladder gives 1
+    assert AT.candidates("flash_decode", (64, 640, "float32")) \
+        == [{"split_k": 1}]
+    for c in AT.candidates("flash_fwd", (64, 1024, 1024, "float32")):
+        assert 1024 % min(c["block_q"], 1024) == 0
+
+
+# ----------------------------------------------------------------------
+# op_bench shared measurement harness
+# ----------------------------------------------------------------------
+
+def test_op_bench_measure_and_pair():
+    import jax
+    import jax.numpy as jnp
+
+    import op_bench
+
+    x = jnp.ones((64, 64))
+    f = jax.jit(lambda a: a @ a)
+    dt = op_bench.measure(lambda: f(x), steps=8, lo=2, k=2)
+    assert isinstance(dt, float) and dt >= 0.0
+    det = op_bench.measure(lambda: f(x), steps=8, lo=2, k=2,
+                           detail=True)
+    assert set(det) == {"step_s", "e2e_s", "compile_s"}
+    da, db = op_bench.measure_pair(lambda: f(x), lambda: f(x),
+                                   steps=8, lo=2, k=2)
+    assert da >= 0.0 and db >= 0.0
+
+
+def test_perf_gate_tuning_rows_unit():
+    import perf_gate as pg
+
+    def fast_tuned(kernel, key, k=5, quiet=True):
+        return 100e-6, 80e-6
+
+    def slow_tuned(kernel, key, k=5, quiet=True):
+        return 100e-6, 170e-6
+
+    rows = pg.build_tuning_rows(
+        [("flash_decode", (64, 512, "float32"))], 1.5,
+        measure=fast_tuned)
+    assert rows[0]["baseline"] == 100.0 and rows[0]["fresh"] == 80.0
+    assert pg.gate(rows)["ok"]
+    rows_bad = pg.build_tuning_rows(
+        [("flash_decode", (64, 512, "float32"))], 1.5,
+        measure=slow_tuned)
+    out = pg.gate(rows_bad)
+    assert not out["ok"] and out["regressions"] == [
+        "tuning:flash_decode:64/512/float32"]
+
+    def broken(kernel, key, k=5, quiet=True):
+        raise RuntimeError("no backend")
+
+    rows_err = pg.build_tuning_rows(
+        [("flash_decode", (64, 512, "float32"))], 1.5, measure=broken)
+    assert pg.gate(rows_err)["missing"]   # fatal, not silently green
+
+
+# ----------------------------------------------------------------------
+# persistent AOT cache
+# ----------------------------------------------------------------------
+
+def test_aot_cache_roundtrip_corrupt_and_stale(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    c = AC.AotCompileCache(str(tmp_path / "cache"))
+    fn = jax.jit(lambda x: x * 2 + 1)
+    compiled = fn.lower(jnp.ones((4,))).compile()
+    assert c.store("k1", compiled)
+    assert c.stats["saved"] == 1
+    # round trip in the same process
+    c2 = AC.AotCompileCache(str(tmp_path / "cache"))
+    loaded = c2.load("k1")
+    assert loaded is not None
+    assert np.allclose(np.asarray(loaded(jnp.ones((4,)))), 3.0)
+    assert c2.stats["loaded"] == 1
+    # unknown key: a miss, not an error
+    assert c2.load("nope") is None and c2.stats["misses"] == 1
+    # torn entry (byte flipped on disk): CRC catches it, load reads as
+    # a miss, the manifest entry is dropped so a re-store lands
+    dg = AC.AotCompileCache._digest("k1")
+    entry = tmp_path / "cache" / "entries" / (dg + ".bin")
+    blob = bytearray(entry.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    entry.write_bytes(bytes(blob))
+    c3 = AC.AotCompileCache(str(tmp_path / "cache"))
+    assert c3.load("k1") is None
+    assert c3.stats["corrupt"] == 1
+    assert c3.store("k1", compiled)   # refresh
+    assert AC.AotCompileCache(str(tmp_path / "cache")).load("k1") \
+        is not None
+    # version/fingerprint mismatch: the whole cache reads as stale
+    # (counted), never as garbage
+    man = tmp_path / "cache" / "MANIFEST.json"
+    raw = json.loads(man.read_text())
+    raw["fingerprint"]["jax"] = "0.0.0"
+    man.write_text(json.dumps(raw))
+    c4 = AC.AotCompileCache(str(tmp_path / "cache"))
+    assert c4.stats["stale"] == 1 and len(c4) == 0
+    assert c4.load("k1") is None
+
+
+# ----------------------------------------------------------------------
+# engine warm start: the zero-compile restart proof
+# ----------------------------------------------------------------------
+
+def test_dense_engine_warm_start_zero_compiles(tmp_path):
+    cache = str(tmp_path / "aot")
+    eng = _tiny_engine()
+    with costs.accounting_scope(capture_xla=True) as bk:
+        rep = eng.precompile((4, 32), dtype="float32",
+                             prompt_buckets=(4,), cache=cache)
+        # the cost book sees precompiled programs without any
+        # observed compile (capture_compiled path)
+        assert len(bk.keys()) == rep["programs"]
+    assert rep["compiled"] == rep["programs"] == 2  # join(4) + step
+    assert rep["warm"] == 0
+    toks_cold = _serve_one(eng)
+    # precompile really did pre-empt the lazy path: serving added no
+    # traces beyond the one-per-program lower()s
+    assert sum(eng.trace_counts.values()) == rep["programs"]
+
+    # ---- restart: fresh engine, populated cache ----
+    eng2 = _tiny_engine()
+    tr = T.start_session()
+    try:
+        with T.retrace_sentinel(eng2):
+            rep2 = eng2.precompile((4, 32), dtype="float32",
+                                   prompt_buckets=(4,), cache=cache)
+            toks_warm = _serve_one(eng2)
+    finally:
+        T.end_session()
+    assert rep2["warm"] == 1 and rep2["compiled"] == 0
+    assert rep2["loaded_from_cache"] == rep["programs"]
+    # ZERO compile spans / traces before (and through) the first
+    # token — the retrace sentinel saw nothing, the tracer saw only
+    # cache hits
+    assert tr.counters.get("compiles", 0) == 0
+    assert tr.counters.get("precompile_cache_hits") == rep["programs"]
+    assert sum(eng2.trace_counts.values()) == 0
+    # bit-identical service from the deserialized programs
+    assert toks_warm == toks_cold
+    # warm ready is strictly faster than cold ready
+    assert rep2["time_to_ready_s"] < rep["time_to_ready_s"]
+    # cold_start surfaces in the snapshot + prometheus render
+    snap = eng2.metrics.snapshot()
+    assert snap["cold_start"]["warm"] == 1
+    assert snap["cold_start"]["first_ttft_ms"] > 0
+    from paddle_tpu.serving.metrics import to_prometheus
+
+    assert "cold_start_warm 1.0" in to_prometheus(snap)
+
+
+def test_paged_engine_warm_start_with_prefix_attach(tmp_path):
+    cache = str(tmp_path / "aot")
+    eng = _tiny_engine(paged=True, page_size=8)
+    rep = eng.precompile((4, 32), dtype="float32", prompt_buckets=(4,),
+                         cache=cache)
+    # pjoin + attach + cow + pstep
+    assert rep["programs"] == 4 and rep["compiled"] == 4
+    toks_cold = [_serve_one(eng) for _ in range(2)]  # repeat: attach
+    eng2 = _tiny_engine(paged=True, page_size=8)
+    with T.retrace_sentinel(eng2):
+        rep2 = eng2.precompile((4, 32), dtype="float32",
+                               prompt_buckets=(4,), cache=cache)
+        toks_warm = [_serve_one(eng2) for _ in range(2)]
+    assert rep2["warm"] == 1 and rep2["loaded_from_cache"] == 4
+    assert sum(eng2.trace_counts.values()) == 0
+    assert toks_warm == toks_cold
+    assert eng2.metrics.prefix_hits >= 1   # attach program exercised
+
+
+def test_chaos_corrupt_cache_falls_back_without_serving_impact(
+        tmp_path):
+    """tuning.cache_load chaos cell: every cache read hands back a
+    corrupted blob — the CRC rejects each entry, every program
+    compiles fresh (counted as cache_errors), and serving output is
+    unaffected. The cache heals: the faulted pass re-stores valid
+    entries, so the NEXT restart is warm again."""
+    cache = str(tmp_path / "aot")
+    eng = _tiny_engine()
+    eng.precompile((4, 32), dtype="float32", prompt_buckets=(4,),
+                   cache=cache)
+    toks_cold = _serve_one(eng)
+
+    eng2 = _tiny_engine()
+    with faults.inject("tuning.cache_load", action="corrupt"):
+        rep2 = eng2.precompile((4, 32), dtype="float32",
+                               prompt_buckets=(4,), cache=cache)
+        assert faults.hit_counts().get("tuning.cache_load", 0) >= 2
+    assert rep2["warm"] == 0
+    assert rep2["cache_errors"] == 2 and rep2["compiled"] == 2
+    assert _serve_one(eng2) == toks_cold   # no serving impact
+
+    # healed: a third start (no faults) is warm again
+    eng3 = _tiny_engine()
+    rep3 = eng3.precompile((4, 32), dtype="float32",
+                           prompt_buckets=(4,), cache=cache)
+    assert rep3["warm"] == 1 and rep3["loaded_from_cache"] == 2
+    assert _serve_one(eng3) == toks_cold
+
+
+def test_chaos_cache_load_raise_is_not_swallowed(tmp_path):
+    """A raise-action injection on the load path propagates (it is
+    the chaos harness's own signal, not a corruption) — the cache
+    must not classify InjectedFault as a torn entry."""
+    cache = str(tmp_path / "aot")
+    eng = _tiny_engine()
+    eng.precompile((4, 32), dtype="float32", prompt_buckets=(4,),
+                   cache=cache)
+    eng2 = _tiny_engine()
+    with faults.inject("tuning.cache_load", on="nth", n=1):
+        with pytest.raises(faults.InjectedFault):
+            eng2.precompile((4, 32), dtype="float32",
+                            prompt_buckets=(4,), cache=cache)
+
+
+@pytest.mark.slow
+def test_sharded_engine_warm_start(tmp_path):
+    """Sharded (disaggregated-prefill) warm start: all seven programs
+    — join/step + prefill/splice per bucket — load from cache with
+    zero compiles on restart."""
+    from paddle_tpu.parallel.mesh import init_mesh
+    from paddle_tpu import nn
+    from paddle_tpu.nn.layer.transformer import (
+        TransformerDecoder, TransformerDecoderLayer)
+    from paddle_tpu.serving.sharded import ShardedServingEngine
+
+    mesh = init_mesh(dp=4, tp=2)
+    paddle.seed(0)
+    layer = TransformerDecoderLayer(32, 2, 64, dropout=0.0)
+    dec = TransformerDecoder(layer, 2)
+    dec.eval()
+    embed, proj = nn.Embedding(17, 32), nn.Linear(32, 17)
+
+    def mk():
+        return ShardedServingEngine(
+            dec, embed, proj, mesh=mesh, num_slots=6, max_len=32,
+            prefill="disaggregated")
+
+    cache = str(tmp_path / "aot")
+    eng = mk()
+    rep = eng.precompile((4, 32), dtype="float32", prompt_buckets=(4,),
+                         cache=cache)
+    assert rep["programs"] == 4   # join, step, prefill, splice
+    toks_cold = _serve_one(eng)
+    eng2 = mk()
+    with T.retrace_sentinel(eng2):
+        rep2 = eng2.precompile((4, 32), dtype="float32",
+                               prompt_buckets=(4,), cache=cache)
+        toks_warm = _serve_one(eng2)
+    assert rep2["warm"] == 1 and rep2["loaded_from_cache"] == 4
+    assert sum(eng2.trace_counts.values()) == 0
+    assert toks_warm == toks_cold
+
+
+def test_spec_engine_precompiles_draft_verify_pair(tmp_path):
+    eng = _tiny_engine(spec_k=4)
+    rep = eng.precompile((4, 32), dtype="float32", prompt_buckets=(4,),
+                         cache=str(tmp_path / "aot"))
+    keys = set(eng._compiled)
+    assert ("join", 4) in keys
+    assert any(k[0] == "draft" for k in keys)
+    assert any(k[0] == "sstep" for k in keys)
+    assert rep["programs"] == 3
+    with T.retrace_sentinel(eng):
+        _serve_one(eng)   # serves on the precompiled pair, no traces
+    assert sum(eng.trace_counts.values()) == rep["programs"]

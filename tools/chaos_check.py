@@ -198,6 +198,61 @@ def _drive_dataloader(point, action):
         raise RuntimeError("dataloader broken after disarm")
 
 
+def _drive_aot_cache(point, action):
+    """tuning.cache_load cell: populate a persistent AOT cache, then
+    restart-precompile with the plan armed. Corrupt blobs must read
+    as CRC misses (fresh compile, cache_errors counted, serving
+    unaffected); a delay just slows; a raise propagates (the chaos
+    harness's own signal) and the NEXT unfaulted precompile still
+    works off the healed cache."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.serving import Scheduler
+    from paddle_tpu.testing import faults
+
+    d = tempfile.mkdtemp(prefix="chaos_aot_")
+    try:
+        eng = _small_engine()
+        eng.precompile((4, 32), dtype="float32", prompt_buckets=(4,),
+                       cache=d)
+        plan = (dict(action="delay", delay_s=0.02) if action == "delay"
+                else dict(action=action))
+        eng2 = _small_engine()
+        with faults.inject(point, on="always", **plan):
+            if action == "raise":
+                try:
+                    eng2.precompile((4, 32), dtype="float32",
+                                    prompt_buckets=(4,), cache=d)
+                    raise RuntimeError("load fault did not surface")
+                except faults.InjectedFault:
+                    pass
+            else:
+                rep = eng2.precompile((4, 32), dtype="float32",
+                                      prompt_buckets=(4,), cache=d)
+                if action == "corrupt" and not rep["cache_errors"]:
+                    raise RuntimeError("corrupt entries undetected")
+        faults.reset()
+        # the pool must serve after the chaos pass, and a clean
+        # restart must be fully warm again (healed cache)
+        eng3 = _small_engine()
+        rep3 = eng3.precompile((4, 32), dtype="float32",
+                               prompt_buckets=(4,), cache=d)
+        if not rep3["warm"]:
+            raise RuntimeError(f"cache did not heal: {rep3}")
+        sched = Scheduler(max_queue=16)
+        reqs = _requests(3, seed=13)
+        for r in reqs:
+            sched.submit(r)
+        eng3.serve_until_idle(sched, max_iterations=500)
+        for r in reqs:
+            if not r.result(timeout=0).ok:
+                raise RuntimeError("request failed on warm pool")
+    finally:
+        faults.reset()
+        shutil.rmtree(d, ignore_errors=True)
+
+
 MATRIX = (
     [("scheduler.admit", a, _drive_serving) for a in ("raise", "delay")]
     + [("serving.slot_join", a, _drive_serving)
@@ -212,6 +267,8 @@ MATRIX = (
        for a in ("raise", "delay", "corrupt")]
     + [("dataloader.next", a, _drive_dataloader)
        for a in ("raise", "delay")]
+    + [("tuning.cache_load", a, _drive_aot_cache)
+       for a in ("raise", "delay", "corrupt")]
 )
 
 

@@ -33,6 +33,7 @@ CHECKS = [
     (r"Paged KV pool", r"~?([\d.]+)()x peak concurrent", ("serving_paged", "value"), "serving_paged x-concurrency"),
     (r"Speculative decoding", r"~?([\d.]+)()x tokens/s", ("decode_throughput", "speculative", "b1", "speedup"), "speculative x-tokens/s"),
     (r"Sharded serving", r"~?([\d.]+)()x lower decode-step p50", ("serving_sharded", "value"), "serving_sharded x-step-p50"),
+    (r"Zero-warmup restart", r"~?([\d.]+)()x faster time-to-ready", ("cold_start", "value"), "cold_start x-ready"),
 ]
 
 MULT = {"": 1.0, "k": 1e3, "M": 1e6}

@@ -33,8 +33,10 @@ def _demo_snapshot():
     """Serve a few requests through a tiny pool (speculation enabled)
     under a tracer session AND an armed cost-accounting session, so
     the dump previews every snapshot section — memory ledger,
-    MFU/goodput gauges, speculation counters included — and return
-    (snapshot, tracer)."""
+    MFU/goodput gauges, speculation counters, cold-start report
+    included — and return (snapshot, tracer)."""
+    import tempfile
+
     import numpy as np
 
     from paddle_tpu import nn
@@ -54,6 +56,12 @@ def _demo_snapshot():
     sched = Scheduler(max_queue=16)
     rs = np.random.RandomState(1)
     with costs.accounting_scope(), session_scope() as tr:
+        # startup precompile into a throwaway AOT cache dir: the
+        # cold_start section renders (and the serve below runs on the
+        # precompiled programs — zero jit stalls, like production)
+        eng.precompile((4, 32), dtype="float32",
+                       prompt_buckets=(1, 2, 4, 8),
+                       cache=tempfile.mkdtemp(prefix="pt_aot_demo_"))
         reqs = []
         for _ in range(6):
             P = int(rs.randint(1, 6))

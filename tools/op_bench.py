@@ -1235,36 +1235,88 @@ def _configs_paged_decode():
     ]
 
 
-def _time_direct(run, steps):
-    """Shared timing scaffold for direct (non-Program) benches:
-    compile, e2e, then median marginal step time over pair slopes."""
+def measure(run, args=(), *, steps=30, lo=5, k=5, detail=False):
+    """THE timing methodology, reusable: median-of-k marginal per-call
+    seconds of `run(*args)` via two-point pair slopes — run `lo` calls
+    and `steps` calls back to back, the slope (t_hi - t_lo)/(steps -
+    lo) cancels the per-batch dispatch constant, and the median over k
+    pairs rides out this box's 1-core scheduling noise. The kernel
+    autotuner (paddle_tpu.tuning.autotune) and every direct op-bench
+    config share this one function, so tuned-vs-fallback comparisons
+    are measured exactly like the committed baselines. First call
+    compiles (jit warmup) and is excluded. Returns seconds, or the
+    {step_s, e2e_s, compile_s} dict with detail=True."""
     import jax
 
     t0 = time.perf_counter()
-    jax.block_until_ready(run())
+    jax.block_until_ready(run(*args))
     compile_s = time.perf_counter() - t0
 
     def run_n(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = run(*args)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    e2e_s = run_n(1)
+    run_n(lo)
+    run_n(steps)
+    slopes = []
+    for _ in range(k):
+        t_lo = run_n(lo)
+        t_hi = run_n(steps)
+        if t_hi > t_lo:
+            slopes.append((t_hi - t_lo) / (steps - lo))
+    slopes.sort()
+    dt = slopes[len(slopes) // 2] if slopes else e2e_s
+    if detail:
+        return {"step_s": dt, "e2e_s": e2e_s, "compile_s": compile_s}
+    return dt
+
+
+def measure_pair(run_a, run_b, *, steps=20, lo=5, k=6):
+    """PAIRED A/B measurement: each repeat times (a, b) back to back
+    with the order alternating between repeats, and the medians of the
+    per-repeat slopes are returned as (dt_a, dt_b) seconds. Sub-2x
+    comparisons on this 1-core box are only stable paired — unpaired
+    group medians drift 2%+ (the PR 8 tracing-overhead lesson); the
+    perf gate's tuned-vs-fallback rows ride this."""
+    import jax
+
+    def run_n(run, n):
         t0 = time.perf_counter()
         for _ in range(n):
             out = run()
         jax.block_until_ready(out)
         return time.perf_counter() - t0
 
-    e2e_s = run_n(1)
-    run_n(5)
-    run_n(steps)
-    slopes = []
-    for _ in range(5):
-        t_lo = run_n(5)
-        t_hi = run_n(steps)
-        if t_hi > t_lo:
-            slopes.append((t_hi - t_lo) / (steps - 5))
-    slopes.sort()
-    dt = slopes[len(slopes) // 2] if slopes else e2e_s
-    return {"e2e_us": round(e2e_s * 1e6, 1),
-            "step_us": round(dt * 1e6, 2),
-            "compile_s": round(compile_s, 2)}
+    for r in (run_a, run_b):          # compile + cache warm, both
+        jax.block_until_ready(r())
+        run_n(r, lo)
+        run_n(r, steps)
+    d_a, d_b = [], []
+    for i in range(k):
+        order = (run_a, run_b) if i % 2 == 0 else (run_b, run_a)
+        got = {}
+        for r in order:
+            t_lo = run_n(r, lo)
+            t_hi = run_n(r, steps)
+            got[id(r)] = max(0.0, (t_hi - t_lo) / (steps - lo))
+        d_a.append(got[id(run_a)])
+        d_b.append(got[id(run_b)])
+    d_a.sort()
+    d_b.sort()
+    return d_a[len(d_a) // 2], d_b[len(d_b) // 2]
+
+
+def _time_direct(run, steps):
+    """Shared timing scaffold for direct (non-Program) benches — the
+    `measure()` methodology formatted as an OP_BENCH row."""
+    r = measure(run, steps=steps, detail=True)
+    return {"e2e_us": round(r["e2e_s"] * 1e6, 1),
+            "step_us": round(r["step_s"] * 1e6, 2),
+            "compile_s": round(r["compile_s"], 2)}
 
 
 def bench_one(name, builder, steps=30):

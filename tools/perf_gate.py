@@ -66,6 +66,16 @@ DEFAULT_BENCH = ("fused_optimizer",)
 SPEC_OPS = ("spec_decode_plain_b1_L2048",
             "spec_decode_verify_k4_b1_L2048")
 
+#: tuned-vs-fallback rows folded into the full-run default (PR 11):
+#: the autotuned flash_decode config must NEVER be slower than the
+#: hand-picked constants it replaced. Both sides are measured fresh,
+#: PAIRED (op_bench.measure_pair — the only stable way to compare
+#: sub-2x deltas on this 1-core box); no committed baseline involved.
+#: On an untuned device the table resolves to the fallback itself, so
+#: the row times the same config twice and trivially holds — the gate
+#: only bites where a sweep actually installed a different config.
+TUNING_ROWS = (("flash_decode", (64, 2048, "float32")),)
+
 
 # ----------------------------------------------------------------------
 # pure comparison core (unit-tested directly; no measurement involved)
@@ -158,6 +168,7 @@ def measure_bench(metric, k=1, quiet=True):
         ("packed_varlen", bench._packed_varlen),
         ("fused_optimizer", bench._fused_optimizer),
         ("decode_throughput", bench._decode_throughput),
+        ("cold_start", bench._cold_start),
         ("serving_throughput", bench._serving_throughput),
         ("serving_paged", bench._serving_paged),
         ("serving_sharded", bench._serving_sharded),
@@ -173,6 +184,56 @@ def measure_bench(metric, k=1, quiet=True):
         if not quiet:
             print(f"  {metric}: {r['value']}", file=sys.stderr)
     return statistics.median(vals)
+
+
+def measure_tuning_row(kernel, key, *, steps=12, k=5, batch=4,
+                       heads=4, quiet=True):
+    """(fallback_s, tuned_s) for one tuning-table row, measured PAIRED
+    via op_bench.measure_pair over the real dispatch path. The tuned
+    side is whatever the active table resolves for (kernel, key) on
+    this device (the fallback itself when untuned)."""
+    import op_bench
+
+    from paddle_tpu.tuning import autotune as AT
+    from paddle_tpu.tuning import table as TBL
+
+    fb = AT.fallback_config(kernel, key)
+    tuned = TBL.lookup(kernel, key) or fb
+    tuned = {kk: tuned[kk] for kk in TBL.KERNEL_KNOBS[kernel]
+             if kk in tuned} or fb
+    run_fb = AT.build_runner(kernel, key, fb, batch, heads)
+    run_tuned = AT.build_runner(kernel, key, tuned, batch, heads)
+    dt_fb, dt_tuned = op_bench.measure_pair(run_fb, run_tuned,
+                                            steps=steps, k=k)
+    if not quiet:
+        print(f"  tuning:{kernel}:{TBL.key_str(key)} fallback "
+              f"{dt_fb * 1e6:.1f}us ({fb}) tuned "
+              f"{dt_tuned * 1e6:.1f}us ({tuned})", file=sys.stderr)
+    return dt_fb, dt_tuned
+
+
+def build_tuning_rows(tuning_rows, tol, k=5, quiet=True,
+                      measure=measure_tuning_row):
+    """Tuned-config-never-slower rows: baseline = the hand-picked
+    fallback's PAIRED measurement, fresh = the tuned config's —
+    direction 'lower', so a tuned entry slower than the constants it
+    replaced regresses. `measure` is injectable for unit tests."""
+    rows = []
+    for kernel, key in tuning_rows:
+        name = "tuning:" + kernel + ":" + "/".join(str(x) for x in key)
+        try:
+            dt_fb, dt_tuned = measure(kernel, key, k=k, quiet=quiet)
+        except Exception as e:
+            rows.append({"name": name, "direction": "lower",
+                         "unit": "paired_us", "tol": tol,
+                         "baseline": None, "fresh": None,
+                         "error": f"{type(e).__name__}: {e}"})
+            continue
+        rows.append({"name": name, "direction": "lower",
+                     "unit": "paired_us", "tol": tol,
+                     "baseline": round(dt_fb * 1e6, 2),
+                     "fresh": round(dt_tuned * 1e6, 2)})
+    return rows
 
 
 def build_rows(op_names, bench_names, op_base, bench_base, tol_op,
@@ -198,7 +259,8 @@ def build_rows(op_names, bench_names, op_base, bench_base, tol_op,
 
 def run_gate(op_names=(), bench_names=(), *, op_baseline=OP_BASELINE,
              bench_baseline=BENCH_BASELINE, tol_op=2.0, tol_bench=1.5,
-             k=3, allowlist=(), out=GATE_OUT, quiet=True):
+             k=3, allowlist=(), out=GATE_OUT, quiet=True,
+             tuning_rows=(), tol_tuning=1.5):
     """Measure, compare, persist. Returns the gate payload (and writes
     it to `out`); callers decide the exit code from payload["ok"]."""
 
@@ -213,12 +275,15 @@ def run_gate(op_names=(), bench_names=(), *, op_baseline=OP_BASELINE,
     bench_base = _load(bench_baseline)
     rows = build_rows(op_names, bench_names, op_base, bench_base,
                       tol_op, tol_bench, k, quiet=quiet)
+    rows += build_tuning_rows(tuning_rows, tol_tuning, k=max(3, k),
+                              quiet=quiet)
     payload = gate(rows, allowlist)
     payload["config"] = {
         "op_baseline": os.path.abspath(op_baseline),
         "bench_baseline": os.path.abspath(bench_baseline),
         "backend": op_base.get("backend"),
-        "tol_op": tol_op, "tol_bench": tol_bench, "k": k,
+        "tol_op": tol_op, "tol_bench": tol_bench,
+        "tol_tuning": tol_tuning, "k": k,
         "allowlist": sorted(allowlist)}
     if out:
         with open(out, "w") as f:
@@ -241,6 +306,11 @@ def main(argv=None):
                          "max(1, k//3))")
     ap.add_argument("--tol-op", type=float, default=2.0)
     ap.add_argument("--tol-bench", type=float, default=1.5)
+    ap.add_argument("--tol-tuning", type=float, default=1.5)
+    ap.add_argument("--tuning", default=None,
+                    help="comma-separated tuning rows KERNEL:d/L/dtype"
+                         " (default: the TUNING_ROWS set on full "
+                         "runs; 'none' to skip)")
     ap.add_argument("--allow", default="",
                     help="comma-separated row names (op:NAME / "
                          "bench:NAME) that may regress without "
@@ -257,6 +327,7 @@ def main(argv=None):
     if args.quick:
         op_names = list(QUICK_OPS)
         bench_names = []
+        tuning_rows = []
         if args.tol_op == 2.0:
             # micro-second rows on a timeshared core need headroom;
             # the quick gate is a smoke of the MACHINERY, the full run
@@ -266,17 +337,25 @@ def main(argv=None):
         op_names = ([c[0] for c in _quick8()] + list(SPEC_OPS)) \
             if args.ops is None else []
         bench_names = list(DEFAULT_BENCH) if args.bench is None else []
+        tuning_rows = list(TUNING_ROWS)
     if args.ops is not None:
         op_names = [s for s in args.ops.split(",") if s]
     if args.bench is not None:
         bench_names = [s for s in args.bench.split(",") if s]
+    if args.tuning is not None:
+        tuning_rows = [] if args.tuning == "none" else [
+            (s.split(":")[0], tuple(
+                int(p) if p.isdigit() else p
+                for p in s.split(":")[1].split("/")))
+            for s in args.tuning.split(",") if s]
 
     payload = run_gate(
         op_names, bench_names, op_baseline=args.op_baseline,
         bench_baseline=args.bench_baseline, tol_op=args.tol_op,
         tol_bench=args.tol_bench, k=args.k,
         allowlist=[s for s in args.allow.split(",") if s],
-        out=args.out, quiet=False)
+        out=args.out, quiet=False, tuning_rows=tuning_rows,
+        tol_tuning=args.tol_tuning)
     for r in payload["rows"]:
         print(f"{r['status']:>12}  {r['name']:<28} "
               f"baseline={r.get('baseline')} fresh={r.get('fresh')} "

@@ -64,6 +64,13 @@ def main(argv=None):
             print(f"  {e['args'].get('key')}  "
                   f"{e.get('dur', 0) / 1e3:9.1f}ms  "
                   f"count={e['args'].get('count')}")
+    pre = [e for e in events if e.get("name") == "precompile"]
+    if pre:
+        hits = sum(1 for e in pre if e["args"].get("source") == "cache")
+        total_ms = sum(e.get("dur", 0) for e in pre) / 1e3
+        print(f"startup precompile: {len(pre)} programs "
+              f"({hits} from cache, {len(pre) - hits} compiled; "
+              f"{total_ms:.1f}ms wall)")
     if steps:
         print(f"decode steps: {len(steps)}")
     drafts = [e for e in events if e.get("name") == "decode.draft"]
