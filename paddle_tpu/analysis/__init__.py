@@ -1,0 +1,47 @@
+"""Static analyzer for the serving stack: program + host-state lints.
+
+Every load-bearing guarantee in this codebase — never-retraces, donated
+hot-path buffers, sharding constraints on every carry, documented
+metric schema, registered fault points, lock-guarded shared state — was
+enforced only *dynamically* (retrace sentinel, soaks, chaos cells): a
+violation surfaced at runtime on one lucky code path, or not at all.
+This package checks the same contracts at the source/jaxpr level, so a
+whole defect class fails CI before any runtime exercises it.
+
+Two halves (see README "Static analysis" for the rule table):
+
+  * **Program analyzer** (`analysis.program`) — traces every program
+    `ServingEngine.precompile()` would ready (dense/paged/sharded/spec
+    + the fused optimizer step) and lints the closed jaxprs: baked-in
+    constants (PTA101), un-donated large carries (PTA102), float
+    promotion surprises (PTA103), host callbacks in jitted bodies
+    (PTA104), sharded carries without constraint coverage (PTA105).
+  * **Host-state + repo lints** (`analysis.hoststate`,
+    `analysis.repo_rules`) — AST checks over serving/, tuning/ and
+    profiler/: mutations of lock-owning classes outside their lock
+    (PTA201, with the `# analysis: single-threaded` escape hatch),
+    snapshot()/SNAPSHOT_DOCS drift (PTA202), unregistered fault points
+    (PTA203), np./time. calls inside jitted bodies (PTA204).
+
+`tools/static_check.py` is the CLI gate; findings carry stable rule
+ids + baseline keys matched against the committed
+`ANALYSIS_BASELINE.json` allowlist (start green, ratchet down).
+"""
+from .findings import RULES, Baseline, Finding, render_text
+from .hoststate import check_paths, check_source
+from .program import (analyze_engine, analyze_fused_optimizer,
+                      analyze_program)
+from .repo_rules import (RULE_FAULT_POINT, RULE_SNAPSHOT_DOC,
+                         fault_point_findings, snapshot_doc_findings)
+from .runner import (build_check_engines, program_findings, repo_root,
+                     run, static_findings)
+
+__all__ = [
+    "RULES", "Finding", "Baseline", "render_text",
+    "check_source", "check_paths",
+    "analyze_program", "analyze_engine", "analyze_fused_optimizer",
+    "snapshot_doc_findings", "fault_point_findings",
+    "RULE_SNAPSHOT_DOC", "RULE_FAULT_POINT",
+    "run", "static_findings", "program_findings",
+    "build_check_engines", "repo_root",
+]

@@ -1,0 +1,204 @@
+"""Repo-convention rules: invariants that span modules.
+
+PTA202 — snapshot/doc sync. `ServingMetrics.snapshot()` is the metric
+surface of record and `SNAPSHOT_DOCS` its documented schema; the two
+must never drift. This rule extracts the key set snapshot() PRODUCES
+straight from its AST (dict literals, the ``**({} if .. else {..})``
+conditional sections, and one level of local-variable indirection for
+the "memory" dict) and diffs it against the `SNAPSHOT_DOCS` keys —
+statically, so a key added to one side fails CI before any runtime
+path renders it. The dynamic half (a fully-populated snapshot
+flattening to exactly the documented keys) lives in
+tests/test_tracing.py and references THIS rule id: one invariant, two
+enforcement points, one source of truth.
+
+PTA203 — fault-point registry. `faults.point(name)` registers points
+idempotently, which means `faults.inject("typo.name")` self-registers
+a fresh point that NO production code ever hits: the plan silently
+never fires. This rule collects every literal `faults.point("...")`
+(the registry) and checks every literal `faults.inject("...")` against
+it.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .findings import Finding
+
+__all__ = ["RULE_SNAPSHOT_DOC", "RULE_FAULT_POINT",
+           "snapshot_keys_from_source", "snapshot_doc_findings",
+           "fault_point_findings", "collect_fault_names"]
+
+RULE_SNAPSHOT_DOC = "PTA202"
+RULE_FAULT_POINT = "PTA203"
+
+
+# ----------------------------------------------------------------------
+# PTA202: snapshot() AST key extraction vs SNAPSHOT_DOCS
+# ----------------------------------------------------------------------
+
+def _flatten_dict_node(node, prefix, local_dicts, out):
+    """Collect dotted key paths produced by a dict-literal AST node.
+    Values that are themselves dict literals (directly, via a local
+    variable, or behind the `**({} if c else {...})` section idiom)
+    recurse; anything else is a leaf."""
+    for k, v in zip(node.keys, node.values):
+        if k is None:                       # **expansion (a section)
+            for branch in _dict_branches(v, local_dicts):
+                _flatten_dict_node(branch, prefix, local_dicts, out)
+            continue
+        if not isinstance(k, ast.Constant) or \
+                not isinstance(k.value, str):
+            continue
+        key = prefix + k.value
+        v = _resolve(v, local_dicts)
+        if isinstance(v, ast.Dict):
+            _flatten_dict_node(v, key + ".", local_dicts, out)
+        else:
+            out.add(key)
+
+
+def _resolve(node, local_dicts):
+    if isinstance(node, ast.Name) and node.id in local_dicts:
+        return local_dicts[node.id]
+    return node
+
+
+def _dict_branches(node, local_dicts):
+    """Dict-literal branches of a `**`-expanded expression: handles
+    `{...}`, a local name, and `{} if cond else {...}` (both arms)."""
+    node = _resolve(node, local_dicts)
+    if isinstance(node, ast.Dict):
+        return [node]
+    if isinstance(node, ast.IfExp):
+        return _dict_branches(node.body, local_dicts) + \
+            _dict_branches(node.orelse, local_dicts)
+    return []
+
+
+def snapshot_keys_from_source(source):
+    """The dotted key set `ServingMetrics.snapshot()` can emit,
+    extracted statically from the module source."""
+    tree = ast.parse(source)
+    fn = None
+    for cls in ast.walk(tree):
+        if isinstance(cls, ast.ClassDef) and cls.name == "ServingMetrics":
+            for meth in cls.body:
+                if isinstance(meth, ast.FunctionDef) and \
+                        meth.name == "snapshot":
+                    fn = meth
+    if fn is None:
+        raise ValueError("ServingMetrics.snapshot() not found")
+    local_dicts = {}
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Dict):
+            for t in sub.targets:
+                if isinstance(t, ast.Name):
+                    local_dicts[t.id] = sub.value
+    keys = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Return) and isinstance(sub.value, ast.Dict):
+            _flatten_dict_node(sub.value, "", local_dicts, keys)
+    return keys
+
+
+def snapshot_doc_findings(metrics_path=None, docs=None):
+    """PTA202 findings (empty = in sync). Defaults to the real
+    serving.metrics module + its SNAPSHOT_DOCS; fixture tests pass a
+    synthetic module path and doc set."""
+    if metrics_path is None:
+        from ..serving import metrics as _m
+
+        metrics_path = _m.__file__
+    if docs is None:
+        from ..serving.metrics import SNAPSHOT_DOCS as docs
+
+    with open(metrics_path) as f:
+        src = f.read()
+    produced = snapshot_keys_from_source(src)
+    documented = set(docs)
+    findings = []
+    where = os.path.basename(metrics_path)
+    for key in sorted(produced - documented):
+        findings.append(Finding(
+            RULE_SNAPSHOT_DOC, where,
+            f"snapshot() emits `{key}` but SNAPSHOT_DOCS does not "
+            f"document it — add the doc row (the schema of record)",
+            baseline_key=f"snapshot:undocumented:{key}"))
+    for key in sorted(documented - produced):
+        findings.append(Finding(
+            RULE_SNAPSHOT_DOC, where,
+            f"SNAPSHOT_DOCS documents `{key}` but snapshot() never "
+            f"emits it — dead doc row (or a dropped metric)",
+            baseline_key=f"snapshot:unemitted:{key}"))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# PTA203: fault-point registry coverage
+# ----------------------------------------------------------------------
+
+def _literal_fault_calls(tree, attr):
+    """(name, lineno) for every `faults.<attr>("literal", ...)` or bare
+    `<attr>("literal", ...)` call in a module."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        named = (isinstance(f, ast.Attribute) and f.attr == attr) or \
+            (isinstance(f, ast.Name) and f.id == attr)
+        if not named:
+            continue
+        a0 = node.args[0]
+        if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+            out.append((a0.value, node.lineno))
+    return out
+
+
+def _py_files(paths):
+    for p in paths:
+        if os.path.isdir(p):
+            for base, _dirs, names in os.walk(p):
+                if "__pycache__" in base:
+                    continue
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        yield os.path.join(base, n)
+        elif p.endswith(".py"):
+            yield p
+
+
+def collect_fault_names(paths, attr="point"):
+    """{name: [file:line, ...]} of literal faults.<attr>() calls."""
+    out = {}
+    for fp in sorted(set(_py_files(paths))):
+        with open(fp) as f:
+            try:
+                tree = ast.parse(f.read())
+            except SyntaxError:
+                continue
+        for name, lineno in _literal_fault_calls(tree, attr):
+            out.setdefault(name, []).append(f"{fp}:{lineno}")
+    return out
+
+
+def fault_point_findings(point_paths, inject_paths):
+    """PTA203 findings: inject() names with no point() registration
+    anywhere in `point_paths` + `inject_paths` (tests register ad-hoc
+    points next to their injections — that counts)."""
+    registry = set(collect_fault_names(
+        list(point_paths) + list(inject_paths), attr="point"))
+    findings = []
+    injected = collect_fault_names(inject_paths, attr="inject")
+    for name, sites in sorted(injected.items()):
+        if name in registry:
+            continue
+        findings.append(Finding(
+            RULE_FAULT_POINT, sites[0],
+            f"faults.inject({name!r}) names a point no faults.point() "
+            f"registers — inject() self-registers it, so the plan "
+            f"silently never fires",
+            baseline_key=f"faults:{name}"))
+    return findings

@@ -1,0 +1,237 @@
+"""Orchestration: the whole-analyzer run the CLI gate and the tier-1
+tests share.
+
+Two halves with different costs:
+
+  * `static_findings(root)` — AST lints (PTA201/PTA204 over serving/,
+    tuning/, profiler/ and optimizer/fused.py) + the repo rules
+    (PTA202 snapshot/doc sync, PTA203 fault-point registry). Pure
+    source reads, sub-second.
+  * `program_findings()` — builds throwaway tiny engines (dense step,
+    dense spec, paged+prefix, and — when the process has a multi-device
+    mesh — sharded disaggregated), traces every program `precompile()`
+    would ready, plus the fused optimizer step, and runs the jaxpr
+    rules. A few seconds of tracing, NO compiles.
+
+`run()` combines them, filters against the committed
+`ANALYSIS_BASELINE.json`, and (for the budget-aware CI fast mode)
+caches program findings keyed on a digest of every package source —
+any edit under paddle_tpu/ invalidates the cache, so a stale pass is
+impossible.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+from .findings import Baseline, Finding
+from . import hoststate, repo_rules
+
+__all__ = ["repo_root", "static_findings", "program_findings",
+           "build_check_engines", "run", "BASELINE_NAME", "CACHE_NAME"]
+
+BASELINE_NAME = "ANALYSIS_BASELINE.json"
+CACHE_NAME = ".static_check_cache.json"
+
+#: tiny-stack program analysis treats buffers past this as "large" —
+#: low on purpose so the check engines' KV pools qualify (production
+#: pools are GBs; the invariant is the same)
+CHECK_LARGE_BYTES = 4096
+
+
+def repo_root():
+    """The repository root (two levels above this package)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _pkg_dir():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------------------
+# static half
+# ----------------------------------------------------------------------
+
+def static_findings(root=None):
+    root = root or repo_root()
+    pkg = os.path.join(root, "paddle_tpu")
+    ast_paths = [os.path.join(pkg, d)
+                 for d in ("serving", "tuning", "profiler")]
+    ast_paths.append(os.path.join(pkg, "optimizer", "fused.py"))
+    findings = hoststate.check_paths([p for p in ast_paths
+                                      if os.path.exists(p)])
+    findings += repo_rules.snapshot_doc_findings()
+    findings += repo_rules.fault_point_findings(
+        point_paths=[pkg],
+        inject_paths=[pkg, os.path.join(root, "tests"),
+                      os.path.join(root, "tools")])
+    return findings
+
+
+# ----------------------------------------------------------------------
+# program half
+# ----------------------------------------------------------------------
+
+def _small_stack(seed=7, D=32, H=2, V=17, layers=2):
+    import numpy as np
+
+    from .. import nn
+    from ..nn.layer.transformer import (TransformerDecoder,
+                                        TransformerDecoderLayer)
+
+    np.random.seed(seed)
+    layer = TransformerDecoderLayer(D, H, 64, dropout=0.0)
+    dec = TransformerDecoder(layer, layers)
+    dec.eval()
+    return dec, nn.Embedding(V, D), nn.Linear(D, V)
+
+
+def _local_mesh(dp=2):
+    """A dp-only DeviceMesh over the first `dp` devices, NOT installed
+    globally — the analyzer must not disturb the process mesh."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from ..parallel.mesh import DeviceMesh
+
+    devs = jax.devices()
+    if len(devs) < dp:
+        return None
+    return DeviceMesh(Mesh(np.asarray(devs[:dp]).reshape(dp), ("dp",)),
+                      ("dp",))
+
+
+def build_check_engines(include_sharded=True):
+    """[(label, engine)] throwaway tiny engines covering the program
+    matrix: dense step, dense spec (draft + verify), paged (pjoin /
+    attach / cow / pstep) and — when >= 2 devices exist — sharded
+    disaggregated (join / step / prefill / splice)."""
+    from ..serving import ServingEngine
+
+    out = []
+    dec, emb, proj = _small_stack(seed=7)
+    out.append(("dense", ServingEngine(dec, emb, proj, num_slots=4,
+                                       max_len=32)))
+    dec, emb, proj = _small_stack(seed=8)
+    out.append(("spec", ServingEngine(dec, emb, proj, num_slots=4,
+                                      max_len=32, spec_k=4)))
+    dec, emb, proj = _small_stack(seed=9)
+    out.append(("paged", ServingEngine(dec, emb, proj, num_slots=4,
+                                       max_len=32, paged=True,
+                                       page_size=8)))
+    if include_sharded:
+        mesh = _local_mesh(dp=2)
+        if mesh is not None:
+            from ..serving import ShardedServingEngine
+
+            dec, emb, proj = _small_stack(seed=10)
+            out.append(("sharded", ShardedServingEngine(
+                dec, emb, proj, mesh=mesh, num_slots=2, max_len=32,
+                prefill="disaggregated")))
+            dec, emb, proj = _small_stack(seed=11)
+            out.append(("sharded_paged", ShardedServingEngine(
+                dec, emb, proj, mesh=mesh, num_slots=2, max_len=32,
+                paged=True, page_size=8)))
+    return out
+
+
+def program_findings(include_sharded=True,
+                     large_bytes=CHECK_LARGE_BYTES):
+    from .program import analyze_engine, analyze_fused_optimizer
+
+    findings = []
+    for _label, eng in build_check_engines(include_sharded):
+        findings.extend(analyze_engine(eng, (4, 32), prompt_buckets=(8,),
+                                       large_bytes=large_bytes))
+    findings += analyze_fused_optimizer(large_bytes=large_bytes)
+    return findings
+
+
+# ----------------------------------------------------------------------
+# the combined gate
+# ----------------------------------------------------------------------
+
+def _source_digest():
+    """sha256 over every package source + the jax version: the fast
+    cache's validity key (any paddle_tpu edit invalidates it)."""
+    import jax
+
+    h = hashlib.sha256()
+    h.update(jax.__version__.encode())
+    pkg = _pkg_dir()
+    for base, dirs, names in os.walk(pkg):
+        if "__pycache__" in base:
+            continue
+        dirs.sort()
+        for n in sorted(names):
+            if not n.endswith(".py"):
+                continue
+            fp = os.path.join(base, n)
+            h.update(fp.encode())
+            with open(fp, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+def _cached_program_findings(root, fast, include_sharded):
+    cache_path = os.path.join(root, CACHE_NAME)
+    digest = _source_digest()
+    if fast and os.path.exists(cache_path):
+        try:
+            with open(cache_path) as f:
+                raw = json.load(f)
+            if raw.get("digest") == digest and \
+                    raw.get("sharded") == bool(include_sharded):
+                return [Finding(d["rule"], d["where"], d["message"],
+                                d["baseline_key"])
+                        for d in raw["findings"]], "hit"
+        except (OSError, ValueError, KeyError):
+            pass
+    findings = program_findings(include_sharded=include_sharded)
+    try:
+        tmp = cache_path + f".tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"digest": digest,
+                       "sharded": bool(include_sharded),
+                       "findings": [x.as_dict() for x in findings]}, f)
+        os.replace(tmp, cache_path)
+    except OSError:
+        pass
+    return findings, "miss"
+
+
+def run(root=None, *, programs=True, include_sharded=True, fast=False,
+        baseline_path=None):
+    """The full gate. Returns a report dict:
+
+        {"findings", "new", "baselined", "stale_baseline",
+         "cache", "ok"}
+
+    `ok` is the gate verdict: no finding outside the baseline. Stale
+    baseline entries are reported (delete them — the ratchet) but do
+    not fail the gate on their own."""
+    root = root or repo_root()
+    findings = static_findings(root)
+    cache = None
+    if programs:
+        prog, cache = _cached_program_findings(root, fast,
+                                               include_sharded)
+        findings = findings + prog
+    if baseline_path is None:
+        baseline_path = os.path.join(root, BASELINE_NAME)
+    if os.path.exists(baseline_path):
+        baseline = Baseline.load(baseline_path)
+    else:
+        baseline = Baseline()
+    new, baselined, stale = baseline.split(findings)
+    return {
+        "findings": findings,
+        "new": new,
+        "baselined": baselined,
+        "stale_baseline": stale,
+        "cache": cache,
+        "ok": not new,
+    }

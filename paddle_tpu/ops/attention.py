@@ -123,7 +123,7 @@ def _kv_bias(mask, b, h, sk):
         return None
     m = mask
     if m.dtype == jnp.bool_:
-        m = jnp.where(m, 0.0, -1e30).astype(jnp.float32)
+        m = jnp.where(m, jnp.float32(0.0), jnp.float32(-1e30))
     # accepted shapes: (b, sk), (b, 1, sk), (b, 1, 1, sk), (1/b, 1, 1, sk)
     shp = m.shape
     if shp[-1] != sk:
@@ -150,7 +150,7 @@ def segment_bias(segment_ids, kv_segment_ids=None):
     seg_q = jnp.asarray(segment_ids)
     seg_k = seg_q if kv_segment_ids is None else jnp.asarray(kv_segment_ids)
     eq = seg_q[:, :, None] == seg_k[:, None, :]
-    return jnp.where(eq, 0.0, -1e30).astype(jnp.float32)[:, None]
+    return jnp.where(eq, jnp.float32(0.0), jnp.float32(-1e30))[:, None]
 
 
 def _z():
@@ -1216,7 +1216,7 @@ def _with_segment_mask(mask, segment_ids, bshd=False):
         return sb
     m = mask
     if m.dtype == jnp.bool_:
-        m = jnp.where(m, 0.0, -1e30).astype(jnp.float32)
+        m = jnp.where(m, jnp.float32(0.0), jnp.float32(-1e30))
     return m + sb
 
 
@@ -1322,7 +1322,7 @@ def decode_attention_reference(q, k, v, length, bias=None, scale=None):
     kpos = jnp.arange(L, dtype=jnp.int32)
     valid = kpos[None, :] < (length.reshape(-1, 1) if length.ndim
                              else length.reshape(1, 1))
-    m = jnp.where(valid, 0.0, -1e30).astype(jnp.float32)
+    m = jnp.where(valid, jnp.float32(0.0), jnp.float32(-1e30))
     if m.shape[0] == 1:
         m = jnp.broadcast_to(m, (b, L))
     if bias is not None:
@@ -1571,7 +1571,7 @@ def verify_attention_reference(q, k, v, length, bias=None, scale=None):
     qpos = (length[:, None] - jnp.int32(T)) + \
         jnp.arange(T, dtype=jnp.int32)[None, :]          # [b, T]
     valid = kpos[None, None, :] <= qpos[:, :, None]      # [b, T, L]
-    m = jnp.where(valid, 0.0, -1e30).astype(jnp.float32)
+    m = jnp.where(valid, jnp.float32(0.0), jnp.float32(-1e30))
     if bias is not None:
         m = m + jnp.asarray(bias, jnp.float32)[:, None, :]
     return sdpa_reference(q, k, v, m[:, None], False, scale)

@@ -249,6 +249,31 @@ class _EngineBase:
         the slot axis."""
         return free[0]
 
+    #: jit-cache key KINDS whose pool-state carry argument is donated
+    #: into the compiled program (position of the state arg in the
+    #: body signature). Donation lets XLA alias the KV pool in place
+    #: instead of copying it every dispatch — on the decode hot path
+    #: that copy is the whole cache. Join-family programs (join/pjoin/
+    #: attach/cow/splice) are NOT donated on purpose: a failed join is
+    #: retried with the SAME carry (per-request isolation), and a
+    #: consumed buffer would widen that failure into a pool-wide
+    #: reset; the static analyzer's donation audit (PTA102) checks
+    #: this declaration and the kept-undonated set is justified in
+    #: ANALYSIS_BASELINE.json. Note the retry contract for donated
+    #: steps: an attempt that executed before failing (or that blew
+    #: the watchdog) consumed the carry, so its retry fails loudly and
+    #: lands in the existing all-or-nothing recovery (_fail_active ->
+    #: _reset_pool) rather than re-running on stale state.
+    _DONATED_KINDS = {"step": 2, "sstep": 2, "pstep": 2}
+
+    def _donate_argnums(self, key):
+        """donate_argnums for the program at `key` (() = donate
+        nothing). One declaration shared by the jit builders AND the
+        static analyzer, so the audit can never drift from the code."""
+        kind = key[0] if isinstance(key, tuple) and key else key
+        pos = self._DONATED_KINDS.get(kind)
+        return () if pos is None else (pos,)
+
     # ---- cost/memory accounting (profiler.costs) ----
     def _step_cost_key(self):
         """The jit-cache key of this engine's batched decode step (the
@@ -1033,7 +1058,8 @@ class ServingEngine(_EngineBase):
     def _build_step(self, key):
         import jax
 
-        return jax.jit(self._step_body(key))
+        return jax.jit(self._step_body(key),
+                       donate_argnums=self._donate_argnums(key))
 
     def _step_body(self, key):
         import jax.numpy as jnp
@@ -1205,7 +1231,8 @@ class ServingEngine(_EngineBase):
     def _build_spec_step(self, vkey):
         import jax
 
-        return jax.jit(self._spec_step_body(vkey))
+        return jax.jit(self._spec_step_body(vkey),
+                       donate_argnums=self._donate_argnums(vkey))
 
     def _spec_step_body(self, vkey):
         import jax.numpy as jnp
@@ -1860,7 +1887,8 @@ class PagedServingEngine(ServingEngine):
     def _build_paged_step(self, ck):
         import jax
 
-        return jax.jit(self._paged_step_body(ck))
+        return jax.jit(self._paged_step_body(ck),
+                       donate_argnums=self._donate_argnums(ck))
 
     # ---- zero-warmup startup (paged program set) ----
     def _startup_programs(self, prompt_buckets):

@@ -498,11 +498,12 @@ class ServingMetrics:
         {weights_bytes, pool_bytes, in_use_bytes,
         compile_temp_peak_bytes} (or None before the pool exists).
         snapshot() calls it OUTSIDE the metrics lock."""
-        self._memory_provider = provider
-        if budget_bytes is not None:
-            self.budget_bytes = int(budget_bytes)
-        if watermark_frac is not None:
-            self.watermark_frac = float(watermark_frac)
+        with self._lock:
+            self._memory_provider = provider
+            if budget_bytes is not None:
+                self.budget_bytes = int(budget_bytes)
+            if watermark_frac is not None:
+                self.watermark_frac = float(watermark_frac)
 
     def check_memory_watermark(self, in_use_bytes):
         """Engine-side liveness check against the configured budget:

@@ -243,10 +243,12 @@ class ShardedServingEngine(ServingEngine):
                 for pc in out["paged"]]
         return out
 
-    def _wrap_state_out(self, body, has_aux):
+    def _wrap_state_out(self, body, has_aux, key):
         """jit a single-chip engine body with the sharded annotations:
         decode kernels constrained via `decode_shardings`, every
-        returned carry pinned to the pool layout."""
+        returned carry pinned to the pool layout, the step-family
+        state carry donated per the shared `_donate_argnums`
+        declaration (same donation audit as the single-chip builders)."""
         import jax
 
         from ..ops import attention as A
@@ -261,18 +263,20 @@ class ShardedServingEngine(ServingEngine):
                 return self._constrain_state(st), aux
             return self._constrain_state(out)
 
-        return jax.jit(fn)
+        return jax.jit(fn, donate_argnums=self._donate_argnums(key))
 
     def _build_join(self, Pb):
-        return self._wrap_state_out(self._join_body(Pb), True)
+        return self._wrap_state_out(self._join_body(Pb), True,
+                                    ("join", Pb))
 
     def _build_step(self, key):
-        return self._wrap_state_out(self._step_body(key), True)
+        return self._wrap_state_out(self._step_body(key), True, key)
 
     def _build_spec_step(self, vkey):
         # the spec verify body returns (state, (emit, n_emit)) — the
         # same state-out contract, annotated identically
-        return self._wrap_state_out(self._spec_step_body(vkey), True)
+        return self._wrap_state_out(self._spec_step_body(vkey), True,
+                                    vkey)
 
     def _build_draft(self, dkey):
         # pure gathers over dp-sharded per-slot rows; the SPMD
@@ -655,13 +659,15 @@ class ShardedPagedServingEngine(ShardedServingEngine, PagedServingEngine):
             self._place_params()
 
     def _build_paged_join(self, Pb):
-        return self._wrap_state_out(self._paged_join_body(Pb), True)
+        return self._wrap_state_out(self._paged_join_body(Pb), True,
+                                    ("pjoin", Pb))
 
     def _build_paged_step(self, ck):
-        return self._wrap_state_out(self._paged_step_body(ck), True)
+        return self._wrap_state_out(self._paged_step_body(ck), True, ck)
 
     def _build_attach(self):
-        return self._wrap_state_out(self._attach_body(), False)
+        return self._wrap_state_out(self._attach_body(), False,
+                                    ("attach",))
 
     def _build_cow(self):
-        return self._wrap_state_out(self._cow_body(), False)
+        return self._wrap_state_out(self._cow_body(), False, ("cow",))

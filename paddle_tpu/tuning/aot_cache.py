@@ -118,7 +118,9 @@ class AotCompileCache:
     def _manifest_path(self):
         return os.path.join(self.path, self.MANIFEST)
 
-    def _read_manifest(self):
+    def _read_manifest(self):   # analysis: single-threaded
+        # construction-time only: no second thread can hold the cache
+        # while __init__ is still populating it
         try:
             with open(self._manifest_path()) as f:
                 raw = json.load(f)
@@ -167,7 +169,8 @@ class AotCompileCache:
         with self._lock:
             meta = self._manifest.get(dg)
         if meta is None or meta.get("key") != key_str:
-            self.stats["misses"] += 1
+            with self._lock:
+                self.stats["misses"] += 1
             return None
         try:
             with open(os.path.join(self._entries_dir, dg + ".bin"),
@@ -183,15 +186,16 @@ class AotCompileCache:
             from jax.experimental import serialize_executable as se
 
             out = se.deserialize_and_load(payload, in_tree, out_tree)
-            self.stats["loaded"] += 1
+            with self._lock:
+                self.stats["loaded"] += 1
             return out
         except faults.InjectedFault:
             raise
         except Exception:
             # torn entry / undeserializable executable: drop it from
             # the manifest so the refreshed store isn't shadowed
-            self.stats["corrupt"] += 1
             with self._lock:
+                self.stats["corrupt"] += 1
                 self._manifest.pop(dg, None)
                 try:
                     self._write_manifest()
@@ -228,5 +232,6 @@ class AotCompileCache:
                 self._write_manifest()
         except OSError:
             return False
-        self.stats["saved"] += 1
+        with self._lock:
+            self.stats["saved"] += 1
         return True

@@ -17,6 +17,14 @@ python -m pytest tests/ -q -x "$@" || rc=1
 echo "== [1b] README bench-claim hygiene =="
 python tools/check_readme_bench.py || rc=1
 
+echo "== [1c] static analyzer gate (AST lints + cached program analyses) =="
+if python tools/static_check.py --fast --json > /tmp/static_check.json; then
+  echo "static-check: pass (see /tmp/static_check.json)"
+else
+  echo "static-check: NEW findings (see /tmp/static_check.json; fix or justify in ANALYSIS_BASELINE.json)"
+  rc=1
+fi
+
 echo "== [2/3] op micro-bench (quick, vs baseline) =="
 if python tools/op_bench.py --cpu --quick --compare; then
   echo "op-bench: no >2x regressions"

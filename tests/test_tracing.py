@@ -492,6 +492,15 @@ def test_snapshot_schema_matches_docs_exactly():
     # base sections only: still a strict subset of the documented keys
     flat_base = flatten_snapshot(ServingMetrics().snapshot())
     assert set(flat_base) < set(SNAPSHOT_DOCS)
+    # one source of truth with the static analyzer: rule PTA202
+    # (snapshot-doc-drift, paddle_tpu.analysis.repo_rules) checks the
+    # SAME invariant against the snapshot() SOURCE, so a key added to
+    # either side fails both this runtime test and the CI gate
+    # (tools/static_check.py)
+    from paddle_tpu.analysis import repo_rules
+
+    assert repo_rules.RULE_SNAPSHOT_DOC == "PTA202"
+    assert repo_rules.snapshot_doc_findings() == []
 
 
 def test_prometheus_rendering():

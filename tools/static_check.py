@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Static analyzer gate over the serving stack (paddle_tpu.analysis).
+
+Two halves (see README "Static analysis" for the rule table):
+
+  * AST + repo lints — lock discipline over serving/tuning/profiler
+    (PTA201), snapshot()/SNAPSHOT_DOCS sync (PTA202), fault-point
+    registry coverage (PTA203), np./time. in jitted bodies (PTA204);
+  * program analysis — traces every program ServingEngine.precompile()
+    would ready (dense / paged / sharded / spec tiny check engines,
+    plus the fused optimizer step; NO compiles, trace only) and lints
+    the jaxprs: baked constants (PTA101), un-donated carries (PTA102),
+    float promotion (PTA103), host callbacks (PTA104), unconstrained
+    sharded carries (PTA105).
+
+Exit status is the gate: 0 when every finding has a justified entry in
+the committed ANALYSIS_BASELINE.json, 1 otherwise. Stale baseline
+entries (matching nothing) are reported so the allowlist only ever
+ratchets DOWN — delete them, don't collect them.
+
+Usage:
+
+    python tools/static_check.py              # full run
+    python tools/static_check.py --fast       # CI budget mode: reuse
+                                              #   cached program results
+                                              #   while no paddle_tpu/
+                                              #   source changed
+    python tools/static_check.py --json       # machine-readable report
+    python tools/static_check.py --no-programs  # AST/repo lints only
+    python tools/static_check.py --write-baseline  # re-seed the
+                                              #   allowlist (fill in
+                                              #   the justifications!)
+"""
+import argparse
+import json
+import os
+import sys
+
+# the sharded check engines need a multi-device mesh: pin the virtual
+# CPU mesh BEFORE jax initializes (same workaround as tests/conftest)
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report on stdout")
+    ap.add_argument("--fast", action="store_true",
+                    help="reuse cached program analyses while no "
+                         "paddle_tpu/ source changed (CI budget mode)")
+    ap.add_argument("--no-programs", action="store_true",
+                    help="skip program (jaxpr) analysis: AST + repo "
+                         "lints only")
+    ap.add_argument("--no-sharded", action="store_true",
+                    help="skip the sharded check engines")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline path (default: repo "
+                         "ANALYSIS_BASELINE.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write every current finding into the "
+                         "baseline (justification left as TODO) "
+                         "instead of gating")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.analysis import Baseline, render_text, runner
+
+    report = runner.run(
+        programs=not args.no_programs,
+        include_sharded=not args.no_sharded,
+        fast=args.fast,
+        baseline_path=args.baseline)
+
+    if args.write_baseline:
+        path = args.baseline or os.path.join(runner.repo_root(),
+                                             runner.BASELINE_NAME)
+        entries = []
+        seen = set()
+        for f in report["findings"]:
+            k = (f.rule, f.baseline_key)
+            if k in seen:
+                continue
+            seen.add(k)
+            entries.append({"rule": f.rule, "match": f.baseline_key,
+                            "justification": "TODO: justify or fix"})
+        Baseline(entries).save(path)
+        print(f"wrote {len(entries)} baseline entries to {path} — "
+              f"replace every TODO justification before committing")
+        return 0
+
+    if args.json:
+        json.dump({
+            "ok": report["ok"],
+            "cache": report["cache"],
+            "findings": [f.as_dict() for f in report["findings"]],
+            "new": [f.as_dict() for f in report["new"]],
+            "baselined": [f.as_dict() for f in report["baselined"]],
+            "stale_baseline": report["stale_baseline"],
+        }, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        n = len(report["findings"])
+        print(f"static_check: {n} finding(s) — "
+              f"{len(report['baselined'])} baselined (justified), "
+              f"{len(report['new'])} new"
+              + (f"  [program cache {report['cache']}]"
+                 if report["cache"] else ""))
+        if report["new"]:
+            print("NEW findings (fix, or baseline with a "
+                  "justification):")
+            print(render_text(report["new"]))
+        for e in report["stale_baseline"]:
+            print(f"stale baseline entry (matches nothing — delete "
+                  f"it): {e['rule']} {e['match']!r}")
+        print("PASS" if report["ok"] else "FAIL")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
