@@ -1693,6 +1693,144 @@ def _serving_paged(n_requests=40, d_model=64, nhead=2, ffn=128,
                        "max_new_tokens": "4..24 ragged (mean ~14)"}}
 
 
+def _serving_paged_spec(d_model=128, nhead=4, ffn=256, n_layers=2,
+                        vocab=512, mem_len=8, max_len=160,
+                        page_size=16, spec_k=8, ngram=2, max_new=96,
+                        pairs=5):
+    """Speculative decoding ON THE PAGED POOL: paged+spec vs
+    paged-plain at EQUAL cache memory (identical page pool both
+    sides), batch 1 and 8, copy-through workload — the regime where
+    draft-verify turns one-dispatch-per-token into two dispatches per
+    accepted run while the block table keeps live bytes tracking
+    actual tokens. Tokens are asserted BIT-IDENTICAL between the two
+    paged engines per request, both pools drain leak-free (allocator
+    free list back to initial), and the batch-1 acceptance rate must
+    clear a floor (the workload is the self-speculation sweet spot —
+    a collapsed acceptance means the paged verify path broke).
+    PAIRED per-pair ratio, alternating order inside pairs,
+    median-of-pairs (the repo's 1-core noise discipline)."""
+    import jax  # noqa: F401  (engine imports lazily)
+
+    from paddle_tpu import nn
+    from paddle_tpu.nn.layer.transformer import (TransformerDecoder,
+                                                 TransformerDecoderLayer)
+    from paddle_tpu.serving.engine import ServingEngine
+    from paddle_tpu.serving.paging import pages_for
+    from paddle_tpu.serving.scheduler import Request, Scheduler
+
+    layer = TransformerDecoderLayer(d_model, nhead, ffn, dropout=0.0)
+    dec = TransformerDecoder(layer, n_layers)
+    dec.eval()
+    embed = nn.Embedding(vocab, d_model)
+    proj = nn.Linear(d_model, vocab)
+
+    # equal cache memory: BOTH pools get the same page pool, sized so
+    # one slot can hold prompt + budget + the spec overhang
+    pages_per_slot = pages_for(max_len + spec_k, page_size)
+
+    def mk_engine(with_spec, slots):
+        kw = dict(spec_k=spec_k, spec_ngram=ngram) if with_spec else {}
+        return ServingEngine(dec, embed, proj, num_slots=slots,
+                             max_len=max_len, paged=True,
+                             page_size=page_size,
+                             num_pages=slots * pages_per_slot, **kw)
+
+    def serve(eng, prompt, n_req):
+        mem = np.random.RandomState(9).randn(
+            mem_len, d_model).astype("f4")
+        sched = Scheduler(max_queue=32)
+        reqs = [Request(prompt.copy(), mem, max_new_tokens=max_new,
+                        eos_id=1) for _ in range(n_req)]
+        for r in reqs:
+            sched.submit(r)
+        t0 = time.perf_counter()
+        eng.serve_until_idle(sched)
+        dt = time.perf_counter() - t0
+        toks = [list(r.result(timeout=5).tokens) for r in reqs]
+        return sum(len(t) for t in toks) / dt, toks
+
+    # copy-through prompt: the model's own greedy continuation (see
+    # decode_throughput.speculative) — templated/copy-through regime
+    rs = np.random.RandomState(3)
+    seed_prompt = np.zeros((8,), np.int32)
+    seed_prompt[1:] = np.tile(rs.randint(2, vocab, (4,)), 2)[:7]
+    seeder = mk_engine(False, 1)
+    _, seed_toks = serve(seeder, seed_prompt, 1)
+    prompt0 = np.zeros((33,), np.int32)
+    prompt0[1:] = seed_toks[0][:32]
+
+    out = {}
+    with _maybe_trace("serving_paged_spec") as trace_art:
+        for batch in (1, 8):
+            base = mk_engine(False, batch)
+            spec = mk_engine(True, batch)
+            serve(base, prompt0, batch)       # compile both paths
+            serve(spec, prompt0, batch)
+            ratios, spec_tps_s, base_tps_s = [], [], []
+            toks_b = toks_s = None
+            for i in range(pairs):
+                order = (base, spec) if i % 2 == 0 else (spec, base)
+                a_tps, a_toks = serve(order[0], prompt0, batch)
+                b_tps, b_toks = serve(order[1], prompt0, batch)
+                if order[0] is base:
+                    bt, st_, btk, stk = a_tps, b_tps, a_toks, b_toks
+                else:
+                    bt, st_, btk, stk = b_tps, a_tps, b_toks, a_toks
+                ratios.append(st_ / bt)
+                spec_tps_s.append(st_)
+                base_tps_s.append(bt)
+                toks_b, toks_s = btk, stk
+            if toks_b != toks_s:
+                raise AssertionError(
+                    "paged speculative decode diverged from the "
+                    "paged non-spec engine (greedy acceptance must "
+                    "be bit-exact)")
+            for eng in (base, spec):          # no page leaks
+                eng.flush_prefix_cache()
+                eng._alloc.check()
+                assert eng._alloc.pages_free == eng.num_pages, \
+                    (eng._alloc.pages_free, eng.num_pages)
+            ratios.sort()
+            med = ratios[len(ratios) // 2]
+            snap = spec.metrics.snapshot()["speculation"]
+            out[f"b{batch}"] = {
+                "spec_tok_per_s":
+                    round(sorted(spec_tps_s)[pairs // 2], 1),
+                "base_tok_per_s":
+                    round(sorted(base_tps_s)[pairs // 2], 1),
+                "speedup": round(med, 2),
+                "acceptance_rate": snap["acceptance_rate"],
+                "effective_k": snap["effective_k"],
+                "k_shrink_events": snap["k_shrink_events"],
+                "draft_step_ms_p50": snap["draft_step_ms"].get("p50"),
+                "verify_step_ms_p50":
+                    snap["verify_step_ms"].get("p50"),
+                "spread": _spread(ratios, kind="pairs")}
+    if out["b1"]["speedup"] < 1.3:
+        raise AssertionError(
+            f"paged speculative A/B below the 1.3x floor at batch 1: "
+            f"{out['b1']}")
+    if out["b1"]["acceptance_rate"] < 0.25:
+        raise AssertionError(
+            f"paged spec acceptance collapsed on the copy-through "
+            f"workload: {out['b1']}")
+    return {"metric": "serving_paged_spec",
+            "value": out["b1"]["speedup"],
+            "unit": "x tokens/s vs paged non-spec at equal cache "
+                    "memory (batch 1)",
+            **({} if trace_art[0] is None
+               else {"trace_artifact": trace_art[0]}),
+            **out,
+            "bit_match_asserted": True, "leak_free_asserted": True,
+            "config": {"spec_k": spec_k, "ngram": ngram,
+                       "max_new": max_new, "page_size": page_size,
+                       "pages_per_slot": pages_per_slot,
+                       "max_len": max_len,
+                       "workload": "copy-through prompt (the model's "
+                                   "own continuation), paged slot "
+                                   "pool"}}
+
+
 def _serving_sharded(n_requests=24, d_model=64, nhead=2, ffn=128,
                      n_layers=2, vocab=128, mem_len=4, max_new=10,
                      prompt_max=8, dense_slots=4, long_prompt=40,
@@ -2019,6 +2157,7 @@ def main():
                ("cold_start", _cold_start),
                ("serving_throughput", _serving_throughput),
                ("serving_paged", _serving_paged),
+               ("serving_paged_spec", _serving_paged_spec),
                ("serving_sharded", _serving_sharded),
                ("multichip_scaling", _multichip_scaling)]
     results = {}

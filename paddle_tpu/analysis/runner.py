@@ -107,8 +107,9 @@ def _local_mesh(dp=2):
 def build_check_engines(include_sharded=True):
     """[(label, engine)] throwaway tiny engines covering the program
     matrix: dense step, dense spec (draft + verify), paged (pjoin /
-    attach / cow / pstep) and — when >= 2 devices exist — sharded
-    disaggregated (join / step / prefill / splice)."""
+    attach / cow / pstep), paged spec (draft + pverify) and — when
+    >= 2 devices exist — sharded disaggregated (join / step /
+    prefill / splice)."""
     from ..serving import ServingEngine
 
     out = []
@@ -122,6 +123,10 @@ def build_check_engines(include_sharded=True):
     out.append(("paged", ServingEngine(dec, emb, proj, num_slots=4,
                                        max_len=32, paged=True,
                                        page_size=8)))
+    dec, emb, proj = _small_stack(seed=12)
+    out.append(("paged_spec", ServingEngine(
+        dec, emb, proj, num_slots=4, max_len=32, paged=True,
+        page_size=8, spec_k=4)))
     if include_sharded:
         mesh = _local_mesh(dp=2)
         if mesh is not None:

@@ -192,23 +192,40 @@ class MultiHeadAttention(Layer):
 
         qd, kd, vd = raw(q), raw(k), raw(v)
         b, h, s, d = qd.shape
-        if s != 1:
+        verify = s > 1 and A.in_kv_verify_scope()
+        if s != 1 and not verify:
             raise ValueError(
                 "PagedKVCache attention is decode-only (one query "
-                "token per slot); prefill goes through the join path")
+                "token per slot); prefill goes through the join path, "
+                "and a multi-token speculative verify block rides "
+                "ops.attention.kv_verify_scope")
         idx = raw(cache.index).astype(jnp.int32)
         table = raw(cache.table).astype(jnp.int32)
-        kp, ks = PG.write_token(cache.k, cache.k_scale, table, idx,
-                                kd[:, :, 0, :])
-        vp, vs = PG.write_token(cache.v, cache.v_scale, table, idx,
-                                vd[:, :, 0, :])
+        if verify:
+            # speculative verify block: the s tokens land at each
+            # slot's own offset, crossing page boundaries as they
+            # fall; the caller's acceptance logic rolls the per-slot
+            # index back afterwards (no page frees on reject)
+            kp, ks = PG.write_tokens(cache.k, cache.k_scale, table,
+                                     idx, kd)
+            vp, vs = PG.write_tokens(cache.v, cache.v_scale, table,
+                                     idx, vd)
+        else:
+            kp, ks = PG.write_token(cache.k, cache.k_scale, table, idx,
+                                    kd[:, :, 0, :])
+            vp, vs = PG.write_token(cache.v, cache.v_scale, table, idx,
+                                    vd[:, :, 0, :])
         new_cache = PG.PagedKVCache(kp, vp, ks, vs, table,
-                                    (idx + 1).astype(jnp.int32))
+                                    (idx + s).astype(jnp.int32))
         mask = None if attn_mask is None else raw(attn_mask)
         if mask is not None and mask.ndim > 2:
             mask = mask.reshape(mask.shape[0], mask.shape[-1])
-        out = A.paged_decode_attention(qd, kp, vp, ks, vs, table,
-                                       idx + 1, bias=mask)
+        if verify:
+            out = A.paged_verify_attention(qd, kp, vp, ks, vs, table,
+                                           idx + s, bias=mask)
+        else:
+            out = A.paged_decode_attention(qd, kp, vp, ks, vs, table,
+                                           idx + 1, bias=mask)
         out = jnp.swapaxes(out, 1, 2).reshape(b, s, h * d)
         return Tensor._wrap(out), new_cache
 

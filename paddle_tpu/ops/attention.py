@@ -1923,6 +1923,203 @@ def paged_decode_attention(q, k_pages, v_pages, k_scale, v_scale, table,
         "out")
 
 
+# --------------------------------------------------------------------------
+# paged verify attention: a k-token draft-verify block against a paged
+# KV cache — the speculative-decoding step of the paged serving pool
+# --------------------------------------------------------------------------
+
+def _paged_verify_heuristic():
+    """Hand-picked dispatch config for `paged_verify_attention`: the
+    scalar-prefetch kernel on, gather-fallback split untouched (0 =
+    let `verify_attention` pick). The committed-fallback source of
+    truth for the paged_flash_verify tuning-table entries."""
+    return {"kernel": True, "split_k": 0}
+
+
+def _paged_flash_verify_call(S, h, mp, psz, d, T, s, has_scale,
+                             has_bias, interpret):
+    """The paged split-K verify kernel: `_paged_flash_decode_call`'s
+    grid — one step per (slot*head, logical page), each K/V BlockSpec
+    index map dereferencing the scalar-prefetched table to pick the
+    physical page row to DMA, int8 dequant in-kernel — with
+    `_flash_verify_call`'s (T, d) query block and causal-within-the-
+    block masking: key position j stays visible to query row i only
+    while j <= the row's absolute position (n_valid - T + i). Per-page
+    partial (acc, m, l) merge in XLA with the standard logsumexp
+    combine."""
+    import jax
+    import jax.numpy as jnp
+
+    pl = _import_pallas()
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(tbl_ref, len_ref, *refs):
+        refs = list(refs)
+        q_ref, k_ref, v_ref = refs[:3]
+        refs = refs[3:]
+        if has_scale:
+            ks_ref, vs_ref = refs[:2]
+            refs = refs[2:]
+        if has_bias:
+            bias_ref = refs[0]
+            refs = refs[1:]
+        o_ref, m_ref, l_ref = refs
+        bh = pl.program_id(0)
+        pi = pl.program_id(1)
+        start = pi * jnp.int32(psz)
+        n_valid = len_ref[bh // jnp.int32(h)]
+
+        # every query sees keys < n_valid only, so pages entirely past
+        # the written region contribute an exact zero to the combine
+        @pl.when(start < n_valid)
+        def _compute():
+            sf = jnp.float32(s)
+            qb = q_ref[...].astype(jnp.float32) * sf      # (T, d)
+            kb = k_ref[...].astype(jnp.float32)           # (psz, d)
+            vb = v_ref[...].astype(jnp.float32)
+            if has_scale:
+                kb = kb * ks_ref[0, 0]                    # dequantize
+                vb = vb * vs_ref[0, 0]                    # in-kernel
+            logits = jnp.dot(qb, kb.T,
+                             preferred_element_type=jnp.float32)
+            kpos = start + jax.lax.broadcasted_iota(
+                jnp.int32, (T, psz), 1)
+            qpos = (n_valid - jnp.int32(T)) + jax.lax.broadcasted_iota(
+                jnp.int32, (T, psz), 0)
+            logits = jnp.where(kpos <= qpos, logits,
+                               jnp.float32(-1e30))
+            if has_bias:
+                logits = logits + bias_ref[...][:, 0][None, :]
+            m = logits.max(axis=-1, keepdims=True)        # (T, 1)
+            p = jnp.exp(logits - m)
+            # a query row fully masked within an active page (its
+            # position precedes the page) leaves m = -1e30; the XLA
+            # combine's alpha flushes that page's contribution to an
+            # exact zero — every row's own position guarantees some
+            # page holds a finite m
+            l = p.sum(axis=-1, keepdims=True)
+            o_ref[...] = jnp.dot(p, vb,
+                                 preferred_element_type=jnp.float32)
+            m_ref[...] = m
+            l_ref[...] = l
+
+        @pl.when(start >= n_valid)
+        def _skip():
+            o_ref[...] = jnp.zeros((T, d), jnp.float32)
+            m_ref[...] = jnp.full((T, 1), -1e30, jnp.float32)
+            l_ref[...] = jnp.zeros((T, 1), jnp.float32)
+
+    def page_ix(bh, pi, tbl, lens):
+        return (tbl[bh // jnp.int32(h), pi], bh % jnp.int32(h),
+                _z(), _z())
+
+    in_specs = [
+        pl.BlockSpec((None, T, d), lambda bh, pi, *_: (bh, _z(), _z())),
+        pl.BlockSpec((None, None, psz, d), page_ix),
+        pl.BlockSpec((None, None, psz, d), page_ix),
+    ]
+    if has_scale:
+        in_specs.append(pl.BlockSpec((None, None, 1, 1), page_ix))
+        in_specs.append(pl.BlockSpec((None, None, 1, 1), page_ix))
+    if has_bias:
+        # bias lives in LOGICAL per-slot coordinates [S, L, 1]: block
+        # by (slot, logical page), no table dereference
+        in_specs.append(pl.BlockSpec(
+            (None, psz, 1),
+            lambda bh, pi, *_: (bh // jnp.int32(h), pi, _z())))
+    out_specs = [
+        pl.BlockSpec((None, None, T, d),
+                     lambda bh, pi, *_: (bh, pi, _z(), _z())),
+        pl.BlockSpec((None, None, T, 1),
+                     lambda bh, pi, *_: (bh, pi, _z(), _z())),
+        pl.BlockSpec((None, None, T, 1),
+                     lambda bh, pi, *_: (bh, pi, _z(), _z())),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((S * h, mp, T, d), jnp.float32),
+        jax.ShapeDtypeStruct((S * h, mp, T, 1), jnp.float32),
+        jax.ShapeDtypeStruct((S * h, mp, T, 1), jnp.float32),
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2, grid=(S * h, mp),
+        in_specs=in_specs, out_specs=out_specs)
+    return pl.pallas_call(kernel, grid_spec=grid_spec,
+                          out_shape=out_shape, interpret=interpret)
+
+
+def paged_flash_verify(q, k_pages, v_pages, k_scale, v_scale, table,
+                       length, bias=None, scale=None, interpret=False):
+    """Pallas paged verify: T query tokens per slot (the pending token
+    plus T-1 drafts, just written through the page table at each
+    slot's own offset) against K/V read THROUGH the table — no dense
+    materialization. q [S, h, T, d]; pages [N+1, h, psz, d] (+1 =
+    trash row); table [S, max_pages] int32 (trash-clipped); length [S]
+    written counts AFTER the T-token write; k_scale/v_scale optional
+    [N+1, h, 1, 1] per-page dequant scales; bias optional [S, L]
+    additive key bias in logical coordinates."""
+    import jax.numpy as jnp
+
+    S, h, T, d = q.shape
+    mp = table.shape[1]
+    psz = k_pages.shape[2]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    call = _paged_flash_verify_call(S, h, mp, psz, d, T, s,
+                                    k_scale is not None,
+                                    bias is not None, interpret)
+    args = [q.reshape(S * h, T, d), k_pages, v_pages]
+    if k_scale is not None:
+        args += [k_scale, v_scale]
+    if bias is not None:
+        args.append(jnp.asarray(bias, jnp.float32)[:, :, None])
+    acc, m, l = call(jnp.asarray(table, jnp.int32),
+                     jnp.asarray(length, jnp.int32), *args)
+    m_star = m.max(axis=1, keepdims=True)
+    alpha = jnp.exp(m - m_star)
+    num = (acc * alpha).sum(axis=1)                # [S*h, T, d]
+    den = jnp.maximum((l * alpha).sum(axis=1), 1e-30)
+    return (num / den).astype(q.dtype).reshape(S, h, T, d)
+
+
+def paged_verify_attention(q, k_pages, v_pages, k_scale, v_scale,
+                           table, length, bias=None, scale=None,
+                           interpret=False):
+    """Paged verify-attention dispatch: the page-table pallas kernel on
+    TPU (or under interpret=True for CPU parity tests); elsewhere
+    gather the pages into the dense logical view and run the exact
+    `verify_attention` composition — with same-dtype pages the
+    gathered buffer reproduces the dense StaticKVCache bit-for-bit,
+    which keeps paged speculative serving bit-identical to the dense
+    pool on the fallback path. The tuned table's (kernel, split_k)
+    ladder picks the path and the gather-side split factor."""
+    psz = k_pages.shape[2]
+    T = q.shape[2]
+    q = _constrain_decode(q, "q")
+    k_pages = _constrain_decode(k_pages, "pages")
+    v_pages = _constrain_decode(v_pages, "pages")
+    cfg = _tuned("paged_flash_verify",
+                 (q.shape[-1], psz, str(k_pages.dtype), int(T)))
+    if cfg is None:
+        cfg = _paged_verify_heuristic()
+    use_kernel = interpret or (
+        _on_tpu() and q.shape[-1] <= 256 and psz % 8 == 0
+        and _flash_usable() and bool(cfg.get("kernel", True)))
+    if use_kernel:
+        try:
+            return _constrain_decode(
+                paged_flash_verify(q, k_pages, v_pages, k_scale,
+                                   v_scale, table, length, bias,
+                                   scale, interpret), "out")
+        except Exception:
+            if interpret:
+                raise
+    kd = paged_gather_kv(k_pages, k_scale, table, q.dtype)
+    vd = paged_gather_kv(v_pages, v_scale, table, q.dtype)
+    split = int(cfg.get("split_k", 0)) or None
+    return _constrain_decode(
+        verify_attention(q, kd, vd, length, bias, scale,
+                         split_k=split), "out")
+
+
 def sdpa(q, k, v, mask=None, is_causal=False, scale=None,
          dropout_p=0.0, dropout_key=None, segment_ids=None):
     """Dispatch: pallas flash fwd+bwd on TPU whenever the mask reduces to

@@ -264,7 +264,17 @@ class _EngineBase:
     #: the watchdog) consumed the carry, so its retry fails loudly and
     #: lands in the existing all-or-nothing recovery (_fail_active ->
     #: _reset_pool) rather than re-running on stale state.
-    _DONATED_KINDS = {"step": 2, "sstep": 2, "pstep": 2}
+    _DONATED_KINDS = {"step": 2, "sstep": 2, "pstep": 2, "pverify": 2}
+
+    def _program(self, key, build):
+        """Get-or-build a compiled program from the observed jit
+        cache: a miss stores `build()`'s result and returns the
+        observing wrapper, so every trace surfaces as a compile span."""
+        fn = self._compiled.get(key)
+        if fn is None:
+            self._compiled[key] = build()
+            fn = self._compiled[key]   # the observed wrapper
+        return fn
 
     def _donate_argnums(self, key):
         """donate_argnums for the program at `key` (() = donate
@@ -684,12 +694,16 @@ class ServingEngine(_EngineBase):
                  max_len=128, max_joins_per_iter=2, metrics=None,
                  callbacks=(), clock=time.monotonic,
                  eager_fallback=False, paged=False, spec_k=None,
-                 spec_ngram=2, **kw):
+                 spec_ngram=2, spec_adapt=True, spec_adapt_low=0.15,
+                 spec_adapt_high=0.6, spec_adapt_patience=4,
+                 spec_adapt_alpha=0.3, **kw):
         super().__init__(num_slots, max_joins_per_iter=max_joins_per_iter,
                          metrics=metrics, callbacks=callbacks, clock=clock,
                          **kw)
         from ..parallel.functional import functionalize
         from ..text.generation import _StepNet
+        from .layers import (DenseLayout, PagedLayout, PlainStepper,
+                             SpecStepper)
 
         self.eager_fallback = bool(eager_fallback)
         self.max_len = int(max_len)
@@ -699,20 +713,34 @@ class ServingEngine(_EngineBase):
         # bit-identical tokens, fewer dispatches. The pool carries
         # spec_k extra cache positions so a round's fixed-k verify
         # write never clips (admission keeps the max_len contract).
+        # Works on EVERY pool layout: the paged pool's verify rides
+        # multi-token page writes + the block-table verify kernel.
         if spec_k is not None:
             spec_k = int(spec_k)
             if spec_k < 2:
                 raise ValueError("spec_k must be >= 2 (the pending "
                                  "token plus at least one draft)")
-            if isinstance(self, PagedServingEngine):
-                raise NotImplementedError(
-                    "speculative decoding is not wired through the "
-                    "paged pool yet (multi-token page writes + paged "
-                    "verify attention are a follow-up); use the dense "
-                    "ServingEngine for spec_k")
         self.spec_k = spec_k
         self.spec_ngram = int(spec_ngram)
+        # adaptive effective k: shrink/regrow the live draft depth
+        # batch-wide on the acceptance-rate EMA with hysteresis (the
+        # force-rejected tail rides the same fixed-k program, so a k
+        # change NEVER retraces); see layers.SpecStepper
+        self.spec_adapt = bool(spec_adapt)
+        self.spec_adapt_low = float(spec_adapt_low)
+        self.spec_adapt_high = float(spec_adapt_high)
+        self.spec_adapt_patience = int(spec_adapt_patience)
+        self.spec_adapt_alpha = float(spec_adapt_alpha)
         self._pool_len = self.max_len + (spec_k or 0)
+        # the composable pool layers (serving/layers.py): cache layout
+        # x placement x stepper — every program body lives there, the
+        # engine classes are configuration shims
+        self.layout = (PagedLayout(self)
+                       if isinstance(self, PagedServingEngine)
+                       else DenseLayout(self))
+        self.placement = self._make_placement()
+        self.stepper = (SpecStepper(self) if self.spec_k
+                        else PlainStepper(self))
         self._net = _StepNet(decoder, embed, project)
         self._fm = functionalize(self._net)
         if not getattr(self, "_accepts_sharded_params", False):
@@ -734,6 +762,23 @@ class ServingEngine(_EngineBase):
         self.metrics.set_memory_provider(self.memory_ledger)
 
     # ------------------------------------------------------------------
+    def _make_placement(self):
+        """The program-build strategy (layers.py): plain single-chip
+        jit here; the sharded engine overrides with the mesh-annotated
+        wrap."""
+        from .layers import SinglePlacement
+
+        return SinglePlacement(self)
+
+    def _pool_variant(self):
+        """Label for per-pool-variant metric splits (the speculation
+        section's step-ms breakdown)."""
+        base = "paged" if isinstance(self, PagedServingEngine) \
+            else "dense"
+        if getattr(self, "_accepts_sharded_params", False):
+            return "sharded-" + base
+        return base
+
     def _params(self):
         """Param pytree the compiled programs run over. The sharded
         engine overrides this with its mesh-placed copy."""
@@ -796,21 +841,29 @@ class ServingEngine(_EngineBase):
     def _step_cost_key(self):
         if self._pool_key is None:
             return None
-        return ("step",) + self._pool_key
+        return self.layout.step_key() if not self.spec_k \
+            else self.layout.spec_step_key()
 
     def cost_hint(self, key):
         kind = key[0] if isinstance(key, tuple) and key else key
         n_params, n_layers, heads, hd, M = self._model_dims()
         pool = self.pool_bytes()
         w = self.weights_bytes()
-        if kind in ("step", "pstep"):
+        if kind in ("step", "pstep", "sstep", "pverify"):
             # the compiled step computes ALL S rows over the full
-            # (masked) max_len window, active or not
+            # (masked) max_len window, active or not; the k-token
+            # verify step feeds spec_k query rows through the same net
             flops = _costs.transformer_decode_flops(
                 n_params, self.num_slots, self.max_len, n_layers,
                 heads, hd, mem_len=M)
+            if kind in ("sstep", "pverify"):
+                flops *= (self.spec_k or 1)
             return {"flops": flops, "bytes_accessed": w + pool,
                     "argument_bytes": w + pool}
+        if kind == "draft":
+            # pure gathers over the [S, L] token mirror — byte traffic
+            return {"flops": 0.0, "bytes_accessed": pool,
+                    "argument_bytes": pool}
         if kind in ("join", "pjoin", "prefill") and len(key) > 1:
             Pb = int(key[1])
             flops = _costs.transformer_prefill_flops(
@@ -843,42 +896,17 @@ class ServingEngine(_EngineBase):
     def _ensure_state(self, memory):
         if self._state is not None:
             return
-        import jax.numpy as jnp
-
         from ..text.generation import NEG
 
-        decoder = self._net.decoder
-        M, Dm = memory.shape
-        dtype = jnp.asarray(np.asarray(memory)).dtype
-        S, L = self.num_slots, self._pool_len
-        inc = [layer.self_attn.gen_cache(None, max_length=L,
-                                         batch_size=S, dtype=dtype)
-               for layer in decoder.layers]
-        static = []
-        for layer in decoder.layers:
-            z = jnp.zeros((S, layer.cross_attn.num_heads, M,
-                           layer.cross_attn.head_dim), dtype)
-            static.append((z, z))
-        self._state = {
-            "tok": jnp.zeros((S,), jnp.int32),
-            "bias": jnp.zeros((S, L), jnp.float32),
-            "mem": jnp.zeros((S, M, Dm), dtype),
-            "inc": inc,
-            "static": static,
-        }
-        if self.spec_k:
-            # the n-gram draft source's token mirror of the cache, plus
-            # each slot's true prompt length / bucket for the logical
-            # (hole-skipping) history view
-            self._state["hist"] = jnp.zeros((S, L), jnp.int32)
-            self._state["plen"] = jnp.zeros((S,), jnp.int32)
-            self._state["pbk"] = jnp.zeros((S,), jnp.int32)
-        self._mem_shape = (M, Dm)
-        self._np_dtype = np.dtype(str(dtype))
-        self._pool_key = (S, L, M, Dm, str(dtype)) + \
-            ((("spec", self.spec_k, self.spec_ngram),)
-             if self.spec_k else ())
+        memory = np.asarray(memory)
         self._neg = float(NEG)
+        self._state = self.layout.build_state(memory)
+        self._mem_shape = tuple(memory.shape)
+        self._np_dtype = np.dtype(str(self._state["mem"].dtype))
+        self._pool_key = self.layout.pool_key(memory)
+        self._post_state_build()
+
+    def _post_state_build(self):
         if self.metrics.budget_bytes > 0:
             # the dense pool commits its whole footprint up front:
             # check the watermark the moment it exists
@@ -895,12 +923,7 @@ class ServingEngine(_EngineBase):
         prompt_b, P0, Pb = pad_prompt_row(r.prompt, pad_id)
         if r._trace is not None:
             _rt.on_join_attr(r, prompt_bucket=Pb)
-        key = ("join", Pb)
-        fn = self._compiled.get(key)
-        if fn is None:
-            fn = self._build_join(Pb)
-            self._compiled[key] = fn
-            fn = self._compiled[key]   # the observed wrapper
+        fn = self._program(("join", Pb), lambda: self._build_join(Pb))
         self._state, tok0 = fn(
             self._params(), self._buffers(), self._state,
             jnp.int32(s), jnp.asarray(prompt_b),
@@ -909,76 +932,12 @@ class ServingEngine(_EngineBase):
         return int(tok0)
 
     def _build_join(self, Pb):
-        import jax
-
-        return jax.jit(self._join_body(Pb))
-
-    def _join_body(self, Pb):
-        """The traceable join program (prefill + splice), separated
-        from its jit wrapper so the sharded engine can wrap the same
-        body in sharding annotations before jitting — one source of
-        truth for the math, one trace_counts key either way."""
-        import jax
-        import jax.numpy as jnp
-
-        from ..nn.layer.transformer import MultiHeadAttention as MHA
-
-        fm = self._fm
-        decoder = self._net.decoder
-        L = self._pool_len
-        spec = bool(self.spec_k)
-        key = ("join", Pb)
-        neg = self._neg
-
-        def join_fn(params, buffers, state, slot, prompt, length,
-                    memory):
-            self.trace_counts[key] += 1  # python side effect: one per
-            #                              trace = one per compile
-            kpos = jnp.arange(L, dtype=jnp.int32)
-            hole = (kpos[None, :] >= length[:, None]) & \
-                (kpos[None, :] < jnp.int32(Pb))
-            bias_row = jnp.where(hole, jnp.float32(neg),
-                                 jnp.float32(0.0))           # [1, L]
-            positions = jnp.arange(Pb, dtype=jnp.int32)[None]
-            inc0 = [layer.self_attn.gen_cache(
-                None, max_length=Pb, batch_size=1, dtype=memory.dtype)
-                for layer in decoder.layers]
-            (lg, inc1, static1), _ = fm.apply(
-                params, buffers, None, prompt, positions, memory,
-                training=False, tgt_mask=bias_row[:, :Pb],
-                memory_mask=None, inc=inc0, prefill=True)
-            # token 0 conditions on the row's LAST REAL prompt position
-            last = jnp.take_along_axis(
-                lg, (length - 1)[:, None, None], axis=1)[:, 0]
-            tok0 = last.argmax(-1).astype(jnp.int32)[0]
-            new_inc = [MHA.static_kv_splice(pool, slot, c.k, c.v,
-                                            jnp.int32(Pb))
-                       for pool, c in zip(state["inc"], inc1)]
-            new_static = [(MHA.splice_rows(pk, slot, sk),
-                           MHA.splice_rows(pv, slot, sv))
-                          for (pk, pv), (sk, sv) in zip(state["static"],
-                                                        static1)]
-            new_state = {
-                "tok": jax.lax.dynamic_update_slice(
-                    state["tok"], tok0[None], (slot,)),
-                "bias": MHA.splice_rows(state["bias"], slot, bias_row),
-                "mem": MHA.splice_rows(state["mem"], slot, memory),
-                "inc": new_inc,
-                "static": new_static,
-            }
-            if spec:
-                hist_row = jnp.concatenate(
-                    [prompt, jnp.zeros((1, L - Pb), jnp.int32)], 1)
-                new_state["hist"] = MHA.splice_rows(
-                    state["hist"], slot, hist_row)
-                new_state["plen"] = jax.lax.dynamic_update_slice(
-                    state["plen"], length.astype(jnp.int32), (slot,))
-                new_state["pbk"] = jax.lax.dynamic_update_slice(
-                    state["pbk"], jnp.full((1,), Pb, jnp.int32),
-                    (slot,))
-            return new_state, tok0
-
-        return join_fn
+        """Every program build is `placement.build(layout body)`: one
+        source of truth for the math in layers.py, one trace_counts
+        key whichever placement wraps it."""
+        key = self.layout.join_key(Pb)
+        return self.placement.build(key, self.layout.join_body(Pb),
+                                    has_aux=True)
 
     def _reset_pool(self):
         # dropped wholesale: the next join's _ensure_state rebuilds a
@@ -1041,123 +1000,26 @@ class ServingEngine(_EngineBase):
 
     # ------------------------------------------------------------------
     def _decode_step(self, active):
-        import jax.numpy as jnp
-
-        if self.spec_k:
-            return self._spec_decode_step(active)
-        key = ("step",) + self._pool_key
-        fn = self._compiled.get(key)
-        if fn is None:
-            fn = self._build_step(key)
-            self._compiled[key] = fn
-            fn = self._compiled[key]   # the observed wrapper
-        self._state, toks = fn(self._params(), self._buffers(),
-                               self._state, jnp.asarray(active))
-        return np.asarray(toks)
+        # plain vs speculative is the Stepper axis (layers.py): one
+        # batched step, or the draft + k-token-verify pair with the
+        # adaptive effective-k controller
+        return self.stepper.decode(active)
 
     def _build_step(self, key):
-        import jax
+        return self.placement.build(key, self.layout.step_body(key),
+                                    has_aux=True)
 
-        return jax.jit(self._step_body(key),
-                       donate_argnums=self._donate_argnums(key))
-
-    def _step_body(self, key):
-        import jax.numpy as jnp
-
-        from ..nn.layer.transformer import MultiHeadAttention as MHA
-
-        fm = self._fm
-
-        def step_fn(params, buffers, state, active):
-            self.trace_counts[key] += 1  # one per trace = one compile
-            inc = state["inc"]
-            posn = inc[0].index[:, None]  # per-SLOT written counts
-            (lg, inc2), _ = fm.apply(
-                params, buffers, None, state["tok"][:, None], posn,
-                state["mem"], training=False, tgt_mask=state["bias"],
-                memory_mask=None, inc=inc, static_kv=state["static"],
-                prefill=False)
-            nxt = lg[:, 0].argmax(-1).astype(jnp.int32)
-            nxt = jnp.where(active, nxt, state["tok"])
-            # inactive slots must not creep their write index: their
-            # (masked, garbage) write this step gets overwritten before
-            # it can ever become visible, but the index itself must
-            # stay put so an idle slot never marches toward max_len
-            inc2 = [MHA.StaticKVCache(
-                c.k, c.v, jnp.where(active, c.index, old.index))
-                for c, old in zip(inc2, inc)]
-            return dict(state, tok=nxt, inc=inc2), nxt
-
-        return step_fn
-
-    # ---- speculative decode: draft program + k-token verify program ----
-    def _spec_decode_step(self, active):
-        """One speculative iteration over the pool: (1) the DRAFT
-        program proposes spec_k - 1 tokens per slot by n-gram
-        self-speculation over each slot's own history (pure jnp, no
-        model weights); (2) the VERIFY program runs one spec_k-token
-        step through the model at each row's own cache offset, accepts
-        the matching draft prefix, rolls the per-row write indices back
-        and returns (emit [S, k], n_emit [S]) — run_iteration delivers
-        up to spec_k bit-exact tokens per slot. Two host dispatches
-        instead of one-per-token; compiled once per pool config."""
-        import jax
-        import jax.numpy as jnp
-
-        spec_on = np.asarray(
-            [r is not None and getattr(r, "spec", True)
-             for r in self.slots], bool)
-        dkey = ("draft",) + self._pool_key
-        fn = self._compiled.get(dkey)
-        if fn is None:
-            fn = self._build_draft(dkey)
-            self._compiled[dkey] = fn
-            fn = self._compiled[dkey]   # the observed wrapper
-        t0 = time.perf_counter()
-        st = self._state
-        drafts = fn(st["hist"], st["tok"], st["plen"], st["pbk"],
-                    st["inc"][0].index)
-        jax.block_until_ready(drafts)
-        t1 = time.perf_counter()
-        vkey = ("sstep",) + self._pool_key
-        fn = self._compiled.get(vkey)
-        if fn is None:
-            fn = self._build_spec_step(vkey)
-            self._compiled[vkey] = fn
-            fn = self._compiled[vkey]   # the observed wrapper
-        self._state, (emit, n_emit) = fn(
-            self._params(), self._buffers(), self._state, drafts,
-            jnp.asarray(active), jnp.asarray(spec_on))
-        emit = np.asarray(emit)
-        n_emit = np.asarray(n_emit)
-        t2 = time.perf_counter()
-        on = active & spec_on
-        proposed = int(on.sum()) * (self.spec_k - 1)
-        accepted = int(np.maximum(n_emit[on] - 1, 0).sum()) \
-            if on.any() else 0
-        self.metrics.record_spec_step(
-            int(active.sum()), proposed, accepted, t1 - t0, t2 - t1)
-        if _trace._SESSION is not None:
-            _rt.on_spec_step(t0, t1, t2, int(active.sum()), proposed,
-                             accepted)
-        return emit, n_emit
+    def _build_spec_step(self, vkey):
+        return self.placement.build(
+            vkey, self.layout.spec_step_body(vkey), has_aux=True)
 
     def _build_draft(self, dkey):
+        # pure gathers over per-slot rows; under a mesh the SPMD
+        # partitioner follows the operand layouts, no pinning needed —
+        # every placement builds it plain
         import jax
 
-        return jax.jit(self._draft_body(dkey))
-
-    def _draft_body(self, dkey):
-        from ..text import speculative as SP
-
-        k, ngram = self.spec_k, self.spec_ngram
-
-        def draft_fn(hist, tok, plen, pbk, index):
-            self.trace_counts[dkey] += 1  # one per trace = one compile
-            return SP.ngram_propose(hist, tok, plen, pbk, k - 1,
-                                    index - pbk, ngram)
-
-        return draft_fn
+        return jax.jit(self.layout.draft_body(dkey))
 
     # ------------------------------------------------------------------
     # zero-warmup startup: AOT precompile + persistent cache
@@ -1220,65 +1082,13 @@ class ServingEngine(_EngineBase):
                 vkey, lambda vkey=vkey: self._build_spec_step(vkey),
                 (params, buffers, state,
                  jnp.zeros((S, self.spec_k - 1), jnp.int32), active,
-                 active)))
+                 active, jnp.int32(self.spec_k))))
         else:
             skey = ("step",) + self._pool_key
             progs.append((
                 skey, lambda skey=skey: self._build_step(skey),
                 (params, buffers, state, active)))
         return progs
-
-    def _build_spec_step(self, vkey):
-        import jax
-
-        return jax.jit(self._spec_step_body(vkey),
-                       donate_argnums=self._donate_argnums(vkey))
-
-    def _spec_step_body(self, vkey):
-        import jax.numpy as jnp
-
-        from ..nn.layer.transformer import MultiHeadAttention as MHA
-        from ..ops import attention as A
-        from ..text import speculative as SP
-        from ..text.decode import greedy_accept
-
-        fm = self._fm
-        k = self.spec_k
-
-        def step_fn(params, buffers, state, drafts, active, spec_on):
-            self.trace_counts[vkey] += 1  # one per trace = one compile
-            inc = state["inc"]
-            idx0 = inc[0].index
-            # a spec=False slot's drafts are forced unmatched (-1 never
-            # equals a vocab token), so it accepts exactly one oracle
-            # token per step — the plain decode semantics on the same
-            # compiled program
-            drafts = jnp.where(spec_on[:, None], drafts, -1)
-            fed = jnp.concatenate([state["tok"][:, None], drafts], 1)
-            posn = idx0[:, None] + jnp.arange(k, dtype=jnp.int32)[None]
-            with A.kv_verify_scope():
-                (lg, inc2), _ = fm.apply(
-                    params, buffers, None, fed, posn, state["mem"],
-                    training=False, tgt_mask=state["bias"],
-                    memory_mask=None, inc=inc,
-                    static_kv=state["static"], prefill=False)
-            preds = lg.argmax(-1).astype(jnp.int32)
-            n_match, emit = greedy_accept(drafts, preds)
-            n_emit = jnp.where(active, n_match + 1, 0).astype(jnp.int32)
-            # acceptance rollback on active rows, index pin on the rest
-            # (the same inactive-slot contract as the plain step)
-            new_idx = SP.rollback_index(inc2[0].index, k, n_match,
-                                        active)
-            inc3 = [MHA.StaticKVCache(c.k, c.v, new_idx) for c in inc2]
-            corr = jnp.take_along_axis(preds, n_match[:, None],
-                                       axis=1)[:, 0]
-            nxt = jnp.where(active, corr, state["tok"])
-            new_state = dict(
-                state, tok=nxt, inc=inc3,
-                hist=SP.write_hist(state["hist"], fed, idx0))
-            return new_state, (emit, n_emit)
-
-        return step_fn
 
 
 def _make_cross_kv_fm(decoder):
@@ -1348,7 +1158,13 @@ class PagedServingEngine(ServingEngine):
         super().__init__(decoder, embed, project, num_slots=num_slots,
                          max_len=max_len, **kw)
         self.page_size = page_size
-        self.max_pages = self.max_len // page_size
+        # a speculative pool writes up to spec_k tokens past a row's
+        # admitted budget before rolling back — round the logical pool
+        # length (and the table width) up to page-cover that overhang;
+        # admission still enforces the max_len contract
+        self._pool_len = pages_for(self.max_len + (self.spec_k or 0),
+                                   page_size) * page_size
+        self.max_pages = self._pool_len // page_size
         self.num_pages = (int(num_pages) if num_pages is not None
                           else self.num_slots * self.max_pages)
         self.kv_dtype = kv_dtype
@@ -1400,60 +1216,33 @@ class PagedServingEngine(ServingEngine):
             return total
         return total - self._alloc.pages_free * self._page_bytes
 
-    def _step_cost_key(self):
-        if self._pool_key is None:
-            return None
-        return ("pstep",) + self._pool_key
+
+    def _spec_overhang(self):
+        """Cache positions a speculative verify may write past a row's
+        accepted budget before the rollback (the force-rejected tail):
+        admission and the per-slot page reservations must cover them."""
+        return (self.spec_k - 1) if self.spec_k else 0
 
     def admit_check(self, r):
         super().admit_check(r)
         # liveness: a request the whole (empty) pool could never hold
         # must fail fast, not defer at the backpressure gate forever
         P = max(1, int(r.prompt.shape[0]))
-        need = pages_for(bucket_size(P) + r.max_new_tokens,
-                         self.page_size)
+        need = pages_for(bucket_size(P) + r.max_new_tokens +
+                         self._spec_overhang(), self.page_size)
         if need > self.num_pages:
             raise ValueError(
                 f"request needs {need} pages > pool num_pages "
                 f"{self.num_pages} ({self.page_size}-token pages)")
 
-    def _ensure_state(self, memory):
-        if self._state is not None:
-            return
+    def _post_state_build(self):
         import jax.numpy as jnp
 
-        from ..text.generation import NEG
         from .paging import resolve_kv_dtype
 
         decoder = self._net.decoder
-        M, Dm = memory.shape
-        dtype = jnp.asarray(np.asarray(memory)).dtype
-        S, L = self.num_slots, self.max_len
-        paged = []
-        for layer in decoder.layers:
-            c = layer.self_attn.gen_paged_cache(
-                self.num_pages, self.page_size, S, self.max_pages,
-                dtype, self.kv_dtype)
-            paged.append({"k": c.k, "v": c.v, "ks": c.k_scale,
-                          "vs": c.v_scale})
-        static = []
-        for layer in decoder.layers:
-            z = jnp.zeros((S, layer.cross_attn.num_heads, M,
-                           layer.cross_attn.head_dim), dtype)
-            static.append((z, z))
-        self._state = {
-            "tok": jnp.zeros((S,), jnp.int32),
-            "bias": jnp.zeros((S, L), jnp.float32),
-            "mem": jnp.zeros((S, M, Dm), dtype),
-            "static": static,
-            "paged": paged,
-        }
-        self._mem_shape = (M, Dm)
-        self._np_dtype = np.dtype(str(dtype))
-        self._pool_key = (S, L, M, Dm, str(dtype), self.page_size,
-                          self.num_pages, str(self.kv_dtype))
-        self._neg = float(NEG)
-        storage, quantized = resolve_kv_dtype(self.kv_dtype, dtype)
+        storage, quantized = resolve_kv_dtype(
+            self.kv_dtype, jnp.dtype(self._np_dtype))
         h0 = decoder.layers[0].self_attn
         per_buf = h0.num_heads * self.page_size * h0.head_dim \
             * jnp.dtype(storage).itemsize
@@ -1523,7 +1312,8 @@ class PagedServingEngine(ServingEngine):
                 # shared pages are free; only a COW of the partial
                 # tail page (when the bucket ends mid-page) is new
                 need_prompt = 1 if Pb % self.page_size else 0
-        total = pages_for(Pb + r.max_new_tokens, self.page_size)
+        total = pages_for(Pb + r.max_new_tokens +
+                          self._spec_overhang(), self.page_size)
         reserve = int(np.ceil(
             self.reserve_decode_frac * (total - n_pp)))
         return need_prompt + reserve
@@ -1606,7 +1396,8 @@ class PagedServingEngine(ServingEngine):
         pad_id = int(r.eos_id) if r.eos_id is not None else 0
         prompt_b, P0, Pb = pad_prompt_row(r.prompt, pad_id)
         self._slot_pages_total[s] = pages_for(
-            Pb + r.max_new_tokens, self.page_size)
+            Pb + r.max_new_tokens + self._spec_overhang(),
+            self.page_size)
         hit = None
         if self._prefix is not None:
             key = self._prefix_key(prompt_b, P0, r)
@@ -1616,7 +1407,7 @@ class PagedServingEngine(ServingEngine):
             _rt.on_join_attr(r, prompt_bucket=Pb,
                              prefix_hit=hit is not None)
         if hit is not None:
-            return self._attach_shared(s, r, hit, P0, Pb)
+            return self._attach_shared(s, r, hit, prompt_b, P0, Pb)
         return self._prefill_join(
             s, r, prompt_b, P0, Pb,
             key if self._prefix is not None else None)
@@ -1627,12 +1418,8 @@ class PagedServingEngine(ServingEngine):
         _PT_PREFILL()
         n_pp = pages_for(Pb, self.page_size)
         pages = self._alloc_pages(n_pp)
-        ck = ("pjoin", Pb)
-        fn = self._compiled.get(ck)
-        if fn is None:
-            fn = self._build_paged_join(Pb)
-            self._compiled[ck] = fn
-            fn = self._compiled[ck]   # the observed wrapper
+        fn = self._program(("pjoin", Pb),
+                           lambda: self._build_paged_join(Pb))
         try:
             self._state, tok0 = fn(
                 self._params(), self._buffers(), self._state,
@@ -1652,30 +1439,39 @@ class PagedServingEngine(ServingEngine):
         self._cow_tail(s, Pb)
         return tok0
 
-    def _attach_shared(self, s, r, hit, P0, Pb):
+    def _attach_spec_rows(self, prompt_b, Pb):
+        """The spec history row an attach splices: the padded prompt
+        pre-padded host-side to the FULL pool length, so the attach
+        program stays one compile for every bucket."""
+        if not self.spec_k:
+            return ()
+        row = np.zeros((1, self._pool_len), np.int32)
+        row[0, :Pb] = np.asarray(prompt_b[0], np.int32)
+        import jax.numpy as jnp
+
+        return (jnp.asarray(row),)
+
+    def _attach_shared(self, s, r, hit, prompt_b, P0, Pb):
         """Prefix-cache hit: map the shared prompt pages read-only and
         splice only the per-request rows (bias hole, memory, cross-attn
-        K/V, cached first token) — ZERO self-attention prefill FLOPs
-        for the shared pages. One compiled program for every bucket
-        (the bucket boundary rides in as a traced scalar)."""
+        K/V, cached first token, and the spec history mirror) — ZERO
+        self-attention prefill FLOPs for the shared pages. One compiled
+        program for every bucket (the bucket boundary rides in as a
+        traced scalar; the history row is pre-padded to pool length)."""
         import jax.numpy as jnp
 
         pages = hit["pages"]
         self._alloc.incref(pages)
         if self._fm_cross is None:
             self._fm_cross = _make_cross_kv_fm(self._net.decoder)
-        ck = ("attach",)
-        fn = self._compiled.get(ck)
-        if fn is None:
-            fn = self._build_attach()
-            self._compiled[ck] = fn
-            fn = self._compiled[ck]   # the observed wrapper
+        fn = self._program(("attach",), self._build_attach)
         try:
             self._state = fn(
                 self._cross_params(), self._fm_cross.buffers(),
                 self._state, jnp.int32(s), jnp.int32(hit["tok0"]),
                 jnp.asarray([P0], jnp.int32), jnp.int32(Pb),
-                jnp.asarray(np.asarray(r.memory, self._np_dtype)[None]))
+                jnp.asarray(np.asarray(r.memory, self._np_dtype)[None]),
+                *self._attach_spec_rows(prompt_b, Pb))
         except Exception:
             self._alloc.decref(pages)
             raise
@@ -1699,12 +1495,7 @@ class PagedServingEngine(ServingEngine):
         if src < 0 or self._alloc.refcount[src] <= 1:
             return
         dst = self._alloc_pages(1)[0]
-        ck = ("cow",)
-        fn = self._compiled.get(ck)
-        if fn is None:
-            fn = self._build_cow()
-            self._compiled[ck] = fn
-            fn = self._compiled[ck]   # the observed wrapper
+        fn = self._program(("cow",), self._build_cow)
         try:
             self._state = fn(self._state, jnp.int32(src),
                              jnp.int32(dst))
@@ -1714,133 +1505,27 @@ class PagedServingEngine(ServingEngine):
         self._alloc.decref([src])
         self._table[s, pi] = dst
 
-    # ---- compiled programs ----
+    # ---- compiled programs (bodies live in layers.PagedLayout) ----
     def _build_paged_join(self, Pb):
-        import jax
-
-        return jax.jit(self._paged_join_body(Pb))
-
-    def _paged_join_body(self, Pb):
-        import jax
-        import jax.numpy as jnp
-
-        from ..nn.layer.transformer import MultiHeadAttention as MHA
-        from . import paging as PG
-
-        fm = self._fm
-        decoder = self._net.decoder
-        L = self.max_len
-        ck = ("pjoin", Pb)
-        neg = self._neg
-
-        def join_fn(params, buffers, state, slot, prompt, length,
-                    memory, page_ids):
-            self.trace_counts[ck] += 1  # one per trace = one compile
-            kpos = jnp.arange(L, dtype=jnp.int32)
-            hole = (kpos[None, :] >= length[:, None]) & \
-                (kpos[None, :] < jnp.int32(Pb))
-            bias_row = jnp.where(hole, jnp.float32(neg),
-                                 jnp.float32(0.0))           # [1, L]
-            positions = jnp.arange(Pb, dtype=jnp.int32)[None]
-            inc0 = [layer.self_attn.gen_cache(
-                None, max_length=Pb, batch_size=1, dtype=memory.dtype)
-                for layer in decoder.layers]
-            (lg, inc1, static1), _ = fm.apply(
-                params, buffers, None, prompt, positions, memory,
-                training=False, tgt_mask=bias_row[:, :Pb],
-                memory_mask=None, inc=inc0, prefill=True)
-            last = jnp.take_along_axis(
-                lg, (length - 1)[:, None, None], axis=1)[:, 0]
-            tok0 = last.argmax(-1).astype(jnp.int32)[0]
-            new_paged = []
-            for pc, c in zip(state["paged"], inc1):
-                cache = PG.PagedKVCache(pc["k"], pc["v"], pc["ks"],
-                                        pc["vs"], None, None)
-                cache = MHA.paged_prompt_splice(cache, page_ids,
-                                                c.k, c.v)
-                new_paged.append({"k": cache.k, "v": cache.v,
-                                  "ks": cache.k_scale,
-                                  "vs": cache.v_scale})
-            new_static = [(MHA.splice_rows(pk, slot, sk),
-                           MHA.splice_rows(pv, slot, sv))
-                          for (pk, pv), (sk, sv) in zip(state["static"],
-                                                        static1)]
-            new_state = {
-                "tok": jax.lax.dynamic_update_slice(
-                    state["tok"], tok0[None], (slot,)),
-                "bias": MHA.splice_rows(state["bias"], slot, bias_row),
-                "mem": MHA.splice_rows(state["mem"], slot, memory),
-                "static": new_static,
-                "paged": new_paged,
-            }
-            return new_state, tok0
-
-        return join_fn
+        return self.placement.build(("pjoin", Pb),
+                                    self.layout.join_body(Pb),
+                                    has_aux=True)
 
     def _build_attach(self):
-        import jax
-
-        return jax.jit(self._attach_body())
-
-    def _attach_body(self):
-        import jax
-        import jax.numpy as jnp
-
-        from ..nn.layer.transformer import MultiHeadAttention as MHA
-
-        fm_cross = self._fm_cross
-        L = self.max_len
-        ck = ("attach",)
-        neg = self._neg
-
-        def attach_fn(cparams, cbuffers, state, slot, tok0, length,
-                      pb, memory):
-            self.trace_counts[ck] += 1
-            static1, _ = fm_cross.apply(cparams, cbuffers, None,
-                                        memory, training=False)
-            kpos = jnp.arange(L, dtype=jnp.int32)
-            hole = (kpos[None, :] >= length[:, None]) & \
-                (kpos[None, :] < pb)                 # pb traced: one
-            #                                          compile, all
-            #                                          buckets
-            bias_row = jnp.where(hole, jnp.float32(neg),
-                                 jnp.float32(0.0))
-            new_static = [(MHA.splice_rows(pk, slot, sk),
-                           MHA.splice_rows(pv, slot, sv))
-                          for (pk, pv), (sk, sv) in zip(state["static"],
-                                                        static1)]
-            return dict(
-                state,
-                tok=jax.lax.dynamic_update_slice(
-                    state["tok"], tok0[None], (slot,)),
-                bias=MHA.splice_rows(state["bias"], slot, bias_row),
-                mem=MHA.splice_rows(state["mem"], slot, memory),
-                static=new_static)
-
-        return attach_fn
+        return self.placement.build(("attach",),
+                                    self.layout.attach_body(),
+                                    has_aux=False)
 
     def _build_cow(self):
-        import jax
+        return self.placement.build(("cow",), self.layout.cow_body(),
+                                    has_aux=False)
 
-        return jax.jit(self._cow_body())
+    def _build_paged_step(self, ck):
+        return self._build_step(ck)
 
-    def _cow_body(self):
-        from . import paging as PG
-
-        ck = ("cow",)
-
-        def cow_fn(state, src, dst):
-            self.trace_counts[ck] += 1
-            new_paged = []
-            for pc in state["paged"]:
-                k, ks = PG.copy_page(pc["k"], pc["ks"], src, dst)
-                v, vs = PG.copy_page(pc["v"], pc["vs"], src, dst)
-                new_paged.append({"k": k, "v": v, "ks": ks, "vs": vs})
-            return dict(state, paged=new_paged)
-
-        return cow_fn
-
-    # ---- decode: on-demand page mapping + one batched step ----
+    # ---- decode: on-demand page mapping + one batched step; the page
+    # mapping and index advance are the PagedLayout host hooks the
+    # steppers drive (layers.py) ----
     def _evict_oom(self, s, exc, now):
         r = self.slots[s]
         self.slots[s] = None
@@ -1850,45 +1535,6 @@ class PagedServingEngine(ServingEngine):
         self.metrics.record_finish("error", len(r.tokens))
         r.finish("error", now, error=exc)
         self._cbs.emit("on_finish", r)
-
-    def _decode_step(self, active):
-        import jax.numpy as jnp
-
-        now = self.clock()
-        # map the page each active slot's write position needs; under
-        # oversubscription a dry pool evicts the starved slot with its
-        # partial tokens (the pool itself keeps serving)
-        for s, r in enumerate(list(self.slots)):
-            if r is None:
-                continue
-            pi = int(self._index[s]) // self.page_size
-            if self._table[s, pi] < 0:
-                try:
-                    self._table[s, pi] = self._alloc_pages(1)[0]
-                except OutOfPages as e:
-                    self._evict_oom(s, e, now)
-        active = np.asarray([r is not None for r in self.slots], bool)
-        if not active.any():
-            return np.zeros((self.num_slots,), np.int64)
-        ck = ("pstep",) + self._pool_key
-        fn = self._compiled.get(ck)
-        if fn is None:
-            fn = self._build_paged_step(ck)
-            self._compiled[ck] = fn
-            fn = self._compiled[ck]   # the observed wrapper
-        self._state, toks = fn(
-            self._params(), self._buffers(), self._state,
-            self._device_table(),
-            jnp.asarray(self._index.astype(np.int32)),
-            jnp.asarray(active))
-        self._index[active] += 1
-        return np.asarray(toks)
-
-    def _build_paged_step(self, ck):
-        import jax
-
-        return jax.jit(self._paged_step_body(ck),
-                       donate_argnums=self._donate_argnums(ck))
 
     # ---- zero-warmup startup (paged program set) ----
     def _startup_programs(self, prompt_buckets):
@@ -1900,6 +1546,9 @@ class PagedServingEngine(ServingEngine):
         M, Dm = self._mem_shape
         mem1 = jnp.zeros((1, M, Dm), jnp.dtype(self._np_dtype))
         one = jnp.asarray([1], jnp.int32)
+        active = jnp.zeros((S,), bool)
+        table0 = jnp.zeros((S, self.max_pages), jnp.int32)
+        index0 = jnp.zeros((S,), jnp.int32)
         progs = []
         for Pb in sorted({bucket_size(int(p)) for p in prompt_buckets}):
             n_pp = pages_for(Pb, self.page_size)
@@ -1912,46 +1561,34 @@ class PagedServingEngine(ServingEngine):
         if self._prefix is not None:
             if self._fm_cross is None:
                 self._fm_cross = _make_cross_kv_fm(self._net.decoder)
+            spec_rows = ((jnp.zeros((1, self._pool_len), jnp.int32),)
+                         if self.spec_k else ())
             progs.append((
                 ("attach",), self._build_attach,
                 (self._cross_params(), self._fm_cross.buffers(), state,
-                 jnp.int32(0), jnp.int32(0), one, jnp.int32(1), mem1)))
+                 jnp.int32(0), jnp.int32(0), one, jnp.int32(1), mem1)
+                + spec_rows))
             progs.append((
                 ("cow",), self._build_cow,
                 (state, jnp.int32(0), jnp.int32(0))))
-        ck = ("pstep",) + self._pool_key
-        progs.append((
-            ck, lambda ck=ck: self._build_paged_step(ck),
-            (params, buffers, state,
-             jnp.zeros((S, self.max_pages), jnp.int32),
-             jnp.zeros((S,), jnp.int32), jnp.zeros((S,), bool))))
+        if self.spec_k:
+            dkey = ("draft",) + self._pool_key
+            progs.append((
+                dkey, lambda dkey=dkey: self._build_draft(dkey),
+                (state["hist"], state["tok"], state["plen"],
+                 state["pbk"], index0)))
+            vkey = ("pverify",) + self._pool_key
+            progs.append((
+                vkey, lambda vkey=vkey: self._build_spec_step(vkey),
+                (params, buffers, state, table0, index0,
+                 jnp.zeros((S, self.spec_k - 1), jnp.int32), active,
+                 active, jnp.int32(self.spec_k))))
+        else:
+            ck = ("pstep",) + self._pool_key
+            progs.append((
+                ck, lambda ck=ck: self._build_paged_step(ck),
+                (params, buffers, state, table0, index0, active)))
         return progs
-
-    def _paged_step_body(self, ck):
-        import jax.numpy as jnp
-
-        from . import paging as PG
-
-        fm = self._fm
-
-        def step_fn(params, buffers, state, table, index, active):
-            self.trace_counts[ck] += 1  # one per trace = one compile
-            inc = [PG.PagedKVCache(pc["k"], pc["v"], pc["ks"],
-                                   pc["vs"], table, index)
-                   for pc in state["paged"]]
-            posn = index[:, None]
-            (lg, inc2), _ = fm.apply(
-                params, buffers, None, state["tok"][:, None], posn,
-                state["mem"], training=False, tgt_mask=state["bias"],
-                memory_mask=None, inc=inc, static_kv=state["static"],
-                prefill=False)
-            nxt = lg[:, 0].argmax(-1).astype(jnp.int32)
-            nxt = jnp.where(active, nxt, state["tok"])
-            new_paged = [{"k": c.k, "v": c.v, "ks": c.k_scale,
-                          "vs": c.v_scale} for c in inc2]
-            return dict(state, tok=nxt, paged=new_paged), nxt
-
-        return step_fn
 
 
 class ArtifactServingEngine(_EngineBase):

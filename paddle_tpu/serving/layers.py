@@ -1,0 +1,899 @@
+"""Composable pool layers: the serving engines' program families as
+orthogonal strategy objects over ONE slot-pool core.
+
+Before this module, each serving capability lived in its own engine
+subclass: the paged pool re-implemented the join/step program pair,
+the sharded engine re-wrapped the single-chip bodies, and speculative
+decoding was wired through the dense pool only — so every new
+capability had to be built once per pool variant (and the paged pool
+simply rejected `spec_k`). Here the `(dense|paged) x (single|sharded)
+x (spec on|off)` grid is three independent axes:
+
+  * **CacheLayout** (`DenseLayout` | `PagedLayout`) owns the pool's
+    device-state shape and every traceable program body that touches
+    it: state construction, the join/attach/cow programs, the plain
+    batched step, and the speculative verify step. The paged layout's
+    verify body is the NEW program of this family: a k-token
+    `write_tokens` page write (boundary-crossing, grow-only int8
+    rescale) + `paged_verify_attention` through the block table.
+  * **Placement** (`SinglePlacement` | `ShardedPlacement`) owns how a
+    body becomes a compiled program: plain `jax.jit` with the shared
+    donation declaration, or the mesh-annotated wrap (decode-kernel
+    sharding scope + a `with_sharding_constraint` pin on every
+    returned pool carry) — the same body traces identically either
+    way, so the trace-count keys never depend on placement.
+  * **Stepper** (`PlainStepper` | `SpecStepper`) owns the per-
+    iteration host dispatch: which program family runs one decode
+    step, how the paged table/index ride in as traced inputs, and the
+    adaptive effective-k controller (speculation only).
+
+An engine is the composition `layout x placement x stepper`; the
+public classes in engine.py/sharded.py are thin configuration shims.
+Every body keeps its `trace_counts[key] += 1` side effect, so one
+trace still means one compile wherever the body was built from.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+__all__ = ["DenseLayout", "PagedLayout", "SinglePlacement",
+           "ShardedPlacement", "PlainStepper", "SpecStepper"]
+
+
+# --------------------------------------------------------------------------
+# cache layouts: pool state + the traceable program bodies
+# --------------------------------------------------------------------------
+
+class CacheLayout:
+    """Base: the engine-agnostic program bodies (the draft proposal is
+    pure jnp over per-slot rows — identical for every layout)."""
+
+    def __init__(self, eng):
+        self.eng = eng
+
+    # ---- program-family keys ----
+    def join_key(self, Pb):
+        raise NotImplementedError
+
+    def step_key(self):
+        raise NotImplementedError
+
+    def spec_step_key(self):
+        raise NotImplementedError
+
+    def draft_key(self):
+        return ("draft",) + self.eng._pool_key
+
+    # ---- host hooks the steppers drive ----
+    def map_step_pages(self, active, width):
+        """Make the next `width` write positions of every occupied
+        slot physically backed (paged: map pages, evicting a starved
+        slot under oversubscription). Returns the possibly-updated
+        active mask."""
+        return active
+
+    def step_extra_args(self):
+        """Extra traced inputs the step programs take between the pool
+        state and the per-slot masks (paged: the device table + per-
+        slot write indices, shipped fresh so mapping never retraces)."""
+        return ()
+
+    def row_index(self):
+        """Per-slot written-token counts, as a traced input for the
+        draft proposal."""
+        raise NotImplementedError
+
+    def advance_rows(self, n_emit):
+        """Advance host-owned write indices after a step delivered
+        `n_emit` tokens per slot (dense carries its indices in-state —
+        no-op)."""
+
+    # ---- the draft proposal body (pure jnp, layout-independent) ----
+    def draft_body(self, dkey):
+        from ..text import speculative as SP
+
+        eng = self.eng
+        k, ngram = eng.spec_k, eng.spec_ngram
+
+        def draft_fn(hist, tok, plen, pbk, index):
+            eng.trace_counts[dkey] += 1  # one per trace = one compile
+            return SP.ngram_propose(hist, tok, plen, pbk, k - 1,
+                                    index - pbk, ngram)
+
+        return draft_fn
+
+    @staticmethod
+    def _spec_join_rows(jnp, MHA, jax, state, out, prompt, length, Pb,
+                        slot, L, constrain=None):
+        """The speculation state a join splices alongside the K/V: the
+        row's token history mirror (prompt at [0, Pb)), its true
+        prompt length, and its bucket — shared by the dense and paged
+        join bodies (and the disaggregated splice)."""
+        c = constrain if constrain is not None else (lambda x: x)
+        hist_row = jnp.concatenate(
+            [prompt, jnp.zeros((1, L - prompt.shape[1]), jnp.int32)], 1)
+        out["hist"] = c(MHA.splice_rows(state["hist"], slot, hist_row))
+        out["plen"] = c(jax.lax.dynamic_update_slice(
+            state["plen"], length.astype(jnp.int32), (slot,)))
+        out["pbk"] = c(jax.lax.dynamic_update_slice(
+            state["pbk"], jnp.full((1,), Pb, jnp.int32), (slot,)))
+        return out
+
+
+class DenseLayout(CacheLayout):
+    """The contiguous [S, H, pool_len, D] StaticKVCache pool: every
+    slot owns its worst-case rows, write indices live in the carry."""
+
+    def join_key(self, Pb):
+        return ("join", Pb)
+
+    def step_key(self):
+        return ("step",) + self.eng._pool_key
+
+    def spec_step_key(self):
+        return ("sstep",) + self.eng._pool_key
+
+    def row_index(self):
+        return self.eng._state["inc"][0].index
+
+    # ---- state ----
+    def build_state(self, memory):
+        import jax.numpy as jnp
+
+        eng = self.eng
+        decoder = eng._net.decoder
+        M, Dm = memory.shape
+        dtype = jnp.asarray(np.asarray(memory)).dtype
+        S, L = eng.num_slots, eng._pool_len
+        inc = [layer.self_attn.gen_cache(None, max_length=L,
+                                         batch_size=S, dtype=dtype)
+               for layer in decoder.layers]
+        static = []
+        for layer in decoder.layers:
+            z = jnp.zeros((S, layer.cross_attn.num_heads, M,
+                           layer.cross_attn.head_dim), dtype)
+            static.append((z, z))
+        state = {
+            "tok": jnp.zeros((S,), jnp.int32),
+            "bias": jnp.zeros((S, L), jnp.float32),
+            "mem": jnp.zeros((S, M, Dm), dtype),
+            "inc": inc,
+            "static": static,
+        }
+        if eng.spec_k:
+            # the n-gram draft source's token mirror of the cache, plus
+            # each slot's true prompt length / bucket for the logical
+            # (hole-skipping) history view
+            state["hist"] = jnp.zeros((S, L), jnp.int32)
+            state["plen"] = jnp.zeros((S,), jnp.int32)
+            state["pbk"] = jnp.zeros((S,), jnp.int32)
+        return state
+
+    def pool_key(self, memory):
+        eng = self.eng
+        M, Dm = memory.shape
+        import jax.numpy as jnp
+
+        dtype = jnp.asarray(np.asarray(memory)).dtype
+        return (eng.num_slots, eng._pool_len, M, Dm, str(dtype)) + \
+            ((("spec", eng.spec_k, eng.spec_ngram),)
+             if eng.spec_k else ())
+
+    # ---- the join program (prefill + splice) ----
+    def join_body(self, Pb):
+        import jax
+        import jax.numpy as jnp
+
+        from ..nn.layer.transformer import MultiHeadAttention as MHA
+
+        eng = self.eng
+        fm = eng._fm
+        decoder = eng._net.decoder
+        L = eng._pool_len
+        spec = bool(eng.spec_k)
+        key = self.join_key(Pb)
+        neg = eng._neg
+
+        def join_fn(params, buffers, state, slot, prompt, length,
+                    memory):
+            eng.trace_counts[key] += 1  # python side effect: one per
+            #                             trace = one per compile
+            kpos = jnp.arange(L, dtype=jnp.int32)
+            hole = (kpos[None, :] >= length[:, None]) & \
+                (kpos[None, :] < jnp.int32(Pb))
+            bias_row = jnp.where(hole, jnp.float32(neg),
+                                 jnp.float32(0.0))           # [1, L]
+            positions = jnp.arange(Pb, dtype=jnp.int32)[None]
+            inc0 = [layer.self_attn.gen_cache(
+                None, max_length=Pb, batch_size=1, dtype=memory.dtype)
+                for layer in decoder.layers]
+            (lg, inc1, static1), _ = fm.apply(
+                params, buffers, None, prompt, positions, memory,
+                training=False, tgt_mask=bias_row[:, :Pb],
+                memory_mask=None, inc=inc0, prefill=True)
+            # token 0 conditions on the row's LAST REAL prompt position
+            last = jnp.take_along_axis(
+                lg, (length - 1)[:, None, None], axis=1)[:, 0]
+            tok0 = last.argmax(-1).astype(jnp.int32)[0]
+            new_inc = [MHA.static_kv_splice(pool, slot, c.k, c.v,
+                                            jnp.int32(Pb))
+                       for pool, c in zip(state["inc"], inc1)]
+            new_static = [(MHA.splice_rows(pk, slot, sk),
+                           MHA.splice_rows(pv, slot, sv))
+                          for (pk, pv), (sk, sv) in zip(state["static"],
+                                                        static1)]
+            new_state = {
+                "tok": jax.lax.dynamic_update_slice(
+                    state["tok"], tok0[None], (slot,)),
+                "bias": MHA.splice_rows(state["bias"], slot, bias_row),
+                "mem": MHA.splice_rows(state["mem"], slot, memory),
+                "inc": new_inc,
+                "static": new_static,
+            }
+            if spec:
+                new_state = self._spec_join_rows(
+                    jnp, MHA, jax, state, new_state, prompt, length,
+                    Pb, slot, L)
+            return new_state, tok0
+
+        return join_fn
+
+    # ---- the plain batched decode step ----
+    def step_body(self, key):
+        import jax.numpy as jnp
+
+        from ..nn.layer.transformer import MultiHeadAttention as MHA
+
+        eng = self.eng
+        fm = eng._fm
+
+        def step_fn(params, buffers, state, active):
+            eng.trace_counts[key] += 1  # one per trace = one compile
+            inc = state["inc"]
+            posn = inc[0].index[:, None]  # per-SLOT written counts
+            (lg, inc2), _ = fm.apply(
+                params, buffers, None, state["tok"][:, None], posn,
+                state["mem"], training=False, tgt_mask=state["bias"],
+                memory_mask=None, inc=inc, static_kv=state["static"],
+                prefill=False)
+            nxt = lg[:, 0].argmax(-1).astype(jnp.int32)
+            nxt = jnp.where(active, nxt, state["tok"])
+            # inactive slots must not creep their write index: their
+            # (masked, garbage) write this step gets overwritten before
+            # it can ever become visible, but the index itself must
+            # stay put so an idle slot never marches toward max_len
+            inc2 = [MHA.StaticKVCache(
+                c.k, c.v, jnp.where(active, c.index, old.index))
+                for c, old in zip(inc2, inc)]
+            return dict(state, tok=nxt, inc=inc2), nxt
+
+        return step_fn
+
+    # ---- the speculative verify step (draft acceptance + rollback) ----
+    def spec_step_body(self, vkey):
+        import jax.numpy as jnp
+
+        from ..nn.layer.transformer import MultiHeadAttention as MHA
+        from ..ops import attention as A
+        from ..text import speculative as SP
+        from ..text.decode import greedy_accept
+
+        eng = self.eng
+        fm = eng._fm
+        k = eng.spec_k
+
+        def sstep_fn(params, buffers, state, drafts, active, spec_on,
+                     k_eff):
+            eng.trace_counts[vkey] += 1  # one per trace = one compile
+            inc = state["inc"]
+            idx0 = inc[0].index
+            # a spec=False slot's drafts are forced unmatched (-1 never
+            # equals a vocab token), so it accepts exactly one oracle
+            # token per step; lanes past the adaptive effective k are
+            # force-rejected the same way — shrinking/regrowing k NEVER
+            # changes a shape, so it never retraces
+            lane = jnp.arange(k - 1, dtype=jnp.int32)[None, :]
+            live = spec_on[:, None] & (lane < k_eff - 1)
+            drafts = jnp.where(live, drafts, -1)
+            fed = jnp.concatenate([state["tok"][:, None], drafts], 1)
+            posn = idx0[:, None] + jnp.arange(k, dtype=jnp.int32)[None]
+            with A.kv_verify_scope():
+                (lg, inc2), _ = fm.apply(
+                    params, buffers, None, fed, posn, state["mem"],
+                    training=False, tgt_mask=state["bias"],
+                    memory_mask=None, inc=inc,
+                    static_kv=state["static"], prefill=False)
+            preds = lg.argmax(-1).astype(jnp.int32)
+            n_match, emit = greedy_accept(drafts, preds)
+            n_emit = jnp.where(active, n_match + 1, 0).astype(jnp.int32)
+            # acceptance rollback on active rows, index pin on the rest
+            # (the same inactive-slot contract as the plain step)
+            new_idx = SP.rollback_index(inc2[0].index, k, n_match,
+                                        active)
+            inc3 = [MHA.StaticKVCache(c.k, c.v, new_idx) for c in inc2]
+            corr = jnp.take_along_axis(preds, n_match[:, None],
+                                       axis=1)[:, 0]
+            nxt = jnp.where(active, corr, state["tok"])
+            new_state = dict(
+                state, tok=nxt, inc=inc3,
+                hist=SP.write_hist(state["hist"], fed, idx0))
+            return new_state, (emit, n_emit)
+
+        return sstep_fn
+
+
+class PagedLayout(CacheLayout):
+    """The global fixed-size page pool with host-owned indirection:
+    write indices and the page table ride in as traced inputs every
+    step, so mapping/rollback are pure host index arithmetic."""
+
+    def join_key(self, Pb):
+        return ("pjoin", Pb)
+
+    def step_key(self):
+        return ("pstep",) + self.eng._pool_key
+
+    def spec_step_key(self):
+        return ("pverify",) + self.eng._pool_key
+
+    def row_index(self):
+        import jax.numpy as jnp
+
+        return jnp.asarray(self.eng._index.astype(np.int32))
+
+    def map_step_pages(self, active, width):
+        from .paging import OutOfPages
+
+        eng = self.eng
+        psz = eng.page_size
+        now = eng.clock()
+        # map the page(s) the next `width` write positions need; under
+        # oversubscription a dry pool evicts the starved slot with its
+        # partial tokens (the pool itself keeps serving). Speculative
+        # steps write the FULL fixed-k block (force-rejected tail
+        # included), so every page the block touches must be mapped.
+        for s, r in enumerate(list(eng.slots)):
+            if r is None:
+                continue
+            i0 = int(eng._index[s])
+            for pi in range(i0 // psz, (i0 + width - 1) // psz + 1):
+                if eng._table[s, pi] < 0:
+                    try:
+                        eng._table[s, pi] = eng._alloc_pages(1)[0]
+                    except OutOfPages as e:
+                        eng._evict_oom(s, e, now)
+                        break
+        return np.asarray(
+            [r is not None and s not in eng._pending
+             for s, r in enumerate(eng.slots)], bool)
+
+    def step_extra_args(self):
+        import jax.numpy as jnp
+
+        eng = self.eng
+        return (eng._device_table(),
+                jnp.asarray(eng._index.astype(np.int32)))
+
+    def advance_rows(self, n_emit):
+        self.eng._index += np.asarray(n_emit, np.int64).astype(
+            self.eng._index.dtype)
+
+    # ---- state ----
+    def build_state(self, memory):
+        import jax.numpy as jnp
+
+        eng = self.eng
+        decoder = eng._net.decoder
+        M, Dm = memory.shape
+        dtype = jnp.asarray(np.asarray(memory)).dtype
+        S, L = eng.num_slots, eng._pool_len
+        paged = []
+        for layer in decoder.layers:
+            c = layer.self_attn.gen_paged_cache(
+                eng.num_pages, eng.page_size, S, eng.max_pages,
+                dtype, eng.kv_dtype)
+            paged.append({"k": c.k, "v": c.v, "ks": c.k_scale,
+                          "vs": c.v_scale})
+        static = []
+        for layer in decoder.layers:
+            z = jnp.zeros((S, layer.cross_attn.num_heads, M,
+                           layer.cross_attn.head_dim), dtype)
+            static.append((z, z))
+        state = {
+            "tok": jnp.zeros((S,), jnp.int32),
+            "bias": jnp.zeros((S, L), jnp.float32),
+            "mem": jnp.zeros((S, M, Dm), dtype),
+            "static": static,
+            "paged": paged,
+        }
+        if eng.spec_k:
+            state["hist"] = jnp.zeros((S, L), jnp.int32)
+            state["plen"] = jnp.zeros((S,), jnp.int32)
+            state["pbk"] = jnp.zeros((S,), jnp.int32)
+        return state
+
+    def pool_key(self, memory):
+        import jax.numpy as jnp
+
+        eng = self.eng
+        M, Dm = memory.shape
+        dtype = jnp.asarray(np.asarray(memory)).dtype
+        return (eng.num_slots, eng._pool_len, M, Dm, str(dtype),
+                eng.page_size, eng.num_pages, str(eng.kv_dtype)) + \
+            ((("spec", eng.spec_k, eng.spec_ngram),)
+             if eng.spec_k else ())
+
+    # ---- the paged join program (prefill into pages) ----
+    def join_body(self, Pb):
+        import jax
+        import jax.numpy as jnp
+
+        from ..nn.layer.transformer import MultiHeadAttention as MHA
+        from . import paging as PG
+
+        eng = self.eng
+        fm = eng._fm
+        decoder = eng._net.decoder
+        L = eng._pool_len
+        spec = bool(eng.spec_k)
+        ck = self.join_key(Pb)
+        neg = eng._neg
+
+        def join_fn(params, buffers, state, slot, prompt, length,
+                    memory, page_ids):
+            eng.trace_counts[ck] += 1  # one per trace = one compile
+            kpos = jnp.arange(L, dtype=jnp.int32)
+            hole = (kpos[None, :] >= length[:, None]) & \
+                (kpos[None, :] < jnp.int32(Pb))
+            bias_row = jnp.where(hole, jnp.float32(neg),
+                                 jnp.float32(0.0))           # [1, L]
+            positions = jnp.arange(Pb, dtype=jnp.int32)[None]
+            inc0 = [layer.self_attn.gen_cache(
+                None, max_length=Pb, batch_size=1, dtype=memory.dtype)
+                for layer in decoder.layers]
+            (lg, inc1, static1), _ = fm.apply(
+                params, buffers, None, prompt, positions, memory,
+                training=False, tgt_mask=bias_row[:, :Pb],
+                memory_mask=None, inc=inc0, prefill=True)
+            last = jnp.take_along_axis(
+                lg, (length - 1)[:, None, None], axis=1)[:, 0]
+            tok0 = last.argmax(-1).astype(jnp.int32)[0]
+            new_paged = []
+            for pc, c in zip(state["paged"], inc1):
+                cache = PG.PagedKVCache(pc["k"], pc["v"], pc["ks"],
+                                        pc["vs"], None, None)
+                cache = MHA.paged_prompt_splice(cache, page_ids,
+                                                c.k, c.v)
+                new_paged.append({"k": cache.k, "v": cache.v,
+                                  "ks": cache.k_scale,
+                                  "vs": cache.v_scale})
+            new_static = [(MHA.splice_rows(pk, slot, sk),
+                           MHA.splice_rows(pv, slot, sv))
+                          for (pk, pv), (sk, sv) in zip(state["static"],
+                                                        static1)]
+            new_state = {
+                "tok": jax.lax.dynamic_update_slice(
+                    state["tok"], tok0[None], (slot,)),
+                "bias": MHA.splice_rows(state["bias"], slot, bias_row),
+                "mem": MHA.splice_rows(state["mem"], slot, memory),
+                "static": new_static,
+                "paged": new_paged,
+            }
+            if spec:
+                new_state = self._spec_join_rows(
+                    jnp, MHA, jax, state, new_state, prompt, length,
+                    Pb, slot, L)
+            return new_state, tok0
+
+        return join_fn
+
+    # ---- the prefix-attach program (zero-prefill shared join) ----
+    def attach_body(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ..nn.layer.transformer import MultiHeadAttention as MHA
+
+        eng = self.eng
+        fm_cross = eng._fm_cross
+        L = eng._pool_len
+        spec = bool(eng.spec_k)
+        ck = ("attach",)
+        neg = eng._neg
+
+        def attach_fn(cparams, cbuffers, state, slot, tok0, length,
+                      pb, memory, *spec_rows):
+            eng.trace_counts[ck] += 1
+            static1, _ = fm_cross.apply(cparams, cbuffers, None,
+                                        memory, training=False)
+            kpos = jnp.arange(L, dtype=jnp.int32)
+            hole = (kpos[None, :] >= length[:, None]) & \
+                (kpos[None, :] < pb)                 # pb traced: one
+            #                                          compile, all
+            #                                          buckets
+            bias_row = jnp.where(hole, jnp.float32(neg),
+                                 jnp.float32(0.0))
+            new_static = [(MHA.splice_rows(pk, slot, sk),
+                           MHA.splice_rows(pv, slot, sv))
+                          for (pk, pv), (sk, sv) in zip(state["static"],
+                                                        static1)]
+            out = dict(
+                state,
+                tok=jax.lax.dynamic_update_slice(
+                    state["tok"], tok0[None], (slot,)),
+                bias=MHA.splice_rows(state["bias"], slot, bias_row),
+                mem=MHA.splice_rows(state["mem"], slot, memory),
+                static=new_static)
+            if spec:
+                # the prompt tokens ride in pre-padded to the full
+                # pool length, so the attach program stays ONE compile
+                # for every bucket (pb is already traced)
+                (hist_row,) = spec_rows
+                out["hist"] = MHA.splice_rows(state["hist"], slot,
+                                              hist_row)
+                out["plen"] = jax.lax.dynamic_update_slice(
+                    state["plen"], length.astype(jnp.int32), (slot,))
+                out["pbk"] = jax.lax.dynamic_update_slice(
+                    state["pbk"], pb.reshape(1).astype(jnp.int32),
+                    (slot,))
+            return out
+
+        return attach_fn
+
+    def cow_body(self):
+        from . import paging as PG
+
+        eng = self.eng
+        ck = ("cow",)
+
+        def cow_fn(state, src, dst):
+            eng.trace_counts[ck] += 1
+            new_paged = []
+            for pc in state["paged"]:
+                k, ks = PG.copy_page(pc["k"], pc["ks"], src, dst)
+                v, vs = PG.copy_page(pc["v"], pc["vs"], src, dst)
+                new_paged.append({"k": k, "v": v, "ks": ks, "vs": vs})
+            return dict(state, paged=new_paged)
+
+        return cow_fn
+
+    # ---- the plain batched decode step (through the page table) ----
+    def step_body(self, ck):
+        import jax.numpy as jnp
+
+        from . import paging as PG
+
+        eng = self.eng
+        fm = eng._fm
+
+        def step_fn(params, buffers, state, table, index, active):
+            eng.trace_counts[ck] += 1  # one per trace = one compile
+            inc = [PG.PagedKVCache(pc["k"], pc["v"], pc["ks"],
+                                   pc["vs"], table, index)
+                   for pc in state["paged"]]
+            posn = index[:, None]
+            (lg, inc2), _ = fm.apply(
+                params, buffers, None, state["tok"][:, None], posn,
+                state["mem"], training=False, tgt_mask=state["bias"],
+                memory_mask=None, inc=inc, static_kv=state["static"],
+                prefill=False)
+            nxt = lg[:, 0].argmax(-1).astype(jnp.int32)
+            nxt = jnp.where(active, nxt, state["tok"])
+            new_paged = [{"k": c.k, "v": c.v, "ks": c.k_scale,
+                          "vs": c.v_scale} for c in inc2]
+            return dict(state, tok=nxt, paged=new_paged), nxt
+
+        return step_fn
+
+    # ---- the paged speculative verify step ----
+    def spec_step_body(self, vkey):
+        import jax.numpy as jnp
+
+        from ..ops import attention as A
+        from ..text import speculative as SP
+        from ..text.decode import greedy_accept
+
+        eng = self.eng
+        fm = eng._fm
+        k = eng.spec_k
+
+        def pverify_fn(params, buffers, state, table, index, drafts,
+                       active, spec_on, k_eff):
+            eng.trace_counts[vkey] += 1  # one per trace = one compile
+            from . import paging as PG
+
+            # force-reject the opted-out rows and the lanes past the
+            # adaptive effective k (-1 never equals a vocab token): k
+            # changes ride the SAME fixed-k compiled program
+            lane = jnp.arange(k - 1, dtype=jnp.int32)[None, :]
+            live = spec_on[:, None] & (lane < k_eff - 1)
+            drafts = jnp.where(live, drafts, -1)
+            fed = jnp.concatenate([state["tok"][:, None], drafts], 1)
+            posn = index[:, None] + jnp.arange(k, dtype=jnp.int32)[None]
+            inc = [PG.PagedKVCache(pc["k"], pc["v"], pc["ks"],
+                                   pc["vs"], table, index)
+                   for pc in state["paged"]]
+            with A.kv_verify_scope():
+                (lg, inc2), _ = fm.apply(
+                    params, buffers, None, fed, posn, state["mem"],
+                    training=False, tgt_mask=state["bias"],
+                    memory_mask=None, inc=inc,
+                    static_kv=state["static"], prefill=False)
+            preds = lg.argmax(-1).astype(jnp.int32)
+            n_match, emit = greedy_accept(drafts, preds)
+            n_emit = jnp.where(active, n_match + 1, 0).astype(jnp.int32)
+            corr = jnp.take_along_axis(preds, n_match[:, None],
+                                       axis=1)[:, 0]
+            nxt = jnp.where(active, corr, state["tok"])
+            # rollback is pure index arithmetic and the index is HOST-
+            # owned (a traced input, not a carry): the stepper adds
+            # n_emit per row; rejected tokens sit masked behind it and
+            # their already-mapped pages are simply rewritten next
+            # round — no page frees on reject
+            new_paged = [{"k": c.k, "v": c.v, "ks": c.k_scale,
+                          "vs": c.v_scale} for c in inc2]
+            new_state = dict(
+                state, tok=nxt, paged=new_paged,
+                hist=SP.write_hist(state["hist"], fed, index))
+            return new_state, (emit, n_emit)
+
+        return pverify_fn
+
+
+# --------------------------------------------------------------------------
+# placements: how a body becomes a compiled program
+# --------------------------------------------------------------------------
+
+class SinglePlacement:
+    """Plain `jax.jit` with the engine's shared donation declaration —
+    the single-chip build path every engine used before placement was
+    an axis."""
+
+    def __init__(self, eng):
+        self.eng = eng
+
+    def build(self, key, body, has_aux=True):
+        import jax
+
+        return jax.jit(body,
+                       donate_argnums=self.eng._donate_argnums(key))
+
+
+class ShardedPlacement:
+    """Mesh-annotated builds: the SAME single-chip body traced under
+    the decode-kernel sharding scope, every returned pool carry pinned
+    to the dp slot layout, donation per the shared declaration. Also
+    owns the pool-state placement (device_put onto the decode mesh)."""
+
+    def __init__(self, eng):
+        self.eng = eng
+
+    def _decode_specs(self):
+        ns = self.eng._ns_pool
+        return {"q": ns, "kv": ns, "pages": ns, "out": ns}
+
+    def constrain_state(self, state):
+        """Pin PartitionSpec('dp') on every pool carry (slot-leading
+        leaves; the paged page/scale arrays shard their page axis the
+        same way), replicating nothing implicitly — the every-carry
+        contract."""
+        import jax
+
+        from ..nn.layer.transformer import MultiHeadAttention as MHA
+
+        c = lambda x: jax.lax.with_sharding_constraint(  # noqa: E731
+            x, self.eng._ns_pool)
+        out = dict(state)
+        for k in ("tok", "bias", "mem", "hist", "plen", "pbk"):
+            if k in out:
+                out[k] = c(out[k])
+        if "inc" in out:
+            out["inc"] = [MHA.StaticKVCache(c(cc.k), c(cc.v),
+                                            c(cc.index))
+                          for cc in out["inc"]]
+        if "static" in out:
+            out["static"] = [(c(sk), c(sv)) for sk, sv in out["static"]]
+        if "paged" in out:
+            out["paged"] = [
+                {"k": c(pc["k"]), "v": c(pc["v"]),
+                 "ks": None if pc["ks"] is None else c(pc["ks"]),
+                 "vs": None if pc["vs"] is None else c(pc["vs"])}
+                for pc in out["paged"]]
+        return out
+
+    def build(self, key, body, has_aux=True):
+        """jit a single-chip engine body with the sharded annotations:
+        decode kernels constrained via `decode_shardings`, every
+        returned carry pinned to the pool layout, the step-family
+        state carry donated per the shared `_donate_argnums`
+        declaration (same donation audit as the single-chip builds)."""
+        import jax
+
+        from ..ops import attention as A
+
+        specs = self._decode_specs()
+
+        def fn(*args):
+            with A.decode_shardings(specs):
+                out = body(*args)
+            if has_aux:
+                st, aux = out
+                return self.constrain_state(st), aux
+            return self.constrain_state(out)
+
+        return jax.jit(fn, donate_argnums=self.eng._donate_argnums(key))
+
+    def place_state(self, state):
+        """Lay the freshly-built pool state out on the decode mesh:
+        slot-leading leaves shard over dp (the KV pool is REBUILT with
+        `gen_cache`'s sharded constructors so the zeros never
+        materialize on one device)."""
+        import jax
+
+        eng = self.eng
+        L, S = eng._pool_len, eng.num_slots
+        dtype = state["mem"].dtype
+        decoder = eng._net.decoder
+        ns = eng._ns_pool
+        out = dict(state)
+        for k in ("tok", "bias", "mem", "hist", "plen", "pbk"):
+            if k in state:
+                out[k] = jax.device_put(state[k], ns)
+        out["static"] = [
+            (jax.device_put(sk, ns), jax.device_put(sv, ns))
+            for sk, sv in state["static"]]
+        if "inc" in state:
+            out["inc"] = [layer.self_attn.gen_cache(
+                None, max_length=L, batch_size=S, dtype=dtype,
+                kv_sharding=ns, index_sharding=ns)
+                for layer in decoder.layers]
+        if "paged" in state:
+            # pad the page-row count to a dp multiple so the page axis
+            # lays out evenly; rows past the trash row (num_pages) are
+            # never referenced by any table entry — pure padding
+            rows = eng.num_pages + 1
+            padded = -(-rows // eng._pool_dp) * eng._pool_dp
+            paged = []
+            for layer in decoder.layers:
+                cc = layer.self_attn.gen_paged_cache(
+                    padded - 1, eng.page_size, S, eng.max_pages,
+                    dtype, eng.kv_dtype, page_sharding=ns)
+                paged.append({"k": cc.k, "v": cc.v, "ks": cc.k_scale,
+                              "vs": cc.v_scale})
+            out["paged"] = paged
+        return out
+
+
+# --------------------------------------------------------------------------
+# steppers: the per-iteration decode dispatch
+# --------------------------------------------------------------------------
+
+class PlainStepper:
+    """One token per slot per iteration: ONE batched program dispatch
+    over the active mask."""
+
+    def __init__(self, eng):
+        self.eng = eng
+
+    def decode(self, active):
+        import jax.numpy as jnp
+
+        eng = self.eng
+        lay = eng.layout
+        active = lay.map_step_pages(active, 1)
+        if not active.any():
+            return np.zeros((eng.num_slots,), np.int64)
+        key = lay.step_key()
+        fn = eng._program(key, lambda: eng._build_step(key))
+        eng._state, toks = fn(eng._params(), eng._buffers(),
+                              eng._state, *lay.step_extra_args(),
+                              jnp.asarray(active))
+        lay.advance_rows(active.astype(np.int64))
+        return np.asarray(toks)
+
+
+class SpecStepper:
+    """Draft-verify: two dispatches deliver up to k tokens per slot,
+    plus the adaptive effective-k controller — batch-wide, driven by
+    the acceptance-rate gauge with hysteresis. `k_eff` rides into the
+    fixed-k verify program as a traced scalar (lanes past it are
+    force-rejected in-program), so shrinking or regrowing k NEVER
+    retraces; the retrace-sentinel soaks hold this with adaptation
+    exercised."""
+
+    def __init__(self, eng):
+        self.eng = eng
+        self.k_eff = eng.spec_k
+        self.k_shrink_events = 0
+        self.k_grow_events = 0
+        self._ema = None
+        self._low_rounds = 0
+        self._high_rounds = 0
+
+    def _adapt(self, on_count, accepted):
+        """Hysteresis: the acceptance-rate EMA must sit below/above the
+        band for `spec_adapt_patience` consecutive rounds before k
+        shrinks/regrows one step — a single unlucky round never
+        thrashes the ladder."""
+        eng = self.eng
+        if not eng.spec_adapt or not on_count:
+            return
+        lanes = on_count * max(1, self.k_eff - 1)
+        rate = accepted / lanes
+        a = eng.spec_adapt_alpha
+        self._ema = rate if self._ema is None else \
+            (1 - a) * self._ema + a * rate
+        if self._ema < eng.spec_adapt_low and self.k_eff > 2:
+            self._low_rounds += 1
+            self._high_rounds = 0
+            if self._low_rounds >= eng.spec_adapt_patience:
+                self.k_eff -= 1
+                self.k_shrink_events += 1
+                self._low_rounds = 0
+                self._ema = None   # fresh window at the new k
+        elif self._ema > eng.spec_adapt_high and \
+                self.k_eff < eng.spec_k:
+            self._high_rounds += 1
+            self._low_rounds = 0
+            if self._high_rounds >= eng.spec_adapt_patience:
+                self.k_eff += 1
+                self.k_grow_events += 1
+                self._high_rounds = 0
+                self._ema = None
+        else:
+            self._low_rounds = self._high_rounds = 0
+
+    def decode(self, active):
+        import jax
+        import jax.numpy as jnp
+
+        eng = self.eng
+        lay = eng.layout
+        # the verify write is the FULL fixed-k block (force-rejected
+        # tail included), so the paged pool maps every page it touches
+        active = lay.map_step_pages(active, eng.spec_k)
+        if not active.any():
+            S, k = eng.num_slots, eng.spec_k
+            return (np.zeros((S, k), np.int64), np.zeros((S,), np.int64))
+        spec_on = np.asarray(
+            [r is not None and getattr(r, "spec", True)
+             for r in eng.slots], bool)
+        st = eng._state
+        dkey = lay.draft_key()
+        fn = eng._program(dkey, lambda: eng._build_draft(dkey))
+        t0 = time.perf_counter()
+        drafts = fn(st["hist"], st["tok"], st["plen"], st["pbk"],
+                    lay.row_index())
+        jax.block_until_ready(drafts)
+        t1 = time.perf_counter()
+        vkey = lay.spec_step_key()
+        fn = eng._program(vkey, lambda: eng._build_spec_step(vkey))
+        eng._state, (emit, n_emit) = fn(
+            eng._params(), eng._buffers(), eng._state,
+            *lay.step_extra_args(), drafts, jnp.asarray(active),
+            jnp.asarray(spec_on), jnp.int32(self.k_eff))
+        emit = np.asarray(emit)
+        n_emit = np.asarray(n_emit)
+        t2 = time.perf_counter()
+        lay.advance_rows(n_emit)
+        on = active & spec_on
+        on_count = int(on.sum())
+        proposed = on_count * (self.k_eff - 1)
+        accepted = int(np.maximum(n_emit[on] - 1, 0).sum()) \
+            if on_count else 0
+        self._adapt(on_count, accepted)
+        eng.metrics.record_spec_step(
+            int(active.sum()), proposed, accepted, t1 - t0, t2 - t1,
+            k_eff=self.k_eff, variant=eng._pool_variant(),
+            k_shrinks=self.k_shrink_events,
+            k_grows=self.k_grow_events)
+        from ..profiler import trace as _trace
+
+        if _trace._SESSION is not None:
+            from . import tracing as _rt
+
+            _rt.on_spec_step(t0, t1, t2, int(active.sum()), proposed,
+                             accepted)
+        return emit, n_emit

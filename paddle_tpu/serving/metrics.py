@@ -144,6 +144,18 @@ SNAPSHOT_DOCS = {
         "counter",
         "rejected drafts — verify lanes burned; in the goodput "
         "denominator"),
+    "speculation.effective_k": (
+        "gauge", "adaptive batch-wide draft depth the spec stepper "
+                 "currently runs at (None until a spec step records)"),
+    "speculation.k_shrink_events": (
+        "counter", "adaptive-k downshifts (acceptance EMA under the "
+                   "low band past the hysteresis patience)"),
+    "speculation.k_grow_events": (
+        "counter", "adaptive-k upshifts (acceptance EMA over the high "
+                   "band past the hysteresis patience)"),
+    "speculation.step_ms_by_variant": (
+        "info", "per-pool-variant (dense/paged/sharded-*) draft/"
+                "verify step-ms p50 split"),
     # cold start (PR 11) — the section appears once the engine runs
     # precompile(): startup AOT compile / persistent-cache accounting.
     # Cold-start latency is a production metric: these are the numbers
@@ -172,7 +184,8 @@ SNAPSHOT_DOCS = {
 }
 
 _SUMMARY_KEYS = {"n", "mean", "p50", "p99", "max"}
-_LEAF_DICTS = {"errors.last", "mfu.device"}
+_LEAF_DICTS = {"errors.last", "mfu.device",
+               "speculation.step_ms_by_variant"}
 
 
 def flatten_snapshot(snap, _prefix=""):
@@ -361,6 +374,15 @@ class ServingMetrics:
         self.accepted_per_step = _Reservoir(512)
         self.draft_step_s = _Reservoir(512)
         self.verify_step_s = _Reservoir(512)
+        # adaptive effective k (the batch-wide draft depth the spec
+        # stepper is currently running) + its hysteresis transitions,
+        # and the draft/verify latency split keyed by pool variant
+        # (dense / paged / sharded-*) so a mixed deployment's spec
+        # steps stay attributable
+        self.spec_k_eff = None
+        self.spec_k_shrinks = 0
+        self.spec_k_grows = 0
+        self._spec_by_variant = {}
         # cold start (PR 11): the engine's precompile() report — how
         # the pool reached readiness (cache-warm vs compiled) and the
         # first request's TTFT (what a restart actually costs callers)
@@ -556,12 +578,17 @@ class ServingMetrics:
 
     # ---- speculative-decoding accounting ----
     def record_spec_step(self, n_active, proposed, accepted, draft_s,
-                         verify_s):
+                         verify_s, k_eff=None, variant=None,
+                         k_shrinks=None, k_grows=None):
         """One speculative iteration: `proposed` draft tokens went into
         the verify step for the spec-enabled active slots, `accepted`
         of them matched the oracle; `draft_s`/`verify_s` are the two
         dispatch wall times. Rejected drafts are wasted verify lanes —
-        they join the goodput denominator."""
+        they join the goodput denominator. `k_eff` is the adaptive
+        batch-wide draft depth this round ran at (with the stepper's
+        cumulative shrink/grow transition counts), `variant` the pool
+        flavor (dense/paged/sharded-*) keying the per-variant step-ms
+        split."""
         with self._lock:
             self._spec_recorded = True
             self.spec_rounds += 1
@@ -571,6 +598,20 @@ class ServingMetrics:
                 self.accepted_per_step.add(accepted / n_active)
             self.draft_step_s.add(draft_s)
             self.verify_step_s.add(verify_s)
+            if k_eff is not None:
+                self.spec_k_eff = int(k_eff)
+            if k_shrinks is not None:
+                self.spec_k_shrinks = int(k_shrinks)
+            if k_grows is not None:
+                self.spec_k_grows = int(k_grows)
+            if variant is not None:
+                v = self._spec_by_variant.get(variant)
+                if v is None:
+                    v = {"draft": _Reservoir(256),
+                         "verify": _Reservoir(256)}
+                    self._spec_by_variant[variant] = v
+                v["draft"].add(draft_s)
+                v["verify"].add(verify_s)
 
     # ---- sharded-serving accounting ----
     def record_step_gap(self, dt_s):
@@ -709,6 +750,17 @@ class ServingMetrics:
                     "verify_step_ms":
                         self.verify_step_s.summary(scale=1e3),
                     "wasted_draft_tokens": wasted_drafts,
+                    "effective_k": self.spec_k_eff,
+                    "k_shrink_events": self.spec_k_shrinks,
+                    "k_grow_events": self.spec_k_grows,
+                    "step_ms_by_variant": {
+                        v: {"draft_p50":
+                                r["draft"].summary(scale=1e3)
+                                .get("p50"),
+                            "verify_p50":
+                                r["verify"].summary(scale=1e3)
+                                .get("p50")}
+                        for v, r in self._spec_by_variant.items()},
                 }}),
                 **({} if mem is None else {"memory": mem}),
                 **({} if not self._mfu else {"mfu": {

@@ -48,7 +48,7 @@ import numpy as np
 __all__ = ["OutOfPages", "PageAllocator", "PrefixCache", "PagedKVCache",
            "pages_for", "resolve_kv_dtype", "quantize_chunks",
            "chunk_prompt", "write_prompt_pages", "write_token",
-           "copy_page", "gather_pages"]
+           "write_tokens", "copy_page", "gather_pages"]
 
 _QMAX = 127.0
 
@@ -337,6 +337,30 @@ def write_token(pages, scales, table, index, tok):
     pg = pg.at[jnp.arange(S), :, off, :].set(qt)
     return (pages.at[pid].set(pg.astype(jnp.int8)),
             scales.at[pid].set(s_new))
+
+
+def write_tokens(pages, scales, table, index, toks):
+    """The k-wide decode write (speculative verify): slot s's T tokens
+    ([S, H, T, D]) land at logical positions index[s] .. index[s] +
+    T - 1, crossing page boundaries wherever they fall — position j
+    resolves its OWN physical page through the table, so a block that
+    straddles two (or more) pages scatters into each. Rides
+    `write_token`'s math position by position (T is a static trace
+    constant), so int8 pages inherit the grow-only scale rescale
+    exactly: a later token that outranges the page re-rescales the
+    payload the earlier tokens just wrote. Rejected speculative tokens
+    need no undo — the caller rolls the per-slot index back and the
+    masked positions are rewritten by the next round's fixed-T write
+    before any query can see them."""
+    import jax.numpy as jnp
+
+    T = toks.shape[2]
+    index = jnp.asarray(index, jnp.int32)
+    for j in range(T):
+        pages, scales = write_token(pages, scales, table,
+                                    index + jnp.int32(j),
+                                    toks[:, :, j, :])
+    return pages, scales
 
 
 def copy_page(pages, scales, src, dst):

@@ -208,131 +208,20 @@ class ShardedServingEngine(ServingEngine):
         return self._weights_bytes
 
     # ------------------------------------------------------------------
-    # sharded compilation: same bodies, annotated
+    # sharded compilation + pool-state placement: the ShardedPlacement
+    # layer (serving/layers.py) wraps the SAME single-chip bodies in
+    # the mesh annotations and lays fresh pool state out over dp
     # ------------------------------------------------------------------
-    def _decode_specs(self):
-        return {"q": self._ns_pool, "kv": self._ns_pool,
-                "pages": self._ns_pool, "out": self._ns_pool}
+    def _make_placement(self):
+        from .layers import ShardedPlacement
 
-    def _constrain_state(self, state):
-        """Pin PartitionSpec('dp') on every pool carry (slot-leading
-        leaves; the paged page/scale arrays shard their page axis the
-        same way), replicating nothing implicitly — the ISSUE's
-        every-carry contract."""
-        import jax
+        return ShardedPlacement(self)
 
-        from ..nn.layer.transformer import MultiHeadAttention as MHA
-
-        c = lambda x: jax.lax.with_sharding_constraint(  # noqa: E731
-            x, self._ns_pool)
-        out = dict(state)
-        for k in ("tok", "bias", "mem", "hist", "plen", "pbk"):
-            if k in out:
-                out[k] = c(out[k])
-        if "inc" in out:
-            out["inc"] = [MHA.StaticKVCache(c(cc.k), c(cc.v),
-                                            c(cc.index))
-                          for cc in out["inc"]]
-        if "static" in out:
-            out["static"] = [(c(sk), c(sv)) for sk, sv in out["static"]]
-        if "paged" in out:
-            out["paged"] = [
-                {"k": c(pc["k"]), "v": c(pc["v"]),
-                 "ks": None if pc["ks"] is None else c(pc["ks"]),
-                 "vs": None if pc["vs"] is None else c(pc["vs"])}
-                for pc in out["paged"]]
-        return out
-
-    def _wrap_state_out(self, body, has_aux, key):
-        """jit a single-chip engine body with the sharded annotations:
-        decode kernels constrained via `decode_shardings`, every
-        returned carry pinned to the pool layout, the step-family
-        state carry donated per the shared `_donate_argnums`
-        declaration (same donation audit as the single-chip builders)."""
-        import jax
-
-        from ..ops import attention as A
-
-        specs = self._decode_specs()
-
-        def fn(*args):
-            with A.decode_shardings(specs):
-                out = body(*args)
-            if has_aux:
-                st, aux = out
-                return self._constrain_state(st), aux
-            return self._constrain_state(out)
-
-        return jax.jit(fn, donate_argnums=self._donate_argnums(key))
-
-    def _build_join(self, Pb):
-        return self._wrap_state_out(self._join_body(Pb), True,
-                                    ("join", Pb))
-
-    def _build_step(self, key):
-        return self._wrap_state_out(self._step_body(key), True, key)
-
-    def _build_spec_step(self, vkey):
-        # the spec verify body returns (state, (emit, n_emit)) — the
-        # same state-out contract, annotated identically
-        return self._wrap_state_out(self._spec_step_body(vkey), True,
-                                    vkey)
-
-    def _build_draft(self, dkey):
-        # pure gathers over dp-sharded per-slot rows; the SPMD
-        # partitioner follows the operand layouts, no pinning needed
-        import jax
-
-        return jax.jit(self._draft_body(dkey))
-
-    # ------------------------------------------------------------------
-    # pool state placement
-    # ------------------------------------------------------------------
     def _ensure_state(self, memory):
         if self._state is not None:
             return
         super()._ensure_state(memory)
-        self._state = self._place_state(self._state)
-
-    def _place_state(self, state):
-        """Lay the freshly-built pool state out on the decode mesh:
-        slot-leading leaves shard over dp (the KV pool is REBUILT with
-        `gen_cache`'s sharded constructors so the zeros never
-        materialize on one device)."""
-        import jax
-
-        L, S = self._pool_len, self.num_slots
-        dtype = state["mem"].dtype
-        decoder = self._net.decoder
-        out = dict(state)
-        for k in ("tok", "bias", "mem", "hist", "plen", "pbk"):
-            if k in state:
-                out[k] = jax.device_put(state[k], self._ns_pool)
-        out["static"] = [
-            (jax.device_put(sk, self._ns_pool),
-             jax.device_put(sv, self._ns_pool))
-            for sk, sv in state["static"]]
-        if "inc" in state:
-            out["inc"] = [layer.self_attn.gen_cache(
-                None, max_length=L, batch_size=S, dtype=dtype,
-                kv_sharding=self._ns_pool,
-                index_sharding=self._ns_pool)
-                for layer in decoder.layers]
-        if "paged" in state:
-            # pad the page-row count to a dp multiple so the page axis
-            # lays out evenly; rows past the trash row (num_pages) are
-            # never referenced by any table entry — pure padding
-            rows = self.num_pages + 1
-            padded = -(-rows // self._pool_dp) * self._pool_dp
-            paged = []
-            for layer in decoder.layers:
-                cc = layer.self_attn.gen_paged_cache(
-                    padded - 1, self.page_size, S, self.max_pages,
-                    dtype, self.kv_dtype, page_sharding=self._ns_pool)
-                paged.append({"k": cc.k, "v": cc.v, "ks": cc.k_scale,
-                              "vs": cc.v_scale})
-            out["paged"] = paged
-        return out
+        self._state = self.placement.place_state(self._state)
 
     # ------------------------------------------------------------------
     # shard-aware slot policy + gauges
@@ -429,24 +318,23 @@ class ShardedServingEngine(ServingEngine):
 
         return jax.jit(prefill_fn)
 
-    def _build_splice(self, Pb):
-        """The decode-slice half of a disaggregated join: land the
-        travelled K/V + bias + memory + first token in the pool at the
-        traced slot — `static_kv_splice`/`splice_rows` with the pool
-        constraints, one compile per prompt bucket."""
+    def _splice_math(self, Pb):
+        """The per-entry splice math (no trace counter): land one
+        travelled prefill's K/V + bias + memory + first token in the
+        pool at the traced slot — `static_kv_splice`/`splice_rows`
+        with the pool constraints. Shared verbatim by the single-entry
+        splice program and the batched scan over it."""
         import jax
         import jax.numpy as jnp
 
         from ..nn.layer.transformer import MultiHeadAttention as MHA
 
-        key = ("splice", Pb)
         ns, ns1 = self._ns_pool, self._ns_pool
         L = self._pool_len
         spec = bool(self.spec_k)
 
-        def splice_fn(state, slot, tok0, bias_row, kvs, statics,
-                      memory, prompt, length):
-            self.trace_counts[key] += 1
+        def splice(state, slot, tok0, bias_row, kvs, statics,
+                   memory, prompt, length):
             new_inc = [MHA.static_kv_splice(pool, slot, k, v,
                                             jnp.int32(Pb),
                                             constraint=(ns, ns1))
@@ -480,18 +368,160 @@ class ShardedServingEngine(ServingEngine):
                         (slot,)), ns)
             return out
 
+        return splice
+
+    def _build_splice(self, Pb):
+        """The decode-slice half of one disaggregated join, as its own
+        program — the single-entry path."""
+        import jax
+
+        key = ("splice", Pb)
+        math = self._splice_math(Pb)
+
+        def splice_fn(state, slot, tok0, bias_row, kvs, statics,
+                      memory, prompt, length):
+            self.trace_counts[key] += 1
+            return math(state, slot, tok0, bias_row, kvs, statics,
+                        memory, prompt, length)
+
         return jax.jit(splice_fn)
+
+    def _build_batched_splice(self, Pb, nb):
+        """`nb` ready prefills of one bucket land in the pool as ONE
+        program: a `lax.scan` of the per-entry splice math over the
+        stacked entries. Entry counts bucket to powers of two and the
+        pad repeats entry 0 — splicing the same (slot, data) twice is
+        idempotent, so padding never corrupts state. One dispatch per
+        (bucket, count-bucket) instead of one per request: a join
+        burst stops serializing `_poll_pending`."""
+        import jax
+
+        key = ("bsplice", Pb, nb)
+        math = self._splice_math(Pb)
+
+        def bsplice_fn(state, slots, tok0s, bias_rows, kvss, staticss,
+                       memories, prompts, lengths):
+            self.trace_counts[key] += 1
+
+            def body(st, xs):
+                slot, tok0, bias_row, kvs, statics, memory, prompt, \
+                    length = xs
+                return math(st, slot, tok0, bias_row, kvs, statics,
+                            memory, prompt, length), None
+
+            st, _ = jax.lax.scan(
+                body, state, (slots, tok0s, bias_rows, kvss, staticss,
+                              memories, prompts, lengths))
+            # the per-entry constraints live inside the scan body; pin
+            # the final carry too so the program's OUTPUT layout is
+            # explicit (the every-carry contract the analyzer audits)
+            return self.placement.constrain_state(st)
+
+        return jax.jit(bsplice_fn)
+
+    def _fail_pending_splice(self, s, r, e):
+        """Per-request isolation: the failed splice kills THIS
+        request's future, frees the slot, pool keeps serving."""
+        self.slots[s] = None
+        self._evict(s)
+        r.slot = None
+        if r._trace is not None:
+            _rt.on_splice_end(r, ok=False, error=e)
+        self.metrics.record_error("prefill_splice", e)
+        r.fail(e, self.clock())
+        self.metrics.record_finish("error", len(r.tokens))
+        self._cbs.emit("on_finish", r)
+
+    def _finish_splice(self, s, r, tok0):
+        self._pending.discard(s)
+        self._pending_info.pop(s, None)
+        if r._trace is not None:
+            _rt.on_splice_end(r, ok=True)
+        self._deliver(r, tok0, self.clock())
+
+    def _splice_one(self, s, info, r):
+        """Single ready prefill: the per-bucket splice program."""
+        import jax
+        import jax.numpy as jnp
+
+        Pb = info["Pb"]
+        try:
+            t1 = time.monotonic()
+            moved = jax.device_put(info["outs"], self._ns_repl)
+            jax.block_until_ready(moved)
+            self.metrics.record_collective(time.monotonic() - t1)
+            fn = self._program(("splice", Pb),
+                               lambda: self._build_splice(Pb))
+            tok0, kvs, statics, bias_row = moved
+            self._state = fn(self._state, jnp.int32(s), tok0,
+                             bias_row, kvs, statics,
+                             jnp.asarray(info["mem"]),
+                             jnp.asarray(info["prompt"]),
+                             jnp.asarray([info["P0"]], jnp.int32))
+            tok0 = int(tok0)
+        except Exception as e:
+            self._fail_pending_splice(s, r, e)
+            return False
+        self._finish_splice(s, r, tok0)
+        return True
+
+    def _splice_batch(self, Pb, ss):
+        """>= 2 ready prefills of one bucket: stack their travelled
+        outputs, move them to the decode slice in one transfer, and
+        land them with ONE scanned program. A dispatch failure fails
+        only the batch's requests (the pool keeps serving); the
+        fault-point gate already ran per entry, so injected faults
+        keep per-request isolation."""
+        import jax
+        import jax.numpy as jnp
+
+        infos = [self._pending_info[s] for s in ss]
+        reqs = [self.slots[s] for s in ss]
+        nb = bucket_size(len(ss))
+        pad = [0] * (nb - len(ss))        # repeat entry 0: idempotent
+        ix = list(range(len(ss))) + pad
+        try:
+            t1 = time.monotonic()
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs),
+                *[infos[i]["outs"] for i in ix])
+            moved = jax.device_put(stacked, self._ns_repl)
+            jax.block_until_ready(moved)
+            self.metrics.record_collective(time.monotonic() - t1)
+            fn = self._program(
+                ("bsplice", Pb, nb),
+                lambda: self._build_batched_splice(Pb, nb))
+            tok0s, kvss, staticss, bias_rows = moved
+            slots = jnp.asarray([ss[i] for i in ix], jnp.int32)
+            mems = jnp.asarray(np.stack(
+                [np.asarray(infos[i]["mem"]) for i in ix]))
+            prompts = jnp.asarray(np.stack(
+                [infos[i]["prompt"] for i in ix]))
+            lengths = jnp.asarray(
+                [[infos[i]["P0"]] for i in ix], jnp.int32)
+            self._state = fn(self._state, slots, tok0s, bias_rows,
+                             kvss, staticss, mems, prompts, lengths)
+            toks = np.asarray(tok0s)
+        except Exception as e:
+            for s, r in zip(ss, reqs):
+                self._fail_pending_splice(s, r, e)
+            return False
+        for i, (s, r) in enumerate(zip(ss, reqs)):
+            self._finish_splice(s, r, int(toks[i]))
+        return True
 
     def _poll_pending(self, now):
         """Splice every finished prefill into the pool. Runs once per
         iteration; a prefill whose arrays are not ready yet just stays
-        pending (the decode step keeps running without it)."""
+        pending (the decode step keeps running without it). Ready
+        prefills GROUP by prompt bucket: each group past one entry
+        lands via the batched-splice program — one dispatch per
+        bucket, not one per request."""
         if not self._pending:
             return False
         import jax
-        import jax.numpy as jnp
 
-        activated = False
+        ready = []
         for s in sorted(self._pending):
             info = self._pending_info.get(s)
             r = self.slots[s]
@@ -513,45 +543,27 @@ class ShardedServingEngine(ServingEngine):
                 jax.block_until_ready(info["outs"])
             self.metrics.record_prefill_step(
                 time.monotonic() - info["t0"])
-            Pb = info["Pb"]
+            # the fault-point gate fires PER REQUEST before any
+            # batching, so an injected splice fault isolates exactly
+            # one request whether or not its bucket batches
             try:
                 _PT_SPLICE()
-                t1 = time.monotonic()
-                moved = jax.device_put(info["outs"], self._ns_repl)
-                jax.block_until_ready(moved)
-                self.metrics.record_collective(time.monotonic() - t1)
-                key = ("splice", Pb)
-                fn = self._compiled.get(key)
-                if fn is None:
-                    fn = self._build_splice(Pb)
-                    self._compiled[key] = fn
-                    fn = self._compiled[key]   # observed wrapper
-                tok0, kvs, statics, bias_row = moved
-                self._state = fn(self._state, jnp.int32(s), tok0,
-                                 bias_row, kvs, statics,
-                                 jnp.asarray(info["mem"]),
-                                 jnp.asarray(info["prompt"]),
-                                 jnp.asarray([info["P0"]], jnp.int32))
-                tok0 = int(tok0)
             except Exception as e:
-                # per-request isolation: the failed splice kills THIS
-                # request's future, frees the slot, pool keeps serving
-                self.slots[s] = None
-                self._evict(s)
-                r.slot = None
-                if r._trace is not None:
-                    _rt.on_splice_end(r, ok=False, error=e)
-                self.metrics.record_error("prefill_splice", e)
-                r.fail(e, self.clock())
-                self.metrics.record_finish("error", len(r.tokens))
-                self._cbs.emit("on_finish", r)
+                self._fail_pending_splice(s, r, e)
                 continue
-            self._pending.discard(s)
-            self._pending_info.pop(s, None)
-            if r._trace is not None:
-                _rt.on_splice_end(r, ok=True)
-            self._deliver(r, tok0, self.clock())
-            activated = True
+            ready.append(s)
+        groups = {}
+        for s in ready:
+            groups.setdefault(self._pending_info[s]["Pb"],
+                              []).append(s)
+        activated = False
+        for Pb, ss in sorted(groups.items()):
+            if len(ss) == 1:
+                s = ss[0]
+                activated |= self._splice_one(
+                    s, self._pending_info[s], self.slots[s])
+            else:
+                activated |= self._splice_batch(Pb, ss)
         return activated
 
     def _evict(self, s):
@@ -610,6 +622,23 @@ class ShardedServingEngine(ServingEngine):
                  jax.device_put(jnp.zeros((1, L), jnp.float32), repl),
                  kvs, statics, mem1, jnp.zeros((1, Pb), jnp.int32),
                  one)))
+            # the batched-splice program for a 2-burst (larger bursts
+            # bucket up and compile on first use): warm-started AND
+            # audited by the program analyzer alongside the rest
+            stack2 = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                lambda *xs: jnp.stack(xs), t, t)
+            progs.append((
+                ("bsplice", Pb, 2),
+                lambda Pb=Pb: self._build_batched_splice(Pb, 2),
+                (state, jnp.zeros((2,), jnp.int32),
+                 jax.device_put(jnp.zeros((2,), jnp.int32), repl),
+                 jax.device_put(jnp.zeros((2, 1, L), jnp.float32),
+                                repl),
+                 jax.device_put(stack2(kvs), repl),
+                 jax.device_put(stack2(statics), repl),
+                 jnp.zeros((2, 1, M, Dm), dt),
+                 jnp.zeros((2, 1, Pb), jnp.int32),
+                 jnp.ones((2, 1), jnp.int32))))
         return progs
 
     def _inflight_prefills(self):
@@ -657,17 +686,3 @@ class ShardedPagedServingEngine(ShardedServingEngine, PagedServingEngine):
             # weights changed: re-place the mesh copies too
             self._scross = None
             self._place_params()
-
-    def _build_paged_join(self, Pb):
-        return self._wrap_state_out(self._paged_join_body(Pb), True,
-                                    ("pjoin", Pb))
-
-    def _build_paged_step(self, ck):
-        return self._wrap_state_out(self._paged_step_body(ck), True, ck)
-
-    def _build_attach(self):
-        return self._wrap_state_out(self._attach_body(), False,
-                                    ("attach",))
-
-    def _build_cow(self):
-        return self._wrap_state_out(self._cow_body(), False, ("cow",))

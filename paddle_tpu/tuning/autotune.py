@@ -72,6 +72,7 @@ def _dims_of(kernel, key):
         flash_decode        (d, L, dtype)
         flash_verify        (d, L, dtype, T)
         paged_flash_decode  (d, psz, dtype)
+        paged_flash_verify  (d, psz, dtype, T)
     """
     if kernel in ("flash_fwd", "flash_bwd"):
         d, sq, sk, dt = key
@@ -87,6 +88,10 @@ def _dims_of(kernel, key):
     if kernel == "paged_flash_decode":
         d, psz, dt = key
         return {"d": int(d), "psz": int(psz), "dtype": str(dt)}
+    if kernel == "paged_flash_verify":
+        d, psz, dt, T = key
+        return {"d": int(d), "psz": int(psz), "dtype": str(dt),
+                "T": int(T)}
     raise ValueError(f"unknown kernel {kernel!r}")
 
 
@@ -109,6 +114,15 @@ def candidates(kernel, key):
     if kernel == "paged_flash_decode":
         # dispatch-level knob only: the grid is (slot*head, page)
         return [{"kernel": True}, {"kernel": False}]
+    if kernel == "paged_flash_verify":
+        # the kernel grid is fixed by the pages, so kernel-on has no
+        # block freedom; kernel-off falls back to gather + the dense
+        # verify dispatch, whose split_k ladder IS tunable (legality
+        # at the nominal 8-mapped-pages logical length)
+        L = dims["psz"] * 8
+        return [{"kernel": True, "split_k": 0}] + \
+            [{"kernel": False, "split_k": n} for n in SPLIT_LADDER
+             if L % n == 0 and (L // n) % 128 == 0]
     raise ValueError(f"unknown kernel {kernel!r}")
 
 
@@ -127,6 +141,8 @@ def fallback_config(kernel, key):
         return {"split_k": A._pick_decode_splits_heuristic(dims["L"])}
     if kernel == "paged_flash_decode":
         return {"kernel": True}
+    if kernel == "paged_flash_verify":
+        return dict(A._paged_verify_heuristic())
     raise ValueError(f"unknown kernel {kernel!r}")
 
 
@@ -150,6 +166,10 @@ DEFAULT_KEYS = {
     "paged_flash_decode": [(d, psz, dt)
                            for d in (64, 128) for psz in (16, 64)
                            for dt in ("float32", "int8")],
+    "paged_flash_verify": [(d, psz, dt, T)
+                           for d in (64, 128) for psz in (16, 64)
+                           for dt in ("float32", "int8")
+                           for T in (2, 4)],
 }
 
 
@@ -221,6 +241,12 @@ def analytic_cost(kernel, key, config, batch=1, heads=1, causal=True):
         L = psz * 8  # nominal 8 mapped pages; relative cost only
         gather = 0.0 if config.get("kernel", True) else 2.0 * L * d * ib
         return {"flops": bh * 4.0 * L * d,
+                "bytes": bh * (2.0 * L * d * ib + gather)}
+    if kernel == "paged_flash_verify":
+        psz, T = dims["psz"], dims["T"]
+        L = psz * 8  # nominal 8 mapped pages; relative cost only
+        gather = 0.0 if config.get("kernel", True) else 2.0 * L * d * ib
+        return {"flops": bh * 4.0 * T * L * d,
                 "bytes": bh * (2.0 * L * d * ib + gather)}
     raise ValueError(f"unknown kernel {kernel!r}")
 
@@ -297,6 +323,29 @@ def build_runner(kernel, key, config, batch=4, heads=4):
         fn = jax.jit(lambda a, b, c, n: disp(
             a, b, c, n, split_k=int(config["split_k"])))
         return lambda: fn(q, kv, kv, length)
+    if kernel == "paged_flash_verify":
+        psz, T = dims["psz"], dims["T"]
+        n_pages, mp = 32, 8
+        q = jnp.asarray(rs.randn(batch, heads, T, d), jnp.float32)
+        pages = jnp.asarray(
+            rs.randn(n_pages + 1, heads, psz, d), jnp.float32)
+        tbl = jnp.asarray(
+            rs.randint(0, n_pages, (batch, mp)), jnp.int32)
+        length = jnp.full((batch,), mp * psz, jnp.int32)
+        use_kernel = bool(config.get("kernel", True)) and \
+            A._on_tpu()   # off-chip, both rows time the gather
+        #                   fallback (interpret mode would time the
+        #                   emulator, not the kernel)
+        if use_kernel:
+            fn = jax.jit(lambda a, kp, vp, t, n: A.paged_flash_verify(
+                a, kp, vp, None, None, t, n))
+        else:
+            split = int(config.get("split_k", 0)) or None
+            fn = jax.jit(lambda a, kp, vp, t, n: A.verify_attention(
+                a, A.paged_gather_kv(kp, None, t, a.dtype),
+                A.paged_gather_kv(vp, None, t, a.dtype), n,
+                split_k=split))
+        return lambda: fn(q, pages, pages, tbl, length)
     if kernel == "paged_flash_decode":
         psz = dims["psz"]
         n_pages, mp = 32, 8

@@ -35,6 +35,10 @@ Kernels and their tunable knobs:
                             the XLA gather path on devices where the
                             scalar-prefetch kernel loses (the grid is
                             (slot*head, page): no shape knob exists)
+    paged_flash_verify      {"kernel": bool, "split_k"} — the paged
+                            speculative verify: kernel-on (grid fixed
+                            by the pages) or gather + the dense verify
+                            dispatch at the tuned split_k
 
 Env switches: ``PT_TUNING=0`` disables every lookup (pure heuristics,
 zero table reads); ``PT_TUNING_TABLE=/path.json`` layers an extra
@@ -51,7 +55,7 @@ __all__ = ["TuningTable", "TableError", "KERNELS", "seq_bucket",
            "current_device_kind", "committed_table_path"]
 
 KERNELS = ("flash_fwd", "flash_bwd", "flash_decode", "flash_verify",
-           "paged_flash_decode")
+           "paged_flash_decode", "paged_flash_verify")
 
 #: knob names each kernel's config may carry (schema validation:
 #: unknown keys are tolerated — forward compat — but a config missing
@@ -62,6 +66,7 @@ KERNEL_KNOBS = {
     "flash_decode": ("split_k",),
     "flash_verify": ("split_k",),
     "paged_flash_decode": ("kernel",),
+    "paged_flash_verify": ("kernel", "split_k"),
 }
 
 #: bump when the key layout or knob semantics change: a mismatched

@@ -402,6 +402,85 @@ def test_sharded_spec_decode_bitmatch_single_trace():
     assert len([k for k in eng.trace_counts if k[0] == "sstep"]) == 1
 
 
+def test_sharded_paged_spec_bitmatch_single_trace_leakfree():
+    """The last cell of the (dense|paged) x (single|sharded) x
+    (spec on|off) grid: speculative decoding over the SHARDED PAGED
+    pool. Draft + pverify compile once each under the pool annotations
+    (sentinel armed), every request bit-matches eager, the allocator
+    drains leak-free."""
+    from paddle_tpu.serving import retrace_sentinel
+
+    stack = _small_stack(seed=95)
+    dec, embed, proj, D, V = stack
+    eng = ShardedServingEngine(dec, embed, proj, mesh=_mesh222(),
+                               num_slots=2, max_len=16, paged=True,
+                               page_size=8, spec_k=4)
+    assert type(eng).__name__ == "ShardedPagedServingEngine"
+    retrace_sentinel(eng).__enter__()   # disarmed by conftest teardown
+    sched = Scheduler(max_queue=8)
+    rs = np.random.RandomState(96)
+    reqs = [_mk_request(rs, D, V, pmax=4, nmax=6) for _ in range(4)]
+    for r in reqs:
+        sched.submit(r)
+    _drive(eng, sched, reqs)
+    eager_cache = {}
+    for r in reqs:
+        res = r.result(timeout=5)
+        assert res.ok, res
+        key = tuple(r.prompt.tolist())
+        if key not in eager_cache:
+            eager_cache[key] = _eager_reference(stack, r, max_new=6)
+        np.testing.assert_array_equal(
+            res.tokens, eager_cache[key][0][:len(res.tokens)])
+    spec = eng.metrics.snapshot()["speculation"]
+    assert spec["rounds"] >= 1
+    assert "sharded-paged" in spec["step_ms_by_variant"]
+    assert len([k for k in eng.trace_counts if k[0] == "draft"]) == 1
+    assert len([k for k in eng.trace_counts
+                if k[0] == "pverify"]) == 1
+    eng.flush_prefix_cache()
+    eng._alloc.check()
+    assert eng._alloc.pages_free == eng.num_pages
+
+
+def test_batched_splice_lands_burst_in_one_program():
+    """A same-bucket burst of disaggregated prefills splices through
+    ONE scanned program (('bsplice', Pb, nb) — pad-by-repeat bucketing)
+    instead of one dispatch each, bit-matching the eager oracle."""
+    stack = _small_stack(seed=97)
+    dec, embed, proj, D, V = stack
+    eng = ShardedServingEngine(dec, embed, proj, mesh=_mesh222(),
+                               num_slots=4, max_len=32,
+                               prefill="disaggregated",
+                               max_joins_per_iter=4)
+    sched = Scheduler(max_queue=16)
+    rs = np.random.RandomState(98)
+    # 4 requests in ONE bucket (P in 3..4 -> Pb=4), submitted together
+    reqs = []
+    for _ in range(4):
+        P = int(rs.randint(3, 5))
+        prompt = rs.randint(2, V, (P,)).astype(np.int32)
+        prompt[0] = 0
+        mem = np.random.RandomState(P * 7).randn(4, D).astype("f4")
+        reqs.append(Request(prompt, mem, max_new_tokens=6, eos_id=1))
+    for r in reqs:
+        sched.submit(r)
+    _drive(eng, sched, reqs)
+    eager_cache = {}
+    for r in reqs:
+        res = r.result(timeout=5)
+        assert res.ok, res
+        key = tuple(r.prompt.tolist())
+        if key not in eager_cache:
+            eager_cache[key] = _eager_reference(stack, r, max_new=6)
+        np.testing.assert_array_equal(
+            res.tokens, eager_cache[key][0][:len(res.tokens)])
+    bs = [k for k in eng.trace_counts if k[0] == "bsplice"]
+    assert bs, dict(eng.trace_counts)   # the batched path engaged
+    assert all(k[2] in (2, 4) for k in bs)
+    assert not eng._pending and not eng._pending_info
+
+
 # ----------------------------------------------------------------------
 # the early guard on single-chip engines
 # ----------------------------------------------------------------------

@@ -440,6 +440,12 @@ def test_serving_spec_guard_rails():
     with pytest.raises(ValueError, match="spec_k"):
         ServingEngine(dec, embed, proj, num_slots=2, max_len=32,
                       spec_k=1)
-    with pytest.raises(NotImplementedError, match="paged"):
-        ServingEngine(dec, embed, proj, num_slots=2, max_len=32,
-                      paged=True, spec_k=4)
+    # the paged pool accepts spec_k since the layer refactor: the
+    # speculative pool carries page-covered overhang positions and the
+    # pverify program family
+    eng = ServingEngine(dec, embed, proj, num_slots=2, max_len=32,
+                        paged=True, page_size=8, spec_k=4)
+    assert eng._pool_len % eng.page_size == 0
+    assert eng._pool_len >= eng.max_len + 4 - 1
+    eng._ensure_state(np.zeros((4, 32), np.float32))
+    assert eng.layout.spec_step_key()[0] == "pverify"

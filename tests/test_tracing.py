@@ -470,7 +470,8 @@ def _full_metrics():
     m.record_step_gap(0.001)
     m.record_prefill_step(0.003)
     m.record_collective(0.001)
-    m.record_spec_step(2, 6, 4, 0.0005, 0.002)
+    m.record_spec_step(2, 6, 4, 0.0005, 0.002, k_eff=3,
+                       variant="paged", k_shrinks=1, k_grows=0)
     m.record_iteration(1, 0.5, pages_in_use=3, pages_free=5,
                        bytes_per_active_token=128.0,
                        shard_occupancy=[0.5, 0.25])

@@ -120,6 +120,64 @@ def _drive_serving(point, action):
             raise RuntimeError("clean request failed after disarm")
 
 
+def _drive_paged_spec(point, action):
+    """The paged-verify fault cell: serving.decode_step armed on a
+    PAGED pool running SPECULATIVE decode (the pverify program path).
+    Exhausted retries must evict the in-flight requests with partials,
+    the allocator free list must return to its initial state after the
+    drain (no page leaked across the eviction/reset), and the revived
+    pool must serve clean spec traffic."""
+    from paddle_tpu.serving import Scheduler
+    from paddle_tpu.testing import faults
+
+    point = point.split("[", 1)[0]     # cell label -> real fault point
+    eng = _small_engine(paged=True, page_size=8, spec_k=4)
+    sched = Scheduler(max_queue=64)
+    plan = (dict(action="delay", delay_s=0.02, on="every", k=3)
+            if action == "delay" else dict(on="every", k=3))
+    inj = faults.inject(point, **plan)
+    accepted = []
+    try:
+        for r in _requests(8, seed=17):
+            sched.submit(r)
+            accepted.append(r)
+        it = 0
+        while sched.depth() > 0 or eng.occupancy() > 0:
+            eng.run_iteration(sched)
+            it += 1
+            if it > 2000:
+                raise RuntimeError("no convergence under faults")
+        fired = inj.fired
+    finally:
+        faults.reset()
+    if not fired:
+        raise RuntimeError(f"plan on {point} never fired")
+    for r in accepted:
+        if not r.future.done():
+            raise RuntimeError(f"hung future {r.id} ({point}/{action})")
+    # leak check: every page back on the free list after the drain
+    eng.flush_prefix_cache()
+    eng._alloc.check()
+    if eng._alloc.pages_free != eng.num_pages:
+        raise RuntimeError(
+            f"page leak: {eng._alloc.pages_free} free of "
+            f"{eng.num_pages} after drain")
+    # pool revives: clean spec traffic completes
+    sched2 = Scheduler(max_queue=16)
+    clean = _requests(3, seed=19)
+    for r in clean:
+        sched2.submit(r)
+    it = 0
+    while sched2.depth() > 0 or eng.occupancy() > 0:
+        eng.run_iteration(sched2)
+        it += 1
+        if it > 500:
+            raise RuntimeError("pool dead after disarm")
+    for r in clean:
+        if not r.result(timeout=0).ok:
+            raise RuntimeError("clean request failed after disarm")
+
+
 def _drive_checkpoint(point, action):
     import shutil
     import tempfile
@@ -260,6 +318,8 @@ MATRIX = (
     + [("serving.prefill", a, _drive_serving)
        for a in ("raise", "delay")]
     + [("serving.decode_step", a, _drive_serving)
+       for a in ("raise", "delay")]
+    + [("serving.decode_step[pspec]", a, _drive_paged_spec)
        for a in ("raise", "delay")]
     + [("checkpoint.write", a, _drive_checkpoint)
        for a in ("raise", "delay", "corrupt")]

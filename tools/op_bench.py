@@ -220,6 +220,7 @@ def _configs():
     cfgs += _configs_serving()
     cfgs += _configs_spec_decode()
     cfgs += _configs_paged_decode()
+    cfgs += _configs_paged_verify()
     cfgs += _configs_sharded_decode()
     return cfgs
 
@@ -1232,6 +1233,56 @@ def _configs_paged_decode():
                                                  "f32")),
         ("paged_decode_b8_L8192_p64_int8", direct(8, 8, 8192, 64, 64,
                                                   "int8")),
+    ]
+
+
+def _configs_paged_verify():
+    """Paged speculative-verify rows: the k-token verify block against
+    K/V reached through the page table (the paged spec pool's per-step
+    kernel call — `ops.attention.paged_verify_attention`), k in
+    {2, 4}, fp32 vs int8 pages. The verify-to-paged-decode step ratio
+    is the paged analogue of the spec_decode_verify rows: speculative
+    decoding on the paged pool wins when accepted run length beats it.
+    On the committed-baseline CPU backend the dispatcher routes to
+    gather + the dense verify reference (the rows exist so the TPU
+    driver's refresh shows the block-table pallas verify delta)."""
+
+    def direct(batch, heads, L, d, psz, T, kv_dtype, steps=30):
+        def bench():
+            import jax
+            import jax.numpy as jnp
+
+            from paddle_tpu.ops.attention import paged_verify_attention
+            from paddle_tpu.serving.paging import quantize_chunks
+
+            rs = np.random.RandomState(0)
+            mp = L // psz
+            n_pages = batch * mp
+            raw = jnp.asarray(
+                rs.randn(n_pages + 1, heads, psz, d).astype("f4"))
+            if kv_dtype == "int8":
+                pages, scales = quantize_chunks(raw, jnp.int8, True)
+            else:
+                pages, scales = raw, None
+            table = jnp.asarray(
+                rs.permutation(n_pages).astype("i4").reshape(batch, mp))
+            q = jnp.asarray(rs.randn(batch, heads, T, d).astype("f4"))
+            length = jnp.asarray(
+                rs.randint(L // 4, L, (batch,)), jnp.int32)
+
+            fn = jax.jit(
+                lambda q, kp, vp, t, n: paged_verify_attention(
+                    q, kp, vp, scales, scales, t, n))
+            return _time_direct(
+                lambda: fn(q, pages, pages, table, length), steps)
+
+        bench._direct = True
+        return bench
+
+    return [
+        (f"paged_verify_k{T}_{dt}",
+         direct(8, 8, 2048, 64, 16, T, dt))
+        for T in (2, 4) for dt in ("f32", "int8")
     ]
 
 

@@ -64,7 +64,12 @@ DEFAULT_BENCH = ("fused_optimizer",)
 #: one verify row and its plain-step pair, so a regression in the
 #: k-token verify path (the spec hot kernel) fails the gate
 SPEC_OPS = ("spec_decode_plain_b1_L2048",
-            "spec_decode_verify_k4_b1_L2048")
+            "spec_decode_verify_k4_b1_L2048",
+            # the paged spec pair (PR 13): the paged decode step and
+            # the paged k-token verify it widens into — a regression
+            # in the block-table verify path fails the gate
+            "paged_decode_b8_L2048_p16_f32",
+            "paged_verify_k4_f32")
 
 #: tuned-vs-fallback rows folded into the full-run default (PR 11):
 #: the autotuned flash_decode config must NEVER be slower than the
