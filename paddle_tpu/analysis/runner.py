@@ -127,6 +127,20 @@ def build_check_engines(include_sharded=True):
     out.append(("paged_spec", ServingEngine(
         dec, emb, proj, num_slots=4, max_len=32, paged=True,
         page_size=8, spec_k=4)))
+    # multi-tenant: int8 base weights + an adapter-carrying program
+    # set (ids + banks ride every join/step — the donation audit must
+    # see the banks stay undonated and the state carry donated)
+    from ..serving import AdapterPool
+
+    dec, emb, proj = _small_stack(seed=13)
+    # rank 8 puts the stacked banks past CHECK_LARGE_BYTES, so the
+    # donation audit sees them as the large undonated args they are
+    # in production (baselined: shared read-only across slots)
+    pool = AdapterPool(dec, capacity=3, rank=8)
+    pool.register_random("t1", seed=1)
+    out.append(("tenant", ServingEngine(
+        dec, emb, proj, num_slots=4, max_len=32, adapters=pool,
+        quantize="int8")))
     if include_sharded:
         mesh = _local_mesh(dp=2)
         if mesh is not None:

@@ -184,6 +184,45 @@ def linear(x, weight, bias=None, name=None):
     return _op("linear", K.linear, x, weight, bias)
 
 
+def linear_int8(x, weight_q, scale, bias=None, name=None):
+    """Scaled int8 matmul: `weight_q` int8 [in, out] + per-output-
+    channel f32 `scale` (ops.quant.quantize_int8_weight's layout),
+    fp32 accumulate, result in x's dtype — the serving engines'
+    quantize="int8" weight path (nn.Linear.quantize_int8)."""
+    from ...ops import quant as Qm
+
+    if bias is None:
+        return _op("linear_int8",
+                   lambda a, w, s: Qm.int8_matmul(a, w, s),
+                   x, weight_q, scale)
+    return _op("linear_int8",
+               lambda a, w, s, b: Qm.int8_matmul(a, w, s, b),
+               x, weight_q, scale, bias)
+
+
+def embedding_int8(x, weight_q, scale, dtype, name=None):
+    """Embedding lookup over an int8 table with per-output-channel
+    scales (nn.Embedding.quantize_int8's storage): gather + scale, no
+    dense dequantized copy."""
+    from ...ops import quant as Qm
+
+    return _op("embedding_int8",
+               lambda ids, w, s: Qm.int8_gather(ids, w, s, dtype),
+               x, weight_q, scale)
+
+
+def lora_delta(x, A, B, ids, name=None):
+    """Per-row low-rank adapter delta `(x @ A[ids]) @ B[ids]` over
+    stacked [n_adapters, ...] banks — the batched gathered matmul the
+    multi-tenant serving pool fuses into its decode step (see
+    ops.quant.lora_delta; ids row 0 = base model, zero delta)."""
+    from ...ops import quant as Qm
+
+    return _op("lora_delta",
+               lambda a, wa, wb, i: Qm.lora_delta(a, wa, wb, i),
+               x, A, B, ids)
+
+
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCHW", name=None):
     if data_format not in ("NCHW", "NHWC"):

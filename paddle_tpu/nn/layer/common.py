@@ -153,14 +153,62 @@ class Linear(Layer):
     def __init__(self, in_features, out_features, weight_attr=None,
                  bias_attr=None, name=None):
         super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
         self.weight = self.create_parameter(
             [in_features, out_features], attr=weight_attr,
             default_initializer=I.XavierUniform())
         self.bias = self.create_parameter(
             [out_features], attr=bias_attr, is_bias=True)
 
+    @property
+    def param_dtype(self):
+        """The compute dtype this layer's outputs carry — the live
+        weight's dtype, or the recorded pre-quantization dtype after
+        quantize_int8() dropped the fp32 weight."""
+        if self.weight is not None:
+            return self.weight._data.dtype
+        return self._weight_dtype
+
+    def quantize_int8(self):
+        """Serving-time weight quantization: replace the fp32 weight
+        parameter with a symmetric per-output-channel int8 buffer +
+        f32 scales (ops.quant.quantize_int8_weight) and route forward
+        through the scaled-int8 matmul. One-way (serving engines own
+        the model by contract); bias/compute dtype untouched."""
+        if self.weight is None:
+            return self
+        from ...core.tensor import Tensor
+        from ...ops import quant as Q
+
+        w = self.weight._data
+        q, s = Q.quantize_int8_weight(w)
+        self._weight_dtype = w.dtype
+        self.register_buffer("weight_q", Tensor._wrap(q))
+        self.register_buffer("weight_scale", Tensor._wrap(s))
+        self.weight = None          # drops the fp32 copy from params
+        return self
+
     def forward(self, x):
-        return F.linear(x, self.weight, self.bias)
+        if self.weight is None and "weight_q" in self._buffers:
+            y = F.linear_int8(x, self.weight_q, self.weight_scale,
+                              self.bias)
+        else:
+            y = F.linear(x, self.weight, self.bias)
+        # batched-LoRA hook: an AdapterPool installs `_lora_idx` on its
+        # target layers; inside a serving step's `lora_scope` the
+        # per-row adapter delta joins the output (one dict read + one
+        # scope read when installed, nothing at all otherwise)
+        idx = self.__dict__.get("_lora_idx")
+        if idx is not None:
+            from ...ops.quant import current_lora
+
+            ctx = current_lora()
+            if ctx is not None:
+                ids, banks = ctx
+                A, B = banks[idx]
+                y = y + F.lora_delta(x, A, B, ids)
+        return y
 
 
 class Embedding(Layer):
@@ -175,7 +223,29 @@ class Embedding(Layer):
         if padding_idx is not None:
             self.weight._data = self.weight._data.at[padding_idx].set(0.0)
 
+    def quantize_int8(self):
+        """Serving-time vocab-table quantization: the [V, D] table
+        becomes int8 + per-output-channel f32 scales; lookups gather
+        int8 rows and scale (no dense dequantized copy). Mirrors
+        Linear.quantize_int8 — the embedding is the one-hot matmul."""
+        if self.weight is None:
+            return self
+        from ...core.tensor import Tensor
+        from ...ops import quant as Q
+
+        w = self.weight._data
+        q, s = Q.quantize_int8_weight(w)
+        self._weight_dtype = w.dtype
+        self.register_buffer("weight_q", Tensor._wrap(q))
+        self.register_buffer("weight_scale", Tensor._wrap(s))
+        self.weight = None
+        return self
+
     def forward(self, x):
+        if self.weight is None and "weight_q" in self._buffers:
+            return F.embedding_int8(x, self.weight_q,
+                                    self.weight_scale,
+                                    self._weight_dtype)
         return F.embedding(x, self.weight, self._padding_idx, self._sparse)
 
 

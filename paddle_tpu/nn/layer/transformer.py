@@ -346,7 +346,7 @@ class MultiHeadAttention(Layer):
 
             b = batch_size if batch_size is not None else key.shape[0]
             if dtype is None:
-                dtype = self.q_proj.weight._data.dtype
+                dtype = self.q_proj.param_dtype
             buf = jnp.zeros(
                 (int(b), self.num_heads, int(max_length), self.head_dim),
                 dtype)

@@ -27,6 +27,15 @@ batching over the static KV cache:
     observer, chrome-trace export) and the `retrace_sentinel` standing
     "never retraces" assertion (README "Observability").
 
+Multi-tenant serving (README "Multi-tenant serving"):
+`adapters.AdapterPool` serves many LoRA fine-tunes from ONE slot pool —
+per-slot adapter ids as traced inputs + stacked A/B banks gathered in
+ONE batched matmul inside the existing step programs (tenant switches
+and hot-load/evict never retrace), refcounted bank rows with
+`OutOfAdapters` backpressure, and `quantize="int8"` base weights
+(symmetric per-output-channel, fp32 compute) shrinking the shared base
+so the freed HBM pays for slots and adapters.
+
 Failure isolation (README "Fault tolerance"): joins/decodes run under
 retry+backoff with an optional watchdog; a failed join kills one
 future (or degrades to `generate_eager`), a failed decode step evicts
@@ -35,6 +44,7 @@ serving, and a wedged loop marks the server dead (`ServerCrashed`)
 with every future resolved. All of it is deterministically testable
 via the `serving.*` fault points in `paddle_tpu.testing.faults`.
 """
+from .adapters import AdapterPool, OutOfAdapters, quantize_net
 from .engine import (ArtifactServingEngine, PagedServingEngine,
                      ServingEngine, WatchdogTimeout)
 from .metrics import (CallbackList, ServingCallback, ServingMetrics,
@@ -54,4 +64,5 @@ __all__ = [
     "WatchdogTimeout", "ServerCrashed", "OutOfPages", "PageAllocator",
     "PagedKVCache", "PrefixCache", "RetraceError", "RetraceSentinel",
     "retrace_sentinel", "session_scope", "to_prometheus",
+    "AdapterPool", "OutOfAdapters", "quantize_net",
 ]

@@ -1,0 +1,378 @@
+"""Multi-tenant adapter serving: batched LoRA banks over one shared base.
+
+Millions of users means thousands of fine-tuned variants, not one model
+per pool. This module lets ONE serving engine carry many tenants:
+
+  * every target Linear of the decoder (self-attention QKV/out-proj and
+    the FFN pair, per layer) gets a row in stacked device banks
+    ``A [capacity, d_in, r]`` / ``B [capacity, r, d_out]`` — row 0 is
+    the base model and stays all-zero, so base requests ride the same
+    compiled step with an exactly-zero delta;
+  * per-slot adapter ids ship into the decode/prefill programs as
+    traced int32 inputs (the page-table trick), and the delta is ONE
+    gathered batched matmul (`ops.quant.lora_delta`) fused into the
+    existing single-dispatch step — joining a new tenant, switching
+    adapters, and hot-load/evict NEVER retrace;
+  * `AdapterPool` is the host-side bookkeeping, riding the
+    PageAllocator pattern: a free list + refcounts over bank rows,
+    LRU reuse of zero-reference rows (a released adapter stays hot
+    until its row is needed — the adapter cache), and `OutOfAdapters`
+    backpressure when every row is pinned by a live slot (the engine
+    defers the queue head via `Scheduler.push_front`, exactly like
+    OutOfPages);
+  * `quantize_net` applies the int8 weight path to the whole serving
+    stack (`nn.Linear/Embedding.quantize_int8`), shrinking the base
+    weights the tenants share — the HBM the ledger frees is what pays
+    for more slots and more adapters at equal memory.
+
+Host-side only: banks are plain jax arrays handed to the engine per
+dispatch; loading an adapter is a functional ``.at[row].set`` per
+target (a partial load can never be observed — the fault point fires
+before any write). Single-threaded by the engine contract, like the
+PageAllocator.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+from ..testing import faults
+
+__all__ = ["OutOfAdapters", "AdapterPool", "quantize_net",
+           "decoder_lora_targets"]
+
+#: fault point on the adapter hot-load (the device bank write): chaos
+#: cells prove a transient load retries and a persistent one isolates
+#: only that tenant's requests
+_PT_ADAPTER_LOAD = faults.point("serving.adapter_load")
+
+
+class OutOfAdapters(RuntimeError):
+    """Every adapter bank row is pinned by a live slot: backpressure —
+    the engine defers the queue head until a tenant's last slot
+    drains and frees a row."""
+
+
+def decoder_lora_targets(decoder):
+    """The per-layer large dense matmuls adapters attach to: self-attn
+    Q/K/V/out projections + the FFN pair, in layer order. Cross-attn
+    and norms stay base-only (the prefix-attach path runs cross-attn
+    K/V alone, so a shared-prefix join needs no banks)."""
+    out = []
+    for layer in decoder.layers:
+        sa = layer.self_attn
+        out.extend((sa.q_proj, sa.k_proj, sa.v_proj, sa.out_proj))
+        out.extend((layer.linear1, layer.linear2))
+    return out
+
+
+def quantize_net(decoder, embed=None, project=None):
+    """int8-quantize every large dense weight of a serving stack: the
+    decoder's self/cross-attention projections and FFN pairs, the
+    token embedding's vocab table, and the logits projection.
+    Symmetric per-output-channel scales, fp32 compute preserved
+    (ops.quant); biases and norms stay fp32. In-place and one-way —
+    the engine owns the model once it serves it."""
+    n = 0
+    for layer in decoder.layers:
+        for attn in (layer.self_attn, layer.cross_attn):
+            for lin in (attn.q_proj, attn.k_proj, attn.v_proj,
+                        attn.out_proj):
+                lin.quantize_int8()
+                n += 1
+        layer.linear1.quantize_int8()
+        layer.linear2.quantize_int8()
+        n += 2
+    if embed is not None and hasattr(embed, "quantize_int8"):
+        embed.quantize_int8()
+        n += 1
+    if project is not None and hasattr(project, "quantize_int8"):
+        project.quantize_int8()
+        n += 1
+    return n
+
+
+class AdapterPool:
+    """Refcounted hot-load/evict of LoRA adapter banks for one serving
+    engine. ``capacity`` counts bank rows INCLUDING the reserved base
+    row 0; ``rank`` is the shared low-rank r; ``alpha`` the LoRA
+    scaling (B is stored pre-scaled by alpha/r, so the serving delta
+    and the merged-weight oracle share one convention). Tenants
+    `register()` host-side weights once; `acquire()` pins a bank row
+    for a slot (loading over the LRU zero-reference row on a miss)
+    and `release()` unpins it — a zero-reference adapter stays HOT
+    until its row is reused, which is the adapter cache the hit-rate
+    gauge measures."""
+
+    def __init__(self, decoder, *, capacity=4, rank=8, alpha=None):
+        if capacity < 2:
+            raise ValueError("capacity must be >= 2 (the reserved "
+                             "base row plus at least one adapter)")
+        if rank < 1:
+            raise ValueError("rank must be >= 1")
+        import jax.numpy as jnp
+
+        self.decoder = decoder
+        self.capacity = int(capacity)
+        self.rank = int(rank)
+        self.alpha = float(alpha) if alpha is not None else float(rank)
+        self.targets = decoder_lora_targets(decoder)
+        self._dims = []
+        for i, lin in enumerate(self.targets):
+            lin._lora_idx = i
+            self._dims.append((int(lin.in_features),
+                               int(lin.out_features)))
+        self._A = [jnp.zeros((self.capacity, din, self.rank),
+                             jnp.float32) for din, _ in self._dims]
+        self._B = [jnp.zeros((self.capacity, self.rank, dout),
+                             jnp.float32) for _, dout in self._dims]
+        self._registry = {}            # name -> [(A, B_scaled) numpy]
+        self._gen = {}                 # name -> registration count:
+        #                                per-tenant prefix-cache keys
+        #                                carry it, so re-registered
+        #                                weights can never serve a
+        #                                stale cached prefix
+        self._rows = {}                # name -> hot bank row
+        self._row_name = {}            # row -> name
+        self.refcount = np.zeros(self.capacity, np.int64)
+        self._free = list(range(self.capacity - 1, 0, -1))
+        self._lru = collections.OrderedDict()   # zero-ref hot rows
+        #: bumped per load so placements (sharded device_put) can
+        #: cache the placed banks between loads
+        self.version = 0
+        self.loads = 0
+        self.evictions = 0
+        self.hits = 0
+        self.misses = 0
+        self._metrics = None
+
+    # ---- engine wiring ----
+    def bind_metrics(self, metrics):
+        """The engine mirrors pool events into its ServingMetrics
+        tenancy section."""
+        self._metrics = metrics
+
+    # ---- tenant registry (host-side cold storage) ----
+    def register(self, name, weights):
+        """Register a tenant's adapter: `weights` is a list aligned
+        with `targets` of (A [d_in, r], B [r, d_out]) arrays (or None
+        for targets the adapter leaves at base). B is stored pre-
+        scaled by alpha/r."""
+        if name is None or name == "base":
+            raise ValueError("adapter name None/'base' is reserved "
+                             "for the base model")
+        if len(weights) != len(self.targets):
+            raise ValueError(
+                f"adapter {name!r} has {len(weights)} target entries, "
+                f"pool targets {len(self.targets)}")
+        s = self.alpha / self.rank
+        stored = []
+        for i, wpair in enumerate(weights):
+            din, dout = self._dims[i]
+            if wpair is None:
+                stored.append((np.zeros((din, self.rank), np.float32),
+                               np.zeros((self.rank, dout), np.float32)))
+                continue
+            wa, wb = wpair
+            wa = np.asarray(wa, np.float32)
+            wb = np.asarray(wb, np.float32) * s
+            if wa.shape != (din, self.rank) or \
+                    wb.shape != (self.rank, dout):
+                raise ValueError(
+                    f"adapter {name!r} target {i}: shapes "
+                    f"{wa.shape}/{wb.shape} != "
+                    f"({din}, {self.rank})/({self.rank}, {dout})")
+            stored.append((wa, wb))
+        # re-registration swaps the tenant's weights: refuse under
+        # live traffic (a slot mid-decode on the old weights), drop a
+        # zero-reference hot row so the next acquire reloads, and
+        # bump the generation the per-tenant prefix keys carry (a
+        # cached prefix prefilled under the OLD weights must miss)
+        row = self._rows.get(name)
+        if row is not None:
+            if self.refcount[row] > 0:
+                raise ValueError(
+                    f"adapter {name!r} is pinned by a live slot; "
+                    f"drain it before re-registering new weights")
+            self._lru.pop(row, None)
+            del self._rows[name]
+            del self._row_name[row]
+            self._free.append(row)
+        self._registry[name] = stored
+        self._gen[name] = self._gen.get(name, 0) + 1
+        return self
+
+    def generation(self, name):
+        """Registration generation for `name` (0 = unregistered) —
+        folded into the paged engine's per-tenant prefix keys."""
+        return self._gen.get(name, 0)
+
+    def register_random(self, name, seed=0, scale=0.1):
+        """Convenience for tests/benches: a deterministic random
+        adapter across every target."""
+        rs = np.random.RandomState(seed)
+        ws = [(rs.randn(din, self.rank).astype(np.float32) * scale,
+               rs.randn(self.rank, dout).astype(np.float32) * scale)
+              for din, dout in self._dims]
+        return self.register(name, ws)
+
+    def registered(self, name):
+        return name in self._registry
+
+    def tenants(self):
+        return sorted(self._registry)
+
+    def merged_weights(self, name):
+        """[(target_index, merged W' = W + A @ B_scaled)] for a
+        registered tenant — the oracle the acceptance tests serve a
+        solo engine with. Requires the targets to still hold fp32
+        weights (merge before quantize_net)."""
+        from ..ops.quant import merge_lora_weight
+
+        out = []
+        for i, (wa, wb) in enumerate(self._registry[name]):
+            lin = self.targets[i]
+            if lin.weight is None:
+                raise ValueError("merged_weights needs fp32 target "
+                                 "weights (quantized in place)")
+            out.append((i, merge_lora_weight(lin.weight._data, wa, wb)))
+        return out
+
+    # ---- hot rows: acquire / release / load ----
+    def can_acquire(self, name):
+        """Admission headroom: True when `name` is already hot or a
+        bank row is free/evictable RIGHT NOW. The engine's admission
+        gate consults this and defers (push_front) on False instead
+        of letting the join raise."""
+        return (name in self._rows or bool(self._free)
+                or bool(self._lru))
+
+    def acquire(self, name):
+        """Pin a bank row for one slot serving `name` and return the
+        row id. Hot adapters hit the cache; a miss loads into a free
+        row (or evicts the LRU zero-reference adapter for its row).
+        Raises KeyError for unregistered names and OutOfAdapters when
+        every row is pinned."""
+        if name is None:
+            return 0
+        if name not in self._registry:
+            raise KeyError(f"adapter {name!r} is not registered "
+                           f"(tenants: {self.tenants()})")
+        row = self._rows.get(name)
+        if row is not None:
+            self.hits += 1
+            if self._metrics is not None:
+                self._metrics.record_adapter_acquire(True)
+            self._lru.pop(row, None)
+            self.refcount[row] += 1
+            return row
+        self.misses += 1
+        if self._metrics is not None:
+            self._metrics.record_adapter_acquire(False)
+        if self._free:
+            row = self._free.pop()
+        elif self._lru:
+            row, _ = self._lru.popitem(last=False)
+            old = self._row_name.pop(row)
+            del self._rows[old]
+            self.evictions += 1
+            if self._metrics is not None:
+                self._metrics.record_adapter_eviction()
+        else:
+            raise OutOfAdapters(
+                f"every adapter row is pinned by a live slot "
+                f"({self.capacity - 1} rows, base excluded)")
+        try:
+            self._load(row, name)
+        except Exception:
+            # the row never became visible: back to the free list
+            self._free.append(row)
+            raise
+        self._rows[name] = row
+        self._row_name[row] = name
+        self.refcount[row] = 1
+        return row
+
+    def _load(self, row, name):
+        """The device write: every target's bank row set from the
+        registry. The fault point fires FIRST, so an injected failure
+        leaves the banks untouched (functional updates commit only on
+        full success)."""
+        _PT_ADAPTER_LOAD()
+        newA, newB = [], []
+        for i, (wa, wb) in enumerate(self._registry[name]):
+            newA.append(self._A[i].at[row].set(wa))
+            newB.append(self._B[i].at[row].set(wb))
+        self._A, self._B = newA, newB
+        self.loads += 1
+        self.version += 1
+        if self._metrics is not None:
+            self._metrics.record_adapter_load()
+
+    def release(self, row):
+        """Unpin one slot's reference. A row reaching zero references
+        stays hot (LRU-evictable) — the next acquire of the same
+        tenant is a free cache hit."""
+        row = int(row)
+        if row == 0:
+            return
+        if self.refcount[row] <= 0:
+            raise RuntimeError(f"release on unpinned adapter row {row}")
+        self.refcount[row] -= 1
+        if self.refcount[row] == 0:
+            self._lru[row] = True
+
+    # ---- the device-side banks the programs take ----
+    def banks(self):
+        """[(A, B)] per target — the traced inputs every adapter-
+        carrying program receives. A fresh list each call (the arrays
+        are immutables; hot-loads swap them)."""
+        return list(zip(self._A, self._B))
+
+    def bytes(self):
+        """Logical device bytes of the stacked banks (the HBM ledger's
+        adapter component): capacity * (d_in + d_out) * r * 4 summed
+        over targets — exactly the analytic footprint."""
+        return sum(int(a.size) * 4 + int(b.size) * 4
+                   for a, b in zip(self._A, self._B))
+
+    def name_of(self, row):
+        """Tenant name for a bank row (None = base)."""
+        return self._row_name.get(int(row))
+
+    @property
+    def hit_rate(self):
+        n = self.hits + self.misses
+        return (self.hits / n) if n else 0.0
+
+    def check(self):
+        """Invariants (the leak checks pivot on this, like
+        PageAllocator.check): free rows, hot zero-ref rows, and
+        pinned rows partition 1..capacity-1 exactly; refcounts are
+        never negative; every hot name maps a consistent row."""
+        free = set(self._free)
+        if len(free) != len(self._free):
+            raise AssertionError("duplicate adapter rows on the free "
+                                 "list")
+        hot = set(self._rows.values())
+        if free & hot:
+            raise AssertionError(f"adapter rows both free and hot: "
+                                 f"{sorted(free & hot)}")
+        if free | hot != set(range(1, self.capacity)):
+            raise AssertionError(
+                "leaked adapter rows: "
+                f"{sorted(set(range(1, self.capacity)) - free - hot)}")
+        if (self.refcount < 0).any():
+            raise AssertionError("negative adapter refcount")
+        for row in free:
+            if self.refcount[row] != 0:
+                raise AssertionError(f"free adapter row {row} holds "
+                                     f"references")
+        for name, row in self._rows.items():
+            if self._row_name.get(row) != name:
+                raise AssertionError(f"row map out of sync at {row}")
+            if self.refcount[row] == 0 and row not in self._lru:
+                raise AssertionError(f"zero-ref hot row {row} not "
+                                     f"LRU-evictable")
+        return True

@@ -154,6 +154,11 @@ class _EngineBase:
     decode step over the active mask. NOT thread-safe — drive it from
     one thread (the `ServingServer` loop or a synchronous drain)."""
 
+    #: the multi-tenant AdapterPool (serving/adapters.py); model-backed
+    #: engines set it from the `adapters=` knob, the Artifact engine
+    #: never does — base-class code guards on None
+    _apool = None
+
     def __init__(self, num_slots, *, max_joins_per_iter=2, metrics=None,
                  callbacks=(), clock=time.monotonic, max_attempts=3,
                  backoff_base_s=0.01, backoff_cap_s=0.5,
@@ -470,11 +475,18 @@ class _EngineBase:
         r.finish(reason, now)
         self._cbs.emit("on_finish", r)
 
+    def _tenant_of(self, r):
+        """Tenant label for per-tenant accounting (None = tenancy off:
+        the engine carries no AdapterPool)."""
+        if self._apool is None:
+            return None
+        return getattr(r, "adapter", None) or "base"
+
     def _deliver(self, r, tok, now):
         if r.state == "DONE":
             return
         r.tokens.append(tok)
-        self.metrics.record_token()
+        self.metrics.record_token(self._tenant_of(r))
         if r.first_token_at is None:
             r.first_token_at = now
             if r._trace is not None:
@@ -696,7 +708,8 @@ class ServingEngine(_EngineBase):
                  eager_fallback=False, paged=False, spec_k=None,
                  spec_ngram=2, spec_adapt=True, spec_adapt_low=0.15,
                  spec_adapt_high=0.6, spec_adapt_patience=4,
-                 spec_adapt_alpha=0.3, **kw):
+                 spec_adapt_alpha=0.3, adapters=None, quantize=None,
+                 **kw):
         super().__init__(num_slots, max_joins_per_iter=max_joins_per_iter,
                          metrics=metrics, callbacks=callbacks, clock=clock,
                          **kw)
@@ -705,6 +718,35 @@ class ServingEngine(_EngineBase):
         from .layers import (DenseLayout, PagedLayout, PlainStepper,
                              SpecStepper)
 
+        # int8 base weights: quantize="int8" rewrites every large
+        # dense weight of the stack (decoder projections + FFN, the
+        # embedding vocab table, the logits projection) to symmetric
+        # per-output-channel int8 + f32 scales BEFORE functionalize
+        # snapshots the state — the compiled programs then carry int8
+        # weight buffers and the scaled-int8 matmul path
+        # (ops/quant.py). In place and one-way: the engine owns the
+        # model it serves. With quantize=None nothing is touched and
+        # the fp32 path is bit-identical to every prior PR.
+        if quantize is not None:
+            if str(quantize) != "int8":
+                raise ValueError(f"quantize={quantize!r}: only 'int8' "
+                                 f"is supported")
+            from .adapters import quantize_net
+
+            quantize_net(decoder, embed, project)
+        self.quantize = quantize
+        # batched LoRA adapters: an AdapterPool turns every step/join
+        # program into an adapter-carrying one — per-slot adapter ids
+        # + stacked A/B banks ride in as traced inputs, so tenant
+        # switches and hot-load/evict never retrace
+        if adapters is not None and adapters.decoder is not decoder:
+            raise ValueError("the AdapterPool was built for a "
+                             "different decoder than this engine "
+                             "serves")
+        self._apool = adapters
+        self._adapter_rows = np.zeros(int(num_slots), np.int64)
+        if adapters is not None:
+            adapters.bind_metrics(self.metrics)
         self.eager_fallback = bool(eager_fallback)
         self.max_len = int(max_len)
         # speculative decoding (text/speculative.py): spec_k >= 2 turns
@@ -779,6 +821,101 @@ class ServingEngine(_EngineBase):
             return "sharded-" + base
         return base
 
+    # ---- multi-tenant adapter plumbing (serving/adapters.py) ----
+    def _adapter_pool_key(self):
+        """Adapter-config component of the pool key: adapter-carrying
+        programs have different signatures (ids + banks ride in), so
+        the jit-cache/AOT identities must not collide with a
+        base-only pool of the same shape."""
+        if self._apool is None:
+            return ()
+        p = self._apool
+        return (("lora", p.capacity, p.rank, len(p.targets)),)
+
+    def _placed_banks(self):
+        """The stacked A/B banks as the programs' traced inputs (the
+        sharded engine overrides with a mesh-replicated copy, cached
+        per pool version)."""
+        return self._apool.banks()
+
+    def _adapter_args(self):
+        """(per-slot adapter ids [S] int32, banks) appended to every
+        step-family dispatch — traced data, never part of a cache
+        key, so adapter switches and hot-loads never retrace."""
+        if self._apool is None:
+            return ()
+        import jax.numpy as jnp
+
+        return (jnp.asarray(self._adapter_rows.astype(np.int32)),
+                self._placed_banks())
+
+    def _lora_ctx(self, ad):
+        """The trace scope a program body opens around fm.apply: `ad`
+        is the body's (ids-or-scalar, banks) tail (empty when the
+        engine carries no pool — a zero-cost nullcontext)."""
+        import contextlib
+
+        if not ad:
+            return contextlib.nullcontext()
+        import jax.numpy as jnp
+
+        from ..ops.quant import lora_scope
+
+        ids, banks = ad
+        return lora_scope(jnp.asarray(ids, jnp.int32).reshape(-1),
+                          banks)
+
+    def _acquire_adapter(self, r):
+        """Pin the request's adapter bank row for its slot (0 = base).
+        Runs inside the join attempt, so a transient load fault rides
+        the join's retry loop; the caller releases on a later join
+        failure."""
+        if self._apool is None:
+            return 0
+        name = getattr(r, "adapter", None)
+        if name is None:
+            return 0
+        return self._apool.acquire(name)
+
+    def _release_adapter_row(self, row):
+        if self._apool is not None and row:
+            self._apool.release(row)
+
+    def _adapter_gate(self, r):
+        """Admission headroom for the request's adapter: False defers
+        the queue head (push_front) until a bank row frees — the
+        OutOfAdapters backpressure path, mirroring OutOfPages."""
+        if self._apool is None:
+            return True
+        name = getattr(r, "adapter", None)
+        if name is None or self._apool.can_acquire(name):
+            return True
+        self.metrics.record_adapter_wait()
+        return False
+
+    def _admission_gate(self, r):
+        return self._adapter_gate(r)
+
+    def _tenant_slot_counts(self):
+        out = {}
+        for s, req in enumerate(self.slots):
+            if req is None:
+                continue
+            t = self._tenant_of(req)
+            out[t] = out.get(t, 0) + 1
+        return out
+
+    def _iteration_gauges(self):
+        if self._apool is None:
+            return None
+        return {"tenant_slots": self._tenant_slot_counts()}
+
+    def _evict(self, s):
+        row = int(self._adapter_rows[s])
+        if row:
+            self._adapter_rows[s] = 0
+            self._release_adapter_row(row)
+
     def _params(self):
         """Param pytree the compiled programs run over. The sharded
         engine overrides this with its mesh-placed copy."""
@@ -812,14 +949,22 @@ class ServingEngine(_EngineBase):
         unmapped pages."""
         return self.pool_bytes()
 
+    def adapter_bytes(self):
+        """Byte footprint of the stacked LoRA banks (0 without an
+        AdapterPool) — the ledger's adapter component, exactly the
+        pool's analytic capacity * (d_in + d_out) * r * 4 sum."""
+        return 0 if self._apool is None else self._apool.bytes()
+
     def memory_ledger(self):
-        """The `memory` section's raw components — weights, pool, live
-        bytes, and the compile temp high-water from the armed cost
-        book (0 when accounting is off)."""
+        """The `memory` section's raw components — weights, pool,
+        adapter banks, live bytes, and the compile temp high-water
+        from the armed cost book (0 when accounting is off)."""
         w = self.weights_bytes()
         p = self.pool_bytes()
+        a = self.adapter_bytes()
         return {"weights_bytes": w, "pool_bytes": p,
-                "in_use_bytes": w + self.pool_in_use_bytes(),
+                "adapter_bytes": a,
+                "in_use_bytes": w + a + self.pool_in_use_bytes(),
                 "compile_temp_peak_bytes": _costs.temp_high_water()}
 
     # ---- analytic cost hints (profiler.costs fallback) ----
@@ -877,6 +1022,16 @@ class ServingEngine(_EngineBase):
         return None
 
     def admit_check(self, r):
+        name = getattr(r, "adapter", None)
+        if name is not None:
+            if self._apool is None:
+                raise ValueError(
+                    f"request names adapter {name!r} but this engine "
+                    f"carries no AdapterPool (adapters=)")
+            if not self._apool.registered(name):
+                raise ValueError(
+                    f"adapter {name!r} is not registered with the "
+                    f"pool (tenants: {self._apool.tenants()})")
         P = max(1, int(r.prompt.shape[0]))
         Pb = bucket_size(P)
         if Pb + r.max_new_tokens > self.max_len:
@@ -914,21 +1069,38 @@ class ServingEngine(_EngineBase):
                 self.weights_bytes() + self.pool_bytes())
 
     # ------------------------------------------------------------------
+    def _join_adapter_args(self, row):
+        """The (adapter id, banks) tail a join/prefill program takes
+        when the engine carries a pool (batch-1: one traced scalar
+        id)."""
+        if self._apool is None:
+            return ()
+        import jax.numpy as jnp
+
+        return (jnp.int32(row), self._placed_banks())
+
     def _join(self, s, r):
         import jax.numpy as jnp
 
         _PT_PREFILL()
         self._ensure_state(r.memory)
+        row = self._acquire_adapter(r)
         pad_id = int(r.eos_id) if r.eos_id is not None else 0
         prompt_b, P0, Pb = pad_prompt_row(r.prompt, pad_id)
         if r._trace is not None:
             _rt.on_join_attr(r, prompt_bucket=Pb)
         fn = self._program(("join", Pb), lambda: self._build_join(Pb))
-        self._state, tok0 = fn(
-            self._params(), self._buffers(), self._state,
-            jnp.int32(s), jnp.asarray(prompt_b),
-            jnp.asarray([P0], jnp.int32),
-            jnp.asarray(np.asarray(r.memory, self._np_dtype)[None]))
+        try:
+            self._state, tok0 = fn(
+                self._params(), self._buffers(), self._state,
+                jnp.int32(s), jnp.asarray(prompt_b),
+                jnp.asarray([P0], jnp.int32),
+                jnp.asarray(np.asarray(r.memory, self._np_dtype)[None]),
+                *self._join_adapter_args(row))
+        except Exception:
+            self._release_adapter_row(row)
+            raise
+        self._adapter_rows[s] = row
         return int(tok0)
 
     def _build_join(self, Pb):
@@ -962,7 +1134,7 @@ class ServingEngine(_EngineBase):
         now = self.clock()
         for t in toks[:n]:
             r.tokens.append(int(t))
-            self.metrics.record_token()
+            self.metrics.record_token(self._tenant_of(r))
             if r.first_token_at is None:
                 r.first_token_at = now
                 if r.submitted_at is not None:
@@ -1055,6 +1227,16 @@ class ServingEngine(_EngineBase):
                 f"{model_fingerprint(self._fm.params(), self._fm.buffers())}|"
                 f"{self._pool_key}")
 
+    def _startup_adapter_args(self):
+        """Step-shaped (ids [S], banks) example args for precompile —
+        placement-mirrored like every other example arg."""
+        if self._apool is None:
+            return ()
+        import jax.numpy as jnp
+
+        return (jnp.zeros((self.num_slots,), jnp.int32),
+                self._placed_banks())
+
     def _startup_programs(self, prompt_buckets):
         import jax.numpy as jnp
 
@@ -1065,12 +1247,14 @@ class ServingEngine(_EngineBase):
         mem1 = jnp.zeros((1, M, Dm), jnp.dtype(self._np_dtype))
         one = jnp.asarray([1], jnp.int32)
         active = jnp.zeros((S,), bool)
+        jad = self._join_adapter_args(0)
+        sad = self._startup_adapter_args()
         progs = []
         for Pb in sorted({bucket_size(int(p)) for p in prompt_buckets}):
             progs.append((
                 ("join", Pb), lambda Pb=Pb: self._build_join(Pb),
                 (params, buffers, state, jnp.int32(0),
-                 jnp.zeros((1, Pb), jnp.int32), one, mem1)))
+                 jnp.zeros((1, Pb), jnp.int32), one, mem1) + jad))
         if self.spec_k:
             dkey = ("draft",) + self._pool_key
             progs.append((
@@ -1080,14 +1264,14 @@ class ServingEngine(_EngineBase):
             vkey = ("sstep",) + self._pool_key
             progs.append((
                 vkey, lambda vkey=vkey: self._build_spec_step(vkey),
-                (params, buffers, state,
-                 jnp.zeros((S, self.spec_k - 1), jnp.int32), active,
+                (params, buffers, state) + sad +
+                (jnp.zeros((S, self.spec_k - 1), jnp.int32), active,
                  active, jnp.int32(self.spec_k))))
         else:
             skey = ("step",) + self._pool_key
             progs.append((
                 skey, lambda skey=skey: self._build_step(skey),
-                (params, buffers, state, active)))
+                (params, buffers, state) + sad + (active,)))
         return progs
 
 
@@ -1270,6 +1454,7 @@ class PagedServingEngine(ServingEngine):
         self._slot_pages_total[s] = 0
 
     def _evict(self, s):
+        super()._evict(s)          # adapter row release
         self._release_slot(s)
 
     def _device_table(self):
@@ -1333,6 +1518,8 @@ class PagedServingEngine(ServingEngine):
         return out
 
     def _admission_gate(self, r):
+        if not self._adapter_gate(r):
+            return False
         need = self._pages_needed(r) + self._outstanding_reservations()
         if self._alloc.pages_free < need and self._prefix is not None:
             self._prefix.reclaim(need)
@@ -1342,8 +1529,9 @@ class PagedServingEngine(ServingEngine):
         return False
 
     def _iteration_gauges(self):
-        gauges = {"pages_in_use": self._alloc.pages_in_use,
-                  "pages_free": self._alloc.pages_free}
+        gauges = dict(super()._iteration_gauges() or {})
+        gauges.update({"pages_in_use": self._alloc.pages_in_use,
+                       "pages_free": self._alloc.pages_free})
         active_toks = sum(int(self._index[s])
                           for s, r in enumerate(self.slots)
                           if r is not None)
@@ -1366,7 +1554,16 @@ class PagedServingEngine(ServingEngine):
     def _prefix_key(self, padded_row, P0, r):
         from .paging import PrefixCache as PC
 
-        return (int(P0),) + PC.key_of(padded_row[0], r.memory)
+        # the prompt K/V depend on the adapter that prefilled them
+        # (LoRA on the K/V projections), so shared-prefix reuse is
+        # PER TENANT — the key carries the adapter name + its
+        # registration GENERATION (never the recyclable bank row), so
+        # re-registered tenant weights can't serve a stale prefix
+        name = getattr(r, "adapter", None)
+        gen = (self._apool.generation(name)
+               if self._apool is not None and name is not None else 0)
+        return (int(P0), name, gen) + \
+            PC.key_of(padded_row[0], r.memory)
 
     def _check_params(self):
         """Prefix-cache entries hold MODEL-DERIVED state (prompt K/V
@@ -1393,6 +1590,16 @@ class PagedServingEngine(ServingEngine):
         # idempotent under the retry loop: a half-joined earlier
         # attempt's pages are released before this one allocates
         self._release_slot(s)
+        row = self._acquire_adapter(r)
+        try:
+            tok0 = self._join_inner(s, r, row)
+        except Exception:
+            self._release_adapter_row(row)
+            raise
+        self._adapter_rows[s] = row
+        return tok0
+
+    def _join_inner(self, s, r, row):
         pad_id = int(r.eos_id) if r.eos_id is not None else 0
         prompt_b, P0, Pb = pad_prompt_row(r.prompt, pad_id)
         self._slot_pages_total[s] = pages_for(
@@ -1410,9 +1617,9 @@ class PagedServingEngine(ServingEngine):
             return self._attach_shared(s, r, hit, prompt_b, P0, Pb)
         return self._prefill_join(
             s, r, prompt_b, P0, Pb,
-            key if self._prefix is not None else None)
+            key if self._prefix is not None else None, row)
 
-    def _prefill_join(self, s, r, prompt_b, P0, Pb, key):
+    def _prefill_join(self, s, r, prompt_b, P0, Pb, key, row=0):
         import jax.numpy as jnp
 
         _PT_PREFILL()
@@ -1426,7 +1633,8 @@ class PagedServingEngine(ServingEngine):
                 jnp.int32(s), jnp.asarray(prompt_b),
                 jnp.asarray([P0], jnp.int32),
                 jnp.asarray(np.asarray(r.memory, self._np_dtype)[None]),
-                jnp.asarray(np.asarray(pages, np.int32)))
+                jnp.asarray(np.asarray(pages, np.int32)),
+                *self._join_adapter_args(row))
         except Exception:
             self._alloc.decref(pages)
             raise
@@ -1549,6 +1757,8 @@ class PagedServingEngine(ServingEngine):
         active = jnp.zeros((S,), bool)
         table0 = jnp.zeros((S, self.max_pages), jnp.int32)
         index0 = jnp.zeros((S,), jnp.int32)
+        jad = self._join_adapter_args(0)
+        sad = self._startup_adapter_args()
         progs = []
         for Pb in sorted({bucket_size(int(p)) for p in prompt_buckets}):
             n_pp = pages_for(Pb, self.page_size)
@@ -1557,7 +1767,7 @@ class PagedServingEngine(ServingEngine):
                 lambda Pb=Pb: self._build_paged_join(Pb),
                 (params, buffers, state, jnp.int32(0),
                  jnp.zeros((1, Pb), jnp.int32), one, mem1,
-                 jnp.zeros((n_pp,), jnp.int32))))
+                 jnp.zeros((n_pp,), jnp.int32)) + jad))
         if self._prefix is not None:
             if self._fm_cross is None:
                 self._fm_cross = _make_cross_kv_fm(self._net.decoder)
@@ -1580,14 +1790,15 @@ class PagedServingEngine(ServingEngine):
             vkey = ("pverify",) + self._pool_key
             progs.append((
                 vkey, lambda vkey=vkey: self._build_spec_step(vkey),
-                (params, buffers, state, table0, index0,
-                 jnp.zeros((S, self.spec_k - 1), jnp.int32), active,
+                (params, buffers, state, table0, index0) + sad +
+                (jnp.zeros((S, self.spec_k - 1), jnp.int32), active,
                  active, jnp.int32(self.spec_k))))
         else:
             ck = ("pstep",) + self._pool_key
             progs.append((
                 ck, lambda ck=ck: self._build_paged_step(ck),
-                (params, buffers, state, table0, index0, active)))
+                (params, buffers, state, table0, index0) + sad +
+                (active,)))
         return progs
 
 

@@ -179,7 +179,7 @@ class DenseLayout(CacheLayout):
         dtype = jnp.asarray(np.asarray(memory)).dtype
         return (eng.num_slots, eng._pool_len, M, Dm, str(dtype)) + \
             ((("spec", eng.spec_k, eng.spec_ngram),)
-             if eng.spec_k else ())
+             if eng.spec_k else ()) + eng._adapter_pool_key()
 
     # ---- the join program (prefill + splice) ----
     def join_body(self, Pb):
@@ -197,7 +197,7 @@ class DenseLayout(CacheLayout):
         neg = eng._neg
 
         def join_fn(params, buffers, state, slot, prompt, length,
-                    memory):
+                    memory, *ad):
             eng.trace_counts[key] += 1  # python side effect: one per
             #                             trace = one per compile
             kpos = jnp.arange(L, dtype=jnp.int32)
@@ -209,10 +209,13 @@ class DenseLayout(CacheLayout):
             inc0 = [layer.self_attn.gen_cache(
                 None, max_length=Pb, batch_size=1, dtype=memory.dtype)
                 for layer in decoder.layers]
-            (lg, inc1, static1), _ = fm.apply(
-                params, buffers, None, prompt, positions, memory,
-                training=False, tgt_mask=bias_row[:, :Pb],
-                memory_mask=None, inc=inc0, prefill=True)
+            # `ad` = (adapter id, banks) on adapter-carrying engines:
+            # the prefill runs under the tenant's LoRA delta
+            with eng._lora_ctx(ad):
+                (lg, inc1, static1), _ = fm.apply(
+                    params, buffers, None, prompt, positions, memory,
+                    training=False, tgt_mask=bias_row[:, :Pb],
+                    memory_mask=None, inc=inc0, prefill=True)
             # token 0 conditions on the row's LAST REAL prompt position
             last = jnp.take_along_axis(
                 lg, (length - 1)[:, None, None], axis=1)[:, 0]
@@ -249,15 +252,17 @@ class DenseLayout(CacheLayout):
         eng = self.eng
         fm = eng._fm
 
-        def step_fn(params, buffers, state, active):
+        def step_fn(params, buffers, state, *rest):
             eng.trace_counts[key] += 1  # one per trace = one compile
+            *ad, active = rest          # ad = (ids, banks) | ()
             inc = state["inc"]
             posn = inc[0].index[:, None]  # per-SLOT written counts
-            (lg, inc2), _ = fm.apply(
-                params, buffers, None, state["tok"][:, None], posn,
-                state["mem"], training=False, tgt_mask=state["bias"],
-                memory_mask=None, inc=inc, static_kv=state["static"],
-                prefill=False)
+            with eng._lora_ctx(ad):
+                (lg, inc2), _ = fm.apply(
+                    params, buffers, None, state["tok"][:, None], posn,
+                    state["mem"], training=False,
+                    tgt_mask=state["bias"], memory_mask=None, inc=inc,
+                    static_kv=state["static"], prefill=False)
             nxt = lg[:, 0].argmax(-1).astype(jnp.int32)
             nxt = jnp.where(active, nxt, state["tok"])
             # inactive slots must not creep their write index: their
@@ -284,9 +289,9 @@ class DenseLayout(CacheLayout):
         fm = eng._fm
         k = eng.spec_k
 
-        def sstep_fn(params, buffers, state, drafts, active, spec_on,
-                     k_eff):
+        def sstep_fn(params, buffers, state, *rest):
             eng.trace_counts[vkey] += 1  # one per trace = one compile
+            *ad, drafts, active, spec_on, k_eff = rest
             inc = state["inc"]
             idx0 = inc[0].index
             # a spec=False slot's drafts are forced unmatched (-1 never
@@ -299,7 +304,7 @@ class DenseLayout(CacheLayout):
             drafts = jnp.where(live, drafts, -1)
             fed = jnp.concatenate([state["tok"][:, None], drafts], 1)
             posn = idx0[:, None] + jnp.arange(k, dtype=jnp.int32)[None]
-            with A.kv_verify_scope():
+            with A.kv_verify_scope(), eng._lora_ctx(ad):
                 (lg, inc2), _ = fm.apply(
                     params, buffers, None, fed, posn, state["mem"],
                     training=False, tgt_mask=state["bias"],
@@ -423,7 +428,7 @@ class PagedLayout(CacheLayout):
         return (eng.num_slots, eng._pool_len, M, Dm, str(dtype),
                 eng.page_size, eng.num_pages, str(eng.kv_dtype)) + \
             ((("spec", eng.spec_k, eng.spec_ngram),)
-             if eng.spec_k else ())
+             if eng.spec_k else ()) + eng._adapter_pool_key()
 
     # ---- the paged join program (prefill into pages) ----
     def join_body(self, Pb):
@@ -442,7 +447,7 @@ class PagedLayout(CacheLayout):
         neg = eng._neg
 
         def join_fn(params, buffers, state, slot, prompt, length,
-                    memory, page_ids):
+                    memory, page_ids, *ad):
             eng.trace_counts[ck] += 1  # one per trace = one compile
             kpos = jnp.arange(L, dtype=jnp.int32)
             hole = (kpos[None, :] >= length[:, None]) & \
@@ -453,10 +458,11 @@ class PagedLayout(CacheLayout):
             inc0 = [layer.self_attn.gen_cache(
                 None, max_length=Pb, batch_size=1, dtype=memory.dtype)
                 for layer in decoder.layers]
-            (lg, inc1, static1), _ = fm.apply(
-                params, buffers, None, prompt, positions, memory,
-                training=False, tgt_mask=bias_row[:, :Pb],
-                memory_mask=None, inc=inc0, prefill=True)
+            with eng._lora_ctx(ad):
+                (lg, inc1, static1), _ = fm.apply(
+                    params, buffers, None, prompt, positions, memory,
+                    training=False, tgt_mask=bias_row[:, :Pb],
+                    memory_mask=None, inc=inc0, prefill=True)
             last = jnp.take_along_axis(
                 lg, (length - 1)[:, None, None], axis=1)[:, 0]
             tok0 = last.argmax(-1).astype(jnp.int32)[0]
@@ -568,17 +574,19 @@ class PagedLayout(CacheLayout):
         eng = self.eng
         fm = eng._fm
 
-        def step_fn(params, buffers, state, table, index, active):
+        def step_fn(params, buffers, state, table, index, *rest):
             eng.trace_counts[ck] += 1  # one per trace = one compile
+            *ad, active = rest          # ad = (ids, banks) | ()
             inc = [PG.PagedKVCache(pc["k"], pc["v"], pc["ks"],
                                    pc["vs"], table, index)
                    for pc in state["paged"]]
             posn = index[:, None]
-            (lg, inc2), _ = fm.apply(
-                params, buffers, None, state["tok"][:, None], posn,
-                state["mem"], training=False, tgt_mask=state["bias"],
-                memory_mask=None, inc=inc, static_kv=state["static"],
-                prefill=False)
+            with eng._lora_ctx(ad):
+                (lg, inc2), _ = fm.apply(
+                    params, buffers, None, state["tok"][:, None], posn,
+                    state["mem"], training=False,
+                    tgt_mask=state["bias"], memory_mask=None, inc=inc,
+                    static_kv=state["static"], prefill=False)
             nxt = lg[:, 0].argmax(-1).astype(jnp.int32)
             nxt = jnp.where(active, nxt, state["tok"])
             new_paged = [{"k": c.k, "v": c.v, "ks": c.k_scale,
@@ -599,11 +607,11 @@ class PagedLayout(CacheLayout):
         fm = eng._fm
         k = eng.spec_k
 
-        def pverify_fn(params, buffers, state, table, index, drafts,
-                       active, spec_on, k_eff):
+        def pverify_fn(params, buffers, state, table, index, *rest):
             eng.trace_counts[vkey] += 1  # one per trace = one compile
             from . import paging as PG
 
+            *ad, drafts, active, spec_on, k_eff = rest
             # force-reject the opted-out rows and the lanes past the
             # adaptive effective k (-1 never equals a vocab token): k
             # changes ride the SAME fixed-k compiled program
@@ -615,7 +623,7 @@ class PagedLayout(CacheLayout):
             inc = [PG.PagedKVCache(pc["k"], pc["v"], pc["ks"],
                                    pc["vs"], table, index)
                    for pc in state["paged"]]
-            with A.kv_verify_scope():
+            with A.kv_verify_scope(), eng._lora_ctx(ad):
                 (lg, inc2), _ = fm.apply(
                     params, buffers, None, fed, posn, state["mem"],
                     training=False, tgt_mask=state["bias"],
@@ -789,6 +797,7 @@ class PlainStepper:
         fn = eng._program(key, lambda: eng._build_step(key))
         eng._state, toks = fn(eng._params(), eng._buffers(),
                               eng._state, *lay.step_extra_args(),
+                              *eng._adapter_args(),
                               jnp.asarray(active))
         lay.advance_rows(active.astype(np.int64))
         return np.asarray(toks)
@@ -872,8 +881,9 @@ class SpecStepper:
         fn = eng._program(vkey, lambda: eng._build_spec_step(vkey))
         eng._state, (emit, n_emit) = fn(
             eng._params(), eng._buffers(), eng._state,
-            *lay.step_extra_args(), drafts, jnp.asarray(active),
-            jnp.asarray(spec_on), jnp.int32(self.k_eff))
+            *lay.step_extra_args(), *eng._adapter_args(), drafts,
+            jnp.asarray(active), jnp.asarray(spec_on),
+            jnp.int32(self.k_eff))
         emit = np.asarray(emit)
         n_emit = np.asarray(n_emit)
         t2 = time.perf_counter()

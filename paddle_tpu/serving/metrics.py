@@ -90,8 +90,11 @@ SNAPSHOT_DOCS = {
     "memory.pool_bytes": (
         "gauge",
         "KV pool + per-slot row arrays (paged: pages/scales/table)"),
+    "memory.adapter_bytes": (
+        "gauge", "stacked LoRA bank bytes (0 without an AdapterPool)"),
     "memory.total_bytes": (
-        "gauge", "weights + pool: the committed device footprint"),
+        "gauge",
+        "weights + pool + adapters: the committed device footprint"),
     "memory.in_use_bytes": (
         "gauge", "weights + rows/pages actually live right now"),
     "memory.budget_bytes": ("gauge", "configured HBM budget (0=unset)"),
@@ -156,6 +159,24 @@ SNAPSHOT_DOCS = {
     "speculation.step_ms_by_variant": (
         "info", "per-pool-variant (dense/paged/sharded-*) draft/"
                 "verify step-ms p50 split"),
+    # multi-tenant serving (PR 15) — the section appears once an
+    # adapter-carrying engine records a tenancy event
+    "tenancy.tenants": (
+        "gauge", "distinct tenants (adapter names + base) served"),
+    "tenancy.active_slots_by_tenant": (
+        "info", "last-iteration occupied-slot count per tenant"),
+    "tenancy.tokens_by_tenant": (
+        "info", "delivered tokens per tenant (the fairness input)"),
+    "tenancy.adapter_loads": (
+        "counter", "adapter bank hot-loads (device writes)"),
+    "tenancy.adapter_evictions": (
+        "counter", "zero-reference adapters evicted for their row"),
+    "tenancy.adapter_hit_rate": (
+        "gauge", "acquires served by an already-hot bank row"),
+    "tenancy.adapter_waits": (
+        "counter", "admissions deferred on OutOfAdapters backpressure"),
+    "tenancy.fairness": (
+        "gauge", "Jain index over tokens_by_tenant (1.0 = even)"),
     # cold start (PR 11) — the section appears once the engine runs
     # precompile(): startup AOT compile / persistent-cache accounting.
     # Cold-start latency is a production metric: these are the numbers
@@ -185,7 +206,9 @@ SNAPSHOT_DOCS = {
 
 _SUMMARY_KEYS = {"n", "mean", "p50", "p99", "max"}
 _LEAF_DICTS = {"errors.last", "mfu.device",
-               "speculation.step_ms_by_variant"}
+               "speculation.step_ms_by_variant",
+               "tenancy.active_slots_by_tenant",
+               "tenancy.tokens_by_tenant"}
 
 
 def flatten_snapshot(snap, _prefix=""):
@@ -254,6 +277,18 @@ def to_prometheus(snapshot, tracer=None, prefix="paddle_tpu_serving"):
         lines.append(f"{prefix}_tracer_spans_dropped "
                      f"{float(tracer.dropped)}")
     return "\n".join(lines) + "\n"
+
+
+def _jain(tokens_by_tenant):
+    """Jain's fairness index over per-tenant delivered tokens:
+    (sum x)^2 / (n * sum x^2) — 1.0 when every tenant got an equal
+    share, 1/n when one tenant took everything. The number the
+    multi-tenant scheduler is judged on."""
+    xs = [float(v) for v in tokens_by_tenant.values() if v > 0]
+    if not xs:
+        return 1.0
+    s = sum(xs)
+    return round((s * s) / (len(xs) * sum(x * x for x in xs)), 4)
 
 
 class _Reservoir:
@@ -383,6 +418,18 @@ class ServingMetrics:
         self.spec_k_shrinks = 0
         self.spec_k_grows = 0
         self._spec_by_variant = {}
+        # multi-tenant serving (the snapshot grows a "tenancy" section
+        # once an adapter-carrying engine records): per-tenant token /
+        # slot accounting plus the AdapterPool's load/evict/hit-rate
+        # counters mirrored by the pool itself
+        self._tenancy = False
+        self.tokens_by_tenant = {}
+        self.tenant_slots = None       # last-iteration gauge
+        self.adapter_loads = 0
+        self.adapter_evictions = 0
+        self.adapter_hits = 0
+        self.adapter_misses = 0
+        self.adapter_waits = 0
         # cold start (PR 11): the engine's precompile() report — how
         # the pool reached readiness (cache-warm vs compiled) and the
         # first request's TTFT (what a restart actually costs callers)
@@ -418,9 +465,13 @@ class ServingMetrics:
                 # the first request ever: the cold-start A/B's number
                 self.first_ttft_s = float(ttft_s)
 
-    def record_token(self):
+    def record_token(self, tenant=None):
         with self._lock:
             self.tokens_out += 1
+            if tenant is not None:
+                self._tenancy = True
+                self.tokens_by_tenant[tenant] = \
+                    self.tokens_by_tenant.get(tenant, 0) + 1
 
     def record_decode(self, n_tokens, dt_s):
         """One engine iteration produced `n_tokens` across the active
@@ -512,6 +563,36 @@ class ServingMetrics:
     def record_oom_eviction(self, n=1):
         with self._lock:
             self.oom_evictions += n
+
+    # ---- multi-tenant accounting (the AdapterPool mirrors its own
+    # events here via bind_metrics; the engine records the waits) ----
+    def record_adapter_acquire(self, hit):
+        """An adapter acquire resolved: hit = an already-hot bank row
+        (the adapter cache), miss = a load had to run."""
+        with self._lock:
+            self._tenancy = True
+            if hit:
+                self.adapter_hits += 1
+            else:
+                self.adapter_misses += 1
+
+    def record_adapter_load(self):
+        with self._lock:
+            self._tenancy = True
+            self.adapter_loads += 1
+
+    def record_adapter_eviction(self):
+        with self._lock:
+            self._tenancy = True
+            self.adapter_evictions += 1
+
+    def record_adapter_wait(self):
+        """Admission deferred: every adapter row pinned by live slots
+        (the OutOfAdapters backpressure path — the request stays
+        queued at the head)."""
+        with self._lock:
+            self._tenancy = True
+            self.adapter_waits += 1
 
     # ---- HBM ledger / MFU accounting (PR 9) ----
     def set_memory_provider(self, provider, budget_bytes=None,
@@ -641,11 +722,14 @@ class ServingMetrics:
 
     def record_iteration(self, queue_depth, occupancy, pages_in_use=None,
                          pages_free=None, bytes_per_active_token=None,
-                         shard_occupancy=None):
+                         shard_occupancy=None, tenant_slots=None):
         with self._lock:
             self.iterations += 1
             self.queue_depth.add(queue_depth)
             self.occupancy.add(occupancy)
+            if tenant_slots is not None:
+                self._tenancy = True
+                self.tenant_slots = dict(tenant_slots)
             if pages_in_use is not None:
                 self.pages_in_use = int(pages_in_use)
             if pages_free is not None:
@@ -674,12 +758,14 @@ class ServingMetrics:
             if ledger is not None:
                 w = int(ledger.get("weights_bytes", 0))
                 p = int(ledger.get("pool_bytes", 0))
-                used = int(ledger.get("in_use_bytes", w + p))
+                a = int(ledger.get("adapter_bytes", 0))
+                used = int(ledger.get("in_use_bytes", w + p + a))
                 b = self.budget_bytes
                 mem = {
                     "weights_bytes": w,
                     "pool_bytes": p,
-                    "total_bytes": w + p,
+                    "adapter_bytes": a,
+                    "total_bytes": w + p + a,
                     "in_use_bytes": used,
                     "budget_bytes": b,
                     "budget_used_frac":
@@ -722,6 +808,20 @@ class ServingMetrics:
                     "ratio": round(self.useful_tokens / good_denom, 4)
                     if good_denom else 1.0,
                 },
+                **({} if not self._tenancy else {"tenancy": {
+                    "tenants": len(self.tokens_by_tenant),
+                    "active_slots_by_tenant":
+                        dict(self.tenant_slots or {}),
+                    "tokens_by_tenant": dict(self.tokens_by_tenant),
+                    "adapter_loads": self.adapter_loads,
+                    "adapter_evictions": self.adapter_evictions,
+                    "adapter_hit_rate": round(
+                        self.adapter_hits /
+                        max(1, self.adapter_hits +
+                            self.adapter_misses), 4),
+                    "adapter_waits": self.adapter_waits,
+                    "fairness": _jain(self.tokens_by_tenant),
+                }}),
                 **({} if self._cold_start is None else {"cold_start": {
                     "time_to_ready_s":
                         self._cold_start.get("time_to_ready_s", 0.0),

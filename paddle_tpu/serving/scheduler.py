@@ -80,7 +80,8 @@ class Request:
     _ids = itertools.count()
 
     def __init__(self, prompt, memory=None, *, max_new_tokens=32,
-                 eos_id=1, deadline=None, stream_cb=None, spec=True):
+                 eos_id=1, deadline=None, stream_cb=None, spec=True,
+                 adapter=None):
         prompt = np.asarray(prompt)
         if prompt.ndim != 1:
             raise ValueError(f"prompt must be 1-D [P], got "
@@ -99,6 +100,11 @@ class Request:
         # draft lanes ride along unmatched) — output is identical
         # either way, this only trades verify width for latency
         self.spec = bool(spec)
+        # multi-tenant serving: the registered adapter name this
+        # request decodes under (None = the base model; per-request
+        # opt-out rides the same compiled program with bank row 0's
+        # zero delta)
+        self.adapter = adapter
         self.tokens = []              # generated so far (ints)
         self.state = "QUEUED"         # QUEUED -> RUNNING -> DONE
         self.finish_reason = None

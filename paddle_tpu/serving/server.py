@@ -69,11 +69,13 @@ class ServingServer:
 
     def submit(self, prompt, memory=None, *, max_new_tokens=32,
                eos_id=1, deadline=None, timeout=None, stream_cb=None,
-               spec=True):
+               spec=True, adapter=None):
         """Enqueue one generation request; returns the `Request` whose
         `.result()` blocks for a RequestResult and whose `.cancel()`
         withdraws it. `timeout` (seconds from now) is sugar for an
-        absolute `deadline` on the engine clock. Raises QueueFull under
+        absolute `deadline` on the engine clock. `adapter` names the
+        registered tenant adapter to decode under (None = base model;
+        needs an engine with an AdapterPool). Raises QueueFull under
         backpressure, RuntimeError after shutdown/drain began, and
         ValueError for unservable requests."""
         if self._dead:
@@ -83,7 +85,7 @@ class ServingServer:
             deadline = self.clock() + float(timeout)
         r = Request(prompt, memory, max_new_tokens=max_new_tokens,
                     eos_id=eos_id, deadline=deadline,
-                    stream_cb=stream_cb, spec=spec)
+                    stream_cb=stream_cb, spec=spec, adapter=adapter)
         self.engine.admit_check(r)   # fail fast, before queueing
         try:
             self.scheduler.submit(r)

@@ -186,6 +186,46 @@ class ShardedServingEngine(ServingEngine):
         self._place_params()
         self._weights_bytes = None   # ledger cache: shapes may change
 
+    # ---- adapter banks on the mesh ----
+    def _placed_banks(self):
+        """The LoRA banks REPLICATED on the decode mesh (they are tiny
+        next to the base weights and every dp shard gathers from
+        them), re-placed only when a hot-load bumps the pool version —
+        a steady pool pays one int compare per dispatch."""
+        pool = self._apool
+        cached = getattr(self, "_banks_placed", None)
+        if cached is not None and cached[0] == pool.version:
+            return cached[1]
+        import jax
+
+        placed = jax.device_put(pool.banks(), self._ns_repl)
+        self._banks_placed = (pool.version, placed)
+        return placed
+
+    def _prefill_banks(self):
+        """The banks replicated on the PREFILL slice's mesh (the
+        disaggregated prefill program's copy)."""
+        pool = self._apool
+        cached = getattr(self, "_banks_prefill", None)
+        if cached is not None and cached[0] == pool.version:
+            return cached[1]
+        import jax
+        import jax.sharding as jsh
+        from jax.sharding import PartitionSpec as P
+
+        placed = jax.device_put(
+            pool.banks(),
+            jsh.NamedSharding(self._prefill_dm.mesh, P()))
+        self._banks_prefill = (pool.version, placed)
+        return placed
+
+    def _prefill_adapter_args(self, row):
+        if self._apool is None:
+            return ()
+        import jax.numpy as jnp
+
+        return (jnp.int32(row), self._prefill_banks())
+
     def _params(self):
         return self._sparams
 
@@ -260,6 +300,7 @@ class ShardedServingEngine(ServingEngine):
 
         _PT_PREFILL()
         self._ensure_state(r.memory)
+        row = self._acquire_adapter(r)
         pad_id = int(r.eos_id) if r.eos_id is not None else 0
         prompt_b, P0, Pb = pad_prompt_row(r.prompt, pad_id)
         key = ("prefill", Pb)
@@ -269,9 +310,15 @@ class ShardedServingEngine(ServingEngine):
             self._compiled[key] = fn
             fn = self._compiled[key]   # the observed wrapper
         mem = np.asarray(r.memory, self._np_dtype)[None]
-        outs = fn(self._pparams, self._pbuffers,
-                  jnp.asarray(prompt_b), jnp.asarray([P0], jnp.int32),
-                  jnp.asarray(mem))
+        try:
+            outs = fn(self._pparams, self._pbuffers,
+                      jnp.asarray(prompt_b),
+                      jnp.asarray([P0], jnp.int32), jnp.asarray(mem),
+                      *self._prefill_adapter_args(row))
+        except Exception:
+            self._release_adapter_row(row)
+            raise
+        self._adapter_rows[s] = row
         self._pending.add(s)
         self._pending_info[s] = {
             "req": r, "outs": outs, "mem": mem, "Pb": Pb,
@@ -295,7 +342,7 @@ class ShardedServingEngine(ServingEngine):
         key = ("prefill", Pb)
         neg = float(NEG)
 
-        def prefill_fn(params, buffers, prompt, length, memory):
+        def prefill_fn(params, buffers, prompt, length, memory, *ad):
             self.trace_counts[key] += 1  # one per trace = one compile
             kpos = jnp.arange(L, dtype=jnp.int32)
             hole = (kpos[None, :] >= length[:, None]) & \
@@ -306,10 +353,11 @@ class ShardedServingEngine(ServingEngine):
             inc0 = [layer.self_attn.gen_cache(
                 None, max_length=Pb, batch_size=1, dtype=memory.dtype)
                 for layer in decoder.layers]
-            (lg, inc1, static1), _ = fm.apply(
-                params, buffers, None, prompt, positions, memory,
-                training=False, tgt_mask=bias_row[:, :Pb],
-                memory_mask=None, inc=inc0, prefill=True)
+            with self._lora_ctx(ad):
+                (lg, inc1, static1), _ = fm.apply(
+                    params, buffers, None, prompt, positions, memory,
+                    training=False, tgt_mask=bias_row[:, :Pb],
+                    memory_mask=None, inc=inc0, prefill=True)
             last = jnp.take_along_axis(
                 lg, (length - 1)[:, None, None], axis=1)[:, 0]
             tok0 = last.argmax(-1).astype(jnp.int32)[0]
@@ -596,12 +644,13 @@ class ShardedServingEngine(ServingEngine):
         L = self._pool_len
         state = self._state
         repl = self._ns_repl
+        pad = self._prefill_adapter_args(0)
         for Pb in sorted({bucket_size(int(p)) for p in prompt_buckets}):
             progs.append((
                 ("prefill", Pb),
                 lambda Pb=Pb: self._build_prefill(Pb),
                 (self._pparams, self._pbuffers,
-                 jnp.zeros((1, Pb), jnp.int32), one, mem1)))
+                 jnp.zeros((1, Pb), jnp.int32), one, mem1) + pad))
             # the splice half sees the travelled prefill outputs
             # REPLICATED on the decode slice (_poll_pending device_puts
             # them to _ns_repl before the call) — mirror that placement

@@ -73,6 +73,10 @@ def _dims_of(kernel, key):
         flash_verify        (d, L, dtype, T)
         paged_flash_decode  (d, psz, dtype)
         paged_flash_verify  (d, psz, dtype, T)
+        int8_matmul         (d, n, dtype)   d = contraction bucket,
+                                            n = output-channel bucket
+        lora_matmul         (d, r, dtype)   d = model-dim bucket,
+                                            r = adapter rank
     """
     if kernel in ("flash_fwd", "flash_bwd"):
         d, sq, sk, dt = key
@@ -92,6 +96,12 @@ def _dims_of(kernel, key):
         d, psz, dt, T = key
         return {"d": int(d), "psz": int(psz), "dtype": str(dt),
                 "T": int(T)}
+    if kernel == "int8_matmul":
+        d, n, dt = key
+        return {"d": int(d), "n": int(n), "dtype": str(dt)}
+    if kernel == "lora_matmul":
+        d, r, dt = key
+        return {"d": int(d), "r": int(r), "dtype": str(dt)}
     raise ValueError(f"unknown kernel {kernel!r}")
 
 
@@ -123,6 +133,18 @@ def candidates(kernel, key):
         return [{"kernel": True, "split_k": 0}] + \
             [{"kernel": False, "split_k": n} for n in SPLIT_LADDER
              if L % n == 0 and (L // n) % 128 == 0]
+    if kernel == "int8_matmul":
+        # tile ladder at the nominal decode-batch m (ops.quant's
+        # INT8_BLOCK_* sets); legality = the tile divides the bucket
+        from ..ops.quant import INT8_BLOCK_M, INT8_BLOCK_N
+
+        n = dims["n"]
+        return [{"block_m": bm, "block_n": bn}
+                for bm in INT8_BLOCK_M for bn in INT8_BLOCK_N
+                if n % bn == 0]
+    if kernel == "lora_matmul":
+        # dispatch-level knob only: the gathered grid is (slot,)
+        return [{"kernel": True}, {"kernel": False}]
     raise ValueError(f"unknown kernel {kernel!r}")
 
 
@@ -143,6 +165,15 @@ def fallback_config(kernel, key):
         return {"kernel": True}
     if kernel == "paged_flash_verify":
         return dict(A._paged_verify_heuristic())
+    if kernel == "int8_matmul":
+        from ..ops import quant as Q
+
+        bm, bn = Q._pick_int8_blocks_heuristic(8, dims["n"])
+        return {"block_m": bm, "block_n": bn}
+    if kernel == "lora_matmul":
+        from ..ops import quant as Q
+
+        return dict(Q._lora_dispatch_heuristic())
     raise ValueError(f"unknown kernel {kernel!r}")
 
 
@@ -170,6 +201,11 @@ DEFAULT_KEYS = {
                            for d in (64, 128) for psz in (16, 64)
                            for dt in ("float32", "int8")
                            for T in (2, 4)],
+    "int8_matmul": [(d, n, dt)
+                    for d in (256, 1024) for n in (256, 1024)
+                    for dt in ("float32", "bfloat16")],
+    "lora_matmul": [(d, r, "float32")
+                    for d in (256, 1024) for r in (8, 32)],
 }
 
 
@@ -248,6 +284,23 @@ def analytic_cost(kernel, key, config, batch=1, heads=1, causal=True):
         gather = 0.0 if config.get("kernel", True) else 2.0 * L * d * ib
         return {"flops": bh * 4.0 * T * L * d,
                 "bytes": bh * (2.0 * L * d * ib + gather)}
+    if kernel == "int8_matmul":
+        # nominal decode-batch m = 8 rows; the int8 weight tile is the
+        # byte-traffic floor (the whole point of the storage format)
+        n = dims["n"]
+        m = 8
+        return {"flops": bh * 2.0 * m * d * n,
+                "bytes": bh * (d * n * 1.0 + n * 4.0 +
+                               m * (d + n) * ib)}
+    if kernel == "lora_matmul":
+        # nominal 8-slot pool, one token per row: two rank-r matmuls
+        # per row + the gathered bank rows' traffic
+        r = dims["r"]
+        m = 8
+        gather = 0.0 if config.get("kernel", True) \
+            else m * (d * r + r * d) * 4.0
+        return {"flops": bh * 2.0 * m * (d * r + r * d),
+                "bytes": bh * (m * (d * r + r * d) * 4.0 + gather)}
     raise ValueError(f"unknown kernel {kernel!r}")
 
 
@@ -346,6 +399,38 @@ def build_runner(kernel, key, config, batch=4, heads=4):
                 A.paged_gather_kv(vp, None, t, a.dtype), n,
                 split_k=split))
         return lambda: fn(q, pages, pages, tbl, length)
+    if kernel == "int8_matmul":
+        from ..ops import quant as Q
+
+        n = dims["n"]
+        m = max(8, batch)
+        x = jnp.asarray(rs.randn(m, d), dt)
+        w = jnp.asarray(rs.randn(d, n) * 0.05, jnp.float32)
+        wq, ws = Q.quantize_int8_weight(w)
+        bm = int(config.get("block_m", 0)) or None
+        bn = int(config.get("block_n", 0)) or None
+        # on the CPU harness the dispatcher times the XLA reference
+        # (config-invariant); on-chip the explicit blocks pin the
+        # candidate tile, same contract as the flash runners
+        fn = jax.jit(lambda a, q_, s_: Q.int8_matmul(
+            a, q_, s_, block_m=bm, block_n=bn))
+        return lambda: fn(x, wq, ws)
+    if kernel == "lora_matmul":
+        from ..ops import quant as Q
+
+        r = dims["r"]
+        n_ad = 4
+        x = jnp.asarray(rs.randn(batch, 1, d), dt)
+        Ab = jnp.asarray(rs.randn(n_ad, d, r) * 0.05, jnp.float32)
+        Bb = jnp.asarray(rs.randn(n_ad, r, d) * 0.05, jnp.float32)
+        ids = jnp.asarray(rs.randint(0, n_ad, (batch,)), jnp.int32)
+        if bool(config.get("kernel", True)) and A._on_tpu():
+            fn = jax.jit(lambda a, wa, wb, i: Q.lora_delta(
+                a, wa, wb, i))
+        else:
+            fn = jax.jit(lambda a, wa, wb, i: Q.lora_delta_reference(
+                a, wa, wb, i))
+        return lambda: fn(x, Ab, Bb, ids)
     if kernel == "paged_flash_decode":
         psz = dims["psz"]
         n_pages, mp = 32, 8

@@ -39,6 +39,13 @@ Kernels and their tunable knobs:
                             speculative verify: kernel-on (grid fixed
                             by the pages) or gather + the dense verify
                             dispatch at the tuned split_k
+    int8_matmul             {"block_m", "block_n"} — the scaled-int8
+                            weight matmul's tile shape (keys
+                            (d_in bucket, d_out bucket, dtype))
+    lora_matmul             {"kernel": bool} — dispatch-level: the
+                            gathered-LoRA scalar-prefetch kernel vs
+                            the XLA gathered einsum (keys
+                            (d bucket, rank, dtype))
 
 Env switches: ``PT_TUNING=0`` disables every lookup (pure heuristics,
 zero table reads); ``PT_TUNING_TABLE=/path.json`` layers an extra
@@ -55,7 +62,8 @@ __all__ = ["TuningTable", "TableError", "KERNELS", "seq_bucket",
            "current_device_kind", "committed_table_path"]
 
 KERNELS = ("flash_fwd", "flash_bwd", "flash_decode", "flash_verify",
-           "paged_flash_decode", "paged_flash_verify")
+           "paged_flash_decode", "paged_flash_verify", "int8_matmul",
+           "lora_matmul")
 
 #: knob names each kernel's config may carry (schema validation:
 #: unknown keys are tolerated — forward compat — but a config missing
@@ -67,6 +75,8 @@ KERNEL_KNOBS = {
     "flash_verify": ("split_k",),
     "paged_flash_decode": ("kernel",),
     "paged_flash_verify": ("kernel", "split_k"),
+    "int8_matmul": ("block_m", "block_n"),
+    "lora_matmul": ("kernel",),
 }
 
 #: bump when the key layout or knob semantics change: a mismatched

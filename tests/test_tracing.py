@@ -472,12 +472,20 @@ def _full_metrics():
     m.record_collective(0.001)
     m.record_spec_step(2, 6, 4, 0.0005, 0.002, k_eff=3,
                        variant="paged", k_shrinks=1, k_grows=0)
+    m.record_token("t1")              # tenancy: per-tenant tokens
+    m.record_adapter_acquire(True)
+    m.record_adapter_acquire(False)
+    m.record_adapter_load()
+    m.record_adapter_eviction()
+    m.record_adapter_wait()
     m.record_iteration(1, 0.5, pages_in_use=3, pages_free=5,
                        bytes_per_active_token=128.0,
-                       shard_occupancy=[0.5, 0.25])
+                       shard_occupancy=[0.5, 0.25],
+                       tenant_slots={"base": 1, "t1": 1})
     m.set_memory_provider(
         lambda: {"weights_bytes": 1000, "pool_bytes": 500,
-                 "in_use_bytes": 1200, "compile_temp_peak_bytes": 64},
+                 "adapter_bytes": 128, "in_use_bytes": 1200,
+                 "compile_temp_peak_bytes": 64},
         budget_bytes=2000)
     m.record_step_utilization(1e6, 2e6, 0.001, CPU_SPEC, "xla")
     m.record_cold_start({"time_to_ready_s": 1.5, "programs": 4,

@@ -47,6 +47,8 @@ def _log(msg):
 #: family, small enough for seconds on the CPU pin
 SMOKE_KEYS = {
     "flash_decode": [(64, 512, "float32")],
+    "int8_matmul": [(256, 256, "float32")],
+    "lora_matmul": [(256, 8, "float32")],
 }
 
 

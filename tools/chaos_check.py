@@ -178,6 +178,99 @@ def _drive_paged_spec(point, action):
             raise RuntimeError("clean request failed after disarm")
 
 
+def _drive_adapter_load(point, action):
+    """serving.adapter_load cells: the multi-tenant pool under bank
+    hot-load faults. `transient` (fires once) must be retried by the
+    join's guard and the tenant served NORMALLY; `raise` (persistent)
+    must isolate ONLY that tenant's requests — eager fallback serves
+    them on the base model while co-resident base/other-tenant
+    requests are untouched; `delay` just slows. After the drain the
+    pool's refcounts and free list are back to initial (leak-free),
+    and clean adapter traffic serves after disarm."""
+    from paddle_tpu import nn
+    from paddle_tpu.nn.layer.transformer import (TransformerDecoder,
+                                                 TransformerDecoderLayer)
+    from paddle_tpu.serving import (AdapterPool, Request, Scheduler,
+                                    ServingEngine)
+    from paddle_tpu.testing import faults
+
+    np.random.seed(7)
+    layer = TransformerDecoderLayer(32, 2, 64, dropout=0.0)
+    dec = TransformerDecoder(layer, 2)
+    dec.eval()
+    embed = nn.Embedding(17, 32)
+    proj = nn.Linear(32, 17)
+    pool = AdapterPool(dec, capacity=3, rank=4)
+    pool.register_random("t1", seed=1)
+    pool.register_random("t2", seed=2)
+    eng = ServingEngine(dec, embed, proj, num_slots=4, max_len=32,
+                        adapters=pool, eager_fallback=True,
+                        max_attempts=2, backoff_base_s=0.0)
+    sched = Scheduler(max_queue=64)
+    if action == "delay":
+        plan = dict(action="delay", delay_s=0.02, on="every", k=2)
+    elif action == "transient":
+        plan = dict(on="nth", n=1, max_fires=1)
+    else:
+        plan = dict(on="always")
+    inj = faults.inject(point, **plan)
+    rs = np.random.RandomState(23)
+    reqs = []
+    try:
+        for name in (None, "t1", "t2", None, "t1", "t2"):
+            P = int(rs.randint(1, 6))
+            prompt = rs.randint(2, 17, (P,)).astype(np.int32)
+            prompt[0] = 0
+            mem = rs.randn(4, 32).astype("f4")
+            r = Request(prompt, mem, max_new_tokens=int(
+                rs.randint(2, 8)), eos_id=1, adapter=name)
+            sched.submit(r)
+            reqs.append((r, name))
+        it = 0
+        while sched.depth() > 0 or eng.occupancy() > 0:
+            eng.run_iteration(sched)
+            it += 1
+            if it > 2000:
+                raise RuntimeError("no convergence under faults")
+        fired = inj.fired
+    finally:
+        faults.reset()
+    if not fired:
+        raise RuntimeError(f"plan on {point} never fired")
+    for r, name in reqs:
+        if not r.future.done():
+            raise RuntimeError(f"hung future {r.id} ({point}/{action})")
+        if not r.result(timeout=0).ok:
+            raise RuntimeError(
+                f"request {r.id} (adapter={name}) failed under "
+                f"{action}: isolation demands it resolve (fallback "
+                f"serves the base model)")
+    if action == "raise":
+        if eng.metrics.fallbacks < 1:
+            raise RuntimeError("persistent load fault never degraded "
+                               "to the eager base-model path")
+    # leak-free: every bank reference released, invariants hold
+    pool.check()
+    if pool.refcount.sum() != 0:
+        raise RuntimeError(f"adapter refcount leak: {pool.refcount}")
+    # clean adapter traffic serves after disarm
+    sched2 = Scheduler(max_queue=16)
+    prompt = np.asarray([0, 3, 5], np.int32)
+    clean = Request(prompt, rs.randn(4, 32).astype("f4"),
+                    max_new_tokens=4, eos_id=1, adapter="t1")
+    sched2.submit(clean)
+    it = 0
+    while sched2.depth() > 0 or eng.occupancy() > 0:
+        eng.run_iteration(sched2)
+        it += 1
+        if it > 500:
+            raise RuntimeError("pool dead after disarm")
+    if not clean.result(timeout=0).ok:
+        raise RuntimeError("clean adapter request failed after disarm")
+    if pool.loads < 1:
+        raise RuntimeError("no successful adapter load after disarm")
+
+
 def _drive_checkpoint(point, action):
     import shutil
     import tempfile
@@ -321,6 +414,8 @@ MATRIX = (
        for a in ("raise", "delay")]
     + [("serving.decode_step[pspec]", a, _drive_paged_spec)
        for a in ("raise", "delay")]
+    + [("serving.adapter_load", a, _drive_adapter_load)
+       for a in ("raise", "delay", "transient")]
     + [("checkpoint.write", a, _drive_checkpoint)
        for a in ("raise", "delay", "corrupt")]
     + [("checkpoint.read", a, _drive_checkpoint)

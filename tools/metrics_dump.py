@@ -43,16 +43,20 @@ def _demo_snapshot():
     from paddle_tpu.nn.layer.transformer import (TransformerDecoder,
                                                  TransformerDecoderLayer)
     from paddle_tpu.profiler import costs
-    from paddle_tpu.serving import (Request, Scheduler, ServingEngine,
-                                    session_scope)
+    from paddle_tpu.serving import (AdapterPool, Request, Scheduler,
+                                    ServingEngine, session_scope)
 
     np.random.seed(0)
     layer = TransformerDecoderLayer(32, 2, 64, dropout=0.0)
     dec = TransformerDecoder(layer, 2)
     dec.eval()
+    # a 2-tenant AdapterPool so the tenancy section renders too
+    pool = AdapterPool(dec, capacity=3, rank=4)
+    pool.register_random("t1", seed=1)
+    pool.register_random("t2", seed=2)
     eng = ServingEngine(dec, nn.Embedding(17, 32), nn.Linear(32, 17),
                         num_slots=4, max_len=32, spec_k=4,
-                        hbm_budget_bytes=1 << 20)
+                        adapters=pool, hbm_budget_bytes=1 << 20)
     sched = Scheduler(max_queue=16)
     rs = np.random.RandomState(1)
     with costs.accounting_scope(), session_scope() as tr:
@@ -63,12 +67,14 @@ def _demo_snapshot():
                        prompt_buckets=(1, 2, 4, 8),
                        cache=tempfile.mkdtemp(prefix="pt_aot_demo_"))
         reqs = []
-        for _ in range(6):
+        for i, name in enumerate((None, "t1", "t2", "t1", None,
+                                  "t2")):
             P = int(rs.randint(1, 6))
             prompt = rs.randint(2, 17, (P,)).astype(np.int32)
             prompt[0] = 0
             r = Request(prompt, rs.randn(4, 32).astype("f4"),
-                        max_new_tokens=int(rs.randint(2, 8)), eos_id=1)
+                        max_new_tokens=int(rs.randint(2, 8)),
+                        eos_id=1, adapter=name)
             sched.submit(r)
             reqs.append(r)
         eng.serve_until_idle(sched, max_iterations=500)

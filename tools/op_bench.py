@@ -222,6 +222,7 @@ def _configs():
     cfgs += _configs_paged_decode()
     cfgs += _configs_paged_verify()
     cfgs += _configs_sharded_decode()
+    cfgs += _configs_lora_int8()
     return cfgs
 
 
@@ -1284,6 +1285,80 @@ def _configs_paged_verify():
          direct(8, 8, 2048, 64, 16, T, dt))
         for T in (2, 4) for dt in ("f32", "int8")
     ]
+
+
+def _configs_lora_int8():
+    """Multi-tenant serving kernel rows (PR 15). `lora_decode_*`: the
+    base decode-shaped linear PLUS the gathered per-row LoRA delta
+    (`ops.quant.lora_delta` — adapter ids gathered from stacked
+    [n_adapters, d, r] banks) vs the base linear alone
+    (`lora_base_b{b}`): the step_us gap is the cost of carrying
+    adapters in every decode dispatch, r in {8, 32} at batch 1 and 8.
+    `int8_matmul_vs_f32`: the scaled-int8 weight matmul
+    (`ops.quant.int8_matmul` — int8 storage, fp32 accumulate) against
+    the same-shape fp32 matmul, measured PAIRED (measure_pair) so the
+    sub-2x delta is stable on this 1-core box; step_us is the int8
+    side, f32_step_us/int8_speedup ride along. On the
+    committed-baseline CPU backend both route through XLA (the rows
+    exist so the TPU driver's refresh shows the pallas tile + weight-
+    traffic delta)."""
+
+    def lora(batch, d, r, with_delta, n_adapters=8, steps=30):
+        def bench():
+            import jax
+            import jax.numpy as jnp
+
+            from paddle_tpu.ops import quant as Q
+
+            rs = np.random.RandomState(0)
+            x = jnp.asarray(rs.randn(batch, 1, d).astype("f4"))
+            w = jnp.asarray((rs.randn(d, d) * 0.05).astype("f4"))
+            b = jnp.asarray(rs.randn(d).astype("f4"))
+            Ab = jnp.asarray(
+                (rs.randn(n_adapters, d, r) * 0.05).astype("f4"))
+            Bb = jnp.asarray(
+                (rs.randn(n_adapters, r, d) * 0.05).astype("f4"))
+            ids = jnp.asarray(rs.randint(0, n_adapters, (batch,)),
+                              jnp.int32)
+
+            if with_delta:
+                fn = jax.jit(lambda a, wa, wb, i: (
+                    a @ w + b + Q.lora_delta(a, wa, wb, i)))
+                return _time_direct(lambda: fn(x, Ab, Bb, ids), steps)
+            fn = jax.jit(lambda a: a @ w + b)
+            return _time_direct(lambda: fn(x), steps)
+
+        bench._direct = True
+        return bench
+
+    def int8_vs_f32(m, d, n, steps=30):
+        def bench():
+            import jax
+            import jax.numpy as jnp
+
+            from paddle_tpu.ops import quant as Q
+
+            rs = np.random.RandomState(0)
+            x = jnp.asarray(rs.randn(m, d).astype("f4"))
+            w = jnp.asarray((rs.randn(d, n) * 0.05).astype("f4"))
+            wq, ws = Q.quantize_int8_weight(w)
+            f_int8 = jax.jit(lambda a: Q.int8_matmul(a, wq, ws))
+            f_f32 = jax.jit(lambda a: a @ w)
+            dt8, dt32 = measure_pair(lambda: f_int8(x),
+                                     lambda: f_f32(x))
+            return {"step_us": round(dt8 * 1e6, 2),
+                    "f32_step_us": round(dt32 * 1e6, 2),
+                    "int8_speedup": round(dt32 / max(dt8, 1e-12), 3)}
+
+        bench._direct = True
+        return bench
+
+    rows = [(f"lora_base_b{b}", lora(b, 768, 8, False))
+            for b in (1, 8)]
+    rows += [(f"lora_decode_r{r}_b{b}", lora(b, 768, r, True))
+             for r in (8, 32) for b in (1, 8)]
+    rows.append(("int8_matmul_vs_f32", int8_vs_f32(8, 768, 3072)))
+    return rows
 
 
 def measure(run, args=(), *, steps=30, lo=5, k=5, detail=False):

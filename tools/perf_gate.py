@@ -71,6 +71,13 @@ SPEC_OPS = ("spec_decode_plain_b1_L2048",
             "paged_decode_b8_L2048_p16_f32",
             "paged_verify_k4_f32")
 
+#: multi-tenant rows folded into the full-run default (PR 15): the
+#: decode-shaped base linear and its adapter-carrying pair (the
+#: step_us gap is the per-dispatch cost of carrying LoRA banks — a
+#: regression here taxes EVERY multi-tenant decode step), plus the
+#: int8-vs-f32 weight matmul row (paired in-row via measure_pair)
+LORA_OPS = ("lora_base_b8", "lora_decode_r8_b8", "int8_matmul_vs_f32")
+
 #: tuned-vs-fallback rows folded into the full-run default (PR 11):
 #: the autotuned flash_decode config must NEVER be slower than the
 #: hand-picked constants it replaced. Both sides are measured fresh,
@@ -339,8 +346,8 @@ def main(argv=None):
             # keeps the tight default
             args.tol_op = 4.0
     else:
-        op_names = ([c[0] for c in _quick8()] + list(SPEC_OPS)) \
-            if args.ops is None else []
+        op_names = ([c[0] for c in _quick8()] + list(SPEC_OPS)
+                    + list(LORA_OPS)) if args.ops is None else []
         bench_names = list(DEFAULT_BENCH) if args.bench is None else []
         tuning_rows = list(TUNING_ROWS)
     if args.ops is not None:
