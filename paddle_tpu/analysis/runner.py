@@ -141,6 +141,19 @@ def build_check_engines(include_sharded=True):
     out.append(("tenant", ServingEngine(
         dec, emb, proj, num_slots=4, max_len=32, adapters=pool,
         quantize="int8")))
+    # radix (PR 16): the paged cell's prefix cache enumerates the
+    # `pattach` partial-attach pair for the admitted prompt bucket;
+    # this cell adds the ADAPTER-carrying shape of the same family —
+    # pattach rides ids + stacked banks like every other join, so the
+    # donation audit sees the radix path exactly as multi-tenant
+    # traffic runs it (banks undonated/shared, pool state carry
+    # audited under the join-family baseline rule)
+    dec, emb, proj = _small_stack(seed=14)
+    pool = AdapterPool(dec, capacity=3, rank=8)
+    pool.register_random("t1", seed=1)
+    out.append(("radix", ServingEngine(
+        dec, emb, proj, num_slots=4, max_len=32, paged=True,
+        page_size=8, adapters=pool)))
     if include_sharded:
         mesh = _local_mesh(dp=2)
         if mesh is not None:

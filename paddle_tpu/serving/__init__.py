@@ -49,7 +49,8 @@ from .engine import (ArtifactServingEngine, PagedServingEngine,
                      ServingEngine, WatchdogTimeout)
 from .metrics import (CallbackList, ServingCallback, ServingMetrics,
                       to_prometheus)
-from .paging import OutOfPages, PageAllocator, PagedKVCache, PrefixCache
+from .paging import (OutOfPages, PageAllocator, PagedKVCache,
+                     PrefixCache, RadixPrefixCache)
 from .scheduler import QueueFull, Request, RequestResult, Scheduler
 from .server import ServerCrashed, ServingServer
 from .sharded import ShardedPagedServingEngine, ShardedServingEngine
@@ -62,7 +63,8 @@ __all__ = [
     "ServingServer", "Scheduler", "Request", "RequestResult",
     "QueueFull", "ServingMetrics", "ServingCallback", "CallbackList",
     "WatchdogTimeout", "ServerCrashed", "OutOfPages", "PageAllocator",
-    "PagedKVCache", "PrefixCache", "RetraceError", "RetraceSentinel",
+    "PagedKVCache", "PrefixCache", "RadixPrefixCache", "RetraceError",
+    "RetraceSentinel",
     "retrace_sentinel", "session_scope", "to_prometheus",
     "AdapterPool", "OutOfAdapters", "quantize_net",
 ]

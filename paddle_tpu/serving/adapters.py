@@ -146,6 +146,7 @@ class AdapterPool:
         self.hits = 0
         self.misses = 0
         self._metrics = None
+        self._invalidate_cbs = []      # see on_invalidate()
 
     # ---- engine wiring ----
     def bind_metrics(self, metrics):
@@ -201,12 +202,23 @@ class AdapterPool:
             self._free.append(row)
         self._registry[name] = stored
         self._gen[name] = self._gen.get(name, 0) + 1
+        for cb in self._invalidate_cbs:
+            cb(name, self._gen[name])
         return self
 
     def generation(self, name):
         """Registration generation for `name` (0 = unregistered) —
         folded into the paged engine's per-tenant prefix keys."""
         return self._gen.get(name, 0)
+
+    def on_invalidate(self, cb):
+        """Subscribe `cb(name, new_generation)` to re-registrations:
+        the paged engine's radix prefix cache drops the tenant's
+        subtree EAGERLY (releasing its page references now) instead of
+        waiting for the generation key to orphan it lazily. Callbacks
+        must hold only weak references to long-lived owners."""
+        self._invalidate_cbs.append(cb)
+        return cb
 
     def register_random(self, name, seed=0, scale=0.1):
         """Convenience for tests/benches: a deterministic random

@@ -43,7 +43,8 @@ from ..profiler import costs as _costs
 from ..profiler import trace as _trace
 from ..testing import faults
 from . import tracing as _rt
-from .paging import OutOfPages, PageAllocator, PrefixCache, pages_for
+from .paging import (OutOfPages, PageAllocator, RadixPrefixCache,
+                     pages_for)
 from .metrics import CallbackList, ServingMetrics
 
 __all__ = ["ServingEngine", "PagedServingEngine",
@@ -53,6 +54,7 @@ __all__ = ["ServingEngine", "PagedServingEngine",
 #: chaos runs; a disarmed hit is one boolean read)
 _PT_SLOT_JOIN = faults.point("serving.slot_join")
 _PT_PREFILL = faults.point("serving.prefill")
+_PT_PATTACH = faults.point("serving.pattach")
 _PT_DECODE = faults.point("serving.decode_step")
 
 
@@ -1015,6 +1017,14 @@ class ServingEngine(_EngineBase):
                 n_params, 1, Pb, n_layers, heads, hd, mem_len=M)
             return {"flops": flops, "bytes_accessed": w + pool,
                     "argument_bytes": w + pool}
+        if kind == "pattach" and len(key) > 2:
+            # tail-only prefill: Tb query rows through the net, each
+            # attending over at most the (Mb + tail) page window
+            Tb = int(key[2])
+            flops = _costs.transformer_prefill_flops(
+                n_params, 1, Tb, n_layers, heads, hd, mem_len=M)
+            return {"flops": flops, "bytes_accessed": w + pool,
+                    "argument_bytes": w + pool}
         if kind in ("attach", "cow", "splice"):
             # row splices / page copies: byte traffic, ~no matmul flops
             return {"flops": 0.0, "bytes_accessed": pool,
@@ -1354,8 +1364,24 @@ class PagedServingEngine(ServingEngine):
         self.kv_dtype = kv_dtype
         self.reserve_decode_frac = float(reserve_decode_frac)
         self._alloc = PageAllocator(self.num_pages, page_size)
-        self._prefix = (PrefixCache(self._alloc, prefix_capacity)
+        self._prefix = (RadixPrefixCache(self._alloc, prefix_capacity,
+                                         page_size=page_size)
                         if prefix_cache else None)
+        self._partial_ok = None   # resolved lazily (needs jnp)
+        if self._prefix is not None and self._apool is not None:
+            # eager tenant invalidation: an adapter re-register drops
+            # the stale subtree immediately (the generation key would
+            # also catch it lazily on next touch)
+            import weakref
+
+            wr = weakref.ref(self)
+
+            def _drop(name, gen):
+                e = wr()
+                if e is not None and e._prefix is not None:
+                    e._prefix.drop_tenant(name)
+
+            self._apool.on_invalidate(_drop)
         self._table = np.full((self.num_slots, self.max_pages), -1,
                               np.int32)
         self._index = np.zeros(self.num_slots, np.int32)
@@ -1492,11 +1518,19 @@ class PagedServingEngine(ServingEngine):
         if self._prefix is not None:
             pad_id = int(r.eos_id) if r.eos_id is not None else 0
             row, P0, Pb = pad_prompt_row(r.prompt, pad_id)
-            if self._prefix.peek(self._prefix_key(row, P0, r)) \
-                    is not None:
+            res = self._prefix.peek(
+                row[0, :P0], P0, Pb, r.memory, self._tenant_key(r),
+                allow_partial=self._radix_partial_ok())
+            if res is not None and res[0] == "whole":
                 # shared pages are free; only a COW of the partial
                 # tail page (when the bucket ends mid-page) is new
                 need_prompt = 1 if Pb % self.page_size else 0
+            elif res is not None:
+                # matched prefix pages are free; the joiner allocates
+                # the rest (COW page included) + a possible tail COW
+                m = len(res[1]["pages"])
+                need_prompt = (n_pp - m) + \
+                    (1 if Pb % self.page_size else 0)
         total = pages_for(Pb + r.max_new_tokens +
                           self._spec_overhang(), self.page_size)
         reserve = int(np.ceil(
@@ -1532,6 +1566,10 @@ class PagedServingEngine(ServingEngine):
         gauges = dict(super()._iteration_gauges() or {})
         gauges.update({"pages_in_use": self._alloc.pages_in_use,
                        "pages_free": self._alloc.pages_free})
+        if self._prefix is not None:
+            st = self._prefix.stats()
+            gauges.update({"trie_nodes": st["nodes"],
+                           "trie_pages": st["pages"]})
         active_toks = sum(int(self._index[s])
                           for s, r in enumerate(self.slots)
                           if r is not None)
@@ -1551,19 +1589,36 @@ class PagedServingEngine(ServingEngine):
         return gauges
 
     # ---- join: prefill into pages, or attach shared prefix pages ----
-    def _prefix_key(self, padded_row, P0, r):
-        from .paging import PrefixCache as PC
-
-        # the prompt K/V depend on the adapter that prefilled them
-        # (LoRA on the K/V projections), so shared-prefix reuse is
-        # PER TENANT — the key carries the adapter name + its
-        # registration GENERATION (never the recyclable bank row), so
-        # re-registered tenant weights can't serve a stale prefix
+    def _tenant_key(self, r):
+        """The radix trie's tenant scope. The prompt K/V depend on the
+        adapter that prefilled them (LoRA on the K/V projections from
+        token 0), so adapter traffic gets its own subtree keyed by
+        (adapter name, registration GENERATION — never the recyclable
+        bank row), while adapter-LESS requests share ONE base subtree
+        across every logical tenant: base-model preambles are the only
+        pages that are safely identical across tenants."""
         name = getattr(r, "adapter", None)
-        gen = (self._apool.generation(name)
-               if self._apool is not None and name is not None else 0)
-        return (int(P0), name, gen) + \
-            PC.key_of(padded_row[0], r.memory)
+        if name is None or self._apool is None:
+            return None
+        return (name, self._apool.generation(name))
+
+    def _radix_partial_ok(self):
+        """Partial (tail-prefill) reuse is admitted only when pages
+        store the COMPUTE dtype: the pattach tail attends to the seed
+        K/V as STORED, while a cold prefill attends to full-precision
+        K/V before quantization — under int8/bf16 storage the two
+        diverge, so quantized pools keep whole-prompt reuse only
+        (whole hits replay the same decode-read path either way)."""
+        if self._partial_ok is None:
+            import jax.numpy as jnp
+
+            from .paging import resolve_kv_dtype
+
+            storage, quantized = resolve_kv_dtype(
+                self.kv_dtype, jnp.dtype(self._np_dtype))
+            self._partial_ok = (not quantized and
+                                storage == jnp.dtype(self._np_dtype))
+        return self._partial_ok
 
     def _check_params(self):
         """Prefix-cache entries hold MODEL-DERIVED state (prompt K/V
@@ -1605,21 +1660,37 @@ class PagedServingEngine(ServingEngine):
         self._slot_pages_total[s] = pages_for(
             Pb + r.max_new_tokens + self._spec_overhang(),
             self.page_size)
-        hit = None
+        res = None
         if self._prefix is not None:
-            key = self._prefix_key(prompt_b, P0, r)
-            hit = self._prefix.lookup(key)
-            self.metrics.record_prefix(hit is not None)
+            res = self._prefix.lookup(
+                prompt_b[0, :P0], P0, Pb, r.memory,
+                self._tenant_key(r),
+                allow_partial=self._radix_partial_ok())
+            kind = res[0] if res is not None else "miss"
+            matched = (P0 if kind == "whole"
+                       else res[1]["seed_len"] if kind == "partial"
+                       else 0)
+            self.metrics.record_prefix(kind, matched_tokens=matched,
+                                       prompt_tokens=P0)
         if r._trace is not None:
             _rt.on_join_attr(r, prompt_bucket=Pb,
-                             prefix_hit=hit is not None)
-        if hit is not None:
-            return self._attach_shared(s, r, hit, prompt_b, P0, Pb)
-        return self._prefill_join(
-            s, r, prompt_b, P0, Pb,
-            key if self._prefix is not None else None, row)
+                             prefix_hit=res is not None and
+                             res[0] == "whole")
+            if self._prefix is not None:
+                psz = self.page_size
+                _rt.on_prefix_match(
+                    r, kind,
+                    matched_pages=pages_for(matched, psz) if matched
+                    else 0,
+                    matched_tokens=matched)
+        if res is not None and res[0] == "whole":
+            return self._attach_shared(s, r, res[1], prompt_b, P0, Pb)
+        if res is not None:
+            return self._pattach_join(s, r, res[1], prompt_b, P0, Pb,
+                                      row)
+        return self._prefill_join(s, r, prompt_b, P0, Pb, row)
 
-    def _prefill_join(self, s, r, prompt_b, P0, Pb, key, row=0):
+    def _prefill_join(self, s, r, prompt_b, P0, Pb, row=0):
         import jax.numpy as jnp
 
         _PT_PREFILL()
@@ -1642,8 +1713,89 @@ class PagedServingEngine(ServingEngine):
         self._index[s] = Pb
         self.prefill_count += 1
         tok0 = int(tok0)
-        if self._prefix is not None and key is not None:
-            self._prefix.insert(key, pages, tok0, P0, Pb)
+        if self._prefix is not None:
+            self._prefix.insert(prompt_b[0, :P0], P0, Pb, r.memory,
+                                self._tenant_key(r), pages, tok0)
+        self._cow_tail(s, Pb)
+        return tok0
+
+    def _pattach_join(self, s, r, match, prompt_b, P0, Pb, row=0):
+        """Radix PARTIAL hit: map the matched prefix pages read-only,
+        COW the mid-page divergence point (when the match ends inside
+        a page), and prefill ONLY the divergent tail through the
+        bucketed `pattach` program — prefill FLOPs scale with the
+        MISSED tokens, not the prompt. The extended prompt is inserted
+        back into the trie, so a conversation tree deepens the shared
+        prefix one branch at a time."""
+        import jax.numpy as jnp
+
+        _PT_PATTACH()
+        psz = self.page_size
+        matched = [int(p) for p in match["pages"]]
+        m = len(matched)
+        j = int(match["j"])
+        seed_len = m * psz + j
+        n_pp = pages_for(Pb, psz)
+        if self._fm_cross is None:
+            self._fm_cross = _make_cross_kv_fm(self._net.decoder)
+        self._alloc.incref(matched)
+        owned = []       # pages THIS join allocated (released on fail)
+        try:
+            if j:
+                dst = self._alloc_pages(1)[0]
+                owned.append(dst)
+                fn = self._program(("cow",), self._build_cow)
+                self._state = fn(self._state,
+                                 jnp.int32(int(match["cow_src"])),
+                                 jnp.int32(dst))
+                self.metrics.record_cow_copy()
+                head = matched + [dst]
+            else:
+                head = list(matched)
+            fresh = self._alloc_pages(n_pp - len(head)) \
+                if n_pp > len(head) else []
+            owned.extend(fresh)
+            full_pages = head + fresh
+            n_tail = P0 - seed_len
+            Tb = max(2, bucket_size(n_tail))   # >= 2: the tail block
+            #                      must take the verify path, not the
+            #                      single-token decode path
+            Mb = bucket_size(m + (1 if j else 0), minimum=1)
+            W = min(self.max_pages, Mb + pages_for(Tb, psz))
+            key = ("pattach", Mb, Tb)
+            fn = self._program(key,
+                               lambda: self._build_pattach(Mb, Tb))
+            trow = np.full((1, W), self.num_pages, np.int32)
+            k = min(W, n_pp)
+            trow[0, :k] = full_pages[:k]
+            tail = np.full((1, Tb),
+                           int(r.eos_id) if r.eos_id is not None else 0,
+                           np.int32)
+            tail[0, :n_tail] = np.asarray(prompt_b[0, seed_len:P0],
+                                          np.int32)
+            self._state, tok0 = fn(
+                self._params(), self._buffers(), self._cross_params(),
+                self._fm_cross.buffers(), self._state, jnp.int32(s),
+                jnp.asarray(trow), jnp.asarray(tail),
+                jnp.int32(seed_len), jnp.asarray([P0], jnp.int32),
+                jnp.int32(Pb),
+                jnp.asarray(np.asarray(r.memory, self._np_dtype)[None]),
+                *self._attach_spec_rows(prompt_b, Pb),
+                *self._join_adapter_args(row))
+        except Exception:
+            if owned:
+                self._alloc.decref(owned)
+            self._alloc.decref(matched)
+            raise
+        self._table[s, :n_pp] = full_pages
+        self._index[s] = Pb
+        tok0 = int(tok0)
+        # insert BEFORE the tail COW so the trie adopts the slot's
+        # pages while they are still the canonical prompt pages — the
+        # COW then sees the shared refcount and gives the slot its
+        # private decode page (same ordering as the cold prefill path)
+        self._prefix.insert(prompt_b[0, :P0], P0, Pb, r.memory,
+                            self._tenant_key(r), full_pages, tok0)
         self._cow_tail(s, Pb)
         return tok0
 
@@ -1712,11 +1864,17 @@ class PagedServingEngine(ServingEngine):
             raise
         self._alloc.decref([src])
         self._table[s, pi] = dst
+        self.metrics.record_cow_copy()
 
     # ---- compiled programs (bodies live in layers.PagedLayout) ----
     def _build_paged_join(self, Pb):
         return self.placement.build(("pjoin", Pb),
                                     self.layout.join_body(Pb),
+                                    has_aux=True)
+
+    def _build_pattach(self, Mb, Tb):
+        return self.placement.build(("pattach", Mb, Tb),
+                                    self.layout.pattach_body(Mb, Tb),
                                     has_aux=True)
 
     def _build_attach(self):
@@ -1781,6 +1939,27 @@ class PagedServingEngine(ServingEngine):
             progs.append((
                 ("cow",), self._build_cow,
                 (state, jnp.int32(0), jnp.int32(0))))
+            if self._radix_partial_ok():
+                # partial-attach pairs the radix cache will hit first:
+                # a last-page divergence per admitted prompt bucket
+                # (matched = all-but-one page, tail = one page)
+                psz = self.page_size
+                pairs = sorted({
+                    (bucket_size(max(1, pages_for(Pb, psz) - 1)),
+                     max(2, bucket_size(min(psz, Pb))))
+                    for Pb in {bucket_size(int(p))
+                               for p in prompt_buckets}})
+                for Mb, Tb in pairs:
+                    W = min(self.max_pages, Mb + pages_for(Tb, psz))
+                    progs.append((
+                        ("pattach", Mb, Tb),
+                        lambda Mb=Mb, Tb=Tb: self._build_pattach(
+                            Mb, Tb),
+                        (params, buffers, self._cross_params(),
+                         self._fm_cross.buffers(), state, jnp.int32(0),
+                         jnp.full((1, W), self.num_pages, jnp.int32),
+                         jnp.zeros((1, Tb), jnp.int32), jnp.int32(1),
+                         one, jnp.int32(Tb), mem1) + spec_rows + jad))
         if self.spec_k:
             dkey = ("draft",) + self._pool_key
             progs.append((

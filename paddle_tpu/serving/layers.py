@@ -53,6 +53,14 @@ class CacheLayout:
     def __init__(self, eng):
         self.eng = eng
 
+    def __repr__(self):
+        # folded into the persistent program-cache fingerprint: must
+        # be stable across processes (no default object address repr).
+        # Layouts are parameterless — pool geometry already lives in
+        # the engine half of the fingerprint — so the class name is
+        # the whole identity.
+        return type(self).__name__
+
     # ---- program-family keys ----
     def join_key(self, Pb):
         raise NotImplementedError
@@ -564,6 +572,97 @@ class PagedLayout(CacheLayout):
             return dict(state, paged=new_paged)
 
         return cow_fn
+
+    # ---- the partial-attach program (radix hit: tail-only prefill) ----
+    def pattach_body(self, Mb, Tb):
+        """Prefill ONLY a prompt's divergent tail, seeded by trie-
+        matched pages: the Tb-bucketed tail runs as ONE verify-mode
+        block through the page pool itself — `write_tokens` lands the
+        tail K/V at the seed boundary through a WIDTH-CLIPPED table row
+        ([1, Mb + pages_for(Tb)]) and `paged_verify_attention` reads
+        the matched seed K/V back through the same row, so attention
+        cost scales with the HIT size, not the full pool. One compile
+        per (matched-pages bucket, tail bucket) pair: seed length,
+        slot, and true prompt length are traced scalars, so hit depth
+        never retraces. Rides the same decode-sharding scope and LoRA
+        context as the verify step, so sharded / spec / adapter cells
+        inherit it unchanged."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..nn.layer.transformer import MultiHeadAttention as MHA
+        from ..ops import attention as A
+        from . import paging as PG
+
+        eng = self.eng
+        fm = eng._fm
+        fm_cross = eng._fm_cross
+        L = eng._pool_len
+        psz = eng.page_size
+        W = min(eng.max_pages, int(Mb) + PG.pages_for(Tb, psz))
+        spec = bool(eng.spec_k)
+        ck = ("pattach", Mb, Tb)
+        neg = eng._neg
+
+        def pattach_fn(params, buffers, cparams, cbuffers, state, slot,
+                       trow, tail, seed_len, length, pb, memory, *rest):
+            eng.trace_counts[ck] += 1  # one per trace = one compile
+            if spec:
+                (hist_row,), ad = rest[:1], rest[1:]
+            else:
+                hist_row, ad = None, rest
+            static1, _ = fm_cross.apply(cparams, cbuffers, None,
+                                        memory, training=False)
+            kpos = jnp.arange(L, dtype=jnp.int32)
+            hole = (kpos[None, :] >= length[:, None]) & \
+                (kpos[None, :] < pb)
+            bias_row = jnp.where(hole, jnp.float32(neg),
+                                 jnp.float32(0.0))           # [1, L]
+            # batch-1 paged view through the clipped table row: the
+            # verify-scope write lands tail K/V at positions
+            # [seed_len, seed_len + Tb) and the verify read gathers
+            # only the W mapped pages (bias clipped to match)
+            inc = [PG.PagedKVCache(pc["k"], pc["v"], pc["ks"],
+                                   pc["vs"], trow, seed_len.reshape(1))
+                   for pc in state["paged"]]
+            posn = seed_len + jnp.arange(Tb, dtype=jnp.int32)[None]
+            with A.kv_verify_scope(), eng._lora_ctx(ad):
+                (lg, inc2), _ = fm.apply(
+                    params, buffers, None, tail, posn, memory,
+                    training=False, tgt_mask=bias_row[:, :W * psz],
+                    memory_mask=None, inc=inc, static_kv=static1,
+                    prefill=False)
+            # token 0 conditions on the LAST REAL prompt position,
+            # which sits at tail lane (length - 1 - seed_len)
+            last = jnp.take_along_axis(
+                lg, (length - 1 - seed_len)[:, None, None],
+                axis=1)[:, 0]
+            tok0 = last.argmax(-1).astype(jnp.int32)[0]
+            new_paged = [{"k": c.k, "v": c.v, "ks": c.k_scale,
+                          "vs": c.v_scale} for c in inc2]
+            new_static = [(MHA.splice_rows(pk, slot, sk),
+                           MHA.splice_rows(pv, slot, sv))
+                          for (pk, pv), (sk, sv) in zip(state["static"],
+                                                        static1)]
+            out = dict(
+                state,
+                tok=jax.lax.dynamic_update_slice(
+                    state["tok"], tok0[None], (slot,)),
+                bias=MHA.splice_rows(state["bias"], slot, bias_row),
+                mem=MHA.splice_rows(state["mem"], slot, memory),
+                static=new_static,
+                paged=new_paged)
+            if spec:
+                out["hist"] = MHA.splice_rows(state["hist"], slot,
+                                              hist_row)
+                out["plen"] = jax.lax.dynamic_update_slice(
+                    state["plen"], length.astype(jnp.int32), (slot,))
+                out["pbk"] = jax.lax.dynamic_update_slice(
+                    state["pbk"], pb.reshape(1).astype(jnp.int32),
+                    (slot,))
+            return out, tok0
+
+        return pattach_fn
 
     # ---- the plain batched decode step (through the page table) ----
     def step_body(self, ck):

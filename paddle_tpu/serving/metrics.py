@@ -83,6 +83,25 @@ SNAPSHOT_DOCS = {
     "paging.oom_evictions": ("counter", "mid-decode OutOfPages victims"),
     "paging.bytes_per_active_token": (
         "summary", "cache bytes per live token (oversubscription)"),
+    # radix prefix cache (PR 16) — the section appears once a paged
+    # join consults the trie
+    "prefix.whole_hits": ("counter",
+                          "joins fully served by cached pages (zero "
+                          "prefill FLOPs)"),
+    "prefix.partial_hits": ("counter",
+                            "joins that matched a prefix and prefilled "
+                            "only the divergent tail (pattach)"),
+    "prefix.misses": ("counter", "joins that ran a full cold prefill"),
+    "prefix.hit_token_ratio": (
+        "gauge", "prefix tokens served from cache / prompt tokens "
+                 "offered — the prefill-FLOPs savings lever"),
+    "prefix.cow_copies": ("counter",
+                          "copy-on-write page copies (mid-page "
+                          "divergence + shared decode tails)"),
+    "prefix.trie_nodes": ("gauge",
+                          "radix-trie page nodes at last iteration"),
+    "prefix.trie_pages": ("gauge",
+                          "physical pages referenced by the trie"),
     # live HBM ledger (PR 9) — the section appears once the engine
     # registers its memory provider (model-backed engines always do)
     "memory.weights_bytes": (
@@ -361,6 +380,16 @@ class ServingMetrics:
         self.pages_free = None
         self.prefix_hits = 0        # joins served from the prefix cache
         self.prefix_misses = 0      # joins that ran a real prefill
+        # radix prefix-cache accounting (PR 16): the snapshot grows a
+        # "prefix" section once a join consults the trie
+        self._prefix_recorded = False
+        self.prefix_whole_hits = 0
+        self.prefix_partial_hits = 0
+        self.prefix_matched_tokens = 0   # prompt tokens served cached
+        self.prefix_prompt_tokens = 0    # prompt tokens offered
+        self.cow_copies = 0
+        self.trie_nodes = None      # last-iteration gauges
+        self.trie_pages = None
         self.page_waits = 0         # admissions deferred on page headroom
         self.oom_evictions = 0      # mid-decode OutOfPages victims
         self.bytes_per_token = _Reservoir(512)  # bytes / active token
@@ -545,14 +574,34 @@ class ServingMetrics:
         with self._lock:
             self.fallbacks += 1
 
-    def record_prefix(self, hit):
-        """A paged join consulted the prefix cache: hit = shared pages
-        mapped with zero prefill; miss = a real prefill ran."""
+    def record_prefix(self, kind, matched_tokens=0, prompt_tokens=0):
+        """A paged join consulted the prefix cache. `kind` is "whole"
+        (every prompt page mapped shared, zero prefill), "partial"
+        (matched prefix mapped, only the divergent tail prefilled) or
+        "miss" (full cold prefill); bools keep the pre-radix contract
+        (True = whole). The token counts feed hit_token_ratio — the
+        prefill-FLOPs savings the radix cache exists for."""
+        if isinstance(kind, bool):
+            kind = "whole" if kind else "miss"
         with self._lock:
-            if hit:
+            self._prefix_recorded = True
+            if kind == "whole":
                 self.prefix_hits += 1
+                self.prefix_whole_hits += 1
+            elif kind == "partial":
+                self.prefix_hits += 1
+                self.prefix_partial_hits += 1
             else:
                 self.prefix_misses += 1
+            self.prefix_matched_tokens += int(matched_tokens)
+            self.prefix_prompt_tokens += int(prompt_tokens)
+
+    def record_cow_copy(self, n=1):
+        """A copy-on-write page copy ran (a joiner's decode tail page
+        was shared, or a partial hit diverged mid-page)."""
+        with self._lock:
+            self._prefix_recorded = True
+            self.cow_copies += n
 
     def record_page_wait(self):
         """Admission deferred: not enough free pages for the queue head
@@ -722,7 +771,8 @@ class ServingMetrics:
 
     def record_iteration(self, queue_depth, occupancy, pages_in_use=None,
                          pages_free=None, bytes_per_active_token=None,
-                         shard_occupancy=None, tenant_slots=None):
+                         shard_occupancy=None, tenant_slots=None,
+                         trie_nodes=None, trie_pages=None):
         with self._lock:
             self.iterations += 1
             self.queue_depth.add(queue_depth)
@@ -734,6 +784,10 @@ class ServingMetrics:
                 self.pages_in_use = int(pages_in_use)
             if pages_free is not None:
                 self.pages_free = int(pages_free)
+            if trie_nodes is not None:
+                self.trie_nodes = int(trie_nodes)
+            if trie_pages is not None:
+                self.trie_pages = int(trie_pages)
             if bytes_per_active_token is not None:
                 self.bytes_per_token.add(bytes_per_active_token)
             if shard_occupancy is not None:
@@ -900,6 +954,17 @@ class ServingMetrics:
                     "oom_evictions": self.oom_evictions,
                     "bytes_per_active_token":
                         self.bytes_per_token.summary(digits=1),
+                }}),
+                **({} if not self._prefix_recorded else {"prefix": {
+                    "whole_hits": self.prefix_whole_hits,
+                    "partial_hits": self.prefix_partial_hits,
+                    "misses": self.prefix_misses,
+                    "hit_token_ratio": round(
+                        self.prefix_matched_tokens /
+                        max(1, self.prefix_prompt_tokens), 4),
+                    "cow_copies": self.cow_copies,
+                    "trie_nodes": self.trie_nodes or 0,
+                    "trie_pages": self.trie_pages or 0,
                 }}),
             }
 

@@ -45,7 +45,8 @@ import hashlib
 
 import numpy as np
 
-__all__ = ["OutOfPages", "PageAllocator", "PrefixCache", "PagedKVCache",
+__all__ = ["OutOfPages", "PageAllocator", "PrefixCache",
+           "RadixPrefixCache", "PagedKVCache",
            "pages_for", "resolve_kv_dtype", "quantize_chunks",
            "chunk_prompt", "write_prompt_pages", "write_token",
            "write_tokens", "copy_page", "gather_pages"]
@@ -224,6 +225,9 @@ class PrefixCache:
         """Adopt `pages` (already refcounted by their owner): the cache
         takes its own reference so they survive the owner's eviction."""
         if key in self._entries:
+            # a re-inserted prefix is HOT: refresh its LRU position so
+            # it isn't evicted ahead of genuinely colder entries
+            self._entries.move_to_end(key)
             return
         self.allocator.incref(pages)
         self._entries[key] = {"pages": list(pages), "tok0": int(tok0),
@@ -247,6 +251,381 @@ class PrefixCache:
     def flush(self):
         while self._entries:
             self._drop_lru()
+
+    @property
+    def hit_rate(self):
+        n = self.hits + self.misses
+        return (self.hits / n) if n else 0.0
+
+
+class _RadixNode:
+    """One FULL page of prompt tokens in the radix trie. The edge from
+    the parent is the page's `page_size`-token run; `page` is the
+    physical page holding its K/V (the trie owns one reference).
+    `terminals` hang completed prompts off the node: the sub-page tail
+    tokens + prompt length key the pages past the last full page (the
+    partial last page and any hole pages up to the prompt bucket)."""
+
+    __slots__ = ("tokens", "page", "parent", "children", "terminals",
+                 "tick")
+
+    def __init__(self, tokens, page, parent):
+        self.tokens = tokens          # page_size-tuple (None at roots)
+        self.page = page              # physical page id (None at roots)
+        self.parent = parent
+        self.children = {}            # {page-token-tuple: _RadixNode}
+        self.terminals = {}           # {(tail-tuple, P0): entry dict}
+        self.tick = 0
+
+
+class RadixPrefixCache:
+    """Host-side token trie over prefilled prompt pages: edges are
+    page-granular token runs, so two prompts sharing a long preamble
+    share the preamble's PHYSICAL pages even when their tails differ.
+
+    `lookup` returns the longest-prefix match:
+
+      * a WHOLE hit (full pages + a terminal whose tail tokens and
+        prompt length match exactly) maps every cached page with zero
+        prefill FLOPs — same contract as the flat `PrefixCache`;
+      * a PARTIAL hit returns the matched full pages plus, when the
+        divergence falls mid-page, a copy-on-write source page and the
+        in-page match length `j` — the engine copies that page and
+        prefills ONLY the divergent tail (the `pattach` program
+        family), seeded by the matched K/V.
+
+    Tenancy: trees are scoped by (memory digest, tenant key). Requests
+    with no adapter share one base subtree ACROSS logical tenants —
+    LoRA perturbs K/V from token 0, so only base-model traffic is
+    safely shareable — while adapter traffic is keyed by
+    (adapter name, generation); a generation bump (adapter
+    re-register) orphans the stale subtree lazily on next touch, or
+    eagerly via `drop_tenant`.
+
+    Eviction is leaf-first LRU over terminals and BARE leaf nodes (no
+    children, no terminals): interior nodes keep serving partial
+    matches until everything under them has aged out, and every drop
+    releases exactly the references the trie took, so
+    `PageAllocator.check()` stays clean under chaos."""
+
+    def __init__(self, allocator, capacity=64, page_size=None):
+        self.allocator = allocator
+        self.capacity = int(capacity)
+        self.page_size = int(page_size if page_size is not None
+                             else allocator.page_size)
+        self._roots = {}              # {(mem digest, tenant): _RadixNode}
+        self._tenant_gen = {}         # {adapter name: last-seen gen}
+        self._tick = 0
+        self._n_nodes = 0
+        self._n_terminals = 0
+        self._n_pages = 0             # pages referenced by the trie
+        self.whole_hits = 0
+        self.partial_hits = 0
+        self.misses = 0
+
+    # -- keys ------------------------------------------------------------
+
+    @staticmethod
+    def mem_digest(memory):
+        """Cross-attention memory digest: decoder self-attn K/V depend
+        on the memory through the cross-attn residual stream, so pages
+        are only shareable within one memory scope."""
+        if memory is None:
+            return ""
+        mem = np.ascontiguousarray(memory)
+        digest = hashlib.sha1()
+        digest.update(str(mem.dtype).encode())
+        digest.update(str(mem.shape).encode())
+        digest.update(mem)
+        return digest.hexdigest()
+
+    def _touch(self):
+        self._tick += 1
+        return self._tick
+
+    def _root_for(self, memory, tenant, create):
+        """Scope root, handling tenant-generation invalidation: a
+        stale-generation subtree is dropped before the fresh one is
+        touched (tenant = None for base traffic, (name, gen) for
+        adapter traffic)."""
+        if tenant is not None:
+            name, gen = tenant
+            old = self._tenant_gen.get(name)
+            if old is not None and old != gen:
+                self.drop_tenant(name)
+            self._tenant_gen[name] = gen
+        key = (self.mem_digest(memory), tenant)
+        root = self._roots.get(key)
+        if root is None and create:
+            root = _RadixNode(None, None, None)
+            self._roots[key] = root
+        return root
+
+    # -- lookup ----------------------------------------------------------
+
+    def _walk(self, root, tokens, P0):
+        """Longest run of full-page children matching `tokens[:P0]`.
+        Returns (node, path) where path is the list of matched nodes
+        (so pages AND parents are recoverable)."""
+        psz = self.page_size
+        node, path = root, []
+        n_full = int(P0) // psz
+        for i in range(n_full):
+            child = node.children.get(tuple(tokens[i * psz:(i + 1) * psz]))
+            if child is None:
+                break
+            node = child
+            path.append(child)
+        return node, path
+
+    def _best_partial(self, node, tokens, P0, m):
+        """Best mid-page extension below `node` (which matched `m` full
+        pages): the longest common prefix between the remaining tokens
+        and any child edge or terminal tail hanging here, capped so at
+        least one divergent tail token remains for the partial attach.
+        Returns (j, cow_src_page)."""
+        psz = self.page_size
+        rem = tuple(tokens[m * psz:P0])
+        limit = min(psz - 1, len(rem) - 1)
+        best_j, best_src = 0, None
+        if limit <= 0:
+            return best_j, best_src
+
+        def common(a, b):
+            n = 0
+            for x, y in zip(a, b):
+                if x != y:
+                    break
+                n += 1
+            return n
+
+        for et, child in node.children.items():
+            j = min(common(rem, et), limit)
+            if j > best_j:
+                best_j, best_src = j, child.page
+        for (tail, _p0), ent in node.terminals.items():
+            if ent["pages"]:
+                j = min(common(rem, tail), limit)
+                if j > best_j:
+                    best_j, best_src = j, ent["pages"][0]
+        return best_j, best_src
+
+    def _match(self, memory, tenant, tokens, P0, Pb, allow_partial,
+               mutate):
+        psz = self.page_size
+        tokens = tuple(int(t) for t in tokens)[:int(P0)]
+        if mutate:
+            root = self._root_for(memory, tenant, create=False)
+        else:
+            # peek: read-only, even for generation bookkeeping
+            if tenant is not None:
+                name, gen = tenant
+                old = self._tenant_gen.get(name)
+                if old is not None and old != gen:
+                    return None
+            root = self._roots.get((self.mem_digest(memory), tenant))
+        if root is None:
+            return None
+        node, path = self._walk(root, tokens, P0)
+        m = len(path)
+        n_full = P0 // psz
+        if m == n_full:
+            ent = node.terminals.get((tokens[n_full * psz:P0], P0))
+            if ent is not None and ent["Pb"] == int(Pb):
+                if mutate:
+                    t = self._touch()
+                    for n in path:
+                        n.tick = t
+                    ent["tick"] = t
+                return ("whole", {
+                    "pages": [n.page for n in path] + list(ent["pages"]),
+                    "tok0": ent["tok0"], "n_prompt": ent["n_prompt"],
+                    "Pb": ent["Pb"]})
+        if not allow_partial:
+            return None
+        if m and m * psz == P0:
+            # every real token sits in matched full pages but no
+            # terminal completes the prompt: back off one page so the
+            # attach has a tail to prefill (the dropped page re-emerges
+            # as the COW source with j = page_size - 1)
+            node = path.pop().parent
+            m -= 1
+        j, cow_src = self._best_partial(node, tokens, P0, m)
+        if m == 0 and j == 0:
+            return None
+        if mutate:
+            t = self._touch()
+            for n in path:
+                n.tick = t
+        return ("partial", {
+            "pages": [n.page for n in path], "j": int(j),
+            "cow_src": cow_src, "seed_len": m * psz + int(j)})
+
+    def lookup(self, tokens, P0, Pb, memory=None, tenant=None,
+               allow_partial=True):
+        """Longest-prefix match for `tokens[:P0]` in the (memory,
+        tenant) scope. Returns None, ("whole", entry) or ("partial",
+        {pages, j, cow_src, seed_len}). The CALLER increfs any pages
+        it maps; matched nodes move to MRU."""
+        res = self._match(memory, tenant, tokens, P0, Pb, allow_partial,
+                          mutate=True)
+        if res is None:
+            self.misses += 1
+        elif res[0] == "whole":
+            self.whole_hits += 1
+        else:
+            self.partial_hits += 1
+        return res
+
+    def peek(self, tokens, P0, Pb, memory=None, tenant=None,
+             allow_partial=True):
+        """Like lookup, but side-effect free (no accounting, no MRU
+        move, no generation invalidation) — the admission gate's
+        headroom estimate uses it."""
+        return self._match(memory, tenant, tokens, P0, Pb,
+                           allow_partial, mutate=False)
+
+    # -- insert ----------------------------------------------------------
+
+    def insert(self, tokens, P0, Pb, memory, tenant, pages, tok0):
+        """Extend the trie with a completed prompt's pages (already
+        refcounted by their slot; the trie takes its own references).
+        Full pages become (or refresh) trie nodes one by one — a
+        partial-hit join re-walks its matched prefix and only adopts
+        the pages it actually created — and the sub-page tail plus any
+        hole pages up to the prompt bucket land in a terminal."""
+        psz = self.page_size
+        tokens = tuple(int(t) for t in tokens)[:int(P0)]
+        P0, Pb = int(P0), int(Pb)
+        root = self._root_for(memory, tenant, create=True)
+        n_full = P0 // psz
+        t = self._touch()
+        node = root
+        for i in range(n_full):
+            et = tokens[i * psz:(i + 1) * psz]
+            child = node.children.get(et)
+            if child is None:
+                page = int(pages[i])
+                self.allocator.incref([page])
+                child = _RadixNode(et, page, node)
+                node.children[et] = child
+                self._n_nodes += 1
+                self._n_pages += 1
+            child.tick = t
+            node = child
+        tkey = (tokens[n_full * psz:P0], P0)
+        ent = node.terminals.get(tkey)
+        if ent is not None:
+            ent["tick"] = t               # hot terminal: refresh LRU
+            return
+        tail = [int(p) for p in pages[n_full:]]
+        self.allocator.incref(tail)
+        node.terminals[tkey] = {"pages": tail, "tok0": int(tok0),
+                                "n_prompt": P0, "Pb": Pb, "tick": t}
+        self._n_terminals += 1
+        self._n_pages += len(tail)
+        while self._n_terminals > self.capacity:
+            if not self._evict_one():
+                break
+
+    # -- eviction --------------------------------------------------------
+
+    def _iter_nodes(self):
+        stack = [(key, root) for key, root in self._roots.items()]
+        while stack:
+            key, node = stack.pop()
+            yield key, node
+            for child in node.children.values():
+                stack.append((key, child))
+
+    def _evict_one(self):
+        """Drop the least-recently-used evictable item: a terminal, or
+        a BARE leaf node (no children, no terminals). Interior nodes
+        are never dropped while anything hangs below them — they still
+        serve partial matches — but become bare (and evictable) as
+        their subtrees age out. Returns False when nothing is left."""
+        best = None                   # (tick, kind, ...)
+        for key, node in self._iter_nodes():
+            for tkey, ent in node.terminals.items():
+                if best is None or ent["tick"] < best[0]:
+                    best = (ent["tick"], "terminal", node, tkey)
+            if (node.parent is not None and not node.children
+                    and not node.terminals):
+                if best is None or node.tick < best[0]:
+                    best = (node.tick, "node", node, key)
+        if best is None:
+            return False
+        if best[1] == "terminal":
+            _, _, node, tkey = best
+            ent = node.terminals.pop(tkey)
+            self.allocator.decref(ent["pages"])
+            self._n_terminals -= 1
+            self._n_pages -= len(ent["pages"])
+        else:
+            _, _, node, key = best
+            del node.parent.children[node.tokens]
+            self.allocator.decref([node.page])
+            self._n_nodes -= 1
+            self._n_pages -= 1
+            parent = node.parent
+            if (parent.parent is None and not parent.children
+                    and not parent.terminals):
+                self._roots.pop(key, None)
+        return True
+
+    def reclaim(self, n_needed):
+        """Evict leaf-first LRU until the allocator has `n_needed`
+        free pages or the trie is exhausted. Returns True on success.
+        (Items whose pages are still mapped by live slots free nothing
+        yet — the refcount keeps them alive — so keep evicting.)"""
+        while self.allocator.pages_free < n_needed:
+            if not self._evict_one():
+                break
+        return self.allocator.pages_free >= n_needed
+
+    def _drop_subtree(self, node):
+        for child in list(node.children.values()):
+            self._drop_subtree(child)
+        for ent in node.terminals.values():
+            self.allocator.decref(ent["pages"])
+            self._n_terminals -= 1
+            self._n_pages -= len(ent["pages"])
+        node.terminals.clear()
+        node.children.clear()
+        if node.page is not None:
+            self.allocator.decref([node.page])
+            self._n_nodes -= 1
+            self._n_pages -= 1
+
+    def drop_tenant(self, name):
+        """Release every subtree keyed to adapter `name` (any
+        generation) — the eager path of generation invalidation."""
+        for key in [k for k in self._roots
+                    if k[1] is not None and k[1][0] == name]:
+            self._drop_subtree(self._roots.pop(key))
+        self._tenant_gen.pop(name, None)
+
+    def flush(self):
+        for key in list(self._roots):
+            self._drop_subtree(self._roots.pop(key))
+
+    # -- introspection ---------------------------------------------------
+
+    def stats(self):
+        """Gauges for the metrics snapshot: trie size in nodes (full
+        prompt pages on edges), terminals, and total pages referenced
+        (node pages + terminal tails)."""
+        return {"nodes": self._n_nodes, "terminals": self._n_terminals,
+                "pages": self._n_pages, "scopes": len(self._roots)}
+
+    def __len__(self):
+        return self._n_terminals
+
+    # flat-cache-compatible accounting, so dashboards keyed on the old
+    # PrefixCache surface keep working
+    @property
+    def hits(self):
+        return self.whole_hits + self.partial_hits
 
     @property
     def hit_rate(self):

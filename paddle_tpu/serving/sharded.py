@@ -586,7 +586,14 @@ class ShardedServingEngine(ServingEngine):
                 # poll) its arrays may never flip ready on their
                 # own — past the deadline, block for them. The
                 # overlap win is gone by then anyway; liveness wins.
-                if time.monotonic() - info["t0"] < self.poll_block_s:
+                # When NOTHING is decoding (every occupied slot is
+                # itself pending) the poll loop is a pure spin, so
+                # there is no overlap to protect: block right away —
+                # a fast driver can burn its iteration budget before
+                # poll_block_s of wall time ever elapses.
+                spin = self.occupancy() == len(self._pending)
+                if (not spin and
+                        time.monotonic() - info["t0"] < self.poll_block_s):
                     continue
                 jax.block_until_ready(info["outs"])
             self.metrics.record_prefill_step(

@@ -17,6 +17,9 @@ Span taxonomy (exported Chrome-trace names):
   join            slot join: prefill / prefix attach / disaggregated
                   dispatch -> return (attrs: slot, prompt bucket,
                   prefix_hit)
+  join.prefix_match  instant under the join: the radix prefix-cache
+                  consult (attrs: kind whole/partial/miss,
+                  matched_pages, matched_tokens)
   pending_splice  disaggregated only: prefill dispatched -> K/V
                   spliced into the live pool (the window the slot is
                   occupied-but-masked)
@@ -64,6 +67,8 @@ SPAN_TAXONOMY = (
     ("request", "per-request root: submit -> finish/fail"),
     ("queue", "admission queue wait: submit -> slot pop"),
     ("join", "slot join: prefill / prefix attach / disagg dispatch"),
+    ("join.prefix_match", "instant: radix prefix-cache consult "
+                          "(kind, matched pages/tokens)"),
     ("pending_splice", "disaggregated prefill in flight -> spliced"),
     ("decode", "slot residency in batched decode steps"),
     ("first_token", "instant: first delivered token (TTFT)"),
@@ -148,6 +153,20 @@ def on_join_attr(r, **attrs):
     rt = r._trace
     if rt is not None and rt.join is not None:
         rt.join.attrs.update(attrs)
+
+
+def on_prefix_match(r, kind, matched_pages=0, matched_tokens=0):
+    """Instant span under the join: what the radix prefix cache
+    returned for this request ("whole" / "partial" / "miss") and how
+    much of the prompt it served — the per-request view of the
+    hit_token_ratio gauge."""
+    rt = r._trace
+    if rt is not None:
+        rt.tr.instant("join.prefix_match", cat="request",
+                      trace_id=rt.tid, parent=rt.join or rt.root,
+                      attrs={"kind": kind,
+                             "matched_pages": int(matched_pages),
+                             "matched_tokens": int(matched_tokens)})
 
 
 def on_join_end(r, ok=True, pending=False, error=None):

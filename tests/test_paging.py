@@ -97,6 +97,143 @@ def test_prefix_cache_lru_and_reclaim():
     assert a.pages_free == 8
 
 
+def test_prefix_cache_reinsert_refreshes_lru():
+    """A re-inserted (resident) prefix is HOT: it must move to the MRU
+    end, so the next capacity eviction takes the genuinely coldest
+    entry instead."""
+    a = PageAllocator(8, 16)
+    c = PrefixCache(a, capacity=2)
+    pages = {}
+    for i in range(2):
+        pages[i] = a.alloc(1)
+        c.insert(("k", i), pages[i], tok0=i, n_prompt=1, Pb=1)
+        a.decref(pages[i])
+    c.insert(("k", 0), pages[0], tok0=0, n_prompt=1, Pb=1)  # re-insert
+    p2 = a.alloc(1)
+    c.insert(("k", 2), p2, tok0=2, n_prompt=1, Pb=1)
+    a.decref(p2)
+    assert c.peek(("k", 0)) is not None      # refreshed: survived
+    assert c.peek(("k", 1)) is None          # true LRU evicted
+    c.flush()
+    a.check()
+    assert a.pages_free == 8
+
+
+# ----------------------------------------------------------------------
+# the radix prefix trie (host-side unit cells; no engine)
+# ----------------------------------------------------------------------
+
+def _radix(n_pages=32, psz=4, capacity=64):
+    a = PageAllocator(n_pages, psz)
+    return a, PG.RadixPrefixCache(a, capacity=capacity, page_size=psz)
+
+
+def _radix_insert(a, trie, tokens, P0, Pb, memory=None, tenant=None,
+                  tok0=7):
+    """Alloc the prompt bucket's pages, insert, drop the caller refs —
+    the trie then holds the only references (like a drained slot)."""
+    pages = a.alloc(PG.pages_for(Pb, trie.page_size))
+    trie.insert(tokens, P0, Pb, memory, tenant, pages, tok0)
+    a.decref(pages)
+    return pages
+
+
+def test_radix_trie_longest_prefix_whole_and_partial():
+    a, trie = _radix()
+    toks = (0, 3, 5, 7, 2, 9, 4, 11, 6, 13)          # P0=10, Pb=16
+    pages = _radix_insert(a, trie, toks, 10, 16)
+    # whole hit: every page back, in page order, with the cached tok0
+    kind, ent = trie.lookup(toks, 10, 16)
+    assert kind == "whole"
+    assert ent["pages"] == list(pages) and ent["tok0"] == 7
+    assert ent["n_prompt"] == 10 and ent["Pb"] == 16
+    # page-aligned divergence: first 2 pages (8 tokens) shared
+    div = toks[:8] + (14, 8, 12)                      # P0=11
+    kind, ent = trie.lookup(div, 11, 16)
+    assert kind == "partial"
+    assert ent["pages"] == list(pages[:2])
+    assert ent["j"] == 0 and ent["seed_len"] == 8
+    # unrelated prompt (no shared token at all): a miss
+    assert trie.lookup((1, 15, 14, 2), 4, 4) is None
+    assert (trie.whole_hits, trie.partial_hits, trie.misses) == (1, 1, 1)
+    assert trie.hits == 2 and 0 < trie.hit_rate < 1
+    trie.flush()
+    a.check()
+    assert a.pages_free == 32
+
+
+def test_radix_trie_mid_page_cow_divergence_and_backoff():
+    a, trie = _radix()
+    full = (0, 3, 5, 7, 2, 9, 4, 11, 6, 13)           # P0=10, Pb=16
+    pages = _radix_insert(a, trie, full, 10, 16, tok0=5)
+    # divergence INSIDE page 1 (matches 6 of its 8 tokens): the trie
+    # hands back the split page as a COW source + in-page length j
+    mid = full[:6] + (15, 8, 12, 10)                  # P0=10
+    kind, ent = trie.lookup(mid, 10, 16)
+    assert kind == "partial"
+    assert ent["pages"] == [pages[0]] and ent["j"] == 2
+    assert ent["cow_src"] == pages[1] and ent["seed_len"] == 6
+    # all-real-tokens-matched but no terminal (shorter prompt): back
+    # off one page so the attach has a tail; the dropped page
+    # re-emerges as the COW source with j = page_size - 1
+    kind, ent = trie.lookup(full[:8], 8, 8)
+    assert kind == "partial"
+    assert ent["pages"] == [pages[0]] and ent["j"] == 3
+    assert ent["cow_src"] == pages[1] and ent["seed_len"] == 7
+    trie.flush()
+    a.check()
+    assert a.pages_free == 32
+
+
+def test_radix_trie_leaf_first_lru_eviction_and_reclaim():
+    a, trie = _radix(capacity=2)
+    pre = (0, 3, 5, 7)                                # one shared page
+    tails = [(2, 9), (4, 11), (6, 13)]
+    for i, t in enumerate(tails):
+        _radix_insert(a, trie, pre + t, 6, 8, tok0=i)
+    # capacity 2: the OLDEST terminal went, the shared interior page
+    # survives (it still serves partial matches for the evictee)
+    assert len(trie) == 2
+    kind, _ = trie.lookup(pre + tails[0], 6, 8)
+    assert kind == "partial"                          # downgraded
+    assert trie.lookup(pre + tails[2], 6, 8)[0] == "whole"
+    st = trie.stats()
+    assert st["terminals"] == 2 and st["nodes"] >= 1
+    assert st["pages"] == a.pages_in_use
+    # page pressure: reclaim drops cold leaves until enough are free
+    assert trie.reclaim(a.pages_free + 2)
+    assert trie.stats()["pages"] == a.pages_in_use
+    trie.flush()
+    a.check()
+    assert a.pages_free == 32
+
+
+def test_radix_trie_tenant_scopes_and_generation_bump():
+    a, trie = _radix()
+    toks = (0, 3, 5, 7, 2, 9)
+    _radix_insert(a, trie, toks, 6, 8, tenant=("lora", 0))
+    assert trie.lookup(toks, 6, 8, tenant=("lora", 0))[0] == "whole"
+    # other scopes never see it: base traffic, another adapter
+    assert trie.lookup(toks, 6, 8) is None
+    assert trie.lookup(toks, 6, 8, tenant=("other", 0)) is None
+    # peek with a bumped generation: a miss, and side-effect free
+    assert trie.peek(toks, 6, 8, tenant=("lora", 1)) is None
+    assert trie.lookup(toks, 6, 8, tenant=("lora", 0))[0] == "whole"
+    # lookup with the bumped generation DROPS the stale subtree
+    assert trie.lookup(toks, 6, 8, tenant=("lora", 1)) is None
+    assert trie.stats()["pages"] == 0
+    a.check()
+    assert a.pages_free == 32
+    # memory digest scoping: same tokens, different cross-attn memory
+    m1 = np.ones((2, 4), "f4")
+    m2 = np.zeros((2, 4), "f4")
+    _radix_insert(a, trie, toks, 6, 8, memory=m1)
+    assert trie.lookup(toks, 6, 8, memory=m1)[0] == "whole"
+    assert trie.lookup(toks, 6, 8, memory=m2) is None
+    trie.flush()
+    a.check()
+
+
 # ----------------------------------------------------------------------
 # page math: quantization round-trips
 # ----------------------------------------------------------------------
@@ -453,6 +590,158 @@ def test_weight_update_invalidates_prefix_cache():
 
 
 # ----------------------------------------------------------------------
+# radix partial reuse through the pool (the pattach program family)
+# ----------------------------------------------------------------------
+
+def _paged_radix_engine(stack, **kw):
+    dec, embed, proj, D, V = stack
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 96)
+    return ServingEngine(dec, embed, proj, paged=True, **kw)
+
+
+def test_partial_prefix_attach_prefills_tail_only_bitmatch():
+    """A prompt sharing a page-aligned preamble with a cached one
+    joins through `pattach`: ZERO full-prefill work (the
+    serving.prefill fault point stays silent, prefill_count frozen)
+    and the tokens bit-match a cold engine's."""
+    stack = _small_stack(seed=121)
+    mem = np.random.RandomState(6).randn(4, stack[3]).astype("f4")
+    pre = [0, 3, 7, 11, 2, 9, 4, 13]
+    pA = np.asarray(pre + [5, 8], np.int32)           # P0=10
+    pB = np.asarray(pre + [6, 10, 12], np.int32)      # shares 2 pages
+
+    def mk(p):
+        return Request(p.copy(), mem, max_new_tokens=8, eos_id=1)
+
+    def cold(p):
+        e = _paged_radix_engine(stack, prefix_cache=False)
+        return _drive(e, [mk(p)])[0]
+
+    eng = _paged_radix_engine(stack)
+    a = _drive(eng, [mk(pA)])[0]
+    with faults.inject("serving.prefill", on="nth", n=10 ** 9):
+        b = _drive(eng, [mk(pB)])[0]
+        hits = faults.hit_counts().get("serving.prefill", 0)
+    assert a.ok and b.ok
+    assert hits == 0 and eng.prefill_count == 1   # tail-only pattach
+    m = eng.metrics
+    assert m.prefix_partial_hits == 1 and m.prefix_whole_hits == 0
+    np.testing.assert_array_equal(a.tokens, cold(pA).tokens)
+    np.testing.assert_array_equal(b.tokens, cold(pB).tokens)
+    pat = {k: v for k, v in eng.trace_counts.items()
+           if k[0] == "pattach"}
+    assert len(pat) == 1 and set(pat.values()) == {1}, pat
+    eng.flush_prefix_cache()
+    eng._alloc.check()
+    assert eng._alloc.pages_free == eng.num_pages
+
+
+def test_branching_conversation_soak_partial_reuse_bitmatch():
+    """Branching conversations (one 12-token preamble, forks at page
+    depths 12 and 16 plus a mid-page fork): every request bit-matches
+    the dense oracle; DISTINCT hit lengths that bucket alike share ONE
+    compiled pattach program (no retrace across hit lengths — the
+    trace counter stays at one compile per bucket pair); the allocator
+    is leak-free after a flush."""
+    stack = _small_stack(seed=131)
+    D = stack[3]
+    mem = np.random.RandomState(7).randn(4, D).astype("f4")
+    pre = [0, 3, 7, 11, 2, 9, 4, 13, 5, 8, 15, 6]     # 3 full pages
+    t1 = [10, 2, 14, 3]                               # pages 12..16
+    specs = [
+        pre + t1 + [5, 9],        # cold prefill; inserts 4 full pages
+        pre + [12, 6, 4],         # fork @12: seed 12 -> pattach (4, 4)
+        pre + t1 + [7, 11, 2],    # fork @16: seed 16 -> pattach (4, 4)
+        pre + t1 + [5, 9],        # exact repeat: whole hit
+        pre[:6] + [8, 14, 2, 5],  # mid-page fork @6: COW + pattach
+        pre + [13, 5, 10],        # fork @12 again, other tail
+    ]
+    specs = [np.asarray(p, np.int32) for p in specs]
+
+    def mk_reqs():
+        return [Request(p.copy(), mem, max_new_tokens=6, eos_id=1)
+                for p in specs]
+
+    dense = ServingEngine(*stack[:3], num_slots=4, max_len=64)
+    want = _drive(dense, mk_reqs())
+    eng = _paged_radix_engine(stack, max_len=64)
+    got = _drive(eng, mk_reqs())
+    for w, g in zip(want, got):
+        assert w.ok and g.ok
+        np.testing.assert_array_equal(g.tokens, w.tokens)
+    m = eng.metrics
+    assert m.prefix_partial_hits >= 3 and m.prefix_whole_hits >= 1
+    assert m.cow_copies >= 1                  # the mid-page fork
+    pat = {k: v for k, v in eng.trace_counts.items()
+           if k[0] == "pattach"}
+    assert pat and set(pat.values()) == {1}, pat
+    # strictly more partial joins than compiled pattach programs:
+    # different hit lengths reused the same (matched, tail) buckets
+    assert m.prefix_partial_hits > len(pat)
+    snap = m.snapshot()["prefix"]
+    assert snap["hit_token_ratio"] > 0.3
+    assert snap["trie_nodes"] >= 1 and snap["trie_pages"] >= 1
+    eng.flush_prefix_cache()
+    eng._alloc.check()
+    assert eng._alloc.pages_free == eng.num_pages
+
+
+def test_quantized_pool_keeps_whole_hits_only():
+    """int8 pages store LOSSY K/V: a pattach tail would attend to the
+    stored seed while a cold prefill attends to full precision, so
+    partial reuse is gated off — shared-prefix prompts miss (full
+    prefill), exact repeats still whole-hit."""
+    stack = _small_stack(seed=141)
+    mem = np.random.RandomState(8).randn(4, stack[3]).astype("f4")
+    pre = [0, 3, 7, 11, 2, 9, 4, 13]
+    pA = np.asarray(pre + [5, 8], np.int32)
+    pB = np.asarray(pre + [6, 10], np.int32)
+    eng = _paged_radix_engine(stack, kv_dtype="int8")
+
+    def mk(p):
+        return Request(p.copy(), mem, max_new_tokens=4, eos_id=1)
+
+    assert all(r.ok for r in _drive(eng, [mk(pA)]))
+    assert all(r.ok for r in _drive(eng, [mk(pB)]))   # no partial
+    assert all(r.ok for r in _drive(eng, [mk(pA)]))   # whole hit
+    m = eng.metrics
+    assert m.prefix_partial_hits == 0
+    assert m.prefix_whole_hits == 1 and eng.prefill_count == 2
+    assert not any(k[0] == "pattach" for k in eng.trace_counts)
+
+
+def test_adapter_generation_bump_drops_tenant_subtree():
+    """Adapter traffic caches under a per-(name, generation) subtree;
+    re-registering the adapter bumps the generation and EAGERLY drops
+    the stale pages (AdapterPool.on_invalidate), so the next join
+    re-prefills against the new weights."""
+    from paddle_tpu.serving import AdapterPool
+
+    stack = _small_stack(seed=151)
+    dec, embed, proj, D, V = stack
+    pool = AdapterPool(dec, capacity=2, rank=4)
+    pool.register_random("t1", seed=1)
+    eng = _paged_radix_engine(stack, adapters=pool)
+    mem = np.random.RandomState(9).randn(4, D).astype("f4")
+    p = np.asarray([0, 3, 7, 11, 2, 9], np.int32)
+
+    def mk():
+        return Request(p.copy(), mem, max_new_tokens=4, eos_id=1,
+                       adapter="t1")
+
+    assert _drive(eng, [mk()])[0].ok
+    assert eng._prefix.stats()["pages"] >= 1
+    pool.register_random("t1", seed=2)        # generation bump
+    assert eng._prefix.stats()["pages"] == 0  # eager drop
+    assert _drive(eng, [mk()])[0].ok
+    assert eng.prefill_count == 2             # re-prefilled, no stale
+    eng._alloc.check()
+
+
+# ----------------------------------------------------------------------
 # chaos: fault injection + leak-freedom
 # ----------------------------------------------------------------------
 
@@ -527,3 +816,36 @@ def test_chaos_slot_join_faults_leak_free():
         want = oracle[tuple(r.prompt.tolist())]
         np.testing.assert_array_equal(res1.tokens,
                                       want[:len(res1.tokens)])
+
+
+@pytest.mark.chaos
+def test_chaos_pattach_fault_retries_and_leak_free():
+    """serving.pattach raises mid-join: the failed partial attach
+    releases every page it took (matched refs AND fresh/COW allocs),
+    the request RETRIES to a clean completion that bit-matches a cold
+    engine, and the free list ends pristine."""
+    stack = _small_stack(seed=161)
+    mem = np.random.RandomState(10).randn(4, stack[3]).astype("f4")
+    pre = [0, 3, 7, 11, 2, 9, 4, 13]
+    pA = np.asarray(pre + [5, 8], np.int32)
+    pB = np.asarray(pre + [6, 10, 12], np.int32)
+
+    def mk(p):
+        return Request(p.copy(), mem, max_new_tokens=6, eos_id=1)
+
+    want = _drive(_paged_radix_engine(stack, prefix_cache=False),
+                  [mk(pB)])[0]
+    eng = _paged_radix_engine(stack, max_attempts=2, backoff_base_s=0.0)
+    assert _drive(eng, [mk(pA)])[0].ok
+    inj = faults.inject("serving.pattach", on="nth", n=1)
+    try:
+        got = _drive(eng, [mk(pB)])[0]
+    finally:
+        faults.reset()
+    assert inj.fired == 1                     # the fault really hit
+    assert got.ok                             # retried to completion
+    np.testing.assert_array_equal(got.tokens, want.tokens)
+    assert eng.metrics.prefix_partial_hits == 2   # failed + retried
+    eng.flush_prefix_cache()
+    eng._alloc.check()
+    assert eng._alloc.pages_free == eng.num_pages

@@ -464,7 +464,10 @@ def _full_metrics():
     m.record_finish("eos", 1)
     m.record_error("stream_cb", RuntimeError("x"))
     m.record_retry("slot_join")
-    m.record_prefix(True)
+    m.record_prefix("whole", matched_tokens=8, prompt_tokens=8)
+    m.record_prefix("partial", matched_tokens=5, prompt_tokens=9)
+    m.record_prefix("miss", prompt_tokens=7)
+    m.record_cow_copy()
     m.record_page_wait()
     m.record_oom_eviction()
     m.record_step_gap(0.001)
@@ -481,7 +484,8 @@ def _full_metrics():
     m.record_iteration(1, 0.5, pages_in_use=3, pages_free=5,
                        bytes_per_active_token=128.0,
                        shard_occupancy=[0.5, 0.25],
-                       tenant_slots={"base": 1, "t1": 1})
+                       tenant_slots={"base": 1, "t1": 1},
+                       trie_nodes=4, trie_pages=6)
     m.set_memory_provider(
         lambda: {"weights_bytes": 1000, "pool_bytes": 500,
                  "adapter_bytes": 128, "in_use_bytes": 1200,

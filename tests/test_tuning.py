@@ -422,15 +422,15 @@ def test_paged_engine_warm_start_with_prefix_attach(tmp_path):
     eng = _tiny_engine(paged=True, page_size=8)
     rep = eng.precompile((4, 32), dtype="float32", prompt_buckets=(4,),
                          cache=cache)
-    # pjoin + attach + cow + pstep
-    assert rep["programs"] == 4 and rep["compiled"] == 4
+    # pjoin + attach + cow + pattach + pstep
+    assert rep["programs"] == 5 and rep["compiled"] == 5
     toks_cold = [_serve_one(eng) for _ in range(2)]  # repeat: attach
     eng2 = _tiny_engine(paged=True, page_size=8)
     with T.retrace_sentinel(eng2):
         rep2 = eng2.precompile((4, 32), dtype="float32",
                                prompt_buckets=(4,), cache=cache)
         toks_warm = [_serve_one(eng2) for _ in range(2)]
-    assert rep2["warm"] == 1 and rep2["loaded_from_cache"] == 4
+    assert rep2["warm"] == 1 and rep2["loaded_from_cache"] == 5
     assert sum(eng2.trace_counts.values()) == 0
     assert toks_warm == toks_cold
     assert eng2.metrics.prefix_hits >= 1   # attach program exercised
@@ -483,9 +483,9 @@ def test_chaos_cache_load_raise_is_not_swallowed(tmp_path):
 
 @pytest.mark.slow
 def test_sharded_engine_warm_start(tmp_path):
-    """Sharded (disaggregated-prefill) warm start: all seven programs
-    — join/step + prefill/splice per bucket — load from cache with
-    zero compiles on restart."""
+    """Sharded (disaggregated-prefill) warm start: every program —
+    join/step + prefill/splice/bsplice per bucket — loads from cache
+    with zero compiles on restart."""
     from paddle_tpu.parallel.mesh import init_mesh
     from paddle_tpu import nn
     from paddle_tpu.nn.layer.transformer import (
@@ -508,14 +508,14 @@ def test_sharded_engine_warm_start(tmp_path):
     eng = mk()
     rep = eng.precompile((4, 32), dtype="float32", prompt_buckets=(4,),
                          cache=cache)
-    assert rep["programs"] == 4   # join, step, prefill, splice
+    assert rep["programs"] == 5   # join, step, prefill, splice, bsplice
     toks_cold = _serve_one(eng)
     eng2 = mk()
     with T.retrace_sentinel(eng2):
         rep2 = eng2.precompile((4, 32), dtype="float32",
                                prompt_buckets=(4,), cache=cache)
         toks_warm = _serve_one(eng2)
-    assert rep2["warm"] == 1 and rep2["loaded_from_cache"] == 4
+    assert rep2["warm"] == 1 and rep2["loaded_from_cache"] == 5
     assert sum(eng2.trace_counts.values()) == 0
     assert toks_warm == toks_cold
 

@@ -34,6 +34,7 @@ CHECKS = [
     (r"Speculative decoding \(self-draft n-gram, k=8, serving pool", r"~?([\d.]+)()x tokens/s", ("decode_throughput", "speculative", "b1", "speedup"), "speculative x-tokens/s"),
     (r"Paged speculative decoding", r"~?([\d.]+)()x tokens/s", ("serving_paged_spec", "value"), "paged-spec x-tokens/s"),
     (r"Multi-tenant serving", r"~?([\d.]+)()x aggregate tokens/s", ("serving_multitenant", "value"), "multitenant x-tokens/s"),
+    (r"Radix prefix cache", r"~?([\d.]+)()x lower TTFT", ("serving_radix", "value"), "serving_radix x-ttft-at-depth"),
     (r"Sharded serving", r"~?([\d.]+)()x lower decode-step p50", ("serving_sharded", "value"), "serving_sharded x-step-p50"),
     (r"Zero-warmup restart", r"~?([\d.]+)()x faster time-to-ready", ("cold_start", "value"), "cold_start x-ready"),
 ]

@@ -30,11 +30,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def _demo_snapshot():
-    """Serve a few requests through a tiny pool (speculation enabled)
-    under a tracer session AND an armed cost-accounting session, so
-    the dump previews every snapshot section — memory ledger,
-    MFU/goodput gauges, speculation counters, cold-start report
-    included — and return (snapshot, tracer)."""
+    """Serve a few requests through a tiny PAGED pool (speculation
+    enabled) under a tracer session AND an armed cost-accounting
+    session, so the dump previews every snapshot section — memory
+    ledger, MFU/goodput gauges, speculation counters, radix
+    prefix-cache stats, cold-start report included — and return
+    (snapshot, tracer). The workload shares an 8-token preamble so
+    the prefix section shows a whole hit, a partial (pattach) hit,
+    and misses."""
     import tempfile
 
     import numpy as np
@@ -55,24 +58,31 @@ def _demo_snapshot():
     pool.register_random("t1", seed=1)
     pool.register_random("t2", seed=2)
     eng = ServingEngine(dec, nn.Embedding(17, 32), nn.Linear(32, 17),
-                        num_slots=4, max_len=32, spec_k=4,
+                        num_slots=4, max_len=32, spec_k=4, paged=True,
+                        page_size=4, num_pages=64,
                         adapters=pool, hbm_budget_bytes=1 << 20)
     sched = Scheduler(max_queue=16)
     rs = np.random.RandomState(1)
+    memory = rs.randn(4, 32).astype("f4")
+    pre = [0, 5, 9, 2, 11, 7, 3, 14]       # shared 8-token preamble
+    prompts = [
+        (pre + [6, 8], None),              # cold prefill (miss)
+        (pre + [6, 8], None),              # identical: whole hit
+        (pre + [12, 4, 10], None),         # shared 2 pages: partial hit
+        (pre + [6, 8], "t1"),              # adapter subtree: miss
+        ([0, 4, 13], "t2"),                # unrelated: miss
+        (pre + [6, 8], "t1"),              # adapter repeat: whole hit
+    ]
     with costs.accounting_scope(), session_scope() as tr:
         # startup precompile into a throwaway AOT cache dir: the
         # cold_start section renders (and the serve below runs on the
         # precompiled programs — zero jit stalls, like production)
         eng.precompile((4, 32), dtype="float32",
-                       prompt_buckets=(1, 2, 4, 8),
+                       prompt_buckets=(4, 16),
                        cache=tempfile.mkdtemp(prefix="pt_aot_demo_"))
         reqs = []
-        for i, name in enumerate((None, "t1", "t2", "t1", None,
-                                  "t2")):
-            P = int(rs.randint(1, 6))
-            prompt = rs.randint(2, 17, (P,)).astype(np.int32)
-            prompt[0] = 0
-            r = Request(prompt, rs.randn(4, 32).astype("f4"),
+        for toks, name in prompts:
+            r = Request(np.asarray(toks, np.int32), memory,
                         max_new_tokens=int(rs.randint(2, 8)),
                         eos_id=1, adapter=name)
             sched.submit(r)

@@ -223,6 +223,7 @@ def _configs():
     cfgs += _configs_paged_verify()
     cfgs += _configs_sharded_decode()
     cfgs += _configs_lora_int8()
+    cfgs += _configs_prefix_attach()
     return cfgs
 
 
@@ -1359,6 +1360,54 @@ def _configs_lora_int8():
              for r in (8, 32) for b in (1, 8)]
     rows.append(("int8_matmul_vs_f32", int8_vs_f32(8, 768, 3072)))
     return rows
+
+
+def _configs_prefix_attach():
+    """Radix prefix-attach rows (PR 16): the pattach program's kernel
+    asymmetry, measured PAIRED. Tail side = verify-mode attention of
+    only the DIVERGENT TAIL (t pages of queries) reading the m trie-
+    matched pages plus itself back through the page table — the attach
+    program's attention call, whose cost scales with the tail. Full
+    side = the same `paged_verify_attention` with queries for the
+    WHOLE prompt at identical total depth — what a whole-prompt
+    prefill pays when the radix cache misses. Both sides write K/V
+    page-granularly in the engine, so the attention pair isolates the
+    reuse win; step_us is the tail side, full_step_us/attach_speedup
+    ride along. m in {4, 16} matched pages, t in {1, 4} tail pages at
+    page_size 16 — the speedup should grow with m/t, and the perf
+    gate's attach pair pins the m16_t1 ratio."""
+
+    def direct(m, t, heads=8, d=64, psz=16):
+        def bench():
+            import jax
+            import jax.numpy as jnp
+
+            from paddle_tpu.ops.attention import paged_verify_attention
+
+            rs = np.random.RandomState(0)
+            W = m + t                       # clipped table width
+            N, T = W * psz, t * psz         # full vs tail tokens
+            pages = jnp.asarray(
+                rs.randn(W + 1, heads, psz, d).astype("f4"))
+            table = jnp.asarray(
+                rs.permutation(W).astype("i4").reshape(1, W))
+            q_tail = jnp.asarray(rs.randn(1, heads, T, d).astype("f4"))
+            q_full = jnp.asarray(rs.randn(1, heads, N, d).astype("f4"))
+            length = jnp.asarray([N], jnp.int32)
+
+            fn = jax.jit(lambda q: paged_verify_attention(
+                q, pages, pages, None, None, table, length))
+            dt_t, dt_f = measure_pair(lambda: fn(q_tail),
+                                      lambda: fn(q_full))
+            return {"step_us": round(dt_t * 1e6, 2),
+                    "full_step_us": round(dt_f * 1e6, 2),
+                    "attach_speedup": round(dt_f / max(dt_t, 1e-12), 3)}
+
+        bench._direct = True
+        return bench
+
+    return [(f"prefix_attach_m{m}_t{t}", direct(m, t))
+            for m in (4, 16) for t in (1, 4)]
 
 
 def measure(run, args=(), *, steps=30, lo=5, k=5, detail=False):

@@ -78,6 +78,15 @@ SPEC_OPS = ("spec_decode_plain_b1_L2048",
 #: int8-vs-f32 weight matmul row (paired in-row via measure_pair)
 LORA_OPS = ("lora_base_b8", "lora_decode_r8_b8", "int8_matmul_vs_f32")
 
+#: radix prefix-attach pair folded into the full-run default (PR 16):
+#: the shallow and deep matched-depth attach rows (tail-only verify
+#: attention through the clipped page table, measured paired in-row
+#: against the same-depth whole-prompt prefill — the int8_matmul
+#: precedent). step_us is the tail side, so a regression in the
+#: pattach hot path — the thing every partial radix hit rides — fails
+#: the gate even while the whole-prompt path stays fast
+RADIX_OPS = ("prefix_attach_m4_t1", "prefix_attach_m16_t1")
+
 #: tuned-vs-fallback rows folded into the full-run default (PR 11):
 #: the autotuned flash_decode config must NEVER be slower than the
 #: hand-picked constants it replaced. Both sides are measured fresh,
@@ -183,6 +192,7 @@ def measure_bench(metric, k=1, quiet=True):
         ("cold_start", bench._cold_start),
         ("serving_throughput", bench._serving_throughput),
         ("serving_paged", bench._serving_paged),
+        ("serving_radix", bench._serving_radix),
         ("serving_sharded", bench._serving_sharded),
     ]).get(metric)
     if fn is None:
@@ -347,7 +357,8 @@ def main(argv=None):
             args.tol_op = 4.0
     else:
         op_names = ([c[0] for c in _quick8()] + list(SPEC_OPS)
-                    + list(LORA_OPS)) if args.ops is None else []
+                    + list(LORA_OPS)
+                    + list(RADIX_OPS)) if args.ops is None else []
         bench_names = list(DEFAULT_BENCH) if args.bench is None else []
         tuning_rows = list(TUNING_ROWS)
     if args.ops is not None:
