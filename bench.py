@@ -1849,10 +1849,18 @@ def _serving_radix(n_requests=28, d_model=128, nhead=2, ffn=256,
     request probes per fork depth (max_new_tokens=1, so TTFT is join
     cost with no queue wait, alternating sides per rep) — asserted:
     the deepest shared-preamble depth shows a strict median TTFT win.
-    The batch-phase p50s ride along unasserted: on this dispatch-bound
-    1-core CPU the per-join fixed costs (undonated pool round-trip,
-    COW dispatch) mask most of the 16x prefill-position saving — the
-    headline is the at-depth win, the fleet-scale p50 win needs a
+    Phase 3 (submit host time): the donated joins return a TRACED
+    first token and the engine defers the int() sync past the
+    admission loop — a paired probe times the 4-join admission
+    iteration with sync_tok0 on vs off and asserts deferral never
+    slows the submit path. Since PR 17 every join DONATES the pool
+    carry (the splice is in place, no whole-pool copy per join) and
+    the default mid_page="round_down" policy serves mid-page forks
+    from the page boundary instead of COWing the divergent page —
+    the two per-join fixed costs that used to mask the 16x
+    prefill-position saving on this dispatch-bound 1-core CPU. The
+    batch-phase p50s still ride along unasserted: what remains is
+    dispatch count, and the fleet-scale p50 win needs a
     bandwidth-bound chip (same caveat as the serving_paged row)."""
     from paddle_tpu import nn
     from paddle_tpu.nn.layer.transformer import (TransformerDecoder,
@@ -1871,8 +1879,10 @@ def _serving_radix(n_requests=28, d_model=128, nhead=2, ffn=256,
     base[0] = 0
     sys_mem = rs.randn(mem_len, d_model).astype("f4")
     # forks at page boundaries (32/64/96 = 2/4/6 pages of seed) plus a
-    # mid-page fork (40 -> COW of the divergent page); tails of 3-4
-    # tokens keep every partial hit on ONE pattach tail bucket
+    # mid-page fork (40 — under the default round_down policy it seeds
+    # from the 32-token boundary with no COW; mid_page="cow" would COW
+    # the divergent page); tails of 3-4 tokens keep every partial hit
+    # on ONE pattach tail bucket
     forks = [32, 64, 96, 40]
     work = []
     for i in range(n_requests):
@@ -1959,6 +1969,9 @@ def _serving_radix(n_requests=28, d_model=128, nhead=2, ffn=256,
     assert m.prefix_partial_hits >= 3, m.prefix_partial_hits
     snap = m.snapshot()["prefix"]
     assert snap["hit_token_ratio"] >= 0.5, snap
+    # the default round_down policy serves mid-page forks from the
+    # page boundary: no COW dispatches at all in the batch phase
+    assert snap["cow_copies"] == 0, snap
 
     # ---- phase 2: paired sequential TTFT probes per fork depth.
     # max_new_tokens=1 makes TTFT the join cost itself (no queue
@@ -1994,6 +2007,42 @@ def _serving_radix(n_requests=28, d_model=128, nhead=2, ffn=256,
     aligned = [f for f in forks if f % page_size == 0]
     best = max(aligned, key=lambda f: depth_win[f]["win"])
     assert depth_win[best]["win"] > 1.0, depth_win
+    # round_down killed the mid-page regression row: the 40-token fork
+    # seeds from the 32-token boundary with no COW dispatch, so it
+    # must at least hold par with the whole-prompt side (the PR-16
+    # committed row LOST ~0.7x here under mid_page="cow")
+    for f in forks:
+        if f % page_size:
+            assert depth_win[f]["win"] > 0.9, depth_win
+
+    # ---- phase 3: submit-path host time, deferred vs eager tok0.
+    # sync_tok0=True restores the old behavior — block on int(tok0)
+    # inside the admission loop, serializing back-to-back joins; the
+    # default defers the sync past the loop so the 4 join dispatches
+    # pipeline. Paired + alternated like the TTFT probes; deferral
+    # must never slow the submit path (the ISSUE-17 satellite check).
+    hrs = np.random.RandomState(2)
+    host = {True: [], False: []}
+    with retrace_sentinel(radix):
+        for rep in range(probe_reps * 2):
+            order = (True, False) if rep % 2 else (False, True)
+            for flag in order:
+                radix.sync_tok0 = flag
+                sched = Scheduler(max_queue=8)
+                for _ in range(4):
+                    t = hrs.randint(2, vocab, (4,))
+                    sched.submit(Request(
+                        np.concatenate([base[:64], t]).astype("i4"),
+                        sys_mem, max_new_tokens=1, eos_id=1))
+                t0 = time.perf_counter()
+                radix.run_iteration(sched)   # the 4-join admission
+                host[flag].append(time.perf_counter() - t0)
+                radix.serve_until_idle(sched, max_iterations=200)
+    radix.sync_tok0 = False
+    sync_ms = float(np.median(host[True])) * 1e3
+    defer_ms = float(np.median(host[False])) * 1e3
+    assert defer_ms <= sync_ms * 1.15, (defer_ms, sync_ms)
+
     # leak-free after the drain on both pools
     for eng in (whole, radix):
         eng.flush_prefix_cache()
@@ -2012,6 +2061,10 @@ def _serving_radix(n_requests=28, d_model=128, nhead=2, ffn=256,
             "leak_free_asserted": True,
             "retrace_sentinel": "armed over batch drive + probes",
             "ttft_by_depth": {str(k): v for k, v in depth_win.items()},
+            "submit_host": {
+                "sync_tok0_ms": round(sync_ms, 2),
+                "deferred_ms": round(defer_ms, 2),
+                "win": round(sync_ms / max(defer_ms, 1e-9), 3)},
             **({} if trace_art[0] is None
                else {"trace_artifact": trace_art[0]}),
             "radix": {"ttft_p50_ms": pct(r_ttft, 50),
@@ -2022,6 +2075,8 @@ def _serving_radix(n_requests=28, d_model=128, nhead=2, ffn=256,
                       "partial_hits": snap["partial_hits"],
                       "misses": snap["misses"],
                       "cow_copies": snap["cow_copies"],
+                      "rounded_down":
+                          radix._prefix.stats()["rounded_down"],
                       "full_prefills": radix.prefill_count,
                       "wall_s": round(r_wall, 2)},
             "whole_prompt": {"ttft_p50_ms": pct(w_ttft, 50),

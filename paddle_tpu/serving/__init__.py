@@ -46,7 +46,7 @@ via the `serving.*` fault points in `paddle_tpu.testing.faults`.
 """
 from .adapters import AdapterPool, OutOfAdapters, quantize_net
 from .engine import (ArtifactServingEngine, PagedServingEngine,
-                     ServingEngine, WatchdogTimeout)
+                     PoolCarryLost, ServingEngine, WatchdogTimeout)
 from .metrics import (CallbackList, ServingCallback, ServingMetrics,
                       to_prometheus)
 from .paging import (OutOfPages, PageAllocator, PagedKVCache,
@@ -62,7 +62,8 @@ __all__ = [
     "ShardedServingEngine", "ShardedPagedServingEngine",
     "ServingServer", "Scheduler", "Request", "RequestResult",
     "QueueFull", "ServingMetrics", "ServingCallback", "CallbackList",
-    "WatchdogTimeout", "ServerCrashed", "OutOfPages", "PageAllocator",
+    "WatchdogTimeout", "PoolCarryLost", "ServerCrashed", "OutOfPages",
+    "PageAllocator",
     "PagedKVCache", "PrefixCache", "RadixPrefixCache", "RetraceError",
     "RetraceSentinel",
     "retrace_sentinel", "session_scope", "to_prometheus",

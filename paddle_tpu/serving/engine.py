@@ -113,6 +113,14 @@ class WatchdogTimeout(TimeoutError):
     cleanly) so one slow/hung compile can't wedge the pool silently."""
 
 
+class PoolCarryLost(RuntimeError):
+    """The donated pool-state carry was consumed by a dispatch that
+    died without assigning a replacement: no valid buffer survives to
+    retry on. Raised instead of dispatching dead buffers; the caller
+    escalates to the all-or-nothing recovery (_fail_active ->
+    _reset_pool) so the pool rebuilds and keeps serving."""
+
+
 class _CachedProgram:
     """A program deserialized from the persistent AOT cache, with a
     rebuild escape hatch: a stale-but-CRC-valid entry whose argument
@@ -204,6 +212,13 @@ class _EngineBase:
             self.metrics.watermark_frac = float(hbm_watermark)
         self._weights_bytes = None   # cached by memory_ledger()
         self._step_cost_cache = None  # (book, key, ProgramCost)
+        # token-0 delivery policy: joins return the TRACED first-token
+        # scalar and run_iteration resolves the whole admission
+        # round's tokens after the last join dispatched — k joins pay
+        # ~1 host sync instead of k blocking int(tok0) calls on the
+        # submit path. sync_tok0=True restores the per-join block (the
+        # bench's before/after host-time check flips it).
+        self.sync_tok0 = False
 
     # ---- subclass surface ----
     def admit_check(self, request):
@@ -260,18 +275,34 @@ class _EngineBase:
     #: into the compiled program (position of the state arg in the
     #: body signature). Donation lets XLA alias the KV pool in place
     #: instead of copying it every dispatch — on the decode hot path
-    #: that copy is the whole cache. Join-family programs (join/pjoin/
-    #: attach/cow/splice) are NOT donated on purpose: a failed join is
-    #: retried with the SAME carry (per-request isolation), and a
-    #: consumed buffer would widen that failure into a pool-wide
-    #: reset; the static analyzer's donation audit (PTA102) checks
-    #: this declaration and the kept-undonated set is justified in
-    #: ANALYSIS_BASELINE.json. Note the retry contract for donated
-    #: steps: an attempt that executed before failing (or that blew
-    #: the watchdog) consumed the carry, so its retry fails loudly and
-    #: lands in the existing all-or-nothing recovery (_fail_active ->
-    #: _reset_pool) rather than re-running on stale state.
-    _DONATED_KINDS = {"step": 2, "sstep": 2, "pstep": 2, "pverify": 2}
+    #: that copy is the whole cache, and on the JOIN family it is the
+    #: whole-pool memcpy that masked the prefix cache's TTFT win (a
+    #: mid-page radix hit paid it twice: cow + pattach). The whole
+    #: program matrix donates now; per-request isolation survives via
+    #: a generation-checked alias instead of a copy:
+    #:
+    #:  - every engine-injected fault point (_PT_SLOT_JOIN/_PT_PREFILL/
+    #:    _PT_PATTACH/_PT_SPLICE) fires host-side BEFORE dispatch, so a
+    #:    failed attempt's carry is the untouched pre-join buffer and
+    #:    the guarded retry re-runs on it bit-identically;
+    #:  - an attempt that EXECUTED before failing (watchdog overrun)
+    #:    already reassigned self._state inside the op closure — join
+    #:    programs write only their target slot, so the retry re-runs
+    #:    slot-idempotently on the surviving carry and co-resident
+    #:    slots stay bit-identical;
+    #:  - the one remaining hazard — a carry consumed by donation with
+    #:    no replacement assigned (a dispatch that died mid-execution)
+    #:    — is detected by _carry_alive() before every attempt and in
+    #:    the join/splice failure handlers, and escalates to the
+    #:    existing all-or-nothing recovery (_fail_active -> _reset_pool)
+    #:    instead of re-dispatching dead buffers.
+    #:
+    #: The static analyzer's donation audit (PTA102) reads this same
+    #: declaration (one source of truth for the jit builders AND the
+    #: audit); ANALYSIS_BASELINE.json carries no join-family waivers.
+    _DONATED_KINDS = {"step": 2, "sstep": 2, "pstep": 2, "pverify": 2,
+                      "join": 2, "pjoin": 2, "attach": 2, "cow": 0,
+                      "pattach": 4, "splice": 0, "bsplice": 0}
 
     def _program(self, key, build):
         """Get-or-build a compiled program from the observed jit
@@ -440,8 +471,30 @@ class _EngineBase:
             return out
         raise last
 
+    def _carry_alive(self):
+        """True when every leaf of the device pool carry is still
+        live. Donated join/step programs consume their input carry;
+        normally the op closure reassigns self._state before anything
+        can observe the dead buffer, but a dispatch that dies
+        mid-execution leaves the consumed carry with no replacement —
+        this sweep (a few hundred host-side is_deleted checks, no
+        device work) is how the retry path refuses to re-dispatch
+        dead buffers."""
+        state = getattr(self, "_state", None)
+        if state is None:
+            return True
+        import jax
+
+        return not any(getattr(x, "is_deleted", lambda: False)()
+                       for x in jax.tree_util.tree_leaves(state))
+
     def _join_attempt(self, s, r):
         _PT_SLOT_JOIN()
+        if not self._carry_alive():
+            raise PoolCarryLost(
+                "pool carry consumed by a failed dispatch with no "
+                "replacement state — refusing to retry the join on "
+                "dead buffers")
         return self._join(s, r)
 
     def _decode_attempt(self, active):
@@ -538,6 +591,7 @@ class _EngineBase:
             self._cbs.emit("on_finish", req)
 
         joins = 0
+        tok0s = []   # (request, traced token-0) resolved after the loop
         while joins < self.max_joins_per_iter:
             free = [i for i, r in enumerate(self.slots) if r is None]
             if not free:
@@ -583,6 +637,13 @@ class _EngineBase:
                     self.metrics.record_finish("error", len(r.tokens))
                     self._cbs.emit("on_finish", r)
                 progress = True
+                if not self._carry_alive():
+                    # the failed attempt consumed the donated carry
+                    # without replacing it: no valid pool state
+                    # survives for the co-resident slots — rebuild
+                    # (all-or-nothing recovery, same as a dead step)
+                    self._fail_active(e)
+                    break
                 continue
             joins += 1
             progress = True
@@ -591,7 +652,17 @@ class _EngineBase:
             self.metrics.record_join()
             self._cbs.emit("on_join", r, s)
             if tok is not None:   # prefill already produced token 0
-                self._deliver(r, int(tok), self.clock())
+                if self.sync_tok0:
+                    self._deliver(r, int(tok), self.clock())
+                else:
+                    tok0s.append((r, tok))
+        # resolve the admission round's first tokens AFTER the last
+        # join dispatched: the traced scalars sync here (one natural
+        # host sync instead of a blocking int() per join). A request
+        # finishing at token 0 frees its slot an iteration late — the
+        # decode step's active mask already excludes DONE slots.
+        for r, tok in tok0s:
+            self._deliver(r, int(tok), self.clock())
         # 3. one batched decode step over the active mask (slots with a
         # disaggregated prefill still in flight stay masked out)
         active = np.asarray(
@@ -1094,6 +1165,14 @@ class ServingEngine(_EngineBase):
 
         _PT_PREFILL()
         self._ensure_state(r.memory)
+        # idempotent under the retry loop: an attempt that executed
+        # but blew the watchdog already pinned its adapter row —
+        # release it before this attempt acquires, or the row's
+        # refcount leaks one per retry
+        prev = int(self._adapter_rows[s])
+        if prev:
+            self._adapter_rows[s] = 0
+            self._release_adapter_row(prev)
         row = self._acquire_adapter(r)
         pad_id = int(r.eos_id) if r.eos_id is not None else 0
         prompt_b, P0, Pb = pad_prompt_row(r.prompt, pad_id)
@@ -1111,7 +1190,7 @@ class ServingEngine(_EngineBase):
             self._release_adapter_row(row)
             raise
         self._adapter_rows[s] = row
-        return int(tok0)
+        return tok0   # traced scalar: run_iteration resolves post-loop
 
     def _build_join(self, Pb):
         """Every program build is `placement.build(layout body)`: one
@@ -1346,6 +1425,7 @@ class PagedServingEngine(ServingEngine):
     def __init__(self, decoder, embed, project, *, num_slots=8,
                  max_len=128, page_size=16, num_pages=None,
                  kv_dtype=None, prefix_cache=True, prefix_capacity=64,
+                 radix_mid_page="round_down",
                  reserve_decode_frac=1.0, paged=True, **kw):
         page_size = int(page_size)
         max_len = pages_for(max_len, page_size) * page_size
@@ -1365,7 +1445,8 @@ class PagedServingEngine(ServingEngine):
         self.reserve_decode_frac = float(reserve_decode_frac)
         self._alloc = PageAllocator(self.num_pages, page_size)
         self._prefix = (RadixPrefixCache(self._alloc, prefix_capacity,
-                                         page_size=page_size)
+                                         page_size=page_size,
+                                         mid_page=radix_mid_page)
                         if prefix_cache else None)
         self._partial_ok = None   # resolved lazily (needs jnp)
         if self._prefix is not None and self._apool is not None:
@@ -1643,8 +1724,14 @@ class PagedServingEngine(ServingEngine):
         if self._prefix is not None:
             self._check_params()
         # idempotent under the retry loop: a half-joined earlier
-        # attempt's pages are released before this one allocates
+        # attempt's pages are released before this one allocates, and
+        # its pinned adapter row is released before this one acquires
+        # (or the row's refcount leaks one per watchdog retry)
         self._release_slot(s)
+        prev = int(self._adapter_rows[s])
+        if prev:
+            self._adapter_rows[s] = 0
+            self._release_adapter_row(prev)
         row = self._acquire_adapter(r)
         try:
             tok0 = self._join_inner(s, r, row)
@@ -1712,7 +1799,9 @@ class PagedServingEngine(ServingEngine):
         self._table[s, :n_pp] = pages
         self._index[s] = Pb
         self.prefill_count += 1
-        tok0 = int(tok0)
+        # tok0 stays the traced scalar: the trie stores it raw and
+        # resolves lazily at the first whole hit; the caller's
+        # delivery resolves after the admission round's last dispatch
         if self._prefix is not None:
             self._prefix.insert(prompt_b[0, :P0], P0, Pb, r.memory,
                                 self._tenant_key(r), pages, tok0)
@@ -1789,7 +1878,6 @@ class PagedServingEngine(ServingEngine):
             raise
         self._table[s, :n_pp] = full_pages
         self._index[s] = Pb
-        tok0 = int(tok0)
         # insert BEFORE the tail COW so the trie adopts the slot's
         # pages while they are still the canonical prompt pages — the
         # COW then sees the shared refcount and gives the slot its

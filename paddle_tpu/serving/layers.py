@@ -61,6 +61,27 @@ class CacheLayout:
         # the whole identity.
         return type(self).__name__
 
+    @staticmethod
+    def distinct_leaves(state):
+        """Donated carries must not alias each other: the cache
+        constructors share one zero buffer between K and V halves
+        (cheap when the state is only read), but XLA rejects donating
+        the same buffer twice in one call — give every repeated leaf
+        its own buffer before the state becomes a donated carry."""
+        import jax
+
+        seen = set()
+
+        def fix(x):
+            if not hasattr(x, "copy"):
+                return x
+            if id(x) in seen:
+                return x.copy()
+            seen.add(id(x))
+            return x
+
+        return jax.tree_util.tree_map(fix, state)
+
     # ---- program-family keys ----
     def join_key(self, Pb):
         raise NotImplementedError
@@ -177,7 +198,7 @@ class DenseLayout(CacheLayout):
             state["hist"] = jnp.zeros((S, L), jnp.int32)
             state["plen"] = jnp.zeros((S,), jnp.int32)
             state["pbk"] = jnp.zeros((S,), jnp.int32)
-        return state
+        return self.distinct_leaves(state)
 
     def pool_key(self, memory):
         eng = self.eng
@@ -190,6 +211,14 @@ class DenseLayout(CacheLayout):
              if eng.spec_k else ()) + eng._adapter_pool_key()
 
     # ---- the join program (prefill + splice) ----
+    # Every join-family body takes the pool `state` as a DONATED carry
+    # (engine._DONATED_KINDS): the returned state's leaves are
+    # slot-local dynamic-update-slices over the input leaves, which
+    # XLA turns into in-place writes on the donated buffers — a join
+    # costs its own slot's rows, not a whole-pool copy. Bodies must
+    # therefore keep every non-updated leaf IDENTITY-passed (no
+    # gratuitous reshapes/casts of untouched pool leaves), or the
+    # aliasing degrades back to a copy.
     def join_body(self, Pb):
         import jax
         import jax.numpy as jnp
@@ -425,7 +454,7 @@ class PagedLayout(CacheLayout):
             state["hist"] = jnp.zeros((S, L), jnp.int32)
             state["plen"] = jnp.zeros((S,), jnp.int32)
             state["pbk"] = jnp.zeros((S,), jnp.int32)
-        return state
+        return self.distinct_leaves(state)
 
     def pool_key(self, memory):
         import jax.numpy as jnp
@@ -756,7 +785,11 @@ class PagedLayout(CacheLayout):
 class SinglePlacement:
     """Plain `jax.jit` with the engine's shared donation declaration —
     the single-chip build path every engine used before placement was
-    an axis."""
+    an axis. The declaration now spans the WHOLE program matrix (the
+    step family AND the join family), so every body's pool carry is a
+    slot-local in-place update, never a whole-pool copy; the engine's
+    guarded-retry path owns the failure semantics the donation
+    sharpens (see engine._DONATED_KINDS)."""
 
     def __init__(self, eng):
         self.eng = eng
@@ -870,7 +903,7 @@ class ShardedPlacement:
                 paged.append({"k": cc.k, "v": cc.v, "ks": cc.k_scale,
                               "vs": cc.v_scale})
             out["paged"] = paged
-        return out
+        return CacheLayout.distinct_leaves(out)
 
 
 # --------------------------------------------------------------------------

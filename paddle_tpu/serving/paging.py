@@ -219,18 +219,26 @@ class PrefixCache:
             return None
         self._entries.move_to_end(key)
         self.hits += 1
+        if not isinstance(e["tok0"], int):
+            # inserted as the producing join's TRACED scalar (the
+            # submit path never blocks on it); the first hit — always
+            # long after that dispatch retired — canonicalizes it
+            e["tok0"] = int(e["tok0"])
         return e
 
     def insert(self, key, pages, tok0, n_prompt, Pb):
         """Adopt `pages` (already refcounted by their owner): the cache
-        takes its own reference so they survive the owner's eviction."""
+        takes its own reference so they survive the owner's eviction.
+        `tok0` may be a still-traced device scalar — stored raw and
+        resolved lazily at the first hit, keeping the producing join's
+        submit path sync-free."""
         if key in self._entries:
             # a re-inserted prefix is HOT: refresh its LRU position so
             # it isn't evicted ahead of genuinely colder entries
             self._entries.move_to_end(key)
             return
         self.allocator.incref(pages)
-        self._entries[key] = {"pages": list(pages), "tok0": int(tok0),
+        self._entries[key] = {"pages": list(pages), "tok0": tok0,
                               "n_prompt": int(n_prompt), "Pb": int(Pb)}
         while len(self._entries) > self.capacity:
             self._drop_lru()
@@ -308,11 +316,25 @@ class RadixPrefixCache:
     releases exactly the references the trie took, so
     `PageAllocator.check()` stays clean under chaos."""
 
-    def __init__(self, allocator, capacity=64, page_size=None):
+    def __init__(self, allocator, capacity=64, page_size=None,
+                 mid_page="round_down"):
+        if mid_page not in ("round_down", "cow"):
+            raise ValueError(f"mid_page={mid_page!r}: expected "
+                             f"'round_down' or 'cow'")
         self.allocator = allocator
         self.capacity = int(capacity)
         self.page_size = int(page_size if page_size is not None
                              else allocator.page_size)
+        # mid-page match policy: a match ending INSIDE a page can be
+        # served by COW-copying the partially-matching page ("cow") or
+        # by rounding the match DOWN to the page boundary and
+        # re-prefilling the whole partial page with the divergent tail
+        # ("round_down"). The copy costs a page write + an extra
+        # dispatch and saves < page_size prefill tokens — on CPU it
+        # measurably LOSES (~0.7x TTFT at depth 40/psz 16), so
+        # round_down is the default; `rounded_down` counts the
+        # decisions so the policy stays measurable.
+        self.mid_page = mid_page
         self._roots = {}              # {(mem digest, tenant): _RadixNode}
         self._tenant_gen = {}         # {adapter name: last-seen gen}
         self._tick = 0
@@ -322,6 +344,7 @@ class RadixPrefixCache:
         self.whole_hits = 0
         self.partial_hits = 0
         self.misses = 0
+        self.rounded_down = 0         # mid-page matches truncated
 
     # -- keys ------------------------------------------------------------
 
@@ -437,6 +460,10 @@ class RadixPrefixCache:
                     for n in path:
                         n.tick = t
                     ent["tick"] = t
+                    if not isinstance(ent["tok0"], int):
+                        # stored as the producing join's traced scalar
+                        # (deferred sync); the first hit canonicalizes
+                        ent["tok0"] = int(ent["tok0"])
                 return ("whole", {
                     "pages": [n.page for n in path] + list(ent["pages"]),
                     "tok0": ent["tok0"], "n_prompt": ent["n_prompt"],
@@ -451,6 +478,15 @@ class RadixPrefixCache:
             node = path.pop().parent
             m -= 1
         j, cow_src = self._best_partial(node, tokens, P0, m)
+        if j and self.mid_page == "round_down":
+            # mid-page policy: drop the sub-page extension and attach
+            # from the page boundary — the pattach tail re-prefills
+            # the j matched tokens along with the divergent remainder,
+            # which beats paying a COW page copy + extra dispatch for
+            # them (see __init__; "cow" preserves the old behavior)
+            if mutate:
+                self.rounded_down += 1
+            j, cow_src = 0, None
         if m == 0 and j == 0:
             return None
         if mutate:
@@ -520,7 +556,10 @@ class RadixPrefixCache:
             return
         tail = [int(p) for p in pages[n_full:]]
         self.allocator.incref(tail)
-        node.terminals[tkey] = {"pages": tail, "tok0": int(tok0),
+        # tok0 may still be the producing join's traced scalar: store
+        # it raw (the submit path never blocks on it) — the first
+        # whole hit canonicalizes it to a host int
+        node.terminals[tkey] = {"pages": tail, "tok0": tok0,
                                 "n_prompt": P0, "Pb": Pb, "tick": t}
         self._n_terminals += 1
         self._n_pages += len(tail)
@@ -616,7 +655,8 @@ class RadixPrefixCache:
         prompt pages on edges), terminals, and total pages referenced
         (node pages + terminal tails)."""
         return {"nodes": self._n_nodes, "terminals": self._n_terminals,
-                "pages": self._n_pages, "scopes": len(self._roots)}
+                "pages": self._n_pages, "scopes": len(self._roots),
+                "rounded_down": self.rounded_down}
 
     def __len__(self):
         return self._n_terminals

@@ -432,7 +432,11 @@ class ShardedServingEngine(ServingEngine):
             return math(state, slot, tok0, bias_row, kvs, statics,
                         memory, prompt, length)
 
-        return jax.jit(splice_fn)
+        # the pool carry donates like the rest of the join family (the
+        # shared _DONATED_KINDS declaration the PTA102 audit reads) —
+        # the splice lands in the pool in place, no whole-pool copy
+        return jax.jit(splice_fn,
+                       donate_argnums=self._donate_argnums(key))
 
     def _build_batched_splice(self, Pb, nb):
         """`nb` ready prefills of one bucket land in the pool as ONE
@@ -465,7 +469,8 @@ class ShardedServingEngine(ServingEngine):
             # explicit (the every-carry contract the analyzer audits)
             return self.placement.constrain_state(st)
 
-        return jax.jit(bsplice_fn)
+        return jax.jit(bsplice_fn,
+                       donate_argnums=self._donate_argnums(key))
 
     def _fail_pending_splice(self, s, r, e):
         """Per-request isolation: the failed splice kills THIS
@@ -487,8 +492,12 @@ class ShardedServingEngine(ServingEngine):
             _rt.on_splice_end(r, ok=True)
         self._deliver(r, tok0, self.clock())
 
-    def _splice_one(self, s, info, r):
-        """Single ready prefill: the per-bucket splice program."""
+    def _splice_one(self, s, info, r, deferred=None):
+        """Single ready prefill: the per-bucket splice program. With a
+        `deferred` list the first-token resolution is batched out of
+        the dispatch path: the (slot, request, traced tok0) triple is
+        appended and _poll_pending finishes the whole round after its
+        LAST dispatch (one host sync, not one per splice)."""
         import jax
         import jax.numpy as jnp
 
@@ -506,11 +515,18 @@ class ShardedServingEngine(ServingEngine):
                              jnp.asarray(info["mem"]),
                              jnp.asarray(info["prompt"]),
                              jnp.asarray([info["P0"]], jnp.int32))
-            tok0 = int(tok0)
         except Exception as e:
             self._fail_pending_splice(s, r, e)
+            if not self._carry_alive():
+                # the donated carry died mid-splice with no
+                # replacement: every co-resident slot is poisoned —
+                # all-or-nothing recovery rebuilds the pool
+                self._fail_active(e)
             return False
-        self._finish_splice(s, r, tok0)
+        if deferred is None or self.sync_tok0:
+            self._finish_splice(s, r, int(tok0))
+        else:
+            deferred.append((s, r, tok0))
         return True
 
     def _splice_batch(self, Pb, ss):
@@ -553,6 +569,8 @@ class ShardedServingEngine(ServingEngine):
         except Exception as e:
             for s, r in zip(ss, reqs):
                 self._fail_pending_splice(s, r, e)
+            if not self._carry_alive():
+                self._fail_active(e)
             return False
         for i, (s, r) in enumerate(zip(ss, reqs)):
             self._finish_splice(s, r, int(toks[i]))
@@ -612,13 +630,18 @@ class ShardedServingEngine(ServingEngine):
             groups.setdefault(self._pending_info[s]["Pb"],
                               []).append(s)
         activated = False
+        deferred = []   # (slot, request, traced tok0) per single splice
         for Pb, ss in sorted(groups.items()):
             if len(ss) == 1:
                 s = ss[0]
                 activated |= self._splice_one(
-                    s, self._pending_info[s], self.slots[s])
+                    s, self._pending_info[s], self.slots[s], deferred)
             else:
                 activated |= self._splice_batch(Pb, ss)
+        # resolve the round's first tokens after the LAST dispatch —
+        # one natural host sync instead of a blocking int() per splice
+        for s, r, t in deferred:
+            self._finish_splice(s, r, int(t))
         return activated
 
     def _evict(self, s):

@@ -1,0 +1,364 @@
+"""Donation safety for the zero-copy join family.
+
+Every join-family program (join/pjoin/attach/cow/pattach/splice/
+bsplice) now DONATES the pool carry — the splice happens in place and
+the old buffers are consumed. This file is the proof that the
+perf-side aliasing never costs correctness:
+
+  * liveness — a join really consumes the pre-join carry (holding the
+    old leaves and reading them after the join raises the runtime's
+    "deleted" error), mirroring the decode-step donation proof in
+    test_analysis.py;
+  * failed-join identity — every engine fault point fires host-side
+    BEFORE dispatch, so a join that fails EVERY attempt leaves the
+    pool carry bit-identical (same array objects, same bytes) and the
+    page free list untouched: per-request isolation survives donation;
+  * carry-lost refusal — if a carry buffer ever dies without a
+    replacement, the next join refuses to dispatch (PoolCarryLost)
+    and the engine degrades through the existing all-or-nothing
+    recovery instead of handing XLA a dead buffer;
+  * the (dense|paged) x (single|sharded) x (plain|spec) matrix with
+    adapters riding, each cell under an armed retrace sentinel and
+    drained leak-free (slow-marked; tier-1 keeps the dense + paged
+    single cells).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn.layer.transformer import (TransformerDecoder,
+                                             TransformerDecoderLayer)
+from paddle_tpu.ops import quant as Q
+from paddle_tpu.serving import (AdapterPool, PoolCarryLost, Request,
+                                Scheduler, ServingEngine,
+                                retrace_sentinel)
+from paddle_tpu.testing import faults
+from paddle_tpu.text.generation import bucket_size, generate_eager
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _small_stack(seed=7, D=32, H=2, V=17, layers=2):
+    # reset BOTH rngs: adapter banks draw from paddle's key stream
+    paddle.seed(seed)
+    np.random.seed(seed)
+    layer = TransformerDecoderLayer(D, H, 64, dropout=0.0)
+    dec = TransformerDecoder(layer, layers)
+    dec.eval()
+    return dec, nn.Embedding(V, D), nn.Linear(D, V), D, V
+
+
+def _mk_pool(dec, capacity=4, rank=4, tenants=("t1", "t2"), scale=0.1):
+    pool = AdapterPool(dec, capacity=capacity, rank=rank)
+    for i, name in enumerate(tenants):
+        pool.register_random(name, seed=100 + i, scale=scale)
+    return pool
+
+
+def _mk_request(rs, D, V, name=None, pmax=6, nmax=8):
+    P = int(rs.randint(1, pmax + 1))
+    prompt = rs.randint(2, V, (P,)).astype(np.int32)
+    prompt[0] = 0
+    mem = np.random.RandomState(
+        int(prompt.sum()) * 131 + P).randn(4, D).astype("f4")
+    n = int(rs.randint(2, nmax + 1))
+    return Request(prompt, mem, max_new_tokens=n, eos_id=1,
+                   adapter=name)
+
+
+def _scoped_eager(stack, pool, r, max_new):
+    """Solo generate_eager oracle, under `lora_scope` when the request
+    names a tenant (batch-1: row invariance makes the pool
+    token-identical)."""
+    jnp = _jnp()
+    dec, embed, proj, D, V = stack
+    name = getattr(r, "adapter", None)
+
+    def run():
+        toks, lens = generate_eager(
+            dec, embed, proj, jnp.asarray(r.memory[None]),
+            jnp.asarray(r.prompt[None]),
+            jnp.asarray([r.prompt.shape[0]], jnp.int32), bos_id=0,
+            eos_id=1, max_new_tokens=max_new,
+            pad_prompt_to=bucket_size(max(1, r.prompt.shape[0])))
+        return np.asarray(toks)[0], int(np.asarray(lens)[0])
+
+    if name is None or pool is None:
+        return run()
+    row = pool.acquire(name)
+    try:
+        with Q.lora_scope(jnp.asarray([row], jnp.int32), pool.banks()):
+            return run()
+    finally:
+        pool.release(row)
+
+
+def _serve(eng, reqs, max_iterations=2000):
+    sched = Scheduler(max_queue=len(reqs) + 8)
+    for r in reqs:
+        sched.submit(r)
+    eng.serve_until_idle(sched, max_iterations=max_iterations)
+    return [r.result(timeout=5) for r in reqs]
+
+
+def _carry_leaves(eng):
+    """The pool carry's array leaves (index/length mirrors included —
+    the whole carry is one donated pytree argument)."""
+    import jax
+
+    return [x for x in jax.tree_util.tree_leaves(eng._state)
+            if hasattr(x, "is_deleted")]
+
+
+def _host_snapshot(eng):
+    return [np.asarray(x).copy() for x in _carry_leaves(eng)]
+
+
+# ----------------------------------------------------------------------
+# liveness: the join consumes the pre-join carry
+# ----------------------------------------------------------------------
+
+def test_join_donation_is_live_dense():
+    """The dense slot join CONSUMES the old pool carry: the held
+    pre-join leaves read back as deleted afterwards (donation is live,
+    not silently copied around)."""
+    dec, embed, proj, D, V = _small_stack(seed=31)
+    eng = ServingEngine(dec, embed, proj, num_slots=2, max_len=32)
+    rs = np.random.RandomState(32)
+    r0 = _mk_request(rs, D, V)
+    assert _serve(eng, [r0])[0].ok
+    old = _carry_leaves(eng)
+    assert old and not any(x.is_deleted() for x in old)
+    eng._join(0, _mk_request(rs, D, V))
+    assert all(x.is_deleted() for x in old)
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(old[0])
+    # and the post-join carry is the live replacement
+    assert not any(x.is_deleted() for x in _carry_leaves(eng))
+
+
+def test_join_donation_is_live_paged():
+    """Both paged admission paths consume the carry: the bucketed
+    prefill join (pjoin) AND the prefix-cache attach (whole trie
+    hit)."""
+    dec, embed, proj, D, V = _small_stack(seed=33)
+    eng = ServingEngine(dec, embed, proj, num_slots=2, max_len=32,
+                        paged=True, page_size=4, num_pages=48)
+    rs = np.random.RandomState(34)
+    r0 = _mk_request(rs, D, V)
+    assert _serve(eng, [r0])[0].ok       # seeds the radix trie
+
+    # pjoin path: fresh prompt -> real prefill
+    old = _carry_leaves(eng)
+    eng._join(0, _mk_request(rs, D, V))
+    assert all(x.is_deleted() for x in old)
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(old[0])
+
+    # attach path: exact repeat of r0 -> whole hit, zero prefill flops,
+    # still an in-place splice of the per-request rows
+    old = _carry_leaves(eng)
+    hits0 = eng._prefix.hits
+    eng._join(1, Request(r0.prompt.copy(), r0.memory,
+                         max_new_tokens=4, eos_id=1))
+    assert eng._prefix.hits == hits0 + 1
+    assert all(x.is_deleted() for x in old)
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(old[0])
+
+
+# ----------------------------------------------------------------------
+# failed joins: the donated carry is bit-identical afterwards
+# ----------------------------------------------------------------------
+
+def _assert_failed_join_identity(eng, sched, rs, D, V, name=None):
+    """Inject a persistent slot_join fault, prove the pool carry came
+    through untouched: same array objects (never reassigned), same
+    bytes, occupancy zero, and the doomed future carries the cause."""
+    snap = _host_snapshot(eng)
+    ids0 = [id(x) for x in _carry_leaves(eng)]
+    doomed = _mk_request(rs, D, V, name)
+    sched.submit(doomed)
+    with faults.inject("serving.slot_join", on="always"):
+        eng.run_iteration(sched)
+    with pytest.raises(faults.InjectedFault):
+        doomed.result(timeout=5)
+    assert doomed.finish_reason == "error"
+    assert eng.occupancy() == 0
+    live = _carry_leaves(eng)
+    assert [id(x) for x in live] == ids0     # carry never reassigned
+    assert not any(x.is_deleted() for x in live)
+    after = _host_snapshot(eng)
+    assert len(after) == len(snap)
+    for a, b in zip(snap, after):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_failed_join_leaves_pool_bit_identical_dense():
+    dec, embed, proj, D, V = _small_stack(seed=41)
+    stack = (dec, embed, proj, D, V)
+    eng = ServingEngine(dec, embed, proj, num_slots=2, max_len=32,
+                        max_attempts=2, backoff_base_s=0.0)
+    retrace_sentinel(eng).__enter__()   # disarmed by conftest teardown
+    rs = np.random.RandomState(42)
+    r0 = _mk_request(rs, D, V)
+    assert _serve(eng, [r0])[0].ok
+    sched = Scheduler(max_queue=8)
+    _assert_failed_join_identity(eng, sched, rs, D, V)
+    snap = eng.metrics.snapshot()
+    assert snap["requests"]["failed"] == 1
+    assert snap["errors"]["last"]["where"] == "slot_join"
+    # survivors: same carry keeps serving bit-exact
+    survivors = [_mk_request(rs, D, V) for _ in range(3)]
+    for r, res in zip(survivors, _serve(eng, survivors)):
+        assert res.ok
+        et, _ = _scoped_eager(stack, None, r, max_new=8)
+        np.testing.assert_array_equal(res.tokens, et[:len(res.tokens)])
+
+
+def test_failed_join_leaves_pool_bit_identical_paged():
+    """Paged cell: on top of the byte-identity, the page free list is
+    back at its pre-fault level, the allocator's refcount invariants
+    hold, and a prefix-cache flush drains every page (leak-free)."""
+    dec, embed, proj, D, V = _small_stack(seed=43)
+    stack = (dec, embed, proj, D, V)
+    eng = ServingEngine(dec, embed, proj, num_slots=2, max_len=32,
+                        paged=True, page_size=4, num_pages=48,
+                        max_attempts=2, backoff_base_s=0.0)
+    retrace_sentinel(eng).__enter__()   # disarmed by conftest teardown
+    rs = np.random.RandomState(44)
+    r0 = _mk_request(rs, D, V)
+    assert _serve(eng, [r0])[0].ok
+    free0 = eng._alloc.pages_free
+    sched = Scheduler(max_queue=8)
+    _assert_failed_join_identity(eng, sched, rs, D, V)
+    assert eng._alloc.pages_free == free0
+    eng._alloc.check()
+    survivors = [_mk_request(rs, D, V) for _ in range(3)]
+    for r, res in zip(survivors, _serve(eng, survivors)):
+        assert res.ok
+        et, _ = _scoped_eager(stack, None, r, max_new=8)
+        np.testing.assert_array_equal(res.tokens, et[:len(res.tokens)])
+    eng._prefix.flush()
+    assert eng._alloc.pages_free == eng.num_pages
+    eng._alloc.check()
+
+
+# ----------------------------------------------------------------------
+# carry lost: refuse to dispatch on dead buffers, degrade cleanly
+# ----------------------------------------------------------------------
+
+def test_carry_lost_refuses_dispatch_and_recovers():
+    """If a carry leaf dies without a replacement (simulated with an
+    explicit delete), the next join raises PoolCarryLost host-side
+    instead of handing XLA a dead buffer; run_iteration escalates
+    through the all-or-nothing recovery and the REBUILT pool serves
+    bit-exact again without retracing."""
+    dec, embed, proj, D, V = _small_stack(seed=45)
+    stack = (dec, embed, proj, D, V)
+    eng = ServingEngine(dec, embed, proj, num_slots=2, max_len=32,
+                        max_attempts=2, backoff_base_s=0.0)
+    retrace_sentinel(eng).__enter__()   # disarmed by conftest teardown
+    rs = np.random.RandomState(46)
+    r0 = _mk_request(rs, D, V)
+    assert _serve(eng, [r0])[0].ok
+    _carry_leaves(eng)[0].delete()      # the simulated loss
+    doomed = _mk_request(rs, D, V)
+    sched = Scheduler(max_queue=8)
+    sched.submit(doomed)
+    eng.run_iteration(sched)
+    with pytest.raises(PoolCarryLost):
+        doomed.result(timeout=5)
+    # recovery: _ensure_state rebuilt a fresh pool, programs stayed
+    # cached (armed sentinel), outputs still bit-match the oracle
+    r1 = _mk_request(rs, D, V)
+    res = _serve(eng, [r1])[0]
+    assert res.ok
+    et, _ = _scoped_eager(stack, None, r1, max_new=8)
+    np.testing.assert_array_equal(res.tokens, et[:len(res.tokens)])
+
+
+# ----------------------------------------------------------------------
+# the full matrix, adapters riding
+# ----------------------------------------------------------------------
+
+def _matrix_cells():
+    return [(paged, spec, sharded)
+            for paged in (False, True)
+            for spec in (False, True)
+            for sharded in (False, True)]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_join_donation_chaos_matrix():
+    """(dense|paged) x (single|sharded) x (plain|spec), mixed-tenant
+    traffic, each cell under an armed retrace sentinel: warm wave
+    bit-matches the scoped oracle, a persistent join fault leaves the
+    carry bit-identical, survivors bit-match afterwards, and the cell
+    drains leak-free (adapter rows + pages)."""
+    from paddle_tpu.parallel import init_mesh
+    from paddle_tpu.profiler import trace as _trace
+    from paddle_tpu.serving import ShardedServingEngine
+
+    for paged, spec, sharded in _matrix_cells():
+        dec, embed, proj, D, V = _small_stack(seed=101)
+        stack = (dec, embed, proj, D, V)
+        pool = _mk_pool(dec, capacity=4, rank=4)
+        kw = dict(num_slots=2, max_len=32, adapters=pool,
+                  max_attempts=2, backoff_base_s=0.0)
+        if paged:
+            kw.update(paged=True, page_size=8)
+        if spec:
+            kw.update(spec_k=4)
+        if sharded:
+            mesh = init_mesh(dp=2, fsdp=2, tp=2)
+            eng = ShardedServingEngine(dec, embed, proj, mesh=mesh,
+                                       **kw)
+        else:
+            eng = ServingEngine(dec, embed, proj, **kw)
+        cell = f"paged={paged} spec={spec} sharded={sharded}"
+        retrace_sentinel(eng).__enter__()
+        rs = np.random.RandomState(102)
+
+        # warm wave through the donated joins, mixed tenants
+        reqs = [_mk_request(rs, D, V, nm)
+                for nm in (None, "t1", "t2", "t1")]
+        for r, res in zip(reqs, _serve(eng, reqs)):
+            assert res.ok, (cell, r.adapter, res)
+            et, _ = _scoped_eager(stack, pool, r, max_new=8)
+            np.testing.assert_array_equal(
+                res.tokens, et[:len(res.tokens)],
+                err_msg=f"{cell} adapter={r.adapter}")
+
+        # failed-join identity (an adapter request: the fault fires
+        # before the row acquire, so tenancy can't leak either)
+        free0 = eng._alloc.pages_free if paged else None
+        sched = Scheduler(max_queue=8)
+        _assert_failed_join_identity(eng, sched, rs, D, V, name="t1")
+        if paged:
+            assert eng._alloc.pages_free == free0, cell
+            eng._alloc.check()
+
+        # survivors bit-match on the SAME (never reset) carry
+        more = [_mk_request(rs, D, V, nm) for nm in ("t2", None)]
+        for r, res in zip(more, _serve(eng, more)):
+            assert res.ok, (cell, r.adapter, res)
+            et, _ = _scoped_eager(stack, pool, r, max_new=8)
+            np.testing.assert_array_equal(
+                res.tokens, et[:len(res.tokens)],
+                err_msg=f"{cell} adapter={r.adapter}")
+
+        # leak-free drain
+        pool.check()
+        assert pool.refcount.sum() == 0, cell
+        if paged:
+            eng._prefix.flush()
+            assert eng._alloc.pages_free == eng.num_pages, cell
+            eng._alloc.check()
+        _trace.reset()

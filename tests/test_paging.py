@@ -123,9 +123,10 @@ def test_prefix_cache_reinsert_refreshes_lru():
 # the radix prefix trie (host-side unit cells; no engine)
 # ----------------------------------------------------------------------
 
-def _radix(n_pages=32, psz=4, capacity=64):
+def _radix(n_pages=32, psz=4, capacity=64, mid_page="round_down"):
     a = PageAllocator(n_pages, psz)
-    return a, PG.RadixPrefixCache(a, capacity=capacity, page_size=psz)
+    return a, PG.RadixPrefixCache(a, capacity=capacity, page_size=psz,
+                                  mid_page=mid_page)
 
 
 def _radix_insert(a, trie, tokens, P0, Pb, memory=None, tenant=None,
@@ -163,11 +164,12 @@ def test_radix_trie_longest_prefix_whole_and_partial():
 
 
 def test_radix_trie_mid_page_cow_divergence_and_backoff():
-    a, trie = _radix()
+    # mid_page="cow" preserves the sub-page extension path: the trie
+    # hands back the split page as a COW source + in-page length j
+    a, trie = _radix(mid_page="cow")
     full = (0, 3, 5, 7, 2, 9, 4, 11, 6, 13)           # P0=10, Pb=16
     pages = _radix_insert(a, trie, full, 10, 16, tok0=5)
-    # divergence INSIDE page 1 (matches 6 of its 8 tokens): the trie
-    # hands back the split page as a COW source + in-page length j
+    # divergence INSIDE page 1 (matches 6 of its 8 tokens)
     mid = full[:6] + (15, 8, 12, 10)                  # P0=10
     kind, ent = trie.lookup(mid, 10, 16)
     assert kind == "partial"
@@ -180,6 +182,45 @@ def test_radix_trie_mid_page_cow_divergence_and_backoff():
     assert kind == "partial"
     assert ent["pages"] == [pages[0]] and ent["j"] == 3
     assert ent["cow_src"] == pages[1] and ent["seed_len"] == 7
+    assert trie.stats()["rounded_down"] == 0
+    trie.flush()
+    a.check()
+    assert a.pages_free == 32
+
+
+def test_radix_trie_mid_page_round_down_default():
+    """Default policy: a mid-page match rounds DOWN to the page
+    boundary — no COW source, the partial page re-prefills with the
+    divergent tail (the sub-page copy measurably loses on CPU)."""
+    a, trie = _radix()
+    assert trie.mid_page == "round_down"
+    full = (0, 3, 5, 7, 2, 9, 4, 11, 6, 13)           # P0=10, Pb=16
+    pages = _radix_insert(a, trie, full, 10, 16, tok0=5)
+    # divergence INSIDE page 1: the match truncates to page 0's edge
+    mid = full[:6] + (15, 8, 12, 10)                  # P0=10
+    kind, ent = trie.lookup(mid, 10, 16)
+    assert kind == "partial"
+    assert ent["pages"] == [pages[0]]
+    assert ent["j"] == 0 and ent["cow_src"] is None
+    assert ent["seed_len"] == 4
+    # back-off case: all real tokens matched, no terminal — rounding
+    # down the dropped page's re-emergence leaves one full page
+    kind, ent = trie.lookup(full[:8], 8, 8)
+    assert kind == "partial"
+    assert ent["pages"] == [pages[0]]
+    assert ent["j"] == 0 and ent["cow_src"] is None
+    assert ent["seed_len"] == 4
+    # a one-page prompt that would only match sub-page: now a miss
+    # (re-prefilling < page_size tokens beats a page copy)
+    assert trie.lookup(full[:3] + (15,), 4, 4) is None
+    st = trie.stats()
+    assert st["rounded_down"] == 3
+    # peek is side-effect free: the counter must not move
+    trie.peek(mid, 10, 16)
+    assert trie.stats()["rounded_down"] == 3
+    # bad policy value rejected loudly
+    with pytest.raises(ValueError):
+        PG.RadixPrefixCache(a, page_size=4, mid_page="maybe")
     trie.flush()
     a.check()
     assert a.pages_free == 32
@@ -667,7 +708,9 @@ def test_branching_conversation_soak_partial_reuse_bitmatch():
 
     dense = ServingEngine(*stack[:3], num_slots=4, max_len=64)
     want = _drive(dense, mk_reqs())
-    eng = _paged_radix_engine(stack, max_len=64)
+    # radix_mid_page="cow" pins the sub-page COW path this test
+    # exercises (the default rounds mid-page matches down instead)
+    eng = _paged_radix_engine(stack, max_len=64, radix_mid_page="cow")
     got = _drive(eng, mk_reqs())
     for w, g in zip(want, got):
         assert w.ok and g.ok
@@ -684,6 +727,44 @@ def test_branching_conversation_soak_partial_reuse_bitmatch():
     snap = m.snapshot()["prefix"]
     assert snap["hit_token_ratio"] > 0.3
     assert snap["trie_nodes"] >= 1 and snap["trie_pages"] >= 1
+    eng.flush_prefix_cache()
+    eng._alloc.check()
+    assert eng._alloc.pages_free == eng.num_pages
+
+
+def test_round_down_policy_serves_mid_page_fork_without_cow():
+    """The DEFAULT mid-page policy: the same branching traffic
+    bit-matches the dense oracle with ZERO divergence-point COW
+    copies — the mid-page fork's match rounds down to the page
+    boundary and the partial page re-prefills with the tail (the
+    trie's `rounded_down` counter proves the policy fired)."""
+    stack = _small_stack(seed=131)
+    D = stack[3]
+    mem = np.random.RandomState(7).randn(4, D).astype("f4")
+    pre = [0, 3, 7, 11, 2, 9, 4, 13, 5, 8, 15, 6]     # 3 full pages
+    specs = [pre + [10, 2, 14, 3, 5, 9],  # cold prefill
+             pre[:6] + [8, 14, 2, 5],     # mid-page fork @6 -> rounds
+             #                              down to the page-4 boundary
+             pre + [12, 6, 4]]            # page-aligned fork @12
+    specs = [np.asarray(p, np.int32) for p in specs]
+
+    def mk_reqs():
+        return [Request(p.copy(), mem, max_new_tokens=6, eos_id=1)
+                for p in specs]
+
+    dense = ServingEngine(*stack[:3], num_slots=4, max_len=64)
+    want = _drive(dense, mk_reqs())
+    eng = _paged_radix_engine(stack, max_len=64)
+    got = _drive(eng, mk_reqs())
+    for w, g in zip(want, got):
+        assert w.ok and g.ok
+        np.testing.assert_array_equal(g.tokens, w.tokens)
+    m = eng.metrics
+    assert m.prefix_partial_hits == 2          # both forks still hit
+    assert m.cow_copies == 0                   # no divergence COW
+    assert eng._prefix.stats()["rounded_down"] >= 1
+    # no cow program was ever compiled on this traffic
+    assert not any(k[0] == "cow" for k in eng.trace_counts)
     eng.flush_prefix_cache()
     eng._alloc.check()
     assert eng._alloc.pages_free == eng.num_pages

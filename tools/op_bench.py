@@ -224,6 +224,7 @@ def _configs():
     cfgs += _configs_sharded_decode()
     cfgs += _configs_lora_int8()
     cfgs += _configs_prefix_attach()
+    cfgs += _configs_join_donation()
     return cfgs
 
 
@@ -1408,6 +1409,114 @@ def _configs_prefix_attach():
 
     return [(f"prefix_attach_m{m}_t{t}", direct(m, t))
             for m in (4, 16) for t in (1, 4)]
+
+
+def _configs_join_donation():
+    """Zero-copy join rows (PR 17): the join family's splice write,
+    DONATED vs undonated, measured PAIRED. Every join program now
+    takes the pool carry with donate_argnums, so the prompt splice is
+    an in-place scatter instead of a whole-pool copy + scatter —
+    step_us is the donated side (what the engine actually dispatches),
+    copy_step_us the undonated twin (the same program without the
+    alias, i.e. what every join paid before this PR), and
+    inplace_speedup their ratio. Dense = the bucketed [1, H, P, D]
+    K/V block landing in the pooled [S, H, L, D] cache at a traced
+    slot (static_kv_splice, the dense join's hot write); paged = the
+    page-granular scatter of the same block into the global page pool
+    (write_prompt_pages, the pjoin/prefill hot write). The donated
+    side ping-pongs the carry through a holder — each call consumes
+    the previous call's output, exactly like the engine's
+    self._state reassignment."""
+
+    def dense(S, heads, L, d, P, steps=20):
+        def bench():
+            import jax
+            import jax.numpy as jnp
+
+            from paddle_tpu.nn.layer.transformer import \
+                MultiHeadAttention as MHA
+
+            rs = np.random.RandomState(0)
+            kb = jnp.asarray(rs.randn(1, heads, P, d).astype("f4"))
+            vb = jnp.asarray(rs.randn(1, heads, P, d).astype("f4"))
+
+            def splice(c, s):
+                return MHA.static_kv_splice(c, s, kb, vb,
+                                            jnp.int32(P))
+
+            def mk_pool():
+                return MHA.StaticKVCache(
+                    jnp.zeros((S, heads, L, d), jnp.float32),
+                    jnp.zeros((S, heads, L, d), jnp.float32),
+                    jnp.zeros((S,), jnp.int32))
+
+            fn_copy = jax.jit(splice)
+            fn_don = jax.jit(splice, donate_argnums=0)
+            pool = mk_pool()
+            holder = [mk_pool()]
+            slot = jnp.int32(S // 2)
+
+            def run_donated():
+                holder[0] = fn_don(holder[0], slot)
+                return holder[0]
+
+            dt_d, dt_c = measure_pair(run_donated,
+                                      lambda: fn_copy(pool, slot),
+                                      steps=steps)
+            return {"step_us": round(dt_d * 1e6, 2),
+                    "copy_step_us": round(dt_c * 1e6, 2),
+                    "inplace_speedup": round(
+                        dt_c / max(dt_d, 1e-12), 3)}
+
+        bench._direct = True
+        return bench
+
+    def paged(n_pages, heads, psz, d, P, steps=20):
+        def bench():
+            import jax
+            import jax.numpy as jnp
+
+            from paddle_tpu.serving.paging import (pages_for,
+                                                   write_prompt_pages)
+
+            rs = np.random.RandomState(0)
+            kv = jnp.asarray(rs.randn(1, heads, P, d).astype("f4"))
+            ids = jnp.asarray(
+                rs.permutation(n_pages)[:pages_for(P, psz)]
+                .astype("i4"))
+
+            def splice(pages):
+                return write_prompt_pages(pages, None, ids, kv,
+                                          False)[0]
+
+            def mk_pages():
+                return jnp.zeros((n_pages + 1, heads, psz, d),
+                                 jnp.float32)
+
+            fn_copy = jax.jit(splice)
+            fn_don = jax.jit(splice, donate_argnums=0)
+            pages = mk_pages()
+            holder = [mk_pages()]
+
+            def run_donated():
+                holder[0] = fn_don(holder[0])
+                return holder[0]
+
+            dt_d, dt_c = measure_pair(run_donated,
+                                      lambda: fn_copy(pages),
+                                      steps=steps)
+            return {"step_us": round(dt_d * 1e6, 2),
+                    "copy_step_us": round(dt_c * 1e6, 2),
+                    "inplace_speedup": round(
+                        dt_c / max(dt_d, 1e-12), 3)}
+
+        bench._direct = True
+        return bench
+
+    return [
+        ("join_inplace_vs_copy_dense", dense(8, 8, 2048, 64, 128)),
+        ("join_inplace_vs_copy_paged", paged(256, 8, 16, 64, 128)),
+    ]
 
 
 def measure(run, args=(), *, steps=30, lo=5, k=5, detail=False):

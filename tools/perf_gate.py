@@ -87,6 +87,14 @@ LORA_OPS = ("lora_base_b8", "lora_decode_r8_b8", "int8_matmul_vs_f32")
 #: the gate even while the whole-prompt path stays fast
 RADIX_OPS = ("prefix_attach_m4_t1", "prefix_attach_m16_t1")
 
+#: zero-copy join rows folded into the full-run default (PR 17): the
+#: dense slot splice and the paged page scatter, each measured paired
+#: in-row DONATED vs undonated (measure_pair). step_us is the donated
+#: side — the write every join in the family now dispatches — so a
+#: regression in the in-place path fails the gate even if the old
+#: copying path would have hidden it
+JOIN_OPS = ("join_inplace_vs_copy_dense", "join_inplace_vs_copy_paged")
+
 #: tuned-vs-fallback rows folded into the full-run default (PR 11):
 #: the autotuned flash_decode config must NEVER be slower than the
 #: hand-picked constants it replaced. Both sides are measured fresh,
@@ -358,7 +366,8 @@ def main(argv=None):
     else:
         op_names = ([c[0] for c in _quick8()] + list(SPEC_OPS)
                     + list(LORA_OPS)
-                    + list(RADIX_OPS)) if args.ops is None else []
+                    + list(RADIX_OPS)
+                    + list(JOIN_OPS)) if args.ops is None else []
         bench_names = list(DEFAULT_BENCH) if args.bench is None else []
         tuning_rows = list(TUNING_ROWS)
     if args.ops is not None:
