@@ -154,6 +154,18 @@ def build_check_engines(include_sharded=True):
     out.append(("radix", ServingEngine(
         dec, emb, proj, num_slots=4, max_len=32, paged=True,
         page_size=8, adapters=pool)))
+    # traffic shaping (PR 19): the chunked-prefill program family —
+    # the dense cjoin and the paged pcjoin both carry the pool state
+    # at arg 4 (see _DONATED_KINDS), so the donation audit verifies
+    # every per-chunk dispatch splices in place instead of copying
+    # the pool once per chunk
+    dec, emb, proj = _small_stack(seed=15)
+    out.append(("chunked", ServingEngine(
+        dec, emb, proj, num_slots=4, max_len=32, prefill_chunk=4)))
+    dec, emb, proj = _small_stack(seed=16)
+    out.append(("chunked_paged", ServingEngine(
+        dec, emb, proj, num_slots=4, max_len=32, paged=True,
+        page_size=4, prefill_chunk=8)))
     if include_sharded:
         mesh = _local_mesh(dp=2)
         if mesh is not None:

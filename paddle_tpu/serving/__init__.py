@@ -16,6 +16,13 @@ batching over the static KV cache:
     `OutOfPages` backpressure (README "Paged KV cache");
   * `scheduler.Scheduler` / `Request` — bounded FIFO admission with
     backpressure (`QueueFull`), deadlines, cancellation, drain;
+  * `shaping.ShapingScheduler` / `SLOClass` — the traffic-shaping
+    control plane over the same surface (README "Traffic shaping"):
+    SLO classes (interactive vs batch TTFT/TPOT targets), weighted
+    fair queueing across tenants, preemption of batch slots to the
+    prefix cache, and watermark/goodput admission gating; pairs with
+    the engines' `prefill_chunk=` chunked prefill so one long prompt
+    never stalls co-resident decode;
   * `server.ServingServer` — thread frontend: submit() -> future with
     per-token streaming;
   * `metrics.ServingMetrics` — TTFT / per-token latency / tokens/s /
@@ -53,6 +60,7 @@ from .paging import (OutOfPages, PageAllocator, PagedKVCache,
                      PrefixCache, RadixPrefixCache)
 from .scheduler import QueueFull, Request, RequestResult, Scheduler
 from .server import ServerCrashed, ServingServer
+from .shaping import BATCH, INTERACTIVE, ShapingScheduler, SLOClass
 from .sharded import ShardedPagedServingEngine, ShardedServingEngine
 from .tracing import (RetraceError, RetraceSentinel, retrace_sentinel,
                       session_scope)
@@ -68,4 +76,5 @@ __all__ = [
     "RetraceSentinel",
     "retrace_sentinel", "session_scope", "to_prometheus",
     "AdapterPool", "OutOfAdapters", "quantize_net",
+    "ShapingScheduler", "SLOClass", "INTERACTIVE", "BATCH",
 ]

@@ -56,6 +56,8 @@ _PT_SLOT_JOIN = faults.point("serving.slot_join")
 _PT_PREFILL = faults.point("serving.prefill")
 _PT_PATTACH = faults.point("serving.pattach")
 _PT_DECODE = faults.point("serving.decode_step")
+_PT_CHUNK = faults.point("serving.prefill_chunk")
+_PT_PREEMPT = faults.point("serving.preempt")
 
 
 def _reject_sharded_params(params, engine_name):
@@ -271,6 +273,55 @@ class _EngineBase:
         the slot axis."""
         return free[0]
 
+    def _advance_chunks(self, now):
+        """Run ONE prefill chunk for every slot mid chunked-prefill.
+        Called between admission and the decode step — a chunk-joined
+        slot's first chunk must dispatch BEFORE any decode step, so the
+        decode step's masked k/v writes (which land at the slot's pool
+        index) can never clobber prompt positions the chunk family owns.
+        Returns True when any chunk ran. Default: no chunking."""
+        return False
+
+    def preempt_slot(self, s, now):
+        """Evict the RUNNING request in slot `s` to the prefix cache so
+        a later re-admission resumes via a cheap attach instead of a
+        re-prefill. Returns the preempted Request (re-queueable), or
+        None when this engine has no preemption mechanism (dense pools:
+        nothing to park the KV in). Default: no mechanism."""
+        return None
+
+    def can_preempt(self, s):
+        """True when slot `s` currently holds a preemptible-in-principle
+        request (engine-side mechanics only — class policy lives in the
+        shaper)."""
+        return False
+
+    def _preempt_for(self, scheduler, now):
+        """Admission found no free slot: ask the scheduler (duck-typed
+        — only the ShapingScheduler implements the hook) for a victim
+        slot, evict it to the prefix cache, and requeue the preempted
+        request. Returns the freed slot index or None."""
+        pick = getattr(scheduler, "pick_preempt_victim", None)
+        if pick is None:
+            return None
+        s = pick(self, now)
+        if s is None:
+            return None
+        try:
+            r = self.preempt_slot(s, now)
+        except Exception as e:
+            # the preempt fault point fires BEFORE any mutation, so a
+            # failed preemption leaves slot, pages, and queue intact —
+            # record it and let this iteration's admission just stop
+            self.metrics.record_error("preempt", e)
+            return None
+        if r is None:
+            return None
+        requeue = getattr(scheduler, "requeue_preempted",
+                          scheduler.push_front)
+        requeue(r)
+        return s
+
     #: jit-cache key KINDS whose pool-state carry argument is donated
     #: into the compiled program (position of the state arg in the
     #: body signature). Donation lets XLA alias the KV pool in place
@@ -302,7 +353,8 @@ class _EngineBase:
     #: audit); ANALYSIS_BASELINE.json carries no join-family waivers.
     _DONATED_KINDS = {"step": 2, "sstep": 2, "pstep": 2, "pverify": 2,
                       "join": 2, "pjoin": 2, "attach": 2, "cow": 0,
-                      "pattach": 4, "splice": 0, "bsplice": 0}
+                      "pattach": 4, "splice": 0, "bsplice": 0,
+                      "cjoin": 4, "pcjoin": 4}
 
     def _program(self, key, build):
         """Get-or-build a compiled program from the observed jit
@@ -527,6 +579,21 @@ class _EngineBase:
         self.slots[s] = None
         self._evict(s)
         self.metrics.record_finish(reason, len(r.tokens))
+        if reason in ("eos", "length"):
+            # slo is an SLOClass once a ShapingScheduler admitted the
+            # request; a string class name through the plain FIFO is
+            # never resolved — no class semantics, nothing to record
+            slo = getattr(r, "slo", None)
+            if hasattr(slo, "ttft_target_s") \
+                    and r.first_token_at is not None \
+                    and r.submitted_at is not None:
+                ttft = r.first_token_at - r.submitted_at
+                n = len(r.tokens)
+                tpot = ((now - r.first_token_at) / (n - 1)
+                        if n > 1 else 0.0)
+                self.metrics.record_slo_finish(
+                    slo.name, ttft, tpot, slo.ttft_target_s,
+                    slo.tpot_target_s)
         r.finish(reason, now)
         self._cbs.emit("on_finish", r)
 
@@ -539,6 +606,15 @@ class _EngineBase:
 
     def _deliver(self, r, tok, now):
         if r.state == "DONE":
+            return
+        rep = getattr(r, "_replay", 0)
+        if rep > 0:
+            # post-preemption replay: the resumed slot re-decodes
+            # tokens the caller already holds (determinism makes the
+            # replay bit-identical); absorb them silently — no append,
+            # no stream callback, no TTFT/throughput double-count
+            r._replay = rep - 1
+            self.metrics.record_replay_token()
             return
         r.tokens.append(tok)
         self.metrics.record_token(self._tenant_of(r))
@@ -595,7 +671,15 @@ class _EngineBase:
         while joins < self.max_joins_per_iter:
             free = [i for i, r in enumerate(self.slots) if r is None]
             if not free:
-                break
+                # fairness-aware preemption: a full pool defers to the
+                # scheduler (duck-typed — only the ShapingScheduler
+                # implements the hook) to evict a lower-class slot to
+                # the prefix cache; resume later rides a cheap attach
+                s = self._preempt_for(scheduler, now)
+                if s is None:
+                    break
+                free = [s]
+                progress = True
             r = scheduler.pop_ready(now, on_dead=_queue_death)
             if r is None:
                 break
@@ -649,6 +733,10 @@ class _EngineBase:
             progress = True
             if r._trace is not None:
                 _rt.on_join_end(r, pending=s in self._pending)
+            if getattr(r, "_replay", 0) > 0:
+                # a preempted request re-joining: its replay counter
+                # was armed at preemption and survives to here
+                self.metrics.record_resume()
             self.metrics.record_join()
             self._cbs.emit("on_join", r, s)
             if tok is not None:   # prefill already produced token 0
@@ -663,6 +751,12 @@ class _EngineBase:
         # decode step's active mask already excludes DONE slots.
         for r, tok in tok0s:
             self._deliver(r, int(tok), self.clock())
+        # 2b. chunked prefill: one chunk per mid-prefill slot, BEFORE
+        # the decode step — a freshly chunk-joined slot's first chunk
+        # must set the pool index past its pad hole before any masked
+        # decode-step write can land inside the prompt region
+        if self._advance_chunks(self.clock()):
+            progress = True
         # 3. one batched decode step over the active mask (slots with a
         # disaggregated prefill still in flight stay masked out)
         active = np.asarray(
@@ -726,6 +820,9 @@ class _EngineBase:
         self.metrics.record_iteration(
             scheduler.depth(), self.occupancy() / self.num_slots,
             **(self._iteration_gauges() or {}))
+        lag_fn = getattr(scheduler, "wfq_lag_by_tenant", None)
+        if lag_fn is not None:
+            self.metrics.set_wfq_lag(lag_fn())
         self._cbs.emit("on_iteration", {
             "queue_depth": scheduler.depth(),
             "occupancy": self.occupancy(), "joins": joins})
@@ -782,7 +879,7 @@ class ServingEngine(_EngineBase):
                  spec_ngram=2, spec_adapt=True, spec_adapt_low=0.15,
                  spec_adapt_high=0.6, spec_adapt_patience=4,
                  spec_adapt_alpha=0.3, adapters=None, quantize=None,
-                 **kw):
+                 prefill_chunk=None, **kw):
         super().__init__(num_slots, max_joins_per_iter=max_joins_per_iter,
                          metrics=metrics, callbacks=callbacks, clock=clock,
                          **kw)
@@ -846,6 +943,25 @@ class ServingEngine(_EngineBase):
         self.spec_adapt_high = float(spec_adapt_high)
         self.spec_adapt_patience = int(spec_adapt_patience)
         self.spec_adapt_alpha = float(spec_adapt_alpha)
+        # chunked prefill (the mechanism; serving/shaping.py is the
+        # policy): prompts longer than `prefill_chunk` positions
+        # prefill in fixed-size chunks dispatched BETWEEN decode
+        # steps — run_iteration runs ONE chunk per mid-prefill slot
+        # per iteration — so the decode-step inter-arrival co-resident
+        # requests see is bounded by one chunk at ANY prompt length.
+        # Power of two so chunk buckets ride the compile-bucket grid
+        # (one cjoin/pcjoin compile per chunk bucket, never per
+        # prompt); the paged engine additionally requires a page
+        # multiple so every chunk boundary is page-aligned.
+        if prefill_chunk is not None:
+            prefill_chunk = int(prefill_chunk)
+            if prefill_chunk < 2 or prefill_chunk & (prefill_chunk - 1):
+                raise ValueError(
+                    f"prefill_chunk={prefill_chunk}: must be a power "
+                    f"of two >= 2 (compile-bucket granularity)")
+        self.prefill_chunk = prefill_chunk
+        self._chunking = {}   # slot -> mid-chunked-prefill progress
+        self._fm_cross = None   # lazy cross-K/V net (attach + chunks)
         self._pool_len = self.max_len + (spec_k or 0)
         # the composable pool layers (serving/layers.py): cache layout
         # x placement x stepper — every program body lives there, the
@@ -984,10 +1100,26 @@ class ServingEngine(_EngineBase):
         return {"tenant_slots": self._tenant_slot_counts()}
 
     def _evict(self, s):
+        self._chunking.pop(s, None)
+        self._pending.discard(s)
         row = int(self._adapter_rows[s])
         if row:
             self._adapter_rows[s] = 0
             self._release_adapter_row(row)
+
+    # ---- the cross-attention K/V net (attach + chunk families) ----
+    def _ensure_cross(self):
+        """Lazily build the functionalized 'memory -> per-layer cross
+        K/V' net the prefix-attach and chunked-prefill program
+        families run (they never run a self-attention prefill, but
+        the joiner's own cross K/V is per-request compute)."""
+        if self._fm_cross is None:
+            self._fm_cross = _make_cross_kv_fm(self._net.decoder)
+
+    def _cross_params(self):
+        """Cross-attention K/V net params for the attach/chunk paths
+        (the sharded engine overrides with its mesh-placed copy)."""
+        return self._fm_cross.params()
 
     def _params(self):
         """Param pytree the compiled programs run over. The sharded
@@ -1096,6 +1228,19 @@ class ServingEngine(_EngineBase):
                 n_params, 1, Tb, n_layers, heads, hd, mem_len=M)
             return {"flops": flops, "bytes_accessed": w + pool,
                     "argument_bytes": w + pool}
+        if kind == "cjoin" and len(key) > 1:
+            # one chunk: Cb query rows through the net
+            Cb = int(key[1])
+            flops = _costs.transformer_prefill_flops(
+                n_params, 1, Cb, n_layers, heads, hd, mem_len=M)
+            return {"flops": flops, "bytes_accessed": w + pool,
+                    "argument_bytes": w + pool}
+        if kind == "pcjoin" and len(key) > 2:
+            Cb = int(key[2])
+            flops = _costs.transformer_prefill_flops(
+                n_params, 1, Cb, n_layers, heads, hd, mem_len=M)
+            return {"flops": flops, "bytes_accessed": w + pool,
+                    "argument_bytes": w + pool}
         if kind in ("attach", "cow", "splice"):
             # row splices / page copies: byte traffic, ~no matmul flops
             return {"flops": 0.0, "bytes_accessed": pool,
@@ -1178,6 +1323,8 @@ class ServingEngine(_EngineBase):
         prompt_b, P0, Pb = pad_prompt_row(r.prompt, pad_id)
         if r._trace is not None:
             _rt.on_join_attr(r, prompt_bucket=Pb)
+        if self.prefill_chunk is not None and P0 > self.prefill_chunk:
+            return self._chunk_begin(s, r, prompt_b, P0, Pb, row)
         fn = self._program(("join", Pb), lambda: self._build_join(Pb))
         try:
             self._state, tok0 = fn(
@@ -1199,6 +1346,132 @@ class ServingEngine(_EngineBase):
         key = self.layout.join_key(Pb)
         return self.placement.build(key, self.layout.join_body(Pb),
                                     has_aux=True)
+
+    # ---- chunked prefill (the cjoin/pcjoin program family) ----
+    def _chunk_begin(self, s, r, prompt_b, P0, Pb, row):
+        """Register the slot as mid-chunked-prefill: NO program runs
+        at join time — run_iteration's _advance_chunks dispatches one
+        chunk per iteration, interleaved with decode steps. The slot
+        sits in `_pending` (occupied for admission, excluded from the
+        decode-step active mask) until the final chunk delivers its
+        token 0. `info["pos"]` is the next prompt position to write:
+        it advances only AFTER a chunk dispatch succeeds, so the
+        guarded retry loop re-runs the SAME chunk (the splice is
+        position-idempotent)."""
+        self._ensure_cross()
+        self._adapter_rows[s] = row
+        self._chunking[s] = {"r": r, "prompt_b": prompt_b, "P0": P0,
+                             "Pb": Pb, "pos": 0}
+        self._pending.add(s)
+        self.metrics.record_chunked_join()
+        return None   # token 0 arrives with the final chunk
+
+    def _chunk_bucket(self, pos, P0):
+        """(Cb, final?) for the chunk starting at `pos`: full
+        `prefill_chunk` mid-prompt, the tail's power-of-two bucket
+        (>= 2) for the final chunk. Never crosses Pb: the final
+        bucket is <= prefill_chunk, which divides every prompt bucket
+        this path serves (chunking requires P0 > prefill_chunk)."""
+        chunk = self.prefill_chunk
+        if pos + chunk < P0:
+            return chunk, False
+        return max(2, bucket_size(P0 - pos)), True
+
+    def _advance_chunks(self, now):
+        if not self._chunking:
+            return False
+        progress = False
+        for s in sorted(self._chunking):
+            info = self._chunking.get(s)
+            r = info["r"] if info is not None else None
+            if r is None or self.slots[s] is not r or \
+                    r.state == "DONE":
+                continue   # harvested between registration and now
+            _ts0 = (time.perf_counter()
+                    if _trace._SESSION is not None else 0.0)
+            try:
+                tok0 = self._guarded(
+                    "prefill_chunk",
+                    lambda s=s, info=info: self._chunk_attempt(s, info))
+            except Exception as e:
+                # per-request isolation, mirroring the join failure
+                # path: the failed chunk kills THIS request's future
+                # and frees the slot; the pool keeps serving
+                self.slots[s] = None
+                self._evict(s)
+                r.slot = None
+                self.metrics.record_error("prefill_chunk", e)
+                r.fail(e, self.clock())
+                self.metrics.record_finish("error", len(r.tokens))
+                self._cbs.emit("on_finish", r)
+                progress = True
+                if not self._carry_alive():
+                    self._fail_active(e)
+                    break
+                continue
+            progress = True
+            done = info["pos"] >= info["P0"]
+            self.metrics.record_chunk()
+            if r._trace is not None:
+                _rt.on_chunk(r, _ts0, time.perf_counter(),
+                             info["pos"], done)
+            if done:
+                self._chunking.pop(s, None)
+                self._pending.discard(s)
+                self._chunk_finalize(s, info)
+                self._deliver(r, int(tok0), self.clock())
+        return progress
+
+    def _chunk_attempt(self, s, info):
+        _PT_CHUNK()
+        if not self._carry_alive():
+            raise PoolCarryLost(
+                "pool carry consumed by a failed dispatch with no "
+                "replacement state — refusing to run a prefill chunk "
+                "on dead buffers")
+        return self._chunk_step(s, info)
+
+    def _chunk_step(self, s, info):
+        import jax.numpy as jnp
+
+        r = info["r"]
+        P0, Pb, pos = info["P0"], info["Pb"], info["pos"]
+        Cb, _ = self._chunk_bucket(pos, P0)
+        rows = info["prompt_b"][:, pos:pos + Cb]
+        fn = self._program(("cjoin", Cb),
+                           lambda: self._build_cjoin(Cb))
+        self._state, tok0 = fn(
+            self._params(), self._buffers(), self._cross_params(),
+            self._fm_cross.buffers(), self._state, jnp.int32(s),
+            jnp.asarray(rows), jnp.int32(pos),
+            jnp.asarray([P0], jnp.int32), jnp.int32(Pb),
+            jnp.asarray(np.asarray(r.memory, self._np_dtype)[None]),
+            *self._attach_spec_rows(info["prompt_b"], Pb),
+            *self._join_adapter_args(int(self._adapter_rows[s])))
+        info["pos"] = pos + Cb
+        return tok0
+
+    def _chunk_finalize(self, s, info):
+        """Host bookkeeping once the final chunk ran (the paged
+        engine maps the tail pages into the radix trie and COWs a
+        shared tail page here; the dense pool's splice already set
+        the slot's write index to Pb)."""
+
+    def _build_cjoin(self, Cb):
+        return self.placement.build(
+            ("cjoin", Cb), self.layout.cjoin_body(Cb), has_aux=True)
+
+    def _attach_spec_rows(self, prompt_b, Pb):
+        """Spec-pool splice rows for the attach/chunk families: the
+        slot's draft history is the PROMPT (the n-gram draft proposes
+        from it), padded to the pool row. () when spec is off."""
+        if not self.spec_k:
+            return ()
+        import jax.numpy as jnp
+
+        row = np.zeros((1, self._pool_len), np.int32)
+        row[0, :Pb] = np.asarray(prompt_b[0], np.int32)
+        return (jnp.asarray(row),)
 
     def _reset_pool(self):
         # dropped wholesale: the next join's _ensure_state rebuilds a
@@ -1361,6 +1634,21 @@ class ServingEngine(_EngineBase):
             progs.append((
                 skey, lambda skey=skey: self._build_step(skey),
                 (params, buffers, state) + sad + (active,)))
+        if self.prefill_chunk:
+            # bucket-length prompts chunk in full-size chunks only
+            # (prefill_chunk divides every bucket it splits), so ONE
+            # cjoin program covers the precompile surface; ragged
+            # final chunks compile their smaller bucket on demand
+            self._ensure_cross()
+            spec_rows = ((jnp.zeros((1, self._pool_len), jnp.int32),)
+                         if self.spec_k else ())
+            Cb = self.prefill_chunk
+            progs.append((
+                ("cjoin", Cb), lambda Cb=Cb: self._build_cjoin(Cb),
+                (params, buffers, self._cross_params(),
+                 self._fm_cross.buffers(), state, jnp.int32(0),
+                 jnp.zeros((1, Cb), jnp.int32), jnp.int32(0), one,
+                 jnp.int32(2 * Cb), mem1) + spec_rows + jad))
         return progs
 
 
@@ -1432,6 +1720,13 @@ class PagedServingEngine(ServingEngine):
         super().__init__(decoder, embed, project, num_slots=num_slots,
                          max_len=max_len, **kw)
         self.page_size = page_size
+        if self.prefill_chunk is not None and \
+                self.prefill_chunk % page_size:
+            raise ValueError(
+                f"prefill_chunk={self.prefill_chunk} must be a "
+                f"multiple of page_size={page_size}: chunk frontiers "
+                f"must be page-aligned so every finished chunk is a "
+                f"radix-trie-insertable run of full pages")
         # a speculative pool writes up to spec_k tokens past a row's
         # admitted budget before rolling back — round the logical pool
         # length (and the table width) up to page-cover that overhang;
@@ -1471,7 +1766,6 @@ class PagedServingEngine(ServingEngine):
         # not-yet-mapped remainder from the free-page headroom so
         # reserve_decode_frac=1.0 is a no-OOM guarantee
         self._slot_pages_total = np.zeros(self.num_slots, np.int64)
-        self._fm_cross = None
         self._page_bytes = None
         self._pool_total_bytes = None  # ledger cache (watermark path)
         self._prefix_params = None   # param identity the cache is
@@ -1479,11 +1773,6 @@ class PagedServingEngine(ServingEngine):
         self.prefill_count = 0   # real prefills run (prefix hits skip)
 
     # ------------------------------------------------------------------
-    def _cross_params(self):
-        """Cross-attention K/V net params for the prefix-attach path
-        (the sharded engine overrides with its mesh-placed copy)."""
-        return self._fm_cross.params()
-
     def _max_len_detail(self):
         return (f" (= {self.max_pages} pages x {self.page_size} "
                 f"tokens, paged)")
@@ -1772,9 +2061,22 @@ class PagedServingEngine(ServingEngine):
                     matched_tokens=matched)
         if res is not None and res[0] == "whole":
             return self._attach_shared(s, r, res[1], prompt_b, P0, Pb)
+        chunk = self.prefill_chunk
         if res is not None:
-            return self._pattach_join(s, r, res[1], prompt_b, P0, Pb,
+            match = res[1]
+            if chunk is not None and \
+                    P0 - len(match["pages"]) * self.page_size > chunk:
+                # long divergent tail: resume from the matched FULL
+                # pages only (round-down — the mid-page j tokens
+                # re-prefill inside the first chunk, trading a few
+                # tokens of reuse for a page-aligned chunk frontier)
+                # and chunk the rest instead of one huge pattach
+                return self._chunk_begin(s, r, prompt_b, P0, Pb, row,
+                                         matched=match["pages"])
+            return self._pattach_join(s, r, match, prompt_b, P0, Pb,
                                       row)
+        if chunk is not None and P0 > chunk:
+            return self._chunk_begin(s, r, prompt_b, P0, Pb, row)
         return self._prefill_join(s, r, prompt_b, P0, Pb, row)
 
     def _prefill_join(self, s, r, prompt_b, P0, Pb, row=0):
@@ -1825,8 +2127,7 @@ class PagedServingEngine(ServingEngine):
         j = int(match["j"])
         seed_len = m * psz + j
         n_pp = pages_for(Pb, psz)
-        if self._fm_cross is None:
-            self._fm_cross = _make_cross_kv_fm(self._net.decoder)
+        self._ensure_cross()
         self._alloc.incref(matched)
         owned = []       # pages THIS join allocated (released on fail)
         try:
@@ -1887,18 +2188,6 @@ class PagedServingEngine(ServingEngine):
         self._cow_tail(s, Pb)
         return tok0
 
-    def _attach_spec_rows(self, prompt_b, Pb):
-        """The spec history row an attach splices: the padded prompt
-        pre-padded host-side to the FULL pool length, so the attach
-        program stays one compile for every bucket."""
-        if not self.spec_k:
-            return ()
-        row = np.zeros((1, self._pool_len), np.int32)
-        row[0, :Pb] = np.asarray(prompt_b[0], np.int32)
-        import jax.numpy as jnp
-
-        return (jnp.asarray(row),)
-
     def _attach_shared(self, s, r, hit, prompt_b, P0, Pb):
         """Prefix-cache hit: map the shared prompt pages read-only and
         splice only the per-request rows (bias hole, memory, cross-attn
@@ -1910,8 +2199,7 @@ class PagedServingEngine(ServingEngine):
 
         pages = hit["pages"]
         self._alloc.incref(pages)
-        if self._fm_cross is None:
-            self._fm_cross = _make_cross_kv_fm(self._net.decoder)
+        self._ensure_cross()
         fn = self._program(("attach",), self._build_attach)
         try:
             self._state = fn(
@@ -1953,6 +2241,146 @@ class PagedServingEngine(ServingEngine):
         self._alloc.decref([src])
         self._table[s, pi] = dst
         self.metrics.record_cow_copy()
+
+    # ---- chunked prefill over pages (the pcjoin program) ----
+    def _chunk_begin(self, s, r, prompt_b, P0, Pb, row, matched=()):
+        """Paged chunk registration: matched full prefix pages (a
+        radix partial hit rounded DOWN to the page boundary) map
+        read-only up front and seed the chunk frontier; the chunks
+        prefill only the divergent tail, page by page. The host index
+        tracks the frontier mid-prompt — safe because pending slots
+        are excluded from both the decode active mask and the
+        on-demand page mapper, and the steps' masked garbage writes
+        land at/past the frontier, where the next chunk (or the
+        slot's own first decode write) overwrites them before any
+        read."""
+        self._ensure_cross()
+        matched = [int(p) for p in matched]
+        if matched:
+            self._alloc.incref(matched)
+            self._table[s, :len(matched)] = matched
+        pos = len(matched) * self.page_size
+        self._index[s] = pos
+        self._adapter_rows[s] = row
+        self._chunking[s] = {"r": r, "prompt_b": prompt_b, "P0": P0,
+                             "Pb": Pb, "pos": pos}
+        self._pending.add(s)
+        self.metrics.record_chunked_join()
+        return None   # token 0 arrives with the final chunk
+
+    def _chunk_step(self, s, info):
+        import jax.numpy as jnp
+
+        r = info["r"]
+        P0, Pb, pos = info["P0"], info["Pb"], info["pos"]
+        psz = self.page_size
+        Cb, final = self._chunk_bucket(pos, P0)
+        end = pos + Cb
+        n_have = pos // psz       # chunk frontiers are page-aligned
+        n_need = pages_for(end, psz) - n_have
+        fresh = self._alloc_pages(n_need) if n_need > 0 else []
+        Mb = bucket_size(n_have, minimum=1)
+        W = min(self.max_pages, Mb + pages_for(Cb, psz))
+        trow = np.full((1, W), self.num_pages, np.int32)
+        pages_now = [int(p) for p in self._table[s, :n_have]] + fresh
+        k = min(W, len(pages_now))
+        trow[0, :k] = pages_now[:k]
+        rows = info["prompt_b"][:, pos:end]
+        fn = self._program(("pcjoin", Mb, Cb),
+                           lambda: self._build_pcjoin(Mb, Cb))
+        try:
+            self._state, tok0 = fn(
+                self._params(), self._buffers(), self._cross_params(),
+                self._fm_cross.buffers(), self._state, jnp.int32(s),
+                jnp.asarray(trow), jnp.asarray(rows), jnp.int32(pos),
+                jnp.asarray([P0], jnp.int32), jnp.int32(Pb),
+                jnp.asarray(np.asarray(r.memory, self._np_dtype)[None]),
+                *self._attach_spec_rows(info["prompt_b"], Pb),
+                *self._join_adapter_args(int(self._adapter_rows[s])))
+        except Exception:
+            if fresh:
+                self._alloc.decref(fresh)
+            raise
+        if fresh:
+            self._table[s, n_have:n_have + len(fresh)] = fresh
+        # mid-chunk the frontier sits mid-PROMPT; the final chunk
+        # graduates the index to Pb so decode starts past the hole
+        self._index[s] = Pb if final else end
+        info["pos"] = end
+        if final:
+            info["tok0"] = tok0
+        elif self._prefix is not None:
+            # the PR-16 follow-up: every finished chunk extends the
+            # request's radix-trie prefix by its full pages, so the
+            # work survives a later slot failure (and co-arrivals
+            # partial-match the growing prefix immediately)
+            self._prefix.insert_prefix(
+                info["prompt_b"][0, :end], r.memory,
+                self._tenant_key(r),
+                [int(p) for p in self._table[s, :end // psz]])
+        return tok0
+
+    def _chunk_finalize(self, s, info):
+        r, P0, Pb = info["r"], info["P0"], info["Pb"]
+        self.prefill_count += 1
+        if self._prefix is not None:
+            pages = [int(p) for p in self._table[s] if p >= 0]
+            self._prefix.insert(
+                info["prompt_b"][0, :P0], P0, Pb, r.memory,
+                self._tenant_key(r), pages, info["tok0"])
+        self._cow_tail(s, Pb)
+
+    def _build_pcjoin(self, Mb, Cb):
+        return self.placement.build(
+            ("pcjoin", Mb, Cb), self.layout.pcjoin_body(Mb, Cb),
+            has_aux=True)
+
+    # ---- fairness-aware preemption: evict to the prefix cache ----
+    def can_preempt(self, s):
+        """Mechanics-only eligibility (class policy lives in the
+        shaper): a RUNNING slot, not mid-chunk, with token 0 already
+        out — its prompt K/V pages are complete, which is what the
+        evict-to-trie resume contract parks — on a pool that HAS a
+        prefix cache to park them in."""
+        r = self.slots[s]
+        return (self._prefix is not None and r is not None and
+                s not in self._pending and r.state == "RUNNING" and
+                len(r.tokens) >= 1)
+
+    def preempt_slot(self, s, now):
+        if not self.can_preempt(s):
+            return None
+        r = self.slots[s]
+        _PT_PREEMPT()   # host-side, BEFORE any mutation: an injected
+        #                 fault aborts the preemption with the slot,
+        #                 its pages, and the queue all untouched
+        pad_id = int(r.eos_id) if r.eos_id is not None else 0
+        prompt_b, P0, Pb = pad_prompt_row(r.prompt, pad_id)
+        pages = []
+        for p in self._table[s, :pages_for(Pb, self.page_size)]:
+            if p < 0:
+                break
+            pages.append(int(p))
+        # park the prompt K/V in the radix trie (an existing terminal
+        # just refreshes its tick; a new one increfs the pages), THEN
+        # release the slot: the pages survive via the trie's refs and
+        # the resume join rides a zero-FLOP whole-prefix attach
+        self._prefix.insert(prompt_b[0, :P0], P0, Pb, r.memory,
+                            self._tenant_key(r), pages,
+                            int(r.tokens[0]))
+        if r._trace is not None:
+            _rt.on_preempt(r, s, len(r.tokens))
+        self.slots[s] = None
+        self._evict(s)
+        r.slot = None
+        r.state = "QUEUED"
+        # greedy decode is deterministic, so the resumed slot re-emits
+        # the tokens the caller already holds bit-identically;
+        # _deliver absorbs exactly this many silently
+        r._replay = len(r.tokens)
+        r._preemptions += 1
+        self.metrics.record_preemption()
+        return r
 
     # ---- compiled programs (bodies live in layers.PagedLayout) ----
     def _build_paged_join(self, Pb):
@@ -2015,8 +2443,7 @@ class PagedServingEngine(ServingEngine):
                  jnp.zeros((1, Pb), jnp.int32), one, mem1,
                  jnp.zeros((n_pp,), jnp.int32)) + jad))
         if self._prefix is not None:
-            if self._fm_cross is None:
-                self._fm_cross = _make_cross_kv_fm(self._net.decoder)
+            self._ensure_cross()
             spec_rows = ((jnp.zeros((1, self._pool_len), jnp.int32),)
                          if self.spec_k else ())
             progs.append((
@@ -2048,6 +2475,30 @@ class PagedServingEngine(ServingEngine):
                          jnp.full((1, W), self.num_pages, jnp.int32),
                          jnp.zeros((1, Tb), jnp.int32), jnp.int32(1),
                          one, jnp.int32(Tb), mem1) + spec_rows + jad))
+        if self.prefill_chunk:
+            # bucket-length prompts chunk in full-size chunks only,
+            # so the precompile surface is one pcjoin per matched-page
+            # bucket Mb the chunk walk visits; ragged final chunks
+            # compile their smaller bucket on demand
+            self._ensure_cross()
+            crows = ((jnp.zeros((1, self._pool_len), jnp.int32),)
+                     if self.spec_k else ())
+            psz = self.page_size
+            Cb = self.prefill_chunk
+            mbs = set()
+            for Pb in {bucket_size(int(p)) for p in prompt_buckets}:
+                for pos in range(0, Pb, Cb) if Pb > Cb else ():
+                    mbs.add(bucket_size(pos // psz, minimum=1))
+            for Mb in sorted(mbs):
+                W = min(self.max_pages, Mb + pages_for(Cb, psz))
+                progs.append((
+                    ("pcjoin", Mb, Cb),
+                    lambda Mb=Mb, Cb=Cb: self._build_pcjoin(Mb, Cb),
+                    (params, buffers, self._cross_params(),
+                     self._fm_cross.buffers(), state, jnp.int32(0),
+                     jnp.full((1, W), self.num_pages, jnp.int32),
+                     jnp.zeros((1, Cb), jnp.int32), jnp.int32(0),
+                     one, jnp.int32(2 * Cb), mem1) + crows + jad))
         if self.spec_k:
             dkey = ("draft",) + self._pool_key
             progs.append((

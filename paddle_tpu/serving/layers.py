@@ -280,6 +280,99 @@ class DenseLayout(CacheLayout):
 
         return join_fn
 
+    # ---- the chunked-prefill program (verify-mode chunk append) ----
+    def cjoin_body(self, Cb):
+        """Prefill ONE Cb-token chunk of a prompt straight into the
+        slot's pool rows: a batch-1 view of the slot's K/V runs the
+        chunk through the verify-mode attention path (multi-token
+        write at [seed, seed + Cb), causal read over everything the
+        earlier chunks wrote), then splices the view row back — decode
+        steps interleave between chunks, so a long prompt never stalls
+        co-resident decodes longer than one chunk. One compile per
+        CHUNK bucket, never per prompt: seed, true prompt length, and
+        the prompt bucket all ride in as traced scalars. Every splice
+        is computed from the TRUE final (length, Pb) — re-running a
+        chunk is idempotent — and the tok0 lane is CLAMPED into the
+        chunk, so only the final chunk's tok0 is meaningful (the host
+        ignores the rest). Stale previous-occupant K/V past the chunk
+        end is causal-masked until a later chunk or decode write
+        replaces it, and the eos-padded tail of the final chunk lands
+        inside the [length, Pb) hole the bias row masks forever."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..nn.layer.transformer import MultiHeadAttention as MHA
+        from ..ops import attention as A
+
+        eng = self.eng
+        fm = eng._fm
+        fm_cross = eng._fm_cross
+        L = eng._pool_len
+        spec = bool(eng.spec_k)
+        ck = ("cjoin", Cb)
+        neg = eng._neg
+
+        def cjoin_fn(params, buffers, cparams, cbuffers, state, slot,
+                     chunk, seed, length, pb, memory, *rest):
+            eng.trace_counts[ck] += 1  # one per trace = one compile
+            if spec:
+                (hist_row,), ad = rest[:1], rest[1:]
+            else:
+                hist_row, ad = None, rest
+            static1, _ = fm_cross.apply(cparams, cbuffers, None,
+                                        memory, training=False)
+            kpos = jnp.arange(L, dtype=jnp.int32)
+            hole = (kpos[None, :] >= length[:, None]) & \
+                (kpos[None, :] < pb)
+            bias_row = jnp.where(hole, jnp.float32(neg),
+                                 jnp.float32(0.0))           # [1, L]
+            # batch-1 view of the slot's rows: the verify-scope write
+            # lands the chunk K/V at [seed, seed + Cb) and the causal
+            # read sees the earlier chunks already in the row
+            inc = [MHA.StaticKVCache(
+                jax.lax.dynamic_slice_in_dim(c.k, slot, 1, axis=0),
+                jax.lax.dynamic_slice_in_dim(c.v, slot, 1, axis=0),
+                seed.reshape(1)) for c in state["inc"]]
+            posn = seed + jnp.arange(Cb, dtype=jnp.int32)[None]
+            with A.kv_verify_scope(), eng._lora_ctx(ad):
+                (lg, inc2), _ = fm.apply(
+                    params, buffers, None, chunk, posn, memory,
+                    training=False, tgt_mask=bias_row,
+                    memory_mask=None, inc=inc, static_kv=static1,
+                    prefill=False)
+            # the LAST REAL prompt position sits at chunk lane
+            # (length - 1 - seed) on the final chunk only; clamp keeps
+            # mid-chunk dispatches in-bounds (their tok0 is discarded)
+            lane = jnp.clip(length - 1 - seed, 0, Cb - 1)
+            last = jnp.take_along_axis(lg, lane[:, None, None],
+                                       axis=1)[:, 0]
+            tok0 = last.argmax(-1).astype(jnp.int32)[0]
+            new_inc = [MHA.static_kv_splice(pool, slot, c.k, c.v, pb)
+                       for pool, c in zip(state["inc"], inc2)]
+            new_static = [(MHA.splice_rows(pk, slot, sk),
+                           MHA.splice_rows(pv, slot, sv))
+                          for (pk, pv), (sk, sv) in zip(state["static"],
+                                                        static1)]
+            out = dict(
+                state,
+                tok=jax.lax.dynamic_update_slice(
+                    state["tok"], tok0[None], (slot,)),
+                bias=MHA.splice_rows(state["bias"], slot, bias_row),
+                mem=MHA.splice_rows(state["mem"], slot, memory),
+                inc=new_inc,
+                static=new_static)
+            if spec:
+                out["hist"] = MHA.splice_rows(state["hist"], slot,
+                                              hist_row)
+                out["plen"] = jax.lax.dynamic_update_slice(
+                    state["plen"], length.astype(jnp.int32), (slot,))
+                out["pbk"] = jax.lax.dynamic_update_slice(
+                    state["pbk"], pb.reshape(1).astype(jnp.int32),
+                    (slot,))
+            return out, tok0
+
+        return cjoin_fn
+
     # ---- the plain batched decode step ----
     def step_body(self, key):
         import jax.numpy as jnp
@@ -396,8 +489,12 @@ class PagedLayout(CacheLayout):
         # partial tokens (the pool itself keeps serving). Speculative
         # steps write the FULL fixed-k block (force-rejected tail
         # included), so every page the block touches must be mapped.
+        # Pending slots (mid chunked-prefill) are skipped: their index
+        # sits mid-PROMPT, the pages there are the chunk programs' to
+        # map, and a dry pool must never OOM-evict a half-prefilled
+        # slot on a decode step it does not even participate in.
         for s, r in enumerate(list(eng.slots)):
-            if r is None:
+            if r is None or s in eng._pending:
                 continue
             i0 = int(eng._index[s])
             for pi in range(i0 // psz, (i0 + width - 1) // psz + 1):
@@ -692,6 +789,96 @@ class PagedLayout(CacheLayout):
             return out, tok0
 
         return pattach_fn
+
+    # ---- the chunked-prefill program (verify-mode chunk append) ----
+    def pcjoin_body(self, Mb, Cb):
+        """Prefill ONE Cb-token chunk of a prompt into the slot's
+        pages: like `pattach_body` the chunk runs as a verify-mode
+        block through a WIDTH-CLIPPED table row ([1, Mb +
+        pages_for(Cb)]) — `write_tokens` lands the chunk K/V at the
+        seed boundary and the verify read gathers only the pages the
+        chunk can see, so attention cost scales with the SEED, not the
+        pool. One compile per (seed-pages bucket, chunk bucket) pair,
+        never per prompt: seed, slot, true length, and bucket are
+        traced scalars. The trie-matched seed of a radix PARTIAL hit
+        rides the same program (seed pages mapped read-only into the
+        clipped row), so a chunk extends the matched node chunk by
+        chunk. tok0's lane is CLAMPED into the chunk: only the final
+        chunk's value is read by the host; every splice is computed
+        from the TRUE final (length, Pb), so chunks are idempotent."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..nn.layer.transformer import MultiHeadAttention as MHA
+        from ..ops import attention as A
+        from . import paging as PG
+
+        eng = self.eng
+        fm = eng._fm
+        fm_cross = eng._fm_cross
+        L = eng._pool_len
+        psz = eng.page_size
+        W = min(eng.max_pages, int(Mb) + PG.pages_for(Cb, psz))
+        spec = bool(eng.spec_k)
+        ck = ("pcjoin", Mb, Cb)
+        neg = eng._neg
+
+        def pcjoin_fn(params, buffers, cparams, cbuffers, state, slot,
+                      trow, chunk, seed, length, pb, memory, *rest):
+            eng.trace_counts[ck] += 1  # one per trace = one compile
+            if spec:
+                (hist_row,), ad = rest[:1], rest[1:]
+            else:
+                hist_row, ad = None, rest
+            static1, _ = fm_cross.apply(cparams, cbuffers, None,
+                                        memory, training=False)
+            kpos = jnp.arange(L, dtype=jnp.int32)
+            hole = (kpos[None, :] >= length[:, None]) & \
+                (kpos[None, :] < pb)
+            bias_row = jnp.where(hole, jnp.float32(neg),
+                                 jnp.float32(0.0))           # [1, L]
+            inc = [PG.PagedKVCache(pc["k"], pc["v"], pc["ks"],
+                                   pc["vs"], trow, seed.reshape(1))
+                   for pc in state["paged"]]
+            posn = seed + jnp.arange(Cb, dtype=jnp.int32)[None]
+            with A.kv_verify_scope(), eng._lora_ctx(ad):
+                (lg, inc2), _ = fm.apply(
+                    params, buffers, None, chunk, posn, memory,
+                    training=False, tgt_mask=bias_row[:, :W * psz],
+                    memory_mask=None, inc=inc, static_kv=static1,
+                    prefill=False)
+            # the LAST REAL prompt position sits at chunk lane
+            # (length - 1 - seed) on the final chunk only; clamp keeps
+            # mid-chunk dispatches in-bounds (their tok0 is discarded)
+            lane = jnp.clip(length - 1 - seed, 0, Cb - 1)
+            last = jnp.take_along_axis(lg, lane[:, None, None],
+                                       axis=1)[:, 0]
+            tok0 = last.argmax(-1).astype(jnp.int32)[0]
+            new_paged = [{"k": c.k, "v": c.v, "ks": c.k_scale,
+                          "vs": c.v_scale} for c in inc2]
+            new_static = [(MHA.splice_rows(pk, slot, sk),
+                           MHA.splice_rows(pv, slot, sv))
+                          for (pk, pv), (sk, sv) in zip(state["static"],
+                                                        static1)]
+            out = dict(
+                state,
+                tok=jax.lax.dynamic_update_slice(
+                    state["tok"], tok0[None], (slot,)),
+                bias=MHA.splice_rows(state["bias"], slot, bias_row),
+                mem=MHA.splice_rows(state["mem"], slot, memory),
+                static=new_static,
+                paged=new_paged)
+            if spec:
+                out["hist"] = MHA.splice_rows(state["hist"], slot,
+                                              hist_row)
+                out["plen"] = jax.lax.dynamic_update_slice(
+                    state["plen"], length.astype(jnp.int32), (slot,))
+                out["pbk"] = jax.lax.dynamic_update_slice(
+                    state["pbk"], pb.reshape(1).astype(jnp.int32),
+                    (slot,))
+            return out, tok0
+
+        return pcjoin_fn
 
     # ---- the plain batched decode step (through the page table) ----
     def step_body(self, ck):

@@ -221,13 +221,41 @@ SNAPSHOT_DOCS = {
     "cold_start.first_ttft_ms": (
         "gauge", "TTFT of the very first request after start (the "
                  "number warm vs cold starts A/B)"),
+    # traffic shaping (PR 19) — the section appears once a shaping
+    # feature records: chunked prefill, preemption/resume, SLO-classed
+    # finishes, or WFQ lag published by the ShapingScheduler
+    "slo.preemptions": (
+        "counter", "batch-class slots evicted to the prefix cache "
+                   "under pressure"),
+    "slo.resumes": (
+        "counter", "preempted requests re-admitted (resume rides the "
+                   "prefix cache, not a re-prefill)"),
+    "slo.replay_tokens": (
+        "counter", "already-delivered tokens a resumed request "
+                   "re-absorbed silently"),
+    "slo.chunked_prefills": (
+        "counter", "joins split into chunked prefill (prompt past the "
+                   "prefill_chunk knob)"),
+    "slo.chunks": (
+        "counter", "prefill chunks dispatched between decode steps"),
+    "slo.ttft_attainment": (
+        "info", "per-class fraction of finished requests that met "
+                "their TTFT target"),
+    "slo.tpot_attainment": (
+        "info", "per-class fraction of finished requests that met "
+                "their TPOT target"),
+    "slo.wfq_lag_by_tenant": (
+        "info", "per-tenant WFQ virtual-time lag (pending finish tag "
+                "minus pool virtual time; 0 = keeping pace)"),
 }
 
 _SUMMARY_KEYS = {"n", "mean", "p50", "p99", "max"}
 _LEAF_DICTS = {"errors.last", "mfu.device",
                "speculation.step_ms_by_variant",
                "tenancy.active_slots_by_tenant",
-               "tenancy.tokens_by_tenant"}
+               "tenancy.tokens_by_tenant",
+               "slo.ttft_attainment", "slo.tpot_attainment",
+               "slo.wfq_lag_by_tenant"}
 
 
 def flatten_snapshot(snap, _prefix=""):
@@ -350,6 +378,20 @@ class ServingMetrics:
     def __init__(self, clock=time.monotonic):
         self._clock = clock
         self._lock = threading.Lock()
+        # identity wiring that reset() keeps: the ledger provider and
+        # its armed budget describe the ENGINE, not a measurement epoch
+        self._memory_provider = None
+        self.budget_bytes = 0
+        self.watermark_frac = 0.9
+        self._init_counters()
+
+    # callers hold the lock: __init__ (exempt by construction) and
+    # reset() (wraps the call in `with self._lock:`)
+    def _init_counters(self):   # analysis: single-threaded
+        """(Re)zero every counter, gauge and reservoir. Split out of
+        __init__ so reset() can start a fresh measurement epoch without
+        touching identity wiring (clock, lock, ledger provider,
+        budget)."""
         self.submitted = 0
         self.completed = 0          # finished with "eos" / "length"
         self.rejected = 0           # backpressure (QueueFull)
@@ -415,9 +457,6 @@ class ServingMetrics:
         # "memory" section. budget_bytes arms the watermark: crossing
         # watermark_frac * budget bumps watermark_warnings ONCE per
         # excursion (warn before OutOfPages/OOM, not after).
-        self._memory_provider = None
-        self.budget_bytes = 0
-        self.watermark_frac = 0.9
         self.watermark_warnings = 0
         self._above_watermark = False
         # goodput accounting: token-denominated usefulness, classified
@@ -473,6 +512,26 @@ class ServingMetrics:
         self.bytes_per_step = 0.0
         self.mfu_util = _Reservoir(512)
         self.bw_util = _Reservoir(512)
+        # traffic shaping (PR 19): chunked-prefill and preemption
+        # counters plus per-SLO-class attainment and the WFQ lag the
+        # ShapingScheduler publishes each iteration — the snapshot
+        # grows an "slo" section once any of them records
+        self._slo = False
+        self.preemptions = 0
+        self.resumes = 0
+        self.replay_tokens = 0
+        self.chunked_prefills = 0
+        self.chunks = 0
+        self.slo_finishes = {}     # class -> {n, ttft_ok, tpot_ok}
+        self.wfq_lag = {}          # tenant -> virtual-time lag
+
+    def reset(self):
+        """Start a fresh measurement epoch: zero every counter,
+        reservoir and gauge while keeping identity wiring (clock, lock,
+        ledger provider, HBM budget). Benches and tests call this
+        between phases instead of zeroing individual fields by hand."""
+        with self._lock:
+            self._init_counters()
 
     # ---- recording (engine / frontend side) ----
     def record_submit(self):
@@ -643,6 +702,65 @@ class ServingMetrics:
             self._tenancy = True
             self.adapter_waits += 1
 
+    # ---- traffic shaping (PR 19) ----
+    def record_chunked_join(self):
+        """A join went chunked: the prompt exceeded prefill_chunk, so
+        its prefill will interleave with decode steps chunk by chunk."""
+        with self._lock:
+            self._slo = True
+            self.chunked_prefills += 1
+
+    def record_chunk(self):
+        """One prefill chunk dispatched between decode steps."""
+        with self._lock:
+            self._slo = True
+            self.chunks += 1
+
+    def record_preemption(self):
+        """A batch-class slot was evicted to the prefix cache to free
+        capacity for higher-priority work."""
+        with self._lock:
+            self._slo = True
+            self.preemptions += 1
+
+    def record_resume(self):
+        """A preempted request re-joined (resume rides the prefix
+        cache whole-hit attach — prefill_count proves no re-prefill)."""
+        with self._lock:
+            self._slo = True
+            self.resumes += 1
+
+    def record_replay_token(self):
+        """A resumed request re-produced an already-delivered token;
+        the engine absorbed it silently (no double delivery)."""
+        with self._lock:
+            self._slo = True
+            self.replay_tokens += 1
+
+    def record_slo_finish(self, name, ttft_s, tpot_s, ttft_target_s,
+                          tpot_target_s):
+        """An SLO-classed request completed: fold its TTFT/TPOT against
+        the class targets into the per-class attainment fractions."""
+        with self._lock:
+            self._slo = True
+            c = self.slo_finishes.setdefault(
+                name, {"n": 0, "ttft_ok": 0, "tpot_ok": 0})
+            c["n"] += 1
+            if float(ttft_s) <= float(ttft_target_s):
+                c["ttft_ok"] += 1
+            if float(tpot_s) <= float(tpot_target_s):
+                c["tpot_ok"] += 1
+
+    def set_wfq_lag(self, lag_by_tenant):
+        """The ShapingScheduler's per-tenant WFQ virtual-time lag at
+        the last iteration (pending finish tag minus pool virtual
+        time; 0 = the tenant is keeping pace with its weight)."""
+        with self._lock:
+            if lag_by_tenant:
+                self._slo = True
+            self.wfq_lag = {str(t): round(float(v), 4)
+                            for t, v in lag_by_tenant.items()}
+
     # ---- HBM ledger / MFU accounting (PR 9) ----
     def set_memory_provider(self, provider, budget_bytes=None,
                             watermark_frac=None):
@@ -671,6 +789,13 @@ class ServingMetrics:
                 self.watermark_warnings += 1
             self._above_watermark = above
         return above
+
+    def watermark_exceeded(self):
+        """True while the ledger last sat above the armed watermark —
+        the shaping scheduler's admission gate reads this to pause
+        batch-class admission while the pool nears its HBM budget."""
+        with self._lock:
+            return self._above_watermark
 
     def record_step_utilization(self, flops, bytes_accessed, dt_s,
                                 spec, source):
@@ -954,6 +1079,20 @@ class ServingMetrics:
                     "oom_evictions": self.oom_evictions,
                     "bytes_per_active_token":
                         self.bytes_per_token.summary(digits=1),
+                }}),
+                **({} if not self._slo else {"slo": {
+                    "preemptions": self.preemptions,
+                    "resumes": self.resumes,
+                    "replay_tokens": self.replay_tokens,
+                    "chunked_prefills": self.chunked_prefills,
+                    "chunks": self.chunks,
+                    "ttft_attainment": {
+                        n: round(c["ttft_ok"] / max(1, c["n"]), 4)
+                        for n, c in self.slo_finishes.items()},
+                    "tpot_attainment": {
+                        n: round(c["tpot_ok"] / max(1, c["n"]), 4)
+                        for n, c in self.slo_finishes.items()},
+                    "wfq_lag_by_tenant": dict(self.wfq_lag),
                 }}),
                 **({} if not self._prefix_recorded else {"prefix": {
                     "whole_hits": self.prefix_whole_hits,

@@ -567,6 +567,37 @@ class RadixPrefixCache:
             if not self._evict_one():
                 break
 
+    def insert_prefix(self, tokens, memory, tenant, pages):
+        """Extend the trie with FULL PAGES only — no terminal: the
+        chunked-prefill path calls this after every completed chunk,
+        so the pages a long prompt has prefilled SO FAR are already
+        partial-matchable (and survive the slot's failure) before the
+        final chunk lands the terminal via `insert`. `tokens` must be
+        a page-multiple prefix; extra tokens past `len(pages) *
+        page_size` are ignored. Existing nodes are refreshed, new ones
+        take their own page reference — identical adoption semantics
+        to `insert`'s full-page walk."""
+        psz = self.page_size
+        n_full = min(len(tokens) // psz, len(pages))
+        if n_full == 0:
+            return
+        tokens = tuple(int(t) for t in tokens)[:n_full * psz]
+        root = self._root_for(memory, tenant, create=True)
+        t = self._touch()
+        node = root
+        for i in range(n_full):
+            et = tokens[i * psz:(i + 1) * psz]
+            child = node.children.get(et)
+            if child is None:
+                page = int(pages[i])
+                self.allocator.incref([page])
+                child = _RadixNode(et, page, node)
+                node.children[et] = child
+                self._n_nodes += 1
+                self._n_pages += 1
+            child.tick = t
+            node = child
+
     # -- eviction --------------------------------------------------------
 
     def _iter_nodes(self):

@@ -81,7 +81,7 @@ class Request:
 
     def __init__(self, prompt, memory=None, *, max_new_tokens=32,
                  eos_id=1, deadline=None, stream_cb=None, spec=True,
-                 adapter=None):
+                 adapter=None, slo=None):
         prompt = np.asarray(prompt)
         if prompt.ndim != 1:
             raise ValueError(f"prompt must be 1-D [P], got "
@@ -105,6 +105,15 @@ class Request:
         # opt-out rides the same compiled program with bank row 0's
         # zero delta)
         self.adapter = adapter
+        # traffic shaping (serving.shaping): the request's SLO class —
+        # an SLOClass, or a class name the ShapingScheduler resolves at
+        # submit. None under the plain FIFO = no class semantics.
+        self.slo = slo
+        # preemption bookkeeping (paged engines only): tokens already
+        # delivered that a post-resume replay must re-absorb silently,
+        # and how many times this request has been preempted
+        self._replay = 0
+        self._preemptions = 0
         self.tokens = []              # generated so far (ints)
         self.state = "QUEUED"         # QUEUED -> RUNNING -> DONE
         self.finish_reason = None

@@ -42,12 +42,15 @@ class ServingServer:
     serve (admission pre-check)."""
 
     def __init__(self, engine, *, max_queue=64, clock=None,
-                 idle_wait_s=0.005, start=True):
+                 idle_wait_s=0.005, start=True, scheduler=None):
         self.engine = engine
         if clock is None:
             clock = engine.clock
         self.clock = clock
-        self.scheduler = Scheduler(max_queue=max_queue, clock=clock)
+        # a caller-built scheduler (e.g. ShapingScheduler with SLO
+        # classes / tenant weights) rides the same loop; default FIFO
+        self.scheduler = scheduler if scheduler is not None else \
+            Scheduler(max_queue=max_queue, clock=clock)
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._drained = threading.Event()
@@ -69,15 +72,17 @@ class ServingServer:
 
     def submit(self, prompt, memory=None, *, max_new_tokens=32,
                eos_id=1, deadline=None, timeout=None, stream_cb=None,
-               spec=True, adapter=None):
+               spec=True, adapter=None, slo=None):
         """Enqueue one generation request; returns the `Request` whose
         `.result()` blocks for a RequestResult and whose `.cancel()`
         withdraws it. `timeout` (seconds from now) is sugar for an
         absolute `deadline` on the engine clock. `adapter` names the
         registered tenant adapter to decode under (None = base model;
-        needs an engine with an AdapterPool). Raises QueueFull under
-        backpressure, RuntimeError after shutdown/drain began, and
-        ValueError for unservable requests."""
+        needs an engine with an AdapterPool). `slo` is the request's
+        SLO class (an `SLOClass` or a class name a `ShapingScheduler`
+        resolves at submit; ignored by the FIFO scheduler). Raises
+        QueueFull under backpressure, RuntimeError after shutdown/drain
+        began, and ValueError for unservable requests."""
         if self._dead:
             raise ServerCrashed(
                 f"server is dead ({self._crash_cause!r}); restart it")
@@ -85,7 +90,8 @@ class ServingServer:
             deadline = self.clock() + float(timeout)
         r = Request(prompt, memory, max_new_tokens=max_new_tokens,
                     eos_id=eos_id, deadline=deadline,
-                    stream_cb=stream_cb, spec=spec, adapter=adapter)
+                    stream_cb=stream_cb, spec=spec, adapter=adapter,
+                    slo=slo)
         self.engine.admit_check(r)   # fail fast, before queueing
         try:
             self.scheduler.submit(r)

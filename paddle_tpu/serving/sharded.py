@@ -589,6 +589,10 @@ class ShardedServingEngine(ServingEngine):
 
         ready = []
         for s in sorted(self._pending):
+            if s in self._chunking:
+                # mid chunked-prefill, not a disaggregated splice:
+                # _advance_chunks owns this slot's pending state
+                continue
             info = self._pending_info.get(s)
             r = self.slots[s]
             if info is None or r is None:   # evicted while pending

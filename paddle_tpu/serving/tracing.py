@@ -30,6 +30,11 @@ Span taxonomy (exported Chrome-trace names):
                   requests
   error           terminal instant: failed/evicted requests, with the
                   cause
+  prefill_chunk   one chunked-prefill dispatch interleaved between
+                  decode steps (attrs: pos — the post-chunk prompt
+                  frontier — and done on the final chunk)
+  preempt         instant: the slot was evicted to the prefix cache to
+                  free capacity (attrs: slot, tokens so far)
   decode.step     engine track: one batched decode step (attrs:
                   n_active, slots, occupancy, queue depth, page-pool
                   and shard gauges)
@@ -71,6 +76,10 @@ SPAN_TAXONOMY = (
                           "(kind, matched pages/tokens)"),
     ("pending_splice", "disaggregated prefill in flight -> spliced"),
     ("decode", "slot residency in batched decode steps"),
+    ("prefill_chunk", "one chunked-prefill dispatch interleaved "
+                      "between decode steps (pos, done)"),
+    ("preempt", "instant: slot evicted to the prefix cache for "
+                "higher-priority work"),
     ("first_token", "instant: first delivered token (TTFT)"),
     ("finish", "terminal instant: finish_reason"),
     ("error", "terminal instant: failure cause"),
@@ -198,6 +207,36 @@ def on_splice_end(r, ok=True, error=None):
     rt.tr.end(rt.splice, ok=ok, **attrs)
     if ok:
         _begin_decode(rt)
+
+
+def on_chunk(r, t0, t1, pos, done):
+    """One chunked-prefill dispatch for this request's slot ([t0, t1],
+    engine clock): `pos` is the POST-chunk prompt frontier, `done`
+    marks the final chunk (the join is complete and the slot decodes
+    from here on)."""
+    rt = r._trace
+    if rt is not None:
+        rt.tr.add_complete("prefill_chunk", t0, t1, cat="request",
+                           trace_id=rt.tid, parent=rt.root,
+                           attrs={"pos": int(pos), "done": bool(done)})
+        if done:
+            _begin_decode(rt)
+
+
+def on_preempt(r, slot, n_tokens):
+    """The shaping scheduler evicted this request's slot to the prefix
+    cache; the decode span closes here and a fresh queue span opens
+    (the request re-enters admission and resumes via attach)."""
+    rt = r._trace
+    if rt is None:
+        return
+    rt.tr.instant("preempt", cat="request", trace_id=rt.tid,
+                  parent=rt.root,
+                  attrs={"slot": int(slot), "tokens": int(n_tokens)})
+    rt.tr.end(rt.decode, steps=rt.steps, tokens=int(n_tokens))
+    rt.decode = None
+    rt.queue = rt.tr.begin("queue", cat="request", trace_id=rt.tid,
+                           parent=rt.root, attrs={"preempted": True})
 
 
 def on_first_token(r):

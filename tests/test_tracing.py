@@ -495,6 +495,13 @@ def _full_metrics():
     m.record_cold_start({"time_to_ready_s": 1.5, "programs": 4,
                          "loaded_from_cache": 3, "compiled": 1,
                          "cache_errors": 0, "warm": 0})
+    m.record_chunked_join()               # traffic shaping: slo section
+    m.record_chunk()
+    m.record_preemption()
+    m.record_resume()
+    m.record_replay_token()
+    m.record_slo_finish("interactive", 0.1, 0.05, 0.5, 0.1)
+    m.set_wfq_lag({"base": 1.0})
     return m
 
 

@@ -178,6 +178,178 @@ def _drive_paged_spec(point, action):
             raise RuntimeError("clean request failed after disarm")
 
 
+def _long_requests(n, seed, pmin=9, pmax=14):
+    from paddle_tpu.serving import Request
+
+    rs = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        P = int(rs.randint(pmin, pmax + 1))
+        prompt = rs.randint(2, 17, (P,)).astype(np.int32)
+        prompt[0] = 0
+        mem = rs.randn(4, 32).astype("f4")
+        out.append(Request(prompt, mem, max_new_tokens=int(
+            rs.randint(2, 8)), eos_id=1))
+    return out
+
+
+def _drive_chunked(point, action):
+    """serving.prefill_chunk cells: a paged pool with chunked prefill
+    armed, faults landing MID-CHUNK-SEQUENCE (the slot holds a
+    partially-prefilled prompt when the fault fires). Exhausted
+    retries must fail only that request, release its pages, and leave
+    the pool serving; after the drain the free list is back to initial
+    and the revived pool completes clean chunked traffic."""
+    from paddle_tpu.serving import Scheduler
+    from paddle_tpu.testing import faults
+
+    eng = _small_engine(paged=True, page_size=4, num_pages=48,
+                        prefill_chunk=4)
+    sched = Scheduler(max_queue=64)
+    plan = (dict(action="delay", delay_s=0.02, on="every", k=3)
+            if action == "delay" else dict(on="every", k=3))
+    inj = faults.inject(point, **plan)
+    accepted = []
+    try:
+        for r in _long_requests(8, seed=29):
+            sched.submit(r)
+            accepted.append(r)
+        it = 0
+        while sched.depth() > 0 or eng.occupancy() > 0:
+            eng.run_iteration(sched)
+            it += 1
+            if it > 2000:
+                raise RuntimeError("no convergence under faults")
+        fired = inj.fired
+    finally:
+        faults.reset()
+    if not fired:
+        raise RuntimeError(f"plan on {point} never fired")
+    for r in accepted:
+        if not r.future.done():
+            raise RuntimeError(f"hung future {r.id} ({point}/{action})")
+    if action == "delay":
+        for r in accepted:
+            if not r.result(timeout=0).ok:
+                raise RuntimeError("delay-only chunk fault failed a "
+                                   "request")
+    # leak check: evicted mid-chunk slots released every page
+    eng.flush_prefix_cache()
+    eng._alloc.check()
+    if eng._alloc.pages_free != eng.num_pages:
+        raise RuntimeError(
+            f"page leak: {eng._alloc.pages_free} free of "
+            f"{eng.num_pages} after chunked-prefill chaos")
+    # pool revives: clean chunked traffic completes
+    sched2 = Scheduler(max_queue=16)
+    clean = _long_requests(3, seed=31)
+    for r in clean:
+        sched2.submit(r)
+    it = 0
+    while sched2.depth() > 0 or eng.occupancy() > 0:
+        eng.run_iteration(sched2)
+        it += 1
+        if it > 500:
+            raise RuntimeError("pool dead after disarm")
+    for r in clean:
+        if not r.result(timeout=0).ok:
+            raise RuntimeError("clean request failed after disarm")
+    if eng.metrics.chunks < 1:
+        raise RuntimeError("chunked prefill never engaged")
+
+
+def _drive_preempt(point, action):
+    """serving.preempt cells: a full 2-slot paged pool running batch
+    work when interactive requests arrive through the
+    ShapingScheduler. The fault point fires BEFORE preemption mutates
+    anything, so an injected raise must abort that preemption cleanly
+    (no slot half-evicted) while every future still resolves OK; the
+    free list returns to initial and the pool revives."""
+    from paddle_tpu.serving import (Request, Scheduler,
+                                    ShapingScheduler)
+    from paddle_tpu.testing import faults
+
+    from paddle_tpu import nn
+    from paddle_tpu.nn.layer.transformer import (TransformerDecoder,
+                                                 TransformerDecoderLayer)
+    from paddle_tpu.serving import ServingEngine
+
+    np.random.seed(7)
+    layer = TransformerDecoderLayer(32, 2, 64, dropout=0.0)
+    dec = TransformerDecoder(layer, 2)
+    dec.eval()
+    embed = nn.Embedding(17, 32)
+    proj = nn.Linear(32, 17)
+    eng = ServingEngine(dec, embed, proj, num_slots=2, max_len=32,
+                        paged=True, page_size=4, num_pages=48,
+                        max_attempts=2, backoff_base_s=0.0)
+    sched = ShapingScheduler(max_queue=64, metrics=eng.metrics)
+    rs = np.random.RandomState(37)
+
+    def mk(pmin, pmax, slo):
+        P = int(rs.randint(pmin, pmax + 1))
+        prompt = rs.randint(2, 17, (P,)).astype(np.int32)
+        prompt[0] = 0
+        mem = rs.randn(4, 32).astype("f4")
+        return Request(prompt, mem, max_new_tokens=int(
+            rs.randint(4, 10)), eos_id=1, slo=slo)
+
+    plan = (dict(action="delay", delay_s=0.02, on="every", k=2)
+            if action == "delay" else dict(on="every", k=2))
+    inj = faults.inject(point, **plan)
+    reqs = []
+    try:
+        for _ in range(3):
+            r = mk(5, 9, "batch")
+            sched.submit(r)
+            reqs.append(r)
+        for _ in range(2):       # fill the pool with batch slots
+            eng.run_iteration(sched)
+        for _ in range(4):
+            r = mk(1, 4, "interactive")
+            sched.submit(r)
+            reqs.append(r)
+        it = 0
+        while sched.depth() > 0 or eng.occupancy() > 0:
+            eng.run_iteration(sched)
+            it += 1
+            if it > 2000:
+                raise RuntimeError("no convergence under faults")
+        fired = inj.fired
+    finally:
+        faults.reset()
+    if not fired:
+        raise RuntimeError(f"plan on {point} never fired")
+    for r in reqs:
+        if not r.future.done():
+            raise RuntimeError(f"hung future {r.id} ({point}/{action})")
+        if not r.result(timeout=0).ok:
+            raise RuntimeError(
+                f"request {r.id} failed under {action}: an aborted "
+                f"preemption must leave the victim running")
+    # leak check: preempted slots' pages all released or in the trie
+    eng.flush_prefix_cache()
+    eng._alloc.check()
+    if eng._alloc.pages_free != eng.num_pages:
+        raise RuntimeError(
+            f"page leak: {eng._alloc.pages_free} free of "
+            f"{eng.num_pages} after preemption chaos")
+    # pool revives on the plain FIFO
+    sched2 = Scheduler(max_queue=16)
+    clean = _requests(3, seed=41)
+    for r in clean:
+        sched2.submit(r)
+    it = 0
+    while sched2.depth() > 0 or eng.occupancy() > 0:
+        eng.run_iteration(sched2)
+        it += 1
+        if it > 500:
+            raise RuntimeError("pool dead after disarm")
+    for r in clean:
+        if not r.result(timeout=0).ok:
+            raise RuntimeError("clean request failed after disarm")
+
+
 def _drive_adapter_load(point, action):
     """serving.adapter_load cells: the multi-tenant pool under bank
     hot-load faults. `transient` (fires once) must be retried by the
@@ -413,6 +585,10 @@ MATRIX = (
     + [("serving.decode_step", a, _drive_serving)
        for a in ("raise", "delay")]
     + [("serving.decode_step[pspec]", a, _drive_paged_spec)
+       for a in ("raise", "delay")]
+    + [("serving.prefill_chunk", a, _drive_chunked)
+       for a in ("raise", "delay")]
+    + [("serving.preempt", a, _drive_preempt)
        for a in ("raise", "delay")]
     + [("serving.adapter_load", a, _drive_adapter_load)
        for a in ("raise", "delay", "transient")]

@@ -35,6 +35,7 @@ CHECKS = [
     (r"Paged speculative decoding", r"~?([\d.]+)()x tokens/s", ("serving_paged_spec", "value"), "paged-spec x-tokens/s"),
     (r"Multi-tenant serving", r"~?([\d.]+)()x aggregate tokens/s", ("serving_multitenant", "value"), "multitenant x-tokens/s"),
     (r"Radix prefix cache", r"~?([\d.]+)()x lower TTFT", ("serving_radix", "value"), "serving_radix x-ttft-at-depth"),
+    (r"Traffic shaping", r"~?([\d.]+)()x lower interactive p99 TTFT", ("serving_slo", "value"), "serving_slo x-interactive-ttft"),
     (r"Sharded serving", r"~?([\d.]+)()x lower decode-step p50", ("serving_sharded", "value"), "serving_sharded x-step-p50"),
     (r"Zero-warmup restart", r"~?([\d.]+)()x faster time-to-ready", ("cold_start", "value"), "cold_start x-ready"),
 ]

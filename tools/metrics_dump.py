@@ -34,10 +34,12 @@ def _demo_snapshot():
     enabled) under a tracer session AND an armed cost-accounting
     session, so the dump previews every snapshot section — memory
     ledger, MFU/goodput gauges, speculation counters, radix
-    prefix-cache stats, cold-start report included — and return
-    (snapshot, tracer). The workload shares an 8-token preamble so
-    the prefix section shows a whole hit, a partial (pattach) hit,
-    and misses."""
+    prefix-cache stats, cold-start report, traffic-shaping slo
+    counters included — and return (snapshot, tracer). The workload
+    shares an 8-token preamble so the prefix section shows a whole
+    hit, a partial (pattach) hit, and misses; it runs class-tagged
+    through a ShapingScheduler over a `prefill_chunk=4` pool so the
+    slo section shows chunked prefills and per-class attainment."""
     import tempfile
 
     import numpy as np
@@ -46,8 +48,9 @@ def _demo_snapshot():
     from paddle_tpu.nn.layer.transformer import (TransformerDecoder,
                                                  TransformerDecoderLayer)
     from paddle_tpu.profiler import costs
-    from paddle_tpu.serving import (AdapterPool, Request, Scheduler,
-                                    ServingEngine, session_scope)
+    from paddle_tpu.serving import (AdapterPool, Request,
+                                    ServingEngine, ShapingScheduler,
+                                    session_scope)
 
     np.random.seed(0)
     layer = TransformerDecoderLayer(32, 2, 64, dropout=0.0)
@@ -59,19 +62,19 @@ def _demo_snapshot():
     pool.register_random("t2", seed=2)
     eng = ServingEngine(dec, nn.Embedding(17, 32), nn.Linear(32, 17),
                         num_slots=4, max_len=32, spec_k=4, paged=True,
-                        page_size=4, num_pages=64,
+                        page_size=4, num_pages=64, prefill_chunk=4,
                         adapters=pool, hbm_budget_bytes=1 << 20)
-    sched = Scheduler(max_queue=16)
+    sched = ShapingScheduler(max_queue=16, metrics=eng.metrics)
     rs = np.random.RandomState(1)
     memory = rs.randn(4, 32).astype("f4")
     pre = [0, 5, 9, 2, 11, 7, 3, 14]       # shared 8-token preamble
     prompts = [
-        (pre + [6, 8], None),              # cold prefill (miss)
-        (pre + [6, 8], None),              # identical: whole hit
-        (pre + [12, 4, 10], None),         # shared 2 pages: partial hit
-        (pre + [6, 8], "t1"),              # adapter subtree: miss
-        ([0, 4, 13], "t2"),                # unrelated: miss
-        (pre + [6, 8], "t1"),              # adapter repeat: whole hit
+        (pre + [6, 8], None, "batch"),     # cold CHUNKED prefill (miss)
+        (pre + [6, 8], None, "interactive"),   # identical: whole hit
+        (pre + [12, 4, 10], None, "batch"),    # shared 2 pages: partial
+        (pre + [6, 8], "t1", "batch"),     # adapter subtree: miss
+        ([0, 4, 13], "t2", "interactive"),     # unrelated: miss
+        (pre + [6, 8], "t1", "batch"),     # adapter repeat: whole hit
     ]
     with costs.accounting_scope(), session_scope() as tr:
         # startup precompile into a throwaway AOT cache dir: the
@@ -81,10 +84,10 @@ def _demo_snapshot():
                        prompt_buckets=(4, 16),
                        cache=tempfile.mkdtemp(prefix="pt_aot_demo_"))
         reqs = []
-        for toks, name in prompts:
+        for toks, name, slo in prompts:
             r = Request(np.asarray(toks, np.int32), memory,
                         max_new_tokens=int(rs.randint(2, 8)),
-                        eos_id=1, adapter=name)
+                        eos_id=1, adapter=name, slo=slo)
             sched.submit(r)
             reqs.append(r)
         eng.serve_until_idle(sched, max_iterations=500)

@@ -201,6 +201,7 @@ def measure_bench(metric, k=1, quiet=True):
         ("serving_throughput", bench._serving_throughput),
         ("serving_paged", bench._serving_paged),
         ("serving_radix", bench._serving_radix),
+        ("serving_slo", bench._serving_slo),
         ("serving_sharded", bench._serving_sharded),
     ]).get(metric)
     if fn is None:
